@@ -1,0 +1,29 @@
+"""mcp-context-forge-tpu: a TPU-native MCP gateway framework.
+
+A ground-up rebuild of the capability set of IBM/mcp-context-forge (an MCP
+gateway / registry / proxy federating MCP servers, A2A agents and REST APIs
+behind one authenticated endpoint — see /root/reference/mcpgateway/__init__.py:6-12)
+plus a genuinely new component the reference lacks: an in-tree ``tpu_local``
+LLM provider — a JAX/XLA inference engine sharded over a TPU slice with
+continuous batching and a paged KV cache — that serves the LLM proxy, the A2A
+chat routing and the LLM-backed plugins without any outbound GPU/SaaS endpoint.
+
+Architecture is TPU-first and dependency-light by design:
+
+- HTTP stack: aiohttp (no FastAPI/granian); middleware chain + JSON-RPC
+  dispatcher + streamable-HTTP/SSE/WS transports built in-tree.
+- Persistence: sqlite3 (stdlib) behind an async repository layer (no
+  SQLAlchemy); in-tree migration runner.
+- Coordination: pluggable EventBus/Lease abstractions (memory backend
+  in-proc; file/socket backends for multi-worker) instead of Redis.
+- Compute: jax + pjit/NamedSharding over a Mesh, Pallas kernels for the
+  attention hot path, XLA collectives over ICI/DCN as the communication
+  backend.
+"""
+
+__version__ = "0.1.0"
+
+PROTOCOL_VERSION = "2025-06-18"
+"""Latest MCP protocol revision this gateway speaks."""
+
+SUPPORTED_PROTOCOL_VERSIONS = ("2024-11-05", "2025-03-26", "2025-06-18")

@@ -1,0 +1,205 @@
+"""Environment-driven settings.
+
+Capability parity with the reference's ~300-field pydantic-settings ``Settings``
+(`/root/reference/mcpgateway/config.py:187`), rebuilt without the
+pydantic-settings dependency: a plain pydantic v2 model hydrated from the
+process environment (prefix ``MCPFORGE_`` or the bare field name, reference-
+compatible) plus an optional ``.env`` file. Security posture carried over:
+startup fails hard on weak/default secrets unless explicitly in dev mode
+(reference `config.py` validate_security_configuration, wired at
+`main.py:1583`).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache
+from pathlib import Path
+from typing import Any, Literal
+
+from pydantic import BaseModel, Field, field_validator
+
+_WEAK_SECRETS = {
+    "", "changeme", "secret", "password", "my-test-key", "mysecretkey",
+    "default", "admin", "test", "jwt-secret", "dev-only-do-not-use",
+}
+
+
+class Settings(BaseModel):
+    """Gateway + engine configuration. Every field is env-overridable."""
+
+    # --- identity / serving ---
+    app_name: str = "MCP Context Forge TPU"
+    host: str = "0.0.0.0"
+    port: int = 4444
+    environment: Literal["development", "production"] = "development"
+    app_domain: str = "http://localhost:4444"
+    dev_mode: bool = True
+
+    # --- persistence ---
+    database_url: str = "sqlite:///./mcpforge.db"
+    db_pool_size: int = 8
+
+    # --- coordination (reference: Redis; here: pluggable bus) ---
+    bus_backend: Literal["memory", "file"] = "memory"
+    bus_dir: str = "/tmp/mcpforge-bus"
+    leader_lease_ttl: float = 15.0
+
+    # --- auth ---
+    auth_required: bool = True
+    jwt_secret_key: str = "dev-only-do-not-use"
+    jwt_algorithm: Literal["HS256", "HS384", "HS512"] = "HS256"
+    jwt_audience: str = "mcpforge-api"
+    jwt_issuer: str = "mcpforge"
+    token_expiry: int = 10080  # minutes
+    basic_auth_user: str = "admin"
+    basic_auth_password: str = "changeme"
+    platform_admin_email: str = "admin@example.com"
+    platform_admin_password: str = "changeme"
+    auth_encryption_secret: str = "dev-only-do-not-use"
+
+    # --- protocol / transports ---
+    protocol_version: str = "2025-06-18"
+    streamable_http_stateful: bool = False
+    sse_keepalive_interval: float = 30.0
+    session_ttl: int = 3600
+    message_ttl: int = 600
+    websocket_ping_interval: float = 20.0
+
+    # --- limits / validation ---
+    max_request_size_bytes: int = 8 * 1024 * 1024
+    max_header_bytes: int = 64 * 1024
+    rate_limit_rps: int = 0  # 0 = disabled
+    rate_limit_burst: int = 200
+    validation_max_tool_name_length: int = 255
+    max_prompt_size: int = 1024 * 1024
+
+    # --- outbound invocation ---
+    tool_timeout: float = 60.0
+    max_tool_retries: int = 3
+    retry_base_delay: float = 0.25
+    retry_max_delay: float = 8.0
+    gateway_health_interval: float = 60.0
+    gateway_failure_threshold: int = 3
+    federation_timeout: float = 30.0
+    skip_ssl_verify: bool = False
+
+    # --- plugins ---
+    plugins_enabled: bool = True
+    plugin_config_file: str = "plugins/config.yaml"
+
+    # --- observability ---
+    otel_enable: bool = True
+    otel_exporter: Literal["none", "console", "otlp", "memory"] = "memory"
+    otel_service_name: str = "mcpforge"
+    log_level: str = "INFO"
+    log_json: bool = False
+    metrics_buffer_flush_interval: float = 5.0
+
+    # --- LLM / tpu_local ---
+    llm_api_prefix: str = "/v1"
+    tpu_local_enabled: bool = True
+    tpu_local_model: str = "llama3-tiny"  # llama3-8b on real v5e-8
+    tpu_local_checkpoint: str = ""  # orbax/safetensors dir; empty = random init
+    tpu_local_max_batch: int = 64
+    tpu_local_max_seq_len: int = 2048
+    tpu_local_page_size: int = 128
+    tpu_local_num_pages: int = 512
+    tpu_local_prefill_buckets: tuple[int, ...] = (128, 512, 2048)
+    tpu_local_mesh_shape: str = "1x8"  # data x model, '' = auto single host
+    tpu_local_dtype: str = "bfloat16"
+    tpu_local_embedding_model: str = "encoder-tiny"
+
+    # --- admin / UI ---
+    admin_api_enabled: bool = True
+    admin_ui_enabled: bool = True
+
+    @field_validator("database_url")
+    @classmethod
+    def _check_db_url(cls, v: str) -> str:
+        if not (v.startswith("sqlite:///") or v.startswith("sqlite+aiosqlite:///")):
+            raise ValueError("only sqlite:/// database URLs are supported in-tree")
+        return v
+
+    @property
+    def database_path(self) -> str:
+        path = self.database_url.split("///", 1)[-1]
+        return path or ":memory:"
+
+    @property
+    def is_sqlite_memory(self) -> bool:
+        return self.database_path in (":memory:", "")
+
+    def validate_security(self) -> list[str]:
+        """Return a list of fatal security problems (empty = OK).
+
+        Mirrors the reference's hard startup failure on weak secrets
+        (CHANGELOG 1.0.6: weak-secret rejection)."""
+        problems: list[str] = []
+        if self.environment == "production" or not self.dev_mode:
+            if self.jwt_secret_key.lower() in _WEAK_SECRETS or len(self.jwt_secret_key) < 16:
+                problems.append("jwt_secret_key is weak/default")
+            if self.auth_encryption_secret.lower() in _WEAK_SECRETS or len(self.auth_encryption_secret) < 16:
+                problems.append("auth_encryption_secret is weak/default")
+            if self.basic_auth_password.lower() in _WEAK_SECRETS or len(self.basic_auth_password) < 8:
+                problems.append("basic_auth_password is weak/default")
+            if self.platform_admin_password.lower() in _WEAK_SECRETS or len(self.platform_admin_password) < 8:
+                problems.append("platform_admin_password is weak/default")
+        return problems
+
+
+def _load_env_file(path: Path) -> dict[str, str]:
+    out: dict[str, str] = {}
+    if not path.exists():
+        return out
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        k, _, v = line.partition("=")
+        out[k.strip()] = v.strip().strip('"').strip("'")
+    return out
+
+
+def load_settings(env: dict[str, str] | None = None, env_file: str | None = ".env") -> Settings:
+    """Build Settings from (explicit env dict | process env | .env file).
+
+    Precedence (highest first): explicit ``env`` dict (keys ``MCPFORGE_X``,
+    ``X`` or bare ``x``) > process environment (``MCPFORGE_X`` only, so
+    unrelated host vars like ``PORT``/``ENVIRONMENT`` cannot reconfigure the
+    gateway) > .env file (``MCPFORGE_X`` or ``X``) > field defaults.
+    """
+    file_source = _load_env_file(Path(env_file)) if env_file else {}
+    explicit = env or {}
+
+    def lookup(name: str) -> str | None:
+        upper = f"MCPFORGE_{name.upper()}"
+        for key in (upper, name.upper(), name):
+            if key in explicit:
+                return explicit[key]
+        if upper in os.environ:
+            return os.environ[upper]
+        for key in (upper, name.upper()):
+            if key in file_source:
+                return file_source[key]
+        return None
+
+    values: dict[str, Any] = {}
+    for name, field in Settings.model_fields.items():
+        raw = lookup(name)
+        if raw is None:
+            continue
+        if "tuple" in str(field.annotation):
+            values[name] = tuple(int(x) for x in str(raw).replace(",", " ").split())
+        else:
+            values[name] = raw
+    return Settings(**values)
+
+
+@lru_cache(maxsize=1)
+def get_settings() -> Settings:
+    return load_settings()
+
+
+def reset_settings_cache() -> None:
+    get_settings.cache_clear()

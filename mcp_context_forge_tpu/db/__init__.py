@@ -1,0 +1,13 @@
+"""Persistence layer: sqlite3 (stdlib) behind an async facade.
+
+Replaces the reference's SQLAlchemy models (`/root/reference/mcpgateway/db.py`,
+~70 models) and alembic tree (110 revisions) with an in-tree schema +
+migration runner. Postgres support is intentionally out of scope for the
+in-tree build; the Database interface is the seam where another backend
+would plug in.
+"""
+
+from .core import Database, Migration
+from .schema import MIGRATIONS
+
+__all__ = ["Database", "Migration", "MIGRATIONS"]

@@ -1,0 +1,187 @@
+"""Async sqlite3 database core.
+
+sqlite3 is synchronous; all statements run on a single dedicated executor
+thread (sqlite connections are not thread-safe across threads, and a shared
+in-memory DB requires one connection), so the event loop never blocks on I/O —
+the same discipline the reference enforces by releasing the DB session before
+network I/O (`/root/reference/mcpgateway/services/tool_service.py:5022`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sqlite3
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Any, Iterable, Sequence
+
+
+@dataclass(frozen=True)
+class Migration:
+    version: int
+    name: str
+    sql: str  # multiple statements allowed
+
+
+class Database:
+    """One sqlite connection on one worker thread, async API."""
+
+    def __init__(self, path: str = ":memory:"):
+        self._path = path
+        self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="db")
+        self._conn: sqlite3.Connection | None = None
+        self._lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _connect(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(self._path, check_same_thread=False)
+        conn.row_factory = sqlite3.Row
+        conn.execute("PRAGMA foreign_keys=ON")
+        if self._path not in (":memory:", ""):
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA synchronous=NORMAL")
+        return conn
+
+    def connect_sync(self) -> None:
+        if self._conn is None:
+            self._conn = self._connect()
+
+    async def connect(self) -> None:
+        await self._run(self.connect_sync)
+
+    async def close(self) -> None:
+        def _close() -> None:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+
+        await self._run(_close)
+        self._executor.shutdown(wait=False)
+
+    async def _run(self, fn, *args):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self._executor, fn, *args)
+
+    # -- statements ----------------------------------------------------------
+
+    def _execute_sync(self, sql: str, params: Sequence[Any]) -> list[dict[str, Any]]:
+        assert self._conn is not None, "Database not connected"
+        with self._lock:
+            cur = self._conn.execute(sql, params)
+            rows = [dict(r) for r in cur.fetchall()]
+            self._conn.commit()
+            return rows
+
+    def _executemany_sync(self, sql: str, seq: list[Sequence[Any]]) -> None:
+        assert self._conn is not None, "Database not connected"
+        with self._lock:
+            self._conn.executemany(sql, seq)
+            self._conn.commit()
+
+    def _executescript_sync(self, script: str) -> None:
+        assert self._conn is not None, "Database not connected"
+        with self._lock:
+            self._conn.executescript(script)
+            self._conn.commit()
+
+    async def execute(self, sql: str, params: Sequence[Any] = ()) -> list[dict[str, Any]]:
+        return await self._run(self._execute_sync, sql, params)
+
+    async def executemany(self, sql: str, seq: list[Sequence[Any]]) -> None:
+        await self._run(self._executemany_sync, sql, seq)
+
+    async def fetchone(self, sql: str, params: Sequence[Any] = ()) -> dict[str, Any] | None:
+        rows = await self.execute(sql, params)
+        return rows[0] if rows else None
+
+    async def fetchall(self, sql: str, params: Sequence[Any] = ()) -> list[dict[str, Any]]:
+        return await self.execute(sql, params)
+
+    async def transaction(self, statements: Iterable[tuple[str, Sequence[Any]]]) -> None:
+        """Run several statements atomically."""
+
+        def _tx() -> None:
+            assert self._conn is not None
+            with self._lock:
+                try:
+                    self._conn.execute("BEGIN")
+                    for sql, params in statements:
+                        self._conn.execute(sql, params)
+                    self._conn.commit()
+                except BaseException:
+                    self._conn.rollback()
+                    raise
+
+        await self._run(_tx)
+
+    # -- migrations ----------------------------------------------------------
+
+    @staticmethod
+    def _split_statements(script: str) -> list[str]:
+        """Split a multi-statement SQL script on statement boundaries
+        (sqlite3.complete_statement-aware, so ';' inside literals/triggers is safe)."""
+        statements: list[str] = []
+        buf = ""
+        for line in script.splitlines():
+            buf += line + "\n"
+            if sqlite3.complete_statement(buf):
+                if buf.strip():
+                    statements.append(buf)
+                buf = ""
+        if buf.strip():
+            statements.append(buf)
+        return statements
+
+    def migrate_sync(self, migrations: Sequence[Migration]) -> int:
+        """Apply pending migrations in version order; returns count applied.
+
+        Each migration script runs atomically: a failure mid-script rolls the
+        whole migration back (executescript would autocommit per statement and
+        wedge the schema between versions)."""
+        self.connect_sync()
+        assert self._conn is not None
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS schema_migrations ("
+                " version INTEGER PRIMARY KEY, name TEXT NOT NULL,"
+                " applied_at REAL NOT NULL)"
+            )
+            done = {r[0] for r in self._conn.execute("SELECT version FROM schema_migrations")}
+            applied = 0
+            for mig in sorted(migrations, key=lambda m: m.version):
+                if mig.version in done:
+                    continue
+                try:
+                    self._conn.execute("BEGIN")
+                    for stmt in self._split_statements(mig.sql):
+                        self._conn.execute(stmt)
+                    self._conn.execute(
+                        "INSERT INTO schema_migrations (version, name, applied_at) VALUES (?,?,?)",
+                        (mig.version, mig.name, time.time()),
+                    )
+                    self._conn.commit()
+                except BaseException:
+                    self._conn.rollback()
+                    raise
+                applied += 1
+            return applied
+
+    async def migrate(self, migrations: Sequence[Migration]) -> int:
+        return await self._run(self.migrate_sync, migrations)
+
+
+def to_json(value: Any) -> str:
+    return json.dumps(value, separators=(",", ":"), ensure_ascii=False)
+
+
+def from_json(value: str | None, default: Any = None) -> Any:
+    if value is None or value == "":
+        return default
+    try:
+        return json.loads(value)
+    except (json.JSONDecodeError, TypeError):
+        return default

@@ -1,0 +1,337 @@
+"""Schema migrations.
+
+Covers the reference's core model families (`/root/reference/mcpgateway/db.py`:
+Tool :3246, Resource :3659, Prompt :4050, Server :4386, Gateway :4686,
+A2AAgent :4891, EmailUser/Team :1457-2399, Role/Permissions :1154-1308,
+metrics :2556-2848, Observability :2849-3097, LLMProvider/LLMModel :6447/6533,
+AuditTrail :6605, plugin bindings :6856/6932) as sqlite DDL. JSON-valued
+columns are TEXT holding canonical JSON.
+"""
+
+from __future__ import annotations
+
+from .core import Migration
+
+_V1 = """
+CREATE TABLE IF NOT EXISTS gateways (
+  id TEXT PRIMARY KEY,
+  name TEXT NOT NULL UNIQUE,
+  url TEXT NOT NULL,
+  description TEXT,
+  transport TEXT NOT NULL DEFAULT 'streamablehttp',  -- streamablehttp|sse
+  auth_type TEXT,                                    -- none|basic|bearer|headers|oauth
+  auth_value TEXT,                                   -- encrypted JSON
+  capabilities TEXT,                                 -- JSON from initialize
+  enabled INTEGER NOT NULL DEFAULT 1,
+  reachable INTEGER NOT NULL DEFAULT 0,
+  state TEXT NOT NULL DEFAULT 'pending',             -- pending|active|failed|deleting
+  failure_count INTEGER NOT NULL DEFAULT 0,
+  last_seen REAL,
+  passthrough_headers TEXT,                          -- JSON list
+  tags TEXT,                                         -- JSON list
+  team_id TEXT,
+  owner_email TEXT,
+  visibility TEXT NOT NULL DEFAULT 'public',
+  created_at REAL NOT NULL,
+  updated_at REAL NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS tools (
+  id TEXT PRIMARY KEY,
+  original_name TEXT NOT NULL,
+  custom_name TEXT,
+  display_name TEXT,
+  description TEXT,
+  integration_type TEXT NOT NULL DEFAULT 'MCP',      -- MCP|REST|A2A|GRPC
+  request_type TEXT NOT NULL DEFAULT 'POST',
+  url TEXT,
+  input_schema TEXT,                                 -- JSON schema
+  output_schema TEXT,
+  annotations TEXT,                                  -- JSON
+  headers TEXT,                                      -- JSON
+  auth_type TEXT,
+  auth_value TEXT,                                   -- encrypted JSON
+  jsonpath_filter TEXT,
+  gateway_id TEXT REFERENCES gateways(id) ON DELETE CASCADE,
+  enabled INTEGER NOT NULL DEFAULT 1,
+  reachable INTEGER NOT NULL DEFAULT 1,
+  tags TEXT,
+  team_id TEXT,
+  owner_email TEXT,
+  visibility TEXT NOT NULL DEFAULT 'public',
+  created_at REAL NOT NULL,
+  updated_at REAL NOT NULL
+);
+CREATE UNIQUE INDEX IF NOT EXISTS ix_tools_name_gateway
+  ON tools(original_name, COALESCE(gateway_id, ''));
+CREATE INDEX IF NOT EXISTS ix_tools_gateway ON tools(gateway_id);
+
+CREATE TABLE IF NOT EXISTS resources (
+  id TEXT PRIMARY KEY,
+  uri TEXT NOT NULL,
+  name TEXT NOT NULL,
+  description TEXT,
+  mime_type TEXT,
+  uri_template TEXT,
+  content TEXT,                                      -- inline content (text or b64)
+  is_binary INTEGER NOT NULL DEFAULT 0,
+  size INTEGER,
+  gateway_id TEXT REFERENCES gateways(id) ON DELETE CASCADE,
+  enabled INTEGER NOT NULL DEFAULT 1,
+  tags TEXT,
+  team_id TEXT,
+  owner_email TEXT,
+  visibility TEXT NOT NULL DEFAULT 'public',
+  created_at REAL NOT NULL,
+  updated_at REAL NOT NULL
+);
+CREATE UNIQUE INDEX IF NOT EXISTS ix_resources_uri_gateway
+  ON resources(uri, COALESCE(gateway_id, ''));
+
+CREATE TABLE IF NOT EXISTS prompts (
+  id TEXT PRIMARY KEY,
+  name TEXT NOT NULL,
+  description TEXT,
+  template TEXT NOT NULL,
+  arguments TEXT,                                    -- JSON list of {name,description,required}
+  gateway_id TEXT REFERENCES gateways(id) ON DELETE CASCADE,
+  enabled INTEGER NOT NULL DEFAULT 1,
+  tags TEXT,
+  team_id TEXT,
+  owner_email TEXT,
+  visibility TEXT NOT NULL DEFAULT 'public',
+  created_at REAL NOT NULL,
+  updated_at REAL NOT NULL
+);
+CREATE UNIQUE INDEX IF NOT EXISTS ix_prompts_name_gateway
+  ON prompts(name, COALESCE(gateway_id, ''));
+
+CREATE TABLE IF NOT EXISTS servers (
+  id TEXT PRIMARY KEY,
+  name TEXT NOT NULL UNIQUE,
+  description TEXT,
+  icon TEXT,
+  enabled INTEGER NOT NULL DEFAULT 1,
+  tags TEXT,
+  team_id TEXT,
+  owner_email TEXT,
+  visibility TEXT NOT NULL DEFAULT 'public',
+  created_at REAL NOT NULL,
+  updated_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS server_tools (
+  server_id TEXT NOT NULL REFERENCES servers(id) ON DELETE CASCADE,
+  tool_id TEXT NOT NULL REFERENCES tools(id) ON DELETE CASCADE,
+  PRIMARY KEY (server_id, tool_id)
+);
+CREATE TABLE IF NOT EXISTS server_resources (
+  server_id TEXT NOT NULL REFERENCES servers(id) ON DELETE CASCADE,
+  resource_id TEXT NOT NULL REFERENCES resources(id) ON DELETE CASCADE,
+  PRIMARY KEY (server_id, resource_id)
+);
+CREATE TABLE IF NOT EXISTS server_prompts (
+  server_id TEXT NOT NULL REFERENCES servers(id) ON DELETE CASCADE,
+  prompt_id TEXT NOT NULL REFERENCES prompts(id) ON DELETE CASCADE,
+  PRIMARY KEY (server_id, prompt_id)
+);
+
+CREATE TABLE IF NOT EXISTS a2a_agents (
+  id TEXT PRIMARY KEY,
+  name TEXT NOT NULL UNIQUE,
+  slug TEXT NOT NULL UNIQUE,
+  description TEXT,
+  endpoint_url TEXT NOT NULL,
+  agent_type TEXT NOT NULL DEFAULT 'jsonrpc',        -- jsonrpc|openai|anthropic|custom|tpu_local
+  protocol_version TEXT NOT NULL DEFAULT '1.0',
+  capabilities TEXT,
+  config TEXT,
+  auth_type TEXT,
+  auth_value TEXT,
+  enabled INTEGER NOT NULL DEFAULT 1,
+  reachable INTEGER NOT NULL DEFAULT 1,
+  tags TEXT,
+  team_id TEXT,
+  owner_email TEXT,
+  visibility TEXT NOT NULL DEFAULT 'public',
+  created_at REAL NOT NULL,
+  updated_at REAL NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS users (
+  email TEXT PRIMARY KEY,
+  password_hash TEXT NOT NULL,
+  full_name TEXT,
+  is_admin INTEGER NOT NULL DEFAULT 0,
+  is_active INTEGER NOT NULL DEFAULT 1,
+  auth_provider TEXT NOT NULL DEFAULT 'local',
+  failed_login_attempts INTEGER NOT NULL DEFAULT 0,
+  locked_until REAL,
+  last_login REAL,
+  created_at REAL NOT NULL,
+  updated_at REAL NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS teams (
+  id TEXT PRIMARY KEY,
+  name TEXT NOT NULL,
+  slug TEXT NOT NULL UNIQUE,
+  description TEXT,
+  is_personal INTEGER NOT NULL DEFAULT 0,
+  visibility TEXT NOT NULL DEFAULT 'private',
+  created_by TEXT,
+  created_at REAL NOT NULL,
+  updated_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS team_members (
+  team_id TEXT NOT NULL REFERENCES teams(id) ON DELETE CASCADE,
+  user_email TEXT NOT NULL REFERENCES users(email) ON DELETE CASCADE,
+  role TEXT NOT NULL DEFAULT 'member',               -- owner|member
+  joined_at REAL NOT NULL,
+  PRIMARY KEY (team_id, user_email)
+);
+CREATE TABLE IF NOT EXISTS team_invitations (
+  id TEXT PRIMARY KEY,
+  team_id TEXT NOT NULL REFERENCES teams(id) ON DELETE CASCADE,
+  email TEXT NOT NULL,
+  role TEXT NOT NULL DEFAULT 'member',
+  token TEXT NOT NULL UNIQUE,
+  invited_by TEXT,
+  expires_at REAL NOT NULL,
+  accepted_at REAL,
+  created_at REAL NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS roles (
+  id TEXT PRIMARY KEY,
+  name TEXT NOT NULL UNIQUE,
+  description TEXT,
+  scope TEXT NOT NULL DEFAULT 'global',              -- global|team
+  permissions TEXT NOT NULL,                         -- JSON list
+  is_system INTEGER NOT NULL DEFAULT 0,
+  created_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS user_roles (
+  user_email TEXT NOT NULL,
+  role_id TEXT NOT NULL REFERENCES roles(id) ON DELETE CASCADE,
+  scope_id TEXT NOT NULL DEFAULT '',                 -- team id when scope=team
+  granted_by TEXT,
+  granted_at REAL NOT NULL,
+  PRIMARY KEY (user_email, role_id, scope_id)
+);
+
+CREATE TABLE IF NOT EXISTS api_tokens (
+  id TEXT PRIMARY KEY,
+  user_email TEXT NOT NULL,
+  name TEXT NOT NULL,
+  jti TEXT NOT NULL UNIQUE,
+  token_hash TEXT NOT NULL,
+  server_id TEXT,                                    -- server-scoped token
+  permissions TEXT,                                  -- JSON scope list
+  team_id TEXT,
+  expires_at REAL,
+  last_used REAL,
+  revoked_at REAL,
+  created_at REAL NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS tool_metrics (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  tool_id TEXT NOT NULL,
+  ts REAL NOT NULL,
+  duration_ms REAL NOT NULL,
+  success INTEGER NOT NULL,
+  error TEXT
+);
+CREATE INDEX IF NOT EXISTS ix_tool_metrics_tool_ts ON tool_metrics(tool_id, ts);
+CREATE TABLE IF NOT EXISTS metrics_rollups (
+  entity_type TEXT NOT NULL,
+  entity_id TEXT NOT NULL,
+  hour INTEGER NOT NULL,
+  count INTEGER NOT NULL,
+  errors INTEGER NOT NULL,
+  total_ms REAL NOT NULL,
+  min_ms REAL,
+  max_ms REAL,
+  PRIMARY KEY (entity_type, entity_id, hour)
+);
+
+CREATE TABLE IF NOT EXISTS observability_traces (
+  trace_id TEXT PRIMARY KEY,
+  name TEXT NOT NULL,
+  start_ts REAL NOT NULL,
+  end_ts REAL,
+  status TEXT,
+  attributes TEXT
+);
+CREATE TABLE IF NOT EXISTS observability_spans (
+  span_id TEXT PRIMARY KEY,
+  trace_id TEXT NOT NULL,
+  parent_span_id TEXT,
+  name TEXT NOT NULL,
+  start_ts REAL NOT NULL,
+  end_ts REAL,
+  status TEXT,
+  attributes TEXT
+);
+CREATE INDEX IF NOT EXISTS ix_obs_spans_trace ON observability_spans(trace_id);
+
+CREATE TABLE IF NOT EXISTS llm_providers (
+  id TEXT PRIMARY KEY,
+  name TEXT NOT NULL UNIQUE,
+  provider_type TEXT NOT NULL,                       -- tpu_local|openai|anthropic|openai_compatible|...
+  api_base TEXT,
+  config TEXT,                                       -- encrypted JSON
+  enabled INTEGER NOT NULL DEFAULT 1,
+  created_at REAL NOT NULL,
+  updated_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS llm_models (
+  id TEXT PRIMARY KEY,
+  provider_id TEXT NOT NULL REFERENCES llm_providers(id) ON DELETE CASCADE,
+  model_id TEXT NOT NULL,                            -- provider-side id
+  alias TEXT NOT NULL UNIQUE,                        -- gateway-side name
+  supports_chat INTEGER NOT NULL DEFAULT 1,
+  supports_embeddings INTEGER NOT NULL DEFAULT 0,
+  config TEXT,
+  enabled INTEGER NOT NULL DEFAULT 1,
+  created_at REAL NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS audit_trail (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  ts REAL NOT NULL,
+  actor TEXT,
+  action TEXT NOT NULL,
+  entity_type TEXT,
+  entity_id TEXT,
+  details TEXT
+);
+
+CREATE TABLE IF NOT EXISTS global_config (
+  key TEXT PRIMARY KEY,
+  value TEXT,
+  updated_at REAL NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS plugin_bindings (
+  id TEXT PRIMARY KEY,
+  plugin_name TEXT NOT NULL,
+  scope_type TEXT NOT NULL,                          -- tool|a2a|team|global
+  scope_id TEXT,
+  mode TEXT,                                         -- override mode
+  config TEXT,
+  enabled INTEGER NOT NULL DEFAULT 1,
+  created_at REAL NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS resource_subscriptions (
+  id TEXT PRIMARY KEY,
+  uri TEXT NOT NULL,
+  session_id TEXT NOT NULL,
+  created_at REAL NOT NULL
+);
+"""
+
+MIGRATIONS: list[Migration] = [
+    Migration(1, "initial-core-schema", _V1),
+]

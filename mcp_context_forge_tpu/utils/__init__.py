@@ -1,0 +1,1 @@
+"""Shared utilities (reference: /root/reference/mcpgateway/utils/ — 45 modules)."""

@@ -1,0 +1,54 @@
+"""Field encryption for stored secrets.
+
+Parity with the reference's AES-256-GCM field encryption
+(`/root/reference/mcpgateway/services/encryption_service.py:109`): secrets at
+rest (gateway auth headers, LLM provider configs, export bundles) are sealed
+with a key derived from ``auth_encryption_secret``.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+from typing import Any
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+_MAGIC = "enc:v1:"
+
+
+class DecryptionError(Exception):
+    """Sealed value could not be opened (wrong key, corruption, truncation)."""
+
+
+def _derive_key(secret: str) -> bytes:
+    return hashlib.sha256(("mcpforge-field-enc:" + secret).encode()).digest()
+
+
+def encrypt_field(value: Any, secret: str) -> str:
+    """Seal a JSON-serializable value. Output is ASCII-safe."""
+    key = _derive_key(secret)
+    nonce = os.urandom(12)
+    plaintext = json.dumps(value, separators=(",", ":")).encode()
+    ct = AESGCM(key).encrypt(nonce, plaintext, None)
+    return _MAGIC + base64.urlsafe_b64encode(nonce + ct).decode()
+
+
+def decrypt_field(token: str | None, secret: str) -> Any:
+    """Open a sealed value; passthrough for legacy/plaintext values."""
+    if token is None:
+        return None
+    if not token.startswith(_MAGIC):
+        try:
+            return json.loads(token)
+        except (json.JSONDecodeError, TypeError):
+            return token
+    try:
+        raw = base64.urlsafe_b64decode(token[len(_MAGIC):].encode())
+        nonce, ct = raw[:12], raw[12:]
+        plaintext = AESGCM(_derive_key(secret)).decrypt(nonce, ct, None)
+        return json.loads(plaintext)
+    except Exception as exc:
+        raise DecryptionError(f"Cannot decrypt sealed field: {type(exc).__name__}") from exc

@@ -1,0 +1,26 @@
+"""ID + slug helpers."""
+
+from __future__ import annotations
+
+import re
+import uuid
+
+
+def new_id() -> str:
+    return uuid.uuid4().hex
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+_slug_re = re.compile(r"[^a-z0-9]+")
+
+
+def slugify(name: str) -> str:
+    s = _slug_re.sub("-", name.lower()).strip("-")
+    return s or new_id()[:8]

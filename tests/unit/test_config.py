@@ -1,0 +1,37 @@
+from mcp_context_forge_tpu.config import Settings, load_settings
+
+
+def test_defaults():
+    s = load_settings(env={"MCPFORGE_DATABASE_URL": "sqlite:///:memory:"}, env_file=None)
+    assert s.port == 4444
+    assert s.database_path == ":memory:"
+    assert s.is_sqlite_memory
+
+
+def test_env_override():
+    s = load_settings(env={"MCPFORGE_PORT": "9999", "MCPFORGE_AUTH_REQUIRED": "false"}, env_file=None)
+    assert s.port == 9999
+    assert s.auth_required is False
+
+
+def test_weak_secret_rejected_in_production():
+    s = Settings(environment="production", dev_mode=False)
+    problems = s.validate_security()
+    assert any("jwt_secret_key" in p for p in problems)
+
+
+def test_strong_secrets_pass():
+    s = Settings(
+        environment="production",
+        dev_mode=False,
+        jwt_secret_key="x" * 32,
+        auth_encryption_secret="y" * 32,
+        basic_auth_password="Str0ng!pass-word",
+        platform_admin_password="Als0-Str0ng!pass",
+    )
+    assert s.validate_security() == []
+
+
+def test_tuple_field_parsing():
+    s = load_settings(env={"MCPFORGE_TPU_LOCAL_PREFILL_BUCKETS": "64,256,1024"}, env_file=None)
+    assert s.tpu_local_prefill_buckets == (64, 256, 1024)

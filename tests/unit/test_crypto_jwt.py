@@ -1,0 +1,52 @@
+import time
+
+import pytest
+
+from mcp_context_forge_tpu.utils import jwt
+from mcp_context_forge_tpu.utils.crypto import decrypt_field, encrypt_field
+
+SECRET = "test-secret-0123456789abcdef"
+
+
+def test_encrypt_roundtrip():
+    value = {"authorization": "Bearer abc", "nested": [1, 2, 3]}
+    sealed = encrypt_field(value, SECRET)
+    assert sealed.startswith("enc:v1:")
+    assert decrypt_field(sealed, SECRET) == value
+
+
+def test_decrypt_plaintext_passthrough():
+    assert decrypt_field('{"a": 1}', SECRET) == {"a": 1}
+    assert decrypt_field("rawstring", SECRET) == "rawstring"
+    assert decrypt_field(None, SECRET) is None
+
+
+def test_jwt_roundtrip():
+    tok = jwt.create_token({"sub": "admin@example.com"}, SECRET, expires_minutes=5,
+                           audience="aud", issuer="iss")
+    payload = jwt.decode(tok, SECRET, audience="aud", issuer="iss")
+    assert payload["sub"] == "admin@example.com"
+
+
+def test_jwt_bad_signature():
+    tok = jwt.create_token({"sub": "x"}, SECRET)
+    with pytest.raises(jwt.JWTError):
+        jwt.decode(tok, "other-secret")
+
+
+def test_jwt_expired():
+    tok = jwt.encode({"sub": "x", "exp": time.time() - 10}, SECRET)
+    with pytest.raises(jwt.JWTError, match="expired"):
+        jwt.decode(tok, SECRET)
+
+
+def test_jwt_wrong_audience():
+    tok = jwt.create_token({"sub": "x"}, SECRET, audience="a")
+    with pytest.raises(jwt.JWTError, match="audience"):
+        jwt.decode(tok, SECRET, audience="b")
+
+
+def test_jwt_alg_not_allowed():
+    tok = jwt.create_token({"sub": "x"}, SECRET, algorithm="HS512")
+    with pytest.raises(jwt.JWTError):
+        jwt.decode(tok, SECRET, algorithms=("HS256",))

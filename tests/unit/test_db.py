@@ -1,0 +1,56 @@
+import time
+
+from mcp_context_forge_tpu.db import MIGRATIONS, Database
+
+
+async def test_migrate_and_crud():
+    db = Database(":memory:")
+    await db.connect()
+    applied = await db.migrate(MIGRATIONS)
+    assert applied == len(MIGRATIONS)
+    # idempotent
+    assert await db.migrate(MIGRATIONS) == 0
+
+    now = time.time()
+    await db.execute(
+        "INSERT INTO gateways (id, name, url, created_at, updated_at) VALUES (?,?,?,?,?)",
+        ("g1", "peer", "http://peer:4444/mcp", now, now),
+    )
+    row = await db.fetchone("SELECT * FROM gateways WHERE id=?", ("g1",))
+    assert row is not None and row["name"] == "peer"
+    await db.close()
+
+
+async def test_transaction_rollback():
+    db = Database(":memory:")
+    await db.connect()
+    await db.migrate(MIGRATIONS)
+    now = time.time()
+    try:
+        await db.transaction([
+            ("INSERT INTO teams (id,name,slug,created_at,updated_at) VALUES (?,?,?,?,?)",
+             ("t1", "a", "a", now, now)),
+            ("INSERT INTO teams (id,name,slug,created_at,updated_at) VALUES (?,?,?,?,?)",
+             ("t2", "b", "a", now, now)),  # duplicate slug -> fails
+        ])
+    except Exception:
+        pass
+    rows = await db.fetchall("SELECT * FROM teams")
+    assert rows == []
+    await db.close()
+
+
+async def test_unique_tool_name_per_gateway():
+    db = Database(":memory:")
+    await db.connect()
+    await db.migrate(MIGRATIONS)
+    now = time.time()
+    sql = "INSERT INTO tools (id, original_name, created_at, updated_at) VALUES (?,?,?,?)"
+    await db.execute(sql, ("t1", "echo", now, now))
+    try:
+        await db.execute(sql, ("t2", "echo", now, now))
+        raised = False
+    except Exception:
+        raised = True
+    assert raised
+    await db.close()

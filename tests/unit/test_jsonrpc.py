@@ -1,0 +1,50 @@
+import pytest
+
+from mcp_context_forge_tpu import jsonrpc
+
+
+def test_parse_valid_request():
+    req = jsonrpc.RPCRequest.parse({"jsonrpc": "2.0", "method": "tools/list", "id": 1})
+    assert req.method == "tools/list"
+    assert req.id == 1
+    assert not req.is_notification
+
+
+def test_parse_notification():
+    req = jsonrpc.RPCRequest.parse({"jsonrpc": "2.0", "method": "notifications/initialized"})
+    assert req.is_notification
+
+
+@pytest.mark.parametrize("bad", [
+    {"method": "x"},
+    {"jsonrpc": "1.0", "method": "x"},
+    {"jsonrpc": "2.0"},
+    {"jsonrpc": "2.0", "method": ""},
+    {"jsonrpc": "2.0", "method": "x", "params": 42},
+    {"jsonrpc": "2.0", "method": "x", "id": True},
+    {"jsonrpc": "2.0", "method": "x", "id": {"k": 1}},
+    [],
+    "nope",
+])
+def test_parse_invalid_requests(bad):
+    with pytest.raises(jsonrpc.JSONRPCError):
+        jsonrpc.RPCRequest.parse(bad)
+
+
+def test_parse_body_size_limit():
+    with pytest.raises(jsonrpc.JSONRPCError) as ei:
+        jsonrpc.parse_body(b"x" * 100, max_size=10)
+    assert ei.value.code == jsonrpc.CONTENT_TOO_LARGE
+
+
+def test_method_registry():
+    reg = jsonrpc.MCPMethodRegistry()
+    assert reg.is_known("tools/call")
+    assert not reg.is_known("bogus/method")
+    reg.register("ui/appbridge/connect")
+    assert reg.is_known("ui/appbridge/connect")
+
+
+def test_error_response_shape():
+    resp = jsonrpc.error_response(7, jsonrpc.METHOD_NOT_FOUND, "nope")
+    assert resp == {"jsonrpc": "2.0", "id": 7, "error": {"code": -32601, "message": "nope"}}
