@@ -1,0 +1,61 @@
+"""CLI entry point (reference: mcpgateway/cli.py uvicorn launcher).
+
+Subcommands: serve (default), token (mint an admin JWT), export/import
+(config snapshot — wired when export_service lands)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="mcpforge",
+                                     description="TPU-native MCP gateway")
+    sub = parser.add_subparsers(dest="command")
+
+    serve = sub.add_parser("serve", help="run the gateway")
+    serve.add_argument("--host", default=None)
+    serve.add_argument("--port", type=int, default=None)
+
+    token = sub.add_parser("token", help="mint a JWT for an email")
+    token.add_argument("email")
+    token.add_argument("--expires-minutes", type=int, default=60)
+
+    sub.add_parser("version", help="print version")
+
+    args = parser.parse_args(argv)
+    command = args.command or "serve"
+
+    if command == "version":
+        from . import __version__
+        print(__version__)
+        return 0
+
+    from .config import get_settings
+    settings = get_settings()
+
+    if command == "token":
+        from .utils import jwt
+        print(jwt.create_token({"sub": args.email}, settings.jwt_secret_key,
+                               settings.jwt_algorithm,
+                               expires_minutes=args.expires_minutes,
+                               audience=settings.jwt_audience,
+                               issuer=settings.jwt_issuer))
+        return 0
+
+    if command == "serve":
+        if args.host:
+            settings = settings.model_copy(update={"host": args.host})
+        if args.port:
+            settings = settings.model_copy(update={"port": args.port})
+        from .gateway.app import run
+        run(settings)
+        return 0
+
+    parser.print_help()
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+"""Outbound protocol clients (MCP over streamable-HTTP/SSE, REST, A2A)."""

@@ -1,0 +1,29 @@
+"""Cross-worker coordination primitives.
+
+The reference coordinates Gunicorn workers and gateway replicas through Redis
+(pub/sub for invalidation + notifications, `SET NX EX` leases for leader
+election, heartbeat keys for session affinity — see
+`/root/reference/mcpgateway/services/leader_election.py:8-12`,
+`services/session_affinity.py:208-265`, `plugins/__init__.py:46-48`).
+
+Redis is not part of this build; the same contracts are expressed as small
+interfaces with two in-tree backends:
+
+- ``memory``  — single-process asyncio implementation (default; exact for a
+  single gateway process, which is also the deployment shape that owns one
+  TPU slice via ``tpu_local``).
+- ``file``    — shared-filesystem implementation (sqlite-backed bus db +
+  lockfile leases) for multi-worker single-host deployments.
+
+The interface is the seam where a networked backend (Redis, etcd) would plug
+in for multi-host fleets.
+"""
+
+from .bus import EventBus, MemoryEventBus, FileEventBus, make_bus
+from .leases import LeaseManager, MemoryLeaseManager, FileLeaseManager, LeaderElector, make_lease_manager
+
+__all__ = [
+    "EventBus", "MemoryEventBus", "FileEventBus", "make_bus",
+    "LeaseManager", "MemoryLeaseManager", "FileLeaseManager", "LeaderElector",
+    "make_lease_manager",
+]

@@ -1,0 +1,1 @@
+"""HTTP gateway: aiohttp app, middleware, JSON-RPC dispatch, transports."""

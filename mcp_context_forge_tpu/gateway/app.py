@@ -1,0 +1,144 @@
+"""Application factory + lifespan.
+
+Reference: `lifespan()` in `/root/reference/mcpgateway/main.py:1429-1760` —
+logging → DB bootstrap → bus → services → plugins → telemetry → transports.
+Same ordering here via aiohttp cleanup contexts.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import AsyncIterator
+
+from aiohttp import web
+
+from ..config import Settings, get_settings
+from ..coordination import make_bus, make_lease_manager
+from ..coordination.leases import LeaderElector
+from ..db import Database, MIGRATIONS
+from ..observability import init_tracer, PrometheusRegistry
+from ..observability.logging import init_logging
+from ..services.auth_service import AuthService
+from ..services.base import AppContext
+from ..services.gateway_service import GatewayService
+from ..services.prompt_service import PromptService
+from ..services.resource_service import ResourceService
+from ..services.server_service import ServerService
+from ..services.tool_service import ToolService
+from .middleware import MIDDLEWARES, RateLimiter
+from .routers import setup_routes
+from .rpc import RPCDispatcher
+from .transports.streamable_http import StreamableHTTPTransport
+from ..jsonrpc import JSONRPCError, RPCRequest, parse_body
+
+logger = logging.getLogger(__name__)
+
+
+async def build_app(settings: Settings | None = None) -> web.Application:
+    settings = settings or get_settings()
+    init_logging(settings.log_level, settings.log_json)
+
+    problems = settings.validate_security()
+    if problems:
+        raise RuntimeError(f"Refusing to start with insecure configuration: {problems}")
+
+    app = web.Application(middlewares=MIDDLEWARES,
+                          client_max_size=settings.max_request_size_bytes)
+
+    db = Database(settings.database_path)
+    await db.connect()
+    await db.migrate(MIGRATIONS)
+
+    bus = make_bus(settings.bus_backend, settings.bus_dir)
+    leases = make_lease_manager(settings.bus_backend, settings.bus_dir)
+    tracer = init_tracer(settings.otel_service_name,
+                         settings.otel_exporter if settings.otel_enable else "none")
+    metrics = PrometheusRegistry()
+
+    ctx = AppContext(settings=settings, db=db, bus=bus, leases=leases,
+                     tracer=tracer, metrics=metrics)
+    app["ctx"] = ctx
+    app["rate_limiter"] = RateLimiter(settings.rate_limit_rps, settings.rate_limit_burst)
+
+    # services
+    auth_service = AuthService(ctx)
+    tool_service = ToolService(ctx)
+    gateway_service = GatewayService(ctx)
+    resource_service = ResourceService(ctx)
+    prompt_service = PromptService(ctx)
+    server_service = ServerService(ctx)
+    app["auth_service"] = auth_service
+    app["tool_service"] = tool_service
+    app["gateway_service"] = gateway_service
+    app["resource_service"] = resource_service
+    app["prompt_service"] = prompt_service
+    app["server_service"] = server_service
+
+    # plugins (optional, loaded if configured)
+    if settings.plugins_enabled:
+        from ..plugins.framework import PluginManager
+        pm = await PluginManager.load(ctx)
+        ctx.plugin_manager = pm
+        app["plugin_manager"] = pm
+
+    # dispatcher + transports
+    dispatcher = RPCDispatcher(ctx, tool_service, resource_service, prompt_service,
+                               server_service)
+    app["dispatcher"] = dispatcher
+    transport = StreamableHTTPTransport(dispatcher, settings)
+    app["streamable_transport"] = transport
+    app.router.add_post("/mcp", transport.handle_post)
+    app.router.add_get("/mcp", transport.handle_get)
+    app.router.add_delete("/mcp", transport.handle_delete)
+    app.router.add_post("/servers/{server_id}/mcp", transport.handle_post)
+    app.router.add_get("/servers/{server_id}/mcp", transport.handle_get)
+
+    async def handle_rpc(request: web.Request) -> web.Response:
+        raw = await request.read()
+        headers = {k.lower(): v for k, v in request.headers.items()}
+        payload: object = None
+        try:
+            payload = parse_body(raw, settings.max_request_size_bytes)
+            rpc_request = RPCRequest.parse(payload)
+            response = await request.app["dispatcher"].dispatch(
+                rpc_request, request["auth"], headers=headers)
+        except JSONRPCError as exc:
+            rid = payload.get("id") if isinstance(payload, dict) else None
+            return web.json_response(exc.to_dict(rid))
+        if response is None:
+            return web.Response(status=202)
+        return web.json_response(response)
+
+    app.router.add_post("/rpc", handle_rpc)
+    setup_routes(app)
+
+    async def lifecycle(app: web.Application) -> AsyncIterator[None]:
+        await bus.start()
+        await transport.sessions.start_sweeper()
+        await auth_service.bootstrap_admin()
+        elector = LeaderElector(leases, "gateway-leader", ctx.worker_id,
+                                ttl=settings.leader_lease_ttl)
+        ctx.extras["leader_elector"] = elector
+        await elector.start()
+        await gateway_service.start_health_loop()
+        logger.info("%s started (worker %s)", settings.app_name, ctx.worker_id)
+        yield
+        await transport.sessions.stop_sweeper()
+        await gateway_service.stop_health_loop()
+        await elector.stop()
+        if ctx.llm_registry is not None:
+            await ctx.llm_registry.shutdown()
+        await bus.stop()
+        await db.close()
+
+    app.cleanup_ctx.append(lifecycle)
+    return app
+
+
+def run(settings: Settings | None = None) -> None:
+    settings = settings or get_settings()
+
+    async def _factory() -> web.Application:
+        return await build_app(settings)
+
+    web.run_app(_factory(), host=settings.host, port=settings.port)

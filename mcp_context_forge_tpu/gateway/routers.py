@@ -1,0 +1,351 @@
+"""REST API routers (reference: mcpgateway/main.py protocol routers +
+mcpgateway/routers/ — 28 routers). Table-driven CRUD over the services plus
+auth, metrics, admin observability endpoints."""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from aiohttp import web
+from pydantic import ValidationError
+
+from ..observability.logging import ring_buffer
+from ..schemas import (
+    A2AAgentCreate,
+    GatewayCreate,
+    GatewayUpdate,
+    PromptCreate,
+    PromptUpdate,
+    ResourceCreate,
+    ResourceUpdate,
+    ServerCreate,
+    ServerUpdate,
+    ToolCreate,
+    ToolUpdate,
+)
+from ..services.auth_service import AuthError
+from ..services.base import ValidationFailure
+
+
+def _dump(model) -> Any:
+    if isinstance(model, list):
+        return [_dump(m) for m in model]
+    return json.loads(model.model_dump_json())
+
+
+async def _body(request: web.Request, schema):
+    try:
+        return schema.model_validate(await request.json())
+    except json.JSONDecodeError as exc:
+        raise ValidationFailure(f"Invalid JSON body: {exc}") from exc
+    except ValidationError as exc:
+        raise ValidationFailure(str(exc)) from exc
+
+
+def setup_routes(app: web.Application) -> None:
+    routes = web.RouteTableDef()
+
+    # ----------------------------------------------------------- health/meta
+    @routes.get("/health")
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response({"status": "healthy"})
+
+    @routes.get("/ready")
+    async def ready(request: web.Request) -> web.Response:
+        try:
+            await request.app["ctx"].db.execute("SELECT 1")
+            return web.json_response({"status": "ready"})
+        except Exception as exc:
+            return web.json_response({"status": "not ready", "detail": str(exc)}, status=503)
+
+    @routes.get("/.well-known/mcp")
+    async def well_known(request: web.Request) -> web.Response:
+        settings = request.app["ctx"].settings
+        return web.json_response({
+            "name": settings.app_name,
+            "protocolVersion": settings.protocol_version,
+            "endpoints": {"mcp": "/mcp", "rpc": "/rpc"},
+        })
+
+    @routes.get("/version")
+    async def version(request: web.Request) -> web.Response:
+        from .. import __version__
+        return web.json_response({"version": __version__})
+
+    # ----------------------------------------------------------------- auth
+    @routes.post("/auth/login")
+    async def login(request: web.Request) -> web.Response:
+        body = await request.json()
+        auth_service = request.app["auth_service"]
+        email = body.get("email") or body.get("username") or ""
+        password = body.get("password") or ""
+        if not await auth_service.verify_password(email, password):
+            raise AuthError("Invalid credentials")
+        token = auth_service.issue_jwt(email)
+        return web.json_response({"access_token": token, "token_type": "bearer"})
+
+    @routes.post("/auth/tokens")
+    async def create_token(request: web.Request) -> web.Response:
+        auth = request["auth"]
+        auth.require("tokens.manage")
+        body = await request.json()
+        token, token_id = await request.app["auth_service"].create_api_token(
+            auth.user, body.get("name", "api-token"),
+            server_id=body.get("server_id"),
+            permissions=body.get("permissions"),
+            expires_minutes=body.get("expires_minutes"))
+        return web.json_response({"token": token, "id": token_id}, status=201)
+
+    @routes.get("/auth/tokens")
+    async def list_tokens(request: web.Request) -> web.Response:
+        auth = request["auth"]
+        auth.require("tokens.manage")
+        return web.json_response(await request.app["auth_service"].list_api_tokens(auth.user))
+
+    @routes.delete("/auth/tokens/{token_id}")
+    async def revoke_token(request: web.Request) -> web.Response:
+        auth = request["auth"]
+        auth.require("tokens.manage")
+        await request.app["auth_service"].revoke_token(request.match_info["token_id"])
+        return web.Response(status=204)
+
+    # ---------------------------------------------------------------- tools
+    @routes.get("/tools")
+    async def list_tools(request: web.Request) -> web.Response:
+        request["auth"].require("tools.read")
+        include_inactive = request.query.get("include_inactive") == "true"
+        tools = await request.app["tool_service"].list_tools(
+            include_inactive=include_inactive, team_ids=request["auth"].teams)
+        return web.json_response(_dump(tools))
+
+    @routes.post("/tools")
+    async def create_tool(request: web.Request) -> web.Response:
+        request["auth"].require("tools.create")
+        tool = await _body(request, ToolCreate)
+        if not tool.owner_email:
+            tool.owner_email = request["auth"].user
+        created = await request.app["tool_service"].register_tool(tool)
+        return web.json_response(_dump(created), status=201)
+
+    @routes.get("/tools/{tool_id}")
+    async def get_tool(request: web.Request) -> web.Response:
+        request["auth"].require("tools.read")
+        tool = await request.app["tool_service"].get_tool(request.match_info["tool_id"])
+        return web.json_response(_dump(tool))
+
+    @routes.put("/tools/{tool_id}")
+    async def update_tool(request: web.Request) -> web.Response:
+        request["auth"].require("tools.update")
+        update = await _body(request, ToolUpdate)
+        tool = await request.app["tool_service"].update_tool(
+            request.match_info["tool_id"], update)
+        return web.json_response(_dump(tool))
+
+    @routes.delete("/tools/{tool_id}")
+    async def delete_tool(request: web.Request) -> web.Response:
+        request["auth"].require("tools.delete")
+        await request.app["tool_service"].delete_tool(request.match_info["tool_id"])
+        return web.Response(status=204)
+
+    @routes.post("/tools/{tool_id}/toggle")
+    async def toggle_tool(request: web.Request) -> web.Response:
+        request["auth"].require("tools.update")
+        body = await request.json()
+        tool = await request.app["tool_service"].toggle_tool(
+            request.match_info["tool_id"], bool(body.get("enabled", True)))
+        return web.json_response(_dump(tool))
+
+    # -------------------------------------------------------------- gateways
+    @routes.get("/gateways")
+    async def list_gateways(request: web.Request) -> web.Response:
+        request["auth"].require("gateways.read")
+        include_inactive = request.query.get("include_inactive") == "true"
+        gws = await request.app["gateway_service"].list_gateways(include_inactive)
+        return web.json_response(_dump(gws))
+
+    @routes.post("/gateways")
+    async def register_gateway(request: web.Request) -> web.Response:
+        request["auth"].require("gateways.create")
+        gw = await _body(request, GatewayCreate)
+        created = await request.app["gateway_service"].register_gateway(gw)
+        return web.json_response(_dump(created), status=201)
+
+    @routes.get("/gateways/{gateway_id}")
+    async def get_gateway(request: web.Request) -> web.Response:
+        request["auth"].require("gateways.read")
+        gw = await request.app["gateway_service"].get_gateway(request.match_info["gateway_id"])
+        return web.json_response(_dump(gw))
+
+    @routes.put("/gateways/{gateway_id}")
+    async def update_gateway(request: web.Request) -> web.Response:
+        request["auth"].require("gateways.update")
+        update = await _body(request, GatewayUpdate)
+        gw = await request.app["gateway_service"].update_gateway(
+            request.match_info["gateway_id"], update)
+        return web.json_response(_dump(gw))
+
+    @routes.delete("/gateways/{gateway_id}")
+    async def delete_gateway(request: web.Request) -> web.Response:
+        request["auth"].require("gateways.delete")
+        await request.app["gateway_service"].delete_gateway(request.match_info["gateway_id"])
+        return web.Response(status=204)
+
+    @routes.post("/gateways/{gateway_id}/refresh")
+    async def refresh_gateway(request: web.Request) -> web.Response:
+        request["auth"].require("gateways.update")
+        gw = await request.app["gateway_service"].refresh_gateway(
+            request.match_info["gateway_id"])
+        return web.json_response(_dump(gw))
+
+    # ------------------------------------------------------------- resources
+    @routes.get("/resources")
+    async def list_resources(request: web.Request) -> web.Response:
+        request["auth"].require("resources.read")
+        res = await request.app["resource_service"].list_resources(
+            request.query.get("include_inactive") == "true")
+        return web.json_response(_dump(res))
+
+    @routes.post("/resources")
+    async def create_resource(request: web.Request) -> web.Response:
+        request["auth"].require("resources.create")
+        res = await _body(request, ResourceCreate)
+        created = await request.app["resource_service"].register_resource(res)
+        return web.json_response(_dump(created), status=201)
+
+    @routes.put("/resources/{resource_id}")
+    async def update_resource(request: web.Request) -> web.Response:
+        request["auth"].require("resources.update")
+        update = await _body(request, ResourceUpdate)
+        res = await request.app["resource_service"].update_resource(
+            request.match_info["resource_id"], update)
+        return web.json_response(_dump(res))
+
+    @routes.delete("/resources/{resource_id}")
+    async def delete_resource(request: web.Request) -> web.Response:
+        request["auth"].require("resources.delete")
+        await request.app["resource_service"].delete_resource(
+            request.match_info["resource_id"])
+        return web.Response(status=204)
+
+    @routes.post("/resources/read")
+    async def read_resource(request: web.Request) -> web.Response:
+        request["auth"].require("resources.read")
+        body = await request.json()
+        result = await request.app["resource_service"].read_resource(body.get("uri", ""))
+        return web.json_response(result)
+
+    # --------------------------------------------------------------- prompts
+    @routes.get("/prompts")
+    async def list_prompts(request: web.Request) -> web.Response:
+        request["auth"].require("prompts.read")
+        prompts = await request.app["prompt_service"].list_prompts(
+            request.query.get("include_inactive") == "true")
+        return web.json_response(_dump(prompts))
+
+    @routes.post("/prompts")
+    async def create_prompt(request: web.Request) -> web.Response:
+        request["auth"].require("prompts.create")
+        prompt = await _body(request, PromptCreate)
+        created = await request.app["prompt_service"].register_prompt(prompt)
+        return web.json_response(_dump(created), status=201)
+
+    @routes.put("/prompts/{prompt_id}")
+    async def update_prompt(request: web.Request) -> web.Response:
+        request["auth"].require("prompts.update")
+        update = await _body(request, PromptUpdate)
+        prompt = await request.app["prompt_service"].update_prompt(
+            request.match_info["prompt_id"], update)
+        return web.json_response(_dump(prompt))
+
+    @routes.delete("/prompts/{prompt_id}")
+    async def delete_prompt(request: web.Request) -> web.Response:
+        request["auth"].require("prompts.delete")
+        await request.app["prompt_service"].delete_prompt(request.match_info["prompt_id"])
+        return web.Response(status=204)
+
+    @routes.post("/prompts/{name}/render")
+    async def render_prompt(request: web.Request) -> web.Response:
+        request["auth"].require("prompts.read")
+        args = await request.json() if request.can_read_body else {}
+        result = await request.app["prompt_service"].render_prompt(
+            request.match_info["name"], args)
+        return web.json_response(result)
+
+    # --------------------------------------------------------------- servers
+    @routes.get("/servers")
+    async def list_servers(request: web.Request) -> web.Response:
+        request["auth"].require("servers.read")
+        servers = await request.app["server_service"].list_servers(
+            request.query.get("include_inactive") == "true")
+        return web.json_response(_dump(servers))
+
+    @routes.post("/servers")
+    async def create_server(request: web.Request) -> web.Response:
+        request["auth"].require("servers.create")
+        server = await _body(request, ServerCreate)
+        created = await request.app["server_service"].register_server(server)
+        return web.json_response(_dump(created), status=201)
+
+    @routes.get("/servers/{server_id}")
+    async def get_server(request: web.Request) -> web.Response:
+        request["auth"].require("servers.read")
+        server = await request.app["server_service"].get_server(
+            request.match_info["server_id"])
+        return web.json_response(_dump(server))
+
+    @routes.put("/servers/{server_id}")
+    async def update_server(request: web.Request) -> web.Response:
+        request["auth"].require("servers.update")
+        update = await _body(request, ServerUpdate)
+        server = await request.app["server_service"].update_server(
+            request.match_info["server_id"], update)
+        return web.json_response(_dump(server))
+
+    @routes.delete("/servers/{server_id}")
+    async def delete_server(request: web.Request) -> web.Response:
+        request["auth"].require("servers.delete")
+        await request.app["server_service"].delete_server(request.match_info["server_id"])
+        return web.Response(status=204)
+
+    # --------------------------------------------------------------- metrics
+    @routes.get("/metrics/prometheus")
+    async def prometheus(request: web.Request) -> web.Response:
+        body, content_type = request.app["ctx"].metrics.render()
+        return web.Response(body=body, content_type=content_type.split(";")[0])
+
+    @routes.get("/metrics")
+    async def metrics_summary(request: web.Request) -> web.Response:
+        request["auth"].require("observability.read")
+        db = request.app["ctx"].db
+        rows = await db.fetchall(
+            "SELECT t.original_name AS name, COUNT(*) AS calls,"
+            " SUM(1 - m.success) AS errors, AVG(m.duration_ms) AS avg_ms,"
+            " MIN(m.duration_ms) AS min_ms, MAX(m.duration_ms) AS max_ms"
+            " FROM tool_metrics m JOIN tools t ON t.id = m.tool_id"
+            " GROUP BY t.original_name ORDER BY calls DESC LIMIT 100")
+        return web.json_response({"tools": rows})
+
+    # ----------------------------------------------------- admin observability
+    @routes.get("/admin/logs")
+    async def admin_logs(request: web.Request) -> web.Response:
+        request["auth"].require("observability.read")
+        return web.json_response(ring_buffer.search(
+            query=request.query.get("q", ""),
+            level=request.query.get("level"),
+            limit=int(request.query.get("limit", "200"))))
+
+    @routes.get("/admin/traces")
+    async def admin_traces(request: web.Request) -> web.Response:
+        request["auth"].require("observability.read")
+        tracer = request.app["ctx"].tracer
+        limit = int(request.query.get("limit", "100"))
+        spans = tracer.finished[-limit:]
+        return web.json_response([{
+            "name": s.name, "trace_id": s.trace_id, "span_id": s.span_id,
+            "parent_span_id": s.parent_span_id, "start_ts": s.start_ts,
+            "duration_ms": s.duration_ms, "status": s.status,
+            "attributes": {k: str(v) for k, v in s.attributes.items()},
+        } for s in reversed(spans)])
+
+    app.add_routes(routes)

@@ -1,0 +1,209 @@
+"""JSON-RPC / MCP method dispatcher.
+
+Reference: the method switch in `_handle_rpc_authenticated`
+(`/root/reference/mcpgateway/main.py:11109`) and `_execute_rpc_tools_call`
+(`main.py:10383`). Here it is a handler table over the service layer; the
+same dispatcher serves ``POST /rpc`` and the ``/mcp`` streamable-HTTP
+transport (and per-virtual-server mounts which scope the catalog).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from .. import PROTOCOL_VERSION, SUPPORTED_PROTOCOL_VERSIONS
+from ..jsonrpc import (
+    INTERNAL_ERROR,
+    INVALID_PARAMS,
+    METHOD_NOT_FOUND,
+    JSONRPCError,
+    RPCRequest,
+    method_registry,
+    result_response,
+)
+from ..services.base import AppContext, NotFoundError, ValidationFailure
+from ..services.auth_service import AuthContext, PermissionDenied
+
+logger = logging.getLogger(__name__)
+
+
+class RPCDispatcher:
+    def __init__(self, ctx: AppContext, tool_service, resource_service,
+                 prompt_service, server_service, completion_service=None,
+                 sampling_handler=None):
+        self.ctx = ctx
+        self.tools = tool_service
+        self.resources = resource_service
+        self.prompts = prompt_service
+        self.servers = server_service
+        self.completion = completion_service
+        self.sampling = sampling_handler
+        self._log_level = "info"
+
+    async def dispatch(self, request: RPCRequest, auth: AuthContext,
+                       headers: dict[str, str] | None = None,
+                       server_id: str | None = None) -> dict[str, Any] | None:
+        """Handle one JSON-RPC request; returns the response (None for
+        notifications). ``server_id`` scopes the catalog to a virtual server
+        (also enforced for server-scoped tokens, reference main.py:11200)."""
+        method = request.method
+        params = request.params
+        headers = headers or {}
+        # server-scoped token enforcement
+        if auth.server_id and server_id and auth.server_id != server_id:
+            raise JSONRPCError(INVALID_PARAMS, "Token is scoped to a different server")
+        effective_server = server_id or auth.server_id
+
+        if request.is_notification or method_registry.is_notification(method):
+            await self._handle_notification(method, params)
+            return None
+
+        with self.ctx.tracer.span(f"rpc.{method}", {"rpc.method": method,
+                                                    "user": auth.user}):
+            try:
+                result = await self._route(method, params, auth, headers, effective_server)
+            except JSONRPCError:
+                raise
+            except NotFoundError as exc:
+                raise JSONRPCError(INVALID_PARAMS, str(exc)) from exc
+            except PermissionDenied as exc:
+                raise JSONRPCError(-32004, str(exc)) from exc
+            except ValidationFailure as exc:
+                raise JSONRPCError(INVALID_PARAMS, str(exc)) from exc
+            except Exception as exc:
+                logger.exception("RPC %s failed", method)
+                raise JSONRPCError(INTERNAL_ERROR, f"{type(exc).__name__}: {exc}") from exc
+        return result_response(request.id, result)
+
+    async def _route(self, method: str, params: dict[str, Any], auth: AuthContext,
+                     headers: dict[str, str], server_id: str | None) -> Any:
+        if method == "initialize":
+            return await self._initialize(params)
+        if method == "ping":
+            return {}
+        if method == "tools/list":
+            auth.require("tools.read")
+            tools = await self.tools.list_tools(team_ids=auth.teams)
+            if server_id:
+                allowed = set(await self.servers.server_tool_names(server_id))
+                tools = [t for t in tools if t.name in allowed]
+            return {"tools": [{
+                "name": t.name,
+                "description": t.description or "",
+                "inputSchema": t.input_schema or {"type": "object"},
+                **({"outputSchema": t.output_schema} if t.output_schema else {}),
+                **({"annotations": t.annotations} if t.annotations else {}),
+            } for t in tools]}
+        if method == "tools/call":
+            auth.require("tools.invoke")
+            name = params.get("name")
+            if not name:
+                raise JSONRPCError(INVALID_PARAMS, "tools/call requires 'name'")
+            if server_id:
+                allowed = set(await self.servers.server_tool_names(server_id))
+                if name not in allowed:
+                    raise JSONRPCError(INVALID_PARAMS,
+                                       f"Tool {name!r} not in server scope")
+            return await self.tools.invoke_tool(
+                name, params.get("arguments", {}) or {}, request_headers=headers,
+                user=auth.user)
+        if method == "resources/list":
+            auth.require("resources.read")
+            resources = await self.resources.list_resources()
+            return {"resources": [{
+                "uri": r.uri, "name": r.name,
+                **({"description": r.description} if r.description else {}),
+                **({"mimeType": r.mime_type} if r.mime_type else {}),
+            } for r in resources if not r.uri_template]}
+        if method == "resources/templates/list":
+            auth.require("resources.read")
+            return {"resourceTemplates": await self.resources.list_templates()}
+        if method == "resources/read":
+            auth.require("resources.read")
+            uri = params.get("uri")
+            if not uri:
+                raise JSONRPCError(INVALID_PARAMS, "resources/read requires 'uri'")
+            pm = self.ctx.plugin_manager
+            if pm is not None:
+                uri = await pm.resource_pre_fetch(uri, user=auth.user)
+            result = await self.resources.read_resource(uri, request_headers=headers)
+            if pm is not None:
+                result = await pm.resource_post_fetch(uri, result, user=auth.user)
+            return result
+        if method == "resources/subscribe":
+            auth.require("resources.read")
+            await self.resources.subscribe(params.get("uri", ""),
+                                           headers.get("mcp-session-id", "anonymous"))
+            return {}
+        if method == "resources/unsubscribe":
+            await self.resources.unsubscribe(params.get("uri", ""),
+                                             headers.get("mcp-session-id", "anonymous"))
+            return {}
+        if method == "prompts/list":
+            auth.require("prompts.read")
+            prompts = await self.prompts.list_prompts()
+            return {"prompts": [{
+                "name": p.name,
+                **({"description": p.description} if p.description else {}),
+                "arguments": [a.model_dump(exclude_none=True) for a in p.arguments],
+            } for p in prompts]}
+        if method == "prompts/get":
+            auth.require("prompts.read")
+            name = params.get("name")
+            if not name:
+                raise JSONRPCError(INVALID_PARAMS, "prompts/get requires 'name'")
+            pm = self.ctx.plugin_manager
+            args = params.get("arguments", {}) or {}
+            if pm is not None:
+                name, args = await pm.prompt_pre_fetch(name, args, user=auth.user)
+            result = await self.prompts.render_prompt(name, args)
+            if pm is not None:
+                result = await pm.prompt_post_fetch(name, result, user=auth.user)
+            return result
+        if method == "roots/list":
+            return {"roots": []}
+        if method == "completion/complete":
+            if self.completion is not None:
+                return await self.completion.complete(params)
+            return {"completion": {"values": [], "total": 0, "hasMore": False}}
+        if method == "sampling/createMessage":
+            if self.sampling is not None:
+                return await self.sampling.create_message(params, user=auth.user)
+            raise JSONRPCError(METHOD_NOT_FOUND, "Sampling not configured")
+        if method == "logging/setLevel":
+            level = params.get("level", "info")
+            self._log_level = level
+            return {}
+        if method == "elicitation/create":
+            raise JSONRPCError(METHOD_NOT_FOUND, "Elicitation requires a connected client")
+        if method_registry.is_known(method):
+            raise JSONRPCError(METHOD_NOT_FOUND, f"Method {method!r} not implemented")
+        raise JSONRPCError(METHOD_NOT_FOUND, f"Unknown method {method!r}")
+
+    async def _initialize(self, params: dict[str, Any]) -> dict[str, Any]:
+        client_version = params.get("protocolVersion", PROTOCOL_VERSION)
+        version = client_version if client_version in SUPPORTED_PROTOCOL_VERSIONS \
+            else PROTOCOL_VERSION
+        return {
+            "protocolVersion": version,
+            "capabilities": {
+                "tools": {"listChanged": True},
+                "resources": {"subscribe": True, "listChanged": True},
+                "prompts": {"listChanged": True},
+                "logging": {},
+                "completions": {},
+            },
+            "serverInfo": {"name": self.ctx.settings.app_name, "version": "0.1.0"},
+        }
+
+    async def _handle_notification(self, method: str, params: dict[str, Any]) -> None:
+        if method == "notifications/initialized":
+            return
+        if method == "notifications/cancelled":
+            cancellation = self.ctx.extras.get("cancellation_service")
+            if cancellation is not None:
+                await cancellation.cancel(params.get("requestId"))
+            return
+        # progress/message notifications are accepted and dropped at the edge
+        return

@@ -1,0 +1,1 @@
+"""Inbound transports: streamable-HTTP (primary), SSE (legacy), WebSocket."""

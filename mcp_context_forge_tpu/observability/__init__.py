@@ -1,0 +1,21 @@
+"""Observability: tracing, metrics, logging.
+
+Reference parity (`/root/reference/mcpgateway/observability.py`,
+`services/observability_service.py`, `services/metrics.py`): OTel-style spans
+on every request / tool call / plugin hook / LLM generation, a queryable
+in-DB trace store, and Prometheus metrics. The image ships only
+opentelemetry-api (no SDK), so the tracer is in-tree with OTel semantics:
+W3C ``traceparent`` propagation, ``gen_ai.*`` attributes on LLM spans,
+graceful no-op when disabled.
+"""
+
+from .tracing import (
+    Span,
+    Tracer,
+    get_tracer,
+    init_tracer,
+    current_span,
+)
+from .metrics import PrometheusRegistry
+
+__all__ = ["Span", "Tracer", "get_tracer", "init_tracer", "current_span", "PrometheusRegistry"]

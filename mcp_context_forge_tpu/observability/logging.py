@@ -1,0 +1,76 @@
+"""Structured/dual logging (reference: services/logging_service.py — RFC 5424
+levels, dual stdout+JSON). In-tree: stdlib logging with an optional JSON
+formatter and a ring buffer for the admin log-search API
+(reference routers/log_search.py)."""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import time
+from typing import Any
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        payload: dict[str, Any] = {
+            "ts": record.created,
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            payload["exc"] = self.formatException(record.exc_info)
+        extra = getattr(record, "ctx", None)
+        if extra:
+            payload.update(extra)
+        return json.dumps(payload, separators=(",", ":"), default=str)
+
+
+class RingBufferHandler(logging.Handler):
+    """Keeps the last N records in memory for /admin/logs search."""
+
+    def __init__(self, capacity: int = 5000) -> None:
+        super().__init__()
+        self.records: collections.deque[dict[str, Any]] = collections.deque(maxlen=capacity)
+
+    def emit(self, record: logging.LogRecord) -> None:
+        self.records.append({
+            "ts": record.created,
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        })
+
+    def search(self, query: str = "", level: str | None = None, limit: int = 200) -> list[dict[str, Any]]:
+        out = []
+        for rec in reversed(self.records):
+            if level and rec["level"] != level.upper():
+                continue
+            if query and query.lower() not in rec["message"].lower():
+                continue
+            out.append(rec)
+            if len(out) >= limit:
+                break
+        return out
+
+
+ring_buffer = RingBufferHandler()
+
+
+def init_logging(level: str = "INFO", as_json: bool = False) -> None:
+    root = logging.getLogger()
+    root.setLevel(level.upper())
+    if not any(isinstance(h, RingBufferHandler) for h in root.handlers):
+        root.addHandler(ring_buffer)
+    stream = next((h for h in root.handlers if isinstance(h, logging.StreamHandler)
+                   and not isinstance(h, RingBufferHandler)), None)
+    if stream is None:
+        stream = logging.StreamHandler()
+        root.addHandler(stream)
+    if as_json:
+        stream.setFormatter(JsonFormatter())
+    else:
+        stream.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)-7s %(name)s: %(message)s"))

@@ -1,0 +1,70 @@
+"""Prometheus metrics (reference: services/metrics.py setup_metrics :306)."""
+
+from __future__ import annotations
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+    CONTENT_TYPE_LATEST,
+)
+
+from .. import __version__
+
+
+class PrometheusRegistry:
+    """Gateway-wide Prometheus metrics, own registry (hermetic for tests)."""
+
+    def __init__(self) -> None:
+        self.registry = CollectorRegistry()
+        self.app_info = Gauge(
+            "mcpforge_app_info", "Application info", ["version"], registry=self.registry
+        )
+        self.app_info.labels(version=__version__).set(1)
+        self.http_requests = Counter(
+            "mcpforge_http_requests_total", "HTTP requests",
+            ["method", "path", "status"], registry=self.registry,
+        )
+        self.http_duration = Histogram(
+            "mcpforge_http_request_duration_seconds", "HTTP request latency",
+            ["method", "path"], registry=self.registry,
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
+        )
+        self.tool_invocations = Counter(
+            "mcpforge_tool_invocations_total", "Tool invocations",
+            ["tool", "status"], registry=self.registry,
+        )
+        self.tool_duration = Histogram(
+            "mcpforge_tool_invocation_duration_seconds", "Tool invocation latency",
+            ["tool"], registry=self.registry,
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0),
+        )
+        self.plugin_duration = Histogram(
+            "mcpforge_plugin_hook_duration_seconds", "Plugin hook latency",
+            ["plugin", "hook"], registry=self.registry,
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
+        )
+        self.llm_tokens = Counter(
+            "mcpforge_llm_tokens_total", "LLM tokens processed by tpu_local",
+            ["model", "kind"], registry=self.registry,  # kind: prompt|completion
+        )
+        self.llm_requests = Counter(
+            "mcpforge_llm_requests_total", "LLM requests", ["model", "status"],
+            registry=self.registry,
+        )
+        self.llm_queue_depth = Gauge(
+            "mcpforge_llm_queue_depth", "tpu_local scheduler queue depth",
+            registry=self.registry,
+        )
+        self.llm_kv_pages_in_use = Gauge(
+            "mcpforge_llm_kv_pages_in_use", "Paged KV cache pages in use",
+            registry=self.registry,
+        )
+        self.sessions_active = Gauge(
+            "mcpforge_sessions_active", "Active MCP sessions", registry=self.registry,
+        )
+
+    def render(self) -> tuple[bytes, str]:
+        return generate_latest(self.registry), CONTENT_TYPE_LATEST

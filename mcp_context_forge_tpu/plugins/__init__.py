@@ -1,0 +1,19 @@
+"""Plugin framework + in-tree plugins.
+
+Reference: `/root/reference/mcpgateway/plugins/` (framework glue over the
+external ``cpex`` package) + `plugins/` (41 in-tree plugins). Here the
+framework is fully in-tree: hook points, payload policies, execution modes,
+a YAML-configured manager, and a registry of built-in plugins.
+"""
+
+from .framework import (
+    HookType,
+    PluginMode,
+    Plugin,
+    PluginConfig,
+    PluginManager,
+    PluginViolation,
+)
+
+__all__ = ["HookType", "PluginMode", "Plugin", "PluginConfig", "PluginManager",
+           "PluginViolation"]

@@ -1,0 +1,40 @@
+"""Built-in plugins (reference: 41 in-tree plugin packages under
+`/root/reference/plugins/`). Importing this package registers every builtin
+under its short name so YAML config can say ``kind: deny_filter``."""
+
+from ..framework import register_builtin
+
+_P = "mcp_context_forge_tpu.plugins.builtin"
+
+for _name, _path in {
+    # filters / guards
+    "deny_filter": f"{_P}.filters.DenyFilterPlugin",
+    "regex_filter": f"{_P}.filters.RegexFilterPlugin",
+    "output_length_guard": f"{_P}.filters.OutputLengthGuardPlugin",
+    "file_type_allowlist": f"{_P}.filters.FileTypeAllowlistPlugin",
+    "resource_filter": f"{_P}.filters.ResourceFilterPlugin",
+    "schema_guard": f"{_P}.filters.SchemaGuardPlugin",
+    "sql_sanitizer": f"{_P}.filters.SqlSanitizerPlugin",
+    "secrets_filter": f"{_P}.filters.SecretsFilterPlugin",
+    # transformers
+    "header_injector": f"{_P}.transformers.HeaderInjectorPlugin",
+    "header_filter": f"{_P}.transformers.HeaderFilterPlugin",
+    "json_repair": f"{_P}.transformers.JsonRepairPlugin",
+    "markdown_cleaner": f"{_P}.transformers.MarkdownCleanerPlugin",
+    "html_to_markdown": f"{_P}.transformers.HtmlToMarkdownPlugin",
+    "search_replace": f"{_P}.transformers.SearchReplacePlugin",
+    "argument_normalizer": f"{_P}.transformers.ArgumentNormalizerPlugin",
+    "privacy_notice_injector": f"{_P}.transformers.PrivacyNoticeInjectorPlugin",
+    "timezone_translator": f"{_P}.transformers.TimezoneTranslatorPlugin",
+    # resilience / ops
+    "circuit_breaker": f"{_P}.resilience.CircuitBreakerPlugin",
+    "cached_tool_result": f"{_P}.resilience.CachedToolResultPlugin",
+    "watchdog": f"{_P}.resilience.WatchdogPlugin",
+    "webhook_notification": f"{_P}.resilience.WebhookNotificationPlugin",
+    # LLM-backed (tpu_local) — north-star plugins
+    "response_cache_by_prompt": f"{_P}.llm_plugins.ResponseCacheByPromptPlugin",
+    "summarizer": f"{_P}.llm_plugins.SummarizerPlugin",
+    "content_moderation": f"{_P}.llm_plugins.ContentModerationPlugin",
+    "harmful_content_detector": f"{_P}.llm_plugins.HarmfulContentDetectorPlugin",
+}.items():
+    register_builtin(_name, _path)

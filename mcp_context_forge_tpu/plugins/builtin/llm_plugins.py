@@ -1,0 +1,195 @@
+"""LLM-backed plugins — the four north-star plugins routed through tpu_local.
+
+Reference counterparts: plugins/response_cache_by_prompt (token-hash cosine
+cache, threshold 0.92, response_cache_by_prompt.py:42-106), plugins/summarizer
+(summarizer.py:106-209 — external OpenAI/Anthropic HTTP calls, replaced here
+by the in-tree engine), plugins/content_moderation (content_moderation.py:
+45-52 provider matrix, replaced by tpu_local classify), and
+plugins/harmful_content_detector.
+
+Every plugin degrades gracefully: with no llm_registry attached (engine
+disabled) the cache falls back to hashed bag-of-words vectors — which is what
+the reference actually ships — and moderation falls back to wordlists.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import math
+import re
+import time
+from typing import Any
+
+from ..framework import Plugin, PluginViolation
+
+logger = logging.getLogger(__name__)
+
+
+def _result_text(result: dict[str, Any]) -> str:
+    parts = []
+    for item in result.get("content", []):
+        if isinstance(item, dict) and item.get("type") == "text":
+            parts.append(item.get("text", ""))
+    return "\n".join(parts)
+
+
+def _bow_vector(text: str, dim: int = 256) -> list[float]:
+    """Hashed bag-of-words embedding (the reference's actual cache vectorizer)."""
+    vec = [0.0] * dim
+    for token in re.findall(r"[a-z0-9]+", text.lower()):
+        vec[int(hashlib.md5(token.encode()).hexdigest(), 16) % dim] += 1.0
+    norm = math.sqrt(sum(v * v for v in vec)) or 1.0
+    return [v / norm for v in vec]
+
+
+def _cosine(a: list[float], b: list[float]) -> float:
+    if len(a) != len(b):
+        return 0.0
+    dot = sum(x * y for x, y in zip(a, b))
+    na = math.sqrt(sum(x * x for x in a)) or 1.0
+    nb = math.sqrt(sum(x * x for x in b)) or 1.0
+    return dot / (na * nb)
+
+
+class ResponseCacheByPromptPlugin(Plugin):
+    """Approximate result cache: cosine similarity over prompt embeddings.
+
+    config: {threshold: 0.92, ttl_seconds: 300, max_entries: 512,
+             use_engine: true}"""
+
+    def __init__(self, config, ctx=None):
+        super().__init__(config, ctx)
+        self._entries: list[tuple[list[float], float, dict[str, Any]]] = []
+
+    async def _embed(self, text: str) -> list[float]:
+        registry = getattr(self.ctx, "llm_registry", None) if self.ctx else None
+        if registry is not None and self.config.config.get("use_engine", True):
+            try:
+                vectors = await registry.embed([text])
+                return vectors[0]
+            except Exception as exc:
+                logger.debug("engine embed failed, falling back to BoW: %s", exc)
+        return _bow_vector(text)
+
+    async def tool_pre_invoke(self, name, arguments, headers, context):
+        prompt = json.dumps({"tool": name, "args": arguments}, sort_keys=True)
+        vector = await self._embed(prompt)
+        threshold = float(self.config.config.get("threshold", 0.92))
+        ttl = float(self.config.config.get("ttl_seconds", 300))
+        now = time.monotonic()
+        self._entries = [e for e in self._entries if now - e[1] < ttl]
+        best, best_sim = None, 0.0
+        for entry_vec, _, result in self._entries:
+            sim = _cosine(vector, entry_vec)
+            if sim > best_sim:
+                best, best_sim = result, sim
+        if best is not None and best_sim >= threshold:
+            context.metadata["cache_hit"] = True
+            import copy
+            return {"result": copy.deepcopy(best)}
+        context.metadata["prompt_vector"] = vector
+        return None
+
+    async def tool_post_invoke(self, name, result, context):
+        if context.metadata.get("cache_hit"):
+            return None
+        vector = context.metadata.get("prompt_vector")
+        if vector is not None and not result.get("isError"):
+            max_entries = int(self.config.config.get("max_entries", 512))
+            if len(self._entries) >= max_entries:
+                self._entries.pop(0)
+            import copy
+            self._entries.append((vector, time.monotonic(), copy.deepcopy(result)))
+        return None
+
+
+class SummarizerPlugin(Plugin):
+    """Summarizes long tool output through the tpu_local chat model.
+
+    config: {threshold_chars: 2000, max_tokens: 256, model: null,
+             prompt: "..."}"""
+
+    async def tool_post_invoke(self, name, result, context):
+        threshold = int(self.config.config.get("threshold_chars", 2000))
+        text = _result_text(result)
+        if len(text) < threshold or result.get("isError"):
+            return None
+        registry = getattr(self.ctx, "llm_registry", None) if self.ctx else None
+        if registry is None:
+            return None  # no engine: pass through untouched
+        prompt = self.config.config.get(
+            "prompt", "Summarize the following tool output concisely, keeping key "
+                      "facts, numbers and identifiers:")
+        response = await registry.chat({
+            "model": self.config.config.get("model"),
+            "messages": [
+                {"role": "system", "content": prompt},
+                {"role": "user", "content": text[:16000]},
+            ],
+            "max_tokens": int(self.config.config.get("max_tokens", 256)),
+            "temperature": 0.0,
+        })
+        summary = response["choices"][0]["message"]["content"]
+        return {"content": [{"type": "text", "text": summary}],
+                "isError": False,
+                "_summarized": True}
+
+
+_HARM_WORDLIST = {
+    "kill yourself", "build a bomb", "make a weapon", "child sexual",
+    "credit card dump", "ransomware payload",
+}
+
+
+class _ClassifierMixin(Plugin):
+    async def _score(self, text: str) -> float:
+        """Harm probability in [0,1] — tpu_local classifier, wordlist fallback."""
+        registry = getattr(self.ctx, "llm_registry", None) if self.ctx else None
+        if registry is not None and self.config.config.get("use_engine", True):
+            classify = getattr(registry, "classify", None)
+            if classify is not None:
+                try:
+                    scores = await classify([text])
+                    return float(scores[0])
+                except Exception as exc:
+                    logger.debug("engine classify failed, wordlist fallback: %s", exc)
+        lowered = text.lower()
+        return 1.0 if any(w in lowered for w in _HARM_WORDLIST) else 0.0
+
+
+class ContentModerationPlugin(_ClassifierMixin):
+    """Blocks tool calls whose inputs are flagged (reference providers
+    IBM Watson/Granite/OpenAI/Azure/AWS → replaced by tpu_local classify).
+
+    config: {threshold: 0.8, use_engine: true}"""
+
+    async def tool_pre_invoke(self, name, arguments, headers, context):
+        threshold = float(self.config.config.get("threshold", 0.8))
+        score = await self._score(json.dumps(arguments))
+        if score >= threshold:
+            raise PluginViolation(
+                f"Input flagged by content moderation (score={score:.2f})",
+                code="CONTENT_MODERATION")
+        return None
+
+
+class HarmfulContentDetectorPlugin(_ClassifierMixin):
+    """Flags/blocks harmful tool output (reference harmful_content_detector).
+
+    config: {threshold: 0.8, action: "block"|"annotate", use_engine: true}"""
+
+    async def tool_post_invoke(self, name, result, context):
+        threshold = float(self.config.config.get("threshold", 0.8))
+        text = _result_text(result)
+        if not text:
+            return None
+        score = await self._score(text)
+        if score < threshold:
+            return None
+        if self.config.config.get("action", "block") == "block":
+            raise PluginViolation(
+                f"Output flagged as harmful (score={score:.2f})", code="HARMFUL_CONTENT")
+        result.setdefault("annotations", {})["harm_score"] = score
+        return result

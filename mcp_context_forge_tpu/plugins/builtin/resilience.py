@@ -1,0 +1,132 @@
+"""Resilience/ops plugins (reference counterparts: circuit_breaker,
+cached_tool_result, watchdog, webhook_notification)."""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+import time
+from typing import Any
+
+import httpx
+
+from ..framework import Plugin, PluginViolation
+
+logger = logging.getLogger(__name__)
+
+
+class CircuitBreakerPlugin(Plugin):
+    """Opens a per-tool circuit after consecutive failures.
+
+    config: {failure_threshold: 5, reset_seconds: 30}"""
+
+    def __init__(self, config, ctx=None):
+        super().__init__(config, ctx)
+        self._failures: dict[str, int] = {}
+        self._opened_at: dict[str, float] = {}
+
+    async def tool_pre_invoke(self, name, arguments, headers, context):
+        threshold = int(self.config.config.get("failure_threshold", 5))
+        reset = float(self.config.config.get("reset_seconds", 30))
+        opened = self._opened_at.get(name)
+        if opened is not None:
+            if time.monotonic() - opened < reset:
+                raise PluginViolation(f"Circuit open for tool {name!r}",
+                                      code="CIRCUIT_OPEN")
+            self._opened_at.pop(name, None)   # half-open: allow a probe
+            self._failures[name] = threshold - 1
+        return None
+
+    async def tool_post_invoke(self, name, result, context):
+        if result.get("isError"):
+            count = self._failures.get(name, 0) + 1
+            self._failures[name] = count
+            if count >= int(self.config.config.get("failure_threshold", 5)):
+                self._opened_at[name] = time.monotonic()
+        else:
+            self._failures.pop(name, None)
+        return None
+
+
+class CachedToolResultPlugin(Plugin):
+    """Exact-match result cache keyed on (tool, arguments).
+
+    config: {ttl_seconds: 60, max_entries: 1024}"""
+
+    def __init__(self, config, ctx=None):
+        super().__init__(config, ctx)
+        self._cache: dict[str, tuple[float, dict[str, Any]]] = {}
+
+    def _key(self, name: str, arguments: dict[str, Any]) -> str:
+        blob = json.dumps({"t": name, "a": arguments}, sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()
+
+    async def tool_pre_invoke(self, name, arguments, headers, context):
+        import copy
+        ttl = float(self.config.config.get("ttl_seconds", 60))
+        entry = self._cache.get(self._key(name, arguments))
+        if entry and time.monotonic() - entry[0] < ttl:
+            context.metadata["cache_hit"] = True
+            # deep copy: downstream post hooks mutate results in place
+            return {"result": copy.deepcopy(entry[1])}
+        context.metadata["cache_args"] = dict(arguments)
+        return None
+
+    async def tool_post_invoke(self, name, result, context):
+        import copy
+        if context.metadata.get("cache_hit"):
+            return None
+        args = context.metadata.get("cache_args")
+        if args is not None and not result.get("isError"):
+            max_entries = int(self.config.config.get("max_entries", 1024))
+            if len(self._cache) >= max_entries:
+                oldest = min(self._cache.items(), key=lambda kv: kv[1][0])[0]
+                self._cache.pop(oldest, None)
+            self._cache[self._key(name, args)] = (time.monotonic(), copy.deepcopy(result))
+        return None
+
+
+class WatchdogPlugin(Plugin):
+    """Logs tool calls that exceed a latency budget.
+
+    config: {max_ms: 5000}"""
+
+    async def tool_pre_invoke(self, name, arguments, headers, context):
+        context.metadata["watchdog_start"] = time.monotonic()
+        return None
+
+    async def tool_post_invoke(self, name, result, context):
+        start = context.metadata.get("watchdog_start")
+        if start is not None:
+            elapsed_ms = (time.monotonic() - start) * 1000
+            if elapsed_ms > float(self.config.config.get("max_ms", 5000)):
+                logger.warning("watchdog: tool %s took %.0f ms", name, elapsed_ms)
+        return None
+
+
+class WebhookNotificationPlugin(Plugin):
+    """Fire-and-forget POST to a webhook on tool completion.
+
+    config: {url: str, events: ["success","error"]}"""
+
+    async def tool_post_invoke(self, name, result, context):
+        url = self.config.config.get("url")
+        if not url:
+            return None
+        events = self.config.config.get("events", ["success", "error"])
+        kind = "error" if result.get("isError") else "success"
+        if kind not in events:
+            return None
+
+        async def _fire() -> None:
+            try:
+                async with httpx.AsyncClient(timeout=5.0) as client:
+                    await client.post(url, json={"tool": name, "event": kind,
+                                                 "user": context.user, "ts": time.time()})
+            except Exception as exc:
+                logger.debug("webhook failed: %s", exc)
+
+        asyncio.get_running_loop().create_task(_fire())
+        return None

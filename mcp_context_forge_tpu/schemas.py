@@ -1,0 +1,263 @@
+"""API request/response schemas (reference: mcpgateway/schemas.py, 9k LoC —
+here table-driven and compact; one Create/Update/Read triple per entity)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Literal
+
+from pydantic import BaseModel, Field, field_validator
+
+Visibility = Literal["public", "team", "private"]
+
+
+class _Entity(BaseModel):
+    description: str | None = None
+    tags: list[str] = Field(default_factory=list)
+    team_id: str | None = None
+    owner_email: str | None = None
+    visibility: Visibility = "public"
+
+
+# ------------------------------------------------------------------ gateways
+
+class GatewayCreate(_Entity):
+    name: str
+    url: str
+    transport: Literal["streamablehttp", "sse"] = "streamablehttp"
+    auth_type: Literal["none", "basic", "bearer", "headers"] | None = None
+    auth_value: dict[str, Any] | None = None  # {username,password} | {token} | {headers}
+    passthrough_headers: list[str] = Field(default_factory=list)
+    enabled: bool = True
+
+    @field_validator("url")
+    @classmethod
+    def _check_url(cls, v: str) -> str:
+        if not v.startswith(("http://", "https://")):
+            raise ValueError("gateway url must be http(s)")
+        return v
+
+
+class GatewayUpdate(BaseModel):
+    name: str | None = None
+    url: str | None = None
+    description: str | None = None
+    transport: Literal["streamablehttp", "sse"] | None = None
+    auth_type: Literal["none", "basic", "bearer", "headers"] | None = None
+    auth_value: dict[str, Any] | None = None
+    passthrough_headers: list[str] | None = None
+    enabled: bool | None = None
+    tags: list[str] | None = None
+    visibility: Visibility | None = None
+
+
+class GatewayRead(_Entity):
+    id: str
+    name: str
+    url: str
+    transport: str = "streamablehttp"
+    auth_type: str | None = None
+    enabled: bool = True
+    reachable: bool = False
+    state: str = "pending"
+    capabilities: dict[str, Any] = Field(default_factory=dict)
+    last_seen: float | None = None
+    created_at: float = Field(default_factory=time.time)
+    updated_at: float = Field(default_factory=time.time)
+
+
+# --------------------------------------------------------------------- tools
+
+class ToolCreate(_Entity):
+    name: str
+    display_name: str | None = None
+    integration_type: Literal["MCP", "REST", "A2A", "GRPC"] = "REST"
+    request_type: Literal["GET", "POST", "PUT", "PATCH", "DELETE"] = "POST"
+    url: str | None = None
+    input_schema: dict[str, Any] = Field(default_factory=lambda: {"type": "object", "properties": {}})
+    output_schema: dict[str, Any] | None = None
+    annotations: dict[str, Any] = Field(default_factory=dict)
+    headers: dict[str, str] = Field(default_factory=dict)
+    auth_type: str | None = None
+    auth_value: dict[str, Any] | None = None
+    jsonpath_filter: str | None = None
+    gateway_id: str | None = None
+    enabled: bool = True
+
+    @field_validator("name")
+    @classmethod
+    def _check_name(cls, v: str) -> str:
+        if not v or len(v) > 255:
+            raise ValueError("tool name must be 1-255 chars")
+        return v
+
+
+class ToolUpdate(BaseModel):
+    display_name: str | None = None
+    custom_name: str | None = None
+    description: str | None = None
+    url: str | None = None
+    request_type: str | None = None
+    input_schema: dict[str, Any] | None = None
+    output_schema: dict[str, Any] | None = None
+    annotations: dict[str, Any] | None = None
+    headers: dict[str, str] | None = None
+    auth_type: str | None = None
+    auth_value: dict[str, Any] | None = None
+    jsonpath_filter: str | None = None
+    enabled: bool | None = None
+    tags: list[str] | None = None
+    visibility: Visibility | None = None
+
+
+class ToolRead(_Entity):
+    id: str
+    name: str  # effective name (custom_name or original)
+    original_name: str
+    display_name: str | None = None
+    integration_type: str = "REST"
+    request_type: str = "POST"
+    url: str | None = None
+    input_schema: dict[str, Any] = Field(default_factory=dict)
+    output_schema: dict[str, Any] | None = None
+    annotations: dict[str, Any] = Field(default_factory=dict)
+    gateway_id: str | None = None
+    enabled: bool = True
+    reachable: bool = True
+    created_at: float = 0.0
+    updated_at: float = 0.0
+
+
+# ----------------------------------------------------------------- resources
+
+class ResourceCreate(_Entity):
+    uri: str
+    name: str
+    mime_type: str | None = None
+    uri_template: str | None = None
+    content: str | None = None
+    is_binary: bool = False
+    gateway_id: str | None = None
+    enabled: bool = True
+
+
+class ResourceUpdate(BaseModel):
+    name: str | None = None
+    description: str | None = None
+    mime_type: str | None = None
+    content: str | None = None
+    enabled: bool | None = None
+    tags: list[str] | None = None
+    visibility: Visibility | None = None
+
+
+class ResourceRead(_Entity):
+    id: str
+    uri: str
+    name: str
+    mime_type: str | None = None
+    uri_template: str | None = None
+    size: int | None = None
+    gateway_id: str | None = None
+    enabled: bool = True
+    created_at: float = 0.0
+    updated_at: float = 0.0
+
+
+# ------------------------------------------------------------------- prompts
+
+class PromptArgument(BaseModel):
+    name: str
+    description: str | None = None
+    required: bool = False
+
+
+class PromptCreate(_Entity):
+    name: str
+    template: str
+    arguments: list[PromptArgument] = Field(default_factory=list)
+    gateway_id: str | None = None
+    enabled: bool = True
+
+
+class PromptUpdate(BaseModel):
+    description: str | None = None
+    template: str | None = None
+    arguments: list[PromptArgument] | None = None
+    enabled: bool | None = None
+    tags: list[str] | None = None
+    visibility: Visibility | None = None
+
+
+class PromptRead(_Entity):
+    id: str
+    name: str
+    template: str
+    arguments: list[PromptArgument] = Field(default_factory=list)
+    gateway_id: str | None = None
+    enabled: bool = True
+    created_at: float = 0.0
+    updated_at: float = 0.0
+
+
+# ------------------------------------------------------------------- servers
+
+class ServerCreate(_Entity):
+    name: str
+    icon: str | None = None
+    associated_tools: list[str] = Field(default_factory=list)
+    associated_resources: list[str] = Field(default_factory=list)
+    associated_prompts: list[str] = Field(default_factory=list)
+    enabled: bool = True
+
+
+class ServerUpdate(BaseModel):
+    name: str | None = None
+    description: str | None = None
+    icon: str | None = None
+    associated_tools: list[str] | None = None
+    associated_resources: list[str] | None = None
+    associated_prompts: list[str] | None = None
+    enabled: bool | None = None
+    tags: list[str] | None = None
+    visibility: Visibility | None = None
+
+
+class ServerRead(_Entity):
+    id: str
+    name: str
+    icon: str | None = None
+    associated_tools: list[str] = Field(default_factory=list)
+    associated_resources: list[str] = Field(default_factory=list)
+    associated_prompts: list[str] = Field(default_factory=list)
+    enabled: bool = True
+    created_at: float = 0.0
+    updated_at: float = 0.0
+
+
+# ----------------------------------------------------------------- A2A agents
+
+class A2AAgentCreate(_Entity):
+    name: str
+    endpoint_url: str
+    agent_type: Literal["jsonrpc", "openai", "anthropic", "custom", "tpu_local"] = "jsonrpc"
+    protocol_version: str = "1.0"
+    capabilities: dict[str, Any] = Field(default_factory=dict)
+    config: dict[str, Any] = Field(default_factory=dict)
+    auth_type: str | None = None
+    auth_value: dict[str, Any] | None = None
+    enabled: bool = True
+
+
+class A2AAgentRead(_Entity):
+    id: str
+    name: str
+    slug: str
+    endpoint_url: str
+    agent_type: str = "jsonrpc"
+    protocol_version: str = "1.0"
+    capabilities: dict[str, Any] = Field(default_factory=dict)
+    enabled: bool = True
+    reachable: bool = True
+    created_at: float = 0.0
+    updated_at: float = 0.0

@@ -1,0 +1,9 @@
+"""Business-logic services (reference: mcpgateway/services/ — 75 modules).
+
+Services are plain async classes bound to an AppContext (db, settings, bus,
+tracer, metrics, plugin manager) created in the app lifespan.
+"""
+
+from .base import AppContext
+
+__all__ = ["AppContext"]

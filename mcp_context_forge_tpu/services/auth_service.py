@@ -1,0 +1,239 @@
+"""Authentication + RBAC.
+
+Reference: `/root/reference/mcpgateway/auth.py` (JWT/basic validation, team
+resolution), `services/email_auth_service.py` (local users, argon2, lockout),
+`services/token_catalog_service.py` (API token catalog with jti revocation,
+server-scoped tokens), `middleware/rbac.py` (permission decorators).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any
+
+from argon2 import PasswordHasher
+from argon2.exceptions import VerifyMismatchError
+
+from ..utils import jwt
+from ..utils.ids import new_id, slugify
+from .base import AppContext, NotFoundError, now
+
+_hasher = PasswordHasher()
+
+# Permission matrix (reference db.py:1308 Permissions)
+PERMISSIONS = {
+    "tools.read", "tools.create", "tools.update", "tools.delete", "tools.invoke",
+    "resources.read", "resources.create", "resources.update", "resources.delete",
+    "prompts.read", "prompts.create", "prompts.update", "prompts.delete",
+    "gateways.read", "gateways.create", "gateways.update", "gateways.delete",
+    "servers.read", "servers.create", "servers.update", "servers.delete",
+    "a2a.read", "a2a.create", "a2a.invoke", "a2a.delete",
+    "teams.read", "teams.manage", "tokens.manage", "admin.all",
+    "llm.chat", "llm.admin", "plugins.manage", "observability.read",
+    "export.run", "import.run",
+}
+
+DEFAULT_USER_PERMISSIONS = {
+    "tools.read", "tools.invoke", "resources.read", "prompts.read",
+    "servers.read", "gateways.read", "a2a.read", "a2a.invoke", "llm.chat",
+}
+
+
+class AuthError(Exception):
+    """401-grade failure."""
+
+
+class PermissionDenied(Exception):
+    """403-grade failure."""
+
+
+@dataclass
+class AuthContext:
+    """Resolved request identity."""
+
+    user: str
+    is_admin: bool = False
+    teams: list[str] = field(default_factory=list)
+    permissions: set[str] = field(default_factory=set)
+    token_jti: str | None = None
+    server_id: str | None = None  # server-scoped token restriction
+    via: str = "jwt"  # jwt|basic|anonymous
+
+    def can(self, permission: str) -> bool:
+        return self.is_admin or "admin.all" in self.permissions or permission in self.permissions
+
+    def require(self, permission: str) -> None:
+        if not self.can(permission):
+            raise PermissionDenied(f"Missing permission: {permission}")
+
+
+class AuthService:
+    def __init__(self, ctx: AppContext):
+        self.ctx = ctx
+        self._revoked_jtis: set[str] = set()
+
+    # ------------------------------------------------------------- bootstrap
+
+    async def bootstrap_admin(self) -> None:
+        """Create the platform admin on first boot (reference bootstrap_db seed)."""
+        settings = self.ctx.settings
+        row = await self.ctx.db.fetchone("SELECT email FROM users WHERE email=?",
+                                         (settings.platform_admin_email,))
+        if row:
+            return
+        ts = now()
+        await self.ctx.db.execute(
+            "INSERT INTO users (email, password_hash, full_name, is_admin, created_at,"
+            " updated_at) VALUES (?,?,?,?,?,?)",
+            (settings.platform_admin_email, _hasher.hash(settings.platform_admin_password),
+             "Platform Admin", 1, ts, ts))
+        # personal team
+        team_id = new_id()
+        await self.ctx.db.execute(
+            "INSERT INTO teams (id, name, slug, is_personal, created_by, created_at,"
+            " updated_at) VALUES (?,?,?,?,?,?,?)",
+            (team_id, "Personal", slugify(settings.platform_admin_email), 1,
+             settings.platform_admin_email, ts, ts))
+        await self.ctx.db.execute(
+            "INSERT INTO team_members (team_id, user_email, role, joined_at)"
+            " VALUES (?,?,?,?)", (team_id, settings.platform_admin_email, "owner", ts))
+
+    # ----------------------------------------------------------------- users
+
+    async def create_user(self, email: str, password: str, full_name: str = "",
+                          is_admin: bool = False) -> None:
+        ts = now()
+        await self.ctx.db.execute(
+            "INSERT INTO users (email, password_hash, full_name, is_admin, created_at,"
+            " updated_at) VALUES (?,?,?,?,?,?)",
+            (email, _hasher.hash(password), full_name, int(is_admin), ts, ts))
+
+    async def verify_password(self, email: str, password: str) -> bool:
+        row = await self.ctx.db.fetchone("SELECT * FROM users WHERE email=? AND is_active=1",
+                                         (email,))
+        if not row:
+            return False
+        lock_expired = bool(row["locked_until"]) and row["locked_until"] <= now()
+        if row["locked_until"] and not lock_expired:
+            raise AuthError("Account locked")
+        try:
+            _hasher.verify(row["password_hash"], password)
+            await self.ctx.db.execute(
+                "UPDATE users SET failed_login_attempts=0, locked_until=NULL,"
+                " last_login=? WHERE email=?", (now(), email))
+            return True
+        except VerifyMismatchError:
+            # an expired lock resets the counter: one stray failure after a
+            # lockout must not instantly re-lock the account
+            prior = 0 if lock_expired else row["failed_login_attempts"]
+            attempts = prior + 1
+            locked_until = now() + 300 if attempts >= 5 else None
+            await self.ctx.db.execute(
+                "UPDATE users SET failed_login_attempts=?, locked_until=? WHERE email=?",
+                (attempts, locked_until, email))
+            return False
+
+    async def user_teams(self, email: str) -> list[str]:
+        rows = await self.ctx.db.fetchall(
+            "SELECT team_id FROM team_members WHERE user_email=?", (email,))
+        return [r["team_id"] for r in rows]
+
+    # ---------------------------------------------------------------- tokens
+
+    def issue_jwt(self, email: str, expires_minutes: int | None = None,
+                  extra: dict[str, Any] | None = None) -> str:
+        settings = self.ctx.settings
+        claims: dict[str, Any] = {"sub": email, **(extra or {})}
+        return jwt.create_token(
+            claims, settings.jwt_secret_key, settings.jwt_algorithm,
+            expires_minutes=expires_minutes or settings.token_expiry,
+            audience=settings.jwt_audience, issuer=settings.jwt_issuer)
+
+    async def create_api_token(self, email: str, name: str,
+                               server_id: str | None = None,
+                               permissions: list[str] | None = None,
+                               expires_minutes: int | None = None) -> tuple[str, str]:
+        """Catalogued API token: returns (token, token_id). Revocable by jti."""
+        jti = new_id()
+        token = self.issue_jwt(email, expires_minutes=expires_minutes,
+                               extra={"jti": jti,
+                                      **({"server_id": server_id} if server_id else {}),
+                                      **({"scopes": permissions} if permissions else {})})
+        token_id = new_id()
+        from ..db.core import to_json
+        await self.ctx.db.execute(
+            "INSERT INTO api_tokens (id, user_email, name, jti, token_hash, server_id,"
+            " permissions, expires_at, created_at) VALUES (?,?,?,?,?,?,?,?,?)",
+            (token_id, email, name, jti, hashlib.sha256(token.encode()).hexdigest(),
+             server_id, to_json(permissions) if permissions else None,
+             now() + (expires_minutes or self.ctx.settings.token_expiry) * 60, now()))
+        return token, token_id
+
+    async def revoke_token(self, token_id: str) -> None:
+        row = await self.ctx.db.fetchone("SELECT jti FROM api_tokens WHERE id=?", (token_id,))
+        if not row:
+            raise NotFoundError("Token not found")
+        await self.ctx.db.execute("UPDATE api_tokens SET revoked_at=? WHERE id=?",
+                                  (now(), token_id))
+        self._revoked_jtis.add(row["jti"])
+        await self.ctx.bus.publish("tokens.revoked", {"jti": row["jti"]})
+
+    async def list_api_tokens(self, email: str) -> list[dict[str, Any]]:
+        return await self.ctx.db.fetchall(
+            "SELECT id, name, jti, server_id, expires_at, last_used, revoked_at,"
+            " created_at FROM api_tokens WHERE user_email=?", (email,))
+
+    # -------------------------------------------------------------- resolve
+
+    async def resolve_bearer(self, token: str) -> AuthContext:
+        settings = self.ctx.settings
+        try:
+            payload = jwt.decode(token, settings.jwt_secret_key,
+                                 algorithms=(settings.jwt_algorithm,),
+                                 audience=settings.jwt_audience,
+                                 issuer=settings.jwt_issuer)
+        except jwt.JWTError as exc:
+            raise AuthError(f"Invalid token: {exc}") from exc
+        email = payload.get("sub")
+        if not email:
+            raise AuthError("Token missing subject")
+        jti = payload.get("jti")
+        if jti:
+            if jti in self._revoked_jtis:
+                raise AuthError("Token revoked")
+            row = await self.ctx.db.fetchone("SELECT revoked_at FROM api_tokens WHERE jti=?",
+                                             (jti,))
+            if row and row["revoked_at"]:
+                self._revoked_jtis.add(jti)
+                raise AuthError("Token revoked")
+            if row:
+                await self.ctx.db.execute("UPDATE api_tokens SET last_used=? WHERE jti=?",
+                                          (now(), jti))
+        user_row = await self.ctx.db.fetchone(
+            "SELECT is_admin, is_active FROM users WHERE email=?", (email,))
+        if user_row and not user_row["is_active"]:
+            raise AuthError("User deactivated")
+        is_admin = bool(user_row and user_row["is_admin"])
+        scopes = payload.get("scopes")
+        perms = set(scopes) if scopes else (
+            set(PERMISSIONS) if is_admin else set(DEFAULT_USER_PERMISSIONS))
+        return AuthContext(user=email, is_admin=is_admin,
+                           teams=await self.user_teams(email),
+                           permissions=perms, token_jti=jti,
+                           server_id=payload.get("server_id"), via="jwt")
+
+    async def resolve_basic(self, username: str, password: str) -> AuthContext:
+        settings = self.ctx.settings
+        if username == settings.basic_auth_user and password == settings.basic_auth_password:
+            return AuthContext(user=settings.platform_admin_email, is_admin=True,
+                               permissions=set(PERMISSIONS), via="basic")
+        if await self.verify_password(username, password):
+            row = await self.ctx.db.fetchone("SELECT is_admin FROM users WHERE email=?",
+                                             (username,))
+            is_admin = bool(row and row["is_admin"])
+            return AuthContext(user=username, is_admin=is_admin,
+                               teams=await self.user_teams(username),
+                               permissions=set(PERMISSIONS) if is_admin
+                               else set(DEFAULT_USER_PERMISSIONS), via="basic")
+        raise AuthError("Invalid credentials")

@@ -1,0 +1,49 @@
+"""Shared service context + base helpers."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Any
+
+from ..config import Settings
+from ..coordination import EventBus, LeaseManager
+from ..db import Database
+from ..observability import PrometheusRegistry, Tracer
+from ..utils.ids import new_id
+
+if TYPE_CHECKING:  # avoid import cycles
+    from ..plugins.framework import PluginManager
+    from ..tpu_local.provider import LLMProviderRegistry
+
+
+class NotFoundError(Exception):
+    pass
+
+
+class ConflictError(Exception):
+    pass
+
+
+class ValidationFailure(Exception):
+    pass
+
+
+@dataclass
+class AppContext:
+    """Singleton bundle handed to every service (built in lifespan)."""
+
+    settings: Settings
+    db: Database
+    bus: EventBus
+    leases: LeaseManager
+    tracer: Tracer
+    metrics: PrometheusRegistry
+    plugin_manager: "PluginManager | None" = None
+    llm_registry: "LLMProviderRegistry | None" = None
+    worker_id: str = field(default_factory=lambda: new_id()[:12])
+    extras: dict[str, Any] = field(default_factory=dict)
+
+
+def now() -> float:
+    return time.time()

@@ -1,0 +1,273 @@
+"""Federation: peer gateway registration, catalog sync, health loop.
+
+Reference: `/root/reference/mcpgateway/services/gateway_service.py` (7.3k LoC):
+register (`:1593`) connects over SSE/streamable-HTTP (`:6751/:6921`), runs
+MCP initialize + tools/resources/prompts listing, persists the peer catalog
+(`:5603/:5731/:5844`); a leader-gated loop re-checks health
+(`check_health_of_gateways :4368`) with failure backoff (`:4288`) and
+deactivation/reactivation. Same behavior here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any
+
+from ..clients.mcp_client import MCPSession
+from ..db.core import from_json, to_json
+from ..schemas import GatewayCreate, GatewayRead, GatewayUpdate
+from ..utils.crypto import encrypt_field
+from ..utils.ids import new_id
+from .base import AppContext, ConflictError, NotFoundError, now
+from .tool_service import _auth_headers
+
+logger = logging.getLogger(__name__)
+
+
+def _row_to_read(row: dict[str, Any]) -> GatewayRead:
+    return GatewayRead(
+        id=row["id"], name=row["name"], url=row["url"], description=row["description"],
+        transport=row["transport"], auth_type=row["auth_type"],
+        enabled=bool(row["enabled"]), reachable=bool(row["reachable"]),
+        state=row["state"], capabilities=from_json(row["capabilities"], {}),
+        last_seen=row["last_seen"], tags=from_json(row["tags"], []),
+        team_id=row["team_id"], owner_email=row["owner_email"],
+        visibility=row["visibility"], created_at=row["created_at"],
+        updated_at=row["updated_at"],
+    )
+
+
+class GatewayService:
+    def __init__(self, ctx: AppContext):
+        self.ctx = ctx
+        self._health_task: asyncio.Task | None = None
+
+    # ------------------------------------------------------------------ CRUD
+
+    async def register_gateway(self, gw: GatewayCreate, sync: bool = True) -> GatewayRead:
+        """Insert as pending, then (by default, synchronously) connect +
+        sync the peer catalog. The reference defers to a lifecycle loop;
+        in-tree both modes exist — background via sync=False."""
+        existing = await self.ctx.db.fetchone(
+            "SELECT id FROM gateways WHERE name=? OR url=?", (gw.name, gw.url))
+        if existing:
+            raise ConflictError(f"Gateway {gw.name!r} (or URL) already registered")
+        gid = new_id()
+        ts = now()
+        auth_value = (encrypt_field(gw.auth_value, self.ctx.settings.auth_encryption_secret)
+                      if gw.auth_value else None)
+        await self.ctx.db.execute(
+            "INSERT INTO gateways (id, name, url, description, transport, auth_type,"
+            " auth_value, enabled, state, passthrough_headers, tags, team_id,"
+            " owner_email, visibility, created_at, updated_at)"
+            " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            (gid, gw.name, gw.url, gw.description, gw.transport, gw.auth_type,
+             auth_value, int(gw.enabled), "pending", to_json(gw.passthrough_headers),
+             to_json(gw.tags), gw.team_id, gw.owner_email, gw.visibility, ts, ts),
+        )
+        if sync:
+            await self._activate(gid)
+        else:
+            asyncio.get_running_loop().create_task(self._activate(gid))
+        return await self.get_gateway(gid)
+
+    async def get_gateway(self, gateway_id: str) -> GatewayRead:
+        row = await self.ctx.db.fetchone("SELECT * FROM gateways WHERE id=?", (gateway_id,))
+        if not row:
+            raise NotFoundError(f"Gateway {gateway_id} not found")
+        return _row_to_read(row)
+
+    async def list_gateways(self, include_inactive: bool = False) -> list[GatewayRead]:
+        sql = "SELECT * FROM gateways"
+        if not include_inactive:
+            sql += " WHERE enabled=1"
+        return [_row_to_read(r) for r in await self.ctx.db.fetchall(sql + " ORDER BY name")]
+
+    async def update_gateway(self, gateway_id: str, update: GatewayUpdate) -> GatewayRead:
+        row = await self.ctx.db.fetchone("SELECT * FROM gateways WHERE id=?", (gateway_id,))
+        if not row:
+            raise NotFoundError(f"Gateway {gateway_id} not found")
+        fields = update.model_dump(exclude_unset=True)
+        sets, params = [], []
+        for key, value in fields.items():
+            if key == "auth_value" and value is not None:
+                value = encrypt_field(value, self.ctx.settings.auth_encryption_secret)
+            elif key in ("passthrough_headers", "tags"):
+                value = to_json(value)
+            elif key == "enabled":
+                value = int(value)
+            sets.append(f"{key}=?")
+            params.append(value)
+        if sets:
+            sets.append("updated_at=?")
+            params.extend([now(), gateway_id])
+            await self.ctx.db.execute(f"UPDATE gateways SET {', '.join(sets)} WHERE id=?", params)
+        await self.ctx.bus.publish("gateways.changed", {"action": "update", "id": gateway_id})
+        return await self.get_gateway(gateway_id)
+
+    async def delete_gateway(self, gateway_id: str) -> None:
+        rows = await self.ctx.db.execute("SELECT id FROM gateways WHERE id=?", (gateway_id,))
+        if not rows:
+            raise NotFoundError(f"Gateway {gateway_id} not found")
+        await self.ctx.db.execute("DELETE FROM gateways WHERE id=?", (gateway_id,))
+        await self.ctx.bus.publish("gateways.changed", {"action": "delete", "id": gateway_id})
+
+    # ------------------------------------------------------- connect + sync
+
+    async def _connect(self, row: dict[str, Any]) -> MCPSession:
+        headers = _auth_headers(row, self.ctx.settings.auth_encryption_secret)
+        session = MCPSession(url=row["url"], transport=row["transport"], headers=headers,
+                             timeout=self.ctx.settings.federation_timeout,
+                             verify_ssl=not self.ctx.settings.skip_ssl_verify)
+        await session.connect()
+        return session
+
+    async def _activate(self, gateway_id: str) -> None:
+        row = await self.ctx.db.fetchone("SELECT * FROM gateways WHERE id=?", (gateway_id,))
+        if not row:
+            return
+        try:
+            async with await self._connect(row) as session:
+                tools = await session.list_tools()
+                resources, prompts = [], []
+                if session.capabilities.get("resources") is not None:
+                    try:
+                        resources = await session.list_resources()
+                    except Exception:
+                        pass
+                if session.capabilities.get("prompts") is not None:
+                    try:
+                        prompts = await session.list_prompts()
+                    except Exception:
+                        pass
+                await self._sync_catalog(gateway_id, session.capabilities, tools,
+                                         resources, prompts)
+            await self.ctx.db.execute(
+                "UPDATE gateways SET state='active', reachable=1, failure_count=0,"
+                " last_seen=?, updated_at=? WHERE id=?", (now(), now(), gateway_id))
+            await self.ctx.bus.publish("gateways.changed", {"action": "activated", "id": gateway_id})
+        except Exception as exc:
+            logger.warning("gateway %s activation failed: %s", gateway_id, exc)
+            await self.ctx.db.execute(
+                "UPDATE gateways SET state='failed', reachable=0,"
+                " failure_count=failure_count+1, updated_at=? WHERE id=?",
+                (now(), gateway_id))
+            await self.ctx.bus.publish("gateways.changed", {"action": "failed", "id": gateway_id})
+
+    async def _sync_catalog(self, gateway_id: str, capabilities: dict[str, Any],
+                            tools: list[dict[str, Any]], resources: list[dict[str, Any]],
+                            prompts: list[dict[str, Any]]) -> None:
+        """Upsert the peer's tools/resources/prompts locally
+        (reference _update_or_create_* :5603/:5731/:5844)."""
+        db = self.ctx.db
+        ts = now()
+        await db.execute("UPDATE gateways SET capabilities=? WHERE id=?",
+                         (to_json(capabilities), gateway_id))
+        seen = []
+        for tool in tools:
+            tname = tool.get("name", "")
+            if not tname:
+                continue
+            seen.append(tname)
+            await db.execute(
+                "INSERT INTO tools (id, original_name, description, integration_type,"
+                " input_schema, output_schema, annotations, gateway_id, enabled,"
+                " created_at, updated_at) VALUES (?,?,?,?,?,?,?,?,?,?,?)"
+                " ON CONFLICT(original_name, COALESCE(gateway_id,'')) DO UPDATE SET"
+                " description=excluded.description, input_schema=excluded.input_schema,"
+                " output_schema=excluded.output_schema, annotations=excluded.annotations,"
+                " updated_at=excluded.updated_at",
+                (new_id(), tname, tool.get("description"), "MCP",
+                 to_json(tool.get("inputSchema", {})),
+                 to_json(tool.get("outputSchema")) if tool.get("outputSchema") else None,
+                 to_json(tool.get("annotations", {})), gateway_id, 1, ts, ts))
+        if seen:
+            marks = ",".join("?" for _ in seen)
+            await db.execute(
+                f"DELETE FROM tools WHERE gateway_id=? AND original_name NOT IN ({marks})",
+                [gateway_id, *seen])
+        else:
+            await db.execute("DELETE FROM tools WHERE gateway_id=?", (gateway_id,))
+        for res in resources:
+            await db.execute(
+                "INSERT INTO resources (id, uri, name, description, mime_type, gateway_id,"
+                " enabled, created_at, updated_at) VALUES (?,?,?,?,?,?,?,?,?)"
+                " ON CONFLICT(uri, COALESCE(gateway_id,'')) DO UPDATE SET"
+                " name=excluded.name, description=excluded.description,"
+                " mime_type=excluded.mime_type, updated_at=excluded.updated_at",
+                (new_id(), res.get("uri", ""), res.get("name", ""), res.get("description"),
+                 res.get("mimeType"), gateway_id, 1, ts, ts))
+        for prompt in prompts:
+            await db.execute(
+                "INSERT INTO prompts (id, name, description, template, arguments, gateway_id,"
+                " enabled, created_at, updated_at) VALUES (?,?,?,?,?,?,?,?,?)"
+                " ON CONFLICT(name, COALESCE(gateway_id,'')) DO UPDATE SET"
+                " description=excluded.description, arguments=excluded.arguments,"
+                " updated_at=excluded.updated_at",
+                (new_id(), prompt.get("name", ""), prompt.get("description"), "",
+                 to_json(prompt.get("arguments", [])), gateway_id, 1, ts, ts))
+        await self.ctx.bus.publish("tools.changed", {"action": "sync", "gateway_id": gateway_id})
+
+    # ------------------------------------------------------------ health loop
+
+    async def start_health_loop(self) -> None:
+        if self._health_task is None:
+            self._health_task = asyncio.create_task(self._health_loop())
+
+    async def stop_health_loop(self) -> None:
+        if self._health_task is not None:
+            self._health_task.cancel()
+            try:
+                await self._health_task
+            except asyncio.CancelledError:
+                pass
+            self._health_task = None
+
+    async def _health_loop(self) -> None:
+        interval = self.ctx.settings.gateway_health_interval
+        elector = self.ctx.extras.get("leader_elector")
+        while True:
+            try:
+                if elector is None or elector.is_leader:
+                    await self.check_health_of_gateways()
+            except Exception as exc:
+                logger.warning("health loop error: %s", exc)
+            await asyncio.sleep(interval)
+
+    async def check_health_of_gateways(self) -> dict[str, bool]:
+        """Ping every enabled gateway; deactivate after threshold failures,
+        reactivate on recovery (reference :4368/:4318/:4485)."""
+        rows = await self.ctx.db.fetchall("SELECT * FROM gateways WHERE enabled=1")
+        results: dict[str, bool] = {}
+        for row in rows:
+            ok = False
+            try:
+                async with await self._connect(row) as session:
+                    ok = True
+            except Exception:
+                ok = False
+            results[row["id"]] = ok
+            if ok:
+                await self.ctx.db.execute(
+                    "UPDATE gateways SET reachable=1, state='active', failure_count=0,"
+                    " last_seen=?, updated_at=? WHERE id=?", (now(), now(), row["id"]))
+                if not row["reachable"]:
+                    await self.ctx.bus.publish("gateways.changed",
+                                               {"action": "reactivated", "id": row["id"]})
+            else:
+                failures = row["failure_count"] + 1
+                state = "failed" if failures >= self.ctx.settings.gateway_failure_threshold \
+                    else row["state"]
+                await self.ctx.db.execute(
+                    "UPDATE gateways SET reachable=0, state=?, failure_count=?,"
+                    " updated_at=? WHERE id=?", (state, failures, now(), row["id"]))
+                if state == "failed" and row["state"] != "failed":
+                    await self.ctx.bus.publish("gateways.changed",
+                                               {"action": "deactivated", "id": row["id"]})
+        return results
+
+    async def refresh_gateway(self, gateway_id: str) -> GatewayRead:
+        """Re-sync the peer catalog on demand."""
+        await self._activate(gateway_id)
+        return await self.get_gateway(gateway_id)

@@ -1,0 +1,396 @@
+"""Tool registry + invocation.
+
+Reference: `/root/reference/mcpgateway/services/tool_service.py` (7.6k LoC).
+Same capability set, restructured: CRUD over the repo layer, invocation with
+plugin pre/post hooks, REST / MCP / A2A branches, retries, per-call metrics,
+output filtering. The reference's phase discipline — detach from the DB
+before network I/O (`tool_service.py:5022`) — holds structurally here since
+rows are plain dicts and the DB facade never spans an await on the hot path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any
+
+import httpx
+
+from ..clients.mcp_client import MCPSession
+from ..db.core import from_json, to_json
+from ..jsonrpc import JSONRPCError, INVALID_PARAMS, INTERNAL_ERROR
+from ..schemas import ToolCreate, ToolRead, ToolUpdate
+from ..utils.crypto import decrypt_field, encrypt_field
+from ..utils.ids import new_id
+from ..utils.retry import with_retries
+from .base import AppContext, ConflictError, NotFoundError, now
+
+
+def _row_to_read(row: dict[str, Any]) -> ToolRead:
+    return ToolRead(
+        id=row["id"],
+        name=row["custom_name"] or row["original_name"],
+        original_name=row["original_name"],
+        display_name=row["display_name"],
+        description=row["description"],
+        integration_type=row["integration_type"],
+        request_type=row["request_type"],
+        url=row["url"],
+        input_schema=from_json(row["input_schema"], {}),
+        output_schema=from_json(row["output_schema"]),
+        annotations=from_json(row["annotations"], {}),
+        gateway_id=row["gateway_id"],
+        enabled=bool(row["enabled"]),
+        reachable=bool(row["reachable"]),
+        tags=from_json(row["tags"], []),
+        team_id=row["team_id"],
+        owner_email=row["owner_email"],
+        visibility=row["visibility"],
+        created_at=row["created_at"],
+        updated_at=row["updated_at"],
+    )
+
+
+class ToolService:
+    def __init__(self, ctx: AppContext):
+        self.ctx = ctx
+        self._lookup_cache: dict[str, dict[str, Any]] = {}  # name -> row
+        # cross-worker invalidation: any tools.changed event (ours or a
+        # peer worker's, incl. federation catalog syncs) drops the cache
+        self.ctx.bus.subscribe("tools.changed", self._on_tools_changed)
+
+    async def _on_tools_changed(self, topic: str, message: dict[str, Any]) -> None:
+        self._lookup_cache.clear()
+
+    # ----------------------------------------------------------------- CRUD
+
+    async def register_tool(self, tool: ToolCreate) -> ToolRead:
+        row = await self.ctx.db.fetchone(
+            "SELECT id FROM tools WHERE original_name=? AND COALESCE(gateway_id,'')=?",
+            (tool.name, tool.gateway_id or ""),
+        )
+        if row:
+            raise ConflictError(f"Tool {tool.name!r} already exists")
+        tid = new_id()
+        ts = now()
+        auth_value = (
+            encrypt_field(tool.auth_value, self.ctx.settings.auth_encryption_secret)
+            if tool.auth_value else None
+        )
+        await self.ctx.db.execute(
+            "INSERT INTO tools (id, original_name, display_name, description,"
+            " integration_type, request_type, url, input_schema, output_schema,"
+            " annotations, headers, auth_type, auth_value, jsonpath_filter,"
+            " gateway_id, enabled, tags, team_id, owner_email, visibility,"
+            " created_at, updated_at) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            (tid, tool.name, tool.display_name, tool.description,
+             tool.integration_type, tool.request_type, tool.url,
+             to_json(tool.input_schema), to_json(tool.output_schema) if tool.output_schema else None,
+             to_json(tool.annotations), to_json(tool.headers), tool.auth_type, auth_value,
+             tool.jsonpath_filter, tool.gateway_id, int(tool.enabled), to_json(tool.tags),
+             tool.team_id, tool.owner_email, tool.visibility, ts, ts),
+        )
+        self._lookup_cache.clear()
+        await self.ctx.bus.publish("tools.changed", {"action": "register", "id": tid})
+        return await self.get_tool(tid)
+
+    async def get_tool(self, tool_id: str) -> ToolRead:
+        row = await self.ctx.db.fetchone("SELECT * FROM tools WHERE id=?", (tool_id,))
+        if not row:
+            raise NotFoundError(f"Tool {tool_id} not found")
+        return _row_to_read(row)
+
+    async def list_tools(self, include_inactive: bool = False,
+                         gateway_id: str | None = None,
+                         team_ids: list[str] | None = None) -> list[ToolRead]:
+        sql = "SELECT * FROM tools"
+        clauses, params = [], []
+        if not include_inactive:
+            clauses.append("enabled=1")
+        if gateway_id is not None:
+            clauses.append("gateway_id=?")
+            params.append(gateway_id)
+        if team_ids is not None:
+            marks = ",".join("?" for _ in team_ids)
+            clauses.append(f"(visibility='public' OR team_id IN ({marks}))")
+            params.extend(team_ids)
+        if clauses:
+            sql += " WHERE " + " AND ".join(clauses)
+        sql += " ORDER BY original_name"
+        return [_row_to_read(r) for r in await self.ctx.db.fetchall(sql, params)]
+
+    async def update_tool(self, tool_id: str, update: ToolUpdate) -> ToolRead:
+        row = await self.ctx.db.fetchone("SELECT * FROM tools WHERE id=?", (tool_id,))
+        if not row:
+            raise NotFoundError(f"Tool {tool_id} not found")
+        fields = update.model_dump(exclude_unset=True)
+        sets, params = [], []
+        for key, value in fields.items():
+            if key == "auth_value" and value is not None:
+                value = encrypt_field(value, self.ctx.settings.auth_encryption_secret)
+            elif key in ("input_schema", "output_schema", "annotations", "headers", "tags"):
+                value = to_json(value)
+            elif key == "enabled":
+                value = int(value)
+            sets.append(f"{key}=?")
+            params.append(value)
+        if sets:
+            sets.append("updated_at=?")
+            params.append(now())
+            params.append(tool_id)
+            await self.ctx.db.execute(f"UPDATE tools SET {', '.join(sets)} WHERE id=?", params)
+        self._lookup_cache.clear()
+        await self.ctx.bus.publish("tools.changed", {"action": "update", "id": tool_id})
+        return await self.get_tool(tool_id)
+
+    async def toggle_tool(self, tool_id: str, enabled: bool) -> ToolRead:
+        await self.ctx.db.execute("UPDATE tools SET enabled=?, updated_at=? WHERE id=?",
+                                  (int(enabled), now(), tool_id))
+        self._lookup_cache.clear()
+        await self.ctx.bus.publish("tools.changed", {"action": "toggle", "id": tool_id})
+        return await self.get_tool(tool_id)
+
+    async def delete_tool(self, tool_id: str) -> None:
+        rows = await self.ctx.db.execute("SELECT id FROM tools WHERE id=?", (tool_id,))
+        if not rows:
+            raise NotFoundError(f"Tool {tool_id} not found")
+        await self.ctx.db.execute("DELETE FROM tools WHERE id=?", (tool_id,))
+        self._lookup_cache.clear()
+        await self.ctx.bus.publish("tools.changed", {"action": "delete", "id": tool_id})
+
+    # ------------------------------------------------------------- invocation
+
+    async def _lookup(self, name: str) -> dict[str, Any]:
+        cached = self._lookup_cache.get(name)
+        if cached is not None:
+            return cached
+        row = await self.ctx.db.fetchone(
+            "SELECT * FROM tools WHERE (custom_name=? OR original_name=?) AND enabled=1",
+            (name, name),
+        )
+        if not row:
+            raise NotFoundError(f"Tool {name!r} not found")
+        self._lookup_cache[name] = row
+        return row
+
+    async def invoke_tool(self, name: str, arguments: dict[str, Any],
+                          request_headers: dict[str, str] | None = None,
+                          user: str | None = None) -> dict[str, Any]:
+        """Invoke by name with plugin hooks, tracing and metrics.
+
+        Returns an MCP ``tools/call`` result: {content: [...], isError: bool}.
+        """
+        started = time.monotonic()
+        status = "success"
+        row = await self._lookup(name)
+        tool_id = row["id"]
+        pm = self.ctx.plugin_manager
+        request_headers = dict(request_headers or {})
+        inbound_snapshot = dict(request_headers)
+        with self.ctx.tracer.span("tool.invoke", {"tool.name": name, "tool.id": tool_id,
+                                                  "tool.type": row["integration_type"]}):
+            try:
+                plugin_ctx = None
+                early = None
+                if pm is not None:
+                    name, arguments, request_headers, early, plugin_ctx = \
+                        await pm.tool_pre_invoke(name, arguments, request_headers,
+                                                 user=user)
+                    if early is None and name != row["original_name"] \
+                            and name != (row["custom_name"] or ""):
+                        row = await self._lookup(name)
+                # headers a plugin added/changed (vs the inbound snapshot) are
+                # forwarded upstream; raw inbound headers are not, except via
+                # the per-gateway passthrough allowlist (MCP branch)
+                injected_headers = {k: v for k, v in request_headers.items()
+                                    if inbound_snapshot.get(k) != v}
+                if early is not None:
+                    result = early
+                else:
+                    try:
+                        result = await self._dispatch(row, arguments, request_headers,
+                                                      injected_headers)
+                    except JSONRPCError:
+                        raise
+                    except Exception as exc:
+                        # MCP semantics: execution failures are isError results,
+                        # not protocol errors — and post hooks (circuit breaker,
+                        # audit) must observe them.
+                        status = "error"
+                        result = {"content": [{"type": "text",
+                                               "text": f"{type(exc).__name__}: {exc}"}],
+                                  "isError": True}
+                if pm is not None:
+                    result = await pm.tool_post_invoke(name, result, user=user,
+                                                       context=plugin_ctx)
+                if row["jsonpath_filter"]:
+                    result = _apply_filter(result, row["jsonpath_filter"])
+                if row["output_schema"]:
+                    _validate_output(result, from_json(row["output_schema"], {}))
+                return result
+            except BaseException:
+                status = "error"
+                raise
+            finally:
+                elapsed = time.monotonic() - started
+                self.ctx.metrics.tool_invocations.labels(tool=name, status=status).inc()
+                self.ctx.metrics.tool_duration.labels(tool=name).observe(elapsed)
+                asyncio.get_running_loop().create_task(
+                    self._record_metric(tool_id, elapsed * 1000, status == "success"))
+
+    async def _record_metric(self, tool_id: str, duration_ms: float, success: bool) -> None:
+        try:
+            await self.ctx.db.execute(
+                "INSERT INTO tool_metrics (tool_id, ts, duration_ms, success) VALUES (?,?,?,?)",
+                (tool_id, now(), duration_ms, int(success)),
+            )
+        except Exception:
+            pass
+
+    async def _dispatch(self, row: dict[str, Any], arguments: dict[str, Any],
+                        request_headers: dict[str, str],
+                        injected_headers: dict[str, str] | None = None) -> dict[str, Any]:
+        integration = row["integration_type"]
+        injected_headers = injected_headers or {}
+        if integration == "REST":
+            return await self._invoke_rest(row, arguments, injected_headers)
+        if integration == "MCP":
+            return await self._invoke_mcp(row, arguments, request_headers,
+                                          injected_headers)
+        if integration == "A2A":
+            a2a = self.ctx.extras.get("a2a_service")
+            if a2a is None:
+                raise JSONRPCError(INTERNAL_ERROR, "A2A service not initialized")
+            agent_name = from_json(row["annotations"], {}).get("a2a_agent") or row["original_name"]
+            reply = await a2a.invoke_agent(agent_name, {"message": arguments})
+            return _text_result(json.dumps(reply) if not isinstance(reply, str) else reply)
+        raise JSONRPCError(INVALID_PARAMS, f"Unsupported integration type {integration}")
+
+    # REST branch (reference tool_service.py:6196+)
+    async def _invoke_rest(self, row: dict[str, Any], arguments: dict[str, Any],
+                           injected_headers: dict[str, str]) -> dict[str, Any]:
+        url = row["url"]
+        if not url:
+            raise JSONRPCError(INVALID_PARAMS, "REST tool has no URL")
+        headers = dict(from_json(row["headers"], {}))
+        headers.update(injected_headers)
+        headers.update(_auth_headers(row, self.ctx.settings.auth_encryption_secret))
+        # URL path templating: {placeholder} substituted from arguments
+        body_args = dict(arguments)
+        for key in list(body_args):
+            token = "{" + key + "}"
+            if token in url:
+                url = url.replace(token, str(body_args.pop(key)))
+        method = row["request_type"].upper()
+        timeout = self.ctx.settings.tool_timeout
+
+        async def _do() -> httpx.Response:
+            async with httpx.AsyncClient(timeout=timeout,
+                                         verify=not self.ctx.settings.skip_ssl_verify) as client:
+                if method in ("GET", "DELETE"):
+                    resp = await client.request(method, url, params=body_args, headers=headers)
+                else:
+                    resp = await client.request(method, url, json=body_args, headers=headers)
+                resp.raise_for_status()
+                return resp
+
+        resp = await with_retries(_do, attempts=self.ctx.settings.max_tool_retries,
+                                  base=self.ctx.settings.retry_base_delay,
+                                  cap=self.ctx.settings.retry_max_delay)
+        try:
+            payload = resp.json()
+            return _text_result(json.dumps(payload))
+        except (json.JSONDecodeError, ValueError):
+            return _text_result(resp.text)
+
+    # MCP branch (reference tool_service.py:5911/:6094)
+    async def _invoke_mcp(self, row: dict[str, Any], arguments: dict[str, Any],
+                          request_headers: dict[str, str],
+                          injected_headers: dict[str, str] | None = None) -> dict[str, Any]:
+        gateway = None
+        if row["gateway_id"]:
+            gateway = await self.ctx.db.fetchone("SELECT * FROM gateways WHERE id=?",
+                                                 (row["gateway_id"],))
+        url = (gateway or {}).get("url") or row["url"]
+        if not url:
+            raise JSONRPCError(INVALID_PARAMS, "MCP tool has no upstream URL")
+        transport = (gateway or {}).get("transport") or "streamablehttp"
+        headers = _auth_headers(gateway or row, self.ctx.settings.auth_encryption_secret)
+        # passthrough headers from the inbound request (reference passthrough_headers)
+        allowed = from_json((gateway or {}).get("passthrough_headers"), [])
+        for h in allowed:
+            value = request_headers.get(h.lower())
+            if value:
+                headers[h] = value
+        headers.update(injected_headers or {})
+
+        async def _do() -> dict[str, Any]:
+            async with MCPSession(url=url, transport=transport, headers=headers,
+                                  timeout=self.ctx.settings.tool_timeout,
+                                  verify_ssl=not self.ctx.settings.skip_ssl_verify) as session:
+                return await session.call_tool(row["original_name"], arguments)
+
+        return await with_retries(_do, attempts=self.ctx.settings.max_tool_retries,
+                                  base=self.ctx.settings.retry_base_delay,
+                                  cap=self.ctx.settings.retry_max_delay)
+
+
+def _text_result(text: str) -> dict[str, Any]:
+    return {"content": [{"type": "text", "text": text}], "isError": False}
+
+
+def _auth_headers(row: dict[str, Any], secret: str) -> dict[str, str]:
+    auth_type = row.get("auth_type")
+    if not auth_type or auth_type == "none":
+        return {}
+    value = decrypt_field(row.get("auth_value"), secret) or {}
+    if auth_type == "basic":
+        import base64
+        creds = base64.b64encode(
+            f"{value.get('username', '')}:{value.get('password', '')}".encode()).decode()
+        return {"authorization": f"Basic {creds}"}
+    if auth_type == "bearer":
+        return {"authorization": f"Bearer {value.get('token', '')}"}
+    if auth_type == "headers":
+        headers = value.get("headers", value)
+        return {str(k): str(v) for k, v in headers.items()}
+    return {}
+
+
+def _apply_filter(result: dict[str, Any], path: str) -> dict[str, Any]:
+    """Minimal JSONPath subset: $.a.b[0].c over the first text content item."""
+    if not path.startswith("$."):
+        return result
+    try:
+        content = result.get("content", [])
+        text = next((c.get("text") for c in content if c.get("type") == "text"), None)
+        if text is None:
+            return result
+        node: Any = json.loads(text)
+        for part in path[2:].replace("]", "").replace("[", ".").split("."):
+            if not part:
+                continue
+            node = node[int(part)] if part.lstrip("-").isdigit() else node[part]
+        return _text_result(json.dumps(node))
+    except Exception:
+        return result
+
+
+def _validate_output(result: dict[str, Any], schema: dict[str, Any]) -> None:
+    """Light output-schema check: required keys on structuredContent/JSON text."""
+    required = schema.get("required", [])
+    if not required:
+        return
+    payload = result.get("structuredContent")
+    if payload is None:
+        try:
+            content = result.get("content", [])
+            text = next((c.get("text") for c in content if c.get("type") == "text"), "")
+            payload = json.loads(text)
+        except Exception:
+            return
+    if isinstance(payload, dict):
+        missing = [k for k in required if k not in payload]
+        if missing:
+            raise JSONRPCError(INTERNAL_ERROR, f"Tool output missing required keys: {missing}")

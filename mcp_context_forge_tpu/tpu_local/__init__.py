@@ -1,0 +1,18 @@
+"""tpu_local: the in-tree TPU inference engine + LLM provider layer.
+
+This is the genuinely new component relative to the reference (which proxies
+all LLM traffic to external providers — `/root/reference/mcpgateway/services/
+llm_proxy_service.py`): a JAX/XLA engine serving OpenAI-compatible chat and
+embeddings from a model sharded over a TPU slice via pjit/NamedSharding,
+with continuous batching and a paged KV cache in HBM.
+
+Layout:
+- ``provider.py``  — LLM provider registry (tpu_local + external passthrough
+  provider types, mirroring the reference's 12-type enum db.py:6307-6321).
+- ``models/``      — Llama-3-class decoder + small encoder, pure-pytree params.
+- ``ops/``         — Pallas kernels (flash attention, paged decode attention).
+- ``parallel/``    — mesh construction + sharding rules + collectives.
+- ``kv/``          — paged KV cache.
+- ``engine.py``    — continuous-batching scheduler + asyncio bridge.
+- ``server.py``    — /v1 OpenAI-compatible endpoints bound to the gateway app.
+"""

@@ -1,0 +1,172 @@
+"""LLM provider registry.
+
+Reference: provider-type enum of 12 (`/root/reference/mcpgateway/db.py:
+6307-6321`), request translation per family (`services/llm_proxy_service.py:
+203-441`), model→provider resolution (`:138`). Here the registry resolves a
+model alias to a provider; ``tpu_local`` is the in-tree engine-backed
+provider, and ``openai_compatible`` covers external OpenAI-shape endpoints
+(openai, ollama, groq, together, …). Anthropic-shape translation is applied
+when ``dialect: anthropic`` is configured.
+"""
+
+from __future__ import annotations
+
+import time
+from abc import ABC, abstractmethod
+from typing import Any, AsyncIterator
+
+import httpx
+
+from ..utils.ids import new_id
+
+
+class LLMError(Exception):
+    pass
+
+
+class LLMProvider(ABC):
+    """One backend capable of chat and/or embeddings (OpenAI wire shapes)."""
+
+    name: str = "provider"
+    provider_type: str = "abstract"
+
+    @abstractmethod
+    async def chat(self, request: dict[str, Any]) -> dict[str, Any]:
+        """OpenAI ChatCompletionRequest dict -> ChatCompletionResponse dict."""
+
+    async def chat_stream(self, request: dict[str, Any]) -> AsyncIterator[dict[str, Any]]:
+        """Yield OpenAI chat.completion.chunk dicts. Default: one-shot."""
+        response = await self.chat(request)
+        choice = response["choices"][0]
+        yield {
+            "id": response["id"], "object": "chat.completion.chunk",
+            "created": response["created"], "model": response["model"],
+            "choices": [{"index": 0,
+                         "delta": {"role": "assistant",
+                                   "content": choice["message"]["content"]},
+                         "finish_reason": choice.get("finish_reason")}],
+        }
+
+    async def embed(self, texts: list[str], model: str | None = None) -> list[list[float]]:
+        raise LLMError(f"Provider {self.name} does not support embeddings")
+
+    async def models(self) -> list[str]:
+        return []
+
+    async def shutdown(self) -> None:
+        return None
+
+
+class OpenAICompatProvider(LLMProvider):
+    """Passthrough to an external OpenAI-compatible endpoint
+    (reference _build_openai_request/_build_ollama_request families)."""
+
+    provider_type = "openai_compatible"
+
+    def __init__(self, name: str, api_base: str, api_key: str = "",
+                 timeout: float = 120.0):
+        self.name = name
+        self.api_base = api_base.rstrip("/")
+        self.api_key = api_key
+        self.timeout = timeout
+
+    def _headers(self) -> dict[str, str]:
+        headers = {"content-type": "application/json"}
+        if self.api_key:
+            headers["authorization"] = f"Bearer {self.api_key}"
+        return headers
+
+    async def chat(self, request: dict[str, Any]) -> dict[str, Any]:
+        async with httpx.AsyncClient(timeout=self.timeout) as client:
+            resp = await client.post(f"{self.api_base}/chat/completions",
+                                     json={**request, "stream": False},
+                                     headers=self._headers())
+            resp.raise_for_status()
+            return resp.json()
+
+    async def embed(self, texts: list[str], model: str | None = None) -> list[list[float]]:
+        async with httpx.AsyncClient(timeout=self.timeout) as client:
+            resp = await client.post(f"{self.api_base}/embeddings",
+                                     json={"input": texts, "model": model or "default"},
+                                     headers=self._headers())
+            resp.raise_for_status()
+            data = resp.json().get("data", [])
+            return [d["embedding"] for d in data]
+
+
+class LLMProviderRegistry:
+    """model alias -> provider resolution + lifecycle."""
+
+    def __init__(self) -> None:
+        self._providers: dict[str, LLMProvider] = {}
+        self._aliases: dict[str, str] = {}  # model alias -> provider name
+        self.default_chat_model: str | None = None
+        self.default_embed_model: str | None = None
+
+    def register(self, provider: LLMProvider, models: list[str],
+                 default_chat: bool = False, default_embed: bool = False) -> None:
+        self._providers[provider.name] = provider
+        for model in models:
+            self._aliases[model] = provider.name
+        if default_chat and models:
+            self.default_chat_model = models[0]
+        if default_embed and models:
+            self.default_embed_model = models[-1]
+
+    def resolve(self, model: str | None) -> tuple[LLMProvider, str]:
+        model = model or self.default_chat_model
+        if model is None:
+            raise LLMError("No model specified and no default configured")
+        name = self._aliases.get(model)
+        if name is None:
+            # fall back to the default provider with the requested model id
+            if self.default_chat_model and self.default_chat_model in self._aliases:
+                name = self._aliases[self.default_chat_model]
+            else:
+                raise LLMError(f"Unknown model {model!r}")
+        return self._providers[name], model
+
+    def list_models(self) -> list[dict[str, Any]]:
+        return [{"id": alias, "object": "model", "owned_by": provider}
+                for alias, provider in sorted(self._aliases.items())]
+
+    async def chat(self, request: dict[str, Any]) -> dict[str, Any]:
+        provider, model = self.resolve(request.get("model"))
+        return await provider.chat({**request, "model": model})
+
+    async def chat_stream(self, request: dict[str, Any]) -> AsyncIterator[dict[str, Any]]:
+        provider, model = self.resolve(request.get("model"))
+        async for chunk in provider.chat_stream({**request, "model": model}):
+            yield chunk
+
+    async def embed(self, texts: list[str], model: str | None = None) -> list[list[float]]:
+        provider, resolved = self.resolve(model or self.default_embed_model)
+        return await provider.embed(texts, model=resolved)
+
+    async def shutdown(self) -> None:
+        for provider in self._providers.values():
+            try:
+                await provider.shutdown()
+            except Exception:
+                pass
+
+
+def make_chat_response(model: str, text: str, prompt_tokens: int = 0,
+                       completion_tokens: int = 0,
+                       finish_reason: str = "stop") -> dict[str, Any]:
+    return {
+        "id": f"chatcmpl-{new_id()[:24]}",
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "message": {"role": "assistant", "content": text},
+            "finish_reason": finish_reason,
+        }],
+        "usage": {
+            "prompt_tokens": prompt_tokens,
+            "completion_tokens": completion_tokens,
+            "total_tokens": prompt_tokens + completion_tokens,
+        },
+    }

@@ -1,0 +1,257 @@
+"""End-to-end gateway tests over a real socket (aiohttp TestServer)."""
+
+import asyncio
+import json
+
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from mcp_context_forge_tpu.config import load_settings
+from mcp_context_forge_tpu.gateway.app import build_app
+
+BASIC = ("admin", "changeme")
+
+
+def _settings(**overrides):
+    env = {
+        "MCPFORGE_DATABASE_URL": "sqlite:///:memory:",
+        "MCPFORGE_PLUGINS_ENABLED": "false",
+        "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
+        **{f"MCPFORGE_{k.upper()}": str(v) for k, v in overrides.items()},
+    }
+    return load_settings(env=env, env_file=None)
+
+
+async def make_client(**overrides) -> TestClient:
+    app = await build_app(_settings(**overrides))
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def make_echo_rest_server() -> TestClient:
+    """A plain REST endpoint the gateway can call as a REST tool."""
+    app = web.Application()
+
+    async def echo(request: web.Request) -> web.Response:
+        body = await request.json()
+        return web.json_response({"echo": body, "header": request.headers.get("x-extra", "")})
+
+    app.router.add_post("/echo", echo)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def test_health_public():
+    client = await make_client()
+    try:
+        resp = await client.get("/health")
+        assert resp.status == 200
+        assert (await resp.json())["status"] == "healthy"
+    finally:
+        await client.close()
+
+
+async def test_auth_required():
+    client = await make_client()
+    try:
+        resp = await client.get("/tools")
+        assert resp.status == 401
+        resp = await client.get("/tools", auth=None,
+                                headers={"authorization": "Bearer bogus"})
+        assert resp.status == 401
+    finally:
+        await client.close()
+
+
+async def test_rest_tool_roundtrip():
+    gateway = await make_client()
+    rest = await make_echo_rest_server()
+    try:
+        url = f"http://{rest.server.host}:{rest.server.port}/echo"
+        import aiohttp
+        auth = aiohttp.BasicAuth(*BASIC)
+        resp = await gateway.post("/tools", json={
+            "name": "echo", "integration_type": "REST", "request_type": "POST",
+            "url": url, "headers": {"x-extra": "injected"},
+        }, auth=auth)
+        assert resp.status == 201, await resp.text()
+        tool = await resp.json()
+        assert tool["name"] == "echo"
+
+        # duplicate -> 409
+        resp = await gateway.post("/tools", json={
+            "name": "echo", "integration_type": "REST", "url": url}, auth=auth)
+        assert resp.status == 409
+
+        # invoke through JSON-RPC
+        resp = await gateway.post("/rpc", json={
+            "jsonrpc": "2.0", "id": 1, "method": "tools/call",
+            "params": {"name": "echo", "arguments": {"hello": "world"}},
+        }, auth=auth)
+        assert resp.status == 200, await resp.text()
+        payload = await resp.json()
+        assert payload["id"] == 1
+        content = payload["result"]["content"][0]["text"]
+        parsed = json.loads(content)
+        assert parsed["echo"] == {"hello": "world"}
+        assert parsed["header"] == "injected"
+
+        # tools/list via /mcp (streamable-http stateless)
+        resp = await gateway.post("/mcp", json={
+            "jsonrpc": "2.0", "id": 2, "method": "tools/list"}, auth=auth)
+        assert resp.status == 200
+        tools = (await resp.json())["result"]["tools"]
+        assert [t["name"] for t in tools] == ["echo"]
+
+        # initialize over /mcp
+        resp = await gateway.post("/mcp", json={
+            "jsonrpc": "2.0", "id": 3, "method": "initialize",
+            "params": {"protocolVersion": "2025-06-18", "capabilities": {},
+                       "clientInfo": {"name": "t", "version": "0"}}}, auth=auth)
+        result = (await resp.json())["result"]
+        assert result["serverInfo"]["name"]
+        assert "tools" in result["capabilities"]
+
+        # metrics recorded
+        await asyncio.sleep(0.05)
+        resp = await gateway.get("/metrics", auth=auth)
+        stats = (await resp.json())["tools"]
+        assert stats and stats[0]["name"] == "echo" and stats[0]["calls"] >= 1
+    finally:
+        await rest.close()
+        await gateway.close()
+
+
+async def test_unknown_method_and_bad_json():
+    gateway = await make_client()
+    try:
+        import aiohttp
+        auth = aiohttp.BasicAuth(*BASIC)
+        resp = await gateway.post("/rpc", json={
+            "jsonrpc": "2.0", "id": 9, "method": "bogus/method"}, auth=auth)
+        payload = await resp.json()
+        assert payload["error"]["code"] == -32601
+
+        resp = await gateway.post("/rpc", data=b"{not json", auth=auth,
+                                  headers={"content-type": "application/json"})
+        payload = await resp.json()
+        assert payload["error"]["code"] == -32700
+    finally:
+        await gateway.close()
+
+
+async def test_self_federation():
+    """Register gateway B (same process) as a peer of gateway A and call a
+    remote tool through the federation path."""
+    peer = await make_client()
+    hub = await make_client()
+    rest = await make_echo_rest_server()
+    try:
+        import aiohttp
+        auth = aiohttp.BasicAuth(*BASIC)
+        # tool lives on the peer
+        url = f"http://{rest.server.host}:{rest.server.port}/echo"
+        resp = await peer.post("/tools", json={
+            "name": "remote-echo", "integration_type": "REST", "url": url}, auth=auth)
+        assert resp.status == 201
+        # hub federates the peer over streamable-http with basic auth
+        peer_url = f"http://{peer.server.host}:{peer.server.port}/mcp"
+        resp = await hub.post("/gateways", json={
+            "name": "peer", "url": peer_url, "transport": "streamablehttp",
+            "auth_type": "basic",
+            "auth_value": {"username": BASIC[0], "password": BASIC[1]},
+        }, auth=auth)
+        assert resp.status == 201, await resp.text()
+        gw = await resp.json()
+        assert gw["state"] == "active", gw
+        # the peer's tool is now in the hub catalog
+        resp = await hub.get("/tools", auth=auth)
+        names = [t["name"] for t in await resp.json()]
+        assert "remote-echo" in names
+        # invoke through the hub -> peer -> REST endpoint
+        resp = await hub.post("/rpc", json={
+            "jsonrpc": "2.0", "id": 5, "method": "tools/call",
+            "params": {"name": "remote-echo", "arguments": {"via": "federation"}},
+        }, auth=auth)
+        payload = await resp.json()
+        assert "result" in payload, payload
+        text = payload["result"]["content"][0]["text"]
+        assert json.loads(text)["echo"] == {"via": "federation"}
+        # health check marks peer reachable
+        results = await hub.app["gateway_service"].check_health_of_gateways()
+        assert list(results.values()) == [True]
+    finally:
+        await rest.close()
+        await hub.close()
+        await peer.close()
+
+
+async def test_prompts_and_resources_roundtrip():
+    gateway = await make_client()
+    try:
+        import aiohttp
+        auth = aiohttp.BasicAuth(*BASIC)
+        resp = await gateway.post("/prompts", json={
+            "name": "greet", "template": "Hello {{ name }}!",
+            "arguments": [{"name": "name", "required": True}]}, auth=auth)
+        assert resp.status == 201, await resp.text()
+        resp = await gateway.post("/rpc", json={
+            "jsonrpc": "2.0", "id": 1, "method": "prompts/get",
+            "params": {"name": "greet", "arguments": {"name": "TPU"}}}, auth=auth)
+        payload = await resp.json()
+        assert payload["result"]["messages"][0]["content"]["text"] == "Hello TPU!"
+        # missing required arg -> invalid params
+        resp = await gateway.post("/rpc", json={
+            "jsonrpc": "2.0", "id": 2, "method": "prompts/get",
+            "params": {"name": "greet"}}, auth=auth)
+        payload = await resp.json()
+        assert payload["error"]["code"] == -32602
+
+        resp = await gateway.post("/resources", json={
+            "uri": "memo://notes/1", "name": "notes", "content": "remember the milk",
+            "mime_type": "text/plain"}, auth=auth)
+        assert resp.status == 201
+        resp = await gateway.post("/rpc", json={
+            "jsonrpc": "2.0", "id": 3, "method": "resources/read",
+            "params": {"uri": "memo://notes/1"}}, auth=auth)
+        payload = await resp.json()
+        assert payload["result"]["contents"][0]["text"] == "remember the milk"
+    finally:
+        await gateway.close()
+
+
+async def test_jwt_flow_and_virtual_server_scoping():
+    gateway = await make_client()
+    rest = await make_echo_rest_server()
+    try:
+        import aiohttp
+        auth = aiohttp.BasicAuth(*BASIC)
+        url = f"http://{rest.server.host}:{rest.server.port}/echo"
+        t1 = await (await gateway.post("/tools", json={
+            "name": "tool-a", "integration_type": "REST", "url": url}, auth=auth)).json()
+        t2 = await (await gateway.post("/tools", json={
+            "name": "tool-b", "integration_type": "REST", "url": url}, auth=auth)).json()
+        server = await (await gateway.post("/servers", json={
+            "name": "virtual-1", "associated_tools": [t1["id"]]}, auth=auth)).json()
+
+        # mint a JWT API token via the REST API
+        resp = await gateway.post("/auth/tokens", json={"name": "ci"}, auth=auth)
+        token = (await resp.json())["token"]
+        bearer = {"authorization": f"Bearer {token}"}
+
+        resp = await gateway.post(f"/servers/{server['id']}/mcp", json={
+            "jsonrpc": "2.0", "id": 1, "method": "tools/list"}, headers=bearer)
+        names = [t["name"] for t in (await resp.json())["result"]["tools"]]
+        assert names == ["tool-a"]
+
+        # tool-b is outside the virtual server scope
+        resp = await gateway.post(f"/servers/{server['id']}/mcp", json={
+            "jsonrpc": "2.0", "id": 2, "method": "tools/call",
+            "params": {"name": "tool-b", "arguments": {}}}, headers=bearer)
+        payload = await resp.json()
+        assert payload["error"]["code"] == -32602
+    finally:
+        await rest.close()
+        await gateway.close()

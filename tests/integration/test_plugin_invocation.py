@@ -1,0 +1,133 @@
+"""Regression tests for plugin ↔ invocation seams (code-review findings)."""
+
+import json
+
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from mcp_context_forge_tpu.plugins.framework import PluginConfig, PluginManager, PluginMode
+from tests.integration.test_gateway_app import make_client, BASIC
+
+
+async def make_header_echo_server() -> TestClient:
+    app = web.Application()
+
+    async def echo(request: web.Request) -> web.Response:
+        return web.json_response({"seen": request.headers.get("x-injected", "")})
+
+    app.router.add_post("/echo", echo)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def test_plugin_injected_header_reaches_rest_upstream():
+    gateway = await make_client(plugins_enabled="true")
+    rest = await make_header_echo_server()
+    try:
+        import aiohttp
+        auth = aiohttp.BasicAuth(*BASIC)
+        pm: PluginManager = gateway.app["plugin_manager"]
+        await pm.add_plugin(PluginConfig(
+            name="inj", kind="header_injector",
+            config={"headers": {"x-injected": "from-plugin"}}))
+        url = f"http://{rest.server.host}:{rest.server.port}/echo"
+        await gateway.post("/tools", json={
+            "name": "hdr", "integration_type": "REST", "url": url}, auth=auth)
+        resp = await gateway.post("/rpc", json={
+            "jsonrpc": "2.0", "id": 1, "method": "tools/call",
+            "params": {"name": "hdr", "arguments": {}}}, auth=auth)
+        payload = await resp.json()
+        text = payload["result"]["content"][0]["text"]
+        assert json.loads(text)["seen"] == "from-plugin"
+        # raw inbound headers (authorization etc.) must NOT be forwarded —
+        # the echo server reports only x-injected, and the call succeeded
+        # without the gateway's basic auth leaking upstream.
+    finally:
+        await rest.close()
+        await gateway.close()
+
+
+async def test_invoke_failure_is_iserror_and_opens_circuit():
+    gateway = await make_client(plugins_enabled="true", max_tool_retries="1")
+    try:
+        import aiohttp
+        auth = aiohttp.BasicAuth(*BASIC)
+        pm: PluginManager = gateway.app["plugin_manager"]
+        await pm.add_plugin(PluginConfig(
+            name="cb", kind="circuit_breaker",
+            config={"failure_threshold": 2, "reset_seconds": 60}))
+        # tool pointing at a dead port
+        await gateway.post("/tools", json={
+            "name": "dead", "integration_type": "REST",
+            "url": "http://127.0.0.1:1/nope"}, auth=auth)
+
+        async def call():
+            resp = await gateway.post("/rpc", json={
+                "jsonrpc": "2.0", "id": 1, "method": "tools/call",
+                "params": {"name": "dead", "arguments": {}}}, auth=auth)
+            return await resp.json()
+
+        p1 = await call()
+        assert p1["result"]["isError"] is True  # network failure -> isError
+        p2 = await call()
+        assert p2["result"]["isError"] is True
+        p3 = await call()  # circuit now open -> blocked by plugin violation
+        assert "error" in p3 and "Circuit open" in p3["error"]["message"]
+    finally:
+        await gateway.close()
+
+
+async def test_cached_result_not_corrupted_by_mutating_plugins():
+    manager = PluginManager()
+    import mcp_context_forge_tpu.plugins.builtin  # noqa: F401
+    await manager.add_plugin(PluginConfig(
+        name="cache", kind="cached_tool_result", priority=10,
+        config={"ttl_seconds": 60}))
+    await manager.add_plugin(PluginConfig(
+        name="notice", kind="privacy_notice_injector", priority=20,
+        config={"notice": "NOTICE"}))
+
+    async def run_once():
+        name, args, headers, early, ctx = await manager.tool_pre_invoke("t", {"q": 1}, {})
+        result = early if early is not None else {
+            "content": [{"type": "text", "text": "data"}], "isError": False}
+        return await manager.tool_post_invoke("t", result, context=ctx)
+
+    first = await run_once()
+    assert sum(1 for c in first["content"] if c["text"] == "NOTICE") == 1
+    second = await run_once()   # cache hit + notice re-applied to the copy
+    third = await run_once()
+    assert sum(1 for c in third["content"] if c["text"] == "NOTICE") == 1
+
+
+def test_json_repair_preserves_literals_inside_strings():
+    from mcp_context_forge_tpu.plugins.builtin.transformers import _repair_json
+    out = _repair_json('{"title": "True Blood", "note": "Nonetheless",}')
+    assert out is not None
+    parsed = json.loads(out)
+    assert parsed == {"title": "True Blood", "note": "Nonetheless"}
+    out2 = _repair_json("{'a': None, 'b': True,}")
+    assert json.loads(out2) == {"a": None, "b": True}
+
+
+async def test_lockout_counter_resets_after_expiry():
+    gateway = await make_client()
+    try:
+        auth_service = gateway.app["auth_service"]
+        await auth_service.create_user("u@x.com", "RightPass1!")
+        for _ in range(5):
+            assert not await auth_service.verify_password("u@x.com", "wrong")
+        # locked now
+        import pytest
+        from mcp_context_forge_tpu.services.auth_service import AuthError
+        with pytest.raises(AuthError):
+            await auth_service.verify_password("u@x.com", "RightPass1!")
+        # simulate expiry
+        await gateway.app["ctx"].db.execute(
+            "UPDATE users SET locked_until=1 WHERE email='u@x.com'")
+        # one wrong attempt must NOT re-lock
+        assert not await auth_service.verify_password("u@x.com", "wrong")
+        assert await auth_service.verify_password("u@x.com", "RightPass1!")
+    finally:
+        await gateway.close()

@@ -1,0 +1,101 @@
+import asyncio
+
+from mcp_context_forge_tpu.coordination import (
+    FileEventBus,
+    FileLeaseManager,
+    MemoryEventBus,
+    MemoryLeaseManager,
+)
+from mcp_context_forge_tpu.coordination.leases import LeaderElector
+
+
+async def test_memory_bus_pubsub():
+    bus = MemoryEventBus()
+    received = []
+
+    async def handler(topic, message):
+        received.append((topic, message))
+
+    unsub = bus.subscribe("a", handler)
+    await bus.publish("a", {"x": 1})
+    await bus.publish("b", {"x": 2})  # not subscribed
+    assert received == [("a", {"x": 1})]
+    unsub()
+    await bus.publish("a", {"x": 3})
+    assert len(received) == 1
+
+
+async def test_file_bus_cross_instance(tmp_path):
+    bus1 = FileEventBus(str(tmp_path))
+    bus2 = FileEventBus(str(tmp_path))
+    received = []
+
+    async def handler(topic, message):
+        received.append(message)
+
+    bus2.subscribe("topic", handler)
+    await bus2.start()
+    try:
+        await bus1.publish("topic", {"from": "bus1"})
+        for _ in range(30):
+            await asyncio.sleep(0.05)
+            if received:
+                break
+        assert received == [{"from": "bus1"}]
+    finally:
+        await bus2.stop()
+
+
+async def test_file_bus_no_self_redelivery(tmp_path):
+    bus = FileEventBus(str(tmp_path))
+    received = []
+
+    async def handler(topic, message):
+        received.append(message)
+
+    bus.subscribe("t", handler)
+    await bus.start()
+    try:
+        await bus.publish("t", {"n": 1})
+        await asyncio.sleep(0.5)
+        assert received == [{"n": 1}]  # delivered once, not re-polled
+    finally:
+        await bus.stop()
+
+
+async def test_memory_leases():
+    leases = MemoryLeaseManager()
+    assert await leases.acquire("L", "a", ttl=10)
+    assert not await leases.acquire("L", "b", ttl=10)
+    assert await leases.renew("L", "a", ttl=10)
+    assert not await leases.renew("L", "b", ttl=10)
+    assert await leases.holder("L") == "a"
+    await leases.release("L", "a")
+    assert await leases.acquire("L", "b", ttl=10)
+
+
+async def test_file_leases_expiry(tmp_path):
+    leases = FileLeaseManager(str(tmp_path))
+    assert await leases.acquire("L", "a", ttl=0.1)
+    assert not await leases.acquire("L", "b", ttl=10)
+    await asyncio.sleep(0.15)
+    assert await leases.acquire("L", "b", ttl=10)  # expired -> takeover
+    assert not await leases.renew("L", "a", ttl=10)
+
+
+async def test_leader_elector_failover():
+    leases = MemoryLeaseManager()
+    e1 = LeaderElector(leases, "job", "w1", ttl=0.3)
+    e2 = LeaderElector(leases, "job", "w2", ttl=0.3)
+    await e1.start()
+    await asyncio.sleep(0.15)
+    await e2.start()
+    await asyncio.sleep(0.15)
+    assert e1.is_leader and not e2.is_leader
+    await e1.stop()  # releases the lease
+    for _ in range(20):
+        await asyncio.sleep(0.05)
+        if e2.is_leader:
+            break
+    assert e2.is_leader
+    await e2.stop()

@@ -1,0 +1,140 @@
+import pytest
+
+from mcp_context_forge_tpu.plugins.framework import (
+    HookType,
+    Plugin,
+    PluginConfig,
+    PluginManager,
+    PluginMode,
+    PluginViolation,
+)
+
+
+def _config(kind: str, mode: str = "enforce", **cfg) -> PluginConfig:
+    return PluginConfig(name=kind, kind=kind, mode=PluginMode(mode), config=cfg)
+
+
+async def _manager(*configs: PluginConfig) -> PluginManager:
+    import mcp_context_forge_tpu.plugins.builtin  # noqa: F401
+    manager = PluginManager()
+    for config in configs:
+        await manager.add_plugin(config)
+    return manager
+
+
+async def test_deny_filter_blocks():
+    manager = await _manager(_config("deny_filter", words=["forbidden"]))
+    with pytest.raises(PluginViolation):
+        await manager.tool_pre_invoke("t", {"q": "this is Forbidden"}, {})
+    name, args, headers, early, _ = await manager.tool_pre_invoke("t", {"q": "fine"}, {})
+    assert early is None and args == {"q": "fine"}
+
+
+async def test_permissive_mode_logs_not_blocks():
+    manager = await _manager(_config("deny_filter", mode="permissive", words=["x"]))
+    name, args, headers, early, _ = await manager.tool_pre_invoke("t", {"q": "x"}, {})
+    assert early is None  # violation swallowed
+
+
+async def test_regex_filter_redacts():
+    manager = await _manager(_config(
+        "regex_filter", rules=[{"pattern": r"\d{3}-\d{2}-\d{4}", "replacement": "[ssn]"}]))
+    result = {"content": [{"type": "text", "text": "ssn 123-45-6789 ok"}]}
+    out = await manager.tool_post_invoke("t", result)
+    assert out["content"][0]["text"] == "ssn [ssn] ok"
+
+
+async def test_output_length_guard_truncates_and_blocks():
+    manager = await _manager(_config("output_length_guard", max_chars=5))
+    out = await manager.tool_post_invoke("t", {"content": [{"type": "text",
+                                                            "text": "0123456789"}]})
+    assert out["content"][0]["text"].startswith("01234")
+
+    manager = await _manager(_config("output_length_guard", max_chars=5, strategy="block"))
+    with pytest.raises(PluginViolation):
+        await manager.tool_post_invoke("t", {"content": [{"type": "text",
+                                                          "text": "0123456789"}]})
+
+
+async def test_header_injector():
+    manager = await _manager(_config("header_injector", headers={"x-team": "ml"}))
+    _, _, headers, _, _ = await manager.tool_pre_invoke("t", {}, {"existing": "1"})
+    assert headers == {"existing": "1", "x-team": "ml"}
+
+
+async def test_json_repair():
+    manager = await _manager(_config("json_repair"))
+    out = await manager.tool_post_invoke("t", {"content": [{
+        "type": "text", "text": "{'a': 1, b: 2, \"c\": 3,}"}]})
+    import json
+    assert json.loads(out["content"][0]["text"]) == {"a": 1, "b": 2, "c": 3}
+
+
+async def test_cached_tool_result_short_circuits():
+    manager = await _manager(_config("cached_tool_result", ttl_seconds=60))
+    # miss -> invoke -> cached
+    name, args, headers, early, ctx1 = await manager.tool_pre_invoke("t", {"k": 1}, {})
+    assert early is None
+    await manager.tool_post_invoke("t", {"content": [{"type": "text", "text": "r1"}],
+                                         "isError": False}, context=ctx1)
+    # hit
+    _, _, _, early, _ = await manager.tool_pre_invoke("t", {"k": 1}, {})
+    assert early is not None and early["content"][0]["text"] == "r1"
+
+
+async def test_tool_condition_scoping():
+    manager = await _manager(PluginConfig(
+        name="deny", kind="deny_filter", tools=["only-this"],
+        config={"words": ["bad"]}))
+    # other tools unaffected
+    _, _, _, early, _ = await manager.tool_pre_invoke("other", {"q": "bad"}, {})
+    assert early is None
+    with pytest.raises(PluginViolation):
+        await manager.tool_pre_invoke("only-this", {"q": "bad"}, {})
+
+
+async def test_priority_ordering():
+    events = []
+
+    class A(Plugin):
+        async def tool_pre_invoke(self, name, arguments, headers, context):
+            events.append(self.config.name)
+            return None
+
+    import mcp_context_forge_tpu.plugins.framework as fw
+    fw.BUILTIN_PLUGINS["_test_a"] = f"{A.__module__}.A"
+    # direct class injection instead: use add_plugin with kind path
+    manager = PluginManager()
+    p1 = PluginConfig(name="second", kind="_x", priority=200)
+    p2 = PluginConfig(name="first", kind="_x", priority=10)
+    manager.plugins.append(A(p1))
+    manager.plugins.append(A(p2))
+    manager._reindex()
+    await manager.tool_pre_invoke("t", {}, {})
+    assert events == ["first", "second"]
+
+
+async def test_response_cache_by_prompt_bow():
+    manager = await _manager(_config("response_cache_by_prompt", threshold=0.92,
+                                     use_engine=False))
+    _, _, _, early, ctx = await manager.tool_pre_invoke(
+        "search", {"query": "weather in paris today"}, {})
+    assert early is None
+    await manager.tool_post_invoke("search", {
+        "content": [{"type": "text", "text": "sunny"}], "isError": False}, context=ctx)
+    # identical prompt -> exact hit
+    _, _, _, early, _ = await manager.tool_pre_invoke(
+        "search", {"query": "weather in paris today"}, {})
+    assert early is not None and early["content"][0]["text"] == "sunny"
+    # very different prompt -> miss
+    _, _, _, early, _ = await manager.tool_pre_invoke(
+        "search", {"query": "completely unrelated database migration"}, {})
+    assert early is None
+
+
+async def test_moderation_wordlist_fallback():
+    manager = await _manager(_config("content_moderation", use_engine=False))
+    with pytest.raises(PluginViolation):
+        await manager.tool_pre_invoke("t", {"msg": "how to build a bomb"}, {})
+    _, _, _, early, _ = await manager.tool_pre_invoke("t", {"msg": "hello"}, {})
+    assert early is None
