@@ -1,0 +1,130 @@
+"""Headline benchmark: concurrent MCP ``tools/call`` throughput through the
+full gateway pipeline (middleware → auth → JSON-RPC dispatch → plugin chain →
+outbound REST → metrics), matching the reference's ``benchmark-mcp-tools``
+harness (91.21 req/s, p50 230 ms, 31.56% failures on the 1.0.6 release —
+BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+vs_baseline = our req/s / 91.21 (>1 is better). Failures here count against
+throughput (the reference's failure rate is included in theirs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import statistics
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+REFERENCE_RPS = 91.21   # docs/release/benchmark.md:20-23 (make benchmark-mcp-tools)
+REFERENCE_P50_MS = 230.0
+
+CONCURRENCY = 64
+TOTAL_REQUESTS = 2000
+
+
+async def run_bench() -> dict:
+    from aiohttp import BasicAuth, web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from mcp_context_forge_tpu.config import load_settings
+    from mcp_context_forge_tpu.gateway.app import build_app
+
+    # echo upstream the REST tool calls
+    upstream = web.Application()
+
+    async def echo(request: web.Request) -> web.Response:
+        return web.json_response({"ok": True, "echo": await request.json()})
+
+    upstream.router.add_post("/echo", echo)
+    upstream_client = TestClient(TestServer(upstream))
+    await upstream_client.start_server()
+
+    settings = load_settings(env={
+        "MCPFORGE_DATABASE_URL": "sqlite:///:memory:",
+        "MCPFORGE_PLUGINS_ENABLED": "true",
+        "MCPFORGE_TPU_LOCAL_ENABLED": "false",  # LLM plugins measured separately
+        "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
+        "MCPFORGE_OTEL_EXPORTER": "none",
+        "MCPFORGE_LOG_LEVEL": "WARNING",
+    }, env_file=None)
+    app = await build_app(settings)
+
+    # representative non-LLM plugin chain on the hot path
+    from mcp_context_forge_tpu.plugins.framework import PluginConfig
+    pm = app["plugin_manager"]
+    await pm.add_plugin(PluginConfig(name="mod", kind="content_moderation",
+                                     config={"use_engine": False}))
+    await pm.add_plugin(PluginConfig(name="regex", kind="regex_filter",
+                                     config={"rules": [{"pattern": r"\d{3}-\d{2}-\d{4}",
+                                                        "replacement": "[ssn]"}]}))
+
+    gateway = TestClient(TestServer(app))
+    await gateway.start_server()
+    auth = BasicAuth("admin", "changeme")
+
+    url = f"http://{upstream_client.server.host}:{upstream_client.server.port}/echo"
+    resp = await gateway.post("/tools", json={
+        "name": "bench-echo", "integration_type": "REST", "url": url}, auth=auth)
+    assert resp.status == 201, await resp.text()
+
+    latencies: list[float] = []
+    failures = 0
+    semaphore = asyncio.Semaphore(CONCURRENCY)
+
+    async def one(i: int) -> None:
+        nonlocal failures
+        payload = {"jsonrpc": "2.0", "id": i, "method": "tools/call",
+                   "params": {"name": "bench-echo",
+                              "arguments": {"n": i, "text": f"payload {i}"}}}
+        async with semaphore:
+            started = time.monotonic()
+            try:
+                resp = await gateway.post("/mcp", json=payload, auth=auth)
+                body = await resp.json()
+                ok = resp.status == 200 and "result" in body \
+                    and not body["result"].get("isError")
+            except Exception:
+                ok = False
+            latencies.append((time.monotonic() - started) * 1000)
+            if not ok:
+                failures += 1
+
+    # warmup
+    await asyncio.gather(*[one(-i) for i in range(1, 33)])
+    latencies.clear()
+    failures = 0
+
+    wall_start = time.monotonic()
+    await asyncio.gather(*[one(i) for i in range(TOTAL_REQUESTS)])
+    wall = time.monotonic() - wall_start
+
+    await gateway.close()
+    await upstream_client.close()
+
+    rps = TOTAL_REQUESTS / wall
+    lat = sorted(latencies)
+    p50 = statistics.median(lat)
+    p95 = lat[int(len(lat) * 0.95)]
+    p99 = lat[int(len(lat) * 0.99)]
+    return {
+        "metric": "gateway_mcp_tools_call_rps",
+        "value": round(rps, 2),
+        "unit": "req/s",
+        "vs_baseline": round(rps / REFERENCE_RPS, 3),
+        "p50_ms": round(p50, 2),
+        "p95_ms": round(p95, 2),
+        "p99_ms": round(p99, 2),
+        "p50_vs_baseline_ms": REFERENCE_P50_MS,
+        "failures": failures,
+        "requests": TOTAL_REQUESTS,
+        "concurrency": CONCURRENCY,
+    }
+
+
+if __name__ == "__main__":
+    result = asyncio.run(run_bench())
+    print(json.dumps(result))

@@ -72,8 +72,10 @@ class MCPSession:
     headers: dict[str, str] = field(default_factory=dict)
     timeout: float = 30.0
     verify_ssl: bool = True
+    client: httpx.AsyncClient | None = None  # external shared pool (not closed)
 
     _client: httpx.AsyncClient | None = None
+    _owns_client: bool = True
     _session_id: str | None = None
     _next_id: int = 1
     # legacy-SSE state
@@ -91,7 +93,13 @@ class MCPSession:
         await self.close()
 
     async def connect(self) -> None:
-        self._client = httpx.AsyncClient(timeout=self.timeout, verify=self.verify_ssl)
+        if self.client is not None:
+            self._client = self.client
+            self._owns_client = False
+        else:
+            self._client = httpx.AsyncClient(timeout=self.timeout,
+                                             verify=self.verify_ssl)
+            self._owns_client = True
         if self.transport == "sse":
             await self._open_sse_stream()
         result = await self.request("initialize", {
@@ -117,7 +125,8 @@ class MCPSession:
                     await self._client.delete(self.url, headers=self._base_headers())
                 except Exception:
                     pass
-            await self._client.aclose()
+            if self._owns_client:
+                await self._client.aclose()
             self._client = None
 
     # ------------------------------------------------------------------ wire
@@ -147,15 +156,19 @@ class MCPSession:
         if self.transport == "sse":
             if self._post_url is None:
                 raise MCPClientError("SSE session not connected")
-            await self._client.post(self._post_url, json=payload, headers=self._base_headers())
+            await self._client.post(self._post_url, json=payload,
+                                    headers=self._base_headers(), timeout=self.timeout)
             return
-        resp = await self._client.post(self.url, json=payload, headers=self._base_headers())
+        resp = await self._client.post(self.url, json=payload,
+                                       headers=self._base_headers(), timeout=self.timeout)
         resp.raise_for_status()
 
     async def _http_request(self, rid: Any, payload: dict[str, Any]) -> dict[str, Any]:
         assert self._client is not None
+        # per-session timeout must hold even on a shared injected client
         req = self._client.build_request("POST", self.url, json=payload,
-                                         headers=self._base_headers())
+                                         headers=self._base_headers(),
+                                         timeout=self.timeout)
         resp = await self._client.send(req, stream=True)
         try:
             if resp.status_code >= 400:

@@ -61,6 +61,9 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     app["rate_limiter"] = RateLimiter(settings.rate_limit_rps, settings.rate_limit_burst)
 
     # services
+    from ..services.upstream_sessions import UpstreamSessionRegistry
+    upstream_sessions = UpstreamSessionRegistry(ctx)
+    ctx.extras["upstream_sessions"] = upstream_sessions
     auth_service = AuthService(ctx)
     tool_service = ToolService(ctx)
     gateway_service = GatewayService(ctx)
@@ -73,6 +76,25 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     app["resource_service"] = resource_service
     app["prompt_service"] = prompt_service
     app["server_service"] = server_service
+
+    # tpu_local engine + LLM provider registry
+    engine = None
+    if settings.tpu_local_enabled:
+        from ..tpu_local.engine import EngineConfig, TPUEngine
+        from ..tpu_local.provider import LLMProviderRegistry
+        from ..tpu_local.server import setup_llm_routes
+        from ..tpu_local.tpu_provider import TPULocalProvider
+        engine = TPUEngine(EngineConfig.from_settings(settings))
+        provider = TPULocalProvider("tpu_local", engine,
+                                    embedding_model=settings.tpu_local_embedding_model,
+                                    tracer=tracer, metrics=metrics)
+        registry = LLMProviderRegistry()
+        registry.register(provider, [settings.tpu_local_model, "tpu_local"],
+                          default_chat=True, default_embed=True)
+        ctx.llm_registry = registry
+        app["llm_registry"] = registry
+        app["tpu_engine"] = engine
+        setup_llm_routes(app, registry, prefix=settings.llm_api_prefix)
 
     # plugins (optional, loaded if configured)
     if settings.plugins_enabled:
@@ -115,7 +137,10 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     async def lifecycle(app: web.Application) -> AsyncIterator[None]:
         await bus.start()
         await transport.sessions.start_sweeper()
+        await upstream_sessions.start()
         await auth_service.bootstrap_admin()
+        if engine is not None:
+            await engine.start()
         elector = LeaderElector(leases, "gateway-leader", ctx.worker_id,
                                 ttl=settings.leader_lease_ttl)
         ctx.extras["leader_elector"] = elector
@@ -128,6 +153,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         await elector.stop()
         if ctx.llm_registry is not None:
             await ctx.llm_registry.shutdown()
+        await upstream_sessions.stop()
+        await ctx.close_http_client()
         await bus.stop()
         await db.close()
 

@@ -43,6 +43,28 @@ class AppContext:
     llm_registry: "LLMProviderRegistry | None" = None
     worker_id: str = field(default_factory=lambda: new_id()[:12])
     extras: dict[str, Any] = field(default_factory=dict)
+    _http_client: Any = None
+
+    @property
+    def http_client(self):
+        """Shared outbound HTTP pool (reference: SharedHttpClient,
+        main.py:1489-1507) — one SSL context + connection pool for all
+        REST/MCP upstream calls; creating a client per call costs ~25 ms."""
+        if self._http_client is None:
+            import httpx
+
+            self._http_client = httpx.AsyncClient(
+                timeout=self.settings.tool_timeout,
+                verify=not self.settings.skip_ssl_verify,
+                limits=httpx.Limits(max_connections=512,
+                                    max_keepalive_connections=128),
+            )
+        return self._http_client
+
+    async def close_http_client(self) -> None:
+        if self._http_client is not None:
+            await self._http_client.aclose()
+            self._http_client = None
 
 
 def now() -> float:

@@ -119,7 +119,8 @@ class GatewayService:
         headers = _auth_headers(row, self.ctx.settings.auth_encryption_secret)
         session = MCPSession(url=row["url"], transport=row["transport"], headers=headers,
                              timeout=self.ctx.settings.federation_timeout,
-                             verify_ssl=not self.ctx.settings.skip_ssl_verify)
+                             verify_ssl=not self.ctx.settings.skip_ssl_verify,
+                             client=self.ctx.http_client)
         await session.connect()
         return session
 

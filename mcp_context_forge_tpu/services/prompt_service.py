@@ -120,7 +120,8 @@ class PromptService:
             async with MCPSession(url=gateway["url"], transport=gateway["transport"],
                                   headers=headers,
                                   timeout=self.ctx.settings.federation_timeout,
-                                  verify_ssl=not self.ctx.settings.skip_ssl_verify) as session:
+                                  verify_ssl=not self.ctx.settings.skip_ssl_verify,
+                                  client=self.ctx.http_client) as session:
                 return await session.get_prompt(name, arguments)
         args = arguments or {}
         declared = from_json(row["arguments"], [])

@@ -116,7 +116,8 @@ class ResourceService:
             async with MCPSession(url=gateway["url"], transport=gateway["transport"],
                                   headers=headers,
                                   timeout=self.ctx.settings.federation_timeout,
-                                  verify_ssl=not self.ctx.settings.skip_ssl_verify) as session:
+                                  verify_ssl=not self.ctx.settings.skip_ssl_verify,
+                                  client=self.ctx.http_client) as session:
                 return await session.read_resource(uri)
         content = row["content"] or ""
         entry: dict[str, Any] = {"uri": uri, "mimeType": row["mime_type"] or "text/plain"}

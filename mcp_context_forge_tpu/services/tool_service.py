@@ -17,7 +17,7 @@ from typing import Any
 
 import httpx
 
-from ..clients.mcp_client import MCPSession
+from ..clients.mcp_client import MCPClientError, MCPSession
 from ..db.core import from_json, to_json
 from ..jsonrpc import JSONRPCError, INVALID_PARAMS, INTERNAL_ERROR
 from ..schemas import ToolCreate, ToolRead, ToolUpdate
@@ -283,17 +283,15 @@ class ToolService:
             if token in url:
                 url = url.replace(token, str(body_args.pop(key)))
         method = row["request_type"].upper()
-        timeout = self.ctx.settings.tool_timeout
+        client = self.ctx.http_client  # shared pool; never per-call clients
 
         async def _do() -> httpx.Response:
-            async with httpx.AsyncClient(timeout=timeout,
-                                         verify=not self.ctx.settings.skip_ssl_verify) as client:
-                if method in ("GET", "DELETE"):
-                    resp = await client.request(method, url, params=body_args, headers=headers)
-                else:
-                    resp = await client.request(method, url, json=body_args, headers=headers)
-                resp.raise_for_status()
-                return resp
+            if method in ("GET", "DELETE"):
+                resp = await client.request(method, url, params=body_args, headers=headers)
+            else:
+                resp = await client.request(method, url, json=body_args, headers=headers)
+            resp.raise_for_status()
+            return resp
 
         resp = await with_retries(_do, attempts=self.ctx.settings.max_tool_retries,
                                   base=self.ctx.settings.retry_base_delay,
@@ -325,10 +323,23 @@ class ToolService:
                 headers[h] = value
         headers.update(injected_headers or {})
 
+        registry = self.ctx.extras.get("upstream_sessions")
+
         async def _do() -> dict[str, Any]:
+            if registry is not None:
+                key, session = await registry.acquire(url, transport, headers)
+                try:
+                    return await session.call_tool(row["original_name"], arguments)
+                except JSONRPCError:
+                    raise  # application-level error: the session is healthy
+                except (httpx.TransportError, MCPClientError, ConnectionError,
+                        asyncio.TimeoutError, OSError):
+                    await registry.invalidate(key)  # transport broke: reconnect
+                    raise
             async with MCPSession(url=url, transport=transport, headers=headers,
                                   timeout=self.ctx.settings.tool_timeout,
-                                  verify_ssl=not self.ctx.settings.skip_ssl_verify) as session:
+                                  verify_ssl=not self.ctx.settings.skip_ssl_verify,
+                                  client=self.ctx.http_client) as session:
                 return await session.call_tool(row["original_name"], arguments)
 
         return await with_retries(_do, attempts=self.ctx.settings.max_tool_retries,

@@ -1,0 +1,167 @@
+"""Weight checkpointing: Orbax (native) + safetensors (HF Llama) loaders
+with sharded restore onto the mesh (SURVEY.md §5.4 TPU mapping).
+
+``save_params``/``load_params`` round-trip the pure-pytree param format.
+``load_hf_llama`` maps HuggingFace Llama-3 safetensors names onto our tree
+(transposed to our (in, out) matmul convention) shard-by-shard so the full
+fp16 checkpoint never materializes on one host.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .models.configs import LlamaConfig
+
+logger = logging.getLogger(__name__)
+
+
+def save_params(path: str, params: dict[str, Any]) -> None:
+    import orbax.checkpoint as ocp
+
+    checkpointer = ocp.StandardCheckpointer()
+    checkpointer.save(os.path.abspath(path), params, force=True)
+    checkpointer.wait_until_finished()
+
+
+def load_params(path: str, config: LlamaConfig, shardings, dtype) -> dict[str, Any]:
+    """Restore from an Orbax dir or HF safetensors dir, sharded."""
+    if os.path.isdir(path) and any(f.endswith(".safetensors")
+                                   for f in os.listdir(path)):
+        return load_hf_llama(path, config, shardings, dtype)
+    import orbax.checkpoint as ocp
+    from .models.llama import init_params
+
+    abstract = jax.eval_shape(lambda: init_params(config, jax.random.PRNGKey(0),
+                                                  dtype=dtype))
+    abstract = jax.tree.map(
+        lambda leaf, sharding: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                                    sharding=sharding),
+        abstract, shardings)
+    checkpointer = ocp.StandardCheckpointer()
+    return checkpointer.restore(os.path.abspath(path), abstract)
+
+
+def _hf_key_map(config: LlamaConfig) -> dict[str, tuple]:
+    """HF name -> (our path, transpose?)."""
+    mapping: dict[str, tuple] = {
+        "model.embed_tokens.weight": (("embed",), False),
+        "model.norm.weight": (("final_norm",), False),
+        "lm_head.weight": (("lm_head",), True),
+    }
+    for i in range(config.n_layers):
+        prefix = f"model.layers.{i}."
+        mapping.update({
+            prefix + "input_layernorm.weight": (("layers", i, "attn_norm"), False),
+            prefix + "self_attn.q_proj.weight": (("layers", i, "wq"), True),
+            prefix + "self_attn.k_proj.weight": (("layers", i, "wk"), True),
+            prefix + "self_attn.v_proj.weight": (("layers", i, "wv"), True),
+            prefix + "self_attn.o_proj.weight": (("layers", i, "wo"), True),
+            prefix + "post_attention_layernorm.weight": (("layers", i, "ffn_norm"), False),
+            prefix + "mlp.gate_proj.weight": (("layers", i, "w1"), True),
+            prefix + "mlp.up_proj.weight": (("layers", i, "w3"), True),
+            prefix + "mlp.down_proj.weight": (("layers", i, "w2"), True),
+        })
+    return mapping
+
+
+def _set_path(tree: dict, path: tuple, value) -> None:
+    node = tree
+    for part in path[:-1]:
+        node = node[part]
+    node[path[-1]] = value
+
+
+def load_hf_llama(path: str, config: LlamaConfig, shardings, dtype) -> dict[str, Any]:
+    """Load HF Llama-3 *.safetensors into the sharded param tree."""
+    try:
+        from safetensors import safe_open
+    except ImportError:  # fall back to a minimal in-tree reader
+        safe_open = None
+    from .models.llama import init_params
+
+    skeleton = jax.eval_shape(lambda: init_params(config, jax.random.PRNGKey(0),
+                                                  dtype=dtype))
+    params = jax.tree.map(lambda leaf: None, skeleton,
+                          is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+    mapping = _hf_key_map(config)
+    files = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
+    for fname in files:
+        full = os.path.join(path, fname)
+        if safe_open is not None:
+            with safe_open(full, framework="numpy") as reader:
+                for key in reader.keys():
+                    if key not in mapping:
+                        continue
+                    tree_path, transpose = mapping[key]
+                    tensor = reader.get_tensor(key)
+                    _place(params, tree_path, tensor, transpose, shardings, dtype)
+        else:
+            for key, tensor in _read_safetensors(full).items():
+                if key not in mapping:
+                    continue
+                tree_path, transpose = mapping[key]
+                _place(params, tree_path, tensor, transpose, shardings, dtype)
+    missing = [p for p, v in _walk(params) if v is None]
+    if missing:
+        raise ValueError(f"Checkpoint missing tensors for: {missing[:5]}…")
+    return params
+
+
+def _place(params, tree_path, tensor, transpose, shardings, dtype) -> None:
+    array = np.asarray(tensor)
+    if transpose:
+        array = array.T
+    sharding = _get_path(shardings, tree_path)
+    value = jax.device_put(jnp.asarray(array, dtype=dtype), sharding)
+    _set_path(params, tree_path, value)
+
+
+def _get_path(tree, path):
+    node = tree
+    for part in path:
+        node = node[part]
+    return node
+
+
+def _walk(tree, prefix=()):  # yields (path, leaf) incl. None leaves
+    if isinstance(tree, dict):
+        for key, value in tree.items():
+            yield from _walk(value, prefix + (key,))
+    elif isinstance(tree, list):
+        for i, value in enumerate(tree):
+            yield from _walk(value, prefix + (i,))
+    else:
+        yield prefix, tree
+
+
+def _read_safetensors(path: str) -> dict[str, np.ndarray]:
+    """Minimal safetensors reader (header json + raw tensors)."""
+    DTYPES = {"F32": np.float32, "F16": np.float16, "BF16": None, "I32": np.int32,
+              "I64": np.int64, "U8": np.uint8}
+    out: dict[str, np.ndarray] = {}
+    with open(path, "rb") as fh:
+        header_len = int.from_bytes(fh.read(8), "little")
+        header = json.loads(fh.read(header_len))
+        base = 8 + header_len
+        for name, meta in header.items():
+            if name == "__metadata__":
+                continue
+            start, end = meta["data_offsets"]
+            fh.seek(base + start)
+            raw = fh.read(end - start)
+            if meta["dtype"] == "BF16":
+                u16 = np.frombuffer(raw, dtype=np.uint16)
+                u32 = u16.astype(np.uint32) << 16
+                arr = u32.view(np.float32).astype(np.float32)
+            else:
+                arr = np.frombuffer(raw, dtype=DTYPES[meta["dtype"]])
+            out[name] = arr.reshape(meta["shape"])
+    return out

@@ -1,0 +1,359 @@
+"""Continuous-batching inference engine.
+
+The crux component (SURVEY.md §7.2 #1): an asyncio front (request queue,
+tokenizer, per-request token streams) bridged to a device loop that
+interleaves bucketed prefill with fixed-capacity decode steps over the paged
+KV cache. XLA's static-shape discipline is respected everywhere:
+
+- prefill compiles once per (bucket, batch=1) shape from
+  ``tpu_local_prefill_buckets``;
+- decode compiles once for the full [max_batch] slot array — inactive slots
+  ride along masked (position 0 into the trash page);
+- sampling params are per-slot device arrays, so mixed greedy/temperature
+  requests share one compiled step.
+
+The engine is a single-owner of its mesh/slice: gateway workers reach it
+in-process (single worker) or over the /v1 HTTP surface (multi-worker),
+mirroring the reference's session-affinity routing (SURVEY.md §7.1 phase 4).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, AsyncIterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kv import PageAllocator, init_kv_state, kv_logical
+from .models import MODEL_CONFIGS, LlamaConfig
+from .models.llama import decode_step, init_params, params_logical, prefill
+from .parallel import make_mesh, param_specs
+from .sampling import SamplingParams, sample_tokens
+from .tokenizer import load_tokenizer
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class EngineConfig:
+    model: str = "llama3-tiny"
+    checkpoint: str = ""
+    max_batch: int = 8              # decode slots
+    max_seq_len: int = 2048
+    page_size: int = 128
+    num_pages: int = 512
+    prefill_buckets: tuple[int, ...] = (128, 512, 2048)
+    mesh_shape: str = ""
+    dtype: str = "bfloat16"
+    max_queue: int = 1024
+    attn_impl: str = "auto"
+
+    @classmethod
+    def from_settings(cls, settings) -> "EngineConfig":
+        return cls(
+            model=settings.tpu_local_model,
+            checkpoint=settings.tpu_local_checkpoint,
+            max_batch=settings.tpu_local_max_batch,
+            max_seq_len=settings.tpu_local_max_seq_len,
+            page_size=settings.tpu_local_page_size,
+            num_pages=settings.tpu_local_num_pages,
+            prefill_buckets=tuple(settings.tpu_local_prefill_buckets),
+            mesh_shape=settings.tpu_local_mesh_shape,
+            dtype=settings.tpu_local_dtype,
+        )
+
+
+@dataclass
+class GenRequest:
+    request_id: str
+    prompt_ids: list[int]
+    max_tokens: int = 128
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    stop_ids: tuple[int, ...] = ()
+    # unbounded: tokens are ints bounded by max_tokens, and a bounded queue
+    # could drop the end-of-stream sentinel and hang the consumer
+    stream: asyncio.Queue = field(default_factory=asyncio.Queue)
+    created: float = field(default_factory=time.time)
+    # filled by the engine
+    slot: int = -1
+    generated: list[int] = field(default_factory=list)
+    finish_reason: str | None = None
+    prefill_ms: float = 0.0
+    queue_ms: float = 0.0
+
+
+class EngineStats:
+    def __init__(self) -> None:
+        self.requests = 0
+        self.prompt_tokens = 0
+        self.completion_tokens = 0
+        self.decode_steps = 0
+        self.queue_depth = 0
+
+
+class TPUEngine:
+    """Owns params + KV pool on the mesh; runs the scheduler loop."""
+
+    def __init__(self, config: EngineConfig):
+        self.config = config
+        self.model_config: LlamaConfig = MODEL_CONFIGS[config.model]
+        self.tokenizer = load_tokenizer(config.checkpoint,
+                                        vocab_size=self.model_config.vocab_size)
+        self.stats = EngineStats()
+        self._queue: asyncio.Queue[GenRequest] = asyncio.Queue(maxsize=config.max_queue)
+        self._running: dict[int, GenRequest] = {}  # slot -> request
+        self._loop_task: asyncio.Task | None = None
+        self._started = False
+        self._dirty_tables = True
+
+        dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+        self.mesh = make_mesh(config.mesh_shape)
+        logger.info("tpu_local: mesh %s, model %s", self.mesh.shape, config.model)
+
+        # params: load checkpoint or random-init, placed with TP shardings
+        with self.mesh:
+            shardings = param_specs(params_logical(self.model_config), self.mesh)
+            if config.checkpoint:
+                from .checkpoint import load_params
+                self.params = load_params(config.checkpoint, self.model_config,
+                                          shardings, dtype)
+            else:
+                init = jax.jit(partial(init_params, self.model_config, dtype=dtype),
+                               out_shardings=shardings)
+                self.params = init(jax.random.PRNGKey(0))
+
+            max_pages_per_slot = config.max_seq_len // config.page_size
+            from .kv import PagedKVState
+            from .parallel.sharding import kv_pages_sharding, logical_to_sharding
+            pages = kv_pages_sharding(self.mesh, self.model_config.n_kv_heads)
+            kv_shardings = PagedKVState(
+                k_pages=pages, v_pages=pages,
+                block_tables=logical_to_sharding("replicated", self.mesh))
+            kv_init = jax.jit(partial(
+                init_kv_state, self.model_config, config.num_pages, config.page_size,
+                config.max_batch, max_pages_per_slot, dtype=dtype),
+                out_shardings=kv_shardings)
+            self.kv = kv_init()
+
+        self.allocator = PageAllocator(config.num_pages, config.page_size,
+                                       config.max_batch, max_pages_per_slot)
+        self._rng = jax.random.PRNGKey(int(time.time()) & 0x7FFFFFFF)
+
+        # compiled steps
+        self._prefill = jax.jit(partial(prefill, config=self.model_config,
+                                        attn_impl=config.attn_impl),
+                                donate_argnames=("kv",))
+        self._decode = jax.jit(self._decode_and_sample, donate_argnames=("kv",))
+
+    # ------------------------------------------------------------- device fns
+
+    def _decode_and_sample(self, params, kv, tokens, positions, slot_ids,
+                           seq_lens, sampling: SamplingParams, key):
+        logits, kv = decode_step(params, self.model_config, tokens, positions,
+                                 kv, slot_ids, seq_lens)
+        next_tokens = sample_tokens(logits, sampling, key)
+        return next_tokens, kv
+
+    # --------------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        if not self._started:
+            self._started = True
+            self._loop_task = asyncio.create_task(self._scheduler_loop())
+
+    async def stop(self) -> None:
+        self._started = False
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+            try:
+                await self._loop_task
+            except asyncio.CancelledError:
+                pass
+            self._loop_task = None
+
+    # ------------------------------------------------------------- submission
+
+    async def submit(self, request: GenRequest) -> GenRequest:
+        self.stats.requests += 1
+        self.stats.prompt_tokens += len(request.prompt_ids)
+        await self._queue.put(request)
+        self.stats.queue_depth = self._queue.qsize()
+        return request
+
+    async def generate(self, prompt_ids: list[int], **kwargs) -> AsyncIterator[int]:
+        """Submit and yield token ids as they decode."""
+        from ..utils.ids import new_id
+        request = GenRequest(request_id=new_id(), prompt_ids=prompt_ids, **kwargs)
+        await self.submit(request)
+        while True:
+            token = await request.stream.get()
+            if token is None:
+                break
+            yield token
+
+    # ---------------------------------------------------------------- schedule
+
+    def _bucket_for(self, length: int) -> int | None:
+        for bucket in sorted(self.config.prefill_buckets):
+            if length <= bucket:
+                return bucket
+        return None
+
+    async def _scheduler_loop(self) -> None:
+        config = self.config
+        decode_interval = 0.0
+        while True:
+            did_work = False
+            # 1) admit waiting requests while slots + pages are free
+            while (len(self._running) < config.max_batch and not self._queue.empty()):
+                request = self._queue.get_nowait()
+                admitted = await self._admit(request)
+                did_work = did_work or admitted
+                if not admitted:
+                    break
+            # 2) one decode step over the running batch
+            if self._running:
+                await self._decode_step_all()
+                did_work = True
+            self.stats.queue_depth = self._queue.qsize()
+            if not did_work:
+                await asyncio.sleep(0.002)
+            else:
+                await asyncio.sleep(decode_interval)  # yield to the event loop
+
+    async def _admit(self, request: GenRequest) -> bool:
+        """Allocate a slot + pages, run prefill, enqueue first token."""
+        config = self.config
+        n_prompt = len(request.prompt_ids)
+        bucket = self._bucket_for(n_prompt)
+        if bucket is None:
+            request.finish_reason = "length"
+            await request.stream.put(None)
+            return True  # consumed (rejected)
+        free_slots = [s for s in range(config.max_batch) if s not in self._running]
+        if not free_slots:
+            await self._requeue(request)
+            return False
+        total = min(n_prompt + request.max_tokens, config.max_seq_len)
+        slot = free_slots[0]
+        if not self.allocator.allocate_slot(slot, total):
+            await self._requeue(request)
+            return False
+
+        request.slot = slot
+        request.queue_ms = (time.time() - request.created) * 1000
+        self._running[slot] = request
+        self._sync_tables()
+
+        started = time.monotonic()
+        tokens = np.full((1, bucket), self.tokenizer.pad_id, dtype=np.int32)
+        positions = np.full((1, bucket), -1, dtype=np.int32)
+        tokens[0, :n_prompt] = request.prompt_ids
+        positions[0, :n_prompt] = np.arange(n_prompt)
+        logits, self.kv = self._prefill(
+            self.params, tokens=jnp.asarray(tokens), positions=jnp.asarray(positions),
+            kv=self.kv, slot_ids=jnp.array([slot]))
+        # sample the first generated token from the last prompt position
+        last = jax.device_get(logits[0, n_prompt - 1])
+        first_token = self._sample_host(last, request)
+        request.prefill_ms = (time.monotonic() - started) * 1000
+        await self._emit(request, first_token)
+        return True
+
+    async def _requeue(self, request: GenRequest) -> None:
+        # put back at the front is not supported by asyncio.Queue; re-put and
+        # let FIFO order approximate fairness
+        await self._queue.put(request)
+
+    def _sample_host(self, logits: np.ndarray, request: GenRequest) -> int:
+        if request.temperature <= 0:
+            return int(np.argmax(logits))
+        scaled = logits / max(request.temperature, 1e-6)
+        if request.top_k > 0:
+            kth = np.partition(scaled, -request.top_k)[-request.top_k]
+            scaled = np.where(scaled >= kth, scaled, -np.inf)
+        probs = np.exp(scaled - scaled.max())
+        if request.top_p < 1.0:
+            order = np.argsort(probs)[::-1]
+            cum = np.cumsum(probs[order]) / probs.sum()
+            cutoff = np.searchsorted(cum, request.top_p) + 1
+            mask = np.zeros_like(probs, dtype=bool)
+            mask[order[:cutoff]] = True
+            probs = np.where(mask, probs, 0.0)
+        probs = probs / probs.sum()
+        return int(np.random.choice(len(probs), p=probs))
+
+    def _sync_tables(self) -> None:
+        self.kv = self.kv._replace(block_tables=self.allocator.tables())
+
+    async def _emit(self, request: GenRequest, token: int) -> None:
+        request.generated.append(token)
+        self.stats.completion_tokens += 1
+        done = (token == self.tokenizer.eos_id or token in request.stop_ids
+                or len(request.generated) >= request.max_tokens)
+        request.stream.put_nowait(token)
+        if done:
+            if request.finish_reason is None:
+                request.finish_reason = ("stop" if (token == self.tokenizer.eos_id
+                                                    or token in request.stop_ids)
+                                         else "length")
+            await self._finish(request)
+
+    async def _finish(self, request: GenRequest) -> None:
+        self._running.pop(request.slot, None)
+        self.allocator.free_slot(request.slot)
+        self._sync_tables()
+        request.stream.put_nowait(None)
+
+    async def _decode_step_all(self) -> None:
+        """One fixed-shape decode step over every active slot."""
+        config = self.config
+        B = config.max_batch
+        tokens = np.zeros((B,), dtype=np.int32)
+        positions = np.zeros((B,), dtype=np.int32)
+        seq_lens = np.zeros((B,), dtype=np.int32)
+        temperature = np.zeros((B,), dtype=np.float32)
+        top_k = np.zeros((B,), dtype=np.int32)
+        top_p = np.ones((B,), dtype=np.float32)
+        active = list(self._running.items())
+        for slot, request in active:
+            # n_ctx counts every token that exists (prompt + generated); the
+            # last generated token is the incoming input: it sits at 0-based
+            # position n_ctx-1 and is written to the cache this step, after
+            # which the slot's context length is n_ctx.
+            n_ctx = len(request.prompt_ids) + len(request.generated)
+            tokens[slot] = request.generated[-1]
+            positions[slot] = n_ctx - 1
+            seq_lens[slot] = n_ctx
+            temperature[slot] = request.temperature
+            top_k[slot] = request.top_k
+            top_p[slot] = request.top_p
+            if not self.allocator.extend_slot(slot, n_ctx):
+                request.finish_reason = "length"
+        self._sync_tables()
+        sampling = SamplingParams(jnp.asarray(temperature), jnp.asarray(top_k),
+                                  jnp.asarray(top_p))
+        self._rng, key = jax.random.split(self._rng)
+        next_tokens, self.kv = self._decode(
+            self.params, self.kv, jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.arange(B, dtype=jnp.int32), jnp.asarray(seq_lens), sampling, key)
+        self.stats.decode_steps += 1
+        next_host = jax.device_get(next_tokens)
+        for slot, request in active:
+            if request.finish_reason == "length" and request.slot in self._running:
+                await self._finish(request)
+                continue
+            await self._emit(request, int(next_host[slot]))
+
+    # ------------------------------------------------------------ embeddings
+
+    def kv_pages_in_use(self) -> int:
+        return self.allocator.pages_in_use

@@ -1,0 +1,14 @@
+"""Paged KV cache."""
+
+from .paged_cache import (
+    PagedKVState,
+    PageAllocator,
+    init_kv_state,
+    write_prefill_kv,
+    write_decode_kv,
+    gather_kv,
+    kv_logical,
+)
+
+__all__ = ["PagedKVState", "PageAllocator", "init_kv_state", "write_prefill_kv",
+           "write_decode_kv", "gather_kv", "kv_logical"]

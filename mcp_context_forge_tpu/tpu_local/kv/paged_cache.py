@@ -1,0 +1,165 @@
+"""Paged KV cache in HBM.
+
+vLLM-style paging adapted to XLA's static-shape discipline (SURVEY.md §7.2
+hard part #1): a fixed pool of pages [L, num_pages, page_size, KV, hd] lives
+in HBM sharded over the ``model`` axis on the kv-head dim; a block table
+[slots, max_pages_per_slot] maps decode slots to pages. Decode memory scales
+with tokens-in-use, not slots × max-context. All writes are scatters and all
+reads are gathers with static shapes, so one compiled decode program serves
+every step.
+
+Page 0 is reserved as the trash page: masked/padding writes land there.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.configs import LlamaConfig
+
+
+class PagedKVState(NamedTuple):
+    """Device state (a pytree — every field is a jax array)."""
+
+    k_pages: jax.Array      # [L, num_pages, page_size, KV, hd]
+    v_pages: jax.Array      # [L, num_pages, page_size, KV, hd]
+    block_tables: jax.Array  # [slots, max_pages_per_slot] int32 (0 = unassigned)
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pages.shape[2]
+
+    @property
+    def max_context(self) -> int:
+        return self.block_tables.shape[1] * self.page_size
+
+
+def kv_logical() -> PagedKVState:
+    """Logical sharding names for the state tree."""
+    return PagedKVState(k_pages="kv_pages", v_pages="kv_pages",
+                        block_tables="replicated")
+
+
+def init_kv_state(config: LlamaConfig, num_pages: int, page_size: int,
+                  max_slots: int, max_pages_per_slot: int,
+                  dtype: jnp.dtype = jnp.bfloat16) -> PagedKVState:
+    shape = (config.n_layers, num_pages, page_size, config.n_kv_heads,
+             config.head_dim)
+    return PagedKVState(
+        k_pages=jnp.zeros(shape, dtype=dtype),
+        v_pages=jnp.zeros(shape, dtype=dtype),
+        block_tables=jnp.zeros((max_slots, max_pages_per_slot), dtype=jnp.int32),
+    )
+
+
+def write_prefill_kv(kv: PagedKVState, layer: int, k: jax.Array, v: jax.Array,
+                     slot_ids: jax.Array, positions: jax.Array,
+                     valid: jax.Array) -> PagedKVState:
+    """Scatter a [B,S] block of K/V into pages.
+
+    k/v: [B,S,KV,hd]; slot_ids: [B]; positions: [B,S]; valid: [B,S] bool."""
+    B, S = positions.shape
+    page_size = kv.page_size
+    page_slot = positions // page_size                      # [B,S] index into table row
+    offset = positions % page_size                          # [B,S]
+    rows = kv.block_tables[slot_ids]                        # [B, P]
+    pages = jnp.take_along_axis(rows, page_slot, axis=1)    # [B,S]
+    pages = jnp.where(valid, pages, 0)                      # trash page for padding
+    offset = jnp.where(valid, offset, 0)
+    flat_pages = pages.reshape(-1)
+    flat_offset = offset.reshape(-1)
+    k_flat = k.reshape(B * S, *k.shape[2:])
+    v_flat = v.reshape(B * S, *v.shape[2:])
+    k_pages = kv.k_pages.at[layer, flat_pages, flat_offset].set(
+        k_flat, mode="drop")
+    v_pages = kv.v_pages.at[layer, flat_pages, flat_offset].set(
+        v_flat, mode="drop")
+    return kv._replace(k_pages=k_pages, v_pages=v_pages)
+
+
+def write_decode_kv(kv: PagedKVState, layer: int, k: jax.Array, v: jax.Array,
+                    slot_ids: jax.Array, positions: jax.Array) -> PagedKVState:
+    """Scatter one token per slot. k/v: [B,KV,hd]; positions: [B]."""
+    page_size = kv.page_size
+    rows = kv.block_tables[slot_ids]                        # [B,P]
+    pages = jnp.take_along_axis(rows, (positions // page_size)[:, None],
+                                axis=1)[:, 0]               # [B]
+    offset = positions % page_size
+    k_pages = kv.k_pages.at[layer, pages, offset].set(k, mode="drop")
+    v_pages = kv.v_pages.at[layer, pages, offset].set(v, mode="drop")
+    return kv._replace(k_pages=k_pages, v_pages=v_pages)
+
+
+def gather_kv(kv: PagedKVState, layer: int, slot_ids: jax.Array
+              ) -> tuple[jax.Array, jax.Array]:
+    """Materialize each slot's context: -> ([B, C, KV, hd], [B, C, KV, hd])
+    where C = max_pages_per_slot * page_size. (The Pallas paged-attention
+    kernel replaces this gather on TPU for large configs.)"""
+    rows = kv.block_tables[slot_ids]                        # [B,P]
+    k = kv.k_pages[layer][rows]                             # [B,P,page,KV,hd]
+    v = kv.v_pages[layer][rows]
+    B, P, page, KV, hd = k.shape
+    return k.reshape(B, P * page, KV, hd), v.reshape(B, P * page, KV, hd)
+
+
+class PageAllocator:
+    """Host-side page bookkeeping: free list + per-slot assignment.
+
+    Page 0 is reserved (trash). The device block table is refreshed from
+    ``tables()`` whenever assignments change."""
+
+    def __init__(self, num_pages: int, page_size: int, max_slots: int,
+                 max_pages_per_slot: int):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.max_slots = max_slots
+        self.max_pages_per_slot = max_pages_per_slot
+        self._free = list(range(num_pages - 1, 0, -1))  # page 0 reserved
+        self._slots: dict[int, list[int]] = {}
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return (n_tokens + self.page_size - 1) // self.page_size
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        return self.pages_needed(n_tokens) <= len(self._free)
+
+    def allocate_slot(self, slot: int, n_tokens: int) -> bool:
+        """Assign pages for a sequence of n_tokens to ``slot``."""
+        needed = self.pages_needed(n_tokens)
+        if needed > len(self._free) or needed > self.max_pages_per_slot:
+            return False
+        self._slots[slot] = [self._free.pop() for _ in range(needed)]
+        return True
+
+    def extend_slot(self, slot: int, n_tokens: int) -> bool:
+        """Ensure capacity for n_tokens total; grows by whole pages."""
+        pages = self._slots.get(slot, [])
+        needed = self.pages_needed(n_tokens)
+        while len(pages) < needed:
+            if not self._free or len(pages) >= self.max_pages_per_slot:
+                return False
+            pages.append(self._free.pop())
+        self._slots[slot] = pages
+        return True
+
+    def free_slot(self, slot: int) -> None:
+        for page in self._slots.pop(slot, []):
+            self._free.append(page)
+
+    def tables(self) -> "jnp.ndarray":
+        import numpy as np
+        table = np.zeros((self.max_slots, self.max_pages_per_slot), dtype=np.int32)
+        for slot, pages in self._slots.items():
+            table[slot, :len(pages)] = pages
+        return jnp.asarray(table)

@@ -1,0 +1,6 @@
+"""Model zoo: Llama-3-class decoder (chat) + small encoder (embeddings /
+moderation classifier), pure-pytree params for pjit."""
+
+from .configs import LlamaConfig, EncoderConfig, MODEL_CONFIGS, ENCODER_CONFIGS
+
+__all__ = ["LlamaConfig", "EncoderConfig", "MODEL_CONFIGS", "ENCODER_CONFIGS"]

@@ -1,0 +1,67 @@
+"""Model configurations."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    name: str
+    vocab_size: int
+    dim: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    ffn_hidden: int
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+MODEL_CONFIGS: dict[str, LlamaConfig] = {
+    # Llama-3-8B geometry (the BASELINE.json flagship)
+    "llama3-8b": LlamaConfig(
+        name="llama3-8b", vocab_size=128_256, dim=4096, n_layers=32,
+        n_heads=32, n_kv_heads=8, ffn_hidden=14_336, rope_theta=500_000.0,
+        max_seq_len=8192),
+    # ~1B-class for single-chip smoke runs
+    "llama3-1b": LlamaConfig(
+        name="llama3-1b", vocab_size=128_256, dim=2048, n_layers=16,
+        n_heads=32, n_kv_heads=8, ffn_hidden=8192, max_seq_len=8192),
+    # tiny configs for CI / CPU mesh (byte-level tokenizer vocab)
+    "llama3-tiny": LlamaConfig(
+        name="llama3-tiny", vocab_size=512, dim=256, n_layers=4,
+        n_heads=8, n_kv_heads=4, ffn_hidden=688, max_seq_len=2048),
+    "llama3-test": LlamaConfig(
+        name="llama3-test", vocab_size=512, dim=64, n_layers=2,
+        n_heads=4, n_kv_heads=2, ffn_hidden=128, max_seq_len=512),
+}
+
+
+@dataclass(frozen=True)
+class EncoderConfig:
+    name: str
+    vocab_size: int
+    dim: int
+    n_layers: int
+    n_heads: int
+    ffn_hidden: int
+    max_seq_len: int = 512
+    n_classes: int = 2  # moderation head: [safe, harmful]
+    norm_eps: float = 1e-5
+
+
+ENCODER_CONFIGS: dict[str, EncoderConfig] = {
+    # MiniLM-class (the reference BASELINE.json embed model gloss)
+    "encoder-mini": EncoderConfig(
+        name="encoder-mini", vocab_size=30_522, dim=384, n_layers=6,
+        n_heads=12, ffn_hidden=1536),
+    "encoder-tiny": EncoderConfig(
+        name="encoder-tiny", vocab_size=512, dim=128, n_layers=2,
+        n_heads=4, ffn_hidden=256),
+}

@@ -1,0 +1,82 @@
+"""Small bidirectional transformer encoder for embeddings + moderation.
+
+Serves (SURVEY.md north star): ``response_cache_by_prompt`` embeddings, the
+``content_moderation``/``harmful_content_detector`` classifier head, and the
+``/v1/embeddings`` endpoint. MiniLM-class geometry (configs.ENCODER_CONFIGS);
+mean-pooled L2-normalized sentence vectors; a 2-class head on the pooled
+vector for harm scoring.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .configs import EncoderConfig
+
+
+def init_encoder_params(config: EncoderConfig, key: jax.Array,
+                        dtype: jnp.dtype = jnp.float32) -> dict[str, Any]:
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, dtype=jnp.float32)
+                * (1.0 / math.sqrt(fan_in))).astype(dtype)
+
+    keys = jax.random.split(key, config.n_layers + 3)
+    layers = []
+    for i in range(config.n_layers):
+        k = jax.random.split(keys[i], 6)
+        layers.append({
+            "norm1": jnp.ones((config.dim,), dtype=jnp.float32),
+            "wqkv": dense(k[0], (config.dim, 3 * config.dim), config.dim),
+            "wo": dense(k[1], (config.dim, config.dim), config.dim),
+            "norm2": jnp.ones((config.dim,), dtype=jnp.float32),
+            "w1": dense(k[2], (config.dim, config.ffn_hidden), config.dim),
+            "w2": dense(k[3], (config.ffn_hidden, config.dim), config.ffn_hidden),
+        })
+    return {
+        "embed": dense(keys[-3], (config.vocab_size, config.dim), config.dim),
+        "pos_embed": dense(keys[-2], (config.max_seq_len, config.dim), config.dim),
+        "layers": layers,
+        "final_norm": jnp.ones((config.dim,), dtype=jnp.float32),
+        "cls_head": dense(keys[-1], (config.dim, config.n_classes), config.dim),
+    }
+
+
+def _layer_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * weight
+
+
+def encode(params: dict[str, Any], config: EncoderConfig, tokens: jax.Array,
+           mask: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """tokens/mask: [B,S] -> (embeddings [B,D] L2-normalized,
+    class logits [B,n_classes])."""
+    B, S = tokens.shape
+    x = params["embed"][tokens] + params["pos_embed"][:S][None]
+    attn_bias = jnp.where(mask[:, None, None, :], 0.0, -1e30)  # [B,1,1,S]
+    hd = config.dim // config.n_heads
+    for layer in params["layers"]:
+        h = _layer_norm(x, layer["norm1"], config.norm_eps)
+        qkv = h @ layer["wqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, config.n_heads, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, config.n_heads, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, config.n_heads, hd).transpose(0, 2, 1, 3)
+        scores = (q @ k.transpose(0, 1, 3, 2)) / math.sqrt(hd) + attn_bias
+        attn = jax.nn.softmax(scores, axis=-1) @ v               # [B,H,S,hd]
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, S, config.dim)
+        x = x + attn @ layer["wo"]
+        h = _layer_norm(x, layer["norm2"], config.norm_eps)
+        x = x + jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
+    x = _layer_norm(x, params["final_norm"], config.norm_eps)
+    # masked mean pooling
+    weights = mask.astype(jnp.float32)[:, :, None]
+    pooled = jnp.sum(x * weights, axis=1) / jnp.maximum(jnp.sum(weights, axis=1), 1.0)
+    embeddings = pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True),
+                                      1e-9)
+    logits = pooled @ params["cls_head"]
+    return embeddings, logits

@@ -1,0 +1,196 @@
+"""Llama-3-class decoder, TPU-first functional implementation.
+
+Pure pytree params (dict-of-arrays) + jit-compiled prefill/decode functions —
+no module framework on the hot path so pjit sees plain matmuls the MXU can
+tile. GQA attention, RoPE, RMSNorm, SwiGLU. Sharding is 1D megatron TP over
+the ``model`` mesh axis (parallel/sharding.py); the paged KV cache shards the
+kv-head dim so decode attention never crosses chips.
+
+Design notes (BASELINE.json north star):
+- prefill: [B, S] bucketed static shapes; causal attention via the Pallas
+  flash kernel (ops/attention.py) on TPU, jnp reference elsewhere.
+- decode: fixed-capacity [B, 1] step over the paged cache; pages gathered by
+  block table — fixed shapes, no recompilation per step.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .configs import LlamaConfig
+from ..ops.attention import causal_attention
+from ..kv.paged_cache import PagedKVState, write_prefill_kv, write_decode_kv, gather_kv
+
+
+# ------------------------------------------------------------------ building blocks
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    orig_dtype = x.dtype
+    x = x.astype(jnp.float32)
+    normed = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    return (normed * weight).astype(orig_dtype)
+
+
+def rope_freqs(head_dim: int, theta: float) -> jax.Array:
+    return 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """x: [..., seq, heads, head_dim]; positions: [..., seq]."""
+    head_dim = x.shape[-1]
+    freqs = rope_freqs(head_dim, theta)                     # [hd/2]
+    angles = positions[..., :, None].astype(jnp.float32) * freqs  # [..., seq, hd/2]
+    cos = jnp.cos(angles)[..., :, None, :]                  # [..., seq, 1, hd/2]
+    sin = jnp.sin(angles)[..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+# ------------------------------------------------------------------------ params
+
+def init_params(config: LlamaConfig, key: jax.Array,
+                dtype: jnp.dtype = jnp.bfloat16) -> dict[str, Any]:
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, dtype=jnp.float32)
+                * (1.0 / math.sqrt(fan_in))).astype(dtype)
+
+    keys = jax.random.split(key, config.n_layers + 2)
+    hd = config.head_dim
+    layers = []
+    for i in range(config.n_layers):
+        k = jax.random.split(keys[i], 7)
+        layers.append({
+            "attn_norm": jnp.ones((config.dim,), dtype=jnp.float32),
+            "wq": dense(k[0], (config.dim, config.n_heads * hd), config.dim),
+            "wk": dense(k[1], (config.dim, config.n_kv_heads * hd), config.dim),
+            "wv": dense(k[2], (config.dim, config.n_kv_heads * hd), config.dim),
+            "wo": dense(k[3], (config.n_heads * hd, config.dim), config.n_heads * hd),
+            "ffn_norm": jnp.ones((config.dim,), dtype=jnp.float32),
+            "w1": dense(k[4], (config.dim, config.ffn_hidden), config.dim),
+            "w3": dense(k[5], (config.dim, config.ffn_hidden), config.dim),
+            "w2": dense(k[6], (config.ffn_hidden, config.dim), config.ffn_hidden),
+        })
+    return {
+        "embed": dense(keys[-2], (config.vocab_size, config.dim), config.dim),
+        "layers": layers,
+        "final_norm": jnp.ones((config.dim,), dtype=jnp.float32),
+        "lm_head": dense(keys[-1], (config.dim, config.vocab_size), config.dim),
+    }
+
+
+def params_logical(config: LlamaConfig) -> dict[str, Any]:
+    """Logical sharding names matching init_params' tree."""
+    layer = {
+        "attn_norm": "replicated",
+        "wq": "attn_qkv", "wk": "attn_qkv", "wv": "attn_qkv",
+        "wo": "attn_out",
+        "ffn_norm": "replicated",
+        "w1": "ffn_up", "w3": "ffn_up", "w2": "ffn_down",
+    }
+    return {
+        "embed": "vocab_in",
+        "layers": [dict(layer) for _ in range(config.n_layers)],
+        "final_norm": "replicated",
+        "lm_head": "vocab_out",
+    }
+
+
+def param_count(config: LlamaConfig) -> int:
+    hd = config.head_dim
+    per_layer = (config.dim * (config.n_heads + 2 * config.n_kv_heads) * hd
+                 + config.n_heads * hd * config.dim
+                 + 3 * config.dim * config.ffn_hidden + 2 * config.dim)
+    return (config.vocab_size * config.dim * 2 + config.dim
+            + config.n_layers * per_layer)
+
+
+# ----------------------------------------------------------------------- forward
+
+def _attention_block(layer: dict[str, Any], config: LlamaConfig, x: jax.Array,
+                     positions: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Project to q,k,v with RoPE. x: [B,S,D] -> q [B,S,H,hd], k/v [B,S,KV,hd]."""
+    B, S, _ = x.shape
+    hd = config.head_dim
+    q = (x @ layer["wq"]).reshape(B, S, config.n_heads, hd)
+    k = (x @ layer["wk"]).reshape(B, S, config.n_kv_heads, hd)
+    v = (x @ layer["wv"]).reshape(B, S, config.n_kv_heads, hd)
+    q = apply_rope(q, positions, config.rope_theta)
+    k = apply_rope(k, positions, config.rope_theta)
+    return q, k, v
+
+
+def _ffn(layer: dict[str, Any], x: jax.Array) -> jax.Array:
+    return (jax.nn.silu(x @ layer["w1"]) * (x @ layer["w3"])) @ layer["w2"]
+
+
+def prefill(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
+            positions: jax.Array, kv: PagedKVState, slot_ids: jax.Array,
+            attn_impl: str = "auto") -> tuple[jax.Array, PagedKVState]:
+    """Full-sequence forward writing KV into the paged cache.
+
+    tokens/positions: [B, S]; slot_ids: [B] row into the block table.
+    Returns (logits [B, S, vocab] fp32, updated kv state).
+    """
+    x = params["embed"][tokens]  # [B,S,D]
+    mask_valid = positions >= 0  # padding has position -1
+    safe_positions = jnp.maximum(positions, 0)
+    for idx, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q, k, v = _attention_block(layer, config, h, safe_positions)
+        kv = write_prefill_kv(kv, idx, k, v, slot_ids, safe_positions, mask_valid)
+        attn = causal_attention(q, k, v, mask_valid, impl=attn_impl)  # [B,S,H,hd]
+        x = x + attn.reshape(*attn.shape[:2], -1) @ layer["wo"]
+        h = rms_norm(x, layer["ffn_norm"], config.norm_eps)
+        x = x + _ffn(layer, h)
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, kv
+
+
+def decode_step(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
+                positions: jax.Array, kv: PagedKVState, slot_ids: jax.Array,
+                seq_lens: jax.Array) -> tuple[jax.Array, PagedKVState]:
+    """One decode step over the paged cache.
+
+    tokens: [B] this step's input token per slot; positions: [B];
+    slot_ids: [B] block-table rows; seq_lens: [B] tokens already in cache
+    (including this one after write). Returns (logits [B, vocab], kv).
+    """
+    B = tokens.shape[0]
+    x = params["embed"][tokens][:, None, :]  # [B,1,D]
+    pos = positions[:, None]                 # [B,1]
+    for idx, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q, k, v = _attention_block(layer, config, h, pos)
+        kv = write_decode_kv(kv, idx, k[:, 0], v[:, 0], slot_ids, positions)
+        keys, values = gather_kv(kv, idx, slot_ids)  # [B, C, KV, hd]
+        attn = _paged_decode_attention(q[:, 0], keys, values, seq_lens, config)
+        x = x + (attn.reshape(B, 1, -1) @ layer["wo"])
+        h = rms_norm(x, layer["ffn_norm"], config.norm_eps)
+        x = x + _ffn(layer, h)
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    return logits, kv
+
+
+def _paged_decode_attention(q: jax.Array, keys: jax.Array, values: jax.Array,
+                            seq_lens: jax.Array, config: LlamaConfig) -> jax.Array:
+    """q: [B,H,hd]; keys/values: [B,C,KV,hd]; seq_lens: [B] -> [B,1,H,hd]."""
+    B, H, hd = q.shape
+    C = keys.shape[1]
+    group = H // config.n_kv_heads
+    qg = q.reshape(B, config.n_kv_heads, group, hd).astype(jnp.float32)
+    kf = keys.astype(jnp.float32)
+    vf = values.astype(jnp.float32)
+    scores = jnp.einsum("bkgh,bckh->bkgc", qg, kf) / math.sqrt(hd)
+    valid = jnp.arange(C)[None, :] < seq_lens[:, None]        # [B,C]
+    scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgc,bckh->bkgh", probs, vf)
+    return out.reshape(B, 1, H, hd).astype(values.dtype)

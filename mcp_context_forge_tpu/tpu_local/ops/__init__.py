@@ -1,0 +1,5 @@
+"""TPU kernels (Pallas) + reference implementations."""
+
+from .attention import causal_attention, flash_attention_pallas
+
+__all__ = ["causal_attention", "flash_attention_pallas"]

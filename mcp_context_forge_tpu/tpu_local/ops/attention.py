@@ -1,0 +1,143 @@
+"""Causal (flash) attention.
+
+- ``flash_attention_pallas``: blockwise online-softmax kernel for TPU
+  (per /opt/skills/guides/pallas_guide.md patterns): grid over
+  (batch*heads, q blocks), inner fori_loop over k blocks up to the causal
+  frontier, running max/denominator in VMEM scratch. HBM traffic is O(S·d)
+  per block instead of materializing the S×S score matrix.
+- ``causal_attention``: dispatcher — Pallas on TPU, jnp reference otherwise
+  (CPU CI / virtual mesh), identical numerics contract (fp32 accumulation).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+# ------------------------------------------------------------------- reference
+
+def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                        valid: jax.Array | None = None) -> jax.Array:
+    """q: [B,S,H,hd]; k/v: [B,S,KV,hd] (GQA); valid: [B,S] bool. -> [B,S,H,hd]."""
+    B, S, H, hd = q.shape
+    KV = k.shape[2]
+    group = H // KV
+    qf = q.astype(jnp.float32).reshape(B, S, KV, group, hd)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    scores = jnp.einsum("bqkgh,bskh->bkgqs", qf, kf) / math.sqrt(hd)
+    causal = jnp.tril(jnp.ones((S, S), dtype=bool))
+    mask = causal[None, None, None]
+    if valid is not None:
+        mask = mask & valid[:, None, None, None, :]
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgqs,bskh->bqkgh", probs, vf)
+    return out.reshape(B, S, H, hd).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------- pallas
+
+def _flash_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, *, block_q: int,
+                  block_k: int, seq_len: int, head_dim: int):
+    """One (batch*head, q-block) program. Refs:
+    q [block_q, hd]; k/v [S, hd]; valid [1, S]; o [block_q, hd]."""
+    q_block = pl.program_id(1)
+    q_start = q_block * block_q
+
+    q = q_ref[:].astype(jnp.float32) / math.sqrt(head_dim)
+    q_positions = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    num_k_blocks = (q_start + block_q + block_k - 1) // block_k
+
+    def body(kb, carry):
+        acc, row_max, row_sum = carry
+        k_start = kb * block_k
+        k_tile = jax.lax.dynamic_slice_in_dim(k_ref[:], k_start, block_k).astype(jnp.float32)
+        v_tile = jax.lax.dynamic_slice_in_dim(v_ref[:], k_start, block_k).astype(jnp.float32)
+        scores = q @ k_tile.T                                  # [bq, bk]
+        k_positions = k_start + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        mask = (k_positions <= q_positions)
+        valid_tile = jax.lax.dynamic_slice_in_dim(valid_ref[0], k_start, block_k)
+        mask = mask & valid_tile[None, :]
+        scores = jnp.where(mask, scores, NEG_INF)
+        tile_max = jnp.max(scores, axis=1, keepdims=True)
+        new_max = jnp.maximum(row_max, tile_max)
+        correction = jnp.exp(row_max - new_max)
+        probs = jnp.exp(scores - new_max)
+        new_sum = row_sum * correction + jnp.sum(probs, axis=1, keepdims=True)
+        new_acc = acc * correction + probs @ v_tile
+        return new_acc, new_max, new_sum
+
+    acc = jnp.zeros((block_q, head_dim), dtype=jnp.float32)
+    row_max = jnp.full((block_q, 1), NEG_INF, dtype=jnp.float32)
+    row_sum = jnp.zeros((block_q, 1), dtype=jnp.float32)
+    acc, row_max, row_sum = jax.lax.fori_loop(0, num_k_blocks, body,
+                                              (acc, row_max, row_sum))
+    o_ref[:] = (acc / jnp.maximum(row_sum, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k", "interpret"))
+def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
+                           valid: jax.Array, block_q: int = 128,
+                           block_k: int = 128, interpret: bool = False) -> jax.Array:
+    """q/k/v: [B,S,H,hd] (kv already expanded to H heads); valid: [B,S]."""
+    B, S, H, hd = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    assert S % block_q == 0 and S % block_k == 0, "seq must divide blocks"
+    # [B,S,H,hd] -> [B*H, S, hd]
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, S, hd)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * H, S, hd)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * H, S, hd)
+    valid_bh = jnp.repeat(valid, H, axis=0)[:, None, :]  # [B*H, 1, S]
+
+    grid = (B * H, S // block_q)
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, block_q=block_q, block_k=block_k,
+                          seq_len=S, head_dim=hd),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, hd), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, hd), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, S, hd), lambda bh, qb: (bh, 0, 0)),
+            pl.BlockSpec((None, S, hd), lambda bh, qb: (bh, 0, 0)),
+            pl.BlockSpec((None, 1, S), lambda bh, qb: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, hd), lambda bh, qb: (bh, qb, 0)),
+        interpret=interpret,
+    )(qt, kt, vt, valid_bh)
+    return out.reshape(B, H, S, hd).transpose(0, 2, 1, 3)
+
+
+# ------------------------------------------------------------------ dispatcher
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                     valid: jax.Array | None = None,
+                     impl: str = "auto") -> jax.Array:
+    """Dispatch: impl in {auto, pallas, reference}."""
+    B, S, H, hd = q.shape
+    if valid is None:
+        valid = jnp.ones((B, S), dtype=bool)
+    use_pallas = impl == "pallas" or (impl == "auto" and _on_tpu()
+                                      and S % 128 == 0 and hd % 128 == 0)
+    if use_pallas:
+        group = H // k.shape[2]
+        k_full = jnp.repeat(k, group, axis=2)
+        v_full = jnp.repeat(v, group, axis=2)
+        return flash_attention_pallas(q, k_full, v_full, valid)
+    return attention_reference(q, k, v, valid)

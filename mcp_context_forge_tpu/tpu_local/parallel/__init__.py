@@ -1,0 +1,12 @@
+"""Mesh construction + sharding rules + collectives.
+
+The TPU-native communication backend: XLA collectives over ICI/DCN compiled
+through pjit/shard_map on a ``jax.sharding.Mesh`` (SURVEY.md §5.8) — the
+NCCL analog is the XLA runtime itself; this package only designs meshes and
+layouts.
+"""
+
+from .mesh import make_mesh, mesh_shape_from_string
+from .sharding import param_specs, logical_to_sharding
+
+__all__ = ["make_mesh", "mesh_shape_from_string", "param_specs", "logical_to_sharding"]

@@ -1,0 +1,36 @@
+"""Device mesh construction.
+
+Axes (SURVEY.md §2.7):
+- ``data``  — DP replica axis (batch-sharded serving / training batch).
+- ``model`` — TP axis: megatron-style head/FFN sharding, collectives ride ICI.
+- optional ``pipe`` / ``seq`` / ``expert`` axes fold into the same Mesh for
+  PP / sequence(ring) / expert parallelism; a v5e-8 slice typically runs
+  (data=1, model=8).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+def mesh_shape_from_string(spec: str, n_devices: int) -> tuple[int, int]:
+    """'1x8' -> (1, 8); '' -> (1, n_devices)."""
+    if not spec:
+        return (1, n_devices)
+    parts = spec.lower().replace("x", " ").split()
+    if len(parts) != 2:
+        raise ValueError(f"mesh shape spec must be 'DxM', got {spec!r}")
+    data, model = int(parts[0]), int(parts[1])
+    if data * model != n_devices:
+        raise ValueError(f"mesh {data}x{model} != {n_devices} devices")
+    return data, model
+
+
+def make_mesh(shape: str = "", devices: list | None = None,
+              axis_names: tuple[str, str] = ("data", "model")) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    data, model = mesh_shape_from_string(shape, len(devices))
+    arr = np.asarray(devices).reshape(data, model)
+    return Mesh(arr, axis_names)

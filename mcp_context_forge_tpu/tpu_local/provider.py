@@ -143,6 +143,14 @@ class LLMProviderRegistry:
         provider, resolved = self.resolve(model or self.default_embed_model)
         return await provider.embed(texts, model=resolved)
 
+    async def classify(self, texts: list[str]) -> list[float]:
+        """Harm scores via the first provider exposing a classifier head."""
+        for provider in self._providers.values():
+            classify = getattr(provider, "classify", None)
+            if classify is not None:
+                return await classify(texts)
+        raise LLMError("No provider supports classification")
+
     async def shutdown(self) -> None:
         for provider in self._providers.values():
             try:

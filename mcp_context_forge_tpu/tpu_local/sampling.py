@@ -1,0 +1,57 @@
+"""On-device token sampling: greedy / temperature / top-k / top-p.
+
+Fully vectorized over the decode batch with per-slot parameters so one
+compiled function serves heterogeneous requests (SURVEY.md §7.1 phase 3.4).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SamplingParams(NamedTuple):
+    """Per-slot device arrays, all [B]."""
+
+    temperature: jax.Array  # 0 => greedy
+    top_k: jax.Array        # 0 => disabled
+    top_p: jax.Array        # 1.0 => disabled
+
+
+def default_sampling(batch: int) -> SamplingParams:
+    return SamplingParams(
+        temperature=jnp.zeros((batch,), dtype=jnp.float32),
+        top_k=jnp.zeros((batch,), dtype=jnp.int32),
+        top_p=jnp.ones((batch,), dtype=jnp.float32),
+    )
+
+
+def sample_tokens(logits: jax.Array, params: SamplingParams,
+                  key: jax.Array) -> jax.Array:
+    """logits: [B, V] fp32 -> token ids [B]."""
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # top-k: mask everything below the k-th logit (k=0 disables)
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]                # [B,V]
+    k = jnp.clip(params.top_k, 0, V)
+    kth_index = jnp.where(k > 0, k - 1, V - 1)
+    kth_value = jnp.take_along_axis(sorted_desc, kth_index[:, None], axis=1)
+    topk_mask = jnp.where((k > 0)[:, None], scaled >= kth_value, True)
+
+    # top-p (nucleus): smallest set with cumulative prob >= p
+    probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
+    cumulative = jnp.cumsum(probs_sorted, axis=-1)
+    cutoff_count = jnp.sum(cumulative < params.top_p[:, None], axis=-1) + 1  # [B]
+    cutoff_index = jnp.clip(cutoff_count - 1, 0, V - 1)
+    cutoff_value = jnp.take_along_axis(sorted_desc, cutoff_index[:, None], axis=1)
+    topp_mask = scaled >= cutoff_value
+
+    masked = jnp.where(topk_mask & topp_mask, scaled, -jnp.inf)
+    sampled = jax.random.categorical(key, masked, axis=-1)
+    return jnp.where(params.temperature <= 0.0, greedy, sampled)

@@ -1,0 +1,108 @@
+"""OpenAI-compatible /v1 surface bound to the gateway app.
+
+Reference: `routers/llm_proxy_router.py:44` (`POST /v1/chat/completions`,
+`/v1/models`) — same wire shapes, served by the in-tree engine instead of
+proxying outbound (chat may still route to an external provider when a
+model alias maps to an ``openai_compatible`` provider in the registry).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from aiohttp import web
+
+from .provider import LLMError, LLMProviderRegistry
+
+
+def setup_llm_routes(app: web.Application, registry: LLMProviderRegistry,
+                     prefix: str = "/v1") -> None:
+    routes = web.RouteTableDef()
+
+    @routes.post(f"{prefix}/chat/completions")
+    async def chat_completions(request: web.Request) -> web.StreamResponse:
+        request["auth"].require("llm.chat")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
+        if not isinstance(body.get("messages"), list) or not body["messages"]:
+            return web.json_response(
+                {"error": {"message": "messages must be a non-empty list"}}, status=422)
+        try:
+            if body.get("stream"):
+                resp = web.StreamResponse(headers={
+                    "content-type": "text/event-stream",
+                    "cache-control": "no-store"})
+                await resp.prepare(request)
+                async for chunk in registry.chat_stream(body):
+                    await resp.write(
+                        b"data: " + json.dumps(chunk).encode() + b"\n\n")
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+                return resp
+            result = await registry.chat(body)
+            return web.json_response(result)
+        except LLMError as exc:
+            return web.json_response({"error": {"message": str(exc),
+                                                "type": "invalid_request_error"}},
+                                     status=404)
+
+    @routes.post(f"{prefix}/embeddings")
+    async def embeddings(request: web.Request) -> web.Response:
+        request["auth"].require("llm.chat")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
+        texts = body.get("input", [])
+        if isinstance(texts, str):
+            texts = [texts]
+        if not texts or not all(isinstance(t, str) for t in texts):
+            return web.json_response(
+                {"error": {"message": "input must be a string or list of strings"}},
+                status=422)
+        try:
+            vectors = await registry.embed(texts, model=body.get("model"))
+        except LLMError as exc:
+            return web.json_response({"error": {"message": str(exc)}}, status=404)
+        return web.json_response({
+            "object": "list",
+            "data": [{"object": "embedding", "index": i, "embedding": vec}
+                     for i, vec in enumerate(vectors)],
+            "model": body.get("model") or "tpu_local-encoder",
+            "usage": {"prompt_tokens": sum(len(t.split()) for t in texts),
+                      "total_tokens": sum(len(t.split()) for t in texts)},
+        })
+
+    @routes.get(f"{prefix}/models")
+    async def models(request: web.Request) -> web.Response:
+        return web.json_response({"object": "list", "data": registry.list_models()})
+
+    @routes.post(f"{prefix}/moderations")
+    async def moderations(request: web.Request) -> web.Response:
+        """OpenAI-compatible moderation endpoint backed by the classifier head."""
+        request["auth"].require("llm.chat")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
+        texts = body.get("input", [])
+        if isinstance(texts, str):
+            texts = [texts]
+        try:
+            scores = await registry.classify(texts)
+        except LLMError as exc:
+            return web.json_response({"error": {"message": str(exc)}}, status=404)
+        return web.json_response({
+            "id": "modr-tpu",
+            "model": "tpu_local-moderation",
+            "results": [{
+                "flagged": score >= 0.5,
+                "category_scores": {"harmful": score},
+                "categories": {"harmful": score >= 0.5},
+            } for score in scores],
+        })
+
+    app.add_routes(routes)

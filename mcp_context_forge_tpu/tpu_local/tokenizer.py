@@ -1,0 +1,86 @@
+"""Tokenization.
+
+Two backends behind one interface:
+- ``HFTokenizer``: loads a ``tokenizer.json`` (HuggingFace ``tokenizers``)
+  from the checkpoint dir — the real Llama-3 BPE when weights are provided.
+- ``ByteTokenizer``: dependency-free byte-level fallback (256 bytes +
+  specials) used by the tiny configs and in CI where no vocab can be
+  downloaded (zero-egress environments).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Protocol
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    bos_id: int
+    eos_id: int
+    pad_id: int
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]: ...
+    def decode(self, ids: list[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """ids 0..255 = bytes; 256=bos, 257=eos, 258=pad."""
+
+    def __init__(self, vocab_size: int = 512):
+        self.vocab_size = vocab_size
+        self.bos_id = 256
+        self.eos_id = 257
+        self.pad_id = 258
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = list(text.encode("utf-8", errors="replace"))
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: list[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    def __init__(self, path: str):
+        from tokenizers import Tokenizer as _HF
+        self._tok = _HF.from_file(path)
+        self.vocab_size = self._tok.get_vocab_size()
+        self.bos_id = self._special("<|begin_of_text|>", 128000)
+        self.eos_id = self._special("<|eot_id|>", 128009)
+        self.pad_id = self.eos_id
+
+    def _special(self, token: str, default: int) -> int:
+        tid = self._tok.token_to_id(token)
+        return tid if tid is not None else default
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = self._tok.encode(text, add_special_tokens=False).ids
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+def load_tokenizer(checkpoint_dir: str = "", vocab_size: int = 512) -> Tokenizer:
+    if checkpoint_dir:
+        path = os.path.join(checkpoint_dir, "tokenizer.json")
+        if os.path.exists(path):
+            return HFTokenizer(path)
+    return ByteTokenizer(vocab_size=vocab_size)
+
+
+def render_chat(messages: list[dict], add_generation_prompt: bool = True) -> str:
+    """Llama-3-style chat template (plain-text rendering)."""
+    parts = []
+    for msg in messages:
+        role = msg.get("role", "user")
+        content = msg.get("content", "")
+        if isinstance(content, list):  # OpenAI content-part arrays
+            content = "".join(p.get("text", "") for p in content
+                              if isinstance(p, dict))
+        parts.append(f"<|start_header_id|>{role}<|end_header_id|>\n{content}<|eot_id|>")
+    if add_generation_prompt:
+        parts.append("<|start_header_id|>assistant<|end_header_id|>\n")
+    return "".join(parts)

@@ -1,0 +1,159 @@
+"""The ``tpu_local`` LLM provider: OpenAI wire shapes over the TPUEngine.
+
+This is the component the BASELINE.json north star names: it replaces the
+reference's outbound provider HTTP calls (`/root/reference/mcpgateway/
+services/llm_proxy_service.py:442/:529`) with in-process inference, and adds
+embeddings + harm classification for the LLM-backed plugins.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, AsyncIterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .engine import EngineConfig, GenRequest, TPUEngine
+from .models import ENCODER_CONFIGS
+from .models.encoder import encode as encoder_forward, init_encoder_params
+from .provider import LLMProvider, make_chat_response
+from .tokenizer import load_tokenizer, render_chat
+from ..utils.ids import new_id
+
+
+class TPULocalProvider(LLMProvider):
+    provider_type = "tpu_local"
+
+    def __init__(self, name: str, engine: TPUEngine,
+                 embedding_model: str = "encoder-tiny",
+                 tracer=None, metrics=None):
+        self.name = name
+        self.engine = engine
+        self.tracer = tracer
+        self.metrics = metrics
+        # embeddings / classifier: a small encoder compiled separately
+        self.encoder_config = ENCODER_CONFIGS[embedding_model]
+        self.encoder_params = init_encoder_params(self.encoder_config,
+                                                  jax.random.PRNGKey(7))
+        self.encoder_tokenizer = load_tokenizer(
+            vocab_size=self.encoder_config.vocab_size)
+        self._encode = jax.jit(
+            lambda params, tokens, mask: encoder_forward(
+                params, self.encoder_config, tokens, mask))
+
+    # ------------------------------------------------------------------ chat
+
+    def _prepare(self, request: dict[str, Any]) -> GenRequest:
+        prompt = render_chat(request.get("messages", []))
+        prompt_ids = self.engine.tokenizer.encode(prompt)
+        max_ctx = self.engine.config.max_seq_len
+        max_prompt = max(self.engine.config.prefill_buckets)
+        prompt_ids = prompt_ids[-max_prompt:]
+        max_tokens = min(int(request.get("max_tokens") or 128),
+                         max_ctx - len(prompt_ids))
+        return GenRequest(
+            request_id=new_id(),
+            prompt_ids=prompt_ids,
+            max_tokens=max(1, max_tokens),
+            temperature=float(request.get("temperature") or 0.0),
+            top_k=int(request.get("top_k") or 0),
+            top_p=float(request.get("top_p") or 1.0),
+        )
+
+    async def chat(self, request: dict[str, Any]) -> dict[str, Any]:
+        gen = self._prepare(request)
+        span_ctx = (self.tracer.span("tpu_local.chat", {
+            "gen_ai.system": "tpu_local",
+            "gen_ai.request.model": request.get("model", self.engine.config.model),
+            "gen_ai.usage.prompt_tokens": len(gen.prompt_ids),
+        }) if self.tracer else None)
+        started = time.monotonic()
+        if span_ctx:
+            span_ctx.__enter__()
+        try:
+            await self.engine.submit(gen)
+            tokens: list[int] = []
+            while True:
+                token = await gen.stream.get()
+                if token is None:
+                    break
+                tokens.append(token)
+            text = self.engine.tokenizer.decode(tokens)
+            if self.metrics is not None:
+                model = request.get("model", self.engine.config.model)
+                self.metrics.llm_tokens.labels(model=model, kind="prompt").inc(
+                    len(gen.prompt_ids))
+                self.metrics.llm_tokens.labels(model=model, kind="completion").inc(
+                    len(tokens))
+                self.metrics.llm_requests.labels(model=model, status="ok").inc()
+                self.metrics.llm_kv_pages_in_use.set(self.engine.kv_pages_in_use())
+            return make_chat_response(
+                request.get("model", self.engine.config.model), text,
+                prompt_tokens=len(gen.prompt_ids), completion_tokens=len(tokens),
+                finish_reason=gen.finish_reason or "stop")
+        finally:
+            if span_ctx:
+                span_ctx.__exit__(None, None, None)
+
+    async def chat_stream(self, request: dict[str, Any]) -> AsyncIterator[dict[str, Any]]:
+        gen = self._prepare(request)
+        await self.engine.submit(gen)
+        model = request.get("model", self.engine.config.model)
+        created = int(time.time())
+        chunk_id = f"chatcmpl-{new_id()[:24]}"
+        pending: list[int] = []
+        while True:
+            token = await gen.stream.get()
+            if token is None:
+                break
+            pending.append(token)
+            text = self.engine.tokenizer.decode(pending)
+            if text and not text.endswith("�"):  # flush complete utf-8 runs
+                pending = []
+                yield {
+                    "id": chunk_id, "object": "chat.completion.chunk",
+                    "created": created, "model": model,
+                    "choices": [{"index": 0, "delta": {"content": text},
+                                 "finish_reason": None}],
+                }
+        yield {
+            "id": chunk_id, "object": "chat.completion.chunk", "created": created,
+            "model": model,
+            "choices": [{"index": 0, "delta": {},
+                         "finish_reason": gen.finish_reason or "stop"}],
+        }
+
+    # ------------------------------------------------------------ embeddings
+
+    def _encode_batch(self, texts: list[str]) -> tuple[np.ndarray, np.ndarray]:
+        max_len = self.encoder_config.max_seq_len
+        batch = len(texts)
+        tokens = np.zeros((batch, max_len), dtype=np.int32)
+        mask = np.zeros((batch, max_len), dtype=bool)
+        for i, text in enumerate(texts):
+            ids = self.encoder_tokenizer.encode(text, add_bos=False)[:max_len]
+            tokens[i, :len(ids)] = ids
+            mask[i, :len(ids)] = True
+        embeddings, logits = self._encode(self.encoder_params,
+                                          jnp.asarray(tokens), jnp.asarray(mask))
+        return np.asarray(embeddings), np.asarray(logits)
+
+    async def embed(self, texts: list[str], model: str | None = None) -> list[list[float]]:
+        embeddings, _ = await asyncio.to_thread(self._encode_batch, texts)
+        return [e.tolist() for e in embeddings]
+
+    async def classify(self, texts: list[str]) -> list[float]:
+        """Harm probability per text (moderation plugins)."""
+        _, logits = await asyncio.to_thread(self._encode_batch, texts)
+        probs = np.exp(logits - logits.max(axis=-1, keepdims=True))
+        probs = probs / probs.sum(axis=-1, keepdims=True)
+        return [float(p[1]) for p in probs]
+
+    async def models(self) -> list[str]:
+        return [self.engine.config.model]
+
+    async def shutdown(self) -> None:
+        await self.engine.stop()

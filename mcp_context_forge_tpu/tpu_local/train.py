@@ -1,0 +1,120 @@
+"""Training step (fine-tuning path + the driver's multi-chip dry-run).
+
+The gateway itself serves inference, but the engine's model stack is fully
+differentiable: this module provides next-token cross-entropy loss and an
+optax AdamW step, pjit-sharded DP×TP over the same mesh/sharding rules as
+serving (batch over ``data``, params over ``model``), so checkpoints can be
+fine-tuned in place on the slice that serves them.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .models.configs import LlamaConfig
+from .models.llama import _attention_block, _ffn, rms_norm
+from .ops.attention import causal_attention
+from .parallel.sharding import param_specs
+from .models.llama import params_logical
+
+
+def forward_logits(params: dict[str, Any], config: LlamaConfig,
+                   tokens: jax.Array, attn_impl: str = "reference") -> jax.Array:
+    """Plain forward (no KV cache) for training: tokens [B,S] -> logits fp32."""
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
+    x = params["embed"][tokens]
+    for layer in params["layers"]:
+        h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q, k, v = _attention_block(layer, config, h, positions)
+        attn = causal_attention(q, k, v, impl=attn_impl)
+        x = x + attn.reshape(B, S, -1) @ layer["wo"]
+        h = rms_norm(x, layer["ffn_norm"], config.norm_eps)
+        x = x + _ffn(layer, h)
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def loss_fn(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
+            targets: jax.Array, mask: jax.Array,
+            attn_impl: str = "reference") -> jax.Array:
+    logits = forward_logits(params, config, tokens, attn_impl)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    return -(picked * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def make_optimizer(lr: float = 1e-4, weight_decay: float = 0.01):
+    return optax.adamw(lr, weight_decay=weight_decay)
+
+
+def train_step(state: TrainState, config: LlamaConfig, optimizer,
+               tokens: jax.Array, targets: jax.Array, mask: jax.Array,
+               attn_impl: str = "reference") -> tuple[TrainState, jax.Array]:
+    loss, grads = jax.value_and_grad(loss_fn)(state.params, config, tokens,
+                                              targets, mask, attn_impl)
+    updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params, opt_state, state.step + 1), loss
+
+
+def build_sharded_train_step(mesh: Mesh, config: LlamaConfig, lr: float = 1e-4):
+    """pjit the full train step over the mesh: DP on batch, TP on params.
+
+    Returns (jitted_step, init_state_fn)."""
+    optimizer = make_optimizer(lr)
+    p_shardings = param_specs(params_logical(config), mesh)
+    data_sharding = NamedSharding(mesh, P("data", None))
+    replicated = NamedSharding(mesh, P())
+
+    def init_state(key: jax.Array) -> TrainState:
+        from .models.llama import init_params
+        params = init_params(config, key, dtype=jnp.float32)
+        return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+
+    opt_shardings = None
+
+    def _infer_state_shardings(state_shape) -> TrainState:
+        # params get their TP shardings; optimizer state mirrors param tree
+        # shapes — shard any leaf whose shape matches a param leaf, else
+        # replicate (adamw mu/nu mirror params exactly).
+        flat_params, _ = jax.tree.flatten(p_shardings)
+
+        def match(leaf_shape, candidates):
+            for sharding, pshape in candidates:
+                if leaf_shape == pshape:
+                    return sharding
+            return replicated
+
+        param_leaves = jax.tree.leaves(state_shape.params)
+        candidates = list(zip(flat_params, [l.shape for l in param_leaves]))
+        opt = jax.tree.map(lambda leaf: match(leaf.shape, candidates),
+                           state_shape.opt_state)
+        return TrainState(p_shardings, opt, replicated)
+
+    init_shape = jax.eval_shape(init_state, jax.random.PRNGKey(0))
+    state_shardings = _infer_state_shardings(init_shape)
+
+    jit_init = jax.jit(init_state, out_shardings=state_shardings)
+
+    step_fn = partial(train_step, config=config, optimizer=optimizer,
+                      attn_impl="reference")
+    jit_step = jax.jit(
+        lambda state, tokens, targets, mask: step_fn(
+            state, tokens=tokens, targets=targets, mask=mask),
+        in_shardings=(state_shardings, data_sharding, data_sharding, data_sharding),
+        out_shardings=(state_shardings, replicated),
+        donate_argnums=(0,))
+    return jit_step, jit_init

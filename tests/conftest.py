@@ -30,6 +30,17 @@ os.environ["MCPFORGE_OTEL_EXPORTER"] = "memory"
 
 import pytest
 
+# The axon sitecustomize force-sets jax_platforms="axon,cpu" at interpreter
+# start (overriding the env var), and initializing the axon backend claims
+# the real TPU. Tests must stay on the virtual CPU mesh: re-pin the config
+# before any jax.devices() call initializes backends.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
 
 def pytest_pyfunc_call(pyfuncitem):
     """Execute async test functions with asyncio.run (no plugin needed)."""

@@ -16,6 +16,7 @@ def _settings(**overrides):
     env = {
         "MCPFORGE_DATABASE_URL": "sqlite:///:memory:",
         "MCPFORGE_PLUGINS_ENABLED": "false",
+        "MCPFORGE_TPU_LOCAL_ENABLED": "false",
         "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
         **{f"MCPFORGE_{k.upper()}": str(v) for k, v in overrides.items()},
     }

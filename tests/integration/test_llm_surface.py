@@ -1,0 +1,143 @@
+"""The SURVEY.md §7.3 'aha' slice: gateway + tpu_local engine end-to-end —
+OpenAI-compatible /v1 endpoints and the LLM plugin chain on tools/call."""
+
+import asyncio
+import json
+
+import aiohttp
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from mcp_context_forge_tpu.config import load_settings
+from mcp_context_forge_tpu.gateway.app import build_app
+
+BASIC = aiohttp.BasicAuth("admin", "changeme")
+
+
+async def make_llm_gateway() -> TestClient:
+    settings = load_settings(env={
+        "MCPFORGE_DATABASE_URL": "sqlite:///:memory:",
+        "MCPFORGE_PLUGINS_ENABLED": "true",
+        "MCPFORGE_TPU_LOCAL_ENABLED": "true",
+        "MCPFORGE_TPU_LOCAL_MODEL": "llama3-test",
+        "MCPFORGE_TPU_LOCAL_MAX_BATCH": "4",
+        "MCPFORGE_TPU_LOCAL_MAX_SEQ_LEN": "128",
+        "MCPFORGE_TPU_LOCAL_PAGE_SIZE": "16",
+        "MCPFORGE_TPU_LOCAL_NUM_PAGES": "64",
+        "MCPFORGE_TPU_LOCAL_PREFILL_BUCKETS": "64",
+        "MCPFORGE_TPU_LOCAL_DTYPE": "float32",
+        "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
+    }, env_file=None)
+    app = await build_app(settings)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def test_v1_surface_end_to_end():
+    gateway = await make_llm_gateway()
+    try:
+        # /v1/models
+        resp = await gateway.get("/v1/models", auth=BASIC)
+        models = [m["id"] for m in (await resp.json())["data"]]
+        assert "llama3-test" in models
+
+        # /v1/chat/completions (greedy, non-stream)
+        resp = await gateway.post("/v1/chat/completions", json={
+            "model": "llama3-test",
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 8,
+        }, auth=BASIC)
+        assert resp.status == 200, await resp.text()
+        body = await resp.json()
+        assert body["object"] == "chat.completion"
+        assert body["usage"]["completion_tokens"] >= 1
+        assert body["choices"][0]["finish_reason"] in ("stop", "length")
+
+        # streaming
+        resp = await gateway.post("/v1/chat/completions", json={
+            "model": "llama3-test",
+            "messages": [{"role": "user", "content": "stream me"}],
+            "max_tokens": 8, "stream": True,
+        }, auth=BASIC)
+        assert resp.headers["content-type"].startswith("text/event-stream")
+        raw = await resp.text()
+        frames = [l[6:] for l in raw.splitlines() if l.startswith("data: ")]
+        assert frames[-1] == "[DONE]"
+        chunks = [json.loads(f) for f in frames[:-1]]
+        assert chunks and chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+
+        # /v1/embeddings
+        resp = await gateway.post("/v1/embeddings", json={
+            "input": ["hello world", "bonjour le monde"]}, auth=BASIC)
+        data = (await resp.json())["data"]
+        assert len(data) == 2 and len(data[0]["embedding"]) == 128
+
+        # /v1/moderations (classifier head)
+        resp = await gateway.post("/v1/moderations", json={
+            "input": "just a friendly message"}, auth=BASIC)
+        results = (await resp.json())["results"]
+        assert "flagged" in results[0]
+
+        # validation errors
+        resp = await gateway.post("/v1/chat/completions", json={
+            "messages": []}, auth=BASIC)
+        assert resp.status == 422
+        resp = await gateway.post("/v1/embeddings", json={"input": [1, 2]}, auth=BASIC)
+        assert resp.status == 422
+    finally:
+        await gateway.close()
+
+
+async def test_llm_plugin_chain_on_tool_call():
+    """summarizer + response_cache_by_prompt with the real engine, wrapped
+    around a REST tool call (BASELINE.json configs 1+3)."""
+    gateway = await make_llm_gateway()
+
+    upstream = web.Application()
+    long_text = "the quick brown fox jumps over the lazy dog. " * 120
+
+    async def bigdoc(request: web.Request) -> web.Response:
+        return web.json_response({"doc": long_text})
+
+    upstream.router.add_post("/doc", bigdoc)
+    upstream_client = TestClient(TestServer(upstream))
+    await upstream_client.start_server()
+    try:
+        from mcp_context_forge_tpu.plugins.framework import PluginConfig
+        pm = gateway.app["plugin_manager"]
+        await pm.add_plugin(PluginConfig(
+            name="cache", kind="response_cache_by_prompt", priority=10,
+            config={"use_engine": True, "threshold": 0.95}))
+        await pm.add_plugin(PluginConfig(
+            name="sum", kind="summarizer", priority=50,
+            config={"threshold_chars": 500, "max_tokens": 8}))
+
+        url = f"http://{upstream_client.server.host}:{upstream_client.server.port}/doc"
+        resp = await gateway.post("/tools", json={
+            "name": "bigdoc", "integration_type": "REST", "url": url}, auth=BASIC)
+        assert resp.status == 201
+
+        async def call():
+            resp = await gateway.post("/rpc", json={
+                "jsonrpc": "2.0", "id": 1, "method": "tools/call",
+                "params": {"name": "bigdoc", "arguments": {"q": "fetch"}}},
+                auth=BASIC)
+            return await resp.json()
+
+        out1 = await call()
+        assert "result" in out1, out1
+        text1 = out1["result"]["content"][0]["text"]
+        # summarizer replaced the long payload with a short engine completion
+        assert len(text1) < len(long_text)
+        assert out1["result"].get("_summarized") is True
+
+        out2 = await call()  # embedding-similarity cache hit: same result
+        assert out2["result"]["content"][0]["text"] == text1
+
+        # OTel spans include engine chat spans
+        spans = [s.name for s in gateway.app["ctx"].tracer.finished]
+        assert "tpu_local.chat" in spans and "tool.invoke" in spans
+    finally:
+        await upstream_client.close()
+        await gateway.close()

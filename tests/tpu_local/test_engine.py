@@ -1,0 +1,93 @@
+"""Engine: continuous batching scheduler over the paged cache (CPU)."""
+
+import asyncio
+
+import pytest
+
+from mcp_context_forge_tpu.tpu_local.engine import EngineConfig, GenRequest, TPUEngine
+
+
+@pytest.fixture(scope="module")
+def engine():
+    config = EngineConfig(model="llama3-test", max_batch=4, max_seq_len=128,
+                          page_size=16, num_pages=64, prefill_buckets=(16, 64),
+                          dtype="float32", attn_impl="reference")
+    return TPUEngine(config)
+
+
+async def _run(engine: TPUEngine, coro):
+    await engine.start()
+    try:
+        return await asyncio.wait_for(coro, timeout=300)
+    finally:
+        await engine.stop()
+
+
+def test_greedy_generation_deterministic(engine):
+    async def main():
+        ids = engine.tokenizer.encode("hello world")
+        out1 = [t async for t in engine.generate(ids, max_tokens=8)]
+        out2 = [t async for t in engine.generate(ids, max_tokens=8)]
+        assert len(out1) == 8 or engine.tokenizer.eos_id in out1
+        assert out1 == out2  # greedy => deterministic
+        return out1
+
+    asyncio.run(_run_with(engine, main()))
+
+
+def _run_with(engine, coro):
+    async def wrapper():
+        await engine.start()
+        try:
+            return await asyncio.wait_for(coro, timeout=300)
+        finally:
+            await engine.stop()
+    return wrapper()
+
+
+def test_concurrent_requests_share_batch(engine):
+    async def main():
+        ids1 = engine.tokenizer.encode("alpha")
+        ids2 = engine.tokenizer.encode("bravo charlie")
+        ids3 = engine.tokenizer.encode("delta echo foxtrot golf")
+        steps_before = engine.stats.decode_steps
+
+        async def gen(ids, n):
+            return [t async for t in engine.generate(ids, max_tokens=n)]
+
+        outs = await asyncio.gather(gen(ids1, 6), gen(ids2, 6), gen(ids3, 6))
+        for out in outs:
+            assert 1 <= len(out) <= 6
+        # all pages freed after completion
+        assert engine.allocator.pages_in_use == 0
+        # continuous batching actually batched: strictly fewer decode steps
+        # than a serial run (3 requests × 5 post-prefill tokens = 15)
+        assert engine.stats.decode_steps - steps_before < 15
+
+    asyncio.run(_run_with(engine, main()))
+
+
+def test_oversized_prompt_rejected(engine):
+    async def main():
+        ids = list(range(300))  # > max bucket 64
+        request = GenRequest(request_id="big", prompt_ids=ids, max_tokens=4)
+        await engine.submit(request)
+        token = await asyncio.wait_for(request.stream.get(), timeout=60)
+        assert token is None
+        assert request.finish_reason == "length"
+
+    asyncio.run(_run_with(engine, main()))
+
+
+def test_more_requests_than_slots(engine):
+    async def main():
+        ids = engine.tokenizer.encode("queue pressure")
+
+        async def gen():
+            return [t async for t in engine.generate(ids, max_tokens=4)]
+
+        outs = await asyncio.gather(*[gen() for _ in range(10)])  # > max_batch=4
+        assert all(len(o) >= 1 for o in outs)
+        assert engine.allocator.pages_in_use == 0
+
+    asyncio.run(_run_with(engine, main()))

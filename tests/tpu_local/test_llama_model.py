@@ -1,0 +1,128 @@
+"""Model correctness: prefill/decode over the paged cache must agree with a
+single full-sequence forward (the classic incremental-decoding invariant)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mcp_context_forge_tpu.tpu_local.kv import PageAllocator, init_kv_state
+from mcp_context_forge_tpu.tpu_local.models import MODEL_CONFIGS
+from mcp_context_forge_tpu.tpu_local.models.llama import (
+    decode_step,
+    init_params,
+    param_count,
+    params_logical,
+    prefill,
+)
+
+CFG = MODEL_CONFIGS["llama3-test"]
+
+
+def _setup(batch=2, max_slots=4, num_pages=32, page_size=16, pages_per_slot=8):
+    params = init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+    kv = init_kv_state(CFG, num_pages, page_size, max_slots, pages_per_slot,
+                       dtype=jnp.float32)
+    alloc = PageAllocator(num_pages, page_size, max_slots, pages_per_slot)
+    return params, kv, alloc
+
+
+def test_param_count_matches_tree():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    total = sum(np.prod(x.shape) for x in jax.tree.leaves(params))
+    assert total == param_count(CFG)
+
+
+def test_logical_tree_matches_params():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    logical = params_logical(CFG)
+    assert jax.tree.structure(params) == jax.tree.structure(logical)
+
+
+def test_prefill_then_decode_matches_full_forward():
+    params, kv, alloc = _setup()
+    S, extra = 13, 5
+    key = jax.random.PRNGKey(1)
+    tokens = jax.random.randint(key, (1, S + extra), 0, CFG.vocab_size)
+
+    # ground truth: prefill over the whole sequence, take per-position logits
+    kv_full = init_kv_state(CFG, 32, 16, 4, 8, dtype=jnp.float32)
+    alloc_full = PageAllocator(32, 16, 4, 8)
+    assert alloc_full.allocate_slot(0, S + extra)
+    kv_full = kv_full._replace(block_tables=alloc_full.tables())
+    positions = jnp.arange(S + extra)[None, :]
+    full_logits, _ = prefill(params, CFG, tokens, positions, kv_full,
+                             jnp.array([0]), attn_impl="reference")
+
+    # incremental: prefill first S, then decode the rest one token at a time
+    assert alloc.allocate_slot(0, S + extra)
+    kv = kv._replace(block_tables=alloc.tables())
+    logits, kv = prefill(params, CFG, tokens[:, :S], positions[:, :S], kv,
+                         jnp.array([0]), attn_impl="reference")
+    np.testing.assert_allclose(np.asarray(logits[0, -1]),
+                               np.asarray(full_logits[0, S - 1]),
+                               rtol=2e-4, atol=2e-4)
+    for i in range(extra):
+        pos = S + i
+        step_logits, kv = decode_step(
+            params, CFG, tokens[:, pos], jnp.array([pos]), kv,
+            jnp.array([0]), jnp.array([pos + 1]))
+        np.testing.assert_allclose(np.asarray(step_logits[0]),
+                                   np.asarray(full_logits[0, pos]),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_padding_does_not_leak_between_slots():
+    """Two sequences in one prefill batch with different lengths: the padded
+    tail of the short one must not change its logits."""
+    params, kv, alloc = _setup()
+    t1 = jax.random.randint(jax.random.PRNGKey(2), (1, 8), 0, CFG.vocab_size)
+    # alone
+    assert alloc.allocate_slot(0, 16)
+    kv0 = kv._replace(block_tables=alloc.tables())
+    solo, _ = prefill(params, CFG, t1, jnp.arange(8)[None], kv0,
+                      jnp.array([0]), attn_impl="reference")
+    # batched with a longer sequence, padded to 16 with position -1
+    assert alloc.allocate_slot(1, 16)
+    kv1 = kv._replace(block_tables=alloc.tables())
+    t2 = jax.random.randint(jax.random.PRNGKey(3), (1, 16), 0, CFG.vocab_size)
+    tokens = jnp.concatenate([jnp.pad(t1, ((0, 0), (0, 8))), t2], axis=0)
+    positions = jnp.stack([
+        jnp.concatenate([jnp.arange(8), -jnp.ones(8, dtype=jnp.int32)]),
+        jnp.arange(16),
+    ])
+    batched, _ = prefill(params, CFG, tokens, positions, kv1,
+                         jnp.array([0, 1]), attn_impl="reference")
+    np.testing.assert_allclose(np.asarray(batched[0, 7]), np.asarray(solo[0, 7]),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_attention_matches_reference():
+    from mcp_context_forge_tpu.tpu_local.ops.attention import (
+        attention_reference, flash_attention_pallas)
+    B, S, H, hd = 2, 64, 4, 32
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (B, S, H, hd), dtype=jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, S, H, hd), dtype=jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, S, H, hd), dtype=jnp.float32)
+    valid = jnp.ones((B, S), dtype=bool).at[1, 50:].set(False)
+    ref = attention_reference(q, k, v, valid)
+    out = flash_attention_pallas(q, k, v, valid, block_q=32, block_k=32,
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_page_allocator():
+    alloc = PageAllocator(num_pages=8, page_size=4, max_slots=2, max_pages_per_slot=4)
+    assert alloc.free_pages == 7  # page 0 reserved
+    assert alloc.allocate_slot(0, 10)  # 3 pages
+    assert alloc.pages_in_use == 3
+    assert alloc.extend_slot(0, 13)    # 4 pages
+    assert not alloc.extend_slot(0, 17)  # exceeds max_pages_per_slot
+    assert alloc.allocate_slot(1, 12)  # 3 more
+    assert alloc.free_pages == 0
+    assert not alloc.can_allocate(1)
+    alloc.free_slot(0)
+    assert alloc.free_pages == 4
+    table = np.asarray(alloc.tables())
+    assert table.shape == (2, 4)
+    assert (table[1][:3] > 0).all()
