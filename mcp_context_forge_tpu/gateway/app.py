@@ -61,6 +61,10 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     app["rate_limiter"] = RateLimiter(settings.rate_limit_rps, settings.rate_limit_burst)
 
     # services
+    from ..services.a2a_service import A2AService
+    from ..services.export_service import ExportService
+    from ..services.llm_provider_service import LLMProviderService
+    from ..services.sampling_service import CompletionService, SamplingHandler
     from ..services.upstream_sessions import UpstreamSessionRegistry
     upstream_sessions = UpstreamSessionRegistry(ctx)
     ctx.extras["upstream_sessions"] = upstream_sessions
@@ -70,12 +74,22 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     resource_service = ResourceService(ctx)
     prompt_service = PromptService(ctx)
     server_service = ServerService(ctx)
+    a2a_service = A2AService(ctx)
+    ctx.extras["a2a_service"] = a2a_service
+    export_service = ExportService(ctx)
+    llm_provider_service = LLMProviderService(ctx)
+    ctx.extras["llm_provider_service"] = llm_provider_service
+    completion_service = CompletionService(ctx)
+    sampling_handler = SamplingHandler(ctx)
     app["auth_service"] = auth_service
     app["tool_service"] = tool_service
     app["gateway_service"] = gateway_service
     app["resource_service"] = resource_service
     app["prompt_service"] = prompt_service
     app["server_service"] = server_service
+    app["a2a_service"] = a2a_service
+    app["export_service"] = export_service
+    app["llm_provider_service"] = llm_provider_service
 
     # tpu_local engine + LLM provider registry
     engine = None
@@ -105,7 +119,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
 
     # dispatcher + transports
     dispatcher = RPCDispatcher(ctx, tool_service, resource_service, prompt_service,
-                               server_service)
+                               server_service, completion_service=completion_service,
+                               sampling_handler=sampling_handler)
     app["dispatcher"] = dispatcher
     transport = StreamableHTTPTransport(dispatcher, settings)
     app["streamable_transport"] = transport
@@ -114,6 +129,14 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     app.router.add_delete("/mcp", transport.handle_delete)
     app.router.add_post("/servers/{server_id}/mcp", transport.handle_post)
     app.router.add_get("/servers/{server_id}/mcp", transport.handle_get)
+
+    from .transports.ws_sse import LegacySSETransport, WebSocketTransport
+    ws_transport = WebSocketTransport(dispatcher, settings)
+    sse_transport = LegacySSETransport(dispatcher, settings)
+    app.router.add_get("/ws", ws_transport.handle)
+    app.router.add_get("/servers/{server_id}/ws", ws_transport.handle)
+    app.router.add_get("/sse", sse_transport.handle_stream)
+    app.router.add_post("/messages", sse_transport.handle_message)
 
     async def handle_rpc(request: web.Request) -> web.Response:
         raw = await request.read()
@@ -133,14 +156,21 @@ async def build_app(settings: Settings | None = None) -> web.Application:
 
     app.router.add_post("/rpc", handle_rpc)
     setup_routes(app)
+    from .routers_extra import setup_extra_routes
+    setup_extra_routes(app)
 
     async def lifecycle(app: web.Application) -> AsyncIterator[None]:
         await bus.start()
+        import asyncio as _asyncio
+
+        from ..utils.masking import native_available
+        await _asyncio.to_thread(native_available)  # prebuild off the loop
         await transport.sessions.start_sweeper()
         await upstream_sessions.start()
         await auth_service.bootstrap_admin()
         if engine is not None:
             await engine.start()
+        await llm_provider_service.rewire()  # external providers from DB
         elector = LeaderElector(leases, "gateway-leader", ctx.worker_id,
                                 ttl=settings.leader_lease_ttl)
         ctx.extras["leader_elector"] = elector

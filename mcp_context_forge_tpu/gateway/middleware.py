@@ -152,6 +152,25 @@ async def auth_middleware(request: web.Request, handler: Handler) -> web.StreamR
     return await handler(request)
 
 
+@web.middleware
+async def request_logging_middleware(request: web.Request, handler: Handler
+                                     ) -> web.StreamResponse:
+    """DEBUG-level request/response logging with sensitive-value masking via
+    the native extension (reference: RequestLoggingMiddleware + the Rust
+    masking crate)."""
+    logger = request.app.logger
+    if logger.isEnabledFor(10):  # DEBUG
+        from ..utils.masking import mask_text
+        body = await request.text() if request.can_read_body else ""
+        logger.debug("req %s %s %s", request.method, request.path,
+                     mask_text(body[:4096]) if body else "")
+    response = await handler(request)
+    if logger.isEnabledFor(10):
+        logger.debug("resp %s %s -> %s", request.method, request.path,
+                     response.status)
+    return response
+
+
 # Order matters: observability outermost so error responses still get
 # metrics + correlation ids; error_middleware outside rate-limit/auth so
 # AuthError and friends map to status codes.
@@ -161,4 +180,5 @@ MIDDLEWARES = [
     error_middleware,
     rate_limit_middleware,
     auth_middleware,
+    request_logging_middleware,
 ]

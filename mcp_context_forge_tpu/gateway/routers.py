@@ -267,7 +267,10 @@ def setup_routes(app: web.Application) -> None:
     @routes.post("/prompts/{name}/render")
     async def render_prompt(request: web.Request) -> web.Response:
         request["auth"].require("prompts.read")
-        args = await request.json() if request.can_read_body else {}
+        try:
+            args = await request.json()
+        except Exception:
+            args = {}
         result = await request.app["prompt_service"].render_prompt(
             request.match_info["name"], args)
         return web.json_response(result)

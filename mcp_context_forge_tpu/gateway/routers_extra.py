@@ -1,0 +1,113 @@
+"""Routers: A2A agents, LLM provider admin, export/import.
+
+Reference: `routers/a2a_router` (via main.py /a2a), `routers/llm_admin.py` /
+`llm_config.py`, export/import endpoints (`main.py:3575-3586`).
+"""
+
+from __future__ import annotations
+
+import json
+
+from aiohttp import web
+from pydantic import ValidationError
+
+from ..schemas import A2AAgentCreate
+from ..services.base import ValidationFailure
+
+
+def setup_extra_routes(app: web.Application) -> None:
+    routes = web.RouteTableDef()
+
+    # ------------------------------------------------------------------- A2A
+    @routes.get("/a2a")
+    async def list_agents(request: web.Request) -> web.Response:
+        request["auth"].require("a2a.read")
+        agents = await request.app["a2a_service"].list_agents(
+            request.query.get("include_inactive") == "true")
+        return web.json_response([json.loads(a.model_dump_json()) for a in agents])
+
+    @routes.post("/a2a")
+    async def register_agent(request: web.Request) -> web.Response:
+        request["auth"].require("a2a.create")
+        try:
+            agent = A2AAgentCreate.model_validate(await request.json())
+        except (json.JSONDecodeError, ValidationError) as exc:
+            raise ValidationFailure(str(exc)) from exc
+        created = await request.app["a2a_service"].register_agent(agent)
+        return web.json_response(json.loads(created.model_dump_json()), status=201)
+
+    @routes.delete("/a2a/{agent_id}")
+    async def delete_agent(request: web.Request) -> web.Response:
+        request["auth"].require("a2a.delete")
+        await request.app["a2a_service"].delete_agent(request.match_info["agent_id"])
+        return web.Response(status=204)
+
+    @routes.post("/a2a/{name}/invoke")
+    async def invoke_agent(request: web.Request) -> web.Response:
+        request["auth"].require("a2a.invoke")
+        # NB: can_read_body flips False once middleware has drained the
+        # payload (the bytes stay cached) — parse, don't gate on it
+        try:
+            payload = await request.json()
+        except Exception:
+            payload = {}
+        hop = int(request.headers.get("x-contextforge-uaid-hop", "0"))
+        result = await request.app["a2a_service"].invoke_agent(
+            request.match_info["name"], payload, user=request["auth"].user, hop=hop)
+        return web.json_response(result)
+
+    # ------------------------------------------------------------- LLM admin
+    @routes.get("/llm/providers")
+    async def list_providers(request: web.Request) -> web.Response:
+        request["auth"].require("llm.admin")
+        return web.json_response(await request.app["llm_provider_service"].list_providers())
+
+    @routes.post("/llm/providers")
+    async def create_provider(request: web.Request) -> web.Response:
+        request["auth"].require("llm.admin")
+        body = await request.json()
+        provider = await request.app["llm_provider_service"].create_provider(
+            name=body.get("name", ""), provider_type=body.get("provider_type", ""),
+            api_base=body.get("api_base", ""), config=body.get("config"))
+        return web.json_response(provider, status=201)
+
+    @routes.delete("/llm/providers/{provider_id}")
+    async def delete_provider(request: web.Request) -> web.Response:
+        request["auth"].require("llm.admin")
+        await request.app["llm_provider_service"].delete_provider(
+            request.match_info["provider_id"])
+        return web.Response(status=204)
+
+    @routes.get("/llm/models")
+    async def list_models(request: web.Request) -> web.Response:
+        request["auth"].require("llm.admin")
+        return web.json_response(await request.app["llm_provider_service"].list_models())
+
+    @routes.post("/llm/providers/{provider_id}/models")
+    async def add_model(request: web.Request) -> web.Response:
+        request["auth"].require("llm.admin")
+        body = await request.json()
+        model = await request.app["llm_provider_service"].add_model(
+            request.match_info["provider_id"], model_id=body.get("model_id", ""),
+            alias=body.get("alias", ""),
+            supports_chat=bool(body.get("supports_chat", True)),
+            supports_embeddings=bool(body.get("supports_embeddings", False)))
+        return web.json_response(model, status=201)
+
+    # ---------------------------------------------------------- export/import
+    @routes.get("/export")
+    async def export_config(request: web.Request) -> web.Response:
+        request["auth"].require("export.run")
+        bundle = await request.app["export_service"].export_all(
+            include_secrets=request.query.get("include_secrets") == "true")
+        return web.json_response(bundle)
+
+    @routes.post("/import")
+    async def import_config(request: web.Request) -> web.Response:
+        request["auth"].require("import.run")
+        body = await request.json()
+        summary = await request.app["export_service"].import_all(
+            body, overwrite=request.query.get("overwrite") == "true")
+        return web.json_response(summary)
+
+    app.add_routes(routes)

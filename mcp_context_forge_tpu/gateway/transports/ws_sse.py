@@ -1,0 +1,126 @@
+"""WebSocket + legacy-SSE inbound transports.
+
+Reference: `transports/websocket_transport.py` (JSON-RPC over WS frames) and
+`transports/sse_transport.py` (GET stream + POST /messages back-channel with
+keepalives). Both feed the same RPCDispatcher as /mcp.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any
+
+from aiohttp import WSMsgType, web
+
+from ...jsonrpc import JSONRPCError, RPCRequest
+from ...utils.ids import new_id
+from .streamable_http import SessionManager, _sse_frame
+
+
+class WebSocketTransport:
+    def __init__(self, dispatcher, settings):
+        self.dispatcher = dispatcher
+        self.settings = settings
+
+    async def handle(self, request: web.Request) -> web.WebSocketResponse:
+        ws = web.WebSocketResponse(heartbeat=self.settings.websocket_ping_interval)
+        await ws.prepare(request)
+        auth = request["auth"]
+        headers = {k.lower(): v for k, v in request.headers.items()}
+        server_id = request.match_info.get("server_id")
+        limiter = request.app.get("rate_limiter")
+        client_key = request.remote or "unknown"
+        async for msg in ws:
+            if msg.type != WSMsgType.TEXT:
+                continue
+            # per-message rate limiting: the HTTP middleware only saw the
+            # upgrade request, not the frames
+            if limiter is not None and not limiter.allow(client_key):
+                await ws.send_json({"jsonrpc": "2.0", "id": None,
+                                    "error": {"code": -32000,
+                                              "message": "Rate limit exceeded"}})
+                continue
+            try:
+                payload = json.loads(msg.data)
+            except json.JSONDecodeError:
+                await ws.send_json({"jsonrpc": "2.0", "id": None,
+                                    "error": {"code": -32700, "message": "Parse error"}})
+                continue
+            messages = payload if isinstance(payload, list) else [payload]
+            for message in messages:
+                try:
+                    rpc_request = RPCRequest.parse(message)
+                    response = await self.dispatcher.dispatch(
+                        rpc_request, auth, headers=headers, server_id=server_id)
+                except JSONRPCError as exc:
+                    response = exc.to_dict(
+                        message.get("id") if isinstance(message, dict) else None)
+                if response is not None:
+                    await ws.send_json(response)
+        return ws
+
+
+class LegacySSETransport:
+    """GET /sse opens the stream; first event names the POST back-channel
+    (/messages?session_id=...); responses ride the stream as message events."""
+
+    def __init__(self, dispatcher, settings, session_manager: SessionManager | None = None):
+        self.dispatcher = dispatcher
+        self.settings = settings
+        self.sessions = session_manager or SessionManager(ttl=settings.session_ttl)
+        self._auth: dict[str, Any] = {}
+
+    async def handle_stream(self, request: web.Request) -> web.StreamResponse:
+        session = self.sessions.create()
+        self._auth[session.id] = request["auth"]
+        resp = web.StreamResponse(headers={
+            "content-type": "text/event-stream", "cache-control": "no-store"})
+        await resp.prepare(request)
+        endpoint = f"/messages?session_id={session.id}"
+        await resp.write(f"event: endpoint\ndata: {endpoint}\n\n".encode())
+        keepalive = self.settings.sse_keepalive_interval
+        try:
+            while True:
+                try:
+                    event_id, message = await asyncio.wait_for(session.queue.get(),
+                                                               timeout=keepalive)
+                    await resp.write(_sse_frame(event_id, message))
+                except asyncio.TimeoutError:
+                    await resp.write(b": keepalive\n\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            self._auth.pop(session.id, None)
+            self.sessions.drop(session.id)
+        return resp
+
+    async def handle_message(self, request: web.Request) -> web.Response:
+        session_id = request.query.get("session_id", "")
+        session = self.sessions.get(session_id)
+        if session is None:
+            return web.json_response({"detail": "Unknown session"}, status=404)
+        # dispatch under the POSTER's auth, and only if the poster is the
+        # stream owner — a leaked session_id must not grant the owner's
+        # permissions to someone else
+        auth = request["auth"]
+        owner = self._auth.get(session_id)
+        if owner is not None and owner.user != auth.user:
+            return web.json_response({"detail": "Session belongs to another user"},
+                                     status=403)
+        headers = {k.lower(): v for k, v in request.headers.items()}
+        headers["mcp-session-id"] = session_id
+        try:
+            payload = json.loads(await request.read())
+        except json.JSONDecodeError:
+            return web.json_response({"detail": "Parse error"}, status=400)
+        try:
+            rpc_request = RPCRequest.parse(payload)
+            response = await self.dispatcher.dispatch(rpc_request, auth,
+                                                      headers=headers)
+        except JSONRPCError as exc:
+            response = exc.to_dict(payload.get("id") if isinstance(payload, dict)
+                                   else None)
+        if response is not None:
+            await self.sessions.send_to_session(session_id, response)
+        return web.Response(status=202)

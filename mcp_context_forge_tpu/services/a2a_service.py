@@ -1,0 +1,236 @@
+"""A2A (agent-to-agent) service.
+
+Reference: `/root/reference/mcpgateway/services/a2a_service.py` (3.7k LoC) +
+`a2a_protocol.py`: agent CRUD, invocation over JSON-RPC ``message/send``
+(v0.2.x vs v1 normalization, `a2a_protocol.py:102-271`), OpenAI/Anthropic/
+custom agent types routed to chat providers (`:2138`), agent_pre/post_invoke
+plugin hooks, and UAID cross-gateway routing with hop limits (`:2574`).
+
+TPU-era addition: ``agent_type: tpu_local`` routes straight into the in-tree
+engine — an A2A agent with zero network hops.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import httpx
+
+from ..db.core import from_json, to_json
+from ..schemas import A2AAgentCreate, A2AAgentRead
+from ..utils.crypto import decrypt_field, encrypt_field
+from ..utils.ids import new_id, slugify
+from .base import AppContext, ConflictError, NotFoundError, ValidationFailure, now
+from .tool_service import _auth_headers
+
+MAX_UAID_HOPS = 3
+
+
+def _row_to_read(row: dict[str, Any]) -> A2AAgentRead:
+    return A2AAgentRead(
+        id=row["id"], name=row["name"], slug=row["slug"],
+        description=row["description"], endpoint_url=row["endpoint_url"],
+        agent_type=row["agent_type"], protocol_version=row["protocol_version"],
+        capabilities=from_json(row["capabilities"], {}),
+        enabled=bool(row["enabled"]), reachable=bool(row["reachable"]),
+        tags=from_json(row["tags"], []), team_id=row["team_id"],
+        owner_email=row["owner_email"], visibility=row["visibility"],
+        created_at=row["created_at"], updated_at=row["updated_at"])
+
+
+class A2AService:
+    def __init__(self, ctx: AppContext):
+        self.ctx = ctx
+
+    # ------------------------------------------------------------------ CRUD
+
+    async def register_agent(self, agent: A2AAgentCreate) -> A2AAgentRead:
+        existing = await self.ctx.db.fetchone("SELECT id FROM a2a_agents WHERE name=?",
+                                              (agent.name,))
+        if existing:
+            raise ConflictError(f"Agent {agent.name!r} already exists")
+        aid = new_id()
+        ts = now()
+        auth_value = (encrypt_field(agent.auth_value,
+                                    self.ctx.settings.auth_encryption_secret)
+                      if agent.auth_value else None)
+        await self.ctx.db.execute(
+            "INSERT INTO a2a_agents (id, name, slug, description, endpoint_url,"
+            " agent_type, protocol_version, capabilities, config, auth_type,"
+            " auth_value, enabled, tags, team_id, owner_email, visibility,"
+            " created_at, updated_at) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            (aid, agent.name, slugify(agent.name), agent.description,
+             agent.endpoint_url, agent.agent_type, agent.protocol_version,
+             to_json(agent.capabilities), to_json(agent.config), agent.auth_type,
+             auth_value, int(agent.enabled), to_json(agent.tags), agent.team_id,
+             agent.owner_email, agent.visibility, ts, ts))
+        await self.ctx.bus.publish("a2a.changed", {"action": "register", "id": aid})
+        return await self.get_agent(aid)
+
+    async def get_agent(self, agent_id: str) -> A2AAgentRead:
+        row = await self.ctx.db.fetchone("SELECT * FROM a2a_agents WHERE id=?",
+                                         (agent_id,))
+        if not row:
+            raise NotFoundError(f"Agent {agent_id} not found")
+        return _row_to_read(row)
+
+    async def list_agents(self, include_inactive: bool = False) -> list[A2AAgentRead]:
+        sql = "SELECT * FROM a2a_agents"
+        if not include_inactive:
+            sql += " WHERE enabled=1"
+        return [_row_to_read(r) for r in await self.ctx.db.fetchall(sql + " ORDER BY name")]
+
+    async def delete_agent(self, agent_id: str) -> None:
+        rows = await self.ctx.db.execute("SELECT id FROM a2a_agents WHERE id=?",
+                                         (agent_id,))
+        if not rows:
+            raise NotFoundError(f"Agent {agent_id} not found")
+        await self.ctx.db.execute("DELETE FROM a2a_agents WHERE id=?", (agent_id,))
+        await self.ctx.bus.publish("a2a.changed", {"action": "delete", "id": agent_id})
+
+    async def toggle_agent(self, agent_id: str, enabled: bool) -> A2AAgentRead:
+        await self.ctx.db.execute("UPDATE a2a_agents SET enabled=?, updated_at=?"
+                                  " WHERE id=?", (int(enabled), now(), agent_id))
+        return await self.get_agent(agent_id)
+
+    # ------------------------------------------------------------- invocation
+
+    async def invoke_agent(self, name: str, payload: dict[str, Any],
+                           user: str | None = None, hop: int = 0) -> Any:
+        """Invoke by name or slug; payload normalized per agent type."""
+        row = await self.ctx.db.fetchone(
+            "SELECT * FROM a2a_agents WHERE (name=? OR slug=?) AND enabled=1",
+            (name, name))
+        if not row:
+            raise NotFoundError(f"Agent {name!r} not found")
+        if hop > MAX_UAID_HOPS:
+            raise ValidationFailure(f"UAID hop limit exceeded ({hop})")
+        pm = self.ctx.plugin_manager
+        with self.ctx.tracer.span("a2a.invoke", {"agent.name": name,
+                                                 "agent.type": row["agent_type"]}):
+            if pm is not None:
+                payload = await pm.agent_pre_invoke(name, payload, user=user)
+            agent_type = row["agent_type"]
+            if agent_type == "tpu_local":
+                result = await self._invoke_tpu_local(row, payload)
+            elif agent_type in ("openai", "anthropic"):
+                result = await self._invoke_chat_provider(row, payload, agent_type)
+            elif agent_type in ("jsonrpc", "custom"):
+                result = await self._invoke_jsonrpc(row, payload, hop)
+            else:
+                raise ValidationFailure(f"Unknown agent type {agent_type!r}")
+            if pm is not None:
+                result = await pm.agent_post_invoke(name, result, user=user)
+            await self._record_metric(row["id"], True)
+            return result
+
+    def _extract_messages(self, payload: dict[str, Any]) -> list[dict[str, Any]]:
+        """Normalize A2A payload shapes into chat messages
+        (reference a2a_protocol normalization :102-271)."""
+        if "messages" in payload:
+            return payload["messages"]
+        message = payload.get("message")
+        if isinstance(message, dict):
+            # v1 shape: {role, parts: [{kind: text, text}]}
+            parts = message.get("parts", [])
+            text = " ".join(p.get("text", "") for p in parts
+                            if isinstance(p, dict) and p.get("kind") in ("text", None))
+            return [{"role": message.get("role", "user"), "content": text}]
+        if isinstance(message, str):
+            return [{"role": "user", "content": message}]
+        if "prompt" in payload:
+            return [{"role": "user", "content": str(payload["prompt"])}]
+        return [{"role": "user", "content": json.dumps(payload)}]
+
+    async def _invoke_tpu_local(self, row: dict[str, Any],
+                                payload: dict[str, Any]) -> dict[str, Any]:
+        registry = self.ctx.llm_registry
+        if registry is None:
+            raise ValidationFailure("tpu_local engine is not enabled")
+        config = from_json(row["config"], {})
+        response = await registry.chat({
+            "model": config.get("model"),
+            "messages": self._extract_messages(payload),
+            "max_tokens": config.get("max_tokens", 256),
+            "temperature": payload.get("temperature", config.get("temperature", 0.0)),
+        })
+        return self._as_a2a_reply(response["choices"][0]["message"]["content"])
+
+    async def _invoke_chat_provider(self, row: dict[str, Any], payload: dict[str, Any],
+                                    provider_kind: str) -> dict[str, Any]:
+        """openai/anthropic-typed agents: OpenAI-shape call to endpoint_url
+        (reference a2a_service.py:2138). The in-tree registry handles the
+        anthropic translation when configured as a provider."""
+        config = from_json(row["config"], {})
+        auth = decrypt_field(row["auth_value"],
+                             self.ctx.settings.auth_encryption_secret) or {}
+        headers = {"content-type": "application/json"}
+        api_key = auth.get("api_key") or auth.get("token", "")
+        if provider_kind == "anthropic":
+            if api_key:
+                headers["x-api-key"] = api_key
+            headers["anthropic-version"] = "2023-06-01"
+            messages = self._extract_messages(payload)
+            body = {"model": config.get("model", "claude-3-5-sonnet-latest"),
+                    "max_tokens": config.get("max_tokens", 256),
+                    "messages": messages}
+            resp = await self.ctx.http_client.post(row["endpoint_url"], json=body,
+                                                   headers=headers)
+            resp.raise_for_status()
+            data = resp.json()
+            text = "".join(b.get("text", "") for b in data.get("content", []))
+            return self._as_a2a_reply(text)
+        if api_key:
+            headers["authorization"] = f"Bearer {api_key}"
+        body = {"model": config.get("model", "gpt-4o-mini"),
+                "messages": self._extract_messages(payload),
+                "max_tokens": config.get("max_tokens", 256)}
+        resp = await self.ctx.http_client.post(row["endpoint_url"], json=body,
+                                               headers=headers)
+        resp.raise_for_status()
+        data = resp.json()
+        return self._as_a2a_reply(data["choices"][0]["message"]["content"])
+
+    async def _invoke_jsonrpc(self, row: dict[str, Any], payload: dict[str, Any],
+                              hop: int) -> Any:
+        """JSON-RPC ``message/send`` (A2A protocol) with UAID hop stamping."""
+        headers = {"content-type": "application/json",
+                   "x-contextforge-uaid-hop": str(hop + 1)}
+        headers.update(_auth_headers(row, self.ctx.settings.auth_encryption_secret))
+        message = payload.get("message")
+        if not (isinstance(message, dict) and "parts" in message):
+            # normalize free-form payloads into the v1 message shape
+            if isinstance(message, str):
+                text = message
+            elif message is not None:
+                text = json.dumps(message)
+            else:
+                text = json.dumps(payload)
+            message = {"role": "user",
+                       "parts": [{"kind": "text", "text": text}],
+                       "messageId": new_id()}
+        body = {"jsonrpc": "2.0", "id": new_id()[:8], "method": "message/send",
+                "params": {"message": message}}
+        resp = await self.ctx.http_client.post(row["endpoint_url"], json=body,
+                                               headers=headers,
+                                               timeout=self.ctx.settings.tool_timeout)
+        resp.raise_for_status()
+        data = resp.json()
+        if "error" in data:
+            raise ValidationFailure(f"Agent error: {data['error']}")
+        return data.get("result", data)
+
+    @staticmethod
+    def _as_a2a_reply(text: str) -> dict[str, Any]:
+        return {"message": {"role": "agent",
+                            "parts": [{"kind": "text", "text": text}],
+                            "messageId": new_id()}}
+
+    async def _record_metric(self, agent_id: str, success: bool) -> None:
+        try:
+            await self.ctx.db.execute(
+                "INSERT INTO tool_metrics (tool_id, ts, duration_ms, success)"
+                " VALUES (?,?,?,?)", (f"a2a:{agent_id}", now(), 0.0, int(success)))
+        except Exception:
+            pass

@@ -1,0 +1,86 @@
+"""Config export/import with sealed secrets.
+
+Reference: `services/export_service.py:1-16` / `import_service.py` (AES-256-
+GCM encrypted entity snapshots) + CLI `cli_export_import.py`. The bundle
+carries every registry entity; secret columns stay sealed (they are stored
+encrypted and exported verbatim) unless ``include_secrets`` re-seals them
+under a bundle passphrase.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from ..db.core import from_json
+from ..utils.crypto import decrypt_field, encrypt_field
+from .base import AppContext, now
+
+EXPORT_TABLES = ["gateways", "tools", "resources", "prompts", "servers",
+                 "server_tools", "server_resources", "server_prompts",
+                 "a2a_agents", "llm_providers", "llm_models", "plugin_bindings"]
+
+SECRET_COLUMNS = {"auth_value", "config"}
+
+
+class ExportService:
+    def __init__(self, ctx: AppContext):
+        self.ctx = ctx
+
+    async def export_all(self, include_secrets: bool = False,
+                         passphrase: str | None = None) -> dict[str, Any]:
+        bundle: dict[str, Any] = {
+            "version": 1,
+            "exported_at": time.time(),
+            "source": self.ctx.settings.app_name,
+            "entities": {},
+        }
+        for table in EXPORT_TABLES:
+            rows = await self.ctx.db.fetchall(f"SELECT * FROM {table}")
+            if not include_secrets:
+                for row in rows:
+                    for column in SECRET_COLUMNS & row.keys():
+                        if table == "llm_providers" and column == "config":
+                            row[column] = None
+                        elif column == "auth_value":
+                            row[column] = None
+            elif passphrase:
+                for row in rows:
+                    for column in SECRET_COLUMNS & row.keys():
+                        if row.get(column):
+                            plain = decrypt_field(
+                                row[column], self.ctx.settings.auth_encryption_secret)
+                            row[column] = encrypt_field(plain, passphrase)
+            bundle["entities"][table] = rows
+        return bundle
+
+    async def import_all(self, bundle: dict[str, Any], overwrite: bool = False,
+                         passphrase: str | None = None) -> dict[str, Any]:
+        entities = bundle.get("entities", {})
+        summary: dict[str, int] = {}
+        conflict = "REPLACE" if overwrite else "IGNORE"
+        for table in EXPORT_TABLES:  # insertion order respects FKs
+            rows = entities.get(table, [])
+            count = 0
+            for row in rows:
+                if passphrase:
+                    for column in SECRET_COLUMNS & row.keys():
+                        if row.get(column):
+                            plain = decrypt_field(row[column], passphrase)
+                            row[column] = encrypt_field(
+                                plain, self.ctx.settings.auth_encryption_secret)
+                columns = list(row.keys())
+                marks = ",".join("?" for _ in columns)
+                try:
+                    await self.ctx.db.execute(
+                        f"INSERT OR {conflict} INTO {table} ({','.join(columns)})"
+                        f" VALUES ({marks})", [row[c] for c in columns])
+                    count += 1
+                except Exception:
+                    pass
+            summary[table] = count
+        await self.ctx.bus.publish("tools.changed", {"action": "import"})
+        llm_service = self.ctx.extras.get("llm_provider_service")
+        if llm_service is not None:  # imported providers usable without restart
+            await llm_service.rewire()
+        return {"imported": summary, "overwrite": overwrite}

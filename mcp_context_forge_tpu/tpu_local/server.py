@@ -32,14 +32,22 @@ def setup_llm_routes(app: web.Application, registry: LLMProviderRegistry,
                 {"error": {"message": "messages must be a non-empty list"}}, status=422)
         try:
             if body.get("stream"):
+                registry.resolve(body.get("model"))  # fail before the stream starts
                 resp = web.StreamResponse(headers={
                     "content-type": "text/event-stream",
                     "cache-control": "no-store"})
                 await resp.prepare(request)
-                async for chunk in registry.chat_stream(body):
-                    await resp.write(
-                        b"data: " + json.dumps(chunk).encode() + b"\n\n")
-                await resp.write(b"data: [DONE]\n\n")
+                try:
+                    async for chunk in registry.chat_stream(body):
+                        await resp.write(
+                            b"data: " + json.dumps(chunk).encode() + b"\n\n")
+                    await resp.write(b"data: [DONE]\n\n")
+                except Exception as exc:
+                    # mid-stream failure: error event on the stream — a second
+                    # response cannot be started once prepare() has run
+                    await resp.write(b"data: " + json.dumps(
+                        {"error": {"message": f"{type(exc).__name__}: {exc}"}}
+                    ).encode() + b"\n\n")
                 await resp.write_eof()
                 return resp
             result = await registry.chat(body)

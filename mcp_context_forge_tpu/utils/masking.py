@@ -1,0 +1,129 @@
+"""Sensitive-value masking for request/response logs.
+
+Native C++ fast path (native/masking.cpp via ctypes — the counterpart of the
+reference's Rust extension, crates/request_logging_masking_native_extension)
+with a pure-Python fallback. The shared object is compiled on first use and
+cached next to the source.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import logging
+import os
+import re
+import subprocess
+import threading
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                           "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libmasking.so")
+_CPP_PATH = os.path.join(_NATIVE_DIR, "masking.cpp")
+
+_lib = None
+_lib_lock = threading.Lock()
+_native_failed = False
+
+SENSITIVE_SUBSTRINGS = (
+    "password", "passwd", "secret", "token", "api_key", "apikey",
+    "authorization", "auth", "credential", "private_key", "session_id",
+    "cookie", "x-api-key", "client_secret", "access_key", "bearer",
+)
+
+_sensitive_cache: dict[str, bool] = {}
+
+
+def _build_native() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _CPP_PATH,
+             "-o", _SO_PATH],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception as exc:
+        logger.debug("native masking build failed (%s); using python fallback", exc)
+        return False
+
+
+def _load_native(build: bool = False):
+    """Load the shared object; only compile when ``build`` is set — the hot
+    path (mask_text inside request middleware) must never run g++ on the
+    event loop. native_available() builds; call it from an executor at
+    startup to prewarm."""
+    global _lib, _native_failed
+    if _lib is not None or _native_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _native_failed:
+            return _lib
+        if not os.path.exists(_SO_PATH) or (
+                os.path.exists(_CPP_PATH)
+                and os.path.getmtime(_CPP_PATH) > os.path.getmtime(_SO_PATH)):
+            if not build:
+                return None  # not built yet: caller falls back to python
+            if not os.path.exists(_CPP_PATH) or not _build_native():
+                _native_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+            lib.mask_sensitive.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+            lib.mask_sensitive.restype = ctypes.c_void_p
+            lib.mask_free.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        except OSError as exc:
+            logger.debug("native masking load failed: %s", exc)
+            _native_failed = True
+    return _lib
+
+
+def is_sensitive_key(key: str) -> bool:
+    cached = _sensitive_cache.get(key)
+    if cached is not None:
+        return cached
+    lower = key.lower()
+    sensitive = any(s in lower for s in SENSITIVE_SUBSTRINGS)
+    if len(_sensitive_cache) < 4096:
+        _sensitive_cache[key] = sensitive
+    return sensitive
+
+
+def mask_text(text: str) -> str:
+    """Mask sensitive values in a JSON-ish log payload string."""
+    lib = _load_native()
+    if lib is not None:
+        raw = text.encode("utf-8", errors="replace")
+        ptr = lib.mask_sensitive(raw, len(raw))
+        try:
+            return ctypes.string_at(ptr).decode("utf-8", errors="replace")
+        finally:
+            lib.mask_free(ptr)
+    return _mask_python(text)
+
+
+def mask_obj(obj: Any) -> Any:
+    """Recursively mask a decoded structure (python fallback path)."""
+    if isinstance(obj, dict):
+        return {k: ("***" if is_sensitive_key(str(k)) else mask_obj(v))
+                for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [mask_obj(v) for v in obj]
+    return obj
+
+
+def _mask_python(text: str) -> str:
+    try:
+        return json.dumps(mask_obj(json.loads(text)), separators=(",", ":"))
+    except (json.JSONDecodeError, TypeError):
+        # non-JSON: regex pass over key=value / "key": "value" shapes
+        pattern = re.compile(
+            r'(?i)("?(?:[\w.-]*(?:' + "|".join(SENSITIVE_SUBSTRINGS) +
+            r')[\w.-]*)"?\s*[:=]\s*)("([^"\\]|\\.)*"|[^\s,}\]]+)')
+        return pattern.sub(r'\1"***"', text)
+
+
+def native_available() -> bool:
+    return _load_native(build=True) is not None

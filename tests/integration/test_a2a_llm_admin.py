@@ -1,0 +1,205 @@
+"""A2A agents, LLM provider admin, export/import, WS + legacy-SSE transports,
+sampling + completion."""
+
+import asyncio
+import json
+
+import aiohttp
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from tests.integration.test_gateway_app import BASIC, make_client
+
+AUTH = aiohttp.BasicAuth(*BASIC)
+
+
+async def make_jsonrpc_agent_server() -> TestClient:
+    """A2A echo agent speaking JSON-RPC message/send."""
+    app = web.Application()
+
+    async def rpc(request: web.Request) -> web.Response:
+        body = await request.json()
+        text = body["params"]["message"]["parts"][0]["text"]
+        return web.json_response({
+            "jsonrpc": "2.0", "id": body["id"],
+            "result": {"message": {"role": "agent",
+                                   "parts": [{"kind": "text",
+                                              "text": f"agent-echo: {text}"}]},
+                       "hop": request.headers.get("x-contextforge-uaid-hop")}})
+
+    app.router.add_post("/", rpc)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def test_a2a_agent_lifecycle_and_invoke():
+    gateway = await make_client()
+    agent_server = await make_jsonrpc_agent_server()
+    try:
+        url = f"http://{agent_server.server.host}:{agent_server.server.port}/"
+        resp = await gateway.post("/a2a", json={
+            "name": "echo-agent", "endpoint_url": url, "agent_type": "jsonrpc"},
+            auth=AUTH)
+        assert resp.status == 201, await resp.text()
+        # duplicate
+        resp = await gateway.post("/a2a", json={
+            "name": "echo-agent", "endpoint_url": url}, auth=AUTH)
+        assert resp.status == 409
+
+        resp = await gateway.post("/a2a/echo-agent/invoke", json={
+            "message": "hello agent"}, auth=AUTH)
+        assert resp.status == 200, await resp.text()
+        result = await resp.json()
+        assert result["message"]["parts"][0]["text"] == "agent-echo: hello agent"
+        assert result["hop"] == "1"  # UAID hop stamped
+
+        resp = await gateway.get("/a2a", auth=AUTH)
+        agents = await resp.json()
+        assert [a["name"] for a in agents] == ["echo-agent"]
+    finally:
+        await agent_server.close()
+        await gateway.close()
+
+
+async def test_a2a_tool_integration():
+    """A2A agent surfaced as a tool and invoked via tools/call."""
+    gateway = await make_client()
+    agent_server = await make_jsonrpc_agent_server()
+    try:
+        url = f"http://{agent_server.server.host}:{agent_server.server.port}/"
+        await gateway.post("/a2a", json={
+            "name": "echo-agent", "endpoint_url": url, "agent_type": "jsonrpc"},
+            auth=AUTH)
+        await gateway.post("/tools", json={
+            "name": "agent-tool", "integration_type": "A2A",
+            "annotations": {"a2a_agent": "echo-agent"}}, auth=AUTH)
+        resp = await gateway.post("/rpc", json={
+            "jsonrpc": "2.0", "id": 1, "method": "tools/call",
+            "params": {"name": "agent-tool", "arguments": {"message": "via tool"}}},
+            auth=AUTH)
+        payload = await resp.json()
+        assert "result" in payload, payload
+        text = payload["result"]["content"][0]["text"]
+        assert "agent-echo" in text
+    finally:
+        await agent_server.close()
+        await gateway.close()
+
+
+async def test_llm_provider_admin_crud():
+    gateway = await make_client()
+    try:
+        resp = await gateway.post("/llm/providers", json={
+            "name": "local-ollama", "provider_type": "openai_compatible",
+            "api_base": "http://localhost:11434/v1",
+            "config": {"api_key": "sk-secret"}}, auth=AUTH)
+        assert resp.status == 201, await resp.text()
+        provider = await resp.json()
+        assert provider["config"] == "***"  # secrets redacted
+
+        resp = await gateway.post(f"/llm/providers/{provider['id']}/models", json={
+            "model_id": "llama3:8b", "alias": "ollama-llama3"}, auth=AUTH)
+        assert resp.status == 201
+        resp = await gateway.get("/llm/models", auth=AUTH)
+        models = await resp.json()
+        assert models[0]["alias"] == "ollama-llama3"
+
+        # invalid provider type
+        resp = await gateway.post("/llm/providers", json={
+            "name": "x", "provider_type": "watsonx"}, auth=AUTH)
+        assert resp.status == 422
+    finally:
+        await gateway.close()
+
+
+async def test_export_import_roundtrip():
+    source = await make_client()
+    target = await make_client()
+    try:
+        await source.post("/tools", json={
+            "name": "exported-tool", "integration_type": "REST",
+            "url": "http://example.invalid/x",
+            "auth_type": "bearer", "auth_value": {"token": "s3cret"}}, auth=AUTH)
+        await source.post("/prompts", json={
+            "name": "exported-prompt", "template": "Hi {{ x }}"}, auth=AUTH)
+
+        resp = await source.get("/export", auth=AUTH)
+        bundle = await resp.json()
+        assert "tools" in bundle["entities"]
+        exported_tool = bundle["entities"]["tools"][0]
+        assert exported_tool["auth_value"] is None  # secrets stripped by default
+
+        resp = await target.post("/import", json=bundle, auth=AUTH)
+        summary = await resp.json()
+        assert summary["imported"]["tools"] == 1
+        resp = await target.get("/tools", auth=AUTH)
+        names = [t["name"] for t in await resp.json()]
+        assert "exported-tool" in names
+    finally:
+        await source.close()
+        await target.close()
+
+
+async def test_websocket_transport():
+    gateway = await make_client()
+    try:
+        async with gateway.ws_connect("/ws", auth=AUTH) as ws:
+            await ws.send_json({"jsonrpc": "2.0", "id": 1, "method": "ping"})
+            msg = await ws.receive_json(timeout=10)
+            assert msg == {"jsonrpc": "2.0", "id": 1, "result": {}}
+            await ws.send_json({"jsonrpc": "2.0", "id": 2, "method": "tools/list"})
+            msg = await ws.receive_json(timeout=10)
+            assert msg["result"]["tools"] == []
+            await ws.send_str("not json")
+            msg = await ws.receive_json(timeout=10)
+            assert msg["error"]["code"] == -32700
+    finally:
+        await gateway.close()
+
+
+async def test_legacy_sse_transport():
+    gateway = await make_client()
+    try:
+        async with gateway.get("/sse", auth=AUTH) as resp:
+            assert resp.status == 200
+            # read the endpoint event
+            endpoint = None
+            buffer = b""
+            while endpoint is None:
+                chunk = await asyncio.wait_for(resp.content.read(512), timeout=10)
+                buffer += chunk
+                for line in buffer.decode().splitlines():
+                    if line.startswith("data: /messages"):
+                        endpoint = line[6:]
+            # post a request to the back-channel
+            post_resp = await gateway.post(endpoint, json={
+                "jsonrpc": "2.0", "id": 5, "method": "ping"}, auth=AUTH)
+            assert post_resp.status == 202
+            # response arrives on the stream
+            found = False
+            deadline = asyncio.get_event_loop().time() + 10
+            while not found and asyncio.get_event_loop().time() < deadline:
+                chunk = await asyncio.wait_for(resp.content.read(512), timeout=10)
+                if b'"id":5' in chunk.replace(b" ", b"") or b'"id": 5' in chunk:
+                    found = True
+            assert found
+    finally:
+        await gateway.close()
+
+
+async def test_completion_complete():
+    gateway = await make_client()
+    try:
+        await gateway.post("/resources", json={
+            "uri": "memo://alpha", "name": "a", "content": "x"}, auth=AUTH)
+        await gateway.post("/resources", json={
+            "uri": "memo://beta", "name": "b", "content": "y"}, auth=AUTH)
+        resp = await gateway.post("/rpc", json={
+            "jsonrpc": "2.0", "id": 1, "method": "completion/complete",
+            "params": {"ref": {"type": "ref/resource"},
+                       "argument": {"name": "uri", "value": "memo://a"}}}, auth=AUTH)
+        payload = await resp.json()
+        assert payload["result"]["completion"]["values"] == ["memo://alpha"]
+    finally:
+        await gateway.close()

@@ -1,0 +1,73 @@
+"""Masking extension: native C++ path vs python fallback parity + perf
+(reference: tests/performance/test_request_logging_masking_native_extension_benchmark.py)."""
+
+import json
+import time
+
+from mcp_context_forge_tpu.utils import masking
+
+SAMPLE = {
+    "user": "alice",
+    "password": "hunter2",
+    "nested": {"api_key": "sk-12345", "safe": "visible", "authorization": "Bearer abc"},
+    "items": [{"token": "t0k3n", "count": 3}],
+    "config": {"client_secret": {"deep": "value"}},
+    "port": 8080,
+}
+
+
+def test_python_fallback_masks():
+    out = json.loads(masking._mask_python(json.dumps(SAMPLE)))
+    assert out["password"] == "***"
+    assert out["nested"]["api_key"] == "***"
+    assert out["nested"]["safe"] == "visible"
+    assert out["items"][0]["token"] == "***"
+    assert out["user"] == "alice"
+    assert out["port"] == 8080
+
+
+def test_native_masks_and_agrees_with_fallback():
+    if not masking.native_available():
+        import pytest
+        pytest.skip("native masking unavailable (no g++?)")
+    text = json.dumps(SAMPLE)
+    out = json.loads(masking.mask_text(text))
+    assert out["password"] == "***"
+    assert out["nested"]["api_key"] == "***"
+    assert out["nested"]["authorization"] == "***"
+    assert out["nested"]["safe"] == "visible"
+    assert out["items"][0]["token"] == "***"
+    assert out["items"][0]["count"] == 3
+    assert out["config"]["client_secret"] == "***"  # structured value masked
+    assert out["user"] == "alice"
+
+
+def test_native_handles_escapes_and_non_json():
+    if not masking.native_available():
+        import pytest
+        pytest.skip("native masking unavailable")
+    tricky = '{"password": "with \\"quote\\"", "note": "password: not a key"}'
+    out = json.loads(masking.mask_text(tricky))
+    assert out["password"] == "***"
+    assert out["note"] == "password: not a key"  # value containing the word stays
+
+
+def test_native_faster_than_python():
+    if not masking.native_available():
+        import pytest
+        pytest.skip("native masking unavailable")
+    payload = json.dumps({f"field_{i}": {"password": "x" * 32, "data": "y" * 64}
+                          for i in range(200)})
+    # warm both paths
+    masking.mask_text(payload)
+    masking._mask_python(payload)
+    n = 50
+    t0 = time.perf_counter()
+    for _ in range(n):
+        masking.mask_text(payload)
+    native_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(n):
+        masking._mask_python(payload)
+    python_s = time.perf_counter() - t0
+    assert native_s < python_s, (native_s, python_s)
