@@ -138,6 +138,12 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     app.router.add_get("/sse", sse_transport.handle_stream)
     app.router.add_post("/messages", sse_transport.handle_message)
 
+    from ..services.reverse_proxy import ReverseProxyHub
+    reverse_hub = ReverseProxyHub(ctx)
+    ctx.extras["reverse_proxy_hub"] = reverse_hub
+    app["reverse_proxy_hub"] = reverse_hub
+    app.router.add_get("/reverse-proxy", reverse_hub.handle_ws)
+
     async def handle_rpc(request: web.Request) -> web.Response:
         raw = await request.read()
         headers = {k.lower(): v for k, v in request.headers.items()}

@@ -1,0 +1,240 @@
+// mcpforge-wrapper: stdio ⇄ gateway streaming wrapper (native).
+//
+// C++ counterpart of the reference's Rust crate (/root/reference/crates/
+// wrapper — mcp_stdio_wrapper: stdio⇄gateway forwarding with JSON-RPC id
+// handling over a streaming HTTP connection). Reads line-delimited JSON-RPC
+// from stdin, POSTs each message to the gateway's /mcp endpoint over a
+// keep-alive HTTP/1.1 connection (raw POSIX sockets — no libcurl in the
+// image), tracks Mcp-Session-Id, and writes responses to stdout.
+//
+// Build: g++ -O2 -std=c++17 stdio_wrapper.cpp -o mcpforge-wrapper
+// Usage: mcpforge-wrapper http://host:port/mcp [auth-header-value]
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <iostream>
+#include <sstream>
+#include <string>
+
+namespace {
+
+struct Url {
+  std::string host;
+  std::string port = "80";
+  std::string path = "/mcp";
+};
+
+bool parse_url(const std::string& url, Url* out) {
+  if (url.rfind("http://", 0) != 0) return false;  // TLS is the gateway's edge job
+  std::string rest = url.substr(7);
+  size_t slash = rest.find('/');
+  std::string hostport = slash == std::string::npos ? rest : rest.substr(0, slash);
+  out->path = slash == std::string::npos ? "/mcp" : rest.substr(slash);
+  size_t colon = hostport.rfind(':');
+  if (colon != std::string::npos) {
+    out->host = hostport.substr(0, colon);
+    out->port = hostport.substr(colon + 1);
+  } else {
+    out->host = hostport;
+  }
+  return !out->host.empty();
+}
+
+class Connection {
+ public:
+  explicit Connection(const Url& url) : url_(url) {}
+  ~Connection() { close_fd(); }
+
+  bool ensure_open() {
+    if (fd_ >= 0) return true;
+    addrinfo hints{};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* result = nullptr;
+    if (getaddrinfo(url_.host.c_str(), url_.port.c_str(), &hints, &result) != 0)
+      return false;
+    for (addrinfo* ai = result; ai; ai = ai->ai_next) {
+      fd_ = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+      if (fd_ < 0) continue;
+      if (connect(fd_, ai->ai_addr, ai->ai_addrlen) == 0) break;
+      close_fd();
+    }
+    freeaddrinfo(result);
+    return fd_ >= 0;
+  }
+
+  void close_fd() {
+    if (fd_ >= 0) {
+      close(fd_);
+      fd_ = -1;
+    }
+    buffer_.clear();
+  }
+
+  bool send_all(const std::string& data) {
+    size_t sent = 0;
+    while (sent < data.size()) {
+      ssize_t n = send(fd_, data.data() + sent, data.size() - sent, MSG_NOSIGNAL);
+      if (n <= 0) return false;
+      sent += static_cast<size_t>(n);
+    }
+    return true;
+  }
+
+  bool saw_response_bytes = false;  // set once any response data arrives
+
+  // Read an HTTP/1.1 response; returns body, fills headers_out. Handles
+  // Content-Length and chunked transfer coding.
+  bool read_response(std::string* body, std::string* headers_out) {
+    saw_response_bytes = !buffer_.empty();  // leftover pipelined bytes count
+    std::string head;
+    while (head.find("\r\n\r\n") == std::string::npos) {
+      if (!fill()) return false;
+      head = buffer_;
+    }
+    size_t header_end = buffer_.find("\r\n\r\n") + 4;
+    *headers_out = buffer_.substr(0, header_end);
+    buffer_.erase(0, header_end);
+
+    std::string lower = *headers_out;
+    for (auto& c : lower) c = static_cast<char>(tolower(c));
+    size_t cl_pos = lower.find("content-length:");
+    if (cl_pos != std::string::npos) {
+      size_t value_start = cl_pos + 15;
+      size_t line_end = lower.find("\r\n", value_start);
+      size_t length = 0;
+      try {
+        length = std::stoul(lower.substr(value_start, line_end - value_start));
+      } catch (const std::exception&) {
+        return false;  // malformed header: fail the response, don't abort
+      }
+      while (buffer_.size() < length) {
+        if (!fill()) return false;
+      }
+      *body = buffer_.substr(0, length);
+      buffer_.erase(0, length);
+      return true;
+    }
+    if (lower.find("transfer-encoding: chunked") != std::string::npos) {
+      body->clear();
+      while (true) {
+        size_t crlf;
+        while ((crlf = buffer_.find("\r\n")) == std::string::npos) {
+          if (!fill()) return false;
+        }
+        size_t chunk_len = 0;
+        try {
+          chunk_len = std::stoul(buffer_.substr(0, crlf), nullptr, 16);
+        } catch (const std::exception&) {
+          return false;
+        }
+        buffer_.erase(0, crlf + 2);
+        if (chunk_len == 0) {
+          // trailing CRLF
+          while (buffer_.size() < 2) {
+            if (!fill()) return false;
+          }
+          buffer_.erase(0, 2);
+          return true;
+        }
+        while (buffer_.size() < chunk_len + 2) {
+          if (!fill()) return false;
+        }
+        body->append(buffer_, 0, chunk_len);
+        buffer_.erase(0, chunk_len + 2);
+      }
+    }
+    return false;
+  }
+
+ private:
+  bool fill() {
+    char chunk[8192];
+    ssize_t n = recv(fd_, chunk, sizeof(chunk), 0);
+    if (n <= 0) return false;
+    saw_response_bytes = true;
+    buffer_.append(chunk, static_cast<size_t>(n));
+    return true;
+  }
+
+  Url url_;
+  int fd_ = -1;
+  std::string buffer_;
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s http://host:port/mcp [authorization-value]\n",
+                 argv[0]);
+    return 2;
+  }
+  Url url;
+  if (!parse_url(argv[1], &url)) {
+    std::fprintf(stderr, "invalid url %s\n", argv[1]);
+    return 2;
+  }
+  std::string auth = argc > 2 ? argv[2] : "";
+  Connection connection(url);
+  std::string session_id;
+
+  std::string line;
+  while (std::getline(std::cin, line)) {
+    if (line.empty()) continue;
+    std::ostringstream request;
+    request << "POST " << url.path << " HTTP/1.1\r\n"
+            << "Host: " << url.host << ":" << url.port << "\r\n"
+            << "Content-Type: application/json\r\n"
+            << "Accept: application/json\r\n"
+            << "Content-Length: " << line.size() << "\r\n";
+    if (!auth.empty()) request << "Authorization: " << auth << "\r\n";
+    if (!session_id.empty()) request << "Mcp-Session-Id: " << session_id << "\r\n";
+    request << "Connection: keep-alive\r\n\r\n" << line;
+
+    bool ok = false;
+    for (int attempt = 0; attempt < 2 && !ok; ++attempt) {
+      if (!connection.ensure_open()) break;
+      if (!connection.send_all(request.str())) {
+        connection.close_fd();  // stale keep-alive: reconnect once
+        continue;
+      }
+      std::string body, headers;
+      if (!connection.read_response(&body, &headers)) {
+        bool clean_close = !connection.saw_response_bytes;
+        connection.close_fd();
+        if (clean_close && attempt == 0) continue;  // stale keep-alive: retry
+        // partial response: the request may have executed — never re-send a
+        // possibly non-idempotent tools/call; surface the failure instead
+        break;
+      }
+      std::string lower = headers;
+      for (auto& c : lower) c = static_cast<char>(tolower(c));
+      size_t sid = lower.find("mcp-session-id:");
+      if (sid != std::string::npos) {
+        size_t start = sid + 15;
+        while (start < lower.size() && lower[start] == ' ') ++start;
+        size_t end = lower.find("\r\n", start);
+        session_id = headers.substr(start, end - start);
+      }
+      if (!body.empty()) {
+        std::fwrite(body.data(), 1, body.size(), stdout);
+        std::fputc('\n', stdout);
+        std::fflush(stdout);
+      }
+      ok = true;
+    }
+    if (!ok) {
+      std::fprintf(stdout,
+                   "{\"jsonrpc\":\"2.0\",\"id\":null,\"error\":{\"code\":-32000,"
+                   "\"message\":\"gateway unreachable\"}}\n");
+      std::fflush(stdout);
+    }
+  }
+  return 0;
+}

@@ -1,0 +1,161 @@
+"""Reverse-proxy tunnel: local MCP servers behind NAT register into this
+gateway over an outbound WebSocket.
+
+Reference: `/root/reference/mcpgateway/reverse_proxy.py` (client) + the
+gateway-side session handling. Protocol (in-tree):
+
+1. client connects ``GET /reverse-proxy`` (authenticated WS);
+2. sends ``{"type": "register", "name": ..., "tools": [...]}``;
+3. gateway upserts a gateway row (``transport='reverse'``) + the tool
+   catalog; ``tools/call`` on those tools is forwarded over the socket as
+   ``{"type": "rpc", "corr": ..., "message": {jsonrpc request}}`` and the
+   client answers ``{"type": "rpc_result", "corr": ..., "message": ...}``;
+4. socket drop deactivates the gateway row.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any
+
+from aiohttp import WSMsgType, web
+
+from ..db.core import to_json
+from ..utils.ids import new_id
+from .base import AppContext, now
+
+logger = logging.getLogger(__name__)
+
+
+class ReverseProxyHub:
+    """Gateway-side registry of live tunnels."""
+
+    def __init__(self, ctx: AppContext):
+        self.ctx = ctx
+        self._sockets: dict[str, web.WebSocketResponse] = {}  # gateway_id -> ws
+        self._pending: dict[str, tuple[str, asyncio.Future]] = {}  # corr -> (gw, fut)
+
+    def is_connected(self, gateway_id: str) -> bool:
+        return gateway_id in self._sockets
+
+    async def call(self, gateway_id: str, message: dict[str, Any],
+                   timeout: float = 60.0) -> dict[str, Any]:
+        ws = self._sockets.get(gateway_id)
+        if ws is None:
+            raise ConnectionError("Reverse-proxy tunnel is not connected")
+        corr = new_id()
+        future: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[corr] = (gateway_id, future)
+        try:
+            await ws.send_json({"type": "rpc", "corr": corr, "message": message})
+            return await asyncio.wait_for(future, timeout=timeout)
+        finally:
+            self._pending.pop(corr, None)
+
+    async def handle_ws(self, request: web.Request) -> web.WebSocketResponse:
+        auth = request["auth"]
+        auth.require("gateways.create")
+        ws = web.WebSocketResponse(heartbeat=30.0)
+        await ws.prepare(request)
+        gateway_id: str | None = None
+        try:
+            async for msg in ws:
+                if msg.type != WSMsgType.TEXT:
+                    continue
+                try:
+                    frame = json.loads(msg.data)
+                except json.JSONDecodeError:
+                    continue
+                kind = frame.get("type")
+                if kind == "register":
+                    if gateway_id is not None:
+                        # one registration per socket; repeats are ignored so
+                        # they cannot orphan the original mapping
+                        await ws.send_json({"type": "error",
+                                            "message": "already registered"})
+                        continue
+                    candidate = await self._register(frame, auth.user,
+                                                     reject_if_connected=True)
+                    if candidate is None:
+                        await ws.send_json({"type": "error",
+                                            "message": "name already connected"})
+                        await ws.close()
+                        break
+                    gateway_id = candidate
+                    self._sockets[gateway_id] = ws
+                    await ws.send_json({"type": "registered", "gateway_id": gateway_id})
+                elif kind == "rpc_result":
+                    entry = self._pending.get(frame.get("corr", ""))
+                    if entry is not None and not entry[1].done():
+                        entry[1].set_result(frame.get("message", {}))
+                elif kind == "ping":
+                    await ws.send_json({"type": "pong"})
+        finally:
+            # only tear down if this socket still owns the mapping — a newer
+            # tunnel for the same gateway must not be killed by stale cleanup
+            if gateway_id is not None and self._sockets.get(gateway_id) is ws:
+                self._sockets.pop(gateway_id, None)
+                for corr, (gid, future) in list(self._pending.items()):
+                    if gid == gateway_id and not future.done():
+                        future.set_exception(
+                            ConnectionError("reverse tunnel closed"))
+                        self._pending.pop(corr, None)
+                await self.ctx.db.execute(
+                    "UPDATE gateways SET reachable=0, state='failed', updated_at=?"
+                    " WHERE id=?", (now(), gateway_id))
+                await self.ctx.bus.publish("gateways.changed",
+                                           {"action": "tunnel-closed",
+                                            "id": gateway_id})
+        return ws
+
+    async def _register(self, frame: dict[str, Any], user: str,
+                        reject_if_connected: bool = False) -> str | None:
+        name = frame.get("name") or f"reverse-{new_id()[:8]}"
+        ts = now()
+        row = await self.ctx.db.fetchone("SELECT id FROM gateways WHERE name=?",
+                                         (name,))
+        if row:
+            gateway_id = row["id"]
+            if reject_if_connected and gateway_id in self._sockets:
+                return None  # a live tunnel already owns this name
+            await self.ctx.db.execute(
+                "UPDATE gateways SET reachable=1, state='active', transport='reverse',"
+                " updated_at=? WHERE id=?", (ts, gateway_id))
+        else:
+            gateway_id = new_id()
+            await self.ctx.db.execute(
+                "INSERT INTO gateways (id, name, url, transport, enabled, reachable,"
+                " state, owner_email, created_at, updated_at)"
+                " VALUES (?,?,?,?,?,?,?,?,?,?)",
+                (gateway_id, name, f"reverse://{name}", "reverse", 1, 1, "active",
+                 user, ts, ts))
+        # upsert the announced tool catalog, pruning tools no longer offered
+        # (same contract as gateway_service._sync_catalog)
+        announced = []
+        for tool in frame.get("tools", []):
+            tool_name = tool.get("name", "")
+            if not tool_name:
+                continue
+            announced.append(tool_name)
+            await self.ctx.db.execute(
+                "INSERT INTO tools (id, original_name, description, integration_type,"
+                " input_schema, gateway_id, enabled, created_at, updated_at)"
+                " VALUES (?,?,?,?,?,?,?,?,?)"
+                " ON CONFLICT(original_name, COALESCE(gateway_id,'')) DO UPDATE SET"
+                " description=excluded.description, input_schema=excluded.input_schema,"
+                " updated_at=excluded.updated_at",
+                (new_id(), tool_name, tool.get("description"), "MCP",
+                 to_json(tool.get("inputSchema", {})), gateway_id, 1, ts, ts))
+        if announced:
+            marks = ",".join("?" for _ in announced)
+            await self.ctx.db.execute(
+                f"DELETE FROM tools WHERE gateway_id=? AND original_name NOT IN ({marks})",
+                [gateway_id, *announced])
+        else:
+            await self.ctx.db.execute("DELETE FROM tools WHERE gateway_id=?",
+                                      (gateway_id,))
+        await self.ctx.bus.publish("tools.changed", {"action": "reverse-register",
+                                                     "gateway_id": gateway_id})
+        return gateway_id

@@ -314,6 +314,18 @@ class ToolService:
         if not url:
             raise JSONRPCError(INVALID_PARAMS, "MCP tool has no upstream URL")
         transport = (gateway or {}).get("transport") or "streamablehttp"
+        if transport == "reverse":  # NAT'd server connected via reverse tunnel
+            hub = self.ctx.extras.get("reverse_proxy_hub")
+            if hub is None or gateway is None:
+                raise JSONRPCError(INTERNAL_ERROR, "Reverse-proxy hub unavailable")
+            response = await hub.call(gateway["id"], {
+                "jsonrpc": "2.0", "id": 1, "method": "tools/call",
+                "params": {"name": row["original_name"], "arguments": arguments}})
+            if "error" in response:
+                err = response["error"] or {}
+                raise JSONRPCError(err.get("code", INTERNAL_ERROR),
+                                   err.get("message", "tunnel error"))
+            return response.get("result", {})
         headers = _auth_headers(gateway or row, self.ctx.settings.auth_encryption_secret)
         # passthrough headers from the inbound request (reference passthrough_headers)
         allowed = from_json((gateway or {}).get("passthrough_headers"), [])

@@ -1,0 +1,61 @@
+"""Reverse-proxy tunnel: local server registers over WS, tools route back
+through the tunnel."""
+
+import aiohttp
+
+from tests.integration.test_gateway_app import BASIC, make_client
+
+AUTH = aiohttp.BasicAuth(*BASIC)
+
+
+async def test_reverse_tunnel_register_and_call():
+    gateway = await make_client()
+    try:
+        async with gateway.ws_connect("/reverse-proxy", auth=AUTH) as ws:
+            await ws.send_json({"type": "register", "name": "nat-server",
+                                "tools": [{"name": "local-time",
+                                           "description": "time on the NAT box",
+                                           "inputSchema": {"type": "object"}}]})
+            reg = await ws.receive_json(timeout=10)
+            assert reg["type"] == "registered"
+
+            # the tunneled tool appears in the catalog
+            resp = await gateway.get("/tools", auth=AUTH)
+            names = [t["name"] for t in await resp.json()]
+            assert "local-time" in names
+
+            # invoke: gateway forwards over the tunnel; we answer like the
+            # NAT'd server would
+            import asyncio
+
+            async def answer():
+                frame = await ws.receive_json(timeout=15)
+                assert frame["type"] == "rpc"
+                message = frame["message"]
+                assert message["params"]["name"] == "local-time"
+                await ws.send_json({"type": "rpc_result", "corr": frame["corr"],
+                                    "message": {"jsonrpc": "2.0", "id": message["id"],
+                                                "result": {"content": [{
+                                                    "type": "text",
+                                                    "text": "12:00"}],
+                                                    "isError": False}}})
+
+            answer_task = asyncio.ensure_future(answer())
+            resp = await gateway.post("/rpc", json={
+                "jsonrpc": "2.0", "id": 1, "method": "tools/call",
+                "params": {"name": "local-time", "arguments": {}}}, auth=AUTH)
+            payload = await resp.json()
+            await answer_task
+            assert payload["result"]["content"][0]["text"] == "12:00"
+
+        # socket closed -> gateway deactivated, call fails as isError
+        resp = await gateway.post("/rpc", json={
+            "jsonrpc": "2.0", "id": 2, "method": "tools/call",
+            "params": {"name": "local-time", "arguments": {}}}, auth=AUTH)
+        payload = await resp.json()
+        assert payload["result"]["isError"] is True
+        resp = await gateway.get("/gateways?include_inactive=true", auth=AUTH)
+        gw = [g for g in await resp.json() if g["name"] == "nat-server"][0]
+        assert gw["reachable"] is False
+    finally:
+        await gateway.close()

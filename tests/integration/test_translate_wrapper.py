@@ -1,0 +1,131 @@
+"""Aux programs: translate bridge (stdio→http) + native C++ stdio wrapper
+against a live gateway."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from mcp_context_forge_tpu.translate import StdioServerBridge, build_bridge_app
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# a minimal stdio MCP server used as the bridge target
+STDIO_SERVER = textwrap.dedent("""
+    import json, sys
+    for line in sys.stdin:
+        msg = json.loads(line)
+        if "id" not in msg:
+            continue
+        if msg["method"] == "initialize":
+            result = {"protocolVersion": "2025-06-18", "capabilities": {"tools": {}},
+                      "serverInfo": {"name": "stdio-demo", "version": "0"}}
+        elif msg["method"] == "tools/list":
+            result = {"tools": [{"name": "upper", "inputSchema": {"type": "object"}}]}
+        elif msg["method"] == "tools/call":
+            text = msg["params"]["arguments"].get("text", "")
+            result = {"content": [{"type": "text", "text": text.upper()}],
+                      "isError": False}
+        else:
+            result = {}
+        out = {"jsonrpc": "2.0", "id": msg["id"], "result": result}
+        sys.stdout.write(json.dumps(out) + "\\n")
+        sys.stdout.flush()
+""")
+
+
+async def test_stdio_to_http_bridge(tmp_path):
+    script = tmp_path / "server.py"
+    script.write_text(STDIO_SERVER)
+    bridge = StdioServerBridge(f"{sys.executable} {script}")
+    await bridge.start()
+    try:
+        app = build_bridge_app(bridge)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.post("/mcp", json={
+                "jsonrpc": "2.0", "id": 42, "method": "tools/call",
+                "params": {"name": "upper", "arguments": {"text": "abc"}}})
+            payload = await resp.json()
+            assert payload["id"] == 42  # id restored after bridge rewrite
+            assert payload["result"]["content"][0]["text"] == "ABC"
+            # notification -> 202
+            resp = await client.post("/mcp", json={
+                "jsonrpc": "2.0", "method": "notifications/initialized"})
+            assert resp.status == 202
+        finally:
+            await client.close()
+    finally:
+        await bridge.stop()
+
+
+async def test_bridge_concurrent_id_rewriting(tmp_path):
+    script = tmp_path / "server.py"
+    script.write_text(STDIO_SERVER)
+    bridge = StdioServerBridge(f"{sys.executable} {script}")
+    await bridge.start()
+    try:
+        async def call(i):
+            response = await bridge.request({
+                "jsonrpc": "2.0", "id": i, "method": "tools/call",
+                "params": {"name": "upper", "arguments": {"text": f"t{i}"}}})
+            return i, response
+
+        results = await asyncio.gather(*[call(i) for i in range(10)])
+        for i, response in results:
+            assert response["id"] == i
+            assert response["result"]["content"][0]["text"] == f"T{i}"
+    finally:
+        await bridge.stop()
+
+
+@pytest.fixture(scope="module")
+def wrapper_binary(tmp_path_factory):
+    src = os.path.join(REPO, "mcp_context_forge_tpu", "native", "stdio_wrapper.cpp")
+    out = str(tmp_path_factory.mktemp("bin") / "mcpforge-wrapper")
+    result = subprocess.run(["g++", "-O2", "-std=c++17", src, "-o", out],
+                            capture_output=True)
+    if result.returncode != 0:
+        pytest.skip(f"g++ unavailable/failed: {result.stderr[:200]}")
+    return out
+
+
+async def test_native_wrapper_against_gateway(wrapper_binary):
+    from tests.integration.test_gateway_app import make_client
+    gateway = await make_client()
+    try:
+        host, port = gateway.server.host, gateway.server.port
+        import base64
+        auth = "Basic " + base64.b64encode(b"admin:changeme").decode()
+
+        proc = await asyncio.create_subprocess_exec(
+            wrapper_binary, f"http://{host}:{port}/mcp", auth,
+            stdin=asyncio.subprocess.PIPE, stdout=asyncio.subprocess.PIPE)
+        try:
+            async def roundtrip(message):
+                proc.stdin.write((json.dumps(message) + "\n").encode())
+                await proc.stdin.drain()
+                line = await asyncio.wait_for(proc.stdout.readline(), timeout=15)
+                return json.loads(line)
+
+            out = await roundtrip({"jsonrpc": "2.0", "id": 1, "method": "ping"})
+            assert out == {"jsonrpc": "2.0", "id": 1, "result": {}}
+            out = await roundtrip({"jsonrpc": "2.0", "id": 2, "method": "tools/list"})
+            assert out["result"]["tools"] == []
+            # keep-alive reuse: a third call on the same connection
+            out = await roundtrip({"jsonrpc": "2.0", "id": 3, "method": "initialize",
+                                   "params": {"protocolVersion": "2025-06-18",
+                                              "capabilities": {},
+                                              "clientInfo": {"name": "w", "version": "0"}}})
+            assert out["result"]["serverInfo"]["name"]
+        finally:
+            proc.stdin.close()
+            await proc.wait()
+    finally:
+        await gateway.close()
