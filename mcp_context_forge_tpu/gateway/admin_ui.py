@@ -1,0 +1,87 @@
+"""Minimal server-rendered admin UI.
+
+Reference: 20.5k-LoC admin.py + 34.8k-LoC JS admin_ui — intentionally
+table-driven and tiny here (SURVEY.md §7.2 #5: the API surface must be
+generated, not hand-grown). One page, vanilla JS over the existing REST API.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>mcpforge admin</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:0;background:#f4f5f7;color:#1a1d21}
+ header{background:#1a1d21;color:#fff;padding:10px 20px;display:flex;gap:16px;align-items:center}
+ header h1{font-size:16px;margin:0}
+ nav button{background:none;border:none;color:#aab;cursor:pointer;font-size:14px;padding:6px 10px}
+ nav button.active{color:#fff;border-bottom:2px solid #6cf}
+ main{padding:20px;max-width:1100px;margin:0 auto}
+ table{width:100%;border-collapse:collapse;background:#fff;box-shadow:0 1px 3px rgba(0,0,0,.08)}
+ th,td{text-align:left;padding:8px 12px;border-bottom:1px solid #eceef1;font-size:13px}
+ th{background:#fafbfc;font-weight:600}
+ .pill{display:inline-block;padding:1px 8px;border-radius:10px;font-size:11px}
+ .ok{background:#d9f2e4;color:#11734b}.bad{background:#fde2e1;color:#a12622}
+ #status{margin:10px 0;color:#667}
+ pre{background:#fff;padding:12px;overflow:auto;font-size:12px}
+</style></head><body>
+<header><h1>mcpforge</h1><nav id="nav"></nav></header>
+<main><div id="status"></div><div id="view"></div></main>
+<script>
+const TABS = {
+  tools:    {url: "/tools?include_inactive=true", cols: ["name","integration_type","url","enabled","reachable"]},
+  gateways: {url: "/gateways?include_inactive=true", cols: ["name","url","transport","state","reachable"]},
+  servers:  {url: "/servers?include_inactive=true", cols: ["name","description","associated_tools","enabled"]},
+  resources:{url: "/resources?include_inactive=true", cols: ["uri","name","mime_type","enabled"]},
+  prompts:  {url: "/prompts?include_inactive=true", cols: ["name","description","enabled"]},
+  agents:   {url: "/a2a?include_inactive=true", cols: ["name","agent_type","endpoint_url","enabled","reachable"]},
+  models:   {url: "/v1/models", cols: ["id","owned_by"], path: "data"},
+  metrics:  {url: "/metrics", cols: ["name","calls","errors","avg_ms","min_ms","max_ms"], path: "tools"},
+  traces:   {url: "/admin/traces?limit=50", cols: ["name","duration_ms","status","trace_id"]},
+  logs:     {url: "/admin/logs?limit=100", cols: ["ts","level","logger","message"]},
+};
+function esc(s){
+  return String(s).replace(/[&<>"']/g, c => ({"&":"&amp;","<":"&lt;",">":"&gt;",
+    '"':"&quot;","'":"&#39;"}[c]));
+}
+function cell(v){
+  if (v === true) return '<span class="pill ok">yes</span>';
+  if (v === false) return '<span class="pill bad">no</span>';
+  if (Array.isArray(v)) return v.length;
+  if (v === null || v === undefined) return "";
+  if (typeof v === "number") return Math.round(v*100)/100;
+  return esc(String(v).slice(0,80));  // API data is attacker-influenced
+}
+async function show(name){
+  document.querySelectorAll("nav button").forEach(b=>b.classList.toggle("active", b.textContent===name));
+  const t = TABS[name];
+  const s = document.getElementById("status");
+  s.textContent = "loading…";
+  try {
+    const r = await fetch(t.url, {headers: {accept: "application/json"}});
+    if (!r.ok) { s.textContent = r.status + " " + await r.text(); return; }
+    let data = await r.json();
+    if (t.path) data = data[t.path] || [];
+    s.textContent = data.length + " rows";
+    const head = "<tr>" + t.cols.map(c=>`<th>${c}</th>`).join("") + "</tr>";
+    const rows = data.map(d=>"<tr>"+t.cols.map(c=>`<td>${cell(d[c])}</td>`).join("")+"</tr>").join("");
+    document.getElementById("view").innerHTML = `<table>${head}${rows}</table>`;
+  } catch(e){ s.textContent = "error: " + e; }
+}
+const nav = document.getElementById("nav");
+for (const name of Object.keys(TABS)){
+  const b = document.createElement("button");
+  b.textContent = name; b.onclick = ()=>show(name); nav.appendChild(b);
+}
+show("tools");
+</script></body></html>"""
+
+
+def setup_admin_ui(app: web.Application) -> None:
+    async def admin_page(request: web.Request) -> web.Response:
+        request["auth"].require("observability.read")
+        return web.Response(text=_PAGE, content_type="text/html")
+
+    app.router.add_get("/admin", admin_page)
+    app.router.add_get("/admin/", admin_page)

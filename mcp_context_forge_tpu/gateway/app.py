@@ -165,6 +165,22 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     from .routers_extra import setup_extra_routes
     setup_extra_routes(app)
 
+    from ..services.catalog_service import CatalogService
+    from ..services.chat_service import ChatService
+    from ..services.metrics_service import MetricsMaintenanceService
+    from ..services.team_service import TeamService
+    app["chat_service"] = ChatService(ctx, tool_service, server_service)
+    app["team_service"] = TeamService(ctx)
+    app["catalog_service"] = CatalogService(ctx)
+    metrics_maintenance = MetricsMaintenanceService(
+        ctx, rollup_interval=settings.metrics_buffer_flush_interval * 60)
+    app["metrics_maintenance"] = metrics_maintenance
+    from .routers_chat import setup_chat_routes
+    setup_chat_routes(app)
+    if settings.admin_ui_enabled:
+        from .admin_ui import setup_admin_ui
+        setup_admin_ui(app)
+
     async def lifecycle(app: web.Application) -> AsyncIterator[None]:
         await bus.start()
         import asyncio as _asyncio
@@ -182,8 +198,22 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         ctx.extras["leader_elector"] = elector
         await elector.start()
         await gateway_service.start_health_loop()
+        await metrics_maintenance.start()
+
+        async def _chat_sweeper() -> None:
+            while True:
+                await _asyncio.sleep(600)
+                app["chat_service"].sweep(ttl=settings.session_ttl)
+
+        chat_sweeper = _asyncio.create_task(_chat_sweeper())
         logger.info("%s started (worker %s)", settings.app_name, ctx.worker_id)
         yield
+        chat_sweeper.cancel()
+        try:
+            await chat_sweeper
+        except _asyncio.CancelledError:
+            pass
+        await metrics_maintenance.stop()
         await transport.sessions.stop_sweeper()
         await gateway_service.stop_health_loop()
         await elector.stop()

@@ -1,0 +1,160 @@
+"""Routers: llmchat (ReAct sessions with SSE streaming), teams, catalog,
+metric rollups. Reference: routers/llmchat_router.py, routers/teams.py,
+routers/catalog.py, routers/metrics_maintenance.py."""
+
+from __future__ import annotations
+
+import json
+
+from aiohttp import web
+
+
+def setup_chat_routes(app: web.Application) -> None:
+    routes = web.RouteTableDef()
+
+    # --------------------------------------------------------------- llmchat
+    @routes.post("/llmchat/connect")
+    async def connect(request: web.Request) -> web.Response:
+        request["auth"].require("llm.chat")
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        session = await request.app["chat_service"].connect(
+            user=request["auth"].user, model=body.get("model"),
+            server_id=body.get("server_id"),
+            max_steps=int(body.get("max_steps", 5)))
+        return web.json_response({"session_id": session.id}, status=201)
+
+    @routes.post("/llmchat/{session_id}/chat")
+    async def chat(request: web.Request) -> web.StreamResponse:
+        request["auth"].require("llm.chat")
+        body = await request.json()
+        text = body.get("message", "")
+        stream = bool(body.get("stream", True))
+        service = request.app["chat_service"]
+        # validate BEFORE the SSE response starts — an async generator only
+        # raises at first iteration, which would be after the 200 headers
+        service.get_session(request.match_info["session_id"],
+                            request["auth"].user)
+        if request.app["ctx"].llm_registry is None:
+            return web.json_response({"detail": "tpu_local engine disabled"},
+                                     status=422)
+        events = service.chat(request.match_info["session_id"],
+                              request["auth"].user, text,
+                              auth_teams=request["auth"].teams)
+        if not stream:
+            collected = [event async for event in events]
+            return web.json_response({"events": collected})
+        resp = web.StreamResponse(headers={"content-type": "text/event-stream",
+                                           "cache-control": "no-store"})
+        await resp.prepare(request)
+        async for event in events:
+            await resp.write(b"data: " + json.dumps(event).encode() + b"\n\n")
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+    @routes.delete("/llmchat/{session_id}")
+    async def disconnect(request: web.Request) -> web.Response:
+        await request.app["chat_service"].disconnect(
+            request.match_info["session_id"], request["auth"].user)
+        return web.Response(status=204)
+
+    # ----------------------------------------------------------------- teams
+    @routes.get("/teams")
+    async def list_teams(request: web.Request) -> web.Response:
+        auth = request["auth"]
+        auth.require("teams.read")
+        user = None if auth.is_admin and request.query.get("all") == "true" \
+            else auth.user
+        return web.json_response(await request.app["team_service"].list_teams(user))
+
+    @routes.post("/teams")
+    async def create_team(request: web.Request) -> web.Response:
+        auth = request["auth"]
+        auth.require("teams.create")  # write permission: read-scoped tokens may not
+        body = await request.json()
+        team = await request.app["team_service"].create_team(
+            name=body.get("name", ""), created_by=auth.user,
+            description=body.get("description", ""),
+            visibility=body.get("visibility", "private"))
+        return web.json_response(team, status=201)
+
+    @routes.get("/teams/{team_id}")
+    async def get_team(request: web.Request) -> web.Response:
+        request["auth"].require("teams.read")
+        return web.json_response(
+            await request.app["team_service"].get_team(request.match_info["team_id"]))
+
+    @routes.delete("/teams/{team_id}")
+    async def delete_team(request: web.Request) -> web.Response:
+        auth = request["auth"]
+        await request.app["team_service"].delete_team(
+            request.match_info["team_id"], auth.user, auth.is_admin)
+        return web.Response(status=204)
+
+    @routes.post("/teams/{team_id}/members")
+    async def add_member(request: web.Request) -> web.Response:
+        auth = request["auth"]
+        body = await request.json()
+        await request.app["team_service"].add_member(
+            request.match_info["team_id"], auth.user, body.get("email", ""),
+            role=body.get("role", "member"), is_admin=auth.is_admin)
+        return web.Response(status=204)
+
+    @routes.delete("/teams/{team_id}/members/{email}")
+    async def remove_member(request: web.Request) -> web.Response:
+        auth = request["auth"]
+        await request.app["team_service"].remove_member(
+            request.match_info["team_id"], auth.user,
+            request.match_info["email"], is_admin=auth.is_admin)
+        return web.Response(status=204)
+
+    @routes.post("/teams/{team_id}/invitations")
+    async def invite(request: web.Request) -> web.Response:
+        auth = request["auth"]
+        body = await request.json()
+        invitation = await request.app["team_service"].invite(
+            request.match_info["team_id"], auth.user, body.get("email", ""),
+            role=body.get("role", "member"), is_admin=auth.is_admin)
+        return web.json_response(invitation, status=201)
+
+    @routes.post("/teams/invitations/accept")
+    async def accept(request: web.Request) -> web.Response:
+        body = await request.json()
+        team = await request.app["team_service"].accept_invitation(
+            body.get("token", ""), request["auth"].user)
+        return web.json_response(team)
+
+    # --------------------------------------------------------------- catalog
+    @routes.get("/catalog")
+    async def catalog(request: web.Request) -> web.Response:
+        request["auth"].require("gateways.read")
+        return web.json_response(await request.app["catalog_service"].list_entries())
+
+    @routes.post("/catalog/{entry_id}/register")
+    async def register_catalog(request: web.Request) -> web.Response:
+        request["auth"].require("gateways.create")
+        gateway = await request.app["catalog_service"].register_entry(
+            request.match_info["entry_id"], request.app["gateway_service"])
+        from .routers import _dump
+        return web.json_response(_dump(gateway), status=201)
+
+    # --------------------------------------------------------------- rollups
+    @routes.get("/metrics/rollups")
+    async def rollups(request: web.Request) -> web.Response:
+        request["auth"].require("observability.read")
+        service = request.app["metrics_maintenance"]
+        return web.json_response(await service.hourly_summary(
+            entity_id=request.query.get("entity_id"),
+            hours=int(request.query.get("hours", "24"))))
+
+    @routes.post("/metrics/rollup")
+    async def run_rollup(request: web.Request) -> web.Response:
+        request["auth"].require("observability.read")
+        service = request.app["metrics_maintenance"]
+        count = await service.rollup()
+        return web.json_response({"rolled_up": count})
+
+    app.add_routes(routes)

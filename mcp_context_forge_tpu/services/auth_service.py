@@ -29,7 +29,7 @@ PERMISSIONS = {
     "gateways.read", "gateways.create", "gateways.update", "gateways.delete",
     "servers.read", "servers.create", "servers.update", "servers.delete",
     "a2a.read", "a2a.create", "a2a.invoke", "a2a.delete",
-    "teams.read", "teams.manage", "tokens.manage", "admin.all",
+    "teams.read", "teams.create", "teams.manage", "tokens.manage", "admin.all",
     "llm.chat", "llm.admin", "plugins.manage", "observability.read",
     "export.run", "import.run",
 }
@@ -37,6 +37,7 @@ PERMISSIONS = {
 DEFAULT_USER_PERMISSIONS = {
     "tools.read", "tools.invoke", "resources.read", "prompts.read",
     "servers.read", "gateways.read", "a2a.read", "a2a.invoke", "llm.chat",
+    "teams.read", "teams.create",
 }
 
 

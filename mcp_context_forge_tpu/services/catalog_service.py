@@ -1,0 +1,52 @@
+"""Curated MCP server catalog (reference: services/catalog_service.py +
+mcp-catalog.yml): a YAML list of known-good servers the admin can register
+with one call."""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import yaml
+
+from ..schemas import GatewayCreate
+from .base import AppContext, NotFoundError
+
+DEFAULT_CATALOG = [
+    {"id": "local-tpu-gateway", "name": "Peer mcpforge gateway",
+     "url": "http://localhost:4444/mcp", "transport": "streamablehttp",
+     "description": "Another mcp-context-forge-tpu instance", "tags": ["mcpforge"]},
+]
+
+
+class CatalogService:
+    def __init__(self, ctx: AppContext, catalog_file: str = "mcp-catalog.yml"):
+        self.ctx = ctx
+        self.catalog_file = catalog_file
+        self._entries: list[dict[str, Any]] | None = None
+
+    def load(self) -> list[dict[str, Any]]:
+        if self._entries is None:
+            path = Path(self.catalog_file)
+            if path.exists():
+                raw = yaml.safe_load(path.read_text()) or {}
+                self._entries = list(raw.get("catalog", raw if isinstance(raw, list)
+                                             else []))
+            else:
+                self._entries = list(DEFAULT_CATALOG)
+        return self._entries
+
+    async def list_entries(self) -> list[dict[str, Any]]:
+        registered = {r["url"] for r in await self.ctx.db.fetchall(
+            "SELECT url FROM gateways")}
+        return [{**e, "registered": e.get("url") in registered} for e in self.load()]
+
+    async def register_entry(self, entry_id: str, gateway_service) -> Any:
+        entry = next((e for e in self.load() if e.get("id") == entry_id), None)
+        if entry is None:
+            raise NotFoundError(f"Catalog entry {entry_id!r} not found")
+        return await gateway_service.register_gateway(GatewayCreate(
+            name=entry.get("name", entry_id), url=entry["url"],
+            transport=entry.get("transport", "streamablehttp"),
+            description=entry.get("description"), tags=entry.get("tags", [])),
+            sync=False)

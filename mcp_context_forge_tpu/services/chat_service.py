@@ -1,0 +1,166 @@
+"""Interactive LLM chat with gateway-tool calling (ReAct loop).
+
+Reference: `routers/llmchat_router.py` + `services/mcp_client_chat_service.py`
+(LangChain/LangGraph ``create_react_agent`` + MultiServerMCPClient so the LLM
+can call gateway tools, `:31-37`). In-tree: a dependency-free ReAct loop —
+the model proposes ``{"tool": ..., "arguments": ...}`` actions, the gateway
+executes them through the normal tools/call pipeline (plugins included), and
+observations feed back until the model answers. Sessions are in-memory per
+user with SSE token streaming on the router side.
+
+BASELINE.json config 5 ("federated multi-tool ReAct agent loop, full LLM
+plugin chain") runs through this service.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator
+
+from ..utils.ids import new_id
+from .base import AppContext, NotFoundError, ValidationFailure
+
+SYSTEM_PROMPT = """You are a tool-using assistant. You may call the tools listed below.
+To call a tool reply with ONLY a JSON object: {"tool": "<name>", "arguments": {...}}
+When you can answer directly, reply with the answer text (no JSON).
+
+Tools:
+{tool_catalog}
+"""
+
+
+@dataclass
+class ChatSession:
+    id: str
+    user: str
+    model: str | None = None
+    server_id: str | None = None  # restrict tools to a virtual server
+    max_steps: int = 5
+    messages: list[dict[str, Any]] = field(default_factory=list)
+    created: float = field(default_factory=time.time)
+    last_used: float = field(default_factory=time.time)
+
+
+class ChatService:
+    def __init__(self, ctx: AppContext, tool_service, server_service):
+        self.ctx = ctx
+        self.tools = tool_service
+        self.servers = server_service
+        self._sessions: dict[str, ChatSession] = {}
+
+    # ------------------------------------------------------------- sessions
+
+    async def connect(self, user: str, model: str | None = None,
+                      server_id: str | None = None, max_steps: int = 5) -> ChatSession:
+        session = ChatSession(id=new_id(), user=user, model=model,
+                              server_id=server_id, max_steps=max_steps)
+        self._sessions[session.id] = session
+        return session
+
+    def get_session(self, session_id: str, user: str) -> ChatSession:
+        session = self._sessions.get(session_id)
+        if session is None or session.user != user:
+            raise NotFoundError("Chat session not found")
+        session.last_used = time.time()
+        return session
+
+    async def disconnect(self, session_id: str, user: str) -> None:
+        session = self._sessions.get(session_id)
+        if session is not None and session.user == user:
+            del self._sessions[session_id]
+
+    # ----------------------------------------------------------------- chat
+
+    async def _tool_catalog(self, session: ChatSession, auth_teams: list[str]
+                            ) -> list[dict[str, Any]]:
+        tools = await self.tools.list_tools(team_ids=auth_teams)
+        if session.server_id:
+            allowed = set(await self.servers.server_tool_names(session.server_id))
+            tools = [t for t in tools if t.name in allowed]
+        return [{"name": t.name, "description": t.description or "",
+                 "schema": t.input_schema} for t in tools]
+
+    @staticmethod
+    def _parse_action(text: str) -> dict[str, Any] | None:
+        """Extract a {"tool": ..., "arguments": ...} action from model output."""
+        text = text.strip()
+        candidates = [text]
+        match = re.search(r"\{.*\}", text, re.S)
+        if match:
+            candidates.append(match.group(0))
+        for candidate in candidates:
+            try:
+                obj = json.loads(candidate)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and isinstance(obj.get("tool"), str):
+                return {"tool": obj["tool"],
+                        "arguments": obj.get("arguments") or {}}
+        return None
+
+    async def chat(self, session_id: str, user: str, text: str,
+                   auth_teams: list[str] | None = None) -> AsyncIterator[dict[str, Any]]:
+        """Run one user turn; yields events:
+        {type: token|tool_call|tool_result|answer|error, ...}."""
+        registry = self.ctx.llm_registry
+        if registry is None:
+            raise ValidationFailure("tpu_local engine is not enabled")
+        session = self.get_session(session_id, user)
+        catalog = await self._tool_catalog(session, auth_teams or [])
+        catalog_text = "\n".join(
+            f"- {t['name']}: {t['description']} args={json.dumps(t['schema'])}"
+            for t in catalog) or "(none)"
+        system = SYSTEM_PROMPT.replace("{tool_catalog}", catalog_text)
+        session.messages.append({"role": "user", "content": text})
+
+        with self.ctx.tracer.span("llmchat.turn", {"session": session.id,
+                                                   "user": user}):
+            for step in range(session.max_steps):
+                response = await registry.chat({
+                    "model": session.model,
+                    "messages": [{"role": "system", "content": system},
+                                 *session.messages],
+                    "max_tokens": 512,
+                    "temperature": 0.0,
+                })
+                reply = response["choices"][0]["message"]["content"]
+                action = self._parse_action(reply)
+                if action is None:
+                    session.messages.append({"role": "assistant", "content": reply})
+                    yield {"type": "answer", "text": reply,
+                           "usage": response.get("usage", {})}
+                    return
+                yield {"type": "tool_call", "tool": action["tool"],
+                       "arguments": action["arguments"], "step": step}
+                try:
+                    result = await self.tools.invoke_tool(
+                        action["tool"], action["arguments"], user=user)
+                    observation = _result_text(result)[:4000]
+                except Exception as exc:
+                    observation = f"ERROR: {type(exc).__name__}: {exc}"
+                yield {"type": "tool_result", "tool": action["tool"],
+                       "text": observation[:500], "step": step}
+                session.messages.append({"role": "assistant", "content": reply})
+                session.messages.append({
+                    "role": "user",
+                    "content": f"Tool {action['tool']} returned:\n{observation}\n"
+                               f"Continue. Answer directly if you can."})
+            yield {"type": "error",
+                   "message": f"Agent exceeded {session.max_steps} steps"}
+
+    def sweep(self, ttl: float = 3600.0) -> None:
+        cutoff = time.time() - ttl
+        for sid in [s for s, sess in self._sessions.items()
+                    if sess.last_used < cutoff]:
+            del self._sessions[sid]
+
+
+def _result_text(result: dict[str, Any]) -> str:
+    parts = []
+    for item in result.get("content", []):
+        if isinstance(item, dict) and item.get("type") == "text":
+            parts.append(item.get("text", ""))
+    return "\n".join(parts)

@@ -1,0 +1,95 @@
+"""Metric rollups + retention (reference: metrics_rollup_service.py,
+metrics_cleanup_service.py, hourly rollup models db.py:2556-2848).
+
+Leader-gated background loops: raw per-call rows roll up into hourly
+aggregates; raw rows older than the retention window are pruned.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any
+
+from .base import AppContext
+
+logger = logging.getLogger(__name__)
+
+
+class MetricsMaintenanceService:
+    def __init__(self, ctx: AppContext, rollup_interval: float = 300.0,
+                 retention_hours: float = 24.0):
+        self.ctx = ctx
+        self.rollup_interval = rollup_interval
+        self.retention_hours = retention_hours
+        self._task: asyncio.Task | None = None
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        elector = self.ctx.extras.get("leader_elector")
+        while True:
+            await asyncio.sleep(self.rollup_interval)
+            try:
+                if elector is None or elector.is_leader:
+                    await self.rollup()
+                    await self.cleanup()
+            except Exception as exc:
+                logger.warning("metrics maintenance failed: %s", exc)
+
+    async def rollup(self) -> int:
+        """Aggregate raw tool_metrics into hourly buckets (idempotent upsert).
+
+        Only hours whose raw rows are still fully retained are recomputed:
+        cleanup() prunes rows older than the retention cutoff, and re-rolling
+        a half-pruned boundary hour would shrink its historical aggregate."""
+        boundary_hour = int((time.time() - self.retention_hours * 3600) / 3600)
+        rows = await self.ctx.db.fetchall(
+            "SELECT tool_id, CAST(ts / 3600 AS INTEGER) AS hour,"
+            " COUNT(*) AS count, SUM(1 - success) AS errors,"
+            " SUM(duration_ms) AS total_ms, MIN(duration_ms) AS min_ms,"
+            " MAX(duration_ms) AS max_ms"
+            " FROM tool_metrics GROUP BY tool_id, hour"
+            " HAVING hour > ?", (boundary_hour,))
+        for row in rows:
+            await self.ctx.db.execute(
+                "INSERT INTO metrics_rollups (entity_type, entity_id, hour, count,"
+                " errors, total_ms, min_ms, max_ms) VALUES ('tool',?,?,?,?,?,?,?)"
+                " ON CONFLICT(entity_type, entity_id, hour) DO UPDATE SET"
+                " count=excluded.count, errors=excluded.errors,"
+                " total_ms=excluded.total_ms, min_ms=excluded.min_ms,"
+                " max_ms=excluded.max_ms",
+                (row["tool_id"], row["hour"], row["count"], row["errors"],
+                 row["total_ms"], row["min_ms"], row["max_ms"]))
+        return len(rows)
+
+    async def cleanup(self) -> int:
+        """Prune raw rows past retention (rollups keep the history)."""
+        cutoff = time.time() - self.retention_hours * 3600
+        before = await self.ctx.db.fetchone(
+            "SELECT COUNT(*) AS n FROM tool_metrics WHERE ts < ?", (cutoff,))
+        await self.ctx.db.execute("DELETE FROM tool_metrics WHERE ts < ?", (cutoff,))
+        return int(before["n"]) if before else 0
+
+    async def hourly_summary(self, entity_id: str | None = None,
+                             hours: int = 24) -> list[dict[str, Any]]:
+        cutoff_hour = int(time.time() / 3600) - hours
+        if entity_id:
+            return await self.ctx.db.fetchall(
+                "SELECT * FROM metrics_rollups WHERE entity_id=? AND hour>=?"
+                " ORDER BY hour", (entity_id, cutoff_hour))
+        return await self.ctx.db.fetchall(
+            "SELECT * FROM metrics_rollups WHERE hour>=? ORDER BY hour",
+            (cutoff_hour,))

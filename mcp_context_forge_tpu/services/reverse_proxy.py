@@ -77,10 +77,11 @@ class ReverseProxyHub:
                                             "message": "already registered"})
                         continue
                     candidate = await self._register(frame, auth.user,
-                                                     reject_if_connected=True)
+                                                     reject_if_connected=True,
+                                                     is_admin=auth.is_admin)
                     if candidate is None:
                         await ws.send_json({"type": "error",
-                                            "message": "name already connected"})
+                                            "message": "name unavailable"})
                         await ws.close()
                         break
                     gateway_id = candidate
@@ -111,15 +112,23 @@ class ReverseProxyHub:
         return ws
 
     async def _register(self, frame: dict[str, Any], user: str,
-                        reject_if_connected: bool = False) -> str | None:
+                        reject_if_connected: bool = False,
+                        is_admin: bool = False) -> str | None:
         name = frame.get("name") or f"reverse-{new_id()[:8]}"
         ts = now()
-        row = await self.ctx.db.fetchone("SELECT id FROM gateways WHERE name=?",
+        row = await self.ctx.db.fetchone("SELECT * FROM gateways WHERE name=?",
                                          (name,))
         if row:
             gateway_id = row["id"]
             if reject_if_connected and gateway_id in self._sockets:
                 return None  # a live tunnel already owns this name
+            # a name may only be re-bound if it is already a reverse gateway
+            # owned by this user (or an admin) — otherwise any gateways.create
+            # principal could hijack an existing forward gateway's tool traffic
+            if row["transport"] != "reverse":
+                return None
+            if not is_admin and row["owner_email"] not in (None, user):
+                return None
             await self.ctx.db.execute(
                 "UPDATE gateways SET reachable=1, state='active', transport='reverse',"
                 " updated_at=? WHERE id=?", (ts, gateway_id))

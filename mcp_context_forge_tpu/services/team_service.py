@@ -1,0 +1,129 @@
+"""Team management: CRUD, membership, invitations.
+
+Reference: `services/team_management_service.py` + invitation/join flows
+(~4k LoC across services/routers). Personal teams are created at user
+bootstrap (auth_service); this service covers shared teams.
+"""
+
+from __future__ import annotations
+
+import secrets
+from typing import Any
+
+from ..utils.ids import new_id, slugify
+from .base import AppContext, ConflictError, NotFoundError, ValidationFailure, now
+
+
+class TeamService:
+    def __init__(self, ctx: AppContext):
+        self.ctx = ctx
+
+    async def create_team(self, name: str, created_by: str,
+                          description: str = "",
+                          visibility: str = "private") -> dict[str, Any]:
+        slug = slugify(name)
+        existing = await self.ctx.db.fetchone("SELECT id FROM teams WHERE slug=?",
+                                              (slug,))
+        if existing:
+            raise ConflictError(f"Team {name!r} already exists")
+        team_id = new_id()
+        ts = now()
+        await self.ctx.db.execute(
+            "INSERT INTO teams (id, name, slug, description, is_personal,"
+            " visibility, created_by, created_at, updated_at)"
+            " VALUES (?,?,?,?,?,?,?,?,?)",
+            (team_id, name, slug, description, 0, visibility, created_by, ts, ts))
+        await self.ctx.db.execute(
+            "INSERT INTO team_members (team_id, user_email, role, joined_at)"
+            " VALUES (?,?,?,?)", (team_id, created_by, "owner", ts))
+        return await self.get_team(team_id)
+
+    async def get_team(self, team_id: str) -> dict[str, Any]:
+        row = await self.ctx.db.fetchone("SELECT * FROM teams WHERE id=?", (team_id,))
+        if not row:
+            raise NotFoundError(f"Team {team_id} not found")
+        members = await self.ctx.db.fetchall(
+            "SELECT user_email, role, joined_at FROM team_members WHERE team_id=?",
+            (team_id,))
+        return {**row, "members": members}
+
+    async def list_teams(self, user: str | None = None) -> list[dict[str, Any]]:
+        if user:
+            rows = await self.ctx.db.fetchall(
+                "SELECT t.* FROM teams t JOIN team_members m ON m.team_id=t.id"
+                " WHERE m.user_email=? ORDER BY t.name", (user,))
+        else:
+            rows = await self.ctx.db.fetchall("SELECT * FROM teams ORDER BY name")
+        return rows
+
+    async def delete_team(self, team_id: str, actor: str, is_admin: bool) -> None:
+        team = await self.get_team(team_id)
+        if team["is_personal"]:
+            raise ValidationFailure("Personal teams cannot be deleted")
+        if not is_admin and not await self._is_owner(team_id, actor):
+            raise ValidationFailure("Only team owners can delete a team")
+        await self.ctx.db.execute("DELETE FROM teams WHERE id=?", (team_id,))
+
+    async def _is_owner(self, team_id: str, user: str) -> bool:
+        row = await self.ctx.db.fetchone(
+            "SELECT role FROM team_members WHERE team_id=? AND user_email=?",
+            (team_id, user))
+        return bool(row and row["role"] == "owner")
+
+    async def add_member(self, team_id: str, actor: str, email: str,
+                         role: str = "member", is_admin: bool = False) -> None:
+        if not is_admin and not await self._is_owner(team_id, actor):
+            raise ValidationFailure("Only team owners can add members")
+        user = await self.ctx.db.fetchone("SELECT email FROM users WHERE email=?",
+                                          (email,))
+        if not user:
+            raise NotFoundError(f"User {email!r} not found")
+        await self.ctx.db.execute(
+            "INSERT OR REPLACE INTO team_members (team_id, user_email, role,"
+            " joined_at) VALUES (?,?,?,?)", (team_id, email, role, now()))
+
+    async def remove_member(self, team_id: str, actor: str, email: str,
+                            is_admin: bool = False) -> None:
+        if not is_admin and not await self._is_owner(team_id, actor) and actor != email:
+            raise ValidationFailure("Not allowed")
+        await self.ctx.db.execute(
+            "DELETE FROM team_members WHERE team_id=? AND user_email=?",
+            (team_id, email))
+
+    # ------------------------------------------------------------ invitations
+
+    async def invite(self, team_id: str, actor: str, email: str,
+                     role: str = "member", expires_hours: float = 72.0,
+                     is_admin: bool = False) -> dict[str, Any]:
+        if not is_admin and not await self._is_owner(team_id, actor):
+            raise ValidationFailure("Only team owners can invite")
+        await self.get_team(team_id)
+        token = secrets.token_urlsafe(24)
+        invitation_id = new_id()
+        await self.ctx.db.execute(
+            "INSERT INTO team_invitations (id, team_id, email, role, token,"
+            " invited_by, expires_at, created_at) VALUES (?,?,?,?,?,?,?,?)",
+            (invitation_id, team_id, email, role, token, actor,
+             now() + expires_hours * 3600, now()))
+        return {"id": invitation_id, "token": token, "team_id": team_id,
+                "email": email, "role": role}
+
+    async def accept_invitation(self, token: str, user: str) -> dict[str, Any]:
+        row = await self.ctx.db.fetchone(
+            "SELECT * FROM team_invitations WHERE token=?", (token,))
+        if not row:
+            raise NotFoundError("Invitation not found")
+        if row["accepted_at"]:
+            raise ValidationFailure("Invitation already used")
+        if row["expires_at"] < now():
+            raise ValidationFailure("Invitation expired")
+        if row["email"].lower() != user.lower():
+            raise ValidationFailure("Invitation was issued to a different email")
+        await self.ctx.db.execute(
+            "INSERT OR REPLACE INTO team_members (team_id, user_email, role,"
+            " joined_at) VALUES (?,?,?,?)",
+            (row["team_id"], user, row["role"], now()))
+        await self.ctx.db.execute(
+            "UPDATE team_invitations SET accepted_at=? WHERE id=?",
+            (now(), row["id"]))
+        return await self.get_team(row["team_id"])

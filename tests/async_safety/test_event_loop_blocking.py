@@ -1,0 +1,71 @@
+"""Async-safety validator (reference: tests/async/async_validator.py —
+detect blocking calls on the event loop). The gateway's request path must not
+run sqlite, g++, or other sync work on the loop thread."""
+
+import asyncio
+import time
+
+import aiohttp
+
+from tests.integration.test_gateway_app import BASIC, make_client
+
+AUTH = aiohttp.BasicAuth(*BASIC)
+
+
+async def test_request_path_does_not_block_loop():
+    """A heartbeat task must keep ticking (< 100ms gaps) while the gateway
+    serves a burst of requests — any sync DB/compile work on the loop would
+    stall it."""
+    gateway = await make_client()
+    try:
+        gaps = []
+
+        async def heartbeat():
+            last = time.monotonic()
+            while True:
+                await asyncio.sleep(0.01)
+                now = time.monotonic()
+                gaps.append(now - last)
+                last = now
+
+        task = asyncio.create_task(heartbeat())
+        # burst of mixed requests (DB reads + writes + auth)
+        for i in range(20):
+            await gateway.post("/tools", json={
+                "name": f"t{i}", "integration_type": "REST",
+                "url": "http://example.invalid/x"}, auth=AUTH)
+        await asyncio.gather(*[
+            gateway.get("/tools", auth=AUTH) for _ in range(50)])
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+        worst = max(gaps)
+        assert worst < 0.25, f"event loop stalled {worst * 1000:.0f} ms"
+    finally:
+        await gateway.close()
+
+
+async def test_db_facade_runs_off_loop():
+    """Database statements execute on the dedicated executor thread."""
+    import threading
+
+    from mcp_context_forge_tpu.db import Database, MIGRATIONS
+
+    db = Database(":memory:")
+    await db.connect()
+    await db.migrate(MIGRATIONS)
+    loop_thread = threading.get_ident()
+    seen = {}
+
+    original = db._execute_sync
+
+    def spy(sql, params):
+        seen["thread"] = threading.get_ident()
+        return original(sql, params)
+
+    db._execute_sync = spy
+    await db.execute("SELECT 1")
+    assert seen["thread"] != loop_thread
+    await db.close()

@@ -1,0 +1,65 @@
+"""Fuzzing (reference: tests/fuzz/test_jsonrpc_fuzz.py — hypothesis over the
+JSON-RPC layer): the parser and dispatcher must never crash, only reject."""
+
+import json
+
+from hypothesis import given, settings, strategies as st
+
+from mcp_context_forge_tpu import jsonrpc
+
+json_values = st.recursive(
+    st.none() | st.booleans() | st.integers() | st.floats(allow_nan=False)
+    | st.text(max_size=20),
+    lambda children: st.lists(children, max_size=4)
+    | st.dictionaries(st.text(max_size=10), children, max_size=4),
+    max_leaves=10)
+
+
+@given(payload=json_values)
+@settings(max_examples=200, deadline=None)
+def test_parse_never_crashes(payload):
+    try:
+        request = jsonrpc.RPCRequest.parse(payload)
+        assert isinstance(request.method, str) and request.method
+    except jsonrpc.JSONRPCError as exc:
+        assert exc.code in (jsonrpc.INVALID_REQUEST, jsonrpc.PARSE_ERROR)
+
+
+@given(raw=st.binary(max_size=200))
+@settings(max_examples=200, deadline=None)
+def test_parse_body_never_crashes(raw):
+    try:
+        jsonrpc.parse_body(raw)
+    except jsonrpc.JSONRPCError as exc:
+        assert exc.code in (jsonrpc.PARSE_ERROR, jsonrpc.CONTENT_TOO_LARGE)
+
+
+@given(method=st.text(max_size=30), params=json_values)
+@settings(max_examples=100, deadline=None)
+def test_wellformed_requests_roundtrip(method, params):
+    if not method:
+        return
+    payload = {"jsonrpc": "2.0", "method": method, "id": 1}
+    if isinstance(params, (dict, list)):
+        payload["params"] = params
+    request = jsonrpc.RPCRequest.parse(payload)
+    assert request.method == method
+    response = jsonrpc.result_response(request.id, {"ok": True})
+    assert json.loads(json.dumps(response))["id"] == 1
+
+
+@given(text=st.text(max_size=300))
+@settings(max_examples=150, deadline=None)
+def test_json_repair_never_crashes(text):
+    from mcp_context_forge_tpu.plugins.builtin.transformers import _repair_json
+    out = _repair_json(text)
+    if out is not None:
+        json.loads(out)  # repaired output must be valid JSON
+
+
+@given(text=st.text(max_size=400))
+@settings(max_examples=150, deadline=None)
+def test_masking_never_crashes_and_preserves_nonsecrets(text):
+    from mcp_context_forge_tpu.utils import masking
+    out = masking.mask_text(text)
+    assert isinstance(out, str)
