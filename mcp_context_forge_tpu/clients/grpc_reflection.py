@@ -1,0 +1,212 @@
+"""Minimal gRPC server-reflection client (no grpc_reflection dependency).
+
+Reference capability: `/root/reference/mcpgateway/translate_grpc.py` (gRPC→MCP
+via server reflection) + `services/grpc_service.py` (dynamic stubs). The
+image ships grpc + protobuf but not the ``grpc_reflection`` helper package,
+so the reflection wire messages (``grpc.reflection.v1alpha``) are declared
+here programmatically as a FileDescriptorProto and compiled with
+``message_factory`` — the same bytes on the wire, no codegen.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import grpc
+from google.protobuf import (
+    descriptor_pb2,
+    descriptor_pool,
+    json_format,
+    message_factory,
+)
+
+_REFLECTION_SERVICE = "grpc.reflection.v1alpha.ServerReflection"
+_METHOD = f"/{_REFLECTION_SERVICE}/ServerReflectionInfo"
+
+
+def _build_reflection_messages():
+    """Declare the subset of reflection.proto we use."""
+    pool = descriptor_pool.DescriptorPool()
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = "mcpforge/reflection.proto"
+    fdp.package = "grpc.reflection.v1alpha"
+    fdp.syntax = "proto3"
+
+    req = fdp.message_type.add()
+    req.name = "ServerReflectionRequest"
+    for num, fname in ((1, "host"), (3, "file_by_filename"),
+                       (4, "file_containing_symbol"), (7, "list_services")):
+        field = req.field.add()
+        field.name, field.number = fname, num
+        field.type = descriptor_pb2.FieldDescriptorProto.TYPE_STRING
+        field.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+        if fname != "host":
+            field.oneof_index = 0
+    req.oneof_decl.add().name = "message_request"
+
+    fdr = fdp.message_type.add()
+    fdr.name = "FileDescriptorResponse"
+    field = fdr.field.add()
+    field.name, field.number = "file_descriptor_proto", 1
+    field.type = descriptor_pb2.FieldDescriptorProto.TYPE_BYTES
+    field.label = descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED
+
+    svc = fdp.message_type.add()
+    svc.name = "ServiceResponse"
+    field = svc.field.add()
+    field.name, field.number = "name", 1
+    field.type = descriptor_pb2.FieldDescriptorProto.TYPE_STRING
+    field.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+
+    lsr = fdp.message_type.add()
+    lsr.name = "ListServiceResponse"
+    field = lsr.field.add()
+    field.name, field.number = "service", 1
+    field.type = descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE
+    field.type_name = ".grpc.reflection.v1alpha.ServiceResponse"
+    field.label = descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED
+
+    resp = fdp.message_type.add()
+    resp.name = "ServerReflectionResponse"
+    for num, fname, tname in (
+            (4, "file_descriptor_response", ".grpc.reflection.v1alpha.FileDescriptorResponse"),
+            (6, "list_services_response", ".grpc.reflection.v1alpha.ListServiceResponse")):
+        field = resp.field.add()
+        field.name, field.number = fname, num
+        field.type = descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE
+        field.type_name = tname
+        field.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+        field.oneof_index = 0
+    resp.oneof_decl.add().name = "message_response"
+
+    fd = pool.Add(fdp)
+    classes = message_factory.GetMessages([fdp], pool=pool)
+    prefix = "grpc.reflection.v1alpha."
+    return (classes[prefix + "ServerReflectionRequest"],
+            classes[prefix + "ServerReflectionResponse"])
+
+
+_ReqClass, _RespClass = _build_reflection_messages()
+
+
+class GrpcReflectionClient:
+    """Discover + dynamically invoke methods on a reflective gRPC server."""
+
+    def __init__(self, target: str):
+        self.target = target
+        self._pool = descriptor_pool.DescriptorPool()
+        self._known_files: set[str] = set()
+        self._channel: Any = None
+
+    def _get_channel(self):
+        # one persistent channel per target: reflection + every invocation
+        # reuse the HTTP/2 connection instead of handshaking per call
+        if self._channel is None:
+            self._channel = grpc.aio.insecure_channel(self.target)
+        return self._channel
+
+    async def close(self) -> None:
+        if self._channel is not None:
+            await self._channel.close()
+            self._channel = None
+
+    async def _reflect(self, **request_fields) -> Any:
+        channel = self._get_channel()
+        call = channel.stream_stream(
+            _METHOD,
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=_RespClass.FromString)
+        request = _ReqClass(**request_fields)
+
+        async def requests():
+            yield request
+
+        stream = call(requests())
+        async for response in stream:
+            return response
+        return None
+
+    async def list_services(self) -> list[str]:
+        response = await self._reflect(list_services="")
+        if response is None:
+            return []
+        return [s.name for s in response.list_services_response.service
+                if s.name != _REFLECTION_SERVICE]
+
+    async def _load_symbol(self, symbol: str) -> None:
+        response = await self._reflect(file_containing_symbol=symbol)
+        if response is None:
+            return
+        pending = []
+        for raw in response.file_descriptor_response.file_descriptor_proto:
+            fdp = descriptor_pb2.FileDescriptorProto.FromString(raw)
+            if fdp.name not in self._known_files:
+                pending.append(fdp)
+        # files may arrive dependent-first: add until fixpoint so imports
+        # resolve regardless of wire order
+        while pending:
+            progressed = False
+            remaining = []
+            for fdp in pending:
+                try:
+                    self._pool.Add(fdp)
+                    self._known_files.add(fdp.name)
+                    progressed = True
+                except Exception:
+                    remaining.append(fdp)
+            pending = remaining
+            if not progressed:
+                break  # genuine duplicates/conflicts: pool keeps first copy
+
+    async def describe_service(self, service: str) -> list[dict[str, Any]]:
+        """-> [{name, full_method, input_schema}] for unary-unary methods."""
+        await self._load_symbol(service)
+        descriptor = self._pool.FindServiceByName(service)
+        methods = []
+        for method in descriptor.methods:
+            if method.client_streaming or method.server_streaming:
+                continue  # tools are request/response; streaming RPCs skipped
+            methods.append({
+                "name": method.name,
+                "full_method": f"/{service}/{method.name}",
+                "input_type": method.input_type.full_name,
+                "output_type": method.output_type.full_name,
+                "input_schema": _message_schema(method.input_type),
+            })
+        return methods
+
+    async def invoke(self, service: str, method_name: str,
+                     arguments: dict[str, Any], timeout: float = 30.0
+                     ) -> dict[str, Any]:
+        await self._load_symbol(service)
+        descriptor = self._pool.FindServiceByName(service)
+        method = descriptor.FindMethodByName(method_name)
+        if method is None:
+            raise ValueError(f"Method {method_name!r} not found on {service}")
+        input_cls = message_factory.GetMessageClass(method.input_type)
+        output_cls = message_factory.GetMessageClass(method.output_type)
+        request = json_format.ParseDict(arguments, input_cls(),
+                                        ignore_unknown_fields=True)
+        call = self._get_channel().unary_unary(
+            f"/{service}/{method_name}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=output_cls.FromString)
+        response = await call(request, timeout=timeout)
+        return json_format.MessageToDict(response,
+                                         preserving_proto_field_name=True)
+
+
+def _message_schema(descriptor) -> dict[str, Any]:
+    """Rough JSON schema from a protobuf message descriptor (1 level deep)."""
+    TYPES = {1: "number", 2: "number", 3: "integer", 4: "integer", 5: "integer",
+             8: "boolean", 9: "string", 12: "string", 13: "integer"}
+    properties = {}
+    for field in descriptor.fields:
+        if field.type == 11:  # message
+            schema: dict[str, Any] = {"type": "object"}
+        else:
+            schema = {"type": TYPES.get(field.type, "string")}
+        if field.label == 3:  # repeated
+            schema = {"type": "array", "items": schema}
+        properties[field.name] = schema
+    return {"type": "object", "properties": properties}

@@ -110,6 +110,10 @@ class Settings(BaseModel):
     tpu_local_dtype: str = "bfloat16"
     tpu_local_embedding_model: str = "encoder-tiny"
 
+    # --- audit / SIEM ---
+    siem_export_url: str = ""  # OpenSearch-compatible endpoint; '' = disabled
+    audit_enabled: bool = True
+
     # --- admin / UI ---
     admin_api_enabled: bool = True
     admin_ui_enabled: bool = True

@@ -165,6 +165,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     from .routers_extra import setup_extra_routes
     setup_extra_routes(app)
 
+    from ..services.audit_service import AuditService
+    from ..services.cancellation_service import CancellationService
     from ..services.catalog_service import CatalogService
     from ..services.chat_service import ChatService
     from ..services.metrics_service import MetricsMaintenanceService
@@ -172,6 +174,45 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     app["chat_service"] = ChatService(ctx, tool_service, server_service)
     app["team_service"] = TeamService(ctx)
     app["catalog_service"] = CatalogService(ctx)
+    audit_service = AuditService(ctx, siem_url=settings.siem_export_url)
+    if settings.audit_enabled:
+        app["audit_service"] = audit_service
+    cancellation_service = CancellationService(ctx)
+    ctx.extras["cancellation_service"] = cancellation_service
+    app["cancellation_service"] = cancellation_service
+    from ..services.grpc_service import GrpcService
+    grpc_service = GrpcService(ctx, tool_service)
+    ctx.extras["grpc_service"] = grpc_service
+    app["grpc_service"] = grpc_service
+
+    async def register_grpc(request: web.Request) -> web.Response:
+        request["auth"].require("tools.create")
+        body = await request.json()
+        try:
+            created = await grpc_service.register_target(
+                body.get("target", ""), prefix=body.get("prefix", ""))
+        except Exception as exc:
+            return web.json_response(
+                {"detail": f"gRPC discovery failed: {type(exc).__name__}"},
+                status=502)
+        return web.json_response({"registered": created}, status=201)
+
+    app.router.add_post("/grpc/register", register_grpc)
+    if engine is not None:
+        ctx.extras["tpu_engine"] = engine
+
+    async def admin_audit(request: web.Request) -> web.Response:
+        request["auth"].require("observability.read")
+        raw_limit = request.query.get("limit", "200")
+        if not raw_limit.isdigit():
+            return web.json_response({"detail": "limit must be an integer"},
+                                     status=400)
+        return web.json_response(await audit_service.search(
+            actor=request.query.get("actor"),
+            action=request.query.get("action"),
+            limit=min(int(raw_limit), 1000)))
+
+    app.router.add_get("/admin/audit", admin_audit)
     metrics_maintenance = MetricsMaintenanceService(
         ctx, rollup_interval=settings.metrics_buffer_flush_interval * 60)
     app["metrics_maintenance"] = metrics_maintenance
@@ -206,8 +247,10 @@ async def build_app(settings: Settings | None = None) -> web.Application:
                 app["chat_service"].sweep(ttl=settings.session_ttl)
 
         chat_sweeper = _asyncio.create_task(_chat_sweeper())
+        await audit_service.start()
         logger.info("%s started (worker %s)", settings.app_name, ctx.worker_id)
         yield
+        await audit_service.stop()
         chat_sweeper.cancel()
         try:
             await chat_sweeper
@@ -220,6 +263,7 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         if ctx.llm_registry is not None:
             await ctx.llm_registry.shutdown()
         await upstream_sessions.stop()
+        await grpc_service.shutdown()
         await ctx.close_http_client()
         await bus.stop()
         await db.close()

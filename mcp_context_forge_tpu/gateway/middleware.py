@@ -168,6 +168,16 @@ async def request_logging_middleware(request: web.Request, handler: Handler
     if logger.isEnabledFor(10):
         logger.debug("resp %s %s -> %s", request.method, request.path,
                      response.status)
+    # audit trail: record successful mutations (reference AuditTrail)
+    audit = request.app.get("audit_service")
+    if (audit is not None and request.method in ("POST", "PUT", "DELETE")
+            and 200 <= response.status < 300
+            and not request.path.startswith(("/rpc", "/mcp", "/messages",
+                                             "/v1/", "/llmchat"))):
+        auth = request.get("auth")
+        await audit.record(auth.user if auth else None,
+                           f"{request.method} {request.path}",
+                           details={"status": response.status})
     return response
 
 

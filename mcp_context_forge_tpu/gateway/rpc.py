@@ -62,7 +62,8 @@ class RPCDispatcher:
         with self.ctx.tracer.span(f"rpc.{method}", {"rpc.method": method,
                                                     "user": auth.user}):
             try:
-                result = await self._route(method, params, auth, headers, effective_server)
+                result = await self._route(method, params, auth, headers,
+                                           effective_server, rpc_id=request.id)
             except JSONRPCError:
                 raise
             except NotFoundError as exc:
@@ -77,7 +78,8 @@ class RPCDispatcher:
         return result_response(request.id, result)
 
     async def _route(self, method: str, params: dict[str, Any], auth: AuthContext,
-                     headers: dict[str, str], server_id: str | None) -> Any:
+                     headers: dict[str, str], server_id: str | None,
+                     rpc_id: Any = None) -> Any:
         if method == "initialize":
             return await self._initialize(params)
         if method == "ping":
@@ -105,9 +107,27 @@ class RPCDispatcher:
                 if name not in allowed:
                     raise JSONRPCError(INVALID_PARAMS,
                                        f"Tool {name!r} not in server scope")
-            return await self.tools.invoke_tool(
+            import asyncio as _asyncio
+            run = _asyncio.ensure_future(self.tools.invoke_tool(
                 name, params.get("arguments", {}) or {}, request_headers=headers,
-                user=auth.user)
+                user=auth.user))
+            cancellation = self.ctx.extras.get("cancellation_service")
+            if cancellation is not None:
+                # MCP notifications/cancelled carries the JSON-RPC request id;
+                # _meta.requestId / x-request-id are extra aliases
+                for key in (rpc_id, (params.get("_meta") or {}).get("requestId"),
+                            headers.get("x-request-id")):
+                    if key is not None:
+                        cancellation.register(key, run)
+            try:
+                return await run
+            except _asyncio.CancelledError:
+                if run.cancelled():
+                    raise JSONRPCError(-32800, "Request cancelled") from None
+                # the HANDLER was cancelled (client disconnect/shutdown):
+                # propagate, and don't leak the still-running tool task
+                run.cancel()
+                raise
         if method == "resources/list":
             auth.require("resources.read")
             resources = await self.resources.list_resources()

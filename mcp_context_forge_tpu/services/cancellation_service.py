@@ -1,0 +1,41 @@
+"""Run cancellation registry (reference: cancellation_service.py + the
+registry in main.py:10434-10460): ``notifications/cancelled`` aborts the
+matching in-flight tools/call; the tpu_local engine additionally aborts the
+matching generation request."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from .base import AppContext
+
+
+class CancellationService:
+    def __init__(self, ctx: AppContext):
+        self.ctx = ctx
+        self._runs: dict[Any, asyncio.Task] = {}
+
+    def register(self, request_id: Any, task: asyncio.Task) -> None:
+        if request_id is not None:
+            self._runs[request_id] = task
+            task.add_done_callback(lambda _: self._runs.pop(request_id, None))
+
+    async def cancel(self, request_id: Any) -> bool:
+        task = self._runs.pop(request_id, None)
+        if task is not None and not task.done():
+            task.cancel()
+            return True
+        # engine-side: cancel a generation whose request_id matches
+        engine = self.ctx.extras.get("tpu_engine")
+        if engine is not None:
+            for request in list(engine._running.values()):
+                if request.request_id == request_id:
+                    request.finish_reason = "cancelled"
+                    await engine._finish(request)
+                    return True
+        return False
+
+    @property
+    def active_runs(self) -> int:
+        return len(self._runs)

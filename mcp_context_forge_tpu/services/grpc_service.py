@@ -1,0 +1,85 @@
+"""gRPC → MCP translation service.
+
+Reference: `services/grpc_service.py` (GrpcService :137, dynamic stubs) +
+`translate_grpc.py` (reflection discovery). Registering a target discovers
+its services/methods over server reflection and exposes each unary method as
+a GRPC-typed tool; tools/call marshals JSON↔protobuf via the dynamic pool.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..clients.grpc_reflection import GrpcReflectionClient
+from ..db.core import to_json
+from ..schemas import ToolCreate
+from .base import AppContext, NotFoundError
+
+
+class GrpcService:
+    def __init__(self, ctx: AppContext, tool_service):
+        self.ctx = ctx
+        self.tools = tool_service
+        self._clients: dict[str, GrpcReflectionClient] = {}
+
+    def _client(self, target: str) -> GrpcReflectionClient:
+        if target not in self._clients:
+            self._clients[target] = GrpcReflectionClient(target)
+        return self._clients[target]
+
+    async def shutdown(self) -> None:
+        for client in self._clients.values():
+            try:
+                await client.close()
+            except Exception:
+                pass
+        self._clients.clear()
+
+    async def register_target(self, target: str,
+                              prefix: str = "") -> list[dict[str, Any]]:
+        """Discover + register every unary method as a tool. Returns the
+        created tool descriptions."""
+        from .base import ConflictError
+
+        client = self._client(target)
+        services = await client.list_services()
+        created: list[dict[str, Any]] = []
+        errors: list[str] = []
+        for service in services:
+            for method in await client.describe_service(service):
+                tool_name = f"{prefix or service.split('.')[-1].lower()}-" \
+                            f"{method['name'].lower()}"
+                annotations = {"grpc_target": target, "grpc_service": service,
+                               "grpc_method": method["name"]}
+                try:
+                    tool = await self.tools.register_tool(ToolCreate(
+                        name=tool_name, integration_type="GRPC",
+                        description=f"gRPC {service}/{method['name']} @ {target}",
+                        input_schema=method["input_schema"],
+                        annotations=annotations))
+                    created.append({"tool": tool.name, "method": method["full_method"]})
+                except ConflictError:
+                    created.append({"tool": tool_name,
+                                    "method": method["full_method"],
+                                    "existing": True})
+                except Exception as exc:  # real failures must be visible
+                    errors.append(f"{method['full_method']}: {type(exc).__name__}")
+        if not services:
+            raise NotFoundError(f"No reflective services found at {target}")
+        result = created
+        if errors:
+            result = created + [{"error": e} for e in errors]
+        return result
+
+    async def invoke(self, annotations: dict[str, Any],
+                     arguments: dict[str, Any]) -> dict[str, Any]:
+        target = annotations.get("grpc_target", "")
+        service = annotations.get("grpc_service", "")
+        method = annotations.get("grpc_method", "")
+        if not (target and service and method):
+            raise NotFoundError("Tool is missing grpc_* annotations")
+        client = self._client(target)
+        result = await client.invoke(service, method, arguments,
+                                     timeout=self.ctx.settings.tool_timeout)
+        return {"content": [{"type": "text", "text": to_json(result)}],
+                "structuredContent": result, "isError": False}

@@ -265,6 +265,12 @@ class ToolService:
             agent_name = from_json(row["annotations"], {}).get("a2a_agent") or row["original_name"]
             reply = await a2a.invoke_agent(agent_name, {"message": arguments})
             return _text_result(json.dumps(reply) if not isinstance(reply, str) else reply)
+        if integration == "GRPC":
+            grpc_service = self.ctx.extras.get("grpc_service")
+            if grpc_service is None:
+                raise JSONRPCError(INTERNAL_ERROR, "gRPC service not initialized")
+            return await grpc_service.invoke(from_json(row["annotations"], {}),
+                                             arguments)
         raise JSONRPCError(INVALID_PARAMS, f"Unsupported integration type {integration}")
 
     # REST branch (reference tool_service.py:6196+)

@@ -1,0 +1,69 @@
+"""Audit trail recording + run cancellation."""
+
+import asyncio
+
+import aiohttp
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from tests.integration.test_gateway_app import BASIC, make_client
+
+AUTH = aiohttp.BasicAuth(*BASIC)
+
+
+async def test_admin_mutations_audited():
+    gateway = await make_client()
+    try:
+        await gateway.post("/tools", json={
+            "name": "audited", "integration_type": "REST",
+            "url": "http://example.invalid/x"}, auth=AUTH)
+        await asyncio.sleep(0.05)
+        resp = await gateway.get("/admin/audit", auth=AUTH)
+        entries = await resp.json()
+        assert any(e["action"] == "POST /tools" for e in entries)
+        assert entries[0]["actor"] == "admin@example.com"
+        # filter by action
+        resp = await gateway.get("/admin/audit?action=POST", auth=AUTH)
+        assert all(e["action"].startswith("POST") for e in await resp.json())
+    finally:
+        await gateway.close()
+
+
+async def test_cancellation_aborts_inflight_tool_call():
+    gateway = await make_client()
+    slow = web.Application()
+    started = asyncio.Event()
+
+    async def slow_handler(request: web.Request) -> web.Response:
+        started.set()
+        await asyncio.sleep(30)
+        return web.json_response({"late": True})
+
+    slow.router.add_post("/slow", slow_handler)
+    upstream = TestClient(TestServer(slow))
+    await upstream.start_server()
+    try:
+        url = f"http://{upstream.server.host}:{upstream.server.port}/slow"
+        await gateway.post("/tools", json={
+            "name": "slow", "integration_type": "REST", "url": url,
+        }, auth=AUTH)
+
+        async def call():
+            resp = await gateway.post("/rpc", json={
+                "jsonrpc": "2.0", "id": 1, "method": "tools/call",
+                "params": {"name": "slow", "arguments": {}}},
+                auth=AUTH, headers={"x-request-id": "run-1"})
+            return await resp.json()
+
+        task = asyncio.ensure_future(call())
+        await asyncio.wait_for(started.wait(), timeout=10)
+        # cancel via notification
+        resp = await gateway.post("/rpc", json={
+            "jsonrpc": "2.0", "method": "notifications/cancelled",
+            "params": {"requestId": "run-1"}}, auth=AUTH)
+        assert resp.status == 202
+        payload = await asyncio.wait_for(task, timeout=10)
+        assert payload["error"]["code"] == -32800  # cancelled, not 30s timeout
+    finally:
+        await upstream.close()
+        await gateway.close()

@@ -1,0 +1,121 @@
+"""gRPC→MCP translation against a real in-process reflective gRPC server.
+
+The test server implements the reflection protocol with the same
+programmatically-declared messages the client uses — no grpc_reflection
+package on either side.
+"""
+
+import grpc
+import pytest
+from google.protobuf import descriptor_pb2
+
+import mcp_context_forge_tpu.clients.grpc_reflection as refl
+
+
+def _calc_fdp() -> descriptor_pb2.FileDescriptorProto:
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = "calc.proto"
+    fdp.package = "test"
+    fdp.syntax = "proto3"
+    req = fdp.message_type.add()
+    req.name = "AddRequest"
+    for i, fname in enumerate(("a", "b"), start=1):
+        field = req.field.add()
+        field.name, field.number = fname, i
+        field.type = descriptor_pb2.FieldDescriptorProto.TYPE_INT32
+        field.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+    rep = fdp.message_type.add()
+    rep.name = "AddReply"
+    field = rep.field.add()
+    field.name, field.number = "sum", 1
+    field.type = descriptor_pb2.FieldDescriptorProto.TYPE_INT32
+    field.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+    service = fdp.service.add()
+    service.name = "Calc"
+    method = service.method.add()
+    method.name = "Add"
+    method.input_type = ".test.AddRequest"
+    method.output_type = ".test.AddReply"
+    return fdp
+
+
+async def _start_server():
+    from google.protobuf import descriptor_pool, message_factory
+
+    fdp = _calc_fdp()
+    pool = descriptor_pool.DescriptorPool()
+    pool.Add(fdp)
+    classes = message_factory.GetMessages([fdp], pool=pool)
+    AddRequest, AddReply = classes["test.AddRequest"], classes["test.AddReply"]
+
+    async def add_handler(request, context):
+        return AddReply(sum=request.a + request.b)
+
+    async def reflection_handler(request_iterator, context):
+        async for request in request_iterator:
+            response = refl._RespClass()
+            which = request.WhichOneof("message_request")
+            if which == "list_services":
+                entry = response.list_services_response.service.add()
+                entry.name = "test.Calc"
+            else:  # file_containing_symbol / file_by_filename
+                response.file_descriptor_response.file_descriptor_proto.append(
+                    fdp.SerializeToString())
+            yield response
+
+    server = grpc.aio.server()
+    calc = grpc.method_handlers_generic_handler("test.Calc", {
+        "Add": grpc.unary_unary_rpc_method_handler(
+            add_handler,
+            request_deserializer=AddRequest.FromString,
+            response_serializer=lambda m: m.SerializeToString())})
+    reflection = grpc.method_handlers_generic_handler(
+        "grpc.reflection.v1alpha.ServerReflection", {
+            "ServerReflectionInfo": grpc.stream_stream_rpc_method_handler(
+                reflection_handler,
+                request_deserializer=refl._ReqClass.FromString,
+                response_serializer=lambda m: m.SerializeToString())})
+    server.add_generic_rpc_handlers((calc, reflection))
+    port = server.add_insecure_port("127.0.0.1:0")
+    await server.start()
+    return server, port
+
+
+async def test_reflection_discovery_and_invoke():
+    server, port = await _start_server()
+    try:
+        client = refl.GrpcReflectionClient(f"127.0.0.1:{port}")
+        services = await client.list_services()
+        assert services == ["test.Calc"]
+        methods = await client.describe_service("test.Calc")
+        assert methods[0]["name"] == "Add"
+        assert methods[0]["input_schema"]["properties"] == {
+            "a": {"type": "integer"}, "b": {"type": "integer"}}
+        result = await client.invoke("test.Calc", "Add", {"a": 20, "b": 22})
+        assert result == {"sum": 42}
+    finally:
+        await server.stop(None)
+
+
+async def test_grpc_tool_through_gateway():
+    from tests.integration.test_gateway_app import make_client
+    import aiohttp
+    server, port = await _start_server()
+    gateway = await make_client()
+    try:
+        auth = aiohttp.BasicAuth("admin", "changeme")
+        resp = await gateway.post("/grpc/register", json={
+            "target": f"127.0.0.1:{port}"}, auth=auth)
+        assert resp.status == 201, await resp.text()
+        registered = (await resp.json())["registered"]
+        assert registered[0]["tool"] == "calc-add"
+
+        resp = await gateway.post("/rpc", json={
+            "jsonrpc": "2.0", "id": 1, "method": "tools/call",
+            "params": {"name": "calc-add", "arguments": {"a": 3, "b": 4}}},
+            auth=auth)
+        payload = await resp.json()
+        assert payload["result"]["structuredContent"] == {"sum": 7}
+    finally:
+        await gateway.close()
+        await server.stop(None)
