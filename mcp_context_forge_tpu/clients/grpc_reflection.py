@@ -134,6 +134,11 @@ class GrpcReflectionClient:
                 if s.name != _REFLECTION_SERVICE]
 
     async def _load_symbol(self, symbol: str) -> None:
+        try:  # already in the pool: skip the reflection round trip
+            self._pool.FindServiceByName(symbol)
+            return
+        except KeyError:
+            pass
         response = await self._reflect(file_containing_symbol=symbol)
         if response is None:
             return

@@ -27,6 +27,12 @@ class LeaseManager(ABC):
     @abstractmethod
     async def release(self, name: str, owner: str) -> None: ...
 
+    async def force_release(self, name: str) -> None:
+        """Break a lease regardless of owner (dead-owner cleanup only)."""
+        holder = await self.holder(name)
+        if holder is not None:
+            await self.release(name, holder)
+
     @abstractmethod
     async def holder(self, name: str) -> str | None: ...
 

@@ -138,6 +138,30 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     app.router.add_get("/sse", sse_transport.handle_stream)
     app.router.add_post("/messages", sse_transport.handle_message)
 
+    # session affinity: forwarded requests run under the original caller's
+    # identity, reconstructed from the bus payload
+    from ..services.auth_service import AuthContext as _AuthCtx
+    from ..services.session_affinity import SessionAffinityService
+
+    async def _affinity_local_handler(message: dict, auth_info: dict):
+        from ..jsonrpc import JSONRPCError as _JE, RPCRequest as _RR
+        auth_ctx = _AuthCtx(user=auth_info.get("user", "anonymous"),
+                            is_admin=bool(auth_info.get("is_admin")),
+                            teams=list(auth_info.get("teams", [])),
+                            permissions=set(auth_info.get("permissions", [])),
+                            via="forwarded")
+        try:
+            return await dispatcher.dispatch(_RR.parse(message), auth_ctx,
+                                             headers=auth_info.get("headers", {}))
+        except _JE as exc:
+            return exc.to_dict(message.get("id") if isinstance(message, dict)
+                               else None)
+
+    affinity = SessionAffinityService(ctx, local_handler=_affinity_local_handler)
+    ctx.extras["session_affinity"] = affinity
+    app["session_affinity"] = affinity
+    transport.affinity = affinity
+
     from ..services.reverse_proxy import ReverseProxyHub
     reverse_hub = ReverseProxyHub(ctx)
     ctx.extras["reverse_proxy_hub"] = reverse_hub
@@ -234,6 +258,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         if engine is not None:
             await engine.start()
         await llm_provider_service.rewire()  # external providers from DB
+        if ctx.plugin_manager is not None:
+            await ctx.plugin_manager.load_bindings()
         elector = LeaderElector(leases, "gateway-leader", ctx.worker_id,
                                 ttl=settings.leader_lease_ttl)
         ctx.extras["leader_elector"] = elector
@@ -247,10 +273,12 @@ async def build_app(settings: Settings | None = None) -> web.Application:
                 app["chat_service"].sweep(ttl=settings.session_ttl)
 
         chat_sweeper = _asyncio.create_task(_chat_sweeper())
+        await affinity.start()
         await audit_service.start()
         logger.info("%s started (worker %s)", settings.app_name, ctx.worker_id)
         yield
         await audit_service.stop()
+        await affinity.stop()
         chat_sweeper.cancel()
         try:
             await chat_sweeper

@@ -94,6 +94,78 @@ def setup_extra_routes(app: web.Application) -> None:
             supports_embeddings=bool(body.get("supports_embeddings", False)))
         return web.json_response(model, status=201)
 
+    # ---------------------------------------------------------------- plugins
+    @routes.get("/plugins")
+    async def list_plugins(request: web.Request) -> web.Response:
+        request["auth"].require("plugins.manage")
+        pm = request.app.get("plugin_manager")
+        if pm is None:
+            return web.json_response([])
+        return web.json_response([{
+            "name": p.config.name, "kind": p.config.kind,
+            "mode": p.config.mode.value, "priority": p.config.priority,
+            "tools": p.config.tools,
+        } for p in pm.plugins])
+
+    @routes.post("/plugins/{name}/mode")
+    async def set_plugin_mode(request: web.Request) -> web.Response:
+        request["auth"].require("plugins.manage")
+        body = await request.json()
+        ctx = request.app["ctx"]
+        name = request.match_info["name"]
+        mode = body.get("mode", "enforce")
+        # binding-backed plugins persist the mode so load_bindings()/restart
+        # cannot silently revert a runtime disable
+        if name.startswith("binding:"):
+            await ctx.db.execute("UPDATE plugin_bindings SET mode=? WHERE id=?",
+                                 (mode, name.split(":", 1)[1]))
+        # runtime enable/disable propagates to every worker over the bus
+        await ctx.bus.publish("plugins.control", {"name": name, "mode": mode})
+        return web.Response(status=204)
+
+    @routes.post("/plugins/bindings")
+    async def create_binding(request: web.Request) -> web.Response:
+        request["auth"].require("plugins.manage")
+        body = await request.json()
+        ctx = request.app["ctx"]
+        from ..db.core import to_json as _to_json
+        from ..services.base import now as _now
+        from ..utils.ids import new_id as _new_id
+        binding_id = _new_id()
+        await ctx.db.execute(
+            "INSERT INTO plugin_bindings (id, plugin_name, scope_type, scope_id,"
+            " mode, config, enabled, created_at) VALUES (?,?,?,?,?,?,?,?)",
+            (binding_id, body.get("plugin_name", ""),
+             body.get("scope_type", "tool"), body.get("scope_id"),
+             body.get("mode", "enforce"),
+             _to_json(body.get("config", {})), 1, _now()))
+        # broadcast so every worker reloads, not just this one
+        await ctx.bus.publish("plugins.bindings.changed", {"id": binding_id})
+        pm = request.app.get("plugin_manager")
+        if pm is not None:
+            await pm.load_bindings()
+        return web.json_response({"id": binding_id}, status=201)
+
+    @routes.get("/plugins/bindings")
+    async def list_bindings(request: web.Request) -> web.Response:
+        request["auth"].require("plugins.manage")
+        rows = await request.app["ctx"].db.fetchall(
+            "SELECT * FROM plugin_bindings ORDER BY created_at")
+        return web.json_response(rows)
+
+    @routes.delete("/plugins/bindings/{binding_id}")
+    async def delete_binding(request: web.Request) -> web.Response:
+        request["auth"].require("plugins.manage")
+        await request.app["ctx"].db.execute(
+            "DELETE FROM plugin_bindings WHERE id=?",
+            (request.match_info["binding_id"],))
+        await request.app["ctx"].bus.publish("plugins.bindings.changed",
+                                             {"id": request.match_info["binding_id"]})
+        pm = request.app.get("plugin_manager")
+        if pm is not None:
+            await pm.load_bindings()
+        return web.Response(status=204)
+
     # ---------------------------------------------------------- export/import
     @routes.get("/export")
     async def export_config(request: web.Request) -> web.Response:

@@ -56,7 +56,7 @@ class RPCDispatcher:
         effective_server = server_id or auth.server_id
 
         if request.is_notification or method_registry.is_notification(method):
-            await self._handle_notification(method, params)
+            await self._handle_notification(method, params, auth)
             return None
 
         with self.ctx.tracer.span(f"rpc.{method}", {"rpc.method": method,
@@ -113,12 +113,13 @@ class RPCDispatcher:
                 user=auth.user))
             cancellation = self.ctx.extras.get("cancellation_service")
             if cancellation is not None:
-                # MCP notifications/cancelled carries the JSON-RPC request id;
-                # _meta.requestId / x-request-id are extra aliases
+                # keys are scoped by user: raw JSON-RPC ids collide across
+                # clients (everyone uses id=1) and an unscoped key would let
+                # one user cancel another's run
                 for key in (rpc_id, (params.get("_meta") or {}).get("requestId"),
                             headers.get("x-request-id")):
                     if key is not None:
-                        cancellation.register(key, run)
+                        cancellation.register(f"{auth.user}:{key}", run)
             try:
                 return await run
             except _asyncio.CancelledError:
@@ -217,13 +218,15 @@ class RPCDispatcher:
             "serverInfo": {"name": self.ctx.settings.app_name, "version": "0.1.0"},
         }
 
-    async def _handle_notification(self, method: str, params: dict[str, Any]) -> None:
+    async def _handle_notification(self, method: str, params: dict[str, Any],
+                                   auth: AuthContext | None = None) -> None:
         if method == "notifications/initialized":
             return
         if method == "notifications/cancelled":
             cancellation = self.ctx.extras.get("cancellation_service")
-            if cancellation is not None:
-                await cancellation.cancel(params.get("requestId"))
+            if cancellation is not None and params.get("requestId") is not None:
+                user = auth.user if auth is not None else "anonymous"
+                await cancellation.cancel(f"{user}:{params.get('requestId')}")
             return
         # progress/message notifications are accepted and dropped at the edge
         return

@@ -148,6 +148,7 @@ class StreamableHTTPTransport:
         self.dispatcher = dispatcher
         self.settings = settings
         self.sessions = session_manager or SessionManager(ttl=settings.session_ttl)
+        self.affinity = None  # SessionAffinityService (multi-worker), set by app
 
     # ------------------------------------------------------------------ POST
 
@@ -177,11 +178,44 @@ class StreamableHTTPTransport:
                 isinstance(m, dict) and m.get("method") == "initialize" for m in messages)
             if session_id:
                 session = self.sessions.get(session_id)
+                if session is not None and self.affinity is not None:
+                    # sliding ownership: renew the owner lease on activity so
+                    # it tracks the local session's sliding TTL
+                    await self.affinity.claim_session(session_id)
                 if session is None:
+                    # another worker may own it (ADR-052): forward over the bus
+                    if self.affinity is not None and not \
+                            await self.affinity.is_local(session_id):
+                        replies = []
+                        forwarded = True
+                        auth_info = {"user": auth.user, "is_admin": auth.is_admin,
+                                     "teams": auth.teams,
+                                     "permissions": sorted(auth.permissions),
+                                     "headers": {"mcp-session-id": session_id}}
+                        for message in messages:
+                            reply = await self.affinity.forward(
+                                session_id, message, auth_info=auth_info)
+                            if reply is None and not (
+                                    isinstance(message, dict)
+                                    and "id" not in message):
+                                # owner died mid-claim: no one can answer this
+                                # request — 404 so the client re-initializes
+                                forwarded = False
+                                break
+                            if reply is not None:
+                                replies.append(reply)
+                        if forwarded:
+                            if not replies:
+                                return web.Response(status=202)
+                            return web.json_response(
+                                replies if isinstance(payload, list) else replies[0],
+                                headers={"mcp-session-id": session_id})
                     return web.json_response(
                         error_response(None, INVALID_REQUEST, "Unknown session"), status=404)
             elif is_initialize:
                 session = self.sessions.create()
+                if self.affinity is not None:
+                    await self.affinity.claim_session(session.id)
             else:
                 return web.json_response(
                     error_response(None, INVALID_REQUEST, "Missing Mcp-Session-Id"),

@@ -202,6 +202,11 @@ class PluginManager:
                 await manager.add_plugin(config)
         if ctx.bus is not None:
             ctx.bus.subscribe("plugins.control", manager._on_control)
+
+            async def _on_bindings_changed(topic, message):
+                await manager.load_bindings()
+
+            ctx.bus.subscribe("plugins.bindings.changed", _on_bindings_changed)
         return manager
 
     async def add_plugin(self, config: PluginConfig) -> Plugin:
@@ -211,6 +216,49 @@ class PluginManager:
         self.plugins.append(plugin)
         self._reindex()
         return plugin
+
+    async def remove_plugin(self, name: str) -> bool:
+        for plugin in list(self.plugins):
+            if plugin.config.name == name:
+                self.plugins.remove(plugin)
+                try:
+                    await plugin.shutdown()
+                except Exception:
+                    pass
+                self._reindex()
+                return True
+        return False
+
+    async def load_bindings(self) -> int:
+        """(Re)load DB-backed plugin bindings (reference: per-tool/per-team
+        bindings, db.py:6856/6932 + tool_plugin_binding_service). A binding
+        instantiates a builtin under the name ``binding:<id>`` scoped to its
+        tool; team/global scopes apply unscoped."""
+        if self.ctx is None:
+            return 0
+        rows = await self.ctx.db.fetchall(
+            "SELECT * FROM plugin_bindings WHERE enabled=1")
+        # drop previously-loaded bindings, then re-add
+        for plugin in list(self.plugins):
+            if plugin.config.name.startswith("binding:"):
+                await self.remove_plugin(plugin.config.name)
+        import json as _json
+        count = 0
+        for row in rows:
+            try:
+                config = PluginConfig(
+                    name=f"binding:{row['id']}",
+                    kind=row["plugin_name"],
+                    mode=PluginMode(row["mode"] or "enforce"),
+                    tools=[row["scope_id"]] if row["scope_type"] == "tool"
+                          and row["scope_id"] else [],
+                    config=_json.loads(row["config"]) if row["config"] else {})
+                await self.add_plugin(config)
+                count += 1
+            except Exception as exc:
+                logger.warning("plugin binding %s failed to load: %s",
+                               row["id"], exc)
+        return count
 
     async def shutdown(self) -> None:
         for plugin in self.plugins:

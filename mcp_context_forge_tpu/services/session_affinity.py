@@ -1,0 +1,147 @@
+"""Multi-worker session affinity + cross-worker RPC forwarding.
+
+Reference: `services/session_affinity.py` (ADR-052 — Redis worker heartbeats,
+session-owner claims, RPC forwarding to the owning worker, wired at
+`main.py:1515-1572,11223`). In-tree over the coordination layer:
+
+- each worker heartbeats a lease ``worker:<id>``;
+- a stateful MCP session is claimed via lease ``session-owner:<sid>``;
+- a worker receiving a request for a session it does not own forwards the
+  JSON-RPC message over the event bus (``affinity.rpc`` topic) and awaits the
+  correlated reply (``affinity.rpc.reply``).
+
+With the memory bus this collapses to always-local (single process); the
+file bus exercises the real protocol across workers on one host — the same
+"multi-node without a cluster" testing shape the reference uses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Awaitable, Callable
+
+from ..utils.ids import new_id
+from .base import AppContext
+
+logger = logging.getLogger(__name__)
+
+HEARTBEAT_TTL = 15.0
+
+
+class SessionAffinityService:
+    def __init__(self, ctx: AppContext,
+                 local_handler: Callable[[dict[str, Any]], Awaitable[dict[str, Any] | None]] | None = None):
+        self.ctx = ctx
+        self.worker_id = ctx.worker_id
+        self.local_handler = local_handler  # executes a forwarded request locally
+        self._heartbeat_task: asyncio.Task | None = None
+        self._pending: dict[str, asyncio.Future] = {}
+        self._unsubs: list = []
+
+    async def start(self) -> None:
+        self._unsubs.append(self.ctx.bus.subscribe("affinity.rpc", self._on_rpc))
+        self._unsubs.append(self.ctx.bus.subscribe("affinity.rpc.reply",
+                                                   self._on_reply))
+        if self._heartbeat_task is None:
+            self._heartbeat_task = asyncio.create_task(self._heartbeat_loop())
+
+    async def stop(self) -> None:
+        for unsub in self._unsubs:
+            unsub()
+        self._unsubs.clear()
+        if self._heartbeat_task is not None:
+            self._heartbeat_task.cancel()
+            try:
+                await self._heartbeat_task
+            except asyncio.CancelledError:
+                pass
+            self._heartbeat_task = None
+        await self.ctx.leases.release(f"worker:{self.worker_id}", self.worker_id)
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            try:
+                await self.ctx.leases.acquire(f"worker:{self.worker_id}",
+                                              self.worker_id, HEARTBEAT_TTL)
+            except Exception:
+                pass
+            await asyncio.sleep(HEARTBEAT_TTL / 3)
+
+    # ------------------------------------------------------------- ownership
+
+    async def claim_session(self, session_id: str, ttl: float | None = None) -> bool:
+        """Claim (or renew) ownership of a stateful session."""
+        return await self.ctx.leases.acquire(
+            f"session-owner:{session_id}", self.worker_id,
+            ttl or self.ctx.settings.session_ttl)
+
+    async def release_session(self, session_id: str) -> None:
+        await self.ctx.leases.release(f"session-owner:{session_id}", self.worker_id)
+
+    async def owner_of(self, session_id: str) -> str | None:
+        return await self.ctx.leases.holder(f"session-owner:{session_id}")
+
+    async def is_local(self, session_id: str) -> bool:
+        owner = await self.owner_of(session_id)
+        return owner is None or owner == self.worker_id
+
+    # ------------------------------------------------------------ forwarding
+
+    async def forward(self, session_id: str, message: dict[str, Any],
+                      auth_info: dict[str, Any] | None = None,
+                      timeout: float = 30.0) -> dict[str, Any] | None:
+        """Send a JSON-RPC request to the owning worker; returns its reply.
+
+        The owner may have died: if its worker heartbeat lease is gone we
+        reclaim locally instead of forwarding into the void."""
+        owner = await self.owner_of(session_id)
+        if owner is None or owner == self.worker_id:
+            return None  # caller handles locally
+        alive = await self.ctx.leases.holder(f"worker:{owner}")
+        if alive != owner:
+            # dead owner: break its claim so this worker can take over
+            await self.ctx.leases.force_release(f"session-owner:{session_id}")
+            return None
+        corr = new_id()
+        future: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[corr] = future
+        try:
+            await self.ctx.bus.publish("affinity.rpc", {
+                "corr": corr, "to": owner, "from": self.worker_id,
+                "session_id": session_id, "message": message,
+                "auth": auth_info or {}})
+            return await asyncio.wait_for(future, timeout=timeout)
+        except asyncio.TimeoutError:
+            return {"jsonrpc": "2.0", "id": message.get("id"),
+                    "error": {"code": -32000,
+                              "message": "Owning worker did not respond"}}
+        finally:
+            self._pending.pop(corr, None)
+
+    async def _on_rpc(self, topic: str, payload: dict[str, Any]) -> None:
+        if payload.get("to") != self.worker_id:
+            return
+        if self.local_handler is None:
+            return
+
+        async def _run() -> None:
+            # spawned: a slow forwarded call must not head-of-line block the
+            # bus poll loop (which also delivers our own forward replies)
+            try:
+                reply = await self.local_handler(payload.get("message", {}),
+                                                 payload.get("auth", {}))
+            except Exception as exc:
+                reply = {"jsonrpc": "2.0",
+                         "id": payload.get("message", {}).get("id"),
+                         "error": {"code": -32603, "message": str(exc)}}
+            await self.ctx.bus.publish("affinity.rpc.reply", {
+                "corr": payload.get("corr"), "to": payload.get("from"),
+                "message": reply})
+
+        asyncio.get_running_loop().create_task(_run())
+
+    async def _on_reply(self, topic: str, payload: dict[str, Any]) -> None:
+        future = self._pending.get(payload.get("corr", ""))
+        if future is not None and not future.done():
+            future.set_result(payload.get("message"))

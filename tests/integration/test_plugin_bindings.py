@@ -1,0 +1,56 @@
+"""DB-backed plugin bindings + runtime mode control over the bus."""
+
+import aiohttp
+
+from tests.integration.test_gateway_app import BASIC, make_client
+
+AUTH = aiohttp.BasicAuth(*BASIC)
+
+
+async def test_binding_scopes_plugin_to_tool():
+    gateway = await make_client(plugins_enabled="true")
+    try:
+        # bind deny_filter to one tool only
+        resp = await gateway.post("/plugins/bindings", json={
+            "plugin_name": "deny_filter", "scope_type": "tool",
+            "scope_id": "guarded", "config": {"words": ["blocked"]}}, auth=AUTH)
+        assert resp.status == 201, await resp.text()
+
+        resp = await gateway.get("/plugins", auth=AUTH)
+        plugins = await resp.json()
+        assert any(p["name"].startswith("binding:") and p["tools"] == ["guarded"]
+                   for p in plugins)
+
+        for name in ("guarded", "open"):
+            await gateway.post("/tools", json={
+                "name": name, "integration_type": "REST",
+                "url": "http://example.invalid/x"}, auth=AUTH)
+
+        async def call(tool):
+            resp = await gateway.post("/rpc", json={
+                "jsonrpc": "2.0", "id": 1, "method": "tools/call",
+                "params": {"name": tool, "arguments": {"q": "blocked words"}}},
+                auth=AUTH)
+            return await resp.json()
+
+        guarded = await call("guarded")
+        assert "error" in guarded and "Denied word" in guarded["error"]["message"]
+        open_result = await call("open")  # unbound tool: plugin not applied
+        assert "result" in open_result  # fails on network, not on the plugin
+        assert open_result["result"]["isError"] is True  # dead upstream
+
+        # runtime disable via the bus -> guarded tool no longer blocked
+        binding = (await (await gateway.get("/plugins/bindings", auth=AUTH)).json())[0]
+        resp = await gateway.post(f"/plugins/binding:{binding['id']}/mode", json={
+            "mode": "disabled"}, auth=AUTH)
+        assert resp.status == 204
+        guarded2 = await call("guarded")
+        assert "result" in guarded2  # reaches the (dead) upstream now
+
+        # delete binding
+        resp = await gateway.delete(f"/plugins/bindings/{binding['id']}", auth=AUTH)
+        assert resp.status == 204
+        plugins = await (await gateway.get("/plugins", auth=AUTH)).json()
+        assert not any(p["name"].startswith("binding:") for p in plugins)
+    finally:
+        await gateway.close()

@@ -1,0 +1,84 @@
+"""2 workers on one host sharing a file bus: session affinity + RPC
+forwarding (the reference's test-primary-worker topology, SURVEY.md §4)."""
+
+import asyncio
+
+import aiohttp
+from aiohttp.test_utils import TestClient, TestServer
+
+from mcp_context_forge_tpu.config import load_settings
+from mcp_context_forge_tpu.gateway.app import build_app
+
+AUTH = aiohttp.BasicAuth("admin", "changeme")
+
+
+async def _worker(bus_dir: str, db_path: str) -> TestClient:
+    settings = load_settings(env={
+        "MCPFORGE_DATABASE_URL": f"sqlite:///{db_path}",
+        "MCPFORGE_PLUGINS_ENABLED": "false",
+        "MCPFORGE_TPU_LOCAL_ENABLED": "false",
+        "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
+        "MCPFORGE_BUS_BACKEND": "file",
+        "MCPFORGE_BUS_DIR": bus_dir,
+        "MCPFORGE_STREAMABLE_HTTP_STATEFUL": "true",
+    }, env_file=None)
+    app = await build_app(settings)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def test_cross_worker_session_forwarding(tmp_path):
+    bus_dir = str(tmp_path / "bus")
+    worker_a = await _worker(bus_dir, str(tmp_path / "a.db"))
+    worker_b = await _worker(bus_dir, str(tmp_path / "b.db"))
+    try:
+        # initialize on A -> A owns the session
+        resp = await worker_a.post("/mcp", json={
+            "jsonrpc": "2.0", "id": 1, "method": "initialize",
+            "params": {"protocolVersion": "2025-06-18", "capabilities": {},
+                       "clientInfo": {"name": "t", "version": "0"}}}, auth=AUTH)
+        assert resp.status == 200, await resp.text()
+        session_id = resp.headers["mcp-session-id"]
+
+        owner = await worker_a.app["session_affinity"].owner_of(session_id)
+        assert owner == worker_a.app["ctx"].worker_id
+
+        # same session hits B (load balancer misroute): forwarded to A
+        resp = await worker_b.post("/mcp", json={
+            "jsonrpc": "2.0", "id": 2, "method": "ping"},
+            headers={"mcp-session-id": session_id,
+                     "authorization": AUTH.encode()}, )
+        assert resp.status == 200, await resp.text()
+        payload = await resp.json()
+        assert payload == {"jsonrpc": "2.0", "id": 2, "result": {}}
+
+        # unknown session on B without any owner -> 404 (not a forward loop)
+        resp = await worker_b.post("/mcp", json={
+            "jsonrpc": "2.0", "id": 3, "method": "ping"},
+            headers={"mcp-session-id": "deadbeef" * 4,
+                     "authorization": AUTH.encode()})
+        assert resp.status == 404
+    finally:
+        await worker_a.close()
+        await worker_b.close()
+
+
+async def test_dead_owner_reclaim(tmp_path):
+    bus_dir = str(tmp_path / "bus")
+    worker_a = await _worker(bus_dir, str(tmp_path / "a.db"))
+    worker_b = await _worker(bus_dir, str(tmp_path / "b.db"))
+    try:
+        affinity_b = worker_b.app["session_affinity"]
+        # fabricate a session owned by a dead worker (no heartbeat lease)
+        await worker_b.app["ctx"].leases.acquire("session-owner:ghost", "dead-worker",
+                                                 ttl=3600)
+        assert not await affinity_b.is_local("ghost")
+        result = await affinity_b.forward("ghost", {"jsonrpc": "2.0", "id": 1,
+                                                    "method": "ping"})
+        # dead owner detected -> claim freed, caller told to handle locally
+        assert result is None
+        assert await affinity_b.owner_of("ghost") is None
+    finally:
+        await worker_a.close()
+        await worker_b.close()
