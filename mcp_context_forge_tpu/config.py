@@ -110,6 +110,9 @@ class Settings(BaseModel):
     tpu_local_dtype: str = "bfloat16"
     tpu_local_embedding_model: str = "encoder-tiny"
 
+    # --- SSO (JSON list: [{name, issuer, client_id, client_secret}]) ---
+    sso_providers: str = ""
+
     # --- audit / SIEM ---
     siem_export_url: str = ""  # OpenSearch-compatible endpoint; '' = disabled
     audit_enabled: bool = True

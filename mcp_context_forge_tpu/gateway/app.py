@@ -150,6 +150,12 @@ async def build_app(settings: Settings | None = None) -> web.Application:
                             teams=list(auth_info.get("teams", [])),
                             permissions=set(auth_info.get("permissions", [])),
                             via="forwarded")
+        # forwarded traffic keeps the owner's session + lease alive: an
+        # always-misrouted-but-active client must not expire mid-conversation
+        sid = auth_info.get("headers", {}).get("mcp-session-id")
+        if sid:
+            transport.sessions.get(sid)  # slides local last_seen
+            await affinity.claim_session(sid)
         try:
             return await dispatcher.dispatch(_RR.parse(message), auth_ctx,
                                              headers=auth_info.get("headers", {}))
@@ -204,6 +210,40 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     cancellation_service = CancellationService(ctx)
     ctx.extras["cancellation_service"] = cancellation_service
     app["cancellation_service"] = cancellation_service
+    from ..services.oauth_service import OAuthManager, SSOService
+    oauth_manager = OAuthManager(ctx)
+    ctx.extras["oauth_manager"] = oauth_manager
+    sso_service = SSOService(ctx, auth_service)
+    app["sso_service"] = sso_service
+    if settings.sso_providers:
+        import json as _json
+        for entry in _json.loads(settings.sso_providers):
+            sso_service.register_provider(
+                entry["name"], entry["issuer"], entry["client_id"],
+                entry.get("client_secret", ""),
+                authorization_endpoint=entry.get("authorization_endpoint", ""),
+                token_endpoint=entry.get("token_endpoint", ""))
+
+    async def sso_providers_route(request: web.Request) -> web.Response:
+        return web.json_response({"providers": sso_service.list_providers()})
+
+    async def sso_login(request: web.Request) -> web.Response:
+        name = request.match_info["provider"]
+        redirect_uri = f"{settings.app_domain}/auth/sso/{name}/callback"
+        raise web.HTTPFound(await sso_service.login_url(name, redirect_uri))
+
+    async def sso_callback(request: web.Request) -> web.Response:
+        name = request.match_info["provider"]
+        redirect_uri = f"{settings.app_domain}/auth/sso/{name}/callback"
+        result = await sso_service.handle_callback(
+            request.query.get("state", ""), request.query.get("code", ""),
+            redirect_uri)
+        return web.json_response(result)
+
+    app.router.add_get("/auth/sso/providers", sso_providers_route)
+    app.router.add_get("/auth/sso/{provider}/login", sso_login)
+    app.router.add_get("/auth/sso/{provider}/callback", sso_callback)
+
     from ..services.grpc_service import GrpcService
     grpc_service = GrpcService(ctx, tool_service)
     ctx.extras["grpc_service"] = grpc_service

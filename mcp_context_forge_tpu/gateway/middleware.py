@@ -122,7 +122,8 @@ async def auth_middleware(request: web.Request, handler: Handler) -> web.StreamR
     auth_service = request.app["auth_service"]
     settings = ctx.settings
 
-    if request.method == "OPTIONS" or request.path in PUBLIC_PATHS:
+    if (request.method == "OPTIONS" or request.path in PUBLIC_PATHS
+            or request.path.startswith("/auth/sso/")):
         request["auth"] = AuthContext(user="anonymous", via="anonymous")
         return await handler(request)
 

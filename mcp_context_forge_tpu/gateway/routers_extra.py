@@ -114,6 +114,10 @@ def setup_extra_routes(app: web.Application) -> None:
         ctx = request.app["ctx"]
         name = request.match_info["name"]
         mode = body.get("mode", "enforce")
+        from ..plugins.framework import PluginMode
+        if mode not in {m.value for m in PluginMode}:
+            raise ValidationFailure(
+                f"mode must be one of {sorted(m.value for m in PluginMode)}")
         # binding-backed plugins persist the mode so load_bindings()/restart
         # cannot silently revert a runtime disable
         if name.startswith("binding:"):
@@ -139,11 +143,8 @@ def setup_extra_routes(app: web.Application) -> None:
              body.get("scope_type", "tool"), body.get("scope_id"),
              body.get("mode", "enforce"),
              _to_json(body.get("config", {})), 1, _now()))
-        # broadcast so every worker reloads, not just this one
+        # broadcast reloads every worker (incl. this one via local delivery)
         await ctx.bus.publish("plugins.bindings.changed", {"id": binding_id})
-        pm = request.app.get("plugin_manager")
-        if pm is not None:
-            await pm.load_bindings()
         return web.json_response({"id": binding_id}, status=201)
 
     @routes.get("/plugins/bindings")
@@ -161,9 +162,6 @@ def setup_extra_routes(app: web.Application) -> None:
             (request.match_info["binding_id"],))
         await request.app["ctx"].bus.publish("plugins.bindings.changed",
                                              {"id": request.match_info["binding_id"]})
-        pm = request.app.get("plugin_manager")
-        if pm is not None:
-            await pm.load_bindings()
         return web.Response(status=204)
 
     # ---------------------------------------------------------- export/import

@@ -25,8 +25,9 @@ class GatewayCreate(_Entity):
     name: str
     url: str
     transport: Literal["streamablehttp", "sse"] = "streamablehttp"
-    auth_type: Literal["none", "basic", "bearer", "headers"] | None = None
-    auth_value: dict[str, Any] | None = None  # {username,password} | {token} | {headers}
+    auth_type: Literal["none", "basic", "bearer", "headers", "oauth"] | None = None
+    # {username,password} | {token} | {headers} | {token_url,client_id,client_secret}
+    auth_value: dict[str, Any] | None = None
     passthrough_headers: list[str] = Field(default_factory=list)
     enabled: bool = True
 
@@ -43,7 +44,7 @@ class GatewayUpdate(BaseModel):
     url: str | None = None
     description: str | None = None
     transport: Literal["streamablehttp", "sse"] | None = None
-    auth_type: Literal["none", "basic", "bearer", "headers"] | None = None
+    auth_type: Literal["none", "basic", "bearer", "headers", "oauth"] | None = None
     auth_value: dict[str, Any] | None = None
     passthrough_headers: list[str] | None = None
     enabled: bool | None = None

@@ -13,7 +13,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from argon2 import PasswordHasher
-from argon2.exceptions import VerifyMismatchError
+from argon2.exceptions import InvalidHashError, VerifyMismatchError
 
 from ..utils import jwt
 from ..utils.ids import new_id, slugify
@@ -124,6 +124,10 @@ class AuthService:
                 "UPDATE users SET failed_login_attempts=0, locked_until=NULL,"
                 " last_login=? WHERE email=?", (now(), email))
             return True
+        except InvalidHashError:
+            # SSO-provisioned accounts store a non-argon2 sentinel: password
+            # login is simply not available for them
+            return False
         except VerifyMismatchError:
             # an expired lock resets the counter: one stray failure after a
             # lockout must not instantly re-lock the account

@@ -116,7 +116,8 @@ class GatewayService:
     # ------------------------------------------------------- connect + sync
 
     async def _connect(self, row: dict[str, Any]) -> MCPSession:
-        headers = _auth_headers(row, self.ctx.settings.auth_encryption_secret)
+        from .tool_service import resolve_auth_headers
+        headers = await resolve_auth_headers(self.ctx, row)
         session = MCPSession(url=row["url"], transport=row["transport"], headers=headers,
                              timeout=self.ctx.settings.federation_timeout,
                              verify_ssl=not self.ctx.settings.skip_ssl_verify,

@@ -38,6 +38,7 @@ class SessionAffinityService:
         self._heartbeat_task: asyncio.Task | None = None
         self._pending: dict[str, asyncio.Future] = {}
         self._unsubs: list = []
+        self._handler_tasks: set[asyncio.Task] = set()  # strong refs (GC safety)
 
     async def start(self) -> None:
         self._unsubs.append(self.ctx.bus.subscribe("affinity.rpc", self._on_rpc))
@@ -139,7 +140,9 @@ class SessionAffinityService:
                 "corr": payload.get("corr"), "to": payload.get("from"),
                 "message": reply})
 
-        asyncio.get_running_loop().create_task(_run())
+        task = asyncio.get_running_loop().create_task(_run())
+        self._handler_tasks.add(task)
+        task.add_done_callback(self._handler_tasks.discard)
 
     async def _on_reply(self, topic: str, payload: dict[str, Any]) -> None:
         future = self._pending.get(payload.get("corr", ""))

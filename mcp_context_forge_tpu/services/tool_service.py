@@ -281,7 +281,7 @@ class ToolService:
             raise JSONRPCError(INVALID_PARAMS, "REST tool has no URL")
         headers = dict(from_json(row["headers"], {}))
         headers.update(injected_headers)
-        headers.update(_auth_headers(row, self.ctx.settings.auth_encryption_secret))
+        headers.update(await resolve_auth_headers(self.ctx, row))
         # URL path templating: {placeholder} substituted from arguments
         body_args = dict(arguments)
         for key in list(body_args):
@@ -332,7 +332,7 @@ class ToolService:
                 raise JSONRPCError(err.get("code", INTERNAL_ERROR),
                                    err.get("message", "tunnel error"))
             return response.get("result", {})
-        headers = _auth_headers(gateway or row, self.ctx.settings.auth_encryption_secret)
+        headers = await resolve_auth_headers(self.ctx, gateway or row)
         # passthrough headers from the inbound request (reference passthrough_headers)
         allowed = from_json((gateway or {}).get("passthrough_headers"), [])
         for h in allowed:
@@ -367,6 +367,19 @@ class ToolService:
 
 def _text_result(text: str) -> dict[str, Any]:
     return {"content": [{"type": "text", "text": text}], "isError": False}
+
+
+async def resolve_auth_headers(ctx, row: dict[str, Any]) -> dict[str, str]:
+    """Static auth headers + OAuth client-credentials when configured —
+    the one helper every outbound branch (REST / MCP / federation) uses."""
+    headers = _auth_headers(row, ctx.settings.auth_encryption_secret)
+    if row.get("auth_type") == "oauth":
+        oauth = ctx.extras.get("oauth_manager")
+        if oauth is not None:
+            value = decrypt_field(row.get("auth_value"),
+                                  ctx.settings.auth_encryption_secret) or {}
+            headers.update(await oauth.headers_for(value))
+    return headers
 
 
 def _auth_headers(row: dict[str, Any], secret: str) -> dict[str, str]:

@@ -1,0 +1,137 @@
+"""OAuth client-credentials for upstream tools + OIDC SSO login flow,
+against a mock IdP / token server."""
+
+import base64
+import json
+import time
+
+import aiohttp
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from tests.integration.test_gateway_app import BASIC, make_client
+
+AUTH = aiohttp.BasicAuth(*BASIC)
+
+
+def _fake_id_token(email: str) -> str:
+    header = base64.urlsafe_b64encode(b'{"alg":"RS256"}').rstrip(b"=")
+    payload = base64.urlsafe_b64encode(json.dumps({
+        "email": email, "name": "SSO User", "iat": int(time.time())}).encode()
+    ).rstrip(b"=")
+    return (header + b"." + payload + b".sig").decode()
+
+
+async def make_idp() -> TestClient:
+    app = web.Application()
+    issued = {"count": 0}
+
+    async def discovery(request):
+        base = f"http://{request.host}"
+        return web.json_response({
+            "authorization_endpoint": f"{base}/authorize",
+            "token_endpoint": f"{base}/token"})
+
+    async def token(request):
+        form = await request.post()
+        issued["count"] += 1
+        if form.get("grant_type") == "client_credentials":
+            if form.get("client_secret") != "s3cret":
+                return web.json_response({"error": "invalid_client"}, status=401)
+            return web.json_response({"access_token": f"cc-token-{issued['count']}",
+                                      "expires_in": 3600})
+        # authorization_code
+        if form.get("code") != "good-code":
+            return web.json_response({"error": "invalid_grant"}, status=400)
+        return web.json_response({
+            "access_token": "at", "id_token": _fake_id_token("sso@corp.com")})
+
+    app.router.add_get("/.well-known/openid-configuration", discovery)
+    app.router.add_post("/token", token)
+    app["issued"] = issued
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def test_oauth_gateway_tool_auth():
+    gateway = await make_client()
+    idp = await make_idp()
+
+    echo = web.Application()
+
+    async def handler(request):
+        return web.json_response({"auth": request.headers.get("authorization", "")})
+
+    echo.router.add_post("/api", handler)
+    upstream = TestClient(TestServer(echo))
+    await upstream.start_server()
+    try:
+        idp_base = f"http://{idp.server.host}:{idp.server.port}"
+        # MCP tool row with oauth auth (direct tool, no gateway row)
+        url = f"http://{upstream.server.host}:{upstream.server.port}/api"
+        await gateway.post("/tools", json={
+            "name": "oauth-rest", "integration_type": "REST", "url": url,
+            "auth_type": "oauth",
+            "auth_value": {"token_url": f"{idp_base}/token",
+                           "client_id": "cid", "client_secret": "s3cret"}},
+            auth=AUTH)
+        # REST branch uses _auth_headers only; oauth applies on MCP branch —
+        # exercise the manager directly for REST parity
+        oauth = gateway.app["ctx"].extras["oauth_manager"]
+        headers = await oauth.headers_for({"token_url": f"{idp_base}/token",
+                                           "client_id": "cid",
+                                           "client_secret": "s3cret"})
+        assert headers["authorization"].startswith("Bearer cc-token-")
+        # cached: second call does not mint a new token
+        await oauth.headers_for({"token_url": f"{idp_base}/token",
+                                 "client_id": "cid", "client_secret": "s3cret"})
+        assert idp.app["issued"]["count"] == 1
+        # bad secret -> error propagates
+        import pytest
+        import httpx
+        with pytest.raises(httpx.HTTPStatusError):
+            await oauth.headers_for({"token_url": f"{idp_base}/token",
+                                     "client_id": "cid", "client_secret": "nope"})
+    finally:
+        await upstream.close()
+        await idp.close()
+        await gateway.close()
+
+
+async def test_sso_login_flow():
+    gateway = await make_client()
+    idp = await make_idp()
+    try:
+        idp_base = f"http://{idp.server.host}:{idp.server.port}"
+        sso = gateway.app["sso_service"]
+        sso.register_provider("corp", idp_base, "client-1", "client-secret")
+
+        resp = await gateway.get("/auth/sso/providers")
+        assert (await resp.json())["providers"] == ["corp"]
+
+        # login redirect carries state + client_id
+        resp = await gateway.get("/auth/sso/corp/login", allow_redirects=False)
+        assert resp.status == 302
+        location = resp.headers["location"]
+        assert "client_id=client-1" in location and "state=" in location
+        state = location.split("state=")[1].split("&")[0]
+
+        # callback with the IdP's code -> local JWT + provisioned user
+        resp = await gateway.get(
+            f"/auth/sso/corp/callback?state={state}&code=good-code")
+        assert resp.status == 200, await resp.text()
+        body = await resp.json()
+        assert body["email"] == "sso@corp.com"
+        # the issued JWT works against the API
+        resp = await gateway.get("/tools", headers={
+            "authorization": f"Bearer {body['access_token']}"})
+        assert resp.status == 200
+
+        # replayed state -> rejected
+        resp = await gateway.get(
+            f"/auth/sso/corp/callback?state={state}&code=good-code")
+        assert resp.status == 422
+    finally:
+        await idp.close()
+        await gateway.close()
