@@ -249,6 +249,34 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     ctx.extras["grpc_service"] = grpc_service
     app["grpc_service"] = grpc_service
 
+    from ..services.toolops_service import ToolOpsService
+    toolops = ToolOpsService(ctx, tool_service)
+    app["toolops_service"] = toolops
+
+    async def toolops_generate(request: web.Request) -> web.Response:
+        request["auth"].require("tools.read")
+        cases = await toolops.generate(
+            request.match_info["name"],
+            use_llm=request.query.get("use_llm") == "true")
+        return web.json_response({"cases": cases})
+
+    async def toolops_run(request: web.Request) -> web.Response:
+        request["auth"].require("tools.invoke")
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        if not isinstance(body, dict):
+            return web.json_response({"detail": "body must be a JSON object"},
+                                     status=422)
+        report = await toolops.run(request.match_info["name"],
+                                   cases=body.get("cases"),
+                                   user=request["auth"].user)
+        return web.json_response(report)
+
+    app.router.add_get("/toolops/{name}/cases", toolops_generate)
+    app.router.add_post("/toolops/{name}/run", toolops_run)
+
     async def register_grpc(request: web.Request) -> web.Response:
         request["auth"].require("tools.create")
         body = await request.json()

@@ -165,18 +165,38 @@ def decode_step(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
     B = tokens.shape[0]
     x = params["embed"][tokens][:, None, :]  # [B,1,D]
     pos = positions[:, None]                 # [B,1]
+    use_pallas = _use_pallas_paged(config, kv)
     for idx, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], config.norm_eps)
         q, k, v = _attention_block(layer, config, h, pos)
         kv = write_decode_kv(kv, idx, k[:, 0], v[:, 0], slot_ids, positions)
-        keys, values = gather_kv(kv, idx, slot_ids)  # [B, C, KV, hd]
-        attn = _paged_decode_attention(q[:, 0], keys, values, seq_lens, config)
+        if use_pallas:
+            from ..ops.paged_attention import paged_decode_attention_pallas
+            G = config.n_heads // config.n_kv_heads
+            qg = q[:, 0].reshape(B, config.n_kv_heads, G, config.head_dim)
+            attn = paged_decode_attention_pallas(
+                qg, kv.k_pages[idx], kv.v_pages[idx],
+                kv.block_tables[slot_ids], seq_lens,
+                page_size=kv.page_size)
+            attn = attn.reshape(B, 1, config.n_heads, config.head_dim)
+        else:
+            keys, values = gather_kv(kv, idx, slot_ids)  # [B, C, KV, hd]
+            attn = _paged_decode_attention(q[:, 0], keys, values, seq_lens, config)
         x = x + (attn.reshape(B, 1, -1) @ layer["wo"])
         h = rms_norm(x, layer["ffn_norm"], config.norm_eps)
         x = x + _ffn(layer, h)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
     logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
     return logits, kv
+
+
+def _use_pallas_paged(config: LlamaConfig, kv: PagedKVState) -> bool:
+    """Pallas paged kernel on real TPU with tile-friendly shapes; the gather
+    reference elsewhere (CPU CI, odd geometries). Evaluated at trace time."""
+    from ..ops.attention import _on_tpu
+
+    return (_on_tpu() and config.head_dim % 128 == 0
+            and kv.page_size % 8 == 0)
 
 
 def _paged_decode_attention(q: jax.Array, keys: jax.Array, values: jax.Array,

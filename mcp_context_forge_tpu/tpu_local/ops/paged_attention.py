@@ -1,0 +1,105 @@
+"""Paged decode attention (Pallas).
+
+Replaces the gather-based decode attention (`models/llama.py:
+_paged_decode_attention` + `kv/paged_cache.py:gather_kv`) on TPU: instead of
+materializing each slot's whole context ([B, C, KV, hd] per layer) in HBM,
+the kernel walks the block table page-by-page — the page index is scalar-
+prefetched so Pallas can DMA exactly the pages a sequence uses from HBM into
+VMEM — maintaining online-softmax stats in VMEM scratch. HBM traffic drops
+from O(B·C_max·hd) copies to the pages actually referenced.
+
+Grid: (batch, kv_head, page). Scalar prefetch: block tables [B, P] and
+seq_lens [B]. Output: [B, KV, G, hd] attention for the single decode token.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
+            acc_ref, m_ref, l_ref, *, page_size: int, num_pages_per_seq: int):
+    b = pl.program_id(0)
+    page_idx = pl.program_id(2)
+
+    @pl.when(page_idx == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    seq_len = seq_lens_ref[b]
+    page_start = page_idx * page_size
+    # tokens this page actually holds for the sequence
+    valid_in_page = seq_len - page_start
+
+    @pl.when(valid_in_page > 0)
+    def _process():
+        q = q_ref[0, 0].astype(jnp.float32)           # [G, hd]
+        k = k_ref[0, :, 0].astype(jnp.float32)        # [page, hd]
+        v = v_ref[0, :, 0].astype(jnp.float32)        # [page, hd]
+        hd = q.shape[-1]
+        scores = (q @ k.T) / math.sqrt(hd)            # [G, page]
+        position = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        scores = jnp.where(position < valid_in_page, scores, NEG_INF)
+        m_prev = m_ref[...]                           # [G, 1]
+        l_prev = l_ref[...]
+        m_tile = jnp.max(scores, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_tile)
+        correction = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(scores - m_new)
+        l_new = l_prev * correction + jnp.sum(probs, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * correction + probs @ v
+        m_ref[...] = m_new
+        l_ref[...] = l_new
+
+    @pl.when(page_idx == num_pages_per_seq - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_ref[...] /
+                       jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("page_size", "interpret"))
+def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
+                                  page_size: int, interpret: bool = False):
+    """q: [B, KV, G, hd]; k_pages/v_pages: [num_pages, page, KV, hd];
+    block_tables: [B, P] int32; seq_lens: [B] int32 -> [B, KV, G, hd]."""
+    B, KV, G, hd = q.shape
+    P = block_tables.shape[1]
+
+    grid = (B, KV, P)
+    kernel = functools.partial(_kernel, page_size=page_size,
+                               num_pages_per_seq=P)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, G, hd), lambda b, k, j, bt, sl: (b, k, 0, 0)),
+                pl.BlockSpec((1, page_size, 1, hd),
+                             lambda b, k, j, bt, sl: (bt[b, j], 0, k, 0)),
+                pl.BlockSpec((1, page_size, 1, hd),
+                             lambda b, k, j, bt, sl: (bt[b, j], 0, k, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, G, hd),
+                                   lambda b, k, j, bt, sl: (b, k, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((G, hd), jnp.float32),
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((G, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, KV, G, hd), q.dtype),
+        interpret=interpret,
+    )(block_tables, seq_lens, q, k_pages, v_pages)
+    return out
