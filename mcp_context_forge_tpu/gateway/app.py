@@ -156,6 +156,13 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         if sid:
             transport.sessions.get(sid)  # slides local last_seen
             await affinity.claim_session(sid)
+        # forwarded RESPONSE messages (no method) are elicitation replies for
+        # a session this worker owns — RPCRequest.parse would reject them
+        if (isinstance(message, dict) and "method" not in message
+                and ("result" in message or "error" in message)):
+            if transport.elicitation is not None:
+                transport.elicitation.resolve(message, session_id=sid)
+            return None
         try:
             return await dispatcher.dispatch(_RR.parse(message), auth_ctx,
                                              headers=auth_info.get("headers", {}))
@@ -248,6 +255,30 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     grpc_service = GrpcService(ctx, tool_service)
     ctx.extras["grpc_service"] = grpc_service
     app["grpc_service"] = grpc_service
+
+    from ..services.elicitation_service import ElicitationService
+    elicitation_service = ElicitationService(ctx, transport.sessions)
+    transport.elicitation = elicitation_service
+    ctx.extras["elicitation_service"] = elicitation_service
+    app["elicitation_service"] = elicitation_service
+
+    async def elicit_route(request: web.Request) -> web.Response:
+        request["auth"].require("tools.invoke")
+        body = await request.json()
+        session_id = request.match_info["session_id"]
+        # the stream lives on the owning worker only
+        if (transport.sessions.get(session_id) is None
+                and not await affinity.is_local(session_id)):
+            return web.json_response(
+                {"detail": "Session is owned by another worker; "
+                           "elicit on the owning worker"}, status=409)
+        result = await elicitation_service.elicit(
+            session_id, body.get("message", ""),
+            requested_schema=body.get("requestedSchema"),
+            timeout=float(body.get("timeout", 120.0)))
+        return web.json_response(result)
+
+    app.router.add_post("/sessions/{session_id}/elicit", elicit_route)
 
     from ..services.toolops_service import ToolOpsService
     toolops = ToolOpsService(ctx, tool_service)

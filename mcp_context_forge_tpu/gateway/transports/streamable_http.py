@@ -149,6 +149,7 @@ class StreamableHTTPTransport:
         self.settings = settings
         self.sessions = session_manager or SessionManager(ttl=settings.session_ttl)
         self.affinity = None  # SessionAffinityService (multi-worker), set by app
+        self.elicitation = None  # ElicitationService, set by app
 
     # ------------------------------------------------------------------ POST
 
@@ -227,6 +228,14 @@ class StreamableHTTPTransport:
 
         responses: list[dict[str, Any]] = []
         for message in messages:
+            # client→server RESPONSE messages (no method): elicitation replies
+            if (isinstance(message, dict) and "method" not in message
+                    and ("result" in message or "error" in message)):
+                elicitation = getattr(self, "elicitation", None)
+                if elicitation is not None:
+                    elicitation.resolve(message,
+                                        session_id=headers.get("mcp-session-id"))
+                continue
             try:
                 rpc_request = RPCRequest.parse(message)
             except JSONRPCError as exc:

@@ -289,24 +289,25 @@ class ToolService:
             if token in url:
                 url = url.replace(token, str(body_args.pop(key)))
         method = row["request_type"].upper()
-        client = self.ctx.http_client  # shared pool; never per-call clients
+        client = self.ctx.aiohttp_client  # shared session; never per-call clients
 
-        async def _do() -> httpx.Response:
-            if method in ("GET", "DELETE"):
-                resp = await client.request(method, url, params=body_args, headers=headers)
-            else:
-                resp = await client.request(method, url, json=body_args, headers=headers)
-            resp.raise_for_status()
-            return resp
+        async def _do() -> str:
+            kwargs = ({"params": _query_params(body_args)}
+                      if method in ("GET", "DELETE") else {"json": body_args})
+            async with client.request(method, url, headers=headers,
+                                      **kwargs) as resp:
+                body = await resp.text()
+                resp.raise_for_status()
+                return body
 
-        resp = await with_retries(_do, attempts=self.ctx.settings.max_tool_retries,
+        body = await with_retries(_do, attempts=self.ctx.settings.max_tool_retries,
                                   base=self.ctx.settings.retry_base_delay,
                                   cap=self.ctx.settings.retry_max_delay)
         try:
-            payload = resp.json()
+            payload = json.loads(body)
             return _text_result(json.dumps(payload))
         except (json.JSONDecodeError, ValueError):
-            return _text_result(resp.text)
+            return _text_result(body)
 
     # MCP branch (reference tool_service.py:5911/:6094)
     async def _invoke_mcp(self, row: dict[str, Any], arguments: dict[str, Any],
@@ -363,6 +364,23 @@ class ToolService:
         return await with_retries(_do, attempts=self.ctx.settings.max_tool_retries,
                                   base=self.ctx.settings.retry_base_delay,
                                   cap=self.ctx.settings.retry_max_delay)
+
+
+def _query_params(args: dict[str, Any]) -> list[tuple[str, str]]:
+    """JSON arguments -> query params with conventional serialization:
+    bools lowercased, lists repeated, None dropped (httpx's behavior, which
+    the aiohttp hot path must preserve)."""
+    out: list[tuple[str, str]] = []
+    for key, value in args.items():
+        if value is None:
+            continue
+        values = value if isinstance(value, (list, tuple)) else [value]
+        for item in values:
+            if isinstance(item, bool):
+                out.append((key, "true" if item else "false"))
+            else:
+                out.append((key, str(item)))
+    return out
 
 
 def _text_result(text: str) -> dict[str, Any]:

@@ -38,6 +38,8 @@ async def with_retries(
 ) -> T:
     """Run ``fn`` with retries. httpx transport errors and 429/5xx retry by
     default; JSON-RPC/application errors do not."""
+    import aiohttp
+
     last: BaseException | None = None
     for attempt in range(attempts):
         try:
@@ -50,7 +52,17 @@ async def with_retries(
             retry_after = float(ra) if ra and ra.replace(".", "", 1).isdigit() else None
             if attempt + 1 < attempts:
                 await asyncio.sleep(backoff_delay(attempt, base, cap, retry_after))
-        except (httpx.TransportError, asyncio.TimeoutError, ConnectionError) as exc:
+        except aiohttp.ClientResponseError as exc:
+            last = exc
+            if exc.status not in RETRYABLE_STATUS:
+                raise
+            ra = (exc.headers or {}).get("Retry-After") if exc.headers else None
+            retry_after = float(ra) if ra and str(ra).replace(".", "", 1).isdigit() \
+                else None
+            if attempt + 1 < attempts:
+                await asyncio.sleep(backoff_delay(attempt, base, cap, retry_after))
+        except (httpx.TransportError, aiohttp.ClientError,
+                asyncio.TimeoutError, ConnectionError) as exc:
             last = exc
             if attempt + 1 < attempts:
                 await asyncio.sleep(backoff_delay(attempt, base, cap))

@@ -1,0 +1,64 @@
+"""Elicitation: gateway asks a connected stateful client for input."""
+
+import asyncio
+import json
+
+import aiohttp
+
+from tests.integration.test_gateway_app import BASIC, make_client
+
+AUTH = aiohttp.BasicAuth(*BASIC)
+
+
+async def test_elicitation_roundtrip():
+    gateway = await make_client(streamable_http_stateful="true")
+    try:
+        # client initializes (mints a session)
+        resp = await gateway.post("/mcp", json={
+            "jsonrpc": "2.0", "id": 1, "method": "initialize",
+            "params": {"protocolVersion": "2025-06-18", "capabilities": {},
+                       "clientInfo": {"name": "c", "version": "0"}}}, auth=AUTH)
+        session_id = resp.headers["mcp-session-id"]
+
+        async def client_stream():
+            """Acts as the connected MCP client: reads the elicitation
+            request off the GET stream and answers it."""
+            async with gateway.get("/mcp", headers={
+                    "mcp-session-id": session_id,
+                    "authorization": AUTH.encode()}) as stream:
+                buffer = b""
+                while True:
+                    chunk = await asyncio.wait_for(stream.content.read(1024),
+                                                   timeout=15)
+                    buffer += chunk
+                    if b"elicitation/create" in buffer:
+                        data_line = [l for l in buffer.decode().splitlines()
+                                     if l.startswith("data: ")][-1]
+                        request = json.loads(data_line[6:])
+                        assert request["params"]["message"] == "Need your name"
+                        # answer via POST (a response message)
+                        await gateway.post("/mcp", json={
+                            "jsonrpc": "2.0", "id": request["id"],
+                            "result": {"action": "accept",
+                                       "content": {"name": "Ada"}}},
+                            headers={"mcp-session-id": session_id,
+                                     "authorization": AUTH.encode()})
+                        return
+
+        client_task = asyncio.ensure_future(client_stream())
+        await asyncio.sleep(0.2)
+        resp = await gateway.post(f"/sessions/{session_id}/elicit", json={
+            "message": "Need your name",
+            "requestedSchema": {"type": "object",
+                                "properties": {"name": {"type": "string"}}}},
+            auth=AUTH)
+        result = await resp.json()
+        await client_task
+        assert result == {"action": "accept", "content": {"name": "Ada"}}
+
+        # no connected stream -> 404
+        resp = await gateway.post("/sessions/doesnotexist/elicit", json={
+            "message": "x"}, auth=AUTH)
+        assert resp.status == 404
+    finally:
+        await gateway.close()

@@ -54,8 +54,14 @@ async def test_reverse_tunnel_register_and_call():
             "params": {"name": "local-time", "arguments": {}}}, auth=AUTH)
         payload = await resp.json()
         assert payload["result"]["isError"] is True
-        resp = await gateway.get("/gateways?include_inactive=true", auth=AUTH)
-        gw = [g for g in await resp.json() if g["name"] == "nat-server"][0]
+        # tunnel-close cleanup is async: poll briefly
+        import asyncio
+        for _ in range(40):
+            resp = await gateway.get("/gateways?include_inactive=true", auth=AUTH)
+            gw = [g for g in await resp.json() if g["name"] == "nat-server"][0]
+            if gw["reachable"] is False:
+                break
+            await asyncio.sleep(0.05)
         assert gw["reachable"] is False
     finally:
         await gateway.close()
