@@ -158,8 +158,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
             await affinity.claim_session(sid)
         # forwarded RESPONSE messages (no method) are elicitation replies for
         # a session this worker owns — RPCRequest.parse would reject them
-        if (isinstance(message, dict) and "method" not in message
-                and ("result" in message or "error" in message)):
+        from ..jsonrpc import is_response_message
+        if is_response_message(message):
             if transport.elicitation is not None:
                 transport.elicitation.resolve(message, session_id=sid)
             return None
@@ -272,10 +272,19 @@ async def build_app(settings: Settings | None = None) -> web.Application:
             return web.json_response(
                 {"detail": "Session is owned by another worker; "
                            "elicit on the owning worker"}, status=409)
+        import math
+        try:
+            timeout = float(body.get("timeout", 120.0))
+        except (TypeError, ValueError):
+            return web.json_response({"detail": "timeout must be a number"},
+                                     status=422)
+        if not math.isfinite(timeout):
+            return web.json_response({"detail": "timeout must be finite"},
+                                     status=422)
         result = await elicitation_service.elicit(
             session_id, body.get("message", ""),
             requested_schema=body.get("requestedSchema"),
-            timeout=float(body.get("timeout", 120.0)))
+            timeout=timeout)
         return web.json_response(result)
 
     app.router.add_post("/sessions/{session_id}/elicit", elicit_route)

@@ -193,14 +193,18 @@ class StreamableHTTPTransport:
                                      "teams": auth.teams,
                                      "permissions": sorted(auth.permissions),
                                      "headers": {"mcp-session-id": session_id}}
+                        from ...jsonrpc import is_response_message
                         for message in messages:
                             reply = await self.affinity.forward(
                                 session_id, message, auth_info=auth_info)
-                            if reply is None and not (
-                                    isinstance(message, dict)
-                                    and "id" not in message):
+                            expects_reply = (isinstance(message, dict)
+                                             and "method" in message
+                                             and "id" in message)
+                            if reply is None and expects_reply:
                                 # owner died mid-claim: no one can answer this
-                                # request — 404 so the client re-initializes
+                                # request — 404 so the client re-initializes.
+                                # (notifications and RESPONSE messages — e.g.
+                                # elicitation replies — legitimately get None)
                                 forwarded = False
                                 break
                             if reply is not None:
@@ -229,8 +233,8 @@ class StreamableHTTPTransport:
         responses: list[dict[str, Any]] = []
         for message in messages:
             # client→server RESPONSE messages (no method): elicitation replies
-            if (isinstance(message, dict) and "method" not in message
-                    and ("result" in message or "error" in message)):
+            from ...jsonrpc import is_response_message
+            if is_response_message(message):
                 elicitation = getattr(self, "elicitation", None)
                 if elicitation is not None:
                     elicitation.resolve(message,

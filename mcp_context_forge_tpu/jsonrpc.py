@@ -77,6 +77,13 @@ def parse_body(raw: bytes, max_size: int = 0) -> Any:
         raise JSONRPCError(PARSE_ERROR, f"Parse error: {exc}") from exc
 
 
+def is_response_message(message: Any) -> bool:
+    """True for client→server RESPONSE messages (result/error, no method) —
+    e.g. elicitation replies riding the POST channel."""
+    return (isinstance(message, dict) and "method" not in message
+            and ("result" in message or "error" in message))
+
+
 def result_response(request_id: Any, result: Any) -> dict[str, Any]:
     return {"jsonrpc": "2.0", "id": request_id, "result": result}
 

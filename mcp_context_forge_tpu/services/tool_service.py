@@ -294,8 +294,10 @@ class ToolService:
         async def _do() -> str:
             kwargs = ({"params": _query_params(body_args)}
                       if method in ("GET", "DELETE") else {"json": body_args})
+            # allow_redirects=False: httpx parity — a 3xx is the tool's
+            # result, not an invitation to fetch an unvalidated Location
             async with client.request(method, url, headers=headers,
-                                      **kwargs) as resp:
+                                      allow_redirects=False, **kwargs) as resp:
                 body = await resp.text()
                 resp.raise_for_status()
                 return body

@@ -44,21 +44,18 @@ async def with_retries(
     for attempt in range(attempts):
         try:
             return await fn()
-        except httpx.HTTPStatusError as exc:
+        except (httpx.HTTPStatusError, aiohttp.ClientResponseError) as exc:
             last = exc
-            if exc.response.status_code not in RETRYABLE_STATUS:
+            if isinstance(exc, httpx.HTTPStatusError):
+                status = exc.response.status_code
+                ra = exc.response.headers.get("retry-after")
+            else:
+                status = exc.status
+                ra = (exc.headers or {}).get("Retry-After") if exc.headers else None
+            if status not in RETRYABLE_STATUS:
                 raise
-            ra = exc.response.headers.get("retry-after")
-            retry_after = float(ra) if ra and ra.replace(".", "", 1).isdigit() else None
-            if attempt + 1 < attempts:
-                await asyncio.sleep(backoff_delay(attempt, base, cap, retry_after))
-        except aiohttp.ClientResponseError as exc:
-            last = exc
-            if exc.status not in RETRYABLE_STATUS:
-                raise
-            ra = (exc.headers or {}).get("Retry-After") if exc.headers else None
-            retry_after = float(ra) if ra and str(ra).replace(".", "", 1).isdigit() \
-                else None
+            retry_after = (float(ra) if ra and str(ra).replace(".", "", 1).isdigit()
+                           else None)
             if attempt + 1 < attempts:
                 await asyncio.sleep(backoff_delay(attempt, base, cap, retry_after))
         except (httpx.TransportError, aiohttp.ClientError,

@@ -1,0 +1,158 @@
+"""Protocol-compliance matrix (reference: tests/compliance/mcp_2025_11_25
+harness — (target × transport) sweeps). Every core MCP method is exercised
+over every inbound transport and must produce an equivalent, spec-shaped
+result."""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from tests.integration.test_gateway_app import BASIC, make_client
+
+AUTH = aiohttp.BasicAuth(*BASIC)
+
+CORE_REQUESTS = [
+    ("initialize", {"protocolVersion": "2025-06-18", "capabilities": {},
+                    "clientInfo": {"name": "m", "version": "0"}}),
+    ("ping", {}),
+    ("tools/list", {}),
+    ("resources/list", {}),
+    ("resources/templates/list", {}),
+    ("prompts/list", {}),
+    ("roots/list", {}),
+    ("completion/complete", {"ref": {"type": "ref/prompt", "name": "x"},
+                             "argument": {"name": "a", "value": ""}}),
+]
+
+
+def _check(method: str, response: dict):
+    assert response.get("jsonrpc") == "2.0"
+    assert "result" in response, (method, response)
+    result = response["result"]
+    if method == "initialize":
+        assert result["protocolVersion"] == "2025-06-18"
+        assert "capabilities" in result and "serverInfo" in result
+    elif method == "tools/list":
+        assert isinstance(result["tools"], list)
+    elif method == "resources/list":
+        assert isinstance(result["resources"], list)
+    elif method == "resources/templates/list":
+        assert isinstance(result["resourceTemplates"], list)
+    elif method == "prompts/list":
+        assert isinstance(result["prompts"], list)
+    elif method == "roots/list":
+        assert isinstance(result["roots"], list)
+    elif method == "completion/complete":
+        assert "completion" in result
+
+
+async def _drive_rpc(gateway):
+    async def call(i, method, params):
+        resp = await gateway.post("/rpc", json={
+            "jsonrpc": "2.0", "id": i, "method": method, "params": params},
+            auth=AUTH)
+        return await resp.json()
+    return call
+
+
+async def _drive_mcp(gateway):
+    async def call(i, method, params):
+        resp = await gateway.post("/mcp", json={
+            "jsonrpc": "2.0", "id": i, "method": method, "params": params},
+            auth=AUTH)
+        return await resp.json()
+    return call
+
+
+async def test_matrix_http_transports():
+    gateway = await make_client()
+    try:
+        for factory in (_drive_rpc, _drive_mcp):
+            call = await factory(gateway)
+            for i, (method, params) in enumerate(CORE_REQUESTS, start=1):
+                response = await call(i, method, params)
+                _check(method, response)
+    finally:
+        await gateway.close()
+
+
+async def test_matrix_websocket():
+    gateway = await make_client()
+    try:
+        async with gateway.ws_connect("/ws", auth=AUTH) as ws:
+            for i, (method, params) in enumerate(CORE_REQUESTS, start=1):
+                await ws.send_json({"jsonrpc": "2.0", "id": i,
+                                    "method": method, "params": params})
+                response = await ws.receive_json(timeout=15)
+                _check(method, response)
+    finally:
+        await gateway.close()
+
+
+async def test_matrix_legacy_sse():
+    gateway = await make_client()
+    try:
+        async with gateway.get("/sse", auth=AUTH) as stream:
+            # read the endpoint event
+            endpoint = None
+            buffer = b""
+            while endpoint is None:
+                buffer += await asyncio.wait_for(stream.content.read(512),
+                                                 timeout=10)
+                for line in buffer.decode().splitlines():
+                    if line.startswith("data: /messages"):
+                        endpoint = line[6:]
+            received: dict[int, dict] = {}
+            for i, (method, params) in enumerate(CORE_REQUESTS, start=1):
+                resp = await gateway.post(endpoint, json={
+                    "jsonrpc": "2.0", "id": i, "method": method,
+                    "params": params}, auth=AUTH)
+                assert resp.status == 202
+            deadline = asyncio.get_event_loop().time() + 20
+            buffer = b""
+            while (len(received) < len(CORE_REQUESTS)
+                   and asyncio.get_event_loop().time() < deadline):
+                buffer += await asyncio.wait_for(stream.content.read(4096),
+                                                 timeout=15)
+                for block in buffer.decode(errors="ignore").split("\n\n"):
+                    for line in block.splitlines():
+                        if line.startswith("data: {"):
+                            try:
+                                message = json.loads(line[6:])
+                            except json.JSONDecodeError:
+                                continue
+                            if isinstance(message.get("id"), int):
+                                received[message["id"]] = message
+            for i, (method, _) in enumerate(CORE_REQUESTS, start=1):
+                assert i in received, f"no response for {method} over SSE"
+                _check(method, received[i])
+    finally:
+        await gateway.close()
+
+
+async def test_matrix_stateful_sessions():
+    gateway = await make_client(streamable_http_stateful="true")
+    try:
+        resp = await gateway.post("/mcp", json={
+            "jsonrpc": "2.0", "id": 0, "method": "initialize",
+            "params": CORE_REQUESTS[0][1]}, auth=AUTH)
+        session = resp.headers["mcp-session-id"]
+        for i, (method, params) in enumerate(CORE_REQUESTS[1:], start=1):
+            resp = await gateway.post("/mcp", json={
+                "jsonrpc": "2.0", "id": i, "method": method, "params": params},
+                headers={"mcp-session-id": session,
+                         "authorization": AUTH.encode()})
+            _check(method, await resp.json())
+        # DELETE ends the session
+        resp = await gateway.delete("/mcp", headers={
+            "mcp-session-id": session, "authorization": AUTH.encode()})
+        assert resp.status == 204
+        resp = await gateway.post("/mcp", json={
+            "jsonrpc": "2.0", "id": 99, "method": "ping"},
+            headers={"mcp-session-id": session,
+                     "authorization": AUTH.encode()})
+        assert resp.status == 404
+    finally:
+        await gateway.close()
