@@ -31,6 +31,22 @@ for _name, _path in {
     "cached_tool_result": f"{_P}.resilience.CachedToolResultPlugin",
     "watchdog": f"{_P}.resilience.WatchdogPlugin",
     "webhook_notification": f"{_P}.resilience.WebhookNotificationPlugin",
+    # content / format
+    "citation_validator": f"{_P}.content_plugins.CitationValidatorPlugin",
+    "safe_html_sanitizer": f"{_P}.content_plugins.SafeHtmlSanitizerPlugin",
+    "code_formatter": f"{_P}.content_plugins.CodeFormatterPlugin",
+    "license_header_injector": f"{_P}.content_plugins.LicenseHeaderInjectorPlugin",
+    "ai_artifacts_normalizer": f"{_P}.content_plugins.AiArtifactsNormalizerPlugin",
+    "toon_encoder": f"{_P}.content_plugins.ToonEncoderPlugin",
+    "robots_license_guard": f"{_P}.content_plugins.RobotsLicenseGuardPlugin",
+    "code_safety_linter": f"{_P}.content_plugins.CodeSafetyLinterPlugin",
+    # security / ops
+    "jwt_claims_extraction": f"{_P}.security_plugins.JwtClaimsExtractionPlugin",
+    "vault": f"{_P}.security_plugins.VaultPlugin",
+    "virus_total_checker": f"{_P}.security_plugins.VirusTotalCheckerPlugin",
+    "span_attribute_customizer": f"{_P}.security_plugins.SpanAttributeCustomizerPlugin",
+    "unified_pdp": f"{_P}.security_plugins.UnifiedPdpPlugin",
+    "tools_telemetry_exporter": f"{_P}.security_plugins.ToolsTelemetryExporterPlugin",
     # LLM-backed (tpu_local) — north-star plugins
     "response_cache_by_prompt": f"{_P}.llm_plugins.ResponseCacheByPromptPlugin",
     "summarizer": f"{_P}.llm_plugins.SummarizerPlugin",

@@ -1,0 +1,149 @@
+"""Extended plugin batch: content + security plugins."""
+
+import json
+import os
+
+import pytest
+
+from mcp_context_forge_tpu.plugins.framework import (
+    PluginConfig,
+    PluginManager,
+    PluginViolation,
+)
+
+
+def _config(kind: str, **cfg) -> PluginConfig:
+    return PluginConfig(name=kind, kind=kind, config=cfg)
+
+
+async def _manager(*configs: PluginConfig) -> PluginManager:
+    import mcp_context_forge_tpu.plugins.builtin  # noqa: F401
+    manager = PluginManager()
+    for config in configs:
+        await manager.add_plugin(config)
+    return manager
+
+
+def _text(result):
+    return result["content"][0]["text"]
+
+
+async def test_citation_validator():
+    manager = await _manager(_config("citation_validator",
+                                     allowed_schemes=["https"],
+                                     allowed_hosts=["example.com"]))
+    ok = {"content": [{"type": "text",
+                       "text": "see https://docs.example.com/page"}]}
+    await manager.tool_post_invoke("t", ok)
+    with pytest.raises(PluginViolation):
+        await manager.tool_post_invoke("t", {"content": [{
+            "type": "text", "text": "see http://example.com/x"}]})
+    with pytest.raises(PluginViolation):
+        await manager.tool_post_invoke("t", {"content": [{
+            "type": "text", "text": "see https://evil.org/x"}]})
+
+
+async def test_safe_html_sanitizer():
+    manager = await _manager(_config("safe_html_sanitizer"))
+    out = await manager.tool_post_invoke("t", {"content": [{
+        "type": "text",
+        "text": '<b>hi</b><script>alert(1)</script><a onclick="x()">y</a>'}]})
+    text = _text(out)
+    assert "<script>" not in text and "onclick" not in text and "<b>hi</b>" in text
+
+
+async def test_toon_encoder_compacts_catalogs():
+    manager = await _manager(_config("toon_encoder", min_items=2))
+    rows = [{"name": f"tool{i}", "n": i} for i in range(3)]
+    out = await manager.tool_post_invoke("t", {"content": [{
+        "type": "text", "text": json.dumps(rows)}]})
+    text = _text(out)
+    assert text.startswith("#toon/v1\nname\tn\n")
+    assert "tool2" in text
+    assert len(text) < len(json.dumps(rows))
+
+
+async def test_vault_injects_and_blocks_missing():
+    os.environ["VAULT_API_KEY"] = "s3cret-value"
+    try:
+        manager = await _manager(_config("vault"))
+        _, args, headers, _, _ = await manager.tool_pre_invoke(
+            "t", {"key": "{{vault:API_KEY}}"}, {"x-auth": "{{vault:API_KEY}}"})
+        assert args["key"] == "s3cret-value"
+        assert headers["x-auth"] == "s3cret-value"
+        with pytest.raises(PluginViolation):
+            await manager.tool_pre_invoke("t", {"key": "{{vault:NOPE}}"}, {})
+    finally:
+        del os.environ["VAULT_API_KEY"]
+
+
+async def test_unified_pdp():
+    manager = await _manager(_config("unified_pdp", rules=[
+        {"users": ["evil@x.com"], "tools": ["*"], "effect": "deny"},
+        {"users": ["*"], "tools": ["admin-tool"], "effect": "deny"},
+    ]))
+    await manager.tool_pre_invoke("any", {}, {}, user="good@x.com")
+    with pytest.raises(PluginViolation):
+        await manager.tool_pre_invoke("any", {}, {}, user="evil@x.com")
+    with pytest.raises(PluginViolation):
+        await manager.tool_pre_invoke("admin-tool", {}, {}, user="good@x.com")
+
+
+async def test_jwt_claims_extraction():
+    from mcp_context_forge_tpu.utils import jwt as jwt_util
+    token = jwt_util.create_token({"sub": "alice@x.com", "team": "ml"},
+                                  "irrelevant-secret")
+    manager = await _manager(_config("jwt_claims_extraction",
+                                     claims={"sub": "caller", "team": "team"}))
+    _, args, _, _, _ = await manager.tool_pre_invoke(
+        "t", {"q": 1}, {"authorization": f"Bearer {token}"})
+    assert args["caller"] == "alice@x.com" and args["team"] == "ml"
+    # required claim missing
+    manager = await _manager(_config("jwt_claims_extraction",
+                                     require=["org"]))
+    with pytest.raises(PluginViolation):
+        await manager.tool_pre_invoke("t", {}, {"authorization": f"Bearer {token}"})
+
+
+async def test_virus_total_hash_block():
+    import hashlib
+    bad = "malicious payload"
+    manager = await _manager(_config(
+        "virus_total_checker",
+        blocked_sha256=[hashlib.sha256(bad.encode()).hexdigest()]))
+    with pytest.raises(PluginViolation):
+        await manager.tool_post_invoke("t", {"content": [{
+            "type": "text", "text": bad}]})
+    await manager.tool_post_invoke("t", {"content": [{
+        "type": "text", "text": "clean"}]})
+
+
+async def test_ai_artifacts_normalizer():
+    manager = await _manager(_config("ai_artifacts_normalizer"))
+    out = await manager.tool_post_invoke("t", {"content": [{
+        "type": "text",
+        "text": "<|eot_id|>As an AI language model, here:\ncode\n```\n"}]})
+    text = _text(out)
+    assert "<|eot_id|>" not in text and "As an AI" not in text
+
+
+async def test_license_header_and_code_formatter():
+    manager = await _manager(
+        _config("code_formatter"),
+        _config("license_header_injector", header="Apache-2.0",
+                comment_prefix="// "))
+    out = await manager.tool_post_invoke("t", {"content": [{
+        "type": "text", "text": "int x;\t\r\nint y;   "}]})
+    text = _text(out)
+    assert text.startswith("// Apache-2.0\n")
+    assert "\r" not in text and "\t" not in text
+
+
+async def test_robots_license_guard():
+    manager = await _manager(_config("robots_license_guard"))
+    with pytest.raises(PluginViolation):
+        await manager.resource_post_fetch("x://a", {"contents": [{
+            "text": '<meta name="robots" content="noai">'}]})
+    out = await manager.resource_post_fetch("x://b", {"contents": [{
+        "text": "plain content"}]})
+    assert out["contents"][0]["text"] == "plain content"
