@@ -1,0 +1,29 @@
+# mcp-context-forge-tpu (reference: 8.7k-line Makefile; the targets that matter)
+
+.PHONY: serve test test-fast bench bench-engine wrapper masking clean
+
+serve:
+	python -m mcp_context_forge_tpu.cli serve
+
+test:
+	python -m pytest tests/ -q
+
+test-fast:
+	python -m pytest tests/unit tests/fuzz -q
+
+bench:
+	python bench.py
+
+bench-engine:
+	python bench_engine.py
+
+wrapper:
+	g++ -O2 -std=c++17 mcp_context_forge_tpu/native/stdio_wrapper.cpp -o mcpforge-wrapper
+
+masking:
+	g++ -O2 -shared -fPIC -std=c++17 mcp_context_forge_tpu/native/masking.cpp \
+	  -o mcp_context_forge_tpu/native/libmasking.so
+
+clean:
+	rm -rf .pytest_cache mcpforge-wrapper mcp_context_forge_tpu/native/libmasking.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

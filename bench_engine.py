@@ -1,0 +1,79 @@
+"""tpu_local engine micro-benchmark: continuous-batching decode throughput.
+
+Separate from bench.py (the driver's headline gateway metric). Prints one
+JSON line: {"metric": "tpu_local_decode_tokens_per_s", ...}. Model/geometry
+via env: BENCH_MODEL (default llama3-tiny), BENCH_CLIENTS, BENCH_TOKENS.
+
+On the real chip run with the axon default platform; on CPU it pins jax to
+cpu automatically when the axon backend is unavailable.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+async def run() -> dict:
+    import jax
+
+    platform = os.environ.get("BENCH_PLATFORM", "")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    try:
+        devices = jax.devices()
+    except Exception:
+        jax.config.update("jax_platforms", "cpu")
+        devices = jax.devices()
+
+    from mcp_context_forge_tpu.tpu_local.engine import EngineConfig, TPUEngine
+
+    model = os.environ.get("BENCH_MODEL", "llama3-tiny")
+    clients = int(os.environ.get("BENCH_CLIENTS", "8"))
+    max_tokens = int(os.environ.get("BENCH_TOKENS", "32"))
+    config = EngineConfig(model=model, max_batch=min(clients, 16),
+                          max_seq_len=512, page_size=16, num_pages=512,
+                          prefill_buckets=(64,),
+                          dtype="bfloat16" if devices[0].platform == "tpu"
+                          else "float32",
+                          attn_impl="auto")
+    engine = TPUEngine(config)
+    await engine.start()
+    try:
+        prompt = engine.tokenizer.encode("benchmark prompt for decode throughput")
+
+        async def one() -> int:
+            count = 0
+            async for _ in engine.generate(prompt, max_tokens=max_tokens):
+                count += 1
+            return count
+
+        # warmup (compiles prefill + decode)
+        await one()
+        started = time.monotonic()
+        counts = await asyncio.gather(*[one() for _ in range(clients)])
+        wall = time.monotonic() - started
+        total = sum(counts)
+        return {
+            "metric": "tpu_local_decode_tokens_per_s",
+            "value": round(total / wall, 2),
+            "unit": "tokens/s",
+            "vs_baseline": None,  # reference has no in-process engine
+            "platform": devices[0].platform,
+            "model": model,
+            "clients": clients,
+            "tokens": total,
+            "wall_s": round(wall, 3),
+            "decode_steps": engine.stats.decode_steps,
+        }
+    finally:
+        await engine.stop()
+
+
+if __name__ == "__main__":
+    print(json.dumps(asyncio.run(run())))

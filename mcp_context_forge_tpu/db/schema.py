@@ -332,6 +332,22 @@ CREATE TABLE IF NOT EXISTS resource_subscriptions (
 );
 """
 
+_V2 = """
+CREATE TABLE IF NOT EXISTS a2a_tasks (
+  id TEXT PRIMARY KEY,
+  agent_id TEXT NOT NULL REFERENCES a2a_agents(id) ON DELETE CASCADE,
+  state TEXT NOT NULL DEFAULT 'submitted',  -- submitted|working|completed|failed|cancelled
+  input TEXT,                               -- JSON message
+  output TEXT,                              -- JSON result
+  error TEXT,
+  created_by TEXT,
+  created_at REAL NOT NULL,
+  updated_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS ix_a2a_tasks_agent ON a2a_tasks(agent_id, created_at);
+"""
+
 MIGRATIONS: list[Migration] = [
     Migration(1, "initial-core-schema", _V1),
+    Migration(2, "a2a-task-store", _V2),
 ]

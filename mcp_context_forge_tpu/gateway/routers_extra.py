@@ -56,6 +56,36 @@ def setup_extra_routes(app: web.Application) -> None:
             request.match_info["name"], payload, user=request["auth"].user, hop=hop)
         return web.json_response(result)
 
+    # ------------------------------------------------------------- A2A tasks
+    @routes.post("/a2a/{name}/tasks")
+    async def create_task(request: web.Request) -> web.Response:
+        request["auth"].require("a2a.invoke")
+        try:
+            payload = await request.json()
+        except Exception:
+            payload = {}
+        task = await request.app["a2a_service"].create_task(
+            request.match_info["name"], payload, user=request["auth"].user)
+        return web.json_response(task, status=201)
+
+    @routes.get("/a2a/tasks/{task_id}")
+    async def get_task(request: web.Request) -> web.Response:
+        request["auth"].require("a2a.read")
+        return web.json_response(
+            await request.app["a2a_service"].get_task(request.match_info["task_id"]))
+
+    @routes.get("/a2a/{name}/tasks")
+    async def list_tasks(request: web.Request) -> web.Response:
+        request["auth"].require("a2a.read")
+        return web.json_response(await request.app["a2a_service"].list_tasks(
+            request.match_info["name"]))
+
+    @routes.post("/a2a/tasks/{task_id}/cancel")
+    async def cancel_task(request: web.Request) -> web.Response:
+        request["auth"].require("a2a.invoke")
+        return web.json_response(await request.app["a2a_service"].cancel_task(
+            request.match_info["task_id"]))
+
     # ------------------------------------------------------------- LLM admin
     @routes.get("/llm/providers")
     async def list_providers(request: web.Request) -> web.Response:

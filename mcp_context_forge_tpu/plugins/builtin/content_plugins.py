@@ -35,7 +35,8 @@ class CitationValidatorPlugin(Plugin):
                     raise PluginViolation(f"Citation scheme {scheme!r} not allowed",
                                           code="CITATION_SCHEME")
                 if hosts:
-                    host = url.split("://", 1)[1].split("/", 1)[0].split(":")[0]
+                    from urllib.parse import urlsplit
+                    host = urlsplit(url).hostname or ""  # userinfo-safe
                     if not any(host == h or host.endswith("." + h) for h in hosts):
                         raise PluginViolation(f"Citation host {host!r} not allowed",
                                               code="CITATION_HOST")
@@ -65,8 +66,10 @@ class SafeHtmlSanitizerPlugin(Plugin):
             for pattern, repl in cls._PATTERNS:
                 text = pattern.sub(repl, text)
             if text == before:
-                break
-        return text
+                return text
+        # still mutating after the cap: adversarially nested markup — fail
+        # closed by stripping every remaining tag rather than shipping it
+        return re.sub(r"<[^>]*>", "", text)
 
     async def tool_post_invoke(self, name, result, context):
         for item in _iter_text(result):

@@ -40,6 +40,15 @@ class JwtClaimsExtractionPlugin(Plugin):
                 raise PluginViolation("Bearer token is not a decodable JWT",
                                       code="CLAIMS_MISSING") from None
             return None
+        # the token is decoded unverified, so its identity must match the
+        # identity the gateway DID verify — otherwise a client authenticated
+        # through another path could smuggle a forged bearer alongside
+        if not self.config.config.get("allow_mismatched_sub", False):
+            sub = claims.get("sub")
+            if sub and context.user and sub != context.user:
+                raise PluginViolation(
+                    "Bearer token subject does not match the authenticated user",
+                    code="CLAIMS_MISMATCH")
         mapping = self.config.config.get("claims", {"sub": "jwt_sub"})
         missing = [c for c in required if c not in claims]
         if missing:

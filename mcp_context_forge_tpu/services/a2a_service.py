@@ -42,6 +42,7 @@ def _row_to_read(row: dict[str, Any]) -> A2AAgentRead:
 class A2AService:
     def __init__(self, ctx: AppContext):
         self.ctx = ctx
+        self._task_runs: dict[str, Any] = {}  # task_id -> asyncio.Task
 
     # ------------------------------------------------------------------ CRUD
 
@@ -220,6 +221,86 @@ class A2AService:
         if "error" in data:
             raise ValidationFailure(f"Agent error: {data['error']}")
         return data.get("result", data)
+
+    # ------------------------------------------------------------- task store
+    # (reference A2ATask db.py:5091: message/send may create long-running
+    # tasks; tasks/get + tasks/cancel poll/abort them)
+
+    async def create_task(self, agent_name: str, payload: dict[str, Any],
+                          user: str | None = None) -> dict[str, Any]:
+        row = await self.ctx.db.fetchone(
+            "SELECT * FROM a2a_agents WHERE (name=? OR slug=?) AND enabled=1",
+            (agent_name, agent_name))
+        if not row:
+            raise NotFoundError(f"Agent {agent_name!r} not found")
+        task_id = new_id()
+        ts = now()
+        await self.ctx.db.execute(
+            "INSERT INTO a2a_tasks (id, agent_id, state, input, created_by,"
+            " created_at, updated_at) VALUES (?,?,?,?,?,?,?)",
+            (task_id, row["id"], "submitted", to_json(payload), user, ts, ts))
+
+        import asyncio
+
+        async def _run() -> None:
+            await self.ctx.db.execute(
+                "UPDATE a2a_tasks SET state='working', updated_at=? WHERE id=?",
+                (now(), task_id))
+            try:
+                result = await self.invoke_agent(agent_name, payload, user=user)
+                # guard on state: a cancel (possibly from another worker)
+                # must not be overwritten by a late completion
+                await self.ctx.db.execute(
+                    "UPDATE a2a_tasks SET state='completed', output=?,"
+                    " updated_at=? WHERE id=? AND state='working'",
+                    (to_json(result), now(), task_id))
+            except Exception as exc:
+                await self.ctx.db.execute(
+                    "UPDATE a2a_tasks SET state='failed', error=?, updated_at=?"
+                    " WHERE id=? AND state='working'",
+                    (f"{type(exc).__name__}: {exc}", now(), task_id))
+
+        task = asyncio.get_running_loop().create_task(_run())
+        self._task_runs[task_id] = task
+        task.add_done_callback(lambda _: self._task_runs.pop(task_id, None))
+        return await self.get_task(task_id)
+
+    async def get_task(self, task_id: str) -> dict[str, Any]:
+        row = await self.ctx.db.fetchone("SELECT * FROM a2a_tasks WHERE id=?",
+                                         (task_id,))
+        if not row:
+            raise NotFoundError(f"Task {task_id} not found")
+        out = dict(row)
+        out["input"] = from_json(row["input"])
+        out["output"] = from_json(row["output"])
+        return out
+
+    async def list_tasks(self, agent_name: str | None = None,
+                         limit: int = 100) -> list[dict[str, Any]]:
+        if agent_name:
+            rows = await self.ctx.db.fetchall(
+                "SELECT t.* FROM a2a_tasks t JOIN a2a_agents a ON a.id=t.agent_id"
+                " WHERE a.name=? OR a.slug=? ORDER BY t.created_at DESC LIMIT ?",
+                (agent_name, agent_name, limit))
+        else:
+            rows = await self.ctx.db.fetchall(
+                "SELECT * FROM a2a_tasks ORDER BY created_at DESC LIMIT ?", (limit,))
+        out = []
+        for row in rows:
+            entry = dict(row)
+            entry["input"] = from_json(row["input"])
+            entry["output"] = from_json(row["output"])
+            out.append(entry)
+        return out
+
+    async def cancel_task(self, task_id: str) -> dict[str, Any]:
+        run = self._task_runs.pop(task_id, None)
+        if run is not None and not run.done():
+            run.cancel()
+        await self.ctx.db.execute(
+            "UPDATE a2a_tasks SET state='cancelled', updated_at=? WHERE id=?"
+            " AND state IN ('submitted','working')", (now(), task_id))
+        return await self.get_task(task_id)
 
     @staticmethod
     def _as_a2a_reply(text: str) -> dict[str, Any]:

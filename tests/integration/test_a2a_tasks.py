@@ -1,0 +1,51 @@
+"""A2A task store: async message/send with polling + cancellation."""
+
+import asyncio
+
+import aiohttp
+
+from tests.integration.test_a2a_llm_admin import make_jsonrpc_agent_server
+from tests.integration.test_gateway_app import BASIC, make_client
+
+AUTH = aiohttp.BasicAuth(*BASIC)
+
+
+async def test_task_lifecycle():
+    gateway = await make_client()
+    agent_server = await make_jsonrpc_agent_server()
+    try:
+        url = f"http://{agent_server.server.host}:{agent_server.server.port}/"
+        await gateway.post("/a2a", json={
+            "name": "task-agent", "endpoint_url": url, "agent_type": "jsonrpc"},
+            auth=AUTH)
+        resp = await gateway.post("/a2a/task-agent/tasks", json={
+            "message": "long running job"}, auth=AUTH)
+        assert resp.status == 201
+        task = await resp.json()
+        assert task["state"] in ("submitted", "working", "completed")
+
+        # poll to completion
+        for _ in range(40):
+            resp = await gateway.get(f"/a2a/tasks/{task['id']}", auth=AUTH)
+            task = await resp.json()
+            if task["state"] in ("completed", "failed"):
+                break
+            await asyncio.sleep(0.05)
+        assert task["state"] == "completed", task
+        assert "agent-echo" in str(task["output"])
+
+        resp = await gateway.get("/a2a/task-agent/tasks", auth=AUTH)
+        tasks = await resp.json()
+        assert len(tasks) == 1
+
+        # unknown task -> 404
+        resp = await gateway.get("/a2a/tasks/nope", auth=AUTH)
+        assert resp.status == 404
+
+        # migration v2 applied on a fresh db (schema_migrations has 2 rows)
+        rows = await gateway.app["ctx"].db.fetchall(
+            "SELECT version FROM schema_migrations ORDER BY version")
+        assert [r["version"] for r in rows] == [1, 2]
+    finally:
+        await agent_server.close()
+        await gateway.close()
