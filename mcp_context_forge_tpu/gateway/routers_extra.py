@@ -12,7 +12,7 @@ from aiohttp import web
 from pydantic import ValidationError
 
 from ..schemas import A2AAgentCreate
-from ..services.base import ValidationFailure
+from ..services.base import NotFoundError, ValidationFailure
 
 
 def setup_extra_routes(app: web.Application) -> None:
@@ -68,17 +68,33 @@ def setup_extra_routes(app: web.Application) -> None:
             request.match_info["name"], payload, user=request["auth"].user)
         return web.json_response(task, status=201)
 
-    @routes.get("/a2a/tasks/{task_id}")
-    async def get_task(request: web.Request) -> web.Response:
-        request["auth"].require("a2a.read")
-        return web.json_response(
-            await request.app["a2a_service"].get_task(request.match_info["task_id"]))
-
+    # {name}/tasks registers BEFORE tasks/{task_id}: an agent literally
+    # named "tasks" must still resolve its task list
     @routes.get("/a2a/{name}/tasks")
     async def list_tasks(request: web.Request) -> web.Response:
         request["auth"].require("a2a.read")
         return web.json_response(await request.app["a2a_service"].list_tasks(
             request.match_info["name"]))
+
+    @routes.get("/a2a/tasks/{task_id}")
+    async def get_task(request: web.Request) -> web.Response:
+        request["auth"].require("a2a.read")
+        task_id = request.match_info["task_id"]
+        service = request.app["a2a_service"]
+        try:
+            return web.json_response(await service.get_task(task_id))
+        except NotFoundError:
+            # /a2a/tasks/{x} collides with /a2a/{name}/tasks when an agent is
+            # literally named "tasks" — aiohttp's literal-prefix index picks
+            # this route regardless of registration order, so disambiguate:
+            # an unknown task id that names an existing agent means "list
+            # that agent's tasks"
+            agent = await request.app["ctx"].db.fetchone(
+                "SELECT id FROM a2a_agents WHERE name=? OR slug=?",
+                (task_id, task_id))
+            if agent:
+                return web.json_response(await service.list_tasks(task_id))
+            raise
 
     @routes.post("/a2a/tasks/{task_id}/cancel")
     async def cancel_task(request: web.Request) -> web.Response:

@@ -43,6 +43,7 @@ class A2AService:
     def __init__(self, ctx: AppContext):
         self.ctx = ctx
         self._task_runs: dict[str, Any] = {}  # task_id -> asyncio.Task
+        self.ctx.bus.subscribe("a2a.task.cancel", self._on_task_cancel)
 
     # ------------------------------------------------------------------ CRUD
 
@@ -242,12 +243,26 @@ class A2AService:
 
         import asyncio
 
+        agent_id = row["id"]
+
         async def _run() -> None:
+            # submitted→working is guarded: a cancel that landed first wins
             await self.ctx.db.execute(
-                "UPDATE a2a_tasks SET state='working', updated_at=? WHERE id=?",
-                (now(), task_id))
+                "UPDATE a2a_tasks SET state='working', updated_at=?"
+                " WHERE id=? AND state='submitted'", (now(), task_id))
+            current = await self.ctx.db.fetchone(
+                "SELECT state FROM a2a_tasks WHERE id=?", (task_id,))
+            if not current or current["state"] != "working":
+                return  # cancelled before it started
             try:
-                result = await self.invoke_agent(agent_name, payload, user=user)
+                # resolve by stored id: a rename between submit and run must
+                # not fail the task (and saves re-resolving by name)
+                agent_row = await self.ctx.db.fetchone(
+                    "SELECT name FROM a2a_agents WHERE id=?", (agent_id,))
+                if not agent_row:
+                    raise NotFoundError("Agent was deleted")
+                result = await self.invoke_agent(agent_row["name"], payload,
+                                                 user=user)
                 # guard on state: a cancel (possibly from another worker)
                 # must not be overwritten by a late completion
                 await self.ctx.db.execute(
@@ -297,10 +312,19 @@ class A2AService:
         run = self._task_runs.pop(task_id, None)
         if run is not None and not run.done():
             run.cancel()
+        else:
+            # the run may live on another worker: broadcast so the owner
+            # aborts its in-flight invocation too
+            await self.ctx.bus.publish("a2a.task.cancel", {"task_id": task_id})
         await self.ctx.db.execute(
             "UPDATE a2a_tasks SET state='cancelled', updated_at=? WHERE id=?"
             " AND state IN ('submitted','working')", (now(), task_id))
         return await self.get_task(task_id)
+
+    async def _on_task_cancel(self, topic: str, message: dict[str, Any]) -> None:
+        run = self._task_runs.pop(message.get("task_id", ""), None)
+        if run is not None and not run.done():
+            run.cancel()
 
     @staticmethod
     def _as_a2a_reply(text: str) -> dict[str, Any]:

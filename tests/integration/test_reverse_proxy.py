@@ -16,7 +16,7 @@ async def test_reverse_tunnel_register_and_call():
                                 "tools": [{"name": "local-time",
                                            "description": "time on the NAT box",
                                            "inputSchema": {"type": "object"}}]})
-            reg = await ws.receive_json(timeout=10)
+            reg = await ws.receive_json(timeout=60)
             assert reg["type"] == "registered"
 
             # the tunneled tool appears in the catalog
@@ -29,7 +29,8 @@ async def test_reverse_tunnel_register_and_call():
             import asyncio
 
             async def answer():
-                frame = await ws.receive_json(timeout=15)
+                # generous: the full suite runs jit compiles concurrently
+                frame = await ws.receive_json(timeout=60)
                 assert frame["type"] == "rpc"
                 message = frame["message"]
                 assert message["params"]["name"] == "local-time"

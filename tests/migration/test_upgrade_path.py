@@ -1,0 +1,51 @@
+"""Migration upgrade matrix (reference: tests/migration/ cross-version
+upgrades): a database created at an older schema version upgrades cleanly
+with data intact."""
+
+import time
+
+from mcp_context_forge_tpu.db import MIGRATIONS, Database
+
+
+async def test_v1_database_upgrades_to_head(tmp_path):
+    path = str(tmp_path / "old.db")
+    # create a v1-only database with data
+    db = Database(path)
+    await db.connect()
+    applied = await db.migrate(MIGRATIONS[:1])
+    assert applied == 1
+    now = time.time()
+    await db.execute(
+        "INSERT INTO a2a_agents (id, name, slug, endpoint_url, created_at,"
+        " updated_at) VALUES ('a1','agent','agent','http://x',?,?)", (now, now))
+    await db.close()
+
+    # reopen and upgrade to head
+    db2 = Database(path)
+    await db2.connect()
+    applied = await db2.migrate(MIGRATIONS)
+    assert applied == len(MIGRATIONS) - 1  # only the new revisions
+    # old data intact, new table usable with FK to old data
+    row = await db2.fetchone("SELECT * FROM a2a_agents WHERE id='a1'")
+    assert row is not None
+    await db2.execute(
+        "INSERT INTO a2a_tasks (id, agent_id, state, created_at, updated_at)"
+        " VALUES ('t1','a1','submitted',?,?)", (now, now))
+    task = await db2.fetchone("SELECT * FROM a2a_tasks WHERE id='t1'")
+    assert task["agent_id"] == "a1"
+    # FK cascade from the old table into the new one
+    await db2.execute("DELETE FROM a2a_agents WHERE id='a1'")
+    assert await db2.fetchone("SELECT * FROM a2a_tasks WHERE id='t1'") is None
+    await db2.close()
+
+
+async def test_head_database_boot_is_noop(tmp_path):
+    path = str(tmp_path / "head.db")
+    db = Database(path)
+    await db.connect()
+    await db.migrate(MIGRATIONS)
+    await db.close()
+    db2 = Database(path)
+    await db2.connect()
+    assert await db2.migrate(MIGRATIONS) == 0
+    await db2.close()
