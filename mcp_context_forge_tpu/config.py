@@ -91,6 +91,8 @@ class Settings(BaseModel):
     # --- observability ---
     otel_enable: bool = True
     otel_exporter: Literal["none", "console", "otlp", "memory"] = "memory"
+    otel_db_store: bool = True           # persist notable spans to the DB
+    otel_db_min_duration_ms: float = 50  # slow-span threshold (errors always kept)
     otel_service_name: str = "mcpforge"
     log_level: str = "INFO"
     log_json: bool = False

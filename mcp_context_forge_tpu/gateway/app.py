@@ -57,6 +57,38 @@ async def build_app(settings: Settings | None = None) -> web.Application:
 
     ctx = AppContext(settings=settings, db=db, bus=bus, leases=leases,
                      tracer=tracer, metrics=metrics)
+
+    if settings.otel_db_store:
+        # in-DB trace store (reference observability_service: separate-path
+        # writes so spans survive failed request transactions). Sampled:
+        # errors always, successes over the slow threshold.
+        import asyncio as _aio
+        import json as _json
+
+        def _db_sink(span) -> None:
+            if span.status != "ERROR" and (
+                    span.duration_ms or 0) < settings.otel_db_min_duration_ms:
+                return
+
+            async def _write() -> None:
+                try:
+                    await db.execute(
+                        "INSERT OR IGNORE INTO observability_spans (span_id,"
+                        " trace_id, parent_span_id, name, start_ts, end_ts,"
+                        " status, attributes) VALUES (?,?,?,?,?,?,?,?)",
+                        (span.span_id, span.trace_id, span.parent_span_id,
+                         span.name, span.start_ts, span.end_ts, span.status,
+                         _json.dumps({k: str(v) for k, v in
+                                      span.attributes.items()})))
+                except Exception:
+                    pass
+
+            try:
+                _aio.get_running_loop().create_task(_write())
+            except RuntimeError:
+                pass  # span finished outside the loop (tests)
+
+        tracer.add_sink(_db_sink)
     app["ctx"] = ctx
     app["rate_limiter"] = RateLimiter(settings.rate_limit_rps, settings.rate_limit_burst)
 
@@ -123,6 +155,21 @@ async def build_app(settings: Settings | None = None) -> web.Application:
                                sampling_handler=sampling_handler)
     app["dispatcher"] = dispatcher
     transport = StreamableHTTPTransport(dispatcher, settings)
+
+    # MCP listChanged notifications: catalog mutations fan out to every
+    # connected stateful session (reference: notification_service +
+    # notifications/*/list_changed)
+    def _notify(method: str):
+        async def handler(topic, message):
+            await transport.sessions.broadcast(
+                {"jsonrpc": "2.0", "method": method})
+        return handler
+
+    bus.subscribe("tools.changed", _notify("notifications/tools/list_changed"))
+    bus.subscribe("resources.changed",
+                  _notify("notifications/resources/list_changed"))
+    bus.subscribe("prompts.changed",
+                  _notify("notifications/prompts/list_changed"))
     app["streamable_transport"] = transport
     app.router.add_post("/mcp", transport.handle_post)
     app.router.add_get("/mcp", transport.handle_get)

@@ -343,6 +343,11 @@ def setup_routes(app: web.Application) -> None:
         request["auth"].require("observability.read")
         tracer = request.app["ctx"].tracer
         limit = int(request.query.get("limit", "100"))
+        if request.query.get("store") == "db":
+            rows = await request.app["ctx"].db.fetchall(
+                "SELECT * FROM observability_spans ORDER BY start_ts DESC LIMIT ?",
+                (min(limit, 1000),))
+            return web.json_response(rows)
         spans = tracer.finished[-limit:]
         return web.json_response([{
             "name": s.name, "trace_id": s.trace_id, "span_id": s.span_id,

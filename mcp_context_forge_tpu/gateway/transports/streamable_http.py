@@ -130,6 +130,14 @@ class SessionManager:
         except asyncio.QueueFull:
             return False
 
+    async def broadcast(self, message: dict[str, Any]) -> int:
+        """Send a notification to every live session (listChanged fanout)."""
+        count = 0
+        for session_id in list(self.sessions):
+            if await self.send_to_session(session_id, message):
+                count += 1
+        return count
+
 
 def _sse_frame(event_id: str | None, data: Any) -> bytes:
     lines = []

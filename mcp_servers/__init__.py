@@ -1,0 +1,8 @@
+"""Sample MCP servers (reference: mcp-servers/ — demo servers used in
+quickstarts and the compose test stack). Each is a single-file stdio MCP
+server runnable standalone or through the translate bridge:
+
+    python -m mcp_servers.time_server                 # stdio
+    python -m mcp_context_forge_tpu.translate \\
+        --stdio "python -m mcp_servers.time_server" --port 9100
+"""

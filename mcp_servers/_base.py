@@ -1,0 +1,71 @@
+"""Tiny stdio MCP server framework for the sample servers."""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Callable
+
+
+class StdioMCPServer:
+    def __init__(self, name: str, version: str = "0.1.0"):
+        self.name = name
+        self.version = version
+        self._tools: dict[str, tuple[dict[str, Any], Callable]] = {}
+
+    def tool(self, name: str, description: str = "",
+             input_schema: dict[str, Any] | None = None):
+        def decorator(fn: Callable) -> Callable:
+            self._tools[name] = ({
+                "name": name, "description": description,
+                "inputSchema": input_schema or {"type": "object", "properties": {}},
+            }, fn)
+            return fn
+        return decorator
+
+    def _handle(self, message: dict[str, Any]) -> dict[str, Any] | None:
+        method = message.get("method", "")
+        if "id" not in message:
+            return None
+        if method == "initialize":
+            result: Any = {"protocolVersion": "2025-06-18",
+                           "capabilities": {"tools": {}},
+                           "serverInfo": {"name": self.name,
+                                          "version": self.version}}
+        elif method == "ping":
+            result = {}
+        elif method == "tools/list":
+            result = {"tools": [spec for spec, _ in self._tools.values()]}
+        elif method == "tools/call":
+            name = message.get("params", {}).get("name", "")
+            arguments = message.get("params", {}).get("arguments", {}) or {}
+            entry = self._tools.get(name)
+            if entry is None:
+                return {"jsonrpc": "2.0", "id": message["id"],
+                        "error": {"code": -32602, "message": f"Unknown tool {name!r}"}}
+            try:
+                output = entry[1](**arguments)
+                result = {"content": [{"type": "text", "text": str(output)}],
+                          "isError": False}
+            except Exception as exc:
+                result = {"content": [{"type": "text",
+                                       "text": f"{type(exc).__name__}: {exc}"}],
+                          "isError": True}
+        else:
+            return {"jsonrpc": "2.0", "id": message["id"],
+                    "error": {"code": -32601, "message": f"Unknown method {method!r}"}}
+        return {"jsonrpc": "2.0", "id": message["id"], "result": result}
+
+    def run(self) -> None:
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                message = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            response = self._handle(message)
+            if response is not None:
+                sys.stdout.write(json.dumps(response) + "\n")
+                sys.stdout.flush()

@@ -1,0 +1,36 @@
+"""Sample MCP server: fast echo/compute tools (the reference compose stack's
+``fast_test_server`` analog, used for benchmarking the gateway overhead)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from ._base import StdioMCPServer
+
+server = StdioMCPServer("fast-test-server")
+
+
+@server.tool("echo", "Echo the arguments back", {
+    "type": "object", "properties": {"payload": {"type": "string"}}})
+def echo(**kwargs) -> str:
+    return json.dumps(kwargs)
+
+
+@server.tool("sha256", "SHA-256 of a string", {
+    "type": "object", "properties": {"text": {"type": "string"}},
+    "required": ["text"]})
+def sha256(text: str) -> str:
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+@server.tool("sum", "Sum a list of numbers", {
+    "type": "object", "properties": {"numbers": {"type": "array",
+                                                 "items": {"type": "number"}}},
+    "required": ["numbers"]})
+def total(numbers: list) -> float:
+    return float(sum(numbers))
+
+
+if __name__ == "__main__":
+    server.run()

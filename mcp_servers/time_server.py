@@ -1,0 +1,37 @@
+"""Sample MCP server: time utilities (reference mcp-servers analog)."""
+
+from __future__ import annotations
+
+import datetime
+
+from ._base import StdioMCPServer
+
+server = StdioMCPServer("time-server")
+
+
+@server.tool("now", "Current UTC time (ISO 8601)")
+def now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+
+@server.tool("add_days", "Add days to an ISO date", {
+    "type": "object",
+    "properties": {"date": {"type": "string"}, "days": {"type": "integer"}},
+    "required": ["date", "days"]})
+def add_days(date: str, days: int) -> str:
+    parsed = datetime.datetime.fromisoformat(date)
+    return (parsed + datetime.timedelta(days=int(days))).isoformat()
+
+
+@server.tool("diff_days", "Days between two ISO dates", {
+    "type": "object",
+    "properties": {"a": {"type": "string"}, "b": {"type": "string"}},
+    "required": ["a", "b"]})
+def diff_days(a: str, b: str) -> int:
+    da = datetime.datetime.fromisoformat(a)
+    db = datetime.datetime.fromisoformat(b)
+    return abs((db - da).days)
+
+
+if __name__ == "__main__":
+    server.run()
