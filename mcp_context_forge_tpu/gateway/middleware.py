@@ -82,16 +82,37 @@ async def security_headers_middleware(request: web.Request, handler: Handler) ->
 class RateLimiter:
     """Per-client token bucket (reference RateLimitMiddleware)."""
 
-    def __init__(self, rps: int, burst: int) -> None:
+    # a bucket that would refill to full is state-free (recreating it at
+    # full burst is identical), so it can be pruned losslessly; prune so IP
+    # churn cannot grow the dict without bound
+    _SWEEP_INTERVAL = 60.0
+
+    def __init__(self, rps: int, burst: int, max_buckets: int = 100_000) -> None:
         self.rps = rps
         self.burst = burst
+        self.max_buckets = max_buckets
         self._buckets: dict[str, tuple[float, float]] = {}  # key -> (tokens, last)
+        self._next_sweep = time.monotonic() + self._SWEEP_INTERVAL
+
+    def _sweep(self, now: float) -> None:
+        self._buckets = {
+            k: (tokens, last) for k, (tokens, last) in self._buckets.items()
+            if tokens + (now - last) * self.rps < self.burst}
+        if len(self._buckets) > self.max_buckets:
+            # flood of still-draining keys: evict the least-recently-seen so
+            # the post-sweep size is bounded and allow() stays amortized O(1)
+            keep = sorted(self._buckets.items(), key=lambda kv: kv[1][1],
+                          reverse=True)[: self.max_buckets]
+            self._buckets = dict(keep)
+        self._next_sweep = now + self._SWEEP_INTERVAL
 
     def allow(self, key: str) -> bool:
         if self.rps <= 0:
             return True
-        tokens, last = self._buckets.get(key, (float(self.burst), time.monotonic()))
         now = time.monotonic()
+        if now >= self._next_sweep or len(self._buckets) > self.max_buckets:
+            self._sweep(now)
+        tokens, last = self._buckets.get(key, (float(self.burst), now))
         tokens = min(self.burst, tokens + (now - last) * self.rps)
         if tokens < 1.0:
             self._buckets[key] = (tokens, now)

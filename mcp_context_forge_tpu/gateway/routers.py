@@ -94,7 +94,7 @@ def setup_routes(app: web.Application) -> None:
             auth.user, body.get("name", "api-token"),
             server_id=body.get("server_id"),
             permissions=body.get("permissions"),
-            expires_minutes=body.get("expires_minutes"))
+            expires_minutes=body.get("expires_minutes"), grantor=auth)
         return web.json_response({"token": token, "id": token_id}, status=201)
 
     @routes.get("/auth/tokens")

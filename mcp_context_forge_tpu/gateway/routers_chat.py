@@ -83,9 +83,12 @@ def setup_chat_routes(app: web.Application) -> None:
 
     @routes.get("/teams/{team_id}")
     async def get_team(request: web.Request) -> web.Response:
-        request["auth"].require("teams.read")
+        auth = request["auth"]
+        auth.require("teams.read")
         return web.json_response(
-            await request.app["team_service"].get_team(request.match_info["team_id"]))
+            await request.app["team_service"].get_team(
+                request.match_info["team_id"], actor=auth.user,
+                is_admin=auth.is_admin))
 
     @routes.delete("/teams/{team_id}")
     async def delete_team(request: web.Request) -> web.Response:

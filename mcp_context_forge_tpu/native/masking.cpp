@@ -11,6 +11,7 @@
 // open-addressing cache memoizes key→sensitive decisions (keys repeat heavily
 // across log records).
 
+#include <atomic>
 #include <cctype>
 #include <cstdint>
 #include <cstdlib>
@@ -25,14 +26,11 @@ const char* kSensitiveSubstrings[] = {
     "cookie", "x-api-key", "client_secret", "access_key", "bearer",
 };
 
-struct CacheEntry {
-  uint64_t hash = 0;
-  bool sensitive = false;
-  bool used = false;
-};
-
+// Each entry packs (hash & ~1) | sensitive-bit into one atomic word so that
+// concurrent readers/writers (ctypes releases the GIL) can never observe a
+// torn hash/verdict pair. 0 doubles as the empty sentinel.
 constexpr size_t kCacheSize = 512;  // power of two
-CacheEntry g_cache[kCacheSize];
+std::atomic<uint64_t> g_cache[kCacheSize];
 
 uint64_t fnv1a(const char* data, size_t len) {
   uint64_t hash = 1469598103934665603ull;
@@ -54,11 +52,16 @@ bool key_is_sensitive(const char* key, size_t len) {
   std::string lower(len, '\0');
   for (size_t i = 0; i < len; ++i)
     lower[i] = static_cast<char>(std::tolower(static_cast<unsigned char>(key[i])));
-  uint64_t hash = fnv1a(lower.data(), lower.size());
-  CacheEntry& slot = g_cache[hash & (kCacheSize - 1)];
-  if (slot.used && slot.hash == hash) return slot.sensitive;
+  // bit 63 marks "occupied" (so 0 stays the empty sentinel) without
+  // biasing the low bits used for slot selection
+  uint64_t hash = fnv1a(lower.data(), lower.size()) | (1ull << 63);
+  std::atomic<uint64_t>& slot = g_cache[(hash >> 1) & (kCacheSize - 1)];
+  uint64_t packed = slot.load(std::memory_order_relaxed);
+  if ((packed & ~1ull) == (hash & ~1ull) && packed != 0)
+    return packed & 1ull;
   bool sensitive = key_is_sensitive_uncached(lower);
-  slot = {hash, sensitive, true};
+  slot.store((hash & ~1ull) | (sensitive ? 1ull : 0ull),
+             std::memory_order_relaxed);
   return sensitive;
 }
 
@@ -142,6 +145,7 @@ char* mask_sensitive(const char* input, size_t len) {
     }
   }
   char* result = static_cast<char*>(std::malloc(out.size() + 1));
+  if (result == nullptr) return nullptr;  // caller treats as "mask in Python"
   std::memcpy(result, out.data(), out.size());
   result[out.size()] = '\0';
   return result;

@@ -60,8 +60,14 @@ class AuthContext:
     token_jti: str | None = None
     server_id: str | None = None  # server-scoped token restriction
     via: str = "jwt"  # jwt|basic|anonymous
+    scoped: bool = False  # token carries explicit scopes: no admin shortcut
 
     def can(self, permission: str) -> bool:
+        # Scoped tokens derive power solely from their scopes — an admin's
+        # read-only CI token must not retain admin.all (reference enforces
+        # this via token_scoping middleware regardless of admin status).
+        if self.scoped:
+            return "admin.all" in self.permissions or permission in self.permissions
         return self.is_admin or "admin.all" in self.permissions or permission in self.permissions
 
     def require(self, permission: str) -> None:
@@ -158,8 +164,35 @@ class AuthService:
     async def create_api_token(self, email: str, name: str,
                                server_id: str | None = None,
                                permissions: list[str] | None = None,
-                               expires_minutes: int | None = None) -> tuple[str, str]:
-        """Catalogued API token: returns (token, token_id). Revocable by jti."""
+                               expires_minutes: int | None = None,
+                               grantor: AuthContext | None = None) -> tuple[str, str]:
+        """Catalogued API token: returns (token, token_id). Revocable by jti.
+
+        When ``grantor`` is given, requested permissions must be a subset of
+        the grantor's effective permissions (no minting admin.all from a
+        tokens.manage-scoped token), and a scoped grantor can only mint
+        tokens at most as powerful as itself.
+        """
+        if grantor is not None:
+            if grantor.server_id:
+                # a server-scoped token must not mint a token that escapes
+                # its server confinement
+                if server_id and server_id != grantor.server_id:
+                    raise PermissionDenied(
+                        "Cannot mint a token for a different server")
+                server_id = grantor.server_id
+            if permissions:
+                unknown = [p for p in permissions if p not in PERMISSIONS]
+                if unknown:
+                    raise PermissionDenied(f"Unknown permissions: {unknown}")
+                denied = [p for p in permissions if not grantor.can(p)]
+                if denied:
+                    raise PermissionDenied(
+                        f"Cannot grant permissions beyond your own: {denied}")
+            elif grantor.scoped:
+                # an unscoped token would inherit the user's full power —
+                # cap it at the grantor's scopes instead
+                permissions = sorted(grantor.permissions)
         jti = new_id()
         token = self.issue_jwt(email, expires_minutes=expires_minutes,
                                extra={"jti": jti,
@@ -221,16 +254,26 @@ class AuthService:
             raise AuthError("User deactivated")
         is_admin = bool(user_row and user_row["is_admin"])
         scopes = payload.get("scopes")
-        perms = set(scopes) if scopes else (
-            set(PERMISSIONS) if is_admin else set(DEFAULT_USER_PERMISSIONS))
+        if scopes:
+            perms = set(scopes) & PERMISSIONS
+            # is_admin feeds direct checks in several services; a scoped
+            # token only keeps it when admin.all was explicitly granted
+            is_admin = is_admin and "admin.all" in perms
+        else:
+            perms = set(PERMISSIONS) if is_admin else set(DEFAULT_USER_PERMISSIONS)
         return AuthContext(user=email, is_admin=is_admin,
                            teams=await self.user_teams(email),
                            permissions=perms, token_jti=jti,
-                           server_id=payload.get("server_id"), via="jwt")
+                           server_id=payload.get("server_id"), via="jwt",
+                           scoped=bool(scopes))
 
     async def resolve_basic(self, username: str, password: str) -> AuthContext:
+        import hmac
+
         settings = self.ctx.settings
-        if username == settings.basic_auth_user and password == settings.basic_auth_password:
+        user_ok = hmac.compare_digest(username.encode(), settings.basic_auth_user.encode())
+        pass_ok = hmac.compare_digest(password.encode(), settings.basic_auth_password.encode())
+        if user_ok and pass_ok:
             return AuthContext(user=settings.platform_admin_email, is_admin=True,
                                permissions=set(PERMISSIONS), via="basic")
         if await self.verify_password(username, password):

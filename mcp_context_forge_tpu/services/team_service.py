@@ -38,13 +38,23 @@ class TeamService:
             " VALUES (?,?,?,?)", (team_id, created_by, "owner", ts))
         return await self.get_team(team_id)
 
-    async def get_team(self, team_id: str) -> dict[str, Any]:
+    async def get_team(self, team_id: str, actor: str | None = None,
+                       is_admin: bool = False) -> dict[str, Any]:
+        """Fetch a team. When an ``actor`` is given, private teams and their
+        member rosters are only returned to members (or platform admins) —
+        teams.read alone must not disclose private rosters."""
         row = await self.ctx.db.fetchone("SELECT * FROM teams WHERE id=?", (team_id,))
         if not row:
             raise NotFoundError(f"Team {team_id} not found")
         members = await self.ctx.db.fetchall(
             "SELECT user_email, role, joined_at FROM team_members WHERE team_id=?",
             (team_id,))
+        if actor is not None and not is_admin:
+            is_member = any(m["user_email"] == actor for m in members)
+            if not is_member:
+                if row["visibility"] != "public":
+                    raise NotFoundError(f"Team {team_id} not found")
+                return {**row, "members": []}
         return {**row, "members": members}
 
     async def list_teams(self, user: str | None = None) -> list[dict[str, Any]]:

@@ -97,10 +97,11 @@ def mask_text(text: str) -> str:
     if lib is not None:
         raw = text.encode("utf-8", errors="replace")
         ptr = lib.mask_sensitive(raw, len(raw))
-        try:
-            return ctypes.string_at(ptr).decode("utf-8", errors="replace")
-        finally:
-            lib.mask_free(ptr)
+        if ptr:  # NULL on OOM -> fall through to the Python path
+            try:
+                return ctypes.string_at(ptr).decode("utf-8", errors="replace")
+            finally:
+                lib.mask_free(ptr)
     return _mask_python(text)
 
 

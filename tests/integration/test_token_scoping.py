@@ -1,0 +1,102 @@
+"""Scoped-token enforcement + private-team disclosure (ADVICE round 1).
+
+Reference behavior: token_scoping middleware restricts even admin-issued
+tokens to their declared scopes, and token creation cannot grant
+permissions beyond the caller's own effective grants.
+"""
+
+import aiohttp
+
+from test_gateway_app import BASIC, make_client
+
+
+async def _scoped_token(client, permissions, name="scoped"):
+    resp = await client.post("/auth/tokens",
+                             json={"name": name, "permissions": permissions},
+                             auth=aiohttp.BasicAuth(*BASIC))
+    assert resp.status == 201, await resp.text()
+    return (await resp.json())["token"]
+
+
+async def test_scoped_token_does_not_inherit_admin():
+    client = await make_client()
+    try:
+        token = await _scoped_token(client, ["tools.read"])
+        headers = {"authorization": f"Bearer {token}"}
+        resp = await client.get("/tools", headers=headers)
+        assert resp.status == 200
+        # admin user, but the read-only token must not create tools
+        resp = await client.post("/tools", json={
+            "name": "t", "integration_type": "REST", "request_type": "POST",
+            "url": "http://127.0.0.1:1/x"}, headers=headers)
+        assert resp.status == 403, await resp.text()
+        # nor read teams (permission absent from scopes)
+        resp = await client.get("/teams", headers=headers)
+        assert resp.status == 403
+    finally:
+        await client.close()
+
+
+async def test_scoped_token_cannot_mint_broader_token():
+    client = await make_client()
+    try:
+        token = await _scoped_token(client, ["tokens.manage", "tools.read"])
+        headers = {"authorization": f"Bearer {token}"}
+        # privilege escalation: request admin.all from a limited token
+        resp = await client.post("/auth/tokens", json={
+            "name": "evil", "permissions": ["admin.all"]}, headers=headers)
+        assert resp.status == 403, await resp.text()
+        # unknown permission names rejected too
+        resp = await client.post("/auth/tokens", json={
+            "name": "bogus", "permissions": ["everything.forever"]}, headers=headers)
+        assert resp.status == 403
+        # unscoped mint from a scoped token is capped at the caller's scopes
+        resp = await client.post("/auth/tokens", json={"name": "child"},
+                                 headers=headers)
+        assert resp.status == 201
+        child = (await resp.json())["token"]
+        child_headers = {"authorization": f"Bearer {child}"}
+        resp = await client.get("/tools", headers=child_headers)
+        assert resp.status == 200
+        resp = await client.get("/teams", headers=child_headers)
+        assert resp.status == 403
+    finally:
+        await client.close()
+
+
+async def test_equal_scope_mint_allowed():
+    client = await make_client()
+    try:
+        token = await _scoped_token(client, ["tokens.manage", "tools.read"])
+        headers = {"authorization": f"Bearer {token}"}
+        resp = await client.post("/auth/tokens", json={
+            "name": "same", "permissions": ["tools.read"]}, headers=headers)
+        assert resp.status == 201, await resp.text()
+    finally:
+        await client.close()
+
+
+async def test_private_team_roster_not_disclosed():
+    client = await make_client()
+    try:
+        auth = aiohttp.BasicAuth(*BASIC)
+        resp = await client.post("/teams", json={
+            "name": "secret-ops", "visibility": "private"}, auth=auth)
+        assert resp.status == 201, await resp.text()
+        team = await resp.json()
+        # second, non-member user
+        auth_service = client.app["auth_service"]
+        await auth_service.create_user("outsider@example.com", "outsider-pw-123")
+        resp = await client.post("/auth/login", json={
+            "email": "outsider@example.com", "password": "outsider-pw-123"})
+        assert resp.status == 200
+        jwt_token = (await resp.json())["access_token"]
+        headers = {"authorization": f"Bearer {jwt_token}"}
+        resp = await client.get(f"/teams/{team['id']}", headers=headers)
+        assert resp.status == 404, await resp.text()
+        # admin still sees it
+        resp = await client.get(f"/teams/{team['id']}", auth=auth)
+        assert resp.status == 200
+        assert (await resp.json())["members"]
+    finally:
+        await client.close()
