@@ -51,10 +51,8 @@ class TeamService:
             (team_id,))
         if actor is not None and not is_admin:
             is_member = any(m["user_email"] == actor for m in members)
-            if not is_member:
-                if row["visibility"] != "public":
-                    raise NotFoundError(f"Team {team_id} not found")
-                return {**row, "members": []}
+            if not is_member and row["visibility"] != "public":
+                raise NotFoundError(f"Team {team_id} not found")
         return {**row, "members": members}
 
     async def list_teams(self, user: str | None = None) -> list[dict[str, Any]]:

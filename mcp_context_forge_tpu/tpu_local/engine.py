@@ -1,16 +1,20 @@
 """Continuous-batching inference engine.
 
 The crux component (SURVEY.md §7.2 #1): an asyncio front (request queue,
-tokenizer, per-request token streams) bridged to a device loop that
-interleaves bucketed prefill with fixed-capacity decode steps over the paged
-KV cache. XLA's static-shape discipline is respected everywhere:
+tokenizer, per-request token streams) bridged to a **dispatch thread** that
+owns every device sync, so decode steps never stall the gateway's event
+loop (SURVEY.md §7.2 #3 — "one process cannot block the event loop on
+jax.device_get"). XLA's static-shape discipline is respected everywhere:
 
-- prefill compiles once per (bucket, batch=1) shape from
-  ``tpu_local_prefill_buckets``;
+- prefill compiles once per (prefill_batch, bucket) shape — admissions are
+  batched up to ``prefill_max_batch`` requests sharing a bucket, so bursts
+  amortize the forward pass instead of serializing behind each other;
 - decode compiles once for the full [max_batch] slot array — inactive slots
   ride along masked (position 0 into the trash page);
 - sampling params are per-slot device arrays, so mixed greedy/temperature
-  requests share one compiled step.
+  requests share one compiled step, and the FIRST token is sampled on
+  device with the same kernel + engine PRNG as every later token (one
+  sampler, one RNG stream).
 
 The engine is a single-owner of its mesh/slice: gateway workers reach it
 in-process (single worker) or over the /v1 HTTP surface (multi-worker),
@@ -21,7 +25,10 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import queue
+import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, AsyncIterator
@@ -49,6 +56,7 @@ class EngineConfig:
     page_size: int = 128
     num_pages: int = 512
     prefill_buckets: tuple[int, ...] = (128, 512, 2048)
+    prefill_max_batch: int = 4      # admissions fused into one prefill call
     mesh_shape: str = ""
     dtype: str = "bfloat16"
     max_queue: int = 1024
@@ -64,6 +72,7 @@ class EngineConfig:
             page_size=settings.tpu_local_page_size,
             num_pages=settings.tpu_local_num_pages,
             prefill_buckets=tuple(settings.tpu_local_prefill_buckets),
+            prefill_max_batch=getattr(settings, "tpu_local_prefill_max_batch", 4),
             mesh_shape=settings.tpu_local_mesh_shape,
             dtype=settings.tpu_local_dtype,
         )
@@ -96,11 +105,14 @@ class EngineStats:
         self.prompt_tokens = 0
         self.completion_tokens = 0
         self.decode_steps = 0
+        self.prefill_batches = 0
+        self.prefill_requests = 0
         self.queue_depth = 0
 
 
 class TPUEngine:
-    """Owns params + KV pool on the mesh; runs the scheduler loop."""
+    """Owns params + KV pool on the mesh; device syncs run on the dispatch
+    thread, token emission hops back to the asyncio loop."""
 
     def __init__(self, config: EngineConfig):
         self.config = config
@@ -108,11 +120,13 @@ class TPUEngine:
         self.tokenizer = load_tokenizer(config.checkpoint,
                                         vocab_size=self.model_config.vocab_size)
         self.stats = EngineStats()
-        self._queue: asyncio.Queue[GenRequest] = asyncio.Queue(maxsize=config.max_queue)
-        self._running: dict[int, GenRequest] = {}  # slot -> request
-        self._loop_task: asyncio.Task | None = None
+        self._work: queue.Queue[GenRequest] = queue.Queue(maxsize=config.max_queue)
+        self._pending: deque[GenRequest] = deque()   # owned by dispatch thread
+        self._running: dict[int, GenRequest] = {}    # slot -> request (thread)
+        self._thread: threading.Thread | None = None
+        self._stop_event = threading.Event()
+        self._loop: asyncio.AbstractEventLoop | None = None
         self._started = False
-        self._dirty_tables = True
 
         dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
         self.mesh = make_mesh(config.mesh_shape)
@@ -148,12 +162,22 @@ class TPUEngine:
         self._rng = jax.random.PRNGKey(int(time.time()) & 0x7FFFFFFF)
 
         # compiled steps
-        self._prefill = jax.jit(partial(prefill, config=self.model_config,
-                                        attn_impl=config.attn_impl),
-                                donate_argnames=("kv",))
+        self._prefill_sample = jax.jit(self._prefill_and_sample,
+                                       donate_argnames=("kv",))
         self._decode = jax.jit(self._decode_and_sample, donate_argnames=("kv",))
 
     # ------------------------------------------------------------- device fns
+
+    def _prefill_and_sample(self, params, kv, tokens, positions, slot_ids,
+                            last_idx, sampling: SamplingParams, key):
+        """Batched prefill + on-device first-token sampling (same sampler and
+        PRNG stream as decode — round-1 VERDICT weak #5)."""
+        logits, kv = prefill(params, self.model_config, tokens, positions, kv,
+                             slot_ids, attn_impl=self.config.attn_impl)
+        B = tokens.shape[0]
+        last = logits[jnp.arange(B), last_idx]          # [B, V]
+        first = sample_tokens(last, sampling, key)
+        return first, kv
 
     def _decode_and_sample(self, params, kv, tokens, positions, slot_ids,
                            seq_lens, sampling: SamplingParams, key):
@@ -165,27 +189,37 @@ class TPUEngine:
     # --------------------------------------------------------------- lifecycle
 
     async def start(self) -> None:
-        if not self._started:
-            self._started = True
-            self._loop_task = asyncio.create_task(self._scheduler_loop())
+        if self._started:
+            return
+        self._started = True
+        self._loop = asyncio.get_running_loop()
+        self._stop_event.clear()
+        self._thread = threading.Thread(target=self._device_loop,
+                                        name="tpu-engine-dispatch", daemon=True)
+        self._thread.start()
 
     async def stop(self) -> None:
+        if not self._started:
+            return
         self._started = False
-        if self._loop_task is not None:
-            self._loop_task.cancel()
-            try:
-                await self._loop_task
-            except asyncio.CancelledError:
-                pass
-            self._loop_task = None
+        self._stop_event.set()
+        thread = self._thread
+        self._thread = None
+        if thread is not None:
+            await asyncio.to_thread(thread.join, 30.0)
 
     # ------------------------------------------------------------- submission
 
     async def submit(self, request: GenRequest) -> GenRequest:
         self.stats.requests += 1
         self.stats.prompt_tokens += len(request.prompt_ids)
-        await self._queue.put(request)
-        self.stats.queue_depth = self._queue.qsize()
+        while True:
+            try:
+                self._work.put_nowait(request)
+                break
+            except queue.Full:  # backpressure without blocking the loop
+                await asyncio.sleep(0.005)
+        self.stats.queue_depth = self._work.qsize() + len(self._pending)
         return request
 
     async def generate(self, prompt_ids: list[int], **kwargs) -> AsyncIterator[int]:
@@ -199,7 +233,45 @@ class TPUEngine:
                 break
             yield token
 
-    # ---------------------------------------------------------------- schedule
+    # --------------------------------------------------------- dispatch thread
+
+    def _device_loop(self) -> None:
+        """Owns every jax call + device sync. Never touched by the asyncio
+        loop; results hop back via loop.call_soon_threadsafe."""
+        try:
+            while not self._stop_event.is_set():
+                did_work = self._admit_batch()
+                if self._running:
+                    self._decode_step_all()
+                    did_work = True
+                self.stats.queue_depth = self._work.qsize() + len(self._pending)
+                if not did_work:
+                    time.sleep(0.001)
+        except Exception:
+            logger.exception("tpu_local dispatch thread crashed")
+        finally:
+            # a dead thread must not strand consumers on stream.get()
+            self._fail_outstanding(
+                "cancelled" if self._stop_event.is_set() else "error")
+
+    def _fail_outstanding(self, reason: str) -> None:
+        self._drain_work()
+        for request in list(self._running.values()):
+            if request.finish_reason is None:
+                request.finish_reason = reason
+            self._finish(request)
+        while self._pending:
+            request = self._pending.popleft()
+            if request.finish_reason is None:
+                request.finish_reason = reason
+            self._post_tokens(request, [], done=True)
+
+    def _drain_work(self) -> None:
+        while True:
+            try:
+                self._pending.append(self._work.get_nowait())
+            except queue.Empty:
+                return
 
     def _bucket_for(self, length: int) -> int | None:
         for bucket in sorted(self.config.prefill_buckets):
@@ -207,113 +279,102 @@ class TPUEngine:
                 return bucket
         return None
 
-    async def _scheduler_loop(self) -> None:
+    def _admit_batch(self) -> bool:
+        """Admit up to prefill_max_batch same-bucket requests in ONE prefill
+        call (round-1 VERDICT weak #4: serial batch=1 admission serialized
+        bursts behind each other and behind decode)."""
         config = self.config
-        decode_interval = 0.0
-        while True:
-            did_work = False
-            # 1) admit waiting requests while slots + pages are free
-            while (len(self._running) < config.max_batch and not self._queue.empty()):
-                request = self._queue.get_nowait()
-                admitted = await self._admit(request)
-                did_work = did_work or admitted
-                if not admitted:
-                    break
-            # 2) one decode step over the running batch
-            if self._running:
-                await self._decode_step_all()
-                did_work = True
-            self.stats.queue_depth = self._queue.qsize()
-            if not did_work:
-                await asyncio.sleep(0.002)
-            else:
-                await asyncio.sleep(decode_interval)  # yield to the event loop
+        self._drain_work()
+        if not self._pending:
+            return False
 
-    async def _admit(self, request: GenRequest) -> bool:
-        """Allocate a slot + pages, run prefill, enqueue first token."""
-        config = self.config
-        n_prompt = len(request.prompt_ids)
-        bucket = self._bucket_for(n_prompt)
-        if bucket is None:
-            request.finish_reason = "length"
-            await request.stream.put(None)
-            return True  # consumed (rejected)
+        # reject oversized prompts immediately
+        while self._pending:
+            head = self._pending[0]
+            if self._bucket_for(len(head.prompt_ids)) is not None:
+                break
+            self._pending.popleft()
+            head.finish_reason = "length"
+            self._post_tokens(head, [], done=True)
+
         free_slots = [s for s in range(config.max_batch) if s not in self._running]
-        if not free_slots:
-            await self._requeue(request)
-            return False
-        total = min(n_prompt + request.max_tokens, config.max_seq_len)
-        slot = free_slots[0]
-        if not self.allocator.allocate_slot(slot, total):
-            await self._requeue(request)
+        if not self._pending or not free_slots:
             return False
 
-        request.slot = slot
-        request.queue_ms = (time.time() - request.created) * 1000
-        self._running[slot] = request
+        bucket = self._bucket_for(len(self._pending[0].prompt_ids))
+        group: list[GenRequest] = []
+        skipped: list[GenRequest] = []
+        limit = min(len(free_slots), config.prefill_max_batch)
+        while self._pending and len(group) < limit:
+            request = self._pending.popleft()
+            if self._bucket_for(len(request.prompt_ids)) == bucket:
+                group.append(request)
+            else:
+                skipped.append(request)
+        for request in reversed(skipped):  # preserve FIFO for other buckets
+            self._pending.appendleft(request)
+        if not group:
+            return False
+
+        admitted: list[GenRequest] = []
+        for request in group:
+            total = min(len(request.prompt_ids) + request.max_tokens,
+                        config.max_seq_len)
+            slot = free_slots[len(admitted)]
+            if not self.allocator.allocate_slot(slot, total):
+                self._pending.appendleft(request)  # page pressure: retry later
+                continue
+            request.slot = slot
+            request.queue_ms = (time.time() - request.created) * 1000
+            self._running[slot] = request
+            admitted.append(request)
+        if not admitted:
+            return False
         self._sync_tables()
 
         started = time.monotonic()
-        tokens = np.full((1, bucket), self.tokenizer.pad_id, dtype=np.int32)
-        positions = np.full((1, bucket), -1, dtype=np.int32)
-        tokens[0, :n_prompt] = request.prompt_ids
-        positions[0, :n_prompt] = np.arange(n_prompt)
-        logits, self.kv = self._prefill(
-            self.params, tokens=jnp.asarray(tokens), positions=jnp.asarray(positions),
-            kv=self.kv, slot_ids=jnp.array([slot]))
-        # sample the first generated token from the last prompt position
-        last = jax.device_get(logits[0, n_prompt - 1])
-        first_token = self._sample_host(last, request)
-        request.prefill_ms = (time.monotonic() - started) * 1000
-        await self._emit(request, first_token)
+        # pad batch to the next power of two so XLA compiles at most
+        # log2(prefill_max_batch)+1 shapes per bucket, not one per distinct
+        # group size; padding rows have positions -1 (no KV write — the same
+        # masking decode uses for inactive slots) and their samples are
+        # discarded
+        B = 1
+        while B < len(admitted):
+            B *= 2
+        tokens = np.full((B, bucket), self.tokenizer.pad_id, dtype=np.int32)
+        positions = np.full((B, bucket), -1, dtype=np.int32)
+        last_idx = np.zeros((B,), dtype=np.int32)
+        slot_ids = np.zeros((B,), dtype=np.int32)
+        temperature = np.zeros((B,), dtype=np.float32)
+        top_k = np.zeros((B,), dtype=np.int32)
+        top_p = np.ones((B,), dtype=np.float32)
+        for i, request in enumerate(admitted):
+            n = len(request.prompt_ids)
+            tokens[i, :n] = request.prompt_ids
+            positions[i, :n] = np.arange(n)
+            last_idx[i] = n - 1
+            slot_ids[i] = request.slot
+            temperature[i] = request.temperature
+            top_k[i] = request.top_k
+            top_p[i] = request.top_p
+        sampling = SamplingParams(jnp.asarray(temperature), jnp.asarray(top_k),
+                                  jnp.asarray(top_p))
+        self._rng, key = jax.random.split(self._rng)
+        first, self.kv = self._prefill_sample(
+            self.params, self.kv, jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(slot_ids), jnp.asarray(last_idx), sampling, key)
+        first_host = jax.device_get(first)  # dispatch thread: sync is fine here
+        elapsed_ms = (time.monotonic() - started) * 1000
+        self.stats.prefill_batches += 1
+        self.stats.prefill_requests += B
+        for i, request in enumerate(admitted):
+            request.prefill_ms = elapsed_ms
+            self._emit(request, int(first_host[i]))
         return True
 
-    async def _requeue(self, request: GenRequest) -> None:
-        # put back at the front is not supported by asyncio.Queue; re-put and
-        # let FIFO order approximate fairness
-        await self._queue.put(request)
+    # ------------------------------------------------------------ decode step
 
-    def _sample_host(self, logits: np.ndarray, request: GenRequest) -> int:
-        if request.temperature <= 0:
-            return int(np.argmax(logits))
-        scaled = logits / max(request.temperature, 1e-6)
-        if request.top_k > 0:
-            kth = np.partition(scaled, -request.top_k)[-request.top_k]
-            scaled = np.where(scaled >= kth, scaled, -np.inf)
-        probs = np.exp(scaled - scaled.max())
-        if request.top_p < 1.0:
-            order = np.argsort(probs)[::-1]
-            cum = np.cumsum(probs[order]) / probs.sum()
-            cutoff = np.searchsorted(cum, request.top_p) + 1
-            mask = np.zeros_like(probs, dtype=bool)
-            mask[order[:cutoff]] = True
-            probs = np.where(mask, probs, 0.0)
-        probs = probs / probs.sum()
-        return int(np.random.choice(len(probs), p=probs))
-
-    def _sync_tables(self) -> None:
-        self.kv = self.kv._replace(block_tables=self.allocator.tables())
-
-    async def _emit(self, request: GenRequest, token: int) -> None:
-        request.generated.append(token)
-        self.stats.completion_tokens += 1
-        done = (token == self.tokenizer.eos_id or token in request.stop_ids
-                or len(request.generated) >= request.max_tokens)
-        request.stream.put_nowait(token)
-        if done:
-            if request.finish_reason is None:
-                request.finish_reason = ("stop" if (token == self.tokenizer.eos_id
-                                                    or token in request.stop_ids)
-                                         else "length")
-            await self._finish(request)
-
-    async def _finish(self, request: GenRequest) -> None:
-        self._running.pop(request.slot, None)
-        self.allocator.free_slot(request.slot)
-        self._sync_tables()
-        request.stream.put_nowait(None)
-
-    async def _decode_step_all(self) -> None:
+    def _decode_step_all(self) -> None:
         """One fixed-shape decode step over every active slot."""
         config = self.config
         B = config.max_batch
@@ -349,9 +410,54 @@ class TPUEngine:
         next_host = jax.device_get(next_tokens)
         for slot, request in active:
             if request.finish_reason == "length" and request.slot in self._running:
-                await self._finish(request)
+                self._finish(request)
                 continue
-            await self._emit(request, int(next_host[slot]))
+            self._emit(request, int(next_host[slot]))
+
+    # ---------------------------------------------------------------- plumbing
+
+    def _sync_tables(self) -> None:
+        self.kv = self.kv._replace(block_tables=self.allocator.tables())
+
+    def _emit(self, request: GenRequest, token: int) -> None:
+        request.generated.append(token)
+        self.stats.completion_tokens += 1
+        done = (token == self.tokenizer.eos_id or token in request.stop_ids
+                or len(request.generated) >= request.max_tokens)
+        if done and request.finish_reason is None:
+            request.finish_reason = ("stop" if (token == self.tokenizer.eos_id
+                                                or token in request.stop_ids)
+                                     else "length")
+        if done:
+            self._running.pop(request.slot, None)
+            self.allocator.free_slot(request.slot)
+            self._sync_tables()
+        self._post_tokens(request, [token], done=done)
+
+    def _finish(self, request: GenRequest) -> None:
+        self._running.pop(request.slot, None)
+        self.allocator.free_slot(request.slot)
+        self._sync_tables()
+        self._post_tokens(request, [], done=True)
+
+    def _post_tokens(self, request: GenRequest, tokens: list[int],
+                     done: bool) -> None:
+        """Hand tokens to the consumer on the asyncio loop (thread-safe)."""
+        loop = self._loop
+
+        def _put() -> None:
+            for token in tokens:
+                request.stream.put_nowait(token)
+            if done:
+                request.stream.put_nowait(None)
+
+        if loop is not None and not loop.is_closed():
+            try:
+                loop.call_soon_threadsafe(_put)
+                return
+            except RuntimeError:
+                pass  # loop shut down mid-flight; fall through
+        _put()  # no loop (tests driving the thread directly)
 
     # ------------------------------------------------------------ embeddings
 

@@ -100,3 +100,23 @@ async def test_private_team_roster_not_disclosed():
         assert (await resp.json())["members"]
     finally:
         await client.close()
+
+
+async def test_public_team_roster_visible_to_non_member():
+    client = await make_client()
+    try:
+        auth = aiohttp.BasicAuth(*BASIC)
+        resp = await client.post("/teams", json={
+            "name": "open-team", "visibility": "public"}, auth=auth)
+        team = await resp.json()
+        auth_service = client.app["auth_service"]
+        await auth_service.create_user("viewer@example.com", "viewer-pw-123")
+        resp = await client.post("/auth/login", json={
+            "email": "viewer@example.com", "password": "viewer-pw-123"})
+        jwt_token = (await resp.json())["access_token"]
+        resp = await client.get(f"/teams/{team['id']}",
+                                headers={"authorization": f"Bearer {jwt_token}"})
+        assert resp.status == 200
+        assert (await resp.json())["members"]  # public roster stays visible
+    finally:
+        await client.close()
