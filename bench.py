@@ -1,19 +1,28 @@
-"""Headline benchmark: concurrent MCP ``tools/call`` throughput through the
-full gateway pipeline (middleware → auth → JSON-RPC dispatch → plugin chain →
-outbound REST → metrics), matching the reference's ``benchmark-mcp-tools``
-harness (91.21 req/s, p50 230 ms, 31.56% failures on the 1.0.6 release —
-BASELINE.md).
+"""Driver benchmark: BASELINE.json configs 1-4 through the real gateway.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
-vs_baseline = our req/s / 91.21 (>1 is better). Failures here count against
-throughput (the reference's failure rate is included in theirs).
+Prints ONE JSON line. Headline metric = config-1 gateway ``tools/call``
+throughput (reference ``benchmark-mcp-tools``: 91.21 req/s, p50 230 ms,
+31.56% failures — BASELINE.md). The ``configs`` field carries the
+engine-backed workloads:
+
+- config1: tools/call, non-LLM plugin chain (moderation wordlist + regex)
+- config2: tools/call through content_moderation + harmful_content_detector
+  backed by the tpu_local classifier (added p50 vs no-plugin path reported)
+- config3: tools/call through the summarizer plugin backed by tpu_local chat
+- config4: OpenAI-compatible /v1/chat/completions, 128 concurrent clients
+
+Platform selection: the real chip is used when the backend initializes
+within a budget (probed in a subprocess so a wedged TPU runtime cannot hang
+the whole bench — round-1 failure mode); otherwise pins cpu.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import os
 import statistics
+import subprocess
 import sys
 import time
 
@@ -26,60 +35,98 @@ CONCURRENCY = 64
 TOTAL_REQUESTS = 2000
 
 
-async def run_bench() -> dict:
-    from aiohttp import BasicAuth, web
+def detect_platform(budget_s: float = 150.0) -> str:
+    """Return the default jax backend if it initializes in time, else 'cpu'."""
+    if os.environ.get("BENCH_PLATFORM"):
+        return os.environ["BENCH_PLATFORM"]
+    code = "import jax; print(jax.default_backend())"
+    try:
+        out = subprocess.run([sys.executable, "-c", code], timeout=budget_s,
+                             capture_output=True, text=True)
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.strip().splitlines()[-1]
+    except subprocess.TimeoutExpired:
+        pass
+    return "cpu"
+
+
+def _percentiles(samples: list[float]) -> dict:
+    lat = sorted(samples)
+    return {
+        "p50_ms": round(statistics.median(lat), 2),
+        "p95_ms": round(lat[int(len(lat) * 0.95)], 2),
+        "p99_ms": round(lat[min(int(len(lat) * 0.99), len(lat) - 1)], 2),
+    }
+
+
+async def _make_gateway(engine: bool, platform: str):
     from aiohttp.test_utils import TestClient, TestServer
 
     from mcp_context_forge_tpu.config import load_settings
     from mcp_context_forge_tpu.gateway.app import build_app
 
-    # echo upstream the REST tool calls
-    upstream = web.Application()
-
-    async def echo(request: web.Request) -> web.Response:
-        return web.json_response({"ok": True, "echo": await request.json()})
-
-    upstream.router.add_post("/echo", echo)
-    upstream_client = TestClient(TestServer(upstream))
-    await upstream_client.start_server()
-
-    settings = load_settings(env={
+    model = os.environ.get(
+        "BENCH_MODEL", "llama3-1b" if platform == "tpu" else "llama3-tiny")
+    env = {
         "MCPFORGE_DATABASE_URL": "sqlite:///:memory:",
         "MCPFORGE_PLUGINS_ENABLED": "true",
-        "MCPFORGE_TPU_LOCAL_ENABLED": "false",  # LLM plugins measured separately
+        "MCPFORGE_TPU_LOCAL_ENABLED": "true" if engine else "false",
+        "MCPFORGE_TPU_LOCAL_MODEL": model,
+        "MCPFORGE_TPU_LOCAL_MAX_BATCH": os.environ.get("BENCH_MAX_BATCH", "32"),
+        "MCPFORGE_TPU_LOCAL_MAX_SEQ_LEN": "1024",
+        "MCPFORGE_TPU_LOCAL_PAGE_SIZE": "64",
+        "MCPFORGE_TPU_LOCAL_NUM_PAGES": "1024",
+        "MCPFORGE_TPU_LOCAL_PREFILL_BUCKETS": "64,256",
+        "MCPFORGE_TPU_LOCAL_DTYPE": ("bfloat16" if platform == "tpu"
+                                     else "float32"),
         "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
         "MCPFORGE_OTEL_EXPORTER": "none",
         "MCPFORGE_LOG_LEVEL": "WARNING",
-    }, env_file=None)
+    }
+    settings = load_settings(env=env, env_file=None)
     app = await build_app(settings)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return app, client, model
 
-    # representative non-LLM plugin chain on the hot path
-    from mcp_context_forge_tpu.plugins.framework import PluginConfig
-    pm = app["plugin_manager"]
-    await pm.add_plugin(PluginConfig(name="mod", kind="content_moderation",
-                                     config={"use_engine": False}))
-    await pm.add_plugin(PluginConfig(name="regex", kind="regex_filter",
-                                     config={"rules": [{"pattern": r"\d{3}-\d{2}-\d{4}",
-                                                        "replacement": "[ssn]"}]}))
 
-    gateway = TestClient(TestServer(app))
-    await gateway.start_server()
-    auth = BasicAuth("admin", "changeme")
+async def _echo_upstream(long_text: bool = False):
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
 
-    url = f"http://{upstream_client.server.host}:{upstream_client.server.port}/echo"
+    upstream = web.Application()
+
+    async def echo(request: web.Request) -> web.Response:
+        body = await request.json()
+        if long_text:
+            return web.json_response(
+                {"ok": True, "report": "metric value 42; " * 400})
+        return web.json_response({"ok": True, "echo": body})
+
+    upstream.router.add_post("/echo", echo)
+    client = TestClient(TestServer(upstream))
+    await client.start_server()
+    return client
+
+
+async def _register_tool(gateway, upstream, auth, name: str) -> None:
+    url = f"http://{upstream.server.host}:{upstream.server.port}/echo"
     resp = await gateway.post("/tools", json={
-        "name": "bench-echo", "integration_type": "REST", "url": url}, auth=auth)
+        "name": name, "integration_type": "REST", "url": url}, auth=auth)
     assert resp.status == 201, await resp.text()
 
+
+async def _tools_call_load(gateway, auth, tool: str, total: int,
+                           concurrency: int, payload_text: str = "payload"):
     latencies: list[float] = []
     failures = 0
-    semaphore = asyncio.Semaphore(CONCURRENCY)
+    semaphore = asyncio.Semaphore(concurrency)
 
     async def one(i: int) -> None:
         nonlocal failures
         payload = {"jsonrpc": "2.0", "id": i, "method": "tools/call",
-                   "params": {"name": "bench-echo",
-                              "arguments": {"n": i, "text": f"payload {i}"}}}
+                   "params": {"name": tool,
+                              "arguments": {"n": i, "text": f"{payload_text} {i}"}}}
         async with semaphore:
             started = time.monotonic()
             try:
@@ -93,38 +140,166 @@ async def run_bench() -> dict:
             if not ok:
                 failures += 1
 
-    # warmup
-    await asyncio.gather(*[one(-i) for i in range(1, 33)])
-    latencies.clear()
-    failures = 0
-
     wall_start = time.monotonic()
-    await asyncio.gather(*[one(i) for i in range(TOTAL_REQUESTS)])
+    await asyncio.gather(*[one(i) for i in range(total)])
     wall = time.monotonic() - wall_start
+    return latencies, failures, wall
 
+
+async def bench_config1(platform: str) -> dict:
+    """Headline: tools/call through the non-LLM plugin chain."""
+    from aiohttp import BasicAuth
+
+    from mcp_context_forge_tpu.plugins.framework import PluginConfig
+
+    app, gateway, _ = await _make_gateway(engine=False, platform=platform)
+    upstream = await _echo_upstream()
+    auth = BasicAuth("admin", "changeme")
+    pm = app["plugin_manager"]
+    await pm.add_plugin(PluginConfig(name="mod", kind="content_moderation",
+                                     config={"use_engine": False}))
+    await pm.add_plugin(PluginConfig(
+        name="regex", kind="regex_filter",
+        config={"rules": [{"pattern": r"\d{3}-\d{2}-\d{4}",
+                           "replacement": "[ssn]"}]}))
+    await _register_tool(gateway, upstream, auth, "bench-echo")
+
+    # warmup
+    await _tools_call_load(gateway, auth, "bench-echo", 32, 32)
+    latencies, failures, wall = await _tools_call_load(
+        gateway, auth, "bench-echo", TOTAL_REQUESTS, CONCURRENCY)
     await gateway.close()
-    await upstream_client.close()
-
+    await upstream.close()
     rps = TOTAL_REQUESTS / wall
-    lat = sorted(latencies)
-    p50 = statistics.median(lat)
-    p95 = lat[int(len(lat) * 0.95)]
-    p99 = lat[int(len(lat) * 0.99)]
+    return {"rps": round(rps, 2), **_percentiles(latencies),
+            "failures": failures, "requests": TOTAL_REQUESTS,
+            "concurrency": CONCURRENCY}
+
+
+async def bench_engine_configs(platform: str) -> dict:
+    """Configs 2-4 against ONE engine-enabled gateway (one compile set)."""
+    from aiohttp import BasicAuth
+
+    from mcp_context_forge_tpu.plugins.framework import PluginConfig
+
+    app, gateway, model = await _make_gateway(engine=True, platform=platform)
+    upstream = await _echo_upstream(long_text=True)
+    auth = BasicAuth("admin", "changeme")
+    out: dict = {"model": model}
+    try:
+        await _register_tool(gateway, upstream, auth, "bench-tool")
+        await app["tpu_provider"].warmup()  # precompile encoder shape grid
+
+        # --- baseline: no plugins on the path
+        await _tools_call_load(gateway, auth, "bench-tool", 16, 8)  # warmup
+        base_lat, _, _ = await _tools_call_load(gateway, auth, "bench-tool",
+                                                200, 32)
+        base_p50 = statistics.median(base_lat)
+
+        # --- config2: classifier chain (content_moderation + harmful_content)
+        pm = app["plugin_manager"]
+        await pm.add_plugin(PluginConfig(name="mod", kind="content_moderation",
+                                         config={"use_engine": True,
+                                                 "threshold": 2.0}))
+        await pm.add_plugin(PluginConfig(name="harm",
+                                         kind="harmful_content_detector",
+                                         config={"use_engine": True,
+                                                 "threshold": 2.0,
+                                                 "action": "annotate"}))
+        await _tools_call_load(gateway, auth, "bench-tool", 8, 4)  # warmup/compile
+        lat2, fail2, wall2 = await _tools_call_load(gateway, auth, "bench-tool",
+                                                    300, 32)
+        out["config2_moderation_chain"] = {
+            **_percentiles(lat2), "failures": fail2,
+            "rps": round(300 / wall2, 2),
+            "added_p50_ms": round(statistics.median(lat2) - base_p50, 2),
+            "requests": 300}
+        await pm.remove_plugin("mod")
+        await pm.remove_plugin("harm")
+
+        # --- config3: summarizer backed by tpu_local chat
+        await pm.add_plugin(PluginConfig(
+            name="sum", kind="summarizer",
+            config={"threshold_chars": 1000, "max_tokens": 32}))
+        await _tools_call_load(gateway, auth, "bench-tool", 2, 1)  # compile
+        lat3, fail3, wall3 = await _tools_call_load(gateway, auth, "bench-tool",
+                                                    32, 8)
+        out["config3_summarizer"] = {
+            **_percentiles(lat3), "failures": fail3,
+            "rps": round(32 / wall3, 2),
+            "added_p50_ms": round(statistics.median(lat3) - base_p50, 2),
+            "requests": 32}
+        await pm.remove_plugin("sum")
+
+        # --- config4: /v1/chat/completions at 128 concurrent clients
+        clients = int(os.environ.get("BENCH_CHAT_CLIENTS", "128"))
+        max_tokens = int(os.environ.get("BENCH_CHAT_TOKENS", "16"))
+
+        async def chat(i: int):
+            started = time.monotonic()
+            resp = await gateway.post("/v1/chat/completions", auth=auth, json={
+                "model": model,
+                "messages": [{"role": "user", "content": f"request {i}: say hi"}],
+                "max_tokens": max_tokens})
+            body = await resp.json()
+            ok = resp.status == 200 and body.get("choices")
+            tokens = body.get("usage", {}).get("completion_tokens", 0) if ok else 0
+            return (time.monotonic() - started) * 1000, tokens, ok
+
+        await asyncio.gather(*[chat(-1) for _ in range(4)])  # warmup
+        wall_start = time.monotonic()
+        results = await asyncio.gather(*[chat(i) for i in range(clients)])
+        wall4 = time.monotonic() - wall_start
+        lat4 = [r[0] for r in results]
+        tokens4 = sum(r[1] for r in results)
+        out["config4_chat_128"] = {
+            **_percentiles(lat4),
+            "clients": clients, "max_tokens": max_tokens,
+            "completion_tokens": tokens4,
+            "tokens_per_s": round(tokens4 / wall4, 2),
+            "failures": sum(1 for r in results if not r[2]),
+            "wall_s": round(wall4, 2)}
+        engine = app.get("tpu_engine")
+        if engine is not None:
+            out["decode_steps"] = engine.stats.decode_steps
+            out["prefill_batches"] = engine.stats.prefill_batches
+    finally:
+        await gateway.close()
+        await upstream.close()
+    return out
+
+
+async def run_bench(platform: str) -> dict:
+    config1 = await bench_config1(platform)
+    engine_results: dict = {}
+    if os.environ.get("BENCH_SKIP_ENGINE") != "1":
+        try:
+            engine_results = await bench_engine_configs(platform)
+        except Exception as exc:  # engine trouble must not kill the headline
+            engine_results = {"error": f"{type(exc).__name__}: {exc}"}
     return {
         "metric": "gateway_mcp_tools_call_rps",
-        "value": round(rps, 2),
+        "value": config1["rps"],
         "unit": "req/s",
-        "vs_baseline": round(rps / REFERENCE_RPS, 3),
-        "p50_ms": round(p50, 2),
-        "p95_ms": round(p95, 2),
-        "p99_ms": round(p99, 2),
+        "vs_baseline": round(config1["rps"] / REFERENCE_RPS, 3),
+        "p50_ms": config1["p50_ms"],
+        "p95_ms": config1["p95_ms"],
+        "p99_ms": config1["p99_ms"],
         "p50_vs_baseline_ms": REFERENCE_P50_MS,
-        "failures": failures,
-        "requests": TOTAL_REQUESTS,
-        "concurrency": CONCURRENCY,
+        "failures": config1["failures"],
+        "requests": config1["requests"],
+        "concurrency": config1["concurrency"],
+        "platform": platform,
+        "configs": engine_results,
     }
 
 
 if __name__ == "__main__":
-    result = asyncio.run(run_bench())
+    chosen = detect_platform()
+    if chosen == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    result = asyncio.run(run_bench(chosen))
     print(json.dumps(result))

@@ -140,6 +140,7 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         ctx.llm_registry = registry
         app["llm_registry"] = registry
         app["tpu_engine"] = engine
+        app["tpu_provider"] = provider
         setup_llm_routes(app, registry, prefix=settings.llm_api_prefix)
 
     # plugins (optional, loaded if configured)

@@ -40,9 +40,11 @@ class TeamService:
 
     async def get_team(self, team_id: str, actor: str | None = None,
                        is_admin: bool = False) -> dict[str, Any]:
-        """Fetch a team. When an ``actor`` is given, private teams and their
-        member rosters are only returned to members (or platform admins) —
-        teams.read alone must not disclose private rosters."""
+        """Fetch a team. When an ``actor`` is given, private teams (and their
+        member rosters) are only returned to members or platform admins —
+        teams.read alone must not disclose them. Public teams deliberately
+        expose their roster to any authenticated user: they are the
+        discoverable/joinable tier (reference team visibility semantics)."""
         row = await self.ctx.db.fetchone("SELECT * FROM teams WHERE id=?", (team_id,))
         if not row:
             raise NotFoundError(f"Team {team_id} not found")

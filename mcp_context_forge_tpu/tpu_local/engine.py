@@ -191,9 +191,15 @@ class TPUEngine:
     async def start(self) -> None:
         if self._started:
             return
+        if self._thread is not None and self._thread.is_alive():
+            # a wedged thread from a failed stop() still owns kv/_running;
+            # a second dispatch thread would corrupt both
+            raise RuntimeError("previous dispatch thread still running")
         self._started = True
         self._loop = asyncio.get_running_loop()
-        self._stop_event.clear()
+        # fresh event per thread: a wedged old thread keeps seeing its own
+        # (set) event and can never be revived by a later start()
+        self._stop_event = threading.Event()
         self._thread = threading.Thread(target=self._device_loop,
                                         name="tpu-engine-dispatch", daemon=True)
         self._thread.start()
@@ -204,13 +210,18 @@ class TPUEngine:
         self._started = False
         self._stop_event.set()
         thread = self._thread
-        self._thread = None
         if thread is not None:
             await asyncio.to_thread(thread.join, 30.0)
+            if thread.is_alive():
+                logger.error("dispatch thread failed to stop within 30s; "
+                             "engine restart refused until it exits")
+                return  # keep self._thread so start() refuses a double-start
+        self._thread = None
 
     # ------------------------------------------------------------- submission
 
     async def submit(self, request: GenRequest) -> GenRequest:
+        self._check_alive()
         self.stats.requests += 1
         self.stats.prompt_tokens += len(request.prompt_ids)
         while True:
@@ -218,9 +229,17 @@ class TPUEngine:
                 self._work.put_nowait(request)
                 break
             except queue.Full:  # backpressure without blocking the loop
+                self._check_alive()
                 await asyncio.sleep(0.005)
         self.stats.queue_depth = self._work.qsize() + len(self._pending)
         return request
+
+    def _check_alive(self) -> None:
+        """Fail fast instead of queueing work no consumer will ever drain
+        (a crashed dispatch thread must not hang every later request)."""
+        if self._started and (self._thread is None
+                              or not self._thread.is_alive()):
+            raise RuntimeError("tpu_local engine dispatch thread is not running")
 
     async def generate(self, prompt_ids: list[int], **kwargs) -> AsyncIterator[int]:
         """Submit and yield token ids as they decode."""
@@ -366,7 +385,7 @@ class TPUEngine:
         first_host = jax.device_get(first)  # dispatch thread: sync is fine here
         elapsed_ms = (time.monotonic() - started) * 1000
         self.stats.prefill_batches += 1
-        self.stats.prefill_requests += B
+        self.stats.prefill_requests += len(admitted)
         for i, request in enumerate(admitted):
             request.prefill_ms = elapsed_ms
             self._emit(request, int(first_host[i]))

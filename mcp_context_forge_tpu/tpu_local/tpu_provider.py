@@ -24,6 +24,81 @@ from .tokenizer import load_tokenizer, render_chat
 from ..utils.ids import new_id
 
 
+class _EncoderBatcher:
+    """Coalesces concurrent embed/classify calls into one encoder forward.
+
+    Plugin classifier traffic arrives one text per tool-call; running a
+    batch-1 forward each time starves throughput (SURVEY.md §7.2 #2 —
+    "requires request coalescing into the same continuous batch"). Submitted
+    texts queue up; a worker drains up to ``max_batch`` per forward, padding
+    the batch dim to a power of two so XLA compiles O(log max_batch) shapes.
+    """
+
+    def __init__(self, encode_batch, max_batch: int = 16,
+                 max_wait_ms: float = 2.0):
+        self._encode_batch = encode_batch  # list[str] -> (embeddings, logits)
+        self.max_batch = max_batch
+        self.max_wait = max_wait_ms / 1000.0
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._worker_task: asyncio.Task | None = None
+
+    async def submit(self, text: str) -> tuple[np.ndarray, np.ndarray]:
+        """Returns (embedding [D], class logits [C]) for one text."""
+        if self._worker_task is None or self._worker_task.done():
+            self._worker_task = asyncio.ensure_future(self._worker())
+        future: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._queue.put((text, future))
+        return await future
+
+    async def stop(self) -> None:
+        if self._worker_task is not None:
+            self._worker_task.cancel()
+            try:
+                await self._worker_task
+            except asyncio.CancelledError:
+                pass
+            self._worker_task = None
+        # strand nothing: queued submitters must not await forever
+        while not self._queue.empty():
+            _, future = self._queue.get_nowait()
+            if not future.done():
+                future.cancel()
+
+    async def _worker(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            batch = [await self._queue.get()]
+            try:
+                deadline = loop.time() + self.max_wait
+                while len(batch) < self.max_batch:
+                    remaining = deadline - loop.time()
+                    if remaining <= 0:
+                        break
+                    try:
+                        batch.append(await asyncio.wait_for(self._queue.get(),
+                                                            remaining))
+                    except asyncio.TimeoutError:
+                        break
+                texts = [text for text, _ in batch]
+                try:
+                    embeddings, logits = await asyncio.to_thread(
+                        self._encode_batch, texts)
+                except Exception as exc:
+                    for _, future in batch:
+                        if not future.done():
+                            future.set_exception(exc)
+                    continue
+                for i, (_, future) in enumerate(batch):
+                    if not future.done():
+                        future.set_result((embeddings[i], logits[i]))
+            except asyncio.CancelledError:
+                # stop() mid-batch: fail the in-flight futures, then exit
+                for _, future in batch:
+                    if not future.done():
+                        future.cancel()
+                raise
+
+
 class TPULocalProvider(LLMProvider):
     provider_type = "tpu_local"
 
@@ -43,6 +118,7 @@ class TPULocalProvider(LLMProvider):
         self._encode = jax.jit(
             lambda params, tokens, mask: encoder_forward(
                 params, self.encoder_config, tokens, mask))
+        self._batcher = _EncoderBatcher(self._encode_batch)
 
     # ------------------------------------------------------------------ chat
 
@@ -130,30 +206,56 @@ class TPULocalProvider(LLMProvider):
 
     def _encode_batch(self, texts: list[str]) -> tuple[np.ndarray, np.ndarray]:
         max_len = self.encoder_config.max_seq_len
-        batch = len(texts)
-        tokens = np.zeros((batch, max_len), dtype=np.int32)
-        mask = np.zeros((batch, max_len), dtype=bool)
-        for i, text in enumerate(texts):
-            ids = self.encoder_tokenizer.encode(text, add_bos=False)[:max_len]
+        encoded = [self.encoder_tokenizer.encode(t, add_bos=False)[:max_len]
+                   for t in texts]
+        # pad batch AND seq dims to powers of two (seq floored at 64):
+        # bounded compile count, and short plugin texts don't pay the full
+        # max_seq_len attention cost
+        batch = 1
+        while batch < len(texts):
+            batch *= 2
+        longest = max((len(ids) for ids in encoded), default=1)
+        # two seq buckets only (short plugin payloads vs full-length): keeps
+        # the (batch, seq) compile grid at 2 * log2(max_batch) shapes
+        seq = 64 if longest <= 64 else max_len
+        tokens = np.zeros((batch, seq), dtype=np.int32)
+        mask = np.zeros((batch, seq), dtype=bool)
+        for i, ids in enumerate(encoded):
             tokens[i, :len(ids)] = ids
             mask[i, :len(ids)] = True
         embeddings, logits = self._encode(self.encoder_params,
                                           jnp.asarray(tokens), jnp.asarray(mask))
-        return np.asarray(embeddings), np.asarray(logits)
+        return (np.asarray(embeddings)[:len(texts)],
+                np.asarray(logits)[:len(texts)])
 
     async def embed(self, texts: list[str], model: str | None = None) -> list[list[float]]:
-        embeddings, _ = await asyncio.to_thread(self._encode_batch, texts)
-        return [e.tolist() for e in embeddings]
+        results = await asyncio.gather(*[self._batcher.submit(t) for t in texts])
+        return [embedding.tolist() for embedding, _ in results]
 
     async def classify(self, texts: list[str]) -> list[float]:
         """Harm probability per text (moderation plugins)."""
-        _, logits = await asyncio.to_thread(self._encode_batch, texts)
-        probs = np.exp(logits - logits.max(axis=-1, keepdims=True))
-        probs = probs / probs.sum(axis=-1, keepdims=True)
-        return [float(p[1]) for p in probs]
+        results = await asyncio.gather(*[self._batcher.submit(t) for t in texts])
+        out = []
+        for _, logits in results:
+            probs = np.exp(logits - logits.max())
+            probs = probs / probs.sum()
+            out.append(float(probs[1]))
+        return out
+
+    async def warmup(self) -> None:
+        """Precompile the encoder's (batch, seq) shape grid so classifier
+        traffic never hits an XLA compile mid-request (each stall would
+        freeze every queued plugin hook for ~seconds)."""
+        long_text = "warmup " * self.encoder_config.max_seq_len
+        batch = 1
+        while batch <= self._batcher.max_batch:
+            await asyncio.to_thread(self._encode_batch, ["warmup"] * batch)
+            await asyncio.to_thread(self._encode_batch, [long_text] * batch)
+            batch *= 2
 
     async def models(self) -> list[str]:
         return [self.engine.config.model]
 
     async def shutdown(self) -> None:
+        await self._batcher.stop()
         await self.engine.stop()

@@ -152,3 +152,34 @@ def test_event_loop_stays_responsive(engine):
         assert max(gaps) < 1.0, f"event loop starved: max gap {max(gaps):.3f}s"
 
     asyncio.run(_run_with(engine, main()))
+
+
+def test_encoder_batcher_coalesces():
+    """Concurrent classify calls fuse into shared encoder forwards."""
+    from mcp_context_forge_tpu.tpu_local.engine import EngineConfig, TPUEngine
+    from mcp_context_forge_tpu.tpu_local.tpu_provider import TPULocalProvider
+
+    config = EngineConfig(model="llama3-test", max_batch=2, max_seq_len=64,
+                          page_size=16, num_pages=16, prefill_buckets=(16,),
+                          dtype="float32", attn_impl="reference")
+    provider = TPULocalProvider("tpu_local", TPUEngine(config))
+    calls = []
+    original = provider._encode_batch
+
+    def counting(texts):
+        calls.append(len(texts))
+        return original(texts)
+
+    provider._batcher._encode_batch = counting
+
+    async def main():
+        scores = await asyncio.gather(
+            *[provider.classify([f"text {i}"]) for i in range(12)])
+        assert all(0.0 <= s[0] <= 1.0 for s in scores)
+        assert sum(calls) == 12
+        assert len(calls) < 12  # at least one fused batch
+        # embeddings ride the same batcher
+        vecs = await provider.embed(["a", "b", "c"])
+        assert len(vecs) == 3 and len(vecs[0]) > 0
+
+    asyncio.run(main())
