@@ -1,9 +1,16 @@
 # mcp-context-forge-tpu (reference: 8.7k-line Makefile; the targets that matter)
 
-.PHONY: serve test test-fast bench bench-engine wrapper masking clean
+.PHONY: serve hub test test-fast test-two-process bench bench-engine wrapper masking clean
 
 serve:
 	python -m mcp_context_forge_tpu.cli serve
+
+hub:
+	python -m mcp_context_forge_tpu.coordination.hub --port 7077
+
+# the reference's test-primary-worker-e2e analog: 2 real OS processes + hub
+test-two-process:
+	python -m pytest tests/integration/test_two_process.py -q
 
 test:
 	python -m pytest tests/ -q

@@ -237,13 +237,17 @@ async def bench_engine_configs(platform: str) -> dict:
 
         async def chat(i: int):
             started = time.monotonic()
-            resp = await gateway.post("/v1/chat/completions", auth=auth, json={
-                "model": model,
-                "messages": [{"role": "user", "content": f"request {i}: say hi"}],
-                "max_tokens": max_tokens})
-            body = await resp.json()
-            ok = resp.status == 200 and body.get("choices")
-            tokens = body.get("usage", {}).get("completion_tokens", 0) if ok else 0
+            try:
+                resp = await gateway.post("/v1/chat/completions", auth=auth, json={
+                    "model": model,
+                    "messages": [{"role": "user",
+                                  "content": f"request {i}: say hi"}],
+                    "max_tokens": max_tokens})
+                body = await resp.json()
+                ok = resp.status == 200 and body.get("choices")
+                tokens = body.get("usage", {}).get("completion_tokens", 0) if ok else 0
+            except Exception:  # one bad request must not void configs 2-3
+                ok, tokens = False, 0
             return (time.monotonic() - started) * 1000, tokens, ok
 
         await asyncio.gather(*[chat(-1) for _ in range(4)])  # warmup
@@ -294,12 +298,17 @@ async def run_bench(platform: str) -> dict:
     }
 
 
-if __name__ == "__main__":
+def pin_platform() -> str:
+    """Probe + pin: returns the chosen platform, forcing cpu when the real
+    backend is wedged (shared by bench.py and bench_engine.py)."""
     chosen = detect_platform()
     if chosen == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    result = asyncio.run(run_bench(chosen))
-    print(json.dumps(result))
+    return chosen
+
+
+if __name__ == "__main__":
+    print(json.dumps(asyncio.run(run_bench(pin_platform()))))

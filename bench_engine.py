@@ -1,11 +1,13 @@
 """tpu_local engine micro-benchmark: continuous-batching decode throughput.
 
 Separate from bench.py (the driver's headline gateway metric). Prints one
-JSON line: {"metric": "tpu_local_decode_tokens_per_s", ...}. Model/geometry
-via env: BENCH_MODEL (default llama3-tiny), BENCH_CLIENTS, BENCH_TOKENS.
+JSON line: {"metric": "tpu_local_decode_tokens_per_s", ...} including
+computed MFU on TPU (decode FLOPs/token ~= 2 * n_params; v5e peak 197
+bf16 TFLOP/s/chip). Model/geometry via env: BENCH_MODEL (default
+llama3-1b on tpu / llama3-tiny on cpu), BENCH_CLIENTS, BENCH_TOKENS.
 
-On the real chip run with the axon default platform; on CPU it pins jax to
-cpu automatically when the axon backend is unavailable.
+Platform: probed in a subprocess (a wedged TPU runtime cannot hang the
+bench — round-1 failure mode); BENCH_PLATFORM overrides.
 """
 
 from __future__ import annotations
@@ -18,29 +20,38 @@ import time
 
 sys.path.insert(0, ".")
 
+from bench import pin_platform  # noqa: E402
 
-async def run() -> dict:
+V5E_PEAK_BF16_TFLOPS = 197.0  # per chip
+
+
+def count_params(config) -> int:
+    """Parameter count from the Llama geometry (embed + layers + head)."""
+    d, v = config.dim, config.vocab_size
+    head_dim = config.head_dim
+    kv_dim = config.n_kv_heads * head_dim
+    per_layer = (d * d +            # wq
+                 2 * d * kv_dim +   # wk, wv
+                 d * d +            # wo
+                 3 * d * config.ffn_hidden +  # w1, w3, w2
+                 2 * d)             # norms
+    return v * d * 2 + config.n_layers * per_layer + d
+
+
+async def run(platform: str) -> dict:
     import jax
 
-    platform = os.environ.get("BENCH_PLATFORM", "")
-    if platform:
-        jax.config.update("jax_platforms", platform)
-    try:
-        devices = jax.devices()
-    except Exception:
-        jax.config.update("jax_platforms", "cpu")
-        devices = jax.devices()
-
     from mcp_context_forge_tpu.tpu_local.engine import EngineConfig, TPUEngine
+    from mcp_context_forge_tpu.tpu_local.models import MODEL_CONFIGS
 
-    model = os.environ.get("BENCH_MODEL", "llama3-tiny")
+    model = os.environ.get(
+        "BENCH_MODEL", "llama3-1b" if platform == "tpu" else "llama3-tiny")
     clients = int(os.environ.get("BENCH_CLIENTS", "8"))
     max_tokens = int(os.environ.get("BENCH_TOKENS", "32"))
     config = EngineConfig(model=model, max_batch=min(clients, 16),
                           max_seq_len=512, page_size=16, num_pages=512,
                           prefill_buckets=(64,),
-                          dtype="bfloat16" if devices[0].platform == "tpu"
-                          else "float32",
+                          dtype="bfloat16" if platform == "tpu" else "float32",
                           attn_impl="auto")
     engine = TPUEngine(config)
     await engine.start()
@@ -59,21 +70,29 @@ async def run() -> dict:
         counts = await asyncio.gather(*[one() for _ in range(clients)])
         wall = time.monotonic() - started
         total = sum(counts)
-        return {
+        tokens_per_s = total / wall
+        out = {
             "metric": "tpu_local_decode_tokens_per_s",
-            "value": round(total / wall, 2),
+            "value": round(tokens_per_s, 2),
             "unit": "tokens/s",
             "vs_baseline": None,  # reference has no in-process engine
-            "platform": devices[0].platform,
+            "platform": platform,
             "model": model,
             "clients": clients,
             "tokens": total,
             "wall_s": round(wall, 3),
             "decode_steps": engine.stats.decode_steps,
+            "prefill_batches": engine.stats.prefill_batches,
         }
+        if platform == "tpu":
+            n_params = count_params(MODEL_CONFIGS[model])
+            achieved_tflops = 2 * n_params * tokens_per_s / 1e12
+            out["n_params"] = n_params
+            out["mfu"] = round(achieved_tflops / V5E_PEAK_BF16_TFLOPS, 4)
+        return out
     finally:
         await engine.stop()
 
 
 if __name__ == "__main__":
-    print(json.dumps(asyncio.run(run())))
+    print(json.dumps(asyncio.run(run(pin_platform()))))

@@ -41,8 +41,13 @@ class Settings(BaseModel):
     db_pool_size: int = 8
 
     # --- coordination (reference: Redis; here: pluggable bus) ---
-    bus_backend: Literal["memory", "file"] = "memory"
+    # memory: one process; file: N workers one host; tcp: cross-host hub
+    bus_backend: Literal["memory", "file", "tcp"] = "memory"
     bus_dir: str = "/tmp/mcpforge-bus"
+    bus_tcp_host: str = "127.0.0.1"
+    bus_tcp_port: int = 7077
+    bus_tcp_serve: bool = False  # this worker also hosts the hub
+    bus_tcp_secret: str = ""     # hub auth; empty = fall back to jwt secret
     leader_lease_ttl: float = 15.0
 
     # --- auth ---

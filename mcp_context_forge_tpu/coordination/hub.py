@@ -1,0 +1,418 @@
+"""TCP coordination hub + client: the cross-host Redis analog.
+
+The reference's multi-worker/multi-host story is Redis pub/sub + key
+leases (`/root/reference/mcpgateway/cache/session_registry.py:12-20`,
+`services/session_affinity.py:265`, `services/leader_election.py:8-12`).
+Round 1 shipped memory/file backends only — single-host by construction.
+This module adds the network tier:
+
+- ``CoordinationHub``: an asyncio TCP server speaking newline-delimited
+  JSON frames; fans published messages out to every other connection and
+  serves lease CAS ops (acquire = SET NX EX, renew = compare-and-extend)
+  from one in-process table, so ordering is total per hub.
+- ``HubClient``: one connection multiplexing pub/sub + lease requests,
+  with exponential-backoff reconnect and resubscribe.
+- ``TcpEventBus`` / ``TcpLeaseManager``: the EventBus/LeaseManager
+  implementations gateway workers select with ``bus_backend=tcp``.
+
+Wire frames (one JSON object per line):
+  client→hub: {"op":"pub","topic":T,"msg":{}}           broadcast
+              {"op":"sub","topic":T} / {"op":"unsub"}   topic filter
+              {"op":"acquire"/"renew"/"release"/"holder",
+               "id":N, "name":..., "owner":..., "ttl":...}
+  hub→client: {"op":"msg","topic":T,"msg":{}}
+              {"op":"resp","id":N, "ok":bool, "holder":str|null}
+
+Run standalone: ``python -m mcp_context_forge_tpu.coordination.hub --port 7077``
+or embedded in a gateway worker (``bus_tcp_serve=true`` — that worker hosts
+the hub; peers point ``bus_tcp_host/port`` at it).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Any, Callable
+
+from .bus import EventBus, Handler
+from .leases import LeaseManager
+
+logger = logging.getLogger(__name__)
+
+MAX_FRAME = 4 * 1024 * 1024
+
+
+class CoordinationHub:
+    """TCP server: pub/sub fan-out + lease table.
+
+    With ``secret`` set, every connection must open with a matching
+    ``{"op": "hello", "secret": ...}`` frame before any other op is
+    honored — bus payloads are trusted by workers (affinity forwards carry
+    auth context), so an unauthenticated network hub would be a
+    privilege-escalation path. Empty secret = loopback/dev only.
+    """
+
+    # a wedged worker that stops reading must not grow our buffers forever
+    MAX_WRITE_BUFFER = 8 * 1024 * 1024
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 7077,
+                 secret: str = ""):
+        self.host = host
+        self.port = port
+        self.secret = secret
+        self._server: asyncio.base_events.Server | None = None
+        # conn id -> (writer, subscribed topics; "*" = all)
+        self._conns: dict[int, tuple[asyncio.StreamWriter, set[str]]] = {}
+        self._next_conn = 0
+        self._leases: dict[str, tuple[str, float]] = {}  # name -> (owner, expires)
+
+    @property
+    def bound_port(self) -> int:
+        if self._server and self._server.sockets:
+            return self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._serve_conn, self.host, self.port, limit=MAX_FRAME)
+        logger.info("coordination hub listening on %s:%s", self.host,
+                    self.bound_port)
+
+    async def stop(self) -> None:
+        # close live connections first: wait_closed() blocks until every
+        # connection handler returns (py3.12 semantics)
+        for writer, _ in list(self._conns.values()):
+            writer.close()
+        self._conns.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # ---------------------------------------------------------------- serving
+
+    async def _serve_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        import hmac
+
+        if self.secret:
+            try:
+                line = await asyncio.wait_for(reader.readline(), timeout=5.0)
+                hello = json.loads(line)
+            except (asyncio.TimeoutError, json.JSONDecodeError, ValueError):
+                writer.close()
+                return
+            if hello.get("op") != "hello" or not hmac.compare_digest(
+                    str(hello.get("secret", "")), self.secret):
+                logger.warning("hub: rejected connection with bad secret")
+                writer.close()
+                return
+        conn_id = self._next_conn
+        self._next_conn += 1
+        self._conns[conn_id] = (writer, set())
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    frame = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                await self._handle(conn_id, writer, frame)
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            self._conns.pop(conn_id, None)
+            writer.close()
+
+    async def _handle(self, conn_id: int, writer: asyncio.StreamWriter,
+                      frame: dict[str, Any]) -> None:
+        op = frame.get("op")
+        conn = self._conns.get(conn_id)
+        if conn is None:  # hub stopping: buffered frames race _conns.clear()
+            return
+        if op == "pub":
+            await self._broadcast(conn_id, frame.get("topic", ""),
+                                  frame.get("msg") or {})
+        elif op == "sub":
+            conn[1].add(frame.get("topic", "*"))
+        elif op == "unsub":
+            conn[1].discard(frame.get("topic", "*"))
+        elif op in ("acquire", "renew", "release", "holder"):
+            self._send(writer, self._lease_op(op, frame))
+
+    async def _broadcast(self, sender: int, topic: str,
+                         message: dict[str, Any]) -> None:
+        frame = {"op": "msg", "topic": topic, "msg": message}
+        for conn_id, (writer, topics) in list(self._conns.items()):
+            if conn_id == sender:
+                continue  # publisher delivers locally itself
+            if topics and ("*" in topics or topic in topics):
+                transport = writer.transport
+                if (transport is not None and
+                        transport.get_write_buffer_size() > self.MAX_WRITE_BUFFER):
+                    # slow consumer: evict rather than buffer without bound
+                    logger.warning("hub: dropping slow consumer conn %s", conn_id)
+                    self._conns.pop(conn_id, None)
+                    writer.close()
+                    continue
+                self._send(writer, frame)
+
+    def _send(self, writer: asyncio.StreamWriter, frame: dict[str, Any]) -> None:
+        try:
+            writer.write(json.dumps(frame, separators=(",", ":")).encode() + b"\n")
+        except (ConnectionResetError, RuntimeError):
+            pass
+
+    # ----------------------------------------------------------------- leases
+
+    def _lease_op(self, op: str, frame: dict[str, Any]) -> dict[str, Any]:
+        name = frame.get("name", "")
+        owner = frame.get("owner", "")
+        ttl = float(frame.get("ttl") or 0.0)
+        resp: dict[str, Any] = {"op": "resp", "id": frame.get("id")}
+        now = time.monotonic()
+        current = self._leases.get(name)
+        expired = current is None or current[1] <= now
+        if op == "acquire":
+            if expired or current[0] == owner:
+                self._leases[name] = (owner, now + ttl)
+                resp["ok"] = True
+            else:
+                resp["ok"] = False
+        elif op == "renew":
+            if not expired and current[0] == owner:
+                self._leases[name] = (owner, now + ttl)
+                resp["ok"] = True
+            else:
+                resp["ok"] = False
+        elif op == "release":
+            if current is not None and current[0] == owner:
+                del self._leases[name]
+            resp["ok"] = True
+        elif op == "holder":
+            resp["ok"] = True
+            resp["holder"] = None if expired else current[0]
+        return resp
+
+
+class HubClient:
+    """One multiplexed connection to the hub, shared by bus + leases."""
+
+    def __init__(self, host: str, port: int, secret: str = "",
+                 reconnect_max: float = 5.0):
+        self.host = host
+        self.port = port
+        self.secret = secret
+        self.reconnect_max = reconnect_max
+        self._writer: asyncio.StreamWriter | None = None
+        self._reader_task: asyncio.Task | None = None
+        self._pending: dict[int, asyncio.Future] = {}
+        self._next_id = 0
+        self._topics: set[str] = set()
+        self._on_message: Callable[[str, dict[str, Any]], Any] | None = None
+        self._connected = asyncio.Event()
+        self._stopping = False
+
+    async def start(self) -> None:
+        self._stopping = False
+        if self._reader_task is None:
+            self._reader_task = asyncio.create_task(self._run())
+        await asyncio.wait_for(self._connected.wait(), timeout=10.0)
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+            try:
+                await self._reader_task
+            except asyncio.CancelledError:
+                pass
+            self._reader_task = None
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        for future in self._pending.values():
+            if not future.done():
+                future.cancel()
+        self._pending.clear()
+
+    def on_message(self, callback: Callable[[str, dict[str, Any]], Any]) -> None:
+        self._on_message = callback
+
+    async def _run(self) -> None:
+        backoff = 0.1
+        while not self._stopping:
+            try:
+                reader, writer = await asyncio.open_connection(
+                    self.host, self.port, limit=MAX_FRAME)
+                self._writer = writer
+                self._send({"op": "hello", "secret": self.secret})
+                for topic in self._topics:  # resubscribe after reconnect
+                    self._send({"op": "sub", "topic": topic})
+                self._connected.set()
+                backoff = 0.1
+                while True:
+                    line = await reader.readline()
+                    if not line:
+                        break
+                    try:
+                        frame = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    await self._dispatch(frame)
+            except (ConnectionError, OSError):
+                pass
+            finally:
+                self._connected.clear()
+                self._writer = None
+                # in-flight requests cannot complete across a reconnect
+                for future in self._pending.values():
+                    if not future.done():
+                        future.set_exception(ConnectionError("hub connection lost"))
+                self._pending.clear()
+            if self._stopping:
+                return
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, self.reconnect_max)
+
+    async def _dispatch(self, frame: dict[str, Any]) -> None:
+        op = frame.get("op")
+        if op == "msg":
+            if self._on_message is not None:
+                try:
+                    result = self._on_message(frame.get("topic", ""),
+                                              frame.get("msg") or {})
+                    if asyncio.iscoroutine(result):
+                        await result
+                except Exception:
+                    logger.exception("bus message handler failed")
+        elif op == "resp":
+            future = self._pending.pop(frame.get("id"), None)
+            if future is not None and not future.done():
+                future.set_result(frame)
+
+    def _send(self, frame: dict[str, Any]) -> None:
+        if self._writer is None:
+            raise ConnectionError("hub not connected")
+        self._writer.write(json.dumps(frame, separators=(",", ":")).encode() + b"\n")
+
+    def publish(self, topic: str, message: dict[str, Any]) -> None:
+        self._send({"op": "pub", "topic": topic, "msg": message})
+
+    def subscribe(self, topic: str) -> None:
+        self._topics.add(topic)
+        if self._writer is not None:
+            self._send({"op": "sub", "topic": topic})
+
+    async def request(self, frame: dict[str, Any],
+                      timeout: float = 5.0) -> dict[str, Any]:
+        self._next_id += 1
+        frame["id"] = self._next_id
+        future: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[frame["id"]] = future
+        self._send(frame)
+        try:
+            return await asyncio.wait_for(future, timeout)
+        finally:
+            self._pending.pop(frame["id"], None)
+
+
+class TcpEventBus(EventBus):
+    """Network bus: publishes through the hub; local delivery is immediate
+    (same contract as MemoryEventBus/FileEventBus)."""
+
+    def __init__(self, client: HubClient):
+        self._client = client
+        self._subs: dict[str, list[Handler]] = {}
+        client.on_message(self._deliver)
+
+    async def start(self) -> None:
+        await self._client.start()
+
+    async def stop(self) -> None:
+        await self._client.stop()
+
+    async def publish(self, topic: str, message: dict[str, Any]) -> None:
+        try:
+            self._client.publish(topic, message)
+        except ConnectionError:
+            logger.warning("bus publish while hub disconnected: %s", topic)
+        await self._deliver(topic, message)
+
+    def subscribe(self, topic: str, handler: Handler) -> Callable[[], None]:
+        self._subs.setdefault(topic, []).append(handler)
+        self._client.subscribe(topic)
+
+        def _unsub() -> None:
+            try:
+                self._subs.get(topic, []).remove(handler)
+            except ValueError:
+                pass
+
+        return _unsub
+
+    async def _deliver(self, topic: str, message: dict[str, Any]) -> None:
+        for handler in list(self._subs.get(topic, ())):
+            try:
+                await handler(topic, message)
+            except Exception:  # subscriber errors must not break publishers
+                pass
+
+
+class TcpLeaseManager(LeaseManager):
+    """Lease CAS served by the hub (cross-host SET NX EX)."""
+
+    def __init__(self, client: HubClient):
+        self._client = client
+
+    async def acquire(self, name: str, owner: str, ttl: float) -> bool:
+        return await self._op("acquire", name, owner, ttl)
+
+    async def renew(self, name: str, owner: str, ttl: float) -> bool:
+        return await self._op("renew", name, owner, ttl)
+
+    async def release(self, name: str, owner: str) -> None:
+        await self._op("release", name, owner, 0.0)
+
+    async def holder(self, name: str) -> str | None:
+        try:
+            resp = await self._client.request({"op": "holder", "name": name})
+            return resp.get("holder")
+        except (ConnectionError, asyncio.TimeoutError):
+            return None
+
+    async def _op(self, op: str, name: str, owner: str, ttl: float) -> bool:
+        try:
+            resp = await self._client.request(
+                {"op": op, "name": name, "owner": owner, "ttl": ttl})
+            return bool(resp.get("ok"))
+        except (ConnectionError, asyncio.TimeoutError):
+            return False  # unreachable hub = cannot hold leadership
+
+
+def main() -> None:  # pragma: no cover - manual entry point
+    import argparse
+
+    import os
+
+    parser = argparse.ArgumentParser(description="mcpforge coordination hub")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=7077)
+    parser.add_argument("--secret",
+                        default=os.environ.get("MCPFORGE_BUS_TCP_SECRET", ""))
+    args = parser.parse_args()
+
+    async def run() -> None:
+        hub = CoordinationHub(args.host, args.port, secret=args.secret)
+        await hub.start()
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

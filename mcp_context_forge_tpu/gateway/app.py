@@ -49,8 +49,26 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     await db.connect()
     await db.migrate(MIGRATIONS)
 
-    bus = make_bus(settings.bus_backend, settings.bus_dir)
-    leases = make_lease_manager(settings.bus_backend, settings.bus_dir)
+    hub = None
+    if settings.bus_backend == "tcp":
+        from ..coordination.hub import (CoordinationHub, HubClient, TcpEventBus,
+                                        TcpLeaseManager)
+        # the hub authenticates workers: bus payloads carry trusted auth
+        # context (affinity forwards), so cross-host pub/sub must not be open
+        bus_secret = settings.bus_tcp_secret or settings.jwt_secret_key
+        if settings.bus_tcp_serve:
+            hub = CoordinationHub(settings.bus_tcp_host, settings.bus_tcp_port,
+                                  secret=bus_secret)
+            await hub.start()
+            app["coordination_hub"] = hub
+        hub_client = HubClient(settings.bus_tcp_host,
+                               hub.bound_port if hub else settings.bus_tcp_port,
+                               secret=bus_secret)
+        bus = TcpEventBus(hub_client)
+        leases = TcpLeaseManager(hub_client)
+    else:
+        bus = make_bus(settings.bus_backend, settings.bus_dir)
+        leases = make_lease_manager(settings.bus_backend, settings.bus_dir)
     tracer = init_tracer(settings.otel_service_name,
                          settings.otel_exporter if settings.otel_enable else "none")
     metrics = PrometheusRegistry()
@@ -450,6 +468,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         await grpc_service.shutdown()
         await ctx.close_http_client()
         await bus.stop()
+        if hub is not None:
+            await hub.stop()
         await db.close()
 
     app.cleanup_ctx.append(lifecycle)

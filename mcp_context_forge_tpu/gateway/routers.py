@@ -54,8 +54,12 @@ def setup_routes(app: web.Application) -> None:
     @routes.get("/ready")
     async def ready(request: web.Request) -> web.Response:
         try:
-            await request.app["ctx"].db.execute("SELECT 1")
-            return web.json_response({"status": "ready"})
+            ctx = request.app["ctx"]
+            await ctx.db.execute("SELECT 1")
+            elector = ctx.extras.get("leader_elector")
+            return web.json_response({
+                "status": "ready", "worker_id": ctx.worker_id,
+                "leader": bool(elector and elector.is_leader)})
         except Exception as exc:
             return web.json_response({"status": "not ready", "detail": str(exc)}, status=503)
 

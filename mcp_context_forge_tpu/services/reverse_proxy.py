@@ -36,6 +36,7 @@ class ReverseProxyHub:
         self.ctx = ctx
         self._sockets: dict[str, web.WebSocketResponse] = {}  # gateway_id -> ws
         self._pending: dict[str, tuple[str, asyncio.Future]] = {}  # corr -> (gw, fut)
+        self._teardowns: set[asyncio.Task] = set()  # strong refs (GC safety)
 
     def is_connected(self, gateway_id: str) -> bool:
         return gateway_id in self._sockets
@@ -103,13 +104,26 @@ class ReverseProxyHub:
                         future.set_exception(
                             ConnectionError("reverse tunnel closed"))
                         self._pending.pop(corr, None)
-                await self.ctx.db.execute(
-                    "UPDATE gateways SET reachable=0, state='failed', updated_at=?"
-                    " WHERE id=?", (now(), gateway_id))
-                await self.ctx.bus.publish("gateways.changed",
-                                           {"action": "tunnel-closed",
-                                            "id": gateway_id})
+                # aiohttp cancels this handler task on abrupt disconnect: the
+                # DB deactivation must survive that, so it runs detached
+                task = asyncio.create_task(self._deactivate(gateway_id))
+                self._teardowns.add(task)
+                task.add_done_callback(self._teardowns.discard)
         return ws
+
+    async def _deactivate(self, gateway_id: str) -> None:
+        if gateway_id in self._sockets:
+            return  # a new tunnel re-registered before we got scheduled
+        try:
+            await self.ctx.db.execute(
+                "UPDATE gateways SET reachable=0, state='failed', updated_at=?"
+                " WHERE id=?", (now(), gateway_id))
+            await self.ctx.bus.publish("gateways.changed",
+                                       {"action": "tunnel-closed",
+                                        "id": gateway_id})
+        except Exception:
+            logger.exception("reverse tunnel deactivation failed for %s",
+                             gateway_id)
 
     async def _register(self, frame: dict[str, Any], user: str,
                         reject_if_connected: bool = False,

@@ -1,0 +1,138 @@
+"""Two REAL OS processes + a standalone hub: cross-process affinity
+forwarding and leader failover (the reference's test-primary-worker-e2e
+topology — `/root/reference/Makefile` target — across actual process
+boundaries, not in-proc workers)."""
+
+import asyncio
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import aiohttp
+
+AUTH = aiohttp.BasicAuth("admin", "changeme")
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+def _spawn_gateway(port: int, hub_port: int, db_path: str) -> subprocess.Popen:
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "MCPFORGE_DATABASE_URL": f"sqlite:///{db_path}",
+        "MCPFORGE_PLUGINS_ENABLED": "false",
+        "MCPFORGE_TPU_LOCAL_ENABLED": "false",
+        "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
+        "MCPFORGE_BUS_BACKEND": "tcp",
+        "MCPFORGE_BUS_TCP_PORT": str(hub_port),
+        "MCPFORGE_STREAMABLE_HTTP_STATEFUL": "true",
+        "MCPFORGE_LEADER_LEASE_TTL": "1.5",
+        "MCPFORGE_JWT_SECRET_KEY": "two-proc-test-jwt-secret-0123456789",
+        "MCPFORGE_AUTH_ENCRYPTION_SECRET": "two-proc-test-enc-secret-0123456789",
+        "MCPFORGE_DEV_MODE": "true",
+        "MCPFORGE_ENVIRONMENT": "development",
+        "MCPFORGE_LOG_LEVEL": "WARNING",
+    }
+    return subprocess.Popen(
+        [sys.executable, "-m", "mcp_context_forge_tpu.cli", "serve",
+         "--host", "127.0.0.1", "--port", str(port)],
+        cwd=REPO, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+async def _wait_ready(session: aiohttp.ClientSession, port: int,
+                      timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            resp = await session.get(f"http://127.0.0.1:{port}/ready")
+            if resp.status == 200:
+                return
+        except aiohttp.ClientError:
+            pass
+        await asyncio.sleep(0.25)
+    raise TimeoutError(f"gateway on :{port} never became ready")
+
+
+async def _leader_map(session: aiohttp.ClientSession, ports: list[int]) -> dict[int, bool]:
+    out = {}
+    for port in ports:
+        try:
+            resp = await session.get(f"http://127.0.0.1:{port}/ready")
+            out[port] = (await resp.json()).get("leader", False)
+        except aiohttp.ClientError:
+            out[port] = False
+    return out
+
+
+async def test_two_process_affinity_and_leader_failover(tmp_path):
+    hub_port = _free_port()
+    port_a, port_b = _free_port(), _free_port()
+
+    hub_proc = subprocess.Popen(
+        [sys.executable, "-m", "mcp_context_forge_tpu.coordination.hub",
+         "--port", str(hub_port)],
+        cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    proc_a = proc_b = None
+    try:
+        time.sleep(0.5)
+        proc_a = _spawn_gateway(port_a, hub_port, str(tmp_path / "a.db"))
+        proc_b = _spawn_gateway(port_b, hub_port, str(tmp_path / "b.db"))
+        async with aiohttp.ClientSession() as session:
+            await _wait_ready(session, port_a)
+            await _wait_ready(session, port_b)
+
+            # --- cross-process session affinity forwarding
+            resp = await session.post(f"http://127.0.0.1:{port_a}/mcp", json={
+                "jsonrpc": "2.0", "id": 1, "method": "initialize",
+                "params": {"protocolVersion": "2025-06-18", "capabilities": {},
+                           "clientInfo": {"name": "t", "version": "0"}}},
+                auth=AUTH)
+            assert resp.status == 200, await resp.text()
+            session_id = resp.headers["mcp-session-id"]
+
+            # misrouted request to B is forwarded to owner A over the hub
+            resp = await session.post(f"http://127.0.0.1:{port_b}/mcp", json={
+                "jsonrpc": "2.0", "id": 2, "method": "ping"},
+                headers={"mcp-session-id": session_id}, auth=AUTH)
+            assert resp.status == 200, await resp.text()
+            assert await resp.json() == {"jsonrpc": "2.0", "id": 2, "result": {}}
+
+            # --- exactly one leader
+            deadline = time.monotonic() + 15
+            leaders = {}
+            while time.monotonic() < deadline:
+                leaders = await _leader_map(session, [port_a, port_b])
+                if sum(leaders.values()) == 1:
+                    break
+                await asyncio.sleep(0.3)
+            assert sum(leaders.values()) == 1, f"leaders: {leaders}"
+
+            # --- kill the leader; the survivor takes over within ~2 TTLs
+            leader_port = next(p for p, is_l in leaders.items() if is_l)
+            survivor_port = port_b if leader_port == port_a else port_a
+            leader_proc = proc_a if leader_port == port_a else proc_b
+            leader_proc.send_signal(signal.SIGKILL)
+            deadline = time.monotonic() + 20
+            took_over = False
+            while time.monotonic() < deadline:
+                leaders = await _leader_map(session, [survivor_port])
+                if leaders.get(survivor_port):
+                    took_over = True
+                    break
+                await asyncio.sleep(0.3)
+            assert took_over, "survivor never became leader after leader kill"
+    finally:
+        for proc in (proc_a, proc_b, hub_proc):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)

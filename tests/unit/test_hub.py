@@ -1,0 +1,135 @@
+"""TCP coordination hub: pub/sub fan-out, lease CAS, reconnect.
+
+Reference semantics: Redis pub/sub + SET NX EX leases
+(`/root/reference/mcpgateway/services/leader_election.py:8-12`,
+`cache/session_registry.py:12-20`) — here served by the in-tree hub.
+"""
+
+import asyncio
+
+from mcp_context_forge_tpu.coordination.hub import (CoordinationHub, HubClient,
+                                                    TcpEventBus,
+                                                    TcpLeaseManager)
+
+
+async def _hub_and_clients(n: int = 2):
+    hub = CoordinationHub("127.0.0.1", 0)
+    await hub.start()
+    clients = [HubClient("127.0.0.1", hub.bound_port) for _ in range(n)]
+    for client in clients:
+        await client.start()
+    return hub, clients
+
+
+async def test_pubsub_crosses_connections():
+    hub, (c1, c2) = await _hub_and_clients()
+    bus1, bus2 = TcpEventBus(c1), TcpEventBus(c2)
+    try:
+        got1, got2 = [], []
+        bus1.subscribe("t", lambda t, m: _collect(got1, m))
+        bus2.subscribe("t", lambda t, m: _collect(got2, m))
+        await asyncio.sleep(0.05)  # let subs register at the hub
+        await bus1.publish("t", {"n": 1})
+        await asyncio.sleep(0.1)
+        assert got1 == [{"n": 1}]        # local delivery
+        assert got2 == [{"n": 1}]        # network delivery
+        # unsubscribed topic does not arrive
+        await bus1.publish("other", {"n": 2})
+        await asyncio.sleep(0.1)
+        assert got2 == [{"n": 1}]
+    finally:
+        await bus1.stop()
+        await bus2.stop()
+        await hub.stop()
+
+
+async def _collect(into, message):
+    into.append(message)
+
+
+async def test_lease_cas_across_connections():
+    hub, (c1, c2) = await _hub_and_clients()
+    l1, l2 = TcpLeaseManager(c1), TcpLeaseManager(c2)
+    try:
+        assert await l1.acquire("leader", "w1", ttl=5.0)
+        assert not await l2.acquire("leader", "w2", ttl=5.0)  # held
+        assert await l2.holder("leader") == "w1"
+        assert await l1.renew("leader", "w1", ttl=5.0)
+        assert not await l2.renew("leader", "w2", ttl=5.0)   # not owner
+        await l1.release("leader", "w1")
+        assert await l2.acquire("leader", "w2", ttl=5.0)     # takeover
+        assert await l1.holder("leader") == "w2"
+    finally:
+        await c1.stop()
+        await c2.stop()
+        await hub.stop()
+
+
+async def test_lease_expiry_allows_takeover():
+    hub, (c1, c2) = await _hub_and_clients()
+    l1, l2 = TcpLeaseManager(c1), TcpLeaseManager(c2)
+    try:
+        assert await l1.acquire("leader", "w1", ttl=0.1)
+        await asyncio.sleep(0.25)
+        assert await l2.acquire("leader", "w2", ttl=5.0)  # expired lease falls
+    finally:
+        await c1.stop()
+        await c2.stop()
+        await hub.stop()
+
+
+async def test_client_reconnects_and_resubscribes():
+    hub, (c1, c2) = await _hub_and_clients()
+    bus2 = TcpEventBus(c2)
+    try:
+        got = []
+        bus2.subscribe("t", lambda t, m: _collect(got, m))
+        await asyncio.sleep(0.05)
+        # sever every connection hub-side; clients must reconnect
+        port = hub.bound_port
+        await hub.stop()
+        hub2 = CoordinationHub("127.0.0.1", port)
+        await hub2.start()
+        await asyncio.sleep(0.6)  # reconnect backoff
+        c1.publish("t", {"again": True})
+        await asyncio.sleep(0.3)
+        assert got == [{"again": True}]
+        await hub2.stop()
+    finally:
+        await bus2.stop()
+        await c1.stop()
+
+
+async def test_disconnected_lease_ops_fail_closed():
+    hub, (c1,) = await _hub_and_clients(1)
+    leases = TcpLeaseManager(c1)
+    await hub.stop()
+    await asyncio.sleep(0.05)
+    # hub gone: cannot claim/hold leadership (no split brain)
+    assert not await leases.acquire("leader", "w1", ttl=5.0)
+    assert await leases.holder("leader") is None
+    await c1.stop()
+
+
+async def test_hub_rejects_bad_secret():
+    hub = CoordinationHub("127.0.0.1", 0, secret="right-secret")
+    await hub.start()
+    try:
+        good = HubClient("127.0.0.1", hub.bound_port, secret="right-secret")
+        await good.start()
+        leases = TcpLeaseManager(good)
+        assert await leases.acquire("l", "w1", ttl=5.0)
+
+        bad = HubClient("127.0.0.1", hub.bound_port, secret="wrong")
+        try:
+            await bad.start()
+        except (asyncio.TimeoutError, TimeoutError):
+            pass  # hub closes the socket; client never connects
+        bad_leases = TcpLeaseManager(bad)
+        # an unauthenticated peer cannot steal the lease
+        assert not await bad_leases.acquire("l", "w2", ttl=5.0)
+        assert await leases.holder("l") == "w1"
+        await bad.stop()
+        await good.stop()
+    finally:
+        await hub.stop()
