@@ -85,10 +85,15 @@ async def run(platform: str) -> dict:
             "prefill_batches": engine.stats.prefill_batches,
         }
         if platform == "tpu":
+            import jax
+
+            n_chips = len(jax.devices())  # engine meshes over every chip
             n_params = count_params(MODEL_CONFIGS[model])
             achieved_tflops = 2 * n_params * tokens_per_s / 1e12
             out["n_params"] = n_params
-            out["mfu"] = round(achieved_tflops / V5E_PEAK_BF16_TFLOPS, 4)
+            out["n_chips"] = n_chips
+            out["mfu"] = round(
+                achieved_tflops / (V5E_PEAK_BF16_TFLOPS * n_chips), 4)
         return out
     finally:
         await engine.stop()

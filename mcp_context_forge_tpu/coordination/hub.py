@@ -109,6 +109,7 @@ class CoordinationHub:
                 logger.warning("hub: rejected connection with bad secret")
                 writer.close()
                 return
+            self._send(writer, {"op": "hello_ok"})
         conn_id = self._next_conn
         self._next_conn += 1
         self._conns[conn_id] = (writer, set())
@@ -137,6 +138,8 @@ class CoordinationHub:
         if op == "pub":
             await self._broadcast(conn_id, frame.get("topic", ""),
                                   frame.get("msg") or {})
+        elif op == "hello":  # secretless hub still acks so clients confirm
+            self._send(writer, {"op": "hello_ok"})
         elif op == "sub":
             conn[1].add(frame.get("topic", "*"))
         elif op == "unsub":
@@ -251,6 +254,11 @@ class HubClient:
                     self.host, self.port, limit=MAX_FRAME)
                 self._writer = writer
                 self._send({"op": "hello", "secret": self.secret})
+                # _connected only after the hub acks the secret — otherwise a
+                # typo'd secret looks like a healthy start with a dead bus
+                line = await asyncio.wait_for(reader.readline(), timeout=5.0)
+                if not line or json.loads(line).get("op") != "hello_ok":
+                    raise ConnectionError("hub rejected handshake (bad secret?)")
                 for topic in self._topics:  # resubscribe after reconnect
                     self._send({"op": "sub", "topic": topic})
                 self._connected.set()
@@ -264,8 +272,11 @@ class HubClient:
                     except json.JSONDecodeError:
                         continue
                     await self._dispatch(frame)
-            except (ConnectionError, OSError):
-                pass
+            except (ConnectionError, OSError, asyncio.TimeoutError,
+                    json.JSONDecodeError) as exc:
+                if backoff >= self.reconnect_max:
+                    logger.warning("hub connection failing (%s:%s): %s",
+                                   self.host, self.port, exc)
             finally:
                 self._connected.clear()
                 self._writer = None
@@ -307,6 +318,14 @@ class HubClient:
         self._topics.add(topic)
         if self._writer is not None:
             self._send({"op": "sub", "topic": topic})
+
+    def unsubscribe(self, topic: str) -> None:
+        self._topics.discard(topic)
+        if self._writer is not None:
+            try:
+                self._send({"op": "unsub", "topic": topic})
+            except ConnectionError:
+                pass  # next reconnect simply won't resubscribe
 
     async def request(self, frame: dict[str, Any],
                       timeout: float = 5.0) -> dict[str, Any]:
@@ -351,7 +370,10 @@ class TcpEventBus(EventBus):
             try:
                 self._subs.get(topic, []).remove(handler)
             except ValueError:
-                pass
+                return
+            if not self._subs.get(topic):  # last handler: stop hub fan-out
+                self._subs.pop(topic, None)
+                self._client.unsubscribe(topic)
 
         return _unsub
 

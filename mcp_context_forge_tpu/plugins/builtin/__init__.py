@@ -52,5 +52,7 @@ for _name, _path in {
     "summarizer": f"{_P}.llm_plugins.SummarizerPlugin",
     "content_moderation": f"{_P}.llm_plugins.ContentModerationPlugin",
     "harmful_content_detector": f"{_P}.llm_plugins.HarmfulContentDetectorPlugin",
+    # out-of-process plugin servers over stdio MCP (reference plugins/external)
+    "external": "mcp_context_forge_tpu.plugins.external.ExternalPlugin",
 }.items():
     register_builtin(_name, _path)

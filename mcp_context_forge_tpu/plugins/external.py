@@ -1,0 +1,226 @@
+"""External (out-of-process) plugins over stdio MCP.
+
+Reference: plugins may run as external MCP servers reached over
+stdio/gRPC/unix transports (`/root/reference/conftest.py:17-22`;
+`plugins/external/{cedar,clamav_server,llmguard,opa}` are shipped as
+standalone plugin servers). Here:
+
+- ``StdioPluginProcess`` — spawns the plugin server as a subprocess and
+  speaks newline-delimited JSON-RPC (MCP) on its stdio; auto-restarts a
+  crashed server with backoff.
+- ``ExternalPlugin`` — a framework `Plugin` whose hook methods forward to
+  the subprocess as MCP ``tools/call`` with the hook name as the tool.
+  Discovery: ``tools/list`` at initialize; the advertised tool names are
+  the hooks the plugin implements.
+
+Hook wire contract (the plugin server's tool result content[0].text is a
+JSON object):
+  {"continue": true}                          no change
+  {"modified": {...hook payload fields...}}   rewrite (policy-checked by
+                                              the manager like any plugin)
+  {"violation": {"reason": ..., "code": ...}} block the request
+
+Config (PluginConfig.config):
+  command: ["python", "path/to/server.py", ...]   required
+  cwd / env: optional spawn environment
+  timeout_s: per-hook call timeout (default 10)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+from typing import Any
+
+from .framework import (HookType, Plugin, PluginConfig, PluginContext,
+                        PluginViolation, register_builtin)
+
+logger = logging.getLogger(__name__)
+
+
+class StdioPluginProcess:
+    """JSON-RPC over a subprocess's stdio, with crash restart."""
+
+    def __init__(self, command: list[str], cwd: str | None = None,
+                 env: dict[str, str] | None = None, timeout_s: float = 10.0):
+        self.command = command
+        self.cwd = cwd
+        self.env = env
+        self.timeout_s = timeout_s
+        self._proc: asyncio.subprocess.Process | None = None
+        self._next_id = 0
+        self._lock = asyncio.Lock()  # one request in flight per process
+
+    async def start(self) -> None:
+        env = dict(os.environ)
+        if self.env:
+            env.update(self.env)
+        self._proc = await asyncio.create_subprocess_exec(
+            *self.command, cwd=self.cwd, env=env,
+            stdin=asyncio.subprocess.PIPE, stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.DEVNULL)
+
+    async def stop(self) -> None:
+        proc = self._proc
+        self._proc = None
+        if proc is not None and proc.returncode is None:
+            proc.terminate()
+            try:
+                await asyncio.wait_for(proc.wait(), timeout=5.0)
+            except asyncio.TimeoutError:
+                proc.kill()
+                await proc.wait()
+
+    @property
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.returncode is None
+
+    async def request(self, method: str,
+                      params: dict[str, Any] | None = None) -> dict[str, Any]:
+        async with self._lock:
+            if not self.alive:
+                # crash restart: a spec-conforming MCP server rejects
+                # requests before initialize, so redo the handshake
+                await self.start()
+                if method != "initialize":
+                    await self._roundtrip("initialize", {
+                        "protocolVersion": "2025-06-18", "capabilities": {},
+                        "clientInfo": {"name": "mcpforge-plugin-host",
+                                       "version": "1"}})
+            return await self._roundtrip(method, params)
+
+    async def _roundtrip(self, method: str,
+                         params: dict[str, Any] | None = None) -> dict[str, Any]:
+        assert self._proc is not None
+        self._next_id += 1
+        rid = self._next_id
+        frame = {"jsonrpc": "2.0", "id": rid, "method": method,
+                 "params": params or {}}
+        self._proc.stdin.write(
+            json.dumps(frame, separators=(",", ":")).encode() + b"\n")
+        await self._proc.stdin.drain()
+        while True:
+            line = await asyncio.wait_for(self._proc.stdout.readline(),
+                                          timeout=self.timeout_s)
+            if not line:
+                raise ConnectionError("external plugin process exited")
+            try:
+                message = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # stray stdout noise from the plugin
+            if message.get("id") != rid:
+                continue
+            if "error" in message:
+                raise RuntimeError(
+                    f"external plugin error: {message['error']}")
+            return message.get("result", {})
+
+
+class ExternalPlugin(Plugin):
+    """Routes hooks to an out-of-process stdio MCP plugin server."""
+
+    def __init__(self, config: PluginConfig, ctx=None):
+        super().__init__(config, ctx)
+        command = config.config.get("command")
+        if not command:
+            raise ValueError(f"external plugin {config.name}: 'command' required")
+        self._proc = StdioPluginProcess(
+            list(command), cwd=config.config.get("cwd"),
+            env=config.config.get("env"),
+            timeout_s=float(config.config.get("timeout_s", 10.0)))
+        self._hooks: set[str] = set()
+
+    async def initialize(self) -> None:
+        await self._proc.start()
+        await self._proc.request("initialize", {
+            "protocolVersion": "2025-06-18", "capabilities": {},
+            "clientInfo": {"name": "mcpforge-plugin-host", "version": "1"}})
+        tools = (await self._proc.request("tools/list")).get("tools", [])
+        hook_names = {h.value for h in HookType}
+        self._hooks = {t["name"] for t in tools if t.get("name") in hook_names}
+        logger.info("external plugin %s: hooks %s", self.config.name,
+                    sorted(self._hooks))
+
+    async def shutdown(self) -> None:
+        await self._proc.stop()
+
+    def implements(self, hook: HookType) -> bool:
+        if hook.value not in self._hooks:
+            return False
+        if self.config.hooks and hook.value not in self.config.hooks:
+            return False
+        return True
+
+    # ------------------------------------------------------------- dispatch
+
+    async def _call(self, hook: str, payload: dict[str, Any]) -> dict[str, Any] | None:
+        result = await self._proc.request("tools/call",
+                                          {"name": hook, "arguments": payload})
+        content = result.get("content") or []
+        text = content[0].get("text", "{}") if content else "{}"
+        if result.get("isError"):  # SDK crash text is plain, not JSON
+            raise RuntimeError(f"external plugin {self.config.name}: {text}")
+        try:
+            verdict = json.loads(text)
+        except json.JSONDecodeError:
+            raise RuntimeError(
+                f"external plugin {self.config.name} returned non-JSON verdict")
+        violation = verdict.get("violation")
+        if violation:
+            raise PluginViolation(violation.get("reason", "blocked"),
+                                  code=violation.get("code", "EXTERNAL_POLICY"),
+                                  details=violation.get("details") or {})
+        return verdict.get("modified")
+
+    async def _call_replacing(self, hook: str, payload: dict[str, Any],
+                              field: str):
+        """Post-style hooks: the manager expects the replacement VALUE (the
+        new result/payload), not the modified-fields dict — unwrap it."""
+        modified = await self._call(hook, payload)
+        return modified.get(field) if modified else None
+
+    @staticmethod
+    def _ctx(context: PluginContext) -> dict[str, Any]:
+        return {"user": context.user, "tool_name": context.tool_name,
+                "metadata": context.metadata}
+
+    async def tool_pre_invoke(self, name, arguments, headers, context):
+        return await self._call("tool_pre_invoke", {
+            "name": name, "arguments": arguments, "headers": headers,
+            "context": self._ctx(context)})
+
+    async def tool_post_invoke(self, name, result, context):
+        return await self._call_replacing("tool_post_invoke", {
+            "name": name, "result": result, "context": self._ctx(context)},
+            "result")
+
+    async def prompt_pre_fetch(self, name, arguments, context):
+        return await self._call("prompt_pre_fetch", {
+            "name": name, "arguments": arguments, "context": self._ctx(context)})
+
+    async def prompt_post_fetch(self, name, result, context):
+        return await self._call_replacing("prompt_post_fetch", {
+            "name": name, "result": result, "context": self._ctx(context)},
+            "result")
+
+    async def resource_pre_fetch(self, uri, context):
+        out = await self._call("resource_pre_fetch",
+                               {"uri": uri, "context": self._ctx(context)})
+        return out.get("uri") if out else None
+
+    async def resource_post_fetch(self, uri, result, context):
+        return await self._call_replacing("resource_post_fetch", {
+            "uri": uri, "result": result, "context": self._ctx(context)},
+            "result")
+
+    async def agent_pre_invoke(self, agent, payload, context):
+        return await self._call_replacing("agent_pre_invoke", {
+            "agent": agent, "payload": payload, "context": self._ctx(context)},
+            "payload")
+
+    async def agent_post_invoke(self, agent, result, context):
+        return await self._call_replacing("agent_post_invoke", {
+            "agent": agent, "result": result, "context": self._ctx(context)},
+            "result")

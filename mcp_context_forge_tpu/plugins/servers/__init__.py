@@ -1,0 +1,5 @@
+"""Standalone external plugin servers (stdio MCP), run out-of-process.
+
+Reference: `/root/reference/plugins/external/{cedar,clamav_server,llmguard,
+opa}` — plugin logic shipped as MCP servers the gateway spawns/connects to.
+"""

@@ -1,0 +1,106 @@
+"""SDK for writing external plugin servers.
+
+A plugin server is a stdio MCP server whose tools are named after the
+framework hooks it implements (see `plugins/external.py` for the host side
+and the verdict wire contract). Usage:
+
+    server = PluginServer("my-policy")
+
+    @server.hook("tool_pre_invoke")
+    def check(name, arguments, headers, context):
+        if name in DENYLIST:
+            return violation("tool denied", code="DENY")
+        return ok()
+
+    server.run()
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Callable
+
+
+def ok() -> dict[str, Any]:
+    """No change; let the request continue."""
+    return {"continue": True}
+
+
+def modified(**fields: Any) -> dict[str, Any]:
+    """Rewrite hook payload fields (e.g. arguments={...})."""
+    return {"modified": fields}
+
+
+def violation(reason: str, code: str = "EXTERNAL_POLICY",
+              details: dict[str, Any] | None = None) -> dict[str, Any]:
+    """Block the request."""
+    return {"violation": {"reason": reason, "code": code,
+                          "details": details or {}}}
+
+
+class PluginServer:
+    def __init__(self, name: str, version: str = "0.1.0"):
+        self.name = name
+        self.version = version
+        self._hooks: dict[str, Callable[..., dict[str, Any]]] = {}
+
+    def hook(self, hook_name: str):
+        def decorator(fn: Callable[..., dict[str, Any]]) -> Callable:
+            self._hooks[hook_name] = fn
+            return fn
+        return decorator
+
+    # ------------------------------------------------------------- protocol
+
+    def _handle(self, message: dict[str, Any]) -> dict[str, Any] | None:
+        method = message.get("method", "")
+        if "id" not in message:
+            return None
+        result: Any
+        if method == "initialize":
+            result = {"protocolVersion": "2025-06-18",
+                      "capabilities": {"tools": {}},
+                      "serverInfo": {"name": self.name, "version": self.version}}
+        elif method == "ping":
+            result = {}
+        elif method == "tools/list":
+            result = {"tools": [
+                {"name": hook_name, "description": f"plugin hook {hook_name}",
+                 "inputSchema": {"type": "object"}}
+                for hook_name in self._hooks]}
+        elif method == "tools/call":
+            params = message.get("params", {})
+            fn = self._hooks.get(params.get("name", ""))
+            if fn is None:
+                return {"jsonrpc": "2.0", "id": message["id"],
+                        "error": {"code": -32602,
+                                  "message": f"Unknown hook {params.get('name')!r}"}}
+            try:
+                verdict = fn(**(params.get("arguments") or {}))
+                result = {"content": [{"type": "text",
+                                       "text": json.dumps(verdict)}],
+                          "isError": False}
+            except Exception as exc:
+                result = {"content": [{"type": "text",
+                                       "text": f"{type(exc).__name__}: {exc}"}],
+                          "isError": True}
+        else:
+            return {"jsonrpc": "2.0", "id": message["id"],
+                    "error": {"code": -32601,
+                              "message": f"Unknown method {method!r}"}}
+        return {"jsonrpc": "2.0", "id": message["id"], "result": result}
+
+    def run(self) -> None:  # pragma: no cover - subprocess entry
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                message = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            response = self._handle(message)
+            if response is not None:
+                sys.stdout.write(json.dumps(response) + "\n")
+                sys.stdout.flush()

@@ -1,0 +1,99 @@
+"""External (out-of-process) plugins: stdio MCP transport + OPA policy
+server enforcing a violation end-to-end through the gateway
+(reference plugins/external/opa, conftest.py:17-22)."""
+
+import json
+import sys
+
+import aiohttp
+
+from test_gateway_app import BASIC, make_client, make_echo_rest_server
+
+AUTH = aiohttp.BasicAuth(*BASIC)
+
+OPA_POLICY = {
+    "deny_tools": ["forbidden-tool"],
+    "deny_patterns": [r"(?i)drop\s+table"],
+    "max_argument_bytes": 4096,
+}
+
+
+async def _gateway_with_opa():
+    client = await make_client(plugins_enabled="true")
+    pm = client.app["plugin_manager"]
+    from mcp_context_forge_tpu.plugins.framework import PluginConfig
+    await pm.add_plugin(PluginConfig(
+        name="opa", kind="external",
+        config={"command": [sys.executable, "-m",
+                            "mcp_context_forge_tpu.plugins.servers.opa_policy"],
+                "env": {"MCPFORGE_OPA_POLICY": json.dumps(OPA_POLICY),
+                        "JAX_PLATFORMS": "cpu"},
+                "cwd": "/root/repo"}))
+    return client
+
+
+async def _register_echo(gateway, rest, name):
+    url = f"http://{rest.server.host}:{rest.server.port}/echo"
+    resp = await gateway.post("/tools", json={
+        "name": name, "integration_type": "REST", "url": url}, auth=AUTH)
+    assert resp.status == 201, await resp.text()
+
+
+async def _call(gateway, tool, arguments):
+    resp = await gateway.post("/rpc", json={
+        "jsonrpc": "2.0", "id": 1, "method": "tools/call",
+        "params": {"name": tool, "arguments": arguments}}, auth=AUTH)
+    return await resp.json()
+
+
+async def test_external_opa_plugin_enforces_policy():
+    gateway = await _gateway_with_opa()
+    rest = await make_echo_rest_server()
+    try:
+        await _register_echo(gateway, rest, "safe-tool")
+        await _register_echo(gateway, rest, "forbidden-tool")
+
+        # clean call passes through the external plugin
+        payload = await _call(gateway, "safe-tool", {"q": "hello"})
+        assert not payload["result"].get("isError"), payload
+
+        # denied tool name -> blocked by the out-of-process policy check
+        # (violations surface as JSON-RPC errors, same as in-proc plugins)
+        payload = await _call(gateway, "forbidden-tool", {"q": "hello"})
+        assert "error" in payload, payload
+        assert "denied" in payload["error"]["message"].lower()
+
+        # denied argument pattern
+        payload = await _call(gateway, "safe-tool",
+                              {"q": "DROP TABLE users;"})
+        assert "error" in payload, payload
+
+        # oversized arguments
+        payload = await _call(gateway, "safe-tool", {"blob": "x" * 8192})
+        assert "error" in payload, payload
+    finally:
+        await gateway.close()
+        await rest.close()
+
+
+async def test_external_plugin_survives_server_crash():
+    """The host restarts a crashed plugin server on the next hook call."""
+    gateway = await _gateway_with_opa()
+    rest = await make_echo_rest_server()
+    try:
+        await _register_echo(gateway, rest, "safe-tool")
+        payload = await _call(gateway, "safe-tool", {"q": "one"})
+        assert not payload["result"].get("isError")
+
+        # kill the plugin server process under the host
+        pm = gateway.app["plugin_manager"]
+        plugin = next(p for p in pm.plugins if p.config.name == "opa")
+        plugin._proc._proc.kill()
+        await plugin._proc._proc.wait()
+
+        # next call restarts the subprocess and still enforces
+        payload = await _call(gateway, "safe-tool", {"q": "DROP TABLE x"})
+        assert "error" in payload, payload
+    finally:
+        await gateway.close()
+        await rest.close()

@@ -118,7 +118,8 @@ async def test_hub_rejects_bad_secret():
         good = HubClient("127.0.0.1", hub.bound_port, secret="right-secret")
         await good.start()
         leases = TcpLeaseManager(good)
-        assert await leases.acquire("l", "w1", ttl=5.0)
+        # ttl outlives the bad client's 10s handshake timeout below
+        assert await leases.acquire("l", "w1", ttl=30.0)
 
         bad = HubClient("127.0.0.1", hub.bound_port, secret="wrong")
         try:
