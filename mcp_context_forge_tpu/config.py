@@ -50,6 +50,10 @@ class Settings(BaseModel):
     bus_tcp_secret: str = ""     # hub auth; empty = fall back to jwt secret
     leader_lease_ttl: float = 15.0
 
+    # --- MCP Apps (ui:// AppBridge, reference main.py:10508) ---
+    mcp_apps_enabled: bool = True
+    mcp_apps_session_ttl: float = 300.0
+
     # --- auth ---
     auth_required: bool = True
     jwt_secret_key: str = "dev-only-do-not-use"
@@ -99,6 +103,8 @@ class Settings(BaseModel):
     otel_db_store: bool = True           # persist notable spans to the DB
     otel_db_min_duration_ms: float = 50  # slow-span threshold (errors always kept)
     otel_service_name: str = "mcpforge"
+    otel_otlp_endpoint: str = ""   # e.g. http://collector:4318 (OTLP/HTTP)
+    otel_otlp_headers: str = ""    # JSON object of extra headers
     log_level: str = "INFO"
     log_json: bool = False
     metrics_buffer_flush_interval: float = 5.0

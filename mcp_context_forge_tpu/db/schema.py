@@ -347,7 +347,43 @@ CREATE TABLE IF NOT EXISTS a2a_tasks (
 CREATE INDEX IF NOT EXISTS ix_a2a_tasks_agent ON a2a_tasks(agent_id, created_at);
 """
 
+_V3 = """
+-- MCP Apps: short-lived AppBridge sessions bound to an MCP session and a
+-- ui:// resource (reference MCPAppSession, db.py:4012)
+CREATE TABLE IF NOT EXISTS mcp_app_sessions (
+  id TEXT PRIMARY KEY,
+  mcp_session_id TEXT NOT NULL,
+  user_email TEXT NOT NULL,
+  server_id TEXT,
+  resource_uri TEXT NOT NULL,
+  created_at REAL NOT NULL,
+  expires_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS ix_mcp_app_sessions_expires
+  ON mcp_app_sessions(expires_at);
+"""
+
+_V4 = """
+-- OAuth Dynamic Client Registration (RFC 7591) records per gateway/issuer
+-- (reference services/dcr_service.py, RegisteredOAuthClient)
+CREATE TABLE IF NOT EXISTS registered_oauth_clients (
+  id TEXT PRIMARY KEY,
+  gateway_id TEXT NOT NULL,
+  issuer TEXT NOT NULL,
+  client_id TEXT NOT NULL,
+  client_secret_enc TEXT,
+  redirect_uri TEXT,
+  scopes TEXT,
+  registration_client_uri TEXT,
+  registration_access_token_enc TEXT,
+  created_at REAL NOT NULL,
+  UNIQUE (gateway_id, issuer)
+);
+"""
+
 MIGRATIONS: list[Migration] = [
     Migration(1, "initial-core-schema", _V1),
     Migration(2, "a2a-task-store", _V2),
+    Migration(3, "mcp-app-sessions", _V3),
+    Migration(4, "registered-oauth-clients", _V4),
 ]

@@ -107,6 +107,20 @@ async def build_app(settings: Settings | None = None) -> web.Application:
                 pass  # span finished outside the loop (tests)
 
         tracer.add_sink(_db_sink)
+
+    otlp_exporter = None
+    if settings.otel_enable and settings.otel_otlp_endpoint:
+        # OTLP/HTTP wire export (reference observability.py:970) — runs
+        # alongside the memory/db sinks
+        import json as _json
+
+        from ..observability.otlp import OTLPExporter
+        headers = (_json.loads(settings.otel_otlp_headers)
+                   if settings.otel_otlp_headers else None)
+        otlp_exporter = OTLPExporter(ctx, settings.otel_otlp_endpoint,
+                                     settings.otel_service_name, headers)
+        tracer.add_sink(otlp_exporter.sink)
+        app["otlp_exporter"] = otlp_exporter
     app["ctx"] = ctx
     app["rate_limiter"] = RateLimiter(settings.rate_limit_rps, settings.rate_limit_burst)
 
@@ -317,12 +331,57 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     app.router.add_get("/auth/sso/{provider}/login", sso_login)
     app.router.add_get("/auth/sso/{provider}/callback", sso_callback)
 
+    # OAuth DCR + token exchange (reference dcr_service.py / oauth_manager
+    # token-exchange validation at gateway_service.py:767)
+    from ..services.oauth_service import DCRService, exchange_token
+    dcr_service = DCRService(ctx)
+    app["dcr_service"] = dcr_service
+    ctx.extras["dcr_service"] = dcr_service
+
+    async def dcr_register(request: web.Request) -> web.Response:
+        request["auth"].require("gateways.update")
+        body = await request.json()
+        record = await dcr_service.get_or_register(
+            body.get("gateway_id", ""), body.get("issuer", ""),
+            body.get("redirect_uri", f"{settings.app_domain}/oauth/callback"),
+            body.get("scopes"))
+        return web.json_response(record, status=201)
+
+    async def dcr_list(request: web.Request) -> web.Response:
+        request["auth"].require("gateways.read")
+        return web.json_response(await dcr_service.list_clients())
+
+    async def dcr_delete(request: web.Request) -> web.Response:
+        request["auth"].require("gateways.update")
+        await dcr_service.delete_client(request.match_info["record_id"])
+        return web.Response(status=204)
+
+    async def oauth_exchange(request: web.Request) -> web.Response:
+        request["auth"].require("gateways.update")
+        body = await request.json()
+        payload = await exchange_token(
+            ctx, body.get("token_url", ""), body.get("subject_token", ""),
+            client_id=body.get("client_id", ""),
+            client_secret=body.get("client_secret", ""),
+            audience=body.get("audience", ""))
+        return web.json_response(payload)
+
+    app.router.add_post("/oauth/dcr/register", dcr_register)
+    app.router.add_get("/oauth/dcr/clients", dcr_list)
+    app.router.add_delete("/oauth/dcr/clients/{record_id}", dcr_delete)
+    app.router.add_post("/oauth/exchange", oauth_exchange)
+
     from ..services.grpc_service import GrpcService
     grpc_service = GrpcService(ctx, tool_service)
     ctx.extras["grpc_service"] = grpc_service
     app["grpc_service"] = grpc_service
 
     from ..services.elicitation_service import ElicitationService
+    if settings.mcp_apps_enabled:
+        from ..services.mcp_apps_service import MCPAppsService
+        app["mcp_apps_service"] = MCPAppsService(ctx, transport.sessions,
+                                                 resource_service)
+
     elicitation_service = ElicitationService(ctx, transport.sessions)
     transport.elicitation = elicitation_service
     ctx.extras["elicitation_service"] = elicitation_service
@@ -445,12 +504,22 @@ async def build_app(settings: Settings | None = None) -> web.Application:
             while True:
                 await _asyncio.sleep(600)
                 app["chat_service"].sweep(ttl=settings.session_ttl)
+                apps_service = app.get("mcp_apps_service")
+                if apps_service is not None:
+                    try:  # expired AppBridge rows must not accumulate
+                        await apps_service.sweep()
+                    except Exception:
+                        logger.exception("mcp_apps sweep failed")
 
         chat_sweeper = _asyncio.create_task(_chat_sweeper())
         await affinity.start()
         await audit_service.start()
+        if otlp_exporter is not None:
+            await otlp_exporter.start()
         logger.info("%s started (worker %s)", settings.app_name, ctx.worker_id)
         yield
+        if otlp_exporter is not None:
+            await otlp_exporter.stop()
         await audit_service.stop()
         await affinity.stop()
         chat_sweeper.cancel()

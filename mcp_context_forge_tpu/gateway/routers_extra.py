@@ -226,4 +226,59 @@ def setup_extra_routes(app: web.Application) -> None:
             body, overwrite=request.query.get("overwrite") == "true")
         return web.json_response(summary)
 
+    # ---------------------------------------- MCP Apps (ui:// AppBridge)
+    # Reference main.py:10508 (create) / :10576 (session-scoped tools/call)
+
+    def _apps(request: web.Request):
+        service = request.app.get("mcp_apps_service")
+        if service is None:
+            raise web.HTTPNotFound(reason="MCP Apps are disabled")
+        return service
+
+    @routes.post("/appbridge/sessions")
+    async def create_app_session(request: web.Request) -> web.Response:
+        auth = request["auth"]
+        auth.require("resources.read")
+        service = _apps(request)
+        body = await request.json()
+        session = await service.create_session(
+            mcp_session_id=(body.get("mcpSessionId")
+                            or request.headers.get("mcp-session-id", "")),
+            user=auth.user,
+            server_id=body.get("serverId") or body.get("server_id") or "",
+            resource_uri=body.get("resourceUri") or body.get("resource_uri") or "")
+        return web.json_response(session, status=201)
+
+    @routes.post("/appbridge/sessions/{app_session_id}/rpc")
+    async def app_session_rpc(request: web.Request) -> web.Response:
+        auth = request["auth"]
+        auth.require("tools.invoke")
+        service = _apps(request)
+        body = await request.json()
+        rpc_id = body.get("id")
+        if body.get("method") != "tools/call":
+            return web.json_response({
+                "jsonrpc": "2.0", "id": rpc_id,
+                "error": {"code": -32601,
+                          "message": "AppBridge sessions only allow tools/call"}})
+        mcp_session_id = (body.get("mcpSessionId")
+                          or request.headers.get("mcp-session-id", ""))
+        session = await service.get_valid_session(
+            request.match_info["app_session_id"], mcp_session_id,
+            auth.user, is_admin=auth.is_admin)
+        if session is None:
+            return web.json_response({
+                "jsonrpc": "2.0", "id": rpc_id,
+                "error": {"code": -32003, "message": "Access denied"}})
+        from ..jsonrpc import JSONRPCError, RPCRequest, error_response
+        try:
+            response = await request.app["dispatcher"].dispatch(
+                RPCRequest.parse(body), auth,
+                headers=dict(request.headers),
+                server_id=session["server_id"])
+        except JSONRPCError as exc:
+            return web.json_response(error_response(rpc_id, exc.code,
+                                                    str(exc)))
+        return web.json_response(response)
+
     app.add_routes(routes)

@@ -1,0 +1,133 @@
+"""OTLP/HTTP wire export for the in-tree tracer.
+
+Reference: vendor-agnostic OTLP export (`/root/reference/mcpgateway/
+observability.py:970` — Jaeger/Zipkin/Tempo/Phoenix/Langfuse all consume
+OTLP). Round 1 only persisted spans to sqlite; this sink batches finished
+spans and POSTs OTLP-JSON to ``{endpoint}/v1/traces`` so any OTLP
+collector can ingest gateway + engine traces.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from typing import Any
+
+from .tracing import Span
+
+logger = logging.getLogger(__name__)
+
+
+def _attr(key: str, value: Any) -> dict[str, Any]:
+    if isinstance(value, bool):
+        typed: dict[str, Any] = {"boolValue": value}
+    elif isinstance(value, int):
+        typed = {"intValue": str(value)}
+    elif isinstance(value, float):
+        typed = {"doubleValue": value}
+    else:
+        typed = {"stringValue": str(value)}
+    return {"key": key, "value": typed}
+
+
+def encode_spans(spans: list[Span], service_name: str) -> dict[str, Any]:
+    """OTLP-JSON ExportTraceServiceRequest."""
+    def nanos(ts: float | None) -> str:
+        return str(int((ts or 0.0) * 1e9))
+
+    return {"resourceSpans": [{
+        "resource": {"attributes": [_attr("service.name", service_name)]},
+        "scopeSpans": [{
+            "scope": {"name": "mcpforge"},
+            "spans": [{
+                "traceId": span.trace_id,
+                "spanId": span.span_id,
+                **({"parentSpanId": span.parent_span_id}
+                   if span.parent_span_id else {}),
+                "name": span.name,
+                "kind": 1,  # SPAN_KIND_INTERNAL
+                "startTimeUnixNano": nanos(span.start_ts),
+                "endTimeUnixNano": nanos(span.end_ts),
+                "attributes": [_attr(k, v) for k, v in span.attributes.items()],
+                "events": [{"timeUnixNano": nanos(ts), "name": name,
+                            "attributes": [_attr(k, v) for k, v in attrs.items()]}
+                           for ts, name, attrs in span.events],
+                "status": {"code": 2 if span.status == "ERROR" else 1},
+            } for span in spans],
+        }],
+    }]}
+
+
+class OTLPExporter:
+    """Buffers spans from the (sync) tracer sink; an async flusher POSTs
+    them in batches. Dropping is preferred over blocking the request path."""
+
+    def __init__(self, ctx, endpoint: str, service_name: str,
+                 headers: dict[str, str] | None = None,
+                 flush_interval: float = 2.0, max_buffer: int = 8192,
+                 max_batch: int = 512):
+        self.ctx = ctx
+        self.endpoint = endpoint.rstrip("/")
+        self.service_name = service_name
+        self.headers = {"content-type": "application/json", **(headers or {})}
+        self.flush_interval = flush_interval
+        self.max_buffer = max_buffer
+        self.max_batch = max_batch
+        self._buffer: list[Span] = []
+        self._lock = threading.Lock()
+        self._task: asyncio.Task | None = None
+        self.exported = 0
+        self.dropped = 0
+
+    def sink(self, span: Span) -> None:
+        with self._lock:
+            if len(self._buffer) >= self.max_buffer:
+                self.dropped += 1
+                return
+            self._buffer.append(span)
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        await self.flush()
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.flush_interval)
+            try:
+                await self.flush()
+            except Exception:
+                logger.debug("otlp flush failed", exc_info=True)
+
+    async def flush(self) -> None:
+        while True:
+            with self._lock:
+                batch = self._buffer[: self.max_batch]
+                del self._buffer[: self.max_batch]
+            if not batch:
+                return
+            payload = encode_spans(batch, self.service_name)
+            try:
+                resp = await self.ctx.http_client.post(
+                    f"{self.endpoint}/v1/traces", json=payload,
+                    headers=self.headers)
+                if resp.status_code >= 400:
+                    logger.warning("otlp export rejected: %s %s",
+                                   resp.status_code, resp.text[:200])
+                    self.dropped += len(batch)
+                else:
+                    self.exported += len(batch)
+            except Exception as exc:
+                # collector down: drop the batch, keep serving
+                logger.debug("otlp export failed: %s", exc)
+                self.dropped += len(batch)

@@ -1,0 +1,77 @@
+"""MCP Apps (ui:// AppBridge) sessions.
+
+Reference: `/root/reference/mcpgateway/main.py:10508` (create) and
+`:10576` (session-scoped tools/call RPC), model `MCPAppSession`
+(`db.py:4012`). An app session binds (MCP session, user, virtual server,
+ui:// resource) for a short TTL; the app's iframe then calls tools ONLY
+through its session, scoped to that server's tool set.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..utils.ids import new_id
+from .base import AppContext, NotFoundError, ValidationFailure, now
+
+
+class MCPAppsService:
+    def __init__(self, ctx: AppContext, session_manager, resource_service):
+        self.ctx = ctx
+        self.sessions = session_manager  # streamable-HTTP SessionManager
+        self.resources = resource_service
+
+    async def create_session(self, mcp_session_id: str, user: str,
+                             server_id: str, resource_uri: str) -> dict[str, Any]:
+        if not resource_uri.startswith("ui://"):
+            raise ValidationFailure("resourceUri must use the ui:// scheme")
+        if not mcp_session_id or self.sessions.get(mcp_session_id) is None:
+            raise NotFoundError("Unknown MCP session")
+        if not server_id:
+            raise ValidationFailure("serverId is required for MCP Apps sessions")
+        server = await self.ctx.db.fetchone(
+            "SELECT id FROM servers WHERE id=? AND enabled=1", (server_id,))
+        if not server:
+            raise NotFoundError(f"Server {server_id!r} not found")
+        # the UI resource must be readable AND associated with this server —
+        # the session binds (server, resource), so a resource from another
+        # server must not be bridgeable into this one
+        associated = await self.ctx.db.fetchone(
+            "SELECT 1 FROM server_resources sr JOIN resources r"
+            " ON r.id = sr.resource_id WHERE sr.server_id=? AND r.uri=?",
+            (server_id, resource_uri))
+        if not associated:
+            raise NotFoundError(
+                f"Resource {resource_uri!r} is not associated with server"
+                f" {server_id!r}")
+        await self.resources.read_resource(resource_uri)
+        ttl = self.ctx.settings.mcp_apps_session_ttl
+        app_session_id = new_id()
+        ts = now()
+        await self.ctx.db.execute(
+            "INSERT INTO mcp_app_sessions (id, mcp_session_id, user_email,"
+            " server_id, resource_uri, created_at, expires_at)"
+            " VALUES (?,?,?,?,?,?,?)",
+            (app_session_id, mcp_session_id, user, server_id, resource_uri,
+             ts, ts + ttl))
+        return {"appSessionId": app_session_id, "resourceUri": resource_uri,
+                "serverId": server_id, "expiresAt": ts + ttl}
+
+    async def get_valid_session(self, app_session_id: str, mcp_session_id: str,
+                                user: str, is_admin: bool = False
+                                ) -> dict[str, Any] | None:
+        row = await self.ctx.db.fetchone(
+            "SELECT * FROM mcp_app_sessions WHERE id=? AND expires_at>?",
+            (app_session_id, now()))
+        if row is None:
+            return None
+        if row["mcp_session_id"] != mcp_session_id:
+            return None
+        if not is_admin and row["user_email"] != user:
+            return None
+        return row
+
+    async def sweep(self) -> int:
+        cursor = await self.ctx.db.execute(
+            "DELETE FROM mcp_app_sessions WHERE expires_at<=?", (now(),))
+        return getattr(cursor, "rowcount", 0)

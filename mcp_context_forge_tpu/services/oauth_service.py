@@ -165,3 +165,176 @@ def _unverified_id_token_claims(id_token: str) -> dict[str, Any]:
         return json.loads(base64.urlsafe_b64decode(payload_b64))
     except Exception:
         return {}
+
+
+class DcrError(ValidationFailure):
+    """Dynamic client registration failure (client-actionable -> 422)."""
+
+
+class DCRService:
+    """OAuth Dynamic Client Registration + AS metadata discovery.
+
+    Reference: `services/dcr_service.py` — RFC 8414 metadata discovery
+    (well-known inserted between host and path, OIDC fallback, issuer-match
+    validation, TTL cache) and RFC 7591 dynamic registration, with the
+    registered client persisted per gateway (encrypted secret).
+    """
+
+    def __init__(self, ctx: AppContext):
+        self.ctx = ctx
+        self._metadata_cache: dict[str, tuple[dict[str, Any], float]] = {}
+        self.metadata_ttl = 3600.0
+
+    async def discover(self, issuer: str) -> dict[str, Any]:
+        """RFC 8414 discovery with OIDC fallback; validates issuer match."""
+        from urllib.parse import urlsplit
+
+        issuer = issuer.rstrip("/")
+        cached = self._metadata_cache.get(issuer)
+        if cached and time.monotonic() - cached[1] < self.metadata_ttl:
+            return cached[0]
+        parsed = urlsplit(issuer)
+        rfc8414 = f"{parsed.scheme}://{parsed.netloc}/.well-known/oauth-authorization-server"
+        if parsed.path:
+            rfc8414 += parsed.path
+        oidc = f"{issuer}/.well-known/openid-configuration"
+        last_error: Exception | None = None
+        for url in (rfc8414, oidc):
+            try:
+                resp = await self.ctx.http_client.get(url)
+                if resp.status_code != 200:
+                    last_error = DcrError(f"metadata fetch {url} -> {resp.status_code}")
+                    continue
+                metadata = resp.json()
+                if (metadata.get("issuer") or "").rstrip("/") != issuer:
+                    raise DcrError(
+                        f"AS metadata issuer mismatch: expected {issuer},"
+                        f" got {metadata.get('issuer')}")
+                self._metadata_cache[issuer] = (metadata, time.monotonic())
+                return metadata
+            except DcrError:
+                raise
+            except Exception as exc:  # network-level
+                last_error = exc
+        raise DcrError(f"Failed to discover AS metadata for {issuer}: {last_error}")
+
+    async def register_client(self, gateway_id: str, issuer: str,
+                              redirect_uri: str,
+                              scopes: list[str] | None = None) -> dict[str, Any]:
+        """RFC 7591 dynamic registration against the issuer's
+        registration_endpoint; persists (encrypted) credentials."""
+        issuer = issuer.rstrip("/")  # stored form must match get_client's
+        metadata = await self.discover(issuer)
+        endpoint = metadata.get("registration_endpoint")
+        if not endpoint:
+            raise DcrError(f"AS {issuer} does not support dynamic registration")
+        body = {
+            "client_name": f"mcpforge-gateway-{gateway_id[:8]}",
+            "redirect_uris": [redirect_uri],
+            "grant_types": ["authorization_code", "refresh_token"],
+            "response_types": ["code"],
+            "token_endpoint_auth_method": "client_secret_basic",
+            **({"scope": " ".join(scopes)} if scopes else {}),
+        }
+        resp = await self.ctx.http_client.post(endpoint, json=body)
+        if resp.status_code not in (200, 201):
+            raise DcrError(f"registration failed ({resp.status_code}): {resp.text[:200]}")
+        registration = resp.json()
+        client_id = registration.get("client_id")
+        if not client_id:
+            raise DcrError("AS response missing client_id")
+        ts = now()
+        record_id = new_id()
+        secret = self.ctx.settings.auth_encryption_secret
+        from ..db.core import to_json
+        from ..utils.crypto import encrypt_field
+        await self.ctx.db.execute(
+            "INSERT INTO registered_oauth_clients (id, gateway_id, issuer,"
+            " client_id, client_secret_enc, redirect_uri, scopes,"
+            " registration_client_uri, registration_access_token_enc, created_at)"
+            " VALUES (?,?,?,?,?,?,?,?,?,?)"
+            " ON CONFLICT(gateway_id, issuer) DO UPDATE SET"
+            " client_id=excluded.client_id,"
+            " client_secret_enc=excluded.client_secret_enc,"
+            " redirect_uri=excluded.redirect_uri, scopes=excluded.scopes,"
+            " registration_client_uri=excluded.registration_client_uri,"
+            " registration_access_token_enc=excluded.registration_access_token_enc",
+            (record_id, gateway_id, issuer, client_id,
+             encrypt_field(registration.get("client_secret", ""), secret),
+             redirect_uri, to_json(scopes or []),
+             registration.get("registration_client_uri"),
+             encrypt_field(registration.get("registration_access_token", ""),
+                           secret),
+             ts))
+        return {"id": record_id, "gateway_id": gateway_id, "issuer": issuer,
+                "client_id": client_id, "redirect_uri": redirect_uri}
+
+    async def get_or_register(self, gateway_id: str, issuer: str,
+                              redirect_uri: str,
+                              scopes: list[str] | None = None) -> dict[str, Any]:
+        row = await self.get_client(gateway_id, issuer)
+        if row is not None:
+            return row
+        return await self.register_client(gateway_id, issuer, redirect_uri, scopes)
+
+    async def get_client(self, gateway_id: str,
+                         issuer: str) -> dict[str, Any] | None:
+        row = await self.ctx.db.fetchone(
+            "SELECT id, gateway_id, issuer, client_id, redirect_uri FROM"
+            " registered_oauth_clients WHERE gateway_id=? AND issuer=?",
+            (gateway_id, issuer.rstrip("/")))
+        return dict(row) if row else None
+
+    async def list_clients(self) -> list[dict[str, Any]]:
+        rows = await self.ctx.db.fetchall(
+            "SELECT id, gateway_id, issuer, client_id, redirect_uri, created_at"
+            " FROM registered_oauth_clients")
+        return [dict(r) for r in rows]
+
+    async def delete_client(self, record_id: str) -> None:
+        row = await self.ctx.db.fetchone(
+            "SELECT * FROM registered_oauth_clients WHERE id=?", (record_id,))
+        if row is None:
+            raise NotFoundError("Registered client not found")
+        # best-effort RFC 7592 de-registration upstream
+        if row["registration_client_uri"]:
+            from ..utils.crypto import decrypt_field
+            token = decrypt_field(row["registration_access_token_enc"],
+                                  self.ctx.settings.auth_encryption_secret)
+            try:
+                await self.ctx.http_client.delete(
+                    row["registration_client_uri"],
+                    headers={"authorization": f"Bearer {token}"} if token else {})
+            except Exception:
+                pass
+        await self.ctx.db.execute(
+            "DELETE FROM registered_oauth_clients WHERE id=?", (record_id,))
+
+
+async def exchange_token(ctx: AppContext, token_url: str, subject_token: str,
+                         client_id: str = "", client_secret: str = "",
+                         audience: str = "",
+                         subject_token_type: str =
+                         "urn:ietf:params:oauth:token-type:access_token"
+                         ) -> dict[str, Any]:
+    """RFC 8693 token exchange (reference gateway_service.py:767 validation
+    path): trade an inbound token for an upstream-audience token."""
+    data = {
+        "grant_type": "urn:ietf:params:oauth:grant-type:token-exchange",
+        "subject_token": subject_token,
+        "subject_token_type": subject_token_type,
+    }
+    if audience:
+        data["audience"] = audience
+    if client_id:
+        data["client_id"] = client_id
+    if client_secret:
+        data["client_secret"] = client_secret
+    resp = await ctx.http_client.post(token_url, data=data)
+    if resp.status_code != 200:
+        raise ValidationFailure(
+            f"token exchange failed ({resp.status_code}): {resp.text[:200]}")
+    payload = resp.json()
+    if "access_token" not in payload:
+        raise ValidationFailure("token exchange response missing access_token")
+    return payload

@@ -42,10 +42,11 @@ async def test_task_lifecycle():
         resp = await gateway.get("/a2a/tasks/nope", auth=AUTH)
         assert resp.status == 404
 
-        # migration v2 applied on a fresh db (schema_migrations has 2 rows)
+        # migrations applied in order on a fresh db (v2 = a2a task store)
         rows = await gateway.app["ctx"].db.fetchall(
             "SELECT version FROM schema_migrations ORDER BY version")
-        assert [r["version"] for r in rows] == [1, 2]
+        versions = [r["version"] for r in rows]
+        assert versions == sorted(versions) and versions[:2] == [1, 2]
     finally:
         await agent_server.close()
         await gateway.close()

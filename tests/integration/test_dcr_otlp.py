@@ -1,0 +1,156 @@
+"""OAuth DCR (RFC 8414 discovery + RFC 7591 registration), RFC 8693 token
+exchange, and OTLP/HTTP span export — round-1 named gaps
+(reference dcr_service.py, gateway_service.py:767, observability.py:970)."""
+
+import asyncio
+import json
+
+import aiohttp
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from test_gateway_app import BASIC, make_client
+
+AUTH = aiohttp.BasicAuth(*BASIC)
+
+
+async def make_fake_as() -> TestClient:
+    """Fake OAuth authorization server: RFC 8414 metadata + DCR + exchange."""
+    app = web.Application()
+    state = {"registrations": [], "deletions": []}
+    app["state"] = state
+
+    async def metadata(request):
+        base = f"http://{request.host}"
+        return web.json_response({
+            "issuer": base,
+            "registration_endpoint": f"{base}/register",
+            "token_endpoint": f"{base}/token",
+            "authorization_endpoint": f"{base}/authorize",
+        })
+
+    async def register(request):
+        body = await request.json()
+        state["registrations"].append(body)
+        base = f"http://{request.host}"
+        return web.json_response({
+            "client_id": f"dcr-client-{len(state['registrations'])}",
+            "client_secret": "dcr-secret-xyz",
+            "registration_client_uri": f"{base}/register/c1",
+            "registration_access_token": "reg-token",
+            **body,
+        }, status=201)
+
+    async def deregister(request):
+        state["deletions"].append(request.headers.get("authorization", ""))
+        return web.Response(status=204)
+
+    async def token(request):
+        form = await request.post()
+        if form.get("grant_type") != "urn:ietf:params:oauth:grant-type:token-exchange":
+            return web.json_response({"error": "unsupported_grant_type"}, status=400)
+        if not form.get("subject_token"):
+            return web.json_response({"error": "invalid_request"}, status=400)
+        return web.json_response({
+            "access_token": f"exchanged-for-{form.get('audience', 'any')}",
+            "issued_token_type": "urn:ietf:params:oauth:token-type:access_token",
+            "token_type": "Bearer", "expires_in": 300})
+
+    app.router.add_get("/.well-known/oauth-authorization-server", metadata)
+    app.router.add_get("/.well-known/openid-configuration", metadata)
+    app.router.add_post("/register", register)
+    app.router.add_delete("/register/c1", deregister)
+    app.router.add_post("/token", token)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def test_dcr_register_and_delete():
+    gateway = await make_client()
+    fake_as = await make_fake_as()
+    try:
+        issuer = f"http://{fake_as.server.host}:{fake_as.server.port}"
+        resp = await gateway.post("/oauth/dcr/register", json={
+            "gateway_id": "gw-1", "issuer": issuer,
+            "redirect_uri": "http://gw/cb", "scopes": ["mcp.read"]},
+            auth=AUTH)
+        assert resp.status == 201, await resp.text()
+        record = await resp.json()
+        assert record["client_id"].startswith("dcr-client-")
+        sent = fake_as.app["state"]["registrations"][0]
+        assert sent["redirect_uris"] == ["http://gw/cb"]
+        assert sent["scope"] == "mcp.read"
+
+        # idempotent: second call reuses the stored registration
+        resp = await gateway.post("/oauth/dcr/register", json={
+            "gateway_id": "gw-1", "issuer": issuer,
+            "redirect_uri": "http://gw/cb"}, auth=AUTH)
+        assert resp.status == 201
+        assert len(fake_as.app["state"]["registrations"]) == 1
+
+        resp = await gateway.get("/oauth/dcr/clients", auth=AUTH)
+        clients = await resp.json()
+        assert len(clients) == 1
+
+        # delete de-registers upstream (RFC 7592) with the access token
+        resp = await gateway.delete(f"/oauth/dcr/clients/{record['id']}",
+                                    auth=AUTH)
+        assert resp.status == 204
+        assert fake_as.app["state"]["deletions"] == ["Bearer reg-token"]
+        resp = await gateway.get("/oauth/dcr/clients", auth=AUTH)
+        assert await resp.json() == []
+    finally:
+        await gateway.close()
+        await fake_as.close()
+
+
+async def test_token_exchange():
+    gateway = await make_client()
+    fake_as = await make_fake_as()
+    try:
+        issuer = f"http://{fake_as.server.host}:{fake_as.server.port}"
+        resp = await gateway.post("/oauth/exchange", json={
+            "token_url": f"{issuer}/token", "subject_token": "inbound-jwt",
+            "audience": "upstream-api"}, auth=AUTH)
+        assert resp.status == 200, await resp.text()
+        payload = await resp.json()
+        assert payload["access_token"] == "exchanged-for-upstream-api"
+    finally:
+        await gateway.close()
+        await fake_as.close()
+
+
+async def test_otlp_span_export():
+    # collector first, so the gateway can be configured with its endpoint
+    collector = web.Application()
+    received: list = []
+
+    async def traces(request):
+        received.append(await request.json())
+        return web.json_response({})
+
+    collector.router.add_post("/v1/traces", traces)
+    collector_client = TestClient(TestServer(collector))
+    await collector_client.start_server()
+    endpoint = (f"http://{collector_client.server.host}:"
+                f"{collector_client.server.port}")
+    gateway = await make_client(otel_exporter="memory",
+                                otel_otlp_endpoint=endpoint)
+    try:
+        resp = await gateway.get("/tools", auth=AUTH)
+        assert resp.status == 200
+        await gateway.app["otlp_exporter"].flush()
+        assert received, "no OTLP batches arrived"
+        spans = received[0]["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert any(s["name"].startswith("http") or "rpc" in s["name"]
+                   or s["name"] for s in spans)
+        span = spans[0]
+        assert len(span["traceId"]) == 32 and len(span["spanId"]) == 16
+        assert int(span["endTimeUnixNano"]) >= int(span["startTimeUnixNano"])
+        resource = received[0]["resourceSpans"][0]["resource"]["attributes"]
+        assert {"key": "service.name",
+                "value": {"stringValue": "mcpforge"}} in resource
+    finally:
+        await gateway.close()
+        await collector_client.close()

@@ -1,0 +1,132 @@
+"""MCP Apps (ui:// AppBridge): session create + session-scoped tools/call
+(reference main.py:10508/:10576, MCPAppSession db.py:4012)."""
+
+import aiohttp
+
+from test_gateway_app import BASIC, make_client, make_echo_rest_server
+
+AUTH = aiohttp.BasicAuth(*BASIC)
+
+
+async def _setup(gateway, rest):
+    """ui:// resource + tool + virtual server containing both; returns
+    (server_id, mcp_session_id)."""
+    url = f"http://{rest.server.host}:{rest.server.port}/echo"
+    resp = await gateway.post("/tools", json={
+        "name": "app-tool", "integration_type": "REST", "url": url}, auth=AUTH)
+    assert resp.status == 201
+    tool_id = (await resp.json())["id"]
+    resp = await gateway.post("/resources", json={
+        "uri": "ui://widget/main", "name": "widget",
+        "content": "<html>widget</html>", "mime_type": "text/html"}, auth=AUTH)
+    assert resp.status == 201, await resp.text()
+    resource_id = (await resp.json())["id"]
+    resp = await gateway.post("/servers", json={
+        "name": "app-server", "associated_tools": [tool_id],
+        "associated_resources": [resource_id]}, auth=AUTH)
+    assert resp.status == 201, await resp.text()
+    server_id = (await resp.json())["id"]
+    # a live MCP session to bind the app session to
+    resp = await gateway.post("/mcp", json={
+        "jsonrpc": "2.0", "id": 1, "method": "initialize",
+        "params": {"protocolVersion": "2025-06-18", "capabilities": {},
+                   "clientInfo": {"name": "t", "version": "0"}}}, auth=AUTH)
+    assert resp.status == 200
+    return server_id, resp.headers["mcp-session-id"]
+
+
+async def test_appbridge_session_lifecycle():
+    gateway = await make_client(streamable_http_stateful="true")
+    rest = await make_echo_rest_server()
+    try:
+        server_id, mcp_session = await _setup(gateway, rest)
+
+        # non-ui:// scheme rejected
+        resp = await gateway.post("/appbridge/sessions", json={
+            "resourceUri": "http://evil/", "serverId": server_id,
+            "mcpSessionId": mcp_session}, auth=AUTH)
+        assert resp.status == 422, await resp.text()
+
+        # unknown MCP session rejected
+        resp = await gateway.post("/appbridge/sessions", json={
+            "resourceUri": "ui://widget/main", "serverId": server_id,
+            "mcpSessionId": "bogus"}, auth=AUTH)
+        assert resp.status == 404
+
+        # valid create
+        resp = await gateway.post("/appbridge/sessions", json={
+            "resourceUri": "ui://widget/main", "serverId": server_id,
+            "mcpSessionId": mcp_session}, auth=AUTH)
+        assert resp.status == 201, await resp.text()
+        app_session = await resp.json()
+        assert app_session["serverId"] == server_id
+
+        sid = app_session["appSessionId"]
+        # session-scoped tools/call succeeds for an in-scope tool
+        resp = await gateway.post(f"/appbridge/sessions/{sid}/rpc", json={
+            "jsonrpc": "2.0", "id": 2, "method": "tools/call",
+            "mcpSessionId": mcp_session,
+            "params": {"name": "app-tool", "arguments": {"q": "hi"}}}, auth=AUTH)
+        payload = await resp.json()
+        assert "result" in payload, payload
+
+        # only tools/call is allowed through the bridge
+        resp = await gateway.post(f"/appbridge/sessions/{sid}/rpc", json={
+            "jsonrpc": "2.0", "id": 3, "method": "tools/list",
+            "mcpSessionId": mcp_session}, auth=AUTH)
+        assert (await resp.json())["error"]["code"] == -32601
+
+        # wrong MCP session id -> access denied
+        resp = await gateway.post(f"/appbridge/sessions/{sid}/rpc", json={
+            "jsonrpc": "2.0", "id": 4, "method": "tools/call",
+            "mcpSessionId": "stolen",
+            "params": {"name": "app-tool", "arguments": {}}}, auth=AUTH)
+        assert (await resp.json())["error"]["code"] == -32003
+    finally:
+        await gateway.close()
+        await rest.close()
+
+
+async def test_appbridge_out_of_scope_tool_denied():
+    gateway = await make_client(streamable_http_stateful="true")
+    rest = await make_echo_rest_server()
+    try:
+        server_id, mcp_session = await _setup(gateway, rest)
+        # another tool NOT associated with the server
+        url = f"http://{rest.server.host}:{rest.server.port}/echo"
+        resp = await gateway.post("/tools", json={
+            "name": "outside-tool", "integration_type": "REST", "url": url},
+            auth=AUTH)
+        assert resp.status == 201
+        resp = await gateway.post("/appbridge/sessions", json={
+            "resourceUri": "ui://widget/main", "serverId": server_id,
+            "mcpSessionId": mcp_session}, auth=AUTH)
+        sid = (await resp.json())["appSessionId"]
+        resp = await gateway.post(f"/appbridge/sessions/{sid}/rpc", json={
+            "jsonrpc": "2.0", "id": 5, "method": "tools/call",
+            "mcpSessionId": mcp_session,
+            "params": {"name": "outside-tool", "arguments": {}}}, auth=AUTH)
+        payload = await resp.json()
+        assert "error" in payload and "scope" in payload["error"]["message"]
+    finally:
+        await gateway.close()
+        await rest.close()
+
+
+async def test_appbridge_unassociated_resource_denied():
+    """A ui:// resource not associated with the server cannot be bridged."""
+    gateway = await make_client(streamable_http_stateful="true")
+    rest = await make_echo_rest_server()
+    try:
+        server_id, mcp_session = await _setup(gateway, rest)
+        resp = await gateway.post("/resources", json={
+            "uri": "ui://other/app", "name": "other",
+            "content": "<html>x</html>", "mime_type": "text/html"}, auth=AUTH)
+        assert resp.status == 201
+        resp = await gateway.post("/appbridge/sessions", json={
+            "resourceUri": "ui://other/app", "serverId": server_id,
+            "mcpSessionId": mcp_session}, auth=AUTH)
+        assert resp.status == 404, await resp.text()
+    finally:
+        await gateway.close()
+        await rest.close()
