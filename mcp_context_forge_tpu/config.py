@@ -121,6 +121,8 @@ class Settings(BaseModel):
     tpu_local_prefill_buckets: tuple[int, ...] = (128, 512, 2048)
     tpu_local_prefill_max_batch: int = 4  # admissions fused into one prefill
     tpu_local_mesh_shape: str = ""  # 'DxM' (e.g. 1x8 on v5e-8); '' = auto (1 x all devices)
+    tpu_local_sp_impl: Literal["none", "ring", "ulysses"] = "none"
+    tpu_local_sp_threshold: int = 1024  # prefill BUCKETS > this use SP prefill
     tpu_local_dtype: str = "bfloat16"
     tpu_local_embedding_model: str = "encoder-tiny"
 

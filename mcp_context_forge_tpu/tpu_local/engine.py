@@ -61,6 +61,10 @@ class EngineConfig:
     dtype: str = "bfloat16"
     max_queue: int = 1024
     attn_impl: str = "auto"
+    # sequence-parallel long prefill: prompts > sp_threshold tokens route
+    # through ring/ulysses attention over the mesh (SURVEY.md §5.7)
+    sp_impl: str = "none"      # none|ring|ulysses
+    sp_threshold: int = 1024
 
     @classmethod
     def from_settings(cls, settings) -> "EngineConfig":
@@ -75,6 +79,8 @@ class EngineConfig:
             prefill_max_batch=getattr(settings, "tpu_local_prefill_max_batch", 4),
             mesh_shape=settings.tpu_local_mesh_shape,
             dtype=settings.tpu_local_dtype,
+            sp_impl=getattr(settings, "tpu_local_sp_impl", "none"),
+            sp_threshold=getattr(settings, "tpu_local_sp_threshold", 1024),
         )
 
 
@@ -131,6 +137,17 @@ class TPUEngine:
         dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
         self.mesh = make_mesh(config.mesh_shape)
         logger.info("tpu_local: mesh %s, model %s", self.mesh.shape, config.model)
+        if config.sp_impl != "none":
+            # SP shard_map requires the sequence (bucket) to divide the axis;
+            # reject at construction instead of killing the dispatch thread
+            # on the first long prefill
+            axis = self.mesh.shape.get("model", 1)
+            bad = [b for b in config.prefill_buckets
+                   if b > config.sp_threshold and b % axis != 0]
+            if bad:
+                raise ValueError(
+                    f"sp_impl={config.sp_impl!r}: prefill buckets {bad} not"
+                    f" divisible by mesh model axis {axis}")
 
         # params: load checkpoint or random-init, placed with TP shardings
         with self.mesh:
@@ -164,16 +181,24 @@ class TPUEngine:
         # compiled steps
         self._prefill_sample = jax.jit(self._prefill_and_sample,
                                        donate_argnames=("kv",))
+        self._prefill_sample_sp = (
+            jax.jit(partial(self._prefill_and_sample, sp=True),
+                    donate_argnames=("kv",))
+            if config.sp_impl != "none" else None)
         self._decode = jax.jit(self._decode_and_sample, donate_argnames=("kv",))
 
     # ------------------------------------------------------------- device fns
 
     def _prefill_and_sample(self, params, kv, tokens, positions, slot_ids,
-                            last_idx, sampling: SamplingParams, key):
+                            last_idx, sampling: SamplingParams, key,
+                            sp: bool = False):
         """Batched prefill + on-device first-token sampling (same sampler and
-        PRNG stream as decode — round-1 VERDICT weak #5)."""
+        PRNG stream as decode — round-1 VERDICT weak #5). ``sp=True`` runs
+        the sequence-parallel attention path for long prompts."""
+        impl = self.config.sp_impl if sp else self.config.attn_impl
         logits, kv = prefill(params, self.model_config, tokens, positions, kv,
-                             slot_ids, attn_impl=self.config.attn_impl)
+                             slot_ids, attn_impl=impl,
+                             mesh=self.mesh if sp else None)
         B = tokens.shape[0]
         last = logits[jnp.arange(B), last_idx]          # [B, V]
         first = sample_tokens(last, sampling, key)
@@ -379,7 +404,12 @@ class TPUEngine:
         sampling = SamplingParams(jnp.asarray(temperature), jnp.asarray(top_k),
                                   jnp.asarray(top_p))
         self._rng, key = jax.random.split(self._rng)
-        first, self.kv = self._prefill_sample(
+        # long buckets route through the sequence-parallel attention path
+        # (shape-deterministic: SP-ness is a property of the bucket)
+        use_sp = (self._prefill_sample_sp is not None
+                  and bucket > self.config.sp_threshold)
+        prefill_fn = self._prefill_sample_sp if use_sp else self._prefill_sample
+        first, self.kv = prefill_fn(
             self.params, self.kv, jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(slot_ids), jnp.asarray(last_idx), sampling, key)
         first_host = jax.device_get(first)  # dispatch thread: sync is fine here

@@ -131,10 +131,12 @@ def _ffn(layer: dict[str, Any], x: jax.Array) -> jax.Array:
 
 def prefill(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
             positions: jax.Array, kv: PagedKVState, slot_ids: jax.Array,
-            attn_impl: str = "auto") -> tuple[jax.Array, PagedKVState]:
+            attn_impl: str = "auto", mesh=None) -> tuple[jax.Array, PagedKVState]:
     """Full-sequence forward writing KV into the paged cache.
 
     tokens/positions: [B, S]; slot_ids: [B] row into the block table.
+    ``attn_impl`` may select the sequence-parallel paths (ring/ulysses)
+    for long-context prefill — requires ``mesh`` (SURVEY.md §5.7).
     Returns (logits [B, S, vocab] fp32, updated kv state).
     """
     x = params["embed"][tokens]  # [B,S,D]
@@ -144,7 +146,8 @@ def prefill(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
         h = rms_norm(x, layer["attn_norm"], config.norm_eps)
         q, k, v = _attention_block(layer, config, h, safe_positions)
         kv = write_prefill_kv(kv, idx, k, v, slot_ids, safe_positions, mask_valid)
-        attn = causal_attention(q, k, v, mask_valid, impl=attn_impl)  # [B,S,H,hd]
+        attn = causal_attention(q, k, v, mask_valid, impl=attn_impl,
+                                mesh=mesh)  # [B,S,H,hd]
         x = x + attn.reshape(*attn.shape[:2], -1) @ layer["wo"]
         h = rms_norm(x, layer["ffn_norm"], config.norm_eps)
         x = x + _ffn(layer, h)

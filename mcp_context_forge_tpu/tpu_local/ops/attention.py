@@ -128,11 +128,34 @@ def _on_tpu() -> bool:
 
 def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                      valid: jax.Array | None = None,
-                     impl: str = "auto") -> jax.Array:
-    """Dispatch: impl in {auto, pallas, reference}."""
+                     impl: str = "auto", mesh=None) -> jax.Array:
+    """Dispatch: impl in {auto, pallas, reference, ring, ulysses}.
+
+    ring/ulysses are the sequence-parallel paths (SURVEY.md §5.7): the
+    sequence dim is sharded over the mesh's ``model`` axis via shard_map —
+    ring rotates K/V blocks over ICI with online-softmax merging; Ulysses
+    reshards seq→heads with one all_to_all each way. Requires ``mesh`` and
+    S divisible by the axis size.
+    """
     B, S, H, hd = q.shape
     if valid is None:
         valid = jnp.ones((B, S), dtype=bool)
+    if impl in ("ring", "ulysses"):
+        if mesh is None:
+            raise ValueError(f"attn impl {impl!r} requires a mesh")
+        from ..parallel.ring_attention import (make_ring_attention,
+                                               make_ulysses_attention)
+        # GQA k/v stay at KV width: the SP bodies expand per device, so the
+        # wire (ppermute/all_to_all) never carries the repeated heads
+        axis_size = mesh.shape.get("model", 1)
+        if impl == "ulysses" and (H % axis_size != 0
+                                  or k.shape[2] % axis_size != 0):
+            # Ulysses reshards heads across the axis, so both q and kv head
+            # counts must divide it; ring has no such constraint — fall
+            # back (same numerics)
+            impl = "ring"
+        maker = make_ring_attention if impl == "ring" else make_ulysses_attention
+        return maker(mesh, axis_name="model")(q, k, v, valid)
     use_pallas = impl == "pallas" or (impl == "auto" and _on_tpu()
                                       and S % 128 == 0 and hd % 128 == 0)
     if use_pallas:

@@ -30,11 +30,15 @@ def _inputs(B=2, S=64, H=8, hd=16, seed=0):
     return q, k, v
 
 
+def _all_valid(q):
+    return jnp.ones(q.shape[:2], dtype=bool)
+
+
 def test_ring_attention_matches_reference(mesh):
     q, k, v = _inputs()
     ref = attention_reference(q, k, v)  # causal, GQA with KV==H
     ring = make_ring_attention(mesh, axis_name="seq", causal=True)
-    out = ring(q, k, v)
+    out = ring(q, k, v, _all_valid(q))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
@@ -42,7 +46,7 @@ def test_ring_attention_matches_reference(mesh):
 def test_ring_attention_non_causal(mesh):
     q, k, v = _inputs(seed=1)
     ring = make_ring_attention(mesh, axis_name="seq", causal=False)
-    out = ring(q, k, v)
+    out = ring(q, k, v, _all_valid(q))
     # non-causal reference
     import math
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(q.shape[-1])
@@ -55,7 +59,48 @@ def test_ring_attention_non_causal(mesh):
 def test_ulysses_attention_matches_reference(mesh):
     q, k, v = _inputs(seed=2)
     ulysses = make_ulysses_attention(mesh, axis_name="seq", causal=True)
-    out = ulysses(q, k, v)
+    out = ulysses(q, k, v, _all_valid(q))
     ref = attention_reference(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_respects_padding_mask(mesh):
+    """Padded (invalid) k positions must contribute nothing — the serving
+    prefill path passes bucket padding masks through the SP impls."""
+    q, k, v = _inputs(seed=3)
+    S = q.shape[1]
+    n_valid = 40
+    valid = jnp.arange(S)[None, :] < n_valid
+    valid = jnp.broadcast_to(valid, q.shape[:2])
+    ring = make_ring_attention(mesh, axis_name="seq", causal=True)
+    out = ring(q, k, v, valid)
+    ref = attention_reference(q, k, v, valid)
+    np.testing.assert_allclose(np.asarray(out[:, :n_valid]),
+                               np.asarray(ref[:, :n_valid]),
+                               rtol=2e-5, atol=2e-5)
+    ulysses = make_ulysses_attention(mesh, axis_name="seq", causal=True)
+    out_u = ulysses(q, k, v, valid)
+    np.testing.assert_allclose(np.asarray(out_u[:, :n_valid]),
+                               np.asarray(ref[:, :n_valid]),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_and_ulysses_gqa(mesh):
+    """GQA (KV < H): k/v stay KV-width on the wire, expanded per device."""
+    B, S, H, KV, hd = 2, 64, 8, 2, 16
+    keys = jax.random.split(jax.random.PRNGKey(9), 3)
+    q = jax.random.normal(keys[0], (B, S, H, hd), dtype=jnp.float32)
+    k = jax.random.normal(keys[1], (B, S, KV, hd), dtype=jnp.float32)
+    v = jax.random.normal(keys[2], (B, S, KV, hd), dtype=jnp.float32)
+    ref = attention_reference(q, k, v)
+    ring = make_ring_attention(mesh, axis_name="seq", causal=True)
+    np.testing.assert_allclose(np.asarray(ring(q, k, v, _all_valid(q))),
+                               np.asarray(ref), rtol=2e-5, atol=2e-5)
+    ulysses = make_ulysses_attention(mesh, axis_name="seq", causal=True)
+    # KV=2 not divisible by 8 -> only valid via the dispatcher fallback;
+    # call with expanded kv to exercise the ulysses body itself
+    k8 = jnp.repeat(k, H // KV, axis=2)
+    v8 = jnp.repeat(v, H // KV, axis=2)
+    np.testing.assert_allclose(np.asarray(ulysses(q, k8, v8, _all_valid(q))),
+                               np.asarray(ref), rtol=2e-5, atol=2e-5)
