@@ -10,7 +10,13 @@ hub:
 
 # the reference's test-primary-worker-e2e analog: 2 real OS processes + hub
 test-two-process:
-	python -m pytest tests/integration/test_two_process.py -q
+	python -m pytest tests/integration/test_two_process.py tests/integration/test_supervisor.py -q
+
+supervise:
+	python -m mcp_context_forge_tpu.cli supervise --workers 2
+
+compose-config:
+	python -c "import yaml; yaml.safe_load(open('docker-compose.yml')); print('ok')"
 
 test:
 	python -m pytest tests/ -q

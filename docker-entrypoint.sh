@@ -1,0 +1,35 @@
+#!/bin/sh
+# Entry point (reference: docker-entrypoint.sh): wait for deps, then exec.
+set -e
+
+if [ -n "$MCPFORGE_WAIT_FOR" ]; then
+  # MCPFORGE_WAIT_FOR="host:port host:port" — wait for each before boot
+  for target in $MCPFORGE_WAIT_FOR; do
+    host=${target%%:*}; port=${target##*:}
+    echo "waiting for $host:$port ..."
+    python - "$host" "$port" <<'PY'
+import socket, sys, time
+host, port = sys.argv[1], int(sys.argv[2])
+for _ in range(120):
+    try:
+        socket.create_connection((host, port), timeout=2).close()
+        sys.exit(0)
+    except OSError:
+        time.sleep(1)
+sys.exit(f"timeout waiting for {host}:{port}")
+PY
+  done
+fi
+
+case "$1" in
+  serve|supervise|hub|token|version)
+    cmd="$1"; shift
+    if [ "$cmd" = "hub" ]; then
+      exec python -m mcp_context_forge_tpu.coordination.hub "$@"
+    fi
+    exec python -m mcp_context_forge_tpu.cli "$cmd" "$@"
+    ;;
+  *)
+    exec "$@"
+    ;;
+esac

@@ -18,6 +18,18 @@ def main(argv: list[str] | None = None) -> int:
     serve.add_argument("--host", default=None)
     serve.add_argument("--port", type=int, default=None)
 
+    supervise = sub.add_parser(
+        "supervise", help="run N worker processes + coordination hub "
+                          "(reference: gunicorn multi-worker)")
+    supervise.add_argument("--workers", type=int, default=2)
+    supervise.add_argument("--host", default=None)
+    supervise.add_argument("--port", type=int, default=None,
+                           help="base port; worker i listens on port+i")
+    supervise.add_argument("--hub-port", type=int, default=None,
+                           help="coordination hub port (default: base port-1)")
+    supervise.add_argument("--no-hub", action="store_true",
+                           help="workers use an external bus (no embedded hub)")
+
     token = sub.add_parser("token", help="mint a JWT for an email")
     token.add_argument("email")
     token.add_argument("--expires-minutes", type=int, default=60)
@@ -51,6 +63,16 @@ def main(argv: list[str] | None = None) -> int:
             settings = settings.model_copy(update={"port": args.port})
         from .gateway.app import run
         run(settings)
+        return 0
+
+    if command == "supervise":
+        from .supervisor import Supervisor
+        base_port = args.port or settings.port
+        supervisor = Supervisor(
+            workers=args.workers, host=args.host or settings.host,
+            base_port=base_port,
+            hub_port=None if args.no_hub else (args.hub_port or base_port - 1))
+        supervisor.run_forever()
         return 0
 
     parser.print_help()

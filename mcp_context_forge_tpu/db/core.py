@@ -150,13 +150,20 @@ class Database:
                 " version INTEGER PRIMARY KEY, name TEXT NOT NULL,"
                 " applied_at REAL NOT NULL)"
             )
-            done = {r[0] for r in self._conn.execute("SELECT version FROM schema_migrations")}
             applied = 0
             for mig in sorted(migrations, key=lambda m: m.version):
-                if mig.version in done:
-                    continue
                 try:
-                    self._conn.execute("BEGIN")
+                    # BEGIN IMMEDIATE takes the write lock up front so two
+                    # processes booting against the same file (multi-worker
+                    # supervisor) serialize; the in-transaction re-check
+                    # makes the loser skip instead of double-applying
+                    self._conn.execute("BEGIN IMMEDIATE")
+                    row = self._conn.execute(
+                        "SELECT 1 FROM schema_migrations WHERE version=?",
+                        (mig.version,)).fetchone()
+                    if row is not None:
+                        self._conn.rollback()
+                        continue
                     for stmt in self._split_statements(mig.sql):
                         self._conn.execute(stmt)
                     self._conn.execute(

@@ -85,26 +85,28 @@ class AuthService:
     async def bootstrap_admin(self) -> None:
         """Create the platform admin on first boot (reference bootstrap_db seed)."""
         settings = self.ctx.settings
-        row = await self.ctx.db.fetchone("SELECT email FROM users WHERE email=?",
-                                         (settings.platform_admin_email,))
-        if row:
-            return
         ts = now()
+        # every statement INSERT OR IGNOREs and the member row resolves the
+        # team id by slug: idempotent AND self-healing — concurrent worker
+        # boots are no-ops, and a crash mid-seed is repaired on next boot
+        # (no existence early-exit that would freeze a partial seed)
         await self.ctx.db.execute(
-            "INSERT INTO users (email, password_hash, full_name, is_admin, created_at,"
-            " updated_at) VALUES (?,?,?,?,?,?)",
+            "INSERT OR IGNORE INTO users (email, password_hash, full_name,"
+            " is_admin, created_at, updated_at) VALUES (?,?,?,?,?,?)",
             (settings.platform_admin_email, _hasher.hash(settings.platform_admin_password),
              "Platform Admin", 1, ts, ts))
-        # personal team
-        team_id = new_id()
+        slug = slugify(settings.platform_admin_email)
         await self.ctx.db.execute(
-            "INSERT INTO teams (id, name, slug, is_personal, created_by, created_at,"
-            " updated_at) VALUES (?,?,?,?,?,?,?)",
-            (team_id, "Personal", slugify(settings.platform_admin_email), 1,
-             settings.platform_admin_email, ts, ts))
-        await self.ctx.db.execute(
-            "INSERT INTO team_members (team_id, user_email, role, joined_at)"
-            " VALUES (?,?,?,?)", (team_id, settings.platform_admin_email, "owner", ts))
+            "INSERT OR IGNORE INTO teams (id, name, slug, is_personal, created_by,"
+            " created_at, updated_at) VALUES (?,?,?,?,?,?,?)",
+            (new_id(), "Personal", slug, 1, settings.platform_admin_email, ts, ts))
+        team = await self.ctx.db.fetchone("SELECT id FROM teams WHERE slug=?",
+                                          (slug,))
+        if team:
+            await self.ctx.db.execute(
+                "INSERT OR IGNORE INTO team_members (team_id, user_email, role,"
+                " joined_at) VALUES (?,?,?,?)",
+                (team["id"], settings.platform_admin_email, "owner", ts))
 
     # ----------------------------------------------------------------- users
 

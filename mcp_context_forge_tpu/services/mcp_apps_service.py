@@ -71,7 +71,6 @@ class MCPAppsService:
             return None
         return row
 
-    async def sweep(self) -> int:
-        cursor = await self.ctx.db.execute(
+    async def sweep(self) -> None:
+        await self.ctx.db.execute(
             "DELETE FROM mcp_app_sessions WHERE expires_at<=?", (now(),))
-        return getattr(cursor, "rowcount", 0)

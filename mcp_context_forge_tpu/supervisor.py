@@ -1,0 +1,146 @@
+"""Multi-worker process supervisor (reference: gunicorn.config.py +
+run-gunicorn.sh — N workers per pod, restart on crash).
+
+Spawns N gateway worker processes on consecutive ports (a front LB — nginx
+/ k8s Service — spreads traffic), plus an embedded coordination hub the
+workers share for affinity/leader/bus. Crashed workers are restarted with
+exponential backoff; SIGTERM/SIGINT stop everything.
+
+Run: ``python -m mcp_context_forge_tpu.cli supervise --workers 2``
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+
+class Supervisor:
+    def __init__(self, workers: int, host: str, base_port: int,
+                 hub_port: int | None = None, env: dict | None = None,
+                 max_backoff: float = 30.0):
+        self.workers = workers
+        self.host = host
+        self.base_port = base_port
+        self.hub_port = hub_port
+        self.env = env or {}
+        self.max_backoff = max_backoff
+        self._procs: dict[int, subprocess.Popen] = {}   # worker idx -> proc
+        self._backoff: dict[int, float] = {}
+        self._restart_at: dict[int, float] = {}  # idx -> earliest respawn time
+        self._healthy_passes: dict[int, int] = {}
+        self._hub_proc: subprocess.Popen | None = None
+        self._stopping = threading.Event()
+
+    # ------------------------------------------------------------- spawning
+
+    def _worker_env(self, idx: int) -> dict:
+        env = {**os.environ, **self.env}
+        if self.hub_port is not None:
+            # the supervisor owns the hub: workers MUST ride it (an inherited
+            # memory/file backend would silently split the coordination plane)
+            env["MCPFORGE_BUS_BACKEND"] = "tcp"
+            env["MCPFORGE_BUS_TCP_HOST"] = "127.0.0.1"
+            env["MCPFORGE_BUS_TCP_PORT"] = str(self.hub_port)
+        env["MCPFORGE_WORKER_INDEX"] = str(idx)
+        return env
+
+    def _spawn_worker(self, idx: int) -> subprocess.Popen:
+        port = self.base_port + idx
+        logger.info("supervisor: starting worker %d on %s:%d", idx, self.host,
+                    port)
+        return subprocess.Popen(
+            [sys.executable, "-m", "mcp_context_forge_tpu.cli", "serve",
+             "--host", self.host, "--port", str(port)],
+            env=self._worker_env(idx))
+
+    def _spawn_hub(self) -> subprocess.Popen:
+        logger.info("supervisor: starting coordination hub on :%d",
+                    self.hub_port)
+        env = {**os.environ, **self.env}
+        secret = env.get("MCPFORGE_BUS_TCP_SECRET") or env.get(
+            "MCPFORGE_JWT_SECRET_KEY", "")
+        return subprocess.Popen(
+            [sys.executable, "-m", "mcp_context_forge_tpu.coordination.hub",
+             "--host", "127.0.0.1", "--port", str(self.hub_port),
+             "--secret", secret],
+            env=env)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        if self.hub_port is not None:
+            self._hub_proc = self._spawn_hub()
+            time.sleep(0.3)
+        for idx in range(self.workers):
+            self._procs[idx] = self._spawn_worker(idx)
+            self._backoff[idx] = 0.5
+
+    def stop(self) -> None:
+        self._stopping.set()
+        for proc in list(self._procs.values()) + (
+                [self._hub_proc] if self._hub_proc else []):
+            if proc.poll() is None:
+                proc.terminate()
+        deadline = time.time() + 10
+        for proc in list(self._procs.values()) + (
+                [self._hub_proc] if self._hub_proc else []):
+            remaining = max(0.1, deadline - time.time())
+            try:
+                proc.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    # a worker must survive this many reap passes before its backoff resets
+    # (a single healthy poll between crashes must not defeat the escalation)
+    HEALTHY_RESET_PASSES = 10
+
+    def reap_once(self) -> None:
+        """One supervision pass: restart dead workers whose backoff deadline
+        has arrived. Never sleeps — one crash-looping worker must not stall
+        supervision of the others or of the hub."""
+        now = time.monotonic()
+        for idx, proc in list(self._procs.items()):
+            code = proc.poll()
+            if code is None:
+                self._healthy_passes[idx] = self._healthy_passes.get(idx, 0) + 1
+                if self._healthy_passes[idx] >= self.HEALTHY_RESET_PASSES:
+                    self._backoff[idx] = 0.5
+                continue
+            if self._stopping.is_set():
+                continue
+            self._healthy_passes[idx] = 0
+            deadline = self._restart_at.get(idx)
+            if deadline is None:
+                delay = self._backoff.get(idx, 0.5)
+                self._restart_at[idx] = now + delay
+                self._backoff[idx] = min(delay * 2, self.max_backoff)
+                logger.warning("supervisor: worker %d exited rc=%s; restart"
+                               " in %.1fs", idx, code, delay)
+            elif now >= deadline:
+                del self._restart_at[idx]
+                self._procs[idx] = self._spawn_worker(idx)
+        if (self._hub_proc is not None and self._hub_proc.poll() is not None
+                and not self._stopping.is_set()):
+            logger.warning("supervisor: hub exited rc=%s; restarting",
+                           self._hub_proc.returncode)
+            self._hub_proc = self._spawn_hub()
+
+    def run_forever(self) -> None:  # pragma: no cover - signal-driven loop
+        def _on_signal(signum, frame):
+            self.stop()
+            raise SystemExit(0)
+
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+        self.start()
+        while not self._stopping.is_set():
+            self.reap_once()
+            time.sleep(1.0)

@@ -1,0 +1,96 @@
+"""Process supervisor: N workers + hub, crash restart (reference:
+gunicorn multi-worker + run-gunicorn.sh restart semantics)."""
+
+import asyncio
+import os
+import signal
+import socket
+import time
+
+import aiohttp
+import pytest
+
+from mcp_context_forge_tpu.supervisor import Supervisor
+
+
+def _free_port_block(n: int) -> int:
+    """Find a base port with n+1 consecutive free ports (hub on base-1)."""
+    for _ in range(50):
+        with socket.socket() as sock:
+            sock.bind(("127.0.0.1", 0))
+            base = sock.getsockname()[1]
+        if base < 2000 or base > 60000:
+            continue
+        try:
+            for offset in range(-1, n):
+                probe = socket.socket()
+                probe.bind(("127.0.0.1", base + offset))
+                probe.close()
+            return base
+        except OSError:
+            continue
+    pytest.skip("no consecutive free port block")
+
+
+async def _wait_healthy(port: int, timeout: float = 40.0) -> None:
+    deadline = time.monotonic() + timeout
+    async with aiohttp.ClientSession() as session:
+        while time.monotonic() < deadline:
+            try:
+                resp = await session.get(f"http://127.0.0.1:{port}/health")
+                if resp.status == 200:
+                    return
+            except aiohttp.ClientError:
+                pass
+            await asyncio.sleep(0.25)
+    raise TimeoutError(f"worker on :{port} not healthy")
+
+
+async def test_supervisor_spawns_and_restarts(tmp_path):
+    base = _free_port_block(2)
+    supervisor = Supervisor(
+        workers=2, host="127.0.0.1", base_port=base, hub_port=base - 1,
+        env={
+            "JAX_PLATFORMS": "cpu",
+            "MCPFORGE_DATABASE_URL": f"sqlite:///{tmp_path}/sup.db",
+            "MCPFORGE_PLUGINS_ENABLED": "false",
+            "MCPFORGE_TPU_LOCAL_ENABLED": "false",
+            "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
+            "MCPFORGE_JWT_SECRET_KEY": "supervisor-test-jwt-0123456789abcd",
+            "MCPFORGE_AUTH_ENCRYPTION_SECRET": "supervisor-test-enc-0123456789",
+            "MCPFORGE_DEV_MODE": "true",
+            "MCPFORGE_ENVIRONMENT": "development",
+            "MCPFORGE_LOG_LEVEL": "WARNING",
+        })
+    supervisor.start()
+    try:
+        await _wait_healthy(base)
+        await _wait_healthy(base + 1)
+
+        # workers share the hub: exactly one leader across the pair
+        async with aiohttp.ClientSession() as session:
+            deadline = time.monotonic() + 15
+            leaders = {}
+            while time.monotonic() < deadline:
+                leaders = {}
+                for port in (base, base + 1):
+                    resp = await session.get(f"http://127.0.0.1:{port}/ready")
+                    leaders[port] = (await resp.json()).get("leader", False)
+                if sum(leaders.values()) == 1:
+                    break
+                await asyncio.sleep(0.3)
+            assert sum(leaders.values()) == 1, leaders
+
+        # kill worker 0: the supervisor revives it
+        victim = supervisor._procs[0]
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=10)
+        for _ in range(20):
+            supervisor.reap_once()
+            if supervisor._procs[0].poll() is None and \
+                    supervisor._procs[0].pid != victim.pid:
+                break
+            await asyncio.sleep(0.2)
+        await _wait_healthy(base)
+    finally:
+        supervisor.stop()
