@@ -66,6 +66,14 @@ class Settings(BaseModel):
     platform_admin_email: str = "admin@example.com"
     platform_admin_password: str = "changeme"
     auth_encryption_secret: str = "dev-only-do-not-use"
+    # password policy for local accounts (reference
+    # services/password_policy_service.py)
+    password_min_length: int = 12
+    password_require_uppercase: bool = True
+    password_require_lowercase: bool = True
+    password_require_digit: bool = True
+    password_require_special: bool = False
+    password_max_length: int = 256  # argon2 DoS guard
 
     # --- protocol / transports ---
     protocol_version: str = "2025-06-18"
@@ -140,9 +148,16 @@ class Settings(BaseModel):
     @field_validator("database_url")
     @classmethod
     def _check_db_url(cls, v: str) -> str:
-        if not (v.startswith("sqlite:///") or v.startswith("sqlite+aiosqlite:///")):
-            raise ValueError("only sqlite:/// database URLs are supported in-tree")
+        if not v.startswith(("sqlite:///", "sqlite+aiosqlite:///",
+                             "postgres://", "postgresql://")):
+            raise ValueError(
+                "database URL must be sqlite:/// or postgresql:// "
+                "(reference config.py:14 dual-DB support)")
         return v
+
+    @property
+    def is_postgres(self) -> bool:
+        return self.database_url.startswith(("postgres://", "postgresql://"))
 
     @property
     def database_path(self) -> str:

@@ -45,7 +45,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     app = web.Application(middlewares=MIDDLEWARES,
                           client_max_size=settings.max_request_size_bytes)
 
-    db = Database(settings.database_path)
+    from ..db.pg import make_database
+    db = make_database(settings.database_url, settings.db_pool_size)
     await db.connect()
     await db.migrate(MIGRATIONS)
 

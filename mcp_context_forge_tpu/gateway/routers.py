@@ -25,7 +25,7 @@ from ..schemas import (
     ToolUpdate,
 )
 from ..services.auth_service import AuthError
-from ..services.base import ValidationFailure
+from ..services.base import NotFoundError, ValidationFailure
 
 
 def _dump(model) -> Any:
@@ -113,6 +113,49 @@ def setup_routes(app: web.Application) -> None:
         auth.require("tokens.manage")
         await request.app["auth_service"].revoke_token(request.match_info["token_id"])
         return web.Response(status=204)
+
+    @routes.post("/auth/password")
+    async def change_password(request: web.Request) -> web.Response:
+        auth = request["auth"]
+        body = await request.json()
+        await request.app["auth_service"].change_password(
+            auth.user, body.get("old_password", ""),
+            body.get("new_password", ""))
+        return web.json_response({"status": "changed"})
+
+    # ----------------------------------------------------- admin user CRUD
+    @routes.post("/admin/users")
+    async def create_user(request: web.Request) -> web.Response:
+        auth = request["auth"]
+        auth.require("admin.all")
+        body = await request.json()
+        await request.app["auth_service"].create_user(
+            body.get("email", ""), body.get("password", ""),
+            full_name=body.get("full_name", ""),
+            is_admin=bool(body.get("is_admin")), enforce_policy=True)
+        return web.json_response({"email": body.get("email")}, status=201)
+
+    @routes.get("/admin/users")
+    async def list_users(request: web.Request) -> web.Response:
+        request["auth"].require("admin.all")
+        rows = await request.app["ctx"].db.fetchall(
+            "SELECT email, full_name, is_admin, is_active, auth_provider,"
+            " last_login, created_at FROM users ORDER BY email")
+        return web.json_response(rows)
+
+    @routes.post("/admin/users/{email}/toggle")
+    async def toggle_user(request: web.Request) -> web.Response:
+        request["auth"].require("admin.all")
+        email = request.match_info["email"]
+        from ..services.base import now
+        await request.app["ctx"].db.execute(
+            "UPDATE users SET is_active=1-is_active, updated_at=? WHERE email=?",
+            (now(), email))
+        row = await request.app["ctx"].db.fetchone(
+            "SELECT email, is_active FROM users WHERE email=?", (email,))
+        if row is None:
+            raise NotFoundError(f"User {email} not found")
+        return web.json_response(row)
 
     # ---------------------------------------------------------------- tools
     @routes.get("/tools")
@@ -344,20 +387,77 @@ def setup_routes(app: web.Application) -> None:
 
     @routes.get("/admin/traces")
     async def admin_traces(request: web.Request) -> web.Response:
+        """Span search: ?q= (name substring), ?status=ERROR, ?trace_id=,
+        ?min_ms= (duration floor), ?store=db|memory (reference
+        routers/observability + log_search)."""
         request["auth"].require("observability.read")
         tracer = request.app["ctx"].tracer
-        limit = int(request.query.get("limit", "100"))
+        limit = max(1, min(int(request.query.get("limit", "100")), 1000))
+        q = request.query.get("q", "")
+        status = request.query.get("status")
+        trace_id = request.query.get("trace_id")
+        min_ms = float(request.query.get("min_ms", "0") or 0)
         if request.query.get("store") == "db":
+            clauses, params = [], []
+            if q:
+                clauses.append("name LIKE ?")
+                params.append(f"%{q}%")
+            if status:
+                clauses.append("status=?")
+                params.append(status)
+            if trace_id:
+                clauses.append("trace_id=?")
+                params.append(trace_id)
+            if min_ms:
+                clauses.append("(end_ts - start_ts) * 1000 >= ?")
+                params.append(min_ms)
+            where = (" WHERE " + " AND ".join(clauses)) if clauses else ""
             rows = await request.app["ctx"].db.fetchall(
-                "SELECT * FROM observability_spans ORDER BY start_ts DESC LIMIT ?",
-                (min(limit, 1000),))
+                f"SELECT * FROM observability_spans{where}"
+                f" ORDER BY start_ts DESC LIMIT ?", [*params, limit])
             return web.json_response(rows)
-        spans = tracer.finished[-limit:]
+        spans = [s for s in tracer.finished
+                 if (not q or q in s.name)
+                 and (not status or s.status == status)
+                 and (not trace_id or s.trace_id == trace_id)
+                 and (s.duration_ms or 0) >= min_ms][-limit:]
         return web.json_response([{
             "name": s.name, "trace_id": s.trace_id, "span_id": s.span_id,
             "parent_span_id": s.parent_span_id, "start_ts": s.start_ts,
             "duration_ms": s.duration_ms, "status": s.status,
             "attributes": {k: str(v) for k, v in s.attributes.items()},
         } for s in reversed(spans)])
+
+    @routes.get("/admin/traces/{trace_id}")
+    async def admin_trace_tree(request: web.Request) -> web.Response:
+        """Full span tree for one trace (memory + db union, deduped)."""
+        request["auth"].require("observability.read")
+        trace_id = request.match_info["trace_id"]
+        tracer = request.app["ctx"].tracer
+        spans = {s.span_id: {
+            "name": s.name, "span_id": s.span_id,
+            "parent_span_id": s.parent_span_id, "start_ts": s.start_ts,
+            "duration_ms": s.duration_ms, "status": s.status,
+            "attributes": {k: str(v) for k, v in s.attributes.items()},
+        } for s in tracer.finished if s.trace_id == trace_id}
+        for row in await request.app["ctx"].db.fetchall(
+                "SELECT * FROM observability_spans WHERE trace_id=?",
+                (trace_id,)):
+            # normalize db rows to the memory-span response shape
+            try:
+                attrs = json.loads(row["attributes"] or "{}")
+            except (TypeError, json.JSONDecodeError):
+                attrs = {}
+            duration = (None if row["end_ts"] is None
+                        else (row["end_ts"] - row["start_ts"]) * 1000)
+            spans.setdefault(row["span_id"], {
+                "name": row["name"], "span_id": row["span_id"],
+                "parent_span_id": row["parent_span_id"],
+                "start_ts": row["start_ts"], "duration_ms": duration,
+                "status": row["status"], "attributes": attrs})
+        if not spans:
+            raise NotFoundError(f"Trace {trace_id} not found")
+        ordered = sorted(spans.values(), key=lambda s: s["start_ts"])
+        return web.json_response({"trace_id": trace_id, "spans": ordered})
 
     app.add_routes(routes)

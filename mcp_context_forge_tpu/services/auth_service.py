@@ -110,13 +110,74 @@ class AuthService:
 
     # ----------------------------------------------------------------- users
 
+    # common-password denylist fragment (reference password_policy_service)
+    _PASSWORD_DENYLIST = {
+        "password", "password1", "passw0rd", "qwerty", "letmein", "changeme",
+        "123456", "12345678", "123456789", "1234567890", "iloveyou", "admin",
+        "welcome", "monkey", "dragon", "abc123", "secret",
+    }
+
+    def validate_password_policy(self, password: str, email: str = "") -> None:
+        """Raise ValidationFailure when a password violates the configured
+        policy (length, character classes, denylist, not-derived-from-email)."""
+        from .base import ValidationFailure
+
+        settings = self.ctx.settings
+        problems: list[str] = []
+        if len(password) < settings.password_min_length:
+            problems.append(f"at least {settings.password_min_length} characters")
+        if len(password) > settings.password_max_length:
+            problems.append(f"at most {settings.password_max_length} characters")
+        if settings.password_require_uppercase and not any(
+                c.isupper() for c in password):
+            problems.append("an uppercase letter")
+        if settings.password_require_lowercase and not any(
+                c.islower() for c in password):
+            problems.append("a lowercase letter")
+        if settings.password_require_digit and not any(
+                c.isdigit() for c in password):
+            problems.append("a digit")
+        if settings.password_require_special and not any(
+                not c.isalnum() for c in password):
+            problems.append("a special character")
+        lowered = password.lower()
+        # digit/symbol padding does not rescue a denylisted word
+        # ("Password123456" -> "password")
+        base = "".join(c for c in lowered if c.isalpha())
+        if lowered in self._PASSWORD_DENYLIST or base in self._PASSWORD_DENYLIST:
+            problems.append("not a commonly-used password")
+        local_part = email.split("@")[0].lower() if email else ""
+        if local_part and len(local_part) >= 4 and local_part in lowered:
+            problems.append("not derived from the account email")
+        if problems:
+            raise ValidationFailure(
+                "Password must contain: " + "; ".join(problems))
+
     async def create_user(self, email: str, password: str, full_name: str = "",
-                          is_admin: bool = False) -> None:
+                          is_admin: bool = False,
+                          enforce_policy: bool = False) -> None:
+        from .base import ConflictError
+
+        if enforce_policy:
+            self.validate_password_policy(password, email)
+        existing = await self.ctx.db.fetchone(
+            "SELECT 1 FROM users WHERE email=?", (email,))
+        if existing:
+            raise ConflictError(f"User {email} already exists")
         ts = now()
         await self.ctx.db.execute(
             "INSERT INTO users (email, password_hash, full_name, is_admin, created_at,"
             " updated_at) VALUES (?,?,?,?,?,?)",
             (email, _hasher.hash(password), full_name, int(is_admin), ts, ts))
+
+    async def change_password(self, email: str, old_password: str,
+                              new_password: str) -> None:
+        if not await self.verify_password(email, old_password):
+            raise AuthError("Current password is incorrect")
+        self.validate_password_policy(new_password, email)
+        await self.ctx.db.execute(
+            "UPDATE users SET password_hash=?, updated_at=? WHERE email=?",
+            (_hasher.hash(new_password), now(), email))
 
     async def verify_password(self, email: str, password: str) -> bool:
         row = await self.ctx.db.fetchone("SELECT * FROM users WHERE email=? AND is_active=1",

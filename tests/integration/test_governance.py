@@ -1,0 +1,121 @@
+"""Governance depth: password policy, admin user CRUD, trace search
+(reference: services/password_policy_service.py, routers/log_search.py,
+routers/observability.py)."""
+
+import aiohttp
+
+from test_gateway_app import BASIC, make_client
+
+AUTH = aiohttp.BasicAuth(*BASIC)
+
+
+async def test_password_policy_enforced():
+    client = await make_client()
+    try:
+        # weak passwords rejected with actionable detail
+        for bad in ("short", "alllowercase1234", "ALLUPPERCASE1234",
+                    "NoDigitsHereSir", "Password123456"):
+            resp = await client.post("/admin/users", json={
+                "email": "u@example.com", "password": bad}, auth=AUTH)
+            assert resp.status == 422, (bad, await resp.text())
+        # derived-from-email rejected
+        resp = await client.post("/admin/users", json={
+            "email": "frederick@example.com",
+            "password": "Frederick1234"}, auth=AUTH)
+        assert resp.status == 422
+        # a conforming password passes
+        resp = await client.post("/admin/users", json={
+            "email": "u@example.com", "password": "Str0ng-enough-pw"},
+            auth=AUTH)
+        assert resp.status == 201, await resp.text()
+    finally:
+        await client.close()
+
+
+async def test_change_password_flow():
+    client = await make_client()
+    try:
+        resp = await client.post("/admin/users", json={
+            "email": "worker@example.com", "password": "Initial-Passw0rd"},
+            auth=AUTH)
+        assert resp.status == 201
+        resp = await client.post("/auth/login", json={
+            "email": "worker@example.com", "password": "Initial-Passw0rd"})
+        token = (await resp.json())["access_token"]
+        headers = {"authorization": f"Bearer {token}"}
+        # wrong old password -> 401
+        resp = await client.post("/auth/password", json={
+            "old_password": "nope", "new_password": "Next-Passw0rd-1"},
+            headers=headers)
+        assert resp.status == 401
+        # weak new password -> 422
+        resp = await client.post("/auth/password", json={
+            "old_password": "Initial-Passw0rd", "new_password": "weak"},
+            headers=headers)
+        assert resp.status == 422
+        # valid change; old stops working, new works
+        resp = await client.post("/auth/password", json={
+            "old_password": "Initial-Passw0rd",
+            "new_password": "Next-Passw0rd-1"}, headers=headers)
+        assert resp.status == 200, await resp.text()
+        resp = await client.post("/auth/login", json={
+            "email": "worker@example.com", "password": "Initial-Passw0rd"})
+        assert resp.status == 401
+        resp = await client.post("/auth/login", json={
+            "email": "worker@example.com", "password": "Next-Passw0rd-1"})
+        assert resp.status == 200
+    finally:
+        await client.close()
+
+
+async def test_admin_user_management():
+    client = await make_client()
+    try:
+        resp = await client.post("/admin/users", json={
+            "email": "staff@example.com", "password": "Sturdy-Passw0rd"},
+            auth=AUTH)
+        assert resp.status == 201
+        resp = await client.get("/admin/users", auth=AUTH)
+        users = await resp.json()
+        assert any(u["email"] == "staff@example.com" for u in users)
+        # deactivate -> login refused; reactivate -> works again
+        resp = await client.post("/admin/users/staff@example.com/toggle",
+                                 auth=AUTH)
+        assert (await resp.json())["is_active"] == 0
+        resp = await client.post("/auth/login", json={
+            "email": "staff@example.com", "password": "Sturdy-Passw0rd"})
+        assert resp.status == 401
+        resp = await client.post("/admin/users/staff@example.com/toggle",
+                                 auth=AUTH)
+        assert (await resp.json())["is_active"] == 1
+        # non-admin cannot reach the admin user surface
+        resp = await client.post("/auth/login", json={
+            "email": "staff@example.com", "password": "Sturdy-Passw0rd"})
+        token = (await resp.json())["access_token"]
+        resp = await client.get("/admin/users",
+                                headers={"authorization": f"Bearer {token}"})
+        assert resp.status == 403
+    finally:
+        await client.close()
+
+
+async def test_trace_search_filters():
+    client = await make_client(otel_exporter="memory")
+    try:
+        await client.get("/tools", auth=AUTH)
+        await client.get("/health")
+        resp = await client.get("/admin/traces?q=http", auth=AUTH)
+        spans = await resp.json()
+        assert spans and all("http" in s["name"] for s in spans)
+        # filter by status finds nothing erroneous yet
+        resp = await client.get("/admin/traces?status=ERROR", auth=AUTH)
+        assert await resp.json() == []
+        # trace tree endpoint resolves a seen trace id
+        trace_id = spans[0]["trace_id"]
+        resp = await client.get(f"/admin/traces/{trace_id}", auth=AUTH)
+        tree = await resp.json()
+        assert tree["trace_id"] == trace_id and tree["spans"]
+        resp = await client.get("/admin/traces/ffffffff", auth=AUTH)
+        assert resp.status == 404
+    finally:
+        await client.close()

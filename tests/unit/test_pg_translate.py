@@ -1,0 +1,67 @@
+"""Postgres dialect translation layer (driver-free; reference dual-DB
+support, config.py:14). The live-PG path skips without asyncpg + a server."""
+
+import pytest
+
+from mcp_context_forge_tpu.db.pg import HAVE_ASYNCPG, translate_sql
+
+
+def test_placeholders_become_positional():
+    assert translate_sql("SELECT * FROM t WHERE a=? AND b=?") == \
+        "SELECT * FROM t WHERE a=$1 AND b=$2"
+
+
+def test_placeholders_inside_literals_untouched():
+    out = translate_sql("SELECT '?' AS q, x FROM t WHERE y=?")
+    assert out == "SELECT '?' AS q, x FROM t WHERE y=$1"
+
+
+def test_insert_or_ignore():
+    out = translate_sql("INSERT OR IGNORE INTO t (a) VALUES (?)")
+    assert out == "INSERT INTO t (a) VALUES ($1) ON CONFLICT DO NOTHING"
+
+
+def test_autoincrement():
+    out = translate_sql(
+        "CREATE TABLE m (id INTEGER PRIMARY KEY AUTOINCREMENT, v TEXT)")
+    assert "BIGINT GENERATED ALWAYS AS IDENTITY PRIMARY KEY" in out
+
+
+def test_schema_translates_clean():
+    """Every in-tree migration statement must pass the translator without
+    leaving sqlite-only syntax behind."""
+    from mcp_context_forge_tpu.db.schema import MIGRATIONS
+
+    for migration in MIGRATIONS:
+        out = translate_sql(migration.sql)
+        assert "AUTOINCREMENT" not in out.upper()
+        assert "INSERT OR IGNORE" not in out.upper()
+
+
+@pytest.mark.skipif(not HAVE_ASYNCPG, reason="asyncpg not installed")
+def test_live_postgres_roundtrip():  # pragma: no cover - needs a server
+    import asyncio
+    import os
+
+    dsn = os.environ.get("MCPFORGE_TEST_PG_DSN")
+    if not dsn:
+        pytest.skip("MCPFORGE_TEST_PG_DSN not set")
+    from mcp_context_forge_tpu.db.pg import PostgresDatabase
+    from mcp_context_forge_tpu.db.schema import MIGRATIONS
+
+    async def main():
+        db = PostgresDatabase(dsn)
+        await db.connect()
+        try:
+            await db.migrate(MIGRATIONS)
+            await db.execute(
+                "INSERT OR IGNORE INTO users (email, password_hash,"
+                " created_at, updated_at) VALUES (?,?,?,?)",
+                ("pg@example.com", "x", 0.0, 0.0))
+            row = await db.fetchone("SELECT email FROM users WHERE email=?",
+                                    ("pg@example.com",))
+            assert row["email"] == "pg@example.com"
+        finally:
+            await db.close()
+
+    asyncio.run(main())
