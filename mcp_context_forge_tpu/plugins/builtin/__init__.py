@@ -52,6 +52,9 @@ for _name, _path in {
     "summarizer": f"{_P}.llm_plugins.SummarizerPlugin",
     "content_moderation": f"{_P}.llm_plugins.ContentModerationPlugin",
     "harmful_content_detector": f"{_P}.llm_plugins.HarmfulContentDetectorPlugin",
+    # validation (reference sparc_static_validator / altk_json_processor)
+    "sparc_static_validator": f"{_P}.validation_plugins.SparcStaticValidatorPlugin",
+    "altk_json_processor": f"{_P}.validation_plugins.AltkJsonProcessorPlugin",
     # out-of-process plugin servers over stdio MCP (reference plugins/external)
     "external": "mcp_context_forge_tpu.plugins.external.ExternalPlugin",
 }.items():

@@ -1,0 +1,113 @@
+"""sparc_static_validator + altk_json_processor builtins (round-1 plugin
+gaps; reference plugins/sparc_static_validator, plugins/altk_json_processor)."""
+
+import json
+
+import pytest
+
+from mcp_context_forge_tpu.plugins.builtin.validation_plugins import (
+    AltkJsonProcessorPlugin, SparcStaticValidatorPlugin, _extract_path)
+from mcp_context_forge_tpu.plugins.framework import (PluginConfig,
+                                                     PluginContext,
+                                                     PluginViolation)
+
+
+class _FakeDB:
+    def __init__(self, schema):
+        self.schema = schema
+
+    async def fetchone(self, sql, params):
+        return {"input_schema": json.dumps(self.schema)}
+
+
+class _Ctx:
+    def __init__(self, schema):
+        self.db = _FakeDB(schema)
+        self.llm_registry = None
+
+
+SCHEMA = {
+    "type": "object",
+    "required": ["city"],
+    "additionalProperties": False,
+    "properties": {
+        "city": {"type": "string"},
+        "days": {"type": "integer"},
+        "units": {"type": "string", "enum": ["metric", "imperial"]},
+    },
+}
+
+
+def _validator(schema=SCHEMA, **config):
+    return SparcStaticValidatorPlugin(
+        PluginConfig(name="sparc", kind="sparc_static_validator",
+                     config=config), _Ctx(schema))
+
+
+async def test_sparc_missing_required():
+    with pytest.raises(PluginViolation) as err:
+        await _validator().tool_pre_invoke("weather", {}, {}, PluginContext())
+    assert "missing required" in str(err.value)
+
+
+async def test_sparc_unknown_param_blocked():
+    with pytest.raises(PluginViolation) as err:
+        await _validator().tool_pre_invoke(
+            "weather", {"city": "Oslo", "bogus": 1}, {}, PluginContext())
+    assert "unknown parameters" in str(err.value)
+
+
+async def test_sparc_type_autocorrect():
+    out = await _validator().tool_pre_invoke(
+        "weather", {"city": "Oslo", "days": "3"}, {}, PluginContext())
+    assert out == {"arguments": {"city": "Oslo", "days": 3}}
+
+
+async def test_sparc_type_mismatch_without_autocorrect():
+    with pytest.raises(PluginViolation) as err:
+        await _validator(auto_correct=False).tool_pre_invoke(
+            "weather", {"city": "Oslo", "days": "3"}, {}, PluginContext())
+    assert "must be integer" in str(err.value)
+
+
+async def test_sparc_enum_enforced():
+    with pytest.raises(PluginViolation) as err:
+        await _validator().tool_pre_invoke(
+            "weather", {"city": "Oslo", "units": "kelvin"}, {},
+            PluginContext())
+    assert "one of" in str(err.value)
+
+
+async def test_sparc_valid_arguments_pass():
+    out = await _validator().tool_pre_invoke(
+        "weather", {"city": "Oslo", "days": 2, "units": "metric"}, {},
+        PluginContext())
+    assert out is None
+
+
+def test_extract_path():
+    data = {"items": [{"name": "a"}, {"name": "b"}], "total": 2}
+    assert _extract_path(data, "items[1].name") == "b"
+    assert _extract_path(data, "total") == 2
+    assert _extract_path(data, "missing.key") is None
+
+
+async def test_json_processor_extracts_paths():
+    plugin = AltkJsonProcessorPlugin(PluginConfig(
+        name="jp", kind="altk_json_processor",
+        config={"threshold_chars": 10, "paths": ["items[0].name", "total"]}))
+    big = {"items": [{"name": "first", "blob": "x" * 100}], "total": 1}
+    result = {"content": [{"type": "text", "text": json.dumps(big)}],
+              "isError": False}
+    out = await plugin.tool_post_invoke("t", result, PluginContext())
+    extracted = json.loads(out["content"][0]["text"])
+    assert extracted == {"items[0].name": "first", "total": 1}
+
+
+async def test_json_processor_passthrough_below_threshold():
+    plugin = AltkJsonProcessorPlugin(PluginConfig(
+        name="jp", kind="altk_json_processor",
+        config={"threshold_chars": 10_000, "paths": ["total"]}))
+    result = {"content": [{"type": "text", "text": "{\"total\": 1}"}],
+              "isError": False}
+    assert await plugin.tool_post_invoke("t", result, PluginContext()) is None
