@@ -18,6 +18,7 @@ import logging
 import time
 from typing import Any
 
+from ...utils.paths import extract_path as _extract_path
 from ..framework import Plugin, PluginViolation
 
 logger = logging.getLogger(__name__)
@@ -66,6 +67,22 @@ class SparcStaticValidatorPlugin(Plugin):
     def __init__(self, config, ctx=None):
         super().__init__(config, ctx)
         self._schema_cache: dict[str, tuple[dict | None, float]] = {}
+        self._unsub = None
+
+    async def initialize(self) -> None:
+        bus = getattr(self.ctx, "bus", None) if self.ctx else None
+        if bus is not None:
+            # same invalidation signal ToolService's lookup cache uses:
+            # a schema update must not be enforced stale for the TTL
+            async def _on_change(topic, message):
+                self._schema_cache.clear()
+
+            self._unsub = bus.subscribe("tools.changed", _on_change)
+
+    async def shutdown(self) -> None:
+        if self._unsub is not None:
+            self._unsub()
+            self._unsub = None
 
     _CACHE_MAX = 2048  # names are client-controlled: bound the dict
 
@@ -109,12 +126,14 @@ class SparcStaticValidatorPlugin(Plugin):
         if missing:
             problems.append(f"missing required parameters: {missing}")
 
-        unknown = [key for key in arguments if properties and
-                   key not in properties]
         strict_unknown = (schema.get("additionalProperties") is False
                           or self.config.config.get("block_unknown_params"))
-        if unknown and strict_unknown:
-            problems.append(f"unknown parameters: {unknown}")
+        if strict_unknown:
+            # an empty properties map with additionalProperties:false means
+            # NO argument is allowed — don't skip enforcement then
+            unknown = [key for key in arguments if key not in properties]
+            if unknown:
+                problems.append(f"unknown parameters: {unknown}")
 
         corrected = dict(arguments)
         changed = False
@@ -150,25 +169,6 @@ class SparcStaticValidatorPlugin(Plugin):
         return None
 
 
-def _extract_path(data: Any, path: str) -> Any:
-    """Dot-path with [i] list indexing: 'items[0].name'."""
-    current = data
-    for part in path.replace("]", "").split("."):
-        if not part:
-            continue
-        key, _, index = part.partition("[")
-        if key:
-            if not isinstance(current, dict) or key not in current:
-                return None
-            current = current[key]
-        if index:
-            try:
-                current = current[int(index)]
-            except (ValueError, IndexError, TypeError, KeyError):
-                return None
-    return current
-
-
 class AltkJsonProcessorPlugin(Plugin):
     """Shrinks long JSON tool results to the data the caller asked for.
 
@@ -190,10 +190,16 @@ class AltkJsonProcessorPlugin(Plugin):
 
         paths = list(self.config.config.get("paths", []))
         if not paths and self.config.config.get("query"):
-            paths = await self._paths_from_engine(text, data)
+            paths = await self._paths_from_engine(text)
         if not paths:
             return None
         extracted = {path: _extract_path(data, path) for path in paths}
+        if all(v is None for v in extracted.values()):
+            # no configured path resolves (schema drift): keep the original
+            # result rather than destroying it
+            logger.warning("json_processor: no path resolved for %s; passing"
+                           " result through unchanged", list(extracted))
+            return None
         # replace only the text blocks: non-text content (images, audio)
         # and sibling result keys (structuredContent, _meta) pass through
         new_content = [c for c in content if c.get("type") != "text"]
@@ -201,7 +207,7 @@ class AltkJsonProcessorPlugin(Plugin):
                             "text": json.dumps(extracted, default=str)})
         return {**result, "content": new_content, "_json_processed": True}
 
-    async def _paths_from_engine(self, text: str, data: Any) -> list[str]:
+    async def _paths_from_engine(self, text: str) -> list[str]:
         """LLM-assisted path discovery (reference: ALTK code generation via
         an LLM; here: tpu_local suggests dot-paths, extraction itself stays
         deterministic — generated paths can't execute arbitrary code)."""

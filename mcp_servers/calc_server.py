@@ -1,0 +1,101 @@
+"""Sample MCP server: safe calculator (reference mcp-servers analog)."""
+
+from __future__ import annotations
+
+import ast
+import math
+import operator
+import statistics
+
+from ._base import StdioMCPServer
+
+server = StdioMCPServer("calc-server")
+
+def _safe_pow(base, exponent):
+    # unbounded integer pow ("9**9**9") would wedge the server
+    if abs(exponent) > 128 or abs(base) > 1e6:
+        raise ValueError("exponentiation operands out of range")
+    return operator.pow(base, exponent)
+
+
+_BIN_OPS = {
+    ast.Add: operator.add, ast.Sub: operator.sub, ast.Mult: operator.mul,
+    ast.Div: operator.truediv, ast.FloorDiv: operator.floordiv,
+    ast.Mod: operator.mod, ast.Pow: _safe_pow,
+}
+_UNARY_OPS = {ast.UAdd: operator.pos, ast.USub: operator.neg}
+_FUNCS = {"sqrt": math.sqrt, "log": math.log, "exp": math.exp,
+          "sin": math.sin, "cos": math.cos, "abs": abs, "round": round}
+_NAMES = {"pi": math.pi, "e": math.e}
+
+
+def _eval(node: ast.AST) -> float:
+    """AST-walking evaluator: numbers, arithmetic, a few math fns — no
+    names/attributes/calls beyond the allowlist (no eval())."""
+    if isinstance(node, ast.Expression):
+        return _eval(node.body)
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)):
+        return node.value
+    if isinstance(node, ast.BinOp) and type(node.op) in _BIN_OPS:
+        return _BIN_OPS[type(node.op)](_eval(node.left), _eval(node.right))
+    if isinstance(node, ast.UnaryOp) and type(node.op) in _UNARY_OPS:
+        return _UNARY_OPS[type(node.op)](_eval(node.operand))
+    if isinstance(node, ast.Name) and node.id in _NAMES:
+        return _NAMES[node.id]
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id in _FUNCS and not node.keywords):
+        return _FUNCS[node.func.id](*[_eval(a) for a in node.args])
+    raise ValueError(f"disallowed expression element: {ast.dump(node)[:60]}")
+
+
+@server.tool("evaluate", "Evaluate an arithmetic expression", {
+    "type": "object", "properties": {"expression": {"type": "string"}},
+    "required": ["expression"]})
+def evaluate(expression: str) -> float:
+    if len(expression) > 1000:
+        raise ValueError("expression too long")
+    return _eval(ast.parse(expression, mode="eval"))
+
+
+@server.tool("stats", "Descriptive statistics for a list of numbers", {
+    "type": "object",
+    "properties": {"numbers": {"type": "array", "items": {"type": "number"}}},
+    "required": ["numbers"]})
+def stats(numbers: list) -> str:
+    values = [float(v) for v in numbers]
+    if not values:
+        raise ValueError("numbers must be non-empty")
+    import json
+    return json.dumps({
+        "count": len(values), "sum": sum(values),
+        "mean": statistics.fmean(values), "min": min(values),
+        "max": max(values),
+        "stdev": statistics.stdev(values) if len(values) > 1 else 0.0,
+        "median": statistics.median(values)})
+
+
+@server.tool("convert", "Unit conversion (length/mass/temperature)", {
+    "type": "object", "properties": {
+        "value": {"type": "number"}, "from_unit": {"type": "string"},
+        "to_unit": {"type": "string"}},
+    "required": ["value", "from_unit", "to_unit"]})
+def convert(value: float, from_unit: str, to_unit: str) -> float:
+    to_meters = {"m": 1.0, "km": 1000.0, "cm": 0.01, "mm": 0.001,
+                 "mi": 1609.344, "ft": 0.3048, "in": 0.0254}
+    to_kg = {"kg": 1.0, "g": 0.001, "lb": 0.45359237, "oz": 0.028349523}
+    value = float(value)
+    if from_unit in to_meters and to_unit in to_meters:
+        return value * to_meters[from_unit] / to_meters[to_unit]
+    if from_unit in to_kg and to_unit in to_kg:
+        return value * to_kg[from_unit] / to_kg[to_unit]
+    temps = {"c", "f", "k"}
+    if from_unit in temps and to_unit in temps:
+        celsius = {"c": value, "f": (value - 32) * 5 / 9,
+                   "k": value - 273.15}[from_unit]
+        return {"c": celsius, "f": celsius * 9 / 5 + 32,
+                "k": celsius + 273.15}[to_unit]
+    raise ValueError(f"cannot convert {from_unit} -> {to_unit}")
+
+
+if __name__ == "__main__":
+    server.run()

@@ -1,0 +1,81 @@
+"""Sample MCP server: JSON utilities (reference mcp-servers analog)."""
+
+from __future__ import annotations
+
+import json
+
+from ._base import StdioMCPServer
+
+server = StdioMCPServer("json-server")
+
+
+def _path(data, path: str):
+    current = data
+    for part in path.replace("]", "").split("."):
+        if not part:
+            continue
+        key, _, index = part.partition("[")
+        if key:
+            current = current[key]
+        if index:
+            current = current[int(index)]
+    return current
+
+
+@server.tool("query", "Extract a dot-path from a JSON document", {
+    "type": "object",
+    "properties": {"document": {"type": "string"}, "path": {"type": "string"}},
+    "required": ["document", "path"]})
+def query(document: str, path: str) -> str:
+    return json.dumps(_path(json.loads(document), path), default=str)
+
+
+@server.tool("validate", "Check whether text is valid JSON", {
+    "type": "object", "properties": {"document": {"type": "string"}},
+    "required": ["document"]})
+def validate(document: str) -> str:
+    try:
+        json.loads(document)
+        return json.dumps({"valid": True})
+    except json.JSONDecodeError as exc:
+        return json.dumps({"valid": False, "error": str(exc),
+                           "line": exc.lineno, "column": exc.colno})
+
+
+@server.tool("diff", "Shallow diff of two JSON objects", {
+    "type": "object",
+    "properties": {"a": {"type": "string"}, "b": {"type": "string"}},
+    "required": ["a", "b"]})
+def diff(a: str, b: str) -> str:
+    left, right = json.loads(a), json.loads(b)
+    if not (isinstance(left, dict) and isinstance(right, dict)):
+        return json.dumps({"equal": left == right})
+    added = sorted(set(right) - set(left))
+    removed = sorted(set(left) - set(right))
+    changed = sorted(k for k in set(left) & set(right) if left[k] != right[k])
+    return json.dumps({"added": added, "removed": removed, "changed": changed,
+                       "equal": not (added or removed or changed)})
+
+
+@server.tool("flatten", "Flatten nested JSON to dot-path keys", {
+    "type": "object", "properties": {"document": {"type": "string"}},
+    "required": ["document"]})
+def flatten(document: str) -> str:
+    out: dict = {}
+
+    def walk(node, prefix=""):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, f"{prefix}.{k}" if prefix else str(k))
+        elif isinstance(node, list):
+            for i, v in enumerate(node):
+                walk(v, f"{prefix}[{i}]")
+        else:
+            out[prefix] = node
+
+    walk(json.loads(document))
+    return json.dumps(out, default=str)
+
+
+if __name__ == "__main__":
+    server.run()

@@ -1,0 +1,81 @@
+"""Sample MCP server: text utilities (reference mcp-servers analog)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+
+from ._base import StdioMCPServer
+
+server = StdioMCPServer("text-server")
+
+
+@server.tool("word_count", "Count words/lines/chars in text", {
+    "type": "object", "properties": {"text": {"type": "string"}},
+    "required": ["text"]})
+def word_count(text: str) -> str:
+    return json.dumps({"words": len(text.split()),
+                       "lines": text.count("\n") + (1 if text else 0),
+                       "chars": len(text)})
+
+
+@server.tool("extract", "Extract regex matches from text", {
+    "type": "object",
+    "properties": {"text": {"type": "string"}, "pattern": {"type": "string"},
+                   "limit": {"type": "integer"}},
+    "required": ["text", "pattern"]})
+def extract(text: str, pattern: str, limit: int = 50) -> str:
+    if len(pattern) > 500:
+        raise ValueError("pattern too long")
+    # ReDoS guard: quantified group itself quantified => catastrophic
+    # backtracking class (heuristic; the single-threaded stdio server has
+    # no per-call timeout to fall back on)
+    if re.search(r"\([^)]*[+*{][^)]*\)\s*[+*{]", pattern):
+        raise ValueError("nested quantifiers are not allowed")
+    compiled = re.compile(pattern)
+    return json.dumps(compiled.findall(text[:20_000])[: int(limit)])
+
+
+@server.tool("case", "Change text case (upper/lower/title/snake/camel)", {
+    "type": "object",
+    "properties": {"text": {"type": "string"}, "mode": {
+        "type": "string", "enum": ["upper", "lower", "title", "snake", "camel"]}},
+    "required": ["text", "mode"]})
+def case(text: str, mode: str) -> str:
+    if mode == "upper":
+        return text.upper()
+    if mode == "lower":
+        return text.lower()
+    if mode == "title":
+        return text.title()
+    words = re.split(r"[\s_\-]+", text.strip())
+    if mode == "snake":
+        return "_".join(w.lower() for w in words if w)
+    if mode == "camel":
+        parts = [w for w in words if w]
+        return (parts[0].lower() + "".join(p.title() for p in parts[1:])
+                if parts else "")
+    raise ValueError(f"unknown mode {mode!r}")
+
+
+@server.tool("checksum", "Hash text (sha256/sha1/md5)", {
+    "type": "object",
+    "properties": {"text": {"type": "string"},
+                   "algorithm": {"type": "string",
+                                 "enum": ["sha256", "sha1", "md5"]}},
+    "required": ["text"]})
+def checksum(text: str, algorithm: str = "sha256") -> str:
+    return hashlib.new(algorithm, text.encode()).hexdigest()
+
+
+@server.tool("dedent_trim", "Normalize whitespace (dedent + strip)", {
+    "type": "object", "properties": {"text": {"type": "string"}},
+    "required": ["text"]})
+def dedent_trim(text: str) -> str:
+    import textwrap
+    return textwrap.dedent(text).strip()
+
+
+if __name__ == "__main__":
+    server.run()

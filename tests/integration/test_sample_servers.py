@@ -76,3 +76,40 @@ async def test_list_changed_notification_to_stateful_session():
         assert await watcher
     finally:
         await gateway.close()
+
+
+async def test_new_sample_servers_federated():
+    """calc/text/json sample servers register and serve through the gateway."""
+    cases = [
+        ("mcp_servers.calc_server", "evaluate",
+         {"expression": "sqrt(16) + 2**3"}, "12.0"),
+        ("mcp_servers.text_server", "case",
+         {"text": "hello world", "mode": "camel"}, "helloWorld"),
+        ("mcp_servers.json_server", "query",
+         {"document": json.dumps({"a": [{"b": 7}]}), "path": "a[0].b"}, "7"),
+    ]
+    gateway = await make_client()
+    bridges = []
+    try:
+        for i, (module, tool, arguments, expected) in enumerate(cases):
+            bridge = StdioServerBridge(f"{sys.executable} -m {module}")
+            await bridge.start()
+            client = TestClient(TestServer(build_bridge_app(bridge)))
+            await client.start_server()
+            bridges.append((bridge, client))
+            url = f"http://{client.server.host}:{client.server.port}/mcp"
+            resp = await gateway.post("/gateways", json={
+                "name": module.split(".")[-1], "url": url,
+                "transport": "streamablehttp"}, auth=AUTH)
+            assert resp.status == 201, await resp.text()
+            resp = await gateway.post("/rpc", json={
+                "jsonrpc": "2.0", "id": i, "method": "tools/call",
+                "params": {"name": tool, "arguments": arguments}}, auth=AUTH)
+            payload = await resp.json()
+            text = payload["result"]["content"][0]["text"]
+            assert expected in text, (tool, text)
+    finally:
+        await gateway.close()
+        for bridge, client in bridges:
+            await client.close()
+            await bridge.stop()

@@ -1,0 +1,82 @@
+"""MCP server builder: scaffold runs end-to-end, deploy.yaml validates and
+compiles to compose (reference mcpgateway/tools/builder)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+from mcp_context_forge_tpu.tools.builder import (generate_compose,
+                                                 scaffold_server,
+                                                 validate_deploy)
+
+
+def _rpc(proc, method, params=None, rid=1):
+    proc.stdin.write(json.dumps({"jsonrpc": "2.0", "id": rid, "method": method,
+                                 "params": params or {}}) + "\n")
+    proc.stdin.flush()
+    return json.loads(proc.stdout.readline())
+
+
+def test_scaffolded_server_speaks_mcp(tmp_path):
+    project = scaffold_server("weather", str(tmp_path),
+                              tools=["get_forecast", "get_alerts"])
+    assert (project / "server.py").exists()
+    assert (project / "plugin-manifest.yaml").exists()
+    proc = subprocess.Popen([sys.executable, str(project / "server.py")],
+                            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                            text=True)
+    try:
+        init = _rpc(proc, "initialize")
+        assert init["result"]["serverInfo"]["name"] == "weather"
+        tools = _rpc(proc, "tools/list", rid=2)["result"]["tools"]
+        assert {"get_forecast", "get_alerts"} <= {t["name"] for t in tools}
+        out = _rpc(proc, "tools/call",
+                   {"name": "get_forecast", "arguments": {"text": "oslo"}},
+                   rid=3)
+        assert out["result"]["isError"] is False
+        assert "oslo" in out["result"]["content"][0]["text"]
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_scaffolded_smoke_test_passes(tmp_path):
+    project = scaffold_server("pinger", str(tmp_path))
+    result = subprocess.run([sys.executable, "test_server.py"],
+                            cwd=project, capture_output=True, text=True,
+                            timeout=60)
+    assert result.returncode == 0, result.stdout + result.stderr
+
+
+def test_deploy_validation():
+    assert validate_deploy({}) != []
+    assert validate_deploy({"gateways": []}) != []
+    assert validate_deploy({"gateways": [{"name": "edge", "workers": 0}]}) != []
+    assert validate_deploy(
+        {"gateways": [{"name": "edge"}],
+         "servers": [{"name": "x"}]}) != []  # server needs command/image
+    assert validate_deploy(
+        {"gateways": [{"name": "edge", "workers": 2}],
+         "servers": [{"name": "time", "command": "python t.py"}]}) == []
+
+
+def test_generate_compose_shape():
+    compose = generate_compose({
+        "gateways": [{"name": "edge", "workers": 2,
+                      "env": {"MCPFORGE_LOG_LEVEL": "INFO"}}],
+        "servers": [{"name": "time", "command": "python time_server.py"}],
+    })
+    services = compose["services"]
+    assert {"hub", "edge-0", "edge-1", "time"} <= set(services)
+    assert services["edge-0"]["environment"]["MCPFORGE_BUS_BACKEND"] == "tcp"
+    assert services["edge-0"]["ports"] != services["edge-1"]["ports"]
+    # round-trips through yaml
+    assert yaml.safe_load(yaml.safe_dump(compose)) == compose
+
+
+def test_generate_compose_rejects_invalid():
+    with pytest.raises(ValueError):
+        generate_compose({"gateways": []})
