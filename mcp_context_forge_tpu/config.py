@@ -75,8 +75,14 @@ class Settings(BaseModel):
     password_require_special: bool = False
     password_max_length: int = 256  # argon2 DoS guard
 
+    # --- HTTP edge (reference middleware stack) ---
+    trust_proxy_headers: bool = False     # honor X-Forwarded-* from the LB
+    max_header_bytes: int = 32768         # 431 above this (0 = unlimited)
+    cors_allowed_origins: str = ""        # csv; "*" = any; "" = CORS off
+
     # --- protocol / transports ---
     protocol_version: str = "2025-06-18"
+    supported_protocol_versions_csv: str = "2025-06-18,2025-03-26,2024-11-05"
     streamable_http_stateful: bool = False
     sse_keepalive_interval: float = 30.0
     session_ttl: int = 3600
@@ -113,6 +119,7 @@ class Settings(BaseModel):
     otel_service_name: str = "mcpforge"
     otel_otlp_endpoint: str = ""   # e.g. http://collector:4318 (OTLP/HTTP)
     otel_otlp_headers: str = ""    # JSON object of extra headers
+    jax_profile_dir: str = "/tmp/mcpforge-jaxprof"  # /admin/engine/profile sink
     log_level: str = "INFO"
     log_json: bool = False
     metrics_buffer_flush_interval: float = 5.0
@@ -158,6 +165,16 @@ class Settings(BaseModel):
     @property
     def is_postgres(self) -> bool:
         return self.database_url.startswith(("postgres://", "postgresql://"))
+
+    @property
+    def cors_origins(self) -> set[str]:
+        return {o.strip() for o in self.cors_allowed_origins.split(",")
+                if o.strip()}
+
+    @property
+    def supported_protocol_versions(self) -> set[str]:
+        return {v.strip() for v in self.supported_protocol_versions_csv.split(",")
+                if v.strip()}
 
     @property
     def database_path(self) -> str:

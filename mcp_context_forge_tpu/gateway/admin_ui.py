@@ -1,8 +1,10 @@
-"""Minimal server-rendered admin UI.
+"""Server-rendered admin UI.
 
 Reference: 20.5k-LoC admin.py + 34.8k-LoC JS admin_ui — intentionally
-table-driven and tiny here (SURVEY.md §7.2 #5: the API surface must be
-generated, not hand-grown). One page, vanilla JS over the existing REST API.
+table-driven here (SURVEY.md §7.2 #5: the API surface must be generated,
+not hand-grown). One page, vanilla JS over the existing REST API: entity
+tabs with client-side search, enable/disable row actions, trace drill-down
+(span tree), users/teams/plugins views, auto-refresh.
 """
 
 from __future__ import annotations
@@ -13,61 +15,152 @@ _PAGE = """<!doctype html>
 <html><head><meta charset="utf-8"><title>mcpforge admin</title>
 <style>
  body{font-family:system-ui,sans-serif;margin:0;background:#f4f5f7;color:#1a1d21}
- header{background:#1a1d21;color:#fff;padding:10px 20px;display:flex;gap:16px;align-items:center}
+ header{background:#1a1d21;color:#fff;padding:10px 20px;display:flex;gap:16px;align-items:center;flex-wrap:wrap}
  header h1{font-size:16px;margin:0}
  nav button{background:none;border:none;color:#aab;cursor:pointer;font-size:14px;padding:6px 10px}
  nav button.active{color:#fff;border-bottom:2px solid #6cf}
- main{padding:20px;max-width:1100px;margin:0 auto}
+ main{padding:20px;max-width:1200px;margin:0 auto}
  table{width:100%;border-collapse:collapse;background:#fff;box-shadow:0 1px 3px rgba(0,0,0,.08)}
  th,td{text-align:left;padding:8px 12px;border-bottom:1px solid #eceef1;font-size:13px}
  th{background:#fafbfc;font-weight:600}
  .pill{display:inline-block;padding:1px 8px;border-radius:10px;font-size:11px}
  .ok{background:#d9f2e4;color:#11734b}.bad{background:#fde2e1;color:#a12622}
- #status{margin:10px 0;color:#667}
- pre{background:#fff;padding:12px;overflow:auto;font-size:12px}
+ #bar{margin:10px 0;display:flex;gap:10px;align-items:center}
+ #status{color:#667}
+ #q{padding:6px 10px;border:1px solid #ccd;border-radius:4px;min-width:220px}
+ button.act{background:#eef;border:1px solid #ccd;border-radius:4px;cursor:pointer;padding:2px 8px;font-size:12px}
+ a.trace{color:#26c;cursor:pointer;text-decoration:underline}
+ #detail{background:#fff;margin-top:14px;padding:12px;box-shadow:0 1px 3px rgba(0,0,0,.08);display:none}
+ .span-row{font-family:ui-monospace,monospace;font-size:12px;white-space:pre}
+ .err{color:#a12622}
 </style></head><body>
 <header><h1>mcpforge</h1><nav id="nav"></nav></header>
-<main><div id="status"></div><div id="view"></div></main>
+<main>
+ <div id="bar">
+  <input id="q" placeholder="filter rows…" oninput="render()">
+  <button class="act" onclick="show(current)">refresh</button>
+  <label style="font-size:12px;color:#667"><input type="checkbox" id="auto"
+   onchange="autoRefresh()"> auto (5s)</label>
+  <span id="status"></span>
+ </div>
+ <div id="view"></div>
+ <div id="detail"></div>
+</main>
 <script>
 const TABS = {
-  tools:    {url: "/tools?include_inactive=true", cols: ["name","integration_type","url","enabled","reachable"]},
-  gateways: {url: "/gateways?include_inactive=true", cols: ["name","url","transport","state","reachable"]},
-  servers:  {url: "/servers?include_inactive=true", cols: ["name","description","associated_tools","enabled"]},
-  resources:{url: "/resources?include_inactive=true", cols: ["uri","name","mime_type","enabled"]},
-  prompts:  {url: "/prompts?include_inactive=true", cols: ["name","description","enabled"]},
-  agents:   {url: "/a2a?include_inactive=true", cols: ["name","agent_type","endpoint_url","enabled","reachable"]},
+  tools:    {url: "/tools?include_inactive=true", cols: ["name","integration_type","url","enabled","reachable"], toggle: id => `/tools/${id}/toggle`, boolcols: ["enabled","reachable"]},
+  gateways: {url: "/gateways?include_inactive=true", cols: ["name","url","transport","state","reachable"], boolcols: ["reachable"]},
+  servers:  {url: "/servers?include_inactive=true", cols: ["name","description","associated_tools","enabled"], boolcols: ["enabled"]},
+  resources:{url: "/resources?include_inactive=true", cols: ["uri","name","mime_type","enabled"], boolcols: ["enabled"]},
+  prompts:  {url: "/prompts?include_inactive=true", cols: ["name","description","enabled"], boolcols: ["enabled"]},
+  agents:   {url: "/a2a?include_inactive=true", cols: ["name","agent_type","endpoint_url","enabled","reachable"], boolcols: ["enabled","reachable"]},
+  plugins:  {url: "/plugins", cols: ["name","kind","mode","priority"]},
+  users:    {url: "/admin/users", cols: ["email","full_name","is_admin","is_active","auth_provider","last_login"], toggle: id => `/admin/users/${encodeURIComponent(id)}/toggle`, idcol: "email", boolcols: ["is_admin","is_active"]},
+  teams:    {url: "/teams", cols: ["name","slug","visibility","is_personal","created_by"], boolcols: ["is_personal"]},
+  tokens:   {url: "/auth/tokens", cols: ["name","server_id","expires_at","last_used","revoked_at"]},
   models:   {url: "/v1/models", cols: ["id","owned_by"], path: "data"},
   metrics:  {url: "/metrics", cols: ["name","calls","errors","avg_ms","min_ms","max_ms"], path: "tools"},
-  traces:   {url: "/admin/traces?limit=50", cols: ["name","duration_ms","status","trace_id"]},
-  logs:     {url: "/admin/logs?limit=100", cols: ["ts","level","logger","message"]},
+  rollups:  {url: "/metrics/rollups", cols: ["entity_type","entity_id","hour","calls","errors","avg_ms"]},
+  traces:   {url: "/admin/traces?limit=100", cols: ["name","duration_ms","status","trace_id"], tracecol: "trace_id"},
+  logs:     {url: "/admin/logs?limit=200", cols: ["ts","level","logger","message"]},
+  audit:    {url: "/admin/audit?limit=100", cols: ["ts","actor","action","details"]},
 };
+let current = "tools", rows = [], shown = [], timer = null;
 function esc(s){
   return String(s).replace(/[&<>"']/g, c => ({"&":"&amp;","<":"&lt;",">":"&gt;",
     '"':"&quot;","'":"&#39;"}[c]));
 }
-function cell(v){
+function cell(v, isBool){
+  // booleanness is a per-COLUMN decision (sqlite int-bools), never by value
+  if (isBool) return (v === true || v === 1)
+    ? '<span class="pill ok">yes</span>' : '<span class="pill bad">no</span>';
   if (v === true) return '<span class="pill ok">yes</span>';
   if (v === false) return '<span class="pill bad">no</span>';
   if (Array.isArray(v)) return v.length;
   if (v === null || v === undefined) return "";
   if (typeof v === "number") return Math.round(v*100)/100;
-  return esc(String(v).slice(0,80));  // API data is attacker-influenced
+  if (typeof v === "object") return esc(JSON.stringify(v).slice(0,80));
+  return esc(String(v).slice(0,100));  // API data is attacker-influenced
+}
+function render(){
+  const t = TABS[current];
+  const q = document.getElementById("q").value.toLowerCase();
+  // `shown` is the single source of truth for row indices: click handlers
+  // index into it, so a filter edit between render and click cannot
+  // misresolve, and attacker data never lands inside a JS string
+  shown = rows.filter(d => !q || JSON.stringify(d).toLowerCase().includes(q));
+  document.getElementById("status").textContent = shown.length + " rows";
+  const actions = t.toggle ? "<th></th>" : "";
+  const head = "<tr>" + t.cols.map(c=>`<th>${c}</th>`).join("") + actions + "</tr>";
+  const bools = new Set(t.boolcols || []);
+  const body = shown.map((d,i)=>{
+    const cells = t.cols.map(c=>{
+      if (t.tracecol === c) return `<td><a class="trace" onclick="trace(${i})">${cell(d[c])}</a></td>`;
+      return `<td>${cell(d[c], bools.has(c))}</td>`;
+    }).join("");
+    const act = t.toggle ? `<td><button class="act" onclick="toggleRow(${i})">toggle</button></td>` : "";
+    return "<tr>"+cells+act+"</tr>";
+  }).join("");
+  document.getElementById("view").innerHTML = `<table>${head}${body}</table>`;
 }
 async function show(name){
+  current = name;
+  document.getElementById("detail").style.display = "none";
   document.querySelectorAll("nav button").forEach(b=>b.classList.toggle("active", b.textContent===name));
   const t = TABS[name];
   const s = document.getElementById("status");
   s.textContent = "loading…";
   try {
     const r = await fetch(t.url, {headers: {accept: "application/json"}});
-    if (!r.ok) { s.textContent = r.status + " " + await r.text(); return; }
+    if (!r.ok) { s.textContent = r.status + " " + esc(await r.text()); return; }
     let data = await r.json();
     if (t.path) data = data[t.path] || [];
-    s.textContent = data.length + " rows";
-    const head = "<tr>" + t.cols.map(c=>`<th>${c}</th>`).join("") + "</tr>";
-    const rows = data.map(d=>"<tr>"+t.cols.map(c=>`<td>${cell(d[c])}</td>`).join("")+"</tr>").join("");
-    document.getElementById("view").innerHTML = `<table>${head}${rows}</table>`;
-  } catch(e){ s.textContent = "error: " + e; }
+    rows = Array.isArray(data) ? data : [];
+    render();
+  } catch(e){ s.textContent = "error: " + esc(String(e)); }
+}
+async function toggleRow(i){
+  const t = TABS[current];
+  const row = shown[i];
+  if (!row) return;
+  const id = row[t.idcol || "id"];
+  const r = await fetch(t.toggle(id), {method: "POST"});
+  if (!r.ok) document.getElementById("status").textContent = "toggle failed: " + r.status;
+  show(current);
+}
+async function trace(i){
+  const t = TABS[current];
+  const row = shown[i];
+  if (!row) return;
+  const id = encodeURIComponent(String(row[t.tracecol] || ""));
+  const r = await fetch(`/admin/traces/${id}`);
+  const d = document.getElementById("detail");
+  d.style.display = "block";
+  if (!r.ok) { d.textContent = "trace fetch failed: " + r.status; return; }
+  const tree = await r.json();
+  const byParent = {};
+  for (const s of tree.spans) (byParent[s.parent_span_id || ""] ??= []).push(s);
+  const lines = [];
+  const walk = (pid, depth) => {
+    for (const s of byParent[pid] || []) {
+      const cls = s.status === "ERROR" ? " err" : "";
+      lines.push(`<div class="span-row${cls}">${"  ".repeat(depth)}${esc(s.name)}`
+        + `  ${s.duration_ms == null ? "" : Math.round(s.duration_ms*100)/100 + "ms"}`
+        + `  ${esc(JSON.stringify(s.attributes||{})).slice(0,160)}</div>`);
+      walk(s.span_id, depth+1);
+    }
+  };
+  walk("", 0);
+  // orphan spans (parent outside the stored window) still render
+  const seen = new Set(tree.spans.map(s=>s.span_id));
+  for (const s of tree.spans)
+    if (s.parent_span_id && !seen.has(s.parent_span_id))
+      lines.push(`<div class="span-row">${esc(s.name)} (orphan)</div>`);
+  d.innerHTML = `<b>trace ${esc(id)}</b> — ${tree.spans.length} spans` + lines.join("");
+}
+function autoRefresh(){
+  if (timer) { clearInterval(timer); timer = null; }
+  if (document.getElementById("auto").checked) timer = setInterval(()=>show(current), 5000);
 }
 const nav = document.getElementById("nav");
 for (const name of Object.keys(TABS)){

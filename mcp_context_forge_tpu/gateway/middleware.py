@@ -24,6 +24,83 @@ PUBLIC_PATHS = {"/health", "/ready", "/version", "/.well-known/mcp", "/auth/logi
 
 
 @web.middleware
+async def forwarded_middleware(request: web.Request, handler: Handler) -> web.StreamResponse:
+    """Honor X-Forwarded-For/Proto from a trusted edge (reference
+    ProxyHeaders + ForwardedHostMiddleware). Off unless trust_proxy_headers
+    — honoring client-supplied headers otherwise lets callers spoof their
+    rate-limit identity."""
+    settings = request.app["ctx"].settings
+    client_ip = request.remote or "unknown"
+    if settings.trust_proxy_headers:
+        forwarded = request.headers.get("x-forwarded-for", "")
+        if forwarded:
+            # RIGHTMOST entry: the one the trusted edge appended — the
+            # leftmost is client-supplied and would let callers mint a fresh
+            # rate-limit identity per request
+            client_ip = forwarded.split(",")[-1].strip() or client_ip
+    request["client_ip"] = client_ip
+    return await handler(request)
+
+
+@web.middleware
+async def header_size_middleware(request: web.Request, handler: Handler) -> web.StreamResponse:
+    """Reject oversized header blocks (reference HeaderSizeMiddleware) —
+    431 before any downstream work."""
+    settings = request.app["ctx"].settings
+    limit = settings.max_header_bytes
+    if limit:
+        total = sum(len(k) + len(v) for k, v in request.raw_headers)
+        if total > limit:
+            return web.json_response(
+                {"detail": f"Request headers exceed {limit} bytes"},
+                status=431)
+    return await handler(request)
+
+
+@web.middleware
+async def protocol_version_middleware(request: web.Request, handler: Handler) -> web.StreamResponse:
+    """Validate MCP-Protocol-Version when a client sends one (reference
+    MCPProtocolVersionMiddleware): unsupported versions get a clear 400
+    instead of undefined behavior deeper in the stack."""
+    version = request.headers.get("mcp-protocol-version")
+    if version and request.path.startswith(("/mcp", "/servers", "/rpc")):
+        supported = request.app["ctx"].settings.supported_protocol_versions
+        if version not in supported:
+            return web.json_response(
+                {"detail": f"Unsupported MCP protocol version {version!r};"
+                           f" supported: {sorted(supported)}"}, status=400)
+    return await handler(request)
+
+
+@web.middleware
+async def cors_middleware(request: web.Request, handler: Handler) -> web.StreamResponse:
+    """CORS for browser-based MCP clients (reference CORSMiddleware).
+    Enabled by setting cors_allowed_origins; '*' allows any origin."""
+    settings = request.app["ctx"].settings
+    allowed = settings.cors_origins
+    origin = request.headers.get("origin", "")
+    grant = origin if (allowed and origin and
+                       ("*" in allowed or origin in allowed)) else ""
+    if request.method == "OPTIONS" and grant:
+        return web.Response(status=204, headers={
+            "access-control-allow-origin": grant,
+            "access-control-allow-methods": "GET, POST, PUT, DELETE, OPTIONS",
+            "access-control-allow-headers":
+                "authorization, content-type, mcp-session-id,"
+                " mcp-protocol-version, last-event-id",
+            "access-control-max-age": "600",
+            "vary": "origin",
+        })
+    response = await handler(request)
+    if grant:
+        response.headers["access-control-allow-origin"] = grant
+        response.headers.setdefault("vary", "origin")
+        response.headers["access-control-expose-headers"] = \
+            "mcp-session-id, x-correlation-id"
+    return response
+
+
+@web.middleware
 async def error_middleware(request: web.Request, handler: Handler) -> web.StreamResponse:
     """Map domain errors to HTTP codes; never leak stack traces."""
     try:
@@ -124,7 +201,7 @@ class RateLimiter:
 @web.middleware
 async def rate_limit_middleware(request: web.Request, handler: Handler) -> web.StreamResponse:
     limiter: RateLimiter = request.app["rate_limiter"]
-    key = request.remote or "unknown"
+    key = request.get("client_ip") or request.remote or "unknown"
     if not limiter.allow(key):
         return web.json_response({"detail": "Rate limit exceeded"}, status=429,
                                  headers={"retry-after": "1"})
@@ -208,8 +285,12 @@ async def request_logging_middleware(request: web.Request, handler: Handler
 # AuthError and friends map to status codes.
 MIDDLEWARES = [
     observability_middleware,
+    forwarded_middleware,
+    cors_middleware,
     security_headers_middleware,
+    header_size_middleware,
     error_middleware,
+    protocol_version_middleware,
     rate_limit_middleware,
     auth_middleware,
     request_logging_middleware,

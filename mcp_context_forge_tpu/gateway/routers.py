@@ -198,9 +198,18 @@ def setup_routes(app: web.Application) -> None:
     @routes.post("/tools/{tool_id}/toggle")
     async def toggle_tool(request: web.Request) -> web.Response:
         request["auth"].require("tools.update")
-        body = await request.json()
-        tool = await request.app["tool_service"].toggle_tool(
-            request.match_info["tool_id"], bool(body.get("enabled", True)))
+        body = {}
+        if request.can_read_body and (await request.read()):
+            # malformed JSON must 422, not silently select flip mode — a
+            # client that MEANT {"enabled": false} must not re-enable
+            body = json.loads(await request.text())
+        tool_id = request.match_info["tool_id"]
+        if "enabled" in body:
+            enabled = bool(body["enabled"])
+        else:  # bare POST (admin UI): flip the current state
+            current = await request.app["tool_service"].get_tool(tool_id)
+            enabled = not current.enabled
+        tool = await request.app["tool_service"].toggle_tool(tool_id, enabled)
         return web.json_response(_dump(tool))
 
     # -------------------------------------------------------------- gateways
@@ -427,6 +436,44 @@ def setup_routes(app: web.Application) -> None:
             "duration_ms": s.duration_ms, "status": s.status,
             "attributes": {k: str(v) for k, v in s.attributes.items()},
         } for s in reversed(spans)])
+
+    @routes.post("/admin/engine/profile")
+    async def engine_profile(request: web.Request) -> web.Response:
+        """Capture a jax.profiler trace of the running engine (SURVEY §5.1
+        TPU mapping: jax.profiler integration alongside the OTel layer).
+        Body: {"duration_ms": 1000, "dir": "/tmp/mcpforge-jaxprof"}."""
+        # writes to disk: an admin capability, not a read one
+        request["auth"].require("admin.all")
+        engine = request.app.get("tpu_engine")
+        if engine is None:
+            raise NotFoundError("tpu_local engine is not enabled")
+        body = await request.json() if request.can_read_body else {}
+        duration_ms = min(float(body.get("duration_ms", 1000.0)), 30_000.0)
+        # server-configured destination only — a client-supplied path would
+        # be a filesystem-write primitive
+        trace_dir = request.app["ctx"].settings.jax_profile_dir
+
+        import asyncio as _aio
+        import jax
+
+        if request.app.get("_jax_profile_active"):
+            return web.json_response(
+                {"detail": "a profile capture is already running"}, status=409)
+        request.app["_jax_profile_active"] = True
+        try:
+            jax.profiler.start_trace(trace_dir)
+            try:
+                await _aio.sleep(duration_ms / 1000.0)
+            finally:
+                jax.profiler.stop_trace()
+        finally:
+            request.app["_jax_profile_active"] = False
+        return web.json_response({
+            "trace_dir": trace_dir, "duration_ms": duration_ms,
+            "decode_steps": engine.stats.decode_steps,
+            "prefill_batches": engine.stats.prefill_batches,
+            "hint": "open with TensorBoard or xprof: the trace contains"
+                    " XLA op timelines for prefill/decode"})
 
     @routes.get("/admin/traces/{trace_id}")
     async def admin_trace_tree(request: web.Request) -> web.Response:

@@ -110,9 +110,14 @@ class Tracer:
 
 
 def parse_traceparent(header: str) -> tuple[str, str] | None:
-    """Extract (trace_id, parent_span_id) from a W3C traceparent header."""
+    """Extract (trace_id, parent_span_id) from a W3C traceparent header.
+    Strictly lowercase-hex per spec — these ids are client-controlled and
+    flow into admin surfaces, so non-hex must never be adopted."""
     parts = header.strip().split("-")
     if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    hexdigits = set("0123456789abcdef")
+    if not (set(parts[1]) <= hexdigits and set(parts[2]) <= hexdigits):
         return None
     return parts[1], parts[2]
 

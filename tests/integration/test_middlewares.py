@@ -1,0 +1,97 @@
+"""New middleware tier: CORS, header-size guard, protocol-version check,
+proxy-forwarded identity (reference middleware stack, main.py:3259-3330)."""
+
+import aiohttp
+
+from test_gateway_app import BASIC, make_client
+
+AUTH = aiohttp.BasicAuth(*BASIC)
+
+
+async def test_cors_preflight_and_headers():
+    client = await make_client(cors_allowed_origins="https://app.example.com")
+    try:
+        resp = await client.options("/tools", headers={
+            "origin": "https://app.example.com",
+            "access-control-request-method": "GET"})
+        assert resp.status == 204
+        assert resp.headers["access-control-allow-origin"] == \
+            "https://app.example.com"
+        # disallowed origin gets no grant
+        resp = await client.options("/tools", headers={
+            "origin": "https://evil.example.com"})
+        assert "access-control-allow-origin" not in resp.headers
+        # simple request carries the grant
+        resp = await client.get("/health",
+                                headers={"origin": "https://app.example.com"})
+        assert resp.headers["access-control-allow-origin"] == \
+            "https://app.example.com"
+    finally:
+        await client.close()
+
+
+async def test_cors_disabled_by_default():
+    client = await make_client()
+    try:
+        resp = await client.get("/health", headers={"origin": "https://x.y"})
+        assert "access-control-allow-origin" not in resp.headers
+    finally:
+        await client.close()
+
+
+async def test_header_size_guard():
+    client = await make_client(max_header_bytes="512")
+    try:
+        resp = await client.get("/health")
+        assert resp.status == 200
+        resp = await client.get("/health", headers={"x-big": "v" * 600})
+        assert resp.status == 431
+    finally:
+        await client.close()
+
+
+async def test_protocol_version_check():
+    client = await make_client()
+    try:
+        resp = await client.post("/mcp", json={
+            "jsonrpc": "2.0", "id": 1, "method": "ping"},
+            headers={"mcp-protocol-version": "1999-01-01"}, auth=AUTH)
+        assert resp.status == 400
+        assert "Unsupported" in (await resp.json())["detail"]
+        resp = await client.post("/mcp", json={
+            "jsonrpc": "2.0", "id": 1, "method": "ping"},
+            headers={"mcp-protocol-version": "2025-06-18"}, auth=AUTH)
+        assert resp.status == 200
+        # non-MCP paths ignore the header entirely
+        resp = await client.get("/health",
+                                headers={"mcp-protocol-version": "1999-01-01"})
+        assert resp.status == 200
+    finally:
+        await client.close()
+
+
+async def test_forwarded_headers_require_trust():
+    # untrusted (default): X-Forwarded-For is ignored for rate identity
+    client = await make_client(rate_limit_rps="1", rate_limit_burst="2")
+    try:
+        hit = 0
+        for i in range(6):
+            resp = await client.get("/health", headers={
+                "x-forwarded-for": f"10.0.0.{i}"})
+            if resp.status == 429:
+                hit += 1
+        assert hit > 0  # spoofed identities did NOT reset the bucket
+    finally:
+        await client.close()
+    # trusted edge: forwarded identities get separate buckets
+    client = await make_client(rate_limit_rps="1", rate_limit_burst="2",
+                               trust_proxy_headers="true")
+    try:
+        statuses = []
+        for i in range(6):
+            resp = await client.get("/health", headers={
+                "x-forwarded-for": f"10.0.0.{i}"})
+            statuses.append(resp.status)
+        assert all(s == 200 for s in statuses), statuses
+    finally:
+        await client.close()
