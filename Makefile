@@ -33,6 +33,9 @@ bench-engine:
 wrapper:
 	g++ -O2 -std=c++17 mcp_context_forge_tpu/native/stdio_wrapper.cpp -o mcpforge-wrapper
 
+edge:
+	g++ -O2 -std=c++17 -pthread mcp_context_forge_tpu/native/mcp_edge.cpp -o mcpforge-edge
+
 masking:
 	g++ -O2 -shared -fPIC -std=c++17 mcp_context_forge_tpu/native/masking.cpp \
 	  -o mcp_context_forge_tpu/native/libmasking.so

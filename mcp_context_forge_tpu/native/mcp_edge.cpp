@@ -1,0 +1,721 @@
+// mcpforge-edge: native MCP HTTP edge (C++).
+//
+// The native-component parity item for the reference's Rust edge sidecar
+// (/root/reference/crates/mcp_runtime — public MCP HTTP edge that owns
+// HTTP/SSE parsing + JSON-RPC framing in front of the Python gateway;
+// SURVEY.md §2.6 names the C++ equivalent as the parity target). Scope of
+// this edge tier ("edge" mode, not the deprecated "full" mode):
+//
+// - terminates HTTP/1.1 (keep-alive) on the public port;
+// - validates JSON-RPC framing with an in-tree recursive-descent JSON
+//   parser BEFORE any Python work: malformed bodies are rejected here
+//   with -32700/-32600, so parse floods never reach the gateway;
+// - enforces a body-size cap and a header cap;
+// - serves /health locally;
+// - forwards valid traffic to the upstream gateway over per-worker
+//   keep-alive connections, streaming the response back byte-for-byte
+//   (SSE responses included — the edge does not buffer event streams).
+//
+// Threading: one acceptor + a fixed worker pool over a socket queue
+// (bounded; overload answers 503 immediately instead of queueing forever).
+//
+// Build: g++ -O2 -std=c++17 -pthread mcp_edge.cpp -o mcpforge-edge
+// Usage: mcpforge-edge <listen_port> <upstream_host> <upstream_port>
+//        [workers=8] [max_body=4194304]
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <iostream>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ------------------------------------------------------------- JSON check
+
+// Minimal recursive-descent JSON validator + top-level key probe. The edge
+// does not build a DOM — it only needs "is this valid JSON" and "does the
+// top-level object carry jsonrpc/method" to reject bad framing cheaply.
+class JsonScanner {
+ public:
+  explicit JsonScanner(const std::string& text) : s_(text) {}
+
+  bool valid() {
+    skip_ws();
+    if (!value(0)) return false;
+    skip_ws();
+    return pos_ == s_.size();
+  }
+
+  bool top_is_array() {
+    size_t i = 0;
+    while (i < s_.size() && (s_[i] == ' ' || s_[i] == '\t' || s_[i] == '\n' ||
+                             s_[i] == '\r'))
+      ++i;
+    return i < s_.size() && s_[i] == '[';
+  }
+
+  bool top_level_has(const std::string& key) {
+    // only meaningful after valid(); re-scan the top object shallowly
+    size_t save = pos_;
+    pos_ = 0;
+    skip_ws();
+    bool found = false;
+    if (pos_ < s_.size() && s_[pos_] == '{') {
+      ++pos_;
+      skip_ws();
+      while (pos_ < s_.size() && s_[pos_] != '}') {
+        std::string k;
+        if (!string_value(&k)) break;
+        skip_ws();
+        if (pos_ >= s_.size() || s_[pos_] != ':') break;
+        ++pos_;
+        skip_ws();
+        if (k == key) {
+          found = true;
+          break;
+        }
+        if (!value(1)) break;  // skip the value
+        skip_ws();
+        if (pos_ < s_.size() && s_[pos_] == ',') {
+          ++pos_;
+          skip_ws();
+        }
+      }
+    }
+    pos_ = save;
+    return found;
+  }
+
+ private:
+  static constexpr int kMaxDepth = 64;
+
+  void skip_ws() {
+    while (pos_ < s_.size() && (s_[pos_] == ' ' || s_[pos_] == '\t' ||
+                                s_[pos_] == '\n' || s_[pos_] == '\r'))
+      ++pos_;
+  }
+
+  bool literal(const char* lit) {
+    size_t len = std::strlen(lit);
+    if (s_.compare(pos_, len, lit) != 0) return false;
+    pos_ += len;
+    return true;
+  }
+
+  bool digits() {
+    size_t start = pos_;
+    while (pos_ < s_.size() && isdigit(static_cast<unsigned char>(s_[pos_])))
+      ++pos_;
+    return pos_ > start;
+  }
+
+  bool number() {
+    if (pos_ < s_.size() && s_[pos_] == '-') ++pos_;
+    if (!digits()) return false;  // "-" / "-." are not numbers
+    if (pos_ < s_.size() && s_[pos_] == '.') {
+      ++pos_;
+      if (!digits()) return false;  // "1." is not a number
+    }
+    if (pos_ < s_.size() && (s_[pos_] == 'e' || s_[pos_] == 'E')) {
+      ++pos_;
+      if (pos_ < s_.size() && (s_[pos_] == '+' || s_[pos_] == '-')) ++pos_;
+      if (!digits()) return false;  // "1e" is not a number
+    }
+    return true;
+  }
+
+  bool string_value(std::string* out = nullptr) {
+    if (pos_ >= s_.size() || s_[pos_] != '"') return false;
+    ++pos_;
+    while (pos_ < s_.size()) {
+      char c = s_[pos_];
+      if (c == '\\') {
+        if (pos_ + 1 >= s_.size()) return false;
+        char esc = s_[pos_ + 1];
+        if (esc == 'u') {
+          if (pos_ + 5 >= s_.size()) return false;
+          for (int i = 2; i <= 5; ++i)
+            if (!isxdigit(static_cast<unsigned char>(s_[pos_ + i]))) return false;
+          pos_ += 6;
+        } else if (std::strchr("\"\\/bfnrt", esc)) {
+          pos_ += 2;
+        } else {
+          return false;
+        }
+        continue;
+      }
+      if (c == '"') {
+        ++pos_;
+        return true;
+      }
+      if (static_cast<unsigned char>(c) < 0x20) return false;  // raw control
+      if (out) out->push_back(c);
+      ++pos_;
+    }
+    return false;
+  }
+
+  bool value(int depth) {
+    if (depth > kMaxDepth) return false;
+    if (pos_ >= s_.size()) return false;
+    char c = s_[pos_];
+    if (c == '{') {
+      ++pos_;
+      skip_ws();
+      if (pos_ < s_.size() && s_[pos_] == '}') {
+        ++pos_;
+        return true;
+      }
+      while (true) {
+        if (!string_value()) return false;
+        skip_ws();
+        if (pos_ >= s_.size() || s_[pos_] != ':') return false;
+        ++pos_;
+        skip_ws();
+        if (!value(depth + 1)) return false;
+        skip_ws();
+        if (pos_ < s_.size() && s_[pos_] == ',') {
+          ++pos_;
+          skip_ws();
+          continue;
+        }
+        break;
+      }
+      if (pos_ >= s_.size() || s_[pos_] != '}') return false;
+      ++pos_;
+      return true;
+    }
+    if (c == '[') {
+      ++pos_;
+      skip_ws();
+      if (pos_ < s_.size() && s_[pos_] == ']') {
+        ++pos_;
+        return true;
+      }
+      while (true) {
+        if (!value(depth + 1)) return false;
+        skip_ws();
+        if (pos_ < s_.size() && s_[pos_] == ',') {
+          ++pos_;
+          skip_ws();
+          continue;
+        }
+        break;
+      }
+      if (pos_ >= s_.size() || s_[pos_] != ']') return false;
+      ++pos_;
+      return true;
+    }
+    if (c == '"') return string_value();
+    if (c == 't') return literal("true");
+    if (c == 'f') return literal("false");
+    if (c == 'n') return literal("null");
+    return number();
+  }
+
+  const std::string& s_;
+  size_t pos_ = 0;
+};
+
+// --------------------------------------------------------------- sockets
+
+bool send_all(int fd, const char* data, size_t len) {
+  size_t sent = 0;
+  while (sent < len) {
+    ssize_t n = send(fd, data + sent, len - sent, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    sent += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool send_all(int fd, const std::string& data) {
+  return send_all(fd, data.data(), data.size());
+}
+
+int connect_to(const std::string& host, const std::string& port) {
+  addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* result = nullptr;
+  if (getaddrinfo(host.c_str(), port.c_str(), &hints, &result) != 0) return -1;
+  int fd = -1;
+  for (addrinfo* ai = result; ai; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(result);
+  if (fd >= 0) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  }
+  return fd;
+}
+
+// ------------------------------------------------------------- HTTP bits
+
+void set_recv_timeout(int fd, int seconds) {
+  timeval tv{};
+  tv.tv_sec = seconds;
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
+struct Header {
+  std::string name;   // lowercased
+  std::string value;  // trimmed
+};
+
+// Parse the header block LINE BY LINE — substring scans over the whole
+// block would let "X-Content-Length:" or folded Transfer-Encoding values
+// desync framing (request smuggling).
+bool parse_headers(const std::string& block, std::vector<Header>* out) {
+  size_t pos = 0;
+  while (pos < block.size()) {
+    size_t eol = block.find("\r\n", pos);
+    std::string line = block.substr(
+        pos, eol == std::string::npos ? std::string::npos : eol - pos);
+    pos = eol == std::string::npos ? block.size() : eol + 2;
+    if (line.empty()) continue;
+    size_t colon = line.find(':');
+    if (colon == std::string::npos) return false;
+    Header header;
+    for (size_t i = 0; i < colon; ++i)
+      header.name.push_back(
+          static_cast<char>(tolower(static_cast<unsigned char>(line[i]))));
+    size_t vstart = colon + 1;
+    while (vstart < line.size() && (line[vstart] == ' ' || line[vstart] == '\t'))
+      ++vstart;
+    header.value = line.substr(vstart);
+    while (!header.value.empty() &&
+           (header.value.back() == ' ' || header.value.back() == '\t'))
+      header.value.pop_back();
+    out->push_back(std::move(header));
+  }
+  return true;
+}
+
+const std::string* find_header(const std::vector<Header>& headers,
+                               const std::string& lowered_name) {
+  for (const auto& header : headers)
+    if (header.name == lowered_name) return &header.value;
+  return nullptr;
+}
+
+struct HttpRequest {
+  std::string method;
+  std::string path;
+  std::vector<Header> headers;
+  std::string body;
+  bool keep_alive = true;
+};
+
+// Reads one HTTP/1.1 request from fd (using and refilling `buffer`).
+// Returns 0 ok, -1 connection closed/error, 400/413/431 for protocol errors.
+int read_request(int fd, std::string& buffer, size_t max_body,
+                 HttpRequest* out) {
+  constexpr size_t kMaxHeader = 65536;
+  char chunk[8192];
+  size_t header_end;
+  while ((header_end = buffer.find("\r\n\r\n")) == std::string::npos) {
+    if (buffer.size() > kMaxHeader) return 431;
+    ssize_t n = recv(fd, chunk, sizeof(chunk), 0);
+    if (n <= 0) return -1;
+    buffer.append(chunk, static_cast<size_t>(n));
+  }
+  std::string head = buffer.substr(0, header_end);
+  size_t line_end = head.find("\r\n");
+  std::string request_line =
+      line_end == std::string::npos ? head : head.substr(0, line_end);
+  std::string header_block =
+      line_end == std::string::npos ? "" : head.substr(line_end + 2);
+
+  size_t sp1 = request_line.find(' ');
+  size_t sp2 = request_line.rfind(' ');
+  if (sp1 == std::string::npos || sp2 == sp1) return 400;
+  out->method = request_line.substr(0, sp1);
+  out->path = request_line.substr(sp1 + 1, sp2 - sp1 - 1);
+
+  out->headers.clear();
+  if (!parse_headers(header_block, &out->headers)) return 400;
+
+  // ANY Transfer-Encoding is rejected inbound: the edge frames strictly by
+  // Content-Length, and forwarding a TE header the edge ignored would be a
+  // CL/TE smuggling vector
+  if (find_header(out->headers, "transfer-encoding") != nullptr) return 400;
+
+  size_t content_length = 0;
+  int cl_seen = 0;
+  for (const auto& header : out->headers) {
+    if (header.name == "content-length") {
+      ++cl_seen;
+      char* end = nullptr;
+      content_length = std::strtoul(header.value.c_str(), &end, 10);
+      if (end == header.value.c_str() || (end && *end != '\0')) return 400;
+    }
+  }
+  if (cl_seen > 1) return 400;  // duplicate CL: ambiguous framing
+
+  out->keep_alive = true;
+  if (const std::string* conn = find_header(out->headers, "connection")) {
+    std::string lowered;
+    for (char c : *conn)
+      lowered.push_back(static_cast<char>(tolower(static_cast<unsigned char>(c))));
+    if (lowered.find("close") != std::string::npos) out->keep_alive = false;
+  }
+  if (content_length > max_body) return 413;
+
+  size_t body_start = header_end + 4;
+  while (buffer.size() - body_start < content_length) {
+    ssize_t n = recv(fd, chunk, sizeof(chunk), 0);
+    if (n <= 0) return -1;
+    buffer.append(chunk, static_cast<size_t>(n));
+  }
+  out->body = buffer.substr(body_start, content_length);
+  buffer.erase(0, body_start + content_length);
+  return 0;
+}
+
+void respond_json(int fd, int status, const std::string& status_text,
+                  const std::string& body, bool keep_alive) {
+  std::string response = "HTTP/1.1 " + std::to_string(status) + " " +
+                         status_text +
+                         "\r\ncontent-type: application/json\r\n"
+                         "content-length: " +
+                         std::to_string(body.size()) + "\r\n" +
+                         (keep_alive ? "" : "connection: close\r\n") + "\r\n" +
+                         body;
+  send_all(fd, response);
+}
+
+// ----------------------------------------------------------------- edge
+
+struct Config {
+  int listen_port;
+  std::string upstream_host;
+  std::string upstream_port;
+  int workers = 8;
+  size_t max_body = 4 * 1024 * 1024;
+};
+
+std::atomic<uint64_t> g_requests{0};
+std::atomic<uint64_t> g_rejected{0};
+
+enum class ProxyResult {
+  kOk,        // response relayed; both connections reusable
+  kFail,      // nothing sent to the client yet; caller may answer 502
+  kStreamed,  // bytes already on the wire; caller must just close
+};
+
+// Rebuild the forwarded header block: hop-by-hop headers dropped, Host
+// rewritten to the upstream, X-Forwarded-For appended with the client.
+std::string build_forward_headers(const HttpRequest& request,
+                                  const Config& config,
+                                  const std::string& client_ip) {
+  std::string block;
+  std::string existing_xff;
+  for (const auto& header : request.headers) {
+    if (header.name == "connection" || header.name == "keep-alive" ||
+        header.name == "proxy-connection" || header.name == "te" ||
+        header.name == "transfer-encoding" || header.name == "upgrade" ||
+        header.name == "host" || header.name == "content-length") {
+      continue;  // hop-by-hop / rewritten below (CL re-emitted from body size)
+    }
+    if (header.name == "x-forwarded-for") {
+      existing_xff = header.value;
+      continue;
+    }
+    block += header.name + ": " + header.value + "\r\n";
+  }
+  block += "host: " + config.upstream_host + ":" + config.upstream_port +
+           "\r\n";
+  block += "x-forwarded-for: " +
+           (existing_xff.empty() ? client_ip : existing_xff + ", " + client_ip) +
+           "\r\n";
+  block += "connection: keep-alive\r\n";
+  return block;
+}
+
+// Streams the upstream response for one request back to the client.
+// Keep-alive per worker thread: `upstream_fd` persists across requests.
+ProxyResult proxy_request(int client_fd, int& upstream_fd, const Config& config,
+                          const HttpRequest& request,
+                          const std::string& client_ip) {
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    if (upstream_fd < 0) {
+      upstream_fd = connect_to(config.upstream_host, config.upstream_port);
+      if (upstream_fd >= 0) set_recv_timeout(upstream_fd, 120);
+    }
+    if (upstream_fd < 0) return ProxyResult::kFail;
+
+    std::string forwarded =
+        request.method + " " + request.path + " HTTP/1.1\r\n" +
+        build_forward_headers(request, config, client_ip) +
+        "content-length: " + std::to_string(request.body.size()) + "\r\n" +
+        "\r\n" + request.body;
+    if (!send_all(upstream_fd, forwarded)) {
+      close(upstream_fd);
+      upstream_fd = -1;
+      continue;  // stale keep-alive: reconnect once
+    }
+
+    // stream the response: parse just enough to know when it ends
+    std::string buffer;
+    char chunk[16384];
+    size_t header_end;
+    bool got_any = false;
+    while ((header_end = buffer.find("\r\n\r\n")) == std::string::npos) {
+      ssize_t n = recv(upstream_fd, chunk, sizeof(chunk), 0);
+      if (n <= 0) break;
+      got_any = true;
+      buffer.append(chunk, static_cast<size_t>(n));
+    }
+    if (header_end == std::string::npos) {
+      close(upstream_fd);
+      upstream_fd = -1;
+      if (!got_any && attempt == 0) continue;  // retry once on dead socket
+      return ProxyResult::kFail;
+    }
+
+    // status code + response headers (line-parsed, not substring-scanned)
+    int status = 0;
+    {
+      size_t sp = buffer.find(' ');
+      if (sp != std::string::npos && sp + 3 < buffer.size())
+        status = std::atoi(buffer.c_str() + sp + 1);
+    }
+    std::vector<Header> resp_headers;
+    size_t first_line_end = buffer.find("\r\n");
+    parse_headers(buffer.substr(first_line_end + 2,
+                                header_end - first_line_end - 2),
+                  &resp_headers);
+    const std::string* cl_value = find_header(resp_headers, "content-length");
+    const std::string* te_value = find_header(resp_headers, "transfer-encoding");
+    const std::string* ct_value = find_header(resp_headers, "content-type");
+    bool chunked = te_value != nullptr &&
+                   te_value->find("chunked") != std::string::npos;
+    bool sse = ct_value != nullptr &&
+               ct_value->rfind("text/event-stream", 0) == 0;
+    // responses that carry NO body regardless of headers (RFC 9110)
+    bool bodiless = request.method == "HEAD" || status == 204 ||
+                    status == 304 || (status >= 100 && status < 200);
+
+    if (!send_all(client_fd, buffer.substr(0, header_end + 4))) {
+      close(upstream_fd);
+      upstream_fd = -1;
+      return ProxyResult::kStreamed;
+    }
+    std::string extra = buffer.substr(header_end + 4);
+
+    if (bodiless) {
+      // nothing further to relay; upstream connection stays reusable
+      return ProxyResult::kOk;
+    }
+
+    if (sse || chunked || cl_value == nullptr) {
+      // stream until upstream closes (SSE / unknown length); this consumes
+      // the upstream connection — and the client one
+      if (!extra.empty()) send_all(client_fd, extra);
+      while (true) {
+        ssize_t n = recv(upstream_fd, chunk, sizeof(chunk), 0);
+        if (n <= 0) break;
+        if (!send_all(client_fd, chunk, static_cast<size_t>(n))) break;
+      }
+      close(upstream_fd);
+      upstream_fd = -1;
+      return ProxyResult::kStreamed;
+    }
+
+    size_t content_length = std::strtoul(cl_value->c_str(), nullptr, 10);
+    if (!extra.empty() && !send_all(client_fd, extra)) {
+      close(upstream_fd);
+      upstream_fd = -1;
+      return ProxyResult::kStreamed;
+    }
+    size_t have = extra.size();
+    while (have < content_length) {
+      ssize_t n = recv(upstream_fd, chunk, sizeof(chunk), 0);
+      if (n <= 0) {
+        close(upstream_fd);
+        upstream_fd = -1;
+        return ProxyResult::kStreamed;
+      }
+      if (!send_all(client_fd, chunk, static_cast<size_t>(n))) {
+        close(upstream_fd);
+        upstream_fd = -1;
+        return ProxyResult::kStreamed;
+      }
+      have += static_cast<size_t>(n);
+    }
+    return ProxyResult::kOk;
+  }
+  return ProxyResult::kFail;
+}
+
+void handle_connection(int client_fd, const Config& config) {
+  // slowloris guard: an idle client may hold a worker for at most 30s
+  set_recv_timeout(client_fd, 30);
+  std::string client_ip = "unknown";
+  {
+    sockaddr_storage peer{};
+    socklen_t len = sizeof(peer);
+    char host[NI_MAXHOST];
+    if (getpeername(client_fd, reinterpret_cast<sockaddr*>(&peer), &len) == 0 &&
+        getnameinfo(reinterpret_cast<sockaddr*>(&peer), len, host, sizeof(host),
+                    nullptr, 0, NI_NUMERICHOST) == 0)
+      client_ip = host;
+  }
+  int upstream_fd = -1;
+  std::string buffer;
+  while (true) {
+    HttpRequest request;
+    int rc = read_request(client_fd, buffer, config.max_body, &request);
+    if (rc == -1) break;
+    if (rc == 400 || rc == 413 || rc == 431) {
+      g_rejected.fetch_add(1);
+      respond_json(client_fd, rc, rc == 413 ? "Payload Too Large"
+                                            : rc == 431 ? "Headers Too Large"
+                                                        : "Bad Request",
+                   "{\"detail\": \"rejected at edge\"}", false);
+      break;
+    }
+    g_requests.fetch_add(1);
+
+    if (request.path == "/health" || request.path == "/edge/health") {
+      respond_json(client_fd, 200, "OK",
+                   "{\"status\": \"healthy\", \"tier\": \"edge\","
+                   " \"requests\": " + std::to_string(g_requests.load()) +
+                   ", \"rejected\": " + std::to_string(g_rejected.load()) + "}",
+                   request.keep_alive);
+      if (!request.keep_alive) break;
+      continue;
+    }
+
+    // JSON-RPC framing enforcement for MCP ingress paths
+    bool rpc_path = request.method == "POST" &&
+                    (request.path.rfind("/mcp", 0) == 0 ||
+                     request.path.rfind("/rpc", 0) == 0 ||
+                     request.path.rfind("/servers/", 0) == 0);
+    if (rpc_path) {
+      JsonScanner scanner(request.body);
+      if (!scanner.valid()) {
+        g_rejected.fetch_add(1);
+        respond_json(client_fd, 400, "Bad Request",
+                     "{\"jsonrpc\": \"2.0\", \"id\": null, \"error\":"
+                     " {\"code\": -32700, \"message\": \"Parse error"
+                     " (rejected at edge)\"}}",
+                     request.keep_alive);
+        if (!request.keep_alive) break;
+        continue;
+      }
+      if (!scanner.top_is_array() &&  // batches validate per-element upstream
+          !scanner.top_level_has("jsonrpc") && !scanner.top_level_has("method")) {
+        g_rejected.fetch_add(1);
+        respond_json(client_fd, 400, "Bad Request",
+                     "{\"jsonrpc\": \"2.0\", \"id\": null, \"error\":"
+                     " {\"code\": -32600, \"message\": \"Invalid Request"
+                     " (rejected at edge)\"}}",
+                     request.keep_alive);
+        if (!request.keep_alive) break;
+        continue;
+      }
+    }
+
+    ProxyResult result =
+        proxy_request(client_fd, upstream_fd, config, request, client_ip);
+    if (result == ProxyResult::kFail) {
+      // nothing was sent yet: a clean 502 is safe
+      respond_json(client_fd, 502, "Bad Gateway",
+                   "{\"detail\": \"upstream unavailable\"}", false);
+      break;
+    }
+    if (result == ProxyResult::kStreamed) break;  // never append to a stream
+    if (!request.keep_alive) break;
+  }
+  if (upstream_fd >= 0) close(upstream_fd);
+  close(client_fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::cerr << "usage: mcpforge-edge <listen_port> <upstream_host>"
+                 " <upstream_port> [workers] [max_body]\n";
+    return 2;
+  }
+  Config config;
+  config.listen_port = std::atoi(argv[1]);
+  config.upstream_host = argv[2];
+  config.upstream_port = argv[3];
+  if (argc > 4) config.workers = std::atoi(argv[4]);
+  if (argc > 5) config.max_body = std::strtoul(argv[5], nullptr, 10);
+
+  int listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(config.listen_port));
+  if (bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(listen_fd, 128) != 0) {
+    perror("bind/listen");
+    return 1;
+  }
+  std::cerr << "mcpforge-edge listening on :" << config.listen_port
+            << " -> " << config.upstream_host << ":" << config.upstream_port
+            << " (" << config.workers << " workers)\n";
+
+  // fixed worker pool over a bounded queue; overload answers 503 directly
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<int> queue;
+  const size_t kQueueCap = 256;
+  std::vector<std::thread> workers;
+  for (int i = 0; i < config.workers; ++i) {
+    workers.emplace_back([&] {
+      while (true) {
+        int fd;
+        {
+          std::unique_lock<std::mutex> lock(mu);
+          cv.wait(lock, [&] { return !queue.empty(); });
+          fd = queue.front();
+          queue.pop_front();
+        }
+        if (fd < 0) return;
+        handle_connection(fd, config);
+      }
+    });
+  }
+
+  while (true) {
+    int client_fd = accept(listen_fd, nullptr, nullptr);
+    if (client_fd < 0) continue;
+    {
+      std::lock_guard<std::mutex> lock(mu);
+      if (queue.size() >= kQueueCap) {
+        respond_json(client_fd, 503, "Service Unavailable",
+                     "{\"detail\": \"edge overloaded\"}", false);
+        close(client_fd);
+        continue;
+      }
+      queue.push_back(client_fd);
+    }
+    cv.notify_one();
+  }
+}

@@ -1,0 +1,185 @@
+"""C++ MCP edge in front of the real gateway (SURVEY.md §2.6 native-edge
+parity item; reference crates/mcp_runtime 'edge' mode): JSON-RPC framing
+enforced natively, valid traffic proxied with keep-alive, SSE streamed."""
+
+import asyncio
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import aiohttp
+import pytest
+
+from test_gateway_app import BASIC, make_client
+
+AUTH = aiohttp.BasicAuth(*BASIC)
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+EDGE_BIN = os.path.join(REPO, "mcpforge-edge")
+
+
+def _free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+async def _edge_for(gateway, *extra_args):
+    if not os.path.exists(EDGE_BIN):
+        build = subprocess.run(["make", "edge"], cwd=REPO, capture_output=True)
+        if build.returncode != 0:
+            pytest.skip("edge binary build failed (no g++?)")
+    port = _free_port()
+    proc = subprocess.Popen(
+        [EDGE_BIN, str(port), str(gateway.server.host),
+         str(gateway.server.port), *extra_args],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = time.monotonic() + 10
+    async with aiohttp.ClientSession() as session:
+        while time.monotonic() < deadline:
+            try:
+                resp = await session.get(f"http://127.0.0.1:{port}/edge/health")
+                if resp.status == 200:
+                    return proc, port
+            except aiohttp.ClientError:
+                await asyncio.sleep(0.1)
+    proc.kill()
+    raise TimeoutError("edge never became healthy")
+
+
+async def test_edge_proxies_and_enforces_framing():
+    gateway = await make_client()
+    proc, port = await _edge_for(gateway)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        async with aiohttp.ClientSession() as session:
+            # local health (never touches python)
+            resp = await session.get(f"{base}/edge/health")
+            body = await resp.json()
+            assert body["tier"] == "edge"
+
+            # proxied REST GET through to the gateway
+            resp = await session.get(f"{base}/version")
+            assert resp.status == 200
+            assert "version" in await resp.json()
+
+            # valid JSON-RPC passes through (auth handled by the gateway)
+            resp = await session.post(f"{base}/rpc", json={
+                "jsonrpc": "2.0", "id": 1, "method": "tools/list"}, auth=AUTH)
+            assert resp.status == 200
+            assert "result" in await resp.json()
+
+            # malformed JSON rejected AT THE EDGE with -32700
+            resp = await session.post(
+                f"{base}/rpc", data=b'{"jsonrpc": "2.0", "id": 1,,}',
+                headers={"content-type": "application/json"}, auth=AUTH)
+            assert resp.status == 400
+            body = await resp.json()
+            assert body["error"]["code"] == -32700
+            assert "edge" in body["error"]["message"]
+
+            # structurally-valid JSON that is not JSON-RPC: -32600 at edge
+            resp = await session.post(
+                f"{base}/rpc", data=b'{"hello": "world"}',
+                headers={"content-type": "application/json"}, auth=AUTH)
+            assert (await resp.json())["error"]["code"] == -32600
+
+            # keep-alive: several requests on one session still work
+            for i in range(5):
+                resp = await session.post(f"{base}/rpc", json={
+                    "jsonrpc": "2.0", "id": i, "method": "ping"}, auth=AUTH)
+                assert resp.status == 200
+
+            # rejected traffic shows up in edge counters
+            resp = await session.get(f"{base}/edge/health")
+            stats = await resp.json()
+            assert stats["rejected"] >= 2
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+        await gateway.close()
+
+
+async def test_edge_concurrent_clients():
+    gateway = await make_client()
+    proc, port = await _edge_for(gateway)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        async with aiohttp.ClientSession() as session:
+            async def one(i):
+                resp = await session.post(f"{base}/rpc", json={
+                    "jsonrpc": "2.0", "id": i, "method": "ping"}, auth=AUTH)
+                return resp.status
+
+            results = await asyncio.gather(*[one(i) for i in range(64)])
+            assert all(s == 200 for s in results)
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+        await gateway.close()
+
+
+async def test_edge_oversized_body_rejected():
+    gateway = await make_client()
+    proc, port = await _edge_for(gateway, "4", "1024")  # 1 KB body cap
+    try:
+        async with aiohttp.ClientSession() as session:
+            resp = await session.post(
+                f"http://127.0.0.1:{port}/rpc", data=b"x" * 4096,
+                headers={"content-type": "application/json"})
+            assert resp.status == 413
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+        await gateway.close()
+
+
+async def test_edge_framing_hardening():
+    """Smuggling-class inputs rejected; batches + HEAD handled correctly."""
+    gateway = await make_client()
+    proc, port = await _edge_for(gateway)
+    try:
+        # raw socket: aiohttp client would refuse to send these
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+
+        async def raw(request: bytes) -> bytes:
+            writer.write(request)
+            await writer.drain()
+            return await asyncio.wait_for(reader.read(4096), timeout=10)
+
+        # Transfer-Encoding inbound -> 400 at the edge (CL/TE desync guard)
+        out = await raw(b"POST /rpc HTTP/1.1\r\nhost: x\r\n"
+                        b"transfer-encoding: chunked\r\n\r\n"
+                        b"0\r\n\r\n")
+        assert b"400" in out.split(b"\r\n")[0]
+        writer.close()
+
+        # duplicate Content-Length -> 400
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        out = await raw(b"POST /rpc HTTP/1.1\r\nhost: x\r\n"
+                        b"content-length: 2\r\ncontent-length: 4\r\n\r\n{}")
+        assert b"400" in out.split(b"\r\n")[0]
+        writer.close()
+
+        async with aiohttp.ClientSession() as session:
+            # JSON-RPC batch (top-level array) passes the edge to the gateway
+            resp = await session.post(
+                f"http://127.0.0.1:{port}/rpc",
+                json=[{"jsonrpc": "2.0", "id": 1, "method": "ping"}],
+                auth=AUTH)
+            assert resp.status != 400 or \
+                (await resp.json()).get("error", {}).get("code") != -32600
+
+            # HEAD does not hang the worker
+            resp = await asyncio.wait_for(
+                session.head(f"http://127.0.0.1:{port}/version"), timeout=10)
+            assert resp.status in (200, 405)
+
+            # edge still healthy afterwards (workers not wedged)
+            resp = await session.get(f"http://127.0.0.1:{port}/edge/health")
+            assert resp.status == 200
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+        await gateway.close()
