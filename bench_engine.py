@@ -48,11 +48,15 @@ async def run(platform: str) -> dict:
         "BENCH_MODEL", "llama3-1b" if platform == "tpu" else "llama3-tiny")
     clients = int(os.environ.get("BENCH_CLIENTS", "8"))
     max_tokens = int(os.environ.get("BENCH_TOKENS", "32"))
+    # multi-step decode dispatch pays off where the per-token host sync is
+    # the bottleneck (TPU): default 4 there, 1 on CPU (compute-bound)
+    decode_block = int(os.environ.get("BENCH_DECODE_BLOCK",
+                                      "4" if platform == "tpu" else "1"))
     config = EngineConfig(model=model, max_batch=min(clients, 16),
                           max_seq_len=512, page_size=16, num_pages=512,
                           prefill_buckets=(64,),
                           dtype="bfloat16" if platform == "tpu" else "float32",
-                          attn_impl="auto")
+                          attn_impl="auto", decode_block=decode_block)
     engine = TPUEngine(config)
     await engine.start()
     try:

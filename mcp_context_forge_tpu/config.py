@@ -138,6 +138,7 @@ class Settings(BaseModel):
     tpu_local_mesh_shape: str = ""  # 'DxM' (e.g. 1x8 on v5e-8); '' = auto (1 x all devices)
     tpu_local_sp_impl: Literal["none", "ring", "ulysses"] = "none"
     tpu_local_sp_threshold: int = 1024  # prefill BUCKETS > this use SP prefill
+    tpu_local_decode_block: int = 1     # decode steps fused per dispatch
     tpu_local_dtype: str = "bfloat16"
     tpu_local_embedding_model: str = "encoder-tiny"
 

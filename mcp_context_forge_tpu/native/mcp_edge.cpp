@@ -275,6 +275,13 @@ void set_recv_timeout(int fd, int seconds) {
   setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
 }
 
+void set_send_timeout(int fd, int seconds) {
+  // a client that stops READING must not wedge a worker in send()
+  timeval tv{};
+  tv.tv_sec = seconds;
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+}
+
 struct Header {
   std::string name;   // lowercased
   std::string value;  // trimmed
@@ -458,7 +465,10 @@ ProxyResult proxy_request(int client_fd, int& upstream_fd, const Config& config,
   for (int attempt = 0; attempt < 2; ++attempt) {
     if (upstream_fd < 0) {
       upstream_fd = connect_to(config.upstream_host, config.upstream_port);
-      if (upstream_fd >= 0) set_recv_timeout(upstream_fd, 120);
+      if (upstream_fd >= 0) {
+        set_recv_timeout(upstream_fd, 120);
+        set_send_timeout(upstream_fd, 30);
+      }
     }
     if (upstream_fd < 0) return ProxyResult::kFail;
 
@@ -528,7 +538,11 @@ ProxyResult proxy_request(int client_fd, int& upstream_fd, const Config& config,
 
     if (sse || chunked || cl_value == nullptr) {
       // stream until upstream closes (SSE / unknown length); this consumes
-      // the upstream connection — and the client one
+      // the upstream connection — and the client one. SSE streams may be
+      // quiet far longer than the request/response timeout: the gateway
+      // sends keepalives every sse_keepalive_interval (30s default), so a
+      // 10-minute idle cap only reaps genuinely dead streams
+      if (sse) set_recv_timeout(upstream_fd, 600);
       if (!extra.empty()) send_all(client_fd, extra);
       while (true) {
         ssize_t n = recv(upstream_fd, chunk, sizeof(chunk), 0);
@@ -567,8 +581,10 @@ ProxyResult proxy_request(int client_fd, int& upstream_fd, const Config& config,
 }
 
 void handle_connection(int client_fd, const Config& config) {
-  // slowloris guard: an idle client may hold a worker for at most 30s
+  // slowloris guard: an idle client may hold a worker for at most 30s on
+  // reads and 30s on writes (a non-reading client blocks send() otherwise)
   set_recv_timeout(client_fd, 30);
+  set_send_timeout(client_fd, 30);
   std::string client_ip = "unknown";
   {
     sockaddr_storage peer{};

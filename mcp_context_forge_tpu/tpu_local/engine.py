@@ -65,6 +65,10 @@ class EngineConfig:
     # through ring/ulysses attention over the mesh (SURVEY.md §5.7)
     sp_impl: str = "none"      # none|ring|ulysses
     sp_threshold: int = 1024
+    # decode steps fused per device dispatch (lax.scan): amortizes the
+    # host<->device sync to 1/k per token; tokens decoded past EOS inside a
+    # block are discarded (standard multi-step scheduling waste)
+    decode_block: int = 1
 
     @classmethod
     def from_settings(cls, settings) -> "EngineConfig":
@@ -81,6 +85,7 @@ class EngineConfig:
             dtype=settings.tpu_local_dtype,
             sp_impl=getattr(settings, "tpu_local_sp_impl", "none"),
             sp_threshold=getattr(settings, "tpu_local_sp_threshold", 1024),
+            decode_block=getattr(settings, "tpu_local_decode_block", 1),
         )
 
 
@@ -121,6 +126,9 @@ class TPUEngine:
     thread, token emission hops back to the asyncio loop."""
 
     def __init__(self, config: EngineConfig):
+        if config.decode_block < 1:
+            raise ValueError(
+                f"decode_block must be >= 1, got {config.decode_block}")
         self.config = config
         self.model_config: LlamaConfig = MODEL_CONFIGS[config.model]
         self.tokenizer = load_tokenizer(config.checkpoint,
@@ -206,10 +214,23 @@ class TPUEngine:
 
     def _decode_and_sample(self, params, kv, tokens, positions, slot_ids,
                            seq_lens, sampling: SamplingParams, key):
-        logits, kv = decode_step(params, self.model_config, tokens, positions,
-                                 kv, slot_ids, seq_lens)
-        next_tokens = sample_tokens(logits, sampling, key)
-        return next_tokens, kv
+        """k fused decode steps via lax.scan (k = config.decode_block):
+        one dispatch + one device_get per k tokens. Returns ([k, B] tokens,
+        kv)."""
+        k = self.config.decode_block
+
+        def step(carry, step_key):
+            step_tokens, step_positions, step_lens, step_kv = carry
+            logits, step_kv = decode_step(params, self.model_config,
+                                          step_tokens, step_positions, step_kv,
+                                          slot_ids, step_lens)
+            sampled = sample_tokens(logits, sampling, step_key)
+            return (sampled, step_positions + 1, step_lens + 1, step_kv), sampled
+
+        keys = jax.random.split(key, k)
+        (_, _, _, kv), all_tokens = jax.lax.scan(
+            step, (tokens, positions, seq_lens, kv), keys)
+        return all_tokens, kv
 
     # --------------------------------------------------------------- lifecycle
 
@@ -433,7 +454,11 @@ class TPUEngine:
         temperature = np.zeros((B,), dtype=np.float32)
         top_k = np.zeros((B,), dtype=np.int32)
         top_p = np.ones((B,), dtype=np.float32)
+        k = config.decode_block
         active = list(self._running.items())
+        # per-slot budget within this block: page capacity and max_tokens cap
+        # how many of the k decoded tokens are usable
+        budgets: dict[int, int] = {}
         for slot, request in active:
             # n_ctx counts every token that exists (prompt + generated); the
             # last generated token is the incoming input: it sits at 0-based
@@ -446,22 +471,36 @@ class TPUEngine:
             temperature[slot] = request.temperature
             top_k[slot] = request.top_k
             top_p[slot] = request.top_p
-            if not self.allocator.extend_slot(slot, n_ctx):
+            # extend pages as far as the block can reach; writes beyond the
+            # allocated range land on the reserved trash page and their
+            # tokens are discarded via the budget
+            remaining = max(0, request.max_tokens - len(request.generated))
+            usable = 0
+            for step_i in range(min(k, remaining)):
+                if self.allocator.extend_slot(slot, n_ctx + step_i):
+                    usable = step_i + 1
+                else:
+                    break
+            budgets[slot] = usable
+            if usable == 0:
                 request.finish_reason = "length"
         self._sync_tables()
         sampling = SamplingParams(jnp.asarray(temperature), jnp.asarray(top_k),
                                   jnp.asarray(top_p))
         self._rng, key = jax.random.split(self._rng)
-        next_tokens, self.kv = self._decode(
+        block_tokens, self.kv = self._decode(
             self.params, self.kv, jnp.asarray(tokens), jnp.asarray(positions),
             jnp.arange(B, dtype=jnp.int32), jnp.asarray(seq_lens), sampling, key)
-        self.stats.decode_steps += 1
-        next_host = jax.device_get(next_tokens)
+        self.stats.decode_steps += k
+        block_host = jax.device_get(block_tokens)  # [k, B]
         for slot, request in active:
             if request.finish_reason == "length" and request.slot in self._running:
                 self._finish(request)
                 continue
-            self._emit(request, int(next_host[slot]))
+            for step_i in range(budgets[slot]):
+                self._emit(request, int(block_host[step_i][slot]))
+                if request.slot not in self._running:
+                    break  # finished (EOS/stop/max): rest of block discarded
 
     # ---------------------------------------------------------------- plumbing
 
