@@ -1,0 +1,133 @@
+"""Fault injection (SURVEY.md §5.3: failure detection / recovery).
+
+- engine dispatch-thread crash: outstanding requests fail fast (no hang),
+  the engine refuses new work, and a stop/start cycle restores service;
+- federation peer flap: health loop deactivates an unreachable peer and
+  reactivates it when it comes back.
+"""
+
+import asyncio
+import sys
+from pathlib import Path
+
+import aiohttp
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "integration"))
+
+from mcp_context_forge_tpu.tpu_local.engine import (EngineConfig, GenRequest,
+                                                    TPUEngine)
+
+
+def _engine() -> TPUEngine:
+    return TPUEngine(EngineConfig(
+        model="llama3-test", max_batch=2, max_seq_len=64, page_size=16,
+        num_pages=32, prefill_buckets=(16,), dtype="float32",
+        attn_impl="reference"))
+
+
+def test_engine_crash_fails_fast_and_recovers():
+    engine = _engine()
+
+    async def main():
+        await engine.start()
+        ids = engine.tokenizer.encode("ok")
+        # healthy round first (compiles)
+        out = [t async for t in engine.generate(ids, max_tokens=2)]
+        assert out
+
+        # inject: decode dispatch raises -> dispatch thread dies
+        real_decode = engine._decode
+
+        def boom(*args, **kwargs):
+            raise RuntimeError("injected device fault")
+
+        engine._decode = boom
+        broken = [t async for t in engine.generate(ids, max_tokens=4)]
+        # stream terminated (no hang); prefill token may have been emitted
+        assert len(broken) <= 1
+
+        # engine now refuses new submissions instead of queueing forever
+        await asyncio.sleep(0.1)
+        with pytest.raises(RuntimeError):
+            await engine.submit(GenRequest(request_id="x", prompt_ids=ids))
+
+        # recovery: restart the dispatch thread with the fault removed
+        engine._decode = real_decode
+        await engine.stop()
+        await engine.start()
+        healed = [t async for t in engine.generate(ids, max_tokens=3)]
+        assert len(healed) >= 1
+        assert engine.allocator.pages_in_use == 0
+        await engine.stop()
+
+    asyncio.run(main())
+
+
+async def test_peer_flap_deactivates_and_reactivates():
+    from test_gateway_app import BASIC, make_client
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    auth = aiohttp.BasicAuth(*BASIC)
+
+    # a peer MCP endpoint we can switch between healthy and failing
+    state = {"up": True}
+    peer = web.Application()
+
+    async def mcp(request: web.Request) -> web.Response:
+        if not state["up"]:
+            return web.Response(status=503)
+        body = await request.json()
+        rid = body.get("id")
+        method = body.get("method", "")
+        if method == "initialize":
+            result = {"protocolVersion": "2025-06-18", "capabilities": {},
+                      "serverInfo": {"name": "flappy", "version": "0"}}
+        elif method in ("ping",):
+            result = {}
+        elif method.endswith("/list"):
+            key = method.split("/")[0]
+            result = {key: []}
+        else:
+            result = {}
+        return web.json_response({"jsonrpc": "2.0", "id": rid, "result": result})
+
+    peer.router.add_post("/mcp", mcp)
+    peer_client = TestClient(TestServer(peer))
+    await peer_client.start_server()
+
+    gateway = await make_client()
+    try:
+        url = f"http://{peer_client.server.host}:{peer_client.server.port}/mcp"
+        resp = await gateway.post("/gateways", json={
+            "name": "flappy", "url": url, "transport": "streamablehttp"},
+            auth=auth)
+        assert resp.status == 201, await resp.text()
+
+        service = gateway.app["gateway_service"]
+
+        async def flappy_state():
+            resp = await gateway.get("/gateways?include_inactive=true",
+                                     auth=auth)
+            return [g for g in await resp.json()
+                    if g["name"] == "flappy"][0]
+
+        # peer goes down -> health loop marks unreachable
+        state["up"] = False
+        for _ in range(5):
+            await service.check_health_of_gateways()
+            if not (await flappy_state())["reachable"]:
+                break
+        assert (await flappy_state())["reachable"] is False
+
+        # peer recovers -> reactivated
+        state["up"] = True
+        for _ in range(5):
+            await service.check_health_of_gateways()
+            if (await flappy_state())["reachable"]:
+                break
+        assert (await flappy_state())["reachable"] is True
+    finally:
+        await gateway.close()
+        await peer_client.close()
