@@ -205,11 +205,40 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     bus.subscribe("prompts.changed",
                   _notify("notifications/prompts/list_changed"))
     app["streamable_transport"] = transport
-    app.router.add_post("/mcp", transport.handle_post)
-    app.router.add_get("/mcp", transport.handle_get)
-    app.router.add_delete("/mcp", transport.handle_delete)
-    app.router.add_post("/servers/{server_id}/mcp", transport.handle_post)
-    app.router.add_get("/servers/{server_id}/mcp", transport.handle_get)
+    # swappable /mcp ingress (ADR 051) + runtime-mutable mode
+    from .ingress import IngressMount
+    ingress = IngressMount(ctx)
+    ingress.register("python", {"post": transport.handle_post,
+                                "get": transport.handle_get,
+                                "delete": transport.handle_delete})
+    ingress.subscribe()
+    await ingress.load()  # adopt the cluster's persisted mode at boot
+    app["ingress"] = ingress
+    app.router.add_post("/mcp", ingress.handler("post"))
+    app.router.add_get("/mcp", ingress.handler("get"))
+    app.router.add_delete("/mcp", ingress.handler("delete"))
+    app.router.add_post("/servers/{server_id}/mcp", ingress.handler("post"))
+    app.router.add_get("/servers/{server_id}/mcp", ingress.handler("get"))
+
+    async def ingress_status(request: web.Request) -> web.Response:
+        request["auth"].require("observability.read")
+        return web.json_response({"mode": ingress.mode,
+                                  "version": ingress.version,
+                                  "available": ingress.names(),
+                                  "changed_at": ingress.changed_at})
+
+    async def ingress_set(request: web.Request) -> web.Response:
+        request["auth"].require("admin.all")
+        body = await request.json()
+        if not isinstance(body, dict):
+            return web.json_response({"detail": "body must be an object"},
+                                     status=422)
+        await ingress.set_mode(body.get("mode", ""))
+        return web.json_response({"mode": ingress.mode,
+                                  "version": ingress.version})
+
+    app.router.add_get("/admin/ingress", ingress_status)
+    app.router.add_post("/admin/ingress", ingress_set)
 
     from .transports.ws_sse import LegacySSETransport, WebSocketTransport
     ws_transport = WebSocketTransport(dispatcher, settings)

@@ -1,0 +1,131 @@
+"""Swappable /mcp ingress mount + cluster-wide runtime mode.
+
+Reference: ADR 051 (`transports/mcp_ingress_mount.py` — a registry of named
+ASGI apps + a selector for the public /mcp) and `runtime_state.py` (ingress
+mode switched at runtime, Redis-propagated, versioned). Here:
+
+- ``IngressMount`` — named handler sets ("python" = in-tree streamable-HTTP
+  transport; "drain" = 503 + Retry-After for rolling maintenance); the
+  active name is runtime-mutable.
+- Mode changes publish on the ``ingress.mode`` bus topic with a version
+  counter, so every worker (memory/file/TCP-hub bus alike) converges on the
+  same mode without restart — the reference's cluster-wide override.
+- The C++ edge tier (native/mcp_edge.cpp) sits IN FRONT of whichever
+  ingress is selected; "drain" therefore drains edge traffic too.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Awaitable, Callable
+
+from aiohttp import web
+
+logger = logging.getLogger(__name__)
+
+Handler = Callable[[web.Request], Awaitable[web.StreamResponse]]
+
+
+class IngressMount:
+    def __init__(self, ctx) -> None:
+        self.ctx = ctx
+        self._ingresses: dict[str, dict[str, Handler]] = {}
+        self.mode = "python"
+        self.version = 0
+        self.changed_at: float | None = None
+        self._register_drain()
+
+    # ------------------------------------------------------------- registry
+
+    def register(self, name: str, handlers: dict[str, Handler]) -> None:
+        """handlers: {"post": ..., "get": ..., "delete": ...}."""
+        self._ingresses[name] = handlers
+
+    def names(self) -> list[str]:
+        return sorted(self._ingresses)
+
+    def _register_drain(self) -> None:
+        async def drain(request: web.Request) -> web.StreamResponse:
+            return web.json_response(
+                {"detail": "MCP ingress is draining for maintenance"},
+                status=503, headers={"retry-after": "10"})
+
+        self.register("drain", {"post": drain, "get": drain, "delete": drain})
+
+    # ----------------------------------------------------------------- mode
+
+    _DB_KEY = "ingress_mode"
+
+    async def load(self) -> None:
+        """Adopt the cluster's persisted mode at boot — a restarted worker
+        must not silently un-drain, and its version counter must continue
+        from the cluster's (the reference's Redis-backed runtime_state;
+        here the shared DB is the source of truth, the bus the push path)."""
+        import json
+
+        row = await self.ctx.db.fetchone(
+            "SELECT value FROM global_config WHERE key=?", (self._DB_KEY,))
+        if not row or not row["value"]:
+            return
+        try:
+            state = json.loads(row["value"])
+        except json.JSONDecodeError:
+            return
+        mode = state.get("mode")
+        if mode in self._ingresses:
+            self.mode = mode
+            self.version = int(state.get("version") or 0)
+            self.changed_at = state.get("changed_at")
+
+    async def set_mode(self, mode: str, publish: bool = True) -> None:
+        import json
+
+        if mode not in self._ingresses:
+            raise ValueError(f"unknown ingress {mode!r}; have {self.names()}")
+        self.mode = mode
+        self.version += 1
+        self.changed_at = time.time()
+        logger.info("mcp ingress mode -> %s (v%d)", mode, self.version)
+        await self.ctx.db.execute(
+            "INSERT INTO global_config (key, value, updated_at) VALUES (?,?,?)"
+            " ON CONFLICT(key) DO UPDATE SET value=excluded.value,"
+            " updated_at=excluded.updated_at",
+            (self._DB_KEY, json.dumps({"mode": mode, "version": self.version,
+                                       "changed_at": self.changed_at}),
+             self.changed_at))
+        if publish:
+            await self.ctx.bus.publish("ingress.mode",
+                                       {"mode": mode, "version": self.version})
+
+    def subscribe(self) -> None:
+        async def _on_mode(topic: str, message: dict[str, Any]) -> None:
+            mode = message.get("mode")
+            version = int(message.get("version") or 0)
+            # versioned: a late-delivered older change must not undo a newer
+            # local one (reference runtime_state version counter)
+            if mode not in self._ingresses or version < self.version:
+                return
+            # adopt the version even when the mode already matches — a
+            # lagging counter would make this worker's future switches be
+            # rejected as stale by every peer
+            self.version = version
+            if mode != self.mode:
+                self.mode = mode
+                self.changed_at = time.time()
+                logger.info("mcp ingress mode <- bus: %s (v%d)", mode, version)
+
+        self.ctx.bus.subscribe("ingress.mode", _on_mode)
+
+    # ------------------------------------------------------------- dispatch
+
+    def handler(self, kind: str) -> Handler:
+        async def dispatch(request: web.Request) -> web.StreamResponse:
+            handlers = self._ingresses.get(self.mode) \
+                or self._ingresses["python"]
+            handler = handlers.get(kind)
+            if handler is None:
+                raise web.HTTPMethodNotAllowed(kind.upper(), ["POST", "GET"])
+            return await handler(request)
+
+        return dispatch

@@ -1,0 +1,124 @@
+"""Swappable /mcp ingress + cluster-wide runtime mode (ADR 051 +
+runtime_state parity): drain mode 503s MCP traffic without restart and
+propagates to peer workers over the bus."""
+
+import asyncio
+
+import aiohttp
+
+from test_gateway_app import BASIC, make_client
+from test_session_affinity import _worker
+
+AUTH = aiohttp.BasicAuth(*BASIC)
+
+PING = {"jsonrpc": "2.0", "id": 1, "method": "ping"}
+INIT = {"jsonrpc": "2.0", "id": 1, "method": "initialize",
+        "params": {"protocolVersion": "2025-06-18", "capabilities": {},
+                   "clientInfo": {"name": "t", "version": "0"}}}
+
+
+async def test_drain_mode_and_restore():
+    client = await make_client()
+    try:
+        resp = await client.post("/mcp", json=PING, auth=AUTH)
+        assert resp.status == 200
+
+        # only admins may switch
+        resp = await client.get("/admin/ingress", auth=AUTH)
+        status = await resp.json()
+        assert status["mode"] == "python"
+        assert set(status["available"]) >= {"python", "drain"}
+
+        resp = await client.post("/admin/ingress", json={"mode": "drain"},
+                                 auth=AUTH)
+        assert resp.status == 200
+
+        # MCP ingress drains; the REST/admin surface stays up
+        resp = await client.post("/mcp", json=PING, auth=AUTH)
+        assert resp.status == 503
+        assert resp.headers["retry-after"]
+        resp = await client.get("/health")
+        assert resp.status == 200
+
+        # unknown mode rejected
+        resp = await client.post("/admin/ingress", json={"mode": "bogus"},
+                                 auth=AUTH)
+        assert resp.status == 422
+
+        resp = await client.post("/admin/ingress", json={"mode": "python"},
+                                 auth=AUTH)
+        assert resp.status == 200
+        resp = await client.post("/mcp", json=PING, auth=AUTH)
+        assert resp.status == 200
+    finally:
+        await client.close()
+
+
+async def test_mode_propagates_across_workers(tmp_path):
+    """Two workers on the file bus: a switch on A drains B too (the
+    reference's Redis-propagated runtime override)."""
+    bus_dir = str(tmp_path / "bus")
+    worker_a = await _worker(bus_dir, str(tmp_path / "a.db"))
+    worker_b = await _worker(bus_dir, str(tmp_path / "b.db"))
+    try:
+        resp = await worker_b.post("/mcp", json=INIT, auth=AUTH)
+        assert resp.status == 200
+
+        resp = await worker_a.post("/admin/ingress", json={"mode": "drain"},
+                                   auth=AUTH)
+        assert resp.status == 200
+
+        # B picks the change off the bus (file-bus poll ~0.2s)
+        for _ in range(30):
+            resp = await worker_b.post("/mcp", json=INIT, auth=AUTH)
+            if resp.status == 503:
+                break
+            await asyncio.sleep(0.1)
+        assert resp.status == 503
+
+        resp = await worker_a.post("/admin/ingress", json={"mode": "python"},
+                                   auth=AUTH)
+        for _ in range(30):
+            resp = await worker_b.post("/mcp", json=INIT, auth=AUTH)
+            if resp.status == 200:
+                break
+            await asyncio.sleep(0.1)
+        assert resp.status == 200
+    finally:
+        await worker_a.close()
+        await worker_b.close()
+
+
+async def test_restarted_worker_adopts_persisted_mode(tmp_path):
+    """A worker booting against a drained cluster's DB must come up
+    drained (not silently serve through the maintenance window)."""
+    bus_dir = str(tmp_path / "bus")
+    db = str(tmp_path / "shared.db")
+    worker_a = await _worker(bus_dir, db)
+    try:
+        resp = await worker_a.post("/admin/ingress", json={"mode": "drain"},
+                                   auth=AUTH)
+        assert resp.status == 200
+        # "restart": a fresh worker on the same DB
+        worker_b = await _worker(bus_dir, db)
+        try:
+            resp = await worker_b.get("/admin/ingress", auth=AUTH)
+            state = await resp.json()
+            assert state["mode"] == "drain"
+            assert state["version"] >= 1
+            resp = await worker_b.post("/mcp", json=INIT, auth=AUTH)
+            assert resp.status == 503
+            # and its OWN switch is not rejected as stale by peers
+            resp = await worker_b.post("/admin/ingress",
+                                       json={"mode": "python"}, auth=AUTH)
+            assert resp.status == 200
+            for _ in range(30):
+                resp = await worker_a.post("/mcp", json=INIT, auth=AUTH)
+                if resp.status == 200:
+                    break
+                await asyncio.sleep(0.1)
+            assert resp.status == 200
+        finally:
+            await worker_b.close()
+    finally:
+        await worker_a.close()
