@@ -339,7 +339,9 @@ async def build_app(settings: Settings | None = None) -> web.Application:
                 entry["name"], entry["issuer"], entry["client_id"],
                 entry.get("client_secret", ""),
                 authorization_endpoint=entry.get("authorization_endpoint", ""),
-                token_endpoint=entry.get("token_endpoint", ""))
+                token_endpoint=entry.get("token_endpoint", ""),
+                dialect=entry.get("dialect", "oidc"),
+                userinfo_endpoint=entry.get("userinfo_endpoint", ""))
 
     async def sso_providers_route(request: web.Request) -> web.Response:
         return web.json_response({"providers": sso_service.list_providers()})

@@ -73,30 +73,46 @@ class IngressMount:
         except json.JSONDecodeError:
             return
         mode = state.get("mode")
+        # the version counter is adopted UNCONDITIONALLY: a worker that
+        # doesn't register the persisted mode name must still continue the
+        # cluster's counter or its own switches get dropped as stale
+        self.version = int(state.get("version") or 0)
+        self.changed_at = state.get("changed_at")
         if mode in self._ingresses:
             self.mode = mode
-            self.version = int(state.get("version") or 0)
-            self.changed_at = state.get("changed_at")
 
     async def set_mode(self, mode: str, publish: bool = True) -> None:
         import json
 
         if mode not in self._ingresses:
             raise ValueError(f"unknown ingress {mode!r}; have {self.names()}")
-        self.mode = mode
-        self.version += 1
-        self.changed_at = time.time()
-        logger.info("mcp ingress mode -> %s (v%d)", mode, self.version)
+        changed_at = time.time()
+        # version allocation is an atomic counter in the SHARED DB: two
+        # concurrent switches on different workers get distinct versions, so
+        # every peer converges on the higher one (no split brain); and we
+        # persist BEFORE touching local state — a failed write must not
+        # leave this worker switched alone with the admin seeing a 500
+        rows = await self.ctx.db.execute(
+            "INSERT INTO global_config (key, value, updated_at)"
+            " VALUES (?, '1', ?) ON CONFLICT(key) DO UPDATE SET"
+            " value=CAST(CAST(value AS INTEGER)+1 AS TEXT),"
+            " updated_at=excluded.updated_at RETURNING value",
+            (self._DB_KEY + ":version", changed_at))
+        version = int(rows[0]["value"]) if rows else self.version + 1
         await self.ctx.db.execute(
             "INSERT INTO global_config (key, value, updated_at) VALUES (?,?,?)"
             " ON CONFLICT(key) DO UPDATE SET value=excluded.value,"
             " updated_at=excluded.updated_at",
-            (self._DB_KEY, json.dumps({"mode": mode, "version": self.version,
-                                       "changed_at": self.changed_at}),
-             self.changed_at))
+            (self._DB_KEY, json.dumps({"mode": mode, "version": version,
+                                       "changed_at": changed_at}),
+             changed_at))
+        self.mode = mode
+        self.version = version
+        self.changed_at = changed_at
+        logger.info("mcp ingress mode -> %s (v%d)", mode, version)
         if publish:
             await self.ctx.bus.publish("ingress.mode",
-                                       {"mode": mode, "version": self.version})
+                                       {"mode": mode, "version": version})
 
     def subscribe(self) -> None:
         async def _on_mode(topic: str, message: dict[str, Any]) -> None:

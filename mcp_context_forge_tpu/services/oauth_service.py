@@ -77,12 +77,19 @@ class SSOService:
     def register_provider(self, name: str, issuer: str, client_id: str,
                           client_secret: str,
                           authorization_endpoint: str = "",
-                          token_endpoint: str = "") -> None:
+                          token_endpoint: str = "",
+                          dialect: str = "oidc",
+                          userinfo_endpoint: str = "") -> None:
+        """dialect: "oidc" (id_token carries claims) or "github" (no OIDC —
+        claims come from the user API; reference sso_service provider
+        quirks for GitHub)."""
         self._providers[name] = {
             "issuer": issuer.rstrip("/"), "client_id": client_id,
             "client_secret": client_secret,
             "authorization_endpoint": authorization_endpoint,
             "token_endpoint": token_endpoint,
+            "dialect": dialect,
+            "userinfo_endpoint": userinfo_endpoint,
         }
 
     def list_providers(self) -> list[str]:
@@ -90,6 +97,12 @@ class SSOService:
 
     async def _discover(self, provider: dict[str, Any]) -> None:
         if provider["authorization_endpoint"] and provider["token_endpoint"]:
+            return
+        if provider.get("dialect") == "github":
+            # GitHub has no OIDC discovery document: well-known endpoints
+            base = provider["issuer"]
+            provider["authorization_endpoint"] = base + "/login/oauth/authorize"
+            provider["token_endpoint"] = base + "/login/oauth/access_token"
             return
         resp = await self.ctx.http_client.get(
             provider["issuer"] + "/.well-known/openid-configuration")
@@ -111,10 +124,11 @@ class SSOService:
             "DELETE FROM global_config WHERE key LIKE 'sso_state:%'"
             " AND updated_at < ?", (now() - self.STATE_TTL,))
         from urllib.parse import urlencode
+        scope = ("read:user user:email" if provider.get("dialect") == "github"
+                 else "openid email profile")
         query = urlencode({
             "response_type": "code", "client_id": provider["client_id"],
-            "redirect_uri": redirect_uri, "scope": "openid email profile",
-            "state": state})
+            "redirect_uri": redirect_uri, "scope": scope, "state": state})
         return f"{provider['authorization_endpoint']}?{query}"
 
     async def handle_callback(self, state: str, code: str,
@@ -131,13 +145,20 @@ class SSOService:
         provider = self._providers.get(provider_name)
         if provider is None:
             raise ValidationFailure("SSO provider no longer configured")
-        resp = await self.ctx.http_client.post(provider["token_endpoint"], data={
-            "grant_type": "authorization_code", "code": code,
-            "redirect_uri": redirect_uri, "client_id": provider["client_id"],
-            "client_secret": provider["client_secret"]})
+        resp = await self.ctx.http_client.post(
+            provider["token_endpoint"], data={
+                "grant_type": "authorization_code", "code": code,
+                "redirect_uri": redirect_uri,
+                "client_id": provider["client_id"],
+                "client_secret": provider["client_secret"]},
+            # GitHub answers urlencoded unless asked for JSON
+            headers={"accept": "application/json"})
         resp.raise_for_status()
         tokens = resp.json()
-        claims = _unverified_id_token_claims(tokens.get("id_token", ""))
+        if provider.get("dialect") == "github":
+            claims = await self._github_claims(provider, tokens)
+        else:
+            claims = _unverified_id_token_claims(tokens.get("id_token", ""))
         email = claims.get("email")
         if not email:
             raise ValidationFailure("IdP id_token is missing an email claim")
@@ -152,6 +173,33 @@ class SSOService:
                 (email, "!sso!", claims.get("name", ""), 0, provider_name, ts, ts))
         token = self.auth.issue_jwt(email)
         return {"access_token": token, "token_type": "bearer", "email": email}
+
+
+    async def _github_claims(self, provider: dict[str, Any],
+                             tokens: dict[str, Any]) -> dict[str, Any]:
+        """GitHub dialect: no id_token — fetch /user (+ /user/emails for a
+        private primary email) with the access token."""
+        access = tokens.get("access_token", "")
+        if not access:
+            raise ValidationFailure("GitHub token response missing access_token")
+        api = provider.get("userinfo_endpoint") or "https://api.github.com/user"
+        headers = {"authorization": f"Bearer {access}",
+                   "accept": "application/vnd.github+json"}
+        resp = await self.ctx.http_client.get(api, headers=headers)
+        resp.raise_for_status()
+        user = resp.json()
+        email = user.get("email")
+        if not email:
+            resp = await self.ctx.http_client.get(api.rstrip("/") + "/emails",
+                                                  headers=headers)
+            if resp.status_code == 200:
+                emails = resp.json()
+                primary = [e for e in emails
+                           if isinstance(e, dict) and e.get("primary")
+                           and e.get("verified")]
+                if primary:
+                    email = primary[0].get("email")
+        return {"email": email, "name": user.get("name") or user.get("login", "")}
 
 
 def _unverified_id_token_claims(id_token: str) -> dict[str, Any]:

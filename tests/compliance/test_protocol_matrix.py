@@ -156,3 +156,29 @@ async def test_matrix_stateful_sessions():
         assert resp.status == 404
     finally:
         await gateway.close()
+
+
+async def test_matrix_through_native_edge():
+    """Target: C++ edge tier fronting the gateway (the reference matrix's
+    rust_edge engine analog) — every core method must behave identically
+    through the native edge."""
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "integration"))
+    from test_mcp_edge import _edge_for
+
+    gateway = await make_client()
+    proc, port = await _edge_for(gateway)
+    try:
+        async with aiohttp.ClientSession() as session:
+            for i, (method, params) in enumerate(CORE_REQUESTS):
+                resp = await session.post(
+                    f"http://127.0.0.1:{port}/rpc",
+                    json={"jsonrpc": "2.0", "id": i, "method": method,
+                          "params": params}, auth=AUTH)
+                assert resp.status == 200, (method, resp.status)
+                _check(method, await resp.json())
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+        await gateway.close()

@@ -135,3 +135,71 @@ async def test_sso_login_flow():
     finally:
         await idp.close()
         await gateway.close()
+
+
+async def make_fake_github() -> TestClient:
+    """GitHub-shaped OAuth provider: no OIDC discovery, urlencoded-unless-
+    asked token endpoint, claims via the user API (private primary email)."""
+    app = web.Application()
+
+    async def token(request: web.Request) -> web.Response:
+        form = await request.post()
+        if form.get("code") != "gh-code":
+            return web.json_response({"error": "bad_verification_code"},
+                                     status=400)
+        assert request.headers.get("accept") == "application/json"
+        return web.json_response({"access_token": "gho_testtoken",
+                                  "token_type": "bearer",
+                                  "scope": "read:user,user:email"})
+
+    async def user(request: web.Request) -> web.Response:
+        assert request.headers["authorization"] == "Bearer gho_testtoken"
+        return web.json_response({"login": "octocat", "name": "Octo Cat",
+                                  "email": None})  # private email
+
+    async def emails(request: web.Request) -> web.Response:
+        return web.json_response([
+            {"email": "secondary@example.com", "primary": False,
+             "verified": True},
+            {"email": "octo@example.com", "primary": True, "verified": True},
+        ])
+
+    app.router.add_post("/login/oauth/access_token", token)
+    app.router.add_get("/user", user)
+    app.router.add_get("/user/emails", emails)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def test_sso_github_dialect():
+    gateway = await make_client()
+    github = await make_fake_github()
+    try:
+        base = f"http://{github.server.host}:{github.server.port}"
+        sso = gateway.app["sso_service"]
+        sso.register_provider("github", base, "gh-client", "gh-secret",
+                              dialect="github",
+                              userinfo_endpoint=f"{base}/user")
+
+        resp = await gateway.get("/auth/sso/github/login",
+                                 allow_redirects=False)
+        assert resp.status == 302
+        location = resp.headers["location"]
+        # GitHub endpoints + GitHub scopes, no OIDC discovery involved
+        assert "/login/oauth/authorize" in location
+        assert "read:user+user:email" in location
+        state = location.split("state=")[1].split("&")[0]
+
+        resp = await gateway.get(
+            f"/auth/sso/github/callback?state={state}&code=gh-code")
+        assert resp.status == 200, await resp.text()
+        body = await resp.json()
+        # primary verified email resolved via /user/emails
+        assert body["email"] == "octo@example.com"
+        resp = await gateway.get("/tools", headers={
+            "authorization": f"Bearer {body['access_token']}"})
+        assert resp.status == 200
+    finally:
+        await github.close()
+        await gateway.close()
