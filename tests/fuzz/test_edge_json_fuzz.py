@@ -1,0 +1,105 @@
+"""Differential fuzz: the C++ edge's JSON validator vs Python's json.
+
+The edge promises "-32700 rejected natively": a payload Python accepts but
+the edge rejects breaks valid clients; one the edge accepts but the
+gateway rejects re-introduces the parse work the edge exists to offload.
+Hypothesis drives both directions through a live edge+gateway pair.
+"""
+
+import asyncio
+import json
+import sys
+from pathlib import Path
+
+import aiohttp
+import pytest
+from hypothesis import HealthCheck, given, settings, strategies as st
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "integration"))
+
+from test_gateway_app import BASIC, make_client
+from test_mcp_edge import _edge_for
+
+AUTH = aiohttp.BasicAuth(*BASIC)
+
+# JSON-ish value strategy: valid docs + mangled variants
+json_values = st.recursive(
+    st.none() | st.booleans() |
+    st.integers(min_value=-10**12, max_value=10**12) |
+    st.floats(allow_nan=False, allow_infinity=False, width=32) |
+    st.text(max_size=40),
+    lambda children: st.lists(children, max_size=4) |
+    st.dictionaries(st.text(max_size=12), children, max_size=4),
+    max_leaves=12)
+
+
+@pytest.fixture(scope="module")
+def edge_pair():
+    holder = {}
+
+    async def boot():
+        gateway = await make_client()
+        proc, port = await _edge_for(gateway)
+        return gateway, proc, port
+
+    loop = asyncio.new_event_loop()
+    holder["loop"] = loop
+    holder["gateway"], holder["proc"], holder["port"] = \
+        loop.run_until_complete(boot())
+    yield holder
+    holder["proc"].kill()
+    holder["proc"].wait(timeout=10)
+    loop.run_until_complete(holder["gateway"].close())
+    loop.close()
+
+
+def _post_raw(holder, body: bytes) -> tuple[int, dict | None]:
+    async def go():
+        async with aiohttp.ClientSession() as session:
+            resp = await session.post(
+                f"http://127.0.0.1:{holder['port']}/rpc", data=body,
+                headers={"content-type": "application/json"}, auth=AUTH)
+            try:
+                return resp.status, await resp.json()
+            except Exception:
+                return resp.status, None
+
+    return holder["loop"].run_until_complete(go())
+
+
+@settings(max_examples=40, deadline=None,
+          suppress_health_check=[HealthCheck.function_scoped_fixture])
+@given(value=json_values)
+def test_valid_json_rpc_never_parse_rejected(edge_pair, value):
+    """Any python-serializable JSON-RPC envelope must clear the edge's
+    validator (it may still fail auth/method checks UPSTREAM, but never
+    with the edge's -32700 parse rejection)."""
+    body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": "ping",
+                       "params": {"blob": value}}).encode()
+    status, payload = _post_raw(edge_pair, body)
+    if status == 400 and payload and "error" in payload:
+        assert payload["error"]["code"] != -32700, payload
+        assert "rejected at edge" not in payload["error"].get("message", "")
+
+
+@settings(max_examples=40, deadline=None,
+          suppress_health_check=[HealthCheck.function_scoped_fixture])
+@given(raw=st.binary(min_size=1, max_size=120))
+def test_invalid_json_agreement(edge_pair, raw):
+    """Random bytes: whenever Python's json rejects the body, the edge must
+    reject it too (parse floods never reach the gateway); whenever Python
+    accepts it, the edge must not claim a parse error."""
+    try:
+        json.loads(raw)
+        python_valid = True
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        python_valid = False
+    status, payload = _post_raw(edge_pair, raw)
+    edge_parse_rejected = (
+        status == 400 and payload is not None and
+        payload.get("error", {}).get("code") == -32700)
+    if python_valid:
+        assert not edge_parse_rejected, (raw, payload)
+    else:
+        # invalid JSON must never be forwarded: the edge answers -32700
+        assert edge_parse_rejected, (raw, status, payload)
