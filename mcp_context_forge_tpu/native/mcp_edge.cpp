@@ -52,6 +52,10 @@ class JsonScanner {
   explicit JsonScanner(const std::string& text) : s_(text) {}
 
   bool valid() {
+    // RFC 8259 §8.1: the wire encoding is UTF-8. One linear pre-pass keeps
+    // the scanner byte-oriented while matching a strict decoder (no
+    // overlongs, surrogates, >U+10FFFF, or truncated sequences).
+    if (!utf8_valid()) return false;
     skip_ws();
     if (!value(0)) return false;
     skip_ws();
@@ -121,8 +125,36 @@ class JsonScanner {
     return pos_ > start;
   }
 
+  bool utf8_valid() const {
+    const unsigned char* p = reinterpret_cast<const unsigned char*>(s_.data());
+    size_t n = s_.size();
+    for (size_t i = 0; i < n;) {
+      unsigned char b = p[i];
+      if (b < 0x80) { ++i; continue; }
+      int len;
+      unsigned int cp, min;
+      if ((b & 0xE0) == 0xC0)      { len = 2; cp = b & 0x1F; min = 0x80; }
+      else if ((b & 0xF0) == 0xE0) { len = 3; cp = b & 0x0F; min = 0x800; }
+      else if ((b & 0xF8) == 0xF0) { len = 4; cp = b & 0x07; min = 0x10000; }
+      else return false;  // stray continuation or 0xF8+ lead
+      if (i + len > n) return false;
+      for (int k = 1; k < len; ++k) {
+        if ((p[i + k] & 0xC0) != 0x80) return false;
+        cp = (cp << 6) | (p[i + k] & 0x3F);
+      }
+      if (cp < min || cp > 0x10FFFF) return false;            // overlong/range
+      if (cp >= 0xD800 && cp <= 0xDFFF) return false;         // surrogate
+      i += len;
+    }
+    return true;
+  }
+
   bool number() {
     if (pos_ < s_.size() && s_[pos_] == '-') ++pos_;
+    // RFC 8259: no leading zeros ("01" is not a number)
+    if (pos_ + 1 < s_.size() && s_[pos_] == '0' &&
+        isdigit(static_cast<unsigned char>(s_[pos_ + 1])))
+      return false;
     if (!digits()) return false;  // "-" / "-." are not numbers
     if (pos_ < s_.size() && s_[pos_] == '.') {
       ++pos_;

@@ -1,0 +1,9 @@
+"""In-tree test-quality tooling (mutation testing).
+
+Parity: the reference drives mutmut via `run_mutmut.py` at its repo root
+(SURVEY §5.2). No mutmut in this image, so the mutator is in-tree: an
+AST-level mutant generator + oracle runner (`mutation.py`) with behavioral
+oracles for the security-critical pure-logic modules (`oracles.py`).
+"""
+
+from .mutation import Mutant, generate_mutants, run_campaign  # noqa: F401

@@ -1,0 +1,230 @@
+"""AST mutation testing: generate single-fault mutants, check the oracles kill them.
+
+Reference analog: `run_mutmut.py` (mutmut campaign over mcpgateway/ with a
+kill-rate gate). mutmut is not in this image, so this is a from-scratch
+mutator built on `ast`: each mutant is the original module source with
+exactly ONE fault injected (comparison flipped, boolean operator swapped,
+`not` dropped, constant nudged, `raise` silenced, `startswith`/`endswith`
+confused). A mutant is *killed* when the module's behavioral oracle fails
+against the mutated module object; survivors are reported so equivalent
+mutants can be allowlisted explicitly in the test.
+
+Usage (test): see `tests/mutation/test_mutation_kill.py`.
+Usage (CLI):  `python -m mcp_context_forge_tpu.testing.mutation jsonrpc`
+"""
+
+from __future__ import annotations
+
+import ast
+import copy
+import types
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+# comparison operator -> its off-by-one/negation confusion
+_COMPARE_SWAPS: dict[type, type] = {
+    ast.Eq: ast.NotEq, ast.NotEq: ast.Eq,
+    ast.Lt: ast.LtE, ast.LtE: ast.Lt,
+    ast.Gt: ast.GtE, ast.GtE: ast.Gt,
+    ast.In: ast.NotIn, ast.NotIn: ast.In,
+    ast.Is: ast.IsNot, ast.IsNot: ast.Is,
+}
+
+_ATTR_SWAPS = {"startswith": "endswith", "endswith": "startswith"}
+
+
+@dataclass
+class Mutant:
+    index: int
+    description: str
+    lineno: int
+    source: str
+
+
+@dataclass
+class CampaignReport:
+    module: str
+    total: int
+    killed: int
+    survivors: list[Mutant] = field(default_factory=list)
+    invalid: int = 0  # mutants that failed to even exec (count as killed)
+
+    @property
+    def kill_rate(self) -> float:
+        return 1.0 if not self.total else (self.total - len(self.survivors)) / self.total
+
+
+class _Mutator(ast.NodeTransformer):
+    """One pass = one (possibly applied) mutation.
+
+    With ``apply_at=None`` it only enumerates mutation sites into
+    ``found``; with ``apply_at=i`` it rewrites the i-th site.
+    """
+
+    def __init__(self, apply_at: int | None = None):
+        self.apply_at = apply_at
+        self.counter = 0
+        self.found: list[tuple[str, int]] = []
+        self.applied: str | None = None
+
+    def _site(self, description: str, lineno: int) -> bool:
+        idx = self.counter
+        self.counter += 1
+        self.found.append((description, lineno))
+        if idx == self.apply_at:
+            self.applied = description
+            return True
+        return False
+
+    def visit_Compare(self, node: ast.Compare) -> ast.AST:
+        self.generic_visit(node)
+        for i, op in enumerate(node.ops):
+            swap = _COMPARE_SWAPS.get(type(op))
+            if swap is None:
+                continue
+            desc = f"{type(op).__name__}->{swap.__name__}"
+            if self._site(desc, node.lineno):
+                new = copy.deepcopy(node)
+                new.ops[i] = swap()
+                return new
+        return node
+
+    def visit_BoolOp(self, node: ast.BoolOp) -> ast.AST:
+        self.generic_visit(node)
+        swap = ast.Or if isinstance(node.op, ast.And) else ast.And
+        desc = f"{type(node.op).__name__}->{swap.__name__}"
+        if self._site(desc, node.lineno):
+            new = copy.deepcopy(node)
+            new.op = swap()
+            return new
+        return node
+
+    def visit_UnaryOp(self, node: ast.UnaryOp) -> ast.AST:
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            if self._site("drop-not", node.lineno):
+                return node.operand
+        return node
+
+    def visit_Constant(self, node: ast.Constant) -> ast.AST:
+        if node.value is True or node.value is False:
+            if self._site(f"{node.value}->{not node.value}", node.lineno):
+                return ast.copy_location(ast.Constant(not node.value), node)
+        elif isinstance(node.value, int) and not isinstance(node.value, bool):
+            if self._site(f"{node.value}->{node.value + 1}", node.lineno):
+                return ast.copy_location(ast.Constant(node.value + 1), node)
+        return node
+
+    def visit_Raise(self, node: ast.Raise) -> ast.AST:
+        self.generic_visit(node)
+        if self._site("raise->pass", node.lineno):
+            return ast.copy_location(ast.Pass(), node)
+        return node
+
+    def visit_Attribute(self, node: ast.Attribute) -> ast.AST:
+        self.generic_visit(node)
+        swap = _ATTR_SWAPS.get(node.attr)
+        if swap is not None:
+            if self._site(f"{node.attr}->{swap}", node.lineno):
+                new = copy.deepcopy(node)
+                new.attr = swap
+                return new
+        return node
+
+
+def generate_mutants(source: str) -> list[Mutant]:
+    """Every single-fault variant of ``source`` (docstrings untouched)."""
+    tree = ast.parse(source)
+    scan = _Mutator(apply_at=None)
+    scan.visit(copy.deepcopy(tree))
+    mutants = []
+    for idx, (desc, lineno) in enumerate(scan.found):
+        mut = _Mutator(apply_at=idx)
+        mutated = mut.visit(copy.deepcopy(tree))
+        ast.fix_missing_locations(mutated)
+        mutants.append(Mutant(index=idx, description=desc, lineno=lineno,
+                              source=ast.unparse(mutated)))
+    return mutants
+
+
+def load_module_from_source(source: str, module_name: str, package: str) -> types.ModuleType:
+    """Exec ``source`` as a throwaway module, leaving the real one untouched.
+
+    The module is registered in sys.modules under a reserved alias only for
+    the duration of the exec (dataclass/typing machinery resolves
+    ``cls.__module__`` through sys.modules); ``package`` makes relative
+    imports inside the module resolve.
+    """
+    import sys
+
+    alias = f"{module_name}__mutant__"
+    mod = types.ModuleType(alias)
+    mod.__package__ = package
+    code = compile(source, f"<mutant:{module_name}>", "exec")
+    sys.modules[alias] = mod
+    try:
+        exec(code, mod.__dict__)  # noqa: S102 - in-tree test tooling
+    finally:
+        sys.modules.pop(alias, None)
+    return mod
+
+
+def run_campaign(module_name: str, source: str, package: str,
+                 oracle: Callable[[types.ModuleType], Any],
+                 skip_lines: frozenset[int] = frozenset(),
+                 line_range: tuple[int, int] | None = None) -> CampaignReport:
+    """Run ``oracle`` against every mutant of ``source``.
+
+    The oracle gets the (mutated) module object and must raise on any
+    behavioral deviation. ``skip_lines`` excludes sites on lines known to be
+    outside the oracle's contract (e.g. log formatting); ``line_range``
+    restricts the campaign to one region (e.g. a single class) so a focused
+    oracle is not graded on code it never exercises.
+    """
+    baseline = load_module_from_source(source, module_name, package)
+    oracle(baseline)  # the oracle must pass on the unmutated module
+
+    mutants = [m for m in generate_mutants(source)
+               if m.lineno not in skip_lines
+               and (line_range is None or line_range[0] <= m.lineno <= line_range[1])]
+    report = CampaignReport(module=module_name, total=len(mutants), killed=0)
+    for m in mutants:
+        try:
+            mod = load_module_from_source(m.source, module_name, package)
+        except Exception:
+            report.invalid += 1
+            report.killed += 1
+            continue
+        try:
+            oracle(mod)
+        except Exception:
+            report.killed += 1
+        else:
+            report.survivors.append(m)
+    return report
+
+
+def main(argv: list[str]) -> int:
+    from . import oracles
+
+    targets = oracles.TARGETS if not argv else {k: oracles.TARGETS[k] for k in argv}
+    worst = 1.0
+    for name, target in targets.items():
+        report = target.run()
+        # allowlisted equivalent mutants don't count against the gate
+        real = [s for s in report.survivors
+                if s.lineno not in target.equivalent_lines]
+        rate = 1.0 if not report.total else (report.total - len(real)) / report.total
+        worst = min(worst, rate)
+        print(f"{name}: {report.total - len(real)}/{report.total} killed "
+              f"({rate:.1%}), {report.invalid} invalid")
+        for s in report.survivors:
+            mark = " (allowlisted)" if s.lineno in target.equivalent_lines else ""
+            print(f"  survivor L{s.lineno}: {s.description}{mark}")
+    return 0 if worst >= 0.85 else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    raise SystemExit(main(sys.argv[1:]))

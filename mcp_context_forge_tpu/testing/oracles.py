@@ -1,0 +1,223 @@
+"""Behavioral oracles for the mutation campaigns.
+
+Each oracle is a dense re-statement of a module's CONTRACT (not its code):
+it must pass on the real module and fail on any single-fault mutant that
+changes observable behavior. Targets are the pure-logic, security-critical
+modules where a silent fault is most expensive — JSON-RPC validation and
+the RBAC permission check (reference gates the same surfaces through its
+mutmut run, `run_mutmut.py`).
+"""
+
+from __future__ import annotations
+
+import ast
+import types
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable
+
+from .mutation import CampaignReport, run_campaign
+
+_PKG_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _class_line_range(source: str, class_name: str) -> tuple[int, int]:
+    for node in ast.walk(ast.parse(source)):
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            return node.lineno, node.end_lineno or node.lineno
+    raise ValueError(f"class {class_name} not found")
+
+
+@dataclass
+class MutationTarget:
+    rel_path: str                 # package-relative source path
+    module_name: str
+    package: str
+    oracle: Callable[[types.ModuleType], None]
+    class_name: str | None = None  # restrict campaign to this class
+    equivalent_lines: frozenset[int] = field(default_factory=frozenset)
+
+    def run(self) -> CampaignReport:
+        source = (_PKG_ROOT / self.rel_path).read_text()
+        line_range = (_class_line_range(source, self.class_name)
+                      if self.class_name else None)
+        return run_campaign(self.module_name, source, self.package, self.oracle,
+                            line_range=line_range)
+
+
+# --------------------------------------------------------------- jsonrpc
+
+def jsonrpc_oracle(mod: types.ModuleType) -> None:
+    # exact wire constants
+    assert mod.PARSE_ERROR == -32700
+    assert mod.INVALID_REQUEST == -32600
+    assert mod.METHOD_NOT_FOUND == -32601
+    assert mod.INVALID_PARAMS == -32602
+    assert mod.INTERNAL_ERROR == -32603
+    assert mod.REQUEST_CANCELLED == -32800
+    assert mod.CONTENT_TOO_LARGE == -32801
+
+    E = mod.JSONRPCError
+
+    def rejects(payload, code=mod.INVALID_REQUEST):
+        try:
+            mod.RPCRequest.parse(payload)
+        except E as exc:
+            assert exc.code == code, (payload, exc.code)
+        else:
+            raise AssertionError(f"accepted {payload!r}")
+
+    # JSONRPCError shape
+    err = E(-32000, "boom").to_dict("id1")
+    assert err == {"jsonrpc": "2.0", "id": "id1",
+                   "error": {"code": -32000, "message": "boom"}}
+    err = E(-32000, "boom", data={"k": 1}).to_dict(None)
+    assert err["error"]["data"] == {"k": 1} and err["id"] is None
+    assert mod.error_response(3, -32601, "nf")["error"]["code"] == -32601
+    assert mod.result_response(7, {"ok": 1}) == {
+        "jsonrpc": "2.0", "id": 7, "result": {"ok": 1}}
+
+    # request validation
+    rejects(None)
+    rejects([])
+    rejects("x")
+    rejects({})                                   # no jsonrpc
+    rejects({"jsonrpc": "2.0"})                   # no method
+    rejects({"jsonrpc": "1.0", "method": "ping"})
+    rejects({"jsonrpc": "2.0", "method": ""})
+    rejects({"jsonrpc": "2.0", "method": 7})
+    rejects({"jsonrpc": "2.0", "method": "m", "params": 3})
+    rejects({"jsonrpc": "2.0", "method": "m", "params": "s"})
+    rejects({"jsonrpc": "2.0", "method": "m", "id": True})
+    rejects({"jsonrpc": "2.0", "method": "m", "id": {}})
+    rejects({"jsonrpc": "2.0", "method": "m", "id": []})
+
+    direct = mod.RPCRequest(method="m")   # direct construction = a call
+    assert direct.is_notification is False and direct.params == {}
+
+    r = mod.RPCRequest.parse({"jsonrpc": "2.0", "method": "ping", "id": 1})
+    assert (r.method, r.id, r.is_notification, r.params) == ("ping", 1, False, {})
+    r = mod.RPCRequest.parse({"jsonrpc": "2.0", "method": "n"})
+    assert r.is_notification and r.id is None
+    r = mod.RPCRequest.parse({"jsonrpc": "2.0", "method": "m", "id": None})
+    assert not r.is_notification          # explicit null id is still a request
+    r = mod.RPCRequest.parse({"jsonrpc": "2.0", "method": "m", "id": "s",
+                              "params": None})
+    assert r.params == {} and r.id == "s"
+    r = mod.RPCRequest.parse({"jsonrpc": "2.0", "method": "m", "id": 1.5,
+                              "params": [1, 2]})
+    assert r.params == {"__args__": [1, 2]} and r.id == 1.5
+    r = mod.RPCRequest.parse({"jsonrpc": "2.0", "method": "m",
+                              "params": {"a": 1}})
+    assert r.params == {"a": 1}
+
+    # body parsing + size cap
+    assert mod.parse_body(b'{"a": 1}') == {"a": 1}
+    assert mod.parse_body(b"[1]", max_size=3) == [1]
+    try:
+        mod.parse_body(b"[1, 2]", max_size=3)
+    except E as exc:
+        assert exc.code == mod.CONTENT_TOO_LARGE
+    else:
+        raise AssertionError("size cap not enforced")
+    assert mod.parse_body(b"[1, 2]") == [1, 2]   # default: no cap
+    try:
+        mod.parse_body(b"{nope")
+    except E as exc:
+        assert exc.code == mod.PARSE_ERROR
+    else:
+        raise AssertionError("parse error not raised")
+
+    # response-message detection (elicitation replies on the POST channel)
+    assert mod.is_response_message({"id": 1, "result": {}})
+    assert mod.is_response_message({"id": 1, "error": {"code": -1}})
+    assert not mod.is_response_message({"id": 1, "method": "m", "result": {}})
+    assert not mod.is_response_message({"id": 1})
+    assert not mod.is_response_message([1])
+    assert not mod.is_response_message("x")
+
+    # method registry
+    reg = mod.MCPMethodRegistry()
+    assert reg.is_known("tools/call") and reg.is_known("initialize")
+    assert reg.is_known("notifications/cancelled")
+    assert not reg.is_known("bogus/method")
+    reg.register("x/custom")
+    assert reg.is_known("x/custom")
+    assert reg.is_notification("notifications/anything")
+    assert not reg.is_notification("tools/list")
+    assert not reg.is_notification("x-notifications/foo")
+    for m in ("ping", "tools/list", "tools/call", "resources/list",
+              "resources/read", "resources/subscribe", "resources/unsubscribe",
+              "resources/templates/list", "prompts/list", "prompts/get",
+              "roots/list", "completion/complete", "sampling/createMessage",
+              "elicitation/create", "logging/setLevel"):
+        assert m in mod.CORE_METHODS, m
+    for m in ("notifications/initialized", "notifications/progress",
+              "notifications/message", "notifications/roots/list_changed",
+              "notifications/tools/list_changed",
+              "notifications/resources/list_changed",
+              "notifications/resources/updated",
+              "notifications/prompts/list_changed"):
+        assert m in mod.NOTIFICATION_METHODS, m
+
+
+# ----------------------------------------------------- AuthContext (RBAC)
+
+def auth_context_oracle(mod: types.ModuleType) -> None:
+    AC = mod.AuthContext
+
+    # plain user: only granted permissions
+    user = AC(user="u@x", permissions={"tools.read"})
+    assert user.can("tools.read")
+    assert not user.can("tools.delete")
+    assert not user.can("admin.all")
+    user.require("tools.read")
+    try:
+        user.require("tools.delete")
+    except mod.PermissionDenied:
+        pass
+    else:
+        raise AssertionError("require() let a denied permission through")
+
+    # admin shortcut applies ONLY to unscoped identities
+    admin = AC(user="a@x", is_admin=True)
+    assert admin.can("tools.delete") and admin.can("anything.at.all")
+
+    # scoped token minted by an admin must NOT inherit admin power
+    scoped = AC(user="a@x", is_admin=True, scoped=True,
+                permissions={"tools.read"})
+    assert scoped.can("tools.read")
+    assert not scoped.can("tools.delete")
+    assert not scoped.can("admin.all")
+
+    # a scoped token that explicitly carries admin.all is a real admin token
+    scoped_admin = AC(user="a@x", is_admin=False, scoped=True,
+                      permissions={"admin.all"})
+    assert scoped_admin.can("tools.delete")
+
+    # admin.all grant acts as wildcard for unscoped users too
+    granted = AC(user="u@x", permissions={"admin.all"})
+    assert granted.can("plugins.manage")
+
+    # defaults
+    anon = AC(user="anon")
+    assert not anon.can("tools.read")
+    assert anon.via == "jwt" and not anon.scoped and not anon.is_admin
+    assert anon.token_jti is None and anon.server_id is None
+
+
+TARGETS: dict[str, MutationTarget] = {
+    "jsonrpc": MutationTarget(
+        rel_path="jsonrpc.py",
+        module_name="mcp_context_forge_tpu.jsonrpc",
+        package="mcp_context_forge_tpu",
+        oracle=jsonrpc_oracle,
+    ),
+    "auth_context": MutationTarget(
+        rel_path="services/auth_service.py",
+        module_name="mcp_context_forge_tpu.services.auth_service",
+        package="mcp_context_forge_tpu.services",
+        oracle=auth_context_oracle,
+        class_name="AuthContext",
+    ),
+}

@@ -39,30 +39,36 @@ def edge_pair():
 
     async def boot():
         gateway = await make_client()
+        holder["gateway"] = gateway          # visible to teardown immediately
         proc, port = await _edge_for(gateway)
-        return gateway, proc, port
+        holder["proc"], holder["port"] = proc, port
+        holder["session"] = aiohttp.ClientSession()
 
     loop = asyncio.new_event_loop()
     holder["loop"] = loop
-    holder["gateway"], holder["proc"], holder["port"] = \
+    try:
         loop.run_until_complete(boot())
-    yield holder
-    holder["proc"].kill()
-    holder["proc"].wait(timeout=10)
-    loop.run_until_complete(holder["gateway"].close())
-    loop.close()
+        yield holder
+    finally:
+        if "proc" in holder:
+            holder["proc"].kill()
+            holder["proc"].wait(timeout=10)
+        if "session" in holder:
+            loop.run_until_complete(holder["session"].close())
+        if "gateway" in holder:
+            loop.run_until_complete(holder["gateway"].close())
+        loop.close()
 
 
 def _post_raw(holder, body: bytes) -> tuple[int, dict | None]:
     async def go():
-        async with aiohttp.ClientSession() as session:
-            resp = await session.post(
-                f"http://127.0.0.1:{holder['port']}/rpc", data=body,
-                headers={"content-type": "application/json"}, auth=AUTH)
-            try:
-                return resp.status, await resp.json()
-            except Exception:
-                return resp.status, None
+        resp = await holder["session"].post(
+            f"http://127.0.0.1:{holder['port']}/rpc", data=body,
+            headers={"content-type": "application/json"}, auth=AUTH)
+        try:
+            return resp.status, await resp.json()
+        except Exception:
+            return resp.status, None
 
     return holder["loop"].run_until_complete(go())
 
@@ -88,11 +94,19 @@ def test_valid_json_rpc_never_parse_rejected(edge_pair, value):
 def test_invalid_json_agreement(edge_pair, raw):
     """Random bytes: whenever Python's json rejects the body, the edge must
     reject it too (parse floods never reach the gateway); whenever Python
-    accepts it, the edge must not claim a parse error."""
+    accepts it, the edge must not claim a parse error.
+
+    The oracle is strict RFC 8259 over UTF-8 bytes, matching the edge
+    scanner: no encoding auto-detection (json.loads on bytes would guess
+    UTF-16 from NUL patterns) and no NaN/Infinity extensions."""
+
+    def _reject_constant(s):
+        raise ValueError(s)
+
     try:
-        json.loads(raw)
+        json.loads(raw.decode("utf-8"), parse_constant=_reject_constant)
         python_valid = True
-    except (json.JSONDecodeError, UnicodeDecodeError):
+    except (json.JSONDecodeError, UnicodeDecodeError, ValueError):
         python_valid = False
     status, payload = _post_raw(edge_pair, raw)
     edge_parse_rejected = (
@@ -103,3 +117,24 @@ def test_invalid_json_agreement(edge_pair, raw):
     else:
         # invalid JSON must never be forwarded: the edge answers -32700
         assert edge_parse_rejected, (raw, status, payload)
+
+
+@pytest.mark.parametrize("raw", [
+    b"01",            # leading zero (RFC 8259)
+    b"-01",
+    b"NaN",           # json extensions the wire grammar forbids
+    b"Infinity",
+    b"-Infinity",
+    b'"\xff"',        # invalid UTF-8 byte
+    b"\xed\xa0\x80",  # encoded surrogate U+D800
+    b"\xc0\xaf",      # overlong '/'
+    b"1\x00",         # trailing NUL is not JSON whitespace
+    b"\x001",         # json.loads(bytes) would sniff this as UTF-16
+])
+def test_edge_rejects_strict_json_violations(edge_pair, raw):
+    """Deterministic pins for the scanner's RFC 8259 strictness — each of
+    these is a byte string Python's lenient bytes-mode loader (or a naive
+    scanner) might accept but the UTF-8 wire grammar forbids."""
+    status, payload = _post_raw(edge_pair, raw)
+    assert status == 400 and payload is not None
+    assert payload["error"]["code"] == -32700, (raw, payload)

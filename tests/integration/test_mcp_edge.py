@@ -26,7 +26,10 @@ def _free_port() -> int:
 
 
 async def _edge_for(gateway, *extra_args):
-    if not os.path.exists(EDGE_BIN):
+    src = os.path.join(REPO, "mcp_context_forge_tpu", "native", "mcp_edge.cpp")
+    stale = (not os.path.exists(EDGE_BIN)
+             or os.path.getmtime(EDGE_BIN) < os.path.getmtime(src))
+    if stale:
         build = subprocess.run(["make", "edge"], cwd=REPO, capture_output=True)
         if build.returncode != 0:
             pytest.skip("edge binary build failed (no g++?)")

@@ -1,0 +1,40 @@
+"""Mutation-testing gate (reference analog: run_mutmut.py kill-rate gate).
+
+Every single-fault mutant of the JSON-RPC validator and of the RBAC
+permission check must be killed by the behavioral oracles — a surviving
+mutant means a fault in a security-critical decision would pass the suite
+silently. 100% here is intentional: both regions are small, pure logic,
+and fully specified by their oracles.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from mcp_context_forge_tpu.testing.oracles import TARGETS
+
+
+@pytest.mark.parametrize("name", sorted(TARGETS))
+def test_all_mutants_killed(name: str) -> None:
+    report = TARGETS[name].run()
+    assert report.total > 0
+    survivors = [s for s in report.survivors
+                 if s.lineno not in TARGETS[name].equivalent_lines]
+    assert not survivors, (
+        f"{name}: {len(survivors)}/{report.total} mutants survived: "
+        + "; ".join(f"L{s.lineno} {s.description}" for s in survivors))
+
+
+def test_mutator_generates_faults() -> None:
+    """The mutator itself: one fault per mutant, all distinct from source."""
+    from mcp_context_forge_tpu.testing.mutation import generate_mutants
+
+    src = ("def f(a, b):\n"
+           "    if a > 3 and not b:\n"
+           "        raise ValueError('x')\n"
+           "    return a == b\n")
+    mutants = generate_mutants(src)
+    kinds = {m.description for m in mutants}
+    assert {"Gt->GtE", "And->Or", "drop-not", "raise->pass",
+            "Eq->NotEq", "3->4"} <= kinds
+    assert all(m.source != src for m in mutants)
