@@ -141,6 +141,10 @@ class Settings(BaseModel):
     tpu_local_decode_block: int = 1     # decode steps fused per dispatch
     tpu_local_dtype: str = "bfloat16"
     tpu_local_embedding_model: str = "encoder-tiny"
+    # backend-init watchdog: a dead TPU runtime/tunnel can block jax.devices()
+    # forever; past this budget the engine raises EngineInitTimeout so the
+    # gateway fails fast instead of never binding its port (0 = no watchdog)
+    tpu_local_init_timeout_s: float = 120.0
 
     # --- SSO (JSON list: [{name, issuer, client_id, client_secret}]) ---
     sso_providers: str = ""

@@ -45,13 +45,12 @@ class Mutant:
 class CampaignReport:
     module: str
     total: int
-    killed: int
     survivors: list[Mutant] = field(default_factory=list)
     invalid: int = 0  # mutants that failed to even exec (count as killed)
 
     @property
-    def kill_rate(self) -> float:
-        return 1.0 if not self.total else (self.total - len(self.survivors)) / self.total
+    def killed(self) -> int:
+        return self.total - len(self.survivors)
 
 
 class _Mutator(ast.NodeTransformer):
@@ -187,18 +186,17 @@ def run_campaign(module_name: str, source: str, package: str,
     mutants = [m for m in generate_mutants(source)
                if m.lineno not in skip_lines
                and (line_range is None or line_range[0] <= m.lineno <= line_range[1])]
-    report = CampaignReport(module=module_name, total=len(mutants), killed=0)
+    report = CampaignReport(module=module_name, total=len(mutants))
     for m in mutants:
         try:
             mod = load_module_from_source(m.source, module_name, package)
         except Exception:
             report.invalid += 1
-            report.killed += 1
             continue
         try:
             oracle(mod)
         except Exception:
-            report.killed += 1
+            pass
         else:
             report.survivors.append(m)
     return report

@@ -69,6 +69,8 @@ class EngineConfig:
     # host<->device sync to 1/k per token; tokens decoded past EOS inside a
     # block are discarded (standard multi-step scheduling waste)
     decode_block: int = 1
+    # seconds to wait for jax backend init before failing fast (0 = forever)
+    init_timeout_s: float = 120.0
 
     @classmethod
     def from_settings(cls, settings) -> "EngineConfig":
@@ -86,6 +88,7 @@ class EngineConfig:
             sp_impl=getattr(settings, "tpu_local_sp_impl", "none"),
             sp_threshold=getattr(settings, "tpu_local_sp_threshold", 1024),
             decode_block=getattr(settings, "tpu_local_decode_block", 1),
+            init_timeout_s=getattr(settings, "tpu_local_init_timeout_s", 120.0),
         )
 
 
@@ -121,6 +124,43 @@ class EngineStats:
         self.queue_depth = 0
 
 
+class EngineInitTimeout(RuntimeError):
+    """jax backend init exceeded the watchdog budget (dead TPU runtime)."""
+
+
+def probe_devices(timeout_s: float) -> list:
+    """``jax.devices()`` under a watchdog.
+
+    A wedged TPU runtime (e.g. a dead tunnel to the chip) blocks backend
+    init indefinitely inside the PJRT client constructor; run it on a
+    daemon thread so a hang becomes a diagnosable exception instead of a
+    gateway that never binds its port. On success the backend is cached
+    process-wide, so every later jax call returns instantly.
+    """
+    if timeout_s <= 0:
+        return jax.devices()
+    result: dict[str, Any] = {}
+
+    def _probe() -> None:
+        try:
+            result["devices"] = jax.devices()
+        except Exception as exc:  # surfaced on the caller thread
+            result["error"] = exc
+
+    t = threading.Thread(target=_probe, name="tpu-init-probe", daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise EngineInitTimeout(
+            f"jax backend init did not complete within {timeout_s:.0f}s — "
+            "TPU runtime unreachable (set MCPFORGE_TPU_LOCAL_ENABLED=false "
+            "to serve without the engine, or raise "
+            "MCPFORGE_TPU_LOCAL_INIT_TIMEOUT_S)")
+    if "error" in result:
+        raise result["error"]
+    return result["devices"]
+
+
 class TPUEngine:
     """Owns params + KV pool on the mesh; device syncs run on the dispatch
     thread, token emission hops back to the asyncio loop."""
@@ -143,7 +183,8 @@ class TPUEngine:
         self._started = False
 
         dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
-        self.mesh = make_mesh(config.mesh_shape)
+        devices = probe_devices(config.init_timeout_s)
+        self.mesh = make_mesh(config.mesh_shape, devices=devices)
         logger.info("tpu_local: mesh %s, model %s", self.mesh.shape, config.model)
         if config.sp_impl != "none":
             # SP shard_map requires the sequence (bucket) to divide the axis;

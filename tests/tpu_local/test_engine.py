@@ -235,3 +235,51 @@ def test_decode_block_respects_max_tokens_and_capacity():
             await engine.stop()
 
     asyncio.run(main())
+
+
+def test_init_watchdog_times_out_on_wedged_backend(monkeypatch):
+    """A dead TPU runtime blocks jax.devices() forever; the watchdog must
+    convert that into a prompt EngineInitTimeout (gateway fails fast
+    instead of never binding its port)."""
+    import threading
+
+    from mcp_context_forge_tpu.tpu_local import engine as eng
+
+    release = threading.Event()
+
+    def wedged_devices():
+        release.wait(10)  # simulated dead tunnel; released in teardown
+        return []
+
+    monkeypatch.setattr(eng.jax, "devices", wedged_devices)
+    try:
+        with pytest.raises(eng.EngineInitTimeout, match="backend init"):
+            eng.probe_devices(0.2)
+    finally:
+        release.set()
+
+
+def test_init_watchdog_propagates_backend_errors(monkeypatch):
+    from mcp_context_forge_tpu.tpu_local import engine as eng
+
+    def broken_devices():
+        raise RuntimeError("no backend")
+
+    monkeypatch.setattr(eng.jax, "devices", broken_devices)
+    with pytest.raises(RuntimeError, match="no backend"):
+        eng.probe_devices(5.0)
+
+
+def test_init_watchdog_disabled_and_passthrough():
+    from mcp_context_forge_tpu.tpu_local import engine as eng
+
+    assert eng.probe_devices(0) == eng.jax.devices()
+    assert eng.probe_devices(30.0) == eng.jax.devices()
+
+
+def test_engine_config_carries_init_timeout():
+    from mcp_context_forge_tpu.config import load_settings
+
+    settings = load_settings(env_file=None)
+    cfg = EngineConfig.from_settings(settings)
+    assert cfg.init_timeout_s == settings.tpu_local_init_timeout_s > 0
