@@ -39,7 +39,7 @@ def _bow_vector(text: str, dim: int = 256) -> list[float]:
     """Hashed bag-of-words embedding (the reference's actual cache vectorizer)."""
     vec = [0.0] * dim
     for token in re.findall(r"[a-z0-9]+", text.lower()):
-        vec[int(hashlib.md5(token.encode()).hexdigest(), 16) % dim] += 1.0
+        vec[int(hashlib.md5(token.encode()).hexdigest(), 16) % dim] += 1.0  # seclint: allow S005 BoW feature hash, not a credential
     norm = math.sqrt(sum(v * v for v in vec)) or 1.0
     return [v / norm for v in vec]
 

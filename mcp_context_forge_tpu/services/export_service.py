@@ -9,6 +9,7 @@ under a bundle passphrase.
 
 from __future__ import annotations
 
+import re
 import time
 from typing import Any
 
@@ -21,6 +22,10 @@ EXPORT_TABLES = ["gateways", "tools", "resources", "prompts", "servers",
                  "a2a_agents", "llm_providers", "llm_models", "plugin_bindings"]
 
 SECRET_COLUMNS = {"auth_value", "config"}
+
+# bundle row keys become INSERT column identifiers — a hostile bundle must
+# not be able to smuggle SQL through them (values always ride ? params)
+_IDENTIFIER = re.compile(r"^[a-z_][a-z0-9_]*$")
 
 
 class ExportService:
@@ -36,7 +41,7 @@ class ExportService:
             "entities": {},
         }
         for table in EXPORT_TABLES:
-            rows = await self.ctx.db.fetchall(f"SELECT * FROM {table}")
+            rows = await self.ctx.db.fetchall(f"SELECT * FROM {table}")  # seclint: allow S006 table from EXPORT_TABLES constant
             if not include_secrets:
                 for row in rows:
                     for column in SECRET_COLUMNS & row.keys():
@@ -70,9 +75,11 @@ class ExportService:
                             row[column] = encrypt_field(
                                 plain, self.ctx.settings.auth_encryption_secret)
                 columns = list(row.keys())
+                if not all(_IDENTIFIER.fullmatch(c) for c in columns):
+                    continue  # hostile/garbled bundle row
                 marks = ",".join("?" for _ in columns)
                 try:
-                    await self.ctx.db.execute(
+                    await self.ctx.db.execute(  # seclint: allow S006 identifiers validated above, values parameterized
                         f"INSERT OR {conflict} INTO {table} ({','.join(columns)})"
                         f" VALUES ({marks})", [row[c] for c in columns])
                     count += 1

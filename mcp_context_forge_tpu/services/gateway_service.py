@@ -102,7 +102,7 @@ class GatewayService:
         if sets:
             sets.append("updated_at=?")
             params.extend([now(), gateway_id])
-            await self.ctx.db.execute(f"UPDATE gateways SET {', '.join(sets)} WHERE id=?", params)
+            await self.ctx.db.execute(f"UPDATE gateways SET {', '.join(sets)} WHERE id=?", params)  # seclint: allow S006 column names from pydantic schema fields
         await self.ctx.bus.publish("gateways.changed", {"action": "update", "id": gateway_id})
         return await self.get_gateway(gateway_id)
 

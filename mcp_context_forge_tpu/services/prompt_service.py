@@ -92,7 +92,7 @@ class PromptService:
         if sets:
             sets.append("updated_at=?")
             params.extend([now(), prompt_id])
-            await self.ctx.db.execute(f"UPDATE prompts SET {', '.join(sets)} WHERE id=?", params)
+            await self.ctx.db.execute(f"UPDATE prompts SET {', '.join(sets)} WHERE id=?", params)  # seclint: allow S006 column names from pydantic schema fields
         await self.ctx.bus.publish("prompts.changed", {"action": "update", "id": prompt_id})
         return await self.get_prompt(prompt_id)
 

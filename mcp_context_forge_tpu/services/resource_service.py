@@ -81,7 +81,7 @@ class ResourceService:
         if sets:
             sets.append("updated_at=?")
             params.extend([now(), resource_id])
-            await self.ctx.db.execute(f"UPDATE resources SET {', '.join(sets)} WHERE id=?", params)
+            await self.ctx.db.execute(f"UPDATE resources SET {', '.join(sets)} WHERE id=?", params)  # seclint: allow S006 column names from pydantic schema fields
         await self.ctx.bus.publish("resources.changed", {"action": "update", "id": resource_id,
                                                          "uri": row["uri"]})
         return await self.get_resource(resource_id)

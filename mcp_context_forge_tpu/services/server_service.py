@@ -103,7 +103,7 @@ class ServerService:
         if sets:
             sets.append("updated_at=?")
             params.extend([now(), server_id])
-            await self.ctx.db.execute(f"UPDATE servers SET {', '.join(sets)} WHERE id=?", params)
+            await self.ctx.db.execute(f"UPDATE servers SET {', '.join(sets)} WHERE id=?", params)  # seclint: allow S006 column names from pydantic schema fields
         await self._set_associations(server_id, assoc_tools, assoc_resources, assoc_prompts)
         await self.ctx.bus.publish("servers.changed", {"action": "update", "id": server_id})
         return await self.get_server(server_id)

@@ -139,7 +139,7 @@ class ToolService:
             sets.append("updated_at=?")
             params.append(now())
             params.append(tool_id)
-            await self.ctx.db.execute(f"UPDATE tools SET {', '.join(sets)} WHERE id=?", params)
+            await self.ctx.db.execute(f"UPDATE tools SET {', '.join(sets)} WHERE id=?", params)  # seclint: allow S006 column names from pydantic schema fields
         self._lookup_cache.clear()
         await self.ctx.bus.publish("tools.changed", {"action": "update", "id": tool_id})
         return await self.get_tool(tool_id)

@@ -6,6 +6,10 @@ changes observable behavior. Targets are the pure-logic, security-critical
 modules where a silent fault is most expensive — JSON-RPC validation and
 the RBAC permission check (reference gates the same surfaces through its
 mutmut run, `run_mutmut.py`).
+
+Oracles signal a killed mutant by raising — plain ``assert`` is their
+mechanism, not auth enforcement, and they never run under ``python -O``.
+# seclint: file-allow S008
 """
 
 from __future__ import annotations

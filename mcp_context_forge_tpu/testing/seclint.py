@@ -1,0 +1,297 @@
+"""In-tree static security linter (bandit/semgrep analog, SURVEY §5.2).
+
+The reference gates CI on bandit + semgrep rule packs; neither tool is in
+this image, so the high-signal rules are re-implemented over ``ast``:
+
+- S001 eval/exec
+- S002 shell execution (os.system/os.popen, subprocess ``shell=True``)
+- S003 unsafe deserialization (pickle/marshal loads)
+- S004 yaml.load without an explicit Safe loader
+- S005 weak hash (md5/sha1) — allowlist non-crypto uses with a trailing
+       ``# seclint: allow S005 <reason>`` comment
+- S006 SQL built by interpolation (f-string/%/+/.format) passed straight
+       to an execute/fetch call — the codebase contract is ``?`` params
+- S007 tempfile.mktemp (TOCTOU)
+- S008 ``assert`` used for auth/permission enforcement in non-test code
+       (stripped under ``python -O``)
+
+Findings fail the suite via ``tests/security/test_seclint.py``; suppress a
+true-but-accepted finding with the trailing allow comment so every
+exception is visible and greppable, exactly like ``# nosec``.
+
+CLI: ``python -m mcp_context_forge_tpu.testing.seclint [path...]``
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+
+_ALLOW_RE = re.compile(r"#\s*seclint:\s*allow\s+(S\d{3})")
+_FILE_ALLOW_RE = re.compile(r"#\s*seclint:\s*file-allow\s+(S\d{3})")
+
+_SHELL_FUNCS = {("os", "system"), ("os", "popen")}
+_PICKLE_FUNCS = {("pickle", "load"), ("pickle", "loads"),
+                 ("marshal", "load"), ("marshal", "loads")}
+_WEAK_HASHES = {"md5", "sha1"}
+_SQL_METHODS = {"execute", "executemany", "executescript",
+                "fetchone", "fetchall", "fetchval"}
+_AUTH_HINTS = re.compile(r"admin|permission|auth|token|scope|secret", re.I)
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    lineno: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.lineno}: {self.rule} {self.message}"
+
+
+def _dotted(node: ast.AST) -> tuple[str, ...]:
+    """('os','path','join') for os.path.join; () when not a plain name path."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return ()
+
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+
+def _own_statements(body: list[ast.stmt]) -> list[ast.AST]:
+    """All nodes in ``body`` excluding nested function/class scopes."""
+    out: list[ast.AST] = []
+    stack: list[ast.AST] = [n for n in body if not isinstance(n, _SCOPE_NODES)]
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        stack.extend(child for child in ast.iter_child_nodes(node)
+                     if not isinstance(child, _SCOPE_NODES))
+    return out
+
+
+def _is_clean(node: ast.AST, clean: set[str]) -> bool:
+    """True when the expression provably contains no tainted data: constant
+    strings, variables only ever assigned clean strings, concatenation /
+    f-strings / ``sep.join(...)`` of clean parts."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, str)
+    if isinstance(node, ast.Name):
+        return node.id in clean
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(_is_clean(e, clean) for e in node.elts)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Add, ast.Mod)):
+        return _is_clean(node.left, clean) and _is_clean(node.right, clean)
+    if isinstance(node, ast.JoinedStr):
+        return all(_is_clean(v.value, clean) for v in node.values
+                   if isinstance(v, ast.FormattedValue))
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join" and len(node.args) == 1
+            and _is_clean(node.func.value, clean)):
+        arg = node.args[0]
+        if isinstance(arg, (ast.GeneratorExp, ast.ListComp)):
+            return _is_clean(arg.elt, clean)
+        return _is_clean(arg, clean)
+    return False
+
+
+def _clean_vars(body: list[ast.stmt]) -> tuple[set[str], set[str]]:
+    """(assigned, clean) for the scope.
+
+    Fixed-point: a local is clean iff every assignment to it is clean.
+    ``assigned`` lets the caller distinguish "tracked and tainted" from
+    "unknown" (parameters, imports) — only tracked-tainted names are
+    worth flagging when passed bare.
+    """
+    assigns: dict[str, list[ast.AST]] = {}
+    opaque = ast.Call(func=ast.Name(id="<opaque>", ctx=ast.Load()),
+                      args=[], keywords=[])
+
+    def record(target: ast.expr, value: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            assigns.setdefault(target.id, []).append(value)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            # pair elementwise when the value is a matching literal
+            # (``a, b = [], []``); otherwise the unpacking is opaque
+            if isinstance(value, (ast.Tuple, ast.List)) and \
+                    len(value.elts) == len(target.elts):
+                for el, val in zip(target.elts, value.elts):
+                    record(el, val)
+            else:
+                for el in target.elts:
+                    record(el, opaque)
+
+    for node in _own_statements(body):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                record(t, node.value)
+        elif isinstance(node, ast.AugAssign):
+            record(node.target, node.value)
+        elif isinstance(node, (ast.AnnAssign, ast.NamedExpr)) and node.value:
+            record(node.target, node.value)
+        elif (isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)
+              and isinstance(node.value.func, ast.Attribute)
+              and isinstance(node.value.func.value, ast.Name)
+              and node.value.func.attr in ("append", "extend", "insert")
+              and node.value.args):
+            # mutations count as assignments for list cleanliness
+            arg = node.value.args[-1]
+            record(ast.Name(id=node.value.func.value.id, ctx=ast.Store()), arg)
+    # optimistic start (all locals clean), then strip any var with a
+    # non-clean assignment until stable; self-reference (sql += "...")
+    # stays clean as long as every fragment is
+    clean = set(assigns)
+    while True:
+        nxt = {v for v in clean
+               if all(_is_clean(e, clean) for e in assigns[v])}
+        if nxt == clean:
+            return set(assigns), clean
+        clean = nxt
+
+
+class _Scanner(ast.NodeVisitor):
+    def __init__(self, path: str, allowed: dict[int, set[str]]):
+        self.path = path
+        self.allowed = allowed
+        self.findings: list[Finding] = []
+        self._scopes: list[tuple[set[str], set[str]]] = []
+
+    def _resolve(self) -> tuple[set[str], set[str]]:
+        """(assigned, clean) with innermost-wins shadowing: a clean outer
+        binding must not launder a tainted inner rebinding of the name."""
+        assigned: set[str] = set()
+        clean: set[str] = set()
+        for scope_assigned, scope_clean in self._scopes:  # outer -> inner
+            assigned |= scope_assigned
+            clean -= scope_assigned          # inner rebinding shadows outer
+            clean |= scope_clean
+        return assigned, clean
+
+    def visit_Module(self, node: ast.Module) -> None:
+        self._scopes.append(_clean_vars(node.body))
+        self.generic_visit(node)
+        self._scopes.pop()
+
+    def _visit_scope(self, node) -> None:
+        self._scopes.append(_clean_vars(node.body))
+        self.generic_visit(node)
+        self._scopes.pop()
+
+    visit_FunctionDef = _visit_scope
+    visit_AsyncFunctionDef = _visit_scope
+
+    def _flag(self, rule: str, node: ast.AST, message: str) -> None:
+        lineno = getattr(node, "lineno", 0)
+        if rule in self.allowed.get(lineno, set()):
+            return
+        self.findings.append(Finding(rule, self.path, lineno, message))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = _dotted(node.func)
+        name = dotted[-1] if dotted else ""
+
+        if dotted in (("eval",), ("exec",)):
+            self._flag("S001", node, f"use of {name}()")
+        if dotted in _SHELL_FUNCS:
+            self._flag("S002", node, f"shell execution via {'.'.join(dotted)}")
+        for kw in node.keywords:
+            if (kw.arg == "shell" and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True):
+                self._flag("S002", node, "subprocess call with shell=True")
+        if dotted in _PICKLE_FUNCS:
+            self._flag("S003", node,
+                       f"unsafe deserialization: {'.'.join(dotted)}")
+        if len(dotted) >= 2 and dotted[-2:] == ("yaml", "load"):
+            loader: ast.AST | None = None
+            if len(node.args) >= 2:
+                loader = node.args[1]
+            for kw in node.keywords:
+                if kw.arg == "Loader":
+                    loader = kw.value
+            loader_name = _dotted(loader)[-1] if loader is not None and \
+                _dotted(loader) else ""
+            if "Safe" not in loader_name:
+                self._flag("S004", node,
+                           "yaml.load without a Safe loader "
+                           "(use yaml.safe_load or Loader=yaml.SafeLoader)")
+        if len(dotted) >= 1 and name in _WEAK_HASHES and \
+                dotted[0] in ("hashlib", name):
+            self._flag("S005", node, f"weak hash {name} "
+                       "(allow non-crypto uses explicitly)")
+        if name in _SQL_METHODS and node.args:
+            sql = node.args[0]
+            dynamic = isinstance(sql, (ast.JoinedStr, ast.BinOp)) or (
+                isinstance(sql, ast.Call)
+                and isinstance(sql.func, ast.Attribute)
+                and sql.func.attr in ("format", "join"))
+            assigned, clean = self._resolve()
+            tainted_name = (isinstance(sql, ast.Name)
+                            and sql.id in assigned
+                            and sql.id not in clean)
+            if tainted_name or (dynamic and not _is_clean(sql, clean)):
+                self._flag("S006", node,
+                           f"{name}() with interpolated SQL "
+                           "(tainted or unprovable fragment)")
+        if dotted == ("tempfile", "mktemp"):
+            self._flag("S007", node, "tempfile.mktemp is TOCTOU-unsafe")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        text = ast.dump(node.test)
+        if _AUTH_HINTS.search(text):
+            self._flag("S008", node,
+                       "assert used for auth/permission logic "
+                       "(stripped under python -O)")
+        self.generic_visit(node)
+
+
+def scan_file(path: Path) -> list[Finding]:
+    source = path.read_text()
+    allowed: dict[int, set[str]] = {}
+    file_allowed: set[str] = set()
+    for i, line in enumerate(source.splitlines(), start=1):
+        for m in _ALLOW_RE.finditer(line):
+            allowed.setdefault(i, set()).add(m.group(1))
+        if i <= 30:  # file-level directives live in the module header
+            for m in _FILE_ALLOW_RE.finditer(line):
+                file_allowed.add(m.group(1))
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        return [Finding("S000", str(path), exc.lineno or 0, "syntax error")]
+    scanner = _Scanner(str(path), allowed)
+    scanner.visit(tree)
+    return [f for f in scanner.findings if f.rule not in file_allowed]
+
+
+def scan_tree(root: Path) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in sorted(root.rglob("*.py")):
+        findings.extend(scan_file(path))
+    return findings
+
+
+def main(argv: list[str]) -> int:
+    roots = [Path(a) for a in argv] or [Path(__file__).resolve().parent.parent]
+    findings: list[Finding] = []
+    for root in roots:
+        findings.extend(scan_tree(root) if root.is_dir() else scan_file(root))
+    for f in findings:
+        print(f)
+    print(f"{len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    raise SystemExit(main(sys.argv[1:]))

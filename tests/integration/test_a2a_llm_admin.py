@@ -141,6 +141,31 @@ async def test_export_import_roundtrip():
         await target.close()
 
 
+async def test_import_rejects_sql_in_column_identifiers():
+    """A hostile bundle must not smuggle SQL through row keys (they become
+    INSERT column identifiers); the row is skipped, the rest imports."""
+    gateway = await make_client()
+    try:
+        await gateway.post("/tools", json={
+            "name": "legit", "integration_type": "REST",
+            "url": "http://example.invalid/x"}, auth=AUTH)
+        bundle = (await (await gateway.get("/export", auth=AUTH)).json())
+        row = dict(bundle["entities"]["tools"][0])
+        row["id"] = "reimported-1"
+        hostile = {"entities": {"tools": [
+            {"id) VALUES ('pwn'); DROP TABLE tools; --": "x"},
+            {"name\n": "trailing-newline-identifier"},
+            row,
+        ]}}
+        resp = await gateway.post("/import", json=hostile, auth=AUTH)
+        summary = await resp.json()
+        assert summary["imported"]["tools"] == 1  # only the legit row
+        resp = await gateway.get("/tools", auth=AUTH)
+        assert resp.status == 200  # tools table intact
+    finally:
+        await gateway.close()
+
+
 async def test_websocket_transport():
     gateway = await make_client()
     try:

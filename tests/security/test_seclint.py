@@ -1,0 +1,159 @@
+"""Static-security gate (reference analog: bandit + semgrep CI jobs).
+
+The whole package must scan clean — every accepted exception is a visible
+``# seclint: allow`` annotation at the site, so this test pins both the
+ruleset and the exception inventory.
+"""
+
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+from mcp_context_forge_tpu.testing.seclint import scan_file, scan_tree
+
+PKG = Path(__file__).resolve().parent.parent.parent / "mcp_context_forge_tpu"
+
+
+def test_package_scans_clean() -> None:
+    findings = scan_tree(PKG)
+    assert not findings, "\n".join(str(f) for f in findings)
+
+
+def _scan_snippet(tmp_path: Path, code: str):
+    p = tmp_path / "snippet.py"
+    p.write_text(textwrap.dedent(code))
+    return scan_file(p)
+
+
+def test_rules_fire(tmp_path: Path) -> None:
+    findings = _scan_snippet(tmp_path, """
+        import hashlib, os, pickle, subprocess, tempfile, yaml
+
+        eval("1+1")
+        os.system("ls")
+        subprocess.run("ls", shell=True)
+        pickle.loads(b"")
+        yaml.load("x")
+        hashlib.md5(b"pw")
+        tempfile.mktemp()
+
+        def f(db, user):
+            db.execute(f"SELECT * FROM t WHERE id={user}")
+            assert user.is_admin, "auth check"
+    """)
+    rules = {f.rule for f in findings}
+    assert rules == {"S001", "S002", "S003", "S004", "S005",
+                     "S006", "S007", "S008"}
+
+
+def test_taint_pass_accepts_constant_sql(tmp_path: Path) -> None:
+    findings = _scan_snippet(tmp_path, """
+        def f(db, include_inactive):
+            sql = "SELECT * FROM tools"
+            if not include_inactive:
+                sql += " WHERE enabled=1"
+            db.fetchall(sql + " ORDER BY name")
+            marks = ",".join("?" for _ in range(3))
+            db.execute(f"DELETE FROM t WHERE id IN ({marks})", (1, 2, 3))
+    """)
+    assert not findings, findings
+
+
+def test_taint_pass_tracks_clause_lists(tmp_path: Path) -> None:
+    """The WHERE-clause builder pattern: constant fragments appended to a
+    list then joined must be provably clean; a tainted append poisons it."""
+    findings = _scan_snippet(tmp_path, """
+        def search(db, actor):
+            sql = "SELECT * FROM audit_trail"
+            clauses, params = [], []
+            if actor:
+                clauses.append("actor=?")
+                params.append(actor)
+            if clauses:
+                sql += " WHERE " + " AND ".join(clauses)
+            db.fetchall(sql, params)
+
+        def poisoned(db, frag):
+            clauses = []
+            clauses.append(frag)
+            db.fetchall("SELECT * FROM t WHERE " + " AND ".join(clauses))
+    """)
+    assert [f.rule for f in findings] == ["S006"]
+    assert findings[0].lineno > 12  # only the poisoned variant
+
+
+def test_taint_pass_rejects_interpolated_values(tmp_path: Path) -> None:
+    findings = _scan_snippet(tmp_path, """
+        def f(db, name):
+            db.execute(f"SELECT * FROM t WHERE name='{name}'")
+
+        def g(db, frag):
+            sql = "SELECT * FROM t WHERE " + frag
+            db.execute(sql)
+    """)
+    assert [f.rule for f in findings] == ["S006", "S006"]
+
+
+def test_bare_join_of_tainted_list_is_flagged(tmp_path: Path) -> None:
+    findings = _scan_snippet(tmp_path, """
+        def f(db, clauses):
+            db.execute(" AND ".join(clauses))
+    """)
+    assert [f.rule for f in findings] == ["S006"]
+
+
+def test_nested_scopes_do_not_leak_taint(tmp_path: Path) -> None:
+    """A tainted local in one function must not poison a same-named module
+    constant used elsewhere; a clean outer binding must not launder a
+    tainted inner rebinding."""
+    findings = _scan_snippet(tmp_path, """
+        BASE = "SELECT * FROM t"
+
+        def unrelated(user):
+            BASE = "WHERE " + user
+            return BASE
+
+        def ok(db):
+            db.execute(BASE)
+
+        def outer(db, u):
+            q = "SELECT 1"
+            def inner(db2):
+                q = "X WHERE " + u
+                db2.execute(q)
+            return q
+    """)
+    assert [(f.rule, f.lineno) for f in findings] == [("S006", 15)]
+
+
+def test_yaml_loader_safety(tmp_path: Path) -> None:
+    findings = _scan_snippet(tmp_path, """
+        import yaml
+
+        yaml.load(x)                            # flagged: no loader
+        yaml.load(x, Loader=yaml.Loader)        # flagged: full loader
+        yaml.load(x, yaml.SafeLoader)           # ok: positional safe
+        yaml.load(x, Loader=yaml.CSafeLoader)   # ok: keyword safe
+    """)
+    assert [(f.rule, f.lineno) for f in findings] == [("S004", 4), ("S004", 5)]
+
+
+def test_allow_annotations(tmp_path: Path) -> None:
+    findings = _scan_snippet(tmp_path, """
+        import hashlib
+
+        hashlib.md5(b"x")  # seclint: allow S005 cache key only
+        eval("1")
+    """)
+    assert [f.rule for f in findings] == ["S001"]
+
+
+def test_file_allow_directive(tmp_path: Path) -> None:
+    findings = _scan_snippet(tmp_path, """
+        # seclint: file-allow S008
+        def f(ctx):
+            assert ctx.is_admin
+            eval("1")
+    """)
+    assert [f.rule for f in findings] == ["S001"]
