@@ -263,6 +263,10 @@ async def bench_engine_configs(platform: str) -> dict:
             "tokens_per_s": round(tokens4 / wall4, 2),
             "failures": sum(1 for r in results if not r[2]),
             "wall_s": round(wall4, 2)}
+        # --- config5: federated multi-tool ReAct agent loop, full plugin chain
+        out["config5_federated_react"] = await _bench_react_loop(
+            app, gateway, upstream, auth, model, platform)
+
         engine = app.get("tpu_engine")
         if engine is not None:
             out["decode_steps"] = engine.stats.decode_steps
@@ -271,6 +275,93 @@ async def bench_engine_configs(platform: str) -> dict:
         await gateway.close()
         await upstream.close()
     return out
+
+
+async def _bench_react_loop(app, gateway, upstream, auth, model: str,
+                            platform: str) -> dict:
+    """BASELINE config 5: concurrent ReAct agents alternating tpu_local
+    thoughts with tool calls that resolve over the federation path
+    (hub -> peer gateway -> REST upstream), moderation chain active."""
+    from mcp_context_forge_tpu.plugins.framework import PluginConfig
+
+    peer_app, peer, _ = await _make_gateway(engine=False, platform=platform)
+    try:
+        for tool in ("fed-search", "fed-calc"):
+            await _register_tool(peer, upstream, auth, tool)
+        peer_url = f"http://{peer.server.host}:{peer.server.port}/mcp"
+        resp = await gateway.post("/gateways", json={
+            "name": "react-peer", "url": peer_url,
+            "transport": "streamablehttp", "auth_type": "basic",
+            "auth_value": {"username": "admin", "password": "changeme"},
+        }, auth=auth)
+        assert resp.status == 201, await resp.text()
+
+        pm = app["plugin_manager"]
+        await pm.add_plugin(PluginConfig(name="mod5", kind="content_moderation",
+                                         config={"use_engine": True,
+                                                 "threshold": 2.0}))
+        await pm.add_plugin(PluginConfig(name="harm5",
+                                         kind="harmful_content_detector",
+                                         config={"use_engine": True,
+                                                 "threshold": 2.0,
+                                                 "action": "annotate"}))
+
+        agents = int(os.environ.get("BENCH_REACT_AGENTS", "16"))
+        iterations = int(os.environ.get("BENCH_REACT_ITERATIONS", "2"))
+
+        async def agent(i: int):
+            started = time.monotonic()
+            llm_steps = tool_steps = 0
+            ok = True
+            history = f"Question {i}: what is the metric value?"
+            try:
+                for step in range(iterations):
+                    resp = await gateway.post(
+                        "/v1/chat/completions", auth=auth, json={
+                            "model": model, "max_tokens": 16,
+                            "messages": [{"role": "user", "content": history}]})
+                    body = await resp.json()
+                    if resp.status != 200 or not body.get("choices"):
+                        ok = False
+                        break
+                    thought = body["choices"][0]["message"]["content"][:80]
+                    llm_steps += 1
+                    tool = "fed-search" if step % 2 == 0 else "fed-calc"
+                    resp = await gateway.post("/mcp", auth=auth, json={
+                        "jsonrpc": "2.0", "id": f"{i}-{step}",
+                        "method": "tools/call",
+                        "params": {"name": tool,
+                                   "arguments": {"q": thought}}})
+                    body = await resp.json()
+                    if resp.status != 200 or "result" not in body or \
+                            body["result"].get("isError"):
+                        ok = False
+                        break
+                    tool_steps += 1
+                    history += f"\nObservation {step}: ok"
+            except Exception:
+                ok = False
+            return (time.monotonic() - started) * 1000, llm_steps, tool_steps, ok
+
+        await agent(-1)  # warmup (compiles nothing new; primes federation)
+        wall_start = time.monotonic()
+        results = await asyncio.gather(*[agent(i) for i in range(agents)])
+        wall = time.monotonic() - wall_start
+        lat = [r[0] for r in results]
+        steps = sum(r[1] + r[2] for r in results)
+        result = {
+            **_percentiles(lat),
+            "agents": agents, "iterations": iterations,
+            "llm_steps": sum(r[1] for r in results),
+            "federated_tool_steps": sum(r[2] for r in results),
+            "steps_per_s": round(steps / wall, 2),
+            "failures": sum(1 for r in results if not r[3]),
+            "wall_s": round(wall, 2)}
+        await pm.remove_plugin("mod5")
+        await pm.remove_plugin("harm5")
+        return result
+    finally:
+        await peer.close()
 
 
 async def run_bench(platform: str) -> dict:

@@ -104,13 +104,16 @@ def _is_clean(node: ast.AST, clean: set[str]) -> bool:
     return False
 
 
-def _clean_vars(body: list[ast.stmt]) -> tuple[set[str], set[str]]:
+def _clean_vars(body: list[ast.stmt],
+                params: tuple[str, ...] = ()) -> tuple[set[str], set[str]]:
     """(assigned, clean) for the scope.
 
     Fixed-point: a local is clean iff every assignment to it is clean.
-    ``assigned`` lets the caller distinguish "tracked and tainted" from
-    "unknown" (parameters, imports) — only tracked-tainted names are
-    worth flagging when passed bare.
+    ``params`` (function arguments) bind as opaque so a parameter
+    shadowing a clean outer constant cannot launder taint; ``assigned``
+    lets the caller distinguish "tracked and tainted" from "unknown"
+    (imports, builtins) — only tracked-tainted names are worth flagging
+    when passed bare.
     """
     assigns: dict[str, list[ast.AST]] = {}
     opaque = ast.Call(func=ast.Name(id="<opaque>", ctx=ast.Load()),
@@ -130,6 +133,9 @@ def _clean_vars(body: list[ast.stmt]) -> tuple[set[str], set[str]]:
                 for el in target.elts:
                     record(el, opaque)
 
+    for name in params or ():
+        assigns.setdefault(name, []).append(opaque)
+
     for node in _own_statements(body):
         if isinstance(node, ast.Assign):
             for t in node.targets:
@@ -138,6 +144,16 @@ def _clean_vars(body: list[ast.stmt]) -> tuple[set[str], set[str]]:
             record(node.target, node.value)
         elif isinstance(node, (ast.AnnAssign, ast.NamedExpr)) and node.value:
             record(node.target, node.value)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            record(node.target, opaque)     # loop over unknown iterable
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    record(item.optional_vars, opaque)
+        elif isinstance(node, (ast.GeneratorExp, ast.ListComp, ast.SetComp,
+                               ast.DictComp)):
+            for comp in node.generators:
+                record(comp.target, opaque)
         elif (isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)
               and isinstance(node.value.func, ast.Attribute)
               and isinstance(node.value.func.value, ast.Name)
@@ -182,7 +198,12 @@ class _Scanner(ast.NodeVisitor):
         self._scopes.pop()
 
     def _visit_scope(self, node) -> None:
-        self._scopes.append(_clean_vars(node.body))
+        a = node.args
+        params = tuple(arg.arg for arg in
+                       [*a.posonlyargs, *a.args, *a.kwonlyargs,
+                        *([a.vararg] if a.vararg else []),
+                        *([a.kwarg] if a.kwarg else [])])
+        self._scopes.append(_clean_vars(node.body, params))
         self.generic_visit(node)
         self._scopes.pop()
 
@@ -254,16 +275,41 @@ class _Scanner(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def scan_file(path: Path) -> list[Finding]:
-    source = path.read_text()
+def _allow_directives(source: str) -> tuple[dict[int, set[str]], set[str]]:
+    """Per-line and file-level allow directives from REAL comments only —
+    a string literal containing the directive text must not whitelist
+    anything (tokenized, the way bandit matches ``# nosec``).
+
+    File-level directives also count when they appear in the module
+    docstring header (first statement), where multi-line policy notes
+    naturally live.
+    """
+    import io
+    import tokenize
+
     allowed: dict[int, set[str]] = {}
     file_allowed: set[str] = set()
-    for i, line in enumerate(source.splitlines(), start=1):
-        for m in _ALLOW_RE.finditer(line):
-            allowed.setdefault(i, set()).add(m.group(1))
-        if i <= 30:  # file-level directives live in the module header
-            for m in _FILE_ALLOW_RE.finditer(line):
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return allowed, file_allowed
+    for tok in tokens:
+        if tok.type == tokenize.COMMENT:
+            for m in _ALLOW_RE.finditer(tok.string):
+                allowed.setdefault(tok.start[0], set()).add(m.group(1))
+            if tok.start[0] <= 30:
+                for m in _FILE_ALLOW_RE.finditer(tok.string):
+                    file_allowed.add(m.group(1))
+        elif tok.type == tokenize.STRING and tok.start[0] == 1:
+            # module docstring: file-level directives only
+            for m in _FILE_ALLOW_RE.finditer(tok.string):
                 file_allowed.add(m.group(1))
+    return allowed, file_allowed
+
+
+def scan_file(path: Path) -> list[Finding]:
+    source = path.read_text()
+    allowed, file_allowed = _allow_directives(source)
     try:
         tree = ast.parse(source)
     except SyntaxError as exc:

@@ -149,6 +149,36 @@ def test_allow_annotations(tmp_path: Path) -> None:
     assert [f.rule for f in findings] == ["S001"]
 
 
+def test_allow_inside_string_literal_does_not_suppress(tmp_path: Path) -> None:
+    findings = _scan_snippet(tmp_path, """
+        x = "# seclint: allow S001"; eval("1")
+    """)
+    assert [f.rule for f in findings] == ["S001"]
+
+
+def test_parameter_shadowing_clean_constant_is_tainted(tmp_path: Path) -> None:
+    findings = _scan_snippet(tmp_path, """
+        BASE = "SELECT * FROM t"
+
+        def f(db, BASE):
+            db.execute(BASE)
+    """)
+    assert [f.rule for f in findings] == ["S006"]
+
+
+def test_for_loop_and_with_targets_are_tainted(tmp_path: Path) -> None:
+    findings = _scan_snippet(tmp_path, """
+        def f(db, rows):
+            for sql in rows:
+                db.execute(sql)
+
+        def g(db, opener):
+            with opener() as sql:
+                db.execute(sql)
+    """)
+    assert [f.rule for f in findings] == ["S006", "S006"]
+
+
 def test_file_allow_directive(tmp_path: Path) -> None:
     findings = _scan_snippet(tmp_path, """
         # seclint: file-allow S008

@@ -11,13 +11,10 @@ from mcp_context_forge_tpu.tpu_local.ops.paged_attention import (
 )
 
 
-def test_paged_decode_matches_gather_reference():
-    CFG = MODEL_CONFIGS["llama3-test"]  # KV=2, H=4, hd=16
-    page_size, num_pages, slots, per_slot = 8, 16, 3, 4
+def _check_against_gather(CFG, page_size, num_pages, slots, per_slot, seq_lens):
     kv = init_kv_state(CFG, num_pages, page_size, slots, per_slot,
                        dtype=jnp.float32)
     alloc = PageAllocator(num_pages, page_size, slots, per_slot)
-    seq_lens = [13, 5, 20]
     for slot, n in enumerate(seq_lens):
         assert alloc.allocate_slot(slot, n)
     kv = kv._replace(block_tables=alloc.tables())
@@ -53,3 +50,18 @@ def test_paged_decode_matches_gather_reference():
         interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_paged_decode_matches_gather_reference():
+    CFG = MODEL_CONFIGS["llama3-test"]  # KV=2, H=4, hd=16
+    _check_against_gather(CFG, page_size=8, num_pages=16, slots=3, per_slot=4,
+                          seq_lens=[13, 5, 20])
+
+
+def test_paged_decode_llama1b_geometry():
+    """Exact llama3-1b attention geometry (KV=8, G=4, head_dim=64) — the
+    shape the TPU gate must admit for the 1B serving path."""
+    class Geo:
+        n_kv_heads, n_heads, head_dim, n_layers = 8, 32, 64, 1
+    _check_against_gather(Geo, page_size=16, num_pages=24, slots=2, per_slot=8,
+                          seq_lens=[19, 33])
