@@ -26,16 +26,11 @@ V5E_PEAK_BF16_TFLOPS = 197.0  # per chip
 
 
 def count_params(config) -> int:
-    """Parameter count from the Llama geometry (embed + layers + head)."""
-    d, v = config.dim, config.vocab_size
-    head_dim = config.head_dim
-    kv_dim = config.n_kv_heads * head_dim
-    per_layer = (d * d +            # wq
-                 2 * d * kv_dim +   # wk, wv
-                 d * d +            # wo
-                 3 * d * config.ffn_hidden +  # w1, w3, w2
-                 2 * d)             # norms
-    return v * d * 2 + config.n_layers * per_layer + d
+    """Parameter count (single source of truth: models.llama.param_count —
+    handles tied embeddings and Qwen2 attention biases)."""
+    from mcp_context_forge_tpu.tpu_local.models.llama import param_count
+
+    return param_count(config)
 
 
 async def run(platform: str) -> dict:

@@ -210,6 +210,18 @@ class _Scanner(ast.NodeVisitor):
     visit_FunctionDef = _visit_scope
     visit_AsyncFunctionDef = _visit_scope
 
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # a lambda's params are a scope too: `lambda db, sql: db.execute(sql)`
+        # must flag exactly like the def spelling
+        a = node.args
+        params = tuple(arg.arg for arg in
+                       [*a.posonlyargs, *a.args, *a.kwonlyargs,
+                        *([a.vararg] if a.vararg else []),
+                        *([a.kwarg] if a.kwarg else [])])
+        self._scopes.append((set(params), set()))
+        self.generic_visit(node)
+        self._scopes.pop()
+
     def _flag(self, rule: str, node: ast.AST, message: str) -> None:
         lineno = getattr(node, "lineno", 0)
         if rule in self.allowed.get(lineno, set()):
@@ -300,10 +312,16 @@ def _allow_directives(source: str) -> tuple[dict[int, set[str]], set[str]]:
             if tok.start[0] <= 30:
                 for m in _FILE_ALLOW_RE.finditer(tok.string):
                     file_allowed.add(m.group(1))
-        elif tok.type == tokenize.STRING and tok.start[0] == 1:
-            # module docstring: file-level directives only
-            for m in _FILE_ALLOW_RE.finditer(tok.string):
-                file_allowed.add(m.group(1))
+    # the REAL module docstring (per ast, not "a string on line 1" — an
+    # assigned string literal must not launder directives) may also carry
+    # file-level directives: that's where policy notes naturally live
+    try:
+        doc = ast.get_docstring(ast.parse(source), clean=False)
+    except SyntaxError:
+        doc = None
+    if doc:
+        for m in _FILE_ALLOW_RE.finditer(doc):
+            file_allowed.add(m.group(1))
     return allowed, file_allowed
 
 

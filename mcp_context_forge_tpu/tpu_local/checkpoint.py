@@ -50,12 +50,17 @@ def load_params(path: str, config: LlamaConfig, shardings, dtype) -> dict[str, A
 
 
 def _hf_key_map(config: LlamaConfig) -> dict[str, tuple]:
-    """HF name -> (our path, transpose?)."""
+    """HF name -> (our path, transpose?).
+
+    Covers the whole config family: Llama-3 / Mistral (no extras), Qwen2
+    (q/k/v ``.bias`` tensors), and tied-embedding models whose checkpoints
+    ship no ``lm_head.weight`` (Llama-3.2-1B, Qwen2-0.5B)."""
     mapping: dict[str, tuple] = {
         "model.embed_tokens.weight": (("embed",), False),
         "model.norm.weight": (("final_norm",), False),
-        "lm_head.weight": (("lm_head",), True),
     }
+    if not config.tie_embeddings:
+        mapping["lm_head.weight"] = (("lm_head",), True)
     for i in range(config.n_layers):
         prefix = f"model.layers.{i}."
         mapping.update({
@@ -69,6 +74,12 @@ def _hf_key_map(config: LlamaConfig) -> dict[str, tuple]:
             prefix + "mlp.up_proj.weight": (("layers", i, "w3"), True),
             prefix + "mlp.down_proj.weight": (("layers", i, "w2"), True),
         })
+        if config.attn_bias:
+            mapping.update({
+                prefix + "self_attn.q_proj.bias": (("layers", i, "bq"), False),
+                prefix + "self_attn.k_proj.bias": (("layers", i, "bk"), False),
+                prefix + "self_attn.v_proj.bias": (("layers", i, "bv"), False),
+            })
     return mapping
 
 

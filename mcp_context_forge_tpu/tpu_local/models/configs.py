@@ -7,6 +7,14 @@ from dataclasses import dataclass
 
 @dataclass(frozen=True)
 class LlamaConfig:
+    """Geometry for the GQA+RoPE+SwiGLU decoder family.
+
+    One trunk covers Llama-3, Mistral (v0.3+, no sliding window), and
+    Qwen2 — the two family knobs are ``attn_bias`` (Qwen2 adds biases to
+    the q/k/v projections) and ``tie_embeddings`` (Qwen2-0.5B and
+    Llama-3.2-1B reuse the embedding matrix as the LM head; their HF
+    checkpoints ship no ``lm_head.weight``)."""
+
     name: str
     vocab_size: int
     dim: int
@@ -17,6 +25,8 @@ class LlamaConfig:
     rope_theta: float = 500_000.0
     norm_eps: float = 1e-5
     max_seq_len: int = 8192
+    attn_bias: bool = False
+    tie_embeddings: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -29,10 +39,32 @@ MODEL_CONFIGS: dict[str, LlamaConfig] = {
         name="llama3-8b", vocab_size=128_256, dim=4096, n_layers=32,
         n_heads=32, n_kv_heads=8, ffn_hidden=14_336, rope_theta=500_000.0,
         max_seq_len=8192),
-    # ~1B-class for single-chip smoke runs
+    # ~1B-class for single-chip smoke runs (Llama-3.2-1B: tied embeddings)
     "llama3-1b": LlamaConfig(
         name="llama3-1b", vocab_size=128_256, dim=2048, n_layers=16,
-        n_heads=32, n_kv_heads=8, ffn_hidden=8192, max_seq_len=8192),
+        n_heads=32, n_kv_heads=8, ffn_hidden=8192, max_seq_len=8192,
+        tie_embeddings=True),
+    # Mistral-7B v0.3 (no sliding window since v0.3)
+    "mistral-7b": LlamaConfig(
+        name="mistral-7b", vocab_size=32_768, dim=4096, n_layers=32,
+        n_heads=32, n_kv_heads=8, ffn_hidden=14_336, rope_theta=1_000_000.0,
+        max_seq_len=32_768),
+    # Qwen2-7B (QKV biases)
+    "qwen2-7b": LlamaConfig(
+        name="qwen2-7b", vocab_size=152_064, dim=3584, n_layers=28,
+        n_heads=28, n_kv_heads=4, ffn_hidden=18_944, rope_theta=1_000_000.0,
+        norm_eps=1e-6, max_seq_len=32_768, attn_bias=True),
+    # Qwen2-0.5B (QKV biases + tied embeddings)
+    "qwen2-0.5b": LlamaConfig(
+        name="qwen2-0.5b", vocab_size=151_936, dim=896, n_layers=24,
+        n_heads=14, n_kv_heads=2, ffn_hidden=4864, rope_theta=1_000_000.0,
+        norm_eps=1e-6, max_seq_len=32_768, attn_bias=True,
+        tie_embeddings=True),
+    # tiny Qwen2-style config exercising both family knobs in CI
+    "qwen2-tiny": LlamaConfig(
+        name="qwen2-tiny", vocab_size=512, dim=256, n_layers=4,
+        n_heads=8, n_kv_heads=4, ffn_hidden=688, max_seq_len=2048,
+        attn_bias=True, tie_embeddings=True),
     # tiny configs for CI / CPU mesh (byte-level tokenizer vocab)
     "llama3-tiny": LlamaConfig(
         name="llama3-tiny", vocab_size=512, dim=256, n_layers=4,

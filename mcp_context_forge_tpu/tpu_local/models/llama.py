@@ -65,7 +65,7 @@ def init_params(config: LlamaConfig, key: jax.Array,
     layers = []
     for i in range(config.n_layers):
         k = jax.random.split(keys[i], 7)
-        layers.append({
+        layer = {
             "attn_norm": jnp.ones((config.dim,), dtype=jnp.float32),
             "wq": dense(k[0], (config.dim, config.n_heads * hd), config.dim),
             "wk": dense(k[1], (config.dim, config.n_kv_heads * hd), config.dim),
@@ -75,13 +75,21 @@ def init_params(config: LlamaConfig, key: jax.Array,
             "w1": dense(k[4], (config.dim, config.ffn_hidden), config.dim),
             "w3": dense(k[5], (config.dim, config.ffn_hidden), config.dim),
             "w2": dense(k[6], (config.ffn_hidden, config.dim), config.ffn_hidden),
-        })
-    return {
+        }
+        if config.attn_bias:  # Qwen2-style q/k/v projection biases
+            layer["bq"] = jnp.zeros((config.n_heads * hd,), dtype=dtype)
+            layer["bk"] = jnp.zeros((config.n_kv_heads * hd,), dtype=dtype)
+            layer["bv"] = jnp.zeros((config.n_kv_heads * hd,), dtype=dtype)
+        layers.append(layer)
+    params = {
         "embed": dense(keys[-2], (config.vocab_size, config.dim), config.dim),
         "layers": layers,
         "final_norm": jnp.ones((config.dim,), dtype=jnp.float32),
-        "lm_head": dense(keys[-1], (config.dim, config.vocab_size), config.dim),
     }
+    if not config.tie_embeddings:
+        params["lm_head"] = dense(keys[-1], (config.dim, config.vocab_size),
+                                  config.dim)
+    return params
 
 
 def params_logical(config: LlamaConfig) -> dict[str, Any]:
@@ -93,12 +101,17 @@ def params_logical(config: LlamaConfig) -> dict[str, Any]:
         "ffn_norm": "replicated",
         "w1": "ffn_up", "w3": "ffn_up", "w2": "ffn_down",
     }
-    return {
+    if config.attn_bias:
+        layer.update({"bq": "replicated", "bk": "replicated",
+                      "bv": "replicated"})
+    tree = {
         "embed": "vocab_in",
         "layers": [dict(layer) for _ in range(config.n_layers)],
         "final_norm": "replicated",
-        "lm_head": "vocab_out",
     }
+    if not config.tie_embeddings:
+        tree["lm_head"] = "vocab_out"
+    return tree
 
 
 def param_count(config: LlamaConfig) -> int:
@@ -106,8 +119,21 @@ def param_count(config: LlamaConfig) -> int:
     per_layer = (config.dim * (config.n_heads + 2 * config.n_kv_heads) * hd
                  + config.n_heads * hd * config.dim
                  + 3 * config.dim * config.ffn_hidden + 2 * config.dim)
-    return (config.vocab_size * config.dim * 2 + config.dim
-            + config.n_layers * per_layer)
+    if config.attn_bias:
+        per_layer += (config.n_heads + 2 * config.n_kv_heads) * hd
+    embeddings = config.vocab_size * config.dim * (
+        1 if config.tie_embeddings else 2)
+    return embeddings + config.dim + config.n_layers * per_layer
+
+
+def lm_logits(params: dict[str, Any], x: jax.Array) -> jax.Array:
+    """Project hidden states to vocab logits; tied models reuse embed.T
+    (sharded vocab-out either way — embed is vocab-in, so the transpose
+    keeps the vocab dim on the ``model`` axis)."""
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return (x @ head).astype(jnp.float32)
 
 
 # ----------------------------------------------------------------------- forward
@@ -117,9 +143,12 @@ def _attention_block(layer: dict[str, Any], config: LlamaConfig, x: jax.Array,
     """Project to q,k,v with RoPE. x: [B,S,D] -> q [B,S,H,hd], k/v [B,S,KV,hd]."""
     B, S, _ = x.shape
     hd = config.head_dim
-    q = (x @ layer["wq"]).reshape(B, S, config.n_heads, hd)
-    k = (x @ layer["wk"]).reshape(B, S, config.n_kv_heads, hd)
-    v = (x @ layer["wv"]).reshape(B, S, config.n_kv_heads, hd)
+    q, k, v = x @ layer["wq"], x @ layer["wk"], x @ layer["wv"]
+    if "bq" in layer:  # static at trace time (pytree structure)
+        q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+    q = q.reshape(B, S, config.n_heads, hd)
+    k = k.reshape(B, S, config.n_kv_heads, hd)
+    v = v.reshape(B, S, config.n_kv_heads, hd)
     q = apply_rope(q, positions, config.rope_theta)
     k = apply_rope(k, positions, config.rope_theta)
     return q, k, v
@@ -152,7 +181,7 @@ def prefill(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
         h = rms_norm(x, layer["ffn_norm"], config.norm_eps)
         x = x + _ffn(layer, h)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = lm_logits(params, x)
     return logits, kv
 
 
@@ -189,7 +218,7 @@ def decode_step(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
         h = rms_norm(x, layer["ffn_norm"], config.norm_eps)
         x = x + _ffn(layer, h)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
-    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    logits = lm_logits(params, x[:, 0])
     return logits, kv
 
 

@@ -49,7 +49,10 @@ def stack_layers(params: dict[str, Any], n_stages: int) -> dict[str, Any]:
         for name in layers[0]
     }
     return {"embed": params["embed"], "final_norm": params["final_norm"],
-            "lm_head": params["lm_head"], "stages": stacked}
+            # tied models reuse the embedding as the head (transposed at
+            # the projection site — stack_layers stays a pure pytree)
+            "lm_head": params.get("lm_head", params["embed"]),
+            "stages": stacked}
 
 
 def _layer_forward(layer: dict[str, Any], config: LlamaConfig, x: jax.Array,
@@ -148,12 +151,13 @@ def build_pp_forward(mesh: Mesh, config: LlamaConfig, n_stages: int,
         }
         return out
 
+    layer_names = ("attn_norm", "wq", "wk", "wv", "wo", "ffn_norm",
+                   "w1", "w3", "w2") + (
+        ("bq", "bk", "bv") if config.attn_bias else ())
     body = shard_map(
         partial(_pipeline_body, config=config, axis_name=axis_name),
         mesh=mesh,
-        in_specs=({name: stage_spec for name in
-                   ("attn_norm", "wq", "wk", "wv", "wo", "ffn_norm",
-                    "w1", "w3", "w2")},
+        in_specs=({name: stage_spec for name in layer_names},
                   replicated, replicated),
         out_specs=replicated, check_rep=False)
 
@@ -170,6 +174,8 @@ def build_pp_forward(mesh: Mesh, config: LlamaConfig, n_stages: int,
         out = body(stacked["stages"], x_mb, pos_mb)       # [M, mb, S, D]
         x = out.reshape(B, S, -1)
         x = rms_norm(x, stacked["final_norm"], config.norm_eps)
-        return (x @ stacked["lm_head"]).astype(jnp.float32)
+        head = (stacked["lm_head"].T if config.tie_embeddings
+                else stacked["lm_head"])
+        return (x @ head).astype(jnp.float32)
 
     return jax.jit(forward), shard_stacked

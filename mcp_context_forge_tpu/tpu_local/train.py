@@ -18,7 +18,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .models.configs import LlamaConfig
-from .models.llama import _attention_block, _ffn, rms_norm
+from .models.llama import _attention_block, _ffn, lm_logits, rms_norm
 from .ops.attention import causal_attention
 from .parallel.sharding import param_specs
 from .models.llama import params_logical
@@ -38,7 +38,7 @@ def forward_logits(params: dict[str, Any], config: LlamaConfig,
         h = rms_norm(x, layer["ffn_norm"], config.norm_eps)
         x = x + _ffn(layer, h)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    return lm_logits(params, x)
 
 
 def loss_fn(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,

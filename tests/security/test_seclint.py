@@ -187,3 +187,30 @@ def test_file_allow_directive(tmp_path: Path) -> None:
             eval("1")
     """)
     assert [f.rule for f in findings] == ["S001"]
+
+
+def test_file_allow_in_real_docstring_only(tmp_path: Path) -> None:
+    """Directives in the ast-level module docstring count; an assigned
+    string literal on line 1 must not launder them."""
+    laundered = _scan_snippet(tmp_path, """\
+        PAYLOAD = "# seclint: file-allow S001"
+        eval("1")
+    """)
+    assert [f.rule for f in laundered] == ["S001"]
+
+    honored = _scan_snippet(tmp_path, '''\
+        #!/usr/bin/env python
+        """Module with policy note.
+
+        # seclint: file-allow S001
+        """
+        eval("1")
+    ''')
+    assert honored == []
+
+
+def test_lambda_parameters_are_tainted(tmp_path: Path) -> None:
+    findings = _scan_snippet(tmp_path, """
+        run = lambda db, sql: db.execute(sql)
+    """)
+    assert [f.rule for f in findings] == ["S006"]

@@ -32,8 +32,9 @@ def _write_hf_checkpoint(path: str, params) -> None:
     tensors: dict[str, np.ndarray] = {
         "model.embed_tokens.weight": np.asarray(params["embed"]),
         "model.norm.weight": np.asarray(params["final_norm"]),
-        "lm_head.weight": t(params["lm_head"]),
     }
+    if "lm_head" in params:  # tied-embedding checkpoints ship no head
+        tensors["lm_head.weight"] = t(params["lm_head"])
     for i, layer in enumerate(params["layers"]):
         prefix = f"model.layers.{i}."
         tensors[prefix + "input_layernorm.weight"] = np.asarray(layer["attn_norm"])
@@ -46,6 +47,10 @@ def _write_hf_checkpoint(path: str, params) -> None:
         tensors[prefix + "mlp.gate_proj.weight"] = t(layer["w1"])
         tensors[prefix + "mlp.up_proj.weight"] = t(layer["w3"])
         tensors[prefix + "mlp.down_proj.weight"] = t(layer["w2"])
+        for bias, hf in (("bq", "q_proj"), ("bk", "k_proj"), ("bv", "v_proj")):
+            if bias in layer:  # Qwen2-style attention biases
+                tensors[prefix + f"self_attn.{hf}.bias"] = \
+                    np.asarray(layer[bias])
     keys = sorted(tensors)
     half = len(keys) // 2
     os.makedirs(path, exist_ok=True)
@@ -113,4 +118,28 @@ def test_orbax_roundtrip(tmp_path):
         loaded = load_params(ckpt, config, shardings, jnp.float32)
     for a, b in zip(jax.tree_util.tree_leaves(params),
                     jax.tree_util.tree_leaves(loaded), strict=True):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_hf_qwen2_roundtrip_with_bias_and_tied_head(tmp_path):
+    """Qwen2-family checkpoint: q/k/v biases load, and the absent
+    lm_head.weight is not required (tied embeddings)."""
+    config = MODEL_CONFIGS["qwen2-tiny"]
+    params = init_params(config, jax.random.PRNGKey(5), dtype=jnp.float32)
+    for layer in params["layers"]:  # nonzero so equality is meaningful
+        layer["bq"] = layer["bq"] + 0.5
+        layer["bk"] = layer["bk"] - 0.25
+        layer["bv"] = layer["bv"] + 0.125
+    ckpt = str(tmp_path / "hf-qwen")
+    _write_hf_checkpoint(ckpt, params)
+
+    mesh = make_mesh("")
+    with mesh:
+        shardings = param_specs(params_logical(config), mesh)
+        loaded = load_params(ckpt, config, shardings, jnp.float32)
+
+    flat_orig = jax.tree_util.tree_leaves(params)
+    flat_loaded = jax.tree_util.tree_leaves(loaded)
+    assert len(flat_orig) == len(flat_loaded)
+    for a, b in zip(flat_orig, flat_loaded):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -126,3 +126,68 @@ def test_page_allocator():
     table = np.asarray(alloc.tables())
     assert table.shape == (2, 4)
     assert (table[1][:3] > 0).all()
+
+
+# ----------------------------------------------- model family: qwen2 knobs
+
+def test_qwen2_family_param_tree_and_count():
+    """attn_bias adds q/k/v bias vectors; tie_embeddings drops lm_head —
+    param_count and the logical sharding tree must track both."""
+    cfg = MODEL_CONFIGS["qwen2-tiny"]
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    assert "lm_head" not in params
+    assert {"bq", "bk", "bv"} <= set(params["layers"][0])
+    total = sum(np.prod(x.shape) for x in jax.tree.leaves(params))
+    assert total == param_count(cfg)
+    logical = params_logical(cfg)
+    assert jax.tree.structure(params) == jax.tree.structure(logical)
+
+
+def test_tied_embeddings_head_is_embed_transpose():
+    from mcp_context_forge_tpu.tpu_local.models.llama import lm_logits
+
+    cfg = MODEL_CONFIGS["qwen2-tiny"]
+    params = init_params(cfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(2), (3, cfg.dim), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(lm_logits(params, x)),
+        np.asarray((x @ params["embed"].T).astype(jnp.float32)),
+        rtol=1e-6)
+
+
+def test_qwen2_prefill_decode_consistency():
+    """The incremental-decoding invariant holds with biases + tied head."""
+    cfg = MODEL_CONFIGS["qwen2-tiny"]
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    # nonzero biases so the bias path actually participates
+    for layer in params["layers"]:
+        layer["bq"] = layer["bq"] + 0.03
+        layer["bk"] = layer["bk"] - 0.02
+        layer["bv"] = layer["bv"] + 0.01
+    kv = init_kv_state(cfg, 32, 16, 4, 8, dtype=jnp.float32)
+    alloc = PageAllocator(32, 16, 4, 8)
+    S = 12
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (1, S), 0,
+                                cfg.vocab_size)
+    positions = jnp.arange(S)[None, :]
+    assert alloc.allocate_slot(0, S + 1)
+    kv = kv._replace(block_tables=alloc.tables())
+    logits_full, kv = prefill(params, cfg, tokens, positions, kv,
+                              jnp.array([0]), attn_impl="reference")
+
+    next_token = jnp.argmax(logits_full[:, -1], axis=-1)
+    logits_step, kv = decode_step(params, cfg, next_token,
+                                  jnp.array([S]), kv, jnp.array([0]),
+                                  jnp.array([S + 1]))
+    # re-run prefill over the extended sequence: last-position logits agree
+    kv2 = init_kv_state(cfg, 32, 16, 4, 8, dtype=jnp.float32)
+    alloc2 = PageAllocator(32, 16, 4, 8)
+    assert alloc2.allocate_slot(0, S + 1)
+    kv2 = kv2._replace(block_tables=alloc2.tables())
+    ext_tokens = jnp.concatenate([tokens, next_token[:, None]], axis=1)
+    ext_positions = jnp.arange(S + 1)[None, :]
+    logits_ext, _ = prefill(params, cfg, ext_tokens, ext_positions, kv2,
+                            jnp.array([0]), attn_impl="reference")
+    np.testing.assert_allclose(np.asarray(logits_step),
+                               np.asarray(logits_ext[:, -1]),
+                               rtol=2e-4, atol=2e-4)

@@ -74,3 +74,41 @@ def test_stack_layers_rejects_uneven():
     params = init_params(config, jax.random.PRNGKey(0), dtype=jnp.float32)
     with pytest.raises(ValueError):
         stack_layers(params, n_stages=3)  # 2 layers / 3 stages
+
+
+def test_pp_forward_qwen2_family():
+    """PP must honor the family knobs: bias params ride the stage sharding
+    and the tied head projects through embed.T."""
+    from mcp_context_forge_tpu.tpu_local.models.llama import (lm_logits,
+                                                              rms_norm)
+    from mcp_context_forge_tpu.tpu_local.parallel.pipeline import (
+        _layer_forward)
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        pytest.skip("needs multiple virtual devices")
+    config = MODEL_CONFIGS["qwen2-tiny"]  # 4 layers, attn_bias + tied
+    mesh = Mesh(np.asarray(devices[:2]).reshape(2), ("pipe",))
+    params = init_params(config, jax.random.PRNGKey(2), dtype=jnp.float32)
+    for layer in params["layers"]:
+        layer["bq"] = layer["bq"] + 0.05
+        layer["bk"] = layer["bk"] - 0.05
+        layer["bv"] = layer["bv"] + 0.02
+
+    B, S = 2, 8
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (B, S), 0,
+                                config.vocab_size)
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+
+    x = params["embed"][tokens]
+    for layer in params["layers"]:
+        x = _layer_forward(layer, config, x, positions)
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    ref = lm_logits(params, x)
+
+    forward, shard_stacked = build_pp_forward(mesh, config, n_stages=2,
+                                              microbatches=2)
+    stacked = shard_stacked(stack_layers(params, n_stages=2))
+    out = forward(stacked, tokens, positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
