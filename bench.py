@@ -82,6 +82,9 @@ async def _make_gateway(engine: bool, platform: str):
         "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
         "MCPFORGE_OTEL_EXPORTER": "none",
         "MCPFORGE_LOG_LEVEL": "WARNING",
+        # compile the full prefill/decode shape grid at boot so the timed
+        # configs below measure steady state, not XLA compile latency
+        "MCPFORGE_TPU_LOCAL_WARMUP": "true" if engine else "false",
     }
     settings = load_settings(env=env, env_file=None)
     app = await build_app(settings)

@@ -63,8 +63,10 @@ async def run(platform: str) -> dict:
                 count += 1
             return count
 
-        # warmup (compiles prefill + decode)
-        await one()
+        # warmup: full shape grid (every pow-2 prefill batch + decode block)
+        # so the timed region below measures steady state, not XLA compiles
+        await asyncio.to_thread(engine.warmup)
+        await one()  # primes the dispatch loop end-to-end (already compiled)
         started = time.monotonic()
         counts = await asyncio.gather(*[one() for _ in range(clients)])
         wall = time.monotonic() - started

@@ -145,6 +145,15 @@ class Settings(BaseModel):
     # forever; past this budget the engine raises EngineInitTimeout so the
     # gateway fails fast instead of never binding its port (0 = no watchdog)
     tpu_local_init_timeout_s: float = 120.0
+    # precompile the full shape grid (prefill buckets x pow-2 admission
+    # batches + decode block) at boot so first traffic never pays XLA
+    # compile latency (~20-40s/shape on TPU); off by default because it
+    # lengthens gateway boot
+    tpu_local_warmup: bool = False
+    # persistent XLA compilation cache dir ('' = disabled): compiled
+    # executables survive process restarts, so a gateway/bench rerun skips
+    # recompilation entirely
+    tpu_local_compile_cache_dir: str = ""
 
     # --- SSO (JSON list: [{name, issuer, client_id, client_secret}]) ---
     sso_providers: str = ""

@@ -44,6 +44,10 @@ def load_config(argv: list[str] | None = None) -> dict[str, Any]:
     return json.loads(os.environ.get("MCPFORGE_SCANNER_CONFIG", "{}"))
 
 
+class ScanBudgetExceeded(Exception):
+    """Traversal node budget exhausted with content left unscanned."""
+
+
 def _content_blobs(payload: Any) -> list[bytes]:
     """Every text/blob fragment in an MCP result/content payload.
 
@@ -51,11 +55,15 @@ def _content_blobs(payload: Any) -> list[bytes]:
     decoded and re-walked (bounded: each decode strictly shrinks the
     text), so a signature cannot hide behind JSON string-escaping —
     e.g. EICAR's backslash becoming ``\\\\`` inside an embedded
-    document."""
+    document. Raises ScanBudgetExceeded (callers fail CLOSED) if the
+    node budget runs out before the walk completes — padding a payload
+    past the budget must not smuggle unscanned content through."""
     blobs: list[bytes] = []
     stack = [payload]
     seen = 0
-    while stack and seen < 10_000:
+    while stack:
+        if seen >= 10_000:
+            raise ScanBudgetExceeded(f"{seen} nodes walked, more remain")
         seen += 1
         node = stack.pop()
         if isinstance(node, dict):
@@ -83,12 +91,19 @@ def build_server(config: dict[str, Any]) -> PluginServer:
     deny_ext = tuple(e.lower() for e in config.get(
         "deny_extensions", [".exe", ".dll", ".scr", ".com", ".bat"]))
 
+    all_signatures = signatures + hex_signatures
+
     def scan(payload: Any, where: str) -> dict[str, Any]:
-        for blob in _content_blobs(payload):
+        try:
+            blobs = _content_blobs(payload)
+        except ScanBudgetExceeded:
+            return violation(f"{where}: payload too complex to scan",
+                             code="SCANNER_BUDGET")
+        for blob in blobs:
             if max_bytes and len(blob) > max_bytes:
                 return violation(f"{where}: content exceeds scan ceiling",
                                  code="SCANNER_TOO_LARGE")
-            for sig in signatures + hex_signatures:
+            for sig in all_signatures:
                 if sig in blob:
                     return violation(
                         f"{where}: content matches malware signature",

@@ -197,12 +197,15 @@ class _Scanner(ast.NodeVisitor):
         self.generic_visit(node)
         self._scopes.pop()
 
+    @staticmethod
+    def _param_names(a: ast.arguments) -> tuple[str, ...]:
+        return tuple(arg.arg for arg in
+                     [*a.posonlyargs, *a.args, *a.kwonlyargs,
+                      *([a.vararg] if a.vararg else []),
+                      *([a.kwarg] if a.kwarg else [])])
+
     def _visit_scope(self, node) -> None:
-        a = node.args
-        params = tuple(arg.arg for arg in
-                       [*a.posonlyargs, *a.args, *a.kwonlyargs,
-                        *([a.vararg] if a.vararg else []),
-                        *([a.kwarg] if a.kwarg else [])])
+        params = self._param_names(node.args)
         self._scopes.append(_clean_vars(node.body, params))
         self.generic_visit(node)
         self._scopes.pop()
@@ -213,12 +216,7 @@ class _Scanner(ast.NodeVisitor):
     def visit_Lambda(self, node: ast.Lambda) -> None:
         # a lambda's params are a scope too: `lambda db, sql: db.execute(sql)`
         # must flag exactly like the def spelling
-        a = node.args
-        params = tuple(arg.arg for arg in
-                       [*a.posonlyargs, *a.args, *a.kwonlyargs,
-                        *([a.vararg] if a.vararg else []),
-                        *([a.kwarg] if a.kwarg else [])])
-        self._scopes.append((set(params), set()))
+        self._scopes.append((set(self._param_names(node.args)), set()))
         self.generic_visit(node)
         self._scopes.pop()
 

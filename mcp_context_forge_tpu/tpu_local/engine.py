@@ -71,6 +71,10 @@ class EngineConfig:
     decode_block: int = 1
     # seconds to wait for jax backend init before failing fast (0 = forever)
     init_timeout_s: float = 120.0
+    # precompile the full shape grid at construction (see TPUEngine.warmup)
+    warmup: bool = False
+    # persistent XLA compilation cache ('' = disabled)
+    compile_cache_dir: str = ""
 
     @classmethod
     def from_settings(cls, settings) -> "EngineConfig":
@@ -89,6 +93,8 @@ class EngineConfig:
             sp_threshold=getattr(settings, "tpu_local_sp_threshold", 1024),
             decode_block=getattr(settings, "tpu_local_decode_block", 1),
             init_timeout_s=getattr(settings, "tpu_local_init_timeout_s", 120.0),
+            warmup=getattr(settings, "tpu_local_warmup", False),
+            compile_cache_dir=getattr(settings, "tpu_local_compile_cache_dir", ""),
         )
 
 
@@ -170,6 +176,12 @@ class TPUEngine:
             raise ValueError(
                 f"decode_block must be >= 1, got {config.decode_block}")
         self.config = config
+        if config.compile_cache_dir:
+            # persistent executable cache: reruns (gateway restarts, bench
+            # repeats) skip XLA recompilation of every step shape
+            jax.config.update("jax_compilation_cache_dir",
+                              config.compile_cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         self.model_config: LlamaConfig = MODEL_CONFIGS[config.model]
         self.tokenizer = load_tokenizer(config.checkpoint,
                                         vocab_size=self.model_config.vocab_size)
@@ -235,6 +247,56 @@ class TPUEngine:
                     donate_argnames=("kv",))
             if config.sp_impl != "none" else None)
         self._decode = jax.jit(self._decode_and_sample, donate_argnames=("kv",))
+        if config.warmup:
+            self.warmup()
+
+    def warmup(self) -> None:
+        """Precompile the full shape grid before traffic: every prefill
+        bucket x power-of-2 admission batch (plus the SP variant for long
+        buckets) and the decode block. Safe pre-traffic: warmup rows use
+        positions=-1, so KV writes land on the reserved trash page (page 0)
+        and the allocator is untouched. Also what benches call so their
+        timed region measures steady state, not XLA compile latency."""
+        started = time.monotonic()
+        shapes = 0
+        with self.mesh:
+            for bucket in self.config.prefill_buckets:
+                use_sp = (self._prefill_sample_sp is not None
+                          and bucket > self.config.sp_threshold)
+                fn = self._prefill_sample_sp if use_sp else self._prefill_sample
+                # _admit_batch pads to the pow-2 CEILING of the group size,
+                # so compile through ceil_pow2(prefill_max_batch), not just
+                # the powers of two at or below it
+                cap = 1
+                while cap < max(1, self.config.prefill_max_batch):
+                    cap *= 2
+                B = 1
+                while B <= cap:
+                    samp = SamplingParams(jnp.zeros((B,), jnp.float32),
+                                          jnp.zeros((B,), jnp.int32),
+                                          jnp.ones((B,), jnp.float32))
+                    first, self.kv = fn(
+                        self.params, self.kv,
+                        jnp.full((B, bucket), self.tokenizer.pad_id, jnp.int32),
+                        jnp.full((B, bucket), -1, jnp.int32),
+                        jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
+                        samp, jax.random.PRNGKey(0))
+                    first.block_until_ready()
+                    shapes += 1
+                    B *= 2
+            B = self.config.max_batch
+            samp = SamplingParams(jnp.zeros((B,), jnp.float32),
+                                  jnp.zeros((B,), jnp.int32),
+                                  jnp.ones((B,), jnp.float32))
+            # seq_lens=0: every slot is "inactive", writes masked to trash
+            block, self.kv = self._decode(
+                self.params, self.kv, jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), jnp.int32), jnp.arange(B, dtype=jnp.int32),
+                jnp.zeros((B,), jnp.int32), samp, jax.random.PRNGKey(0))
+            block.block_until_ready()
+            shapes += 1
+        logger.info("tpu_local warmup: %d shapes compiled in %.1fs",
+                    shapes, time.monotonic() - started)
 
     # ------------------------------------------------------------- device fns
 

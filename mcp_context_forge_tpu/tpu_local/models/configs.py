@@ -39,7 +39,9 @@ MODEL_CONFIGS: dict[str, LlamaConfig] = {
         name="llama3-8b", vocab_size=128_256, dim=4096, n_layers=32,
         n_heads=32, n_kv_heads=8, ffn_hidden=14_336, rope_theta=500_000.0,
         max_seq_len=8192),
-    # ~1B-class for single-chip smoke runs (Llama-3.2-1B: tied embeddings)
+    # ~1B-class for single-chip smoke runs (Llama-3.2-1B geometry; HF ships
+    # it with tied embeddings and no lm_head.weight — checkpoints saved
+    # before tie_embeddings landed must be re-exported under this name)
     "llama3-1b": LlamaConfig(
         name="llama3-1b", vocab_size=128_256, dim=2048, n_layers=16,
         n_heads=32, n_kv_heads=8, ffn_hidden=8192, max_seq_len=8192,

@@ -1,0 +1,83 @@
+"""Steady-state TPU step profiler: times compiled prefill/decode calls
+directly (no asyncio), separating compile from per-step latency."""
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+os.environ.setdefault("JAX_PLATFORMS", "")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mcp_context_forge_tpu.tpu_local.engine import EngineConfig, TPUEngine
+from mcp_context_forge_tpu.tpu_local.sampling import SamplingParams
+
+MODEL = os.environ.get("BENCH_MODEL", "llama3-1b")
+BATCH = int(os.environ.get("BENCH_BATCH", "8"))
+BLOCK = int(os.environ.get("BENCH_DECODE_BLOCK", "4"))
+
+cfg = EngineConfig(model=MODEL, max_batch=BATCH, max_seq_len=512,
+                   page_size=16, num_pages=512, prefill_buckets=(64,),
+                   dtype="bfloat16", attn_impl="auto", decode_block=BLOCK)
+t0 = time.monotonic()
+eng = TPUEngine(cfg)
+print(f"engine init (params+kv alloc): {time.monotonic()-t0:.1f}s",
+      flush=True)
+
+B = BATCH
+bucket = 64
+prompt = list(range(1, 17))
+for slot in range(B):
+    assert eng.allocator.allocate_slot(slot, len(prompt) + 64)
+eng._sync_tables()
+
+tokens = np.zeros((B, bucket), np.int32)
+positions = np.full((B, bucket), -1, np.int32)
+last_idx = np.zeros((B,), np.int32)
+for i in range(B):
+    tokens[i, :len(prompt)] = prompt
+    positions[i, :len(prompt)] = np.arange(len(prompt))
+    last_idx[i] = len(prompt) - 1
+samp = SamplingParams(jnp.zeros((B,), jnp.float32),
+                      jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32))
+key = jax.random.PRNGKey(0)
+
+t0 = time.monotonic()
+first, eng.kv = eng._prefill_sample(eng.params, eng.kv, jnp.asarray(tokens),
+                                    jnp.asarray(positions),
+                                    jnp.arange(B, dtype=jnp.int32),
+                                    jnp.asarray(last_idx), samp, key)
+first.block_until_ready()
+print(f"prefill B={B} compile+run: {time.monotonic()-t0:.1f}s", flush=True)
+
+for rep in range(3):
+    t0 = time.monotonic()
+    first, eng.kv = eng._prefill_sample(eng.params, eng.kv, jnp.asarray(tokens),
+                                        jnp.asarray(positions),
+                                        jnp.arange(B, dtype=jnp.int32),
+                                        jnp.asarray(last_idx), samp, key)
+    first.block_until_ready()
+    print(f"prefill B={B} steady: {(time.monotonic()-t0)*1000:.1f}ms", flush=True)
+
+dt = np.zeros((B,), np.int32) + 7
+pos = np.zeros((B,), np.int32) + len(prompt)
+lens = pos + 1
+t0 = time.monotonic()
+out, eng.kv = eng._decode(eng.params, eng.kv, jnp.asarray(dt), jnp.asarray(pos),
+                          jnp.arange(B, dtype=jnp.int32), jnp.asarray(lens),
+                          samp, key)
+out.block_until_ready()
+print(f"decode block={BLOCK} compile+run: {time.monotonic()-t0:.1f}s", flush=True)
+
+N = 20
+t0 = time.monotonic()
+for i in range(N):
+    out, eng.kv = eng._decode(eng.params, eng.kv, jnp.asarray(dt),
+                              jnp.asarray(pos), jnp.arange(B, dtype=jnp.int32),
+                              jnp.asarray(lens), samp, key)
+    _ = jax.device_get(out)
+per = (time.monotonic() - t0) / N
+print(f"decode steady: {per*1000:.2f}ms / block of {BLOCK} "
+      f"-> {BATCH*BLOCK/per:.0f} tok/s at batch {BATCH}", flush=True)

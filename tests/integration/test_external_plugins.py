@@ -170,3 +170,17 @@ async def test_external_prompt_guard_blocks_and_redacts():
     finally:
         await gateway.close()
         await rest.close()
+
+
+def test_content_scanner_budget_fails_closed():
+    """Padding a payload past the traversal budget must NOT smuggle
+    unscanned content through — the scanner blocks instead of skipping."""
+    from mcp_context_forge_tpu.plugins.servers.content_scanner import build_server
+
+    server = build_server({"signatures": ["MALWARE-MARKER-XYZ"]})
+    hook = server._hooks["tool_post_invoke"]
+    padded = {"pad": ["x"] * 10_001, "tail": "MALWARE-MARKER-XYZ"}
+    out = hook(name="t", result=padded)
+    assert out["violation"]["code"] == "SCANNER_BUDGET"
+    clean = hook(name="t", result={"ok": ["fine"] * 10})
+    assert "violation" not in clean or not clean.get("violation")

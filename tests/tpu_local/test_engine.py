@@ -304,3 +304,29 @@ def test_engine_serves_qwen2_family():
             await engine.stop()
 
     asyncio.run(run())
+
+
+def test_warmup_precompiles_without_corrupting_state():
+    """warmup() compiles the full shape grid pre-traffic; generation after
+    warmup is identical to a cold engine's (trash-page writes only, the
+    allocator untouched)."""
+    async def run():
+        kwargs = dict(model="llama3-test", max_batch=2, max_seq_len=128,
+                      page_size=16, num_pages=64, prefill_buckets=(16, 32),
+                      prefill_max_batch=2, dtype="float32",
+                      attn_impl="reference", decode_block=2)
+        warm = TPUEngine(EngineConfig(**kwargs, warmup=True))
+        assert warm.allocator.pages_in_use == 0
+        cold = TPUEngine(EngineConfig(**kwargs))
+        ids = warm.tokenizer.encode("warmup parity prompt")
+
+        async def gen(engine):
+            await engine.start()
+            try:
+                return [t async for t in engine.generate(ids, max_tokens=6)]
+            finally:
+                await engine.stop()
+
+        assert await gen(warm) == await gen(cold)
+
+    asyncio.run(run())
