@@ -154,6 +154,10 @@ class Settings(BaseModel):
     # executables survive process restarts, so a gateway/bench rerun skips
     # recompilation entirely
     tpu_local_compile_cache_dir: str = ""
+    # prefix cache: resident KV pages of shared full-page prompt prefixes
+    # are reused across requests, so repeated plugin/chat templates only
+    # prefill their suffix (vLLM automatic-prefix-caching analog)
+    tpu_local_prefix_cache: bool = True
 
     # --- SSO (JSON list: [{name, issuer, client_id, client_secret}]) ---
     sso_providers: str = ""

@@ -437,6 +437,36 @@ def setup_routes(app: web.Application) -> None:
             "attributes": {k: str(v) for k, v in s.attributes.items()},
         } for s in reversed(spans)])
 
+    @routes.get("/admin/engine/stats")
+    async def engine_stats(request: web.Request) -> web.Response:
+        """Scheduler/cache counters of the in-process tpu_local engine
+        (reference analog: runtime_admin/observability admin surfaces)."""
+        request["auth"].require("observability.read")
+        engine = request.app.get("tpu_engine")
+        if engine is None:
+            raise NotFoundError("tpu_local engine is not enabled")
+        stats = engine.stats
+        alloc = engine.allocator
+        return web.json_response({
+            "model": engine.config.model,
+            "mesh": dict(engine.mesh.shape),
+            "requests": stats.requests,
+            "prompt_tokens": stats.prompt_tokens,
+            "completion_tokens": stats.completion_tokens,
+            "decode_steps": stats.decode_steps,
+            "prefill_batches": stats.prefill_batches,
+            "prefill_requests": stats.prefill_requests,
+            "queue_depth": stats.queue_depth,
+            "kv_pages_in_use": alloc.pages_in_use,
+            "kv_pages_free": alloc.free_pages,
+            "prefix_cache": {
+                "enabled": engine.config.prefix_cache,
+                "cached_pages": alloc.cached_pages,
+                "hits": alloc.prefix_hits,
+                "hit_tokens": alloc.prefix_hit_tokens,
+            },
+        })
+
     @routes.post("/admin/engine/profile")
     async def engine_profile(request: web.Request) -> web.Response:
         """Capture a jax.profiler trace of the running engine (SURVEY §5.1

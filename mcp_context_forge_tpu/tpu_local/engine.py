@@ -39,7 +39,8 @@ import numpy as np
 
 from .kv import PageAllocator, init_kv_state, kv_logical
 from .models import MODEL_CONFIGS, LlamaConfig
-from .models.llama import decode_step, init_params, params_logical, prefill
+from .models.llama import (decode_step, init_params, params_logical, prefill,
+                           prefill_with_history)
 from .parallel import make_mesh, param_specs
 from .sampling import SamplingParams, sample_tokens
 from .tokenizer import load_tokenizer
@@ -75,6 +76,9 @@ class EngineConfig:
     warmup: bool = False
     # persistent XLA compilation cache ('' = disabled)
     compile_cache_dir: str = ""
+    # prefix cache: reuse resident KV pages for shared full-page prompt
+    # prefixes; only each request's suffix pays prefill (vLLM APC analog)
+    prefix_cache: bool = True
 
     @classmethod
     def from_settings(cls, settings) -> "EngineConfig":
@@ -95,6 +99,7 @@ class EngineConfig:
             init_timeout_s=getattr(settings, "tpu_local_init_timeout_s", 120.0),
             warmup=getattr(settings, "tpu_local_warmup", False),
             compile_cache_dir=getattr(settings, "tpu_local_compile_cache_dir", ""),
+            prefix_cache=getattr(settings, "tpu_local_prefix_cache", True),
         )
 
 
@@ -117,6 +122,12 @@ class GenRequest:
     finish_reason: str | None = None
     prefill_ms: float = 0.0
     queue_ms: float = 0.0
+    # prefix-cache admission state: cached history length, the referenced
+    # cache pages held for this request, and the (suffix) bucket; bucket -1
+    # means not yet matched
+    hist: int = 0
+    held_pages: list[int] = field(default_factory=list)
+    bucket: int = -1
 
 
 class EngineStats:
@@ -247,6 +258,9 @@ class TPUEngine:
                     donate_argnames=("kv",))
             if config.sp_impl != "none" else None)
         self._decode = jax.jit(self._decode_and_sample, donate_argnames=("kv",))
+        self._prefill_hist = (
+            jax.jit(self._prefill_hist_and_sample, donate_argnames=("kv",))
+            if config.prefix_cache else None)
         if config.warmup:
             self.warmup()
 
@@ -263,7 +277,9 @@ class TPUEngine:
             for bucket in self.config.prefill_buckets:
                 use_sp = (self._prefill_sample_sp is not None
                           and bucket > self.config.sp_threshold)
-                fn = self._prefill_sample_sp if use_sp else self._prefill_sample
+                fns = ([self._prefill_sample_sp] if use_sp
+                       else [self._prefill_sample]
+                       + ([self._prefill_hist] if self._prefill_hist else []))
                 # _admit_batch pads to the pow-2 CEILING of the group size,
                 # so compile through ceil_pow2(prefill_max_batch), not just
                 # the powers of two at or below it
@@ -275,14 +291,17 @@ class TPUEngine:
                     samp = SamplingParams(jnp.zeros((B,), jnp.float32),
                                           jnp.zeros((B,), jnp.int32),
                                           jnp.ones((B,), jnp.float32))
-                    first, self.kv = fn(
-                        self.params, self.kv,
-                        jnp.full((B, bucket), self.tokenizer.pad_id, jnp.int32),
-                        jnp.full((B, bucket), -1, jnp.int32),
-                        jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
-                        samp, jax.random.PRNGKey(0))
-                    first.block_until_ready()
-                    shapes += 1
+                    for fn in fns:
+                        first, self.kv = fn(
+                            self.params, self.kv,
+                            jnp.full((B, bucket), self.tokenizer.pad_id,
+                                     jnp.int32),
+                            jnp.full((B, bucket), -1, jnp.int32),
+                            jnp.zeros((B,), jnp.int32),
+                            jnp.zeros((B,), jnp.int32),
+                            samp, jax.random.PRNGKey(0))
+                        first.block_until_ready()
+                        shapes += 1
                     B *= 2
             B = self.config.max_batch
             samp = SamplingParams(jnp.zeros((B,), jnp.float32),
@@ -310,6 +329,18 @@ class TPUEngine:
         logits, kv = prefill(params, self.model_config, tokens, positions, kv,
                              slot_ids, attn_impl=impl,
                              mesh=self.mesh if sp else None)
+        B = tokens.shape[0]
+        last = logits[jnp.arange(B), last_idx]          # [B, V]
+        first = sample_tokens(last, sampling, key)
+        return first, kv
+
+    def _prefill_hist_and_sample(self, params, kv, tokens, positions, slot_ids,
+                                 last_idx, sampling: SamplingParams, key):
+        """Suffix prefill over cached prefix pages (prefix-cache hit path):
+        same surface as _prefill_and_sample, but attention spans the slot's
+        whole paged context, so rows start at their history offset."""
+        logits, kv = prefill_with_history(params, self.model_config, tokens,
+                                          positions, kv, slot_ids)
         B = tokens.shape[0]
         last = logits[jnp.arange(B), last_idx]          # [B, V]
         first = sample_tokens(last, sampling, key)
@@ -430,6 +461,8 @@ class TPUEngine:
             self._finish(request)
         while self._pending:
             request = self._pending.popleft()
+            self.allocator.release_prefix(request.held_pages)
+            request.held_pages = []
             if request.finish_reason is None:
                 request.finish_reason = reason
             self._post_tokens(request, [], done=True)
@@ -447,6 +480,39 @@ class TPUEngine:
                 return bucket
         return None
 
+    def _assign_bucket(self, request: GenRequest) -> int:
+        """Request's prefill bucket (0 = fits no bucket), matched against
+        the prefix cache exactly once: a hit holds references on the cached
+        pages and buckets by SUFFIX length, so a 2048-token prompt with a
+        cached 1920-token template prefix prefills in the smallest bucket.
+        SP buckets never run the history path (the shard_map prefill has no
+        paged-history support) — those fall back to a dense full prefill."""
+        if request.bucket != -1:
+            return request.bucket
+        ids = request.prompt_ids
+        if len(ids) + 1 > self.config.max_seq_len:
+            # the prompt plus >=1 generated token must fit the block table;
+            # past it, page indices clamp and silently overwrite (and, with
+            # the prefix cache, publish) the slot's last page
+            request.bucket = 0
+            return 0
+        if self.config.prefix_cache and self._prefill_hist is not None:
+            hist, pages = self.allocator.match_prefix(ids)
+            if hist:
+                bucket = self._bucket_for(len(ids) - hist)
+                sp_bucket = (self._prefill_sample_sp is not None
+                             and bucket is not None
+                             and bucket > self.config.sp_threshold)
+                if bucket is None or sp_bucket:
+                    self.allocator.release_prefix(pages)
+                else:
+                    request.hist, request.held_pages = hist, pages
+                    request.bucket = bucket
+                    return bucket
+        bucket = self._bucket_for(len(ids))
+        request.bucket = 0 if bucket is None else bucket
+        return request.bucket
+
     def _admit_batch(self) -> bool:
         """Admit up to prefill_max_batch same-bucket requests in ONE prefill
         call (round-1 VERDICT weak #4: serial batch=1 admission serialized
@@ -459,7 +525,7 @@ class TPUEngine:
         # reject oversized prompts immediately
         while self._pending:
             head = self._pending[0]
-            if self._bucket_for(len(head.prompt_ids)) is not None:
+            if self._assign_bucket(head) != 0:
                 break
             self._pending.popleft()
             head.finish_reason = "length"
@@ -469,15 +535,21 @@ class TPUEngine:
         if not self._pending or not free_slots:
             return False
 
-        bucket = self._bucket_for(len(self._pending[0].prompt_ids))
+        head = self._pending[0]
+        bucket = self._assign_bucket(head)
+        # history rows run the gathered-context attention path, which costs
+        # O(S * max_context) regardless of hist — don't drag dense rows of
+        # the same bucket through it (they'd pay for a hit they didn't get)
+        with_hist = head.hist > 0
         group: list[GenRequest] = []
         skipped: list[GenRequest] = []
         limit = min(len(free_slots), config.prefill_max_batch)
         while self._pending and len(group) < limit:
             request = self._pending.popleft()
-            if self._bucket_for(len(request.prompt_ids)) == bucket:
+            if (self._assign_bucket(request) == bucket
+                    and (request.hist > 0) == with_hist):
                 group.append(request)
-            else:
+            else:  # holds (if any) persist — the pages are pinned until admitted
                 skipped.append(request)
         for request in reversed(skipped):  # preserve FIFO for other buckets
             self._pending.appendleft(request)
@@ -489,9 +561,11 @@ class TPUEngine:
             total = min(len(request.prompt_ids) + request.max_tokens,
                         config.max_seq_len)
             slot = free_slots[len(admitted)]
-            if not self.allocator.allocate_slot(slot, total):
+            if not self.allocator.allocate_slot(slot, total,
+                                                prefix_pages=request.held_pages):
                 self._pending.appendleft(request)  # page pressure: retry later
                 continue
+            request.held_pages = []  # ownership moved to the slot
             request.slot = slot
             request.queue_ms = (time.time() - request.created) * 1000
             self._running[slot] = request
@@ -517,9 +591,10 @@ class TPUEngine:
         top_k = np.zeros((B,), dtype=np.int32)
         top_p = np.ones((B,), dtype=np.float32)
         for i, request in enumerate(admitted):
-            n = len(request.prompt_ids)
-            tokens[i, :n] = request.prompt_ids
-            positions[i, :n] = np.arange(n)
+            suffix = request.prompt_ids[request.hist:]  # hist tokens are cached
+            n = len(suffix)
+            tokens[i, :n] = suffix
+            positions[i, :n] = np.arange(request.hist, request.hist + n)
             last_idx[i] = n - 1
             slot_ids[i] = request.slot
             temperature[i] = request.temperature
@@ -529,13 +604,23 @@ class TPUEngine:
                                   jnp.asarray(top_p))
         self._rng, key = jax.random.split(self._rng)
         # long buckets route through the sequence-parallel attention path
-        # (shape-deterministic: SP-ness is a property of the bucket)
+        # (shape-deterministic: SP-ness is a property of the bucket; SP
+        # groups never carry history — _assign_bucket guarantees it)
         use_sp = (self._prefill_sample_sp is not None
                   and bucket > self.config.sp_threshold)
-        prefill_fn = self._prefill_sample_sp if use_sp else self._prefill_sample
+        any_hist = any(r.hist > 0 for r in admitted)
+        prefill_fn = (self._prefill_sample_sp if use_sp
+                      else self._prefill_hist if any_hist
+                      else self._prefill_sample)
         first, self.kv = prefill_fn(
             self.params, self.kv, jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(slot_ids), jnp.asarray(last_idx), sampling, key)
+        if self.config.prefix_cache:
+            # prompt pages are on the device write path now; register the
+            # full ones so later prompts sharing the prefix skip their KV
+            for request in admitted:
+                self.allocator.register_prefix(request.slot,
+                                               request.prompt_ids)
         first_host = jax.device_get(first)  # dispatch thread: sync is fine here
         elapsed_ms = (time.monotonic() - started) * 1000
         self.stats.prefill_batches += 1

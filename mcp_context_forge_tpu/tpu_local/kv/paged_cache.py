@@ -106,10 +106,21 @@ def gather_kv(kv: PagedKVState, layer: int, slot_ids: jax.Array
 
 
 class PageAllocator:
-    """Host-side page bookkeeping: free list + per-slot assignment.
+    """Host-side page bookkeeping: refcounted free list + per-slot
+    assignment + prefix cache.
 
     Page 0 is reserved (trash). The device block table is refreshed from
-    ``tables()`` whenever assignments change."""
+    ``tables()`` whenever assignments change.
+
+    Prefix cache (vLLM automatic-prefix-caching analog, TPU-static
+    shapes): FULL pages of prompt tokens are registered under a chained
+    key (parent_key, page_tokens), so a later prompt sharing the prefix
+    reuses the resident pages and only its suffix is prefilled. Pages are
+    refcounted across slots; cached pages whose refcount drops to 0 stay
+    resident on an LRU until allocation pressure evicts them. A matched
+    page is immutable by construction — matches cover only positions
+    strictly before the new prompt's last token, and decode writes start
+    at the prompt's end."""
 
     def __init__(self, num_pages: int, page_size: int, max_slots: int,
                  max_pages_per_slot: int):
@@ -119,27 +130,121 @@ class PageAllocator:
         self.max_pages_per_slot = max_pages_per_slot
         self._free = list(range(num_pages - 1, 0, -1))  # page 0 reserved
         self._slots: dict[int, list[int]] = {}
+        self._ref: dict[int, int] = {}                  # page -> live refs
+        self._cached: dict[tuple, int] = {}             # chain key -> page
+        self._page_key: dict[int, tuple] = {}           # page -> chain key
+        self._lru: dict[int, None] = {}                 # ref==0 resident pages
+        self.prefix_hits = 0
+        self.prefix_hit_tokens = 0
 
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        return len(self._free) + len(self._lru)
 
     @property
     def pages_in_use(self) -> int:
-        return (self.num_pages - 1) - len(self._free)
+        return (self.num_pages - 1) - self.free_pages
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._cached)
 
     def pages_needed(self, n_tokens: int) -> int:
         return (n_tokens + self.page_size - 1) // self.page_size
 
     def can_allocate(self, n_tokens: int) -> bool:
-        return self.pages_needed(n_tokens) <= len(self._free)
+        return self.pages_needed(n_tokens) <= self.free_pages
 
-    def allocate_slot(self, slot: int, n_tokens: int) -> bool:
-        """Assign pages for a sequence of n_tokens to ``slot``."""
+    def _take_page(self) -> int:
+        """A writable page: prefer truly-free, else evict the LRU-oldest
+        resident cache page."""
+        if self._free:
+            return self._free.pop()
+        page = next(iter(self._lru))
+        del self._lru[page]
+        key = self._page_key.pop(page, None)
+        if key is not None and self._cached.get(key) == page:
+            del self._cached[key]
+        return page
+
+    def _release_page(self, page: int) -> None:
+        self._ref[page] = self._ref.get(page, 1) - 1
+        if self._ref[page] > 0:
+            return
+        del self._ref[page]
+        if page in self._page_key:       # registered prefix page: keep warm
+            self._lru[page] = None
+        else:
+            self._free.append(page)
+
+    # ------------------------------------------------------------ prefix cache
+
+    def match_prefix(self, prompt_ids: list[int]) -> tuple[int, list[int]]:
+        """Longest cached full-page prefix of ``prompt_ids``.
+
+        Returns (n_tokens_matched, pages) and takes a REFERENCE on every
+        matched page (caller must either assign them to a slot or call
+        release_prefix). Matches never cover the prompt's last token —
+        at least one token must prefill to produce logits."""
+        max_pages = max(0, (len(prompt_ids) - 1) // self.page_size)
+        key: tuple = ()
+        pages: list[int] = []
+        for i in range(max_pages):
+            chunk = tuple(prompt_ids[i * self.page_size:(i + 1) * self.page_size])
+            key = (key, chunk)
+            page = self._cached.get(key)
+            if page is None:
+                break
+            pages.append(page)
+        for page in pages:
+            self._ref[page] = self._ref.get(page, 0) + 1
+            self._lru.pop(page, None)
+        return len(pages) * self.page_size, pages
+
+    def release_prefix(self, pages: list[int]) -> None:
+        """Drop the references taken by match_prefix (request not admitted)."""
+        for page in reversed(pages):
+            self._release_page(page)
+
+    def register_prefix(self, slot: int, prompt_ids: list[int]) -> None:
+        """Register the slot's full prompt pages for future reuse. First
+        registration of a chain key wins; later identical pages stay
+        private and simply free when their slot does."""
+        pages = self._slots.get(slot, [])
+        n_full = len(prompt_ids) // self.page_size
+        key: tuple = ()
+        for i in range(min(n_full, len(pages))):
+            chunk = tuple(prompt_ids[i * self.page_size:(i + 1) * self.page_size])
+            key = (key, chunk)
+            page = pages[i]
+            if key in self._cached:
+                continue
+            if page in self._page_key:   # already registered under another key
+                continue
+            self._cached[key] = page
+            self._page_key[page] = key
+
+    # -------------------------------------------------------------- slot pages
+
+    def allocate_slot(self, slot: int, n_tokens: int,
+                      prefix_pages: list[int] | None = None) -> bool:
+        """Assign pages for a sequence of n_tokens to ``slot``; the first
+        ``prefix_pages`` (already referenced via match_prefix) are shared."""
+        shared = prefix_pages or []
         needed = self.pages_needed(n_tokens)
-        if needed > len(self._free) or needed > self.max_pages_per_slot:
+        fresh = needed - len(shared)
+        if (fresh > len(self._free) + len(self._lru)
+                or needed > self.max_pages_per_slot or fresh < 0):
             return False
-        self._slots[slot] = [self._free.pop() for _ in range(needed)]
+        if shared:  # hits are counted when the match is CONSUMED, not probed
+            self.prefix_hits += 1
+            self.prefix_hit_tokens += len(shared) * self.page_size
+        pages = list(shared)
+        for _ in range(fresh):
+            page = self._take_page()
+            self._ref[page] = self._ref.get(page, 0) + 1
+            pages.append(page)
+        self._slots[slot] = pages
         return True
 
     def extend_slot(self, slot: int, n_tokens: int) -> bool:
@@ -147,15 +252,17 @@ class PageAllocator:
         pages = self._slots.get(slot, [])
         needed = self.pages_needed(n_tokens)
         while len(pages) < needed:
-            if not self._free or len(pages) >= self.max_pages_per_slot:
+            if not (self._free or self._lru) or len(pages) >= self.max_pages_per_slot:
                 return False
-            pages.append(self._free.pop())
+            page = self._take_page()
+            self._ref[page] = self._ref.get(page, 0) + 1
+            pages.append(page)
         self._slots[slot] = pages
         return True
 
     def free_slot(self, slot: int) -> None:
-        for page in self._slots.pop(slot, []):
-            self._free.append(page)
+        for page in reversed(self._slots.pop(slot, [])):
+            self._release_page(page)
 
     def tables(self) -> "jnp.ndarray":
         import numpy as np

@@ -185,6 +185,63 @@ def prefill(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
     return logits, kv
 
 
+def prefill_with_history(params: dict[str, Any], config: LlamaConfig,
+                         tokens: jax.Array, positions: jax.Array,
+                         kv: PagedKVState, slot_ids: jax.Array
+                         ) -> tuple[jax.Array, PagedKVState]:
+    """Suffix/chunk prefill attending over cached history (prefix-cache
+    path — reference analog: the response_cache_by_prompt plugin caches
+    whole responses; this caches the KV of shared prompt PREFIXES so only
+    each request's suffix pays prefill FLOPs).
+
+    tokens/positions: [B, S] where positions carry ABSOLUTE positions (a
+    row whose prompt shares ``hist`` cached tokens starts at position
+    ``hist``); padding has position -1. The row's block table must already
+    map its history pages. Per-row history lengths may differ freely —
+    attention masks on absolute position (cache_pos <= q_pos), so one
+    compiled shape serves any mix. Returns (logits [B,S,V] fp32, kv)."""
+    B, S = tokens.shape
+    x = params["embed"][tokens]
+    mask_valid = positions >= 0
+    safe_positions = jnp.maximum(positions, 0)
+    for idx, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q, k, v = _attention_block(layer, config, h, safe_positions)
+        kv = write_prefill_kv(kv, idx, k, v, slot_ids, safe_positions,
+                              mask_valid)
+        keys, values = gather_kv(kv, idx, slot_ids)     # [B, C, KV, hd]
+        attn = _history_attention(q, keys, values, safe_positions,
+                                  mask_valid, config)
+        x = x + attn.reshape(B, S, -1) @ layer["wo"]
+        h = rms_norm(x, layer["ffn_norm"], config.norm_eps)
+        x = x + _ffn(layer, h)
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = lm_logits(params, x)
+    return logits, kv
+
+
+def _history_attention(q: jax.Array, keys: jax.Array, values: jax.Array,
+                       positions: jax.Array, valid: jax.Array,
+                       config: LlamaConfig) -> jax.Array:
+    """Chunk queries over the full gathered context (history + chunk).
+
+    q: [B,S,H,hd]; keys/values: [B,C,KV,hd]; positions/valid: [B,S].
+    Causality rides absolute position: cache index c (its position in the
+    slot's context) attends iff c <= q_position. -> [B,S,H,hd]."""
+    B, S, H, hd = q.shape
+    C = keys.shape[1]
+    G = H // config.n_kv_heads
+    qg = q.reshape(B, S, config.n_kv_heads, G, hd).astype(jnp.float32)
+    kf = keys.astype(jnp.float32)
+    scores = jnp.einsum("bskgh,bckh->bkgsc", qg, kf) / math.sqrt(hd)
+    cache_pos = jnp.arange(C)[None, None, :]                 # [1,1,C]
+    ok = (cache_pos <= positions[:, :, None]) & valid[:, :, None]  # [B,S,C]
+    scores = jnp.where(ok[:, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgsc,bckh->bskgh", probs, values.astype(jnp.float32))
+    return out.reshape(B, S, H, hd).astype(values.dtype)
+
+
 def decode_step(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
                 positions: jax.Array, kv: PagedKVState, slot_ids: jax.Array,
                 seq_lens: jax.Array) -> tuple[jax.Array, PagedKVState]:

@@ -1,0 +1,223 @@
+"""Prefix cache: allocator page sharing + suffix-only prefill parity.
+
+Reference analog: the response_cache_by_prompt plugin caches whole
+responses (/root/reference/plugins/response_cache_by_prompt/); the engine
+caches the KV of shared prompt PREFIXES instead, so the north-star plugin
+chain (fixed moderation/summarizer templates + varying user content) only
+pays prefill for each request's suffix."""
+
+import asyncio
+
+import pytest
+
+from mcp_context_forge_tpu.tpu_local.engine import EngineConfig, TPUEngine
+from mcp_context_forge_tpu.tpu_local.kv.paged_cache import PageAllocator
+
+PS = 4  # tiny pages make page-boundary math visible
+
+
+# ------------------------------------------------------------------ allocator
+
+def test_match_requires_full_pages_and_spares_last_token():
+    alloc = PageAllocator(num_pages=16, page_size=PS, max_slots=2,
+                          max_pages_per_slot=8)
+    prompt = list(range(10))                       # 2 full pages + 2 tokens
+    assert alloc.allocate_slot(0, 12)
+    alloc.register_prefix(0, prompt)
+    assert alloc.cached_pages == 2
+
+    hist, pages = alloc.match_prefix(prompt)
+    assert hist == 2 * PS and len(pages) == 2
+    alloc.release_prefix(pages)
+
+    # a prompt that IS exactly the cached pages must still leave >=1 token
+    # to prefill: only the first page may match
+    hist, pages = alloc.match_prefix(prompt[:8])
+    assert hist == PS and len(pages) == 1
+    alloc.release_prefix(pages)
+
+    # diverging second page: only the first matches
+    hist, pages = alloc.match_prefix(prompt[:4] + [99, 98, 97, 96, 95])
+    assert hist == PS
+    alloc.release_prefix(pages)
+
+
+def test_refcounts_keep_shared_pages_alive_until_all_release():
+    alloc = PageAllocator(num_pages=16, page_size=PS, max_slots=4,
+                          max_pages_per_slot=8)
+    prompt = list(range(9))                        # 2 full pages + 1
+    assert alloc.allocate_slot(0, 9)
+    alloc.register_prefix(0, prompt)
+    shared = list(alloc._slots[0][:2])
+
+    hist, pages = alloc.match_prefix(prompt)
+    assert pages == shared
+    assert alloc.allocate_slot(1, 9, prefix_pages=pages)
+    assert alloc._slots[1][:2] == shared           # same physical pages
+
+    alloc.free_slot(0)                             # slot 1 still references
+    assert all(alloc._ref.get(p, 0) >= 1 for p in shared)
+    alloc.free_slot(1)
+    # cached pages stay RESIDENT (LRU) at ref 0, not returned to free list
+    assert all(p in alloc._lru for p in shared)
+    assert alloc.cached_pages == 2
+
+    # a fresh match still hits the resident pages
+    hist, pages = alloc.match_prefix(prompt)
+    assert hist == 2 * PS and pages == shared
+    alloc.release_prefix(pages)
+
+
+def test_eviction_under_pressure_reclaims_lru_cache_pages():
+    alloc = PageAllocator(num_pages=8, page_size=PS, max_slots=2,
+                          max_pages_per_slot=8)    # 7 usable pages
+    prompt = list(range(9))
+    assert alloc.allocate_slot(0, 9)               # 3 pages
+    alloc.register_prefix(0, prompt)
+    alloc.free_slot(0)                             # 2 cached resident, 7 free-ish
+    assert alloc.free_pages == 7 and alloc.cached_pages == 2
+
+    # exhaust the free list; allocation must evict the resident cache pages
+    assert alloc.allocate_slot(1, 7 * PS)
+    assert alloc.cached_pages == 0                 # evicted to serve demand
+    hist, pages = alloc.match_prefix(prompt)
+    assert hist == 0 and pages == []
+
+
+# ------------------------------------------------------------------- engine
+
+def _engine(prefix_cache: bool) -> TPUEngine:
+    return TPUEngine(EngineConfig(
+        model="llama3-test", max_batch=2, max_seq_len=128, page_size=16,
+        num_pages=64, prefill_buckets=(16, 64), dtype="float32",
+        attn_impl="reference", prefix_cache=prefix_cache))
+
+
+async def _gen(engine: TPUEngine, ids, n=8):
+    return [t async for t in engine.generate(ids, max_tokens=n)]
+
+
+def test_suffix_prefill_matches_cold_prefill_exactly():
+    """Greedy outputs through the history path must equal the dense path:
+    same template prefix (>1 page), different user suffixes."""
+    async def run():
+        cached = _engine(True)
+        cold = _engine(False)
+        template = cached.tokenizer.encode("sys: moderation template; answer:")
+        assert 2 * 16 < len(template) <= 48  # spans >1 full page, fits bucket
+        prompts = [template + cached.tokenizer.encode(f" user {i}")
+                   for i in range(3)]
+        assert all(len(p) <= 64 for p in prompts)
+
+        for engine in (cached, cold):
+            await engine.start()
+        try:
+            outs_cached = [await _gen(cached, p) for p in prompts]
+            outs_cold = [await _gen(cold, p) for p in prompts]
+            assert all(len(out) >= 1 for out in outs_cold)
+            assert outs_cached == outs_cold
+            # 2nd+ prompts hit the cached template pages
+            assert cached.allocator.prefix_hit_tokens >= 16
+            assert cold.allocator.prefix_hit_tokens == 0
+            # and a rerun of the FIRST prompt still matches its cold run
+            assert await _gen(cached, prompts[0]) == outs_cold[0]
+        finally:
+            for engine in (cached, cold):
+                await engine.stop()
+
+    asyncio.run(run())
+
+
+def test_hit_uses_smaller_bucket():
+    """A long prompt with a cached prefix buckets by suffix length —
+    the whole point: template-dominated prompts prefill small."""
+    async def run():
+        engine = _engine(True)
+        template = list(range(3, 40))              # 37 tokens: 2 full pages
+        p1 = template + [41, 42, 43, 44]           # 41 tokens -> bucket 64
+        p2 = template + [51, 52, 53]               # suffix 8 -> bucket 16
+        await engine.start()
+        try:
+            await _gen(engine, p1, n=4)
+            req_bucket = []
+            # second request: suffix = 40-32=8 tokens + tail -> bucket 16
+            from mcp_context_forge_tpu.tpu_local.engine import GenRequest
+            request = GenRequest(request_id="probe", prompt_ids=p2)
+            engine._assign_bucket(request)
+            req_bucket.append((request.hist, request.bucket))
+            engine.allocator.release_prefix(request.held_pages)
+            assert req_bucket == [(32, 16)]
+        finally:
+            await engine.stop()
+
+    asyncio.run(run())
+
+
+def test_prefix_cache_off_is_inert():
+    alloc_probe = _engine(False)
+    assert alloc_probe._prefill_hist is None
+
+    async def run():
+        await alloc_probe.start()
+        try:
+            ids = alloc_probe.tokenizer.encode("hello " * 8)
+            out = await _gen(alloc_probe, ids, n=4)
+            assert len(out) >= 1
+            assert alloc_probe.allocator.cached_pages == 0
+        finally:
+            await alloc_probe.stop()
+
+    asyncio.run(run())
+
+
+def test_oversize_prompt_rejected_even_on_prefix_hit():
+    """A prompt that exceeds max_seq_len must reject cleanly even when a
+    long cached prefix would make its SUFFIX fit a bucket — otherwise page
+    indices clamp and the corrupted page gets published to the cache."""
+    async def run():
+        engine = TPUEngine(EngineConfig(
+            model="llama3-test", max_batch=2, max_seq_len=64, page_size=16,
+            num_pages=64, prefill_buckets=(16, 64), dtype="float32",
+            attn_impl="reference", prefix_cache=True))
+        await engine.start()
+        try:
+            base = list(range(3, 3 + 48))          # 3 full pages cached
+            out = await _gen(engine, base + [99], n=2)
+            assert len(out) >= 1
+
+            from mcp_context_forge_tpu.tpu_local.engine import GenRequest
+            over = base + list(range(60, 80))      # 68 tokens > max_seq_len
+            request = GenRequest(request_id="probe", prompt_ids=over)
+            assert engine._assign_bucket(request) == 0   # rejected
+            assert request.held_pages == []              # no dangling refs
+
+            oversized = GenRequest(request_id="x", prompt_ids=over)
+            await engine.submit(oversized)
+            token = await asyncio.wait_for(oversized.stream.get(), timeout=60)
+            assert token is None and oversized.finish_reason == "length"
+        finally:
+            await engine.stop()
+
+    asyncio.run(run())
+
+
+def test_mixed_group_splits_hist_from_dense():
+    """Admission groups never mix cache-hit rows with dense rows: dense
+    prompts must not pay the gathered-context attention path."""
+    async def run():
+        engine = _engine(True)
+        tmpl = list(range(3, 40))                  # registers 2 full pages
+        await engine.start()
+        try:
+            await _gen(engine, tmpl + [77], n=2)
+            # concurrent burst: one hit (shares tmpl) + one dense, same bucket
+            hit, dense = tmpl + [88], list(range(100, 140))
+            outs = await asyncio.gather(_gen(engine, hit, n=2),
+                                        _gen(engine, dense, n=2))
+            assert all(len(o) >= 1 for o in outs)
+            # the two admissions ran as separate prefill batches
+            assert engine.stats.prefill_batches >= 3
+        finally:
+            await engine.stop()
+
+    asyncio.run(run())
