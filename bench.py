@@ -87,6 +87,10 @@ async def _make_gateway(engine: bool, platform: str):
         # compile the full prefill/decode shape grid at boot so the timed
         # configs below measure steady state, not XLA compile latency
         "MCPFORGE_TPU_LOCAL_WARMUP": "true" if engine else "false",
+        # persistent executable cache: bench reruns (and the engine bench)
+        # skip XLA recompiles entirely
+        "MCPFORGE_TPU_LOCAL_COMPILE_CACHE_DIR": os.environ.get(
+            "MCPFORGE_TPU_LOCAL_COMPILE_CACHE_DIR", "/tmp/mcpforge-xla-cache"),
     }
     settings = load_settings(env=env, env_file=None)
     app = await build_app(settings)

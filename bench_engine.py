@@ -51,7 +51,10 @@ async def run(platform: str) -> dict:
                           max_seq_len=512, page_size=16, num_pages=512,
                           prefill_buckets=(64,),
                           dtype="bfloat16" if platform == "tpu" else "float32",
-                          attn_impl="auto", decode_block=decode_block)
+                          attn_impl="auto", decode_block=decode_block,
+                          compile_cache_dir=os.environ.get(
+                              "MCPFORGE_TPU_LOCAL_COMPILE_CACHE_DIR",
+                              "/tmp/mcpforge-xla-cache"))
     engine = TPUEngine(config)
     await engine.start()
     try:

@@ -158,6 +158,12 @@ class Settings(BaseModel):
     # are reused across requests, so repeated plugin/chat templates only
     # prefill their suffix (vLLM automatic-prefix-caching analog)
     tpu_local_prefix_cache: bool = True
+    # speculative decoding via prompt-lookup (n-gram) drafting: verify k
+    # drafted tokens per dispatch — decode is bandwidth-bound, so accepted
+    # drafts are nearly free. Greedy requests only; off by default.
+    tpu_local_spec_decode: bool = False
+    tpu_local_spec_k: int = 4
+    tpu_local_spec_ngram: int = 2
 
     # --- SSO (JSON list: [{name, issuer, client_id, client_secret}]) ---
     sso_providers: str = ""

@@ -465,6 +465,11 @@ def setup_routes(app: web.Application) -> None:
                 "hits": alloc.prefix_hits,
                 "hit_tokens": alloc.prefix_hit_tokens,
             },
+            "spec_decode": {
+                "enabled": engine.config.spec_decode,
+                "steps": stats.spec_steps,
+                "extra_tokens": stats.spec_tokens,
+            },
         })
 
     @routes.post("/admin/engine/profile")

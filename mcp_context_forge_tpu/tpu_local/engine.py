@@ -79,6 +79,19 @@ class EngineConfig:
     # prefix cache: reuse resident KV pages for shared full-page prompt
     # prefixes; only each request's suffix pays prefill (vLLM APC analog)
     prefix_cache: bool = True
+    # speculative decoding via prompt-lookup (n-gram) drafting: decode is
+    # HBM-bandwidth-bound (one full param read per step), so verifying
+    # spec_k drafted tokens in ONE step multiplies tokens/step by the
+    # accept rate for free bandwidth-wise. Greedy rows only; sampled rows
+    # ride the same verify step one token at a time. Mutually exclusive
+    # with decode_block > 1. CAVEAT: the verify step runs the gathered
+    # full-context attention path, not the Pallas paged kernel plain
+    # decode uses on TPU — at low accept rates (non-repetitive output)
+    # that trade can lose; enable for repetitive workloads (summaries,
+    # extraction, code edits) and watch stats.spec_tokens.
+    spec_decode: bool = False
+    spec_k: int = 4          # chunk width: 1 input token + spec_k-1 drafts
+    spec_ngram: int = 2      # context n-gram length used for lookup
 
     @classmethod
     def from_settings(cls, settings) -> "EngineConfig":
@@ -100,6 +113,9 @@ class EngineConfig:
             warmup=getattr(settings, "tpu_local_warmup", False),
             compile_cache_dir=getattr(settings, "tpu_local_compile_cache_dir", ""),
             prefix_cache=getattr(settings, "tpu_local_prefix_cache", True),
+            spec_decode=getattr(settings, "tpu_local_spec_decode", False),
+            spec_k=getattr(settings, "tpu_local_spec_k", 4),
+            spec_ngram=getattr(settings, "tpu_local_spec_ngram", 2),
         )
 
 
@@ -122,11 +138,10 @@ class GenRequest:
     finish_reason: str | None = None
     prefill_ms: float = 0.0
     queue_ms: float = 0.0
-    # prefix-cache admission state: cached history length, the referenced
-    # cache pages held for this request, and the (suffix) bucket; bucket -1
-    # means not yet matched
+    # prefix-cache admission state: probed cached-history length and the
+    # (suffix) bucket; bucket -1 means not yet probed. The probe takes no
+    # page references — the real match happens at admission.
     hist: int = 0
-    held_pages: list[int] = field(default_factory=list)
     bucket: int = -1
 
 
@@ -139,6 +154,8 @@ class EngineStats:
         self.prefill_batches = 0
         self.prefill_requests = 0
         self.queue_depth = 0
+        self.spec_steps = 0      # speculative verify dispatches
+        self.spec_tokens = 0     # extra tokens emitted beyond 1/step
 
 
 class EngineInitTimeout(RuntimeError):
@@ -186,6 +203,11 @@ class TPUEngine:
         if config.decode_block < 1:
             raise ValueError(
                 f"decode_block must be >= 1, got {config.decode_block}")
+        if config.spec_decode and config.decode_block > 1:
+            raise ValueError("spec_decode and decode_block>1 are mutually "
+                             "exclusive (both widen the per-dispatch step)")
+        if config.spec_decode and config.spec_k < 2:
+            raise ValueError(f"spec_k must be >= 2, got {config.spec_k}")
         self.config = config
         if config.compile_cache_dir:
             # persistent executable cache: reruns (gateway restarts, bench
@@ -261,6 +283,9 @@ class TPUEngine:
         self._prefill_hist = (
             jax.jit(self._prefill_hist_and_sample, donate_argnames=("kv",))
             if config.prefix_cache else None)
+        self._verify = (jax.jit(self._verify_and_sample,
+                                donate_argnames=("kv",))
+                        if config.spec_decode else None)
         if config.warmup:
             self.warmup()
 
@@ -307,13 +332,23 @@ class TPUEngine:
             samp = SamplingParams(jnp.zeros((B,), jnp.float32),
                                   jnp.zeros((B,), jnp.int32),
                                   jnp.ones((B,), jnp.float32))
-            # seq_lens=0: every slot is "inactive", writes masked to trash
-            block, self.kv = self._decode(
-                self.params, self.kv, jnp.zeros((B,), jnp.int32),
-                jnp.zeros((B,), jnp.int32), jnp.arange(B, dtype=jnp.int32),
-                jnp.zeros((B,), jnp.int32), samp, jax.random.PRNGKey(0))
-            block.block_until_ready()
-            shapes += 1
+            if self._verify is not None:
+                block, self.kv = self._verify(
+                    self.params, self.kv,
+                    jnp.zeros((B, self.config.spec_k), jnp.int32),
+                    jnp.full((B, self.config.spec_k), -1, jnp.int32),
+                    jnp.arange(B, dtype=jnp.int32), samp,
+                    jax.random.PRNGKey(0))
+                block.block_until_ready()
+                shapes += 1
+            else:
+                # seq_lens=0: every slot is "inactive", writes masked to trash
+                block, self.kv = self._decode(
+                    self.params, self.kv, jnp.zeros((B,), jnp.int32),
+                    jnp.zeros((B,), jnp.int32), jnp.arange(B, dtype=jnp.int32),
+                    jnp.zeros((B,), jnp.int32), samp, jax.random.PRNGKey(0))
+                block.block_until_ready()
+                shapes += 1
         logger.info("tpu_local warmup: %d shapes compiled in %.1fs",
                     shapes, time.monotonic() - started)
 
@@ -345,6 +380,23 @@ class TPUEngine:
         last = logits[jnp.arange(B), last_idx]          # [B, V]
         first = sample_tokens(last, sampling, key)
         return first, kv
+
+    def _verify_and_sample(self, params, kv, tokens, positions, slot_ids,
+                           sampling: SamplingParams, key):
+        """Speculative verify: a [B, K] chunk (1 real token + K-1 drafts per
+        row) through the gathered-history path, sampling at EVERY position.
+        Position j's sample is the model's true next token given the chunk
+        prefix up to j — the host accepts drafts while they agree. Returns
+        ([B, K] sampled tokens, kv)."""
+        logits, kv = prefill_with_history(params, self.model_config, tokens,
+                                          positions, kv, slot_ids)
+        B, K, V = logits.shape
+        flat = logits.reshape(B * K, V)
+        samp = SamplingParams(jnp.repeat(sampling.temperature, K),
+                              jnp.repeat(sampling.top_k, K),
+                              jnp.repeat(sampling.top_p, K))
+        out = sample_tokens(flat, samp, key)
+        return out.reshape(B, K), kv
 
     def _decode_and_sample(self, params, kv, tokens, positions, slot_ids,
                            seq_lens, sampling: SamplingParams, key):
@@ -441,7 +493,10 @@ class TPUEngine:
             while not self._stop_event.is_set():
                 did_work = self._admit_batch()
                 if self._running:
-                    self._decode_step_all()
+                    if self._verify is not None:
+                        self._spec_step_all()
+                    else:
+                        self._decode_step_all()
                     did_work = True
                 self.stats.queue_depth = self._work.qsize() + len(self._pending)
                 if not did_work:
@@ -461,8 +516,6 @@ class TPUEngine:
             self._finish(request)
         while self._pending:
             request = self._pending.popleft()
-            self.allocator.release_prefix(request.held_pages)
-            request.held_pages = []
             if request.finish_reason is None:
                 request.finish_reason = reason
             self._post_tokens(request, [], done=True)
@@ -481,11 +534,14 @@ class TPUEngine:
         return None
 
     def _assign_bucket(self, request: GenRequest) -> int:
-        """Request's prefill bucket (0 = fits no bucket), matched against
-        the prefix cache exactly once: a hit holds references on the cached
-        pages and buckets by SUFFIX length, so a 2048-token prompt with a
-        cached 1920-token template prefix prefills in the smallest bucket.
-        SP buckets never run the history path (the shard_map prefill has no
+        """Request's prefill bucket (0 = fits no bucket). A prefix-cache
+        hit buckets by SUFFIX length, so a 2048-token prompt with a cached
+        1920-token template prefix prefills in the smallest bucket. The
+        probe is READ-ONLY — no page references are taken here, so pending
+        requests never pin cache pages (a pinned-pages cycle between two
+        queued requests would deadlock admission); the real match happens
+        at admission and is re-verified against this probe. SP buckets
+        never run the history path (the shard_map prefill has no
         paged-history support) — those fall back to a dense full prefill."""
         if request.bucket != -1:
             return request.bucket
@@ -497,18 +553,17 @@ class TPUEngine:
             request.bucket = 0
             return 0
         if self.config.prefix_cache and self._prefill_hist is not None:
-            hist, pages = self.allocator.match_prefix(ids)
+            hist = self.allocator.probe_prefix(ids)
             if hist:
                 bucket = self._bucket_for(len(ids) - hist)
                 sp_bucket = (self._prefill_sample_sp is not None
                              and bucket is not None
                              and bucket > self.config.sp_threshold)
-                if bucket is None or sp_bucket:
-                    self.allocator.release_prefix(pages)
-                else:
-                    request.hist, request.held_pages = hist, pages
+                if bucket is not None and not sp_bucket:
+                    request.hist = hist
                     request.bucket = bucket
                     return bucket
+        request.hist = 0
         bucket = self._bucket_for(len(ids))
         request.bucket = 0 if bucket is None else bucket
         return request.bucket
@@ -549,7 +604,7 @@ class TPUEngine:
             if (self._assign_bucket(request) == bucket
                     and (request.hist > 0) == with_hist):
                 group.append(request)
-            else:  # holds (if any) persist — the pages are pinned until admitted
+            else:
                 skipped.append(request)
         for request in reversed(skipped):  # preserve FIFO for other buckets
             self._pending.appendleft(request)
@@ -561,11 +616,25 @@ class TPUEngine:
             total = min(len(request.prompt_ids) + request.max_tokens,
                         config.max_seq_len)
             slot = free_slots[len(admitted)]
+            shared: list[int] = []
+            if request.hist:
+                hist, shared = self.allocator.match_prefix(request.prompt_ids)
+                if hist != request.hist:
+                    # the cache moved between probe and admission (eviction
+                    # or a longer registration): re-probe for a new bucket
+                    self.allocator.release_prefix(shared)
+                    request.bucket = -1
+                    self._pending.appendleft(request)
+                    continue
             if not self.allocator.allocate_slot(slot, total,
-                                                prefix_pages=request.held_pages):
-                self._pending.appendleft(request)  # page pressure: retry later
+                                                prefix_pages=shared):
+                # page pressure: release the match (references held past
+                # this point would pin pages and could deadlock admission)
+                # and retry later with a fresh probe
+                self.allocator.release_prefix(shared)
+                request.bucket = -1
+                self._pending.appendleft(request)
                 continue
-            request.held_pages = []  # ownership moved to the slot
             request.slot = slot
             request.queue_ms = (time.time() - request.created) * 1000
             self._running[slot] = request
@@ -629,6 +698,97 @@ class TPUEngine:
             request.prefill_ms = elapsed_ms
             self._emit(request, int(first_host[i]))
         return True
+
+    # ------------------------------------------------------- speculative step
+
+    def _draft_tokens(self, request: GenRequest, k: int) -> list[int]:
+        """Prompt-lookup drafting: the most recent earlier occurrence of the
+        trailing spec_ngram in (prompt + generated), returning up to k
+        tokens that followed it. No draft model — the context itself is the
+        proposer (works because summaries/tool outputs echo their inputs,
+        and greedy decoding revisits its own phrases)."""
+        n = self.config.spec_ngram
+        ctx = request.prompt_ids + request.generated
+        if len(ctx) <= n:
+            return []
+        tail = ctx[-n:]
+        lo = max(0, len(ctx) - n - 512)  # bounded scan window
+        for start in range(len(ctx) - n - 1, lo - 1, -1):
+            if ctx[start:start + n] == tail:
+                return ctx[start + n:start + n + k]
+        return []
+
+    def _spec_step_all(self) -> None:
+        """One [B, K] verify step over every active slot: row = last token
+        + up to K-1 drafted continuations. Drafts are accepted while the
+        model's own (sampled) next token agrees, so each dispatch emits
+        1..K tokens per slot for a single param read. Greedy rows only get
+        drafts; sampled rows ride along at width 1 (their one token is
+        drawn from the true distribution). Rejected-draft KV is dead by
+        masking: attention reads at position p only after some later chunk
+        rewrites p."""
+        config = self.config
+        B, K = config.max_batch, config.spec_k
+        tokens = np.zeros((B, K), dtype=np.int32)
+        positions = np.full((B, K), -1, dtype=np.int32)
+        temperature = np.zeros((B,), dtype=np.float32)
+        top_k = np.zeros((B,), dtype=np.int32)
+        top_p = np.ones((B,), dtype=np.float32)
+        active = list(self._running.items())
+        widths: dict[int, int] = {}
+        chunks: dict[int, list[int]] = {}
+        for slot, request in active:
+            n_ctx = len(request.prompt_ids) + len(request.generated)
+            p0 = n_ctx - 1
+            remaining = max(0, request.max_tokens - len(request.generated))
+            chunk = [request.generated[-1]]
+            if request.temperature == 0.0 and remaining > 1:
+                chunk += self._draft_tokens(request, K - 1)
+            chunk = chunk[:min(K, remaining)]  # active => remaining >= 1
+            usable = 0
+            for j in range(len(chunk)):
+                if self.allocator.extend_slot(slot, p0 + j + 1):
+                    usable = j + 1
+                else:
+                    break
+            widths[slot] = usable
+            if usable == 0:
+                request.finish_reason = "length"
+                continue
+            chunk = chunk[:usable]
+            chunks[slot] = chunk
+            tokens[slot, :usable] = chunk
+            positions[slot, :usable] = np.arange(p0, p0 + usable)
+            temperature[slot] = request.temperature
+            top_k[slot] = request.top_k
+            top_p[slot] = request.top_p
+        self._sync_tables()
+        sampling = SamplingParams(jnp.asarray(temperature), jnp.asarray(top_k),
+                                  jnp.asarray(top_p))
+        self._rng, key = jax.random.split(self._rng)
+        block, self.kv = self._verify(
+            self.params, self.kv, jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.arange(B, dtype=jnp.int32), sampling, key)
+        self.stats.decode_steps += 1
+        self.stats.spec_steps += 1
+        block_host = jax.device_get(block)  # [B, K]
+        for slot, request in active:
+            if request.finish_reason == "length" and request.slot in self._running:
+                self._finish(request)
+                continue
+            chunk = chunks.get(slot, [])
+            sampled = block_host[slot]
+            emitted = 0
+            for j in range(widths[slot]):
+                # chunk[j] (j>0) is a draft: valid iff it matched the
+                # model's sample at the previous position
+                if j > 0 and chunk[j] != sampled[j - 1]:
+                    break
+                self._emit(request, int(sampled[j]))
+                emitted += 1
+                if request.slot not in self._running:
+                    break  # EOS/stop/max hit inside the chunk
+            self.stats.spec_tokens += max(0, emitted - 1)
 
     # ------------------------------------------------------------ decode step
 

@@ -179,13 +179,10 @@ class PageAllocator:
 
     # ------------------------------------------------------------ prefix cache
 
-    def match_prefix(self, prompt_ids: list[int]) -> tuple[int, list[int]]:
-        """Longest cached full-page prefix of ``prompt_ids``.
-
-        Returns (n_tokens_matched, pages) and takes a REFERENCE on every
-        matched page (caller must either assign them to a slot or call
-        release_prefix). Matches never cover the prompt's last token —
-        at least one token must prefill to produce logits."""
+    def _walk_prefix(self, prompt_ids: list[int]) -> list[int]:
+        """Pages of the longest cached full-page prefix. Matches never
+        cover the prompt's last token — at least one token must prefill to
+        produce logits."""
         max_pages = max(0, (len(prompt_ids) - 1) // self.page_size)
         key: tuple = ()
         pages: list[int] = []
@@ -196,6 +193,21 @@ class PageAllocator:
             if page is None:
                 break
             pages.append(page)
+        return pages
+
+    def probe_prefix(self, prompt_ids: list[int]) -> int:
+        """Read-only: tokens a match WOULD cover (used for bucket sizing).
+        Takes no references, so probing can never pin pages — the real
+        match happens at admission via match_prefix."""
+        return len(self._walk_prefix(prompt_ids)) * self.page_size
+
+    def match_prefix(self, prompt_ids: list[int]) -> tuple[int, list[int]]:
+        """Longest cached full-page prefix of ``prompt_ids``.
+
+        Returns (n_tokens_matched, pages) and takes a REFERENCE on every
+        matched page (caller must either assign them to a slot or call
+        release_prefix)."""
+        pages = self._walk_prefix(prompt_ids)
         for page in pages:
             self._ref[page] = self._ref.get(page, 0) + 1
             self._lru.pop(page, None)

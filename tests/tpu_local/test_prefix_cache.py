@@ -143,9 +143,8 @@ def test_hit_uses_smaller_bucket():
             # second request: suffix = 40-32=8 tokens + tail -> bucket 16
             from mcp_context_forge_tpu.tpu_local.engine import GenRequest
             request = GenRequest(request_id="probe", prompt_ids=p2)
-            engine._assign_bucket(request)
+            engine._assign_bucket(request)  # read-only probe: no refs taken
             req_bucket.append((request.hist, request.bucket))
-            engine.allocator.release_prefix(request.held_pages)
             assert req_bucket == [(32, 16)]
         finally:
             await engine.stop()
@@ -189,7 +188,6 @@ def test_oversize_prompt_rejected_even_on_prefix_hit():
             over = base + list(range(60, 80))      # 68 tokens > max_seq_len
             request = GenRequest(request_id="probe", prompt_ids=over)
             assert engine._assign_bucket(request) == 0   # rejected
-            assert request.held_pages == []              # no dangling refs
 
             oversized = GenRequest(request_id="x", prompt_ids=over)
             await engine.submit(oversized)
@@ -217,6 +215,34 @@ def test_mixed_group_splits_hist_from_dense():
             assert all(len(o) >= 1 for o in outs)
             # the two admissions ran as separate prefill batches
             assert engine.stats.prefill_batches >= 3
+        finally:
+            await engine.stop()
+
+    asyncio.run(run())
+
+
+def test_page_pressure_with_templates_makes_progress():
+    """Two templated requests whose combined page demand exceeds the pool
+    must serialize, not deadlock: probes take no references, so pending
+    requests can never pin pages against each other."""
+    async def run():
+        engine = TPUEngine(EngineConfig(
+            model="llama3-test", max_batch=2, max_seq_len=64, page_size=16,
+            num_pages=6, prefill_buckets=(16, 64), dtype="float32",
+            attn_impl="reference", prefix_cache=True))  # 5 usable pages
+        tmplA = list(range(3, 36))                  # 33 tokens: 2 full pages
+        tmplB = list(range(100, 133))
+        await engine.start()
+        try:
+            # seed A's template into the cache, then demand both at once:
+            # each needs 4 pages (33+16 tokens of capacity = 49 -> 4 pages)
+            seed = await _gen(engine, tmplA + [40], n=2)
+            assert len(seed) >= 1
+            outs = await asyncio.wait_for(asyncio.gather(
+                _gen(engine, tmplA + [41], n=8),
+                _gen(engine, tmplB + [42], n=8),
+            ), timeout=300)
+            assert all(len(o) >= 1 for o in outs)
         finally:
             await engine.stop()
 
