@@ -84,11 +84,12 @@ class EngineConfig:
     # spec_k drafted tokens in ONE step multiplies tokens/step by the
     # accept rate for free bandwidth-wise. Greedy rows only; sampled rows
     # ride the same verify step one token at a time. Mutually exclusive
-    # with decode_block > 1. CAVEAT: the verify step runs the gathered
-    # full-context attention path, not the Pallas paged kernel plain
-    # decode uses on TPU — at low accept rates (non-repetitive output)
-    # that trade can lose; enable for repetitive workloads (summaries,
-    # extraction, code edits) and watch stats.spec_tokens.
+    # with decode_block > 1. On TPU the verify runs the Pallas paged
+    # CHUNK kernel (same enabling conditions as decode); the remaining
+    # trade is K x the attention/MLP compute per dispatch, so low accept
+    # rates (non-repetitive output) can still lose — enable for
+    # repetitive workloads (summaries, extraction, code edits) and watch
+    # stats.spec_tokens.
     spec_decode: bool = False
     spec_k: int = 4          # chunk width: 1 input token + spec_k-1 drafts
     spec_ngram: int = 2      # context n-gram length used for lookup
@@ -140,9 +141,12 @@ class GenRequest:
     queue_ms: float = 0.0
     # prefix-cache admission state: probed cached-history length and the
     # (suffix) bucket; bucket -1 means not yet probed. The probe takes no
-    # page references — the real match happens at admission.
+    # page references — the real match happens at admission. ``chunked``
+    # marks prompts whose (suffix) length exceeds every bucket: they
+    # prefill in multiple bucket-sized chunks through the history path.
     hist: int = 0
     bucket: int = -1
+    chunked: bool = False
 
 
 class EngineStats:
@@ -280,9 +284,11 @@ class TPUEngine:
                     donate_argnames=("kv",))
             if config.sp_impl != "none" else None)
         self._decode = jax.jit(self._decode_and_sample, donate_argnames=("kv",))
-        self._prefill_hist = (
-            jax.jit(self._prefill_hist_and_sample, donate_argnames=("kv",))
-            if config.prefix_cache else None)
+        # the chunk/history prefill is a core primitive (prefix-cache hits
+        # AND chunked prefill of prompts longer than the largest bucket);
+        # always built, compiled lazily on first use
+        self._prefill_hist = jax.jit(self._prefill_hist_and_sample,
+                                     donate_argnames=("kv",))
         self._verify = (jax.jit(self._verify_and_sample,
                                 donate_argnames=("kv",))
                         if config.spec_decode else None)
@@ -302,9 +308,6 @@ class TPUEngine:
             for bucket in self.config.prefill_buckets:
                 use_sp = (self._prefill_sample_sp is not None
                           and bucket > self.config.sp_threshold)
-                fns = ([self._prefill_sample_sp] if use_sp
-                       else [self._prefill_sample]
-                       + ([self._prefill_hist] if self._prefill_hist else []))
                 # _admit_batch pads to the pow-2 CEILING of the group size,
                 # so compile through ceil_pow2(prefill_max_batch), not just
                 # the powers of two at or below it
@@ -313,6 +316,15 @@ class TPUEngine:
                     cap *= 2
                 B = 1
                 while B <= cap:
+                    # the history fn serves prefix-cache hits (any B) and
+                    # chunked prefill (always B=1) — don't compile hit-path
+                    # batch shapes that can't occur with the cache off
+                    if use_sp:
+                        fns = [self._prefill_sample_sp]
+                    else:
+                        fns = [self._prefill_sample]
+                        if self.config.prefix_cache or B == 1:
+                            fns.append(self._prefill_hist)
                     samp = SamplingParams(jnp.zeros((B,), jnp.float32),
                                           jnp.zeros((B,), jnp.int32),
                                           jnp.ones((B,), jnp.float32))
@@ -545,6 +557,7 @@ class TPUEngine:
         paged-history support) — those fall back to a dense full prefill."""
         if request.bucket != -1:
             return request.bucket
+        request.chunked = False  # recomputed below on every (re-)probe
         ids = request.prompt_ids
         if len(ids) + 1 > self.config.max_seq_len:
             # the prompt plus >=1 generated token must fit the block table;
@@ -552,7 +565,7 @@ class TPUEngine:
             # the prefix cache, publish) the slot's last page
             request.bucket = 0
             return 0
-        if self.config.prefix_cache and self._prefill_hist is not None:
+        if self.config.prefix_cache:
             hist = self.allocator.probe_prefix(ids)
             if hist:
                 bucket = self._bucket_for(len(ids) - hist)
@@ -563,9 +576,25 @@ class TPUEngine:
                     request.hist = hist
                     request.bucket = bucket
                     return bucket
+                if bucket is None:
+                    # the suffix alone exceeds every bucket: chunk it, but
+                    # FROM the cached prefix — the chunk loop starts at hist
+                    request.hist = hist
+                    request.chunked = True
+                    request.bucket = max(self.config.prefill_buckets)
+                    return request.bucket
         request.hist = 0
         bucket = self._bucket_for(len(ids))
-        request.bucket = 0 if bucket is None else bucket
+        if bucket is None:
+            # longer than every bucket but fits the block table: chunked
+            # prefill — bucket-sized chunks through the history path, each
+            # attending to the previous chunks' KV. (Also the safety net
+            # for a prefix-cache hit whose pages were evicted between
+            # probe and admission: the request stays servable.)
+            request.chunked = True
+            request.bucket = max(self.config.prefill_buckets)
+            return request.bucket
+        request.bucket = bucket
         return request.bucket
 
     def _admit_batch(self) -> bool:
@@ -602,7 +631,9 @@ class TPUEngine:
         while self._pending and len(group) < limit:
             request = self._pending.popleft()
             if (self._assign_bucket(request) == bucket
-                    and (request.hist > 0) == with_hist):
+                    and (request.hist > 0) == with_hist
+                    and request.chunked == head.chunked
+                    and not (request.chunked and group)):  # chunked: alone
                 group.append(request)
             else:
                 skipped.append(request)
@@ -642,6 +673,20 @@ class TPUEngine:
         if not admitted:
             return False
         self._sync_tables()
+
+        if admitted[0].chunked:
+            request = admitted[0]  # chunked requests are admitted alone
+            first_tok = self._prefill_chunked(request)
+            # register BEFORE emitting: a first token that finishes the
+            # request (EOS / max_tokens=1) frees the slot's pages, and a
+            # post-emit registration would cache nothing — defeating the
+            # prefix cache for classification-style template workloads
+            if self.config.prefix_cache:
+                self.allocator.register_prefix(request.slot,
+                                               request.prompt_ids)
+            self.stats.prefill_requests += 1
+            self._emit(request, first_tok)
+            return True
 
         started = time.monotonic()
         # pad batch to the next power of two so XLA compiles at most
@@ -698,6 +743,43 @@ class TPUEngine:
             request.prefill_ms = elapsed_ms
             self._emit(request, int(first_host[i]))
         return True
+
+    def _prefill_chunked(self, request: GenRequest) -> int:
+        """Prefill a prompt longer than every bucket in bucket-sized chunks
+        through the history path — chunk i attends to chunks 0..i-1 already
+        written to the slot's pages (plus any cached prefix). Mid-chunk
+        samples predict known prompt tokens and are discarded; returns the
+        final chunk's sampled token (the request's first output — emitted
+        by the caller AFTER prefix registration)."""
+        started = time.monotonic()
+        ids = request.prompt_ids
+        buckets = sorted(self.config.prefill_buckets)
+        start = request.hist
+        first = None
+        while start < len(ids):
+            remaining = len(ids) - start
+            bucket = next((b for b in buckets if remaining <= b), buckets[-1])
+            end = min(start + bucket, len(ids))
+            n = end - start
+            tokens = np.full((1, bucket), self.tokenizer.pad_id, dtype=np.int32)
+            positions = np.full((1, bucket), -1, dtype=np.int32)
+            tokens[0, :n] = ids[start:end]
+            positions[0, :n] = np.arange(start, end)
+            sampling = SamplingParams(
+                jnp.asarray([request.temperature], jnp.float32),
+                jnp.asarray([request.top_k], jnp.int32),
+                jnp.asarray([request.top_p], jnp.float32))
+            self._rng, key = jax.random.split(self._rng)
+            first, self.kv = self._prefill_hist(
+                self.params, self.kv, jnp.asarray(tokens),
+                jnp.asarray(positions),
+                jnp.asarray([request.slot], dtype=jnp.int32),
+                jnp.asarray([n - 1], dtype=jnp.int32), sampling, key)
+            self.stats.prefill_batches += 1
+            start = end
+        first_host = jax.device_get(first)
+        request.prefill_ms = (time.monotonic() - started) * 1000
+        return int(first_host[0])
 
     # ------------------------------------------------------- speculative step
 

@@ -204,14 +204,29 @@ def prefill_with_history(params: dict[str, Any], config: LlamaConfig,
     x = params["embed"][tokens]
     mask_valid = positions >= 0
     safe_positions = jnp.maximum(positions, 0)
+    G = config.n_heads // config.n_kv_heads
+    # the chunk kernel keeps (S*G, hd) f32 accumulators + an (S*G, page)
+    # score tile in VMEM with no tiling over S yet — gate to row counts
+    # that comfortably fit the ~16 MiB/core budget (large prefill buckets
+    # fall back to the gather path)
+    use_pallas = _use_pallas_paged(config, kv) and S * G <= 2048
     for idx, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], config.norm_eps)
         q, k, v = _attention_block(layer, config, h, safe_positions)
         kv = write_prefill_kv(kv, idx, k, v, slot_ids, safe_positions,
                               mask_valid)
-        keys, values = gather_kv(kv, idx, slot_ids)     # [B, C, KV, hd]
-        attn = _history_attention(q, keys, values, safe_positions,
-                                  mask_valid, config)
+        if use_pallas:
+            from ..ops.paged_attention import paged_chunk_attention_pallas
+            qg = q.reshape(B, S, config.n_kv_heads, G, config.head_dim)
+            attn = paged_chunk_attention_pallas(
+                qg, kv.k_pages[idx], kv.v_pages[idx],
+                kv.block_tables[slot_ids], positions,
+                page_size=kv.page_size)
+            attn = attn.reshape(B, S, config.n_heads, config.head_dim)
+        else:
+            keys, values = gather_kv(kv, idx, slot_ids)  # [B, C, KV, hd]
+            attn = _history_attention(q, keys, values, safe_positions,
+                                      mask_valid, config)
         x = x + attn.reshape(B, S, -1) @ layer["wo"]
         h = rms_norm(x, layer["ffn_norm"], config.norm_eps)
         x = x + _ffn(layer, h)

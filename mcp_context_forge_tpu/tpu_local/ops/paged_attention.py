@@ -67,6 +67,95 @@ def _kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
                        jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
 
 
+def _chunk_kernel(block_tables_ref, q_pos_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, page_size: int,
+                  num_pages_per_seq: int):
+    """Chunk (multi-query) variant of _kernel: S queries per sequence walk
+    the same page list with online softmax; causality rides the absolute
+    query positions (cache position c attends iff c <= q_pos). Serves the
+    prefix-cache suffix prefill and the spec-decode verify step."""
+    page_idx = pl.program_id(2)
+
+    @pl.when(page_idx == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    pos = q_pos_ref[0]                                # [S] (-1 = padding row)
+    page_start = page_idx * page_size
+    # the page holds live context iff any query position reaches it
+    @pl.when(jnp.max(pos) + 1 - page_start > 0)
+    def _process():
+        q = q_ref[0, :, 0].astype(jnp.float32)        # [S, G, hd]
+        S, G, hd = q.shape
+        q2 = q.reshape(S * G, hd)
+        k = k_ref[0, :, 0].astype(jnp.float32)        # [page, hd]
+        v = v_ref[0, :, 0].astype(jnp.float32)
+        scores = (q2 @ k.T) / math.sqrt(hd)           # [S*G, page]
+        col = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1) + page_start
+        row_pos = jnp.broadcast_to(pos[:, None], (S, G)).reshape(S * G, 1)
+        scores = jnp.where(col <= row_pos, scores, NEG_INF)
+        m_prev = m_ref[...]                           # [S*G, 1]
+        l_prev = l_ref[...]
+        m_tile = jnp.max(scores, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_tile)
+        correction = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(scores - m_new)
+        l_new = l_prev * correction + jnp.sum(probs, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * correction + probs @ v
+        m_ref[...] = m_new
+        l_ref[...] = l_new
+
+    @pl.when(page_idx == num_pages_per_seq - 1)
+    def _finish():
+        S = q_pos_ref.shape[1]
+        G, hd = o_ref.shape[3], o_ref.shape[4]
+        out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0, :, 0] = out.reshape(S, G, hd).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
+def paged_chunk_attention_pallas(q, k_pages, v_pages, block_tables,
+                                 q_positions, page_size: int,
+                                 interpret: bool = False):
+    """q: [B, S, KV, G, hd]; k_pages/v_pages: [num_pages, page, KV, hd];
+    block_tables: [B, P] int32; q_positions: [B, S] int32 absolute
+    positions (-1 = padding) -> [B, S, KV, G, hd]."""
+    B, S, KV, G, hd = q.shape
+    P = block_tables.shape[1]
+
+    grid = (B, KV, P)
+    kernel = functools.partial(_chunk_kernel, page_size=page_size,
+                               num_pages_per_seq=P)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, S), lambda b, k, j, bt: (b, 0)),
+                pl.BlockSpec((1, S, 1, G, hd),
+                             lambda b, k, j, bt: (b, 0, k, 0, 0)),
+                pl.BlockSpec((1, page_size, 1, hd),
+                             lambda b, k, j, bt: (bt[b, j], 0, k, 0)),
+                pl.BlockSpec((1, page_size, 1, hd),
+                             lambda b, k, j, bt: (bt[b, j], 0, k, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, S, 1, G, hd),
+                                   lambda b, k, j, bt: (b, 0, k, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((S * G, hd), jnp.float32),
+                pltpu.VMEM((S * G, 1), jnp.float32),
+                pltpu.VMEM((S * G, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, S, KV, G, hd), q.dtype),
+        interpret=interpret,
+    )(block_tables, q_positions, q, k_pages, v_pages)
+    return out
+
+
 @functools.partial(jax.jit,
                    static_argnames=("page_size", "interpret"))
 def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,

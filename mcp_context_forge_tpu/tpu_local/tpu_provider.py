@@ -126,8 +126,10 @@ class TPULocalProvider(LLMProvider):
         prompt = render_chat(request.get("messages", []))
         prompt_ids = self.engine.tokenizer.encode(prompt)
         max_ctx = self.engine.config.max_seq_len
-        max_prompt = max(self.engine.config.prefill_buckets)
-        prompt_ids = prompt_ids[-max_prompt:]
+        # prompts longer than every bucket prefill in chunks through the
+        # engine's history path; only the block-table bound truncates (the
+        # engine needs room for at least one generated token)
+        prompt_ids = prompt_ids[-(max_ctx - 1):]
         max_tokens = min(int(request.get("max_tokens") or 128),
                          max_ctx - len(prompt_ids))
         return GenRequest(

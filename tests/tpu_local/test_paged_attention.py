@@ -65,3 +65,63 @@ def test_paged_decode_llama1b_geometry():
         n_kv_heads, n_heads, head_dim, n_layers = 8, 32, 64, 1
     _check_against_gather(Geo, page_size=16, num_pages=24, slots=2, per_slot=8,
                           seq_lens=[19, 33])
+
+
+def test_paged_chunk_matches_history_reference():
+    """Chunk kernel (S queries over the page list) vs _history_attention:
+    per-row history offsets, padding rows, multi-page contexts."""
+    from mcp_context_forge_tpu.tpu_local.kv import write_decode_kv, gather_kv
+    from mcp_context_forge_tpu.tpu_local.models.llama import _history_attention
+    from mcp_context_forge_tpu.tpu_local.ops.paged_attention import (
+        paged_chunk_attention_pallas,
+    )
+
+    CFG = MODEL_CONFIGS["llama3-test"]  # KV=2, H=4, hd=16
+    page_size, num_pages, slots, per_slot = 8, 16, 3, 4
+    KV, hd = CFG.n_kv_heads, CFG.head_dim
+    G = CFG.n_heads // KV
+    S = 6
+    # per-slot (history, chunk) splits; slot 2's row is partly padding
+    hists = [8, 0, 13]
+    chunk_lens = [6, 6, 3]
+
+    kv = init_kv_state(CFG, num_pages, page_size, slots, per_slot,
+                       dtype=jnp.float32)
+    alloc = PageAllocator(num_pages, page_size, slots, per_slot)
+    for slot in range(slots):
+        assert alloc.allocate_slot(slot, hists[slot] + chunk_lens[slot])
+    kv = kv._replace(block_tables=alloc.tables())
+
+    key = jax.random.PRNGKey(1)
+    for slot in range(slots):
+        for pos in range(hists[slot] + chunk_lens[slot]):
+            key, k1, k2 = jax.random.split(key, 3)
+            kv = write_decode_kv(
+                kv, 0, jax.random.normal(k1, (1, KV, hd), dtype=jnp.float32),
+                jax.random.normal(k2, (1, KV, hd), dtype=jnp.float32),
+                jnp.array([slot]), jnp.array([pos]))
+
+    key, kq = jax.random.split(key)
+    q = jax.random.normal(kq, (slots, S, KV * G, hd), dtype=jnp.float32)
+    positions = np.full((slots, S), -1, dtype=np.int32)
+    for slot in range(slots):
+        positions[slot, :chunk_lens[slot]] = np.arange(
+            hists[slot], hists[slot] + chunk_lens[slot])
+    positions = jnp.asarray(positions)
+    valid = positions >= 0
+    safe = jnp.maximum(positions, 0)
+
+    keys_g, values_g = gather_kv(kv, 0, jnp.arange(slots))
+    ref = _history_attention(q, keys_g, values_g, safe, valid, CFG)
+
+    qg = q.reshape(slots, S, KV, G, hd)
+    out = paged_chunk_attention_pallas(
+        qg, kv.k_pages[0], kv.v_pages[0], kv.block_tables, positions,
+        page_size=page_size, interpret=True)
+    out = out.reshape(slots, S, KV * G, hd)
+    # compare only valid rows (padding rows are garbage in both paths)
+    for slot in range(slots):
+        n = chunk_lens[slot]
+        np.testing.assert_allclose(np.asarray(out[slot, :n]),
+                                   np.asarray(ref[slot, :n]),
+                                   rtol=2e-5, atol=2e-5)

@@ -154,7 +154,6 @@ def test_hit_uses_smaller_bucket():
 
 def test_prefix_cache_off_is_inert():
     alloc_probe = _engine(False)
-    assert alloc_probe._prefill_hist is None
 
     async def run():
         await alloc_probe.start()
@@ -243,6 +242,105 @@ def test_page_pressure_with_templates_makes_progress():
                 _gen(engine, tmplB + [42], n=8),
             ), timeout=300)
             assert all(len(o) >= 1 for o in outs)
+        finally:
+            await engine.stop()
+
+    asyncio.run(run())
+
+
+def test_chunked_prefill_matches_single_bucket_prefill():
+    """A prompt longer than every bucket prefills in chunks through the
+    history path — greedy output must equal a wide-bucket engine's (and
+    beforehand such prompts were wrongly terminal-rejected as 'length')."""
+    async def run():
+        kwargs = dict(model="llama3-test", max_batch=2, max_seq_len=128,
+                      page_size=16, num_pages=64, dtype="float32",
+                      attn_impl="reference")
+        chunked = TPUEngine(EngineConfig(**kwargs, prefill_buckets=(16,),
+                                         prefix_cache=False))
+        wide = TPUEngine(EngineConfig(**kwargs, prefill_buckets=(64,),
+                                      prefix_cache=False))
+        ids = list(range(3, 53))                   # 50 tokens > bucket 16
+        for engine in (chunked, wide):
+            await engine.start()
+        try:
+            out_c = await _gen(chunked, ids, n=8)
+            out_w = await _gen(wide, ids, n=8)
+            assert len(out_w) >= 1 and out_c == out_w
+            assert chunked.stats.prefill_batches >= 4   # 50/16 -> 4 chunks
+        finally:
+            for engine in (chunked, wide):
+                await engine.stop()
+
+    asyncio.run(run())
+
+
+def test_chunked_prefill_reuses_cached_prefix():
+    """Chunked + prefix cache compose: the cached template skips its
+    chunks entirely."""
+    async def run():
+        engine = TPUEngine(EngineConfig(
+            model="llama3-test", max_batch=2, max_seq_len=128, page_size=16,
+            num_pages=64, prefill_buckets=(16,), dtype="float32",
+            attn_impl="reference", prefix_cache=True))
+        tmpl = list(range(3, 45))                  # 42 tokens: 2 chunked passes
+        await engine.start()
+        try:
+            out1 = await _gen(engine, tmpl + [50], n=4)
+            batches_after_seed = engine.stats.prefill_batches
+            out2 = await _gen(engine, tmpl + [60], n=4)
+            assert len(out1) >= 1 and len(out2) >= 1
+            # hit: 32 cached tokens -> 11-token suffix = ONE bucket-16 call
+            assert engine.stats.prefill_batches == batches_after_seed + 1
+            assert engine.allocator.prefix_hit_tokens >= 32
+        finally:
+            await engine.stop()
+
+    asyncio.run(run())
+
+
+def test_chunked_suffix_still_uses_cached_prefix():
+    """When the suffix alone exceeds every bucket, chunking must start FROM
+    the cached prefix (regression: the fall-through reset hist to 0 and
+    re-prefilled the whole template), and outputs stay parity-exact."""
+    async def run():
+        kwargs = dict(model="llama3-test", max_batch=4, max_seq_len=256,
+                      page_size=16, num_pages=128, prefill_buckets=(32,),
+                      dtype="float32", attn_impl="reference")
+        warm = TPUEngine(EngineConfig(**kwargs, prefix_cache=True))
+        cold = TPUEngine(EngineConfig(**kwargs, prefix_cache=False))
+        tmpl = list(range(3, 123))                 # 120-token template
+        prompts = [tmpl + [200 + i] * 40 for i in range(3)]  # 40-tok suffixes
+        await warm.start(); await cold.start()
+        try:
+            outs_w = [await _gen(warm, p, n=4) for p in prompts]
+            outs_c = [await _gen(cold, p, n=4) for p in prompts]
+            assert outs_w == outs_c
+            assert warm.allocator.prefix_hit_tokens >= 2 * 112  # 7 pages x2
+            assert warm.stats.prefill_batches < cold.stats.prefill_batches
+        finally:
+            await warm.stop(); await cold.stop()
+
+    asyncio.run(run())
+
+
+def test_chunked_template_registers_even_when_first_token_finishes():
+    """max_tokens=1 classification over a chunked template: the prefix must
+    register before the finishing emit frees the slot (regression: post-emit
+    registration cached nothing)."""
+    async def run():
+        engine = TPUEngine(EngineConfig(
+            model="llama3-test", max_batch=2, max_seq_len=128, page_size=16,
+            num_pages=64, prefill_buckets=(16,), dtype="float32",
+            attn_impl="reference", prefix_cache=True))
+        tmpl = list(range(3, 45))                  # 42 tokens, chunked
+        await engine.start()
+        try:
+            out = await _gen(engine, tmpl + [50], n=1)
+            assert len(out) == 1
+            assert engine.allocator.cached_pages >= 2  # template registered
+            await _gen(engine, tmpl + [60], n=1)
+            assert engine.allocator.prefix_hit_tokens >= 32
         finally:
             await engine.stop()
 
