@@ -1,6 +1,7 @@
 # mcp-context-forge-tpu (reference: 8.7k-line Makefile; the targets that matter)
 
-.PHONY: serve hub test test-fast test-two-process bench bench-engine wrapper masking clean
+.PHONY: serve hub test test-fast test-two-process bench bench-engine wrapper masking clean \
+	sanitize sanitize-tsan sanitize-asan
 
 serve:
 	python -m mcp_context_forge_tpu.cli serve
@@ -39,6 +40,37 @@ edge:
 masking:
 	g++ -O2 -shared -fPIC -std=c++17 mcp_context_forge_tpu/native/masking.cpp \
 	  -o mcp_context_forge_tpu/native/libmasking.so
+
+# --- sanitizer tier for the C++ components (SURVEY.md §5.2: the reference's
+# Rust tier gets the borrow checker + deny.toml; the C++ tier gets TSAN +
+# ASAN/UBSAN builds run against the same tests) ---
+SAN_DIR := /tmp/mcpforge-san
+
+sanitize-tsan:
+	mkdir -p $(SAN_DIR)
+	g++ -std=c++17 -g -fsanitize=thread tests/native/masking_stress.cpp \
+	  -o $(SAN_DIR)/masking_stress_tsan -pthread
+	$(SAN_DIR)/masking_stress_tsan
+	g++ -std=c++17 -g -O1 -fsanitize=thread -pthread \
+	  mcp_context_forge_tpu/native/mcp_edge.cpp -o $(SAN_DIR)/edge_tsan
+	MCPFORGE_EDGE_BIN=$(SAN_DIR)/edge_tsan \
+	  python -m pytest tests/integration/test_mcp_edge.py -q
+
+sanitize-asan:
+	mkdir -p $(SAN_DIR)
+	g++ -std=c++17 -g -fsanitize=address,undefined \
+	  tests/native/masking_stress.cpp -o $(SAN_DIR)/masking_stress_asan -pthread
+	$(SAN_DIR)/masking_stress_asan
+	g++ -std=c++17 -g -O1 -fsanitize=address,undefined -pthread \
+	  mcp_context_forge_tpu/native/mcp_edge.cpp -o $(SAN_DIR)/edge_asan
+	g++ -std=c++17 -g -O1 -fsanitize=address,undefined \
+	  mcp_context_forge_tpu/native/stdio_wrapper.cpp -o $(SAN_DIR)/wrapper_asan
+	MCPFORGE_EDGE_BIN=$(SAN_DIR)/edge_asan \
+	  python -m pytest tests/integration/test_mcp_edge.py -q
+	MCPFORGE_WRAPPER_BIN=$(SAN_DIR)/wrapper_asan \
+	  python -m pytest tests/integration/test_translate_wrapper.py -q
+
+sanitize: sanitize-tsan sanitize-asan
 
 clean:
 	rm -rf .pytest_cache mcpforge-wrapper mcp_context_forge_tpu/native/libmasking.so

@@ -164,6 +164,18 @@ class Settings(BaseModel):
     tpu_local_spec_decode: bool = False
     tpu_local_spec_k: int = 4
     tpu_local_spec_ngram: int = 2
+    # weight-only quantization: "" (full precision) or "int8" — per-channel
+    # scales, dequant fused into the matmul; halves HBM footprint+traffic
+    # (how Llama-3-8B fits one 16 GB v5e chip)
+    tpu_local_quant: str = ""
+    # moderation classify granularity: texts longer than the window are
+    # scored over fixed windows (max-pooled) — 'full' strides the whole
+    # text (bounded by max_windows; the default covers 1024 tokens, a
+    # superset of the old single-row 512-token scan), 'sample' scores
+    # head+tail only (cheapest, weakest)
+    tpu_local_classify_window: int = 128
+    tpu_local_classify_coverage: str = "full"
+    tpu_local_classify_max_windows: int = 8
 
     # --- SSO (JSON list: [{name, issuer, client_id, client_secret}]) ---
     sso_providers: str = ""

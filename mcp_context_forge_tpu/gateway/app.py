@@ -167,6 +167,9 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         provider = TPULocalProvider("tpu_local", engine,
                                     embedding_model=settings.tpu_local_embedding_model,
                                     tracer=tracer, metrics=metrics)
+        provider.classify_window = settings.tpu_local_classify_window
+        provider.classify_coverage = settings.tpu_local_classify_coverage
+        provider.classify_max_windows = settings.tpu_local_classify_max_windows
         registry = LLMProviderRegistry()
         registry.register(provider, [settings.tpu_local_model, "tpu_local"],
                           default_chat=True, default_embed=True)
@@ -341,7 +344,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
                 authorization_endpoint=entry.get("authorization_endpoint", ""),
                 token_endpoint=entry.get("token_endpoint", ""),
                 dialect=entry.get("dialect", "oidc"),
-                userinfo_endpoint=entry.get("userinfo_endpoint", ""))
+                userinfo_endpoint=entry.get("userinfo_endpoint", ""),
+                metadata=entry.get("metadata"))
 
     async def sso_providers_route(request: web.Request) -> web.Response:
         return web.json_response({"providers": sso_service.list_providers()})

@@ -17,6 +17,8 @@ and the verdict wire contract). Usage:
 
 from __future__ import annotations
 
+import asyncio
+import inspect
 import json
 import sys
 from typing import Any, Callable
@@ -70,14 +72,38 @@ class PluginServer:
                  "inputSchema": {"type": "object"}}
                 for hook_name in self._hooks]}
         elif method == "tools/call":
+            # execution lives in _dispatch (async, overlapped); a
+            # tools/call only reaches here when the hook is unknown
             params = message.get("params", {})
-            fn = self._hooks.get(params.get("name", ""))
-            if fn is None:
-                return {"jsonrpc": "2.0", "id": message["id"],
-                        "error": {"code": -32602,
-                                  "message": f"Unknown hook {params.get('name')!r}"}}
+            return {"jsonrpc": "2.0", "id": message["id"],
+                    "error": {"code": -32602,
+                              "message": f"Unknown hook {params.get('name')!r}"}}
+        else:
+            return {"jsonrpc": "2.0", "id": message["id"],
+                    "error": {"code": -32601,
+                              "message": f"Unknown method {method!r}"}}
+        return {"jsonrpc": "2.0", "id": message["id"], "result": result}
+
+    # The host multiplexes hook calls by JSON-RPC id, so the server must
+    # actually OVERLAP them or concurrency dies here: every tools/call runs
+    # as its own task, sync hooks hop to a worker thread (a blocking scanner
+    # must not convoy the pipe), and responses stream back in completion
+    # order — ids, not ordering, correlate them.
+
+    async def _call_hook(self, fn: Callable[..., dict[str, Any]],
+                         arguments: dict[str, Any]) -> dict[str, Any]:
+        if inspect.iscoroutinefunction(fn):
+            return await fn(**arguments)
+        return await asyncio.to_thread(fn, **arguments)
+
+    async def _dispatch(self, message: dict[str, Any]) -> None:
+        method = message.get("method", "")
+        params = message.get("params", {})
+        fn = self._hooks.get(params.get("name", "")) \
+            if method == "tools/call" else None
+        if fn is not None:
             try:
-                verdict = fn(**(params.get("arguments") or {}))
+                verdict = await self._call_hook(fn, params.get("arguments") or {})
                 result = {"content": [{"type": "text",
                                        "text": json.dumps(verdict)}],
                           "isError": False}
@@ -85,14 +111,29 @@ class PluginServer:
                 result = {"content": [{"type": "text",
                                        "text": f"{type(exc).__name__}: {exc}"}],
                           "isError": True}
+            response: dict[str, Any] | None = {
+                "jsonrpc": "2.0", "id": message["id"], "result": result}
         else:
-            return {"jsonrpc": "2.0", "id": message["id"],
-                    "error": {"code": -32601,
-                              "message": f"Unknown method {method!r}"}}
-        return {"jsonrpc": "2.0", "id": message["id"], "result": result}
+            response = self._handle(message)
+        if response is not None:
+            # single-threaded loop + no await between write and flush:
+            # whole lines only, tasks can't interleave bytes
+            sys.stdout.write(json.dumps(response) + "\n")
+            sys.stdout.flush()
 
-    def run(self) -> None:  # pragma: no cover - subprocess entry
-        for line in sys.stdin:
+    async def _run_async(self) -> None:  # pragma: no cover - subprocess entry
+        loop = asyncio.get_running_loop()
+        # a tool_post_invoke frame carries the full tool result on one
+        # line — the default 64 KiB StreamReader limit would kill the
+        # server on big payloads (the old sync loop was unlimited)
+        reader = asyncio.StreamReader(limit=64 * 1024 * 1024)
+        await loop.connect_read_pipe(
+            lambda: asyncio.StreamReaderProtocol(reader), sys.stdin)
+        tasks: set[asyncio.Task] = set()
+        while True:
+            line = await reader.readline()
+            if not line:
+                break
             line = line.strip()
             if not line:
                 continue
@@ -100,7 +141,11 @@ class PluginServer:
                 message = json.loads(line)
             except json.JSONDecodeError:
                 continue
-            response = self._handle(message)
-            if response is not None:
-                sys.stdout.write(json.dumps(response) + "\n")
-                sys.stdout.flush()
+            if "id" not in message:
+                continue
+            task = asyncio.ensure_future(self._dispatch(message))
+            tasks.add(task)
+            task.add_done_callback(tasks.discard)
+
+    def run(self) -> None:  # pragma: no cover - subprocess entry
+        asyncio.run(self._run_async())

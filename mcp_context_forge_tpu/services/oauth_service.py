@@ -79,10 +79,22 @@ class SSOService:
                           authorization_endpoint: str = "",
                           token_endpoint: str = "",
                           dialect: str = "oidc",
-                          userinfo_endpoint: str = "") -> None:
-        """dialect: "oidc" (id_token carries claims) or "github" (no OIDC —
-        claims come from the user API; reference sso_service provider
-        quirks for GitHub)."""
+                          userinfo_endpoint: str = "",
+                          metadata: dict[str, Any] | None = None) -> None:
+        """dialect selects the IdP's claim quirks (reference sso_service
+        normalizes the same five families, `sso_service.py:1788-1900`):
+
+        - "oidc" / "google": id_token carries standard claims
+        - "github": no OIDC — claims come from the user API
+        - "okta": groups ride a configurable claim (default "groups")
+        - "keycloak": email/username claims configurable; groups assembled
+          from realm_access.roles / resource_access client roles / custom
+          groups claim per metadata flags map_realm_roles/map_client_roles
+        - "entra": email falls back preferred_username -> upn
+
+        ``metadata`` may also carry ``admin_groups`` (IdP group names that
+        grant is_admin) and ``team_mapping`` ({group: team_id} auto-joined
+        at login — the reference's SSO team mapping)."""
         self._providers[name] = {
             "issuer": issuer.rstrip("/"), "client_id": client_id,
             "client_secret": client_secret,
@@ -90,6 +102,7 @@ class SSOService:
             "token_endpoint": token_endpoint,
             "dialect": dialect,
             "userinfo_endpoint": userinfo_endpoint,
+            "metadata": metadata or {},
         }
 
     def list_providers(self) -> list[str]:
@@ -124,8 +137,14 @@ class SSOService:
             "DELETE FROM global_config WHERE key LIKE 'sso_state:%'"
             " AND updated_at < ?", (now() - self.STATE_TTL,))
         from urllib.parse import urlencode
-        scope = ("read:user user:email" if provider.get("dialect") == "github"
-                 else "openid email profile")
+        dialect = provider.get("dialect", "oidc")
+        if dialect == "github":
+            scope = "read:user user:email"
+        elif dialect == "okta":
+            scope = "openid email profile groups"
+        else:
+            scope = "openid email profile"
+        scope = provider["metadata"].get("scope", scope)
         query = urlencode({
             "response_type": "code", "client_id": provider["client_id"],
             "redirect_uri": redirect_uri, "scope": scope, "state": state})
@@ -159,20 +178,91 @@ class SSOService:
             claims = await self._github_claims(provider, tokens)
         else:
             claims = _unverified_id_token_claims(tokens.get("id_token", ""))
-        email = claims.get("email")
+        info = self._normalize_claims(provider, claims)
+        email = info.get("email")
         if not email:
             raise ValidationFailure("IdP id_token is missing an email claim")
+        metadata = provider.get("metadata", {})
+        admin_groups = set(metadata.get("admin_groups") or [])
+        is_admin = 1 if admin_groups & set(info["groups"]) else 0
         # provision on first login (reference sso_service auto-provisioning)
         row = await self.ctx.db.fetchone("SELECT email FROM users WHERE email=?",
                                          (email,))
+        ts = now()
         if not row:
-            ts = now()
             await self.ctx.db.execute(
                 "INSERT INTO users (email, password_hash, full_name, is_admin,"
                 " auth_provider, created_at, updated_at) VALUES (?,?,?,?,?,?,?)",
-                (email, "!sso!", claims.get("name", ""), 0, provider_name, ts, ts))
+                (email, "!sso!", info.get("name", ""), is_admin,
+                 provider_name, ts, ts))
+        elif is_admin:
+            # group-derived privilege refreshes on every login (groups may
+            # have been granted since provisioning); it is never revoked
+            # here — local admin grants stay authoritative
+            await self.ctx.db.execute(
+                "UPDATE users SET is_admin=1, updated_at=? WHERE email=?",
+                (ts, email))
+        await self._apply_team_mapping(email, info["groups"], metadata)
         token = self.auth.issue_jwt(email)
         return {"access_token": token, "token_type": "bearer", "email": email}
+
+    def _normalize_claims(self, provider: dict[str, Any],
+                          claims: dict[str, Any]) -> dict[str, Any]:
+        """Flatten IdP-dialect claim quirks into {email, name, groups}
+        (reference `sso_service.py:1788-1900` normalizes the same way)."""
+        metadata = provider.get("metadata", {})
+        dialect = provider.get("dialect", "oidc")
+        groups_claim = metadata.get("groups_claim", "groups")
+        email = claims.get("email")
+        name = claims.get("name", "")
+        groups: list[str] = []
+        raw = claims.get(groups_claim)
+        if isinstance(raw, str):
+            groups = [raw]
+        elif isinstance(raw, list):
+            groups = [str(g) for g in raw if str(g).strip()]
+        if dialect == "keycloak":
+            email = claims.get(metadata.get("email_claim", "email"))
+            if metadata.get("map_realm_roles"):
+                groups.extend((claims.get("realm_access") or {}).get("roles", []))
+            if metadata.get("map_client_roles"):
+                for client, access in (claims.get("resource_access") or {}).items():
+                    groups.extend(f"{client}:{role}"
+                                  for role in access.get("roles", []))
+            name = name or claims.get("preferred_username", "")
+        elif dialect == "entra":
+            # Entra often omits email: preferred_username (the UPN) or upn
+            email = (claims.get("email") or claims.get("preferred_username")
+                     or claims.get("upn"))
+            name = claims.get("name") or (email or "")
+            # roles claim carries app-role assignments alongside groups
+            roles = claims.get("roles")
+            if isinstance(roles, list):
+                groups.extend(str(r) for r in roles)
+        return {"email": email, "name": name, "groups": groups}
+
+    async def _apply_team_mapping(self, email: str, groups: list[str],
+                                  metadata: dict[str, Any]) -> None:
+        """IdP groups -> team memberships ({group: team_id}); memberships
+        created here are tagged via role 'member' and re-asserted each
+        login (reference sso_service._apply_team_mapping)."""
+        mapping = metadata.get("team_mapping") or {}
+        for group in groups:
+            team_id = mapping.get(group)
+            if not team_id:
+                continue
+            team = await self.ctx.db.fetchone(
+                "SELECT id FROM teams WHERE id=?", (team_id,))
+            if team is None:
+                continue
+            existing = await self.ctx.db.fetchone(
+                "SELECT team_id FROM team_members WHERE team_id=? AND"
+                " user_email=?", (team_id, email))
+            if existing is None:
+                await self.ctx.db.execute(
+                    "INSERT INTO team_members (team_id, user_email, role,"
+                    " joined_at) VALUES (?,?,?,?)",
+                    (team_id, email, "member", now()))
 
 
     async def _github_claims(self, provider: dict[str, Any],

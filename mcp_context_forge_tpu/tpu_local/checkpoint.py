@@ -31,22 +31,48 @@ def save_params(path: str, params: dict[str, Any]) -> None:
     checkpointer.wait_until_finished()
 
 
-def load_params(path: str, config: LlamaConfig, shardings, dtype) -> dict[str, Any]:
-    """Restore from an Orbax dir or HF safetensors dir, sharded."""
+def load_params(path: str, config: LlamaConfig, shardings, dtype,
+                quant: str = "") -> dict[str, Any]:
+    """Restore from an Orbax dir or HF safetensors dir, sharded.
+
+    ``quant="int8"``: safetensors tensors are quantized per-channel on the
+    way in (quantize.py), one tensor at a time, so the full bf16 model
+    never resides on the device. Orbax dirs must already BE quantized
+    (saved from a quantized tree) — a full-precision Orbax dir under
+    quant="int8" raises a clear error instead of an opaque tree
+    mismatch."""
     if os.path.isdir(path) and any(f.endswith(".safetensors")
                                    for f in os.listdir(path)):
-        return load_hf_llama(path, config, shardings, dtype)
+        return load_hf_llama(path, config, shardings, dtype, quant=quant)
     import orbax.checkpoint as ocp
-    from .models.llama import init_params
+    from .models.llama import init_params, params_logical
 
-    abstract = jax.eval_shape(lambda: init_params(config, jax.random.PRNGKey(0),
-                                                  dtype=dtype))
+    def skeleton():
+        full = init_params(config, jax.random.PRNGKey(0), dtype=dtype)
+        if quant == "int8":
+            from .quantize import quantize_tree
+            return quantize_tree(full, params_logical(config),
+                                 scale_dtype=dtype)
+        return full
+
+    abstract = jax.eval_shape(skeleton)
     abstract = jax.tree.map(
         lambda leaf, sharding: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
                                                     sharding=sharding),
         abstract, shardings)
     checkpointer = ocp.StandardCheckpointer()
-    return checkpointer.restore(os.path.abspath(path), abstract)
+    try:
+        return checkpointer.restore(os.path.abspath(path), abstract)
+    except Exception as exc:
+        if quant:
+            raise ValueError(
+                f"Orbax checkpoint at {path} does not match the quantized "
+                f"({quant}) tree — re-save it from a quantized engine "
+                "(save_params on a quant engine's params) or load the "
+                "original HF safetensors dir, which quantizes on the way "
+                f"in. Underlying error: {type(exc).__name__}: {exc}"
+            ) from exc
+        raise
 
 
 def _hf_key_map(config: LlamaConfig) -> dict[str, tuple]:
@@ -90,16 +116,24 @@ def _set_path(tree: dict, path: tuple, value) -> None:
     node[path[-1]] = value
 
 
-def load_hf_llama(path: str, config: LlamaConfig, shardings, dtype) -> dict[str, Any]:
+def load_hf_llama(path: str, config: LlamaConfig, shardings, dtype,
+                  quant: str = "") -> dict[str, Any]:
     """Load HF Llama-3 *.safetensors into the sharded param tree."""
     try:
         from safetensors import safe_open
     except ImportError:  # fall back to a minimal in-tree reader
         safe_open = None
-    from .models.llama import init_params
+    from .models.llama import init_params, params_logical
 
-    skeleton = jax.eval_shape(lambda: init_params(config, jax.random.PRNGKey(0),
-                                                  dtype=dtype))
+    def skeleton_fn():
+        full = init_params(config, jax.random.PRNGKey(0), dtype=dtype)
+        if quant == "int8":
+            from .quantize import quantize_tree
+            return quantize_tree(full, params_logical(config),
+                                 scale_dtype=dtype)
+        return full
+
+    skeleton = jax.eval_shape(skeleton_fn)
     params = jax.tree.map(lambda leaf: None, skeleton,
                           is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
     mapping = _hf_key_map(config)
@@ -131,7 +165,18 @@ def _place(params, tree_path, tensor, transpose, shardings, dtype) -> None:
     if transpose:
         array = array.T
     sharding = _get_path(shardings, tree_path)
-    value = jax.device_put(jnp.asarray(array, dtype=dtype), sharding)
+    if isinstance(sharding, dict):  # int8 target: quantize on the way in
+        from .quantize import quantize_leaf
+        # per-ROW scales for the (gathered) embedding, per-out-channel
+        # for matmul weights (quantize._QUANT_RULES)
+        axis = 1 if tree_path[-1] == "embed" else 0
+        leaf = quantize_leaf(array, axis, scale_dtype=dtype)
+        value = {
+            "q": jax.device_put(leaf["q"], sharding["q"]),
+            "s": jax.device_put(leaf["s"], sharding["s"]),
+        }
+    else:
+        value = jax.device_put(jnp.asarray(array, dtype=dtype), sharding)
     _set_path(params, tree_path, value)
 
 

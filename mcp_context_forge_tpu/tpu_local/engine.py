@@ -93,6 +93,10 @@ class EngineConfig:
     spec_decode: bool = False
     spec_k: int = 4          # chunk width: 1 input token + spec_k-1 drafts
     spec_ngram: int = 2      # context n-gram length used for lookup
+    # weight-only quantization: "" (full precision) or "int8" — halves the
+    # resident param footprint AND the per-step HBM traffic (quantize.py;
+    # how Llama-3-8B fits a single 16 GB v5e chip)
+    quant: str = ""
 
     @classmethod
     def from_settings(cls, settings) -> "EngineConfig":
@@ -117,6 +121,7 @@ class EngineConfig:
             spec_decode=getattr(settings, "tpu_local_spec_decode", False),
             spec_k=getattr(settings, "tpu_local_spec_k", 4),
             spec_ngram=getattr(settings, "tpu_local_spec_ngram", 2),
+            quant=getattr(settings, "tpu_local_quant", ""),
         )
 
 
@@ -166,6 +171,28 @@ class EngineInitTimeout(RuntimeError):
     """jax backend init exceeded the watchdog budget (dead TPU runtime)."""
 
 
+_compile_cache_dir: str | None = None
+
+
+def _apply_compile_cache(path: str) -> None:
+    """Set the process-global persistent XLA cache exactly once.
+
+    ``jax_compilation_cache_dir`` is process state, not engine state: a
+    second engine (or a test constructing engines back to back) must not
+    silently flip the cache out from under compiled-but-unwritten entries
+    (round-2 ADVICE low). First caller wins; a conflicting later value is
+    logged and ignored."""
+    global _compile_cache_dir
+    if _compile_cache_dir is None:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        _compile_cache_dir = path
+    elif _compile_cache_dir != path:
+        logger.warning(
+            "compile cache already pinned to %s; ignoring %s "
+            "(process-global setting)", _compile_cache_dir, path)
+
+
 def probe_devices(timeout_s: float) -> list:
     """``jax.devices()`` under a watchdog.
 
@@ -212,13 +239,11 @@ class TPUEngine:
                              "exclusive (both widen the per-dispatch step)")
         if config.spec_decode and config.spec_k < 2:
             raise ValueError(f"spec_k must be >= 2, got {config.spec_k}")
+        if config.spec_decode and config.spec_ngram < 1:
+            raise ValueError(f"spec_ngram must be >= 1, got {config.spec_ngram}")
         self.config = config
         if config.compile_cache_dir:
-            # persistent executable cache: reruns (gateway restarts, bench
-            # repeats) skip XLA recompilation of every step shape
-            jax.config.update("jax_compilation_cache_dir",
-                              config.compile_cache_dir)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+            _apply_compile_cache(config.compile_cache_dir)
         self.model_config: LlamaConfig = MODEL_CONFIGS[config.model]
         self.tokenizer = load_tokenizer(config.checkpoint,
                                         vocab_size=self.model_config.vocab_size)
@@ -247,16 +272,31 @@ class TPUEngine:
                     f"sp_impl={config.sp_impl!r}: prefill buckets {bad} not"
                     f" divisible by mesh model axis {axis}")
 
-        # params: load checkpoint or random-init, placed with TP shardings
+        if config.quant not in ("", "int8"):
+            raise ValueError(f"unsupported quant mode {config.quant!r}")
+        # params: load checkpoint or random-init, placed with TP shardings;
+        # quant="int8" swaps in the {"q","s"} tree (quantize.py)
         with self.mesh:
-            shardings = param_specs(params_logical(self.model_config), self.mesh)
+            logical = params_logical(self.model_config)
+            if config.quant == "int8":
+                from .quantize import quantize_logical, quantize_tree
+                shardings = param_specs(quantize_logical(logical), self.mesh)
+            else:
+                shardings = param_specs(logical, self.mesh)
             if config.checkpoint:
                 from .checkpoint import load_params
                 self.params = load_params(config.checkpoint, self.model_config,
-                                          shardings, dtype)
+                                          shardings, dtype, quant=config.quant)
             else:
-                init = jax.jit(partial(init_params, self.model_config, dtype=dtype),
-                               out_shardings=shardings)
+                if config.quant == "int8":
+                    def init_fn(key):
+                        full = init_params(self.model_config, key, dtype=dtype)
+                        return quantize_tree(full, logical, scale_dtype=dtype)
+                    init = jax.jit(init_fn, out_shardings=shardings)
+                else:
+                    init = jax.jit(partial(init_params, self.model_config,
+                                           dtype=dtype),
+                                   out_shardings=shardings)
                 self.params = init(jax.random.PRNGKey(0))
 
             max_pages_per_slot = config.max_seq_len // config.page_size
@@ -353,14 +393,16 @@ class TPUEngine:
                     jax.random.PRNGKey(0))
                 block.block_until_ready()
                 shapes += 1
-            else:
-                # seq_lens=0: every slot is "inactive", writes masked to trash
-                block, self.kv = self._decode(
-                    self.params, self.kv, jnp.zeros((B,), jnp.int32),
-                    jnp.zeros((B,), jnp.int32), jnp.arange(B, dtype=jnp.int32),
-                    jnp.zeros((B,), jnp.int32), samp, jax.random.PRNGKey(0))
-                block.block_until_ready()
-                shapes += 1
+            # plain decode is always live: spec engines fall back to it on
+            # steps where no greedy row would draft (width-K verify would be
+            # pure compute waste — round-2 ADVICE low)
+            # seq_lens=0: every slot is "inactive", writes masked to trash
+            block, self.kv = self._decode(
+                self.params, self.kv, jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), jnp.int32), jnp.arange(B, dtype=jnp.int32),
+                jnp.zeros((B,), jnp.int32), samp, jax.random.PRNGKey(0))
+            block.block_until_ready()
+            shapes += 1
         logger.info("tpu_local warmup: %d shapes compiled in %.1fs",
                     shapes, time.monotonic() - started)
 
@@ -505,7 +547,7 @@ class TPUEngine:
             while not self._stop_event.is_set():
                 did_work = self._admit_batch()
                 if self._running:
-                    if self._verify is not None:
+                    if self._verify is not None and self._any_would_draft():
                         self._spec_step_all()
                     else:
                         self._decode_step_all()
@@ -799,6 +841,18 @@ class TPUEngine:
             if ctx[start:start + n] == tail:
                 return ctx[start + n:start + n + k]
         return []
+
+    def _any_would_draft(self) -> bool:
+        """True iff some active row can take speculative drafts this step.
+        Purely-sampled (or one-token-remaining) traffic pays ~spec_k x the
+        attention/MLP compute through the [B,K] verify for zero extra
+        emitted tokens — those steps run the plain width-1 decode instead
+        (round-2 ADVICE low)."""
+        for request in self._running.values():
+            if (request.temperature == 0.0
+                    and request.max_tokens - len(request.generated) > 1):
+                return True
+        return False
 
     def _spec_step_all(self) -> None:
         """One [B, K] verify step over every active slot: row = last token

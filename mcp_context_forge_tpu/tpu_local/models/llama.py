@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from .configs import LlamaConfig
 from ..ops.attention import causal_attention
 from ..kv.paged_cache import PagedKVState, write_prefill_kv, write_decode_kv, gather_kv
+from ..quantize import embed_rows, qmm, qmm_t
 
 
 # ------------------------------------------------------------------ building blocks
@@ -129,11 +130,13 @@ def param_count(config: LlamaConfig) -> int:
 def lm_logits(params: dict[str, Any], x: jax.Array) -> jax.Array:
     """Project hidden states to vocab logits; tied models reuse embed.T
     (sharded vocab-out either way — embed is vocab-in, so the transpose
-    keeps the vocab dim on the ``model`` axis)."""
+    keeps the vocab dim on the ``model`` axis). Quantized heads apply
+    their per-vocab-channel scales to the OUTPUT, never materializing a
+    dequantized table (quantize.py)."""
     head = params.get("lm_head")
     if head is None:
-        head = params["embed"].T
-    return (x @ head).astype(jnp.float32)
+        return qmm_t(x, params["embed"]).astype(jnp.float32)
+    return qmm(x, head).astype(jnp.float32)
 
 
 # ----------------------------------------------------------------------- forward
@@ -143,7 +146,9 @@ def _attention_block(layer: dict[str, Any], config: LlamaConfig, x: jax.Array,
     """Project to q,k,v with RoPE. x: [B,S,D] -> q [B,S,H,hd], k/v [B,S,KV,hd]."""
     B, S, _ = x.shape
     hd = config.head_dim
-    q, k, v = x @ layer["wq"], x @ layer["wk"], x @ layer["wv"]
+    q = qmm(x, layer["wq"])
+    k = qmm(x, layer["wk"])
+    v = qmm(x, layer["wv"])
     if "bq" in layer:  # static at trace time (pytree structure)
         q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
     q = q.reshape(B, S, config.n_heads, hd)
@@ -155,7 +160,8 @@ def _attention_block(layer: dict[str, Any], config: LlamaConfig, x: jax.Array,
 
 
 def _ffn(layer: dict[str, Any], x: jax.Array) -> jax.Array:
-    return (jax.nn.silu(x @ layer["w1"]) * (x @ layer["w3"])) @ layer["w2"]
+    return qmm(jax.nn.silu(qmm(x, layer["w1"])) * qmm(x, layer["w3"]),
+               layer["w2"])
 
 
 def prefill(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
@@ -168,7 +174,7 @@ def prefill(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
     for long-context prefill — requires ``mesh`` (SURVEY.md §5.7).
     Returns (logits [B, S, vocab] fp32, updated kv state).
     """
-    x = params["embed"][tokens]  # [B,S,D]
+    x = embed_rows(params["embed"], tokens)  # [B,S,D]
     mask_valid = positions >= 0  # padding has position -1
     safe_positions = jnp.maximum(positions, 0)
     for idx, layer in enumerate(params["layers"]):
@@ -177,7 +183,7 @@ def prefill(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
         kv = write_prefill_kv(kv, idx, k, v, slot_ids, safe_positions, mask_valid)
         attn = causal_attention(q, k, v, mask_valid, impl=attn_impl,
                                 mesh=mesh)  # [B,S,H,hd]
-        x = x + attn.reshape(*attn.shape[:2], -1) @ layer["wo"]
+        x = x + qmm(attn.reshape(*attn.shape[:2], -1), layer["wo"])
         h = rms_norm(x, layer["ffn_norm"], config.norm_eps)
         x = x + _ffn(layer, h)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
@@ -201,38 +207,63 @@ def prefill_with_history(params: dict[str, Any], config: LlamaConfig,
     attention masks on absolute position (cache_pos <= q_pos), so one
     compiled shape serves any mix. Returns (logits [B,S,V] fp32, kv)."""
     B, S = tokens.shape
-    x = params["embed"][tokens]
+    x = embed_rows(params["embed"], tokens)
     mask_valid = positions >= 0
     safe_positions = jnp.maximum(positions, 0)
     G = config.n_heads // config.n_kv_heads
-    # the chunk kernel keeps (S*G, hd) f32 accumulators + an (S*G, page)
-    # score tile in VMEM with no tiling over S yet — gate to row counts
-    # that comfortably fit the ~16 MiB/core budget (large prefill buckets
-    # fall back to the gather path)
-    use_pallas = _use_pallas_paged(config, kv) and S * G <= 2048
+    # Attention is tiled over S (queries only — the chunk's KV is written
+    # first, causality rides absolute positions): the Pallas chunk kernel
+    # keeps (T*G, hd) f32 accumulators + a (T*G, page) score tile in VMEM,
+    # and the gather fallback materializes a [B,KV,G,T,C] f32 score tensor;
+    # untiled, a 2048-token chunk against a long resident context is
+    # multi-GB per layer (round-2 ADVICE medium). T divides S because both
+    # are powers of two.
+    tile = _history_tile(S, G)
+    use_pallas = _use_pallas_paged(config, kv) and tile * G <= 2048
     for idx, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], config.norm_eps)
         q, k, v = _attention_block(layer, config, h, safe_positions)
         kv = write_prefill_kv(kv, idx, k, v, slot_ids, safe_positions,
                               mask_valid)
-        if use_pallas:
-            from ..ops.paged_attention import paged_chunk_attention_pallas
-            qg = q.reshape(B, S, config.n_kv_heads, G, config.head_dim)
-            attn = paged_chunk_attention_pallas(
-                qg, kv.k_pages[idx], kv.v_pages[idx],
-                kv.block_tables[slot_ids], positions,
-                page_size=kv.page_size)
-            attn = attn.reshape(B, S, config.n_heads, config.head_dim)
-        else:
+        if not use_pallas:
             keys, values = gather_kv(kv, idx, slot_ids)  # [B, C, KV, hd]
-            attn = _history_attention(q, keys, values, safe_positions,
-                                      mask_valid, config)
-        x = x + attn.reshape(B, S, -1) @ layer["wo"]
+        tiles = []
+        for t0 in range(0, S, tile):
+            qs = q[:, t0:t0 + tile]
+            ps = positions[:, t0:t0 + tile]
+            if use_pallas:
+                from ..ops.paged_attention import paged_chunk_attention_pallas
+                qg = qs.reshape(B, -1, config.n_kv_heads, G, config.head_dim)
+                at = paged_chunk_attention_pallas(
+                    qg, kv.k_pages[idx], kv.v_pages[idx],
+                    kv.block_tables[slot_ids], ps,
+                    page_size=kv.page_size)
+                at = at.reshape(B, -1, config.n_heads, config.head_dim)
+            else:
+                at = _history_attention(
+                    qs, keys, values, safe_positions[:, t0:t0 + tile],
+                    mask_valid[:, t0:t0 + tile], config)
+            tiles.append(at)
+        attn = tiles[0] if len(tiles) == 1 else jnp.concatenate(tiles, axis=1)
+        x = x + qmm(attn.reshape(B, S, -1), layer["wo"])
         h = rms_norm(x, layer["ffn_norm"], config.norm_eps)
         x = x + _ffn(layer, h)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
     logits = lm_logits(params, x)
     return logits, kv
+
+
+def _history_tile(S: int, G: int) -> int:
+    """Query-tile width for chunk/history attention: large enough to keep
+    the MXU busy, small enough that T*G fits the Pallas kernel's VMEM
+    budget (and the gather fallback's [B,KV,G,T,C] f32 scores stay
+    bounded). S and the returned tile are powers of two, so the tile
+    always divides S."""
+    tile = max(128, 2048 // max(1, G))
+    t = 128
+    while t * 2 <= min(tile, S):
+        t *= 2
+    return min(t, S)
 
 
 def _history_attention(q: jax.Array, keys: jax.Array, values: jax.Array,
@@ -267,7 +298,7 @@ def decode_step(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
     (including this one after write). Returns (logits [B, vocab], kv).
     """
     B = tokens.shape[0]
-    x = params["embed"][tokens][:, None, :]  # [B,1,D]
+    x = embed_rows(params["embed"], tokens)[:, None, :]  # [B,1,D]
     pos = positions[:, None]                 # [B,1]
     use_pallas = _use_pallas_paged(config, kv)
     for idx, layer in enumerate(params["layers"]):
@@ -286,7 +317,7 @@ def decode_step(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
         else:
             keys, values = gather_kv(kv, idx, slot_ids)  # [B, C, KV, hd]
             attn = _paged_decode_attention(q[:, 0], keys, values, seq_lens, config)
-        x = x + (attn.reshape(B, 1, -1) @ layer["wo"])
+        x = x + qmm(attn.reshape(B, 1, -1), layer["wo"])
         h = rms_norm(x, layer["ffn_norm"], config.norm_eps)
         x = x + _ffn(layer, h)
     x = rms_norm(x, params["final_norm"], config.norm_eps)

@@ -23,6 +23,9 @@ LOGICAL_RULES: dict[str, P] = {
     "attn_out": P("model", None),         # (heads*hd, dim) row-parallel
     "ffn_up": P(None, "model"),           # (dim, hidden) column-parallel
     "ffn_down": P("model", None),         # (hidden, dim) row-parallel
+    # int8 per-channel scale vectors indexed by a model-sharded axis
+    # (quantize.py): shard with the channels they scale
+    "scale_model": P("model"),
     "kv_pages": P(None, None, None, "model", None),  # (L, pages, page, kv_heads, hd)
     "activations": P("data", None, None),  # (batch, seq, dim)
     "decode_heads": P("data", None, "model", None),  # (batch, seq, heads, hd)

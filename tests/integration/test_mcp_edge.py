@@ -16,7 +16,9 @@ from test_gateway_app import BASIC, make_client
 
 AUTH = aiohttp.BasicAuth(*BASIC)
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-EDGE_BIN = os.path.join(REPO, "mcpforge-edge")
+# MCPFORGE_EDGE_BIN points the suite at an alternate (e.g. TSAN/ASAN) build
+EDGE_BIN = os.environ.get("MCPFORGE_EDGE_BIN",
+                          os.path.join(REPO, "mcpforge-edge"))
 
 
 def _free_port() -> int:
@@ -27,12 +29,17 @@ def _free_port() -> int:
 
 async def _edge_for(gateway, *extra_args):
     src = os.path.join(REPO, "mcp_context_forge_tpu", "native", "mcp_edge.cpp")
-    stale = (not os.path.exists(EDGE_BIN)
-             or os.path.getmtime(EDGE_BIN) < os.path.getmtime(src))
-    if stale:
-        build = subprocess.run(["make", "edge"], cwd=REPO, capture_output=True)
-        if build.returncode != 0:
-            pytest.skip("edge binary build failed (no g++?)")
+    if "MCPFORGE_EDGE_BIN" in os.environ:
+        if not os.path.exists(EDGE_BIN):
+            pytest.skip(f"MCPFORGE_EDGE_BIN {EDGE_BIN} missing")
+    else:
+        stale = (not os.path.exists(EDGE_BIN)
+                 or os.path.getmtime(EDGE_BIN) < os.path.getmtime(src))
+        if stale:
+            build = subprocess.run(["make", "edge"], cwd=REPO,
+                                   capture_output=True)
+            if build.returncode != 0:
+                pytest.skip("edge binary build failed (no g++?)")
     port = _free_port()
     proc = subprocess.Popen(
         [EDGE_BIN, str(port), str(gateway.server.host),

@@ -203,3 +203,147 @@ async def test_sso_github_dialect():
     finally:
         await github.close()
         await gateway.close()
+
+
+def _claims_id_token(claims: dict) -> str:
+    header = base64.urlsafe_b64encode(b'{"alg":"RS256"}').rstrip(b"=")
+    payload = base64.urlsafe_b64encode(json.dumps(claims).encode()).rstrip(b"=")
+    return (header + b"." + payload + b".sig").decode()
+
+
+async def make_idp_with_claims(claims: dict) -> TestClient:
+    """OIDC IdP whose token endpoint mints an id_token with fixed claims —
+    lets each dialect test shape keycloak/entra/okta-style tokens."""
+    app = web.Application()
+
+    async def discovery(request):
+        base = f"http://{request.host}"
+        return web.json_response({
+            "authorization_endpoint": f"{base}/authorize",
+            "token_endpoint": f"{base}/token"})
+
+    async def token(request):
+        form = await request.post()
+        if form.get("code") != "good-code":
+            return web.json_response({"error": "invalid_grant"}, status=400)
+        return web.json_response({
+            "access_token": "at", "id_token": _claims_id_token(claims)})
+
+    app.router.add_get("/.well-known/openid-configuration", discovery)
+    app.router.add_post("/token", token)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def _sso_roundtrip(gateway, provider: str):
+    resp = await gateway.get(f"/auth/sso/{provider}/login",
+                             allow_redirects=False)
+    assert resp.status == 302
+    state = resp.headers["location"].split("state=")[1].split("&")[0]
+    resp = await gateway.get(
+        f"/auth/sso/{provider}/callback?state={state}&code=good-code")
+    assert resp.status == 200, await resp.text()
+    return await resp.json()
+
+
+async def test_sso_keycloak_dialect_roles_and_team_mapping():
+    """Keycloak: realm/client roles -> groups; admin_groups grants
+    is_admin; team_mapping auto-joins the mapped team (reference
+    sso_service.py:1831-1860 + _apply_team_mapping)."""
+    gateway = await make_client()
+    idp = await make_idp_with_claims({
+        "email": "kc@corp.com", "preferred_username": "kcuser",
+        "realm_access": {"roles": ["platform-admins"]},
+        "resource_access": {"gateway": {"roles": ["operator"]}},
+    })
+    try:
+        # a team the mapping will join
+        resp = await gateway.post("/teams", json={"name": "ops"}, auth=AUTH)
+        team_id = (await resp.json())["id"]
+        base = f"http://{idp.server.host}:{idp.server.port}"
+        gateway.app["sso_service"].register_provider(
+            "kc", base, "kc-client", "kc-secret", dialect="keycloak",
+            metadata={"map_realm_roles": True, "map_client_roles": True,
+                      "admin_groups": ["platform-admins"],
+                      "team_mapping": {"gateway:operator": team_id}})
+        body = await _sso_roundtrip(gateway, "kc")
+        assert body["email"] == "kc@corp.com"
+        db = gateway.app["ctx"].db
+        row = await db.fetchone("SELECT is_admin FROM users WHERE email=?",
+                                ("kc@corp.com",))
+        assert row["is_admin"] == 1  # realm role matched admin_groups
+        member = await db.fetchone(
+            "SELECT role FROM team_members WHERE team_id=? AND user_email=?",
+            (team_id, "kc@corp.com"))
+        assert member is not None  # client role mapped into the team
+    finally:
+        await idp.close()
+        await gateway.close()
+
+
+async def test_sso_entra_dialect_upn_fallback():
+    """Entra: no email claim — UPN (preferred_username) is the identity;
+    app roles ride the roles claim (reference sso_service.py:1863-1880)."""
+    gateway = await make_client()
+    idp = await make_idp_with_claims({
+        "preferred_username": "user@tenant.onmicrosoft.com",
+        "name": "Entra User", "roles": ["Gateway.Admin"]})
+    try:
+        base = f"http://{idp.server.host}:{idp.server.port}"
+        gateway.app["sso_service"].register_provider(
+            "entra", base, "app-id", "app-secret", dialect="entra",
+            metadata={"admin_groups": ["Gateway.Admin"]})
+        body = await _sso_roundtrip(gateway, "entra")
+        assert body["email"] == "user@tenant.onmicrosoft.com"
+        db = gateway.app["ctx"].db
+        row = await db.fetchone("SELECT is_admin FROM users WHERE email=?",
+                                ("user@tenant.onmicrosoft.com",))
+        assert row["is_admin"] == 1
+    finally:
+        await idp.close()
+        await gateway.close()
+
+
+async def test_sso_okta_dialect_groups_scope_and_claim():
+    """Okta: groups scope requested at authorize; groups claim (custom
+    name supported) feeds admin mapping (reference sso_service.py:1826)."""
+    gateway = await make_client()
+    idp = await make_idp_with_claims({
+        "email": "okta@corp.com", "name": "Okta User",
+        "okta_groups": ["Everyone", "Admins"]})
+    try:
+        base = f"http://{idp.server.host}:{idp.server.port}"
+        gateway.app["sso_service"].register_provider(
+            "okta", base, "okta-client", "okta-secret", dialect="okta",
+            metadata={"groups_claim": "okta_groups",
+                      "admin_groups": ["Admins"]})
+        resp = await gateway.get("/auth/sso/okta/login", allow_redirects=False)
+        assert "groups" in resp.headers["location"]  # okta groups scope
+        state = resp.headers["location"].split("state=")[1].split("&")[0]
+        resp = await gateway.get(
+            f"/auth/sso/okta/callback?state={state}&code=good-code")
+        assert resp.status == 200
+        db = gateway.app["ctx"].db
+        row = await db.fetchone("SELECT is_admin FROM users WHERE email=?",
+                                ("okta@corp.com",))
+        assert row["is_admin"] == 1
+    finally:
+        await idp.close()
+        await gateway.close()
+
+
+async def test_sso_google_dialect_plain_oidc():
+    """Google rides the generic OIDC path (reference sso_service.py:1809)."""
+    gateway = await make_client()
+    idp = await make_idp_with_claims({
+        "email": "g@gmail.com", "name": "G User", "email_verified": True})
+    try:
+        base = f"http://{idp.server.host}:{idp.server.port}"
+        gateway.app["sso_service"].register_provider(
+            "google", base, "g-client", "g-secret", dialect="google")
+        body = await _sso_roundtrip(gateway, "google")
+        assert body["email"] == "g@gmail.com"
+    finally:
+        await idp.close()
+        await gateway.close()

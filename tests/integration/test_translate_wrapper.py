@@ -87,6 +87,12 @@ async def test_bridge_concurrent_id_rewriting(tmp_path):
 
 @pytest.fixture(scope="module")
 def wrapper_binary(tmp_path_factory):
+    # MCPFORGE_WRAPPER_BIN points at an alternate (e.g. ASAN/TSAN) build
+    override = os.environ.get("MCPFORGE_WRAPPER_BIN")
+    if override:
+        if not os.path.exists(override):
+            pytest.skip(f"MCPFORGE_WRAPPER_BIN {override} missing")
+        return override
     src = os.path.join(REPO, "mcp_context_forge_tpu", "native", "stdio_wrapper.cpp")
     out = str(tmp_path_factory.mktemp("bin") / "mcpforge-wrapper")
     result = subprocess.run(["g++", "-O2", "-std=c++17", src, "-o", out],

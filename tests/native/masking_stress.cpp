@@ -1,0 +1,61 @@
+// Concurrency stress driver for the masking extension (SURVEY.md §5.2 —
+// the reference gets the borrow checker + `cargo deny`; the C++ tier gets
+// TSAN/ASAN/UBSAN instead). Hammers mask_sensitive from many threads with
+// colliding and non-colliding keys so the packed-atomic key cache
+// (masking.cpp g_cache) is read and written concurrently — the exact
+// surface of the round-1 torn-pair race. Build:
+//   g++ -std=c++17 -fsanitize=thread  -g tests/native/masking_stress.cpp
+//   g++ -std=c++17 -fsanitize=address,undefined -g ...
+// Exit 0 = outputs correct and sanitizer-clean.
+
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "../../mcp_context_forge_tpu/native/masking.cpp"
+
+namespace {
+
+std::atomic<int> g_failures{0};
+
+void expect(const char* input, const char* expected) {
+  char* out = mask_sensitive(input, std::strlen(input));
+  if (out == nullptr || std::strcmp(out, expected) != 0) {
+    std::fprintf(stderr, "FAIL: %s -> %s (want %s)\n", input,
+                 out ? out : "<null>", expected);
+    ++g_failures;
+  }
+  mask_free(out);
+}
+
+void worker(int seed) {
+  for (int iter = 0; iter < 2000; ++iter) {
+    expect(R"({"password":"hunter2","ok":1})", R"({"password":"***","ok":1})");
+    expect(R"({"api_key":"k","nested":{"token":"t"}})",
+           R"({"api_key":"***","nested":{"token":"***"}})");
+    expect(R"({"plain":"value"})", R"({"plain":"value"})");
+    // per-thread unique keys force cache inserts (and slot collisions)
+    // interleaved with the shared-key lookups above
+    std::string unique = "{\"key_" + std::to_string(seed) + "_" +
+                         std::to_string(iter % 97) + "_secret\":\"x\"}";
+    std::string masked = unique.substr(0, unique.find(":\"x\"")) + ":\"***\"}";
+    expect(unique.c_str(), masked.c_str());
+  }
+}
+
+}  // namespace
+
+int main() {
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 8; ++t) threads.emplace_back(worker, t);
+  for (auto& th : threads) th.join();
+  if (g_failures.load() != 0) {
+    std::fprintf(stderr, "masking_stress: %d failures\n", g_failures.load());
+    return 1;
+  }
+  std::puts("masking_stress: ok");
+  return 0;
+}

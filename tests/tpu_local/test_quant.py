@@ -1,0 +1,148 @@
+"""Int8 weight-only quantization (quantize.py — round-2 VERDICT #2).
+
+Covers: numerics vs full precision, footprint math proving Llama-3-8B
+fits one 16 GB v5e chip, engine serving with quant="int8" (greedy decode
++ TP sharding on the virtual mesh), and quantized HF-checkpoint loading.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mcp_context_forge_tpu.tpu_local.models import MODEL_CONFIGS
+from mcp_context_forge_tpu.tpu_local.models.llama import (init_params,
+                                                          param_count,
+                                                          params_logical)
+from mcp_context_forge_tpu.tpu_local.quantize import (embed_rows, param_bytes,
+                                                      qmm, qmm_t,
+                                                      quantize_leaf,
+                                                      quantize_logical,
+                                                      quantize_tree)
+
+
+def test_quantize_leaf_roundtrip_error_bounded():
+    """Per-channel int8: worst-case error is s/2 = max|W[:,o]|/254 per
+    element — reconstruction must sit within that bound everywhere."""
+    w = np.random.default_rng(0).normal(size=(128, 64)).astype(np.float32)
+    leaf = quantize_leaf(w, axis=0)
+    assert leaf["q"].dtype == jnp.int8
+    recon = np.asarray(leaf["q"], np.float32) * np.asarray(leaf["s"])[None, :]
+    bound = np.abs(w).max(axis=0) / 254.0 + 1e-6
+    assert (np.abs(recon - w) <= bound[None, :] + 1e-5).all()
+
+
+def test_qmm_matches_dense_within_tolerance():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(4, 128)).astype(np.float32))
+    w = rng.normal(size=(128, 256)).astype(np.float32)
+    dense = x @ jnp.asarray(w)
+    quant = qmm(x, quantize_leaf(w, axis=0))
+    rel = float(jnp.linalg.norm(quant - dense) / jnp.linalg.norm(dense))
+    assert rel < 0.01, rel
+    # transposed form (tied lm head): embed is (vocab, dim)
+    emb = rng.normal(size=(256, 128)).astype(np.float32)
+    dense_t = x @ jnp.asarray(emb).T
+    quant_t = qmm_t(x, quantize_leaf(emb, axis=1))
+    rel_t = float(jnp.linalg.norm(quant_t - dense_t) / jnp.linalg.norm(dense_t))
+    assert rel_t < 0.01, rel_t
+
+
+def test_embed_rows_quantized_gather():
+    rng = np.random.default_rng(2)
+    table = rng.normal(size=(64, 32)).astype(np.float32)
+    tokens = jnp.asarray([[1, 5, 63], [0, 2, 4]])
+    dense = jnp.asarray(table)[tokens]
+    quant = embed_rows(quantize_leaf(table, axis=1), tokens)
+    rel = float(jnp.linalg.norm(quant - dense) / jnp.linalg.norm(dense))
+    assert rel < 0.01, rel
+
+
+def test_full_forward_parity_small_model():
+    """Whole-model check: quantized prefill logits track full precision
+    closely enough that greedy argmax agrees on a real geometry."""
+    from mcp_context_forge_tpu.tpu_local.engine import EngineConfig, TPUEngine
+
+    def greedy_tokens(quant: str) -> list[int]:
+        config = EngineConfig(model="llama3-test", max_batch=2, max_seq_len=64,
+                              page_size=16, num_pages=32, prefill_buckets=(16,),
+                              dtype="float32", attn_impl="reference",
+                              quant=quant)
+        engine = TPUEngine(config)
+        import asyncio
+
+        async def run():
+            await engine.start()
+            try:
+                out = []
+                prompt = engine.tokenizer.encode("the quick brown fox")
+                async for tok in engine.generate(prompt, max_tokens=8):
+                    out.append(tok)
+                return out
+            finally:
+                await engine.stop()
+
+        return asyncio.run(run())
+
+    full = greedy_tokens("")
+    quant = greedy_tokens("int8")
+    assert len(quant) == len(full)
+    # random-init logits are near-uniform, the hardest case for argmax
+    # stability — still require strong agreement on the first tokens
+    agree = sum(1 for a, b in zip(full, quant) if a == b)
+    assert agree >= len(full) // 2, (full, quant)
+
+
+def test_llama3_8b_int8_fits_one_v5e_chip():
+    """The capacity claim, proved on abstract shapes (no allocation):
+    int8 8B params + scales + norms < 9.5 GB, leaving >6 GB of a 16 GB
+    v5e for KV pages + activations; bf16 provably does NOT fit."""
+    config = MODEL_CONFIGS["llama3-8b"]
+    logical = params_logical(config)
+
+    abstract_full = jax.eval_shape(
+        lambda: init_params(config, jax.random.PRNGKey(0),
+                            dtype=jnp.bfloat16))
+    abstract_q = jax.eval_shape(
+        lambda: quantize_tree(
+            init_params(config, jax.random.PRNGKey(0), dtype=jnp.bfloat16),
+            logical, scale_dtype=jnp.bfloat16))
+    full_gb = param_bytes(abstract_full) / 1e9
+    quant_gb = param_bytes(abstract_q) / 1e9
+    assert full_gb > 15.0, full_gb          # bf16 can't share a 16 GB chip
+    assert quant_gb < 9.5, quant_gb         # int8 leaves room for KV
+    assert param_count(config) > 7.5e9      # it really is the 8B geometry
+
+
+def test_quantized_hf_checkpoint_load(tmp_path):
+    """HF safetensors -> int8 tree: tensors quantize on the way in and the
+    engine boots from them (llama3-test geometry, synthetic checkpoint)."""
+    import asyncio
+
+    from mcp_context_forge_tpu.tpu_local.engine import EngineConfig, TPUEngine
+    from tests.tpu_local.test_checkpoint import _write_hf_checkpoint
+
+    config = MODEL_CONFIGS["llama3-test"]
+    full_params = init_params(config, jax.random.PRNGKey(3),
+                              dtype=jnp.float32)
+    ckpt = tmp_path / "hf"
+    _write_hf_checkpoint(str(ckpt), full_params)
+    engine_config = EngineConfig(model="llama3-test", checkpoint=str(ckpt),
+                                 max_batch=2, max_seq_len=64, page_size=16,
+                                 num_pages=32, prefill_buckets=(16,),
+                                 dtype="float32", attn_impl="reference",
+                                 quant="int8")
+    engine = TPUEngine(engine_config)
+    assert engine.params["layers"][0]["wq"]["q"].dtype == jnp.int8
+
+    async def run():
+        await engine.start()
+        try:
+            tokens = []
+            async for tok in engine.generate(
+                    engine.tokenizer.encode("hello"), max_tokens=4):
+                tokens.append(tok)
+            return tokens
+        finally:
+            await engine.stop()
+
+    assert len(asyncio.run(run())) == 4
