@@ -31,6 +31,11 @@ bench:
 bench-engine:
 	python bench_engine.py
 
+# real HF-format checkpoint built in-tree (BPE tokenizer.json + safetensors;
+# the model memorizes its corpus so greedy decode is assertable)
+tiny-checkpoint:
+	python -m mcp_context_forge_tpu.tools.tiny_checkpoint /tmp/mcpforge-tiny-ckpt
+
 wrapper:
 	g++ -O2 -std=c++17 mcp_context_forge_tpu/native/stdio_wrapper.cpp -o mcpforge-wrapper
 

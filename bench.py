@@ -115,13 +115,15 @@ async def _make_gateway(engine: bool, platform: str):
         "MCPFORGE_PLUGINS_ENABLED": "true",
         "MCPFORGE_TPU_LOCAL_ENABLED": "true" if engine else "false",
         "MCPFORGE_TPU_LOCAL_MODEL": model,
-        "MCPFORGE_TPU_LOCAL_MAX_BATCH": os.environ.get("BENCH_MAX_BATCH", "32"),
+        "MCPFORGE_TPU_LOCAL_MAX_BATCH": os.environ.get("BENCH_MAX_BATCH", "64"),
+        "MCPFORGE_TPU_LOCAL_PREFILL_MAX_BATCH": os.environ.get(
+            "BENCH_PREFILL_MAX_BATCH", "16"),
         "MCPFORGE_TPU_LOCAL_MAX_SEQ_LEN": "1024",
         # 16-token pages: full-page granularity for prefix-cache hits on
         # shared plugin/chat templates (suffix-only prefill)
         "MCPFORGE_TPU_LOCAL_PAGE_SIZE": "16",
         "MCPFORGE_TPU_LOCAL_NUM_PAGES": "4096",
-        "MCPFORGE_TPU_LOCAL_PREFILL_BUCKETS": "64,256",
+        "MCPFORGE_TPU_LOCAL_PREFILL_BUCKETS": "64,128,256",
         "MCPFORGE_TPU_LOCAL_DTYPE": ("bfloat16" if platform == "tpu"
                                      else "float32"),
         "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",

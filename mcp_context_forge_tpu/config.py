@@ -177,6 +177,17 @@ class Settings(BaseModel):
     tpu_local_classify_coverage: str = "full"
     tpu_local_classify_max_windows: int = 8
 
+    # --- header passthrough (reference config.py:3489-3499: off by
+    # default for security; sensitive headers need per-gateway opt-in) ---
+    enable_header_passthrough: bool = False
+    default_passthrough_headers: str = "x-tenant-id,x-trace-id"
+    # --- response compression (reference SSEAwareCompressMiddleware) ---
+    compression_enabled: bool = True
+    compression_min_bytes: int = 1024
+    # --- host validation: comma-separated allowed Host headers; '' = any
+    # (reference forwarded-host validation tier) ---
+    allowed_hosts: str = ""
+
     # --- SSO (JSON list: [{name, issuer, client_id, client_secret}]) ---
     sso_providers: str = ""
 
@@ -211,6 +222,15 @@ class Settings(BaseModel):
     def supported_protocol_versions(self) -> set[str]:
         return {v.strip() for v in self.supported_protocol_versions_csv.split(",")
                 if v.strip()}
+
+    def default_passthrough_list(self) -> list[str]:
+        return [h.strip() for h in self.default_passthrough_headers.split(",")
+                if h.strip()]
+
+    @property
+    def allowed_host_set(self) -> set[str]:
+        return {h.strip().lower() for h in self.allowed_hosts.split(",")
+                if h.strip()}
 
     @property
     def database_path(self) -> str:

@@ -2,9 +2,15 @@
 
 Reference: 20.5k-LoC admin.py + 34.8k-LoC JS admin_ui — intentionally
 table-driven here (SURVEY.md §7.2 #5: the API surface must be generated,
-not hand-grown). One page, vanilla JS over the existing REST API: entity
-tabs with client-side search, enable/disable row actions, trace drill-down
-(span tree), users/teams/plugins views, auto-refresh.
+not hand-grown). One page, vanilla JS over the existing REST API:
+
+- entity tabs with client-side search + auto-refresh
+- full CRUD where the API has it: create forms (per-entity field specs),
+  JSON edit (PUT), delete, enable/disable toggles
+- trace drill-down: span tree AND a gantt view (bars positioned by
+  start_ts/duration over the trace window — the reference's admin trace
+  timeline)
+- engine dashboard: live tpu_local stats as stat cards
 """
 
 from __future__ import annotations
@@ -25,45 +31,73 @@ _PAGE = """<!doctype html>
  th{background:#fafbfc;font-weight:600}
  .pill{display:inline-block;padding:1px 8px;border-radius:10px;font-size:11px}
  .ok{background:#d9f2e4;color:#11734b}.bad{background:#fde2e1;color:#a12622}
- #bar{margin:10px 0;display:flex;gap:10px;align-items:center}
+ #bar{margin:10px 0;display:flex;gap:10px;align-items:center;flex-wrap:wrap}
  #status{color:#667}
  #q{padding:6px 10px;border:1px solid #ccd;border-radius:4px;min-width:220px}
  button.act{background:#eef;border:1px solid #ccd;border-radius:4px;cursor:pointer;padding:2px 8px;font-size:12px}
+ button.danger{background:#fde2e1;border-color:#eab}
  a.trace{color:#26c;cursor:pointer;text-decoration:underline}
  #detail{background:#fff;margin-top:14px;padding:12px;box-shadow:0 1px 3px rgba(0,0,0,.08);display:none}
  .span-row{font-family:ui-monospace,monospace;font-size:12px;white-space:pre}
  .err{color:#a12622}
+ #form{background:#fff;margin:10px 0;padding:12px;box-shadow:0 1px 3px rgba(0,0,0,.08);display:none}
+ #form input{margin:3px 6px 3px 0;padding:5px 8px;border:1px solid #ccd;border-radius:4px}
+ #edit-area{width:100%;min-height:140px;font-family:ui-monospace,monospace;font-size:12px}
+ .gantt{position:relative;height:18px;margin:1px 0;background:#fafbfc}
+ .gantt .bar{position:absolute;top:2px;height:14px;background:#9cf;border-radius:2px;min-width:2px}
+ .gantt .bar.err{background:#f99}
+ .gantt .lbl{position:absolute;left:4px;top:1px;font-size:11px;font-family:ui-monospace,monospace;white-space:nowrap;z-index:1}
+ .cards{display:flex;gap:12px;flex-wrap:wrap}
+ .card{background:#fff;box-shadow:0 1px 3px rgba(0,0,0,.08);padding:12px 18px;min-width:130px}
+ .card b{display:block;font-size:22px}.card span{color:#667;font-size:12px}
 </style></head><body>
 <header><h1>mcpforge</h1><nav id="nav"></nav></header>
 <main>
  <div id="bar">
   <input id="q" placeholder="filter rows…" oninput="render()">
   <button class="act" onclick="show(current)">refresh</button>
+  <button class="act" id="newbtn" onclick="openForm()" style="display:none">+ new</button>
   <label style="font-size:12px;color:#667"><input type="checkbox" id="auto"
    onchange="autoRefresh()"> auto (5s)</label>
   <span id="status"></span>
  </div>
+ <div id="form"></div>
  <div id="view"></div>
  <div id="detail"></div>
 </main>
 <script>
 const TABS = {
-  tools:    {url: "/tools?include_inactive=true", cols: ["name","integration_type","url","enabled","reachable"], toggle: id => `/tools/${id}/toggle`, boolcols: ["enabled","reachable"]},
-  gateways: {url: "/gateways?include_inactive=true", cols: ["name","url","transport","state","reachable"], boolcols: ["reachable"]},
-  servers:  {url: "/servers?include_inactive=true", cols: ["name","description","associated_tools","enabled"], boolcols: ["enabled"]},
-  resources:{url: "/resources?include_inactive=true", cols: ["uri","name","mime_type","enabled"], boolcols: ["enabled"]},
-  prompts:  {url: "/prompts?include_inactive=true", cols: ["name","description","enabled"], boolcols: ["enabled"]},
-  agents:   {url: "/a2a?include_inactive=true", cols: ["name","agent_type","endpoint_url","enabled","reachable"], boolcols: ["enabled","reachable"]},
+  tools:    {url: "/tools?include_inactive=true", cols: ["name","integration_type","url","enabled","reachable"], toggle: id => `/tools/${id}/toggle`, boolcols: ["enabled","reachable"],
+             create: {url:"/tools", fields:["name","integration_type","url","description"]},
+             edit: id => `/tools/${id}`, del: id => `/tools/${id}`},
+  gateways: {url: "/gateways?include_inactive=true", cols: ["name","url","transport","state","reachable"], boolcols: ["reachable"],
+             create: {url:"/gateways", fields:["name","url","transport"]},
+             edit: id => `/gateways/${id}`, del: id => `/gateways/${id}`},
+  servers:  {url: "/servers?include_inactive=true", cols: ["name","description","associated_tools","enabled"], boolcols: ["enabled"],
+             create: {url:"/servers", fields:["name","description"]},
+             edit: id => `/servers/${id}`, del: id => `/servers/${id}`},
+  resources:{url: "/resources?include_inactive=true", cols: ["uri","name","mime_type","enabled"], boolcols: ["enabled"],
+             create: {url:"/resources", fields:["uri","name","content","mime_type"]},
+             edit: id => `/resources/${id}`, del: id => `/resources/${id}`},
+  prompts:  {url: "/prompts?include_inactive=true", cols: ["name","description","enabled"], boolcols: ["enabled"],
+             create: {url:"/prompts", fields:["name","template","description"]},
+             edit: id => `/prompts/${id}`, del: id => `/prompts/${id}`},
+  agents:   {url: "/a2a?include_inactive=true", cols: ["name","agent_type","endpoint_url","enabled","reachable"], boolcols: ["enabled","reachable"],
+             create: {url:"/a2a", fields:["name","agent_type","endpoint_url"]}},
   plugins:  {url: "/plugins", cols: ["name","kind","mode","priority"]},
-  users:    {url: "/admin/users", cols: ["email","full_name","is_admin","is_active","auth_provider","last_login"], toggle: id => `/admin/users/${encodeURIComponent(id)}/toggle`, idcol: "email", boolcols: ["is_admin","is_active"]},
-  teams:    {url: "/teams", cols: ["name","slug","visibility","is_personal","created_by"], boolcols: ["is_personal"]},
-  tokens:   {url: "/auth/tokens", cols: ["name","server_id","expires_at","last_used","revoked_at"]},
+  users:    {url: "/admin/users", cols: ["email","full_name","is_admin","is_active","auth_provider","last_login"], toggle: id => `/admin/users/${encodeURIComponent(id)}/toggle`, idcol: "email", boolcols: ["is_admin","is_active"],
+             create: {url:"/admin/users", fields:["email","password","full_name"]}},
+  teams:    {url: "/teams", cols: ["name","slug","visibility","is_personal","created_by"], boolcols: ["is_personal"],
+             create: {url:"/teams", fields:["name","visibility"]}},
+  tokens:   {url: "/auth/tokens", cols: ["name","server_id","expires_at","last_used","revoked_at"],
+             del: id => `/auth/tokens/${id}`},
   models:   {url: "/v1/models", cols: ["id","owned_by"], path: "data"},
   metrics:  {url: "/metrics", cols: ["name","calls","errors","avg_ms","min_ms","max_ms"], path: "tools"},
   rollups:  {url: "/metrics/rollups", cols: ["entity_type","entity_id","hour","calls","errors","avg_ms"]},
   traces:   {url: "/admin/traces?limit=100", cols: ["name","duration_ms","status","trace_id"], tracecol: "trace_id"},
   logs:     {url: "/admin/logs?limit=200", cols: ["ts","level","logger","message"]},
   audit:    {url: "/admin/audit?limit=100", cols: ["ts","actor","action","details"]},
+  engine:   {url: "/admin/engine/stats", special: "engine"},
 };
 let current = "tools", rows = [], shown = [], timer = null;
 function esc(s){
@@ -82,30 +116,51 @@ function cell(v, isBool){
   if (typeof v === "object") return esc(JSON.stringify(v).slice(0,80));
   return esc(String(v).slice(0,100));  // API data is attacker-influenced
 }
+function renderEngine(stats){
+  const order = ["requests","prompt_tokens","completion_tokens","decode_steps",
+                 "prefill_batches","queue_depth","kv_pages_in_use","prefix_hits",
+                 "prefix_hit_tokens","spec_steps","spec_tokens",
+                 "prefill_ms_total","decode_ms_total"];
+  const cards = order.filter(k => k in stats).map(k =>
+    `<div class="card"><b>${cell(stats[k])}</b><span>${k}</span></div>`).join("");
+  const rest = Object.keys(stats).filter(k => !order.includes(k));
+  const extra = rest.map(k =>
+    `<div class="card"><b>${cell(stats[k])}</b><span>${k}</span></div>`).join("");
+  document.getElementById("view").innerHTML =
+    `<div class="cards">${cards}${extra}</div>`;
+  document.getElementById("status").textContent = "engine stats";
+}
 function render(){
   const t = TABS[current];
+  if (t.special === "engine") return;  // rendered at fetch time
   const q = document.getElementById("q").value.toLowerCase();
   // `shown` is the single source of truth for row indices: click handlers
   // index into it, so a filter edit between render and click cannot
   // misresolve, and attacker data never lands inside a JS string
   shown = rows.filter(d => !q || JSON.stringify(d).toLowerCase().includes(q));
   document.getElementById("status").textContent = shown.length + " rows";
-  const actions = t.toggle ? "<th></th>" : "";
-  const head = "<tr>" + t.cols.map(c=>`<th>${c}</th>`).join("") + actions + "</tr>";
+  const hasActs = t.toggle || t.edit || t.del;
+  const head = "<tr>" + t.cols.map(c=>`<th>${c}</th>`).join("")
+    + (hasActs ? "<th></th>" : "") + "</tr>";
   const bools = new Set(t.boolcols || []);
   const body = shown.map((d,i)=>{
     const cells = t.cols.map(c=>{
       if (t.tracecol === c) return `<td><a class="trace" onclick="trace(${i})">${cell(d[c])}</a></td>`;
       return `<td>${cell(d[c], bools.has(c))}</td>`;
     }).join("");
-    const act = t.toggle ? `<td><button class="act" onclick="toggleRow(${i})">toggle</button></td>` : "";
-    return "<tr>"+cells+act+"</tr>";
+    let act = "";
+    if (t.toggle) act += `<button class="act" onclick="toggleRow(${i})">toggle</button> `;
+    if (t.edit)   act += `<button class="act" onclick="editRow(${i})">edit</button> `;
+    if (t.del)    act += `<button class="act danger" onclick="delRow(${i})">delete</button>`;
+    return "<tr>"+cells+(hasActs?`<td>${act}</td>`:"")+"</tr>";
   }).join("");
   document.getElementById("view").innerHTML = `<table>${head}${body}</table>`;
 }
 async function show(name){
   current = name;
   document.getElementById("detail").style.display = "none";
+  document.getElementById("form").style.display = "none";
+  document.getElementById("newbtn").style.display = TABS[name].create ? "" : "none";
   document.querySelectorAll("nav button").forEach(b=>b.classList.toggle("active", b.textContent===name));
   const t = TABS[name];
   const s = document.getElementById("status");
@@ -114,10 +169,33 @@ async function show(name){
     const r = await fetch(t.url, {headers: {accept: "application/json"}});
     if (!r.ok) { s.textContent = r.status + " " + esc(await r.text()); return; }
     let data = await r.json();
+    if (t.special === "engine") return renderEngine(data);
     if (t.path) data = data[t.path] || [];
     rows = Array.isArray(data) ? data : [];
     render();
   } catch(e){ s.textContent = "error: " + esc(String(e)); }
+}
+function openForm(){
+  const t = TABS[current];
+  if (!t.create) return;
+  const f = document.getElementById("form");
+  f.style.display = "block";
+  f.innerHTML = `<b>new ${esc(current)}</b><br>` + t.create.fields.map(x =>
+    `<input id="f-${x}" placeholder="${x}">`).join("")
+    + `<button class="act" onclick="submitForm()">create</button>`;
+}
+async function submitForm(){
+  const t = TABS[current];
+  const body = {};
+  for (const x of t.create.fields){
+    const v = document.getElementById("f-"+x).value;
+    if (v) body[x] = v;
+  }
+  const r = await fetch(t.create.url, {method:"POST",
+    headers:{"content-type":"application/json"}, body: JSON.stringify(body)});
+  document.getElementById("status").textContent = r.ok ? "created" :
+    `create failed: ${r.status} ` + esc(await r.text());
+  if (r.ok) show(current);
 }
 async function toggleRow(i){
   const t = TABS[current];
@@ -126,6 +204,40 @@ async function toggleRow(i){
   const id = row[t.idcol || "id"];
   const r = await fetch(t.toggle(id), {method: "POST"});
   if (!r.ok) document.getElementById("status").textContent = "toggle failed: " + r.status;
+  show(current);
+}
+let editTarget = null;  // id captured at OPEN time: a filter edit must not
+                        // re-point the save at a different row
+function editRow(i){
+  const t = TABS[current];
+  const row = shown[i];
+  if (!row) return;
+  editTarget = row[t.idcol || "id"];
+  const d = document.getElementById("detail");
+  d.style.display = "block";
+  d.innerHTML = `<b>edit ${esc(String(editTarget))}</b><br>`
+    + `<textarea id="edit-area"></textarea><br>`
+    + `<button class="act" onclick="saveEdit()">save (PUT)</button>`;
+  document.getElementById("edit-area").value = JSON.stringify(row, null, 1);
+}
+async function saveEdit(){
+  const t = TABS[current];
+  if (editTarget == null) return;
+  let body;
+  try { body = JSON.parse(document.getElementById("edit-area").value); }
+  catch(e){ document.getElementById("status").textContent = "bad JSON: " + esc(String(e)); return; }
+  const r = await fetch(t.edit(editTarget), {method:"PUT",
+    headers:{"content-type":"application/json"}, body: JSON.stringify(body)});
+  document.getElementById("status").textContent = r.ok ? "saved" :
+    `save failed: ${r.status} ` + esc(await r.text());
+  if (r.ok) show(current);
+}
+async function delRow(i){
+  const t = TABS[current];
+  const row = shown[i];
+  if (!row || !confirm("delete " + (row.name || row[t.idcol || "id"]) + "?")) return;
+  const r = await fetch(t.del(row[t.idcol || "id"]), {method:"DELETE"});
+  if (!r.ok) document.getElementById("status").textContent = "delete failed: " + r.status;
   show(current);
 }
 async function trace(i){
@@ -138,8 +250,9 @@ async function trace(i){
   d.style.display = "block";
   if (!r.ok) { d.textContent = "trace fetch failed: " + r.status; return; }
   const tree = await r.json();
+  const spans = tree.spans;
   const byParent = {};
-  for (const s of tree.spans) (byParent[s.parent_span_id || ""] ??= []).push(s);
+  for (const s of spans) (byParent[s.parent_span_id || ""] ??= []).push(s);
   const lines = [];
   const walk = (pid, depth) => {
     for (const s of byParent[pid] || []) {
@@ -152,11 +265,26 @@ async function trace(i){
   };
   walk("", 0);
   // orphan spans (parent outside the stored window) still render
-  const seen = new Set(tree.spans.map(s=>s.span_id));
-  for (const s of tree.spans)
+  const seen = new Set(spans.map(s=>s.span_id));
+  for (const s of spans)
     if (s.parent_span_id && !seen.has(s.parent_span_id))
       lines.push(`<div class="span-row">${esc(s.name)} (orphan)</div>`);
-  d.innerHTML = `<b>trace ${esc(id)}</b> — ${tree.spans.length} spans` + lines.join("");
+  // gantt: bars positioned over the trace window (reference trace timeline)
+  let gantt = "";
+  const starts = spans.map(s=>s.start_ts).filter(v=>v!=null);
+  if (starts.length){
+    const t0 = Math.min(...starts);
+    const t1 = Math.max(...spans.map(s=>(s.start_ts||t0)+((s.duration_ms||0)/1000)));
+    const window_s = Math.max(t1 - t0, 1e-6);
+    gantt = "<br><b>timeline</b>" + spans.map(s=>{
+      const left = (((s.start_ts||t0)-t0)/window_s)*100;
+      const width = Math.max((((s.duration_ms||0)/1000)/window_s)*100, 0.3);
+      const cls = s.status === "ERROR" ? "bar err" : "bar";
+      return `<div class="gantt"><span class="lbl">${esc(s.name)}</span>`
+        + `<div class="${cls}" style="left:${left.toFixed(2)}%;width:${width.toFixed(2)}%"></div></div>`;
+    }).join("");
+  }
+  d.innerHTML = `<b>trace ${esc(id)}</b> — ${spans.length} spans` + lines.join("") + gantt;
 }
 function autoRefresh(){
   if (timer) { clearInterval(timer); timer = null; }

@@ -459,6 +459,8 @@ def setup_routes(app: web.Application) -> None:
             "queue_depth": stats.queue_depth,
             "kv_pages_in_use": alloc.pages_in_use,
             "kv_pages_free": alloc.free_pages,
+            "prefill_ms_total": round(stats.prefill_ms_total, 1),
+            "decode_ms_total": round(stats.decode_ms_total, 1),
             "prefix_cache": {
                 "enabled": engine.config.prefix_cache,
                 "cached_pages": alloc.cached_pages,

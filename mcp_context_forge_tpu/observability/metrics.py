@@ -65,6 +65,11 @@ class PrometheusRegistry:
         self.sessions_active = Gauge(
             "mcpforge_sessions_active", "Active MCP sessions", registry=self.registry,
         )
+        self.client_disconnects = Counter(
+            "mcpforge_client_disconnects_total",
+            "Requests whose client went away mid-flight",
+            registry=self.registry,
+        )
 
     def render(self) -> tuple[bytes, str]:
         return generate_latest(self.registry), CONTENT_TYPE_LATEST

@@ -71,7 +71,10 @@ class StdioPluginProcess:
         self._proc = await asyncio.create_subprocess_exec(
             *self.command, cwd=self.cwd, env=env,
             stdin=asyncio.subprocess.PIPE, stdout=asyncio.subprocess.PIPE,
-            stderr=asyncio.subprocess.DEVNULL)
+            stderr=asyncio.subprocess.DEVNULL,
+            # a modified tool_post_invoke payload comes back as ONE line;
+            # the 64 KiB default would kill the reader (mirrors sdk.py)
+            limit=64 * 1024 * 1024)
         self._reader = asyncio.ensure_future(self._read_loop(self._proc))
 
     async def stop(self) -> None:

@@ -6,9 +6,12 @@ DB models LLMProvider/LLMModel (`db.py:6447/6533`), provider-type enum of 12
 (`db.py:6307-6321`). In-tree the supported types are:
 
 - ``tpu_local``            — the in-tree engine (registered at startup).
-- ``openai_compatible``    — any OpenAI-shape endpoint (covers openai,
-  azure_openai via full URL, ollama, groq, together, mistral, cohere-compat).
-- ``anthropic``            — via the A2A anthropic translation.
+- ``openai_compatible``    — any OpenAI-shape endpoint; ``openai``,
+  ``mistral``, ``groq``, ``together``, ``cohere`` are aliases of it (the
+  reference routes those through its OpenAI builder the same way).
+- translation dialects (``DialectProvider``): ``azure_openai``,
+  ``anthropic``, ``ollama``, ``bedrock``, ``google_vertex``, ``watsonx`` —
+  the full reference provider-type enum (`db.py:6307-6321`).
 
 Creating/enabling a provider row immediately (re)wires the runtime registry,
 so model aliases resolve without a restart.
@@ -19,12 +22,17 @@ from __future__ import annotations
 from typing import Any
 
 from ..db.core import from_json, to_json
-from ..tpu_local.provider import LLMProviderRegistry, OpenAICompatProvider
+from ..tpu_local.provider import (DialectProvider, LLMProviderRegistry,
+                                  OpenAICompatProvider)
 from ..utils.crypto import decrypt_field, encrypt_field
 from ..utils.ids import new_id
 from .base import AppContext, ConflictError, NotFoundError, ValidationFailure, now
 
-SUPPORTED_TYPES = {"tpu_local", "openai_compatible", "anthropic"}
+OPENAI_TRUNK_TYPES = {"openai_compatible", "openai", "mistral", "groq",
+                      "together", "cohere"}
+DIALECT_TYPES = {"azure_openai", "anthropic", "ollama", "bedrock",
+                 "google_vertex", "watsonx"}
+SUPPORTED_TYPES = {"tpu_local"} | OPENAI_TRUNK_TYPES | DIALECT_TYPES
 
 
 class LLMProviderService:
@@ -119,10 +127,17 @@ class LLMProviderService:
                                self.ctx.settings.auth_encryption_secret) or {}
         if isinstance(config, str):
             config = {}
-        provider = OpenAICompatProvider(
-            name=row["name"], api_base=row["api_base"] or "",
-            api_key=config.get("api_key", ""),
-            timeout=float(config.get("timeout", 120.0)))
+        if row["provider_type"] in DIALECT_TYPES:
+            provider: Any = DialectProvider(
+                name=row["name"], dialect=row["provider_type"],
+                api_base=row["api_base"] or "",
+                api_key=config.get("api_key", ""), config=config,
+                timeout=float(config.get("timeout", 120.0)))
+        else:
+            provider = OpenAICompatProvider(
+                name=row["name"], api_base=row["api_base"] or "",
+                api_key=config.get("api_key", ""),
+                timeout=float(config.get("timeout", 120.0)))
         models = await self.ctx.db.fetchall(
             "SELECT alias FROM llm_models WHERE provider_id=? AND enabled=1",
             (row["id"],))

@@ -254,7 +254,8 @@ class ToolService:
         integration = row["integration_type"]
         injected_headers = injected_headers or {}
         if integration == "REST":
-            return await self._invoke_rest(row, arguments, injected_headers)
+            return await self._invoke_rest(row, arguments, injected_headers,
+                                           request_headers)
         if integration == "MCP":
             return await self._invoke_mcp(row, arguments, request_headers,
                                           injected_headers)
@@ -273,13 +274,32 @@ class ToolService:
                                              arguments)
         raise JSONRPCError(INVALID_PARAMS, f"Unsupported integration type {integration}")
 
+    def _passthrough(self, headers: dict[str, str],
+                     request_headers: dict[str, str],
+                     gateway: dict[str, Any] | None) -> None:
+        """Copy allowlisted inbound headers onto the upstream call:
+        per-gateway list first, else the global default when the feature
+        flag is on; sensitive headers never ride the default (reference
+        passthrough_headers + config.py:3489-3499)."""
+        allowed = from_json((gateway or {}).get("passthrough_headers"), [])
+        if not allowed and self.ctx.settings.enable_header_passthrough:
+            allowed = [h for h in self.ctx.settings.default_passthrough_list()
+                       if h.lower() not in ("authorization", "cookie")]
+        for h in allowed:
+            value = request_headers.get(h.lower())
+            if value:
+                headers[h] = value
+
     # REST branch (reference tool_service.py:6196+)
     async def _invoke_rest(self, row: dict[str, Any], arguments: dict[str, Any],
-                           injected_headers: dict[str, str]) -> dict[str, Any]:
+                           injected_headers: dict[str, str],
+                           request_headers: dict[str, str] | None = None
+                           ) -> dict[str, Any]:
         url = row["url"]
         if not url:
             raise JSONRPCError(INVALID_PARAMS, "REST tool has no URL")
         headers = dict(from_json(row["headers"], {}))
+        self._passthrough(headers, request_headers or {}, None)
         headers.update(injected_headers)
         headers.update(await resolve_auth_headers(self.ctx, row))
         # URL path templating: {placeholder} substituted from arguments
@@ -336,12 +356,7 @@ class ToolService:
                                    err.get("message", "tunnel error"))
             return response.get("result", {})
         headers = await resolve_auth_headers(self.ctx, gateway or row)
-        # passthrough headers from the inbound request (reference passthrough_headers)
-        allowed = from_json((gateway or {}).get("passthrough_headers"), [])
-        for h in allowed:
-            value = request_headers.get(h.lower())
-            if value:
-                headers[h] = value
+        self._passthrough(headers, request_headers, gateway)
         headers.update(injected_headers or {})
 
         registry = self.ctx.extras.get("upstream_sessions")

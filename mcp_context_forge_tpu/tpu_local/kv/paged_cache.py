@@ -93,12 +93,20 @@ def write_decode_kv(kv: PagedKVState, layer: int, k: jax.Array, v: jax.Array,
     return kv._replace(k_pages=k_pages, v_pages=v_pages)
 
 
-def gather_kv(kv: PagedKVState, layer: int, slot_ids: jax.Array
+def gather_kv(kv: PagedKVState, layer: int, slot_ids: jax.Array,
+              ctx_pages: int | None = None
               ) -> tuple[jax.Array, jax.Array]:
     """Materialize each slot's context: -> ([B, C, KV, hd], [B, C, KV, hd])
-    where C = max_pages_per_slot * page_size. (The Pallas paged-attention
-    kernel replaces this gather on TPU for large configs.)"""
+    where C = ctx_pages * page_size (default: the full block-table width).
+    ``ctx_pages`` is STATIC (a compile-time context-width bucket): decode
+    cost is dominated by this gather's HBM traffic, and pulling the full
+    max-context width for 40-token conversations wastes ~24x the
+    bandwidth — the engine picks a power-of-two bucket covering the
+    longest active row each step. (The Pallas paged-attention kernel
+    replaces this gather on TPU for large configs.)"""
     rows = kv.block_tables[slot_ids]                        # [B,P]
+    if ctx_pages is not None:
+        rows = rows[:, :ctx_pages]
     k = kv.k_pages[layer][rows]                             # [B,P,page,KV,hd]
     v = kv.v_pages[layer][rows]
     B, P, page, KV, hd = k.shape

@@ -166,13 +166,19 @@ def _ffn(layer: dict[str, Any], x: jax.Array) -> jax.Array:
 
 def prefill(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
             positions: jax.Array, kv: PagedKVState, slot_ids: jax.Array,
-            attn_impl: str = "auto", mesh=None) -> tuple[jax.Array, PagedKVState]:
+            attn_impl: str = "auto", mesh=None,
+            last_idx: jax.Array | None = None) -> tuple[jax.Array, PagedKVState]:
     """Full-sequence forward writing KV into the paged cache.
 
     tokens/positions: [B, S]; slot_ids: [B] row into the block table.
     ``attn_impl`` may select the sequence-parallel paths (ring/ulysses)
     for long-context prefill — requires ``mesh`` (SURVEY.md §5.7).
-    Returns (logits [B, S, vocab] fp32, updated kv state).
+    ``last_idx`` ([B], optional): project ONLY those positions through the
+    lm head, returning [B, vocab] — serving needs one next-token
+    distribution per row, and materializing [B, S, vocab] f32 is S x the
+    FLOPs and memory (a 2048-bucket Llama-3 prefill would allocate >4 GB
+    of logits on a 16 GB chip). Training/tests omit it for full logits.
+    Returns (logits [B, S, vocab] or [B, vocab] fp32, updated kv state).
     """
     x = embed_rows(params["embed"], tokens)  # [B,S,D]
     mask_valid = positions >= 0  # padding has position -1
@@ -187,13 +193,17 @@ def prefill(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
         h = rms_norm(x, layer["ffn_norm"], config.norm_eps)
         x = x + _ffn(layer, h)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
+    if last_idx is not None:
+        x = x[jnp.arange(x.shape[0]), last_idx]  # [B, D] before the lm head
     logits = lm_logits(params, x)
     return logits, kv
 
 
 def prefill_with_history(params: dict[str, Any], config: LlamaConfig,
                          tokens: jax.Array, positions: jax.Array,
-                         kv: PagedKVState, slot_ids: jax.Array
+                         kv: PagedKVState, slot_ids: jax.Array,
+                         ctx_pages: int | None = None,
+                         last_idx: jax.Array | None = None
                          ) -> tuple[jax.Array, PagedKVState]:
     """Suffix/chunk prefill attending over cached history (prefix-cache
     path — reference analog: the response_cache_by_prompt plugin caches
@@ -205,7 +215,11 @@ def prefill_with_history(params: dict[str, Any], config: LlamaConfig,
     ``hist``); padding has position -1. The row's block table must already
     map its history pages. Per-row history lengths may differ freely —
     attention masks on absolute position (cache_pos <= q_pos), so one
-    compiled shape serves any mix. Returns (logits [B,S,V] fp32, kv)."""
+    compiled shape serves any mix. ``ctx_pages`` is the STATIC
+    context-width bucket (see gather_kv) — without it a prefix-cache hit
+    with 40 resident tokens pays attention over the full table width,
+    costing MORE than the dense prefill it was meant to save.
+    Returns (logits [B,S,V] fp32, kv)."""
     B, S = tokens.shape
     x = embed_rows(params["embed"], tokens)
     mask_valid = positions >= 0
@@ -226,7 +240,11 @@ def prefill_with_history(params: dict[str, Any], config: LlamaConfig,
         kv = write_prefill_kv(kv, idx, k, v, slot_ids, safe_positions,
                               mask_valid)
         if not use_pallas:
-            keys, values = gather_kv(kv, idx, slot_ids)  # [B, C, KV, hd]
+            keys, values = gather_kv(kv, idx, slot_ids, ctx_pages)
+        else:
+            tables = kv.block_tables[slot_ids]
+            if ctx_pages is not None:
+                tables = tables[:, :ctx_pages]
         tiles = []
         for t0 in range(0, S, tile):
             qs = q[:, t0:t0 + tile]
@@ -236,7 +254,7 @@ def prefill_with_history(params: dict[str, Any], config: LlamaConfig,
                 qg = qs.reshape(B, -1, config.n_kv_heads, G, config.head_dim)
                 at = paged_chunk_attention_pallas(
                     qg, kv.k_pages[idx], kv.v_pages[idx],
-                    kv.block_tables[slot_ids], ps,
+                    tables, ps,
                     page_size=kv.page_size)
                 at = at.reshape(B, -1, config.n_heads, config.head_dim)
             else:
@@ -249,6 +267,8 @@ def prefill_with_history(params: dict[str, Any], config: LlamaConfig,
         h = rms_norm(x, layer["ffn_norm"], config.norm_eps)
         x = x + _ffn(layer, h)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
+    if last_idx is not None:  # serving: one next-token row per request
+        x = x[jnp.arange(B), last_idx]
     logits = lm_logits(params, x)
     return logits, kv
 
@@ -290,12 +310,15 @@ def _history_attention(q: jax.Array, keys: jax.Array, values: jax.Array,
 
 def decode_step(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
                 positions: jax.Array, kv: PagedKVState, slot_ids: jax.Array,
-                seq_lens: jax.Array) -> tuple[jax.Array, PagedKVState]:
+                seq_lens: jax.Array, ctx_pages: int | None = None
+                ) -> tuple[jax.Array, PagedKVState]:
     """One decode step over the paged cache.
 
     tokens: [B] this step's input token per slot; positions: [B];
     slot_ids: [B] block-table rows; seq_lens: [B] tokens already in cache
-    (including this one after write). Returns (logits [B, vocab], kv).
+    (including this one after write); ctx_pages: STATIC context-width
+    bucket — attention reads only the first ctx_pages table columns (the
+    engine guarantees every active row fits). Returns (logits [B,V], kv).
     """
     B = tokens.shape[0]
     x = embed_rows(params["embed"], tokens)[:, None, :]  # [B,1,D]
@@ -309,13 +332,16 @@ def decode_step(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
             from ..ops.paged_attention import paged_decode_attention_pallas
             G = config.n_heads // config.n_kv_heads
             qg = q[:, 0].reshape(B, config.n_kv_heads, G, config.head_dim)
+            tables = kv.block_tables[slot_ids]
+            if ctx_pages is not None:
+                tables = tables[:, :ctx_pages]
             attn = paged_decode_attention_pallas(
                 qg, kv.k_pages[idx], kv.v_pages[idx],
-                kv.block_tables[slot_ids], seq_lens,
+                tables, seq_lens,
                 page_size=kv.page_size)
             attn = attn.reshape(B, 1, config.n_heads, config.head_dim)
         else:
-            keys, values = gather_kv(kv, idx, slot_ids)  # [B, C, KV, hd]
+            keys, values = gather_kv(kv, idx, slot_ids, ctx_pages)
             attn = _paged_decode_attention(q[:, 0], keys, values, seq_lens, config)
         x = x + qmm(attn.reshape(B, 1, -1), layer["wo"])
         h = rms_norm(x, layer["ffn_norm"], config.norm_eps)

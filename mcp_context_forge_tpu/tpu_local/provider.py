@@ -94,6 +94,225 @@ class OpenAICompatProvider(LLMProvider):
             return [d["embedding"] for d in data]
 
 
+class DialectProvider(LLMProvider):
+    """Per-family request translation onto non-OpenAI provider APIs
+    (reference `services/llm_proxy_service.py:203-441` builds requests per
+    provider family and `:659-860` transforms the responses back; the
+    gateway's own surface stays OpenAI-shaped either way).
+
+    Families: ``azure_openai`` (deployment URL + api-key header),
+    ``anthropic`` (/v1/messages, system extraction), ``ollama`` (native
+    /api/chat with options), ``bedrock`` (Converse API; bearer API-key
+    auth — SigV4 signing is the caller's proxy concern), ``google_vertex``
+    (:generateContent contents/parts), ``watsonx`` (/ml/v1/text/chat with
+    project_id). ``cohere``/``mistral``/``groq``/``together`` ride
+    OpenAICompatProvider unchanged, as they do in the reference.
+
+    config keys (per family): deployment, resource_name, api_version,
+    anthropic_version, project, location, project_id, auth_header.
+    """
+
+    def __init__(self, name: str, dialect: str, api_base: str = "",
+                 api_key: str = "", config: dict[str, Any] | None = None,
+                 timeout: float = 120.0):
+        if dialect not in ("azure_openai", "anthropic", "ollama", "bedrock",
+                          "google_vertex", "watsonx"):
+            raise LLMError(f"unknown provider dialect {dialect!r}")
+        self.name = name
+        self.provider_type = dialect
+        self.dialect = dialect
+        self.api_base = api_base.rstrip("/")
+        self.api_key = api_key
+        self.config = config or {}
+        self.timeout = timeout
+
+    # ------------------------------------------------------------- builders
+
+    def build_request(self, request: dict[str, Any]
+                      ) -> tuple[str, dict[str, str], dict[str, Any]]:
+        """OpenAI-shape request dict -> (url, headers, body) per family."""
+        return getattr(self, f"_build_{self.dialect}")(request)
+
+    @staticmethod
+    def _split_system(messages: list[dict[str, Any]]
+                      ) -> tuple[str, list[dict[str, Any]]]:
+        system, rest = [], []
+        for message in messages:
+            if message.get("role") == "system":
+                system.append(message.get("content") or "")
+            else:
+                rest.append(message)
+        return "\n".join(system), rest
+
+    def _build_azure_openai(self, request):
+        deployment = (self.config.get("deployment")
+                      or self.config.get("deployment_name")
+                      or request.get("model", ""))
+        api_version = self.config.get("api_version", "2024-02-15-preview")
+        base = self.api_base
+        if not base and self.config.get("resource_name"):
+            base = f"https://{self.config['resource_name']}.openai.azure.com"
+        url = (f"{base}/openai/deployments/{deployment}/chat/completions"
+               f"?api-version={api_version}")
+        headers = {"content-type": "application/json",
+                   "api-key": self.api_key}
+        body = {key: value for key, value in request.items()
+                if key not in ("model", "stream")}
+        return url, headers, body
+
+    def _build_anthropic(self, request):
+        url = f"{self.api_base or 'https://api.anthropic.com'}/v1/messages"
+        headers = {"content-type": "application/json",
+                   "x-api-key": self.api_key,
+                   "anthropic-version": self.config.get("anthropic_version",
+                                                        "2023-06-01")}
+        system, messages = self._split_system(request.get("messages", []))
+        body = {"model": request.get("model"),
+                "messages": [{"role": m["role"], "content": m.get("content") or ""}
+                             for m in messages],
+                "max_tokens": request.get("max_tokens") or 4096}
+        if system:
+            body["system"] = system
+        if request.get("temperature") is not None:
+            body["temperature"] = request["temperature"]
+        return url, headers, body
+
+    def _build_ollama(self, request):
+        url = f"{self.api_base or 'http://localhost:11434'}/api/chat"
+        body = {"model": request.get("model"),
+                "messages": [{"role": m["role"], "content": m.get("content") or ""}
+                             for m in request.get("messages", [])],
+                "stream": False}
+        options = {}
+        if request.get("temperature") is not None:
+            options["temperature"] = request["temperature"]
+        if request.get("max_tokens"):
+            options["num_predict"] = request["max_tokens"]
+        if options:
+            body["options"] = options
+        return url, {"content-type": "application/json"}, body
+
+    def _build_bedrock(self, request):
+        model_id = request.get("model", "")
+        url = f"{self.api_base}/model/{model_id}/converse"
+        headers = {"content-type": "application/json"}
+        if self.api_key:  # Bedrock API keys ride Authorization: Bearer
+            headers["authorization"] = f"Bearer {self.api_key}"
+        system, messages = self._split_system(request.get("messages", []))
+        body: dict[str, Any] = {
+            "messages": [{"role": m["role"],
+                          "content": [{"text": m.get("content") or ""}]}
+                         for m in messages]}
+        if system:
+            body["system"] = [{"text": system}]
+        inference: dict[str, Any] = {}
+        if request.get("max_tokens"):
+            inference["maxTokens"] = request["max_tokens"]
+        if request.get("temperature") is not None:
+            inference["temperature"] = request["temperature"]
+        if inference:
+            body["inferenceConfig"] = inference
+        return url, headers, body
+
+    def _build_google_vertex(self, request):
+        project = self.config.get("project", "")
+        location = self.config.get("location", "us-central1")
+        model = request.get("model", "")
+        url = (f"{self.api_base}/v1/projects/{project}/locations/{location}"
+               f"/publishers/google/models/{model}:generateContent")
+        headers = {"content-type": "application/json"}
+        if self.api_key:
+            headers["authorization"] = f"Bearer {self.api_key}"
+        system, messages = self._split_system(request.get("messages", []))
+        contents = [{"role": "model" if m["role"] == "assistant" else "user",
+                     "parts": [{"text": m.get("content") or ""}]}
+                    for m in messages]
+        body: dict[str, Any] = {"contents": contents}
+        if system:
+            body["systemInstruction"] = {"parts": [{"text": system}]}
+        generation: dict[str, Any] = {}
+        if request.get("max_tokens"):
+            generation["maxOutputTokens"] = request["max_tokens"]
+        if request.get("temperature") is not None:
+            generation["temperature"] = request["temperature"]
+        if generation:
+            body["generationConfig"] = generation
+        return url, headers, body
+
+    def _build_watsonx(self, request):
+        version = self.config.get("api_version", "2024-05-31")
+        url = f"{self.api_base}/ml/v1/text/chat?version={version}"
+        headers = {"content-type": "application/json"}
+        if self.api_key:
+            headers["authorization"] = f"Bearer {self.api_key}"
+        body = {"model_id": request.get("model"),
+                "project_id": self.config.get("project_id", ""),
+                "messages": request.get("messages", [])}
+        if request.get("max_tokens"):
+            body["max_tokens"] = request["max_tokens"]
+        if request.get("temperature") is not None:
+            body["temperature"] = request["temperature"]
+        return url, headers, body
+
+    # ----------------------------------------------------------- transforms
+
+    def transform_response(self, model: str,
+                           data: dict[str, Any]) -> dict[str, Any]:
+        """Provider-family response -> OpenAI ChatCompletionResponse."""
+        if self.dialect in ("azure_openai", "watsonx"):
+            # both answer OpenAI-shaped chat choices already
+            data.setdefault("model", model)
+            return data
+        if self.dialect == "anthropic":
+            text = "".join(block.get("text", "")
+                           for block in data.get("content", [])
+                           if block.get("type") == "text")
+            usage = data.get("usage", {})
+            out = make_chat_response(
+                model, text,
+                prompt_tokens=usage.get("input_tokens", 0),
+                completion_tokens=usage.get("output_tokens", 0),
+                finish_reason={"end_turn": "stop", "max_tokens": "length"}.get(
+                    data.get("stop_reason"), "stop"))
+            return out
+        if self.dialect == "ollama":
+            return make_chat_response(
+                model, (data.get("message") or {}).get("content", ""),
+                prompt_tokens=data.get("prompt_eval_count", 0),
+                completion_tokens=data.get("eval_count", 0),
+                finish_reason="stop" if data.get("done") else "length")
+        if self.dialect == "bedrock":
+            message = ((data.get("output") or {}).get("message") or {})
+            text = "".join(block.get("text", "")
+                           for block in message.get("content", []))
+            usage = data.get("usage", {})
+            return make_chat_response(
+                model, text,
+                prompt_tokens=usage.get("inputTokens", 0),
+                completion_tokens=usage.get("outputTokens", 0),
+                finish_reason={"end_turn": "stop", "max_tokens": "length"}.get(
+                    data.get("stopReason"), "stop"))
+        if self.dialect == "google_vertex":
+            candidates = data.get("candidates") or [{}]
+            parts = ((candidates[0].get("content") or {}).get("parts") or [])
+            text = "".join(part.get("text", "") for part in parts)
+            usage = data.get("usageMetadata", {})
+            return make_chat_response(
+                model, text,
+                prompt_tokens=usage.get("promptTokenCount", 0),
+                completion_tokens=usage.get("candidatesTokenCount", 0),
+                finish_reason={"STOP": "stop", "MAX_TOKENS": "length"}.get(
+                    candidates[0].get("finishReason"), "stop"))
+        raise LLMError(f"no transform for dialect {self.dialect!r}")
+
+    async def chat(self, request: dict[str, Any]) -> dict[str, Any]:
+        url, headers, body = self.build_request(request)
+        async with httpx.AsyncClient(timeout=self.timeout) as client:
+            resp = await client.post(url, json=body, headers=headers)
+            resp.raise_for_status()
+            return self.transform_response(request.get("model", ""), resp.json())
+
+
 class LLMProviderRegistry:
     """model alias -> provider resolution + lifecycle."""
 

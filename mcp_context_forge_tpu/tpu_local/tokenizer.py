@@ -25,21 +25,55 @@ class Tokenizer(Protocol):
 
 
 class ByteTokenizer:
-    """ids 0..255 = bytes; 256=bos, 257=eos, 258=pad."""
+    """ids 0..255 = bytes; 256=bos, 257=eos, 258=pad; 259+ = chat-template
+    markers. The markers encode as ONE token each — exactly how the real
+    Llama-3 BPE treats its special tokens — otherwise every chat turn pays
+    ~90 extra byte-tokens of template scaffolding, which on the tiny CPU
+    proxies dominates prefill compute (3x the user content)."""
+
+    SPECIALS = ("<|start_header_id|>", "<|end_header_id|>", "<|eot_id|>")
 
     def __init__(self, vocab_size: int = 512):
         self.vocab_size = vocab_size
         self.bos_id = 256
         self.eos_id = 257
         self.pad_id = 258
+        self._special_ids = {tok: 259 + i
+                             for i, tok in enumerate(self.SPECIALS)}
+        self._id_specials = {i: tok for tok, i in self._special_ids.items()}
 
     def encode(self, text: str, add_bos: bool = True) -> list[int]:
-        ids = list(text.encode("utf-8", errors="replace"))
-        return ([self.bos_id] + ids) if add_bos else ids
+        ids: list[int] = [self.bos_id] if add_bos else []
+        i = 0
+        while i < len(text):
+            for tok, tid in self._special_ids.items():
+                if text.startswith(tok, i):
+                    ids.append(tid)
+                    i += len(tok)
+                    break
+            else:
+                # longest run of plain text until the next special
+                nxt = min((text.find(t, i) for t in self.SPECIALS
+                           if text.find(t, i) != -1), default=len(text))
+                ids.extend(text[i:nxt].encode("utf-8", errors="replace"))
+                i = nxt
+        return ids
 
     def decode(self, ids: list[int]) -> str:
-        data = bytes(i for i in ids if 0 <= i < 256)
-        return data.decode("utf-8", errors="replace")
+        out: list[str] = []
+        run: list[int] = []
+        for i in ids:
+            if 0 <= i < 256:
+                run.append(i)
+            else:
+                if run:
+                    out.append(bytes(run).decode("utf-8", errors="replace"))
+                    run = []
+                # specials are dropped from decoded text (HF parity:
+                # skip_special_tokens=True)
+        if run:
+            out.append(bytes(run).decode("utf-8", errors="replace"))
+        return "".join(out)
 
 
 class HFTokenizer:

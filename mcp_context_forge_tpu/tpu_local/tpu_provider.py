@@ -9,7 +9,9 @@ embeddings + harm classification for the LLM-backed plugins.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import time
+from collections import OrderedDict
 from typing import Any, AsyncIterator
 
 import jax
@@ -125,6 +127,12 @@ class TPULocalProvider(LLMProvider):
         self.classify_window = 128
         self.classify_coverage = "full"
         self.classify_max_windows = 8
+        # verdict cache: the classifier is a pure function of (params, text)
+        # and params are fixed for the provider's lifetime, so identical
+        # text MUST score identically — moderation of repeated tool
+        # outputs/templates skips the encoder entirely (LRU-bounded)
+        self._classify_cache: "OrderedDict[tuple, float]" = OrderedDict()
+        self.classify_cache_size = 8192
 
     # ------------------------------------------------------------------ chat
 
@@ -263,8 +271,18 @@ class TPULocalProvider(LLMProvider):
         windows only)."""
         coverage = coverage or self.classify_coverage
         W = self.classify_window
+        cached: dict[int, float] = {}
+        keys: dict[int, tuple] = {}
         jobs: list[tuple[int, list[int]]] = []   # (text index, window ids)
         for i, text in enumerate(texts):
+            key = (hashlib.sha256(text.encode()).digest(), coverage, W,
+                   self.classify_max_windows)
+            hit = self._classify_cache.get(key)
+            if hit is not None:
+                self._classify_cache.move_to_end(key)
+                cached[i] = hit
+                continue
+            keys[i] = key
             ids = self._tokenize(text)
             if len(ids) <= W:
                 jobs.append((i, ids))
@@ -290,6 +308,12 @@ class TPULocalProvider(LLMProvider):
             probs = np.exp(logits - logits.max())
             probs = probs / probs.sum()
             scores[i] = max(scores[i], float(probs[1]))
+        for i, score in cached.items():
+            scores[i] = score
+        for i, key in keys.items():
+            self._classify_cache[key] = scores[i]
+            while len(self._classify_cache) > self.classify_cache_size:
+                self._classify_cache.popitem(last=False)
         return scores
 
     async def warmup(self) -> None:

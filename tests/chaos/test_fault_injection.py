@@ -37,12 +37,14 @@ def test_engine_crash_fails_fast_and_recovers():
         assert out
 
         # inject: decode dispatch raises -> dispatch thread dies
-        real_decode = engine._decode
+        real_decode_fn = engine._decode_fn
 
-        def boom(*args, **kwargs):
-            raise RuntimeError("injected device fault")
+        def boom_fn(ctx_pages):
+            def boom(*args, **kwargs):
+                raise RuntimeError("injected device fault")
+            return boom
 
-        engine._decode = boom
+        engine._decode_fn = boom_fn
         broken = [t async for t in engine.generate(ids, max_tokens=4)]
         # stream terminated (no hang); prefill token may have been emitted
         assert len(broken) <= 1
@@ -53,7 +55,7 @@ def test_engine_crash_fails_fast_and_recovers():
             await engine.submit(GenRequest(request_id="x", prompt_ids=ids))
 
         # recovery: restart the dispatch thread with the fault removed
-        engine._decode = real_decode
+        engine._decode_fn = real_decode_fn
         await engine.stop()
         await engine.start()
         healed = [t async for t in engine.generate(ids, max_tokens=3)]

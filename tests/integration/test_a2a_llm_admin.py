@@ -105,9 +105,13 @@ async def test_llm_provider_admin_crud():
         models = await resp.json()
         assert models[0]["alias"] == "ollama-llama3"
 
-        # invalid provider type
+        # watsonx is a real dialect now (DialectProvider); an unknown
+        # type still 422s
         resp = await gateway.post("/llm/providers", json={
-            "name": "x", "provider_type": "watsonx"}, auth=AUTH)
+            "name": "wx", "provider_type": "watsonx"}, auth=AUTH)
+        assert resp.status == 201
+        resp = await gateway.post("/llm/providers", json={
+            "name": "x", "provider_type": "smoke-signals"}, auth=AUTH)
         assert resp.status == 422
     finally:
         await gateway.close()

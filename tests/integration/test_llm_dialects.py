@@ -1,0 +1,222 @@
+"""LLM provider translation families (round-2 VERDICT missing #3).
+
+DialectProvider builds per-family requests and transforms responses back
+to OpenAI shape (reference `services/llm_proxy_service.py:203-441`,
+`:659-860`); stub provider servers assert the wire format each family
+actually receives.
+"""
+
+import json
+
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from mcp_context_forge_tpu.tpu_local.provider import DialectProvider, LLMError
+
+MESSAGES = [{"role": "system", "content": "be terse"},
+            {"role": "user", "content": "hi"}]
+
+
+async def _stub(handler, route: str):
+    app = web.Application()
+    app.router.add_post(route, handler)
+    app["seen"] = {}
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def _base(client) -> str:
+    return f"http://{client.server.host}:{client.server.port}"
+
+
+async def test_azure_openai_dialect():
+    async def handler(request):
+        request.app["seen"] = {
+            "path": request.path_qs, "api_key": request.headers.get("api-key"),
+            "body": await request.json()}
+        return web.json_response({
+            "id": "cmpl-1", "object": "chat.completion", "created": 1,
+            "choices": [{"index": 0, "message": {"role": "assistant",
+                                                 "content": "azure says hi"},
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 3, "completion_tokens": 4,
+                      "total_tokens": 7}})
+
+    stub = await _stub(handler,
+                       "/openai/deployments/my-dep/chat/completions")
+    try:
+        provider = DialectProvider(
+            "az", "azure_openai", api_base=_base(stub), api_key="azkey",
+            config={"deployment": "my-dep", "api_version": "2024-06-01"})
+        out = await provider.chat({"model": "gpt-4o", "messages": MESSAGES,
+                                   "max_tokens": 16, "temperature": 0.2})
+        seen = stub.app["seen"]
+        assert "api-version=2024-06-01" in seen["path"]
+        assert seen["api_key"] == "azkey"
+        assert "model" not in seen["body"]  # deployment URL carries it
+        assert out["choices"][0]["message"]["content"] == "azure says hi"
+    finally:
+        await stub.close()
+
+
+async def test_anthropic_dialect():
+    async def handler(request):
+        request.app["seen"] = {
+            "x_api_key": request.headers.get("x-api-key"),
+            "version": request.headers.get("anthropic-version"),
+            "body": await request.json()}
+        return web.json_response({
+            "content": [{"type": "text", "text": "claude says hi"}],
+            "stop_reason": "end_turn",
+            "usage": {"input_tokens": 5, "output_tokens": 6}})
+
+    stub = await _stub(handler, "/v1/messages")
+    try:
+        provider = DialectProvider("an", "anthropic", api_base=_base(stub),
+                                   api_key="akey")
+        out = await provider.chat({"model": "claude-3", "messages": MESSAGES,
+                                   "max_tokens": 32})
+        seen = stub.app["seen"]
+        assert seen["x_api_key"] == "akey"
+        assert seen["version"] == "2023-06-01"
+        assert seen["body"]["system"] == "be terse"       # system extracted
+        assert all(m["role"] != "system" for m in seen["body"]["messages"])
+        assert out["choices"][0]["message"]["content"] == "claude says hi"
+        assert out["usage"]["prompt_tokens"] == 5
+        assert out["choices"][0]["finish_reason"] == "stop"
+    finally:
+        await stub.close()
+
+
+async def test_ollama_native_dialect():
+    async def handler(request):
+        request.app["seen"] = {"body": await request.json()}
+        return web.json_response({
+            "message": {"role": "assistant", "content": "llama says hi"},
+            "done": True, "prompt_eval_count": 2, "eval_count": 3})
+
+    stub = await _stub(handler, "/api/chat")
+    try:
+        provider = DialectProvider("ol", "ollama", api_base=_base(stub))
+        out = await provider.chat({"model": "llama3", "messages": MESSAGES,
+                                   "temperature": 0.5, "max_tokens": 8})
+        body = stub.app["seen"]["body"]
+        assert body["options"] == {"temperature": 0.5, "num_predict": 8}
+        assert body["stream"] is False
+        assert out["choices"][0]["message"]["content"] == "llama says hi"
+        assert out["usage"]["completion_tokens"] == 3
+    finally:
+        await stub.close()
+
+
+async def test_bedrock_converse_dialect():
+    async def handler(request):
+        request.app["seen"] = {
+            "auth": request.headers.get("authorization"),
+            "body": await request.json()}
+        return web.json_response({
+            "output": {"message": {"role": "assistant",
+                                   "content": [{"text": "titan says hi"}]}},
+            "stopReason": "max_tokens",
+            "usage": {"inputTokens": 7, "outputTokens": 8}})
+
+    stub = await _stub(handler, "/model/my.model-id/converse")
+    try:
+        provider = DialectProvider("br", "bedrock", api_base=_base(stub),
+                                   api_key="bearer-key")
+        out = await provider.chat({"model": "my.model-id",
+                                   "messages": MESSAGES, "max_tokens": 16})
+        seen = stub.app["seen"]
+        assert seen["auth"] == "Bearer bearer-key"
+        assert seen["body"]["system"] == [{"text": "be terse"}]
+        assert seen["body"]["messages"][0]["content"] == [{"text": "hi"}]
+        assert seen["body"]["inferenceConfig"]["maxTokens"] == 16
+        assert out["choices"][0]["message"]["content"] == "titan says hi"
+        assert out["choices"][0]["finish_reason"] == "length"
+    finally:
+        await stub.close()
+
+
+async def test_google_vertex_dialect():
+    async def handler(request):
+        request.app["seen"] = {"body": await request.json()}
+        return web.json_response({
+            "candidates": [{"content": {"parts": [{"text": "gemini says hi"}]},
+                            "finishReason": "STOP"}],
+            "usageMetadata": {"promptTokenCount": 9,
+                              "candidatesTokenCount": 10}})
+
+    stub = await _stub(
+        handler, "/v1/projects/my-proj/locations/us-central1/publishers/"
+                 "google/models/gemini-pro:generateContent")
+    try:
+        provider = DialectProvider("gv", "google_vertex", api_base=_base(stub),
+                                   api_key="gv-token",
+                                   config={"project": "my-proj"})
+        out = await provider.chat({"model": "gemini-pro",
+                                   "messages": MESSAGES, "max_tokens": 20})
+        body = stub.app["seen"]["body"]
+        assert body["systemInstruction"] == {"parts": [{"text": "be terse"}]}
+        assert body["contents"][0] == {"role": "user",
+                                       "parts": [{"text": "hi"}]}
+        assert body["generationConfig"]["maxOutputTokens"] == 20
+        assert out["choices"][0]["message"]["content"] == "gemini says hi"
+        assert out["usage"]["prompt_tokens"] == 9
+    finally:
+        await stub.close()
+
+
+async def test_watsonx_dialect():
+    async def handler(request):
+        request.app["seen"] = {"path": request.path_qs,
+                               "body": await request.json()}
+        return web.json_response({
+            "model": "granite", "object": "chat.completion", "created": 1,
+            "id": "wx-1",
+            "choices": [{"index": 0, "message": {"role": "assistant",
+                                                 "content": "granite says hi"},
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 1, "completion_tokens": 2,
+                      "total_tokens": 3}})
+
+    stub = await _stub(handler, "/ml/v1/text/chat")
+    try:
+        provider = DialectProvider("wx", "watsonx", api_base=_base(stub),
+                                   api_key="wx-token",
+                                   config={"project_id": "proj-1"})
+        out = await provider.chat({"model": "granite", "messages": MESSAGES})
+        seen = stub.app["seen"]
+        assert "version=2024-05-31" in seen["path"]
+        assert seen["body"]["model_id"] == "granite"
+        assert seen["body"]["project_id"] == "proj-1"
+        assert out["choices"][0]["message"]["content"] == "granite says hi"
+    finally:
+        await stub.close()
+
+
+def test_unknown_dialect_rejected():
+    import pytest
+
+    with pytest.raises(LLMError):
+        DialectProvider("x", "smoke-signals")
+
+
+async def test_provider_service_wires_dialects():
+    """CRUD a bedrock provider row -> registry resolves its model alias to
+    a DialectProvider (llm_provider_service._wire_provider)."""
+    from tests.integration.test_gateway_app import make_client
+
+    gateway = await make_client()
+    try:
+        service = gateway.app["ctx"].extras["llm_provider_service"]
+        row = await service.create_provider(
+            "bedrock-east", "bedrock", api_base="http://127.0.0.1:9",
+            config={"api_key": "k"})
+        await service.add_model(row["id"], "anthropic.claude-v2", "claude-v2")
+        provider, model = service.registry.resolve("claude-v2")
+        assert isinstance(provider, DialectProvider)
+        assert provider.dialect == "bedrock"
+        assert model == "claude-v2"
+    finally:
+        await gateway.close()

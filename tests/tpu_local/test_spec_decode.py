@@ -143,7 +143,7 @@ def test_accept_loop_emits_confirmed_drafts_deterministically():
         captured["tokens"] = np.asarray(tokens)
         return jnp.asarray(np.asarray(tokens) + 1), kv
 
-    engine._verify = fake_verify
+    engine._verify_fn = lambda ctx_pages: fake_verify
     engine._spec_step_all()
 
     # chunk = [t0=6, d1=7, d2=5, d3=6]; s = [7, 8, 6, 7]

@@ -134,3 +134,56 @@ async def test_hub_rejects_bad_secret():
         await good.stop()
     finally:
         await hub.stop()
+
+
+async def test_hub_restart_under_load():
+    """Round-2 VERDICT weak #8: hub death partitions coordination — verify
+    the documented recovery contract UNDER LOAD: publishers keep running
+    (downtime messages drop, no hangs/crashes), subscribers resubscribe,
+    and leases — hub-memory state — are re-acquirable after restart."""
+    hub, (c1, c2) = await _hub_and_clients()
+    leases = TcpLeaseManager(c1)
+    bus2 = TcpEventBus(c2)
+    got = []
+    bus2.subscribe("load", lambda t, m: _collect(got, m))
+    await asyncio.sleep(0.05)
+    assert await leases.acquire("job", "w1", ttl=30)
+
+    stop = asyncio.Event()
+    sent = {"n": 0}
+
+    async def publisher():
+        while not stop.is_set():
+            try:
+                c1.publish("load", {"n": sent["n"]})
+                sent["n"] += 1
+            except ConnectionError:
+                pass  # fail-fast contract during the partition
+            await asyncio.sleep(0.02)
+
+    task = asyncio.ensure_future(publisher())
+    try:
+        await asyncio.sleep(0.2)          # healthy traffic flowing
+        assert got, "no messages before restart"
+        port = hub.bound_port
+        await hub.stop()
+        await asyncio.sleep(0.3)          # load continues against dead hub
+        # lease ops fail closed during the partition (False, never a hang
+        # or a split-brain True)
+        assert not await asyncio.wait_for(
+            leases.acquire("job2", "w1", ttl=5), 2.0)
+        hub2 = CoordinationHub("127.0.0.1", port)
+        await hub2.start()
+        await asyncio.sleep(0.8)          # reconnect backoff + resubscribe
+        before = len(got)
+        await asyncio.sleep(0.4)
+        assert len(got) > before, "stream did not resume after restart"
+        # hub state is memory-only: the lease is gone; holder re-acquires
+        assert await leases.acquire("job", "w1", ttl=30)
+        await hub2.stop()
+    finally:
+        stop.set()
+        await task
+        await bus2.stop()
+        await c1.stop()
+        await c2.stop()
