@@ -106,6 +106,18 @@ class Settings(BaseModel):
     gateway_failure_threshold: int = 3
     federation_timeout: float = 30.0
     skip_ssl_verify: bool = False
+    # upstream MCP session pooling (reference session registry caps)
+    upstream_max_sessions: int = 128
+    upstream_idle_ttl: float = 300.0
+    # external (out-of-process) plugin servers
+    external_plugin_timeout: float = 10.0
+
+    # --- account lockout (reference email_auth lockout policy) ---
+    auth_max_failed_attempts: int = 5
+    auth_lockout_seconds: float = 300.0
+
+    # --- admin log search ring buffer ---
+    log_buffer_capacity: int = 5000
 
     # --- plugins ---
     plugins_enabled: bool = True
@@ -168,6 +180,10 @@ class Settings(BaseModel):
     # scales, dequant fused into the matmul; halves HBM footprint+traffic
     # (how Llama-3-8B fits one 16 GB v5e chip)
     tpu_local_quant: str = ""
+    # decode batch-width bucketing (+ slot compaction, shrink hysteresis):
+    # size decode dispatches by active load — enable for latency-sensitive
+    # low-concurrency serving; bursty full loads prefer fixed max_batch
+    tpu_local_batch_buckets: bool = False
     # moderation classify granularity: texts longer than the window are
     # scored over fixed windows (max-pooled) — 'full' strides the whole
     # text (bounded by max_windows; the default covers 1024 tokens, a
@@ -176,6 +192,12 @@ class Settings(BaseModel):
     tpu_local_classify_window: int = 128
     tpu_local_classify_coverage: str = "full"
     tpu_local_classify_max_windows: int = 8
+    tpu_local_classify_cache_size: int = 8192
+    # encoder microbatch coalescing (embed/classify traffic)
+    tpu_local_encoder_max_batch: int = 32
+    tpu_local_encoder_max_wait_ms: float = 2.0
+    # engine admission queue bound (backpressure past this)
+    tpu_local_max_queue: int = 1024
 
     # --- header passthrough (reference config.py:3489-3499: off by
     # default for security; sensitive headers need per-gateway opt-in) ---

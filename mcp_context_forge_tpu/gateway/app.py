@@ -36,7 +36,8 @@ logger = logging.getLogger(__name__)
 
 async def build_app(settings: Settings | None = None) -> web.Application:
     settings = settings or get_settings()
-    init_logging(settings.log_level, settings.log_json)
+    init_logging(settings.log_level, settings.log_json,
+                 buffer_capacity=settings.log_buffer_capacity)
 
     problems = settings.validate_security()
     if problems:
@@ -131,7 +132,9 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     from ..services.llm_provider_service import LLMProviderService
     from ..services.sampling_service import CompletionService, SamplingHandler
     from ..services.upstream_sessions import UpstreamSessionRegistry
-    upstream_sessions = UpstreamSessionRegistry(ctx)
+    upstream_sessions = UpstreamSessionRegistry(
+        ctx, max_sessions=settings.upstream_max_sessions,
+        idle_ttl=settings.upstream_idle_ttl)
     ctx.extras["upstream_sessions"] = upstream_sessions
     auth_service = AuthService(ctx)
     tool_service = ToolService(ctx)
@@ -164,12 +167,16 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         from ..tpu_local.server import setup_llm_routes
         from ..tpu_local.tpu_provider import TPULocalProvider
         engine = TPUEngine(EngineConfig.from_settings(settings))
-        provider = TPULocalProvider("tpu_local", engine,
-                                    embedding_model=settings.tpu_local_embedding_model,
-                                    tracer=tracer, metrics=metrics)
+        provider = TPULocalProvider(
+            "tpu_local", engine,
+            embedding_model=settings.tpu_local_embedding_model,
+            tracer=tracer, metrics=metrics,
+            encoder_max_batch=settings.tpu_local_encoder_max_batch,
+            encoder_max_wait_ms=settings.tpu_local_encoder_max_wait_ms)
         provider.classify_window = settings.tpu_local_classify_window
         provider.classify_coverage = settings.tpu_local_classify_coverage
         provider.classify_max_windows = settings.tpu_local_classify_max_windows
+        provider.classify_cache_size = settings.tpu_local_classify_cache_size
         registry = LLMProviderRegistry()
         registry.register(provider, [settings.tpu_local_model, "tpu_local"],
                           default_chat=True, default_embed=True)

@@ -59,9 +59,13 @@ class RingBufferHandler(logging.Handler):
 ring_buffer = RingBufferHandler()
 
 
-def init_logging(level: str = "INFO", as_json: bool = False) -> None:
+def init_logging(level: str = "INFO", as_json: bool = False,
+                 buffer_capacity: int | None = None) -> None:
     root = logging.getLogger()
     root.setLevel(level.upper())
+    if buffer_capacity and buffer_capacity != ring_buffer.records.maxlen:
+        ring_buffer.records = collections.deque(ring_buffer.records,
+                                                maxlen=buffer_capacity)
     if not any(isinstance(h, RingBufferHandler) for h in root.handlers):
         root.addHandler(ring_buffer)
     stream = next((h for h in root.handlers if isinstance(h, logging.StreamHandler)

@@ -186,10 +186,12 @@ class ExternalPlugin(Plugin):
         command = config.config.get("command")
         if not command:
             raise ValueError(f"external plugin {config.name}: 'command' required")
+        default_timeout = getattr(
+            getattr(ctx, "settings", None), "external_plugin_timeout", 10.0)
         self._proc = StdioPluginProcess(
             list(command), cwd=config.config.get("cwd"),
             env=config.config.get("env"),
-            timeout_s=float(config.config.get("timeout_s", 10.0)))
+            timeout_s=float(config.config.get("timeout_s", default_timeout)))
         self._hooks: set[str] = set()
 
     async def initialize(self) -> None:

@@ -202,7 +202,10 @@ class AuthService:
             # lockout must not instantly re-lock the account
             prior = 0 if lock_expired else row["failed_login_attempts"]
             attempts = prior + 1
-            locked_until = now() + 300 if attempts >= 5 else None
+            settings = self.ctx.settings
+            locked_until = (
+                now() + settings.auth_lockout_seconds
+                if attempts >= settings.auth_max_failed_attempts else None)
             await self.ctx.db.execute(
                 "UPDATE users SET failed_login_attempts=?, locked_until=? WHERE email=?",
                 (attempts, locked_until, email))

@@ -97,6 +97,13 @@ class EngineConfig:
     # resident param footprint AND the per-step HBM traffic (quantize.py;
     # how Llama-3-8B fits a single 16 GB v5e chip)
     quant: str = ""
+    # decode batch-width bucketing: size decode arrays by the ACTIVE slot
+    # ceiling (pow-2, with slot compaction + shrink hysteresis) instead of
+    # max_batch. Wins on sparse/steady loads (fewer wasted rows per step);
+    # loses on bursty full loads — every width change re-homes the donated
+    # KV pool (~a pool copy). Off by default; enable for latency-sensitive
+    # low-concurrency serving.
+    batch_buckets: bool = False
 
     @classmethod
     def from_settings(cls, settings) -> "EngineConfig":
@@ -122,6 +129,8 @@ class EngineConfig:
             spec_k=getattr(settings, "tpu_local_spec_k", 4),
             spec_ngram=getattr(settings, "tpu_local_spec_ngram", 2),
             quant=getattr(settings, "tpu_local_quant", ""),
+            batch_buckets=getattr(settings, "tpu_local_batch_buckets", False),
+            max_queue=getattr(settings, "tpu_local_max_queue", 1024),
         )
 
 
@@ -257,6 +266,10 @@ class TPUEngine:
         self._stop_event = threading.Event()
         self._loop: asyncio.AbstractEventLoop | None = None
         self._started = False
+        # decode batch-width hysteresis state (see _decode_step_all)
+        self._batch_width = min(8, config.max_batch)
+        self._shrink_streak = 0
+        self._shrink_peak = 0
 
         dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
         devices = probe_devices(config.init_timeout_s)
@@ -325,11 +338,12 @@ class TPUEngine:
             jax.jit(partial(self._prefill_and_sample, sp=True),
                     donate_argnames=("kv",))
             if config.sp_impl != "none" else None)
-        # decode compiles per context-width bucket (pow-2 pages): attention
-        # reads only the table columns the longest active row needs — the
-        # full-width gather wastes ~max_context/actual_context x HBM
-        # bandwidth on short conversations, and decode is bandwidth-bound
-        self._decode_fns: dict[int, Any] = {}
+        # decode compiles per (batch-width, context-width) bucket pair:
+        # attention reads only the table columns the longest active row
+        # needs — the full-width gather wastes ~max_context/actual_context
+        # x HBM bandwidth on short conversations, and decode is
+        # bandwidth-bound
+        self._decode_fns: dict[tuple[int, int], Any] = {}
         # the chunk/history prefill is a core primitive (prefix-cache hits
         # AND chunked prefill of prompts longer than the largest bucket);
         # compiled per context-width bucket like decode (a hit with 40
@@ -360,13 +374,54 @@ class TPUEngine:
                 return bucket
         return self._ctx_buckets()[-1]
 
-    def _decode_fn(self, ctx_pages: int):
-        fn = self._decode_fns.get(ctx_pages)
+    def _batch_buckets(self) -> list[int]:
+        """Decode batch-width buckets: powers of two from 8 (or max_batch
+        if smaller) up to max_batch. Decode dispatches size their arrays
+        by the ACTIVE slot ceiling, not configured capacity — with slot
+        compaction (below) a half-idle engine stops paying attention and
+        sampling FLOPs for empty slots."""
+        buckets = []
+        width = min(8, self.config.max_batch)
+        while width < self.config.max_batch:
+            buckets.append(width)
+            width *= 2
+        buckets.append(self.config.max_batch)
+        return buckets
+
+    def _batch_bucket_for(self, active_ceiling: int) -> int:
+        for bucket in self._batch_buckets():
+            if bucket >= active_ceiling:
+                return bucket
+        return self.config.max_batch
+
+    def _decode_fn(self, ctx_pages: int, batch: int | None = None):
+        key = (batch or self.config.max_batch, ctx_pages)
+        fn = self._decode_fns.get(key)
         if fn is None:
             fn = jax.jit(partial(self._decode_and_sample, ctx_pages=ctx_pages),
                          donate_argnames=("kv",))
-            self._decode_fns[ctx_pages] = fn
+            self._decode_fns[key] = fn
         return fn
+
+    def _compact_slots(self) -> None:
+        """Move the highest-slot requests into the lowest free slots so the
+        active ceiling equals the active COUNT. Only block-table rows move
+        (pages are slot-agnostic); the device table refreshes on the next
+        _sync_tables. Runs between dispatches on the dispatch thread."""
+        if not self._running:
+            return
+        count = len(self._running)
+        for slot in sorted(self._running, reverse=True):
+            if slot < count:
+                break  # already compact below the ceiling
+            target = min(s for s in range(self.config.max_batch)
+                         if s not in self._running)
+            if target >= slot:
+                break
+            request = self._running.pop(slot)
+            self.allocator.move_slot(slot, target)
+            request.slot = target
+            self._running[target] = request
 
     def _hist_ctx_buckets(self) -> list[int]:
         """Context-width buckets for the history/chunk prefill: one per
@@ -467,15 +522,23 @@ class TPUEngine:
             # plain decode is always live: spec engines fall back to it on
             # steps where no greedy row would draft (width-K verify would be
             # pure compute waste — round-2 ADVICE low). One compile per
-            # context-width bucket.
+            # (batch-width, context-width) bucket pair.
             # seq_lens=0: every slot is "inactive", writes masked to trash
-            for ctx_pages in self._ctx_buckets():
-                block, self.kv = self._decode_fn(ctx_pages)(
-                    self.params, self.kv, jnp.zeros((B,), jnp.int32),
-                    jnp.zeros((B,), jnp.int32), jnp.arange(B, dtype=jnp.int32),
-                    jnp.zeros((B,), jnp.int32), samp, jax.random.PRNGKey(0))
-                block.block_until_ready()
-                shapes += 1
+            widths = (self._batch_buckets() if self.config.batch_buckets
+                      else [self.config.max_batch])
+            for batch in widths:
+                bsamp = SamplingParams(jnp.zeros((batch,), jnp.float32),
+                                       jnp.zeros((batch,), jnp.int32),
+                                       jnp.ones((batch,), jnp.float32))
+                for ctx_pages in self._ctx_buckets():
+                    block, self.kv = self._decode_fn(ctx_pages, batch)(
+                        self.params, self.kv, jnp.zeros((batch,), jnp.int32),
+                        jnp.zeros((batch,), jnp.int32),
+                        jnp.arange(batch, dtype=jnp.int32),
+                        jnp.zeros((batch,), jnp.int32), bsamp,
+                        jax.random.PRNGKey(0))
+                    block.block_until_ready()
+                    shapes += 1
         logger.info("tpu_local warmup: %d shapes compiled in %.1fs",
                     shapes, time.monotonic() - started)
 
@@ -1038,9 +1101,38 @@ class TPUEngine:
     # ------------------------------------------------------------ decode step
 
     def _decode_step_all(self) -> None:
-        """One fixed-shape decode step over every active slot."""
+        """One fixed-shape decode step over every active slot. The batch
+        width is the power-of-two bucket covering the ACTIVE slot ceiling
+        (slots are compacted first), so a lightly loaded engine doesn't
+        pay full-capacity attention/sampling per step."""
         config = self.config
-        B = config.max_batch
+        if config.batch_buckets:
+            self._compact_slots()
+            # Hysteresis on the width: switching executables makes XLA
+            # re-home the donated KV pool (~a full pool copy), so width
+            # changes must be RARE. Grow immediately (correctness: arrays
+            # must cover the active ceiling); shrink only after the smaller
+            # width has sufficed for a sustained streak (load genuinely
+            # dropped, not an inter-wave dip).
+            desired = self._batch_bucket_for(max(self._running) + 1)
+            if desired >= self._batch_width:
+                self._batch_width = desired
+                self._shrink_streak = 0
+                self._shrink_peak = 0
+            else:
+                self._shrink_streak += 1
+                # shrink to the PEAK desired width seen over the streak, not
+                # the instantaneous one — a momentary dip must not trigger
+                # an over-shrink followed by an immediate re-grow (each
+                # width change re-homes the donated KV pool)
+                self._shrink_peak = max(self._shrink_peak, desired)
+                if self._shrink_streak >= 16:
+                    self._batch_width = self._shrink_peak
+                    self._shrink_streak = 0
+                    self._shrink_peak = 0
+            B = self._batch_width
+        else:
+            B = config.max_batch
         tokens = np.zeros((B,), dtype=np.int32)
         positions = np.zeros((B,), dtype=np.int32)
         seq_lens = np.zeros((B,), dtype=np.int32)
@@ -1085,7 +1177,7 @@ class TPUEngine:
         # (seq_lens counts the incoming token; k-1 more may be written)
         started = time.monotonic()
         ctx_pages = self._ctx_bucket_for(int(seq_lens.max()) + k)
-        block_tokens, self.kv = self._decode_fn(ctx_pages)(
+        block_tokens, self.kv = self._decode_fn(ctx_pages, B)(
             self.params, self.kv, jnp.asarray(tokens), jnp.asarray(positions),
             jnp.arange(B, dtype=jnp.int32), jnp.asarray(seq_lens), sampling, key)
         self.stats.decode_steps += k

@@ -280,6 +280,14 @@ class PageAllocator:
         self._slots[slot] = pages
         return True
 
+    def move_slot(self, old: int, new: int) -> None:
+        """Reassign a slot's pages to another (free) slot id — pages are
+        slot-agnostic, so compaction moves only this mapping (the device
+        block table refreshes from tables())."""
+        assert new not in self._slots, f"slot {new} occupied"
+        if old in self._slots:
+            self._slots[new] = self._slots.pop(old)
+
     def free_slot(self, slot: int) -> None:
         for page in reversed(self._slots.pop(slot, [])):
             self._release_page(page)

@@ -106,7 +106,9 @@ class TPULocalProvider(LLMProvider):
 
     def __init__(self, name: str, engine: TPUEngine,
                  embedding_model: str = "encoder-tiny",
-                 tracer=None, metrics=None):
+                 tracer=None, metrics=None,
+                 encoder_max_batch: int = 32,
+                 encoder_max_wait_ms: float = 2.0):
         self.name = name
         self.engine = engine
         self.tracer = tracer
@@ -120,7 +122,9 @@ class TPULocalProvider(LLMProvider):
         self._encode = jax.jit(
             lambda params, tokens, mask: encoder_forward(
                 params, self.encoder_config, tokens, mask))
-        self._batcher = _EncoderBatcher(self._encode_batch)
+        self._batcher = _EncoderBatcher(self._encode_batch,
+                                        max_batch=encoder_max_batch,
+                                        max_wait_ms=encoder_max_wait_ms)
         # moderation scoring granularity (see classify()): default "full"
         # covers max_windows*window = 1024 tokens — a superset of the old
         # single-row 512-token scan, never a detection regression

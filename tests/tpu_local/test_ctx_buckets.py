@@ -1,0 +1,121 @@
+"""Context-width bucketing correctness (ADR 010).
+
+The engine compiles decode/history-prefill per power-of-two context-width
+bucket and slices the block table to it. These tests pin the invariants
+that make that safe: bucket selection always covers the longest active
+row (including mid-block growth), and generations that CROSS bucket
+boundaries are bit-identical to a full-width engine.
+"""
+
+import asyncio
+
+from mcp_context_forge_tpu.tpu_local.engine import EngineConfig, TPUEngine
+
+
+def _engine(**overrides) -> TPUEngine:
+    base = dict(model="llama3-test", max_batch=2, max_seq_len=256,
+                page_size=16, num_pages=64, prefill_buckets=(32,),
+                dtype="float32", attn_impl="reference", prefix_cache=False)
+    base.update(overrides)
+    return TPUEngine(EngineConfig(**base))
+
+
+def _greedy(engine: TPUEngine, prompt: list[int], max_tokens: int) -> list[int]:
+    async def run():
+        await engine.start()
+        try:
+            out = []
+            async for tok in engine.generate(prompt, max_tokens=max_tokens):
+                out.append(tok)
+            return out
+        finally:
+            await engine.stop()
+
+    return asyncio.run(run())
+
+
+def test_bucket_selection_covers_need():
+    engine = _engine()
+    # max_seq_len 256 / page 16 = 16 pages; buckets 4, 8, 16
+    assert engine._ctx_buckets() == [4, 8, 16]
+    assert engine._ctx_bucket_for(1) == 4
+    assert engine._ctx_bucket_for(64) == 4      # exactly 4 pages
+    assert engine._ctx_bucket_for(65) == 8      # crosses into page 5
+    assert engine._ctx_bucket_for(128) == 8
+    assert engine._ctx_bucket_for(129) == 16
+    assert engine._ctx_bucket_for(10_000) == 16  # clamped to table width
+
+
+def test_generation_across_bucket_boundary_matches_full_width():
+    """A greedy generation that grows from inside the smallest bucket
+    (prompt 30 tokens) THROUGH the 64- and 128-token boundaries must
+    emit exactly what an engine pinned to full width emits — bucketing
+    may never change logits, only traffic."""
+    bucketed = _engine()
+    prompt = bucketed.tokenizer.encode("x" * 29)  # bos + 29 -> 30 tokens
+    out_bucketed = _greedy(bucketed, prompt, max_tokens=120)
+
+    full = _engine()
+    # pin every dispatch to the full table width
+    table_pages = full.config.max_seq_len // full.config.page_size
+    full._ctx_bucket_for = lambda needed: table_pages
+    full._hist_ctx_for = lambda needed: table_pages
+    out_full = _greedy(full, prompt, max_tokens=120)
+
+    assert out_bucketed == out_full
+    assert len(out_bucketed) == 120  # crossed 64 and 128 token boundaries
+
+
+def test_decode_block_respects_bucket_growth():
+    """decode_block > 1 extends positions INSIDE one dispatch: the bucket
+    chosen for the block must already cover seq_len + k, or late
+    sub-steps would write/read past the sliced table."""
+    engine = _engine(decode_block=4)
+    prompt = engine.tokenizer.encode("y" * 29)
+    out = _greedy(engine, prompt, max_tokens=40)
+    assert len(out) == 40
+
+    reference = _engine(decode_block=1)
+    assert out == _greedy(reference, prompt, max_tokens=40)
+
+
+def test_slot_compaction_preserves_generations():
+    """Batch-width bucketing depends on compaction: finish the low-slot
+    request mid-flight, admit another, and verify the surviving high-slot
+    request's stream is unaffected (its pages only changed table rows)."""
+    engine = _engine(max_batch=4, batch_buckets=True)
+
+    async def run():
+        await engine.start()
+        try:
+            short = engine.tokenizer.encode("a" * 20)
+            long = engine.tokenizer.encode("b" * 20)
+
+            async def consume(prompt, n):
+                out = []
+                async for tok in engine.generate(prompt, max_tokens=n):
+                    out.append(tok)
+                return out
+
+            # expected output of the long request, measured solo
+            expected = await consume(long, 60)
+            # now race it against short requests that finish early, forcing
+            # holes + compaction while the long one is mid-stream
+            results = await asyncio.gather(
+                consume(short, 3), consume(short, 3), consume(long, 60),
+                consume(short, 3))
+            assert results[2] == expected
+            return True
+        finally:
+            await engine.stop()
+
+    assert asyncio.run(run())
+
+
+def test_batch_bucket_selection():
+    engine = _engine(max_batch=4, batch_buckets=True)
+    assert engine._batch_buckets() == [4]
+    engine16 = _engine(max_batch=16, batch_buckets=True)
+    assert engine16._batch_buckets() == [8, 16]
+    assert engine16._batch_bucket_for(1) == 8
+    assert engine16._batch_bucket_for(9) == 16
