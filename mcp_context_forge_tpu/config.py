@@ -198,6 +198,10 @@ class Settings(BaseModel):
     tpu_local_encoder_max_wait_ms: float = 2.0
     # engine admission queue bound (backpressure past this)
     tpu_local_max_queue: int = 1024
+    # device-fault recovery: crashed dispatch thread rebuilds KV, re-queues
+    # pending requests and restarts itself (bounded); off = fail fast
+    tpu_local_auto_restart: bool = False
+    tpu_local_auto_restart_max: int = 3
 
     # --- header passthrough (reference config.py:3489-3499: off by
     # default for security; sensitive headers need per-gateway opt-in) ---

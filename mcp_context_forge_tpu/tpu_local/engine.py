@@ -104,6 +104,13 @@ class EngineConfig:
     # KV pool (~a pool copy). Off by default; enable for latency-sensitive
     # low-concurrency serving.
     batch_buckets: bool = False
+    # device-fault recovery (SURVEY §5.3): a crashed dispatch thread
+    # rebuilds the KV pool, re-queues PENDING requests (mid-stream ones
+    # fail — silent retry would duplicate emitted tokens) and restarts
+    # itself, at most auto_restart_max times. Off by default: tests and
+    # benches prefer fail-fast; production serving turns it on.
+    auto_restart: bool = False
+    auto_restart_max: int = 3
 
     @classmethod
     def from_settings(cls, settings) -> "EngineConfig":
@@ -131,6 +138,8 @@ class EngineConfig:
             quant=getattr(settings, "tpu_local_quant", ""),
             batch_buckets=getattr(settings, "tpu_local_batch_buckets", False),
             max_queue=getattr(settings, "tpu_local_max_queue", 1024),
+            auto_restart=getattr(settings, "tpu_local_auto_restart", False),
+            auto_restart_max=getattr(settings, "tpu_local_auto_restart_max", 3),
         )
 
 
@@ -176,6 +185,7 @@ class EngineStats:
         self.spec_tokens = 0     # extra tokens emitted beyond 1/step
         self.prefill_ms_total = 0.0   # device wall inside prefill dispatches
         self.decode_ms_total = 0.0    # device wall inside decode dispatches
+        self.engine_restarts = 0      # crash-recovery restarts (auto_restart)
 
 
 class EngineInitTimeout(RuntimeError):
@@ -314,21 +324,9 @@ class TPUEngine:
                                    out_shardings=shardings)
                 self.params = init(jax.random.PRNGKey(0))
 
-            max_pages_per_slot = config.max_seq_len // config.page_size
-            from .kv import PagedKVState
-            from .parallel.sharding import kv_pages_sharding, logical_to_sharding
-            pages = kv_pages_sharding(self.mesh, self.model_config.n_kv_heads)
-            kv_shardings = PagedKVState(
-                k_pages=pages, v_pages=pages,
-                block_tables=logical_to_sharding("replicated", self.mesh))
-            kv_init = jax.jit(partial(
-                init_kv_state, self.model_config, config.num_pages, config.page_size,
-                config.max_batch, max_pages_per_slot, dtype=dtype),
-                out_shardings=kv_shardings)
-            self.kv = kv_init()
+            self._kv_dtype = dtype
+            self._init_kv()
 
-        self.allocator = PageAllocator(config.num_pages, config.page_size,
-                                       config.max_batch, max_pages_per_slot)
         self._rng = jax.random.PRNGKey(int(time.time()) & 0x7FFFFFFF)
 
         # compiled steps
@@ -353,6 +351,28 @@ class TPUEngine:
             {} if config.spec_decode else None)
         if config.warmup:
             self.warmup()
+
+    def _init_kv(self) -> None:
+        """(Re)build the KV pool + allocator on the mesh — used at
+        construction and by crash recovery (a fault inside a jitted call
+        may have consumed the donated kv buffers)."""
+        config = self.config
+        max_pages_per_slot = config.max_seq_len // config.page_size
+        from .kv import PagedKVState
+        from .parallel.sharding import kv_pages_sharding, logical_to_sharding
+        with self.mesh:
+            pages = kv_pages_sharding(self.mesh, self.model_config.n_kv_heads)
+            kv_shardings = PagedKVState(
+                k_pages=pages, v_pages=pages,
+                block_tables=logical_to_sharding("replicated", self.mesh))
+            kv_init = jax.jit(partial(
+                init_kv_state, self.model_config, config.num_pages,
+                config.page_size, config.max_batch, max_pages_per_slot,
+                dtype=self._kv_dtype),
+                out_shardings=kv_shardings)
+            self.kv = kv_init()
+        self.allocator = PageAllocator(config.num_pages, config.page_size,
+                                       config.max_batch, max_pages_per_slot)
 
     def _ctx_buckets(self) -> list[int]:
         """The page-width buckets decode compiles for: powers of two from
@@ -695,6 +715,7 @@ class TPUEngine:
     def _device_loop(self) -> None:
         """Owns every jax call + device sync. Never touched by the asyncio
         loop; results hop back via loop.call_soon_threadsafe."""
+        crashed = False
         try:
             while not self._stop_event.is_set():
                 did_work = self._admit_batch()
@@ -708,11 +729,66 @@ class TPUEngine:
                 if not did_work:
                     time.sleep(0.001)
         except Exception:
+            crashed = True
             logger.exception("tpu_local dispatch thread crashed")
         finally:
-            # a dead thread must not strand consumers on stream.get()
-            self._fail_outstanding(
-                "cancelled" if self._stop_event.is_set() else "error")
+            if (crashed and self.config.auto_restart
+                    and not self._stop_event.is_set()
+                    and self.stats.engine_restarts
+                    < self.config.auto_restart_max):
+                self._restart_after_crash()
+            else:
+                # a dead thread must not strand consumers on stream.get()
+                self._fail_outstanding(
+                    "cancelled" if self._stop_event.is_set() else "error")
+
+    def _restart_after_crash(self) -> None:
+        """Device-fault recovery (SURVEY §5.3: "TPU driver errors → engine
+        restart + request re-queue"). Runs on the DYING dispatch thread:
+
+        - mid-stream requests fail (tokens already emitted; a silent retry
+          would duplicate output) — the gateway's retry layer owns those;
+        - PENDING requests (no tokens yet) re-queue and survive;
+        - the KV pool + allocator are REBUILT: a crash inside a jitted call
+          may have consumed the donated kv buffers, so resident state is
+          untrustworthy (params are never donated and stay);
+        - a fresh dispatch thread takes over. Bounded by auto_restart_max.
+        """
+        self.stats.engine_restarts += 1
+        logger.warning("tpu_local: restarting engine after crash (%d/%d)",
+                       self.stats.engine_restarts, self.config.auto_restart_max)
+        self._drain_work()
+        requeue = list(self._pending)
+        self._pending.clear()
+        for request in list(self._running.values()):
+            if request.finish_reason is None:
+                request.finish_reason = "error"
+            self._running.pop(request.slot, None)
+            self._post_tokens(request, [], done=True)
+        try:
+            self._init_kv()
+            for request in requeue:  # fresh admission state
+                request.slot = -1
+                request.bucket = -1
+                request.hist = 0
+                request.chunked = False
+                self._pending.append(request)
+            requeue = []
+            replacement = threading.Thread(target=self._device_loop,
+                                           name="tpu-engine-dispatch",
+                                           daemon=True)
+            # start BEFORE publishing: a concurrent stop() must never join
+            # a not-yet-started thread (the dying thread keeps
+            # _check_alive() true until this method returns)
+            replacement.start()
+            self._thread = replacement
+        except Exception:
+            logger.exception("tpu_local: crash recovery failed; engine down")
+            # fail EVERYTHING reachable — the requeue list, _pending, and
+            # anything submitted into _work while the rebuild ran — so no
+            # consumer is stranded on stream.get()
+            self._pending.extendleft(reversed(requeue))
+            self._fail_outstanding("error")
 
     def _fail_outstanding(self, reason: str) -> None:
         self._drain_work()

@@ -133,3 +133,63 @@ async def test_peer_flap_deactivates_and_reactivates():
     finally:
         await gateway.close()
         await peer_client.close()
+
+
+def test_engine_auto_restart_requeues_pending():
+    """SURVEY §5.3 recovery envelope: with auto_restart on, a device fault
+    rebuilds the KV pool, restarts the dispatch thread, and PENDING
+    requests (no tokens emitted) survive and complete; the mid-flight
+    request fails (retry would duplicate its emitted tokens)."""
+    engine = TPUEngine(EngineConfig(
+        model="llama3-test", max_batch=1, max_seq_len=64, page_size=16,
+        num_pages=32, prefill_buckets=(16,), dtype="float32",
+        attn_impl="reference", auto_restart=True, auto_restart_max=2))
+
+    async def main():
+        await engine.start()
+        ids = engine.tokenizer.encode("ok")
+        # healthy round (compiles everything)
+        assert [t async for t in engine.generate(ids, max_tokens=2)]
+
+        # inject a one-shot fault into the decode dispatch
+        real_decode_fn = engine._decode_fn
+        fired = {"n": 0}
+
+        def flaky_fn(ctx_pages, batch=None):
+            fn = real_decode_fn(ctx_pages, batch)
+
+            def maybe_boom(*args, **kwargs):
+                if fired["n"] == 0:
+                    fired["n"] += 1
+                    raise RuntimeError("injected device fault")
+                return fn(*args, **kwargs)
+            return maybe_boom
+
+        engine._decode_fn = flaky_fn
+
+        # victim occupies the single slot (mid-stream when the fault fires);
+        # a second request waits in the queue — it must SURVIVE the crash
+        victim = GenRequest(request_id="victim", prompt_ids=ids, max_tokens=8)
+        await engine.submit(victim)
+        survivor_tokens = []
+        async for tok in engine.generate(ids, max_tokens=3):
+            survivor_tokens.append(tok)
+        assert len(survivor_tokens) == 3          # completed after restart
+        assert engine.stats.engine_restarts == 1
+        assert fired["n"] == 1
+
+        # victim's stream terminated with an error, not a hang
+        drained = []
+        while True:
+            token = await asyncio.wait_for(victim.stream.get(), 5.0)
+            if token is None:
+                break
+            drained.append(token)
+        assert victim.finish_reason == "error"
+
+        # engine still serves after recovery
+        healed = [t async for t in engine.generate(ids, max_tokens=2)]
+        assert len(healed) == 2
+        await engine.stop()
+
+    asyncio.run(main())
