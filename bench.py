@@ -129,9 +129,14 @@ async def _make_gateway(engine: bool, platform: str):
         "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
         "MCPFORGE_OTEL_EXPORTER": "none",
         "MCPFORGE_LOG_LEVEL": "WARNING",
-        # compile the full prefill/decode shape grid at boot so the timed
-        # configs below measure steady state, not XLA compile latency
+        # compile the prefill/decode shape grid at boot so the timed
+        # configs below measure steady state, not XLA compile latency;
+        # on a cold TPU cache the FULL grid is ~dozens of 20-40s compiles,
+        # so the chip uses the fast subset (persistent cache keeps any
+        # mid-traffic stragglers)
         "MCPFORGE_TPU_LOCAL_WARMUP": "true" if engine else "false",
+        "MCPFORGE_TPU_LOCAL_WARMUP_MODE": ("fast" if platform == "tpu"
+                                           else "full"),
         # persistent executable cache: bench reruns (and the engine bench)
         # skip XLA recompiles entirely
         "MCPFORGE_TPU_LOCAL_COMPILE_CACHE_DIR": os.environ.get(

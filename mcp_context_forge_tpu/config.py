@@ -166,6 +166,9 @@ class Settings(BaseModel):
     # executables survive process restarts, so a gateway/bench rerun skips
     # recompilation entirely
     tpu_local_compile_cache_dir: str = ""
+    # warmup grid scope: 'full' (no mid-traffic compiles ever) or 'fast'
+    # (cold-TPU-friendly subset; a rare cache miss pays one compile)
+    tpu_local_warmup_mode: Literal["full", "fast"] = "full"
     # prefix cache: resident KV pages of shared full-page prompt prefixes
     # are reused across requests, so repeated plugin/chat templates only
     # prefill their suffix (vLLM automatic-prefix-caching analog)

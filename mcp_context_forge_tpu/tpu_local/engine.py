@@ -72,8 +72,9 @@ class EngineConfig:
     decode_block: int = 1
     # seconds to wait for jax backend init before failing fast (0 = forever)
     init_timeout_s: float = 120.0
-    # precompile the full shape grid at construction (see TPUEngine.warmup)
+    # precompile the shape grid at construction (see TPUEngine.warmup)
     warmup: bool = False
+    warmup_mode: str = "full"  # full | fast (cold-TPU-friendly subset)
     # persistent XLA compilation cache ('' = disabled)
     compile_cache_dir: str = ""
     # prefix cache: reuse resident KV pages for shared full-page prompt
@@ -130,6 +131,7 @@ class EngineConfig:
             decode_block=getattr(settings, "tpu_local_decode_block", 1),
             init_timeout_s=getattr(settings, "tpu_local_init_timeout_s", 120.0),
             warmup=getattr(settings, "tpu_local_warmup", False),
+            warmup_mode=getattr(settings, "tpu_local_warmup_mode", "full"),
             compile_cache_dir=getattr(settings, "tpu_local_compile_cache_dir", ""),
             prefix_cache=getattr(settings, "tpu_local_prefix_cache", True),
             spec_decode=getattr(settings, "tpu_local_spec_decode", False),
@@ -478,15 +480,31 @@ class TPUEngine:
             self._prefill_hist_fns[ctx_pages] = fn
         return fn
 
-    def warmup(self) -> None:
-        """Precompile the full shape grid before traffic: every prefill
-        bucket x power-of-2 admission batch (plus the SP variant for long
-        buckets) and the decode block. Safe pre-traffic: warmup rows use
-        positions=-1, so KV writes land on the reserved trash page (page 0)
-        and the allocator is untouched. Also what benches call so their
-        timed region measures steady state, not XLA compile latency."""
+    def warmup(self, mode: str | None = None) -> None:
+        """Precompile the shape grid before traffic. Safe pre-traffic:
+        warmup rows use positions=-1, so KV writes land on the reserved
+        trash page (page 0) and the allocator is untouched. Also what
+        benches call so their timed region measures steady state, not XLA
+        compile latency.
+
+        ``mode`` (default config.warmup_mode):
+        - "full": every prefill bucket x power-of-2 admission batch x
+          history context bucket + the decode grid — zero mid-traffic
+          compiles, but on a cold TPU cache the grid is ~dozens of shapes
+          at 20-40 s each;
+        - "fast": per bucket only B=1 and the admission cap, history only
+          at the smallest + largest context bucket — boots in minutes on
+          a cold chip; a cache miss mid-traffic costs one compile (which
+          the persistent cache then keeps).
+        """
+        mode = mode or self.config.warmup_mode
+        if mode not in ("full", "fast"):
+            raise ValueError(f"warmup mode must be full|fast, got {mode!r}")
         started = time.monotonic()
         shapes = 0
+        hist_ctx = self._hist_ctx_buckets()
+        if mode == "fast" and len(hist_ctx) > 2:
+            hist_ctx = [hist_ctx[0], hist_ctx[-1]]
         with self.mesh:
             for bucket in self.config.prefill_buckets:
                 use_sp = (self._prefill_sample_sp is not None
@@ -499,6 +517,9 @@ class TPUEngine:
                     cap *= 2
                 B = 1
                 while B <= cap:
+                    if mode == "fast" and B not in (1, cap):
+                        B *= 2
+                        continue
                     # the history fn serves prefix-cache hits (any B) and
                     # chunked prefill (always B=1) — don't compile hit-path
                     # batch shapes that can't occur with the cache off;
@@ -508,8 +529,7 @@ class TPUEngine:
                     else:
                         fns = [self._prefill_sample]
                         if self.config.prefix_cache or B == 1:
-                            fns.extend(self._hist_fn(cp)
-                                       for cp in self._hist_ctx_buckets())
+                            fns.extend(self._hist_fn(cp) for cp in hist_ctx)
                     samp = SamplingParams(jnp.zeros((B,), jnp.float32),
                                           jnp.zeros((B,), jnp.int32),
                                           jnp.ones((B,), jnp.float32))
