@@ -60,6 +60,10 @@ sanitize-tsan:
 	  mcp_context_forge_tpu/native/mcp_edge.cpp -o $(SAN_DIR)/edge_tsan
 	MCPFORGE_EDGE_BIN=$(SAN_DIR)/edge_tsan \
 	  python -m pytest tests/integration/test_mcp_edge.py -q
+	g++ -std=c++17 -g -O1 -fsanitize=thread -pthread \
+	  mcp_context_forge_tpu/native/stdio_wrapper.cpp -o $(SAN_DIR)/wrapper_tsan
+	MCPFORGE_WRAPPER_BIN=$(SAN_DIR)/wrapper_tsan \
+	  python -m pytest tests/integration/test_translate_wrapper.py -q
 
 sanitize-asan:
 	mkdir -p $(SAN_DIR)

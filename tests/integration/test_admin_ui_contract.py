@@ -1,0 +1,100 @@
+"""Admin UI data contract (Playwright substitute — no browser in the CI
+image): every endpoint the UI's TABS spec references must answer with the
+shape the page's JS consumes (a JSON array, or an object whose `path`
+field holds the array; the engine tab gets a stats object). Catches the
+classic drift failure — a renamed route or field silently blanking a tab.
+"""
+
+import json
+import re
+
+import aiohttp
+
+from test_gateway_app import BASIC, make_client
+
+AUTH = aiohttp.BasicAuth(*BASIC)
+
+
+def _parse_tabs() -> dict[str, dict]:
+    """Extract {tab: {url, path?, special?}} from the page source."""
+    from mcp_context_forge_tpu.gateway import admin_ui
+
+    block = admin_ui._PAGE.split("const TABS = {", 1)[1]
+    # cut at the closing "};" of the TABS literal
+    block = block.split("\n};", 1)[0]
+    tabs: dict[str, dict] = {}
+    # anchored to line starts so nested create:{url:...} sub-objects of an
+    # entry never parse as phantom tabs
+    for line_match in re.finditer(
+            r"^  (\w+):\s*\{url:\s*\"([^\"]+)\"", block, re.MULTILINE):
+        name, url = line_match.group(1), line_match.group(2)
+        entry: dict = {"url": url}
+        line_end = block.find("\n", line_match.end())
+        rest = block[line_match.end():
+                     line_end if line_end != -1 else len(block)]
+        path = re.search(r"path:\s*\"(\w+)\"", rest)
+        if path:
+            entry["path"] = path.group(1)
+        if "special" in rest:
+            entry["special"] = True
+        tabs[name] = entry
+    return tabs
+
+
+async def test_every_tab_endpoint_answers_with_consumable_shape():
+    tabs = _parse_tabs()
+    # the spec should cover the entity families the reference UI covers
+    for expected in ("tools", "gateways", "servers", "resources", "prompts",
+                     "users", "teams", "tokens", "traces", "logs", "audit",
+                     "plugins", "metrics", "engine"):
+        assert expected in tabs, f"TABS lost the {expected} tab"
+
+    client = await make_client(tpu_local_enabled="true",
+                               tpu_local_model="llama3-test",
+                               tpu_local_max_batch="2",
+                               tpu_local_max_seq_len="64",
+                               tpu_local_page_size="16",
+                               tpu_local_num_pages="32",
+                               tpu_local_prefill_buckets="16",
+                               tpu_local_dtype="float32")
+    try:
+        resp = await client.get("/admin", auth=AUTH)
+        assert resp.status == 200
+        assert "text/html" in resp.headers["content-type"]
+
+        for name, spec in tabs.items():
+            resp = await client.get(spec["url"], auth=AUTH)
+            assert resp.status == 200, (name, spec["url"], resp.status,
+                                        await resp.text())
+            data = await resp.json()
+            if spec.get("special"):          # engine stats object
+                assert "decode_steps" in data, (name, data)
+            elif "path" in spec:
+                assert isinstance(data.get(spec["path"]), list), (name, data)
+            else:
+                assert isinstance(data, list), (name, type(data))
+    finally:
+        await client.close()
+
+
+async def test_tab_row_actions_resolve():
+    """The toggle/edit/delete URL templates the UI builds must hit real
+    routes (create a tool, toggle it, PUT it, delete it — the exact verbs
+    the page uses)."""
+    client = await make_client()
+    try:
+        resp = await client.post("/tools", json={
+            "name": "ui-tool", "integration_type": "REST",
+            "url": "http://127.0.0.1:9/x"}, auth=AUTH)
+        assert resp.status == 201
+        tool = await resp.json()
+        resp = await client.post(f"/tools/{tool['id']}/toggle", auth=AUTH)
+        assert resp.status == 200
+        body = dict(tool)
+        body["description"] = "edited from the admin UI"
+        resp = await client.put(f"/tools/{tool['id']}", json=body, auth=AUTH)
+        assert resp.status == 200, await resp.text()
+        resp = await client.delete(f"/tools/{tool['id']}", auth=AUTH)
+        assert resp.status in (200, 204)
+    finally:
+        await client.close()
