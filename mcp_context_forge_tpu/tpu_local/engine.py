@@ -172,6 +172,7 @@ class GenRequest:
     hist: int = 0
     bucket: int = -1
     chunked: bool = False
+    chunk_pos: int = 0   # tokens prefilled so far (chunk-round scheduler)
 
 
 class EngineStats:
@@ -274,6 +275,7 @@ class TPUEngine:
         self._work: queue.Queue[GenRequest] = queue.Queue(maxsize=config.max_queue)
         self._pending: deque[GenRequest] = deque()   # owned by dispatch thread
         self._running: dict[int, GenRequest] = {}    # slot -> request (thread)
+        self._chunking: dict[int, GenRequest] = {}   # slot -> mid-chunk-prefill
         self._thread: threading.Thread | None = None
         self._stop_event = threading.Event()
         self._loop: asyncio.AbstractEventLoop | None = None
@@ -432,14 +434,12 @@ class TPUEngine:
         _sync_tables. Runs between dispatches on the dispatch thread."""
         if not self._running:
             return
-        count = len(self._running)
         for slot in sorted(self._running, reverse=True):
-            if slot < count:
-                break  # already compact below the ceiling
-            target = min(s for s in range(self.config.max_batch)
-                         if s not in self._running)
-            if target >= slot:
-                break
+            frees = [s for s in range(slot)
+                     if s not in self._running and s not in self._chunking]
+            if not frees:
+                break  # nothing lower is free: already compact
+            target = frees[0]
             request = self._running.pop(slot)
             self.allocator.move_slot(slot, target)
             request.slot = target
@@ -520,15 +520,19 @@ class TPUEngine:
                     if mode == "fast" and B not in (1, cap):
                         B *= 2
                         continue
-                    # the history fn serves prefix-cache hits (any B) and
-                    # chunked prefill (always B=1) — don't compile hit-path
-                    # batch shapes that can't occur with the cache off;
-                    # one compile per context-width bucket (see _hist_fn)
+                    # the history fn serves prefix-cache hits AND chunk
+                    # rounds (both batch to any B now) — compile it for
+                    # every B whenever either path is reachable; one
+                    # compile per context-width bucket (see _hist_fn)
+                    hist_reachable = (
+                        self.config.prefix_cache
+                        or self.config.max_seq_len
+                        > max(self.config.prefill_buckets))
                     if use_sp:
                         fns = [self._prefill_sample_sp]
                     else:
                         fns = [self._prefill_sample]
-                        if self.config.prefix_cache or B == 1:
+                        if hist_reachable:
                             fns.extend(self._hist_fn(cp) for cp in hist_ctx)
                     samp = SamplingParams(jnp.zeros((B,), jnp.float32),
                                           jnp.zeros((B,), jnp.int32),
@@ -739,6 +743,9 @@ class TPUEngine:
         try:
             while not self._stop_event.is_set():
                 did_work = self._admit_batch()
+                if self._chunking:
+                    self._chunk_round()
+                    did_work = True
                 if self._running:
                     if self._verify_fns is not None and self._any_would_draft():
                         self._spec_step_all()
@@ -780,6 +787,10 @@ class TPUEngine:
         self._drain_work()
         requeue = list(self._pending)
         self._pending.clear()
+        # mid-chunk requests have emitted NOTHING — they re-queue safely
+        # (their pages die with the KV rebuild below)
+        requeue.extend(self._chunking.values())
+        self._chunking.clear()
         for request in list(self._running.values()):
             if request.finish_reason is None:
                 request.finish_reason = "error"
@@ -792,6 +803,7 @@ class TPUEngine:
                 request.bucket = -1
                 request.hist = 0
                 request.chunked = False
+                request.chunk_pos = 0
                 self._pending.append(request)
             requeue = []
             replacement = threading.Thread(target=self._device_loop,
@@ -816,6 +828,12 @@ class TPUEngine:
             if request.finish_reason is None:
                 request.finish_reason = reason
             self._finish(request)
+        for request in list(self._chunking.values()):
+            self._chunking.pop(request.slot, None)
+            self.allocator.free_slot(request.slot)
+            if request.finish_reason is None:
+                request.finish_reason = reason
+            self._post_tokens(request, [], done=True)
         while self._pending:
             request = self._pending.popleft()
             if request.finish_reason is None:
@@ -917,12 +935,19 @@ class TPUEngine:
             head.finish_reason = "length"
             self._post_tokens(head, [], done=True)
 
-        free_slots = [s for s in range(config.max_batch) if s not in self._running]
+        free_slots = [s for s in range(config.max_batch)
+                      if s not in self._running and s not in self._chunking]
         if not self._pending or not free_slots:
             return False
 
         head = self._pending[0]
         bucket = self._assign_bucket(head)
+        if head.chunked and len(self._chunking) >= config.prefill_max_batch:
+            # chunk rounds advance at most prefill_max_batch rows: admitting
+            # more chunkers would pin full-prompt page allocations that sit
+            # idle for rounds, starving short requests under page pressure —
+            # they wait in _pending holding nothing instead
+            return False
         # history rows run the gathered-context attention path, which costs
         # O(S * max_context) regardless of hist — don't drag dense rows of
         # the same bucket through it (they'd pay for a hit they didn't get)
@@ -930,12 +955,20 @@ class TPUEngine:
         group: list[GenRequest] = []
         skipped: list[GenRequest] = []
         limit = min(len(free_slots), config.prefill_max_batch)
+        if head.chunked:
+            limit = min(limit,
+                        config.prefill_max_batch - len(self._chunking))
         while self._pending and len(group) < limit:
             request = self._pending.popleft()
-            if (self._assign_bucket(request) == bucket
-                    and (request.hist > 0) == with_hist
-                    and request.chunked == head.chunked
-                    and not (request.chunked and group)):  # chunked: alone
+            if head.chunked:
+                # chunked requests group with each other regardless of hist
+                # — chunk ROUNDS batch them (per-row absolute positions)
+                ok = (self._assign_bucket(request) != 0 and request.chunked)
+            else:
+                ok = (self._assign_bucket(request) == bucket
+                      and (request.hist > 0) == with_hist
+                      and not request.chunked)
+            if ok:
                 group.append(request)
             else:
                 skipped.append(request)
@@ -970,54 +1003,24 @@ class TPUEngine:
                 continue
             request.slot = slot
             request.queue_ms = (time.time() - request.created) * 1000
-            self._running[slot] = request
+            if request.chunked:
+                # chunk-round scheduler owns it until the prompt is fully
+                # prefilled; slots/pages are held, decode ignores it
+                request.chunk_pos = request.hist
+                self._chunking[slot] = request
+            else:
+                self._running[slot] = request
             admitted.append(request)
         if not admitted:
             return False
         self._sync_tables()
 
         if admitted[0].chunked:
-            request = admitted[0]  # chunked requests are admitted alone
-            first_tok = self._prefill_chunked(request)
-            # register BEFORE emitting: a first token that finishes the
-            # request (EOS / max_tokens=1) frees the slot's pages, and a
-            # post-emit registration would cache nothing — defeating the
-            # prefix cache for classification-style template workloads
-            if self.config.prefix_cache:
-                self.allocator.register_prefix(request.slot,
-                                               request.prompt_ids)
-            self.stats.prefill_requests += 1
-            self._emit(request, first_tok)
-            return True
+            return True  # device work happens in _chunk_round
 
         started = time.monotonic()
-        # pad batch to the next power of two so XLA compiles at most
-        # log2(prefill_max_batch)+1 shapes per bucket, not one per distinct
-        # group size; padding rows have positions -1 (no KV write — the same
-        # masking decode uses for inactive slots) and their samples are
-        # discarded
-        B = 1
-        while B < len(admitted):
-            B *= 2
-        tokens = np.full((B, bucket), self.tokenizer.pad_id, dtype=np.int32)
-        positions = np.full((B, bucket), -1, dtype=np.int32)
-        last_idx = np.zeros((B,), dtype=np.int32)
-        slot_ids = np.zeros((B,), dtype=np.int32)
-        temperature = np.zeros((B,), dtype=np.float32)
-        top_k = np.zeros((B,), dtype=np.int32)
-        top_p = np.ones((B,), dtype=np.float32)
-        for i, request in enumerate(admitted):
-            suffix = request.prompt_ids[request.hist:]  # hist tokens are cached
-            n = len(suffix)
-            tokens[i, :n] = suffix
-            positions[i, :n] = np.arange(request.hist, request.hist + n)
-            last_idx[i] = n - 1
-            slot_ids[i] = request.slot
-            temperature[i] = request.temperature
-            top_k[i] = request.top_k
-            top_p[i] = request.top_p
-        sampling = SamplingParams(jnp.asarray(temperature), jnp.asarray(top_k),
-                                  jnp.asarray(top_p))
+        tokens, positions, last_idx, slot_ids, sampling = self._pack_rows(
+            [(r, r.hist, len(r.prompt_ids)) for r in admitted], bucket)
         self._rng, key = jax.random.split(self._rng)
         # long buckets route through the sequence-parallel attention path
         # (shape-deterministic: SP-ness is a property of the bucket; SP
@@ -1035,8 +1038,8 @@ class TPUEngine:
         else:
             prefill_fn = self._prefill_sample
         first, self.kv = prefill_fn(
-            self.params, self.kv, jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(slot_ids), jnp.asarray(last_idx), sampling, key)
+            self.params, self.kv, tokens, positions,
+            slot_ids, last_idx, sampling, key)
         if self.config.prefix_cache:
             # prompt pages are on the device write path now; register the
             # full ones so later prompts sharing the prefix skip their KV
@@ -1053,42 +1056,92 @@ class TPUEngine:
             self._emit(request, int(first_host[i]))
         return True
 
-    def _prefill_chunked(self, request: GenRequest) -> int:
-        """Prefill a prompt longer than every bucket in bucket-sized chunks
-        through the history path — chunk i attends to chunks 0..i-1 already
-        written to the slot's pages (plus any cached prefix). Mid-chunk
-        samples predict known prompt tokens and are discarded; returns the
-        final chunk's sampled token (the request's first output — emitted
-        by the caller AFTER prefix registration)."""
-        started = time.monotonic()
-        ids = request.prompt_ids
-        buckets = sorted(self.config.prefill_buckets)
-        start = request.hist
-        first = None
-        while start < len(ids):
-            remaining = len(ids) - start
-            bucket = next((b for b in buckets if remaining <= b), buckets[-1])
-            end = min(start + bucket, len(ids))
+    def _pack_rows(self, rows: list[tuple[GenRequest, int, int]], S: int):
+        """Pack [(request, start, end)] prompt spans into padded [B, S]
+        device arrays + per-row sampling params. B pads to the next power
+        of two so XLA compiles at most log2(prefill_max_batch)+1 shapes
+        per width; padding rows have positions -1 (no KV write — the same
+        masking decode uses for inactive slots) and their samples are
+        discarded. Shared by dense/suffix prefill and chunk rounds."""
+        B = 1
+        while B < len(rows):
+            B *= 2
+        tokens = np.full((B, S), self.tokenizer.pad_id, dtype=np.int32)
+        positions = np.full((B, S), -1, dtype=np.int32)
+        last_idx = np.zeros((B,), dtype=np.int32)
+        slot_ids = np.zeros((B,), dtype=np.int32)
+        temperature = np.zeros((B,), dtype=np.float32)
+        top_k = np.zeros((B,), dtype=np.int32)
+        top_p = np.ones((B,), dtype=np.float32)
+        for i, (request, start, end) in enumerate(rows):
             n = end - start
-            tokens = np.full((1, bucket), self.tokenizer.pad_id, dtype=np.int32)
-            positions = np.full((1, bucket), -1, dtype=np.int32)
-            tokens[0, :n] = ids[start:end]
-            positions[0, :n] = np.arange(start, end)
-            sampling = SamplingParams(
-                jnp.asarray([request.temperature], jnp.float32),
-                jnp.asarray([request.top_k], jnp.int32),
-                jnp.asarray([request.top_p], jnp.float32))
-            self._rng, key = jax.random.split(self._rng)
-            first, self.kv = self._hist_fn(self._hist_ctx_for(end))(
-                self.params, self.kv, jnp.asarray(tokens),
-                jnp.asarray(positions),
-                jnp.asarray([request.slot], dtype=jnp.int32),
-                jnp.asarray([n - 1], dtype=jnp.int32), sampling, key)
-            self.stats.prefill_batches += 1
-            start = end
+            tokens[i, :n] = request.prompt_ids[start:end]
+            positions[i, :n] = np.arange(start, end)
+            last_idx[i] = n - 1
+            slot_ids[i] = request.slot
+            temperature[i] = request.temperature
+            top_k[i] = request.top_k
+            top_p[i] = request.top_p
+        sampling = SamplingParams(jnp.asarray(temperature), jnp.asarray(top_k),
+                                  jnp.asarray(top_p))
+        return (jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(last_idx), jnp.asarray(slot_ids), sampling)
+
+    def _chunk_round(self) -> None:
+        """Advance every mid-prefill long prompt by ONE chunk, batched.
+
+        Prompts longer than every bucket prefill in bucket-sized chunks
+        through the history path — chunk i attends to chunks 0..i-1
+        already in the slot's pages (plus any cached prefix). Rows carry
+        ABSOLUTE positions, so requests at different chunk offsets batch
+        into one dispatch (previously each long prompt chunked alone at
+        B=1, serializing summarizer-style concurrent traffic). Mid-chunk
+        samples predict known prompt tokens and are discarded; a row
+        whose prompt completes this round emits its sampled token and
+        moves to decode."""
+        config = self.config
+        batch = list(self._chunking.values())[:config.prefill_max_batch]
+        if len(batch) == 1:
+            # solo: the smallest bucket covering the REMAINING span — a
+            # short final chunk must not pay a max-bucket-wide dispatch
+            remaining = len(batch[0].prompt_ids) - batch[0].chunk_pos
+            S = next((b for b in sorted(config.prefill_buckets)
+                      if remaining <= b), max(config.prefill_buckets))
+        else:
+            S = max(config.prefill_buckets)
+        started = time.monotonic()
+        rows: list[tuple[GenRequest, int, int]] = []
+        max_end = 1
+        for request in batch:
+            start = request.chunk_pos
+            end = min(start + S, len(request.prompt_ids))
+            rows.append((request, start, end))
+            request.chunk_pos = end
+            max_end = max(max_end, end)
+        tokens, positions, last_idx, slot_ids, sampling = \
+            self._pack_rows(rows, S)
+        self._rng, key = jax.random.split(self._rng)
+        first, self.kv = self._hist_fn(self._hist_ctx_for(max_end))(
+            self.params, self.kv, tokens, positions,
+            slot_ids, last_idx, sampling, key)
         first_host = jax.device_get(first)
-        request.prefill_ms = (time.monotonic() - started) * 1000
-        return int(first_host[0])
+        elapsed_ms = (time.monotonic() - started) * 1000
+        self.stats.prefill_batches += 1
+        self.stats.prefill_ms_total += elapsed_ms
+        for i, request in enumerate(batch):
+            request.prefill_ms += elapsed_ms
+            if request.chunk_pos < len(request.prompt_ids):
+                continue  # more chunks to go; sample discarded
+            del self._chunking[request.slot]
+            # register BEFORE emitting: a first token that finishes the
+            # request (EOS / max_tokens=1) frees the slot's pages, and a
+            # post-emit registration would cache nothing
+            if config.prefix_cache:
+                self.allocator.register_prefix(request.slot,
+                                               request.prompt_ids)
+            self.stats.prefill_requests += 1
+            self._running[request.slot] = request
+            self._emit(request, int(first_host[i]))
 
     # ------------------------------------------------------- speculative step
 

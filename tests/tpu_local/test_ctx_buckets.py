@@ -119,3 +119,41 @@ def test_batch_bucket_selection():
     assert engine16._batch_buckets() == [8, 16]
     assert engine16._batch_bucket_for(1) == 8
     assert engine16._batch_bucket_for(9) == 16
+
+
+def test_chunk_rounds_batch_concurrent_long_prompts():
+    """Long prompts (beyond every bucket) used to chunk-prefill alone at
+    B=1; chunk ROUNDS batch rows of different requests at their own
+    absolute offsets. Proof: 4 concurrent long prompts consume ~1 round
+    per chunk, not 4, and outputs stay identical to solo runs."""
+    def build():
+        return _engine(max_batch=4, max_seq_len=256, num_pages=96,
+                       prefill_buckets=(32,), prefill_max_batch=4)
+
+    engine = _engine(max_batch=4, max_seq_len=256, num_pages=96,
+                     prefill_buckets=(32,), prefill_max_batch=4)
+    # 80 tokens > largest bucket 32 -> chunked (3 chunks of <=32)
+    prompt = engine.tokenizer.encode("z" * 79)
+    assert len(prompt) == 80
+
+    solo = _greedy(engine, prompt, max_tokens=5)
+
+    engine2 = build()
+
+    async def run_concurrent():
+        await engine2.start()
+        try:
+            async def one():
+                out = []
+                async for tok in engine2.generate(prompt, max_tokens=5):
+                    out.append(tok)
+                return out
+            return await asyncio.gather(*[one() for _ in range(4)])
+        finally:
+            await engine2.stop()
+
+    results = asyncio.run(run_concurrent())
+    assert all(r == solo for r in results), (solo, results)
+    # 4 requests x 3 chunks: batched rounds need ~3-6 prefill dispatches
+    # (arrival stagger can split the first round), never the serial 12
+    assert engine2.stats.prefill_batches <= 8, engine2.stats.prefill_batches
