@@ -210,6 +210,86 @@ def auth_context_oracle(mod: types.ModuleType) -> None:
     assert anon.token_jti is None and anon.server_id is None
 
 
+# ------------------------------------------------- int8 quantization
+
+def quantize_oracle(mod: types.ModuleType) -> None:
+    """Behavioral spec of quantize.py: exact scales, exact rounding, both
+    matmul forms, gather, rule mapping. A surviving mutant here means a
+    silent numerics fault in the serving weight path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    w = np.array([[1.0, -2.0], [3.0, 0.5], [-0.25, 4.0]], np.float32)
+    leaf = mod.quantize_leaf(w, axis=0)
+    assert leaf["q"].dtype == jnp.int8
+    np.testing.assert_allclose(np.asarray(leaf["s"]),
+                               [3.0 / 127, 4.0 / 127], rtol=1e-6)
+    scales = np.asarray(leaf["s"])
+    np.testing.assert_array_equal(
+        np.asarray(leaf["q"]),
+        np.round(w / scales[None]).astype(np.int8))
+    recon = np.asarray(leaf["q"], np.float32) * scales[None]
+
+    # all-zero weights hit the epsilon clamp EXACTLY (no zero-division)
+    tiny = mod.quantize_leaf(np.zeros((2, 2), np.float32), axis=0)
+    np.testing.assert_allclose(np.asarray(tiny["s"]), np.float32(1e-8),
+                               rtol=0)
+
+    # qmm: quant path equals x @ reconstruction; plain path exact
+    x = jnp.asarray(np.array([[1.0, 0.0, 2.0]], np.float32))
+    np.testing.assert_allclose(np.asarray(mod.qmm(x, leaf)),
+                               np.asarray(x) @ recon, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(mod.qmm(x, jnp.asarray(w))),
+                               np.asarray(x @ jnp.asarray(w)), rtol=1e-6)
+
+    # per-ROW table (embedding) + transposed head form
+    emb = np.array([[1.0, 2.0], [3.0, -4.0], [0.5, 0.25]], np.float32)
+    leaf_e = mod.quantize_leaf(emb, axis=1)
+    recon_e = (np.asarray(leaf_e["q"], np.float32)
+               * np.asarray(leaf_e["s"])[:, None])
+    xt = jnp.asarray(np.array([[1.0, -1.0]], np.float32))
+    np.testing.assert_allclose(np.asarray(mod.qmm_t(xt, leaf_e)),
+                               np.asarray(xt) @ recon_e.T, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(mod.qmm_t(xt, jnp.asarray(emb))),
+                               np.asarray(xt) @ emb.T, rtol=1e-6)
+
+    # gather: quantized rows reconstruct; plain rows pass through exactly
+    rows = np.asarray(mod.embed_rows(leaf_e, jnp.asarray([2, 0])))
+    np.testing.assert_allclose(rows, recon_e[[2, 0]], rtol=1e-5)
+    np.testing.assert_array_equal(
+        np.asarray(mod.embed_rows(jnp.asarray(emb), jnp.asarray([1]))),
+        emb[[1]])
+
+    # discrimination + rule mapping
+    assert mod.is_quant(leaf)
+    assert not mod.is_quant(w) and not mod.is_quant({"q": 1})
+    logical = mod.quantize_logical({"embed": "vocab_in",
+                                    "norm": "replicated"})
+    assert logical["embed"] == {"q": "vocab_in", "s": "scale_model"}
+    assert logical["norm"] == "replicated"
+    tree = mod.quantize_tree({"embed": emb,
+                              "norm": np.ones((3,), np.float32)},
+                             {"embed": "vocab_in", "norm": "replicated"})
+    assert mod.is_quant(tree["embed"]) and not mod.is_quant(tree["norm"])
+    # vocab_in reduces along axis 1 (per-ROW scales): must match leaf_e
+    np.testing.assert_array_equal(np.asarray(tree["embed"]["q"]),
+                                  np.asarray(leaf_e["q"]))
+    # every matmul-weight rule reduces along axis 0 (per-OUT-channel)
+    for name in ("vocab_out", "attn_qkv", "attn_out", "ffn_up", "ffn_down"):
+        out = mod.quantize_tree({"w": w}, {"w": name})
+        np.testing.assert_array_equal(np.asarray(out["w"]["q"]),
+                                      np.asarray(leaf["q"]))
+        expected_scale = ("scale_model"
+                          if name in ("vocab_out", "attn_qkv", "ffn_up")
+                          else "replicated")
+        assert mod.quantize_logical({"w": name})["w"]["s"] == expected_scale
+
+    abstract = jax.eval_shape(lambda: {"a": jnp.zeros((4,), jnp.int8),
+                                       "b": jnp.zeros((2,), jnp.float32)})
+    assert mod.param_bytes(abstract) == 4 + 8
+
+
 TARGETS: dict[str, MutationTarget] = {
     "jsonrpc": MutationTarget(
         rel_path="jsonrpc.py",
@@ -223,5 +303,11 @@ TARGETS: dict[str, MutationTarget] = {
         package="mcp_context_forge_tpu.services",
         oracle=auth_context_oracle,
         class_name="AuthContext",
+    ),
+    "quantize": MutationTarget(
+        rel_path="tpu_local/quantize.py",
+        module_name="mcp_context_forge_tpu.tpu_local.quantize",
+        package="mcp_context_forge_tpu.tpu_local",
+        oracle=quantize_oracle,
     ),
 }

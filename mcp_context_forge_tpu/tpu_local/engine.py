@@ -653,13 +653,18 @@ class TPUEngine:
         one dispatch + one device_get per k tokens. ``ctx_pages`` is the
         static context-width bucket. Returns ([k, B] tokens, kv)."""
         k = self.config.decode_block
+        # the write mask is fixed for the WHOLE block from the initial
+        # lens (inside the scan lens increment for every row, so a
+        # len-derived mask would "activate" idle rows on later sub-steps)
+        active = seq_lens > 0
 
         def step(carry, step_key):
             step_tokens, step_positions, step_lens, step_kv = carry
             logits, step_kv = decode_step(params, self.model_config,
                                           step_tokens, step_positions, step_kv,
                                           slot_ids, step_lens,
-                                          ctx_pages=ctx_pages)
+                                          ctx_pages=ctx_pages,
+                                          write_mask=active)
             sampled = sample_tokens(logits, sampling, step_key)
             return (sampled, step_positions + 1, step_lens + 1, step_kv), sampled
 
@@ -940,14 +945,27 @@ class TPUEngine:
         if not self._pending or not free_slots:
             return False
 
-        head = self._pending[0]
-        bucket = self._assign_bucket(head)
-        if head.chunked and len(self._chunking) >= config.prefill_max_batch:
-            # chunk rounds advance at most prefill_max_batch rows: admitting
-            # more chunkers would pin full-prompt page allocations that sit
-            # idle for rounds, starving short requests under page pressure —
-            # they wait in _pending holding nothing instead
+        # chunk rounds advance at most prefill_max_batch rows: admitting
+        # more chunkers would pin full-prompt page allocations that sit
+        # idle for rounds — but a chunked HEAD at capacity must not block
+        # the short requests behind it either, so capacity-blocked
+        # chunkers step aside (keeping FIFO among themselves) and the
+        # next admissible request leads the group
+        deferred: list[GenRequest] = []
+        head: GenRequest | None = None
+        while self._pending:
+            candidate = self._pending[0]
+            if (self._assign_bucket(candidate) != 0 and candidate.chunked
+                    and len(self._chunking) >= config.prefill_max_batch):
+                deferred.append(self._pending.popleft())
+                continue
+            head = candidate
+            break
+        if head is None:
+            for request in reversed(deferred):
+                self._pending.appendleft(request)
             return False
+        bucket = self._assign_bucket(head)
         # history rows run the gathered-context attention path, which costs
         # O(S * max_context) regardless of hist — don't drag dense rows of
         # the same bucket through it (they'd pay for a hit they didn't get)
@@ -973,6 +991,8 @@ class TPUEngine:
             else:
                 skipped.append(request)
         for request in reversed(skipped):  # preserve FIFO for other buckets
+            self._pending.appendleft(request)
+        for request in reversed(deferred):  # capacity-blocked chunkers first
             self._pending.appendleft(request)
         if not group:
             return False
@@ -1101,14 +1121,12 @@ class TPUEngine:
         moves to decode."""
         config = self.config
         batch = list(self._chunking.values())[:config.prefill_max_batch]
-        if len(batch) == 1:
-            # solo: the smallest bucket covering the REMAINING span — a
-            # short final chunk must not pay a max-bucket-wide dispatch
-            remaining = len(batch[0].prompt_ids) - batch[0].chunk_pos
-            S = next((b for b in sorted(config.prefill_buckets)
-                      if remaining <= b), max(config.prefill_buckets))
-        else:
-            S = max(config.prefill_buckets)
+        # the smallest bucket covering the WIDEST remaining span this
+        # round — rows all on short final chunks must not pay a
+        # max-bucket-wide dispatch (every (B, bucket) pair is warmed)
+        max_remaining = max(len(r.prompt_ids) - r.chunk_pos for r in batch)
+        S = next((b for b in sorted(config.prefill_buckets)
+                  if max_remaining <= b), max(config.prefill_buckets))
         started = time.monotonic()
         rows: list[tuple[GenRequest, int, int]] = []
         max_end = 1

@@ -81,13 +81,22 @@ def write_prefill_kv(kv: PagedKVState, layer: int, k: jax.Array, v: jax.Array,
 
 
 def write_decode_kv(kv: PagedKVState, layer: int, k: jax.Array, v: jax.Array,
-                    slot_ids: jax.Array, positions: jax.Array) -> PagedKVState:
-    """Scatter one token per slot. k/v: [B,KV,hd]; positions: [B]."""
+                    slot_ids: jax.Array, positions: jax.Array,
+                    valid: jax.Array | None = None) -> PagedKVState:
+    """Scatter one token per slot. k/v: [B,KV,hd]; positions: [B];
+    valid: [B] bool — False rows write to the trash page. Inactive decode
+    rows MUST be masked explicitly: a slot can be allocated but not
+    decoding (mid-chunk-prefill), in which case its block-table row maps
+    REAL pages and an unmasked position-0 write would corrupt the
+    prompt's first page."""
     page_size = kv.page_size
     rows = kv.block_tables[slot_ids]                        # [B,P]
     pages = jnp.take_along_axis(rows, (positions // page_size)[:, None],
                                 axis=1)[:, 0]               # [B]
     offset = positions % page_size
+    if valid is not None:
+        pages = jnp.where(valid, pages, 0)                  # trash page
+        offset = jnp.where(valid, offset, 0)
     k_pages = kv.k_pages.at[layer, pages, offset].set(k, mode="drop")
     v_pages = kv.v_pages.at[layer, pages, offset].set(v, mode="drop")
     return kv._replace(k_pages=k_pages, v_pages=v_pages)

@@ -310,7 +310,8 @@ def _history_attention(q: jax.Array, keys: jax.Array, values: jax.Array,
 
 def decode_step(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
                 positions: jax.Array, kv: PagedKVState, slot_ids: jax.Array,
-                seq_lens: jax.Array, ctx_pages: int | None = None
+                seq_lens: jax.Array, ctx_pages: int | None = None,
+                write_mask: jax.Array | None = None
                 ) -> tuple[jax.Array, PagedKVState]:
     """One decode step over the paged cache.
 
@@ -318,7 +319,10 @@ def decode_step(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
     slot_ids: [B] block-table rows; seq_lens: [B] tokens already in cache
     (including this one after write); ctx_pages: STATIC context-width
     bucket — attention reads only the first ctx_pages table columns (the
-    engine guarantees every active row fits). Returns (logits [B,V], kv).
+    engine guarantees every active row fits); write_mask: [B] bool —
+    False rows write to the trash page (a slot can be allocated but NOT
+    decoding, e.g. mid-chunk-prefill, and must never be written by
+    decode). Returns (logits [B,V], kv).
     """
     B = tokens.shape[0]
     x = embed_rows(params["embed"], tokens)[:, None, :]  # [B,1,D]
@@ -327,7 +331,8 @@ def decode_step(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
     for idx, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], config.norm_eps)
         q, k, v = _attention_block(layer, config, h, pos)
-        kv = write_decode_kv(kv, idx, k[:, 0], v[:, 0], slot_ids, positions)
+        kv = write_decode_kv(kv, idx, k[:, 0], v[:, 0], slot_ids, positions,
+                             valid=write_mask)
         if use_pallas:
             from ..ops.paged_attention import paged_decode_attention_pallas
             G = config.n_heads // config.n_kv_heads

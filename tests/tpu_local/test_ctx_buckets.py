@@ -130,8 +130,7 @@ def test_chunk_rounds_batch_concurrent_long_prompts():
         return _engine(max_batch=4, max_seq_len=256, num_pages=96,
                        prefill_buckets=(32,), prefill_max_batch=4)
 
-    engine = _engine(max_batch=4, max_seq_len=256, num_pages=96,
-                     prefill_buckets=(32,), prefill_max_batch=4)
+    engine = build()
     # 80 tokens > largest bucket 32 -> chunked (3 chunks of <=32)
     prompt = engine.tokenizer.encode("z" * 79)
     assert len(prompt) == 80
@@ -157,3 +156,45 @@ def test_chunk_rounds_batch_concurrent_long_prompts():
     # 4 requests x 3 chunks: batched rounds need ~3-6 prefill dispatches
     # (arrival stagger can split the first round), never the serial 12
     assert engine2.stats.prefill_batches <= 8, engine2.stats.prefill_batches
+
+
+def test_decode_overlap_does_not_corrupt_mid_chunk_kv():
+    """THE interleaving hazard: a request decoding while another is
+    mid-chunk-prefill. Decode dispatches cover every slot; mid-chunk
+    slots have REAL pages mapped, so an unmasked inactive-row write
+    (position 0) would silently overwrite the chunker's first prompt
+    page. The chunker's output must equal its solo output even when
+    decode steps run between its chunk rounds."""
+    def build():
+        return _engine(max_batch=2, max_seq_len=256, num_pages=96,
+                       prefill_buckets=(16,), prefill_max_batch=1)
+
+    solo_engine = build()
+    long_prompt = solo_engine.tokenizer.encode("w" * 99)  # 100 tok, 7 chunks
+    solo = _greedy(solo_engine, long_prompt, max_tokens=5)
+
+    engine = build()
+
+    async def run():
+        await engine.start()
+        try:
+            short_prompt = engine.tokenizer.encode("s" * 10)
+
+            async def consume(prompt, n):
+                out = []
+                async for tok in engine.generate(prompt, max_tokens=n):
+                    out.append(tok)
+                return out
+
+            # the short request decodes first (stream until done) WHILE the
+            # long prompt advances through its 7 chunk rounds
+            short_task = asyncio.ensure_future(consume(short_prompt, 40))
+            # let the short request get admitted and decoding
+            await asyncio.sleep(0.15)
+            long_out = await consume(long_prompt, 5)
+            await short_task
+            return long_out
+        finally:
+            await engine.stop()
+
+    assert asyncio.run(run()) == solo
