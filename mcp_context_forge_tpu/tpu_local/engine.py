@@ -955,7 +955,14 @@ class TPUEngine:
         head: GenRequest | None = None
         while self._pending:
             candidate = self._pending[0]
-            if (self._assign_bucket(candidate) != 0 and candidate.chunked
+            if self._assign_bucket(candidate) == 0:
+                # oversized requests behind deferred chunkers reject here —
+                # promoting one to head would admit it with bucket 0
+                self._pending.popleft()
+                candidate.finish_reason = "length"
+                self._post_tokens(candidate, [], done=True)
+                continue
+            if (candidate.chunked
                     and len(self._chunking) >= config.prefill_max_batch):
                 deferred.append(self._pending.popleft())
                 continue

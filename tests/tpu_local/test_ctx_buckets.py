@@ -198,3 +198,36 @@ def test_decode_overlap_does_not_corrupt_mid_chunk_kv():
             await engine.stop()
 
     assert asyncio.run(run()) == solo
+
+
+def test_oversized_prompt_behind_blocked_chunker_rejects_cleanly():
+    """An over-long prompt queued behind a capacity-blocked chunker must
+    reject with finish_reason=length — never become the admission head
+    with bucket 0 (which would crash the dispatch thread)."""
+    engine = _engine(max_batch=2, max_seq_len=64, num_pages=96,
+                     prefill_buckets=(16,), prefill_max_batch=1)
+
+    async def run():
+        await engine.start()
+        try:
+            async def consume(prompt, n):
+                out = []
+                async for tok in engine.generate(prompt, max_tokens=n):
+                    out.append(tok)
+                return out
+
+            # two chunked prompts: the second defers behind chunking capacity
+            long_prompt = engine.tokenizer.encode("c" * 50)   # 51 tok, chunked
+            oversized = list(range(70))                       # > max_seq_len-1
+            t1 = asyncio.ensure_future(consume(long_prompt, 3))
+            t2 = asyncio.ensure_future(consume(long_prompt, 3))
+            await asyncio.sleep(0.05)
+            bad = await asyncio.wait_for(consume(oversized, 3), 10.0)
+            assert bad == []                                  # length-rejected
+            out1, out2 = await asyncio.gather(t1, t2)
+            assert len(out1) == 3 and out1 == out2            # engine healthy
+            return True
+        finally:
+            await engine.stop()
+
+    assert asyncio.run(run())
