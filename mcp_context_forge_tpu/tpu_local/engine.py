@@ -931,15 +931,7 @@ class TPUEngine:
         if not self._pending:
             return False
 
-        # reject oversized prompts immediately
-        while self._pending:
-            head = self._pending[0]
-            if self._assign_bucket(head) != 0:
-                break
-            self._pending.popleft()
-            head.finish_reason = "length"
-            self._post_tokens(head, [], done=True)
-
+        # (oversized prompts reject inside the head-selection scan below)
         free_slots = [s for s in range(config.max_batch)
                       if s not in self._running and s not in self._chunking]
         if not self._pending or not free_slots:

@@ -11,6 +11,7 @@ when ``dialect: anthropic`` is configured.
 
 from __future__ import annotations
 
+import json
 import time
 from abc import ABC, abstractmethod
 from typing import Any, AsyncIterator
@@ -311,6 +312,98 @@ class DialectProvider(LLMProvider):
             resp = await client.post(url, json=body, headers=headers)
             resp.raise_for_status()
             return self.transform_response(request.get("model", ""), resp.json())
+
+    # ------------------------------------------------------------ streaming
+
+    @staticmethod
+    def _chunk(chunk_id: str, model: str, text: str | None,
+               finish: str | None = None) -> dict[str, Any]:
+        """One OpenAI stream chunk. ``chunk_id`` is per-STREAM: every
+        delta of a completion must share the id (clients aggregate by it;
+        same convention as tpu_provider.chat_stream)."""
+        delta: dict[str, Any] = {}
+        if text:
+            delta = {"role": "assistant", "content": text}
+        return {"id": chunk_id,
+                "object": "chat.completion.chunk",
+                "created": int(time.time()), "model": model,
+                "choices": [{"index": 0, "delta": delta,
+                             "finish_reason": finish}]}
+
+    async def chat_stream(self, request: dict[str, Any]
+                          ) -> AsyncIterator[dict[str, Any]]:
+        """Streamed chat translated back to OpenAI chunk shape (reference
+        `llm_proxy_service.py:529` + `_transform_anthropic_stream_chunk:774`
+        / `_transform_ollama_stream_chunk:824`): anthropic SSE
+        content_block_delta events, ollama ndjson lines, azure/watsonx
+        OpenAI-shaped SSE passthrough. bedrock/vertex stream with binary
+        event framing the gateway doesn't speak — those fall back to the
+        one-shot default."""
+        if self.dialect not in ("anthropic", "ollama", "azure_openai",
+                                "watsonx"):
+            async for chunk in super().chat_stream(request):
+                yield chunk
+            return
+        model = request.get("model", "")
+        url, headers, body = self.build_request(request)
+        if self.dialect == "watsonx":
+            # watsonx streams on a SIBLING endpoint, not a body flag
+            url = url.replace("/ml/v1/text/chat?", "/ml/v1/text/chat_stream?")
+        body["stream"] = True
+        chunk_id = f"chatcmpl-{new_id()[:24]}"
+        async with httpx.AsyncClient(timeout=self.timeout) as client:
+            async with client.stream("POST", url, json=body,
+                                     headers=headers) as resp:
+                resp.raise_for_status()
+                async for line in resp.aiter_lines():
+                    line = line.strip()
+                    if not line:
+                        continue
+                    if self.dialect == "ollama":       # ndjson, one obj/line
+                        event = json.loads(line)
+                        if event.get("error"):
+                            raise LLMError(f"ollama stream: {event['error']}")
+                        text = (event.get("message") or {}).get("content", "")
+                        if text:
+                            yield self._chunk(chunk_id, model, text)
+                        if event.get("done"):
+                            finish = ("length"
+                                      if event.get("done_reason") == "length"
+                                      else "stop")
+                            yield self._chunk(chunk_id, model, None, finish)
+                            return
+                        continue
+                    if not line.startswith("data:"):
+                        continue                       # SSE comments/events
+                    payload = line[5:].strip()
+                    if payload == "[DONE]":
+                        return
+                    event = json.loads(payload)
+                    if self.dialect == "anthropic":
+                        kind = event.get("type")
+                        if kind == "error":
+                            # mid-stream abort (overloaded etc.): surface it
+                            # — swallowing would masquerade as a clean,
+                            # short completion
+                            raise LLMError(
+                                "anthropic stream error: "
+                                f"{(event.get('error') or {}).get('type')}")
+                        if kind == "content_block_delta":
+                            text = (event.get("delta") or {}).get("text", "")
+                            if text:
+                                yield self._chunk(chunk_id, model, text)
+                        elif kind == "message_delta":
+                            stop = (event.get("delta") or {}).get("stop_reason")
+                            if stop:
+                                yield self._chunk(
+                                    chunk_id, model, None,
+                                    {"end_turn": "stop",
+                                     "max_tokens": "length"}.get(stop, "stop"))
+                        elif kind == "message_stop":
+                            return
+                    else:  # azure_openai / watsonx: OpenAI-shaped chunks
+                        event.setdefault("model", model)
+                        yield event
 
 
 class LLMProviderRegistry:

@@ -220,3 +220,197 @@ async def test_provider_service_wires_dialects():
         assert model == "claude-v2"
     finally:
         await gateway.close()
+
+
+async def test_anthropic_stream_translation():
+    """Anthropic SSE content_block_delta events become OpenAI chunks
+    (reference _transform_anthropic_stream_chunk)."""
+    async def handler(request):
+        body = await request.json()
+        assert body["stream"] is True
+        resp = web.StreamResponse(
+            headers={"content-type": "text/event-stream"})
+        await resp.prepare(request)
+        events = [
+            {"type": "message_start", "message": {}},
+            {"type": "content_block_delta", "delta": {"type": "text_delta",
+                                                      "text": "hel"}},
+            {"type": "content_block_delta", "delta": {"type": "text_delta",
+                                                      "text": "lo"}},
+            {"type": "message_delta", "delta": {"stop_reason": "end_turn"}},
+            {"type": "message_stop"},
+        ]
+        for event in events:
+            await resp.write(f"data: {json.dumps(event)}\n\n".encode())
+        return resp
+
+    stub = await _stub(handler, "/v1/messages")
+    try:
+        provider = DialectProvider("an", "anthropic", api_base=_base(stub),
+                                   api_key="k")
+        chunks = [c async for c in provider.chat_stream(
+            {"model": "claude-3", "messages": MESSAGES, "max_tokens": 16})]
+        text = "".join(c["choices"][0]["delta"].get("content", "")
+                       for c in chunks)
+        assert text == "hello"
+        assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+        assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+    finally:
+        await stub.close()
+
+
+async def test_ollama_stream_translation():
+    """Ollama ndjson lines become OpenAI chunks (reference
+    _transform_ollama_stream_chunk)."""
+    async def handler(request):
+        resp = web.StreamResponse(
+            headers={"content-type": "application/x-ndjson"})
+        await resp.prepare(request)
+        lines = [
+            {"message": {"role": "assistant", "content": "ll"}, "done": False},
+            {"message": {"role": "assistant", "content": "ama"}, "done": False},
+            {"message": {"role": "assistant", "content": ""}, "done": True},
+        ]
+        for line in lines:
+            await resp.write((json.dumps(line) + "\n").encode())
+        return resp
+
+    stub = await _stub(handler, "/api/chat")
+    try:
+        provider = DialectProvider("ol", "ollama", api_base=_base(stub))
+        chunks = [c async for c in provider.chat_stream(
+            {"model": "llama3", "messages": MESSAGES})]
+        text = "".join(c["choices"][0]["delta"].get("content", "")
+                       for c in chunks)
+        assert text == "llama"
+        assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+    finally:
+        await stub.close()
+
+
+async def test_azure_stream_passthrough():
+    """Azure answers OpenAI-shaped SSE already — chunks pass through with
+    the model field defaulted."""
+    async def handler(request):
+        resp = web.StreamResponse(
+            headers={"content-type": "text/event-stream"})
+        await resp.prepare(request)
+        chunk = {"object": "chat.completion.chunk",
+                 "choices": [{"index": 0, "delta": {"content": "hi"},
+                              "finish_reason": None}]}
+        await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+        await resp.write(b"data: [DONE]\n\n")
+        return resp
+
+    stub = await _stub(handler, "/openai/deployments/d/chat/completions")
+    try:
+        provider = DialectProvider("az", "azure_openai", api_base=_base(stub),
+                                   api_key="k", config={"deployment": "d"})
+        chunks = [c async for c in provider.chat_stream(
+            {"model": "gpt-4o", "messages": MESSAGES})]
+        assert chunks[0]["choices"][0]["delta"]["content"] == "hi"
+        assert chunks[0]["model"] == "gpt-4o"  # defaulted in passthrough
+    finally:
+        await stub.close()
+
+
+async def test_bedrock_stream_falls_back_to_oneshot():
+    """Dialects without a text stream protocol fall back to the one-shot
+    default (a single chunk carrying the whole completion)."""
+    async def handler(request):
+        return web.json_response({
+            "output": {"message": {"content": [{"text": "whole answer"}]}},
+            "stopReason": "end_turn", "usage": {}})
+
+    stub = await _stub(handler, "/model/m/converse")
+    try:
+        provider = DialectProvider("br", "bedrock", api_base=_base(stub))
+        chunks = [c async for c in provider.chat_stream(
+            {"model": "m", "messages": MESSAGES})]
+        assert len(chunks) == 1
+        assert chunks[0]["choices"][0]["delta"]["content"] == "whole answer"
+    finally:
+        await stub.close()
+
+
+async def test_ollama_stream_length_reason_and_shared_id():
+    async def handler(request):
+        resp = web.StreamResponse(
+            headers={"content-type": "application/x-ndjson"})
+        await resp.prepare(request)
+        lines = [
+            {"message": {"role": "assistant", "content": "tr"}, "done": False},
+            {"message": {"role": "assistant", "content": "unc"}, "done": False},
+            {"message": {"content": ""}, "done": True, "done_reason": "length"},
+        ]
+        for line in lines:
+            await resp.write((json.dumps(line) + "\n").encode())
+        return resp
+
+    stub = await _stub(handler, "/api/chat")
+    try:
+        provider = DialectProvider("ol", "ollama", api_base=_base(stub))
+        chunks = [c async for c in provider.chat_stream(
+            {"model": "llama3", "messages": MESSAGES, "max_tokens": 2})]
+        # truncation is visible to streaming clients, like the one-shot path
+        assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+        # every delta of one completion shares the stream id
+        assert len({c["id"] for c in chunks}) == 1
+    finally:
+        await stub.close()
+
+
+async def test_anthropic_stream_error_event_raises():
+    """A mid-stream abort (overloaded_error) must surface as an error —
+    not masquerade as a clean short completion."""
+    import pytest
+
+    async def handler(request):
+        resp = web.StreamResponse(
+            headers={"content-type": "text/event-stream"})
+        await resp.prepare(request)
+        events = [
+            {"type": "content_block_delta", "delta": {"type": "text_delta",
+                                                      "text": "par"}},
+            {"type": "error", "error": {"type": "overloaded_error"}},
+        ]
+        for event in events:
+            await resp.write(f"data: {json.dumps(event)}\n\n".encode())
+        return resp
+
+    stub = await _stub(handler, "/v1/messages")
+    try:
+        provider = DialectProvider("an", "anthropic", api_base=_base(stub),
+                                   api_key="k")
+        with pytest.raises(LLMError):
+            async for _ in provider.chat_stream(
+                    {"model": "claude-3", "messages": MESSAGES}):
+                pass
+    finally:
+        await stub.close()
+
+
+async def test_watsonx_stream_uses_sibling_endpoint():
+    """watsonx streams on /ml/v1/text/chat_stream (not a body flag on the
+    chat endpoint) and answers OpenAI-shaped SSE."""
+    async def handler(request):
+        resp = web.StreamResponse(
+            headers={"content-type": "text/event-stream"})
+        await resp.prepare(request)
+        chunk = {"object": "chat.completion.chunk",
+                 "choices": [{"index": 0, "delta": {"content": "wx"},
+                              "finish_reason": None}]}
+        await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+        await resp.write(b"data: [DONE]\n\n")
+        return resp
+
+    stub = await _stub(handler, "/ml/v1/text/chat_stream")
+    try:
+        provider = DialectProvider("wx", "watsonx", api_base=_base(stub),
+                                   api_key="t", config={"project_id": "p"})
+        chunks = [c async for c in provider.chat_stream(
+            {"model": "granite", "messages": MESSAGES})]
+        assert chunks[0]["choices"][0]["delta"]["content"] == "wx"
+        assert chunks[0]["model"] == "granite"
+    finally:
+        await stub.close()
