@@ -231,3 +231,8 @@ def test_oversized_prompt_behind_blocked_chunker_rejects_cleanly():
             await engine.stop()
 
     assert asyncio.run(run())
+
+
+# (the spec-decode x chunked-prefill losslessness test lives in
+# test_real_checkpoint.py — random weights never ACCEPT a draft, so only
+# a trained, repetitive model exercises the accepted-draft path)

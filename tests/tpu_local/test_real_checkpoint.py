@@ -104,3 +104,46 @@ def test_quantized_engine_same_checkpoint(checkpoint_dir):
 
     out = asyncio.run(main())
     assert "rome" in out, out
+
+
+def test_spec_decode_after_chunked_prefill_accepts_drafts(checkpoint_dir):
+    """Spec decoding and chunk rounds share the history path. Random
+    weights never accept a draft (greedy output doesn't echo the prompt),
+    so this runs on the TRAINED checkpoint: a long repeated-fact prompt
+    chunk-prefills, the model's memorized continuation repeats the
+    phrase, prompt-lookup drafts genuinely ACCEPT — and the output must
+    still exactly equal the plain engine's (lossless by construction)."""
+    from mcp_context_forge_tpu.tpu_local.engine import EngineConfig, TPUEngine
+
+    def build(spec: bool) -> TPUEngine:
+        return TPUEngine(EngineConfig(
+            model="llama3-test", checkpoint=checkpoint_dir, max_batch=2,
+            max_seq_len=128, page_size=16, num_pages=96,
+            prefill_buckets=(16, 32), dtype="float32",
+            attn_impl="reference", spec_decode=spec))
+
+    def greedy(engine: TPUEngine, prompt: list[int], n: int) -> list[int]:
+        async def run():
+            await engine.start()
+            try:
+                out = []
+                async for tok in engine.generate(prompt, max_tokens=n):
+                    out.append(tok)
+                return out
+            finally:
+                await engine.stop()
+        return asyncio.run(run())
+
+    plain = build(False)
+    text = "the capital of france is paris. " * 6
+    prompt = plain.tokenizer.encode(text)
+    assert len(prompt) > 32  # beyond every bucket -> chunk rounds
+    expected = greedy(plain, prompt, 16)
+
+    spec = build(True)
+    out = greedy(spec, prompt, 16)
+    assert out == expected
+    assert spec.stats.spec_steps > 0
+    # the memorized continuation repeats the phrase: drafts ACCEPT
+    assert spec.stats.spec_tokens > 0, (
+        "no draft ever accepted — the interesting path stayed dark")
