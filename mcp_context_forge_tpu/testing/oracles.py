@@ -290,6 +290,69 @@ def quantize_oracle(mod: types.ModuleType) -> None:
     assert mod.param_bytes(abstract) == 4 + 8
 
 
+# ---------------------------------------------------- RateLimiter
+
+def rate_limiter_oracle(mod: types.ModuleType) -> None:
+    """Token-bucket semantics: burst honored exactly, refill at rps,
+    recency-ordered eviction, rps<=0 disables. A surviving mutant is a
+    silent DoS-protection fault."""
+    import time as _time
+
+    RL = mod.RateLimiter
+
+    # burst: exactly `burst` immediate requests pass, the next fails
+    limiter = RL(rps=1, burst=3)
+    assert [limiter.allow("k") for _ in range(4)] == [True, True, True, False]
+
+    # refill: advance time by 2s at 5 rps -> 10 tokens, capped at burst 3
+    limiter = RL(rps=5, burst=3)
+    for _ in range(3):
+        assert limiter.allow("k")
+    assert not limiter.allow("k")
+    tokens, last = limiter._buckets["k"]
+    limiter._buckets["k"] = (tokens, last - 2.0)  # simulate 2s elapsed
+    results = [limiter.allow("k") for _ in range(4)]
+    assert results == [True, True, True, False], results
+
+    # independent buckets per key
+    limiter = RL(rps=1, burst=1)
+    assert limiter.allow("a")
+    assert limiter.allow("b")
+    assert not limiter.allow("a")
+
+    # disabled limiter always allows and stores nothing
+    off = RL(rps=0, burst=1)
+    assert all(off.allow("x") for _ in range(5))
+    assert not off._buckets
+
+    # recency-ordered eviction: oldest-seen key leaves first
+    limiter = RL(rps=1, burst=1, max_buckets=3)
+    for key in ("k0", "k1", "k2"):
+        limiter.allow(key)
+    limiter.allow("k0")          # refresh k0
+    limiter.allow("k3")          # overflow -> evict k1 (oldest)
+    assert "k1" not in limiter._buckets
+    assert {"k0", "k2", "k3"} <= set(limiter._buckets)
+    assert len(limiter._buckets) == 3
+
+    # sweep prunes only refilled-to-full buckets (back-dated timestamps —
+    # no wall-clock sleeps in a per-mutant campaign)
+    limiter = RL(rps=100, burst=1)
+    now = _time.monotonic()
+    limiter._buckets["gone"] = (0.0, now - 1.0)   # refilled to full long ago
+    limiter._buckets["hot"] = (0.0, now + 100)    # never full
+    limiter._sweep(now)
+    assert "gone" not in limiter._buckets
+    assert "hot" in limiter._buckets
+    # boundary: an EXACTLY-full bucket is state-free and must prune (the
+    # documented sweep contract — recreating it at full burst is identical)
+    limiter = RL(rps=1, burst=2)
+    now = _time.monotonic()
+    limiter._buckets["edge"] = (2.0, now)
+    limiter._sweep(now)
+    assert "edge" not in limiter._buckets
+
+
 TARGETS: dict[str, MutationTarget] = {
     "jsonrpc": MutationTarget(
         rel_path="jsonrpc.py",
@@ -309,5 +372,18 @@ TARGETS: dict[str, MutationTarget] = {
         module_name="mcp_context_forge_tpu.tpu_local.quantize",
         package="mcp_context_forge_tpu.tpu_local",
         oracle=quantize_oracle,
+    ),
+    "rate_limiter": MutationTarget(
+        rel_path="gateway/middleware.py",
+        module_name="mcp_context_forge_tpu.gateway.middleware",
+        package="mcp_context_forge_tpu.gateway",
+        oracle=rate_limiter_oracle,
+        class_name="RateLimiter",
+        # 173: the max_buckets DEFAULT-value line — nudging the 100_000
+        # cap by one is behaviorally equivalent (oracle passes explicit
+        # caps). 190: the sweep-trigger compare `now >= _next_sweep` vs
+        # `>` differs only at exact monotonic-clock equality (measure
+        # zero — the sweep just fires one tick later).
+        equivalent_lines=frozenset({173, 190}),
     ),
 }
