@@ -462,6 +462,7 @@ def setup_routes(app: web.Application) -> None:
             "prefill_ms_total": round(stats.prefill_ms_total, 1),
             "decode_ms_total": round(stats.decode_ms_total, 1),
             "engine_restarts": stats.engine_restarts,
+            "chunking": stats.chunking,  # long prompts mid-chunk-prefill
             "prefix_cache": {
                 "enabled": engine.config.prefix_cache,
                 "cached_pages": alloc.cached_pages,

@@ -189,6 +189,7 @@ class EngineStats:
         self.prefill_ms_total = 0.0   # device wall inside prefill dispatches
         self.decode_ms_total = 0.0    # device wall inside decode dispatches
         self.engine_restarts = 0      # crash-recovery restarts (auto_restart)
+        self.chunking = 0             # long prompts mid-chunk-prefill
 
 
 class EngineInitTimeout(RuntimeError):
@@ -758,6 +759,7 @@ class TPUEngine:
                         self._decode_step_all()
                     did_work = True
                 self.stats.queue_depth = self._work.qsize() + len(self._pending)
+                self.stats.chunking = len(self._chunking)
                 if not did_work:
                     time.sleep(0.001)
         except Exception:

@@ -1,0 +1,59 @@
+"""ByteTokenizer property tests (hypothesis): the round-3 special-token
+handling must never break the byte-level roundtrip invariant, and
+template markers must encode to exactly one token wherever they appear.
+"""
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from mcp_context_forge_tpu.tpu_local.tokenizer import ByteTokenizer, render_chat
+
+TOK = ByteTokenizer()
+
+
+@given(st.text(max_size=300))
+@settings(max_examples=200, deadline=None)
+def test_plain_text_roundtrips(text):
+    """Text without template markers: encode/decode is the identity (up
+    to utf-8 replacement of unpaired surrogates, which encode() already
+    normalizes)."""
+    ids = TOK.encode(text, add_bos=False)
+    normalized = text.encode("utf-8", errors="replace").decode("utf-8")
+    assert TOK.decode(ids) == normalized
+    # no byte sequence may accidentally produce a special/reserved id
+    assert all(0 <= i < 256 for i in ids)
+
+
+@given(st.lists(st.sampled_from(
+    list(ByteTokenizer.SPECIALS) + ["plain", "x", "<|", "|>", ""]),
+    min_size=0, max_size=12))
+@settings(max_examples=200, deadline=None)
+def test_specials_encode_as_single_tokens(parts):
+    """Any interleaving of markers and plain text: each marker is ONE
+    token (>=259), markers never survive into decoded text, and the
+    plain-text bytes are preserved in order."""
+    text = "".join(parts)
+    ids = TOK.encode(text, add_bos=False)
+    n_specials = sum(1 for p in parts if p in ByteTokenizer.SPECIALS)
+    assert sum(1 for i in ids if i >= 259) == n_specials
+    plain = "".join(p for p in parts if p not in ByteTokenizer.SPECIALS)
+    assert TOK.decode(ids) == plain
+
+
+@given(st.text(alphabet=st.characters(blacklist_characters="<|>"),
+               max_size=120))
+@settings(max_examples=100, deadline=None)
+def test_chat_template_token_budget(content):
+    """The rendered chat scaffolding costs a CONSTANT 6 tokens (3 markers
+    x 2 headers + 2 role words + 2 newlines... measured as total minus
+    content bytes), independent of content — the property that keeps CPU
+    prefill costs honest."""
+    ids = TOK.encode(render_chat([{"role": "user", "content": content}]),
+                     add_bos=False)
+    content_bytes = len(content.encode("utf-8", errors="replace"))
+    overhead = len(ids) - content_bytes
+    # user hdr (2 specials + 'user' + \n) + eot + assistant hdr (2 specials
+    # + 'assistant' + \n) = fixed
+    assert overhead == TOK.encode(render_chat([{"role": "user",
+                                                "content": ""}]),
+                                  add_bos=False).__len__()
