@@ -1,6 +1,6 @@
 # mcp-context-forge-tpu (reference: 8.7k-line Makefile; the targets that matter)
 
-.PHONY: serve hub test test-fast test-two-process bench bench-engine wrapper masking clean \
+.PHONY: serve hub test test-py test-fast test-two-process bench bench-engine wrapper masking clean \
 	sanitize sanitize-tsan sanitize-asan
 
 serve:
@@ -19,7 +19,10 @@ supervise:
 compose-config:
 	python -c "import yaml; yaml.safe_load(open('docker-compose.yml')); print('ok')"
 
-test:
+# full gate: python suite + the C++ tier under TSAN and ASAN/UBSAN
+test: test-py sanitize
+
+test-py:
 	python -m pytest tests/ -q
 
 test-fast:
