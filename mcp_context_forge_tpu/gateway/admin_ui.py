@@ -118,9 +118,9 @@ function cell(v, isBool){
 }
 function renderEngine(stats){
   const order = ["requests","prompt_tokens","completion_tokens","decode_steps",
-                 "prefill_batches","queue_depth","kv_pages_in_use","prefix_hits",
-                 "prefix_hit_tokens","spec_steps","spec_tokens",
-                 "prefill_ms_total","decode_ms_total"];
+                 "prefill_batches","queue_depth","chunking","kv_pages_in_use",
+                 "prefix_hits","prefix_hit_tokens","spec_steps","spec_tokens",
+                 "prefill_ms_total","decode_ms_total","engine_restarts"];
   const cards = order.filter(k => k in stats).map(k =>
     `<div class="card"><b>${cell(stats[k])}</b><span>${k}</span></div>`).join("");
   const rest = Object.keys(stats).filter(k => !order.includes(k));
