@@ -353,6 +353,115 @@ def rate_limiter_oracle(mod: types.ModuleType) -> None:
     assert "edge" not in limiter._buckets
 
 
+# -------------------------------------------------- PageAllocator
+
+def page_allocator_oracle(mod: types.ModuleType) -> None:
+    """KV-page bookkeeping spec: capacity math, refcounted sharing,
+    prefix chains, LRU eviction, slot moves, trash-page reservation. A
+    surviving mutant is silent KV corruption or a page leak."""
+    PA = mod.PageAllocator
+
+    # capacity: page 0 reserved, ceil-division page math
+    alloc = PA(num_pages=8, page_size=4, max_slots=4, max_pages_per_slot=4)
+    assert alloc.free_pages == 7 and alloc.pages_in_use == 0
+    assert alloc.pages_needed(1) == 1 and alloc.pages_needed(4) == 1
+    assert alloc.pages_needed(5) == 2
+    assert alloc.can_allocate(28) and not alloc.can_allocate(29)
+
+    # allocation consumes exactly ceil(tokens/page) pages; page 0 never
+    # hands out
+    assert alloc.allocate_slot(0, 9)  # 3 pages
+    assert alloc.pages_in_use == 3 and alloc.free_pages == 4
+    assert 0 not in alloc._slots[0]
+
+    # per-slot cap enforced
+    assert not alloc.allocate_slot(1, 17)  # 5 pages > max_pages_per_slot
+    # pool exhaustion enforced
+    assert alloc.allocate_slot(1, 16)      # 4 pages -> pool empty
+    assert alloc.free_pages == 0
+    assert not alloc.allocate_slot(2, 1)
+
+    # extend grows by whole pages and respects both caps
+    alloc.free_slot(1)
+    assert alloc.free_pages == 4
+    assert alloc.extend_slot(0, 12)        # still 3 pages
+    assert alloc.pages_in_use == 3
+    assert alloc.extend_slot(0, 13)        # grows to 4
+    assert alloc.pages_in_use == 4
+    assert not alloc.extend_slot(0, 17)    # per-slot cap
+
+    # free returns everything
+    alloc.free_slot(0)
+    assert alloc.pages_in_use == 0 and alloc.free_pages == 7
+
+    # prefix chains: register full pages, probe is read-only, match
+    # refcounts, shared pages survive the owner's free
+    alloc = PA(num_pages=8, page_size=4, max_slots=4, max_pages_per_slot=4)
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]          # 2 full pages + 1 token
+    assert alloc.allocate_slot(0, len(prompt))
+    alloc.register_prefix(0, prompt)
+    assert alloc.cached_pages == 2
+    before_refs = dict(alloc._ref)
+    before_in_use = alloc.pages_in_use
+    assert alloc.probe_prefix(prompt) == 8        # full pages only
+    assert alloc.pages_in_use == before_in_use    # probe took nothing
+    assert alloc._ref == before_refs              # ...not even a refcount
+    # a prompt sharing ONE page matches one page
+    assert alloc.probe_prefix([1, 2, 3, 4, 99, 98, 97, 96, 95]) == 4
+    # the last token never matches (at least one must prefill)
+    assert alloc.probe_prefix([1, 2, 3, 4]) == 0
+
+    hist, shared = alloc.match_prefix(prompt)
+    assert hist == 8 and len(shared) == 2
+    assert alloc.allocate_slot(1, len(prompt), prefix_pages=shared)
+    assert alloc.prefix_hits == 1 and alloc.prefix_hit_tokens == 8
+    # shared pages counted once, refcounted at exactly 2
+    assert alloc.pages_in_use == 3 + 1 + 2 - 2    # 3 owner + 1 fresh
+    assert alloc._ref[shared[0]] == 2
+    alloc.free_slot(0)                            # owner leaves...
+    assert alloc._ref[shared[0]] == 1             # one reference released
+    table = alloc.tables()
+    import numpy as np
+    assert int(np.asarray(table)[1, 0]) == shared[0]  # ...sharer keeps pages
+
+    # unmatched release drops the references again
+    hist2, shared2 = alloc.match_prefix(prompt)
+    assert hist2 == 8
+    alloc.release_prefix(shared2)
+    alloc.free_slot(1)
+    # refcount zero + registered -> pages stay warm on the LRU, so the
+    # free list alone shrinks but free_pages (incl. evictable) is full
+    assert alloc.free_pages == 7
+    # matching LRU-RESIDENT pages (ref entry deleted at zero) starts the
+    # count from scratch: exactly one reference per matched page
+    hist3, shared3 = alloc.match_prefix(prompt)
+    assert hist3 == 8 and alloc._ref[shared3[0]] == 1
+    alloc.release_prefix(shared3)
+    assert alloc.free_pages == 7
+    # an allocation EXACTLY covered by shared pages (zero fresh) is valid
+    hist4, shared4 = alloc.match_prefix(prompt)
+    assert alloc.allocate_slot(2, 8, prefix_pages=shared4)
+    assert alloc.pages_in_use == 2
+    alloc.free_slot(2)
+
+    # eviction: allocation pressure reclaims LRU cache pages
+    for slot in range(3):
+        assert alloc.allocate_slot(slot, 8)       # 6 pages; evicts cache
+    assert alloc.allocate_slot(3, 4)              # the 7th page
+    assert alloc.free_pages == 0
+    assert alloc.cached_pages <= 1                # chain broken by eviction
+
+    # move_slot: pages follow the new id, old id empties
+    alloc = PA(num_pages=8, page_size=4, max_slots=4, max_pages_per_slot=4)
+    assert alloc.allocate_slot(3, 8)
+    pages = list(alloc._slots[3])
+    alloc.move_slot(3, 0)
+    assert alloc._slots[0] == pages and 3 not in alloc._slots
+    table = alloc.tables()
+    assert int(np.asarray(table)[0, 0]) == pages[0]
+    assert int(np.asarray(table)[3, 0]) == 0
+
+
 TARGETS: dict[str, MutationTarget] = {
     "jsonrpc": MutationTarget(
         rel_path="jsonrpc.py",
@@ -372,6 +481,20 @@ TARGETS: dict[str, MutationTarget] = {
         module_name="mcp_context_forge_tpu.tpu_local.quantize",
         package="mcp_context_forge_tpu.tpu_local",
         oracle=quantize_oracle,
+    ),
+    "page_allocator": MutationTarget(
+        rel_path="tpu_local/kv/paged_cache.py",
+        module_name="mcp_context_forge_tpu.tpu_local.kv.paged_cache",
+        package="mcp_context_forge_tpu.tpu_local.kv",
+        oracle=page_allocator_oracle,
+        class_name="PageAllocator",
+        # 183: _take_page's `key is not None and _cached.get(key) == page`
+        # — register_prefix maintains _page_key[page] == key iff
+        # _cached[key] == page, so the second conjunct is purely defensive
+        # and And->Or is equivalent under the invariant. 190: the
+        # defensive ref-default in _release_page (allocate/extend/match
+        # always set a ref first, so the default is unreachable).
+        equivalent_lines=frozenset({183, 190}),
     ),
     "rate_limiter": MutationTarget(
         rel_path="gateway/middleware.py",

@@ -185,7 +185,10 @@ class PageAllocator:
         return page
 
     def _release_page(self, page: int) -> None:
-        self._ref[page] = self._ref.get(page, 1) - 1
+        # defensive default: the allocate/extend/match paths always set a
+        # ref before a page can be released
+        current = self._ref.get(page, 1)
+        self._ref[page] = current - 1
         if self._ref[page] > 0:
             return
         del self._ref[page]
