@@ -204,6 +204,60 @@ async def _tools_call_load(gateway, auth, tool: str, total: int,
     return latencies, failures, wall
 
 
+async def _mp_load(gateway, *, mode: str, tool: str = "", model: str = "",
+                   total: int, concurrency: int, workers: int,
+                   max_tokens: int = 16) -> dict:
+    """Drive the gateway from ``workers`` separate OS processes.
+
+    VERDICT r3 #1: the 1k-concurrency north star cannot be measured from
+    the server's own event loop — client bookkeeping for 1000 in-flight
+    tasks would serialize with request handling and the numbers would be
+    client-side scheduling delay. Worker processes hold the sockets and
+    timestamp the requests; this box has ONE vCPU, so server + clients
+    still share a core (documented in the output as client_processes —
+    the honest caveat that p50 includes client-side scheduling under
+    oversubscription)."""
+    per = total // workers
+    conc = concurrency // workers
+    procs = []
+    env = dict(os.environ)
+    # axon sitecustomize registers the TPU PJRT plugin at EVERY interpreter
+    # start and can hang when the tunnel is down; workers never need jax
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
+    for w in range(workers):
+        spec = {"base": f"http://{gateway.server.host}:{gateway.server.port}",
+                "mode": mode, "tool": tool, "model": model,
+                "max_tokens": max_tokens, "total": per,
+                "concurrency": conc, "worker": w,
+                "user": "admin", "password": "changeme"}
+        procs.append(await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "mcp_context_forge_tpu.testing.loadgen",
+            json.dumps(spec), env=env, cwd=os.path.dirname(
+                os.path.abspath(__file__)) or ".",
+            stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.PIPE))
+    reports = []
+    for p in procs:
+        out, err = await p.communicate()
+        if p.returncode != 0:
+            raise RuntimeError(f"loadgen worker failed: {err[-400:]!r}")
+        reports.append(json.loads(out))
+    latencies = [x for r in reports for x in r["latencies_ms"]]
+    failures = sum(r["failures"] for r in reports)
+    wall = max(r["last_ts"] for r in reports) - min(
+        r["first_ts"] for r in reports)
+    errors: dict = {}
+    for r in reports:
+        for k, v in r["errors"].items():
+            errors[k] = errors.get(k, 0) + v
+    out = {**_percentiles(latencies), "failures": failures,
+           "requests": per * workers, "concurrency": conc * workers,
+           "client_processes": workers,
+           "rps": round(per * workers / max(wall, 1e-6), 2)}
+    if errors:
+        out["errors"] = errors
+    return out
+
+
 async def bench_config1(platform: str) -> dict:
     """Headline: tools/call through the non-LLM plugin chain."""
     from aiohttp import BasicAuth
@@ -254,6 +308,17 @@ async def bench_engine_configs(platform: str) -> dict:
                                                 200, 32)
         base_p50 = statistics.median(base_lat)
 
+        # --- north-star depth: 1k-concurrency baseline (no plugins yet)
+        deep_conc = int(os.environ.get("BENCH_1K_CONCURRENCY", "1000"))
+        deep_total = int(os.environ.get("BENCH_1K_TOTAL", "3000"))
+        deep_workers = int(os.environ.get("BENCH_1K_WORKERS", "4"))
+        deep = os.environ.get("BENCH_SKIP_1K") != "1"
+        if deep:
+            base_1k = await _mp_load(gateway, mode="tools_call",
+                                     tool="bench-tool", total=deep_total,
+                                     concurrency=deep_conc,
+                                     workers=deep_workers)
+
         # --- config2: classifier chain (content_moderation + harmful_content)
         pm = app["plugin_manager"]
         await pm.add_plugin(PluginConfig(name="mod", kind="content_moderation",
@@ -272,6 +337,23 @@ async def bench_engine_configs(platform: str) -> dict:
             "rps": round(300 / wall2, 2),
             "added_p50_ms": round(statistics.median(lat2) - base_p50, 2),
             "requests": 300}
+
+        # --- north star: the moderation chain at 1,000 concurrent calls
+        # (driver target: <200 ms p50 ADDED latency @ 1k concurrency).
+        # added p50 compares against the SAME-depth no-plugin baseline —
+        # comparing 1k-deep chain latency to a 32-deep baseline would
+        # launder queueing delay into "plugin cost"
+        if deep:
+            chain_1k = await _mp_load(gateway, mode="tools_call",
+                                      tool="bench-tool", total=deep_total,
+                                      concurrency=deep_conc,
+                                      workers=deep_workers)
+            out["config2_1k_concurrency"] = {
+                "baseline_no_plugins": base_1k,
+                "moderation_chain": chain_1k,
+                "added_p50_ms": round(chain_1k["p50_ms"] - base_1k["p50_ms"], 2),
+                "note": ("1-vCPU box: server + client processes share one "
+                         "core; p50 includes client-side scheduling")}
         await pm.remove_plugin("mod")
         await pm.remove_plugin("harm")
 

@@ -9,7 +9,7 @@ compile cache, so the timed region is steady-state.
 
 Artifacts:
 - ``tpu_capture_log.jsonl`` — every attempt (probe failures included)
-- ``BENCH_TPU_r03.json``   — best capture so far + the full A/B table
+- ``BENCH_TPU_r04.json``   — best capture so far + the full A/B table
 
 Usage: ``python tpu_capture.py [--once]`` (loop period via
 TPU_CAPTURE_PERIOD_S, default 600).
@@ -25,7 +25,7 @@ import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 LOG = os.path.join(REPO, "tpu_capture_log.jsonl")
-OUT = os.path.join(REPO, "BENCH_TPU_r03.json")
+OUT = os.path.join(REPO, "BENCH_TPU_r04.json")
 
 GRID = [
     {"BENCH_DECODE_BLOCK": "1", "BENCH_SPEC": "0"},
