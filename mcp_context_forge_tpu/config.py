@@ -89,23 +89,65 @@ class Settings(BaseModel):
     message_ttl: int = 600
     websocket_ping_interval: float = 20.0
 
-    # --- limits / validation ---
+    # --- limits / validation (reference validation_* family,
+    # config.py: validation_max_name_length .. validation_max_tag_length;
+    # enforced centrally on every create/update body in routers._body) ---
     max_request_size_bytes: int = 8 * 1024 * 1024
     max_header_bytes: int = 64 * 1024
+    max_header_count: int = 128            # 431 past this many fields
+    max_header_field_bytes: int = 16384    # 431 past this per-field size
     rate_limit_rps: int = 0  # 0 = disabled
     rate_limit_burst: int = 200
     validation_max_tool_name_length: int = 255
+    validation_max_name_length: int = 255
+    validation_max_description_length: int = 8192
+    validation_max_url_length: int = 2048
+    validation_max_tag_length: int = 64
+    validation_max_tags: int = 32
     max_prompt_size: int = 1024 * 1024
+    max_resource_size: int = 4 * 1024 * 1024
+
+    # --- per-entity caps (reference max_teams_per_user /
+    # max_members_per_team / mcpgateway_a2a_max_agents /
+    # mcpgateway_bulk_import_max_tools; 0 = unlimited) ---
+    max_teams_per_user: int = 50
+    max_members_per_team: int = 100
+    a2a_max_agents: int = 100
+    bulk_import_max_entities: int = 1000
+
+    # --- pagination (reference pagination_* family) ---
+    pagination_default_page_size: int = 50
+    pagination_max_page_size: int = 500
 
     # --- outbound invocation ---
     tool_timeout: float = 60.0
+    # outbound REST pool sizing (reference: httpx limits / aiohttp connector
+    # knobs). per_host=0 = unlimited per host: a gateway fronting ONE busy
+    # upstream must not self-throttle below its own concurrency (the global
+    # cap still bounds sockets)
+    outbound_pool_limit: int = 1024
+    outbound_pool_limit_per_host: int = 0
     max_tool_retries: int = 3
     retry_base_delay: float = 0.25
     retry_max_delay: float = 8.0
     gateway_health_interval: float = 60.0
     gateway_failure_threshold: int = 3
+    max_concurrent_health_checks: int = 10  # health-loop fan-out bound
     federation_timeout: float = 30.0
     skip_ssl_verify: bool = False
+    # outbound HTTP pool shaping (reference httpx_* family)
+    http_max_connections: int = 512
+    http_max_keepalive: int = 128
+    http_connect_timeout: float = 10.0
+    # --- TLS: serving + outbound contexts (reference ssl_context_cache,
+    # utils/ssl_context_cache; contexts are built once per distinct
+    # (ca, cert, key) triple and cached — building one per request costs
+    # ~10 ms and re-reads the bundle from disk) ---
+    ssl_enabled: bool = False     # serve HTTPS (cert+key below)
+    ssl_cert_file: str = ""
+    ssl_key_file: str = ""
+    ssl_ca_bundle: str = ""       # custom CA bundle for OUTBOUND verification
+    ssl_context_cache_size: int = 32
     # upstream MCP session pooling (reference session registry caps)
     upstream_max_sessions: int = 128
     upstream_idle_ttl: float = 300.0
@@ -210,12 +252,27 @@ class Settings(BaseModel):
     # default for security; sensitive headers need per-gateway opt-in) ---
     enable_header_passthrough: bool = False
     default_passthrough_headers: str = "x-tenant-id,x-trace-id"
+    # passthrough may REPLACE headers the gateway itself set (auth headers
+    # from tool config, content negotiation) — off: gateway wins
+    enable_overwrite_base_headers: bool = False
+    # allow authorization/cookie through the GLOBAL default list (per-
+    # gateway allowlists always may) — reference
+    # enable_sensitive_header_passthrough, off for credential hygiene
+    enable_sensitive_header_passthrough: bool = False
     # --- response compression (reference SSEAwareCompressMiddleware) ---
     compression_enabled: bool = True
     compression_min_bytes: int = 1024
     # --- host validation: comma-separated allowed Host headers; '' = any
     # (reference forwarded-host validation tier) ---
     allowed_hosts: str = ""
+    cors_allow_credentials: bool = False
+
+    # --- well-known files (reference well_known_* family:
+    # routers/well_known.py serves robots/security/custom files) ---
+    well_known_robots_txt: str = "User-agent: *\nDisallow: /"
+    well_known_security_txt: str = ""      # '' = 404
+    well_known_custom_files: str = ""      # JSON object {filename: content}
+    well_known_cache_max_age: int = 3600
 
     # --- SSO (JSON list: [{name, issuer, client_id, client_secret}]) ---
     sso_providers: str = ""

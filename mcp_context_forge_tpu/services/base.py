@@ -53,11 +53,17 @@ class AppContext:
         if self._http_client is None:
             import httpx
 
+            from ..utils.sslctx import outbound_ssl
+
+            ssl_ctx = outbound_ssl(self.settings)
             self._http_client = httpx.AsyncClient(
-                timeout=self.settings.tool_timeout,
-                verify=not self.settings.skip_ssl_verify,
-                limits=httpx.Limits(max_connections=512,
-                                    max_keepalive_connections=128),
+                timeout=httpx.Timeout(
+                    self.settings.tool_timeout,
+                    connect=self.settings.http_connect_timeout),
+                verify=ssl_ctx if ssl_ctx is not None else True,
+                limits=httpx.Limits(
+                    max_connections=self.settings.http_max_connections,
+                    max_keepalive_connections=self.settings.http_max_keepalive),
             )
         return self._http_client
 
@@ -79,11 +85,15 @@ class AppContext:
         if self._aiohttp_client is None:
             import aiohttp
 
-            ssl_arg = False if self.settings.skip_ssl_verify else None
+            from ..utils.sslctx import outbound_ssl
+
+            ssl_arg = outbound_ssl(self.settings)
             self._aiohttp_client = aiohttp.ClientSession(
                 timeout=aiohttp.ClientTimeout(total=self.settings.tool_timeout),
-                connector=aiohttp.TCPConnector(limit=512, limit_per_host=128,
-                                               ssl=ssl_arg))
+                connector=aiohttp.TCPConnector(
+                    limit=self.settings.outbound_pool_limit,
+                    limit_per_host=self.settings.outbound_pool_limit_per_host,
+                    ssl=ssl_arg))
         return self._aiohttp_client
 
 
