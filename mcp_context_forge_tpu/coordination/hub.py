@@ -20,8 +20,11 @@ Wire frames (one JSON object per line):
               {"op":"sub","topic":T} / {"op":"unsub"}   topic filter
               {"op":"acquire"/"renew"/"release"/"holder",
                "id":N, "name":..., "owner":..., "ttl":...}
+              {"op":"kv_set","id":N,"key":K,"value":{},"ttl":S}
+              {"op":"kv_get"/"kv_del","id":N,"key":K}
   hub→client: {"op":"msg","topic":T,"msg":{}}
-              {"op":"resp","id":N, "ok":bool, "holder":str|null}
+              {"op":"resp","id":N, "ok":bool, "holder":str|null,
+               "value":{}|null}
 
 Run standalone: ``python -m mcp_context_forge_tpu.coordination.hub --port 7077``
 or embedded in a gateway worker (``bus_tcp_serve=true`` — that worker hosts
@@ -67,6 +70,10 @@ class CoordinationHub:
         self._conns: dict[int, tuple[asyncio.StreamWriter, set[str]]] = {}
         self._next_conn = 0
         self._leases: dict[str, tuple[str, float]] = {}  # name -> (owner, expires)
+        # shared KV (chat sessions, small cross-worker state); value JSON,
+        # expires 0.0 = never. The Redis-keys analog next to pub/sub+leases.
+        self._kv: dict[str, tuple[Any, float]] = {}
+        self._kv_next_sweep = time.monotonic() + 60.0
 
     @property
     def bound_port(self) -> int:
@@ -146,6 +153,8 @@ class CoordinationHub:
             conn[1].discard(frame.get("topic", "*"))
         elif op in ("acquire", "renew", "release", "holder"):
             self._send(writer, self._lease_op(op, frame))
+        elif op in ("kv_set", "kv_get", "kv_del"):
+            self._send(writer, self._kv_op(op, frame))
 
     async def _broadcast(self, sender: int, topic: str,
                          message: dict[str, Any]) -> None:
@@ -199,6 +208,31 @@ class CoordinationHub:
         elif op == "holder":
             resp["ok"] = True
             resp["holder"] = None if expired else current[0]
+        return resp
+
+
+    # --------------------------------------------------------------- kv store
+
+    def _kv_op(self, op: str, frame: dict[str, Any]) -> dict[str, Any]:
+        key = str(frame.get("key", ""))
+        resp: dict[str, Any] = {"op": "resp", "id": frame.get("id"), "ok": True}
+        now = time.monotonic()
+        if now >= self._kv_next_sweep:
+            self._kv = {k: (v, exp) for k, (v, exp) in self._kv.items()
+                        if exp == 0.0 or exp > now}
+            self._kv_next_sweep = now + 60.0
+        if op == "kv_set":
+            ttl = float(frame.get("ttl") or 0.0)
+            self._kv[key] = (frame.get("value"), now + ttl if ttl else 0.0)
+        elif op == "kv_get":
+            entry = self._kv.get(key)
+            if entry is None or (entry[1] and entry[1] <= now):
+                self._kv.pop(key, None)
+                resp["value"] = None
+            else:
+                resp["value"] = entry[0]
+        elif op == "kv_del":
+            self._kv.pop(key, None)
         return resp
 
 
@@ -326,6 +360,16 @@ class HubClient:
                 self._send({"op": "unsub", "topic": topic})
             except ConnectionError:
                 pass  # next reconnect simply won't resubscribe
+
+    async def kv_set(self, key: str, value: Any, ttl: float = 0.0) -> None:
+        await self.request({"op": "kv_set", "key": key, "value": value,
+                            "ttl": ttl})
+
+    async def kv_get(self, key: str) -> Any:
+        return (await self.request({"op": "kv_get", "key": key})).get("value")
+
+    async def kv_del(self, key: str) -> None:
+        await self.request({"op": "kv_del", "key": key})
 
     async def request(self, frame: dict[str, Any],
                       timeout: float = 5.0) -> dict[str, Any]:

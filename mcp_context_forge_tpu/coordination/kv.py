@@ -1,0 +1,150 @@
+"""Shared key-value store: the Redis-keys analog next to pub/sub + leases.
+
+The reference keeps per-user chat session state (and other small
+cross-worker state) in Redis keys (`/root/reference/mcpgateway/routers/
+llmchat_router.py:476-636`). Backends mirror the event-bus tiers:
+
+- ``MemoryKVStore`` — one process (default dev posture)
+- ``FileKVStore``   — N workers on one host share ``bus_dir``
+- ``TcpKVStore``    — cross-host via the coordination hub (hub.py)
+
+Values are JSON-serializable objects; ``ttl`` seconds (0 = no expiry).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from abc import ABC, abstractmethod
+from typing import Any
+
+
+class KVStore(ABC):
+    @abstractmethod
+    async def set(self, key: str, value: Any, ttl: float = 0.0) -> None: ...
+
+    @abstractmethod
+    async def get(self, key: str) -> Any:
+        """Returns the stored value, or None when absent/expired."""
+
+    @abstractmethod
+    async def delete(self, key: str) -> None: ...
+
+    async def purge_expired(self) -> int:
+        """Drop expired entries eagerly. get() already expires lazily, but
+        abandoned keys that are never read again (stale chat sessions)
+        would otherwise accumulate forever — the gateway's periodic
+        sweeper calls this. Returns the number purged. The hub backend
+        no-ops (the hub sweeps server-side)."""
+        return 0
+
+
+class MemoryKVStore(KVStore):
+    def __init__(self) -> None:
+        self._data: dict[str, tuple[Any, float]] = {}
+
+    async def set(self, key: str, value: Any, ttl: float = 0.0) -> None:
+        self._data[key] = (value, time.monotonic() + ttl if ttl else 0.0)
+
+    async def get(self, key: str) -> Any:
+        entry = self._data.get(key)
+        if entry is None:
+            return None
+        if entry[1] and entry[1] <= time.monotonic():
+            del self._data[key]
+            return None
+        return entry[0]
+
+    async def delete(self, key: str) -> None:
+        self._data.pop(key, None)
+
+    async def purge_expired(self) -> int:
+        now = time.monotonic()
+        dead = [k for k, (_, exp) in self._data.items()
+                if exp and exp <= now]
+        for k in dead:
+            del self._data[k]
+        return len(dead)
+
+
+class FileKVStore(KVStore):
+    """One JSON file per key under ``dir/kv/`` — atomic via rename, so a
+    concurrent reader sees the old or the new value, never a torn write."""
+
+    def __init__(self, directory: str):
+        self._dir = os.path.join(directory, "kv")
+        os.makedirs(self._dir, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in key)
+        return os.path.join(self._dir, safe + ".json")
+
+    async def set(self, key: str, value: Any, ttl: float = 0.0) -> None:
+        path = self._path(key)
+        payload = {"value": value,
+                   "expires": time.time() + ttl if ttl else 0.0}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh)
+        os.replace(tmp, path)
+
+    async def get(self, key: str) -> Any:
+        try:
+            with open(self._path(key)) as fh:
+                payload = json.load(fh)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+        if payload["expires"] and payload["expires"] <= time.time():
+            await self.delete(key)
+            return None
+        return payload["value"]
+
+    async def delete(self, key: str) -> None:
+        try:
+            os.unlink(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    async def purge_expired(self) -> int:
+        purged = 0
+        now = time.time()
+        for entry in os.listdir(self._dir):
+            path = os.path.join(self._dir, entry)
+            try:
+                with open(path) as fh:
+                    payload = json.load(fh)
+                if payload.get("expires") and payload["expires"] <= now:
+                    os.unlink(path)
+                    purged += 1
+            except (OSError, json.JSONDecodeError):
+                continue  # concurrent writer/deleter; next sweep retries
+        return purged
+
+
+class TcpKVStore(KVStore):
+    """Hub-backed KV (CoordinationHub kv_set/kv_get/kv_del frames)."""
+
+    def __init__(self, client):
+        self._client = client
+
+    async def set(self, key: str, value: Any, ttl: float = 0.0) -> None:
+        await self._client.kv_set(key, value, ttl)
+
+    async def get(self, key: str) -> Any:
+        try:
+            return await self._client.kv_get(key)
+        except (ConnectionError, TimeoutError):
+            return None
+
+    async def delete(self, key: str) -> None:
+        try:
+            await self._client.kv_del(key)
+        except (ConnectionError, TimeoutError):
+            pass
+
+
+def make_kv(backend: str, directory: str = "/tmp/mcpforge-bus") -> KVStore:
+    if backend == "file":
+        return FileKVStore(directory)
+    return MemoryKVStore()

@@ -35,8 +35,8 @@ def setup_chat_routes(app: web.Application) -> None:
         service = request.app["chat_service"]
         # validate BEFORE the SSE response starts — an async generator only
         # raises at first iteration, which would be after the 200 headers
-        service.get_session(request.match_info["session_id"],
-                            request["auth"].user)
+        await service.get_session(request.match_info["session_id"],
+                                  request["auth"].user)
         if request.app["ctx"].llm_registry is None:
             return web.json_response({"detail": "tpu_local engine disabled"},
                                      status=422)

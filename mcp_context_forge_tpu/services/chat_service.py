@@ -1,12 +1,21 @@
-"""Interactive LLM chat with gateway-tool calling (ReAct loop).
+"""Interactive LLM chat with native OpenAI function calling.
 
 Reference: `routers/llmchat_router.py` + `services/mcp_client_chat_service.py`
-(LangChain/LangGraph ``create_react_agent`` + MultiServerMCPClient so the LLM
-can call gateway tools, `:31-37`). In-tree: a dependency-free ReAct loop —
-the model proposes ``{"tool": ..., "arguments": ...}`` actions, the gateway
-executes them through the normal tools/call pipeline (plugins included), and
-observations feed back until the model answers. Sessions are in-memory per
-user with SSE token streaming on the router side.
+(LangChain/LangGraph ``create_react_agent`` + MultiServerMCPClient,
+`:31-37`, provider classes `:733-1055`). In-tree equivalent, no framework:
+
+- the gateway's tool catalog is passed to the model as an OpenAI ``tools``
+  array; the model answers with ``message.tool_calls`` (structured
+  emission handled by the provider layer, `tpu_local/tool_calls.py`);
+- tool calls execute through the normal tools/call pipeline (plugin
+  chain included) — PARALLEL calls run concurrently like the reference's
+  LangGraph executor;
+- conversation state keeps the OpenAI message shapes (assistant
+  ``tool_calls`` + ``tool`` role results with ``tool_call_id``);
+- tokens stream as they decode (SSE on the router side);
+- sessions persist in the coordination KV store, so with a tcp/file bus
+  ANY worker can continue a session (reference keeps this state in
+  Redis, `routers/llmchat_router.py:476-636`).
 
 BASELINE.json config 5 ("federated multi-tool ReAct agent loop, full LLM
 plugin chain") runs through this service.
@@ -14,22 +23,19 @@ plugin chain") runs through this service.
 
 from __future__ import annotations
 
+import asyncio
 import json
-import re
 import time
-from dataclasses import dataclass, field
+from dataclasses import asdict, dataclass, field
 from typing import Any, AsyncIterator
 
+from ..coordination.kv import KVStore, MemoryKVStore
 from ..utils.ids import new_id
 from .base import AppContext, NotFoundError, ValidationFailure
 
-SYSTEM_PROMPT = """You are a tool-using assistant. You may call the tools listed below.
-To call a tool reply with ONLY a JSON object: {"tool": "<name>", "arguments": {...}}
-When you can answer directly, reply with the answer text (no JSON).
-
-Tools:
-{tool_catalog}
-"""
+SYSTEM_PROMPT = ("You are a helpful tool-using assistant. Prefer calling the "
+                 "available functions to look up facts; answer directly when "
+                 "no function applies.")
 
 
 @dataclass
@@ -45,61 +51,74 @@ class ChatSession:
 
 
 class ChatService:
-    def __init__(self, ctx: AppContext, tool_service, server_service):
+    def __init__(self, ctx: AppContext, tool_service, server_service,
+                 kv: KVStore | None = None, session_ttl: float = 3600.0):
         self.ctx = ctx
         self.tools = tool_service
         self.servers = server_service
-        self._sessions: dict[str, ChatSession] = {}
+        self._kv = kv if kv is not None else MemoryKVStore()
+        self.session_ttl = session_ttl
 
     # ------------------------------------------------------------- sessions
+
+    @staticmethod
+    def _key(session_id: str) -> str:
+        return f"chat:{session_id}"
+
+    async def _save(self, session: ChatSession) -> None:
+        await self._kv.set(self._key(session.id), asdict(session),
+                           ttl=self.session_ttl)
 
     async def connect(self, user: str, model: str | None = None,
                       server_id: str | None = None, max_steps: int = 5) -> ChatSession:
         session = ChatSession(id=new_id(), user=user, model=model,
                               server_id=server_id, max_steps=max_steps)
-        self._sessions[session.id] = session
+        await self._save(session)
         return session
 
-    def get_session(self, session_id: str, user: str) -> ChatSession:
-        session = self._sessions.get(session_id)
-        if session is None or session.user != user:
+    async def get_session(self, session_id: str, user: str) -> ChatSession:
+        raw = await self._kv.get(self._key(session_id))
+        if raw is None or raw.get("user") != user:
             raise NotFoundError("Chat session not found")
+        session = ChatSession(**raw)
         session.last_used = time.time()
         return session
 
     async def disconnect(self, session_id: str, user: str) -> None:
-        session = self._sessions.get(session_id)
-        if session is not None and session.user == user:
-            del self._sessions[session_id]
+        raw = await self._kv.get(self._key(session_id))
+        if raw is not None and raw.get("user") == user:
+            await self._kv.delete(self._key(session_id))
 
     # ----------------------------------------------------------------- chat
 
-    async def _tool_catalog(self, session: ChatSession, auth_teams: list[str]
-                            ) -> list[dict[str, Any]]:
+    async def _tool_defs(self, session: ChatSession, auth_teams: list[str]
+                         ) -> list[dict[str, Any]]:
         tools = await self.tools.list_tools(team_ids=auth_teams)
         if session.server_id:
             allowed = set(await self.servers.server_tool_names(session.server_id))
             tools = [t for t in tools if t.name in allowed]
-        return [{"name": t.name, "description": t.description or "",
-                 "schema": t.input_schema} for t in tools]
+        return [{"type": "function",
+                 "function": {"name": t.name,
+                              "description": t.description or "",
+                              "parameters": t.input_schema
+                              or {"type": "object", "properties": {}}}}
+                for t in tools]
 
-    @staticmethod
-    def _parse_action(text: str) -> dict[str, Any] | None:
-        """Extract a {"tool": ..., "arguments": ...} action from model output."""
-        text = text.strip()
-        candidates = [text]
-        match = re.search(r"\{.*\}", text, re.S)
-        if match:
-            candidates.append(match.group(0))
-        for candidate in candidates:
-            try:
-                obj = json.loads(candidate)
-            except json.JSONDecodeError:
-                continue
-            if isinstance(obj, dict) and isinstance(obj.get("tool"), str):
-                return {"tool": obj["tool"],
-                        "arguments": obj.get("arguments") or {}}
-        return None
+    async def _run_tool(self, call: dict[str, Any], user: str) -> dict[str, Any]:
+        """Execute ONE tool call; returns the OpenAI ``tool`` role message."""
+        fn = call.get("function", {})
+        try:
+            arguments = json.loads(fn.get("arguments") or "{}")
+        except json.JSONDecodeError:
+            arguments = {}
+        try:
+            result = await self.tools.invoke_tool(fn.get("name", ""),
+                                                  arguments, user=user)
+            observation = _result_text(result)[:4000]
+        except Exception as exc:
+            observation = f"ERROR: {type(exc).__name__}: {exc}"
+        return {"role": "tool", "tool_call_id": call.get("id", ""),
+                "content": observation}
 
     async def chat(self, session_id: str, user: str, text: str,
                    auth_teams: list[str] | None = None) -> AsyncIterator[dict[str, Any]]:
@@ -108,54 +127,83 @@ class ChatService:
         registry = self.ctx.llm_registry
         if registry is None:
             raise ValidationFailure("tpu_local engine is not enabled")
-        session = self.get_session(session_id, user)
-        catalog = await self._tool_catalog(session, auth_teams or [])
-        catalog_text = "\n".join(
-            f"- {t['name']}: {t['description']} args={json.dumps(t['schema'])}"
-            for t in catalog) or "(none)"
-        system = SYSTEM_PROMPT.replace("{tool_catalog}", catalog_text)
+        session = await self.get_session(session_id, user)
+        tools = await self._tool_defs(session, auth_teams or [])
         session.messages.append({"role": "user", "content": text})
 
         with self.ctx.tracer.span("llmchat.turn", {"session": session.id,
                                                    "user": user}):
             for step in range(session.max_steps):
-                response = await registry.chat({
+                request = {
                     "model": session.model,
-                    "messages": [{"role": "system", "content": system},
+                    "messages": [{"role": "system", "content": SYSTEM_PROMPT},
                                  *session.messages],
+                    "tools": tools,
                     "max_tokens": 512,
                     "temperature": 0.0,
-                })
-                reply = response["choices"][0]["message"]["content"]
-                action = self._parse_action(reply)
-                if action is None:
-                    session.messages.append({"role": "assistant", "content": reply})
-                    yield {"type": "answer", "text": reply,
-                           "usage": response.get("usage", {})}
+                }
+                content_parts: list[str] = []
+                calls_by_index: dict[int, dict[str, Any]] = {}
+                usage: dict[str, Any] = {}
+                async for chunk in registry.chat_stream(request):
+                    usage = chunk.get("usage") or usage
+                    for choice in chunk.get("choices", []):
+                        delta = choice.get("delta", {})
+                        piece = delta.get("content")
+                        if piece:
+                            content_parts.append(piece)
+                            yield {"type": "token", "text": piece}
+                        # OpenAI streaming semantics: tool_call deltas are
+                        # FRAGMENTS keyed by index — the first carries
+                        # id/name, later ones append arguments substrings
+                        # (azure/watsonx passthrough streams this way;
+                        # tpu_local happens to send whole calls)
+                        for frag in delta.get("tool_calls", []):
+                            idx = frag.get("index", len(calls_by_index))
+                            call = calls_by_index.setdefault(
+                                idx, {"id": "", "type": "function",
+                                      "function": {"name": "",
+                                                   "arguments": ""}})
+                            if frag.get("id"):
+                                call["id"] = frag["id"]
+                            fn = frag.get("function", {})
+                            if fn.get("name"):
+                                call["function"]["name"] = fn["name"]
+                            if fn.get("arguments"):
+                                call["function"]["arguments"] += fn["arguments"]
+                tool_calls = [calls_by_index[i]
+                              for i in sorted(calls_by_index)]
+                reply = "".join(content_parts)
+
+                if not tool_calls:
+                    session.messages.append({"role": "assistant",
+                                             "content": reply})
+                    await self._save(session)
+                    yield {"type": "answer", "text": reply, "usage": usage}
                     return
-                yield {"type": "tool_call", "tool": action["tool"],
-                       "arguments": action["arguments"], "step": step}
-                try:
-                    result = await self.tools.invoke_tool(
-                        action["tool"], action["arguments"], user=user)
-                    observation = _result_text(result)[:4000]
-                except Exception as exc:
-                    observation = f"ERROR: {type(exc).__name__}: {exc}"
-                yield {"type": "tool_result", "tool": action["tool"],
-                       "text": observation[:500], "step": step}
-                session.messages.append({"role": "assistant", "content": reply})
-                session.messages.append({
-                    "role": "user",
-                    "content": f"Tool {action['tool']} returned:\n{observation}\n"
-                               f"Continue. Answer directly if you can."})
+
+                for call in tool_calls:
+                    fn = call.get("function", {})
+                    yield {"type": "tool_call", "id": call.get("id"),
+                           "tool": fn.get("name"),
+                           "arguments": fn.get("arguments"), "step": step}
+                session.messages.append({"role": "assistant",
+                                         "content": reply or None,
+                                         "tool_calls": tool_calls})
+                # parallel tool calls execute concurrently (reference
+                # LangGraph ToolNode semantics); results append in call
+                # order so tool_call_id pairing stays deterministic
+                results = await asyncio.gather(
+                    *[self._run_tool(call, user) for call in tool_calls])
+                for call, message in zip(tool_calls, results):
+                    yield {"type": "tool_result",
+                           "id": call.get("id"),
+                           "tool": call.get("function", {}).get("name"),
+                           "text": message["content"][:500], "step": step}
+                    session.messages.append(message)
+                await self._save(session)
             yield {"type": "error",
                    "message": f"Agent exceeded {session.max_steps} steps"}
-
-    def sweep(self, ttl: float = 3600.0) -> None:
-        cutoff = time.time() - ttl
-        for sid in [s for s, sess in self._sessions.items()
-                    if sess.last_used < cutoff]:
-            del self._sessions[sid]
 
 
 def _result_text(result: dict[str, Any]) -> str:

@@ -334,14 +334,17 @@ class DialectProvider(LLMProvider):
                           ) -> AsyncIterator[dict[str, Any]]:
         """Streamed chat translated back to OpenAI chunk shape (reference
         `llm_proxy_service.py:529` + `_transform_anthropic_stream_chunk:774`
-        / `_transform_ollama_stream_chunk:824`): anthropic SSE
-        content_block_delta events, ollama ndjson lines, azure/watsonx
-        OpenAI-shaped SSE passthrough. bedrock/vertex stream with binary
-        event framing the gateway doesn't speak — those fall back to the
-        one-shot default."""
-        if self.dialect not in ("anthropic", "ollama", "azure_openai",
-                                "watsonx"):
-            async for chunk in super().chat_stream(request):
+        / `_transform_ollama_stream_chunk:824`). Native per family:
+        anthropic SSE content_block_delta events, ollama ndjson lines,
+        azure/watsonx OpenAI-shaped SSE passthrough, bedrock ConverseStream
+        AWS event-stream binary frames (utils/eventstream.py), vertex
+        streamGenerateContent with ``alt=sse``."""
+        if self.dialect == "bedrock":
+            async for chunk in self._bedrock_stream(request):
+                yield chunk
+            return
+        if self.dialect == "google_vertex":
+            async for chunk in self._vertex_stream(request):
                 yield chunk
             return
         model = request.get("model", "")
@@ -404,6 +407,75 @@ class DialectProvider(LLMProvider):
                     else:  # azure_openai / watsonx: OpenAI-shaped chunks
                         event.setdefault("model", model)
                         yield event
+
+    async def _bedrock_stream(self, request: dict[str, Any]
+                              ) -> AsyncIterator[dict[str, Any]]:
+        """Bedrock ConverseStream: the sibling ``converse-stream`` endpoint
+        answers application/vnd.amazon.eventstream binary frames; event
+        payloads are JSON keyed by ``:event-type`` (contentBlockDelta /
+        messageStop / metadata; exceptions ride ``:message-type``)."""
+        from ..utils.eventstream import iter_frames
+
+        model = request.get("model", "")
+        url, headers, body = self.build_request(request)
+        url = url.replace("/converse", "/converse-stream")
+        chunk_id = f"chatcmpl-{new_id()[:24]}"
+        async with httpx.AsyncClient(timeout=self.timeout) as client:
+            async with client.stream("POST", url, json=body,
+                                     headers=headers) as resp:
+                resp.raise_for_status()
+                async for frame_headers, payload in iter_frames(
+                        resp.aiter_bytes()):
+                    if frame_headers.get(":message-type") == "exception":
+                        raise LLMError(
+                            "bedrock stream exception: "
+                            f"{frame_headers.get(':exception-type')}")
+                    event_type = frame_headers.get(":event-type")
+                    event = json.loads(payload) if payload else {}
+                    if event_type == "contentBlockDelta":
+                        text = (event.get("delta") or {}).get("text", "")
+                        if text:
+                            yield self._chunk(chunk_id, model, text)
+                    elif event_type == "messageStop":
+                        yield self._chunk(
+                            chunk_id, model, None,
+                            {"end_turn": "stop", "max_tokens": "length"}.get(
+                                event.get("stopReason"), "stop"))
+                        return
+
+    async def _vertex_stream(self, request: dict[str, Any]
+                             ) -> AsyncIterator[dict[str, Any]]:
+        """Vertex ``streamGenerateContent?alt=sse``: SSE lines each holding
+        a GenerateContentResponse with incremental candidate parts."""
+        model = request.get("model", "")
+        url, headers, body = self.build_request(request)
+        url = url.replace(":generateContent", ":streamGenerateContent")
+        url += ("&" if "?" in url else "?") + "alt=sse"
+        chunk_id = f"chatcmpl-{new_id()[:24]}"
+        async with httpx.AsyncClient(timeout=self.timeout) as client:
+            async with client.stream("POST", url, json=body,
+                                     headers=headers) as resp:
+                resp.raise_for_status()
+                finish: str | None = None
+                async for line in resp.aiter_lines():
+                    line = line.strip()
+                    if not line.startswith("data:"):
+                        continue
+                    payload = line[5:].strip()
+                    if payload == "[DONE]":
+                        break
+                    event = json.loads(payload)
+                    candidates = event.get("candidates") or [{}]
+                    parts = ((candidates[0].get("content") or {})
+                             .get("parts") or [])
+                    text = "".join(part.get("text", "") for part in parts)
+                    if text:
+                        yield self._chunk(chunk_id, model, text)
+                    reason = candidates[0].get("finishReason")
+                    if reason:
+                        finish = {"STOP": "stop",
+                                  "MAX_TOKENS": "length"}.get(reason, "stop")
+                yield self._chunk(chunk_id, model, None, finish or "stop")
 
 
 class LLMProviderRegistry:
@@ -473,7 +545,16 @@ class LLMProviderRegistry:
 
 def make_chat_response(model: str, text: str, prompt_tokens: int = 0,
                        completion_tokens: int = 0,
-                       finish_reason: str = "stop") -> dict[str, Any]:
+                       finish_reason: str = "stop",
+                       tool_calls: list[dict[str, Any]] | None = None
+                       ) -> dict[str, Any]:
+    message: dict[str, Any] = {"role": "assistant", "content": text}
+    if tool_calls:
+        # OpenAI wire shape: content null, calls carried structurally,
+        # finish_reason tells the client to execute and continue
+        message = {"role": "assistant", "content": None,
+                   "tool_calls": tool_calls}
+        finish_reason = "tool_calls"
     return {
         "id": f"chatcmpl-{new_id()[:24]}",
         "object": "chat.completion",
@@ -481,7 +562,7 @@ def make_chat_response(model: str, text: str, prompt_tokens: int = 0,
         "model": model,
         "choices": [{
             "index": 0,
-            "message": {"role": "assistant", "content": text},
+            "message": message,
             "finish_reason": finish_reason,
         }],
         "usage": {

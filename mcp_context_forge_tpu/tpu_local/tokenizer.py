@@ -105,15 +105,36 @@ def load_tokenizer(checkpoint_dir: str = "", vocab_size: int = 512) -> Tokenizer
     return ByteTokenizer(vocab_size=vocab_size)
 
 
-def render_chat(messages: list[dict], add_generation_prompt: bool = True) -> str:
-    """Llama-3-style chat template (plain-text rendering)."""
+def render_chat(messages: list[dict], add_generation_prompt: bool = True,
+                tools: list[dict] | None = None) -> str:
+    """Llama-3-style chat template (plain-text rendering).
+
+    Function-calling parity (OpenAI wire shapes -> prompt text):
+    - ``tools`` renders as a system block of JSON function signatures
+      (tool_calls.render_tools_block, Llama-3.1 convention);
+    - assistant messages carrying ``tool_calls`` render the call JSON so
+      the model sees its prior calls in-context;
+    - ``tool`` role messages render under the ``ipython`` header —
+      Llama 3's tool-response role."""
     parts = []
+    if tools:
+        from .tool_calls import render_tools_block
+
+        parts.append(f"<|start_header_id|>system<|end_header_id|>\n"
+                     f"{render_tools_block(tools)}<|eot_id|>")
     for msg in messages:
         role = msg.get("role", "user")
         content = msg.get("content", "")
         if isinstance(content, list):  # OpenAI content-part arrays
             content = "".join(p.get("text", "") for p in content
                               if isinstance(p, dict))
+        if role == "assistant" and msg.get("tool_calls"):
+            from .tool_calls import tool_call_message_text
+
+            call_text = tool_call_message_text(msg["tool_calls"])
+            content = f"{content}\n{call_text}" if content else call_text
+        elif role == "tool":
+            role = "ipython"
         parts.append(f"<|start_header_id|>{role}<|end_header_id|>\n{content}<|eot_id|>")
     if add_generation_prompt:
         parts.append("<|start_header_id|>assistant<|end_header_id|>\n")

@@ -141,7 +141,10 @@ class TPULocalProvider(LLMProvider):
     # ------------------------------------------------------------------ chat
 
     def _prepare(self, request: dict[str, Any]) -> GenRequest:
-        prompt = render_chat(request.get("messages", []))
+        tools = request.get("tools")
+        if request.get("tool_choice") == "none":
+            tools = None
+        prompt = render_chat(request.get("messages", []), tools=tools)
         prompt_ids = self.engine.tokenizer.encode(prompt)
         max_ctx = self.engine.config.max_seq_len
         # prompts longer than every bucket prefill in chunks through the
@@ -186,10 +189,16 @@ class TPULocalProvider(LLMProvider):
                     len(tokens))
                 self.metrics.llm_requests.labels(model=model, status="ok").inc()
                 self.metrics.llm_kv_pages_in_use.set(self.engine.kv_pages_in_use())
+            tool_calls = None
+            if request.get("tools") and request.get("tool_choice") != "none":
+                from .tool_calls import parse_tool_calls
+
+                tool_calls = parse_tool_calls(text)
             return make_chat_response(
                 request.get("model", self.engine.config.model), text,
                 prompt_tokens=len(gen.prompt_ids), completion_tokens=len(tokens),
-                finish_reason=gen.finish_reason or "stop")
+                finish_reason=gen.finish_reason or "stop",
+                tool_calls=tool_calls)
         finally:
             if span_ctx:
                 span_ctx.__exit__(None, None, None)
@@ -200,6 +209,13 @@ class TPULocalProvider(LLMProvider):
         model = request.get("model", self.engine.config.model)
         created = int(time.time())
         chunk_id = f"chatcmpl-{new_id()[:24]}"
+        # function calling: a completion that OPENS with JSON is (probably)
+        # a tool call — buffer it instead of streaming fragments the client
+        # would render; plain text streams token-by-token as usual
+        expect_tools = bool(request.get("tools")) \
+            and request.get("tool_choice") != "none"
+        buffering = expect_tools  # until the first flush decides
+        emitted: list[str] = []
         pending: list[int] = []
         while True:
             token = await gen.stream.get()
@@ -209,17 +225,54 @@ class TPULocalProvider(LLMProvider):
             text = self.engine.tokenizer.decode(pending)
             if text and not text.endswith("�"):  # flush complete utf-8 runs
                 pending = []
+                if buffering:
+                    emitted.append(text)
+                    head = "".join(emitted).lstrip()
+                    if head and head[0] not in "{[":
+                        buffering = False  # plain answer: replay + stream
+                        for chunk in emitted:
+                            yield self._content_chunk(chunk_id, created,
+                                                      model, chunk)
+                        emitted = []
+                    continue
+                yield self._content_chunk(chunk_id, created, model, text)
+        if buffering and emitted:
+            full = "".join(emitted)
+            from .tool_calls import parse_tool_calls
+
+            calls = parse_tool_calls(full)
+            if calls:
+                deltas = [{**call, "index": i} for i, call in enumerate(calls)]
                 yield {
                     "id": chunk_id, "object": "chat.completion.chunk",
                     "created": created, "model": model,
-                    "choices": [{"index": 0, "delta": {"content": text},
+                    "choices": [{"index": 0,
+                                 "delta": {"tool_calls": deltas},
                                  "finish_reason": None}],
                 }
+                yield {
+                    "id": chunk_id, "object": "chat.completion.chunk",
+                    "created": created, "model": model,
+                    "choices": [{"index": 0, "delta": {},
+                                 "finish_reason": "tool_calls"}],
+                }
+                return
+            yield self._content_chunk(chunk_id, created, model, full)
         yield {
             "id": chunk_id, "object": "chat.completion.chunk", "created": created,
             "model": model,
             "choices": [{"index": 0, "delta": {},
                          "finish_reason": gen.finish_reason or "stop"}],
+        }
+
+    @staticmethod
+    def _content_chunk(chunk_id: str, created: int, model: str,
+                       text: str) -> dict[str, Any]:
+        return {
+            "id": chunk_id, "object": "chat.completion.chunk",
+            "created": created, "model": model,
+            "choices": [{"index": 0, "delta": {"content": text},
+                         "finish_reason": None}],
         }
 
     # ------------------------------------------------------------ embeddings

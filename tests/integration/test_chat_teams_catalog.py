@@ -42,39 +42,44 @@ async def test_react_chat_loop_with_tool():
         assert events and events[-1]["type"] in ("answer", "error", "tool_result",
                                                  "tool_call")
 
-        # action parsing: a model reply that IS a tool call gets executed
+        # drive a full turn with a scripted model: the service consumes the
+        # OpenAI STREAMING surface (delta.content / delta.tool_calls)
         service = gateway.app["chat_service"]
-        action = service._parse_action('{"tool": "weather", "arguments": {}}')
-        assert action == {"tool": "weather", "arguments": {}}
-        action = service._parse_action('Thought: check\n{"tool": "weather", "arguments": {"city": "x"}}')
-        assert action["tool"] == "weather"
-        assert service._parse_action("plain answer") is None
-
-        # drive a full turn with a scripted model: monkeypatch registry.chat
         registry = gateway.app["ctx"].llm_registry
-        replies = iter([
-            '{"tool": "weather", "arguments": {}}',
-            "It is 21C.",
+        scripts = iter([
+            [{"choices": [{"delta": {"tool_calls": [
+                {"id": "call_1", "type": "function", "index": 0,
+                 "function": {"name": "weather", "arguments": "{}"}}]},
+                "finish_reason": None}]},
+             {"choices": [{"delta": {}, "finish_reason": "tool_calls"}]}],
+            [{"choices": [{"delta": {"content": "It is "}, "finish_reason": None}]},
+             {"choices": [{"delta": {"content": "21C."}, "finish_reason": None}]},
+             {"choices": [{"delta": {}, "finish_reason": "stop"}]}],
         ])
 
-        async def scripted_chat(request):
-            return {"choices": [{"message": {"content": next(replies)},
-                                 "finish_reason": "stop"}],
-                    "model": "scripted", "usage": {}}
+        async def scripted_stream(request):
+            for chunk in next(scripts):
+                yield chunk
 
-        original = registry.chat
-        registry.chat = scripted_chat
+        original = registry.chat_stream
+        registry.chat_stream = scripted_stream
         try:
             events = []
             async for event in service.chat(session_id, "admin@example.com",
                                             "what's the weather?"):
                 events.append(event)
         finally:
-            registry.chat = original
+            registry.chat_stream = original
         kinds = [e["type"] for e in events]
-        assert kinds == ["tool_call", "tool_result", "answer"]
+        assert kinds == ["tool_call", "tool_result", "token", "token", "answer"]
         assert "21" in events[1]["text"]
-        assert events[2]["text"] == "It is 21C."
+        assert events[-1]["text"] == "It is 21C."
+        # native message shapes persisted: assistant tool_calls + tool role
+        session = await service.get_session(session_id, "admin@example.com")
+        roles = [m["role"] for m in session.messages]
+        assert roles[-4:] == ["user", "assistant", "tool", "assistant"]
+        assert session.messages[-3]["tool_calls"][0]["function"]["name"] == "weather"
+        assert session.messages[-2]["tool_call_id"] == "call_1"
     finally:
         await rest.close()
         await gateway.close()

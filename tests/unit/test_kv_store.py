@@ -1,0 +1,73 @@
+"""Coordination KV store: memory/file/tcp backends + TTL semantics.
+
+Reference analog: Redis keys for per-user chat session state
+(`/root/reference/mcpgateway/routers/llmchat_router.py:476-636`).
+"""
+
+import asyncio
+
+import pytest
+
+from mcp_context_forge_tpu.coordination.hub import CoordinationHub, HubClient
+from mcp_context_forge_tpu.coordination.kv import (FileKVStore, MemoryKVStore,
+                                                   TcpKVStore, make_kv)
+
+
+@pytest.mark.parametrize("backend", ["memory", "file"])
+async def test_kv_set_get_delete(backend, tmp_path):
+    kv = make_kv(backend, str(tmp_path))
+    await kv.set("k", {"a": 1})
+    assert await kv.get("k") == {"a": 1}
+    await kv.set("k", [1, 2])  # overwrite
+    assert await kv.get("k") == [1, 2]
+    await kv.delete("k")
+    assert await kv.get("k") is None
+    await kv.delete("k")  # idempotent
+
+
+async def test_memory_kv_ttl_expiry():
+    kv = MemoryKVStore()
+    await kv.set("k", "v", ttl=0.05)
+    assert await kv.get("k") == "v"
+    await asyncio.sleep(0.08)
+    assert await kv.get("k") is None
+
+
+async def test_file_kv_ttl_and_key_sanitization(tmp_path):
+    kv = FileKVStore(str(tmp_path))
+    await kv.set("chat:abc/../x", "v", ttl=0.05)
+    assert await kv.get("chat:abc/../x") == "v"
+    # traversal characters never reach the filesystem
+    names = [p.name for p in (tmp_path / "kv").iterdir()]
+    assert all("/" not in n and ":" not in n for n in names)
+    await asyncio.sleep(0.08)
+    assert await kv.get("chat:abc/../x") is None
+
+
+async def test_file_kv_shared_between_instances(tmp_path):
+    a, b = FileKVStore(str(tmp_path)), FileKVStore(str(tmp_path))
+    await a.set("shared", {"x": 1})
+    assert await b.get("shared") == {"x": 1}
+
+
+async def test_tcp_kv_crosses_connections():
+    hub = CoordinationHub("127.0.0.1", 0)
+    await hub.start()
+    c1 = HubClient("127.0.0.1", hub.bound_port)
+    c2 = HubClient("127.0.0.1", hub.bound_port)
+    await c1.start()
+    await c2.start()
+    try:
+        kv1, kv2 = TcpKVStore(c1), TcpKVStore(c2)
+        await kv1.set("session", {"user": "a"}, ttl=60)
+        assert await kv2.get("session") == {"user": "a"}  # other worker sees it
+        await kv2.delete("session")
+        assert await kv1.get("session") is None
+        # ttl expiry at the hub
+        await kv1.set("brief", 1, ttl=0.05)
+        await asyncio.sleep(0.08)
+        assert await kv2.get("brief") is None
+    finally:
+        await c1.stop()
+        await c2.stop()
+        await hub.stop()

@@ -1,0 +1,90 @@
+"""OpenAI tools/tool_calls wire layer (tpu_local/tool_calls.py) +
+chat-template rendering of function-calling messages."""
+
+import json
+
+from mcp_context_forge_tpu.tpu_local.tool_calls import (
+    parse_tool_calls, render_tools_block, tool_call_message_text)
+from mcp_context_forge_tpu.tpu_local.tokenizer import render_chat
+
+WEATHER_TOOL = {"type": "function", "function": {
+    "name": "get_weather", "description": "Weather by city",
+    "parameters": {"type": "object",
+                   "properties": {"city": {"type": "string"}}}}}
+
+
+def test_render_tools_block_lists_signatures():
+    block = render_tools_block([WEATHER_TOOL])
+    assert "get_weather" in block
+    assert "Weather by city" in block
+    assert '{"name": "<function-name>"' in block
+
+
+def test_parse_single_call_parameters_and_arguments_keys():
+    for key in ("parameters", "arguments"):
+        calls = parse_tool_calls(
+            json.dumps({"name": "get_weather", key: {"city": "Oslo"}}))
+        assert len(calls) == 1
+        assert calls[0]["type"] == "function"
+        assert calls[0]["function"]["name"] == "get_weather"
+        assert json.loads(calls[0]["function"]["arguments"]) == {"city": "Oslo"}
+        assert calls[0]["id"].startswith("call_")
+
+
+def test_parse_legacy_tool_key():
+    calls = parse_tool_calls('{"tool": "search", "arguments": {"q": "x"}}')
+    assert calls[0]["function"]["name"] == "search"
+
+
+def test_parse_parallel_calls_array():
+    text = json.dumps([
+        {"name": "get_weather", "parameters": {"city": "Oslo"}},
+        {"name": "get_weather", "parameters": {"city": "Bergen"}},
+    ])
+    calls = parse_tool_calls(text)
+    assert len(calls) == 2
+    cities = [json.loads(c["function"]["arguments"])["city"] for c in calls]
+    assert cities == ["Oslo", "Bergen"]
+    # ids are unique per call
+    assert calls[0]["id"] != calls[1]["id"]
+
+
+def test_parse_python_tag_and_prose_wrapping():
+    assert parse_tool_calls(
+        '<|python_tag|>{"name": "f", "parameters": {}}')[0]["function"]["name"] == "f"
+    wrapped = 'Sure, let me check.\n{"name": "f", "parameters": {"a": 1}}\nDone.'
+    assert parse_tool_calls(wrapped)[0]["function"]["name"] == "f"
+
+
+def test_parse_rejects_plain_answers():
+    assert parse_tool_calls("The weather is sunny.") is None
+    assert parse_tool_calls('{"no_name_key": 1}') is None
+    assert parse_tool_calls('[1, 2, 3]') is None
+    assert parse_tool_calls('{"name": "", "parameters": {}}') is None
+    # arguments must be an object, not a scalar
+    assert parse_tool_calls('{"name": "f", "parameters": 3}') is None
+
+
+def test_tool_call_message_text_roundtrip():
+    calls = parse_tool_calls('{"name": "f", "parameters": {"x": 1}}')
+    text = tool_call_message_text(calls)
+    reparsed = parse_tool_calls(text)
+    assert reparsed[0]["function"]["name"] == "f"
+    assert json.loads(reparsed[0]["function"]["arguments"]) == {"x": 1}
+
+
+def test_render_chat_function_calling_shapes():
+    calls = [{"id": "call_1", "type": "function",
+              "function": {"name": "f", "arguments": '{"x":1}'}}]
+    prompt = render_chat(
+        [{"role": "user", "content": "hi"},
+         {"role": "assistant", "content": None, "tool_calls": calls},
+         {"role": "tool", "tool_call_id": "call_1", "content": "42"}],
+        tools=[WEATHER_TOOL])
+    # tools render once in a system header
+    assert prompt.index("get_weather") < prompt.index("hi")
+    # assistant tool_calls render as call JSON; tool role renders as ipython
+    assert '{"name":"f","parameters":{"x":1}}' in prompt
+    assert "<|start_header_id|>ipython<|end_header_id|>\n42" in prompt
+    # generation prompt still appended
+    assert prompt.rstrip().endswith("<|start_header_id|>assistant<|end_header_id|>")
