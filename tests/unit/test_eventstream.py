@@ -1,0 +1,86 @@
+"""AWS event-stream codec (utils/eventstream.py): framing, CRCs,
+incremental reassembly. Property: decode(encode(h, p)) == (h, p)."""
+
+import json
+import zlib
+
+import pytest
+
+from mcp_context_forge_tpu.utils.eventstream import (EventStreamError,
+                                                     decode_frame,
+                                                     encode_frame,
+                                                     iter_frames)
+
+
+def test_roundtrip():
+    headers = {":event-type": "contentBlockDelta", ":message-type": "event"}
+    payload = json.dumps({"delta": {"text": "hi"}}).encode()
+    got_headers, got_payload = decode_frame(encode_frame(headers, payload))
+    assert got_headers == headers
+    assert got_payload == payload
+
+
+def test_empty_payload_and_empty_headers():
+    assert decode_frame(encode_frame({}, b"")) == ({}, b"")
+    assert decode_frame(encode_frame({"a": "b"}, b"")) == ({"a": "b"}, b"")
+
+
+def test_corrupt_message_crc_rejected():
+    frame = bytearray(encode_frame({"k": "v"}, b"payload"))
+    frame[-6] ^= 0xFF  # flip a payload byte: message CRC must catch it
+    with pytest.raises(EventStreamError, match="message CRC"):
+        decode_frame(bytes(frame))
+
+
+def test_corrupt_prelude_rejected():
+    frame = bytearray(encode_frame({}, b"x"))
+    frame[5] ^= 0x01  # headers-length byte: prelude CRC must catch it
+    with pytest.raises(EventStreamError, match="prelude CRC"):
+        decode_frame(bytes(frame))
+
+
+def test_length_mismatch_rejected():
+    frame = bytearray(encode_frame({}, b"xyz"))
+    # recompute a VALID prelude claiming a longer frame, then truncate:
+    total = (len(frame) + 1).to_bytes(4, "big")
+    frame[0:4] = total
+    frame[8:12] = zlib.crc32(bytes(frame[0:8])).to_bytes(4, "big")
+    with pytest.raises(EventStreamError):
+        decode_frame(bytes(frame))
+
+
+def test_scalar_header_types_decode():
+    # hand-build headers: bool true (0), int32 (4)
+    hdr = bytes([4]) + b"flag" + bytes([0])
+    hdr += bytes([3]) + b"num" + bytes([4]) + (42).to_bytes(4, "big")
+    prelude = (12 + len(hdr) + 4).to_bytes(4, "big") + len(hdr).to_bytes(4, "big")
+    prelude += zlib.crc32(prelude).to_bytes(4, "big")
+    body = prelude + hdr
+    frame = body + zlib.crc32(body).to_bytes(4, "big")
+    headers, payload = decode_frame(frame)
+    assert headers == {"flag": True, "num": 42}
+    assert payload == b""
+
+
+async def test_iter_frames_reassembles_split_frames():
+    frames = [encode_frame({":event-type": f"e{i}"}, f"p{i}".encode() * i)
+              for i in range(6)]
+    blob = b"".join(frames)
+
+    async def chunked(n):
+        for i in range(0, len(blob), n):
+            yield blob[i:i + n]
+
+    for split in (1, 7, 64, len(blob)):
+        got = [h async for h, _ in iter_frames(chunked(split))]
+        assert [h[":event-type"] for h in got] == [f"e{i}" for i in range(6)]
+
+
+async def test_iter_frames_trailing_garbage_raises():
+    blob = encode_frame({}, b"ok") + b"\x00\x01"
+
+    async def once():
+        yield blob
+
+    with pytest.raises(EventStreamError, match="trailing"):
+        _ = [f async for f in iter_frames(once())]
