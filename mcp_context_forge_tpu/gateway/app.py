@@ -66,11 +66,18 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         hub_client = HubClient(settings.bus_tcp_host,
                                hub.bound_port if hub else settings.bus_tcp_port,
                                secret=bus_secret)
+        from ..coordination.kv import TcpKVStore
+
         bus = TcpEventBus(hub_client)
         leases = TcpLeaseManager(hub_client)
+        kv_store = TcpKVStore(hub_client)
     else:
+        from ..coordination.kv import make_kv
+
         bus = make_bus(settings.bus_backend, settings.bus_dir)
         leases = make_lease_manager(settings.bus_backend, settings.bus_dir)
+        kv_store = make_kv(settings.bus_backend, settings.bus_dir)
+    app["kv_store"] = kv_store
     tracer = init_tracer(settings.otel_service_name,
                          settings.otel_exporter if settings.otel_enable else "none")
     metrics = PrometheusRegistry()
@@ -321,6 +328,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     setup_routes(app)
     from .routers_extra import setup_extra_routes
     setup_extra_routes(app)
+    from .routers_discovery import setup_discovery_routes
+    setup_discovery_routes(app)
 
     from ..services.audit_service import AuditService
     from ..services.cancellation_service import CancellationService
@@ -328,7 +337,9 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     from ..services.chat_service import ChatService
     from ..services.metrics_service import MetricsMaintenanceService
     from ..services.team_service import TeamService
-    app["chat_service"] = ChatService(ctx, tool_service, server_service)
+    app["chat_service"] = ChatService(ctx, tool_service, server_service,
+                                      kv=kv_store,
+                                      session_ttl=settings.session_ttl)
     app["team_service"] = TeamService(ctx)
     app["catalog_service"] = CatalogService(ctx)
     audit_service = AuditService(ctx, siem_url=settings.siem_export_url)
@@ -544,9 +555,14 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         await metrics_maintenance.start()
 
         async def _chat_sweeper() -> None:
+            # chat sessions expire via KV ttl; the purge drops entries no
+            # one will ever get() again (abandoned sessions)
             while True:
                 await _asyncio.sleep(600)
-                app["chat_service"].sweep(ttl=settings.session_ttl)
+                try:
+                    await kv_store.purge_expired()
+                except Exception:
+                    logger.exception("kv purge failed")
                 apps_service = app.get("mcp_apps_service")
                 if apps_service is not None:
                     try:  # expired AppBridge rows must not accumulate
@@ -594,4 +610,7 @@ def run(settings: Settings | None = None) -> None:
     async def _factory() -> web.Application:
         return await build_app(settings)
 
-    web.run_app(_factory(), host=settings.host, port=settings.port)
+    from ..utils.sslctx import serving_ssl
+
+    web.run_app(_factory(), host=settings.host, port=settings.port,
+                ssl_context=serving_ssl(settings))

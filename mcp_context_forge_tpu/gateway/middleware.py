@@ -21,7 +21,7 @@ from ..services.base import ConflictError, NotFoundError, ValidationFailure
 
 Handler = Callable[[web.Request], Awaitable[web.StreamResponse]]
 
-PUBLIC_PATHS = {"/health", "/ready", "/version", "/.well-known/mcp", "/auth/login"}
+PUBLIC_PATHS = {"/health", "/ready", "/version", "/auth/login", "/robots.txt"}
 
 
 @web.middleware
@@ -55,6 +55,18 @@ async def header_size_middleware(request: web.Request, handler: Handler) -> web.
             return web.json_response(
                 {"detail": f"Request headers exceed {limit} bytes"},
                 status=431)
+    if settings.max_header_count and \
+            len(request.raw_headers) > settings.max_header_count:
+        return web.json_response(
+            {"detail": f"More than {settings.max_header_count} header fields"},
+            status=431)
+    if settings.max_header_field_bytes:
+        for key, value in request.raw_headers:
+            if len(key) + len(value) > settings.max_header_field_bytes:
+                return web.json_response(
+                    {"detail": "Header field exceeds "
+                               f"{settings.max_header_field_bytes} bytes"},
+                    status=431)
     return await handler(request)
 
 
@@ -83,7 +95,7 @@ async def cors_middleware(request: web.Request, handler: Handler) -> web.StreamR
     grant = origin if (allowed and origin and
                        ("*" in allowed or origin in allowed)) else ""
     if request.method == "OPTIONS" and grant:
-        return web.Response(status=204, headers={
+        headers = {
             "access-control-allow-origin": grant,
             "access-control-allow-methods": "GET, POST, PUT, DELETE, OPTIONS",
             "access-control-allow-headers":
@@ -91,13 +103,18 @@ async def cors_middleware(request: web.Request, handler: Handler) -> web.StreamR
                 " mcp-protocol-version, last-event-id",
             "access-control-max-age": "600",
             "vary": "origin",
-        })
+        }
+        if settings.cors_allow_credentials:
+            headers["access-control-allow-credentials"] = "true"
+        return web.Response(status=204, headers=headers)
     response = await handler(request)
     if grant:
         response.headers["access-control-allow-origin"] = grant
         response.headers.setdefault("vary", "origin")
         response.headers["access-control-expose-headers"] = \
             "mcp-session-id, x-correlation-id"
+        if settings.cors_allow_credentials:
+            response.headers["access-control-allow-credentials"] = "true"
     return response
 
 
@@ -278,7 +295,13 @@ async def auth_middleware(request: web.Request, handler: Handler) -> web.StreamR
     settings = ctx.settings
 
     if (request.method == "OPTIONS" or request.path in PUBLIC_PATHS
-            or request.path.startswith("/auth/sso/")):
+            or request.path.startswith("/auth/sso/")
+            # well-known files are public discovery surface by definition
+            # (gateway-level AND per-server; reference well_known +
+            # server_well_known routers serve them unauthenticated)
+            or request.path.startswith("/.well-known/")
+            or (request.path.startswith("/servers/")
+                and request.path.endswith("/.well-known/mcp"))):
         request["auth"] = AuthContext(user="anonymous", via="anonymous")
         return await handler(request)
 

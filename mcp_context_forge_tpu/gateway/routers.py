@@ -26,6 +26,7 @@ from ..schemas import (
 )
 from ..services.auth_service import AuthError
 from ..services.base import NotFoundError, ValidationFailure
+from .pagination import paginate
 
 
 def _dump(model) -> Any:
@@ -36,11 +37,40 @@ def _dump(model) -> Any:
 
 async def _body(request: web.Request, schema):
     try:
-        return schema.model_validate(await request.json())
+        model = schema.model_validate(await request.json())
     except json.JSONDecodeError as exc:
         raise ValidationFailure(f"Invalid JSON body: {exc}") from exc
     except ValidationError as exc:
         raise ValidationFailure(str(exc)) from exc
+    _check_field_limits(model, request.app["ctx"].settings)
+    return model
+
+
+def _check_field_limits(model, settings) -> None:
+    """Central create/update field limits (reference validation_* family,
+    `/root/reference/mcpgateway/config.py` validation_max_name_length ..
+    validation_max_tag_length): one enforcement point for every entity
+    schema instead of per-model validators that can drift."""
+    checks = (("name", settings.validation_max_name_length),
+              ("description", settings.validation_max_description_length),
+              ("url", settings.validation_max_url_length))
+    for field_name, limit in checks:
+        value = getattr(model, field_name, None)
+        if isinstance(value, str) and limit and len(value) > limit:
+            raise ValidationFailure(
+                f"{field_name} exceeds {limit} characters")
+    tags = getattr(model, "tags", None)
+    if tags:
+        if settings.validation_max_tags and \
+                len(tags) > settings.validation_max_tags:
+            raise ValidationFailure(
+                f"More than {settings.validation_max_tags} tags")
+        for tag in tags:
+            if settings.validation_max_tag_length and \
+                    len(tag) > settings.validation_max_tag_length:
+                raise ValidationFailure(
+                    f"Tag exceeds {settings.validation_max_tag_length}"
+                    " characters")
 
 
 def setup_routes(app: web.Application) -> None:
@@ -141,7 +171,8 @@ def setup_routes(app: web.Application) -> None:
         rows = await request.app["ctx"].db.fetchall(
             "SELECT email, full_name, is_admin, is_active, auth_provider,"
             " last_login, created_at FROM users ORDER BY email")
-        return web.json_response(rows)
+        return paginate(request, rows, lambda page: list(page),
+                        key=lambda row: row["email"])
 
     @routes.post("/admin/users/{email}/toggle")
     async def toggle_user(request: web.Request) -> web.Response:
@@ -164,7 +195,7 @@ def setup_routes(app: web.Application) -> None:
         include_inactive = request.query.get("include_inactive") == "true"
         tools = await request.app["tool_service"].list_tools(
             include_inactive=include_inactive, team_ids=request["auth"].teams)
-        return web.json_response(_dump(tools))
+        return paginate(request, tools, _dump)
 
     @routes.post("/tools")
     async def create_tool(request: web.Request) -> web.Response:
@@ -218,7 +249,7 @@ def setup_routes(app: web.Application) -> None:
         request["auth"].require("gateways.read")
         include_inactive = request.query.get("include_inactive") == "true"
         gws = await request.app["gateway_service"].list_gateways(include_inactive)
-        return web.json_response(_dump(gws))
+        return paginate(request, gws, _dump)
 
     @routes.post("/gateways")
     async def register_gateway(request: web.Request) -> web.Response:
@@ -260,7 +291,7 @@ def setup_routes(app: web.Application) -> None:
         request["auth"].require("resources.read")
         res = await request.app["resource_service"].list_resources(
             request.query.get("include_inactive") == "true")
-        return web.json_response(_dump(res))
+        return paginate(request, res, _dump)
 
     @routes.post("/resources")
     async def create_resource(request: web.Request) -> web.Response:
@@ -297,7 +328,7 @@ def setup_routes(app: web.Application) -> None:
         request["auth"].require("prompts.read")
         prompts = await request.app["prompt_service"].list_prompts(
             request.query.get("include_inactive") == "true")
-        return web.json_response(_dump(prompts))
+        return paginate(request, prompts, _dump)
 
     @routes.post("/prompts")
     async def create_prompt(request: web.Request) -> web.Response:
@@ -337,7 +368,7 @@ def setup_routes(app: web.Application) -> None:
         request["auth"].require("servers.read")
         servers = await request.app["server_service"].list_servers(
             request.query.get("include_inactive") == "true")
-        return web.json_response(_dump(servers))
+        return paginate(request, servers, _dump)
 
     @routes.post("/servers")
     async def create_server(request: web.Request) -> web.Response:

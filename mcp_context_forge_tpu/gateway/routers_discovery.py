@@ -1,0 +1,256 @@
+"""Discovery routers: tags, cross-entity search, OpenAPI schema,
+per-server well-known, metrics maintenance.
+
+Reference: `routers/tags_router` + `routers/search` + `openapi_schema` +
+`server_well_known` + `metrics_maintenance` in the main router list
+(`/root/reference/mcpgateway/main.py:3575-3586`).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from aiohttp import web
+
+from .. import __version__
+from ..services.base import NotFoundError
+
+_ENTITY_SOURCES = ("tools", "resources", "prompts", "servers", "gateways",
+                   "a2a_agents")
+
+
+async def _all_entities(app: web.Application, teams: list[str],
+                        types: list[str] | None = None
+                        ) -> dict[str, list[Any]]:
+    """Taggable/searchable entities keyed by type — only the requested
+    ``types`` are fetched, concurrently (a narrowed /tags?entity_types=
+    must not pay five unrelated DB round-trips)."""
+    import asyncio
+
+    loaders = {
+        "tools": lambda: app["tool_service"].list_tools(team_ids=teams),
+        "resources": lambda: app["resource_service"].list_resources(),
+        "prompts": lambda: app["prompt_service"].list_prompts(),
+        "servers": lambda: app["server_service"].list_servers(),
+        "gateways": lambda: app["gateway_service"].list_gateways(),
+        "a2a_agents": lambda: app["a2a_service"].list_agents(),
+    }
+    wanted = [t for t in (types or _ENTITY_SOURCES) if t in loaders]
+    results = await asyncio.gather(*[loaders[t]() for t in wanted])
+    return dict(zip(wanted, results))
+
+
+def setup_discovery_routes(app: web.Application) -> None:
+    routes = web.RouteTableDef()
+
+    # ------------------------------------------------------------------ tags
+    @routes.get("/tags")
+    async def list_tags(request: web.Request) -> web.Response:
+        """Aggregated tag census across entity types (reference tags
+        router: names + per-type counts, optional entity_types filter)."""
+        request["auth"].require("tools.read")
+        wanted = request.query.get("entity_types")
+        types = ([t.strip() for t in wanted.split(",") if t.strip()]
+                 if wanted else list(_ENTITY_SOURCES))
+        entities = await _all_entities(request.app, request["auth"].teams,
+                                       types)
+        census: dict[str, dict[str, Any]] = {}
+        for etype in types:
+            for entity in entities.get(etype, []):
+                for tag in getattr(entity, "tags", None) or []:
+                    stats = census.setdefault(
+                        tag, {"name": tag, "total": 0,
+                              "by_type": {}})
+                    stats["total"] += 1
+                    stats["by_type"][etype] = stats["by_type"].get(etype, 0) + 1
+        return web.json_response(
+            sorted(census.values(), key=lambda s: (-s["total"], s["name"])))
+
+    @routes.get("/tags/{tag}/entities")
+    async def tag_entities(request: web.Request) -> web.Response:
+        request["auth"].require("tools.read")
+        tag = request.match_info["tag"]
+        entities = await _all_entities(request.app, request["auth"].teams)
+        out = []
+        for etype, items in entities.items():
+            for entity in items:
+                if tag in (getattr(entity, "tags", None) or []):
+                    out.append({"type": etype,
+                                "id": getattr(entity, "id", None),
+                                "name": getattr(entity, "name", ""),
+                                "description": getattr(entity, "description",
+                                                       None)})
+        return web.json_response({"tag": tag, "entities": out})
+
+    # ---------------------------------------------------------------- search
+    @routes.get("/search")
+    async def search(request: web.Request) -> web.Response:
+        """Case-insensitive substring search over name/description/tags of
+        every entity type (reference routers/search.py), grouped by type.
+        ``?q=`` required; ``?types=tools,prompts`` narrows; ``?limit=``
+        caps per-type results."""
+        request["auth"].require("tools.read")
+        query = request.query.get("q", "").strip().lower()
+        if not query:
+            return web.json_response(
+                {"detail": "query parameter 'q' is required"}, status=422)
+        wanted = request.query.get("types")
+        types = ([t.strip() for t in wanted.split(",") if t.strip()]
+                 if wanted else list(_ENTITY_SOURCES))
+        limit = max(1, min(int(request.query.get("limit", "25")), 200))
+        entities = await _all_entities(request.app, request["auth"].teams,
+                                       types)
+        results: dict[str, list[dict[str, Any]]] = {}
+        for etype in types:
+            hits = []
+            for entity in entities.get(etype, []):
+                name = str(getattr(entity, "name", ""))
+                desc = str(getattr(entity, "description", None) or "")
+                tags = getattr(entity, "tags", None) or []
+                haystacks = (name.lower(), desc.lower(),
+                             " ".join(tags).lower())
+                if any(query in hay for hay in haystacks):
+                    hits.append({"id": getattr(entity, "id", None),
+                                 "name": name, "description": desc or None,
+                                 "tags": tags})
+                    if len(hits) >= limit:
+                        break
+            if hits:
+                results[etype] = hits
+        return web.json_response({
+            "query": query,
+            "results": results,
+            "total": sum(len(v) for v in results.values())})
+
+    # ----------------------------------------------------------- openapi.json
+    @routes.get("/openapi.json")
+    async def openapi_schema(request: web.Request) -> web.Response:
+        """OpenAPI 3.1 document generated from the live route table
+        (reference routers/openapi_schema.py serves the FastAPI schema;
+        aiohttp has none built in, so the gateway derives one)."""
+        request["auth"].require("tools.read")
+        paths: dict[str, dict[str, Any]] = {}
+        for route in request.app.router.routes():
+            method = route.method.lower()
+            if method in ("head", "options", "*"):
+                continue
+            info = route.resource.get_info() if route.resource else {}
+            path = info.get("path") or info.get("formatter")
+            if not path or path.startswith("/admin/ui"):
+                continue
+            handler_doc = (route.handler.__doc__ or "").strip()
+            op: dict[str, Any] = {
+                "operationId": f"{method}_{route.handler.__name__}",
+                "summary": handler_doc.split("\n", 1)[0][:120]
+                or route.handler.__name__,
+                "responses": {"200": {"description": "Success"}},
+            }
+            params = [seg[1:-1] for seg in path.split("/")
+                      if seg.startswith("{") and seg.endswith("}")]
+            if params:
+                op["parameters"] = [{"name": p, "in": "path",
+                                     "required": True,
+                                     "schema": {"type": "string"}}
+                                    for p in params]
+            paths.setdefault(path, {})[method] = op
+        from ..schemas import (GatewayRead, PromptRead, ResourceRead,
+                               ServerRead, ToolRead)
+
+        components = {
+            name: model.model_json_schema(ref_template=
+                                          "#/components/schemas/{model}")
+            for name, model in (("ToolRead", ToolRead),
+                                ("ResourceRead", ResourceRead),
+                                ("PromptRead", PromptRead),
+                                ("ServerRead", ServerRead),
+                                ("GatewayRead", GatewayRead))}
+        # hoist nested $defs so every $ref resolves at components/schemas
+        hoisted: dict[str, Any] = {}
+        for schema in components.values():
+            for def_name, def_schema in schema.pop("$defs", {}).items():
+                hoisted.setdefault(def_name, def_schema)
+        components.update(hoisted)
+        return web.json_response({
+            "openapi": "3.1.0",
+            "info": {"title": request.app["ctx"].settings.app_name,
+                     "version": __version__},
+            "paths": dict(sorted(paths.items())),
+            "components": {"schemas": components},
+        })
+
+    # ------------------------------------------- per-server well-known (public)
+    @routes.get("/servers/{server_id}/.well-known/mcp")
+    async def server_well_known(request: web.Request) -> web.Response:
+        """Public discovery metadata for ONE virtual server (reference
+        routers/server_well_known.py): name + protocol + endpoint, no
+        catalog contents (those stay behind auth)."""
+        try:
+            server = await request.app["server_service"].get_server(
+                request.match_info["server_id"])
+        except NotFoundError:
+            return web.json_response({"detail": "Server not found"},
+                                     status=404)
+        settings = request.app["ctx"].settings
+        base = settings.app_domain.rstrip("/")
+        return web.json_response({
+            "name": server.name,
+            "description": server.description,
+            "protocol_version": settings.protocol_version,
+            "endpoint": f"{base}/servers/{server.id}/mcp",
+            "transport": ["streamable-http"],
+        })
+
+    # --------------------------------------------- well-known files (public)
+    @routes.get("/robots.txt")
+    async def robots_txt(request: web.Request) -> web.Response:
+        """reference well_known_robots_txt (crawler exclusion by default)."""
+        settings = request.app["ctx"].settings
+        return web.Response(
+            text=settings.well_known_robots_txt, content_type="text/plain",
+            headers={"cache-control":
+                     f"max-age={settings.well_known_cache_max_age}"})
+
+    @routes.get("/.well-known/{file}")
+    async def well_known_file(request: web.Request) -> web.Response:
+        """security.txt + operator-defined custom well-known files
+        (reference routers/well_known.py; JSON map in settings)."""
+        settings = request.app["ctx"].settings
+        name = request.match_info["file"]
+        content: str | None = None
+        if name == "security.txt" and settings.well_known_security_txt:
+            content = settings.well_known_security_txt
+        elif settings.well_known_custom_files:
+            try:
+                custom = json.loads(settings.well_known_custom_files)
+            except json.JSONDecodeError:
+                custom = {}
+            value = custom.get(name)
+            content = value if isinstance(value, str) else None
+        if content is None:
+            return web.json_response({"detail": "Not found"}, status=404)
+        return web.Response(
+            text=content, content_type="text/plain",
+            headers={"cache-control":
+                     f"max-age={settings.well_known_cache_max_age}"})
+
+    # ------------------------------------------------------ metrics maintenance
+    @routes.post("/metrics/prune")
+    async def prune_metrics(request: web.Request) -> web.Response:
+        """Retention cleanup now (reference metrics_maintenance router):
+        raw metric rows past retention are deleted; rollups keep history."""
+        request["auth"].require("admin.all")
+        pruned = await request.app["metrics_maintenance"].cleanup()
+        return web.json_response({"pruned": pruned})
+
+    @routes.post("/metrics/reset")
+    async def reset_metrics(request: web.Request) -> web.Response:
+        """Drop ALL raw metric rows + rollups (reference /metrics DELETE)."""
+        request["auth"].require("admin.all")
+        db = request.app["ctx"].db
+        raw = await db.fetchone("SELECT COUNT(*) AS n FROM tool_metrics")
+        await db.execute("DELETE FROM tool_metrics")
+        await db.execute("DELETE FROM metrics_rollups")
+        return web.json_response({"deleted_raw": int(raw["n"]) if raw else 0})
+
+    app.add_routes(routes)

@@ -24,7 +24,10 @@ def setup_extra_routes(app: web.Application) -> None:
         request["auth"].require("a2a.read")
         agents = await request.app["a2a_service"].list_agents(
             request.query.get("include_inactive") == "true")
-        return web.json_response([json.loads(a.model_dump_json()) for a in agents])
+        from .pagination import paginate
+        return paginate(request, agents,
+                        lambda page: [json.loads(a.model_dump_json())
+                                      for a in page])
 
     @routes.post("/a2a")
     async def register_agent(request: web.Request) -> web.Response:

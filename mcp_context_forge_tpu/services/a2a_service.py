@@ -52,6 +52,13 @@ class A2AService:
                                               (agent.name,))
         if existing:
             raise ConflictError(f"Agent {agent.name!r} already exists")
+        cap = self.ctx.settings.a2a_max_agents
+        if cap:
+            count = await self.ctx.db.fetchone(
+                "SELECT COUNT(*) AS n FROM a2a_agents")
+            if count and int(count["n"]) >= cap:
+                raise ValidationFailure(
+                    f"Agent registry is at capacity ({cap}; a2a_max_agents)")
         aid = new_id()
         ts = now()
         auth_value = (encrypt_field(agent.auth_value,

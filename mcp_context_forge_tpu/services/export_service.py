@@ -15,7 +15,7 @@ from typing import Any
 
 from ..db.core import from_json
 from ..utils.crypto import decrypt_field, encrypt_field
-from .base import AppContext, now
+from .base import AppContext, ValidationFailure, now
 
 EXPORT_TABLES = ["gateways", "tools", "resources", "prompts", "servers",
                  "server_tools", "server_resources", "server_prompts",
@@ -62,6 +62,12 @@ class ExportService:
     async def import_all(self, bundle: dict[str, Any], overwrite: bool = False,
                          passphrase: str | None = None) -> dict[str, Any]:
         entities = bundle.get("entities", {})
+        cap = self.ctx.settings.bulk_import_max_entities
+        total = sum(len(rows) for rows in entities.values()
+                    if isinstance(rows, list))
+        if cap and total > cap:
+            raise ValidationFailure(
+                f"Bundle holds {total} rows (bulk_import_max_entities {cap})")
         summary: dict[str, int] = {}
         conflict = "REPLACE" if overwrite else "IGNORE"
         for table in EXPORT_TABLES:  # insertion order respects FKs

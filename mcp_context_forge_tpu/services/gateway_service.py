@@ -242,13 +242,22 @@ class GatewayService:
         reactivate on recovery (reference :4368/:4318/:4485)."""
         rows = await self.ctx.db.fetchall("SELECT * FROM gateways WHERE enabled=1")
         results: dict[str, bool] = {}
-        for row in rows:
-            ok = False
-            try:
-                async with await self._connect(row) as session:
-                    ok = True
-            except Exception:
-                ok = False
+        # bounded fan-out (reference max_concurrent_health_checks): N slow
+        # peers must not serialize into an N*timeout sweep, but an
+        # unbounded gather over hundreds of peers would burst sockets
+        semaphore = asyncio.Semaphore(
+            max(1, self.ctx.settings.max_concurrent_health_checks))
+
+        async def probe(row) -> bool:
+            async with semaphore:
+                try:
+                    async with await self._connect(row):
+                        return True
+                except Exception:
+                    return False
+
+        probed = await asyncio.gather(*[probe(row) for row in rows])
+        for row, ok in zip(rows, probed):
             results[row["id"]] = ok
             if ok:
                 await self.ctx.db.execute(

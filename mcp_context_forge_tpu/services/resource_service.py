@@ -12,7 +12,7 @@ from ..clients.mcp_client import MCPSession
 from ..db.core import from_json, to_json
 from ..schemas import ResourceCreate, ResourceRead, ResourceUpdate
 from ..utils.ids import new_id
-from .base import AppContext, ConflictError, NotFoundError, now
+from .base import AppContext, ConflictError, NotFoundError, ValidationFailure, now
 from .tool_service import _auth_headers
 
 
@@ -40,6 +40,10 @@ class ResourceService:
         rid = new_id()
         ts = now()
         size = len(res.content.encode()) if res.content else None
+        cap = self.ctx.settings.max_resource_size
+        if cap and size and size > cap:
+            raise ValidationFailure(
+                f"Resource content is {size} bytes (max_resource_size {cap})")
         await self.ctx.db.execute(
             "INSERT INTO resources (id, uri, name, description, mime_type, uri_template,"
             " content, is_binary, size, gateway_id, enabled, tags, team_id, owner_email,"

@@ -26,6 +26,14 @@ class TeamService:
                                               (slug,))
         if existing:
             raise ConflictError(f"Team {name!r} already exists")
+        cap = self.ctx.settings.max_teams_per_user
+        if cap:
+            owned = await self.ctx.db.fetchone(
+                "SELECT COUNT(*) AS n FROM teams WHERE created_by=?"
+                " AND is_personal=0", (created_by,))
+            if owned and int(owned["n"]) >= cap:
+                raise ValidationFailure(
+                    f"User already owns {cap} teams (max_teams_per_user)")
         team_id = new_id()
         ts = now()
         await self.ctx.db.execute(
@@ -88,9 +96,28 @@ class TeamService:
                                           (email,))
         if not user:
             raise NotFoundError(f"User {email!r} not found")
+        await self._check_member_cap(team_id, email)
         await self.ctx.db.execute(
             "INSERT OR REPLACE INTO team_members (team_id, user_email, role,"
             " joined_at) VALUES (?,?,?,?)", (team_id, email, role, now()))
+
+    async def _check_member_cap(self, team_id: str, email: str) -> None:
+        """Cap only NEW memberships: re-adding an existing member is a
+        role change via INSERT OR REPLACE and must work on a full team."""
+        cap = self.ctx.settings.max_members_per_team
+        if not cap:
+            return
+        existing = await self.ctx.db.fetchone(
+            "SELECT 1 AS x FROM team_members WHERE team_id=? AND user_email=?",
+            (team_id, email))
+        if existing:
+            return
+        members = await self.ctx.db.fetchone(
+            "SELECT COUNT(*) AS n FROM team_members WHERE team_id=?",
+            (team_id,))
+        if members and int(members["n"]) >= cap:
+            raise ValidationFailure(
+                f"Team already has {cap} members (max_members_per_team)")
 
     async def remove_member(self, team_id: str, actor: str, email: str,
                             is_admin: bool = False) -> None:
@@ -129,6 +156,7 @@ class TeamService:
             raise ValidationFailure("Invitation expired")
         if row["email"].lower() != user.lower():
             raise ValidationFailure("Invitation was issued to a different email")
+        await self._check_member_cap(row["team_id"], user)
         await self.ctx.db.execute(
             "INSERT OR REPLACE INTO team_members (team_id, user_email, role,"
             " joined_at) VALUES (?,?,?,?)",

@@ -281,14 +281,29 @@ class ToolService:
         per-gateway list first, else the global default when the feature
         flag is on; sensitive headers never ride the default (reference
         passthrough_headers + config.py:3489-3499)."""
+        settings = self.ctx.settings
         allowed = from_json((gateway or {}).get("passthrough_headers"), [])
-        if not allowed and self.ctx.settings.enable_header_passthrough:
-            allowed = [h for h in self.ctx.settings.default_passthrough_list()
-                       if h.lower() not in ("authorization", "cookie")]
+        if not allowed and settings.enable_header_passthrough:
+            allowed = settings.default_passthrough_list()
+            if not settings.enable_sensitive_header_passthrough:
+                # credentials never ride the GLOBAL default list; a
+                # per-gateway allowlist is an explicit operator opt-in
+                allowed = [h for h in allowed
+                           if h.lower() not in ("authorization", "cookie")]
+        # case-insensitive membership: base headers may be stored in any
+        # casing ('X-Tenant-Id' vs allowlist 'x-tenant-id') and two
+        # differently-cased duplicates must never ride one request
+        existing = {k.lower(): k for k in headers}
         for h in allowed:
             value = request_headers.get(h.lower())
-            if value:
+            if not value:
+                continue
+            present = existing.get(h.lower())
+            if present is None:
                 headers[h] = value
+                existing[h.lower()] = h
+            elif settings.enable_overwrite_base_headers:
+                headers[present] = value
 
     # REST branch (reference tool_service.py:6196+)
     async def _invoke_rest(self, row: dict[str, Any], arguments: dict[str, Any],
@@ -299,9 +314,13 @@ class ToolService:
         if not url:
             raise JSONRPCError(INVALID_PARAMS, "REST tool has no URL")
         headers = dict(from_json(row["headers"], {}))
-        self._passthrough(headers, request_headers or {}, None)
         headers.update(injected_headers)
         headers.update(await resolve_auth_headers(self.ctx, row))
+        # passthrough runs over the COMPLETE base header set so
+        # enable_overwrite_base_headers can actually replace tool-config
+        # auth (it is the no-overwrite default that must see auth too,
+        # or it would add a duplicate instead of skipping)
+        self._passthrough(headers, request_headers or {}, None)
         # URL path templating: {placeholder} substituted from arguments
         body_args = dict(arguments)
         for key in list(body_args):
@@ -356,8 +375,8 @@ class ToolService:
                                    err.get("message", "tunnel error"))
             return response.get("result", {})
         headers = await resolve_auth_headers(self.ctx, gateway or row)
-        self._passthrough(headers, request_headers, gateway)
         headers.update(injected_headers or {})
+        self._passthrough(headers, request_headers, gateway)
 
         registry = self.ctx.extras.get("upstream_sessions")
 

@@ -1,0 +1,143 @@
+"""Config breadth families (VERDICT r3 #8): header guards, validation
+limits, per-entity caps, well-known files, passthrough policy knobs.
+
+Reference: `/root/reference/mcpgateway/config.py` validation_*, max_*,
+well_known_*, enable_*_header_passthrough families.
+"""
+
+import aiohttp
+
+from tests.integration.test_gateway_app import BASIC, make_client
+
+AUTH = aiohttp.BasicAuth(*BASIC)
+
+
+async def test_header_count_and_field_size_guards():
+    gateway = await make_client(max_header_count="40",
+                                max_header_field_bytes="64")
+    try:
+        resp = await gateway.get("/health")
+        assert resp.status == 200
+        # one oversize field -> 431
+        resp = await gateway.get("/health", headers={"x-big": "v" * 100})
+        assert resp.status == 431
+        # too many fields -> 431
+        many = {f"x-h{i}": "1" for i in range(45)}
+        resp = await gateway.get("/health", headers=many)
+        assert resp.status == 431
+    finally:
+        await gateway.close()
+
+
+async def test_validation_limits_enforced_centrally():
+    gateway = await make_client(validation_max_name_length="10",
+                                validation_max_tags="2",
+                                validation_max_tag_length="5")
+    try:
+        resp = await gateway.post("/tools", json={
+            "name": "way-too-long-name", "integration_type": "REST",
+            "url": "http://u.example"}, auth=AUTH)
+        assert resp.status == 422
+        assert "name exceeds 10" in (await resp.json())["detail"]
+        resp = await gateway.post("/tools", json={
+            "name": "ok", "integration_type": "REST",
+            "url": "http://u.example", "tags": ["a", "b", "c"]}, auth=AUTH)
+        assert resp.status == 422
+        resp = await gateway.post("/tools", json={
+            "name": "ok", "integration_type": "REST",
+            "url": "http://u.example", "tags": ["toolong"]}, auth=AUTH)
+        assert resp.status == 422
+        resp = await gateway.post("/tools", json={
+            "name": "ok", "integration_type": "REST",
+            "url": "http://u.example", "tags": ["ab", "cd"]}, auth=AUTH)
+        assert resp.status == 201
+    finally:
+        await gateway.close()
+
+
+async def test_per_entity_caps():
+    gateway = await make_client(max_teams_per_user="2",
+                                a2a_max_agents="1",
+                                max_resource_size="100")
+    try:
+        for i in range(2):
+            resp = await gateway.post("/teams", json={"name": f"team-{i}"},
+                                      auth=AUTH)
+            assert resp.status == 201
+        resp = await gateway.post("/teams", json={"name": "team-over"},
+                                  auth=AUTH)
+        assert resp.status == 422
+        assert "max_teams_per_user" in (await resp.json())["detail"]
+
+        resp = await gateway.post("/a2a", json={
+            "name": "a1", "endpoint_url": "http://a.example"}, auth=AUTH)
+        assert resp.status == 201
+        resp = await gateway.post("/a2a", json={
+            "name": "a2", "endpoint_url": "http://a.example"}, auth=AUTH)
+        assert resp.status == 422
+
+        resp = await gateway.post("/resources", json={
+            "uri": "mem://big", "name": "big", "content": "x" * 200},
+            auth=AUTH)
+        assert resp.status == 422
+        assert "max_resource_size" in (await resp.json())["detail"]
+    finally:
+        await gateway.close()
+
+
+async def test_well_known_files():
+    gateway = await make_client(
+        well_known_security_txt="Contact: mailto:sec@x.example",
+        well_known_custom_files='{"ai.txt": "no crawling"}')
+    try:
+        resp = await gateway.get("/robots.txt")  # public, no auth
+        assert resp.status == 200
+        assert "Disallow: /" in await resp.text()
+        assert "max-age=" in resp.headers["cache-control"]
+        resp = await gateway.get("/.well-known/security.txt")
+        assert (await resp.text()) == "Contact: mailto:sec@x.example"
+        resp = await gateway.get("/.well-known/ai.txt")
+        assert (await resp.text()) == "no crawling"
+        resp = await gateway.get("/.well-known/nope.txt")
+        assert resp.status == 404
+    finally:
+        await gateway.close()
+
+
+async def test_sensitive_passthrough_policy(monkeypatch):
+    """Global default list drops authorization/cookie unless the sensitive
+    opt-in is set; gateway-set headers win unless overwrite enabled."""
+    from mcp_context_forge_tpu.config import load_settings
+    from mcp_context_forge_tpu.services.tool_service import ToolService
+
+    def svc(**env):
+        settings = load_settings(env={
+            "MCPFORGE_ENABLE_HEADER_PASSTHROUGH": "true",
+            "MCPFORGE_DEFAULT_PASSTHROUGH_HEADERS":
+                "authorization,x-tenant-id", **env}, env_file=None)
+        service = ToolService.__new__(ToolService)
+
+        class _Ctx:
+            pass
+
+        service.ctx = _Ctx()
+        service.ctx.settings = settings
+        return service
+
+    headers = {"x-base": "gw"}
+    svc()._passthrough(headers, {"authorization": "Bearer leak",
+                                 "x-tenant-id": "t1"}, None)
+    assert "authorization" not in headers      # sensitive dropped
+    assert headers["x-tenant-id"] == "t1"
+
+    headers = {}
+    svc(MCPFORGE_ENABLE_SENSITIVE_HEADER_PASSTHROUGH="true")._passthrough(
+        headers, {"authorization": "Bearer ok"}, None)
+    assert headers["authorization"] == "Bearer ok"
+
+    headers = {"x-tenant-id": "gateway-set"}
+    svc()._passthrough(headers, {"x-tenant-id": "client"}, None)
+    assert headers["x-tenant-id"] == "gateway-set"   # no overwrite
+    svc(MCPFORGE_ENABLE_OVERWRITE_BASE_HEADERS="true")._passthrough(
+        headers, {"x-tenant-id": "client"}, None)
+    assert headers["x-tenant-id"] == "client"        # opt-in overwrite

@@ -1,0 +1,170 @@
+"""Long-tail API surface (VERDICT r3 #7): tags + search routers, cursor
+pagination, /openapi.json, per-server well-known, metrics maintenance.
+
+Reference: `/root/reference/mcpgateway/main.py:3575-3586` router list,
+`utils/pagination`.
+"""
+
+import aiohttp
+
+from tests.integration.test_gateway_app import BASIC, make_client
+
+AUTH = aiohttp.BasicAuth(*BASIC)
+
+
+async def _seed(gateway, n_tools: int = 5):
+    for i in range(n_tools):
+        resp = await gateway.post("/tools", json={
+            "name": f"tool-{i:02d}", "integration_type": "REST",
+            "url": f"http://up.example/{i}",
+            "description": f"searchable tool number {i}",
+            "tags": ["alpha"] if i % 2 == 0 else ["beta", "alpha"],
+        }, auth=AUTH)
+        assert resp.status == 201, await resp.text()
+
+
+async def test_tags_census_and_entities():
+    gateway = await make_client()
+    try:
+        await _seed(gateway)
+        await gateway.post("/prompts", json={
+            "name": "p1", "template": "hello {{x}}", "tags": ["alpha"]},
+            auth=AUTH)
+        resp = await gateway.get("/tags", auth=AUTH)
+        assert resp.status == 200
+        census = {t["name"]: t for t in await resp.json()}
+        assert census["alpha"]["total"] == 6          # 5 tools + 1 prompt
+        assert census["alpha"]["by_type"] == {"tools": 5, "prompts": 1}
+        assert census["beta"]["by_type"] == {"tools": 2}
+        # filter by entity type
+        resp = await gateway.get("/tags?entity_types=prompts", auth=AUTH)
+        census = {t["name"]: t for t in await resp.json()}
+        assert census["alpha"]["total"] == 1 and "beta" not in census
+
+        resp = await gateway.get("/tags/beta/entities", auth=AUTH)
+        body = await resp.json()
+        assert {e["name"] for e in body["entities"]} == {"tool-01", "tool-03"}
+        assert all(e["type"] == "tools" for e in body["entities"])
+    finally:
+        await gateway.close()
+
+
+async def test_search_across_entities():
+    gateway = await make_client()
+    try:
+        await _seed(gateway, 3)
+        await gateway.post("/prompts", json={
+            "name": "weather-report", "template": "t {{x}}",
+            "description": "searchable prompt"}, auth=AUTH)
+        resp = await gateway.get("/search?q=searchable", auth=AUTH)
+        body = await resp.json()
+        assert body["total"] == 4
+        assert len(body["results"]["tools"]) == 3
+        assert body["results"]["prompts"][0]["name"] == "weather-report"
+        # type narrowing + per-type limit
+        resp = await gateway.get("/search?q=searchable&types=tools&limit=2",
+                                 auth=AUTH)
+        body = await resp.json()
+        assert list(body["results"]) == ["tools"] and body["total"] == 2
+        # tag search hits too
+        resp = await gateway.get("/search?q=beta", auth=AUTH)
+        assert (await resp.json())["total"] == 1
+        # missing q -> 422
+        resp = await gateway.get("/search", auth=AUTH)
+        assert resp.status == 422
+    finally:
+        await gateway.close()
+
+
+async def test_cursor_pagination_walks_all_pages():
+    gateway = await make_client()
+    try:
+        await _seed(gateway, 7)
+        seen: list[str] = []
+        cursor = ""
+        for _ in range(10):
+            url = f"/tools?limit=3" + (f"&cursor={cursor}" if cursor else "")
+            body = await (await gateway.get(url, auth=AUTH)).json()
+            assert body["total"] == 7
+            seen += [t["name"] for t in body["items"]]
+            if not body["next_cursor"]:
+                break
+            cursor = body["next_cursor"]
+        assert seen == [f"tool-{i:02d}" for i in range(7)]  # no dup, no gap
+        # legacy shape untouched without params
+        body = await (await gateway.get("/tools", auth=AUTH)).json()
+        assert isinstance(body, list) and len(body) == 7
+        # bad cursor -> 422, not silent restart
+        resp = await gateway.get("/tools?cursor=%%%", auth=AUTH)
+        assert resp.status == 422
+        # pagination exists on the other entity lists
+        for path in ("/gateways", "/resources", "/prompts", "/servers",
+                     "/a2a", "/admin/users"):
+            body = await (await gateway.get(f"{path}?limit=2", auth=AUTH)).json()
+            assert set(body) == {"items", "next_cursor", "total"}, path
+    finally:
+        await gateway.close()
+
+
+async def test_openapi_schema_reflects_routes():
+    gateway = await make_client()
+    try:
+        resp = await gateway.get("/openapi.json", auth=AUTH)
+        assert resp.status == 200
+        doc = await resp.json()
+        assert doc["openapi"] == "3.1.0"
+        assert "/tools" in doc["paths"]
+        assert "post" in doc["paths"]["/tools"] and "get" in doc["paths"]["/tools"]
+        # path params surfaced
+        params = doc["paths"]["/tools/{tool_id}"]["get"]["parameters"]
+        assert params[0]["name"] == "tool_id" and params[0]["in"] == "path"
+        # component schemas resolve
+        assert "ToolRead" in doc["components"]["schemas"]
+        # the discovery endpoints themselves are in the schema
+        for path in ("/tags", "/search", "/openapi.json"):
+            assert path in doc["paths"]
+    finally:
+        await gateway.close()
+
+
+async def test_server_well_known_is_public():
+    gateway = await make_client()
+    try:
+        resp = await gateway.post("/servers", json={
+            "name": "srv", "description": "virtual"}, auth=AUTH)
+        server_id = (await resp.json())["id"]
+        # NO auth on purpose: discovery metadata is public
+        resp = await gateway.get(f"/servers/{server_id}/.well-known/mcp")
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["name"] == "srv"
+        assert body["endpoint"].endswith(f"/servers/{server_id}/mcp")
+        assert "streamable-http" in body["transport"]
+        resp = await gateway.get("/servers/nope/.well-known/mcp")
+        assert resp.status == 404
+        # but the server LIST stays authenticated
+        resp = await gateway.get("/servers")
+        assert resp.status == 401
+    finally:
+        await gateway.close()
+
+
+async def test_metrics_maintenance_endpoints():
+    gateway = await make_client()
+    try:
+        db = gateway.app["ctx"].db
+        await db.execute(
+            "INSERT INTO tool_metrics (tool_id, ts, duration_ms, success)"
+            " VALUES ('t1', 1, 5.0, 1)")  # ancient row: prunable
+        resp = await gateway.post("/metrics/prune", auth=AUTH)
+        assert resp.status == 200
+        assert (await resp.json())["pruned"] == 1
+        await db.execute(
+            "INSERT INTO tool_metrics (tool_id, ts, duration_ms, success)"
+            " VALUES ('t1', strftime('%s','now'), 5.0, 1)")
+        resp = await gateway.post("/metrics/reset", auth=AUTH)
+        assert (await resp.json())["deleted_raw"] == 1
+        row = await db.fetchone("SELECT COUNT(*) AS n FROM tool_metrics")
+        assert row["n"] == 0
+    finally:
+        await gateway.close()
