@@ -4,13 +4,22 @@ Reference: 20.5k-LoC admin.py + 34.8k-LoC JS admin_ui — intentionally
 table-driven here (SURVEY.md §7.2 #5: the API surface must be generated,
 not hand-grown). One page, vanilla JS over the existing REST API:
 
-- entity tabs with client-side search + auto-refresh
-- full CRUD where the API has it: create forms (per-entity field specs),
-  JSON edit (PUT), delete, enable/disable toggles
-- trace drill-down: span tree AND a gantt view (bars positioned by
-  start_ts/duration over the trace window — the reference's admin trace
-  timeline)
-- engine dashboard: live tpu_local stats as stat cards
+- entity tabs with client-side search + auto-refresh + cursor paging
+- full CRUD where the API has it: create forms (per-entity field specs,
+  typed fields ``name:int`` / ``name:csv`` / ``name:json``), JSON edit
+  (PUT), delete, enable/disable toggles
+- per-entity DETAIL views (key-value pane + related records: team
+  members with add/remove/invite, token mint-once reveal, plugin mode
+  dropdowns posting /plugins/{name}/mode)
+- metrics dashboard: totals cards + hourly rollup bar chart (pure divs)
+- export/import pane: download the config bundle, paste-to-import with
+  overwrite toggle
+- trace drill-down: span tree AND a gantt view; engine stat cards
+
+The UI contract test (`tests/integration/test_admin_ui_contract.py` +
+`test_admin_ui_coverage.py`) asserts every admin REST endpoint is
+reachable from this page — the JS-free browser tier (no node/playwright
+in the image; the reference uses `tests/playwright/`).
 """
 
 from __future__ import annotations
@@ -41,8 +50,8 @@ _PAGE = """<!doctype html>
  .span-row{font-family:ui-monospace,monospace;font-size:12px;white-space:pre}
  .err{color:#a12622}
  #form{background:#fff;margin:10px 0;padding:12px;box-shadow:0 1px 3px rgba(0,0,0,.08);display:none}
- #form input{margin:3px 6px 3px 0;padding:5px 8px;border:1px solid #ccd;border-radius:4px}
- #edit-area{width:100%;min-height:140px;font-family:ui-monospace,monospace;font-size:12px}
+ #form input,#detail input,#detail select{margin:3px 6px 3px 0;padding:5px 8px;border:1px solid #ccd;border-radius:4px}
+ #edit-area,#import-area{width:100%;min-height:140px;font-family:ui-monospace,monospace;font-size:12px}
  .gantt{position:relative;height:18px;margin:1px 0;background:#fafbfc}
  .gantt .bar{position:absolute;top:2px;height:14px;background:#9cf;border-radius:2px;min-width:2px}
  .gantt .bar.err{background:#f99}
@@ -50,6 +59,15 @@ _PAGE = """<!doctype html>
  .cards{display:flex;gap:12px;flex-wrap:wrap}
  .card{background:#fff;box-shadow:0 1px 3px rgba(0,0,0,.08);padding:12px 18px;min-width:130px}
  .card b{display:block;font-size:22px}.card span{color:#667;font-size:12px}
+ .kv{font-family:ui-monospace,monospace;font-size:12px}
+ .kv td{padding:3px 10px}
+ .chart{display:flex;align-items:flex-end;gap:2px;height:120px;background:#fff;padding:10px;box-shadow:0 1px 3px rgba(0,0,0,.08);margin-top:10px}
+ .chart .col{flex:1;display:flex;flex-direction:column;justify-content:flex-end;height:100%}
+ .chart .v{background:#9cf;min-height:1px}
+ .chart .e{background:#f99}
+ .chart .t{font-size:9px;color:#889;text-align:center;overflow:hidden}
+ select.mode{font-size:12px;padding:2px}
+ .reveal{background:#fffbe6;border:1px solid #eda;padding:8px;margin:8px 0;font-family:ui-monospace,monospace;font-size:12px;word-break:break-all}
 </style></head><body>
 <header><h1>mcpforge</h1><nav id="nav"></nav></header>
 <main>
@@ -57,6 +75,7 @@ _PAGE = """<!doctype html>
   <input id="q" placeholder="filter rows…" oninput="render()">
   <button class="act" onclick="show(current)">refresh</button>
   <button class="act" id="newbtn" onclick="openForm()" style="display:none">+ new</button>
+  <button class="act" id="morebtn" onclick="nextPage()" style="display:none">next page ▸</button>
   <label style="font-size:12px;color:#667"><input type="checkbox" id="auto"
    onchange="autoRefresh()"> auto (5s)</label>
   <span id="status"></span>
@@ -67,39 +86,58 @@ _PAGE = """<!doctype html>
 </main>
 <script>
 const TABS = {
-  tools:    {url: "/tools?include_inactive=true", cols: ["name","integration_type","url","enabled","reachable"], toggle: id => `/tools/${id}/toggle`, boolcols: ["enabled","reachable"],
-             create: {url:"/tools", fields:["name","integration_type","url","description"]},
-             edit: id => `/tools/${id}`, del: id => `/tools/${id}`},
-  gateways: {url: "/gateways?include_inactive=true", cols: ["name","url","transport","state","reachable"], boolcols: ["reachable"],
+  tools:    {paged:true, url: "/tools?include_inactive=true", cols: ["name","integration_type","url","enabled","reachable"], toggle: id => `/tools/${id}/toggle`, boolcols: ["enabled","reachable"],
+             create: {url:"/tools", fields:["name","integration_type","url","description","tags:csv"]},
+             edit: id => `/tools/${id}`, del: id => `/tools/${id}`,
+             detail: id => `/tools/${id}`,
+             rowacts: [{label:"gen cases", method:"GET", key:"name", show:true, url: n => `/toolops/${encodeURIComponent(n)}/cases`},
+                       {label:"run cases", method:"POST", key:"name", show:true, url: n => `/toolops/${encodeURIComponent(n)}/run`}]},
+  gateways: {paged:true, url: "/gateways?include_inactive=true", cols: ["name","url","transport","state","reachable"], boolcols: ["reachable"],
              create: {url:"/gateways", fields:["name","url","transport"]},
-             edit: id => `/gateways/${id}`, del: id => `/gateways/${id}`},
-  servers:  {url: "/servers?include_inactive=true", cols: ["name","description","associated_tools","enabled"], boolcols: ["enabled"],
-             create: {url:"/servers", fields:["name","description"]},
-             edit: id => `/servers/${id}`, del: id => `/servers/${id}`},
-  resources:{url: "/resources?include_inactive=true", cols: ["uri","name","mime_type","enabled"], boolcols: ["enabled"],
+             edit: id => `/gateways/${id}`, del: id => `/gateways/${id}`,
+             detail: id => `/gateways/${id}`,
+             rowacts: [{label:"resync", method:"POST", url: id => `/gateways/${id}/refresh`}]},
+  servers:  {paged:true, url: "/servers?include_inactive=true", cols: ["name","description","associated_tools","enabled"], boolcols: ["enabled"],
+             create: {url:"/servers", fields:["name","description","associated_tools:csv"]},
+             edit: id => `/servers/${id}`, del: id => `/servers/${id}`,
+             detail: id => `/servers/${id}`},
+  resources:{paged:true, url: "/resources?include_inactive=true", cols: ["uri","name","mime_type","enabled"], boolcols: ["enabled"],
              create: {url:"/resources", fields:["uri","name","content","mime_type"]},
              edit: id => `/resources/${id}`, del: id => `/resources/${id}`},
-  prompts:  {url: "/prompts?include_inactive=true", cols: ["name","description","enabled"], boolcols: ["enabled"],
+  prompts:  {paged:true, url: "/prompts?include_inactive=true", cols: ["name","description","enabled"], boolcols: ["enabled"],
              create: {url:"/prompts", fields:["name","template","description"]},
              edit: id => `/prompts/${id}`, del: id => `/prompts/${id}`},
-  agents:   {url: "/a2a?include_inactive=true", cols: ["name","agent_type","endpoint_url","enabled","reachable"], boolcols: ["enabled","reachable"],
-             create: {url:"/a2a", fields:["name","agent_type","endpoint_url"]}},
-  plugins:  {url: "/plugins", cols: ["name","kind","mode","priority"]},
-  users:    {url: "/admin/users", cols: ["email","full_name","is_admin","is_active","auth_provider","last_login"], toggle: id => `/admin/users/${encodeURIComponent(id)}/toggle`, idcol: "email", boolcols: ["is_admin","is_active"],
+  agents:   {paged:true, url: "/a2a?include_inactive=true", cols: ["name","agent_type","endpoint_url","enabled","reachable"], boolcols: ["enabled","reachable"],
+             create: {url:"/a2a", fields:["name","agent_type","endpoint_url"]},
+             del: id => `/a2a/${id}`},
+  plugins:  {url: "/plugins", cols: ["name","kind","mode","priority"], special: "plugins"},
+  bindings: {url: "/plugins/bindings", cols: ["plugin_name","scope_type","scope_id","mode","enabled"], boolcols: ["enabled"],
+             create: {url:"/plugins/bindings", fields:["plugin_name","scope_type","scope_id","mode","config:json"]},
+             del: id => `/plugins/bindings/${id}`},
+  users:    {paged:true, url: "/admin/users", cols: ["email","full_name","is_admin","is_active","auth_provider","last_login"], toggle: id => `/admin/users/${encodeURIComponent(id)}/toggle`, idcol: "email", boolcols: ["is_admin","is_active"],
              create: {url:"/admin/users", fields:["email","password","full_name"]}},
   teams:    {url: "/teams", cols: ["name","slug","visibility","is_personal","created_by"], boolcols: ["is_personal"],
-             create: {url:"/teams", fields:["name","visibility"]}},
+             create: {url:"/teams", fields:["name","visibility"]},
+             del: id => `/teams/${id}`, detail: id => `/teams/${id}`, special: "teams"},
   tokens:   {url: "/auth/tokens", cols: ["name","server_id","expires_at","last_used","revoked_at"],
+             create: {url:"/auth/tokens", fields:["name","expires_minutes:int","permissions:csv","server_id"], reveal: "token"},
              del: id => `/auth/tokens/${id}`},
+  providers:{url: "/llm/providers", cols: ["name","provider_type","api_base","enabled"], boolcols: ["enabled"],
+             create: {url:"/llm/providers", fields:["name","provider_type","api_base","api_key"]},
+             del: id => `/llm/providers/${id}`},
   models:   {url: "/v1/models", cols: ["id","owned_by"], path: "data"},
+  llmmodels:{url: "/llm/models", cols: ["model_alias","provider_id","enabled"], boolcols: ["enabled"]},
+  ingress:  {url: "/admin/ingress", special: "ingress"},
+  dashboard:{special: "dashboard"},
   metrics:  {url: "/metrics", cols: ["name","calls","errors","avg_ms","min_ms","max_ms"], path: "tools"},
   rollups:  {url: "/metrics/rollups", cols: ["entity_type","entity_id","hour","calls","errors","avg_ms"]},
   traces:   {url: "/admin/traces?limit=100", cols: ["name","duration_ms","status","trace_id"], tracecol: "trace_id"},
   logs:     {url: "/admin/logs?limit=200", cols: ["ts","level","logger","message"]},
   audit:    {url: "/admin/audit?limit=100", cols: ["ts","actor","action","details"]},
+  exportimport: {special: "exportimport"},
   engine:   {url: "/admin/engine/stats", special: "engine"},
 };
-let current = "tools", rows = [], shown = [], timer = null;
+let current = "tools", rows = [], shown = [], timer = null, cursor = null;
 function esc(s){
   return String(s).replace(/[&<>"']/g, c => ({"&":"&amp;","<":"&lt;",">":"&gt;",
     '"':"&quot;","'":"&#39;"}[c]));
@@ -127,75 +165,258 @@ function renderEngine(stats){
   const extra = rest.map(k =>
     `<div class="card"><b>${cell(stats[k])}</b><span>${k}</span></div>`).join("");
   document.getElementById("view").innerHTML =
-    `<div class="cards">${cards}${extra}</div>`;
+    `<div class="cards">${cards}${extra}</div>
+     <br><button class="act" onclick="engineProfile()">capture jax profile</button>`;
   document.getElementById("status").textContent = "engine stats";
+}
+async function engineProfile(){
+  const r = await fetch("/admin/engine/profile", {method:"POST",
+    headers:{"content-type":"application/json"}, body:"{}"});
+  document.getElementById("status").textContent =
+    r.ok ? "profile captured" : "profile failed: " + r.status;
+}
+async function renderDashboard(){
+  // totals from /metrics + hourly bars from /metrics/rollups (last 24h)
+  const v = document.getElementById("view");
+  const [mr, rr] = await Promise.all([fetch("/metrics"), fetch("/metrics/rollups?hours=24")]);
+  if (!mr.ok || !rr.ok){ v.textContent = "dashboard fetch failed"; return; }
+  const metrics = await mr.json(), roll = await rr.json();
+  const tools = metrics.tools || [];
+  const calls = tools.reduce((a,t)=>a+(t.calls||0),0);
+  const errors = tools.reduce((a,t)=>a+(t.errors||0),0);
+  const avg = tools.length ? tools.reduce((a,t)=>a+(t.avg_ms||0),0)/tools.length : 0;
+  const byHour = {};
+  for (const r of roll) {
+    const h = r.hour;
+    byHour[h] = byHour[h] || {calls:0, errors:0};
+    byHour[h].calls += r.calls ?? r.count ?? 0;
+    byHour[h].errors += r.errors || 0;
+  }
+  const hours = Object.keys(byHour).map(Number).sort((a,b)=>a-b);
+  const peak = Math.max(1, ...hours.map(h=>byHour[h].calls));
+  const chart = hours.map(h=>{
+    const b = byHour[h];
+    const hv = Math.round((b.calls/peak)*100);
+    const he = Math.round((b.errors/peak)*100);
+    const label = new Date(h*3600*1000).getUTCHours();
+    return `<div class="col" title="${b.calls} calls / ${b.errors} errors">`
+      + `<div class="e" style="height:${he}%"></div>`
+      + `<div class="v" style="height:${Math.max(hv-he,0)}%"></div>`
+      + `<div class="t">${label}</div></div>`;
+  }).join("");
+  v.innerHTML = `<div class="cards">
+    <div class="card"><b>${calls}</b><span>tool calls</span></div>
+    <div class="card"><b>${errors}</b><span>errors</span></div>
+    <div class="card"><b>${Math.round(avg*100)/100}</b><span>avg ms</span></div>
+    <div class="card"><b>${tools.length}</b><span>active tools</span></div>
+   </div>
+   <div class="chart">${chart || '<span style="color:#889">no rollup data — POST /metrics/rollup to aggregate</span>'}</div>
+   <br><button class="act" onclick="runRollup()">run rollup now</button>
+   <button class="act" onclick="pruneMetrics()">prune raw metrics</button>
+   <button class="act danger" onclick="resetMetrics()">reset ALL metrics (/metrics/reset)</button>`;
+  document.getElementById("status").textContent = "dashboard";
+}
+async function runRollup(){
+  const r = await fetch("/metrics/rollup", {method:"POST"});
+  document.getElementById("status").textContent = r.ok ? "rolled up" : "rollup failed";
+  renderDashboard();
+}
+async function resetMetrics(){
+  if (!confirm("drop ALL raw metrics and rollups?")) return;
+  const r = await fetch("/metrics/reset", {method:"POST"});
+  document.getElementById("status").textContent = r.ok ? "metrics reset" : "reset failed";
+  renderDashboard();
+}
+async function pruneMetrics(){
+  const r = await fetch("/metrics/prune", {method:"POST"});
+  document.getElementById("status").textContent = r.ok ?
+    "pruned " + (await r.json()).pruned + " rows" : "prune failed";
+}
+function renderExportImport(){
+  document.getElementById("view").innerHTML = `
+   <div style="background:#fff;padding:14px;box-shadow:0 1px 3px rgba(0,0,0,.08)">
+    <b>export</b><br>
+    <label style="font-size:12px"><input type="checkbox" id="exp-secrets"> include secrets (sealed)</label>
+    <button class="act" onclick="doExport()">download bundle (/export)</button>
+    <hr>
+    <b>import</b> (paste a bundle)<br>
+    <textarea id="import-area" placeholder='{"version":1,"entities":{...}}'></textarea><br>
+    <label style="font-size:12px"><input type="checkbox" id="imp-overwrite"> overwrite existing</label>
+    <button class="act" onclick="doImport()">import (/import)</button>
+    <pre id="imp-result" class="kv"></pre>
+   </div>`;
+  document.getElementById("status").textContent = "export / import";
+}
+async function doExport(){
+  const secrets = document.getElementById("exp-secrets").checked;
+  const r = await fetch("/export" + (secrets ? "?include_secrets=true" : ""));
+  if (!r.ok){ document.getElementById("status").textContent = "export failed: " + r.status; return; }
+  const blob = new Blob([JSON.stringify(await r.json(), null, 1)], {type:"application/json"});
+  const a = document.createElement("a");
+  a.href = URL.createObjectURL(blob); a.download = "mcpforge-export.json"; a.click();
+  URL.revokeObjectURL(a.href);
+}
+async function doImport(){
+  let bundle;
+  try { bundle = JSON.parse(document.getElementById("import-area").value); }
+  catch(e){ document.getElementById("status").textContent = "bad JSON: " + esc(String(e)); return; }
+  const overwrite = document.getElementById("imp-overwrite").checked;
+  const r = await fetch("/import", {method:"POST",
+    headers:{"content-type":"application/json"},
+    body: JSON.stringify({bundle, overwrite})});
+  const out = await r.text();
+  document.getElementById("imp-result").textContent = out.slice(0, 2000);
+  document.getElementById("status").textContent = r.ok ? "imported" : "import failed: " + r.status;
 }
 function render(){
   const t = TABS[current];
-  if (t.special === "engine") return;  // rendered at fetch time
+  if (t.special === "engine" || t.special === "dashboard"
+      || t.special === "exportimport") return;  // rendered at fetch time
   const q = document.getElementById("q").value.toLowerCase();
   // `shown` is the single source of truth for row indices: click handlers
   // index into it, so a filter edit between render and click cannot
   // misresolve, and attacker data never lands inside a JS string
   shown = rows.filter(d => !q || JSON.stringify(d).toLowerCase().includes(q));
   document.getElementById("status").textContent = shown.length + " rows";
-  const hasActs = t.toggle || t.edit || t.del;
+  const hasActs = t.toggle || t.edit || t.del || t.detail || t.rowacts
+    || t.special === "plugins";
   const head = "<tr>" + t.cols.map(c=>`<th>${c}</th>`).join("")
     + (hasActs ? "<th></th>" : "") + "</tr>";
   const bools = new Set(t.boolcols || []);
   const body = shown.map((d,i)=>{
     const cells = t.cols.map(c=>{
       if (t.tracecol === c) return `<td><a class="trace" onclick="trace(${i})">${cell(d[c])}</a></td>`;
+      if (t.special === "plugins" && c === "mode")
+        return `<td><select class="mode" onchange="setMode(${i}, this.value)">`
+          + ["enforce","enforce_ignore_error","permissive","audit","disabled"].map(m =>
+            `<option${m===d.mode?" selected":""}>${m}</option>`).join("") + "</select></td>";
       return `<td>${cell(d[c], bools.has(c))}</td>`;
     }).join("");
     let act = "";
+    if (t.detail) act += `<button class="act" onclick="detailRow(${i})">view</button> `;
     if (t.toggle) act += `<button class="act" onclick="toggleRow(${i})">toggle</button> `;
     if (t.edit)   act += `<button class="act" onclick="editRow(${i})">edit</button> `;
+    for (const [j, ra] of (t.rowacts || []).entries())
+      act += `<button class="act" onclick="rowAct(${i},${j})">${ra.label}</button> `;
     if (t.del)    act += `<button class="act danger" onclick="delRow(${i})">delete</button>`;
     return "<tr>"+cells+(hasActs?`<td>${act}</td>`:"")+"</tr>";
   }).join("");
   document.getElementById("view").innerHTML = `<table>${head}${body}</table>`;
 }
-async function show(name){
+async function show(name, keepCursor){
   current = name;
+  if (!keepCursor) cursor = null;
   document.getElementById("detail").style.display = "none";
   document.getElementById("form").style.display = "none";
   document.getElementById("newbtn").style.display = TABS[name].create ? "" : "none";
+  document.getElementById("morebtn").style.display = "none";
   document.querySelectorAll("nav button").forEach(b=>b.classList.toggle("active", b.textContent===name));
   const t = TABS[name];
   const s = document.getElementById("status");
   s.textContent = "loading…";
+  if (t.special === "dashboard") return renderDashboard();
+  if (t.special === "exportimport") return renderExportImport();
   try {
-    const r = await fetch(t.url, {headers: {accept: "application/json"}});
+    let url = t.url;
+    if (t.paged) {
+      url += (url.includes("?") ? "&" : "?") + "limit=100";
+      if (cursor) url += "&cursor=" + encodeURIComponent(cursor);
+    }
+    const r = await fetch(url, {headers: {accept: "application/json"}});
     if (!r.ok) { s.textContent = r.status + " " + esc(await r.text()); return; }
     let data = await r.json();
     if (t.special === "engine") return renderEngine(data);
+    if (t.special === "ingress") return renderIngress(data);
     if (t.path) data = data[t.path] || [];
+    if (data && !Array.isArray(data) && Array.isArray(data.items)){
+      cursor = data.next_cursor;   // cursor-paged shape (pagination.py)
+      document.getElementById("morebtn").style.display = cursor ? "" : "none";
+      data = data.items;
+    }
     rows = Array.isArray(data) ? data : [];
     render();
   } catch(e){ s.textContent = "error: " + esc(String(e)); }
 }
+function nextPage(){ if (cursor) show(current, true); }
 function openForm(){
   const t = TABS[current];
   if (!t.create) return;
   const f = document.getElementById("form");
   f.style.display = "block";
   f.innerHTML = `<b>new ${esc(current)}</b><br>` + t.create.fields.map(x =>
-    `<input id="f-${x}" placeholder="${x}">`).join("")
+    `<input id="f-${x.split(":")[0]}" placeholder="${x}">`).join("")
     + `<button class="act" onclick="submitForm()">create</button>`;
 }
 async function submitForm(){
   const t = TABS[current];
   const body = {};
-  for (const x of t.create.fields){
+  for (const spec of t.create.fields){
+    const [x, kind] = spec.split(":");
     const v = document.getElementById("f-"+x).value;
-    if (v) body[x] = v;
+    if (!v) continue;
+    if (kind === "int") body[x] = parseInt(v, 10);
+    else if (kind === "csv") body[x] = v.split(",").map(s=>s.trim()).filter(Boolean);
+    else if (kind === "json") { try { body[x] = JSON.parse(v); } catch(e) { body[x] = v; } }
+    else body[x] = v;
   }
   const r = await fetch(t.create.url, {method:"POST",
     headers:{"content-type":"application/json"}, body: JSON.stringify(body)});
   document.getElementById("status").textContent = r.ok ? "created" :
     `create failed: ${r.status} ` + esc(await r.text());
-  if (r.ok) show(current);
+  if (r.ok && t.create.reveal){
+    // mint-once secrets (API tokens): shown a single time, never stored
+    const out = await r.json();
+    const d = document.getElementById("detail");
+    d.style.display = "block";
+    d.innerHTML = `<b>copy it now — it is not retrievable later</b>
+      <div class="reveal">${esc(String(out[t.create.reveal] || ""))}</div>`;
+  }
+  if (r.ok) show(current, true);
+}
+async function setMode(i, mode){
+  const row = shown[i];
+  if (!row) return;
+  const r = await fetch(`/plugins/${encodeURIComponent(row.name)}/mode`, {
+    method:"POST", headers:{"content-type":"application/json"},
+    body: JSON.stringify({mode})});
+  document.getElementById("status").textContent = r.ok
+    ? `mode of ${row.name} → ${mode}` : "mode change failed: " + r.status;
+  if (!r.ok) show(current);
+}
+async function rowAct(i, j){
+  const t = TABS[current], row = shown[i];
+  if (!row) return;
+  const ra = t.rowacts[j];
+  const r = await fetch(ra.url(row[ra.key || t.idcol || "id"]), {method: ra.method});
+  document.getElementById("status").textContent =
+    `${ra.label}: ` + (r.ok ? "ok" : "failed " + r.status);
+  if (ra.show && r.ok){
+    const d = document.getElementById("detail");
+    d.style.display = "block";
+    d.innerHTML = `<b>${esc(ra.label)}</b><pre class="kv">`
+      + esc(JSON.stringify(await r.json(), null, 1).slice(0, 4000)) + `</pre>`;
+    return;
+  }
+  show(current);
+}
+async function renderIngress(data){
+  const opts = (data.available || []).map(m =>
+    `<option${m===data.mode?" selected":""}>${esc(m)}</option>`).join("");
+  document.getElementById("view").innerHTML = `
+   <div class="cards">
+    <div class="card"><b>${esc(String(data.mode))}</b><span>active ingress</span></div>
+    <div class="card"><b>${cell(data.version)}</b><span>version</span></div>
+   </div><br>
+   <select id="ingress-mode">${opts}</select>
+   <button class="act" onclick="setIngress()">switch mode (POST /admin/ingress)</button>`;
+  document.getElementById("status").textContent = "ingress mount";
+}
+async function setIngress(){
+  const mode = document.getElementById("ingress-mode").value;
+  const r = await fetch("/admin/ingress", {method:"POST",
+    headers:{"content-type":"application/json"}, body: JSON.stringify({mode})});
+  document.getElementById("status").textContent = r.ok ? "switched" : "switch failed: " + r.status;
+  show(current);
 }
 async function toggleRow(i){
   const t = TABS[current];
@@ -205,6 +426,58 @@ async function toggleRow(i){
   const r = await fetch(t.toggle(id), {method: "POST"});
   if (!r.ok) document.getElementById("status").textContent = "toggle failed: " + r.status;
   show(current);
+}
+async function detailRow(i){
+  const t = TABS[current];
+  const row = shown[i];
+  if (!row) return;
+  const id = row[t.idcol || "id"];
+  const r = await fetch(t.detail(id));
+  const d = document.getElementById("detail");
+  d.style.display = "block";
+  if (!r.ok){ d.textContent = "detail fetch failed: " + r.status; return; }
+  const full = await r.json();
+  const kv = Object.entries(full).map(([k,v]) =>
+    `<tr><td><b>${esc(k)}</b></td><td>${cell(v)}</td></tr>`).join("");
+  let extra = "";
+  if (t.special === "teams"){
+    const members = (full.members || []).map(m =>
+      `<tr><td>${esc(m.user_email||"")}</td><td>${esc(m.role||"")}</td>
+       <td><button class="act danger" onclick="removeMember('${esc(String(id))}','${esc(String(m.user_email||""))}')">remove</button></td></tr>`).join("");
+    extra = `<br><b>members</b><table class="kv">${members}</table>
+      <input id="m-email" placeholder="email"><input id="m-role" placeholder="role (member)">
+      <button class="act" onclick="addMember('${esc(String(id))}')">add member (/teams/{id}/members)</button>
+      <button class="act" onclick="inviteMember('${esc(String(id))}')">invite (/teams/{id}/invitations)</button>
+      <span id="invite-out" class="kv"></span>`;
+  }
+  d.innerHTML = `<b>${esc(current)} ${esc(String(id))}</b>
+    <table class="kv">${kv}</table>${extra}`;
+}
+async function addMember(teamId){
+  const email = document.getElementById("m-email").value;
+  const role = document.getElementById("m-role").value || "member";
+  const r = await fetch(`/teams/${encodeURIComponent(teamId)}/members`, {
+    method:"POST", headers:{"content-type":"application/json"},
+    body: JSON.stringify({email, role})});
+  document.getElementById("status").textContent = r.ok ? "member added" :
+    "add failed: " + r.status + " " + esc(await r.text());
+}
+async function inviteMember(teamId){
+  const email = document.getElementById("m-email").value;
+  const r = await fetch(`/teams/${encodeURIComponent(teamId)}/invitations`, {
+    method:"POST", headers:{"content-type":"application/json"},
+    body: JSON.stringify({email})});
+  if (r.ok){
+    const out = await r.json();
+    document.getElementById("invite-out").textContent =
+      "invitation token: " + (out.token || "");
+  } else document.getElementById("status").textContent = "invite failed: " + r.status;
+}
+async function removeMember(teamId, email){
+  const r = await fetch(`/teams/${encodeURIComponent(teamId)}/members/${encodeURIComponent(email)}`,
+    {method:"DELETE"});
+  document.getElementById("status").textContent = r.ok ? "member removed" :
+    "remove failed: " + r.status;
 }
 let editTarget = null;  // id captured at OPEN time: a filter edit must not
                         // re-point the save at a different row
@@ -306,3 +579,8 @@ def setup_admin_ui(app: web.Application) -> None:
 
     app.router.add_get("/admin", admin_page)
     app.router.add_get("/admin/", admin_page)
+
+
+def admin_page_source() -> str:
+    """The page source, for the UI contract test tier."""
+    return _PAGE

@@ -26,7 +26,7 @@ def _parse_tabs() -> dict[str, dict]:
     # anchored to line starts so nested create:{url:...} sub-objects of an
     # entry never parse as phantom tabs
     for line_match in re.finditer(
-            r"^  (\w+):\s*\{url:\s*\"([^\"]+)\"", block, re.MULTILINE):
+            r"^  (\w+):\s*\{(?:paged:true,\s*)?url:\s*\"([^\"]+)\"", block, re.MULTILINE):
         name, url = line_match.group(1), line_match.group(2)
         entry: dict = {"url": url}
         line_end = block.find("\n", line_match.end())
@@ -35,8 +35,9 @@ def _parse_tabs() -> dict[str, dict]:
         path = re.search(r"path:\s*\"(\w+)\"", rest)
         if path:
             entry["path"] = path.group(1)
-        if "special" in rest:
-            entry["special"] = True
+        special = re.search(r"special:\s*\"(\w+)\"", rest)
+        if special:
+            entry["special"] = special.group(1)
         tabs[name] = entry
     return tabs
 
@@ -67,8 +68,14 @@ async def test_every_tab_endpoint_answers_with_consumable_shape():
             assert resp.status == 200, (name, spec["url"], resp.status,
                                         await resp.text())
             data = await resp.json()
-            if spec.get("special"):          # engine stats object
+            if spec.get("special") == "engine":   # engine stats object
                 assert "decode_steps" in data, (name, data)
+            elif spec.get("special") == "ingress":
+                assert "mode" in data and "available" in data, (name, data)
+            elif spec.get("special") == "teams":
+                assert isinstance(data, list), (name, type(data))
+            elif spec.get("special") == "plugins":
+                assert isinstance(data, list), (name, type(data))
             elif "path" in spec:
                 assert isinstance(data.get(spec["path"]), list), (name, data)
             else:
