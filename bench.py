@@ -124,6 +124,10 @@ async def _make_gateway(engine: bool, platform: str):
         "MCPFORGE_TPU_LOCAL_PAGE_SIZE": "16",
         "MCPFORGE_TPU_LOCAL_NUM_PAGES": "4096",
         "MCPFORGE_TPU_LOCAL_PREFILL_BUCKETS": "64,128,256",
+        # classifier coalescing width: at 1k-concurrency depth the encoder
+        # queue is always saturated, so wider forwards amortize dispatch
+        "MCPFORGE_TPU_LOCAL_ENCODER_MAX_BATCH": os.environ.get(
+            "BENCH_ENCODER_MAX_BATCH", "64"),
         "MCPFORGE_TPU_LOCAL_DTYPE": ("bfloat16" if platform == "tpu"
                                      else "float32"),
         # multi-step decode dispatch amortizes the host<->device sync —
