@@ -1,0 +1,54 @@
+"""SSL context construction + caching.
+
+Reference: `utils/ssl_context_cache` — building an ``ssl.SSLContext``
+loads and parses the CA bundle from disk (~10 ms and a syscall burst),
+so contexts are built once per distinct (ca_bundle, cert, key, verify)
+tuple and reused for every outbound connection.
+"""
+
+from __future__ import annotations
+
+import ssl
+from functools import lru_cache
+
+
+@lru_cache(maxsize=64)
+def _cached_context(ca_bundle: str, cert_file: str, key_file: str,
+                    verify: bool) -> ssl.SSLContext:
+    if not verify:
+        context = ssl.create_default_context()
+        context.check_hostname = False
+        context.verify_mode = ssl.CERT_NONE
+        return context
+    context = ssl.create_default_context(
+        cafile=ca_bundle or None)
+    if cert_file:
+        context.load_cert_chain(cert_file, key_file or None)
+    return context
+
+
+def outbound_ssl(settings) -> ssl.SSLContext | bool | None:
+    """ssl= argument for outbound client connections.
+
+    Returns False (verification off) when skip_ssl_verify, a cached
+    custom context when a CA bundle is pinned, else None (library
+    default context — aiohttp/httpx cache that themselves)."""
+    if settings.skip_ssl_verify:
+        return False
+    if settings.ssl_ca_bundle:
+        return _cached_context(settings.ssl_ca_bundle, "", "", True)
+    return None
+
+
+def serving_ssl(settings) -> ssl.SSLContext | None:
+    """Server-side TLS context (ssl_enabled + cert/key), else None."""
+    if not settings.ssl_enabled:
+        return None
+    context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    context.load_cert_chain(settings.ssl_cert_file,
+                            settings.ssl_key_file or None)
+    return context
+
+
+def context_cache_info():
+    return _cached_context.cache_info()
