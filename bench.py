@@ -132,7 +132,8 @@ async def _make_gateway(engine: bool, platform: str):
                                      else "float32"),
         # multi-step decode dispatch amortizes the host<->device sync —
         # the win is on TPU (CPU is compute-bound, sync is cheap there)
-        "MCPFORGE_TPU_LOCAL_DECODE_BLOCK": "4" if platform == "tpu" else "1",
+        "MCPFORGE_TPU_LOCAL_DECODE_BLOCK": os.environ.get(
+            "BENCH_DECODE_BLOCK", "4" if platform == "tpu" else "1"),
         "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
         "MCPFORGE_OTEL_EXPORTER": "none",
         "MCPFORGE_LOG_LEVEL": "WARNING",

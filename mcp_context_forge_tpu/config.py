@@ -241,6 +241,9 @@ class Settings(BaseModel):
     # encoder microbatch coalescing (embed/classify traffic)
     tpu_local_encoder_max_batch: int = 32
     tpu_local_encoder_max_wait_ms: float = 2.0
+    # smallest encoder seq bucket: moderation texts are ~20 tokens, and
+    # padding every row to 64 doubles the classify forward for nothing
+    tpu_local_encoder_min_seq: int = 32
     # engine admission queue bound (backpressure past this)
     tpu_local_max_queue: int = 1024
     # device-fault recovery: crashed dispatch thread rebuilds KV, re-queues

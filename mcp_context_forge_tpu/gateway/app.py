@@ -179,7 +179,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
             embedding_model=settings.tpu_local_embedding_model,
             tracer=tracer, metrics=metrics,
             encoder_max_batch=settings.tpu_local_encoder_max_batch,
-            encoder_max_wait_ms=settings.tpu_local_encoder_max_wait_ms)
+            encoder_max_wait_ms=settings.tpu_local_encoder_max_wait_ms,
+            encoder_min_seq=settings.tpu_local_encoder_min_seq)
         provider.classify_window = settings.tpu_local_classify_window
         provider.classify_coverage = settings.tpu_local_classify_coverage
         provider.classify_max_windows = settings.tpu_local_classify_max_windows

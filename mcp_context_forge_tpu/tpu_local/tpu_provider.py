@@ -108,7 +108,8 @@ class TPULocalProvider(LLMProvider):
                  embedding_model: str = "encoder-tiny",
                  tracer=None, metrics=None,
                  encoder_max_batch: int = 32,
-                 encoder_max_wait_ms: float = 2.0):
+                 encoder_max_wait_ms: float = 2.0,
+                 encoder_min_seq: int = 32):
         self.name = name
         self.engine = engine
         self.tracer = tracer
@@ -122,6 +123,7 @@ class TPULocalProvider(LLMProvider):
         self._encode = jax.jit(
             lambda params, tokens, mask: encoder_forward(
                 params, self.encoder_config, tokens, mask))
+        self.encoder_min_seq = max(8, encoder_min_seq)
         self._batcher = _EncoderBatcher(self._encode_batch,
                                         max_batch=encoder_max_batch,
                                         max_wait_ms=encoder_max_wait_ms)
@@ -278,10 +280,12 @@ class TPULocalProvider(LLMProvider):
     # ------------------------------------------------------------ embeddings
 
     def _seq_bucket(self, longest: int) -> int:
-        """Smallest power-of-two seq bucket (floored at 64) covering
-        ``longest``: bounded compile count, and short plugin texts don't
-        pay full max_seq_len attention (seq^2) cost."""
-        seq = 64
+        """Smallest power-of-two seq bucket (floored at ``encoder_min_seq``)
+        covering ``longest``: bounded compile count, and short plugin texts
+        don't pay full max_seq_len attention (seq^2) cost. Moderation
+        texts are typically ~20 tokens, so the floor matters: 32 halves
+        the classify forward vs the old fixed 64 floor."""
+        seq = self.encoder_min_seq
         while seq < longest and seq < self.encoder_config.max_seq_len:
             seq *= 2
         return min(seq, self.encoder_config.max_seq_len)
@@ -379,7 +383,7 @@ class TPULocalProvider(LLMProvider):
         freeze every queued plugin hook for ~seconds)."""
         batch = 1
         while batch <= self._batcher.max_batch:
-            seq = 64
+            seq = self.encoder_min_seq
             while True:
                 rows = [[1] * seq] * batch
                 await asyncio.to_thread(self._encode_batch, rows)
