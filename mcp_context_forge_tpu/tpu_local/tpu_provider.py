@@ -150,11 +150,15 @@ class TPULocalProvider(LLMProvider):
         prompt_ids = self.engine.tokenizer.encode(prompt)
         max_ctx = self.engine.config.max_seq_len
         # prompts longer than every bucket prefill in chunks through the
-        # engine's history path; only the block-table bound truncates (the
-        # engine needs room for at least one generated token)
-        prompt_ids = prompt_ids[-(max_ctx - 1):]
-        max_tokens = min(int(request.get("max_tokens") or 128),
-                         max_ctx - len(prompt_ids))
+        # engine's history path; the block-table bound truncates, and the
+        # truncation RESERVES room for the requested completion (capped at
+        # a quarter of the context) — without the reserve, a near-full-
+        # context prompt (summarizer over a long tool output) silently
+        # clamps max_tokens to 1 and "summarizes" into a single token
+        requested = int(request.get("max_tokens") or 128)
+        reserve = max(1, min(requested, max_ctx // 4))
+        prompt_ids = prompt_ids[-(max_ctx - reserve):]
+        max_tokens = min(requested, max_ctx - len(prompt_ids))
         return GenRequest(
             request_id=new_id(),
             prompt_ids=prompt_ids,

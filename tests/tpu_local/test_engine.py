@@ -330,3 +330,50 @@ def test_warmup_precompiles_without_corrupting_state():
         assert await gen(warm) == await gen(cold)
 
     asyncio.run(run())
+
+
+def test_prepare_reserves_completion_room():
+    """A near-full-context prompt must not clamp max_tokens to 1: the
+    truncation reserves up to a quarter of the context for generation
+    (the summarizer-over-long-tool-output shape)."""
+    from mcp_context_forge_tpu.tpu_local.engine import EngineConfig, TPUEngine
+    from mcp_context_forge_tpu.tpu_local.tpu_provider import TPULocalProvider
+
+    config = EngineConfig(model="llama3-test", max_batch=2, max_seq_len=128,
+                          page_size=16, num_pages=32, prefill_buckets=(32,),
+                          dtype="float32", attn_impl="reference")
+    provider = TPULocalProvider("tpu_local", TPUEngine(config))
+    gen = provider._prepare({
+        "messages": [{"role": "user", "content": "x" * 4000}],
+        "max_tokens": 32})
+    assert gen.max_tokens == 32
+    assert len(gen.prompt_ids) == 128 - 32
+    # small prompts are untouched and keep their full budget
+    gen = provider._prepare({
+        "messages": [{"role": "user", "content": "hi"}], "max_tokens": 16})
+    assert gen.max_tokens == 16
+    assert len(gen.prompt_ids) < 64
+    # a request asking for more than the whole context still fits
+    gen = provider._prepare({
+        "messages": [{"role": "user", "content": "x" * 4000}],
+        "max_tokens": 9999})
+    assert len(gen.prompt_ids) + gen.max_tokens <= 128
+    assert gen.max_tokens == 32  # reserve cap = ctx // 4
+
+
+def test_compile_cache_scoped_by_host_fingerprint(monkeypatch):
+    """The persistent XLA cache must be per-host-CPU-features: this
+    container migrates between hosts, and loading an AOT entry compiled
+    under different features SIGSEGVs mid-request (observed: +amx hosts
+    vs hosts without)."""
+    from mcp_context_forge_tpu.tpu_local import engine as eng
+
+    fp = eng._host_fingerprint()
+    assert fp and len(fp) == 12
+    assert fp == eng._host_fingerprint()  # stable within a host
+    monkeypatch.setattr(eng, "_compile_cache_dir", None)
+    recorded = {}
+    monkeypatch.setattr(eng.jax.config, "update",
+                        lambda key, value: recorded.setdefault(key, value))
+    eng._apply_compile_cache("/tmp/cache-root")
+    assert recorded["jax_compilation_cache_dir"] == f"/tmp/cache-root/{fp}"
