@@ -23,6 +23,7 @@ mirroring the reference's session-affinity routing (SURVEY.md §7.1 phase 4).
 
 from __future__ import annotations
 
+import os
 import asyncio
 import logging
 import queue
@@ -199,6 +200,34 @@ class EngineInitTimeout(RuntimeError):
 _compile_cache_dir: str | None = None
 
 
+def _host_fingerprint() -> str:
+    """Hash of the host's CPU feature flags + arch.
+
+    The persistent cache stores AOT executables specialized to the
+    COMPILING host's CPU features; this container migrates between hosts
+    with different feature sets (observed: +amx/+prefer-no-gather hosts
+    vs hosts without), and XLA loading a mismatched AOT entry SIGSEGVs
+    mid-request (cpu_aot_loader 'machine type ... doesn't match'
+    warnings, then a crash in the decode path). Scoping the cache dir by
+    fingerprint makes a migrated container start a fresh cache instead
+    of loading poison. TPU executables don't depend on host CPU flags,
+    but re-warming a per-host subdir is cheap relative to a SIGSEGV."""
+    import hashlib
+    import platform
+
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("flags"):
+                    flags = line
+                    break
+    except OSError:
+        pass
+    raw = f"{platform.machine()}:{flags}"
+    return hashlib.sha256(raw.encode()).hexdigest()[:12]
+
+
 def _apply_compile_cache(path: str) -> None:
     """Set the process-global persistent XLA cache exactly once.
 
@@ -208,6 +237,7 @@ def _apply_compile_cache(path: str) -> None:
     (round-2 ADVICE low). First caller wins; a conflicting later value is
     logged and ignored."""
     global _compile_cache_dir
+    path = os.path.join(path, _host_fingerprint())
     if _compile_cache_dir is None:
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -1282,7 +1312,25 @@ class TPUEngine:
             # must cover the active ceiling); shrink only after the smaller
             # width has sufficed for a sustained streak (load genuinely
             # dropped, not an inter-wave dip).
-            desired = self._batch_bucket_for(max(self._running) + 1)
+            # anticipatory growth: size by active + ADMISSIBLE queued load,
+            # not the instantaneous ceiling — a 128-request burst must cost
+            # ONE re-home (8->64), not one per pow-2 rung (each width
+            # change copies the donated KV pool inside the next dispatch;
+            # four rungs of that dominated short-decode chat bursts in the
+            # config-4 A/B: 2251 ms vs 1465 ms of device time). Queued
+            # requests that CANNOT be admitted (no free slots, or the page
+            # pool is the binding constraint) must not pin the width high:
+            # a page-bound backlog would otherwise run full-width decode
+            # over a handful of active slots for its whole duration.
+            incoming = self._work.qsize() + len(self._pending)
+            free_slots = (config.max_batch - len(self._running)
+                          - len(self._chunking))
+            page_capacity = (self.allocator.free_pages
+                             // self.allocator.avg_slot_pages())
+            admissible = max(0, min(incoming, free_slots, page_capacity))
+            ceiling = max(max(self._running) + 1,
+                          len(self._running) + admissible)
+            desired = self._batch_bucket_for(min(ceiling, config.max_batch))
             if desired >= self._batch_width:
                 self._batch_width = desired
                 self._shrink_streak = 0

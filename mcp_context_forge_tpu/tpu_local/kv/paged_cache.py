@@ -158,6 +158,15 @@ class PageAllocator:
     def free_pages(self) -> int:
         return len(self._free) + len(self._lru)
 
+    def avg_slot_pages(self) -> int:
+        """Average page footprint of currently active slots (the typical
+        admission cost); max_pages_per_slot when nothing is active —
+        conservative for capacity estimates."""
+        if not self._slots:
+            return self.max_pages_per_slot
+        total = sum(len(pages) for pages in self._slots.values())
+        return max(1, total // len(self._slots))
+
     @property
     def pages_in_use(self) -> int:
         return (self.num_pages - 1) - self.free_pages
