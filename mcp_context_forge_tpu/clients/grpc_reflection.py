@@ -92,8 +92,18 @@ _ReqClass, _RespClass = _build_reflection_messages()
 class GrpcReflectionClient:
     """Discover + dynamically invoke methods on a reflective gRPC server."""
 
-    def __init__(self, target: str):
+    def __init__(self, target: str, tls: bool = False,
+                 ca_pem: str | None = None, cert_pem: str | None = None,
+                 key_pem: str | None = None, authority: str | None = None):
+        """``tls`` selects a secure channel; ``ca_pem`` pins a root,
+        ``cert_pem``/``key_pem`` add mutual TLS, ``authority`` overrides
+        :authority (reference translate_grpc TLS options)."""
         self.target = target
+        self.tls = tls
+        self.ca_pem = ca_pem
+        self.cert_pem = cert_pem
+        self.key_pem = key_pem
+        self.authority = authority
         self._pool = descriptor_pool.DescriptorPool()
         self._known_files: set[str] = set()
         self._channel: Any = None
@@ -102,7 +112,22 @@ class GrpcReflectionClient:
         # one persistent channel per target: reflection + every invocation
         # reuse the HTTP/2 connection instead of handshaking per call
         if self._channel is None:
-            self._channel = grpc.aio.insecure_channel(self.target)
+            options = []
+            if self.authority:
+                options.append(("grpc.default_authority", self.authority))
+            if self.tls:
+                credentials = grpc.ssl_channel_credentials(
+                    root_certificates=self.ca_pem.encode()
+                    if self.ca_pem else None,
+                    private_key=self.key_pem.encode()
+                    if self.key_pem else None,
+                    certificate_chain=self.cert_pem.encode()
+                    if self.cert_pem else None)
+                self._channel = grpc.aio.secure_channel(
+                    self.target, credentials, options=options)
+            else:
+                self._channel = grpc.aio.insecure_channel(self.target,
+                                                          options=options)
         return self._channel
 
     async def close(self) -> None:
@@ -164,41 +189,114 @@ class GrpcReflectionClient:
                 break  # genuine duplicates/conflicts: pool keeps first copy
 
     async def describe_service(self, service: str) -> list[dict[str, Any]]:
-        """-> [{name, full_method, input_schema}] for unary-unary methods."""
+        """-> [{name, full_method, streaming, input_schema}] for EVERY
+        method; ``streaming`` is unary/server/client/bidi (streaming RPCs
+        are first-class: a tool call collects/sends bounded streams)."""
         await self._load_symbol(service)
         descriptor = self._pool.FindServiceByName(service)
         methods = []
         for method in descriptor.methods:
-            if method.client_streaming or method.server_streaming:
-                continue  # tools are request/response; streaming RPCs skipped
+            if method.client_streaming and method.server_streaming:
+                streaming = "bidi"
+            elif method.server_streaming:
+                streaming = "server"
+            elif method.client_streaming:
+                streaming = "client"
+            else:
+                streaming = "unary"
+            schema = _message_schema(method.input_type)
+            if method.client_streaming:
+                # the tool takes the request STREAM as a JSON array
+                schema = {"type": "object", "properties": {
+                    "requests": {"type": "array", "items": schema}}}
             methods.append({
                 "name": method.name,
                 "full_method": f"/{service}/{method.name}",
+                "streaming": streaming,
                 "input_type": method.input_type.full_name,
                 "output_type": method.output_type.full_name,
-                "input_schema": _message_schema(method.input_type),
+                "input_schema": schema,
             })
         return methods
 
-    async def invoke(self, service: str, method_name: str,
-                     arguments: dict[str, Any], timeout: float = 30.0
-                     ) -> dict[str, Any]:
+    async def _resolve(self, service: str, method_name: str):
         await self._load_symbol(service)
         descriptor = self._pool.FindServiceByName(service)
         method = descriptor.FindMethodByName(method_name)
         if method is None:
             raise ValueError(f"Method {method_name!r} not found on {service}")
-        input_cls = message_factory.GetMessageClass(method.input_type)
-        output_cls = message_factory.GetMessageClass(method.output_type)
-        request = json_format.ParseDict(arguments, input_cls(),
-                                        ignore_unknown_fields=True)
-        call = self._get_channel().unary_unary(
-            f"/{service}/{method_name}",
-            request_serializer=lambda m: m.SerializeToString(),
-            response_deserializer=output_cls.FromString)
-        response = await call(request, timeout=timeout)
+        return (message_factory.GetMessageClass(method.input_type),
+                message_factory.GetMessageClass(method.output_type),
+                method)
+
+    async def invoke(self, service: str, method_name: str,
+                     arguments: dict[str, Any], timeout: float = 30.0,
+                     max_stream_messages: int = 256) -> dict[str, Any]:
+        """Unary and streaming RPCs behind one JSON surface.
+
+        - unary:  arguments -> request message; returns the response dict
+        - server: returns {"messages": [...], "truncated": bool}
+        - client: arguments["requests"] (array) -> one response dict
+        - bidi:   arguments["requests"] -> {"messages": [...], ...}
+        Streams are bounded by ``max_stream_messages`` — a tool result is
+        a value, not an unbounded subscription."""
+        input_cls, output_cls, method = await self._resolve(service,
+                                                            method_name)
+        path = f"/{service}/{method_name}"
+        serialize = lambda m: m.SerializeToString()  # noqa: E731
+        channel = self._get_channel()
+
+        def parse_one(payload: dict[str, Any]):
+            return json_format.ParseDict(payload, input_cls(),
+                                         ignore_unknown_fields=True)
+
+        if method.client_streaming:
+            raw = arguments.get("requests")
+            if not isinstance(raw, list):
+                raise ValueError(
+                    "client-streaming RPC needs arguments.requests: [...]")
+            requests = [parse_one(item) for item in raw]
+        else:
+            requests = [parse_one(arguments)]
+
+        async def request_iter():
+            for message in requests:
+                yield message
+
+        if method.client_streaming and method.server_streaming:
+            call = channel.stream_stream(path, request_serializer=serialize,
+                                         response_deserializer=output_cls.FromString)
+            stream = call(request_iter(), timeout=timeout)
+            return await self._collect_stream(stream, max_stream_messages)
+        if method.server_streaming:
+            call = channel.unary_stream(path, request_serializer=serialize,
+                                        response_deserializer=output_cls.FromString)
+            stream = call(requests[0], timeout=timeout)
+            return await self._collect_stream(stream, max_stream_messages)
+        if method.client_streaming:
+            call = channel.stream_unary(path, request_serializer=serialize,
+                                        response_deserializer=output_cls.FromString)
+            response = await call(request_iter(), timeout=timeout)
+            return json_format.MessageToDict(response,
+                                             preserving_proto_field_name=True)
+        call = channel.unary_unary(path, request_serializer=serialize,
+                                   response_deserializer=output_cls.FromString)
+        response = await call(requests[0], timeout=timeout)
         return json_format.MessageToDict(response,
                                          preserving_proto_field_name=True)
+
+    @staticmethod
+    async def _collect_stream(stream, cap: int) -> dict[str, Any]:
+        messages = []
+        truncated = False
+        async for response in stream:
+            if len(messages) >= cap:
+                truncated = True
+                stream.cancel()
+                break
+            messages.append(json_format.MessageToDict(
+                response, preserving_proto_field_name=True))
+        return {"messages": messages, "truncated": truncated}
 
 
 def _message_schema(descriptor) -> dict[str, Any]:

@@ -153,6 +153,9 @@ class Settings(BaseModel):
     upstream_idle_ttl: float = 300.0
     # external (out-of-process) plugin servers
     external_plugin_timeout: float = 10.0
+    # gRPC translation: streamed-RPC tool results are bounded collections
+    # (reference mcpgateway_grpc_max_message_size family)
+    grpc_max_stream_messages: int = 256
 
     # --- account lockout (reference email_auth lockout policy) ---
     auth_max_failed_attempts: int = 5

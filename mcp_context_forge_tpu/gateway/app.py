@@ -502,7 +502,10 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         body = await request.json()
         try:
             created = await grpc_service.register_target(
-                body.get("target", ""), prefix=body.get("prefix", ""))
+                body.get("target", ""), prefix=body.get("prefix", ""),
+                tls=bool(body.get("tls")), ca_pem=body.get("ca_pem"),
+                cert_pem=body.get("cert_pem"), key_pem=body.get("key_pem"),
+                authority=body.get("authority"))
         except Exception as exc:
             return web.json_response(
                 {"detail": f"gRPC discovery failed: {type(exc).__name__}"},

@@ -8,6 +8,7 @@ a GRPC-typed tool; tools/call marshals JSON↔protobuf via the dynamic pool.
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 from ..clients.grpc_reflection import GrpcReflectionClient
@@ -21,10 +22,47 @@ class GrpcService:
         self.ctx = ctx
         self.tools = tool_service
         self._clients: dict[str, GrpcReflectionClient] = {}
+        self._tls_options: dict[str, dict[str, Any]] = {}  # target -> opts
 
-    def _client(self, target: str) -> GrpcReflectionClient:
+    async def _load_tls_options(self, target: str) -> dict[str, Any]:
+        """Channel options survive restarts: the tools a TLS registration
+        created persist in the DB, so the options must too (global_config
+        row per target; the private key is sealed at rest)."""
+        if target in self._tls_options:
+            return self._tls_options[target]
+        row = await self.ctx.db.fetchone(
+            "SELECT value FROM global_config WHERE key=?",
+            (f"grpc_channel:{target}",))
+        options: dict[str, Any] = {}
+        if row and row["value"]:
+            from ..db.core import from_json
+            from ..utils.crypto import decrypt_field
+
+            options = from_json(row["value"], {})
+            if options.get("key_pem"):
+                options["key_pem"] = decrypt_field(
+                    options["key_pem"], self.ctx.settings.auth_encryption_secret)
+        self._tls_options[target] = options
+        return options
+
+    async def _save_tls_options(self, target: str,
+                                options: dict[str, Any]) -> None:
+        from ..utils.crypto import encrypt_field
+
+        sealed = dict(options)
+        if sealed.get("key_pem"):
+            sealed["key_pem"] = encrypt_field(
+                sealed["key_pem"], self.ctx.settings.auth_encryption_secret)
+        await self.ctx.db.execute(
+            "INSERT INTO global_config (key, value, updated_at)"
+            " VALUES (?,?,?) ON CONFLICT(key) DO UPDATE SET"
+            " value=excluded.value, updated_at=excluded.updated_at",
+            (f"grpc_channel:{target}", to_json(sealed), time.time()))
+
+    async def _client(self, target: str) -> GrpcReflectionClient:
         if target not in self._clients:
-            self._clients[target] = GrpcReflectionClient(target)
+            options = await self._load_tls_options(target)
+            self._clients[target] = GrpcReflectionClient(target, **options)
         return self._clients[target]
 
     async def shutdown(self) -> None:
@@ -35,13 +73,30 @@ class GrpcService:
                 pass
         self._clients.clear()
 
-    async def register_target(self, target: str,
-                              prefix: str = "") -> list[dict[str, Any]]:
-        """Discover + register every unary method as a tool. Returns the
-        created tool descriptions."""
+    async def register_target(self, target: str, prefix: str = "",
+                              tls: bool = False, ca_pem: str | None = None,
+                              cert_pem: str | None = None,
+                              key_pem: str | None = None,
+                              authority: str | None = None
+                              ) -> list[dict[str, Any]]:
+        """Discover + register every method (unary AND streaming) as a
+        tool. TLS options (root pin / mTLS / :authority override) follow
+        the reference translate_grpc channel options."""
         from .base import ConflictError
 
-        client = self._client(target)
+        if tls or ca_pem or cert_pem or key_pem or authority:
+            options = {
+                # cert material implies TLS; a bare :authority override
+                # stays plaintext (proxied plaintext backends use it)
+                "tls": bool(tls or ca_pem or cert_pem),
+                "ca_pem": ca_pem, "cert_pem": cert_pem,
+                "key_pem": key_pem, "authority": authority}
+            self._tls_options[target] = options
+            await self._save_tls_options(target, options)
+            old = self._clients.pop(target, None)  # rebuild the channel
+            if old is not None:
+                await old.close()
+        client = await self._client(target)
         services = await client.list_services()
         created: list[dict[str, Any]] = []
         errors: list[str] = []
@@ -50,7 +105,8 @@ class GrpcService:
                 tool_name = f"{prefix or service.split('.')[-1].lower()}-" \
                             f"{method['name'].lower()}"
                 annotations = {"grpc_target": target, "grpc_service": service,
-                               "grpc_method": method["name"]}
+                               "grpc_method": method["name"],
+                               "grpc_streaming": method["streaming"]}
                 try:
                     tool = await self.tools.register_tool(ToolCreate(
                         name=tool_name, integration_type="GRPC",
@@ -78,8 +134,10 @@ class GrpcService:
         method = annotations.get("grpc_method", "")
         if not (target and service and method):
             raise NotFoundError("Tool is missing grpc_* annotations")
-        client = self._client(target)
-        result = await client.invoke(service, method, arguments,
-                                     timeout=self.ctx.settings.tool_timeout)
+        client = await self._client(target)
+        result = await client.invoke(
+            service, method, arguments,
+            timeout=self.ctx.settings.tool_timeout,
+            max_stream_messages=self.ctx.settings.grpc_max_stream_messages)
         return {"content": [{"type": "text", "text": to_json(result)}],
                 "structuredContent": result, "isError": False}

@@ -36,6 +36,18 @@ def _calc_fdp() -> descriptor_pb2.FileDescriptorProto:
     method.name = "Add"
     method.input_type = ".test.AddRequest"
     method.output_type = ".test.AddReply"
+    # server-streaming: CountTo(a) -> stream of sums 1..a
+    method = service.method.add()
+    method.name = "CountTo"
+    method.input_type = ".test.AddRequest"
+    method.output_type = ".test.AddReply"
+    method.server_streaming = True
+    # client-streaming: SumAll(stream AddRequest) -> one AddReply
+    method = service.method.add()
+    method.name = "SumAll"
+    method.input_type = ".test.AddRequest"
+    method.output_type = ".test.AddReply"
+    method.client_streaming = True
     return fdp
 
 
@@ -50,6 +62,16 @@ async def _start_server():
 
     async def add_handler(request, context):
         return AddReply(sum=request.a + request.b)
+
+    async def count_to_handler(request, context):
+        for i in range(1, request.a + 1):
+            yield AddReply(sum=i)
+
+    async def sum_all_handler(request_iterator, context):
+        total = 0
+        async for request in request_iterator:
+            total += request.a + request.b
+        return AddReply(sum=total)
 
     async def reflection_handler(request_iterator, context):
         async for request in request_iterator:
@@ -67,6 +89,14 @@ async def _start_server():
     calc = grpc.method_handlers_generic_handler("test.Calc", {
         "Add": grpc.unary_unary_rpc_method_handler(
             add_handler,
+            request_deserializer=AddRequest.FromString,
+            response_serializer=lambda m: m.SerializeToString()),
+        "CountTo": grpc.unary_stream_rpc_method_handler(
+            count_to_handler,
+            request_deserializer=AddRequest.FromString,
+            response_serializer=lambda m: m.SerializeToString()),
+        "SumAll": grpc.stream_unary_rpc_method_handler(
+            sum_all_handler,
             request_deserializer=AddRequest.FromString,
             response_serializer=lambda m: m.SerializeToString())})
     reflection = grpc.method_handlers_generic_handler(
@@ -87,12 +117,28 @@ async def test_reflection_discovery_and_invoke():
         client = refl.GrpcReflectionClient(f"127.0.0.1:{port}")
         services = await client.list_services()
         assert services == ["test.Calc"]
-        methods = await client.describe_service("test.Calc")
-        assert methods[0]["name"] == "Add"
-        assert methods[0]["input_schema"]["properties"] == {
+        methods = {m["name"]: m for m in
+                   await client.describe_service("test.Calc")}
+        assert methods["Add"]["streaming"] == "unary"
+        assert methods["Add"]["input_schema"]["properties"] == {
             "a": {"type": "integer"}, "b": {"type": "integer"}}
+        assert methods["CountTo"]["streaming"] == "server"
+        assert methods["SumAll"]["streaming"] == "client"
+        assert "requests" in methods["SumAll"]["input_schema"]["properties"]
         result = await client.invoke("test.Calc", "Add", {"a": 20, "b": 22})
         assert result == {"sum": 42}
+        # server-streaming collects bounded messages
+        result = await client.invoke("test.Calc", "CountTo", {"a": 4})
+        assert [m["sum"] for m in result["messages"]] == [1, 2, 3, 4]
+        assert result["truncated"] is False
+        result = await client.invoke("test.Calc", "CountTo", {"a": 9},
+                                     max_stream_messages=3)
+        assert [m["sum"] for m in result["messages"]] == [1, 2, 3]
+        assert result["truncated"] is True
+        # client-streaming takes arguments.requests
+        result = await client.invoke("test.Calc", "SumAll", {"requests": [
+            {"a": 1, "b": 2}, {"a": 3, "b": 4}]})
+        assert result == {"sum": 10}
     finally:
         await server.stop(None)
 
@@ -107,8 +153,8 @@ async def test_grpc_tool_through_gateway():
         resp = await gateway.post("/grpc/register", json={
             "target": f"127.0.0.1:{port}"}, auth=auth)
         assert resp.status == 201, await resp.text()
-        registered = (await resp.json())["registered"]
-        assert registered[0]["tool"] == "calc-add"
+        registered = {r["tool"] for r in (await resp.json())["registered"]}
+        assert {"calc-add", "calc-countto", "calc-sumall"} <= registered
 
         resp = await gateway.post("/rpc", json={
             "jsonrpc": "2.0", "id": 1, "method": "tools/call",
@@ -116,6 +162,61 @@ async def test_grpc_tool_through_gateway():
             auth=auth)
         payload = await resp.json()
         assert payload["result"]["structuredContent"] == {"sum": 7}
+
+        # streaming RPCs through the normal tools/call pipeline
+        resp = await gateway.post("/rpc", json={
+            "jsonrpc": "2.0", "id": 2, "method": "tools/call",
+            "params": {"name": "calc-countto", "arguments": {"a": 3}}},
+            auth=auth)
+        payload = await resp.json()
+        assert [m["sum"] for m in
+                payload["result"]["structuredContent"]["messages"]] == [1, 2, 3]
+        resp = await gateway.post("/rpc", json={
+            "jsonrpc": "2.0", "id": 3, "method": "tools/call",
+            "params": {"name": "calc-sumall", "arguments": {"requests": [
+                {"a": 5, "b": 5}, {"a": 1, "b": 1}]}}}, auth=auth)
+        payload = await resp.json()
+        assert payload["result"]["structuredContent"] == {"sum": 12}
     finally:
         await gateway.close()
         await server.stop(None)
+
+
+async def test_tls_options_survive_service_restart():
+    """TLS/channel options persist in global_config (key sealed at rest):
+    a fresh GrpcService instance — a restarted gateway — rebuilds the
+    channel with the registered options instead of silently downgrading
+    to plaintext."""
+    from tests.integration.test_gateway_app import make_client
+
+    gateway = await make_client()
+    try:
+        service = gateway.app["grpc_service"]
+        await service._save_tls_options("10.0.0.5:443", {
+            "tls": True, "ca_pem": "PEM", "cert_pem": None,
+            "key_pem": "PRIVATE", "authority": "svc.internal"})
+        # the key is sealed in the DB row, not plaintext
+        row = await gateway.app["ctx"].db.fetchone(
+            "SELECT value FROM global_config WHERE key=?",
+            ("grpc_channel:10.0.0.5:443",))
+        assert "PRIVATE" not in row["value"]
+
+        from mcp_context_forge_tpu.services.grpc_service import GrpcService
+        fresh = GrpcService(gateway.app["ctx"], gateway.app["tool_service"])
+        client = await fresh._client("10.0.0.5:443")
+        assert client.tls is True
+        assert client.ca_pem == "PEM"
+        assert client.key_pem == "PRIVATE"       # unsealed on load
+        assert client.authority == "svc.internal"
+        await fresh.shutdown()
+
+        # a bare :authority override stays plaintext
+        await service._save_tls_options("10.0.0.6:50051", {
+            "tls": False, "ca_pem": None, "cert_pem": None,
+            "key_pem": None, "authority": "proxy.internal"})
+        fresh2 = GrpcService(gateway.app["ctx"], gateway.app["tool_service"])
+        client = await fresh2._client("10.0.0.6:50051")
+        assert client.tls is False and client.authority == "proxy.internal"
+        await fresh2.shutdown()
+    finally:
+        await gateway.close()
