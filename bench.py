@@ -134,6 +134,13 @@ async def _make_gateway(engine: bool, platform: str):
         # the win is on TPU (CPU is compute-bound, sync is cheap there)
         "MCPFORGE_TPU_LOCAL_DECODE_BLOCK": os.environ.get(
             "BENCH_DECODE_BLOCK", "4" if platform == "tpu" else "1"),
+        # decode width tracks active load: measured 3.6x on the CPU proxy
+        # for config 3 (8 active slots of max_batch 64 — fixed-width
+        # decode burns 8x the compute). TPU default stays off pending the
+        # hardware A/B (width flips re-home the donated KV pool; the
+        # re-home cost on real HBM is unmeasured).
+        "MCPFORGE_TPU_LOCAL_BATCH_BUCKETS": os.environ.get(
+            "BENCH_BATCH_BUCKETS", "false" if platform == "tpu" else "true"),
         "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
         "MCPFORGE_OTEL_EXPORTER": "none",
         "MCPFORGE_LOG_LEVEL": "WARNING",
@@ -357,8 +364,15 @@ async def bench_engine_configs(platform: str) -> dict:
                 "baseline_no_plugins": base_1k,
                 "moderation_chain": chain_1k,
                 "added_p50_ms": round(chain_1k["p50_ms"] - base_1k["p50_ms"], 2),
+                # the depth-independent number: added service time per
+                # request (Little's law — at depth N, added p50 ~= N x
+                # this). <200 ms added p50 @ 1k therefore needs the chain
+                # to cost <0.2 ms/request over baseline at saturation.
+                "added_service_ms_per_request": round(
+                    1000.0 / chain_1k["rps"] - 1000.0 / base_1k["rps"], 3),
                 "note": ("1-vCPU box: server + client processes share one "
-                         "core; p50 includes client-side scheduling")}
+                         "core; p50 includes client-side scheduling and "
+                         "queueing at saturation (p50 ~= depth/rps)")}
         await pm.remove_plugin("mod")
         await pm.remove_plugin("harm")
 

