@@ -135,6 +135,7 @@ const TABS = {
   logs:     {url: "/admin/logs?limit=200", cols: ["ts","level","logger","message"]},
   audit:    {url: "/admin/audit?limit=100", cols: ["ts","actor","action","details"]},
   exportimport: {special: "exportimport"},
+  chat:     {special: "chat"},
   engine:   {url: "/admin/engine/stats", special: "engine"},
 };
 let current = "tools", rows = [], shown = [], timer = null, cursor = null;
@@ -232,6 +233,96 @@ async function pruneMetrics(){
   document.getElementById("status").textContent = r.ok ?
     "pruned " + (await r.json()).pruned + " rows" : "prune failed";
 }
+let chatSession = null;
+function renderChat(){
+  document.getElementById("view").innerHTML = `
+   <div style="background:#fff;padding:14px;box-shadow:0 1px 3px rgba(0,0,0,.08)">
+    <b>llmchat playground</b> (tpu_local agent + gateway tools, SSE streaming)<br>
+    <div id="chat-log" style="min-height:160px;max-height:420px;overflow:auto;
+      font-size:13px;margin:10px 0;border:1px solid #eceef1;padding:8px"></div>
+    <input id="chat-input" style="width:70%;padding:6px 10px;border:1px solid #ccd;border-radius:4px"
+      placeholder="message…" onkeydown="if(event.key==='Enter')sendChat()">
+    <button class="act" onclick="sendChat()">send (/llmchat)</button>
+    <button class="act danger" onclick="resetChat()">reset session</button>
+   </div>`;
+  document.getElementById("status").textContent =
+    chatSession ? "session " + chatSession : "no session yet";
+}
+function chatLine(cls, text){
+  const log = document.getElementById("chat-log");
+  if (!log) return null;  // user left the chat tab mid-stream
+  const div = document.createElement("div");
+  div.style.whiteSpace = "pre-wrap";
+  if (cls === "user") div.style.fontWeight = "600";
+  if (cls === "tool") div.style.color = "#667";
+  if (cls === "err") div.style.color = "#a12622";
+  div.textContent = text;
+  log.appendChild(div);
+  log.scrollTop = log.scrollHeight;
+  return div;
+}
+async function resetChat(){
+  if (chatSession) await fetch(`/llmchat/${chatSession}`, {method:"DELETE"});
+  chatSession = null;
+  renderChat();
+}
+let chatBusy = false;
+async function sendChat(){
+  if (chatBusy) return;  // one in-flight turn per session: concurrent
+                         // turns would interleave the stored history
+  const input = document.getElementById("chat-input");
+  const text = input.value.trim();
+  if (!text) return;
+  chatBusy = true;
+  try {
+    if (!chatSession){
+      const r = await fetch("/llmchat/connect", {method:"POST",
+        headers:{"content-type":"application/json"}, body:"{}"});
+      if (!r.ok){ chatLine("err", "connect failed: " + r.status); return; }
+      chatSession = (await r.json()).session_id;
+      document.getElementById("status").textContent = "session " + chatSession;
+    }
+    chatLine("user", "you: " + text);
+    const r = await fetch(`/llmchat/${chatSession}/chat`, {method:"POST",
+      headers:{"content-type":"application/json"},
+      body: JSON.stringify({message: text, stream: true})});
+    if (!r.ok){ chatLine("err", "chat failed: " + r.status); return; }
+    input.value = "";  // only a SENT message clears the box
+    const reader = r.body.getReader();
+    const decoder = new TextDecoder();
+    let buffer = "", tokenDiv = null;
+    while (true){
+      const {done, value} = await reader.read();
+      if (done) break;
+      buffer += decoder.decode(value, {stream: true});
+      let idx;
+      while ((idx = buffer.indexOf("\n\n")) !== -1){
+        const frame = buffer.slice(0, idx);
+        buffer = buffer.slice(idx + 2);
+        if (!frame.startsWith("data: ") || frame === "data: [DONE]") continue;
+        let event;
+        try { event = JSON.parse(frame.slice(6)); } catch(e){ continue; }
+        if (event.type === "token"){
+          if (!tokenDiv) tokenDiv = chatLine("", "assistant: ");
+          if (tokenDiv) tokenDiv.textContent += event.text;
+        } else if (event.type === "tool_call"){
+          tokenDiv = null;  // next step's tokens open a NEW line (they
+                            // must render BELOW the tool lines, in order)
+          chatLine("tool", `→ tool ${event.tool}(${event.arguments || "{}"})`);
+        } else if (event.type === "tool_result"){
+          chatLine("tool", `← ${event.tool}: ${event.text}`);
+        } else if (event.type === "answer"){
+          if (tokenDiv) tokenDiv = null;
+          else chatLine("", "assistant: " + event.text);
+        } else if (event.type === "error"){
+          chatLine("err", "error: " + event.message);
+        }
+      }
+    }
+  } finally {
+    chatBusy = false;
+  }
+}
 function renderExportImport(){
   document.getElementById("view").innerHTML = `
    <div style="background:#fff;padding:14px;box-shadow:0 1px 3px rgba(0,0,0,.08)">
@@ -316,6 +407,7 @@ async function show(name, keepCursor){
   s.textContent = "loading…";
   if (t.special === "dashboard") return renderDashboard();
   if (t.special === "exportimport") return renderExportImport();
+  if (t.special === "chat") return renderChat();
   try {
     let url = t.url;
     if (t.paged) {

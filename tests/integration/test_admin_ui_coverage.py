@@ -18,7 +18,7 @@ from test_gateway_app import make_client
 # public discovery, per-session paths. Everything else must be in the UI.
 NON_UI_PREFIXES = (
     "/mcp", "/rpc", "/servers/{server_id}/mcp", "/messages",
-    "/v1/", "/llmchat", "/auth/login", "/auth/password", "/auth/sso",
+    "/v1/", "/auth/login", "/auth/password", "/auth/sso",
     "/oauth", "/.well-known", "/robots.txt", "/health", "/ready",
     "/version", "/appbridge", "/a2a/{name}", "/a2a/tasks",
     "/llm/providers/{provider_id}/models",  # create-model API (CLI surface)
