@@ -462,6 +462,212 @@ def page_allocator_oracle(mod: types.ModuleType) -> None:
     assert int(np.asarray(table)[3, 0]) == 0
 
 
+# ----------------------------------------------------- avg slot footprint
+
+def _avg_slot_pages_spec(mod: types.ModuleType) -> None:
+    a = mod.PageAllocator(num_pages=32, page_size=4, max_slots=4,
+                          max_pages_per_slot=8)
+    # nothing active: conservative max footprint
+    assert a.avg_slot_pages() == 8
+    assert a.allocate_slot(0, 8)    # 2 pages
+    assert a.avg_slot_pages() == 2
+    assert a.allocate_slot(1, 16)   # 4 pages
+    assert a.avg_slot_pages() == 3  # (2 + 4) // 2
+    a.free_slot(1)
+    assert a.avg_slot_pages() == 2
+    a.free_slot(0)
+    assert a.allocate_slot(2, 2)    # 1 page: floor of the average is 1
+    assert a.avg_slot_pages() == 1
+
+
+# ------------------------------------------------------------ eventstream
+
+def eventstream_oracle(mod: types.ModuleType) -> None:
+    """Behavioral spec of the AWS event-stream codec: exact framing
+    layout, both CRCs live, typed headers, incremental reassembly. A
+    surviving mutant means silently corrupt Bedrock streams."""
+    import asyncio
+    import zlib
+
+    headers = {":event-type": "contentBlockDelta", ":message-type": "event"}
+    payload = b'{"delta":{"text":"hi"}}'
+    frame = mod.encode_frame(headers, payload)
+    # exact layout: total length, headers length, prelude CRC
+    total = int.from_bytes(frame[0:4], "big")
+    assert total == len(frame)
+    hlen = int.from_bytes(frame[4:8], "big")
+    assert hlen == len(frame) - 12 - 4 - len(payload)
+    assert int.from_bytes(frame[8:12], "big") == zlib.crc32(frame[0:8])
+    assert int.from_bytes(frame[-4:], "big") == zlib.crc32(frame[:-4])
+    assert mod.decode_frame(frame) == (headers, payload)
+    assert mod.decode_frame(mod.encode_frame({}, b"")) == ({}, b"")
+
+    # every corrupted byte position must be caught by SOME check
+    for pos in (2, 5, 9, 13, len(frame) - 6, len(frame) - 2):
+        corrupt = bytearray(frame)
+        corrupt[pos] ^= 0xFF
+        try:
+            mod.decode_frame(bytes(corrupt))
+        except mod.EventStreamError:
+            pass
+        else:
+            raise AssertionError(f"corruption at byte {pos} accepted")
+
+    # typed headers: bool true/false + every scalar width + bytes + string
+    hdr = bytes([1]) + b"t" + bytes([0])
+    hdr += bytes([1]) + b"f" + bytes([1])
+    hdr += bytes([1]) + b"a" + bytes([2]) + (5).to_bytes(1, "big")
+    hdr += bytes([1]) + b"b" + bytes([3]) + (-300).to_bytes(2, "big",
+                                                            signed=True)
+    hdr += bytes([1]) + b"c" + bytes([4]) + (7).to_bytes(4, "big")
+    hdr += bytes([1]) + b"d" + bytes([5]) + (2**40).to_bytes(8, "big")
+    hdr += bytes([1]) + b"e" + bytes([8]) + (123456).to_bytes(8, "big")
+    hdr += bytes([1]) + b"u" + bytes([9]) + bytes(range(16))
+    hdr += bytes([1]) + b"s" + bytes([7]) + (2).to_bytes(2, "big") + b"ok"
+    hdr += bytes([1]) + b"r" + bytes([6]) + (3).to_bytes(2, "big") + b"\x01\x02\x03"
+    parsed = mod._parse_headers(hdr)
+    assert parsed == {"t": True, "f": False, "a": 5, "b": -300, "c": 7,
+                      "d": 2**40, "e": 123456, "u": bytes(range(16)),
+                      "s": "ok", "r": b"\x01\x02\x03"}
+    # unknown value type is an error, not silent garbage
+    try:
+        mod._parse_headers(bytes([1]) + b"x" + bytes([99]))
+    except mod.EventStreamError:
+        pass
+    else:
+        raise AssertionError("unknown header type accepted")
+
+    # bad prelude CRC with a RECOMPUTED (valid) message CRC: only the
+    # prelude check can catch this one
+    broken = bytearray(frame)
+    broken[8] ^= 0xFF
+    broken[-4:] = zlib.crc32(bytes(broken[:-4])).to_bytes(4, "big")
+    try:
+        mod.decode_frame(bytes(broken))
+    except mod.EventStreamError as exc:
+        assert "prelude" in str(exc)
+    else:
+        raise AssertionError("bad prelude CRC accepted")
+
+    # extra bytes past the claimed total, with the TRAILING CRC recomputed
+    # so both CRC checks pass: only the length check can catch this
+    padded = bytearray(frame + b"\x00" * 6)
+    padded[-4:] = zlib.crc32(bytes(padded[:-4])).to_bytes(4, "big")
+    try:
+        mod.decode_frame(bytes(padded))
+    except mod.EventStreamError as exc:
+        assert "length" in str(exc)
+    else:
+        raise AssertionError("over-long frame accepted")
+
+    # incremental reassembly across every split granularity
+    frames = [mod.encode_frame({"k": str(i)}, bytes([i]) * i)
+              for i in range(5)]
+    frames.append(mod.encode_frame({}, b""))   # the minimal 16-byte frame
+    blob = b"".join(frames)
+
+    async def collect(step):
+        async def chunks():
+            for i in range(0, len(blob), step):
+                yield blob[i:i + step]
+        return [h async for h, _ in mod.iter_frames(chunks())]
+
+    for step in (1, 3, len(blob)):
+        got = asyncio.run(collect(step))
+        assert [h.get("k") for h in got] == ["0", "1", "2", "3", "4", None]
+
+    async def feed(data):
+        async def chunks():
+            yield data
+        return [f async for f in mod.iter_frames(chunks())]
+
+    try:
+        asyncio.run(feed(blob + b"\x00"))
+    except mod.EventStreamError:
+        pass
+    else:
+        raise AssertionError("trailing bytes accepted")
+    # implausible frame lengths fail fast instead of buffering forever
+    for claimed in (3, 17 * 1024 * 1024):
+        bad = claimed.to_bytes(4, "big") + b"\x00" * 12
+        try:
+            asyncio.run(feed(bad))
+        except mod.EventStreamError:
+            pass
+        else:
+            raise AssertionError(f"implausible length {claimed} accepted")
+    # a stream that ENDS mid-frame is an error (incomplete trailing frame)
+    try:
+        asyncio.run(feed(frame[:11]))
+    except mod.EventStreamError:
+        pass
+    else:
+        raise AssertionError("truncated stream accepted")
+
+
+# ------------------------------------------------------------- tool_calls
+
+def tool_calls_oracle(mod: types.ModuleType) -> None:
+    """Behavioral spec of the function-calling wire layer: accepted
+    emission shapes, rejection of plain answers, OpenAI tool_calls
+    structure, render/parse round trip."""
+    import json as _json
+
+    calls = mod.parse_tool_calls('{"name": "f", "parameters": {"a": 1}}')
+    assert len(calls) == 1
+    call = calls[0]
+    assert call["type"] == "function"
+    assert call["id"].startswith("call_")
+    assert call["function"]["name"] == "f"
+    assert _json.loads(call["function"]["arguments"]) == {"a": 1}
+
+    # alternate key spellings
+    assert mod.parse_tool_calls(
+        '{"name": "g", "arguments": {"x": 2}}')[0]["function"]["name"] == "g"
+    assert mod.parse_tool_calls(
+        '{"tool": "h", "arguments": {}}')[0]["function"]["name"] == "h"
+    # arrays = parallel calls, order preserved, unique ids
+    multi = mod.parse_tool_calls(
+        '[{"name": "a", "parameters": {}}, {"name": "b", "parameters": {}}]')
+    assert [c["function"]["name"] for c in multi] == ["a", "b"]
+    assert multi[0]["id"] != multi[1]["id"]
+    # python_tag prefix and prose wrapping
+    assert mod.parse_tool_calls(
+        '<|python_tag|>{"name": "f", "parameters": {}}') is not None
+    assert mod.parse_tool_calls(
+        'Sure.\n{"name": "f", "parameters": {}}\nDone.') is not None
+    # rejections: plain text, missing/empty name, scalar args, non-dicts
+    for bad in ("plain answer", '{"x": 1}', '{"name": "", "parameters": {}}',
+                '{"name": "f", "parameters": 3}', "[1, 2]", "[]",
+                '[{"name": "f", "parameters": {}}, {"x": 1}]'):
+        assert mod.parse_tool_calls(bad) is None, bad
+
+    # non-string names reject; id carries 16 hex chars after the prefix
+    assert mod.parse_tool_calls('{"name": 3, "parameters": {}}') is None
+    assert len(call["id"]) == len("call_") + 16
+    # leading-JSON-with-trailing-prose parses via the outermost span
+    tail = mod.parse_tool_calls('{"name": "t", "parameters": {}}thanks!')
+    assert tail[0]["function"]["name"] == "t"
+
+    # render block lists every signature + the call instruction,
+    # INCLUDING the parameters schema
+    block = mod.render_tools_block([
+        {"type": "function", "function": {"name": "fn1", "description": "D",
+                                          "parameters": {"type": "object"}}}])
+    assert "fn1" in block and "D" in block
+    assert '{"type":"object"}' in block
+    assert '"<function-name>"' in block
+
+    # round trip: rendered call text re-parses to the same call
+    text = mod.tool_call_message_text(calls)
+    again = mod.parse_tool_calls(text)
+    assert again[0]["function"]["name"] == "f"
+    assert _json.loads(again[0]["function"]["arguments"]) == {"a": 1}
+    multi_text = mod.tool_call_message_text(multi)
+    assert [c["function"]["name"] for c in mod.parse_tool_calls(multi_text)] \
+        == ["a", "b"]
+
+
 TARGETS: dict[str, MutationTarget] = {
     "jsonrpc": MutationTarget(
         rel_path="jsonrpc.py",
@@ -486,15 +692,41 @@ TARGETS: dict[str, MutationTarget] = {
         rel_path="tpu_local/kv/paged_cache.py",
         module_name="mcp_context_forge_tpu.tpu_local.kv.paged_cache",
         package="mcp_context_forge_tpu.tpu_local.kv",
-        oracle=page_allocator_oracle,
+        oracle=lambda mod: (page_allocator_oracle(mod),
+                            _avg_slot_pages_spec(mod)),
         class_name="PageAllocator",
-        # 183: _take_page's `key is not None and _cached.get(key) == page`
+        # 192: _take_page's `key is not None and _cached.get(key) == page`
         # — register_prefix maintains _page_key[page] == key iff
         # _cached[key] == page, so the second conjunct is purely defensive
-        # and And->Or is equivalent under the invariant. 190: the
+        # and And->Or is equivalent under the invariant. 199: the
         # defensive ref-default in _release_page (allocate/extend/match
         # always set a ref first, so the default is unreachable).
-        equivalent_lines=frozenset({183, 190}),
+        equivalent_lines=frozenset({192, 199}),
+    ),
+    "eventstream": MutationTarget(
+        rel_path="utils/eventstream.py",
+        module_name="mcp_context_forge_tpu.utils.eventstream",
+        package="mcp_context_forge_tpu.utils",
+        oracle=eventstream_oracle,
+        # Contract-equivalent mutants (the oracle's contract is "raises
+        # EventStreamError"; which check fires is unobservable):
+        # 69 short-frame raise (downstream CRC/length checks also raise);
+        # 70/71 prelude-offset shifts (observable only in frames with a
+        # >16 MB segment — leading length bytes are 0 below 2^24);
+        # 111-114 iter_frames fail-fast guard (its removal/loosening
+        # still ends in decode_frame or trailing-bytes raising; the
+        # 16 MB cap value itself is an arbitrary tunable).
+        equivalent_lines=frozenset({69, 70, 71, 72, 111, 112,
+                                    113, 114}),
+    ),
+    "tool_calls": MutationTarget(
+        rel_path="tpu_local/tool_calls.py",
+        module_name="mcp_context_forge_tpu.tpu_local.tool_calls",
+        package="mcp_context_forge_tpu.tpu_local",
+        oracle=tool_calls_oracle,
+        # 85: `0 <= start < end` Lt->LtE — find(open) and rfind(close)
+        # are different characters, so start == end is unsatisfiable.
+        equivalent_lines=frozenset({85}),
     ),
     "rate_limiter": MutationTarget(
         rel_path="gateway/middleware.py",
@@ -502,11 +734,11 @@ TARGETS: dict[str, MutationTarget] = {
         package="mcp_context_forge_tpu.gateway",
         oracle=rate_limiter_oracle,
         class_name="RateLimiter",
-        # 173: the max_buckets DEFAULT-value line — nudging the 100_000
+        # 190: the max_buckets DEFAULT-value line — nudging the 100_000
         # cap by one is behaviorally equivalent (oracle passes explicit
-        # caps). 190: the sweep-trigger compare `now >= _next_sweep` vs
+        # caps). 207: the sweep-trigger compare `now >= _next_sweep` vs
         # `>` differs only at exact monotonic-clock equality (measure
         # zero — the sweep just fires one tick later).
-        equivalent_lines=frozenset({173, 190}),
+        equivalent_lines=frozenset({190, 207}),
     ),
 }

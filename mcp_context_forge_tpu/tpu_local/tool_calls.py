@@ -74,9 +74,10 @@ def _normalize_call(obj: Any) -> dict[str, Any] | None:
 def parse_tool_calls(text: str) -> list[dict[str, Any]] | None:
     """Tool calls emitted in ``text``, or None when it is a plain answer."""
     stripped = text.strip()
-    if stripped.startswith("<|python_tag|>"):
-        stripped = stripped[len("<|python_tag|>"):].strip()
     candidates = [stripped]
+    # NOTE: a leading <|python_tag|> marker needs no special-casing — the
+    # outermost-JSON-span fallback below starts at the first brace/bracket,
+    # which skips any prefix marker (and any prose) identically.
     # models wrap JSON in prose/code fences; try the outermost JSON span too
     for open_ch, close_ch in ("{}", "[]"):
         start = stripped.find(open_ch)

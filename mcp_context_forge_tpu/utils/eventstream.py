@@ -26,8 +26,9 @@ from typing import Any, AsyncIterator
 _PRELUDE_LEN = 12
 _CRC_LEN = 4
 
-# value-type tag -> fixed byte width (None = length-prefixed or special)
-_FIXED_WIDTH = {0: 0, 1: 0, 2: 1, 3: 2, 4: 4, 5: 8, 8: 8, 9: 16}
+# scalar value-type tag -> fixed byte width (bools 0/1 carry no payload
+# and are handled before this table; 6/7 are length-prefixed)
+_FIXED_WIDTH = {2: 1, 3: 2, 4: 4, 5: 8, 8: 8, 9: 16}
 
 
 class EventStreamError(ValueError):
@@ -52,12 +53,12 @@ def _parse_headers(data: bytes) -> dict[str, Any]:
             raw = data[i:i + vlen]
             i += vlen
             headers[name] = raw.decode("utf-8") if vtype == 7 else raw
-        elif vtype in _FIXED_WIDTH:  # integer/timestamp/uuid scalars
+        elif vtype in _FIXED_WIDTH:  # integer/timestamp scalars + uuid
             width = _FIXED_WIDTH[vtype]
             raw = data[i:i + width]
             i += width
-            headers[name] = (int.from_bytes(raw, "big", signed=vtype != 9)
-                             if vtype != 9 else raw)
+            headers[name] = (raw if vtype == 9
+                             else int.from_bytes(raw, "big", signed=True))
         else:
             raise EventStreamError(f"unknown header value type {vtype}")
     return headers
