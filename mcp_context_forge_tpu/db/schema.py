@@ -381,9 +381,18 @@ CREATE TABLE IF NOT EXISTS registered_oauth_clients (
 );
 """
 
+# v5: per-entity invocation metrics (reference keeps per-entity call
+# records + hourly rollups for tools/resources/prompts/servers/a2a,
+# db.py:2556-2848 — one discriminated table here instead of five shapes)
+_V5 = """
+ALTER TABLE tool_metrics ADD COLUMN entity_type TEXT NOT NULL DEFAULT 'tool';
+CREATE INDEX IF NOT EXISTS ix_tool_metrics_type ON tool_metrics(entity_type, ts);
+"""
+
 MIGRATIONS: list[Migration] = [
     Migration(1, "initial-core-schema", _V1),
     Migration(2, "a2a-task-store", _V2),
     Migration(3, "mcp-app-sessions", _V3),
     Migration(4, "registered-oauth-clients", _V4),
+    Migration(5, "per-entity-metrics", _V5),
 ]

@@ -413,8 +413,20 @@ def setup_routes(app: web.Application) -> None:
             " SUM(1 - m.success) AS errors, AVG(m.duration_ms) AS avg_ms,"
             " MIN(m.duration_ms) AS min_ms, MAX(m.duration_ms) AS max_ms"
             " FROM tool_metrics m JOIN tools t ON t.id = m.tool_id"
+            " WHERE m.entity_type='tool'"
             " GROUP BY t.original_name ORDER BY calls DESC LIMIT 100")
-        return web.json_response({"tools": rows})
+        out = {"tools": rows}
+        # per-entity families (reference keeps separate metric models per
+        # entity, db.py:2556-2848; here one discriminated table)
+        for etype, key in (("resource", "resources"), ("prompt", "prompts"),
+                           ("a2a", "a2a_agents")):
+            out[key] = await db.fetchall(
+                "SELECT tool_id AS name, COUNT(*) AS calls,"
+                " SUM(1 - success) AS errors, AVG(duration_ms) AS avg_ms,"
+                " MIN(duration_ms) AS min_ms, MAX(duration_ms) AS max_ms"
+                " FROM tool_metrics WHERE entity_type=?"
+                " GROUP BY tool_id ORDER BY calls DESC LIMIT 100", (etype,))
+        return web.json_response(out)
 
     # ----------------------------------------------------- admin observability
     @routes.get("/admin/logs")

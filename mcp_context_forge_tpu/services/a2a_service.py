@@ -342,7 +342,8 @@ class A2AService:
     async def _record_metric(self, agent_id: str, success: bool) -> None:
         try:
             await self.ctx.db.execute(
-                "INSERT INTO tool_metrics (tool_id, ts, duration_ms, success)"
-                " VALUES (?,?,?,?)", (f"a2a:{agent_id}", now(), 0.0, int(success)))
+                "INSERT INTO tool_metrics (tool_id, ts, duration_ms, success,"
+                " entity_type) VALUES (?,?,?,?,'a2a')",
+                (agent_id, now(), 0.0, int(success)))
         except Exception:
             pass

@@ -57,22 +57,22 @@ class MetricsMaintenanceService:
         a half-pruned boundary hour would shrink its historical aggregate."""
         boundary_hour = int((time.time() - self.retention_hours * 3600) / 3600)
         rows = await self.ctx.db.fetchall(
-            "SELECT tool_id, CAST(ts / 3600 AS INTEGER) AS hour,"
+            "SELECT entity_type, tool_id, CAST(ts / 3600 AS INTEGER) AS hour,"
             " COUNT(*) AS count, SUM(1 - success) AS errors,"
             " SUM(duration_ms) AS total_ms, MIN(duration_ms) AS min_ms,"
             " MAX(duration_ms) AS max_ms"
-            " FROM tool_metrics GROUP BY tool_id, hour"
+            " FROM tool_metrics GROUP BY entity_type, tool_id, hour"
             " HAVING hour > ?", (boundary_hour,))
         for row in rows:
             await self.ctx.db.execute(
                 "INSERT INTO metrics_rollups (entity_type, entity_id, hour, count,"
-                " errors, total_ms, min_ms, max_ms) VALUES ('tool',?,?,?,?,?,?,?)"
+                " errors, total_ms, min_ms, max_ms) VALUES (?,?,?,?,?,?,?,?)"
                 " ON CONFLICT(entity_type, entity_id, hour) DO UPDATE SET"
                 " count=excluded.count, errors=excluded.errors,"
                 " total_ms=excluded.total_ms, min_ms=excluded.min_ms,"
                 " max_ms=excluded.max_ms",
-                (row["tool_id"], row["hour"], row["count"], row["errors"],
-                 row["total_ms"], row["min_ms"], row["max_ms"]))
+                (row["entity_type"], row["tool_id"], row["hour"], row["count"],
+                 row["errors"], row["total_ms"], row["min_ms"], row["max_ms"]))
         return len(rows)
 
     async def cleanup(self) -> int:

@@ -106,6 +106,33 @@ class PromptService:
     async def render_prompt(self, name: str, arguments: dict[str, Any] | None = None
                             ) -> dict[str, Any]:
         """MCP ``prompts/get``: render to messages. Federated prompts proxy."""
+        import time as _time
+
+        started = _time.monotonic()
+        try:
+            result = await self._render_prompt(name, arguments)
+        except Exception:
+            await self._record_metric(name, (_time.monotonic() - started) * 1000,
+                                      False)
+            raise
+        await self._record_metric(name, (_time.monotonic() - started) * 1000,
+                                  True)
+        return result
+
+    async def _record_metric(self, name: str, duration_ms: float,
+                             success: bool) -> None:
+        """Per-entity invocation metrics (reference PromptMetric rows)."""
+        try:
+            await self.ctx.db.execute(
+                "INSERT INTO tool_metrics (tool_id, ts, duration_ms, success,"
+                " entity_type) VALUES (?,?,?,?,'prompt')",
+                (name, now(), duration_ms, int(success)))
+        except Exception:
+            pass
+
+    async def _render_prompt(self, name: str,
+                             arguments: dict[str, Any] | None = None
+                             ) -> dict[str, Any]:
         row = await self.ctx.db.fetchone(
             "SELECT * FROM prompts WHERE name=? AND enabled=1"
             " ORDER BY gateway_id IS NOT NULL", (name,))

@@ -104,6 +104,33 @@ class ResourceService:
         Local rows serve inline content; federated rows proxy to the owning
         gateway. Plugin resource hooks wrap this call at the dispatcher level.
         """
+        import time as _time
+
+        started = _time.monotonic()
+        try:
+            result = await self._read_resource(uri, request_headers)
+        except Exception:
+            await self._record_metric(uri, (_time.monotonic() - started) * 1000,
+                                      False)
+            raise
+        await self._record_metric(uri, (_time.monotonic() - started) * 1000,
+                                  True)
+        return result
+
+    async def _record_metric(self, uri: str, duration_ms: float,
+                             success: bool) -> None:
+        """Per-entity invocation metrics (reference ResourceMetric rows)."""
+        try:
+            await self.ctx.db.execute(
+                "INSERT INTO tool_metrics (tool_id, ts, duration_ms, success,"
+                " entity_type) VALUES (?,?,?,?,'resource')",
+                (uri, now(), duration_ms, int(success)))
+        except Exception:
+            pass
+
+    async def _read_resource(self, uri: str,
+                             request_headers: dict[str, str] | None = None
+                             ) -> dict[str, Any]:
         row = await self.ctx.db.fetchone(
             "SELECT * FROM resources WHERE uri=? AND enabled=1 ORDER BY gateway_id IS NOT NULL",
             (uri,))

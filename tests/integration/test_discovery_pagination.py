@@ -168,3 +168,38 @@ async def test_metrics_maintenance_endpoints():
         assert row["n"] == 0
     finally:
         await gateway.close()
+
+
+async def test_per_entity_metrics_and_rollups():
+    """Resource reads, prompt renders and tool calls record discriminated
+    metric rows; rollups and /metrics report per entity family
+    (reference per-entity metric models, db.py:2556-2848)."""
+    gateway = await make_client()
+    try:
+        await gateway.post("/resources", json={
+            "uri": "mem://doc", "name": "doc", "content": "hello"}, auth=AUTH)
+        await gateway.post("/prompts", json={
+            "name": "greet", "template": "hi {{who}}"}, auth=AUTH)
+        resp = await gateway.post("/resources/read", json={"uri": "mem://doc"},
+                                  auth=AUTH)
+        assert resp.status == 200
+        resp = await gateway.post("/prompts/greet/render",
+                                  json={"who": "x"}, auth=AUTH)
+        assert resp.status == 200
+        # a failed render records too
+        await gateway.post("/prompts/missing/render", json={}, auth=AUTH)
+
+        body = await (await gateway.get("/metrics", auth=AUTH)).json()
+        assert body["resources"][0]["name"] == "mem://doc"
+        assert body["resources"][0]["calls"] == 1
+        prompts = {r["name"]: r for r in body["prompts"]}
+        assert prompts["greet"]["errors"] == 0
+        assert prompts["missing"]["errors"] == 1
+
+        resp = await gateway.post("/metrics/rollup", auth=AUTH)
+        assert resp.status == 200
+        rollups = await (await gateway.get("/metrics/rollups", auth=AUTH)).json()
+        types = {r["entity_type"] for r in rollups}
+        assert {"resource", "prompt"} <= types
+    finally:
+        await gateway.close()
