@@ -68,12 +68,14 @@ async def run(platform: str) -> dict:
     if spec:
         decode_block = 1  # mutually exclusive with multi-step dispatch
     quant = os.environ.get("BENCH_QUANT", "")
+    buckets = os.environ.get("BENCH_BATCH_BUCKETS", "0") == "1"
     config = EngineConfig(model=model, max_batch=min(clients, 16),
                           max_seq_len=512, page_size=16, num_pages=1024,
                           prefill_buckets=(64,),
                           dtype="bfloat16" if platform == "tpu" else "float32",
                           attn_impl="auto", decode_block=decode_block,
                           spec_decode=spec, quant=quant,
+                          batch_buckets=buckets,
                           compile_cache_dir=os.environ.get(
                               "MCPFORGE_TPU_LOCAL_COMPILE_CACHE_DIR",
                               "/tmp/mcpforge-xla-cache"))
@@ -124,7 +126,7 @@ async def run(platform: str) -> dict:
             "clients": clients,
             "tokens": total,
             "wall_s": round(wall, 3),
-            "decode_block": decode_block,
+            "decode_block": decode_block, "batch_buckets": buckets,
             "spec_decode": spec,
             "quant": quant,
             "decode_steps": steps,

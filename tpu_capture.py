@@ -35,6 +35,10 @@ GRID = [
      "BENCH_PROMPT_MODE": "repetitive"},
     # int8 on the same model: A/B the bandwidth win directly
     {"BENCH_DECODE_BLOCK": "4", "BENCH_SPEC": "0", "BENCH_QUANT": "int8"},
+    # decode-width bucketing: 3.6x on the CPU proxy at light load; the
+    # open question is the donated-pool re-home cost on real HBM
+    {"BENCH_DECODE_BLOCK": "1", "BENCH_SPEC": "0",
+     "BENCH_BATCH_BUCKETS": "1", "BENCH_CLIENTS": "4"},
     # the flagship: Llama-3-8B int8 resident on ONE v5e chip (VERDICT #2)
     {"BENCH_DECODE_BLOCK": "4", "BENCH_SPEC": "0", "BENCH_QUANT": "int8",
      "BENCH_MODEL": "llama3-8b", "BENCH_CLIENTS": "8"},
