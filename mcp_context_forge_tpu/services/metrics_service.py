@@ -86,10 +86,15 @@ class MetricsMaintenanceService:
     async def hourly_summary(self, entity_id: str | None = None,
                              hours: int = 24) -> list[dict[str, Any]]:
         cutoff_hour = int(time.time() / 3600) - hours
+        # calls/avg_ms are the presentation names the admin tables show
+        # (raw rollup rows carry count/total_ms); count >= 1 by
+        # construction (COUNT(*) over grouped rows)
+        select = ("SELECT *, count AS calls,"
+                  " ROUND(total_ms * 1.0 / count, 2) AS avg_ms"
+                  " FROM metrics_rollups")
         if entity_id:
             return await self.ctx.db.fetchall(
-                "SELECT * FROM metrics_rollups WHERE entity_id=? AND hour>=?"
-                " ORDER BY hour", (entity_id, cutoff_hour))
+                f"{select} WHERE entity_id=? AND hour>=? ORDER BY hour",
+                (entity_id, cutoff_hour))
         return await self.ctx.db.fetchall(
-            "SELECT * FROM metrics_rollups WHERE hour>=? ORDER BY hour",
-            (cutoff_hour,))
+            f"{select} WHERE hour>=? ORDER BY hour", (cutoff_hour,))

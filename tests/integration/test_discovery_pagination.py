@@ -203,3 +203,23 @@ async def test_per_entity_metrics_and_rollups():
         assert {"resource", "prompt"} <= types
     finally:
         await gateway.close()
+
+
+async def test_rollup_rows_carry_presentation_fields():
+    """hourly_summary enriches raw rollup rows with calls/avg_ms — the
+    admin rollups table and dashboard consume those names."""
+    gateway = await make_client()
+    try:
+        db = gateway.app["ctx"].db
+        await db.execute(
+            "INSERT INTO tool_metrics (tool_id, ts, duration_ms, success)"
+            " VALUES ('t1', strftime('%s','now'), 10.0, 1),"
+            " ('t1', strftime('%s','now'), 30.0, 0)")
+        await gateway.post("/metrics/rollup", auth=AUTH)
+        rows = await (await gateway.get("/metrics/rollups", auth=AUTH)).json()
+        row = next(r for r in rows if r["entity_id"] == "t1")
+        assert row["calls"] == 2
+        assert row["avg_ms"] == 20.0
+        assert row["errors"] == 1
+    finally:
+        await gateway.close()
