@@ -9,11 +9,15 @@ from dataclasses import dataclass
 class LlamaConfig:
     """Geometry for the GQA+RoPE+SwiGLU decoder family.
 
-    One trunk covers Llama-3, Mistral (v0.3+, no sliding window), and
-    Qwen2 — the two family knobs are ``attn_bias`` (Qwen2 adds biases to
-    the q/k/v projections) and ``tie_embeddings`` (Qwen2-0.5B and
-    Llama-3.2-1B reuse the embedding matrix as the LM head; their HF
-    checkpoints ship no ``lm_head.weight``)."""
+    One trunk covers Llama-3, Mistral (v0.3+, no sliding window), Qwen2
+    and Gemma. Family knobs: ``attn_bias`` (Qwen2 q/k/v projection
+    biases), ``tie_embeddings`` (Qwen2-0.5B, Llama-3.2-1B, Gemma — no
+    ``lm_head.weight`` in the HF checkpoint), ``head_dim_override``
+    (Gemma decouples head_dim from dim//n_heads: 2B uses 256-wide heads
+    on a 2048 model dim), ``hidden_act`` (Gemma gates with tanh-approx
+    GeLU instead of SiLU), ``embed_scale`` (Gemma multiplies embeddings
+    by sqrt(dim)), and ``norm_plus_one`` (Gemma RMSNorm scales by
+    ``1 + weight`` — HF stores the weight zero-centered)."""
 
     name: str
     vocab_size: int
@@ -27,10 +31,18 @@ class LlamaConfig:
     max_seq_len: int = 8192
     attn_bias: bool = False
     tie_embeddings: bool = False
+    head_dim_override: int | None = None
+    hidden_act: str = "silu"      # silu | gelu (tanh approximation)
+    embed_scale: bool = False     # multiply embeddings by sqrt(dim)
+    norm_plus_one: bool = False   # RMSNorm scales by (1 + weight)
 
     @property
     def head_dim(self) -> int:
-        return self.dim // self.n_heads
+        return self.head_dim_override or self.dim // self.n_heads
+
+    @property
+    def embed_multiplier(self) -> float:
+        return float(self.dim) ** 0.5 if self.embed_scale else 1.0
 
 
 MODEL_CONFIGS: dict[str, LlamaConfig] = {
@@ -56,6 +68,14 @@ MODEL_CONFIGS: dict[str, LlamaConfig] = {
         name="qwen2-7b", vocab_size=152_064, dim=3584, n_layers=28,
         n_heads=28, n_kv_heads=4, ffn_hidden=18_944, rope_theta=1_000_000.0,
         norm_eps=1e-6, max_seq_len=32_768, attn_bias=True),
+    # Gemma-2B: MQA (1 kv head), 256-wide heads decoupled from dim,
+    # GeGLU, sqrt(dim)-scaled embeddings, (1+w) RMSNorm, tied head
+    "gemma-2b": LlamaConfig(
+        name="gemma-2b", vocab_size=256_000, dim=2048, n_layers=18,
+        n_heads=8, n_kv_heads=1, ffn_hidden=16_384, rope_theta=10_000.0,
+        norm_eps=1e-6, max_seq_len=8192, tie_embeddings=True,
+        head_dim_override=256, hidden_act="gelu", embed_scale=True,
+        norm_plus_one=True),
     # Qwen2-0.5B (QKV biases + tied embeddings)
     "qwen2-0.5b": LlamaConfig(
         name="qwen2-0.5b", vocab_size=151_936, dim=896, n_layers=24,
@@ -74,6 +94,15 @@ MODEL_CONFIGS: dict[str, LlamaConfig] = {
     "llama3-test": LlamaConfig(
         name="llama3-test", vocab_size=512, dim=64, n_layers=2,
         n_heads=4, n_kv_heads=2, ffn_hidden=128, max_seq_len=512),
+    # gemma geometry at CI scale: every family knob exercised (MQA,
+    # decoupled 32-wide heads on a 64 model dim, GeGLU, scaled embeds,
+    # (1+w) norms, tied head)
+    "gemma-test": LlamaConfig(
+        name="gemma-test", vocab_size=512, dim=64, n_layers=2,
+        n_heads=4, n_kv_heads=1, ffn_hidden=128, rope_theta=10_000.0,
+        norm_eps=1e-6, max_seq_len=512, tie_embeddings=True,
+        head_dim_override=32, hidden_act="gelu", embed_scale=True,
+        norm_plus_one=True),
 }
 
 

@@ -30,11 +30,15 @@ from ..quantize import embed_rows, qmm, qmm_t
 
 # ------------------------------------------------------------------ building blocks
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float,
+             plus_one: bool = False) -> jax.Array:
+    """``plus_one``: Gemma checkpoints store zero-centered norm weights
+    and scale by (1 + w) — static at trace time."""
     orig_dtype = x.dtype
     x = x.astype(jnp.float32)
     normed = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
-    return (normed * weight).astype(orig_dtype)
+    scale = weight + 1.0 if plus_one else weight
+    return (normed * scale).astype(orig_dtype)
 
 
 def rope_freqs(head_dim: int, theta: float) -> jax.Array:
@@ -159,9 +163,12 @@ def _attention_block(layer: dict[str, Any], config: LlamaConfig, x: jax.Array,
     return q, k, v
 
 
-def _ffn(layer: dict[str, Any], x: jax.Array) -> jax.Array:
-    return qmm(jax.nn.silu(qmm(x, layer["w1"])) * qmm(x, layer["w3"]),
-               layer["w2"])
+def _ffn(layer: dict[str, Any], x: jax.Array,
+         act: str = "silu") -> jax.Array:
+    gate = qmm(x, layer["w1"])
+    gate = (jax.nn.gelu(gate, approximate=True) if act == "gelu"
+            else jax.nn.silu(gate))  # GeGLU (Gemma) vs SwiGLU
+    return qmm(gate * qmm(x, layer["w3"]), layer["w2"])
 
 
 def prefill(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
@@ -180,19 +187,19 @@ def prefill(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
     of logits on a 16 GB chip). Training/tests omit it for full logits.
     Returns (logits [B, S, vocab] or [B, vocab] fp32, updated kv state).
     """
-    x = embed_rows(params["embed"], tokens)  # [B,S,D]
+    x = embed_rows(params["embed"], tokens, config.embed_multiplier)  # [B,S,D]
     mask_valid = positions >= 0  # padding has position -1
     safe_positions = jnp.maximum(positions, 0)
     for idx, layer in enumerate(params["layers"]):
-        h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        h = rms_norm(x, layer["attn_norm"], config.norm_eps, config.norm_plus_one)
         q, k, v = _attention_block(layer, config, h, safe_positions)
         kv = write_prefill_kv(kv, idx, k, v, slot_ids, safe_positions, mask_valid)
         attn = causal_attention(q, k, v, mask_valid, impl=attn_impl,
                                 mesh=mesh)  # [B,S,H,hd]
         x = x + qmm(attn.reshape(*attn.shape[:2], -1), layer["wo"])
-        h = rms_norm(x, layer["ffn_norm"], config.norm_eps)
-        x = x + _ffn(layer, h)
-    x = rms_norm(x, params["final_norm"], config.norm_eps)
+        h = rms_norm(x, layer["ffn_norm"], config.norm_eps, config.norm_plus_one)
+        x = x + _ffn(layer, h, config.hidden_act)
+    x = rms_norm(x, params["final_norm"], config.norm_eps, config.norm_plus_one)
     if last_idx is not None:
         x = x[jnp.arange(x.shape[0]), last_idx]  # [B, D] before the lm head
     logits = lm_logits(params, x)
@@ -221,7 +228,7 @@ def prefill_with_history(params: dict[str, Any], config: LlamaConfig,
     costing MORE than the dense prefill it was meant to save.
     Returns (logits [B,S,V] fp32, kv)."""
     B, S = tokens.shape
-    x = embed_rows(params["embed"], tokens)
+    x = embed_rows(params["embed"], tokens, config.embed_multiplier)
     mask_valid = positions >= 0
     safe_positions = jnp.maximum(positions, 0)
     G = config.n_heads // config.n_kv_heads
@@ -235,7 +242,7 @@ def prefill_with_history(params: dict[str, Any], config: LlamaConfig,
     tile = _history_tile(S, G)
     use_pallas = _use_pallas_paged(config, kv) and tile * G <= 2048
     for idx, layer in enumerate(params["layers"]):
-        h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        h = rms_norm(x, layer["attn_norm"], config.norm_eps, config.norm_plus_one)
         q, k, v = _attention_block(layer, config, h, safe_positions)
         kv = write_prefill_kv(kv, idx, k, v, slot_ids, safe_positions,
                               mask_valid)
@@ -264,9 +271,9 @@ def prefill_with_history(params: dict[str, Any], config: LlamaConfig,
             tiles.append(at)
         attn = tiles[0] if len(tiles) == 1 else jnp.concatenate(tiles, axis=1)
         x = x + qmm(attn.reshape(B, S, -1), layer["wo"])
-        h = rms_norm(x, layer["ffn_norm"], config.norm_eps)
-        x = x + _ffn(layer, h)
-    x = rms_norm(x, params["final_norm"], config.norm_eps)
+        h = rms_norm(x, layer["ffn_norm"], config.norm_eps, config.norm_plus_one)
+        x = x + _ffn(layer, h, config.hidden_act)
+    x = rms_norm(x, params["final_norm"], config.norm_eps, config.norm_plus_one)
     if last_idx is not None:  # serving: one next-token row per request
         x = x[jnp.arange(B), last_idx]
     logits = lm_logits(params, x)
@@ -325,11 +332,11 @@ def decode_step(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
     decode). Returns (logits [B,V], kv).
     """
     B = tokens.shape[0]
-    x = embed_rows(params["embed"], tokens)[:, None, :]  # [B,1,D]
+    x = embed_rows(params["embed"], tokens, config.embed_multiplier)[:, None, :]  # [B,1,D]
     pos = positions[:, None]                 # [B,1]
     use_pallas = _use_pallas_paged(config, kv)
     for idx, layer in enumerate(params["layers"]):
-        h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        h = rms_norm(x, layer["attn_norm"], config.norm_eps, config.norm_plus_one)
         q, k, v = _attention_block(layer, config, h, pos)
         kv = write_decode_kv(kv, idx, k[:, 0], v[:, 0], slot_ids, positions,
                              valid=write_mask)
@@ -349,9 +356,9 @@ def decode_step(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
             keys, values = gather_kv(kv, idx, slot_ids, ctx_pages)
             attn = _paged_decode_attention(q[:, 0], keys, values, seq_lens, config)
         x = x + qmm(attn.reshape(B, 1, -1), layer["wo"])
-        h = rms_norm(x, layer["ffn_norm"], config.norm_eps)
-        x = x + _ffn(layer, h)
-    x = rms_norm(x, params["final_norm"], config.norm_eps)
+        h = rms_norm(x, layer["ffn_norm"], config.norm_eps, config.norm_plus_one)
+        x = x + _ffn(layer, h, config.hidden_act)
+    x = rms_norm(x, params["final_norm"], config.norm_eps, config.norm_plus_one)
     logits = lm_logits(params, x[:, 0])
     return logits, kv
 

@@ -57,12 +57,12 @@ def stack_layers(params: dict[str, Any], n_stages: int) -> dict[str, Any]:
 
 def _layer_forward(layer: dict[str, Any], config: LlamaConfig, x: jax.Array,
                    positions: jax.Array) -> jax.Array:
-    h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+    h = rms_norm(x, layer["attn_norm"], config.norm_eps, config.norm_plus_one)
     q, k, v = _attention_block(layer, config, h, positions)
     attn = causal_attention(q, k, v, impl="reference")
     x = x + attn.reshape(*attn.shape[:2], -1) @ layer["wo"]
-    h = rms_norm(x, layer["ffn_norm"], config.norm_eps)
-    return x + _ffn(layer, h)
+    h = rms_norm(x, layer["ffn_norm"], config.norm_eps, config.norm_plus_one)
+    return x + _ffn(layer, h, config.hidden_act)
 
 
 def _stage_forward(stage_layers: dict[str, Any], config: LlamaConfig,
@@ -169,11 +169,13 @@ def build_pp_forward(mesh: Mesh, config: LlamaConfig, n_stages: int,
                              " microbatches")
         mb = B // microbatches
         x = stacked["embed"][tokens]                      # [B, S, D]
+        if config.embed_multiplier != 1.0:  # Gemma sqrt(dim) scaling
+            x = x * jnp.asarray(config.embed_multiplier, dtype=x.dtype)
         x_mb = x.reshape(microbatches, mb, S, -1)
         pos_mb = positions[:mb]                           # identical rows
         out = body(stacked["stages"], x_mb, pos_mb)       # [M, mb, S, D]
         x = out.reshape(B, S, -1)
-        x = rms_norm(x, stacked["final_norm"], config.norm_eps)
+        x = rms_norm(x, stacked["final_norm"], config.norm_eps, config.norm_plus_one)
         head = (stacked["lm_head"].T if config.tie_embeddings
                 else stacked["lm_head"])
         return (x @ head).astype(jnp.float32)

@@ -102,13 +102,19 @@ def qmm_t(x: jax.Array, w: Any) -> jax.Array:
     return (x @ w["q"].T.astype(x.dtype)) * w["s"].astype(x.dtype)
 
 
-def embed_rows(embed: Any, tokens: jax.Array) -> jax.Array:
+def embed_rows(embed: Any, tokens: jax.Array,
+               multiplier: float = 1.0) -> jax.Array:
     """Embedding gather for a plain or per-row-quantized table; quantized
-    tables come back in the scale's dtype (the engine's compute dtype)."""
+    tables come back in the scale's dtype (the engine's compute dtype).
+    ``multiplier``: Gemma scales embeddings by sqrt(dim) (static)."""
     if not is_quant(embed):
-        return embed[tokens]
-    s = embed["s"]
-    return embed["q"][tokens].astype(s.dtype) * s[tokens][..., None]
+        rows = embed[tokens]
+    else:
+        s = embed["s"]
+        rows = embed["q"][tokens].astype(s.dtype) * s[tokens][..., None]
+    if multiplier != 1.0:
+        rows = rows * jnp.asarray(multiplier, dtype=rows.dtype)
+    return rows
 
 
 def param_bytes(tree: Any) -> int:

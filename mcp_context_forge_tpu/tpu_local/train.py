@@ -30,14 +30,16 @@ def forward_logits(params: dict[str, Any], config: LlamaConfig,
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
     x = params["embed"][tokens]
+    if config.embed_multiplier != 1.0:  # Gemma sqrt(dim) scaling
+        x = x * jnp.asarray(config.embed_multiplier, dtype=x.dtype)
     for layer in params["layers"]:
-        h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        h = rms_norm(x, layer["attn_norm"], config.norm_eps, config.norm_plus_one)
         q, k, v = _attention_block(layer, config, h, positions)
         attn = causal_attention(q, k, v, impl=attn_impl)
         x = x + attn.reshape(B, S, -1) @ layer["wo"]
-        h = rms_norm(x, layer["ffn_norm"], config.norm_eps)
-        x = x + _ffn(layer, h)
-    x = rms_norm(x, params["final_norm"], config.norm_eps)
+        h = rms_norm(x, layer["ffn_norm"], config.norm_eps, config.norm_plus_one)
+        x = x + _ffn(layer, h, config.hidden_act)
+    x = rms_norm(x, params["final_norm"], config.norm_eps, config.norm_plus_one)
     return lm_logits(params, x)
 
 

@@ -143,3 +143,29 @@ def test_hf_qwen2_roundtrip_with_bias_and_tied_head(tmp_path):
     assert len(flat_orig) == len(flat_loaded)
     for a, b in zip(flat_orig, flat_loaded):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_hf_gemma_roundtrip_decoupled_head_dim(tmp_path):
+    """Gemma-family checkpoint: projections sized by the decoupled
+    head_dim (q [H*256, dim] in HF layout) map through the same key
+    table; tied head + zero-centered norm weights load verbatim (the
+    +1 shift is a runtime knob, not a load transform)."""
+    config = MODEL_CONFIGS["gemma-test"]
+    params = init_params(config, jax.random.PRNGKey(7), dtype=jnp.float32)
+    for layer in params["layers"]:  # zero-centered norms, like HF gemma
+        layer["attn_norm"] = layer["attn_norm"] - 1.0 + 0.01
+        layer["ffn_norm"] = layer["ffn_norm"] - 1.0 - 0.02
+    ckpt = str(tmp_path / "hf-gemma")
+    _write_hf_checkpoint(ckpt, params)
+
+    mesh = make_mesh("")
+    with mesh:
+        shardings = param_specs(params_logical(config), mesh)
+        loaded = load_params(ckpt, config, shardings, jnp.float32)
+
+    flat_orig = jax.tree_util.tree_leaves(params)
+    flat_loaded = jax.tree_util.tree_leaves(loaded)
+    assert len(flat_orig) == len(flat_loaded)
+    for a, b in zip(flat_orig, flat_loaded):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert loaded["layers"][0]["wq"].shape == (64, 128)  # dim x H*hd(32)
