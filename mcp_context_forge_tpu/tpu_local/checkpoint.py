@@ -79,8 +79,12 @@ def _hf_key_map(config: LlamaConfig) -> dict[str, tuple]:
     """HF name -> (our path, transpose?).
 
     Covers the whole config family: Llama-3 / Mistral (no extras), Qwen2
-    (q/k/v ``.bias`` tensors), and tied-embedding models whose checkpoints
-    ship no ``lm_head.weight`` (Llama-3.2-1B, Qwen2-0.5B)."""
+    (q/k/v ``.bias`` tensors), Gemma (same names, decoupled shapes),
+    tied-embedding models whose checkpoints ship no ``lm_head.weight``
+    (Llama-3.2-1B, Qwen2-0.5B, Gemma), and Mixtral MoE layers (per-expert
+    ``block_sparse_moe.experts.M.w{1,2,3}`` tensors STACK into the
+    [E, ...] expert arrays — entries carry an expert index as a third
+    element; ``w1``/``w3`` are [D,F] after transpose, ``w2`` [F,D])."""
     mapping: dict[str, tuple] = {
         "model.embed_tokens.weight": (("embed",), False),
         "model.norm.weight": (("final_norm",), False),
@@ -96,10 +100,23 @@ def _hf_key_map(config: LlamaConfig) -> dict[str, tuple]:
             prefix + "self_attn.v_proj.weight": (("layers", i, "wv"), True),
             prefix + "self_attn.o_proj.weight": (("layers", i, "wo"), True),
             prefix + "post_attention_layernorm.weight": (("layers", i, "ffn_norm"), False),
-            prefix + "mlp.gate_proj.weight": (("layers", i, "w1"), True),
-            prefix + "mlp.up_proj.weight": (("layers", i, "w3"), True),
-            prefix + "mlp.down_proj.weight": (("layers", i, "w2"), True),
         })
+        if config.n_experts:
+            mapping[prefix + "block_sparse_moe.gate.weight"] = (
+                ("layers", i, "router"), True)
+            for m in range(config.n_experts):
+                eprefix = prefix + f"block_sparse_moe.experts.{m}."
+                mapping.update({
+                    eprefix + "w1.weight": (("layers", i, "w1"), True, m),
+                    eprefix + "w3.weight": (("layers", i, "w3"), True, m),
+                    eprefix + "w2.weight": (("layers", i, "w2"), True, m),
+                })
+        else:
+            mapping.update({
+                prefix + "mlp.gate_proj.weight": (("layers", i, "w1"), True),
+                prefix + "mlp.up_proj.weight": (("layers", i, "w3"), True),
+                prefix + "mlp.down_proj.weight": (("layers", i, "w2"), True),
+            })
         if config.attn_bias:
             mapping.update({
                 prefix + "self_attn.q_proj.bias": (("layers", i, "bq"), False),
@@ -137,23 +154,40 @@ def load_hf_llama(path: str, config: LlamaConfig, shardings, dtype,
     params = jax.tree.map(lambda leaf: None, skeleton,
                           is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
     mapping = _hf_key_map(config)
+    # per-expert tensors accumulate host-side until the stack is complete
+    staged: dict[tuple, list] = {}
+
+    def handle(key, tensor):
+        entry = mapping.get(key)
+        if entry is None:
+            return
+        tree_path, transpose = entry[0], entry[1]
+        if len(entry) == 3:                      # expert slice: stage it
+            slices = staged.setdefault(tree_path,
+                                       [None] * config.n_experts)
+            array = np.asarray(tensor)
+            slices[entry[2]] = array.T if transpose else array
+            if all(s is not None for s in slices):
+                _place(params, tree_path, np.stack(slices), False,
+                       shardings, dtype)
+                del staged[tree_path]
+            return
+        _place(params, tree_path, tensor, transpose, shardings, dtype)
+
     files = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
     for fname in files:
         full = os.path.join(path, fname)
         if safe_open is not None:
             with safe_open(full, framework="numpy") as reader:
                 for key in reader.keys():
-                    if key not in mapping:
-                        continue
-                    tree_path, transpose = mapping[key]
-                    tensor = reader.get_tensor(key)
-                    _place(params, tree_path, tensor, transpose, shardings, dtype)
+                    handle(key, reader.get_tensor(key))
         else:
             for key, tensor in _read_safetensors(full).items():
-                if key not in mapping:
-                    continue
-                tree_path, transpose = mapping[key]
-                _place(params, tree_path, tensor, transpose, shardings, dtype)
+                handle(key, tensor)
+    if staged:
+        raise ValueError(
+            f"Checkpoint has incomplete expert stacks for: "
+            f"{sorted(staged)[:3]}…")
     missing = [p for p, v in _walk(params) if v is None]
     if missing:
         raise ValueError(f"Checkpoint missing tensors for: {missing[:5]}…")

@@ -35,6 +35,11 @@ class LlamaConfig:
     hidden_act: str = "silu"      # silu | gelu (tanh approximation)
     embed_scale: bool = False     # multiply embeddings by sqrt(dim)
     norm_plus_one: bool = False   # RMSNorm scales by (1 + weight)
+    # MoE (Mixtral family): n_experts > 0 replaces the dense FFN with a
+    # top-k routed expert FFN (drop-free expert scan in the serving
+    # trunk; parallel/moe.py capacity dispatch for EP training fleets)
+    n_experts: int = 0
+    moe_top_k: int = 2
 
     @property
     def head_dim(self) -> int:
@@ -68,6 +73,12 @@ MODEL_CONFIGS: dict[str, LlamaConfig] = {
         name="qwen2-7b", vocab_size=152_064, dim=3584, n_layers=28,
         n_heads=28, n_kv_heads=4, ffn_hidden=18_944, rope_theta=1_000_000.0,
         norm_eps=1e-6, max_seq_len=32_768, attn_bias=True),
+    # Mixtral-8x7B: Mistral trunk + 8-expert top-2 MoE FFN
+    "mixtral-8x7b": LlamaConfig(
+        name="mixtral-8x7b", vocab_size=32_000, dim=4096, n_layers=32,
+        n_heads=32, n_kv_heads=8, ffn_hidden=14_336,
+        rope_theta=1_000_000.0, max_seq_len=32_768, n_experts=8,
+        moe_top_k=2),
     # Gemma-2B: MQA (1 kv head), 256-wide heads decoupled from dim,
     # GeGLU, sqrt(dim)-scaled embeddings, (1+w) RMSNorm, tied head
     "gemma-2b": LlamaConfig(
@@ -97,6 +108,11 @@ MODEL_CONFIGS: dict[str, LlamaConfig] = {
     # gemma geometry at CI scale: every family knob exercised (MQA,
     # decoupled 32-wide heads on a 64 model dim, GeGLU, scaled embeds,
     # (1+w) norms, tied head)
+    # mixtral geometry at CI scale (4 experts, top-2)
+    "mixtral-test": LlamaConfig(
+        name="mixtral-test", vocab_size=512, dim=64, n_layers=2,
+        n_heads=4, n_kv_heads=2, ffn_hidden=96, max_seq_len=512,
+        n_experts=4, moe_top_k=2),
     "gemma-test": LlamaConfig(
         name="gemma-test", vocab_size=512, dim=64, n_layers=2,
         n_heads=4, n_kv_heads=1, ffn_hidden=128, rope_theta=10_000.0,

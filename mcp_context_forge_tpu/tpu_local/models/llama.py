@@ -77,10 +77,24 @@ def init_params(config: LlamaConfig, key: jax.Array,
             "wv": dense(k[2], (config.dim, config.n_kv_heads * hd), config.dim),
             "wo": dense(k[3], (config.n_heads * hd, config.dim), config.n_heads * hd),
             "ffn_norm": jnp.ones((config.dim,), dtype=jnp.float32),
-            "w1": dense(k[4], (config.dim, config.ffn_hidden), config.dim),
-            "w3": dense(k[5], (config.dim, config.ffn_hidden), config.dim),
-            "w2": dense(k[6], (config.ffn_hidden, config.dim), config.ffn_hidden),
         }
+        if config.n_experts:  # Mixtral: stacked expert FFN + router
+            ek = jax.random.split(k[4], 3)
+            E = config.n_experts
+            layer["router"] = dense(k[5], (config.dim, E), config.dim)
+            layer["w1"] = dense(ek[0], (E, config.dim, config.ffn_hidden),
+                                config.dim)
+            layer["w3"] = dense(ek[1], (E, config.dim, config.ffn_hidden),
+                                config.dim)
+            layer["w2"] = dense(ek[2], (E, config.ffn_hidden, config.dim),
+                                config.ffn_hidden)
+        else:
+            layer["w1"] = dense(k[4], (config.dim, config.ffn_hidden),
+                                config.dim)
+            layer["w3"] = dense(k[5], (config.dim, config.ffn_hidden),
+                                config.dim)
+            layer["w2"] = dense(k[6], (config.ffn_hidden, config.dim),
+                                config.ffn_hidden)
         if config.attn_bias:  # Qwen2-style q/k/v projection biases
             layer["bq"] = jnp.zeros((config.n_heads * hd,), dtype=dtype)
             layer["bk"] = jnp.zeros((config.n_kv_heads * hd,), dtype=dtype)
@@ -104,8 +118,12 @@ def params_logical(config: LlamaConfig) -> dict[str, Any]:
         "wq": "attn_qkv", "wk": "attn_qkv", "wv": "attn_qkv",
         "wo": "attn_out",
         "ffn_norm": "replicated",
-        "w1": "ffn_up", "w3": "ffn_up", "w2": "ffn_down",
     }
+    if config.n_experts:
+        layer.update({"router": "replicated", "w1": "moe_up",
+                      "w3": "moe_up", "w2": "moe_down"})
+    else:
+        layer.update({"w1": "ffn_up", "w3": "ffn_up", "w2": "ffn_down"})
     if config.attn_bias:
         layer.update({"bq": "replicated", "bk": "replicated",
                       "bv": "replicated"})
@@ -121,9 +139,14 @@ def params_logical(config: LlamaConfig) -> dict[str, Any]:
 
 def param_count(config: LlamaConfig) -> int:
     hd = config.head_dim
+    if config.n_experts:
+        ffn = (config.n_experts * 3 * config.dim * config.ffn_hidden
+               + config.dim * config.n_experts)   # experts + router
+    else:
+        ffn = 3 * config.dim * config.ffn_hidden
     per_layer = (config.dim * (config.n_heads + 2 * config.n_kv_heads) * hd
                  + config.n_heads * hd * config.dim
-                 + 3 * config.dim * config.ffn_hidden + 2 * config.dim)
+                 + ffn + 2 * config.dim)
     if config.attn_bias:
         per_layer += (config.n_heads + 2 * config.n_kv_heads) * hd
     embeddings = config.vocab_size * config.dim * (
@@ -171,6 +194,31 @@ def _ffn(layer: dict[str, Any], x: jax.Array,
     return qmm(gate * qmm(x, layer["w3"]), layer["w2"])
 
 
+def _ffn_block(layer: dict[str, Any], config: LlamaConfig,
+               x: jax.Array) -> jax.Array:
+    """Dense SwiGLU/GeGLU, or top-k routed MoE when the layer carries a
+    router (Mixtral family).
+
+    The SERVING trunk runs the drop-free expert-scan formulation
+    (parallel/moe.py moe_ffn_dense_mask): capacity drops make a layer's
+    output a function of the BATCH SHAPE — a token dropped in an
+    11-token prefill but kept in a 1-token decode would break the
+    incremental-decode invariant (prefill + decode must equal one long
+    prefill). EP fleets with an 'expert' mesh axis use moe_ffn's
+    capacity dispatch instead (all_to_all lowering, Switch drop
+    policy)."""
+    if "router" in layer:
+        from ..parallel.moe import MoEConfig, moe_ffn_dense_mask
+
+        moe_cfg = MoEConfig(dim=config.dim, n_experts=config.n_experts,
+                            expert_hidden=config.ffn_hidden,
+                            top_k=config.moe_top_k)
+        return moe_ffn_dense_mask(
+            {k: layer[k] for k in ("router", "w1", "w3", "w2")}, x,
+            moe_cfg, act=config.hidden_act)
+    return _ffn(layer, x, config.hidden_act)
+
+
 def prefill(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
             positions: jax.Array, kv: PagedKVState, slot_ids: jax.Array,
             attn_impl: str = "auto", mesh=None,
@@ -198,7 +246,7 @@ def prefill(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
                                 mesh=mesh)  # [B,S,H,hd]
         x = x + qmm(attn.reshape(*attn.shape[:2], -1), layer["wo"])
         h = rms_norm(x, layer["ffn_norm"], config.norm_eps, config.norm_plus_one)
-        x = x + _ffn(layer, h, config.hidden_act)
+        x = x + _ffn_block(layer, config, h)
     x = rms_norm(x, params["final_norm"], config.norm_eps, config.norm_plus_one)
     if last_idx is not None:
         x = x[jnp.arange(x.shape[0]), last_idx]  # [B, D] before the lm head
@@ -272,7 +320,7 @@ def prefill_with_history(params: dict[str, Any], config: LlamaConfig,
         attn = tiles[0] if len(tiles) == 1 else jnp.concatenate(tiles, axis=1)
         x = x + qmm(attn.reshape(B, S, -1), layer["wo"])
         h = rms_norm(x, layer["ffn_norm"], config.norm_eps, config.norm_plus_one)
-        x = x + _ffn(layer, h, config.hidden_act)
+        x = x + _ffn_block(layer, config, h)
     x = rms_norm(x, params["final_norm"], config.norm_eps, config.norm_plus_one)
     if last_idx is not None:  # serving: one next-token row per request
         x = x[jnp.arange(B), last_idx]
@@ -357,7 +405,7 @@ def decode_step(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
             attn = _paged_decode_attention(q[:, 0], keys, values, seq_lens, config)
         x = x + qmm(attn.reshape(B, 1, -1), layer["wo"])
         h = rms_norm(x, layer["ffn_norm"], config.norm_eps, config.norm_plus_one)
-        x = x + _ffn(layer, h, config.hidden_act)
+        x = x + _ffn_block(layer, config, h)
     x = rms_norm(x, params["final_norm"], config.norm_eps, config.norm_plus_one)
     logits = lm_logits(params, x[:, 0])
     return logits, kv

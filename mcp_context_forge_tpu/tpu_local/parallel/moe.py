@@ -134,6 +134,52 @@ def moe_ffn(params: dict[str, Any], x: jax.Array, config: MoEConfig,
     return out.reshape(B, S, D)
 
 
+def moe_ffn_dense_mask(params: dict[str, Any], x: jax.Array,
+                       config: MoEConfig, act: str = "silu") -> jax.Array:
+    """Drop-free routed FFN as a scan over EXPERTS with gate masks.
+
+    The serving formulation: every expert runs over all T tokens and the
+    top-k gate mask zeroes the rest. Per-token output is EXACTLY the
+    reference function (no capacity drops), so it is invariant to batch
+    shape — the property continuous batching needs (prefill + decode must
+    equal one long prefill; capacity dispatch violates it whenever a
+    batch-dependent drop occurs). Costs E/k x the ideal FFN FLOPs and
+    O(T*F) transient memory per expert step (vs the [T,E,C] dispatch
+    tensors of ``moe_ffn``, quadratic in T when run drop-free).
+    Quantized expert stacks work unchanged: the scan slices the [E,...]
+    int8/scale leaves into the 2D shapes ``qmm`` handles.
+    """
+    from ..quantize import qmm
+
+    B, S, D = x.shape
+    flat = x.reshape(-1, D)
+    logits = (qmm(flat, params["router"])
+              if isinstance(params["router"], dict)
+              else flat @ params["router"]).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                   # [T, E]
+    _, top_idx = jax.lax.top_k(logits, config.top_k)
+    one_hot = jax.nn.one_hot(top_idx, config.n_experts,
+                             dtype=jnp.float32)               # [T, k, E]
+    keep = jnp.sum(one_hot, axis=1)                           # [T, E]
+    gates = probs * keep
+    gates = gates / jnp.maximum(
+        jnp.sum(gates, axis=-1, keepdims=True), 1e-9)         # renormalized
+    gates = gates.astype(x.dtype)
+
+    def one_expert(acc, weights):
+        w1, w3, w2, gate_col = weights                        # gate_col [T]
+        h = qmm(flat, w1)
+        h = (jax.nn.gelu(h, approximate=True) if act == "gelu"
+             else jax.nn.silu(h))
+        h = qmm(h * qmm(flat, w3), w2)                        # [T, D]
+        return acc + gate_col[:, None] * h, None
+
+    out, _ = jax.lax.scan(
+        one_expert, jnp.zeros_like(flat),
+        (params["w1"], params["w3"], params["w2"], gates.T))
+    return out.reshape(B, S, D)
+
+
 def moe_ffn_reference(params: dict[str, Any], x: jax.Array,
                       config: MoEConfig) -> jax.Array:
     """Dense per-token loop over selected experts (no capacity drops) —

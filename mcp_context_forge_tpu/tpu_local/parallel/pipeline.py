@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.configs import LlamaConfig
-from ..models.llama import _attention_block, _ffn, rms_norm
+from ..models.llama import _attention_block, _ffn_block, rms_norm
 from ..ops.attention import causal_attention
 
 
@@ -62,7 +62,7 @@ def _layer_forward(layer: dict[str, Any], config: LlamaConfig, x: jax.Array,
     attn = causal_attention(q, k, v, impl="reference")
     x = x + attn.reshape(*attn.shape[:2], -1) @ layer["wo"]
     h = rms_norm(x, layer["ffn_norm"], config.norm_eps, config.norm_plus_one)
-    return x + _ffn(layer, h, config.hidden_act)
+    return x + _ffn_block(layer, config, h)
 
 
 def _stage_forward(stage_layers: dict[str, Any], config: LlamaConfig,

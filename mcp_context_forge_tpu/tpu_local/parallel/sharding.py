@@ -23,6 +23,13 @@ LOGICAL_RULES: dict[str, P] = {
     "attn_out": P("model", None),         # (heads*hd, dim) row-parallel
     "ffn_up": P(None, "model"),           # (dim, hidden) column-parallel
     "ffn_down": P("model", None),         # (hidden, dim) row-parallel
+    # MoE stacked experts (E, dim, hidden)/(E, hidden, dim): megatron
+    # WITHIN each expert under plain TP (same comms as dense); an
+    # 'expert'-axis mesh shards the stack instead (shard_moe_params)
+    "moe_up": P(None, None, "model"),
+    "moe_down": P(None, "model", None),
+    "scale_moe_model": P(None, "model"),  # [E, hidden] expert-stack scales
+    "scale_moe": P(None, None),           # [E, dim]
     # int8 per-channel scale vectors indexed by a model-sharded axis
     # (quantize.py): shard with the channels they scale
     "scale_model": P("model"),

@@ -41,6 +41,10 @@ _QUANT_RULES: dict[str, tuple[int, str]] = {
     "attn_out": (0, "replicated"),     # (H*hd, dim) row-parallel
     "ffn_up": (0, "scale_model"),      # (dim, hidden) column-parallel
     "ffn_down": (0, "replicated"),     # (hidden, dim) row-parallel
+    # MoE expert stacks quantize per (expert, out-channel): reduce the
+    # middle (contraction) axis of [E, D, F] / [E, F, D]
+    "moe_up": (1, "scale_moe_model"),
+    "moe_down": (1, "scale_moe"),
 }
 
 

@@ -18,7 +18,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .models.configs import LlamaConfig
-from .models.llama import _attention_block, _ffn, lm_logits, rms_norm
+from .models.llama import _attention_block, _ffn_block, lm_logits, rms_norm
 from .ops.attention import causal_attention
 from .parallel.sharding import param_specs
 from .models.llama import params_logical
@@ -38,7 +38,7 @@ def forward_logits(params: dict[str, Any], config: LlamaConfig,
         attn = causal_attention(q, k, v, impl=attn_impl)
         x = x + attn.reshape(B, S, -1) @ layer["wo"]
         h = rms_norm(x, layer["ffn_norm"], config.norm_eps, config.norm_plus_one)
-        x = x + _ffn(layer, h, config.hidden_act)
+        x = x + _ffn_block(layer, config, h)
     x = rms_norm(x, params["final_norm"], config.norm_eps, config.norm_plus_one)
     return lm_logits(params, x)
 

@@ -44,9 +44,17 @@ def _write_hf_checkpoint(path: str, params) -> None:
         tensors[prefix + "self_attn.o_proj.weight"] = t(layer["wo"])
         tensors[prefix + "post_attention_layernorm.weight"] = \
             np.asarray(layer["ffn_norm"])
-        tensors[prefix + "mlp.gate_proj.weight"] = t(layer["w1"])
-        tensors[prefix + "mlp.up_proj.weight"] = t(layer["w3"])
-        tensors[prefix + "mlp.down_proj.weight"] = t(layer["w2"])
+        if "router" in layer:  # mixtral MoE layout: per-expert tensors
+            tensors[prefix + "block_sparse_moe.gate.weight"] = t(layer["router"])
+            for m in range(layer["w1"].shape[0]):
+                eprefix = prefix + f"block_sparse_moe.experts.{m}."
+                tensors[eprefix + "w1.weight"] = t(layer["w1"][m])
+                tensors[eprefix + "w3.weight"] = t(layer["w3"][m])
+                tensors[eprefix + "w2.weight"] = t(layer["w2"][m])
+        else:
+            tensors[prefix + "mlp.gate_proj.weight"] = t(layer["w1"])
+            tensors[prefix + "mlp.up_proj.weight"] = t(layer["w3"])
+            tensors[prefix + "mlp.down_proj.weight"] = t(layer["w2"])
         for bias, hf in (("bq", "q_proj"), ("bk", "k_proj"), ("bv", "v_proj")):
             if bias in layer:  # Qwen2-style attention biases
                 tensors[prefix + f"self_attn.{hf}.bias"] = \
@@ -169,3 +177,26 @@ def test_hf_gemma_roundtrip_decoupled_head_dim(tmp_path):
     for a, b in zip(flat_orig, flat_loaded):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert loaded["layers"][0]["wq"].shape == (64, 128)  # dim x H*hd(32)
+
+
+def test_hf_mixtral_roundtrip_stacks_experts(tmp_path):
+    """Mixtral MoE checkpoint: per-expert block_sparse_moe tensors stack
+    into the [E, ...] arrays, the gate loads as the router, and the
+    loaded tree matches the original leaf-for-leaf."""
+    config = MODEL_CONFIGS["mixtral-test"]
+    params = init_params(config, jax.random.PRNGKey(13), dtype=jnp.float32)
+    ckpt = str(tmp_path / "hf-mixtral")
+    _write_hf_checkpoint(ckpt, params)
+
+    mesh = make_mesh("")
+    with mesh:
+        shardings = param_specs(params_logical(config), mesh)
+        loaded = load_params(ckpt, config, shardings, jnp.float32)
+
+    flat_orig = jax.tree_util.tree_leaves(params)
+    flat_loaded = jax.tree_util.tree_leaves(loaded)
+    assert len(flat_orig) == len(flat_loaded)
+    for a, b in zip(flat_orig, flat_loaded):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert loaded["layers"][0]["w1"].shape == (4, 64, 96)
+    assert loaded["layers"][0]["router"].shape == (64, 4)

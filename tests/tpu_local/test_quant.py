@@ -146,3 +146,36 @@ def test_quantized_hf_checkpoint_load(tmp_path):
             await engine.stop()
 
     assert len(asyncio.run(run())) == 4
+
+
+def test_mixtral_expert_stacks_quantize_and_serve():
+    """MoE expert stacks quantize per (expert, out-channel) and the
+    dense-mask serving path computes through the int8 leaves (the scan
+    slices [E,...] quant dicts into the 2D shapes qmm handles)."""
+    import jax
+    import numpy as np
+
+    from mcp_context_forge_tpu.tpu_local.models import MODEL_CONFIGS
+    from mcp_context_forge_tpu.tpu_local.models.llama import (
+        _ffn_block, init_params, params_logical)
+    from mcp_context_forge_tpu.tpu_local.quantize import quantize_tree
+
+    cfg = MODEL_CONFIGS["mixtral-test"]
+    params = init_params(cfg, jax.random.PRNGKey(29), dtype=jnp.float32)
+    quant = quantize_tree(params, params_logical(cfg),
+                          scale_dtype=jnp.float32)
+    qlayer = quant["layers"][0]
+    assert qlayer["w1"]["q"].dtype == jnp.int8
+    assert qlayer["w1"]["q"].shape == (4, 64, 96)
+    assert qlayer["w1"]["s"].shape == (4, 96)    # per (expert, out-channel)
+    assert qlayer["w2"]["s"].shape == (4, 64)
+
+    x = jax.random.normal(jax.random.PRNGKey(31), (1, 5, cfg.dim),
+                          dtype=jnp.float32)
+    full = _ffn_block(params["layers"][0], cfg, x)
+    quantized = _ffn_block(qlayer, cfg, x)
+    assert quantized.shape == full.shape
+    # int8 is approximate; outputs must correlate strongly with full
+    a, b = np.asarray(full).ravel(), np.asarray(quantized).ravel()
+    corr = np.corrcoef(a, b)[0, 1]
+    assert corr > 0.99, corr
