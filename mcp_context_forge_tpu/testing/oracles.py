@@ -462,6 +462,44 @@ def page_allocator_oracle(mod: types.ModuleType) -> None:
     assert int(np.asarray(table)[3, 0]) == 0
 
 
+def _quantize_moe_and_scale_spec(mod: types.ModuleType) -> None:
+    """MoE expert-stack quant rules + the embed multiplier knob."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    # [E, D, F] stack quantizes per (expert, out-channel): axis 1 reduced
+    w = np.arange(2 * 3 * 4, dtype=np.float32).reshape(2, 3, 4) - 10.0
+    logical = {"w1": "moe_up", "w2": "moe_down", "n": "replicated"}
+    tree = {"w1": w, "w2": np.transpose(w, (0, 2, 1)),
+            "n": np.ones((3,), np.float32)}
+    quant = mod.quantize_tree(tree, logical, scale_dtype=jnp.float32)
+    assert quant["w1"]["q"].shape == (2, 3, 4)
+    assert quant["w1"]["s"].shape == (2, 4)      # axis 1 reduced
+    assert quant["w2"]["s"].shape == (2, 3)
+    np.testing.assert_allclose(
+        np.asarray(quant["w1"]["s"]),
+        np.max(np.abs(w), axis=1) / 127.0, rtol=1e-6)
+    # reconstruction error bounded by one quant step per channel
+    recon = (np.asarray(quant["w1"]["q"], np.float32)
+             * np.asarray(quant["w1"]["s"])[:, None, :])
+    assert np.max(np.abs(recon - w)) <= np.max(np.asarray(quant["w1"]["s"]))
+    # norms (no rule) stay untouched
+    np.testing.assert_array_equal(np.asarray(quant["n"]), tree["n"])
+
+    # embed multiplier: exact scaling, plain AND quantized tables
+    table = np.array([[1.0, -2.0], [0.5, 4.0]], np.float32)
+    tokens = jnp.asarray([1, 0])
+    plain = np.asarray(mod.embed_rows(jnp.asarray(table), tokens, 8.0))
+    np.testing.assert_allclose(plain, table[[1, 0]] * 8.0, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(mod.embed_rows(jnp.asarray(table), tokens)),
+        table[[1, 0]], rtol=1e-6)  # default multiplier is identity
+    qtable = mod.quantize_leaf(table, axis=1)
+    scaled = np.asarray(mod.embed_rows(qtable, tokens, 8.0))
+    unscaled = np.asarray(mod.embed_rows(qtable, tokens))
+    np.testing.assert_allclose(scaled, unscaled * 8.0, rtol=1e-6)
+
+
 # ----------------------------------------------------- avg slot footprint
 
 def _avg_slot_pages_spec(mod: types.ModuleType) -> None:
@@ -686,7 +724,8 @@ TARGETS: dict[str, MutationTarget] = {
         rel_path="tpu_local/quantize.py",
         module_name="mcp_context_forge_tpu.tpu_local.quantize",
         package="mcp_context_forge_tpu.tpu_local",
-        oracle=quantize_oracle,
+        oracle=lambda mod: (quantize_oracle(mod),
+                            _quantize_moe_and_scale_spec(mod)),
     ),
     "page_allocator": MutationTarget(
         rel_path="tpu_local/kv/paged_cache.py",
