@@ -134,6 +134,17 @@ def moe_ffn(params: dict[str, Any], x: jax.Array, config: MoEConfig,
     return out.reshape(B, S, D)
 
 
+def router_probs(router: Any, flat: jax.Array) -> jax.Array:
+    """Router softmax probabilities [T, E]; handles a quantized router
+    (the ONE place routing math lives — the serving FFN and the training
+    aux loss must never drift)."""
+    from ..quantize import qmm
+
+    logits = (qmm(flat, router) if isinstance(router, dict)
+              else flat @ router)
+    return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+
 def moe_ffn_dense_mask(params: dict[str, Any], x: jax.Array,
                        config: MoEConfig, act: str = "silu") -> jax.Array:
     """Drop-free routed FFN as a scan over EXPERTS with gate masks.
@@ -153,11 +164,8 @@ def moe_ffn_dense_mask(params: dict[str, Any], x: jax.Array,
 
     B, S, D = x.shape
     flat = x.reshape(-1, D)
-    logits = (qmm(flat, params["router"])
-              if isinstance(params["router"], dict)
-              else flat @ params["router"]).astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)                   # [T, E]
-    _, top_idx = jax.lax.top_k(logits, config.top_k)
+    probs = router_probs(params["router"], flat)              # [T, E]
+    _, top_idx = jax.lax.top_k(probs, config.top_k)
     one_hot = jax.nn.one_hot(top_idx, config.n_experts,
                              dtype=jnp.float32)               # [T, k, E]
     keep = jnp.sum(one_hot, axis=1)                           # [T, E]

@@ -25,31 +25,61 @@ from .models.llama import params_logical
 
 
 def forward_logits(params: dict[str, Any], config: LlamaConfig,
-                   tokens: jax.Array, attn_impl: str = "reference") -> jax.Array:
-    """Plain forward (no KV cache) for training: tokens [B,S] -> logits fp32."""
+                   tokens: jax.Array, attn_impl: str = "reference",
+                   return_aux: bool = False):
+    """Plain forward (no KV cache) for training: tokens [B,S] -> logits
+    fp32. ``return_aux=True`` also returns the Switch-style router
+    load-balancing loss (E * sum_e f_e * P_e, averaged over MoE layers)
+    computed inside the SAME forward — without it, MoE fine-tuning can
+    collapse routing onto a few experts (nothing else pushes back; the
+    drop-free serving formulation happily computes a collapsed
+    router)."""
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
     x = params["embed"][tokens]
     if config.embed_multiplier != 1.0:  # Gemma sqrt(dim) scaling
         x = x * jnp.asarray(config.embed_multiplier, dtype=x.dtype)
+    aux = jnp.zeros((), jnp.float32)
+    n_moe = 0
     for layer in params["layers"]:
         h = rms_norm(x, layer["attn_norm"], config.norm_eps, config.norm_plus_one)
         q, k, v = _attention_block(layer, config, h, positions)
         attn = causal_attention(q, k, v, impl=attn_impl)
         x = x + attn.reshape(B, S, -1) @ layer["wo"]
         h = rms_norm(x, layer["ffn_norm"], config.norm_eps, config.norm_plus_one)
+        if return_aux and "router" in layer:
+            from .parallel.moe import router_probs
+
+            probs = router_probs(layer["router"],
+                                 h.reshape(-1, config.dim))
+            top1 = jnp.argmax(probs, axis=-1)
+            frac = jnp.mean(
+                jax.nn.one_hot(top1, config.n_experts, dtype=jnp.float32),
+                axis=0)
+            aux = aux + config.n_experts * jnp.sum(frac
+                                                   * jnp.mean(probs, axis=0))
+            n_moe += 1
         x = x + _ffn_block(layer, config, h)
     x = rms_norm(x, params["final_norm"], config.norm_eps, config.norm_plus_one)
-    return lm_logits(params, x)
+    logits = lm_logits(params, x)
+    if return_aux:
+        return logits, aux / jnp.maximum(n_moe, 1)
+    return logits
 
 
 def loss_fn(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
             targets: jax.Array, mask: jax.Array,
-            attn_impl: str = "reference") -> jax.Array:
-    logits = forward_logits(params, config, tokens, attn_impl)
+            attn_impl: str = "reference",
+            moe_aux_weight: float = 0.01) -> jax.Array:
+    if config.n_experts:
+        logits, aux = forward_logits(params, config, tokens, attn_impl,
+                                     return_aux=True)
+    else:
+        logits, aux = forward_logits(params, config, tokens, attn_impl), 0.0
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     picked = jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
-    return -(picked * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    ce = -(picked * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return ce + moe_aux_weight * aux
 
 
 class TrainState(NamedTuple):
