@@ -13,6 +13,7 @@ Values are JSON-serializable objects; ``ttl`` seconds (0 = no expiry).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import time
@@ -77,6 +78,18 @@ class FileKVStore(KVStore):
         os.makedirs(self._dir, exist_ok=True)
 
     def _path(self, key: str) -> str:
+        # collision-free: distinct keys must never share a file (client-
+        # supplied session ids flow in here), so hash rather than sanitize;
+        # a short readable prefix keeps the directory debuggable
+        prefix = "".join(c if c.isalnum() or c in "-_." else "_"
+                         for c in key)[:40]
+        digest = hashlib.sha256(key.encode()).hexdigest()[:24]
+        return os.path.join(self._dir, f"{prefix}.{digest}.json")
+
+    def _legacy_path(self, key: str) -> str:
+        # pre-hash naming: read-only fallback so entries written before
+        # the collision fix (and by older workers sharing bus_dir during
+        # a rolling restart) stay visible
         safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in key)
         return os.path.join(self._dir, safe + ".json")
 
@@ -90,10 +103,15 @@ class FileKVStore(KVStore):
         os.replace(tmp, path)
 
     async def get(self, key: str) -> Any:
-        try:
-            with open(self._path(key)) as fh:
-                payload = json.load(fh)
-        except (FileNotFoundError, json.JSONDecodeError):
+        payload = None
+        for path in (self._path(key), self._legacy_path(key)):
+            try:
+                with open(path) as fh:
+                    payload = json.load(fh)
+                break
+            except (FileNotFoundError, json.JSONDecodeError):
+                continue
+        if payload is None:
             return None
         if payload["expires"] and payload["expires"] <= time.time():
             await self.delete(key)
@@ -101,10 +119,11 @@ class FileKVStore(KVStore):
         return payload["value"]
 
     async def delete(self, key: str) -> None:
-        try:
-            os.unlink(self._path(key))
-        except FileNotFoundError:
-            pass
+        for path in (self._path(key), self._legacy_path(key)):
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
 
     async def purge_expired(self) -> int:
         purged = 0

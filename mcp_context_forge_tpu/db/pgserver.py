@@ -244,6 +244,9 @@ class _Conn:
                 continue
             if mtype == b"Q":
                 self._simple_query(payload[:-1].decode())
+                # simple-protocol errors return the session to idle (real
+                # PG semantics); skip-until-sync is extended-protocol only
+                self._skip_until_sync = False
                 self._ready()
             elif mtype == b"P":                    # Parse
                 parts = payload.split(b"\x00", 2)

@@ -533,13 +533,16 @@ async function detailRow(i){
     `<tr><td><b>${esc(k)}</b></td><td>${cell(v)}</td></tr>`).join("");
   let extra = "";
   if (t.special === "teams"){
-    const members = (full.members || []).map(m =>
+    // server data never lands inside a JS string literal: handlers take
+    // indices and resolve id/email from detailTeam at click time
+    detailTeam = {id: String(id), members: full.members || []};
+    const members = detailTeam.members.map((m, midx) =>
       `<tr><td>${esc(m.user_email||"")}</td><td>${esc(m.role||"")}</td>
-       <td><button class="act danger" onclick="removeMember('${esc(String(id))}','${esc(String(m.user_email||""))}')">remove</button></td></tr>`).join("");
+       <td><button class="act danger" onclick="removeMemberAt(${midx})">remove</button></td></tr>`).join("");
     extra = `<br><b>members</b><table class="kv">${members}</table>
       <input id="m-email" placeholder="email"><input id="m-role" placeholder="role (member)">
-      <button class="act" onclick="addMember('${esc(String(id))}')">add member (/teams/{id}/members)</button>
-      <button class="act" onclick="inviteMember('${esc(String(id))}')">invite (/teams/{id}/invitations)</button>
+      <button class="act" onclick="addMember(detailTeam.id)">add member (/teams/{id}/members)</button>
+      <button class="act" onclick="inviteMember(detailTeam.id)">invite (/teams/{id}/invitations)</button>
       <span id="invite-out" class="kv"></span>`;
   }
   d.innerHTML = `<b>${esc(current)} ${esc(String(id))}</b>
@@ -564,6 +567,11 @@ async function inviteMember(teamId){
     document.getElementById("invite-out").textContent =
       "invitation token: " + (out.token || "");
   } else document.getElementById("status").textContent = "invite failed: " + r.status;
+}
+let detailTeam = null;  // {id, members[]} of the open teams detail pane
+async function removeMemberAt(midx){
+  if (!detailTeam || !detailTeam.members[midx]) return;
+  await removeMember(detailTeam.id, String(detailTeam.members[midx].user_email||""));
 }
 async function removeMember(teamId, email){
   const r = await fetch(`/teams/${encodeURIComponent(teamId)}/members/${encodeURIComponent(email)}`,

@@ -144,6 +144,7 @@ class ChatService:
                 }
                 content_parts: list[str] = []
                 calls_by_index: dict[int, dict[str, Any]] = {}
+                last_idx = 0
                 usage: dict[str, Any] = {}
                 async for chunk in registry.chat_stream(request):
                     usage = chunk.get("usage") or usage
@@ -159,7 +160,18 @@ class ChatService:
                         # (azure/watsonx passthrough streams this way;
                         # tpu_local happens to send whole calls)
                         for frag in delta.get("tool_calls", []):
-                            idx = frag.get("index", len(calls_by_index))
+                            # a continuation fragment missing "index" must
+                            # append to the CURRENT call — but a fragment
+                            # carrying a new id/name IS a new call even
+                            # without an index (some providers omit it)
+                            idx = frag.get("index")
+                            if idx is None:
+                                fn0 = frag.get("function") or {}
+                                if frag.get("id") or fn0.get("name"):
+                                    idx = len(calls_by_index)
+                                else:
+                                    idx = last_idx
+                            last_idx = idx
                             call = calls_by_index.setdefault(
                                 idx, {"id": "", "type": "function",
                                       "function": {"name": "",

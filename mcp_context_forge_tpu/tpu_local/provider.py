@@ -338,7 +338,25 @@ class DialectProvider(LLMProvider):
         anthropic SSE content_block_delta events, ollama ndjson lines,
         azure/watsonx OpenAI-shaped SSE passthrough, bedrock ConverseStream
         AWS event-stream binary frames (utils/eventstream.py), vertex
-        streamGenerateContent with ``alt=sse``."""
+        streamGenerateContent with ``alt=sse``.
+
+        Invariant for ALL dialects: the stream terminates with a
+        finish_reason chunk even when the upstream closes early —
+        consumers key turn-end on the terminal chunk."""
+        finished = False
+        last_id: str | None = None
+        async for chunk in self._dispatch_stream(request):
+            last_id = chunk.get("id") or last_id
+            for choice in chunk.get("choices", []):
+                if choice.get("finish_reason"):
+                    finished = True
+            yield chunk
+        if not finished:
+            yield self._chunk(last_id or f"chatcmpl-{new_id()[:24]}",
+                              request.get("model", ""), None, "stop")
+
+    async def _dispatch_stream(self, request: dict[str, Any]
+                               ) -> AsyncIterator[dict[str, Any]]:
         if self.dialect == "bedrock":
             async for chunk in self._bedrock_stream(request):
                 yield chunk

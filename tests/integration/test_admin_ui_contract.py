@@ -105,3 +105,21 @@ async def test_tab_row_actions_resolve():
         assert resp.status in (200, 204)
     finally:
         await client.close()
+
+
+def test_teams_pane_never_interpolates_server_data_into_js_strings():
+    """Stored-XSS guard (advisor r4 medium #2): the teams detail pane must
+    resolve member emails from the JS-side detailTeam store via indices —
+    esc() cannot protect data placed inside a JS string literal, because
+    the HTML parser decodes entities in attribute values before JS runs."""
+    from mcp_context_forge_tpu.gateway import admin_ui
+
+    page = admin_ui._PAGE
+    # index-based handler present and wired
+    assert "removeMemberAt(" in page
+    assert "detailTeam" in page
+    # no template interpolation of escaped server data into inline JS
+    # string literals anywhere in the members/team-action handlers
+    assert "removeMember('${esc(" not in page
+    assert "addMember('${esc(" not in page
+    assert "inviteMember('${esc(" not in page

@@ -187,3 +187,56 @@ async def test_llmchat_sse_streams_token_events():
         assert raw.rstrip().endswith("data: [DONE]")
     finally:
         await gateway.close()
+
+
+async def test_fragment_without_index_appends_to_current_call():
+    """Passthrough providers fragment arguments across deltas; a
+    continuation fragment that omits "index" must append to the CURRENT
+    call, not open a new one (advisor r4 low #3)."""
+    turn = [
+        {"choices": [{"delta": {"tool_calls": [
+            {"index": 0, "id": "call_0", "type": "function",
+             "function": {"name": "lookup",
+                          "arguments": '{"q": "sp'}}]},
+            "finish_reason": None}]},
+        # continuation: no index, no id — arguments substring only
+        {"choices": [{"delta": {"tool_calls": [
+            {"function": {"arguments": 'lit"}'}}]},
+            "finish_reason": None}]},
+        {"choices": [{"delta": {}, "finish_reason": "tool_calls"}]},
+    ]
+    registry = _ScriptedRegistry([turn, _answer_chunks("done")])
+    tools = _StubTools(delay=0.0)
+    service = ChatService(_ctx(registry), tools, server_service=None)
+    session = await service.connect("u@x")
+    events = [e async for e in service.chat(session.id, "u@x", "go")]
+    kinds = [e["type"] for e in events]
+    assert kinds.count("tool_call") == 1  # NOT two corrupted calls
+    assert tools.calls == [("lookup", {"q": "split"})]
+
+
+async def test_indexless_fragment_with_new_id_opens_new_call():
+    """Providers that legally omit "index" but stream WHOLE calls per
+    delta: a fragment carrying a fresh id/name is a NEW call, not a
+    continuation of the previous one."""
+    turn = [
+        {"choices": [{"delta": {"tool_calls": [
+            {"id": "call_a", "type": "function",
+             "function": {"name": "lookup",
+                          "arguments": '{"q": "a"}'}}]},
+            "finish_reason": None}]},
+        {"choices": [{"delta": {"tool_calls": [
+            {"id": "call_b", "type": "function",
+             "function": {"name": "lookup",
+                          "arguments": '{"q": "b"}'}}]},
+            "finish_reason": None}]},
+        {"choices": [{"delta": {}, "finish_reason": "tool_calls"}]},
+    ]
+    registry = _ScriptedRegistry([turn, _answer_chunks("done")])
+    tools = _StubTools(delay=0.0)
+    service = ChatService(_ctx(registry), tools, server_service=None)
+    session = await service.connect("u@x")
+    events = [e async for e in service.chat(session.id, "u@x", "go")]
+    assert [e["type"] for e in events].count("tool_call") == 2
+    assert sorted(tools.calls, key=str) == [("lookup", {"q": "a"}),
+                                            ("lookup", {"q": "b"})]

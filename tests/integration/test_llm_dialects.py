@@ -507,3 +507,77 @@ async def test_watsonx_stream_uses_sibling_endpoint():
         assert chunks[0]["model"] == "granite"
     finally:
         await stub.close()
+
+
+async def test_bedrock_stream_early_close_still_finishes_turn():
+    """If the upstream stream ends without a messageStop frame, the
+    dialect must still terminate with a finish_reason chunk like every
+    other path (advisor r4 low #4)."""
+    from mcp_context_forge_tpu.utils.eventstream import encode_frame
+
+    async def handler(request):
+        resp = web.StreamResponse(headers={
+            "content-type": "application/vnd.amazon.eventstream"})
+        await resp.prepare(request)
+        await resp.write(encode_frame(
+            {":message-type": "event", ":event-type": "contentBlockDelta"},
+            json.dumps({"delta": {"text": "partial"},
+                        "contentBlockIndex": 0}).encode()))
+        return resp  # closes with no messageStop
+
+    stub = await _stub(handler, "/model/m/converse-stream")
+    try:
+        provider = DialectProvider("br", "bedrock", api_base=_base(stub))
+        chunks = [c async for c in provider.chat_stream(
+            {"model": "m", "messages": MESSAGES})]
+        assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+        text = "".join(c["choices"][0]["delta"].get("content", "")
+                       for c in chunks)
+        assert text == "partial"
+    finally:
+        await stub.close()
+
+
+async def test_anthropic_stream_early_close_still_finishes_turn():
+    """The terminal-chunk invariant holds for EVERY dialect, enforced in
+    the shared chat_stream wrapper: an anthropic SSE stream that closes
+    after content_block_delta but before message_delta/stop still ends
+    with a finish_reason chunk sharing the stream id."""
+    async def handler(request):
+        resp = web.StreamResponse(headers={"content-type":
+                                           "text/event-stream"})
+        await resp.prepare(request)
+        await resp.write(
+            b'data: {"type": "content_block_delta",'
+            b' "delta": {"type": "text_delta", "text": "par"}}\n\n')
+        return resp  # closes with no message_stop
+
+    stub = await _stub(handler, "/v1/messages")
+    try:
+        provider = DialectProvider("an", "anthropic", api_base=_base(stub),
+                                   api_key="k")
+        chunks = [c async for c in provider.chat_stream(
+            {"model": "m", "messages": MESSAGES})]
+        assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+        assert len({c["id"] for c in chunks}) == 1
+    finally:
+        await stub.close()
+
+
+async def test_ollama_stream_early_close_still_finishes_turn():
+    async def handler(request):
+        resp = web.StreamResponse(headers={"content-type":
+                                           "application/x-ndjson"})
+        await resp.prepare(request)
+        await resp.write(
+            b'{"message": {"content": "par"}, "done": false}\n')
+        return resp  # closes with no done:true line
+
+    stub = await _stub(handler, "/api/chat")
+    try:
+        provider = DialectProvider("ol", "ollama", api_base=_base(stub))
+        chunks = [c async for c in provider.chat_stream(
+            {"model": "m", "messages": MESSAGES})]
+        assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+    finally:
+        await stub.close()

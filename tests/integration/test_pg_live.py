@@ -190,3 +190,27 @@ def test_concurrent_connections_share_state(pg_server):
             await b.close()
 
     asyncio.run(main())
+
+
+def test_simple_query_error_returns_to_idle(pg_server):
+    """A failed simple-protocol query must NOT arm skip-until-sync: real
+    PG returns to idle after an ErrorResponse on 'Q' (the in-tree driver
+    sends BEGIN/COMMIT/ROLLBACK and DDL as simple queries, and simple-
+    protocol clients never send Sync — advisor r4 medium #1)."""
+    from mcp_context_forge_tpu.db.pgwire import PGConnection
+
+    async def main():
+        conn = PGConnection("127.0.0.1", pg_server, USER, PASSWORD, "forge")
+        await conn.connect()
+        with pytest.raises(PGError):
+            await conn.query("ROLLBACK")  # no transaction is active
+        # next simple query must answer, not hang waiting for Sync
+        rows = await asyncio.wait_for(conn.query("SELECT 1 AS one"), 5)
+        assert rows[0]["one"] == 1
+        # and the extended protocol still works on the same connection
+        rows = await asyncio.wait_for(
+            conn.query("SELECT $1 AS t", ["ok"]), 5)
+        assert rows[0]["t"] == "ok"
+        await conn.close()
+
+    asyncio.run(main())

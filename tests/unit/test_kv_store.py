@@ -71,3 +71,31 @@ async def test_tcp_kv_crosses_connections():
         await c1.stop()
         await c2.stop()
         await hub.stop()
+
+
+async def test_file_kv_distinct_keys_never_collide(tmp_path):
+    """Sanitization must not map distinct keys to one file: client-
+    supplied session ids flow into the key (advisor r4 low #5)."""
+    kv = FileKVStore(str(tmp_path))
+    await kv.set("chat:a-b", 1)
+    await kv.set("chat:a_b", 2)
+    await kv.set("chat_a:b", 3)
+    assert await kv.get("chat:a-b") == 1
+    assert await kv.get("chat:a_b") == 2
+    assert await kv.get("chat_a:b") == 3
+    await kv.delete("chat:a_b")
+    assert await kv.get("chat:a-b") == 1
+    assert await kv.get("chat_a:b") == 3
+
+
+async def test_file_kv_reads_legacy_sanitized_filenames(tmp_path):
+    """Entries written under the pre-hash naming stay visible (rolling
+    restarts share bus_dir across worker versions)."""
+    import json as _json
+    kv = FileKVStore(str(tmp_path))
+    legacy = tmp_path / "kv" / "chat_legacy.json"
+    legacy.write_text(_json.dumps({"value": {"x": 1}, "expires": 0.0}))
+    assert await kv.get("chat:legacy") == {"x": 1}
+    await kv.delete("chat:legacy")
+    assert await kv.get("chat:legacy") is None
+    assert not legacy.exists()

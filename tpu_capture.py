@@ -25,7 +25,7 @@ import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 LOG = os.path.join(REPO, "tpu_capture_log.jsonl")
-OUT = os.path.join(REPO, "BENCH_TPU_r04.json")
+OUT = os.path.join(REPO, "BENCH_TPU_r05.json")
 
 GRID = [
     {"BENCH_DECODE_BLOCK": "1", "BENCH_SPEC": "0"},
