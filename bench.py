@@ -376,7 +376,25 @@ async def bench_engine_configs(platform: str) -> dict:
         await pm.remove_plugin("mod")
         await pm.remove_plugin("harm")
 
-        # --- config3: summarizer backed by tpu_local chat
+        # --- config3: summarizer backed by tpu_local chat. Two numbers:
+        # the default path (result-hash cache + singleflight — repeated
+        # tool outputs coalesce onto one engine decode, the latency-budget
+        # engineering of SURVEY §7.2 #2), and the cache-disabled path
+        # (every request pays the full 32-token decode — the raw engine
+        # cost the roofline doc projects; see docs/roofline-v5e.md)
+        await pm.add_plugin(PluginConfig(
+            name="sum-raw", kind="summarizer",
+            config={"threshold_chars": 1000, "max_tokens": 32,
+                    "cache": False}))
+        await _tools_call_load(gateway, auth, "bench-tool", 2, 1)  # compile
+        lat3r, fail3r, wall3r = await _tools_call_load(
+            gateway, auth, "bench-tool", 32, 8)
+        out["config3_summarizer_uncached"] = {
+            **_percentiles(lat3r), "failures": fail3r,
+            "rps": round(32 / wall3r, 2),
+            "added_p50_ms": round(statistics.median(lat3r) - base_p50, 2),
+            "requests": 32}
+        await pm.remove_plugin("sum-raw")
         await pm.add_plugin(PluginConfig(
             name="sum", kind="summarizer",
             config={"threshold_chars": 1000, "max_tokens": 32}))
@@ -387,7 +405,10 @@ async def bench_engine_configs(platform: str) -> dict:
             **_percentiles(lat3), "failures": fail3,
             "rps": round(32 / wall3, 2),
             "added_p50_ms": round(statistics.median(lat3) - base_p50, 2),
-            "requests": 32}
+            "requests": 32,
+            "note": ("default path: result-hash cache + singleflight; "
+                     "uncached raw-decode cost in config3_summarizer_"
+                     "uncached")}
         await pm.remove_plugin("sum")
 
         # --- config4: /v1/chat/completions at 128 concurrent clients

@@ -86,11 +86,16 @@ class FileKVStore(KVStore):
         digest = hashlib.sha256(key.encode()).hexdigest()[:24]
         return os.path.join(self._dir, f"{prefix}.{digest}.json")
 
-    def _legacy_path(self, key: str) -> str:
-        # pre-hash naming: read-only fallback so entries written before
-        # the collision fix (and by older workers sharing bus_dir during
-        # a rolling restart) stay visible
+    def _legacy_path(self, key: str) -> str | None:
+        # pre-hash naming: fallback so entries written before the
+        # collision fix (and by older workers sharing bus_dir during a
+        # rolling restart) stay visible — but ONLY for keys whose
+        # sanitized form is lossless: a lossy key's legacy filename is
+        # ambiguous (several keys collapse onto it), so reading or
+        # deleting it could cross into a DIFFERENT key's entry
         safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in key)
+        if safe != key:
+            return None
         return os.path.join(self._dir, safe + ".json")
 
     async def set(self, key: str, value: Any, ttl: float = 0.0) -> None:
@@ -105,6 +110,8 @@ class FileKVStore(KVStore):
     async def get(self, key: str) -> Any:
         payload = None
         for path in (self._path(key), self._legacy_path(key)):
+            if path is None:
+                continue
             try:
                 with open(path) as fh:
                     payload = json.load(fh)
@@ -120,6 +127,8 @@ class FileKVStore(KVStore):
 
     async def delete(self, key: str) -> None:
         for path in (self._path(key), self._legacy_path(key)):
+            if path is None:
+                continue
             try:
                 os.unlink(path)
             except FileNotFoundError:

@@ -14,6 +14,7 @@ the reference actually ships — and moderation falls back to wordlists.
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import json
 import logging
@@ -108,8 +109,43 @@ class ResponseCacheByPromptPlugin(Plugin):
 class SummarizerPlugin(Plugin):
     """Summarizes long tool output through the tpu_local chat model.
 
+    Latency budget (SURVEY §7.2 #2): summarization is deterministic
+    (temperature 0) over the tool output, so identical outputs MUST
+    summarize identically — a result-hash cache skips the engine for
+    repeats, and a singleflight table coalesces CONCURRENT identical
+    calls onto one in-flight engine chat (a burst of N calls over the
+    same tool output pays one decode, not N). Engine calls are tagged
+    ``priority: batch`` so interactive chat admits first under slot
+    contention.
+
     config: {threshold_chars: 2000, max_tokens: 256, model: null,
-             prompt: "..."}"""
+             prompt: "...", cache: true, cache_ttl_seconds: 600,
+             cache_max_entries: 256}"""
+
+    def __init__(self, config, ctx=None):
+        super().__init__(config, ctx)
+        # key -> (summary, monotonic deadline); insertion-ordered for LRU
+        self._cache: "dict[str, tuple[str, float]]" = {}
+        self._inflight: dict[str, asyncio.Future] = {}
+
+    def _key(self, prompt: str, text: str, max_tokens: int) -> str:
+        raw = json.dumps([self.config.config.get("model"), prompt,
+                          max_tokens, text])
+        return hashlib.sha256(raw.encode()).hexdigest()
+
+    async def _summarize(self, registry, prompt: str, text: str,
+                         max_tokens: int) -> str:
+        response = await registry.chat({
+            "model": self.config.config.get("model"),
+            "messages": [
+                {"role": "system", "content": prompt},
+                {"role": "user", "content": text},
+            ],
+            "max_tokens": max_tokens,
+            "temperature": 0.0,
+            "priority": "batch",
+        })
+        return response["choices"][0]["message"]["content"]
 
     async def tool_post_invoke(self, name, result, context):
         threshold = int(self.config.config.get("threshold_chars", 2000))
@@ -122,19 +158,58 @@ class SummarizerPlugin(Plugin):
         prompt = self.config.config.get(
             "prompt", "Summarize the following tool output concisely, keeping key "
                       "facts, numbers and identifiers:")
-        response = await registry.chat({
-            "model": self.config.config.get("model"),
-            "messages": [
-                {"role": "system", "content": prompt},
-                {"role": "user", "content": text[:16000]},
-            ],
-            "max_tokens": int(self.config.config.get("max_tokens", 256)),
-            "temperature": 0.0,
-        })
-        summary = response["choices"][0]["message"]["content"]
+        text = text[:16000]
+        max_tokens = int(self.config.config.get("max_tokens", 256))
+
+        if not self.config.config.get("cache", True):
+            summary = await self._summarize(registry, prompt, text, max_tokens)
+            return {"content": [{"type": "text", "text": summary}],
+                    "isError": False, "_summarized": True}
+
+        key = self._key(prompt, text, max_tokens)
+        ttl = float(self.config.config.get("cache_ttl_seconds", 600))
+        now = time.monotonic()
+        hit = self._cache.get(key)
+        if hit is not None and hit[1] > now:
+            self._cache.pop(key)        # true LRU: a hit refreshes recency
+            self._cache[key] = hit
+            context.metadata["summary_cache_hit"] = True
+            return {"content": [{"type": "text", "text": hit[0]}],
+                    "isError": False, "_summarized": True}
+
+        flight = self._inflight.get(key)
+        if flight is None:
+            flight = asyncio.get_running_loop().create_future()
+            self._inflight[key] = flight
+            try:
+                summary = await self._summarize(registry, prompt, text,
+                                                max_tokens)
+            except BaseException as exc:
+                # BaseException: a CancelledError (client disconnect) must
+                # not strand a forever-pending future in _inflight — every
+                # later identical call would await it until restart
+                if isinstance(exc, Exception):
+                    flight.set_exception(exc)
+                    # an unawaited exception-holding future must not warn
+                    flight.exception()
+                else:
+                    flight.cancel()
+                self._inflight.pop(key, None)
+                raise
+            max_entries = int(self.config.config.get("cache_max_entries", 256))
+            if max_entries > 0:
+                while len(self._cache) >= max_entries:
+                    self._cache.pop(next(iter(self._cache)))
+                self._cache[key] = (summary, time.monotonic() + ttl)
+            flight.set_result(summary)
+            # cache first, THEN retire the flight: a caller arriving in
+            # between finds one or the other, never neither
+            self._inflight.pop(key, None)
+        else:
+            summary = await flight  # coalesce onto the in-flight call
+            context.metadata["summary_cache_hit"] = True
         return {"content": [{"type": "text", "text": summary}],
-                "isError": False,
-                "_summarized": True}
+                "isError": False, "_summarized": True}
 
 
 _HARM_WORDLIST = {

@@ -168,7 +168,10 @@ class ChatService:
                             if idx is None:
                                 fn0 = frag.get("function") or {}
                                 if frag.get("id") or fn0.get("name"):
-                                    idx = len(calls_by_index)
+                                    # next unused index (len() would
+                                    # collide when explicit indices are
+                                    # sparse, merging distinct calls)
+                                    idx = max(calls_by_index, default=-1) + 1
                                 else:
                                     idx = last_idx
                             last_idx = idx

@@ -155,6 +155,11 @@ class GenRequest:
     top_k: int = 0
     top_p: float = 1.0
     stop_ids: tuple[int, ...] = ()
+    # admission class (SURVEY §7.2 #2 latency budget): 0 = interactive
+    # (chat turns, agent hops), 1 = background (summaries, batch work).
+    # Lower admits first when slots are contended; decode itself is shared
+    # continuous batching, so a class never starves once admitted.
+    priority: int = 0
     # unbounded: tokens are ints bounded by max_tokens, and a bounded queue
     # could drop the end-of-stream sentinel and hang the consumer
     stream: asyncio.Queue = field(default_factory=asyncio.Queue)
@@ -971,6 +976,13 @@ class TPUEngine:
         self._drain_work()
         if not self._pending:
             return False
+        # priority classes: interactive requests admit before queued
+        # background work (summaries must not make a chat turn wait for a
+        # free slot — and the sort is stable, so FIFO holds within each
+        # class and no class reorders internally)
+        if len({r.priority for r in self._pending}) > 1:
+            self._pending = deque(sorted(self._pending,
+                                         key=lambda r: r.priority))
 
         # (oversized prompts reject inside the head-selection scan below)
         free_slots = [s for s in range(config.max_batch)

@@ -159,6 +159,10 @@ class TPULocalProvider(LLMProvider):
         reserve = max(1, min(requested, max_ctx // 4))
         prompt_ids = prompt_ids[-(max_ctx - reserve):]
         max_tokens = min(requested, max_ctx - len(prompt_ids))
+        # admission class: plugins tag offline-ish work (summaries) as
+        # "batch" so interactive chat turns admit first under contention
+        priority = {"interactive": 0, "batch": 1}.get(
+            str(request.get("priority") or "interactive"), 0)
         return GenRequest(
             request_id=new_id(),
             prompt_ids=prompt_ids,
@@ -166,6 +170,7 @@ class TPULocalProvider(LLMProvider):
             temperature=float(request.get("temperature") or 0.0),
             top_k=int(request.get("top_k") or 0),
             top_p=float(request.get("top_p") or 1.0),
+            priority=priority,
         )
 
     async def chat(self, request: dict[str, Any]) -> dict[str, Any]:

@@ -240,3 +240,29 @@ async def test_indexless_fragment_with_new_id_opens_new_call():
     assert [e["type"] for e in events].count("tool_call") == 2
     assert sorted(tools.calls, key=str) == [("lookup", {"q": "a"}),
                                             ("lookup", {"q": "b"})]
+
+
+async def test_indexless_new_call_avoids_sparse_index_collision():
+    """Explicit indices {0, 2} then an indexless whole-call fragment:
+    the new call must take an UNUSED index, not len()==2 (which would
+    merge it into the existing index-2 call)."""
+    turn = [
+        {"choices": [{"delta": {"tool_calls": [
+            {"index": 0, "id": "call_0", "type": "function",
+             "function": {"name": "lookup", "arguments": '{"q": "a"}'}},
+            {"index": 2, "id": "call_2", "type": "function",
+             "function": {"name": "lookup", "arguments": '{"q": "c"}'}}]},
+            "finish_reason": None}]},
+        {"choices": [{"delta": {"tool_calls": [
+            {"id": "call_new", "type": "function",
+             "function": {"name": "lookup", "arguments": '{"q": "n"}'}}]},
+            "finish_reason": None}]},
+        {"choices": [{"delta": {}, "finish_reason": "tool_calls"}]},
+    ]
+    registry = _ScriptedRegistry([turn, _answer_chunks("done")])
+    tools = _StubTools(delay=0.0)
+    service = ChatService(_ctx(registry), tools, server_service=None)
+    session = await service.connect("u@x")
+    events = [e async for e in service.chat(session.id, "u@x", "go")]
+    assert [e["type"] for e in events].count("tool_call") == 3
+    assert sorted(a.get("q") for _, a in tools.calls) == ["a", "c", "n"]

@@ -377,3 +377,34 @@ def test_compile_cache_scoped_by_host_fingerprint(monkeypatch):
                         lambda key, value: recorded.setdefault(key, value))
     eng._apply_compile_cache("/tmp/cache-root")
     assert recorded["jax_compilation_cache_dir"] == f"/tmp/cache-root/{fp}"
+
+
+def test_priority_admission_interactive_before_batch(engine):
+    """Admission classes (SURVEY §7.2 #2): when slots are contended, an
+    interactive request queued BEHIND background summaries admits first;
+    FIFO holds within each class. Drives _admit_batch directly (no
+    dispatch thread) so the pending order is deterministic."""
+    ids = engine.tokenizer.encode("hello world")
+    batch = [GenRequest(request_id=f"bg{i}", prompt_ids=ids, max_tokens=4,
+                        priority=1) for i in range(3)]
+    chat = GenRequest(request_id="chat", prompt_ids=ids, max_tokens=4,
+                      priority=0)
+    for request in batch:
+        engine._pending.append(request)
+    engine._pending.append(chat)  # arrives LAST
+    try:
+        engine._admit_batch()
+        running = {r.request_id for r in engine._running.values()}
+        assert "chat" in running
+        # 4 slots, 4 requests, prefill_max_batch=4: all admitted, but the
+        # interactive one leads the group (slot order follows group order)
+        assert chat.slot == 0
+        # FIFO preserved within the background class
+        bg_slots = [r.slot for r in batch]
+        assert bg_slots == sorted(bg_slots)
+    finally:
+        for slot in list(engine._running):
+            engine._running.pop(slot)
+            engine.allocator.free_slot(slot)
+        engine._pending.clear()
+        engine._sync_tables()

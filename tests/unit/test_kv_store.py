@@ -90,12 +90,19 @@ async def test_file_kv_distinct_keys_never_collide(tmp_path):
 
 async def test_file_kv_reads_legacy_sanitized_filenames(tmp_path):
     """Entries written under the pre-hash naming stay visible (rolling
-    restarts share bus_dir across worker versions)."""
+    restarts share bus_dir across worker versions) — but ONLY for keys
+    whose sanitized form is lossless: a lossy key's legacy filename is
+    ambiguous, so the fallback must not read (or delete) across keys."""
     import json as _json
     kv = FileKVStore(str(tmp_path))
     legacy = tmp_path / "kv" / "chat_legacy.json"
     legacy.write_text(_json.dumps({"value": {"x": 1}, "expires": 0.0}))
-    assert await kv.get("chat:legacy") == {"x": 1}
-    await kv.delete("chat:legacy")
+    assert await kv.get("chat_legacy") == {"x": 1}
+    # 'chat:legacy' sanitizes onto the SAME legacy file but is a distinct
+    # key: neither its get nor its delete may touch that file
     assert await kv.get("chat:legacy") is None
+    await kv.delete("chat:legacy")
+    assert legacy.exists()
+    await kv.delete("chat_legacy")
+    assert await kv.get("chat_legacy") is None
     assert not legacy.exists()

@@ -1,0 +1,186 @@
+"""Summarizer latency budget: result-hash cache + singleflight coalescing.
+
+SURVEY §7.2 #2 / round-4 VERDICT next #1: the config-3 shape is N
+concurrent tool calls whose (identical) long outputs each trigger an
+engine summary. Deterministic summarization (temperature 0) makes the
+summary a pure function of (model, prompt, max_tokens, text), so repeats
+must cost zero engine decodes and a concurrent burst must coalesce onto
+ONE in-flight chat. Reference per-call hook shape:
+`/root/reference/plugins/summarizer/summarizer.py:275-306`.
+"""
+
+import asyncio
+
+import pytest
+
+from mcp_context_forge_tpu.plugins.builtin.llm_plugins import SummarizerPlugin
+from mcp_context_forge_tpu.plugins.framework import PluginConfig, PluginContext
+
+
+class _CountingRegistry:
+    def __init__(self, delay: float = 0.0, fail: bool = False):
+        self.calls = []
+        self.delay = delay
+        self.fail = fail
+
+    async def chat(self, request):
+        self.calls.append(request)
+        if self.delay:
+            await asyncio.sleep(self.delay)
+        if self.fail:
+            raise RuntimeError("engine down")
+        text = request["messages"][1]["content"]
+        return {"choices": [{"message": {
+            "content": f"summary#{len(self.calls)} of {len(text)} chars"}}]}
+
+
+class _Ctx:
+    def __init__(self, registry):
+        self.llm_registry = registry
+
+
+def _plugin(registry, **config):
+    base = {"threshold_chars": 100, "max_tokens": 16}
+    base.update(config)
+    return SummarizerPlugin(PluginConfig(name="sum", kind="summarizer",
+                                         config=base), _Ctx(registry))
+
+
+def _result(text):
+    return {"content": [{"type": "text", "text": text}], "isError": False}
+
+
+LONG = "metric value 42; " * 40  # > threshold_chars
+
+
+async def test_identical_outputs_summarize_once():
+    registry = _CountingRegistry()
+    plugin = _plugin(registry)
+    first = await plugin.tool_post_invoke("t", _result(LONG), PluginContext())
+    ctx = PluginContext()
+    second = await plugin.tool_post_invoke("t", _result(LONG), ctx)
+    assert len(registry.calls) == 1
+    assert first["content"][0]["text"] == second["content"][0]["text"]
+    assert ctx.metadata.get("summary_cache_hit") is True
+    # the engine call was tagged background-priority
+    assert registry.calls[0]["priority"] == "batch"
+
+
+async def test_distinct_outputs_do_not_share_summaries():
+    registry = _CountingRegistry()
+    plugin = _plugin(registry)
+    a = await plugin.tool_post_invoke("t", _result(LONG), PluginContext())
+    b = await plugin.tool_post_invoke("t", _result(LONG + "tail"),
+                                      PluginContext())
+    assert len(registry.calls) == 2
+    assert a["content"][0]["text"] != b["content"][0]["text"]
+
+
+async def test_concurrent_burst_coalesces_onto_one_engine_call():
+    """The config-3 shape: 8 simultaneous identical summaries -> 1 chat."""
+    registry = _CountingRegistry(delay=0.05)
+    plugin = _plugin(registry)
+    results = await asyncio.gather(*[
+        plugin.tool_post_invoke("t", _result(LONG), PluginContext())
+        for _ in range(8)])
+    assert len(registry.calls) == 1
+    texts = {r["content"][0]["text"] for r in results}
+    assert len(texts) == 1
+
+
+async def test_failed_flight_does_not_poison_later_calls():
+    registry = _CountingRegistry(fail=True)
+    plugin = _plugin(registry)
+    with pytest.raises(RuntimeError):
+        await plugin.tool_post_invoke("t", _result(LONG), PluginContext())
+    registry.fail = False
+    out = await plugin.tool_post_invoke("t", _result(LONG), PluginContext())
+    assert out["_summarized"] is True
+    assert len(registry.calls) == 2
+
+
+async def test_concurrent_waiters_see_flight_failure():
+    registry = _CountingRegistry(delay=0.05, fail=True)
+    plugin = _plugin(registry)
+    results = await asyncio.gather(*[
+        plugin.tool_post_invoke("t", _result(LONG), PluginContext())
+        for _ in range(4)], return_exceptions=True)
+    assert all(isinstance(r, RuntimeError) for r in results)
+    assert len(registry.calls) == 1
+
+
+async def test_ttl_expiry_recomputes():
+    registry = _CountingRegistry()
+    plugin = _plugin(registry, cache_ttl_seconds=0.03)
+    await plugin.tool_post_invoke("t", _result(LONG), PluginContext())
+    await asyncio.sleep(0.05)
+    await plugin.tool_post_invoke("t", _result(LONG), PluginContext())
+    assert len(registry.calls) == 2
+
+
+async def test_cache_disabled_calls_engine_every_time():
+    registry = _CountingRegistry()
+    plugin = _plugin(registry, cache=False)
+    await plugin.tool_post_invoke("t", _result(LONG), PluginContext())
+    await plugin.tool_post_invoke("t", _result(LONG), PluginContext())
+    assert len(registry.calls) == 2
+
+
+async def test_cache_eviction_bounded():
+    registry = _CountingRegistry()
+    plugin = _plugin(registry, cache_max_entries=2)
+    for i in range(4):
+        await plugin.tool_post_invoke(
+            "t", _result(LONG + str(i)), PluginContext())
+    assert len(plugin._cache) <= 2
+
+
+async def test_short_and_error_outputs_pass_through():
+    registry = _CountingRegistry()
+    plugin = _plugin(registry)
+    assert await plugin.tool_post_invoke(
+        "t", _result("short"), PluginContext()) is None
+    err = {"content": [{"type": "text", "text": LONG}], "isError": True}
+    assert await plugin.tool_post_invoke("t", err, PluginContext()) is None
+    assert registry.calls == []
+
+
+async def test_leader_cancellation_does_not_strand_followers_forever():
+    """A cancelled leader (client disconnect) must clear its in-flight
+    entry: later identical calls retry instead of awaiting a dead future
+    until process restart."""
+    registry = _CountingRegistry(delay=0.2)
+    plugin = _plugin(registry)
+    leader = asyncio.ensure_future(
+        plugin.tool_post_invoke("t", _result(LONG), PluginContext()))
+    await asyncio.sleep(0.02)  # leader is awaiting the engine
+    leader.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await leader
+    assert plugin._inflight == {}
+    registry.delay = 0.0
+    out = await plugin.tool_post_invoke("t", _result(LONG), PluginContext())
+    assert out["_summarized"] is True
+
+
+async def test_zero_cache_capacity_means_no_caching():
+    registry = _CountingRegistry()
+    plugin = _plugin(registry, cache_max_entries=0)
+    await plugin.tool_post_invoke("t", _result(LONG), PluginContext())
+    await plugin.tool_post_invoke("t", _result(LONG), PluginContext())
+    assert len(registry.calls) == 2
+    assert plugin._cache == {}
+
+
+async def test_eviction_is_lru_not_fifo():
+    registry = _CountingRegistry()
+    plugin = _plugin(registry, cache_max_entries=2)
+    await plugin.tool_post_invoke("t", _result(LONG + "a"), PluginContext())
+    await plugin.tool_post_invoke("t", _result(LONG + "b"), PluginContext())
+    # hit 'a': refreshes recency, so 'b' is the eviction victim
+    await plugin.tool_post_invoke("t", _result(LONG + "a"), PluginContext())
+    await plugin.tool_post_invoke("t", _result(LONG + "c"), PluginContext())
+    ctx = PluginContext()
+    await plugin.tool_post_invoke("t", _result(LONG + "a"), ctx)
+    assert ctx.metadata.get("summary_cache_hit") is True
+    assert len(registry.calls) == 3  # a, b, c — never a twice
