@@ -119,6 +119,9 @@ const TABS = {
   teams:    {url: "/teams", cols: ["name","slug","visibility","is_personal","created_by"], boolcols: ["is_personal"],
              create: {url:"/teams", fields:["name","visibility"]},
              del: id => `/teams/${id}`, detail: id => `/teams/${id}`, special: "teams"},
+  roles:    {paged:true, url: "/rbac/roles", cols: ["name","scope","description","is_system","assignment_count"], boolcols: ["is_system"],
+             create: {url:"/rbac/roles", fields:["name","description","scope","permissions:csv"]},
+             del: id => `/rbac/roles/${id}`, detail: id => `/rbac/roles/${id}`, special: "roles"},
   tokens:   {url: "/auth/tokens", cols: ["name","server_id","expires_at","last_used","revoked_at"],
              create: {url:"/auth/tokens", fields:["name","expires_minutes:int","permissions:csv","server_id"], reveal: "token"},
              del: id => `/auth/tokens/${id}`},
@@ -545,8 +548,61 @@ async function detailRow(i){
       <button class="act" onclick="inviteMember(detailTeam.id)">invite (/teams/{id}/invitations)</button>
       <span id="invite-out" class="kv"></span>`;
   }
+  if (t.special === "roles"){
+    // same index-based pattern as teams: no server data in JS literals
+    detailRole = {id: String(id), assignments: full.assignments || []};
+    const rows = detailRole.assignments.map((a, aidx) =>
+      `<tr><td>${esc(a.user_email||"")}</td><td>${esc(a.scope_id||"")}</td>
+       <td><button class="act danger" onclick="revokeRoleAt(${aidx})">revoke</button></td></tr>`).join("");
+    extra = `<br><b>assignments</b><table class="kv">${rows}</table>
+      <input id="r-email" placeholder="user email"><input id="r-scope" placeholder="scope_id (team-scoped only)">
+      <button class="act" onclick="assignRole()">assign (/rbac/users/{email}/roles)</button>
+      <br><b>permission inspector</b><br>
+      <input id="p-email" placeholder="user email"><input id="p-perm" placeholder="permission">
+      <button class="act" onclick="checkPermission()">check (/rbac/permissions/check)</button>
+      <button class="act" onclick="userPermissions()">effective set</button>
+      <span id="perm-out" class="kv"></span>`;
+  }
   d.innerHTML = `<b>${esc(current)} ${esc(String(id))}</b>
     <table class="kv">${kv}</table>${extra}`;
+}
+let detailRole = null;  // {id, assignments[]} of the open roles detail pane
+async function assignRole(){
+  if (!detailRole) return;
+  const email = document.getElementById("r-email").value;
+  const scope = document.getElementById("r-scope").value;
+  const r = await fetch(`/rbac/users/${encodeURIComponent(email)}/roles`, {
+    method:"POST", headers:{"content-type":"application/json"},
+    body: JSON.stringify({role_id: detailRole.id, scope_id: scope})});
+  document.getElementById("status").textContent = r.ok ? "role assigned" :
+    "assign failed: " + r.status + " " + esc(await r.text());
+  show(current);
+}
+async function revokeRoleAt(aidx){
+  if (!detailRole || !detailRole.assignments[aidx]) return;
+  const a = detailRole.assignments[aidx];
+  const email = String(a.user_email || "");
+  const qs = a.scope_id ? `?scope_id=${encodeURIComponent(String(a.scope_id))}` : "";
+  const r = await fetch(`/rbac/users/${encodeURIComponent(email)}/roles/${encodeURIComponent(detailRole.id)}` + qs,
+    {method:"DELETE"});
+  document.getElementById("status").textContent = r.ok ? "role revoked" :
+    "revoke failed: " + r.status;
+  show(current);
+}
+async function checkPermission(){
+  const email = document.getElementById("p-email").value;
+  const perm = document.getElementById("p-perm").value;
+  const r = await fetch("/rbac/permissions/check", {method:"POST",
+    headers:{"content-type":"application/json"},
+    body: JSON.stringify({user_email: email, permission: perm})});
+  const out = r.ok ? await r.json() : {error: r.status};
+  document.getElementById("perm-out").textContent = JSON.stringify(out);
+}
+async function userPermissions(){
+  const email = document.getElementById("p-email").value;
+  const r = await fetch(`/rbac/permissions/user/${encodeURIComponent(email)}`);
+  const out = r.ok ? await r.json() : {error: r.status};
+  document.getElementById("perm-out").textContent = JSON.stringify(out);
 }
 async function addMember(teamId){
   const email = document.getElementById("m-email").value;

@@ -331,6 +331,10 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     setup_extra_routes(app)
     from .routers_discovery import setup_discovery_routes
     setup_discovery_routes(app)
+    from ..services.role_service import RoleService
+    app["role_service"] = RoleService(ctx)
+    from .routers_rbac import setup_rbac_routes
+    setup_rbac_routes(app)
 
     from ..services.audit_service import AuditService
     from ..services.cancellation_service import CancellationService
@@ -546,6 +550,7 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         await transport.sessions.start_sweeper()
         await upstream_sessions.start()
         await auth_service.bootstrap_admin()
+        await app["role_service"].bootstrap_system_roles()
         if engine is not None:
             await engine.start()
         await llm_provider_service.rewire()  # external providers from DB

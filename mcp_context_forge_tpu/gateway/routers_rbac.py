@@ -1,0 +1,122 @@
+"""RBAC routes: role CRUD, user-role assignment, permission inspection.
+
+Reference surface: `/root/reference/mcpgateway/routers/rbac.py`
+(`/rbac/roles` CRUD, `/rbac/users/{email}/roles` assign/list/revoke,
+`/rbac/permissions/check`, `/rbac/permissions/user/{email}`). Guarded by
+``admin.all`` (the reference's `admin.user_management` family maps onto
+the single admin tier here); resolution itself happens in
+`auth_service.resolve_*`, so an assignment changes `require()` outcomes
+on the user's next request.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from ..services.role_service import RoleService
+from .pagination import paginate
+
+
+def setup_rbac_routes(app: web.Application) -> None:
+    routes = web.RouteTableDef()
+    service: RoleService = app["role_service"]
+
+    # ------------------------------------------------------------ role CRUD
+    @routes.get("/rbac/roles")
+    async def list_roles(request: web.Request) -> web.Response:
+        request["auth"].require("admin.all")
+        rows = await service.list_roles()
+        return paginate(request, rows, lambda page: list(page),
+                        key=lambda row: row["id"])
+
+    @routes.post("/rbac/roles")
+    async def create_role(request: web.Request) -> web.Response:
+        auth = request["auth"]
+        auth.require("admin.all")
+        body = await request.json()
+        role = await service.create_role(
+            body.get("name", ""), body.get("permissions") or [],
+            description=body.get("description", ""),
+            scope=body.get("scope", "global"), created_by=auth.user)
+        return web.json_response(role, status=201)
+
+    @routes.get("/rbac/roles/{role_id}")
+    async def get_role(request: web.Request) -> web.Response:
+        request["auth"].require("admin.all")
+        return web.json_response(
+            await service.get_role(request.match_info["role_id"]))
+
+    @routes.put("/rbac/roles/{role_id}")
+    async def update_role(request: web.Request) -> web.Response:
+        request["auth"].require("admin.all")
+        body = await request.json()
+        role = await service.update_role(
+            request.match_info["role_id"], name=body.get("name"),
+            description=body.get("description"),
+            permissions=body.get("permissions"))
+        return web.json_response(role)
+
+    @routes.delete("/rbac/roles/{role_id}")
+    async def delete_role(request: web.Request) -> web.Response:
+        request["auth"].require("admin.all")
+        await service.delete_role(request.match_info["role_id"])
+        return web.Response(status=204)
+
+    # ----------------------------------------------------------- assignment
+    @routes.get("/rbac/users/{email}/roles")
+    async def user_roles(request: web.Request) -> web.Response:
+        request["auth"].require("admin.all")
+        return web.json_response(
+            await service.user_roles(request.match_info["email"]))
+
+    @routes.post("/rbac/users/{email}/roles")
+    async def assign_role(request: web.Request) -> web.Response:
+        auth = request["auth"]
+        auth.require("admin.all")
+        body = await request.json()
+        grant = await service.assign_role(
+            request.match_info["email"], body.get("role_id", ""),
+            scope_id=body.get("scope_id", ""), granted_by=auth.user)
+        return web.json_response(grant, status=201)
+
+    @routes.delete("/rbac/users/{email}/roles/{role_id}")
+    async def revoke_role(request: web.Request) -> web.Response:
+        request["auth"].require("admin.all")
+        await service.revoke_role(
+            request.match_info["email"], request.match_info["role_id"],
+            scope_id=request.query.get("scope_id", ""))
+        return web.Response(status=204)
+
+    # ----------------------------------------------------------- inspection
+    @routes.get("/rbac/permissions/user/{email}")
+    async def user_permissions(request: web.Request) -> web.Response:
+        """Effective permission set via the SAME helper resolve_* uses —
+        the inspector can never drift from enforcement. Team-scoped
+        grants resolve against the user's memberships; per-assignment
+        detail lives at /rbac/users/{email}/roles."""
+        request["auth"].require("admin.all")
+        email = request.match_info["email"]
+        perms, is_admin, is_active = \
+            await request.app["auth_service"].effective_permissions(email)
+        return web.json_response(
+            {"user_email": email, "is_admin": is_admin,
+             "is_active": is_active, "permissions": sorted(perms)})
+
+    @routes.post("/rbac/permissions/check")
+    async def check_permission(request: web.Request) -> web.Response:
+        request["auth"].require("admin.all")
+        body = await request.json()
+        email = body.get("user_email", "")
+        permission = body.get("permission", "")
+        perms, is_admin, is_active = \
+            await request.app["auth_service"].effective_permissions(email)
+        # mirrors AuthContext.can for an unscoped identity — plus the
+        # deactivation gate resolve_* applies before permissions matter
+        granted = is_active and (is_admin or "admin.all" in perms
+                                 or permission in perms)
+        return web.json_response({"user_email": email,
+                                  "permission": permission,
+                                  "is_active": is_active,
+                                  "granted": granted})
+
+    app.add_routes(routes)

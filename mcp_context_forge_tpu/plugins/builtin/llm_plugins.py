@@ -168,46 +168,55 @@ class SummarizerPlugin(Plugin):
 
         key = self._key(prompt, text, max_tokens)
         ttl = float(self.config.config.get("cache_ttl_seconds", 600))
-        now = time.monotonic()
-        hit = self._cache.get(key)
-        if hit is not None and hit[1] > now:
-            self._cache.pop(key)        # true LRU: a hit refreshes recency
-            self._cache[key] = hit
-            context.metadata["summary_cache_hit"] = True
-            return {"content": [{"type": "text", "text": hit[0]}],
-                    "isError": False, "_summarized": True}
+        while True:
+            hit = self._cache.get(key)
+            if hit is not None and hit[1] > time.monotonic():
+                self._cache.pop(key)    # true LRU: a hit refreshes recency
+                self._cache[key] = hit
+                context.metadata["summary_cache_hit"] = True
+                return {"content": [{"type": "text", "text": hit[0]}],
+                        "isError": False, "_summarized": True}
 
-        flight = self._inflight.get(key)
-        if flight is None:
-            flight = asyncio.get_running_loop().create_future()
-            self._inflight[key] = flight
+            flight = self._inflight.get(key)
+            if flight is None:
+                break  # become the leader below
             try:
-                summary = await self._summarize(registry, prompt, text,
-                                                max_tokens)
-            except BaseException as exc:
-                # BaseException: a CancelledError (client disconnect) must
-                # not strand a forever-pending future in _inflight — every
-                # later identical call would await it until restart
-                if isinstance(exc, Exception):
-                    flight.set_exception(exc)
-                    # an unawaited exception-holding future must not warn
-                    flight.exception()
-                else:
-                    flight.cancel()
-                self._inflight.pop(key, None)
-                raise
-            max_entries = int(self.config.config.get("cache_max_entries", 256))
-            if max_entries > 0:
-                while len(self._cache) >= max_entries:
-                    self._cache.pop(next(iter(self._cache)))
-                self._cache[key] = (summary, time.monotonic() + ttl)
-            flight.set_result(summary)
-            # cache first, THEN retire the flight: a caller arriving in
-            # between finds one or the other, never neither
+                summary = await flight  # coalesce onto the in-flight call
+                context.metadata["summary_cache_hit"] = True
+                return {"content": [{"type": "text", "text": summary}],
+                        "isError": False, "_summarized": True}
+            except asyncio.CancelledError:
+                if flight.cancelled():
+                    continue  # only the LEADER's client died: retry —
+                              # this follower may become the new leader
+                raise         # this follower's own task was cancelled
+
+        flight = asyncio.get_running_loop().create_future()
+        self._inflight[key] = flight
+        try:
+            summary = await self._summarize(registry, prompt, text,
+                                            max_tokens)
+        except BaseException as exc:
+            # BaseException: a CancelledError (client disconnect) must
+            # not strand a forever-pending future in _inflight — every
+            # later identical call would await it until restart
+            if isinstance(exc, Exception):
+                flight.set_exception(exc)
+                # an unawaited exception-holding future must not warn
+                flight.exception()
+            else:
+                flight.cancel()
             self._inflight.pop(key, None)
-        else:
-            summary = await flight  # coalesce onto the in-flight call
-            context.metadata["summary_cache_hit"] = True
+            raise
+        max_entries = int(self.config.config.get("cache_max_entries", 256))
+        if max_entries > 0:
+            while len(self._cache) >= max_entries:
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[key] = (summary, time.monotonic() + ttl)
+        flight.set_result(summary)
+        # cache first, THEN retire the flight: a caller arriving in
+        # between finds one or the other, never neither
+        self._inflight.pop(key, None)
         return {"content": [{"type": "text", "text": summary}],
                 "isError": False, "_summarized": True}
 

@@ -319,16 +319,22 @@ class AuthService:
         if user_row and not user_row["is_active"]:
             raise AuthError("User deactivated")
         is_admin = bool(user_row and user_row["is_admin"])
+        teams = await self.user_teams(email)
         scopes = payload.get("scopes")
         if scopes:
+            # scoped tokens derive power SOLELY from their scopes — role
+            # grants made after minting must not widen them
             perms = set(scopes) & PERMISSIONS
             # is_admin feeds direct checks in several services; a scoped
             # token only keeps it when admin.all was explicitly granted
             is_admin = is_admin and "admin.all" in perms
+        elif is_admin:
+            perms = set(PERMISSIONS)
         else:
-            perms = set(PERMISSIONS) if is_admin else set(DEFAULT_USER_PERMISSIONS)
+            perms = (set(DEFAULT_USER_PERMISSIONS)
+                     | await self._role_permissions(email, teams))
         return AuthContext(user=email, is_admin=is_admin,
-                           teams=await self.user_teams(email),
+                           teams=teams,
                            permissions=perms, token_jti=jti,
                            server_id=payload.get("server_id"), via="jwt",
                            scoped=bool(scopes))
@@ -346,8 +352,36 @@ class AuthService:
             row = await self.ctx.db.fetchone("SELECT is_admin FROM users WHERE email=?",
                                              (username,))
             is_admin = bool(row and row["is_admin"])
+            teams = await self.user_teams(username)
+            perms = (set(PERMISSIONS) if is_admin
+                     else set(DEFAULT_USER_PERMISSIONS)
+                     | await self._role_permissions(username, teams))
             return AuthContext(user=username, is_admin=is_admin,
-                               teams=await self.user_teams(username),
-                               permissions=set(PERMISSIONS) if is_admin
-                               else set(DEFAULT_USER_PERMISSIONS), via="basic")
+                               teams=teams, permissions=perms, via="basic")
         raise AuthError("Invalid credentials")
+
+    async def _role_permissions(self, email: str,
+                                teams: list[str]) -> set[str]:
+        """Permissions granted through role assignments (role_service.py —
+        the roles/user_roles tables); resolved per request so an
+        assignment change takes effect on the next call."""
+        from .role_service import RoleService
+        return await RoleService(self.ctx).role_permissions(email, teams)
+
+    async def effective_permissions(self, email: str
+                                    ) -> tuple[set[str], bool, bool]:
+        """(permissions, is_admin, is_active) exactly as ``resolve_*``
+        would compute them for an unscoped identity — the ONE place the
+        resolution rule lives, shared by the /rbac inspection endpoints
+        so they can never drift from enforcement."""
+        row = await self.ctx.db.fetchone(
+            "SELECT is_admin, is_active FROM users WHERE email=?", (email,))
+        is_admin = bool(row and row["is_admin"])
+        is_active = bool(row is None or row["is_active"])
+        teams = await self.user_teams(email)
+        if is_admin:
+            perms = set(PERMISSIONS)
+        else:
+            perms = (set(DEFAULT_USER_PERMISSIONS)
+                     | await self._role_permissions(email, teams))
+        return perms, is_admin, is_active

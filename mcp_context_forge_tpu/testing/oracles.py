@@ -165,6 +165,38 @@ def jsonrpc_oracle(mod: types.ModuleType) -> None:
         assert m in mod.NOTIFICATION_METHODS, m
 
 
+# ------------------------------------------------- RoleGrantResolver (RBAC)
+
+def role_resolver_oracle(mod: types.ModuleType) -> None:
+    """Contract of role-assignment permission resolution (role_service.py):
+    global grants always apply, team grants only with membership, grants
+    never escape the catalog, and no scope ever leaks across teams."""
+    resolve = mod.RoleGrantResolver.resolve
+    catalog = {"a.read", "a.write", "b.read", "c.run"}
+    rows = [
+        {"scope": "global", "scope_id": "", "permissions": '["a.read"]'},
+        {"scope": "team", "scope_id": "t1", "permissions": '["a.write"]'},
+        {"scope": "team", "scope_id": "t2", "permissions": '["b.read"]'},
+        {"scope": "global", "scope_id": "", "permissions": '["ghost.perm"]'},
+    ]
+    assert resolve(rows, ["t1"], catalog) == {"a.read", "a.write"}
+    assert resolve(rows, [], catalog) == {"a.read"}
+    assert resolve(rows, ["t2"], catalog) == {"a.read", "b.read"}
+    assert resolve(rows, ["t1", "t2"], catalog) == {"a.read", "a.write",
+                                                    "b.read"}
+    assert resolve(rows, ["t3"], catalog) == {"a.read"}
+    assert resolve([], ["t1"], catalog) == set()
+    # multi-permission rows resolve in full; catalog intersection applies
+    many = [{"scope": "global", "scope_id": "",
+             "permissions": '["a.read", "c.run", "x.never"]'}]
+    assert resolve(many, [], catalog) == {"a.read", "c.run"}
+    # a team grant needs BOTH conditions: team scope AND membership — a
+    # global row with a stray scope_id must still apply
+    stray = [{"scope": "global", "scope_id": "tX",
+              "permissions": '["b.read"]'}]
+    assert resolve(stray, [], catalog) == {"b.read"}
+
+
 # ----------------------------------------------------- AuthContext (RBAC)
 
 def auth_context_oracle(mod: types.ModuleType) -> None:
@@ -712,6 +744,13 @@ TARGETS: dict[str, MutationTarget] = {
         module_name="mcp_context_forge_tpu.jsonrpc",
         package="mcp_context_forge_tpu",
         oracle=jsonrpc_oracle,
+    ),
+    "role_resolver": MutationTarget(
+        rel_path="services/role_service.py",
+        module_name="mcp_context_forge_tpu.services.role_service",
+        package="mcp_context_forge_tpu.services",
+        oracle=role_resolver_oracle,
+        class_name="RoleGrantResolver",
     ),
     "auth_context": MutationTarget(
         rel_path="services/auth_service.py",

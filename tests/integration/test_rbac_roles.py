@@ -1,0 +1,273 @@
+"""RBAC role management (round-4 VERDICT next #3): role CRUD, user-role
+assignment, and permission resolution through the ``roles``/``user_roles``
+tables — assignments must CHANGE ``require()`` outcomes on the user's
+next request. Reference: `/root/reference/mcpgateway/routers/rbac.py` +
+`services/role_service.py` + Role/UserRole models (`db.py:1154-1308`).
+"""
+
+import aiohttp
+
+from tests.integration.test_gateway_app import BASIC, make_client
+
+ADMIN = aiohttp.BasicAuth(*BASIC)
+USER_EMAIL, USER_PASSWORD = "dev@example.com", "Str0ng!passw0rd#1"
+USER = aiohttp.BasicAuth(USER_EMAIL, USER_PASSWORD)
+
+
+async def _create_user(client, email=USER_EMAIL, password=USER_PASSWORD):
+    resp = await client.post("/admin/users", json={
+        "email": email, "password": password}, auth=ADMIN)
+    assert resp.status == 201, await resp.text()
+
+
+async def test_system_roles_seeded_and_protected():
+    client = await make_client()
+    try:
+        resp = await client.get("/rbac/roles", auth=ADMIN)
+        assert resp.status == 200
+        roles = {r["name"]: r for r in await resp.json()}
+        assert {"platform_admin", "developer", "viewer"} <= set(roles)
+        assert roles["platform_admin"]["is_system"] is True
+        assert "admin.all" in roles["platform_admin"]["permissions"]
+        # immutable + undeletable
+        rid = roles["viewer"]["id"]
+        resp = await client.put(f"/rbac/roles/{rid}",
+                                json={"description": "x"}, auth=ADMIN)
+        assert resp.status in (400, 422)
+        resp = await client.delete(f"/rbac/roles/{rid}", auth=ADMIN)
+        assert resp.status in (400, 422)
+    finally:
+        await client.close()
+
+
+async def test_role_crud_and_validation():
+    client = await make_client()
+    try:
+        resp = await client.post("/rbac/roles", json={
+            "name": "ops", "permissions": ["tools.read", "tools.invoke"],
+            "description": "operators"}, auth=ADMIN)
+        assert resp.status == 201, await resp.text()
+        role = await resp.json()
+        assert role["permissions"] == ["tools.invoke", "tools.read"]
+
+        # unknown permission rejected
+        resp = await client.post("/rbac/roles", json={
+            "name": "bad", "permissions": ["not.a.permission"]}, auth=ADMIN)
+        assert resp.status in (400, 422)
+        # duplicate name rejected
+        resp = await client.post("/rbac/roles", json={
+            "name": "ops", "permissions": ["tools.read"]}, auth=ADMIN)
+        assert resp.status == 409
+
+        resp = await client.put(f"/rbac/roles/{role['id']}", json={
+            "permissions": ["tools.read"]}, auth=ADMIN)
+        assert resp.status == 200
+        assert (await resp.json())["permissions"] == ["tools.read"]
+
+        resp = await client.delete(f"/rbac/roles/{role['id']}", auth=ADMIN)
+        assert resp.status == 204
+        resp = await client.get(f"/rbac/roles/{role['id']}", auth=ADMIN)
+        assert resp.status == 404
+    finally:
+        await client.close()
+
+
+async def test_assignment_changes_require_outcomes():
+    """The VERDICT's acceptance shape: a permission denied before the
+    grant is allowed after it, and denied again after revocation — no
+    restart, no re-login."""
+    client = await make_client()
+    try:
+        await _create_user(client)
+        # baseline: default users cannot create tools
+        resp = await client.post("/tools", json={
+            "name": "t1", "integration_type": "REST",
+            "url": "http://127.0.0.1:1/x"}, auth=USER)
+        assert resp.status == 403
+
+        roles = {r["name"]: r for r in
+                 await (await client.get("/rbac/roles", auth=ADMIN)).json()}
+        dev_id = roles["developer"]["id"]
+        resp = await client.post(f"/rbac/users/{USER_EMAIL}/roles",
+                                 json={"role_id": dev_id}, auth=ADMIN)
+        assert resp.status == 201, await resp.text()
+
+        # next request: tools.create now granted through the role
+        resp = await client.post("/tools", json={
+            "name": "t1", "integration_type": "REST",
+            "url": "http://127.0.0.1:1/x"}, auth=USER)
+        assert resp.status == 201, await resp.text()
+
+        resp = await client.delete(
+            f"/rbac/users/{USER_EMAIL}/roles/{dev_id}", auth=ADMIN)
+        assert resp.status == 204
+        resp = await client.post("/tools", json={
+            "name": "t2", "integration_type": "REST",
+            "url": "http://127.0.0.1:1/x"}, auth=USER)
+        assert resp.status == 403
+    finally:
+        await client.close()
+
+
+async def test_team_scoped_role_applies_only_with_membership():
+    client = await make_client()
+    try:
+        await _create_user(client)
+        team = await (await client.post(
+            "/teams", json={"name": "plat"}, auth=ADMIN)).json()
+        resp = await client.post("/rbac/roles", json={
+            "name": "team-plugin-admin", "scope": "team",
+            "permissions": ["plugins.manage"]}, auth=ADMIN)
+        role = await resp.json()
+
+        # scope_id mandatory for team roles
+        resp = await client.post(f"/rbac/users/{USER_EMAIL}/roles",
+                                 json={"role_id": role["id"]}, auth=ADMIN)
+        assert resp.status in (400, 422)
+
+        resp = await client.post(
+            f"/rbac/users/{USER_EMAIL}/roles",
+            json={"role_id": role["id"], "scope_id": team["id"]}, auth=ADMIN)
+        assert resp.status == 201, await resp.text()
+
+        # the user is NOT a member of the team: grant stays dormant
+        resp = await client.get("/plugins", auth=USER)
+        assert resp.status == 403
+
+        resp = await client.post(f"/teams/{team['id']}/members", json={
+            "email": USER_EMAIL, "role": "member"}, auth=ADMIN)
+        assert resp.status in (200, 201, 204), await resp.text()
+
+        # membership + team-scoped grant => permission active
+        resp = await client.get("/plugins", auth=USER)
+        assert resp.status == 200
+    finally:
+        await client.close()
+
+
+async def test_scoped_token_unaffected_by_later_role_grant():
+    """Scoped API tokens derive power solely from their minted scopes:
+    a role granted AFTER minting must not widen the token."""
+    client = await make_client()
+    try:
+        await _create_user(client)
+        # minting needs tokens.manage, itself granted through a role here
+        resp = await client.post("/rbac/roles", json={
+            "name": "minter", "permissions": ["tokens.manage"]}, auth=ADMIN)
+        minter = await resp.json()
+        resp = await client.post(f"/rbac/users/{USER_EMAIL}/roles",
+                                 json={"role_id": minter["id"]}, auth=ADMIN)
+        assert resp.status == 201
+        resp = await client.post("/auth/tokens", json={
+            "name": "ci", "permissions": ["tools.read"]}, auth=USER)
+        assert resp.status == 201, await resp.text()
+        token = (await resp.json())["token"]
+        bearer = {"Authorization": f"Bearer {token}"}
+
+        roles = {r["name"]: r for r in
+                 await (await client.get("/rbac/roles", auth=ADMIN)).json()}
+        resp = await client.post(
+            f"/rbac/users/{USER_EMAIL}/roles",
+            json={"role_id": roles["developer"]["id"]}, auth=ADMIN)
+        assert resp.status == 201
+
+        resp = await client.get("/tools", headers=bearer)
+        assert resp.status == 200
+        resp = await client.post("/tools", json={
+            "name": "t", "integration_type": "REST",
+            "url": "http://127.0.0.1:1/x"}, headers=bearer)
+        assert resp.status == 403  # token scope, not role, decides
+    finally:
+        await client.close()
+
+
+async def test_permission_inspection_endpoints():
+    client = await make_client()
+    try:
+        await _create_user(client)
+        resp = await client.post("/rbac/permissions/check", json={
+            "user_email": USER_EMAIL, "permission": "tools.create"},
+            auth=ADMIN)
+        assert (await resp.json())["granted"] is False
+
+        roles = {r["name"]: r for r in
+                 await (await client.get("/rbac/roles", auth=ADMIN)).json()}
+        await client.post(f"/rbac/users/{USER_EMAIL}/roles",
+                          json={"role_id": roles["developer"]["id"]},
+                          auth=ADMIN)
+        resp = await client.post("/rbac/permissions/check", json={
+            "user_email": USER_EMAIL, "permission": "tools.create"},
+            auth=ADMIN)
+        assert (await resp.json())["granted"] is True
+
+        resp = await client.get(f"/rbac/permissions/user/{USER_EMAIL}",
+                                auth=ADMIN)
+        perms = (await resp.json())["permissions"]
+        assert "tools.create" in perms and "admin.all" not in perms
+
+        resp = await client.get(f"/rbac/users/{USER_EMAIL}/roles",
+                                auth=ADMIN)
+        assigned = await resp.json()
+        assert [r["name"] for r in assigned] == ["developer"]
+    finally:
+        await client.close()
+
+
+async def test_rbac_surface_requires_admin():
+    client = await make_client()
+    try:
+        await _create_user(client)
+        for method, path in (("GET", "/rbac/roles"),
+                             ("POST", "/rbac/roles"),
+                             ("GET", f"/rbac/users/{USER_EMAIL}/roles"),
+                             ("POST", "/rbac/permissions/check")):
+            resp = await client.request(method, path, json={}, auth=USER)
+            assert resp.status == 403, (method, path, resp.status)
+    finally:
+        await client.close()
+
+
+async def test_update_role_is_atomic_on_validation_failure():
+    """A rejected update must leave the role untouched — no silent
+    partial rename before the permissions validation fails."""
+    client = await make_client()
+    try:
+        role = await (await client.post("/rbac/roles", json={
+            "name": "atomic", "permissions": ["tools.read"]},
+            auth=ADMIN)).json()
+        resp = await client.put(f"/rbac/roles/{role['id']}", json={
+            "name": "renamed", "permissions": ["not.a.permission"]},
+            auth=ADMIN)
+        assert resp.status in (400, 422)
+        fresh = await (await client.get(f"/rbac/roles/{role['id']}",
+                                        auth=ADMIN)).json()
+        assert fresh["name"] == "atomic"
+        assert fresh["permissions"] == ["tools.read"]
+    finally:
+        await client.close()
+
+
+async def test_permission_check_respects_deactivation():
+    """The inspector shares the resolution helper with enforcement: a
+    deactivated user reports granted=false even with roles assigned."""
+    client = await make_client()
+    try:
+        await _create_user(client)
+        roles = {r["name"]: r for r in
+                 await (await client.get("/rbac/roles", auth=ADMIN)).json()}
+        await client.post(f"/rbac/users/{USER_EMAIL}/roles",
+                          json={"role_id": roles["developer"]["id"]},
+                          auth=ADMIN)
+        resp = await client.post("/rbac/permissions/check", json={
+            "user_email": USER_EMAIL, "permission": "tools.create"},
+            auth=ADMIN)
+        assert (await resp.json())["granted"] is True
+
+        await client.post(f"/admin/users/{USER_EMAIL}/toggle", auth=ADMIN)
+        resp = await client.post("/rbac/permissions/check", json={
+            "user_email": USER_EMAIL, "permission": "tools.create"},
+            auth=ADMIN)
+        body = await resp.json()
+        assert body["granted"] is False and body["is_active"] is False
+    finally:
+        await client.close()

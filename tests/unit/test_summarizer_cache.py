@@ -184,3 +184,24 @@ async def test_eviction_is_lru_not_fifo():
     await plugin.tool_post_invoke("t", _result(LONG + "a"), ctx)
     assert ctx.metadata.get("summary_cache_hit") is True
     assert len(registry.calls) == 3  # a, b, c — never a twice
+
+
+async def test_followers_survive_leader_cancellation():
+    """When the LEADER's client disconnects mid-decode, coalesced
+    followers (whose clients are fine) must retry — one becomes the new
+    leader — instead of failing with the leader's CancelledError."""
+    registry = _CountingRegistry(delay=0.1)
+    plugin = _plugin(registry)
+    leader = asyncio.ensure_future(
+        plugin.tool_post_invoke("t", _result(LONG), PluginContext()))
+    await asyncio.sleep(0.02)
+    followers = [asyncio.ensure_future(
+        plugin.tool_post_invoke("t", _result(LONG), PluginContext()))
+        for _ in range(3)]
+    await asyncio.sleep(0.02)
+    leader.cancel()
+    results = await asyncio.gather(*followers)
+    assert all(r["_summarized"] is True for r in results)
+    assert len({r["content"][0]["text"] for r in results}) == 1
+    # leader's call + exactly one retry leader
+    assert len(registry.calls) == 2
