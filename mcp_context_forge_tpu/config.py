@@ -80,6 +80,20 @@ class Settings(BaseModel):
     max_header_bytes: int = 32768         # 431 above this (0 = unlimited)
     cors_allowed_origins: str = ""        # csv; "*" = any; "" = CORS off
 
+    # --- CSRF / session protections (reference csrf_middleware.py +
+    # password_change_enforcement.py) ---
+    csrf_enabled: bool = True
+    csrf_trusted_origins_csv: str = ""   # extra allowed Origin values
+    csrf_token_ttl_s: float = 8 * 3600.0
+    password_change_enforcement_enabled: bool = True
+    # --- token usage accounting (reference token_usage_middleware.py) ---
+    token_usage_logging_enabled: bool = True
+    token_usage_log_retention: int = 10000   # rows kept per maintenance pass
+    # --- DB query logging (reference middleware/db_query_logging.py) ---
+    db_query_logging: bool = False
+    db_query_logging_slow_ms: float = 100.0  # WARN above this per query
+    db_query_n1_threshold: int = 3           # same-shape repeats => suspect
+
     # --- protocol / transports ---
     protocol_version: str = "2025-06-18"
     supported_protocol_versions_csv: str = "2025-06-18,2025-03-26,2024-11-05"
@@ -309,6 +323,11 @@ class Settings(BaseModel):
     def cors_origins(self) -> set[str]:
         return {o.strip() for o in self.cors_allowed_origins.split(",")
                 if o.strip()}
+
+    @property
+    def csrf_trusted_origins(self) -> tuple[str, ...]:
+        return tuple(o.strip() for o in self.csrf_trusted_origins_csv.split(",")
+                     if o.strip())
 
     @property
     def supported_protocol_versions(self) -> set[str]:

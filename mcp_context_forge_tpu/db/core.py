@@ -14,13 +14,31 @@ linted instead. # seclint: file-allow S006
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import json
 import sqlite3
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
+from contextlib import contextmanager
 from dataclasses import dataclass
-from typing import Any, Iterable, Sequence
+from typing import Any, Iterable, Iterator, Sequence
+
+# per-task query telemetry (db_query_logging_middleware): None = off;
+# a list collects (normalized sql, elapsed ms) for every statement the
+# current task runs. ContextVar so concurrent requests never interleave.
+_query_capture: contextvars.ContextVar[list | None] = \
+    contextvars.ContextVar("db_query_capture", default=None)
+
+
+@contextmanager
+def query_log_capture() -> Iterator[list[tuple[str, float]]]:
+    """Collect (sql, ms) for every query the enclosed code runs."""
+    token = _query_capture.set([])
+    try:
+        yield _query_capture.get()
+    finally:
+        _query_capture.reset(token)
 
 
 @dataclass(frozen=True)
@@ -93,7 +111,15 @@ class Database:
             self._conn.commit()
 
     async def execute(self, sql: str, params: Sequence[Any] = ()) -> list[dict[str, Any]]:
-        return await self._run(self._execute_sync, sql, params)
+        log = _query_capture.get()
+        if log is None:
+            return await self._run(self._execute_sync, sql, params)
+        started = time.monotonic()
+        try:
+            return await self._run(self._execute_sync, sql, params)
+        finally:
+            log.append((" ".join(sql.split()),
+                        (time.monotonic() - started) * 1000))
 
     async def executemany(self, sql: str, seq: list[Sequence[Any]]) -> None:
         await self._run(self._executemany_sync, sql, seq)

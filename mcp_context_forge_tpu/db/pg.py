@@ -94,9 +94,20 @@ class PostgresDatabase:
         return await conn.query(translate_sql(sql), list(params))
 
     async def execute(self, sql: str, params: Sequence[Any] = ()) -> list[dict[str, Any]]:
+        from .core import _query_capture
+        log = _query_capture.get()
         conn = await self._pool.acquire()
         try:
-            return await self._query(conn, sql, params)
+            # clock the statement only: pool-acquire wait is a sizing
+            # signal, not query time — a 1 ms query that waited 150 ms
+            # for a connection must not WARN as a slow query
+            started = time.monotonic() if log is not None else 0.0
+            try:
+                return await self._query(conn, sql, params)
+            finally:
+                if log is not None:
+                    log.append((" ".join(sql.split()),
+                                (time.monotonic() - started) * 1000))
         finally:
             await self._pool.release(conn)
 

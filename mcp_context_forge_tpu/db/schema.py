@@ -389,10 +389,35 @@ ALTER TABLE tool_metrics ADD COLUMN entity_type TEXT NOT NULL DEFAULT 'tool';
 CREATE INDEX IF NOT EXISTS ix_tool_metrics_type ON tool_metrics(entity_type, ts);
 """
 
+# v6: middleware long tail (reference middleware/token_usage_middleware.py
+# TokenUsageLog db.py:5565 + password_change_enforcement.py)
+_V6 = """
+ALTER TABLE users ADD COLUMN password_change_required INTEGER NOT NULL DEFAULT 0;
+CREATE TABLE IF NOT EXISTS token_usage_logs (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  token_jti TEXT NOT NULL,
+  user_email TEXT,
+  ts REAL NOT NULL,
+  method TEXT NOT NULL,
+  path TEXT NOT NULL,
+  status INTEGER NOT NULL,
+  response_ms REAL NOT NULL,
+  client_ip TEXT,
+  user_agent TEXT,
+  blocked INTEGER NOT NULL DEFAULT 0,
+  block_reason TEXT
+);
+CREATE INDEX IF NOT EXISTS ix_token_usage_jti_ts
+  ON token_usage_logs(token_jti, ts);
+CREATE INDEX IF NOT EXISTS ix_token_usage_email_ts
+  ON token_usage_logs(user_email, ts);
+"""
+
 MIGRATIONS: list[Migration] = [
     Migration(1, "initial-core-schema", _V1),
     Migration(2, "a2a-task-store", _V2),
     Migration(3, "mcp-app-sessions", _V3),
     Migration(4, "registered-oauth-clients", _V4),
     Migration(5, "per-entity-metrics", _V5),
+    Migration(6, "token-usage-and-password-enforcement", _V6),
 ]

@@ -85,6 +85,17 @@ _PAGE = """<!doctype html>
  <div id="detail"></div>
 </main>
 <script>
+// double-submit CSRF: echo the csrf_token cookie on every fetch — a
+// cross-site page can make the browser SEND the cookie but cannot READ
+// it, so the echo proves this same-origin script issued the request
+const _fetch = window.fetch.bind(window);
+window.fetch = (url, opts) => {
+  opts = opts || {};
+  const m = document.cookie.match(/(?:^|; )csrf_token=([^;]*)/);
+  opts.headers = Object.assign({}, opts.headers,
+                               m ? {"X-CSRF-Token": m[1]} : {});
+  return _fetch(url, opts);
+};
 const TABS = {
   tools:    {paged:true, url: "/tools?include_inactive=true", cols: ["name","integration_type","url","enabled","reachable"], toggle: id => `/tools/${id}/toggle`, boolcols: ["enabled","reachable"],
              create: {url:"/tools", fields:["name","integration_type","url","description","tags:csv"]},
@@ -115,7 +126,8 @@ const TABS = {
              create: {url:"/plugins/bindings", fields:["plugin_name","scope_type","scope_id","mode","config:json"]},
              del: id => `/plugins/bindings/${id}`},
   users:    {paged:true, url: "/admin/users", cols: ["email","full_name","is_admin","is_active","auth_provider","last_login"], toggle: id => `/admin/users/${encodeURIComponent(id)}/toggle`, idcol: "email", boolcols: ["is_admin","is_active"],
-             create: {url:"/admin/users", fields:["email","password","full_name"]}},
+             create: {url:"/admin/users", fields:["email","password","full_name"]},
+             rowacts: [{label:"require pw change", method:"POST", key:"email", show:true, url: e => `/admin/users/${encodeURIComponent(e)}/require-password-change`}]},
   teams:    {url: "/teams", cols: ["name","slug","visibility","is_personal","created_by"], boolcols: ["is_personal"],
              create: {url:"/teams", fields:["name","visibility"]},
              del: id => `/teams/${id}`, detail: id => `/teams/${id}`, special: "teams"},
@@ -124,7 +136,8 @@ const TABS = {
              del: id => `/rbac/roles/${id}`, detail: id => `/rbac/roles/${id}`, special: "roles"},
   tokens:   {url: "/auth/tokens", cols: ["name","server_id","expires_at","last_used","revoked_at"],
              create: {url:"/auth/tokens", fields:["name","expires_minutes:int","permissions:csv","server_id"], reveal: "token"},
-             del: id => `/auth/tokens/${id}`},
+             del: id => `/auth/tokens/${id}`,
+             rowacts: [{label:"usage", method:"GET", show:true, url: id => `/auth/tokens/${id}/usage`}]},
   providers:{url: "/llm/providers", cols: ["name","provider_type","api_base","enabled"], boolcols: ["enabled"],
              create: {url:"/llm/providers", fields:["name","provider_type","api_base","api_key"]},
              del: id => `/llm/providers/${id}`},
@@ -731,7 +744,19 @@ show("tools");
 def setup_admin_ui(app: web.Application) -> None:
     async def admin_page(request: web.Request) -> web.Response:
         request["auth"].require("observability.read")
-        return web.Response(text=_PAGE, content_type="text/html")
+        response = web.Response(text=_PAGE, content_type="text/html")
+        # double-submit CSRF: the page JS echoes this cookie's value in
+        # X-CSRF-Token on every mutating fetch (csrf_middleware validates)
+        settings = request.app["ctx"].settings
+        if settings.csrf_enabled:
+            from ..services import csrf_service
+            token = csrf_service.mint(request["auth"].user,
+                                      settings.jwt_secret_key,
+                                      ttl_s=settings.csrf_token_ttl_s)
+            response.set_cookie(csrf_service.COOKIE_NAME, token,
+                                httponly=False,  # JS must read to echo
+                                samesite="Strict", path="/")
+        return response
 
     app.router.add_get("/admin", admin_page)
     app.router.add_get("/admin/", admin_page)

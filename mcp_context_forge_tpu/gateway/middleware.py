@@ -332,6 +332,177 @@ async def auth_middleware(request: web.Request, handler: Handler) -> web.StreamR
 
 
 @web.middleware
+async def csrf_middleware(request: web.Request, handler: Handler
+                          ) -> web.StreamResponse:
+    """CSRF protection for the ambient-credential surface (reference
+    middleware/csrf_middleware.py + services/csrf_service.py).
+
+    Runs AFTER auth (needs the resolved identity). Bearer-token requests
+    are exempt — a cross-site page cannot set an Authorization header
+    with a token it doesn't hold. Basic-auth and cookie-session requests
+    ride credentials the BROWSER attaches automatically, so unsafe
+    methods must prove same-origin provenance:
+
+    - browser-declared cross-site (``Sec-Fetch-Site``/mismatched
+      ``Origin``) → 403 (non-browser clients send neither header and are
+      not CSRF-able);
+    - when the admin page's ``csrf_token`` cookie is present, the
+      ``X-CSRF-Token`` header must echo it and verify (double-submit:
+      cross-site JS can make the browser SEND the cookie, not READ it).
+    """
+    from ..services import csrf_service
+
+    settings = request.app["ctx"].settings
+    if (not settings.csrf_enabled
+            or request.method in csrf_service.SAFE_METHODS
+            or request.path in PUBLIC_PATHS):
+        return await handler(request)
+    auth = request.get("auth")
+    header = request.headers.get("authorization", "")
+    if header.lower().startswith("bearer ") or auth is None \
+            or auth.via == "anonymous":
+        return await handler(request)
+    host = request.headers.get("host", "")
+    if csrf_service.browser_cross_site(request.headers, host,
+                                       settings.csrf_trusted_origins):
+        return web.json_response(
+            {"detail": "CSRF validation failed", "code": "CSRF_CROSS_SITE"},
+            status=403)
+    cookie = request.cookies.get(csrf_service.COOKIE_NAME)
+    if cookie:
+        echoed = request.headers.get(csrf_service.HEADER_NAME, "")
+        import hmac as _hmac
+        if not echoed or not _hmac.compare_digest(echoed, cookie) \
+                or not csrf_service.validate(echoed, auth.user,
+                                             settings.jwt_secret_key):
+            return web.json_response(
+                {"detail": "CSRF validation failed",
+                 "code": "CSRF_TOKEN_INVALID"}, status=403)
+    return await handler(request)
+
+
+@web.middleware
+async def password_change_middleware(request: web.Request, handler: Handler
+                                     ) -> web.StreamResponse:
+    """Mandatory password-change enforcement (reference
+    middleware/password_change_enforcement.py): an interactive identity
+    whose ``password_change_required`` flag is set may only reach the
+    password-change surface until it rotates. API tokens (programmatic)
+    are exempt, as are the endpoints needed to perform the change; the
+    REST shape is a 403 with a machine-readable code (the reference's
+    browser tier 303-redirects to its change-password page)."""
+    settings = request.app["ctx"].settings
+    if not settings.password_change_enforcement_enabled:
+        return await handler(request)
+    auth = request.get("auth")
+    if (auth is None or auth.via == "anonymous" or auth.token_jti
+            or auth.scoped or request.path in PUBLIC_PATHS
+            or request.path == "/auth/password"):
+        return await handler(request)
+    # the flag rides AuthContext (read in resolve_*'s existing users-row
+    # fetch) — no extra hot-path query here
+    if auth.password_change_required:
+        return web.json_response(
+            {"detail": "Password change required before further access",
+             "code": "PASSWORD_CHANGE_REQUIRED",
+             "change_url": "/auth/password"}, status=403)
+    return await handler(request)
+
+
+@web.middleware
+async def token_usage_middleware(request: web.Request, handler: Handler
+                                 ) -> web.StreamResponse:
+    """API-token usage accounting (reference
+    middleware/token_usage_middleware.py + TokenUsageLog, db.py:5565):
+    every request that authenticates with an API token (jti-bearing JWT)
+    is recorded — endpoint, status, latency, client — including 4xx
+    outcomes (marked blocked) and 401 rejections of revoked/expired
+    tokens, where the jti is recovered from the unverified payload and
+    checked against the token catalog so forged tokens can't spam the
+    log. Sits OUTSIDE error translation to see final statuses."""
+    settings = request.app["ctx"].settings
+    if not settings.token_usage_logging_enabled:
+        return await handler(request)
+    started = time.monotonic()
+    response = await handler(request)
+    auth = request.get("auth")
+    jti = auth.token_jti if auth is not None else None
+    user_email = auth.user if auth is not None else None
+    if jti is None and response.status in (401, 403):
+        # auth rejected before an identity existed: identify (not trust)
+        # the token, then confirm the jti is a real catalog row
+        header = request.headers.get("authorization", "")
+        if header.lower().startswith("bearer "):
+            from ..utils import jwt as jwt_utils
+            payload = jwt_utils.decode_unverified(header[7:].strip())
+            candidate = (payload or {}).get("jti")
+            if candidate:
+                row = await request.app["ctx"].db.fetchone(
+                    "SELECT jti, user_email FROM api_tokens WHERE jti=?",
+                    (candidate,))
+                if row:
+                    jti = row["jti"]
+                    # catalog row first: the unverified sub is attacker-
+                    # chosen and must not spoof attribution
+                    user_email = row["user_email"] or payload.get("sub")
+    if jti is not None:
+        blocked = 400 <= response.status < 500
+        await request.app["ctx"].db.execute(
+            "INSERT INTO token_usage_logs (token_jti, user_email, ts,"
+            " method, path, status, response_ms, client_ip, user_agent,"
+            " blocked, block_reason) VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+            (jti, user_email, time.time(), request.method, request.path,
+             response.status,
+             round((time.monotonic() - started) * 1000, 2),
+             request.get("client_ip", request.remote),
+             request.headers.get("user-agent", "")[:256],
+             1 if blocked else 0,
+             f"http_{response.status}" if blocked else None))
+    return response
+
+
+@web.middleware
+async def db_query_logging_middleware(request: web.Request, handler: Handler
+                                      ) -> web.StreamResponse:
+    """Per-request DB query telemetry (reference
+    middleware/db_query_logging.py): when enabled, every query the
+    handler runs is collected (innermost middleware — auth-layer queries
+    are excluded by position), slow statements WARN, and N+1 patterns
+    (the same normalized statement repeated >= threshold times) are
+    called out. Response gains X-DB-Query-Count/-Time-MS headers so the
+    signal is scriptable without log scraping."""
+    settings = request.app["ctx"].settings
+    if not settings.db_query_logging:
+        return await handler(request)
+    from ..db.core import query_log_capture
+    with query_log_capture() as queries:
+        response = await handler(request)
+    if not queries:
+        return response
+    logger = request.app.logger
+    total_ms = sum(ms for _, ms in queries)
+    response.headers["X-DB-Query-Count"] = str(len(queries))
+    response.headers["X-DB-Query-Time-MS"] = f"{total_ms:.2f}"
+    for sql, ms in queries:
+        if ms >= settings.db_query_logging_slow_ms:
+            logger.warning("slow query (%.1f ms) on %s %s: %s",
+                           ms, request.method, request.path, sql[:300])
+    shapes: dict[str, int] = {}
+    for sql, _ in queries:
+        shapes[sql] = shapes.get(sql, 0) + 1
+    suspects = {sql: n for sql, n in shapes.items()
+                if n >= settings.db_query_n1_threshold}
+    if suspects:
+        logger.warning(
+            "possible N+1 on %s %s: %s", request.method, request.path,
+            "; ".join(f"{n}x {sql[:160]}" for sql, n in suspects.items()))
+    else:
+        logger.debug("%s %s ran %d queries in %.2f ms", request.method,
+                     request.path, len(queries), total_ms)
+    return response
+
+
+@web.middleware
 async def request_logging_middleware(request: web.Request, handler: Handler
                                      ) -> web.StreamResponse:
     """DEBUG-level request/response logging with sensitive-value masking via
@@ -372,9 +543,18 @@ MIDDLEWARES = [
     compression_middleware,
     security_headers_middleware,
     header_size_middleware,
+    # token usage sits OUTSIDE error translation so 401/403 rejections of
+    # revoked tokens surface here as statuses, not exceptions
+    token_usage_middleware,
     error_middleware,
     protocol_version_middleware,
     rate_limit_middleware,
     auth_middleware,
+    # csrf + password-change need the resolved identity (inside auth)
+    csrf_middleware,
+    password_change_middleware,
     request_logging_middleware,
+    # innermost: captures only the HANDLER's queries (auth/limit-layer
+    # queries run above and are excluded by position)
+    db_query_logging_middleware,
 ]

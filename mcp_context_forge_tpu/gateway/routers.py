@@ -24,7 +24,7 @@ from ..schemas import (
     ToolCreate,
     ToolUpdate,
 )
-from ..services.auth_service import AuthError
+from ..services.auth_service import AuthError, PermissionDenied
 from ..services.base import NotFoundError, ValidationFailure
 from .pagination import paginate
 
@@ -144,6 +144,27 @@ def setup_routes(app: web.Application) -> None:
         await request.app["auth_service"].revoke_token(request.match_info["token_id"])
         return web.Response(status=204)
 
+    @routes.get("/auth/tokens/{token_id}/usage")
+    async def token_usage(request: web.Request) -> web.Response:
+        """Usage trail of one API token (reference TokenUsageLog +
+        token_usage_middleware): endpoint, status, latency, client,
+        blocked attempts — owner or admin only."""
+        auth = request["auth"]
+        auth.require("tokens.manage")
+        row = await request.app["ctx"].db.fetchone(
+            "SELECT jti, user_email FROM api_tokens WHERE id=?",
+            (request.match_info["token_id"],))
+        if row is None:
+            raise NotFoundError("Token not found")
+        if row["user_email"] != auth.user and not auth.can("admin.all"):
+            raise PermissionDenied("Not your token")
+        logs = await request.app["ctx"].db.fetchall(
+            "SELECT ts, method, path, status, response_ms, client_ip,"
+            " user_agent, blocked, block_reason FROM token_usage_logs"
+            " WHERE token_jti=? ORDER BY ts DESC LIMIT 500", (row["jti"],))
+        return web.json_response({"token_id": request.match_info["token_id"],
+                                  "entries": logs})
+
     @routes.post("/auth/password")
     async def change_password(request: web.Request) -> web.Response:
         auth = request["auth"]
@@ -162,8 +183,19 @@ def setup_routes(app: web.Application) -> None:
         await request.app["auth_service"].create_user(
             body.get("email", ""), body.get("password", ""),
             full_name=body.get("full_name", ""),
-            is_admin=bool(body.get("is_admin")), enforce_policy=True)
+            is_admin=bool(body.get("is_admin")), enforce_policy=True,
+            require_password_change=bool(body.get("require_password_change")))
         return web.json_response({"email": body.get("email")}, status=201)
+
+    @routes.post("/admin/users/{email}/require-password-change")
+    async def require_password_change(request: web.Request) -> web.Response:
+        """Flag a user for mandatory rotation (reference
+        password_change_enforcement.py); cleared by /auth/password."""
+        request["auth"].require("admin.all")
+        await request.app["auth_service"].set_password_change_required(
+            request.match_info["email"], True)
+        return web.json_response({"email": request.match_info["email"],
+                                  "password_change_required": True})
 
     @routes.get("/admin/users")
     async def list_users(request: web.Request) -> web.Response:

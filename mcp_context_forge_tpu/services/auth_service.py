@@ -61,6 +61,9 @@ class AuthContext:
     server_id: str | None = None  # server-scoped token restriction
     via: str = "jwt"  # jwt|basic|anonymous
     scoped: bool = False  # token carries explicit scopes: no admin shortcut
+    # mandatory-rotation flag (password_change_middleware) — read in the
+    # resolve_* users-row fetch so enforcement costs no extra query
+    password_change_required: bool = False
 
     def can(self, permission: str) -> bool:
         # Scoped tokens derive power solely from their scopes — an admin's
@@ -155,7 +158,8 @@ class AuthService:
 
     async def create_user(self, email: str, password: str, full_name: str = "",
                           is_admin: bool = False,
-                          enforce_policy: bool = False) -> None:
+                          enforce_policy: bool = False,
+                          require_password_change: bool = False) -> None:
         from .base import ConflictError
 
         if enforce_policy:
@@ -166,9 +170,23 @@ class AuthService:
             raise ConflictError(f"User {email} already exists")
         ts = now()
         await self.ctx.db.execute(
-            "INSERT INTO users (email, password_hash, full_name, is_admin, created_at,"
-            " updated_at) VALUES (?,?,?,?,?,?)",
-            (email, _hasher.hash(password), full_name, int(is_admin), ts, ts))
+            "INSERT INTO users (email, password_hash, full_name, is_admin,"
+            " password_change_required, created_at, updated_at)"
+            " VALUES (?,?,?,?,?,?,?)",
+            (email, _hasher.hash(password), full_name, int(is_admin),
+             int(require_password_change), ts, ts))
+
+    async def set_password_change_required(self, email: str,
+                                           required: bool = True) -> None:
+        """Admin lever for the enforcement middleware (reference
+        password_change_enforcement.py): the flagged user can only reach
+        /auth/password until they rotate."""
+        rows = await self.ctx.db.execute(
+            "UPDATE users SET password_change_required=?, updated_at=?"
+            " WHERE email=? RETURNING email",
+            (int(required), now(), email))
+        if not rows:
+            raise NotFoundError(f"User {email} not found")
 
     async def change_password(self, email: str, old_password: str,
                               new_password: str) -> None:
@@ -176,7 +194,8 @@ class AuthService:
             raise AuthError("Current password is incorrect")
         self.validate_password_policy(new_password, email)
         await self.ctx.db.execute(
-            "UPDATE users SET password_hash=?, updated_at=? WHERE email=?",
+            "UPDATE users SET password_hash=?, password_change_required=0,"
+            " updated_at=? WHERE email=?",
             (_hasher.hash(new_password), now(), email))
 
     async def verify_password(self, email: str, password: str) -> bool:
@@ -315,7 +334,8 @@ class AuthService:
                 await self.ctx.db.execute("UPDATE api_tokens SET last_used=? WHERE jti=?",
                                           (now(), jti))
         user_row = await self.ctx.db.fetchone(
-            "SELECT is_admin, is_active FROM users WHERE email=?", (email,))
+            "SELECT is_admin, is_active, password_change_required"
+            " FROM users WHERE email=?", (email,))
         if user_row and not user_row["is_active"]:
             raise AuthError("User deactivated")
         is_admin = bool(user_row and user_row["is_admin"])
@@ -337,7 +357,10 @@ class AuthService:
                            teams=teams,
                            permissions=perms, token_jti=jti,
                            server_id=payload.get("server_id"), via="jwt",
-                           scoped=bool(scopes))
+                           scoped=bool(scopes),
+                           password_change_required=bool(
+                               user_row
+                               and user_row["password_change_required"]))
 
     async def resolve_basic(self, username: str, password: str) -> AuthContext:
         import hmac
@@ -349,15 +372,18 @@ class AuthService:
             return AuthContext(user=settings.platform_admin_email, is_admin=True,
                                permissions=set(PERMISSIONS), via="basic")
         if await self.verify_password(username, password):
-            row = await self.ctx.db.fetchone("SELECT is_admin FROM users WHERE email=?",
-                                             (username,))
+            row = await self.ctx.db.fetchone(
+                "SELECT is_admin, password_change_required FROM users"
+                " WHERE email=?", (username,))
             is_admin = bool(row and row["is_admin"])
             teams = await self.user_teams(username)
             perms = (set(PERMISSIONS) if is_admin
                      else set(DEFAULT_USER_PERMISSIONS)
                      | await self._role_permissions(username, teams))
             return AuthContext(user=username, is_admin=is_admin,
-                               teams=teams, permissions=perms, via="basic")
+                               teams=teams, permissions=perms, via="basic",
+                               password_change_required=bool(
+                                   row and row["password_change_required"]))
         raise AuthError("Invalid credentials")
 
     async def _role_permissions(self, email: str,
@@ -373,11 +399,14 @@ class AuthService:
         """(permissions, is_admin, is_active) exactly as ``resolve_*``
         would compute them for an unscoped identity — the ONE place the
         resolution rule lives, shared by the /rbac inspection endpoints
-        so they can never drift from enforcement."""
+        so they can never drift from enforcement. Unknown users 404:
+        an identity that can never authenticate has no permission set."""
         row = await self.ctx.db.fetchone(
             "SELECT is_admin, is_active FROM users WHERE email=?", (email,))
-        is_admin = bool(row and row["is_admin"])
-        is_active = bool(row is None or row["is_active"])
+        if row is None:
+            raise NotFoundError(f"User {email!r} not found")
+        is_admin = bool(row["is_admin"])
+        is_active = bool(row["is_active"])
         teams = await self.user_teams(email)
         if is_admin:
             perms = set(PERMISSIONS)

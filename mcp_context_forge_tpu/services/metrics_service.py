@@ -76,11 +76,20 @@ class MetricsMaintenanceService:
         return len(rows)
 
     async def cleanup(self) -> int:
-        """Prune raw rows past retention (rollups keep the history)."""
+        """Prune raw rows past retention (rollups keep the history); the
+        token-usage trail keeps its newest ``token_usage_log_retention``
+        rows (reference prunes TokenUsageLog the same maintenance way)."""
         cutoff = time.time() - self.retention_hours * 3600
         before = await self.ctx.db.fetchone(
             "SELECT COUNT(*) AS n FROM tool_metrics WHERE ts < ?", (cutoff,))
         await self.ctx.db.execute("DELETE FROM tool_metrics WHERE ts < ?", (cutoff,))
+        keep = int(getattr(self.ctx.settings, "token_usage_log_retention",
+                           10000))
+        if keep > 0:
+            await self.ctx.db.execute(
+                "DELETE FROM token_usage_logs WHERE id NOT IN"
+                " (SELECT id FROM token_usage_logs ORDER BY ts DESC LIMIT ?)",
+                (keep,))
         return int(before["n"]) if before else 0
 
     async def hourly_summary(self, entity_id: str | None = None,

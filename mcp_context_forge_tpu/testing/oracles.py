@@ -202,8 +202,12 @@ def role_resolver_oracle(mod: types.ModuleType) -> None:
 def auth_context_oracle(mod: types.ModuleType) -> None:
     AC = mod.AuthContext
 
-    # plain user: only granted permissions
+    # plain user: only granted permissions; no spurious rotation flag
+    # (a default-True flag would lock every identity out of the surface)
     user = AC(user="u@x", permissions={"tools.read"})
+    assert user.password_change_required is False
+    assert AC(user="u@x", password_change_required=True
+              ).password_change_required is True
     assert user.can("tools.read")
     assert not user.can("tools.delete")
     assert not user.can("admin.all")

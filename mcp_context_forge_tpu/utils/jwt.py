@@ -45,6 +45,18 @@ def encode(payload: dict[str, Any], secret: str, algorithm: str = "HS256") -> st
     return signing_input + "." + _b64url(sig)
 
 
+def decode_unverified(token: str) -> dict[str, Any] | None:
+    """Payload WITHOUT signature/expiry verification — identification
+    only, never authentication (token-usage accounting of rejected
+    requests needs the jti of a token that failed verification)."""
+    try:
+        _, payload_b64, _ = token.split(".")
+        payload = json.loads(_b64url_decode(payload_b64))
+        return payload if isinstance(payload, dict) else None
+    except (ValueError, json.JSONDecodeError):
+        return None
+
+
 def decode(
     token: str,
     secret: str,

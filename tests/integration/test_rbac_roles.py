@@ -271,3 +271,19 @@ async def test_permission_check_respects_deactivation():
         assert body["granted"] is False and body["is_active"] is False
     finally:
         await client.close()
+
+
+async def test_permission_inspection_unknown_user_404s():
+    """An identity that can never authenticate has no permission set —
+    the inspector must 404, not fabricate the default grants."""
+    client = await make_client()
+    try:
+        resp = await client.post("/rbac/permissions/check", json={
+            "user_email": "no-such@x", "permission": "tools.read"},
+            auth=ADMIN)
+        assert resp.status == 404
+        resp = await client.get("/rbac/permissions/user/no-such@x",
+                                auth=ADMIN)
+        assert resp.status == 404
+    finally:
+        await client.close()
