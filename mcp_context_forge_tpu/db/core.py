@@ -90,12 +90,20 @@ class Database:
 
     # -- statements ----------------------------------------------------------
 
-    def _execute_sync(self, sql: str, params: Sequence[Any]) -> list[dict[str, Any]]:
+    def _execute_sync(self, sql: str, params: Sequence[Any],
+                      timing: list[float] | None = None
+                      ) -> list[dict[str, Any]]:
         assert self._conn is not None, "Database not connected"
         with self._lock:
+            # clock inside the lock: executor/lock queue wait is a
+            # concurrency signal, not query time — a 1 ms SELECT queued
+            # behind a 200 ms statement must not WARN as a slow query
+            started = time.monotonic() if timing is not None else 0.0
             cur = self._conn.execute(sql, params)
             rows = [dict(r) for r in cur.fetchall()]
             self._conn.commit()
+            if timing is not None:
+                timing.append((time.monotonic() - started) * 1000)
             return rows
 
     def _executemany_sync(self, sql: str, seq: list[Sequence[Any]]) -> None:
@@ -114,12 +122,12 @@ class Database:
         log = _query_capture.get()
         if log is None:
             return await self._run(self._execute_sync, sql, params)
-        started = time.monotonic()
+        timing: list[float] = []  # filled under the lock on the db thread
         try:
-            return await self._run(self._execute_sync, sql, params)
+            return await self._run(self._execute_sync, sql, params, timing)
         finally:
             log.append((" ".join(sql.split()),
-                        (time.monotonic() - started) * 1000))
+                        timing[0] if timing else 0.0))
 
     async def executemany(self, sql: str, seq: list[Sequence[Any]]) -> None:
         await self._run(self._executemany_sync, sql, seq)

@@ -413,6 +413,23 @@ CREATE INDEX IF NOT EXISTS ix_token_usage_email_ts
   ON token_usage_logs(user_email, ts);
 """
 
+# v7: persisted compliance reports (reference compliance_router.py +
+# services/compliance_service.py report store)
+_V7 = """
+CREATE TABLE IF NOT EXISTS compliance_reports (
+  id TEXT PRIMARY KEY,
+  framework TEXT NOT NULL,
+  period_start REAL NOT NULL,
+  period_end REAL NOT NULL,
+  generated_at REAL NOT NULL,
+  generated_by TEXT,
+  summary TEXT NOT NULL,
+  report TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS ix_compliance_reports_generated
+  ON compliance_reports(generated_at);
+"""
+
 MIGRATIONS: list[Migration] = [
     Migration(1, "initial-core-schema", _V1),
     Migration(2, "a2a-task-store", _V2),
@@ -420,4 +437,5 @@ MIGRATIONS: list[Migration] = [
     Migration(4, "registered-oauth-clients", _V4),
     Migration(5, "per-entity-metrics", _V5),
     Migration(6, "token-usage-and-password-enforcement", _V6),
+    Migration(7, "compliance-reports", _V7),
 ]

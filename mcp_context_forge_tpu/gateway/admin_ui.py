@@ -131,6 +131,11 @@ const TABS = {
   teams:    {url: "/teams", cols: ["name","slug","visibility","is_personal","created_by"], boolcols: ["is_personal"],
              create: {url:"/teams", fields:["name","visibility"]},
              del: id => `/teams/${id}`, detail: id => `/teams/${id}`, special: "teams"},
+  compliance: {url: "/compliance/reports", cols: ["framework","generated_at","generated_by","summary"],
+             create: {url:"/compliance/reports", fields:["framework","period_days:int"]},
+             detail: id => `/compliance/reports/${id}`,
+             rowacts: [{label:"export md", method:"GET", show:true, url: id => `/compliance/reports/${id}/export?format=markdown`},
+                       {label:"frameworks", method:"GET", show:true, url: () => `/compliance/frameworks`}]},
   roles:    {paged:true, url: "/rbac/roles", cols: ["name","scope","description","is_system","assignment_count"], boolcols: ["is_system"],
              create: {url:"/rbac/roles", fields:["name","description","scope","permissions:csv"]},
              del: id => `/rbac/roles/${id}`, detail: id => `/rbac/roles/${id}`, special: "roles"},

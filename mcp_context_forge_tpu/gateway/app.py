@@ -333,8 +333,11 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     setup_discovery_routes(app)
     from ..services.role_service import RoleService
     app["role_service"] = RoleService(ctx)
-    from .routers_rbac import setup_rbac_routes
+    from ..services.compliance_service import ComplianceService
+    app["compliance_service"] = ComplianceService(ctx)
+    from .routers_rbac import setup_compliance_routes, setup_rbac_routes
     setup_rbac_routes(app)
+    setup_compliance_routes(app)
 
     from ..services.audit_service import AuditService
     from ..services.cancellation_service import CancellationService

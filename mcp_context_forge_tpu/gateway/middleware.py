@@ -442,22 +442,37 @@ async def token_usage_middleware(request: web.Request, handler: Handler
                     (candidate,))
                 if row:
                     jti = row["jti"]
-                    # catalog row first: the unverified sub is attacker-
-                    # chosen and must not spoof attribution
-                    user_email = row["user_email"] or payload.get("sub")
+                    # catalog attribution ONLY: the unverified sub is
+                    # attacker-chosen and must not spoof the trail
+                    user_email = row["user_email"]
     if jti is not None:
-        blocked = 400 <= response.status < 500
-        await request.app["ctx"].db.execute(
-            "INSERT INTO token_usage_logs (token_jti, user_email, ts,"
-            " method, path, status, response_ms, client_ip, user_agent,"
-            " blocked, block_reason) VALUES (?,?,?,?,?,?,?,?,?,?,?)",
-            (jti, user_email, time.time(), request.method, request.path,
-             response.status,
-             round((time.monotonic() - started) * 1000, 2),
-             request.get("client_ip", request.remote),
-             request.headers.get("user-agent", "")[:256],
-             1 if blocked else 0,
-             f"http_{response.status}" if blocked else None))
+        # "blocked" means a security denial (authn/authz/rate limit) —
+        # routine 404s/validation 400s are normal traffic, and counting
+        # them would poison the compliance evidence built on this table
+        blocked = response.status in (401, 403, 429)
+        row_values = (
+            jti, user_email, time.time(), request.method, request.path,
+            response.status,
+            round((time.monotonic() - started) * 1000, 2),
+            request.get("client_ip", request.remote),
+            request.headers.get("user-agent", "")[:256],
+            1 if blocked else 0,
+            f"http_{response.status}" if blocked else None)
+
+        async def _record() -> None:
+            try:
+                await request.app["ctx"].db.execute(
+                    "INSERT INTO token_usage_logs (token_jti, user_email,"
+                    " ts, method, path, status, response_ms, client_ip,"
+                    " user_agent, blocked, block_reason)"
+                    " VALUES (?,?,?,?,?,?,?,?,?,?,?)", row_values)
+            except Exception:  # accounting must never break serving
+                request.app.logger.debug("token usage write failed",
+                                         exc_info=True)
+
+        # off the critical path: the response must not wait on the
+        # serialized DB executor for an accounting write
+        asyncio.ensure_future(_record())
     return response
 
 

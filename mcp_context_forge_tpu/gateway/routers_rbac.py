@@ -120,3 +120,65 @@ def setup_rbac_routes(app: web.Application) -> None:
                                   "granted": granted})
 
     app.add_routes(routes)
+
+
+def setup_compliance_routes(app: web.Application) -> None:
+    """Compliance report generator routes (reference
+    `routers/compliance_router.py`): framework catalog, report
+    generation over an assessment period, retrieval, and export."""
+    from ..services.compliance_service import (CONTROLS, FRAMEWORK_TITLES,
+                                               FRAMEWORKS,
+                                               ComplianceService)
+
+    routes = web.RouteTableDef()
+    service: ComplianceService = app["compliance_service"]
+
+    @routes.get("/compliance/frameworks")
+    async def frameworks(request: web.Request) -> web.Response:
+        request["auth"].require("admin.all")
+        return web.json_response([
+            {"id": fw, "title": FRAMEWORK_TITLES[fw],
+             "controls": [{"id": c.id, "title": c.title}
+                          for c in CONTROLS[fw]]}
+            for fw in FRAMEWORKS])
+
+    @routes.post("/compliance/reports")
+    async def generate(request: web.Request) -> web.Response:
+        auth = request["auth"]
+        auth.require("admin.all")
+        body = await request.json()
+        import time as _time
+        days = float(body.get("period_days") or 30)
+        end = float(body.get("period_end") or _time.time())
+        start = float(body.get("period_start") or (end - days * 86400))
+        report = await service.generate(body.get("framework", ""),
+                                        start, end, generated_by=auth.user)
+        return web.json_response(report, status=201)
+
+    @routes.get("/compliance/reports")
+    async def list_reports(request: web.Request) -> web.Response:
+        request["auth"].require("admin.all")
+        return web.json_response(await service.list_reports())
+
+    @routes.get("/compliance/reports/{report_id}")
+    async def get_report(request: web.Request) -> web.Response:
+        request["auth"].require("admin.all")
+        return web.json_response(
+            await service.get_report(request.match_info["report_id"]))
+
+    @routes.get("/compliance/reports/{report_id}/export")
+    async def export_report(request: web.Request) -> web.Response:
+        request["auth"].require("admin.all")
+        report_id = request.match_info["report_id"]
+        if request.query.get("format", "json") == "markdown":
+            text = await service.export_markdown(report_id)
+            return web.Response(
+                text=text, content_type="text/markdown",
+                headers={"Content-Disposition":
+                         f'attachment; filename="{report_id}.md"'})
+        report = await service.get_report(report_id)
+        return web.json_response(
+            report, headers={"Content-Disposition":
+                             f'attachment; filename="{report_id}.json"'})
+
+    app.add_routes(routes)

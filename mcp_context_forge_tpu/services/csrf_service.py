@@ -17,6 +17,18 @@ prove same-origin intent. Two complementary mechanisms:
   every mutating fetch. A cross-site attacker can make the browser SEND
   the cookie but cannot READ it, so the echo proves same-origin JS ran.
   Tokens are HMAC(user|expiry) under the JWT secret — stateless, no DB.
+
+Residual gap, stated honestly: a legacy browser that re-attaches Basic
+credentials to a cross-site form POST while sending NEITHER
+``Sec-Fetch-Site`` nor ``Origin`` passes the origin check, and — because
+the cookie is SameSite=Strict — the double-submit branch has no cookie
+to demand. Every browser since ~2011 sends ``Origin`` on cross-origin
+POSTs (and all evergreen ones send fetch metadata), so the exposure is
+pre-2011 user agents only; closing it fully would mean requiring the
+token pair on EVERY non-Bearer mutation, breaking curl/SDK basic-auth
+clients. The reference accepts the same trade (its Bearer-exempt,
+cookie-bound validation never fires for ambient-Basic non-browser
+clients either).
 """
 
 from __future__ import annotations

@@ -1,0 +1,129 @@
+"""Compliance report generator (round-4 VERDICT next #8): FedRAMP
+Moderate/High, HIPAA, SOC2 Type II reports fed from the audit trail,
+user/role inventory, token hygiene and config posture.
+
+Reference: `/root/reference/mcpgateway/routers/compliance_router.py:7-10`
++ `services/compliance_service.py`.
+"""
+
+import aiohttp
+
+from tests.integration.test_gateway_app import BASIC, make_client
+
+ADMIN = aiohttp.BasicAuth(*BASIC)
+
+
+async def test_framework_catalog():
+    client = await make_client()
+    try:
+        resp = await client.get("/compliance/frameworks", auth=ADMIN)
+        assert resp.status == 200
+        frameworks = {f["id"]: f for f in await resp.json()}
+        assert set(frameworks) == {"fedramp_moderate", "fedramp_high",
+                                   "hipaa", "soc2_type2"}
+        assert {c["id"] for c in frameworks["fedramp_moderate"]["controls"]} \
+            == {"AC-2", "AC-3", "AC-6", "AU-2", "AU-3", "AU-6"}
+        # high = moderate + authenticator/session controls
+        assert {"IA-5", "SC-23"} <= {
+            c["id"] for c in frameworks["fedramp_high"]["controls"]}
+        assert "164.312(b)" in {c["id"]
+                                for c in frameworks["hipaa"]["controls"]}
+        assert "CC7.2" in {c["id"]
+                           for c in frameworks["soc2_type2"]["controls"]}
+    finally:
+        await client.close()
+
+
+async def test_generate_report_with_evidence_and_persistence():
+    client = await make_client()
+    try:
+        # produce some audit evidence inside the period
+        await client.post("/tools", json={
+            "name": "audit-me", "integration_type": "REST",
+            "url": "http://127.0.0.1:1/x"}, auth=ADMIN)
+
+        resp = await client.post("/compliance/reports", json={
+            "framework": "fedramp_moderate", "period_days": 1}, auth=ADMIN)
+        assert resp.status == 201, await resp.text()
+        report = await resp.json()
+        summary = report["summary"]
+        assert summary["total_controls"] == 6
+        assert (summary["implemented"] + summary["partial"]
+                + summary["not_implemented"]) == 6
+        assert 0 <= summary["compliance_pct"] <= 100
+
+        # evidence is concrete: the audit artifact saw our mutation
+        au2 = next(c for c in report["controls"]
+                   if c["control_id"] == "AU-2")
+        audit = next(a for a in au2["artifacts"]
+                     if a["source"] == "audit_logs")
+        assert audit["events_in_period"] >= 1
+        assert any("POST /tools" in a for a in audit["action_types_sampled"])
+
+        # persisted: list + get return it
+        resp = await client.get("/compliance/reports", auth=ADMIN)
+        listed = await resp.json()
+        assert [r["id"] for r in listed] == [report["id"]]
+        assert listed[0]["summary"]["total_controls"] == 6
+        resp = await client.get(f"/compliance/reports/{report['id']}",
+                                auth=ADMIN)
+        assert (await resp.json())["id"] == report["id"]
+    finally:
+        await client.close()
+
+
+async def test_findings_drive_status():
+    """dev_mode + short passwords must surface as findings with
+    recommendations — the report reflects the actual posture."""
+    client = await make_client()  # dev_mode default true in tests
+    try:
+        resp = await client.post("/compliance/reports", json={
+            "framework": "soc2_type2", "period_days": 1}, auth=ADMIN)
+        report = await resp.json()
+        cc61 = next(c for c in report["controls"]
+                    if c["control_id"] == "CC6.1")
+        assert any("dev mode" in f for f in cc61["findings"])
+        assert cc61["status"] in ("partial", "not_implemented")
+        assert cc61["recommendations"]
+    finally:
+        await client.close()
+
+
+async def test_markdown_export_and_json_export():
+    client = await make_client()
+    try:
+        resp = await client.post("/compliance/reports", json={
+            "framework": "hipaa", "period_days": 7}, auth=ADMIN)
+        report = await resp.json()
+        resp = await client.get(
+            f"/compliance/reports/{report['id']}/export?format=markdown",
+            auth=ADMIN)
+        assert resp.status == 200
+        assert resp.headers["content-type"].startswith("text/markdown")
+        text = await resp.text()
+        assert "HIPAA" in text and "164.312(b)" in text
+        resp = await client.get(
+            f"/compliance/reports/{report['id']}/export", auth=ADMIN)
+        assert "attachment" in resp.headers["Content-Disposition"]
+        assert (await resp.json())["framework"] == "hipaa"
+    finally:
+        await client.close()
+
+
+async def test_validation_and_authz():
+    client = await make_client()
+    try:
+        resp = await client.post("/compliance/reports", json={
+            "framework": "nist-9000"}, auth=ADMIN)
+        assert resp.status in (400, 422)
+        resp = await client.get("/compliance/reports/nope", auth=ADMIN)
+        assert resp.status == 404
+        # non-admin denied
+        await client.post("/admin/users", json={
+            "email": "c@x.com", "password": "C0mpliance!Pass9"}, auth=ADMIN)
+        resp = await client.get("/compliance/frameworks",
+                                auth=aiohttp.BasicAuth(
+                                    "c@x.com", "C0mpliance!Pass9"))
+        assert resp.status == 403
+    finally:
+        await client.close()
