@@ -589,6 +589,13 @@ async def build_app(settings: Settings | None = None) -> web.Application:
             await otlp_exporter.start()
         logger.info("%s started (worker %s)", settings.app_name, ctx.worker_id)
         yield
+        # drain in-flight token-usage accounting writes BEFORE the db
+        # closes: the last requests' rows (incl. blocked security
+        # denials the compliance reports count) must not be lost
+        pending_usage = app.get("_token_usage_tasks")
+        if pending_usage:
+            await _asyncio.gather(*list(pending_usage),
+                                  return_exceptions=True)
         if otlp_exporter is not None:
             await otlp_exporter.stop()
         await audit_service.stop()

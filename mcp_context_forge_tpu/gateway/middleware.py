@@ -471,8 +471,13 @@ async def token_usage_middleware(request: web.Request, handler: Handler
                                          exc_info=True)
 
         # off the critical path: the response must not wait on the
-        # serialized DB executor for an accounting write
-        asyncio.ensure_future(_record())
+        # serialized DB executor for an accounting write. The task set
+        # holds strong references (the loop keeps only weak ones) and is
+        # drained at shutdown so final-request rows aren't lost.
+        tasks: set = request.app.setdefault("_token_usage_tasks", set())
+        task = asyncio.ensure_future(_record())
+        tasks.add(task)
+        task.add_done_callback(tasks.discard)
     return response
 
 

@@ -146,11 +146,23 @@ def setup_compliance_routes(app: web.Application) -> None:
     async def generate(request: web.Request) -> web.Response:
         auth = request["auth"]
         auth.require("admin.all")
+        from ..services.base import ValidationFailure
         body = await request.json()
+        if not isinstance(body, dict):
+            raise ValidationFailure("Body must be a JSON object")
         import time as _time
-        days = float(body.get("period_days") or 30)
-        end = float(body.get("period_end") or _time.time())
-        start = float(body.get("period_start") or (end - days * 86400))
+
+        def number(name: str, default: float) -> float:
+            value = body.get(name)
+            if value is None:
+                return default
+            if not isinstance(value, (int, float)) or isinstance(value, bool):
+                raise ValidationFailure(f"{name} must be a number")
+            return float(value)
+
+        days = number("period_days", 30.0)
+        end = number("period_end", _time.time())
+        start = number("period_start", end - days * 86400)
         report = await service.generate(body.get("framework", ""),
                                         start, end, generated_by=auth.user)
         return web.json_response(report, status=201)

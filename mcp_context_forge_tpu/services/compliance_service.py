@@ -151,7 +151,8 @@ class ComplianceService:
             " FROM audit_trail WHERE ts >= ? AND ts <= ?", (start, end))
         actions = await self.ctx.db.fetchall(
             "SELECT DISTINCT action FROM audit_trail"
-            " WHERE ts >= ? AND ts <= ? LIMIT 20", (start, end))
+            " WHERE ts >= ? AND ts <= ? ORDER BY action LIMIT 20",
+            (start, end))
         return {"source": "audit_logs",
                 "events_in_period": int(row["total"] or 0),
                 "distinct_actors": int(row["actors"] or 0),

@@ -102,10 +102,14 @@ class EngineConfig:
     # decode batch-width bucketing: size decode arrays by the ACTIVE slot
     # ceiling (pow-2, with slot compaction + shrink hysteresis) instead of
     # max_batch. Wins on sparse/steady loads (fewer wasted rows per step);
-    # loses on bursty full loads — every width change re-homes the donated
-    # KV pool (~a pool copy). Off by default; enable for latency-sensitive
-    # low-concurrency serving.
+    # every width change re-homes the donated KV pool (~a pool copy), so
+    # the width starts at max_batch (identical to fixed width until light
+    # load is SUSTAINED), pins at max while work is queued, and only
+    # shrinks to warmup-compiled widths after batch_shrink_steps
+    # consecutive under-width steps. Off by default; enable for
+    # latency-sensitive low-concurrency serving.
     batch_buckets: bool = False
+    batch_shrink_steps: int = 64
     # device-fault recovery (SURVEY §5.3): a crashed dispatch thread
     # rebuilds the KV pool, re-queues PENDING requests (mid-stream ones
     # fail — silent retry would duplicate emitted tokens) and restarts
@@ -302,6 +306,15 @@ class TPUEngine:
         if config.spec_decode and config.spec_ngram < 1:
             raise ValueError(f"spec_ngram must be >= 1, got {config.spec_ngram}")
         self.config = config
+        if config.batch_buckets and not config.warmup:
+            # shrink targets are warmup-compiled widths only; without a
+            # warmup the engine serves correctly but stays at full width
+            # (the waste bucketing exists to remove) — say so loudly
+            logger.warning(
+                "batch_buckets=true without warmup: decode width will pin "
+                "at max_batch until warmup() runs (shrinking never "
+                "compiles on the serving path) — set "
+                "MCPFORGE_TPU_LOCAL_WARMUP=true for production serving")
         if config.compile_cache_dir:
             _apply_compile_cache(config.compile_cache_dir)
         self.model_config: LlamaConfig = MODEL_CONFIGS[config.model]
@@ -316,10 +329,19 @@ class TPUEngine:
         self._stop_event = threading.Event()
         self._loop: asyncio.AbstractEventLoop | None = None
         self._started = False
-        # decode batch-width hysteresis state (see _decode_step_all)
-        self._batch_width = min(8, config.max_batch)
+        # decode batch-width hysteresis state (see _decode_step_all):
+        # start at FULL width — bucketing must never be slower than fixed
+        # width on a fresh engine; the first idle->burst transition costs
+        # zero re-homes, and sustained light load earns the shrink
+        self._batch_width = config.max_batch
         self._shrink_streak = 0
         self._shrink_peak = 0
+        # widths whose full ctx-bucket decode grid warmup precompiled:
+        # shrinking is an OPTIMIZATION, so the engine never eats a
+        # mid-traffic compile (+ donated-pool re-home) to get smaller —
+        # only warmed widths are shrink targets. Growth is correctness
+        # (arrays must cover the ceiling) and may compile.
+        self._warmed_widths: set[int] = set()
 
         dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
         devices = probe_devices(config.init_timeout_s)
@@ -628,6 +650,7 @@ class TPUEngine:
                         jax.random.PRNGKey(0))
                     block.block_until_ready()
                     shapes += 1
+                self._warmed_widths.add(batch)
         logger.info("tpu_local warmup: %d shapes compiled in %.1fs",
                     shapes, time.monotonic() - started)
 
@@ -1326,47 +1349,54 @@ class TPUEngine:
         pay full-capacity attention/sampling per step."""
         config = self.config
         if config.batch_buckets:
-            self._compact_slots()
             # Hysteresis on the width: switching executables makes XLA
             # re-home the donated KV pool (~a full pool copy), so width
             # changes must be RARE. Grow immediately (correctness: arrays
             # must cover the active ceiling); shrink only after the smaller
             # width has sufficed for a sustained streak (load genuinely
             # dropped, not an inter-wave dip).
-            # anticipatory growth: size by active + ADMISSIBLE queued load,
-            # not the instantaneous ceiling — a 128-request burst must cost
-            # ONE re-home (8->64), not one per pow-2 rung (each width
-            # change copies the donated KV pool inside the next dispatch;
-            # four rungs of that dominated short-decode chat bursts in the
-            # config-4 A/B: 2251 ms vs 1465 ms of device time). Queued
-            # requests that CANNOT be admitted (no free slots, or the page
-            # pool is the binding constraint) must not pin the width high:
-            # a page-bound backlog would otherwise run full-width decode
-            # over a handful of active slots for its whole duration.
             incoming = self._work.qsize() + len(self._pending)
-            free_slots = (config.max_batch - len(self._running)
-                          - len(self._chunking))
             page_capacity = (self.allocator.free_pages
                              // self.allocator.avg_slot_pages())
-            admissible = max(0, min(incoming, free_slots, page_capacity))
-            ceiling = max(max(self._running) + 1,
-                          len(self._running) + admissible)
-            desired = self._batch_bucket_for(min(ceiling, config.max_batch))
-            if desired >= self._batch_width:
-                self._batch_width = desired
+            if incoming > 0 and page_capacity > 0:
+                # PIN at max width while the queue is non-empty (round-4
+                # A/B: buckets lost ~15% to fixed width at FULL load):
+                # with work queued, freed slots refill at the next
+                # admission, so sizing below capacity only schedules a
+                # re-home — and the per-step compaction scan buys
+                # nothing, because holes refill immediately. Exception:
+                # a PAGE-BOUND backlog (page_capacity == 0 — queued work
+                # that cannot admit) must not pin, or the backlog would
+                # run full-width decode over a handful of active slots
+                # for its whole duration.
+                self._batch_width = config.max_batch
                 self._shrink_streak = 0
                 self._shrink_peak = 0
             else:
-                self._shrink_streak += 1
-                # shrink to the PEAK desired width seen over the streak, not
-                # the instantaneous one — a momentary dip must not trigger
-                # an over-shrink followed by an immediate re-grow (each
-                # width change re-homes the donated KV pool)
-                self._shrink_peak = max(self._shrink_peak, desired)
-                if self._shrink_streak >= 16:
-                    self._batch_width = self._shrink_peak
+                self._compact_slots()
+                desired = self._batch_bucket_for(
+                    min(max(self._running) + 1, config.max_batch))
+                if desired >= self._batch_width:
+                    self._batch_width = desired
                     self._shrink_streak = 0
                     self._shrink_peak = 0
+                else:
+                    self._shrink_streak += 1
+                    # shrink to the PEAK desired width seen over the
+                    # streak, not the instantaneous one — a momentary dip
+                    # must not trigger an over-shrink followed by an
+                    # immediate re-grow (each width change re-homes the
+                    # donated KV pool)
+                    self._shrink_peak = max(self._shrink_peak, desired)
+                    if self._shrink_streak >= config.batch_shrink_steps:
+                        # never EAT a compile to get smaller (round-4
+                        # config-4 tail: the drain-phase shrink compiled
+                        # a fresh executable inside the serving path) —
+                        # only warmup-compiled widths are shrink targets
+                        if self._shrink_peak in self._warmed_widths:
+                            self._batch_width = self._shrink_peak
+                        self._shrink_streak = 0
+                        self._shrink_peak = 0
             B = self._batch_width
         else:
             B = config.max_batch

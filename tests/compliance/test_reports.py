@@ -127,3 +127,19 @@ async def test_validation_and_authz():
         assert resp.status == 403
     finally:
         await client.close()
+
+
+async def test_non_numeric_period_rejected_not_500():
+    client = await make_client()
+    try:
+        resp = await client.post("/compliance/reports", json={
+            "framework": "hipaa", "period_days": [7]}, auth=ADMIN)
+        assert resp.status in (400, 422)
+        resp = await client.post("/compliance/reports", json=["hipaa"],
+                                 auth=ADMIN)
+        assert resp.status in (400, 422)
+        resp = await client.post("/compliance/reports", json={
+            "framework": "hipaa", "period_end": True}, auth=ADMIN)
+        assert resp.status in (400, 422)
+    finally:
+        await client.close()

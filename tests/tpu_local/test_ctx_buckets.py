@@ -236,3 +236,97 @@ def test_oversized_prompt_behind_blocked_chunker_rejects_cleanly():
 # (the spec-decode x chunked-prefill losslessness test lives in
 # test_real_checkpoint.py — random weights never ACCEPT a draft, so only
 # a trained, repetitive model exercises the accepted-draft path)
+
+
+def test_width_pins_at_max_while_queue_nonempty():
+    """Round-4 A/B follow-up: with work queued (and pages available) the
+    decode width pins to max_batch — freed slots refill next admission,
+    so a sub-capacity width would only schedule a pool re-home. With an
+    empty queue the hysteresis path still sizes by the active ceiling."""
+    engine = _engine(max_batch=16, batch_buckets=True)
+    ids = engine.tokenizer.encode("hello")
+    from mcp_context_forge_tpu.tpu_local.engine import GenRequest
+
+    # active slots + queue NON-empty -> pinned back to max even from a
+    # previously shrunken width (the init default is max; force 8 here)
+    engine._pending.append(GenRequest(request_id="q", prompt_ids=ids,
+                                      max_tokens=4))
+    engine._admit_batch()
+    engine._pending.append(GenRequest(request_id="q2", prompt_ids=ids,
+                                      max_tokens=4))
+    engine._batch_width = 8
+    engine._decode_step_all()
+    assert engine._batch_width == 16
+
+    # queue empty + smaller width warmed -> hysteresis shrinks to the
+    # active ceiling's bucket (8 for <=8 active) after the streak
+    engine._warmed_widths.add(8)
+    engine._shrink_streak = 0
+    steps = 0
+    while steps < engine.config.batch_shrink_steps + 4:
+        if not engine._running:
+            engine._pending.append(GenRequest(
+                request_id=f"lite{steps}", prompt_ids=ids, max_tokens=4))
+            engine._admit_batch()
+        engine._decode_step_all()
+        steps += 1
+    assert engine._batch_width == 8
+
+
+def test_page_bound_backlog_does_not_pin():
+    """Queued work that CANNOT admit (page pool exhausted) must not hold
+    the width at max: the backlog would otherwise decode full-width over
+    a handful of slots for its whole duration."""
+    engine = _engine(max_batch=16, batch_buckets=True, num_pages=8,
+                     max_seq_len=64)
+    ids = engine.tokenizer.encode("hello world and more text")
+    from mcp_context_forge_tpu.tpu_local.engine import GenRequest
+
+    # fill pages with one long-budget request, then queue more
+    engine._pending.append(GenRequest(request_id="big", prompt_ids=ids,
+                                      max_tokens=48))
+    engine._admit_batch()
+    assert engine._running
+    # exhaust the pool so queued work is page-bound
+    while engine.allocator.free_pages >= engine.allocator.avg_slot_pages():
+        if not engine.allocator.allocate_slot(
+                len(engine._running) + 1, engine.config.page_size):
+            break
+    engine._pending.append(GenRequest(request_id="q", prompt_ids=ids,
+                                      max_tokens=8))
+    engine._batch_width = min(8, engine.config.max_batch)
+    engine._decode_step_all()
+    assert engine._batch_width < engine.config.max_batch
+
+
+def test_shrink_requires_warmed_width_and_sustained_streak():
+    """Shrinking is an optimization: it must never compile a fresh
+    executable mid-traffic (only warmup-compiled widths are targets) and
+    only engages after batch_shrink_steps consecutive under-width steps."""
+    engine = _engine(max_batch=16, batch_buckets=True)
+    ids = engine.tokenizer.encode("hello")
+    from mcp_context_forge_tpu.tpu_local.engine import GenRequest
+
+    engine._pending.append(GenRequest(request_id="solo", prompt_ids=ids,
+                                      max_tokens=4))
+    engine._admit_batch()
+    # width starts at max; with NO warmed widths a long light-load streak
+    # must not shrink (that would compile (8, ctx) on the serving path)
+    for _ in range(engine.config.batch_shrink_steps + 2):
+        if not engine._running:
+            engine._pending.append(GenRequest(
+                request_id=f"s{_}", prompt_ids=ids, max_tokens=4))
+            engine._admit_batch()
+        engine._decode_step_all()
+    assert engine._batch_width == 16
+
+    # with the smaller width warmed, the same streak shrinks
+    engine._warmed_widths.add(8)
+    engine._shrink_streak = 0
+    for _ in range(engine.config.batch_shrink_steps + 2):
+        if not engine._running:
+            engine._pending.append(GenRequest(
+                request_id=f"t{_}", prompt_ids=ids, max_tokens=4))
+            engine._admit_batch()
+        engine._decode_step_all()
+    assert engine._batch_width == 8
