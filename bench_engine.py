@@ -69,13 +69,14 @@ async def run(platform: str) -> dict:
         decode_block = 1  # mutually exclusive with multi-step dispatch
     quant = os.environ.get("BENCH_QUANT", "")
     buckets = os.environ.get("BENCH_BATCH_BUCKETS", "0") == "1"
+    moe_impl = os.environ.get("BENCH_MOE_IMPL", "")
     config = EngineConfig(model=model, max_batch=min(clients, 16),
                           max_seq_len=512, page_size=16, num_pages=1024,
                           prefill_buckets=(64,),
                           dtype="bfloat16" if platform == "tpu" else "float32",
                           attn_impl="auto", decode_block=decode_block,
                           spec_decode=spec, quant=quant,
-                          batch_buckets=buckets,
+                          batch_buckets=buckets, moe_impl=moe_impl,
                           compile_cache_dir=os.environ.get(
                               "MCPFORGE_TPU_LOCAL_COMPILE_CACHE_DIR",
                               "/tmp/mcpforge-xla-cache"))

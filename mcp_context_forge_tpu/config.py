@@ -242,6 +242,7 @@ class Settings(BaseModel):
     # scales, dequant fused into the matmul; halves HBM footprint+traffic
     # (how Llama-3-8B fits one 16 GB v5e chip)
     tpu_local_quant: str = ""
+    tpu_local_moe_impl: str = ""  # ""=model default | dense | grouped | grouped_pallas
     # decode batch-width bucketing (+ slot compaction, shrink hysteresis):
     # size decode dispatches by active load — enable for latency-sensitive
     # low-concurrency serving; bursty full loads prefer fixed max_batch

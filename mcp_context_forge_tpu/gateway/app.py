@@ -335,6 +335,9 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     app["role_service"] = RoleService(ctx)
     from ..services.compliance_service import ComplianceService
     app["compliance_service"] = ComplianceService(ctx)
+    # pre-create: token_usage_middleware appends from request handlers,
+    # and a frozen (started) aiohttp app refuses new keys
+    app["_token_usage_tasks"] = set()
     from .routers_rbac import setup_compliance_routes, setup_rbac_routes
     setup_rbac_routes(app)
     setup_compliance_routes(app)

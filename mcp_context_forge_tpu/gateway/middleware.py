@@ -472,9 +472,10 @@ async def token_usage_middleware(request: web.Request, handler: Handler
 
         # off the critical path: the response must not wait on the
         # serialized DB executor for an accounting write. The task set
+        # (created in build_app — a frozen aiohttp app rejects new keys)
         # holds strong references (the loop keeps only weak ones) and is
         # drained at shutdown so final-request rows aren't lost.
-        tasks: set = request.app.setdefault("_token_usage_tasks", set())
+        tasks: set = request.app["_token_usage_tasks"]
         task = asyncio.ensure_future(_record())
         tasks.add(task)
         task.add_done_callback(tasks.discard)

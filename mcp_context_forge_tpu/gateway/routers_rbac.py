@@ -152,12 +152,18 @@ def setup_compliance_routes(app: web.Application) -> None:
             raise ValidationFailure("Body must be a JSON object")
         import time as _time
 
+        import math
+
         def number(name: str, default: float) -> float:
             value = body.get(name)
             if value is None:
                 return default
-            if not isinstance(value, (int, float)) or isinstance(value, bool):
-                raise ValidationFailure(f"{name} must be a number")
+            if (not isinstance(value, (int, float))
+                    or isinstance(value, bool)
+                    or not math.isfinite(value)):
+                # json.loads accepts NaN/Infinity literals; NaN bounds
+                # would match no rows and serialize as non-standard JSON
+                raise ValidationFailure(f"{name} must be a finite number")
             return float(value)
 
         days = number("period_days", 30.0)

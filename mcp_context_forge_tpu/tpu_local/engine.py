@@ -99,6 +99,9 @@ class EngineConfig:
     # resident param footprint AND the per-step HBM traffic (quantize.py;
     # how Llama-3-8B fits a single 16 GB v5e chip)
     quant: str = ""
+    # MoE serving formulation override ("" = model default; see
+    # models/configs.py moe_impl): dense | grouped | grouped_pallas
+    moe_impl: str = ""
     # decode batch-width bucketing: size decode arrays by the ACTIVE slot
     # ceiling (pow-2, with slot compaction + shrink hysteresis) instead of
     # max_batch. Wins on sparse/steady loads (fewer wasted rows per step);
@@ -143,6 +146,7 @@ class EngineConfig:
             spec_k=getattr(settings, "tpu_local_spec_k", 4),
             spec_ngram=getattr(settings, "tpu_local_spec_ngram", 2),
             quant=getattr(settings, "tpu_local_quant", ""),
+            moe_impl=getattr(settings, "tpu_local_moe_impl", ""),
             batch_buckets=getattr(settings, "tpu_local_batch_buckets", False),
             max_queue=getattr(settings, "tpu_local_max_queue", 1024),
             auto_restart=getattr(settings, "tpu_local_auto_restart", False),
@@ -318,6 +322,10 @@ class TPUEngine:
         if config.compile_cache_dir:
             _apply_compile_cache(config.compile_cache_dir)
         self.model_config: LlamaConfig = MODEL_CONFIGS[config.model]
+        if config.moe_impl:
+            import dataclasses
+            self.model_config = dataclasses.replace(
+                self.model_config, moe_impl=config.moe_impl)
         self.tokenizer = load_tokenizer(config.checkpoint,
                                         vocab_size=self.model_config.vocab_size)
         self.stats = EngineStats()
@@ -361,6 +369,12 @@ class TPUEngine:
 
         if config.quant not in ("", "int8"):
             raise ValueError(f"unsupported quant mode {config.quant!r}")
+        if config.moe_impl not in ("", "dense", "grouped", "grouped_pallas"):
+            # a typo must not silently serve the dense path (and make a
+            # hardware A/B compare dense against dense)
+            raise ValueError(
+                f"moe_impl must be dense|grouped|grouped_pallas, "
+                f"got {config.moe_impl!r}")
         # params: load checkpoint or random-init, placed with TP shardings;
         # quant="int8" swaps in the {"q","s"} tree (quantize.py)
         with self.mesh:

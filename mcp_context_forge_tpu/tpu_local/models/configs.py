@@ -36,10 +36,23 @@ class LlamaConfig:
     embed_scale: bool = False     # multiply embeddings by sqrt(dim)
     norm_plus_one: bool = False   # RMSNorm scales by (1 + weight)
     # MoE (Mixtral family): n_experts > 0 replaces the dense FFN with a
-    # top-k routed expert FFN (drop-free expert scan in the serving
-    # trunk; parallel/moe.py capacity dispatch for EP training fleets)
+    # top-k routed expert FFN. ``moe_impl`` picks the drop-free serving
+    # formulation (all compute the same per-token function):
+    #   dense          — expert scan with gate masks (E/k x FLOPs waste;
+    #                    no gathers — safe default everywhere)
+    #   grouped        — block-sparse grouped GEMM, XLA gathered weights
+    #                    (~k/E FLOPs; gathers materialize — small models)
+    #   grouped_pallas — block-sparse grouped GEMM, Pallas kernel (TPU:
+    #                    weight tiles DMA per block via scalar prefetch)
+    # parallel/moe.py's capacity dispatch stays the EP-training path.
+    # The grouped path only pays when T·k >= E·moe_block (its padded-row
+    # bound is T·k + E·moe_block vs dense's E·T): prefill clears the bar,
+    # decode (T = batch width) never does — those steps fall back to the
+    # dense scan automatically. moe_block is also the kernel's row-block.
     n_experts: int = 0
     moe_top_k: int = 2
+    moe_impl: str = "dense"
+    moe_block: int = 128
 
     @property
     def head_dim(self) -> int:

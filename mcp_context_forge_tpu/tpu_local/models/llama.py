@@ -213,9 +213,29 @@ def _ffn_block(layer: dict[str, Any], config: LlamaConfig,
         moe_cfg = MoEConfig(dim=config.dim, n_experts=config.n_experts,
                             expert_hidden=config.ffn_hidden,
                             top_k=config.moe_top_k)
-        return moe_ffn_dense_mask(
-            {k: layer[k] for k in ("router", "w1", "w3", "w2")}, x,
-            moe_cfg, act=config.hidden_act)
+        moe_params = {k: layer[k] for k in ("router", "w1", "w3", "w2")}
+        impl = getattr(config, "moe_impl", "dense")
+        block = getattr(config, "moe_block", 128)
+        T = x.shape[0] * x.shape[1]
+        # grouped pays only when T·k >= E·block (padded rows T·k+E·block
+        # vs dense's E·T): prefill yes, decode (T = batch width) no —
+        # decode steps ALWAYS run the dense scan
+        if (impl.startswith("grouped")
+                and T * config.moe_top_k >= config.n_experts * block):
+            # block-sparse grouped GEMM: ~top_k/E of the dense-mask
+            # FLOPs, exact-parity (ops/grouped_moe.py). The kernel path
+            # interprets off-TPU so the code path exists everywhere.
+            import jax as _jax
+
+            from ..ops.grouped_moe import moe_ffn_grouped
+            use_pallas = impl == "grouped_pallas"
+            return moe_ffn_grouped(
+                moe_params, x, moe_cfg, act=config.hidden_act,
+                impl="pallas" if use_pallas else "xla", block=block,
+                interpret=(use_pallas
+                           and _jax.default_backend() != "tpu"))
+        return moe_ffn_dense_mask(moe_params, x, moe_cfg,
+                                  act=config.hidden_act)
     return _ffn(layer, x, config.hidden_act)
 
 

@@ -1,0 +1,318 @@
+"""Dropless grouped-GEMM MoE FFN (round-4 VERDICT next #4).
+
+The serving trunk's drop-free expert-scan (`parallel/moe.py
+moe_ffn_dense_mask`) runs EVERY expert over EVERY token and masks — E/k×
+the needed FFN FLOPs (4× waste for Mixtral 8×top-2). This module computes
+the same per-token function at ~k/E of the dense cost with STATIC shapes
+(XLA requirement), using the block-sparse trick of MegaBlocks-style
+grouped GEMMs:
+
+1. flatten the T×k (token, expert) assignments, argsort by expert —
+   each expert's tokens become contiguous;
+2. pad every expert group up to a multiple of the row-block size Bt and
+   scatter tokens into a padded buffer. Total padded rows are bounded by
+   ``N + E·Bt`` (each group wastes < one block), so the buffer and the
+   block count NB = ceil(N/Bt) + E are STATIC — dropless without dynamic
+   shapes, no capacity factor, no skew cliff;
+3. every row-block belongs to exactly ONE expert (`block_expert[NB]`,
+   computed on device). The FFN is then NB independent [Bt, D] × expert
+   GEMMs:
+   - XLA path: gather the block's expert weights and einsum — correct
+     everywhere, but materializes gathered weights in HBM;
+   - Pallas path (TPU): ``block_expert`` rides scalar prefetch, and the
+     BlockSpec index maps DMA exactly the ONE expert's weight tiles a
+     block needs from HBM into VMEM — the gather never materializes.
+     F is tiled; the [Bt, D] output accumulates in VMEM scratch.
+4. unsort + gate-combine back to [T, D].
+
+Per-token outputs are EXACTLY the dense-mask formulation's (same router
+math via ``router_probs``, same renormalized gates), so the continuous-
+batching invariant (prefill + decode ≡ one long prefill) holds — tested
+against the dense-mask oracle in tests/tpu_local/test_grouped_moe.py.
+
+FLOPs accounting: dense-mask runs E·T rows through the FFN; grouped runs
+NB·Bt = T·k + E·Bt rows (+ router). For Mixtral-shape 8×top-2 with
+T=2048, Bt=128: (2048·2 + 8·128)/ (8·2048) = 31.3% vs 25% ideal — the
+E·Bt padding term vanishes as T grows.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+# --------------------------------------------------------------- routing
+
+def route_sorted_blocks(probs: jax.Array, top_k: int, block: int
+                        ) -> dict[str, jax.Array]:
+    """Static-shape block-sparse routing plan from router probabilities.
+
+    Returns:
+      sorted_token  [NP]  flat-token index feeding each padded row
+      row_valid     [NP]  1.0 for live rows, 0.0 for group padding
+      gates         [NP]  renormalized gate of the (token, slot) pair
+      block_expert  [NB]  owning expert of each row-block
+      (NP = NB·block; NB = ceil(T·k/block) + E — both static)
+    """
+    T, E = probs.shape
+    N = T * top_k
+    NB = -(-N // block) + E
+    NP = NB * block
+
+    _, top_idx = jax.lax.top_k(probs, top_k)                   # [T, k]
+    gates = jnp.take_along_axis(probs, top_idx, axis=1)        # [T, k]
+    gates = gates / jnp.maximum(
+        jnp.sum(gates, axis=-1, keepdims=True), 1e-9)          # renorm
+
+    expert_flat = top_idx.reshape(N)                           # [N]
+    token_flat = jnp.repeat(jnp.arange(T, dtype=jnp.int32), top_k)
+    gate_flat = gates.reshape(N)
+
+    order = jnp.argsort(expert_flat, stable=True)              # [N]
+    sorted_expert = expert_flat[order]
+    counts = jnp.bincount(sorted_expert, length=E)             # [E]
+    group_start = jnp.cumsum(counts) - counts                  # [E]
+    padded_counts = -(-counts // block) * block
+    padded_start = jnp.cumsum(padded_counts) - padded_counts   # [E]
+    # padded destination of sorted row j: its rank within the group,
+    # offset by the group's padded start
+    j = jnp.arange(N)
+    rank = j - group_start[sorted_expert]
+    dest = padded_start[sorted_expert] + rank                  # [N] < NP
+
+    sorted_token = jnp.zeros((NP,), jnp.int32).at[dest].set(
+        token_flat[order])
+    row_valid = jnp.zeros((NP,), jnp.float32).at[dest].set(1.0)
+    gates_padded = jnp.zeros((NP,), jnp.float32).at[dest].set(
+        gate_flat[order])
+
+    # owning expert per block: block b starts at row b·block; an expert
+    # owns it iff padded_start[e] <= b·block < padded_start[e]+padded.
+    # searchsorted over the padded-end cumsum gives that e; blocks past
+    # every group (pure padding) clamp to E-1 and are all-invalid rows.
+    padded_end = jnp.cumsum(padded_counts)                     # [E]
+    block_starts = jnp.arange(NB) * block
+    block_expert = jnp.clip(
+        jnp.searchsorted(padded_end, block_starts, side="right"),
+        0, E - 1).astype(jnp.int32)
+    return {"sorted_token": sorted_token, "row_valid": row_valid,
+            "gates": gates_padded, "block_expert": block_expert}
+
+
+def _act(h: jax.Array, act: str) -> jax.Array:
+    return (jax.nn.gelu(h, approximate=True) if act == "gelu"
+            else jax.nn.silu(h))
+
+
+# --------------------------------------------------------------- XLA path
+
+def _expert_blocks_xla(x_pad: jax.Array, w1, w3, w2,
+                       block_expert: jax.Array, act: str) -> jax.Array:
+    """[NB, Bt, D] rows through their owning expert's FFN — pure XLA.
+    Gathered per-block weights materialize ([NB, D, F] etc.); fine at
+    moderate sizes and the reference semantics for the Pallas kernel.
+    Quantized expert stacks ({"q","s"} leaves) dequantize per block —
+    XLA fuses the scale multiply into the GEMM epilogue."""
+    def take(w):
+        if isinstance(w, dict):
+            # int8 stacks: q [E, A, B] with the CONTRACTION axis (1)
+            # reduced, s [E, B] on the surviving out-channels
+            return (w["q"][block_expert].astype(jnp.float32)
+                    * w["s"][block_expert][:, None, :]).astype(x_pad.dtype)
+        return w[block_expert]
+
+    h = _act(jnp.einsum("btd,bdf->btf", x_pad, take(w1)), act)
+    h = h * jnp.einsum("btd,bdf->btf", x_pad, take(w3))
+    return jnp.einsum("btf,bfd->btd", h, take(w2))
+
+
+# ------------------------------------------------------------ Pallas path
+
+def _moe_block_kernel(block_expert_ref, x_ref, w1_ref, w3_ref, w2_ref,
+                      o_ref, acc_ref, *, act: str, f_tiles: int):
+    """One (row-block, F-tile) step: h = act(x@w1_f) * (x@w3_f); the
+    [Bt, D] output accumulates h @ w2_f in VMEM scratch across F-tiles.
+    The expert's weight tiles arrive via the BlockSpec index maps reading
+    the scalar-prefetched ``block_expert`` — the kernel body never
+    gathers."""
+    f = pl.program_id(1)
+
+    @pl.when(f == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[0].astype(jnp.float32)                  # [Bt, D]
+    w1 = w1_ref[0].astype(jnp.float32)                # [D, Ft]
+    w3 = w3_ref[0].astype(jnp.float32)
+    w2 = w2_ref[0].astype(jnp.float32)                # [Ft, D]
+    h = _act(x @ w1, act) * (x @ w3)                  # [Bt, Ft]
+    acc_ref[...] += h @ w2                            # [Bt, D]
+
+    @pl.when(f == f_tiles - 1)
+    def _finish():
+        o_ref[0] = acc_ref[...].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("act", "block", "f_tile", "interpret"))
+def _expert_blocks_pallas(x_pad: jax.Array, w1: jax.Array, w3: jax.Array,
+                          w2: jax.Array, block_expert: jax.Array,
+                          act: str = "silu", block: int = 128,
+                          f_tile: int = 512,
+                          interpret: bool = False) -> jax.Array:
+    NB = block_expert.shape[0]
+    D = x_pad.shape[-1]
+    F = w1.shape[-1]
+    f_tile = min(f_tile, F)
+    assert F % f_tile == 0, (F, f_tile)
+    f_tiles = F // f_tile
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,                 # block_expert
+        grid=(NB, f_tiles),
+        in_specs=[
+            pl.BlockSpec((1, block, D), lambda b, f, be: (b, 0, 0)),
+            pl.BlockSpec((1, D, f_tile), lambda b, f, be: (be[b], 0, f)),
+            pl.BlockSpec((1, D, f_tile), lambda b, f, be: (be[b], 0, f)),
+            pl.BlockSpec((1, f_tile, D), lambda b, f, be: (be[b], f, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block, D), lambda b, f, be: (b, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((block, D), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_moe_block_kernel, act=act, f_tiles=f_tiles),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((NB, block, D), x_pad.dtype),
+        interpret=interpret,
+    )(block_expert, x_pad, w1, w3, w2)
+
+
+def _moe_block_kernel_q8(block_expert_ref, x_ref, q1_ref, s1_ref, q3_ref,
+                         s3_ref, q2_ref, s2_ref, o_ref, acc_ref, *,
+                         act: str, f_tiles: int):
+    """Int8 expert stacks: HBM reads stay int8-sized (the decode
+    bottleneck quantization exists to halve); scales apply per F-tile on
+    the hidden and once on the output (s2 factors out of the F sum)."""
+    f = pl.program_id(1)
+
+    @pl.when(f == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[0].astype(jnp.float32)                    # [Bt, D]
+    q1 = q1_ref[0].astype(jnp.float32)                  # [D, Ft]
+    q3 = q3_ref[0].astype(jnp.float32)
+    q2 = q2_ref[0].astype(jnp.float32)                  # [Ft, D]
+    s1 = s1_ref[0].astype(jnp.float32)                  # [Ft]
+    s3 = s3_ref[0].astype(jnp.float32)
+    h = _act((x @ q1) * s1[None, :], act) * ((x @ q3) * s3[None, :])
+    acc_ref[...] += h @ q2
+
+    @pl.when(f == f_tiles - 1)
+    def _finish():
+        s2 = s2_ref[0].astype(jnp.float32)              # [D]
+        o_ref[0] = (acc_ref[...] * s2[None, :]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("act", "block", "f_tile", "interpret"))
+def _expert_blocks_pallas_q8(x_pad, w1, w3, w2, block_expert,
+                             act: str = "silu", block: int = 128,
+                             f_tile: int = 512,
+                             interpret: bool = False) -> jax.Array:
+    NB = block_expert.shape[0]
+    D = x_pad.shape[-1]
+    F = w1["q"].shape[-1]
+    f_tile = min(f_tile, F)
+    assert F % f_tile == 0, (F, f_tile)
+    f_tiles = F // f_tile
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(NB, f_tiles),
+        in_specs=[
+            pl.BlockSpec((1, block, D), lambda b, f, be: (b, 0, 0)),
+            pl.BlockSpec((1, D, f_tile), lambda b, f, be: (be[b], 0, f)),
+            pl.BlockSpec((1, f_tile), lambda b, f, be: (be[b], f)),
+            pl.BlockSpec((1, D, f_tile), lambda b, f, be: (be[b], 0, f)),
+            pl.BlockSpec((1, f_tile), lambda b, f, be: (be[b], f)),
+            pl.BlockSpec((1, f_tile, D), lambda b, f, be: (be[b], f, 0)),
+            pl.BlockSpec((1, D), lambda b, f, be: (be[b], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block, D), lambda b, f, be: (b, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((block, D), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_moe_block_kernel_q8, act=act, f_tiles=f_tiles),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((NB, block, D), x_pad.dtype),
+        interpret=interpret,
+    )(block_expert, x_pad, w1["q"], w1["s"], w3["q"], w3["s"],
+      w2["q"], w2["s"])
+
+
+# ----------------------------------------------------------- public entry
+
+def moe_ffn_grouped(params: dict[str, Any], x: jax.Array, config,
+                    act: str = "silu", impl: str = "xla",
+                    block: int = 128, interpret: bool = False) -> jax.Array:
+    """Dropless grouped MoE FFN, exact-parity with
+    ``moe_ffn_dense_mask``. x: [B, S, D] -> [B, S, D].
+
+    ``impl``: "xla" (gathered-weights einsum — every backend; for large
+    models the gather MATERIALIZES [NB, D, F] weights in HBM, so it is
+    the reference semantics, not the serving path) or "pallas" (TPU
+    kernel, int8 and full-precision variants — weight tiles DMA
+    per-block via scalar prefetch, nothing materializes;
+    ``interpret=True`` runs it on CPU for tests).
+    """
+    from ..parallel.moe import router_probs
+
+    B, S, D = x.shape
+    flat = x.reshape(-1, D)
+    probs = router_probs(params["router"], flat)                # [T, E]
+    plan = route_sorted_blocks(probs, config.top_k, block)
+
+    x_pad = flat[plan["sorted_token"]]                          # [NP, D]
+    x_pad = x_pad * plan["row_valid"][:, None].astype(x.dtype)
+    NB = plan["block_expert"].shape[0]
+
+    quantized = isinstance(params["w1"], dict)
+    if impl == "pallas" and quantized:
+        out_blocks = _expert_blocks_pallas_q8(
+            x_pad.reshape(NB, block, D), params["w1"], params["w3"],
+            params["w2"], plan["block_expert"], act=act, block=block,
+            interpret=interpret)
+    elif impl == "pallas":
+        out_blocks = _expert_blocks_pallas(
+            x_pad.reshape(NB, block, D), params["w1"], params["w3"],
+            params["w2"], plan["block_expert"], act=act, block=block,
+            interpret=interpret)
+    else:
+        out_blocks = _expert_blocks_xla(
+            x_pad.reshape(NB, block, D), params["w1"], params["w3"],
+            params["w2"], plan["block_expert"], act)
+    out_rows = out_blocks.reshape(NB * block, D)
+    weighted = out_rows * (plan["gates"]
+                           * plan["row_valid"])[:, None].astype(x.dtype)
+    out = jnp.zeros_like(flat).at[plan["sorted_token"]].add(weighted)
+    return out.reshape(B, S, D)
+
+
+def grouped_flops(T: int, top_k: int, n_experts: int, dim: int,
+                  hidden: int, block: int = 128) -> dict[str, float]:
+    """FFN FLOPs accounting: grouped vs dense-mask vs ideal (router
+    excluded from all three). Used by tests to pin the ~k/E claim."""
+    per_row = 3 * 2 * dim * hidden          # w1, w3, w2 matmuls
+    NB = -(-T * top_k // block) + n_experts
+    return {
+        "dense_mask": float(n_experts * T * per_row),
+        "grouped": float(NB * block * per_row),
+        "ideal": float(T * top_k * per_row),
+    }

@@ -1,0 +1,227 @@
+"""Dropless grouped-GEMM MoE (round-4 VERDICT next #4).
+
+The block-sparse formulation must compute EXACTLY the dense-mask
+formulation's per-token function (the continuous-batching invariant
+rides on it) at ~top_k/n_experts of the dense FLOPs, with the Pallas
+kernel (interpreter mode on CPU) agreeing with the XLA reference path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mcp_context_forge_tpu.tpu_local.ops.grouped_moe import (
+    grouped_flops, moe_ffn_grouped, route_sorted_blocks)
+from mcp_context_forge_tpu.tpu_local.parallel.moe import (
+    MoEConfig, init_moe_params, moe_ffn_dense_mask, router_probs)
+
+CFG = MoEConfig(dim=32, n_experts=8, expert_hidden=64, top_k=2)
+
+
+def _params(seed=0, dtype=jnp.float32):
+    return init_moe_params(CFG, jax.random.PRNGKey(seed), dtype=dtype)
+
+
+def _x(shape=(2, 24), seed=1):
+    return jax.random.normal(jax.random.PRNGKey(seed),
+                             (*shape, CFG.dim), dtype=jnp.float32)
+
+
+def test_routing_plan_invariants():
+    probs = jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(3), (50, CFG.n_experts)),
+        axis=-1)
+    plan = route_sorted_blocks(probs, CFG.top_k, block=16)
+    NB = plan["block_expert"].shape[0]
+    assert NB == -(-50 * CFG.top_k // 16) + CFG.n_experts
+    valid = np.asarray(plan["row_valid"])
+    assert valid.sum() == 50 * CFG.top_k          # dropless: every pair
+    # every live row's block belongs to the expert that row routed to
+    block_expert = np.asarray(plan["block_expert"])
+    tokens = np.asarray(plan["sorted_token"])
+    gates = np.asarray(plan["gates"])
+    _, top_idx = jax.lax.top_k(probs, CFG.top_k)
+    routed = {(int(t), int(e))
+              for t, row in enumerate(np.asarray(top_idx)) for e in row}
+    for row in np.nonzero(valid)[0]:
+        expert = block_expert[row // 16]
+        assert (tokens[row], expert) in routed
+        assert gates[row] > 0
+    # gates of each token sum to 1 (renormalized top-k)
+    sums = np.zeros(50)
+    for row in np.nonzero(valid)[0]:
+        sums[tokens[row]] += gates[row]
+    np.testing.assert_allclose(sums, 1.0, rtol=1e-5)
+
+
+def test_grouped_xla_matches_dense_mask_oracle():
+    params = _params()
+    x = _x()
+    dense = moe_ffn_dense_mask(params, x, CFG)
+    grouped = moe_ffn_grouped(params, x, CFG, impl="xla", block=16)
+    np.testing.assert_allclose(np.asarray(grouped), np.asarray(dense),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_grouped_pallas_interpret_matches_xla():
+    params = _params()
+    x = _x()
+    xla = moe_ffn_grouped(params, x, CFG, impl="xla", block=16)
+    pallas = moe_ffn_grouped(params, x, CFG, impl="pallas", block=16,
+                             interpret=True)
+    np.testing.assert_allclose(np.asarray(pallas), np.asarray(xla),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_batch_shape_invariance():
+    """The dropless property that matters for serving: prefill+decode
+    must equal one long prefill — per-token outputs are independent of
+    how tokens are batched."""
+    params = _params()
+    x = _x((1, 48), seed=7)
+    together = moe_ffn_grouped(params, x, CFG, impl="xla", block=16)
+    first = moe_ffn_grouped(params, x[:, :31], CFG, impl="xla", block=16)
+    rest = moe_ffn_grouped(params, x[:, 31:], CFG, impl="xla", block=16)
+    np.testing.assert_allclose(
+        np.asarray(jnp.concatenate([first, rest], axis=1)),
+        np.asarray(together), rtol=2e-5, atol=2e-6)
+
+
+def test_extreme_skew_is_dropless():
+    """All tokens routed to ONE expert (the capacity formulation's worst
+    case): the grouped path must still match the oracle exactly."""
+    params = _params()
+    # a router that sends everything to expert 3 with top-2 = {3, then 0}
+    router = np.zeros((CFG.dim, CFG.n_experts), np.float32)
+    router[:, 3] = 1.0
+    params["router"] = jnp.asarray(router)
+    x = jnp.abs(_x((1, 40), seed=9)) + 0.1   # positive => logits skew to 3
+    dense = moe_ffn_dense_mask(params, x, CFG)
+    grouped = moe_ffn_grouped(params, x, CFG, impl="xla", block=16)
+    np.testing.assert_allclose(np.asarray(grouped), np.asarray(dense),
+                               rtol=2e-5, atol=2e-6)
+    pallas = moe_ffn_grouped(params, x, CFG, impl="pallas", block=16,
+                             interpret=True)
+    np.testing.assert_allclose(np.asarray(pallas), np.asarray(dense),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_gelu_activation_parity():
+    params = _params()
+    x = _x()
+    dense = moe_ffn_dense_mask(params, x, CFG, act="gelu")
+    grouped = moe_ffn_grouped(params, x, CFG, act="gelu", impl="xla",
+                              block=16)
+    np.testing.assert_allclose(np.asarray(grouped), np.asarray(dense),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_quantized_experts_route_through_xla_path():
+    from mcp_context_forge_tpu.tpu_local.quantize import quantize_tree
+
+    params = _params()
+    # the serving trunk's logical names (models/llama.py moe layer): the
+    # _QUANT_RULES table covers moe_up/moe_down — NOT the EP-training
+    # "expert_stack" name, which would silently skip quantization
+    logical = {"router": "replicated", "w1": "moe_up", "w3": "moe_up",
+               "w2": "moe_down"}
+    qparams = quantize_tree(dict(params), logical)
+    from mcp_context_forge_tpu.tpu_local.quantize import is_quant
+    assert is_quant(qparams["w1"]) and is_quant(qparams["w2"])
+    x = _x()
+    dense = moe_ffn_dense_mask(qparams, x, CFG)
+    grouped = moe_ffn_grouped(qparams, x, CFG, impl="xla", block=16)
+    np.testing.assert_allclose(np.asarray(grouped), np.asarray(dense),
+                               rtol=1e-3, atol=1e-4)
+    # the int8 Pallas kernel (interpret mode) matches both
+    kernel = moe_ffn_grouped(qparams, x, CFG, impl="pallas", block=16,
+                             interpret=True)
+    np.testing.assert_allclose(np.asarray(kernel), np.asarray(grouped),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_flops_accounting_near_topk_over_e():
+    """The whole point: ~top_k/E of dense cost, padding vanishing with T."""
+    acct = grouped_flops(T=2048, top_k=2, n_experts=8, dim=512,
+                         hidden=1024, block=128)
+    assert acct["ideal"] / acct["dense_mask"] == pytest.approx(0.25)
+    ratio = acct["grouped"] / acct["dense_mask"]
+    assert ratio < 0.33                       # ~4x fewer FLOPs than dense
+    big = grouped_flops(T=65536, top_k=2, n_experts=8, dim=512,
+                        hidden=1024, block=128)
+    assert big["grouped"] / big["ideal"] < 1.01   # padding term vanishes
+
+
+def test_mixtral_trunk_parity_across_impls():
+    """The serving trunk end-to-end: a mixtral-test engine generates the
+    SAME greedy tokens under dense / grouped / grouped_pallas — the MoE
+    formulation is a perf knob, never a numerics knob. moe_block is
+    shrunk so the CI-scale prefill clears the T·k >= E·block gate (at the
+    default 128 the tiny prompt would fall back to dense)."""
+    import asyncio
+    import dataclasses
+
+    from mcp_context_forge_tpu.tpu_local.engine import (EngineConfig,
+                                                        TPUEngine)
+
+    def generate(moe_impl: str) -> list[int]:
+        config = EngineConfig(model="mixtral-test", max_batch=2,
+                              max_seq_len=128, page_size=16, num_pages=32,
+                              prefill_buckets=(32,), dtype="float32",
+                              attn_impl="reference", moe_impl=moe_impl)
+        engine = TPUEngine(config)
+        engine.model_config = dataclasses.replace(engine.model_config,
+                                                  moe_block=8)
+
+        async def run():
+            await engine.start()
+            try:
+                ids = engine.tokenizer.encode("route me through experts")
+                return [t async for t in engine.generate(ids, max_tokens=8)]
+            finally:
+                await engine.stop()
+
+        return asyncio.run(run())
+
+    dense = generate("dense")
+    assert len(dense) == 8
+    assert generate("grouped") == dense
+    assert generate("grouped_pallas") == dense  # interprets off-TPU
+
+
+def test_decode_shapes_fall_back_to_dense():
+    """The gate: grouped pays only when T·k >= E·block — a decode-shaped
+    [B, 1] call must route through the dense scan (block padding would
+    cost MORE than dense there), without changing outputs."""
+    from unittest import mock
+
+    params = _params()
+    x = _x((4, 1), seed=11)  # decode shape: T=4, k=2 -> 8 < E*block
+
+    class _Cfg:
+        dim = CFG.dim
+        n_experts = CFG.n_experts
+        ffn_hidden = CFG.expert_hidden
+        moe_top_k = CFG.top_k
+        hidden_act = "silu"
+        moe_impl = "grouped"
+        moe_block = 16
+
+    from mcp_context_forge_tpu.tpu_local.models.llama import _ffn_block
+    layer = dict(params)
+    with mock.patch(
+            "mcp_context_forge_tpu.tpu_local.ops.grouped_moe."
+            "moe_ffn_grouped") as spy:
+        out = _ffn_block(layer, _Cfg(), x)
+        spy.assert_not_called()
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(moe_ffn_dense_mask(params, x, CFG)),
+        rtol=2e-5, atol=2e-6)
+    # a prefill-shaped call with the same config DOES take the grouped path
+    big = _x((4, 32), seed=12)  # T=128, k=2 -> 256 >= E*block=128
+    grouped = _ffn_block(layer, _Cfg(), big)
+    np.testing.assert_allclose(
+        np.asarray(grouped),
+        np.asarray(moe_ffn_dense_mask(params, big, CFG)),
+        rtol=2e-5, atol=2e-6)

@@ -42,6 +42,10 @@ GRID = [
     # the flagship: Llama-3-8B int8 resident on ONE v5e chip (VERDICT #2)
     {"BENCH_DECODE_BLOCK": "4", "BENCH_SPEC": "0", "BENCH_QUANT": "int8",
      "BENCH_MODEL": "llama3-8b", "BENCH_CLIENTS": "8"},
+    # grouped-GEMM MoE kernel A/B on real silicon (round-5): dense-mask
+    # scan vs block-sparse Pallas kernel on the CI-scale mixtral
+    {"BENCH_MODEL": "mixtral-test", "BENCH_MOE_IMPL": "dense"},
+    {"BENCH_MODEL": "mixtral-test", "BENCH_MOE_IMPL": "grouped_pallas"},
 ]
 
 
