@@ -70,6 +70,7 @@ async def run(platform: str) -> dict:
     quant = os.environ.get("BENCH_QUANT", "")
     buckets = os.environ.get("BENCH_BATCH_BUCKETS", "0") == "1"
     moe_impl = os.environ.get("BENCH_MOE_IMPL", "")
+    moe_block = int(os.environ.get("BENCH_MOE_BLOCK", "0"))
     config = EngineConfig(model=model, max_batch=min(clients, 16),
                           max_seq_len=512, page_size=16, num_pages=1024,
                           prefill_buckets=(64,),
@@ -77,6 +78,7 @@ async def run(platform: str) -> dict:
                           attn_impl="auto", decode_block=decode_block,
                           spec_decode=spec, quant=quant,
                           batch_buckets=buckets, moe_impl=moe_impl,
+                          moe_block=moe_block,
                           compile_cache_dir=os.environ.get(
                               "MCPFORGE_TPU_LOCAL_COMPILE_CACHE_DIR",
                               "/tmp/mcpforge-xla-cache"))

@@ -49,7 +49,27 @@ def translate_sql(sql: str) -> str:
     if re.search(r"^\s*INSERT\s+OR\s+IGNORE", out, re.IGNORECASE):
         out = re.sub(r"INSERT\s+OR\s+IGNORE", "INSERT", out, count=1,
                      flags=re.IGNORECASE)
-        out = out.rstrip().rstrip(";") + " ON CONFLICT DO NOTHING"
+        out = out.rstrip().rstrip(";")
+        # the conflict clause precedes RETURNING in PG grammar — appending
+        # blindly would produce "... RETURNING x ON CONFLICT ..." (invalid
+        # on every backend; caught by the differential corpus). Search
+        # OUTSIDE string literals only: a column value containing the
+        # word "returning" must not attract the clause into the literal.
+        segments = out.split("'")
+        pos = None
+        offset = 0
+        for i, segment in enumerate(segments):
+            if i % 2 == 0:
+                found = re.search(r"\bRETURNING\b", segment, re.IGNORECASE)
+                if found:
+                    pos = offset + found.start()
+                    break
+            offset += len(segment) + 1
+        if pos is not None:
+            out = (out[:pos].rstrip() + " ON CONFLICT DO NOTHING "
+                   + out[pos:])
+        else:
+            out += " ON CONFLICT DO NOTHING"
     out = re.sub(r"\bAUTOINCREMENT\b", "GENERATED ALWAYS AS IDENTITY",
                  out, flags=re.IGNORECASE)
     out = re.sub(r"\bINTEGER\s+PRIMARY\s+KEY\s+GENERATED ALWAYS AS IDENTITY",

@@ -100,8 +100,12 @@ class EngineConfig:
     # how Llama-3-8B fits a single 16 GB v5e chip)
     quant: str = ""
     # MoE serving formulation override ("" = model default; see
-    # models/configs.py moe_impl): dense | grouped | grouped_pallas
+    # models/configs.py moe_impl): dense | grouped | grouped_pallas.
+    # moe_block overrides the kernel row-block AND the T·k >= E·block
+    # engagement gate (0 = model default) — small models/benches need a
+    # smaller block or every dispatch falls back to the dense scan.
     moe_impl: str = ""
+    moe_block: int = 0
     # decode batch-width bucketing: size decode arrays by the ACTIVE slot
     # ceiling (pow-2, with slot compaction + shrink hysteresis) instead of
     # max_batch. Wins on sparse/steady loads (fewer wasted rows per step);
@@ -322,10 +326,15 @@ class TPUEngine:
         if config.compile_cache_dir:
             _apply_compile_cache(config.compile_cache_dir)
         self.model_config: LlamaConfig = MODEL_CONFIGS[config.model]
-        if config.moe_impl:
+        if config.moe_impl or config.moe_block:
             import dataclasses
-            self.model_config = dataclasses.replace(
-                self.model_config, moe_impl=config.moe_impl)
+            overrides: dict[str, Any] = {}
+            if config.moe_impl:
+                overrides["moe_impl"] = config.moe_impl
+            if config.moe_block:
+                overrides["moe_block"] = config.moe_block
+            self.model_config = dataclasses.replace(self.model_config,
+                                                    **overrides)
         self.tokenizer = load_tokenizer(config.checkpoint,
                                         vocab_size=self.model_config.vocab_size)
         self.stats = EngineStats()

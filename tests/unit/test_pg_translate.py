@@ -65,3 +65,20 @@ def test_live_postgres_roundtrip():  # pragma: no cover - needs a server
             await db.close()
 
     asyncio.run(main())
+
+
+def test_on_conflict_precedes_returning_clause():
+    """PG grammar: the conflict clause comes BEFORE RETURNING; and a
+    literal containing the word 'returning' must not attract it."""
+    out = translate_sql(
+        "INSERT OR IGNORE INTO t (a) VALUES (?) RETURNING id")
+    assert out == ("INSERT INTO t (a) VALUES ($1)"
+                   " ON CONFLICT DO NOTHING RETURNING id")
+    out = translate_sql(
+        "INSERT OR IGNORE INTO t (a) VALUES ('about RETURNING rows')")
+    assert out == ("INSERT INTO t (a) VALUES ('about RETURNING rows')"
+                   " ON CONFLICT DO NOTHING")
+    out = translate_sql(
+        "INSERT OR IGNORE INTO t (a) VALUES ('x RETURNING y') RETURNING a")
+    assert out == ("INSERT INTO t (a) VALUES ('x RETURNING y')"
+                   " ON CONFLICT DO NOTHING RETURNING a")

@@ -43,9 +43,13 @@ GRID = [
     {"BENCH_DECODE_BLOCK": "4", "BENCH_SPEC": "0", "BENCH_QUANT": "int8",
      "BENCH_MODEL": "llama3-8b", "BENCH_CLIENTS": "8"},
     # grouped-GEMM MoE kernel A/B on real silicon (round-5): dense-mask
-    # scan vs block-sparse Pallas kernel on the CI-scale mixtral
+    # scan vs block-sparse Pallas kernel on the CI-scale mixtral.
+    # moe_block=16 so 64-token prefill dispatches clear the T*k >= E*block
+    # gate (at the default 128 nearly every dispatch would fall back to
+    # dense and the A/B would compare dense against dense)
     {"BENCH_MODEL": "mixtral-test", "BENCH_MOE_IMPL": "dense"},
-    {"BENCH_MODEL": "mixtral-test", "BENCH_MOE_IMPL": "grouped_pallas"},
+    {"BENCH_MODEL": "mixtral-test", "BENCH_MOE_IMPL": "grouped_pallas",
+     "BENCH_MOE_BLOCK": "16"},
 ]
 
 
