@@ -41,6 +41,28 @@ def query_log_capture() -> Iterator[list[tuple[str, float]]]:
         _query_capture.reset(token)
 
 
+def iter_outside_literal_segments(sql: str):
+    """Yield ``(offset, segment)`` for every stretch of ``sql`` OUTSIDE
+    single-quoted string literals (sqlite/PG '' escapes fall out of the
+    parity naturally). THE one implementation of the literal-skipping
+    idiom — the dialect translators (pg.translate_sql, pgserver.
+    pg_to_sqlite) must all use it, so a literal-awareness fix lands
+    everywhere at once."""
+    offset = 0
+    for i, segment in enumerate(sql.split("'")):
+        if i % 2 == 0:
+            yield offset, segment
+        offset += len(segment) + 1
+
+
+def map_outside_literals(sql: str, fn) -> str:
+    """Rewrite only the outside-literal segments with ``fn``."""
+    parts = sql.split("'")
+    for i in range(0, len(parts), 2):
+        parts[i] = fn(parts[i])
+    return "'".join(parts)
+
+
 @dataclass(frozen=True)
 class Migration:
     version: int

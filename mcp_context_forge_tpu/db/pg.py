@@ -31,7 +31,8 @@ import re
 import time
 from typing import Any, Iterable, Sequence
 
-from .core import Migration
+from .core import (Migration, iter_outside_literal_segments,
+                   map_outside_literals)
 from .pgwire import PGWirePool
 
 # the driver is in-tree now — always available (name kept because older
@@ -55,16 +56,12 @@ def translate_sql(sql: str) -> str:
         # on every backend; caught by the differential corpus). Search
         # OUTSIDE string literals only: a column value containing the
         # word "returning" must not attract the clause into the literal.
-        segments = out.split("'")
         pos = None
-        offset = 0
-        for i, segment in enumerate(segments):
-            if i % 2 == 0:
-                found = re.search(r"\bRETURNING\b", segment, re.IGNORECASE)
-                if found:
-                    pos = offset + found.start()
-                    break
-            offset += len(segment) + 1
+        for offset, segment in iter_outside_literal_segments(out):
+            found = re.search(r"\bRETURNING\b", segment, re.IGNORECASE)
+            if found:
+                pos = offset + found.start()
+                break
         if pos is not None:
             out = (out[:pos].rstrip() + " ON CONFLICT DO NOTHING "
                    + out[pos:])
@@ -76,15 +73,16 @@ def translate_sql(sql: str) -> str:
                  "BIGINT GENERATED ALWAYS AS IDENTITY PRIMARY KEY",
                  out, flags=re.IGNORECASE)
     # positional placeholders: ? -> $n (skip ? inside string literals)
-    parts = out.split("'")
     n = 0
-    for i in range(0, len(parts), 2):  # even chunks are outside literals
+
+    def number_placeholders(segment: str) -> str:
         def repl(_m) -> str:
             nonlocal n
             n += 1
             return f"${n}"
-        parts[i] = re.sub(r"\?", repl, parts[i])
-    return "'".join(parts)
+        return re.sub(r"\?", repl, segment)
+
+    return map_outside_literals(out, number_placeholders)
 
 
 class PostgresDatabase:

@@ -52,10 +52,9 @@ def pg_to_sqlite(sql: str) -> str:
     out = re.sub(r"\bDOUBLE\s+PRECISION\b", "REAL", out, flags=re.IGNORECASE)
     # $n -> ?n outside string literals (sqlite numbered params match
     # postgres positional semantics exactly)
-    parts = out.split("'")
-    for i in range(0, len(parts), 2):
-        parts[i] = re.sub(r"\$(\d+)", r"?\1", parts[i])
-    return "'".join(parts)
+    from .core import map_outside_literals
+    return map_outside_literals(
+        out, lambda segment: re.sub(r"\$(\d+)", r"?\1", segment))
 
 
 def _infer_oid(values: list[Any]) -> int:

@@ -84,8 +84,14 @@ _PAGE = """<!doctype html>
  <div id="view"></div>
  <div id="detail"></div>
 </main>
-<script>
-// double-submit CSRF: echo the csrf_token cookie on every fetch — a
+<script src="/admin/app.js"></script>
+</body></html>"""
+
+# The page's JavaScript, served as its own asset (/admin/app.js) so
+# it is a TESTABLE MODULE: tests/integration/test_admin_js_render.py
+# extracts and EXECUTES its pure render functions (no JS runtime in
+# the CI image; a mechanical subset translator runs them in-process).
+_JS = r"""// double-submit CSRF: echo the csrf_token cookie on every fetch — a
 // cross-site page can make the browser SEND the cookie but cannot READ
 // it, so the echo proves this same-origin script issued the request
 const _fetch = window.fetch.bind(window);
@@ -743,7 +749,8 @@ for (const name of Object.keys(TABS)){
   b.textContent = name; b.onclick = ()=>show(name); nav.appendChild(b);
 }
 show("tools");
-</script></body></html>"""
+"""
+
 
 
 def setup_admin_ui(app: web.Application) -> None:
@@ -763,10 +770,23 @@ def setup_admin_ui(app: web.Application) -> None:
                                 samesite="Strict", path="/")
         return response
 
+    async def admin_js(request: web.Request) -> web.Response:
+        request["auth"].require("observability.read")
+        return web.Response(text=_JS,
+                            content_type="application/javascript")
+
     app.router.add_get("/admin", admin_page)
     app.router.add_get("/admin/", admin_page)
+    app.router.add_get("/admin/app.js", admin_js)
 
 
 def admin_page_source() -> str:
-    """The page source, for the UI contract test tier."""
-    return _PAGE
+    """HTML + JS combined, for the UI contract/coverage test tier (the
+    gates scan every URL the page's JS can build)."""
+    return _PAGE + _JS
+
+
+def admin_js_source() -> str:
+    """The JS module alone, for the execution test tier
+    (tests/integration/test_admin_js_render.py)."""
+    return _JS

@@ -1,0 +1,329 @@
+"""Admin-page JS EXECUTION tier (round-4 VERDICT next #10).
+
+No JS runtime exists in the CI image (no node/bun/deno/quickjs, no
+embeddable engine), so the page's pure render functions (``esc``,
+``cell``) are extracted from the served /admin/app.js module and run
+through a MECHANICAL subset translator into Python — the translator
+raises on any construct it does not understand, so the functions cannot
+drift into untested territory silently. The translated logic then
+EXECUTES against golden vectors (including stored-XSS payloads) and
+against live API rows from a booted gateway, mirroring the page's
+``render()`` row template. Reference tier: tests/playwright/.
+"""
+
+import json
+import re
+
+import aiohttp
+import pytest
+
+from mcp_context_forge_tpu.gateway.admin_ui import admin_js_source
+from tests.integration.test_gateway_app import BASIC, make_client
+
+ADMIN = aiohttp.BasicAuth(*BASIC)
+
+UNDEFINED = object()   # JS undefined sentinel (distinct from null=None)
+
+
+# ----------------------------------------------------- extraction helpers
+
+def extract_function(name: str) -> str:
+    js = admin_js_source()
+    match = re.search(rf"function {name}\(([^)]*)\)\s*{{", js)
+    assert match, f"function {name} not found in /admin/app.js"
+    depth = 0
+    start = js.index("{", match.start())
+    for i in range(start, len(js)):
+        if js[i] == "{":
+            depth += 1
+        elif js[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return js[match.start():i + 1]
+    raise AssertionError(f"unbalanced braces in {name}")
+
+
+# ------------------------------------------------- JS-subset runtime shims
+
+def js_string(v):
+    if v is UNDEFINED:
+        return "undefined"
+    if v is None:
+        return "null"
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    if isinstance(v, float) and v == int(v):
+        return str(int(v))
+    if isinstance(v, (dict, list)):
+        return json.dumps(v, separators=(",", ":"))  # close enough for cell
+    return str(v)
+
+
+def js_eq(a, b):
+    """JS === : same type AND same value (numbers are one type; bools are
+    NOT numbers — 1 === true is false)."""
+    if isinstance(a, bool) or isinstance(b, bool):
+        return a is b
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return a == b
+    if type(a) is not type(b):
+        return False
+    return a == b
+
+
+def js_typeof(v):
+    if v is UNDEFINED:
+        return "undefined"
+    if isinstance(v, bool):
+        return "boolean"
+    if isinstance(v, (int, float)):
+        return "number"
+    if isinstance(v, str):
+        return "string"
+    return "object"   # null, arrays, dicts — all "object" in JS
+
+
+def math_round(v):
+    import math
+    return math.floor(v + 0.5)   # JS rounds .5 toward +inf
+
+
+def js_replace_map(s, char_class, mapping):
+    return re.sub(char_class, lambda m: mapping[m.group(0)], s)
+
+
+def json_stringify(v):
+    return json.dumps(v, separators=(",", ":"))
+
+
+# --------------------------------------------------- the subset translator
+
+def translate(js_fn: str):
+    """Mechanically translate one flat JS function (if/return chains +
+    the expression constructs the admin page uses) into a Python
+    callable. Anything unrecognized raises — drift fails loudly."""
+    js_fn = re.sub(r"//[^\n]*", "", js_fn)           # strip comments
+    header = re.match(r"function (\w+)\(([^)]*)\)\s*{(.*)}\s*$",
+                      js_fn, re.DOTALL)
+    assert header, f"unparsable function header: {js_fn[:80]}"
+    name, args, body = header.groups()
+
+    # join multi-line statements (statements end with ';') — split only
+    # OUTSIDE string literals (the esc map contains quoted entities)
+    def split_statements(text: str) -> list[str]:
+        out, buf, quote, in_regex = [], [], None, False
+        prev_sig = ""   # last non-space char outside literals
+        for ch in text.replace("\n", " "):
+            if quote:
+                buf.append(ch)
+                if ch == quote:
+                    quote = None
+            elif in_regex:
+                buf.append(ch)
+                if ch == "/":
+                    in_regex = False
+            elif ch in "'\"`":
+                quote = ch
+                buf.append(ch)
+            elif ch == "/" and prev_sig in "(,=":
+                in_regex = True   # /regex/ literal (e.g. esc's char class)
+                buf.append(ch)
+            elif ch == ";":
+                out.append("".join(buf))
+                buf = []
+            else:
+                buf.append(ch)
+            if not ch.isspace() and quote is None and not in_regex:
+                prev_sig = ch
+        out.append("".join(buf))
+        return [s.strip() for s in out if s.strip()]
+
+    statements = split_statements(body)
+
+    def expr(e: str) -> str:
+        e = e.strip()
+        # the esc() replace idiom: .replace(/[...]/g, c => ({...})[c])
+        replace = re.match(
+            r"^(.*?)\.replace\(/(\[[^/]*\])/g,\s*\w+\s*=>\s*"
+            r"\(\s*(\{.*\})\[\w+\]\s*\)\)$", e, re.DOTALL)
+        if replace:
+            base, char_class, mapping = replace.groups()
+            return (f"js_replace_map({expr(base)}, {char_class!r}, "
+                    f"{mapping})")
+        # ternary (non-nested)
+        ternary = re.match(r"^\((.*?)\)\s*\?(.*?):(.*)$", e, re.DOTALL)
+        if ternary:
+            cond, then, other = ternary.groups()
+            return (f"({expr(then)} if {expr(cond)} else {expr(other)})")
+        # strict equality / typeof / membership rewrites
+        e = re.sub(r"typeof (\w+) === \"(\w+)\"",
+                   r'js_eq(js_typeof(\1), "\2")', e)
+        e = re.sub(r"(\w+(?:\.\w+)*)\s*===\s*(true|false|null|undefined)",
+                   lambda m: f"js_eq({m.group(1)}, {_lit(m.group(2))})", e)
+        e = re.sub(r"(\w+(?:\.\w+)*)\s*===\s*(\d+)",
+                   r"js_eq(\1, \2)", e)
+        e = e.replace("||", " or ").replace("&&", " and ")
+        e = re.sub(r"Array\.isArray\((\w+)\)", r"isinstance(\1, list)", e)
+        e = re.sub(r"Math\.round\(([^)]*)\)", r"math_round(\1)", e)
+        e = re.sub(r"JSON\.stringify\((\w+)\)", r"json_stringify(\1)", e)
+        e = re.sub(r"String\((\w+)\)", r"js_string(\1)", e)
+        e = re.sub(r"\.slice\((\d+),\s*(\d+)\)", r"[\1:\2]", e)
+        e = re.sub(r"(\w+)\.length", r"len(\1)", e)
+        return e
+
+    def _lit(token: str) -> str:
+        return {"true": "True", "false": "False", "null": "None",
+                "undefined": "UNDEFINED"}[token]
+
+    lines = [f"def {name}({args}, *_ignored):"]
+    for statement in statements:
+        conditional = re.match(r"^if \((.*?)\)\s+return\s+(.*)$",
+                               statement, re.DOTALL)
+        plain = re.match(r"^return\s+(.*)$", statement, re.DOTALL)
+        if conditional:
+            cond, value = conditional.groups()
+            lines.append(f"    if {expr(cond)}: return {expr(value)}")
+        elif plain:
+            lines.append(f"    return {expr(plain.group(1))}")
+        else:
+            raise AssertionError(
+                f"untranslatable statement in {name}: {statement!r}")
+    namespace = {"js_eq": js_eq, "js_typeof": js_typeof,
+                 "js_string": js_string, "math_round": math_round,
+                 "js_replace_map": js_replace_map,
+                 "json_stringify": json_stringify, "UNDEFINED": UNDEFINED}
+    exec("\n".join(lines), namespace)  # noqa: S102 — our own page source
+    return namespace[name]
+
+
+@pytest.fixture(scope="module")
+def esc():
+    return translate(extract_function("esc"))
+
+
+@pytest.fixture(scope="module")
+def cell():
+    fn = translate(extract_function("cell"))
+    # cell calls esc — bind the translated esc into its namespace
+    fn.__globals__["esc"] = translate(extract_function("esc"))
+
+    def bound(v, is_bool=False):
+        return fn(v, is_bool)
+    return bound
+
+
+# ------------------------------------------------------- golden executions
+
+def test_esc_executes_and_neutralizes_xss(esc):
+    assert esc("plain") == "plain"
+    assert esc("<script>alert(1)</script>") == \
+        "&lt;script&gt;alert(1)&lt;/script&gt;"
+    assert esc("a&b") == "a&amp;b"
+    assert esc('x" onmouseover="evil()') == \
+        "x&quot; onmouseover=&quot;evil()"
+    assert esc("o'brien") == "o&#39;brien"
+    assert esc(42) == "42"          # String() coercion, then escape
+    assert esc(None) == "null"
+
+
+def test_cell_executes_the_page_type_dispatch(cell):
+    # per-column boolean rendering (sqlite int-bools)
+    assert cell(1, True) == '<span class="pill ok">yes</span>'
+    assert cell(0, True) == '<span class="pill bad">no</span>'
+    assert cell(True, True) == '<span class="pill ok">yes</span>'
+    # value-typed booleans without the column hint
+    assert cell(True) == '<span class="pill ok">yes</span>'
+    assert cell(False) == '<span class="pill bad">no</span>'
+    # JS semantics: 1 is NOT true without the column hint
+    assert cell(1) == 1.0 or cell(1) == 1
+    assert cell([1, 2, 3]) == 3          # arrays render as their length
+    assert cell(None) == ""
+    assert cell(UNDEFINED) == ""
+    assert cell(3.14159) == 3.14         # Math.round(v*100)/100
+    assert cell({"k": "<i>"}) == esc_json({"k": "<i>"})
+    long = "x" * 200
+    assert cell(long) == "x" * 100       # slice cap
+    assert cell("<b>bold</b>") == "&lt;b&gt;bold&lt;/b&gt;"
+
+
+def esc_json(v):
+    raw = json.dumps(v, separators=(",", ":"))[:80]
+    return (raw.replace("&", "&amp;").replace("<", "&lt;")
+               .replace(">", "&gt;").replace('"', "&quot;")
+               .replace("'", "&#39;"))
+
+
+def test_rounding_matches_js_not_python(cell):
+    """JS Math.round rounds .5 toward +inf; Python's round() is
+    banker's — the translator must carry JS semantics."""
+    assert cell(0.125) == 0.13           # round(12.5)/100: banker's says 12
+    assert cell(0.135) == 0.14
+
+
+# -------------------------------------------- live row-render execution
+
+def _tabs_row_template(js: str) -> None:
+    """The mirror contract: render()'s cell call must keep the exact
+    shape this test reproduces (fails loudly if the page changes)."""
+    assert "return `<td>${cell(d[c], bools.has(c))}</td>`;" in js
+
+
+async def test_live_rows_render_with_stored_xss_neutralized(cell, esc):
+    """End-to-end golden render: store an XSS payload through the real
+    API, fetch the rows the page would fetch, execute the page's
+    (translated) cell/esc over them exactly as render() does, and
+    assert the payload cannot escape the table cell."""
+    js = admin_js_source()
+    _tabs_row_template(js)
+    payload = '<img src=x onerror="alert(1)">'
+    client = await make_client()
+    try:
+        resp = await client.post("/tools", json={
+            "name": "xss-probe", "integration_type": "REST",
+            "url": "http://127.0.0.1:1/x", "description": payload},
+            auth=ADMIN)
+        assert resp.status == 201, await resp.text()
+        resp = await client.get("/tools?include_inactive=true", auth=ADMIN)
+        rows = await resp.json()
+        row = next(r for r in rows if r["name"] == "xss-probe")
+
+        cols = ["name", "integration_type", "url", "enabled", "reachable"]
+        bools = {"enabled", "reachable"}
+        cells = "".join(
+            f"<td>{cell(row.get(c, UNDEFINED), c in bools)}</td>"
+            for c in cols)
+        html = "<tr>" + cells + "</tr>"
+        assert payload not in html
+        # description is not a column here; render the detail pane's kv
+        kv = f"<tr><td><b>{esc('description')}</b></td>" \
+             f"<td>{cell(row['description'])}</td></tr>"
+        assert payload not in kv
+        assert "&lt;img" in kv
+        # boolean columns rendered through the pill path
+        assert 'class="pill' in html
+    finally:
+        await client.close()
+
+
+async def test_app_js_served_at_the_src_the_page_references():
+    """The page's <script src> and the router must stay tied: fetch the
+    src URL extracted from the served HTML and get the JS module back
+    (auth-gated like the page itself)."""
+    client = await make_client()
+    try:
+        resp = await client.get("/admin", auth=ADMIN)
+        page = await resp.text()
+        match = re.search(r'<script src="([^"]+)"></script>', page)
+        assert match, "page no longer references an external script"
+        src = match.group(1)
+        resp = await client.get(src, auth=ADMIN)
+        assert resp.status == 200
+        assert resp.headers["content-type"].startswith(
+            "application/javascript")
+        assert await resp.text() == admin_js_source()
+        resp = await client.get(src)
+        assert resp.status == 401   # same auth gate as the page
+    finally:
+        await client.close()

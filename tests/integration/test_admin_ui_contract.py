@@ -19,7 +19,7 @@ def _parse_tabs() -> dict[str, dict]:
     """Extract {tab: {url, path?, special?}} from the page source."""
     from mcp_context_forge_tpu.gateway import admin_ui
 
-    block = admin_ui._PAGE.split("const TABS = {", 1)[1]
+    block = admin_ui.admin_page_source().split("const TABS = {", 1)[1]
     # cut at the closing "};" of the TABS literal
     block = block.split("\n};", 1)[0]
     tabs: dict[str, dict] = {}
@@ -114,7 +114,7 @@ def test_teams_pane_never_interpolates_server_data_into_js_strings():
     the HTML parser decodes entities in attribute values before JS runs."""
     from mcp_context_forge_tpu.gateway import admin_ui
 
-    page = admin_ui._PAGE
+    page = admin_ui.admin_page_source()
     # index-based handler present and wired
     assert "removeMemberAt(" in page
     assert "detailTeam" in page

@@ -286,3 +286,38 @@ def test_landmine_returning_on_conflict_agreement(pg_server):  # noqa: F811
             await wire.close()
 
     asyncio.run(main())
+
+
+def test_landmine_division_sqlstate_now_and_advisory(pg_server):  # noqa: F811
+    """docs/pg-divergences.md rows 3/6/7/8 — asserted so the doc cannot
+    rot: division semantics, coarse SQLSTATE mapping, no server-side
+    now(), and the advisory-lock no-op."""
+    from mcp_context_forge_tpu.db.pgwire import PGConnection
+
+    async def main():
+        conn = PGConnection("127.0.0.1", pg_server, USER, PASSWORD, "forge")
+        await conn.connect()
+        try:
+            # row 3: sqlite 1/0 -> NULL (PG would raise 22012); ints floor
+            rows = await conn.query("SELECT 1/0 AS z, 1/2 AS half")
+            assert rows[0]["z"] is None and rows[0]["half"] == 0
+            # row 6: coarse mapping — unique violation reports 23505
+            await conn.query(
+                "CREATE TABLE IF NOT EXISTS uq_probe (v BIGINT PRIMARY KEY)")
+            await conn.query("INSERT INTO uq_probe (v) VALUES ($1)", [1])
+            try:
+                await conn.query("INSERT INTO uq_probe (v) VALUES ($1)", [1])
+                raise AssertionError("duplicate must raise")
+            except PGError as exc:
+                assert exc.fields.get("C") == "23505"
+            # row 7: no server-side now() — errors instead of a timestamp
+            with pytest.raises(PGError):
+                await conn.query("SELECT now() AS ts")
+            # recover (simple-query errors return to idle) and assert
+            # row 8: advisory locks answer a row without locking anything
+            rows = await conn.query("SELECT pg_advisory_lock(42)")
+            assert len(rows) == 1
+        finally:
+            await conn.close()
+
+    asyncio.run(main())
