@@ -80,12 +80,34 @@ class Settings(BaseModel):
     max_header_bytes: int = 32768         # 431 above this (0 = unlimited)
     cors_allowed_origins: str = ""        # csv; "*" = any; "" = CORS off
 
+    # --- auth resolution cache (reference auth_cache_* family): resolve_*
+    # re-reads users/teams/roles per request; short TTLs bound staleness
+    # and explicit invalidation (role grants, membership changes, toggles)
+    # keeps the must-be-immediate paths immediate ---
+    auth_cache_enabled: bool = True
+    auth_cache_user_ttl: float = 30.0
+    auth_cache_teams_ttl: float = 30.0
+    auth_cache_role_ttl: float = 30.0
+    auth_cache_revocation_ttl: float = 30.0
+    auth_cache_max_entries: int = 4096
+
     # --- CSRF / session protections (reference csrf_middleware.py +
     # password_change_enforcement.py) ---
     csrf_enabled: bool = True
     csrf_trusted_origins_csv: str = ""   # extra allowed Origin values
     csrf_token_ttl_s: float = 8 * 3600.0
+    csrf_cookie_name: str = "csrf_token"
+    csrf_header_name: str = "X-CSRF-Token"
+    csrf_cookie_secure: bool = False     # set true behind TLS
+    csrf_exempt_paths_csv: str = ""      # exact-or-prefix exemptions
+    # fail-closed Origin/Referer requirement for ambient-credential
+    # mutations (reference csrf_check_referer): off by default — it
+    # rejects non-browser basic-auth clients that send neither header
+    csrf_check_referer: bool = False
     password_change_enforcement_enabled: bool = True
+    # bootstrap admin must rotate the seed password before using the
+    # surface (reference admin_require_password_change_on_bootstrap)
+    admin_require_password_change_on_bootstrap: bool = False
     # --- token usage accounting (reference token_usage_middleware.py) ---
     token_usage_logging_enabled: bool = True
     token_usage_log_retention: int = 10000   # rows kept per maintenance pass
@@ -120,6 +142,44 @@ class Settings(BaseModel):
     validation_max_tags: int = 32
     max_prompt_size: int = 1024 * 1024
     max_resource_size: int = 4 * 1024 * 1024
+
+    # --- team governance (reference allow_team_* family) ---
+    allow_team_creation: bool = True
+    allow_team_invitations: bool = True
+    allow_public_visibility: bool = True
+    default_team_member_role: str = "member"
+    invitation_expiry_hours: float = 72.0
+    # --- SSO provisioning policy (reference sso_* long tail) ---
+    sso_trusted_domains_csv: str = ""     # ""=any; else allowlist
+    sso_require_admin_approval: bool = False  # provision deactivated
+    sso_auto_admin_domains_csv: str = ""  # domains granted is_admin
+    # --- API token policy ---
+    api_token_max_lifetime_minutes: float = 0.0  # 0 = unlimited
+    # --- outbound/identity plumbing ---
+    auth_header_name: str = "authorization"  # custom ingress auth header
+    # --- correlation ids (reference correlation_id_* family) ---
+    correlation_id_header: str = "x-correlation-id"
+    correlation_id_response_header: str = "x-correlation-id"
+    correlation_id_preserve: bool = True  # honor inbound ids; else mint
+    # --- DB resilience (reference db_* tuning family) ---
+    db_sqlite_busy_timeout_ms: int = 10000
+    db_max_retries: int = 3               # on SQLITE_BUSY/locked
+    db_retry_interval_ms: float = 50.0
+    # --- content validation (reference content_* family) ---
+    allowed_resource_mime_types_csv: str = ""  # ""=any
+    # --- metrics retention ---
+    metrics_retention_hours: float = 24.0
+    # --- admin stats cache (reference admin_stats_cache_*) ---
+    admin_stats_cache_enabled: bool = False
+    admin_stats_cache_ttl_s: float = 5.0
+    # --- chat agent ---
+    llmchat_max_steps: int = 6
+    # --- CORS detail (reference cors long tail) ---
+    cors_allowed_methods_csv: str = "GET,POST,PUT,DELETE,OPTIONS"
+    cors_allowed_headers_csv: str = ("authorization,content-type,"
+                                     "mcp-protocol-version,mcp-session-id,"
+                                     "x-correlation-id,x-csrf-token")
+    cors_max_age_s: int = 600
 
     # --- per-entity caps (reference max_teams_per_user /
     # max_members_per_team / mcpgateway_a2a_max_agents /
@@ -329,6 +389,41 @@ class Settings(BaseModel):
     def csrf_trusted_origins(self) -> tuple[str, ...]:
         return tuple(o.strip() for o in self.csrf_trusted_origins_csv.split(",")
                      if o.strip())
+
+    @staticmethod
+    def _csv(raw: str) -> tuple[str, ...]:
+        return tuple(v.strip() for v in raw.split(",") if v.strip())
+
+    @property
+    def csrf_exempt_paths(self) -> tuple[str, ...]:
+        return self._csv(self.csrf_exempt_paths_csv)
+
+    @property
+    def sso_trusted_domains(self) -> tuple[str, ...]:
+        return tuple(d.lower() for d in self._csv(self.sso_trusted_domains_csv))
+
+    @property
+    def sso_auto_admin_domains(self) -> tuple[str, ...]:
+        return tuple(d.lower()
+                     for d in self._csv(self.sso_auto_admin_domains_csv))
+
+    @property
+    def allowed_resource_mime_types(self) -> tuple[str, ...]:
+        return self._csv(self.allowed_resource_mime_types_csv)
+
+    @property
+    def cors_allowed_methods(self) -> str:
+        return ", ".join(self._csv(self.cors_allowed_methods_csv))
+
+    @property
+    def cors_allowed_headers(self) -> str:
+        # protocol-required headers always ride along, deduped (an empty
+        # csv must not yield a leading ', ' — malformed header value)
+        merged = list(self._csv(self.cors_allowed_headers_csv))
+        for required in ("mcp-session-id", "last-event-id"):
+            if required not in merged:
+                merged.append(required)
+        return ", ".join(merged)
 
     @property
     def supported_protocol_versions(self) -> set[str]:

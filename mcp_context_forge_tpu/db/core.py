@@ -73,8 +73,13 @@ class Migration:
 class Database:
     """One sqlite connection on one worker thread, async API."""
 
-    def __init__(self, path: str = ":memory:"):
+    def __init__(self, path: str = ":memory:",
+                 busy_timeout_ms: int = 10000, max_retries: int = 3,
+                 retry_interval_ms: float = 50.0):
         self._path = path
+        self._busy_timeout_ms = busy_timeout_ms
+        self._max_retries = max(0, max_retries)
+        self._retry_interval_s = max(0.0, retry_interval_ms) / 1000.0
         self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="db")
         self._conn: sqlite3.Connection | None = None
         self._lock = threading.Lock()
@@ -82,7 +87,8 @@ class Database:
     # -- lifecycle -----------------------------------------------------------
 
     def _connect(self) -> sqlite3.Connection:
-        conn = sqlite3.connect(self._path, check_same_thread=False)
+        conn = sqlite3.connect(self._path, check_same_thread=False,
+                               timeout=self._busy_timeout_ms / 1000.0)
         conn.row_factory = sqlite3.Row
         conn.execute("PRAGMA foreign_keys=ON")
         if self._path not in (":memory:", ""):
@@ -121,9 +127,29 @@ class Database:
             # concurrency signal, not query time — a 1 ms SELECT queued
             # behind a 200 ms statement must not WARN as a slow query
             started = time.monotonic() if timing is not None else 0.0
-            cur = self._conn.execute(sql, params)
-            rows = [dict(r) for r in cur.fetchall()]
-            self._conn.commit()
+            attempt = 0
+            while True:
+                try:
+                    # the retry must cover COMMIT too: cross-process WAL
+                    # contention surfaces at statement finalization as
+                    # often as at execution
+                    cur = self._conn.execute(sql, params)
+                    rows = [dict(r) for r in cur.fetchall()]
+                    self._conn.commit()
+                    break
+                except sqlite3.OperationalError as exc:
+                    # transient cross-process contention (WAL writers from
+                    # another worker): bounded retry (db_max_retries)
+                    message = str(exc).lower()
+                    transient = "locked" in message or "busy" in message
+                    if not transient or attempt >= self._max_retries:
+                        raise
+                    try:
+                        self._conn.rollback()
+                    except sqlite3.Error:
+                        pass
+                    attempt += 1
+                    time.sleep(self._retry_interval_s)
             if timing is not None:
                 timing.append((time.monotonic() - started) * 1000)
             return rows

@@ -224,11 +224,16 @@ def _split(script: str) -> list[str]:
     return statements
 
 
-def make_database(database_url: str, pool_size: int = 8):
+def make_database(database_url: str, pool_size: int = 8,
+                  busy_timeout_ms: int = 10000, max_retries: int = 3,
+                  retry_interval_ms: float = 50.0):
     """Factory: postgres:// / postgresql:// DSNs select PostgresDatabase,
     everything else the sqlite core (reference config.py:14 dual-DB)."""
     if database_url.startswith(("postgres://", "postgresql://")):
         return PostgresDatabase(database_url, pool_size)
     from .core import Database
 
-    return Database(database_url.split("///", 1)[-1] or ":memory:")
+    return Database(database_url.split("///", 1)[-1] or ":memory:",
+                    busy_timeout_ms=busy_timeout_ms,
+                    max_retries=max_retries,
+                    retry_interval_ms=retry_interval_ms)

@@ -765,14 +765,25 @@ def setup_admin_ui(app: web.Application) -> None:
             token = csrf_service.mint(request["auth"].user,
                                       settings.jwt_secret_key,
                                       ttl_s=settings.csrf_token_ttl_s)
-            response.set_cookie(csrf_service.COOKIE_NAME, token,
+            response.set_cookie(settings.csrf_cookie_name, token,
                                 httponly=False,  # JS must read to echo
+                                secure=settings.csrf_cookie_secure,
                                 samesite="Strict", path="/")
         return response
 
+    # substitute the configured CSRF names ONCE (settings are fixed for
+    # the app's lifetime; the cookie name lands inside a JS regex literal,
+    # so regex metacharacters in it must be escaped — 'csrf.token' is a
+    # valid RFC 6265 name that would otherwise change the pattern)
+    import re as _re
+    settings = app["ctx"].settings
+    _served_js = _JS.replace(
+        "csrf_token=", _re.escape(settings.csrf_cookie_name) + "=").replace(
+        '"X-CSRF-Token"', '"' + settings.csrf_header_name + '"')
+
     async def admin_js(request: web.Request) -> web.Response:
         request["auth"].require("observability.read")
-        return web.Response(text=_JS,
+        return web.Response(text=_served_js,
                             content_type="application/javascript")
 
     app.router.add_get("/admin", admin_page)

@@ -47,7 +47,10 @@ async def build_app(settings: Settings | None = None) -> web.Application:
                           client_max_size=settings.max_request_size_bytes)
 
     from ..db.pg import make_database
-    db = make_database(settings.database_url, settings.db_pool_size)
+    db = make_database(settings.database_url, settings.db_pool_size,
+                       busy_timeout_ms=settings.db_sqlite_busy_timeout_ms,
+                       max_retries=settings.db_max_retries,
+                       retry_interval_ms=settings.db_retry_interval_ms)
     await db.connect()
     await db.migrate(MIGRATIONS)
 
@@ -157,6 +160,9 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     completion_service = CompletionService(ctx)
     sampling_handler = SamplingHandler(ctx)
     app["auth_service"] = auth_service
+    # membership/role writers bust the auth resolution cache through this
+    # hook (services must not import each other for it)
+    ctx.extras["auth_invalidate"] = auth_service.invalidate_user
     app["tool_service"] = tool_service
     app["gateway_service"] = gateway_service
     app["resource_service"] = resource_service
@@ -335,9 +341,10 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     app["role_service"] = RoleService(ctx)
     from ..services.compliance_service import ComplianceService
     app["compliance_service"] = ComplianceService(ctx)
-    # pre-create: token_usage_middleware appends from request handlers,
-    # and a frozen (started) aiohttp app refuses new keys
+    # pre-create: request handlers may not add keys to a frozen
+    # (started) aiohttp app
     app["_token_usage_tasks"] = set()
+    app["_stats_cache"] = {}
     from .routers_rbac import setup_compliance_routes, setup_rbac_routes
     setup_rbac_routes(app)
     setup_compliance_routes(app)
@@ -539,7 +546,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
 
     app.router.add_get("/admin/audit", admin_audit)
     metrics_maintenance = MetricsMaintenanceService(
-        ctx, rollup_interval=settings.metrics_buffer_flush_interval * 60)
+        ctx, rollup_interval=settings.metrics_buffer_flush_interval * 60,
+        retention_hours=settings.metrics_retention_hours)
     app["metrics_maintenance"] = metrics_maintenance
     from .routers_chat import setup_chat_routes
     setup_chat_routes(app)

@@ -97,11 +97,9 @@ async def cors_middleware(request: web.Request, handler: Handler) -> web.StreamR
     if request.method == "OPTIONS" and grant:
         headers = {
             "access-control-allow-origin": grant,
-            "access-control-allow-methods": "GET, POST, PUT, DELETE, OPTIONS",
-            "access-control-allow-headers":
-                "authorization, content-type, mcp-session-id,"
-                " mcp-protocol-version, last-event-id",
-            "access-control-max-age": "600",
+            "access-control-allow-methods": settings.cors_allowed_methods,
+            "access-control-allow-headers": settings.cors_allowed_headers,
+            "access-control-max-age": str(settings.cors_max_age_s),
             "vary": "origin",
         }
         if settings.cors_allow_credentials:
@@ -146,7 +144,10 @@ async def error_middleware(request: web.Request, handler: Handler) -> web.Stream
 async def observability_middleware(request: web.Request, handler: Handler) -> web.StreamResponse:
     """Correlation id + span + Prometheus metrics per request."""
     ctx = request.app["ctx"]
-    correlation_id = request.headers.get("x-correlation-id", uuid.uuid4().hex[:16])
+    settings = ctx.settings
+    inbound = (request.headers.get(settings.correlation_id_header, "")
+               if settings.correlation_id_preserve else "")
+    correlation_id = inbound or uuid.uuid4().hex[:16]
     request["correlation_id"] = correlation_id
     started = time.monotonic()
     route = request.match_info.route.resource
@@ -160,7 +161,8 @@ async def observability_middleware(request: web.Request, handler: Handler) -> we
         elapsed = time.monotonic() - started
         ctx.metrics.http_requests.labels(request.method, path_label, str(response.status)).inc()
         ctx.metrics.http_duration.labels(request.method, path_label).observe(elapsed)
-        response.headers["x-correlation-id"] = correlation_id
+        response.headers[settings.correlation_id_response_header] = \
+            correlation_id
         return response
 
 
@@ -305,7 +307,7 @@ async def auth_middleware(request: web.Request, handler: Handler) -> web.StreamR
         request["auth"] = AuthContext(user="anonymous", via="anonymous")
         return await handler(request)
 
-    header = request.headers.get("authorization", "")
+    header = request.headers.get(settings.auth_header_name, "")
     auth_ctx: AuthContext | None = None
     pm = ctx.plugin_manager
     if pm is not None:
@@ -357,8 +359,12 @@ async def csrf_middleware(request: web.Request, handler: Handler
             or request.method in csrf_service.SAFE_METHODS
             or request.path in PUBLIC_PATHS):
         return await handler(request)
+    for exempt in settings.csrf_exempt_paths:
+        if request.path == exempt or \
+                request.path.startswith(exempt.rstrip("/") + "/"):
+            return await handler(request)
     auth = request.get("auth")
-    header = request.headers.get("authorization", "")
+    header = request.headers.get(settings.auth_header_name, "")
     if header.lower().startswith("bearer ") or auth is None \
             or auth.via == "anonymous":
         return await handler(request)
@@ -368,9 +374,19 @@ async def csrf_middleware(request: web.Request, handler: Handler
         return web.json_response(
             {"detail": "CSRF validation failed", "code": "CSRF_CROSS_SITE"},
             status=403)
-    cookie = request.cookies.get(csrf_service.COOKIE_NAME)
+    if settings.csrf_check_referer and not (
+            request.headers.get("origin")
+            or request.headers.get("referer")
+            or request.headers.get("sec-fetch-site")):
+        # fail-closed posture: ambient-credential mutations must declare
+        # provenance (rejects legacy browsers AND non-browser basic-auth
+        # clients — that is the documented trade of enabling this knob)
+        return web.json_response(
+            {"detail": "CSRF validation failed",
+             "code": "CSRF_NO_PROVENANCE"}, status=403)
+    cookie = request.cookies.get(settings.csrf_cookie_name)
     if cookie:
-        echoed = request.headers.get(csrf_service.HEADER_NAME, "")
+        echoed = request.headers.get(settings.csrf_header_name, "")
         import hmac as _hmac
         if not echoed or not _hmac.compare_digest(echoed, cookie) \
                 or not csrf_service.validate(echoed, auth.user,
@@ -431,7 +447,7 @@ async def token_usage_middleware(request: web.Request, handler: Handler
     if jti is None and response.status in (401, 403):
         # auth rejected before an identity existed: identify (not trust)
         # the token, then confirm the jti is a real catalog row
-        header = request.headers.get("authorization", "")
+        header = request.headers.get(settings.auth_header_name, "")
         if header.lower().startswith("bearer "):
             from ..utils import jwt as jwt_utils
             payload = jwt_utils.decode_unverified(header[7:].strip())

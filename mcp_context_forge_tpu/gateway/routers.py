@@ -214,6 +214,7 @@ def setup_routes(app: web.Application) -> None:
         await request.app["ctx"].db.execute(
             "UPDATE users SET is_active=1-is_active, updated_at=? WHERE email=?",
             (now(), email))
+        request.app["auth_service"].invalidate_user(email)
         row = await request.app["ctx"].db.fetchone(
             "SELECT email, is_active FROM users WHERE email=?", (email,))
         if row is None:
@@ -439,6 +440,14 @@ def setup_routes(app: web.Application) -> None:
     @routes.get("/metrics")
     async def metrics_summary(request: web.Request) -> web.Response:
         request["auth"].require("observability.read")
+        settings = request.app["ctx"].settings
+        if settings.admin_stats_cache_enabled:
+            # dashboard polling (auto-refresh tabs) must not re-aggregate
+            # per request (reference admin_stats_cache_* family)
+            import time as _time
+            cached = request.app["_stats_cache"].get("v")
+            if cached and cached[1] > _time.monotonic():
+                return web.json_response(cached[0])
         db = request.app["ctx"].db
         rows = await db.fetchall(
             "SELECT t.original_name AS name, COUNT(*) AS calls,"
@@ -458,6 +467,10 @@ def setup_routes(app: web.Application) -> None:
                 " MIN(duration_ms) AS min_ms, MAX(duration_ms) AS max_ms"
                 " FROM tool_metrics WHERE entity_type=?"
                 " GROUP BY tool_id ORDER BY calls DESC LIMIT 100", (etype,))
+        if settings.admin_stats_cache_enabled:
+            import time as _time
+            request.app["_stats_cache"]["v"] = (
+                out, _time.monotonic() + settings.admin_stats_cache_ttl_s)
         return web.json_response(out)
 
     # ----------------------------------------------------- admin observability

@@ -23,7 +23,8 @@ def setup_chat_routes(app: web.Application) -> None:
         session = await request.app["chat_service"].connect(
             user=request["auth"].user, model=body.get("model"),
             server_id=body.get("server_id"),
-            max_steps=int(body.get("max_steps", 5)))
+            max_steps=(int(body["max_steps"])
+                       if body.get("max_steps") is not None else None))
         return web.json_response({"session_id": session.id}, status=201)
 
     @routes.post("/llmchat/{session_id}/chat")
@@ -78,7 +79,8 @@ def setup_chat_routes(app: web.Application) -> None:
         team = await request.app["team_service"].create_team(
             name=body.get("name", ""), created_by=auth.user,
             description=body.get("description", ""),
-            visibility=body.get("visibility", "private"))
+            visibility=body.get("visibility", "private"),
+            is_admin=auth.is_admin)
         return web.json_response(team, status=201)
 
     @routes.get("/teams/{team_id}")
@@ -103,7 +105,7 @@ def setup_chat_routes(app: web.Application) -> None:
         body = await request.json()
         await request.app["team_service"].add_member(
             request.match_info["team_id"], auth.user, body.get("email", ""),
-            role=body.get("role", "member"), is_admin=auth.is_admin)
+            role=body.get("role") or None, is_admin=auth.is_admin)
         return web.Response(status=204)
 
     @routes.delete("/teams/{team_id}/members/{email}")
@@ -120,7 +122,7 @@ def setup_chat_routes(app: web.Application) -> None:
         body = await request.json()
         invitation = await request.app["team_service"].invite(
             request.match_info["team_id"], auth.user, body.get("email", ""),
-            role=body.get("role", "member"), is_admin=auth.is_admin)
+            role=body.get("role") or None, is_admin=auth.is_admin)
         return web.json_response(invitation, status=201)
 
     @routes.post("/teams/invitations/accept")

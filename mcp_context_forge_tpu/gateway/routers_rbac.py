@@ -77,6 +77,8 @@ def setup_rbac_routes(app: web.Application) -> None:
         grant = await service.assign_role(
             request.match_info["email"], body.get("role_id", ""),
             scope_id=body.get("scope_id", ""), granted_by=auth.user)
+        request.app["auth_service"].invalidate_user(
+            request.match_info["email"])
         return web.json_response(grant, status=201)
 
     @routes.delete("/rbac/users/{email}/roles/{role_id}")
@@ -85,6 +87,8 @@ def setup_rbac_routes(app: web.Application) -> None:
         await service.revoke_role(
             request.match_info["email"], request.match_info["role_id"],
             scope_id=request.query.get("scope_id", ""))
+        request.app["auth_service"].invalidate_user(
+            request.match_info["email"])
         return web.Response(status=204)
 
     # ----------------------------------------------------------- inspection

@@ -82,6 +82,43 @@ class AuthService:
     def __init__(self, ctx: AppContext):
         self.ctx = ctx
         self._revoked_jtis: set[str] = set()
+        # resolution cache (settings auth_cache_*): bounds the per-request
+        # users/teams/roles reads. TTL caps staleness; the write paths
+        # that must be IMMEDIATE (role grants, membership changes, user
+        # toggles, password ops) call invalidate_user()/invalidate_jti().
+        self._cache: dict[tuple, tuple[Any, float]] = {}
+
+    # ----------------------------------------------------- resolution cache
+
+    def _cache_get(self, key: tuple) -> Any:
+        entry = self._cache.get(key)
+        if entry is None:
+            return None
+        value, expiry = entry
+        import time as _time
+        if expiry <= _time.monotonic():
+            self._cache.pop(key, None)
+            return None
+        return value
+
+    def _cache_put(self, key: tuple, value: Any, ttl: float) -> None:
+        settings = self.ctx.settings
+        if not getattr(settings, "auth_cache_enabled", True) or ttl <= 0:
+            return
+        import time as _time
+        limit = int(getattr(settings, "auth_cache_max_entries", 4096))
+        while len(self._cache) >= max(1, limit):
+            self._cache.pop(next(iter(self._cache)))
+        self._cache[key] = (value, _time.monotonic() + ttl)
+
+    def invalidate_user(self, email: str) -> None:
+        """Drop every cached fact about one identity — called by the
+        paths whose effect must be visible on the NEXT request."""
+        for kind in ("user", "teams", "roles"):
+            self._cache.pop((kind, email), None)
+
+    def invalidate_jti(self, jti: str) -> None:
+        self._cache.pop(("jti", jti), None)
 
     # ------------------------------------------------------------- bootstrap
 
@@ -95,9 +132,11 @@ class AuthService:
         # (no existence early-exit that would freeze a partial seed)
         await self.ctx.db.execute(
             "INSERT OR IGNORE INTO users (email, password_hash, full_name,"
-            " is_admin, created_at, updated_at) VALUES (?,?,?,?,?,?)",
+            " is_admin, password_change_required, created_at, updated_at)"
+            " VALUES (?,?,?,?,?,?,?)",
             (settings.platform_admin_email, _hasher.hash(settings.platform_admin_password),
-             "Platform Admin", 1, ts, ts))
+             "Platform Admin", 1,
+             int(settings.admin_require_password_change_on_bootstrap), ts, ts))
         slug = slugify(settings.platform_admin_email)
         await self.ctx.db.execute(
             "INSERT OR IGNORE INTO teams (id, name, slug, is_personal, created_by,"
@@ -187,6 +226,7 @@ class AuthService:
             (int(required), now(), email))
         if not rows:
             raise NotFoundError(f"User {email} not found")
+        self.invalidate_user(email)
 
     async def change_password(self, email: str, old_password: str,
                               new_password: str) -> None:
@@ -197,6 +237,7 @@ class AuthService:
             "UPDATE users SET password_hash=?, password_change_required=0,"
             " updated_at=? WHERE email=?",
             (_hasher.hash(new_password), now(), email))
+        self.invalidate_user(email)
 
     async def verify_password(self, email: str, password: str) -> bool:
         row = await self.ctx.db.fetchone("SELECT * FROM users WHERE email=? AND is_active=1",
@@ -231,9 +272,15 @@ class AuthService:
             return False
 
     async def user_teams(self, email: str) -> list[str]:
+        cached = self._cache_get(("teams", email))
+        if cached is not None:
+            return list(cached)
         rows = await self.ctx.db.fetchall(
             "SELECT team_id FROM team_members WHERE user_email=?", (email,))
-        return [r["team_id"] for r in rows]
+        teams = [r["team_id"] for r in rows]
+        self._cache_put(("teams", email), tuple(teams),
+                        self.ctx.settings.auth_cache_teams_ttl)
+        return teams
 
     # ---------------------------------------------------------------- tokens
 
@@ -278,6 +325,12 @@ class AuthService:
                 # an unscoped token would inherit the user's full power —
                 # cap it at the grantor's scopes instead
                 permissions = sorted(grantor.permissions)
+        cap = float(getattr(self.ctx.settings,
+                            "api_token_max_lifetime_minutes", 0.0))
+        if cap > 0:
+            # policy ceiling: no token may outlive the configured maximum
+            # (an unset request gets the cap, a longer request is clamped)
+            expires_minutes = min(expires_minutes or cap, cap)
         jti = new_id()
         token = self.issue_jwt(email, expires_minutes=expires_minutes,
                                extra={"jti": jti,
@@ -300,6 +353,7 @@ class AuthService:
         await self.ctx.db.execute("UPDATE api_tokens SET revoked_at=? WHERE id=?",
                                   (now(), token_id))
         self._revoked_jtis.add(row["jti"])
+        self.invalidate_jti(row["jti"])
         await self.ctx.bus.publish("tokens.revoked", {"jti": row["jti"]})
 
     async def list_api_tokens(self, email: str) -> list[dict[str, Any]]:
@@ -325,17 +379,29 @@ class AuthService:
         if jti:
             if jti in self._revoked_jtis:
                 raise AuthError("Token revoked")
-            row = await self.ctx.db.fetchone("SELECT revoked_at FROM api_tokens WHERE jti=?",
-                                             (jti,))
-            if row and row["revoked_at"]:
+            revocation = self._cache_get(("jti", jti))
+            if revocation is None:
+                row = await self.ctx.db.fetchone(
+                    "SELECT revoked_at FROM api_tokens WHERE jti=?", (jti,))
+                revocation = ("miss" if row is None
+                              else ("revoked" if row["revoked_at"] else "ok"))
+                self._cache_put(("jti", jti), revocation,
+                                self.ctx.settings.auth_cache_revocation_ttl)
+            if revocation == "revoked":
                 self._revoked_jtis.add(jti)
                 raise AuthError("Token revoked")
-            if row:
+            if revocation == "ok":
                 await self.ctx.db.execute("UPDATE api_tokens SET last_used=? WHERE jti=?",
                                           (now(), jti))
-        user_row = await self.ctx.db.fetchone(
-            "SELECT is_admin, is_active, password_change_required"
-            " FROM users WHERE email=?", (email,))
+        user_row = self._cache_get(("user", email))
+        if user_row is None:
+            user_row = await self.ctx.db.fetchone(
+                "SELECT is_admin, is_active, password_change_required"
+                " FROM users WHERE email=?", (email,))
+            self._cache_put(("user", email), user_row or {},
+                            self.ctx.settings.auth_cache_user_ttl)
+        elif user_row == {}:
+            user_row = None
         if user_row and not user_row["is_active"]:
             raise AuthError("User deactivated")
         is_admin = bool(user_row and user_row["is_admin"])
@@ -369,8 +435,22 @@ class AuthService:
         user_ok = hmac.compare_digest(username.encode(), settings.basic_auth_user.encode())
         pass_ok = hmac.compare_digest(password.encode(), settings.basic_auth_password.encode())
         if user_ok and pass_ok:
+            # the env-credential superuser still maps onto the platform
+            # admin IDENTITY: its forced-rotation flag applies here too
+            # (admin_require_password_change_on_bootstrap would otherwise
+            # be a no-op for the very account it exists to rotate)
+            row = self._cache_get(("user", settings.platform_admin_email))
+            if row is None:
+                row = await self.ctx.db.fetchone(
+                    "SELECT is_admin, is_active, password_change_required"
+                    " FROM users WHERE email=?",
+                    (settings.platform_admin_email,)) or {}
+                self._cache_put(("user", settings.platform_admin_email),
+                                row, settings.auth_cache_user_ttl)
             return AuthContext(user=settings.platform_admin_email, is_admin=True,
-                               permissions=set(PERMISSIONS), via="basic")
+                               permissions=set(PERMISSIONS), via="basic",
+                               password_change_required=bool(
+                                   row.get("password_change_required")))
         if await self.verify_password(username, password):
             row = await self.ctx.db.fetchone(
                 "SELECT is_admin, password_change_required FROM users"
@@ -389,10 +469,18 @@ class AuthService:
     async def _role_permissions(self, email: str,
                                 teams: list[str]) -> set[str]:
         """Permissions granted through role assignments (role_service.py —
-        the roles/user_roles tables); resolved per request so an
-        assignment change takes effect on the next call."""
+        the roles/user_roles tables). Cached per (email, teams) with
+        auth_cache_role_ttl; grant/revoke paths invalidate, so an
+        assignment change still takes effect on the next call."""
+        key = ("roles", email)
+        cached = self._cache_get(key)
+        if cached is not None and cached[0] == tuple(teams):
+            return set(cached[1])
         from .role_service import RoleService
-        return await RoleService(self.ctx).role_permissions(email, teams)
+        perms = await RoleService(self.ctx).role_permissions(email, teams)
+        self._cache_put(key, (tuple(teams), frozenset(perms)),
+                        self.ctx.settings.auth_cache_role_ttl)
+        return perms
 
     async def effective_permissions(self, email: str
                                     ) -> tuple[set[str], bool, bool]:

@@ -70,7 +70,11 @@ class ChatService:
                            ttl=self.session_ttl)
 
     async def connect(self, user: str, model: str | None = None,
-                      server_id: str | None = None, max_steps: int = 5) -> ChatSession:
+                      server_id: str | None = None,
+                      max_steps: int | None = None) -> ChatSession:
+        if max_steps is None:  # explicit request wins over the setting
+            max_steps = getattr(getattr(self.ctx, "settings", None),
+                                "llmchat_max_steps", 5) or 5
         session = ChatSession(id=new_id(), user=user, model=model,
                               server_id=server_id, max_steps=max_steps)
         await self._save(session)

@@ -182,19 +182,41 @@ class SSOService:
         email = info.get("email")
         if not email:
             raise ValidationFailure("IdP id_token is missing an email claim")
+        settings = self.ctx.settings
+        domain = email.rsplit("@", 1)[-1].lower()
+        trusted = settings.sso_trusted_domains
+        if trusted and domain not in trusted:
+            # provisioning policy: only allowlisted email domains may
+            # enter through SSO (reference sso trusted-domain gating)
+            raise ValidationFailure(
+                f"SSO domain {domain!r} is not in sso_trusted_domains")
         metadata = provider.get("metadata", {})
         admin_groups = set(metadata.get("admin_groups") or [])
         is_admin = 1 if admin_groups & set(info["groups"]) else 0
+        if domain in settings.sso_auto_admin_domains:
+            is_admin = 1
         # provision on first login (reference sso_service auto-provisioning)
-        row = await self.ctx.db.fetchone("SELECT email FROM users WHERE email=?",
-                                         (email,))
+        row = await self.ctx.db.fetchone(
+            "SELECT email, is_active FROM users WHERE email=?", (email,))
         ts = now()
         if not row:
             await self.ctx.db.execute(
                 "INSERT INTO users (email, password_hash, full_name, is_admin,"
-                " auth_provider, created_at, updated_at) VALUES (?,?,?,?,?,?,?)",
+                " auth_provider, is_active, created_at, updated_at)"
+                " VALUES (?,?,?,?,?,?,?,?)",
                 (email, "!sso!", info.get("name", ""), is_admin,
-                 provider_name, ts, ts))
+                 provider_name,
+                 0 if settings.sso_require_admin_approval else 1, ts, ts))
+            if settings.sso_require_admin_approval:
+                raise ValidationFailure(
+                    "Account provisioned; awaiting administrator approval "
+                    "(sso_require_admin_approval)")
+        elif not row["is_active"]:
+            # EVERY later login of a deactivated/pending account must stop
+            # here — not mint a token that only fails downstream, and not
+            # run team-mapping/admin-refresh writes for it
+            raise ValidationFailure(
+                "Account is deactivated or awaiting administrator approval")
         elif is_admin:
             # group-derived privilege refreshes on every login (groups may
             # have been granted since provisioning); it is never revoked

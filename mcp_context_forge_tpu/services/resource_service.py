@@ -44,6 +44,12 @@ class ResourceService:
         if cap and size and size > cap:
             raise ValidationFailure(
                 f"Resource content is {size} bytes (max_resource_size {cap})")
+        allowed_mimes = self.ctx.settings.allowed_resource_mime_types
+        if allowed_mimes and res.mime_type \
+                and res.mime_type not in allowed_mimes:
+            raise ValidationFailure(
+                f"mime_type {res.mime_type!r} not in "
+                "allowed_resource_mime_types")
         await self.ctx.db.execute(
             "INSERT INTO resources (id, uri, name, description, mime_type, uri_template,"
             " content, is_binary, size, gateway_id, enabled, tags, team_id, owner_email,"

@@ -18,9 +18,24 @@ class TeamService:
     def __init__(self, ctx: AppContext):
         self.ctx = ctx
 
+    def _invalidate_auth(self, email: str) -> None:
+        """Membership changes must hit the NEXT request: bust the auth
+        resolution cache (hook installed by the app factory)."""
+        hook = self.ctx.extras.get("auth_invalidate")
+        if hook is not None:
+            hook(email)
+
     async def create_team(self, name: str, created_by: str,
                           description: str = "",
-                          visibility: str = "private") -> dict[str, Any]:
+                          visibility: str = "private",
+                          is_admin: bool = False) -> dict[str, Any]:
+        settings = self.ctx.settings
+        if not settings.allow_team_creation and not is_admin:
+            raise ValidationFailure(
+                "Team creation is disabled (allow_team_creation)")
+        if visibility == "public" and not settings.allow_public_visibility:
+            raise ValidationFailure(
+                "Public teams are disabled (allow_public_visibility)")
         slug = slugify(name)
         existing = await self.ctx.db.fetchone("SELECT id FROM teams WHERE slug=?",
                                               (slug,))
@@ -89,9 +104,11 @@ class TeamService:
         return bool(row and row["role"] == "owner")
 
     async def add_member(self, team_id: str, actor: str, email: str,
-                         role: str = "member", is_admin: bool = False) -> None:
+                         role: str | None = None,
+                         is_admin: bool = False) -> None:
         if not is_admin and not await self._is_owner(team_id, actor):
             raise ValidationFailure("Only team owners can add members")
+        role = role or self.ctx.settings.default_team_member_role
         user = await self.ctx.db.fetchone("SELECT email FROM users WHERE email=?",
                                           (email,))
         if not user:
@@ -100,6 +117,7 @@ class TeamService:
         await self.ctx.db.execute(
             "INSERT OR REPLACE INTO team_members (team_id, user_email, role,"
             " joined_at) VALUES (?,?,?,?)", (team_id, email, role, now()))
+        self._invalidate_auth(email)
 
     async def _check_member_cap(self, team_id: str, email: str) -> None:
         """Cap only NEW memberships: re-adding an existing member is a
@@ -126,12 +144,21 @@ class TeamService:
         await self.ctx.db.execute(
             "DELETE FROM team_members WHERE team_id=? AND user_email=?",
             (team_id, email))
+        self._invalidate_auth(email)
 
     # ------------------------------------------------------------ invitations
 
     async def invite(self, team_id: str, actor: str, email: str,
-                     role: str = "member", expires_hours: float = 72.0,
+                     role: str | None = None,
+                     expires_hours: float | None = None,
                      is_admin: bool = False) -> dict[str, Any]:
+        settings = self.ctx.settings
+        if not settings.allow_team_invitations:
+            raise ValidationFailure(
+                "Team invitations are disabled (allow_team_invitations)")
+        role = role or settings.default_team_member_role
+        if expires_hours is None:
+            expires_hours = settings.invitation_expiry_hours
         if not is_admin and not await self._is_owner(team_id, actor):
             raise ValidationFailure("Only team owners can invite")
         await self.get_team(team_id)
@@ -164,4 +191,5 @@ class TeamService:
         await self.ctx.db.execute(
             "UPDATE team_invitations SET accepted_at=? WHERE id=?",
             (now(), row["id"]))
+        self._invalidate_auth(user)
         return await self.get_team(row["team_id"])
