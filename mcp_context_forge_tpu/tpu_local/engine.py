@@ -315,14 +315,14 @@ class TPUEngine:
             raise ValueError(f"spec_ngram must be >= 1, got {config.spec_ngram}")
         self.config = config
         if config.batch_buckets and not config.warmup:
-            # shrink targets are warmup-compiled widths only; without a
-            # warmup the engine serves correctly but stays at full width
-            # (the waste bucketing exists to remove) — say so loudly
-            logger.warning(
-                "batch_buckets=true without warmup: decode width will pin "
-                "at max_batch until warmup() runs (shrinking never "
-                "compiles on the serving path) — set "
-                "MCPFORGE_TPU_LOCAL_WARMUP=true for production serving")
+            # unwarmed engines shrink only to widths already compiled
+            # in-process (shrinking never compiles on the serving path);
+            # warmup compiles the whole grid up front and starts at max
+            logger.info(
+                "batch_buckets=true without warmup: width starts small "
+                "and shrink targets are limited to in-process-compiled "
+                "widths — set MCPFORGE_TPU_LOCAL_WARMUP=true for "
+                "production serving")
         if config.compile_cache_dir:
             _apply_compile_cache(config.compile_cache_dir)
         self.model_config: LlamaConfig = MODEL_CONFIGS[config.model]
@@ -346,11 +346,14 @@ class TPUEngine:
         self._stop_event = threading.Event()
         self._loop: asyncio.AbstractEventLoop | None = None
         self._started = False
-        # decode batch-width hysteresis state (see _decode_step_all):
-        # start at FULL width — bucketing must never be slower than fixed
-        # width on a fresh engine; the first idle->burst transition costs
-        # zero re-homes, and sustained light load earns the shrink
-        self._batch_width = config.max_batch
+        # decode batch-width hysteresis state (see _decode_step_all).
+        # UNWARMED engines start small (light load is free immediately; a
+        # burst pays ONE grow re-home) and may shrink back to any width
+        # compiled earlier in-process. warmup() flips the posture: width
+        # starts at max (a warmed engine must never be slower than fixed
+        # width — the round-5 config-4 A/B) and shrink targets are the
+        # whole warmed grid.
+        self._batch_width = min(8, config.max_batch)
         self._shrink_streak = 0
         self._shrink_peak = 0
         # widths whose full ctx-bucket decode grid warmup precompiled:
@@ -359,6 +362,7 @@ class TPUEngine:
         # only warmed widths are shrink targets. Growth is correctness
         # (arrays must cover the ceiling) and may compile.
         self._warmed_widths: set[int] = set()
+        self._batch_width = self._batch_buckets()[0]
 
         dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
         devices = probe_devices(config.init_timeout_s)
@@ -674,6 +678,14 @@ class TPUEngine:
                     block.block_until_ready()
                     shapes += 1
                 self._warmed_widths.add(batch)
+            if self.config.batch_buckets:
+                # warmed posture: start at max (never slower than fixed
+                # width; the first burst costs zero transitions) — the
+                # warmed grid makes every later shrink compile-free. Any
+                # pre-warmup shrink evidence is stale at the new width.
+                self._batch_width = self.config.max_batch
+                self._shrink_streak = 0
+                self._shrink_peak = 0
         logger.info("tpu_local warmup: %d shapes compiled in %.1fs",
                     shapes, time.monotonic() - started)
 
@@ -1378,48 +1390,64 @@ class TPUEngine:
             # must cover the active ceiling); shrink only after the smaller
             # width has sufficed for a sustained streak (load genuinely
             # dropped, not an inter-wave dip).
+            # the width target is the ACTIVE ceiling plus the queued load
+            # that could actually admit (anticipatory growth, round-4):
+            # one transiently queued request at 8-active/64-slot light
+            # load targets 16, not 64 — jumping to max on any queued item
+            # cost config-3 a 4.5x regression in the round-5 gateway
+            # bench. At genuine full load the target IS max_batch, so
+            # this matches the fixed-width engine there.
             incoming = self._work.qsize() + len(self._pending)
+            free_slots = (config.max_batch - len(self._running)
+                          - len(self._chunking))
             page_capacity = (self.allocator.free_pages
                              // self.allocator.avg_slot_pages())
-            if incoming > 0 and page_capacity > 0:
-                # PIN at max width while the queue is non-empty (round-4
-                # A/B: buckets lost ~15% to fixed width at FULL load):
-                # with work queued, freed slots refill at the next
-                # admission, so sizing below capacity only schedules a
-                # re-home — and the per-step compaction scan buys
-                # nothing, because holes refill immediately. Exception:
-                # a PAGE-BOUND backlog (page_capacity == 0 — queued work
-                # that cannot admit) must not pin, or the backlog would
-                # run full-width decode over a handful of active slots
-                # for its whole duration.
-                self._batch_width = config.max_batch
+            admissible = max(0, min(incoming, free_slots, page_capacity))
+            if admissible == 0:
+                # compaction pays exactly when holes will NOT refill at
+                # the next admission: an empty queue, OR a page-bound
+                # backlog (queued work that cannot admit) — without it a
+                # lone high-index slot would hold the ceiling at max for
+                # the backlog's whole duration
+                self._compact_slots()
+            ceiling = min(max(max(self._running) + 1,
+                              len(self._running) + admissible),
+                          config.max_batch)
+            desired = self._batch_bucket_for(ceiling)
+            if desired >= self._batch_width:
+                # grow immediately (arrays must cover the ceiling)
+                self._batch_width = desired
                 self._shrink_streak = 0
                 self._shrink_peak = 0
             else:
-                self._compact_slots()
-                desired = self._batch_bucket_for(
-                    min(max(self._running) + 1, config.max_batch))
-                if desired >= self._batch_width:
-                    self._batch_width = desired
+                self._shrink_streak += 1
+                # shrink to the PEAK desired width seen over the streak,
+                # not the instantaneous one — a momentary dip must not
+                # trigger an over-shrink followed by an immediate re-grow
+                # (each width change re-homes the donated KV pool)
+                self._shrink_peak = max(self._shrink_peak, desired)
+                if self._shrink_streak >= config.batch_shrink_steps:
+                    # never EAT a compile to get smaller (round-4
+                    # config-4 tail: the drain-phase shrink compiled a
+                    # fresh executable inside the serving path) — shrink
+                    # only to warmup-compiled widths or widths this
+                    # process already compiled (an unwarmed engine that
+                    # grew for a burst may return to its earlier width:
+                    # the executables exist)
+                    target = self._shrink_peak
+                    # "already compiled" means the (width, ctx) PAIR the
+                    # next dispatch would use — a width whose executables
+                    # exist only for shorter contexts would still compile
+                    # mid-traffic
+                    ctx_now = self._ctx_bucket_for(max(
+                        (len(r.prompt_ids) + len(r.generated)
+                         for r in self._running.values()), default=1)
+                        + config.decode_block)
+                    if (target in self._warmed_widths
+                            or (target, ctx_now) in self._decode_fns):
+                        self._batch_width = target
                     self._shrink_streak = 0
                     self._shrink_peak = 0
-                else:
-                    self._shrink_streak += 1
-                    # shrink to the PEAK desired width seen over the
-                    # streak, not the instantaneous one — a momentary dip
-                    # must not trigger an over-shrink followed by an
-                    # immediate re-grow (each width change re-homes the
-                    # donated KV pool)
-                    self._shrink_peak = max(self._shrink_peak, desired)
-                    if self._shrink_streak >= config.batch_shrink_steps:
-                        # never EAT a compile to get smaller (round-4
-                        # config-4 tail: the drain-phase shrink compiled
-                        # a fresh executable inside the serving path) —
-                        # only warmup-compiled widths are shrink targets
-                        if self._shrink_peak in self._warmed_widths:
-                            self._batch_width = self._shrink_peak
-                        self._shrink_streak = 0
-                        self._shrink_peak = 0
             B = self._batch_width
         else:
             B = config.max_batch

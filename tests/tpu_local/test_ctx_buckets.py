@@ -238,39 +238,32 @@ def test_oversized_prompt_behind_blocked_chunker_rejects_cleanly():
 # a trained, repetitive model exercises the accepted-draft path)
 
 
-def test_width_pins_at_max_while_queue_nonempty():
-    """Round-4 A/B follow-up: with work queued (and pages available) the
-    decode width pins to max_batch — freed slots refill next admission,
-    so a sub-capacity width would only schedule a pool re-home. With an
-    empty queue the hysteresis path still sizes by the active ceiling."""
-    engine = _engine(max_batch=16, batch_buckets=True)
+def test_width_grows_to_cover_queued_admissible_load():
+    """Anticipatory growth: the width targets active + ADMISSIBLE queued
+    load — a big backlog grows to max in one hop, while ONE transiently
+    queued request at light load must NOT jump the width to max (that
+    re-pin cost config-3 a 4.5x regression in the round-5 bench)."""
+    engine = _engine(max_batch=16, batch_buckets=True, num_pages=256)
     ids = engine.tokenizer.encode("hello")
     from mcp_context_forge_tpu.tpu_local.engine import GenRequest
 
-    # active slots + queue NON-empty -> pinned back to max even from a
-    # previously shrunken width (the init default is max; force 8 here)
-    engine._pending.append(GenRequest(request_id="q", prompt_ids=ids,
+    # one active + ONE queued: stays at the small bucket
+    engine._pending.append(GenRequest(request_id="a", prompt_ids=ids,
                                       max_tokens=4))
     engine._admit_batch()
-    engine._pending.append(GenRequest(request_id="q2", prompt_ids=ids,
+    engine._pending.append(GenRequest(request_id="t", prompt_ids=ids,
                                       max_tokens=4))
-    engine._batch_width = 8
+    engine._decode_step_all()
+    assert engine._batch_width == 8
+
+    # a real backlog: ceiling = active + admissible reaches max -> one hop
+    for i in range(20):
+        engine._pending.append(GenRequest(request_id=f"b{i}",
+                                          prompt_ids=ids, max_tokens=4))
+    engine._admit_batch()
     engine._decode_step_all()
     assert engine._batch_width == 16
 
-    # queue empty + smaller width warmed -> hysteresis shrinks to the
-    # active ceiling's bucket (8 for <=8 active) after the streak
-    engine._warmed_widths.add(8)
-    engine._shrink_streak = 0
-    steps = 0
-    while steps < engine.config.batch_shrink_steps + 4:
-        if not engine._running:
-            engine._pending.append(GenRequest(
-                request_id=f"lite{steps}", prompt_ids=ids, max_tokens=4))
-            engine._admit_batch()
-        engine._decode_step_all()
-        steps += 1
-    assert engine._batch_width == 8
 
 
 def test_page_bound_backlog_does_not_pin():
@@ -299,34 +292,40 @@ def test_page_bound_backlog_does_not_pin():
     assert engine._batch_width < engine.config.max_batch
 
 
-def test_shrink_requires_warmed_width_and_sustained_streak():
-    """Shrinking is an optimization: it must never compile a fresh
-    executable mid-traffic (only warmup-compiled widths are targets) and
-    only engages after batch_shrink_steps consecutive under-width steps."""
+def test_shrink_requires_compiled_width_and_sustained_streak():
+    """Shrinking never compiles on the serving path: targets must be
+    warmup-compiled OR already compiled in-process (an unwarmed engine
+    that grew for a burst returns to its earlier width), and only after
+    batch_shrink_steps consecutive under-width steps."""
     engine = _engine(max_batch=16, batch_buckets=True)
     ids = engine.tokenizer.encode("hello")
     from mcp_context_forge_tpu.tpu_local.engine import GenRequest
 
-    engine._pending.append(GenRequest(request_id="solo", prompt_ids=ids,
-                                      max_tokens=4))
-    engine._admit_batch()
-    # width starts at max; with NO warmed widths a long light-load streak
-    # must not shrink (that would compile (8, ctx) on the serving path)
-    for _ in range(engine.config.batch_shrink_steps + 2):
-        if not engine._running:
-            engine._pending.append(GenRequest(
-                request_id=f"s{_}", prompt_ids=ids, max_tokens=4))
-            engine._admit_batch()
-        engine._decode_step_all()
-    assert engine._batch_width == 16
+    assert engine._batch_width == 8  # unwarmed engines start small
 
-    # with the smaller width warmed, the same streak shrinks
-    engine._warmed_widths.add(8)
+    def light_steps(n, prefix):
+        for i in range(n):
+            if not engine._running:
+                engine._pending.append(GenRequest(
+                    request_id=f"{prefix}{i}", prompt_ids=ids, max_tokens=4))
+                engine._admit_batch()
+            engine._decode_step_all()
+
+    # light phase compiles the (8, ctx) executables
+    light_steps(4, "warm")
+    # burst: ceiling = active + admissible reaches max width
+    for i in range(20):
+        engine._pending.append(GenRequest(request_id=f"b{i}",
+                                          prompt_ids=ids, max_tokens=4))
+    engine._admit_batch()
+    engine._decode_step_all()
+    assert engine._batch_width == 16
+    while engine._running or engine._pending:
+        engine._admit_batch()
+        if engine._running:
+            engine._decode_step_all()
+    # drain done; sustained light load shrinks BACK to the in-process-
+    # compiled width 8 (no warmup ran) after the streak
     engine._shrink_streak = 0
-    for _ in range(engine.config.batch_shrink_steps + 2):
-        if not engine._running:
-            engine._pending.append(GenRequest(
-                request_id=f"t{_}", prompt_ids=ids, max_tokens=4))
-            engine._admit_batch()
-        engine._decode_step_all()
+    light_steps(engine.config.batch_shrink_steps + 4, "lite")
     assert engine._batch_width == 8
