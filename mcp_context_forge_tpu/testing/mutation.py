@@ -208,16 +208,19 @@ def main(argv: list[str]) -> int:
     targets = oracles.TARGETS if not argv else {k: oracles.TARGETS[k] for k in argv}
     worst = 1.0
     for name, target in targets.items():
+        source = (oracles._PKG_ROOT / target.rel_path).read_text()
         report = target.run()
-        # allowlisted equivalent mutants don't count against the gate
+        # allowlisted equivalent mutants (line- or marker-anchored) don't
+        # count against the gate — same rule as the pytest tier
         real = [s for s in report.survivors
-                if s.lineno not in target.equivalent_lines]
+                if not target.is_equivalent(s.lineno, source)]
         rate = 1.0 if not report.total else (report.total - len(real)) / report.total
         worst = min(worst, rate)
         print(f"{name}: {report.total - len(real)}/{report.total} killed "
               f"({rate:.1%}), {report.invalid} invalid")
         for s in report.survivors:
-            mark = " (allowlisted)" if s.lineno in target.equivalent_lines else ""
+            mark = (" (allowlisted)"
+                    if target.is_equivalent(s.lineno, source) else "")
             print(f"  survivor L{s.lineno}: {s.description}{mark}")
     return 0 if worst >= 0.85 else 1
 

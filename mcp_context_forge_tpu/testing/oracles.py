@@ -40,6 +40,21 @@ class MutationTarget:
     oracle: Callable[[types.ModuleType], None]
     class_name: str | None = None  # restrict campaign to this class
     equivalent_lines: frozenset[int] = field(default_factory=frozenset)
+    # CONTENT-anchored equivalence exemptions: a surviving mutant whose
+    # ORIGINAL source line contains one of these substrings is accepted
+    # as behaviorally equivalent. Use these instead of equivalent_lines —
+    # absolute line numbers silently stop exempting (or exempt the WRONG
+    # line) whenever unrelated edits shift the file.
+    equivalent_markers: tuple[str, ...] = ()
+
+    def is_equivalent(self, lineno: int, source: str) -> bool:
+        if lineno in self.equivalent_lines:
+            return True
+        lines = source.splitlines()
+        if not (1 <= lineno <= len(lines)):
+            return False
+        line = lines[lineno - 1]
+        return any(marker in line for marker in self.equivalent_markers)
 
     def run(self) -> CampaignReport:
         source = (_PKG_ROOT / self.rel_path).read_text()
@@ -816,11 +831,12 @@ TARGETS: dict[str, MutationTarget] = {
         package="mcp_context_forge_tpu.gateway",
         oracle=rate_limiter_oracle,
         class_name="RateLimiter",
-        # 190: the max_buckets DEFAULT-value line — nudging the 100_000
-        # cap by one is behaviorally equivalent (oracle passes explicit
-        # caps). 207: the sweep-trigger compare `now >= _next_sweep` vs
-        # `>` differs only at exact monotonic-clock equality (measure
-        # zero — the sweep just fires one tick later).
-        equivalent_lines=frozenset({190, 207}),
+        # the max_buckets DEFAULT — nudging the 100_000 cap by one is
+        # behaviorally equivalent (oracle passes explicit caps); and the
+        # sweep-trigger compare `now >= _next_sweep` vs `>` differs only
+        # at exact monotonic-clock equality (measure zero — the sweep
+        # fires one tick later)
+        equivalent_markers=("max_buckets: int = 100_000",
+                            "now >= self._next_sweep"),
     ),
 }

@@ -352,8 +352,8 @@ class TPUEngine:
         # compiled earlier in-process. warmup() flips the posture: width
         # starts at max (a warmed engine must never be slower than fixed
         # width — the round-5 config-4 A/B) and shrink targets are the
-        # whole warmed grid.
-        self._batch_width = min(8, config.max_batch)
+        # whole warmed grid. (_batch_width itself is set to the smallest
+        # bucket just below, once _warmed_widths exists.)
         self._shrink_streak = 0
         self._shrink_peak = 0
         # widths whose full ctx-bucket decode grid warmup precompiled:
@@ -362,7 +362,7 @@ class TPUEngine:
         # only warmed widths are shrink targets. Growth is correctness
         # (arrays must cover the ceiling) and may compile.
         self._warmed_widths: set[int] = set()
-        self._batch_width = self._batch_buckets()[0]
+        self._batch_width = self._batch_buckets()[0]  # smallest bucket
 
         dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
         devices = probe_devices(config.init_timeout_s)
@@ -1411,7 +1411,8 @@ class TPUEngine:
                 # the backlog's whole duration
                 self._compact_slots()
             ceiling = min(max(max(self._running) + 1,
-                              len(self._running) + admissible),
+                              len(self._running) + len(self._chunking)
+                              + admissible),
                           config.max_batch)
             desired = self._batch_bucket_for(ceiling)
             if desired >= self._batch_width:
