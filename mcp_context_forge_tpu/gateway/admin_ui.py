@@ -137,6 +137,7 @@ const TABS = {
   teams:    {url: "/teams", cols: ["name","slug","visibility","is_personal","created_by"], boolcols: ["is_personal"],
              create: {url:"/teams", fields:["name","visibility"]},
              del: id => `/teams/${id}`, detail: id => `/teams/${id}`, special: "teams"},
+  config:   {url: "/admin/config", cols: ["name","value"]},
   compliance: {url: "/compliance/reports", cols: ["framework","generated_at","generated_by","summary"],
              create: {url:"/compliance/reports", fields:["framework","period_days:int"]},
              detail: id => `/compliance/reports/${id}`,

@@ -187,6 +187,30 @@ def setup_routes(app: web.Application) -> None:
             require_password_change=bool(body.get("require_password_change")))
         return web.json_response({"email": body.get("email")}, status=201)
 
+    @routes.get("/admin/config")
+    async def effective_config(request: web.Request) -> web.Response:
+        """The EFFECTIVE settings the worker is running with, secrets
+        redacted (reference admin exposes its configuration view the
+        same way) — the operator's 'what is this gateway actually
+        configured to do' answer without shell access."""
+        request["auth"].require("admin.all")
+        import re as _re
+        settings = request.app["ctx"].settings
+        # compound fields that EMBED credentials without a telltale name
+        opaque = {"sso_providers", "otel_otlp_headers"}
+        out = []
+        for name in sorted(type(settings).model_fields):
+            value = getattr(settings, name)
+            if any(fragment in name
+                   for fragment in ("secret", "password", "api_key")) \
+                    or name in opaque:
+                value = "***redacted***" if value else ""
+            elif name == "database_url" and isinstance(value, str):
+                # keep host/db, scrub DSN userinfo (postgresql://u:p@...)
+                value = _re.sub(r"://[^@/]+@", "://***@", value)
+            out.append({"name": name, "value": value})
+        return web.json_response(out)
+
     @routes.post("/admin/users/{email}/require-password-change")
     async def require_password_change(request: web.Request) -> web.Response:
         """Flag a user for mandatory rotation (reference

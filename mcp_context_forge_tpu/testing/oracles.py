@@ -47,17 +47,23 @@ class MutationTarget:
     # line) whenever unrelated edits shift the file.
     equivalent_markers: tuple[str, ...] = ()
 
-    def is_equivalent(self, lineno: int, source: str) -> bool:
+    def source(self) -> str:
+        """THE source the campaign mutates — every equivalence check
+        must read the same bytes (one derivation, three call sites)."""
+        return (_PKG_ROOT / self.rel_path).read_text()
+
+    def is_equivalent(self, lineno: int, source: str | None = None) -> bool:
         if lineno in self.equivalent_lines:
             return True
-        lines = source.splitlines()
+        lines = (source if source is not None
+                 else self.source()).splitlines()
         if not (1 <= lineno <= len(lines)):
             return False
         line = lines[lineno - 1]
         return any(marker in line for marker in self.equivalent_markers)
 
     def run(self) -> CampaignReport:
-        source = (_PKG_ROOT / self.rel_path).read_text()
+        source = self.source()
         line_range = (_class_line_range(source, self.class_name)
                       if self.class_name else None)
         return run_campaign(self.module_name, source, self.package, self.oracle,
@@ -792,13 +798,15 @@ TARGETS: dict[str, MutationTarget] = {
         oracle=lambda mod: (page_allocator_oracle(mod),
                             _avg_slot_pages_spec(mod)),
         class_name="PageAllocator",
-        # 192: _take_page's `key is not None and _cached.get(key) == page`
-        # — register_prefix maintains _page_key[page] == key iff
-        # _cached[key] == page, so the second conjunct is purely defensive
-        # and And->Or is equivalent under the invariant. 199: the
-        # defensive ref-default in _release_page (allocate/extend/match
-        # always set a ref first, so the default is unreachable).
-        equivalent_lines=frozenset({192, 199}),
+        # _take_page's `key is not None and _cached.get(key) == page` —
+        # register_prefix maintains _page_key[page] == key iff
+        # _cached[key] == page, so the second conjunct is purely
+        # defensive and And->Or is equivalent under the invariant; and
+        # the defensive ref-default in _release_page (allocate/extend/
+        # match always set a ref first, so the default is unreachable).
+        equivalent_markers=(
+            "key is not None and self._cached.get(key) == page",
+            "current = self._ref.get(page, 1)"),
     ),
     "eventstream": MutationTarget(
         rel_path="utils/eventstream.py",
@@ -806,24 +814,35 @@ TARGETS: dict[str, MutationTarget] = {
         package="mcp_context_forge_tpu.utils",
         oracle=eventstream_oracle,
         # Contract-equivalent mutants (the oracle's contract is "raises
-        # EventStreamError"; which check fires is unobservable):
-        # 69 short-frame raise (downstream CRC/length checks also raise);
-        # 70/71 prelude-offset shifts (observable only in frames with a
-        # >16 MB segment — leading length bytes are 0 below 2^24);
-        # 111-114 iter_frames fail-fast guard (its removal/loosening
+        # EventStreamError"; which check fires is unobservable): the
+        # decode_frame short-frame guard (downstream CRC/length checks
+        # also raise); prelude-offset shifts (observable only in frames
+        # with a >16 MB segment — leading length bytes are 0 below
+        # 2^24); the iter_frames fail-fast guard (its removal/loosening
         # still ends in decode_frame or trailing-bytes raising; the
         # 16 MB cap value itself is an arbitrary tunable).
-        equivalent_lines=frozenset({69, 70, 71, 72, 111, 112,
-                                    113, 114}),
+        equivalent_markers=(
+            "if len(frame) < _PRELUDE_LEN + _CRC_LEN",
+            'raise EventStreamError("frame shorter than prelude")',
+            "total = int.from_bytes(frame[0:4]",
+            "headers_len = int.from_bytes(frame[4:8]",
+            "total = int.from_bytes(buf[0:4]",
+            "if total < _PRELUDE_LEN + _CRC_LEN or total > 16",
+            'raise EventStreamError(f"implausible frame length',
+            "if len(buf) < total",
+            # the buffering loop condition `len(buf) >= _PRELUDE_LEN` vs
+            # `>`: at exactly prelude-many bytes the loop just waits for
+            # the next chunk — frame decoding is unchanged
+            "while len(buf) >= _PRELUDE_LEN"),
     ),
     "tool_calls": MutationTarget(
         rel_path="tpu_local/tool_calls.py",
         module_name="mcp_context_forge_tpu.tpu_local.tool_calls",
         package="mcp_context_forge_tpu.tpu_local",
         oracle=tool_calls_oracle,
-        # 85: `0 <= start < end` Lt->LtE — find(open) and rfind(close)
-        # are different characters, so start == end is unsatisfiable.
-        equivalent_lines=frozenset({85}),
+        # `0 <= start < end` Lt->LtE — find(open) and rfind(close) are
+        # different characters, so start == end is unsatisfiable.
+        equivalent_markers=("if 0 <= start < end:",),
     ),
     "rate_limiter": MutationTarget(
         rel_path="gateway/middleware.py",

@@ -123,3 +123,34 @@ def test_teams_pane_never_interpolates_server_data_into_js_strings():
     assert "removeMember('${esc(" not in page
     assert "addMember('${esc(" not in page
     assert "inviteMember('${esc(" not in page
+
+
+async def test_admin_config_view_redacts_secrets():
+    """/admin/config: every settings field visible, secrets redacted —
+    the admin UI's 'what is this gateway running with' tab."""
+    from mcp_context_forge_tpu.config import Settings
+
+    import aiohttp
+    from test_gateway_app import BASIC as _BASIC
+    client = await make_client()
+    try:
+        resp = await client.get("/admin/config",
+                                auth=aiohttp.BasicAuth(*_BASIC))
+        assert resp.status == 200
+        rows = {r["name"]: r["value"] for r in await resp.json()}
+        assert set(rows) == set(Settings.model_fields)
+        assert rows["jwt_secret_key"] == "***redacted***"
+        assert rows["platform_admin_password"] == "***redacted***"
+        assert rows["basic_auth_password"] == "***redacted***"
+        settings = client.app["ctx"].settings
+        assert rows["port"] == settings.port  # non-secret values pass through
+        # non-admins denied
+        await client.post("/admin/users", json={
+            "email": "cfg@x.com", "password": "Cfg!Strong2024x"},
+            auth=aiohttp.BasicAuth(*_BASIC))
+        resp = await client.get("/admin/config",
+                                auth=aiohttp.BasicAuth("cfg@x.com",
+                                                       "Cfg!Strong2024x"))
+        assert resp.status == 403
+    finally:
+        await client.close()
