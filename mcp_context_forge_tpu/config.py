@@ -172,6 +172,25 @@ class Settings(BaseModel):
     # --- admin stats cache (reference admin_stats_cache_*) ---
     admin_stats_cache_enabled: bool = False
     admin_stats_cache_ttl_s: float = 5.0
+    # --- performance tracking (reference performance_tracker.py +
+    # performance_threshold_* family; thresholds in ms) ---
+    performance_tracking_enabled: bool = True
+    performance_max_samples: int = 512
+    performance_threshold_database_query_ms: float = 100.0
+    performance_threshold_http_request_ms: float = 1000.0
+    performance_threshold_tool_invocation_ms: float = 5000.0
+    performance_threshold_resource_read_ms: float = 500.0
+    performance_degradation_multiplier: float = 2.0
+    # --- support bundle (reference support_bundle_service.py) ---
+    support_bundle_enabled: bool = True
+    support_bundle_log_tail: int = 1000
+    # --- hot/cold gateway classification (reference
+    # server_classification_service.py + hot_cold_classification_enabled;
+    # gated health polling for large federations) ---
+    hot_cold_classification_enabled: bool = False
+    hot_cold_hot_cap: int = 50
+    hot_cold_hot_window_s: float = 3600.0
+    hot_cold_cold_poll_multiplier: int = 5
     # --- chat agent ---
     llmchat_max_steps: int = 6
     # --- CORS detail (reference cors long tail) ---

@@ -83,6 +83,9 @@ class Database:
         self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="db")
         self._conn: sqlite3.Connection | None = None
         self._lock = threading.Lock()
+        # optional per-query timing sink: Callable[[float], None], ms.
+        # Set by the app to feed the PerformanceTracker "db.query" series.
+        self.on_query = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -168,14 +171,25 @@ class Database:
 
     async def execute(self, sql: str, params: Sequence[Any] = ()) -> list[dict[str, Any]]:
         log = _query_capture.get()
-        if log is None:
+        cb = self.on_query
+        if log is None and cb is None:
             return await self._run(self._execute_sync, sql, params)
         timing: list[float] = []  # filled under the lock on the db thread
         try:
             return await self._run(self._execute_sync, sql, params, timing)
         finally:
-            log.append((" ".join(sql.split()),
-                        timing[0] if timing else 0.0))
+            # timing stays empty when the statement raised — a failed query
+            # must not record a 0.0 ms sample into the db.query series
+            if timing:
+                if cb is not None:
+                    # app-level timing sink (PerformanceTracker); in-lock
+                    # query time only, so queue wait can't masquerade as a
+                    # slow query
+                    cb(timing[0])
+                if log is not None:
+                    log.append((" ".join(sql.split()), timing[0]))
+            elif log is not None:
+                log.append((" ".join(sql.split()), 0.0))
 
     async def executemany(self, sql: str, seq: list[Sequence[Any]]) -> None:
         await self._run(self._executemany_sync, sql, seq)

@@ -165,6 +165,7 @@ const TABS = {
   exportimport: {special: "exportimport"},
   chat:     {special: "chat"},
   engine:   {url: "/admin/engine/stats", special: "engine"},
+  diagnostics: {special: "diagnostics"},
 };
 let current = "tools", rows = [], shown = [], timer = null, cursor = null;
 function esc(s){
@@ -197,6 +198,61 @@ function renderEngine(stats){
     `<div class="cards">${cards}${extra}</div>
      <br><button class="act" onclick="engineProfile()">capture jax profile</button>`;
   document.getElementById("status").textContent = "engine stats";
+}
+async function renderDiagnostics(){
+  // system-scale counters + operation timing + support-bundle download
+  const v = document.getElementById("view");
+  const [sr, pr, cr] = await Promise.all([
+    fetch("/admin/system/stats"), fetch("/admin/performance"),
+    fetch("/admin/classification")]);
+  if (!sr.ok){ v.textContent = "system stats fetch failed: " + sr.status; return; }
+  const stats = await sr.json();
+  let html = "";
+  for (const family of ["users","teams","tokens","metrics","security","workflows"]){
+    const fam = stats[family];
+    if (!fam || typeof fam !== "object") continue;
+    const cards = Object.keys(fam).map(k =>
+      `<div class="card"><b>${cell(fam[k])}</b><span>${esc(family+"."+k)}</span></div>`).join("");
+    html += `<div class="cards">${cards}</div>`;
+  }
+  const ent = stats.entities || {};
+  const entRows = Object.keys(ent).map(k => {
+    const e = ent[k];
+    const total = (e && typeof e === "object") ? e.total : e;
+    const enabled = (e && typeof e === "object") ? e.enabled : "";
+    return `<tr><td>${esc(k)}</td><td>${cell(total)}</td><td>${cell(enabled)}</td></tr>`;
+  }).join("");
+  html += `<table><tr><th>entity</th><th>total</th><th>enabled</th></tr>${entRows}</table>`;
+  if (pr.ok){
+    const perf = await pr.json();
+    const ops = perf.operations || {};
+    const perfRows = Object.keys(ops).map(k => {
+      const o = ops[k];
+      return `<tr><td>${esc(k)}</td><td>${cell(o.count)}</td><td>${cell(o.avg_ms)}</td>`
+        + `<td>${cell(o.p50_ms)}</td><td>${cell(o.p95_ms)}</td><td>${cell(o.p99_ms)}</td>`
+        + `<td>${cell(o.max_ms)}</td><td>${cell(o.slow)}</td></tr>`;
+    }).join("");
+    html += `<br><b>operation timings</b><table><tr><th>operation</th><th>count</th>`
+      + `<th>avg ms</th><th>p50</th><th>p95</th><th>p99</th><th>max</th><th>slow</th></tr>`
+      + `${perfRows}</table>`
+      + `<button class="act danger" onclick="clearPerf()">reset timings</button> `;
+  }
+  if (cr.ok){  // 404 when hot/cold classification is disabled
+    const cls = await cr.json();
+    html += `<br><b>gateway polling</b><div class="cards">`
+      + `<div class="card"><b>${cell((cls.hot||[]).length)}</b><span>hot peers</span></div>`
+      + `<div class="card"><b>${cell((cls.cold||[]).length)}</b><span>cold peers</span></div>`
+      + `<div class="card"><b>${cell((cls.metadata||{}).cycle)}</b><span>poll cycle</span></div></div>`;
+  }
+  html += `<br><a class="act" href="/admin/support-bundle" download>download support bundle</a>`;
+  v.innerHTML = html;
+  document.getElementById("status").textContent = "diagnostics";
+}
+async function clearPerf(){
+  const r = await fetch("/admin/performance", {method:"DELETE"});
+  await renderDiagnostics();  // re-render first: it overwrites the status
+  document.getElementById("status").textContent =
+    r.ok ? "timings cleared" : "clear failed: " + r.status;
 }
 async function engineProfile(){
   const r = await fetch("/admin/engine/profile", {method:"POST",
@@ -389,8 +445,8 @@ async function doImport(){
 }
 function render(){
   const t = TABS[current];
-  if (t.special === "engine" || t.special === "dashboard"
-      || t.special === "exportimport") return;  // rendered at fetch time
+  if (!t.cols) return;  // special tabs (engine/dashboard/chat/diagnostics/
+                        // ingress/exportimport) render at fetch time
   const q = document.getElementById("q").value.toLowerCase();
   // `shown` is the single source of truth for row indices: click handlers
   // index into it, so a filter edit between render and click cannot
@@ -436,6 +492,7 @@ async function show(name, keepCursor){
   if (t.special === "dashboard") return renderDashboard();
   if (t.special === "exportimport") return renderExportImport();
   if (t.special === "chat") return renderChat();
+  if (t.special === "diagnostics") return renderDiagnostics();
   try {
     let url = t.url;
     if (t.paged) {

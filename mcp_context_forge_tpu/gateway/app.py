@@ -136,6 +136,14 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     app["ctx"] = ctx
     app["rate_limiter"] = RateLimiter(settings.rate_limit_rps, settings.rate_limit_burst)
 
+    # operation-timing registry (reference performance_tracker.py): http /
+    # db / tool / resource series feed /admin/performance and the bundle
+    if settings.performance_tracking_enabled:
+        from ..services.diagnostics_service import tracker_from_settings
+        perf = tracker_from_settings(settings)
+        ctx.extras["perf_tracker"] = perf
+        db.on_query = lambda ms: perf.record("db.query", ms / 1e3)
+
     # services
     from ..services.a2a_service import A2AService
     from ..services.export_service import ExportService
@@ -197,6 +205,7 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         ctx.llm_registry = registry
         app["llm_registry"] = registry
         app["tpu_engine"] = engine
+        ctx.extras["tpu_engine"] = engine
         app["tpu_provider"] = provider
         setup_llm_routes(app, registry, prefix=settings.llm_api_prefix)
 
@@ -339,6 +348,14 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     setup_discovery_routes(app)
     from ..services.role_service import RoleService
     app["role_service"] = RoleService(ctx)
+    from ..services.diagnostics_service import (SupportBundleService,
+                                                SystemStatsService)
+    app["system_stats_service"] = SystemStatsService(ctx)
+    app["support_bundle_service"] = SupportBundleService(ctx)
+    if settings.hot_cold_classification_enabled:
+        from ..services.classification_service import (
+            ServerClassificationService)
+        ctx.extras["server_classifier"] = ServerClassificationService(ctx)
     from ..services.compliance_service import ComplianceService
     app["compliance_service"] = ComplianceService(ctx)
     # pre-create: request handlers may not add keys to a frozen

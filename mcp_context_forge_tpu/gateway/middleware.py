@@ -161,6 +161,9 @@ async def observability_middleware(request: web.Request, handler: Handler) -> we
         elapsed = time.monotonic() - started
         ctx.metrics.http_requests.labels(request.method, path_label, str(response.status)).inc()
         ctx.metrics.http_duration.labels(request.method, path_label).observe(elapsed)
+        perf = ctx.extras.get("perf_tracker")
+        if perf is not None:
+            perf.record("http.request", elapsed)
         response.headers[settings.correlation_id_response_header] = \
             correlation_id
         return response

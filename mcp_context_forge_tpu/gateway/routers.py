@@ -194,22 +194,9 @@ def setup_routes(app: web.Application) -> None:
         same way) — the operator's 'what is this gateway actually
         configured to do' answer without shell access."""
         request["auth"].require("admin.all")
-        import re as _re
-        settings = request.app["ctx"].settings
-        # compound fields that EMBED credentials without a telltale name
-        opaque = {"sso_providers", "otel_otlp_headers"}
-        out = []
-        for name in sorted(type(settings).model_fields):
-            value = getattr(settings, name)
-            if any(fragment in name
-                   for fragment in ("secret", "password", "api_key")) \
-                    or name in opaque:
-                value = "***redacted***" if value else ""
-            elif name == "database_url" and isinstance(value, str):
-                # keep host/db, scrub DSN userinfo (postgresql://u:p@...)
-                value = _re.sub(r"://[^@/]+@", "://***@", value)
-            out.append({"name": name, "value": value})
-        return web.json_response(out)
+        from ..utils.redact import redact_settings
+        return web.json_response(
+            redact_settings(request.app["ctx"].settings))
 
     @routes.post("/admin/users/{email}/require-password-change")
     async def require_password_change(request: web.Request) -> web.Response:
@@ -548,6 +535,73 @@ def setup_routes(app: web.Application) -> None:
             "duration_ms": s.duration_ms, "status": s.status,
             "attributes": {k: str(v) for k, v in s.attributes.items()},
         } for s in reversed(spans)])
+
+    @routes.get("/admin/system/stats")
+    async def system_stats(request: web.Request) -> web.Response:
+        """Deployment-scale counters across every entity family
+        (reference services/system_stats_service.py, admin.py:18142)."""
+        request["auth"].require("observability.read")
+        return web.json_response(
+            await request.app["system_stats_service"].stats())
+
+    @routes.get("/admin/performance")
+    async def performance_summary(request: web.Request) -> web.Response:
+        """Operation timing percentiles + slow-op counts (reference
+        services/performance_tracker.py:178)."""
+        request["auth"].require("observability.read")
+        perf = request.app["ctx"].extras.get("perf_tracker")
+        if perf is None:
+            raise NotFoundError("performance tracking is disabled")
+        op = request.query.get("operation")
+        out = perf.summary(op)
+        if op and request.query.get("degradation") == "true":
+            settings = request.app["ctx"].settings
+            out["degradation"] = perf.degradation(
+                op, settings.performance_degradation_multiplier)
+        return web.json_response(out)
+
+    @routes.delete("/admin/performance")
+    async def performance_clear(request: web.Request) -> web.Response:
+        request["auth"].require("admin.all")
+        perf = request.app["ctx"].extras.get("perf_tracker")
+        if perf is None:
+            raise NotFoundError("performance tracking is disabled")
+        perf.clear(request.query.get("operation"))
+        return web.Response(status=204)
+
+    @routes.get("/admin/classification")
+    async def classification_state(request: web.Request) -> web.Response:
+        """Hot/cold polling state (reference
+        server_classification_service.py; restored, not stubbed)."""
+        request["auth"].require("observability.read")
+        classifier = request.app["ctx"].extras.get("server_classifier")
+        if classifier is None:
+            raise NotFoundError("hot/cold classification is disabled")
+        # recompute on read: the health loop refreshes only once per
+        # interval, and the operator wants the CURRENT hot/cold split
+        return web.json_response(await classifier.classify())
+
+    @routes.get("/admin/support-bundle")
+    async def support_bundle(request: web.Request) -> web.Response:
+        """Sanitized diagnostics zip download (reference
+        services/support_bundle_service.py, admin.py:18212)."""
+        request["auth"].require("admin.all")
+        settings = request.app["ctx"].settings
+        if not settings.support_bundle_enabled:
+            raise NotFoundError("support bundle generation is disabled")
+        try:
+            tail = int(request.query.get("tail",
+                                         settings.support_bundle_log_tail))
+        except ValueError as exc:
+            raise ValidationFailure("tail must be an integer") from exc
+        name, payload = await request.app["support_bundle_service"].generate(
+            include_logs=request.query.get("logs") != "false",
+            include_env=request.query.get("env") != "false",
+            log_tail=tail)
+        return web.Response(
+            body=payload, content_type="application/zip",
+            headers={"content-disposition":
+                     f'attachment; filename="{name}"'})
 
     @routes.get("/admin/engine/stats")
     async def engine_stats(request: web.Request) -> web.Response:

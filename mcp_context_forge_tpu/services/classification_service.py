@@ -1,0 +1,97 @@
+"""Hot/cold gateway classification for gated health polling.
+
+Reference: ``services/server_classification_service.py`` — upstream the
+feature degraded to "always poll" after its session-pool signal was
+removed (#4205; the module says so itself). This implementation restores
+the real capability from signals this schema already has:
+
+- recent tool traffic through a gateway (``tool_metrics`` joined via
+  ``tools.gateway_id``) — a peer serving calls now is HOT;
+- registration recency (a just-added peer must be probed promptly, so
+  it starts hot until a full window passes with no traffic).
+
+``gateways.last_seen`` is deliberately NOT a signal: the health probe
+itself refreshes it, so using it would keep every probed peer hot
+forever (probe → last_seen bump → hot → probe …).
+
+HOT peers are probed every health cycle; COLD peers every
+``hot_cold_cold_poll_multiplier``-th cycle — an unused federation of
+hundreds of peers stops costing a full probe fan-out per cycle while
+reactivation latency stays bounded (a cold recovering peer is seen at
+most ``multiplier * interval`` late). The hot set is capped
+(``hot_cold_hot_cap``) by most-recent-use rank so one noisy deployment
+cannot starve probing of the rest.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any
+
+from .base import AppContext
+
+logger = logging.getLogger(__name__)
+
+
+class ServerClassificationService:
+    def __init__(self, ctx: AppContext) -> None:
+        self._ctx = ctx
+        self._cycle = 0
+        self._hot: set[str] = set()
+        self._last_result: dict[str, Any] | None = None
+
+    async def classify(self) -> dict[str, Any]:
+        """Recompute hot/cold sets from current traffic + liveness."""
+        settings = self._ctx.settings
+        window = settings.hot_cold_hot_window_s
+        cutoff = time.time() - window
+        rows = await self._ctx.db.fetchall(
+            "SELECT g.id, g.created_at,"
+            " MAX(m.ts) AS last_invocation"
+            " FROM gateways g"
+            " LEFT JOIN tools t ON t.gateway_id = g.id"
+            " LEFT JOIN tool_metrics m ON m.tool_id = t.id AND m.ts > ?"
+            " WHERE g.enabled=1 GROUP BY g.id", (cutoff,))
+        scored: list[tuple[float, str]] = []
+        cold: list[str] = []
+        for row in rows:
+            # the strongest recency signal wins; registration recency keeps
+            # brand-new peers hot for one full window
+            signal = max(row["last_invocation"] or 0.0,
+                         row["created_at"] or 0.0)
+            if signal > cutoff:
+                scored.append((signal, row["id"]))
+            else:
+                cold.append(row["id"])
+        scored.sort(reverse=True)
+        cap = max(1, settings.hot_cold_hot_cap)
+        hot = [gid for _, gid in scored[:cap]]
+        cold.extend(gid for _, gid in scored[cap:])
+        self._hot = set(hot)
+        self._last_result = {
+            "hot": hot, "cold": cold,
+            "metadata": {
+                "total_servers": len(rows),
+                "hot_cap": cap,
+                "hot_actual": len(hot),
+                "window_s": window,
+                "cycle": self._cycle,
+                "timestamp": time.time(),
+            },
+        }
+        return self._last_result
+
+    def should_poll(self, gateway_id: str) -> bool:
+        """Gate one health probe. Hot: every cycle. Cold: every Nth."""
+        if gateway_id in self._hot:
+            return True
+        multiplier = max(1, self._ctx.settings.hot_cold_cold_poll_multiplier)
+        return self._cycle % multiplier == 0
+
+    def advance_cycle(self) -> None:
+        self._cycle += 1
+
+    @property
+    def last_result(self) -> dict[str, Any] | None:
+        return self._last_result

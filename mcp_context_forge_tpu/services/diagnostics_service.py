@@ -1,0 +1,389 @@
+"""Operator diagnostics: system stats, performance tracking, support bundle.
+
+Reference analogs, rebuilt for this stack (async sqlite + aiohttp + the
+in-proc ring logger) rather than translated:
+
+- ``SystemStatsService`` — comprehensive deployment-scale counts across
+  every entity family (reference
+  ``services/system_stats_service.py:90-458``, surfaced at
+  ``admin.py:18142``). One aggregate SQL pass per family over the single
+  discriminated schema instead of per-model ORM counts.
+- ``PerformanceTracker`` — in-process operation timing with percentile
+  summaries, configurable slow-op thresholds and degradation checks
+  (reference ``services/performance_tracker.py:28-370`` +
+  ``performance_service.py``). Bounded ring per operation; zero cost
+  when disabled.
+- ``SupportBundleService`` — one-call sanitized diagnostics zip:
+  version/platform info, effective settings (redacted via
+  ``utils.redact``), allowlisted env, recent in-proc logs, DB/table
+  stats and engine state (reference
+  ``services/support_bundle_service.py:76-493``, ``admin.py:18212``).
+  Built fully in memory — no temp files to leak on a crashed worker.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import os
+import platform
+import sys
+import time
+import zipfile
+from collections import deque
+from contextlib import contextmanager
+from typing import Any
+
+from .. import PROTOCOL_VERSION, __version__
+from ..observability.logging import ring_buffer
+from ..utils.redact import redact_env, redact_settings
+from .base import AppContext
+
+logger = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------------------
+# system stats
+# --------------------------------------------------------------------------
+
+class SystemStatsService:
+    """Deployment-scale counters for the admin dashboard.
+
+    The reference walks 9 stat families with per-ORM-model queries and an
+    admin-stats TTL cache; here each family is one aggregate SELECT over
+    the discriminated tables, cached in ``AppContext.extras`` under the
+    same TTL knob the other dashboard aggregations use.
+    """
+
+    _CACHE_KEY = "_system_stats_cache"
+
+    def __init__(self, ctx: AppContext) -> None:
+        self._ctx = ctx
+
+    async def stats(self) -> dict[str, Any]:
+        settings = self._ctx.settings
+        if settings.admin_stats_cache_enabled:
+            cached = self._ctx.extras.get(self._CACHE_KEY)
+            if cached and cached[1] > time.monotonic():
+                return cached[0]
+        out = {
+            "users": await self._users(),
+            "teams": await self._teams(),
+            "entities": await self._entities(),
+            "tokens": await self._tokens(),
+            "metrics": await self._metrics(),
+            "security": await self._security(),
+            "workflows": await self._workflows(),
+            "timestamp": time.time(),
+        }
+        if settings.admin_stats_cache_enabled:
+            self._ctx.extras[self._CACHE_KEY] = (
+                out, time.monotonic() + settings.admin_stats_cache_ttl_s)
+        return out
+
+    async def _one(self, sql: str, params: tuple = ()) -> dict[str, Any]:
+        row = await self._ctx.db.fetchone(sql, params)
+        return {k: (v or 0) for k, v in (row or {}).items()}
+
+    async def _users(self) -> dict[str, Any]:
+        return await self._one(
+            "SELECT COUNT(*) AS total,"
+            " SUM(CASE WHEN is_active THEN 1 ELSE 0 END) AS active,"
+            " SUM(CASE WHEN is_admin THEN 1 ELSE 0 END) AS admins,"
+            " SUM(CASE WHEN auth_provider != 'local' THEN 1 ELSE 0 END)"
+            "   AS sso_provisioned FROM users")
+
+    async def _teams(self) -> dict[str, Any]:
+        out = await self._one(
+            "SELECT COUNT(*) AS total,"
+            " SUM(CASE WHEN is_personal THEN 1 ELSE 0 END) AS personal"
+            " FROM teams")
+        out.update(await self._one(
+            "SELECT COUNT(*) AS members,"
+            " COUNT(DISTINCT user_email) AS distinct_members"
+            " FROM team_members"))
+        out.update(await self._one(
+            "SELECT SUM(CASE WHEN accepted_at IS NULL THEN 1 ELSE 0 END)"
+            " AS pending_invitations FROM team_invitations"))
+        return out
+
+    async def _entities(self) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for table in ("tools", "resources", "prompts", "servers",
+                      "gateways", "a2a_agents", "llm_providers",
+                      "llm_models"):
+            row = await self._one(
+                f"SELECT COUNT(*) AS total,"  # noqa: S608 — fixed table set
+                f" SUM(CASE WHEN enabled THEN 1 ELSE 0 END) AS enabled"
+                f" FROM {table}")
+            out[table] = row
+        out["resource_subscriptions"] = (await self._one(
+            "SELECT COUNT(*) AS total FROM resource_subscriptions"))["total"]
+        out["plugin_bindings"] = (await self._one(
+            "SELECT COUNT(*) AS total FROM plugin_bindings"))["total"]
+        return out
+
+    async def _tokens(self) -> dict[str, Any]:
+        return await self._one(
+            "SELECT COUNT(*) AS total,"
+            " SUM(CASE WHEN revoked_at IS NOT NULL THEN 1 ELSE 0 END)"
+            "   AS revoked,"
+            " SUM(CASE WHEN expires_at IS NOT NULL AND expires_at < ?"
+            "     THEN 1 ELSE 0 END) AS expired"
+            " FROM api_tokens", (time.time(),))
+
+    async def _metrics(self) -> dict[str, Any]:
+        out = await self._one(
+            "SELECT COUNT(*) AS raw_rows,"
+            " SUM(CASE WHEN success THEN 0 ELSE 1 END) AS errors,"
+            " AVG(duration_ms) AS avg_duration_ms FROM tool_metrics")
+        out["rollup_rows"] = (await self._one(
+            "SELECT COUNT(*) AS total FROM metrics_rollups"))["total"]
+        out["traces"] = (await self._one(
+            "SELECT COUNT(*) AS total FROM observability_traces"))["total"]
+        return out
+
+    async def _security(self) -> dict[str, Any]:
+        out = await self._one(
+            "SELECT COUNT(*) AS audit_rows FROM audit_trail")
+        # lockout posture lives on the users table (auth_service lockout)
+        out.update(await self._one(
+            "SELECT SUM(CASE WHEN failed_login_attempts > 0 THEN 1 ELSE 0"
+            " END) AS users_with_failed_logins,"
+            " SUM(CASE WHEN locked_until IS NOT NULL AND locked_until > ?"
+            " THEN 1 ELSE 0 END) AS locked_users FROM users",
+            (time.time(),)))
+        out["roles"] = (await self._one(
+            "SELECT COUNT(*) AS total FROM roles"))["total"]
+        out["role_assignments"] = (await self._one(
+            "SELECT COUNT(*) AS total FROM user_roles"))["total"]
+        return out
+
+    async def _workflows(self) -> dict[str, Any]:
+        rows = await self._ctx.db.fetchall(
+            "SELECT state, COUNT(*) AS n FROM a2a_tasks GROUP BY state")
+        return {r["state"]: r["n"] for r in rows}
+
+
+# --------------------------------------------------------------------------
+# performance tracking
+# --------------------------------------------------------------------------
+
+class PerformanceTracker:
+    """Bounded per-operation timing registry.
+
+    ``track("tool.invoke")`` wraps any block; summaries expose count /
+    avg / p50 / p95 / p99 / max plus threshold breaches. The reference
+    keeps unbounded per-operation lists trimmed on read; here each op is
+    a fixed ``deque`` so a hot gateway can never grow the tracker.
+    """
+
+    def __init__(self, max_samples: int = 512,
+                 thresholds: dict[str, float] | None = None) -> None:
+        self._samples: dict[str, deque[float]] = {}
+        self._totals: dict[str, int] = {}
+        self._slow: dict[str, int] = {}
+        self._max = max_samples
+        # seconds per operation-class; checked on every record
+        self.thresholds = dict(thresholds or {})
+
+    @contextmanager
+    def track(self, operation: str, component: str | None = None):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(operation, time.perf_counter() - start, component)
+
+    def record(self, operation: str, seconds: float,
+               component: str | None = None) -> None:
+        buf = self._samples.get(operation)
+        if buf is None:
+            buf = self._samples[operation] = deque(maxlen=self._max)
+        buf.append(seconds)
+        self._totals[operation] = self._totals.get(operation, 0) + 1
+        limit = self._threshold_for(operation)
+        if limit and seconds > limit:
+            self._slow[operation] = self._slow.get(operation, 0) + 1
+            logger.warning("slow operation %s: %.1f ms (threshold %.1f ms)%s",
+                           operation, seconds * 1e3, limit * 1e3,
+                           f" [{component}]" if component else "")
+
+    def _threshold_for(self, operation: str) -> float | None:
+        if operation in self.thresholds:
+            return self.thresholds[operation]
+        # class thresholds match on prefix: "db." / "http." / "tool." ...
+        prefix = operation.split(".", 1)[0]
+        return self.thresholds.get(prefix)
+
+    def summary(self, operation: str | None = None) -> dict[str, Any]:
+        names = [operation] if operation else sorted(self._samples)
+        ops = {}
+        for name in names:
+            buf = self._samples.get(name)
+            if not buf:
+                continue
+            vals = sorted(buf)
+            n = len(vals)
+
+            def pct(p: float) -> float:
+                return vals[min(n - 1, int(p * n))]
+
+            ops[name] = {
+                "count": self._totals.get(name, n),
+                "window": n,
+                "avg_ms": round(sum(vals) / n * 1e3, 3),
+                "p50_ms": round(pct(0.50) * 1e3, 3),
+                "p95_ms": round(pct(0.95) * 1e3, 3),
+                "p99_ms": round(pct(0.99) * 1e3, 3),
+                "max_ms": round(vals[-1] * 1e3, 3),
+                "slow": self._slow.get(name, 0),
+            }
+        return {"operations": ops}
+
+    def degradation(self, operation: str,
+                    multiplier: float = 2.0) -> dict[str, Any]:
+        """Is the recent half of the window `multiplier`x the older half?
+
+        The reference compares current average against a stored baseline;
+        a split-window comparison needs no persisted baseline and answers
+        the same operator question ("did this op just get slower?").
+        """
+        buf = list(self._samples.get(operation, ()))
+        if len(buf) < 8:
+            return {"operation": operation, "degraded": False,
+                    "reason": "insufficient samples"}
+        half = len(buf) // 2
+        old = sum(buf[:half]) / half
+        new = sum(buf[half:]) / (len(buf) - half)
+        degraded = old > 0 and new > old * multiplier
+        return {"operation": operation, "degraded": degraded,
+                "baseline_avg_ms": round(old * 1e3, 3),
+                "recent_avg_ms": round(new * 1e3, 3),
+                "multiplier": multiplier}
+
+    def clear(self, operation: str | None = None) -> None:
+        if operation is None:
+            self._samples.clear()
+            self._totals.clear()
+            self._slow.clear()
+        else:
+            self._samples.pop(operation, None)
+            self._totals.pop(operation, None)
+            self._slow.pop(operation, None)
+
+
+def tracker_from_settings(settings: Any) -> PerformanceTracker:
+    """Build the app tracker with the reference's four class thresholds
+    (performance_threshold_* fields, ms in config, seconds here)."""
+    return PerformanceTracker(
+        max_samples=settings.performance_max_samples,
+        thresholds={
+            "db": settings.performance_threshold_database_query_ms / 1e3,
+            "http": settings.performance_threshold_http_request_ms / 1e3,
+            "tool": settings.performance_threshold_tool_invocation_ms / 1e3,
+            "resource": settings.performance_threshold_resource_read_ms / 1e3,
+        })
+
+
+# --------------------------------------------------------------------------
+# support bundle
+# --------------------------------------------------------------------------
+
+class SupportBundleService:
+    """Sanitized one-file diagnostics for a support ticket."""
+
+    def __init__(self, ctx: AppContext) -> None:
+        self._ctx = ctx
+
+    async def generate(self, *, include_logs: bool = True,
+                       include_env: bool = True,
+                       log_tail: int = 1000) -> tuple[str, bytes]:
+        """Return (filename, zip bytes). Everything passes the shared
+        redaction policy before it reaches the archive."""
+        stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+        name = f"mcpforge-support-{stamp}.zip"
+        buf = io.BytesIO()
+        entries: list[str] = []
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+            def put(path: str, payload: Any) -> None:
+                entries.append(path)
+                body = payload if isinstance(payload, str) else json.dumps(
+                    payload, indent=2, default=str)
+                zf.writestr(path, body)
+
+            put("version.json", {
+                "version": __version__,
+                "protocol_version": PROTOCOL_VERSION,
+                "python": sys.version,
+                "worker_id": self._ctx.worker_id,
+            })
+            put("system.json", self._system_info())
+            put("settings.json", redact_settings(self._ctx.settings))
+            if include_env:
+                put("environment.json", redact_env(os.environ))
+            put("database.json", await self._db_info())
+            engine = self._ctx.extras.get("tpu_engine")
+            if engine is not None:
+                try:
+                    stats = engine.stats
+                    put("engine.json", {
+                        "model": engine.config.model,
+                        "mesh": dict(engine.mesh.shape),
+                        "requests": stats.requests,
+                        "completion_tokens": stats.completion_tokens,
+                        "decode_steps": stats.decode_steps,
+                        "queue_depth": stats.queue_depth,
+                    })
+                except Exception as exc:  # diagnostics must not fail the bundle
+                    put("engine.json", {"error": str(exc)})
+            if include_logs:
+                records = ring_buffer.search(limit=log_tail)
+                put("logs/recent.jsonl",
+                    "\n".join(json.dumps(r, default=str) for r in records))
+            perf = self._ctx.extras.get("perf_tracker")
+            if perf is not None:
+                put("performance.json", perf.summary())
+            put("manifest.json", {
+                "generated_at": stamp,
+                "entries": sorted(entries),
+                "sanitized": True,
+            })
+        return name, buf.getvalue()
+
+    def _system_info(self) -> dict[str, Any]:
+        info: dict[str, Any] = {
+            "platform": platform.platform(),
+            "machine": platform.machine(),
+            "python_implementation": platform.python_implementation(),
+            "pid": os.getpid(),
+            "cpu_count": os.cpu_count(),
+        }
+        try:
+            load1, load5, load15 = os.getloadavg()
+            info["loadavg"] = {"1m": load1, "5m": load5, "15m": load15}
+        except OSError:
+            pass
+        try:
+            import resource
+            usage = resource.getrusage(resource.RUSAGE_SELF)
+            info["max_rss_kb"] = usage.ru_maxrss
+        except Exception:
+            pass
+        return info
+
+    async def _db_info(self) -> dict[str, Any]:
+        db = self._ctx.db
+        tables = await db.fetchall(
+            "SELECT name FROM sqlite_master WHERE type='table'"
+            " AND name NOT LIKE 'sqlite_%' ORDER BY name")
+        counts = {}
+        for row in tables:
+            table = row["name"]
+            one = await db.fetchone(
+                f"SELECT COUNT(*) AS n FROM {table}")  # noqa: S608 — names from sqlite_master
+            counts[table] = one["n"] if one else 0
+        version = await db.fetchone("SELECT MAX(version) AS v FROM schema_migrations")
+        return {"schema_version": (version or {}).get("v"),
+                "table_rows": counts}

@@ -239,8 +239,15 @@ class GatewayService:
 
     async def check_health_of_gateways(self) -> dict[str, bool]:
         """Ping every enabled gateway; deactivate after threshold failures,
-        reactivate on recovery (reference :4368/:4318/:4485)."""
+        reactivate on recovery (reference :4368/:4318/:4485). With
+        hot/cold classification on, cold peers are probed every Nth
+        cycle only (services/classification_service.py)."""
         rows = await self.ctx.db.fetchall("SELECT * FROM gateways WHERE enabled=1")
+        classifier = self.ctx.extras.get("server_classifier")
+        if classifier is not None:
+            await classifier.classify()
+            rows = [r for r in rows if classifier.should_poll(r["id"])]
+            classifier.advance_cycle()
         results: dict[str, bool] = {}
         # bounded fan-out (reference max_concurrent_health_checks): N slow
         # peers must not serialize into an N*timeout sweep, but an

@@ -126,6 +126,9 @@ class ResourceService:
     async def _record_metric(self, uri: str, duration_ms: float,
                              success: bool) -> None:
         """Per-entity invocation metrics (reference ResourceMetric rows)."""
+        perf = self.ctx.extras.get("perf_tracker")
+        if perf is not None:
+            perf.record("resource.read", duration_ms / 1000.0)
         try:
             await self.ctx.db.execute(
                 "INSERT INTO tool_metrics (tool_id, ts, duration_ms, success,"

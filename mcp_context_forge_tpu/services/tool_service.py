@@ -236,6 +236,9 @@ class ToolService:
                 elapsed = time.monotonic() - started
                 self.ctx.metrics.tool_invocations.labels(tool=name, status=status).inc()
                 self.ctx.metrics.tool_duration.labels(tool=name).observe(elapsed)
+                perf = self.ctx.extras.get("perf_tracker")
+                if perf is not None:
+                    perf.record("tool.invoke", elapsed)
                 asyncio.get_running_loop().create_task(
                     self._record_metric(tool_id, elapsed * 1000, status == "success"))
 

@@ -1,0 +1,78 @@
+"""Shared secret redaction for operator-facing surfaces.
+
+One redaction policy serves the admin effective-config view, the support
+bundle and the env snapshot (reference keeps the same list duplicated in
+`services/support_bundle_service.py:112-186` and its admin config view;
+here it is a single module so the surfaces can't drift).
+
+Policy: a value is a secret when its *name* carries a credential
+fragment, when the field is a known compound carrier (embeds credentials
+without a telltale name), or when it is a DSN whose userinfo would leak
+a password.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Mapping
+
+REDACTED = "***redacted***"
+
+# name fragments that mark a credential regardless of casing.  "token"
+# is deliberately a SUFFIX match only: token_expiry / csrf_token_ttl_s /
+# token_usage_logging_enabled are tuning knobs, while *_token fields
+# (access_token, bearer_token) carry the credential itself.
+_SECRET_FRAGMENTS = (
+    "secret", "password", "passwd", "api_key", "apikey",
+    "private_key", "credential",
+)
+
+# fields that EMBED credentials in a compound value (JSON blobs, header
+# maps) — the name alone doesn't give them away
+_OPAQUE_FIELDS = {"sso_providers", "otel_otlp_headers"}
+
+_DSN_USERINFO = re.compile(r"://[^@/\s]+@")
+
+
+def is_secret_name(name: str) -> bool:
+    low = name.lower()
+    return (any(f in low for f in _SECRET_FRAGMENTS)
+            or low.endswith("_token") or low == "token"
+            or low in _OPAQUE_FIELDS)
+
+
+def redact_value(name: str, value: Any) -> Any:
+    """Redact one named value; DSNs keep host/db but lose userinfo."""
+    if isinstance(value, bool) or isinstance(value, (int, float)):
+        return value  # no credential is numeric; keep tuning knobs visible
+    if is_secret_name(name):
+        return REDACTED if value else ""
+    if isinstance(value, str) and "://" in value:
+        return _DSN_USERINFO.sub("://***@", value)
+    return value
+
+
+def redact_settings(settings: Any) -> list[dict[str, Any]]:
+    """The effective-settings table, secrets redacted, stable order."""
+    out = []
+    for name in sorted(type(settings).model_fields):
+        out.append({"name": name,
+                    "value": redact_value(name, getattr(settings, name))})
+    return out
+
+
+def redact_env(environ: Mapping[str, str]) -> dict[str, str]:
+    """A process-environment snapshot safe to put in a support bundle.
+
+    Only configuration-shaped variables are included (MCPFORGE_*, JAX/XLA
+    tuning, proxy settings) — a full environ dump ships unrelated host
+    secrets even redacted-by-name, so allowlist the prefixes instead.
+    """
+    keep_prefixes = ("MCPFORGE_", "JAX_", "XLA_", "LIBTPU", "TPU_",
+                     "HTTP_PROXY", "HTTPS_PROXY", "NO_PROXY", "PYTHONPATH")
+    out: dict[str, str] = {}
+    for key in sorted(environ):
+        if not key.upper().startswith(keep_prefixes):
+            continue
+        out[key] = str(redact_value(key, environ[key]))
+    return out

@@ -1,0 +1,126 @@
+"""Hot/cold gateway classification (reference
+services/server_classification_service.py — upstream degraded to
+"always poll"; here the signal is rebuilt from tool_metrics + gateway
+recency, so the gating is real and testable)."""
+
+import time
+
+import aiohttp
+
+from test_gateway_app import BASIC, make_client
+
+
+async def _seed_gateway(app, gid: str, created_ago: float) -> None:
+    now = time.time()
+    await app["ctx"].db.execute(
+        "INSERT INTO gateways (id, name, url, enabled, created_at,"
+        " updated_at) VALUES (?,?,?,1,?,?)",
+        (gid, gid, f"http://127.0.0.1:9/{gid}", now - created_ago,
+         now - created_ago))
+
+
+async def _seed_traffic(app, gid: str, ago: float) -> None:
+    now = time.time()
+    await app["ctx"].db.execute(
+        "INSERT INTO tools (id, original_name, integration_type,"
+        " gateway_id, enabled, created_at, updated_at)"
+        " VALUES (?,?,?,?,1,?,?)",
+        (f"t-{gid}", f"t-{gid}", "MCP", gid, now, now))
+    await app["ctx"].db.execute(
+        "INSERT INTO tool_metrics (tool_id, ts, duration_ms, success)"
+        " VALUES (?,?,?,1)", (f"t-{gid}", now - ago, 5.0))
+
+
+async def test_classify_by_traffic_and_registration_recency():
+    client = await make_client(hot_cold_classification_enabled="true",
+                               hot_cold_hot_window_s="600")
+    try:
+        app = client.app
+        # stale peer, no traffic -> cold; fresh registration -> hot;
+        # stale peer WITH recent traffic -> hot
+        await _seed_gateway(app, "stale", created_ago=7200)
+        await _seed_gateway(app, "fresh", created_ago=10)
+        await _seed_gateway(app, "busy", created_ago=7200)
+        await _seed_traffic(app, "busy", ago=30)
+
+        classifier = app["ctx"].extras["server_classifier"]
+        result = await classifier.classify()
+        assert set(result["hot"]) == {"fresh", "busy"}
+        assert result["cold"] == ["stale"]
+        assert result["metadata"]["total_servers"] == 3
+
+        # hot: every cycle; cold: exactly once per multiplier window
+        # (the startup health pass may already have advanced the cycle,
+        # so assert the pattern, not the phase)
+        polls = []
+        for _ in range(5):
+            polls.append(classifier.should_poll("stale"))
+            classifier.advance_cycle()
+        assert polls.count(True) == 1
+        assert classifier.should_poll("busy")
+
+        resp = await client.get("/admin/classification",
+                                auth=aiohttp.BasicAuth(*BASIC))
+        assert resp.status == 200
+        body = await resp.json()
+        assert set(body["hot"]) == {"fresh", "busy"}
+    finally:
+        await client.close()
+
+
+async def test_hot_cap_bounds_the_hot_set():
+    client = await make_client(hot_cold_classification_enabled="true",
+                               hot_cold_hot_cap="1")
+    try:
+        app = client.app
+        await _seed_gateway(app, "g1", created_ago=7200)
+        await _seed_gateway(app, "g2", created_ago=7200)
+        await _seed_traffic(app, "g1", ago=120)   # older traffic
+        await _seed_traffic(app, "g2", ago=10)    # most recent wins the slot
+        result = await app["ctx"].extras["server_classifier"].classify()
+        assert result["hot"] == ["g2"]
+        assert set(result["cold"]) == {"g1"}
+    finally:
+        await client.close()
+
+
+async def test_health_loop_skips_cold_peers(monkeypatch):
+    client = await make_client(hot_cold_classification_enabled="true",
+                               hot_cold_hot_window_s="600",
+                               hot_cold_cold_poll_multiplier="3")
+    try:
+        app = client.app
+        await _seed_gateway(app, "stale", created_ago=7200)
+        await _seed_gateway(app, "fresh", created_ago=10)
+        gw = app["gateway_service"]
+        probed: list[str] = []
+
+        class _Conn:
+            async def __aenter__(self):
+                return self
+
+            async def __aexit__(self, *exc):
+                return False
+
+        async def fake_connect(row):
+            probed.append(row["id"])
+            return _Conn()
+
+        monkeypatch.setattr(gw, "_connect", fake_connect)
+        # cycle 0: multiplier boundary -> both probed; cycles 1-2: hot only
+        for _ in range(3):
+            await gw.check_health_of_gateways()
+        assert probed.count("fresh") == 3
+        assert probed.count("stale") == 1
+    finally:
+        await client.close()
+
+
+async def test_classification_disabled_404s():
+    client = await make_client()
+    try:
+        resp = await client.get("/admin/classification",
+                                auth=aiohttp.BasicAuth(*BASIC))
+        assert resp.status == 404
+    finally:
+        await client.close()

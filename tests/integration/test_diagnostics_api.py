@@ -1,0 +1,153 @@
+"""Diagnostics surface: /admin/system/stats, /admin/performance,
+/admin/support-bundle (reference admin.py:18142,18212 +
+services/system_stats_service.py / support_bundle_service.py /
+performance_tracker.py)."""
+
+import io
+import json
+import zipfile
+
+import aiohttp
+
+from test_gateway_app import BASIC, make_client
+
+
+async def test_system_stats_counts_entities():
+    client = await make_client()
+    try:
+        # create one tool so the counters have something to count
+        resp = await client.post("/tools", json={
+            "name": "diag_tool", "integration_type": "REST",
+            "url": "http://127.0.0.1:9/x"}, auth=aiohttp.BasicAuth(*BASIC))
+        assert resp.status == 201
+        resp = await client.get("/admin/system/stats",
+                                auth=aiohttp.BasicAuth(*BASIC))
+        assert resp.status == 200
+        stats = await resp.json()
+        assert stats["entities"]["tools"]["total"] == 1
+        assert stats["entities"]["tools"]["enabled"] == 1
+        assert stats["users"]["total"] >= 1      # platform admin bootstrap
+        assert stats["users"]["admins"] >= 1
+        assert "roles" in stats["security"]
+        # unauthenticated: denied
+        resp = await client.get("/admin/system/stats")
+        assert resp.status == 401
+    finally:
+        await client.close()
+
+
+async def test_performance_endpoint_tracks_requests():
+    client = await make_client()
+    try:
+        for _ in range(3):
+            await client.get("/health")
+        resp = await client.get("/admin/performance",
+                                auth=aiohttp.BasicAuth(*BASIC))
+        assert resp.status == 200
+        ops = (await resp.json())["operations"]
+        # the http middleware feeds the tracker; db wiring feeds db.query
+        assert ops["http.request"]["count"] >= 3
+        assert ops["db.query"]["count"] >= 1
+        assert ops["http.request"]["p95_ms"] >= ops["http.request"]["p50_ms"]
+
+        # single-operation view + degradation verdict
+        resp = await client.get(
+            "/admin/performance?operation=http.request&degradation=true",
+            auth=aiohttp.BasicAuth(*BASIC))
+        body = await resp.json()
+        assert set(body["operations"]) == {"http.request"}
+        assert "degraded" in body["degradation"]
+
+        # clear requires admin and empties the series
+        resp = await client.delete("/admin/performance",
+                                   auth=aiohttp.BasicAuth(*BASIC))
+        assert resp.status == 204
+        resp = await client.get("/admin/performance",
+                                auth=aiohttp.BasicAuth(*BASIC))
+        ops = (await resp.json())["operations"]
+        # only the post-clear requests remain (the DELETE itself is recorded
+        # by the middleware after its handler ran)
+        assert ops.get("http.request", {}).get("count", 0) <= 2
+    finally:
+        await client.close()
+
+
+async def test_performance_disabled_404s():
+    client = await make_client(performance_tracking_enabled="false")
+    try:
+        resp = await client.get("/admin/performance",
+                                auth=aiohttp.BasicAuth(*BASIC))
+        assert resp.status == 404
+    finally:
+        await client.close()
+
+
+async def test_support_bundle_zip_is_sanitized():
+    client = await make_client()
+    try:
+        resp = await client.get("/admin/support-bundle",
+                                auth=aiohttp.BasicAuth(*BASIC))
+        assert resp.status == 200
+        assert resp.content_type == "application/zip"
+        assert "attachment" in resp.headers["content-disposition"]
+        payload = await resp.read()
+        zf = zipfile.ZipFile(io.BytesIO(payload))
+        names = set(zf.namelist())
+        assert {"manifest.json", "version.json", "system.json",
+                "settings.json", "environment.json",
+                "database.json", "logs/recent.jsonl"} <= names
+
+        settings_rows = json.loads(zf.read("settings.json"))
+        by_name = {r["name"]: r["value"] for r in settings_rows}
+        assert by_name["jwt_secret_key"] == "***redacted***"
+        assert by_name["basic_auth_password"] == "***redacted***"
+
+        manifest = json.loads(zf.read("manifest.json"))
+        assert manifest["sanitized"] is True
+        assert set(manifest["entries"]) == names - {"manifest.json"}
+        db_info = json.loads(zf.read("database.json"))
+        assert db_info["table_rows"]["users"] >= 1
+        assert db_info["schema_version"] is not None
+
+        # raw secret bytes never appear anywhere in the archive
+        secret = client.app["ctx"].settings.jwt_secret_key.encode()
+        for name in names:
+            assert secret not in zf.read(name), name
+
+        # opt-outs drop the optional sections
+        resp = await client.get("/admin/support-bundle?logs=false&env=false",
+                                auth=aiohttp.BasicAuth(*BASIC))
+        zf2 = zipfile.ZipFile(io.BytesIO(await resp.read()))
+        assert "logs/recent.jsonl" not in zf2.namelist()
+        assert "environment.json" not in zf2.namelist()
+
+        # non-admin users denied
+        await client.post("/admin/users", json={
+            "email": "diag@x.com", "password": "Quartz!Moss2024x"},
+            auth=aiohttp.BasicAuth(*BASIC))
+        resp = await client.get("/admin/support-bundle",
+                                auth=aiohttp.BasicAuth("diag@x.com",
+                                                       "Quartz!Moss2024x"))
+        assert resp.status == 403
+    finally:
+        await client.close()
+
+
+async def test_support_bundle_rejects_bad_tail():
+    client = await make_client()
+    try:
+        resp = await client.get("/admin/support-bundle?tail=abc",
+                                auth=aiohttp.BasicAuth(*BASIC))
+        assert resp.status == 422  # validation error, not a 500
+    finally:
+        await client.close()
+
+
+async def test_support_bundle_disabled_404s():
+    client = await make_client(support_bundle_enabled="false")
+    try:
+        resp = await client.get("/admin/support-bundle",
+                                auth=aiohttp.BasicAuth(*BASIC))
+        assert resp.status == 404
+    finally:
+        await client.close()

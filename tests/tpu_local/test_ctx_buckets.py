@@ -222,7 +222,9 @@ def test_oversized_prompt_behind_blocked_chunker_rejects_cleanly():
             t1 = asyncio.ensure_future(consume(long_prompt, 3))
             t2 = asyncio.ensure_future(consume(long_prompt, 3))
             await asyncio.sleep(0.05)
-            bad = await asyncio.wait_for(consume(oversized, 3), 10.0)
+            # generous bound: this box is 1 vCPU and the suite may share it
+            # with the TPU capture loop — 10 s flaked under that contention
+            bad = await asyncio.wait_for(consume(oversized, 3), 30.0)
             assert bad == []                                  # length-rejected
             out1, out2 = await asyncio.gather(t1, t2)
             assert len(out1) == 3 and out1 == out2            # engine healthy

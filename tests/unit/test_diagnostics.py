@@ -1,0 +1,133 @@
+"""Unit tier: redaction policy + PerformanceTracker.
+
+Reference analogs: `services/performance_tracker.py` (timings /
+percentiles / thresholds / degradation) and the sanitization rules of
+`services/support_bundle_service.py:112-186`.
+"""
+
+import time
+
+from mcp_context_forge_tpu.services.diagnostics_service import (
+    PerformanceTracker,
+)
+from mcp_context_forge_tpu.utils.redact import (
+    REDACTED,
+    redact_env,
+    redact_settings,
+    redact_value,
+)
+
+
+# ---------------------------------------------------------------- redaction
+
+def test_redact_value_name_fragments():
+    assert redact_value("jwt_secret_key", "abc") == REDACTED
+    assert redact_value("basic_auth_password", "x") == REDACTED
+    assert redact_value("some_api_key", "k") == REDACTED
+    assert redact_value("ssl_credential_blob", "c") == REDACTED
+    # empty secrets render empty, not the redaction marker
+    assert redact_value("jwt_secret_key", "") == ""
+
+
+def test_redact_value_token_suffix_only():
+    """*_token is a credential; token_* tuning knobs are not."""
+    assert redact_value("access_token", "tok") == REDACTED
+    assert redact_value("token_expiry", 10080) == 10080
+    assert redact_value("csrf_cookie_name", "csrf_token") == "csrf_token"
+    assert redact_value("token_usage_logging_enabled", True) is True
+
+
+def test_redact_value_dsn_userinfo():
+    out = redact_value("database_url", "postgresql://u:pw@host:5432/db")
+    assert "pw" not in out and out.endswith("@host:5432/db")
+    # URLs without userinfo pass through unchanged
+    assert redact_value("app_domain", "http://localhost:4444") == \
+        "http://localhost:4444"
+
+
+def test_redact_settings_covers_every_field():
+    from mcp_context_forge_tpu.config import Settings
+    rows = redact_settings(Settings())
+    names = {r["name"] for r in rows}
+    assert names == set(Settings.model_fields)
+    by_name = {r["name"]: r["value"] for r in rows}
+    assert by_name["jwt_secret_key"] == REDACTED
+    assert by_name["port"] == 4444
+
+
+def test_redact_env_allowlists_prefixes():
+    env = {
+        "MCPFORGE_PORT": "4444",
+        "MCPFORGE_JWT_SECRET_KEY": "supersecret",
+        "JAX_PLATFORMS": "cpu",
+        "HOME": "/root",                 # not config-shaped: excluded
+        "AWS_SECRET_ACCESS_KEY": "leak"  # excluded by allowlist
+    }
+    out = redact_env(env)
+    assert out["MCPFORGE_PORT"] == "4444"
+    assert out["MCPFORGE_JWT_SECRET_KEY"] == REDACTED
+    assert out["JAX_PLATFORMS"] == "cpu"
+    assert "HOME" not in out and "AWS_SECRET_ACCESS_KEY" not in out
+
+
+# ---------------------------------------------------------------- tracker
+
+def test_tracker_summary_percentiles():
+    t = PerformanceTracker(max_samples=64)
+    for ms in range(1, 101):
+        t.record("db.query", ms / 1000.0)
+    s = t.summary("db.query")["operations"]["db.query"]
+    assert s["count"] == 100
+    assert s["window"] == 64           # bounded ring keeps the recent 64
+    assert s["max_ms"] == 100.0
+    assert s["p50_ms"] > s["avg_ms"] * 0  # present and numeric
+    assert s["p95_ms"] >= s["p50_ms"]
+
+
+def test_tracker_threshold_slow_count_by_prefix():
+    t = PerformanceTracker(thresholds={"db": 0.010})
+    t.record("db.query", 0.002)
+    t.record("db.query", 0.050)        # slow
+    t.record("db.migrate", 0.050)      # class threshold applies by prefix
+    s = t.summary()["operations"]
+    assert s["db.query"]["slow"] == 1
+    assert s["db.migrate"]["slow"] == 1
+
+
+def test_tracker_track_context_manager():
+    t = PerformanceTracker()
+    with t.track("tool.invoke"):
+        time.sleep(0.002)
+    s = t.summary("tool.invoke")["operations"]["tool.invoke"]
+    assert s["count"] == 1 and s["max_ms"] >= 1.0
+
+
+def test_tracker_degradation_split_window():
+    t = PerformanceTracker()
+    for _ in range(8):
+        t.record("http.request", 0.010)
+    for _ in range(8):
+        t.record("http.request", 0.100)
+    verdict = t.degradation("http.request", multiplier=2.0)
+    assert verdict["degraded"] is True
+    assert verdict["recent_avg_ms"] > verdict["baseline_avg_ms"]
+    # steady series is not degraded
+    t2 = PerformanceTracker()
+    for _ in range(16):
+        t2.record("x", 0.010)
+    assert t2.degradation("x")["degraded"] is False
+    # too few samples: explicitly inconclusive
+    t3 = PerformanceTracker()
+    t3.record("y", 1.0)
+    assert t3.degradation("y")["degraded"] is False
+
+
+def test_tracker_clear():
+    t = PerformanceTracker()
+    t.record("a.x", 0.01)
+    t.record("b.y", 0.01)
+    t.clear("a.x")
+    ops = t.summary()["operations"]
+    assert "a.x" not in ops and "b.y" in ops
+    t.clear()
+    assert t.summary()["operations"] == {}
