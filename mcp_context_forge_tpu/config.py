@@ -191,6 +191,27 @@ class Settings(BaseModel):
     hot_cold_hot_cap: int = 50
     hot_cold_hot_window_s: float = 3600.0
     hot_cold_cold_poll_multiplier: int = 5
+    # --- SMTP email notifications (reference smtp_* family +
+    # email_notification_service.py) ---
+    smtp_enabled: bool = False
+    smtp_host: str = ""
+    smtp_port: int = 587
+    smtp_user: str = ""
+    smtp_password: str = ""
+    smtp_from_email: str = "noreply@localhost"
+    smtp_from_name: str = "MCP Gateway"
+    smtp_use_tls: bool = True     # STARTTLS on a plain connection
+    smtp_use_ssl: bool = False    # implicit TLS (SMTPS, port 465)
+    smtp_timeout_seconds: float = 10.0
+    account_lockout_notification_enabled: bool = False
+    team_invitation_email_enabled: bool = True  # only fires when smtp is on
+    # --- password reset (reference password_reset_* family) ---
+    password_reset_enabled: bool = False
+    password_reset_token_expiry_minutes: float = 60.0
+    password_reset_rate_limit: int = 3          # requests per window/email
+    password_reset_rate_window_minutes: float = 60.0
+    password_reset_min_response_ms: float = 100.0  # user-enumeration guard
+    password_reset_invalidate_sessions: bool = True
     # --- chat agent ---
     llmchat_max_steps: int = 6
     # --- CORS detail (reference cors long tail) ---

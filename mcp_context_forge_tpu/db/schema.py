@@ -430,6 +430,25 @@ CREATE INDEX IF NOT EXISTS ix_compliance_reports_generated
   ON compliance_reports(generated_at);
 """
 
+# v8: password reset flow (reference password_reset_* settings family +
+# email_notification_service.py). Only the sha256 of the reset token is
+# stored — a database leak must not yield usable reset links.
+# users.tokens_valid_after: JWTs issued before this instant are rejected
+# (session invalidation on reset, reference
+# password_reset_invalidate_sessions).
+_V8 = """
+CREATE TABLE IF NOT EXISTS password_reset_tokens (
+  token_hash TEXT PRIMARY KEY,
+  user_email TEXT NOT NULL,
+  expires_at REAL NOT NULL,
+  used_at REAL,
+  created_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS ix_prt_email_created
+  ON password_reset_tokens(user_email, created_at);
+ALTER TABLE users ADD COLUMN tokens_valid_after REAL;
+"""
+
 MIGRATIONS: list[Migration] = [
     Migration(1, "initial-core-schema", _V1),
     Migration(2, "a2a-task-store", _V2),
@@ -438,4 +457,5 @@ MIGRATIONS: list[Migration] = [
     Migration(5, "per-entity-metrics", _V5),
     Migration(6, "token-usage-and-password-enforcement", _V6),
     Migration(7, "compliance-reports", _V7),
+    Migration(8, "password-reset-and-session-invalidation", _V8),
 ]

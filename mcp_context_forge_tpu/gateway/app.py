@@ -352,6 +352,10 @@ async def build_app(settings: Settings | None = None) -> web.Application:
                                                 SystemStatsService)
     app["system_stats_service"] = SystemStatsService(ctx)
     app["support_bundle_service"] = SupportBundleService(ctx)
+    from ..services.email_service import EmailNotificationService
+    email_service = EmailNotificationService(ctx)
+    app["email_service"] = email_service
+    ctx.extras["email_service"] = email_service
     if settings.hot_cold_classification_enabled:
         from ..services.classification_service import (
             ServerClassificationService)

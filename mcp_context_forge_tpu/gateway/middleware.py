@@ -21,7 +21,10 @@ from ..services.base import ConflictError, NotFoundError, ValidationFailure
 
 Handler = Callable[[web.Request], Awaitable[web.StreamResponse]]
 
-PUBLIC_PATHS = {"/health", "/ready", "/version", "/auth/login", "/robots.txt"}
+PUBLIC_PATHS = {"/health", "/ready", "/version", "/auth/login", "/robots.txt",
+                # reset flow is pre-auth by nature; both endpoints are
+                # rate-limited + enumeration-hardened in the handlers
+                "/auth/password/reset-request", "/auth/password/reset"}
 
 
 @web.middleware

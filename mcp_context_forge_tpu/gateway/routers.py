@@ -174,6 +174,90 @@ def setup_routes(app: web.Application) -> None:
             body.get("new_password", ""))
         return web.json_response({"status": "changed"})
 
+    @routes.post("/auth/password/reset-request")
+    async def password_reset_request(request: web.Request) -> web.Response:
+        """Start a reset: always 202 with the same body and a minimum
+        response time, whether or not the account exists (reference
+        password_reset_min_response_ms user-enumeration guard)."""
+        import asyncio as _asyncio
+        import time as _time
+        settings = request.app["ctx"].settings
+        if not settings.password_reset_enabled:
+            raise NotFoundError("password reset is disabled")
+        started = _time.monotonic()
+        body = await request.json()
+        email = str(body.get("email", "")).strip().lower()
+        if email:
+            token = await request.app["auth_service"].request_password_reset(
+                email)
+            if token:
+                email_service = request.app.get("email_service")
+                if email_service is not None:
+                    # background send: awaiting SMTP inline would make
+                    # existing accounts answer SLOWER than unknown ones
+                    # (up to smtp_timeout_seconds) — the floor below only
+                    # pads short responses, it cannot cap long ones
+                    tasks = request.app["_token_usage_tasks"]
+                    task = _asyncio.get_running_loop().create_task(
+                        email_service.send_password_reset(
+                            email, token,
+                            settings.password_reset_token_expiry_minutes))
+                    tasks.add(task)
+                    task.add_done_callback(tasks.discard)
+        floor_s = settings.password_reset_min_response_ms / 1e3
+        remaining = floor_s - (_time.monotonic() - started)
+        if remaining > 0:
+            await _asyncio.sleep(remaining)
+        return web.json_response(
+            {"status": "accepted",
+             "detail": "If the account exists, a reset link was sent."},
+            status=202)
+
+    @routes.get("/auth/password/reset")
+    async def password_reset_page(request: web.Request) -> web.Response:
+        """The page the emailed reset link lands on: a minimal form that
+        POSTs the token + new password back to this path. Without it the
+        link in the mail would hit a POST-only JSON endpoint (405)."""
+        if not request.app["ctx"].settings.password_reset_enabled:
+            raise NotFoundError("password reset is disabled")
+        # the token is NEVER interpolated into the page (reflected-XSS
+        # surface); the script reads it from location.search client-side
+        return web.Response(content_type="text/html", text="""<!doctype html>
+<title>Password reset</title>
+<h3>Choose a new password</h3>
+<form id="f"><input type="password" id="p" placeholder="new password"
+  autocomplete="new-password" minlength="8" required>
+<button>Reset</button></form><p id="out"></p>
+<script>
+document.getElementById("f").onsubmit = async (e) => {
+  e.preventDefault();
+  const token = new URLSearchParams(location.search).get("token") || "";
+  const r = await fetch("/auth/password/reset", {method: "POST",
+    headers: {"content-type": "application/json"},
+    body: JSON.stringify({token, new_password:
+      document.getElementById("p").value})});
+  document.getElementById("out").textContent = r.ok
+    ? "Password reset. You can sign in now."
+    : "Reset failed: " + (await r.json()).detail;
+};
+</script>""")
+
+    @routes.post("/auth/password/reset")
+    async def password_reset(request: web.Request) -> web.Response:
+        settings = request.app["ctx"].settings
+        if not settings.password_reset_enabled:
+            raise NotFoundError("password reset is disabled")
+        body = await request.json()
+        email = await request.app["auth_service"].reset_password(
+            str(body.get("token", "")), str(body.get("new_password", "")))
+        email_service = request.app.get("email_service")
+        if email_service is not None:
+            await email_service.send_password_reset_confirmation(email)
+        audit = request.app.get("audit_service")
+        if audit is not None:
+            await audit.record(email, "auth.password_reset")
+        return web.json_response({"status": "reset"})
+
     # ----------------------------------------------------- admin user CRUD
     @routes.post("/admin/users")
     async def create_user(request: web.Request) -> web.Response:

@@ -87,6 +87,9 @@ class AuthService:
         # that must be IMMEDIATE (role grants, membership changes, user
         # toggles, password ops) call invalidate_user()/invalidate_jti().
         self._cache: dict[tuple, tuple[Any, float]] = {}
+        # strong refs to fire-and-forget notification tasks (the event
+        # loop holds only weak ones)
+        self._bg_tasks: set[Any] = set()
 
     # ----------------------------------------------------- resolution cache
 
@@ -239,6 +242,76 @@ class AuthService:
             (_hasher.hash(new_password), now(), email))
         self.invalidate_user(email)
 
+    # ------------------------------------------------------ password reset
+
+    @staticmethod
+    def _reset_token_hash(token: str) -> str:
+        return hashlib.sha256(token.encode()).hexdigest()
+
+    async def request_password_reset(self, email: str) -> str | None:
+        """Issue a reset token for a local active account.
+
+        Returns the raw token when one was issued, else None — the CALLER
+        must answer identically either way (user-enumeration guard,
+        reference password_reset_min_response_ms posture). Rate limited
+        per email by counting tokens issued inside the window.
+        """
+        settings = self.ctx.settings
+        row = await self.ctx.db.fetchone(
+            "SELECT auth_provider FROM users WHERE email=? AND is_active=1",
+            (email,))
+        if not row or row["auth_provider"] != "local":
+            return None  # SSO accounts reset upstream
+        window_start = now() - settings.password_reset_rate_window_minutes * 60
+        issued = await self.ctx.db.fetchone(
+            "SELECT COUNT(*) AS n FROM password_reset_tokens"
+            " WHERE user_email=? AND created_at > ?", (email, window_start))
+        if issued and issued["n"] >= settings.password_reset_rate_limit:
+            return None
+        import secrets
+        token = secrets.token_urlsafe(32)
+        expires = now() + settings.password_reset_token_expiry_minutes * 60
+        await self.ctx.db.execute(
+            "INSERT INTO password_reset_tokens (token_hash, user_email,"
+            " expires_at, created_at) VALUES (?,?,?,?)",
+            (self._reset_token_hash(token), email, expires, now()))
+        # expired rows are dead weight; prune opportunistically
+        await self.ctx.db.execute(
+            "DELETE FROM password_reset_tokens WHERE expires_at < ?",
+            (now() - 86400,))
+        return token
+
+    async def reset_password(self, token: str, new_password: str) -> str:
+        """Consume a reset token; returns the account email.
+
+        Single-use, expiring; on success the lockout state clears and —
+        when password_reset_invalidate_sessions is on — every JWT issued
+        before this instant stops validating (users.tokens_valid_after
+        checked against the token's iat in resolve_bearer)."""
+        row = await self.ctx.db.fetchone(
+            "SELECT * FROM password_reset_tokens WHERE token_hash=?",
+            (self._reset_token_hash(token),))
+        if not row or row["used_at"] or row["expires_at"] < now():
+            raise AuthError("Invalid or expired reset token")
+        email = row["user_email"]
+        self.validate_password_policy(new_password, email)
+        invalidate = self.ctx.settings.password_reset_invalidate_sessions
+        await self.ctx.db.transaction([
+            ("UPDATE password_reset_tokens SET used_at=? WHERE token_hash=?",
+             (now(), row["token_hash"])),
+            ("UPDATE users SET password_hash=?, failed_login_attempts=0,"
+             " locked_until=NULL, password_change_required=0, updated_at=?"
+             + (", tokens_valid_after=?" if invalidate else "")
+             + " WHERE email=?",
+             # the cutoff is floored to whole seconds: JWT iat has 1 s
+             # resolution, and a session minted in the same second AFTER
+             # the reset must not be killed by the sub-second fraction
+             (_hasher.hash(new_password), now(),
+              *((float(int(now())),) if invalidate else ()), email)),
+        ])
+        self.invalidate_user(email)
+        return email
+
     async def verify_password(self, email: str, password: str) -> bool:
         row = await self.ctx.db.fetchone("SELECT * FROM users WHERE email=? AND is_active=1",
                                          (email,))
@@ -269,6 +342,19 @@ class AuthService:
             await self.ctx.db.execute(
                 "UPDATE users SET failed_login_attempts=?, locked_until=? WHERE email=?",
                 (attempts, locked_until, email))
+            email_service = self.ctx.extras.get("email_service")
+            if (locked_until is not None and email_service is not None
+                    and settings.account_lockout_notification_enabled):
+                # fire-and-forget: the mail must not delay the 401 (the
+                # lockout response time is itself a probe-visible signal).
+                # The set holds a strong reference — the loop alone keeps
+                # only a weak one and GC could drop the pending task
+                import asyncio
+                task = asyncio.get_running_loop().create_task(
+                    email_service.send_account_lockout(
+                        email, settings.auth_lockout_seconds / 60))
+                self._bg_tasks.add(task)
+                task.add_done_callback(self._bg_tasks.discard)
             return False
 
     async def user_teams(self, email: str) -> list[str]:
@@ -396,14 +482,24 @@ class AuthService:
         user_row = self._cache_get(("user", email))
         if user_row is None:
             user_row = await self.ctx.db.fetchone(
-                "SELECT is_admin, is_active, password_change_required"
-                " FROM users WHERE email=?", (email,))
+                "SELECT is_admin, is_active, password_change_required,"
+                " tokens_valid_after FROM users WHERE email=?", (email,))
             self._cache_put(("user", email), user_row or {},
                             self.ctx.settings.auth_cache_user_ttl)
         elif user_row == {}:
             user_row = None
         if user_row and not user_row["is_active"]:
             raise AuthError("User deactivated")
+        # .get(): the ("user", email) cache key is shared with resolve_basic,
+        # whose row does not carry this column (basic auth re-proves the
+        # password every request, so it has no session to invalidate)
+        if user_row and user_row.get("tokens_valid_after"):
+            # password reset invalidated all prior sessions: any JWT minted
+            # before the reset instant is dead (iat is always set by
+            # utils.jwt.create_token)
+            iat = payload.get("iat")
+            if iat is not None and iat < user_row["tokens_valid_after"]:
+                raise AuthError("Token invalidated by password reset")
         is_admin = bool(user_row and user_row["is_admin"])
         teams = await self.user_teams(email)
         scopes = payload.get("scopes")
@@ -441,9 +537,13 @@ class AuthService:
             # be a no-op for the very account it exists to rotate)
             row = self._cache_get(("user", settings.platform_admin_email))
             if row is None:
+                # same column set as resolve_bearer: both paths write the
+                # shared ("user", email) cache key, and a row missing
+                # tokens_valid_after would silently skip the post-reset
+                # session-invalidation check for a full cache TTL
                 row = await self.ctx.db.fetchone(
-                    "SELECT is_admin, is_active, password_change_required"
-                    " FROM users WHERE email=?",
+                    "SELECT is_admin, is_active, password_change_required,"
+                    " tokens_valid_after FROM users WHERE email=?",
                     (settings.platform_admin_email,)) or {}
                 self._cache_put(("user", settings.platform_admin_email),
                                 row, settings.auth_cache_user_ttl)

@@ -161,7 +161,7 @@ class TeamService:
             expires_hours = settings.invitation_expiry_hours
         if not is_admin and not await self._is_owner(team_id, actor):
             raise ValidationFailure("Only team owners can invite")
-        await self.get_team(team_id)
+        team = await self.get_team(team_id)  # also the existence check
         token = secrets.token_urlsafe(24)
         invitation_id = new_id()
         await self.ctx.db.execute(
@@ -169,6 +169,12 @@ class TeamService:
             " invited_by, expires_at, created_at) VALUES (?,?,?,?,?,?,?,?)",
             (invitation_id, team_id, email, role, token, actor,
              now() + expires_hours * 3600, now()))
+        email_service = self.ctx.extras.get("email_service")
+        if (email_service is not None
+                and settings.team_invitation_email_enabled):
+            # fail-open: invitation mail must never fail the invite itself
+            await email_service.send_team_invitation(
+                email, team["name"], actor, token)
         return {"id": invitation_id, "token": token, "team_id": team_id,
                 "email": email, "role": role}
 

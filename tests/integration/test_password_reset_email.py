@@ -1,0 +1,289 @@
+"""Password reset flow + SMTP email notifications (reference
+password_reset_* settings family, services/email_notification_service.py,
+smtp_* config). Delivery is tested against a real in-test SMTP server
+speaking enough of RFC 5321 for smtplib to hand over a message."""
+
+import asyncio
+import time
+
+import aiohttp
+
+from test_gateway_app import BASIC, make_client
+
+ADMIN_EMAIL = "admin@example.com"
+
+
+# ----------------------------------------------------------- smtp test stub
+
+class SmtpStub:
+    """Accepts one SMTP conversation at a time; records (from, to, data)."""
+
+    def __init__(self) -> None:
+        self.messages: list[dict] = []
+        self._server: asyncio.AbstractServer | None = None
+        self.port = 0
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, "127.0.0.1", 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        msg = {"from": "", "to": [], "data": ""}
+
+        async def say(line: str) -> None:
+            writer.write((line + "\r\n").encode())
+            await writer.drain()
+
+        await say("220 smtp-stub ready")
+        while True:
+            raw = await reader.readline()
+            if not raw:
+                break
+            line = raw.decode().strip()
+            verb = line.split(":", 1)[0].split(" ", 1)[0].upper()
+            if verb in ("EHLO", "HELO"):
+                await say("250 smtp-stub")
+            elif verb == "MAIL":
+                msg["from"] = line.split(":", 1)[1].strip()
+                await say("250 ok")
+            elif verb == "RCPT":
+                msg["to"].append(line.split(":", 1)[1].strip())
+                await say("250 ok")
+            elif verb == "DATA":
+                await say("354 go ahead")
+                body = []
+                while True:
+                    data_line = await reader.readline()
+                    if data_line.strip() == b".":
+                        break
+                    body.append(data_line.decode())
+                msg["data"] = "".join(body)
+                self.messages.append(dict(msg))
+                msg = {"from": "", "to": [], "data": ""}
+                await say("250 accepted")
+            elif verb == "QUIT":
+                await say("221 bye")
+                break
+            else:
+                await say("250 ok")
+        writer.close()
+
+
+async def make_smtp_client(**overrides):
+    stub = SmtpStub()
+    await stub.start()
+    kwargs = {"smtp_enabled": "true", "smtp_host": "127.0.0.1",
+              "smtp_port": str(stub.port), "smtp_use_tls": "false",
+              "password_reset_enabled": "true",
+              "password_reset_min_response_ms": "0", **overrides}
+    client = await make_client(**kwargs)
+    return client, stub
+
+
+async def _wait_mail(stub, n: int, timeout_s: float = 5.0) -> None:
+    """Reset mails are sent in a background task AFTER the 202 (the
+    inline await leaked account existence through response timing)."""
+    deadline = time.monotonic() + timeout_s
+    while len(stub.messages) < n and time.monotonic() < deadline:
+        await asyncio.sleep(0.02)
+    assert len(stub.messages) >= n, f"expected {n} mails, got {len(stub.messages)}"
+
+
+def _mail_body(mail: dict) -> str:
+    """Decode the MIME payload (set_content line-wraps long URLs with
+    quoted-printable soft breaks, so raw-data regexes mangle tokens)."""
+    import email as _email
+    msg = _email.message_from_string(mail["data"])
+    return msg.get_payload(decode=True).decode()
+
+
+# ----------------------------------------------------------------- the flow
+
+async def test_reset_flow_end_to_end_with_real_smtp():
+    client, stub = await make_smtp_client()
+    try:
+        resp = await client.post("/auth/password/reset-request",
+                                 json={"email": ADMIN_EMAIL})
+        assert resp.status == 202
+        # the mail went over a real TCP SMTP conversation (background task)
+        await _wait_mail(stub, 1)
+        mail = stub.messages[0]
+        assert ADMIN_EMAIL in mail["to"][0]
+        body = _mail_body(mail)
+        assert "/auth/password/reset?token=" in body
+        token = body.split("token=", 1)[1].split()[0].strip()
+
+        resp = await client.post("/auth/password/reset", json={
+            "token": token, "new_password": "Rook!Garnet2026zz"})
+        assert resp.status == 200
+        # the confirmation mail also went out
+        assert len(stub.messages) == 2
+
+        # old password dead, new password lives
+        resp = await client.post("/auth/login", json={
+            "email": ADMIN_EMAIL, "password": BASIC[1]})
+        assert resp.status == 401
+        resp = await client.post("/auth/login", json={
+            "email": ADMIN_EMAIL, "password": "Rook!Garnet2026zz"})
+        assert resp.status == 200
+
+        # single use: the same token cannot reset again
+        resp = await client.post("/auth/password/reset", json={
+            "token": token, "new_password": "Other!Jasper2026zz"})
+        assert resp.status == 401
+    finally:
+        await client.close()
+        await stub.stop()
+
+
+async def test_reset_invalidates_prior_sessions():
+    client, stub = await make_smtp_client()
+    try:
+        resp = await client.post("/auth/login", json={
+            "email": ADMIN_EMAIL, "password": BASIC[1]})
+        jwt_before = (await resp.json())["access_token"]
+        hdr = {"authorization": f"Bearer {jwt_before}"}
+        assert (await client.get("/tools", headers=hdr)).status == 200
+
+        # iat has 1 s resolution: the reset must land in a LATER second
+        await asyncio.sleep(1.1)
+        await client.post("/auth/password/reset-request",
+                          json={"email": ADMIN_EMAIL})
+        await _wait_mail(stub, 1)
+        token = _mail_body(stub.messages[0]).split("token=", 1)[1].split()[0]
+        await client.post("/auth/password/reset", json={
+            "token": token, "new_password": "Rook!Garnet2026zz"})
+
+        resp = await client.get("/tools", headers=hdr)
+        assert resp.status == 401  # pre-reset JWT is dead
+
+        resp = await client.post("/auth/login", json={
+            "email": ADMIN_EMAIL, "password": "Rook!Garnet2026zz"})
+        jwt_after = (await resp.json())["access_token"]
+        resp = await client.get(
+            "/tools", headers={"authorization": f"Bearer {jwt_after}"})
+        assert resp.status == 200  # post-reset JWT lives
+    finally:
+        await client.close()
+        await stub.stop()
+
+
+async def test_reset_request_is_enumeration_safe():
+    client, stub = await make_smtp_client(
+        password_reset_min_response_ms="80")
+    try:
+        bodies = []
+        for email in (ADMIN_EMAIL, "ghost@nowhere.example"):
+            started = time.monotonic()
+            resp = await client.post("/auth/password/reset-request",
+                                     json={"email": email})
+            elapsed = time.monotonic() - started
+            assert resp.status == 202
+            assert elapsed >= 0.08  # both paths honor the response floor
+            bodies.append(await resp.text())
+        assert bodies[0] == bodies[1]  # byte-identical answers
+        await _wait_mail(stub, 1)
+        assert len(stub.messages) == 1  # but only the real account got mail
+    finally:
+        await client.close()
+        await stub.stop()
+
+
+async def test_reset_request_rate_limited_per_email():
+    client, stub = await make_smtp_client(password_reset_rate_limit="2")
+    try:
+        for _ in range(4):
+            resp = await client.post("/auth/password/reset-request",
+                                     json={"email": ADMIN_EMAIL})
+            assert resp.status == 202  # externally identical
+        await _wait_mail(stub, 2)
+        assert len(stub.messages) == 2  # but only 2 tokens were issued
+    finally:
+        await client.close()
+        await stub.stop()
+
+
+async def test_reset_disabled_404s_and_expired_token_rejected():
+    client = await make_client()
+    try:
+        resp = await client.post("/auth/password/reset-request",
+                                 json={"email": ADMIN_EMAIL})
+        assert resp.status == 404
+    finally:
+        await client.close()
+
+    client, stub = await make_smtp_client(
+        password_reset_token_expiry_minutes="0")
+    try:
+        token = await client.app["auth_service"].request_password_reset(
+            ADMIN_EMAIL)
+        assert token
+        await asyncio.sleep(0.01)  # 0-minute expiry: already stale
+        resp = await client.post("/auth/password/reset", json={
+            "token": token, "new_password": "Rook!Garnet2026zz"})
+        assert resp.status == 401
+    finally:
+        await client.close()
+        await stub.stop()
+
+
+async def test_reset_landing_page_never_reflects_the_token():
+    client, stub = await make_smtp_client()
+    try:
+        resp = await client.get(
+            "/auth/password/reset?token=SENTINEL<script>alert(1)</script>")
+        assert resp.status == 200
+        page = await resp.text()
+        # the page reads the token client-side from location.search — the
+        # server must never interpolate it (reflected-XSS surface)
+        assert "SENTINEL" not in page
+        assert 'fetch("/auth/password/reset"' in page
+    finally:
+        await client.close()
+        await stub.stop()
+
+
+async def test_lockout_sends_notification_mail():
+    client, stub = await make_smtp_client(
+        account_lockout_notification_enabled="true",
+        auth_max_failed_attempts="2")
+    try:
+        for _ in range(2):
+            resp = await client.post("/auth/login", json={
+                "email": ADMIN_EMAIL, "password": "wrong-pass-xx"})
+            assert resp.status == 401
+        # the mail is fire-and-forget; give the executor a beat
+        for _ in range(50):
+            if stub.messages:
+                break
+            await asyncio.sleep(0.05)
+        assert stub.messages, "lockout mail never arrived"
+        assert "locked" in _mail_body(stub.messages[0]).lower()
+    finally:
+        await client.close()
+        await stub.stop()
+
+
+async def test_team_invitation_sends_mail():
+    client, stub = await make_smtp_client()
+    try:
+        resp = await client.post("/teams", json={"name": "mailteam"},
+                                 auth=aiohttp.BasicAuth(*BASIC))
+        assert resp.status == 201
+        team_id = (await resp.json())["id"]
+        resp = await client.post(f"/teams/{team_id}/invitations",
+                                 json={"email": "newbie@x.com"},
+                                 auth=aiohttp.BasicAuth(*BASIC))
+        assert resp.status in (200, 201)
+        assert stub.messages
+        assert "Invitation token:" in _mail_body(stub.messages[-1])
+    finally:
+        await client.close()
+        await stub.stop()
