@@ -232,6 +232,24 @@ class Settings(BaseModel):
     # --- pagination (reference pagination_* family) ---
     pagination_default_page_size: int = 50
     pagination_max_page_size: int = 500
+    pagination_min_page_size: int = 1
+    pagination_include_links: bool = False  # RFC 8288-style next link
+    # --- SSRF guard for catalog URLs (reference ssrf_* family) ---
+    ssrf_protection_enabled: bool = False  # off: localhost upstreams are
+                                           # the common single-host posture
+    ssrf_allow_localhost: bool = True
+    ssrf_allow_private_networks: bool = True
+    ssrf_blocked_hosts_csv: str = ""
+    ssrf_allowed_networks_csv: str = ""    # explicit allow beats all blocks
+    ssrf_blocked_networks_csv: str = ""
+    ssrf_dns_fail_closed: bool = True
+    # --- file logging + rotation (reference log_to_file/log_rotation_*) ---
+    log_to_file: bool = False
+    log_folder: str = "logs"
+    log_file: str = "mcpforge.log"
+    log_rotation_enabled: bool = False
+    log_max_size_mb: float = 1.0
+    log_backup_count: int = 5
 
     # --- outbound invocation ---
     tool_timeout: float = 60.0
@@ -248,6 +266,8 @@ class Settings(BaseModel):
     gateway_failure_threshold: int = 3
     max_concurrent_health_checks: int = 10  # health-loop fan-out bound
     federation_timeout: float = 30.0
+    # wizard dry-run probe bound (reference gateway_validation_timeout)
+    gateway_validation_timeout: float = 10.0
     skip_ssl_verify: bool = False
     # outbound HTTP pool shaping (reference httpx_* family)
     http_max_connections: int = 512

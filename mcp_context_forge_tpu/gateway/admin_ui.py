@@ -110,7 +110,8 @@ const TABS = {
              rowacts: [{label:"gen cases", method:"GET", key:"name", show:true, url: n => `/toolops/${encodeURIComponent(n)}/cases`},
                        {label:"run cases", method:"POST", key:"name", show:true, url: n => `/toolops/${encodeURIComponent(n)}/run`}]},
   gateways: {paged:true, url: "/gateways?include_inactive=true", cols: ["name","url","transport","state","reachable"], boolcols: ["reachable"],
-             create: {url:"/gateways", fields:["name","url","transport"]},
+             create: {url:"/gateways", fields:["name","url","transport"],
+                      testurl: "/gateways/test"},
              edit: id => `/gateways/${id}`, del: id => `/gateways/${id}`,
              detail: id => `/gateways/${id}`,
              rowacts: [{label:"resync", method:"POST", url: id => `/gateways/${id}/refresh`}]},
@@ -522,7 +523,32 @@ function openForm(){
   f.style.display = "block";
   f.innerHTML = `<b>new ${esc(current)}</b><br>` + t.create.fields.map(x =>
     `<input id="f-${x.split(":")[0]}" placeholder="${x}">`).join("")
-    + `<button class="act" onclick="submitForm()">create</button>`;
+    + (t.create.testurl
+       ? `<button class="act" onclick="testForm()">test connection</button>`
+       : "")
+    + `<button class="act" onclick="submitForm()">create</button>`
+    + `<span id="f-probe"></span>`;
+}
+async function testForm(){
+  // wizard step: dry-run the connectivity probe before committing
+  const t = TABS[current];
+  const body = {};
+  for (const spec of t.create.fields){
+    const x = spec.split(":")[0];
+    const el = document.getElementById("f-" + x);
+    if (el && el.value) body[x] = el.value;
+  }
+  const probe = document.getElementById("f-probe");
+  probe.textContent = "probing…";
+  const r = await fetch(t.create.testurl, {method: "POST",
+    headers: {"content-type": "application/json"},
+    body: JSON.stringify(body)});
+  if (!r.ok){ probe.textContent = "probe failed: " + r.status; return; }
+  const d = await r.json();
+  probe.innerHTML = d.ok
+    ? `<span class="pill ok">reachable</span> ${cell(d.latency_ms)}ms, `
+      + `${cell(d.tool_count)} tools, caps: ${esc((d.capabilities||[]).join(", "))}`
+    : `<span class="pill bad">unreachable</span> ${esc(d.error||"")}`;
 }
 async function submitForm(){
   const t = TABS[current];

@@ -8,6 +8,7 @@ Same ordering here via aiohttp cleanup contexts.
 from __future__ import annotations
 
 import logging
+from pathlib import Path
 from typing import AsyncIterator
 
 from aiohttp import web
@@ -37,7 +38,13 @@ logger = logging.getLogger(__name__)
 async def build_app(settings: Settings | None = None) -> web.Application:
     settings = settings or get_settings()
     init_logging(settings.log_level, settings.log_json,
-                 buffer_capacity=settings.log_buffer_capacity)
+                 buffer_capacity=settings.log_buffer_capacity,
+                 file_path=(str(Path(settings.log_folder)
+                                / settings.log_file)
+                            if settings.log_to_file else None),
+                 rotation=settings.log_rotation_enabled,
+                 max_mb=settings.log_max_size_mb,
+                 backup_count=settings.log_backup_count)
 
     problems = settings.validate_security()
     if problems:

@@ -65,9 +65,11 @@ def paginate(request: web.Request, items: Sequence[Any],
             return f"{label}\x00{getattr(item, 'id', '')}"
     settings = request.app["ctx"].settings
     max_page = settings.pagination_max_page_size or MAX_PAGE
+    min_page = max(1, settings.pagination_min_page_size)
     try:
-        limit = max(1, min(int(limit_q or settings.pagination_default_page_size),
-                           max_page))
+        limit = max(min_page,
+                    min(int(limit_q or settings.pagination_default_page_size),
+                        max_page))
     except ValueError as exc:
         raise ValidationFailure(f"Invalid limit: {limit_q!r}") from exc
     ordered = sorted(items, key=lambda item: str(key(item)))
@@ -80,9 +82,22 @@ def paginate(request: web.Request, items: Sequence[Any],
             start += 1
     page = ordered[start:start + limit]
     more = start + limit < len(ordered)
-    return web.json_response({
+    next_cursor = (encode_cursor(str(key(page[-1])))
+                   if more and page else None)
+    body = {
         "items": dump(page),
-        "next_cursor": encode_cursor(str(key(page[-1])))
-        if more and page else None,
+        "next_cursor": next_cursor,
         "total": len(ordered),
-    })
+    }
+    if settings.pagination_include_links:
+        # RFC 8288-style affordance (reference pagination_include_links):
+        # clients follow `links.next` instead of assembling the query
+        from yarl import URL
+        body["links"] = {
+            "self": str(request.rel_url),
+            "next": (str(URL(request.rel_url.path).with_query(
+                {**request.query, "cursor": next_cursor,
+                 "limit": str(limit)}))
+                     if next_cursor else None),
+        }
+    return web.json_response(body)

@@ -252,7 +252,13 @@ document.getElementById("f").onsubmit = async (e) => {
             str(body.get("token", "")), str(body.get("new_password", "")))
         email_service = request.app.get("email_service")
         if email_service is not None:
-            await email_service.send_password_reset_confirmation(email)
+            # background: the just-reset user must not wait out a slow MX
+            import asyncio as _asyncio
+            tasks = request.app["_token_usage_tasks"]
+            task = _asyncio.get_running_loop().create_task(
+                email_service.send_password_reset_confirmation(email))
+            tasks.add(task)
+            task.add_done_callback(tasks.discard)
         audit = request.app.get("audit_service")
         if audit is not None:
             await audit.record(email, "auth.password_reset")
@@ -385,6 +391,19 @@ document.getElementById("f").onsubmit = async (e) => {
         gw = await _body(request, GatewayCreate)
         created = await request.app["gateway_service"].register_gateway(gw)
         return web.json_response(_dump(created), status=201)
+
+    @routes.post("/gateways/test")
+    async def test_gateway(request: web.Request) -> web.Response:
+        """Registration-wizard dry run: probe a peer before persisting
+        it (reference admin gateway connectivity test)."""
+        request["auth"].require("gateways.create")
+        body = await request.json()
+        result = await request.app["gateway_service"].test_gateway(
+            str(body.get("url", "")),
+            transport=str(body.get("transport") or "streamablehttp"),
+            auth_type=body.get("auth_type"),
+            auth_value=body.get("auth_value"))
+        return web.json_response(result)
 
     @routes.get("/gateways/{gateway_id}")
     async def get_gateway(request: web.Request) -> web.Response:

@@ -58,9 +58,18 @@ class RingBufferHandler(logging.Handler):
 
 ring_buffer = RingBufferHandler()
 
+# the one file sink this module manages (see init_logging)
+_file_handler: logging.FileHandler | None = None
+
 
 def init_logging(level: str = "INFO", as_json: bool = False,
-                 buffer_capacity: int | None = None) -> None:
+                 buffer_capacity: int | None = None,
+                 file_path: str | None = None,
+                 rotation: bool = False, max_mb: float = 1.0,
+                 backup_count: int = 5) -> None:
+    """Root logging: ring buffer (admin /admin/logs + support bundle),
+    stream, and — when ``file_path`` is set — a file sink with optional
+    size rotation (reference log_to_file/log_rotation_* family)."""
     root = logging.getLogger()
     root.setLevel(level.upper())
     if buffer_capacity and buffer_capacity != ring_buffer.records.maxlen:
@@ -73,8 +82,27 @@ def init_logging(level: str = "INFO", as_json: bool = False,
     if stream is None:
         stream = logging.StreamHandler()
         root.addHandler(stream)
-    if as_json:
-        stream.setFormatter(JsonFormatter())
-    else:
-        stream.setFormatter(logging.Formatter(
-            "%(asctime)s %(levelname)-7s %(name)s: %(message)s"))
+    formatter: logging.Formatter = (JsonFormatter() if as_json
+                                    else logging.Formatter(
+        "%(asctime)s %(levelname)-7s %(name)s: %(message)s"))
+    stream.setFormatter(formatter)
+    # the file sink is fully re-created on every init: the root logger is
+    # process-global, so an app built with log_to_file=false (or changed
+    # rotation params) must DROP the sink a previous init attached
+    global _file_handler
+    if _file_handler is not None:
+        root.removeHandler(_file_handler)
+        _file_handler.close()
+        _file_handler = None
+    if file_path:
+        import os
+        os.makedirs(os.path.dirname(file_path) or ".", exist_ok=True)
+        if rotation:
+            from logging.handlers import RotatingFileHandler
+            _file_handler = RotatingFileHandler(
+                file_path, maxBytes=int(max_mb * 1024 * 1024),
+                backupCount=backup_count)
+        else:
+            _file_handler = logging.FileHandler(file_path)
+        _file_handler.setFormatter(formatter)
+        root.addHandler(_file_handler)

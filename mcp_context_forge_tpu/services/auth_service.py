@@ -295,20 +295,27 @@ class AuthService:
             raise AuthError("Invalid or expired reset token")
         email = row["user_email"]
         self.validate_password_policy(new_password, email)
+        # atomic claim: the conditional UPDATE is the single-use gate —
+        # two concurrent resets with the same token both pass the SELECT
+        # above, but only one RETURNING row exists (the db serializes
+        # writes on one connection)
+        claimed = await self.ctx.db.execute(
+            "UPDATE password_reset_tokens SET used_at=?"
+            " WHERE token_hash=? AND used_at IS NULL RETURNING token_hash",
+            (now(), row["token_hash"]))
+        if not claimed:
+            raise AuthError("Invalid or expired reset token")
         invalidate = self.ctx.settings.password_reset_invalidate_sessions
-        await self.ctx.db.transaction([
-            ("UPDATE password_reset_tokens SET used_at=? WHERE token_hash=?",
-             (now(), row["token_hash"])),
-            ("UPDATE users SET password_hash=?, failed_login_attempts=0,"
-             " locked_until=NULL, password_change_required=0, updated_at=?"
-             + (", tokens_valid_after=?" if invalidate else "")
-             + " WHERE email=?",
-             # the cutoff is floored to whole seconds: JWT iat has 1 s
-             # resolution, and a session minted in the same second AFTER
-             # the reset must not be killed by the sub-second fraction
-             (_hasher.hash(new_password), now(),
-              *((float(int(now())),) if invalidate else ()), email)),
-        ])
+        await self.ctx.db.execute(
+            "UPDATE users SET password_hash=?, failed_login_attempts=0,"
+            " locked_until=NULL, password_change_required=0, updated_at=?"
+            + (", tokens_valid_after=?" if invalidate else "")
+            + " WHERE email=?",
+            # the cutoff is floored to whole seconds: JWT iat has 1 s
+            # resolution, and a session minted in the same second AFTER
+            # the reset must not be killed by the sub-second fraction
+            (_hasher.hash(new_password), now(),
+             *((float(int(now())),) if invalidate else ()), email))
         self.invalidate_user(email)
         return email
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Any
 
 from ..clients.mcp_client import MCPSession
@@ -53,6 +54,8 @@ class GatewayService:
             "SELECT id FROM gateways WHERE name=? OR url=?", (gw.name, gw.url))
         if existing:
             raise ConflictError(f"Gateway {gw.name!r} (or URL) already registered")
+        from ..utils.ssrf import ensure_url_allowed
+        await ensure_url_allowed(self.ctx.settings, gw.url)
         gid = new_id()
         ts = now()
         auth_value = (encrypt_field(gw.auth_value, self.ctx.settings.auth_encryption_secret)
@@ -89,6 +92,9 @@ class GatewayService:
         if not row:
             raise NotFoundError(f"Gateway {gateway_id} not found")
         fields = update.model_dump(exclude_unset=True)
+        if fields.get("url"):
+            from ..utils.ssrf import ensure_url_allowed
+            await ensure_url_allowed(self.ctx.settings, fields["url"])
         sets, params = [], []
         for key, value in fields.items():
             if key == "auth_value" and value is not None:
@@ -114,6 +120,46 @@ class GatewayService:
         await self.ctx.bus.publish("gateways.changed", {"action": "delete", "id": gateway_id})
 
     # ------------------------------------------------------- connect + sync
+
+    async def test_gateway(self, url: str, transport: str = "streamablehttp",
+                           auth_type: str | None = None,
+                           auth_value: str | None = None) -> dict[str, Any]:
+        """Dry-run connectivity probe for the registration wizard: connect
+        + initialize + count tools WITHOUT persisting anything (reference
+        admin 'test gateway' + gateway_validation_timeout). Always returns
+        a result dict — failures are data, not exceptions, so the UI can
+        show them inline before the operator commits the registration."""
+        if not url.lower().startswith(("http://", "https://")):
+            return {"ok": False, "error": "URL must be http(s)"}
+        from ..services.base import ValidationFailure
+        from ..utils.ssrf import ensure_url_allowed
+        try:
+            await ensure_url_allowed(self.ctx.settings, url)
+        except ValidationFailure as exc:
+            return {"ok": False, "error": str(exc)}
+        row = {"url": url, "transport": transport, "auth_type": auth_type,
+               "auth_value": (encrypt_field(
+                   auth_value, self.ctx.settings.auth_encryption_secret)
+                   if auth_value else None),
+               "passthrough_headers": None, "id": "", "name": "(test)"}
+        started = time.monotonic()
+        try:
+            async with asyncio.timeout(
+                    self.ctx.settings.gateway_validation_timeout):
+                async with await self._connect(row) as session:
+                    tools = await session.list_tools()
+                    return {
+                        "ok": True,
+                        "latency_ms": round(
+                            (time.monotonic() - started) * 1000, 1),
+                        "server_info": session.server_info,
+                        "capabilities": sorted(session.capabilities),
+                        "tool_count": len(tools),
+                    }
+        except Exception as exc:
+            return {"ok": False,
+                    "latency_ms": round((time.monotonic() - started) * 1000, 1),
+                    "error": f"{type(exc).__name__}: {exc}"}
 
     async def _connect(self, row: dict[str, Any]) -> MCPSession:
         from .tool_service import resolve_auth_headers

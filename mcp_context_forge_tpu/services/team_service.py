@@ -17,6 +17,8 @@ from .base import AppContext, ConflictError, NotFoundError, ValidationFailure, n
 class TeamService:
     def __init__(self, ctx: AppContext):
         self.ctx = ctx
+        # strong refs for fire-and-forget mails (loop holds weak refs only)
+        self._bg_tasks: set = set()
 
     def _invalidate_auth(self, email: str) -> None:
         """Membership changes must hit the NEXT request: bust the auth
@@ -172,9 +174,15 @@ class TeamService:
         email_service = self.ctx.extras.get("email_service")
         if (email_service is not None
                 and settings.team_invitation_email_enabled):
-            # fail-open: invitation mail must never fail the invite itself
-            await email_service.send_team_invitation(
-                email, team["name"], actor, token)
+            # background + fail-open: the invite API must not stall for
+            # smtp_timeout_seconds on a slow MX, and mail failure must
+            # never fail the invite itself
+            import asyncio
+            task = asyncio.get_running_loop().create_task(
+                email_service.send_team_invitation(
+                    email, team["name"], actor, token))
+            self._bg_tasks.add(task)
+            task.add_done_callback(self._bg_tasks.discard)
         return {"id": invitation_id, "token": token, "team_id": team_id,
                 "email": email, "role": role}
 

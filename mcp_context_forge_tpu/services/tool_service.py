@@ -72,6 +72,9 @@ class ToolService:
         )
         if row:
             raise ConflictError(f"Tool {tool.name!r} already exists")
+        if tool.url:
+            from ..utils.ssrf import ensure_url_allowed
+            await ensure_url_allowed(self.ctx.settings, tool.url)
         tid = new_id()
         ts = now()
         auth_value = (
@@ -125,6 +128,9 @@ class ToolService:
         if not row:
             raise NotFoundError(f"Tool {tool_id} not found")
         fields = update.model_dump(exclude_unset=True)
+        if fields.get("url"):
+            from ..utils.ssrf import ensure_url_allowed
+            await ensure_url_allowed(self.ctx.settings, fields["url"])
         sets, params = [], []
         for key, value in fields.items():
             if key == "auth_value" and value is not None:

@@ -1,0 +1,139 @@
+"""Round-5 config families, pass 3a: SSRF guard, file logging +
+rotation, pagination floor/links. Every field flips observable
+behavior (the config-breadth bar: wired, not just declared)."""
+
+import logging
+import os
+
+import aiohttp
+import pytest
+
+from mcp_context_forge_tpu.utils.ssrf import ensure_url_allowed
+from mcp_context_forge_tpu.services.base import ValidationFailure
+from test_gateway_app import BASIC, make_client
+
+
+# ------------------------------------------------------------------- ssrf
+
+def _settings(**kw):
+    from mcp_context_forge_tpu.config import load_settings
+    env = {"MCPFORGE_SSRF_PROTECTION_ENABLED": "true",
+           **{f"MCPFORGE_{k.upper()}": v for k, v in kw.items()}}
+    return load_settings(env=env, env_file=None)
+
+
+async def test_ssrf_disabled_is_noop():
+    from mcp_context_forge_tpu.config import load_settings
+    settings = load_settings(env={}, env_file=None)
+    await ensure_url_allowed(settings, "http://127.0.0.1:1/x")  # no raise
+
+
+async def test_ssrf_blocks_loopback_and_private_when_told():
+    settings = _settings(ssrf_allow_localhost="false",
+                         ssrf_allow_private_networks="false")
+    with pytest.raises(ValidationFailure, match="loopback"):
+        await ensure_url_allowed(settings, "http://127.0.0.1:8080/x")
+    with pytest.raises(ValidationFailure, match="private"):
+        await ensure_url_allowed(settings, "http://10.1.2.3/x")
+    with pytest.raises(ValidationFailure, match="scheme"):
+        await ensure_url_allowed(settings, "gopher://example.com/")
+    # public addresses pass
+    await ensure_url_allowed(settings, "http://93.184.216.34/x")
+
+
+async def test_ssrf_allowlist_beats_blocks_and_blocklist_wins():
+    settings = _settings(ssrf_allow_localhost="false",
+                         ssrf_allowed_networks_csv="127.0.0.0/8")
+    await ensure_url_allowed(settings, "http://127.0.0.1:9/x")  # pinhole
+    settings = _settings(ssrf_blocked_networks_csv="93.184.216.0/24")
+    with pytest.raises(ValidationFailure, match="blocked network"):
+        await ensure_url_allowed(settings, "http://93.184.216.34/x")
+    settings = _settings(ssrf_blocked_hosts_csv="evil.example")
+    with pytest.raises(ValidationFailure, match="blocked"):
+        await ensure_url_allowed(settings, "http://evil.example/x")
+
+
+async def test_ssrf_dns_failure_honors_fail_mode():
+    settings = _settings(ssrf_dns_fail_closed="true")
+    with pytest.raises(ValidationFailure, match="resolve"):
+        await ensure_url_allowed(
+            settings, "http://no-such-host.invalid/x")
+    settings = _settings(ssrf_dns_fail_closed="false")
+    await ensure_url_allowed(settings, "http://no-such-host.invalid/x")
+
+
+async def test_ssrf_gates_tool_and_gateway_registration():
+    client = await make_client(ssrf_protection_enabled="true",
+                               ssrf_allow_localhost="false")
+    try:
+        auth = aiohttp.BasicAuth(*BASIC)
+        resp = await client.post("/tools", json={
+            "name": "ssrf-tool", "integration_type": "REST",
+            "url": "http://127.0.0.1:9/x"}, auth=auth)
+        assert resp.status == 422
+        assert "loopback" in (await resp.json())["detail"]
+        resp = await client.post("/gateways", json={
+            "name": "ssrf-gw", "url": "http://127.0.0.1:9/mcp"}, auth=auth)
+        assert resp.status == 422
+        # the wizard probe reports instead of raising
+        resp = await client.post("/gateways/test", json={
+            "url": "http://127.0.0.1:9/mcp"}, auth=auth)
+        body = await resp.json()
+        assert body["ok"] is False and "loopback" in body["error"]
+    finally:
+        await client.close()
+
+
+# ---------------------------------------------------------------- file log
+
+async def test_log_to_file_with_rotation(tmp_path):
+    log_dir = tmp_path / "logdir"
+    client = await make_client(log_to_file="true",
+                               log_folder=str(log_dir),
+                               log_file="gw.log",
+                               log_rotation_enabled="true",
+                               log_max_size_mb="0.001",  # ~1 KB: force roll
+                               log_backup_count="2")
+    try:
+        for i in range(200):
+            logging.getLogger("rotation-test").info(
+                "filler line %04d padding padding padding padding", i)
+        files = sorted(os.listdir(log_dir))
+        assert "gw.log" in files
+        assert any(f.startswith("gw.log.") for f in files), files
+        assert len([f for f in files if f.startswith("gw.log")]) <= 3
+        assert "filler line" in (log_dir / "gw.log.1").read_text() + \
+            (log_dir / "gw.log").read_text()
+    finally:
+        await client.close()
+        # detach the file handler so later tests don't write here
+        root = logging.getLogger()
+        for h in list(root.handlers):
+            if isinstance(h, logging.FileHandler):
+                root.removeHandler(h)
+                h.close()
+
+
+# -------------------------------------------------------------- pagination
+
+async def test_pagination_min_floor_and_links():
+    client = await make_client(pagination_min_page_size="5",
+                               pagination_include_links="true")
+    try:
+        auth = aiohttp.BasicAuth(*BASIC)
+        for i in range(8):
+            await client.post("/tools", json={
+                "name": f"pg{i}", "integration_type": "REST",
+                "url": "http://127.0.0.1:9/x"}, auth=auth)
+        # limit=1 is floored to the configured minimum of 5
+        resp = await client.get("/tools?limit=1", auth=auth)
+        body = await resp.json()
+        assert len(body["items"]) == 5
+        assert body["links"]["next"] and "cursor=" in body["links"]["next"]
+        # following the link yields the remainder and a null next
+        resp = await client.get(body["links"]["next"], auth=auth)
+        body = await resp.json()
+        assert len(body["items"]) == 3
+        assert body["links"]["next"] is None
+    finally:
+        await client.close()

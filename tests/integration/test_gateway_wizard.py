@@ -1,0 +1,63 @@
+"""Registration-wizard connectivity probe: POST /gateways/test dry-runs
+connect + initialize + tool census without persisting (reference admin
+gateway connectivity test + gateway_validation_timeout)."""
+
+import aiohttp
+
+from test_gateway_app import BASIC, make_client
+
+
+async def test_probe_live_peer_reports_capabilities_without_persisting():
+    peer = await make_client()
+    hub = await make_client()
+    try:
+        auth = aiohttp.BasicAuth(*BASIC)
+        await peer.post("/tools", json={
+            "name": "probe-echo", "integration_type": "REST",
+            "url": "http://127.0.0.1:9/x"}, auth=auth)
+        peer_url = f"http://{peer.server.host}:{peer.server.port}/mcp"
+        resp = await hub.post("/gateways/test", json={
+            "url": peer_url, "transport": "streamablehttp",
+            "auth_type": "basic",
+            "auth_value": {"username": BASIC[0], "password": BASIC[1]},
+        }, auth=auth)
+        assert resp.status == 200
+        result = await resp.json()
+        assert result["ok"] is True, result
+        assert result["tool_count"] == 1
+        assert result["latency_ms"] > 0
+        assert "tools" in result["capabilities"]
+        # the dry run persisted NOTHING
+        resp = await hub.get("/gateways?include_inactive=true", auth=auth)
+        assert await resp.json() == []
+    finally:
+        await peer.close()
+        await hub.close()
+
+
+async def test_probe_dead_peer_returns_error_not_500():
+    hub = await make_client(gateway_validation_timeout="2")
+    try:
+        resp = await hub.post("/gateways/test", json={
+            "url": "http://127.0.0.1:9/mcp"},
+            auth=aiohttp.BasicAuth(*BASIC))
+        assert resp.status == 200
+        result = await resp.json()
+        assert result["ok"] is False
+        assert result["error"]
+    finally:
+        await hub.close()
+
+
+async def test_probe_rejects_non_http_schemes():
+    hub = await make_client()
+    try:
+        resp = await hub.post("/gateways/test", json={
+            "url": "file:///etc/passwd"}, auth=aiohttp.BasicAuth(*BASIC))
+        result = await resp.json()
+        assert result["ok"] is False and "http" in result["error"]
+        # permission-gated like registration itself
+        resp = await hub.post("/gateways/test", json={"url": "http://x/"})
+        assert resp.status == 401
+    finally:
+        await hub.close()

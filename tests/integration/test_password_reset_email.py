@@ -123,8 +123,8 @@ async def test_reset_flow_end_to_end_with_real_smtp():
         resp = await client.post("/auth/password/reset", json={
             "token": token, "new_password": "Rook!Garnet2026zz"})
         assert resp.status == 200
-        # the confirmation mail also went out
-        assert len(stub.messages) == 2
+        # the confirmation mail also went out (background task)
+        await _wait_mail(stub, 2)
 
         # old password dead, new password lives
         resp = await client.post("/auth/login", json={
@@ -234,6 +234,26 @@ async def test_reset_disabled_404s_and_expired_token_rejected():
         await stub.stop()
 
 
+async def test_concurrent_resets_single_use_atomically():
+    """Two racing resets with one token: exactly one wins (the
+    conditional-UPDATE claim is the serialization point, not the
+    check-then-act SELECT)."""
+    client, stub = await make_smtp_client()
+    try:
+        svc = client.app["auth_service"]
+        token = await svc.request_password_reset(ADMIN_EMAIL)
+        results = await asyncio.gather(
+            svc.reset_password(token, "Race!Winner2026zz"),
+            svc.reset_password(token, "Race!Loser2026zzz"),
+            return_exceptions=True)
+        winners = [r for r in results if isinstance(r, str)]
+        losers = [r for r in results if isinstance(r, Exception)]
+        assert len(winners) == 1 and len(losers) == 1, results
+    finally:
+        await client.close()
+        await stub.stop()
+
+
 async def test_reset_landing_page_never_reflects_the_token():
     client, stub = await make_smtp_client()
     try:
@@ -282,7 +302,7 @@ async def test_team_invitation_sends_mail():
                                  json={"email": "newbie@x.com"},
                                  auth=aiohttp.BasicAuth(*BASIC))
         assert resp.status in (200, 201)
-        assert stub.messages
+        await _wait_mail(stub, 1)
         assert "Invitation token:" in _mail_body(stub.messages[-1])
     finally:
         await client.close()
