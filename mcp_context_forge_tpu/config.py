@@ -12,6 +12,7 @@ startup fails hard on weak/default secrets unless explicitly in dev mode
 
 from __future__ import annotations
 
+import logging
 import os
 from functools import lru_cache
 from pathlib import Path
@@ -313,7 +314,14 @@ class Settings(BaseModel):
     jax_profile_dir: str = "/tmp/mcpforge-jaxprof"  # /admin/engine/profile sink
     log_level: str = "INFO"
     log_json: bool = False
-    metrics_buffer_flush_interval: float = 5.0
+    # rollup cadence (renamed from the misleading
+    # metrics_buffer_flush_interval — it drives ROLLUPS, in minutes)
+    metrics_rollup_interval_minutes: float = 5.0
+    # --- metrics write buffer (reference metrics_buffer_service.py):
+    # hot-path invocations append in memory; one executemany per flush ---
+    metrics_buffer_enabled: bool = True
+    metrics_buffer_max_size: int = 500
+    metrics_buffer_flush_interval_s: float = 1.0
 
     # --- LLM / tpu_local ---
     llm_api_prefix: str = "/v1"
@@ -562,9 +570,20 @@ def load_settings(env: dict[str, str] | None = None, env_file: str | None = ".en
                 return file_source[key]
         return None
 
+    # renamed fields: the old env key keeps working as an alias so an
+    # upgrade cannot silently revert an operator's tuning to defaults
+    _ALIASES = {"metrics_rollup_interval_minutes":
+                "metrics_buffer_flush_interval"}
+
     values: dict[str, Any] = {}
     for name, field in Settings.model_fields.items():
         raw = lookup(name)
+        if raw is None and name in _ALIASES:
+            raw = lookup(_ALIASES[name])
+            if raw is not None:
+                logging.getLogger(__name__).warning(
+                    "config: %s is deprecated; use %s",
+                    _ALIASES[name].upper(), name.upper())
         if raw is None:
             continue
         if "tuple" in str(field.annotation):

@@ -574,9 +574,16 @@ async def build_app(settings: Settings | None = None) -> web.Application:
 
     app.router.add_get("/admin/audit", admin_audit)
     metrics_maintenance = MetricsMaintenanceService(
-        ctx, rollup_interval=settings.metrics_buffer_flush_interval * 60,
+        ctx, rollup_interval=settings.metrics_rollup_interval_minutes * 60,
         retention_hours=settings.metrics_retention_hours)
     app["metrics_maintenance"] = metrics_maintenance
+    metrics_buffer = None
+    if settings.metrics_buffer_enabled:
+        from ..services.metrics_service import MetricsBuffer
+        metrics_buffer = MetricsBuffer(
+            ctx, max_size=settings.metrics_buffer_max_size,
+            flush_interval=settings.metrics_buffer_flush_interval_s)
+        ctx.extras["metrics_buffer"] = metrics_buffer
     from .routers_chat import setup_chat_routes
     setup_chat_routes(app)
     if settings.admin_ui_enabled:
@@ -604,6 +611,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         await elector.start()
         await gateway_service.start_health_loop()
         await metrics_maintenance.start()
+        if metrics_buffer is not None:
+            await metrics_buffer.start()
 
         async def _chat_sweeper() -> None:
             # chat sessions expire via KV ttl; the purge drops entries no
@@ -644,6 +653,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
             await chat_sweeper
         except _asyncio.CancelledError:
             pass
+        if metrics_buffer is not None:
+            await metrics_buffer.stop()
         await metrics_maintenance.stop()
         await transport.sessions.stop_sweeper()
         await gateway_service.stop_health_loop()

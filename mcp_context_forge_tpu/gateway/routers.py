@@ -563,6 +563,9 @@ document.getElementById("f").onsubmit = async (e) => {
             if cached and cached[1] > _time.monotonic():
                 return web.json_response(cached[0])
         db = request.app["ctx"].db
+        buffer = request.app["ctx"].extras.get("metrics_buffer")
+        if buffer is not None:
+            await buffer.flush()  # read-after-write for the dashboard
         rows = await db.fetchall(
             "SELECT t.original_name AS name, COUNT(*) AS calls,"
             " SUM(1 - m.success) AS errors, AVG(m.duration_ms) AS avg_ms,"

@@ -248,6 +248,9 @@ def setup_discovery_routes(app: web.Application) -> None:
         """Drop ALL raw metric rows + rollups (reference /metrics DELETE)."""
         request["auth"].require("admin.all")
         db = request.app["ctx"].db
+        buffer = request.app["ctx"].extras.get("metrics_buffer")
+        if buffer is not None:
+            await buffer.flush()  # buffered rows must die with the reset
         raw = await db.fetchone("SELECT COUNT(*) AS n FROM tool_metrics")
         await db.execute("DELETE FROM tool_metrics")
         await db.execute("DELETE FROM metrics_rollups")

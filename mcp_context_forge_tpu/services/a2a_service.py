@@ -340,6 +340,10 @@ class A2AService:
                             "messageId": new_id()}}
 
     async def _record_metric(self, agent_id: str, success: bool) -> None:
+        buffer = self.ctx.extras.get("metrics_buffer")
+        if buffer is not None:
+            buffer.add(agent_id, 0.0, success, entity_type="a2a")
+            return
         try:
             await self.ctx.db.execute(
                 "INSERT INTO tool_metrics (tool_id, ts, duration_ms, success,"

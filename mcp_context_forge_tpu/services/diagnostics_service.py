@@ -136,6 +136,9 @@ class SystemStatsService:
             " FROM api_tokens", (time.time(),))
 
     async def _metrics(self) -> dict[str, Any]:
+        buffer = self._ctx.extras.get("metrics_buffer")
+        if buffer is not None:
+            await buffer.flush()
         out = await self._one(
             "SELECT COUNT(*) AS raw_rows,"
             " SUM(CASE WHEN success THEN 0 ELSE 1 END) AS errors,"

@@ -17,6 +17,76 @@ from .base import AppContext
 logger = logging.getLogger(__name__)
 
 
+class MetricsBuffer:
+    """Batched per-call metric writes (reference metrics_buffer_service.py).
+
+    The invocation hot path pays ONE list append — no task spawn, no db
+    executor round trip; a background loop drains the buffer with a
+    single executemany per flush interval (or immediately when the
+    buffer fills). Readers that need read-after-write (the admin metrics
+    endpoints, rollups, system stats) call ``flush()`` first.
+    """
+
+    def __init__(self, ctx: AppContext, max_size: int = 500,
+                 flush_interval: float = 1.0) -> None:
+        self._ctx = ctx
+        self._rows: list[tuple] = []
+        self._max = max(1, max_size)
+        self._interval = flush_interval
+        self._task: asyncio.Task | None = None
+        self._kick = asyncio.Event()
+        self._flush_lock = asyncio.Lock()
+
+    def add(self, entity_id: str, duration_ms: float, success: bool,
+            entity_type: str = "tool") -> None:
+        self._rows.append((entity_id, time.time(), duration_ms,
+                           int(success), entity_type))
+        if len(self._rows) >= self._max:
+            self._kick.set()
+
+    async def flush(self) -> int:
+        async with self._flush_lock:
+            rows, self._rows = self._rows, []
+            if not rows:
+                return 0
+            try:
+                await self._ctx.db.executemany(
+                    "INSERT INTO tool_metrics (tool_id, ts, duration_ms,"
+                    " success, entity_type) VALUES (?,?,?,?,?)", rows)
+            except asyncio.CancelledError:
+                # stop() cancels the loop task mid-flush: the swapped-out
+                # batch must survive so the drain flush in stop() writes it
+                self._rows = rows + self._rows
+                raise
+            except Exception:  # metrics loss must never break serving
+                logger.debug("metrics flush failed (%d rows)", len(rows),
+                             exc_info=True)
+            return len(rows)
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        await self.flush()  # drain the tail on shutdown
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await asyncio.wait_for(self._kick.wait(), self._interval)
+            except asyncio.TimeoutError:
+                pass
+            self._kick.clear()
+            await self.flush()
+
+
 class MetricsMaintenanceService:
     def __init__(self, ctx: AppContext, rollup_interval: float = 300.0,
                  retention_hours: float = 24.0):
@@ -55,6 +125,9 @@ class MetricsMaintenanceService:
         Only hours whose raw rows are still fully retained are recomputed:
         cleanup() prunes rows older than the retention cutoff, and re-rolling
         a half-pruned boundary hour would shrink its historical aggregate."""
+        buffer = self.ctx.extras.get("metrics_buffer")
+        if buffer is not None:
+            await buffer.flush()  # roll up what the hot path buffered
         boundary_hour = int((time.time() - self.retention_hours * 3600) / 3600)
         rows = await self.ctx.db.fetchall(
             "SELECT entity_type, tool_id, CAST(ts / 3600 AS INTEGER) AS hour,"

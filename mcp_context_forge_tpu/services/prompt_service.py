@@ -122,6 +122,10 @@ class PromptService:
     async def _record_metric(self, name: str, duration_ms: float,
                              success: bool) -> None:
         """Per-entity invocation metrics (reference PromptMetric rows)."""
+        buffer = self.ctx.extras.get("metrics_buffer")
+        if buffer is not None:
+            buffer.add(name, duration_ms, success, entity_type="prompt")
+            return
         try:
             await self.ctx.db.execute(
                 "INSERT INTO tool_metrics (tool_id, ts, duration_ms, success,"

@@ -129,6 +129,10 @@ class ResourceService:
         perf = self.ctx.extras.get("perf_tracker")
         if perf is not None:
             perf.record("resource.read", duration_ms / 1000.0)
+        buffer = self.ctx.extras.get("metrics_buffer")
+        if buffer is not None:
+            buffer.add(uri, duration_ms, success, entity_type="resource")
+            return
         try:
             await self.ctx.db.execute(
                 "INSERT INTO tool_metrics (tool_id, ts, duration_ms, success,"

@@ -245,8 +245,14 @@ class ToolService:
                 perf = self.ctx.extras.get("perf_tracker")
                 if perf is not None:
                     perf.record("tool.invoke", elapsed)
-                asyncio.get_running_loop().create_task(
-                    self._record_metric(tool_id, elapsed * 1000, status == "success"))
+                buffer = self.ctx.extras.get("metrics_buffer")
+                if buffer is not None:
+                    # one in-memory append; the buffer batches the INSERT
+                    buffer.add(tool_id, elapsed * 1000, status == "success")
+                else:
+                    asyncio.get_running_loop().create_task(
+                        self._record_metric(tool_id, elapsed * 1000,
+                                            status == "success"))
 
     async def _record_metric(self, tool_id: str, duration_ms: float, success: bool) -> None:
         try:

@@ -1,0 +1,80 @@
+"""MetricsBuffer: batched hot-path metric writes (reference
+metrics_buffer_service.py)."""
+
+import asyncio
+
+from mcp_context_forge_tpu.db import Database, MIGRATIONS
+from mcp_context_forge_tpu.services.metrics_service import MetricsBuffer
+
+
+class _Ctx:
+    def __init__(self, db):
+        self.db = db
+        self.extras = {}
+
+
+async def _make():
+    db = Database(":memory:")
+    await db.connect()
+    await db.migrate(MIGRATIONS)
+    return _Ctx(db)
+
+
+def test_flush_batches_rows_with_entity_types():
+    async def run():
+        ctx = await _make()
+        buf = MetricsBuffer(ctx, max_size=100, flush_interval=60)
+        buf.add("t1", 5.0, True)
+        buf.add("t1", 7.0, False)
+        buf.add("uri://x", 3.0, True, entity_type="resource")
+        # nothing hits the db before flush
+        rows = await ctx.db.fetchall("SELECT * FROM tool_metrics")
+        assert rows == []
+        assert await buf.flush() == 3
+        rows = await ctx.db.fetchall(
+            "SELECT tool_id, duration_ms, success, entity_type"
+            " FROM tool_metrics ORDER BY id")
+        assert [r["tool_id"] for r in rows] == ["t1", "t1", "uri://x"]
+        assert rows[1]["success"] == 0
+        assert rows[2]["entity_type"] == "resource"
+        assert await buf.flush() == 0  # drained
+        await ctx.db.close()
+
+    asyncio.run(run())
+
+
+def test_full_buffer_triggers_immediate_flush():
+    async def run():
+        ctx = await _make()
+        buf = MetricsBuffer(ctx, max_size=5, flush_interval=3600)
+        await buf.start()
+        try:
+            for i in range(5):
+                buf.add(f"t{i}", 1.0, True)
+            # the kick event wakes the loop well before the 1h interval
+            for _ in range(100):
+                rows = await ctx.db.fetchall(
+                    "SELECT COUNT(*) AS n FROM tool_metrics")
+                if rows[0]["n"] == 5:
+                    break
+                await asyncio.sleep(0.01)
+            assert rows[0]["n"] == 5
+        finally:
+            await buf.stop()
+            await ctx.db.close()
+
+    asyncio.run(run())
+
+
+def test_stop_drains_the_tail():
+    async def run():
+        ctx = await _make()
+        buf = MetricsBuffer(ctx, max_size=1000, flush_interval=3600)
+        await buf.start()
+        buf.add("tail", 1.0, True)
+        await buf.stop()
+        rows = await ctx.db.fetchall("SELECT tool_id FROM tool_metrics")
+        assert [r["tool_id"] for r in rows] == ["tail"]
+        await ctx.db.close()
+
+    asyncio.run(run())
