@@ -582,7 +582,7 @@ def load_settings(env: dict[str, str] | None = None, env_file: str | None = ".en
             raw = lookup(_ALIASES[name])
             if raw is not None:
                 logging.getLogger(__name__).warning(
-                    "config: %s is deprecated; use %s",
+                    "config: MCPFORGE_%s is deprecated; use MCPFORGE_%s",
                     _ALIASES[name].upper(), name.upper())
         if raw is None:
             continue

@@ -262,9 +262,10 @@ async function engineProfile(){
     r.ok ? "profile captured" : "profile failed: " + r.status;
 }
 async function renderDashboard(){
-  // totals from /metrics + hourly bars from /metrics/rollups (last 24h)
+  // totals from /metrics + hourly bars from the combined series (rollups
+  // + un-rolled raw tail, so the current hour is never missing)
   const v = document.getElementById("view");
-  const [mr, rr] = await Promise.all([fetch("/metrics"), fetch("/metrics/rollups?hours=24")]);
+  const [mr, rr] = await Promise.all([fetch("/metrics"), fetch("/metrics/timeseries?hours=24")]);
   if (!mr.ok || !rr.ok){ v.textContent = "dashboard fetch failed"; return; }
   const metrics = await mr.json(), roll = await rr.json();
   const tools = metrics.tools || [];

@@ -155,6 +155,16 @@ def setup_chat_routes(app: web.Application) -> None:
             entity_id=request.query.get("entity_id"),
             hours=int(request.query.get("hours", "24"))))
 
+    @routes.get("/metrics/timeseries")
+    async def metrics_timeseries(request: web.Request) -> web.Response:
+        """Hourly calls/errors/avg series: persisted rollups + the
+        un-rolled raw tail (reference metrics_query_service.py)."""
+        request["auth"].require("observability.read")
+        service = request.app["metrics_maintenance"]
+        return web.json_response(await service.timeseries(
+            hours=float(request.query.get("hours", "24")),
+            entity_type=request.query.get("entity_type")))
+
     @routes.post("/metrics/rollup")
     async def run_rollup(request: web.Request) -> web.Response:
         request["auth"].require("observability.read")

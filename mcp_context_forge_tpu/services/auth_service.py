@@ -306,7 +306,7 @@ class AuthService:
         if not claimed:
             raise AuthError("Invalid or expired reset token")
         invalidate = self.ctx.settings.password_reset_invalidate_sessions
-        await self.ctx.db.execute(
+        await self.ctx.db.execute(  # seclint: allow S006 fixed literal branch, no user data in SQL text
             "UPDATE users SET password_hash=?, failed_login_attempts=0,"
             " locked_until=NULL, password_change_required=0, updated_at=?"
             + (", tokens_valid_after=?" if invalidate else "")

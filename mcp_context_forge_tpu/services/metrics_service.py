@@ -148,6 +148,58 @@ class MetricsMaintenanceService:
                  row["errors"], row["total_ms"], row["min_ms"], row["max_ms"]))
         return len(rows)
 
+    async def timeseries(self, hours: float = 24.0,
+                         entity_type: str | None = None) -> list[dict[str, Any]]:
+        """Hourly series combining rollups with the un-rolled raw tail
+        (reference metrics_query_service.py: raw rows die at retention,
+        rollups persist — long ranges need both; the current hour may not
+        be rolled up yet, so raw fills any hour the rollups miss)."""
+        buffer = self.ctx.extras.get("metrics_buffer")
+        if buffer is not None:
+            await buffer.flush()
+        since_hour = int((time.time() - hours * 3600) / 3600)
+        etype_clause = " AND entity_type=?" if entity_type else ""
+        params: list[Any] = [since_hour]
+        if entity_type:
+            params.append(entity_type)
+        rolled = await self.ctx.db.fetchall(  # seclint: allow S006 fixed clause fragment
+            f"SELECT hour, SUM(count) AS calls, SUM(errors) AS errors,"
+            f" SUM(total_ms) AS total_ms FROM metrics_rollups"
+            f" WHERE hour >= ?{etype_clause} GROUP BY hour",
+            params)
+        raw = await self.ctx.db.fetchall(  # seclint: allow S006 fixed clause fragment
+            f"SELECT CAST(ts / 3600 AS INTEGER) AS hour, COUNT(*) AS calls,"
+            f" SUM(1 - success) AS errors, SUM(duration_ms) AS total_ms"
+            f" FROM tool_metrics WHERE ts >= ?{etype_clause}"
+            f" GROUP BY hour",
+            [since_hour * 3600.0, *params[1:]])
+        by_hour = {r["hour"]: r for r in rolled}
+        # raw WINS for hours its retention still fully covers: the flush
+        # above makes raw exact up to this instant, while the rollup of an
+        # in-progress hour is frozen at the last maintenance pass. Rollups
+        # only carry the hours whose raw rows have been pruned.
+        boundary_hour = int((time.time() - self.retention_hours * 3600)
+                            / 3600)
+        for row in raw:
+            if row["hour"] > boundary_hour:
+                by_hour[row["hour"]] = row
+            else:
+                by_hour.setdefault(row["hour"], row)
+        out = []
+        for hour in sorted(by_hour):
+            r = by_hour[hour]
+            calls = r["calls"] or 0
+            out.append({
+                "hour": hour,
+                "hour_iso": time.strftime("%Y-%m-%dT%H:00:00Z",
+                                          time.gmtime(hour * 3600)),
+                "calls": calls,
+                "errors": r["errors"] or 0,
+                "avg_ms": round((r["total_ms"] or 0) / calls, 3) if calls
+                else 0.0,
+            })
+        return out
+
     async def cleanup(self) -> int:
         """Prune raw rows past retention (rollups keep the history); the
         token-usage trail keeps its newest ``token_usage_log_retention``

@@ -1,0 +1,89 @@
+"""Combined raw + rollup metric series (reference
+metrics_query_service.py): history survives raw-row pruning via
+rollups, the fresh tail comes from raw rows not yet rolled up."""
+
+import time
+
+import aiohttp
+
+from test_gateway_app import BASIC, make_client
+
+
+async def test_timeseries_merges_rollups_and_raw_tail():
+    client = await make_client()
+    try:
+        db = client.app["ctx"].db
+        now = time.time()
+        this_hour = int(now / 3600)
+        # two PAST hours of raw traffic, rolled up then pruned (simulating
+        # retention) — only the rollups remember them
+        for hours_ago, n in ((3, 4), (2, 6)):
+            for i in range(n):
+                await db.execute(
+                    "INSERT INTO tool_metrics (tool_id, ts, duration_ms,"
+                    " success, entity_type) VALUES (?,?,?,?,'tool')",
+                    (f"old{i}", now - hours_ago * 3600, 10.0, 1))
+        await client.app["metrics_maintenance"].rollup()
+        await db.execute("DELETE FROM tool_metrics")
+        # fresh traffic in the CURRENT hour, not rolled up
+        for i in range(5):
+            await db.execute(
+                "INSERT INTO tool_metrics (tool_id, ts, duration_ms,"
+                " success, entity_type) VALUES (?,?,?,?,'tool')",
+                ("fresh", now, 20.0, 0))
+
+        resp = await client.get("/metrics/timeseries?hours=6",
+                                auth=aiohttp.BasicAuth(*BASIC))
+        assert resp.status == 200
+        series = await resp.json()
+        by_hour = {row["hour"]: row for row in series}
+        assert by_hour[this_hour - 3]["calls"] == 4   # from rollups
+        assert by_hour[this_hour - 2]["calls"] == 6   # from rollups
+        fresh = by_hour[this_hour]
+        assert fresh["calls"] == 5                    # from the raw tail
+        assert fresh["errors"] == 5
+        assert fresh["avg_ms"] == 20.0
+        assert all("hour_iso" in row for row in series)
+
+        # entity_type filter: nothing matches 'resource'
+        resp = await client.get(
+            "/metrics/timeseries?hours=6&entity_type=resource",
+            auth=aiohttp.BasicAuth(*BASIC))
+        assert await resp.json() == []
+    finally:
+        await client.close()
+
+
+async def test_timeseries_no_double_count_and_no_stale_current_hour():
+    """A rolled-up hour whose raw rows still exist counts once — and
+    counts the FRESH raw total, not the frozen mid-hour rollup."""
+    client = await make_client()
+    try:
+        db = client.app["ctx"].db
+        now = time.time()
+        this_hour = int(now / 3600)
+        for i in range(7):
+            await db.execute(
+                "INSERT INTO tool_metrics (tool_id, ts, duration_ms,"
+                " success, entity_type) VALUES (?,?,?,?,'tool')",
+                ("both", now, 10.0, 1))
+        await client.app["metrics_maintenance"].rollup()  # raw stays too
+        resp = await client.get("/metrics/timeseries?hours=2",
+                                auth=aiohttp.BasicAuth(*BASIC))
+        series = {r["hour"]: r for r in await resp.json()}
+        assert series[this_hour]["calls"] == 7  # once, not 14
+
+        # traffic AFTER the rollup must show immediately (raw wins while
+        # retention still covers the hour)
+        for i in range(3):
+            await db.execute(
+                "INSERT INTO tool_metrics (tool_id, ts, duration_ms,"
+                " success, entity_type) VALUES (?,?,?,?,'tool')",
+                ("late", now, 10.0, 0))
+        resp = await client.get("/metrics/timeseries?hours=2",
+                                auth=aiohttp.BasicAuth(*BASIC))
+        series = {r["hour"]: r for r in await resp.json()}
+        assert series[this_hour]["calls"] == 10
+        assert series[this_hour]["errors"] == 3
+    finally:
+        await client.close()
