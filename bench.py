@@ -387,13 +387,34 @@ async def bench_engine_configs(platform: str) -> dict:
             config={"threshold_chars": 1000, "max_tokens": 32,
                     "cache": False}))
         await _tools_call_load(gateway, auth, "bench-tool", 2, 1)  # compile
+        # width telemetry: config3-uncached has shown a rare ~2.4 s bad
+        # mode after the 1k tier (vs ~0.9 s standalone) — sample the
+        # decode width so any bad-mode artifact carries its own diagnosis
+        engine = app.get("tpu_engine")
+        width_trace: list[int] = []
+
+        async def _width_sampler():
+            while True:
+                width_trace.append(engine._batch_width)
+                await asyncio.sleep(0.2)
+
+        sampler = (asyncio.ensure_future(_width_sampler())
+                   if engine is not None else None)
         lat3r, fail3r, wall3r = await _tools_call_load(
             gateway, auth, "bench-tool", 32, 8)
+        if sampler is not None:
+            sampler.cancel()
         out["config3_summarizer_uncached"] = {
             **_percentiles(lat3r), "failures": fail3r,
             "rps": round(32 / wall3r, 2),
             "added_p50_ms": round(statistics.median(lat3r) - base_p50, 2),
-            "requests": 32}
+            "requests": 32,
+            **({"width": {"start": width_trace[0] if width_trace else None,
+                          "end": width_trace[-1] if width_trace else None,
+                          "max": max(width_trace, default=None),
+                          "min": min(width_trace, default=None),
+                          "samples": len(width_trace)}}
+               if engine is not None else {})}
         await pm.remove_plugin("sum-raw")
         await pm.add_plugin(PluginConfig(
             name="sum", kind="summarizer",

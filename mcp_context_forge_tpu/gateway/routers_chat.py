@@ -8,6 +8,8 @@ import json
 
 from aiohttp import web
 
+from ..services.base import ValidationFailure
+
 
 def setup_chat_routes(app: web.Application) -> None:
     routes = web.RouteTableDef()
@@ -161,8 +163,15 @@ def setup_chat_routes(app: web.Application) -> None:
         un-rolled raw tail (reference metrics_query_service.py)."""
         request["auth"].require("observability.read")
         service = request.app["metrics_maintenance"]
+        try:
+            hours = float(request.query.get("hours", "24"))
+            if not (0 < hours <= 24 * 366):  # also rejects nan/inf
+                raise ValueError(hours)
+        except ValueError as exc:
+            raise ValidationFailure(
+                "hours must be a number in (0, 8784]") from exc
         return web.json_response(await service.timeseries(
-            hours=float(request.query.get("hours", "24")),
+            hours=hours,
             entity_type=request.query.get("entity_type")))
 
     @routes.post("/metrics/rollup")

@@ -50,6 +50,12 @@ async def test_timeseries_merges_rollups_and_raw_tail():
             "/metrics/timeseries?hours=6&entity_type=resource",
             auth=aiohttp.BasicAuth(*BASIC))
         assert await resp.json() == []
+
+        # malformed / non-finite hours: 422, never a 500
+        for bad in ("abc", "nan", "inf", "-1", "0"):
+            resp = await client.get(f"/metrics/timeseries?hours={bad}",
+                                    auth=aiohttp.BasicAuth(*BASIC))
+            assert resp.status == 422, bad
     finally:
         await client.close()
 
