@@ -235,6 +235,15 @@ class Settings(BaseModel):
     pagination_max_page_size: int = 500
     pagination_min_page_size: int = 1
     pagination_include_links: bool = False  # RFC 8288-style next link
+    # --- registry list cache (reference registry_cache_* family):
+    # TTL-cached list endpoints, bus-invalidated on entity changes ---
+    registry_cache_enabled: bool = False
+    registry_cache_default_ttl_s: float = 30.0
+    registry_cache_tools_ttl_s: float = 30.0
+    registry_cache_resources_ttl_s: float = 30.0
+    registry_cache_prompts_ttl_s: float = 30.0
+    registry_cache_servers_ttl_s: float = 30.0
+    registry_cache_gateways_ttl_s: float = 30.0
     # --- SSRF guard for catalog URLs (reference ssrf_* family) ---
     ssrf_protection_enabled: bool = False  # off: localhost upstreams are
                                            # the common single-host posture

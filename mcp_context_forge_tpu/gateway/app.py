@@ -367,6 +367,12 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         from ..services.classification_service import (
             ServerClassificationService)
         ctx.extras["server_classifier"] = ServerClassificationService(ctx)
+    if settings.registry_cache_enabled:
+        from .registry_cache import RegistryCache
+        registry_cache = RegistryCache(ctx)
+        registry_cache.wire()
+        app["registry_cache"] = registry_cache
+        ctx.extras["registry_cache"] = registry_cache
     from ..services.compliance_service import ComplianceService
     app["compliance_service"] = ComplianceService(ctx)
     # pre-create: request handlers may not add keys to a frozen

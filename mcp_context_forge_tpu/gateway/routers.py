@@ -35,6 +35,23 @@ def _dump(model) -> Any:
     return json.loads(model.model_dump_json())
 
 
+async def _cached_list(request: web.Request, entity: str, key: str, loader):
+    """List-endpoint TTL cache (reference registry_cache_* family); the
+    loader runs on miss and the result is bus-invalidated on change."""
+    cache = request.app.get("registry_cache")
+    if cache is None:
+        return await loader()
+    items = cache.get(entity, key)
+    if items is None:
+        # capture the generation BEFORE loading: an invalidation that
+        # fires while the db read runs makes this snapshot stale, and
+        # put() must then drop it instead of caching pre-write state
+        generation = cache.generation(entity)
+        items = await loader()
+        cache.put(entity, key, items, generation)
+    return items
+
+
 async def _body(request: web.Request, schema):
     try:
         model = schema.model_validate(await request.json())
@@ -327,8 +344,14 @@ document.getElementById("f").onsubmit = async (e) => {
     async def list_tools(request: web.Request) -> web.Response:
         request["auth"].require("tools.read")
         include_inactive = request.query.get("include_inactive") == "true"
-        tools = await request.app["tool_service"].list_tools(
-            include_inactive=include_inactive, team_ids=request["auth"].teams)
+        # the tool list is TEAM-scoped: the cache key must carry the
+        # viewer's team set or private entries would leak across users
+        teams = ",".join(sorted(request["auth"].teams or []))
+        tools = await _cached_list(
+            request, "tools", f"{include_inactive}:{teams}",
+            lambda: request.app["tool_service"].list_tools(
+                include_inactive=include_inactive,
+                team_ids=request["auth"].teams))
         return paginate(request, tools, _dump)
 
     @routes.post("/tools")
@@ -382,7 +405,10 @@ document.getElementById("f").onsubmit = async (e) => {
     async def list_gateways(request: web.Request) -> web.Response:
         request["auth"].require("gateways.read")
         include_inactive = request.query.get("include_inactive") == "true"
-        gws = await request.app["gateway_service"].list_gateways(include_inactive)
+        gws = await _cached_list(
+            request, "gateways", str(include_inactive),
+            lambda: request.app["gateway_service"].list_gateways(
+                include_inactive))
         return paginate(request, gws, _dump)
 
     @routes.post("/gateways")
@@ -436,8 +462,11 @@ document.getElementById("f").onsubmit = async (e) => {
     @routes.get("/resources")
     async def list_resources(request: web.Request) -> web.Response:
         request["auth"].require("resources.read")
-        res = await request.app["resource_service"].list_resources(
-            request.query.get("include_inactive") == "true")
+        include_inactive = request.query.get("include_inactive") == "true"
+        res = await _cached_list(
+            request, "resources", str(include_inactive),
+            lambda: request.app["resource_service"].list_resources(
+                include_inactive))
         return paginate(request, res, _dump)
 
     @routes.post("/resources")
@@ -473,8 +502,11 @@ document.getElementById("f").onsubmit = async (e) => {
     @routes.get("/prompts")
     async def list_prompts(request: web.Request) -> web.Response:
         request["auth"].require("prompts.read")
-        prompts = await request.app["prompt_service"].list_prompts(
-            request.query.get("include_inactive") == "true")
+        include_inactive = request.query.get("include_inactive") == "true"
+        prompts = await _cached_list(
+            request, "prompts", str(include_inactive),
+            lambda: request.app["prompt_service"].list_prompts(
+                include_inactive))
         return paginate(request, prompts, _dump)
 
     @routes.post("/prompts")
@@ -513,8 +545,11 @@ document.getElementById("f").onsubmit = async (e) => {
     @routes.get("/servers")
     async def list_servers(request: web.Request) -> web.Response:
         request["auth"].require("servers.read")
-        servers = await request.app["server_service"].list_servers(
-            request.query.get("include_inactive") == "true")
+        include_inactive = request.query.get("include_inactive") == "true"
+        servers = await _cached_list(
+            request, "servers", str(include_inactive),
+            lambda: request.app["server_service"].list_servers(
+                include_inactive))
         return paginate(request, servers, _dump)
 
     @routes.post("/servers")

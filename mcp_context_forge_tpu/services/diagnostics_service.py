@@ -147,6 +147,10 @@ class SystemStatsService:
             "SELECT COUNT(*) AS total FROM metrics_rollups"))["total"]
         out["traces"] = (await self._one(
             "SELECT COUNT(*) AS total FROM observability_traces"))["total"]
+        cache = self._ctx.extras.get("registry_cache")
+        if cache is not None:
+            out["registry_cache_hits"] = cache.hits
+            out["registry_cache_misses"] = cache.misses
         return out
 
     async def _security(self) -> dict[str, Any]:

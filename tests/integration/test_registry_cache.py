@@ -1,0 +1,92 @@
+"""Registry list cache (reference registry_cache_* family): TTL-cached
+list endpoints, invalidated by the same bus events that drive
+cross-worker sync; team-scoped keys for the tool list."""
+
+import time
+
+import aiohttp
+
+from test_gateway_app import BASIC, make_client
+
+
+async def _mk_tool(client, name, **extra):
+    resp = await client.post("/tools", json={
+        "name": name, "integration_type": "REST",
+        "url": "http://127.0.0.1:9/x", **extra},
+        auth=aiohttp.BasicAuth(*BASIC))
+    assert resp.status == 201, await resp.text()
+    return await resp.json()
+
+
+async def test_cache_serves_stale_until_bus_invalidation():
+    client = await make_client(registry_cache_enabled="true",
+                               registry_cache_tools_ttl_s="300")
+    try:
+        auth = aiohttp.BasicAuth(*BASIC)
+        await _mk_tool(client, "c1")
+        resp = await client.get("/tools", auth=auth)
+        assert len(await resp.json()) == 1  # miss -> cached
+
+        # a DIRECT db insert bypasses the bus: the cache must go stale
+        # (this is what proves the cache actually serves from memory)
+        now = time.time()
+        await client.app["ctx"].db.execute(
+            "INSERT INTO tools (id, original_name, integration_type,"
+            " enabled, created_at, updated_at) VALUES"
+            " ('ghost','ghost','REST',1,?,?)", (now, now))
+        resp = await client.get("/tools", auth=auth)
+        assert len(await resp.json()) == 1  # still the cached answer
+
+        # an API write publishes tools.changed -> invalidation -> fresh
+        await _mk_tool(client, "c2")
+        resp = await client.get("/tools", auth=auth)
+        assert len(await resp.json()) == 3  # c1 + ghost + c2
+
+        cache = client.app["registry_cache"]
+        assert cache.hits >= 1 and cache.misses >= 2
+    finally:
+        await client.close()
+
+
+async def test_cache_key_carries_team_scope():
+    client = await make_client(registry_cache_enabled="true")
+    try:
+        auth = aiohttp.BasicAuth(*BASIC)
+        # a team-private tool owned by the admin's team
+        resp = await client.post("/teams", json={"name": "cachet"},
+                                 auth=auth)
+        team_id = (await resp.json())["id"]
+        await _mk_tool(client, "private-tool", team_id=team_id,
+                       visibility="team")
+        # a normal user outside the team
+        await client.post("/admin/users", json={
+            "email": "out@x.com", "password": "Out!Sider2026zz"},
+            auth=auth)
+        user_auth = aiohttp.BasicAuth("out@x.com", "Out!Sider2026zz")
+
+        # the member view: a JWT resolves teams (the env-credential basic
+        # superuser carries no team memberships by design)
+        resp = await client.post("/auth/login", json={
+            "email": "admin@example.com", "password": BASIC[1]})
+        jwt = (await resp.json())["access_token"]
+        resp = await client.get(
+            "/tools", headers={"authorization": f"Bearer {jwt}"})
+        member_names = [t["name"] for t in await resp.json()]
+        resp = await client.get("/tools", auth=user_auth)
+        user_names = [t["name"] for t in await resp.json()]
+        assert "private-tool" in member_names
+        # the cached member list must NOT be replayed to the outsider
+        assert "private-tool" not in user_names
+    finally:
+        await client.close()
+
+
+async def test_cache_disabled_is_passthrough():
+    client = await make_client()
+    try:
+        assert client.app.get("registry_cache") is None
+        await _mk_tool(client, "nc1")
+        resp = await client.get("/tools", auth=aiohttp.BasicAuth(*BASIC))
+        assert len(await resp.json()) == 1
+    finally:
+        await client.close()

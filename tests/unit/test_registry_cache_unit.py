@@ -1,0 +1,47 @@
+"""RegistryCache internals: generation-gated put and expiry eviction."""
+
+import time
+
+from mcp_context_forge_tpu.gateway.registry_cache import RegistryCache
+
+
+class _Ctx:
+    class _Bus:
+        def subscribe(self, *_a, **_k):
+            return lambda: None
+
+    def __init__(self, ttl=30.0):
+        self.bus = self._Bus()
+
+        class S:
+            registry_cache_default_ttl_s = ttl
+            registry_cache_tools_ttl_s = ttl
+        self.settings = S()
+
+
+def test_put_drops_snapshot_loaded_before_invalidation():
+    cache = RegistryCache(_Ctx())
+    gen = cache.generation("tools")
+    cache.invalidate("tools")          # a write lands mid-load
+    cache.put("tools", "k", ["stale"], gen)
+    assert cache.get("tools", "k") is None  # stale snapshot was rejected
+    cache.put("tools", "k", ["fresh"], cache.generation("tools"))
+    assert cache.get("tools", "k") == ["fresh"]
+
+
+def test_expired_entries_are_evicted_not_retained():
+    ctx = _Ctx(ttl=0.01)
+    cache = RegistryCache(ctx)
+    cache.put("tools", "k", [1])
+    time.sleep(0.02)
+    assert cache.get("tools", "k") is None
+    assert ("tools", "k") not in cache._store  # dead entry removed
+
+
+def test_invalidate_all_bumps_every_generation():
+    cache = RegistryCache(_Ctx())
+    before = {e: cache.generation(e)
+              for e in ("tools", "servers", "gateways")}
+    cache.invalidate()
+    for entity, gen in before.items():
+        assert cache.generation(entity) == gen + 1
