@@ -235,6 +235,16 @@ class Settings(BaseModel):
     pagination_max_page_size: int = 500
     pagination_min_page_size: int = 1
     pagination_include_links: bool = False  # RFC 8288-style next link
+    # --- baggage propagation (reference otel_baggage_* family) ---
+    otel_baggage_enabled: bool = False
+    otel_baggage_max_items: int = 10
+    otel_baggage_max_size_bytes: int = 1024
+    # "header=baggage.key" pairs, e.g. "x-tenant-id=tenant.id"
+    otel_baggage_header_mappings_csv: str = ""
+    # --- endpoint deprecation (reference middleware/deprecation.py +
+    # legacy_api_* family; RFC 8594 Sunset) ---
+    deprecated_path_prefixes_csv: str = ""
+    legacy_api_sunset_date: str = ""   # e.g. "Sat, 31 Dec 2026 23:59:59 GMT"
     # --- registry list cache (reference registry_cache_* family):
     # TTL-cached list endpoints, bus-invalidated on entity changes ---
     registry_cache_enabled: bool = False
@@ -474,6 +484,18 @@ class Settings(BaseModel):
     @property
     def csrf_exempt_paths(self) -> tuple[str, ...]:
         return self._csv(self.csrf_exempt_paths_csv)
+
+    @property
+    def deprecated_path_prefixes(self) -> tuple[str, ...]:
+        return self._csv(self.deprecated_path_prefixes_csv)
+
+    @property
+    def otel_baggage_header_mappings(self) -> tuple[tuple[str, str], ...]:
+        """Parsed (header, baggage-key) pairs."""
+        return tuple(tuple(pair.split("=", 1))  # type: ignore[misc]
+                     for pair in self._csv(
+                         self.otel_baggage_header_mappings_csv)
+                     if "=" in pair)
 
     @property
     def sso_trusted_domains(self) -> tuple[str, ...]:

@@ -143,6 +143,40 @@ async def error_middleware(request: web.Request, handler: Handler) -> web.Stream
                                  status=500)
 
 
+def _extract_baggage(request: web.Request, settings) -> dict[str, str]:
+    """W3C baggage from the inbound header plus configured header→key
+    mappings (reference middleware/baggage_middleware.py +
+    otel_baggage_* family). Values are percent-decoded per the W3C
+    syntax, item count and TOTAL utf-8 size are bounded, and operator
+    mappings are admitted BEFORE the untrusted inbound header so a
+    padded baggage header cannot starve tenant attribution."""
+    from urllib.parse import unquote
+
+    entries: dict[str, str] = {}
+    max_items = settings.otel_baggage_max_items
+    budget = settings.otel_baggage_max_size_bytes
+
+    def _add(key: str, value: str) -> None:
+        nonlocal budget
+        key = key.strip()
+        value = unquote(value.strip()).replace(",", "").replace(";", "")[:256]
+        cost = len(key.encode()) + len(value.encode())
+        if key and value and len(entries) < max_items and cost <= budget:
+            entries[key] = value
+            budget -= cost
+
+    for header, key in settings.otel_baggage_header_mappings:
+        value = request.headers.get(header)
+        if value:
+            _add(key, value)
+    raw = request.headers.get("baggage", "")
+    for member in raw.split(","):
+        if "=" in member:
+            key, value = member.split("=", 1)
+            _add(key, value.split(";", 1)[0])  # properties are dropped
+    return entries
+
+
 @web.middleware
 async def observability_middleware(request: web.Request, handler: Handler) -> web.StreamResponse:
     """Correlation id + span + Prometheus metrics per request."""
@@ -155,10 +189,16 @@ async def observability_middleware(request: web.Request, handler: Handler) -> we
     started = time.monotonic()
     route = request.match_info.route.resource
     path_label = route.canonical if route is not None else request.path
-    with ctx.tracer.span("http.request", {
+    attrs = {
         "http.method": request.method, "http.path": request.path,
         "correlation_id": correlation_id,
-    }, traceparent=request.headers.get("traceparent")) as span:
+    }
+    if settings.otel_baggage_enabled:
+        baggage = _extract_baggage(request, settings)
+        request["baggage"] = baggage
+        attrs.update({f"baggage.{k}": v for k, v in baggage.items()})
+    with ctx.tracer.span("http.request", attrs,
+                         traceparent=request.headers.get("traceparent")) as span:
         response = await handler(request)
         span.set_attribute("http.status_code", response.status)
         elapsed = time.monotonic() - started
@@ -170,6 +210,23 @@ async def observability_middleware(request: web.Request, handler: Handler) -> we
         response.headers[settings.correlation_id_response_header] = \
             correlation_id
         return response
+
+
+@web.middleware
+async def deprecation_middleware(request: web.Request, handler: Handler) -> web.StreamResponse:
+    """Sunset/Deprecation headers on configured legacy path prefixes
+    (reference middleware/deprecation.py + legacy_api_* settings): lets
+    an operator announce an endpoint's retirement machine-readably
+    (RFC 8594) without touching handlers."""
+    response = await handler(request)
+    settings = request.app["ctx"].settings
+    prefixes = settings.deprecated_path_prefixes
+    if prefixes and any(request.path.startswith(p) for p in prefixes):
+        response.headers["Deprecation"] = "true"
+        response.headers["X-Deprecated-Endpoint"] = request.path
+        if settings.legacy_api_sunset_date:
+            response.headers["Sunset"] = settings.legacy_api_sunset_date
+    return response
 
 
 @web.middleware
@@ -585,6 +642,7 @@ MIDDLEWARES = [
     cors_middleware,
     compression_middleware,
     security_headers_middleware,
+    deprecation_middleware,
     header_size_middleware,
     # token usage sits OUTSIDE error translation so 401/403 rejections of
     # revoked tokens surface here as statuses, not exceptions

@@ -1,0 +1,105 @@
+"""Baggage extraction + deprecation headers (reference
+middleware/baggage_middleware.py + middleware/deprecation.py)."""
+
+import aiohttp
+
+from test_gateway_app import BASIC, make_client
+
+
+async def test_baggage_lands_on_the_request_span():
+    client = await make_client(
+        otel_baggage_enabled="true",
+        otel_baggage_header_mappings_csv="x-tenant-id=tenant.id")
+    try:
+        resp = await client.get(
+            "/health",
+            headers={"baggage": "user.tier=gold;prop=x,region=eu",
+                     "x-tenant-id": "acme"})
+        assert resp.status == 200
+        spans = [s for s in client.app["ctx"].tracer.finished
+                 if s.name == "http.request"
+                 and s.attributes.get("http.path") == "/health"]
+        attrs = spans[-1].attributes
+        assert attrs["baggage.user.tier"] == "gold"   # property dropped
+        assert attrs["baggage.region"] == "eu"
+        assert attrs["baggage.tenant.id"] == "acme"   # header mapping
+    finally:
+        await client.close()
+
+
+async def test_baggage_bounds_and_sanitization():
+    client = await make_client(otel_baggage_enabled="true",
+                               otel_baggage_max_items="2")
+    try:
+        await client.get("/health", headers={
+            "baggage": "a=1,b=2,c=3,evil=x;y"})
+        spans = [s for s in client.app["ctx"].tracer.finished
+                 if s.name == "http.request"]
+        attrs = spans[-1].attributes
+        keys = [k for k in attrs if k.startswith("baggage.")]
+        assert len(keys) == 2  # max_items enforced
+    finally:
+        await client.close()
+
+
+async def test_operator_mappings_survive_padded_baggage():
+    """A client padding the baggage header must not starve the
+    operator's configured header mapping out of the item budget."""
+    client = await make_client(
+        otel_baggage_enabled="true", otel_baggage_max_items="3",
+        otel_baggage_header_mappings_csv="x-tenant-id=tenant.id")
+    try:
+        await client.get("/health", headers={
+            "baggage": "a=1,b=2,c=3,d=4,e=5",
+            "x-tenant-id": "acme"})
+        spans = [s for s in client.app["ctx"].tracer.finished
+                 if s.name == "http.request"]
+        attrs = spans[-1].attributes
+        assert attrs["baggage.tenant.id"] == "acme"  # admitted first
+    finally:
+        await client.close()
+
+
+async def test_baggage_total_size_budget_and_percent_decoding():
+    client = await make_client(otel_baggage_enabled="true",
+                               otel_baggage_max_size_bytes="24")
+    try:
+        # W3C percent-encoding decodes; total budget (not per-entry)
+        await client.get("/health", headers={
+            "baggage": "user.name=Jane%20Doe,big=" + "x" * 200})
+        spans = [s for s in client.app["ctx"].tracer.finished
+                 if s.name == "http.request"]
+        attrs = spans[-1].attributes
+        assert attrs["baggage.user.name"] == "Jane Doe"
+        assert "baggage.big" not in attrs  # would bust the 24-byte budget
+    finally:
+        await client.close()
+
+
+async def test_baggage_disabled_adds_nothing():
+    client = await make_client()
+    try:
+        await client.get("/health", headers={"baggage": "a=1"})
+        spans = [s for s in client.app["ctx"].tracer.finished
+                 if s.name == "http.request"]
+        assert not any(k.startswith("baggage.")
+                       for k in spans[-1].attributes)
+    finally:
+        await client.close()
+
+
+async def test_deprecation_headers_on_configured_prefixes():
+    client = await make_client(
+        deprecated_path_prefixes_csv="/metrics/rollups,/old",
+        legacy_api_sunset_date="Sat, 31 Dec 2026 23:59:59 GMT")
+    try:
+        resp = await client.get("/metrics/rollups",
+                                auth=aiohttp.BasicAuth(*BASIC))
+        assert resp.headers["Deprecation"] == "true"
+        assert resp.headers["Sunset"] == "Sat, 31 Dec 2026 23:59:59 GMT"
+        assert resp.headers["X-Deprecated-Endpoint"] == "/metrics/rollups"
+        # non-matching paths untouched
+        resp = await client.get("/health")
+        assert "Deprecation" not in resp.headers
+    finally:
+        await client.close()
