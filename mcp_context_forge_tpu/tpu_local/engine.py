@@ -117,6 +117,11 @@ class EngineConfig:
     # latency-sensitive low-concurrency serving.
     batch_buckets: bool = False
     batch_shrink_steps: int = 64
+    # idle-boundary width reset: after this long fully idle, the next
+    # admission re-sizes from the NEW load instead of inheriting a stale
+    # burst width. High enough that inter-wave dips (ms) never trigger
+    # the shrink+regrow re-home pair the hysteresis exists to avoid.
+    batch_idle_reset_s: float = 2.0
     # device-fault recovery (SURVEY §5.3): a crashed dispatch thread
     # rebuilds the KV pool, re-queues PENDING requests (mid-stream ones
     # fail — silent retry would duplicate emitted tokens) and restarts
@@ -363,6 +368,10 @@ class TPUEngine:
         # (arrays must cover the ceiling) and may compile.
         self._warmed_widths: set[int] = set()
         self._batch_width = self._batch_buckets()[0]  # smallest bucket
+        # when the engine last had active work (idle-boundary reset guard);
+        # starts "now" so the warmed start-at-max posture survives a
+        # burst arriving right after startup
+        self._last_active_ts = time.monotonic()
 
         dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
         devices = probe_devices(config.init_timeout_s)
@@ -1034,6 +1043,9 @@ class TPUEngine:
         self._drain_work()
         if not self._pending:
             return False
+        was_idle = (not self._running and not self._chunking
+                    and (time.monotonic() - self._last_active_ts
+                         >= config.batch_idle_reset_s))
         # priority classes: interactive requests admit before queued
         # background work (summaries must not make a chat turn wait for a
         # free slot — and the sort is stable, so FIFO holds within each
@@ -1144,6 +1156,31 @@ class TPUEngine:
         if not admitted:
             return False
         self._sync_tables()
+        self._last_active_ts = time.monotonic()
+        if was_idle and config.batch_buckets:
+            # idle-boundary width reset: a width inherited from a drained
+            # burst must not tax the next arrival for batch_shrink_steps
+            # decode steps (the config-3 post-burst bad mode: summaries
+            # decoding at width 64 with 8 active). Guards: the engine was
+            # idle past batch_idle_reset_s (millisecond inter-wave dips
+            # keep the warmed start-at-max posture), the ceiling counts
+            # ADMISSIBLE load only (a page-bound backlog must not hold a
+            # too-wide bucket over a handful of decodable slots — same
+            # clamp the decode-path sizing uses), slots were assigned
+            # from index 0 up so the bucket covers every admitted slot,
+            # and the reset never compiles (warmed widths only).
+            active = len(self._running) + len(self._chunking)
+            admissible = max(0, min(
+                len(self._pending),
+                config.max_batch - active,
+                self.allocator.free_pages
+                // self.allocator.avg_slot_pages()))
+            ceiling = min(active + admissible, config.max_batch)
+            desired = self._batch_bucket_for(max(ceiling, 1))
+            if desired < self._batch_width and desired in self._warmed_widths:
+                self._batch_width = desired
+                self._shrink_streak = 0
+                self._shrink_peak = 0
 
         if admitted[0].chunked:
             return True  # device work happens in _chunk_round
@@ -1383,6 +1420,8 @@ class TPUEngine:
         (slots are compacted first), so a lightly loaded engine doesn't
         pay full-capacity attention/sampling per step."""
         config = self.config
+        if self._running or self._chunking:
+            self._last_active_ts = time.monotonic()
         if config.batch_buckets:
             # Hysteresis on the width: switching executables makes XLA
             # re-home the donated KV pool (~a full pool copy), so width

@@ -240,6 +240,56 @@ def test_oversized_prompt_behind_blocked_chunker_rejects_cleanly():
 # a trained, repetitive model exercises the accepted-draft path)
 
 
+def test_idle_boundary_resets_stale_burst_width():
+    """A width inherited from a drained burst resets at the next idle
+    admission (the config-3 post-burst bad mode: 8 summaries decoding at
+    width 64 until the shrink hysteresis finally fires). The reset only
+    targets WARMED widths and only applies when the engine was idle."""
+    engine = _engine(max_batch=16, batch_buckets=True, num_pages=256)
+    ids = engine.tokenizer.encode("hello")
+    from mcp_context_forge_tpu.tpu_local.engine import GenRequest
+
+    # simulate post-burst state: width pinned at max, engine drained
+    # long enough to cross the idle-reset threshold
+    engine._warmed_widths = set(engine._batch_buckets())
+    engine._batch_width = 16
+    engine._last_active_ts = 0.0
+    engine._pending.append(GenRequest(request_id="i1", prompt_ids=ids,
+                                      max_tokens=4))
+    engine._admit_batch()
+    assert engine._batch_width == 8  # smallest bucket covering the load
+
+    # NOT idle: a second admission while one runs must not reset
+    engine._batch_width = 16
+    engine._last_active_ts = 0.0
+    engine._pending.append(GenRequest(request_id="i2", prompt_ids=ids,
+                                      max_tokens=4))
+    engine._admit_batch()
+    assert engine._batch_width == 16
+
+    # a millisecond inter-wave dip (recent activity) keeps the warmed
+    # start-at-max posture: no shrink+regrow re-home pair per wave
+    engine3 = _engine(max_batch=16, batch_buckets=True, num_pages=256)
+    engine3._warmed_widths = set(engine3._batch_buckets())
+    engine3._batch_width = 16
+    import time as _time
+    engine3._last_active_ts = _time.monotonic()  # active milliseconds ago
+    engine3._pending.append(GenRequest(request_id="i4", prompt_ids=ids,
+                                       max_tokens=4))
+    engine3._admit_batch()
+    assert engine3._batch_width == 16
+
+    # unwarmed target: the reset must never buy a compile
+    engine2 = _engine(max_batch=16, batch_buckets=True, num_pages=256)
+    engine2._warmed_widths = set()
+    engine2._batch_width = 16
+    engine2._last_active_ts = 0.0
+    engine2._pending.append(GenRequest(request_id="i3", prompt_ids=ids,
+                                       max_tokens=4))
+    engine2._admit_batch()
+    assert engine2._batch_width == 16
+
+
 def test_width_grows_to_cover_queued_admissible_load():
     """Anticipatory growth: the width targets active + ADMISSIBLE queued
     load — a big backlog grows to max in one hop, while ONE transiently
