@@ -331,6 +331,11 @@ class Settings(BaseModel):
     otel_otlp_endpoint: str = ""   # e.g. http://collector:4318 (OTLP/HTTP)
     otel_otlp_headers: str = ""    # JSON object of extra headers
     jax_profile_dir: str = "/tmp/mcpforge-jaxprof"  # /admin/engine/profile sink
+    # opt-in production profiler capture: the /admin/engine/profile*
+    # endpoints (duration capture + start/stop) 404 unless enabled —
+    # profiling writes device traces to disk and stalls the runtime, so
+    # a fleet operator must turn it on deliberately
+    jax_profile_enabled: bool = False
     log_level: str = "INFO"
     log_json: bool = False
     # rollup cadence (renamed from the misleading
@@ -415,6 +420,9 @@ class Settings(BaseModel):
     # pending requests and restarts itself (bounded); off = fail fast
     tpu_local_auto_restart: bool = False
     tpu_local_auto_restart_max: int = 3
+    # step-introspection ring size (per-dispatch summaries served by
+    # GET /admin/engine/steps)
+    tpu_local_step_log_size: int = 256
 
     # --- header passthrough (reference config.py:3489-3499: off by
     # default for security; sensitive headers need per-gateway opt-in) ---

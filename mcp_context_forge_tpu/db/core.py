@@ -73,6 +73,10 @@ class Migration:
 class Database:
     """One sqlite connection on one worker thread, async API."""
 
+    # RETURNING landed in sqlite 3.35; serving images commonly ship older
+    # (3.34 observed) — callers needing claim semantics branch on this
+    supports_returning = sqlite3.sqlite_version_info >= (3, 35, 0)
+
     def __init__(self, path: str = ":memory:",
                  busy_timeout_ms: int = 10000, max_retries: int = 3,
                  retry_interval_ms: float = 50.0):

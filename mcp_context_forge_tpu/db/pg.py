@@ -88,6 +88,8 @@ def translate_sql(sql: str) -> str:
 class PostgresDatabase:
     """Database API over the in-tree wire driver (db/pgwire.py)."""
 
+    supports_returning = True  # every supported PG version has RETURNING
+
     def __init__(self, dsn: str, pool_size: int = 8):
         self._dsn = dsn
         self._pool_size = pool_size

@@ -185,7 +185,7 @@ function cell(v, isBool){
   if (typeof v === "object") return esc(JSON.stringify(v).slice(0,80));
   return esc(String(v).slice(0,100));  // API data is attacker-influenced
 }
-function renderEngine(stats){
+async function renderEngine(stats){
   const order = ["requests","prompt_tokens","completion_tokens","decode_steps",
                  "prefill_batches","queue_depth","chunking","kv_pages_in_use",
                  "prefix_hits","prefix_hit_tokens","spec_steps","spec_tokens",
@@ -195,10 +195,41 @@ function renderEngine(stats){
   const rest = Object.keys(stats).filter(k => !order.includes(k));
   const extra = rest.map(k =>
     `<div class="card"><b>${cell(stats[k])}</b><span>${k}</span></div>`).join("");
+  // step introspection: what the scheduler dispatched last (newest first)
+  let steps = "";
+  try {
+    const r = await fetch("/admin/engine/steps?limit=32");
+    if (r.ok){
+      const intro = await r.json();
+      const cols = ["seq","kind","batch","width","bucket","ctx_pages",
+                    "duration_ms","tokens","queue_depth","kv_pages_in_use"];
+      const body = (intro.steps || []).slice().reverse().map(s =>
+        "<tr>" + cols.map(c => `<td>${cell(s[c])}</td>`).join("") + "</tr>"
+      ).join("");
+      if (body) steps = `<br><h3>recent engine steps</h3><table><tr>`
+        + cols.map(c => `<th>${esc(c)}</th>`).join("") + `</tr>${body}</table>`;
+    }
+  } catch(e){}
   document.getElementById("view").innerHTML =
-    `<div class="cards">${cards}${extra}</div>
-     <br><button class="act" onclick="engineProfile()">capture jax profile</button>`;
+    `<div class="cards">${cards}${extra}</div>${steps}
+     <br><button class="act" onclick="engineProfile()">capture jax profile</button>
+     <button class="act" onclick="engineProfileCtl('start')">start profile</button>
+     <button class="act" onclick="engineProfileCtl('stop')">stop profile</button>
+     <button class="act" onclick="engineProfileStatus()">profile status</button>`;
   document.getElementById("status").textContent = "engine stats";
+}
+async function engineProfileCtl(action){
+  const url = action === "start" ? "/admin/engine/profile/start"
+                                 : "/admin/engine/profile/stop";
+  const r = await fetch(url, {method:"POST"});
+  document.getElementById("status").textContent =
+    r.ok ? "profile " + action + " ok" : "profile " + action + " failed: " + r.status;
+}
+async function engineProfileStatus(){
+  const r = await fetch("/admin/engine/profile/status");
+  document.getElementById("status").textContent = r.ok
+    ? "profiler active: " + (await r.json()).active
+    : "profile status failed: " + r.status;
 }
 async function renderDiagnostics(){
   // system-scale counters + operation timing + support-bundle download

@@ -194,7 +194,12 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         from ..tpu_local.provider import LLMProviderRegistry
         from ..tpu_local.server import setup_llm_routes
         from ..tpu_local.tpu_provider import TPULocalProvider
-        engine = TPUEngine(EngineConfig.from_settings(settings))
+        # telemetry handles ride into the engine so the dispatch thread can
+        # emit llm.prefill/llm.decode spans + token-level SLO histograms
+        engine = TPUEngine(EngineConfig.from_settings(settings),
+                           tracer=tracer, metrics=metrics)
+        from ..services.diagnostics_service import JaxProfilerCapture
+        app["jax_profiler"] = JaxProfilerCapture(settings.jax_profile_dir)
         provider = TPULocalProvider(
             "tpu_local", engine,
             embedding_model=settings.tpu_local_embedding_model,
@@ -229,6 +234,7 @@ async def build_app(settings: Settings | None = None) -> web.Application:
                                sampling_handler=sampling_handler)
     app["dispatcher"] = dispatcher
     transport = StreamableHTTPTransport(dispatcher, settings)
+    transport.sessions.metrics = metrics  # mcpforge_sessions_active gauge
 
     # MCP listChanged notifications: catalog mutations fan out to every
     # connected stateful session (reference: notification_service +

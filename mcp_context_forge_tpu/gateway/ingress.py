@@ -92,13 +92,19 @@ class IngressMount:
         # every peer converges on the higher one (no split brain); and we
         # persist BEFORE touching local state — a failed write must not
         # leave this worker switched alone with the admin seeing a 500
-        rows = await self.ctx.db.execute(
+        await self.ctx.db.execute(
             "INSERT INTO global_config (key, value, updated_at)"
             " VALUES (?, '1', ?) ON CONFLICT(key) DO UPDATE SET"
             " value=CAST(CAST(value AS INTEGER)+1 AS TEXT),"
-            " updated_at=excluded.updated_at RETURNING value",
+            " updated_at=excluded.updated_at",
             (self._DB_KEY + ":version", changed_at))
-        version = int(rows[0]["value"]) if rows else self.version + 1
+        # re-read instead of RETURNING (sqlite >= 3.35 only): a concurrent
+        # switch may have advanced the counter further, which is fine —
+        # peers converge on the higher version by design
+        row = await self.ctx.db.fetchone(
+            "SELECT value FROM global_config WHERE key=?",
+            (self._DB_KEY + ":version",))
+        version = int(row["value"]) if row else self.version + 1
         await self.ctx.db.execute(
             "INSERT INTO global_config (key, value, updated_at) VALUES (?,?,?)"
             " ON CONFLICT(key) DO UPDATE SET value=excluded.value,"

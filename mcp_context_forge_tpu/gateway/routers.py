@@ -202,7 +202,27 @@ def setup_routes(app: web.Application) -> None:
         if not settings.password_reset_enabled:
             raise NotFoundError("password reset is disabled")
         started = _time.monotonic()
-        body = await request.json()
+
+        async def _floor() -> None:
+            # the enumeration guard must hold on EVERY exit path — a
+            # malformed-body fast 400 vs a padded 202 would itself be a
+            # timing side channel on the parse branch
+            remaining = (settings.password_reset_min_response_ms / 1e3
+                         - (_time.monotonic() - started))
+            if remaining > 0:
+                await _asyncio.sleep(remaining)
+
+        try:
+            body = await request.json()
+        except Exception:
+            # malformed JSON is a client error (400), not a 500
+            await _floor()
+            return web.json_response({"detail": "Invalid JSON body"},
+                                     status=400)
+        if not isinstance(body, dict):
+            await _floor()
+            return web.json_response({"detail": "body must be a JSON object"},
+                                     status=400)
         email = str(body.get("email", "")).strip().lower()
         if email:
             token = await request.app["auth_service"].request_password_reset(
@@ -221,10 +241,7 @@ def setup_routes(app: web.Application) -> None:
                             settings.password_reset_token_expiry_minutes))
                     tasks.add(task)
                     task.add_done_callback(tasks.discard)
-        floor_s = settings.password_reset_min_response_ms / 1e3
-        remaining = floor_s - (_time.monotonic() - started)
-        if remaining > 0:
-            await _asyncio.sleep(remaining)
+        await _floor()
         return web.json_response(
             {"status": "accepted",
              "detail": "If the account exists, a reset link was sent."},
@@ -264,7 +281,14 @@ document.getElementById("f").onsubmit = async (e) => {
         settings = request.app["ctx"].settings
         if not settings.password_reset_enabled:
             raise NotFoundError("password reset is disabled")
-        body = await request.json()
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"detail": "Invalid JSON body"},
+                                     status=400)
+        if not isinstance(body, dict):
+            return web.json_response({"detail": "body must be a JSON object"},
+                                     status=400)
         email = await request.app["auth_service"].reset_password(
             str(body.get("token", "")), str(body.get("new_password", "")))
         email_service = request.app.get("email_service")
@@ -788,38 +812,43 @@ document.getElementById("f").onsubmit = async (e) => {
         """Capture a jax.profiler trace of the running engine (SURVEY §5.1
         TPU mapping: jax.profiler integration alongside the OTel layer).
         Body: {"duration_ms": 1000, "dir": "/tmp/mcpforge-jaxprof"}."""
-        # writes to disk: an admin capability, not a read one
+        # writes to disk: an admin capability, not a read one — and opt-in
+        # via config (profiling stalls the runtime and writes traces)
         request["auth"].require("admin.all")
+        from .routers_extra import profiler_or_404
+
+        # the shared JaxProfilerCapture serializes EVERY profiling surface
+        # (the jax profiler is process-global): a timed capture and the
+        # start/stop endpoints must see each other's state. A concurrent
+        # capture raises ConflictError -> 409 via the error middleware.
+        profiler = profiler_or_404(request)
         engine = request.app.get("tpu_engine")
         if engine is None:
             raise NotFoundError("tpu_local engine is not enabled")
         body = await request.json() if request.can_read_body else {}
         duration_ms = min(float(body.get("duration_ms", 1000.0)), 30_000.0)
-        # server-configured destination only — a client-supplied path would
-        # be a filesystem-write primitive
-        trace_dir = request.app["ctx"].settings.jax_profile_dir
 
         import asyncio as _aio
-        import jax
 
-        if request.app.get("_jax_profile_active"):
-            return web.json_response(
-                {"detail": "a profile capture is already running"}, status=409)
-        request.app["_jax_profile_active"] = True
+        started = profiler.start()["started_at"]
         try:
-            jax.profiler.start_trace(trace_dir)
-            try:
-                await _aio.sleep(duration_ms / 1000.0)
-            finally:
-                jax.profiler.stop_trace()
+            await _aio.sleep(duration_ms / 1000.0)
         finally:
-            request.app["_jax_profile_active"] = False
-        return web.json_response({
-            "trace_dir": trace_dir, "duration_ms": duration_ms,
+            from ..services.base import ConflictError as _Conflict
+            try:
+                # stop OUR capture only: an operator who stopped it and
+                # started their own mid-window must not lose theirs
+                result = profiler.stop(expect_started_at=started)
+            except _Conflict:
+                result = {"active": profiler.active,
+                          "trace_dir": profiler.trace_dir,
+                          "detail": "capture was stopped externally"}
+        result.update({
+            "duration_ms": duration_ms,
             "decode_steps": engine.stats.decode_steps,
             "prefill_batches": engine.stats.prefill_batches,
-            "hint": "open with TensorBoard or xprof: the trace contains"
-                    " XLA op timelines for prefill/decode"})
+        })
+        return web.json_response(result)
 
     @routes.get("/admin/traces/{trace_id}")
     async def admin_trace_tree(request: web.Request) -> web.Response:

@@ -15,6 +15,19 @@ from ..schemas import A2AAgentCreate
 from ..services.base import NotFoundError, ValidationFailure
 
 
+def profiler_or_404(request: web.Request):
+    """The single gate for EVERY profiling surface (timed capture and
+    start/stop/status): opt-in config flag first, then the shared
+    JaxProfilerCapture (created only alongside the engine)."""
+    if not request.app["ctx"].settings.jax_profile_enabled:
+        raise NotFoundError("profiler capture is disabled "
+                            "(set MCPFORGE_JAX_PROFILE_ENABLED=true)")
+    profiler = request.app.get("jax_profiler")
+    if profiler is None:
+        raise NotFoundError("tpu_local engine is not enabled")
+    return profiler
+
+
 def setup_extra_routes(app: web.Application) -> None:
     routes = web.RouteTableDef()
 
@@ -142,6 +155,43 @@ def setup_extra_routes(app: web.Application) -> None:
             supports_chat=bool(body.get("supports_chat", True)),
             supports_embeddings=bool(body.get("supports_embeddings", False)))
         return web.json_response(model, status=201)
+
+    # ------------------------------------------------- engine introspection
+    @routes.get("/admin/engine/steps")
+    async def engine_steps(request: web.Request) -> web.Response:
+        """Last N engine step summaries from the in-engine ring buffer
+        (step kind, batch size, padded shape, duration, tokens emitted) —
+        the operator's 'what is the scheduler actually dispatching right
+        now' answer for the admin UI. Read-only."""
+        request["auth"].require("observability.read")
+        engine = request.app.get("tpu_engine")
+        if engine is None:
+            raise NotFoundError("tpu_local engine is not enabled")
+        from ..services.diagnostics_service import engine_introspection
+        try:
+            limit = int(request.query.get("limit", "64"))
+        except ValueError as exc:
+            raise ValidationFailure("limit must be an integer") from exc
+        return web.json_response(
+            engine_introspection(engine, limit=max(1, min(limit, 1024))))
+
+    @routes.get("/admin/engine/profile/status")
+    async def profile_status(request: web.Request) -> web.Response:
+        request["auth"].require("observability.read")
+        return web.json_response(profiler_or_404(request).status())
+
+    @routes.post("/admin/engine/profile/start")
+    async def profile_start(request: web.Request) -> web.Response:
+        """Begin an open-ended jax.profiler capture (stop it with
+        /admin/engine/profile/stop); operator brackets exactly the
+        traffic window they care about."""
+        request["auth"].require("admin.all")
+        return web.json_response(profiler_or_404(request).start())
+
+    @routes.post("/admin/engine/profile/stop")
+    async def profile_stop(request: web.Request) -> web.Response:
+        request["auth"].require("admin.all")
+        return web.json_response(profiler_or_404(request).stop())
 
     # ---------------------------------------------------------------- plugins
     @routes.get("/plugins")

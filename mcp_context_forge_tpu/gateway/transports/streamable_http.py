@@ -80,6 +80,11 @@ class SessionManager:
         self.events = InMemoryEventStore()
         self.ttl = ttl
         self._sweeper: asyncio.Task | None = None
+        self.metrics = None  # PrometheusRegistry, set by app wiring
+
+    def _sync_gauge(self) -> None:
+        if self.metrics is not None:
+            self.metrics.sessions_active.set(len(self.sessions))
 
     async def start_sweeper(self) -> None:
         if self._sweeper is None:
@@ -101,6 +106,7 @@ class SessionManager:
     def create(self) -> StreamSession:
         session = StreamSession(id=new_id())
         self.sessions[session.id] = session
+        self._sync_gauge()
         return session
 
     def get(self, session_id: str) -> StreamSession | None:
@@ -112,6 +118,7 @@ class SessionManager:
     def drop(self, session_id: str) -> None:
         self.sessions.pop(session_id, None)
         self.events.drop(session_id)
+        self._sync_gauge()
 
     def sweep(self) -> None:
         cutoff = time.time() - self.ttl

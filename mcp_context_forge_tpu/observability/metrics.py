@@ -62,6 +62,53 @@ class PrometheusRegistry:
             "mcpforge_llm_kv_pages_in_use", "Paged KV cache pages in use",
             registry=self.registry,
         )
+        # token-level SLO signals (fed by the engine dispatch thread):
+        # TTFT = submit -> first token (queue + prefill), TPOT = mean
+        # inter-token latency over the decode phase of one request
+        self.llm_ttft = Histogram(
+            "mcpforge_llm_ttft_seconds", "Time to first token",
+            ["model"], registry=self.registry,
+            buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                     10.0, 30.0),
+        )
+        self.llm_tpot = Histogram(
+            "mcpforge_llm_tpot_seconds",
+            "Per-token decode latency (mean over one request)",
+            ["model"], registry=self.registry,
+            buckets=(0.002, 0.005, 0.01, 0.02, 0.04, 0.08, 0.15, 0.3,
+                     0.6, 1.2, 2.5),
+        )
+        self.llm_queue_wait = Histogram(
+            "mcpforge_llm_queue_wait_seconds",
+            "Submit -> batch admission wait", registry=self.registry,
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0,
+                     60.0),
+        )
+        self.llm_batch_occupancy = Gauge(
+            "mcpforge_llm_batch_occupancy",
+            "Active decode slots at the last engine step",
+            registry=self.registry,
+        )
+        self.llm_kv_page_utilization = Gauge(
+            "mcpforge_llm_kv_page_utilization",
+            "Fraction of the paged KV pool in use (0..1)",
+            registry=self.registry,
+        )
+        self.llm_kv_alloc_failures = Counter(
+            "mcpforge_llm_kv_alloc_failures_total",
+            "Admissions deferred or requests truncated for lack of KV pages",
+            registry=self.registry,
+        )
+        self.llm_step_tokens_per_sec = Gauge(
+            "mcpforge_llm_step_tokens_per_sec",
+            "Tokens emitted per second by the last engine step",
+            registry=self.registry,
+        )
+        self.llm_providers_wired = Gauge(
+            "mcpforge_llm_providers_wired",
+            "External LLM providers currently wired into the registry",
+            registry=self.registry,
+        )
         self.sessions_active = Gauge(
             "mcpforge_sessions_active", "Active MCP sessions", registry=self.registry,
         )

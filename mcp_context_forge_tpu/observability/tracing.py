@@ -57,6 +57,11 @@ class Span:
     def traceparent(self) -> str:
         return f"00-{self.trace_id}-{self.span_id}-01"
 
+    def context(self) -> tuple[str, str]:
+        """(trace_id, span_id) — hand this across threads so work running
+        off the contextvar chain (engine dispatch) can parent to it."""
+        return self.trace_id, self.span_id
+
 
 class Tracer:
     def __init__(self, service_name: str = "mcpforge", exporter: str = "memory",
@@ -91,8 +96,36 @@ class Tracer:
             raise
         finally:
             span.end_ts = time.time()
-            _current_span.reset(token)
+            try:
+                _current_span.reset(token)
+            except ValueError:
+                # the span was opened inside an (async) generator that a
+                # different context is now closing (GC-driven aclose):
+                # the token belongs to a foreign Context, whose own spans
+                # must not be touched — the original context never sees
+                # this span again anyway, so leave everything alone
+                pass
             self._finish(span)
+
+    def emit_span(self, name: str, start_ts: float, end_ts: float,
+                  trace_ctx: tuple[str, str] | None = None,
+                  attributes: dict[str, Any] | None = None,
+                  status: str = "OK") -> Span:
+        """Record an already-completed span with explicit timing and
+        parentage. For producers that cannot wrap their work in the
+        ``span()`` context manager — the engine dispatch thread measures
+        phases for many interleaved requests at once, then reports each
+        one here with the (trace_id, span_id) its submitter captured."""
+        if trace_ctx is not None:
+            trace_id, parent_id = trace_ctx
+        else:
+            trace_id, parent_id = _rand_hex(16), None
+        span = Span(name=name, trace_id=trace_id, span_id=_rand_hex(8),
+                    parent_span_id=parent_id, start_ts=start_ts,
+                    attributes=dict(attributes or {}), status=status)
+        span.end_ts = end_ts
+        self._finish(span)
+        return span
 
     def _finish(self, span: Span) -> None:
         if self.exporter == "memory":

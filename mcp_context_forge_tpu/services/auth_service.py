@@ -223,11 +223,15 @@ class AuthService:
         """Admin lever for the enforcement middleware (reference
         password_change_enforcement.py): the flagged user can only reach
         /auth/password until they rotate."""
-        rows = await self.ctx.db.execute(
+        # no RETURNING: sqlite < 3.35 (still common in serving images)
+        # rejects it — update, then confirm the row exists portably
+        await self.ctx.db.execute(
             "UPDATE users SET password_change_required=?, updated_at=?"
-            " WHERE email=? RETURNING email",
+            " WHERE email=?",
             (int(required), now(), email))
-        if not rows:
+        row = await self.ctx.db.fetchone(
+            "SELECT email FROM users WHERE email=?", (email,))
+        if not row:
             raise NotFoundError(f"User {email} not found")
         self.invalidate_user(email)
 
@@ -297,13 +301,30 @@ class AuthService:
         self.validate_password_policy(new_password, email)
         # atomic claim: the conditional UPDATE is the single-use gate —
         # two concurrent resets with the same token both pass the SELECT
-        # above, but only one RETURNING row exists (the db serializes
-        # writes on one connection)
-        claimed = await self.ctx.db.execute(
-            "UPDATE password_reset_tokens SET used_at=?"
-            " WHERE token_hash=? AND used_at IS NULL RETURNING token_hash",
-            (now(), row["token_hash"]))
-        if not claimed:
+        # above, but only one UPDATE matches the used_at IS NULL row.
+        claim_ts = now()
+        if getattr(self.ctx.db, "supports_returning", False):
+            # PG (and sqlite >= 3.35): RETURNING reports the winner in
+            # one round trip — no float round-trip comparison involved
+            won = bool(await self.ctx.db.execute(
+                "UPDATE password_reset_tokens SET used_at=?"
+                " WHERE token_hash=? AND used_at IS NULL"
+                " RETURNING token_hash",
+                (claim_ts, row["token_hash"])))
+        else:
+            # old sqlite: stamp our claim timestamp, re-read, and check it
+            # is OURS that persisted. Sound here because all writes
+            # serialize on the Database's single connection and sqlite
+            # REAL is float8 — the float round-trips exactly.
+            await self.ctx.db.execute(
+                "UPDATE password_reset_tokens SET used_at=?"
+                " WHERE token_hash=? AND used_at IS NULL",
+                (claim_ts, row["token_hash"]))
+            claimed = await self.ctx.db.fetchone(
+                "SELECT used_at FROM password_reset_tokens"
+                " WHERE token_hash=?", (row["token_hash"],))
+            won = bool(claimed) and claimed["used_at"] == claim_ts
+        if not won:
             raise AuthError("Invalid or expired reset token")
         invalidate = self.ctx.settings.password_reset_invalidate_sessions
         await self.ctx.db.execute(  # seclint: allow S006 fixed literal branch, no user data in SQL text

@@ -135,8 +135,13 @@ class ChatService:
         tools = await self._tool_defs(session, auth_teams or [])
         session.messages.append({"role": "user", "content": text})
 
-        with self.ctx.tracer.span("llmchat.turn", {"session": session.id,
-                                                   "user": user}):
+        with self.ctx.tracer.span("llmchat.turn", {
+                "session": session.id, "user": user,
+                "gen_ai.request.model": session.model or "default",
+                "llm.tools_offered": len(tools)}) as turn_span:
+            # tolerate stub tracers whose spans don't expose set_attribute
+            set_attr = getattr(turn_span, "set_attribute", lambda *a: None)
+            total_tool_calls = 0
             for step in range(session.max_steps):
                 request = {
                     "model": session.model,
@@ -198,9 +203,12 @@ class ChatService:
                     session.messages.append({"role": "assistant",
                                              "content": reply})
                     await self._save(session)
+                    set_attr("llm.steps", step + 1)
+                    set_attr("llm.tool_calls", total_tool_calls)
                     yield {"type": "answer", "text": reply, "usage": usage}
                     return
 
+                total_tool_calls += len(tool_calls)
                 for call in tool_calls:
                     fn = call.get("function", {})
                     yield {"type": "tool_call", "id": call.get("id"),
@@ -221,6 +229,10 @@ class ChatService:
                            "text": message["content"][:500], "step": step}
                     session.messages.append(message)
                 await self._save(session)
+            # runaway turn (max_steps exhausted): the span an operator
+            # filters for must still carry the step/tool-call counters
+            set_attr("llm.steps", session.max_steps)
+            set_attr("llm.tool_calls", total_tool_calls)
             yield {"type": "error",
                    "message": f"Agent exceeded {session.max_steps} steps"}
 

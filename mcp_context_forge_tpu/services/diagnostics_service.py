@@ -37,10 +37,90 @@ from typing import Any
 
 from .. import PROTOCOL_VERSION, __version__
 from ..observability.logging import ring_buffer
-from ..utils.redact import redact_env, redact_settings
-from .base import AppContext
+from ..utils.redact import redact_env, redact_settings, redact_text
+from .base import AppContext, ConflictError
 
 logger = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------------------
+# engine step introspection + profiler capture
+# --------------------------------------------------------------------------
+
+def engine_introspection(engine: Any, limit: int = 64) -> dict[str, Any]:
+    """The engine's step ring buffer plus the scheduler counters an
+    operator needs to read it (served by GET /admin/engine/steps and
+    included in the support bundle)."""
+    stats = engine.stats
+    return {
+        "model": engine.config.model,
+        "max_batch": engine.config.max_batch,
+        "queue_depth": stats.queue_depth,
+        "decode_steps": stats.decode_steps,
+        "prefill_batches": stats.prefill_batches,
+        "chunking": stats.chunking,
+        "kv": {
+            "pages_in_use": engine.allocator.pages_in_use,
+            "free_pages": engine.allocator.free_pages,
+            "num_pages": engine.config.num_pages,
+            "page_size": engine.config.page_size,
+        },
+        "steps": engine.recent_steps(limit),
+    }
+
+
+class JaxProfilerCapture:
+    """Opt-in ``jax.profiler`` trace capture of the live engine (SURVEY
+    §5.1: jax.profiler integration alongside the OTel layer).
+
+    start()/stop() let an operator bracket exactly the traffic they care
+    about on a production v5e slice; the trace lands in the
+    server-configured ``jax_profile_dir`` (never a client-supplied path —
+    that would be a filesystem-write primitive). The profiler is
+    process-global, so captures are serialized through this object."""
+
+    def __init__(self, trace_dir: str) -> None:
+        self.trace_dir = trace_dir
+        self._started_at: float | None = None
+
+    @property
+    def active(self) -> bool:
+        return self._started_at is not None
+
+    def status(self) -> dict[str, Any]:
+        return {"active": self.active, "trace_dir": self.trace_dir,
+                "started_at": self._started_at}
+
+    def start(self) -> dict[str, Any]:
+        if self.active:
+            raise ConflictError("a profiler capture is already running")
+        import jax
+
+        jax.profiler.start_trace(self.trace_dir)
+        self._started_at = time.time()
+        return self.status()
+
+    def stop(self, expect_started_at: float | None = None) -> dict[str, Any]:
+        """``expect_started_at`` lets a timed capture stop only the capture
+        it started — without it, a concurrent operator's stop+start window
+        would let the timed handler silently kill the operator's capture."""
+        if not self.active:
+            raise ConflictError("no profiler capture is running")
+        if (expect_started_at is not None
+                and self._started_at != expect_started_at):
+            raise ConflictError("the running capture belongs to another "
+                                "caller; leaving it alone")
+        import jax
+
+        started = self._started_at
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            self._started_at = None
+        return {"active": False, "trace_dir": self.trace_dir,
+                "duration_ms": round((time.time() - (started or 0.0)) * 1e3, 1),
+                "hint": "open with TensorBoard or xprof: the trace contains"
+                        " XLA op timelines for prefill/decode"}
 
 
 # --------------------------------------------------------------------------
@@ -345,12 +425,21 @@ class SupportBundleService:
                         "decode_steps": stats.decode_steps,
                         "queue_depth": stats.queue_depth,
                     })
+                    if hasattr(engine, "recent_steps"):
+                        put("engine_steps.json",
+                            engine_introspection(engine, limit=128))
                 except Exception as exc:  # diagnostics must not fail the bundle
                     put("engine.json", {"error": str(exc)})
             if include_logs:
+                # log MESSAGES are free text: exception strings and
+                # third-party libraries embed DSNs/bearer tokens that the
+                # name-keyed settings redaction never sees — run every
+                # serialized record through the content redaction pass
+                # before it reaches the 'sanitized: true' archive
                 records = ring_buffer.search(limit=log_tail)
                 put("logs/recent.jsonl",
-                    "\n".join(json.dumps(r, default=str) for r in records))
+                    "\n".join(redact_text(json.dumps(r, default=str))
+                              for r in records))
             perf = self._ctx.extras.get("perf_tracker")
             if perf is not None:
                 put("performance.json", perf.summary())

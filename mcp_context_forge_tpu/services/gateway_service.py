@@ -143,19 +143,23 @@ class GatewayService:
                    if auth_value else None),
                "passthrough_headers": None, "id": "", "name": "(test)"}
         started = time.monotonic()
+
+        async def _probe() -> dict:
+            async with await self._connect(row) as session:
+                tools = await session.list_tools()
+                return {
+                    "ok": True,
+                    "latency_ms": round(
+                        (time.monotonic() - started) * 1000, 1),
+                    "server_info": session.server_info,
+                    "capabilities": sorted(session.capabilities),
+                    "tool_count": len(tools),
+                }
+
         try:
-            async with asyncio.timeout(
-                    self.ctx.settings.gateway_validation_timeout):
-                async with await self._connect(row) as session:
-                    tools = await session.list_tools()
-                    return {
-                        "ok": True,
-                        "latency_ms": round(
-                            (time.monotonic() - started) * 1000, 1),
-                        "server_info": session.server_info,
-                        "capabilities": sorted(session.capabilities),
-                        "tool_count": len(tools),
-                    }
+            # wait_for, not asyncio.timeout: the serving image is 3.10
+            return await asyncio.wait_for(
+                _probe(), self.ctx.settings.gateway_validation_timeout)
         except Exception as exc:
             return {"ok": False,
                     "latency_ms": round((time.monotonic() - started) * 1000, 1),

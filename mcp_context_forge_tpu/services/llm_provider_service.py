@@ -117,8 +117,21 @@ class LLMProviderService:
         registered by the app at startup and kept)."""
         rows = await self.ctx.db.fetchall(
             "SELECT * FROM llm_providers WHERE enabled=1")
-        for row in rows:
-            await self._wire_provider(row)
+        # gauge counts EXTERNAL providers actually wired — tpu_local rows
+        # are registered by app startup and skipped here, and a row whose
+        # config fails to decrypt must not be counted (the gauge exists to
+        # surface exactly that degraded state), so update in finally
+        wired = 0
+        try:
+            with self.ctx.tracer.span("llm.provider.rewire",
+                                      {"providers": len(rows)}):
+                for row in rows:
+                    await self._wire_provider(row)
+                    if row["provider_type"] != "tpu_local":
+                        wired += 1
+        finally:
+            if self.ctx.metrics is not None:
+                self.ctx.metrics.llm_providers_wired.set(wired)
 
     async def _wire_provider(self, row: dict[str, Any]) -> None:
         if row["provider_type"] == "tpu_local":

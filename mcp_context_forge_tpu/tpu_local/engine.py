@@ -129,6 +129,9 @@ class EngineConfig:
     # benches prefer fail-fast; production serving turns it on.
     auto_restart: bool = False
     auto_restart_max: int = 3
+    # step-introspection ring: per-dispatch summaries (kind, batch shape,
+    # duration, tokens) kept for the diagnostics endpoint / admin UI
+    step_log_size: int = 256
 
     @classmethod
     def from_settings(cls, settings) -> "EngineConfig":
@@ -160,6 +163,7 @@ class EngineConfig:
             max_queue=getattr(settings, "tpu_local_max_queue", 1024),
             auto_restart=getattr(settings, "tpu_local_auto_restart", False),
             auto_restart_max=getattr(settings, "tpu_local_auto_restart_max", 3),
+            step_log_size=getattr(settings, "tpu_local_step_log_size", 256),
         )
 
 
@@ -196,6 +200,13 @@ class GenRequest:
     bucket: int = -1
     chunked: bool = False
     chunk_pos: int = 0   # tokens prefilled so far (chunk-round scheduler)
+    # telemetry: (trace_id, span_id) of the submitter's llm.request span —
+    # the dispatch thread parents llm.queue/prefill/decode spans to it
+    trace_ctx: tuple[str, str] | None = None
+    first_token_ts: float = 0.0
+    # once-only guard: crash-recovery requeues pass admission twice, and
+    # the queue span/histogram must not double-observe the request
+    queue_observed: bool = False
 
 
 class EngineStats:
@@ -307,7 +318,14 @@ class TPUEngine:
     """Owns params + KV pool on the mesh; device syncs run on the dispatch
     thread, token emission hops back to the asyncio loop."""
 
-    def __init__(self, config: EngineConfig):
+    def __init__(self, config: EngineConfig, tracer=None, metrics=None):
+        # telemetry handles are optional: None means zero-cost no-ops, so
+        # unit tests and benches constructing engines directly pay nothing
+        self.tracer = tracer
+        self.metrics = metrics
+        self.step_log: deque[dict[str, Any]] = deque(
+            maxlen=max(1, config.step_log_size))
+        self._step_seq = 0
         if config.decode_block < 1:
             raise ValueError(
                 f"decode_block must be >= 1, got {config.decode_block}")
@@ -831,6 +849,8 @@ class TPUEngine:
                 self._check_alive()
                 await asyncio.sleep(0.005)
         self.stats.queue_depth = self._work.qsize() + len(self._pending)
+        if self.metrics is not None:
+            self.metrics.llm_queue_depth.set(self.stats.queue_depth)
         return request
 
     def _check_alive(self) -> None:
@@ -912,6 +932,10 @@ class TPUEngine:
         for request in list(self._running.values()):
             if request.finish_reason is None:
                 request.finish_reason = "error"
+            # crash-killed requests are the ones an operator hunts for in
+            # traces — emit their ERROR llm.decode span like every other
+            # termination path does
+            self._observe_finish(request)
             self._running.pop(request.slot, None)
             self._post_tokens(request, [], done=True)
         try:
@@ -1139,12 +1163,15 @@ class TPUEngine:
                 # page pressure: release the match (references held past
                 # this point would pin pages and could deadlock admission)
                 # and retry later with a fresh probe
+                if self.metrics is not None:
+                    self.metrics.llm_kv_alloc_failures.inc()
                 self.allocator.release_prefix(shared)
                 request.bucket = -1
                 self._pending.appendleft(request)
                 continue
             request.slot = slot
             request.queue_ms = (time.time() - request.created) * 1000
+            self._observe_admitted(request)
             if request.chunked:
                 # chunk-round scheduler owns it until the prompt is fully
                 # prefilled; slots/pages are held, decode ignores it
@@ -1218,6 +1245,10 @@ class TPUEngine:
         self.stats.prefill_ms_total += elapsed_ms
         self.stats.prefill_batches += 1
         self.stats.prefill_requests += len(admitted)
+        self._record_step("prefill", batch=len(admitted),
+                          width=int(tokens.shape[0]),  # the dispatched pad
+                          dur_ms=elapsed_ms, tokens=len(admitted),
+                          bucket=bucket)
         for i, request in enumerate(admitted):
             request.prefill_ms = elapsed_ms
             self._emit(request, int(first_host[i]))
@@ -1293,6 +1324,12 @@ class TPUEngine:
         elapsed_ms = (time.monotonic() - started) * 1000
         self.stats.prefill_batches += 1
         self.stats.prefill_ms_total += elapsed_ms
+        self._record_step(
+            "chunk_prefill", batch=len(batch), width=int(tokens.shape[0]),
+            dur_ms=elapsed_ms,
+            tokens=sum(1 for r in batch
+                       if r.chunk_pos >= len(r.prompt_ids)),
+            bucket=S)
         for i, request in enumerate(batch):
             request.prefill_ms += elapsed_ms
             if request.chunk_pos < len(request.prompt_ids):
@@ -1374,7 +1411,10 @@ class TPUEngine:
                     break
             widths[slot] = usable
             if usable == 0:
+                # page pool exhausted mid-stream: the request truncates
                 request.finish_reason = "length"
+                if self.metrics is not None:
+                    self.metrics.llm_kv_alloc_failures.inc()
                 continue
             chunk = chunk[:usable]
             chunks[slot] = chunk
@@ -1387,13 +1427,17 @@ class TPUEngine:
         sampling = SamplingParams(jnp.asarray(temperature), jnp.asarray(top_k),
                                   jnp.asarray(top_p))
         self._rng, key = jax.random.split(self._rng)
+        started = time.monotonic()
         max_pos = int(positions.max()) + 1 if active else K
-        block, self.kv = self._verify_fn(self._ctx_bucket_for(max_pos))(
+        spec_ctx_pages = self._ctx_bucket_for(max_pos)
+        block, self.kv = self._verify_fn(spec_ctx_pages)(
             self.params, self.kv, jnp.asarray(tokens), jnp.asarray(positions),
             jnp.arange(B, dtype=jnp.int32), sampling, key)
         self.stats.decode_steps += 1
         self.stats.spec_steps += 1
         block_host = jax.device_get(block)  # [B, K]
+        spec_elapsed_ms = (time.monotonic() - started) * 1000
+        spec_emitted = 0
         for slot, request in active:
             if request.finish_reason == "length" and request.slot in self._running:
                 self._finish(request)
@@ -1411,6 +1455,10 @@ class TPUEngine:
                 if request.slot not in self._running:
                     break  # EOS/stop/max hit inside the chunk
             self.stats.spec_tokens += max(0, emitted - 1)
+            spec_emitted += emitted
+        self._record_step("spec_decode", batch=len(active), width=B,
+                          dur_ms=spec_elapsed_ms, tokens=spec_emitted,
+                          ctx_pages=spec_ctx_pages)
 
     # ------------------------------------------------------------ decode step
 
@@ -1526,7 +1574,10 @@ class TPUEngine:
                     break
             budgets[slot] = usable
             if usable == 0:
+                # page pool exhausted mid-stream: the request truncates
                 request.finish_reason = "length"
+                if self.metrics is not None:
+                    self.metrics.llm_kv_alloc_failures.inc()
         self._sync_tables()
         sampling = SamplingParams(jnp.asarray(temperature), jnp.asarray(top_k),
                                   jnp.asarray(top_p))
@@ -1540,15 +1591,110 @@ class TPUEngine:
             jnp.arange(B, dtype=jnp.int32), jnp.asarray(seq_lens), sampling, key)
         self.stats.decode_steps += k
         block_host = jax.device_get(block_tokens)  # [k, B]
-        self.stats.decode_ms_total += (time.monotonic() - started) * 1000
+        decode_elapsed_ms = (time.monotonic() - started) * 1000
+        self.stats.decode_ms_total += decode_elapsed_ms
+        decode_emitted = 0
         for slot, request in active:
             if request.finish_reason == "length" and request.slot in self._running:
                 self._finish(request)
                 continue
             for step_i in range(budgets[slot]):
                 self._emit(request, int(block_host[step_i][slot]))
+                decode_emitted += 1
                 if request.slot not in self._running:
                     break  # finished (EOS/stop/max): rest of block discarded
+        self._record_step("decode", batch=len(active), width=B,
+                          dur_ms=decode_elapsed_ms, tokens=decode_emitted,
+                          ctx_pages=ctx_pages)
+
+    # --------------------------------------------------------------- telemetry
+
+    def _record_step(self, kind: str, *, batch: int, width: int,
+                     dur_ms: float, tokens: int, bucket: int | None = None,
+                     ctx_pages: int | None = None) -> None:
+        """One ring-buffer entry + gauge refresh per device dispatch.
+        Runs on the dispatch thread; deque.append and prometheus_client
+        ops are both thread-safe, and the asyncio side only ever copies
+        the deque (recent_steps), never mutates it."""
+        self._step_seq += 1
+        depth = self._work.qsize() + len(self._pending)
+        pages_in_use = self.allocator.pages_in_use
+        self.step_log.append({
+            "seq": self._step_seq,
+            "ts": time.time(),
+            "kind": kind,                       # prefill|chunk_prefill|decode|spec_decode
+            "batch": batch,                     # rows carrying real work
+            "width": width,                     # padded dispatch width
+            "bucket": bucket,                   # prefill token bucket (S)
+            "ctx_pages": ctx_pages,             # decode context-width bucket
+            "duration_ms": round(dur_ms, 3),
+            "tokens": tokens,                   # tokens emitted by this step
+            "queue_depth": depth,
+            "kv_pages_in_use": pages_in_use,
+        })
+        m = self.metrics
+        if m is not None:
+            m.llm_batch_occupancy.set(len(self._running) + len(self._chunking))
+            m.llm_kv_pages_in_use.set(pages_in_use)
+            m.llm_kv_page_utilization.set(
+                pages_in_use / max(1, self.config.num_pages - 1))
+            m.llm_queue_depth.set(depth)
+            if dur_ms > 0 and tokens:
+                m.llm_step_tokens_per_sec.set(tokens / (dur_ms / 1e3))
+
+    def recent_steps(self, limit: int | None = None) -> list[dict[str, Any]]:
+        """Last N step summaries, oldest first (diagnostics surface)."""
+        steps = list(self.step_log)
+        if limit is not None and limit > 0:
+            steps = steps[-limit:]
+        return steps
+
+    def _span(self, name: str, request: GenRequest, start_ts: float,
+              end_ts: float, status: str = "OK", **attrs: Any) -> None:
+        """Emit one per-request engine span parented to the submitter's
+        llm.request span (no contextvars on the dispatch thread)."""
+        if self.tracer is None or request.trace_ctx is None:
+            return
+        attributes: dict[str, Any] = {
+            "gen_ai.system": "tpu_local",
+            "gen_ai.request.model": self.config.model,
+            "llm.slot": request.slot,
+        }
+        attributes.update(attrs)
+        try:
+            self.tracer.emit_span(name, start_ts, end_ts,
+                                  trace_ctx=request.trace_ctx,
+                                  attributes=attributes, status=status)
+        except Exception:
+            pass  # telemetry must never kill the dispatch thread
+
+    def _observe_admitted(self, request: GenRequest) -> None:
+        """Queue-phase telemetry at the moment a request wins a slot."""
+        if request.queue_observed:
+            return  # re-admission after crash recovery
+        request.queue_observed = True
+        if self.metrics is not None:
+            self.metrics.llm_queue_wait.observe(
+                max(0.0, request.queue_ms / 1e3))
+        self._span("llm.queue", request, request.created, time.time(),
+                   **{"llm.queue_ms": round(request.queue_ms, 2),
+                      "llm.priority": request.priority})
+
+    def _observe_finish(self, request: GenRequest) -> None:
+        """Decode-phase telemetry when a request leaves the engine: TPOT
+        over the inter-token phase + the llm.decode span."""
+        now = time.time()
+        n = len(request.generated)
+        decode_start = request.first_token_ts or now
+        if self.metrics is not None and n > 1:
+            self.metrics.llm_tpot.labels(model=self.config.model).observe(
+                max(0.0, (now - decode_start) / (n - 1)))
+        reason = request.finish_reason or "stop"
+        self._span("llm.decode", request, decode_start, now,
+                   status="OK" if reason in ("stop", "length") else "ERROR",
+                   **{"gen_ai.usage.completion_tokens": n,
+                      "llm.finish_reason": reason,
+                      "llm.kv_pages": self.allocator.slot_pages(request.slot)})
 
     # ---------------------------------------------------------------- plumbing
 
@@ -1558,6 +1704,20 @@ class TPUEngine:
     def _emit(self, request: GenRequest, token: int) -> None:
         request.generated.append(token)
         self.stats.completion_tokens += 1
+        if request.first_token_ts == 0.0:
+            request.first_token_ts = time.time()
+            if self.metrics is not None:
+                self.metrics.llm_ttft.labels(model=self.config.model).observe(
+                    max(0.0, request.first_token_ts - request.created))
+            self._span("llm.prefill", request, request.created
+                       + request.queue_ms / 1e3, request.first_token_ts,
+                       **{"gen_ai.usage.prompt_tokens": len(request.prompt_ids),
+                          "llm.prefill_ms": round(request.prefill_ms, 2),
+                          "llm.bucket": request.bucket,
+                          "llm.cached_prefix_tokens": request.hist,
+                          "llm.chunked": request.chunked,
+                          "llm.kv_pages": self.allocator.slot_pages(
+                              request.slot)})
         done = (token == self.tokenizer.eos_id or token in request.stop_ids
                 or len(request.generated) >= request.max_tokens)
         if done and request.finish_reason is None:
@@ -1565,12 +1725,14 @@ class TPUEngine:
                                                 or token in request.stop_ids)
                                      else "length")
         if done:
+            self._observe_finish(request)  # before free_slot: pages still held
             self._running.pop(request.slot, None)
             self.allocator.free_slot(request.slot)
             self._sync_tables()
         self._post_tokens(request, [token], done=done)
 
     def _finish(self, request: GenRequest) -> None:
+        self._observe_finish(request)
         self._running.pop(request.slot, None)
         self.allocator.free_slot(request.slot)
         self._sync_tables()

@@ -178,6 +178,10 @@ class PageAllocator:
     def pages_needed(self, n_tokens: int) -> int:
         return (n_tokens + self.page_size - 1) // self.page_size
 
+    def slot_pages(self, slot: int) -> int:
+        """Pages currently held by one slot (telemetry surface)."""
+        return len(self._slots.get(slot, ()))
+
     def can_allocate(self, n_tokens: int) -> bool:
         return self.pages_needed(n_tokens) <= self.free_pages
 

@@ -13,12 +13,23 @@ from typing import Any
 
 from aiohttp import web
 
+from ..observability.tracing import current_span
 from .provider import LLMError, LLMProviderRegistry
 
 
 def setup_llm_routes(app: web.Application, registry: LLMProviderRegistry,
                      prefix: str = "/v1") -> None:
     routes = web.RouteTableDef()
+
+    def _count_error(request: web.Request) -> None:
+        """Resolution/validation failures never reach the provider's own
+        counters — record them here. The model label is FIXED: on this
+        path the name is client-supplied and unresolvable, so labeling
+        with it would mint unbounded Prometheus label children."""
+        metrics = request.app["ctx"].metrics
+        if metrics is not None:
+            metrics.llm_requests.labels(model="unresolved",
+                                        status="error").inc()
 
     @routes.post(f"{prefix}/chat/completions")
     async def chat_completions(request: web.Request) -> web.StreamResponse:
@@ -30,6 +41,11 @@ def setup_llm_routes(app: web.Application, registry: LLMProviderRegistry,
         if not isinstance(body.get("messages"), list) or not body["messages"]:
             return web.json_response(
                 {"error": {"message": "messages must be a non-empty list"}}, status=422)
+        span = current_span()  # the gateway's http.request span
+        if span is not None:
+            span.set_attribute("gen_ai.operation.name", "chat")
+            span.set_attribute("gen_ai.request.model", body.get("model") or "")
+            span.set_attribute("llm.stream", bool(body.get("stream")))
         try:
             if body.get("stream"):
                 registry.resolve(body.get("model"))  # fail before the stream starts
@@ -53,6 +69,7 @@ def setup_llm_routes(app: web.Application, registry: LLMProviderRegistry,
             result = await registry.chat(body)
             return web.json_response(result)
         except LLMError as exc:
+            _count_error(request)
             return web.json_response({"error": {"message": str(exc),
                                                 "type": "invalid_request_error"}},
                                      status=404)

@@ -173,16 +173,39 @@ class TPULocalProvider(LLMProvider):
             priority=priority,
         )
 
+    def _request_span(self, request: dict[str, Any], gen: GenRequest):
+        """Open the llm.request span (parent = whatever is current on the
+        asyncio side — the gateway's http.request span via contextvars)
+        and hand its context to the engine so the dispatch thread can
+        parent llm.queue/prefill/decode under it."""
+        if self.tracer is None:
+            return None, None
+        span_ctx = self.tracer.span("llm.request", {
+            "gen_ai.system": "tpu_local",
+            "gen_ai.request.model": request.get("model",
+                                                self.engine.config.model),
+            "gen_ai.usage.prompt_tokens": len(gen.prompt_ids),
+            "gen_ai.request.max_tokens": gen.max_tokens,
+        })
+        span = span_ctx.__enter__()
+        gen.trace_ctx = span.context()
+        return span_ctx, span
+
+    def _count_request(self, model: str, prompt_tokens: int,
+                       completion_tokens: int, status: str = "ok") -> None:
+        if self.metrics is None:
+            return
+        self.metrics.llm_tokens.labels(model=model, kind="prompt").inc(
+            prompt_tokens)
+        self.metrics.llm_tokens.labels(model=model, kind="completion").inc(
+            completion_tokens)
+        self.metrics.llm_requests.labels(model=model, status=status).inc()
+        self.metrics.llm_kv_pages_in_use.set(self.engine.kv_pages_in_use())
+
     async def chat(self, request: dict[str, Any]) -> dict[str, Any]:
         gen = self._prepare(request)
-        span_ctx = (self.tracer.span("tpu_local.chat", {
-            "gen_ai.system": "tpu_local",
-            "gen_ai.request.model": request.get("model", self.engine.config.model),
-            "gen_ai.usage.prompt_tokens": len(gen.prompt_ids),
-        }) if self.tracer else None)
-        started = time.monotonic()
-        if span_ctx:
-            span_ctx.__enter__()
+        model = request.get("model", self.engine.config.model)
+        span_ctx, span = self._request_span(request, gen)
         try:
             await self.engine.submit(gen)
             tokens: list[int] = []
@@ -192,32 +215,72 @@ class TPULocalProvider(LLMProvider):
                     break
                 tokens.append(token)
             text = self.engine.tokenizer.decode(tokens)
-            if self.metrics is not None:
-                model = request.get("model", self.engine.config.model)
-                self.metrics.llm_tokens.labels(model=model, kind="prompt").inc(
-                    len(gen.prompt_ids))
-                self.metrics.llm_tokens.labels(model=model, kind="completion").inc(
-                    len(tokens))
-                self.metrics.llm_requests.labels(model=model, status="ok").inc()
-                self.metrics.llm_kv_pages_in_use.set(self.engine.kv_pages_in_use())
+            self._count_request(model, len(gen.prompt_ids), len(tokens))
+            if span is not None:
+                span.set_attribute("gen_ai.usage.completion_tokens",
+                                   len(tokens))
+                span.set_attribute("gen_ai.response.finish_reason",
+                                   gen.finish_reason or "stop")
             tool_calls = None
             if request.get("tools") and request.get("tool_choice") != "none":
                 from .tool_calls import parse_tool_calls
 
                 tool_calls = parse_tool_calls(text)
             return make_chat_response(
-                request.get("model", self.engine.config.model), text,
+                model, text,
                 prompt_tokens=len(gen.prompt_ids), completion_tokens=len(tokens),
                 finish_reason=gen.finish_reason or "stop",
                 tool_calls=tool_calls)
+        except (asyncio.CancelledError, GeneratorExit):
+            raise  # client went away: not a serving error
+        except BaseException as exc:
+            if self.metrics is not None:
+                self.metrics.llm_requests.labels(model=model,
+                                                 status="error").inc()
+            if span is not None:
+                # the finally below exits the span with no exc_info, so
+                # mark it here or the trace would show a clean OK span
+                # for a request the metrics count as an error
+                span.record_exception(exc)
+            raise
         finally:
             if span_ctx:
                 span_ctx.__exit__(None, None, None)
 
     async def chat_stream(self, request: dict[str, Any]) -> AsyncIterator[dict[str, Any]]:
         gen = self._prepare(request)
-        await self.engine.submit(gen)
         model = request.get("model", self.engine.config.model)
+        # span covers submit -> terminal chunk; parentage captured at the
+        # first __anext__ (inside the gateway handler's http.request span)
+        span_ctx, span = self._request_span(request, gen)
+        try:
+            async for chunk in self._chat_stream_inner(request, gen, model):
+                yield chunk
+            self._count_request(model, len(gen.prompt_ids),
+                                len(gen.generated))
+            if span is not None:
+                span.set_attribute("gen_ai.usage.completion_tokens",
+                                   len(gen.generated))
+                span.set_attribute("gen_ai.response.finish_reason",
+                                   gen.finish_reason or "stop")
+                span.set_attribute("llm.stream", True)
+        except (asyncio.CancelledError, GeneratorExit):
+            raise  # mid-stream disconnects are not serving errors
+        except BaseException as exc:
+            if self.metrics is not None:
+                self.metrics.llm_requests.labels(model=model,
+                                                 status="error").inc()
+            if span is not None:
+                span.record_exception(exc)
+            raise
+        finally:
+            if span_ctx:
+                span_ctx.__exit__(None, None, None)
+
+    async def _chat_stream_inner(self, request: dict[str, Any],
+                                 gen: GenRequest, model: str
+                                 ) -> AsyncIterator[dict[str, Any]]:
+        await self.engine.submit(gen)
         created = int(time.time())
         chunk_id = f"chatcmpl-{new_id()[:24]}"
         # function calling: a completion that OPENS with JSON is (probably)

@@ -4,19 +4,38 @@ Parity with the reference's AES-256-GCM field encryption
 (`/root/reference/mcpgateway/services/encryption_service.py:109`): secrets at
 rest (gateway auth headers, LLM provider configs, export bundles) are sealed
 with a key derived from ``auth_encryption_secret``.
+
+The ``cryptography`` package is a GATED dependency: when it is absent
+(slim TPU images bake jax + the serving stack only), sealing falls back to
+an in-tree encrypt-then-MAC construction (SHA-256 counter keystream XOR +
+HMAC-SHA256 tag) so the gateway still boots and the provider-config CRUD
+surface keeps working. The fallback shares the wire prefix; a value sealed
+by one mode is not readable by the other (decrypt raises DecryptionError),
+which only matters if a database migrates between images with and without
+the library. A warning is logged once at import so the degraded mode is
+visible in operator logs.
 """
 
 from __future__ import annotations
 
 import base64
 import hashlib
+import hmac
 import json
+import logging
 import os
 from typing import Any
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # gated: no pip installs in the serving image
+    AESGCM = None
+    logging.getLogger(__name__).warning(
+        "cryptography is not installed: field encryption is using the "
+        "in-tree HMAC-authenticated stream-cipher fallback")
 
 _MAGIC = "enc:v1:"
+_TAG_LEN = 16
 
 
 class DecryptionError(Exception):
@@ -27,13 +46,28 @@ def _derive_key(secret: str) -> bytes:
     return hashlib.sha256(("mcpforge-field-enc:" + secret).encode()).digest()
 
 
+def _keystream(key: bytes, nonce: bytes, length: int) -> bytes:
+    out = b""
+    counter = 0
+    while len(out) < length:
+        out += hashlib.sha256(
+            key + nonce + counter.to_bytes(4, "big")).digest()
+        counter += 1
+    return out[:length]
+
+
 def encrypt_field(value: Any, secret: str) -> str:
     """Seal a JSON-serializable value. Output is ASCII-safe."""
     key = _derive_key(secret)
     nonce = os.urandom(12)
     plaintext = json.dumps(value, separators=(",", ":")).encode()
-    ct = AESGCM(key).encrypt(nonce, plaintext, None)
-    return _MAGIC + base64.urlsafe_b64encode(nonce + ct).decode()
+    if AESGCM is not None:
+        ct = AESGCM(key).encrypt(nonce, plaintext, None)
+        return _MAGIC + base64.urlsafe_b64encode(nonce + ct).decode()
+    stream = _keystream(key, nonce, len(plaintext))
+    ct = bytes(a ^ b for a, b in zip(plaintext, stream))
+    tag = hmac.new(key, nonce + ct, hashlib.sha256).digest()[:_TAG_LEN]
+    return _MAGIC + base64.urlsafe_b64encode(nonce + ct + tag).decode()
 
 
 def decrypt_field(token: str | None, secret: str) -> Any:
@@ -47,8 +81,17 @@ def decrypt_field(token: str | None, secret: str) -> Any:
             return token
     try:
         raw = base64.urlsafe_b64decode(token[len(_MAGIC):].encode())
-        nonce, ct = raw[:12], raw[12:]
-        plaintext = AESGCM(_derive_key(secret)).decrypt(nonce, ct, None)
+        key = _derive_key(secret)
+        nonce = raw[:12]
+        if AESGCM is not None:
+            plaintext = AESGCM(key).decrypt(nonce, raw[12:], None)
+        else:
+            ct, tag = raw[12:-_TAG_LEN], raw[-_TAG_LEN:]
+            want = hmac.new(key, nonce + ct, hashlib.sha256).digest()[:_TAG_LEN]
+            if not hmac.compare_digest(tag, want):
+                raise ValueError("bad auth tag")
+            stream = _keystream(key, nonce, len(ct))
+            plaintext = bytes(a ^ b for a, b in zip(ct, stream))
         return json.loads(plaintext)
     except Exception as exc:
         raise DecryptionError(f"Cannot decrypt sealed field: {type(exc).__name__}") from exc

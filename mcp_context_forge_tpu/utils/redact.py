@@ -43,13 +43,62 @@ def is_secret_name(name: str) -> bool:
 
 def redact_value(name: str, value: Any) -> Any:
     """Redact one named value; DSNs keep host/db but lose userinfo."""
-    if isinstance(value, bool) or isinstance(value, (int, float)):
-        return value  # no credential is numeric; keep tuning knobs visible
+    # name check FIRST: a secret-named field with a numeric value (a PIN,
+    # a numeric API key in an opaque map) must redact too — the numeric
+    # fast path below only keeps non-secret tuning knobs visible
     if is_secret_name(name):
         return REDACTED if value else ""
+    if isinstance(value, bool) or isinstance(value, (int, float)):
+        return value
     if isinstance(value, str) and "://" in value:
         return _DSN_USERINFO.sub("://***@", value)
     return value
+
+
+# content-level patterns for FREE TEXT (log lines, exception strings):
+# unlike the name-keyed policy above, these run over values whose field
+# names carry no signal. False positives are acceptable here — the only
+# consumer is the support bundle, where over-redaction is the safe side.
+_TEXT_PATTERNS: tuple[tuple[re.Pattern, str], ...] = (
+    # Authorization header material
+    (re.compile(r"(?i)\b(bearer|basic)[ :=]+[A-Za-z0-9._+/=\-]{8,}"),
+     r"\1 " + REDACTED),
+    # JWTs (three base64url segments, first always 'eyJ')
+    (re.compile(r"\beyJ[A-Za-z0-9_\-]{8,}\.[A-Za-z0-9_\-]{4,}"
+                r"\.[A-Za-z0-9_\-]+"), REDACTED),
+    # vendor API keys of the sk-... shape
+    (re.compile(r"\bsk-[A-Za-z0-9_\-]{16,}\b"), REDACTED),
+)
+
+# key=value / "key": "value" pairs whose key names a credential.  The
+# value is checked separately: purely numeric values stay — telemetry
+# fields like max_tokens / prompt_tokens carry "token" in the KEY, and
+# scrubbing their counts would blind the very bundle built for debugging
+_KV_PATTERN = re.compile(
+    r"(?i)([\"']?[\w.\-]*(?:secret|password|passwd|api[_-]?key"
+    r"|apikey|credential|token)[\w.\-]*[\"']?\s*[:=]\s*[\"']?)"
+    r"([^\s\"',;&]{4,})")
+
+
+def _kv_replace(match: re.Match) -> str:
+    value = match.group(2)
+    try:
+        float(value)
+        return match.group(0)  # numeric telemetry, not a credential
+    except ValueError:
+        return match.group(1) + REDACTED
+
+
+def redact_text(text: str) -> str:
+    """Scrub credential-shaped content out of free text (the support
+    bundle's log records; reference support_bundle_service sanitizes log
+    CONTENT, not just named settings)."""
+    if not text:
+        return text
+    for pattern, replacement in _TEXT_PATTERNS:
+        text = pattern.sub(replacement, text)
+    text = _KV_PATTERN.sub(_kv_replace, text)
+    return _DSN_USERINFO.sub("://***@", text)
 
 
 def redact_settings(settings: Any) -> list[dict[str, Any]]:
