@@ -67,6 +67,10 @@ async def run(platform: str) -> dict:
     spec = os.environ.get("BENCH_SPEC", "0") == "1"
     if spec:
         decode_block = 1  # mutually exclusive with multi-step dispatch
+    # A/B arm for the overlapped decode pipeline: BENCH_OVERLAP=0 runs the
+    # serial dispatch->device_get->bookkeeping loop, =1 (default) overlaps
+    # host work behind device execution
+    overlap = os.environ.get("BENCH_OVERLAP", "1") == "1"
     quant = os.environ.get("BENCH_QUANT", "")
     buckets = os.environ.get("BENCH_BATCH_BUCKETS", "0") == "1"
     moe_impl = os.environ.get("BENCH_MOE_IMPL", "")
@@ -76,6 +80,7 @@ async def run(platform: str) -> dict:
                           prefill_buckets=(64,),
                           dtype="bfloat16" if platform == "tpu" else "float32",
                           attn_impl="auto", decode_block=decode_block,
+                          decode_overlap=overlap,
                           spec_decode=spec, quant=quant,
                           batch_buckets=buckets, moe_impl=moe_impl,
                           moe_block=moe_block,
@@ -106,11 +111,16 @@ async def run(platform: str) -> dict:
 
         # warmup so the timed region below measures steady state, not XLA
         # compiles; the fast subset on TPU keeps cold-cache boot in minutes
-        await asyncio.to_thread(engine.warmup,
-                                "fast" if platform == "tpu" else "full")
+        # (BENCH_WARMUP overrides — the CI smoke uses "fast" everywhere)
+        await asyncio.to_thread(
+            engine.warmup,
+            os.environ.get("BENCH_WARMUP",
+                           "fast" if platform == "tpu" else "full"))
         await one()  # primes the dispatch loop end-to-end (already compiled)
         steps0 = engine.stats.decode_steps
         spec0 = engine.stats.spec_tokens
+        overlap0 = engine.stats.overlap_steps
+        drains0 = engine.stats.pipeline_drains
         prefills0 = engine.stats.prefill_batches
         started = time.monotonic()
         results = await asyncio.gather(*[one() for _ in range(clients)])
@@ -131,6 +141,12 @@ async def run(platform: str) -> dict:
             "wall_s": round(wall, 3),
             "decode_block": decode_block, "batch_buckets": buckets,
             "spec_decode": spec,
+            "decode_overlap": overlap,
+            "overlap_steps": engine.stats.overlap_steps - overlap0,
+            "pipeline_drains": engine.stats.pipeline_drains - drains0,
+            # the number overlap exists to drive to ~0: fraction of decode
+            # wall the device spent waiting on host bookkeeping
+            "device_idle_frac": round(engine.device_idle_fraction(), 4),
             "quant": quant,
             "decode_steps": steps,
             "prefill_batches": engine.stats.prefill_batches - prefills0,

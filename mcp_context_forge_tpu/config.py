@@ -362,6 +362,12 @@ class Settings(BaseModel):
     tpu_local_sp_impl: Literal["none", "ring", "ulysses"] = "none"
     tpu_local_sp_threshold: int = 1024  # prefill BUCKETS > this use SP prefill
     tpu_local_decode_block: int = 1     # decode steps fused per dispatch
+    # depth-2 overlapped decode pipeline: step N+1 dispatches fed by step
+    # N's on-device sampled tokens while N's results transfer and emit one
+    # step behind — host bookkeeping hides behind device execution. Drain
+    # barriers keep token streams identical to the serial path; disable
+    # only to A/B or to debug scheduling.
+    tpu_local_decode_overlap: bool = True
     tpu_local_dtype: str = "bfloat16"
     tpu_local_embedding_model: str = "encoder-tiny"
     # backend-init watchdog: a dead TPU runtime/tunnel can block jax.devices()

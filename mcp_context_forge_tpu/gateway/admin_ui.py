@@ -189,6 +189,7 @@ async function renderEngine(stats){
   const order = ["requests","prompt_tokens","completion_tokens","decode_steps",
                  "prefill_batches","queue_depth","chunking","kv_pages_in_use",
                  "prefix_hits","prefix_hit_tokens","spec_steps","spec_tokens",
+                 "overlap_steps","pipeline_drains","dispatch_gap_ms_total",
                  "prefill_ms_total","decode_ms_total","engine_restarts"];
   const cards = order.filter(k => k in stats).map(k =>
     `<div class="card"><b>${cell(stats[k])}</b><span>${k}</span></div>`).join("");
@@ -202,7 +203,8 @@ async function renderEngine(stats){
     if (r.ok){
       const intro = await r.json();
       const cols = ["seq","kind","batch","width","bucket","ctx_pages",
-                    "duration_ms","tokens","queue_depth","kv_pages_in_use"];
+                    "duration_ms","gap_ms","tokens","queue_depth",
+                    "kv_pages_in_use"];
       const body = (intro.steps || []).slice().reverse().map(s =>
         "<tr>" + cols.map(c => `<td>${cell(s[c])}</td>`).join("") + "</tr>"
       ).join("");

@@ -104,6 +104,23 @@ class PrometheusRegistry:
             "Tokens emitted per second by the last engine step",
             registry=self.registry,
         )
+        # overlapped-decode health: the gap histogram is the host-side
+        # stall between device dispatches (the thing the pipeline hides —
+        # collapses to ~0 when overlap is on), and the idle fraction is
+        # gaps / (gaps + in-step wall) over the recent decode window
+        self.llm_dispatch_gap = Histogram(
+            "mcpforge_llm_dispatch_gap_seconds",
+            "Host-side stall between consecutive decode dispatches",
+            registry=self.registry,
+            buckets=(0.00001, 0.00005, 0.0001, 0.00025, 0.0005, 0.001,
+                     0.0025, 0.005, 0.01, 0.025, 0.05, 0.1),
+        )
+        self.llm_device_idle_frac = Gauge(
+            "mcpforge_llm_device_idle_fraction",
+            "Fraction of recent decode wall time the device waited on host "
+            "bookkeeping (0..1; ~0 with the overlapped pipeline)",
+            registry=self.registry,
+        )
         self.llm_providers_wired = Gauge(
             "mcpforge_llm_providers_wired",
             "External LLM providers currently wired into the registry",

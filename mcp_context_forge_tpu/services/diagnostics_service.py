@@ -59,6 +59,13 @@ def engine_introspection(engine: Any, limit: int = 64) -> dict[str, Any]:
         "decode_steps": stats.decode_steps,
         "prefill_batches": stats.prefill_batches,
         "chunking": stats.chunking,
+        # overlapped-pipeline health (docs/perf_decode.md): device-fed
+        # dispatches, barrier-forced drains, and the host-stall total the
+        # pipeline exists to hide
+        "overlap_steps": stats.overlap_steps,
+        "pipeline_drains": stats.pipeline_drains,
+        "dispatch_gap_ms_total": round(stats.dispatch_gap_ms_total, 3),
+        "device_idle_fraction": round(engine.device_idle_fraction(), 4),
         "kv": {
             "pages_in_use": engine.allocator.pages_in_use,
             "free_pages": engine.allocator.free_pages,

@@ -519,6 +519,53 @@ def page_allocator_oracle(mod: types.ModuleType) -> None:
     assert int(np.asarray(table)[3, 0]) == 0
 
 
+def _dirty_tracking_spec(mod: types.ModuleType) -> None:
+    """Dirty-row contract: the engine skips the block-table upload iff no
+    row changed, so a mutant that over- or under-reports dirt is either a
+    per-step upload regression or a stale device table (KV reads through
+    wrong pages)."""
+    import numpy as np
+
+    PA = mod.PageAllocator
+    alloc = PA(num_pages=8, page_size=4, max_slots=4, max_pages_per_slot=4)
+    assert not alloc.dirty                       # fresh allocator is clean
+    assert alloc.allocate_slot(0, 4)
+    assert alloc.dirty                           # allocation dirties its row
+    alloc.tables()
+    assert not alloc.dirty                       # reading the table cleans
+
+    # growth WITHIN the allocated pages is clean (no upload); crossing a
+    # page boundary dirties exactly then
+    assert alloc.grow_slot(0, 3) == 4
+    assert not alloc.dirty
+    assert alloc.grow_slot(0, 5) == 8
+    assert alloc.dirty
+    row = np.asarray(alloc.tables())[0]
+    assert (row[:2] > 0).all() and (row[2:] == 0).all()
+
+    # a cap-bound partial grant persists the pages it DID take
+    assert alloc.grow_slot(0, 99) == 16          # capped by max_pages_per_slot
+    assert alloc.slot_pages(0) == 4 and alloc.dirty
+    alloc.tables()
+
+    # ...and so does a POOL-DRY partial grant (distinct branch: free list
+    # exhausted below both the target and the per-slot cap)
+    dry = PA(num_pages=4, page_size=4, max_slots=4, max_pages_per_slot=8)
+    assert dry.allocate_slot(0, 4) and dry.allocate_slot(1, 4)
+    assert dry.grow_slot(0, 12) == 8             # wanted 3 pages, pool had 1
+    assert dry.slot_pages(0) == 2 and dry.free_pages == 0
+    assert dry.dirty
+
+    # move and free both dirty; the freed row reads back as zeros
+    alloc.move_slot(0, 2)
+    assert alloc.dirty
+    assert int(np.asarray(alloc.tables())[2, 0]) > 0
+    alloc.free_slot(2)
+    assert alloc.dirty
+    assert (np.asarray(alloc.tables()) == 0).all()
+    assert not alloc.dirty
+
+
 def _quantize_moe_and_scale_spec(mod: types.ModuleType) -> None:
     """MoE expert-stack quant rules + the embed multiplier knob."""
     import jax.numpy as jnp
@@ -796,7 +843,8 @@ TARGETS: dict[str, MutationTarget] = {
         module_name="mcp_context_forge_tpu.tpu_local.kv.paged_cache",
         package="mcp_context_forge_tpu.tpu_local.kv",
         oracle=lambda mod: (page_allocator_oracle(mod),
-                            _avg_slot_pages_spec(mod)),
+                            _avg_slot_pages_spec(mod),
+                            _dirty_tracking_spec(mod)),
         class_name="PageAllocator",
         # _take_page's `key is not None and _cached.get(key) == page` —
         # register_prefix maintains _page_key[page] == key iff

@@ -71,6 +71,15 @@ class EngineConfig:
     # host<->device sync to 1/k per token; tokens decoded past EOS inside a
     # block are discarded (standard multi-step scheduling waste)
     decode_block: int = 1
+    # depth-2 overlapped decode pipeline: dispatch step N+1 fed by step
+    # N's device-resident sampled tokens while step N's results transfer
+    # and emit one step behind, so host bookkeeping (emission, EOS
+    # checks, page extension) hides behind device execution instead of
+    # serializing with it. Drain barriers (admission, chunk completion,
+    # batch-width changes, stop/crash) keep token streams identical to
+    # the serial path. Ignored when spec_decode is on (the verify step
+    # has its own host feedback loop).
+    decode_overlap: bool = True
     # seconds to wait for jax backend init before failing fast (0 = forever)
     init_timeout_s: float = 120.0
     # precompile the shape grid at construction (see TPUEngine.warmup)
@@ -149,6 +158,7 @@ class EngineConfig:
             sp_impl=getattr(settings, "tpu_local_sp_impl", "none"),
             sp_threshold=getattr(settings, "tpu_local_sp_threshold", 1024),
             decode_block=getattr(settings, "tpu_local_decode_block", 1),
+            decode_overlap=getattr(settings, "tpu_local_decode_overlap", True),
             init_timeout_s=getattr(settings, "tpu_local_init_timeout_s", 120.0),
             warmup=getattr(settings, "tpu_local_warmup", False),
             warmup_mode=getattr(settings, "tpu_local_warmup_mode", "full"),
@@ -224,6 +234,9 @@ class EngineStats:
         self.decode_ms_total = 0.0    # device wall inside decode dispatches
         self.engine_restarts = 0      # crash-recovery restarts (auto_restart)
         self.chunking = 0             # long prompts mid-chunk-prefill
+        self.overlap_steps = 0        # decode dispatches fed from device tokens
+        self.pipeline_drains = 0      # overlap barriers that forced a drain
+        self.dispatch_gap_ms_total = 0.0  # host-side stall between dispatches
 
 
 class EngineInitTimeout(RuntimeError):
@@ -369,6 +382,19 @@ class TPUEngine:
         self._stop_event = threading.Event()
         self._loop: asyncio.AbstractEventLoop | None = None
         self._started = False
+        # overlapped decode pipeline state (dispatch thread only): the
+        # dispatched-but-not-yet-emitted decode step, if any
+        self._inflight: dict[str, Any] | None = None
+        # submit-side wakeup: the dispatch thread blocks here when idle
+        # instead of polling with time.sleep (satellite: idle wakeup
+        # latency and idle CPU both drop)
+        self._wake = threading.Event()
+        # step emission buffer: tokens accumulate here during a step and
+        # flush to the asyncio loop in ONE call_soon_threadsafe per step
+        self._emit_buf: list[list[Any]] = []
+        # dispatch-gap telemetry: (gap_s, step_wall_s) per decode step
+        self._gap_window: deque[tuple[float, float]] = deque(maxlen=256)
+        self._last_step_done_ts: float | None = None
         # decode batch-width hysteresis state (see _decode_step_all).
         # UNWARMED engines start small (light load is free immediately; a
         # burst pays ONE grow re-home) and may shrink back to any width
@@ -458,6 +484,10 @@ class TPUEngine:
         # x HBM bandwidth on short conversations, and decode is
         # bandwidth-bound
         self._decode_fns: dict[tuple[int, int], Any] = {}
+        # device-token-feedback decode (overlapped pipeline steady state):
+        # same grid as _decode_fns, but the input token comes from the
+        # PREVIOUS dispatch's on-device sampled block instead of the host
+        self._decode_fb_fns: dict[tuple[int, int], Any] = {}
         # the chunk/history prefill is a core primitive (prefix-cache hits
         # AND chunked prefill of prompts longer than the largest bucket);
         # compiled per context-width bucket like decode (a hit with 40
@@ -537,6 +567,16 @@ class TPUEngine:
             fn = jax.jit(partial(self._decode_and_sample, ctx_pages=ctx_pages),
                          donate_argnames=("kv",))
             self._decode_fns[key] = fn
+        return fn
+
+    def _decode_fb_fn(self, ctx_pages: int, batch: int | None = None):
+        key = (batch or self.config.max_batch, ctx_pages)
+        fn = self._decode_fb_fns.get(key)
+        if fn is None:
+            fn = jax.jit(partial(self._decode_and_sample_fb,
+                                 ctx_pages=ctx_pages),
+                         donate_argnames=("kv",))
+            self._decode_fb_fns[key] = fn
         return fn
 
     def _compact_slots(self) -> None:
@@ -704,6 +744,20 @@ class TPUEngine:
                         jax.random.PRNGKey(0))
                     block.block_until_ready()
                     shapes += 1
+                    if self.config.decode_overlap and self._verify_fns is None:
+                        # the pipelined steady state runs the feedback
+                        # variant; warm it alongside so overlap never
+                        # compiles mid-traffic
+                        block, self.kv = self._decode_fb_fn(ctx_pages, batch)(
+                            self.params, self.kv,
+                            jnp.zeros((self.config.decode_block, batch),
+                                      jnp.int32),
+                            jnp.zeros((batch,), jnp.int32),
+                            jnp.arange(batch, dtype=jnp.int32),
+                            jnp.zeros((batch,), jnp.int32), bsamp,
+                            jax.random.PRNGKey(0))
+                        block.block_until_ready()
+                        shapes += 1
                 self._warmed_widths.add(batch)
             if self.config.batch_buckets:
                 # warmed posture: start at max (never slower than fixed
@@ -803,6 +857,19 @@ class TPUEngine:
             step, (tokens, positions, seq_lens, kv), keys)
         return all_tokens, kv
 
+    def _decode_and_sample_fb(self, params, kv, prev_block, positions,
+                              slot_ids, seq_lens, sampling: SamplingParams,
+                              key, ctx_pages: int | None = None):
+        """Device-token-feedback decode (overlapped pipeline steady state):
+        the input token is the PREVIOUS dispatch's last sampled token —
+        row k-1 of its [k, B] block — which never left the device, so the
+        host feeds no tokens at all between barriers. prev_block is NOT
+        donated: the retire path still reads it back for emission while
+        this step executes."""
+        return self._decode_and_sample(params, kv, prev_block[-1], positions,
+                                       slot_ids, seq_lens, sampling, key,
+                                       ctx_pages=ctx_pages)
+
     # --------------------------------------------------------------- lifecycle
 
     async def start(self) -> None:
@@ -814,9 +881,10 @@ class TPUEngine:
             raise RuntimeError("previous dispatch thread still running")
         self._started = True
         self._loop = asyncio.get_running_loop()
-        # fresh event per thread: a wedged old thread keeps seeing its own
-        # (set) event and can never be revived by a later start()
+        # fresh events per thread: a wedged old thread keeps seeing its own
+        # (set) events and can never be revived by a later start()
         self._stop_event = threading.Event()
+        self._wake = threading.Event()
         self._thread = threading.Thread(target=self._device_loop,
                                         name="tpu-engine-dispatch", daemon=True)
         self._thread.start()
@@ -826,6 +894,7 @@ class TPUEngine:
             return
         self._started = False
         self._stop_event.set()
+        self._wake.set()  # unblock an idle dispatch thread immediately
         thread = self._thread
         if thread is not None:
             await asyncio.to_thread(thread.join, 30.0)
@@ -844,6 +913,7 @@ class TPUEngine:
         while True:
             try:
                 self._work.put_nowait(request)
+                self._wake.set()  # wake an idle dispatch thread
                 break
             except queue.Full:  # backpressure without blocking the loop
                 self._check_alive()
@@ -875,28 +945,65 @@ class TPUEngine:
 
     def _device_loop(self) -> None:
         """Owns every jax call + device sync. Never touched by the asyncio
-        loop; results hop back via loop.call_soon_threadsafe."""
+        loop; results hop back via loop.call_soon_threadsafe (one flush
+        per step, not one wakeup per token).
+
+        With ``decode_overlap`` the decode phase runs a depth-2 pipeline:
+        one decode step is always in flight, fed by the previous step's
+        on-device tokens, and results emit one step behind. Everything
+        that re-homes slots or pages (admission, chunk completion, width
+        changes, stop/crash) first drains the pipeline so token streams
+        stay byte-identical to the serial path."""
         crashed = False
+        overlap = self.config.decode_overlap and self._verify_fns is None
         try:
             while not self._stop_event.is_set():
-                did_work = self._admit_batch()
+                did_work = False
+                # drain the bounded handoff queue EVERY iteration (as the
+                # old unconditional _admit_batch did): the backlog lives
+                # in the unbounded _pending, where the priority sort and
+                # within-class FIFO apply — even while all slots are busy
+                self._drain_work()
+                incoming = bool(self._pending)
+                occupied = len(self._running) + len(self._chunking)
+                can_admit = incoming and occupied < self.config.max_batch
+                if self._inflight is not None and (
+                        can_admit or self._chunking or not self._running):
+                    # drain barriers: admission and chunk completion move
+                    # requests into slots/pages the in-flight lookahead
+                    # indexes; an empty running set means the lookahead
+                    # holds only rows that already finished
+                    self._drain_pipeline()
+                    did_work = True
+                if can_admit:
+                    did_work = self._admit_batch() or did_work
                 if self._chunking:
                     self._chunk_round()
                     did_work = True
                 if self._running:
                     if self._verify_fns is not None and self._any_would_draft():
                         self._spec_step_all()
+                    elif overlap:
+                        self._decode_step_overlapped()
                     else:
                         self._decode_step_all()
                     did_work = True
                 self.stats.queue_depth = self._work.qsize() + len(self._pending)
                 self.stats.chunking = len(self._chunking)
+                self._flush_emits()
                 if not did_work:
-                    time.sleep(0.001)
+                    self._wait_for_work()
+            # clean stop: already-sampled in-flight tokens reach their
+            # streams before the cancel sweep below
+            self._drain_pipeline()
         except Exception:
             crashed = True
+            # device state (and the in-flight block) is suspect after a
+            # fault inside a jitted call; never try to read it back
+            self._inflight = None
             logger.exception("tpu_local dispatch thread crashed")
         finally:
+            self._flush_emits()
             if (crashed and self.config.auto_restart
                     and not self._stop_event.is_set()
                     and self.stats.engine_restarts
@@ -922,6 +1029,7 @@ class TPUEngine:
         self.stats.engine_restarts += 1
         logger.warning("tpu_local: restarting engine after crash (%d/%d)",
                        self.stats.engine_restarts, self.config.auto_restart_max)
+        self._inflight = None  # sampled-but-unfetched tokens die with the kv
         self._drain_work()
         requeue = list(self._pending)
         self._pending.clear()
@@ -938,6 +1046,9 @@ class TPUEngine:
             self._observe_finish(request)
             self._running.pop(request.slot, None)
             self._post_tokens(request, [], done=True)
+        # flush BEFORE the replacement thread can exist: two dispatch
+        # threads must never race on the unlocked emit buffer
+        self._flush_emits()
         try:
             self._init_kv()
             for request in requeue:  # fresh admission state
@@ -965,6 +1076,7 @@ class TPUEngine:
             self._fail_outstanding("error")
 
     def _fail_outstanding(self, reason: str) -> None:
+        self._inflight = None
         self._drain_work()
         for request in list(self._running.values()):
             if request.finish_reason is None:
@@ -981,6 +1093,19 @@ class TPUEngine:
             if request.finish_reason is None:
                 request.finish_reason = reason
             self._post_tokens(request, [], done=True)
+        self._flush_emits()
+
+    def _wait_for_work(self) -> None:
+        """Idle path: block on the submit-side wake event instead of a
+        1 ms sleep poll — submit latency drops to the event signal and
+        idle CPU to ~zero. clear-then-check closes the race where a
+        request lands between the caller's emptiness check and the wait;
+        the timeout is a safety net for states the event cannot signal
+        (e.g. page-bound pending work that must periodically re-probe)."""
+        self._wake.clear()
+        if self._work.qsize() or self._stop_event.is_set():
+            return
+        self._wake.wait(0.05)
 
     def _drain_work(self) -> None:
         while True:
@@ -1241,6 +1366,7 @@ class TPUEngine:
                 self.allocator.register_prefix(request.slot,
                                                request.prompt_ids)
         first_host = jax.device_get(first)  # dispatch thread: sync is fine here
+        self._last_step_done_ts = time.monotonic()
         elapsed_ms = (time.monotonic() - started) * 1000
         self.stats.prefill_ms_total += elapsed_ms
         self.stats.prefill_batches += 1
@@ -1321,6 +1447,7 @@ class TPUEngine:
             self.params, self.kv, tokens, positions,
             slot_ids, last_idx, sampling, key)
         first_host = jax.device_get(first)
+        self._last_step_done_ts = time.monotonic()
         elapsed_ms = (time.monotonic() - started) * 1000
         self.stats.prefill_batches += 1
         self.stats.prefill_ms_total += elapsed_ms
@@ -1403,12 +1530,10 @@ class TPUEngine:
             if request.temperature == 0.0 and remaining > 1:
                 chunk += self._draft_tokens(request, K - 1)
             chunk = chunk[:min(K, remaining)]  # active => remaining >= 1
-            usable = 0
-            for j in range(len(chunk)):
-                if self.allocator.extend_slot(slot, p0 + j + 1):
-                    usable = j + 1
-                else:
-                    break
+            # one allocator call per slot (not one per drafted token): the
+            # usable width falls out of the granted token capacity
+            capacity = self.allocator.grow_slot(slot, p0 + len(chunk))
+            usable = max(0, min(len(chunk), capacity - p0))
             widths[slot] = usable
             if usable == 0:
                 # page pool exhausted mid-stream: the request truncates
@@ -1436,6 +1561,7 @@ class TPUEngine:
         self.stats.decode_steps += 1
         self.stats.spec_steps += 1
         block_host = jax.device_get(block)  # [B, K]
+        self._last_step_done_ts = time.monotonic()
         spec_elapsed_ms = (time.monotonic() - started) * 1000
         spec_emitted = 0
         for slot, request in active:
@@ -1463,10 +1589,121 @@ class TPUEngine:
     # ------------------------------------------------------------ decode step
 
     def _decode_step_all(self) -> None:
-        """One fixed-shape decode step over every active slot. The batch
-        width is the power-of-two bucket covering the ACTIVE slot ceiling
-        (slots are compacted first), so a lightly loaded engine doesn't
-        pay full-capacity attention/sampling per step."""
+        """Serial decode: one fixed-shape step over every active slot,
+        dispatched and retired back-to-back (the pre-overlap behavior;
+        also the first step after any pipeline drain)."""
+        inflight = self._decode_dispatch(self._decode_width(), None)
+        self._decode_retire(inflight)
+
+    def _decode_step_overlapped(self) -> None:
+        """Depth-2 pipelined decode: dispatch step N+1 fed by step N's
+        device-resident sampled tokens, THEN retire step N while the
+        device executes N+1. The host's per-step work — device_get,
+        emission, EOS checks, page extension — overlaps device compute
+        instead of sitting between dispatches. Rows that finish inside
+        step N still ride dispatch N+1 (their KV writes land in pages no
+        one can reuse before the next drain barrier) and their lookahead
+        tokens are discarded at retire, exactly like tokens past EOS
+        inside a decode_block."""
+        config = self.config
+        k = config.decode_block
+        feed = self._inflight
+        self._inflight = None
+        if feed is not None:
+            # barriers that invalidate the lookahead's slot->column map or
+            # its token-feedback row:
+            # - a row the in-flight dispatch doesn't cover (defensive —
+            #   admission/chunk completion drain upstream);
+            # - a PARTIAL budget on a row that will survive its retire
+            #   (per-slot page cap granted 0 < b < k): the feedback fn
+            #   feeds block row k-1, but the row's true last token is at
+            #   b-1 — only a host-fed dispatch can resume it correctly;
+            # - a batch_buckets compaction/width decision that would move
+            #   slots under it
+            stale = any(
+                feed["reqs"].get(slot) is not request
+                or (0 < feed["budgets"].get(slot, 0) < k
+                    and len(request.generated) + feed["budgets"][slot]
+                    < request.max_tokens)
+                for slot, request in self._running.items())
+            holes = False
+            if config.batch_buckets:
+                ceiling = max(self._running) + 1
+                holes = (ceiling != len(self._running) + len(self._chunking)
+                         or self._batch_bucket_for(ceiling)
+                         != self._batch_width)
+            if stale or holes:
+                if not self._drain_feed(feed):
+                    return
+                feed = None
+        if feed is not None and all(
+                request.max_tokens - len(request.generated)
+                - feed["budgets"].get(slot, 0) <= 0
+                for slot, request in self._running.items()):
+            # every surviving row's budget is already exhausted by the
+            # in-flight tokens (max_tokens tail): a lookahead would sample
+            # only discards — retire instead, keeping decode_steps and RNG
+            # consumption identical to the serial path on these tails
+            self._decode_retire(feed)
+            return
+        if feed is not None:
+            # page-pressure pre-flight: the lookahead's grow_slot calls run
+            # BEFORE retire N frees any EOS'd rows' pages, so dispatching
+            # into a too-dry pool would truncate rows the serial order
+            # (retire, then grow from the freed pages) would have served.
+            # If the pool can't cover every surviving row's full want,
+            # drain first — the retire may free pages, and the follow-up
+            # host-fed dispatch then truncates exactly where serial would.
+            deficit = 0
+            for slot, request in self._running.items():
+                pending = feed["budgets"].get(slot, 0)
+                n_ctx = (len(request.prompt_ids) + len(request.generated)
+                         + pending)
+                want = min(k, max(0, request.max_tokens
+                                  - len(request.generated) - pending))
+                if want > 0:
+                    deficit += max(
+                        0, self.allocator.pages_needed(n_ctx + want - 1)
+                        - self.allocator.slot_pages(slot))
+            if deficit > self.allocator.free_pages:
+                if not self._drain_feed(feed):
+                    return
+                feed = None
+        B = self._decode_width(allow_compact=feed is None)
+        if feed is not None and feed["B"] != B:
+            # width changed (batch_buckets growth): the [k, B] feedback
+            # shape no longer matches — drain and restart host-fed
+            if not self._drain_feed(feed):
+                return
+            feed = None
+            B = self._decode_width()
+        nxt = self._decode_dispatch(B, feed)
+        self._inflight = nxt
+        if feed is not None:
+            self._decode_retire(feed)
+
+    def _drain_pipeline(self) -> None:
+        """Retire the in-flight decode step, if any (pipeline barrier)."""
+        inflight = self._inflight
+        if inflight is None:
+            return
+        self._inflight = None
+        self.stats.pipeline_drains += 1
+        self._decode_retire(inflight)
+
+    def _drain_feed(self, feed: dict[str, Any]) -> bool:
+        """Barrier inside the overlap step: retire the fed step now and
+        report whether any rows survive to dispatch."""
+        self.stats.pipeline_drains += 1
+        self._decode_retire(feed)
+        return bool(self._running)
+
+    def _decode_width(self, allow_compact: bool = True) -> int:
+        """The decode dispatch width: the power-of-two bucket covering the
+        ACTIVE slot ceiling (slots compacted first) under batch_buckets,
+        else the configured max. ``allow_compact=False`` skips slot
+        compaction — moving rows under an in-flight lookahead would break
+        its slot->column mapping."""
         config = self.config
         if self._running or self._chunking:
             self._last_active_ts = time.monotonic()
@@ -1490,7 +1727,7 @@ class TPUEngine:
             page_capacity = (self.allocator.free_pages
                              // self.allocator.avg_slot_pages())
             admissible = max(0, min(incoming, free_slots, page_capacity))
-            if admissible == 0:
+            if admissible == 0 and allow_compact:
                 # compaction pays exactly when holes will NOT refill at
                 # the next admission: an empty queue, OR a page-bound
                 # backlog (queued work that cannot admit) — without it a
@@ -1536,48 +1773,67 @@ class TPUEngine:
                         self._batch_width = target
                     self._shrink_streak = 0
                     self._shrink_peak = 0
-            B = self._batch_width
-        else:
-            B = config.max_batch
+            return self._batch_width
+        return config.max_batch
+
+    def _decode_dispatch(self, B: int, feed: dict[str, Any] | None
+                         ) -> dict[str, Any]:
+        """Build and submit one decode dispatch of width ``B``; returns the
+        in-flight record the matching _decode_retire consumes.
+
+        ``feed`` is the previous, still-in-flight step: its [k, B] sampled
+        block (device-resident) supplies this step's input token, and host
+        state advances OPTIMISTICALLY by the fed step's per-slot budgets.
+        The optimism is sound: a row that survives its step always used
+        its FULL budget (a short budget means max_tokens or the page pool
+        ended it, i.e. the row dies at that step's retire), so surviving
+        rows advance by exactly ``budget`` tokens and dead rows' lookahead
+        output is discarded wholesale."""
+        config = self.config
+        k = config.decode_block
         tokens = np.zeros((B,), dtype=np.int32)
         positions = np.zeros((B,), dtype=np.int32)
         seq_lens = np.zeros((B,), dtype=np.int32)
         temperature = np.zeros((B,), dtype=np.float32)
         top_k = np.zeros((B,), dtype=np.int32)
         top_p = np.ones((B,), dtype=np.float32)
-        k = config.decode_block
-        active = list(self._running.items())
         # per-slot budget within this block: page capacity and max_tokens cap
         # how many of the k decoded tokens are usable
         budgets: dict[int, int] = {}
-        for slot, request in active:
-            # n_ctx counts every token that exists (prompt + generated); the
-            # last generated token is the incoming input: it sits at 0-based
-            # position n_ctx-1 and is written to the cache this step, after
-            # which the slot's context length is n_ctx.
-            n_ctx = len(request.prompt_ids) + len(request.generated)
-            tokens[slot] = request.generated[-1]
+        truncated: set[int] = set()
+        reqs = dict(self._running)
+        for slot, request in reqs.items():
+            pending = feed["budgets"].get(slot, 0) if feed is not None else 0
+            # n_ctx counts every token that exists (prompt + generated +
+            # the fed step's budgeted-but-unseen tokens); the input token
+            # sits at 0-based position n_ctx-1 and is written to the cache
+            # this step, after which the slot's context length is n_ctx.
+            n_ctx = len(request.prompt_ids) + len(request.generated) + pending
+            if feed is None:
+                tokens[slot] = request.generated[-1]
             positions[slot] = n_ctx - 1
             seq_lens[slot] = n_ctx
             temperature[slot] = request.temperature
             top_k[slot] = request.top_k
             top_p[slot] = request.top_p
-            # extend pages as far as the block can reach; writes beyond the
-            # allocated range land on the reserved trash page and their
-            # tokens are discarded via the budget
-            remaining = max(0, request.max_tokens - len(request.generated))
+            # extend pages as far as the block can reach, in ONE allocator
+            # call; writes beyond the granted range land on the reserved
+            # trash page and their tokens are discarded via the budget
+            remaining = max(0, request.max_tokens - len(request.generated)
+                            - pending)
+            want = min(k, remaining)
             usable = 0
-            for step_i in range(min(k, remaining)):
-                if self.allocator.extend_slot(slot, n_ctx + step_i):
-                    usable = step_i + 1
-                else:
-                    break
+            if want > 0:
+                capacity = self.allocator.grow_slot(slot, n_ctx + want - 1)
+                usable = max(0, min(want, capacity - (n_ctx - 1)))
+                if usable == 0:
+                    # page pool exhausted mid-stream: the request truncates
+                    # (finish happens at retire so the PREVIOUS step's
+                    # tokens still emit first)
+                    truncated.add(slot)
+                    if self.metrics is not None:
+                        self.metrics.llm_kv_alloc_failures.inc()
             budgets[slot] = usable
-            if usable == 0:
-                # page pool exhausted mid-stream: the request truncates
-                request.finish_reason = "length"
-                if self.metrics is not None:
-                    self.metrics.llm_kv_alloc_failures.inc()
         self._sync_tables()
         sampling = SamplingParams(jnp.asarray(temperature), jnp.asarray(top_k),
                                   jnp.asarray(top_p))
@@ -1586,32 +1842,88 @@ class TPUEngine:
         # (seq_lens counts the incoming token; k-1 more may be written)
         started = time.monotonic()
         ctx_pages = self._ctx_bucket_for(int(seq_lens.max()) + k)
-        block_tokens, self.kv = self._decode_fn(ctx_pages, B)(
-            self.params, self.kv, jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.arange(B, dtype=jnp.int32), jnp.asarray(seq_lens), sampling, key)
+        # dispatch-gap telemetry: host time the device sat idle between
+        # steps. A device-fed dispatch by construction overlaps the still-
+        # running previous step, so its gap is zero.
+        gap_s = 0.0
+        if feed is None and self._last_step_done_ts is not None:
+            gap_s = max(0.0, started - self._last_step_done_ts)
+        else:
+            self.stats.overlap_steps += int(feed is not None)
+        self.stats.dispatch_gap_ms_total += gap_s * 1000
+        if self.metrics is not None:
+            self.metrics.llm_dispatch_gap.observe(gap_s)
+        if feed is None:
+            block_tokens, self.kv = self._decode_fn(ctx_pages, B)(
+                self.params, self.kv, jnp.asarray(tokens),
+                jnp.asarray(positions), jnp.arange(B, dtype=jnp.int32),
+                jnp.asarray(seq_lens), sampling, key)
+        else:
+            block_tokens, self.kv = self._decode_fb_fn(ctx_pages, B)(
+                self.params, self.kv, feed["block"], jnp.asarray(positions),
+                jnp.arange(B, dtype=jnp.int32), jnp.asarray(seq_lens),
+                sampling, key)
+        try:
+            block_tokens.copy_to_host_async()  # D2H overlaps device compute
+        except AttributeError:
+            pass
         self.stats.decode_steps += k
-        block_host = jax.device_get(block_tokens)  # [k, B]
-        decode_elapsed_ms = (time.monotonic() - started) * 1000
+        return {"block": block_tokens, "budgets": budgets, "reqs": reqs,
+                "truncated": truncated, "B": B, "ctx_pages": ctx_pages,
+                "batch": len(reqs), "dispatch_ts": started, "gap_s": gap_s}
+
+    def _decode_retire(self, inflight: dict[str, Any]) -> None:
+        """Fetch and emit one dispatched decode step. Under overlap this
+        runs while the NEXT step executes on device, so every line here is
+        off the device's critical path."""
+        block_host = np.asarray(inflight["block"])  # [k, B]; blocks if needed
+        done_ts = time.monotonic()
+        self._last_step_done_ts = done_ts
+        decode_elapsed_ms = (done_ts - inflight["dispatch_ts"]) * 1000
         self.stats.decode_ms_total += decode_elapsed_ms
         decode_emitted = 0
-        for slot, request in active:
-            if request.finish_reason == "length" and request.slot in self._running:
+        for slot, request in inflight["reqs"].items():
+            if self._running.get(slot) is not request:
+                continue  # finished at an earlier retire: lookahead discards
+            if slot in inflight["truncated"]:
+                if request.finish_reason is None:
+                    request.finish_reason = "length"
                 self._finish(request)
                 continue
-            for step_i in range(budgets[slot]):
+            for step_i in range(inflight["budgets"][slot]):
                 self._emit(request, int(block_host[step_i][slot]))
                 decode_emitted += 1
-                if request.slot not in self._running:
+                if self._running.get(slot) is not request:
                     break  # finished (EOS/stop/max): rest of block discarded
-        self._record_step("decode", batch=len(active), width=B,
-                          dur_ms=decode_elapsed_ms, tokens=decode_emitted,
-                          ctx_pages=ctx_pages)
+        self._gap_window.append((inflight["gap_s"],
+                                 decode_elapsed_ms / 1000))
+        self._record_step("decode", batch=inflight["batch"],
+                          width=inflight["B"], dur_ms=decode_elapsed_ms,
+                          tokens=decode_emitted,
+                          ctx_pages=inflight["ctx_pages"],
+                          gap_ms=inflight["gap_s"] * 1000)
+        if self.metrics is not None:
+            self.metrics.llm_device_idle_frac.set(self.device_idle_fraction())
+
+    def device_idle_fraction(self) -> float:
+        """Fraction of recent decode wall time the device spent waiting on
+        host bookkeeping (dispatch gaps / (gaps + in-step wall)); the
+        number the overlapped pipeline exists to drive to ~0."""
+        gaps = walls = 0.0
+        # snapshot first: callers include the asyncio thread (diagnostics,
+        # bench) while the dispatch thread appends
+        for gap_s, wall_s in list(self._gap_window):
+            gaps += gap_s
+            walls += wall_s
+        total = gaps + walls
+        return gaps / total if total > 0 else 0.0
 
     # --------------------------------------------------------------- telemetry
 
     def _record_step(self, kind: str, *, batch: int, width: int,
                      dur_ms: float, tokens: int, bucket: int | None = None,
-                     ctx_pages: int | None = None) -> None:
+                     ctx_pages: int | None = None,
+                     gap_ms: float | None = None) -> None:
         """One ring-buffer entry + gauge refresh per device dispatch.
         Runs on the dispatch thread; deque.append and prometheus_client
         ops are both thread-safe, and the asyncio side only ever copies
@@ -1631,6 +1943,9 @@ class TPUEngine:
             "tokens": tokens,                   # tokens emitted by this step
             "queue_depth": depth,
             "kv_pages_in_use": pages_in_use,
+            # host-side stall before this dispatch (decode only; 0 when the
+            # overlapped pipeline kept the device fed)
+            "gap_ms": round(gap_ms, 3) if gap_ms is not None else None,
         })
         m = self.metrics
         if m is not None:
@@ -1699,7 +2014,12 @@ class TPUEngine:
     # ---------------------------------------------------------------- plumbing
 
     def _sync_tables(self) -> None:
-        self.kv = self.kv._replace(block_tables=self.allocator.tables())
+        """Refresh the device block table — but only when the allocator
+        marked rows dirty since the last sync. Steady-state decode (no
+        page growth, no finishes) uploads NOTHING: the previous table
+        rides through the donated kv pytree unchanged."""
+        if self.allocator.dirty:
+            self.kv = self.kv._replace(block_tables=self.allocator.tables())
 
     def _emit(self, request: GenRequest, token: int) -> None:
         request.generated.append(token)
@@ -1728,26 +2048,46 @@ class TPUEngine:
             self._observe_finish(request)  # before free_slot: pages still held
             self._running.pop(request.slot, None)
             self.allocator.free_slot(request.slot)
-            self._sync_tables()
+            # no table sync here: free_slot marked the row dirty, and every
+            # device dispatch path syncs before submitting
         self._post_tokens(request, [token], done=done)
 
     def _finish(self, request: GenRequest) -> None:
         self._observe_finish(request)
         self._running.pop(request.slot, None)
         self.allocator.free_slot(request.slot)
-        self._sync_tables()
         self._post_tokens(request, [], done=True)
 
     def _post_tokens(self, request: GenRequest, tokens: list[int],
                      done: bool) -> None:
-        """Hand tokens to the consumer on the asyncio loop (thread-safe)."""
+        """Queue tokens for the consumer. Posts accumulate in a step-local
+        buffer (merged per request) and hop to the asyncio loop in ONE
+        call_soon_threadsafe per flush — one loop wakeup per engine step,
+        not one per token (the old per-token wakeups were measurable
+        scheduler pressure at decode_block/spec widths > 1)."""
+        buf = self._emit_buf
+        if buf and buf[-1][0] is request and not buf[-1][2]:
+            buf[-1][1].extend(tokens)
+            buf[-1][2] = done
+        else:
+            buf.append([request, list(tokens), done])
+
+    def _flush_emits(self) -> None:
+        """Deliver everything buffered by _post_tokens in one loop hop.
+        Called once per dispatch-loop iteration and at the end of every
+        termination path (fail/crash/stop), so no consumer can strand on
+        an unflushed buffer."""
+        if not self._emit_buf:
+            return
+        batch, self._emit_buf = self._emit_buf, []
         loop = self._loop
 
         def _put() -> None:
-            for token in tokens:
-                request.stream.put_nowait(token)
-            if done:
-                request.stream.put_nowait(None)
+            for request, tokens, done in batch:
+                for token in tokens:
+                    request.stream.put_nowait(token)
+                if done:
+                    request.stream.put_nowait(None)
 
         if loop is not None and not loop.is_closed():
             try:

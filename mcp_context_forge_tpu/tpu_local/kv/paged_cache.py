@@ -141,6 +141,7 @@ class PageAllocator:
 
     def __init__(self, num_pages: int, page_size: int, max_slots: int,
                  max_pages_per_slot: int):
+        import numpy as np
         self.num_pages = num_pages
         self.page_size = page_size
         self.max_slots = max_slots
@@ -153,6 +154,17 @@ class PageAllocator:
         self._lru: dict[int, None] = {}                 # ref==0 resident pages
         self.prefix_hits = 0
         self.prefix_hit_tokens = 0
+        # dirty-row tracking: rows whose page list changed since tables()
+        # was last read. Steady-state decode (no page growth, no finishes)
+        # leaves this empty, so the engine skips the host->device table
+        # upload entirely between such steps.
+        self._dirty: set[int] = set()
+        self._table = np.zeros((max_slots, max_pages_per_slot), dtype=np.int32)
+
+    @property
+    def dirty(self) -> bool:
+        """True iff some block-table row changed since the last tables()."""
+        return bool(self._dirty)
 
     @property
     def free_pages(self) -> int:
@@ -290,20 +302,40 @@ class PageAllocator:
             self._ref[page] = self._ref.get(page, 0) + 1
             pages.append(page)
         self._slots[slot] = pages
+        self._dirty.add(slot)
         return True
 
-    def extend_slot(self, slot: int, n_tokens: int) -> bool:
-        """Ensure capacity for n_tokens total; grows by whole pages."""
-        pages = self._slots.get(slot, [])
+    def grow_slot(self, slot: int, n_tokens: int) -> int:
+        """Best-effort growth toward ``n_tokens`` total capacity; returns
+        the slot's token capacity (pages * page_size) after growth. ONE
+        call replaces the per-lookahead-token extend_slot probe loop the
+        engine used to run per slot per step: the caller derives its
+        usable-token budget from the returned capacity. Partial growth
+        persists (pages already taken stay with the slot), matching the
+        old loop's behavior when the pool ran dry mid-extension."""
+        pages = self._slots.get(slot)
+        missing = pages is None
+        if missing:
+            pages = []
         needed = self.pages_needed(n_tokens)
+        grew = False
         while len(pages) < needed:
-            if not (self._free or self._lru) or len(pages) >= self.max_pages_per_slot:
-                return False
+            if not (self._free or self._lru) \
+                    or len(pages) >= self.max_pages_per_slot:
+                break
             page = self._take_page()
             self._ref[page] = self._ref.get(page, 0) + 1
             pages.append(page)
-        self._slots[slot] = pages
-        return True
+            grew = True
+        if grew:
+            if missing:
+                self._slots[slot] = pages
+            self._dirty.add(slot)
+        return len(pages) * self.page_size
+
+    def extend_slot(self, slot: int, n_tokens: int) -> bool:
+        """Ensure capacity for n_tokens total; grows by whole pages."""
+        return self.grow_slot(slot, n_tokens) >= n_tokens
 
     def move_slot(self, old: int, new: int) -> None:
         """Reassign a slot's pages to another (free) slot id — pages are
@@ -312,14 +344,27 @@ class PageAllocator:
         assert new not in self._slots, f"slot {new} occupied"
         if old in self._slots:
             self._slots[new] = self._slots.pop(old)
+            self._dirty.add(old)
+            self._dirty.add(new)
 
     def free_slot(self, slot: int) -> None:
-        for page in reversed(self._slots.pop(slot, [])):
+        pages = self._slots.pop(slot, [])
+        if pages:
+            self._dirty.add(slot)
+        for page in reversed(pages):
             self._release_page(page)
 
     def tables(self) -> "jnp.ndarray":
-        import numpy as np
-        table = np.zeros((self.max_slots, self.max_pages_per_slot), dtype=np.int32)
-        for slot, pages in self._slots.items():
-            table[slot, :len(pages)] = pages
-        return jnp.asarray(table)
+        """The device block table. Only dirty rows are rebuilt in the
+        cached host table; the returned array is a fresh copy (jnp.array
+        copies), so later in-place row updates can never alias a device
+        buffer. Reading clears the dirty set — callers that gate on
+        ``dirty`` skip the upload entirely when nothing changed."""
+        for slot in self._dirty:
+            row = self._table[slot]
+            row[:] = 0
+            pages = self._slots.get(slot)
+            if pages:
+                row[:len(pages)] = pages
+        self._dirty.clear()
+        return jnp.array(self._table)

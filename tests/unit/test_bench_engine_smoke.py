@@ -1,0 +1,50 @@
+"""CPU-backend smoke of bench_engine.py: the A/B harness itself must not
+rot between TPU windows — it runs end-to-end (engine build, warmup, timed
+generation, JSON report) on every CI pass, tiny model, tiny token budget."""
+
+import asyncio
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.fixture()
+def bench_env(monkeypatch):
+    monkeypatch.setenv("BENCH_MODEL", "llama3-test")
+    monkeypatch.setenv("BENCH_CLIENTS", "2")
+    monkeypatch.setenv("BENCH_TOKENS", "4")
+    monkeypatch.setenv("BENCH_DECODE_BLOCK", "1")
+    monkeypatch.setenv("BENCH_WARMUP", "fast")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, REPO_ROOT)
+    yield
+    sys.path.remove(REPO_ROOT)
+
+
+def test_bench_engine_cpu_smoke(bench_env, monkeypatch):
+    import bench_engine
+
+    out = asyncio.run(bench_engine.run("cpu"))
+    assert out["metric"] == "tpu_local_decode_tokens_per_s"
+    assert out["value"] > 0
+    assert out["platform"] == "cpu"
+    assert out["tokens"] >= 2 * 1  # every client produced something
+    assert out["decode_steps"] >= 1
+    # the overlap A/B knob is reported so captures are self-describing
+    assert out["decode_overlap"] is True
+    assert out["overlap_steps"] >= 0
+    assert 0.0 <= out["device_idle_frac"] <= 1.0
+
+
+def test_bench_engine_serial_arm(bench_env, monkeypatch):
+    import bench_engine
+
+    monkeypatch.setenv("BENCH_OVERLAP", "0")
+    out = asyncio.run(bench_engine.run("cpu"))
+    assert out["decode_overlap"] is False
+    assert out["overlap_steps"] == 0
+    assert out["value"] > 0
