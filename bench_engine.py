@@ -23,6 +23,11 @@ llama3-tiny on cpu), BENCH_CLIENTS, BENCH_TOKENS, BENCH_DECODE_BLOCK,
 BENCH_SPEC (=1 enables prompt-lookup speculative decoding),
 BENCH_PROMPT_MODE (repetitive|chat — repetitive favors spec drafting).
 
+BENCH_KV_QUANT=1 runs an A/B pair at the SAME KV byte budget — baseline
+KV dtype vs int8 paged KV (tpu_local_kv_quant) — and reports both arms'
+tok/s, each arm's page capacity + peak resident pages, and the int8
+arm's greedy token-parity rate against the baseline arm.
+
 Platform: probed in a subprocess (a wedged TPU runtime cannot hang the
 bench — round-1 failure mode); BENCH_PLATFORM overrides.
 """
@@ -52,7 +57,7 @@ def count_params(config) -> int:
     return param_count(config)
 
 
-async def run(platform: str) -> dict:
+async def run(platform: str, kv_quant: str = "") -> dict:
     from mcp_context_forge_tpu.tpu_local.engine import EngineConfig, TPUEngine
     from mcp_context_forge_tpu.tpu_local.models import MODEL_CONFIGS
 
@@ -75,13 +80,22 @@ async def run(platform: str) -> dict:
     buckets = os.environ.get("BENCH_BATCH_BUCKETS", "0") == "1"
     moe_impl = os.environ.get("BENCH_MOE_IMPL", "")
     moe_block = int(os.environ.get("BENCH_MOE_BLOCK", "0"))
+    # page size: the int8 Pallas gate needs page_size % 32 == 0 — under
+    # BENCH_KV_QUANT both arms run 32 so the A/B compares KV storage
+    # dtype on the SAME kernel path (16-page baseline would keep the
+    # fused kernel while the int8 arm fell back to the dequant gather,
+    # attributing the gather's extra HBM traffic to quantization)
+    page_size = int(os.environ.get(
+        "BENCH_PAGE_SIZE",
+        "32" if os.environ.get("BENCH_KV_QUANT", "0") == "1" else "16"))
     config = EngineConfig(model=model, max_batch=min(clients, 16),
-                          max_seq_len=512, page_size=16, num_pages=1024,
+                          max_seq_len=512, page_size=page_size,
+                          num_pages=1024,
                           prefill_buckets=(64,),
                           dtype="bfloat16" if platform == "tpu" else "float32",
                           attn_impl="auto", decode_block=decode_block,
                           decode_overlap=overlap,
-                          spec_decode=spec, quant=quant,
+                          spec_decode=spec, quant=quant, kv_quant=kv_quant,
                           batch_buckets=buckets, moe_impl=moe_impl,
                           moe_block=moe_block,
                           compile_cache_dir=os.environ.get(
@@ -99,15 +113,15 @@ async def run(platform: str) -> dict:
             text = "benchmark prompt for decode throughput"
         prompt = engine.tokenizer.encode(text)
 
-        async def one() -> tuple[int, list[float]]:
-            count, intervals = 0, []
+        async def one() -> tuple[list[int], list[float]]:
+            tokens, intervals = [], []
             last = time.monotonic()
-            async for _ in engine.generate(prompt, max_tokens=max_tokens):
+            async for tok in engine.generate(prompt, max_tokens=max_tokens):
                 nownow = time.monotonic()
                 intervals.append((nownow - last) * 1000)
                 last = nownow
-                count += 1
-            return count, intervals
+                tokens.append(tok)
+            return tokens, intervals
 
         # warmup so the timed region below measures steady state, not XLA
         # compiles; the fast subset on TPU keeps cold-cache boot in minutes
@@ -125,7 +139,7 @@ async def run(platform: str) -> dict:
         started = time.monotonic()
         results = await asyncio.gather(*[one() for _ in range(clients)])
         wall = time.monotonic() - started
-        total = sum(r[0] for r in results)
+        total = sum(len(r[0]) for r in results)
         intervals = sorted(i for _, iv in results for i in iv[1:])  # drop TTFT
         tokens_per_s = total / wall
         steps = engine.stats.decode_steps - steps0
@@ -148,6 +162,14 @@ async def run(platform: str) -> dict:
             # wall the device spent waiting on host bookkeeping
             "device_idle_frac": round(engine.device_idle_fraction(), 4),
             "quant": quant,
+            # KV storage arm: page capacity is the dtype-aware pool size
+            # at the FIXED byte budget num_pages denominates (int8 ~2x),
+            # peak is the allocator's monotonic high-water resident mark
+            # (the step ring is bounded and would under-report long runs)
+            "kv_quant": kv_quant,
+            "kv_pages_capacity": engine.num_kv_pages,
+            "kv_pages_peak": engine.allocator.peak_pages_in_use,
+            "token_streams": [r[0] for r in results],
             "decode_steps": steps,
             "prefill_batches": engine.stats.prefill_batches - prefills0,
             "spec_tokens": engine.stats.spec_tokens - spec0,
@@ -183,5 +205,32 @@ async def run(platform: str) -> dict:
         await engine.stop()
 
 
+def main() -> dict:
+    platform = pin_platform()
+    out = asyncio.run(run(platform))
+    base_streams = out.pop("token_streams")
+    if os.environ.get("BENCH_KV_QUANT", "0") == "1":
+        # A/B arm: same byte budget, int8 paged KV. Prompts are greedy and
+        # identical across arms, so per-position token agreement measures
+        # quantization drift directly (1.0 = byte-identical streams).
+        arm = asyncio.run(run(platform, kv_quant="int8"))
+        arm_streams = arm.pop("token_streams")
+        matched = positions = 0
+        for a, b in zip(base_streams, arm_streams):
+            positions += max(len(a), len(b))
+            matched += sum(1 for x, y in zip(a, b) if x == y)
+        keys = ("value", "kv_pages_capacity", "kv_pages_peak",
+                "decode_steps", "device_idle_frac")
+        out["kv_quant_ab"] = {
+            "baseline": {k: out[k] for k in keys},
+            "int8": {k: arm[k] for k in keys},
+            "page_capacity_ratio": round(
+                arm["kv_pages_capacity"] / max(1, out["kv_pages_capacity"]),
+                3),
+            "token_parity_rate": round(matched / max(1, positions), 4),
+        }
+    return out
+
+
 if __name__ == "__main__":
-    print(json.dumps(asyncio.run(run(pin_platform()))))
+    print(json.dumps(main()))

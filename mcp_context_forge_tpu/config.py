@@ -400,6 +400,11 @@ class Settings(BaseModel):
     # scales, dequant fused into the matmul; halves HBM footprint+traffic
     # (how Llama-3-8B fits one 16 GB v5e chip)
     tpu_local_quant: str = ""
+    # KV-cache quantization: "" (pages in the engine dtype) or "int8" —
+    # pages store int8 with per-page, per-kv-head scales, halving
+    # decode-attention HBM traffic; at the byte budget tpu_local_num_pages
+    # denotes, the pool holds ~2x the pages (kv/paged_cache.py)
+    tpu_local_kv_quant: str = ""
     tpu_local_moe_impl: str = ""  # ""=model default | dense | grouped | grouped_pallas
     # decode batch-width bucketing (+ slot compaction, shrink hysteresis):
     # size decode dispatches by active load — enable for latency-sensitive

@@ -188,6 +188,7 @@ function cell(v, isBool){
 async function renderEngine(stats){
   const order = ["requests","prompt_tokens","completion_tokens","decode_steps",
                  "prefill_batches","queue_depth","chunking","kv_pages_in_use",
+                 "kv_bytes_in_use","kv_quant",
                  "prefix_hits","prefix_hit_tokens","spec_steps","spec_tokens",
                  "overlap_steps","pipeline_drains","dispatch_gap_ms_total",
                  "prefill_ms_total","decode_ms_total","engine_restarts"];

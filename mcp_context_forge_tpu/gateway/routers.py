@@ -790,6 +790,8 @@ document.getElementById("f").onsubmit = async (e) => {
             "queue_depth": stats.queue_depth,
             "kv_pages_in_use": alloc.pages_in_use,
             "kv_pages_free": alloc.free_pages,
+            "kv_quant": engine.config.kv_quant or "off",
+            "kv_bytes_in_use": engine.kv_bytes_in_use(),
             "prefill_ms_total": round(stats.prefill_ms_total, 1),
             "decode_ms_total": round(stats.decode_ms_total, 1),
             "engine_restarts": stats.engine_restarts,

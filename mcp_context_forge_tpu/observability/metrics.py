@@ -62,6 +62,14 @@ class PrometheusRegistry:
             "mcpforge_llm_kv_pages_in_use", "Paged KV cache pages in use",
             registry=self.registry,
         )
+        # dtype-aware twin of the page-count gauge: pages x page bytes
+        # under the active KV storage dtype (int8 pages cost ~half their
+        # bf16 twin), so mixed-mode fleets compare on one byte axis
+        self.llm_kv_bytes_in_use = Gauge(
+            "mcpforge_llm_kv_bytes_in_use",
+            "HBM bytes the in-use KV pages occupy under the active KV dtype",
+            registry=self.registry,
+        )
         # token-level SLO signals (fed by the engine dispatch thread):
         # TTFT = submit -> first token (queue + prefill), TPOT = mean
         # inter-token latency over the decode phase of one request

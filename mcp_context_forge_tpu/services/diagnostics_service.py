@@ -69,8 +69,13 @@ def engine_introspection(engine: Any, limit: int = 64) -> dict[str, Any]:
         "kv": {
             "pages_in_use": engine.allocator.pages_in_use,
             "free_pages": engine.allocator.free_pages,
-            "num_pages": engine.config.num_pages,
+            # the DTYPE-AWARE pool size (int8 pools hold ~2x the pages
+            # config.num_pages denominates in engine-dtype bytes)
+            "num_pages": engine.num_kv_pages,
             "page_size": engine.config.page_size,
+            "quant": engine.config.kv_quant or "off",
+            "bytes_in_use": engine.kv_bytes_in_use(),
+            "bytes_capacity": engine.kv_bytes_capacity(),
         },
         "steps": engine.recent_steps(limit),
     }

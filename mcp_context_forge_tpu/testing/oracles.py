@@ -421,6 +421,7 @@ def page_allocator_oracle(mod: types.ModuleType) -> None:
     # capacity: page 0 reserved, ceil-division page math
     alloc = PA(num_pages=8, page_size=4, max_slots=4, max_pages_per_slot=4)
     assert alloc.free_pages == 7 and alloc.pages_in_use == 0
+    assert alloc.peak_pages_in_use == 0   # nothing allocated yet
     assert alloc.pages_needed(1) == 1 and alloc.pages_needed(4) == 1
     assert alloc.pages_needed(5) == 2
     assert alloc.can_allocate(28) and not alloc.can_allocate(29)
@@ -429,6 +430,7 @@ def page_allocator_oracle(mod: types.ModuleType) -> None:
     # hands out
     assert alloc.allocate_slot(0, 9)  # 3 pages
     assert alloc.pages_in_use == 3 and alloc.free_pages == 4
+    assert alloc.peak_pages_in_use == 3   # high-water mark tracks
     assert 0 not in alloc._slots[0]
 
     # per-slot cap enforced
@@ -438,18 +440,22 @@ def page_allocator_oracle(mod: types.ModuleType) -> None:
     assert alloc.free_pages == 0
     assert not alloc.allocate_slot(2, 1)
 
-    # extend grows by whole pages and respects both caps
+    # growth happens by whole pages and respects both caps: grow_slot
+    # returns the granted token capacity (pages * page_size)
     alloc.free_slot(1)
     assert alloc.free_pages == 4
-    assert alloc.extend_slot(0, 12)        # still 3 pages
+    assert alloc.grow_slot(0, 12) >= 12    # still 3 pages
     assert alloc.pages_in_use == 3
-    assert alloc.extend_slot(0, 13)        # grows to 4
+    assert alloc.grow_slot(0, 13) >= 13    # grows to 4
     assert alloc.pages_in_use == 4
-    assert not alloc.extend_slot(0, 17)    # per-slot cap
+    assert alloc.grow_slot(0, 17) < 17     # per-slot cap
+    assert alloc.pages_in_use == 4
 
-    # free returns everything
+    # free returns everything; the peak is MONOTONIC (a bench reading it
+    # after the run must see the high-water mark, not the final state)
     alloc.free_slot(0)
     assert alloc.pages_in_use == 0 and alloc.free_pages == 7
+    assert alloc.peak_pages_in_use == 7
 
     # prefix chains: register full pages, probe is read-only, match
     # refcounts, shared pages survive the owner's free

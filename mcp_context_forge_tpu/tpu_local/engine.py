@@ -108,6 +108,13 @@ class EngineConfig:
     # resident param footprint AND the per-step HBM traffic (quantize.py;
     # how Llama-3-8B fits a single 16 GB v5e chip)
     quant: str = ""
+    # KV-cache quantization: "" (pages in the engine dtype) or "int8" —
+    # pages store int8 with per-page, per-kv-head scales
+    # (kv/paged_cache.py), halving decode-attention HBM traffic; the
+    # Pallas decode kernel dequantizes in VMEM. ``num_pages`` stays
+    # denominated in ENGINE-DTYPE pages (a byte budget): at the same HBM
+    # bytes an int8 pool holds ~2x the pages, so _init_kv converts.
+    kv_quant: str = ""
     # MoE serving formulation override ("" = model default; see
     # models/configs.py moe_impl): dense | grouped | grouped_pallas.
     # moe_block overrides the kernel row-block AND the T·k >= E·block
@@ -168,6 +175,7 @@ class EngineConfig:
             spec_k=getattr(settings, "tpu_local_spec_k", 4),
             spec_ngram=getattr(settings, "tpu_local_spec_ngram", 2),
             quant=getattr(settings, "tpu_local_quant", ""),
+            kv_quant=getattr(settings, "tpu_local_kv_quant", ""),
             moe_impl=getattr(settings, "tpu_local_moe_impl", ""),
             batch_buckets=getattr(settings, "tpu_local_batch_buckets", False),
             max_queue=getattr(settings, "tpu_local_max_queue", 1024),
@@ -435,6 +443,9 @@ class TPUEngine:
 
         if config.quant not in ("", "int8"):
             raise ValueError(f"unsupported quant mode {config.quant!r}")
+        if config.kv_quant not in ("", "int8"):
+            raise ValueError(
+                f"unsupported kv_quant mode {config.kv_quant!r}")
         if config.moe_impl not in ("", "dense", "grouped", "grouped_pallas"):
             # a typo must not silently serve the dense path (and make a
             # hardware A/B compare dense against dense)
@@ -501,23 +512,51 @@ class TPUEngine:
     def _init_kv(self) -> None:
         """(Re)build the KV pool + allocator on the mesh — used at
         construction and by crash recovery (a fault inside a jitted call
-        may have consumed the donated kv buffers)."""
+        may have consumed the donated kv buffers).
+
+        ``config.num_pages`` is a BYTE budget denominated in engine-dtype
+        pages: under ``kv_quant="int8"`` the same bytes hold ~2x the
+        pages (1 byte/element + a per-page scale sliver), so the pool and
+        allocator are sized by the converted, dtype-aware page count."""
         config = self.config
         max_pages_per_slot = config.max_seq_len // config.page_size
-        from .kv import PagedKVState
-        from .parallel.sharding import kv_pages_sharding, logical_to_sharding
+        from .kv import kv_page_bytes, num_pages_for_budget
+        from .parallel.sharding import (kv_pages_sharding, kv_scales_sharding,
+                                        logical_to_sharding)
+        # bytes one page costs under the ACTIVE storage mode (gauge unit)
+        self._kv_page_bytes = kv_page_bytes(
+            self.model_config, config.page_size, self._kv_dtype,
+            config.kv_quant)
+        if config.kv_quant:
+            budget = config.num_pages * kv_page_bytes(
+                self.model_config, config.page_size, self._kv_dtype)
+            self.num_kv_pages = num_pages_for_budget(
+                self.model_config, config.page_size, budget,
+                self._kv_dtype, config.kv_quant)
+        else:
+            self.num_kv_pages = config.num_pages
         with self.mesh:
-            pages = kv_pages_sharding(self.mesh, self.model_config.n_kv_heads)
-            kv_shardings = PagedKVState(
-                k_pages=pages, v_pages=pages,
-                block_tables=logical_to_sharding("replicated", self.mesh))
+            # kv_logical is the single source of the state's structure;
+            # the page/scale rules route through the divisibility-aware
+            # helpers (kv heads that don't divide the TP degree replicate)
+            n_kv = self.model_config.n_kv_heads
+
+            def to_sharding(name: str):
+                if name == "kv_pages":
+                    return kv_pages_sharding(self.mesh, n_kv)
+                if name == "kv_scales":
+                    return kv_scales_sharding(self.mesh, n_kv)
+                return logical_to_sharding(name, self.mesh)
+
+            kv_shardings = jax.tree.map(to_sharding,
+                                        kv_logical(config.kv_quant))
             kv_init = jax.jit(partial(
-                init_kv_state, self.model_config, config.num_pages,
+                init_kv_state, self.model_config, self.num_kv_pages,
                 config.page_size, config.max_batch, max_pages_per_slot,
-                dtype=self._kv_dtype),
+                dtype=self._kv_dtype, quant=config.kv_quant),
                 out_shardings=kv_shardings)
             self.kv = kv_init()
-        self.allocator = PageAllocator(config.num_pages, config.page_size,
+        self.allocator = PageAllocator(self.num_kv_pages, config.page_size,
                                        config.max_batch, max_pages_per_slot)
 
     def _ctx_buckets(self) -> list[int]:
@@ -1952,7 +1991,11 @@ class TPUEngine:
             m.llm_batch_occupancy.set(len(self._running) + len(self._chunking))
             m.llm_kv_pages_in_use.set(pages_in_use)
             m.llm_kv_page_utilization.set(
-                pages_in_use / max(1, self.config.num_pages - 1))
+                pages_in_use / max(1, self.num_kv_pages - 1))
+            # dtype-aware byte view: pages x page bytes under the ACTIVE
+            # KV dtype, so int8 and bf16 engines are comparable on one
+            # dashboard even though their page counts differ 2x
+            m.llm_kv_bytes_in_use.set(self.kv_bytes_in_use())
             m.llm_queue_depth.set(depth)
             if dur_ms > 0 and tokens:
                 m.llm_step_tokens_per_sec.set(tokens / (dur_ms / 1e3))
@@ -2101,3 +2144,12 @@ class TPUEngine:
 
     def kv_pages_in_use(self) -> int:
         return self.allocator.pages_in_use
+
+    def kv_bytes_in_use(self) -> int:
+        """HBM bytes the in-use KV pages occupy under the active storage
+        dtype (int8 pages cost half their bf16 twin plus a scale sliver)."""
+        return self.allocator.pages_in_use * self._kv_page_bytes
+
+    def kv_bytes_capacity(self) -> int:
+        """HBM bytes the whole KV pool occupies (fixed at construction)."""
+        return self.num_kv_pages * self._kv_page_bytes

@@ -4,11 +4,14 @@ from .paged_cache import (
     PagedKVState,
     PageAllocator,
     init_kv_state,
+    kv_page_bytes,
+    num_pages_for_budget,
     write_prefill_kv,
     write_decode_kv,
     gather_kv,
     kv_logical,
 )
 
-__all__ = ["PagedKVState", "PageAllocator", "init_kv_state", "write_prefill_kv",
-           "write_decode_kv", "gather_kv", "kv_logical"]
+__all__ = ["PagedKVState", "PageAllocator", "init_kv_state", "kv_page_bytes",
+           "num_pages_for_budget", "write_prefill_kv", "write_decode_kv",
+           "gather_kv", "kv_logical"]

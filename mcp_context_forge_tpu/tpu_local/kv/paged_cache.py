@@ -9,6 +9,20 @@ reads are gathers with static shapes, so one compiled decode program serves
 every step.
 
 Page 0 is reserved as the trash page: masked/padding writes land there.
+
+Int8 storage mode (``quant="int8"``): pages hold int8 values plus a
+per-page, per-kv-head scale array [L, num_pages, KV], halving the pool's
+HBM footprint and the per-step KV traffic. Scales are RUNNING MAXIMA over
+a page's tenancy: a write at offset 0 begins a new tenancy and resets the
+page scale (a freed/reallocated page must not inherit the old tenant's
+range), later appends grow the scale monotonically and requantize the
+page's resident values when it grows — so every live value always
+dequantizes with the scale it was quantized under. The writers assume
+each row's valid positions within one call form a CONTIGUOUS ascending
+span (true for every engine path: prefill, chunked/suffix prefill,
+decode, spec-verify), which is what makes the prior-content requantize
+cheap: only the page under each row's first written token can hold
+earlier tokens of that row.
 """
 
 from __future__ import annotations
@@ -19,14 +33,22 @@ import jax
 import jax.numpy as jnp
 
 from ..models.configs import LlamaConfig
+from ..quantize import KV_SCALE_EPS, kv_dequantize, kv_int8_scale, kv_quantize
 
 
 class PagedKVState(NamedTuple):
-    """Device state (a pytree — every field is a jax array)."""
+    """Device state (a pytree — every field is a jax array).
+
+    ``k_scales``/``v_scales`` are None for full-precision pools; under
+    int8 they hold the per-(layer, page, kv-head) dequant scales in the
+    engine's COMPUTE dtype (the scale dtype doubles as the compute-dtype
+    marker, mirroring quantize.py's weight-scale convention)."""
 
     k_pages: jax.Array      # [L, num_pages, page_size, KV, hd]
     v_pages: jax.Array      # [L, num_pages, page_size, KV, hd]
     block_tables: jax.Array  # [slots, max_pages_per_slot] int32 (0 = unassigned)
+    k_scales: jax.Array | None = None   # [L, num_pages, KV] (int8 mode only)
+    v_scales: jax.Array | None = None   # [L, num_pages, KV]
 
     @property
     def page_size(self) -> int:
@@ -36,29 +58,115 @@ class PagedKVState(NamedTuple):
     def max_context(self) -> int:
         return self.block_tables.shape[1] * self.page_size
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scales is not None
 
-def kv_logical() -> PagedKVState:
+
+def kv_logical(quant: str = "") -> PagedKVState:
     """Logical sharding names for the state tree."""
+    scales = "kv_scales" if quant == "int8" else None
     return PagedKVState(k_pages="kv_pages", v_pages="kv_pages",
-                        block_tables="replicated")
+                        block_tables="replicated",
+                        k_scales=scales, v_scales=scales)
 
 
 def init_kv_state(config: LlamaConfig, num_pages: int, page_size: int,
                   max_slots: int, max_pages_per_slot: int,
-                  dtype: jnp.dtype = jnp.bfloat16) -> PagedKVState:
+                  dtype: jnp.dtype = jnp.bfloat16,
+                  quant: str = "") -> PagedKVState:
     shape = (config.n_layers, num_pages, page_size, config.n_kv_heads,
              config.head_dim)
+    tables = jnp.zeros((max_slots, max_pages_per_slot), dtype=jnp.int32)
+    if quant == "int8":
+        scale_shape = (config.n_layers, num_pages, config.n_kv_heads)
+        return PagedKVState(
+            k_pages=jnp.zeros(shape, dtype=jnp.int8),
+            v_pages=jnp.zeros(shape, dtype=jnp.int8),
+            block_tables=tables,
+            k_scales=jnp.zeros(scale_shape, dtype=dtype),
+            v_scales=jnp.zeros(scale_shape, dtype=dtype),
+        )
     return PagedKVState(
         k_pages=jnp.zeros(shape, dtype=dtype),
         v_pages=jnp.zeros(shape, dtype=dtype),
-        block_tables=jnp.zeros((max_slots, max_pages_per_slot), dtype=jnp.int32),
+        block_tables=tables,
     )
+
+
+def kv_page_bytes(config: LlamaConfig, page_size: int,
+                  dtype: jnp.dtype = jnp.bfloat16, quant: str = "") -> int:
+    """HBM bytes ONE page (K and V, all layers) costs under a storage
+    mode — the unit _init_kv's byte-denominated budget divides by."""
+    elems = (2 * config.n_layers * page_size * config.n_kv_heads
+             * config.head_dim)
+    if quant == "int8":
+        scale_bytes = (2 * config.n_layers * config.n_kv_heads
+                       * jnp.dtype(dtype).itemsize)
+        return elems + scale_bytes  # int8 values + per-(page, head) scales
+    return elems * jnp.dtype(dtype).itemsize
+
+
+def num_pages_for_budget(config: LlamaConfig, page_size: int,
+                         budget_bytes: int, dtype: jnp.dtype = jnp.bfloat16,
+                         quant: str = "") -> int:
+    """Pages a fixed HBM byte budget holds under a storage mode (~2x under
+    int8: 1 byte/elem + a per-page scale sliver vs 2 bytes/elem bf16)."""
+    return max(2, int(budget_bytes
+                      // kv_page_bytes(config, page_size, dtype, quant)))
+
+
+# --------------------------------------------------------- int8 write helpers
+
+def _quant_store(pages: jax.Array, scales: jax.Array, layer: int,
+                 values: jax.Array, flat_pages: jax.Array,
+                 flat_offset: jax.Array, first_pages: jax.Array,
+                 first_mask: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Quantize ``values`` [N, KV, hd] into int8 ``pages`` with running-max
+    per-(page, kv-head) scales; returns (pages, scales) for one layer's
+    K or V side.
+
+    ``first_pages``/``first_mask`` [R]: the page under each row's FIRST
+    written token, masked to rows whose span starts mid-page — the only
+    pages that can hold prior tokens of the spans being written (spans
+    are contiguous), so only they are requantized when their scale grows.
+    """
+    old_scales = scales[layer]                               # [P, KV]
+    # offset-0 writes begin a page tenancy: drop the stale scale so a
+    # reallocated page can't inherit (and forever creep on) the previous
+    # tenant's range. Non-fresh tokens alias the trash page here.
+    fresh_pages = jnp.where(flat_offset == 0, flat_pages, 0)
+    layer_scales = old_scales.at[fresh_pages].set(0.0, mode="drop")
+    # running-max update from this call's tokens
+    amax = jnp.max(jnp.abs(values.astype(jnp.float32)), axis=-1)  # [N, KV]
+    tok_scale = kv_int8_scale(amax).astype(layer_scales.dtype)
+    layer_scales = layer_scales.at[flat_pages].max(tok_scale, mode="drop")
+    # requantize prior resident content of first-touched pages whose scale
+    # grew: q_old was written under s_old; under the new page scale s_new
+    # the same value is q_old * s_old / s_new (ratio <= 1, so no clipping
+    # of live values — stale masked-dead positions may saturate, but they
+    # are never read before being rewritten)
+    safe_first = jnp.where(first_mask, first_pages, 0)
+    resident = pages[layer, safe_first]                      # [R, page, KV, hd]
+    s_old = old_scales[safe_first].astype(jnp.float32)       # [R, KV]
+    s_new = layer_scales[safe_first].astype(jnp.float32)
+    ratio = s_old / jnp.maximum(s_new, KV_SCALE_EPS)
+    requant = jnp.round(resident.astype(jnp.float32) * ratio[:, None, :, None])
+    requant = jnp.clip(requant, -127.0, 127.0).astype(jnp.int8)
+    requant = jnp.where(first_mask[:, None, None, None], requant, resident)
+    pages = pages.at[layer, safe_first].set(requant, mode="drop")
+    # finally the new tokens, quantized under the settled page scales
+    s_final = layer_scales[flat_pages][..., None]            # [N, KV, 1]
+    q = kv_quantize(values, s_final.astype(jnp.float32))
+    pages = pages.at[layer, flat_pages, flat_offset].set(q, mode="drop")
+    return pages, scales.at[layer].set(layer_scales)
 
 
 def write_prefill_kv(kv: PagedKVState, layer: int, k: jax.Array, v: jax.Array,
                      slot_ids: jax.Array, positions: jax.Array,
                      valid: jax.Array) -> PagedKVState:
-    """Scatter a [B,S] block of K/V into pages.
+    """Scatter a [B,S] block of K/V into pages (quantizing on store under
+    int8 mode — each row's span must be contiguous, see module docstring).
 
     k/v: [B,S,KV,hd]; slot_ids: [B]; positions: [B,S]; valid: [B,S] bool."""
     B, S = positions.shape
@@ -73,6 +181,23 @@ def write_prefill_kv(kv: PagedKVState, layer: int, k: jax.Array, v: jax.Array,
     flat_offset = offset.reshape(-1)
     k_flat = k.reshape(B * S, *k.shape[2:])
     v_flat = v.reshape(B * S, *v.shape[2:])
+    if kv.quantized:
+        # the page under each row's first written token is the only one
+        # that can hold PRIOR tokens of the span; rows are robust to
+        # leading padding (argmax finds the first valid column)
+        first_idx = jnp.argmax(valid, axis=1)               # [B]
+        take = lambda a: jnp.take_along_axis(a, first_idx[:, None],
+                                             axis=1)[:, 0]
+        first_pages = take(pages)
+        first_mask = take(valid) & (take(offset) > 0)
+        k_pages, k_scales = _quant_store(kv.k_pages, kv.k_scales, layer,
+                                         k_flat, flat_pages, flat_offset,
+                                         first_pages, first_mask)
+        v_pages, v_scales = _quant_store(kv.v_pages, kv.v_scales, layer,
+                                         v_flat, flat_pages, flat_offset,
+                                         first_pages, first_mask)
+        return kv._replace(k_pages=k_pages, v_pages=v_pages,
+                           k_scales=k_scales, v_scales=v_scales)
     k_pages = kv.k_pages.at[layer, flat_pages, flat_offset].set(
         k_flat, mode="drop")
     v_pages = kv.v_pages.at[layer, flat_pages, flat_offset].set(
@@ -97,6 +222,18 @@ def write_decode_kv(kv: PagedKVState, layer: int, k: jax.Array, v: jax.Array,
     if valid is not None:
         pages = jnp.where(valid, pages, 0)                  # trash page
         offset = jnp.where(valid, offset, 0)
+    if kv.quantized:
+        # a one-token span: the written page itself may hold the row's
+        # earlier tokens (offset > 0), so it is its own "first page"
+        first_mask = offset > 0
+        if valid is not None:
+            first_mask = first_mask & valid
+        k_pages, k_scales = _quant_store(kv.k_pages, kv.k_scales, layer,
+                                         k, pages, offset, pages, first_mask)
+        v_pages, v_scales = _quant_store(kv.v_pages, kv.v_scales, layer,
+                                         v, pages, offset, pages, first_mask)
+        return kv._replace(k_pages=k_pages, v_pages=v_pages,
+                           k_scales=k_scales, v_scales=v_scales)
     k_pages = kv.k_pages.at[layer, pages, offset].set(k, mode="drop")
     v_pages = kv.v_pages.at[layer, pages, offset].set(v, mode="drop")
     return kv._replace(k_pages=k_pages, v_pages=v_pages)
@@ -112,12 +249,23 @@ def gather_kv(kv: PagedKVState, layer: int, slot_ids: jax.Array,
     max-context width for 40-token conversations wastes ~24x the
     bandwidth — the engine picks a power-of-two bucket covering the
     longest active row each step. (The Pallas paged-attention kernel
-    replaces this gather on TPU for large configs.)"""
+    replaces this gather on TPU for large configs.)
+
+    Int8 pools dequantize in a per-page epilogue (q * scale), returning
+    the scales' dtype — the compute dtype — so the CPU/interpret
+    fallback, the history/chunk prefill path, and the spec-decode verify
+    path all serve quantized pages unchanged."""
     rows = kv.block_tables[slot_ids]                        # [B,P]
     if ctx_pages is not None:
         rows = rows[:, :ctx_pages]
     k = kv.k_pages[layer][rows]                             # [B,P,page,KV,hd]
     v = kv.v_pages[layer][rows]
+    if kv.quantized:
+        dt = kv.k_scales.dtype
+        ks = kv.k_scales[layer][rows][:, :, None, :, None]  # [B,P,1,KV,1]
+        vs = kv.v_scales[layer][rows][:, :, None, :, None]
+        k = kv_dequantize(k, ks, dt)
+        v = kv_dequantize(v, vs, dt)
     B, P, page, KV, hd = k.shape
     return k.reshape(B, P * page, KV, hd), v.reshape(B, P * page, KV, hd)
 
@@ -154,6 +302,9 @@ class PageAllocator:
         self._lru: dict[int, None] = {}                 # ref==0 resident pages
         self.prefix_hits = 0
         self.prefix_hit_tokens = 0
+        # monotonic high-water mark of pages_in_use (benches/telemetry):
+        # a rolling step ring under-reports peaks on long runs
+        self.peak_pages_in_use = 0
         # dirty-row tracking: rows whose page list changed since tables()
         # was last read. Steady-state decode (no page growth, no finishes)
         # leaves this empty, so the engine skips the host->device table
@@ -182,6 +333,10 @@ class PageAllocator:
     @property
     def pages_in_use(self) -> int:
         return (self.num_pages - 1) - self.free_pages
+
+    def _track_peak(self) -> None:
+        self.peak_pages_in_use = max(self.peak_pages_in_use,
+                                     self.pages_in_use)
 
     @property
     def cached_pages(self) -> int:
@@ -256,6 +411,7 @@ class PageAllocator:
         for page in pages:
             self._ref[page] = self._ref.get(page, 0) + 1
             self._lru.pop(page, None)
+        self._track_peak()  # re-referencing LRU pages raises pages_in_use
         return len(pages) * self.page_size, pages
 
     def release_prefix(self, pages: list[int]) -> None:
@@ -303,6 +459,7 @@ class PageAllocator:
             pages.append(page)
         self._slots[slot] = pages
         self._dirty.add(slot)
+        self._track_peak()
         return True
 
     def grow_slot(self, slot: int, n_tokens: int) -> int:
@@ -331,11 +488,8 @@ class PageAllocator:
             if missing:
                 self._slots[slot] = pages
             self._dirty.add(slot)
+            self._track_peak()
         return len(pages) * self.page_size
-
-    def extend_slot(self, slot: int, n_tokens: int) -> bool:
-        """Ensure capacity for n_tokens total; grows by whole pages."""
-        return self.grow_slot(slot, n_tokens) >= n_tokens
 
     def move_slot(self, old: int, new: int) -> None:
         """Reassign a slot's pages to another (free) slot id — pages are

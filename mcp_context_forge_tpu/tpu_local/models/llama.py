@@ -330,7 +330,9 @@ def prefill_with_history(params: dict[str, Any], config: LlamaConfig,
                 at = paged_chunk_attention_pallas(
                     qg, kv.k_pages[idx], kv.v_pages[idx],
                     tables, ps,
-                    page_size=kv.page_size)
+                    page_size=kv.page_size,
+                    k_scales=(kv.k_scales[idx] if kv.quantized else None),
+                    v_scales=(kv.v_scales[idx] if kv.quantized else None))
                 at = at.reshape(B, -1, config.n_heads, config.head_dim)
             else:
                 at = _history_attention(
@@ -418,7 +420,9 @@ def decode_step(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
             attn = paged_decode_attention_pallas(
                 qg, kv.k_pages[idx], kv.v_pages[idx],
                 tables, seq_lens,
-                page_size=kv.page_size)
+                page_size=kv.page_size,
+                k_scales=(kv.k_scales[idx] if kv.quantized else None),
+                v_scales=(kv.v_scales[idx] if kv.quantized else None))
             attn = attn.reshape(B, 1, config.n_heads, config.head_dim)
         else:
             keys, values = gather_kv(kv, idx, slot_ids, ctx_pages)
@@ -433,11 +437,14 @@ def decode_step(params: dict[str, Any], config: LlamaConfig, tokens: jax.Array,
 
 def _use_pallas_paged(config: LlamaConfig, kv: PagedKVState) -> bool:
     """Pallas paged kernel on real TPU with tile-friendly shapes; the gather
-    reference elsewhere (CPU CI, odd geometries). Evaluated at trace time."""
+    reference elsewhere (CPU CI, odd geometries). Evaluated at trace time.
+    Int8 pools need page_size % 32 == 0 (the int8 sublane tile is 32 vs 8
+    for wider dtypes) — smaller pages fall back to the dequant gather."""
     from ..ops.attention import _on_tpu
 
+    min_page = 32 if kv.quantized else 8
     return (_on_tpu() and config.head_dim % 128 == 0
-            and kv.page_size % 8 == 0)
+            and kv.page_size % min_page == 0)
 
 
 def _paged_decode_attention(q: jax.Array, keys: jax.Array, values: jax.Array,

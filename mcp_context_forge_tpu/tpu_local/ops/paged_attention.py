@@ -8,6 +8,12 @@ prefetched so Pallas can DMA exactly the pages a sequence uses from HBM into
 VMEM — maintaining online-softmax stats in VMEM scratch. HBM traffic drops
 from O(B·C_max·hd) copies to the pages actually referenced.
 
+Int8 pages (kv/paged_cache.py quant mode) dequantize IN VMEM: the
+per-(page, kv-head) scales ride the same scalar-prefetch-indexed DMA path
+as the pages themselves (BlockSpec indexed by the block table), so the HBM
+side of decode attention moves 1 byte/element instead of 2 and the
+dequant multiply fuses into the f32 score math the kernel already does.
+
 Grid: (batch, kv_head, page). Scalar prefetch: block tables [B, P] and
 seq_lens [B]. Output: [B, KV, G, hd] attention for the single decode token.
 """
@@ -25,8 +31,12 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
-            acc_ref, m_ref, l_ref, *, page_size: int, num_pages_per_seq: int):
+def _kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref, *rest,
+            page_size: int, num_pages_per_seq: int, quantized: bool):
+    if quantized:
+        k_scale_ref, v_scale_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
     b = pl.program_id(0)
     page_idx = pl.program_id(2)
 
@@ -46,6 +56,9 @@ def _kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0, 0].astype(jnp.float32)           # [G, hd]
         k = k_ref[0, :, 0].astype(jnp.float32)        # [page, hd]
         v = v_ref[0, :, 0].astype(jnp.float32)        # [page, hd]
+        if quantized:  # fused dequant: one scalar per (page, head) tile
+            k = k * k_scale_ref[0, 0].astype(jnp.float32)
+            v = v * v_scale_ref[0, 0].astype(jnp.float32)
         hd = q.shape[-1]
         scores = (q @ k.T) / math.sqrt(hd)            # [G, page]
         position = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
@@ -67,13 +80,16 @@ def _kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
                        jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
 
 
-def _chunk_kernel(block_tables_ref, q_pos_ref, q_ref, k_ref, v_ref, o_ref,
-                  acc_ref, m_ref, l_ref, *, page_size: int,
-                  num_pages_per_seq: int):
+def _chunk_kernel(block_tables_ref, q_pos_ref, q_ref, k_ref, v_ref, *rest,
+                  page_size: int, num_pages_per_seq: int, quantized: bool):
     """Chunk (multi-query) variant of _kernel: S queries per sequence walk
     the same page list with online softmax; causality rides the absolute
     query positions (cache position c attends iff c <= q_pos). Serves the
     prefix-cache suffix prefill and the spec-decode verify step."""
+    if quantized:
+        k_scale_ref, v_scale_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
     page_idx = pl.program_id(2)
 
     @pl.when(page_idx == 0)
@@ -92,6 +108,9 @@ def _chunk_kernel(block_tables_ref, q_pos_ref, q_ref, k_ref, v_ref, o_ref,
         q2 = q.reshape(S * G, hd)
         k = k_ref[0, :, 0].astype(jnp.float32)        # [page, hd]
         v = v_ref[0, :, 0].astype(jnp.float32)
+        if quantized:
+            k = k * k_scale_ref[0, 0].astype(jnp.float32)
+            v = v * v_scale_ref[0, 0].astype(jnp.float32)
         scores = (q2 @ k.T) / math.sqrt(hd)           # [S*G, page]
         col = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1) + page_start
         row_pos = jnp.broadcast_to(pos[:, None], (S, G)).reshape(S * G, 1)
@@ -115,33 +134,52 @@ def _chunk_kernel(block_tables_ref, q_pos_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, :, 0] = out.reshape(S, G, hd).astype(o_ref.dtype)
 
 
+def _scale_spec(n_index: int):
+    """BlockSpec for a [num_pages, KV] scale array: one (1, 1) scalar tile
+    per grid step, DMA'd from the SAME block-table-indexed page the K/V
+    specs fetch. ``n_index``: arity of the index_map (grid dims + scalar
+    prefetch refs)."""
+    if n_index == 5:  # decode grid: (b, k, j) + (bt, sl)
+        return pl.BlockSpec((1, 1), lambda b, k, j, bt, sl: (bt[b, j], k))
+    return pl.BlockSpec((1, 1), lambda b, k, j, bt: (bt[b, j], k))
+
+
 @functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
 def paged_chunk_attention_pallas(q, k_pages, v_pages, block_tables,
                                  q_positions, page_size: int,
-                                 interpret: bool = False):
+                                 interpret: bool = False,
+                                 k_scales=None, v_scales=None):
     """q: [B, S, KV, G, hd]; k_pages/v_pages: [num_pages, page, KV, hd];
     block_tables: [B, P] int32; q_positions: [B, S] int32 absolute
-    positions (-1 = padding) -> [B, S, KV, G, hd]."""
+    positions (-1 = padding); k_scales/v_scales: [num_pages, KV] dequant
+    scales for int8 pages (None = full-precision pages)
+    -> [B, S, KV, G, hd]."""
     B, S, KV, G, hd = q.shape
     P = block_tables.shape[1]
+    quantized = k_scales is not None
 
     grid = (B, KV, P)
     kernel = functools.partial(_chunk_kernel, page_size=page_size,
-                               num_pages_per_seq=P)
+                               num_pages_per_seq=P, quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((1, S), lambda b, k, j, bt: (b, 0)),
+        pl.BlockSpec((1, S, 1, G, hd),
+                     lambda b, k, j, bt: (b, 0, k, 0, 0)),
+        pl.BlockSpec((1, page_size, 1, hd),
+                     lambda b, k, j, bt: (bt[b, j], 0, k, 0)),
+        pl.BlockSpec((1, page_size, 1, hd),
+                     lambda b, k, j, bt: (bt[b, j], 0, k, 0)),
+    ]
+    inputs = [q_positions, q, k_pages, v_pages]
+    if quantized:
+        in_specs += [_scale_spec(4), _scale_spec(4)]
+        inputs += [k_scales, v_scales]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, S), lambda b, k, j, bt: (b, 0)),
-                pl.BlockSpec((1, S, 1, G, hd),
-                             lambda b, k, j, bt: (b, 0, k, 0, 0)),
-                pl.BlockSpec((1, page_size, 1, hd),
-                             lambda b, k, j, bt: (bt[b, j], 0, k, 0)),
-                pl.BlockSpec((1, page_size, 1, hd),
-                             lambda b, k, j, bt: (bt[b, j], 0, k, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, S, 1, G, hd),
                                    lambda b, k, j, bt: (b, 0, k, 0, 0)),
             scratch_shapes=[
@@ -152,34 +190,43 @@ def paged_chunk_attention_pallas(q, k_pages, v_pages, block_tables,
         ),
         out_shape=jax.ShapeDtypeStruct((B, S, KV, G, hd), q.dtype),
         interpret=interpret,
-    )(block_tables, q_positions, q, k_pages, v_pages)
+    )(block_tables, *inputs)
     return out
 
 
 @functools.partial(jax.jit,
                    static_argnames=("page_size", "interpret"))
 def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
-                                  page_size: int, interpret: bool = False):
+                                  page_size: int, interpret: bool = False,
+                                  k_scales=None, v_scales=None):
     """q: [B, KV, G, hd]; k_pages/v_pages: [num_pages, page, KV, hd];
-    block_tables: [B, P] int32; seq_lens: [B] int32 -> [B, KV, G, hd]."""
+    block_tables: [B, P] int32; seq_lens: [B] int32; k_scales/v_scales:
+    [num_pages, KV] dequant scales for int8 pages (None = full precision)
+    -> [B, KV, G, hd]."""
     B, KV, G, hd = q.shape
     P = block_tables.shape[1]
+    quantized = k_scales is not None
 
     grid = (B, KV, P)
     kernel = functools.partial(_kernel, page_size=page_size,
-                               num_pages_per_seq=P)
+                               num_pages_per_seq=P, quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((1, 1, G, hd), lambda b, k, j, bt, sl: (b, k, 0, 0)),
+        pl.BlockSpec((1, page_size, 1, hd),
+                     lambda b, k, j, bt, sl: (bt[b, j], 0, k, 0)),
+        pl.BlockSpec((1, page_size, 1, hd),
+                     lambda b, k, j, bt, sl: (bt[b, j], 0, k, 0)),
+    ]
+    inputs = [q, k_pages, v_pages]
+    if quantized:
+        in_specs += [_scale_spec(5), _scale_spec(5)]
+        inputs += [k_scales, v_scales]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, 1, G, hd), lambda b, k, j, bt, sl: (b, k, 0, 0)),
-                pl.BlockSpec((1, page_size, 1, hd),
-                             lambda b, k, j, bt, sl: (bt[b, j], 0, k, 0)),
-                pl.BlockSpec((1, page_size, 1, hd),
-                             lambda b, k, j, bt, sl: (bt[b, j], 0, k, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, G, hd),
                                    lambda b, k, j, bt, sl: (b, k, 0, 0)),
             scratch_shapes=[
@@ -190,5 +237,5 @@ def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
         ),
         out_shape=jax.ShapeDtypeStruct((B, KV, G, hd), q.dtype),
         interpret=interpret,
-    )(block_tables, seq_lens, q, k_pages, v_pages)
+    )(block_tables, seq_lens, *inputs)
     return out

@@ -34,6 +34,9 @@ LOGICAL_RULES: dict[str, P] = {
     # (quantize.py): shard with the channels they scale
     "scale_model": P("model"),
     "kv_pages": P(None, None, None, "model", None),  # (L, pages, page, kv_heads, hd)
+    # int8 KV-page dequant scales (L, pages, kv_heads): shard the kv-head
+    # dim with the pages they scale
+    "kv_scales": P(None, None, "model"),
     "activations": P("data", None, None),  # (batch, seq, dim)
     "decode_heads": P("data", None, "model", None),  # (batch, seq, heads, hd)
 }
@@ -56,6 +59,15 @@ def kv_pages_sharding(mesh: Mesh, n_kv_heads: int) -> NamedSharding:
     model_size = mesh.shape.get("model", 1)
     if n_kv_heads % model_size == 0:
         return NamedSharding(mesh, LOGICAL_RULES["kv_pages"])
+    return NamedSharding(mesh, P())
+
+
+def kv_scales_sharding(mesh: Mesh, n_kv_heads: int) -> NamedSharding:
+    """Int8 KV scale sharding: tracks kv_pages_sharding — the scale of a
+    model-sharded page shard lives on the same chip as its values."""
+    model_size = mesh.shape.get("model", 1)
+    if n_kv_heads % model_size == 0:
+        return NamedSharding(mesh, LOGICAL_RULES["kv_scales"])
     return NamedSharding(mesh, P())
 
 

@@ -125,3 +125,37 @@ def param_bytes(tree: Any) -> int:
     """Resident bytes of a (possibly abstract) param tree."""
     leaves = jax.tree.leaves(tree)
     return sum(leaf.size * leaf.dtype.itemsize for leaf in leaves)
+
+
+# ------------------------------------------------------------------- KV cache
+#
+# The paged KV cache quantizes per PAGE per kv-head (kv/paged_cache.py):
+# symmetric int8 with a running-max scale, so every value in a page shares
+# one scale and the Pallas decode kernel dequantizes with a single scalar
+# multiply per (page, head) tile. These three primitives are the whole
+# numeric contract — the writers, the gather epilogue, and the fused-dequant
+# kernels must all agree on them.
+
+KV_SCALE_EPS = 1e-8  # floor under scales: all-zero pages must not divide by 0
+
+
+def kv_int8_scale(amax: jax.Array) -> jax.Array:
+    """Per-(page, head) scale from a max-|value| statistic: q = round(x/s)
+    stays inside [-127, 127] for every |x| <= amax."""
+    return amax.astype(jnp.float32) / 127.0
+
+
+def kv_quantize(x: jax.Array, scale: jax.Array) -> jax.Array:
+    """x -> int8 under ``scale`` (broadcast against x's leading dims).
+    Values beyond 127*scale saturate — the writers keep scales at the
+    running page max, so saturation only ever applies to stale (masked-
+    dead) positions being requantized."""
+    q = jnp.round(x.astype(jnp.float32) / jnp.maximum(scale, KV_SCALE_EPS))
+    return jnp.clip(q, -127.0, 127.0).astype(jnp.int8)
+
+
+def kv_dequantize(q: jax.Array, scale: jax.Array,
+                  dtype: jnp.dtype) -> jax.Array:
+    """int8 page values -> ``dtype`` (the engine compute dtype; scales are
+    stored in it, mirroring the weight-quant scale_dtype marker)."""
+    return (q.astype(jnp.float32) * scale.astype(jnp.float32)).astype(dtype)

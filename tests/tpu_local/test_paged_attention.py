@@ -11,9 +11,10 @@ from mcp_context_forge_tpu.tpu_local.ops.paged_attention import (
 )
 
 
-def _check_against_gather(CFG, page_size, num_pages, slots, per_slot, seq_lens):
+def _check_against_gather(CFG, page_size, num_pages, slots, per_slot, seq_lens,
+                          quant=""):
     kv = init_kv_state(CFG, num_pages, page_size, slots, per_slot,
-                       dtype=jnp.float32)
+                       dtype=jnp.float32, quant=quant)
     alloc = PageAllocator(num_pages, page_size, slots, per_slot)
     for slot, n in enumerate(seq_lens):
         assert alloc.allocate_slot(slot, n)
@@ -35,7 +36,9 @@ def _check_against_gather(CFG, page_size, num_pages, slots, per_slot, seq_lens):
     key, kq = jax.random.split(key)
     q = jax.random.normal(kq, (slots, KV, G, hd), dtype=jnp.float32)
 
-    # reference: gather + masked softmax (same math as llama._paged_decode_attention)
+    # reference: gather + masked softmax (same math as llama's
+    # _paged_decode_attention; gather_kv dequantizes int8 pages, so the
+    # kernel's FUSED dequant is held to the same stored values)
     import math
     keys_g, values_g = gather_kv(kv, 0, jnp.arange(slots))
     scores = jnp.einsum("bkgh,bckh->bkgc", q, keys_g) / math.sqrt(hd)
@@ -47,7 +50,9 @@ def _check_against_gather(CFG, page_size, num_pages, slots, per_slot, seq_lens):
     out = paged_decode_attention_pallas(
         q, kv.k_pages[0], kv.v_pages[0], kv.block_tables,
         jnp.asarray(seq_lens, dtype=jnp.int32), page_size=page_size,
-        interpret=True)
+        interpret=True,
+        k_scales=kv.k_scales[0] if quant else None,
+        v_scales=kv.v_scales[0] if quant else None)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
@@ -56,6 +61,23 @@ def test_paged_decode_matches_gather_reference():
     CFG = MODEL_CONFIGS["llama3-test"]  # KV=2, H=4, hd=16
     _check_against_gather(CFG, page_size=8, num_pages=16, slots=3, per_slot=4,
                           seq_lens=[13, 5, 20])
+
+
+def test_paged_decode_int8_fused_dequant_matches_gather():
+    """Tier-1 interpret-mode pin for the fused-dequant decode kernel: the
+    in-VMEM q*scale path must equal the dequant-gather epilogue exactly
+    (same int8 values, same scales — only WHERE the multiply happens
+    differs), so the kernel cannot rot between TPU hardware windows."""
+    CFG = MODEL_CONFIGS["llama3-test"]
+    _check_against_gather(CFG, page_size=8, num_pages=16, slots=3, per_slot=4,
+                          seq_lens=[13, 5, 20], quant="int8")
+
+
+def test_paged_decode_int8_llama1b_geometry():
+    class Geo:
+        n_kv_heads, n_heads, head_dim, n_layers = 8, 32, 64, 1
+    _check_against_gather(Geo, page_size=16, num_pages=24, slots=2, per_slot=8,
+                          seq_lens=[19, 33], quant="int8")
 
 
 def test_paged_decode_llama1b_geometry():
@@ -67,9 +89,15 @@ def test_paged_decode_llama1b_geometry():
                           seq_lens=[19, 33])
 
 
-def test_paged_chunk_matches_history_reference():
+import pytest
+
+
+@pytest.mark.parametrize("quant", ["", "int8"])
+def test_paged_chunk_matches_history_reference(quant):
     """Chunk kernel (S queries over the page list) vs _history_attention:
-    per-row history offsets, padding rows, multi-page contexts."""
+    per-row history offsets, padding rows, multi-page contexts. The int8
+    variant pins the kernel's fused dequant against the gather epilogue
+    (identical stored values, so the comparison is exact-tolerance)."""
     from mcp_context_forge_tpu.tpu_local.kv import write_decode_kv, gather_kv
     from mcp_context_forge_tpu.tpu_local.models.llama import _history_attention
     from mcp_context_forge_tpu.tpu_local.ops.paged_attention import (
@@ -86,7 +114,7 @@ def test_paged_chunk_matches_history_reference():
     chunk_lens = [6, 6, 3]
 
     kv = init_kv_state(CFG, num_pages, page_size, slots, per_slot,
-                       dtype=jnp.float32)
+                       dtype=jnp.float32, quant=quant)
     alloc = PageAllocator(num_pages, page_size, slots, per_slot)
     for slot in range(slots):
         assert alloc.allocate_slot(slot, hists[slot] + chunk_lens[slot])
@@ -117,7 +145,9 @@ def test_paged_chunk_matches_history_reference():
     qg = q.reshape(slots, S, KV, G, hd)
     out = paged_chunk_attention_pallas(
         qg, kv.k_pages[0], kv.v_pages[0], kv.block_tables, positions,
-        page_size=page_size, interpret=True)
+        page_size=page_size, interpret=True,
+        k_scales=kv.k_scales[0] if quant else None,
+        v_scales=kv.v_scales[0] if quant else None)
     out = out.reshape(slots, S, KV * G, hd)
     # compare only valid rows (padding rows are garbage in both paths)
     for slot in range(slots):

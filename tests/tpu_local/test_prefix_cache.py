@@ -324,6 +324,48 @@ def test_chunked_suffix_still_uses_cached_prefix():
     asyncio.run(run())
 
 
+def test_prefix_cache_on_int8_pages_register_release_match():
+    """Prefix cache composes with int8 KV pages: scales are PER PAGE, so a
+    shared prefix page carries its dequant scale with it. Register (first
+    request) → release (it finishes) → match (later requests) must return
+    the same cached-prefix length as a bf16 pool would, and the
+    continuation must be byte-identical to an int8 engine with the cache
+    off under greedy sampling."""
+    async def run():
+        base = dict(model="llama3-test", max_batch=2, max_seq_len=128,
+                    page_size=16, num_pages=64, prefill_buckets=(16, 64),
+                    dtype="float32", attn_impl="reference", kv_quant="int8")
+        cached = TPUEngine(EngineConfig(**base, prefix_cache=True))
+        cold = TPUEngine(EngineConfig(**base, prefix_cache=False))
+        template = cached.tokenizer.encode("sys: moderation template; answer:")
+        assert 2 * 16 < len(template) <= 48  # spans >1 full page
+        prompts = [template + cached.tokenizer.encode(f" user {i}")
+                   for i in range(3)]
+        for engine in (cached, cold):
+            await engine.start()
+        try:
+            seed = await _gen(cached, prompts[0])       # register
+            assert len(seed) >= 1                        # ...then release
+            # the cached-prefix length a match covers equals the bf16
+            # allocator's math (full pages strictly before the last token)
+            from mcp_context_forge_tpu.tpu_local.engine import GenRequest
+            probe = GenRequest(request_id="p", prompt_ids=prompts[1])
+            cached._assign_bucket(probe)
+            expected_hist = (len(template) // 16) * 16
+            assert probe.hist == expected_hist
+            outs_cached = [await _gen(cached, p) for p in prompts[1:]]
+            outs_cold = [await _gen(cold, p) for p in prompts[1:]]
+            assert outs_cached == outs_cold              # byte-identical
+            assert cached.allocator.prefix_hit_tokens >= expected_hist
+            # and the quantized pages really are the storage in play
+            assert cached.kv.quantized
+        finally:
+            for engine in (cached, cold):
+                await engine.stop()
+
+    asyncio.run(run())
+
+
 def test_chunked_template_registers_even_when_first_token_finishes():
     """max_tokens=1 classification over a chunked template: the prefix must
     register before the finishing emit frees the slot (regression: post-emit

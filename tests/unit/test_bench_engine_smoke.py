@@ -48,3 +48,22 @@ def test_bench_engine_serial_arm(bench_env, monkeypatch):
     assert out["decode_overlap"] is False
     assert out["overlap_steps"] == 0
     assert out["value"] > 0
+
+
+def test_bench_engine_kv_quant_ab_arm(bench_env, monkeypatch):
+    """BENCH_KV_QUANT=1: both storage arms run at the same byte budget and
+    the report carries capacity ratio + greedy token-parity rate."""
+    import bench_engine
+
+    monkeypatch.setenv("BENCH_KV_QUANT", "1")
+    monkeypatch.setattr(bench_engine, "pin_platform", lambda: "cpu")
+    out = bench_engine.main()
+    assert "token_streams" not in out  # raw streams never hit the JSON line
+    ab = out["kv_quant_ab"]
+    assert ab["baseline"]["value"] > 0 and ab["int8"]["value"] > 0
+    # fixed byte budget: the int8 pool must hold ~2x the pages (float32
+    # baseline on CPU makes the ratio ~4x; >=1.9 is the hardware bf16 bar)
+    assert ab["page_capacity_ratio"] >= 1.9
+    assert 0.0 <= ab["token_parity_rate"] <= 1.0
+    # greedy + tiny context: int8 drift must not flip tokens here
+    assert ab["token_parity_rate"] == 1.0
