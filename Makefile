@@ -1,6 +1,6 @@
 # mcp-context-forge-tpu (reference: 8.7k-line Makefile; the targets that matter)
 
-.PHONY: serve hub test test-py test-fast test-two-process bench bench-engine wrapper masking clean \
+.PHONY: serve hub lint test test-py test-fast test-two-process bench bench-engine wrapper masking clean \
 	sanitize sanitize-tsan sanitize-asan
 
 serve:
@@ -19,8 +19,14 @@ supervise:
 compose-config:
 	python -c "import yaml; yaml.safe_load(open('docker-compose.yml')); print('ok')"
 
-# full gate: python suite + the C++ tier under TSAN and ASAN/UBSAN
-test: test-py sanitize
+# in-tree static analysis (docs/static_analysis.md): async-safety, TPU
+# host-sync hazards, thread-boundary discipline. Non-zero exit on any
+# unsuppressed finding; also enforced in tier-1 via test_lint_clean.py.
+lint:
+	python -m mcp_context_forge_tpu.tools.lint mcp_context_forge_tpu
+
+# full gate: lint + python suite + the C++ tier under TSAN and ASAN/UBSAN
+test: lint test-py sanitize
 
 test-py:
 	python -m pytest tests/ -q

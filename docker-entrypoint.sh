@@ -22,6 +22,12 @@ PY
 fi
 
 case "$1" in
+  lint)
+    # in-tree static analysis (docs/static_analysis.md): non-zero exit
+    # on unsuppressed findings, same gate the image build already ran
+    shift
+    exec python -m mcp_context_forge_tpu.tools.lint "$@"
+    ;;
   serve|supervise|hub|token|version)
     cmd="$1"; shift
     if [ "$cmd" = "hub" ]; then

@@ -13,6 +13,7 @@ Values are JSON-serializable objects; ``ttl`` seconds (0 = no expiry).
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import json
 import os
@@ -98,7 +99,12 @@ class FileKVStore(KVStore):
             return None
         return os.path.join(self._dir, safe + ".json")
 
-    async def set(self, key: str, value: Any, ttl: float = 0.0) -> None:
+    # sync bodies run via asyncio.to_thread: these sit on the gateway
+    # request path (chat session state), and a slow/contended disk would
+    # otherwise stall every in-flight request on the loop
+    # (async-blocking-call lint rule; runtime twin in tests/async_safety/)
+
+    def _set_sync(self, key: str, value: Any, ttl: float) -> None:
         path = self._path(key)
         payload = {"value": value,
                    "expires": time.time() + ttl if ttl else 0.0}
@@ -107,17 +113,22 @@ class FileKVStore(KVStore):
             json.dump(payload, fh)
         os.replace(tmp, path)
 
-    async def get(self, key: str) -> Any:
-        payload = None
+    async def set(self, key: str, value: Any, ttl: float = 0.0) -> None:
+        await asyncio.to_thread(self._set_sync, key, value, ttl)
+
+    def _read_sync(self, key: str) -> Any:
         for path in (self._path(key), self._legacy_path(key)):
             if path is None:
                 continue
             try:
                 with open(path) as fh:
-                    payload = json.load(fh)
-                break
+                    return json.load(fh)
             except (FileNotFoundError, json.JSONDecodeError):
                 continue
+        return None
+
+    async def get(self, key: str) -> Any:
+        payload = await asyncio.to_thread(self._read_sync, key)
         if payload is None:
             return None
         if payload["expires"] and payload["expires"] <= time.time():
@@ -125,7 +136,7 @@ class FileKVStore(KVStore):
             return None
         return payload["value"]
 
-    async def delete(self, key: str) -> None:
+    def _delete_sync(self, key: str) -> None:
         for path in (self._path(key), self._legacy_path(key)):
             if path is None:
                 continue
@@ -134,7 +145,10 @@ class FileKVStore(KVStore):
             except FileNotFoundError:
                 pass
 
-    async def purge_expired(self) -> int:
+    async def delete(self, key: str) -> None:
+        await asyncio.to_thread(self._delete_sync, key)
+
+    def _purge_sync(self) -> int:
         purged = 0
         now = time.time()
         for entry in os.listdir(self._dir):
@@ -148,6 +162,9 @@ class FileKVStore(KVStore):
             except (OSError, json.JSONDecodeError):
                 continue  # concurrent writer/deleter; next sweep retries
         return purged
+
+    async def purge_expired(self) -> int:
+        return await asyncio.to_thread(self._purge_sync)
 
 
 class TcpKVStore(KVStore):

@@ -832,7 +832,9 @@ document.getElementById("f").onsubmit = async (e) => {
 
         import asyncio as _aio
 
-        started = profiler.start()["started_at"]
+        # profiler start/stop write trace files — run them off the loop
+        # (async-blocking-call discipline; the capture's mutex serializes)
+        started = (await _aio.to_thread(profiler.start))["started_at"]
         try:
             await _aio.sleep(duration_ms / 1000.0)
         finally:
@@ -840,7 +842,8 @@ document.getElementById("f").onsubmit = async (e) => {
             try:
                 # stop OUR capture only: an operator who stopped it and
                 # started their own mid-window must not lose theirs
-                result = profiler.stop(expect_started_at=started)
+                result = await _aio.to_thread(profiler.stop,
+                                              expect_started_at=started)
             except _Conflict:
                 result = {"active": profiler.active,
                           "trace_dir": profiler.trace_dir,

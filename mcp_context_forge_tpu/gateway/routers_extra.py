@@ -186,12 +186,21 @@ def setup_extra_routes(app: web.Application) -> None:
         /admin/engine/profile/stop); operator brackets exactly the
         traffic window they care about."""
         request["auth"].require("admin.all")
-        return web.json_response(profiler_or_404(request).start())
+        # start_trace/stop_trace write trace files: off the loop
+        # (async-blocking-call discipline), serialized by the capture's
+        # internal mutex
+        import asyncio
+
+        profiler = profiler_or_404(request)
+        return web.json_response(await asyncio.to_thread(profiler.start))
 
     @routes.post("/admin/engine/profile/stop")
     async def profile_stop(request: web.Request) -> web.Response:
         request["auth"].require("admin.all")
-        return web.json_response(profiler_or_404(request).stop())
+        import asyncio
+
+        profiler = profiler_or_404(request)
+        return web.json_response(await asyncio.to_thread(profiler.stop))
 
     # ---------------------------------------------------------------- plugins
     @routes.get("/plugins")

@@ -19,7 +19,7 @@ class PrometheusRegistry:
 
     def __init__(self) -> None:
         self.registry = CollectorRegistry()
-        self.app_info = Gauge(
+        self.app_info = Gauge(  # lint: allow[dead-metric] fully populated at registration
             "mcpforge_app_info", "Application info", ["version"], registry=self.registry
         )
         self.app_info.labels(version=__version__).set(1)

@@ -188,7 +188,8 @@ class PluginManager:
         manager = cls(ctx)
         path = Path(config_path or ctx.settings.plugin_config_file)
         if path.exists():
-            raw = yaml.safe_load(path.read_text()) or {}
+            # one small config read before the gateway serves traffic
+            raw = yaml.safe_load(path.read_text()) or {}  # lint: allow[async-blocking-call] startup-only
             for entry in raw.get("plugins", []):
                 config = PluginConfig(
                     name=entry.get("name", entry.get("kind", "plugin")),

@@ -23,12 +23,14 @@ in-proc ring logger) rather than translated:
 
 from __future__ import annotations
 
+import asyncio
 import io
 import json
 import logging
 import os
 import platform
 import sys
+import threading
 import time
 import zipfile
 from collections import deque
@@ -94,6 +96,11 @@ class JaxProfilerCapture:
     def __init__(self, trace_dir: str) -> None:
         self.trace_dir = trace_dir
         self._started_at: float | None = None
+        # start/stop run via asyncio.to_thread (start_trace/stop_trace
+        # write trace files — blocking the gateway loop for a disk flush
+        # defeats the capture); the lock keeps the active-check + the
+        # process-global profiler call atomic across those threads
+        self._mutex = threading.Lock()
 
     @property
     def active(self) -> bool:
@@ -104,35 +111,38 @@ class JaxProfilerCapture:
                 "started_at": self._started_at}
 
     def start(self) -> dict[str, Any]:
-        if self.active:
-            raise ConflictError("a profiler capture is already running")
-        import jax
+        with self._mutex:
+            if self.active:
+                raise ConflictError("a profiler capture is already running")
+            import jax
 
-        jax.profiler.start_trace(self.trace_dir)
-        self._started_at = time.time()
-        return self.status()
+            jax.profiler.start_trace(self.trace_dir)
+            self._started_at = time.time()
+            return self.status()
 
     def stop(self, expect_started_at: float | None = None) -> dict[str, Any]:
         """``expect_started_at`` lets a timed capture stop only the capture
         it started — without it, a concurrent operator's stop+start window
         would let the timed handler silently kill the operator's capture."""
-        if not self.active:
-            raise ConflictError("no profiler capture is running")
-        if (expect_started_at is not None
-                and self._started_at != expect_started_at):
-            raise ConflictError("the running capture belongs to another "
-                                "caller; leaving it alone")
-        import jax
+        with self._mutex:
+            if not self.active:
+                raise ConflictError("no profiler capture is running")
+            if (expect_started_at is not None
+                    and self._started_at != expect_started_at):
+                raise ConflictError("the running capture belongs to another "
+                                    "caller; leaving it alone")
+            import jax
 
-        started = self._started_at
-        try:
-            jax.profiler.stop_trace()
-        finally:
-            self._started_at = None
-        return {"active": False, "trace_dir": self.trace_dir,
-                "duration_ms": round((time.time() - (started or 0.0)) * 1e3, 1),
-                "hint": "open with TensorBoard or xprof: the trace contains"
-                        " XLA op timelines for prefill/decode"}
+            started = self._started_at
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                self._started_at = None
+            return {"active": False, "trace_dir": self.trace_dir,
+                    "duration_ms": round(
+                        (time.time() - (started or 0.0)) * 1e3, 1),
+                    "hint": "open with TensorBoard or xprof: the trace "
+                            "contains XLA op timelines for prefill/decode"}
 
 
 # --------------------------------------------------------------------------
@@ -402,9 +412,57 @@ class SupportBundleService:
                        include_env: bool = True,
                        log_tail: int = 1000) -> tuple[str, bytes]:
         """Return (filename, zip bytes). Everything passes the shared
-        redaction policy before it reaches the archive."""
+        redaction policy before it reaches the archive.
+
+        The awaitable pieces (DB stats) gather here on the loop; the
+        CPU-bound part — per-record log redaction plus DEFLATE over the
+        whole archive — runs in a worker thread. On a loaded gateway a
+        bundle download must not stall every in-flight request
+        (async-blocking-call lint rule; the heartbeat test in
+        tests/async_safety/ is its runtime twin)."""
         stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
         name = f"mcpforge-support-{stamp}.zip"
+        sections: list[tuple[str, Any]] = [("version.json", {
+            "version": __version__,
+            "protocol_version": PROTOCOL_VERSION,
+            "python": sys.version,
+            "worker_id": self._ctx.worker_id,
+        })]
+        sections.append(("system.json", self._system_info()))
+        sections.append(("settings.json", redact_settings(self._ctx.settings)))
+        if include_env:
+            sections.append(("environment.json", redact_env(os.environ)))
+        sections.append(("database.json", await self._db_info()))
+        engine = self._ctx.extras.get("tpu_engine")
+        if engine is not None:
+            try:
+                stats = engine.stats
+                sections.append(("engine.json", {
+                    "model": engine.config.model,
+                    "mesh": dict(engine.mesh.shape),
+                    "requests": stats.requests,
+                    "completion_tokens": stats.completion_tokens,
+                    "decode_steps": stats.decode_steps,
+                    "queue_depth": stats.queue_depth,
+                }))
+                if hasattr(engine, "recent_steps"):
+                    sections.append(("engine_steps.json",
+                                     engine_introspection(engine, limit=128)))
+            except Exception as exc:  # diagnostics must not fail the bundle
+                sections.append(("engine.json", {"error": str(exc)}))
+        records = (ring_buffer.search(limit=log_tail) if include_logs
+                   else None)
+        perf = self._ctx.extras.get("perf_tracker")
+        if perf is not None:
+            sections.append(("performance.json", perf.summary()))
+        payload = await asyncio.to_thread(self._build_zip, stamp, sections,
+                                          records)
+        return name, payload
+
+    @staticmethod
+    def _build_zip(stamp: str, sections: list[tuple[str, Any]],
+                   records: list[Any] | None) -> bytes:
+        """Worker-thread half: redact log records, serialize, compress."""
         buf = io.BytesIO()
         entries: list[str] = []
         with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
@@ -414,53 +472,23 @@ class SupportBundleService:
                     payload, indent=2, default=str)
                 zf.writestr(path, body)
 
-            put("version.json", {
-                "version": __version__,
-                "protocol_version": PROTOCOL_VERSION,
-                "python": sys.version,
-                "worker_id": self._ctx.worker_id,
-            })
-            put("system.json", self._system_info())
-            put("settings.json", redact_settings(self._ctx.settings))
-            if include_env:
-                put("environment.json", redact_env(os.environ))
-            put("database.json", await self._db_info())
-            engine = self._ctx.extras.get("tpu_engine")
-            if engine is not None:
-                try:
-                    stats = engine.stats
-                    put("engine.json", {
-                        "model": engine.config.model,
-                        "mesh": dict(engine.mesh.shape),
-                        "requests": stats.requests,
-                        "completion_tokens": stats.completion_tokens,
-                        "decode_steps": stats.decode_steps,
-                        "queue_depth": stats.queue_depth,
-                    })
-                    if hasattr(engine, "recent_steps"):
-                        put("engine_steps.json",
-                            engine_introspection(engine, limit=128))
-                except Exception as exc:  # diagnostics must not fail the bundle
-                    put("engine.json", {"error": str(exc)})
-            if include_logs:
+            for path, payload in sections:
+                put(path, payload)
+            if records is not None:
                 # log MESSAGES are free text: exception strings and
                 # third-party libraries embed DSNs/bearer tokens that the
                 # name-keyed settings redaction never sees — run every
                 # serialized record through the content redaction pass
                 # before it reaches the 'sanitized: true' archive
-                records = ring_buffer.search(limit=log_tail)
                 put("logs/recent.jsonl",
                     "\n".join(redact_text(json.dumps(r, default=str))
                               for r in records))
-            perf = self._ctx.extras.get("perf_tracker")
-            if perf is not None:
-                put("performance.json", perf.summary())
             put("manifest.json", {
                 "generated_at": stamp,
                 "entries": sorted(entries),
                 "sanitized": True,
             })
-        return name, buf.getvalue()
+        return buf.getvalue()
 
     def _system_info(self) -> dict[str, Any]:
         info: dict[str, Any] = {

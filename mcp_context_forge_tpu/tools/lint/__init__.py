@@ -1,0 +1,30 @@
+"""mcpforge-lint: in-tree AST analysis for async-safety, TPU host-sync
+hazards, and thread-boundary discipline.
+
+Run: ``python -m mcp_context_forge_tpu.tools.lint [paths...]``
+Docs: ``docs/static_analysis.md`` (rule catalogue, suppression syntax,
+baseline workflow, adding a rule).
+"""
+
+from pathlib import Path
+
+from .core import (Baseline, FileContext, Finding, LintResult,  # noqa: F401
+                   Rule, collect_sources, lint_contexts, lint_sources,
+                   register, registered_rules)
+from .rules import active_rules  # noqa: F401
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def lint_paths(paths: list[Path], rules=None,
+               baseline: Baseline | None = None) -> LintResult:
+    """Lint every .py under ``paths`` with ``rules`` (default: all)."""
+    return lint_sources(collect_sources(paths),
+                        rules if rules is not None else active_rules(),
+                        baseline)
+
+
+def load_default_baseline() -> Baseline:
+    if DEFAULT_BASELINE.exists():
+        return Baseline.load(DEFAULT_BASELINE)
+    return Baseline()

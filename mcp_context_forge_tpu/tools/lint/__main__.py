@@ -1,0 +1,129 @@
+"""CLI: ``python -m mcp_context_forge_tpu.tools.lint [paths...]``.
+
+Exit 0 when clean (no unsuppressed, unbaselined findings and no parse
+errors); exit 1 otherwise. ``--write-baseline`` snapshots the current
+findings into the baseline file — every entry then needs a hand-written
+``reason`` before the file loads as a valid gate (see
+docs/static_analysis.md for the burn-down workflow).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from . import (DEFAULT_BASELINE, Baseline, active_rules,
+               load_default_baseline, lint_paths)
+from .reporters import json_report, text_report
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mcp_context_forge_tpu.tools.lint",
+        description="in-tree AST lint: async-safety, TPU host-sync "
+                    "hazards, thread-boundary discipline")
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories (default: the package)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help=f"baseline file (default: {DEFAULT_BASELINE})")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline (show everything)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write current findings to the baseline file "
+                             "(fill in each entry's reason by hand)")
+    parser.add_argument("--rules", default="",
+                        help="comma-separated rule ids (default: all)")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    rules = active_rules()
+    if args.list_rules:
+        for rule in rules:
+            print(f"{rule.rule_id}: {rule.description}")
+        return 0
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = wanted - {r.rule_id for r in rules}
+        if unknown:
+            print(f"unknown rule id(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.rule_id in wanted]
+
+    roots = ([Path(p) for p in args.paths] if args.paths
+             else [Path(__file__).resolve().parents[2]])
+    missing = [str(p) for p in roots if not p.exists()]
+    if missing:
+        print(f"no such file or directory: {missing}", file=sys.stderr)
+        return 2
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    if args.no_baseline or args.write_baseline:
+        # a regenerated baseline must capture EVERY current finding, not
+        # just the ones the previous baseline didn't already cover
+        baseline = Baseline()
+    else:
+        try:
+            baseline = (Baseline.load(baseline_path)
+                        if args.baseline is not None
+                        else load_default_baseline())
+        except FileNotFoundError:
+            print(f"baseline file not found: {baseline_path}",
+                  file=sys.stderr)
+            return 2
+        except ValueError as exc:
+            print(f"invalid baseline {baseline_path}: {exc}",
+                  file=sys.stderr)
+            return 2
+
+    result = lint_paths(roots, rules=rules, baseline=baseline)
+
+    if args.write_baseline:
+        # scoped runs (subset paths / --rules) must not discard the
+        # entries they never re-checked: keep every existing entry whose
+        # (rule, path) is outside this run's scope, replace the rest —
+        # and a still-firing entry keeps its hand-written reason (the
+        # justification is the reviewable artifact; a snapshot must not
+        # reset it to the TODO placeholder). Read leniently: the file
+        # being regenerated may itself still hold placeholders.
+        import json as _json
+
+        from .core import collect_sources, paths_match
+        linted = set(collect_sources(roots))
+        rule_ids = {r.rule_id for r in rules}
+        existing = (_json.loads(baseline_path.read_text()).get("entries", [])
+                    if baseline_path.exists() else [])
+        kept = [e for e in existing
+                if e.get("rule") not in rule_ids
+                or not any(paths_match(str(e.get("path")), p)
+                           for p in linted)]
+
+        def reason_for(finding) -> str:
+            for e in existing:
+                if (e.get("rule") == finding.rule
+                        and e.get("code") == finding.code
+                        and paths_match(str(e.get("path")), finding.path)
+                        and e.get("reason")):
+                    return str(e["reason"])
+            return "TODO: justify or fix"
+
+        fresh = [Baseline.entry_for(f, reason=reason_for(f))
+                 for f in result.findings]
+        Baseline(entries=kept + fresh).save(baseline_path)
+        todos = sum(1 for e in fresh if e["reason"].startswith("TODO"))
+        print(f"wrote {len(fresh)} entr(y/ies) ({todos} needing a reason) "
+              f"+ kept {len(kept)} out-of-scope to {baseline_path} — "
+              f"replace every TODO reason before committing (the loader "
+              f"refuses placeholders)")
+        return 0
+
+    print(text_report(result) if args.format == "text"
+          else json_report(result))
+    # stale baseline entries fail the run too — the tier-1 gate
+    # (test_lint_clean.py) treats them as failures, and this CLI backs
+    # the same gate in `make lint` and the Containerfile build
+    return 0 if result.clean and not result.stale_baseline else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,166 @@
+"""Shared AST helpers for the lint rules: dotted-name resolution, the
+intra-module call graph, and jit-wrapped-function discovery."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+FunctionNode = ast.FunctionDef | ast.AsyncFunctionDef
+
+
+def dotted(node: ast.AST) -> tuple[str, ...]:
+    """('jax','device_get') for jax.device_get; () when not a name path."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return ()
+
+
+def walk_functions(tree: ast.Module) -> Iterator[FunctionNode]:
+    """Every function/method def in the module, any nesting depth."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def called_names(fn: FunctionNode) -> set[str]:
+    """Names this function calls: bare ``foo()`` and ``self.foo()`` —
+    the intra-module/-class call-graph edge set. Calls inside nested
+    defs are attributed to the enclosing function (they run, at the
+    latest, on the thread that defined them or received them)."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Name):
+            out.add(func.id)
+        elif isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name) and func.value.id == "self":
+                out.add(func.attr)
+    return out
+
+
+def reachable_functions(tree: ast.Module,
+                        roots: set[str]) -> dict[str, FunctionNode]:
+    """Closure of the name-keyed call graph from ``roots``.
+
+    Name-keyed (not qualname) — two classes sharing a method name merge;
+    for a hazard lint, over-approximating reachability is the safe
+    direction."""
+    defs: dict[str, FunctionNode] = {}
+    edges: dict[str, set[str]] = {}
+    for fn in walk_functions(tree):
+        # first def wins so nested helper defs don't shadow methods
+        defs.setdefault(fn.name, fn)
+        edges.setdefault(fn.name, set()).update(called_names(fn))
+    seen: set[str] = set()
+    frontier = [name for name in roots if name in defs]
+    while frontier:
+        name = frontier.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        frontier.extend(callee for callee in edges.get(name, ())
+                        if callee in defs and callee not in seen)
+    return {name: defs[name] for name in seen}
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    d = dotted(node.func)
+    return d == ("jit",) or (len(d) == 2 and d[1] == "jit")
+
+
+def _partial_target(node: ast.AST) -> tuple[ast.AST, set[str]]:
+    """Unwrap ``partial(f, kw=...)`` -> (f, bound kwarg names)."""
+    bound: set[str] = set()
+    while (isinstance(node, ast.Call) and dotted(node.func)
+           and dotted(node.func)[-1] == "partial" and node.args):
+        bound.update(kw.arg for kw in node.keywords if kw.arg)
+        node = node.args[0]
+    return node, bound
+
+
+def static_argnames_of(call: ast.Call) -> set[str]:
+    """Argument names the jit call marks static via static_argnames."""
+    static: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg != "static_argnames":
+            continue
+        value = kw.value
+        elts = value.elts if isinstance(value, (ast.Tuple, ast.List)) \
+            else [value]
+        static.update(e.value for e in elts
+                      if isinstance(e, ast.Constant)
+                      and isinstance(e.value, str))
+    return static
+
+
+def jitted_functions(tree: ast.Module) -> dict[str, set[str]]:
+    """``{function name: non-traced param names}`` for every function the
+    module wraps in ``jax.jit`` — directly (``jax.jit(f)``), through
+    ``partial`` (bound kwargs become non-traced), or as a decorator.
+
+    Only functions *defined in this module* are returned; jitting an
+    imported name is out of this per-file rule's reach."""
+    defined = {fn.name for fn in walk_functions(tree)}
+    out: dict[str, set[str]] = {}
+
+    def record(target: ast.AST, static: set[str]) -> None:
+        d = dotted(target)
+        name = d[-1] if d else ""
+        if name in defined:
+            out.setdefault(name, set()).update(static)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_call(node) and node.args:
+            target, bound = _partial_target(node.args[0])
+            record(target, bound | static_argnames_of(node))
+    for fn in walk_functions(tree):
+        for deco in fn.decorator_list:
+            call = deco if isinstance(deco, ast.Call) else None
+            d = dotted(call.func if call else deco)
+            if d == ("jit",) or (len(d) == 2 and d[1] == "jit"):
+                out.setdefault(fn.name, set()).update(
+                    static_argnames_of(call) if call else set())
+            elif call is not None and d and d[-1] == "partial" and call.args:
+                inner = dotted(call.args[0])
+                if inner == ("jit",) or (len(inner) == 2
+                                         and inner[1] == "jit"):
+                    out.setdefault(fn.name, set()).update(
+                        static_argnames_of(call))
+    return out
+
+
+def decorator_jitted(tree: ast.Module) -> set[str]:
+    """Functions whose OWN name is a jitted callable (``@jax.jit`` /
+    ``@partial(jax.jit, ...)``) — unlike ``g = jax.jit(f)``, where calling
+    ``f`` directly still runs plain Python."""
+    out: set[str] = set()
+    for fn in walk_functions(tree):
+        for deco in fn.decorator_list:
+            call = deco if isinstance(deco, ast.Call) else None
+            d = dotted(call.func if call else deco)
+            if d == ("jit",) or (len(d) == 2 and d[1] == "jit"):
+                out.add(fn.name)
+            elif call is not None and d and d[-1] == "partial" and call.args:
+                inner = dotted(call.args[0])
+                if inner == ("jit",) or (len(inner) == 2
+                                         and inner[1] == "jit"):
+                    out.add(fn.name)
+    return out
+
+
+def param_names(fn: FunctionNode) -> list[str]:
+    a = fn.args
+    names = [arg.arg for arg in [*a.posonlyargs, *a.args, *a.kwonlyargs]]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names

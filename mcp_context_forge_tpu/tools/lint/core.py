@@ -1,0 +1,288 @@
+"""mcpforge-lint engine: file contexts, rule registry, suppressions, baseline.
+
+The rules themselves live in ``rules/``; this module is the load-bearing
+machinery they plug into, and it is mutation-gated (see
+``testing/oracles.py::lint_core_oracle``) — a fault that silently eats a
+finding, honors a suppression it should not, or mis-matches the baseline
+must fail the suite.
+
+Vocabulary (all parsed from REAL comments via tokenize, never strings):
+
+- ``# lint: allow[rule-id] reason`` — suppress `rule-id` on this line.
+- ``# lint: thread[name]``          — the attribute assigned on this line
+  is owned by thread `name` (cross-thread-mutation rule).
+- ``# lint: runs-on[name]``         — the function defined here runs on
+  thread `name`.
+- ``# lint: lock[name]``            — the attribute assigned on this line
+  is a lock guarding thread `name`'s state.
+- ``# lint: hot-path``              — the function defined here roots a
+  host-sync-sensitive region (host-sync-in-hot-path rule).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Iterable
+
+_MARKER_RE = re.compile(r"#\s*lint:\s*([a-z][a-z-]*)(?:\[([^\]]*)\])?")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    lineno: int
+    message: str
+    code: str = ""  # stripped source line; the baseline's content anchor
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.lineno}: {self.rule} {self.message}"
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"rule": self.rule, "path": self.path, "lineno": self.lineno,
+                "message": self.message, "code": self.code}
+
+
+class FileContext:
+    """One parsed source file: AST + the lint marker comments, line-keyed."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module,
+                 markers: dict[int, list[tuple[str, str]]]):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.markers = markers
+        self.lines = source.splitlines()
+
+    @classmethod
+    def from_source(cls, source: str, path: str) -> "FileContext":
+        tree = ast.parse(source)
+        markers: dict[int, list[tuple[str, str]]] = {}
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            for m in _MARKER_RE.finditer(tok.string):
+                markers.setdefault(tok.start[0], []).append(
+                    (m.group(1), m.group(2) or ""))
+        return cls(path, source, tree, markers)
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def allowed(self, lineno: int) -> set[str]:
+        """Rule ids suppressed on this line via ``# lint: allow[...]``."""
+        return {arg for kind, arg in self.markers.get(lineno, ())
+                if kind == "allow" and arg}
+
+    def markers_of(self, kind: str) -> dict[int, str]:
+        """line -> argument, for every marker of ``kind`` in the file."""
+        out: dict[int, str] = {}
+        for lineno, entries in self.markers.items():
+            for mkind, arg in entries:
+                if mkind == kind:
+                    out[lineno] = arg
+        return out
+
+    def def_marker(self, node: ast.AST, kind: str) -> str | None:
+        """Marker of ``kind`` attached to a def: any line from the def
+        keyword through the end of the signature (multi-line defs count;
+        a one-line ``def f(): body  # marker`` counts its only line)."""
+        markers = self.markers_of(kind)
+        first_body = node.body[0].lineno if getattr(node, "body", None) else \
+            node.lineno
+        for lineno in range(node.lineno, max(first_body, node.lineno + 1)):
+            if lineno in markers:
+                return markers[lineno]
+        return None
+
+
+class Rule:
+    """Base class; subclasses register with ``@register``.
+
+    Per-file rules override ``check``; whole-tree rules (which need every
+    file at once, e.g. dead-metric) override ``check_project``.
+    """
+
+    rule_id: str = ""
+    description: str = ""
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, contexts: list[FileContext]) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: dict[str, type[Rule]] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    if not cls.rule_id:
+        raise ValueError(f"{cls.__name__} has no rule_id")
+    if cls.rule_id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {cls.rule_id!r}")
+    _REGISTRY[cls.rule_id] = cls
+    return cls
+
+
+def registered_rules() -> dict[str, type[Rule]]:
+    return dict(_REGISTRY)
+
+
+def paths_match(a: str, b: str) -> bool:
+    """Same file across invocation styles: exact, or one is a whole-
+    segment suffix of the other — `make lint` sees
+    ``mcp_context_forge_tpu/x.py`` where the tier-1 gate (absolute
+    resolved roots) and the Containerfile (``/build/...``) see longer
+    spellings of the same file; a baseline entry must suppress in all
+    three or the gates diverge."""
+    if a == b:
+        return True
+    return a.endswith("/" + b) or b.endswith("/" + a)
+
+
+@dataclass
+class Baseline:
+    """Accepted pre-existing findings, content-anchored.
+
+    Entries match on (rule, path, code) — the stripped source line — never
+    on line numbers, so unrelated edits shifting a file do not silently
+    re-arm (or mis-suppress) a baselined finding. Paths compare via
+    ``paths_match`` so relative and absolute invocations agree. Every
+    entry must carry a written ``reason``; both ``load`` and ``save``
+    refuse entries without one (a hand-added reason-less entry must not
+    silently suppress).
+    """
+
+    entries: list[dict[str, Any]] = field(default_factory=list)
+    _used: set[int] = field(default_factory=set)
+
+    @staticmethod
+    def _check_reasons(entries: list[dict[str, Any]],
+                       forbid_todo: bool = False) -> None:
+        for entry in entries:
+            reason = entry.get("reason")
+            if not reason:
+                raise ValueError(
+                    f"baseline entry for {entry.get('path')}:"
+                    f"{entry.get('rule')} has no written reason")
+            if forbid_todo and str(reason).startswith("TODO"):
+                raise ValueError(
+                    f"baseline entry for {entry.get('path')}:"
+                    f"{entry.get('rule')} still has the --write-baseline "
+                    f"placeholder reason — write the real justification")
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        """Gate-side read: refuses reason-less entries AND the
+        ``TODO:`` placeholders ``--write-baseline`` emits, so a
+        forgotten placeholder cannot suppress findings forever."""
+        raw = json.loads(Path(path).read_text())
+        entries = list(raw.get("entries", []))
+        cls._check_reasons(entries, forbid_todo=True)
+        return cls(entries=entries)
+
+    def save(self, path: Path) -> None:
+        self._check_reasons(self.entries)
+        Path(path).write_text(json.dumps(
+            {"entries": self.entries}, indent=2, sort_keys=True) + "\n")
+
+    def match(self, finding: Finding) -> bool:
+        """True (and consume the entry) when ``finding`` is baselined."""
+        for i, entry in enumerate(self.entries):
+            if i in self._used:
+                continue
+            if (entry.get("rule") == finding.rule
+                    and paths_match(str(entry.get("path")), finding.path)
+                    and entry.get("code") == finding.code):
+                self._used.add(i)
+                return True
+        return False
+
+    def stale(self) -> list[dict[str, Any]]:
+        """Entries no current finding matched — burn them down."""
+        return [e for i, e in enumerate(self.entries) if i not in self._used]
+
+    @staticmethod
+    def entry_for(finding: Finding, reason: str) -> dict[str, Any]:
+        return {"rule": finding.rule, "path": finding.path,
+                "code": finding.code, "reason": reason}
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding] = field(default_factory=list)   # actionable
+    suppressed: list[Finding] = field(default_factory=list)  # # lint: allow
+    baselined: list[Finding] = field(default_factory=list)
+    stale_baseline: list[dict[str, Any]] = field(default_factory=list)
+    errors: list[Finding] = field(default_factory=list)      # syntax errors
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.errors
+
+
+def lint_contexts(contexts: list[FileContext], rules: Iterable[Rule],
+                  baseline: Baseline | None = None) -> LintResult:
+    """Run ``rules`` over ``contexts`` and triage every finding into
+    actionable / suppressed / baselined."""
+    result = LintResult()
+    by_path = {ctx.path: ctx for ctx in contexts}
+    raw: list[Finding] = []
+    for rule in rules:
+        for ctx in contexts:
+            raw.extend(rule.check(ctx))
+        raw.extend(rule.check_project(contexts))
+    baseline = baseline if baseline is not None else Baseline()
+    for finding in raw:
+        ctx = by_path.get(finding.path)
+        if ctx is not None:
+            if not finding.code:
+                finding.code = ctx.line(finding.lineno).strip()
+            if finding.rule in ctx.allowed(finding.lineno):
+                result.suppressed.append(finding)
+                continue
+        if baseline.match(finding):
+            result.baselined.append(finding)
+            continue
+        result.findings.append(finding)
+    result.findings.sort(key=lambda f: (f.path, f.lineno, f.rule))
+    result.stale_baseline = baseline.stale()
+    return result
+
+
+def lint_sources(sources: dict[str, str], rules: Iterable[Rule],
+                 baseline: Baseline | None = None) -> LintResult:
+    """Lint in-memory ``{path: source}`` pairs (fixtures and tests)."""
+    contexts: list[FileContext] = []
+    errors: list[Finding] = []
+    for path, source in sorted(sources.items()):
+        try:
+            contexts.append(FileContext.from_source(source, path))
+        except SyntaxError as exc:
+            errors.append(Finding("syntax-error", path, exc.lineno or 0,
+                                  "file does not parse", code=""))
+    result = lint_contexts(contexts, rules, baseline)
+    result.errors.extend(errors)
+    return result
+
+
+def collect_sources(roots: list[Path]) -> dict[str, str]:
+    """``{posix-path: source}`` for every .py under ``roots`` (files ok)."""
+    sources: dict[str, str] = {}
+    for root in roots:
+        paths = sorted(root.rglob("*.py")) if root.is_dir() else [root]
+        for path in paths:
+            if "__pycache__" in path.parts:
+                continue
+            sources[path.as_posix()] = path.read_text(encoding="utf-8",
+                                                      errors="replace")
+    return sources

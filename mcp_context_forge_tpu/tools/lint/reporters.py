@@ -1,0 +1,39 @@
+"""Text and JSON reporters for lint results."""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from .core import LintResult
+
+
+def text_report(result: LintResult) -> str:
+    lines: list[str] = []
+    for finding in [*result.errors, *result.findings]:
+        lines.append(str(finding))
+        if finding.code:
+            lines.append(f"    {finding.code}")
+    for entry in result.stale_baseline:
+        lines.append(f"stale baseline entry (burn it down): "
+                     f"{entry.get('path')}: {entry.get('rule')} "
+                     f"[{entry.get('code')}]")
+    lines.append(
+        f"{len(result.findings)} finding(s), "
+        f"{len(result.suppressed)} suppressed, "
+        f"{len(result.baselined)} baselined, "
+        f"{len(result.stale_baseline)} stale baseline entr(y/ies), "
+        f"{len(result.errors)} parse error(s)")
+    return "\n".join(lines)
+
+
+def json_report(result: LintResult) -> str:
+    payload: dict[str, Any] = {
+        "findings": [f.to_dict() for f in result.findings],
+        "errors": [f.to_dict() for f in result.errors],
+        "suppressed": [f.to_dict() for f in result.suppressed],
+        "baselined": [f.to_dict() for f in result.baselined],
+        "stale_baseline": result.stale_baseline,
+        "clean": result.clean,
+    }
+    return json.dumps(payload, indent=2, sort_keys=True)

@@ -1,0 +1,10 @@
+"""Rule catalogue: importing this package populates the registry."""
+
+from ..core import Rule, registered_rules
+from . import (async_blocking, dead_metric, host_sync, jit_discipline,  # noqa: F401
+               thread_boundary)
+
+
+def active_rules() -> list[Rule]:
+    """One instance of every registered rule, id-sorted (stable output)."""
+    return [cls() for _, cls in sorted(registered_rules().items())]

@@ -1,0 +1,108 @@
+"""Rule: blocking calls inside ``async def``.
+
+One sync sqlite statement, file read, or ``time.sleep`` on the gateway
+event loop stalls EVERY in-flight request (the runtime twin of this check
+is ``tests/async_safety/test_event_loop_blocking.py``, which can only
+exercise the paths a burst happens to hit). The deny-list is the set of
+call shapes this codebase has actually put on a loop: sync file I/O
+(``open``/pathlib read-write/zipfile/tarfile), sync sleep, sync sqlite,
+subprocess, and the sync HTTP clients.
+
+Fix: ``await asyncio.to_thread(...)`` (or the aiohttp/db facade that
+already exists for the case). Calls inside a nested ``def``/``lambda``
+are not flagged — that's exactly how work is handed to a thread.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..astutil import dotted
+from ..core import FileContext, Finding, Rule, register
+
+# exact dotted call paths that block the calling thread
+BLOCKING_CALLS: dict[tuple[str, ...], str] = {
+    ("time", "sleep"): "use asyncio.sleep",
+    ("sqlite3", "connect"): "use the async Database facade",
+    ("subprocess", "run"): "use asyncio.create_subprocess_exec",
+    ("subprocess", "call"): "use asyncio.create_subprocess_exec",
+    ("subprocess", "check_call"): "use asyncio.create_subprocess_exec",
+    ("subprocess", "check_output"): "use asyncio.create_subprocess_exec",
+    ("subprocess", "Popen"): "use asyncio.create_subprocess_exec",
+    ("requests", "get"): "use aiohttp",
+    ("requests", "post"): "use aiohttp",
+    ("requests", "put"): "use aiohttp",
+    ("requests", "patch"): "use aiohttp",
+    ("requests", "delete"): "use aiohttp",
+    ("requests", "head"): "use aiohttp",
+    ("requests", "request"): "use aiohttp",
+    ("urllib", "request", "urlopen"): "use aiohttp",
+    ("socket", "create_connection"): "use loop.sock_connect/aiohttp",
+    ("os", "system"): "use asyncio.create_subprocess_exec",
+    ("os", "popen"): "use asyncio.create_subprocess_exec",
+    ("open",): "move the file I/O to asyncio.to_thread",
+    ("zipfile", "ZipFile"): "build the archive in asyncio.to_thread",
+    ("tarfile", "open"): "build the archive in asyncio.to_thread",
+    ("jax", "profiler", "start_trace"):
+        "profiler writes trace files; call via asyncio.to_thread",
+    ("jax", "profiler", "stop_trace"):
+        "profiler writes trace files; call via asyncio.to_thread",
+}
+
+# method names that are sync file I/O on any receiver (pathlib idiom)
+BLOCKING_METHODS: dict[str, str] = {
+    "read_text": "move the file I/O to asyncio.to_thread",
+    "write_text": "move the file I/O to asyncio.to_thread",
+    "read_bytes": "move the file I/O to asyncio.to_thread",
+    "write_bytes": "move the file I/O to asyncio.to_thread",
+}
+
+
+@register
+class AsyncBlockingCallRule(Rule):
+    rule_id = "async-blocking-call"
+    description = ("blocking call on the event loop: sync sleep/file I/O/"
+                   "subprocess/HTTP inside async def")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        findings: list[Finding] = []
+
+        class Visitor(ast.NodeVisitor):
+            def __init__(self) -> None:
+                self.async_fn: str | None = None
+
+            def visit_AsyncFunctionDef(self, node) -> None:
+                prev, self.async_fn = self.async_fn, node.name
+                self.generic_visit(node)
+                self.async_fn = prev
+
+            def visit_FunctionDef(self, node) -> None:
+                # a nested sync def is DEFERRED work (to_thread target,
+                # executor fn, callback) — its body is off the loop
+                prev, self.async_fn = self.async_fn, None
+                self.generic_visit(node)
+                self.async_fn = prev
+
+            def visit_Lambda(self, node) -> None:
+                prev, self.async_fn = self.async_fn, None
+                self.generic_visit(node)
+                self.async_fn = prev
+
+            def visit_Call(self, node: ast.Call) -> None:
+                if self.async_fn is not None:
+                    d = dotted(node.func)
+                    hint = BLOCKING_CALLS.get(d)
+                    if hint is None and isinstance(node.func, ast.Attribute):
+                        hint = BLOCKING_METHODS.get(node.func.attr)
+                        d = (node.func.attr,)
+                    if hint is not None:
+                        findings.append(Finding(
+                            AsyncBlockingCallRule.rule_id, ctx.path,
+                            node.lineno,
+                            f"blocking call {'.'.join(d)}() inside async "
+                            f"def {self.async_fn} — {hint}"))
+                self.generic_visit(node)
+
+        Visitor().visit(ctx.tree)
+        return iter(findings)

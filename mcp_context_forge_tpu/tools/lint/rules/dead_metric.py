@@ -1,0 +1,74 @@
+"""Rule: registered Prometheus metrics nothing outside observability/ feeds.
+
+A metric registered on ``PrometheusRegistry`` that no product code ever
+touches silently reads as 0 forever — dashboard noise that looks like
+health (this is exactly how ``llm_queue_depth`` and ``sessions_active``
+drifted dead before the telemetry PR). Promoted from
+``tests/unit/test_metrics_lint.py`` into the framework; that test is now
+a thin wrapper over this rule, so the check has one implementation.
+
+Purely static: the registry file is parsed for ``self.NAME = Counter/
+Gauge/Histogram(...)`` assignments, and every other linted file is
+searched for ``.NAME`` references. Metrics legitimately complete at
+registration time (``app_info``) carry ``# lint: allow[dead-metric]`` on
+their registration line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..astutil import dotted
+from ..core import FileContext, Finding, Rule, register
+
+METRIC_TYPES = {"Counter", "Gauge", "Histogram", "Summary"}
+REGISTRY_CLASS = "PrometheusRegistry"
+
+
+@register
+class DeadMetricRule(Rule):
+    rule_id = "dead-metric"
+    description = ("metric registered on PrometheusRegistry but never "
+                   "referenced outside observability/")
+
+    def check_project(self, contexts: list[FileContext]) -> Iterator[Finding]:
+        registry_ctx = None
+        registry_cls = None
+        for ctx in contexts:
+            for node in ctx.tree.body:
+                if isinstance(node, ast.ClassDef) and \
+                        node.name == REGISTRY_CLASS:
+                    registry_ctx, registry_cls = ctx, node
+                    break
+        if registry_cls is None:
+            return iter(())  # subset run without the registry: nothing to do
+
+        metrics: dict[str, int] = {}  # attr -> registration line
+        for node in ast.walk(registry_cls):
+            if not (isinstance(node, (ast.Assign, ast.AnnAssign))
+                    and isinstance(node.value, ast.Call)):
+                continue
+            d = dotted(node.value.func)
+            if not d or d[-1] not in METRIC_TYPES:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for target in targets:
+                if (isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"):
+                    metrics[target.attr] = node.lineno
+
+        blob = "\n".join(ctx.source for ctx in contexts
+                         if "observability" not in ctx.path.split("/"))
+        findings: list[Finding] = []
+        for name, lineno in sorted(metrics.items()):
+            if f".{name}" not in blob:
+                findings.append(Finding(
+                    self.rule_id, registry_ctx.path, lineno,
+                    f"metric {name} is registered but never referenced "
+                    f"outside observability/ — wire it up, remove it, or "
+                    f"allow[dead-metric] it if fully populated at "
+                    f"registration"))
+        return iter(findings)

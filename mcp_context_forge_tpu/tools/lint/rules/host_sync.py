@@ -1,0 +1,74 @@
+"""Rule: host-sync primitives reachable from a ``# lint: hot-path`` root.
+
+The overlapped decode pipeline (docs/perf_decode.md) earns its 16→61
+tok/s by keeping the device fed: one stray ``.item()`` / ``device_get`` /
+``block_until_ready`` / ``np.asarray``-on-a-device-value inside the
+dispatch loop reintroduces the host stall the pipeline exists to hide —
+silently, because nothing is *wrong*, just slow.
+
+Scope is call-graph driven, not directory driven: functions marked
+``# lint: hot-path`` (the engine's ``_device_loop``) root a reachability
+closure over same-module ``foo()`` / ``self.foo()`` calls; host-sync
+primitives anywhere in that closure are findings. Intentional sync points
+(the retire-side read-back, prefill's first-token fetch) carry per-line
+``# lint: allow[host-sync-in-hot-path]`` with the reason — the explicit
+allowlist the rule exists to force.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..astutil import dotted, reachable_functions
+from ..core import FileContext, Finding, Rule, register
+
+# dotted call paths that force a device->host synchronization
+HOST_SYNC_CALLS: set[tuple[str, ...]] = {
+    ("jax", "device_get"),
+    ("np", "asarray"), ("np", "array"),
+    ("numpy", "asarray"), ("numpy", "array"),
+}
+
+# zero-arg methods that force a device->host synchronization
+HOST_SYNC_METHODS: set[str] = {"item", "block_until_ready"}
+
+
+@register
+class HostSyncInHotPathRule(Rule):
+    rule_id = "host-sync-in-hot-path"
+    description = ("host-device synchronization reachable from a "
+                   "# lint: hot-path root (decode dispatch loop)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        roots = {fn.name for fn in ast.walk(ctx.tree)
+                 if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                 and ctx.def_marker(fn, "hot-path") is not None}
+        if not roots:
+            return iter(())
+        findings: list[Finding] = []
+        seen: set[tuple[int, int]] = set()
+        for name, fn in sorted(reachable_functions(ctx.tree, roots).items()):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                # a nested def is walked under its parent too: one finding
+                if (node.lineno, node.col_offset) in seen:
+                    continue
+                d = dotted(node.func)
+                sync: str | None = None
+                if d in HOST_SYNC_CALLS:
+                    sync = ".".join(d)
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in HOST_SYNC_METHODS
+                      and not node.args and not node.keywords):
+                    sync = f".{node.func.attr}"
+                if sync is not None:
+                    seen.add((node.lineno, node.col_offset))
+                    findings.append(Finding(
+                        self.rule_id, ctx.path, node.lineno,
+                        f"{sync}() in {name}, reachable from hot-path "
+                        f"root(s) {sorted(roots)} — stalls the device "
+                        f"pipeline; overlap the read-back or allow[] it "
+                        f"with the reason"))
+        return iter(findings)

@@ -1,0 +1,199 @@
+"""Rules: Python control flow on tracers, and jit-cache-busting literals.
+
+``tracer-python-branch`` — a Python ``if``/``while`` on a JAX tracer
+inside a jit-compiled function either raises ``TracerBoolConversionError``
+at trace time or, worse, silently bakes one branch into the compiled
+graph when the value happens to be concrete during tracing. The rule
+finds functions this module wraps in ``jax.jit`` (direct call, through
+``functools.partial``, or as a decorator), treats their non-static
+parameters as tracers, propagates taint through straight-line
+assignments, and flags ``if``/``while``/ternary tests that consume a
+tracer as a *value*. Static metadata uses — ``x.shape``/``x.ndim``/
+``x.dtype``/``x.size``, ``len(x)``, ``isinstance(x, ...)``, ``x is
+None`` — are concrete at trace time and never flagged.
+
+``jit-cache-buster`` — calling a jit-wrapped callable with a bare Python
+scalar (or a ``jnp.float32``-style dtype attribute) as a traced argument
+compiles a fresh executable per distinct weak-typed value; on the decode
+path that is a mid-traffic recompile. Pass device arrays
+(``jnp.asarray(...)``) or mark the argument static.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..astutil import (static_argnames_of, decorator_jitted, dotted,
+                       jitted_functions, param_names, walk_functions)
+from ..core import FileContext, Finding, Rule, register
+
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+STATIC_CALLS = {("len",), ("isinstance",), ("getattr",), ("hasattr",),
+                ("type",)}
+
+ARRAY_MODULES = {"np", "jnp", "numpy"}
+DTYPE_NAMES = {"float32", "float16", "bfloat16", "float64", "int8", "int16",
+               "int32", "int64", "uint8", "uint32", "bool_"}
+
+
+def _pruned_walk(node: ast.AST):
+    """Yield ``node`` and descendants WITHOUT descending into nested
+    function defs or lambdas (their scopes are handled separately); the
+    def nodes themselves are yielded so callers can recurse."""
+    stack: list[ast.AST] = [node]
+    while stack:
+        current = stack.pop()
+        yield current
+        if isinstance(current, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(current))
+
+
+def _tracer_uses(node: ast.AST, traced: set[str]) -> list[ast.Name]:
+    """Names in ``node`` that consume a traced value AS a value (not as
+    static metadata)."""
+    if isinstance(node, ast.Attribute) and node.attr in STATIC_ATTRS:
+        return []
+    if isinstance(node, ast.Call) and dotted(node.func) in STATIC_CALLS:
+        return []
+    if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+        return []  # `x is None`: tracers are never None; static dispatch
+    if isinstance(node, ast.Name):
+        return [node] if node.id in traced else []
+    uses: list[ast.Name] = []
+    for child in ast.iter_child_nodes(node):
+        uses.extend(_tracer_uses(child, traced))
+    return uses
+
+
+@register
+class TracerPythonBranchRule(Rule):
+    rule_id = "tracer-python-branch"
+    description = ("Python if/while on a JAX tracer inside a jit-compiled "
+                   "function (use lax.cond/select/while_loop)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        jitted = jitted_functions(ctx.tree)
+        if not jitted:
+            return iter(())
+        findings: list[Finding] = []
+        for fn in walk_functions(ctx.tree):
+            non_traced = jitted.get(fn.name)
+            if non_traced is None:
+                continue
+            traced = set(param_names(fn)) - non_traced - {"self"}
+            self._scan(fn.body, traced, fn.name, ctx, findings)
+        return iter(findings)
+
+    def _scan(self, body: list[ast.stmt], traced: set[str], fn_name: str,
+              ctx: FileContext, findings: list[Finding]) -> None:
+        """Per-scope pass: propagate taint through assignments to a
+        fixpoint (order-insensitive), flag branch tests, then recurse into
+        nested defs (their bodies trace too — a scan/cond callee branching
+        on its carry is the same bug)."""
+        traced = set(traced)
+        nodes = [node for stmt in body for node in _pruned_walk(stmt)]
+        nested = [n for n in nodes
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        changed = True
+        while changed:
+            changed = False
+            for node in nodes:
+                targets: list[ast.expr] = []
+                if isinstance(node, ast.Assign) and \
+                        _tracer_uses(node.value, traced):
+                    targets = list(node.targets)
+                elif isinstance(node, ast.AugAssign) and \
+                        _tracer_uses(node.value, traced):
+                    targets = [node.target]
+                for target in targets:
+                    for name in ast.walk(target):
+                        if isinstance(name, ast.Name) and \
+                                name.id not in traced:
+                            traced.add(name.id)
+                            changed = True
+        for node in nodes:
+            if isinstance(node, (ast.If, ast.While)):
+                self._flag(node.test, traced, fn_name, ctx, findings,
+                           kind=type(node).__name__.lower())
+            elif isinstance(node, ast.IfExp):
+                self._flag(node.test, traced, fn_name, ctx, findings,
+                           kind="ternary")
+        for fn in nested:
+            self._scan(fn.body, traced | set(param_names(fn)),
+                       f"{fn_name}.{fn.name}", ctx, findings)
+
+    def _flag(self, test: ast.expr, traced: set[str], fn_name: str,
+              ctx: FileContext, findings: list[Finding], kind: str) -> None:
+        uses = _tracer_uses(test, traced)
+        if uses:
+            names = sorted({u.id for u in uses})
+            findings.append(Finding(
+                self.rule_id, ctx.path, test.lineno,
+                f"Python {kind} on traced value(s) {names} in jitted "
+                f"{fn_name} — use jax.lax.cond/select/while_loop or hoist "
+                f"the decision out of the jit"))
+
+
+@register
+class JitCacheBusterRule(Rule):
+    rule_id = "jit-cache-buster"
+    description = ("Python scalar/dtype literal passed as a traced argument "
+                   "to a jit-wrapped callable (per-value recompiles)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # names/attributes assigned a jax.jit(...) value in this module,
+        # with the static parameter names each jit call declares — a
+        # literal bound to a static_argnames keyword is CORRECT (it is
+        # exactly the fix this rule recommends) and never flagged
+        jit_named: dict[str, set[str]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                d = dotted(node.value.func)
+                if d == ("jit",) or (len(d) == 2 and d[1] == "jit"):
+                    static = static_argnames_of(node.value)
+                    for target in node.targets:
+                        td = dotted(target)
+                        if td:
+                            jit_named.setdefault(td[-1],
+                                                 set()).update(static)
+        # plus functions jitted via decorator, callable by their own name
+        # (NOT names merely wrapped elsewhere: calling those directly runs
+        # plain Python and busts nothing)
+        jitted = jitted_functions(ctx.tree)
+        for name in decorator_jitted(ctx.tree):
+            jit_named.setdefault(name, set()).update(jitted.get(name, set()))
+        if not jit_named:
+            return iter(())
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if not d or d[-1] not in jit_named:
+                continue
+            static = jit_named[d[-1]]
+            candidates = [*node.args,
+                          *[kw.value for kw in node.keywords
+                            if kw.arg not in static]]
+            for arg in candidates:
+                bad: str | None = None
+                if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, (bool, int, float)):
+                    bad = repr(arg.value)
+                else:
+                    ad = dotted(arg)
+                    if (len(ad) == 2 and ad[0] in ARRAY_MODULES
+                            and ad[1] in DTYPE_NAMES):
+                        bad = ".".join(ad)
+                if bad is not None:
+                    findings.append(Finding(
+                        self.rule_id, ctx.path, arg.lineno,
+                        f"literal {bad} passed to jitted {d[-1]}() — wrap "
+                        f"in jnp.asarray(...) or mark the parameter "
+                        f"static_argnames"))
+        return iter(findings)

@@ -1,0 +1,170 @@
+"""Rule: cross-thread mutation of annotated engine state.
+
+The TPU engine splits work between the asyncio loop and the dispatch
+thread; its shared-state contract is documented in comments ("owned by
+dispatch thread") that nothing enforces. This rule makes the contract
+machine-checked:
+
+- ``self.attr = ...  # lint: thread[dispatch]`` declares the attribute
+  owned by thread ``dispatch``;
+- ``def _device_loop(self):  # lint: runs-on[dispatch]`` declares the
+  thread a method runs on; ``__init__`` is implicitly ``init``
+  (pre-thread: nothing else exists yet, so it may touch anything);
+- ownership contexts propagate through same-class ``self.m()`` calls, so
+  only the entry points need marking;
+- ``self.lock_attr = ...  # lint: lock[dispatch]`` declares a lock whose
+  ``with self.lock_attr:`` blocks legalize mutation of dispatch-owned
+  state from any thread.
+
+A mutation (assignment, augmented assignment, ``del``, or a mutating
+method call — append/pop/clear/...) of an owned attribute from a method
+whose propagated contexts include neither the owning thread nor ``init``
+is a finding: route it through ``call_soon_threadsafe``, a lock-guarded
+setter, or mark the method's real thread.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..astutil import called_names
+from ..core import FileContext, Finding, Rule, register
+
+MUTATOR_METHODS = {"append", "appendleft", "extend", "extendleft", "insert",
+                   "clear", "pop", "popleft", "popitem", "remove", "discard",
+                   "add", "update", "setdefault", "sort", "reverse"}
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _mutated_attr(target: ast.AST) -> str | None:
+    """``self.x`` or ``self.x[...]`` as a mutation target -> ``x``."""
+    attr = _self_attr(target)
+    if attr is None and isinstance(target, ast.Subscript):
+        attr = _self_attr(target.value)
+    return attr
+
+
+@register
+class CrossThreadMutationRule(Rule):
+    rule_id = "cross-thread-mutation"
+    description = ("mutation of a # lint: thread[...]-owned attribute from "
+                   "a method not proven to run on the owning thread")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        findings: list[Finding] = []
+        for node in ctx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._check_class(node, ctx, findings)
+        return iter(findings)
+
+    def _check_class(self, cls: ast.ClassDef, ctx: FileContext,
+                     findings: list[Finding]) -> None:
+        thread_lines = ctx.markers_of("thread")
+        lock_lines = ctx.markers_of("lock")
+        owned: dict[str, str] = {}     # attr -> owning thread
+        locks: dict[str, str] = {}     # lock attr -> thread it guards
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for method in methods:
+            for node in ast.walk(method):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for target in targets:
+                        attr = _self_attr(target)
+                        if attr is None:
+                            continue
+                        if node.lineno in thread_lines:
+                            owned[attr] = thread_lines[node.lineno]
+                        if node.lineno in lock_lines:
+                            locks[attr] = lock_lines[node.lineno]
+        if not owned:
+            return
+
+        # thread contexts: marked roots + __init__, propagated through the
+        # same-class call graph (self.m() edges)
+        contexts: dict[str, set[str]] = {m.name: set() for m in methods}
+        edges = {m.name: {callee for callee in called_names(m)
+                          if callee in contexts} for m in methods}
+        for method in methods:
+            marker = ctx.def_marker(method, "runs-on")
+            if marker:
+                contexts[method.name].add(marker)
+            if method.name == "__init__":
+                contexts[method.name].add("init")
+        changed = True
+        while changed:
+            changed = False
+            for name, callees in edges.items():
+                for callee in callees:
+                    before = len(contexts[callee])
+                    contexts[callee] |= contexts[name]
+                    changed = changed or len(contexts[callee]) != before
+
+        for method in methods:
+            self._scan_method(method, owned, locks, contexts[method.name],
+                              ctx, findings)
+
+    def _scan_method(self, method, owned: dict[str, str],
+                     locks: dict[str, str], allowed: set[str],
+                     ctx: FileContext, findings: list[Finding]) -> None:
+        rule_id = self.rule_id
+
+        def flag(node: ast.AST, attr: str, how: str) -> None:
+            owner = owned[attr]
+            findings.append(Finding(
+                rule_id, ctx.path, node.lineno,
+                f"{how} of self.{attr} (owned by thread "
+                f"'{owner}') in {method.name}(), which is not marked or "
+                f"reachable as runs-on[{owner}] — hop via "
+                f"call_soon_threadsafe, guard with a lint: lock[{owner}] "
+                f"lock, or mark the method's thread"))
+
+        def illegal(attr: str | None, guarded: set[str]) -> bool:
+            if attr not in owned:
+                return False
+            if allowed == {"init"}:
+                # PURE pre-thread closure: nothing else runs yet. A method
+                # also reachable from a marked runtime thread does not get
+                # the init pass — its runtime callers must own the state.
+                return False
+            return owned[attr] not in allowed | guarded
+
+        def visit(node: ast.AST, guarded: set[str]) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                extra = {locks[attr] for item in node.items
+                         for attr in [_self_attr(item.context_expr)]
+                         if attr is not None and attr in locks}
+                for child in ast.iter_child_nodes(node):
+                    visit(child, guarded | extra)
+                return
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for target in targets:
+                    attr = _mutated_attr(target)
+                    if illegal(attr, guarded):
+                        flag(node, attr, "assignment")
+            elif isinstance(node, ast.Delete):
+                for target in node.targets:
+                    attr = _mutated_attr(target)
+                    if illegal(attr, guarded):
+                        flag(node, attr, "del")
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in MUTATOR_METHODS:
+                attr = _self_attr(node.func.value)
+                if illegal(attr, guarded):
+                    flag(node, attr, f".{node.func.attr}()")
+            for child in ast.iter_child_nodes(node):
+                visit(child, guarded)
+
+        for stmt in method.body:
+            visit(stmt, set())

@@ -383,26 +383,26 @@ class TPUEngine:
                                         vocab_size=self.model_config.vocab_size)
         self.stats = EngineStats()
         self._work: queue.Queue[GenRequest] = queue.Queue(maxsize=config.max_queue)
-        self._pending: deque[GenRequest] = deque()   # owned by dispatch thread
-        self._running: dict[int, GenRequest] = {}    # slot -> request (thread)
-        self._chunking: dict[int, GenRequest] = {}   # slot -> mid-chunk-prefill
+        self._pending: deque[GenRequest] = deque()   # lint: thread[dispatch]
+        self._running: dict[int, GenRequest] = {}    # slot -> request  # lint: thread[dispatch]
+        self._chunking: dict[int, GenRequest] = {}   # mid-chunk-prefill  # lint: thread[dispatch]
         self._thread: threading.Thread | None = None
         self._stop_event = threading.Event()
         self._loop: asyncio.AbstractEventLoop | None = None
         self._started = False
         # overlapped decode pipeline state (dispatch thread only): the
         # dispatched-but-not-yet-emitted decode step, if any
-        self._inflight: dict[str, Any] | None = None
+        self._inflight: dict[str, Any] | None = None  # lint: thread[dispatch]
         # submit-side wakeup: the dispatch thread blocks here when idle
         # instead of polling with time.sleep (satellite: idle wakeup
         # latency and idle CPU both drop)
         self._wake = threading.Event()
         # step emission buffer: tokens accumulate here during a step and
         # flush to the asyncio loop in ONE call_soon_threadsafe per step
-        self._emit_buf: list[list[Any]] = []
+        self._emit_buf: list[list[Any]] = []  # lint: thread[dispatch]
         # dispatch-gap telemetry: (gap_s, step_wall_s) per decode step
-        self._gap_window: deque[tuple[float, float]] = deque(maxlen=256)
-        self._last_step_done_ts: float | None = None
+        self._gap_window: deque[tuple[float, float]] = deque(maxlen=256)  # lint: thread[dispatch]
+        self._last_step_done_ts: float | None = None  # lint: thread[dispatch]
         # decode batch-width hysteresis state (see _decode_step_all).
         # UNWARMED engines start small (light load is free immediately; a
         # burst pays ONE grow re-home) and may shrink back to any width
@@ -411,19 +411,19 @@ class TPUEngine:
         # width — the round-5 config-4 A/B) and shrink targets are the
         # whole warmed grid. (_batch_width itself is set to the smallest
         # bucket just below, once _warmed_widths exists.)
-        self._shrink_streak = 0
-        self._shrink_peak = 0
+        self._shrink_streak = 0  # lint: thread[dispatch]
+        self._shrink_peak = 0  # lint: thread[dispatch]
         # widths whose full ctx-bucket decode grid warmup precompiled:
         # shrinking is an OPTIMIZATION, so the engine never eats a
         # mid-traffic compile (+ donated-pool re-home) to get smaller —
         # only warmed widths are shrink targets. Growth is correctness
         # (arrays must cover the ceiling) and may compile.
-        self._warmed_widths: set[int] = set()
-        self._batch_width = self._batch_buckets()[0]  # smallest bucket
+        self._warmed_widths: set[int] = set()  # lint: thread[dispatch]
+        self._batch_width = self._batch_buckets()[0]  # smallest  # lint: thread[dispatch]
         # when the engine last had active work (idle-boundary reset guard);
         # starts "now" so the warmed start-at-max posture survives a
         # burst arriving right after startup
-        self._last_active_ts = time.monotonic()
+        self._last_active_ts = time.monotonic()  # lint: thread[dispatch]
 
         dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
         devices = probe_devices(config.init_timeout_s)
@@ -982,7 +982,7 @@ class TPUEngine:
 
     # --------------------------------------------------------- dispatch thread
 
-    def _device_loop(self) -> None:
+    def _device_loop(self) -> None:  # lint: runs-on[dispatch]  # lint: hot-path
         """Owns every jax call + device sync. Never touched by the asyncio
         loop; results hop back via loop.call_soon_threadsafe (one flush
         per step, not one wakeup per token).
@@ -1404,7 +1404,7 @@ class TPUEngine:
             for request in admitted:
                 self.allocator.register_prefix(request.slot,
                                                request.prompt_ids)
-        first_host = jax.device_get(first)  # dispatch thread: sync is fine here
+        first_host = jax.device_get(first)  # lint: allow[host-sync-in-hot-path] first-token fetch: prefill result feeds host-side admission
         self._last_step_done_ts = time.monotonic()
         elapsed_ms = (time.monotonic() - started) * 1000
         self.stats.prefill_ms_total += elapsed_ms
@@ -1485,7 +1485,7 @@ class TPUEngine:
         first, self.kv = self._hist_fn(self._hist_ctx_for(max_end))(
             self.params, self.kv, tokens, positions,
             slot_ids, last_idx, sampling, key)
-        first_host = jax.device_get(first)
+        first_host = jax.device_get(first)  # lint: allow[host-sync-in-hot-path] chunk-round boundary: host decides next chunk from these tokens
         self._last_step_done_ts = time.monotonic()
         elapsed_ms = (time.monotonic() - started) * 1000
         self.stats.prefill_batches += 1
@@ -1599,7 +1599,7 @@ class TPUEngine:
             jnp.arange(B, dtype=jnp.int32), sampling, key)
         self.stats.decode_steps += 1
         self.stats.spec_steps += 1
-        block_host = jax.device_get(block)  # [B, K]
+        block_host = jax.device_get(block)  # [B, K]  # lint: allow[host-sync-in-hot-path] spec verify: host must compare drafts to accept
         self._last_step_done_ts = time.monotonic()
         spec_elapsed_ms = (time.monotonic() - started) * 1000
         spec_emitted = 0
@@ -1915,7 +1915,7 @@ class TPUEngine:
         """Fetch and emit one dispatched decode step. Under overlap this
         runs while the NEXT step executes on device, so every line here is
         off the device's critical path."""
-        block_host = np.asarray(inflight["block"])  # [k, B]; blocks if needed
+        block_host = np.asarray(inflight["block"])  # [k, B]  # lint: allow[host-sync-in-hot-path] retire-side read-back, overlapped by the in-flight dispatch
         done_ts = time.monotonic()
         self._last_step_done_ts = done_ts
         decode_elapsed_ms = (done_ts - inflight["dispatch_ts"]) * 1000

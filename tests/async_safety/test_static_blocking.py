@@ -1,0 +1,48 @@
+"""Static companion to the heartbeat test (test_event_loop_blocking.py):
+the async-blocking-call lint rule over the request-path packages must be
+EMPTY — no suppressions, no baseline. The runtime burst only catches a
+blocking call on the paths it happens to exercise; this catches every
+``async def`` in gateway/, services/, and db/ the moment the blocking
+call is written.
+
+(plugins/framework.py carries the single allowed startup-only config
+read; anything new must be fixed with asyncio.to_thread, not allowed.)
+"""
+
+from pathlib import Path
+
+import mcp_context_forge_tpu
+from mcp_context_forge_tpu.tools.lint import (Baseline, lint_paths,
+                                              load_default_baseline)
+from mcp_context_forge_tpu.tools.lint.rules.async_blocking import \
+    AsyncBlockingCallRule
+
+PACKAGE_ROOT = Path(mcp_context_forge_tpu.__file__).resolve().parent
+REQUEST_PATH_PACKAGES = ("gateway", "services", "db", "coordination")
+
+
+def test_request_path_packages_have_zero_blocking_calls():
+    """Stricter than the tier-1 gate: findings AND suppressions must be
+    empty on the request path — an allow[] comment in gateway/ would
+    pass the package gate but is still a loop stall waiting to happen."""
+    roots = [PACKAGE_ROOT / pkg for pkg in REQUEST_PATH_PACKAGES]
+    result = lint_paths(roots, rules=[AsyncBlockingCallRule()],
+                        baseline=Baseline())
+    assert not result.errors
+    assert not result.findings, "\n".join(str(f) for f in result.findings)
+    assert not result.suppressed, (
+        "async-blocking-call suppressed on the request path — fix with "
+        "asyncio.to_thread instead:\n"
+        + "\n".join(str(f) for f in result.suppressed))
+
+
+def test_async_rule_baseline_for_request_path_is_empty():
+    """The shipped baseline must not quietly accumulate request-path
+    blocking calls either."""
+    baseline = load_default_baseline()
+    offenders = [
+        entry for entry in baseline.entries
+        if entry.get("rule") == "async-blocking-call"
+        and any(f"/{pkg}/" in str(entry.get("path", ""))
+                for pkg in REQUEST_PATH_PACKAGES)]
+    assert not offenders, offenders

@@ -151,3 +151,38 @@ async def test_support_bundle_disabled_404s():
         assert resp.status == 404
     finally:
         await client.close()
+
+
+async def test_support_bundle_zip_builds_off_the_event_loop():
+    """The DEFLATE pass + log redaction must run in a worker thread: a
+    bundle download on a loaded gateway must not stall every in-flight
+    request (static twin: the async-blocking-call lint rule; runtime
+    twin: tests/async_safety/test_event_loop_blocking.py)."""
+    import threading
+
+    from mcp_context_forge_tpu.services.diagnostics_service import \
+        SupportBundleService
+
+    client = await make_client()
+    try:
+        loop_thread = threading.get_ident()
+        seen: list[int] = []
+        original = SupportBundleService._build_zip
+
+        def spy(stamp, sections, records):
+            seen.append(threading.get_ident())
+            return original(stamp, sections, records)
+
+        SupportBundleService._build_zip = staticmethod(spy)
+        try:
+            resp = await client.get("/admin/support-bundle",
+                                    auth=aiohttp.BasicAuth(*BASIC))
+            assert resp.status == 200
+            # the archive is still complete when assembled off-loop
+            zf = zipfile.ZipFile(io.BytesIO(await resp.read()))
+            assert "manifest.json" in zf.namelist()
+        finally:
+            SupportBundleService._build_zip = staticmethod(original)
+        assert seen and loop_thread not in seen
+    finally:
+        await client.close()

@@ -106,3 +106,29 @@ async def test_file_kv_reads_legacy_sanitized_filenames(tmp_path):
     await kv.delete("chat_legacy")
     assert await kv.get("chat_legacy") is None
     assert not legacy.exists()
+
+
+async def test_file_kv_runs_file_io_off_the_event_loop(tmp_path):
+    """FileKVStore sits on the gateway request path (chat session state):
+    its disk I/O must execute on a worker thread, never the loop thread
+    (static twin: the async-blocking-call lint rule)."""
+    import threading
+
+    kv = FileKVStore(str(tmp_path))
+    loop_thread = threading.get_ident()
+    seen: set[int] = set()
+
+    for name in ("_set_sync", "_read_sync", "_delete_sync", "_purge_sync"):
+        original = getattr(kv, name)
+
+        def spy(*args, _original=original, **kwargs):
+            seen.add(threading.get_ident())
+            return _original(*args, **kwargs)
+
+        setattr(kv, name, spy)
+
+    await kv.set("k", {"a": 1}, ttl=60)
+    assert await kv.get("k") == {"a": 1}
+    await kv.delete("k")
+    assert await kv.purge_expired() == 0
+    assert seen and loop_thread not in seen

@@ -1,0 +1,59 @@
+"""Tier-1 gate: the whole package must lint clean.
+
+`python -m mcp_context_forge_tpu.tools.lint mcp_context_forge_tpu` and
+this test run the same code path; a new blocking call on the event loop,
+a host sync on the decode dispatch path, a cross-thread mutation of
+annotated engine state, or a dead metric fails the suite here — without
+needing the runtime burst tests to happen to hit the new path.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import mcp_context_forge_tpu
+from mcp_context_forge_tpu.tools.lint import (active_rules,
+                                              load_default_baseline,
+                                              lint_paths)
+
+PACKAGE_ROOT = Path(mcp_context_forge_tpu.__file__).resolve().parent
+
+
+def test_package_lints_clean_with_at_least_six_rules():
+    rules = active_rules()
+    assert len(rules) >= 6, [r.rule_id for r in rules]
+    result = lint_paths([PACKAGE_ROOT], rules=rules,
+                        baseline=load_default_baseline())
+    assert not result.errors, "\n".join(str(f) for f in result.errors)
+    assert not result.findings, (
+        "unsuppressed lint findings (fix, # lint: allow[...] with a "
+        "reason, or baseline with a written justification):\n"
+        + "\n".join(str(f) for f in result.findings))
+    assert not result.stale_baseline, (
+        "baseline entries whose finding no longer exists — delete them:\n"
+        + "\n".join(str(e) for e in result.stale_baseline))
+
+
+def test_rules_are_exercised_not_vacuous():
+    """The clean run must come from rules that actually inspected code:
+    the engine's annotated hot path exists and the known intentional
+    sync points surface as SUPPRESSED findings (if the annotations or
+    the reachability analysis silently broke, these would vanish and
+    the gate would be green for the wrong reason)."""
+    result = lint_paths([PACKAGE_ROOT], baseline=load_default_baseline())
+    by_rule: dict[str, int] = {}
+    for finding in result.suppressed:
+        by_rule[finding.rule] = by_rule.get(finding.rule, 0) + 1
+    # the four intentional read-backs on the decode dispatch path
+    assert by_rule.get("host-sync-in-hot-path", 0) >= 4, by_rule
+    # plugin-config startup read + app_info registration-time metric
+    assert by_rule.get("async-blocking-call", 0) >= 1, by_rule
+    assert by_rule.get("dead-metric", 0) >= 1, by_rule
+
+
+def test_cli_entrypoint_matches_the_gate():
+    from mcp_context_forge_tpu.tools.lint.__main__ import main
+
+    assert main([str(PACKAGE_ROOT)]) == 0
+    assert main(["--list-rules"]) == 0
+    assert main([str(PACKAGE_ROOT), "--rules", "no-such-rule"]) == 2

@@ -1,0 +1,626 @@
+"""Per-rule fixture tests for mcpforge-lint: every rule must fire on its
+violation fixture AND stay silent on the compliant twin, and the engine's
+suppression/baseline plumbing must triage findings exactly.
+
+(The whole-tree gate lives in test_lint_clean.py; the engine internals
+are additionally mutation-gated via testing/oracles.py.)
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+from mcp_context_forge_tpu.tools.lint import (Baseline, active_rules,
+                                              lint_sources)
+from mcp_context_forge_tpu.tools.lint.rules.async_blocking import \
+    AsyncBlockingCallRule
+from mcp_context_forge_tpu.tools.lint.rules.dead_metric import DeadMetricRule
+from mcp_context_forge_tpu.tools.lint.rules.host_sync import \
+    HostSyncInHotPathRule
+from mcp_context_forge_tpu.tools.lint.rules.jit_discipline import (
+    JitCacheBusterRule, TracerPythonBranchRule)
+from mcp_context_forge_tpu.tools.lint.rules.thread_boundary import \
+    CrossThreadMutationRule
+
+
+def run(rule, source: str, path: str = "pkg/mod.py"):
+    result = lint_sources({path: textwrap.dedent(source)}, [rule])
+    assert not result.errors, result.errors
+    return result.findings
+
+
+# ------------------------------------------------------ async-blocking-call
+
+def test_async_blocking_fires_on_sleep_open_subprocess_requests():
+    findings = run(AsyncBlockingCallRule(), """
+        import time, subprocess, requests
+
+        async def handler(path):
+            time.sleep(1)
+            with open(path) as fh:
+                data = fh.read()
+            subprocess.run(["ls"])
+            requests.get("http://x")
+            return data
+        """)
+    assert [f.lineno for f in findings] == [5, 6, 8, 9]
+    assert all(f.rule == "async-blocking-call" for f in findings)
+    assert "time.sleep" in findings[0].message
+    assert "handler" in findings[0].message
+
+
+def test_async_blocking_fires_on_pathlib_and_zipfile():
+    findings = run(AsyncBlockingCallRule(), """
+        import zipfile
+
+        async def bundle(p):
+            text = p.read_text()
+            with zipfile.ZipFile("x.zip", "w") as zf:
+                zf.writestr("a", text)
+        """)
+    assert len(findings) == 2
+    assert "read_text" in findings[0].message
+    assert "zipfile.ZipFile" in findings[1].message
+
+
+def test_async_blocking_silent_on_compliant_twin():
+    findings = run(AsyncBlockingCallRule(), """
+        import asyncio, time
+
+        def sync_helper(path):
+            with open(path) as fh:     # sync def: off the loop
+                return fh.read()
+
+        async def handler(path):
+            await asyncio.sleep(1)
+            data = await asyncio.to_thread(sync_helper, path)
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, lambda: open(path).close())
+            return data
+
+        def main():
+            time.sleep(1)              # sync context: fine
+        """)
+    assert findings == []
+
+
+def test_async_blocking_nested_sync_def_inside_async_is_exempt():
+    findings = run(AsyncBlockingCallRule(), """
+        import asyncio
+
+        async def handler(path):
+            def work():
+                with open(path) as fh:
+                    return fh.read()
+            return await asyncio.to_thread(work)
+        """)
+    assert findings == []
+
+
+# ---------------------------------------------------- host-sync-in-hot-path
+
+HOT_LOOP_VIOLATION = """
+    import jax
+    import numpy as np
+
+    class Engine:
+        def _loop(self):  # lint: hot-path
+            while True:
+                self._step()
+
+        def _step(self):
+            block = self._dispatch()
+            host = np.asarray(block)
+            first = jax.device_get(block)
+            block.block_until_ready()
+            count = block.item()
+            return host, first, count
+
+        def _dispatch(self):
+            return object()
+"""
+
+
+def test_host_sync_fires_in_reachable_functions():
+    findings = run(HostSyncInHotPathRule(), HOT_LOOP_VIOLATION)
+    assert len(findings) == 4
+    assert {f.lineno for f in findings} == {12, 13, 14, 15}
+    assert "np.asarray" in findings[0].message
+    assert "_loop" in findings[0].message  # names the root
+
+
+def test_host_sync_silent_without_hot_path_root():
+    source = HOT_LOOP_VIOLATION.replace("  # lint: hot-path", "")
+    findings = run(HostSyncInHotPathRule(), source)
+    assert findings == []
+
+
+def test_host_sync_silent_outside_the_reachable_closure():
+    findings = run(HostSyncInHotPathRule(), """
+        import jax
+
+        class Engine:
+            def _loop(self):  # lint: hot-path
+                self._step()
+
+            def _step(self):
+                return 1
+
+            def warmup(self):          # not reachable from the root
+                x = self._step()
+                jax.device_get(x)
+                x.block_until_ready()
+        """)
+    assert findings == []
+
+
+def test_host_sync_allow_comment_suppresses_with_reason():
+    source = HOT_LOOP_VIOLATION.replace(
+        "host = np.asarray(block)",
+        "host = np.asarray(block)  "
+        "# lint: allow[host-sync-in-hot-path] retire read-back")
+    result = lint_sources({"pkg/mod.py": textwrap.dedent(source)},
+                          [HostSyncInHotPathRule()])
+    assert len(result.findings) == 3          # the other three still fire
+    assert len(result.suppressed) == 1
+    assert result.suppressed[0].lineno == 12
+
+
+def test_host_sync_block_until_ready_in_root_itself_fires():
+    findings = run(HostSyncInHotPathRule(), """
+        def loop(x):  # lint: hot-path
+            x.block_until_ready()
+        """)
+    assert len(findings) == 1
+
+
+def test_host_sync_one_line_def_marker_counts():
+    """A marker on a one-line def must arm the rule (the scan window
+    covers the def's only line)."""
+    findings = run(HostSyncInHotPathRule(), """
+        def loop(x): x.block_until_ready()  # lint: hot-path
+        """)
+    assert len(findings) == 1
+
+
+# ---------------------------------------------------- tracer-python-branch
+
+def test_tracer_branch_fires_on_if_while_ternary():
+    findings = run(TracerPythonBranchRule(), """
+        import jax
+
+        def step(x, y):
+            if x > 0:
+                y = y + 1
+            while y:
+                y = y - 1
+            z = 1 if x else 2
+            return z
+
+        step_c = jax.jit(step)
+        """)
+    assert [f.lineno for f in findings] == [5, 7, 9]
+    assert all(f.rule == "tracer-python-branch" for f in findings)
+    assert "['x']" in findings[0].message
+
+
+def test_tracer_branch_taint_propagates_through_assignment():
+    findings = run(TracerPythonBranchRule(), """
+        import jax
+
+        @jax.jit
+        def step(x):
+            flag = x > 0
+            if flag:
+                return 1
+            return 0
+        """)
+    assert len(findings) == 1
+    assert findings[0].lineno == 7
+    assert "['flag']" in findings[0].message
+
+
+def test_tracer_branch_silent_on_static_metadata_and_static_args():
+    findings = run(TracerPythonBranchRule(), """
+        import jax
+        from functools import partial
+
+        def step(x, mode, k=None):
+            if x.shape[0] > 4:          # shape: static under trace
+                pass
+            if len(x) > 2:              # len: static
+                pass
+            if k is None:               # identity vs None: static
+                pass
+            if mode:                    # partial-bound python value
+                pass
+            return x
+
+        step_c = jax.jit(partial(step, mode=True),
+                         static_argnames=("k",))
+        """)
+    assert findings == []
+
+
+def test_tracer_branch_flags_nested_scan_body():
+    findings = run(TracerPythonBranchRule(), """
+        import jax
+
+        @jax.jit
+        def outer(x):
+            def body(carry, t):
+                if carry > 0:
+                    return carry, t
+                return carry + 1, t
+            return jax.lax.scan(body, x, None)
+        """)
+    assert len(findings) == 1
+    assert "outer.body" in findings[0].message
+
+
+def test_tracer_branch_silent_in_unjitted_function():
+    findings = run(TracerPythonBranchRule(), """
+        import jax
+
+        def plain(x):
+            if x > 0:
+                return 1
+            return 0
+
+        other = jax.jit(lambda y: y)
+        """)
+    assert findings == []
+
+
+# ------------------------------------------------------- jit-cache-buster
+
+def test_cache_buster_fires_on_scalar_and_dtype_literal():
+    findings = run(JitCacheBusterRule(), """
+        import jax
+        import jax.numpy as jnp
+
+        def f(a, b, c):
+            return a
+
+        f_c = jax.jit(f)
+
+        def caller(arr):
+            return f_c(arr, 0.5, jnp.float32)
+        """)
+    assert len(findings) == 2
+    assert "0.5" in findings[0].message
+    assert "jnp.float32" in findings[1].message
+
+
+def test_cache_buster_silent_on_arrays_and_unjitted_calls():
+    findings = run(JitCacheBusterRule(), """
+        import jax
+        import jax.numpy as jnp
+
+        def f(a, b):
+            return a
+
+        f_c = jax.jit(f)
+
+        def caller(arr):
+            f(arr, 0.5)                      # plain python call: fine
+            return f_c(arr, jnp.asarray(0.5))
+        """)
+    assert findings == []
+
+
+def test_cache_buster_silent_on_static_argnames_literal():
+    """A literal bound to a static_argnames parameter is exactly the fix
+    the rule recommends — it must not flag it."""
+    findings = run(JitCacheBusterRule(), """
+        import jax
+
+        def f(a, k=None):
+            return a
+
+        f_c = jax.jit(f, static_argnames=("k",))
+
+        def caller(arr):
+            f_c(arr, k=4)          # static kwarg literal: correct
+            return f_c(arr, 4)     # positional literal: still flagged
+        """)
+    assert len(findings) == 1
+    assert findings[0].lineno == 11
+    assert "still flagged" in findings[0].code
+
+
+def test_cache_buster_fires_via_decorated_function_name():
+    findings = run(JitCacheBusterRule(), """
+        import jax
+
+        @jax.jit
+        def g(a):
+            return a
+
+        def caller():
+            return g(3)
+        """)
+    assert len(findings) == 1
+    assert "3" in findings[0].message
+
+
+# -------------------------------------------------- cross-thread-mutation
+
+ENGINE_FIXTURE = """
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._pending = []          # lint: thread[dispatch]
+            self._running = {}          # lint: thread[dispatch]
+            self._mutex = threading.Lock()   # lint: lock[dispatch]
+            self._stats = 0
+
+        def _loop(self):  # lint: runs-on[dispatch]
+            self._step()
+
+        def _step(self):
+            self._pending.append(1)     # reachable from the dispatch root
+            self._running[0] = 1
+
+        def submit(self, item):
+            self._pending.append(item)
+            self._running[0] = item
+            self._stats += 1
+"""
+
+
+def test_cross_thread_mutation_fires_from_unmarked_method():
+    findings = run(CrossThreadMutationRule(), ENGINE_FIXTURE)
+    assert len(findings) == 2
+    assert all(f.lineno in (19, 20) for f in findings)
+    assert "submit" in findings[0].message
+    assert "'dispatch'" in findings[0].message
+    # un-annotated state (self._stats) is never policed
+    assert not any("_stats" in f.message for f in findings)
+
+
+def test_cross_thread_mutation_silent_for_reachable_and_init():
+    source = ENGINE_FIXTURE.replace(
+        "        def submit(self, item):",
+        "        def submit(self, item):  # lint: runs-on[dispatch]")
+    assert run(CrossThreadMutationRule(), source) == []
+
+
+def test_cross_thread_mutation_lock_guard_legalizes():
+    source = ENGINE_FIXTURE.replace(
+        """        def submit(self, item):
+            self._pending.append(item)
+            self._running[0] = item""",
+        """        def submit(self, item):
+            with self._mutex:
+                self._pending.append(item)
+                self._running[0] = item""")
+    assert run(CrossThreadMutationRule(), source) == []
+
+
+def test_cross_thread_mutation_init_may_touch_everything():
+    findings = run(CrossThreadMutationRule(), """
+        class Engine:
+            def __init__(self):
+                self._pending = []      # lint: thread[dispatch]
+                self._pending.append(0)
+                self._setup()
+
+            def _setup(self):           # reachable from __init__ only
+                self._pending = []
+        """)
+    assert findings == []
+
+
+def test_cross_thread_mutation_init_pass_not_blanket():
+    """The init exemption covers only PURE pre-thread closures: a helper
+    also reachable from a marked runtime thread must justify the
+    mutation through its runtime owner, not ride the init pass."""
+    findings = run(CrossThreadMutationRule(), """
+        class Engine:
+            def __init__(self):
+                self._pending = []      # lint: thread[dispatch]
+                self._reset()
+
+            def handler(self):  # lint: runs-on[loop]
+                self._reset()
+
+            def _reset(self):           # init + loop contexts
+                self._pending = []
+        """)
+    assert len(findings) == 1
+    assert "_reset" in findings[0].message
+
+
+def test_cross_thread_mutation_del_and_augassign_fire():
+    findings = run(CrossThreadMutationRule(), """
+        class Engine:
+            def __init__(self):
+                self._depth = 0         # lint: thread[dispatch]
+                self._slots = {}        # lint: thread[dispatch]
+
+            def poke(self):
+                self._depth += 1
+                del self._slots[0]
+        """)
+    assert len(findings) == 2
+    assert "assignment" in findings[0].message
+    assert "del" in findings[1].message
+
+
+# ------------------------------------------------------------ dead-metric
+
+METRICS_FIXTURE = """
+    from prometheus_client import Counter, Gauge
+
+    class PrometheusRegistry:
+        def __init__(self):
+            self.http_requests = Counter("r", "d")
+            self.queue_depth = Gauge("q", "d")
+"""
+
+
+def test_dead_metric_fires_for_unfed_metric():
+    result = lint_sources({
+        "pkg/observability/metrics.py": textwrap.dedent(METRICS_FIXTURE),
+        "pkg/gateway/app.py": "def handle(m):\n    m.http_requests.inc()\n",
+    }, [DeadMetricRule()])
+    assert len(result.findings) == 1
+    assert result.findings[0].rule == "dead-metric"
+    assert "queue_depth" in result.findings[0].message
+    assert result.findings[0].path == "pkg/observability/metrics.py"
+
+
+def test_dead_metric_detects_annotated_registration():
+    """`self.x: Gauge = Gauge(...)` (AnnAssign) registers a metric just
+    as much as a plain assignment — the old live-introspection test saw
+    it, so the static rule must too."""
+    result = lint_sources({
+        "pkg/observability/metrics.py": (
+            "from prometheus_client import Gauge\n\n"
+            "class PrometheusRegistry:\n"
+            "    def __init__(self):\n"
+            "        self.depth: Gauge = Gauge('d', 'd')\n"),
+        "pkg/gateway/app.py": "x = 1\n",
+    }, [DeadMetricRule()])
+    assert len(result.findings) == 1
+    assert "depth" in result.findings[0].message
+
+
+def test_dead_metric_silent_when_all_metrics_fed():
+    result = lint_sources({
+        "pkg/observability/metrics.py": textwrap.dedent(METRICS_FIXTURE),
+        "pkg/gateway/app.py": ("def handle(m):\n    m.http_requests.inc()\n"
+                               "    m.queue_depth.set(1)\n"),
+    }, [DeadMetricRule()])
+    assert result.findings == []
+
+
+def test_dead_metric_reference_inside_observability_does_not_count():
+    result = lint_sources({
+        "pkg/observability/metrics.py": textwrap.dedent(METRICS_FIXTURE),
+        "pkg/observability/export.py":
+            "def f(m):\n    m.queue_depth.set(1)\n    m.http_requests.inc()\n",
+    }, [DeadMetricRule()])
+    assert {"queue_depth", "http_requests"} == {
+        f.message.split()[1] for f in result.findings}
+
+
+def test_dead_metric_silent_without_registry_in_file_set():
+    result = lint_sources({
+        "pkg/gateway/app.py": "x = 1\n",
+    }, [DeadMetricRule()])
+    assert result.findings == []
+
+
+# --------------------------------------------------- engine-level plumbing
+
+def test_baseline_matches_on_content_not_line_number():
+    source = """
+        import time
+
+        async def handler():
+            time.sleep(1)
+        """
+    baseline = Baseline(entries=[{
+        "rule": "async-blocking-call", "path": "pkg/mod.py",
+        "code": "time.sleep(1)", "reason": "known; migrating next PR"}])
+    result = lint_sources({"pkg/mod.py": textwrap.dedent(source)},
+                          [AsyncBlockingCallRule()], baseline)
+    assert result.findings == []
+    assert len(result.baselined) == 1
+    assert result.stale_baseline == []
+
+    # shifted lines still match (content anchor)...
+    shifted = "# header\n# more\n" + textwrap.dedent(source)
+    baseline2 = Baseline(entries=list(baseline.entries))
+    result = lint_sources({"pkg/mod.py": shifted},
+                          [AsyncBlockingCallRule()], baseline2)
+    assert result.findings == [] and len(result.baselined) == 1
+
+    # ...but a fixed violation leaves the entry stale
+    baseline3 = Baseline(entries=list(baseline.entries))
+    result = lint_sources(
+        {"pkg/mod.py": "import asyncio\n\nasync def handler():\n"
+                       "    await asyncio.sleep(1)\n"},
+        [AsyncBlockingCallRule()], baseline3)
+    assert result.findings == []
+    assert len(result.stale_baseline) == 1
+
+
+def test_baseline_matches_across_relative_and_absolute_paths():
+    """`make lint` (relative roots), the tier-1 gate (absolute resolved
+    roots), and the Containerfile (/build/...) must all agree on one
+    baseline entry."""
+    source = "import time\n\nasync def handler():\n    time.sleep(1)\n"
+    entry = {"rule": "async-blocking-call", "path": "pkg/mod.py",
+             "code": "time.sleep(1)", "reason": "known"}
+    for spelling in ("pkg/mod.py", "/root/repo/pkg/mod.py",
+                     "/build/pkg/mod.py"):
+        baseline = Baseline(entries=[dict(entry)])
+        result = lint_sources({spelling: source},
+                              [AsyncBlockingCallRule()], baseline)
+        assert result.findings == [] and len(result.baselined) == 1, spelling
+    # a different file of the same basename must NOT match
+    baseline = Baseline(entries=[dict(entry)])
+    result = lint_sources({"other/mod.py": source},
+                          [AsyncBlockingCallRule()], baseline)
+    assert len(result.findings) == 1 and result.stale_baseline
+
+
+def test_baseline_load_refuses_reasonless_entries(tmp_path):
+    import json
+
+    path = tmp_path / "bl.json"
+    path.write_text(json.dumps({"entries": [
+        {"rule": "async-blocking-call", "path": "a.py", "code": "x"}]}))
+    try:
+        Baseline.load(path)
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("reason-less baseline entry loaded")
+
+
+def test_suppression_is_per_rule_and_per_line():
+    source = """
+        import time
+
+        async def handler():
+            time.sleep(1)  # lint: allow[some-other-rule]
+            time.sleep(2)  # lint: allow[async-blocking-call] legacy path
+        """
+    result = lint_sources({"pkg/mod.py": textwrap.dedent(source)},
+                          [AsyncBlockingCallRule()])
+    assert len(result.findings) == 1          # wrong rule id: still fires
+    assert result.findings[0].lineno == 5
+    assert len(result.suppressed) == 1
+
+
+def test_allow_directive_in_string_literal_is_ignored():
+    source = '''
+        import time
+
+        async def handler():
+            x = "# lint: allow[async-blocking-call]"
+            time.sleep(1); y = x
+        '''
+    result = lint_sources({"pkg/mod.py": textwrap.dedent(source)},
+                          [AsyncBlockingCallRule()])
+    assert len(result.findings) == 1
+
+
+def test_syntax_error_is_reported_not_swallowed():
+    result = lint_sources({"pkg/bad.py": "def broken(:\n"},
+                          [AsyncBlockingCallRule()])
+    assert not result.clean
+    assert result.errors and result.errors[0].rule == "syntax-error"
+
+
+def test_active_rules_registry_has_the_six_shipping_rules():
+    ids = {r.rule_id for r in active_rules()}
+    assert {"async-blocking-call", "host-sync-in-hot-path",
+            "tracer-python-branch", "jit-cache-buster",
+            "cross-thread-mutation", "dead-metric"} <= ids
+    assert len(ids) >= 6

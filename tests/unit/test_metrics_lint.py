@@ -1,36 +1,27 @@
-"""Dead-metric lint: every metric registered on PrometheusRegistry must be
-referenced somewhere outside observability/ — a metric nothing feeds is
-dashboard noise that silently reads as 0 forever (this is how
-llm_queue_depth and sessions_active drifted dead before the telemetry PR).
+"""Dead-metric check: thin wrapper over the lint framework's dead-metric
+rule (mcp_context_forge_tpu/tools/lint/rules/dead_metric.py), so the
+check has exactly one implementation. A metric registered on
+PrometheusRegistry that nothing outside observability/ feeds is dashboard
+noise that silently reads as 0 forever — this is how llm_queue_depth and
+sessions_active drifted dead before the telemetry PR.
+
+Metrics legitimately complete at registration time (app_info) carry
+``# lint: allow[dead-metric]`` on their registration line in metrics.py.
 """
 
 from pathlib import Path
 
-from prometheus_client import Counter, Gauge, Histogram
-
 import mcp_context_forge_tpu
-from mcp_context_forge_tpu.observability.metrics import PrometheusRegistry
-
-# metrics that are fully populated at registration time and legitimately
-# never touched again outside observability/
-SELF_CONTAINED = {"app_info"}
+from mcp_context_forge_tpu.tools.lint import lint_paths
+from mcp_context_forge_tpu.tools.lint.rules.dead_metric import DeadMetricRule
 
 
 def test_every_registered_metric_is_fed_outside_observability():
-    registry = PrometheusRegistry()
-    names = sorted(attr for attr, value in vars(registry).items()
-                   if isinstance(value, (Counter, Gauge, Histogram)))
-    assert names, "registry introspection found no metrics"
-
-    package_root = Path(mcp_context_forge_tpu.__file__).parent
-    blob = "\n".join(
-        path.read_text(encoding="utf-8", errors="replace")
-        for path in sorted(package_root.rglob("*.py"))
-        if "observability" not in path.parts)
-
-    dead = [name for name in names
-            if name not in SELF_CONTAINED and f".{name}" not in blob]
-    assert not dead, (
-        f"metrics registered on PrometheusRegistry but never referenced "
-        f"outside observability/: {dead} — wire them up or remove them "
-        f"(add to SELF_CONTAINED only if populated at registration)")
+    package_root = Path(mcp_context_forge_tpu.__file__).resolve().parent
+    result = lint_paths([package_root], rules=[DeadMetricRule()])
+    assert not result.findings, "\n".join(str(f) for f in result.findings)
+    # the rule saw the registry: the allow[dead-metric]-annotated
+    # registration-time metric (app_info) proves it fired and was
+    # deliberately suppressed rather than silently finding nothing
+    assert any(f.rule == "dead-metric" for f in result.suppressed), (
+        "dead-metric rule inspected nothing — registry detection broke")
