@@ -23,6 +23,11 @@ llama3-tiny on cpu), BENCH_CLIENTS, BENCH_TOKENS, BENCH_DECODE_BLOCK,
 BENCH_SPEC (=1 enables prompt-lookup speculative decoding),
 BENCH_PROMPT_MODE (repetitive|chat — repetitive favors spec drafting).
 
+BENCH_REPLICAS=N (default 1) serves the same client load through an
+EnginePool of N replicas (device-subset meshes on TPU, shared-device
+replicas on CPU) and reports aggregate tok/s plus per-replica routing/
+occupancy so the pool's scheduling overhead and balance are visible.
+
 BENCH_KV_QUANT=1 runs an A/B pair at the SAME KV byte budget — baseline
 KV dtype vs int8 paged KV (tpu_local_kv_quant) — and reports both arms'
 tok/s, each arm's page capacity + peak resident pages, and the int8
@@ -88,6 +93,7 @@ async def run(platform: str, kv_quant: str = "") -> dict:
     page_size = int(os.environ.get(
         "BENCH_PAGE_SIZE",
         "32" if os.environ.get("BENCH_KV_QUANT", "0") == "1" else "16"))
+    replicas = int(os.environ.get("BENCH_REPLICAS", "1"))
     config = EngineConfig(model=model, max_batch=min(clients, 16),
                           max_seq_len=512, page_size=page_size,
                           num_pages=1024,
@@ -101,7 +107,12 @@ async def run(platform: str, kv_quant: str = "") -> dict:
                           compile_cache_dir=os.environ.get(
                               "MCPFORGE_TPU_LOCAL_COMPILE_CACHE_DIR",
                               "/tmp/mcpforge-xla-cache"))
-    engine = TPUEngine(config)
+    if replicas > 1:
+        from mcp_context_forge_tpu.tpu_local.pool import EnginePool
+
+        engine = EnginePool(config, replicas=replicas)
+    else:
+        engine = TPUEngine(config)
     await engine.start()
     try:
         prompt_mode = os.environ.get("BENCH_PROMPT_MODE", "chat")
@@ -167,8 +178,13 @@ async def run(platform: str, kv_quant: str = "") -> dict:
             # peak is the allocator's monotonic high-water resident mark
             # (the step ring is bounded and would under-report long runs)
             "kv_quant": kv_quant,
-            "kv_pages_capacity": engine.num_kv_pages,
-            "kv_pages_peak": engine.allocator.peak_pages_in_use,
+            "kv_pages_capacity": (
+                sum(r.engine.num_kv_pages for r in engine.replicas)
+                if replicas > 1 else engine.num_kv_pages),
+            "kv_pages_peak": (
+                sum(r.engine.allocator.peak_pages_in_use
+                    for r in engine.replicas)
+                if replicas > 1 else engine.allocator.peak_pages_in_use),
             "token_streams": [r[0] for r in results],
             "decode_steps": steps,
             "prefill_batches": engine.stats.prefill_batches - prefills0,
@@ -178,6 +194,26 @@ async def run(platform: str, kv_quant: str = "") -> dict:
             "token_latency_p95_ms": (round(intervals[int(len(intervals) * 0.95)], 2)
                                      if intervals else None),
         }
+        out["replicas"] = replicas
+        if replicas > 1:
+            # pool arm: aggregate tok/s is `value` above (the clients'
+            # wall covers the whole pool); per-replica occupancy shows
+            # how the router balanced the load
+            stats_total = max(1, sum(r.engine.stats.completion_tokens
+                                     for r in engine.replicas))
+            out["pool"] = {
+                "router": engine.router.counters(),
+                "requeues": engine.requeues,
+                "per_replica": [{
+                    "id": r.id,
+                    "routed": r.routed,
+                    "completion_tokens": r.engine.stats.completion_tokens,
+                    "occupancy_share": round(
+                        r.engine.stats.completion_tokens / stats_total, 3),
+                    "decode_steps": r.engine.stats.decode_steps,
+                    "kv_pages_peak": r.engine.allocator.peak_pages_in_use,
+                } for r in engine.replicas],
+            }
         if platform == "tpu":
             import jax
 

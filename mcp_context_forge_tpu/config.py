@@ -434,6 +434,23 @@ class Settings(BaseModel):
     # step-introspection ring size (per-dispatch summaries served by
     # GET /admin/engine/steps)
     tpu_local_step_log_size: int = 256
+    # --- engine replica pool (tpu_local/pool/, docs/serving_pool.md) ---
+    # N > 1 serves LLM traffic from N engine replicas on device-subset
+    # meshes (e.g. 2 replicas x 4 chips on a v5e-8) behind an
+    # affinity-routing, failover-capable pool; 1 = the single engine,
+    # no pool layer at all
+    tpu_local_replicas: int = 1
+    # routing: prefer the replica whose prefix cache already holds the
+    # prompt's prefix (suffix-only prefill there); load balance by least
+    # outstanding decode tokens otherwise
+    tpu_local_pool_affinity_routing: bool = True
+    # health monitor cadence + the heartbeat-staleness bar for declaring
+    # a replica wedged (its in-flight requests then requeue onto healthy
+    # replicas as continuations)
+    tpu_local_pool_health_interval_s: float = 0.5
+    tpu_local_pool_heartbeat_timeout_s: float = 10.0
+    # failovers allowed per logical request before it errors out
+    tpu_local_pool_requeue_max: int = 2
 
     # --- header passthrough (reference config.py:3489-3499: off by
     # default for security; sensitive headers need per-gateway opt-in) ---

@@ -197,6 +197,32 @@ async function renderEngine(stats){
   const rest = Object.keys(stats).filter(k => !order.includes(k));
   const extra = rest.map(k =>
     `<div class="card"><b>${cell(stats[k])}</b><span>${k}</span></div>`).join("");
+  // replica pool card (multi-replica serving tier; 404 when replicas=1)
+  let pool = "";
+  try {
+    const pr = await fetch("/admin/engine/pool");
+    if (pr.ok){
+      const p = await pr.json();
+      const pcols = ["id","state","occupancy","outstanding",
+                     "outstanding_tokens","kv_pages_in_use","routed",
+                     "requeued_off","reloads","failures","heartbeat_age_s"];
+      const pbody = (p.replicas || []).map(rp =>
+        "<tr>" + pcols.map(c => `<td>${cell(rp[c])}</td>`).join("")
+        + `<td><button class="act" onclick="poolAct('${esc(rp.id)}','drain')">drain</button>
+           <button class="act" onclick="poolAct('${esc(rp.id)}','undrain')">undrain</button>
+           <button class="act" onclick="poolAct('${esc(rp.id)}','reload')">reload</button></td></tr>`
+      ).join("");
+      pool = `<br><h3>engine replica pool</h3>
+        <div class="cards">
+          <div class="card"><b>${cell((p.router||{}).routed)}</b><span>routed</span></div>
+          <div class="card"><b>${cell((p.router||{}).affinity_hits)}</b><span>affinity_hits</span></div>
+          <div class="card"><b>${cell(p.requeues)}</b><span>requeues</span></div>
+          <div class="card"><b>${cell((p.health||{}).failures)}</b><span>replica_failures</span></div>
+        </div>
+        <table><tr>` + pcols.map(c => `<th>${esc(c)}</th>`).join("")
+        + `<th>actions</th></tr>${pbody}</table>`;
+    }
+  } catch(e){}
   // step introspection: what the scheduler dispatched last (newest first)
   let steps = "";
   try {
@@ -214,12 +240,18 @@ async function renderEngine(stats){
     }
   } catch(e){}
   document.getElementById("view").innerHTML =
-    `<div class="cards">${cards}${extra}</div>${steps}
+    `<div class="cards">${cards}${extra}</div>${pool}${steps}
      <br><button class="act" onclick="engineProfile()">capture jax profile</button>
      <button class="act" onclick="engineProfileCtl('start')">start profile</button>
      <button class="act" onclick="engineProfileCtl('stop')">stop profile</button>
      <button class="act" onclick="engineProfileStatus()">profile status</button>`;
   document.getElementById("status").textContent = "engine stats";
+}
+async function poolAct(rid, action){
+  const r = await fetch(`/admin/engine/pool/${rid}/${action}`, {method:"POST"});
+  document.getElementById("status").textContent = r.ok
+    ? `replica ${rid} ${action} ok` : `replica ${rid} ${action} failed: ${r.status}`;
+  if (r.ok) show("engine");
 }
 async function engineProfileCtl(action){
   const url = action === "start" ? "/admin/engine/profile/start"

@@ -189,6 +189,7 @@ async def build_app(settings: Settings | None = None) -> web.Application:
 
     # tpu_local engine + LLM provider registry
     engine = None
+    engine_pool = None
     if settings.tpu_local_enabled:
         from ..tpu_local.engine import EngineConfig, TPUEngine
         from ..tpu_local.provider import LLMProviderRegistry
@@ -196,12 +197,35 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         from ..tpu_local.tpu_provider import TPULocalProvider
         # telemetry handles ride into the engine so the dispatch thread can
         # emit llm.prefill/llm.decode spans + token-level SLO histograms
-        engine = TPUEngine(EngineConfig.from_settings(settings),
-                           tracer=tracer, metrics=metrics)
+        engine_config = EngineConfig.from_settings(settings)
+        if settings.tpu_local_replicas > 1:
+            # replica pool: N engines on device-subset meshes behind the
+            # affinity router + health monitor (docs/serving_pool.md).
+            # The provider speaks to the POOL. app["tpu_engine"] is still
+            # set (replica 0 at build time) for code that predates the
+            # pool, but the single-engine admin surfaces resolve the
+            # CURRENT engine through live_tpu_engine() — a pool reload
+            # swaps the engine object, so a build-time reference goes
+            # stale after the first hot-swap.
+            from ..tpu_local.pool import EnginePool
+            engine_pool = EnginePool(
+                engine_config,
+                replicas=settings.tpu_local_replicas,
+                tracer=tracer, metrics=metrics,
+                affinity_routing=settings.tpu_local_pool_affinity_routing,
+                health_interval_s=settings.tpu_local_pool_health_interval_s,
+                heartbeat_timeout_s=(
+                    settings.tpu_local_pool_heartbeat_timeout_s),
+                requeue_max=settings.tpu_local_pool_requeue_max)
+            engine = engine_pool.replicas[0].engine
+            app["tpu_engine_pool"] = engine_pool
+            ctx.extras["tpu_engine_pool"] = engine_pool
+        else:
+            engine = TPUEngine(engine_config, tracer=tracer, metrics=metrics)
         from ..services.diagnostics_service import JaxProfilerCapture
         app["jax_profiler"] = JaxProfilerCapture(settings.jax_profile_dir)
         provider = TPULocalProvider(
-            "tpu_local", engine,
+            "tpu_local", engine_pool if engine_pool is not None else engine,
             embedding_model=settings.tpu_local_embedding_model,
             tracer=tracer, metrics=metrics,
             encoder_max_batch=settings.tpu_local_encoder_max_batch,
@@ -612,7 +636,9 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         await upstream_sessions.start()
         await auth_service.bootstrap_admin()
         await app["role_service"].bootstrap_system_roles()
-        if engine is not None:
+        if engine_pool is not None:
+            await engine_pool.start()  # replicas + health monitor
+        elif engine is not None:
             await engine.start()
         await llm_provider_service.rewire()  # external providers from DB
         if ctx.plugin_manager is not None:

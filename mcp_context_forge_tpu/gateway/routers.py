@@ -773,7 +773,8 @@ document.getElementById("f").onsubmit = async (e) => {
         """Scheduler/cache counters of the in-process tpu_local engine
         (reference analog: runtime_admin/observability admin surfaces)."""
         request["auth"].require("observability.read")
-        engine = request.app.get("tpu_engine")
+        from ..services.diagnostics_service import live_tpu_engine
+        engine = live_tpu_engine(request.app)
         if engine is None:
             raise NotFoundError("tpu_local engine is not enabled")
         stats = engine.stats
@@ -809,6 +810,59 @@ document.getElementById("f").onsubmit = async (e) => {
             },
         })
 
+    @routes.get("/admin/engine/pool")
+    async def engine_pool_status(request: web.Request) -> web.Response:
+        """Replica-pool topology card: per-replica health, occupancy, and
+        routing/failover counters (tpu_local/pool/, docs/serving_pool.md)."""
+        request["auth"].require("observability.read")
+        pool = request.app.get("tpu_engine_pool")
+        if pool is None:
+            raise NotFoundError(
+                "engine replica pool is not enabled "
+                "(set MCPFORGE_TPU_LOCAL_REPLICAS > 1)")
+        return web.json_response(pool.status())
+
+    @routes.post("/admin/engine/pool/{replica}/{action}")
+    async def engine_pool_action(request: web.Request) -> web.Response:
+        """drain | undrain | reload one replica. Drain stops routing and
+        waits for in-flight work; reload is the rolling weight hot-swap
+        (drain -> rebuild engine from config.checkpoint -> readmit)."""
+        request["auth"].require("admin.all")  # reload swaps weights
+        pool = request.app.get("tpu_engine_pool")
+        if pool is None:
+            raise NotFoundError(
+                "engine replica pool is not enabled "
+                "(set MCPFORGE_TPU_LOCAL_REPLICAS > 1)")
+        action = request.match_info["action"]
+        rid = request.match_info["replica"]
+        body = {}
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except json.JSONDecodeError:
+                raise ValidationFailure("body must be JSON")
+        if not isinstance(body, dict):  # valid JSON but e.g. [30] or "60"
+            raise ValidationFailure("body must be a JSON object")
+        try:
+            timeout_s = float(body.get("timeout_s", 60.0))
+        except (TypeError, ValueError):
+            raise ValidationFailure("timeout_s must be a number")
+        try:
+            if action == "drain":
+                result = await pool.drain(rid, timeout_s=timeout_s)
+            elif action == "undrain":
+                result = await pool.undrain(rid)
+            elif action == "reload":
+                result = await pool.reload(rid, timeout_s=timeout_s)
+            else:
+                raise ValidationFailure(
+                    f"action must be drain|undrain|reload, got {action!r}")
+        except KeyError as exc:
+            raise NotFoundError(str(exc)) from exc
+        except ValueError as exc:
+            raise ValidationFailure(str(exc)) from exc
+        return web.json_response(result)
+
     @routes.post("/admin/engine/profile")
     async def engine_profile(request: web.Request) -> web.Response:
         """Capture a jax.profiler trace of the running engine (SURVEY §5.1
@@ -824,7 +878,8 @@ document.getElementById("f").onsubmit = async (e) => {
         # start/stop endpoints must see each other's state. A concurrent
         # capture raises ConflictError -> 409 via the error middleware.
         profiler = profiler_or_404(request)
-        engine = request.app.get("tpu_engine")
+        from ..services.diagnostics_service import live_tpu_engine
+        engine = live_tpu_engine(request.app)
         if engine is None:
             raise NotFoundError("tpu_local engine is not enabled")
         body = await request.json() if request.can_read_body else {}

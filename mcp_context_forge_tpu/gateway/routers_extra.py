@@ -164,10 +164,11 @@ def setup_extra_routes(app: web.Application) -> None:
         the operator's 'what is the scheduler actually dispatching right
         now' answer for the admin UI. Read-only."""
         request["auth"].require("observability.read")
-        engine = request.app.get("tpu_engine")
+        from ..services.diagnostics_service import (engine_introspection,
+                                                    live_tpu_engine)
+        engine = live_tpu_engine(request.app)
         if engine is None:
             raise NotFoundError("tpu_local engine is not enabled")
-        from ..services.diagnostics_service import engine_introspection
         try:
             limit = int(request.query.get("limit", "64"))
         except ValueError as exc:

@@ -54,35 +54,45 @@ class PrometheusRegistry:
             "mcpforge_llm_requests_total", "LLM requests", ["model", "status"],
             registry=self.registry,
         )
+        # every engine-fed GAUGE carries a replica label: gauges are
+        # last-writer-wins, so N replicas' dispatch threads writing one
+        # unlabeled series would flap between replicas' values (counters
+        # and histograms aggregate correctly unlabeled and keep only the
+        # labels their queries need)
         self.llm_queue_depth = Gauge(
             "mcpforge_llm_queue_depth", "tpu_local scheduler queue depth",
-            registry=self.registry,
+            ["replica"], registry=self.registry,
         )
         self.llm_kv_pages_in_use = Gauge(
             "mcpforge_llm_kv_pages_in_use", "Paged KV cache pages in use",
-            registry=self.registry,
+            ["replica"], registry=self.registry,
         )
         # dtype-aware twin of the page-count gauge: pages x page bytes
         # under the active KV storage dtype (int8 pages cost ~half their
-        # bf16 twin), so mixed-mode fleets compare on one byte axis
+        # bf16 twin), so mixed-mode fleets compare on one byte axis.
+        # Replica-labeled: under an EnginePool each replica owns its own
+        # KV pool, and a per-replica byte view is what capacity planning
+        # and the drain decision read.
         self.llm_kv_bytes_in_use = Gauge(
             "mcpforge_llm_kv_bytes_in_use",
             "HBM bytes the in-use KV pages occupy under the active KV dtype",
-            registry=self.registry,
+            ["replica"], registry=self.registry,
         )
         # token-level SLO signals (fed by the engine dispatch thread):
         # TTFT = submit -> first token (queue + prefill), TPOT = mean
-        # inter-token latency over the decode phase of one request
+        # inter-token latency over the decode phase of one request.
+        # The replica label separates a degraded replica's tail from the
+        # pool aggregate (sum across label children for the fleet view).
         self.llm_ttft = Histogram(
             "mcpforge_llm_ttft_seconds", "Time to first token",
-            ["model"], registry=self.registry,
+            ["model", "replica"], registry=self.registry,
             buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
                      10.0, 30.0),
         )
         self.llm_tpot = Histogram(
             "mcpforge_llm_tpot_seconds",
             "Per-token decode latency (mean over one request)",
-            ["model"], registry=self.registry,
+            ["model", "replica"], registry=self.registry,
             buckets=(0.002, 0.005, 0.01, 0.02, 0.04, 0.08, 0.15, 0.3,
                      0.6, 1.2, 2.5),
         )
@@ -95,12 +105,12 @@ class PrometheusRegistry:
         self.llm_batch_occupancy = Gauge(
             "mcpforge_llm_batch_occupancy",
             "Active decode slots at the last engine step",
-            registry=self.registry,
+            ["replica"], registry=self.registry,
         )
         self.llm_kv_page_utilization = Gauge(
             "mcpforge_llm_kv_page_utilization",
             "Fraction of the paged KV pool in use (0..1)",
-            registry=self.registry,
+            ["replica"], registry=self.registry,
         )
         self.llm_kv_alloc_failures = Counter(
             "mcpforge_llm_kv_alloc_failures_total",
@@ -110,7 +120,7 @@ class PrometheusRegistry:
         self.llm_step_tokens_per_sec = Gauge(
             "mcpforge_llm_step_tokens_per_sec",
             "Tokens emitted per second by the last engine step",
-            registry=self.registry,
+            ["replica"], registry=self.registry,
         )
         # overlapped-decode health: the gap histogram is the host-side
         # stall between device dispatches (the thing the pipeline hides —
@@ -119,7 +129,7 @@ class PrometheusRegistry:
         self.llm_dispatch_gap = Histogram(
             "mcpforge_llm_dispatch_gap_seconds",
             "Host-side stall between consecutive decode dispatches",
-            registry=self.registry,
+            ["replica"], registry=self.registry,
             buckets=(0.00001, 0.00005, 0.0001, 0.00025, 0.0005, 0.001,
                      0.0025, 0.005, 0.01, 0.025, 0.05, 0.1),
         )
@@ -127,7 +137,37 @@ class PrometheusRegistry:
             "mcpforge_llm_device_idle_fraction",
             "Fraction of recent decode wall time the device waited on host "
             "bookkeeping (0..1; ~0 with the overlapped pipeline)",
-            registry=self.registry,
+            ["replica"], registry=self.registry,
+        )
+        # EnginePool (tpu_local/pool/) serving tier: per-replica health,
+        # load, and routing outcomes — fed by the pool router/health
+        # monitor on the gateway loop
+        self.llm_pool_replica_up = Gauge(
+            "mcpforge_llm_pool_replica_up",
+            "1 while the replica is routable (ready), 0 otherwise",
+            ["replica"], registry=self.registry,
+        )
+        self.llm_pool_outstanding = Gauge(
+            "mcpforge_llm_pool_outstanding_requests",
+            "In-flight requests the pool has routed to the replica",
+            ["replica"], registry=self.registry,
+        )
+        self.llm_pool_routed = Counter(
+            "mcpforge_llm_pool_routed_total",
+            "Requests routed to the replica (affinity: prefix-cache hit "
+            "steered the choice)",
+            ["replica", "affinity"], registry=self.registry,
+        )
+        self.llm_pool_requeues = Counter(
+            "mcpforge_llm_pool_requeues_total",
+            "In-flight requests requeued off a failed replica onto a "
+            "healthy one",
+            ["replica"], registry=self.registry,
+        )
+        self.llm_pool_reloads = Counter(
+            "mcpforge_llm_pool_reloads_total",
+            "Rolling drain->swap->readmit reloads completed per replica",
+            ["replica"], registry=self.registry,
         )
         self.llm_providers_wired = Gauge(
             "mcpforge_llm_providers_wired",

@@ -26,14 +26,18 @@ class CancellationService:
         if task is not None and not task.done():
             task.cancel()
             return True
-        # engine-side: cancel a generation whose request_id matches
-        engine = self.ctx.extras.get("tpu_engine")
+        # engine-side: cancel a generation whose request_id matches. The
+        # pool knows the logical id on every replica (including requeued
+        # shadows whose engine-side id carries a ~rN suffix); the
+        # single-engine path resolves the CURRENT engine through the
+        # live accessor so a pool reload cannot strand a stale reference.
+        from .diagnostics_service import live_tpu_engine
+        pool = self.ctx.extras.get("tpu_engine_pool")
+        if pool is not None:
+            return pool.cancel(request_id)
+        engine = live_tpu_engine(self.ctx.extras)
         if engine is not None:
-            for request in list(engine._running.values()):
-                if request.request_id == request_id:
-                    request.finish_reason = "cancelled"
-                    await engine._finish(request)
-                    return True
+            return engine.request_cancel(request_id)
         return False
 
     @property

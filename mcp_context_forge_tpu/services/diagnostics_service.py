@@ -49,6 +49,21 @@ logger = logging.getLogger(__name__)
 # engine step introspection + profiler capture
 # --------------------------------------------------------------------------
 
+def live_tpu_engine(container: Any) -> Any:
+    """The CURRENT engine behind the single-engine admin surfaces
+    (/admin/engine/stats|steps|profile, the bundle's engine.json).
+
+    When the replica pool is enabled, read THROUGH it: a pool reload
+    swaps replica 0's engine object, so a ``tpu_engine`` reference
+    captured at app build time goes stale after the first hot-swap
+    (frozen stats, dead step ring). ``container`` is the aiohttp app or
+    ``ctx.extras`` — anything dict-like."""
+    pool = container.get("tpu_engine_pool")
+    if pool is not None:
+        return pool.replicas[0].engine
+    return container.get("tpu_engine")
+
+
 def engine_introspection(engine: Any, limit: int = 64) -> dict[str, Any]:
     """The engine's step ring buffer plus the scheduler counters an
     operator needs to read it (served by GET /admin/engine/steps and
@@ -433,7 +448,7 @@ class SupportBundleService:
         if include_env:
             sections.append(("environment.json", redact_env(os.environ)))
         sections.append(("database.json", await self._db_info()))
-        engine = self._ctx.extras.get("tpu_engine")
+        engine = live_tpu_engine(self._ctx.extras)
         if engine is not None:
             try:
                 stats = engine.stats
@@ -450,6 +465,26 @@ class SupportBundleService:
                                      engine_introspection(engine, limit=128)))
             except Exception as exc:  # diagnostics must not fail the bundle
                 sections.append(("engine.json", {"error": str(exc)}))
+        pool = self._ctx.extras.get("tpu_engine_pool")
+        if pool is not None:
+            # replica pool topology + PER-REPLICA step rings: the support
+            # bundle must show which replica wedged/crashed and what each
+            # one dispatched last, not just replica 0's view
+            try:
+                sections.append(("engine_pool.json", pool.status()))
+            except Exception as exc:
+                sections.append(("engine_pool.json", {"error": str(exc)}))
+            for replica in pool.replicas:
+                name = f"engine_pool/replica-{replica.id}-steps.json"
+                try:
+                    sections.append((
+                        name, engine_introspection(replica.engine,
+                                                   limit=128)))
+                except Exception as exc:
+                    # per-replica error entry keeps zip names unique AND
+                    # shows which replica's ring was unreadable (e.g.
+                    # mid-reload) instead of truncating the loop
+                    sections.append((name, {"error": str(exc)}))
         records = (ring_buffer.search(limit=log_tail) if include_logs
                    else None)
         perf = self._ctx.extras.get("perf_tracker")

@@ -53,6 +53,10 @@ logger = logging.getLogger(__name__)
 class EngineConfig:
     model: str = "llama3-tiny"
     checkpoint: str = ""
+    # identity within an EnginePool (pool/): labels the replica's metrics
+    # (TTFT/TPOT/dispatch-gap/KV-bytes) and spans so per-replica SLOs are
+    # separable on one dashboard. "0" for a standalone engine.
+    replica_id: str = "0"
     max_batch: int = 8              # decode slots
     max_seq_len: int = 2048
     page_size: int = 128
@@ -225,6 +229,11 @@ class GenRequest:
     # once-only guard: crash-recovery requeues pass admission twice, and
     # the queue span/histogram must not double-observe the request
     queue_observed: bool = False
+    # same pattern for the first-token surfaces: a pool-failover
+    # continuation whose original attempt already emitted tokens must not
+    # observe a second TTFT sample (it would span the failed attempt +
+    # failover) or re-emit llm.prefill for the same logical request
+    ttft_observed: bool = False
 
 
 class EngineStats:
@@ -339,7 +348,8 @@ class TPUEngine:
     """Owns params + KV pool on the mesh; device syncs run on the dispatch
     thread, token emission hops back to the asyncio loop."""
 
-    def __init__(self, config: EngineConfig, tracer=None, metrics=None):
+    def __init__(self, config: EngineConfig, tracer=None, metrics=None,
+                 devices: list | None = None):
         # telemetry handles are optional: None means zero-cost no-ops, so
         # unit tests and benches constructing engines directly pay nothing
         self.tracer = tracer
@@ -390,6 +400,7 @@ class TPUEngine:
         self._stop_event = threading.Event()
         self._loop: asyncio.AbstractEventLoop | None = None
         self._started = False
+        self._killed = False
         # overlapped decode pipeline state (dispatch thread only): the
         # dispatched-but-not-yet-emitted decode step, if any
         self._inflight: dict[str, Any] | None = None  # lint: thread[dispatch]
@@ -424,9 +435,22 @@ class TPUEngine:
         # starts "now" so the warmed start-at-max posture survives a
         # burst arriving right after startup
         self._last_active_ts = time.monotonic()  # lint: thread[dispatch]
+        # liveness heartbeat: bumped once per dispatch-loop iteration (the
+        # idle wait is bounded at 50 ms, so a healthy engine beats at
+        # >=20 Hz even with no traffic). The pool's health monitor reads
+        # its AGE to tell a wedged device call from an idle engine.
+        self._heartbeat_ts = time.monotonic()  # lint: thread[dispatch]
+        # cancellation handoff: request ids the loop side asked to
+        # terminate; the dispatch thread consumes them at the top of each
+        # iteration (request_cancel is the only other writer, lock-guarded)
+        self._cancels: set[str] = set()  # lint: thread[dispatch]
+        self._cancel_lock = threading.Lock()  # lint: lock[dispatch]
 
         dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
-        devices = probe_devices(config.init_timeout_s)
+        # an EnginePool passes each replica its device subset; a standalone
+        # engine owns every device the (watchdogged) backend reports
+        if devices is None:
+            devices = probe_devices(config.init_timeout_s)
         self.mesh = make_mesh(config.mesh_shape, devices=devices)
         logger.info("tpu_local: mesh %s, model %s", self.mesh.shape, config.model)
         if config.sp_impl != "none":
@@ -706,6 +730,23 @@ class TPUEngine:
         if mode == "fast" and len(hist_ctx) > 2:
             hist_ctx = [hist_ctx[0], hist_ctx[-1]]
         with self.mesh:
+            # sharding-settle call: the first jitted call canonicalizes
+            # the kv pytree's output shardings (P(...,'model',...) from
+            # kv_init becomes the executables' inferred placement), and
+            # the pjit cache keys on input shardings — compiling the grid
+            # against the PRE-transition kv would bake the init placement
+            # into the first shape and recompile it at first traffic hit
+            b0 = min(self.config.prefill_buckets)
+            settle = SamplingParams(jnp.zeros((1,), jnp.float32),
+                                    jnp.zeros((1,), jnp.int32),
+                                    jnp.ones((1,), jnp.float32))
+            first, self.kv = self._prefill_sample(
+                self.params, self.kv,
+                jnp.full((1, b0), self.tokenizer.pad_id, jnp.int32),
+                jnp.full((1, b0), -1, jnp.int32),
+                jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32),
+                settle, jax.random.PRNGKey(0))
+            first.block_until_ready()
             for bucket in self.config.prefill_buckets:
                 use_sp = (self._prefill_sample_sp is not None
                           and bucket > self.config.sp_threshold)
@@ -786,11 +827,14 @@ class TPUEngine:
                     if self.config.decode_overlap and self._verify_fns is None:
                         # the pipelined steady state runs the feedback
                         # variant; warm it alongside so overlap never
-                        # compiles mid-traffic
+                        # compiles mid-traffic. Feed it the plain decode's
+                        # OUTPUT block — at runtime the feed is always the
+                        # previous step's on-device jit output, and the
+                        # pjit cache keys on that committed sharding (a
+                        # fresh jnp.zeros here would warm a cache entry
+                        # traffic never hits)
                         block, self.kv = self._decode_fb_fn(ctx_pages, batch)(
-                            self.params, self.kv,
-                            jnp.zeros((self.config.decode_block, batch),
-                                      jnp.int32),
+                            self.params, self.kv, block,
                             jnp.zeros((batch,), jnp.int32),
                             jnp.arange(batch, dtype=jnp.int32),
                             jnp.zeros((batch,), jnp.int32), bsamp,
@@ -919,6 +963,7 @@ class TPUEngine:
             # a second dispatch thread would corrupt both
             raise RuntimeError("previous dispatch thread still running")
         self._started = True
+        self._killed = False
         self._loop = asyncio.get_running_loop()
         # fresh events per thread: a wedged old thread keeps seeing its own
         # (set) events and can never be revived by a later start()
@@ -943,6 +988,82 @@ class TPUEngine:
                 return  # keep self._thread so start() refuses a double-start
         self._thread = None
 
+    def kill(self) -> None:
+        """Signal the dispatch thread to stop WITHOUT joining it.
+
+        Pool failover path: a wedged device call can hold the thread for
+        minutes, and the pool must not wait on it before requeueing the
+        replica's in-flight requests onto healthy replicas. After kill()
+        the engine refuses new submissions (_check_alive) and a revived
+        zombie thread exits at its next loop check; any tokens it emits
+        land in streams the pool has already abandoned."""
+        self._killed = True
+        self._started = False
+        self._stop_event.set()
+        self._wake.set()
+
+    def heartbeat_age(self) -> float:
+        """Seconds since the dispatch loop last started an iteration —
+        the pool health monitor's wedge signal (a healthy loop beats at
+        >=20 Hz; a thread stuck inside a device call stops beating)."""
+        return max(0.0, time.monotonic() - self._heartbeat_ts)
+
+    def last_step_age(self) -> float | None:
+        """Seconds since the last device dispatch retired (step-ring
+        staleness); None before the first step."""
+        if self._last_step_done_ts is None:
+            return None
+        return max(0.0, time.monotonic() - self._last_step_done_ts)
+
+    def dispatch_alive(self) -> bool:
+        """True while the dispatch thread is running (started and the
+        thread object is live) — the crash half of the health check."""
+        return bool(self._started and self._thread is not None
+                    and self._thread.is_alive())
+
+    @property
+    def warmed(self) -> bool:
+        """True once warmup compiled at least one decode width. A warmed
+        engine has no first-dispatch compile left, so the pool health
+        monitor may read a stale heartbeat as a wedge even before the
+        first traffic step retires."""
+        return bool(self._warmed_widths)
+
+    def request_cancel(self, request_id: str) -> bool:
+        """Thread-safe: ask the dispatch thread to terminate a generation.
+
+        Returns True when the id matches a request the engine currently
+        holds (pending, chunk-prefilling, or decoding); the stream then
+        receives its terminal like any other finish, with
+        ``finish_reason="cancelled"``. A request still in the submit
+        handoff queue is not yet visible here (the window is one
+        dispatch-loop iteration) — callers get False and may retry.
+        The id set is consumed by ``_apply_cancels`` on the dispatch
+        thread; this side only reads the request tables (snapshots under
+        the GIL) and mutates under the cancel lock."""
+        for _ in range(8):
+            try:
+                known = any(
+                    r.request_id == request_id
+                    for bucket in (list(self._pending),
+                                   list(self._chunking.values()),
+                                   list(self._running.values()))
+                    for r in bucket)
+                break
+            except RuntimeError:
+                # the dispatch thread mutated a table mid-snapshot; the
+                # tables are small and mutate once per step — retry
+                continue
+        else:
+            known = True  # can't prove absence: mark anyway (an unmatched
+            #               id is dropped at the next _apply_cancels sweep)
+        if not known:
+            return False
+        with self._cancel_lock:
+            self._cancels.add(request_id)
+        self._wake.set()
+        return True
+
     # ------------------------------------------------------------- submission
 
     async def submit(self, request: GenRequest) -> GenRequest:
@@ -955,16 +1076,29 @@ class TPUEngine:
                 self._wake.set()  # wake an idle dispatch thread
                 break
             except queue.Full:  # backpressure without blocking the loop
-                self._check_alive()
                 await asyncio.sleep(0.005)
+                # recheck AFTER the await, with no further await before
+                # the retry put: the pool's health sweep can kill this
+                # engine during the sleep (kill + _fail_outstanding drain
+                # the queue), and a put that then succeeds would register
+                # work on a dead replica no sweep will ever requeue
+                self._check_alive()
         self.stats.queue_depth = self._work.qsize() + len(self._pending)
         if self.metrics is not None:
-            self.metrics.llm_queue_depth.set(self.stats.queue_depth)
+            self.metrics.llm_queue_depth.labels(
+                replica=self.config.replica_id).set(self.stats.queue_depth)
         return request
 
     def _check_alive(self) -> None:
         """Fail fast instead of queueing work no consumer will ever drain
-        (a crashed dispatch thread must not hang every later request)."""
+        (a crashed dispatch thread must not hang every later request).
+        A kill()ed engine refuses outright: kill clears _started without
+        joining, so the liveness clause alone would wave submissions into
+        a queue nothing drains — exactly the pool race where a submit
+        awaiting backpressure resumes after the health sweep killed the
+        replica."""
+        if self._killed:
+            raise RuntimeError("tpu_local engine was killed (failover)")
         if self._started and (self._thread is None
                               or not self._thread.is_alive()):
             raise RuntimeError("tpu_local engine dispatch thread is not running")
@@ -996,45 +1130,57 @@ class TPUEngine:
         crashed = False
         overlap = self.config.decode_overlap and self._verify_fns is None
         try:
-            while not self._stop_event.is_set():
-                did_work = False
-                # drain the bounded handoff queue EVERY iteration (as the
-                # old unconditional _admit_batch did): the backlog lives
-                # in the unbounded _pending, where the priority sort and
-                # within-class FIFO apply — even while all slots are busy
-                self._drain_work()
-                incoming = bool(self._pending)
-                occupied = len(self._running) + len(self._chunking)
-                can_admit = incoming and occupied < self.config.max_batch
-                if self._inflight is not None and (
-                        can_admit or self._chunking or not self._running):
-                    # drain barriers: admission and chunk completion move
-                    # requests into slots/pages the in-flight lookahead
-                    # indexes; an empty running set means the lookahead
-                    # holds only rows that already finished
-                    self._drain_pipeline()
-                    did_work = True
-                if can_admit:
-                    did_work = self._admit_batch() or did_work
-                if self._chunking:
-                    self._chunk_round()
-                    did_work = True
-                if self._running:
-                    if self._verify_fns is not None and self._any_would_draft():
-                        self._spec_step_all()
-                    elif overlap:
-                        self._decode_step_overlapped()
-                    else:
-                        self._decode_step_all()
-                    did_work = True
-                self.stats.queue_depth = self._work.qsize() + len(self._pending)
-                self.stats.chunking = len(self._chunking)
-                self._flush_emits()
-                if not did_work:
-                    self._wait_for_work()
-            # clean stop: already-sampled in-flight tokens reach their
-            # streams before the cancel sweep below
-            self._drain_pipeline()
+            # the pjit dispatch cache keys on the AMBIENT mesh context, not
+            # just input shardings: warmup() compiles under ``with
+            # self.mesh`` so dispatch must run under it too, or every
+            # warmed shape recompiles on its first traffic hit (observed:
+            # seconds-long "mid-traffic" compiles on shapes warmup had
+            # already built, which reads as a wedge to the pool's
+            # heartbeat monitor)
+            with self.mesh:
+                while not self._stop_event.is_set():
+                    self._heartbeat_ts = time.monotonic()
+                    did_work = False
+                    # drain the bounded handoff queue EVERY iteration (as the
+                    # old unconditional _admit_batch did): the backlog lives
+                    # in the unbounded _pending, where the priority sort and
+                    # within-class FIFO apply — even while all slots are busy
+                    self._drain_work()
+                    if self._cancels:
+                        self._apply_cancels()
+                        did_work = True
+                    incoming = bool(self._pending)
+                    occupied = len(self._running) + len(self._chunking)
+                    can_admit = incoming and occupied < self.config.max_batch
+                    if self._inflight is not None and (
+                            can_admit or self._chunking or not self._running):
+                        # drain barriers: admission and chunk completion move
+                        # requests into slots/pages the in-flight lookahead
+                        # indexes; an empty running set means the lookahead
+                        # holds only rows that already finished
+                        self._drain_pipeline()
+                        did_work = True
+                    if can_admit:
+                        did_work = self._admit_batch() or did_work
+                    if self._chunking:
+                        self._chunk_round()
+                        did_work = True
+                    if self._running:
+                        if self._verify_fns is not None and self._any_would_draft():
+                            self._spec_step_all()
+                        elif overlap:
+                            self._decode_step_overlapped()
+                        else:
+                            self._decode_step_all()
+                        did_work = True
+                    self.stats.queue_depth = self._work.qsize() + len(self._pending)
+                    self.stats.chunking = len(self._chunking)
+                    self._flush_emits()
+                    if not did_work:
+                        self._wait_for_work()
+                # clean stop: already-sampled in-flight tokens reach their
+                # streams before the cancel sweep below
+                self._drain_pipeline()
         except Exception:
             crashed = True
             # device state (and the in-flight block) is suspect after a
@@ -1133,6 +1279,39 @@ class TPUEngine:
                 request.finish_reason = reason
             self._post_tokens(request, [], done=True)
         self._flush_emits()
+
+    def _apply_cancels(self) -> None:  # lint: runs-on[dispatch]
+        """Terminate the generations request_cancel() marked. Runs at the
+        top of the dispatch iteration; a cancelled RUNNING slot re-homes
+        pages, so the overlap pipeline drains first (same barrier as
+        admission/stop). Ids that matched nothing (the request finished
+        between the mark and this sweep) are dropped — cancelling a
+        completed request is a no-op by contract."""
+        with self._cancel_lock:
+            ids, self._cancels = self._cancels, set()
+        if not ids:
+            return
+        if self._inflight is not None and any(
+                r.request_id in ids for r in self._running.values()):
+            self._drain_pipeline()
+        for request in list(self._running.values()):
+            if request.request_id in ids and request.finish_reason is None:
+                request.finish_reason = "cancelled"
+                self._finish(request)
+        for request in list(self._chunking.values()):
+            if request.request_id in ids and request.finish_reason is None:
+                self._chunking.pop(request.slot, None)
+                self.allocator.free_slot(request.slot)
+                request.finish_reason = "cancelled"
+                self._post_tokens(request, [], done=True)
+        kept: deque[GenRequest] = deque()
+        for request in self._pending:
+            if request.request_id in ids and request.finish_reason is None:
+                request.finish_reason = "cancelled"
+                self._post_tokens(request, [], done=True)
+            else:
+                kept.append(request)
+        self._pending = kept
 
     def _wait_for_work(self) -> None:
         """Idle path: block on the submit-side wake event instead of a
@@ -1891,7 +2070,8 @@ class TPUEngine:
             self.stats.overlap_steps += int(feed is not None)
         self.stats.dispatch_gap_ms_total += gap_s * 1000
         if self.metrics is not None:
-            self.metrics.llm_dispatch_gap.observe(gap_s)
+            self.metrics.llm_dispatch_gap.labels(
+                replica=self.config.replica_id).observe(gap_s)
         if feed is None:
             block_tokens, self.kv = self._decode_fn(ctx_pages, B)(
                 self.params, self.kv, jnp.asarray(tokens),
@@ -1942,7 +2122,9 @@ class TPUEngine:
                           ctx_pages=inflight["ctx_pages"],
                           gap_ms=inflight["gap_s"] * 1000)
         if self.metrics is not None:
-            self.metrics.llm_device_idle_frac.set(self.device_idle_fraction())
+            self.metrics.llm_device_idle_frac.labels(
+                replica=self.config.replica_id).set(
+                self.device_idle_fraction())
 
     def device_idle_fraction(self) -> float:
         """Fraction of recent decode wall time the device spent waiting on
@@ -1988,17 +2170,21 @@ class TPUEngine:
         })
         m = self.metrics
         if m is not None:
-            m.llm_batch_occupancy.set(len(self._running) + len(self._chunking))
-            m.llm_kv_pages_in_use.set(pages_in_use)
-            m.llm_kv_page_utilization.set(
+            rid = self.config.replica_id
+            m.llm_batch_occupancy.labels(replica=rid).set(
+                len(self._running) + len(self._chunking))
+            m.llm_kv_pages_in_use.labels(replica=rid).set(pages_in_use)
+            m.llm_kv_page_utilization.labels(replica=rid).set(
                 pages_in_use / max(1, self.num_kv_pages - 1))
             # dtype-aware byte view: pages x page bytes under the ACTIVE
             # KV dtype, so int8 and bf16 engines are comparable on one
             # dashboard even though their page counts differ 2x
-            m.llm_kv_bytes_in_use.set(self.kv_bytes_in_use())
-            m.llm_queue_depth.set(depth)
+            m.llm_kv_bytes_in_use.labels(
+                replica=self.config.replica_id).set(self.kv_bytes_in_use())
+            m.llm_queue_depth.labels(replica=rid).set(depth)
             if dur_ms > 0 and tokens:
-                m.llm_step_tokens_per_sec.set(tokens / (dur_ms / 1e3))
+                m.llm_step_tokens_per_sec.labels(replica=rid).set(
+                    tokens / (dur_ms / 1e3))
 
     def recent_steps(self, limit: int | None = None) -> list[dict[str, Any]]:
         """Last N step summaries, oldest first (diagnostics surface)."""
@@ -2016,6 +2202,7 @@ class TPUEngine:
         attributes: dict[str, Any] = {
             "gen_ai.system": "tpu_local",
             "gen_ai.request.model": self.config.model,
+            "llm.replica_id": self.config.replica_id,
             "llm.slot": request.slot,
         }
         attributes.update(attrs)
@@ -2045,7 +2232,9 @@ class TPUEngine:
         n = len(request.generated)
         decode_start = request.first_token_ts or now
         if self.metrics is not None and n > 1:
-            self.metrics.llm_tpot.labels(model=self.config.model).observe(
+            self.metrics.llm_tpot.labels(
+                model=self.config.model,
+                replica=self.config.replica_id).observe(
                 max(0.0, (now - decode_start) / (n - 1)))
         reason = request.finish_reason or "stop"
         self._span("llm.decode", request, decode_start, now,
@@ -2062,25 +2251,35 @@ class TPUEngine:
         page growth, no finishes) uploads NOTHING: the previous table
         rides through the donated kv pytree unchanged."""
         if self.allocator.dirty:
-            self.kv = self.kv._replace(block_tables=self.allocator.tables())
+            # upload under the table's existing (replicated NamedSharding)
+            # placement: the pjit cache keys on input shardings, so a bare
+            # jnp.array here — single-device, uncommitted — would recompile
+            # every warmup-built executable at its first traffic hit
+            self.kv = self.kv._replace(block_tables=jax.device_put(
+                self.allocator.tables(), self.kv.block_tables.sharding))
 
     def _emit(self, request: GenRequest, token: int) -> None:
         request.generated.append(token)
         self.stats.completion_tokens += 1
         if request.first_token_ts == 0.0:
             request.first_token_ts = time.time()
-            if self.metrics is not None:
-                self.metrics.llm_ttft.labels(model=self.config.model).observe(
-                    max(0.0, request.first_token_ts - request.created))
-            self._span("llm.prefill", request, request.created
-                       + request.queue_ms / 1e3, request.first_token_ts,
-                       **{"gen_ai.usage.prompt_tokens": len(request.prompt_ids),
-                          "llm.prefill_ms": round(request.prefill_ms, 2),
-                          "llm.bucket": request.bucket,
-                          "llm.cached_prefix_tokens": request.hist,
-                          "llm.chunked": request.chunked,
-                          "llm.kv_pages": self.allocator.slot_pages(
-                              request.slot)})
+            if not request.ttft_observed:
+                request.ttft_observed = True
+                if self.metrics is not None:
+                    self.metrics.llm_ttft.labels(
+                        model=self.config.model,
+                        replica=self.config.replica_id).observe(
+                        max(0.0, request.first_token_ts - request.created))
+                self._span("llm.prefill", request, request.created
+                           + request.queue_ms / 1e3, request.first_token_ts,
+                           **{"gen_ai.usage.prompt_tokens":
+                                  len(request.prompt_ids),
+                              "llm.prefill_ms": round(request.prefill_ms, 2),
+                              "llm.bucket": request.bucket,
+                              "llm.cached_prefix_tokens": request.hist,
+                              "llm.chunked": request.chunked,
+                              "llm.kv_pages": self.allocator.slot_pages(
+                                  request.slot)})
         done = (token == self.tokenizer.eos_id or token in request.stop_ids
                 or len(request.generated) >= request.max_tokens)
         if done and request.finish_reason is None:

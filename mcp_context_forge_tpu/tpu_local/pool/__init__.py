@@ -1,0 +1,9 @@
+"""Engine replica pool: affinity-routed multi-replica serving tier with
+failover and rolling reload (docs/serving_pool.md)."""
+
+from .health import HealthMonitor
+from .pool import EnginePool, EngineReplica, PoolRecord, partition_devices
+from .router import ReplicaRouter
+
+__all__ = ["EnginePool", "EngineReplica", "PoolRecord", "HealthMonitor",
+           "ReplicaRouter", "partition_devices"]

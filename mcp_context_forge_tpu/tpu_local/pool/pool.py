@@ -1,0 +1,631 @@
+"""EnginePool: an affinity-routed multi-replica serving tier.
+
+One ``TPUEngine`` is one mesh, one dispatch thread, one failure domain.
+The pool owns N of them — device-subset meshes carved out of
+``jax.devices()`` (N full-overlap CPU replicas in tests) — behind the
+same submit/generate surface the provider already speaks, adding what a
+single replica cannot have:
+
+- **routing** (router.py): prefix-cache affinity first, then least
+  outstanding decode tokens, per-priority admission carried through to
+  each replica's own scheduler;
+- **failover** (health.py): a crashed or wedged replica's in-flight
+  requests REQUEUE onto healthy replicas as continuations — the new
+  prompt is (original prompt + tokens already emitted), so consumers
+  see every token exactly once and greedy streams continue
+  byte-identically. Composes with the engine's once-only admission
+  guard: requeued shadows carry ``queue_observed=True`` so the logical
+  request's queue-wait is observed exactly once;
+- **drain/reload**: rolling checkpoint hot-swap per replica
+  (``drain -> swap weights -> readmit``) while the rest of the pool
+  keeps serving.
+
+Requests are never handed to an engine directly: the pool submits a
+*shadow* request and pumps its stream into the client's, which is the
+interception point failover needs (the engine's terminal "error" post
+must not reach the consumer when a survivor can finish the request).
+
+All pool state lives on the gateway's asyncio loop (the ``thread[pool]``
+lint context); engines' dispatch threads are reached only through their
+thread-safe submit/kill/liveness surfaces.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Callable
+
+from ..engine import EngineConfig, EngineStats, GenRequest, TPUEngine, probe_devices
+from ..parallel import mesh_shape_from_string
+from .health import HealthMonitor
+from .router import ReplicaRouter
+
+logger = logging.getLogger(__name__)
+
+
+def partition_devices(devices: list, n: int) -> list[list]:
+    """Split the device list into n replica meshes.
+
+    With at least n devices each replica gets an equal contiguous slice
+    (remainder devices are dropped with a warning — a 3-replica pool on
+    8 chips serves 2+2+2 and idles 2; pick divisors). With fewer devices
+    than replicas (CPU tests, single-chip dev boxes) every replica runs
+    the FULL set: correctness-identical, throughput shared."""
+    if n <= 1:
+        return [list(devices)]
+    if len(devices) >= n:
+        per = len(devices) // n
+        dropped = len(devices) - per * n
+        if dropped:
+            logger.warning(
+                "engine pool: %d device(s) idle (%d devices / %d replicas)",
+                dropped, len(devices), n)
+        return [list(devices[i * per:(i + 1) * per]) for i in range(n)]
+    logger.info("engine pool: %d replicas sharing %d device(s) "
+                "(test/dev topology)", n, len(devices))
+    return [list(devices) for _ in range(n)]
+
+
+@dataclass
+class PoolRecord:
+    """One logical client request as the pool tracks it: the client-facing
+    GenRequest (never submitted to any engine) plus the engine-facing
+    shadow currently serving it."""
+    request: GenRequest
+    shadow: GenRequest
+    replica: "EngineReplica"
+    attempts: int = 1            # dispatches so far (1 = never requeued)
+    pump: asyncio.Task | None = None
+    done: bool = False
+
+
+class EngineReplica:
+    """One engine plus the pool's view of it."""
+
+    STATES = ("ready", "draining", "reloading", "dead")
+
+    def __init__(self, rid: str, index: int, engine: TPUEngine) -> None:
+        self.id = rid
+        self.index = index
+        self.engine = engine
+        self.state = "ready"
+        self.outstanding: dict[str, PoolRecord] = {}
+        self.routed = 0
+        self.requeued_off = 0
+        self.reloads = 0
+        self.failures = 0
+        self.last_failure = ""
+
+    def outstanding_tokens(self) -> int:
+        """Budgeted work still owed: the router's least-loaded signal."""
+        return sum(max(0, rec.request.max_tokens - len(rec.request.generated))
+                   for rec in self.outstanding.values())
+
+    def status(self) -> dict[str, Any]:
+        engine = self.engine
+        stats = engine.stats
+        return {
+            "id": self.id,
+            "state": self.state,
+            "model": engine.config.model,
+            "mesh_devices": int(engine.mesh.size),
+            "dispatch_alive": engine.dispatch_alive(),
+            "heartbeat_age_s": round(engine.heartbeat_age(), 3),
+            # occupancy: slots carrying work right now vs capacity
+            "occupancy": len(engine._running) + len(engine._chunking),
+            "max_batch": engine.config.max_batch,
+            "outstanding": len(self.outstanding),
+            "outstanding_tokens": self.outstanding_tokens(),
+            "kv_pages_in_use": engine.allocator.pages_in_use,
+            "queue_depth": stats.queue_depth,
+            "requests": stats.requests,
+            "completion_tokens": stats.completion_tokens,
+            "decode_steps": stats.decode_steps,
+            "engine_restarts": stats.engine_restarts,
+            "routed": self.routed,
+            "requeued_off": self.requeued_off,
+            "reloads": self.reloads,
+            "failures": self.failures,
+            "last_failure": self.last_failure,
+        }
+
+
+class EnginePool:
+    """N TPUEngine replicas behind the single-engine serving surface."""
+
+    def __init__(self, config: EngineConfig, replicas: int = 2,
+                 tracer=None, metrics=None,
+                 affinity_routing: bool = True,
+                 health_interval_s: float = 0.5,
+                 heartbeat_timeout_s: float = 10.0,
+                 requeue_max: int = 2,
+                 devices: list | None = None,
+                 engine_factory: Callable[..., TPUEngine] | None = None):
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self.config = config
+        self.tracer = tracer
+        self.metrics = metrics
+        self.requeue_max = max(0, requeue_max)
+        self._factory = engine_factory or (
+            lambda cfg, tracer, metrics, devices: TPUEngine(
+                cfg, tracer=tracer, metrics=metrics, devices=devices))
+        if devices is None:
+            devices = probe_devices(config.init_timeout_s)
+        self._device_sets = partition_devices(devices, replicas)
+        # an explicit tpu_local_mesh_shape is sized for the FULL machine;
+        # replicas get a device subset, so the spec would fail every
+        # per-replica make_mesh (e.g. "1x8" on a 2-replica v5e-8 pool
+        # where each replica holds 4 chips). Fall back to the auto mesh
+        # (1 x subset) rather than refusing to boot.
+        self._mesh_shape = config.mesh_shape
+        if self._mesh_shape and replicas > 1:
+            per = len(self._device_sets[0])
+            try:
+                mesh_shape_from_string(self._mesh_shape, per)
+            except ValueError:
+                logger.warning(
+                    "engine pool: mesh shape %r does not fit the %d "
+                    "device(s) each of %d replicas receives — using the "
+                    "auto (1, %d) mesh per replica",
+                    self._mesh_shape, per, replicas, per)
+                self._mesh_shape = ""
+        self.replicas: list[EngineReplica] = []
+        for i in range(replicas):
+            self.replicas.append(
+                EngineReplica(str(i), i, self._build_engine(i)))
+        self.router = ReplicaRouter(affinity=affinity_routing)
+        self.health = HealthMonitor(self, interval_s=health_interval_s,
+                                    heartbeat_timeout_s=heartbeat_timeout_s)
+        self.tokenizer = self.replicas[0].engine.tokenizer
+        self.requeues = 0            # lint: thread[pool]
+        self._started = False        # lint: thread[pool]
+        self._stopping = False       # lint: thread[pool]
+        self._set_up_gauges()
+
+    def _build_engine(self, index: int) -> TPUEngine:
+        cfg = dataclasses.replace(self.config, replica_id=str(index),
+                                  mesh_shape=self._mesh_shape)
+        return self._factory(cfg, self.tracer, self.metrics,
+                             self._device_sets[index])
+
+    # --------------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:  # lint: runs-on[pool]
+        if self._started:
+            return
+        self._started = True
+        self._stopping = False
+        for replica in self.replicas:
+            if replica.state == "ready":
+                await replica.engine.start()
+        await self.health.start()
+
+    async def stop(self) -> None:  # lint: runs-on[pool]
+        self._stopping = True
+        self._started = False
+        await self.health.stop()
+        for replica in self.replicas:
+            try:
+                await replica.engine.stop()
+            except Exception:
+                logger.exception("engine pool: replica %s stop failed",
+                                 replica.id)
+
+    def warmup(self, mode: str | None = None) -> None:
+        """Precompile every replica's shape grid (bench/boot path)."""
+        for replica in self.replicas:
+            replica.engine.warmup(mode)
+
+    # -------------------------------------------------------------- submission
+
+    async def submit(self, request: GenRequest) -> GenRequest:  # lint: runs-on[pool]
+        """Route and dispatch one request; same contract as
+        TPUEngine.submit (tokens arrive on request.stream, None-terminated,
+        finish_reason filled)."""
+        await self._dispatch(request, attempts=1)
+        return request
+
+    async def generate(self, prompt_ids: list[int],
+                       **kwargs) -> AsyncIterator[int]:  # lint: runs-on[pool]
+        from ...utils.ids import new_id
+        request = GenRequest(request_id=new_id(), prompt_ids=prompt_ids,
+                             **kwargs)
+        await self.submit(request)
+        while True:
+            token = await request.stream.get()
+            if token is None:
+                break
+            yield token
+
+    def cancel(self, request_id: str) -> bool:  # lint: runs-on[pool]
+        """Cancel a logical request wherever the router placed it. The
+        record is keyed by the CLIENT-facing id; the engine is told the
+        shadow's id (which carries a ``~rN`` suffix after a requeue), so
+        post-failover requests stay cancellable by their original id.
+        The engine posts the ``cancelled`` terminal through the normal
+        stream path, which the pump forwards to the client."""
+        for replica in self.replicas:
+            record = replica.outstanding.get(request_id)
+            if record is not None:
+                return replica.engine.request_cancel(
+                    record.shadow.request_id)
+        return False
+
+    def _routable(self) -> list[EngineReplica]:
+        return [r for r in self.replicas if r.state == "ready"]
+
+    async def _dispatch(self, request: GenRequest, attempts: int) -> None:
+        """Pick a replica, submit the shadow, start the pump. Retries
+        across replicas when a submit itself fails (racing a crash)."""
+        last_error: Exception | None = None
+        for _ in range(len(self.replicas)):
+            routable = self._routable()
+            if not routable:
+                break
+            replica, affinity_hit = self.router.route(routable,
+                                                      request.prompt_ids)
+            shadow = self._make_shadow(request, attempts)
+            record = PoolRecord(request=request, shadow=shadow,
+                                replica=replica, attempts=attempts)
+            try:
+                await replica.engine.submit(shadow)
+            except RuntimeError as exc:
+                # dispatch thread died between the health sweep and now:
+                # mark it so the router stops offering it, try the next
+                last_error = exc
+                self.fail_replica(replica, reason="submit refused: "
+                                  f"{exc}")
+                continue
+            if replica.state == "dead":
+                # the health sweep failed the replica while submit awaited
+                # backpressure and has already swept its outstanding map —
+                # registering now would park the record on a corpse no
+                # sweep revisits. Abandon the shadow (the dead engine's
+                # terminal lands in it unobserved) and route a fresh one.
+                last_error = RuntimeError(
+                    f"replica {replica.id} died during submit")
+                continue
+            replica.routed += 1
+            replica.outstanding[request.request_id] = record
+            record.pump = asyncio.get_running_loop().create_task(
+                self._pump(record), name=f"pool-pump-{request.request_id}")
+            m = self.metrics
+            if m is not None:
+                m.llm_pool_routed.labels(
+                    replica=replica.id,
+                    affinity="hit" if affinity_hit else "miss").inc()
+                m.llm_pool_outstanding.labels(replica=replica.id).set(
+                    len(replica.outstanding))
+            return
+        # no replica could take it
+        logger.error("engine pool: no routable replica for %s (%s)",
+                     request.request_id, last_error)
+        if request.finish_reason is None:
+            request.finish_reason = "error"
+        request.stream.put_nowait(None)
+
+    def _make_shadow(self, request: GenRequest, attempts: int) -> GenRequest:
+        """The engine-facing request. On a requeue the prompt is the
+        CONTINUATION — original prompt plus every token already delivered
+        — so the survivor resumes where the failed replica stopped and
+        nothing is emitted twice; ``queue_observed`` rides the engine's
+        once-only guard so the logical request's queue phase is observed
+        exactly once across attempts."""
+        suffix = "" if attempts == 1 else f"~r{attempts - 1}"
+        return GenRequest(
+            request_id=f"{request.request_id}{suffix}",
+            prompt_ids=list(request.prompt_ids) + list(request.generated),
+            max_tokens=max(1, request.max_tokens - len(request.generated)),
+            temperature=request.temperature,
+            top_k=request.top_k,
+            top_p=request.top_p,
+            stop_ids=request.stop_ids,
+            priority=request.priority,
+            created=request.created,
+            trace_ctx=request.trace_ctx,
+            queue_observed=attempts > 1,
+            # once-only TTFT/llm.prefill: if the failed attempt already
+            # delivered a first token, the logical request's TTFT has
+            # been observed — the continuation must not observe a second
+            # sample spanning the failed attempt + failover
+            ttft_observed=len(request.generated) > 0,
+        )
+
+    async def _pump(self, record: PoolRecord) -> None:
+        """Forward the shadow's tokens to the client stream; on the
+        terminal, either finish the client or hand the record to the
+        failover path. Cancelled (without side effects) when the health
+        monitor takes over a failed replica's records."""
+        shadow = record.shadow
+        request = record.request
+        while True:
+            token = await shadow.stream.get()
+            if token is None:
+                break
+            request.generated.append(token)
+            request.stream.put_nowait(token)
+        await self._on_shadow_done(record)
+
+    async def _on_shadow_done(self, record: PoolRecord) -> None:
+        replica = record.replica
+        request = record.request
+        replica.outstanding.pop(request.request_id, None)
+        if self.metrics is not None:
+            self.metrics.llm_pool_outstanding.labels(
+                replica=replica.id).set(len(replica.outstanding))
+        reason = record.shadow.finish_reason or "stop"
+        if reason == "error" and not self._stopping:
+            # the engine only posts "error" terminals from its crash /
+            # fail-outstanding paths — treat it as replica evidence, then
+            # try to finish the request elsewhere
+            if not record.replica.engine.dispatch_alive():
+                self.fail_replica(replica,
+                                  reason="stream error + dead dispatch")
+            await self._requeue(record)
+            return
+        record.done = True
+        if request.finish_reason is None:
+            request.finish_reason = reason
+        request.stream.put_nowait(None)
+
+    # ---------------------------------------------------------------- failover
+
+    def fail_replica(self, replica: EngineReplica,
+                     reason: str = "") -> None:  # lint: runs-on[pool]
+        """Take a replica out of rotation and requeue its in-flight
+        requests. Idempotent; called by the health monitor (wedge/crash
+        sweep) and the submit/pump paths (stream evidence)."""
+        if replica.state == "dead":
+            return
+        replica.state = "dead"
+        replica.failures += 1
+        replica.last_failure = reason or "failed"
+        logger.error("engine pool: replica %s marked dead (%s)",
+                     replica.id, replica.last_failure)
+        if self.metrics is not None:
+            self.metrics.llm_pool_replica_up.labels(replica=replica.id).set(0)
+        # signal, never join: a wedged dispatch thread must not delay the
+        # requeue, and a zombie that later revives exits at its next loop
+        # check (its late emissions land in abandoned shadow streams)
+        replica.engine.kill()
+        survivors = self._take_over_records(replica)
+        if survivors:
+            asyncio.get_running_loop().create_task(
+                self._requeue_batch(survivors),
+                name=f"pool-requeue-{replica.id}")
+
+    def _take_over_records(self, replica: EngineReplica
+                           ) -> list[PoolRecord]:  # lint: runs-on[pool]
+        """Detach a replica's in-flight records from it: cancel the pumps,
+        forward whatever each shadow stream already holds (tokens the
+        consumer must not lose OR see twice), deliver any terminal that
+        raced the takeover, and return the records that still need a
+        home. Used by the failover sweep and by reload when a drain
+        times out with work still in flight."""
+        records = list(replica.outstanding.values())
+        replica.outstanding.clear()
+        if self.metrics is not None:
+            self.metrics.llm_pool_outstanding.labels(
+                replica=replica.id).set(0)
+        survivors: list[PoolRecord] = []
+        for record in records:
+            if record.pump is not None:
+                record.pump.cancel()
+            finished = self._drain_shadow(record)
+            if finished and (record.shadow.finish_reason or "stop") \
+                    != "error":
+                # the shadow actually completed (terminal raced the
+                # takeover): deliver it, nothing to requeue
+                record.done = True
+                if record.request.finish_reason is None:
+                    record.request.finish_reason = \
+                        record.shadow.finish_reason or "stop"
+                record.request.stream.put_nowait(None)
+                continue
+            survivors.append(record)
+        return survivors
+
+    def _drain_shadow(self, record: PoolRecord) -> bool:
+        """Forward whatever the failed replica already emitted into the
+        shadow stream (tokens the consumer must not lose OR see twice),
+        returning True if the terminal None was present."""
+        while True:
+            try:
+                token = record.shadow.stream.get_nowait()
+            except asyncio.QueueEmpty:
+                return False
+            if token is None:
+                return True
+            record.request.generated.append(token)
+            record.request.stream.put_nowait(token)
+
+    async def _requeue_batch(self, records: list[PoolRecord]) -> None:
+        for record in records:
+            await self._requeue(record)
+
+    async def _requeue(self, record: PoolRecord) -> None:
+        request = record.request
+        if record.done or request.finish_reason is not None:
+            return
+        old = record.replica
+        if len(request.generated) >= request.max_tokens:
+            # the failed replica had already emitted the full budget
+            record.done = True
+            request.finish_reason = "length"
+            request.stream.put_nowait(None)
+            return
+        if (self._stopping or record.attempts - 1 >= self.requeue_max
+                or not self._routable()):
+            record.done = True
+            request.finish_reason = "error"
+            request.stream.put_nowait(None)
+            return
+        self.requeues += 1
+        # counted here — not in fail_replica — so the status card's
+        # requeued_off and mcpforge_llm_pool_requeues_total agree no
+        # matter which path (health sweep or pump error terminal)
+        # triggered the requeue
+        old.requeued_off += 1
+        if self.metrics is not None:
+            self.metrics.llm_pool_requeues.labels(replica=old.id).inc()
+        logger.warning("engine pool: requeueing %s off replica %s "
+                       "(%d tokens already delivered)", request.request_id,
+                       old.id, len(request.generated))
+        await self._dispatch(request, attempts=record.attempts + 1)
+
+    # ------------------------------------------------------------ drain/reload
+
+    def _replica(self, rid: str) -> EngineReplica:
+        for replica in self.replicas:
+            if replica.id == rid:
+                return replica
+        raise KeyError(f"no replica {rid!r} "
+                       f"(have {[r.id for r in self.replicas]})")
+
+    async def drain(self, rid: str,  # lint: runs-on[pool]
+                    timeout_s: float = 60.0) -> dict[str, Any]:
+        """Stop routing new work to the replica and wait for its in-flight
+        requests to finish on it. Idempotent; ``undrain`` reverses."""
+        replica = self._replica(rid)
+        if replica.state == "ready":
+            replica.state = "draining"
+            if self.metrics is not None:
+                self.metrics.llm_pool_replica_up.labels(
+                    replica=replica.id).set(0)
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while replica.outstanding and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        status = replica.status()
+        status["drained"] = not replica.outstanding
+        return status
+
+    async def undrain(self, rid: str) -> dict[str, Any]:  # lint: runs-on[pool]
+        """Readmit a drained (or draining) replica to the router."""
+        replica = self._replica(rid)
+        if replica.state != "draining":
+            raise ValueError(
+                f"replica {rid} is {replica.state}, not draining")
+        replica.state = "ready"
+        if self.metrics is not None:
+            self.metrics.llm_pool_replica_up.labels(replica=replica.id).set(1)
+        return replica.status()
+
+    async def reload(self, rid: str,  # lint: runs-on[pool]
+                     timeout_s: float = 60.0) -> dict[str, Any]:
+        """Rolling weight hot-swap: drain -> rebuild the engine (fresh
+        checkpoint read from ``config.checkpoint``) -> readmit. The rest
+        of the pool serves throughout; a dead replica can be reloaded
+        too (that IS its recovery path)."""
+        replica = self._replica(rid)
+        if replica.state == "reloading":
+            raise ValueError(f"replica {rid} is already reloading")
+        was_dead = replica.state == "dead"
+        if not was_dead:
+            await self.drain(rid, timeout_s=timeout_s)
+            if replica.outstanding:
+                # the drain timed out with generations still running.
+                # engine.stop() would terminate them with
+                # finish_reason="cancelled" — a truncated stream for the
+                # client — while the rest of the pool could finish them
+                # exactly as the wedge/crash path does: hand the
+                # stragglers to the survivors as continuations. (The
+                # replica is already off the router: "draining".)
+                stragglers = self._take_over_records(replica)
+                if stragglers:
+                    logger.warning(
+                        "engine pool: reload of replica %s requeueing %d "
+                        "request(s) the drain window did not cover",
+                        rid, len(stragglers))
+                    await self._requeue_batch(stragglers)
+        replica.state = "reloading"
+        try:
+            await replica.engine.stop()
+        except Exception:
+            logger.exception("engine pool: replica %s stop during reload "
+                             "failed (continuing with rebuild)", rid)
+        # a kill()ed engine was never joined (stop() returns immediately
+        # once _started is false) and its zombie thread pins the old
+        # params + KV pool on the replica's devices; give it a bounded
+        # window to exit before committing a second footprint to the
+        # same HBM (docs/serving_pool.md, reload section)
+        thread = getattr(replica.engine, "_thread", None)
+        if thread is not None and thread.is_alive():
+            await asyncio.to_thread(thread.join, min(max(timeout_s, 0.0), 30.0))
+            if thread.is_alive():
+                logger.warning(
+                    "engine pool: replica %s dispatch thread is still "
+                    "wedged; rebuilding anyway — device memory may be "
+                    "double-committed until it exits", rid)
+        try:
+            # engine construction compiles + loads weights: off the loop
+            engine = await asyncio.to_thread(self._build_engine,
+                                             replica.index)
+        except Exception:
+            replica.state = "dead"
+            if self.metrics is not None:
+                self.metrics.llm_pool_replica_up.labels(
+                    replica=replica.id).set(0)
+            raise
+        replica.engine = engine
+        if self._started:
+            await engine.start()
+        replica.state = "ready"
+        replica.reloads += 1
+        if self.metrics is not None:
+            self.metrics.llm_pool_reloads.labels(replica=replica.id).inc()
+            self.metrics.llm_pool_replica_up.labels(replica=replica.id).set(1)
+        logger.info("engine pool: replica %s reloaded%s", rid,
+                    " (was dead)" if was_dead else "")
+        return replica.status()
+
+    # ------------------------------------------------------------- aggregation
+
+    @property
+    def stats(self) -> EngineStats:
+        """Aggregated scheduler counters across replicas (the facade the
+        bench and stats surfaces read; recomputed per access)."""
+        total = EngineStats()
+        for replica in self.replicas:
+            stats = replica.engine.stats
+            for name, value in vars(stats).items():
+                setattr(total, name, getattr(total, name) + value)
+        return total
+
+    def kv_pages_in_use(self) -> int:
+        return sum(r.engine.allocator.pages_in_use for r in self.replicas)
+
+    def kv_bytes_in_use(self) -> int:
+        return sum(r.engine.kv_bytes_in_use() for r in self.replicas)
+
+    def device_idle_fraction(self) -> float:
+        fracs = [r.engine.device_idle_fraction() for r in self.replicas]
+        return sum(fracs) / len(fracs) if fracs else 0.0
+
+    def status(self) -> dict[str, Any]:
+        """The /admin/engine/pool payload: per-replica health, occupancy,
+        and routing/failover counters."""
+        return {
+            "replicas": [r.status() for r in self.replicas],
+            "router": {**self.router.counters(),
+                       "affinity_routing": self.router.affinity_routing},
+            "requeues": self.requeues,
+            "requeue_max": self.requeue_max,
+            "health": {
+                "sweeps": self.health.sweeps,
+                "failures": self.health.failures,
+                "interval_s": self.health.interval_s,
+                "heartbeat_timeout_s": self.health.heartbeat_timeout_s,
+            },
+        }
+
+    def _set_up_gauges(self) -> None:
+        if self.metrics is None:
+            return
+        for replica in self.replicas:
+            self.metrics.llm_pool_replica_up.labels(replica=replica.id).set(1)
+            self.metrics.llm_pool_outstanding.labels(replica=replica.id).set(0)

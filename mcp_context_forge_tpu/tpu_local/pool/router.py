@@ -1,0 +1,82 @@
+"""Replica routing policy for the EnginePool.
+
+Two signals, in order ("A System for Microserving of LLMs",
+arXiv:2412.12488 — context-aware routing over disaggregated engines;
+xLLM's scheduler makes the same trade):
+
+1. **prefix-cache affinity** — each replica owns its own KV pool and
+   prefix cache, so a request whose prompt prefix is resident on replica
+   R prefills only its suffix there and the full prompt anywhere else.
+   The probe reuses the engine's read-only ``allocator.probe_prefix``
+   (no page references taken — pending requests must never pin cache
+   pages). An affinity win only counts when it is worth at least one
+   full page: sub-page "hits" save nothing (the engine re-buckets them
+   away at admission).
+2. **least outstanding decode tokens** — among equally-affine replicas,
+   route to the one with the least budgeted work (sum over in-flight
+   requests of their remaining ``max_tokens``), the pool's proxy for
+   time-to-first-slot. Ties break round-robin so cold starts spread.
+
+Priority rides THROUGH the router untouched: admission classes are a
+per-replica scheduler concern (the engine's priority-sorted pending
+queue), not a placement one — a pool that sent all priority-0 traffic
+to one replica would serialize exactly the requests that most want
+spare capacity.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Sequence
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from .pool import EngineReplica
+
+
+class ReplicaRouter:
+    """Scores routable replicas; owns the routing counters the admin
+    surface reports. Runs on the gateway loop (submit path)."""
+
+    def __init__(self, affinity: bool = True) -> None:
+        self.affinity_routing = affinity
+        self.routed = 0           # lint: thread[pool]
+        self.affinity_hits = 0    # lint: thread[pool]
+        self._rr = 0              # round-robin tiebreak cursor  # lint: thread[pool]
+
+    def route(self, replicas: Sequence["EngineReplica"],  # lint: runs-on[pool]  # lint: hot-path
+              prompt_ids: list[int]) -> tuple["EngineReplica", bool]:
+        """Pick a replica for ``prompt_ids`` among ``replicas`` (already
+        filtered to routable ones, non-empty). Returns (replica,
+        affinity_hit). On the submit hot path: pure host-side scoring,
+        no device sync."""
+        if len(replicas) == 1:
+            choice, hit = replicas[0], False
+        else:
+            choice, hit = self._score(replicas, prompt_ids)
+        self.routed += 1
+        if hit:
+            self.affinity_hits += 1
+        return choice, hit
+
+    def _score(self, replicas: Sequence["EngineReplica"],
+               prompt_ids: list[int]) -> tuple["EngineReplica", bool]:
+        best = None
+        best_key = None
+        best_hist = 0
+        self._rr += 1
+        for i, replica in enumerate(replicas):
+            hist = 0
+            if self.affinity_routing:
+                engine = replica.engine
+                if engine.config.prefix_cache:
+                    hist = engine.allocator.probe_prefix(prompt_ids)
+                    if hist < engine.config.page_size:
+                        hist = 0  # sub-page match saves no prefill
+            # max affinity, then min outstanding tokens, then round-robin
+            key = (-hist, replica.outstanding_tokens(),
+                   (i + self._rr) % len(replicas))
+            if best_key is None or key < best_key:
+                best, best_key, best_hist = replica, key, hist
+        return best, best_hist > 0
+
+    def counters(self) -> dict[str, int]:
+        return {"routed": self.routed, "affinity_hits": self.affinity_hits}

@@ -102,9 +102,15 @@ class _EncoderBatcher:
 
 
 class TPULocalProvider(LLMProvider):
+    """``engine`` is anything speaking the engine serving surface —
+    a single :class:`TPUEngine` or an :class:`~..pool.EnginePool` of N
+    replicas (submit/generate/stop/tokenizer/config/kv_pages_in_use);
+    the provider is pool-agnostic: routing, failover, and drain/reload
+    all live below this seam."""
+
     provider_type = "tpu_local"
 
-    def __init__(self, name: str, engine: TPUEngine,
+    def __init__(self, name: str, engine: "TPUEngine | Any",
                  embedding_model: str = "encoder-tiny",
                  tracer=None, metrics=None,
                  encoder_max_batch: int = 32,
@@ -200,7 +206,9 @@ class TPULocalProvider(LLMProvider):
         self.metrics.llm_tokens.labels(model=model, kind="completion").inc(
             completion_tokens)
         self.metrics.llm_requests.labels(model=model, status=status).inc()
-        self.metrics.llm_kv_pages_in_use.set(self.engine.kv_pages_in_use())
+        # kv_pages_in_use is replica-labeled and written by each engine's
+        # own step path; a provider-level aggregate write would stomp the
+        # per-replica series under a pool
 
     async def chat(self, request: dict[str, Any]) -> dict[str, Any]:
         gen = self._prepare(request)

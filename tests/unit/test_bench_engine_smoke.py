@@ -50,6 +50,26 @@ def test_bench_engine_serial_arm(bench_env, monkeypatch):
     assert out["value"] > 0
 
 
+def test_bench_engine_replica_pool_arm(bench_env, monkeypatch):
+    """BENCH_REPLICAS=2: the same client load through an EnginePool of 2
+    CPU replicas — aggregate tok/s plus per-replica occupancy report."""
+    import bench_engine
+
+    monkeypatch.setenv("BENCH_REPLICAS", "2")
+    out = asyncio.run(bench_engine.run("cpu"))
+    assert out["value"] > 0
+    assert out["replicas"] == 2
+    pool = out["pool"]
+    assert pool["router"]["routed"] >= 2  # every client got routed
+    per = pool["per_replica"]
+    assert [p["id"] for p in per] == ["0", "1"]
+    # every timed token is accounted to the two replicas (the prime
+    # request before the timed region may add a few on top)
+    assert sum(p["completion_tokens"] for p in per) >= out["tokens"]
+    assert abs(sum(p["occupancy_share"] for p in per) - 1.0) < 0.01
+    assert pool["requeues"] == 0  # no failovers on a healthy run
+
+
 def test_bench_engine_kv_quant_ab_arm(bench_env, monkeypatch):
     """BENCH_KV_QUANT=1: both storage arms run at the same byte budget and
     the report carries capacity ratio + greedy token-parity rate."""

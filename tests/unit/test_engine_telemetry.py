@@ -68,6 +68,9 @@ def test_engine_emits_queue_prefill_decode_spans(telemetry_engine):
     decode = spans["llm.decode"]
     assert decode.attributes["gen_ai.usage.completion_tokens"] == len(tokens)
     assert decode.attributes["llm.finish_reason"] in ("stop", "length")
+    # replica identity rides every engine span (pool-separable traces)
+    assert prefill.attributes["llm.replica_id"] == "0"
+    assert decode.attributes["llm.replica_id"] == "0"
 
 
 def test_engine_without_telemetry_handles_is_silent(telemetry_engine):
@@ -89,16 +92,24 @@ def test_slo_metrics_and_stable_labels(telemetry_engine):
     _generate(engine, prompt="measure me", max_tokens=8)
     body, _ = metrics.render()
     text = body.decode()
-    # histograms carry samples with the model label
-    assert 'mcpforge_llm_ttft_seconds_count{model="llama3-test"}' in text
-    assert 'mcpforge_llm_tpot_seconds_count{model="llama3-test"}' in text
+    # histograms carry samples with the model + replica labels
+    assert ('mcpforge_llm_ttft_seconds_count'
+            '{model="llama3-test",replica="0"}') in text
+    assert ('mcpforge_llm_tpot_seconds_count'
+            '{model="llama3-test",replica="0"}') in text
+    assert 'mcpforge_llm_dispatch_gap_seconds_count{replica="0"}' in text
+    assert 'mcpforge_llm_kv_bytes_in_use{replica="0"}' in text
     assert "mcpforge_llm_queue_wait_seconds_count" in text
-    # gauges exist and KV utilization stays in [0, 1]
+    # engine-fed gauges are replica-labeled (gauges are last-writer-wins,
+    # so a pool's replicas must not share one series) and KV utilization
+    # stays in [0, 1]
     util = [line for line in text.splitlines()
-            if line.startswith("mcpforge_llm_kv_page_utilization ")]
+            if line.startswith(
+                'mcpforge_llm_kv_page_utilization{replica="0"} ')]
     assert util and 0.0 <= float(util[0].split()[-1]) <= 1.0
-    assert "mcpforge_llm_batch_occupancy" in text
-    assert "mcpforge_llm_step_tokens_per_sec" in text
+    assert 'mcpforge_llm_batch_occupancy{replica="0"}' in text
+    assert 'mcpforge_llm_step_tokens_per_sec{replica="0"}' in text
+    assert 'mcpforge_llm_queue_depth{replica="0"}' in text
 
     def count_of(metric: str) -> float:
         for line in text.splitlines():
@@ -106,8 +117,10 @@ def test_slo_metrics_and_stable_labels(telemetry_engine):
                 return float(line.split()[-1])
         return 0.0
 
-    assert count_of('mcpforge_llm_ttft_seconds_count{model="llama3-test"}') >= 1
-    assert count_of('mcpforge_llm_tpot_seconds_count{model="llama3-test"}') >= 1
+    assert count_of('mcpforge_llm_ttft_seconds_count'
+                    '{model="llama3-test",replica="0"}') >= 1
+    assert count_of('mcpforge_llm_tpot_seconds_count'
+                    '{model="llama3-test",replica="0"}') >= 1
 
 
 def test_step_ring_buffer_bounded_and_shaped(telemetry_engine):
@@ -190,8 +203,10 @@ async def test_gateway_http_span_is_ancestor_of_llm_request():
         # /metrics exposition carries non-zero SLO histograms + gauges
         resp = await gateway.get("/metrics/prometheus", auth=auth)
         text = await resp.text()
-        assert 'mcpforge_llm_ttft_seconds_count{model="llama3-test"}' in text
-        assert 'mcpforge_llm_tpot_seconds_count{model="llama3-test"}' in text
+        assert ('mcpforge_llm_ttft_seconds_count'
+                '{model="llama3-test",replica="0"}') in text
+        assert ('mcpforge_llm_tpot_seconds_count'
+                '{model="llama3-test",replica="0"}') in text
         assert "mcpforge_llm_kv_page_utilization" in text
 
         # step-introspection endpoint returns the last N step summaries
