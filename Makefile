@@ -1,6 +1,6 @@
 # mcp-context-forge-tpu (reference: 8.7k-line Makefile; the targets that matter)
 
-.PHONY: serve hub lint test test-py test-fast test-two-process bench bench-engine wrapper masking clean \
+.PHONY: serve hub lint bench-check test test-py test-fast test-two-process bench bench-engine wrapper masking clean \
 	sanitize sanitize-tsan sanitize-asan
 
 serve:
@@ -25,8 +25,15 @@ compose-config:
 lint:
 	python -m mcp_context_forge_tpu.tools.lint mcp_context_forge_tpu
 
-# full gate: lint + python suite + the C++ tier under TSAN and ASAN/UBSAN
-test: lint test-py sanitize
+# bench-history trend gate (pure stdlib, like lint): fails on
+# tolerance-breaking regressions of tok/s, hbm_roofline_frac, or p95
+# latency across the checked-in BENCH_*.json rounds
+bench-check:
+	python -m mcp_context_forge_tpu.tools.bench_trend
+
+# full gate: lint + bench trend + python suite + the C++ tier under TSAN
+# and ASAN/UBSAN
+test: lint bench-check test-py sanitize
 
 test-py:
 	python -m pytest tests/ -q

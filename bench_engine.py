@@ -50,8 +50,11 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from bench import pin_platform  # noqa: E402
 
-V5E_PEAK_BF16_TFLOPS = 197.0  # per chip
-V5E_HBM_GBPS = 819.0          # per chip
+# roofline peaks: single source of truth shared with the engine's live
+# gauges (tpu_local/roofline.py is jax-free, so importing it here cannot
+# pin the platform before pin_platform runs)
+from mcp_context_forge_tpu.tpu_local.roofline import (  # noqa: E402
+    V5E_HBM_GBPS, V5E_PEAK_BF16_TFLOPS)
 
 
 def count_params(config) -> int:
@@ -81,6 +84,9 @@ async def run(platform: str, kv_quant: str = "") -> dict:
     # serial dispatch->device_get->bookkeeping loop, =1 (default) overlaps
     # host work behind device execution
     overlap = os.environ.get("BENCH_OVERLAP", "1") == "1"
+    # BENCH_SAMPLE_EVERY=N: decode-step phase attribution every Nth step
+    # (the bench then reports the sampled phase rows alongside tok/s)
+    sample_every = int(os.environ.get("BENCH_SAMPLE_EVERY", "0"))
     quant = os.environ.get("BENCH_QUANT", "")
     buckets = os.environ.get("BENCH_BATCH_BUCKETS", "0") == "1"
     moe_impl = os.environ.get("BENCH_MOE_IMPL", "")
@@ -101,6 +107,7 @@ async def run(platform: str, kv_quant: str = "") -> dict:
                           dtype="bfloat16" if platform == "tpu" else "float32",
                           attn_impl="auto", decode_block=decode_block,
                           decode_overlap=overlap,
+                          step_sample_every=sample_every,
                           spec_decode=spec, quant=quant, kv_quant=kv_quant,
                           batch_buckets=buckets, moe_impl=moe_impl,
                           moe_block=moe_block,
@@ -195,6 +202,19 @@ async def run(platform: str, kv_quant: str = "") -> dict:
                                      if intervals else None),
         }
         out["replicas"] = replicas
+        # live-observability twins of the post-hoc numbers below: the
+        # warmup-captured cost-model roofline over the run's decode
+        # window, XLA compile attribution (serving count must be 0 on a
+        # warmed engine), and — under BENCH_SAMPLE_EVERY — the last few
+        # sampled phase-attribution rows
+        eng0 = engine.replicas[0].engine if replicas > 1 else engine
+        out["live_roofline"] = eng0.roofline_snapshot()
+        out["xla_compiles"] = {k: v for k, v in eng0.compile_stats().items()
+                               if k != "recent"}
+        if sample_every:
+            out["sample_every"] = sample_every
+            out["phase_rows"] = [s["phases"] for s in eng0.recent_steps()
+                                 if s.get("phases")][-8:]
         if replicas > 1:
             # pool arm: aggregate tok/s is `value` above (the clients'
             # wall covers the whole pool); per-replica occupancy shows

@@ -28,6 +28,12 @@ case "$1" in
     shift
     exec python -m mcp_context_forge_tpu.tools.lint "$@"
     ;;
+  bench-check)
+    # bench-history trend gate (tools/bench_trend.py): non-zero exit on
+    # tolerance-breaking regressions across the BENCH_*.json rounds
+    shift
+    exec python -m mcp_context_forge_tpu.tools.bench_trend "$@"
+    ;;
   serve|supervise|hub|token|version)
     cmd="$1"; shift
     if [ "$cmd" = "hub" ]; then

@@ -434,6 +434,26 @@ class Settings(BaseModel):
     # step-introspection ring size (per-dispatch summaries served by
     # GET /admin/engine/steps)
     tpu_local_step_log_size: int = 256
+    # --- decode-step attribution & live roofline (docs/observability.md,
+    # "Step attribution, live roofline, and SLOs") ---
+    # every Nth decode dispatch runs serially with a timed
+    # block_until_ready window and splits into host-dispatch/table-sync/
+    # device-compute/read-back/emission phases (step ring + Prometheus +
+    # llm.decode span events); 0 = off, steady-state traffic unperturbed
+    tpu_local_step_sample_every: int = 0
+    # capture XLA cost_analysis() per warmed executable so live step
+    # timing feeds mcpforge_llm_mfu / mcpforge_llm_hbm_roofline_frac
+    tpu_local_cost_analysis: bool = True
+    # per-chip roofline peaks the live gauges divide by (defaults: v5e)
+    tpu_local_peak_tflops_per_chip: float = 197.0
+    tpu_local_hbm_gbps_per_chip: float = 819.0
+    # --- serving SLOs (GET /admin/slo, observability/slo.py) ---
+    # p95 targets per objective; burn rate = fraction of window samples
+    # over target / error budget (>1 means the budget is burning down)
+    slo_ttft_p95_ms: float = 2500.0
+    slo_tpot_p95_ms: float = 250.0
+    slo_queue_wait_p95_ms: float = 1500.0
+    slo_error_budget: float = 0.05
     # --- engine replica pool (tpu_local/pool/, docs/serving_pool.md) ---
     # N > 1 serves LLM traffic from N engine replicas on device-subset
     # meshes (e.g. 2 replicas x 4 chips on a v5e-8) behind an

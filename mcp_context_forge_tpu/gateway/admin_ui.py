@@ -185,6 +185,13 @@ function cell(v, isBool){
   if (typeof v === "object") return esc(JSON.stringify(v).slice(0,80));
   return esc(String(v).slice(0,100));  // API data is attacker-influenced
 }
+function fnum(v){
+  // roofline fractions live at 1e-2..1e-8 (MFU 0.00018 is the headline
+  // production number) — cell()'s 2-decimal rounding would zero them
+  if (v === null || v === undefined || typeof v !== "number") return cell(v);
+  if (v !== 0 && Math.abs(v) < 0.01) return v.toExponential(2);
+  return Math.round(v*10000)/10000;
+}
 async function renderEngine(stats){
   const order = ["requests","prompt_tokens","completion_tokens","decode_steps",
                  "prefill_batches","queue_depth","chunking","kv_pages_in_use",
@@ -223,24 +230,59 @@ async function renderEngine(stats){
         + `<th>actions</th></tr>${pbody}</table>`;
     }
   } catch(e){}
+  // serving SLO verdicts (percentiles + burn rate vs error budget)
+  let slo = "";
+  try {
+    const sr = await fetch("/admin/slo?window=admin-ui");
+    if (sr.ok){
+      const s = await sr.json();
+      const scols = ["name","target_ms","window_p_ms","cumulative_p_ms",
+                     "window_samples","fraction_over_target","burn_rate","ok"];
+      const sbody = (s.objectives || []).map(o =>
+        "<tr>" + scols.map(c => `<td>${
+          c === "fraction_over_target" || c === "burn_rate"
+            ? fnum(o[c]) : cell(o[c])
+        }</td>`).join("") + "</tr>"
+      ).join("");
+      if (sbody) slo = `<br><h3>serving SLOs ${s.ok
+          ? '<span class="pill ok">within budget</span>'
+          : '<span class="pill bad">burning</span>'}</h3><table><tr>`
+        + scols.map(c => `<th>${esc(c)}</th>`).join("")
+        + `</tr>${sbody}</table>`;
+    }
+  } catch(e){}
   // step introspection: what the scheduler dispatched last (newest first)
   let steps = "";
   try {
     const r = await fetch("/admin/engine/steps?limit=32");
     if (r.ok){
       const intro = await r.json();
+      // compile tracking + live roofline summary cards (a serving-stage
+      // XLA compile on a warmed engine is the mid-traffic catastrophe)
+      const xc = intro.xla_compiles || {};
+      const rf = intro.roofline || {};
+      steps = `<br><h3>step attribution &amp; roofline</h3>
+        <div class="cards">
+          <div class="card"><b>${cell((xc.serving||{}).count)}</b><span>serving_xla_compiles</span></div>
+          <div class="card"><b>${cell((xc.warmup||{}).count)}</b><span>warmup_xla_compiles</span></div>
+          <div class="card"><b>${fnum(rf.mfu)}</b><span>live_mfu</span></div>
+          <div class="card"><b>${fnum(rf.hbm_roofline_frac)}</b><span>live_hbm_roofline_frac</span></div>
+          <div class="card"><b>${cell((intro.phase_sampling||{}).samples)}</b><span>phase_samples</span></div>
+        </div>`;
       const cols = ["seq","kind","batch","width","bucket","ctx_pages",
-                    "duration_ms","gap_ms","tokens","queue_depth",
-                    "kv_pages_in_use"];
+                    "duration_ms","gap_ms","tokens","mfu","hbm_frac",
+                    "phases","queue_depth","kv_pages_in_use"];
       const body = (intro.steps || []).slice().reverse().map(s =>
-        "<tr>" + cols.map(c => `<td>${cell(s[c])}</td>`).join("") + "</tr>"
+        "<tr>" + cols.map(c => `<td>${
+          c === "mfu" || c === "hbm_frac" ? fnum(s[c]) : cell(s[c])
+        }</td>`).join("") + "</tr>"
       ).join("");
-      if (body) steps = `<br><h3>recent engine steps</h3><table><tr>`
+      if (body) steps += `<br><h3>recent engine steps</h3><table><tr>`
         + cols.map(c => `<th>${esc(c)}</th>`).join("") + `</tr>${body}</table>`;
     }
   } catch(e){}
   document.getElementById("view").innerHTML =
-    `<div class="cards">${cards}${extra}</div>${pool}${steps}
+    `<div class="cards">${cards}${extra}</div>${pool}${slo}${steps}
      <br><button class="act" onclick="engineProfile()">capture jax profile</button>
      <button class="act" onclick="engineProfileCtl('start')">start profile</button>
      <button class="act" onclick="engineProfileCtl('stop')">stop profile</button>

@@ -224,6 +224,12 @@ async def build_app(settings: Settings | None = None) -> web.Application:
             engine = TPUEngine(engine_config, tracer=tracer, metrics=metrics)
         from ..services.diagnostics_service import JaxProfilerCapture
         app["jax_profiler"] = JaxProfilerCapture(settings.jax_profile_dir)
+        # SLO verdicts over the engine's token-level histograms at
+        # GET /admin/slo (targets + error budget from settings)
+        from ..observability.slo import SloEvaluator, default_objectives
+        app["slo_evaluator"] = SloEvaluator(
+            metrics, default_objectives(settings),
+            error_budget=settings.slo_error_budget)
         provider = TPULocalProvider(
             "tpu_local", engine_pool if engine_pool is not None else engine,
             embedding_model=settings.tpu_local_embedding_model,

@@ -810,6 +810,23 @@ document.getElementById("f").onsubmit = async (e) => {
             },
         })
 
+    @routes.get("/admin/slo")
+    async def slo_status(request: web.Request) -> web.Response:
+        """Serving-SLO verdicts over the TTFT/TPOT/queue-wait histograms
+        (observability/slo.py): per-objective percentile estimates
+        (cumulative + window since the previous call), fraction of window
+        samples over target, and burn rate against the error budget.
+        ``?window=<name>`` names the caller's delta window (default
+        "default") — the admin UI polls its own so it cannot shred a
+        load harness's phase-length windows."""
+        request["auth"].require("observability.read")
+        evaluator = request.app.get("slo_evaluator")
+        if evaluator is None:
+            raise NotFoundError("SLO evaluation is not enabled "
+                                "(requires the tpu_local engine)")
+        consumer = request.query.get("window", "default")[:64] or "default"
+        return web.json_response(evaluator.evaluate(consumer=consumer))
+
     @routes.get("/admin/engine/pool")
     async def engine_pool_status(request: web.Request) -> web.Response:
         """Replica-pool topology card: per-replica health, occupancy, and

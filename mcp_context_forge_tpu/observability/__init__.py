@@ -17,5 +17,8 @@ from .tracing import (
     current_span,
 )
 from .metrics import PrometheusRegistry
+from .slo import SloEvaluator, SloObjective, default_objectives
 
-__all__ = ["Span", "Tracer", "get_tracer", "init_tracer", "current_span", "PrometheusRegistry"]
+__all__ = ["Span", "Tracer", "get_tracer", "init_tracer", "current_span",
+           "PrometheusRegistry", "SloEvaluator", "SloObjective",
+           "default_objectives"]

@@ -12,6 +12,33 @@ import time
 from typing import Any
 
 
+def trace_extra(trace_ctx: "tuple[str, str] | None") -> dict[str, Any]:
+    """``extra=`` kwargs that stamp a log record with an explicit
+    (trace_id, span_id) — for producers off the contextvar chain (the
+    engine dispatch thread, the pool's failover sweep) whose records must
+    still join to the OTel trace of the request they concern."""
+    if not trace_ctx:
+        return {}
+    return {"ctx": {"trace_id": trace_ctx[0], "span_id": trace_ctx[1]}}
+
+
+def _trace_fields(record: logging.LogRecord) -> tuple[str | None, str | None]:
+    """(trace_id, span_id) for a record: an explicit ``ctx`` extra wins
+    (cross-thread producers), else the contextvar-current span (gateway
+    request handlers), else nothing."""
+    ctx = getattr(record, "ctx", None)
+    if isinstance(ctx, dict) and ctx.get("trace_id"):
+        return ctx.get("trace_id"), ctx.get("span_id")
+    try:  # lazy: logging must work before/without the tracer
+        from .tracing import current_span
+        span = current_span()
+    except Exception:
+        span = None
+    if span is not None:
+        return span.trace_id, span.span_id
+    return None, None
+
+
 class JsonFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
         payload: dict[str, Any] = {
@@ -22,6 +49,11 @@ class JsonFormatter(logging.Formatter):
         }
         if record.exc_info:
             payload["exc"] = self.formatException(record.exc_info)
+        trace_id, span_id = _trace_fields(record)
+        if trace_id:
+            payload["trace_id"] = trace_id
+            if span_id:
+                payload["span_id"] = span_id
         extra = getattr(record, "ctx", None)
         if extra:
             payload.update(extra)
@@ -36,12 +68,18 @@ class RingBufferHandler(logging.Handler):
         self.records: collections.deque[dict[str, Any]] = collections.deque(maxlen=capacity)
 
     def emit(self, record: logging.LogRecord) -> None:
-        self.records.append({
+        entry = {
             "ts": record.created,
             "level": record.levelname,
             "logger": record.name,
             "message": record.getMessage(),
-        })
+        }
+        trace_id, span_id = _trace_fields(record)
+        if trace_id:
+            entry["trace_id"] = trace_id
+            if span_id:
+                entry["span_id"] = span_id
+        self.records.append(entry)
 
     def search(self, query: str = "", level: str | None = None, limit: int = 200) -> list[dict[str, Any]]:
         out = []

@@ -139,6 +139,50 @@ class PrometheusRegistry:
             "bookkeeping (0..1; ~0 with the overlapped pipeline)",
             ["replica"], registry=self.registry,
         )
+        # decode-step phase attribution (opt-in sampling via
+        # tpu_local_step_sample_every): how a sampled step's wall splits
+        # between host dispatch, block-table sync, device compute,
+        # read-back, and emission bookkeeping — the "where do the 87 ms
+        # go" histogram the roofline gap analysis needs
+        self.llm_step_phase = Histogram(
+            "mcpforge_llm_step_phase_seconds",
+            "Sampled decode-step phase durations (host_dispatch, "
+            "table_sync, device_compute, readback, emit)",
+            ["replica", "phase"], registry=self.registry,
+            buckets=(0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+                     0.005, 0.01, 0.025, 0.05, 0.1, 0.25),
+        )
+        # live roofline gauges: warmup-captured XLA cost_analysis()
+        # (FLOPs / bytes accessed per executable) divided by each decode
+        # step's measured wall — the bench-only MFU / hbm_roofline_frac
+        # numbers as always-on production signals (tpu_local/roofline.py)
+        self.llm_mfu = Gauge(
+            "mcpforge_llm_mfu",
+            "Model FLOPs utilization of the last decode step (XLA "
+            "cost-model FLOPs / wall / peak)",
+            ["replica"], registry=self.registry,
+        )
+        self.llm_hbm_roofline = Gauge(
+            "mcpforge_llm_hbm_roofline_frac",
+            "Fraction of the HBM-bandwidth roofline the last decode step "
+            "achieved (XLA cost-model bytes / wall / peak BW)",
+            ["replica"], registry=self.registry,
+        )
+        # XLA compile tracking (tpu_local/compile_events.py): a compile
+        # at stage="serving" on a warmed engine is the PR-5 silent
+        # catastrophe resurfacing — alert on it
+        self.llm_xla_compiles = Counter(
+            "mcpforge_llm_xla_compiles_total",
+            "XLA backend compilations attributed to the engine, by "
+            "lifecycle stage (warmup|serving)",
+            ["replica", "stage"], registry=self.registry,
+        )
+        self.llm_xla_compile_time = Histogram(
+            "mcpforge_llm_xla_compile_seconds",
+            "Duration of XLA backend compilations attributed to the engine",
+            ["replica"], registry=self.registry,
+            buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 180.0),
+        )
         # EnginePool (tpu_local/pool/) serving tier: per-replica health,
         # load, and routing outcomes — fed by the pool router/health
         # monitor on the gateway loop

@@ -1,0 +1,226 @@
+"""Serving SLO evaluation over the token-level Prometheus histograms.
+
+Turns the TTFT / TPOT / queue-wait histograms the engine already feeds
+into operator-facing objective verdicts at ``GET /admin/slo``: for each
+objective, the estimated percentile (cumulative since boot AND over the
+window since the previous evaluation), the fraction of window samples
+over target, and a burn rate against the configured error budget
+(fraction-over-target / budget — burn rate 1.0 means the budget is being
+consumed exactly as provisioned; >1 means the SLO is burning down).
+
+The evaluator is deliberately pull-based: it reads the histograms the
+engine writes (no second write path, nothing on the dispatch thread) and
+keeps one snapshot per objective so consecutive calls see window deltas.
+Percentiles are linear interpolation across bucket boundaries — the
+standard histogram_quantile estimate, good to a bucket width.
+
+This is the SLO-assertion seam ROADMAP item 5's load harness drives:
+scenario runs hit /admin/slo between phases instead of re-deriving
+percentiles from raw samples.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass
+from typing import Any
+
+
+@dataclass(frozen=True)
+class SloObjective:
+    """One latency objective: metric_attr names a Histogram attribute on
+    PrometheusRegistry; target_ms bounds the given percentile."""
+
+    name: str
+    metric_attr: str
+    percentile: float
+    target_ms: float
+
+
+def default_objectives(settings: Any) -> list[SloObjective]:
+    return [
+        SloObjective("ttft_p95", "llm_ttft", 0.95,
+                     float(settings.slo_ttft_p95_ms)),
+        SloObjective("tpot_p95", "llm_tpot", 0.95,
+                     float(settings.slo_tpot_p95_ms)),
+        SloObjective("queue_wait_p95", "llm_queue_wait", 0.95,
+                     float(settings.slo_queue_wait_p95_ms)),
+    ]
+
+
+def _histogram_state(metric: Any) -> tuple[dict[float, float], float]:
+    """(cumulative bucket counts summed across label children, total
+    count) for a prometheus_client Histogram."""
+    buckets: dict[float, float] = {}
+    count = 0.0
+    for family in metric.collect():
+        for sample in family.samples:
+            if sample.name.endswith("_bucket"):
+                le_raw = sample.labels.get("le", "+Inf")
+                le = math.inf if le_raw == "+Inf" else float(le_raw)
+                buckets[le] = buckets.get(le, 0.0) + sample.value
+            elif sample.name.endswith("_count"):
+                count += sample.value
+    return buckets, count
+
+
+def _delta(cur: dict[float, float], count: float,
+           prev: tuple[dict[float, float], float] | None
+           ) -> tuple[dict[float, float], float]:
+    if prev is None:
+        return dict(cur), count
+    prev_buckets, prev_count = prev
+    window = {le: max(0.0, c - prev_buckets.get(le, 0.0))
+              for le, c in cur.items()}
+    return window, max(0.0, count - prev_count)
+
+
+def _percentile_s(buckets: dict[float, float], count: float,
+                  q: float) -> float | None:
+    """Interpolated q-quantile in seconds; None when the histogram is
+    empty. Clamps to the last finite bucket bound when the quantile lands
+    in the +Inf bucket (the honest 'at least this' estimate)."""
+    if count <= 0.0 or not buckets:
+        return None
+    target = q * count
+    prev_le = 0.0
+    prev_cum = 0.0
+    last_finite = 0.0
+    for le in sorted(buckets):
+        cum = buckets[le]
+        if le != math.inf:
+            last_finite = le
+        if cum >= target:
+            if le == math.inf:
+                return last_finite if last_finite > 0.0 else None
+            span = cum - prev_cum
+            frac = (target - prev_cum) / span if span > 0.0 else 1.0
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_cum = le, cum
+    return last_finite if last_finite > 0.0 else None
+
+
+def _fraction_over(buckets: dict[float, float], count: float,
+                   threshold_s: float) -> float:
+    """Fraction of observations PROVABLY above threshold_s, interpolating
+    within the bucket the threshold falls into. When the threshold sits
+    beyond the last finite bucket bound, the +Inf-bucket mass is
+    indeterminate (somewhere between the bound and the threshold — the
+    histogram cannot tell which side) and must NOT read as a breach: a
+    target above the bucket range would otherwise report a permanent
+    false 'burning'. Callers surface that case via
+    :func:`_target_above_buckets` instead."""
+    if count <= 0.0 or not buckets:
+        return 0.0
+    prev_le = 0.0
+    prev_cum = 0.0
+    at_threshold = None
+    for le in sorted(buckets):
+        cum = buckets[le]
+        if le >= threshold_s:
+            if le == math.inf:
+                # threshold > every finite bound: +Inf mass is
+                # indeterminate, count nothing as provably over
+                at_threshold = cum
+            else:
+                span = cum - prev_cum
+                width = le - prev_le
+                frac = (threshold_s - prev_le) / width if width > 0.0 else 1.0
+                at_threshold = prev_cum + span * min(1.0, max(0.0, frac))
+            break
+        prev_le, prev_cum = le, cum
+    if at_threshold is None:
+        at_threshold = prev_cum
+    return max(0.0, min(1.0, (count - at_threshold) / count))
+
+
+def _target_above_buckets(buckets: dict[float, float],
+                          threshold_s: float) -> bool:
+    """True when the objective's target exceeds the histogram's top
+    finite bucket bound — breaches between the bound and the target are
+    unmeasurable, so the verdict is optimistic until the buckets are
+    widened (surfaced per objective so operators see it)."""
+    finite = [le for le in buckets if le != math.inf]
+    return bool(finite) and threshold_s > max(finite)
+
+
+class SloEvaluator:
+    """Stateful evaluator over one PrometheusRegistry. Call pattern is
+    pull (the /admin/slo handler); window percentiles/burn rates cover
+    the interval since the previous call BY THE SAME CONSUMER: windows
+    are keyed by a caller-supplied name, so the admin UI's 5 s poll
+    cannot shred the load harness's phase-length deltas (each consumer's
+    snapshot advances only on its own calls)."""
+
+    MAX_CONSUMERS = 16  # /admin/slo is auth-gated, but still bound it
+
+    def __init__(self, metrics: Any, objectives: list[SloObjective],
+                 error_budget: float = 0.05) -> None:
+        self.metrics = metrics
+        self.objectives = objectives
+        self.error_budget = max(1e-6, float(error_budget))
+        # consumer -> objective -> (buckets, count); consumer -> last ts
+        self._prev: dict[str, dict[str, tuple[dict[float, float], float]]] = {}
+        self._prev_ts: dict[str, float] = {}
+
+    def evaluate(self, consumer: str = "default") -> dict[str, Any]:
+        now = time.time()
+        if consumer not in self._prev and len(
+                self._prev) >= self.MAX_CONSUMERS:
+            # evict the staled-out consumer rather than grow unbounded
+            oldest = min(self._prev_ts, key=self._prev_ts.get)
+            self._prev.pop(oldest, None)
+            self._prev_ts.pop(oldest, None)
+        prev = self._prev.setdefault(consumer, {})
+        prev_ts = self._prev_ts.get(consumer)
+        window_s = (now - prev_ts) if prev_ts is not None else None
+        results: list[dict[str, Any]] = []
+        overall_ok = True
+        for obj in self.objectives:
+            metric = getattr(self.metrics, obj.metric_attr, None)
+            if metric is None:
+                continue
+            buckets, count = _histogram_state(metric)
+            win_buckets, win_count = _delta(buckets, count,
+                                            prev.get(obj.name))
+            prev[obj.name] = (buckets, count)
+            threshold_s = obj.target_ms / 1e3
+            cum_p = _percentile_s(buckets, count, obj.percentile)
+            win_p = _percentile_s(win_buckets, win_count, obj.percentile)
+            # burn rate over the freshest data available: the window when
+            # it has samples, else lifetime (first call / idle gateway)
+            frac_buckets, frac_count = ((win_buckets, win_count)
+                                        if win_count > 0 else (buckets, count))
+            over = _fraction_over(frac_buckets, frac_count, threshold_s)
+            burn_rate = over / self.error_budget
+            ok = burn_rate <= 1.0
+            overall_ok = overall_ok and ok
+            results.append({
+                # target beyond the top finite bucket: the fraction-over
+                # is optimistic (unmeasurable band) — widen the buckets
+                "target_above_buckets": _target_above_buckets(buckets,
+                                                              threshold_s),
+                "name": obj.name,
+                "metric": obj.metric_attr,
+                "percentile": obj.percentile,
+                "target_ms": obj.target_ms,
+                "cumulative_p_ms": (round(cum_p * 1e3, 3)
+                                    if cum_p is not None else None),
+                "window_p_ms": (round(win_p * 1e3, 3)
+                                if win_p is not None else None),
+                "window_samples": win_count,
+                "total_samples": count,
+                "fraction_over_target": round(over, 5),
+                "burn_rate": round(burn_rate, 4),
+                "ok": ok,
+            })
+        self._prev_ts[consumer] = now
+        return {
+            "ok": overall_ok,
+            "error_budget": self.error_budget,
+            "consumer": consumer,
+            "window_s": round(window_s, 3) if window_s is not None else None,
+            "evaluated_at": now,
+            "objectives": results,
+        }

@@ -110,12 +110,16 @@ class Tracer:
     def emit_span(self, name: str, start_ts: float, end_ts: float,
                   trace_ctx: tuple[str, str] | None = None,
                   attributes: dict[str, Any] | None = None,
-                  status: str = "OK") -> Span:
+                  status: str = "OK",
+                  events: list[tuple[float, str, dict[str, Any]]] | None = None
+                  ) -> Span:
         """Record an already-completed span with explicit timing and
         parentage. For producers that cannot wrap their work in the
         ``span()`` context manager — the engine dispatch thread measures
         phases for many interleaved requests at once, then reports each
-        one here with the (trace_id, span_id) its submitter captured."""
+        one here with the (trace_id, span_id) its submitter captured.
+        ``events`` are pre-timestamped (ts, name, attributes) span events
+        (the engine's sampled decode-step phase rows ride here)."""
         if trace_ctx is not None:
             trace_id, parent_id = trace_ctx
         else:
@@ -123,6 +127,9 @@ class Tracer:
         span = Span(name=name, trace_id=trace_id, span_id=_rand_hex(8),
                     parent_span_id=parent_id, start_ts=start_ts,
                     attributes=dict(attributes or {}), status=status)
+        if events:
+            span.events = [(ts, ev_name, dict(attrs))
+                           for ts, ev_name, attrs in events]
         span.end_ts = end_ts
         self._finish(span)
         return span

@@ -83,6 +83,17 @@ def engine_introspection(engine: Any, limit: int = 64) -> dict[str, Any]:
         "pipeline_drains": stats.pipeline_drains,
         "dispatch_gap_ms_total": round(stats.dispatch_gap_ms_total, 3),
         "device_idle_fraction": round(engine.device_idle_fraction(), 4),
+        # decode-step attribution + live roofline + compile tracking
+        # (docs/observability.md "Step attribution, live roofline, and
+        # SLOs"): phase rows ride each sampled step in "steps" below
+        "phase_sampling": {
+            "every": engine.config.step_sample_every,
+            "samples": getattr(stats, "phase_samples", 0),
+        },
+        "roofline": (engine.roofline_snapshot()
+                     if hasattr(engine, "roofline_snapshot") else None),
+        "xla_compiles": (engine.compile_stats()
+                         if hasattr(engine, "compile_stats") else None),
         "kv": {
             "pages_in_use": engine.allocator.pages_in_use,
             "free_pages": engine.allocator.free_pages,

@@ -1,0 +1,133 @@
+"""XLA compile-event tracking via ``jax.monitoring``.
+
+PR 5 proved a mid-traffic XLA compile is a silent catastrophe: four pjit
+cache-key mismatches made every warmed executable recompile at first
+traffic hit, reading as seconds-long wedges to the pool health monitor.
+The fix landed, but nothing GUARDS it — a future cache-key regression
+would only show up as mysterious latency. This module counts and times
+every backend compile and attributes it to the engine (and lifecycle
+stage) that triggered it, so "a warmed engine compiled during serving"
+becomes an alarm, not an archaeology project.
+
+Mechanism: jax emits ``/jax/core/compile/backend_compile_duration`` on
+the COMPILING thread. Listeners are process-global and cannot be
+unregistered individually, so exactly one module-level listener is
+installed (idempotent) and dispatches by ``threading.get_ident()`` into
+a registration table: the engine's dispatch thread registers itself as
+stage ``serving`` for its lifetime, and ``warmup()`` / engine
+construction register their caller thread as stage ``warmup`` for the
+call's duration. Compiles on unregistered threads (e.g. the encoder, or
+test scaffolding) are ignored.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+from jax import monitoring
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_by_thread: dict[int, tuple["CompileTracker", str]] = {}
+_installed = False
+
+
+class CompileTracker:
+    """One engine's compile counters, bumped from whichever thread
+    compiles (own lock; the engine's thread-ownership lint contexts do
+    not apply here by design)."""
+
+    STAGES = ("warmup", "serving")
+
+    def __init__(self, on_compile: Callable[[str, float], None] | None = None
+                 ) -> None:
+        self._lock = threading.Lock()
+        self._counts = {stage: 0 for stage in self.STAGES}
+        self._ms_totals = {stage: 0.0 for stage in self.STAGES}
+        self._last_ts = 0.0
+        self._recent: deque[dict[str, Any]] = deque(maxlen=32)
+        # (stage, duration_s) callback for metrics/span emission; must be
+        # cheap and is wrapped so a telemetry failure never breaks the
+        # compiling thread
+        self._on_compile = on_compile
+
+    def record(self, stage: str, duration_s: float) -> None:
+        now = time.time()
+        with self._lock:
+            self._counts[stage] = self._counts.get(stage, 0) + 1
+            self._ms_totals[stage] = (self._ms_totals.get(stage, 0.0)
+                                      + duration_s * 1000.0)
+            self._last_ts = now
+            self._recent.append({"ts": now, "stage": stage,
+                                 "duration_ms": round(duration_s * 1000, 3)})
+        if self._on_compile is not None:
+            try:
+                self._on_compile(stage, duration_s)
+            except Exception:
+                pass
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "warmup": {"count": self._counts.get("warmup", 0),
+                           "ms_total": round(
+                               self._ms_totals.get("warmup", 0.0), 3)},
+                "serving": {"count": self._counts.get("serving", 0),
+                            "ms_total": round(
+                                self._ms_totals.get("serving", 0.0), 3)},
+                "last_compile_ts": self._last_ts,
+                "recent": list(self._recent),
+            }
+
+    def serving_compiles(self) -> int:
+        with self._lock:
+            return self._counts.get("serving", 0)
+
+
+def _listener(event: str, duration: float, **_kwargs: Any) -> None:
+    if event != _COMPILE_EVENT:
+        return
+    try:
+        registration = _by_thread.get(threading.get_ident())
+        if registration is not None:
+            tracker, stage = registration
+            tracker.record(stage, float(duration))
+    except Exception:
+        pass  # a broken listener must never break compilation
+
+
+def install_listener() -> None:
+    """Register the process-global dispatch listener exactly once."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        monitoring.register_event_duration_secs_listener(_listener)
+        _installed = True
+
+
+def track_thread(tracker: CompileTracker, stage: str
+                 ) -> tuple[int, tuple[CompileTracker, str] | None]:
+    """Attribute the CURRENT thread's compiles to ``tracker`` as
+    ``stage``; returns a token for :func:`restore_thread` (save/restore
+    semantics so nested attributions — warmup called on a thread a pool
+    already registered — unwind cleanly)."""
+    ident = threading.get_ident()
+    with _lock:
+        previous = _by_thread.get(ident)
+        _by_thread[ident] = (tracker, stage)
+    return ident, previous
+
+
+def restore_thread(token: tuple[int, tuple[CompileTracker, str] | None]
+                   ) -> None:
+    ident, previous = token
+    with _lock:
+        if previous is None:
+            _by_thread.pop(ident, None)
+        else:
+            _by_thread[ident] = previous

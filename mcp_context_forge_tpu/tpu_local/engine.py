@@ -38,11 +38,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability.logging import trace_extra
+from .compile_events import (CompileTracker, install_listener,
+                             restore_thread, track_thread)
 from .kv import PageAllocator, init_kv_state, kv_logical
 from .models import MODEL_CONFIGS, LlamaConfig
 from .models.llama import (decode_step, init_params, params_logical, prefill,
                            prefill_with_history)
 from .parallel import make_mesh, param_specs
+from .roofline import (V5E_HBM_GBPS, V5E_PEAK_BF16_TFLOPS, CostRegistry,
+                       roofline_fractions)
 from .sampling import SamplingParams, sample_tokens
 from .tokenizer import load_tokenizer
 
@@ -152,6 +157,23 @@ class EngineConfig:
     # step-introspection ring: per-dispatch summaries (kind, batch shape,
     # duration, tokens) kept for the diagnostics endpoint / admin UI
     step_log_size: int = 256
+    # decode-step phase attribution: every Nth decode dispatch runs
+    # serially with a timed block_until_ready window so its wall splits
+    # into host-dispatch / table-sync / device-compute / read-back /
+    # emission phases (step ring + mcpforge_llm_step_phase_seconds +
+    # llm.decode span events). 0 disables — the default, so steady-state
+    # traffic is unperturbed and token streams stay byte-identical.
+    step_sample_every: int = 0
+    # capture XLA cost_analysis() (FLOPs, bytes accessed) per compiled
+    # executable at warmup into the engine's CostRegistry — what feeds
+    # the live mcpforge_llm_mfu / mcpforge_llm_hbm_roofline_frac gauges.
+    # Capture lowers each shape once more through the AOT path (a real
+    # compile, amortized by the persistent cache); disable on cold TPUs
+    # where warmup time is the binding constraint.
+    cost_analysis: bool = True
+    # per-chip roofline peaks the live gauges divide by (defaults: v5e)
+    peak_tflops_per_chip: float = V5E_PEAK_BF16_TFLOPS
+    hbm_gbps_per_chip: float = V5E_HBM_GBPS
 
     @classmethod
     def from_settings(cls, settings) -> "EngineConfig":
@@ -186,6 +208,14 @@ class EngineConfig:
             auto_restart=getattr(settings, "tpu_local_auto_restart", False),
             auto_restart_max=getattr(settings, "tpu_local_auto_restart_max", 3),
             step_log_size=getattr(settings, "tpu_local_step_log_size", 256),
+            step_sample_every=getattr(
+                settings, "tpu_local_step_sample_every", 0),
+            cost_analysis=getattr(settings, "tpu_local_cost_analysis", True),
+            peak_tflops_per_chip=getattr(
+                settings, "tpu_local_peak_tflops_per_chip",
+                V5E_PEAK_BF16_TFLOPS),
+            hbm_gbps_per_chip=getattr(
+                settings, "tpu_local_hbm_gbps_per_chip", V5E_HBM_GBPS),
         )
 
 
@@ -254,6 +284,7 @@ class EngineStats:
         self.overlap_steps = 0        # decode dispatches fed from device tokens
         self.pipeline_drains = 0      # overlap barriers that forced a drain
         self.dispatch_gap_ms_total = 0.0  # host-side stall between dispatches
+        self.phase_samples = 0        # decode steps with phase attribution
 
 
 class EngineInitTimeout(RuntimeError):
@@ -445,7 +476,37 @@ class TPUEngine:
         # iteration (request_cancel is the only other writer, lock-guarded)
         self._cancels: set[str] = set()  # lint: thread[dispatch]
         self._cancel_lock = threading.Lock()  # lint: lock[dispatch]
+        # decode-step attribution + live roofline state: the dispatch
+        # counter drives the sampling cadence, phase events feed llm.decode
+        # span events, the roofline window backs roofline_snapshot(), and
+        # the cost registry holds warmup-captured XLA cost_analysis()
+        self._dispatch_count = 0  # lint: thread[dispatch]
+        self._phase_events: deque[tuple[float, dict[str, float]]] = \
+            deque(maxlen=64)  # lint: thread[dispatch]
+        self._roofline_window: deque[tuple[float, float, float]] = \
+            deque(maxlen=256)  # lint: thread[dispatch]
+        self.cost_registry = CostRegistry()
+        # XLA compile tracking: every backend compile on a registered
+        # thread (dispatch = "serving", warmup callers = "warmup") counts
+        # + times itself; a serving-stage compile on a warmed engine is
+        # the PR-5 mid-traffic-compile catastrophe resurfacing
+        self.compile_tracker = CompileTracker(self._on_xla_compile)
+        install_listener()
+        # the build window compiles for real (param init, KV-state
+        # placement, config.warmup's grid): attribute it all to the
+        # "warmup" stage so the every-engine-compile-is-attributed
+        # contract holds from construction on
+        ctor_token = track_thread(self.compile_tracker, "warmup")
+        try:
+            self._build_device_state(devices)
+        finally:
+            restore_thread(ctor_token)
 
+    def _build_device_state(self, devices) -> None:
+        """Mesh + params + KV pool + jitted-step tables (the compile-heavy
+        tail of construction; runs under the constructor's warmup-stage
+        compile attribution)."""
+        config = self.config
         dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
         # an EnginePool passes each replica its device subset; a standalone
         # engine owns every device the (watchdogged) backend reports
@@ -709,7 +770,9 @@ class TPUEngine:
         warmup rows use positions=-1, so KV writes land on the reserved
         trash page (page 0) and the allocator is untouched. Also what
         benches call so their timed region measures steady state, not XLA
-        compile latency.
+        compile latency. Compiles here (and the cost-registry AOT
+        captures) attribute to the tracker's "warmup" stage — only
+        compiles on the dispatch thread count as the mid-traffic kind.
 
         ``mode`` (default config.warmup_mode):
         - "full": every prefill bucket x power-of-2 admission batch x
@@ -721,11 +784,24 @@ class TPUEngine:
           a cold chip; a cache miss mid-traffic costs one compile (which
           the persistent cache then keeps).
         """
+        token = track_thread(self.compile_tracker, "warmup")
+        try:
+            self._warmup_impl(mode)
+        finally:
+            restore_thread(token)
+
+    def _warmup_impl(self, mode: str | None = None) -> None:
         mode = mode or self.config.warmup_mode
         if mode not in ("full", "fast"):
             raise ValueError(f"warmup mode must be full|fast, got {mode!r}")
         started = time.monotonic()
         shapes = 0
+        # cost-registry capture (roofline.py): AOT-lower each executable
+        # once and record XLA's FLOPs / bytes-accessed so live step timing
+        # can feed the mcpforge_llm_mfu / hbm_roofline_frac gauges. Always
+        # BEFORE the warming call at the same shape: the call donates
+        # self.kv, and lower() must see live buffers
+        capture = self.config.cost_analysis
         hist_ctx = self._hist_ctx_buckets()
         if mode == "fast" and len(hist_ctx) > 2:
             hist_ctx = [hist_ctx[0], hist_ctx[-1]]
@@ -747,6 +823,16 @@ class TPUEngine:
                 jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32),
                 settle, jax.random.PRNGKey(0))
             first.block_until_ready()
+            # utility-kernel warmup: the dispatch thread's first
+            # jax.random.split UNPACK (a slice program) and _sync_tables'
+            # sharded block-table device_put would otherwise be tiny
+            # serving-stage compiles, polluting the zero-mid-traffic-
+            # compile invariant the compile tracker guards. After the
+            # settle call so the table sharding is the canonical one.
+            _k1, _k2 = jax.random.split(self._rng)
+            del _k1, _k2
+            jax.device_put(self.allocator.tables(),
+                           self.kv.block_tables.sharding)
             for bucket in self.config.prefill_buckets:
                 use_sp = (self._prefill_sample_sp is not None
                           and bucket > self.config.sp_threshold)
@@ -779,14 +865,17 @@ class TPUEngine:
                                           jnp.zeros((B,), jnp.int32),
                                           jnp.ones((B,), jnp.float32))
                     for fn in fns:
-                        first, self.kv = fn(
-                            self.params, self.kv,
-                            jnp.full((B, bucket), self.tokenizer.pad_id,
-                                     jnp.int32),
-                            jnp.full((B, bucket), -1, jnp.int32),
-                            jnp.zeros((B,), jnp.int32),
-                            jnp.zeros((B,), jnp.int32),
-                            samp, jax.random.PRNGKey(0))
+                        args = (self.params, self.kv,
+                                jnp.full((B, bucket), self.tokenizer.pad_id,
+                                         jnp.int32),
+                                jnp.full((B, bucket), -1, jnp.int32),
+                                jnp.zeros((B,), jnp.int32),
+                                jnp.zeros((B,), jnp.int32),
+                                samp, jax.random.PRNGKey(0))
+                        if capture and B == 1 and fn is self._prefill_sample:
+                            self.cost_registry.capture("prefill", B, bucket,
+                                                       fn, *args)
+                        first, self.kv = fn(*args)
                         first.block_until_ready()
                         shapes += 1
                     B *= 2
@@ -796,12 +885,16 @@ class TPUEngine:
                                   jnp.ones((B,), jnp.float32))
             if self._verify_fns is not None:
                 for ctx_pages in self._ctx_buckets():
-                    block, self.kv = self._verify_fn(ctx_pages)(
-                        self.params, self.kv,
-                        jnp.zeros((B, self.config.spec_k), jnp.int32),
-                        jnp.full((B, self.config.spec_k), -1, jnp.int32),
-                        jnp.arange(B, dtype=jnp.int32), samp,
-                        jax.random.PRNGKey(0))
+                    args = (self.params, self.kv,
+                            jnp.zeros((B, self.config.spec_k), jnp.int32),
+                            jnp.full((B, self.config.spec_k), -1, jnp.int32),
+                            jnp.arange(B, dtype=jnp.int32), samp,
+                            jax.random.PRNGKey(0))
+                    if capture:
+                        self.cost_registry.capture(
+                            "spec_verify", B, ctx_pages,
+                            self._verify_fn(ctx_pages), *args)
+                    block, self.kv = self._verify_fn(ctx_pages)(*args)
                     block.block_until_ready()
                     shapes += 1
             # plain decode is always live: spec engines fall back to it on
@@ -816,12 +909,17 @@ class TPUEngine:
                                        jnp.zeros((batch,), jnp.int32),
                                        jnp.ones((batch,), jnp.float32))
                 for ctx_pages in self._ctx_buckets():
-                    block, self.kv = self._decode_fn(ctx_pages, batch)(
-                        self.params, self.kv, jnp.zeros((batch,), jnp.int32),
-                        jnp.zeros((batch,), jnp.int32),
-                        jnp.arange(batch, dtype=jnp.int32),
-                        jnp.zeros((batch,), jnp.int32), bsamp,
-                        jax.random.PRNGKey(0))
+                    args = (self.params, self.kv,
+                            jnp.zeros((batch,), jnp.int32),
+                            jnp.zeros((batch,), jnp.int32),
+                            jnp.arange(batch, dtype=jnp.int32),
+                            jnp.zeros((batch,), jnp.int32), bsamp,
+                            jax.random.PRNGKey(0))
+                    if capture:
+                        self.cost_registry.capture(
+                            "decode", batch, ctx_pages,
+                            self._decode_fn(ctx_pages, batch), *args)
+                    block, self.kv = self._decode_fn(ctx_pages, batch)(*args)
                     block.block_until_ready()
                     shapes += 1
                     if self.config.decode_overlap and self._verify_fns is None:
@@ -833,12 +931,18 @@ class TPUEngine:
                         # pjit cache keys on that committed sharding (a
                         # fresh jnp.zeros here would warm a cache entry
                         # traffic never hits)
-                        block, self.kv = self._decode_fb_fn(ctx_pages, batch)(
-                            self.params, self.kv, block,
-                            jnp.zeros((batch,), jnp.int32),
-                            jnp.arange(batch, dtype=jnp.int32),
-                            jnp.zeros((batch,), jnp.int32), bsamp,
-                            jax.random.PRNGKey(0))
+                        fb_args = (self.params, self.kv, block,
+                                   jnp.zeros((batch,), jnp.int32),
+                                   jnp.arange(batch, dtype=jnp.int32),
+                                   jnp.zeros((batch,), jnp.int32), bsamp,
+                                   jax.random.PRNGKey(0))
+                        if capture:
+                            self.cost_registry.capture(
+                                "decode_fb", batch, ctx_pages,
+                                self._decode_fb_fn(ctx_pages, batch),
+                                *fb_args)
+                        block, self.kv = self._decode_fb_fn(
+                            ctx_pages, batch)(*fb_args)
                         block.block_until_ready()
                         shapes += 1
                 self._warmed_widths.add(batch)
@@ -1129,6 +1233,9 @@ class TPUEngine:
         stay byte-identical to the serial path."""
         crashed = False
         overlap = self.config.decode_overlap and self._verify_fns is None
+        # every XLA compile on this thread is a mid-traffic ("serving")
+        # compile — the thing warmup exists to prevent; count + time it
+        compile_token = track_thread(self.compile_tracker, "serving")
         try:
             # the pjit dispatch cache keys on the AMBIENT mesh context, not
             # just input shardings: warmup() compiles under ``with
@@ -1189,15 +1296,23 @@ class TPUEngine:
             logger.exception("tpu_local dispatch thread crashed")
         finally:
             self._flush_emits()
-            if (crashed and self.config.auto_restart
-                    and not self._stop_event.is_set()
-                    and self.stats.engine_restarts
-                    < self.config.auto_restart_max):
-                self._restart_after_crash()
-            else:
-                # a dead thread must not strand consumers on stream.get()
-                self._fail_outstanding(
-                    "cancelled" if self._stop_event.is_set() else "error")
+            try:
+                if (crashed and self.config.auto_restart
+                        and not self._stop_event.is_set()
+                        and self.stats.engine_restarts
+                        < self.config.auto_restart_max):
+                    # still registered: crash-recovery compiles (fresh
+                    # _init_kv jit wrappers) are mid-traffic "serving"
+                    # compiles and must not escape attribution
+                    self._restart_after_crash()
+                else:
+                    # a dead thread must not strand consumers on
+                    # stream.get()
+                    self._fail_outstanding(
+                        "cancelled" if self._stop_event.is_set()
+                        else "error")
+            finally:
+                restore_thread(compile_token)
 
     def _restart_after_crash(self) -> None:
         """Device-fault recovery (SURVEY §5.3: "TPU driver errors → engine
@@ -1266,6 +1381,14 @@ class TPUEngine:
         for request in list(self._running.values()):
             if request.finish_reason is None:
                 request.finish_reason = reason
+            # trace correlation (observability/logging.py): the incident
+            # line for a generation killed mid-decode joins to the OTel
+            # trace of the request it truncated
+            logger.warning(
+                "tpu_local: failing in-flight request %s (%s) after %d "
+                "generated token(s)", request.request_id,
+                request.finish_reason, len(request.generated),
+                extra=trace_extra(request.trace_ctx))
             self._finish(request)
         for request in list(self._chunking.values()):
             self._chunking.pop(request.slot, None)
@@ -1800,9 +1923,12 @@ class TPUEngine:
                     break  # EOS/stop/max hit inside the chunk
             self.stats.spec_tokens += max(0, emitted - 1)
             spec_emitted += emitted
+        mfu, hbm_frac = self._observe_roofline(
+            "spec_verify", B, spec_ctx_pages, spec_elapsed_ms)
         self._record_step("spec_decode", batch=len(active), width=B,
                           dur_ms=spec_elapsed_ms, tokens=spec_emitted,
-                          ctx_pages=spec_ctx_pages)
+                          ctx_pages=spec_ctx_pages, mfu=mfu,
+                          hbm_frac=hbm_frac)
 
     # ------------------------------------------------------------ decode step
 
@@ -1825,6 +1951,16 @@ class TPUEngine:
         inside a decode_block."""
         config = self.config
         k = config.decode_block
+        if self._phase_sample_due():
+            # sampled steps run SERIALLY so the timed block_until_ready
+            # window attributes this one step alone (a device-fed step's
+            # wall overlaps its neighbor and cannot be split into
+            # phases). Drains are the same barrier admission uses, so
+            # token streams stay byte-identical to the unsampled run.
+            self._drain_pipeline()
+            if self._running:
+                self._decode_step_all()
+            return
         feed = self._inflight
         self._inflight = None
         if feed is not None:
@@ -2009,6 +2145,11 @@ class TPUEngine:
         output is discarded wholesale."""
         config = self.config
         k = config.decode_block
+        # phase attribution (opt-in sampling): this dispatch runs serial
+        # (the overlapped caller drained first) and times each phase
+        build_ts = time.monotonic()
+        sampled = self._phase_sample_due()
+        self._dispatch_count += 1
         tokens = np.zeros((B,), dtype=np.int32)
         positions = np.zeros((B,), dtype=np.int32)
         seq_lens = np.zeros((B,), dtype=np.int32)
@@ -2052,7 +2193,9 @@ class TPUEngine:
                     if self.metrics is not None:
                         self.metrics.llm_kv_alloc_failures.inc()
             budgets[slot] = usable
+        sync_start = time.monotonic()
         self._sync_tables()
+        sync_s = time.monotonic() - sync_start
         sampling = SamplingParams(jnp.asarray(temperature), jnp.asarray(top_k),
                                   jnp.asarray(top_p))
         self._rng, key = jax.random.split(self._rng)
@@ -2082,6 +2225,19 @@ class TPUEngine:
                 self.params, self.kv, feed["block"], jnp.asarray(positions),
                 jnp.arange(B, dtype=jnp.int32), jnp.asarray(seq_lens),
                 sampling, key)
+        dispatched_ts = time.monotonic()
+        phases: dict[str, float] | None = None
+        if sampled:
+            # the one intentional sync sampling buys: bounds this step's
+            # device-compute phase exactly, every Nth step only
+            block_tokens.block_until_ready()  # lint: allow[host-sync-in-hot-path] opt-in phase-attribution window (config.step_sample_every): every Nth step pays one timed sync; steady-state steps stay overlapped
+            ready_ts = time.monotonic()
+            phases = {
+                "host_dispatch_ms": max(
+                    0.0, (dispatched_ts - build_ts - sync_s) * 1000),
+                "table_sync_ms": sync_s * 1000,
+                "device_compute_ms": (ready_ts - dispatched_ts) * 1000,
+            }
         try:
             block_tokens.copy_to_host_async()  # D2H overlaps device compute
         except AttributeError:
@@ -2089,16 +2245,27 @@ class TPUEngine:
         self.stats.decode_steps += k
         return {"block": block_tokens, "budgets": budgets, "reqs": reqs,
                 "truncated": truncated, "B": B, "ctx_pages": ctx_pages,
-                "batch": len(reqs), "dispatch_ts": started, "gap_s": gap_s}
+                "batch": len(reqs), "dispatch_ts": started, "gap_s": gap_s,
+                "fed": feed is not None, "build_ts": build_ts,
+                "phases": phases}
 
     def _decode_retire(self, inflight: dict[str, Any]) -> None:
         """Fetch and emit one dispatched decode step. Under overlap this
         runs while the NEXT step executes on device, so every line here is
         off the device's critical path."""
+        fetch_ts = time.monotonic()
         block_host = np.asarray(inflight["block"])  # [k, B]  # lint: allow[host-sync-in-hot-path] retire-side read-back, overlapped by the in-flight dispatch
         done_ts = time.monotonic()
+        prev_done_ts = self._last_step_done_ts
         self._last_step_done_ts = done_ts
         decode_elapsed_ms = (done_ts - inflight["dispatch_ts"]) * 1000
+        # roofline denominator: under the depth-2 pipeline this step was
+        # dispatched while its PREDECESSOR still executed, so dispatch->
+        # done spans ~2 device steps at steady state — the per-step wall
+        # is retire-to-retire there, and dispatch->done only when the
+        # device was idle at dispatch (serial path / first after drain)
+        step_wall_ms = (done_ts - max(inflight["dispatch_ts"],
+                                      prev_done_ts or 0.0)) * 1000
         self.stats.decode_ms_total += decode_elapsed_ms
         decode_emitted = 0
         for slot, request in inflight["reqs"].items():
@@ -2114,13 +2281,27 @@ class TPUEngine:
                 decode_emitted += 1
                 if self._running.get(slot) is not request:
                     break  # finished (EOS/stop/max): rest of block discarded
+        emit_done_ts = time.monotonic()
+        phases = inflight.get("phases")
+        if phases is not None:
+            # a phase row exists only when the SAMPLED dispatch reached
+            # retire intact (crash/drop paths discard the inflight record,
+            # so partial rows never surface)
+            phases["readback_ms"] = (done_ts - fetch_ts) * 1000
+            phases["emit_ms"] = (emit_done_ts - done_ts) * 1000
+            phases["total_ms"] = (emit_done_ts - inflight["build_ts"]) * 1000
+            self._observe_phases(phases)
+        mfu, hbm_frac = self._observe_roofline(
+            "decode_fb" if inflight.get("fed") else "decode",
+            inflight["B"], inflight["ctx_pages"], step_wall_ms)
         self._gap_window.append((inflight["gap_s"],
                                  decode_elapsed_ms / 1000))
         self._record_step("decode", batch=inflight["batch"],
                           width=inflight["B"], dur_ms=decode_elapsed_ms,
                           tokens=decode_emitted,
                           ctx_pages=inflight["ctx_pages"],
-                          gap_ms=inflight["gap_s"] * 1000)
+                          gap_ms=inflight["gap_s"] * 1000,
+                          phases=phases, mfu=mfu, hbm_frac=hbm_frac)
         if self.metrics is not None:
             self.metrics.llm_device_idle_frac.labels(
                 replica=self.config.replica_id).set(
@@ -2141,10 +2322,115 @@ class TPUEngine:
 
     # --------------------------------------------------------------- telemetry
 
+    def _phase_sample_due(self) -> bool:
+        """True when the NEXT decode dispatch should take the timed
+        phase-attribution window (every Nth; 0 disables). Pure predicate
+        on the dispatch counter so the overlapped wrapper and the
+        dispatch itself agree within one step."""
+        n = self.config.step_sample_every
+        return n > 0 and self._dispatch_count % n == 0
+
+    def _observe_phases(self, phases: dict[str, float]) -> None:
+        """Publish one completed sampled-step phase row: stats counter,
+        the per-phase histograms, and the event buffer llm.decode spans
+        attach from. Runs at retire on the dispatch thread."""
+        self.stats.phase_samples += 1
+        self._phase_events.append((time.time(), dict(phases)))
+        if self.metrics is not None:
+            rid = self.config.replica_id
+            for key, dur_ms in phases.items():
+                if key == "total_ms":
+                    continue
+                self.metrics.llm_step_phase.labels(
+                    replica=rid, phase=key[:-3]).observe(
+                    max(0.0, dur_ms / 1e3))
+
+    def _observe_roofline(self, kind: str, width: int, ctx_pages: int,
+                          dur_ms: float) -> tuple[float | None, float | None]:
+        """Live roofline: the dispatched executable's warmup-captured XLA
+        cost over this step's measured wall. Feeds the mcpforge_llm_mfu /
+        hbm_roofline_frac gauges and the snapshot window; (None, None)
+        when the registry has no entry (unwarmed engine or cost capture
+        off)."""
+        entry = self.cost_registry.lookup(kind, width, ctx_pages)
+        if entry is None and kind == "decode_fb":
+            entry = self.cost_registry.lookup("decode", width, ctx_pages)
+        if entry is None or dur_ms <= 0:
+            return None, None
+        dur_s = dur_ms / 1e3
+        mfu, frac = roofline_fractions(
+            entry.flops, entry.bytes_accessed, dur_s, self.mesh.size,
+            self.config.peak_tflops_per_chip, self.config.hbm_gbps_per_chip)
+        self._roofline_window.append((entry.flops, entry.bytes_accessed,
+                                      dur_s))
+        if self.metrics is not None:
+            rid = self.config.replica_id
+            self.metrics.llm_mfu.labels(replica=rid).set(mfu)
+            self.metrics.llm_hbm_roofline.labels(replica=rid).set(frac)
+        return mfu, frac
+
+    def roofline_snapshot(self) -> dict[str, Any]:
+        """Aggregate cost-model roofline over the recent decode window
+        (the live twin of bench_engine's post-hoc mfu/hbm numbers)."""
+        flops = byts = dur = 0.0
+        window = list(self._roofline_window)
+        for f, b, d in window:
+            flops += f
+            byts += b
+            dur += d
+        out: dict[str, Any] = {
+            "window_steps": len(window),
+            "cost_entries": self.cost_registry.counts(),
+        }
+        if dur > 0:
+            mfu, frac = roofline_fractions(
+                flops, byts, dur, self.mesh.size,
+                self.config.peak_tflops_per_chip,
+                self.config.hbm_gbps_per_chip)
+            # 12 digits: a CPU-test replica's MFU sits at ~1e-7 — and a
+            # load-stalled host can stretch one step's wall enough to
+            # push it below 1e-9 — it must never round to a dead 0.0
+            out["mfu"] = round(mfu, 12)
+            out["hbm_roofline_frac"] = round(frac, 12)
+        return out
+
+    def _on_xla_compile(self, stage: str, duration_s: float) -> None:
+        """CompileTracker callback — runs on whichever thread compiled.
+        Counts every attributed compile; serving-stage compiles (the
+        mid-traffic kind PR 5 proved catastrophic) also emit a span so
+        they are findable next to the request traces they stalled."""
+        rid = self.config.replica_id
+        if self.metrics is not None:
+            try:
+                self.metrics.llm_xla_compiles.labels(
+                    replica=rid, stage=stage).inc()
+                self.metrics.llm_xla_compile_time.labels(
+                    replica=rid).observe(duration_s)
+            except Exception:
+                pass
+        if stage == "serving" and self.tracer is not None:
+            try:
+                now = time.time()
+                self.tracer.emit_span(
+                    "llm.xla_compile", now - duration_s, now,
+                    attributes={"gen_ai.request.model": self.config.model,
+                                "llm.replica_id": rid,
+                                "llm.compile_stage": stage})
+            except Exception:
+                pass  # telemetry must never break the compiling thread
+
+    def compile_stats(self) -> dict[str, Any]:
+        """Warmup/serving XLA compile counts + timings (admin surfaces,
+        pool status, support bundle)."""
+        return self.compile_tracker.snapshot()
+
     def _record_step(self, kind: str, *, batch: int, width: int,
                      dur_ms: float, tokens: int, bucket: int | None = None,
                      ctx_pages: int | None = None,
-                     gap_ms: float | None = None) -> None:
+                     gap_ms: float | None = None,
+                     phases: dict[str, float] | None = None,
+                     mfu: float | None = None,
+                     hbm_frac: float | None = None) -> None:
         """One ring-buffer entry + gauge refresh per device dispatch.
         Runs on the dispatch thread; deque.append and prometheus_client
         ops are both thread-safe, and the asyncio side only ever copies
@@ -2167,6 +2453,12 @@ class TPUEngine:
             # host-side stall before this dispatch (decode only; 0 when the
             # overlapped pipeline kept the device fed)
             "gap_ms": round(gap_ms, 3) if gap_ms is not None else None,
+            # sampled phase attribution (None unless this step took the
+            # step_sample_every window) and live cost-model roofline
+            "phases": ({k: round(v, 3) for k, v in phases.items()}
+                       if phases is not None else None),
+            "mfu": round(mfu, 12) if mfu is not None else None,
+            "hbm_frac": round(hbm_frac, 12) if hbm_frac is not None else None,
         })
         m = self.metrics
         if m is not None:
@@ -2194,7 +2486,9 @@ class TPUEngine:
         return steps
 
     def _span(self, name: str, request: GenRequest, start_ts: float,
-              end_ts: float, status: str = "OK", **attrs: Any) -> None:
+              end_ts: float, status: str = "OK",
+              events: list[tuple[float, str, dict[str, Any]]] | None = None,
+              **attrs: Any) -> None:
         """Emit one per-request engine span parented to the submitter's
         llm.request span (no contextvars on the dispatch thread)."""
         if self.tracer is None or request.trace_ctx is None:
@@ -2209,7 +2503,8 @@ class TPUEngine:
         try:
             self.tracer.emit_span(name, start_ts, end_ts,
                                   trace_ctx=request.trace_ctx,
-                                  attributes=attributes, status=status)
+                                  attributes=attributes, status=status,
+                                  events=events)
         except Exception:
             pass  # telemetry must never kill the dispatch thread
 
@@ -2237,8 +2532,16 @@ class TPUEngine:
                 replica=self.config.replica_id).observe(
                 max(0.0, (now - decode_start) / (n - 1)))
         reason = request.finish_reason or "stop"
+        # sampled phase rows that landed during this request's decode
+        # phase ride along as span events — the trace-side view of the
+        # step-attribution ring (batch-wide, so shared across the
+        # requests decoding concurrently)
+        phase_events = [(ts, "decode.step.phases", attrs)
+                        for ts, attrs in list(self._phase_events)
+                        if ts >= decode_start][-8:]
         self._span("llm.decode", request, decode_start, now,
                    status="OK" if reason in ("stop", "length") else "ERROR",
+                   events=phase_events or None,
                    **{"gen_ai.usage.completion_tokens": n,
                       "llm.finish_reason": reason,
                       "llm.kv_pages": self.allocator.slot_pages(request.slot)})

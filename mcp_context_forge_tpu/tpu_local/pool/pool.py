@@ -39,6 +39,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Callable
 
+from ...observability.logging import trace_extra
 from ..engine import EngineConfig, EngineStats, GenRequest, TPUEngine, probe_devices
 from ..parallel import mesh_shape_from_string
 from .health import HealthMonitor
@@ -131,6 +132,12 @@ class EngineReplica:
             "reloads": self.reloads,
             "failures": self.failures,
             "last_failure": self.last_failure,
+            # mid-traffic XLA compiles (compile_events.py): serving-stage
+            # count > 0 on a warmed replica is the PR-5 catastrophe — the
+            # health monitor's wedge bar assumes it stays 0
+            "xla_compiles": engine.compile_stats(),
+            # live cost-model roofline over the recent decode window
+            "roofline": engine.roofline_snapshot(),
         }
 
 
@@ -304,7 +311,8 @@ class EnginePool:
             return
         # no replica could take it
         logger.error("engine pool: no routable replica for %s (%s)",
-                     request.request_id, last_error)
+                     request.request_id, last_error,
+                     extra=trace_extra(request.trace_ctx))
         if request.finish_reason is None:
             request.finish_reason = "error"
         request.stream.put_nowait(None)
@@ -473,9 +481,12 @@ class EnginePool:
         old.requeued_off += 1
         if self.metrics is not None:
             self.metrics.llm_pool_requeues.labels(replica=old.id).inc()
+        # trace correlation: the failover line joins to the request's
+        # OTel trace in the JSON/ring logs (observability/logging.py)
         logger.warning("engine pool: requeueing %s off replica %s "
                        "(%d tokens already delivered)", request.request_id,
-                       old.id, len(request.generated))
+                       old.id, len(request.generated),
+                       extra=trace_extra(request.trace_ctx))
         await self._dispatch(request, attempts=record.attempts + 1)
 
     # ------------------------------------------------------------ drain/reload

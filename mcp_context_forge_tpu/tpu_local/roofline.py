@@ -1,0 +1,121 @@
+"""XLA cost-model registry + roofline math for the live MFU / HBM gauges.
+
+``bench_engine.py`` computes MFU and ``hbm_roofline_frac`` after the fact
+from analytic byte counts — useful for captures, invisible in production.
+This module makes the same numbers ALWAYS-ON: at warmup the engine lowers
+each compiled executable once more through the AOT path and records XLA's
+own ``cost_analysis()`` (FLOPs, bytes accessed) into a per-engine
+:class:`CostRegistry`; every decode retire then divides the dispatched
+executable's cost by its measured wall to feed the
+``mcpforge_llm_mfu`` / ``mcpforge_llm_hbm_roofline_frac`` gauges.
+
+The peaks are per-chip and configurable (``EngineConfig.peak_tflops_per_
+chip`` / ``hbm_gbps_per_chip``); defaults are TPU v5e. On CPU backends
+the fractions are meaningless against TPU peaks but harmless — the A/B
+signal (did a change move the fraction) survives any constant.
+
+Pure stdlib on purpose: imported by ``bench_engine.py`` before the jax
+platform is pinned, so it must not import jax at module scope.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+# TPU v5e, per chip (also the single source for bench_engine.py)
+V5E_PEAK_BF16_TFLOPS = 197.0
+V5E_HBM_GBPS = 819.0
+
+
+@dataclass(frozen=True)
+class CostEntry:
+    """One executable's XLA cost model: total FLOPs and HBM bytes touched
+    per dispatch (the whole batch, not per row)."""
+
+    flops: float
+    bytes_accessed: float
+
+
+def normalize_cost_analysis(analysis: Any) -> CostEntry | None:
+    """``Compiled.cost_analysis()`` returns a dict on recent jax and a
+    one-element list of dicts on older versions; extract the two numbers
+    the roofline needs, or None when the backend has no cost model."""
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not isinstance(analysis, dict):
+        return None
+    flops = float(analysis.get("flops", 0.0) or 0.0)
+    byts = float(analysis.get("bytes accessed", 0.0) or 0.0)
+    if flops <= 0.0 and byts <= 0.0:
+        return None
+    return CostEntry(flops=flops, bytes_accessed=byts)
+
+
+def roofline_fractions(flops: float, bytes_accessed: float, dur_s: float,
+                       n_chips: int, peak_tflops_per_chip: float,
+                       hbm_gbps_per_chip: float) -> tuple[float, float]:
+    """(mfu, hbm_roofline_frac) for one dispatch of known cost and wall."""
+    if dur_s <= 0.0:
+        return 0.0, 0.0
+    chips = max(1, n_chips)
+    mfu = flops / dur_s / (peak_tflops_per_chip * 1e12 * chips)
+    frac = bytes_accessed / dur_s / (hbm_gbps_per_chip * 1e9 * chips)
+    return mfu, frac
+
+
+class CostRegistry:
+    """Per-engine map of (kind, batch width, ctx bucket) -> CostEntry.
+
+    Kinds mirror the engine's executable families: ``prefill`` (dense,
+    keyed by token bucket at B=1), ``decode`` / ``decode_fb`` (keyed by
+    batch width x context-page bucket), ``spec_verify``. Populated only
+    at warmup — capture lowers+compiles through the AOT path, which is a
+    real XLA compile, so it must never run on the serving path.
+    """
+
+    def __init__(self) -> None:
+        self._entries: dict[str, dict[tuple[int, int], CostEntry]] = {}
+
+    def capture(self, kind: str, width: int, ctx: int, fn: Any,
+                *args: Any) -> CostEntry | None:
+        """Record ``fn``'s XLA cost at this shape (``fn`` is a jitted
+        callable; ``args`` the exact example arguments warmup dispatches).
+        Swallows every failure: a backend without a cost model must not
+        break warmup."""
+        try:
+            analysis = fn.lower(*args).compile().cost_analysis()
+        except Exception:
+            return None
+        entry = normalize_cost_analysis(analysis)
+        if entry is not None:
+            self._entries.setdefault(kind, {})[(width, ctx)] = entry
+        return entry
+
+    def lookup(self, kind: str, width: int, ctx: int) -> CostEntry | None:
+        """Exact (width, ctx) hit, else the same ctx at any width (batch
+        rows are cheap next to the shared param read decode streams, so a
+        width-mismatched entry is still the right order of magnitude)."""
+        table = self._entries.get(kind)
+        if not table:
+            return None
+        entry = table.get((width, ctx))
+        if entry is not None:
+            return entry
+        for (_w, c), candidate in sorted(table.items()):
+            if c == ctx:
+                return candidate
+        return None
+
+    def counts(self) -> dict[str, int]:
+        return {kind: len(table) for kind, table in sorted(
+            self._entries.items())}
+
+    def snapshot(self) -> dict[str, Any]:
+        """Serializable registry view for /admin/engine/steps + bench."""
+        return {
+            kind: {f"{w}x{c}": {"flops": entry.flops,
+                                "bytes_accessed": entry.bytes_accessed}
+                   for (w, c), entry in sorted(table.items())}
+            for kind, table in sorted(self._entries.items())
+        }

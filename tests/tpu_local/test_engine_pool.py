@@ -181,6 +181,22 @@ def test_priority_rides_through_to_the_shadow():
     assert pool._make_shadow(fresh, attempts=2).ttft_observed is False
 
 
+def test_shadow_carries_trace_context_across_requeues():
+    """llm.* spans must stay parented to the gateway request after a
+    replica kill: first-attempt AND requeued continuation shadows carry
+    the original request's trace_ctx (the engine's _span parents off it,
+    so losing it on failover would orphan every post-failover span)."""
+    pool = _pool(replicas=2)
+    trace_ctx = ("ab" * 16, "cd" * 8)
+    request = GenRequest(request_id="traced", prompt_ids=[1, 2, 3],
+                         max_tokens=8, trace_ctx=trace_ctx)
+    assert pool._make_shadow(request, attempts=1).trace_ctx == trace_ctx
+    request.generated.extend([4, 5])
+    requeued = pool._make_shadow(request, attempts=2)
+    assert requeued.trace_ctx == trace_ctx
+    assert requeued.request_id == "traced~r1"
+
+
 # ---------------------------------------------------------------- failover
 
 def test_kill_one_replica_mid_decode_loses_nothing():
@@ -215,6 +231,12 @@ def test_kill_one_replica_mid_decode_loses_nothing():
         assert sum(r.requeued_off for r in pool.replicas) == pool.requeues
         status = pool.status()
         assert status["replicas"][1]["last_failure"]
+        # the status card carries the compile-tracking + live-roofline
+        # blocks per replica (what /admin/engine/pool and the support
+        # bundle serve)
+        for card in status["replicas"]:
+            assert {"warmup", "serving"} <= set(card["xla_compiles"])
+            assert "cost_entries" in card["roofline"]
 
     asyncio.run(main())
 

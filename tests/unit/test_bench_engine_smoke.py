@@ -38,6 +38,29 @@ def test_bench_engine_cpu_smoke(bench_env, monkeypatch):
     assert out["decode_overlap"] is True
     assert out["overlap_steps"] >= 0
     assert 0.0 <= out["device_idle_frac"] <= 1.0
+    # live-observability twins of the post-hoc roofline numbers: the
+    # warmup-captured cost registry saw the serving executables, and
+    # compile attribution is reported (warmup counted, recent ring
+    # stripped from the JSON line)
+    assert out["live_roofline"]["cost_entries"].get("decode", 0) >= 1
+    assert out["xla_compiles"]["warmup"]["count"] > 0
+    assert out["xla_compiles"]["serving"]["count"] >= 0
+    assert "recent" not in out["xla_compiles"]
+
+
+def test_bench_engine_phase_sampling_arm(bench_env, monkeypatch):
+    """BENCH_SAMPLE_EVERY=N: the capture reports sampled phase rows so a
+    TPU window leaves step-attribution evidence next to tok/s."""
+    import bench_engine
+
+    monkeypatch.setenv("BENCH_SAMPLE_EVERY", "2")
+    out = asyncio.run(bench_engine.run("cpu"))
+    assert out["value"] > 0
+    assert out["sample_every"] == 2
+    assert out["phase_rows"], "sampling arm produced no phase rows"
+    for row in out["phase_rows"]:
+        assert {"host_dispatch_ms", "table_sync_ms", "device_compute_ms",
+                "readback_ms", "emit_ms", "total_ms"} == set(row)
 
 
 def test_bench_engine_serial_arm(bench_env, monkeypatch):

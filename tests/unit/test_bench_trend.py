@@ -1,0 +1,170 @@
+"""Bench-history trend gate (tools/bench_trend.py): the checked-in
+BENCH_*.json rounds must pass, and a synthetic regressed capture must
+fail — the exact contract `make bench-check` enforces in the Makefile
+test chain and the Containerfile builder stage."""
+
+import json
+import os
+
+from mcp_context_forge_tpu.tools.bench_trend import (check_series,
+                                                     discover_series, main,
+                                                     run_check)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def _capture(value, p95=100.0, metric="tpu_local_decode_tokens_per_s",
+             hbm=0.005):
+    return {"metric": metric, "value": value, "hbm_roofline_frac": hbm,
+            "token_latency_p95_ms": p95}
+
+
+def _write_series(tmp_path, prefix, payloads):
+    for i, payload in enumerate(payloads, start=1):
+        (tmp_path / f"{prefix}_r{i:02d}.json").write_text(
+            json.dumps(payload))
+
+
+# ------------------------------------------------------- checked-in history
+
+def test_checked_in_history_passes():
+    """The committed BENCH rounds are the gate's baseline: they must be
+    green, and the gate must actually be LOOKING (non-vacuity: at least
+    one multi-round series produced checks)."""
+    report = run_check(REPO_ROOT)
+    assert report["ok"], report["regressions"]
+    checked = [r for r in report["series"] if r["checks"]]
+    assert checked, "gate ran no checks against the checked-in history"
+    metrics_checked = {c["metric"] for r in checked for c in r["checks"]}
+    assert "value" in metrics_checked
+
+
+def test_discover_series_groups_and_orders():
+    series = discover_series(REPO_ROOT)
+    assert "BENCH" in series and "BENCH_LOCAL" in series
+    rounds = [r for r, _path in series["BENCH"]]
+    assert rounds == sorted(rounds) and len(rounds) >= 2
+    # BASELINE.json and other non-round files don't pollute the series
+    assert all("_r" in os.path.basename(p)
+               for entries in series.values() for _r, p in entries)
+
+
+def test_cli_passes_on_repo_history(capsys):
+    assert main(["--root", REPO_ROOT]) == 0
+    out = capsys.readouterr().out
+    assert "PASS" in out
+
+
+# ------------------------------------------------------ synthetic regression
+
+def test_synthetic_throughput_regression_fails(tmp_path):
+    _write_series(tmp_path, "BENCH_TPU",
+                  [_capture(14.0), _capture(15.0),
+                   _capture(6.0)])  # newest: tok/s collapsed
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert not report["ok"]
+    assert any("value=6.0" in line for line in report["regressions"])
+    assert main(["--root", str(tmp_path)]) == 1
+
+
+def test_synthetic_p95_regression_fails(tmp_path):
+    """Lower-is-better metrics gate in the other direction."""
+    _write_series(tmp_path, "BENCH_TPU",
+                  [_capture(14.0, p95=100.0), _capture(14.5, p95=110.0),
+                   _capture(14.2, p95=400.0)])  # p95 exploded, tok/s fine
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert not report["ok"]
+    assert any("token_latency_p95_ms" in line
+               for line in report["regressions"])
+
+
+def test_synthetic_roofline_regression_fails(tmp_path):
+    _write_series(tmp_path, "BENCH_TPU",
+                  [_capture(14.0, hbm=0.005), _capture(14.0, hbm=0.0055),
+                   _capture(14.0, hbm=0.001)])
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert not report["ok"]
+    assert any("hbm_roofline_frac" in line for line in report["regressions"])
+
+
+def test_median_baseline_resists_one_fast_outlier(tmp_path):
+    """One anomalously fast round must not fail every later capture: the
+    baseline is the MEDIAN of priors, not the max."""
+    _write_series(tmp_path, "BENCH_TPU",
+                  [_capture(14.0), _capture(100.0),  # outlier round
+                   _capture(14.5), _capture(14.2)])
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert report["ok"], report["regressions"]
+
+
+def test_within_tolerance_drift_passes(tmp_path):
+    _write_series(tmp_path, "BENCH_TPU",
+                  [_capture(14.0), _capture(15.0), _capture(12.0)])
+    assert run_check(str(tmp_path), tolerance=0.25)["ok"]
+    # the same drift breaches a tighter band
+    assert not run_check(str(tmp_path), tolerance=0.05)["ok"]
+
+
+def test_driver_wrapper_payloads_unwrap(tmp_path):
+    """Round files written by the bench driver nest the capture under
+    'parsed' — the gate reads through the wrapper."""
+    _write_series(tmp_path, "BENCH_TPU", [
+        {"n": 1, "rc": 0, "parsed": _capture(14.0)},
+        {"n": 2, "rc": 0, "parsed": _capture(5.0)},  # regressed, wrapped
+    ])
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert not report["ok"]
+
+
+def test_single_capture_and_ungated_series_skip(tmp_path):
+    _write_series(tmp_path, "BENCH_TPU", [_capture(14.0)])
+    _write_series(tmp_path, "MULTICHIP",
+                  [{"metric": "multichip_smoke", "value": 1},
+                   {"metric": "multichip_smoke", "value": 1}])
+    (tmp_path / "garbage_r01.json").write_text("not json {")
+    report = run_check(str(tmp_path))
+    assert report["ok"]  # nothing regressed...
+    assert report["checks"] == 0  # ...but nothing was gated either
+    skips = {r["series"]: r.get("skipped") for r in report["series"]}
+    assert skips["BENCH_TPU"] == "single capture"
+    assert skips["MULTICHIP"] == "no gated captures"
+    assert skips["garbage"] == "no gated captures"
+
+
+def test_unreadable_newest_capture_fails_not_falls_back(tmp_path):
+    """A truncated/metric-less NEWEST round must fail the gate, not
+    silently judge the second-newest instead (the vacuous-pass class)."""
+    _write_series(tmp_path, "BENCH_TPU", [_capture(14.0), _capture(14.5)])
+    (tmp_path / "BENCH_TPU_r03.json").write_text("{ truncated by a crash")
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert not report["ok"]
+    assert any("r03" in line and "cannot be checked" in line
+               for line in report["regressions"])
+    # same verdict when the newest parses but lost its gate metric
+    (tmp_path / "BENCH_TPU_r03.json").write_text(
+        json.dumps({"metric": "something_else", "value": 1.0}))
+    assert not run_check(str(tmp_path), tolerance=0.25)["ok"]
+
+
+def test_vacuous_gate_is_a_failure_not_a_pass(tmp_path, capsys):
+    """A run that compared NOTHING (wrong root, history not shipped,
+    BENCH_TREND_ROOT typo) must not print PASS/exit 0 — it exits 2,
+    distinct from a regression's 1."""
+    assert main(["--root", str(tmp_path)]) == 2
+    err = capsys.readouterr().err
+    assert "nothing was checked" in err
+    # same verdict when every series is skipped (single captures only)
+    _write_series(tmp_path, "BENCH_TPU", [_capture(14.0)])
+    assert main(["--root", str(tmp_path)]) == 2
+
+
+def test_check_series_reports_bounds(tmp_path):
+    _write_series(tmp_path, "BENCH_TPU",
+                  [_capture(10.0), _capture(20.0), _capture(16.0)])
+    entries = discover_series(str(tmp_path))["BENCH_TPU"]
+    result = check_series("BENCH_TPU", entries, tolerance=0.25)
+    value_check = next(c for c in result["checks"] if c["metric"] == "value")
+    assert value_check["baseline_median"] == 15.0  # median of 10, 20
+    assert value_check["bound"] == 11.25
+    assert value_check["regressed"] is False

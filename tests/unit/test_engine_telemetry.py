@@ -145,13 +145,14 @@ def test_step_ring_buffer_bounded_and_shaped(telemetry_engine):
 
 # --------------------------------------------------------------- gateway path
 
-async def _make_llm_gateway():
+async def _make_llm_gateway(**extra_env):
     from aiohttp.test_utils import TestClient, TestServer
 
     from mcp_context_forge_tpu.config import load_settings
     from mcp_context_forge_tpu.gateway.app import build_app
 
     settings = load_settings(env={
+        **extra_env,
         "MCPFORGE_DATABASE_URL": "sqlite:///:memory:",
         "MCPFORGE_PLUGINS_ENABLED": "false",
         "MCPFORGE_TPU_LOCAL_ENABLED": "true",
@@ -223,5 +224,67 @@ async def test_gateway_http_span_is_ancestor_of_llm_request():
         assert resp.status == 404
         resp = await gateway.post("/admin/engine/profile", json={}, auth=auth)
         assert resp.status == 404
+    finally:
+        await gateway.close()
+
+
+async def test_gateway_slo_and_step_attribution_surfaces():
+    """GET /admin/slo serves objective verdicts over the engine's real
+    histograms, and /admin/engine/steps carries the step-attribution /
+    roofline / compile-tracking blocks (with phase rows when sampling is
+    enabled via MCPFORGE_TPU_LOCAL_STEP_SAMPLE_EVERY)."""
+    import aiohttp
+    auth = aiohttp.BasicAuth("admin", "changeme")
+    gateway = await _make_llm_gateway(
+        MCPFORGE_TPU_LOCAL_STEP_SAMPLE_EVERY="2",
+        MCPFORGE_SLO_TPOT_P95_MS="60000",  # CPU decode must not flake it
+        MCPFORGE_SLO_TTFT_P95_MS="60000",
+        MCPFORGE_SLO_QUEUE_WAIT_P95_MS="60000",
+    )
+    try:
+        # SLO endpoint is live before any traffic (empty histograms)
+        resp = await gateway.get("/admin/slo", auth=auth)
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["ok"] is True
+        assert {o["name"] for o in body["objectives"]} == {
+            "ttft_p95", "tpot_p95", "queue_wait_p95"}
+
+        resp = await gateway.post("/v1/chat/completions", json={
+            "model": "llama3-test",
+            "messages": [{"role": "user", "content": "measure my steps"}],
+            "max_tokens": 8,
+        }, auth=auth)
+        assert resp.status == 200, await resp.text()
+
+        # traffic landed: objectives now carry samples, generous targets
+        # keep the verdict green
+        resp = await gateway.get("/admin/slo", auth=auth)
+        body = await resp.json()
+        assert body["ok"] is True, body
+        ttft = next(o for o in body["objectives"] if o["name"] == "ttft_p95")
+        assert ttft["total_samples"] >= 1
+        assert ttft["cumulative_p_ms"] is not None
+
+        # step introspection: attribution + roofline + compile blocks,
+        # and sampled decode rows carry complete phase dicts
+        resp = await gateway.get("/admin/engine/steps?limit=32", auth=auth)
+        assert resp.status == 200
+        intro = await resp.json()
+        assert intro["phase_sampling"]["every"] == 2
+        assert intro["phase_sampling"]["samples"] >= 1
+        assert "cost_entries" in intro["roofline"]
+        assert intro["xla_compiles"]["serving"]["count"] >= 0
+        phase_rows = [s for s in intro["steps"] if s.get("phases")]
+        assert phase_rows, "sampling enabled but no phase rows served"
+        for row in phase_rows:
+            assert {"host_dispatch_ms", "table_sync_ms", "device_compute_ms",
+                    "readback_ms", "emit_ms", "total_ms"} == set(row["phases"])
+
+        # sampled phase histograms reached the exposition
+        resp = await gateway.get("/metrics/prometheus", auth=auth)
+        text = await resp.text()
+        assert 'mcpforge_llm_step_phase_seconds_count' in text
+        assert 'mcpforge_llm_xla_compiles_total' in text
     finally:
         await gateway.close()

@@ -1,0 +1,94 @@
+"""Log <-> trace correlation (observability/logging.py): JSON log records
+and the /admin/logs ring carry trace_id/span_id — from the
+contextvar-current span inside a traced request, or from an explicit
+``trace_extra(trace_ctx)`` stamp on cross-thread producers (the engine
+dispatch thread, the pool's failover sweep)."""
+
+import json
+import logging
+
+from mcp_context_forge_tpu.observability.logging import (JsonFormatter,
+                                                         RingBufferHandler,
+                                                         trace_extra)
+from mcp_context_forge_tpu.observability.tracing import Tracer
+
+
+def _record(msg="hello", **extra):
+    record = logging.LogRecord("test.logger", logging.INFO, __file__, 1,
+                               msg, None, None)
+    for key, value in extra.items():
+        setattr(record, key, value)
+    return record
+
+
+def test_trace_extra_builds_ctx_kwargs():
+    assert trace_extra(("t" * 32, "s" * 16)) == {
+        "ctx": {"trace_id": "t" * 32, "span_id": "s" * 16}}
+    # None-safe: producers pass request.trace_ctx straight through
+    assert trace_extra(None) == {}
+
+
+def test_json_formatter_stamps_explicit_ctx():
+    payload = json.loads(JsonFormatter().format(
+        _record(**trace_extra(("ab" * 16, "cd" * 8)))))
+    assert payload["trace_id"] == "ab" * 16
+    assert payload["span_id"] == "cd" * 8
+    assert payload["message"] == "hello"
+
+
+def test_json_formatter_uses_current_span():
+    tracer = Tracer(exporter="memory")
+    formatter = JsonFormatter()
+    with tracer.span("unit.op") as span:
+        payload = json.loads(formatter.format(_record("inside")))
+    assert payload["trace_id"] == span.trace_id
+    assert payload["span_id"] == span.span_id
+    # outside any span: no trace fields at all
+    outside = json.loads(formatter.format(_record("outside")))
+    assert "trace_id" not in outside and "span_id" not in outside
+
+
+def test_explicit_ctx_wins_over_current_span():
+    """A cross-thread producer's stamp names the request it CONCERNS,
+    which beats whatever span happens to be current on the emitting
+    task."""
+    tracer = Tracer(exporter="memory")
+    formatter = JsonFormatter()
+    with tracer.span("unrelated.op"):
+        payload = json.loads(formatter.format(
+            _record(**trace_extra(("11" * 16, "22" * 8)))))
+    assert payload["trace_id"] == "11" * 16
+    assert payload["span_id"] == "22" * 8
+
+
+def test_ring_buffer_entries_carry_trace_fields():
+    handler = RingBufferHandler(capacity=8)
+    handler.emit(_record("plain line"))
+    handler.emit(_record("correlated line",
+                         **trace_extra(("ee" * 16, "ff" * 8))))
+    plain, correlated = list(handler.records)
+    assert "trace_id" not in plain
+    assert correlated["trace_id"] == "ee" * 16
+    assert correlated["span_id"] == "ff" * 8
+    # the admin log-search path surfaces the fields too
+    found = handler.search(query="correlated")
+    assert found and found[0]["trace_id"] == "ee" * 16
+
+
+def test_pool_requeue_log_joins_the_request_trace(caplog):
+    """The pool stamps its failover lines with the affected request's
+    trace (tpu_local/pool/pool.py) — pin the contract at the logging
+    layer: a warning carrying trace_extra lands in the ring with the
+    request's ids."""
+    handler = RingBufferHandler(capacity=8)
+    logger = logging.getLogger("unit.pool.requeue")
+    logger.addHandler(handler)
+    try:
+        trace_ctx = ("ab" * 16, "cd" * 8)  # GenRequest.trace_ctx shape
+        logger.warning("engine pool: requeueing %s off replica %s", "req-1",
+                       "0", extra=trace_extra(trace_ctx))
+    finally:
+        logger.removeHandler(handler)
+    (entry,) = list(handler.records)
+    assert entry["trace_id"] == trace_ctx[0]
+    assert entry["span_id"] == trace_ctx[1]

@@ -1,0 +1,191 @@
+"""Serving-SLO evaluation (observability/slo.py): interpolated
+percentiles over the token-level histograms, window deltas between
+evaluations, fraction-over-target, and burn rate vs the error budget."""
+
+import math
+
+from mcp_context_forge_tpu.observability.metrics import PrometheusRegistry
+from mcp_context_forge_tpu.observability.slo import (SloEvaluator,
+                                                     SloObjective,
+                                                     _fraction_over,
+                                                     _percentile_s,
+                                                     default_objectives)
+
+
+def _evaluator(objectives=None, budget=0.05):
+    metrics = PrometheusRegistry()
+    objectives = objectives or [
+        SloObjective("ttft_p95", "llm_ttft", 0.95, 1000.0)]
+    return metrics, SloEvaluator(metrics, objectives, error_budget=budget)
+
+
+def _observe_ttft(metrics, seconds, n=1):
+    for _ in range(n):
+        metrics.llm_ttft.labels(model="m", replica="0").observe(seconds)
+
+
+# ------------------------------------------------------------- pure helpers
+
+def test_percentile_interpolates_within_bucket():
+    # 10 samples uniform in the (0.1, 0.25] bucket: p50 lands mid-bucket
+    buckets = {0.1: 0.0, 0.25: 10.0, math.inf: 10.0}
+    p50 = _percentile_s(buckets, 10.0, 0.5)
+    assert 0.1 < p50 < 0.25
+    # all mass below the first bound: estimate within it
+    assert _percentile_s({0.1: 10.0, math.inf: 10.0}, 10.0, 0.95) <= 0.1
+
+
+def test_percentile_empty_and_inf_clamp():
+    assert _percentile_s({}, 0.0, 0.95) is None
+    # quantile lands in +Inf: clamp to the last finite bound (the honest
+    # "at least this" estimate), never return inf
+    buckets = {0.1: 5.0, math.inf: 10.0}
+    assert _percentile_s(buckets, 10.0, 0.95) == 0.1
+
+
+def test_fraction_over_threshold():
+    buckets = {0.1: 80.0, 1.0: 90.0, math.inf: 100.0}
+    # everything over 1.0s: the +Inf residue (10 of 100)
+    assert _fraction_over(buckets, 100.0, 1.0) == 0.1
+    # threshold below all mass
+    assert _fraction_over(buckets, 100.0, 0.0) == 1.0
+    assert _fraction_over({}, 0.0, 1.0) == 0.0
+
+
+def test_target_above_top_bucket_is_not_a_false_breach():
+    """A target beyond the last finite bucket bound makes the +Inf mass
+    indeterminate (between the bound and the target — the histogram
+    cannot tell which side): it must not read as a breach, and the
+    objective is flagged so operators widen the buckets."""
+    buckets = {0.1: 80.0, 1.0: 90.0, math.inf: 100.0}
+    # 10 samples in +Inf are somewhere above 1.0s; with a 5.0s target
+    # none of them is PROVABLY over
+    assert _fraction_over(buckets, 100.0, 5.0) == 0.0
+    # end-to-end: llm_tpot's top finite bucket is 2.5s — a 5000ms target
+    # with every sample under 2.5s must stay ok, flagged as unmeasurable
+    metrics, evaluator = _evaluator(
+        objectives=[SloObjective("tpot_p95", "llm_tpot", 0.95, 5000.0)])
+    for _ in range(20):
+        metrics.llm_tpot.labels(model="m", replica="0").observe(3.0)
+    report = evaluator.evaluate()
+    (obj,) = report["objectives"]
+    assert report["ok"] is True
+    assert obj["fraction_over_target"] == 0.0
+    assert obj["target_above_buckets"] is True
+    # a target the buckets can resolve is not flagged
+    metrics2, evaluator2 = _evaluator()
+    _observe_ttft(metrics2, 0.05, n=3)
+    (obj2,) = evaluator2.evaluate()["objectives"]
+    assert obj2["target_above_buckets"] is False
+
+
+# ---------------------------------------------------------------- evaluator
+
+def test_within_budget_reports_ok():
+    metrics, evaluator = _evaluator()
+    _observe_ttft(metrics, 0.05, n=40)  # all far under the 1000ms target
+    report = evaluator.evaluate()
+    assert report["ok"] is True
+    (obj,) = report["objectives"]
+    assert obj["name"] == "ttft_p95"
+    assert obj["total_samples"] == 40
+    assert obj["fraction_over_target"] == 0.0
+    assert obj["burn_rate"] == 0.0
+    assert obj["cumulative_p_ms"] is not None
+    assert obj["cumulative_p_ms"] <= 1000.0
+
+
+def test_breach_burns_the_budget():
+    metrics, evaluator = _evaluator(budget=0.05)
+    _observe_ttft(metrics, 0.05, n=10)
+    _observe_ttft(metrics, 20.0, n=10)  # half the samples way over 1s
+    report = evaluator.evaluate()
+    assert report["ok"] is False
+    (obj,) = report["objectives"]
+    assert obj["fraction_over_target"] > 0.4
+    assert obj["burn_rate"] > 1.0
+    assert obj["ok"] is False
+
+
+def test_window_delta_between_evaluations():
+    """The second evaluate() sees only what arrived since the first: a
+    burst of breaches after a clean boot flips the WINDOW verdict even
+    though the cumulative percentile still looks healthy-ish."""
+    metrics, evaluator = _evaluator(budget=0.05)
+    _observe_ttft(metrics, 0.05, n=100)
+    first = evaluator.evaluate()
+    assert first["ok"] is True
+    assert first["window_s"] is None  # no prior evaluation
+    _observe_ttft(metrics, 20.0, n=20)  # the regression burst
+    second = evaluator.evaluate()
+    assert second["window_s"] is not None
+    (obj,) = second["objectives"]
+    assert obj["window_samples"] == 20
+    assert obj["total_samples"] == 120
+    # window is pure breach -> burn rate saturates
+    assert obj["fraction_over_target"] > 0.9
+    assert second["ok"] is False
+    # third call with no new traffic: burn rate falls back to lifetime
+    third = evaluator.evaluate()
+    (obj3,) = third["objectives"]
+    assert obj3["window_samples"] == 0
+    assert obj3["fraction_over_target"] < obj["fraction_over_target"]
+
+
+def test_consumer_windows_are_independent():
+    """An admin-UI poll must not shred another consumer's delta window:
+    each named consumer's snapshot advances only on its own calls."""
+    metrics, evaluator = _evaluator()
+    _observe_ttft(metrics, 0.05, n=10)
+    evaluator.evaluate(consumer="harness")  # harness baseline
+    _observe_ttft(metrics, 0.05, n=7)
+    # a chatty UI polls (and observes the 7 new samples on ITS window)
+    ui = evaluator.evaluate(consumer="admin-ui")
+    assert ui["consumer"] == "admin-ui"
+    _observe_ttft(metrics, 0.05, n=5)
+    # the harness's window still spans everything since ITS last call
+    (obj,) = evaluator.evaluate(consumer="harness")["objectives"]
+    assert obj["window_samples"] == 12  # 7 + 5, UI poll didn't eat them
+
+
+def test_consumer_table_is_bounded():
+    metrics, evaluator = _evaluator()
+    _observe_ttft(metrics, 0.05, n=3)
+    for i in range(evaluator.MAX_CONSUMERS + 5):
+        evaluator.evaluate(consumer=f"c{i}")
+    assert len(evaluator._prev) <= evaluator.MAX_CONSUMERS
+    assert len(evaluator._prev_ts) <= evaluator.MAX_CONSUMERS
+
+
+def test_empty_histograms_are_ok_not_crash():
+    _metrics, evaluator = _evaluator()
+    report = evaluator.evaluate()
+    assert report["ok"] is True
+    (obj,) = report["objectives"]
+    assert obj["cumulative_p_ms"] is None
+    assert obj["window_p_ms"] is None
+    assert obj["burn_rate"] == 0.0
+
+
+def test_default_objectives_read_settings():
+    class Settings:
+        slo_ttft_p95_ms = 111.0
+        slo_tpot_p95_ms = 22.0
+        slo_queue_wait_p95_ms = 333.0
+
+    objectives = default_objectives(Settings())
+    by_name = {o.name: o for o in objectives}
+    assert set(by_name) == {"ttft_p95", "tpot_p95", "queue_wait_p95"}
+    assert by_name["ttft_p95"].target_ms == 111.0
+    assert by_name["tpot_p95"].metric_attr == "llm_tpot"
+    assert by_name["queue_wait_p95"].target_ms == 333.0
+    assert all(o.percentile == 0.95 for o in objectives)
+
+
+def test_missing_metric_attr_is_skipped():
+    metrics, evaluator = _evaluator(
+        objectives=[SloObjective("ghost", "no_such_metric", 0.95, 1.0),
+                    SloObjective("ttft_p95", "llm_ttft", 0.95, 1000.0)])
+    _observe_ttft(metrics, 0.01, n=3)
+    report = evaluator.evaluate()
+    assert [o["name"] for o in report["objectives"]] == ["ttft_p95"]
