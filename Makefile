@@ -1,6 +1,6 @@
 # mcp-context-forge-tpu (reference: 8.7k-line Makefile; the targets that matter)
 
-.PHONY: serve hub lint bench-check test test-py test-fast test-two-process bench bench-engine wrapper masking clean \
+.PHONY: serve hub lint bench-check test test-py test-fast test-two-process bench bench-engine bench-superstep wrapper masking clean \
 	sanitize sanitize-tsan sanitize-asan
 
 serve:
@@ -46,6 +46,11 @@ bench:
 
 bench-engine:
 	python bench_engine.py
+
+# token-loop-fusion A/B: one arm per K, greedy parity + host-syncs-per-
+# token + live roofline per arm (ROADMAP item 1 acceptance sweep)
+bench-superstep:
+	BENCH_SUPERSTEP=1,4,8,16 python bench_engine.py
 
 # real HF-format checkpoint built in-tree (BPE tokenizer.json + safetensors;
 # the model memorizes its corpus so greedy decode is assertable)

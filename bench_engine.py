@@ -16,7 +16,16 @@ justified roofline):
 
 Also reported: per-token latency percentiles (intervals between
 consecutive tokens on each stream, post-warmup) and the A/B knobs in
-effect (decode_block, spec_decode) so captures are self-describing.
+effect (superstep/decode_block, spec_decode) so captures are
+self-describing.
+
+BENCH_SUPERSTEP=K runs the K-step fused decode super-step
+(tpu_local_superstep: one jitted on-device token loop per dispatch, one
+host sync per K tokens). A comma list (``BENCH_SUPERSTEP=1,4,8,16``)
+runs an arm per K and reports ``superstep_ab``: per-arm tok/s,
+host-syncs-per-token, live roofline, and greedy token parity against
+the first arm — the ROADMAP-item-1 A/B that shows the host-dispatch
+bound dissolving as K rises.
 
 Model/geometry via env: BENCH_MODEL (default llama3-1b on tpu /
 llama3-tiny on cpu), BENCH_CLIENTS, BENCH_TOKENS, BENCH_DECODE_BLOCK,
@@ -65,7 +74,7 @@ def count_params(config) -> int:
     return param_count(config)
 
 
-async def run(platform: str, kv_quant: str = "") -> dict:
+async def run(platform: str, kv_quant: str = "", superstep: int = 0) -> dict:
     from mcp_context_forge_tpu.tpu_local.engine import EngineConfig, TPUEngine
     from mcp_context_forge_tpu.tpu_local.models import MODEL_CONFIGS
 
@@ -77,9 +86,19 @@ async def run(platform: str, kv_quant: str = "") -> dict:
     # the bottleneck (TPU): default 4 there, 1 on CPU (compute-bound)
     decode_block = int(os.environ.get("BENCH_DECODE_BLOCK",
                                       "4" if platform == "tpu" else "1"))
+    # super-step arm: the K-step fused token loop supersedes the legacy
+    # decode_block knob (a single BENCH_SUPERSTEP value flows through
+    # main(); sweep lists fan out to one run() per K)
+    if superstep == 0:
+        env_ss = os.environ.get("BENCH_SUPERSTEP", "")
+        if env_ss and "," not in env_ss:
+            superstep = int(env_ss)
+    if superstep > 0:
+        decode_block = 1
     spec = os.environ.get("BENCH_SPEC", "0") == "1"
     if spec:
         decode_block = 1  # mutually exclusive with multi-step dispatch
+        superstep = 0
     # A/B arm for the overlapped decode pipeline: BENCH_OVERLAP=0 runs the
     # serial dispatch->device_get->bookkeeping loop, =1 (default) overlaps
     # host work behind device execution
@@ -106,6 +125,7 @@ async def run(platform: str, kv_quant: str = "") -> dict:
                           prefill_buckets=(64,),
                           dtype="bfloat16" if platform == "tpu" else "float32",
                           attn_impl="auto", decode_block=decode_block,
+                          superstep=max(1, superstep),
                           decode_overlap=overlap,
                           step_sample_every=sample_every,
                           spec_decode=spec, quant=quant, kv_quant=kv_quant,
@@ -150,6 +170,7 @@ async def run(platform: str, kv_quant: str = "") -> dict:
                            "fast" if platform == "tpu" else "full"))
         await one()  # primes the dispatch loop end-to-end (already compiled)
         steps0 = engine.stats.decode_steps
+        dispatches0 = engine.stats.decode_dispatches
         spec0 = engine.stats.spec_tokens
         overlap0 = engine.stats.overlap_steps
         drains0 = engine.stats.pipeline_drains
@@ -161,6 +182,7 @@ async def run(platform: str, kv_quant: str = "") -> dict:
         intervals = sorted(i for _, iv in results for i in iv[1:])  # drop TTFT
         tokens_per_s = total / wall
         steps = engine.stats.decode_steps - steps0
+        dispatches = engine.stats.decode_dispatches - dispatches0
         out = {
             "metric": "tpu_local_decode_tokens_per_s",
             "value": round(tokens_per_s, 2),
@@ -172,6 +194,12 @@ async def run(platform: str, kv_quant: str = "") -> dict:
             "tokens": total,
             "wall_s": round(wall, 3),
             "decode_block": decode_block, "batch_buckets": buckets,
+            # K-step fused token loop: each decode dispatch retires up to
+            # superstep tokens/slot in ONE host sync — syncs/token is the
+            # number token-loop fusion exists to drive toward 1/K
+            "superstep": config.fused_steps,
+            "decode_dispatches": dispatches,
+            "host_syncs_per_token": round(dispatches / max(1, total), 4),
             "spec_decode": spec,
             "decode_overlap": overlap,
             "overlap_steps": engine.stats.overlap_steps - overlap0,
@@ -261,20 +289,61 @@ async def run(platform: str, kv_quant: str = "") -> dict:
         await engine.stop()
 
 
+def _parity_rate(base_streams, arm_streams) -> float:
+    """Per-position greedy token agreement across paired streams (1.0 =
+    byte-identical)."""
+    matched = positions = 0
+    for a, b in zip(base_streams, arm_streams):
+        positions += max(len(a), len(b))
+        matched += sum(1 for x, y in zip(a, b) if x == y)
+    return round(matched / max(1, positions), 4)
+
+
+def _superstep_sweep() -> list[int]:
+    """K values of a BENCH_SUPERSTEP sweep ('1,4,8,16'); empty for a
+    single/unset value (which run() consumes directly)."""
+    raw = os.environ.get("BENCH_SUPERSTEP", "")
+    if "," not in raw:
+        return []
+    return [int(v) for v in raw.split(",") if v.strip()]
+
+
 def main() -> dict:
     platform = pin_platform()
-    out = asyncio.run(run(platform))
+    sweep = _superstep_sweep()
+    out = asyncio.run(run(platform, superstep=sweep[0] if sweep else 0))
     base_streams = out.pop("token_streams")
+    if sweep:
+        # superstep A/B: one arm per K, all greedy on identical prompts —
+        # host syncs per token must fall ~1/K while streams stay
+        # byte-identical to the first arm (exact fused-decode parity)
+        arm_keys = ("superstep", "value", "decode_steps",
+                    "decode_dispatches", "host_syncs_per_token",
+                    "device_idle_frac", "live_roofline")
+        arms = [{**{k: out[k] for k in arm_keys}, "token_parity_rate": 1.0}]
+        if "hbm_roofline_frac" in out:
+            arms[0]["hbm_roofline_frac"] = out["hbm_roofline_frac"]
+        for k_steps in sweep[1:]:
+            arm = asyncio.run(run(platform, superstep=k_steps))
+            arm_streams = arm.pop("token_streams")
+            summary = {**{k: arm[k] for k in arm_keys},
+                       "token_parity_rate": _parity_rate(base_streams,
+                                                         arm_streams)}
+            if "hbm_roofline_frac" in arm:
+                summary["hbm_roofline_frac"] = arm["hbm_roofline_frac"]
+            arms.append(summary)
+        out["superstep_ab"] = {"arms": arms}
     if os.environ.get("BENCH_KV_QUANT", "0") == "1":
         # A/B arm: same byte budget, int8 paged KV. Prompts are greedy and
         # identical across arms, so per-position token agreement measures
         # quantization drift directly (1.0 = byte-identical streams).
-        arm = asyncio.run(run(platform, kv_quant="int8"))
+        # the int8 arm must run at the SAME fused K as the baseline it is
+        # compared against (under a sweep, run() sees the comma value and
+        # would fall back to BENCH_DECODE_BLOCK — conflating the fusion
+        # win with the quantization win)
+        arm = asyncio.run(run(platform, kv_quant="int8",
+                              superstep=sweep[0] if sweep else 0))
         arm_streams = arm.pop("token_streams")
-        matched = positions = 0
-        for a, b in zip(base_streams, arm_streams):
-            positions += max(len(a), len(b))
-            matched += sum(1 for x, y in zip(a, b) if x == y)
         keys = ("value", "kv_pages_capacity", "kv_pages_peak",
                 "decode_steps", "device_idle_frac")
         out["kv_quant_ab"] = {
@@ -283,7 +352,7 @@ def main() -> dict:
             "page_capacity_ratio": round(
                 arm["kv_pages_capacity"] / max(1, out["kv_pages_capacity"]),
                 3),
-            "token_parity_rate": round(matched / max(1, positions), 4),
+            "token_parity_rate": _parity_rate(base_streams, arm_streams),
         }
     return out
 

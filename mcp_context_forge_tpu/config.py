@@ -362,6 +362,14 @@ class Settings(BaseModel):
     tpu_local_sp_impl: Literal["none", "ring", "ulysses"] = "none"
     tpu_local_sp_threshold: int = 1024  # prefill BUCKETS > this use SP prefill
     tpu_local_decode_block: int = 1     # decode steps fused per dispatch
+    # K-step decode super-steps (token-loop fusion): one jitted on-device
+    # loop runs K decode iterations — fused sampling, in-loop paged-KV
+    # append, per-slot budget/EOS masking freezing finished rows — and
+    # the host syncs once per K tokens. Supersedes tpu_local_decode_block
+    # (legacy alias). Raise on host-dispatch-bound TPU decode (8-16);
+    # trade: up to K-1 tokens of lookahead compute waste past EOS, and
+    # admissions wait out the in-flight super-step (TTFT vs throughput).
+    tpu_local_superstep: int = 1
     # depth-2 overlapped decode pipeline: step N+1 dispatches fed by step
     # N's on-device sampled tokens while N's results transfer and emit one
     # step behind — host bookkeeping hides behind device execution. Drain

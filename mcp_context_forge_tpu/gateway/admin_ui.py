@@ -194,6 +194,7 @@ function fnum(v){
 }
 async function renderEngine(stats){
   const order = ["requests","prompt_tokens","completion_tokens","decode_steps",
+                 "decode_dispatches",
                  "prefill_batches","queue_depth","chunking","kv_pages_in_use",
                  "kv_bytes_in_use","kv_quant",
                  "prefix_hits","prefix_hit_tokens","spec_steps","spec_tokens",
@@ -270,7 +271,8 @@ async function renderEngine(stats){
           <div class="card"><b>${cell((intro.phase_sampling||{}).samples)}</b><span>phase_samples</span></div>
         </div>`;
       const cols = ["seq","kind","batch","width","bucket","ctx_pages",
-                    "duration_ms","gap_ms","tokens","mfu","hbm_frac",
+                    "duration_ms","gap_ms","tokens","superstep","frozen",
+                    "mfu","hbm_frac",
                     "phases","queue_depth","kv_pages_in_use"];
       const body = (intro.steps || []).slice().reverse().map(s =>
         "<tr>" + cols.map(c => `<td>${

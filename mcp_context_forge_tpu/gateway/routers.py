@@ -786,6 +786,10 @@ document.getElementById("f").onsubmit = async (e) => {
             "prompt_tokens": stats.prompt_tokens,
             "completion_tokens": stats.completion_tokens,
             "decode_steps": stats.decode_steps,
+            # host syncs: one retire per dispatch; steps/dispatches ≈ the
+            # effective superstep K (token-loop fusion, perf_decode.md)
+            "decode_dispatches": stats.decode_dispatches,
+            "superstep": engine.config.fused_steps,
             "prefill_batches": stats.prefill_batches,
             "prefill_requests": stats.prefill_requests,
             "queue_depth": stats.queue_depth,

@@ -119,7 +119,19 @@ class PrometheusRegistry:
         )
         self.llm_step_tokens_per_sec = Gauge(
             "mcpforge_llm_step_tokens_per_sec",
-            "Tokens emitted per second by the last engine step",
+            "Tokens emitted per second by the last engine step (over the "
+            "true retire-to-retire step wall, so superstep K>1 and the "
+            "overlap pipeline both report truthfully)",
+            ["replica"], registry=self.registry,
+        )
+        # K-step super-step accounting: tokens retired per device
+        # dispatch (≈ batch × superstep at steady state). One host sync
+        # retires this many tokens — the token-loop-fusion win is this
+        # gauge rising while dispatch-gap stays flat
+        self.llm_tokens_per_dispatch = Gauge(
+            "mcpforge_llm_tokens_per_dispatch",
+            "Tokens emitted by the last decode dispatch (superstep K>1 "
+            "retires up to K per slot per host sync)",
             ["replica"], registry=self.registry,
         )
         # overlapped-decode health: the gap histogram is the host-side

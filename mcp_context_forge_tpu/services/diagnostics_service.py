@@ -74,6 +74,8 @@ def engine_introspection(engine: Any, limit: int = 64) -> dict[str, Any]:
         "max_batch": engine.config.max_batch,
         "queue_depth": stats.queue_depth,
         "decode_steps": stats.decode_steps,
+        "decode_dispatches": stats.decode_dispatches,
+        "superstep": engine.config.fused_steps,
         "prefill_batches": stats.prefill_batches,
         "chunking": stats.chunking,
         # overlapped-pipeline health (docs/perf_decode.md): device-fed

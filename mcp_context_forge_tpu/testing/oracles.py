@@ -572,6 +572,34 @@ def _dirty_tracking_spec(mod: types.ModuleType) -> None:
     assert not alloc.dirty
 
 
+def _pregrant_block_spec(mod: types.ModuleType) -> None:
+    """Super-step pre-grant contract (token-loop fusion): ONE call grants
+    a K-token decode block's pages and returns the usable token budget.
+    The off-by-one space here — input token at position n_ctx-1, the
+    LAST sampled token's KV deferred to the next dispatch — is exactly
+    where a silent mutant truncates streams or overruns granted pages."""
+    PA = mod.PageAllocator
+    alloc = PA(num_pages=8, page_size=4, max_slots=2, max_pages_per_slot=4)
+    assert alloc.allocate_slot(0, 4)            # 1 page, capacity 4
+    # k=0 is a no-op: zero budget AND zero page-growth side effect
+    before = alloc.pages_in_use
+    assert alloc.pregrant_block(0, 9, 0) == 0
+    assert alloc.pages_in_use == before
+    # k=1 at the page edge: capacity n_ctx+k-1 = 4 still fits 1 page
+    assert alloc.pregrant_block(0, 4, 1) == 1
+    assert alloc.pages_in_use == before
+    # crossing the boundary by exactly one token grows exactly one page
+    assert alloc.pregrant_block(0, 4, 2) == 2   # needs 5 tokens -> 2 pages
+    assert alloc.pages_in_use == before + 1
+
+    # partial grant: wants 3 pages' capacity, the pool has one free page
+    dry = PA(num_pages=3, page_size=4, max_slots=2, max_pages_per_slot=4)
+    assert dry.allocate_slot(0, 4)              # 1 page; 1 free remains
+    assert dry.pregrant_block(0, 6, 4) == 3     # capacity 8: min(4, 8-5)
+    # dry pool + slot at its capacity edge: zero budget, never 1/negative
+    assert dry.pregrant_block(0, 9, 4) == 0
+
+
 def _quantize_moe_and_scale_spec(mod: types.ModuleType) -> None:
     """MoE expert-stack quant rules + the embed multiplier knob."""
     import jax.numpy as jnp
@@ -1082,7 +1110,8 @@ TARGETS: dict[str, MutationTarget] = {
         package="mcp_context_forge_tpu.tpu_local.kv",
         oracle=lambda mod: (page_allocator_oracle(mod),
                             _avg_slot_pages_spec(mod),
-                            _dirty_tracking_spec(mod)),
+                            _dirty_tracking_spec(mod),
+                            _pregrant_block_spec(mod)),
         class_name="PageAllocator",
         # _take_page's `key is not None and _cached.get(key) == page` —
         # register_prefix maintains _page_key[page] == key iff

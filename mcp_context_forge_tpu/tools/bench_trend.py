@@ -90,8 +90,10 @@ def _load(path: str) -> dict[str, Any] | None:
 def check_series(prefix: str, entries: list[tuple[int, str]],
                  tolerance: float) -> dict[str, Any]:
     """Compare the newest round's gated metrics against the median of
-    earlier rounds. Files whose "metric" field has no gate (MULTICHIP
-    smoke payloads etc.) are skipped, as are single-capture series."""
+    earlier rounds, per super-step arm (captures carrying the same
+    "superstep" K compare only with each other). Files whose "metric"
+    field has no gate (MULTICHIP smoke payloads etc.) are skipped, as
+    are single-capture series/arms."""
     payloads = [(rnd, path, _load(path)) for rnd, path in entries]
     payloads = [(rnd, path, p) for rnd, path, p in payloads
                 if p is not None and p.get("metric") in _GATES]
@@ -112,39 +114,59 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
         result["skipped"] = ("no gated captures"
                              if not payloads else "single capture")
         return result
-    latest_round, latest_path, latest = payloads[-1]
-    history = payloads[:-1]
-    result["latest"] = os.path.basename(latest_path)
-    for key, higher_better in _GATES[latest.get("metric")]:
-        latest_val = latest.get(key)
-        prior = [p.get(key) for _rnd, _path, p in history
-                 if isinstance(p.get(key), (int, float))]
-        if not isinstance(latest_val, (int, float)) or not prior:
-            continue  # metric absent in the newest or every prior capture
-        baseline = statistics.median(prior)
-        if higher_better:
-            bound = baseline * (1.0 - tolerance)
-            regressed = latest_val < bound
-        else:
-            bound = baseline * (1.0 + tolerance)
-            regressed = latest_val > bound
-        check = {
-            "metric": key,
-            "latest": latest_val,
-            "latest_round": latest_round,
-            "baseline_median": baseline,
-            "prior_rounds": len(prior),
-            "bound": round(bound, 4),
-            "higher_is_better": higher_better,
-            "regressed": regressed,
-        }
-        result["checks"].append(check)
-        if regressed:
-            result["regressions"].append(
-                f"{prefix} r{latest_round:02d} {key}={latest_val} breaches "
-                f"{'>' if not higher_better else '<'} {bound:.4g} "
-                f"(median of {len(prior)} prior round(s) = {baseline}, "
-                f"tolerance {tolerance:.0%})")
+    result["latest"] = os.path.basename(payloads[-1][1])
+    # partition by super-step arm: captures self-describe their fused-K
+    # via the "superstep" field (absent/1 = the classic one-token step),
+    # and a K=8 arm's tok/s must only be judged against K=8 history —
+    # comparing across K would read the fusion win itself as an outlier
+    # baseline and every later unfused capture as a regression
+    groups: dict[int, list[tuple[int, str, dict[str, Any]]]] = {}
+    for item in payloads:
+        groups.setdefault(int(item[2].get("superstep") or 1),
+                          []).append(item)
+    for k_steps, group in sorted(groups.items()):
+        if len(group) < 2:
+            # a new arm's first capture has no history yet — surface it
+            # (a silent zero-check pass would hide the round where the
+            # fused path's numbers first land, the vacuous-pass class)
+            result.setdefault("new_arms", []).append(
+                {"superstep": k_steps,
+                 "capture": os.path.basename(group[-1][1])})
+            continue
+        latest_round, latest_path, latest = group[-1]
+        history = group[:-1]
+        arm = "" if k_steps == 1 else f"@superstep={k_steps}"
+        for key, higher_better in _GATES[latest.get("metric")]:
+            latest_val = latest.get(key)
+            prior = [p.get(key) for _rnd, _path, p in history
+                     if isinstance(p.get(key), (int, float))]
+            if not isinstance(latest_val, (int, float)) or not prior:
+                continue  # metric absent in the newest or every prior capture
+            baseline = statistics.median(prior)
+            if higher_better:
+                bound = baseline * (1.0 - tolerance)
+                regressed = latest_val < bound
+            else:
+                bound = baseline * (1.0 + tolerance)
+                regressed = latest_val > bound
+            check = {
+                "metric": key,
+                "superstep": k_steps,
+                "latest": latest_val,
+                "latest_round": latest_round,
+                "baseline_median": baseline,
+                "prior_rounds": len(prior),
+                "bound": round(bound, 4),
+                "higher_is_better": higher_better,
+                "regressed": regressed,
+            }
+            result["checks"].append(check)
+            if regressed:
+                result["regressions"].append(
+                    f"{prefix}{arm} r{latest_round:02d} {key}={latest_val} "
+                    f"breaches {'>' if not higher_better else '<'} "
+                    f"{bound:.4g} (median of {len(prior)} prior round(s) = "
+                    f"{baseline}, tolerance {tolerance:.0%})")
     return result
 
 
@@ -196,6 +218,10 @@ def main(argv: list[str] | None = None) -> int:
                 print(f"bench-trend: {result['series']}: skipped "
                       f"({result['skipped']})")
                 continue
+            for arm in result.get("new_arms", ()):
+                print(f"bench-trend: {result['series']}"
+                      f"@superstep={arm['superstep']}: first capture "
+                      f"({arm['capture']}) — no history to gate yet")
             for check in result["checks"]:
                 arrow = "REGRESSED" if check["regressed"] else "ok"
                 print(f"bench-trend: {result['series']} {check['metric']}: "

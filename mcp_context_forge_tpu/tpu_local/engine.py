@@ -80,6 +80,18 @@ class EngineConfig:
     # host<->device sync to 1/k per token; tokens decoded past EOS inside a
     # block are discarded (standard multi-step scheduling waste)
     decode_block: int = 1
+    # K-step decode SUPER-STEPS (token-loop fusion, ROADMAP item 1 /
+    # SnapStream-style dataflow decoding): one jitted lax.scan runs
+    # ``superstep`` decode iterations entirely on device — fused
+    # sampling, in-loop paged-KV page append over pre-granted pages, and
+    # per-slot budget/EOS/stop masking so finished rows FREEZE on device
+    # (no post-EOS KV writes, positions stop advancing) — and the host
+    # syncs once per K tokens instead of once per token. Supersedes
+    # ``decode_block`` (kept as a back-compat alias; setting both to
+    # conflicting values is rejected). Composes with decode_overlap
+    # (depth-2 pipeline at super-step granularity) and int8 KV; mutually
+    # exclusive with spec_decode like decode_block>1.
+    superstep: int = 1
     # depth-2 overlapped decode pipeline: dispatch step N+1 fed by step
     # N's device-resident sampled tokens while step N's results transfer
     # and emit one step behind, so host bookkeeping (emission, EOS
@@ -175,6 +187,12 @@ class EngineConfig:
     peak_tflops_per_chip: float = V5E_PEAK_BF16_TFLOPS
     hbm_gbps_per_chip: float = V5E_HBM_GBPS
 
+    @property
+    def fused_steps(self) -> int:
+        """Effective decode iterations fused per device dispatch: the
+        superstep K when set, else the legacy decode_block alias."""
+        return self.superstep if self.superstep > 1 else self.decode_block
+
     @classmethod
     def from_settings(cls, settings) -> "EngineConfig":
         return cls(
@@ -191,6 +209,7 @@ class EngineConfig:
             sp_impl=getattr(settings, "tpu_local_sp_impl", "none"),
             sp_threshold=getattr(settings, "tpu_local_sp_threshold", 1024),
             decode_block=getattr(settings, "tpu_local_decode_block", 1),
+            superstep=getattr(settings, "tpu_local_superstep", 1),
             decode_overlap=getattr(settings, "tpu_local_decode_overlap", True),
             init_timeout_s=getattr(settings, "tpu_local_init_timeout_s", 120.0),
             warmup=getattr(settings, "tpu_local_warmup", False),
@@ -272,6 +291,8 @@ class EngineStats:
         self.prompt_tokens = 0
         self.completion_tokens = 0
         self.decode_steps = 0
+        self.decode_dispatches = 0    # device dispatches (= host syncs);
+        #                               decode_steps / decode_dispatches ≈ K
         self.prefill_batches = 0
         self.prefill_requests = 0
         self.queue_depth = 0
@@ -379,6 +400,13 @@ class TPUEngine:
     """Owns params + KV pool on the mesh; device syncs run on the dispatch
     thread, token emission hops back to the asyncio loop."""
 
+    # static stop-id columns the super-step's on-device freeze checks:
+    # column 0 is always EOS, the rest carry a request's first stop_ids.
+    # STATIC so one compiled super-step serves every request mix; rows
+    # with more stop ids stay host-detected (the device merely fails to
+    # freeze early — streams are unaffected, see _decode_and_sample)
+    _STOP_TBL_WIDTH = 4
+
     def __init__(self, config: EngineConfig, tracer=None, metrics=None,
                  devices: list | None = None):
         # telemetry handles are optional: None means zero-cost no-ops, so
@@ -391,14 +419,28 @@ class TPUEngine:
         if config.decode_block < 1:
             raise ValueError(
                 f"decode_block must be >= 1, got {config.decode_block}")
-        if config.spec_decode and config.decode_block > 1:
-            raise ValueError("spec_decode and decode_block>1 are mutually "
-                             "exclusive (both widen the per-dispatch step)")
+        if config.superstep < 1:
+            raise ValueError(
+                f"superstep must be >= 1, got {config.superstep}")
+        if (config.superstep > 1 and config.decode_block > 1
+                and config.superstep != config.decode_block):
+            raise ValueError(
+                f"superstep={config.superstep} and decode_block="
+                f"{config.decode_block} disagree — set only one "
+                "(decode_block is the legacy alias)")
+        if config.spec_decode and config.fused_steps > 1:
+            raise ValueError("spec_decode and superstep/decode_block>1 are "
+                             "mutually exclusive (both widen the "
+                             "per-dispatch step)")
         if config.spec_decode and config.spec_k < 2:
             raise ValueError(f"spec_k must be >= 2, got {config.spec_k}")
         if config.spec_decode and config.spec_ngram < 1:
             raise ValueError(f"spec_ngram must be >= 1, got {config.spec_ngram}")
         self.config = config
+        # the fused super-step width every decode dispatch scans over
+        # (1 = the classic one-token step); resolved once — the compiled
+        # grid is keyed on it
+        self._k = config.fused_steps
         if config.batch_buckets and not config.warmup:
             # unwarmed engines shrink only to widths already compiled
             # in-process (shrinking never compiles on the serving path);
@@ -908,18 +950,25 @@ class TPUEngine:
                 bsamp = SamplingParams(jnp.zeros((batch,), jnp.float32),
                                        jnp.zeros((batch,), jnp.int32),
                                        jnp.ones((batch,), jnp.float32))
+                # super-step freeze inputs (values are irrelevant to the
+                # compile — jit keys on shape/dtype): zero budgets, empty
+                # stop table
+                wbudget = jnp.zeros((batch,), jnp.int32)
+                wstops = jnp.full((batch, self._STOP_TBL_WIDTH), -1,
+                                  jnp.int32)
                 for ctx_pages in self._ctx_buckets():
                     args = (self.params, self.kv,
                             jnp.zeros((batch,), jnp.int32),
                             jnp.zeros((batch,), jnp.int32),
                             jnp.arange(batch, dtype=jnp.int32),
-                            jnp.zeros((batch,), jnp.int32), bsamp,
-                            jax.random.PRNGKey(0))
+                            jnp.zeros((batch,), jnp.int32), wbudget,
+                            wstops, bsamp, jax.random.PRNGKey(0))
                     if capture:
                         self.cost_registry.capture(
                             "decode", batch, ctx_pages,
                             self._decode_fn(ctx_pages, batch), *args)
-                    block, self.kv = self._decode_fn(ctx_pages, batch)(*args)
+                    (block, _, _), self.kv = \
+                        self._decode_fn(ctx_pages, batch)(*args)
                     block.block_until_ready()
                     shapes += 1
                     if self.config.decode_overlap and self._verify_fns is None:
@@ -934,14 +983,14 @@ class TPUEngine:
                         fb_args = (self.params, self.kv, block,
                                    jnp.zeros((batch,), jnp.int32),
                                    jnp.arange(batch, dtype=jnp.int32),
-                                   jnp.zeros((batch,), jnp.int32), bsamp,
-                                   jax.random.PRNGKey(0))
+                                   jnp.zeros((batch,), jnp.int32), wbudget,
+                                   wstops, bsamp, jax.random.PRNGKey(0))
                         if capture:
                             self.cost_registry.capture(
                                 "decode_fb", batch, ctx_pages,
                                 self._decode_fb_fn(ctx_pages, batch),
                                 *fb_args)
-                        block, self.kv = self._decode_fb_fn(
+                        (block, _, _), self.kv = self._decode_fb_fn(
                             ctx_pages, batch)(*fb_args)
                         block.block_until_ready()
                         shapes += 1
@@ -1018,35 +1067,74 @@ class TPUEngine:
         return out.reshape(B, K), kv
 
     def _decode_and_sample(self, params, kv, tokens, positions, slot_ids,
-                           seq_lens, sampling: SamplingParams, key,
+                           seq_lens, budgets, stop_tbl,
+                           sampling: SamplingParams, key,
                            ctx_pages: int | None = None):
-        """k fused decode steps via lax.scan (k = config.decode_block):
-        one dispatch + one device_get per k tokens. ``ctx_pages`` is the
-        static context-width bucket. Returns ([k, B] tokens, kv)."""
-        k = self.config.decode_block
-        # the write mask is fixed for the WHOLE block from the initial
-        # lens (inside the scan lens increment for every row, so a
-        # len-derived mask would "activate" idle rows on later sub-steps)
+        """One decode SUPER-STEP: k = config.fused_steps decode iterations
+        as a single jitted lax.scan — fused sampling, in-loop paged-KV
+        append over pre-granted pages, and per-slot budget/EOS/stop
+        masking so finished rows FREEZE on device instead of burning a
+        host round-trip per token (the SnapStream-style token-loop
+        fusion of ROADMAP item 1).
+
+        ``budgets`` [B] int32 caps how many of the k sampled tokens are
+        real per row (max_tokens remainder ∧ granted page capacity);
+        ``stop_tbl`` [B, _STOP_TBL_WIDTH] int32 carries each row's EOS +
+        stop ids (-1 padding, never a real token). A frozen row (EOS/stop
+        sampled, or budget exhausted) stops writing KV and stops
+        advancing positions/lens — so int8 page scales never creep on
+        post-EOS garbage — while the fixed-shape compute rides along
+        masked. The host stays authoritative at retire (_emit re-checks
+        every finish condition), so a stop id beyond the static table
+        width costs only wasted lookahead compute, never a wrong stream.
+
+        Returns ((tokens [k, B], valid [k, B] bool, done [B] bool), kv):
+        valid[j, b] marks a token the host should emit; done[b] is the
+        device's end-of-stream verdict, retired in ONE readback."""
+        k = self._k
+        # rows with work this dispatch (inactive slots — empty or
+        # mid-chunk-prefill — never write; the mask below derives from
+        # the INITIAL lens, not the in-scan incremented ones)
         active = seq_lens > 0
 
-        def step(carry, step_key):
-            step_tokens, step_positions, step_lens, step_kv = carry
+        def step(carry, xs):
+            (step_tokens, step_positions, step_lens, done, prev_valid,
+             step_kv) = carry
+            j, step_key = xs
+            # sub-step j writes the KV of its INPUT token — sampled at
+            # j-1, or host/feedback-fed at j=0, always a real emitted
+            # token — so the write mask trails validity by one sub-step,
+            # and a done row never writes its terminal token's KV
+            # (exactly the serial engine, which never re-dispatches a
+            # finished request)
             logits, step_kv = decode_step(params, self.model_config,
-                                          step_tokens, step_positions, step_kv,
-                                          slot_ids, step_lens,
+                                          step_tokens, step_positions,
+                                          step_kv, slot_ids, step_lens,
                                           ctx_pages=ctx_pages,
-                                          write_mask=active)
+                                          write_mask=(active & prev_valid
+                                                      & ~done))
             sampled = sample_tokens(logits, sampling, step_key)
-            return (sampled, step_positions + 1, step_lens + 1, step_kv), sampled
+            valid = active & ~done & (j < budgets)
+            hit_stop = jnp.any(sampled[:, None] == stop_tbl, axis=1)
+            done = done | (valid & hit_stop)
+            next_positions = jnp.where(valid, step_positions + 1,
+                                       step_positions)
+            next_lens = jnp.where(valid, step_lens + 1, step_lens)
+            return ((sampled, next_positions, next_lens, done, valid,
+                     step_kv), (sampled, valid))
 
+        B = tokens.shape[0]
         keys = jax.random.split(key, k)
-        (_, _, _, kv), all_tokens = jax.lax.scan(
-            step, (tokens, positions, seq_lens, kv), keys)
-        return all_tokens, kv
+        carry0 = (tokens, positions, seq_lens,
+                  jnp.zeros((B,), dtype=bool), active, kv)
+        (_, _, _, done, _, kv), (all_tokens, all_valid) = jax.lax.scan(
+            step, carry0, (jnp.arange(k), keys))
+        return (all_tokens, all_valid, done), kv
 
     def _decode_and_sample_fb(self, params, kv, prev_block, positions,
-                              slot_ids, seq_lens, sampling: SamplingParams,
-                              key, ctx_pages: int | None = None):
+                              slot_ids, seq_lens, budgets, stop_tbl,
+                              sampling: SamplingParams, key,
+                              ctx_pages: int | None = None):
         """Device-token-feedback decode (overlapped pipeline steady state):
         the input token is the PREVIOUS dispatch's last sampled token —
         row k-1 of its [k, B] block — which never left the device, so the
@@ -1054,8 +1142,8 @@ class TPUEngine:
         donated: the retire path still reads it back for emission while
         this step executes."""
         return self._decode_and_sample(params, kv, prev_block[-1], positions,
-                                       slot_ids, seq_lens, sampling, key,
-                                       ctx_pages=ctx_pages)
+                                       slot_ids, seq_lens, budgets, stop_tbl,
+                                       sampling, key, ctx_pages=ctx_pages)
 
     # --------------------------------------------------------------- lifecycle
 
@@ -1873,8 +1961,8 @@ class TPUEngine:
             chunk = chunk[:min(K, remaining)]  # active => remaining >= 1
             # one allocator call per slot (not one per drafted token): the
             # usable width falls out of the granted token capacity
-            capacity = self.allocator.grow_slot(slot, p0 + len(chunk))
-            usable = max(0, min(len(chunk), capacity - p0))
+            # (n_ctx = p0 + 1: the verify chunk's first token sits at p0)
+            usable = self.allocator.pregrant_block(slot, p0 + 1, len(chunk))
             widths[slot] = usable
             if usable == 0:
                 # page pool exhausted mid-stream: the request truncates
@@ -1900,6 +1988,7 @@ class TPUEngine:
             self.params, self.kv, jnp.asarray(tokens), jnp.asarray(positions),
             jnp.arange(B, dtype=jnp.int32), sampling, key)
         self.stats.decode_steps += 1
+        self.stats.decode_dispatches += 1
         self.stats.spec_steps += 1
         block_host = jax.device_get(block)  # [B, K]  # lint: allow[host-sync-in-hot-path] spec verify: host must compare drafts to accept
         self._last_step_done_ts = time.monotonic()
@@ -1950,7 +2039,7 @@ class TPUEngine:
         tokens are discarded at retire, exactly like tokens past EOS
         inside a decode_block."""
         config = self.config
-        k = config.decode_block
+        k = self._k
         if self._phase_sample_due():
             # sampled steps run SERIALLY so the timed block_until_ready
             # window attributes this one step alone (a device-fed step's
@@ -2121,7 +2210,7 @@ class TPUEngine:
                     ctx_now = self._ctx_bucket_for(max(
                         (len(r.prompt_ids) + len(r.generated)
                          for r in self._running.values()), default=1)
-                        + config.decode_block)
+                        + self._k)
                     if (target in self._warmed_widths
                             or (target, ctx_now) in self._decode_fns):
                         self._batch_width = target
@@ -2131,9 +2220,9 @@ class TPUEngine:
         return config.max_batch
 
     def _decode_dispatch(self, B: int, feed: dict[str, Any] | None
-                         ) -> dict[str, Any]:
-        """Build and submit one decode dispatch of width ``B``; returns the
-        in-flight record the matching _decode_retire consumes.
+                         ) -> dict[str, Any]:  # lint: hot-path
+        """Build and submit one decode SUPER-STEP dispatch of width ``B``;
+        returns the in-flight record the matching _decode_retire consumes.
 
         ``feed`` is the previous, still-in-flight step: its [k, B] sampled
         block (device-resident) supplies this step's input token, and host
@@ -2144,7 +2233,7 @@ class TPUEngine:
         rows advance by exactly ``budget`` tokens and dead rows' lookahead
         output is discarded wholesale."""
         config = self.config
-        k = config.decode_block
+        k = self._k
         # phase attribution (opt-in sampling): this dispatch runs serial
         # (the overlapped caller drained first) and times each phase
         build_ts = time.monotonic()
@@ -2156,6 +2245,12 @@ class TPUEngine:
         temperature = np.zeros((B,), dtype=np.float32)
         top_k = np.zeros((B,), dtype=np.int32)
         top_p = np.ones((B,), dtype=np.float32)
+        # device-side freeze inputs: per-slot token budgets (max_tokens
+        # remainder ∧ granted pages) and the EOS/stop-id table — what
+        # lets a finished row freeze INSIDE the super-step without a
+        # host round-trip
+        budget_arr = np.zeros((B,), dtype=np.int32)
+        stop_tbl = np.full((B, self._STOP_TBL_WIDTH), -1, dtype=np.int32)
         # per-slot budget within this block: page capacity and max_tokens cap
         # how many of the k decoded tokens are usable
         budgets: dict[int, int] = {}
@@ -2175,7 +2270,7 @@ class TPUEngine:
             temperature[slot] = request.temperature
             top_k[slot] = request.top_k
             top_p[slot] = request.top_p
-            # extend pages as far as the block can reach, in ONE allocator
+            # pre-grant pages for the whole super-step in ONE allocator
             # call; writes beyond the granted range land on the reserved
             # trash page and their tokens are discarded via the budget
             remaining = max(0, request.max_tokens - len(request.generated)
@@ -2183,8 +2278,7 @@ class TPUEngine:
             want = min(k, remaining)
             usable = 0
             if want > 0:
-                capacity = self.allocator.grow_slot(slot, n_ctx + want - 1)
-                usable = max(0, min(want, capacity - (n_ctx - 1)))
+                usable = self.allocator.pregrant_block(slot, n_ctx, want)
                 if usable == 0:
                     # page pool exhausted mid-stream: the request truncates
                     # (finish happens at retire so the PREVIOUS step's
@@ -2193,6 +2287,10 @@ class TPUEngine:
                     if self.metrics is not None:
                         self.metrics.llm_kv_alloc_failures.inc()
             budgets[slot] = usable
+            budget_arr[slot] = usable
+            stops = (self.tokenizer.eos_id,) + tuple(
+                request.stop_ids)[:self._STOP_TBL_WIDTH - 1]
+            stop_tbl[slot, :len(stops)] = stops
         sync_start = time.monotonic()
         self._sync_tables()
         sync_s = time.monotonic() - sync_start
@@ -2216,15 +2314,19 @@ class TPUEngine:
             self.metrics.llm_dispatch_gap.labels(
                 replica=self.config.replica_id).observe(gap_s)
         if feed is None:
-            block_tokens, self.kv = self._decode_fn(ctx_pages, B)(
-                self.params, self.kv, jnp.asarray(tokens),
-                jnp.asarray(positions), jnp.arange(B, dtype=jnp.int32),
-                jnp.asarray(seq_lens), sampling, key)
+            (block_tokens, block_valid, block_done), self.kv = \
+                self._decode_fn(ctx_pages, B)(
+                    self.params, self.kv, jnp.asarray(tokens),
+                    jnp.asarray(positions), jnp.arange(B, dtype=jnp.int32),
+                    jnp.asarray(seq_lens), jnp.asarray(budget_arr),
+                    jnp.asarray(stop_tbl), sampling, key)
         else:
-            block_tokens, self.kv = self._decode_fb_fn(ctx_pages, B)(
-                self.params, self.kv, feed["block"], jnp.asarray(positions),
-                jnp.arange(B, dtype=jnp.int32), jnp.asarray(seq_lens),
-                sampling, key)
+            (block_tokens, block_valid, block_done), self.kv = \
+                self._decode_fb_fn(ctx_pages, B)(
+                    self.params, self.kv, feed["block"],
+                    jnp.asarray(positions), jnp.arange(B, dtype=jnp.int32),
+                    jnp.asarray(seq_lens), jnp.asarray(budget_arr),
+                    jnp.asarray(stop_tbl), sampling, key)
         dispatched_ts = time.monotonic()
         phases: dict[str, float] | None = None
         if sampled:
@@ -2239,22 +2341,32 @@ class TPUEngine:
                 "device_compute_ms": (ready_ts - dispatched_ts) * 1000,
             }
         try:
-            block_tokens.copy_to_host_async()  # D2H overlaps device compute
+            # D2H overlaps device compute (tokens + the super-step's
+            # valid/done masks all retire in one readback)
+            block_tokens.copy_to_host_async()
+            block_valid.copy_to_host_async()
+            block_done.copy_to_host_async()
         except AttributeError:
             pass
         self.stats.decode_steps += k
-        return {"block": block_tokens, "budgets": budgets, "reqs": reqs,
-                "truncated": truncated, "B": B, "ctx_pages": ctx_pages,
+        self.stats.decode_dispatches += 1
+        return {"block": block_tokens, "valid": block_valid,
+                "done": block_done, "budgets": budgets, "reqs": reqs,
+                "truncated": truncated, "B": B, "k": k,
+                "ctx_pages": ctx_pages,
                 "batch": len(reqs), "dispatch_ts": started, "gap_s": gap_s,
                 "fed": feed is not None, "build_ts": build_ts,
                 "phases": phases}
 
-    def _decode_retire(self, inflight: dict[str, Any]) -> None:
-        """Fetch and emit one dispatched decode step. Under overlap this
-        runs while the NEXT step executes on device, so every line here is
-        off the device's critical path."""
+    def _decode_retire(self, inflight: dict[str, Any]) -> None:  # lint: hot-path
+        """Fetch and emit one dispatched decode SUPER-STEP: the [k, B]
+        token block plus the device's valid/done masks come back in ONE
+        readback, and up to k tokens per slot emit per sync. Under
+        overlap this runs while the NEXT step executes on device, so
+        every line here is off the device's critical path."""
         fetch_ts = time.monotonic()
-        block_host = np.asarray(inflight["block"])  # [k, B]  # lint: allow[host-sync-in-hot-path] retire-side read-back, overlapped by the in-flight dispatch
+        block_host, valid_host, done_host = jax.device_get(  # lint: allow[host-sync-in-hot-path] retire-side read-back — the ONE host sync per K-token super-step, overlapped by the in-flight dispatch
+            (inflight["block"], inflight["valid"], inflight["done"]))
         done_ts = time.monotonic()
         prev_done_ts = self._last_step_done_ts
         self._last_step_done_ts = done_ts
@@ -2277,6 +2389,10 @@ class TPUEngine:
                 self._finish(request)
                 continue
             for step_i in range(inflight["budgets"][slot]):
+                if not valid_host[step_i][slot]:
+                    # the device froze this row mid-super-step (EOS/stop
+                    # sampled earlier in the block): nothing real follows
+                    break
                 self._emit(request, int(block_host[step_i][slot]))
                 decode_emitted += 1
                 if self._running.get(slot) is not request:
@@ -2301,7 +2417,10 @@ class TPUEngine:
                           tokens=decode_emitted,
                           ctx_pages=inflight["ctx_pages"],
                           gap_ms=inflight["gap_s"] * 1000,
-                          phases=phases, mfu=mfu, hbm_frac=hbm_frac)
+                          phases=phases, mfu=mfu, hbm_frac=hbm_frac,
+                          superstep=inflight["k"],
+                          frozen=int(done_host.sum()),
+                          wall_ms=step_wall_ms)
         if self.metrics is not None:
             self.metrics.llm_device_idle_frac.labels(
                 replica=self.config.replica_id).set(
@@ -2430,7 +2549,10 @@ class TPUEngine:
                      gap_ms: float | None = None,
                      phases: dict[str, float] | None = None,
                      mfu: float | None = None,
-                     hbm_frac: float | None = None) -> None:
+                     hbm_frac: float | None = None,
+                     superstep: int | None = None,
+                     frozen: int | None = None,
+                     wall_ms: float | None = None) -> None:
         """One ring-buffer entry + gauge refresh per device dispatch.
         Runs on the dispatch thread; deque.append and prometheus_client
         ops are both thread-safe, and the asyncio side only ever copies
@@ -2448,6 +2570,12 @@ class TPUEngine:
             "ctx_pages": ctx_pages,             # decode context-width bucket
             "duration_ms": round(dur_ms, 3),
             "tokens": tokens,                   # tokens emitted by this step
+            # decode iterations fused into this dispatch (None for
+            # prefill rows) and rows the device froze mid-super-step —
+            # K>1 accounting: tokens ≈ batch × superstep at steady state,
+            # and ONE host sync retired them all
+            "superstep": superstep,
+            "frozen": frozen,
             "queue_depth": depth,
             "kv_pages_in_use": pages_in_use,
             # host-side stall before this dispatch (decode only; 0 when the
@@ -2474,9 +2602,16 @@ class TPUEngine:
             m.llm_kv_bytes_in_use.labels(
                 replica=self.config.replica_id).set(self.kv_bytes_in_use())
             m.llm_queue_depth.labels(replica=rid).set(depth)
-            if dur_ms > 0 and tokens:
+            # tokens/s over the TRUE per-step wall (retire-to-retire under
+            # the depth-2 overlap — dur_ms there spans ~2 device steps and
+            # would halve the gauge); tokens counts every token this
+            # dispatch emitted, so the gauge stays truthful at superstep>1
+            rate_ms = wall_ms if wall_ms is not None else dur_ms
+            if rate_ms > 0 and tokens:
                 m.llm_step_tokens_per_sec.labels(replica=rid).set(
-                    tokens / (dur_ms / 1e3))
+                    tokens / (rate_ms / 1e3))
+            if superstep is not None and tokens:
+                m.llm_tokens_per_dispatch.labels(replica=rid).set(tokens)
 
     def recent_steps(self, limit: int | None = None) -> list[dict[str, Any]]:
         """Last N step summaries, oldest first (diagnostics surface)."""

@@ -491,6 +491,25 @@ class PageAllocator:
             self._track_peak()
         return len(pages) * self.page_size
 
+    def pregrant_block(self, slot: int, n_ctx: int, k: int) -> int:
+        """Pre-grant pages for a K-token decode super-step in ONE call;
+        returns the usable token budget (0..k).
+
+        ``n_ctx`` counts every token that exists for the row INCLUDING
+        the incoming input token (0-based position n_ctx-1, whose KV is
+        written this dispatch). The k sampled tokens land at positions
+        n_ctx-1+1.., but the LAST one's KV is written only when it
+        becomes the next dispatch's input — so capacity must cover
+        n_ctx + k - 1 tokens, and the budget is how many sampled tokens
+        fit the granted capacity. Growth dirties the slot's block-table
+        row exactly when new pages were taken, so the host->device table
+        sync stays a once-per-super-step reconcile (tables() clears the
+        dirty set at upload)."""
+        if k <= 0:
+            return 0
+        capacity = self.grow_slot(slot, n_ctx + k - 1)
+        return max(0, min(k, capacity - (n_ctx - 1)))
+
     def move_slot(self, old: int, new: int) -> None:
         """Reassign a slot's pages to another (free) slot id — pages are
         slot-agnostic, so compaction moves only this mapping (the device

@@ -16,11 +16,13 @@ from mcp_context_forge_tpu.tpu_local.sampling import SamplingParams
 
 MODEL = os.environ.get("BENCH_MODEL", "llama3-1b")
 BATCH = int(os.environ.get("BENCH_BATCH", "8"))
-BLOCK = int(os.environ.get("BENCH_DECODE_BLOCK", "4"))
+# K-step super-step width (BENCH_DECODE_BLOCK honored as legacy alias)
+BLOCK = int(os.environ.get("BENCH_SUPERSTEP",
+                           os.environ.get("BENCH_DECODE_BLOCK", "4")))
 
 cfg = EngineConfig(model=MODEL, max_batch=BATCH, max_seq_len=512,
                    page_size=16, num_pages=512, prefill_buckets=(64,),
-                   dtype="bfloat16", attn_impl="auto", decode_block=BLOCK)
+                   dtype="bfloat16", attn_impl="auto", superstep=BLOCK)
 t0 = time.monotonic()
 eng = TPUEngine(cfg)
 print(f"engine init (params+kv alloc): {time.monotonic()-t0:.1f}s",
@@ -64,20 +66,29 @@ for rep in range(3):
 dt = np.zeros((B,), np.int32) + 7
 pos = np.zeros((B,), np.int32) + len(prompt)
 lens = pos + 1
+# super-step freeze inputs: full budget per row, EOS-only stop table
+budgets = jnp.full((B,), BLOCK, jnp.int32)
+stop_tbl = jnp.full((B, TPUEngine._STOP_TBL_WIDTH), -1, jnp.int32)
+stop_tbl = stop_tbl.at[:, 0].set(eng.tokenizer.eos_id)
+ctx_pages = eng._ctx_bucket_for(int(lens.max()) + BLOCK)
+decode = eng._decode_fn(ctx_pages, B)
 t0 = time.monotonic()
-out, eng.kv = eng._decode(eng.params, eng.kv, jnp.asarray(dt), jnp.asarray(pos),
-                          jnp.arange(B, dtype=jnp.int32), jnp.asarray(lens),
-                          samp, key)
+(out, _valid, _done), eng.kv = decode(
+    eng.params, eng.kv, jnp.asarray(dt), jnp.asarray(pos),
+    jnp.arange(B, dtype=jnp.int32), jnp.asarray(lens), budgets, stop_tbl,
+    samp, key)
 out.block_until_ready()
-print(f"decode block={BLOCK} compile+run: {time.monotonic()-t0:.1f}s", flush=True)
+print(f"decode superstep={BLOCK} compile+run: {time.monotonic()-t0:.1f}s",
+      flush=True)
 
 N = 20
 t0 = time.monotonic()
 for i in range(N):
-    out, eng.kv = eng._decode(eng.params, eng.kv, jnp.asarray(dt),
-                              jnp.asarray(pos), jnp.arange(B, dtype=jnp.int32),
-                              jnp.asarray(lens), samp, key)
-    _ = jax.device_get(out)
+    (out, valid, done), eng.kv = decode(
+        eng.params, eng.kv, jnp.asarray(dt), jnp.asarray(pos),
+        jnp.arange(B, dtype=jnp.int32), jnp.asarray(lens), budgets,
+        stop_tbl, samp, key)
+    _ = jax.device_get((out, valid, done))  # ONE host sync per K tokens
 per = (time.monotonic() - t0) / N
-print(f"decode steady: {per*1000:.2f}ms / block of {BLOCK} "
+print(f"decode steady: {per*1000:.2f}ms / super-step of {BLOCK} "
       f"-> {BATCH*BLOCK/per:.0f} tok/s at batch {BATCH}", flush=True)

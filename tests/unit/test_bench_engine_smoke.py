@@ -63,6 +63,41 @@ def test_bench_engine_phase_sampling_arm(bench_env, monkeypatch):
                 "readback_ms", "emit_ms", "total_ms"} == set(row)
 
 
+def test_bench_engine_superstep_sweep_arm(bench_env, monkeypatch):
+    """BENCH_SUPERSTEP=1,8: one arm per K — host syncs per emitted token
+    must drop ~K-fold while greedy streams stay byte-identical (the
+    ROADMAP-item-1 A/B, CPU twin of the TPU roofline run)."""
+    import bench_engine
+
+    monkeypatch.setenv("BENCH_TOKENS", "16")
+    monkeypatch.setenv("BENCH_SUPERSTEP", "1,8")
+    monkeypatch.setattr(bench_engine, "pin_platform", lambda: "cpu")
+    out = bench_engine.main()
+    assert out["superstep"] == 1
+    arms = out["superstep_ab"]["arms"]
+    assert [a["superstep"] for a in arms] == [1, 8]
+    for arm in arms:
+        assert arm["value"] > 0
+        assert arm["token_parity_rate"] == 1.0  # exact fused parity
+        assert "live_roofline" in arm
+    # the tentpole claim, measured: >=4x fewer host syncs per token at K=8
+    assert (arms[0]["host_syncs_per_token"]
+            >= 4 * arms[1]["host_syncs_per_token"]), arms
+    assert arms[1]["decode_dispatches"] < arms[0]["decode_dispatches"]
+
+
+def test_bench_engine_single_superstep_env(bench_env, monkeypatch):
+    """A single BENCH_SUPERSTEP value flows into the engine config and
+    the capture self-describes it (what bench_trend groups arms by)."""
+    import bench_engine
+
+    monkeypatch.setenv("BENCH_SUPERSTEP", "4")
+    out = asyncio.run(bench_engine.run("cpu"))
+    assert out["superstep"] == 4
+    assert out["value"] > 0
+    assert out["host_syncs_per_token"] <= 0.6  # ~1/4 + prefill slack
+
+
 def test_bench_engine_serial_arm(bench_env, monkeypatch):
     import bench_engine
 

@@ -168,3 +168,42 @@ def test_check_series_reports_bounds(tmp_path):
     assert value_check["baseline_median"] == 15.0  # median of 10, 20
     assert value_check["bound"] == 11.25
     assert value_check["regressed"] is False
+
+
+# ------------------------------------------------------- superstep arms
+
+def test_superstep_arms_gate_separately(tmp_path):
+    """Captures self-describe their fused K: a K=8 arm is judged only
+    against K=8 history, so the fusion win never reads as an outlier
+    baseline for K=1 rounds (and vice versa)."""
+    _write_series(tmp_path, "BENCH_TPU", [
+        _capture(14.0),                                  # K=1 history
+        {**_capture(100.0), "superstep": 8},             # K=8 history
+        _capture(14.5),                                  # K=1 newest: fine
+        {**_capture(40.0), "superstep": 8},              # K=8 regressed
+    ])
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert not report["ok"]
+    assert any("superstep=8" in line for line in report["regressions"])
+    # the K=1 pair passed; the K=8 pair produced the regression
+    by_arm = {c["superstep"]: c for r in report["series"]
+              for c in r["checks"] if c["metric"] == "value"}
+    assert by_arm[1]["regressed"] is False
+    assert by_arm[8]["regressed"] is True
+
+
+def test_first_capture_of_a_new_arm_is_surfaced_not_silent(tmp_path, capsys):
+    """A first-of-its-K capture has no history to gate against — the run
+    must SAY so instead of printing nothing (the vacuous-pass class)."""
+    _write_series(tmp_path, "BENCH_TPU", [
+        _capture(14.0), _capture(14.5),                  # K=1: gated
+        {**_capture(100.0), "superstep": 8},             # new arm, newest
+    ])
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert report["ok"] and report["checks"] >= 1       # K=1 still gated
+    series = next(r for r in report["series"] if r["series"] == "BENCH_TPU")
+    assert series["new_arms"] == [
+        {"superstep": 8, "capture": "BENCH_TPU_r03.json"}]
+    assert main(["--root", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "no history to gate yet" in out

@@ -4,12 +4,13 @@ The TPU tunnel flaps: sometimes ``jax.devices()`` hangs or the axon
 backend errors out. This loop runs all round in the background, probing
 the backend in a SUBPROCESS (a wedged runtime can't hang the loop) and —
 whenever the chip is reachable — running the engine bench A/B grid
-(decode_block 1 vs 4, spec_decode off/on) with warmup + the persistent
+(superstep 1/4/8/16, spec_decode off/on, int8) with warmup + the persistent
 compile cache, so the timed region is steady-state.
 
 Artifacts:
 - ``tpu_capture_log.jsonl`` — every attempt (probe failures included)
-- ``BENCH_TPU_r04.json``   — best capture so far + the full A/B table
+- ``BENCH_TPU_r06.json``   — best capture so far + the full A/B table
+  (r05 stays untouched: it is the K=1 baseline the superstep A/B cites)
 
 Usage: ``python tpu_capture.py [--once]`` (loop period via
 TPU_CAPTURE_PERIOD_S, default 600).
@@ -25,27 +26,33 @@ import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 LOG = os.path.join(REPO, "tpu_capture_log.jsonl")
-OUT = os.path.join(REPO, "BENCH_TPU_r05.json")
+# round-6 artifact: the round-5 file is the checked-in K=1 baseline the
+# superstep A/B is defined against — never overwrite it (bench_trend
+# gates each superstep arm against its own history)
+OUT = os.path.join(REPO, "BENCH_TPU_r06.json")
 
 GRID = [
-    # order = information per minute under a FLAPPING tunnel: the round-5
-    # window captured only config 1 before the relay died, and its 87 ms
-    # p50 token latency is dispatch-RTT-bound (every decode step is a
-    # round trip over the axon tunnel), so the block-8 contrast — 8 tokens
-    # per dispatch — is the single most valuable second datum
-    {"BENCH_DECODE_BLOCK": "1", "BENCH_SPEC": "0"},
-    {"BENCH_DECODE_BLOCK": "8", "BENCH_SPEC": "0"},
-    {"BENCH_DECODE_BLOCK": "4", "BENCH_SPEC": "0"},
-    {"BENCH_DECODE_BLOCK": "1", "BENCH_SPEC": "1",
+    # order = information per minute under a FLAPPING tunnel: round 5
+    # measured the decode loop 180x off the HBM roofline and entirely
+    # host-dispatch bound (87 ms p50 = one axon-tunnel round trip per
+    # token), so the K-step SUPER-STEP arms — one host sync per K tokens,
+    # with on-device EOS/budget freeze — are the single most valuable
+    # data: the K=1 baseline then K∈{8,16} contrast measures
+    # hbm_roofline_frac climbing toward the ROADMAP-item-1 >=0.3 target
+    {"BENCH_SUPERSTEP": "1", "BENCH_SPEC": "0"},
+    {"BENCH_SUPERSTEP": "8", "BENCH_SPEC": "0"},
+    {"BENCH_SUPERSTEP": "16", "BENCH_SPEC": "0"},
+    {"BENCH_SUPERSTEP": "4", "BENCH_SPEC": "0"},
+    {"BENCH_SUPERSTEP": "1", "BENCH_SPEC": "1",
      "BENCH_PROMPT_MODE": "repetitive"},
     # int8 on the same model: A/B the bandwidth win directly
-    {"BENCH_DECODE_BLOCK": "4", "BENCH_SPEC": "0", "BENCH_QUANT": "int8"},
+    {"BENCH_SUPERSTEP": "8", "BENCH_SPEC": "0", "BENCH_QUANT": "int8"},
     # decode-width bucketing: 3.6x on the CPU proxy at light load; the
     # open question is the donated-pool re-home cost on real HBM
-    {"BENCH_DECODE_BLOCK": "1", "BENCH_SPEC": "0",
+    {"BENCH_SUPERSTEP": "1", "BENCH_SPEC": "0",
      "BENCH_BATCH_BUCKETS": "1", "BENCH_CLIENTS": "4"},
     # the flagship: Llama-3-8B int8 resident on ONE v5e chip (VERDICT #2)
-    {"BENCH_DECODE_BLOCK": "4", "BENCH_SPEC": "0", "BENCH_QUANT": "int8",
+    {"BENCH_SUPERSTEP": "8", "BENCH_SPEC": "0", "BENCH_QUANT": "int8",
      "BENCH_MODEL": "llama3-8b", "BENCH_CLIENTS": "8"},
     # grouped-GEMM MoE kernel A/B on real silicon (round-5): dense-mask
     # scan vs block-sparse Pallas kernel on the CI-scale mixtral.
@@ -143,7 +150,7 @@ def attempt() -> bool:
                 gateway["note"] = ("engine configs failed TPU init; "
                                    "headline is the engine-free gateway "
                                    "path only")
-            with open(os.path.join(REPO, "BENCH_GATEWAY_TPU_r05.json"),
+            with open(os.path.join(REPO, "BENCH_GATEWAY_TPU_r06.json"),
                       "w") as fh:
                 json.dump(gateway, fh, indent=1)
             log({"event": "gateway_capture", "rps": gateway.get("value")})
@@ -156,7 +163,7 @@ def attempt() -> bool:
     artifact = {
         **best,
         "note": ("post-warmup steady-state capture; persistent compile "
-                 "cache active; see ab_grid for decode_block/spec A-B"),
+                 "cache active; see ab_grid for superstep/spec A-B"),
         "ab_grid": results,
         "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
