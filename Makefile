@@ -1,6 +1,6 @@
 # mcp-context-forge-tpu (reference: 8.7k-line Makefile; the targets that matter)
 
-.PHONY: serve hub lint bench-check test test-py test-fast test-two-process bench bench-engine bench-superstep wrapper masking clean \
+.PHONY: serve hub lint bench-check test test-py test-fast test-two-process bench bench-engine bench-superstep bench-scenarios wrapper masking clean \
 	sanitize sanitize-tsan sanitize-asan
 
 serve:
@@ -51,6 +51,14 @@ bench-engine:
 # token + live roofline per arm (ROADMAP item 1 acceptance sweep)
 bench-superstep:
 	BENCH_SUPERSTEP=1,4,8,16 python bench_engine.py
+
+# SLO-asserting gateway scenario harness (docs/load_harness.md): burst /
+# diurnal ramp / mixed chat+tools+A2A+federation / chaos replica-kill
+# under load, each gated through /admin/slo delta windows; captures land
+# as BENCH_SCENARIO_*_r<N>.json and bench-check gates them per arm.
+# CPU smoke variant runs in tier-1 (tests/unit/test_bench_scenarios_smoke.py).
+bench-scenarios:
+	python bench_gateway_scenarios.py
 
 # real HF-format checkpoint built in-tree (BPE tokenizer.json + safetensors;
 # the model memorizes its corpus so greedy decode is assertable)

@@ -1,0 +1,479 @@
+"""Gateway scenario load harness: burst / ramp / mixed / chaos, SLO-gated.
+
+ROADMAP item 5's measurement layer: gateway RPS plateaued at ~900–1200
+req/s across r01–r05 while the engine got 4–60× faster, and the next
+round of work (shared-state scale-out, disaggregated serving) needs
+scenario-shaped, SLO-asserting evidence — not another single-number
+throughput run. Four scenarios against a REAL-socket gateway with the
+engine replica pool behind it:
+
+- **burst**: baseline → concurrency spike → cooldown (queueing recovery);
+- **ramp**: compressed diurnal curve (staircase up, staircase down);
+- **mixed**: interleaved chat / MCP tools-call / federated tools-call /
+  A2A traffic in one closed loop (the four production wire shapes);
+- **chaos**: replica kill + rolling reload under sustained load —
+  in-flight streams must finish on survivors with zero loss/duplication
+  (token-level parity vs an uninterrupted reference engine), and the
+  SLO window must REPORT the breach rather than hang or vacuously pass.
+
+Each scenario evaluates TTFT/TPOT/queue-wait/http-phase SLOs through
+``GET /admin/slo`` per-consumer delta windows (its own named window, so
+nothing shreds the deltas) and writes a ``BENCH_SCENARIO_<NAME>_r<N>.json``
+capture; ``tools/bench_trend.py`` gates each scenario series per arm in
+``make bench-check``. A run that produces ZERO captures exits non-zero —
+the PR-6 no-vacuous-pass rule.
+
+Env knobs:
+    BENCH_SCENARIO_SMOKE=1       tiny totals (tier-1 CPU smoke)
+    BENCH_SCENARIO_MODEL         model (default llama3-tiny / llama3-1b on tpu)
+    BENCH_SCENARIO_ROUND=N       capture round suffix (default: next free)
+    BENCH_SCENARIO_DIR           capture directory (default: repo root)
+    BENCH_SCENARIO_WRITE=0       skip writing captures (still prints JSON)
+    BENCH_SCENARIO_PARITY=0      skip the chaos token-parity reference run
+                                 (double-commits device memory; off on TPU)
+    BENCH_SCENARIO_ENFORCE_SLO=1 breached SLO windows fail the run
+    BENCH_SCENARIO_ONLY=a,b      run a subset of scenarios
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)) or ".")
+
+SCENARIOS = ("burst", "ramp", "mixed", "chaos")
+
+
+def _smoke() -> bool:
+    return os.environ.get("BENCH_SCENARIO_SMOKE") == "1"
+
+
+def _scale() -> dict:
+    """Request/concurrency budgets; smoke keeps tier-1 under seconds."""
+    if _smoke():
+        return {"burst_phases": [("baseline", 2, 6), ("burst", 8, 24),
+                                 ("cooldown", 2, 6)],
+                "ramp_steps": [2, 4, 2], "ramp_requests": 6,
+                "mixed_concurrency": 4, "mixed_requests": 16,
+                "chaos_concurrency": 3, "chaos_requests": 9,
+                "chaos_prompts": 4, "max_tokens": 6}
+    return {"burst_phases": [("baseline", 4, 60), ("burst", 64, 400),
+                             ("cooldown", 4, 60)],
+            "ramp_steps": [4, 8, 16, 32, 16, 8, 4], "ramp_requests": 50,
+            "mixed_concurrency": 16, "mixed_requests": 240,
+            "chaos_concurrency": 8, "chaos_requests": 64,
+            "chaos_prompts": 6, "max_tokens": 16}
+
+
+async def _make_gateway(platform: str, replicas: int = 2):
+    """Engine-enabled gateway with the replica pool, on a real socket
+    (bench.py's AppRunner/TCPSite plumbing)."""
+    from bench import _serve_tcp
+
+    from mcp_context_forge_tpu.config import load_settings
+    from mcp_context_forge_tpu.gateway.app import build_app
+
+    model = os.environ.get(
+        "BENCH_SCENARIO_MODEL",
+        "llama3-1b" if platform == "tpu" else "llama3-tiny")
+    if _smoke():
+        model = os.environ.get("BENCH_SCENARIO_MODEL", "llama3-test")
+    env = {
+        "MCPFORGE_DATABASE_URL": "sqlite:///:memory:",
+        "MCPFORGE_PLUGINS_ENABLED": "false",
+        "MCPFORGE_TPU_LOCAL_ENABLED": "true",
+        "MCPFORGE_TPU_LOCAL_MODEL": model,
+        "MCPFORGE_TPU_LOCAL_REPLICAS": str(replicas),
+        "MCPFORGE_TPU_LOCAL_POOL_HEALTH_INTERVAL_S": "0.1",
+        "MCPFORGE_TPU_LOCAL_MAX_BATCH": "8" if _smoke() else "32",
+        "MCPFORGE_TPU_LOCAL_MAX_SEQ_LEN": "128" if _smoke() else "1024",
+        "MCPFORGE_TPU_LOCAL_PAGE_SIZE": "16",
+        "MCPFORGE_TPU_LOCAL_NUM_PAGES": "128" if _smoke() else "2048",
+        "MCPFORGE_TPU_LOCAL_PREFILL_BUCKETS": ("16,64" if _smoke()
+                                               else "64,128,256"),
+        "MCPFORGE_TPU_LOCAL_DTYPE": ("bfloat16" if platform == "tpu"
+                                     else "float32"),
+        "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
+        "MCPFORGE_OTEL_EXPORTER": "none",
+        "MCPFORGE_LOG_LEVEL": "WARNING",
+        # generous engine targets on CPU proxies; the http objective is
+        # the one scenario loads push around — targets stay defaults so
+        # breach REPORTING is exercised, verdicts are recorded not faked
+        "MCPFORGE_SLO_TTFT_P95_MS": os.environ.get(
+            "BENCH_SCENARIO_TTFT_MS", "30000" if platform != "tpu" else "2500"),
+        "MCPFORGE_SLO_TPOT_P95_MS": os.environ.get(
+            "BENCH_SCENARIO_TPOT_MS", "30000" if platform != "tpu" else "250"),
+        # warmup the shape grid so timed scenarios measure steady state —
+        # but the FAST subset everywhere: the full grid × 2 replicas is
+        # tens of minutes of XLA compiles on a CPU box, and a rare
+        # mid-scenario straggler compile is itself realistic load
+        "MCPFORGE_TPU_LOCAL_WARMUP": "false" if _smoke() else "true",
+        "MCPFORGE_TPU_LOCAL_WARMUP_MODE": "fast",
+        "MCPFORGE_TPU_LOCAL_COMPILE_CACHE_DIR": os.environ.get(
+            "MCPFORGE_TPU_LOCAL_COMPILE_CACHE_DIR",
+            "/tmp/mcpforge-xla-cache"),
+    }
+    settings = load_settings(env=env, env_file=None)
+    app = await build_app(settings)
+    client = await _serve_tcp(app)
+    return app, client, model
+
+
+async def _register_echo_tool(client, auth, name: str):
+    from bench import _echo_upstream, _register_tool
+    upstream = await _echo_upstream()
+    await _register_tool(client, upstream, auth, name)
+    return upstream
+
+
+# ------------------------------------------------------------------ scenarios
+
+async def scenario_burst(app, client, auth, model, scale) -> dict:
+    """Spike concurrency 16x over baseline; the SLO window brackets the
+    whole curve so queueing during the spike lands in the verdicts."""
+    from mcp_context_forge_tpu.tools.loadgen import (SloWindow, chat_kind,
+                                                     run_phases,
+                                                     tools_call_kind)
+    window = SloWindow(client, "scenario-burst", auth)
+    await window.open()
+    kinds = [tools_call_kind("scenario-echo"),
+             chat_kind(model, max_tokens=scale["max_tokens"])]
+    result = await run_phases(client, auth, kinds, scale["burst_phases"])
+    result["slo"] = await window.close()
+    burst_phase = next(p for p in result["phases"] if p["name"] == "burst")
+    return {"scenario": "burst", "value": burst_phase["rps"],
+            "p50_ms": burst_phase.get("p50_ms"),
+            "p95_ms": burst_phase.get("p95_ms"), **_strip(result)}
+
+
+async def scenario_ramp(app, client, auth, model, scale) -> dict:
+    """Compressed diurnal curve: staircase concurrency up then down."""
+    from mcp_context_forge_tpu.tools.loadgen import (SloWindow, chat_kind,
+                                                     run_phases,
+                                                     tools_call_kind)
+    window = SloWindow(client, "scenario-ramp", auth)
+    await window.open()
+    kinds = [chat_kind(model, max_tokens=scale["max_tokens"]),
+             tools_call_kind("scenario-echo")]
+    phases = [(f"step-{conc}", conc, scale["ramp_requests"])
+              for conc in scale["ramp_steps"]]
+    result = await run_phases(client, auth, kinds, phases)
+    result["slo"] = await window.close()
+    return {"scenario": "ramp", "value": result["rps"],
+            "p50_ms": result.get("p50_ms"), "p95_ms": result.get("p95_ms"),
+            **_strip(result)}
+
+
+async def scenario_mixed(app, client, auth, model, scale) -> dict:
+    """The four production wire shapes interleaved in one closed loop:
+    chat, local MCP tools-call, FEDERATED tools-call (resolved through a
+    registered peer gateway), and an engine-backed A2A agent."""
+    from mcp_context_forge_tpu.tools.loadgen import (SloWindow, a2a_kind,
+                                                     chat_kind, run_phases,
+                                                     tools_call_kind)
+    window = SloWindow(client, "scenario-mixed", auth)
+    await window.open()
+    kinds = [chat_kind(model, max_tokens=scale["max_tokens"]),
+             tools_call_kind("scenario-echo"),
+             tools_call_kind("fed-echo"),
+             a2a_kind("scenario-agent")]
+    result = await run_phases(client, auth, kinds, [
+        ("mixed", scale["mixed_concurrency"], scale["mixed_requests"])])
+    result["slo"] = await window.close()
+    return {"scenario": "mixed", "value": result["rps"],
+            "p50_ms": result.get("p50_ms"), "p95_ms": result.get("p95_ms"),
+            "traffic": ["chat", "tools_call", "federation", "a2a"],
+            **_strip(result)}
+
+
+async def _reference_streams(app, prompts, max_tokens):
+    """What one UNINTERRUPTED engine emits for ``prompts`` — the parity
+    bar the chaos scenario's merged failover streams must match
+    (tests/tpu_local/test_engine_pool.py's reference pattern)."""
+    from mcp_context_forge_tpu.tpu_local.engine import TPUEngine
+    pool = app["tpu_engine_pool"]
+    config = dataclasses.replace(pool.config, replica_id="chaos-ref")
+    engine = TPUEngine(config)
+    await engine.start()
+    outs = []
+    try:
+        for prompt in prompts:
+            ids = engine.tokenizer.encode(prompt)
+            outs.append([t async for t in engine.generate(
+                ids, max_tokens=max_tokens)])
+    finally:
+        await engine.stop()
+    return outs
+
+
+async def scenario_chaos(app, client, auth, model, scale) -> dict:
+    """Replica kill + rolling reload under load. Three verdicts: (a) the
+    token streams in flight across the kill match an uninterrupted
+    reference exactly (zero lost/duplicated tokens — the pool requeues
+    continuations); (b) the killed replica reloads back to ready while
+    traffic keeps flowing; (c) the SLO window reports the breach period
+    with samples instead of hanging or passing vacuously."""
+    from mcp_context_forge_tpu.tools.loadgen import (SloWindow, chat_kind,
+                                                     run_phase)
+    pool = app["tpu_engine_pool"]
+    max_tokens = max(8, scale["max_tokens"])
+    prompts = [f"chaos scenario prompt {i} with some extra words"
+               for i in range(scale["chaos_prompts"])]
+    parity = os.environ.get("BENCH_SCENARIO_PARITY", "1") != "0"
+    refs = await _reference_streams(app, prompts, max_tokens) if parity \
+        else None
+
+    window = SloWindow(client, "scenario-chaos", auth)
+    await window.open()
+
+    killed: dict = {}
+
+    async def kill_when_busy():
+        # fire once a replica holds in-flight work that has already
+        # emitted tokens — the kill must interrupt MID-STREAM, or the
+        # scenario proves nothing about requeue continuations
+        for _ in range(5000):
+            ready = [r for r in pool.replicas if r.state == "ready"]
+            busy = max(ready, key=lambda r: len(r.outstanding),
+                       default=None)
+            if busy is not None and any(
+                    len(rec.request.generated) > 0
+                    for rec in busy.outstanding.values()):
+                killed["rid"] = busy.id
+                pool.fail_replica(
+                    busy, reason="chaos scenario: injected replica kill")
+                return
+            await asyncio.sleep(0.005)
+
+    async def token_streams():
+        async def gen(p):
+            ids = pool.tokenizer.encode(p)
+            return [t async for t in pool.generate(
+                ids, max_tokens=max_tokens)]
+        return await asyncio.gather(*[gen(p) for p in prompts])
+
+    kill_task = asyncio.ensure_future(kill_when_busy())
+    streams_task = asyncio.ensure_future(token_streams())
+    load = await run_phase(
+        client, auth, [chat_kind(model, max_tokens=max_tokens)],
+        name="chaos-load", concurrency=scale["chaos_concurrency"],
+        requests=scale["chaos_requests"])
+    outs = await streams_task
+    await kill_task
+
+    # rolling reload of the dead replica while residual traffic flows
+    reload_ok = False
+    tail = None
+    if killed:
+        reload_task = asyncio.ensure_future(pool.reload(killed["rid"]))
+        tail = await run_phase(
+            client, auth, [chat_kind(model, max_tokens=max_tokens)],
+            name="reload-tail", concurrency=2,
+            requests=max(4, scale["chaos_requests"] // 4))
+        await reload_task
+        reload_ok = pool._replica(killed["rid"]).state == "ready"
+
+    slo = await window.close()
+    parity_ok = refs is None or [list(o) for o in outs] == refs
+    lost = sum(1 for o in outs if not o)
+    return {
+        "scenario": "chaos", "value": load.summary()["rps"],
+        "p50_ms": load.summary().get("p50_ms"),
+        "p95_ms": load.summary().get("p95_ms"),
+        "requests": load.requests + (tail.requests if tail else 0),
+        "failures": load.failures + (tail.failures if tail else 0),
+        "killed_replica": killed.get("rid"),
+        "requeues": pool.requeues,
+        "streams": len(outs),
+        "lost_streams": lost,
+        "token_parity": (None if refs is None else bool(parity_ok)),
+        "replica_reloaded": reload_ok,
+        "slo": slo, "slo_ok": slo["ok"],
+        "hard_fail": (
+            (not killed and "kill never fired")
+            # empty streams gate even with the parity reference off
+            # (BENCH_SCENARIO_PARITY=0 on TPU): losing a stream outright
+            # must never ship, reference run or not — truncation vs EOS
+            # needs the reference, loss does not
+            or (lost > 0 and f"{lost} stream(s) lost across the kill")
+            or (refs is not None and not parity_ok
+                and "token streams diverged from the uninterrupted "
+                    "reference (lost or duplicated tokens)")
+            or (not reload_ok and "killed replica did not reload to ready")
+            or None),
+    }  # request failures are gated generically by the driver
+
+
+def _strip(result: dict) -> dict:
+    """Phase summaries + SLO verdicts, minus raw latency arrays."""
+    return {"requests": result["requests"], "failures": result["failures"],
+            "rps": result["rps"], "wall_s": result["wall_s"],
+            "phases": result.get("phases"), "slo": result.get("slo"),
+            "slo_ok": result.get("slo", {}).get("ok")}
+
+
+# --------------------------------------------------------------------- driver
+
+def _next_round(out_dir: str) -> int:
+    rounds = [0]
+    for path in glob.glob(os.path.join(out_dir, "BENCH_SCENARIO_*_r*.json")):
+        match = re.search(r"_r(\d+)\.json$", path)
+        if match:
+            rounds.append(int(match.group(1)))
+    return max(rounds) + 1
+
+
+def _write_capture(out_dir: str, rnd: int, capture: dict) -> str:
+    # non-CPU platforms get their own filename prefix (the repo's
+    # BENCH_TPU_ vs BENCH_LOCAL_ convention): bench_trend groups series
+    # by prefix, and a TPU round must never be median'd into the CPU
+    # history — the cross-platform delta would read as a regression
+    platform = str(capture.get("platform", "cpu")).upper()
+    arm = "" if platform == "CPU" else f"_{platform}"
+    name = (f"BENCH_SCENARIO{arm}_{capture['scenario'].upper()}"
+            f"_r{rnd:02d}.json")
+    path = os.path.join(out_dir, name)
+    with open(path, "w") as fh:
+        json.dump(capture, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return name
+
+
+async def run_scenarios(platform: str) -> dict:
+    from aiohttp import BasicAuth
+
+    from mcp_context_forge_tpu.tools.loadgen import assert_slo_measured
+
+    only = {s for s in os.environ.get("BENCH_SCENARIO_ONLY", "").split(",")
+            if s}
+    wanted = [s for s in SCENARIOS if not only or s in only]
+    if not wanted:
+        # nothing selected (BENCH_SCENARIO_ONLY names no real scenario):
+        # report the vacuous run without paying a gateway build
+        return {"metric": "gateway_scenario_slo", "scenarios": {},
+                "captures_written": [], "platform": platform,
+                "problems": [f"BENCH_SCENARIO_ONLY={sorted(only)} matches "
+                             f"no scenario (have {list(SCENARIOS)})"],
+                "ok": False}
+    scale = _scale()
+    auth = BasicAuth("admin", "changeme")
+    app, client, model = await _make_gateway(platform, replicas=2)
+    peer = upstream = None
+    captures: list[dict] = []
+    problems: list[str] = []
+    try:
+        upstream = await _register_echo_tool(client, auth, "scenario-echo")
+        if "mixed" in wanted:
+            # federation peer + engine-backed A2A agent for mixed traffic
+            from bench import _make_gateway as _bench_gateway
+            from bench import _register_tool
+            _, peer, _ = await _bench_gateway(engine=False,
+                                              platform=platform)
+            await _register_tool(peer, upstream, auth, "fed-echo")
+            resp = await client.post("/gateways", json={
+                "name": "scenario-peer",
+                "url": f"http://{peer.server.host}:{peer.server.port}/mcp",
+                "transport": "streamablehttp", "auth_type": "basic",
+                "auth_value": {"username": "admin", "password": "changeme"},
+            }, auth=auth)
+            assert resp.status == 201, await resp.text()
+            resp = await client.post("/a2a", json={
+                "name": "scenario-agent", "agent_type": "tpu_local",
+                "endpoint_url": "tpu://local"}, auth=auth)
+            assert resp.status == 201, await resp.text()
+
+        # prime both replicas + SLO consumers before any timed window
+        from mcp_context_forge_tpu.tools.loadgen import chat_kind, run_phase
+        await run_phase(client, auth,
+                        [chat_kind(model, max_tokens=scale["max_tokens"])],
+                        name="prime", concurrency=2, requests=4)
+
+        runners = {
+            "burst": lambda: scenario_burst(app, client, auth, model, scale),
+            "ramp": lambda: scenario_ramp(app, client, auth, model, scale),
+            "mixed": lambda: scenario_mixed(app, client, auth, model, scale),
+            "chaos": lambda: scenario_chaos(app, client, auth, model, scale),
+        }
+        for name in wanted:
+            started = time.monotonic()
+            try:
+                capture = await runners[name]()
+            except Exception as exc:
+                problems.append(f"{name}: {type(exc).__name__}: {exc}")
+                continue
+            capture.update({
+                "metric": "gateway_scenario_slo", "unit": "req/s",
+                "platform": platform, "model": model,
+                "smoke": _smoke(),
+                "scenario_wall_s": round(time.monotonic() - started, 2),
+            })
+            # no-vacuous-pass: the scenario must have actually pushed
+            # samples through the objectives it claims verdicts for
+            unmeasured = assert_slo_measured(
+                capture.get("slo", {}), ["http_p95", "ttft_p95"])
+            if unmeasured:
+                problems.append(f"{name}: " + "; ".join(unmeasured))
+            hard = capture.pop("hard_fail", None)
+            if hard:
+                problems.append(f"{name}: {hard}")
+            # EVERY scenario's request failures gate the run (the chaos
+            # reload-tail included — its failures fold into the capture)
+            if capture.get("failures"):
+                problems.append(
+                    f"{name}: {capture['failures']} request(s) failed")
+            if (os.environ.get("BENCH_SCENARIO_ENFORCE_SLO") == "1"
+                    and not capture.get("slo_ok", True)):
+                problems.append(f"{name}: SLO window breached "
+                                f"(enforcement on)")
+            captures.append(capture)
+    finally:
+        for c in (peer, upstream, client):
+            if c is not None:
+                try:
+                    await c.close()
+                except Exception:
+                    pass
+
+    out_dir = os.environ.get(
+        "BENCH_SCENARIO_DIR",
+        os.path.dirname(os.path.abspath(__file__)) or ".")
+    written: list[str] = []
+    if captures and os.environ.get("BENCH_SCENARIO_WRITE") != "0":
+        rnd = int(os.environ.get("BENCH_SCENARIO_ROUND",
+                                 _next_round(out_dir)))
+        written = [_write_capture(out_dir, rnd, c) for c in captures]
+    return {
+        "metric": "gateway_scenario_slo",
+        "scenarios": {c["scenario"]: c for c in captures},
+        "captures_written": written,
+        "problems": problems,
+        "platform": platform,
+        "ok": not problems and bool(captures),
+    }
+
+
+def main() -> int:
+    from bench import pin_platform
+    platform = pin_platform()
+    report = asyncio.run(run_scenarios(platform))
+    print(json.dumps(report))
+    if not report["scenarios"]:
+        # the no-vacuous-pass rule: a harness that ran nothing must not
+        # exit 0 (exit 2, distinct from scenario failures)
+        print("bench-scenarios: FAIL no scenario produced a capture",
+              file=sys.stderr)
+        return 2
+    for problem in report["problems"]:
+        print(f"bench-scenarios: FAIL {problem}", file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

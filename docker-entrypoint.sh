@@ -34,6 +34,13 @@ case "$1" in
     shift
     exec python -m mcp_context_forge_tpu.tools.bench_trend "$@"
     ;;
+  bench-scenarios)
+    # SLO-asserting gateway scenario harness (docs/load_harness.md):
+    # burst/ramp/mixed/chaos with /admin/slo verdicts; exits non-zero on
+    # scenario hard-failures or a zero-capture (vacuous) run
+    shift
+    exec python bench_gateway_scenarios.py "$@"
+    ;;
   serve|supervise|hub|token|version)
     cmd="$1"; shift
     if [ "$cmd" = "hub" ]; then

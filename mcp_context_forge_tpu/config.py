@@ -461,7 +461,29 @@ class Settings(BaseModel):
     slo_ttft_p95_ms: float = 2500.0
     slo_tpot_p95_ms: float = 250.0
     slo_queue_wait_p95_ms: float = 1500.0
+    # gateway-side objective over the HTTP duration histogram (all
+    # routes); the load harness asserts it per scenario window
+    slo_http_p95_ms: float = 1000.0
     slo_error_budget: float = 0.05
+    # --- gateway flight recorder & loop health (gateway/flight_recorder.py,
+    # docs/observability.md "Gateway flight recorder & loop health") ---
+    gw_flight_recorder_enabled: bool = True
+    # completed-request ring (recency window) and the slowest-N retained
+    # by duration across the worker's lifetime (GET /admin/gateway/requests)
+    gw_flight_ring_size: int = 256
+    gw_flight_slowest_size: int = 32
+    # slow-request bar: past this the request WARNs with its phase
+    # vector + trace ids (the r05 "http.request: 3786 ms" line, now with
+    # a breakdown); 0 = inherit performance_threshold_http_request_ms
+    gw_slow_request_ms: float = 0.0
+    # event-loop lag sampler cadence and the long-callback warning bar
+    gw_loop_lag_interval_s: float = 0.25
+    gw_loop_lag_warn_ms: float = 250.0
+    # surface engine admission depth/saturation as X-Queue-Depth +
+    # Retry-After response headers on the LLM serving surface, and
+    # advise backoff past this saturation fraction
+    gw_backpressure_headers: bool = True
+    gw_backpressure_retry_after_at: float = 0.8
     # --- engine replica pool (tpu_local/pool/, docs/serving_pool.md) ---
     # N > 1 serves LLM traffic from N engine replicas on device-subset
     # meshes (e.g. 2 replicas x 4 chips on a v5e-8) behind an
@@ -596,6 +618,16 @@ class Settings(BaseModel):
     def default_passthrough_list(self) -> list[str]:
         return [h.strip() for h in self.default_passthrough_headers.split(",")
                 if h.strip()]
+
+    @property
+    def gw_slow_request_s(self) -> float:
+        """Effective slow-request bar in seconds: the dedicated knob, or
+        the perf tracker's http threshold when unset (one bar, two
+        consumers — the phase-vector log and the tracker's slow count
+        must agree on what 'slow' means)."""
+        ms = self.gw_slow_request_ms or \
+            self.performance_threshold_http_request_ms
+        return max(0.0, ms) / 1e3
 
     @property
     def allowed_host_set(self) -> set[str]:

@@ -174,9 +174,11 @@ class Database:
             self._conn.commit()
 
     async def execute(self, sql: str, params: Sequence[Any] = ()) -> list[dict[str, Any]]:
+        from ..observability.phases import current_phases
         log = _query_capture.get()
         cb = self.on_query
-        if log is None and cb is None:
+        clock = current_phases()  # flight-recorder db-phase attribution
+        if log is None and cb is None and clock is None:
             return await self._run(self._execute_sync, sql, params)
         timing: list[float] = []  # filled under the lock on the db thread
         try:
@@ -192,6 +194,12 @@ class Database:
                     cb(timing[0])
                 if log is not None:
                     log.append((" ".join(sql.split()), timing[0]))
+                if clock is not None:
+                    # in-lock statement time into the request's phase
+                    # vector (GET /admin/gateway/requests); executor
+                    # queue wait lands in the handler residue instead —
+                    # it is loop/pool contention, not DB time
+                    clock.add("db", timing[0] / 1e3)
             elif log is not None:
                 log.append((" ".join(sql.split()), 0.0))
 

@@ -115,19 +115,25 @@ class PostgresDatabase:
 
     async def execute(self, sql: str, params: Sequence[Any] = ()) -> list[dict[str, Any]]:
         from .core import _query_capture
+        from ..observability.phases import current_phases
         log = _query_capture.get()
+        clock = current_phases()  # flight-recorder db-phase attribution
         conn = await self._pool.acquire()
         try:
             # clock the statement only: pool-acquire wait is a sizing
             # signal, not query time — a 1 ms query that waited 150 ms
             # for a connection must not WARN as a slow query
-            started = time.monotonic() if log is not None else 0.0
+            timed = log is not None or clock is not None
+            started = time.monotonic() if timed else 0.0
             try:
                 return await self._query(conn, sql, params)
             finally:
-                if log is not None:
-                    log.append((" ".join(sql.split()),
-                                (time.monotonic() - started) * 1000))
+                if timed:
+                    elapsed_ms = (time.monotonic() - started) * 1000
+                    if log is not None:
+                        log.append((" ".join(sql.split()), elapsed_ms))
+                    if clock is not None:
+                        clock.add("db", elapsed_ms / 1e3)
         finally:
             await self._pool.release(conn)
 

@@ -166,6 +166,7 @@ const TABS = {
   exportimport: {special: "exportimport"},
   chat:     {special: "chat"},
   engine:   {url: "/admin/engine/stats", special: "engine"},
+  gateway:  {url: "/admin/gateway/requests?limit=24", special: "gwflight"},
   diagnostics: {special: "diagnostics"},
 };
 let current = "tools", rows = [], shown = [], timer = null, cursor = null;
@@ -290,6 +291,43 @@ async function renderEngine(stats){
      <button class="act" onclick="engineProfileCtl('stop')">stop profile</button>
      <button class="act" onclick="engineProfileStatus()">profile status</button>`;
   document.getElementById("status").textContent = "engine stats";
+}
+function gwFlightTable(title, rows){
+  // phase vector rendered inline: the breakdown IS the payload here
+  const cols = ["ts","method","path","status","duration_ms","phases_ms",
+                "error","trace_id"];
+  const body = (rows || []).map(r =>
+    "<tr>" + cols.map(c => {
+      if (c === "phases_ms")
+        return `<td class="kv">${esc(JSON.stringify(r.phases_ms || {}))}</td>`;
+      if (c === "ts") return `<td>${esc(new Date((r.ts||0)*1000)
+        .toISOString().slice(11,23))}</td>`;
+      return `<td>${cell(r[c])}</td>`;
+    }).join("") + "</tr>").join("");
+  if (!body) return "";
+  return `<br><h3>${esc(title)}</h3><table><tr>`
+    + cols.map(c => `<th>${esc(c)}</th>`).join("") + `</tr>${body}</table>`;
+}
+function renderGatewayFlight(snap){
+  // request flight recorder: slowest-N + recent rings with per-phase
+  // breakdowns, loop-lag health, engine backpressure — the HTTP-tier
+  // twin of the engine tab's step attribution card
+  const loop = snap.loop || {};
+  const bp = snap.backpressure || {};
+  const cards = `<div class="cards">
+    <div class="card"><b>${cell(snap.recorded)}</b><span>requests_recorded</span></div>
+    <div class="card"><b>${cell(snap.slow_requests)}</b><span>slow_requests (&gt;${cell(snap.slow_request_ms)}ms)</span></div>
+    <div class="card"><b>${cell(snap.inflight)}</b><span>in_flight</span></div>
+    <div class="card"><b>${cell(loop.last_lag_ms)}</b><span>loop_lag_last_ms</span></div>
+    <div class="card"><b>${cell(loop.max_lag_ms)}</b><span>loop_lag_max_ms</span></div>
+    <div class="card"><b>${cell(loop.long_callbacks)}</b><span>long_callbacks</span></div>
+    <div class="card"><b>${cell(bp.depth)}</b><span>engine_queue_depth</span></div>
+    <div class="card"><b>${fnum(bp.saturation)}</b><span>engine_saturation</span></div>
+   </div>`;
+  document.getElementById("view").innerHTML = cards
+    + gwFlightTable("slowest requests", snap.slowest)
+    + gwFlightTable("recent requests", snap.recent);
+  document.getElementById("status").textContent = "gateway flight recorder";
 }
 async function poolAct(rid, action){
   const r = await fetch(`/admin/engine/pool/${rid}/${action}`, {method:"POST"});
@@ -615,6 +653,7 @@ async function show(name, keepCursor){
     if (!r.ok) { s.textContent = r.status + " " + esc(await r.text()); return; }
     let data = await r.json();
     if (t.special === "engine") return renderEngine(data);
+    if (t.special === "gwflight") return renderGatewayFlight(data);
     if (t.special === "ingress") return renderIngress(data);
     if (t.path) data = data[t.path] || [];
     if (data && !Array.isArray(data) && Array.isArray(data.items)){

@@ -143,6 +143,32 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     app["ctx"] = ctx
     app["rate_limiter"] = RateLimiter(settings.rate_limit_rps, settings.rate_limit_burst)
 
+    # gateway data-plane flight recorder + event-loop health
+    # (gateway/flight_recorder.py, docs/observability.md): per-request
+    # phase attribution rings behind GET /admin/gateway/requests and the
+    # loop-lag sampler — the gateway twin of the engine's step ring
+    loop_sampler = None
+    if settings.gw_flight_recorder_enabled:
+        from .flight_recorder import FlightRecorder, LoopLagSampler
+        recorder = FlightRecorder(
+            metrics, ring_size=settings.gw_flight_ring_size,
+            slowest_size=settings.gw_flight_slowest_size,
+            slow_request_s=settings.gw_slow_request_s)
+        app["flight_recorder"] = recorder
+        loop_sampler = LoopLagSampler(
+            metrics, interval_s=settings.gw_loop_lag_interval_s,
+            warn_s=settings.gw_loop_lag_warn_ms / 1e3, recorder=recorder)
+        app["loop_lag_sampler"] = loop_sampler
+
+    # SLO verdicts over the serving histograms at GET /admin/slo —
+    # engine objectives (TTFT/TPOT/queue-wait) read empty without the
+    # engine, but the gateway http_p95 objective holds for every
+    # deployment, so the evaluator is unconditional
+    from ..observability.slo import SloEvaluator, default_objectives
+    app["slo_evaluator"] = SloEvaluator(
+        metrics, default_objectives(settings),
+        error_budget=settings.slo_error_budget)
+
     # operation-timing registry (reference performance_tracker.py): http /
     # db / tool / resource series feed /admin/performance and the bundle
     if settings.performance_tracking_enabled:
@@ -224,12 +250,6 @@ async def build_app(settings: Settings | None = None) -> web.Application:
             engine = TPUEngine(engine_config, tracer=tracer, metrics=metrics)
         from ..services.diagnostics_service import JaxProfilerCapture
         app["jax_profiler"] = JaxProfilerCapture(settings.jax_profile_dir)
-        # SLO verdicts over the engine's token-level histograms at
-        # GET /admin/slo (targets + error budget from settings)
-        from ..observability.slo import SloEvaluator, default_objectives
-        app["slo_evaluator"] = SloEvaluator(
-            metrics, default_objectives(settings),
-            error_budget=settings.slo_error_budget)
         provider = TPULocalProvider(
             "tpu_local", engine_pool if engine_pool is not None else engine,
             embedding_model=settings.tpu_local_embedding_model,
@@ -654,6 +674,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         ctx.extras["leader_elector"] = elector
         await elector.start()
         await gateway_service.start_health_loop()
+        if loop_sampler is not None:
+            await loop_sampler.start()
         await metrics_maintenance.start()
         if metrics_buffer is not None:
             await metrics_buffer.start()
@@ -699,6 +721,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
             pass
         if metrics_buffer is not None:
             await metrics_buffer.stop()
+        if loop_sampler is not None:
+            await loop_sampler.stop()
         await metrics_maintenance.stop()
         await transport.sessions.stop_sweeper()
         await gateway_service.stop_health_loop()

@@ -16,8 +16,11 @@ from typing import Awaitable, Callable
 
 from aiohttp import web
 
+from ..observability import phases as request_phases
+from ..observability.tracing import current_span
 from ..services.auth_service import AuthContext, AuthError, PermissionDenied
 from ..services.base import ConflictError, NotFoundError, ValidationFailure
+from .flight_recorder import backpressure_headers, queue_state
 
 Handler = Callable[[web.Request], Awaitable[web.StreamResponse]]
 
@@ -206,10 +209,124 @@ async def observability_middleware(request: web.Request, handler: Handler) -> we
         ctx.metrics.http_duration.labels(request.method, path_label).observe(elapsed)
         perf = ctx.extras.get("perf_tracker")
         if perf is not None:
-            perf.record("http.request", elapsed)
+            # the flight recorder (one layer in) already attributed this
+            # request; ride its phase vector on the tracker's slow-op
+            # warning so "http.request: 3786 ms" is never a bare
+            # duration again (r05 bench-tail satellite). Formatted only
+            # when the record will actually WARN — record() reads
+            # component on the slow branch alone, and stringifying a
+            # dict per request is hot-path waste
+            entry = request.get("flight_entry")
+            slow = entry is not None and perf.will_warn("http.request",
+                                                        elapsed)
+            perf.record("http.request", elapsed,
+                        component=(f"phases={entry['phases_ms']}"
+                                   if slow else None))
         response.headers[settings.correlation_id_response_header] = \
             correlation_id
         return response
+
+
+@web.middleware
+async def flight_recorder_middleware(request: web.Request,
+                                     handler: Handler) -> web.StreamResponse:
+    """Gateway data-plane flight recorder (flight_recorder.py +
+    observability/phases.py): open a PhaseClock for the request, let the
+    instrumented layers (auth resolution, plugin hooks, DB statements,
+    the engine handoff, serialization) charge their wall into named
+    buckets, then record the completed request — phase vector, status,
+    trace ids — into the bounded rings behind
+    ``GET /admin/gateway/requests``, the per-route phase histograms, and
+    a ``gw.phases`` event on the ``http.request`` span. The residue
+    (wall minus every attributed phase) reports as ``handler`` — or
+    ``error`` when an exception passed through — so the vector always
+    sums to the measured wall (tolerance-gated in tests).
+
+    Sits just inside observability_middleware: current_span() is the
+    http.request span here, and client-disconnect CancelledErrors still
+    propagate through (rows for aborted requests carry
+    ``client_disconnected``). Also surfaces engine-pool admission depth
+    as X-Queue-Depth / Retry-After backpressure headers on the LLM
+    serving surface."""
+    settings = request.app["ctx"].settings
+    recorder = request.app.get("flight_recorder")
+    if recorder is None:
+        # recorder off is NOT backpressure off: the two are independent
+        # knobs, and clients must keep their queue-depth signal
+        response = await handler(request)
+        _apply_backpressure(request, response, settings)
+        return response
+    clock = request_phases.PhaseClock()
+    token = request_phases.set_phase_clock(clock)
+    span = current_span()
+    trace = span.context() if span is not None else None
+    rid = recorder.start_request(request.path, trace)
+    started = time.perf_counter()
+    response: web.StreamResponse | None = None
+    error: str | None = None
+    disconnected = False
+    try:
+        response = await handler(request)
+        return response
+    except web.HTTPException as exc:
+        response = exc  # an HTTPException IS its response
+        raise
+    except asyncio.CancelledError:
+        error = "CancelledError"
+        disconnected = True
+        raise
+    except Exception as exc:  # recorded, then translated upstream
+        error = type(exc).__name__
+        raise
+    finally:
+        recorder.finish_request(rid)
+        request_phases.reset_phase_clock(token)
+        wall = time.perf_counter() - started
+        clock.add("error" if error else "handler",
+                  max(0.0, wall - clock.total()))
+        if response is not None:
+            status = response.status
+        elif disconnected:
+            status = 499  # client closed request (nginx convention)
+        else:
+            status = 500
+        route = request.match_info.route.resource
+        # unmatched paths are client-controlled: one fixed label child,
+        # never a per-path Prometheus series (the row keeps the raw path)
+        route_label = route.canonical if route is not None else "unmatched"
+        phases_ms = clock.vector_ms()
+        if error is None and status >= 500:
+            # the handler's exception was already translated to a 5xx
+            # below us — the row must still say this request failed
+            error = f"http_{status}"
+        entry = recorder.record(
+            method=request.method, path=request.path, route=route_label,
+            status=status, duration_s=wall, phases_ms=phases_ms,
+            trace_id=trace[0] if trace else None,
+            span_id=trace[1] if trace else None,
+            correlation_id=request.get("correlation_id"),
+            error=error,
+            client_disconnected=(disconnected
+                                 or bool(request.get("client_disconnected"))))
+        request["flight_entry"] = entry
+        if span is not None:
+            span.add_event("gw.phases", {
+                "duration_ms": entry["duration_ms"], **phases_ms})
+        if response is not None:
+            _apply_backpressure(request, response, settings)
+
+
+def _apply_backpressure(request: web.Request,
+                        response: web.StreamResponse, settings) -> None:
+    """X-Queue-Depth / Retry-After on the LLM serving surface (unary
+    responses; the SSE path sets them pre-prepare in tpu_local/server).
+    queue_state() feeds the saturation gauge as a side effect."""
+    if (not settings.gw_backpressure_headers or response.prepared
+            or not request.path.startswith(
+                (settings.llm_api_prefix + "/", "/llmchat"))):
+        return
+    response.headers.update(
+        backpressure_headers(queue_state(request.app), settings))
 
 
 @web.middleware
@@ -370,26 +487,31 @@ async def auth_middleware(request: web.Request, handler: Handler) -> web.StreamR
         request["auth"] = AuthContext(user="anonymous", via="anonymous")
         return await handler(request)
 
-    header = request.headers.get(settings.auth_header_name, "")
-    auth_ctx: AuthContext | None = None
-    pm = ctx.plugin_manager
-    if pm is not None:
-        auth_ctx = await pm.http_auth_resolve_user(dict(request.headers))
-    if auth_ctx is None:
-        if header.lower().startswith("bearer "):
-            auth_ctx = await auth_service.resolve_bearer(header[7:].strip())
-        elif header.lower().startswith("basic "):
-            try:
-                decoded = base64.b64decode(header[6:].strip()).decode()
-                username, _, password = decoded.partition(":")
-            except Exception as exc:
-                raise AuthError("Malformed basic credentials") from exc
-            auth_ctx = await auth_service.resolve_basic(username, password)
-        elif not settings.auth_required:
-            auth_ctx = AuthContext(user="anonymous", is_admin=True, via="anonymous")
-        else:
-            raise AuthError("Authentication required")
-    request["auth"] = auth_ctx
+    # flight-recorder attribution: identity resolution (header parse,
+    # plugin resolve, DB-backed bearer/basic lookups) charges the "auth"
+    # phase; the plugin hooks inside charge "plugins" via PluginManager
+    # and self-time accounting keeps the two from double-counting
+    with request_phases.phase("auth"):
+        header = request.headers.get(settings.auth_header_name, "")
+        auth_ctx: AuthContext | None = None
+        pm = ctx.plugin_manager
+        if pm is not None:
+            auth_ctx = await pm.http_auth_resolve_user(dict(request.headers))
+        if auth_ctx is None:
+            if header.lower().startswith("bearer "):
+                auth_ctx = await auth_service.resolve_bearer(header[7:].strip())
+            elif header.lower().startswith("basic "):
+                try:
+                    decoded = base64.b64decode(header[6:].strip()).decode()
+                    username, _, password = decoded.partition(":")
+                except Exception as exc:
+                    raise AuthError("Malformed basic credentials") from exc
+                auth_ctx = await auth_service.resolve_basic(username, password)
+            elif not settings.auth_required:
+                auth_ctx = AuthContext(user="anonymous", is_admin=True, via="anonymous")
+            else:
+                raise AuthError("Authentication required")
+        request["auth"] = auth_ctx
     if pm is not None:
         await pm.http_pre_request(request.method, request.path, dict(request.headers),
                                   user=auth_ctx.user)
@@ -636,6 +758,10 @@ async def request_logging_middleware(request: web.Request, handler: Handler
 # AuthError and friends map to status codes.
 MIDDLEWARES = [
     observability_middleware,
+    # flight recorder just inside observability: current_span() is the
+    # http.request span, and disconnect CancelledErrors (re-raised one
+    # layer down) still pass through so aborted requests get rows too
+    flight_recorder_middleware,
     client_disconnect_middleware,
     forwarded_middleware,
     host_validation_middleware,

@@ -825,9 +825,8 @@ document.getElementById("f").onsubmit = async (e) => {
         load harness's phase-length windows."""
         request["auth"].require("observability.read")
         evaluator = request.app.get("slo_evaluator")
-        if evaluator is None:
-            raise NotFoundError("SLO evaluation is not enabled "
-                                "(requires the tpu_local engine)")
+        if evaluator is None:  # pragma: no cover - evaluator is unconditional
+            raise NotFoundError("SLO evaluation is not enabled")
         consumer = request.query.get("window", "default")[:64] or "default"
         return web.json_response(evaluator.evaluate(consumer=consumer))
 

@@ -176,6 +176,31 @@ def setup_extra_routes(app: web.Application) -> None:
         return web.json_response(
             engine_introspection(engine, limit=max(1, min(limit, 1024))))
 
+    @routes.get("/admin/gateway/requests")
+    async def gateway_requests(request: web.Request) -> web.Response:
+        """The gateway flight recorder's rings (gateway/flight_recorder.py):
+        slowest-N requests retained by duration plus the recency window,
+        each row carrying its phase vector (edge/auth/plugins/db/engine/
+        serialize/handler/error ms) and trace ids, alongside event-loop
+        health and the engine-pool backpressure view — the HTTP-tier
+        answer to /admin/engine/steps. Read-only."""
+        request["auth"].require("observability.read")
+        recorder = request.app.get("flight_recorder")
+        if recorder is None:
+            raise NotFoundError(
+                "gateway flight recorder is disabled "
+                "(set MCPFORGE_GW_FLIGHT_RECORDER_ENABLED=true)")
+        try:
+            limit = int(request.query.get("limit", "32"))
+        except ValueError as exc:
+            raise ValidationFailure("limit must be an integer") from exc
+        snapshot = recorder.snapshot(limit=max(1, min(limit, 1024)))
+        sampler = request.app.get("loop_lag_sampler")
+        snapshot["loop"] = sampler.snapshot() if sampler is not None else None
+        from .flight_recorder import queue_state
+        snapshot["backpressure"] = queue_state(request.app)
+        return web.json_response(snapshot)
+
     @routes.get("/admin/engine/profile/status")
     async def profile_status(request: web.Request) -> web.Response:
         request["auth"].require("observability.read")

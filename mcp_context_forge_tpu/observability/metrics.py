@@ -230,6 +230,51 @@ class PrometheusRegistry:
             "External LLM providers currently wired into the registry",
             registry=self.registry,
         )
+        # --- gateway data-plane flight recorder (gateway/flight_recorder.py,
+        # docs/observability.md "Gateway flight recorder & loop health") ---
+        # per-request wall time split into attributed phases (edge
+        # middleware pre-work, authn, plugin pipeline, db, engine
+        # handoff, serialization, handler residue, error residue) — the
+        # gateway twin of mcpforge_llm_step_phase_seconds
+        self.gw_request_phase = Histogram(
+            "mcpforge_gw_request_phase_seconds",
+            "Gateway request wall time attributed to a phase "
+            "(edge, auth, plugins, routing, db, engine, serialize, "
+            "handler, error)",
+            ["route", "phase"], registry=self.registry,
+            buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                     0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
+        )
+        # slow requests past gw_slow_request_ms — the counter twin of the
+        # phase-vector warning log line
+        self.gw_slow_requests = Counter(
+            "mcpforge_gw_slow_requests_total",
+            "Requests slower than the configured gw_slow_request_ms "
+            "threshold (each also logs its phase vector)",
+            ["route"], registry=self.registry,
+        )
+        # event-loop health: how late the loop ran a timer that asked
+        # for gw_loop_lag_interval_s — sustained mass in the upper
+        # buckets means a callback is blocking the loop (the runtime
+        # complement of mcpforge-lint's static async-blocking rule).
+        # Per-worker by construction: each process owns its registry.
+        self.gw_loop_lag = Histogram(
+            "mcpforge_gw_loop_lag_seconds",
+            "Scheduled-callback delta of the gateway event loop "
+            "(per worker; lag = blocked-loop time)",
+            registry=self.registry,
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0),
+        )
+        # engine/pool admission saturation (0..1) as seen by the HTTP
+        # tier — the value behind the X-Queue-Depth / Retry-After
+        # backpressure headers (ROADMAP item 5's pool→HTTP wiring)
+        self.gw_engine_saturation = Gauge(
+            "mcpforge_gw_engine_saturation",
+            "Engine admission-queue saturation the gateway last surfaced "
+            "to clients (queued work / admission capacity, 0..1)",
+            registry=self.registry,
+        )
         self.sessions_active = Gauge(
             "mcpforge_sessions_active", "Active MCP sessions", registry=self.registry,
         )

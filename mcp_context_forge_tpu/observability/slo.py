@@ -46,6 +46,11 @@ def default_objectives(settings: Any) -> list[SloObjective]:
                      float(settings.slo_tpot_p95_ms)),
         SloObjective("queue_wait_p95", "llm_queue_wait", 0.95,
                      float(settings.slo_queue_wait_p95_ms)),
+        # gateway-side: end-to-end HTTP latency across routes — the
+        # objective the scenario load harness asserts per phase window
+        # (summed over method/path children like every other objective)
+        SloObjective("http_p95", "http_duration", 0.95,
+                     float(getattr(settings, "slo_http_p95_ms", 1000.0))),
     ]
 
 

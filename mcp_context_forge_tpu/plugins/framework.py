@@ -20,6 +20,8 @@ from typing import Any, TYPE_CHECKING
 
 import yaml
 
+from ..observability import phases as request_phases
+
 if TYPE_CHECKING:
     from ..services.base import AppContext
     from ..services.auth_service import AuthContext
@@ -297,7 +299,12 @@ class PluginManager:
     async def _run(self, plugin: Plugin, hook: HookType, coro) -> Any:
         started = time.monotonic()
         try:
-            return await coro
+            # per-request attribution: every hook's wall charges the
+            # "plugins" phase of the flight-recorder clock (no-op when
+            # no request is being recorded); self-time nesting keeps an
+            # auth-resolve hook from double-counting inside "auth"
+            with request_phases.phase("plugins"):
+                return await coro
         except PluginViolation:
             if plugin.config.mode in (PluginMode.ENFORCE, PluginMode.ENFORCE_IGNORE_ERROR):
                 raise

@@ -158,12 +158,16 @@ class A2AService:
         if registry is None:
             raise ValidationFailure("tpu_local engine is not enabled")
         config = from_json(row["config"], {})
-        response = await registry.chat({
-            "model": config.get("model"),
-            "messages": self._extract_messages(payload),
-            "max_tokens": config.get("max_tokens", 256),
-            "temperature": payload.get("temperature", config.get("temperature", 0.0)),
-        })
+        from ..observability.phases import phase
+        with phase("engine"):  # flight-recorder attribution: A2A agents
+            # backed by the in-tree engine charge "engine", not residue
+            response = await registry.chat({
+                "model": config.get("model"),
+                "messages": self._extract_messages(payload),
+                "max_tokens": config.get("max_tokens", 256),
+                "temperature": payload.get("temperature",
+                                           config.get("temperature", 0.0)),
+            })
         return self._as_a2a_reply(response["choices"][0]["message"]["content"])
 
     async def _invoke_chat_provider(self, row: dict[str, Any], payload: dict[str, Any],

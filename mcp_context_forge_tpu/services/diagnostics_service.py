@@ -335,6 +335,14 @@ class PerformanceTracker:
         finally:
             self.record(operation, time.perf_counter() - start, component)
 
+    def will_warn(self, operation: str, seconds: float) -> bool:
+        """THE slow-op predicate — public so callers that build an
+        expensive ``component`` (the flight recorder's phase vector) can
+        skip the work when record() won't warn, without re-deriving the
+        threshold rule."""
+        limit = self._threshold_for(operation)
+        return bool(limit) and seconds > limit
+
     def record(self, operation: str, seconds: float,
                component: str | None = None) -> None:
         buf = self._samples.get(operation)
@@ -342,8 +350,8 @@ class PerformanceTracker:
             buf = self._samples[operation] = deque(maxlen=self._max)
         buf.append(seconds)
         self._totals[operation] = self._totals.get(operation, 0) + 1
-        limit = self._threshold_for(operation)
-        if limit and seconds > limit:
+        if self.will_warn(operation, seconds):
+            limit = self._threshold_for(operation)
             self._slow[operation] = self._slow.get(operation, 0) + 1
             logger.warning("slow operation %s: %.1f ms (threshold %.1f ms)%s",
                            operation, seconds * 1e3, limit * 1e3,
@@ -421,6 +429,10 @@ def tracker_from_settings(settings: Any) -> PerformanceTracker:
         thresholds={
             "db": settings.performance_threshold_database_query_ms / 1e3,
             "http": settings.performance_threshold_http_request_ms / 1e3,
+            # exact-op threshold wins over the "http" class prefix: the
+            # flight recorder's configurable gw_slow_request_ms and the
+            # tracker's slow-op count must agree on one bar
+            "http.request": settings.gw_slow_request_s,
             "tool": settings.performance_threshold_tool_invocation_ms / 1e3,
             "resource": settings.performance_threshold_resource_read_ms / 1e3,
         })

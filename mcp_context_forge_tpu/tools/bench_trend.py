@@ -54,6 +54,13 @@ _GATES: dict[str, list[tuple[str, bool]]] = {
         ("value", True),
         ("p95_ms", False),
     ],
+    # scenario load harness (bench_gateway_scenarios.py): one series per
+    # scenario arm by filename prefix (BENCH_SCENARIO_BURST_..., _RAMP_,
+    # _MIXED_, _CHAOS_), gated on scenario throughput and tail latency
+    "gateway_scenario_slo": [
+        ("value", True),
+        ("p95_ms", False),
+    ],
 }
 
 

@@ -1,0 +1,229 @@
+"""Scenario-shaping gateway load generator (SLO-asserting harness core).
+
+``testing/loadgen.py`` is the raw multi-process throughput worker (the
+1k-concurrency north-star driver); THIS module is the shape layer above
+it: named traffic scenarios — burst, diurnal ramp, mixed workloads,
+chaos — driven against an in-process gateway client, with SLO verdicts
+pulled from ``GET /admin/slo`` per-consumer delta windows instead of
+re-deriving percentiles client-side. ROADMAP item 5 names exactly this:
+a load harness that asserts SLOs (TTFT/TPOT p99, error budget), not just
+throughput; xLLM's serving-tier report (arXiv:2510.14686) and the LLM
+microserving model (arXiv:2412.12488) both treat SLO-gated scenarios as
+the precondition for serving-tier scale-out.
+
+The client contract is duck-typed: anything with aiohttp-style
+``post(path, json=..., auth=...)`` / ``get(path, ...)`` — an
+``aiohttp.test_utils.TestClient`` in tier-1 smoke, ``bench.py``'s
+real-socket ``_SocketClient`` in the bench driver. Pure asyncio; never
+imports jax (the harness builds the gateway, not this module).
+
+Usage shape (see ``bench_gateway_scenarios.py``)::
+
+    window = SloWindow(client, "scenario-burst", auth)
+    await window.open()                # resets this consumer's delta
+    result = await run_phases(client, auth, kinds, phases)
+    result["slo"] = await window.close()   # verdicts over the window
+"""
+
+from __future__ import annotations
+
+import asyncio
+import statistics
+import time
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Sequence
+
+# one request of a given kind: (client, auth, i) -> (ok, error_tag)
+RequestFn = Callable[[Any, Any, int], Awaitable[tuple[bool, str]]]
+
+
+# --------------------------------------------------------------- request kinds
+
+def chat_kind(model: str, max_tokens: int = 8,
+              prompt: str = "scenario request") -> RequestFn:
+    """OpenAI-compatible chat completion against the in-tree engine."""
+    async def one(client, auth, i: int) -> tuple[bool, str]:
+        resp = await client.post("/v1/chat/completions", auth=auth, json={
+            "model": model,
+            "messages": [{"role": "user", "content": f"{prompt} {i}"}],
+            "max_tokens": max_tokens})
+        body = await resp.json()
+        ok = resp.status == 200 and bool(body.get("choices"))
+        return ok, "" if ok else f"http_{resp.status}"
+    return one
+
+
+def tools_call_kind(tool: str, text: str = "payload") -> RequestFn:
+    """MCP tools/call over /mcp (streamable-http stateless)."""
+    async def one(client, auth, i: int) -> tuple[bool, str]:
+        resp = await client.post("/mcp", auth=auth, json={
+            "jsonrpc": "2.0", "id": i, "method": "tools/call",
+            "params": {"name": tool,
+                       "arguments": {"n": i, "text": f"{text} {i}"}}})
+        body = await resp.json()
+        ok = (resp.status == 200 and "result" in body
+              and not body["result"].get("isError"))
+        return ok, "" if ok else f"http_{resp.status}"
+    return one
+
+
+def a2a_kind(agent: str) -> RequestFn:
+    """A2A agent invocation (the gateway's agent-to-agent surface)."""
+    async def one(client, auth, i: int) -> tuple[bool, str]:
+        resp = await client.post(f"/a2a/{agent}/invoke", auth=auth,
+                                 json={"q": f"scenario {i}"})
+        ok = resp.status == 200
+        await resp.read()
+        return ok, "" if ok else f"http_{resp.status}"
+    return one
+
+
+# ------------------------------------------------------------------ execution
+
+@dataclass
+class PhaseResult:
+    """One load phase's client-side numbers."""
+    name: str
+    concurrency: int
+    requests: int = 0
+    failures: int = 0
+    wall_s: float = 0.0
+    latencies_ms: list[float] = field(default_factory=list)
+    errors: Counter = field(default_factory=Counter)
+
+    def summary(self) -> dict[str, Any]:
+        lat = sorted(self.latencies_ms)
+        out: dict[str, Any] = {
+            "name": self.name,
+            "concurrency": self.concurrency,
+            "requests": self.requests,
+            "failures": self.failures,
+            "wall_s": round(self.wall_s, 3),
+            "rps": round(self.requests / self.wall_s, 2)
+            if self.wall_s > 0 else 0.0,
+        }
+        if lat:
+            out["p50_ms"] = round(statistics.median(lat), 2)
+            out["p95_ms"] = round(lat[min(int(len(lat) * 0.95),
+                                          len(lat) - 1)], 2)
+            out["p99_ms"] = round(lat[min(int(len(lat) * 0.99),
+                                          len(lat) - 1)], 2)
+        if self.errors:
+            out["errors"] = dict(self.errors)
+        return out
+
+
+async def run_phase(client, auth, kinds: Sequence[RequestFn], *,
+                    name: str, concurrency: int, requests: int) -> PhaseResult:
+    """Closed-loop phase: ``concurrency`` workers drain ``requests``
+    total, each request round-robining across ``kinds`` (deterministic
+    mix — a mixed-traffic scenario interleaves chat/tools/A2A instead of
+    batching by kind)."""
+    result = PhaseResult(name=name, concurrency=concurrency)
+    # plain iterator, no lock: workers share one event loop and next()
+    # has no await point, so draws cannot interleave
+    counter = iter(range(requests))
+
+    async def worker() -> None:
+        while True:
+            i = next(counter, None)
+            if i is None:
+                return
+            kind = kinds[i % len(kinds)]
+            started = time.monotonic()
+            try:
+                ok, tag = await kind(client, auth, i)
+            except Exception as exc:
+                ok, tag = False, type(exc).__name__
+            result.latencies_ms.append((time.monotonic() - started) * 1e3)
+            result.requests += 1
+            if not ok:
+                result.failures += 1
+                result.errors[tag or "error"] += 1
+
+    wall_start = time.monotonic()
+    await asyncio.gather(*[worker() for _ in range(max(1, concurrency))])
+    result.wall_s = time.monotonic() - wall_start
+    return result
+
+
+async def run_phases(client, auth, kinds: Sequence[RequestFn],
+                     phases: Sequence[tuple[str, int, int]]
+                     ) -> dict[str, Any]:
+    """Run ``(name, concurrency, requests)`` phases back to back (the
+    ramp shape is just a phase list) and merge the numbers."""
+    results = [await run_phase(client, auth, kinds, name=name,
+                               concurrency=conc, requests=n)
+               for name, conc, n in phases]
+    merged = PhaseResult(name="total",
+                         concurrency=max(r.concurrency for r in results))
+    for r in results:
+        merged.requests += r.requests
+        merged.failures += r.failures
+        merged.wall_s += r.wall_s
+        merged.latencies_ms.extend(r.latencies_ms)
+        merged.errors.update(r.errors)
+    return {"phases": [r.summary() for r in results], **merged.summary()}
+
+
+# ----------------------------------------------------------------- SLO window
+
+class SloWindow:
+    """One named ``/admin/slo`` delta window bracketing a scenario.
+
+    The evaluator keys delta state per consumer (``?window=<name>``), so
+    a scenario's phase-length window cannot be shredded by the admin
+    UI's 5 s poll — ``open()`` advances this consumer's snapshot to
+    "now", ``close()`` reads the verdicts accumulated since."""
+
+    def __init__(self, client, name: str, auth) -> None:
+        self.client = client
+        self.name = name
+        self.auth = auth
+
+    async def _evaluate(self) -> dict[str, Any]:
+        resp = await self.client.get(f"/admin/slo?window={self.name}",
+                                     auth=self.auth)
+        if resp.status != 200:
+            raise RuntimeError(
+                f"/admin/slo -> {resp.status}: {await resp.text()}")
+        return await resp.json()
+
+    async def open(self) -> None:
+        await self._evaluate()  # snapshot reset: deltas start here
+
+    async def close(self) -> dict[str, Any]:
+        report = await self._evaluate()
+        return {
+            "ok": report["ok"],
+            "window_s": report["window_s"],
+            "error_budget": report["error_budget"],
+            "objectives": {
+                o["name"]: {
+                    "ok": o["ok"],
+                    "target_ms": o["target_ms"],
+                    "window_p_ms": o["window_p_ms"],
+                    "window_samples": o["window_samples"],
+                    "fraction_over_target": o["fraction_over_target"],
+                    "burn_rate": o["burn_rate"],
+                } for o in report["objectives"]
+            },
+        }
+
+
+def assert_slo_measured(slo: dict[str, Any],
+                        objectives: Sequence[str]) -> list[str]:
+    """The no-vacuous-pass rule for scenario SLOs: each named objective
+    must have WINDOW SAMPLES (the scenario actually exercised it) — a
+    breach is a verdict, an empty window is a harness bug. Returns the
+    list of problems (empty = measured)."""
+    problems = []
+    for name in objectives:
+        obj = slo.get("objectives", {}).get(name)
+        if obj is None:
+            problems.append(f"objective {name} missing from /admin/slo")
+        elif not obj["window_samples"]:
+            problems.append(f"objective {name} saw zero window samples "
+                            f"(scenario never exercised it)")
+    return problems

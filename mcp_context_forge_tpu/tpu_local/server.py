@@ -13,8 +13,18 @@ from typing import Any
 
 from aiohttp import web
 
+from ..observability import phases as request_phases
 from ..observability.tracing import current_span
 from .provider import LLMError, LLMProviderRegistry
+
+
+def _queue_state(request: web.Request) -> dict[str, Any] | None:
+    """Engine/pool admission state for the backpressure headers, when
+    the gateway has them enabled (gateway/flight_recorder.queue_state)."""
+    if not request.app["ctx"].settings.gw_backpressure_headers:
+        return None
+    from ..gateway.flight_recorder import queue_state
+    return queue_state(request.app)
 
 
 def setup_llm_routes(app: web.Application, registry: LLMProviderRegistry,
@@ -48,15 +58,36 @@ def setup_llm_routes(app: web.Application, registry: LLMProviderRegistry,
             span.set_attribute("llm.stream", bool(body.get("stream")))
         try:
             if body.get("stream"):
-                registry.resolve(body.get("model"))  # fail before the stream starts
-                resp = web.StreamResponse(headers={
-                    "content-type": "text/event-stream",
-                    "cache-control": "no-store"})
+                with request_phases.phase("routing"):
+                    registry.resolve(body.get("model"))  # fail before the stream starts
+                headers = {"content-type": "text/event-stream",
+                           "cache-control": "no-store"}
+                # backpressure surfaces BEFORE prepare(): a streamed
+                # response's headers are immutable once sent, so the
+                # flight-recorder middleware cannot add them afterwards
+                state = _queue_state(request)
+                if state is not None:
+                    from ..gateway.flight_recorder import \
+                        backpressure_headers
+                    headers.update(backpressure_headers(
+                        state, request.app["ctx"].settings))
+                resp = web.StreamResponse(headers=headers)
                 await resp.prepare(request)
                 try:
-                    async for chunk in registry.chat_stream(body):
-                        await resp.write(
-                            b"data: " + json.dumps(chunk).encode() + b"\n\n")
+                    # phase attribution splits the stream loop: waiting
+                    # on the engine's next chunk is "engine", pushing it
+                    # to the socket is "serialize"
+                    chunks = registry.chat_stream(body).__aiter__()
+                    while True:
+                        with request_phases.phase("engine"):
+                            try:
+                                chunk = await chunks.__anext__()
+                            except StopAsyncIteration:
+                                break
+                        with request_phases.phase("serialize"):
+                            await resp.write(
+                                b"data: " + json.dumps(chunk).encode()
+                                + b"\n\n")
                     await resp.write(b"data: [DONE]\n\n")
                 except Exception as exc:
                     # mid-stream failure: error event on the stream — a second
@@ -66,8 +97,10 @@ def setup_llm_routes(app: web.Application, registry: LLMProviderRegistry,
                     ).encode() + b"\n\n")
                 await resp.write_eof()
                 return resp
-            result = await registry.chat(body)
-            return web.json_response(result)
+            with request_phases.phase("engine"):
+                result = await registry.chat(body)
+            with request_phases.phase("serialize"):
+                return web.json_response(result)
         except LLMError as exc:
             _count_error(request)
             return web.json_response({"error": {"message": str(exc),

@@ -72,6 +72,10 @@ async def test_every_tab_endpoint_answers_with_consumable_shape():
                 assert "decode_steps" in data, (name, data)
             elif spec.get("special") == "ingress":
                 assert "mode" in data and "available" in data, (name, data)
+            elif spec.get("special") == "gwflight":
+                # flight-recorder snapshot: rings + loop health blocks
+                assert "slowest" in data and "recent" in data, (name, data)
+                assert "loop" in data, (name, data)
             elif spec.get("special") == "teams":
                 assert isinstance(data, list), (name, type(data))
             elif spec.get("special") == "plugins":

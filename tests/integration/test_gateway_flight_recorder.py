@@ -1,0 +1,348 @@
+"""Gateway flight recorder, wired end-to-end: every HTTP request gets a
+phase row whose vector sums to the measured wall (tolerance-gated, incl.
+plugin-pipeline and streaming-chat routes), GET /admin/gateway/requests
+serves the slowest-N ring with per-phase breakdowns, error paths (plugin
+hook raise, auth reject, client disconnect) still emit rows, rings stay
+bounded under churn, and the engine-pool backpressure headers ride the
+LLM surface."""
+
+import asyncio
+import types
+
+import aiohttp
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from mcp_context_forge_tpu.config import load_settings
+from mcp_context_forge_tpu.gateway.app import build_app
+from mcp_context_forge_tpu.plugins.framework import Plugin, PluginConfig, \
+    PluginViolation
+
+AUTH = aiohttp.BasicAuth("admin", "changeme")
+
+
+class BoomPreRequestPlugin(Plugin):
+    """http_pre_request hook that rejects everything non-public."""
+
+    async def http_pre_request(self, method, path, headers, context):
+        raise PluginViolation("flight-recorder test boom", code="BOOM")
+
+
+async def _make_gateway(engine: bool = False, **extra_env) -> TestClient:
+    env = {
+        "MCPFORGE_DATABASE_URL": "sqlite:///:memory:",
+        "MCPFORGE_PLUGINS_ENABLED": "true",
+        "MCPFORGE_TPU_LOCAL_ENABLED": "true" if engine else "false",
+        "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
+        **({"MCPFORGE_TPU_LOCAL_MODEL": "llama3-test",
+            "MCPFORGE_TPU_LOCAL_MAX_BATCH": "4",
+            "MCPFORGE_TPU_LOCAL_MAX_SEQ_LEN": "128",
+            "MCPFORGE_TPU_LOCAL_PAGE_SIZE": "16",
+            "MCPFORGE_TPU_LOCAL_NUM_PAGES": "64",
+            "MCPFORGE_TPU_LOCAL_PREFILL_BUCKETS": "64",
+            "MCPFORGE_TPU_LOCAL_DTYPE": "float32"} if engine else {}),
+        **extra_env,
+    }
+    app = await build_app(load_settings(env=env, env_file=None))
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def _rows(client) -> list:
+    return list(client.app["flight_recorder"].recent)
+
+
+def _sum_ok(row, tolerance_ms: float = 1.5) -> bool:
+    """The acceptance invariant: phase sum ≈ measured wall."""
+    return abs(sum(row["phases_ms"].values())
+               - row["duration_ms"]) <= tolerance_ms
+
+
+async def test_every_request_gets_a_phase_row_summing_to_wall():
+    client = await _make_gateway()
+    try:
+        for path in ("/health", "/version"):
+            resp = await client.get(path)
+            assert resp.status == 200
+        resp = await client.get("/tools", auth=AUTH)  # auth + db work
+        assert resp.status == 200
+        rows = _rows(client)
+        assert len(rows) >= 3
+        for row in rows:
+            assert row["phases_ms"], row
+            assert all(v >= 0 for v in row["phases_ms"].values()), row
+            assert _sum_ok(row), row
+        tools_row = next(r for r in rows if r["path"] == "/tools")
+        # the authenticated, DB-backed route attributes both layers
+        assert tools_row["phases_ms"].get("auth", 0) > 0, tools_row
+        assert tools_row["phases_ms"].get("db", 0) > 0, tools_row
+        assert tools_row["status"] == 200
+        # rows join their OTel traces (http.request span ids + corr id)
+        assert len(tools_row["trace_id"]) == 32
+        assert tools_row["correlation_id"]
+    finally:
+        await client.close()
+
+
+async def test_plugin_pipeline_and_auth_phases_attributed():
+    client = await _make_gateway()
+    try:
+        pm = client.app["plugin_manager"]
+
+        class SlowHook(Plugin):
+            async def http_pre_request(self, method, path, headers, context):
+                await asyncio.sleep(0.03)
+
+        pm.plugins.append(SlowHook(PluginConfig(name="slow",
+                                                kind="inline")))
+        pm._reindex()
+        resp = await client.get("/tools", auth=AUTH)
+        assert resp.status == 200
+        row = next(r for r in reversed(_rows(client))
+                   if r["path"] == "/tools")
+        # the hook's 30 ms lands in "plugins", NOT in auth or residue
+        assert row["phases_ms"].get("plugins", 0) >= 25.0, row
+        assert row["phases_ms"].get("auth", 0) < 25.0, row
+        assert _sum_ok(row), row
+    finally:
+        await client.close()
+
+
+async def test_plugin_hook_raise_still_emits_row():
+    client = await _make_gateway()
+    try:
+        pm = client.app["plugin_manager"]
+        await pm.add_plugin(PluginConfig(
+            name="boom",
+            kind="test_gateway_flight_recorder.BoomPreRequestPlugin"))
+        resp = await client.get("/tools", auth=AUTH)
+        assert resp.status == 500  # violation surfaces as translated error
+        row = next(r for r in reversed(_rows(client))
+                   if r["path"] == "/tools")
+        assert row["status"] == 500
+        assert row["error"] == "http_500"
+        assert row["phases_ms"].get("plugins", 0) >= 0
+        assert _sum_ok(row), row
+    finally:
+        await client.close()
+
+
+async def test_auth_reject_still_emits_row():
+    client = await _make_gateway()
+    try:
+        resp = await client.get("/tools")  # no credentials
+        assert resp.status == 401
+        row = next(r for r in reversed(_rows(client))
+                   if r["path"] == "/tools")
+        assert row["status"] == 401
+        assert "auth" in row["phases_ms"]
+        assert _sum_ok(row), row
+    finally:
+        await client.close()
+
+
+async def test_client_disconnect_mid_request_emits_error_row():
+    """A CancelledError escaping the handler (aiohttp's client-gone
+    signal) must still produce a flight-recorder row flagged
+    client_disconnected, with the residue charged to 'error'."""
+    from mcp_context_forge_tpu.gateway.flight_recorder import FlightRecorder
+    from mcp_context_forge_tpu.gateway.middleware import (
+        client_disconnect_middleware, flight_recorder_middleware)
+
+    recorder = FlightRecorder(slow_request_s=0.0)
+    app = web.Application(middlewares=[flight_recorder_middleware,
+                                       client_disconnect_middleware])
+    app["flight_recorder"] = recorder
+    app["ctx"] = types.SimpleNamespace(
+        settings=load_settings(env={"MCPFORGE_DATABASE_URL":
+                                    "sqlite:///:memory:"}, env_file=None),
+        metrics=None)
+
+    async def cancelled_handler(request):
+        raise asyncio.CancelledError()
+
+    app.router.add_get("/gone", cancelled_handler)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        try:
+            await client.get("/gone")
+        except aiohttp.ClientError:
+            pass  # server drops the connection for a cancelled handler
+        row = next(r for r in recorder.recent if r["path"] == "/gone")
+        assert row["client_disconnected"] is True
+        assert row["error"] == "CancelledError"
+        assert row["status"] == 499
+        assert "error" in row["phases_ms"]
+    finally:
+        await client.close()
+
+
+async def test_admin_endpoint_serves_slowest_ring_and_loop_health():
+    client = await _make_gateway(MCPFORGE_GW_FLIGHT_RING_SIZE="16",
+                                 MCPFORGE_GW_FLIGHT_SLOWEST_SIZE="4")
+    try:
+        for i in range(40):  # churn well past both bounds
+            await client.get("/health")
+        resp = await client.get("/admin/gateway/requests?limit=8",
+                                auth=AUTH)
+        assert resp.status == 200
+        snap = await resp.json()
+        assert snap["recorded"] >= 40
+        assert len(snap["recent"]) <= 8
+        assert 1 <= len(snap["slowest"]) <= 4  # bounded under churn
+        for row in snap["slowest"] + snap["recent"]:
+            assert "phases_ms" in row and "duration_ms" in row
+        # slowest is duration-ordered, worst first
+        durations = [r["duration_ms"] for r in snap["slowest"]]
+        assert durations == sorted(durations, reverse=True)
+        assert snap["loop"] is not None  # sampler lives alongside
+        assert snap["loop"]["samples"] >= 0
+        # rings bounded in the recorder itself, not just the response
+        recorder = client.app["flight_recorder"]
+        assert len(recorder.recent) <= 16
+        assert len(recorder.slowest()) <= 4
+        # limit validation
+        resp = await client.get("/admin/gateway/requests?limit=zep",
+                                auth=AUTH)
+        assert resp.status == 422
+    finally:
+        await client.close()
+
+
+async def test_recorder_disabled_404s_and_skips_rows():
+    client = await _make_gateway(
+        MCPFORGE_GW_FLIGHT_RECORDER_ENABLED="false")
+    try:
+        resp = await client.get("/health")
+        assert resp.status == 200
+        assert "flight_recorder" not in client.app
+        resp = await client.get("/admin/gateway/requests", auth=AUTH)
+        assert resp.status == 404
+    finally:
+        await client.close()
+
+
+async def test_backpressure_headers_survive_recorder_disable():
+    """The recorder and the backpressure signal are independent knobs:
+    turning attribution off must not strip X-Queue-Depth from unary LLM
+    responses (clients keep their queue-depth signal)."""
+    import types
+
+    from mcp_context_forge_tpu.gateway.middleware import (
+        flight_recorder_middleware)
+
+    class _Stats:
+        queue_depth = 7
+
+    class _Cfg:
+        max_queue = 10
+
+    app = web.Application(middlewares=[flight_recorder_middleware])
+    app["ctx"] = types.SimpleNamespace(
+        settings=load_settings(env={"MCPFORGE_DATABASE_URL":
+                                    "sqlite:///:memory:"}, env_file=None),
+        metrics=None)
+    app["tpu_engine"] = types.SimpleNamespace(stats=_Stats(), config=_Cfg())
+
+    async def chat(request):
+        return web.json_response({"ok": True})
+
+    app.router.add_post("/v1/chat/completions", chat)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        assert "flight_recorder" not in app  # recorder genuinely off
+        resp = await client.post("/v1/chat/completions", json={})
+        assert resp.status == 200
+        assert resp.headers.get("X-Queue-Depth") == "7"
+        # 0.7 saturation sits below the 0.8 advisory bar: no Retry-After
+        assert resp.headers.get("Retry-After") is None
+        _Stats.queue_depth = 10  # saturate -> backoff advice appears
+        resp = await client.post("/v1/chat/completions", json={})
+        assert resp.headers.get("Retry-After") == "8"
+    finally:
+        await client.close()
+
+
+async def test_slow_request_threshold_is_configurable(caplog):
+    import logging
+    client = await _make_gateway(MCPFORGE_GW_SLOW_REQUEST_MS="1")
+    try:
+        with caplog.at_level(logging.WARNING):
+            # /tools does real auth + db work: comfortably over 1 ms
+            resp = await client.get("/tools", auth=AUTH)
+            assert resp.status == 200
+        record = next(r for r in caplog.records
+                      if "slow request" in r.message)
+        message = record.getMessage()
+        assert "phases=" in message and "threshold 1.0 ms" in message
+        assert client.app["flight_recorder"].slow_requests >= 1
+    finally:
+        await client.close()
+
+
+async def test_engine_routes_attribute_engine_phase_and_headers():
+    """Chat completions (unary AND streaming) attribute the engine
+    handoff, and the LLM surface carries the X-Queue-Depth backpressure
+    header wired from engine admission state."""
+    client = await _make_gateway(engine=True)
+    try:
+        resp = await client.post("/v1/chat/completions", auth=AUTH, json={
+            "model": "llama3-test",
+            "messages": [{"role": "user", "content": "attribute me"}],
+            "max_tokens": 4})
+        assert resp.status == 200, await resp.text()
+        assert resp.headers.get("X-Queue-Depth") is not None
+        row = next(r for r in reversed(_rows(client))
+                   if r["path"] == "/v1/chat/completions")
+        phases = row["phases_ms"]
+        # the engine handoff dominates a chat request's wall
+        assert phases.get("engine", 0) > 0, row
+        assert phases["engine"] >= 0.5 * row["duration_ms"], row
+        assert "serialize" in phases, row
+        assert _sum_ok(row), row
+
+        # streaming: headers ride the prepared SSE response, the row
+        # splits engine wait from socket writes
+        resp = await client.post("/v1/chat/completions", auth=AUTH, json={
+            "model": "llama3-test", "stream": True,
+            "messages": [{"role": "user", "content": "stream me"}],
+            "max_tokens": 4})
+        assert resp.status == 200
+        assert resp.headers.get("X-Queue-Depth") is not None
+        body = await resp.text()
+        assert "data: [DONE]" in body
+        row = next(r for r in reversed(_rows(client))
+                   if r["path"] == "/v1/chat/completions")
+        assert row["phases_ms"].get("engine", 0) > 0, row
+        assert "serialize" in row["phases_ms"], row
+        assert _sum_ok(row), row
+
+        # saturation gauge was fed by the header path
+        rendered = client.app["ctx"].metrics.render()[0].decode()
+        assert "mcpforge_gw_engine_saturation" in rendered
+        assert 'mcpforge_gw_request_phase_seconds_bucket' in rendered
+    finally:
+        await client.close()
+
+
+async def test_slo_endpoint_serves_http_objective_without_engine():
+    """The http_p95 objective makes /admin/slo meaningful for pure
+    gateway deployments (no engine), and the scenario harness's named
+    delta windows work against it."""
+    client = await _make_gateway()
+    try:
+        await client.get("/health")
+        resp = await client.get("/admin/slo?window=fr-test", auth=AUTH)
+        assert resp.status == 200
+        body = await resp.json()
+        names = {o["name"] for o in body["objectives"]}
+        assert "http_p95" in names
+        http_obj = next(o for o in body["objectives"]
+                        if o["name"] == "http_p95")
+        assert http_obj["total_samples"] >= 1
+        assert body["consumer"] == "fr-test"
+    finally:
+        await client.close()

@@ -1,0 +1,118 @@
+"""CPU smoke of bench_gateway_scenarios.py: the SLO-asserting scenario
+harness must not rot between TPU windows. Runs burst + ramp + chaos at
+tiny scale against a real-socket pool-of-2 gateway (mixed — which builds
+a second peer gateway — stays in `make bench-scenarios`), asserts the
+captures bench_trend gates, the per-scenario SLO verdicts, and the chaos
+stream-integrity contract; plus the no-vacuous-pass exit path."""
+
+import asyncio
+import json
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.fixture()
+def scenario_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("BENCH_SCENARIO_SMOKE", "1")
+    monkeypatch.setenv("BENCH_SCENARIO_MODEL", "llama3-test")
+    monkeypatch.setenv("BENCH_SCENARIO_DIR", str(tmp_path))
+    monkeypatch.setenv("BENCH_SCENARIO_ROUND", "1")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, REPO_ROOT)
+    yield tmp_path
+    sys.path.remove(REPO_ROOT)
+
+
+def test_scenarios_cpu_smoke(scenario_env, monkeypatch):
+    monkeypatch.setenv("BENCH_SCENARIO_ONLY", "burst,ramp,chaos")
+    import bench_gateway_scenarios as bgs
+
+    report = asyncio.run(bgs.run_scenarios("cpu"))
+    assert report["ok"], report["problems"]
+    assert set(report["scenarios"]) == {"burst", "ramp", "chaos"}
+
+    for name, cap in report["scenarios"].items():
+        # the bench_trend gate contract: self-describing metric + the
+        # two gated values
+        assert cap["metric"] == "gateway_scenario_slo"
+        assert cap["value"] > 0
+        assert cap["p95_ms"] > 0
+        assert cap["failures"] == 0
+        # SLO verdicts came from /admin/slo delta windows, MEASURED:
+        # every asserted objective saw window samples (no vacuous pass)
+        slo = cap["slo"]
+        assert isinstance(slo["ok"], bool)
+        for objective in ("http_p95", "ttft_p95", "tpot_p95"):
+            assert slo["objectives"][objective]["window_samples"] > 0, \
+                (name, objective, slo)
+
+    burst = report["scenarios"]["burst"]
+    assert [p["name"] for p in burst["phases"]] == ["baseline", "burst",
+                                                    "cooldown"]
+    ramp = report["scenarios"]["ramp"]
+    assert [p["concurrency"] for p in ramp["phases"]] == [2, 4, 2]
+
+    # chaos: the kill interrupted real in-flight work, the merged
+    # failover streams matched the uninterrupted reference token-for-
+    # token, and the killed replica reloaded under residual load
+    chaos = report["scenarios"]["chaos"]
+    assert chaos["killed_replica"] is not None
+    assert chaos["requeues"] >= 1
+    assert chaos["token_parity"] is True
+    assert chaos["lost_streams"] == 0
+    assert chaos["replica_reloaded"] is True
+
+    # captures written per scenario, parseable, prefix-per-arm so
+    # bench_trend groups each scenario into its own gated series
+    names = sorted(report["captures_written"])
+    assert names == ["BENCH_SCENARIO_BURST_r01.json",
+                     "BENCH_SCENARIO_CHAOS_r01.json",
+                     "BENCH_SCENARIO_RAMP_r01.json"]
+    for file_name in names:
+        with open(scenario_env / file_name) as fh:
+            payload = json.load(fh)
+        assert payload["metric"] == "gateway_scenario_slo"
+        assert payload["value"] > 0
+
+
+def test_zero_scenario_run_is_not_a_pass(scenario_env, monkeypatch):
+    """PR-6's no-vacuous-pass rule: a run that produced no captures must
+    not report ok (main() exits 2 on an empty scenario set)."""
+    monkeypatch.setenv("BENCH_SCENARIO_ONLY", "no-such-scenario")
+    import bench_gateway_scenarios as bgs
+
+    report = asyncio.run(bgs.run_scenarios("cpu"))
+    assert report["ok"] is False
+    assert report["scenarios"] == {}
+    assert report["problems"]
+
+
+def test_scenario_captures_are_gated_by_bench_trend(scenario_env,
+                                                    monkeypatch, tmp_path):
+    """End-to-end with the trend gate: a healthy next round passes, a
+    collapsed-throughput round FAILS its scenario arm."""
+    from mcp_context_forge_tpu.tools.bench_trend import run_check
+
+    def write(round_n, value, p95):
+        path = tmp_path / f"BENCH_SCENARIO_BURST_r{round_n:02d}.json"
+        path.write_text(json.dumps({
+            "metric": "gateway_scenario_slo", "scenario": "burst",
+            "value": value, "p95_ms": p95, "unit": "req/s"}))
+
+    write(1, 100.0, 50.0)
+    write(2, 110.0, 45.0)
+    write(3, 104.0, 52.0)  # healthy newest
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert report["ok"], report["regressions"]
+    assert report["checks"] >= 2
+
+    write(3, 20.0, 400.0)  # step-function regression
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert not report["ok"]
+    assert any("BENCH_SCENARIO_BURST" in line or "value" in line
+               for line in report["regressions"])

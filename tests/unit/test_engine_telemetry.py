@@ -240,6 +240,7 @@ async def test_gateway_slo_and_step_attribution_surfaces():
         MCPFORGE_SLO_TPOT_P95_MS="60000",  # CPU decode must not flake it
         MCPFORGE_SLO_TTFT_P95_MS="60000",
         MCPFORGE_SLO_QUEUE_WAIT_P95_MS="60000",
+        MCPFORGE_SLO_HTTP_P95_MS="60000",
     )
     try:
         # SLO endpoint is live before any traffic (empty histograms)
@@ -248,7 +249,7 @@ async def test_gateway_slo_and_step_attribution_surfaces():
         body = await resp.json()
         assert body["ok"] is True
         assert {o["name"] for o in body["objectives"]} == {
-            "ttft_p95", "tpot_p95", "queue_wait_p95"}
+            "ttft_p95", "tpot_p95", "queue_wait_p95", "http_p95"}
 
         resp = await gateway.post("/v1/chat/completions", json={
             "model": "llama3-test",

@@ -172,13 +172,19 @@ def test_default_objectives_read_settings():
         slo_ttft_p95_ms = 111.0
         slo_tpot_p95_ms = 22.0
         slo_queue_wait_p95_ms = 333.0
+        slo_http_p95_ms = 444.0
 
     objectives = default_objectives(Settings())
     by_name = {o.name: o for o in objectives}
-    assert set(by_name) == {"ttft_p95", "tpot_p95", "queue_wait_p95"}
+    assert set(by_name) == {"ttft_p95", "tpot_p95", "queue_wait_p95",
+                            "http_p95"}
     assert by_name["ttft_p95"].target_ms == 111.0
     assert by_name["tpot_p95"].metric_attr == "llm_tpot"
     assert by_name["queue_wait_p95"].target_ms == 333.0
+    # gateway-side objective over the HTTP duration histogram (the one
+    # the scenario load harness asserts per phase window)
+    assert by_name["http_p95"].metric_attr == "http_duration"
+    assert by_name["http_p95"].target_ms == 444.0
     assert all(o.percentile == 0.95 for o in objectives)
 
 
