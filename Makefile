@@ -53,9 +53,11 @@ bench-superstep:
 	BENCH_SUPERSTEP=1,4,8,16 python bench_engine.py
 
 # SLO-asserting gateway scenario harness (docs/load_harness.md): burst /
-# diurnal ramp / mixed chat+tools+A2A+federation / chaos replica-kill
-# under load, each gated through /admin/slo delta windows; captures land
-# as BENCH_SCENARIO_*_r<N>.json and bench-check gates them per arm.
+# diurnal ramp / mixed chat+tools+A2A+federation / tenant (skewed
+# per-tenant mix with SLO classes + token-conservation gate) / chaos
+# replica-kill under load, each gated through /admin/slo delta windows;
+# captures land as BENCH_SCENARIO_*_r<N>.json and bench-check gates
+# them per arm.
 # CPU smoke variant runs in tier-1 (tests/unit/test_bench_scenarios_smoke.py).
 bench-scenarios:
 	python bench_gateway_scenarios.py

@@ -48,7 +48,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)) or ".")
 
-SCENARIOS = ("burst", "ramp", "mixed", "chaos")
+# "tenant" runs BEFORE "chaos": its ledger-vs-engine conservation check
+# reads pool.stats, which forgets a replica's counters when chaos's
+# rolling reload rebuilds the engine (the ledger, correctly, does not)
+SCENARIOS = ("burst", "ramp", "mixed", "tenant", "chaos")
 
 
 def _smoke() -> bool:
@@ -63,13 +66,15 @@ def _scale() -> dict:
                 "ramp_steps": [2, 4, 2], "ramp_requests": 6,
                 "mixed_concurrency": 4, "mixed_requests": 16,
                 "chaos_concurrency": 3, "chaos_requests": 9,
-                "chaos_prompts": 4, "max_tokens": 6}
+                "chaos_prompts": 4, "max_tokens": 6,
+                "tenant_concurrency": 4, "tenant_requests": 16}
     return {"burst_phases": [("baseline", 4, 60), ("burst", 64, 400),
                              ("cooldown", 4, 60)],
             "ramp_steps": [4, 8, 16, 32, 16, 8, 4], "ramp_requests": 50,
             "mixed_concurrency": 16, "mixed_requests": 240,
             "chaos_concurrency": 8, "chaos_requests": 64,
-            "chaos_prompts": 6, "max_tokens": 16}
+            "chaos_prompts": 6, "max_tokens": 16,
+            "tenant_concurrency": 8, "tenant_requests": 80}
 
 
 async def _make_gateway(platform: str, replicas: int = 2):
@@ -110,6 +115,19 @@ async def _make_gateway(platform: str, replicas: int = 2):
             "BENCH_SCENARIO_TTFT_MS", "30000" if platform != "tpu" else "2500"),
         "MCPFORGE_SLO_TPOT_P95_MS": os.environ.get(
             "BENCH_SCENARIO_TPOT_MS", "30000" if platform != "tpu" else "250"),
+        # tenant metering + SLO classes (scenario "tenant"): premium and
+        # batch bundles assigned to the scenario's minted users; rollup
+        # interval long — the scenario flushes explicitly for determinism
+        "MCPFORGE_TENANT_LABEL_CLAMP": "4",
+        "MCPFORGE_TENANT_QUOTA_TOKENS_PER_WINDOW": "100000",
+        "MCPFORGE_TENANT_USAGE_ROLLUP_INTERVAL_S": "3600",
+        "MCPFORGE_SLO_CLASSES": json.dumps({
+            "premium": {"ttft_p95_ms": 30000 if platform != "tpu" else 1000,
+                        "http_p95_ms": 30000 if platform != "tpu" else 2000},
+            "batch": {"ttft_p95_ms": 120000, "http_p95_ms": 120000}}),
+        "MCPFORGE_SLO_TENANT_CLASSES": json.dumps({
+            "user:tenant-a@scenario.local": "premium",
+            "user:tenant-c@scenario.local": "batch"}),
         # warmup the shape grid so timed scenarios measure steady state —
         # but the FAST subset everywhere: the full grid × 2 replicas is
         # tens of minutes of XLA compiles on a CPU box, and a rare
@@ -191,6 +209,129 @@ async def scenario_mixed(app, client, auth, model, scale) -> dict:
             "p50_ms": result.get("p50_ms"), "p95_ms": result.get("p95_ms"),
             "traffic": ["chat", "tools_call", "federation", "a2a"],
             **_strip(result)}
+
+
+async def scenario_tenant(app, client, auth, model, scale) -> dict:
+    """Per-tenant mix: three minted principals with skewed weights
+    (5:2:1) drive one closed loop; each tenant's assigned SLO CLASS is
+    evaluated over its own ``/admin/slo?tenant=`` window. Verdicts:
+    (a) every tenant's class window actually measured (no vacuous pass);
+    (b) ledger-vs-engine token conservation holds under the mixed load
+    (sum of per-tenant prompt/generated/cache-hit tokens == the pool's
+    untagged totals); (c) the exported tenant label set respects the
+    clamp bound; (d) the rollup writes durable tenant_usage rows."""
+    from aiohttp import BasicAuth
+
+    from mcp_context_forge_tpu.tools.loadgen import (SloWindow, chat_kind,
+                                                     run_phase,
+                                                     weighted_schedule)
+    pool = app["tpu_engine_pool"]
+    ledger = app["tenant_ledger"]
+    tenants = [("tenant-a@scenario.local", "Vq8#mRt2xW!a", 5),
+               ("tenant-b@scenario.local", "Vq8#mRt2xW!b", 2),
+               ("tenant-c@scenario.local", "Vq8#mRt2xW!c", 1)]
+    for email, password, _ in tenants:
+        resp = await client.post("/admin/users", json={
+            "email": email, "password": password,
+            "full_name": "Scenario Tenant"}, auth=auth)
+        assert resp.status in (201, 409), await resp.text()
+    auths = {email: BasicAuth(email, password)
+             for email, password, _ in tenants}
+    ids = {email: f"user:{email}" for email, _, _ in tenants}
+    kind = chat_kind(model, max_tokens=scale["max_tokens"])
+    # deterministic clamp admission BEFORE the windows open: a tenant
+    # admitted mid-window would resolve a different label at close()
+    # than at open() (peek "other" -> own label) and read a fresh, empty
+    # delta — prime one request per tenant so labels are stable
+    for email, _, _ in tenants:
+        await run_phase(client, auths[email], [kind], name="prime",
+                        concurrency=1, requests=1)
+
+    windows = {email: SloWindow(client, "scenario-tenant", auth,
+                                tenant=ids[email]) for email, _, _ in tenants}
+    for window in windows.values():
+        await window.open()
+    pick = weighted_schedule([(auths[email], weight)
+                              for email, _, weight in tenants])
+    load = await run_phase(client, pick, [kind], name="tenant-mix",
+                           concurrency=scale["tenant_concurrency"],
+                           requests=scale["tenant_requests"])
+    slos = {ids[email]: await windows[email].close()
+            for email, _, _ in tenants}
+
+    # conservation: ledger column sums == the pool's untagged totals.
+    # Valid only while no replica was reload-rebuilt (pool.stats forgets
+    # a swapped engine's counters; the ledger keeps them) — scenario
+    # ordering runs "tenant" before "chaos" for exactly this reason.
+    stats = pool.stats
+    sums = ledger.column_sums()
+    hit_tokens = sum(r.engine.allocator.prefix_hit_tokens
+                     for r in pool.replicas)
+    reloaded = any(r.reloads for r in pool.replicas)
+    conservation = {
+        "checked": not reloaded,
+        "ledger_prompt": sums["prompt_tokens"],
+        "engine_prompt": stats.prompt_tokens,
+        "ledger_generated": sums["generated_tokens"],
+        "engine_generated": stats.completion_tokens,
+        "ledger_cache_hit": sums["cache_hit_tokens"],
+        "engine_cache_hit": hit_tokens,
+    }
+    conserved = (reloaded
+                 or (sums["prompt_tokens"] == stats.prompt_tokens
+                     and sums["generated_tokens"] == stats.completion_tokens
+                     and sums["cache_hit_tokens"] == hit_tokens))
+
+    # clamp bound: exported tenant label children <= top-N + "other"
+    rendered = app["ctx"].metrics.render()[0].decode()
+    labels = {line.split('tenant="')[1].split('"')[0]
+              for line in rendered.splitlines()
+              if not line.startswith("#") and 'tenant="' in line}
+    clamp_n = app["ctx"].metrics.tenant_clamp.max_tenants
+
+    # durable usage trail: force one rollup flush, then read it back
+    rollup_rows = 0
+    rollup = app.get("tenant_usage_rollup")
+    if rollup is not None:
+        await rollup.flush()
+        recent = await rollup.recent(limit=50)
+        rollup_rows = len(recent)
+    usage = await client.get("/admin/tenants/usage", auth=auth)
+    assert usage.status == 200, await usage.text()
+    usage_body = await usage.json()
+
+    per_tenant_requests = {t["tenant"]: t["requests"]
+                           for t in usage_body["tenants"]}
+    summary = load.summary()
+    heavy = slos[ids["tenant-a@scenario.local"]]
+    return {
+        "scenario": "tenant", "value": summary["rps"],
+        "p50_ms": summary.get("p50_ms"), "p95_ms": summary.get("p95_ms"),
+        "requests": load.requests, "failures": load.failures,
+        "wall_s": summary["wall_s"],
+        "tenants": {ids[email]: {"weight": weight, "slo": slos[ids[email]]}
+                    for email, _, weight in tenants},
+        "per_tenant_requests": per_tenant_requests,
+        "conservation": conservation,
+        "tenant_label_children": sorted(labels),
+        "clamp": usage_body["clamp"],
+        "rollup_rows": rollup_rows,
+        # the heavy tenant's class window doubles as the capture's
+        # gate-facing slo block (driver asserts it was MEASURED)
+        "slo": heavy, "slo_ok": all(s["ok"] for s in slos.values()),
+        "hard_fail": (
+            (not conserved and "per-tenant ledger sums diverged from the "
+                               f"engine totals: {conservation}")
+            or (len(labels) > clamp_n + 1
+                and f"tenant label set {sorted(labels)} exceeds the "
+                    f"top-{clamp_n}+1 clamp")
+            or (rollup_rows == 0 and "no tenant_usage rollup rows written")
+            or next((f"tenant window for {t} saw zero ttft samples"
+                     for t, s in slos.items()
+                     if not s["objectives"]["ttft_p95"]["window_samples"]),
+                    None)
+            or None),
+    }
 
 
 async def _reference_streams(app, prompts, max_tokens):
@@ -399,6 +540,8 @@ async def run_scenarios(platform: str) -> dict:
             "burst": lambda: scenario_burst(app, client, auth, model, scale),
             "ramp": lambda: scenario_ramp(app, client, auth, model, scale),
             "mixed": lambda: scenario_mixed(app, client, auth, model, scale),
+            "tenant": lambda: scenario_tenant(app, client, auth, model,
+                                              scale),
             "chaos": lambda: scenario_chaos(app, client, auth, model, scale),
         }
         for name in wanted:

@@ -465,6 +465,34 @@ class Settings(BaseModel):
     # routes); the load harness asserts it per scenario window
     slo_http_p95_ms: float = 1000.0
     slo_error_budget: float = 0.05
+    # --- SLO classes + tenant metering (observability/metering.py,
+    # docs/multitenancy.md) ---
+    # named target bundles assignable per tenant, JSON object of
+    # {"<name>": {"ttft_p95_ms": .., "tpot_p95_ms": .., "http_p95_ms": ..}}
+    # (the conceptual slo_class_<name>_{ttft,tpot,http}_p95_ms family);
+    # unset fields inherit the flat slo_* defaults. '' = default class only
+    slo_classes: str = ""
+    # tenant id -> class name, JSON object ({"team:abc": "premium"});
+    # unassigned tenants evaluate against the "default" class
+    slo_tenant_classes: str = ""
+    # per-tenant usage ledger (prompt/generated/cache-hit tokens +
+    # KV-page-seconds) fed by the engine at the same sites as its
+    # untagged counters, rolled up into the tenant_usage DB table and
+    # served at GET /admin/tenants/usage
+    tenant_metering_enabled: bool = True
+    # bounded-cardinality tenant label: the first N distinct tenants get
+    # their own Prometheus label child, the rest clamp to "other" (the
+    # exported set never exceeds N+1); size above your tenant count
+    tenant_label_clamp: int = 8
+    # exact per-tenant ledger rows kept in memory (overflow -> "other")
+    tenant_ledger_max_tenants: int = 512
+    # async rollup cadence: ledger window -> tenant_usage rows
+    tenant_usage_rollup_interval_s: float = 60.0
+    # tokens (prompt + generated) a tenant may consume per rollup window
+    # before mcpforge_gw_tenant_quota_used_ratio reads >= 1.0 — the
+    # saturation signal ROADMAP item 5's distributed rate limiter will
+    # enforce; 0 = no quota (gauge stays 0)
+    tenant_quota_tokens_per_window: int = 0
     # --- gateway flight recorder & loop health (gateway/flight_recorder.py,
     # docs/observability.md "Gateway flight recorder & loop health") ---
     gw_flight_recorder_enabled: bool = True

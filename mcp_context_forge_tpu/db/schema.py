@@ -449,6 +449,30 @@ CREATE INDEX IF NOT EXISTS ix_prt_email_created
 ALTER TABLE users ADD COLUMN tokens_valid_after REAL;
 """
 
+# v9: per-tenant usage rollups (observability/metering.py,
+# docs/multitenancy.md): one row per (tenant, rollup window) with the
+# token + KV-residency accounting the engine's TenantLedger accumulated
+# — the durable usage trail billing and the distributed rate limiter
+# (ROADMAP item 5) read. Tokens are conserved: summing any column over
+# all tenants equals the engine's untagged totals for the window.
+_V9 = """
+CREATE TABLE IF NOT EXISTS tenant_usage (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  tenant TEXT NOT NULL,
+  window_start REAL NOT NULL,
+  window_end REAL NOT NULL,
+  requests INTEGER NOT NULL DEFAULT 0,
+  prompt_tokens INTEGER NOT NULL DEFAULT 0,
+  generated_tokens INTEGER NOT NULL DEFAULT 0,
+  cache_hit_tokens INTEGER NOT NULL DEFAULT 0,
+  kv_page_seconds REAL NOT NULL DEFAULT 0
+);
+CREATE INDEX IF NOT EXISTS ix_tenant_usage_tenant_window
+  ON tenant_usage(tenant, window_end);
+CREATE INDEX IF NOT EXISTS ix_tenant_usage_window
+  ON tenant_usage(window_end);
+"""
+
 MIGRATIONS: list[Migration] = [
     Migration(1, "initial-core-schema", _V1),
     Migration(2, "a2a-task-store", _V2),
@@ -458,4 +482,5 @@ MIGRATIONS: list[Migration] = [
     Migration(6, "token-usage-and-password-enforcement", _V6),
     Migration(7, "compliance-reports", _V7),
     Migration(8, "password-reset-and-session-invalidation", _V8),
+    Migration(9, "tenant-usage-rollups", _V9),
 ]

@@ -167,6 +167,7 @@ const TABS = {
   chat:     {special: "chat"},
   engine:   {url: "/admin/engine/stats", special: "engine"},
   gateway:  {url: "/admin/gateway/requests?limit=24", special: "gwflight"},
+  tenants:  {url: "/admin/tenants/usage?limit=32", special: "tenants"},
   diagnostics: {special: "diagnostics"},
 };
 let current = "tools", rows = [], shown = [], timer = null, cursor = null;
@@ -294,8 +295,8 @@ async function renderEngine(stats){
 }
 function gwFlightTable(title, rows){
   // phase vector rendered inline: the breakdown IS the payload here
-  const cols = ["ts","method","path","status","duration_ms","phases_ms",
-                "error","trace_id"];
+  const cols = ["ts","method","path","status","tenant","duration_ms",
+                "phases_ms","error","trace_id"];
   const body = (rows || []).map(r =>
     "<tr>" + cols.map(c => {
       if (c === "phases_ms")
@@ -328,6 +329,63 @@ function renderGatewayFlight(snap){
     + gwFlightTable("slowest requests", snap.slowest)
     + gwFlightTable("recent requests", snap.recent);
   document.getElementById("status").textContent = "gateway flight recorder";
+}
+async function renderTenants(usage){
+  // per-tenant metering (observability/metering.py): live ledger rows,
+  // quota window, label clamp, and the recent DB rollups — plus each
+  // tenant's SLO-class verdict fetched per row from /admin/slo?tenant=
+  const clamp = usage.clamp || {};
+  const cards = `<div class="cards">
+    <div class="card"><b>${cell(usage.tenant_count)}</b><span>tenants</span></div>
+    <div class="card"><b>${cell(usage.rollups_written)}</b><span>rollup_rows_written</span></div>
+    <div class="card"><b>${cell(usage.rollup_interval_s)}</b><span>rollup_interval_s</span></div>
+    <div class="card"><b>${cell(usage.quota_tokens_per_window) || "off"}</b><span>quota_tokens_per_window</span></div>
+    <div class="card"><b>${cell((clamp.admitted||[]).length)}/${cell(clamp.max_tenants)}</b><span>label_clamp (top-N + other)</span></div>
+   </div>`;
+  const cols = ["tenant","label","requests","prompt_tokens","generated_tokens",
+                "cache_hit_tokens","kv_page_seconds","window_tokens",
+                "quota_used_ratio"];
+  // index-based handler lookup: a tenant id is attacker-influenced
+  // (user emails), and interpolating it into an onclick JS string would
+  // let a quote in the id break out (the HTML parser decodes esc()'s
+  // entities BEFORE the JS engine parses the attribute)
+  tenantRows = usage.tenants || [];
+  const body = tenantRows.map((t, i) =>
+    "<tr>" + cols.map(c => `<td>${
+      c === "quota_used_ratio" || c === "kv_page_seconds" ? fnum(t[c]) : cell(t[c])
+    }</td>`).join("")
+    + `<td><button class="act" onclick="tenantSlo(${i})">slo</button></td></tr>`
+  ).join("");
+  let table = body ? `<br><h3>ledger (cumulative since boot)</h3><table><tr>`
+    + cols.map(c => `<th>${esc(c)}</th>`).join("") + `<th></th></tr>${body}</table>` : "";
+  const rcols = ["tenant","window_start","window_end","requests",
+                 "prompt_tokens","generated_tokens","cache_hit_tokens",
+                 "kv_page_seconds"];
+  const rbody = (usage.rollups || []).slice(0, 24).map(r =>
+    "<tr>" + rcols.map(c => `<td>${
+      c === "window_start" || c === "window_end"
+        ? esc(new Date((r[c]||0)*1000).toISOString().slice(11,19)) : cell(r[c])
+    }</td>`).join("") + "</tr>").join("");
+  if (rbody) table += `<br><h3>recent rollups (tenant_usage table)</h3><table><tr>`
+    + rcols.map(c => `<th>${esc(c)}</th>`).join("") + `</tr>${rbody}</table>`;
+  document.getElementById("view").innerHTML = cards + table
+    + `<pre id="tenant-slo" class="kv"></pre>`;
+  document.getElementById("status").textContent = "tenant usage metering";
+}
+let tenantRows = [];
+async function tenantSlo(i){
+  // the tenant's assigned SLO class, evaluated over ITS label slice
+  const row = tenantRows[i];
+  if (!row) return;
+  const r = await fetch("/admin/slo?window=admin-ui&tenant=" + encodeURIComponent(row.tenant));
+  const el = document.getElementById("tenant-slo");
+  if (!r.ok){ el.textContent = "slo fetch failed: " + r.status; return; }
+  const s = await r.json();
+  el.textContent = JSON.stringify({tenant: s.tenant, slo_class: s.slo_class,
+    tenant_label: s.tenant_label, clamped: s.tenant_clamped, ok: s.ok,
+    objectives: (s.objectives||[]).map(o => ({name: o.name, target_ms: o.target_ms,
+      window_p_ms: o.window_p_ms, window_samples: o.window_samples,
+      burn_rate: o.burn_rate, ok: o.ok}))}, null, 1);
 }
 async function poolAct(rid, action){
   const r = await fetch(`/admin/engine/pool/${rid}/${action}`, {method:"POST"});
@@ -654,6 +712,7 @@ async function show(name, keepCursor){
     let data = await r.json();
     if (t.special === "engine") return renderEngine(data);
     if (t.special === "gwflight") return renderGatewayFlight(data);
+    if (t.special === "tenants") return renderTenants(data);
     if (t.special === "ingress") return renderIngress(data);
     if (t.path) data = data[t.path] || [];
     if (data && !Array.isArray(data) && Array.isArray(data.items)){

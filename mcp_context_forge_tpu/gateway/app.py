@@ -90,7 +90,12 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     app["kv_store"] = kv_store
     tracer = init_tracer(settings.otel_service_name,
                          settings.otel_exporter if settings.otel_enable else "none")
-    metrics = PrometheusRegistry()
+    # one tenant clamp shared by the metric registry and the usage
+    # ledger: bounded tenant label cardinality (top-N + "other"),
+    # identical admission on both sides (docs/multitenancy.md)
+    from ..observability.tenant import TenantClamp
+    tenant_clamp = TenantClamp(settings.tenant_label_clamp)
+    metrics = PrometheusRegistry(tenant_clamp=tenant_clamp)
 
     ctx = AppContext(settings=settings, db=db, bus=bus, leases=leases,
                      tracer=tracer, metrics=metrics)
@@ -160,14 +165,40 @@ async def build_app(settings: Settings | None = None) -> web.Application:
             warn_s=settings.gw_loop_lag_warn_ms / 1e3, recorder=recorder)
         app["loop_lag_sampler"] = loop_sampler
 
+    # per-tenant usage metering (observability/metering.py): the ledger
+    # the engine feeds at retire time, its periodic DB rollup, and the
+    # GET /admin/tenants/usage surface. Built before the engine so
+    # every replica (and every reload-rebuilt engine) shares it.
+    tenant_ledger = None
+    tenant_rollup = None
+    if settings.tenant_metering_enabled:
+        from ..observability.metering import TenantLedger, TenantUsageRollup
+        tenant_ledger = TenantLedger(
+            clamp=tenant_clamp, metrics=metrics,
+            max_tenants=settings.tenant_ledger_max_tenants,
+            quota_tokens_per_window=settings.tenant_quota_tokens_per_window)
+        tenant_rollup = TenantUsageRollup(
+            db, tenant_ledger,
+            interval_s=settings.tenant_usage_rollup_interval_s)
+        app["tenant_ledger"] = tenant_ledger
+        app["tenant_usage_rollup"] = tenant_rollup
+        ctx.extras["tenant_ledger"] = tenant_ledger
+
     # SLO verdicts over the serving histograms at GET /admin/slo —
     # engine objectives (TTFT/TPOT/queue-wait) read empty without the
     # engine, but the gateway http_p95 objective holds for every
-    # deployment, so the evaluator is unconditional
-    from ..observability.slo import SloEvaluator, default_objectives
+    # deployment, so the evaluator is unconditional. SLO classes map
+    # tenants to named target bundles, evaluated per tenant label slice
+    # at /admin/slo?tenant= (clamp peek: a probe never consumes a slot)
+    from ..observability.slo import (SloEvaluator, default_objectives,
+                                     parse_slo_classes,
+                                     parse_tenant_classes)
     app["slo_evaluator"] = SloEvaluator(
         metrics, default_objectives(settings),
-        error_budget=settings.slo_error_budget)
+        error_budget=settings.slo_error_budget,
+        slo_classes=parse_slo_classes(settings),
+        tenant_classes=parse_tenant_classes(settings),
+        tenant_label=tenant_clamp.peek)
 
     # operation-timing registry (reference performance_tracker.py): http /
     # db / tool / resource series feed /admin/performance and the bundle
@@ -242,12 +273,14 @@ async def build_app(settings: Settings | None = None) -> web.Application:
                 health_interval_s=settings.tpu_local_pool_health_interval_s,
                 heartbeat_timeout_s=(
                     settings.tpu_local_pool_heartbeat_timeout_s),
-                requeue_max=settings.tpu_local_pool_requeue_max)
+                requeue_max=settings.tpu_local_pool_requeue_max,
+                ledger=tenant_ledger)
             engine = engine_pool.replicas[0].engine
             app["tpu_engine_pool"] = engine_pool
             ctx.extras["tpu_engine_pool"] = engine_pool
         else:
-            engine = TPUEngine(engine_config, tracer=tracer, metrics=metrics)
+            engine = TPUEngine(engine_config, tracer=tracer, metrics=metrics,
+                               ledger=tenant_ledger)
         from ..services.diagnostics_service import JaxProfilerCapture
         app["jax_profiler"] = JaxProfilerCapture(settings.jax_profile_dir)
         provider = TPULocalProvider(
@@ -676,6 +709,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         await gateway_service.start_health_loop()
         if loop_sampler is not None:
             await loop_sampler.start()
+        if tenant_rollup is not None:
+            await tenant_rollup.start()  # ledger window -> tenant_usage
         await metrics_maintenance.start()
         if metrics_buffer is not None:
             await metrics_buffer.start()
@@ -729,6 +764,11 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         await elector.stop()
         if ctx.llm_registry is not None:
             await ctx.llm_registry.shutdown()
+        if tenant_rollup is not None:
+            # AFTER engine shutdown (the last retires have landed in the
+            # ledger) and before db.close(): the final window's usage
+            # rows must not be lost at shutdown
+            await tenant_rollup.stop()
         await upstream_sessions.stop()
         await grpc_service.shutdown()
         await ctx.close_http_client()

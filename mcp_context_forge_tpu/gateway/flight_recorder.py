@@ -96,6 +96,7 @@ class FlightRecorder:
                duration_s: float, phases_ms: dict[str, float],
                trace_id: str | None = None, span_id: str | None = None,
                correlation_id: str | None = None,
+               tenant: str | None = None,
                error: str | None = None,
                client_disconnected: bool = False) -> dict[str, Any]:
         """Append one completed request to the rings + Prometheus."""
@@ -108,6 +109,10 @@ class FlightRecorder:
             "duration_ms": round(duration_s * 1e3, 3),
             "phases_ms": phases_ms,
         }
+        if tenant:
+            # rows keep the EXACT tenant (bounded ring, no cardinality
+            # concern); only the Prometheus label below is clamped
+            entry["tenant"] = tenant
         if trace_id:
             entry["trace_id"] = trace_id
             if span_id:
@@ -129,9 +134,11 @@ class FlightRecorder:
                 self._slowest.pop(0)
         metrics = self.metrics
         if metrics is not None:
+            tenant_label = metrics.tenant_clamp.label(tenant or "anonymous")
             for phase_name, ms in phases_ms.items():
                 metrics.gw_request_phase.labels(
-                    route=route, phase=phase_name).observe(ms / 1e3)
+                    route=route, phase=phase_name,
+                    tenant=tenant_label).observe(ms / 1e3)
         # strictly-greater, matching PerformanceTracker.record's slow
         # branch — the two consumers of gw_slow_request_s must agree on
         # one bar (the walls differ by the recorder's own µs overhead;
@@ -159,17 +166,28 @@ class FlightRecorder:
         """Worst-duration-first."""
         return [entry for _, _, entry in reversed(self._slowest)]
 
-    def snapshot(self, limit: int = 64) -> dict[str, Any]:
+    def snapshot(self, limit: int = 64,
+                 tenant: str | None = None) -> dict[str, Any]:
+        """Ring contents; ``tenant`` filters both rings to one tenant's
+        rows (exact match on the row's unclamped tenant)."""
         limit = max(1, limit)
-        return {
+        slowest = self.slowest()
+        recent = list(self.recent)[::-1]  # newest first
+        if tenant:
+            slowest = [r for r in slowest if r.get("tenant") == tenant]
+            recent = [r for r in recent if r.get("tenant") == tenant]
+        out = {
             "recorded": self.recorded,
             "slow_requests": self.slow_requests,
             "slow_request_ms": round(self.slow_request_s * 1e3, 1),
             "ring_size": self.ring_size,
             "inflight": len(self.inflight),
-            "slowest": self.slowest()[:limit],
-            "recent": list(self.recent)[-limit:][::-1],  # newest first
+            "slowest": slowest[:limit],
+            "recent": recent[:limit],
         }
+        if tenant:
+            out["tenant"] = tenant
+        return out
 
 
 class LoopLagSampler:

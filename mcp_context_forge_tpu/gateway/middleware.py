@@ -17,6 +17,7 @@ from typing import Awaitable, Callable
 from aiohttp import web
 
 from ..observability import phases as request_phases
+from ..observability import tenant as tenant_ctx
 from ..observability.tracing import current_span
 from ..services.auth_service import AuthContext, AuthError, PermissionDenied
 from ..services.base import ConflictError, NotFoundError, ValidationFailure
@@ -206,7 +207,15 @@ async def observability_middleware(request: web.Request, handler: Handler) -> we
         span.set_attribute("http.status_code", response.status)
         elapsed = time.monotonic() - started
         ctx.metrics.http_requests.labels(request.method, path_label, str(response.status)).inc()
-        ctx.metrics.http_duration.labels(request.method, path_label).observe(elapsed)
+        # tenant resolved by the auth middleware (deeper in the chain —
+        # set by the time the handler returns); requests rejected before
+        # auth (rate limit, header size) read as anonymous. Clamped: the
+        # label child set stays bounded at tenant_label_clamp + 1
+        ctx.metrics.http_duration.labels(
+            request.method, path_label,
+            ctx.metrics.tenant_clamp.label(
+                request.get("tenant") or tenant_ctx.ANONYMOUS)
+        ).observe(elapsed)
         perf = ctx.extras.get("perf_tracker")
         if perf is not None:
             # the flight recorder (one layer in) already attributed this
@@ -305,6 +314,7 @@ async def flight_recorder_middleware(request: web.Request,
             trace_id=trace[0] if trace else None,
             span_id=trace[1] if trace else None,
             correlation_id=request.get("correlation_id"),
+            tenant=request.get("tenant"),
             error=error,
             client_disconnected=(disconnected
                                  or bool(request.get("client_disconnected"))))
@@ -464,6 +474,21 @@ async def rate_limit_middleware(request: web.Request, handler: Handler) -> web.S
     return await handler(request)
 
 
+async def _handle_as_tenant(request: web.Request,
+                            handler: Handler) -> web.StreamResponse:
+    """Run the rest of the chain under the principal's resolved tenant:
+    ``request['tenant']`` for the observability/flight-recorder layers
+    above, and the contextvar the LLM provider stamps onto GenRequests
+    (team → API key → user resolution; docs/multitenancy.md)."""
+    tenant = tenant_ctx.resolve_tenant(request.get("auth"))
+    request["tenant"] = tenant
+    token = tenant_ctx.set_current_tenant(tenant)
+    try:
+        return await handler(request)
+    finally:
+        tenant_ctx.reset_current_tenant(token)
+
+
 @web.middleware
 async def auth_middleware(request: web.Request, handler: Handler) -> web.StreamResponse:
     """Resolve identity (Bearer JWT / Basic) into request['auth'].
@@ -485,7 +510,7 @@ async def auth_middleware(request: web.Request, handler: Handler) -> web.StreamR
             or (request.path.startswith("/servers/")
                 and request.path.endswith("/.well-known/mcp"))):
         request["auth"] = AuthContext(user="anonymous", via="anonymous")
-        return await handler(request)
+        return await _handle_as_tenant(request, handler)
 
     # flight-recorder attribution: identity resolution (header parse,
     # plugin resolve, DB-backed bearer/basic lookups) charges the "auth"
@@ -515,7 +540,7 @@ async def auth_middleware(request: web.Request, handler: Handler) -> web.StreamR
     if pm is not None:
         await pm.http_pre_request(request.method, request.path, dict(request.headers),
                                   user=auth_ctx.user)
-    return await handler(request)
+    return await _handle_as_tenant(request, handler)
 
 
 @web.middleware

@@ -822,13 +822,18 @@ document.getElementById("f").onsubmit = async (e) => {
         samples over target, and burn rate against the error budget.
         ``?window=<name>`` names the caller's delta window (default
         "default") — the admin UI polls its own so it cannot shred a
-        load harness's phase-length windows."""
+        load harness's phase-length windows. ``?tenant=<id>`` evaluates
+        that tenant's assigned SLO CLASS (slo_classes /
+        slo_tenant_classes) against the tenant's metric label slice,
+        with its own per-(window, tenant) delta isolation."""
         request["auth"].require("observability.read")
         evaluator = request.app.get("slo_evaluator")
         if evaluator is None:  # pragma: no cover - evaluator is unconditional
             raise NotFoundError("SLO evaluation is not enabled")
         consumer = request.query.get("window", "default")[:64] or "default"
-        return web.json_response(evaluator.evaluate(consumer=consumer))
+        tenant = request.query.get("tenant") or None
+        return web.json_response(evaluator.evaluate(
+            consumer=consumer, tenant=tenant[:128] if tenant else None))
 
     @routes.get("/admin/engine/pool")
     async def engine_pool_status(request: web.Request) -> web.Response:

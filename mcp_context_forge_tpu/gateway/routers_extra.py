@@ -194,12 +194,38 @@ def setup_extra_routes(app: web.Application) -> None:
             limit = int(request.query.get("limit", "32"))
         except ValueError as exc:
             raise ValidationFailure("limit must be an integer") from exc
-        snapshot = recorder.snapshot(limit=max(1, min(limit, 1024)))
+        snapshot = recorder.snapshot(limit=max(1, min(limit, 1024)),
+                                     tenant=request.query.get("tenant"))
         sampler = request.app.get("loop_lag_sampler")
         snapshot["loop"] = sampler.snapshot() if sampler is not None else None
         from .flight_recorder import queue_state
         snapshot["backpressure"] = queue_state(request.app)
         return web.json_response(snapshot)
+
+    @routes.get("/admin/tenants/usage")
+    async def tenant_usage(request: web.Request) -> web.Response:
+        """Per-tenant usage metering (observability/metering.py): the
+        live ledger (prompt/generated/cache-hit tokens, KV-page-seconds,
+        current quota window) plus recent rows from the tenant_usage
+        rollup table — the accounting plane ROADMAP item 5's distributed
+        rate limiter consumes. Read-only."""
+        request["auth"].require("observability.read")
+        ledger = request.app.get("tenant_ledger")
+        if ledger is None:
+            raise NotFoundError(
+                "tenant metering is disabled "
+                "(set MCPFORGE_TENANT_METERING_ENABLED=true)")
+        try:
+            limit = int(request.query.get("limit", "64"))
+        except ValueError as exc:
+            raise ValidationFailure("limit must be an integer") from exc
+        payload = ledger.snapshot(limit=max(1, min(limit, 1024)))
+        rollup = request.app.get("tenant_usage_rollup")
+        payload["rollups"] = (await rollup.recent(limit=min(limit * 2, 200))
+                              if rollup is not None else [])
+        payload["rollup_interval_s"] = (rollup.interval_s
+                                        if rollup is not None else None)
+        return web.json_response(payload)
 
     @routes.get("/admin/engine/profile/status")
     async def profile_status(request: web.Request) -> web.Response:

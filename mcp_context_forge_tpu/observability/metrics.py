@@ -12,13 +12,23 @@ from prometheus_client import (
 )
 
 from .. import __version__
+from .tenant import TenantClamp
 
 
 class PrometheusRegistry:
-    """Gateway-wide Prometheus metrics, own registry (hermetic for tests)."""
+    """Gateway-wide Prometheus metrics, own registry (hermetic for tests).
 
-    def __init__(self) -> None:
+    ``tenant_clamp`` bounds every ``tenant`` label in this registry:
+    first-N-observed tenants keep their own label child, the rest clamp
+    to ``"other"``, so per-tenant slicing can never explode series
+    cardinality (docs/multitenancy.md). The app replaces the default
+    clamp with one sized by ``tenant_label_clamp`` and shares the SAME
+    instance with the :class:`~.metering.TenantLedger` so metric labels
+    and ledger admission agree."""
+
+    def __init__(self, tenant_clamp: TenantClamp | None = None) -> None:
         self.registry = CollectorRegistry()
+        self.tenant_clamp = tenant_clamp or TenantClamp()
         self.app_info = Gauge(  # lint: allow[dead-metric] fully populated at registration
             "mcpforge_app_info", "Application info", ["version"], registry=self.registry
         )
@@ -27,9 +37,11 @@ class PrometheusRegistry:
             "mcpforge_http_requests_total", "HTTP requests",
             ["method", "path", "status"], registry=self.registry,
         )
+        # tenant label (clamped): the per-tenant http_p95 SLO-class
+        # objective slices this histogram by label child
         self.http_duration = Histogram(
             "mcpforge_http_request_duration_seconds", "HTTP request latency",
-            ["method", "path"], registry=self.registry,
+            ["method", "path", "tenant"], registry=self.registry,
             buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
         )
         self.tool_invocations = Counter(
@@ -83,22 +95,26 @@ class PrometheusRegistry:
         # inter-token latency over the decode phase of one request.
         # The replica label separates a degraded replica's tail from the
         # pool aggregate (sum across label children for the fleet view).
+        # the tenant label (clamped to top-N + "other" by tenant_clamp)
+        # turns these into the per-tenant SLO-class evidence /admin/slo
+        # evaluates — a noisy neighbor's tail separates from the fleet's
         self.llm_ttft = Histogram(
             "mcpforge_llm_ttft_seconds", "Time to first token",
-            ["model", "replica"], registry=self.registry,
+            ["model", "replica", "tenant"], registry=self.registry,
             buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
                      10.0, 30.0),
         )
         self.llm_tpot = Histogram(
             "mcpforge_llm_tpot_seconds",
             "Per-token decode latency (mean over one request)",
-            ["model", "replica"], registry=self.registry,
+            ["model", "replica", "tenant"], registry=self.registry,
             buckets=(0.002, 0.005, 0.01, 0.02, 0.04, 0.08, 0.15, 0.3,
                      0.6, 1.2, 2.5),
         )
         self.llm_queue_wait = Histogram(
             "mcpforge_llm_queue_wait_seconds",
-            "Submit -> batch admission wait", registry=self.registry,
+            "Submit -> batch admission wait", ["tenant"],
+            registry=self.registry,
             buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0,
                      60.0),
         )
@@ -241,7 +257,7 @@ class PrometheusRegistry:
             "Gateway request wall time attributed to a phase "
             "(edge, auth, plugins, routing, db, engine, serialize, "
             "handler, error)",
-            ["route", "phase"], registry=self.registry,
+            ["route", "phase", "tenant"], registry=self.registry,
             buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                      0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
         )
@@ -274,6 +290,30 @@ class PrometheusRegistry:
             "Engine admission-queue saturation the gateway last surfaced "
             "to clients (queued work / admission capacity, 0..1)",
             registry=self.registry,
+        )
+        # --- per-tenant usage metering (observability/metering.py,
+        # docs/multitenancy.md) --- exported views of the TenantLedger;
+        # every tenant label below rides the registry's clamp, so the
+        # child set is bounded at tenant_label_clamp + 1 ("other")
+        self.llm_tenant_tokens = Counter(
+            "mcpforge_llm_tenant_tokens_total",
+            "Tokens accounted to a tenant by the metering ledger "
+            "(kind: prompt|generated|cache_hit; cache_hit = prefill "
+            "tokens served from shared prefix-cache pages)",
+            ["tenant", "kind"], registry=self.registry,
+        )
+        self.llm_tenant_kv_page_seconds = Counter(
+            "mcpforge_llm_tenant_kv_page_seconds_total",
+            "KV-page-seconds of HBM residency accounted to a tenant "
+            "(pages held x seconds resident, summed at request retire)",
+            ["tenant"], registry=self.registry,
+        )
+        self.gw_tenant_quota_used_ratio = Gauge(
+            "mcpforge_gw_tenant_quota_used_ratio",
+            "Fraction of the per-tenant token quota consumed in the "
+            "current rollup window (0 when no quota is configured) — "
+            "the admission signal the distributed rate limiter reads",
+            ["tenant"], registry=self.registry,
         )
         self.sessions_active = Gauge(
             "mcpforge_sessions_active", "Active MCP sessions", registry=self.registry,

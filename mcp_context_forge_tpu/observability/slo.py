@@ -38,6 +38,27 @@ class SloObjective:
     target_ms: float
 
 
+@dataclass(frozen=True)
+class SloClass:
+    """A named bundle of latency targets assignable per tenant
+    (``slo_class_<name>_{ttft,tpot,http}_p95_ms`` in docs/config terms).
+    ``/admin/slo?tenant=<t>`` evaluates the tenant's assigned class
+    against that tenant's metric label slice."""
+
+    name: str
+    ttft_p95_ms: float
+    tpot_p95_ms: float
+    http_p95_ms: float
+
+    def objectives(self) -> list[SloObjective]:
+        return [
+            SloObjective("ttft_p95", "llm_ttft", 0.95, self.ttft_p95_ms),
+            SloObjective("tpot_p95", "llm_tpot", 0.95, self.tpot_p95_ms),
+            SloObjective("http_p95", "http_duration", 0.95,
+                         self.http_p95_ms),
+        ]
+
+
 def default_objectives(settings: Any) -> list[SloObjective]:
     return [
         SloObjective("ttft_p95", "llm_ttft", 0.95,
@@ -54,13 +75,72 @@ def default_objectives(settings: Any) -> list[SloObjective]:
     ]
 
 
-def _histogram_state(metric: Any) -> tuple[dict[float, float], float]:
+def parse_slo_classes(settings: Any) -> dict[str, SloClass]:
+    """SLO-class bundles from settings: the ``default`` class comes from
+    the flat ``slo_*_p95_ms`` targets; ``slo_classes`` (JSON object:
+    ``{"premium": {"ttft_p95_ms": 500, ...}}``) adds named bundles whose
+    unset fields inherit the defaults. Malformed JSON fails fast at app
+    build — a silently-dropped SLO class is a false all-clear."""
+    import json
+
+    default = SloClass(
+        "default",
+        ttft_p95_ms=float(settings.slo_ttft_p95_ms),
+        tpot_p95_ms=float(settings.slo_tpot_p95_ms),
+        http_p95_ms=float(getattr(settings, "slo_http_p95_ms", 1000.0)))
+    classes = {"default": default}
+    raw = getattr(settings, "slo_classes", "") or ""
+    if raw:
+        try:
+            parsed = json.loads(raw)
+            if not isinstance(parsed, dict):
+                raise ValueError("must be a JSON object")
+            for name, targets in parsed.items():
+                if not isinstance(targets, dict):
+                    raise ValueError(f"class {name!r} must map to an object")
+                classes[name] = SloClass(
+                    name,
+                    ttft_p95_ms=float(targets.get("ttft_p95_ms",
+                                                  default.ttft_p95_ms)),
+                    tpot_p95_ms=float(targets.get("tpot_p95_ms",
+                                                  default.tpot_p95_ms)),
+                    http_p95_ms=float(targets.get("http_p95_ms",
+                                                  default.http_p95_ms)))
+        except (json.JSONDecodeError, TypeError, ValueError) as exc:
+            raise ValueError(f"invalid slo_classes setting: {exc}") from exc
+    return classes
+
+
+def parse_tenant_classes(settings: Any) -> dict[str, str]:
+    """``slo_tenant_classes`` JSON object: tenant id → class name."""
+    import json
+
+    raw = getattr(settings, "slo_tenant_classes", "") or ""
+    if not raw:
+        return {}
+    try:
+        parsed = json.loads(raw)
+        if not isinstance(parsed, dict):
+            raise ValueError("must be a JSON object")
+        return {str(k): str(v) for k, v in parsed.items()}
+    except (json.JSONDecodeError, TypeError, ValueError) as exc:
+        raise ValueError(
+            f"invalid slo_tenant_classes setting: {exc}") from exc
+
+
+def _histogram_state(metric: Any, match: dict[str, str] | None = None
+                     ) -> tuple[dict[float, float], float]:
     """(cumulative bucket counts summed across label children, total
-    count) for a prometheus_client Histogram."""
+    count) for a prometheus_client Histogram. ``match`` restricts the
+    sum to children whose labels carry every given key=value — the
+    tenant-sliced evaluation path."""
     buckets: dict[float, float] = {}
     count = 0.0
     for family in metric.collect():
         for sample in family.samples:
+            if match and any(sample.labels.get(k) != v
+                             for k, v in match.items()):
+                continue
             if sample.name.endswith("_bucket"):
                 le_raw = sample.labels.get("le", "+Inf")
                 le = math.inf if le_raw == "+Inf" else float(le_raw)
@@ -156,39 +236,96 @@ class SloEvaluator:
     the interval since the previous call BY THE SAME CONSUMER: windows
     are keyed by a caller-supplied name, so the admin UI's 5 s poll
     cannot shred the load harness's phase-length deltas (each consumer's
-    snapshot advances only on its own calls)."""
+    snapshot advances only on its own calls).
+
+    **Tenant-sliced evaluation**: ``evaluate(tenant=...)`` resolves the
+    tenant's assigned :class:`SloClass` (``slo_tenant_classes`` →
+    ``slo_classes``, else ``default``) and evaluates its target bundle
+    against only the metric label children carrying that tenant's
+    (clamped) label. Tenant windows are isolated per (consumer, tenant)
+    — polling tenant A never shreds tenant B's deltas.
+
+    **Window freshness**: a consumer's FIRST sight of an objective (a
+    genuinely new consumer, or one that staled out of the bounded table
+    and re-appeared) records a snapshot and reports an EMPTY window —
+    never the whole metric lifetime dressed up as a window. A re-
+    appearing tenant window must start fresh, not inherit the stale
+    implicit from-boot baseline (burn rate falls back to lifetime data,
+    labeled as such by window_samples == 0)."""
 
     MAX_CONSUMERS = 16  # /admin/slo is auth-gated, but still bound it
 
     def __init__(self, metrics: Any, objectives: list[SloObjective],
-                 error_budget: float = 0.05) -> None:
+                 error_budget: float = 0.05,
+                 slo_classes: dict[str, SloClass] | None = None,
+                 tenant_classes: dict[str, str] | None = None,
+                 tenant_label: Any = None) -> None:
         self.metrics = metrics
         self.objectives = objectives
         self.error_budget = max(1e-6, float(error_budget))
+        # named target bundles + tenant → class assignment (per-tenant
+        # evaluation path); tenant_label maps a tenant id to its clamped
+        # metric label WITHOUT consuming a clamp admission slot
+        self.slo_classes = slo_classes or {}
+        self.tenant_classes = tenant_classes or {}
+        self.tenant_label = tenant_label or (lambda t: t)
         # consumer -> objective -> (buckets, count); consumer -> last ts
         self._prev: dict[str, dict[str, tuple[dict[float, float], float]]] = {}
         self._prev_ts: dict[str, float] = {}
 
-    def evaluate(self, consumer: str = "default") -> dict[str, Any]:
+    def class_for(self, tenant: str) -> SloClass:
+        name = self.tenant_classes.get(tenant, "default")
+        cls = self.slo_classes.get(name)
+        if cls is None:
+            cls = self.slo_classes.get("default")
+        if cls is None:  # evaluator built without classes: derive one
+            targets = {o.name: o.target_ms for o in self.objectives}
+            cls = SloClass("default",
+                           ttft_p95_ms=targets.get("ttft_p95", 2500.0),
+                           tpot_p95_ms=targets.get("tpot_p95", 250.0),
+                           http_p95_ms=targets.get("http_p95", 1000.0))
+        return cls
+
+    def evaluate(self, consumer: str = "default",
+                 tenant: str | None = None) -> dict[str, Any]:
         now = time.time()
-        if consumer not in self._prev and len(
+        slo_class = None
+        match = None
+        objectives = self.objectives
+        key = consumer
+        if tenant is not None:
+            slo_class = self.class_for(tenant)
+            label = self.tenant_label(tenant)
+            match = {"tenant": label}
+            objectives = slo_class.objectives()
+            # per-(consumer, tenant) window isolation; \x1f cannot occur
+            # in either part (consumer is query-string-trimmed)
+            key = f"{consumer}\x1ftenant={label}"
+        if key not in self._prev and len(
                 self._prev) >= self.MAX_CONSUMERS:
             # evict the staled-out consumer rather than grow unbounded
             oldest = min(self._prev_ts, key=self._prev_ts.get)
             self._prev.pop(oldest, None)
             self._prev_ts.pop(oldest, None)
-        prev = self._prev.setdefault(consumer, {})
-        prev_ts = self._prev_ts.get(consumer)
+        prev = self._prev.setdefault(key, {})
+        prev_ts = self._prev_ts.get(key)
         window_s = (now - prev_ts) if prev_ts is not None else None
         results: list[dict[str, Any]] = []
         overall_ok = True
-        for obj in self.objectives:
+        for obj in objectives:
             metric = getattr(self.metrics, obj.metric_attr, None)
             if metric is None:
                 continue
-            buckets, count = _histogram_state(metric)
-            win_buckets, win_count = _delta(buckets, count,
-                                            prev.get(obj.name))
+            buckets, count = _histogram_state(metric, match)
+            prior = prev.get(obj.name)
+            if prior is None:
+                # first sight (fresh consumer OR post-eviction return):
+                # snapshot now, report an EMPTY window — the from-boot
+                # totals are not this window's data
+                win_buckets: dict[float, float] = {}
+                win_count = 0.0
+            else:
+                win_buckets, win_count = _delta(buckets, count, prior)
             prev[obj.name] = (buckets, count)
             threshold_s = obj.target_ms / 1e3
             cum_p = _percentile_s(buckets, count, obj.percentile)
@@ -220,8 +357,8 @@ class SloEvaluator:
                 "burn_rate": round(burn_rate, 4),
                 "ok": ok,
             })
-        self._prev_ts[consumer] = now
-        return {
+        self._prev_ts[key] = now
+        report = {
             "ok": overall_ok,
             "error_budget": self.error_budget,
             "consumer": consumer,
@@ -229,3 +366,11 @@ class SloEvaluator:
             "evaluated_at": now,
             "objectives": results,
         }
+        if tenant is not None:
+            report["tenant"] = tenant
+            report["tenant_label"] = match["tenant"]
+            # a clamped tenant's slice is the shared "other" bucket —
+            # verdicts cover the overflow POOL, not this tenant alone
+            report["tenant_clamped"] = match["tenant"] != tenant
+            report["slo_class"] = slo_class.name
+        return report

@@ -1,0 +1,139 @@
+"""Tenant identity resolution + request-scoped propagation.
+
+The gateway's whole point is multitenant federation (teams, RBAC,
+per-consumer API keys), yet until this module every telemetry surface —
+flight-recorder rows, TTFT/TPOT histograms, the engine step ring — was
+tenant-blind: it could say *where* a millisecond went but not *whose* it
+was. This module is the identity seam everything tenant-sliced hangs
+off:
+
+- :func:`resolve_tenant` — one documented resolution order from the auth
+  middleware's resolved principal (``AuthContext``): **team → API key →
+  user**, with ``anonymous`` for unauthenticated surfaces. The first
+  team a principal belongs to is its billing tenant (personal teams make
+  this the user's own bucket); a team-less API token bills to the token;
+  a bare user bills to the user. Prefixes (``team:`` / ``key:`` /
+  ``user:``) keep the namespaces collision-free.
+- a contextvar carrying the resolved tenant through the request's async
+  call tree, so the LLM provider can stamp it onto the engine-facing
+  ``GenRequest`` without the OpenAI wire shapes growing a tenant field
+  (same pattern as :mod:`.phases`). Work submitted outside an HTTP
+  request (plugin summarizers, warmup) has no tenant and accounts under
+  :data:`UNATTRIBUTED`.
+- :class:`TenantClamp` — the bounded-cardinality label mapper: the first
+  ``max_tenants`` distinct tenants observed get their own Prometheus
+  label; every later tenant maps to ``"other"``. The exported label set
+  therefore never exceeds ``max_tenants + 1`` children no matter how
+  many principals hit the gateway — tenant labels cannot explode a
+  histogram's cardinality. (Operators size the clamp above their
+  expected tenant count; the ledger in :mod:`.metering` keeps exact
+  per-tenant rows regardless of the clamp.)
+
+Everything here is import-light (no jax, no aiohttp) so the engine,
+middleware, and bench tooling can all share it.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+from typing import Any
+
+# accounting bucket for engine work with no resolved tenant (direct
+# engine submissions, plugin-internal chat, warmup traffic)
+UNATTRIBUTED = "unattributed"
+# clamp overflow label: the N+1'th distinct tenant and every one after
+OTHER = "other"
+# unauthenticated surfaces (public paths, auth_required=false)
+ANONYMOUS = "anonymous"
+
+_current_tenant: contextvars.ContextVar[str | None] = \
+    contextvars.ContextVar("mcpforge_tenant", default=None)
+
+
+def resolve_tenant(auth: Any) -> str:
+    """Map a resolved principal to its billing tenant.
+
+    Resolution order (docs/multitenancy.md): the principal's first team,
+    else its API-key jti, else the user itself; no principal (or an
+    anonymous one) is ``anonymous``. Deliberately prefix-namespaced so a
+    team named like an email can never collide with a user tenant.
+
+    "First team" is the lexicographically SMALLEST team id: the
+    team_members query carries no ORDER BY, so list position is
+    backend/row-order dependent — an order-sensitive pick would split
+    one multi-team principal's usage across tenant rows whenever the
+    auth cache refreshed in a different order.
+    """
+    if auth is None or getattr(auth, "via", "anonymous") == "anonymous":
+        return ANONYMOUS
+    teams = getattr(auth, "teams", None)
+    if teams:
+        return f"team:{min(teams)}"
+    jti = getattr(auth, "token_jti", None)
+    if jti:
+        return f"key:{jti}"
+    return f"user:{getattr(auth, 'user', '') or ANONYMOUS}"
+
+
+def current_tenant() -> str | None:
+    """The request's resolved tenant, or None outside an instrumented
+    request (callers treat None as unattributed work)."""
+    return _current_tenant.get()
+
+
+def set_current_tenant(tenant: str | None) -> contextvars.Token:
+    return _current_tenant.set(tenant)
+
+
+def reset_current_tenant(token: contextvars.Token) -> None:
+    try:
+        _current_tenant.reset(token)
+    except ValueError:  # foreign-context reset (generator teardown)
+        pass
+
+
+class TenantClamp:
+    """First-N-observed tenant → Prometheus-label mapper.
+
+    ``label()`` admits a tenant while fewer than ``max_tenants`` are
+    tracked and returns :data:`OTHER` for everyone after — the exported
+    label set is bounded at ``max_tenants + 1`` by construction, and a
+    tenant's label never changes once admitted (a strict running top-N
+    would RENAME label children as rankings shift, churning series).
+    ``peek()`` is the read-only twin for query paths (/admin/slo must
+    not let a probe of an unknown tenant consume an admission slot).
+
+    Thread-safe: the engine dispatch thread labels at retire time while
+    the gateway loop labels HTTP observations.
+    """
+
+    def __init__(self, max_tenants: int = 8) -> None:
+        self.max_tenants = max(1, int(max_tenants))
+        self._admitted: set[str] = set()
+        self._lock = threading.Lock()
+
+    def label(self, tenant: str) -> str:
+        tenant = tenant or UNATTRIBUTED
+        with self._lock:
+            if tenant in self._admitted:
+                return tenant
+            if len(self._admitted) < self.max_tenants:
+                self._admitted.add(tenant)
+                return tenant
+        return OTHER
+
+    def peek(self, tenant: str) -> str:
+        """``label()`` without admission — unknown tenants read as
+        :data:`OTHER` instead of consuming a clamp slot."""
+        tenant = tenant or UNATTRIBUTED
+        with self._lock:
+            return tenant if tenant in self._admitted else OTHER
+
+    def admitted(self) -> list[str]:
+        with self._lock:
+            return sorted(self._admitted)
+
+    def snapshot(self) -> dict[str, Any]:
+        return {"max_tenants": self.max_tenants,
+                "admitted": self.admitted()}

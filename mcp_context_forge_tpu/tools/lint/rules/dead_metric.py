@@ -1,4 +1,4 @@
-"""Rule: registered Prometheus metrics nothing outside observability/ feeds.
+"""Rule: registered Prometheus metrics nothing outside the registry feeds.
 
 A metric registered on ``PrometheusRegistry`` that no product code ever
 touches silently reads as 0 forever — dashboard noise that looks like
@@ -8,10 +8,13 @@ drifted dead before the telemetry PR). Promoted from
 a thin wrapper over this rule, so the check has one implementation.
 
 Purely static: the registry file is parsed for ``self.NAME = Counter/
-Gauge/Histogram(...)`` assignments, and every other linted file is
-searched for ``.NAME`` references. Metrics legitimately complete at
-registration time (``app_info``) carry ``# lint: allow[dead-metric]`` on
-their registration line.
+Gauge/Histogram(...)`` assignments, and every OTHER linted file —
+including observability/ siblings such as the tenant metering ledger,
+which is a real producer, not registration-side code — is searched for
+``.NAME`` references. Only the registry module itself is excluded (a
+metric referenced nowhere but its own registration is dead). Metrics
+legitimately complete at registration time (``app_info``) carry
+``# lint: allow[dead-metric]`` on their registration line.
 """
 
 from __future__ import annotations
@@ -30,7 +33,7 @@ REGISTRY_CLASS = "PrometheusRegistry"
 class DeadMetricRule(Rule):
     rule_id = "dead-metric"
     description = ("metric registered on PrometheusRegistry but never "
-                   "referenced outside observability/")
+                   "referenced outside the registry module")
 
     def check_project(self, contexts: list[FileContext]) -> Iterator[Finding]:
         registry_ctx = None
@@ -61,14 +64,14 @@ class DeadMetricRule(Rule):
                     metrics[target.attr] = node.lineno
 
         blob = "\n".join(ctx.source for ctx in contexts
-                         if "observability" not in ctx.path.split("/"))
+                         if ctx.path != registry_ctx.path)
         findings: list[Finding] = []
         for name, lineno in sorted(metrics.items()):
             if f".{name}" not in blob:
                 findings.append(Finding(
                     self.rule_id, registry_ctx.path, lineno,
                     f"metric {name} is registered but never referenced "
-                    f"outside observability/ — wire it up, remove it, or "
-                    f"allow[dead-metric] it if fully populated at "
+                    f"outside the registry module — wire it up, remove "
+                    f"it, or allow[dead-metric] it if fully populated at "
                     f"registration"))
         return iter(findings)

@@ -38,6 +38,35 @@ from typing import Any, Awaitable, Callable, Sequence
 RequestFn = Callable[[Any, Any, int], Awaitable[tuple[bool, str]]]
 
 
+# ---------------------------------------------------------------- tenant mix
+
+def weighted_schedule(items: Sequence[tuple[Any, int]]
+                      ) -> Callable[[int], Any]:
+    """Deterministic skewed interleave over ``(value, weight)`` pairs:
+    returns ``pick(i)`` mapping request index -> value with exact
+    weight proportions over each period of ``sum(weights)`` requests.
+
+    Smooth weighted round-robin (the nginx algorithm) precomputed into a
+    period schedule, so a tenant-mix scenario gets the same a,a,b,a,c...
+    interleave on every run — reproducible per-tenant SLO windows — and
+    heavy tenants spread through the period instead of batching up
+    front. Weights are integers (give 5:2:1, not 0.5:0.2:0.1)."""
+    pairs = [(value, int(weight)) for value, weight in items if weight > 0]
+    if not pairs:
+        raise ValueError("weighted_schedule needs at least one "
+                         "positive-weight item")
+    total = sum(weight for _, weight in pairs)
+    current = [0] * len(pairs)
+    schedule = []
+    for _ in range(total):
+        for j, (_, weight) in enumerate(pairs):
+            current[j] += weight
+        best = max(range(len(pairs)), key=lambda j: current[j])
+        current[best] -= total
+        schedule.append(pairs[best][0])
+    return lambda i: schedule[i % total]
+
+
 # --------------------------------------------------------------- request kinds
 
 def chat_kind(model: str, max_tokens: int = 8,
@@ -119,11 +148,14 @@ async def run_phase(client, auth, kinds: Sequence[RequestFn], *,
     """Closed-loop phase: ``concurrency`` workers drain ``requests``
     total, each request round-robining across ``kinds`` (deterministic
     mix — a mixed-traffic scenario interleaves chat/tools/A2A instead of
-    batching by kind)."""
+    batching by kind). ``auth`` may be a CALLABLE ``auth_for(i)`` — the
+    per-tenant mix hook: pass ``weighted_schedule([(auth_a, 5), ...])``
+    to drive N principals with skewed weights through one phase."""
     result = PhaseResult(name=name, concurrency=concurrency)
     # plain iterator, no lock: workers share one event loop and next()
     # has no await point, so draws cannot interleave
     counter = iter(range(requests))
+    auth_for = auth if callable(auth) else (lambda _i: auth)
 
     async def worker() -> None:
         while True:
@@ -133,7 +165,7 @@ async def run_phase(client, auth, kinds: Sequence[RequestFn], *,
             kind = kinds[i % len(kinds)]
             started = time.monotonic()
             try:
-                ok, tag = await kind(client, auth, i)
+                ok, tag = await kind(client, auth_for(i), i)
             except Exception as exc:
                 ok, tag = False, type(exc).__name__
             result.latencies_ms.append((time.monotonic() - started) * 1e3)
@@ -175,16 +207,26 @@ class SloWindow:
     The evaluator keys delta state per consumer (``?window=<name>``), so
     a scenario's phase-length window cannot be shredded by the admin
     UI's 5 s poll — ``open()`` advances this consumer's snapshot to
-    "now", ``close()`` reads the verdicts accumulated since."""
+    "now", ``close()`` reads the verdicts accumulated since.
 
-    def __init__(self, client, name: str, auth) -> None:
+    ``tenant`` scopes the window to one tenant's SLO CLASS evaluated
+    over that tenant's metric label slice (``?tenant=``); tenant windows
+    isolate per (window, tenant), so a mix scenario opens one SloWindow
+    per tenant and closes them independently."""
+
+    def __init__(self, client, name: str, auth,
+                 tenant: str | None = None) -> None:
         self.client = client
         self.name = name
         self.auth = auth
+        self.tenant = tenant
 
     async def _evaluate(self) -> dict[str, Any]:
-        resp = await self.client.get(f"/admin/slo?window={self.name}",
-                                     auth=self.auth)
+        url = f"/admin/slo?window={self.name}"
+        if self.tenant:
+            from urllib.parse import quote
+            url += f"&tenant={quote(self.tenant)}"
+        resp = await self.client.get(url, auth=self.auth)
         if resp.status != 200:
             raise RuntimeError(
                 f"/admin/slo -> {resp.status}: {await resp.text()}")
@@ -199,6 +241,10 @@ class SloWindow:
             "ok": report["ok"],
             "window_s": report["window_s"],
             "error_budget": report["error_budget"],
+            **({"tenant": report.get("tenant"),
+                "slo_class": report.get("slo_class"),
+                "tenant_clamped": report.get("tenant_clamped")}
+               if self.tenant else {}),
             "objectives": {
                 o["name"]: {
                     "ok": o["ok"],

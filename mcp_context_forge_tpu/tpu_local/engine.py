@@ -271,6 +271,12 @@ class GenRequest:
     bucket: int = -1
     chunked: bool = False
     chunk_pos: int = 0   # tokens prefilled so far (chunk-round scheduler)
+    # billing identity (observability/tenant.py resolution order:
+    # team → API key → user; "" = unattributed internal work). Rides
+    # into the engine so retire-time accounting lands in the tenant
+    # ledger, survives pool failover (shadows copy it), and labels the
+    # TTFT/TPOT/queue-wait histograms (clamped)
+    tenant: str = ""
     # telemetry: (trace_id, span_id) of the submitter's llm.request span —
     # the dispatch thread parents llm.queue/prefill/decode spans to it
     trace_ctx: tuple[str, str] | None = None
@@ -408,11 +414,16 @@ class TPUEngine:
     _STOP_TBL_WIDTH = 4
 
     def __init__(self, config: EngineConfig, tracer=None, metrics=None,
-                 devices: list | None = None):
+                 devices: list | None = None, ledger=None):
         # telemetry handles are optional: None means zero-cost no-ops, so
         # unit tests and benches constructing engines directly pay nothing
         self.tracer = tracer
         self.metrics = metrics
+        # per-tenant usage ledger (observability/metering.py): fed at the
+        # SAME sites as the untagged stats counters so per-tenant sums
+        # conserve exactly against stats.prompt_tokens /
+        # completion_tokens / allocator.prefix_hit_tokens
+        self.ledger = ledger
         self.step_log: deque[dict[str, Any]] = deque(
             maxlen=max(1, config.step_log_size))
         self._step_seq = 0
@@ -1262,6 +1273,11 @@ class TPUEngine:
         self._check_alive()
         self.stats.requests += 1
         self.stats.prompt_tokens += len(request.prompt_ids)
+        if self.ledger is not None:
+            # same site as stats.prompt_tokens — the per-tenant slices
+            # must sum to the untagged total (conservation gate)
+            self.ledger.add(request.tenant, requests=1,
+                            prompt_tokens=len(request.prompt_ids))
         while True:
             try:
                 self._work.put_nowait(request)
@@ -1723,6 +1739,14 @@ class TPUEngine:
                 request.bucket = -1
                 self._pending.appendleft(request)
                 continue
+            if shared and self.ledger is not None:
+                # discounted prefill: these tokens were served from shared
+                # prefix-cache pages. Same site semantics as the
+                # allocator's prefix_hit_tokens (counted when the match is
+                # CONSUMED by a successful allocate), so the per-tenant
+                # slices conserve against it exactly
+                self.ledger.add(request.tenant, cache_hit_tokens=(
+                    len(shared) * self.allocator.page_size))
             request.slot = slot
             request.queue_ms = (time.time() - request.created) * 1000
             self._observe_admitted(request)
@@ -2634,6 +2658,8 @@ class TPUEngine:
             "llm.replica_id": self.config.replica_id,
             "llm.slot": request.slot,
         }
+        if request.tenant:
+            attributes["llm.tenant"] = request.tenant
         attributes.update(attrs)
         try:
             self.tracer.emit_span(name, start_ts, end_ts,
@@ -2643,13 +2669,19 @@ class TPUEngine:
         except Exception:
             pass  # telemetry must never kill the dispatch thread
 
+    def _tenant_label(self, request: GenRequest) -> str:
+        """Clamped Prometheus tenant label for a request (the registry's
+        shared TenantClamp bounds the exported child set)."""
+        return self.metrics.tenant_clamp.label(request.tenant)
+
     def _observe_admitted(self, request: GenRequest) -> None:
         """Queue-phase telemetry at the moment a request wins a slot."""
         if request.queue_observed:
             return  # re-admission after crash recovery
         request.queue_observed = True
         if self.metrics is not None:
-            self.metrics.llm_queue_wait.observe(
+            self.metrics.llm_queue_wait.labels(
+                tenant=self._tenant_label(request)).observe(
                 max(0.0, request.queue_ms / 1e3))
         self._span("llm.queue", request, request.created, time.time(),
                    **{"llm.queue_ms": round(request.queue_ms, 2),
@@ -2664,8 +2696,17 @@ class TPUEngine:
         if self.metrics is not None and n > 1:
             self.metrics.llm_tpot.labels(
                 model=self.config.model,
-                replica=self.config.replica_id).observe(
+                replica=self.config.replica_id,
+                tenant=self._tenant_label(request)).observe(
                 max(0.0, (now - decode_start) / (n - 1)))
+        if self.ledger is not None and request.slot >= 0:
+            # HBM residency: pages this request held x its resident wall
+            # (admission -> retire; pages are still held here — the
+            # callers free the slot AFTER _observe_finish)
+            admitted_ts = request.created + request.queue_ms / 1e3
+            self.ledger.add(request.tenant, kv_page_seconds=(
+                self.allocator.slot_pages(request.slot)
+                * max(0.0, now - admitted_ts)))
         reason = request.finish_reason or "stop"
         # sampled phase rows that landed during this request's decode
         # phase ride along as span events — the trace-side view of the
@@ -2699,6 +2740,11 @@ class TPUEngine:
     def _emit(self, request: GenRequest, token: int) -> None:
         request.generated.append(token)
         self.stats.completion_tokens += 1
+        if self.ledger is not None:
+            # same site as stats.completion_tokens (conservation gate);
+            # counting at retire rather than finish means a failover
+            # never loses a killed replica's already-emitted tokens
+            self.ledger.add(request.tenant, generated_tokens=1)
         if request.first_token_ts == 0.0:
             request.first_token_ts = time.time()
             if not request.ttft_observed:
@@ -2706,7 +2752,8 @@ class TPUEngine:
                 if self.metrics is not None:
                     self.metrics.llm_ttft.labels(
                         model=self.config.model,
-                        replica=self.config.replica_id).observe(
+                        replica=self.config.replica_id,
+                        tenant=self._tenant_label(request)).observe(
                         max(0.0, request.first_token_ts - request.created))
                 self._span("llm.prefill", request, request.created
                            + request.queue_ms / 1e3, request.first_token_ts,

@@ -151,16 +151,22 @@ class EnginePool:
                  heartbeat_timeout_s: float = 10.0,
                  requeue_max: int = 2,
                  devices: list | None = None,
-                 engine_factory: Callable[..., TPUEngine] | None = None):
+                 engine_factory: Callable[..., TPUEngine] | None = None,
+                 ledger=None):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         self.config = config
         self.tracer = tracer
         self.metrics = metrics
+        # one tenant ledger shared by every replica (and every rebuilt
+        # engine a reload produces): per-tenant token accounting must
+        # survive failover and hot-swap with nothing lost or double-billed
+        self.ledger = ledger
         self.requeue_max = max(0, requeue_max)
         self._factory = engine_factory or (
-            lambda cfg, tracer, metrics, devices: TPUEngine(
-                cfg, tracer=tracer, metrics=metrics, devices=devices))
+            lambda cfg, tracer, metrics, devices, ledger=None: TPUEngine(
+                cfg, tracer=tracer, metrics=metrics, devices=devices,
+                ledger=ledger))
         if devices is None:
             devices = probe_devices(config.init_timeout_s)
         self._device_sets = partition_devices(devices, replicas)
@@ -198,7 +204,7 @@ class EnginePool:
         cfg = dataclasses.replace(self.config, replica_id=str(index),
                                   mesh_shape=self._mesh_shape)
         return self._factory(cfg, self.tracer, self.metrics,
-                             self._device_sets[index])
+                             self._device_sets[index], ledger=self.ledger)
 
     # --------------------------------------------------------------- lifecycle
 
@@ -335,6 +341,10 @@ class EnginePool:
             stop_ids=request.stop_ids,
             priority=request.priority,
             created=request.created,
+            # billing identity must ride EVERY shadow, including requeued
+            # continuations — a failover must not turn a tenant's tail
+            # tokens into unattributed work (token-conservation gate)
+            tenant=request.tenant,
             trace_ctx=request.trace_ctx,
             queue_observed=attempts > 1,
             # once-only TTFT/llm.prefill: if the failed attempt already

@@ -169,6 +169,10 @@ class TPULocalProvider(LLMProvider):
         # "batch" so interactive chat turns admit first under contention
         priority = {"interactive": 0, "batch": 1}.get(
             str(request.get("priority") or "interactive"), 0)
+        # billing identity from the request-scoped contextvar the auth
+        # middleware set (team → API key → user); engine-internal callers
+        # (plugins, warmup) have none and account as unattributed
+        from ..observability.tenant import current_tenant
         return GenRequest(
             request_id=new_id(),
             prompt_ids=prompt_ids,
@@ -177,6 +181,7 @@ class TPULocalProvider(LLMProvider):
             top_k=int(request.get("top_k") or 0),
             top_p=float(request.get("top_p") or 1.0),
             priority=priority,
+            tenant=current_tenant() or "",
         )
 
     def _request_span(self, request: dict[str, Any], gen: GenRequest):

@@ -1,0 +1,187 @@
+"""Per-tenant token conservation through the engine + replica pool.
+
+The metering plane's contract (ISSUE 10 acceptance), falsifiable:
+
+- summing any ledger column over ALL tenants equals the engine's
+  untagged totals — prompt tokens vs ``stats.prompt_tokens``, generated
+  vs ``stats.completion_tokens``, cache-hit vs the allocators'
+  ``prefix_hit_tokens`` — under concurrent mixed-tenant load and with
+  the cardinality clamp active ("other" in play);
+- tenant attribution RIDES the pool's shadow requests across a replica
+  kill: requeued continuations bill to the same tenant, and per-tenant
+  generated-token totals equal the tokens each tenant's clients actually
+  received (no lost billing, no double billing);
+- the exported Prometheus tenant label set never exceeds the configured
+  clamp + 1.
+"""
+
+import asyncio
+
+import pytest
+
+from mcp_context_forge_tpu.observability.metering import TenantLedger
+from mcp_context_forge_tpu.observability.metrics import PrometheusRegistry
+from mcp_context_forge_tpu.observability.tenant import TenantClamp
+from mcp_context_forge_tpu.tpu_local.engine import (EngineConfig, GenRequest,
+                                                    TPUEngine)
+from mcp_context_forge_tpu.tpu_local.pool import EnginePool
+
+from test_engine_pool import _poison_decode
+
+
+def _config(**overrides):
+    kwargs = dict(model="llama3-test", max_batch=4, max_seq_len=128,
+                  page_size=16, num_pages=64, prefill_buckets=(16, 64),
+                  dtype="float32", attn_impl="reference")
+    kwargs.update(overrides)
+    return EngineConfig(**kwargs)
+
+
+def _metered_pool(replicas=2, clamp_n=2, **overrides):
+    registry = PrometheusRegistry(tenant_clamp=TenantClamp(clamp_n))
+    ledger = TenantLedger(clamp=registry.tenant_clamp, metrics=registry)
+    pool = EnginePool(_config(**overrides), replicas=replicas,
+                      metrics=registry, health_interval_s=0.05,
+                      ledger=ledger)
+    return pool, ledger, registry
+
+
+async def _run_request(pool, prompt, tenant, max_tokens=16):
+    ids = pool.tokenizer.encode(prompt)
+    request = GenRequest(request_id=f"req-{tenant}-{abs(hash(prompt)) % 999}",
+                        prompt_ids=ids, max_tokens=max_tokens, tenant=tenant)
+    await pool.submit(request)
+    tokens = []
+    while True:
+        token = await request.stream.get()
+        if token is None:
+            break
+        tokens.append(token)
+    return request, tokens
+
+
+def _assert_conserved(pool, ledger):
+    """Ledger column sums == the pool's untagged engine totals."""
+    sums = ledger.column_sums()
+    stats = pool.stats
+    assert sums["prompt_tokens"] == stats.prompt_tokens, (sums, vars(stats))
+    assert sums["generated_tokens"] == stats.completion_tokens, (
+        sums, vars(stats))
+    hit_tokens = sum(r.engine.allocator.prefix_hit_tokens
+                     for r in pool.replicas)
+    assert sums["cache_hit_tokens"] == hit_tokens, (sums, hit_tokens)
+
+
+def _tenant_label_children(registry):
+    rendered = registry.render()[0].decode()
+    labels = set()
+    for line in rendered.splitlines():
+        if line.startswith("#") or 'tenant="' not in line:
+            continue
+        labels.add(line.split('tenant="')[1].split('"')[0])
+    return labels
+
+
+def test_mixed_tenant_conservation_with_clamp_and_prefix_hits():
+    """Concurrent 4-tenant load on a pool of 2 with a clamp of 2: two
+    tenants export as themselves, two clamp to "other", repeat prompts
+    produce real prefix-cache hits — and every column still conserves
+    exactly against the untagged engine totals."""
+    tenants = [f"team:t{i}" for i in range(4)]
+    shared = "conservation prompt with a long shared preamble " \
+             "that spans full pages easily"
+
+    async def main():
+        pool, ledger, registry = _metered_pool(replicas=2, clamp_n=2)
+        await pool.start()
+        try:
+            # wave 1: distinct prompts per tenant (cold prefill)
+            jobs = [(f"{shared} first {t}", t) for t in tenants]
+            # wave 2: EXACT repeats -> affinity routing + prefix hits
+            await asyncio.gather(*[
+                _run_request(pool, p, t, max_tokens=8) for p, t in jobs])
+            results = await asyncio.gather(*[
+                _run_request(pool, p, t, max_tokens=8) for p, t in jobs])
+        finally:
+            await pool.stop()
+        for request, tokens in results:
+            assert tokens and request.finish_reason in ("stop", "length")
+        _assert_conserved(pool, ledger)
+        sums = ledger.column_sums()
+        assert sums["cache_hit_tokens"] > 0   # the discount really fired
+        assert sums["kv_page_seconds"] > 0.0  # residency accounted
+        # exact rows exist per tenant even though labels clamp
+        assert set(ledger.totals()) == set(tenants)
+        labels = _tenant_label_children(registry)
+        assert len(labels) <= 2 + 1, labels    # clamp + "other"
+        assert "other" in labels
+
+    asyncio.run(main())
+
+
+def test_tenant_conservation_across_replica_kill():
+    """Chaos: replica 1 dies mid-decode with mixed-tenant work in
+    flight. Continuations resume on the survivor UNDER THE SAME TENANT:
+    column sums still equal the untagged totals (the killed replica's
+    partial emissions and the rebuilt continuation prompts are counted
+    identically on both sides), per-tenant generated tokens equal what
+    each tenant's clients received, and nothing lands unattributed."""
+    tenants = [f"team:t{i}" for i in range(3)]
+    prompts = {t: f"failover accounting prompt {t} with extra words"
+               for t in tenants}
+
+    async def main():
+        pool, ledger, registry = _metered_pool(replicas=2, clamp_n=8)
+        _poison_decode(pool.replicas[1].engine, explode_after=3)
+        await pool.start()
+        try:
+            results = await asyncio.gather(*[
+                _run_request(pool, prompts[t], t, max_tokens=24)
+                for t in tenants for _ in range(2)])
+        finally:
+            await pool.stop()
+        # zero lost streams, and the kill actually fired
+        assert all(tokens for _, tokens in results)
+        assert pool.requeues >= 1
+        assert pool.replicas[1].state == "dead"
+        _assert_conserved(pool, ledger)
+        # per-tenant: generated == delivered (no lost or double billing)
+        delivered: dict[str, int] = {}
+        for request, tokens in results:
+            assert request.finish_reason in ("stop", "length")
+            delivered[request.tenant] = (delivered.get(request.tenant, 0)
+                                         + len(tokens))
+        totals = ledger.totals()
+        for tenant in tenants:
+            assert totals[tenant]["generated_tokens"] == delivered[tenant], (
+                tenant, totals[tenant], delivered)
+        # requeued shadows carried the tenant — nothing unattributed
+        assert "unattributed" not in totals
+
+    asyncio.run(main())
+
+
+def test_single_engine_ledger_matches_stats_exactly():
+    """The narrowest form of the invariant: one engine, one tenant,
+    ledger == stats at every column site."""
+    ledger = TenantLedger()
+    engine = TPUEngine(_config(), ledger=ledger)
+
+    async def main():
+        await engine.start()
+        try:
+            ids = engine.tokenizer.encode("exact accounting prompt")
+            request = GenRequest(request_id="r1", prompt_ids=ids,
+                                 max_tokens=10, tenant="team:solo")
+            await engine.submit(request)
+            while (await request.stream.get()) is not None:
+                pass
+        finally:
+            await engine.stop()
+
+    asyncio.run(main())
+    totals = ledger.totals()["team:solo"]
+    assert totals["prompt_tokens"] == engine.stats.prompt_tokens
+    assert totals["generated_tokens"] == engine.stats.completion_tokens
+    assert totals["requests"] == engine.stats.requests == 1
+    assert totals["kv_page_seconds"] > 0.0

@@ -29,12 +29,12 @@ def scenario_env(monkeypatch, tmp_path):
 
 
 def test_scenarios_cpu_smoke(scenario_env, monkeypatch):
-    monkeypatch.setenv("BENCH_SCENARIO_ONLY", "burst,ramp,chaos")
+    monkeypatch.setenv("BENCH_SCENARIO_ONLY", "burst,ramp,tenant,chaos")
     import bench_gateway_scenarios as bgs
 
     report = asyncio.run(bgs.run_scenarios("cpu"))
     assert report["ok"], report["problems"]
-    assert set(report["scenarios"]) == {"burst", "ramp", "chaos"}
+    assert set(report["scenarios"]) == {"burst", "ramp", "tenant", "chaos"}
 
     for name, cap in report["scenarios"].items():
         # the bench_trend gate contract: self-describing metric + the
@@ -57,6 +57,28 @@ def test_scenarios_cpu_smoke(scenario_env, monkeypatch):
     ramp = report["scenarios"]["ramp"]
     assert [p["concurrency"] for p in ramp["phases"]] == [2, 4, 2]
 
+    # tenant: the per-tenant mix ran with skewed weights, each tenant's
+    # SLO CLASS window measured over its own label slice, the ledger
+    # conserved tokens against the engine totals, the exported label set
+    # respected the clamp, and the rollup wrote durable rows
+    tenant = report["scenarios"]["tenant"]
+    assert tenant["conservation"]["checked"] is True
+    assert (tenant["conservation"]["ledger_prompt"]
+            == tenant["conservation"]["engine_prompt"]) and (
+        tenant["conservation"]["ledger_generated"]
+        == tenant["conservation"]["engine_generated"])
+    assert tenant["rollup_rows"] > 0
+    per_class = {t["slo"]["slo_class"]
+                 for t in tenant["tenants"].values()}
+    assert {"premium", "default", "batch"} == per_class
+    # heavy tenant got ~5x the light tenant's traffic (5:2:1 schedule)
+    heavy = tenant["per_tenant_requests"]["user:tenant-a@scenario.local"]
+    light = tenant["per_tenant_requests"]["user:tenant-c@scenario.local"]
+    assert heavy > light
+    for t, block in tenant["tenants"].items():
+        assert block["slo"]["objectives"]["ttft_p95"]["window_samples"] > 0, \
+            (t, block)
+
     # chaos: the kill interrupted real in-flight work, the merged
     # failover streams matched the uninterrupted reference token-for-
     # token, and the killed replica reloaded under residual load
@@ -72,7 +94,8 @@ def test_scenarios_cpu_smoke(scenario_env, monkeypatch):
     names = sorted(report["captures_written"])
     assert names == ["BENCH_SCENARIO_BURST_r01.json",
                      "BENCH_SCENARIO_CHAOS_r01.json",
-                     "BENCH_SCENARIO_RAMP_r01.json"]
+                     "BENCH_SCENARIO_RAMP_r01.json",
+                     "BENCH_SCENARIO_TENANT_r01.json"]
     for file_name in names:
         with open(scenario_env / file_name) as fh:
             payload = json.load(fh)

@@ -92,11 +92,13 @@ def test_slo_metrics_and_stable_labels(telemetry_engine):
     _generate(engine, prompt="measure me", max_tokens=8)
     body, _ = metrics.render()
     text = body.decode()
-    # histograms carry samples with the model + replica labels
+    # histograms carry samples with the model + replica + (clamped)
+    # tenant labels; direct engine submissions have no resolved tenant
+    # and account as "unattributed"
     assert ('mcpforge_llm_ttft_seconds_count'
-            '{model="llama3-test",replica="0"}') in text
+            '{model="llama3-test",replica="0",tenant="unattributed"}') in text
     assert ('mcpforge_llm_tpot_seconds_count'
-            '{model="llama3-test",replica="0"}') in text
+            '{model="llama3-test",replica="0",tenant="unattributed"}') in text
     assert 'mcpforge_llm_dispatch_gap_seconds_count{replica="0"}' in text
     assert 'mcpforge_llm_kv_bytes_in_use{replica="0"}' in text
     assert "mcpforge_llm_queue_wait_seconds_count" in text
@@ -118,9 +120,11 @@ def test_slo_metrics_and_stable_labels(telemetry_engine):
         return 0.0
 
     assert count_of('mcpforge_llm_ttft_seconds_count'
-                    '{model="llama3-test",replica="0"}') >= 1
+                    '{model="llama3-test",replica="0",'
+                    'tenant="unattributed"}') >= 1
     assert count_of('mcpforge_llm_tpot_seconds_count'
-                    '{model="llama3-test",replica="0"}') >= 1
+                    '{model="llama3-test",replica="0",'
+                    'tenant="unattributed"}') >= 1
 
 
 def test_step_ring_buffer_bounded_and_shaped(telemetry_engine):
@@ -201,13 +205,20 @@ async def test_gateway_http_span_is_ancestor_of_llm_request():
                     and s.trace_id == span.trace_id}
         assert {"llm.prefill", "llm.decode"} <= children
 
-        # /metrics exposition carries non-zero SLO histograms + gauges
+        # /metrics exposition carries non-zero SLO histograms + gauges;
+        # the HTTP-resolved principal rides the tenant label end to end
+        # (the env-credential superuser has no team rows, so resolution
+        # falls through team -> API key -> USER)
         resp = await gateway.get("/metrics/prometheus", auth=auth)
         text = await resp.text()
         assert ('mcpforge_llm_ttft_seconds_count'
-                '{model="llama3-test",replica="0"}') in text
+                '{model="llama3-test",replica="0",'
+                'tenant="user:admin@example.com"}') in text
         assert ('mcpforge_llm_tpot_seconds_count'
-                '{model="llama3-test",replica="0"}') in text
+                '{model="llama3-test",replica="0"') in text
+        # the ledger's exported twin carries the same tenant
+        assert ('mcpforge_llm_tenant_tokens_total{kind="prompt",'
+                'tenant="user:admin@example.com"}') in text
         assert "mcpforge_llm_kv_page_utilization" in text
 
         # step-introspection endpoint returns the last N step summaries

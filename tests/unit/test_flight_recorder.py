@@ -158,15 +158,32 @@ def test_snapshot_shape_and_metrics_observed():
                               slow_request_s=0.001)
     recorder.record(method="GET", path="/a", route="/a", status=500,
                     duration_s=0.5, phases_ms={"error": 500.0},
-                    error="RuntimeError")
+                    tenant="team:t1", error="RuntimeError")
     snap = recorder.snapshot(limit=8)
     assert snap["recorded"] == 1 and snap["slow_requests"] == 1
     assert snap["slowest"][0]["error"] == "RuntimeError"
     assert snap["recent"][0]["status"] == 500
+    # rows carry the EXACT tenant; the Prometheus label is clamped
+    assert snap["recent"][0]["tenant"] == "team:t1"
     rendered = metrics.render()[0].decode()
-    assert 'mcpforge_gw_request_phase_seconds_count{phase="error",' \
-           'route="/a"} 1.0' in rendered
+    assert ('mcpforge_gw_request_phase_seconds_count{phase="error",'
+            'route="/a",tenant="team:t1"} 1.0') in rendered
     assert 'mcpforge_gw_slow_requests_total{route="/a"} 1.0' in rendered
+
+
+def test_snapshot_tenant_filter():
+    recorder = FlightRecorder(None, ring_size=8, slowest_size=4)
+    for tenant in ("team:a", "team:b", "team:a", None):
+        recorder.record(method="GET", path="/x", route="/x", status=200,
+                        duration_s=0.01, phases_ms={"handler": 10.0},
+                        tenant=tenant)
+    snap = recorder.snapshot(limit=8, tenant="team:a")
+    assert snap["tenant"] == "team:a"
+    assert len(snap["recent"]) == 2
+    assert all(r["tenant"] == "team:a" for r in snap["recent"])
+    assert all(r.get("tenant") == "team:a" for r in snap["slowest"])
+    # unfiltered snapshot still returns everything
+    assert len(recorder.snapshot(limit=8)["recent"]) == 4
 
 
 # --------------------------------------------------------- LoopLagSampler

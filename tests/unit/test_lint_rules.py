@@ -497,14 +497,29 @@ def test_dead_metric_silent_when_all_metrics_fed():
     assert result.findings == []
 
 
-def test_dead_metric_reference_inside_observability_does_not_count():
+def test_dead_metric_reference_in_registry_module_does_not_count():
+    """Self-references inside the registry file are registration noise,
+    not feeding — a metric referenced nowhere else is dead."""
     result = lint_sources({
-        "pkg/observability/metrics.py": textwrap.dedent(METRICS_FIXTURE),
-        "pkg/observability/export.py":
-            "def f(m):\n    m.queue_depth.set(1)\n    m.http_requests.inc()\n",
+        "pkg/observability/metrics.py": textwrap.dedent(METRICS_FIXTURE) + (
+            "    def helper(self):\n"
+            "        return self.queue_depth\n"
+            "        # .http_requests mentioned here too\n"),
     }, [DeadMetricRule()])
     assert {"queue_depth", "http_requests"} == {
         f.message.split()[1] for f in result.findings}
+
+
+def test_dead_metric_observability_sibling_producer_counts():
+    """observability/ siblings (e.g. metering.py's tenant ledger) are
+    REAL producers: feeding from them keeps a metric alive — only the
+    registry module itself is excluded from the feed scan."""
+    result = lint_sources({
+        "pkg/observability/metrics.py": textwrap.dedent(METRICS_FIXTURE),
+        "pkg/observability/metering.py":
+            "def f(m):\n    m.queue_depth.set(1)\n    m.http_requests.inc()\n",
+    }, [DeadMetricRule()])
+    assert result.findings == []
 
 
 def test_dead_metric_silent_without_registry_in_file_set():

@@ -19,9 +19,11 @@ def _evaluator(objectives=None, budget=0.05):
     return metrics, SloEvaluator(metrics, objectives, error_budget=budget)
 
 
-def _observe_ttft(metrics, seconds, n=1):
+def _observe_ttft(metrics, seconds, n=1, tenant="unattributed"):
     for _ in range(n):
-        metrics.llm_ttft.labels(model="m", replica="0").observe(seconds)
+        metrics.llm_ttft.labels(model="m", replica="0",
+                                tenant=metrics.tenant_clamp.label(tenant)
+                                ).observe(seconds)
 
 
 # ------------------------------------------------------------- pure helpers
@@ -66,7 +68,8 @@ def test_target_above_top_bucket_is_not_a_false_breach():
     metrics, evaluator = _evaluator(
         objectives=[SloObjective("tpot_p95", "llm_tpot", 0.95, 5000.0)])
     for _ in range(20):
-        metrics.llm_tpot.labels(model="m", replica="0").observe(3.0)
+        metrics.llm_tpot.labels(model="m", replica="0",
+                                tenant="unattributed").observe(3.0)
     report = evaluator.evaluate()
     (obj,) = report["objectives"]
     assert report["ok"] is True
@@ -157,6 +160,52 @@ def test_consumer_table_is_bounded():
     assert len(evaluator._prev_ts) <= evaluator.MAX_CONSUMERS
 
 
+def test_evicted_consumer_reappears_with_a_fresh_window():
+    """A (tenant-keyed) consumer that staled out of the bounded table
+    and re-appears must start a FRESH window — not report the whole
+    metric lifetime (including breaches from long before its return)
+    dressed up as its delta window. Regression for the eviction path:
+    tenant-keyed windows multiply consumers, so eviction churn is
+    routine, and a stale implicit from-boot baseline would bill old
+    breaches to the re-opened window."""
+    metrics, evaluator = _evaluator(budget=0.05)
+    _observe_ttft(metrics, 20.0, n=50)      # breach history, pre-window
+    evaluator.evaluate(consumer="t")
+    # churn enough other consumers to evict "t" from the bounded table
+    for i in range(evaluator.MAX_CONSUMERS + 1):
+        evaluator.evaluate(consumer=f"churn{i}")
+    assert "t" not in evaluator._prev
+    report = evaluator.evaluate(consumer="t")  # re-appears
+    (obj,) = report["objectives"]
+    # fresh window: no samples, no window percentile, no window_s —
+    # NOT the 50 stale breaches presented as this window's data
+    assert report["window_s"] is None
+    assert obj["window_samples"] == 0
+    assert obj["window_p_ms"] is None
+    # the next call sees only traffic since the re-appearance
+    _observe_ttft(metrics, 0.05, n=3)
+    second = evaluator.evaluate(consumer="t")
+    (obj2,) = second["objectives"]
+    assert obj2["window_samples"] == 3
+    assert obj2["fraction_over_target"] == 0.0
+    assert obj2["ok"] is True
+
+
+def test_first_call_reports_empty_window_not_lifetime():
+    """First sight of any consumer snapshots and reports an EMPTY
+    window; burn rate falls back to lifetime data (labeled by
+    window_samples == 0)."""
+    metrics, evaluator = _evaluator(budget=0.05)
+    _observe_ttft(metrics, 20.0, n=10)
+    report = evaluator.evaluate()
+    (obj,) = report["objectives"]
+    assert obj["window_samples"] == 0
+    assert obj["total_samples"] == 10
+    # lifetime fallback still surfaces the breach
+    assert obj["fraction_over_target"] > 0.9
+    assert report["ok"] is False
+
+
 def test_empty_histograms_are_ok_not_crash():
     _metrics, evaluator = _evaluator()
     report = evaluator.evaluate()
@@ -186,6 +235,118 @@ def test_default_objectives_read_settings():
     assert by_name["http_p95"].metric_attr == "http_duration"
     assert by_name["http_p95"].target_ms == 444.0
     assert all(o.percentile == 0.95 for o in objectives)
+
+
+# ------------------------------------------------------- SLO classes / tenant
+
+class _ClassSettings:
+    slo_ttft_p95_ms = 2500.0
+    slo_tpot_p95_ms = 250.0
+    slo_queue_wait_p95_ms = 1500.0
+    slo_http_p95_ms = 1000.0
+    slo_classes = ('{"premium": {"ttft_p95_ms": 100, "tpot_p95_ms": 50,'
+                   ' "http_p95_ms": 200}, "batch": {"ttft_p95_ms": 9000}}')
+    slo_tenant_classes = '{"team:gold": "premium", "team:bulk": "batch"}'
+
+
+def test_parse_slo_classes_and_assignment():
+    from mcp_context_forge_tpu.observability.slo import (parse_slo_classes,
+                                                         parse_tenant_classes)
+    classes = parse_slo_classes(_ClassSettings())
+    assert set(classes) == {"default", "premium", "batch"}
+    assert classes["premium"].ttft_p95_ms == 100
+    # unset fields inherit the flat defaults
+    assert classes["batch"].tpot_p95_ms == 250.0
+    assert classes["batch"].http_p95_ms == 1000.0
+    assert parse_tenant_classes(_ClassSettings()) == {
+        "team:gold": "premium", "team:bulk": "batch"}
+    # malformed JSON fails fast (a dropped SLO class is a false all-clear)
+    class Bad(_ClassSettings):
+        slo_classes = '{"premium": 5}'
+    import pytest
+    with pytest.raises(ValueError):
+        parse_slo_classes(Bad())
+
+
+def _tenant_evaluator():
+    from mcp_context_forge_tpu.observability.slo import (parse_slo_classes,
+                                                         parse_tenant_classes)
+    from mcp_context_forge_tpu.observability.tenant import TenantClamp
+
+    metrics = PrometheusRegistry(tenant_clamp=TenantClamp(2))
+    settings = _ClassSettings()
+    evaluator = SloEvaluator(
+        metrics, default_objectives(settings), error_budget=0.05,
+        slo_classes=parse_slo_classes(settings),
+        tenant_classes=parse_tenant_classes(settings),
+        tenant_label=metrics.tenant_clamp.peek)
+    return metrics, evaluator
+
+
+def test_tenant_evaluation_uses_class_targets_and_label_slice():
+    """/admin/slo?tenant= evaluates the tenant's assigned class against
+    ONLY that tenant's metric label children."""
+    metrics, evaluator = _tenant_evaluator()
+    # gold breaches its strict premium 100ms TTFT target; bulk is slow
+    # too but its batch class tolerates 9000ms
+    _observe_ttft(metrics, 0.5, n=20, tenant="team:gold")
+    _observe_ttft(metrics, 0.5, n=20, tenant="team:bulk")
+    evaluator.evaluate(consumer="w", tenant="team:gold")   # open windows
+    evaluator.evaluate(consumer="w", tenant="team:bulk")
+    _observe_ttft(metrics, 0.5, n=10, tenant="team:gold")
+    _observe_ttft(metrics, 0.5, n=10, tenant="team:bulk")
+    gold = evaluator.evaluate(consumer="w", tenant="team:gold")
+    bulk = evaluator.evaluate(consumer="w", tenant="team:bulk")
+    assert gold["slo_class"] == "premium"
+    assert gold["tenant_label"] == "team:gold"
+    assert gold["tenant_clamped"] is False
+    gold_ttft = next(o for o in gold["objectives"]
+                     if o["name"] == "ttft_p95")
+    bulk_ttft = next(o for o in bulk["objectives"]
+                     if o["name"] == "ttft_p95")
+    # the label slice isolates each tenant's 10-sample window
+    assert gold_ttft["window_samples"] == 10
+    assert bulk_ttft["window_samples"] == 10
+    assert gold_ttft["target_ms"] == 100
+    assert bulk_ttft["target_ms"] == 9000
+    assert gold_ttft["ok"] is False      # 500ms >> premium's 100ms
+    assert bulk_ttft["ok"] is True       # batch tolerates it
+    # class bundles cover ttft/tpot/http (queue-wait stays fleet-wide)
+    assert {o["name"] for o in gold["objectives"]} == {
+        "ttft_p95", "tpot_p95", "http_p95"}
+
+
+def test_tenant_windows_are_isolated_from_each_other_and_untenanted():
+    metrics, evaluator = _tenant_evaluator()
+    _observe_ttft(metrics, 0.05, n=4, tenant="team:gold")
+    evaluator.evaluate(consumer="w", tenant="team:gold")
+    evaluator.evaluate(consumer="w")                      # untenanted window
+    _observe_ttft(metrics, 0.05, n=6, tenant="team:gold")
+    # an untenanted poll on the SAME consumer name must not shred the
+    # tenant window's delta
+    evaluator.evaluate(consumer="w")
+    gold = evaluator.evaluate(consumer="w", tenant="team:gold")
+    obj = next(o for o in gold["objectives"] if o["name"] == "ttft_p95")
+    assert obj["window_samples"] == 6
+
+
+def test_clamped_tenant_reads_other_slice_and_says_so():
+    """A tenant past the clamp evaluates over the shared "other" label
+    slice — report it as clamped so the verdict is not misread as
+    tenant-isolated. The probe itself must not consume a clamp slot."""
+    metrics, evaluator = _tenant_evaluator()      # clamp of 2
+    _observe_ttft(metrics, 0.05, n=2, tenant="team:a")
+    _observe_ttft(metrics, 0.05, n=2, tenant="team:b")
+    _observe_ttft(metrics, 0.05, n=3, tenant="team:c")   # -> "other"
+    report = evaluator.evaluate(tenant="team:c")
+    assert report["tenant_label"] == "other"
+    assert report["tenant_clamped"] is True
+    obj = next(o for o in report["objectives"] if o["name"] == "ttft_p95")
+    assert obj["total_samples"] == 3
+    # probing an unseen tenant via /admin/slo did not admit it
+    assert "team:never-seen" not in metrics.tenant_clamp.admitted()
+    evaluator.evaluate(tenant="team:never-seen")
+    assert "team:never-seen" not in metrics.tenant_clamp.admitted()
 
 
 def test_missing_metric_attr_is_skipped():

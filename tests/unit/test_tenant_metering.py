@@ -1,0 +1,249 @@
+"""Tenant metering plane (observability/tenant.py + metering.py):
+principal → tenant resolution order, the bounded-cardinality label
+clamp, ledger conservation under concurrent multi-threaded adds, quota
+ratios, and the DB rollup round-trip."""
+
+import asyncio
+import threading
+
+import pytest
+
+from mcp_context_forge_tpu.observability.metering import (TenantLedger,
+                                                          TenantUsageRollup)
+from mcp_context_forge_tpu.observability.metrics import PrometheusRegistry
+from mcp_context_forge_tpu.observability.tenant import (ANONYMOUS, OTHER,
+                                                        UNATTRIBUTED,
+                                                        TenantClamp,
+                                                        current_tenant,
+                                                        reset_current_tenant,
+                                                        resolve_tenant,
+                                                        set_current_tenant)
+
+
+class _Auth:
+    def __init__(self, user="u@x", via="basic", teams=(), token_jti=None):
+        self.user = user
+        self.via = via
+        self.teams = list(teams)
+        self.token_jti = token_jti
+
+
+# ------------------------------------------------------------- resolution
+
+def test_resolution_order_team_then_key_then_user():
+    assert resolve_tenant(_Auth(teams=["t1", "t2"],
+                                token_jti="j")) == "team:t1"
+    # the team pick is ORDER-INDEPENDENT (min): the membership query has
+    # no ORDER BY, and a row-order-dependent pick would split one
+    # principal's usage across tenant rows between cache refreshes
+    assert resolve_tenant(_Auth(teams=["t2", "t1"])) == "team:t1"
+    assert resolve_tenant(_Auth(token_jti="j1")) == "key:j1"
+    assert resolve_tenant(_Auth(user="alice@x")) == "user:alice@x"
+    assert resolve_tenant(_Auth(via="anonymous")) == ANONYMOUS
+    assert resolve_tenant(None) == ANONYMOUS
+
+
+def test_contextvar_roundtrip():
+    assert current_tenant() is None
+    token = set_current_tenant("team:a")
+    assert current_tenant() == "team:a"
+    reset_current_tenant(token)
+    assert current_tenant() is None
+
+
+# ------------------------------------------------------------------ clamp
+
+def test_clamp_bounds_label_set_at_n_plus_one():
+    clamp = TenantClamp(3)
+    labels = {clamp.label(f"team:{i}") for i in range(20)}
+    assert len(labels) == 4  # 3 admitted + "other"
+    assert OTHER in labels
+    # admitted labels are sticky — re-labeling never renames
+    first = clamp.admitted()
+    for i in range(20):
+        clamp.label(f"team:{i}")
+    assert clamp.admitted() == first
+
+
+def test_clamp_peek_never_admits():
+    clamp = TenantClamp(2)
+    assert clamp.peek("team:x") == OTHER
+    assert clamp.admitted() == []
+    assert clamp.label("team:x") == "team:x"
+    assert clamp.peek("team:x") == "team:x"
+
+
+# ----------------------------------------------------------------- ledger
+
+def test_ledger_conservation_under_concurrent_adds():
+    """Column sums over all tenants equal the per-thread grand totals,
+    with the clamp active and the ledger's own overflow bucket in play —
+    tokens are conserved no matter which bucket they land in."""
+    registry = PrometheusRegistry(tenant_clamp=TenantClamp(2))
+    ledger = TenantLedger(clamp=registry.tenant_clamp, metrics=registry,
+                          max_tenants=4)
+    threads = []
+
+    def work(tid):
+        for i in range(200):
+            ledger.add(f"team:{(tid + i) % 8}", requests=1,
+                       prompt_tokens=3, generated_tokens=2,
+                       cache_hit_tokens=1, kv_page_seconds=0.5)
+
+    for tid in range(4):
+        threads.append(threading.Thread(target=work, args=(tid,)))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sums = ledger.column_sums()
+    assert sums["requests"] == 800
+    assert sums["prompt_tokens"] == 2400
+    assert sums["generated_tokens"] == 1600
+    assert sums["cache_hit_tokens"] == 800
+    assert sums["kv_page_seconds"] == pytest.approx(400.0)
+    # ledger rows bounded at max_tenants (+ the overflow bucket)
+    assert len(ledger.totals()) <= ledger.max_tenants + 1
+    # exported label children bounded at clamp + 1
+    rendered = registry.render()[0].decode()
+    tenant_labels = {line.split('tenant="')[1].split('"')[0]
+                     for line in rendered.splitlines()
+                     if line.startswith("mcpforge_llm_tenant_tokens_total{")}
+    assert len(tenant_labels) <= registry.tenant_clamp.max_tenants + 1
+
+
+def test_ledger_unattributed_and_snapshot_ordering():
+    ledger = TenantLedger()
+    ledger.add("", prompt_tokens=1)
+    ledger.add("team:big", prompt_tokens=100, generated_tokens=50)
+    ledger.add("team:small", prompt_tokens=2)
+    snap = ledger.snapshot()
+    assert snap["tenants"][0]["tenant"] == "team:big"  # heaviest first
+    assert {t["tenant"] for t in snap["tenants"]} == {
+        "team:big", "team:small", UNATTRIBUTED}
+    assert snap["tenant_count"] == 3
+
+
+def test_quota_ratio_tracks_window_and_resets_on_take():
+    registry = PrometheusRegistry()
+    ledger = TenantLedger(metrics=registry, quota_tokens_per_window=100)
+    ledger.add("team:a", prompt_tokens=30, generated_tokens=20)
+    assert ledger.quota_ratio("team:a") == pytest.approx(0.5)
+    rendered = registry.render()[0].decode()
+    assert ('mcpforge_gw_tenant_quota_used_ratio{tenant="team:a"} 0.5'
+            in rendered)
+    started, rows = ledger.take_window()
+    assert rows["team:a"]["prompt_tokens"] == 30
+    assert ledger.quota_ratio("team:a") == 0.0  # fresh window
+    rendered = registry.render()[0].decode()
+    assert ('mcpforge_gw_tenant_quota_used_ratio{tenant="team:a"} 0.0'
+            in rendered)
+    # cumulative totals survive the window drain
+    assert ledger.totals()["team:a"]["prompt_tokens"] == 30
+
+
+def test_no_quota_means_zero_ratio():
+    ledger = TenantLedger(metrics=PrometheusRegistry())
+    ledger.add("team:a", prompt_tokens=10**9)
+    assert ledger.quota_ratio("team:a") == 0.0
+
+
+def test_quota_gauge_aggregates_tenants_sharing_the_other_label():
+    """The "other" gauge must report the overflow POOL's summed window
+    consumption — last-writer-wins per tenant would let a clamped
+    tenant at 95% of quota hide behind a 1%-tenant's later write, and
+    the rate limiter reading the gauge would admit past quota."""
+    registry = PrometheusRegistry(tenant_clamp=TenantClamp(1))
+    ledger = TenantLedger(clamp=registry.tenant_clamp, metrics=registry,
+                          quota_tokens_per_window=100)
+    registry.tenant_clamp.label("team:admitted")  # fill the one slot
+    ledger.add("team:x", prompt_tokens=95)        # -> "other", heavy
+    ledger.add("team:y", prompt_tokens=1)         # -> "other", light, LAST
+    rendered = registry.render()[0].decode()
+    line = next(l for l in rendered.splitlines()
+                if l.startswith('mcpforge_gw_tenant_quota_used_ratio'
+                                '{tenant="other"}'))
+    assert float(line.split()[-1]) == pytest.approx(0.96)  # sum, not 0.01
+
+
+# --------------------------------------------------------- loadgen schedule
+
+def test_weighted_schedule_is_deterministic_and_proportional():
+    from mcp_context_forge_tpu.tools.loadgen import weighted_schedule
+
+    pick = weighted_schedule([("a", 5), ("b", 2), ("c", 1)])
+    period = [pick(i) for i in range(8)]
+    # exact proportions per period, heavy tenant spread (not batched)
+    assert period.count("a") == 5
+    assert period.count("b") == 2
+    assert period.count("c") == 1
+    assert period[:3] != ["a", "a", "a"]  # smooth WRR interleaves
+    # periodic + reproducible
+    assert [pick(i) for i in range(8, 16)] == period
+    assert [weighted_schedule([("a", 5), ("b", 2), ("c", 1)])(i)
+            for i in range(8)] == period
+    with pytest.raises(ValueError):
+        weighted_schedule([("a", 0)])
+
+
+# ----------------------------------------------------------------- rollup
+
+class _FakeDb:
+    def __init__(self, fail=False):
+        self.rows = []
+        self.fail = fail
+
+    async def executemany(self, sql, seq):
+        if self.fail:
+            raise RuntimeError("db down")
+        self.rows.extend(seq)
+
+    async def fetchall(self, sql, params=()):
+        out = []
+        for r in self.rows[-params[0]:]:
+            out.append({"tenant": r[0], "window_start": r[1],
+                        "window_end": r[2], "requests": r[3],
+                        "prompt_tokens": r[4], "generated_tokens": r[5],
+                        "cache_hit_tokens": r[6], "kv_page_seconds": r[7]})
+        return out
+
+
+def test_rollup_flush_writes_rows_and_preserves_conservation():
+    ledger = TenantLedger()
+    ledger.add("team:a", requests=2, prompt_tokens=10, generated_tokens=4)
+    ledger.add("team:b", prompt_tokens=7, cache_hit_tokens=3)
+    db = _FakeDb()
+    rollup = TenantUsageRollup(db, ledger, interval_s=60)
+    written = asyncio.run(rollup.flush())
+    assert written == 2
+    by_tenant = {r[0]: r for r in db.rows}
+    assert by_tenant["team:a"][4] == 10   # prompt_tokens
+    assert by_tenant["team:b"][6] == 3    # cache_hit_tokens
+    # the DB rows + the (now empty) window still sum to the cumulative
+    # totals — the rollup moved tokens, never lost them
+    assert ledger.column_sums()["prompt_tokens"] == 17
+    assert asyncio.run(rollup.flush()) == 0  # drained window writes nothing
+
+
+def test_rollup_failure_remerges_window_instead_of_dropping():
+    registry = PrometheusRegistry()
+    ledger = TenantLedger(metrics=registry, quota_tokens_per_window=100)
+    ledger.add("team:a", prompt_tokens=10)
+    original_start = ledger._window_started
+    db = _FakeDb(fail=True)
+    rollup = TenantUsageRollup(db, ledger, interval_s=60)
+    with pytest.raises(RuntimeError):
+        asyncio.run(rollup.flush())
+    # the quota gauge is RESTORED after the failed drain (take_window
+    # zeroed it; the tokens are still unbilled in the merged-back window)
+    rendered = registry.render()[0].decode()
+    assert ('mcpforge_gw_tenant_quota_used_ratio{tenant="team:a"} 0.1'
+            in rendered)
+    db.fail = False
+    assert asyncio.run(rollup.flush()) == 1  # usage survived the outage
+    assert db.rows[0][4] == 10
+    # the retried row carries the ORIGINAL window_start — take_window
+    # advanced it during the failed drain, and stamping the usage with
+    # the post-failure window would misattribute it in time (quota
+    # audits / billing reconciliation are window-bounded)
+    assert db.rows[0][1] == original_start
