@@ -42,6 +42,17 @@ KV dtype vs int8 paged KV (tpu_local_kv_quant) — and reports both arms'
 tok/s, each arm's page capacity + peak resident pages, and the int8
 arm's greedy token-parity rate against the baseline arm.
 
+BENCH_PREFIX_TIERS=1 runs the tiered-prefix-cache A/B
+(tpu_local_prefix_tiers, docs/kv_tiering.md): a shared-prefix workload
+— more distinct long templates than the FIXED small HBM page budget
+can keep resident, revisited round-robin so each template is evicted
+between uses — served with tiers off (eviction drops pages) vs on
+(eviction spills to host/disk; matches restore). Reports per-arm
+prefix_hit_tokens, the tier hit mix, spill/restore counts + restore
+p95, tok/s, and greedy token parity across arms. The acceptance bar:
+the tiers-on arm's prefix_hit_tokens >= 2x the off arm's at the same
+page budget.
+
 Platform: probed in a subprocess (a wedged TPU runtime cannot hang the
 bench — round-1 failure mode); BENCH_PLATFORM overrides.
 """
@@ -289,6 +300,109 @@ async def run(platform: str, kv_quant: str = "", superstep: int = 0) -> dict:
         await engine.stop()
 
 
+async def _run_prefix_tiers_arm(platform: str, tiers: bool) -> dict:
+    """One arm of the tiered-prefix-cache A/B: G distinct long templates
+    over a page budget sized to hold only a couple of them, revisited in
+    rotation so every reuse finds its pages evicted (dropped with tiers
+    off, spilled with tiers on)."""
+    from mcp_context_forge_tpu.tpu_local.engine import EngineConfig, TPUEngine
+
+    model = os.environ.get(
+        "BENCH_MODEL", "llama3-1b" if platform == "tpu" else "llama3-tiny")
+    page_size = int(os.environ.get("BENCH_PAGE_SIZE", "16"))
+    groups = int(os.environ.get("BENCH_TIER_GROUPS", "6"))
+    rounds = int(os.environ.get("BENCH_TIER_ROUNDS", "3"))
+    max_tokens = int(os.environ.get("BENCH_TOKENS", "8"))
+    tmpl_pages = 3                       # full pages per shared template
+    # the FIXED HBM page budget both arms serve under: room for one
+    # active request (template + suffix + generation) plus ~1.5 cached
+    # templates — far below the groups x tmpl_pages working set
+    slot_pages = tmpl_pages + 2
+    target_pages = 1 + slot_pages + int(tmpl_pages * 1.5)
+    kv_quant = os.environ.get("BENCH_KV_QUANT_TIERS", "")
+    num_pages = target_pages
+    if kv_quant:
+        # EngineConfig.num_pages is a byte budget denominated in
+        # ENGINE-DTYPE pages; re-denominate so the RESIDENT pool still
+        # holds ~target_pages and the eviction pressure the A/B depends
+        # on survives the int8 conversion
+        import jax.numpy as jnp
+
+        from mcp_context_forge_tpu.tpu_local.kv import kv_page_bytes
+        from mcp_context_forge_tpu.tpu_local.models import MODEL_CONFIGS
+
+        dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
+        mc = MODEL_CONFIGS[model]
+        budget = target_pages * kv_page_bytes(mc, page_size, dtype, kv_quant)
+        num_pages = max(2, -(-budget // kv_page_bytes(mc, page_size, dtype)))
+    config = EngineConfig(
+        model=model, max_batch=2, max_seq_len=page_size * 8,
+        page_size=page_size, num_pages=num_pages,
+        prefill_buckets=(page_size, page_size * 4),
+        dtype="bfloat16" if platform == "tpu" else "float32",
+        attn_impl="auto", prefix_cache=True, prefix_tiers=tiers,
+        tier_host_bytes=64 * 1024 * 1024, tier_disk_bytes=64 * 1024 * 1024,
+        kv_quant=kv_quant,
+        compile_cache_dir=os.environ.get(
+            "MCPFORGE_TPU_LOCAL_COMPILE_CACHE_DIR",
+            "/tmp/mcpforge-xla-cache"))
+    engine = TPUEngine(config)
+    await engine.start()
+    try:
+        templates = [[7 + g * 101 + i for i in range(tmpl_pages * page_size)]
+                     for g in range(groups)]
+        streams: list[list[int]] = []
+        prompt_tokens = 0
+        started = time.monotonic()
+        total = 0
+        for r in range(rounds):
+            for g, template in enumerate(templates):
+                prompt = template + [900 + r * groups + g]
+                prompt_tokens += len(prompt)
+                tokens = [t async for t in engine.generate(
+                    prompt, max_tokens=max_tokens)]
+                streams.append(tokens)
+                total += len(tokens)
+        wall = time.monotonic() - started
+        alloc = engine.allocator
+        arm = {
+            "prefix_tiers": tiers,
+            "value": round(total / wall, 2) if wall else 0.0,
+            "tokens": total,
+            "kv_pages_capacity": engine.num_kv_pages,
+            "prompt_tokens": prompt_tokens,
+            "prefix_hits": alloc.prefix_hits,
+            "prefix_hit_tokens": alloc.prefix_hit_tokens,
+            "tier_hit_mix": dict(alloc.tier_hit_tokens),
+            "token_streams": streams,
+        }
+        stats = engine.tier_stats()
+        if stats is not None:
+            arm["spills"] = stats["spills"]
+            arm["restores"] = stats["restores"]
+            arm["restore_p95_ms"] = stats["restore_p95_ms"]
+            arm["store"] = stats.get("store")
+        return arm
+    finally:
+        await engine.stop()
+
+
+def run_prefix_tiers(platform: str) -> dict:
+    """The BENCH_PREFIX_TIERS A/B block: tiers off vs on at the same
+    page budget + workload; parity is greedy and must be 1.0."""
+    off = asyncio.run(_run_prefix_tiers_arm(platform, tiers=False))
+    on = asyncio.run(_run_prefix_tiers_arm(platform, tiers=True))
+    base_streams = off.pop("token_streams")
+    on_streams = on.pop("token_streams")
+    return {
+        "baseline": off,
+        "tiered": on,
+        "hit_tokens_ratio": round(
+            on["prefix_hit_tokens"] / max(1, off["prefix_hit_tokens"]), 3),
+        "token_parity_rate": _parity_rate(base_streams, on_streams),
+    }
+
+
 def _parity_rate(base_streams, arm_streams) -> float:
     """Per-position greedy token agreement across paired streams (1.0 =
     byte-identical)."""
@@ -354,6 +468,13 @@ def main() -> dict:
                 3),
             "token_parity_rate": _parity_rate(base_streams, arm_streams),
         }
+    if os.environ.get("BENCH_PREFIX_TIERS", "0") == "1":
+        # tiered prefix cache A/B: shared-prefix workload at a FIXED
+        # small HBM page budget — tiers off drops evicted templates,
+        # tiers on spills + restores them. The capture self-describes as
+        # a tiers arm so bench_trend judges it only against tier history.
+        out["prefix_tiers"] = True
+        out["prefix_tiers_ab"] = run_prefix_tiers(platform)
     return out
 
 

@@ -67,14 +67,18 @@ def _scale() -> dict:
                 "mixed_concurrency": 4, "mixed_requests": 16,
                 "chaos_concurrency": 3, "chaos_requests": 9,
                 "chaos_prompts": 4, "max_tokens": 6,
-                "tenant_concurrency": 4, "tenant_requests": 16}
+                "tenant_concurrency": 4, "tenant_requests": 16,
+                "prefix_concurrency": 3, "prefix_requests": 12,
+                "prefix_template_chars": 80}
     return {"burst_phases": [("baseline", 4, 60), ("burst", 64, 400),
                              ("cooldown", 4, 60)],
             "ramp_steps": [4, 8, 16, 32, 16, 8, 4], "ramp_requests": 50,
             "mixed_concurrency": 16, "mixed_requests": 240,
             "chaos_concurrency": 8, "chaos_requests": 64,
             "chaos_prompts": 6, "max_tokens": 16,
-            "tenant_concurrency": 8, "tenant_requests": 80}
+            "tenant_concurrency": 8, "tenant_requests": 80,
+            "prefix_concurrency": 8, "prefix_requests": 64,
+            "prefix_template_chars": 220}
 
 
 async def _make_gateway(platform: str, replicas: int = 2):
@@ -103,6 +107,12 @@ async def _make_gateway(platform: str, replicas: int = 2):
         "MCPFORGE_TPU_LOCAL_NUM_PAGES": "128" if _smoke() else "2048",
         "MCPFORGE_TPU_LOCAL_PREFILL_BUCKETS": ("16,64" if _smoke()
                                                else "64,128,256"),
+        # tiered prefix cache ON (docs/kv_tiering.md): the pool-shared
+        # spill store + prefix index serve every scenario; the tenant
+        # scenario's long-shared-prefix arm gates the hit accounting
+        "MCPFORGE_TPU_LOCAL_PREFIX_TIERS": "1",
+        "MCPFORGE_TPU_LOCAL_TIER_HOST_BYTES": str(64 * 1024 * 1024),
+        "MCPFORGE_TPU_LOCAL_TIER_DISK_BYTES": str(64 * 1024 * 1024),
         "MCPFORGE_TPU_LOCAL_DTYPE": ("bfloat16" if platform == "tpu"
                                      else "float32"),
         "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
@@ -256,6 +266,52 @@ async def scenario_tenant(app, client, auth, model, scale) -> dict:
     load = await run_phase(client, pick, [kind], name="tenant-mix",
                            concurrency=scale["tenant_concurrency"],
                            requests=scale["tenant_requests"])
+
+    # long-shared-prefix arm (ROADMAP item 3 / docs/kv_tiering.md):
+    # every tenant's prompts share one long template, so the template's
+    # pages serve from the prefix cache — HBM-resident or RESTORED from
+    # the pool-shared spill tiers (MCPFORGE_TPU_LOCAL_PREFIX_TIERS=1
+    # above) — and prefix_hit_tokens becomes the dominant prefill term.
+    # Runs BEFORE the conservation read below so the per-tenant
+    # cache_hit ledger sums are checked over the tiered hit path too.
+    hit0 = sum(r.engine.allocator.prefix_hit_tokens for r in pool.replicas)
+    prompt0 = pool.stats.prompt_tokens
+    tier0: dict[str, int] = {}
+    for r in pool.replicas:
+        for tier, tokens in r.engine.allocator.tier_hit_tokens.items():
+            tier0[tier] = tier0.get(tier, 0) + tokens
+    template = ("shared kv-tier governance preamble; "
+                * 40)[:scale["prefix_template_chars"]]
+    prefix_kind = chat_kind(model, max_tokens=scale["max_tokens"],
+                            prompt=template)
+    prefix_load = await run_phase(
+        client, pick, [prefix_kind], name="tenant-prefix",
+        concurrency=scale["prefix_concurrency"],
+        requests=scale["prefix_requests"])
+    hit_tokens = sum(r.engine.allocator.prefix_hit_tokens
+                     for r in pool.replicas) - hit0
+    prefill_tokens = pool.stats.prompt_tokens - prompt0
+    # deltas over the arm, like hit_tokens/prefill_tokens above — the
+    # lifetime totals would misattribute the tenant-mix phase's hits
+    tier_mix: dict[str, int] = {}
+    for r in pool.replicas:
+        for tier, tokens in r.engine.allocator.tier_hit_tokens.items():
+            tier_mix[tier] = tier_mix.get(tier, 0) + tokens
+    tier_mix = {tier: tokens - tier0.get(tier, 0)
+                for tier, tokens in tier_mix.items()}
+    prefix_arm = {
+        "requests": prefix_load.requests,
+        "failures": prefix_load.failures,
+        "hit_tokens": hit_tokens,
+        "prefill_tokens": prefill_tokens,
+        # the arm's point: cached tokens outweigh the tokens actually
+        # prefilled (prompt total - hits = what the device computed)
+        "hit_dominant": hit_tokens > (prefill_tokens - hit_tokens),
+        "tier_hit_tokens": tier_mix,
+        "store": (pool.tier_store.stats()
+                  if pool.tier_store is not None else None),
+    }
+
     slos = {ids[email]: await windows[email].close()
             for email, _, _ in tenants}
 
@@ -313,6 +369,7 @@ async def scenario_tenant(app, client, auth, model, scale) -> dict:
                     for email, _, weight in tenants},
         "per_tenant_requests": per_tenant_requests,
         "conservation": conservation,
+        "prefix": prefix_arm,
         "tenant_label_children": sorted(labels),
         "clamp": usage_body["clamp"],
         "rollup_rows": rollup_rows,
@@ -326,6 +383,10 @@ async def scenario_tenant(app, client, auth, model, scale) -> dict:
                 and f"tenant label set {sorted(labels)} exceeds the "
                     f"top-{clamp_n}+1 clamp")
             or (rollup_rows == 0 and "no tenant_usage rollup rows written")
+            or (prefix_load.failures and
+                f"{prefix_load.failures} failures in the shared-prefix arm")
+            or (hit_tokens == 0 and "shared-prefix arm produced zero "
+                                    "prefix_hit_tokens (dead cache)")
             or next((f"tenant window for {t} saw zero ttft samples"
                      for t, s in slos.items()
                      if not s["objectives"]["ttft_p95"]["window_samples"]),

@@ -398,6 +398,22 @@ class Settings(BaseModel):
     # are reused across requests, so repeated plugin/chat templates only
     # prefill their suffix (vLLM automatic-prefix-caching analog)
     tpu_local_prefix_cache: bool = True
+    # tiered prefix/KV cache (docs/kv_tiering.md): evicted prefix pages
+    # spill HBM -> bounded host RAM (int8 + scales; quantize-on-spill for
+    # bf16 pools) -> bounded disk (async write-behind), and admission
+    # restores tier-resident pages on match (fetch-on-miss). Under a
+    # replica pool the store + prefix index are shared by every replica,
+    # so a prefix prefilled anywhere serves hits everywhere. Requires
+    # tpu_local_prefix_cache.
+    tpu_local_prefix_tiers: bool = False
+    tpu_local_tier_host_bytes: int = 256 * 1024 * 1024
+    tpu_local_tier_disk_bytes: int = 1024 * 1024 * 1024
+    tpu_local_tier_disk_dir: str = ""  # "" = private tempdir per store
+    # spill storage for full-precision pools: "int8" (default; 2-4x
+    # cheaper tiers, restored pages carry resident-int8-grade greedy
+    # drift) or "" for resident-precision spills (lossless round trip).
+    # int8-resident pools always spill verbatim (bit-exact).
+    tpu_local_tier_spill_quant: str = "int8"
     # speculative decoding via prompt-lookup (n-gram) drafting: verify k
     # drafted tokens per dispatch — decode is bandwidth-bound, so accepted
     # drafts are nearly free. Greedy requests only; off by default.

@@ -199,7 +199,9 @@ async function renderEngine(stats){
                  "decode_dispatches",
                  "prefill_batches","queue_depth","chunking","kv_pages_in_use",
                  "kv_bytes_in_use","kv_quant",
-                 "prefix_hits","prefix_hit_tokens","spec_steps","spec_tokens",
+                 "prefix_hits","prefix_hit_tokens","tier_hits_host",
+                 "tier_hits_disk","tier_hit_tokens_spilled",
+                 "spec_steps","spec_tokens",
                  "overlap_steps","pipeline_drains","dispatch_gap_ms_total",
                  "prefill_ms_total","decode_ms_total","engine_restarts"];
   const cards = order.filter(k => k in stats).map(k =>

@@ -806,7 +806,16 @@ document.getElementById("f").onsubmit = async (e) => {
                 "cached_pages": alloc.cached_pages,
                 "hits": alloc.prefix_hits,
                 "hit_tokens": alloc.prefix_hit_tokens,
+                # tiered spill store (docs/kv_tiering.md): per-tier hit
+                # split, spill/restore counters, store footprint
+                "tiers": engine.tier_stats(),
             },
+            # flat twins for the admin-UI engine cards (cell() renders
+            # scalars; the nested block above is the API-facing detail)
+            "tier_hits_host": alloc.tier_hits["host"],
+            "tier_hits_disk": alloc.tier_hits["disk"],
+            "tier_hit_tokens_spilled": (alloc.tier_hit_tokens["host"]
+                                        + alloc.tier_hit_tokens["disk"]),
             "spec_decode": {
                 "enabled": engine.config.spec_decode,
                 "steps": stats.spec_steps,

@@ -133,6 +133,38 @@ class PrometheusRegistry:
             "Admissions deferred or requests truncated for lack of KV pages",
             registry=self.registry,
         )
+        # --- tiered prefix/KV cache (tpu_local/kv/tiers.py,
+        # docs/kv_tiering.md) --- per-tier split of the prefix-cache hit
+        # stream (hbm = resident pages, host/disk = pages restored from a
+        # spill tier at admission); counted at the same consume site as
+        # allocator.prefix_hit_tokens, so summing tiers reproduces it
+        self.llm_prefix_tier_hits = Counter(
+            "mcpforge_llm_prefix_tier_hits_total",
+            "Prefix-cache page hits by serving tier (hbm = resident, "
+            "host/disk = restored on match from a spill tier)",
+            ["replica", "tier"], registry=self.registry,
+        )
+        # bytes resident per tier: hbm is per-replica (registered prefix
+        # pages x page bytes); host/disk report the POOL-SHARED store, so
+        # every replica's child carries the same value — read one child,
+        # never sum across replicas for the shared tiers
+        self.llm_prefix_tier_bytes = Gauge(
+            "mcpforge_llm_prefix_tier_bytes",
+            "Bytes resident in each prefix-cache tier (hbm per replica; "
+            "host/disk are the pool-shared spill store)",
+            ["replica", "tier"], registry=self.registry,
+        )
+        # spill/restore dataflow latency: spill = device->host page read
+        # + T1 admit at eviction, restore = verified fetch + host->device
+        # upload at match, writeback = the worker's T1->T2 persist
+        self.llm_prefix_tier_io = Histogram(
+            "mcpforge_llm_prefix_tier_io_seconds",
+            "Tiered prefix-cache page movement latency by operation "
+            "(spill, restore, writeback) and tier touched",
+            ["op", "tier"], registry=self.registry,
+            buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                     0.025, 0.05, 0.1, 0.25, 1.0),
+        )
         self.llm_step_tokens_per_sec = Gauge(
             "mcpforge_llm_step_tokens_per_sec",
             "Tokens emitted per second by the last engine step (over the "

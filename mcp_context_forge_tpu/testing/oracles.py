@@ -656,6 +656,207 @@ def _avg_slot_pages_spec(mod: types.ModuleType) -> None:
     assert a.avg_slot_pages() == 1
 
 
+def _prefix_tier_spec(mod: types.ModuleType) -> None:
+    """Tiered-prefix-cache contract (docs/kv_tiering.md): spill-on-evict
+    hands the EXACT chain identity (hash, parent, chunk) to the tier
+    client, probe caps tier promises at restore capacity (a probe that
+    over-promises livelocks admission), fetch-on-miss restores take one
+    reference per page and register locally, failed restores hand the
+    page back, and the per-tier hit split conserves against
+    prefix_hit_tokens at the same consume site."""
+    from mcp_context_forge_tpu.tpu_local.kv.prefix_index import (
+        ROOT_HASH, chain_hash, chain_hashes)
+
+    PA = mod.PageAllocator
+
+    class Tiers:
+        active = True
+
+        def __init__(self):
+            self.keys: set[bytes] = set()
+            self.spills: list[tuple] = []
+            self.published: list[bytes] = []
+            self.unpublished: list[bytes] = []
+            self.fail = False
+
+        def probe(self, key_hash):
+            return key_hash in self.keys
+
+        def spill(self, key_hash, parent, chunk, page):
+            self.spills.append((key_hash, parent, tuple(chunk), page))
+            self.keys.add(key_hash)
+            return True
+
+        def restore(self, key_hash, parent, chunk, page):
+            if self.fail or key_hash not in self.keys:
+                return None
+            return "host"
+
+        def publish_hbm(self, key_hash):
+            self.published.append(key_hash)
+
+        def unpublish_hbm(self, key_hash):
+            self.unpublished.append(key_hash)
+
+    tiers = Tiers()
+    alloc = PA(num_pages=8, page_size=4, max_slots=4, max_pages_per_slot=4,
+               tiers=tiers)
+    assert alloc.tier_hits == {"hbm": 0, "host": 0, "disk": 0}
+    assert alloc.tier_hit_tokens == {"hbm": 0, "host": 0, "disk": 0}
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    assert alloc.allocate_slot(0, 9)
+    alloc.register_prefix(0, prompt)               # 2 pages + 2 publishes
+    assert len(tiers.published) == 2
+
+    # resident consume: the hbm split counts at the SAME site as
+    # prefix_hit_tokens (the tenant ledger's cache_hit mirror)
+    hist, shared = alloc.match_prefix(prompt)
+    assert hist == 8
+    assert alloc.allocate_slot(1, 9, prefix_pages=shared)
+    assert alloc.tier_hits == {"hbm": 2, "host": 0, "disk": 0}
+    assert alloc.tier_hit_tokens == {"hbm": 8, "host": 0, "disk": 0}
+    assert sum(alloc.tier_hit_tokens.values()) == alloc.prefix_hit_tokens
+    alloc.free_slot(1)
+    alloc.free_slot(0)
+
+    # spill-on-evict: pressure reclaims the (now ref==0) registered
+    # pages; each handoff carries the exact chain identity and retracts
+    # the HBM publication
+    for slot in range(3):
+        assert alloc.allocate_slot(slot, 8)
+    assert alloc.allocate_slot(3, 4)
+    assert len(tiers.spills) >= 2
+    by_chunk = {s[2]: s for s in tiers.spills}    # eviction order is
+    s0 = by_chunk[(1, 2, 3, 4)]                   # LRU-by-last-match,
+    s1 = by_chunk[(5, 6, 7, 8)]                   # not chain order
+    assert s0[1] == ROOT_HASH
+    assert s0[0] == chain_hash(ROOT_HASH, (1, 2, 3, 4))
+    assert s1[1] == s0[0]                         # chained parent
+    assert s1[0] == chain_hash(s0[0], (5, 6, 7, 8))
+    assert s0[0] in tiers.unpublished and s1[0] in tiers.unpublished
+
+    # fetch-on-miss: a FRESH allocator (same shared tiers) serves the
+    # chain from the tier store — probe promises it, match restores it
+    # with exactly one reference per page, and the split says "host"
+    alloc2 = PA(num_pages=8, page_size=4, max_slots=2, max_pages_per_slot=4,
+                tiers=tiers)
+    assert alloc2.probe_prefix(prompt) == 8
+    assert alloc2.probe_prefix([1, 2, 3, 4]) == 0  # last token never matches
+    hist, pages2 = alloc2.match_prefix(prompt)
+    assert hist == 8 and len(pages2) == 2
+    assert all(alloc2._ref[p] == 1 for p in pages2)
+    assert alloc2.allocate_slot(0, 9, prefix_pages=pages2)
+    assert alloc2.tier_hits == {"hbm": 0, "host": 2, "disk": 0}
+    assert alloc2.tier_hit_tokens["host"] == 8
+    assert sum(alloc2.tier_hit_tokens.values()) == alloc2.prefix_hit_tokens
+    alloc2.free_slot(0)
+    # restored pages registered locally: the re-match is resident (hbm),
+    # and re-referencing an LRU page starts its count at exactly one
+    assert alloc2.probe_prefix(prompt) == 8
+    hist, pages3 = alloc2.match_prefix(prompt)
+    assert hist == 8
+    assert all(alloc2._ref[p] == 1 for p in pages3)
+    assert alloc2.allocate_slot(1, 9, prefix_pages=pages3)
+    assert alloc2.tier_hits["hbm"] == 2
+    alloc2.free_slot(1)
+
+    # probe caps tier promises at restore capacity: free+evictable of 2
+    # limits a 3-chunk tiered chain to 2 pages; a fully-pinned pool
+    # promises nothing (an over-promise here is an admission livelock)
+    prompt13 = list(range(20, 33))                 # 3 full pages + 1 token
+    tiers.keys.update(chain_hashes(prompt13, 4))
+    alloc3 = PA(num_pages=6, page_size=4, max_slots=2, max_pages_per_slot=4,
+                tiers=tiers)                       # 5 usable
+    assert alloc3.allocate_slot(0, 12)             # 3 pinned -> capacity 2
+    assert alloc3.probe_prefix(prompt13) == 8
+    alloc4 = PA(num_pages=4, page_size=4, max_slots=2, max_pages_per_slot=4,
+                tiers=tiers)                       # 3 usable
+    assert alloc4.allocate_slot(0, 12)             # everything pinned
+    assert alloc4.probe_prefix(prompt13) == 0
+
+    # matching a resident ref==0 (LRU) chain page PINS it, consuming one
+    # unit of the capacity later restores draw from — the probe must
+    # model that or it promises a hist match_prefix cannot deliver
+    # (admission livelock). A ref>0 resident page consumes nothing.
+    alloc6 = PA(num_pages=5, page_size=4, max_slots=3, max_pages_per_slot=4,
+                tiers=tiers)                       # 4 usable
+    assert alloc6.allocate_slot(0, 5)              # 2 pages
+    alloc6.register_prefix(0, prompt13[:5])        # chunk0 resident
+    alloc6.free_slot(0)                            # chunk0 -> LRU
+    assert alloc6.allocate_slot(1, 8)              # pin two free pages
+    assert alloc6.free_pages == 2                  # 1 free + 1 evictable
+    # chunk0 local-LRU (consumes 1) + chunk1 from tier (consumes 1);
+    # chunk2 finds no capacity left
+    assert alloc6.probe_prefix(prompt13) == 8
+    hist, pages6 = alloc6.match_prefix(prompt13[:5])
+    assert hist == 4
+    assert alloc6.allocate_slot(2, 5, prefix_pages=pages6)  # chunk0 ref>0
+    alloc6.free_slot(1)                            # capacity back: 2 free
+    assert alloc6.free_pages == 2
+    # the PINNED chunk0 consumes NO capacity: both tier chunks fit it
+    assert alloc6.probe_prefix(prompt13) == 12
+
+    # registration covers the FINAL page of an exact-multiple prompt
+    # (matches never cover the last token, but longer prompts share it)
+    exact = PA(num_pages=8, page_size=4, max_slots=2, max_pages_per_slot=4)
+    assert exact.allocate_slot(0, 8)
+    exact.register_prefix(0, [11, 12, 13, 14, 15, 16, 17, 18])
+    assert exact.cached_pages == 2
+    hist, m = exact.match_prefix([11, 12, 13, 14, 15, 16, 17, 18, 90, 91])
+    assert hist == 8
+    exact.release_prefix(m)
+    # ...and registering a prompt LONGER than the slot's pages stops at
+    # the pages the slot actually holds
+    assert exact.allocate_slot(1, 4)               # 1 page
+    exact.register_prefix(1, list(range(40, 52)))  # 3 full chunks
+    assert exact.cached_pages == 3                 # 2 from slot 0 + 1 new
+
+    # failed restore: the taken page goes BACK (no leak) and the match
+    # ends at the pages already secured
+    tiers.fail = True
+    free_before = alloc3.free_pages
+    hist, pages4 = alloc3.match_prefix(prompt13)
+    assert hist == 0 and pages4 == []
+    assert alloc3.free_pages == free_before
+    tiers.fail = False
+
+    # ...and a fully-pinned MATCH stops cleanly at zero (a mutant that
+    # reads the capacity guard wrong walks into _take_page's trap)
+    hist, none = alloc4.match_prefix(prompt13)
+    assert hist == 0 and none == []
+
+    # a TIER-LESS allocator's match breaks at the first uncached chunk
+    # even with free pages in hand (the tier walk must be unreachable
+    # without a client — reaching it here is an attribute error)
+    plain = PA(num_pages=8, page_size=4, max_slots=2, max_pages_per_slot=4)
+    assert plain.allocate_slot(0, 9)
+    plain.register_prefix(0, prompt)
+    hist, partial = plain.match_prefix([1, 2, 3, 4, 90, 91, 92, 93, 94])
+    assert hist == 4 and len(partial) == 1
+    plain.release_prefix(partial)
+
+    # first registration of a chain key WINS: a later identical prompt's
+    # pages stay private (a mutant that re-registers would swap the
+    # cached chain onto the newer slot's pages)
+    first_pages = list(plain._slots[0][:2])
+    assert plain.allocate_slot(1, 9)
+    plain.register_prefix(1, prompt)
+    hist, m = plain.match_prefix(prompt)
+    assert hist == 8 and m == first_pages
+    plain.release_prefix(m)
+
+    # the empty-pool bug trap: _take_page with nothing free and nothing
+    # evictable must raise, not hand out a phantom page
+    boom = PA(num_pages=2, page_size=4, max_slots=1, max_pages_per_slot=4,
+              tiers=tiers)
+    assert boom.allocate_slot(0, 4)                # the only usable page
+    try:
+        boom._take_page()
+        raise AssertionError("exhausted pool handed out a phantom page")
+    except RuntimeError:
+        pass
+
+
 # ------------------------------------------------------------ eventstream
 
 def eventstream_oracle(mod: types.ModuleType) -> None:
@@ -1111,7 +1312,8 @@ TARGETS: dict[str, MutationTarget] = {
         oracle=lambda mod: (page_allocator_oracle(mod),
                             _avg_slot_pages_spec(mod),
                             _dirty_tracking_spec(mod),
-                            _pregrant_block_spec(mod)),
+                            _pregrant_block_spec(mod),
+                            _prefix_tier_spec(mod)),
         class_name="PageAllocator",
         # _take_page's `key is not None and _cached.get(key) == page` —
         # register_prefix maintains _page_key[page] == key iff

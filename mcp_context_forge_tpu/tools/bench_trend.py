@@ -122,27 +122,33 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
                              if not payloads else "single capture")
         return result
     result["latest"] = os.path.basename(payloads[-1][1])
-    # partition by super-step arm: captures self-describe their fused-K
-    # via the "superstep" field (absent/1 = the classic one-token step),
-    # and a K=8 arm's tok/s must only be judged against K=8 history —
-    # comparing across K would read the fusion win itself as an outlier
-    # baseline and every later unfused capture as a regression
-    groups: dict[int, list[tuple[int, str, dict[str, Any]]]] = {}
+    # partition by arm: captures self-describe their fused-K via the
+    # "superstep" field (absent/1 = the classic one-token step) and
+    # their tiered-prefix-cache mode via "prefix_tiers" — a K=8 arm's
+    # tok/s must only be judged against K=8 history, and a BENCH_PREFIX_
+    # TIERS capture's pressure workload only against tier history —
+    # comparing across arms would read the optimization win itself as an
+    # outlier baseline and every later plain capture as a regression
+    groups: dict[tuple[int, bool],
+                 list[tuple[int, str, dict[str, Any]]]] = {}
     for item in payloads:
-        groups.setdefault(int(item[2].get("superstep") or 1),
+        groups.setdefault((int(item[2].get("superstep") or 1),
+                           bool(item[2].get("prefix_tiers"))),
                           []).append(item)
-    for k_steps, group in sorted(groups.items()):
+    for (k_steps, tiers), group in sorted(groups.items()):
         if len(group) < 2:
             # a new arm's first capture has no history yet — surface it
             # (a silent zero-check pass would hide the round where the
             # fused path's numbers first land, the vacuous-pass class)
             result.setdefault("new_arms", []).append(
-                {"superstep": k_steps,
+                {"superstep": k_steps, "prefix_tiers": tiers,
                  "capture": os.path.basename(group[-1][1])})
             continue
         latest_round, latest_path, latest = group[-1]
         history = group[:-1]
         arm = "" if k_steps == 1 else f"@superstep={k_steps}"
+        if tiers:
+            arm += "@tiers"
         for key, higher_better in _GATES[latest.get("metric")]:
             latest_val = latest.get(key)
             prior = [p.get(key) for _rnd, _path, p in history
@@ -226,8 +232,9 @@ def main(argv: list[str] | None = None) -> int:
                       f"({result['skipped']})")
                 continue
             for arm in result.get("new_arms", ()):
+                tiers = "@tiers" if arm.get("prefix_tiers") else ""
                 print(f"bench-trend: {result['series']}"
-                      f"@superstep={arm['superstep']}: first capture "
+                      f"@superstep={arm['superstep']}{tiers}: first capture "
                       f"({arm['capture']}) — no history to gate yet")
             for check in result["checks"]:
                 arrow = "REGRESSED" if check["regressed"] else "ok"

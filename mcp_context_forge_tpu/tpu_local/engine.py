@@ -111,6 +111,25 @@ class EngineConfig:
     # prefix cache: reuse resident KV pages for shared full-page prompt
     # prefixes; only each request's suffix pays prefill (vLLM APC analog)
     prefix_cache: bool = True
+    # tiered prefix/KV cache (kv/tiers.py, docs/kv_tiering.md): evicted
+    # prefix pages SPILL to a bounded host-RAM store (int8 bytes +
+    # per-(layer, kv-head) scales; quantize-on-spill under a bf16 pool)
+    # with a disk write-behind tier below it, and admission restores
+    # tier-resident chain pages into HBM on match (fetch-on-miss). Under
+    # an EnginePool the store + prefix index are POOL-SHARED, so a
+    # prefix prefilled on any replica serves a hit on every replica.
+    # Requires prefix_cache.
+    prefix_tiers: bool = False
+    tier_host_bytes: int = 256 * 1024 * 1024   # T1 (host RAM) byte budget
+    tier_disk_bytes: int = 1024 * 1024 * 1024  # T2 (disk) byte budget; 0 = off
+    tier_disk_dir: str = ""                    # "" = private tempdir
+    # spill storage mode for FULL-PRECISION pools: "int8" (default)
+    # quantizes on spill — 2-4x cheaper tiers, restored pages carry the
+    # same small greedy drift as resident int8 KV — or "" to spill in
+    # resident precision (lossless round trip, byte-identical
+    # continuations guaranteed). An int8-resident pool always spills its
+    # bytes verbatim (bit-exact) regardless of this knob.
+    tier_spill_quant: str = "int8"
     # speculative decoding via prompt-lookup (n-gram) drafting: decode is
     # HBM-bandwidth-bound (one full param read per step), so verifying
     # spec_k drafted tokens in ONE step multiplies tokens/step by the
@@ -216,6 +235,14 @@ class EngineConfig:
             warmup_mode=getattr(settings, "tpu_local_warmup_mode", "full"),
             compile_cache_dir=getattr(settings, "tpu_local_compile_cache_dir", ""),
             prefix_cache=getattr(settings, "tpu_local_prefix_cache", True),
+            prefix_tiers=getattr(settings, "tpu_local_prefix_tiers", False),
+            tier_host_bytes=getattr(
+                settings, "tpu_local_tier_host_bytes", 256 * 1024 * 1024),
+            tier_disk_bytes=getattr(
+                settings, "tpu_local_tier_disk_bytes", 1024 * 1024 * 1024),
+            tier_disk_dir=getattr(settings, "tpu_local_tier_disk_dir", ""),
+            tier_spill_quant=getattr(
+                settings, "tpu_local_tier_spill_quant", "int8"),
             spec_decode=getattr(settings, "tpu_local_spec_decode", False),
             spec_k=getattr(settings, "tpu_local_spec_k", 4),
             spec_ngram=getattr(settings, "tpu_local_spec_ngram", 2),
@@ -414,7 +441,8 @@ class TPUEngine:
     _STOP_TBL_WIDTH = 4
 
     def __init__(self, config: EngineConfig, tracer=None, metrics=None,
-                 devices: list | None = None, ledger=None):
+                 devices: list | None = None, ledger=None,
+                 tier_store=None, prefix_index=None):
         # telemetry handles are optional: None means zero-cost no-ops, so
         # unit tests and benches constructing engines directly pay nothing
         self.tracer = tracer
@@ -447,7 +475,37 @@ class TPUEngine:
             raise ValueError(f"spec_k must be >= 2, got {config.spec_k}")
         if config.spec_decode and config.spec_ngram < 1:
             raise ValueError(f"spec_ngram must be >= 1, got {config.spec_ngram}")
+        if config.prefix_tiers and not config.prefix_cache:
+            raise ValueError("prefix_tiers requires prefix_cache (the tiers "
+                             "spill and restore prefix-cache pages)")
+        if config.tier_spill_quant not in ("", "int8"):
+            raise ValueError(f"unsupported tier_spill_quant mode "
+                             f"{config.tier_spill_quant!r}")
         self.config = config
+        # tiered prefix cache (kv/tiers.py): bind to the POOL-SHARED
+        # store/index when an EnginePool passed them, else own a private
+        # store (standalone engine). A client with only an index still
+        # publishes HBM residency so the pool router can score affinity
+        # across replicas even with the spill tiers off.
+        self._owned_tier_store = None
+        self._tier_client = None
+        if config.prefix_cache and (config.prefix_tiers
+                                    or prefix_index is not None
+                                    or tier_store is not None):
+            from .kv.tiers import TierClient, TieredPageStore
+            store = tier_store
+            if store is None and config.prefix_tiers:
+                store = TieredPageStore(
+                    host_bytes=config.tier_host_bytes,
+                    disk_bytes=config.tier_disk_bytes,
+                    disk_dir=config.tier_disk_dir,
+                    index=prefix_index, metrics=metrics)
+                self._owned_tier_store = store
+            self._tier_client = TierClient(config.replica_id, store=store,
+                                           index=prefix_index,
+                                           metrics=metrics)
+        # dispatch-side export snapshot for the per-tier hit counters
+        self._tier_hits_exported: dict[str, int] = {}  # lint: thread[dispatch]
         # the fused super-step width every decode dispatch scans over
         # (1 = the classic one-token step); resolved once — the compiled
         # grid is keyed on it
@@ -644,8 +702,107 @@ class TPUEngine:
         self._prefill_hist_fns: dict[int, Any] = {}
         self._verify_fns: dict[int, Any] | None = (
             {} if config.spec_decode else None)
+        # spill-tier device I/O (one compiled scatter/gather per direction;
+        # the page index rides as a traced scalar so every page shares it)
+        self._tier_read_fn = None
+        self._tier_write_fn = None
+        if self._tier_client is not None and self._tier_client.store is not None:
+            self._build_tier_fns()
+            self._tier_client.read_fn = self._read_page_payload
+            self._tier_client.write_fn = self._upload_page
         if config.warmup:
             self.warmup()
+
+    def _build_tier_fns(self) -> None:
+        """Jitted device I/O for the spill tiers: a one-page device->host
+        read (quantize-on-spill under a bf16/f32 pool — the same int8 +
+        per-(layer, kv-head) running-max scheme the resident int8 mode
+        uses; an int8 pool spills its resident bytes + scales verbatim,
+        so its T1/T2 round trip is bit-exact) and the inverse host->device
+        upload (dequantize-on-restore for full-precision pools). Warmup
+        exercises both so a first spill/restore mid-traffic never
+        compiles on the serving path."""
+        from .quantize import kv_dequantize, kv_int8_scale, kv_quantize
+
+        if self.config.kv_quant == "int8":
+            def read(kv, idx):
+                return (kv.k_pages[:, idx], kv.v_pages[:, idx],
+                        kv.k_scales[:, idx].astype(jnp.float32),
+                        kv.v_scales[:, idx].astype(jnp.float32))
+
+            def write(kv, idx, k, v, ks, vs):
+                return kv._replace(
+                    k_pages=kv.k_pages.at[:, idx].set(k),
+                    v_pages=kv.v_pages.at[:, idx].set(v),
+                    k_scales=kv.k_scales.at[:, idx].set(
+                        ks.astype(kv.k_scales.dtype)),
+                    v_scales=kv.v_scales.at[:, idx].set(
+                        vs.astype(kv.v_scales.dtype)))
+        elif self.config.tier_spill_quant == "":
+            # resident-precision spill (tier_spill_quant=""): payloads
+            # carry the page values as float32 (a lossless container for
+            # bf16/f32 residents), so the round trip is byte-identical
+            # at 2-4x the tier footprint of int8
+            def read(kv, idx):
+                scales = jnp.ones(
+                    (kv.k_pages.shape[0], kv.k_pages.shape[3]), jnp.float32)
+                return (kv.k_pages[:, idx].astype(jnp.float32),
+                        kv.v_pages[:, idx].astype(jnp.float32),
+                        scales, scales)
+
+            def write(kv, idx, k, v, ks, vs):
+                dt = kv.k_pages.dtype
+                return kv._replace(
+                    k_pages=kv.k_pages.at[:, idx].set(k.astype(dt)),
+                    v_pages=kv.v_pages.at[:, idx].set(v.astype(dt)))
+        else:
+            def _quant(page):  # [L, page, KV, hd] -> (int8, [L, KV] scales)
+                amax = jnp.max(jnp.abs(page.astype(jnp.float32)),
+                               axis=(1, 3))
+                scales = kv_int8_scale(amax)
+                return (kv_quantize(page, scales[:, None, :, None]),
+                        scales.astype(jnp.float32))
+
+            def read(kv, idx):
+                kq, ks = _quant(kv.k_pages[:, idx])
+                vq, vs = _quant(kv.v_pages[:, idx])
+                return kq, vq, ks, vs
+
+            def write(kv, idx, k, v, ks, vs):
+                dt = kv.k_pages.dtype
+                return kv._replace(
+                    k_pages=kv.k_pages.at[:, idx].set(
+                        kv_dequantize(k, ks[:, None, :, None], dt)),
+                    v_pages=kv.v_pages.at[:, idx].set(
+                        kv_dequantize(v, vs[:, None, :, None], dt)))
+
+        self._tier_read_fn = jax.jit(read)
+        self._tier_write_fn = jax.jit(write, donate_argnames=("kv",))
+
+    def _read_page_payload(self, page: int):
+        """Device->host read of one prefix page for spilling. Dispatch
+        thread only; runs at eviction time (admission/grow under page
+        pressure), and the payload must leave HBM before the page's new
+        tenant overwrites it."""
+        from .kv.tiers import SpilledPage
+        out = self._tier_read_fn(self.kv, jnp.asarray(page, jnp.int32))
+        k, v, ks, vs = jax.device_get(out)  # lint: allow[host-sync-in-hot-path] spill-on-evict: the evicted page's bytes must be read before its new tenant overwrites them
+        return SpilledPage(chunk=(), parent=b"", k=np.asarray(k),
+                           v=np.asarray(v), k_scales=np.asarray(ks),
+                           v_scales=np.asarray(vs))
+
+    def _upload_page(self, page: int, payload) -> None:
+        """Host->device upload of a restored page into this replica's
+        pool (fetch-on-miss inside the admission allocate path; dispatch
+        thread, pipeline already drained by the admission barrier)."""
+        # np.asarray normalizes pinned-host payloads too: every call sees
+        # the same (shape, dtype, uncommitted-numpy) signature, so the
+        # warmup-compiled executable serves all of them (zero mid-traffic
+        # compiles — the pool wedge monitor depends on that invariant)
+        self.kv = self._tier_write_fn(
+            self.kv, jnp.asarray(page, jnp.int32),
+            np.asarray(payload.k), np.asarray(payload.v),
+            np.asarray(payload.k_scales), np.asarray(payload.v_scales))
 
     def _init_kv(self) -> None:
         """(Re)build the KV pool + allocator on the mesh — used at
@@ -694,8 +851,18 @@ class TPUEngine:
                 dtype=self._kv_dtype, quant=config.kv_quant),
                 out_shardings=kv_shardings)
             self.kv = kv_init()
+        if self._tier_client is not None:
+            # a rebuilt pool (crash restart, reload) invalidates every
+            # resident page — stale HBM locations in the pool index would
+            # mis-route until they aged out
+            self._tier_client.drop_replica()
+        # the fresh allocator's tier counters restart at zero: the delta
+        # snapshot must too, or post-rebuild hits are swallowed until the
+        # new totals pass the old ones (counters would silently flatline)
+        self._tier_hits_exported.clear()
         self.allocator = PageAllocator(self.num_kv_pages, config.page_size,
-                                       config.max_batch, max_pages_per_slot)
+                                       config.max_batch, max_pages_per_slot,
+                                       tiers=self._tier_client)
 
     def _ctx_buckets(self) -> list[int]:
         """The page-width buckets decode compiles for: powers of two from
@@ -886,6 +1053,15 @@ class TPUEngine:
             del _k1, _k2
             jax.device_put(self.allocator.tables(),
                            self.kv.block_tables.sharding)
+            if self._tier_read_fn is not None:
+                # spill/restore executables: compile both directions now
+                # (against the trash page — contents are zeros either
+                # way) so eviction-under-pressure and fetch-on-miss never
+                # compile on the serving path
+                idx = jnp.asarray(0, jnp.int32)
+                spilled = jax.device_get(self._tier_read_fn(self.kv, idx))
+                self.kv = self._tier_write_fn(self.kv, idx, *spilled)
+                shapes += 1
             for bucket in self.config.prefill_buckets:
                 use_sp = (self._prefill_sample_sp is not None
                           and bucket > self.config.sp_threshold)
@@ -1178,6 +1354,7 @@ class TPUEngine:
 
     async def stop(self) -> None:
         if not self._started:
+            self._close_owned_tiers()
             return
         self._started = False
         self._stop_event.set()
@@ -1190,6 +1367,17 @@ class TPUEngine:
                              "engine restart refused until it exits")
                 return  # keep self._thread so start() refuses a double-start
         self._thread = None
+        self._close_owned_tiers()
+
+    def _close_owned_tiers(self) -> None:
+        """Shut down a standalone engine's private spill store (its
+        write-behind worker + tempdir). Pool-shared stores are closed by
+        the pool, which outlives every replica engine."""
+        if self._owned_tier_store is not None:
+            self._owned_tier_store.close()
+            self._owned_tier_store = None
+            if self._tier_client is not None:
+                self._tier_client.store = None
 
     def kill(self) -> None:
         """Signal the dispatch thread to stop WITHOUT joining it.
@@ -2636,6 +2824,32 @@ class TPUEngine:
                     tokens / (rate_ms / 1e3))
             if superstep is not None and tokens:
                 m.llm_tokens_per_dispatch.labels(replica=rid).set(tokens)
+            if self._tier_client is not None:
+                self._export_tier_metrics(m, rid)
+
+    def _export_tier_metrics(self, m, rid: str) -> None:
+        """Per-tier prefix counters/gauges (dispatch thread, piggybacked
+        on the per-step gauge refresh): hit counters export as deltas
+        from the allocator's consume-site totals; byte gauges report HBM
+        residency per replica and the shared store's host/disk footprint
+        (pool-shared, so every replica's child reports the same store
+        number — read one, don't sum)."""
+        alloc = self.allocator
+        for tier, count in alloc.tier_hits.items():
+            prev = self._tier_hits_exported.get(tier, 0)
+            if count > prev:
+                m.llm_prefix_tier_hits.labels(replica=rid, tier=tier).inc(
+                    count - prev)
+                self._tier_hits_exported[tier] = count
+        m.llm_prefix_tier_bytes.labels(replica=rid, tier="hbm").set(
+            alloc.cached_pages * self._kv_page_bytes)
+        store = self._tier_client.store
+        if store is not None:
+            s = store.stats()
+            m.llm_prefix_tier_bytes.labels(replica=rid, tier="host").set(
+                s["host_bytes"])
+            m.llm_prefix_tier_bytes.labels(replica=rid, tier="disk").set(
+                s["disk_bytes"])
 
     def recent_steps(self, limit: int | None = None) -> list[dict[str, Any]]:
         """Last N step summaries, oldest first (diagnostics surface)."""
@@ -2833,6 +3047,20 @@ class TPUEngine:
         """HBM bytes the in-use KV pages occupy under the active storage
         dtype (int8 pages cost half their bf16 twin plus a scale sliver)."""
         return self.allocator.pages_in_use * self._kv_page_bytes
+
+    def tier_stats(self) -> dict[str, Any] | None:
+        """Tiered-prefix-cache snapshot for the stats/pool/admin
+        surfaces: per-tier hit split (consume-site, conserves against
+        prefix_hit_tokens), spill/restore counts + restore p95, and the
+        shared store's per-tier footprint. None when no tier client is
+        wired (prefix_tiers off AND no pool index)."""
+        if self._tier_client is None:
+            return None
+        out = self._tier_client.stats()
+        out["enabled"] = self._tier_client.store is not None
+        out["hits"] = dict(self.allocator.tier_hits)
+        out["hit_tokens"] = dict(self.allocator.tier_hit_tokens)
+        return out
 
     def kv_bytes_capacity(self) -> int:
         """HBM bytes the whole KV pool occupies (fixed at construction)."""

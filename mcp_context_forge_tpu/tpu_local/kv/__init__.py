@@ -1,8 +1,9 @@
-"""Paged KV cache."""
+"""Paged KV cache (+ spill tiers and the pool-global prefix index)."""
 
 from .paged_cache import (
     PagedKVState,
     PageAllocator,
+    PrefixEvictionPolicy,
     init_kv_state,
     kv_page_bytes,
     num_pages_for_budget,
@@ -11,7 +12,12 @@ from .paged_cache import (
     gather_kv,
     kv_logical,
 )
+from .prefix_index import PrefixIndex, chain_hash, chain_hashes
+from .tiers import SpilledPage, TierClient, TieredPageStore
 
-__all__ = ["PagedKVState", "PageAllocator", "init_kv_state", "kv_page_bytes",
+__all__ = ["PagedKVState", "PageAllocator", "PrefixEvictionPolicy",
+           "init_kv_state", "kv_page_bytes",
            "num_pages_for_budget", "write_prefill_kv", "write_decode_kv",
-           "gather_kv", "kv_logical"]
+           "gather_kv", "kv_logical",
+           "PrefixIndex", "chain_hash", "chain_hashes",
+           "SpilledPage", "TierClient", "TieredPageStore"]

@@ -270,6 +270,44 @@ def gather_kv(kv: PagedKVState, layer: int, slot_ids: jax.Array,
     return k.reshape(B, P * page, KV, hd), v.reshape(B, P * page, KV, hd)
 
 
+class PrefixEvictionPolicy:
+    """Eviction order over the ref==0 resident prefix pages: LRU by LAST
+    MATCH. A page leaves the policy when a match re-references it (pin
+    counts — the refcounts — protect every in-flight span by
+    construction: referenced pages are simply never candidates) and
+    re-enters at the MRU end when the last reference drops, so the
+    victim is always the resident page whose prefix went unmatched the
+    longest. Dict-shaped on purpose: the allocator (and tests) treat it
+    as the old ``_lru`` ordered-dict."""
+
+    def __init__(self) -> None:
+        self._order: dict[int, None] = {}
+
+    def add(self, page: int) -> None:
+        """(Re-)admit a ref==0 resident page at the MRU end."""
+        self._order.pop(page, None)
+        self._order[page] = None
+
+    def discard(self, page: int) -> None:
+        self._order.pop(page, None)
+
+    def pop(self, page: int, default=None):
+        return self._order.pop(page, default)
+
+    def victim(self) -> int | None:
+        """The LRU-by-last-match page, or None when nothing is evictable."""
+        return next(iter(self._order)) if self._order else None
+
+    def __contains__(self, page: int) -> bool:
+        return page in self._order
+
+    def __len__(self) -> int:
+        return len(self._order)
+
+    def __iter__(self):
+        return iter(self._order)
+
+
 class PageAllocator:
     """Host-side page bookkeeping: refcounted free list + per-slot
     assignment + prefix cache.
@@ -282,26 +320,45 @@ class PageAllocator:
     key (parent_key, page_tokens), so a later prompt sharing the prefix
     reuses the resident pages and only its suffix is prefilled. Pages are
     refcounted across slots; cached pages whose refcount drops to 0 stay
-    resident on an LRU until allocation pressure evicts them. A matched
-    page is immutable by construction — matches cover only positions
-    strictly before the new prompt's last token, and decode writes start
-    at the prompt's end."""
+    resident under the eviction policy (LRU-by-last-match) until
+    allocation pressure reclaims them. A matched page is immutable by
+    construction — matches cover only positions strictly before the new
+    prompt's last token, and decode writes start at the prompt's end.
+
+    Tiers (``tiers.py`` + ``prefix_index.py``, attach via ``self.tiers``):
+    with a :class:`~.tiers.TierClient` wired, eviction SPILLS the page's
+    bytes to the pool-shared host/disk store instead of dropping them,
+    and ``probe_prefix``/``match_prefix`` extend past the local HBM walk
+    by RESTORING tier-resident chain pages into freshly taken pages
+    (fetch-on-miss) — so a prefix prefilled on any replica, then evicted
+    anywhere, still serves a hit here. Restored pages register into the
+    local cache and count toward ``prefix_hit_tokens`` at the same
+    consume site as resident hits (the tenant-ledger ``cache_hit``
+    conservation contract is unchanged)."""
 
     def __init__(self, num_pages: int, page_size: int, max_slots: int,
-                 max_pages_per_slot: int):
+                 max_pages_per_slot: int, tiers=None):
         import numpy as np
         self.num_pages = num_pages
         self.page_size = page_size
         self.max_slots = max_slots
         self.max_pages_per_slot = max_pages_per_slot
+        self.tiers = tiers                              # TierClient | None
         self._free = list(range(num_pages - 1, 0, -1))  # page 0 reserved
         self._slots: dict[int, list[int]] = {}
         self._ref: dict[int, int] = {}                  # page -> live refs
         self._cached: dict[tuple, int] = {}             # chain key -> page
         self._page_key: dict[int, tuple] = {}           # page -> chain key
-        self._lru: dict[int, None] = {}                 # ref==0 resident pages
+        self._page_hash: dict[int, tuple] = {}          # page -> (hash, parent)
+        self._lru = PrefixEvictionPolicy()              # ref==0 resident pages
+        # provenance of pages restored from a spill tier, consumed (and
+        # cleared) when a successful allocate takes the hit — the per-tier
+        # split of prefix_hit_tokens
+        self._restored_tier: dict[int, str] = {}
         self.prefix_hits = 0
         self.prefix_hit_tokens = 0
+        self.tier_hits = {"hbm": 0, "host": 0, "disk": 0}
+        self.tier_hit_tokens = {"hbm": 0, "host": 0, "disk": 0}
         # monotonic high-water mark of pages_in_use (benches/telemetry):
         # a rolling step ring under-reports peaks on long runs
         self.peak_pages_in_use = 0
@@ -353,16 +410,36 @@ class PageAllocator:
         return self.pages_needed(n_tokens) <= self.free_pages
 
     def _take_page(self) -> int:
-        """A writable page: prefer truly-free, else evict the LRU-oldest
-        resident cache page."""
+        """A writable page: prefer truly-free, else reclaim the eviction
+        policy's victim (LRU-by-last-match). With a tier client wired,
+        a reclaimed prefix page SPILLS its bytes to the shared host/disk
+        store on the way out instead of dropping them."""
         if self._free:
             return self._free.pop()
-        page = next(iter(self._lru))
-        del self._lru[page]
+        page = self._lru.victim()
+        if page is None:  # callers gate on free_pages; this is a bug trap
+            raise RuntimeError("page pool exhausted with nothing evictable")
+        self._lru.discard(page)
         key = self._page_key.pop(page, None)
         if key is not None and self._cached.get(key) == page:
             del self._cached[key]
+            self._evict_page(page, key)
+        self._page_hash.pop(page, None)
+        self._restored_tier.pop(page, None)
         return page
+
+    def _evict_page(self, page: int, key: tuple) -> None:
+        """Spill-instead-of-drop: hand the evicted page's bytes to the
+        tier store (device read runs on the calling dispatch thread) and
+        move its index residency HBM -> tier."""
+        tiers = self.tiers
+        if tiers is None:
+            return
+        hashed = self._page_hash.get(page)
+        if hashed is not None:
+            key_hash, parent = hashed
+            tiers.spill(key_hash, parent, key[1], page)
+            tiers.unpublish_hbm(key_hash)
 
     def _release_page(self, page: int) -> None:
         # defensive default: the allocate/extend/match paths always set a
@@ -373,7 +450,7 @@ class PageAllocator:
             return
         del self._ref[page]
         if page in self._page_key:       # registered prefix page: keep warm
-            self._lru[page] = None
+            self._lru.add(page)          # MRU end: LRU-by-last-match order
         else:
             self._free.append(page)
 
@@ -395,22 +472,108 @@ class PageAllocator:
             pages.append(page)
         return pages
 
+    def _chain_steps(self, prompt_ids: list[int], full: bool = False):
+        """Yield ``(key, key_hash, parent_hash, chunk)`` per full page of
+        the prompt (depth order) — the MATCHABLE pages by default (a
+        match never covers the last token), or every full page with
+        ``full=True`` (the registration walk: a prompt ending exactly on
+        a page boundary registers its final page too, for longer prompts
+        to share). Hashes come from prefix_index.chain_hash so the
+        allocator, the tier store, and the pool index all speak one
+        chain identity."""
+        from .prefix_index import ROOT_HASH, chain_hash
+        if full:
+            max_pages = len(prompt_ids) // self.page_size
+        else:
+            max_pages = max(0, (len(prompt_ids) - 1) // self.page_size)
+        key: tuple = ()
+        parent = ROOT_HASH
+        for i in range(max_pages):
+            chunk = tuple(prompt_ids[i * self.page_size:(i + 1) * self.page_size])
+            key = (key, chunk)
+            key_hash = chain_hash(parent, chunk)
+            yield key, key_hash, parent, chunk
+            parent = key_hash
+
     def probe_prefix(self, prompt_ids: list[int]) -> int:
-        """Read-only: tokens a match WOULD cover (used for bucket sizing).
-        Takes no references, so probing can never pin pages — the real
-        match happens at admission via match_prefix."""
-        return len(self._walk_prefix(prompt_ids)) * self.page_size
+        """Read-only: tokens a match WOULD cover (used for bucket sizing
+        and router affinity). Takes no references, so probing can never
+        pin pages — the real match happens at admission via
+        match_prefix. With tiers wired the walk continues past the local
+        HBM chain through tier-resident pages, capped at the restore
+        capacity currently available (free + evictable pages): the probe
+        must never promise a hist the match cannot restore, or admission
+        would livelock re-probing the same prompt."""
+        if self.tiers is None or not self.tiers.active:
+            return len(self._walk_prefix(prompt_ids)) * self.page_size
+        n = 0
+        restorable = self.free_pages
+        for key, key_hash, _parent, _chunk in self._chain_steps(prompt_ids):
+            page = self._cached.get(key)
+            if page is not None:
+                if page in self._lru:
+                    # matching PINS a ref==0 resident page (it leaves the
+                    # eviction policy), consuming one unit of the same
+                    # capacity later restores draw from — not modeling
+                    # that promises a hist the match cannot deliver and
+                    # admission livelocks re-probing it
+                    restorable -= 1
+                n += 1
+            elif restorable > 0 and self.tiers.probe(key_hash):
+                n += 1
+                restorable -= 1
+            else:
+                break
+        return n * self.page_size
 
     def match_prefix(self, prompt_ids: list[int]) -> tuple[int, list[int]]:
         """Longest cached full-page prefix of ``prompt_ids``.
 
         Returns (n_tokens_matched, pages) and takes a REFERENCE on every
         matched page (caller must either assign them to a slot or call
-        release_prefix)."""
-        pages = self._walk_prefix(prompt_ids)
-        for page in pages:
-            self._ref[page] = self._ref.get(page, 0) + 1
-            self._lru.pop(page, None)
+        release_prefix). With tiers wired, chain pages missing from HBM
+        but present in the shared spill store are RESTORED here
+        (fetch-on-miss): a fresh page is taken (evicting — and spilling —
+        colder pages if needed), the verified payload uploads into this
+        replica's HBM, and the page registers into the local cache so
+        later matches treat it as resident. A failed restore (payload
+        gone, hash collision, pool dry) ends the match at the pages
+        already secured."""
+        if self.tiers is None:  # hash-free fast path (tier-less default)
+            # behaviorally identical to the chain walk below minus the
+            # per-chunk sha256 the tier identity needs — the default
+            # config must not pay hashing on the admission hot path
+            pages = self._walk_prefix(prompt_ids)
+            for page in pages:
+                self._ref[page] = self._ref.get(page, 0) + 1
+                self._lru.pop(page, None)
+            self._track_peak()
+            return len(pages) * self.page_size, pages
+        tiered = self.tiers.active
+        pages: list[int] = []
+        for key, key_hash, parent, chunk in self._chain_steps(prompt_ids):
+            page = self._cached.get(key)
+            if page is not None:
+                self._ref[page] = self._ref.get(page, 0) + 1
+                self._lru.pop(page, None)
+                pages.append(page)
+                continue
+            if not tiered or not (self._free or len(self._lru)):
+                break
+            if not self.tiers.probe(key_hash):
+                break
+            page = self._take_page()
+            tier = self.tiers.restore(key_hash, parent, chunk, page)
+            if tier is None:
+                self._free.append(page)   # miss/collision: hand it back
+                break
+            self._ref[page] = 1
+            self._cached[key] = page
+            self._page_key[page] = key
+            self._page_hash[page] = (key_hash, parent)
+            self._restored_tier[page] = tier
+            self.tiers.publish_hbm(key_hash)
+            pages.append(page)
         self._track_peak()  # re-referencing LRU pages raises pages_in_use
         return len(pages) * self.page_size, pages
 
@@ -420,22 +583,24 @@ class PageAllocator:
             self._release_page(page)
 
     def register_prefix(self, slot: int, prompt_ids: list[int]) -> None:
-        """Register the slot's full prompt pages for future reuse. First
-        registration of a chain key wins; later identical pages stay
-        private and simply free when their slot does."""
+        """Register the slot's full prompt pages for future reuse (and
+        publish their HBM residency to the pool index when one is
+        wired). First registration of a chain key wins; later identical
+        pages stay private and simply free when their slot does."""
         pages = self._slots.get(slot, [])
-        n_full = len(prompt_ids) // self.page_size
-        key: tuple = ()
-        for i in range(min(n_full, len(pages))):
-            chunk = tuple(prompt_ids[i * self.page_size:(i + 1) * self.page_size])
-            key = (key, chunk)
+        for i, (key, key_hash, parent, _chunk) in enumerate(
+                self._chain_steps(prompt_ids, full=True)):
+            if i >= len(pages):
+                break
             page = pages[i]
-            if key in self._cached:
-                continue
-            if page in self._page_key:   # already registered under another key
-                continue
-            self._cached[key] = page
-            self._page_key[page] = key
+            if key not in self._cached and page not in self._page_key:
+                # (a page already registered under another key stays
+                # private and simply frees with its slot)
+                self._cached[key] = page
+                self._page_key[page] = key
+                self._page_hash[page] = (key_hash, parent)
+                if self.tiers is not None:
+                    self.tiers.publish_hbm(key_hash)
 
     # -------------------------------------------------------------- slot pages
 
@@ -452,6 +617,13 @@ class PageAllocator:
         if shared:  # hits are counted when the match is CONSUMED, not probed
             self.prefix_hits += 1
             self.prefix_hit_tokens += len(shared) * self.page_size
+            for page in shared:
+                # per-tier split of the SAME consume event: pages restored
+                # from a spill tier carry their provenance until first
+                # consumed, resident pages count as hbm
+                tier = self._restored_tier.pop(page, "hbm")
+                self.tier_hits[tier] += 1
+                self.tier_hit_tokens[tier] += self.page_size
         pages = list(shared)
         for _ in range(fresh):
             page = self._take_page()

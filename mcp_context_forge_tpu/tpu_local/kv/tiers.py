@@ -1,0 +1,546 @@
+"""Spill tiers for the prefix/KV page cache: pinned host RAM -> disk.
+
+The HBM prefix cache (``PageAllocator``) evicts cold pages under
+allocation pressure; with a :class:`TieredPageStore` attached, eviction
+SPILLS instead of drops:
+
+- **T1 (host)**: evicted pages land in a bounded host-RAM map as int8
+  bytes + per-(layer, kv-head) dequant scales. Engines whose resident
+  pool is already int8 spill their bytes verbatim (a T1/T2 round trip is
+  bit-exact); bf16 pools quantize-on-spill (int8 + running-max page
+  scale — the same scheme the resident int8 mode uses, whose greedy
+  parity is pinned in tests). On TPU the arrays are committed to pinned
+  host memory when the runtime supports it, so the restore's host->HBM
+  upload DMAs without a bounce copy; everywhere else they are plain
+  numpy.
+- **T2 (disk)**: when T1 overflows its byte budget, the oldest entries
+  hand off to a write-behind worker thread (the ``spill`` lint-thread
+  context) that persists them as ``.npz`` files under a bounded disk
+  budget. Entries stay readable throughout (the pending map serves reads
+  until the file lands). A T2 hit at match time re-onlines the payload
+  into T1 on its way back to HBM.
+
+The store is POOL-SHARED: every replica spills into and restores from
+the same instance, which is what makes admission-time **fetch-on-miss**
+work across replicas — a prefix prefilled (then evicted) on replica 1
+restores into replica 0's HBM inside replica 0's allocate path. The
+pool-global :class:`~.prefix_index.PrefixIndex` learns tier residency
+from the store (publish/unpublish on every transition) so the router
+can score tier hits as affinity.
+
+Collision safety: entries are keyed by the 32-byte chain hash, but every
+payload carries its exact page tokens + parent hash, and ``get``
+verifies both against the requester's expectation. A colliding key can
+therefore only produce a MISS, never wrong pages.
+
+Thread model: ``put``/``get``/``probe`` run on engine dispatch threads
+(admission/eviction); the write-behind loop owns the disk state
+(``# lint: thread[spill]``), with the store lock legalizing the
+cross-thread handoffs; the router reads only the index, never the store.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import shutil
+import tempfile
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Any, Callable, Sequence
+
+import numpy as np
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from .prefix_index import PrefixIndex
+
+logger = logging.getLogger(__name__)
+
+TIERS = ("hbm", "host", "disk")
+
+
+@dataclass
+class SpilledPage:
+    """One spilled prefix page: int8 K/V bytes + per-(layer, kv-head)
+    dequant scales, plus the identity evidence ``get`` verifies."""
+
+    chunk: tuple[int, ...]     # the page's exact prompt tokens
+    parent: bytes              # parent chain hash (prefix_index.chain_hash)
+    k: np.ndarray              # [L, page, KV, hd] int8
+    v: np.ndarray              # [L, page, KV, hd] int8
+    k_scales: np.ndarray       # [L, KV] float32
+    v_scales: np.ndarray       # [L, KV] float32
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.k.nbytes + self.v.nbytes
+                   + self.k_scales.nbytes + self.v_scales.nbytes)
+
+
+def pin_host(arr: np.ndarray) -> Any:
+    """Best-effort pinned-host placement of a spill buffer (TPU runtimes
+    DMA from pinned memory without a bounce copy). Returns the input
+    unchanged when the backend has no pinned_host space (CPU tests) —
+    the store treats the result as array-like either way."""
+    try:  # pragma: no cover - exercised only on TPU runtimes
+        import jax
+
+        device = jax.local_devices()[0]
+        if device.platform != "tpu":
+            return arr
+        sharding = jax.sharding.SingleDeviceSharding(
+            device, memory_kind="pinned_host")
+        return jax.device_put(arr, sharding)
+    except Exception:
+        return arr
+
+
+class TieredPageStore:
+    """Bounded host-RAM + disk store for spilled prefix pages (module doc)."""
+
+    def __init__(self, host_bytes: int, disk_bytes: int = 0,
+                 disk_dir: str = "", index: "PrefixIndex | None" = None,
+                 metrics=None, pin: bool = True) -> None:
+        self.host_budget = max(0, int(host_bytes))
+        self.disk_budget = max(0, int(disk_bytes))
+        self.index = index
+        self.metrics = metrics
+        self._pin = pin
+        self._lock = threading.Lock()  # lint: lock[spill]
+        # T1: insertion-ordered = LRU-by-last-use (get() re-inserts)
+        self._host: dict[bytes, SpilledPage] = {}
+        self._host_nbytes = 0
+        # handed to the writer but not yet on disk: still served from RAM
+        self._pending: dict[bytes, SpilledPage] = {}  # lint: thread[spill]
+        # T2 residency: hash -> (path, nbytes), insertion-ordered (FIFO
+        # eviction when the disk budget overflows)
+        self._disk: dict[bytes, tuple[str, int]] = {}  # lint: thread[spill]
+        self._disk_nbytes = 0  # lint: thread[spill]
+        self._writeq: "queue.Queue[bytes | None]" = queue.Queue()
+        self._writer: threading.Thread | None = None
+        self._closed = False
+        self._disk_dir = disk_dir
+        self._owns_dir = False
+        # counters (read by stats surfaces; int ops are GIL-atomic)
+        self.spilled = 0
+        self.dropped = 0          # evicted past the last tier (truly gone)
+        self.collisions = 0       # key matched, payload identity did not
+        self.disk_writes = 0
+        self.disk_reads = 0
+
+    # ------------------------------------------------------------- lifecycle
+
+    def _ensure_dir(self) -> str:
+        if not self._disk_dir:
+            self._disk_dir = tempfile.mkdtemp(prefix="mcpforge-kv-tier-")
+            self._owns_dir = True
+        os.makedirs(self._disk_dir, exist_ok=True)
+        return self._disk_dir
+
+    def _ensure_writer(self) -> None:
+        if self._writer is None or not self._writer.is_alive():
+            self._writer = threading.Thread(
+                target=self._writer_loop, name="kv-tier-spill", daemon=True)
+            self._writer.start()
+
+    def close(self) -> None:
+        """Stop the write-behind worker and drop disk state this store
+        owns (an operator-provided disk_dir is left in place — it may be
+        a shared cache another pool still reads)."""
+        self._closed = True
+        writer = self._writer
+        if writer is not None and writer.is_alive():
+            self._writeq.put(None)
+            writer.join(timeout=5.0)
+        with self._lock:
+            self._pending.clear()
+            self._host.clear()
+            self._host_nbytes = 0
+            disk, self._disk = dict(self._disk), {}
+            self._disk_nbytes = 0
+        if self._owns_dir and self._disk_dir:
+            shutil.rmtree(self._disk_dir, ignore_errors=True)
+        elif disk:
+            for path, _ in disk.values():
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+    # ------------------------------------------------------------------ write
+
+    def put(self, key_hash: bytes, payload: SpilledPage) -> None:
+        """Admit a spilled page into T1, displacing LRU entries toward T2
+        (write-behind) when the host budget overflows. Duplicate keys
+        are a no-op — shared prefixes spill from many replicas."""
+        if self._closed or self.host_budget <= 0:
+            return
+        if self._pin:
+            payload.k = pin_host(payload.k)
+            payload.v = pin_host(payload.v)
+        with self._lock:
+            if (key_hash in self._host or key_hash in self._pending
+                    or key_hash in self._disk):
+                return
+            self._host[key_hash] = payload
+            self._host_nbytes += payload.nbytes
+            overflow = self._trim_host_locked()
+        self.spilled += 1
+        if self.index is not None:
+            self.index.publish_tier(key_hash, "host")
+        self._dispatch_overflow(overflow)
+
+    def _trim_host_locked(self) -> list[bytes]:
+        """Enforce the T1 byte budget (caller holds the lock): displace
+        LRU entries toward the write-behind queue (or drop them when the
+        disk tier is off). Returns the keys to hand to the worker —
+        queueing happens OUTSIDE the lock."""
+        overflow: list[bytes] = []
+        while self._host_nbytes > self.host_budget and len(self._host) > 1:
+            old_key, old = next(iter(self._host.items()))
+            del self._host[old_key]
+            self._host_nbytes -= old.nbytes
+            if old_key in self._disk:
+                # a displaced RE-ONLINED entry: its disk copy is already
+                # durable — rewriting would double-count _disk_nbytes
+                if self.index is not None:
+                    self.index.unpublish_tier(old_key, "host")
+            elif self.disk_budget > 0:
+                self._pending[old_key] = old  # lint: allow[cross-thread-mutation] _locked-suffix contract: every caller holds self._lock (the lint lock scope is per-method)
+                overflow.append(old_key)
+            else:
+                self.dropped += 1
+                if self.index is not None:
+                    self.index.unpublish_tier(old_key, "host")
+        return overflow
+
+    def _dispatch_overflow(self, overflow: list[bytes]) -> None:
+        if overflow:
+            self._ensure_writer()
+            for old_key in overflow:
+                self._writeq.put(old_key)
+
+    # ------------------------------------------------------------------- read
+
+    def probe(self, key_hash: bytes) -> bool:
+        """True iff some tier holds the key (no payload verification —
+        the probe sizes buckets; the match verifies)."""
+        with self._lock:
+            return (key_hash in self._host or key_hash in self._pending
+                    or key_hash in self._disk)
+
+    def get(self, key_hash: bytes, parent: bytes,
+            chunk: Sequence[int]) -> tuple[SpilledPage, str] | None:
+        """Fetch + VERIFY one page: the stored payload must carry exactly
+        ``(parent, chunk)`` or the result is a miss (hash collision —
+        wrong pages are never served). A disk hit re-onlines into T1.
+        Returns ``(payload, source_tier)``."""
+        expected = tuple(chunk)
+        path = None
+        collided = False
+        with self._lock:
+            payload = self._host.get(key_hash)
+            if payload is not None:
+                # LRU touch: re-insert at the MRU end
+                del self._host[key_hash]
+                self._host[key_hash] = payload
+                hit = self._verify(payload, parent, expected, "host")
+                if hit is None:  # collision: drop it, or probe() keeps
+                    del self._host[key_hash]   # promising an unrestorable
+                    self._host_nbytes -= payload.nbytes  # hist (livelock)
+                    collided = True
+            else:
+                payload = self._pending.get(key_hash)
+                if payload is not None:
+                    hit = self._verify(payload, parent, expected, "host")
+                    if hit is None:
+                        self._pending.pop(key_hash, None)
+                        collided = True
+                else:
+                    hit = None
+                    entry = self._disk.get(key_hash)
+                    if entry is not None:
+                        path = entry[0]
+        if collided:
+            # the dropped T1 copy must leave the index too, or the
+            # router keeps scoring phantom tier affinity for the hash
+            self.dropped += 1
+            if self.index is not None:
+                self.index.unpublish_tier(key_hash, "host")
+            return None
+        if payload is not None and path is None:
+            return hit
+        if path is None:
+            return None
+        payload = self._read_file(path)
+        if payload is None:
+            with self._lock:
+                entry = self._disk.pop(key_hash, None)
+                if entry is not None:
+                    self._disk_nbytes -= entry[1]
+            if self.index is not None:
+                self.index.unpublish_tier(key_hash, "disk")
+            return None
+        self.disk_reads += 1
+        hit = self._verify(payload, parent, expected, "disk")
+        if hit is None:
+            # collision on the disk copy: drop it too (see host path)
+            with self._lock:
+                entry = self._disk.pop(key_hash, None)
+                if entry is not None:
+                    self._disk_nbytes -= entry[1]
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            if self.index is not None:
+                self.index.unpublish_tier(key_hash, "disk")
+            return None
+        if hit is not None:
+            # re-online on match: later matches (any replica) serve from
+            # RAM; the disk copy stays for durability until budget churn.
+            # The SAME budget trim as put() applies — a restore-heavy
+            # phase must not grow T1 past tier_host_bytes just because
+            # the bytes arrived via re-onlining instead of spilling
+            overflow: list[bytes] = []
+            with self._lock:
+                if key_hash not in self._host and not self._closed:
+                    self._host[key_hash] = payload
+                    self._host_nbytes += payload.nbytes
+                    overflow = self._trim_host_locked()
+            if self.index is not None:
+                self.index.publish_tier(key_hash, "host")
+            self._dispatch_overflow(overflow)
+        return hit
+
+    def _verify(self, payload: SpilledPage, parent: bytes,
+                chunk: tuple[int, ...],
+                tier: str) -> tuple[SpilledPage, str] | None:
+        if payload.parent != parent or payload.chunk != chunk:
+            self.collisions += 1
+            logger.warning(
+                "kv tier store: chain-hash collision (tier=%s) — "
+                "payload identity mismatch, serving a miss", tier)
+            return None
+        return payload, tier
+
+    # ----------------------------------------------------------- spill worker
+
+    def _writer_loop(self) -> None:  # lint: runs-on[spill]
+        """Write-behind: persist pending T1 overflow to disk, bounded by
+        the disk budget (oldest files evicted — past the last tier, the
+        page is truly gone and the index forgets it)."""
+        while True:
+            key_hash = self._writeq.get()
+            if key_hash is None:
+                return
+            with self._lock:
+                payload = self._pending.get(key_hash)
+            if payload is None:
+                continue
+            path = os.path.join(self._ensure_dir(),
+                                key_hash.hex() + ".npz")
+            started = time.monotonic()
+            try:
+                self._write_file(path, payload)
+            except OSError:
+                logger.exception("kv tier store: disk write failed (%s); "
+                                 "dropping page", path)
+                with self._lock:
+                    self._pending.pop(key_hash, None)
+                self.dropped += 1
+                if self.index is not None:
+                    self.index.unpublish_tier(key_hash, "host")
+                continue
+            nbytes = payload.nbytes
+            evicted: list[tuple[bytes, str]] = []
+            with self._lock:
+                self._pending.pop(key_hash, None)
+                self._disk[key_hash] = (path, nbytes)
+                self._disk_nbytes += nbytes
+                while self._disk_nbytes > self.disk_budget \
+                        and len(self._disk) > 1:
+                    old_key, (old_path, old_nbytes) = \
+                        next(iter(self._disk.items()))
+                    del self._disk[old_key]
+                    self._disk_nbytes -= old_nbytes
+                    evicted.append((old_key, old_path))
+            self.disk_writes += 1
+            if self.metrics is not None:
+                self.metrics.llm_prefix_tier_io.labels(
+                    op="writeback", tier="disk").observe(
+                    time.monotonic() - started)
+            if self.index is not None:
+                self.index.publish_tier(key_hash, "disk")
+                self.index.unpublish_tier(key_hash, "host")
+            for old_key, old_path in evicted:
+                try:
+                    os.unlink(old_path)
+                except OSError:
+                    pass
+                self.dropped += 1
+                if self.index is not None:
+                    self.index.unpublish_tier(old_key, "disk")
+
+    @staticmethod
+    def _write_file(path: str, payload: SpilledPage) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            np.savez(fh,
+                     chunk=np.asarray(payload.chunk, dtype=np.int64),
+                     parent=np.frombuffer(payload.parent, dtype=np.uint8),
+                     k=np.asarray(payload.k), v=np.asarray(payload.v),
+                     k_scales=np.asarray(payload.k_scales),
+                     v_scales=np.asarray(payload.v_scales))
+        os.replace(tmp, path)
+
+    @staticmethod
+    def _read_file(path: str) -> SpilledPage | None:
+        try:
+            with np.load(path) as data:
+                return SpilledPage(
+                    chunk=tuple(int(t) for t in data["chunk"]),
+                    parent=data["parent"].tobytes(),
+                    k=data["k"], v=data["v"],
+                    k_scales=data["k_scales"], v_scales=data["v_scales"])
+        except (OSError, KeyError, ValueError):
+            logger.warning("kv tier store: unreadable spill file %s", path)
+            return None
+
+    # ------------------------------------------------------------------ stats
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            host_entries = len(self._host) + len(self._pending)
+            host_nbytes = self._host_nbytes + sum(
+                p.nbytes for p in self._pending.values())
+            disk_entries = len(self._disk)
+            disk_nbytes = self._disk_nbytes
+        return {
+            "host_pages": host_entries, "host_bytes": host_nbytes,
+            "host_budget_bytes": self.host_budget,
+            "disk_pages": disk_entries, "disk_bytes": disk_nbytes,
+            "disk_budget_bytes": self.disk_budget,
+            "spilled": self.spilled, "dropped": self.dropped,
+            "disk_writes": self.disk_writes, "disk_reads": self.disk_reads,
+            "collisions": self.collisions,
+        }
+
+
+class TierClient:
+    """One engine's binding to the (pool-shared) store + index.
+
+    Owns the engine-provided device I/O callbacks — ``read_fn(page) ->
+    SpilledPage-shaped arrays`` (device->host, quantize-on-spill under a
+    bf16 pool) and ``write_fn(page, payload)`` (host->device upload into
+    the admitting replica's HBM) — plus the spill/restore latency
+    windows the stats surfaces report. The allocator calls ``spill`` at
+    eviction and ``restore`` at match time; both run on the engine's
+    dispatch thread (the only thread allowed to touch device state)."""
+
+    def __init__(self, replica_id: str,
+                 store: TieredPageStore | None = None,
+                 index: "PrefixIndex | None" = None,
+                 metrics=None) -> None:
+        self.replica_id = replica_id
+        self.store = store
+        self.index = index
+        self.metrics = metrics
+        self.read_fn: Callable[[int], SpilledPage] | None = None
+        self.write_fn: Callable[[int, SpilledPage], None] | None = None
+        self.spills = 0
+        self.restores = 0
+        self.spill_ms: deque[float] = deque(maxlen=256)
+        self.restore_ms: deque[float] = deque(maxlen=256)
+
+    @property
+    def active(self) -> bool:
+        """True when spill/restore are actually wired (store + device IO);
+        a client with only an index still publishes HBM residency for
+        the router but never moves page bytes."""
+        return (self.store is not None and self.read_fn is not None
+                and self.write_fn is not None)
+
+    # ------------------------------------------------------- index publication
+
+    def publish_hbm(self, key_hash: bytes) -> None:
+        if self.index is not None:
+            self.index.publish_hbm(key_hash, self.replica_id)
+
+    def unpublish_hbm(self, key_hash: bytes) -> None:
+        if self.index is not None:
+            self.index.unpublish_hbm(key_hash, self.replica_id)
+
+    def drop_replica(self) -> None:
+        if self.index is not None:
+            self.index.drop_replica(self.replica_id)
+
+    # ---------------------------------------------------------- byte movement
+
+    def probe(self, key_hash: bytes) -> bool:
+        return self.store is not None and self.store.probe(key_hash)
+
+    def spill(self, key_hash: bytes, parent: bytes, chunk: Sequence[int],
+              page: int) -> bool:
+        """Evicted-page handoff: read the page's bytes off the device and
+        admit them into T1. Skips the device read when some tier already
+        holds the key (another replica spilled the same chain)."""
+        if not self.active:
+            return False
+        if self.store.probe(key_hash):
+            return True
+        started = time.monotonic()
+        payload = self.read_fn(page)
+        payload.chunk = tuple(chunk)
+        payload.parent = parent
+        self.store.put(key_hash, payload)
+        elapsed = time.monotonic() - started
+        self.spills += 1
+        self.spill_ms.append(elapsed * 1e3)
+        if self.metrics is not None:
+            self.metrics.llm_prefix_tier_io.labels(
+                op="spill", tier="host").observe(elapsed)
+        return True
+
+    def restore(self, key_hash: bytes, parent: bytes, chunk: Sequence[int],
+                page: int) -> str | None:
+        """Fetch-on-miss: verify + fetch the spilled page and upload it
+        into ``page`` of THIS replica's HBM pool. Returns the source
+        tier ("host"/"disk") or None (miss / collision)."""
+        if not self.active:
+            return None
+        started = time.monotonic()
+        hit = self.store.get(key_hash, parent, chunk)
+        if hit is None:
+            return None
+        payload, tier = hit
+        self.write_fn(page, payload)
+        elapsed = time.monotonic() - started
+        self.restores += 1
+        self.restore_ms.append(elapsed * 1e3)
+        if self.metrics is not None:
+            self.metrics.llm_prefix_tier_io.labels(
+                op="restore", tier=tier).observe(elapsed)
+        return tier
+
+    # ------------------------------------------------------------------ stats
+
+    def restore_p95_ms(self) -> float | None:
+        if not self.restore_ms:
+            return None
+        window = sorted(self.restore_ms)
+        return round(window[min(len(window) - 1,
+                                int(len(window) * 0.95))], 3)
+
+    def stats(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "replica": self.replica_id,
+            "spills": self.spills, "restores": self.restores,
+            "restore_p95_ms": self.restore_p95_ms(),
+        }
+        if self.store is not None:
+            out["store"] = self.store.stats()
+        return out

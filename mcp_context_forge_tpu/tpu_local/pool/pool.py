@@ -138,6 +138,9 @@ class EngineReplica:
             "xla_compiles": engine.compile_stats(),
             # live cost-model roofline over the recent decode window
             "roofline": engine.roofline_snapshot(),
+            # tiered prefix cache: this replica's per-tier hit split +
+            # spill/restore counters (None when tiers and index are off)
+            "prefix_tiers": engine.tier_stats(),
         }
 
 
@@ -162,11 +165,33 @@ class EnginePool:
         # engine a reload produces): per-tenant token accounting must
         # survive failover and hot-swap with nothing lost or double-billed
         self.ledger = ledger
+        # pool-global prefix plane (docs/kv_tiering.md): ONE index maps
+        # hashed prefix chains -> (replica | tier) locations — replicas
+        # publish their HBM registrations into it and the router scores
+        # it as affinity — and, with prefix_tiers on, ONE spill store is
+        # shared by every replica so admission can fetch-on-miss: a
+        # prefix prefilled (then evicted) on any replica restores into
+        # the admitting replica's own HBM. Both survive reload-rebuilt
+        # engines (content-addressed by token chain, not replica state).
+        self.prefix_index = None
+        self.tier_store = None
+        if config.prefix_cache:
+            from ..kv.prefix_index import PrefixIndex
+            self.prefix_index = PrefixIndex()
+            if config.prefix_tiers:
+                from ..kv.tiers import TieredPageStore
+                self.tier_store = TieredPageStore(
+                    host_bytes=config.tier_host_bytes,
+                    disk_bytes=config.tier_disk_bytes,
+                    disk_dir=config.tier_disk_dir,
+                    index=self.prefix_index, metrics=metrics)
         self.requeue_max = max(0, requeue_max)
         self._factory = engine_factory or (
-            lambda cfg, tracer, metrics, devices, ledger=None: TPUEngine(
+            lambda cfg, tracer, metrics, devices, ledger=None,
+            tier_store=None, prefix_index=None: TPUEngine(
                 cfg, tracer=tracer, metrics=metrics, devices=devices,
-                ledger=ledger))
+                ledger=ledger, tier_store=tier_store,
+                prefix_index=prefix_index))
         if devices is None:
             devices = probe_devices(config.init_timeout_s)
         self._device_sets = partition_devices(devices, replicas)
@@ -191,7 +216,9 @@ class EnginePool:
         for i in range(replicas):
             self.replicas.append(
                 EngineReplica(str(i), i, self._build_engine(i)))
-        self.router = ReplicaRouter(affinity=affinity_routing)
+        self.router = ReplicaRouter(affinity=affinity_routing,
+                                    index=self.prefix_index,
+                                    page_size=config.page_size)
         self.health = HealthMonitor(self, interval_s=health_interval_s,
                                     heartbeat_timeout_s=heartbeat_timeout_s)
         self.tokenizer = self.replicas[0].engine.tokenizer
@@ -204,7 +231,9 @@ class EnginePool:
         cfg = dataclasses.replace(self.config, replica_id=str(index),
                                   mesh_shape=self._mesh_shape)
         return self._factory(cfg, self.tracer, self.metrics,
-                             self._device_sets[index], ledger=self.ledger)
+                             self._device_sets[index], ledger=self.ledger,
+                             tier_store=self.tier_store,
+                             prefix_index=self.prefix_index)
 
     # --------------------------------------------------------------- lifecycle
 
@@ -228,6 +257,10 @@ class EnginePool:
             except Exception:
                 logger.exception("engine pool: replica %s stop failed",
                                  replica.id)
+        # the shared spill store outlives every replica engine (reloads
+        # rebuild engines against it); close it only with the pool
+        if self.tier_store is not None:
+            self.tier_store.close()
 
     def warmup(self, mode: str | None = None) -> None:
         """Precompile every replica's shape grid (bench/boot path)."""
@@ -411,6 +444,13 @@ class EnginePool:
         # requeue, and a zombie that later revives exits at its next loop
         # check (its late emissions land in abandoned shadow streams)
         replica.engine.kill()
+        client = getattr(replica.engine, "_tier_client", None)
+        if client is not None:
+            # the dead engine's HBM pages are unreachable — forget its
+            # prefix-index entries so affinity scoring can't chase
+            # ghosts (pages already SPILLED are content-addressed in the
+            # shared store and keep serving every survivor)
+            client.drop_replica()
         survivors = self._take_over_records(replica)
         if survivors:
             asyncio.get_running_loop().create_task(
@@ -634,6 +674,13 @@ class EnginePool:
             "replicas": [r.status() for r in self.replicas],
             "router": {**self.router.counters(),
                        "affinity_routing": self.router.affinity_routing},
+            "prefix_tiers": {
+                "enabled": self.tier_store is not None,
+                "store": (self.tier_store.stats()
+                          if self.tier_store is not None else None),
+                "index": (self.prefix_index.stats()
+                          if self.prefix_index is not None else None),
+            },
             "requeues": self.requeues,
             "requeue_max": self.requeue_max,
             "health": {

@@ -4,12 +4,21 @@ Two signals, in order ("A System for Microserving of LLMs",
 arXiv:2412.12488 — context-aware routing over disaggregated engines;
 xLLM's scheduler makes the same trade):
 
-1. **prefix-cache affinity** — each replica owns its own KV pool and
-   prefix cache, so a request whose prompt prefix is resident on replica
-   R prefills only its suffix there and the full prompt anywhere else.
-   The probe reuses the engine's read-only ``allocator.probe_prefix``
-   (no page references taken — pending requests must never pin cache
-   pages). An affinity win only counts when it is worth at least one
+1. **prefix-cache affinity** — each replica owns its own HBM KV pool
+   and prefix cache, so a request whose prompt prefix is resident on
+   replica R prefills only its suffix there and the full prompt
+   anywhere else. Affinity is scored from BOTH views of residency:
+   the replica's own read-only ``allocator.probe_prefix`` (local HBM
+   plus, with tiers on, the shared spill store that replica could
+   restore from) and the pool-global prefix index
+   (``kv/prefix_index.py``) — so a prefix resident only on replica 1's
+   HBM raises replica 1's score no matter which replica is examined
+   first, and a chain spilled to the pool-shared host/disk tiers counts
+   as a hit for EVERY replica (fetch-on-miss restores it at admission,
+   so tier hits are affinity-real but placement-neutral: the
+   least-outstanding signal below breaks the tie). No page references
+   are taken by any probe — pending requests must never pin cache
+   pages. An affinity win only counts when it is worth at least one
    full page: sub-page "hits" save nothing (the engine re-buckets them
    away at admission).
 2. **least outstanding decode tokens** — among equally-affine replicas,
@@ -29,6 +38,7 @@ from __future__ import annotations
 from typing import TYPE_CHECKING, Sequence
 
 if TYPE_CHECKING:  # pragma: no cover - typing only
+    from ..kv.prefix_index import PrefixIndex
     from .pool import EngineReplica
 
 
@@ -36,22 +46,27 @@ class ReplicaRouter:
     """Scores routable replicas; owns the routing counters the admin
     surface reports. Runs on the gateway loop (submit path)."""
 
-    def __init__(self, affinity: bool = True) -> None:
+    def __init__(self, affinity: bool = True,
+                 index: "PrefixIndex | None" = None,
+                 page_size: int = 0) -> None:
         self.affinity_routing = affinity
+        self._index = index
+        self._page_size = page_size
         self.routed = 0           # lint: thread[pool]
         self.affinity_hits = 0    # lint: thread[pool]
+        self.index_hits = 0       # routes the pool index steered  # lint: thread[pool]
         self._rr = 0              # round-robin tiebreak cursor  # lint: thread[pool]
 
     def route(self, replicas: Sequence["EngineReplica"],  # lint: runs-on[pool]  # lint: hot-path
               prompt_ids: list[int]) -> tuple["EngineReplica", bool]:
         """Pick a replica for ``prompt_ids`` among ``replicas`` (already
         filtered to routable ones, non-empty). Returns (replica,
-        affinity_hit). On the submit hot path: pure host-side scoring,
-        no device sync."""
-        if len(replicas) == 1:
-            choice, hit = replicas[0], False
-        else:
-            choice, hit = self._score(replicas, prompt_ids)
+        affinity_hit). On the submit hot path: pure host-side scoring
+        (dict walks over the allocator and the pool index), no device
+        sync. A single routable replica still scores — the affinity
+        accounting must stay truthful when the pool is degraded to one
+        survivor."""
+        choice, hit = self._score(replicas, prompt_ids)
         self.routed += 1
         if hit:
             self.affinity_hits += 1
@@ -62,13 +77,29 @@ class ReplicaRouter:
         best = None
         best_key = None
         best_hist = 0
+        chain = None
+        if self.affinity_routing and self._index is not None \
+                and self._page_size > 0:
+            chain = self._index.chain_locations(prompt_ids, self._page_size)
+            if not any(hbm or tiered for hbm, tiered in chain):
+                chain = None  # nothing indexed: skip the per-replica fold
+        best_from_index = False
         self._rr += 1
         for i, replica in enumerate(replicas):
             hist = 0
+            from_index = False
             if self.affinity_routing:
                 engine = replica.engine
                 if engine.config.prefix_cache:
                     hist = engine.allocator.probe_prefix(prompt_ids)
+                    if chain is not None:
+                        # pool-global view: pages resident on THIS
+                        # replica's HBM or restorable from a shared tier
+                        idx_hist = self._index.reachable_tokens(
+                            chain, replica.id, self._page_size)
+                        if idx_hist > hist:
+                            hist = idx_hist
+                            from_index = True
                     if hist < engine.config.page_size:
                         hist = 0  # sub-page match saves no prefill
             # max affinity, then min outstanding tokens, then round-robin
@@ -76,7 +107,11 @@ class ReplicaRouter:
                    (i + self._rr) % len(replicas))
             if best_key is None or key < best_key:
                 best, best_key, best_hist = replica, key, hist
+                best_from_index = from_index and hist > 0
+        if best_from_index:
+            self.index_hits += 1
         return best, best_hist > 0
 
     def counters(self) -> dict[str, int]:
-        return {"routed": self.routed, "affinity_hits": self.affinity_hits}
+        return {"routed": self.routed, "affinity_hits": self.affinity_hits,
+                "index_hits": self.index_hits}

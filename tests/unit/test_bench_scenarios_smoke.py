@@ -68,6 +68,17 @@ def test_scenarios_cpu_smoke(scenario_env, monkeypatch):
         tenant["conservation"]["ledger_generated"]
         == tenant["conservation"]["engine_generated"])
     assert tenant["rollup_rows"] > 0
+    # long-shared-prefix arm (docs/kv_tiering.md): the shared template's
+    # pages served from the prefix cache (HBM or restored tier pages),
+    # cached tokens dominate the arm's prefill, conservation includes
+    # the cache_hit column over the tiered path
+    prefix = tenant["prefix"]
+    assert prefix["requests"] > 0 and prefix["failures"] == 0
+    assert prefix["hit_tokens"] > 0
+    assert prefix["hit_dominant"] is True, prefix
+    assert (tenant["conservation"]["ledger_cache_hit"]
+            == tenant["conservation"]["engine_cache_hit"])
+    assert sum(prefix["tier_hit_tokens"].values()) > 0
     per_class = {t["slo"]["slo_class"]
                  for t in tenant["tenants"].values()}
     assert {"premium", "default", "batch"} == per_class

@@ -203,7 +203,29 @@ def test_first_capture_of_a_new_arm_is_surfaced_not_silent(tmp_path, capsys):
     assert report["ok"] and report["checks"] >= 1       # K=1 still gated
     series = next(r for r in report["series"] if r["series"] == "BENCH_TPU")
     assert series["new_arms"] == [
-        {"superstep": 8, "capture": "BENCH_TPU_r03.json"}]
+        {"superstep": 8, "prefix_tiers": False,
+         "capture": "BENCH_TPU_r03.json"}]
     assert main(["--root", str(tmp_path)]) == 0
     out = capsys.readouterr().out
     assert "no history to gate yet" in out
+
+
+def test_prefix_tiers_captures_gate_as_their_own_arm(tmp_path):
+    """A BENCH_PREFIX_TIERS capture (pressure workload, different tok/s
+    regime) must only be judged against tier history: mixing it into the
+    plain series would read the pressure workload as a regression."""
+    _write_series(tmp_path, "BENCH_LOCAL", [
+        _capture(100.0), _capture(102.0),                 # plain history
+        {**_capture(8.0), "prefix_tiers": True},          # tier arm, r3
+        {**_capture(7.9), "prefix_tiers": True},          # tier arm, r4
+    ])
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert report["ok"], report["regressions"]
+    # both arms were actually compared (plain r2-vs-r1, tiers r4-vs-r3)
+    assert report["checks"] >= 4
+    # and a tier-arm regression is caught WITHIN the arm
+    (tmp_path / "BENCH_LOCAL_r05.json").write_text(json.dumps(
+        {**_capture(3.0), "prefix_tiers": True}))
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert not report["ok"]
+    assert any("@tiers" in line for line in report["regressions"])

@@ -639,3 +639,35 @@ def test_active_rules_registry_has_the_six_shipping_rules():
             "tracer-python-branch", "jit-cache-buster",
             "cross-thread-mutation", "dead-metric"} <= ids
     assert len(ids) >= 6
+
+
+def test_cross_thread_mutation_spill_worker_context():
+    """The tiered-KV spill worker (kv/tiers.py) rides the same
+    annotation grammar as dispatch/pool: disk state is thread[spill]-
+    owned by the write-behind loop, and producer-side handoffs must go
+    through the lint: lock[spill] store lock — an unguarded mutation
+    from put() is a finding."""
+    fixture = """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()   # lint: lock[spill]
+                self._disk = {}                 # lint: thread[spill]
+                self._pending = {}              # lint: thread[spill]
+
+            def _writer_loop(self):  # lint: runs-on[spill]
+                self._disk[b"k"] = ("path", 1)
+                self._pending.pop(b"k", None)
+
+            def put(self, key, payload):
+                with self._lock:
+                    self._pending[key] = payload
+
+            def put_unguarded(self, key, payload):
+                self._pending[key] = payload
+    """
+    findings = run(CrossThreadMutationRule(), fixture)
+    assert len(findings) == 1
+    assert "put_unguarded" in findings[0].message
+    assert "'spill'" in findings[0].message
