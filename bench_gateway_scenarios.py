@@ -107,6 +107,12 @@ async def _make_gateway(platform: str, replicas: int = 2):
         "MCPFORGE_TPU_LOCAL_NUM_PAGES": "128" if _smoke() else "2048",
         "MCPFORGE_TPU_LOCAL_PREFILL_BUCKETS": ("16,64" if _smoke()
                                                else "64,128,256"),
+        # request forensics (docs/observability.md): each arm's slowest
+        # request must stitch at /admin/trace/{id}; widen the per-route
+        # slowest retention so five back-to-back scenarios sharing the
+        # chat route each keep their own slowest alongside breach and
+        # exemplar retention
+        "MCPFORGE_TRACE_STORE_SLOWEST_PER_KEY": "8",
         # tiered prefix cache ON (docs/kv_tiering.md): the pool-shared
         # spill store + prefix index serve every scenario; the tenant
         # scenario's long-shared-prefix arm gates the hit accounting
@@ -541,10 +547,17 @@ def _write_capture(out_dir: str, rnd: int, capture: dict) -> str:
     arm = "" if platform == "CPU" else f"_{platform}"
     name = (f"BENCH_SCENARIO{arm}_{capture['scenario'].upper()}"
             f"_r{rnd:02d}.json")
+    # ATOMIC per-arm write, issued as soon as the scenario completes —
+    # a dropped tunnel / OOM mid-round keeps every finished arm's
+    # capture on disk (the exact failure that voided
+    # BENCH_GATEWAY_TPU_r05.json), and os.replace can never leave a
+    # half-written JSON for bench_trend to choke on
     path = os.path.join(out_dir, name)
-    with open(path, "w") as fh:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
         json.dump(capture, fh, indent=1, sort_keys=True)
         fh.write("\n")
+    os.replace(tmp, path)
     return name
 
 
@@ -570,6 +583,7 @@ async def run_scenarios(platform: str) -> dict:
     peer = upstream = None
     captures: list[dict] = []
     problems: list[str] = []
+    written: list[str] = []
     try:
         upstream = await _register_echo_tool(client, auth, "scenario-echo")
         if "mixed" in wanted:
@@ -605,8 +619,15 @@ async def run_scenarios(platform: str) -> dict:
                                               scale),
             "chaos": lambda: scenario_chaos(app, client, auth, model, scale),
         }
+        out_dir = os.environ.get(
+            "BENCH_SCENARIO_DIR",
+            os.path.dirname(os.path.abspath(__file__)) or ".")
+        write = os.environ.get("BENCH_SCENARIO_WRITE") != "0"
+        rnd = int(os.environ.get("BENCH_SCENARIO_ROUND",
+                                 _next_round(out_dir)))
         for name in wanted:
             started = time.monotonic()
+            scenario_t0 = time.time()  # forensics probe window anchor
             try:
                 capture = await runners[name]()
             except Exception as exc:
@@ -624,6 +645,19 @@ async def run_scenarios(platform: str) -> dict:
                 capture.get("slo", {}), ["http_p95", "ttft_p95"])
             if unmeasured:
                 problems.append(f"{name}: " + "; ".join(unmeasured))
+            # request forensics (same no-vacuous spirit): the scenario's
+            # SLOWEST request must be retrievable at /admin/trace/{id}
+            # as a complete stitched waterfall — tail retention plus
+            # cross-layer stitching proven against real scenario load.
+            # since_ts scopes the pick to THIS scenario's rows (the
+            # rings span the whole run)
+            from mcp_context_forge_tpu.tools.loadgen import \
+                probe_slowest_trace
+            forensics = await probe_slowest_trace(client, auth,
+                                                  since_ts=scenario_t0)
+            capture["forensics"] = forensics
+            for problem in forensics["problems"]:
+                problems.append(f"{name}: forensics: {problem}")
             hard = capture.pop("hard_fail", None)
             if hard:
                 problems.append(f"{name}: {hard}")
@@ -637,6 +671,10 @@ async def run_scenarios(platform: str) -> dict:
                 problems.append(f"{name}: SLO window breached "
                                 f"(enforcement on)")
             captures.append(capture)
+            if write:
+                # durable per-arm capture: written the moment the arm
+                # finishes, not at end-of-round (atomic rename inside)
+                written.append(_write_capture(out_dir, rnd, capture))
     finally:
         for c in (peer, upstream, client):
             if c is not None:
@@ -644,15 +682,6 @@ async def run_scenarios(platform: str) -> dict:
                     await c.close()
                 except Exception:
                     pass
-
-    out_dir = os.environ.get(
-        "BENCH_SCENARIO_DIR",
-        os.path.dirname(os.path.abspath(__file__)) or ".")
-    written: list[str] = []
-    if captures and os.environ.get("BENCH_SCENARIO_WRITE") != "0":
-        rnd = int(os.environ.get("BENCH_SCENARIO_ROUND",
-                                 _next_round(out_dir)))
-        written = [_write_capture(out_dir, rnd, c) for c in captures]
     return {
         "metric": "gateway_scenario_slo",
         "scenarios": {c["scenario"]: c for c in captures},

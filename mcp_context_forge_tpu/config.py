@@ -330,6 +330,28 @@ class Settings(BaseModel):
     otel_service_name: str = "mcpforge"
     otel_otlp_endpoint: str = ""   # e.g. http://collector:4318 (OTLP/HTTP)
     otel_otlp_headers: str = ""    # JSON object of extra headers
+    # transient OTLP delivery failures retry with exponential backoff
+    # this many times before the batch drops (counted in
+    # mcpforge_otel_spans_dropped_total{reason="retry_exhausted"})
+    otel_otlp_retry_max: int = 3
+    # --- request forensics plane (observability/trace_store.py,
+    # docs/observability.md "Request forensics & exemplars") ---
+    # in-process tail-sampled trace store behind GET /admin/trace/{id}:
+    # keeps every error trace, every SLO-breaching trace, the slowest-N
+    # per route/tenant, exemplar-pinned traces, and a deterministic
+    # 1-in-M sample of the rest, bounded at trace_store_max_traces
+    trace_store_enabled: bool = True
+    trace_store_max_traces: int = 512
+    trace_store_max_spans: int = 256
+    trace_store_sample_every: int = 32       # 0 = no background sample
+    trace_store_slowest_per_key: int = 4     # per route AND per tenant
+    # rootless traces (engine driven without a gateway span) finalize
+    # after this idle window instead of leaking in the open table
+    trace_store_idle_finalize_s: float = 30.0
+    # per-bucket trace-id exemplars on the TTFT/TPOT/queue-wait/http
+    # histograms, exported in OpenMetrics syntax when the scraper
+    # negotiates it (Accept: application/openmetrics-text)
+    metrics_exemplars: bool = True
     jax_profile_dir: str = "/tmp/mcpforge-jaxprof"  # /admin/engine/profile sink
     # opt-in production profiler capture: the /admin/engine/profile*
     # endpoints (duration capture + start/stop) 404 unless enabled —

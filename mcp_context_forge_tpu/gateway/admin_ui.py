@@ -167,6 +167,7 @@ const TABS = {
   chat:     {special: "chat"},
   engine:   {url: "/admin/engine/stats", special: "engine"},
   gateway:  {url: "/admin/gateway/requests?limit=24", special: "gwflight"},
+  forensics:{url: "/admin/trace?limit=50", special: "forensics"},
   tenants:  {url: "/admin/tenants/usage?limit=32", special: "tenants"},
   diagnostics: {special: "diagnostics"},
 };
@@ -331,6 +332,89 @@ function renderGatewayFlight(snap){
     + gwFlightTable("slowest requests", snap.slowest)
     + gwFlightTable("recent requests", snap.recent);
   document.getElementById("status").textContent = "gateway flight recorder";
+}
+let forensicRows = [];
+function renderForensics(snap){
+  // tail-sampled trace store (observability/trace_store.py): what
+  // survived retention and why, each row clicking through to its
+  // stitched cross-layer waterfall at /admin/trace/{id}
+  forensicRows = snap.traces || [];
+  const cards = `<div class="cards">
+    <div class="card"><b>${cell(snap.retained)}/${cell(snap.max_traces)}</b><span>retained (budget)</span></div>
+    <div class="card"><b>${cell(snap.finalized)}</b><span>traces_finalized</span></div>
+    <div class="card"><b>${cell(snap.dropped)}</b><span>dropped (boring)</span></div>
+    <div class="card"><b>${cell(snap.evicted)}</b><span>evicted (budget)</span></div>
+    <div class="card"><b>${cell(snap.open)}</b><span>open</span></div>
+    <div class="card"><b>${cell((snap.exemplars||{}).pinned_traces)}</b><span>exemplar_pins</span></div>
+   </div>`;
+  const cols = ["ts","root","route","tenant","status","duration_ms",
+                "span_count","reasons","breaches","trace_id"];
+  const body = forensicRows.map((t, i) =>
+    "<tr>" + cols.map(c => {
+      if (c === "ts") return `<td>${esc(new Date((t.ts||0)*1000)
+        .toISOString().slice(11,23))}</td>`;
+      if (c === "reasons" || c === "breaches")
+        return `<td>${esc((t[c]||[]).join(","))}</td>`;
+      return `<td>${cell(t[c])}</td>`;
+    }).join("")
+    + `<td><button class="act" onclick="forensicWaterfall(${i})">waterfall</button></td></tr>`
+  ).join("");
+  document.getElementById("view").innerHTML = cards
+    + (body ? `<br><h3>retained traces (newest first)</h3><table><tr>`
+      + cols.map(c => `<th>${esc(c)}</th>`).join("")
+      + `<th></th></tr>${body}</table>`
+      : "<br>no retained traces yet — drive some traffic");
+  document.getElementById("status").textContent = "request forensics";
+}
+async function forensicWaterfall(i){
+  const row = forensicRows[i];
+  if (!row) return;
+  const id = encodeURIComponent(String(row.trace_id || ""));
+  const r = await fetch(`/admin/trace/${id}`);
+  const d = document.getElementById("detail");
+  d.style.display = "block";
+  if (!r.ok){ d.textContent = "waterfall fetch failed: " + r.status; return; }
+  const w = await r.json();
+  const inv = w.invariants || {};
+  const pill = ok => ok ? '<span class="pill ok">ok</span>'
+                        : '<span class="pill bad">violated</span>';
+  let html = `<b>waterfall ${esc(String(row.trace_id||""))}</b>
+    <div class="cards">
+      <div class="card"><b>${cell((w.root||{}).duration_ms)}</b><span>wall_ms (${esc((w.root||{}).name||"?")})</span></div>
+      <div class="card"><b>${cell(w.span_count)}</b><span>spans</span></div>
+      <div class="card"><b>${esc((w.replica_hops||[]).join(" → ")||"-")}</b><span>replica_hops</span></div>
+      <div class="card"><b>${cell(w.engine_steps_joined)}</b><span>engine_steps_joined</span></div>
+      <div class="card">${pill(inv.children_within_parent)}<span>children_within_parent</span></div>
+      <div class="card">${pill(inv.child_cover_le_wall)}<span>child_cover_le_wall</span></div>
+    </div>`;
+  if (w.gateway)
+    html += `<div class="kv">gateway phases (sum ${cell(w.gateway.phase_sum_ms)}ms`
+      + ` / wall ${cell(w.gateway.duration_ms)}ms): `
+      + `${esc(JSON.stringify(w.gateway.phases_ms||{}))}</div>`;
+  // indented span rows + gantt bars over the trace window
+  const flat = [];
+  const walk = (node, depth) => {
+    flat.push([node, depth]);
+    for (const c of node.children || []) walk(c, depth+1);
+  };
+  for (const root of w.tree || []) walk(root, 0);
+  const starts = flat.map(([s]) => s.start_ts).filter(v => v != null);
+  const t0 = starts.length ? Math.min(...starts) : 0;
+  const t1 = Math.max(...flat.map(([s]) =>
+    (s.start_ts||t0) + ((s.duration_ms||0)/1000)), t0 + 1e-6);
+  const win = t1 - t0;
+  html += flat.map(([s, depth]) => {
+    const left = (((s.start_ts||t0)-t0)/win)*100;
+    const width = Math.max((((s.duration_ms||0)/1000)/win)*100, 0.3);
+    const cls = s.status === "ERROR" ? "bar err" : "bar";
+    const steps = s.engine_steps ? ` [${s.engine_steps.length} engine steps]` : "";
+    return `<div class="span-row${s.status==="ERROR"?" err":""}">`
+      + `${"  ".repeat(depth)}${esc(s.name)} (${esc(s.layer||"")})`
+      + `  ${s.duration_ms == null ? "" : Math.round(s.duration_ms*100)/100 + "ms"}`
+      + `${esc(steps)}</div>`
+      + `<div class="gantt"><div class="${cls}" style="left:${left.toFixed(2)}%;width:${width.toFixed(2)}%"></div></div>`;
+  }).join("");
+  d.innerHTML = html;
 }
 async function renderTenants(usage){
   // per-tenant metering (observability/metering.py): live ledger rows,
@@ -714,6 +798,7 @@ async function show(name, keepCursor){
     let data = await r.json();
     if (t.special === "engine") return renderEngine(data);
     if (t.special === "gwflight") return renderGatewayFlight(data);
+    if (t.special === "forensics") return renderForensics(data);
     if (t.special === "tenants") return renderTenants(data);
     if (t.special === "ingress") return renderIngress(data);
     if (t.path) data = data[t.path] || [];

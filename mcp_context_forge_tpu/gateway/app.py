@@ -94,8 +94,11 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     # ledger: bounded tenant label cardinality (top-N + "other"),
     # identical admission on both sides (docs/multitenancy.md)
     from ..observability.tenant import TenantClamp
+    from ..observability.trace_store import ExemplarLedger
     tenant_clamp = TenantClamp(settings.tenant_label_clamp)
-    metrics = PrometheusRegistry(tenant_clamp=tenant_clamp)
+    metrics = PrometheusRegistry(
+        tenant_clamp=tenant_clamp,
+        exemplars=ExemplarLedger(enabled=settings.metrics_exemplars))
 
     ctx = AppContext(settings=settings, db=db, bus=bus, leases=leases,
                      tracer=tracer, metrics=metrics)
@@ -142,9 +145,34 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         headers = (_json.loads(settings.otel_otlp_headers)
                    if settings.otel_otlp_headers else None)
         otlp_exporter = OTLPExporter(ctx, settings.otel_otlp_endpoint,
-                                     settings.otel_service_name, headers)
+                                     settings.otel_service_name, headers,
+                                     max_retries=settings.otel_otlp_retry_max)
         tracer.add_sink(otlp_exporter.sink)
         app["otlp_exporter"] = otlp_exporter
+
+    # request forensics plane (observability/trace_store.py): the
+    # tail-sampled trace store rides the tracer as one more sink, next
+    # to the OTLP exporter — errors, SLO breaches, slowest-N per
+    # route/tenant, exemplar-pinned traces, and a deterministic sample
+    # survive; GET /admin/trace/{id} stitches the cross-layer waterfall
+    if settings.trace_store_enabled:
+        from ..observability.trace_store import TraceStore
+        trace_store = TraceStore(
+            max_traces=settings.trace_store_max_traces,
+            max_spans_per_trace=settings.trace_store_max_spans,
+            sample_every=settings.trace_store_sample_every,
+            slowest_per_key=settings.trace_store_slowest_per_key,
+            idle_finalize_s=settings.trace_store_idle_finalize_s,
+            slo_targets={
+                "http": settings.slo_http_p95_ms / 1e3,
+                "ttft": settings.slo_ttft_p95_ms / 1e3,
+                "tpot": settings.slo_tpot_p95_ms / 1e3,
+                "queue_wait": settings.slo_queue_wait_p95_ms / 1e3,
+            },
+            exemplars=metrics.exemplars)
+        tracer.add_sink(trace_store.sink)
+        app["trace_store"] = trace_store
+        ctx.extras["trace_store"] = trace_store
     app["ctx"] = ctx
     app["rate_limiter"] = RateLimiter(settings.rate_limit_rps, settings.rate_limit_burst)
 

@@ -166,6 +166,18 @@ class FlightRecorder:
         """Worst-duration-first."""
         return [entry for _, _, entry in reversed(self._slowest)]
 
+    def find_trace(self, trace_id: str) -> dict[str, Any] | None:
+        """The recorder row for one trace id (slowest ring first — the
+        waterfall endpoint's gateway-side join; a row present in both
+        rings is the same dict object)."""
+        for entry in self.slowest():
+            if entry.get("trace_id") == trace_id:
+                return entry
+        for entry in reversed(self.recent):
+            if entry.get("trace_id") == trace_id:
+                return entry
+        return None
+
     def snapshot(self, limit: int = 64,
                  tenant: str | None = None) -> dict[str, Any]:
         """Ring contents; ``tenant`` filters both rings to one tenant's

@@ -203,6 +203,11 @@ async def observability_middleware(request: web.Request, handler: Handler) -> we
         attrs.update({f"baggage.{k}": v for k, v in baggage.items()})
     with ctx.tracer.span("http.request", attrs,
                          traceparent=request.headers.get("traceparent")) as span:
+        # route TEMPLATE for bounded-cardinality consumers (the trace
+        # store's slowest-per-route tables); unmatched paths are
+        # client-controlled and collapse to one key
+        span.set_attribute("http.route",
+                           path_label if route is not None else "unmatched")
         response = await handler(request)
         span.set_attribute("http.status_code", response.status)
         elapsed = time.monotonic() - started
@@ -210,12 +215,20 @@ async def observability_middleware(request: web.Request, handler: Handler) -> we
         # tenant resolved by the auth middleware (deeper in the chain —
         # set by the time the handler returns); requests rejected before
         # auth (rate limit, header size) read as anonymous. Clamped: the
-        # label child set stays bounded at tenant_label_clamp + 1
+        # label child set stays bounded at tenant_label_clamp + 1. The
+        # span carries the EXACT tenant (bounded store, no cardinality
+        # concern) so the trace store can slice slowest-N per tenant,
+        # and the observe rides a trace-id exemplar: a p99 spike on the
+        # http histogram clicks through to a retained trace
+        span.set_attribute("gw.tenant",
+                           request.get("tenant") or tenant_ctx.ANONYMOUS)
+        tenant_label = ctx.metrics.tenant_clamp.label(
+            request.get("tenant") or tenant_ctx.ANONYMOUS)
         ctx.metrics.http_duration.labels(
-            request.method, path_label,
-            ctx.metrics.tenant_clamp.label(
-                request.get("tenant") or tenant_ctx.ANONYMOUS)
-        ).observe(elapsed)
+            request.method, path_label, tenant_label,
+        ).observe(elapsed, exemplar=ctx.metrics.exemplar(
+            "http_duration", elapsed, span.trace_id,
+            (request.method, path_label, tenant_label)))
         perf = ctx.extras.get("perf_tracker")
         if perf is not None:
             # the flight recorder (one layer in) already attributed this

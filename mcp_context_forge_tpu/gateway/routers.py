@@ -607,8 +607,14 @@ document.getElementById("f").onsubmit = async (e) => {
     # --------------------------------------------------------------- metrics
     @routes.get("/metrics/prometheus")
     async def prometheus(request: web.Request) -> web.Response:
-        body, content_type = request.app["ctx"].metrics.render()
-        return web.Response(body=body, content_type=content_type.split(";")[0])
+        # content negotiation: a scraper that accepts OpenMetrics gets
+        # the exemplar-bearing exposition (per-bucket trace ids on the
+        # TTFT/TPOT/queue-wait/http histograms — the dashboard's
+        # click-through into /admin/trace/{id}); classic text otherwise
+        body, content_type = request.app["ctx"].metrics.render(
+            accept=request.headers.get("accept", ""))
+        return web.Response(body=body,
+                            headers={"Content-Type": content_type})
 
     @routes.get("/metrics")
     async def metrics_summary(request: web.Request) -> web.Response:

@@ -202,6 +202,67 @@ def setup_extra_routes(app: web.Application) -> None:
         snapshot["backpressure"] = queue_state(request.app)
         return web.json_response(snapshot)
 
+    def _trace_store_or_404(request: web.Request):
+        store = request.app.get("trace_store")
+        if store is None:
+            raise NotFoundError(
+                "request forensics trace store is disabled "
+                "(set MCPFORGE_TRACE_STORE_ENABLED=true)")
+        return store
+
+    @routes.get("/admin/trace")
+    async def trace_list(request: web.Request) -> web.Response:
+        """Retention stats + newest-first retained trace summaries from
+        the tail-sampled trace store (observability/trace_store.py):
+        what survived (errors, SLO breaches, slowest per route/tenant,
+        exemplar pins, the 1-in-M sample) and why. Read-only."""
+        request["auth"].require("observability.read")
+        store = _trace_store_or_404(request)
+        try:
+            limit = int(request.query.get("limit", "64"))
+        except ValueError as exc:
+            raise ValidationFailure("limit must be an integer") from exc
+        return web.json_response(store.snapshot(
+            limit=max(1, min(limit, 1024))))
+
+    @routes.get("/admin/trace/{trace_id}")
+    async def trace_waterfall(request: web.Request) -> web.Response:
+        """THE cross-layer waterfall for one retained trace: the span
+        tree (gateway -> provider -> engine -> KV tiers -> pool requeue
+        hops), the flight recorder's phase vector, and the engine
+        step-ring rows each decode span overlapped (superstep, phases,
+        mfu/hbm_frac) — with containment / sum-of-children invariants.
+        A p99 exemplar on /metrics clicks through to here. Read-only."""
+        request["auth"].require("observability.read")
+        store = _trace_store_or_404(request)
+        trace_id = request.match_info["trace_id"]
+        entry = store.get(trace_id)
+        if entry is None:
+            raise NotFoundError(
+                f"trace {trace_id} is not retained (tail sampling keeps "
+                "errors, SLO breaches, slowest-N, exemplars, and a 1-in-"
+                f"{store.sample_every} sample); the head-sampled span "
+                f"ring at /admin/traces/{trace_id} may still have it")
+        from ..observability.trace_store import stitch_waterfall
+        recorder = request.app.get("flight_recorder")
+        gateway_row = (recorder.find_trace(trace_id)
+                       if recorder is not None else None)
+        engines: dict = {}
+        pool = request.app.get("tpu_engine_pool")
+        if pool is not None:
+            engines = {r.id: r.engine for r in pool.replicas}
+        else:
+            engine = request.app.get("tpu_engine")
+            if engine is not None:
+                engines = {engine.config.replica_id: engine}
+        waterfall = stitch_waterfall(entry["spans"],
+                                     gateway_row=gateway_row,
+                                     engines=engines)
+        waterfall["retention"] = {k: entry[k] for k in
+                                  ("reasons", "breaches", "route",
+                                   "tenant", "status", "truncated")}
+        return web.json_response(waterfall)
+
     @routes.get("/admin/tenants/usage")
     async def tenant_usage(request: web.Request) -> web.Response:
         """Per-tenant usage metering (observability/metering.py): the

@@ -18,7 +18,9 @@ from .tracing import (
 )
 from .metrics import PrometheusRegistry
 from .slo import SloEvaluator, SloObjective, default_objectives
+from .trace_store import ExemplarLedger, TraceStore, stitch_waterfall
 
 __all__ = ["Span", "Tracer", "get_tracer", "init_tracer", "current_span",
            "PrometheusRegistry", "SloEvaluator", "SloObjective",
-           "default_objectives"]
+           "default_objectives", "TraceStore", "ExemplarLedger",
+           "stitch_waterfall"]

@@ -10,9 +10,11 @@ from prometheus_client import (
     generate_latest,
     CONTENT_TYPE_LATEST,
 )
+from prometheus_client.openmetrics import exposition as openmetrics
 
 from .. import __version__
 from .tenant import TenantClamp
+from .trace_store import ExemplarLedger
 
 
 class PrometheusRegistry:
@@ -26,9 +28,17 @@ class PrometheusRegistry:
     instance with the :class:`~.metering.TenantLedger` so metric labels
     and ledger admission agree."""
 
-    def __init__(self, tenant_clamp: TenantClamp | None = None) -> None:
+    def __init__(self, tenant_clamp: TenantClamp | None = None,
+                 exemplars: ExemplarLedger | None = None) -> None:
         self.registry = CollectorRegistry()
         self.tenant_clamp = tenant_clamp or TenantClamp()
+        # per-bucket trace-id exemplars for the latency histograms
+        # (observability/trace_store.py): observe sites call
+        # self.exemplar(...) and pass the result to observe(), and the
+        # trace store keeps every live exemplar's trace retained so the
+        # OpenMetrics click-through never dangles
+        self.exemplars = exemplars if exemplars is not None \
+            else ExemplarLedger()
         self.app_info = Gauge(  # lint: allow[dead-metric] fully populated at registration
             "mcpforge_app_info", "Application info", ["version"], registry=self.registry
         )
@@ -355,6 +365,53 @@ class PrometheusRegistry:
             "Requests whose client went away mid-flight",
             registry=self.registry,
         )
+        # --- OTLP export health (observability/otlp.py): a collector
+        # outage used to log at debug and silently drop the batch; the
+        # exporter now retries with backoff and accounts every span's
+        # fate here, so "traces stopped arriving" is a dashboard fact
+        # rather than a grep through debug logs
+        self.otel_spans_exported = Counter(
+            "mcpforge_otel_spans_exported_total",
+            "Spans successfully delivered to the OTLP collector",
+            registry=self.registry,
+        )
+        self.otel_spans_dropped = Counter(
+            "mcpforge_otel_spans_dropped_total",
+            "Spans dropped by the OTLP exporter, by cause (buffer_full, "
+            "rejected = collector 4xx, retry_exhausted, shutdown = "
+            "undeliverable at process exit)",
+            ["reason"], registry=self.registry,
+        )
+        # exemplar bucket registration: the ledger places an observed
+        # value into its bucket without re-deriving prometheus internals
+        # (docs/observability.md "Request forensics & exemplars")
+        for attr in ("llm_ttft", "llm_tpot", "llm_queue_wait",
+                     "http_duration"):
+            metric = getattr(self, attr)
+            self.exemplars.register(attr, metric._upper_bounds)
 
-    def render(self) -> tuple[bytes, str]:
+    def exemplar(self, metric: str, value: float, trace_id: str | None,
+                 labels: tuple = ()) -> dict[str, str] | None:
+        """The exemplar dict for ``Histogram.observe(value, exemplar=)``
+        — None when exemplars are off or the request is unattributed.
+        Also pins ``trace_id`` in the trace store's retention set via
+        the shared :class:`~.trace_store.ExemplarLedger`. ``labels``
+        must be the SAME label values the ``.labels(...)`` child was
+        selected with: prometheus stores exemplars per labeled child,
+        so a label-blind ledger cell would let tenant B's observe unpin
+        tenant A's trace while A's bucket line still renders it — a
+        dangling click-through."""
+        try:
+            return self.exemplars.note(metric, value, trace_id, labels)
+        except Exception:
+            return None  # telemetry must never break an observe site
+
+    def render(self, accept: str = "") -> tuple[bytes, str]:
+        """Exposition bytes + content type. A scraper that negotiates
+        OpenMetrics (``Accept: application/openmetrics-text``) gets the
+        exemplar-bearing format; everyone else keeps the classic text
+        format (exemplars are syntactically illegal there)."""
+        if "application/openmetrics-text" in (accept or ""):
+            return (openmetrics.generate_latest(self.registry),
+                    openmetrics.CONTENT_TYPE_LATEST)
         return generate_latest(self.registry), CONTENT_TYPE_LATEST

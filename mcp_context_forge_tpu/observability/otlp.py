@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
+import time
 from typing import Any
 
 from .tracing import Span
@@ -61,12 +62,21 @@ def encode_spans(spans: list[Span], service_name: str) -> dict[str, Any]:
 
 class OTLPExporter:
     """Buffers spans from the (sync) tracer sink; an async flusher POSTs
-    them in batches. Dropping is preferred over blocking the request path."""
+    them in batches. A transient delivery failure (collector restart,
+    network blip, 5xx) RETRIES the batch with exponential backoff up to
+    ``max_retries`` before dropping — the old behavior (debug log +
+    silent drop on the first failure) turned every collector rollout
+    into a trace gap nobody could see. Every span's fate lands in
+    ``mcpforge_otel_spans_exported_total`` / ``_dropped_total{reason}``.
+    Dropping is still preferred over blocking the request path: the
+    buffer is bounded and a 4xx rejection (malformed/unauthorized —
+    retrying cannot help) drops immediately."""
 
     def __init__(self, ctx, endpoint: str, service_name: str,
                  headers: dict[str, str] | None = None,
                  flush_interval: float = 2.0, max_buffer: int = 8192,
-                 max_batch: int = 512):
+                 max_batch: int = 512, max_retries: int = 3,
+                 backoff_base_s: float = 0.5):
         self.ctx = ctx
         self.endpoint = endpoint.rstrip("/")
         self.service_name = service_name
@@ -74,16 +84,40 @@ class OTLPExporter:
         self.flush_interval = flush_interval
         self.max_buffer = max_buffer
         self.max_batch = max_batch
+        self.max_retries = max(0, int(max_retries))
+        self.backoff_base_s = max(0.01, float(backoff_base_s))
         self._buffer: list[Span] = []
         self._lock = threading.Lock()
         self._task: asyncio.Task | None = None
+        # in-flight retry state (flusher-task only): the failed batch,
+        # its attempt count, and the earliest monotonic time to retry
+        self._retry_batch: list[Span] | None = None
+        self._retry_attempts = 0
+        self._retry_at = 0.0
         self.exported = 0
         self.dropped = 0
+        self.retries = 0
+
+    @property
+    def _metrics(self):
+        return getattr(self.ctx, "metrics", None)
+
+    def _count_exported(self, n: int) -> None:
+        self.exported += n
+        m = self._metrics
+        if m is not None:
+            m.otel_spans_exported.inc(n)
+
+    def _count_dropped(self, n: int, reason: str) -> None:
+        self.dropped += n
+        m = self._metrics
+        if m is not None:
+            m.otel_spans_dropped.labels(reason=reason).inc(n)
 
     def sink(self, span: Span) -> None:
         with self._lock:
             if len(self._buffer) >= self.max_buffer:
-                self.dropped += 1
+                self._count_dropped(1, "buffer_full")
                 return
             self._buffer.append(span)
 
@@ -99,7 +133,23 @@ class OTLPExporter:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        # final flush: a pending retry gets its last attempt NOW rather
+        # than waiting out a backoff window the process will not live
+        self._retry_at = 0.0
         await self.flush()
+        # whatever the final attempt could not deliver is lost when the
+        # process exits — account for it here instead of leaving a
+        # "retrying in Xs" log (for a retry that will never run) as the
+        # last trace of the loss
+        if self._retry_batch is not None:
+            self._count_dropped(len(self._retry_batch), "shutdown")
+            self._retry_batch = None
+            self._retry_attempts = 0
+        with self._lock:
+            leftover = len(self._buffer)
+            self._buffer.clear()
+        if leftover:
+            self._count_dropped(leftover, "shutdown")
 
     async def _loop(self) -> None:
         while True:
@@ -110,10 +160,20 @@ class OTLPExporter:
                 logger.debug("otlp flush failed", exc_info=True)
 
     async def flush(self) -> None:
+        # the retried batch goes FIRST (span order roughly preserved,
+        # and a still-down collector is discovered before new batches
+        # are risked); not yet due -> wait for the next tick
         while True:
-            with self._lock:
-                batch = self._buffer[: self.max_batch]
-                del self._buffer[: self.max_batch]
+            if self._retry_batch is not None:
+                if time.monotonic() < self._retry_at:
+                    return
+                batch = self._retry_batch
+                retrying = True
+            else:
+                with self._lock:
+                    batch = self._buffer[: self.max_batch]
+                    del self._buffer[: self.max_batch]
+                retrying = False
             if not batch:
                 return
             payload = encode_spans(batch, self.service_name)
@@ -121,13 +181,43 @@ class OTLPExporter:
                 resp = await self.ctx.http_client.post(
                     f"{self.endpoint}/v1/traces", json=payload,
                     headers=self.headers)
-                if resp.status_code >= 400:
+                if 400 <= resp.status_code < 500:
+                    # the collector REJECTED the payload: retrying the
+                    # same bytes cannot succeed — drop, loudly
                     logger.warning("otlp export rejected: %s %s",
                                    resp.status_code, resp.text[:200])
-                    self.dropped += len(batch)
+                    self._count_dropped(len(batch), "rejected")
+                elif resp.status_code >= 500:
+                    self._defer(batch, f"http_{resp.status_code}")
+                    return
                 else:
-                    self.exported += len(batch)
+                    self._count_exported(len(batch))
             except Exception as exc:
-                # collector down: drop the batch, keep serving
-                logger.debug("otlp export failed: %s", exc)
-                self.dropped += len(batch)
+                # collector down / network blip: transient by default
+                self._defer(batch, f"{type(exc).__name__}: {exc}")
+                return
+            if retrying:
+                self._retry_batch = None
+                self._retry_attempts = 0
+
+    def _defer(self, batch: list[Span], cause: str) -> None:
+        """Schedule a failed batch for retry with exponential backoff,
+        dropping it only after ``max_retries`` attempts."""
+        attempts = self._retry_attempts + 1 if self._retry_batch is batch \
+            else 1
+        if attempts > self.max_retries:
+            logger.warning(
+                "otlp export dropped %d span(s) after %d attempt(s): %s",
+                len(batch), attempts, cause)
+            self._count_dropped(len(batch), "retry_exhausted")
+            self._retry_batch = None
+            self._retry_attempts = 0
+            return
+        self.retries += 1
+        self._retry_batch = batch
+        self._retry_attempts = attempts
+        backoff = self.backoff_base_s * (2 ** (attempts - 1))
+        self._retry_at = time.monotonic() + backoff
+        logger.warning(
+            "otlp export failed (attempt %d/%d, retrying in %.1fs): %s",
+            attempts, self.max_retries, backoff, cause)

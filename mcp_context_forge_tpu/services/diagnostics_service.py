@@ -510,6 +510,19 @@ class SupportBundleService:
                     # shows which replica's ring was unreadable (e.g.
                     # mid-reload) instead of truncating the loop
                     sections.append((name, {"error": str(exc)}))
+        trace_store = self._ctx.extras.get("trace_store")
+        if trace_store is not None:
+            # request forensics: retention stats + summaries, plus full
+            # span dumps of the newest retained traces so the waterfall
+            # can be stitched OFFLINE from the bundle alone (trace ids
+            # are random hex; span attributes carry no free-text bodies)
+            try:
+                sections.append(("traces.json", {
+                    **trace_store.snapshot(limit=64),
+                    "exported_spans": trace_store.export(limit=16),
+                }))
+            except Exception as exc:
+                sections.append(("traces.json", {"error": str(exc)}))
         records = (ring_buffer.search(limit=log_tail) if include_logs
                    else None)
         perf = self._ctx.extras.get("perf_tracker")

@@ -2,7 +2,7 @@
 
 from ..core import Rule, registered_rules
 from . import (async_blocking, dead_metric, host_sync, jit_discipline,  # noqa: F401
-               thread_boundary)
+               span_stitch, thread_boundary)
 
 
 def active_rules() -> list[Rule]:

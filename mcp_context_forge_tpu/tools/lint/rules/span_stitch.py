@@ -1,0 +1,91 @@
+"""Rule: every emitted span name must be stitchable into the waterfall.
+
+The request forensics plane (``observability/trace_store.py``) stitches
+a trace's spans into one cross-layer waterfall by NAME: the stitch table
+``STITCH_SPANS`` maps each span name to its serving layer, and the
+``/admin/trace/{id}`` invariants, layer counts, and tier/requeue joins
+all key on it. A span emitted under a name the table does not know still
+records — but falls into the "other" layer and outside every join,
+which is exactly how a new subsystem's latency silently escapes the
+forensics view (the pre-PR-13 pool requeue path was invisible this way).
+
+Statically enforced: every call to ``Tracer.emit_span`` (the off-thread
+producer API) or the engine's ``_span`` wrapper whose span name is a
+STRING LITERAL must name a key of ``STITCH_SPANS`` or a member of
+``STITCH_ALLOWLIST`` (both literal-eval'd from the trace-store module's
+AST — this rule runs pre-deps, so it must not import the package).
+Dynamic names (f-strings, variables) are out of scope for a static
+check and are not flagged.
+
+Dead-metric's sibling: dead-metric catches registered-but-never-fed;
+this catches emitted-but-never-stitched.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding, Rule, register
+
+SPAN_EMITTERS = {"emit_span", "_span"}
+TABLE_NAMES = ("STITCH_SPANS", "STITCH_ALLOWLIST")
+STORE_MODULE = "observability/trace_store.py"
+
+
+def _load_stitch_tables(contexts: list[FileContext]
+                        ) -> tuple[set[str], str] | None:
+    """(known span names, store path) from the trace-store module's
+    literal tables; None when the run's file subset excludes it."""
+    for ctx in contexts:
+        if not ctx.path.replace("\\", "/").endswith(STORE_MODULE):
+            continue
+        known: set[str] = set()
+        for node in ctx.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name) and \
+                        target.id in TABLE_NAMES:
+                    value = ast.literal_eval(node.value)
+                    if isinstance(value, dict):
+                        known.update(str(k) for k in value)
+                    else:
+                        known.update(str(v) for v in value)
+        return known, ctx.path
+    return None
+
+
+@register
+class SpanStitchRule(Rule):
+    rule_id = "span-stitch"
+    description = ("span name emitted via Tracer.emit_span but absent "
+                   "from the trace-store stitch table — the waterfall "
+                   "cannot place it")
+
+    def check_project(self, contexts: list[FileContext]) -> Iterator[Finding]:
+        loaded = _load_stitch_tables(contexts)
+        if loaded is None:
+            return iter(())  # subset run without the store: nothing to do
+        known, _store_path = loaded
+        findings: list[Finding] = []
+        for ctx in contexts:
+            for node in ast.walk(ctx.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in SPAN_EMITTERS
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                name = node.args[0].value
+                if name in known:
+                    continue
+                findings.append(Finding(
+                    self.rule_id, ctx.path, node.lineno,
+                    f"span {name!r} is emitted here but absent from "
+                    f"STITCH_SPANS/STITCH_ALLOWLIST in "
+                    f"observability/trace_store.py — add it to the "
+                    f"stitch table (with its layer) so the waterfall "
+                    f"can place it, or allow[span-stitch] with a reason"))
+        return iter(findings)

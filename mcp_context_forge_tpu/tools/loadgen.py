@@ -258,6 +258,100 @@ class SloWindow:
         }
 
 
+async def probe_slowest_trace(client, auth,
+                              since_ts: float | None = None
+                              ) -> dict[str, Any]:
+    """The no-vacuous rule for request forensics (the trace-side twin
+    of :func:`assert_slo_measured`): after a scenario, its SLOWEST
+    request — the one an operator would chase — must be retrievable at
+    ``/admin/trace/{id}`` as a complete stitched waterfall. Returns
+    ``{"trace_id", "duration_ms", "spans", "waterfall_complete",
+    "problems": [...]}`` — empty problems = forensics held up.
+
+    ``since_ts`` scopes the pick to rows recorded at/after that wall
+    time: the flight recorder's rings span the whole gateway lifetime,
+    and back-to-back scenarios against one gateway must each probe
+    THEIR OWN slowest request, not keep re-validating whichever earlier
+    scenario was globally slowest.
+
+    Retention is GLOBAL while the window is per-scenario, so the
+    scenario's slowest row can legitimately have been displaced from
+    the slowest-per-route tables by an earlier scenario's slower
+    requests (and its transient exemplar pin replaced). The probe
+    therefore walks the window's rows slowest-first and validates the
+    slowest RETAINED one — deterministic across shared-gateway runs —
+    recording a displacement note; it hard-fails only when NO in-window
+    trace is retained at all (forensics genuinely dark for the
+    scenario).
+
+    Checks on the picked trace: the waterfall has spans, the gateway
+    phase vector (summing to the row's wall — the existing flight-
+    recorder invariant, re-asserted over the stitched surface), and its
+    containment invariants hold."""
+    problems: list[str] = []
+    out: dict[str, Any] = {"trace_id": None, "duration_ms": None,
+                           "spans": 0, "waterfall_complete": False,
+                           "displaced": 0, "problems": problems}
+    resp = await client.get("/admin/gateway/requests?limit=256",
+                            auth=auth)
+    if resp.status != 200:
+        problems.append(f"/admin/gateway/requests -> {resp.status}")
+        return out
+    snapshot = await resp.json()
+    rows = list(snapshot.get("slowest") or []) \
+        + list(snapshot.get("recent") or [])
+    if since_ts is not None:
+        rows = [r for r in rows if r.get("ts", 0.0) >= since_ts]
+    if not rows:
+        problems.append("flight recorder has no request rows"
+                        + (" in the scenario window" if since_ts else ""))
+        return out
+    rows.sort(key=lambda r: r.get("duration_ms", 0.0), reverse=True)
+    if not rows[0].get("trace_id"):
+        problems.append("slowest request row carries no trace_id")
+        return out
+    waterfall = None
+    for row in rows:
+        trace_id = row.get("trace_id")
+        if not trace_id:
+            continue
+        resp = await client.get(f"/admin/trace/{trace_id}", auth=auth)
+        if resp.status == 200:
+            out["trace_id"] = trace_id
+            out["duration_ms"] = row.get("duration_ms")
+            waterfall = await resp.json()
+            break
+        out["displaced"] += 1
+    if waterfall is None:
+        problems.append(
+            f"none of the window's {len(rows)} request traces is "
+            f"retained: /admin/trace has no forensics for this scenario")
+        return out
+    out["spans"] = waterfall.get("span_count", 0)
+    out["waterfall_complete"] = bool(waterfall.get("complete"))
+    if not waterfall.get("span_count"):
+        problems.append(f"trace {trace_id} stitched to zero spans")
+    gateway = waterfall.get("gateway")
+    if gateway is None:
+        problems.append(f"trace {trace_id} has no gateway flight-"
+                        f"recorder join")
+    else:
+        drift = abs(gateway.get("phase_sum_ms", 0.0)
+                    - gateway.get("duration_ms", 0.0))
+        if drift > 2.0:
+            problems.append(
+                f"trace {trace_id} gateway phase sum diverges from "
+                f"wall by {drift:.2f} ms")
+    inv = waterfall.get("invariants") or {}
+    if not inv.get("children_within_parent"):
+        problems.append(f"trace {trace_id}: child spans escape their "
+                        f"parent window")
+    if not inv.get("child_cover_le_wall"):
+        problems.append(f"trace {trace_id}: children cover more wall "
+                        f"than their parent")
+    return out
+
+
 def assert_slo_measured(slo: dict[str, Any],
                         objectives: Sequence[str]) -> list[str]:
     """The no-vacuous-pass rule for scenario SLOs: each named objective

@@ -503,7 +503,7 @@ class TPUEngine:
                 self._owned_tier_store = store
             self._tier_client = TierClient(config.replica_id, store=store,
                                            index=prefix_index,
-                                           metrics=metrics)
+                                           metrics=metrics, tracer=tracer)
         # dispatch-side export snapshot for the per-tier hit counters
         self._tier_hits_exported: dict[str, int] = {}  # lint: thread[dispatch]
         # the fused super-step width every decode dispatch scans over
@@ -1907,26 +1907,38 @@ class TPUEngine:
                         config.max_seq_len)
             slot = free_slots[len(admitted)]
             shared: list[int] = []
-            if request.hist:
-                hist, shared = self.allocator.match_prefix(request.prompt_ids)
-                if hist != request.hist:
-                    # the cache moved between probe and admission (eviction
-                    # or a longer registration): re-probe for a new bucket
+            # trace attribution for tier IO: spills/restores the match +
+            # allocate below trigger emit tier.spill/tier.restore spans
+            # into THIS request's trace (cleared after — spills forced by
+            # later decode-time page growth stay unattributed)
+            if self._tier_client is not None:
+                self._tier_client.trace_ctx = request.trace_ctx
+            try:
+                if request.hist:
+                    hist, shared = self.allocator.match_prefix(
+                        request.prompt_ids)
+                    if hist != request.hist:
+                        # the cache moved between probe and admission
+                        # (eviction or a longer registration): re-probe
+                        # for a new bucket
+                        self.allocator.release_prefix(shared)
+                        request.bucket = -1
+                        self._pending.appendleft(request)
+                        continue
+                if not self.allocator.allocate_slot(slot, total,
+                                                    prefix_pages=shared):
+                    # page pressure: release the match (references held
+                    # past this point would pin pages and could deadlock
+                    # admission) and retry later with a fresh probe
+                    if self.metrics is not None:
+                        self.metrics.llm_kv_alloc_failures.inc()
                     self.allocator.release_prefix(shared)
                     request.bucket = -1
                     self._pending.appendleft(request)
                     continue
-            if not self.allocator.allocate_slot(slot, total,
-                                                prefix_pages=shared):
-                # page pressure: release the match (references held past
-                # this point would pin pages and could deadlock admission)
-                # and retry later with a fresh probe
-                if self.metrics is not None:
-                    self.metrics.llm_kv_alloc_failures.inc()
-                self.allocator.release_prefix(shared)
-                request.bucket = -1
-                self._pending.appendleft(request)
-                continue
+            finally:
+                if self._tier_client is not None:
+                    self._tier_client.trace_ctx = None
             if shared and self.ledger is not None:
                 # discounted prefill: these tokens were served from shared
                 # prefix-cache pages. Same site semantics as the
@@ -2888,15 +2900,29 @@ class TPUEngine:
         shared TenantClamp bounds the exported child set)."""
         return self.metrics.tenant_clamp.label(request.tenant)
 
+    def _exemplar(self, metric: str, value: float, request: GenRequest,
+                  labels: tuple = ()) -> dict[str, str] | None:
+        """Trace-id exemplar for a latency observe (None when the
+        request is unattributed or exemplars are off) — the forensics
+        click-through from a histogram bucket to the retained trace.
+        ``labels`` must match the ``.labels(...)`` child the observe
+        targets (prometheus keeps exemplars per labeled child)."""
+        if self.metrics is None or request.trace_ctx is None:
+            return None
+        return self.metrics.exemplar(metric, value, request.trace_ctx[0],
+                                     labels)
+
     def _observe_admitted(self, request: GenRequest) -> None:
         """Queue-phase telemetry at the moment a request wins a slot."""
         if request.queue_observed:
             return  # re-admission after crash recovery
         request.queue_observed = True
         if self.metrics is not None:
-            self.metrics.llm_queue_wait.labels(
-                tenant=self._tenant_label(request)).observe(
-                max(0.0, request.queue_ms / 1e3))
+            wait_s = max(0.0, request.queue_ms / 1e3)
+            tenant = self._tenant_label(request)
+            self.metrics.llm_queue_wait.labels(tenant=tenant).observe(
+                wait_s, exemplar=self._exemplar("llm_queue_wait", wait_s,
+                                                request, (tenant,)))
         self._span("llm.queue", request, request.created, time.time(),
                    **{"llm.queue_ms": round(request.queue_ms, 2),
                       "llm.priority": request.priority})
@@ -2908,11 +2934,15 @@ class TPUEngine:
         n = len(request.generated)
         decode_start = request.first_token_ts or now
         if self.metrics is not None and n > 1:
+            tpot_s = max(0.0, (now - decode_start) / (n - 1))
+            tenant = self._tenant_label(request)
             self.metrics.llm_tpot.labels(
                 model=self.config.model,
                 replica=self.config.replica_id,
-                tenant=self._tenant_label(request)).observe(
-                max(0.0, (now - decode_start) / (n - 1)))
+                tenant=tenant).observe(
+                tpot_s, exemplar=self._exemplar(
+                    "llm_tpot", tpot_s, request,
+                    (self.config.model, self.config.replica_id, tenant)))
         if self.ledger is not None and request.slot >= 0:
             # HBM residency: pages this request held x its resident wall
             # (admission -> retire; pages are still held here — the
@@ -2964,11 +2994,17 @@ class TPUEngine:
             if not request.ttft_observed:
                 request.ttft_observed = True
                 if self.metrics is not None:
+                    ttft_s = max(0.0,
+                                 request.first_token_ts - request.created)
+                    tenant = self._tenant_label(request)
                     self.metrics.llm_ttft.labels(
                         model=self.config.model,
                         replica=self.config.replica_id,
-                        tenant=self._tenant_label(request)).observe(
-                        max(0.0, request.first_token_ts - request.created))
+                        tenant=tenant).observe(
+                        ttft_s, exemplar=self._exemplar(
+                            "llm_ttft", ttft_s, request,
+                            (self.config.model, self.config.replica_id,
+                             tenant)))
                 self._span("llm.prefill", request, request.created
                            + request.queue_ms / 1e3, request.first_token_ts,
                            **{"gen_ai.usage.prompt_tokens":

@@ -444,17 +444,35 @@ class TierClient:
     def __init__(self, replica_id: str,
                  store: TieredPageStore | None = None,
                  index: "PrefixIndex | None" = None,
-                 metrics=None) -> None:
+                 metrics=None, tracer=None) -> None:
         self.replica_id = replica_id
         self.store = store
         self.index = index
         self.metrics = metrics
+        self.tracer = tracer
+        # trace attribution handoff: the engine's admission path sets
+        # this to the admitting request's (trace_id, span_id) around
+        # match/allocate so spill/restore IO lands as tier.spill /
+        # tier.restore spans inside that request's waterfall (set and
+        # read on the same dispatch thread; None = unattributed, no span)
+        self.trace_ctx: tuple[str, str] | None = None
         self.read_fn: Callable[[int], SpilledPage] | None = None
         self.write_fn: Callable[[int, SpilledPage], None] | None = None
         self.spills = 0
         self.restores = 0
         self.spill_ms: deque[float] = deque(maxlen=256)
         self.restore_ms: deque[float] = deque(maxlen=256)
+
+    def _emit_io_span(self, name: str, wall_start: float,
+                      attrs: dict[str, Any]) -> None:
+        if self.tracer is None or self.trace_ctx is None:
+            return
+        try:
+            self.tracer.emit_span(
+                name, wall_start, time.time(), trace_ctx=self.trace_ctx,
+                attributes={"llm.replica_id": self.replica_id, **attrs})
+        except Exception:
+            pass  # telemetry must never break the dispatch thread
 
     @property
     def active(self) -> bool:
@@ -493,6 +511,7 @@ class TierClient:
         if self.store.probe(key_hash):
             return True
         started = time.monotonic()
+        wall_start = time.time()
         payload = self.read_fn(page)
         payload.chunk = tuple(chunk)
         payload.parent = parent
@@ -503,6 +522,9 @@ class TierClient:
         if self.metrics is not None:
             self.metrics.llm_prefix_tier_io.labels(
                 op="spill", tier="host").observe(elapsed)
+        self._emit_io_span("tier.spill", wall_start, {
+            "tier.tier": "host", "tier.tokens": len(payload.chunk),
+            "tier.bytes": payload.nbytes})
         return True
 
     def restore(self, key_hash: bytes, parent: bytes, chunk: Sequence[int],
@@ -513,6 +535,7 @@ class TierClient:
         if not self.active:
             return None
         started = time.monotonic()
+        wall_start = time.time()
         hit = self.store.get(key_hash, parent, chunk)
         if hit is None:
             return None
@@ -524,6 +547,9 @@ class TierClient:
         if self.metrics is not None:
             self.metrics.llm_prefix_tier_io.labels(
                 op="restore", tier=tier).observe(elapsed)
+        self._emit_io_span("tier.restore", wall_start, {
+            "tier.tier": tier, "tier.tokens": len(payload.chunk),
+            "tier.bytes": payload.nbytes})
         return tier
 
     # ------------------------------------------------------------------ stats

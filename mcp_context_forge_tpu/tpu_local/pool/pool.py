@@ -537,7 +537,25 @@ class EnginePool:
                        "(%d tokens already delivered)", request.request_id,
                        old.id, len(request.generated),
                        extra=trace_extra(request.trace_ctx))
+        requeued_at = time.time()
         await self._dispatch(request, attempts=record.attempts + 1)
+        if self.tracer is not None and request.trace_ctx is not None:
+            # the failover hop as a span: joins the killed replica's
+            # llm.* spans to the successor's in ONE trace, tenant
+            # intact — the forensics waterfall renders the hop instead
+            # of two disconnected half-requests
+            try:
+                attrs = {"llm.from_replica": old.id,
+                         "llm.attempt": record.attempts + 1,
+                         "llm.tokens_delivered": len(request.generated)}
+                if request.tenant:
+                    attrs["llm.tenant"] = request.tenant
+                self.tracer.emit_span("pool.requeue", requeued_at,
+                                      time.time(),
+                                      trace_ctx=request.trace_ctx,
+                                      attributes=attrs)
+            except Exception:
+                pass  # telemetry must never break failover
 
     # ------------------------------------------------------------ drain/reload
 

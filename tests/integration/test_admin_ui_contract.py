@@ -76,6 +76,10 @@ async def test_every_tab_endpoint_answers_with_consumable_shape():
                 # flight-recorder snapshot: rings + loop health blocks
                 assert "slowest" in data and "recent" in data, (name, data)
                 assert "loop" in data, (name, data)
+            elif spec.get("special") == "forensics":
+                # trace-store snapshot: retention stats + retained rows
+                assert "retained" in data and "traces" in data, (name, data)
+                assert "max_traces" in data, (name, data)
             elif spec.get("special") == "tenants":
                 # tenant metering: ledger rows + clamp + rollup blocks
                 assert "tenants" in data and "clamp" in data, (name, data)

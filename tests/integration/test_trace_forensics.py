@@ -1,0 +1,185 @@
+"""Request forensics plane, wired end-to-end through the gateway: a chat
+request's trace is tail-retained, ``GET /admin/trace/{id}`` stitches the
+cross-layer waterfall (gateway flight-recorder phase vector ↔ provider ↔
+engine spans ↔ step-ring rows) with its containment invariants holding,
+the retained-trace listing explains WHY each trace survived, and
+``/metrics/prometheus`` exports per-bucket trace-id exemplars in
+OpenMetrics syntax whose targets are retained (the dashboard
+click-through can never dangle)."""
+
+import io
+import re
+import zipfile
+
+import aiohttp
+from aiohttp.test_utils import TestClient, TestServer
+
+from mcp_context_forge_tpu.config import load_settings
+from mcp_context_forge_tpu.gateway.app import build_app
+
+AUTH = aiohttp.BasicAuth("admin", "changeme")
+
+
+async def _make_gateway(**extra_env) -> TestClient:
+    env = {
+        "MCPFORGE_DATABASE_URL": "sqlite:///:memory:",
+        "MCPFORGE_PLUGINS_ENABLED": "false",
+        "MCPFORGE_TPU_LOCAL_ENABLED": "true",
+        "MCPFORGE_TPU_LOCAL_MODEL": "llama3-test",
+        "MCPFORGE_TPU_LOCAL_MAX_BATCH": "4",
+        "MCPFORGE_TPU_LOCAL_MAX_SEQ_LEN": "128",
+        "MCPFORGE_TPU_LOCAL_PAGE_SIZE": "16",
+        "MCPFORGE_TPU_LOCAL_NUM_PAGES": "64",
+        "MCPFORGE_TPU_LOCAL_PREFILL_BUCKETS": "64",
+        "MCPFORGE_TPU_LOCAL_DTYPE": "float32",
+        "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
+        **extra_env,
+    }
+    app = await build_app(load_settings(env=env, env_file=None))
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def _chat(client, max_tokens=8):
+    resp = await client.post("/v1/chat/completions", auth=AUTH, json={
+        "model": "llama3-test",
+        "messages": [{"role": "user", "content": "forensics probe"}],
+        "max_tokens": max_tokens})
+    assert resp.status == 200, await resp.text()
+    return await resp.json()
+
+
+async def test_chat_trace_retained_and_waterfall_stitches():
+    client = await _make_gateway()
+    try:
+        await _chat(client)
+        rows = await (await client.get("/admin/gateway/requests?limit=4",
+                                       auth=AUTH)).json()
+        row = next(r for r in rows["recent"]
+                   if r["path"] == "/v1/chat/completions")
+        trace_id = row["trace_id"]
+        resp = await client.get(f"/admin/trace/{trace_id}", auth=AUTH)
+        assert resp.status == 200, await resp.text()
+        wf = await resp.json()
+        names = {s["name"] for s in _flat(wf["tree"])}
+        # the cross-layer join: gateway root, provider request, and the
+        # engine's queue/prefill/decode phases in ONE tree
+        assert {"http.request", "llm.request", "llm.queue", "llm.prefill",
+                "llm.decode"} <= names, names
+        assert wf["complete"], wf["invariants"]
+        assert wf["invariants"]["children_within_parent"]
+        assert wf["invariants"]["child_sum_le_wall"]
+        # flight-recorder join: phase vector present and summing to wall
+        # (the PR-8 invariant, re-asserted over the stitched surface)
+        gw = wf["gateway"]
+        assert gw is not None and gw["phases_ms"]
+        assert abs(gw["phase_sum_ms"] - gw["duration_ms"]) <= 2.0, gw
+        # engine step-ring join: the decode span overlapped real rows
+        assert wf["engine_steps_joined"] >= 1
+        decode = next(s for s in _flat(wf["tree"])
+                      if s["name"] == "llm.decode")
+        assert decode["engine_steps"][0]["kind"] in ("decode",
+                                                     "spec_decode")
+        assert wf["replica_hops"] == ["0"]
+        assert wf["retention"]["reasons"]
+    finally:
+        await client.close()
+
+
+def _flat(tree):
+    out = []
+    stack = list(tree)
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        stack.extend(node.get("children", []))
+    return out
+
+
+async def test_trace_listing_explains_retention_and_404s_unknown():
+    client = await _make_gateway()
+    try:
+        await _chat(client)
+        snap = await (await client.get("/admin/trace", auth=AUTH)).json()
+        assert snap["retained"] >= 1
+        assert snap["retained"] <= snap["max_traces"]
+        trace = snap["traces"][0]
+        assert trace["reasons"], trace
+        assert trace["route"]
+        # unknown trace: 404 with the retention policy in the message
+        resp = await client.get(f"/admin/trace/{'f' * 32}", auth=AUTH)
+        assert resp.status == 404
+        assert "tail sampling" in (await resp.json())["detail"]
+        # disabled store: distinct 404
+        bare = await _make_gateway(MCPFORGE_TRACE_STORE_ENABLED="false")
+        try:
+            resp = await bare.get("/admin/trace", auth=AUTH)
+            assert resp.status == 404
+        finally:
+            await bare.close()
+    finally:
+        await client.close()
+
+
+async def test_openmetrics_exemplars_click_through_to_retained_traces():
+    client = await _make_gateway()
+    try:
+        await _chat(client)
+        # classic text format: no exemplar syntax (it would be illegal)
+        resp = await client.get("/metrics/prometheus", auth=AUTH)
+        classic = await resp.text()
+        assert "# {trace_id=" not in classic
+        # OpenMetrics negotiation: exemplars ride the latency buckets
+        resp = await client.get("/metrics/prometheus", auth=AUTH, headers={
+            "accept": "application/openmetrics-text; version=1.0.0"})
+        assert "openmetrics-text" in resp.headers["Content-Type"]
+        body = await resp.text()
+        assert body.rstrip().endswith("# EOF")
+        exemplar_ids = set(re.findall(
+            r'# \{trace_id="([0-9a-f]{32})"\}', body))
+        assert exemplar_ids, "no exemplars in the OpenMetrics exposition"
+        # engine-side histograms carry them too, not just the http tier
+        assert re.search(
+            r'mcpforge_llm_ttft_seconds_bucket\{[^}]*\} \d+\.\d+ '
+            r'# \{trace_id=', body), "llm_ttft lost its exemplars"
+        # THE click-through contract: every live exemplar's trace is
+        # retained — /admin/trace/{id} serves a stitched waterfall
+        store = client.app["trace_store"]
+        for trace_id in exemplar_ids:
+            resp = await client.get(f"/admin/trace/{trace_id}", auth=AUTH)
+            assert resp.status == 200, \
+                f"exemplar {trace_id} dangles (not retained)"
+        assert store.exemplars.stats()["pinned_traces"] >= 1
+    finally:
+        await client.close()
+
+
+async def test_support_bundle_ships_traces_json():
+    client = await _make_gateway()
+    try:
+        await _chat(client)
+        _, payload = await \
+            client.app["support_bundle_service"].generate()
+        with zipfile.ZipFile(io.BytesIO(payload)) as zf:
+            names = set(zf.namelist())
+            assert "traces.json" in names, names
+            import json
+            traces = json.loads(zf.read("traces.json"))
+            assert traces["retained"] >= 1
+            assert traces["exported_spans"], \
+                "bundle traces.json has no offline-stitchable spans"
+            assert traces["exported_spans"][0]["spans"]
+    finally:
+        await client.close()
+
+
+async def test_exemplars_can_be_disabled():
+    client = await _make_gateway(MCPFORGE_METRICS_EXEMPLARS="false")
+    try:
+        await _chat(client)
+        resp = await client.get("/metrics/prometheus", auth=AUTH, headers={
+            "accept": "application/openmetrics-text"})
+        assert "# {trace_id=" not in await resp.text()
+    finally:
+        await client.close()

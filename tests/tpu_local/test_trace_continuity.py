@@ -1,0 +1,131 @@
+"""Chaos-path trace continuity: a replica kill mid-decode must NOT cut
+the request's trace in half. The killed replica's spans (queue/prefill
+on replica A), the pool's requeue hop, and the successor's spans (decode
+on replica B) all land in ONE retained trace, with the tenant label
+conserved end-to-end — the forensics waterfall renders the failover
+instead of two disconnected half-requests. (The pool-level twin of the
+bench chaos scenario's /admin/trace assertion.)"""
+
+import asyncio
+
+from mcp_context_forge_tpu.observability.trace_store import (TraceStore,
+                                                             stitch_waterfall)
+from mcp_context_forge_tpu.observability.tracing import Tracer
+from mcp_context_forge_tpu.tpu_local.engine import EngineConfig, GenRequest
+from mcp_context_forge_tpu.tpu_local.pool import EnginePool
+
+TENANT = "user:chaos@forensics.test"
+
+
+def _pool(tracer):
+    config = EngineConfig(model="llama3-test", max_batch=4, max_seq_len=128,
+                          page_size=16, num_pages=64,
+                          prefill_buckets=(16, 64), dtype="float32",
+                          attn_impl="reference")
+    return EnginePool(config, replicas=2, tracer=tracer,
+                      health_interval_s=0.05, heartbeat_timeout_s=10.0)
+
+
+def test_requeued_request_trace_shows_both_replica_hops_tenant_intact():
+    tracer = Tracer(exporter="none")
+    store = TraceStore(max_traces=64, sample_every=0, idle_finalize_s=60.0)
+    tracer.add_sink(store.sink)
+
+    async def main():
+        pool = _pool(tracer)
+        await pool.start()
+        trace_ids: list[str] = []
+        try:
+            from mcp_context_forge_tpu.utils.ids import new_id
+
+            async def gen(i: int) -> list[int]:
+                # each request under its own llm.request root span, the
+                # way tpu_provider parents engine spans in production
+                with tracer.span("llm.request") as root:
+                    ids = pool.tokenizer.encode(
+                        f"chaos continuity prompt {i} with extra words")
+                    request = GenRequest(request_id=new_id(),
+                                         prompt_ids=ids, max_tokens=24,
+                                         tenant=TENANT,
+                                         trace_ctx=root.context())
+                    trace_ids.append(root.trace_id)
+                    await pool.submit(request)
+                    out = []
+                    while True:
+                        token = await request.stream.get()
+                        if token is None:
+                            return out
+                        out.append(token)
+
+            async def kill_when_busy():
+                # fire once a replica holds work that has already
+                # emitted tokens — the kill must land MID-STREAM
+                for _ in range(5000):
+                    ready = [r for r in pool.replicas
+                             if r.state == "ready"]
+                    busy = max(ready, key=lambda r: len(r.outstanding),
+                               default=None)
+                    if busy is not None and any(
+                            len(rec.request.generated) > 0
+                            for rec in busy.outstanding.values()):
+                        pool.fail_replica(
+                            busy, reason="trace-continuity chaos kill")
+                        return busy.id
+                    await asyncio.sleep(0.002)
+                return None
+
+            kill_task = asyncio.ensure_future(kill_when_busy())
+            outs = await asyncio.gather(*[gen(i) for i in range(4)])
+            killed_rid = await kill_task
+            assert killed_rid is not None, "kill never fired"
+            assert pool.requeues >= 1
+            assert all(outs), "a stream was lost across the kill"
+        finally:
+            await pool.stop()
+
+        # find the requeued request's RETAINED trace
+        requeued = None
+        for trace_id in trace_ids:
+            entry = store.get(trace_id)
+            if entry is None:
+                continue
+            if any(s["name"] == "pool.requeue" for s in entry["spans"]):
+                requeued = entry
+                break
+        assert requeued is not None, \
+            "no retained trace shows the requeue hop"
+        spans = requeued["spans"]
+
+        # the kill event: the requeue span names the dead replica
+        requeue = next(s for s in spans if s["name"] == "pool.requeue")
+        assert requeue["attributes"]["llm.from_replica"] == killed_rid
+        assert requeue["attributes"]["llm.tenant"] == TENANT
+
+        # BOTH hops present: the killed replica's admission-side spans
+        # and the survivor's decode, in one trace
+        by_replica: dict[str, set] = {}
+        for span in spans:
+            rid = span["attributes"].get("llm.replica_id")
+            if rid is not None:
+                by_replica.setdefault(str(rid), set()).add(span["name"])
+        assert len(by_replica) == 2, by_replica
+        assert killed_rid in by_replica
+        survivor = next(r for r in by_replica if r != killed_rid)
+        assert "llm.decode" in by_replica[survivor], by_replica
+
+        # tenant conserved end-to-end: EVERY engine-side span carries it
+        for span in spans:
+            if span["name"].startswith("llm.") and \
+                    span["name"] != "llm.request":
+                assert span["attributes"].get("llm.tenant") == TENANT, span
+
+        # and the stitched waterfall agrees: two hops, one tenant, the
+        # union-cover invariant holding across the overlap
+        wf = stitch_waterfall(spans)
+        assert sorted(wf["replica_hops"]) == sorted(by_replica)
+        assert wf["tenants"] == [TENANT]
+        assert len(wf["requeues"]) == 1
+        assert wf["invariants"]["child_cover_le_wall"], wf["invariants"]
+        assert wf["invariants"]["children_within_parent"], wf["invariants"]
+
+    asyncio.run(main())

@@ -72,6 +72,16 @@ def log(entry: dict) -> None:
     print(json.dumps(entry), flush=True)
 
 
+def write_json_atomic(path: str, obj: dict) -> None:
+    """Crash-durable artifact write: a dropped tunnel / OOM mid-dump can
+    never leave a truncated JSON where a capture used to be (os.replace
+    is atomic on one filesystem)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(obj, fh, indent=1)
+    os.replace(tmp, path)
+
+
 def probe(budget_s: float = 150.0) -> str:
     code = "import jax; print(jax.default_backend())"
     try:
@@ -126,6 +136,18 @@ def attempt() -> bool:
         if result is not None:
             log({"event": "capture", **result})
             results.append(result)
+            # durable PER-ARM partial: the grid takes hours on a
+            # flapping tunnel, and losing every finished arm to a
+            # mid-round drop is exactly what voided the r05 gateway
+            # window — each completed arm lands on disk immediately
+            write_json_atomic(OUT + ".partial", {
+                "note": "partial capture — arms completed so far "
+                        "(full artifact replaces this at round end)",
+                "arms_completed": len(results),
+                "arms_total": len(GRID),
+                "ab_grid": results,
+                "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            })
     if not results:
         return False
     # with a live window, also capture the GATEWAY bench on the chip
@@ -150,9 +172,8 @@ def attempt() -> bool:
                 gateway["note"] = ("engine configs failed TPU init; "
                                    "headline is the engine-free gateway "
                                    "path only")
-            with open(os.path.join(REPO, "BENCH_GATEWAY_TPU_r06.json"),
-                      "w") as fh:
-                json.dump(gateway, fh, indent=1)
+            write_json_atomic(
+                os.path.join(REPO, "BENCH_GATEWAY_TPU_r06.json"), gateway)
             log({"event": "gateway_capture", "rps": gateway.get("value")})
         else:
             log({"event": "gateway_capture_failed",
@@ -175,9 +196,15 @@ def attempt() -> bool:
         except (json.JSONDecodeError, OSError):
             pass
     if best.get("value", 0) >= prev_best:
-        with open(OUT, "w") as fh:
-            json.dump(artifact, fh, indent=1)
+        write_json_atomic(OUT, artifact)
         log({"event": "artifact_updated", "value": best.get("value")})
+    # the round completed: the per-arm partial is superseded (either by
+    # the fresh OUT or by a better prior round) — don't leave a stale
+    # partial for artifact collection to confuse with a capture
+    try:
+        os.remove(OUT + ".partial")
+    except OSError:
+        pass
     return True
 
 
