@@ -1,6 +1,6 @@
 # mcp-context-forge-tpu (reference: 8.7k-line Makefile; the targets that matter)
 
-.PHONY: serve hub lint bench-check test test-py test-fast test-two-process bench bench-engine bench-superstep bench-scenarios wrapper masking clean \
+.PHONY: serve hub lint bench-check test test-py test-fast test-two-process bench bench-engine bench-superstep bench-scenarios bench-chaos wrapper masking clean \
 	sanitize sanitize-tsan sanitize-asan
 
 serve:
@@ -60,6 +60,14 @@ bench-superstep:
 # them per arm.
 # CPU smoke variant runs in tier-1 (tests/unit/test_bench_scenarios_smoke.py).
 bench-scenarios:
+	python bench_gateway_scenarios.py
+
+# chaos matrix only (docs/resilience.md): fault-injection arms —
+# db-outage / tier-fault / overload-shed / chaos (slow-replica + kill)
+# — against the fault plane; every arm gates on stream integrity,
+# ledger conservation, and breaker transitions
+bench-chaos:
+	BENCH_SCENARIO_ONLY=db-outage,tier-fault,overload-shed,chaos \
 	python bench_gateway_scenarios.py
 
 # real HF-format checkpoint built in-tree (BPE tokenizer.json + safetensors;

@@ -85,6 +85,9 @@ class _SocketClient:
     def get(self, path: str, **kwargs):
         return self._session.get(self._base + path, **kwargs)
 
+    def delete(self, path: str, **kwargs):
+        return self._session.delete(self._base + path, **kwargs)
+
     async def close(self) -> None:
         await self._session.close()
         await self._runner.cleanup()

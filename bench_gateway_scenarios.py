@@ -14,7 +14,25 @@ engine replica pool behind it:
 - **chaos**: replica kill + rolling reload under sustained load —
   in-flight streams must finish on survivors with zero loss/duplication
   (token-level parity vs an uninterrupted reference engine), and the
-  SLO window must REPORT the breach rather than hang or vacuously pass.
+  SLO window must REPORT the breach rather than hang or vacuously pass;
+  an injected slow-replica phase (``engine.dispatch`` latency) runs
+  first — slow must never mean wrong.
+
+Chaos matrix (ISSUE 14, docs/resilience.md) — each arm injects faults
+through the ``POST /admin/faults`` plane and gates on the degradation
+ladder actually engaging:
+
+- **db-outage**: db.execute faults SCOPED to the tenant_usage table —
+  rollup windows park bounded (drop-oldest COUNTED), the ledger.rollup
+  breaker walks open → half_open → closed, recovery re-merges with
+  original stamps, serving + token conservation never waver;
+- **tier-fault**: disk write/read faults against a deliberately tiny
+  host tier — entries quarantine to clean MISSes, the tier.disk breaker
+  opens (T1/HBM keep serving), recovery closes it; zero request
+  failures throughout;
+- **overload-shed**: a slow-dispatch fault saturates a tiny admission
+  queue — the batch SLO class sheds with 429 + Retry-After while the
+  premium class is admitted and holds its targets.
 
 Each scenario evaluates TTFT/TPOT/queue-wait/http-phase SLOs through
 ``GET /admin/slo`` per-consumer delta windows (its own named window, so
@@ -48,10 +66,15 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)) or ".")
 
-# "tenant" runs BEFORE "chaos": its ledger-vs-engine conservation check
-# reads pool.stats, which forgets a replica's counters when chaos's
-# rolling reload rebuilds the engine (the ledger, correctly, does not)
-SCENARIOS = ("burst", "ramp", "mixed", "tenant", "chaos")
+# Ordering constraints: "tenant" and "db-outage" run BEFORE "chaos" —
+# their ledger-vs-engine conservation checks read pool.stats, which
+# forgets a replica's counters when chaos's rolling reload rebuilds the
+# engine (the ledger, correctly, does not). "db-outage" also runs
+# before the dedicated-gateway arms (tier-fault / overload-shed): those
+# builds rebind the process-global fault plane + degradation manager to
+# THEIR app (see _rebind_resilience_plane).
+SCENARIOS = ("burst", "ramp", "mixed", "tenant", "db-outage",
+             "tier-fault", "overload-shed", "chaos")
 
 
 def _smoke() -> bool:
@@ -69,7 +92,12 @@ def _scale() -> dict:
                 "chaos_prompts": 4, "max_tokens": 6,
                 "tenant_concurrency": 4, "tenant_requests": 16,
                 "prefix_concurrency": 3, "prefix_requests": 12,
-                "prefix_template_chars": 80}
+                "prefix_template_chars": 80,
+                "db_outage_flushes": 5, "db_outage_requests": 3,
+                "tier_templates": 8, "tier_requests": 16,
+                "tier_concurrency": 3,
+                "shed_requests": 16, "shed_concurrency": 6,
+                "shed_latency_ms": 30.0}
     return {"burst_phases": [("baseline", 4, 60), ("burst", 64, 400),
                              ("cooldown", 4, 60)],
             "ramp_steps": [4, 8, 16, 32, 16, 8, 4], "ramp_requests": 50,
@@ -78,12 +106,21 @@ def _scale() -> dict:
             "chaos_prompts": 6, "max_tokens": 16,
             "tenant_concurrency": 8, "tenant_requests": 80,
             "prefix_concurrency": 8, "prefix_requests": 64,
-            "prefix_template_chars": 220}
+            "prefix_template_chars": 220,
+            "db_outage_flushes": 6, "db_outage_requests": 10,
+            "tier_templates": 14, "tier_requests": 56,
+            "tier_concurrency": 6,
+            "shed_requests": 48, "shed_concurrency": 10,
+            "shed_latency_ms": 40.0}
 
 
-async def _make_gateway(platform: str, replicas: int = 2):
+async def _make_gateway(platform: str, replicas: int = 2,
+                        extra_env: dict | None = None):
     """Engine-enabled gateway with the replica pool, on a real socket
-    (bench.py's AppRunner/TCPSite plumbing)."""
+    (bench.py's AppRunner/TCPSite plumbing). ``extra_env`` overlays the
+    base env — the dedicated chaos-matrix gateways (tier-fault's tiny
+    host tier, overload-shed's tiny admission queue) shape themselves
+    with it."""
     from bench import _serve_tcp
 
     from mcp_context_forge_tpu.config import load_settings
@@ -153,11 +190,63 @@ async def _make_gateway(platform: str, replicas: int = 2):
         "MCPFORGE_TPU_LOCAL_COMPILE_CACHE_DIR": os.environ.get(
             "MCPFORGE_TPU_LOCAL_COMPILE_CACHE_DIR",
             "/tmp/mcpforge-xla-cache"),
+        # fault-injection plane ARMED (docs/resilience.md): rules are
+        # installed only by the chaos-matrix scenarios through
+        # POST /admin/faults, so the classic scenarios run unperturbed;
+        # fast breaker cooldowns + a small rollup pending buffer keep
+        # the degradation ladder's recovery observable inside one arm
+        "MCPFORGE_FAULT_INJECTION_ENABLED": "true",
+        "MCPFORGE_DEGRADATION_COOLDOWN_S": "0.2",
+        "MCPFORGE_TENANT_ROLLUP_PENDING_MAX": "3",
     }
+    env.update(extra_env or {})
     settings = load_settings(env=env, env_file=None)
     app = await build_app(settings)
     client = await _serve_tcp(app)
     return app, client, model
+
+
+async def _arm_fault(client, auth, rule: dict) -> None:
+    resp = await client.post("/admin/faults", json=rule, auth=auth)
+    assert resp.status == 201, await resp.text()
+
+
+async def _disarm_fault(client, auth, point: str) -> None:
+    resp = await client.delete(f"/admin/faults/{point}", auth=auth)
+    assert resp.status == 200, await resp.text()
+
+
+def _rebind_resilience_plane(app):
+    """Re-bind the PROCESS-GLOBAL fault plane + degradation manager to
+    ``app``. Every build_app() reconfigures the singletons for itself
+    (hermetic tests), and this harness builds several gateways per run
+    (the mixed arm's peer, the dedicated chaos-matrix gateways) — so a
+    fault-matrix scenario first points the plane back at the gateway it
+    is about to drive and re-adopts that gateway's live breakers into
+    the manager's registry."""
+    from mcp_context_forge_tpu.observability.degradation import \
+        configure_degradation
+    from mcp_context_forge_tpu.observability.faults import \
+        configure_fault_plane
+    ctx = app["ctx"]
+    settings = ctx.settings
+    configure_fault_plane(settings.fault_injection_enabled,
+                          metrics=ctx.metrics)
+    manager = configure_degradation(
+        metrics=ctx.metrics,
+        failure_threshold=settings.degradation_failure_threshold,
+        cooldown_s=settings.degradation_cooldown_s)
+    rollup = app.get("tenant_usage_rollup")
+    if rollup is not None:
+        manager.adopt(rollup._breaker)
+    pool = app.get("tpu_engine_pool")
+    store = pool.tier_store if pool is not None else None
+    if store is None:
+        engine = app.get("tpu_engine")
+        store = getattr(engine, "_owned_tier_store", None)
+    if store is not None:
+        manager.adopt(store._disk_breaker)
+    return manager
 
 
 async def _register_echo_tool(client, auth, name: str):
@@ -401,6 +490,412 @@ async def scenario_tenant(app, client, auth, model, scale) -> dict:
     }
 
 
+async def scenario_db_outage(app, client, auth, model, scale) -> dict:
+    """Sustained DB outage against the tenant-usage rollup: db.execute
+    faults SCOPED to the tenant_usage table (auth + the serving data
+    plane stay untouched — that is the degradation claim). Gates:
+    (a) zero request failures while the DB is down; (b) the pending
+    buffer stays bounded and drop-oldest losses are COUNTED, never
+    hidden; (c) the ledger.rollup breaker walks open → half_open →
+    closed, visible in mcpforge_degradation_state; (d) recovery writes
+    the surviving windows with their ORIGINAL stamps; (e) per-tenant
+    ledger conservation vs the engine totals holds EXACTLY across the
+    whole outage."""
+    from mcp_context_forge_tpu.tools.loadgen import (SloWindow, chat_kind,
+                                                     run_phase)
+    manager = _rebind_resilience_plane(app)
+    pool = app["tpu_engine_pool"]
+    ledger = app["tenant_ledger"]
+    rollup = app["tenant_usage_rollup"]
+    settings = app["ctx"].settings
+    window = SloWindow(client, "scenario-db-outage", auth)
+    await window.open()
+    kind = chat_kind(model, max_tokens=scale["max_tokens"])
+    loads = []
+    pending_seen = []
+    failed_flushes = 0
+    rows_before = len(await rollup.recent(limit=200))
+    await _arm_fault(client, auth, {
+        "point": "db.execute", "kind": "error", "mode": "always",
+        "scope": "tenant_usage",
+        "message": "db-outage scenario: tenant_usage is down"})
+    try:
+        for i in range(scale["db_outage_flushes"]):
+            loads.append(await run_phase(
+                client, auth, [kind], name=f"outage-{i}", concurrency=2,
+                requests=scale["db_outage_requests"]))
+            try:
+                await rollup.flush()
+            except Exception:
+                failed_flushes += 1
+            pending_seen.append(rollup.outage_stats()["pending_windows"])
+        mid = rollup.outage_stats()
+        # mid-outage: the degradation gauge must SHOW the open breaker
+        metrics_mid = app["ctx"].metrics.render()[0].decode()
+        gauge_open = ('mcpforge_degradation_state{component='
+                      '"ledger.rollup"} 2.0') in metrics_mid
+        faults_counted = "mcpforge_faults_injected_total" in metrics_mid \
+            and 'point="db.execute"' in metrics_mid
+    finally:
+        await _disarm_fault(client, auth, "db.execute")
+    await asyncio.sleep(settings.degradation_cooldown_s + 0.05)
+    tail = await run_phase(client, auth, [kind], name="recovery",
+                           concurrency=2,
+                           requests=scale["db_outage_requests"])
+    written = await rollup.flush()
+    post = rollup.outage_stats()
+    rows_after = len(await rollup.recent(limit=200))
+    slo = await window.close()
+    # conservation across the outage (valid while nothing reloaded —
+    # this scenario is ordered before chaos for exactly this reason)
+    stats = pool.stats
+    sums = ledger.column_sums()
+    reloaded = any(r.reloads for r in pool.replicas)
+    conserved = reloaded or (
+        sums["prompt_tokens"] == stats.prompt_tokens
+        and sums["generated_tokens"] == stats.completion_tokens)
+    transitions = [t["to"] for t in manager.transitions("ledger.rollup")]
+    requests = sum(p.requests for p in loads) + tail.requests
+    failures = sum(p.failures for p in loads) + tail.failures
+    wall_s = sum(p.wall_s for p in loads) + tail.wall_s
+    latencies = sorted(x for p in loads + [tail] for x in p.latencies_ms)
+    return {
+        "scenario": "db-outage",
+        "value": round(requests / wall_s, 2) if wall_s else 0.0,
+        "p50_ms": round(latencies[len(latencies) // 2], 2)
+        if latencies else None,
+        "p95_ms": round(latencies[min(int(len(latencies) * 0.95),
+                                      len(latencies) - 1)], 2)
+        if latencies else None,
+        "requests": requests, "failures": failures, "wall_s": wall_s,
+        "failed_flushes": failed_flushes,
+        "pending_seen": pending_seen,
+        "windows_dropped": post["windows_dropped"],
+        "tokens_dropped": post["tokens_dropped"],
+        "recovery_rows_written": written,
+        "rollup_rows_delta": rows_after - rows_before,
+        "breaker_mid": mid["breaker"]["state"],
+        "breaker_transitions": transitions,
+        "degradation_gauge_open_observed": gauge_open,
+        "conservation": {
+            "checked": not reloaded,
+            "ledger_prompt": sums["prompt_tokens"],
+            "engine_prompt": stats.prompt_tokens,
+            "ledger_generated": sums["generated_tokens"],
+            "engine_generated": stats.completion_tokens,
+        },
+        "slo": slo, "slo_ok": slo["ok"],
+        "hard_fail": (
+            (failures and f"{failures} request(s) failed during the DB "
+             "outage — the scoped fault must not touch serving")
+            or (failed_flushes == 0 and "the injected outage never "
+                "failed a flush (fault did not fire)")
+            or (max(pending_seen) > rollup.pending_max
+                and f"pending buffer exceeded its bound: {pending_seen}")
+            or (post["windows_dropped"] == 0
+                and "sustained outage never exercised drop-oldest — the "
+                    "loss counter is unproven")
+            or (mid["breaker"]["state"] != "open"
+                and f"breaker was {mid['breaker']['state']} mid-outage, "
+                    "not open")
+            or (not gauge_open and "mcpforge_degradation_state never "
+                "showed ledger.rollup open")
+            or (not faults_counted and "mcpforge_faults_injected_total "
+                "never counted the db.execute fault")
+            or (written == 0 and "recovery flush wrote nothing")
+            or (post["pending_windows"] != 0
+                and f"{post['pending_windows']} window(s) still pending "
+                    "after recovery")
+            or ("half_open" not in transitions or transitions[-1] != "closed")
+            and f"breaker recovery transitions not observed: {transitions}"
+            or (not conserved and "ledger-vs-engine conservation broke "
+                f"across the outage: {sums} vs prompt="
+                f"{stats.prompt_tokens} generated={stats.completion_tokens}")
+            or None),
+    }
+
+
+async def scenario_tier_fault(app, client, auth, model, scale,
+                              platform) -> dict:
+    """Disk-tier fault injection against a dedicated gateway whose host
+    tier is deliberately tiny (every spill overflow hits the disk
+    write-behind). Phase 1: tier.disk.write errors — writebacks retry,
+    exhaust, quarantine CLEANLY (counted), the tier.disk breaker opens,
+    and requests keep succeeding from HBM/T1. Phase 2: faults cleared —
+    the half-open probe closes the breaker and the disk tier fills
+    again. Phase 3: tier.disk.read + tier.host.get faults — reads
+    degrade to clean MISSes. Gates: zero request failures in every
+    phase, quarantine + breaker transitions observed, zero lost
+    streams."""
+    from mcp_context_forge_tpu.tools.loadgen import (SloWindow, chat_kind,
+                                                     probe_slowest_trace,
+                                                     run_phase)
+    from aiohttp import BasicAuth
+    started_ts = time.time()
+    fapp, fclient, fmodel = await _make_gateway(platform, replicas=1,
+                                                extra_env={
+        "MCPFORGE_TPU_LOCAL_REPLICAS": "1",
+        "MCPFORGE_TPU_LOCAL_MAX_BATCH": "4",
+        "MCPFORGE_TPU_LOCAL_NUM_PAGES": "30",
+        "MCPFORGE_TPU_LOCAL_MAX_SEQ_LEN": "128",
+        # T1 ~2 pages for the test geometry: spills overflow to disk
+        "MCPFORGE_TPU_LOCAL_TIER_HOST_BYTES": "4096",
+        "MCPFORGE_TPU_LOCAL_TIER_DISK_BYTES": str(1 << 20),
+        "MCPFORGE_TIER_IO_RETRY_MAX": "1",
+        "MCPFORGE_TIER_IO_RETRY_BACKOFF_MS": "2",
+        "MCPFORGE_DEGRADATION_FAILURE_THRESHOLD": "2",
+        "MCPFORGE_TPU_LOCAL_WARMUP": "false",
+    })
+    fauth = BasicAuth("admin", "changeme")
+    try:
+        engine = fapp["tpu_engine"]
+        store = engine._owned_tier_store
+        assert store is not None, "tier-fault gateway built without tiers"
+        manager = fapp["degradation"]
+        window = SloWindow(fclient, "scenario-tier-fault", fauth)
+        await window.open()
+        # distinct long templates: fill the page pool, force evictions
+        # (spills), overflow T1 (writebacks)
+        kinds = [chat_kind(fmodel, max_tokens=scale["max_tokens"],
+                           prompt=f"tier corpus template {j} " * 10)
+                 for j in range(scale["tier_templates"])]
+
+        async def _drain_writer():
+            deadline = time.monotonic() + 30
+            while ((not store._writeq.empty() or store._pending)
+                   and time.monotonic() < deadline):
+                await asyncio.sleep(0.02)
+
+        await _arm_fault(fclient, fauth, {
+            "point": "tier.disk.write", "kind": "error", "mode": "always",
+            "message": "tier-fault scenario: disk down"})
+        outage = await run_phase(fclient, fauth, kinds, name="disk-down",
+                                 concurrency=scale["tier_concurrency"],
+                                 requests=scale["tier_requests"])
+        await _drain_writer()
+        mid = store.stats()
+        await _disarm_fault(fclient, fauth, "tier.disk.write")
+        await asyncio.sleep(
+            fapp["ctx"].settings.degradation_cooldown_s + 0.05)
+        recovery = await run_phase(fclient, fauth, kinds, name="recovery",
+                                   concurrency=scale["tier_concurrency"],
+                                   requests=scale["tier_requests"])
+        await _drain_writer()
+        post = store.stats()
+        # read-path faults: disk reads + host gets degrade to clean
+        # MISSes (re-prefill), never request failures
+        await _arm_fault(fclient, fauth, {
+            "point": "tier.disk.read", "kind": "error",
+            "mode": "one_in_n", "n": 2})
+        await _arm_fault(fclient, fauth, {
+            "point": "tier.host.get", "kind": "error",
+            "mode": "one_in_n", "n": 4})
+        reread = await run_phase(fclient, fauth, kinds, name="read-faults",
+                                 concurrency=scale["tier_concurrency"],
+                                 requests=scale["tier_requests"])
+        await _disarm_fault(fclient, fauth, "tier.disk.read")
+        await _disarm_fault(fclient, fauth, "tier.host.get")
+        final = store.stats()
+        slo = await window.close()
+        transitions = [t["to"] for t in manager.transitions("tier.disk")]
+        tier_hits = dict(engine.allocator.tier_hit_tokens)
+        metrics_text = fapp["ctx"].metrics.render()[0].decode()
+        io_errors_counted = \
+            "mcpforge_llm_prefix_tier_io_errors_total" in metrics_text
+        forensics = await probe_slowest_trace(fclient, fauth,
+                                              since_ts=started_ts)
+        requests = outage.requests + recovery.requests + reread.requests
+        failures = outage.failures + recovery.failures + reread.failures
+        wall_s = outage.wall_s + recovery.wall_s + reread.wall_s
+        latencies = sorted(x for p in (outage, recovery, reread)
+                           for x in p.latencies_ms)
+        return {
+            "scenario": "tier-fault",
+            "value": round(requests / wall_s, 2) if wall_s else 0.0,
+            "p50_ms": round(latencies[len(latencies) // 2], 2)
+            if latencies else None,
+            "p95_ms": round(latencies[min(int(len(latencies) * 0.95),
+                                          len(latencies) - 1)], 2)
+            if latencies else None,
+            "requests": requests, "failures": failures, "wall_s": wall_s,
+            "spilled": final["spilled"],
+            "io_errors_mid": mid["io_errors"],
+            "io_errors_final": final["io_errors"],
+            "quarantined_mid": mid["dropped"],
+            "disk_pages_mid": mid["disk_pages"],
+            "disk_pages_post_recovery": post["disk_pages"],
+            "breaker_mid": mid["disk_breaker"]["state"],
+            "breaker_final": final["disk_breaker"]["state"],
+            "breaker_transitions": transitions,
+            "tier_hit_tokens": tier_hits,
+            "forensics": forensics,
+            "slo": slo, "slo_ok": slo["ok"],
+            "hard_fail": (
+                (failures and f"{failures} request(s) failed — tier "
+                 "faults must degrade, never break serving")
+                or (final["spilled"] == 0 and "no page ever spilled — "
+                    "the tier plane was never exercised")
+                or (mid["io_errors"]["disk.write"] == 0
+                    and "disk-down phase produced zero write IO errors "
+                        "(fault did not reach the writer)")
+                or (mid["dropped"] == 0 and "no entry was quarantined "
+                    "under the disk outage")
+                or (mid["disk_breaker"]["state"] != "open"
+                    and f"tier.disk breaker was "
+                        f"{mid['disk_breaker']['state']} mid-outage")
+                or (final["disk_breaker"]["state"] != "closed"
+                    and "tier.disk breaker did not recover to closed")
+                or (post["disk_pages"] == 0 and "disk tier stayed empty "
+                    "after recovery (writebacks never resumed)")
+                or ("half_open" not in transitions
+                    and f"no half-open probe observed: {transitions}")
+                or (not io_errors_counted
+                    and "mcpforge_llm_prefix_tier_io_errors_total "
+                        "missing from the registry")
+                or next((f"forensics: {p}"
+                         for p in forensics["problems"]), None)
+                or None),
+        }
+    finally:
+        try:
+            await fclient.close()
+        except Exception:
+            pass
+
+
+async def scenario_overload_shed(app, client, auth, model, scale,
+                                 platform) -> dict:
+    """Overload shedding, lowest SLO class first: a dedicated gateway
+    with a tiny admission queue takes an engine.dispatch latency fault
+    (the slow-replica signal), saturation crosses the shed bar, and the
+    BATCH class 429s with Retry-After while the PREMIUM class is
+    admitted and holds its targets. Gates: batch actually shed (with
+    the header), premium saw zero 429s and zero failures, its SLO
+    window measured + ok, the shed counter moved, and llm.overload
+    reported open then closed."""
+    from aiohttp import BasicAuth
+
+    from mcp_context_forge_tpu.tools.loadgen import (
+        SloWindow, chat_kind, probe_slowest_trace, run_phase,
+        shed_tracking_chat_kind, weighted_schedule)
+    started_ts = time.time()
+    tenants = [("shed-premium@scenario.local", "Vq8#mRt2xW!p", "premium"),
+               ("shed-batch@scenario.local", "Vq8#mRt2xW!q", "batch")]
+    fapp, fclient, fmodel = await _make_gateway(platform, replicas=1,
+                                                extra_env={
+        "MCPFORGE_TPU_LOCAL_REPLICAS": "1",
+        "MCPFORGE_TPU_LOCAL_MAX_BATCH": "4",
+        "MCPFORGE_TPU_LOCAL_MAX_QUEUE": "4",
+        "MCPFORGE_GW_SHED_SATURATION_AT": "0.3",
+        "MCPFORGE_GW_SHED_CLASS_ORDER": json.dumps(["batch"]),
+        "MCPFORGE_SLO_TENANT_CLASSES": json.dumps(
+            {f"user:{email}": cls for email, _pw, cls in tenants}),
+        "MCPFORGE_TPU_LOCAL_WARMUP": "false",
+    })
+    fauth = BasicAuth("admin", "changeme")
+    try:
+        manager = fapp["degradation"]
+        shedder = fapp["overload_shedder"]
+        for email, password, _cls in tenants:
+            resp = await fclient.post("/admin/users", json={
+                "email": email, "password": password,
+                "full_name": "Shed Scenario"}, auth=fauth)
+            assert resp.status in (201, 409), await resp.text()
+        auths = {cls: BasicAuth(email, password)
+                 for email, password, cls in tenants}
+        # prime before windows: stable clamp labels + warm shapes
+        prime_kind = chat_kind(fmodel, max_tokens=scale["max_tokens"])
+        for a in auths.values():
+            await run_phase(fclient, a, [prime_kind], name="prime",
+                            concurrency=1, requests=1)
+        premium_window = SloWindow(fclient, "scenario-shed", fauth,
+                                   tenant="user:shed-premium@scenario.local")
+        await premium_window.open()
+        # the overload: a latency fault drags every dispatch iteration,
+        # the queue backs up, saturation crosses the shed bar
+        await _arm_fault(fclient, fauth, {
+            "point": "engine.dispatch", "kind": "latency",
+            "latency_ms": scale["shed_latency_ms"], "mode": "always"})
+        shed_log: dict = {}
+        batch_kind = shed_tracking_chat_kind(fmodel, shed_log,
+                                             max_tokens=scale["max_tokens"])
+        premium_kind = chat_kind(fmodel, max_tokens=scale["max_tokens"])
+        premium_failures: list = []
+
+        async def one(client_, auth_, i):
+            # premium and batch interleave 1:2 — batch floods, premium
+            # must hold
+            if pick(i) == "premium":
+                ok, tag = await premium_kind(client_, auths["premium"], i)
+                if not ok:
+                    premium_failures.append(tag)
+                return ok, tag
+            return await batch_kind(client_, auths["batch"], i)
+
+        pick = weighted_schedule([("premium", 1), ("batch", 2)])
+        load = await run_phase(fclient, fauth, [one], name="overload",
+                               concurrency=scale["shed_concurrency"],
+                               requests=scale["shed_requests"])
+        await _disarm_fault(fclient, fauth, "engine.dispatch")
+        # drain, then one premium request at idle: the shedder's next
+        # decide sees low saturation and reports llm.overload closed
+        tail_ok, _tag = await premium_kind(fclient, auths["premium"], 0)
+        slo = await premium_window.close()
+        transitions = [t["to"] for t in manager.transitions("llm.overload")]
+        metrics_text = fapp["ctx"].metrics.render()[0].decode()
+        shed_counted = "mcpforge_gw_requests_shed_total" in metrics_text \
+            and 'slo_class="batch"' in metrics_text
+        forensics = await probe_slowest_trace(fclient, fauth,
+                                              since_ts=started_ts)
+        return {
+            "scenario": "overload-shed",
+            "value": round(load.requests / load.wall_s, 2)
+            if load.wall_s else 0.0,
+            "p50_ms": load.summary().get("p50_ms"),
+            "p95_ms": load.summary().get("p95_ms"),
+            "requests": load.requests,
+            # 429s with Retry-After are the EXPECTED shed outcome, not
+            # failures; anything else (incl. 429 sans header) gates
+            "failures": load.failures,
+            "wall_s": load.wall_s,
+            "shed_429s": shed_log.get("shed", 0),
+            "shed_total": shedder.shed_total,
+            "premium_failures": premium_failures,
+            "overload_transitions": transitions,
+            "tail_premium_ok": tail_ok,
+            "errors": dict(load.errors),
+            "forensics": forensics,
+            "slo": slo, "slo_ok": slo["ok"],
+            "hard_fail": (
+                (shed_log.get("shed", 0) == 0
+                 and "batch class was never shed — saturation signal "
+                     "did not drive a single 429")
+                or (load.failures and f"{load.failures} non-shed "
+                    f"failure(s): {dict(load.errors)}")
+                or (premium_failures and "premium requests failed under "
+                    f"overload: {premium_failures}")
+                or (not tail_ok and "post-overload premium request failed")
+                or ("open" not in transitions
+                    and "llm.overload never reported open while shedding")
+                or (transitions and transitions[-1] != "closed"
+                    and "llm.overload did not close after the overload "
+                        f"cleared: {transitions}")
+                or (not shed_counted
+                    and "mcpforge_gw_requests_shed_total never counted "
+                        "the batch sheds")
+                or (not slo["ok"] and "premium class breached its SLO "
+                    "targets while batch was shedding")
+                or next((f"forensics: {p}"
+                         for p in forensics["problems"]), None)
+                or None),
+        }
+    finally:
+        try:
+            await fclient.close()
+        except Exception:
+            pass
+
+
 async def _reference_streams(app, prompts, max_tokens):
     """What one UNINTERRUPTED engine emits for ``prompts`` — the parity
     bar the chaos scenario's merged failover streams must match
@@ -440,6 +935,28 @@ async def scenario_chaos(app, client, auth, model, scale) -> dict:
 
     window = SloWindow(client, "scenario-chaos", auth)
     await window.open()
+
+    # slow-replica arm (ISSUE 14): replica 0 drags every dispatch
+    # iteration through an injected engine.dispatch latency — slow must
+    # never mean WRONG: streams complete, zero failures, the SLO window
+    # simply reports the inflation. Disarmed before the kill phase so
+    # the parity streams run against clean replicas.
+    _rebind_resilience_plane(app)
+    await _arm_fault(client, auth, {
+        "point": "engine.dispatch", "kind": "latency",
+        "latency_ms": 15.0, "scope": "0"})
+    slow = await run_phase(
+        client, auth, [chat_kind(model, max_tokens=max_tokens)],
+        name="slow-replica", concurrency=scale["chaos_concurrency"],
+        requests=max(4, scale["chaos_requests"] // 2))
+    await _disarm_fault(client, auth, "engine.dispatch")
+    # forensics are probed over the KILL phase only: the injected
+    # dispatch-loop sleep lands between a request's last token reaching
+    # the client (http root closes) and the engine's finish bookkeeping
+    # (llm.decode span end), so slow-phase traces legitimately fail the
+    # strict containment invariants by the injected milliseconds — the
+    # failover stitch is what the probe must prove clean
+    post_slow_ts = time.time()
 
     killed: dict = {}
 
@@ -491,12 +1008,21 @@ async def scenario_chaos(app, client, auth, model, scale) -> dict:
     slo = await window.close()
     parity_ok = refs is None or [list(o) for o in outs] == refs
     lost = sum(1 for o in outs if not o)
+    from mcp_context_forge_tpu.tools.loadgen import probe_slowest_trace
+    forensics = await probe_slowest_trace(client, auth,
+                                          since_ts=post_slow_ts)
     return {
+        "forensics": forensics,
         "scenario": "chaos", "value": load.summary()["rps"],
         "p50_ms": load.summary().get("p50_ms"),
         "p95_ms": load.summary().get("p95_ms"),
-        "requests": load.requests + (tail.requests if tail else 0),
-        "failures": load.failures + (tail.failures if tail else 0),
+        "requests": load.requests + slow.requests
+        + (tail.requests if tail else 0),
+        "failures": load.failures + slow.failures
+        + (tail.failures if tail else 0),
+        "slow_replica": {"requests": slow.requests,
+                         "failures": slow.failures,
+                         "p95_ms": slow.summary().get("p95_ms")},
         "killed_replica": killed.get("rid"),
         "requeues": pool.requeues,
         "streams": len(outs),
@@ -515,6 +1041,8 @@ async def scenario_chaos(app, client, auth, model, scale) -> dict:
                 and "token streams diverged from the uninterrupted "
                     "reference (lost or duplicated tokens)")
             or (not reload_ok and "killed replica did not reload to ready")
+            or next((f"forensics: {p}" for p in forensics["problems"]),
+                    None)
             or None),
     }  # request failures are gated generically by the driver
 
@@ -545,8 +1073,8 @@ def _write_capture(out_dir: str, rnd: int, capture: dict) -> str:
     # history — the cross-platform delta would read as a regression
     platform = str(capture.get("platform", "cpu")).upper()
     arm = "" if platform == "CPU" else f"_{platform}"
-    name = (f"BENCH_SCENARIO{arm}_{capture['scenario'].upper()}"
-            f"_r{rnd:02d}.json")
+    scenario = capture["scenario"].upper().replace("-", "_")
+    name = f"BENCH_SCENARIO{arm}_{scenario}_r{rnd:02d}.json"
     # ATOMIC per-arm write, issued as soon as the scenario completes —
     # a dropped tunnel / OOM mid-round keeps every finished arm's
     # capture on disk (the exact failure that voided
@@ -617,6 +1145,12 @@ async def run_scenarios(platform: str) -> dict:
             "mixed": lambda: scenario_mixed(app, client, auth, model, scale),
             "tenant": lambda: scenario_tenant(app, client, auth, model,
                                               scale),
+            "db-outage": lambda: scenario_db_outage(app, client, auth,
+                                                    model, scale),
+            "tier-fault": lambda: scenario_tier_fault(
+                app, client, auth, model, scale, platform),
+            "overload-shed": lambda: scenario_overload_shed(
+                app, client, auth, model, scale, platform),
             "chaos": lambda: scenario_chaos(app, client, auth, model, scale),
         }
         out_dir = os.environ.get(
@@ -651,13 +1185,17 @@ async def run_scenarios(platform: str) -> dict:
             # cross-layer stitching proven against real scenario load.
             # since_ts scopes the pick to THIS scenario's rows (the
             # rings span the whole run)
-            from mcp_context_forge_tpu.tools.loadgen import \
-                probe_slowest_trace
-            forensics = await probe_slowest_trace(client, auth,
-                                                  since_ts=scenario_t0)
-            capture["forensics"] = forensics
-            for problem in forensics["problems"]:
-                problems.append(f"{name}: forensics: {problem}")
+            if "forensics" not in capture:
+                # dedicated-gateway arms (tier-fault, overload-shed)
+                # probe their OWN gateway's forensics inside the
+                # scenario; everyone else probes the shared one here
+                from mcp_context_forge_tpu.tools.loadgen import \
+                    probe_slowest_trace
+                forensics = await probe_slowest_trace(
+                    client, auth, since_ts=scenario_t0)
+                capture["forensics"] = forensics
+                for problem in capture["forensics"]["problems"]:
+                    problems.append(f"{name}: forensics: {problem}")
             hard = capture.pop("hard_fail", None)
             if hard:
                 problems.append(f"{name}: {hard}")

@@ -41,6 +41,14 @@ case "$1" in
     shift
     exec python bench_gateway_scenarios.py "$@"
     ;;
+  bench-chaos)
+    # fault-injection matrix only (docs/resilience.md): db-outage /
+    # tier-fault / overload-shed / chaos (slow-replica + kill), gated on
+    # stream integrity, ledger conservation, and breaker transitions
+    shift
+    BENCH_SCENARIO_ONLY=db-outage,tier-fault,overload-shed,chaos \
+      exec python bench_gateway_scenarios.py "$@"
+    ;;
   serve|supervise|hub|token|version)
     cmd="$1"; shift
     if [ "$cmd" = "hub" ]; then

@@ -550,6 +550,47 @@ class Settings(BaseModel):
     # advise backoff past this saturation fraction
     gw_backpressure_headers: bool = True
     gw_backpressure_retry_after_at: float = 0.8
+    # --- fault injection + graceful degradation (observability/faults.py,
+    # observability/degradation.py, docs/resilience.md) ---
+    # master arm switch for the fault plane: with it UNSET (default) no
+    # rule can be installed and every fault point is a single dict-miss
+    # no-op (pinned in test); set it for chaos runs / the bench matrix
+    fault_injection_enabled: bool = False
+    # boot-time rules (JSON array of FaultRule objects) for headless
+    # harnesses; runtime arming goes through POST /admin/faults
+    fault_rules: str = ""
+    # circuit breakers (disk spill tier, federation peers, rollup):
+    # consecutive failures before a breaker opens, and how long it stays
+    # open before admitting one half-open recovery probe
+    degradation_failure_threshold: int = 3
+    degradation_cooldown_s: float = 5.0
+    # spill-tier disk IO hardening: transient read/write errors retry
+    # this many times with jittered backoff before the entry is
+    # quarantined (dropped to a clean MISS, counted in
+    # mcpforge_llm_prefix_tier_io_errors_total)
+    tier_io_retry_max: int = 2
+    tier_io_retry_backoff_ms: float = 10.0
+    # bounded buffer of rollup windows a DB outage could not flush:
+    # beyond this many pending windows the OLDEST drops (loss counted in
+    # rollup stats) instead of growing without bound
+    tenant_rollup_pending_max: int = 8
+    # overload shedding on the LLM chat surface: past this engine
+    # saturation the LOWEST SLO class sheds with 429 + Retry-After;
+    # gw_shed_class_order (JSON array, lowest first) lists the SHEDDABLE
+    # classes — classes not listed never shed on saturation, which is
+    # how higher classes hold their targets. '' = no class sheds on
+    # saturation (quota shedding still applies when a quota is set)
+    gw_shed_enabled: bool = True
+    gw_shed_saturation_at: float = 0.95
+    gw_shed_class_order: str = ""
+    # chat SSE waits up to this long for the FIRST engine chunk before
+    # sending response headers: an immediately-refused request (pool
+    # capacity gone) gets a clean 503 + Retry-After instead of a 200
+    # stream that dies, while a long-TTFT request still gets its
+    # headers inside proxy first-byte timeouts (the stream then starts
+    # when the first chunk lands). 0 = send headers immediately.
+    gw_stream_first_chunk_wait_s: float = 1.0
+
     # --- engine replica pool (tpu_local/pool/, docs/serving_pool.md) ---
     # N > 1 serves LLM traffic from N engine replicas on device-subset
     # meshes (e.g. 2 replicas x 4 chips on a v5e-8) behind an

@@ -24,6 +24,11 @@ from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Any, Iterable, Iterator, Sequence
 
+# module-top, not per-statement: execute() is the gateway's hottest DB
+# path and the fault point's disabled cost must stay one dict miss
+# (faults.py is stdlib-only; no import cycle back into db/)
+from ..observability.faults import fault_point
+
 # per-task query telemetry (db_query_logging_middleware): None = off;
 # a list collects (normalized sql, elapsed ms) for every statement the
 # current task runs. ContextVar so concurrent requests never interleave.
@@ -175,6 +180,13 @@ class Database:
 
     async def execute(self, sql: str, params: Sequence[Any] = ()) -> list[dict[str, Any]]:
         from ..observability.phases import current_phases
+        # fault point db.execute (docs/resilience.md): scope = the SQL
+        # text, so a chaos rule can target one table's statements (the
+        # db-outage scenario faults tenant_usage writes without touching
+        # the auth path). Unarmed: one dict miss.
+        act = fault_point("db.execute", scope=sql)
+        if act is not None:
+            await act.async_apply()
         log = _query_capture.get()
         cb = self.on_query
         clock = current_phases()  # flight-recorder db-phase attribution
@@ -204,6 +216,9 @@ class Database:
                 log.append((" ".join(sql.split()), 0.0))
 
     async def executemany(self, sql: str, seq: list[Sequence[Any]]) -> None:
+        act = fault_point("db.execute", scope=sql)  # same point as execute
+        if act is not None:
+            await act.async_apply()
         await self._run(self._executemany_sync, sql, seq)
 
     async def fetchone(self, sql: str, params: Sequence[Any] = ()) -> dict[str, Any] | None:

@@ -327,11 +327,74 @@ function renderGatewayFlight(snap){
     <div class="card"><b>${cell(loop.long_callbacks)}</b><span>long_callbacks</span></div>
     <div class="card"><b>${cell(bp.depth)}</b><span>engine_queue_depth</span></div>
     <div class="card"><b>${fnum(bp.saturation)}</b><span>engine_saturation</span></div>
+    <div class="card"><b>${cell(snap.shed_total)}</b><span>requests_shed</span></div>
    </div>`;
-  document.getElementById("view").innerHTML = cards
+  // degradation ladder (docs/resilience.md): one pill per component —
+  // closed = healthy, half_open = probing recovery, open = degraded
+  // path active (full breaker detail at GET /admin/faults)
+  const deg = snap.degradation || {};
+  const degRow = Object.keys(deg).length
+    ? "<br><h3>degradation ladder</h3><div class=\"cards\">"
+      + Object.keys(deg).sort().map(c =>
+        `<div class="card"><b>${esc(deg[c])}</b><span>${esc(c)}</span></div>`
+      ).join("") + "</div>"
+    : "";
+  document.getElementById("view").innerHTML = cards + degRow
+    + '<br><button class="act" onclick="faultsDetail()">fault plane / breakers</button>'
     + gwFlightTable("slowest requests", snap.slowest)
     + gwFlightTable("recent requests", snap.recent);
   document.getElementById("status").textContent = "gateway flight recorder";
+}
+async function faultsDetail(){
+  // the resilience plane (docs/resilience.md): armed fault rules with
+  // fired/call counts (disarmable per point), breaker snapshots +
+  // transition history, rollup outage stats, shedder counters
+  const r = await fetch("/admin/faults");
+  const d = document.getElementById("detail");
+  d.style.display = "block";
+  if (!r.ok){ d.textContent = "faults fetch failed: " + r.status; return; }
+  const f = await r.json();
+  faultRules = f.rules || [];
+  let html = `<b>fault plane ${f.enabled ? "(ARMED)" : "(disabled)"}</b>`;
+  html += faultRules.length
+    ? "<table><tr><th>point</th><th>kind</th><th>mode</th><th>scope</th>"
+      + "<th>fired/calls</th><th></th></tr>"
+      + faultRules.map((r2, i) =>
+        `<tr><td>${esc(r2.point)}</td><td>${esc(r2.kind)}</td>`
+        + `<td>${esc(r2.mode)}</td><td>${esc(r2.scope||"")}</td>`
+        + `<td>${cell(r2.fired)}/${cell(r2.calls)}</td>`
+        + `<td><button class="act" onclick="faultDisarm(${i})">disarm</button></td></tr>`
+      ).join("") + "</table>"
+    : "<div class=\"kv\">no rules armed</div>";
+  const deg = f.degradation || {};
+  html += "<br><b>breakers</b><table><tr><th>component</th><th>key</th>"
+    + "<th>state</th><th>consec</th><th>fail/ok</th></tr>"
+    + (deg.breakers||[]).map(b =>
+      `<tr><td>${esc(b.component)}</td><td>${esc(b.key||"")}</td>`
+      + `<td>${esc(b.state)}</td><td>${cell(b.consecutive_failures)}</td>`
+      + `<td>${cell(b.failures)}/${cell(b.successes)}</td></tr>`).join("")
+    + "</table>";
+  if (deg.rollup)
+    html += `<div class="kv">rollup outage: pending ${cell(deg.rollup.pending_windows)}`
+      + `/${cell(deg.rollup.pending_max)}, dropped ${cell(deg.rollup.windows_dropped)}`
+      + ` window(s) / ${cell(deg.rollup.tokens_dropped)} token(s)</div>`;
+  if (f.shedder)
+    html += `<div class="kv">shedder: shed_total ${cell(f.shedder.shed_total)},`
+      + ` bar ${fnum(f.shedder.shed_at)}, order ${esc(JSON.stringify(f.shedder.class_order))}</div>`;
+  html += "<div class=\"kv\">transitions: "
+    + esc((deg.transitions||[]).map(t =>
+      `${t.component}:${t.from}→${t.to}`).join(", ") || "none") + "</div>";
+  d.innerHTML = html;
+}
+let faultRules = [];
+async function faultDisarm(i){
+  // index-based lookup: the point name is server data and must never
+  // be interpolated into an onclick JS string (tenants-tab XSS rule)
+  const rule = faultRules[i];
+  if (!rule) return;
+  await fetch(`/admin/faults/${encodeURIComponent(String(rule.point))}`,
+              {method: "DELETE"});
+  faultsDetail();
 }
 let forensicRows = [];
 function renderForensics(snap){

@@ -7,6 +7,7 @@ Same ordering here via aiohttp cleanup contexts.
 
 from __future__ import annotations
 
+import json
 import logging
 from pathlib import Path
 from typing import AsyncIterator
@@ -193,6 +194,25 @@ async def build_app(settings: Settings | None = None) -> web.Application:
             warn_s=settings.gw_loop_lag_warn_ms / 1e3, recorder=recorder)
         app["loop_lag_sampler"] = loop_sampler
 
+    # fault-injection plane + graceful-degradation ladder
+    # (observability/faults.py, observability/degradation.py,
+    # docs/resilience.md). Configured BEFORE any component that grabs a
+    # breaker (tier store, rollup, federation) so every breaker inherits
+    # this app's thresholds and metrics sink. The plane stays a no-op
+    # unless fault_injection_enabled is set.
+    from ..observability.degradation import configure_degradation
+    from ..observability.faults import configure_fault_plane
+    fault_plane = configure_fault_plane(
+        settings.fault_injection_enabled, metrics=metrics,
+        rules_json=settings.fault_rules)
+    degradation = configure_degradation(
+        metrics=metrics,
+        failure_threshold=settings.degradation_failure_threshold,
+        cooldown_s=settings.degradation_cooldown_s)
+    app["fault_plane"] = fault_plane
+    app["degradation"] = degradation
+    ctx.extras["degradation"] = degradation
+
     # per-tenant usage metering (observability/metering.py): the ledger
     # the engine feeds at retire time, its periodic DB rollup, and the
     # GET /admin/tenants/usage surface. Built before the engine so
@@ -207,7 +227,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
             quota_tokens_per_window=settings.tenant_quota_tokens_per_window)
         tenant_rollup = TenantUsageRollup(
             db, tenant_ledger,
-            interval_s=settings.tenant_usage_rollup_interval_s)
+            interval_s=settings.tenant_usage_rollup_interval_s,
+            pending_max=settings.tenant_rollup_pending_max)
         app["tenant_ledger"] = tenant_ledger
         app["tenant_usage_rollup"] = tenant_rollup
         ctx.extras["tenant_ledger"] = tenant_ledger
@@ -221,12 +242,37 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     from ..observability.slo import (SloEvaluator, default_objectives,
                                      parse_slo_classes,
                                      parse_tenant_classes)
+    tenant_class_map = parse_tenant_classes(settings)
     app["slo_evaluator"] = SloEvaluator(
         metrics, default_objectives(settings),
         error_budget=settings.slo_error_budget,
         slo_classes=parse_slo_classes(settings),
-        tenant_classes=parse_tenant_classes(settings),
+        tenant_classes=tenant_class_map,
         tenant_label=tenant_clamp.peek)
+
+    # overload shedder (observability/degradation.py): admission-time
+    # 429s on the LLM chat surface, lowest SLO class first, consuming
+    # the engine-saturation gauge's source signal + the tenant quota
+    # window — ROADMAP item 5's "429s driven from the saturation signal"
+    if settings.gw_shed_enabled:
+        from ..observability.degradation import OverloadShedder
+        shed_order: list[str] = []
+        if settings.gw_shed_class_order:
+            try:
+                shed_order = json.loads(settings.gw_shed_class_order)
+            except json.JSONDecodeError as exc:
+                raise ValueError(
+                    f"invalid gw_shed_class_order JSON: {exc}") from exc
+            if not isinstance(shed_order, list):
+                raise ValueError("gw_shed_class_order must be a JSON "
+                                 "array of class names, lowest first")
+        app["overload_shedder"] = OverloadShedder(
+            shed_at=settings.gw_shed_saturation_at,
+            class_order=shed_order,
+            tenant_classes=tenant_class_map,
+            ledger=tenant_ledger,
+            degradation=degradation,
+            metrics=metrics)
 
     # operation-timing registry (reference performance_tracker.py): http /
     # db / tool / resource series feed /admin/performance and the bundle

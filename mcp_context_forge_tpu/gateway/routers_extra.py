@@ -200,6 +200,15 @@ def setup_extra_routes(app: web.Application) -> None:
         snapshot["loop"] = sampler.snapshot() if sampler is not None else None
         from .flight_recorder import queue_state
         snapshot["backpressure"] = queue_state(request.app)
+        # degradation ladder summary (docs/resilience.md): per-component
+        # breaker states ride the gateway tab next to backpressure —
+        # "disk tier quarantined" belongs on the same screen as queue
+        # depth (full detail incl. transitions at GET /admin/faults)
+        from ..observability.degradation import get_degradation
+        snapshot["degradation"] = get_degradation().status()["components"]
+        shedder = request.app.get("overload_shedder")
+        snapshot["shed_total"] = (shedder.shed_total
+                                  if shedder is not None else None)
         return web.json_response(snapshot)
 
     def _trace_store_or_404(request: web.Request):
@@ -287,6 +296,87 @@ def setup_extra_routes(app: web.Application) -> None:
         payload["rollup_interval_s"] = (rollup.interval_s
                                         if rollup is not None else None)
         return web.json_response(payload)
+
+    # ------------------------------------------- fault plane + degradation
+
+    @routes.get("/admin/faults")
+    async def faults_status(request: web.Request) -> web.Response:
+        """The resilience plane's status surface: armed fault rules
+        (with fired/call counts), the legal fault-point catalogue, and
+        the degradation ladder — per-component breaker states, bounded
+        transition history, rollup outage stats (docs/resilience.md).
+        Readable even with injection disabled: the degradation half is
+        production telemetry, not a chaos tool."""
+        request["auth"].require("observability.read")
+        from ..observability.degradation import get_degradation
+        from ..observability.faults import get_fault_plane
+        payload = get_fault_plane().snapshot()
+        payload["degradation"] = get_degradation().status()
+        rollup = request.app.get("tenant_usage_rollup")
+        if rollup is not None:
+            payload["degradation"]["rollup"] = rollup.outage_stats()
+        shedder = request.app.get("overload_shedder")
+        if shedder is not None:
+            payload["shedder"] = {
+                "enabled": shedder.enabled,
+                "shed_at": shedder.shed_at,
+                "class_order": shedder.class_order,
+                "shed_total": shedder.shed_total,
+            }
+        return web.json_response(payload)
+
+    @routes.post("/admin/faults")
+    async def faults_arm(request: web.Request) -> web.Response:
+        """Arm one fault rule (the chaos harness's drive path): body is
+        a FaultRule object — {"point", "kind", "mode", "n", "window_s",
+        "latency_ms", "scope", "seed", "message"}. 404 unless
+        fault_injection_enabled is set (the default-off contract: the
+        rule table cannot become non-empty on a production gateway)."""
+        request["auth"].require("admin.all")
+        from ..observability.faults import FaultRule, get_fault_plane
+        plane = get_fault_plane()
+        if not plane.enabled:
+            raise NotFoundError(
+                "fault injection is disabled "
+                "(set MCPFORGE_FAULT_INJECTION_ENABLED=true)")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError as exc:
+            raise ValidationFailure(f"invalid JSON: {exc}") from exc
+        if not isinstance(body, dict):
+            raise ValidationFailure("body must be a fault-rule object")
+        allowed = ("point", "kind", "mode", "n", "window_s",
+                   "latency_ms", "scope", "seed", "message")
+        unknown = sorted(set(body) - set(allowed))
+        if unknown:
+            # fail CLOSED: a typo'd field ("Scope", "latencyMs") must
+            # not silently arm a broader fault than the operator asked
+            # for (an unscoped always-error db rule takes the whole
+            # gateway down instead of one table)
+            raise ValidationFailure(
+                f"unknown fault-rule field(s) {unknown} "
+                f"(allowed: {list(allowed)})")
+        try:
+            rule = plane.arm(FaultRule(**body))
+        except (TypeError, ValueError) as exc:
+            raise ValidationFailure(str(exc)) from exc
+        return web.json_response(rule.snapshot(), status=201)
+
+    @routes.delete("/admin/faults/{point}")
+    async def faults_disarm(request: web.Request) -> web.Response:
+        """Disarm one point (no error if it was not armed — disarm is
+        the cleanup path and must be idempotent)."""
+        request["auth"].require("admin.all")
+        from ..observability.faults import get_fault_plane
+        removed = get_fault_plane().disarm(request.match_info["point"])
+        return web.json_response({"disarmed": removed})
+
+    @routes.delete("/admin/faults")
+    async def faults_clear(request: web.Request) -> web.Response:
+        request["auth"].require("admin.all")
+        from ..observability.faults import get_fault_plane
+        get_fault_plane().clear()
+        return web.json_response({"cleared": True})
 
     @routes.get("/admin/engine/profile/status")
     async def profile_status(request: web.Request) -> web.Response:

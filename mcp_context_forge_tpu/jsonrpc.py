@@ -20,6 +20,9 @@ INTERNAL_ERROR = -32603
 # MCP-specific
 REQUEST_CANCELLED = -32800
 CONTENT_TOO_LARGE = -32801
+# server-range: upstream temporarily unavailable (degradation ladder —
+# open federation breaker; error.data carries retry_after_s)
+UPSTREAM_UNAVAILABLE = -32003
 
 
 class JSONRPCError(Exception):

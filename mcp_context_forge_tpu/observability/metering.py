@@ -210,32 +210,6 @@ class TenantLedger:
                                 tenant=label).set(0.0)
         return started, rows
 
-    def restore_window(self, started: float,
-                       rows: dict[str, dict[str, float]]) -> None:
-        """Merge a drained-but-unflushed window back (rollup DB outage):
-        the rows, the per-label quota aggregates (gauge restored too —
-        take_window already zeroed it), AND the window start — a retried
-        flush must stamp the usage with the window it was actually
-        consumed in, not the post-failure one."""
-        with self._lock:
-            touched: set[str] = set()
-            for tenant, row in rows.items():
-                window = self._window.setdefault(tenant, _zero_row())
-                for c in _COLUMNS:
-                    window[c] += row[c]
-                label = self._label_for(tenant)
-                touched.add(label)
-                self._label_window_tokens[label] = (
-                    self._label_window_tokens.get(label, 0.0)
-                    + row["prompt_tokens"] + row["generated_tokens"])
-            self._window_started = min(self._window_started, started)
-            if self.metrics is not None and self.quota_tokens_per_window:
-                for label in touched:
-                    self._child(self.metrics.gw_tenant_quota_used_ratio,
-                                tenant=label).set(
-                        self._label_window_tokens[label]
-                        / self.quota_tokens_per_window)
-
     def snapshot(self, limit: int = 64) -> dict[str, Any]:
         """The /admin/tenants/usage live view: cumulative + current
         window per tenant, heaviest (by total tokens) first."""
@@ -270,16 +244,42 @@ class TenantLedger:
 
 class TenantUsageRollup:
     """Periodic async drain of the ledger's rollup window into the
-    ``tenant_usage`` DB table (schema v9). Runs on the gateway loop; a
-    failed write logs and retries next interval with the usage intact in
-    the NEXT window's delta only if re-added — so the flush re-merges
-    rows back on failure rather than dropping them."""
+    ``tenant_usage`` DB table (schema v9). Runs on the gateway loop.
+
+    DB-outage behavior (docs/resilience.md): a window whose write fails
+    parks in a BOUNDED pending buffer carrying its ORIGINAL
+    ``(window_start, window_end)`` stamps — a retried flush writes the
+    usage against the window it was actually consumed in, not the
+    post-recovery clock. Under a sustained outage the buffer never
+    grows past ``pending_max`` windows: the OLDEST drops with its loss
+    COUNTED (``windows_dropped`` / ``tokens_dropped`` — reported, never
+    hidden) instead of unbounded memory growth. Repeated failures open
+    the ``ledger.rollup`` breaker, which skips DB attempts until the
+    cooldown admits a half-open probe (no retry storm against a dead
+    DB); the ledger's cumulative per-tenant totals are untouched
+    throughout, so token conservation holds across the whole outage.
+    The DB write rides the ``ledger.rollup.flush`` fault point."""
 
     def __init__(self, db: Any, ledger: TenantLedger,
-                 interval_s: float = 60.0) -> None:
+                 interval_s: float = 60.0, pending_max: int = 8) -> None:
         self.db = db
         self.ledger = ledger
         self.interval_s = max(0.05, float(interval_s))
+        self.pending_max = max(1, int(pending_max))
+        # failed-but-unflushed windows, oldest first:
+        # (window_start, window_end, rows)
+        self._pending: list[tuple[float, float, dict[str, dict[str, float]]]] = []
+        # reentrancy guard (plain flag: all callers share the gateway
+        # loop): two overlapping flushes — the interval task racing a
+        # scenario/shutdown flush suspended at the DB await — would both
+        # write pending[0] and then double-pop, silently losing a window
+        # the loss counters never saw
+        self._flushing = False
+        self.windows_dropped = 0
+        self.tokens_dropped = 0
+        self.consecutive_failures = 0
+        from .degradation import get_degradation
+        self._breaker = get_degradation().breaker("ledger.rollup")
         self._task: asyncio.Task | None = None
 
     async def start(self) -> None:
@@ -295,9 +295,10 @@ class TenantUsageRollup:
                 await task
             except asyncio.CancelledError:
                 pass
-        # final flush so the last window's usage survives shutdown
+        # final flush so the last window's usage survives shutdown —
+        # forced past an open breaker (one last attempt beats certain loss)
         try:
-            await self.flush()
+            await self.flush(force=True)
         except Exception:
             logger.exception("tenant usage final flush failed")
 
@@ -309,32 +310,87 @@ class TenantUsageRollup:
             except Exception:
                 logger.exception("tenant usage rollup failed")
 
-    async def flush(self) -> int:
-        """Write one rollup row per tenant with window activity."""
+    def _trim_pending(self) -> None:
+        """Bound the outage buffer: drop the OLDEST windows past
+        ``pending_max``, counting exactly what was lost."""
+        while len(self._pending) > self.pending_max:
+            started, ended, rows = self._pending.pop(0)
+            self.windows_dropped += 1
+            lost = sum(int(r["prompt_tokens"] + r["generated_tokens"])
+                       for r in rows.values())
+            self.tokens_dropped += lost
+            logger.error(
+                "tenant usage rollup: dropped window [%0.1f, %0.1f] "
+                "(%d tenant rows, %d tokens) — pending buffer full at "
+                "%d windows during DB outage", started, ended, len(rows),
+                lost, self.pending_max)
+
+    async def flush(self, force: bool = False) -> int:
+        """Drain the live window into the pending buffer, then write
+        every pending window (oldest first, original stamps). Raises on
+        the first write failure with everything unwritten still parked
+        (bounded); returns rows written."""
+        from .faults import fault_point
         started, rows = self.ledger.take_window()
-        if not rows:
+        if rows:
+            self._pending.append((started, time.time(), rows))
+            self._trim_pending()
+        if self._flushing:
+            # another flush is mid-write: the fresh window is parked
+            # above and the running flush (or the next tick) drains it —
+            # overlapping writers would double-insert one window and
+            # silently lose another
             return 0
-        now = time.time()
+        if not self._pending:
+            return 0
+        if not self._breaker.allow() and not force:
+            # breaker open, cooldown pending: don't hammer the dead DB;
+            # windows stay parked for the half-open probe
+            return 0
+        self._flushing = True
+        written = 0
         try:
-            await self.db.executemany(
-                "INSERT INTO tenant_usage (tenant, window_start, window_end,"
-                " requests, prompt_tokens, generated_tokens,"
-                " cache_hit_tokens, kv_page_seconds)"
-                " VALUES (?,?,?,?,?,?,?,?)",
-                [(tenant, started, now, int(row["requests"]),
-                  int(row["prompt_tokens"]), int(row["generated_tokens"]),
-                  int(row["cache_hit_tokens"]),
-                  round(row["kv_page_seconds"], 6))
-                 for tenant, row in sorted(rows.items())])
-        except Exception:
-            # merge the failed window back (keys already passed _key) so
-            # the usage lands in the next flush instead of vanishing —
-            # accounting must not lose tokens to a transient DB error,
-            # and the retried row must carry the ORIGINAL window_start
-            self.ledger.restore_window(started, rows)
-            raise
-        self.ledger.rollups_written += len(rows)
-        return len(rows)
+            while self._pending:
+                w_started, w_ended, w_rows = self._pending[0]
+                try:
+                    act = fault_point("ledger.rollup.flush", scope="flush")
+                    if act is not None:
+                        await act.async_apply()
+                    await self.db.executemany(
+                        "INSERT INTO tenant_usage (tenant, window_start,"
+                        " window_end, requests, prompt_tokens,"
+                        " generated_tokens, cache_hit_tokens,"
+                        " kv_page_seconds)"
+                        " VALUES (?,?,?,?,?,?,?,?)",
+                        [(tenant, w_started, w_ended, int(row["requests"]),
+                          int(row["prompt_tokens"]),
+                          int(row["generated_tokens"]),
+                          int(row["cache_hit_tokens"]),
+                          round(row["kv_page_seconds"], 6))
+                         for tenant, row in sorted(w_rows.items())])
+                except Exception:
+                    self.consecutive_failures += 1
+                    self._breaker.record_failure("rollup flush")
+                    raise
+                self._pending.pop(0)
+                written += len(w_rows)
+                self.ledger.rollups_written += len(w_rows)
+        finally:
+            self._flushing = False
+        self.consecutive_failures = 0
+        self._breaker.record_success()
+        return written
+
+    def outage_stats(self) -> dict[str, Any]:
+        """The degradation surface's view of the rollup path."""
+        return {
+            "pending_windows": len(self._pending),
+            "pending_max": self.pending_max,
+            "windows_dropped": self.windows_dropped,
+            "tokens_dropped": self.tokens_dropped,
+            "consecutive_failures": self.consecutive_failures,
+            "breaker": self._breaker.snapshot(),
+        }
 
     async def recent(self, limit: int = 100) -> list[dict[str, Any]]:
         rows = await self.db.fetchall(

@@ -175,6 +175,17 @@ class PrometheusRegistry:
             buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                      0.025, 0.05, 0.1, 0.25, 1.0),
         )
+        # spill-tier IO failure accounting (tiers.py disk hardening):
+        # transient errors retry with bounded backoff, then the entry is
+        # quarantined (clean MISS, never a hang or a poisoned serve) —
+        # this counter is the evidence trail per (tier, op)
+        self.llm_prefix_tier_io_errors = Counter(
+            "mcpforge_llm_prefix_tier_io_errors_total",
+            "Tiered prefix-cache IO failures after retries, by tier and "
+            "operation (the entry is quarantined — dropped to a clean "
+            "MISS, counted here)",
+            ["tier", "op"], registry=self.registry,
+        )
         self.llm_step_tokens_per_sec = Gauge(
             "mcpforge_llm_step_tokens_per_sec",
             "Tokens emitted per second by the last engine step (over the "
@@ -356,6 +367,38 @@ class PrometheusRegistry:
             "current rollup window (0 when no quota is configured) — "
             "the admission signal the distributed rate limiter reads",
             ["tenant"], registry=self.registry,
+        )
+        # --- fault-injection plane + degradation ladder
+        # (observability/faults.py, observability/degradation.py,
+        # docs/resilience.md) ---
+        # every fault an armed rule injected, by point and kind — the
+        # chaos matrix gates on "the fault actually fired" so a scenario
+        # whose fault never armed cannot pass vacuously
+        self.faults_injected = Counter(
+            "mcpforge_faults_injected_total",
+            "Faults injected by the fault plane, by fault point and kind "
+            "(error, latency, corrupt); only counts when "
+            "fault_injection_enabled is set and a rule fired",
+            ["point", "kind"], registry=self.registry,
+        )
+        # per-component breaker state: 0 closed (healthy), 1 half-open
+        # (probing recovery), 2 open (degraded path active). Components:
+        # tier.disk, federation (worst peer), ledger.rollup, llm.overload
+        self.degradation_state = Gauge(
+            "mcpforge_degradation_state",
+            "Degradation-ladder state per component (0=closed, "
+            "1=half_open, 2=open); multi-member components report their "
+            "worst member",
+            ["component"], registry=self.registry,
+        )
+        # admission-time load shedding on the LLM surface: 429 +
+        # Retry-After, lowest SLO class first (docs/resilience.md)
+        self.gw_requests_shed = Counter(
+            "mcpforge_gw_requests_shed_total",
+            "LLM-surface requests shed with 429 + Retry-After, by the "
+            "tenant's SLO class and cause (overload = saturation past "
+            "the class's shed bar, quota = tenant window exhausted)",
+            ["slo_class", "reason"], registry=self.registry,
         )
         self.sessions_active = Gauge(
             "mcpforge_sessions_active", "Active MCP sessions", registry=self.registry,

@@ -166,7 +166,15 @@ class GatewayService:
                     "error": f"{type(exc).__name__}: {exc}"}
 
     async def _connect(self, row: dict[str, Any]) -> MCPSession:
+        from ..observability.faults import fault_point
         from .tool_service import resolve_auth_headers
+        # fault point federation.peer.request (scope = peer URL): the
+        # connect/initialize leg — activation, health probes, and the
+        # registration wizard all ride it, so an injected peer outage
+        # degrades every federation surface the way a real one does
+        act = fault_point("federation.peer.request", scope=row.get("url", ""))
+        if act is not None:
+            await act.async_apply()
         headers = await resolve_auth_headers(self.ctx, row)
         session = MCPSession(url=row["url"], transport=row["transport"], headers=headers,
                              timeout=self.ctx.settings.federation_timeout,
@@ -314,8 +322,18 @@ class GatewayService:
                     return False
 
         probed = await asyncio.gather(*[probe(row) for row in rows])
+        from ..observability.degradation import get_degradation
+        degradation = get_degradation()
         for row, ok in zip(rows, probed):
             results[row["id"]] = ok
+            # health probes double as the federation breaker's recovery
+            # evidence: a successful probe closes the peer's breaker so
+            # proxied calls resume without waiting for live traffic
+            breaker = degradation.breaker("federation", key=row["id"])
+            if ok:
+                breaker.record_success()
+            else:
+                breaker.record_failure("health probe failed")
             if ok:
                 await self.ctx.db.execute(
                     "UPDATE gateways SET reachable=1, state='active', failure_count=0,"

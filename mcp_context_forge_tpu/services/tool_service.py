@@ -19,7 +19,8 @@ import httpx
 
 from ..clients.mcp_client import MCPClientError, MCPSession
 from ..db.core import from_json, to_json
-from ..jsonrpc import JSONRPCError, INVALID_PARAMS, INTERNAL_ERROR
+from ..jsonrpc import (JSONRPCError, INVALID_PARAMS, INTERNAL_ERROR,
+                       UPSTREAM_UNAVAILABLE)
 from ..schemas import ToolCreate, ToolRead, ToolUpdate
 from ..utils.crypto import decrypt_field, encrypt_field
 from ..utils.ids import new_id
@@ -376,6 +377,25 @@ class ToolService:
         url = (gateway or {}).get("url") or row["url"]
         if not url:
             raise JSONRPCError(INVALID_PARAMS, "MCP tool has no upstream URL")
+        # federation degradation ladder (docs/resilience.md): repeated
+        # peer failures open a per-peer breaker — proxied calls then
+        # fail FAST with a Retry-After advisory while the locally-synced
+        # catalog (tools/resources/prompts rows) keeps serving; once the
+        # cooldown elapses, allow() admits one half-open probe call and
+        # a success closes the breaker
+        breaker = None
+        if gateway is not None:
+            from ..observability.degradation import get_degradation
+            breaker = get_degradation().breaker("federation",
+                                                key=gateway["id"])
+            if not breaker.allow():
+                raise JSONRPCError(
+                    UPSTREAM_UNAVAILABLE,
+                    f"federated peer {gateway.get('name') or gateway['id']} "
+                    "is circuit-open (repeated failures); cached catalog "
+                    "still served, proxied calls refused until recovery",
+                    data={"retry_after_s": max(1, int(breaker.cooldown_s)),
+                          "degraded": "federation"})
         transport = (gateway or {}).get("transport") or "streamablehttp"
         if transport == "reverse":  # NAT'd server connected via reverse tunnel
             hub = self.ctx.extras.get("reverse_proxy_hub")
@@ -396,6 +416,12 @@ class ToolService:
         registry = self.ctx.extras.get("upstream_sessions")
 
         async def _do() -> dict[str, Any]:
+            from ..observability.faults import fault_point
+            # fault point federation.peer.request, scope = peer URL:
+            # fires per attempt so retry behavior is exercised too
+            act = fault_point("federation.peer.request", scope=url)
+            if act is not None:
+                await act.async_apply()
             if registry is not None:
                 key, session = await registry.acquire(url, transport, headers)
                 try:
@@ -412,9 +438,27 @@ class ToolService:
                                   client=self.ctx.http_client) as session:
                 return await session.call_tool(row["original_name"], arguments)
 
-        return await with_retries(_do, attempts=self.ctx.settings.max_tool_retries,
-                                  base=self.ctx.settings.retry_base_delay,
-                                  cap=self.ctx.settings.retry_max_delay)
+        try:
+            result = await with_retries(
+                _do, attempts=self.ctx.settings.max_tool_retries,
+                base=self.ctx.settings.retry_base_delay,
+                cap=self.ctx.settings.retry_max_delay)
+        except JSONRPCError:
+            # application-level error: the peer ANSWERED — healthy. This
+            # must count as breaker success, not merely "not a failure":
+            # if this call was the half-open probe, skipping the success
+            # would strand the breaker half_open (refusing every later
+            # call to a recovered peer until a health sweep runs)
+            if breaker is not None:
+                breaker.record_success()
+            raise
+        except Exception:
+            if breaker is not None:
+                breaker.record_failure("federated call failed")
+            raise
+        if breaker is not None:
+            breaker.record_success()
+        return result
 
 
 def _query_params(args: dict[str, Any]) -> list[tuple[str, str]]:

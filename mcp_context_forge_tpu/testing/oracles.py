@@ -81,6 +81,7 @@ def jsonrpc_oracle(mod: types.ModuleType) -> None:
     assert mod.INTERNAL_ERROR == -32603
     assert mod.REQUEST_CANCELLED == -32800
     assert mod.CONTENT_TOO_LARGE == -32801
+    assert mod.UPSTREAM_UNAVAILABLE == -32003
 
     E = mod.JSONRPCError
 
@@ -759,6 +760,30 @@ def _prefix_tier_spec(mod: types.ModuleType) -> None:
     assert alloc2.allocate_slot(1, 9, prefix_pages=pages3)
     assert alloc2.tier_hits["hbm"] == 2
     alloc2.free_slot(1)
+
+    # spill-on-drain (docs/resilience.md): EVERY ref==0 registered page
+    # spills with its exact chain identity, the count is exact, pinned
+    # spans never spill, and a page missing its hash evidence is
+    # SKIPPED (never unpacked) — tier-less/inactive allocators return
+    # exactly 0
+    spills_before = len(tiers.spills)
+    assert alloc2.spill_resident_prefix() == 2
+    assert len(tiers.spills) == spills_before + 2
+    assert {s[2] for s in tiers.spills[-2:]} == {(1, 2, 3, 4),
+                                                 (5, 6, 7, 8)}
+    page = next(iter(alloc2._lru))
+    saved = alloc2._page_hash.pop(page)            # defensive-skip branch
+    assert alloc2.spill_resident_prefix() == 1
+    alloc2._page_hash[page] = saved
+    hist, pages4 = alloc2.match_prefix(prompt)     # pin both pages
+    assert hist == 8 and alloc2.allocate_slot(0, 9, prefix_pages=pages4)
+    assert alloc2.spill_resident_prefix() == 0     # in-flight: untouched
+    alloc2.free_slot(0)
+    assert PA(num_pages=8, page_size=4, max_slots=2,
+              max_pages_per_slot=4).spill_resident_prefix() == 0
+    tiers.active = False
+    assert alloc2.spill_resident_prefix() == 0
+    tiers.active = True
 
     # probe caps tier promises at restore capacity: free+evictable of 2
     # limits a 3-chunk tiered chain to 2 pages; a fully-pinned pool

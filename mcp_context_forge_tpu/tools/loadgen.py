@@ -83,6 +83,31 @@ def chat_kind(model: str, max_tokens: int = 8,
     return one
 
 
+def shed_tracking_chat_kind(model: str, shed_log: dict,
+                            max_tokens: int = 8,
+                            prompt: str = "scenario request") -> RequestFn:
+    """Chat kind for overload-shed scenarios: a 429 carrying Retry-After
+    is the EXPECTED shed outcome — counted into ``shed_log['shed']``,
+    not as a failure — while a 429 MISSING the header is a failure (the
+    backpressure-header contract breach the scenario exists to catch).
+    Every other status keeps :func:`chat_kind` semantics."""
+    async def one(client, auth, i: int) -> tuple[bool, str]:
+        resp = await client.post("/v1/chat/completions", auth=auth, json={
+            "model": model,
+            "messages": [{"role": "user", "content": f"{prompt} {i}"}],
+            "max_tokens": max_tokens})
+        if resp.status == 429:
+            await resp.read()
+            if "Retry-After" not in resp.headers:
+                return False, "429_without_retry_after"
+            shed_log["shed"] = shed_log.get("shed", 0) + 1
+            return True, ""
+        body = await resp.json()
+        ok = resp.status == 200 and bool(body.get("choices"))
+        return ok, "" if ok else f"http_{resp.status}"
+    return one
+
+
 def tools_call_kind(tool: str, text: str = "payload") -> RequestFn:
     """MCP tools/call over /mcp (streamable-http stateless)."""
     async def one(client, auth, i: int) -> tuple[bool, str]:

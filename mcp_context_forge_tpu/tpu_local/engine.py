@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability.faults import fault_point
 from ..observability.logging import trace_extra
 from .compile_events import (CompileTracker, install_listener,
                              restore_thread, track_thread)
@@ -130,6 +131,11 @@ class EngineConfig:
     # continuations guaranteed). An int8-resident pool always spills its
     # bytes verbatim (bit-exact) regardless of this knob.
     tier_spill_quant: str = "int8"
+    # spill-tier disk IO hardening (docs/resilience.md): transient
+    # read/writeback errors retry this many times with jittered backoff,
+    # then the entry quarantines to a clean MISS
+    tier_io_retry_max: int = 2
+    tier_io_retry_backoff_ms: float = 10.0
     # speculative decoding via prompt-lookup (n-gram) drafting: decode is
     # HBM-bandwidth-bound (one full param read per step), so verifying
     # spec_k drafted tokens in ONE step multiplies tokens/step by the
@@ -243,6 +249,9 @@ class EngineConfig:
             tier_disk_dir=getattr(settings, "tpu_local_tier_disk_dir", ""),
             tier_spill_quant=getattr(
                 settings, "tpu_local_tier_spill_quant", "int8"),
+            tier_io_retry_max=getattr(settings, "tier_io_retry_max", 2),
+            tier_io_retry_backoff_ms=getattr(
+                settings, "tier_io_retry_backoff_ms", 10.0),
             spec_decode=getattr(settings, "tpu_local_spec_decode", False),
             spec_k=getattr(settings, "tpu_local_spec_k", 4),
             spec_ngram=getattr(settings, "tpu_local_spec_ngram", 2),
@@ -499,7 +508,9 @@ class TPUEngine:
                     host_bytes=config.tier_host_bytes,
                     disk_bytes=config.tier_disk_bytes,
                     disk_dir=config.tier_disk_dir,
-                    index=prefix_index, metrics=metrics)
+                    index=prefix_index, metrics=metrics,
+                    io_retry_max=config.tier_io_retry_max,
+                    io_retry_backoff_ms=config.tier_io_retry_backoff_ms)
                 self._owned_tier_store = store
             self._tier_client = TierClient(config.replica_id, store=store,
                                            index=prefix_index,
@@ -1369,6 +1380,26 @@ class TPUEngine:
         self._thread = None
         self._close_owned_tiers()
 
+    def spill_prefix_pages(self) -> int:
+        """Spill-on-drain: copy every ref==0 resident prefix page into
+        the (pool-shared) spill store so a rebuilt engine fetches the
+        corpus on miss instead of losing it with the HBM pool
+        (docs/resilience.md; ROADMAP item 3's remaining half). Caller
+        contract: the dispatch thread must be QUIESCED (stop() joined —
+        the pool's reload path) — this reads device pages from the
+        calling thread, which is only legal with no concurrent device
+        mutation. Runs under the engine mesh so the warmup-compiled
+        tier-read executable serves every page (no fresh compiles)."""
+        client = self._tier_client
+        if client is None or not client.active:
+            return 0
+        with self.mesh:
+            spilled = self.allocator.spill_resident_prefix()
+        if spilled:
+            logger.info("tpu_local: spilled %d resident prefix page(s) "
+                        "to the tier store before teardown", spilled)
+        return spilled
+
     def _close_owned_tiers(self) -> None:
         """Shut down a standalone engine's private spill store (its
         write-behind worker + tempdir). Pool-shared stores are closed by
@@ -1539,6 +1570,16 @@ class TPUEngine:
             with self.mesh:
                 while not self._stop_event.is_set():
                     self._heartbeat_ts = time.monotonic()
+                    # fault point engine.dispatch (docs/resilience.md),
+                    # scope = replica id: latency = a slow replica (the
+                    # chaos matrix's slow-replica arm — heartbeat still
+                    # beats, work just drags), error = a dispatch-thread
+                    # crash through the REAL crash/failover path below.
+                    # Unarmed (the default): one dict miss per iteration.
+                    fault = fault_point("engine.dispatch",
+                                        scope=self.config.replica_id)
+                    if fault is not None:
+                        fault.apply()
                     did_work = False
                     # drain the bounded handoff queue EVERY iteration (as the
                     # old unconditional _admit_batch did): the backlog lives

@@ -582,6 +582,32 @@ class PageAllocator:
         for page in reversed(pages):
             self._release_page(page)
 
+    def spill_resident_prefix(self) -> int:
+        """Spill-on-drain (ROADMAP item 3, docs/resilience.md): push
+        every ref==0 REGISTERED prefix page through the tier spill path
+        before this pool's HBM is torn down (drain → reload), so the
+        rebuilt replica — or any pool sibling — restores the prefix
+        corpus by fetch-on-miss instead of re-prefilling it from
+        scratch. In-flight spans (ref > 0) are untouched: their pages
+        die with the teardown like any active allocation. Pages stay
+        resident afterwards (the spill is a copy, not an eviction); the
+        caller is about to drop the whole pool. Returns pages spilled
+        (``TieredPageStore.put`` dedupes chains other replicas already
+        spilled — those still count as preserved here)."""
+        tiers = self.tiers
+        if tiers is None or not tiers.active:
+            return 0
+        spilled = 0
+        for page in list(self._lru):
+            key = self._page_key.get(page)
+            hashed = self._page_hash.get(page)
+            if key is None or hashed is None:
+                continue
+            key_hash, parent = hashed
+            if tiers.spill(key_hash, parent, key[1], page):
+                spilled += 1
+        return spilled
+
     def register_prefix(self, slot: int, prompt_ids: list[int]) -> None:
         """Register the slot's full prompt pages for future reuse (and
         publish their HBM residency to the pool index when one is

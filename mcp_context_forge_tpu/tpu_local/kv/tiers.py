@@ -54,12 +54,23 @@ from typing import TYPE_CHECKING, Any, Callable, Sequence
 
 import numpy as np
 
+from ...observability.degradation import get_degradation
+from ...observability.faults import FaultAction, FaultError, fault_point
+
 if TYPE_CHECKING:  # pragma: no cover - typing only
     from .prefix_index import PrefixIndex
 
 logger = logging.getLogger(__name__)
 
 TIERS = ("hbm", "host", "disk")
+
+
+def _backoff_s(base_ms: float, attempt: int, salt: int) -> float:
+    """Bounded jittered backoff for transient disk-IO retries: doubles
+    per attempt with a deterministic ±25% jitter derived from ``salt``
+    (no RNG state — the same failure sequence retries identically)."""
+    jitter = 0.75 + 0.5 * ((salt * 2654435761 + attempt) % 100) / 100.0
+    return (base_ms / 1e3) * (2 ** attempt) * jitter
 
 
 @dataclass
@@ -103,12 +114,25 @@ class TieredPageStore:
 
     def __init__(self, host_bytes: int, disk_bytes: int = 0,
                  disk_dir: str = "", index: "PrefixIndex | None" = None,
-                 metrics=None, pin: bool = True) -> None:
+                 metrics=None, pin: bool = True,
+                 io_retry_max: int = 2,
+                 io_retry_backoff_ms: float = 10.0) -> None:
         self.host_budget = max(0, int(host_bytes))
         self.disk_budget = max(0, int(disk_bytes))
         self.index = index
         self.metrics = metrics
         self._pin = pin
+        # disk IO hardening (docs/resilience.md): transient read/write
+        # errors retry with bounded jittered backoff, then the ENTRY is
+        # quarantined — dropped to a clean MISS, never a hang or a
+        # poisoned serve; repeated failures open the tier.disk breaker
+        # and the whole disk tier quarantines (HBM/T1 keep serving)
+        # until a half-open probe succeeds
+        self.io_retry_max = max(0, int(io_retry_max))
+        self.io_retry_backoff_ms = max(0.0, float(io_retry_backoff_ms))
+        self._disk_breaker = get_degradation().breaker("tier.disk")
+        self.io_errors = {("disk", "read"): 0, ("disk", "write"): 0,
+                          ("host", "get"): 0}
         self._lock = threading.Lock()  # lint: lock[spill]
         # T1: insertion-ordered = LRU-by-last-use (get() re-inserts)
         self._host: dict[bytes, SpilledPage] = {}
@@ -237,8 +261,27 @@ class TieredPageStore:
         """Fetch + VERIFY one page: the stored payload must carry exactly
         ``(parent, chunk)`` or the result is a miss (hash collision —
         wrong pages are never served). A disk hit re-onlines into T1.
-        Returns ``(payload, source_tier)``."""
+        Returns ``(payload, source_tier)``.
+
+        Fault points: ``tier.host.get`` covers the T1 fetch (error =
+        clean MISS, corrupt = the payload fails identity verification
+        and the entry quarantines — the collision path); the disk load
+        below rides ``tier.disk.read`` inside :meth:`_read_disk`."""
         expected = tuple(chunk)
+        corrupt_host = False
+        act = fault_point("tier.host.get", scope=key_hash.hex())
+        if act is not None:
+            if act.kind == "corrupt":
+                corrupt_host = True  # forces the verify-failure path
+            else:
+                try:
+                    act.apply()
+                except FaultError:
+                    # an injected T1 fault degrades to a MISS — the
+                    # match ends at the pages already secured, never a
+                    # crash inside the admission path
+                    self._count_io_error("host", "get")
+                    return None
         path = None
         collided = False
         with self._lock:
@@ -247,7 +290,8 @@ class TieredPageStore:
                 # LRU touch: re-insert at the MRU end
                 del self._host[key_hash]
                 self._host[key_hash] = payload
-                hit = self._verify(payload, parent, expected, "host")
+                hit = None if corrupt_host \
+                    else self._verify(payload, parent, expected, "host")
                 if hit is None:  # collision: drop it, or probe() keeps
                     del self._host[key_hash]   # promising an unrestorable
                     self._host_nbytes -= payload.nbytes  # hist (livelock)
@@ -255,7 +299,8 @@ class TieredPageStore:
             else:
                 payload = self._pending.get(key_hash)
                 if payload is not None:
-                    hit = self._verify(payload, parent, expected, "host")
+                    hit = None if corrupt_host \
+                        else self._verify(payload, parent, expected, "host")
                     if hit is None:
                         self._pending.pop(key_hash, None)
                         collided = True
@@ -268,6 +313,8 @@ class TieredPageStore:
             # the dropped T1 copy must leave the index too, or the
             # router keeps scoring phantom tier affinity for the hash
             self.dropped += 1
+            if corrupt_host:
+                self._count_io_error("host", "get")
             if self.index is not None:
                 self.index.unpublish_tier(key_hash, "host")
             return None
@@ -275,8 +322,15 @@ class TieredPageStore:
             return hit
         if path is None:
             return None
-        payload = self._read_file(path)
+        if not self._disk_breaker.allow():
+            # disk tier quarantined (breaker open): clean MISS; the
+            # entry STAYS — it may serve again once a half-open probe
+            # closes the breaker
+            return None
+        payload = self._read_disk(path)
         if payload is None:
+            self._disk_breaker.record_failure("disk read")
+            self._count_io_error("disk", "read")
             with self._lock:
                 entry = self._disk.pop(key_hash, None)
                 if entry is not None:
@@ -284,6 +338,7 @@ class TieredPageStore:
             if self.index is not None:
                 self.index.unpublish_tier(key_hash, "disk")
             return None
+        self._disk_breaker.record_success()
         self.disk_reads += 1
         hit = self._verify(payload, parent, expected, "disk")
         if hit is None:
@@ -332,7 +387,14 @@ class TieredPageStore:
     def _writer_loop(self) -> None:  # lint: runs-on[spill]
         """Write-behind: persist pending T1 overflow to disk, bounded by
         the disk budget (oldest files evicted — past the last tier, the
-        page is truly gone and the index forgets it)."""
+        page is truly gone and the index forgets it).
+
+        Hardened (docs/resilience.md): transient write errors — real or
+        injected at the ``tier.disk.write`` fault point — retry with
+        bounded jittered backoff, then the ENTRY quarantines (clean
+        drop, counted); repeated failures open the ``tier.disk``
+        breaker, after which writebacks drop immediately (no retry
+        storm against a dead disk) until a half-open probe recovers."""
         while True:
             key_hash = self._writeq.get()
             if key_hash is None:
@@ -344,17 +406,29 @@ class TieredPageStore:
             path = os.path.join(self._ensure_dir(),
                                 key_hash.hex() + ".npz")
             started = time.monotonic()
-            try:
-                self._write_file(path, payload)
-            except OSError:
-                logger.exception("kv tier store: disk write failed (%s); "
-                                 "dropping page", path)
+            if not self._disk_breaker.allow():
+                # disk tier quarantined: drop cleanly (stay bounded,
+                # never wedge the writer on a dead disk); T1/HBM keep
+                # serving the corpus that remains
                 with self._lock:
                     self._pending.pop(key_hash, None)
                 self.dropped += 1
                 if self.index is not None:
                     self.index.unpublish_tier(key_hash, "host")
                 continue
+            if not self._write_disk(path, payload):
+                self._disk_breaker.record_failure("disk write")
+                self._count_io_error("disk", "write")
+                logger.warning("kv tier store: disk write failed after "
+                               "%d attempt(s) (%s); dropping page",
+                               self.io_retry_max + 1, path)
+                with self._lock:
+                    self._pending.pop(key_hash, None)
+                self.dropped += 1
+                if self.index is not None:
+                    self.index.unpublish_tier(key_hash, "host")
+                continue
+            self._disk_breaker.record_success()
             nbytes = payload.nbytes
             evicted: list[tuple[bytes, str]] = []
             with self._lock:
@@ -385,6 +459,93 @@ class TieredPageStore:
                 if self.index is not None:
                     self.index.unpublish_tier(old_key, "disk")
 
+    def _count_io_error(self, tier: str, op: str) -> None:
+        self.io_errors[(tier, op)] += 1
+        if self.metrics is not None:
+            try:
+                self.metrics.llm_prefix_tier_io_errors.labels(
+                    tier=tier, op=op).inc()
+            except Exception:
+                pass  # accounting must never mask the IO failure itself
+
+    def _write_disk(self, path: str, payload: SpilledPage) -> bool:
+        """One writeback with bounded retries. The ``tier.disk.write``
+        fault point fires per ATTEMPT (an ``error`` rule in ``always``
+        mode exhausts the retries; ``one_in_n`` exercises the retry
+        succeeding); a ``corrupt`` rule mangles the file AFTER a clean
+        write — the read side's verification must turn it into a MISS."""
+        corrupt_after = False
+        for attempt in range(self.io_retry_max + 1):
+            act = fault_point("tier.disk.write", scope=path)
+            try:
+                if act is not None:
+                    if act.kind == "corrupt":
+                        corrupt_after = True
+                    else:
+                        act.apply()
+                self._write_file(path, payload)
+            except OSError:
+                if attempt >= self.io_retry_max:
+                    return False
+                time.sleep(_backoff_s(self.io_retry_backoff_ms, attempt,
+                                      len(path)))
+                continue
+            if corrupt_after:
+                try:
+                    with open(path, "r+b") as fh:
+                        data = fh.read()
+                        fh.seek(0)
+                        fh.write(FaultAction.corrupt_bytes(data))
+                except OSError:
+                    pass
+            return True
+        return False
+
+    def _read_disk(self, path: str) -> SpilledPage | None:
+        """One disk load with bounded retries: transient ``OSError``
+        (or an injected ``tier.disk.read`` error) retries with jittered
+        backoff; structurally corrupt content (real bit rot or an
+        injected ``corrupt`` rule) quarantines immediately — retrying
+        cannot fix a bad file, and the caller drops the entry to a
+        clean MISS."""
+        for attempt in range(self.io_retry_max + 1):
+            data_override = None
+            act = fault_point("tier.disk.read", scope=path)
+            try:
+                if act is not None:
+                    if act.kind == "corrupt":
+                        with open(path, "rb") as fh:
+                            data_override = FaultAction.corrupt_bytes(
+                                fh.read())
+                    else:
+                        act.apply()
+                if data_override is not None:
+                    import io
+                    with np.load(io.BytesIO(data_override)) as data:
+                        return self._payload_from(data)
+                with np.load(path) as data:
+                    return self._payload_from(data)
+            except OSError:
+                if attempt >= self.io_retry_max:
+                    return None
+                time.sleep(_backoff_s(self.io_retry_backoff_ms, attempt,
+                                      len(path)))
+            except Exception:
+                # corrupt content (BadZipFile / KeyError / ValueError /
+                # truncated pickle): unrecoverable, quarantine now
+                logger.warning("kv tier store: corrupt spill file %s",
+                               path)
+                return None
+        return None
+
+    @staticmethod
+    def _payload_from(data) -> SpilledPage:
+        return SpilledPage(
+            chunk=tuple(int(t) for t in data["chunk"]),
+            parent=data["parent"].tobytes(),
+            k=data["k"], v=data["v"],
+            k_scales=data["k_scales"], v_scales=data["v_scales"])
+
     @staticmethod
     def _write_file(path: str, payload: SpilledPage) -> None:
         tmp = path + ".tmp"
@@ -399,13 +560,11 @@ class TieredPageStore:
 
     @staticmethod
     def _read_file(path: str) -> SpilledPage | None:
+        """Unhardened single-shot load (kept for tooling/tests); the
+        serving path uses :meth:`_read_disk`."""
         try:
             with np.load(path) as data:
-                return SpilledPage(
-                    chunk=tuple(int(t) for t in data["chunk"]),
-                    parent=data["parent"].tobytes(),
-                    k=data["k"], v=data["v"],
-                    k_scales=data["k_scales"], v_scales=data["v_scales"])
+                return TieredPageStore._payload_from(data)
         except (OSError, KeyError, ValueError):
             logger.warning("kv tier store: unreadable spill file %s", path)
             return None
@@ -427,6 +586,9 @@ class TieredPageStore:
             "spilled": self.spilled, "dropped": self.dropped,
             "disk_writes": self.disk_writes, "disk_reads": self.disk_reads,
             "collisions": self.collisions,
+            "io_errors": {f"{tier}.{op}": count for (tier, op), count
+                          in self.io_errors.items()},
+            "disk_breaker": self._disk_breaker.snapshot(),
         }
 
 

@@ -184,7 +184,9 @@ class EnginePool:
                     host_bytes=config.tier_host_bytes,
                     disk_bytes=config.tier_disk_bytes,
                     disk_dir=config.tier_disk_dir,
-                    index=self.prefix_index, metrics=metrics)
+                    index=self.prefix_index, metrics=metrics,
+                    io_retry_max=config.tier_io_retry_max,
+                    io_retry_backoff_ms=config.tier_io_retry_backoff_ms)
         self.requeue_max = max(0, requeue_max)
         self._factory = engine_factory or (
             lambda cfg, tracer, metrics, devices, ledger=None,
@@ -348,12 +350,15 @@ class EnginePool:
                 m.llm_pool_outstanding.labels(replica=replica.id).set(
                     len(replica.outstanding))
             return
-        # no replica could take it
+        # no replica could take it: this is CAPACITY loss, not a broken
+        # request — terminate with the "unavailable" reason the serving
+        # surface maps to a clean 503 + Retry-After (backpressure-header
+        # contract, docs/resilience.md) instead of a bare error
         logger.error("engine pool: no routable replica for %s (%s)",
                      request.request_id, last_error,
                      extra=trace_extra(request.trace_ctx))
         if request.finish_reason is None:
-            request.finish_reason = "error"
+            request.finish_reason = "unavailable"
         request.stream.put_nowait(None)
 
     def _make_shadow(self, request: GenRequest, attempts: int) -> GenRequest:
@@ -507,6 +512,7 @@ class EnginePool:
             await self._requeue(record)
 
     async def _requeue(self, record: PoolRecord) -> None:
+        from ...observability.faults import FaultError, fault_point
         request = record.request
         if record.done or request.finish_reason is not None:
             return
@@ -519,10 +525,27 @@ class EnginePool:
             return
         if (self._stopping or record.attempts - 1 >= self.requeue_max
                 or not self._routable()):
+            # requeue budget spent / nowhere to go: the stream ends with
+            # "unavailable" — the provider raises LLMUnavailable and the
+            # HTTP surface answers 503 + Retry-After (clean terminal,
+            # never a bare mid-stream error; pinned in the pool tests)
             record.done = True
-            request.finish_reason = "error"
+            request.finish_reason = "unavailable"
             request.stream.put_nowait(None)
             return
+        # fault point pool.requeue (docs/resilience.md): an injected
+        # error fails THIS failover hop the same way a spent budget
+        # does; latency delays the continuation (the chaos matrix's
+        # slow-failover arm). Unarmed: one dict miss.
+        act = fault_point("pool.requeue", scope=request.request_id)
+        if act is not None:
+            try:
+                await act.async_apply()
+            except FaultError:
+                record.done = True
+                request.finish_reason = "unavailable"
+                request.stream.put_nowait(None)
+                return
         self.requeues += 1
         # counted here — not in fail_replica — so the status card's
         # requeued_off and mcpforge_llm_pool_requeues_total agree no
@@ -640,6 +663,27 @@ class EnginePool:
                     "engine pool: replica %s dispatch thread is still "
                     "wedged; rebuilding anyway — device memory may be "
                     "double-committed until it exits", rid)
+        # spill-on-drain (docs/resilience.md): with the dispatch thread
+        # quiesced and the old engine's device state still intact, copy
+        # its ref==0 resident prefix pages into the pool-shared spill
+        # store — the rebuilt engine (and every sibling) then restores
+        # the prefix corpus by fetch-on-miss instead of losing it with
+        # the torn-down HBM pool. A dead/wedged engine is skipped: its
+        # device state is suspect and must not poison the shared tiers.
+        thread_quiesced = thread is None or not thread.is_alive()
+        if not was_dead and thread_quiesced \
+                and self.tier_store is not None:
+            try:
+                spilled = await asyncio.to_thread(
+                    replica.engine.spill_prefix_pages)
+                if spilled:
+                    logger.info("engine pool: reload of replica %s "
+                                "spilled %d resident prefix page(s)",
+                                rid, spilled)
+            except Exception:
+                logger.exception("engine pool: spill-on-drain failed for "
+                                 "replica %s (continuing with rebuild)",
+                                 rid)
         try:
             # engine construction compiles + loads weights: off the loop
             engine = await asyncio.to_thread(self._build_engine,

@@ -25,6 +25,17 @@ class LLMError(Exception):
     pass
 
 
+class LLMUnavailable(LLMError):
+    """Serving capacity is temporarily gone (pool requeue budget spent,
+    no routable replica, overload shed). The HTTP surface maps this to
+    503 + Retry-After — the backpressure-header contract — instead of a
+    bare error (docs/resilience.md)."""
+
+    def __init__(self, message: str, retry_after_s: int = 1) -> None:
+        super().__init__(message)
+        self.retry_after_s = max(1, int(retry_after_s))
+
+
 class LLMProvider(ABC):
     """One backend capable of chat and/or embeddings (OpenAI wire shapes)."""
 

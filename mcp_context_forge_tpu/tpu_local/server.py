@@ -8,6 +8,7 @@ model alias maps to an ``openai_compatible`` provider in the registry).
 
 from __future__ import annotations
 
+import asyncio
 import json
 from typing import Any
 
@@ -15,7 +16,7 @@ from aiohttp import web
 
 from ..observability import phases as request_phases
 from ..observability.tracing import current_span
-from .provider import LLMError, LLMProviderRegistry
+from .provider import LLMError, LLMProviderRegistry, LLMUnavailable
 
 
 def _queue_state(request: web.Request) -> dict[str, Any] | None:
@@ -41,9 +42,62 @@ def setup_llm_routes(app: web.Application, registry: LLMProviderRegistry,
             metrics.llm_requests.labels(model="unresolved",
                                         status="error").inc()
 
+    def _unavailable_response(request: web.Request,
+                              exc: LLMUnavailable) -> web.Response:
+        """503 + Retry-After: the backpressure-header contract for a
+        request the pool could not serve (requeue budget spent, no
+        routable replica). Retry-After scales with live saturation when
+        the queue state is readable, floored at the exception's own
+        advisory."""
+        from ..gateway.flight_recorder import queue_state, retry_after_s
+        state = queue_state(request.app)
+        retry_in = exc.retry_after_s
+        headers = {}
+        if state is not None:
+            headers["X-Queue-Depth"] = str(state["depth"])
+            retry_in = max(retry_in, retry_after_s(state["saturation"]))
+        headers["Retry-After"] = str(retry_in)
+        _count_error(request)
+        return web.json_response(
+            {"error": {"message": str(exc), "type": "overloaded_error",
+                       "code": 503, "retry_after_s": retry_in}},
+            status=503, headers=headers)
+
+    def _shed_response(request: web.Request) -> web.Response | None:
+        """Overload-shedding admission gate (observability/degradation.py,
+        docs/resilience.md): consult the shedder with the live engine
+        saturation + the request's tenant; a shed verdict becomes a 429
+        with Retry-After, lowest SLO class first."""
+        shedder = request.app.get("overload_shedder")
+        if shedder is None:
+            return None
+        from ..gateway.flight_recorder import queue_state
+        state = queue_state(request.app)
+        verdict = shedder.decide(
+            (state or {}).get("saturation", 0.0),
+            request.get("tenant") or "")
+        if verdict is None:
+            return None
+        headers = {"Retry-After": str(verdict["retry_after_s"])}
+        if state is not None:
+            headers["X-Queue-Depth"] = str(state["depth"])
+        _count_error(request)
+        return web.json_response(
+            {"error": {"message": "request shed under overload "
+                       f"({verdict['reason']}); retry after "
+                       f"{verdict['retry_after_s']}s",
+                       "type": "overloaded_error", "code": 429,
+                       "reason": verdict["reason"],
+                       "slo_class": verdict["slo_class"],
+                       "retry_after_s": verdict["retry_after_s"]}},
+            status=429, headers=headers)
+
     @routes.post(f"{prefix}/chat/completions")
     async def chat_completions(request: web.Request) -> web.StreamResponse:
         request["auth"].require("llm.chat")
+        shed = _shed_response(request)
+        if shed is not None:
+            return shed
         try:
             body = await request.json()
         except json.JSONDecodeError:
@@ -60,47 +114,105 @@ def setup_llm_routes(app: web.Application, registry: LLMProviderRegistry,
             if body.get("stream"):
                 with request_phases.phase("routing"):
                     registry.resolve(body.get("model"))  # fail before the stream starts
-                headers = {"content-type": "text/event-stream",
-                           "cache-control": "no-store"}
-                # backpressure surfaces BEFORE prepare(): a streamed
-                # response's headers are immutable once sent, so the
-                # flight-recorder middleware cannot add them afterwards
-                state = _queue_state(request)
-                if state is not None:
-                    from ..gateway.flight_recorder import \
-                        backpressure_headers
-                    headers.update(backpressure_headers(
-                        state, request.app["ctx"].settings))
-                resp = web.StreamResponse(headers=headers)
-                await resp.prepare(request)
+                # the FIRST chunk is awaited BEFORE prepare() — but only
+                # for a BOUNDED window: a request the pool refuses
+                # outright (LLMUnavailable — requeue budget spent,
+                # nothing routable) gets a clean 503 + Retry-After
+                # instead of a 200 stream that dies, while a long-TTFT
+                # request (deep queue, cold compile) must not have its
+                # response HEADERS serialized behind the whole TTFT —
+                # past the window headers go out and the first chunk is
+                # awaited mid-stream like before
+                chunks = registry.chat_stream(body).__aiter__()
+                first_task = asyncio.ensure_future(chunks.__anext__())
                 try:
-                    # phase attribution splits the stream loop: waiting
-                    # on the engine's next chunk is "engine", pushing it
-                    # to the socket is "serialize"
-                    chunks = registry.chat_stream(body).__aiter__()
-                    while True:
+                    first = None
+                    first_pending = True
+                    wait_s = request.app["ctx"].settings \
+                        .gw_stream_first_chunk_wait_s
+                    if wait_s > 0:
                         with request_phases.phase("engine"):
+                            done, _ = await asyncio.wait({first_task},
+                                                         timeout=wait_s)
+                        if done:
+                            first_pending = False
                             try:
-                                chunk = await chunks.__anext__()
+                                # raises LLMUnavailable -> pre-prepare 503
+                                first = first_task.result()
                             except StopAsyncIteration:
-                                break
-                        with request_phases.phase("serialize"):
-                            await resp.write(
-                                b"data: " + json.dumps(chunk).encode()
-                                + b"\n\n")
-                    await resp.write(b"data: [DONE]\n\n")
-                except Exception as exc:
-                    # mid-stream failure: error event on the stream — a second
-                    # response cannot be started once prepare() has run
-                    await resp.write(b"data: " + json.dumps(
-                        {"error": {"message": f"{type(exc).__name__}: {exc}"}}
-                    ).encode() + b"\n\n")
-                await resp.write_eof()
-                return resp
+                                first = None
+                    headers = {"content-type": "text/event-stream",
+                               "cache-control": "no-store"}
+                    # backpressure surfaces BEFORE prepare(): a streamed
+                    # response's headers are immutable once sent, so the
+                    # flight-recorder middleware cannot add them afterwards
+                    state = _queue_state(request)
+                    if state is not None:
+                        from ..gateway.flight_recorder import \
+                            backpressure_headers
+                        headers.update(backpressure_headers(
+                            state, request.app["ctx"].settings))
+                    resp = web.StreamResponse(headers=headers)
+                    await resp.prepare(request)
+                    try:
+                        # phase attribution splits the stream loop:
+                        # waiting on the engine's next chunk is
+                        # "engine", pushing it to the socket is
+                        # "serialize"
+                        chunk = first
+                        if first_pending:
+                            # headers already out: finish waiting for
+                            # the first chunk on the open stream (a
+                            # refusal now lands as a structured error
+                            # event below)
+                            with request_phases.phase("engine"):
+                                try:
+                                    chunk = await first_task
+                                except StopAsyncIteration:
+                                    chunk = None
+                        while chunk is not None:
+                            with request_phases.phase("serialize"):
+                                await resp.write(
+                                    b"data: " + json.dumps(chunk).encode()
+                                    + b"\n\n")
+                            with request_phases.phase("engine"):
+                                try:
+                                    chunk = await chunks.__anext__()
+                                except StopAsyncIteration:
+                                    chunk = None
+                        await resp.write(b"data: [DONE]\n\n")
+                    except Exception as exc:
+                        # mid-stream failure: error event on the stream —
+                        # a second response cannot be started once
+                        # prepare() has run
+                        await resp.write(b"data: " + json.dumps(
+                            {"error": {"message":
+                                       f"{type(exc).__name__}: {exc}"}}
+                        ).encode() + b"\n\n")
+                    await resp.write_eof()
+                    return resp
+                finally:
+                    # the prefetch must never leak a generation: if
+                    # anything failed (client disconnect during the
+                    # bounded wait, prepare() error, mid-stream cancel
+                    # while the first chunk was still pending) cancel
+                    # the task, retrieve any unobserved exception, and
+                    # close the provider stream so the engine side
+                    # winds down instead of generating for a dead client
+                    if not first_task.done():
+                        first_task.cancel()
+                    elif not first_task.cancelled():
+                        first_task.exception()  # mark retrieved
+                    try:
+                        await chunks.aclose()
+                    except Exception:
+                        pass
             with request_phases.phase("engine"):
                 result = await registry.chat(body)
             with request_phases.phase("serialize"):
                 return web.json_response(result)
+        except LLMUnavailable as exc:
+            return _unavailable_response(request, exc)
         except LLMError as exc:
             _count_error(request)
             return web.json_response({"error": {"message": str(exc),

@@ -227,6 +227,13 @@ class TPULocalProvider(LLMProvider):
                 if token is None:
                     break
                 tokens.append(token)
+            if gen.finish_reason == "unavailable":
+                # pool requeue budget spent / no routable replica: a
+                # clean 503 + Retry-After beats a partial "completion"
+                from .provider import LLMUnavailable
+                raise LLMUnavailable(
+                    "serving capacity temporarily unavailable "
+                    "(pool failover budget exhausted)")
             text = self.engine.tokenizer.decode(tokens)
             self._count_request(model, len(gen.prompt_ids), len(tokens))
             if span is not None:
@@ -304,6 +311,7 @@ class TPULocalProvider(LLMProvider):
         buffering = expect_tools  # until the first flush decides
         emitted: list[str] = []
         pending: list[int] = []
+        delivered = False  # any content chunk actually yielded downstream
         while True:
             token = await gen.stream.get()
             if token is None:
@@ -318,11 +326,38 @@ class TPULocalProvider(LLMProvider):
                     if head and head[0] not in "{[":
                         buffering = False  # plain answer: replay + stream
                         for chunk in emitted:
+                            delivered = True
                             yield self._content_chunk(chunk_id, created,
                                                       model, chunk)
                         emitted = []
                     continue
+                delivered = True
                 yield self._content_chunk(chunk_id, created, model, text)
+        if gen.finish_reason == "unavailable":
+            if not delivered:
+                # nothing reached the client yet: raise so the HTTP
+                # surface can answer a clean 503 + Retry-After (the
+                # stream handler fetches its FIRST chunk pre-prepare)
+                from .provider import LLMUnavailable
+                raise LLMUnavailable(
+                    "serving capacity temporarily unavailable "
+                    "(pool failover budget exhausted)")
+            # tokens already streamed: terminate with a STRUCTURED
+            # terminal chunk (finish_reason + error object with the
+            # retry advisory) instead of a bare mid-stream error
+            yield {
+                "id": chunk_id, "object": "chat.completion.chunk",
+                "created": created, "model": model,
+                "choices": [{"index": 0, "delta": {},
+                             "finish_reason": "unavailable"}],
+                "error": {"message": "serving capacity lost mid-stream "
+                                     "(pool failover budget exhausted); "
+                                     "retry with the partial output "
+                                     "discarded",
+                          "type": "overloaded_error", "code": 503,
+                          "retry_after_s": 1},
+            }
+            return
         if buffering and emitted:
             full = "".join(emitted)
             from .tool_calls import parse_tool_calls

@@ -1,0 +1,191 @@
+"""POST /admin/faults drive path + degradation surfaces (ISSUE 14).
+
+The admin plane's contracts:
+
+- default OFF: GET reports enabled=false (degradation status still
+  served — it is production telemetry), POST refuses with 404;
+- enabled: POST arms a rule (validated), the rule FIRES through the
+  real seam (db.execute scoped to one table, ledger.rollup.flush,
+  federation.peer.request), fired counts and the injected-fault metric
+  move, DELETE disarms idempotently;
+- the degradation block carries breaker states + transition history +
+  rollup outage stats, and /admin/gateway/requests carries the compact
+  per-component summary next to backpressure.
+"""
+
+import asyncio
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from mcp_context_forge_tpu.config import load_settings
+from mcp_context_forge_tpu.gateway.app import build_app
+
+BASIC = ("admin", "changeme")
+
+
+def _settings(**overrides):
+    env = {
+        "MCPFORGE_DATABASE_URL": "sqlite:///:memory:",
+        "MCPFORGE_PLUGINS_ENABLED": "false",
+        "MCPFORGE_TPU_LOCAL_ENABLED": "false",
+        "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
+        "MCPFORGE_DEGRADATION_COOLDOWN_S": "0.05",
+        "MCPFORGE_DEGRADATION_FAILURE_THRESHOLD": "2",
+        **{f"MCPFORGE_{k.upper()}": str(v) for k, v in overrides.items()},
+    }
+    return load_settings(env=env, env_file=None)
+
+
+async def make_client(**overrides) -> TestClient:
+    app = await build_app(_settings(**overrides))
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def _auth(client):
+    from aiohttp import BasicAuth
+    return BasicAuth(*BASIC)
+
+
+async def test_faults_admin_disabled_by_default():
+    client = await make_client()
+    try:
+        resp = await client.get("/admin/faults", auth=_auth(client))
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["enabled"] is False
+        assert body["rules"] == []
+        assert "components" in body["degradation"]
+        # the rollup outage stats ride the degradation block
+        assert body["degradation"]["rollup"]["pending_windows"] == 0
+        resp = await client.post("/admin/faults", auth=_auth(client),
+                                 json={"point": "db.execute"})
+        assert resp.status == 404  # default-off contract: cannot arm
+    finally:
+        await client.close()
+
+
+async def test_arm_fire_and_disarm_through_the_db_seam():
+    client = await make_client(fault_injection_enabled="true")
+    try:
+        auth = _auth(client)
+        # bad rules are rejected with a 4xx, not armed half-broken
+        resp = await client.post("/admin/faults", auth=auth,
+                                 json={"point": "no.such.point"})
+        assert resp.status in (400, 422)
+        # unknown fields fail CLOSED: a typo'd "Scope" must not arm an
+        # UNSCOPED rule that faults every statement
+        resp = await client.post("/admin/faults", auth=auth, json={
+            "point": "db.execute", "kind": "error",
+            "Scope": "tenant_usage"})
+        assert resp.status in (400, 422), await resp.text()
+        assert "Scope" in await resp.text()
+        resp = await client.get("/admin/faults", auth=auth)
+        assert (await resp.json())["rules"] == []
+        # scoped arm: only tenant_usage statements fault — the auth
+        # path (users table) keeps the admin surface usable mid-chaos
+        resp = await client.post("/admin/faults", auth=auth, json={
+            "point": "db.execute", "kind": "error", "mode": "always",
+            "scope": "tenant_usage"})
+        assert resp.status == 201
+        ctx = client.server.app["ctx"]
+        with_scope = await ctx.db.execute("SELECT 1")
+        assert with_scope == [{"1": 1}]          # unscoped SQL unaffected
+        import pytest
+        from mcp_context_forge_tpu.observability.faults import FaultError
+        with pytest.raises(FaultError):
+            await ctx.db.execute("SELECT * FROM tenant_usage")
+        resp = await client.get("/admin/faults", auth=auth)
+        body = await resp.json()
+        rule = next(r for r in body["rules"] if r["point"] == "db.execute")
+        assert rule["fired"] == 1
+        # injected faults are metric facts
+        metrics = client.server.app["ctx"].metrics.render()[0].decode()
+        assert ('mcpforge_faults_injected_total{kind="error",'
+                'point="db.execute"} 1.0') in metrics
+        resp = await client.delete("/admin/faults/db.execute", auth=auth)
+        assert (await resp.json())["disarmed"] is True
+        resp = await client.delete("/admin/faults/db.execute", auth=auth)
+        assert (await resp.json())["disarmed"] is False   # idempotent
+        assert await ctx.db.execute("SELECT 1 FROM tenant_usage"
+                                    " LIMIT 1") == []
+    finally:
+        await client.close()
+
+
+async def test_rollup_flush_fault_point_and_breaker_surface():
+    """Arm ledger.rollup.flush, drive flushes to open the breaker, then
+    disarm and watch the half-open probe recover — all through the
+    admin surface's reporting."""
+    client = await make_client(fault_injection_enabled="true")
+    try:
+        auth = _auth(client)
+        app = client.server.app
+        ledger = app["tenant_ledger"]
+        rollup = app["tenant_usage_rollup"]
+        resp = await client.post("/admin/faults", auth=auth, json={
+            "point": "ledger.rollup.flush", "kind": "error",
+            "mode": "always"})
+        assert resp.status == 201
+        for i in range(2):
+            ledger.add("team:x", prompt_tokens=5 + i)
+            try:
+                await rollup.flush()
+            except Exception:
+                pass
+        resp = await client.get("/admin/faults", auth=auth)
+        body = await resp.json()
+        assert body["degradation"]["components"]["ledger.rollup"] == "open"
+        assert body["degradation"]["rollup"]["pending_windows"] == 2
+        await client.delete("/admin/faults/ledger.rollup.flush", auth=auth)
+        await asyncio.sleep(0.06)               # cooldown
+        assert await rollup.flush() == 2        # original windows land
+        resp = await client.get("/admin/faults", auth=auth)
+        body = await resp.json()
+        assert body["degradation"]["components"]["ledger.rollup"] == "closed"
+        transitions = [t["to"] for t in body["degradation"]["transitions"]
+                       if t["component"] == "ledger.rollup"]
+        assert transitions == ["open", "half_open", "closed"]
+    finally:
+        await client.close()
+
+
+async def test_federation_fault_point_fires_through_the_wizard_probe():
+    """federation.peer.request rides GatewayService._connect: the
+    registration wizard's dry-run probe reports the injected outage as
+    data (inline error), proving the seam sits on the real connect
+    path."""
+    client = await make_client(fault_injection_enabled="true")
+    try:
+        auth = _auth(client)
+        resp = await client.post("/admin/faults", auth=auth, json={
+            "point": "federation.peer.request", "kind": "error",
+            "mode": "always", "message": "injected peer outage"})
+        assert resp.status == 201
+        resp = await client.post("/gateways/test", auth=auth, json={
+            "url": "http://peer.invalid:9/mcp",
+            "transport": "streamablehttp"})
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["ok"] is False
+        assert "injected peer outage" in body["error"]
+        resp = await client.get("/admin/faults", auth=auth)
+        rules = (await resp.json())["rules"]
+        assert next(r for r in rules
+                    if r["point"] == "federation.peer.request")["fired"] >= 1
+    finally:
+        await client.close()
+
+
+async def test_gateway_tab_payload_carries_degradation_summary():
+    client = await make_client()
+    try:
+        resp = await client.get("/admin/gateway/requests",
+                                auth=_auth(client))
+        assert resp.status == 200
+        body = await resp.json()
+        assert isinstance(body["degradation"], dict)
+        assert body["shed_total"] == 0
+    finally:
+        await client.close()

@@ -1,0 +1,188 @@
+"""HTTP-surface halves of the degradation ladder (ISSUE 14): the
+503 + Retry-After contract for lost serving capacity and the overload
+shedder's 429s, end-to-end through /v1/chat/completions."""
+
+import json
+
+import aiohttp
+from aiohttp.test_utils import TestClient, TestServer
+
+from mcp_context_forge_tpu.config import load_settings
+from mcp_context_forge_tpu.gateway.app import build_app
+
+BASIC = aiohttp.BasicAuth("admin", "changeme")
+
+
+async def make_llm_gateway(**overrides) -> TestClient:
+    settings = load_settings(env={
+        "MCPFORGE_DATABASE_URL": "sqlite:///:memory:",
+        "MCPFORGE_PLUGINS_ENABLED": "false",
+        "MCPFORGE_TPU_LOCAL_ENABLED": "true",
+        "MCPFORGE_TPU_LOCAL_MODEL": "llama3-test",
+        "MCPFORGE_TPU_LOCAL_MAX_BATCH": "4",
+        "MCPFORGE_TPU_LOCAL_MAX_SEQ_LEN": "128",
+        "MCPFORGE_TPU_LOCAL_PAGE_SIZE": "16",
+        "MCPFORGE_TPU_LOCAL_NUM_PAGES": "64",
+        "MCPFORGE_TPU_LOCAL_PREFILL_BUCKETS": "64",
+        "MCPFORGE_TPU_LOCAL_DTYPE": "float32",
+        "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
+        **{f"MCPFORGE_{k.upper()}": str(v) for k, v in overrides.items()},
+    }, env_file=None)
+    app = await build_app(settings)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+class _UnavailableEngine:
+    """Duck-typed engine refusing every request the way a
+    requeue-exhausted pool does."""
+
+    def __init__(self, engine):
+        self.tokenizer = engine.tokenizer
+        self.config = engine.config
+
+    async def submit(self, gen):
+        gen.finish_reason = "unavailable"
+        gen.stream.put_nowait(None)
+        return gen
+
+
+async def test_unavailable_pool_maps_to_503_with_retry_after():
+    gateway = await make_llm_gateway()
+    try:
+        app = gateway.server.app
+        provider = app["tpu_provider"]
+        provider.engine = _UnavailableEngine(app["tpu_engine"])
+        body = {"model": "llama3-test",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4}
+        # unary: clean 503 + Retry-After (never a 200 with an 'error'
+        # finish_reason buried in the JSON)
+        resp = await gateway.post("/v1/chat/completions", json=body,
+                                  auth=BASIC)
+        assert resp.status == 503, await resp.text()
+        assert int(resp.headers["Retry-After"]) >= 1
+        payload = await resp.json()
+        assert payload["error"]["type"] == "overloaded_error"
+        assert payload["error"]["retry_after_s"] >= 1
+        # streaming: the FIRST chunk is fetched before prepare(), so a
+        # refused request gets the same clean 503 — not a 200 SSE
+        # stream that dies mid-flight
+        resp = await gateway.post("/v1/chat/completions",
+                                  json={**body, "stream": True},
+                                  auth=BASIC)
+        assert resp.status == 503, await resp.text()
+        assert int(resp.headers["Retry-After"]) >= 1
+    finally:
+        await gateway.close()
+
+
+async def test_streaming_surface_unchanged_by_first_chunk_prefetch():
+    """The pre-prepare first-chunk fetch must not change the happy
+    path: same SSE framing, same terminal [DONE]."""
+    gateway = await make_llm_gateway()
+    try:
+        resp = await gateway.post("/v1/chat/completions", json={
+            "model": "llama3-test",
+            "messages": [{"role": "user", "content": "stream me"}],
+            "max_tokens": 6, "stream": True}, auth=BASIC)
+        assert resp.status == 200
+        assert resp.headers["content-type"].startswith("text/event-stream")
+        raw = await resp.text()
+        frames = [l[6:] for l in raw.splitlines() if l.startswith("data: ")]
+        assert frames[-1] == "[DONE]"
+        chunks = [json.loads(f) for f in frames[:-1]]
+        assert chunks[-1]["choices"][0]["finish_reason"] in ("stop",
+                                                             "length")
+        assert any(c["choices"][0]["delta"].get("content")
+                   for c in chunks)
+    finally:
+        await gateway.close()
+
+
+async def test_stream_first_chunk_wait_zero_sends_headers_immediately():
+    """gw_stream_first_chunk_wait_s=0 skips the pre-prepare wait (the
+    long-TTFT posture: headers must never serialize behind TTFT); the
+    first chunk is then awaited on the open stream and the happy path
+    is unchanged."""
+    gateway = await make_llm_gateway(gw_stream_first_chunk_wait_s="0")
+    try:
+        resp = await gateway.post("/v1/chat/completions", json={
+            "model": "llama3-test",
+            "messages": [{"role": "user", "content": "stream me"}],
+            "max_tokens": 6, "stream": True}, auth=BASIC)
+        assert resp.status == 200
+        raw = await resp.text()
+        frames = [l[6:] for l in raw.splitlines() if l.startswith("data: ")]
+        assert frames[-1] == "[DONE]"
+        assert any(json.loads(f)["choices"][0]["delta"].get("content")
+                   for f in frames[:-1])
+    finally:
+        await gateway.close()
+
+
+async def test_overload_shed_429_lowest_class_first():
+    """With the default class sheddable at bar 0.0, every request from
+    an unmapped tenant sheds with 429 + Retry-After; a tenant mapped to
+    an UNLISTED class (premium) is never shed on saturation — the
+    'higher classes hold' half of the ladder."""
+    gateway = await make_llm_gateway(
+        gw_shed_saturation_at="0.0",
+        gw_shed_class_order='["default"]',
+        slo_tenant_classes=json.dumps(
+            {"user:admin@example.com": "premium"}))
+    try:
+        body = {"model": "llama3-test",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4}
+        # admin maps to premium (unlisted): admitted even at the bar
+        resp = await gateway.post("/v1/chat/completions", json=body,
+                                  auth=BASIC)
+        assert resp.status == 200, await resp.text()
+        # mint a plain user -> tenant class "default" -> sheds
+        resp = await gateway.post("/admin/users", json={
+            "email": "shed@example.com", "password": "Vq8#mRt2xW!s",
+            "full_name": "Shed Target"}, auth=BASIC)
+        assert resp.status in (201, 409), await resp.text()
+        user = aiohttp.BasicAuth("shed@example.com", "Vq8#mRt2xW!s")
+        resp = await gateway.post("/v1/chat/completions", json=body,
+                                  auth=user)
+        assert resp.status == 429, await resp.text()
+        assert int(resp.headers["Retry-After"]) >= 1
+        payload = await resp.json()
+        assert payload["error"]["reason"] == "overload"
+        assert payload["error"]["slo_class"] == "default"
+        app = gateway.server.app
+        assert app["overload_shedder"].shed_total >= 1
+        metrics = app["ctx"].metrics.render()[0].decode()
+        assert ('mcpforge_gw_requests_shed_total{reason="overload",'
+                'slo_class="default"}') in metrics
+        # degradation surface reports the shed state
+        resp = await gateway.get("/admin/faults", auth=BASIC)
+        assert (await resp.json())["shedder"]["shed_total"] >= 1
+    finally:
+        await gateway.close()
+
+
+async def test_quota_exhausted_tenant_sheds_with_429():
+    """The quota half of ROADMAP item 5: a tenant whose window is spent
+    (quota_ratio >= 1) 429s regardless of saturation."""
+    gateway = await make_llm_gateway(
+        tenant_quota_tokens_per_window="10")
+    try:
+        body = {"model": "llama3-test",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4}
+        app = gateway.server.app
+        # burn the admin tenant's window directly through the ledger
+        app["tenant_ledger"].add("user:admin@example.com",
+                                 prompt_tokens=11)
+        resp = await gateway.post("/v1/chat/completions", json=body,
+                                  auth=BASIC)
+        assert resp.status == 429, await resp.text()
+        payload = await resp.json()
+        assert payload["error"]["reason"] == "quota"
+        assert int(resp.headers["Retry-After"]) >= 1
+    finally:
+        await gateway.close()

@@ -445,9 +445,11 @@ def test_pool_cancel_routes_to_serving_replica():
     asyncio.run(main())
 
 
-def test_requeue_budget_exhaustion_errors_out():
-    """When every replica is gone the pool terminates streams with
-    finish_reason='error' instead of stranding consumers."""
+def test_requeue_budget_exhaustion_terminates_as_unavailable():
+    """ISSUE-14 satellite: a spent requeue budget (every replica gone)
+    terminates the stream with finish_reason='unavailable' — the clean
+    capacity-loss terminal the HTTP surface maps to 503 + Retry-After
+    (backpressure-header contract) — never a bare mid-stream 'error'."""
     async def main():
         pool = _pool(replicas=2)
         _poison_decode(pool.replicas[0].engine, explode_after=1)
@@ -465,10 +467,85 @@ def test_requeue_budget_exhaustion_errors_out():
                 if token is None:
                     break
                 tokens.append(token)
-            assert request.finish_reason == "error"
+            assert request.finish_reason == "unavailable"
             assert all(r.state == "dead" for r in pool.replicas)
         finally:
             await pool.stop()
+
+    asyncio.run(main())
+
+
+def test_unavailable_terminal_maps_to_llm_unavailable():
+    """The provider half of the contract: a stream that ends
+    'unavailable' with nothing delivered raises LLMUnavailable (the
+    server answers 503 + Retry-After), both unary and streaming."""
+    from mcp_context_forge_tpu.tpu_local.provider import LLMUnavailable
+    from mcp_context_forge_tpu.tpu_local.tpu_provider import \
+        TPULocalProvider
+
+    class _UnavailableEngine:
+        """Duck-typed engine surface whose every request is refused the
+        way a requeue-exhausted pool refuses it."""
+
+        def __init__(self, engine):
+            self.tokenizer = engine.tokenizer
+            self.config = engine.config
+
+        async def submit(self, gen):
+            gen.finish_reason = "unavailable"
+            gen.stream.put_nowait(None)
+            return gen
+
+    async def main():
+        engine = TPUEngine(_config())
+        provider = TPULocalProvider("tpu_local",
+                                    _UnavailableEngine(engine))
+        request = {"model": "llama3-test",
+                   "messages": [{"role": "user", "content": "hi"}],
+                   "max_tokens": 4}
+        with pytest.raises(LLMUnavailable) as err:
+            await provider.chat(request)
+        assert err.value.retry_after_s >= 1
+        with pytest.raises(LLMUnavailable):
+            async for _chunk in provider.chat_stream(dict(request)):
+                pass
+
+    asyncio.run(main())
+
+
+def test_unavailable_mid_stream_yields_structured_terminal():
+    """Tokens already delivered: the stream must END with a structured
+    chunk (finish_reason='unavailable' + error object carrying the 503
+    retry advisory), never a bare exception into the SSE writer."""
+    from mcp_context_forge_tpu.tpu_local.tpu_provider import \
+        TPULocalProvider
+
+    class _DieMidStreamEngine:
+        def __init__(self, engine):
+            self.tokenizer = engine.tokenizer
+            self.config = engine.config
+
+        async def submit(self, gen):
+            for token in self.tokenizer.encode("partial answer")[:3]:
+                gen.generated.append(token)
+                gen.stream.put_nowait(token)
+            gen.finish_reason = "unavailable"
+            gen.stream.put_nowait(None)
+            return gen
+
+    async def main():
+        engine = TPUEngine(_config())
+        provider = TPULocalProvider("tpu_local",
+                                    _DieMidStreamEngine(engine))
+        chunks = [c async for c in provider.chat_stream(
+            {"model": "llama3-test",
+             "messages": [{"role": "user", "content": "hi"}],
+             "max_tokens": 8})]
+        assert chunks, "partial content must still reach the client"
+        terminal = chunks[-1]
+        assert terminal["choices"][0]["finish_reason"] == "unavailable"
+        assert terminal["error"]["code"] == 503
+        assert terminal["error"]["retry_after_s"] >= 1
 
     asyncio.run(main())
 

@@ -455,3 +455,190 @@ def test_prefix_index_chain_locations_and_reachability():
     chain = index.chain_locations(prompt, PS)
     assert index.reachable_tokens(chain, "1", PS) == 16  # tier only
     assert index.stats() == {"keys_hbm": 0, "keys_tiered": 1}
+
+
+# ---------------------------------------------- disk IO hardening (ISSUE 14)
+
+def _arm(rule_kwargs):
+    from mcp_context_forge_tpu.observability.faults import (FaultRule,
+                                                            configure_fault_plane)
+    plane = configure_fault_plane(True)
+    plane.arm(FaultRule(**rule_kwargs))
+    return plane
+
+
+@pytest.fixture()
+def fault_env():
+    """Armed fault plane + fast degradation thresholds, reset after."""
+    from mcp_context_forge_tpu.observability.degradation import \
+        configure_degradation
+    from mcp_context_forge_tpu.observability.faults import \
+        configure_fault_plane
+    configure_degradation(failure_threshold=2, cooldown_s=0.05)
+    yield
+    configure_fault_plane(False)
+    configure_degradation()
+
+
+def _spill_three(store):
+    """Three one-page spills into a T1 sized for one page: two overflow
+    to the write-behind worker."""
+    chunks = [tuple(range(i, i + 4)) for i in range(0, 12, 4)]
+    hashes = [chain_hashes(list(c) + [99], 4)[0] for c in chunks]
+    for h, c in zip(hashes, chunks):
+        store.put(h, _payload(c, fill=c[0] + 1))
+    return hashes, chunks
+
+
+def _drain_writer(store, deadline_s=10):
+    deadline = time.monotonic() + deadline_s
+    while (not store._writeq.empty() or store._pending) \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+
+
+def test_disk_write_fault_retries_then_quarantines_entry(fault_env):
+    """A persistent write error exhausts the bounded retries, drops the
+    entry CLEANLY (no hang, no poisoned serve), counts it in
+    io_errors{disk,write}, and opens the tier.disk breaker after the
+    threshold — T1 keeps serving throughout."""
+    from mcp_context_forge_tpu.observability.degradation import \
+        get_degradation
+    _arm({"point": "tier.disk.write", "kind": "error", "mode": "always"})
+    one = _payload((0,) * 4).nbytes
+    store = TieredPageStore(host_bytes=one + 1, disk_bytes=1 << 20,
+                            pin=False, io_retry_max=1,
+                            io_retry_backoff_ms=1.0)
+    try:
+        hashes, chunks = _spill_three(store)
+        _drain_writer(store)
+        stats = store.stats()
+        assert stats["disk_pages"] == 0
+        assert stats["io_errors"]["disk.write"] >= 2
+        assert stats["dropped"] >= 2                  # clean quarantine
+        assert stats["disk_breaker"]["state"] == "open"
+        assert get_degradation().component_state("tier.disk") == "open"
+        # T1 keeps serving: the newest entry is still a HIT
+        assert store.get(hashes[-1], ROOT_HASH, chunks[-1]) is not None
+        # the quarantined entries are clean MISSes, not hangs/errors
+        assert store.get(hashes[0], ROOT_HASH, chunks[0]) is None
+    finally:
+        store.close()
+
+
+def test_disk_write_transient_fault_recovers_via_retry(fault_env):
+    """A 1-in-2 write fault is absorbed by the retry (backoff then
+    success): nothing is lost, the breaker stays closed."""
+    _arm({"point": "tier.disk.write", "kind": "error",
+          "mode": "one_in_n", "n": 2})
+    one = _payload((0,) * 4).nbytes
+    store = TieredPageStore(host_bytes=one + 1, disk_bytes=1 << 20,
+                            pin=False, io_retry_max=2,
+                            io_retry_backoff_ms=1.0)
+    try:
+        _spill_three(store)
+        _drain_writer(store)
+        stats = store.stats()
+        assert stats["disk_pages"] == 2
+        assert stats["io_errors"]["disk.write"] == 0
+        assert stats["disk_breaker"]["state"] == "closed"
+    finally:
+        store.close()
+
+
+def test_disk_breaker_half_open_probe_recovers(fault_env):
+    """After the injected outage clears, the cooldown admits ONE probe
+    writeback; its success closes the breaker and the disk tier serves
+    again — the open -> half_open -> closed ladder in order."""
+    from mcp_context_forge_tpu.observability.degradation import \
+        get_degradation
+    from mcp_context_forge_tpu.observability.faults import \
+        get_fault_plane
+    _arm({"point": "tier.disk.write", "kind": "error", "mode": "always"})
+    one = _payload((0,) * 4).nbytes
+    store = TieredPageStore(host_bytes=one + 1, disk_bytes=1 << 20,
+                            pin=False, io_retry_max=0,
+                            io_retry_backoff_ms=1.0)
+    try:
+        _spill_three(store)
+        _drain_writer(store)
+        assert store.stats()["disk_breaker"]["state"] == "open"
+        get_fault_plane().disarm("tier.disk.write")
+        time.sleep(0.06)                     # cooldown elapses
+        chunks = [tuple(range(i, i + 4)) for i in range(100, 112, 4)]
+        hashes = [chain_hashes(list(c) + [99], 4)[0] for c in chunks]
+        for h, c in zip(hashes, chunks):
+            store.put(h, _payload(c))
+        _drain_writer(store)
+        assert store.stats()["disk_breaker"]["state"] == "closed"
+        assert store.stats()["disk_pages"] >= 1
+        transitions = [t["to"] for t in
+                       get_degradation().transitions("tier.disk")]
+        assert transitions[:3] == ["open", "half_open", "closed"]
+    finally:
+        store.close()
+
+
+def test_disk_read_fault_is_a_clean_miss_and_quarantines(fault_env):
+    """A persistent read error (after retries) drops the disk entry to
+    a clean MISS — never a hang, never garbage pages."""
+    _arm({"point": "tier.disk.read", "kind": "error", "mode": "always"})
+    one = _payload((0,) * 4).nbytes
+    store = TieredPageStore(host_bytes=one + 1, disk_bytes=1 << 20,
+                            pin=False, io_retry_max=1,
+                            io_retry_backoff_ms=1.0)
+    try:
+        hashes, chunks = _spill_three(store)
+        _drain_writer(store)
+        assert store.stats()["disk_pages"] == 2
+        assert store.get(hashes[0], ROOT_HASH, chunks[0]) is None
+        stats = store.stats()
+        assert stats["io_errors"]["disk.read"] == 1
+        assert stats["disk_pages"] == 1               # entry quarantined
+    finally:
+        store.close()
+
+
+def test_disk_read_corruption_quarantines_immediately(fault_env):
+    """Injected payload corruption (mangled file bytes) must surface as
+    a clean MISS via the unreadable-content path — wrong pages are
+    never served, and no retry storm (corruption is not transient)."""
+    _arm({"point": "tier.disk.read", "kind": "corrupt", "mode": "once"})
+    one = _payload((0,) * 4).nbytes
+    store = TieredPageStore(host_bytes=one + 1, disk_bytes=1 << 20,
+                            pin=False, io_retry_max=3,
+                            io_retry_backoff_ms=1.0)
+    try:
+        hashes, chunks = _spill_three(store)
+        _drain_writer(store)
+        assert store.get(hashes[0], ROOT_HASH, chunks[0]) is None
+        assert store.stats()["io_errors"]["disk.read"] == 1
+        # the OTHER disk entry (fault fired once) still round-trips
+        assert store.get(hashes[1], ROOT_HASH, chunks[1]) is not None
+    finally:
+        store.close()
+
+
+def test_host_get_fault_degrades_to_miss(fault_env):
+    """tier.host.get error = MISS (admission continues with the pages
+    already secured); corrupt = identity-verify failure, the entry
+    quarantines exactly like a hash collision."""
+    from mcp_context_forge_tpu.observability.faults import (
+        FaultRule, get_fault_plane)
+    store = TieredPageStore(host_bytes=1 << 20, disk_bytes=0, pin=False)
+    try:
+        chunk = tuple(range(4))
+        h = chain_hashes(list(chunk) + [99], 4)[0]
+        store.put(h, _payload(chunk))
+        plane = _arm({"point": "tier.host.get", "kind": "error",
+                      "mode": "once"})
+        assert store.get(h, ROOT_HASH, chunk) is None      # injected MISS
+        assert store.stats()["io_errors"]["host.get"] == 1
+        assert store.get(h, ROOT_HASH, chunk) is not None  # entry intact
+        plane.arm(FaultRule(point="tier.host.get", kind="corrupt",
+                            mode="once"))
+        assert store.get(h, ROOT_HASH, chunk) is None      # quarantined
+        assert not store.probe(h)
+        get_fault_plane().clear()
+    finally:
+        store.close()

@@ -229,6 +229,51 @@ def test_kill_mid_generation_requeues_through_tier_restore():
     asyncio.run(main())
 
 
+def test_spill_on_drain_preserves_prefix_corpus_across_reload():
+    """ISSUE-14 satellite (ROADMAP item 3's remaining half): drain →
+    reload SPILLS the replica's ref==0 resident prefix pages through
+    the TierClient path before the HBM pool is torn down, so the
+    rebuilt replica serves the template by fetch-on-miss — pinned by a
+    byte-identical continuation vs an uninterrupted engine (lossless
+    resident-precision spills) instead of losing the prefix corpus."""
+    template = list(range(3, 36))   # 2 full pages + tail
+
+    async def main():
+        ref = TPUEngine(_config(prefix_tiers=False))
+        await ref.start()
+        try:
+            await _engine_gen(ref, template + [40])
+            ref_out = await _engine_gen(ref, template + [41], n=12)
+        finally:
+            await ref.stop()
+
+        # tier_spill_quant="" = lossless spill container: the restored
+        # pages are bit-identical, so the continuation must be too
+        pool = _pool(replicas=1, tier_spill_quant="")
+        await pool.start()
+        try:
+            r0 = pool.replicas[0].engine
+            await _engine_gen(r0, template + [40])
+            # no allocation pressure: nothing spilled yet — the corpus
+            # is exactly what a naive reload would LOSE
+            spilled0 = pool.tier_store.stats()["spilled"]
+            assert r0.allocator.cached_pages >= 2
+            await pool.reload("0")
+            assert pool.tier_store.stats()["spilled"] > spilled0, \
+                "reload must spill resident prefix pages before teardown"
+            engine = pool.replicas[0].engine
+            assert engine is not r0                  # rebuilt object
+            out = await _engine_gen(engine, template + [41], n=12)
+            assert out == ref_out                    # byte-identical
+            # and the hit really came through the tier restore path
+            assert (engine.allocator.tier_hit_tokens["host"]
+                    + engine.allocator.tier_hit_tokens["disk"]) >= 2 * PS
+        finally:
+            await pool.stop()
+
+    asyncio.run(main())
+
+
 def test_reload_drops_stale_hbm_index_entries():
     """A reloaded (rebuilt) replica's HBM pages are gone: the index must
     forget its entries at rebuild so the router can't chase ghosts; the

@@ -114,6 +114,65 @@ def test_scenarios_cpu_smoke(scenario_env, monkeypatch):
         assert payload["value"] > 0
 
 
+def test_chaos_matrix_fault_scenarios_smoke(scenario_env, monkeypatch):
+    """ISSUE-14 chaos matrix at tiny scale: db-outage (bounded rollup
+    buffer + ledger.rollup breaker ladder + conservation), tier-fault
+    (disk quarantine + tier.disk breaker recovery, zero failures), and
+    overload-shed (batch 429s with Retry-After while premium holds).
+    Chaos's slow-replica arm rides the main smoke above."""
+    monkeypatch.setenv("BENCH_SCENARIO_ONLY",
+                       "db-outage,tier-fault,overload-shed")
+    import bench_gateway_scenarios as bgs
+
+    report = asyncio.run(bgs.run_scenarios("cpu"))
+    assert report["ok"], report["problems"]
+    assert set(report["scenarios"]) == {"db-outage", "tier-fault",
+                                        "overload-shed"}
+
+    outage = report["scenarios"]["db-outage"]
+    assert outage["failures"] == 0            # serving never wavered
+    assert outage["failed_flushes"] >= 1
+    assert outage["windows_dropped"] >= 1     # loss REPORTED, bounded
+    assert max(outage["pending_seen"]) <= 3   # the pending_max bound
+    assert outage["breaker_mid"] == "open"
+    transitions = outage["breaker_transitions"]
+    assert "half_open" in transitions and transitions[-1] == "closed"
+    assert outage["degradation_gauge_open_observed"] is True
+    cons = outage["conservation"]
+    assert cons["checked"] and \
+        cons["ledger_prompt"] == cons["engine_prompt"] and \
+        cons["ledger_generated"] == cons["engine_generated"]
+    assert outage["recovery_rows_written"] >= 1
+
+    tier = report["scenarios"]["tier-fault"]
+    assert tier["failures"] == 0
+    assert tier["spilled"] >= 1
+    assert tier["io_errors_mid"]["disk.write"] >= 1
+    assert tier["quarantined_mid"] >= 1
+    assert tier["breaker_mid"] == "open"
+    assert tier["breaker_final"] == "closed"
+    assert tier["disk_pages_post_recovery"] >= 1
+    assert sum(tier["tier_hit_tokens"].values()) >= 1
+
+    shed = report["scenarios"]["overload-shed"]
+    assert shed["shed_429s"] >= 1             # batch actually shed
+    assert shed["failures"] == 0              # ... cleanly (header present)
+    assert shed["premium_failures"] == []     # premium held
+    assert shed["slo"]["slo_class"] == "premium" and shed["slo_ok"]
+    assert "open" in shed["overload_transitions"]
+    assert shed["overload_transitions"][-1] == "closed"
+
+    names = sorted(report["captures_written"])
+    assert names == ["BENCH_SCENARIO_DB_OUTAGE_r01.json",
+                     "BENCH_SCENARIO_OVERLOAD_SHED_r01.json",
+                     "BENCH_SCENARIO_TIER_FAULT_r01.json"]
+    for file_name in names:
+        with open(scenario_env / file_name) as fh:
+            payload = json.load(fh)
+        assert payload["metric"] == "gateway_scenario_slo"
+        assert payload["value"] > 0
+
+
 def test_zero_scenario_run_is_not_a_pass(scenario_env, monkeypatch):
     """PR-6's no-vacuous-pass rule: a run that produced no captures must
     not report ok (main() exits 2 on an empty scenario set)."""

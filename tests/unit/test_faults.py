@@ -1,0 +1,357 @@
+"""Fault plane + degradation ladder units (ISSUE 14).
+
+The contracts, in falsifiable form:
+
+- default OFF is a true no-op: arming refuses, the rule table stays
+  empty, and a fault-point check touches NOTHING but one dict miss
+  (pinned with a lock that explodes on acquire);
+- schedules are deterministic: once / 1-in-N (seeded) / window /
+  always, with scope substring filtering;
+- every fire counts in mcpforge_faults_injected_total{point,kind};
+- CircuitBreaker walks closed → open → half_open → closed (and back to
+  open on probe failure), exports mcpforge_degradation_state, and the
+  manager keeps the transition history the chaos matrix gates on;
+- OverloadShedder sheds the LOWEST SLO class first, never an unlisted
+  class, and enforces the tenant quota window independently.
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from mcp_context_forge_tpu.observability.degradation import (
+    CircuitBreaker, OverloadShedder, configure_degradation,
+    get_degradation)
+from mcp_context_forge_tpu.observability.faults import (
+    FAULT_POINTS, FaultAction, FaultError, FaultPlane, FaultRule,
+    configure_fault_plane, fault_point, get_fault_plane)
+from mcp_context_forge_tpu.observability.metrics import PrometheusRegistry
+
+
+@pytest.fixture(autouse=True)
+def _reset_plane():
+    """Hermetic singletons: every test starts disabled and empty (the
+    lock is restored first — the zero-overhead pin swaps in a lock that
+    refuses to be acquired)."""
+    yield
+    get_fault_plane()._lock = threading.Lock()
+    configure_fault_plane(False)
+    configure_degradation()
+
+
+# ------------------------------------------------------------- default off
+
+def test_disabled_plane_refuses_arming_and_is_a_noop():
+    plane = configure_fault_plane(False)
+    with pytest.raises(RuntimeError):
+        plane.arm(FaultRule(point="db.execute"))
+    assert plane.snapshot()["rules"] == []
+    for point in FAULT_POINTS:
+        assert fault_point(point) is None
+
+
+class _ExplodingLock:
+    def __enter__(self):
+        raise AssertionError("unarmed fault point must not lock")
+
+    def __exit__(self, *args):
+        return False
+
+
+def test_unarmed_fault_point_is_one_dict_miss_no_lock():
+    """The zero-overhead pin: with nothing armed, check() must cost a
+    single dict miss — it may not acquire the plane lock (which would
+    serialize every DB statement and engine-dispatch iteration through
+    one mutex just to say 'no faults')."""
+    plane = configure_fault_plane(True)
+    plane._lock = _ExplodingLock()
+    for point in FAULT_POINTS:
+        assert plane.check(point) is None
+    # and with a rule armed on ANOTHER point, unarmed points stay free
+    plane._lock = threading.Lock()
+    plane.arm(FaultRule(point="db.execute"))
+    plane._lock = _ExplodingLock()
+    assert plane.check("tier.disk.read") is None
+
+
+def test_unknown_point_and_bad_rules_are_rejected():
+    plane = configure_fault_plane(True)
+    with pytest.raises(ValueError):
+        plane.arm(FaultRule(point="no.such.point"))
+    with pytest.raises(ValueError):
+        plane.arm(FaultRule(point="db.execute", kind="explode"))
+    with pytest.raises(ValueError):
+        plane.arm(FaultRule(point="db.execute", mode="one_in_n", n=0))
+    with pytest.raises(ValueError):
+        plane.arm(FaultRule(point="db.execute", kind="latency"))
+
+
+# --------------------------------------------------------------- schedules
+
+def test_once_mode_fires_exactly_once():
+    plane = configure_fault_plane(True)
+    plane.arm(FaultRule(point="db.execute", mode="once"))
+    fires = [plane.check("db.execute") is not None for _ in range(5)]
+    assert fires == [True, False, False, False, False]
+
+
+def test_one_in_n_is_deterministic_and_seeded():
+    plane = configure_fault_plane(True)
+    plane.arm(FaultRule(point="db.execute", mode="one_in_n", n=3))
+    assert [plane.check("db.execute") is not None for _ in range(6)] \
+        == [True, False, False, True, False, False]
+    plane.arm(FaultRule(point="db.execute", mode="one_in_n", n=3, seed=1))
+    assert [plane.check("db.execute") is not None for _ in range(6)] \
+        == [False, False, True, False, False, True]
+
+
+def test_window_mode_expires():
+    plane = configure_fault_plane(True)
+    plane.arm(FaultRule(point="db.execute", mode="window", window_s=0.05))
+    assert plane.check("db.execute") is not None
+    time.sleep(0.08)
+    assert plane.check("db.execute") is None
+    # calls kept counting (the schedule is observable after expiry)
+    assert plane.snapshot()["rules"][0]["calls"] == 2
+    assert plane.snapshot()["rules"][0]["fired"] == 1
+
+
+def test_scope_substring_filters():
+    plane = configure_fault_plane(True)
+    plane.arm(FaultRule(point="db.execute", scope="tenant_usage"))
+    assert plane.check("db.execute",
+                       scope="INSERT INTO tenant_usage ...") is not None
+    assert plane.check("db.execute", scope="SELECT * FROM users") is None
+    assert plane.check("db.execute") is None  # no scope offered
+
+
+# ----------------------------------------------------------------- actions
+
+def test_error_action_raises_fault_error_as_connection_error():
+    act = FaultAction("db.execute", "error")
+    with pytest.raises(FaultError):
+        act.apply()
+    with pytest.raises(ConnectionError):   # ⊂ OSError: disk handlers
+        act.apply()
+    with pytest.raises(OSError):
+        act.apply()
+
+    async def main():
+        with pytest.raises(FaultError):
+            await act.async_apply()
+    asyncio.run(main())
+
+
+def test_latency_action_sleeps_roughly_the_asked_time():
+    act = FaultAction("engine.dispatch", "latency", latency_s=0.03)
+    started = time.monotonic()
+    act.apply()
+    assert time.monotonic() - started >= 0.025
+
+
+def test_corrupt_bytes_is_deterministic_and_length_preserving():
+    data = bytes(range(256)) * 8
+    mangled = FaultAction.corrupt_bytes(data)
+    assert len(mangled) == len(data)
+    assert mangled != data
+    assert mangled == FaultAction.corrupt_bytes(data)
+    assert mangled[0] == data[0] ^ 0xFF
+
+
+def test_fired_faults_count_in_metrics():
+    registry = PrometheusRegistry()
+    plane = configure_fault_plane(True, metrics=registry)
+    plane.arm(FaultRule(point="tier.disk.write", kind="error"))
+    plane.check("tier.disk.write")
+    plane.check("tier.disk.write")
+    rendered = registry.render()[0].decode()
+    assert ('mcpforge_faults_injected_total{kind="error",'
+            'point="tier.disk.write"} 2.0') in rendered
+
+
+def test_configure_from_env_rules_json():
+    plane = configure_fault_plane(True, rules_json=(
+        '[{"point": "engine.dispatch", "kind": "latency",'
+        ' "latency_ms": 5, "scope": "0"}]'))
+    assert plane.check("engine.dispatch", scope="1") is None
+    act = plane.check("engine.dispatch", scope="0")
+    assert act is not None and act.kind == "latency"
+    with pytest.raises(ValueError):
+        configure_fault_plane(True, rules_json="{not json")
+    # disabled: env rules are ignored entirely (no half-armed state)
+    plane = configure_fault_plane(False, rules_json=(
+        '[{"point": "engine.dispatch", "kind": "error"}]'))
+    assert plane.snapshot()["rules"] == []
+
+
+def test_disarm_and_clear_are_idempotent():
+    plane = configure_fault_plane(True)
+    plane.arm(FaultRule(point="pool.requeue"))
+    assert plane.disarm("pool.requeue") is True
+    assert plane.disarm("pool.requeue") is False
+    plane.arm(FaultRule(point="pool.requeue"))
+    plane.clear()
+    assert plane.snapshot()["rules"] == []
+    assert get_fault_plane() is plane
+
+
+# ------------------------------------------------------------------ breaker
+
+def test_breaker_full_ladder_closed_open_half_open_closed():
+    registry = PrometheusRegistry()
+    manager = configure_degradation(metrics=registry,
+                                    failure_threshold=2, cooldown_s=0.05)
+    breaker = manager.breaker("tier.disk")
+    assert breaker.allow() is True
+    breaker.record_failure()
+    assert breaker.state == "closed"          # below threshold
+    breaker.record_failure()
+    assert breaker.state == "open"
+    assert breaker.allow() is False           # cooldown pending
+    rendered = registry.render()[0].decode()
+    assert 'mcpforge_degradation_state{component="tier.disk"} 2.0' \
+        in rendered
+    time.sleep(0.06)
+    assert breaker.allow() is True            # the half-open probe
+    assert breaker.state == "half_open"
+    assert breaker.allow() is False           # only ONE probe at a time
+    breaker.record_success()
+    assert breaker.state == "closed"
+    transitions = [t["to"] for t in manager.transitions("tier.disk")]
+    assert transitions == ["open", "half_open", "closed"]
+    rendered = registry.render()[0].decode()
+    assert 'mcpforge_degradation_state{component="tier.disk"} 0.0' \
+        in rendered
+
+
+def test_breaker_probe_failure_reopens():
+    manager = configure_degradation(failure_threshold=1, cooldown_s=0.02)
+    breaker = manager.breaker("federation", key="peer-1")
+    breaker.record_failure()
+    assert breaker.state == "open"
+    time.sleep(0.03)
+    assert breaker.allow() is True
+    breaker.record_failure()                  # probe failed
+    assert breaker.state == "open"
+    # a success whenever it lands closes (consecutive reset)
+    breaker.record_success()
+    assert breaker.state == "closed"
+
+
+def test_success_resets_consecutive_failures():
+    breaker = CircuitBreaker("x", failure_threshold=3)
+    breaker.record_failure()
+    breaker.record_failure()
+    breaker.record_success()
+    breaker.record_failure()
+    breaker.record_failure()
+    assert breaker.state == "closed"          # never 3 consecutive
+
+
+def test_manager_aggregates_worst_member_per_component():
+    manager = configure_degradation(failure_threshold=1, cooldown_s=60)
+    ok_peer = manager.breaker("federation", key="peer-ok")
+    bad_peer = manager.breaker("federation", key="peer-bad")
+    ok_peer.record_success()
+    bad_peer.record_failure()
+    assert manager.component_state("federation") == "open"
+    status = manager.status()
+    assert status["components"]["federation"] == "open"
+    assert {b["key"] for b in status["breakers"]
+            if b["component"] == "federation"} == {"peer-ok", "peer-bad"}
+
+
+def test_manual_state_for_shedder():
+    manager = configure_degradation()
+    manager.set_state("llm.overload", "open")
+    assert manager.component_state("llm.overload") == "open"
+    manager.set_state("llm.overload", "closed")
+    assert [t["component"] for t in manager.transitions("llm.overload")] \
+        == ["llm.overload"] * 2
+    with pytest.raises(ValueError):
+        manager.set_state("llm.overload", "exploded")
+
+
+def test_manual_open_state_expires_after_ttl():
+    """The shedder only runs on admission: an overload burst followed
+    by total idle must not read 'open' forever — past the TTL the state
+    lazily reads closed, with the expiry recorded as a transition."""
+    registry = PrometheusRegistry()
+    manager = configure_degradation(metrics=registry)
+    manager.set_state("llm.overload", "open", ttl_s=0.03)
+    assert manager.component_state("llm.overload") == "open"
+    time.sleep(0.04)
+    assert manager.component_state("llm.overload") == "closed"
+    transitions = manager.transitions("llm.overload")
+    assert transitions[-1]["to"] == "closed" and transitions[-1]["expired"]
+    rendered = registry.render()[0].decode()
+    assert ('mcpforge_degradation_state{component="llm.overload"} 0.0'
+            in rendered)
+    # no TTL = sticky until the next decide (explicit closes still work)
+    manager.set_state("llm.overload", "open")
+    time.sleep(0.04)
+    assert manager.component_state("llm.overload") == "open"
+
+
+# ------------------------------------------------------------------ shedder
+
+class _QuotaLedger:
+    def __init__(self, ratios):
+        self.ratios = ratios
+
+    def quota_ratio(self, tenant):
+        return self.ratios.get(tenant, 0.0)
+
+
+def _shedder(**kw):
+    kw.setdefault("shed_at", 0.5)
+    kw.setdefault("class_order", ["batch", "default"])
+    kw.setdefault("tenant_classes", {"user:b@x": "batch",
+                                     "user:p@x": "premium"})
+    return OverloadShedder(**kw)
+
+
+def test_shed_lowest_class_first_unlisted_never_sheds():
+    shedder = _shedder()
+    # below the bar: nobody sheds
+    assert shedder.decide(0.4, "user:b@x") is None
+    # at the bar: the HEAD of the order (batch) sheds...
+    verdict = shedder.decide(0.55, "user:b@x")
+    assert verdict is not None and verdict["reason"] == "overload"
+    assert verdict["status"] == 429 and verdict["retry_after_s"] >= 1
+    assert verdict["slo_class"] == "batch"
+    # ...default holds until its own (higher) bar...
+    assert shedder.decide(0.55, "user:unmapped@x") is None
+    assert shedder.decide(0.80, "user:unmapped@x") is not None
+    # ...and premium — NOT in the order — never sheds on saturation
+    assert shedder.decide(1.0, "user:p@x") is None
+
+
+def test_quota_exhaustion_sheds_regardless_of_saturation():
+    shedder = _shedder(ledger=_QuotaLedger({"user:p@x": 1.2}))
+    verdict = shedder.decide(0.0, "user:p@x")
+    assert verdict is not None and verdict["reason"] == "quota"
+    assert verdict["quota_used_ratio"] == 1.2
+    assert shedder.decide(0.0, "user:b@x") is None  # under quota
+
+
+def test_shedder_reports_state_and_counts():
+    registry = PrometheusRegistry()
+    manager = configure_degradation(metrics=registry)
+    shedder = _shedder(degradation=manager, metrics=registry)
+    shedder.decide(0.9, "user:b@x")
+    assert manager.component_state("llm.overload") == "open"
+    assert shedder.shed_total == 1
+    rendered = registry.render()[0].decode()
+    assert ('mcpforge_gw_requests_shed_total{reason="overload",'
+            'slo_class="batch"} 1.0') in rendered
+    shedder.decide(0.1, "user:b@x")
+    assert manager.component_state("llm.overload") == "closed"
+
+
+def test_disabled_shedder_admits_everything():
+    shedder = _shedder(enabled=False,
+                       ledger=_QuotaLedger({"user:b@x": 9.0}))
+    assert shedder.decide(1.0, "user:b@x") is None

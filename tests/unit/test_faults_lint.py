@@ -1,0 +1,97 @@
+"""Fault-point non-vacuity gate (ISSUE 14, mirroring the dead-metric
+rule): the registry in ``observability/faults.py`` and the seams must
+agree exactly, and every point must be exercised by at least one test.
+
+Three failure modes this catches:
+
+- a point registered in FAULT_POINTS with no ``fault_point("...")``
+  seam in product code — a chaos scenario could arm it and prove
+  nothing (the rule fires into the void);
+- a seam calling ``fault_point`` with a literal NOT in FAULT_POINTS —
+  arm() would reject the name, so the seam is dead;
+- a point no test ever arms/names — its degradation behavior is
+  unproven (the vacuity the dead-metric rule exists to prevent).
+"""
+
+import ast
+import os
+
+from mcp_context_forge_tpu.observability.faults import FAULT_POINTS
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+PACKAGE = os.path.join(REPO_ROOT, "mcp_context_forge_tpu")
+TESTS = os.path.join(REPO_ROOT, "tests")
+
+
+def _python_files(root):
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def _seam_literals():
+    """Every literal first argument passed to ``fault_point(...)`` in
+    the package (AST, not grep: comments and docstrings don't count)."""
+    seams: dict[str, list[str]] = {}
+    for path in _python_files(PACKAGE):
+        with open(path, encoding="utf-8") as fh:
+            source = fh.read()
+        if "fault_point" not in source:
+            continue
+        tree = ast.parse(source, filename=path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = func.id if isinstance(func, ast.Name) else (
+                func.attr if isinstance(func, ast.Attribute) else None)
+            if name != "fault_point" or not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                seams.setdefault(arg.value, []).append(
+                    os.path.relpath(path, REPO_ROOT))
+    return seams
+
+
+def test_every_registered_point_has_a_product_seam():
+    seams = _seam_literals()
+    missing = [p for p in FAULT_POINTS if p not in seams]
+    assert not missing, (
+        f"FAULT_POINTS registered with no fault_point() seam in product "
+        f"code: {missing} — a rule armed there fires into the void")
+
+
+def test_every_seam_literal_is_a_registered_point():
+    seams = _seam_literals()
+    unknown = sorted(set(seams) - set(FAULT_POINTS))
+    assert not unknown, (
+        f"fault_point() called with unregistered literals {unknown} — "
+        f"arm() rejects these names, so the seams are dead; add them to "
+        f"FAULT_POINTS (and docs/resilience.md)")
+
+
+def test_every_point_is_exercised_by_at_least_one_test():
+    """Non-vacuity: each point's name must appear in some test source
+    (this file excepted — listing them here would be vacuous by
+    definition)."""
+    this_file = os.path.abspath(__file__)
+    blob_parts = []
+    for path in _python_files(TESTS):
+        if os.path.abspath(path) == this_file:
+            continue
+        with open(path, encoding="utf-8") as fh:
+            blob_parts.append(fh.read())
+    blob = "\n".join(blob_parts)
+    unexercised = [p for p in FAULT_POINTS if p not in blob]
+    assert not unexercised, (
+        f"fault points never exercised by any test: {unexercised} — "
+        f"their degradation behavior is unproven (arm them in a unit "
+        f"test or chaos scenario)")
+
+
+def test_registry_is_sorted_and_unique():
+    """Keep the catalogue reviewable: sorted, no duplicates."""
+    assert list(FAULT_POINTS) == sorted(set(FAULT_POINTS))

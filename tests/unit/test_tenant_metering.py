@@ -5,6 +5,7 @@ ratios, and the DB rollup round-trip."""
 
 import asyncio
 import threading
+import time
 
 import pytest
 
@@ -192,8 +193,10 @@ class _FakeDb:
     def __init__(self, fail=False):
         self.rows = []
         self.fail = fail
+        self.attempts = 0
 
     async def executemany(self, sql, seq):
+        self.attempts += 1
         if self.fail:
             raise RuntimeError("db down")
         self.rows.extend(seq)
@@ -225,25 +228,99 @@ def test_rollup_flush_writes_rows_and_preserves_conservation():
     assert asyncio.run(rollup.flush()) == 0  # drained window writes nothing
 
 
-def test_rollup_failure_remerges_window_instead_of_dropping():
-    registry = PrometheusRegistry()
-    ledger = TenantLedger(metrics=registry, quota_tokens_per_window=100)
+def test_rollup_failure_parks_window_and_retries_with_original_stamps():
+    """A failed flush parks the window in the bounded pending buffer
+    (docs/resilience.md) and the retry writes it with its ORIGINAL
+    window_start — stamping usage with the post-failure clock would
+    misattribute it in time (quota audits are window-bounded)."""
+    from mcp_context_forge_tpu.observability.degradation import \
+        configure_degradation
+    configure_degradation(failure_threshold=3, cooldown_s=0.0)
+    ledger = TenantLedger(quota_tokens_per_window=100)
     ledger.add("team:a", prompt_tokens=10)
     original_start = ledger._window_started
     db = _FakeDb(fail=True)
     rollup = TenantUsageRollup(db, ledger, interval_s=60)
     with pytest.raises(RuntimeError):
         asyncio.run(rollup.flush())
-    # the quota gauge is RESTORED after the failed drain (take_window
-    # zeroed it; the tokens are still unbilled in the merged-back window)
-    rendered = registry.render()[0].decode()
-    assert ('mcpforge_gw_tenant_quota_used_ratio{tenant="team:a"} 0.1'
-            in rendered)
+    assert rollup.outage_stats()["pending_windows"] == 1
+    assert rollup.consecutive_failures == 1
+    # cumulative accounting is untouched by the outage (conservation)
+    assert ledger.column_sums()["prompt_tokens"] == 10
     db.fail = False
     assert asyncio.run(rollup.flush()) == 1  # usage survived the outage
     assert db.rows[0][4] == 10
-    # the retried row carries the ORIGINAL window_start — take_window
-    # advanced it during the failed drain, and stamping the usage with
-    # the post-failure window would misattribute it in time (quota
-    # audits / billing reconciliation are window-bounded)
     assert db.rows[0][1] == original_start
+    assert rollup.outage_stats()["pending_windows"] == 0
+    assert rollup.consecutive_failures == 0
+
+
+def test_rollup_sustained_outage_stays_bounded_and_recovers():
+    """Satellite gate (ISSUE 14): N consecutive failed flushes keep the
+    pending buffer bounded at pending_max (drop-oldest, loss COUNTED),
+    open the ledger.rollup breaker, and recovery re-merges the surviving
+    windows with their original stamps while cumulative totals conserve
+    throughout."""
+    from mcp_context_forge_tpu.observability.degradation import \
+        configure_degradation, get_degradation
+    configure_degradation(failure_threshold=3, cooldown_s=0.01)
+    ledger = TenantLedger()
+    db = _FakeDb(fail=True)
+    rollup = TenantUsageRollup(db, ledger, interval_s=60, pending_max=3)
+    starts = []
+    for i in range(6):
+        ledger.add("team:a", prompt_tokens=10 + i)
+        starts.append(ledger._window_started)
+        try:
+            asyncio.run(rollup.flush())
+        except RuntimeError:
+            pass
+    stats = rollup.outage_stats()
+    # bounded: 6 failed windows, only pending_max retained
+    assert stats["pending_windows"] == 3
+    # loss is REPORTED, not hidden: 3 oldest dropped, tokens counted
+    assert stats["windows_dropped"] == 3
+    assert stats["tokens_dropped"] == 10 + 11 + 12
+    # breaker opened after the threshold (open attempts were skipped —
+    # consecutive_failures counts real DB attempts, not skipped ones)
+    assert stats["breaker"]["state"] in ("open", "half_open")
+    assert get_degradation().component_state("ledger.rollup") != "closed"
+    # cumulative accounting conserved through the whole outage
+    assert ledger.column_sums()["prompt_tokens"] == sum(
+        10 + i for i in range(6))
+    # recovery: cooldown elapses, the half-open probe flush succeeds,
+    # every surviving window lands with its ORIGINAL start stamp
+    time.sleep(0.02)
+    db.fail = False
+    written = asyncio.run(rollup.flush())
+    assert written == 3
+    assert rollup.outage_stats()["pending_windows"] == 0
+    assert rollup.outage_stats()["breaker"]["state"] == "closed"
+    written_starts = sorted(r[1] for r in db.rows)
+    assert written_starts == sorted(starts[3:])
+    transitions = [t["to"] for t in
+                   get_degradation().transitions("ledger.rollup")]
+    assert "open" in transitions and transitions[-1] == "closed"
+
+
+def test_rollup_breaker_open_skips_db_attempts_until_cooldown():
+    """While the breaker is open (cooldown pending) flush() parks the
+    window WITHOUT hitting the DB — no retry storm against a dead
+    backend; force=True (the shutdown path) still attempts."""
+    from mcp_context_forge_tpu.observability.degradation import \
+        configure_degradation
+    configure_degradation(failure_threshold=1, cooldown_s=60.0)
+    ledger = TenantLedger()
+    db = _FakeDb(fail=True)
+    rollup = TenantUsageRollup(db, ledger, interval_s=60, pending_max=8)
+    ledger.add("team:a", prompt_tokens=1)
+    with pytest.raises(RuntimeError):
+        asyncio.run(rollup.flush())  # opens the breaker (threshold 1)
+    attempts_after_open = db.attempts
+    ledger.add("team:a", prompt_tokens=2)
+    assert asyncio.run(rollup.flush()) == 0   # parked, no DB attempt
+    assert db.attempts == attempts_after_open
+    assert rollup.outage_stats()["pending_windows"] == 2
+    db.fail = False
+    assert asyncio.run(rollup.flush(force=True)) == 2  # shutdown path
+    assert rollup.outage_stats()["pending_windows"] == 0
