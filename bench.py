@@ -99,11 +99,18 @@ async def _serve_tcp(app) -> _SocketClient:
 
     runner = web.AppRunner(app)
     await runner.setup()
-    site = web.TCPSite(runner, "127.0.0.1", 0)
+    # deep accept backlog: the open-loop burst arm offers thousands of
+    # connections inside one RTT, and the 128 default resets the excess
+    site = web.TCPSite(runner, "127.0.0.1", 0,
+                       backlog=int(os.environ.get("BENCH_LISTEN_BACKLOG",
+                                                  "4096")))
     await site.start()
     host, port = runner.addresses[0][:2]
     session = aiohttp.ClientSession(
-        connector=aiohttp.TCPConnector(limit=512))
+        connector=aiohttp.TCPConnector(
+            # the 10k-concurrent open-loop arm needs more sockets than
+            # the default cap (fd rlimit permitting)
+            limit=int(os.environ.get("BENCH_CLIENT_CONN_LIMIT", "512"))))
     return _SocketClient(app, runner, session, host, port)
 
 

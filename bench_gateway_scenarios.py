@@ -74,7 +74,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)) or ".")
 # builds rebind the process-global fault plane + degradation manager to
 # THEIR app (see _rebind_resilience_plane).
 SCENARIOS = ("burst", "ramp", "mixed", "tenant", "db-outage",
-             "tier-fault", "overload-shed", "chaos")
+             "tier-fault", "overload-shed", "chaos", "workers")
 
 
 def _smoke() -> bool:
@@ -97,7 +97,11 @@ def _scale() -> dict:
                 "tier_templates": 8, "tier_requests": 16,
                 "tier_concurrency": 3,
                 "shed_requests": 16, "shed_concurrency": 6,
-                "shed_latency_ms": 30.0}
+                "shed_latency_ms": 30.0,
+                "burst_open_rate": 60.0, "burst_open_requests": 30,
+                "burst_open_inflight": 64,
+                "workers_rate": 40.0, "workers_requests": 24,
+                "workers_inflight": 64}
     return {"burst_phases": [("baseline", 4, 60), ("burst", 64, 400),
                              ("cooldown", 4, 60)],
             "ramp_steps": [4, 8, 16, 32, 16, 8, 4], "ramp_requests": 50,
@@ -111,7 +115,21 @@ def _scale() -> dict:
             "tier_templates": 14, "tier_requests": 56,
             "tier_concurrency": 6,
             "shed_requests": 48, "shed_concurrency": 10,
-            "shed_latency_ms": 40.0}
+            "shed_latency_ms": 40.0,
+            # open-loop burst arm (coordinated-omission-free): offered
+            # rate is deliberately tunable ABOVE capacity so in-flight
+            # climbs toward the 10k-connection bound during the arm
+            "burst_open_rate": float(os.environ.get("BENCH_OPEN_RATE",
+                                                    "1500")),
+            "burst_open_requests": int(os.environ.get("BENCH_OPEN_REQUESTS",
+                                                      "6000")),
+            "burst_open_inflight": int(os.environ.get("BENCH_OPEN_INFLIGHT",
+                                                      "10000")),
+            "workers_rate": float(os.environ.get("BENCH_WORKERS_RATE",
+                                                 "400")),
+            "workers_requests": int(os.environ.get("BENCH_WORKERS_REQUESTS",
+                                                   "2000")),
+            "workers_inflight": 10000}
 
 
 async def _make_gateway(platform: str, replicas: int = 2,
@@ -260,8 +278,15 @@ async def _register_echo_tool(client, auth, name: str):
 
 async def scenario_burst(app, client, auth, model, scale) -> dict:
     """Spike concurrency 16x over baseline; the SLO window brackets the
-    whole curve so queueing during the spike lands in the verdicts."""
+    whole curve so queueing during the spike lands in the verdicts.
+    Then the OPEN-LOOP arm (tools/loadgen.run_phase_open): paced
+    arrivals at a fixed offered rate with latency measured from each
+    request's SCHEDULED time — the closed loop under-reports latency at
+    saturation (coordinated omission), and this arm is where the
+    10k-concurrent posture is driven (BENCH_OPEN_RATE / _REQUESTS /
+    _INFLIGHT)."""
     from mcp_context_forge_tpu.tools.loadgen import (SloWindow, chat_kind,
+                                                     run_phase_open,
                                                      run_phases,
                                                      tools_call_kind)
     window = SloWindow(client, "scenario-burst", auth)
@@ -269,11 +294,27 @@ async def scenario_burst(app, client, auth, model, scale) -> dict:
     kinds = [tools_call_kind("scenario-echo"),
              chat_kind(model, max_tokens=scale["max_tokens"])]
     result = await run_phases(client, auth, kinds, scale["burst_phases"])
+    open_phase = await run_phase_open(
+        client, auth, [tools_call_kind("scenario-echo")],
+        name="burst-open", rate_rps=scale["burst_open_rate"],
+        requests=scale["burst_open_requests"],
+        max_in_flight=scale["burst_open_inflight"])
     result["slo"] = await window.close()
     burst_phase = next(p for p in result["phases"] if p["name"] == "burst")
+    open_summary = open_phase.summary()
     return {"scenario": "burst", "value": burst_phase["rps"],
             "p50_ms": burst_phase.get("p50_ms"),
-            "p95_ms": burst_phase.get("p95_ms"), **_strip(result)}
+            "p95_ms": burst_phase.get("p95_ms"),
+            # not trend-gated alongside value/p95_ms: open-loop latency
+            # is measured from SCHEDULED arrival and is incomparable
+            # with the closed-loop history by construction
+            "open_loop": {"offered_rps": scale["burst_open_rate"],
+                          "max_in_flight": scale["burst_open_inflight"],
+                          "peak_in_flight": open_phase.concurrency,
+                          **open_summary},
+            **{k: v for k, v in _strip(result).items()},
+            "failures": result["failures"] + open_phase.failures,
+            "requests": result["requests"] + open_phase.requests}
 
 
 async def scenario_ramp(app, client, auth, model, scale) -> dict:
@@ -1047,6 +1088,280 @@ async def scenario_chaos(app, client, auth, model, scale) -> dict:
     }  # request failures are gated generically by the driver
 
 
+async def scenario_workers(platform, scale) -> dict:
+    """Multi-worker scale-out arm (docs/scaleout.md): N gateway workers
+    over ONE coordination hub with the SHARED engine plane (one worker
+    owns the pool, the rest serve LLM traffic over the bus RPC seam) and
+    a shared DB. Four verdicts:
+
+    (a) throughput: the same open-loop offered load against one worker
+        vs client-side-LB'd across all N (``scaleup`` = fleet/single;
+        on a single-core host the GIL bounds this near 1.0 for
+        in-process workers — the capture records ``in_process`` so the
+        number is read honestly);
+    (b) fleet SLO truth: the scenario window is evaluated at
+        ``/admin/slo?scope=fleet`` on worker 0 — TTFT samples live in
+        the pool OWNER's registry and must still be measured;
+    (c) cross-worker SSE handoff: a session owned by worker 0 is
+        streamed through worker 1 with byte-identical frames;
+    (d) worker-death chaos: worker 0 (pool owner AND stream owner) dies
+        mid-stream — the relayed stream terminates CLEANLY within the
+        liveness bound with the loss COUNTED
+        (mcpforge_gw_session_handoffs_total{stream_lost}), and a
+        survivor re-elects pool ownership and serves chat again.
+    """
+    import tempfile
+
+    from aiohttp import BasicAuth
+
+    from bench import _serve_tcp
+    from mcp_context_forge_tpu.config import load_settings
+    from mcp_context_forge_tpu.gateway.app import build_app
+    from mcp_context_forge_tpu.tools.loadgen import (
+        SloWindow, chat_kind, probe_slowest_trace, run_phase_open)
+
+    workers_n = max(2, int(os.environ.get("BENCH_GW_WORKERS", "2")))
+    model = os.environ.get("BENCH_SCENARIO_MODEL", "llama3-test" if _smoke()
+                           else ("llama3-1b" if platform == "tpu"
+                                 else "llama3-tiny"))
+    tmp = tempfile.mkdtemp(prefix="mcpforge-workers-")
+    base_env = {
+        "MCPFORGE_DATABASE_URL": f"sqlite:///{tmp}/workers.db",
+        "MCPFORGE_DB_SQLITE_BUSY_TIMEOUT_MS": "5000",
+        "MCPFORGE_PLUGINS_ENABLED": "false",
+        "MCPFORGE_TPU_LOCAL_ENABLED": "true",
+        "MCPFORGE_TPU_LOCAL_POOL_SHARED": "true",
+        "MCPFORGE_TPU_LOCAL_REPLICAS": "1",
+        "MCPFORGE_TPU_LOCAL_MODEL": model,
+        "MCPFORGE_TPU_LOCAL_WARMUP": "false",
+        "MCPFORGE_TPU_LOCAL_MAX_BATCH": "8" if _smoke() else "16",
+        "MCPFORGE_TPU_LOCAL_MAX_SEQ_LEN": "128" if _smoke() else "512",
+        "MCPFORGE_TPU_LOCAL_PAGE_SIZE": "16",
+        "MCPFORGE_TPU_LOCAL_NUM_PAGES": "128" if _smoke() else "512",
+        "MCPFORGE_TPU_LOCAL_PREFILL_BUCKETS": "16,64" if _smoke() else "64",
+        "MCPFORGE_TPU_LOCAL_DTYPE": ("bfloat16" if platform == "tpu"
+                                     else "float32"),
+        "MCPFORGE_STREAMABLE_HTTP_STATEFUL": "true",
+        "MCPFORGE_SSE_KEEPALIVE_INTERVAL": "0.5",
+        "MCPFORGE_GW_STREAM_IDLE_TIMEOUT_S": "1.0",
+        "MCPFORGE_LEADER_LEASE_TTL": "2.0",
+        "MCPFORGE_GW_FLEET_METRICS": "true",
+        "MCPFORGE_GW_FLEET_METRICS_INTERVAL_S": "0.5",
+        "MCPFORGE_GW_WORKERS": str(workers_n),
+        "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
+        "MCPFORGE_OTEL_EXPORTER": "none",
+        "MCPFORGE_LOG_LEVEL": "WARNING",
+        "MCPFORGE_SLO_TTFT_P95_MS": "60000" if platform != "tpu" else "2500",
+        "MCPFORGE_SLO_TPOT_P95_MS": "60000" if platform != "tpu" else "250",
+    }
+    apps, clients = [], []
+    # the hub lives OUTSIDE the workers (the supervisor topology):
+    # killing the pool-owning worker must not take the coordination
+    # plane down with it — that is what makes re-election possible
+    from mcp_context_forge_tpu.coordination.hub import CoordinationHub
+    hub = CoordinationHub("127.0.0.1", 0)
+    await hub.start()
+
+    async def _worker(idx: int):
+        env = dict(base_env)
+        env["MCPFORGE_WORKER_INDEX"] = str(idx)
+        env["MCPFORGE_BUS_BACKEND"] = "tcp"
+        env["MCPFORGE_BUS_TCP_PORT"] = str(hub.bound_port)
+        app = await build_app(load_settings(env=env, env_file=None))
+        client = await _serve_tcp(app)
+        apps.append(app)
+        clients.append(client)
+
+    auth = BasicAuth("admin", "changeme")
+    upstream = None
+    # ONE try from here: a build/registration failure must still close
+    # every already-started worker, the upstream, and the hub (finally)
+    try:
+        for idx in range(workers_n):
+            await _worker(idx)
+        upstream = await _register_echo_tool(clients[0], auth,
+                                             "workers-echo")
+        chat = chat_kind(model, max_tokens=scale["max_tokens"])
+
+        # tools-call over /rpc: the worker fleet runs STATEFUL /mcp for
+        # the session-handoff arm, and a stateless tools-call there
+        # would 400 on the missing session id
+        async def tools(client, a, i):
+            resp = await client.post("/rpc", auth=a, json={
+                "jsonrpc": "2.0", "id": i, "method": "tools/call",
+                "params": {"name": "workers-echo",
+                           "arguments": {"n": i, "text": f"payload {i}"}}})
+            body = await resp.json()
+            ok = (resp.status == 200 and "result" in body
+                  and not body["result"].get("isError"))
+            return ok, "" if ok else f"http_{resp.status}"
+
+        # prime until the elected owner's pool is built and serving —
+        # remote workers ride the RPC seam (503 + Retry-After until the
+        # election settles)
+        deadline = time.monotonic() + 300
+        primed = False
+        while time.monotonic() < deadline and not primed:
+            oks = []
+            for client in clients:
+                ok, _tag = await chat(client, auth, 0)
+                oks.append(ok)
+            primed = all(oks)
+            if not primed:
+                await asyncio.sleep(0.5)
+        owner_stats = [a["engine_plane"].stats() for a in apps]
+
+        window = SloWindow(clients[0], "scenario-workers", auth,
+                           scope="fleet")
+        await window.open()
+        kinds = [tools, tools, tools, chat]  # data-plane heavy mix
+
+        def lb(kind, pool):
+            async def one(_client, a, i):
+                return await kind(pool[i % len(pool)], a, i)
+            return one
+
+        single = await run_phase_open(
+            clients[0], auth, [lb(k, clients[:1]) for k in kinds],
+            name="single-worker", rate_rps=scale["workers_rate"],
+            requests=scale["workers_requests"],
+            max_in_flight=scale["workers_inflight"])
+        fleet = await run_phase_open(
+            clients[0], auth, [lb(k, clients) for k in kinds],
+            name=f"fleet-{workers_n}", rate_rps=scale["workers_rate"],
+            requests=scale["workers_requests"],
+            max_in_flight=scale["workers_inflight"])
+        slo = await window.close()
+
+        # --- cross-worker SSE handoff: byte-identical frames ---
+        from mcp_context_forge_tpu.gateway.transports.streamable_http import \
+            _sse_frame
+        resp = await clients[0].post("/mcp", auth=auth, json={
+            "jsonrpc": "2.0", "id": 1, "method": "initialize",
+            "params": {"protocolVersion": "2025-06-18",
+                       "capabilities": {}, "clientInfo": {"name": "bench"}}})
+        sid = resp.headers.get("mcp-session-id")
+        await resp.read()
+        transport0 = apps[0]["streamable_transport"]
+        events = [{"jsonrpc": "2.0", "method": "notifications/ping",
+                   "params": {"n": i}} for i in range(3)]
+        for event in events:
+            await transport0.sessions.send_to_session(sid, event)
+        expected = b"".join(
+            _sse_frame(e.event_id, e.message)
+            for e in transport0.sessions.events._events[sid])
+        stream_resp = await clients[1].get(
+            "/mcp", auth=auth, headers={"mcp-session-id": sid})
+        got = b""
+        frames_deadline = time.monotonic() + 30
+        while len(got) < len(expected) and time.monotonic() < frames_deadline:
+            chunk = await asyncio.wait_for(
+                stream_resp.content.read(len(expected) - len(got)),
+                timeout=30)
+            if not chunk:
+                break
+            got += chunk
+        handoff_identical = got == expected
+
+        # --- worker-death chaos: owner dies mid-stream ---
+        kill_started = time.monotonic()
+        await clients[0].close()  # worker 0 (pool + session owner) dies
+        hang = False
+        try:
+            # the relayed stream must END (clean EOF), never hang
+            while True:
+                chunk = await asyncio.wait_for(stream_resp.content.read(4096),
+                                               timeout=30)
+                if not chunk:
+                    break
+        except asyncio.TimeoutError:
+            hang = True
+        stream_end_s = time.monotonic() - kill_started
+        metrics1 = apps[1]["ctx"].metrics.render()[0].decode()
+        loss_counted = ('mcpforge_gw_session_handoffs_total'
+                        '{kind="stream_lost"}') in metrics1
+
+        # --- leader failover: a survivor re-elects and serves chat ---
+        failover_ok = False
+        failover_deadline = time.monotonic() + 300
+        while time.monotonic() < failover_deadline and not failover_ok:
+            ok, _tag = await chat(clients[1], auth, 1)
+            failover_ok = ok
+            if not failover_ok:
+                await asyncio.sleep(0.5)
+        failover_s = time.monotonic() - kill_started
+
+        forensics = await probe_slowest_trace(clients[1], auth)
+        single_summary = single.summary()
+        fleet_summary = fleet.summary()
+        scaleup = (fleet_summary["rps"] / single_summary["rps"]
+                   if single_summary["rps"] else 0.0)
+        return {
+            "scenario": "workers", "workers": workers_n,
+            "in_process": True,
+            "value": fleet_summary["rps"],
+            "p50_ms": fleet_summary.get("p50_ms"),
+            "p95_ms": fleet_summary.get("p95_ms"),
+            "requests": single.requests + fleet.requests,
+            "failures": single.failures + fleet.failures,
+            "wall_s": round(single.wall_s + fleet.wall_s, 3),
+            "offered_rps": scale["workers_rate"],
+            "single_worker": single_summary,
+            "fleet": fleet_summary,
+            "scaleup": round(scaleup, 3),
+            "owner_stats": owner_stats,
+            "handoff": {
+                "byte_identical": handoff_identical,
+                "expected_bytes": len(expected),
+                "received_bytes": len(got),
+                "stream_end_after_kill_s": round(stream_end_s, 2),
+                "loss_counted": loss_counted,
+                "hang": hang,
+            },
+            "leader_failover": {"ok": failover_ok,
+                                "recovered_s": round(failover_s, 2)},
+            "forensics": forensics,
+            "slo": slo, "slo_ok": slo["ok"],
+            "hard_fail": (
+                (not primed and "workers never primed: shared engine "
+                                "plane did not elect/serve")
+                or (single.failures + fleet.failures
+                    and f"{single.failures + fleet.failures} request(s) "
+                        "failed in the throughput arms")
+                or (not handoff_identical
+                    and f"relayed SSE bytes diverged from the owner's "
+                        f"frames ({len(got)}/{len(expected)} bytes)")
+                or (hang and "relayed stream HUNG after the owning "
+                             "worker died (liveness bound breached)")
+                or (not loss_counted
+                    and "owner death was not counted in "
+                        "mcpforge_gw_session_handoffs_total{stream_lost}")
+                or (not failover_ok
+                    and "no survivor re-elected pool ownership — chat "
+                        "never recovered after the owner died")
+                or next((f"forensics: {p}"
+                         for p in forensics["problems"]), None)
+                or None),
+        }
+    finally:
+        # clients[0] is usually already dead (the chaos kill); double
+        # closes and failures-before-the-kill both land here safely
+        for client in clients:
+            try:
+                await client.close()
+            except Exception:
+                pass
+        if upstream is not None:
+            try:
+                await upstream.close()
+            except Exception:
+                pass
+        try:
+            await hub.stop()
+        except Exception:
+            pass
+
+
 def _strip(result: dict) -> dict:
     """Phase summaries + SLO verdicts, minus raw latency arrays."""
     return {"requests": result["requests"], "failures": result["failures"],
@@ -1152,6 +1467,7 @@ async def run_scenarios(platform: str) -> dict:
             "overload-shed": lambda: scenario_overload_shed(
                 app, client, auth, model, scale, platform),
             "chaos": lambda: scenario_chaos(app, client, auth, model, scale),
+            "workers": lambda: scenario_workers(platform, scale),
         }
         out_dir = os.environ.get(
             "BENCH_SCENARIO_DIR",
@@ -1173,6 +1489,9 @@ async def run_scenarios(platform: str) -> dict:
                 "smoke": _smoke(),
                 "scenario_wall_s": round(time.monotonic() - started, 2),
             })
+            # worker-count arm partition (tools/bench_trend.py): a
+            # 4-worker round must never median against 1-worker history
+            capture.setdefault("workers", 1)
             # no-vacuous-pass: the scenario must have actually pushed
             # samples through the objectives it claims verdicts for
             unmeasured = assert_slo_measured(

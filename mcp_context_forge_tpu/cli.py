@@ -40,11 +40,16 @@ def main(argv: list[str] | None = None) -> int:
     supervise.add_argument("--workers", type=int, default=2)
     supervise.add_argument("--host", default=None)
     supervise.add_argument("--port", type=int, default=None,
-                           help="base port; worker i listens on port+i")
+                           help="shared SO_REUSEPORT port (default), or the "
+                                "base port with --port-per-worker")
     supervise.add_argument("--hub-port", type=int, default=None,
                            help="coordination hub port (default: base port-1)")
     supervise.add_argument("--no-hub", action="store_true",
                            help="workers use an external bus (no embedded hub)")
+    supervise.add_argument("--port-per-worker", action="store_true",
+                           help="legacy layout: worker i listens on port+i "
+                                "behind an external LB instead of one "
+                                "SO_REUSEPORT socket")
 
     token = sub.add_parser("token", help="mint a JWT for an email")
     token.add_argument("email")
@@ -87,7 +92,8 @@ def main(argv: list[str] | None = None) -> int:
         supervisor = Supervisor(
             workers=args.workers, host=args.host or settings.host,
             base_port=base_port,
-            hub_port=None if args.no_hub else (args.hub_port or base_port - 1))
+            hub_port=None if args.no_hub else (args.hub_port or base_port - 1),
+            reuse_port=not args.port_per_worker)
         supervisor.run_forever()
         return 0
 

@@ -51,6 +51,53 @@ class Settings(BaseModel):
     bus_tcp_secret: str = ""     # hub auth; empty = fall back to jwt secret
     leader_lease_ttl: float = 15.0
 
+    # --- multi-worker gateway scale-out (supervisor.py, coordination/rpc.py,
+    # docs/scaleout.md) ---
+    # informational worker count + index, stamped by the supervisor per
+    # worker (fleet metrics / flight-recorder attribution read them)
+    gw_workers: int = 1
+    worker_index: int = 0
+    # all workers bind ONE listening port with SO_REUSEPORT (the kernel
+    # spreads accepts); off = the legacy port-per-worker layout
+    gw_reuse_port: bool = False
+    # listen(2) backlog: the aiohttp default of 128 resets connections
+    # under a 10k-concurrent open-loop burst before a worker ever sees
+    # them; sized for the scale-out posture
+    gw_listen_backlog: int = 1024
+    # cross-worker session handoff: an SSE stream or elicit request
+    # landing on a non-owning worker is served over the bus RPC seam
+    # instead of refused (the 409 survives only as the fallback when the
+    # owner is unreachable)
+    gw_session_handoff: bool = True
+    gw_rpc_timeout_s: float = 30.0
+    # streaming RPC idle bar: no chunk for this long triggers an owner
+    # liveness check (dead owner => clean termination, never a hang)
+    gw_stream_idle_timeout_s: float = 15.0
+    # per-worker metrics aggregation: each worker publishes its exposition
+    # on the bus so /metrics/prometheus?scope=fleet and
+    # /admin/slo?scope=fleet report fleet-wide truth from any worker
+    gw_fleet_metrics: bool = False
+    gw_fleet_metrics_interval_s: float = 2.0
+    # --- distributed tenant rate limiter (coordination/ratelimit.py) ---
+    # enforce tenant_quota_tokens_per_window against ONE shared counter
+    # (hub-backed token bucket) instead of per-worker ledgers: N workers
+    # admit at most quota + one bucket burst, never N x quota
+    gw_distributed_limiter: bool = True
+    # tokens a worker draws from the shared budget per grant — the
+    # "one configured bucket burst" of over-admission the limiter allows
+    tenant_quota_burst_tokens: int = 2048
+    # shared quota window length; 0 = inherit the rollup interval (the
+    # window behind mcpforge_gw_tenant_quota_used_ratio)
+    tenant_quota_window_s: float = 0.0
+    # how often each worker reconciles ledger actuals into the shared
+    # counter (the conservation-gated signal the limiter consumes)
+    tenant_limiter_sync_interval_s: float = 0.25
+    # --- shared engine plane (tpu_local/pool_rpc.py): ONE worker owns
+    # the EnginePool (leader-elected via the coordination leases); the
+    # others serve LLM traffic through the bus RPC seam without
+    # duplicating HBM state. Requires a cross-process bus backend.
+    tpu_local_pool_shared: bool = False
+
     # --- MCP Apps (ui:// AppBridge, reference main.py:10508) ---
     mcp_apps_enabled: bool = True
     mcp_apps_session_ttl: float = 300.0

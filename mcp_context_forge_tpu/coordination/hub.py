@@ -74,6 +74,9 @@ class CoordinationHub:
         # expires 0.0 = never. The Redis-keys analog next to pub/sub+leases.
         self._kv: dict[str, tuple[Any, float]] = {}
         self._kv_next_sweep = time.monotonic() + 60.0
+        # rate-limit windows: key -> (consumed, window_started, window_s)
+        self._rl: dict[str, tuple[float, float, float]] = {}
+        self._rl_next_sweep = time.monotonic() + 60.0
 
     @property
     def bound_port(self) -> int:
@@ -155,6 +158,8 @@ class CoordinationHub:
             self._send(writer, self._lease_op(op, frame))
         elif op in ("kv_set", "kv_get", "kv_del"):
             self._send(writer, self._kv_op(op, frame))
+        elif op == "rl_take":
+            self._send(writer, self._rl_op(frame))
 
     async def _broadcast(self, sender: int, topic: str,
                          message: dict[str, Any]) -> None:
@@ -234,6 +239,41 @@ class CoordinationHub:
         elif op == "kv_del":
             self._kv.pop(key, None)
         return resp
+
+
+    # ---------------------------------------------------------- rate limiting
+
+    def _rl_op(self, frame: dict[str, Any]) -> dict[str, Any]:
+        """Shared token-budget window: the distributed tenant limiter's
+        CAS (coordination/ratelimit.py). One counter per key, reset each
+        ``window_s``; ``take`` succeeds while consumed < limit (grants
+        overshoot by at most one cost — the bounded over-admission),
+        ``force`` charges unconditionally (ledger reconciliation).
+        Ordering is total per hub, so N workers' grants serialize here."""
+        key = str(frame.get("key", ""))
+        cost = float(frame.get("cost") or 0.0)
+        limit = float(frame.get("limit") or 0.0)
+        window_s = max(0.001, float(frame.get("window_s") or 60.0))
+        force = bool(frame.get("force"))
+        now = time.monotonic()
+        if now >= self._rl_next_sweep:
+            # an expired window is state-free (the next take resets it
+            # identically), so pruning is lossless — churned tenant keys
+            # must not grow the table forever (same discipline as _kv)
+            self._rl = {k: entry for k, entry in self._rl.items()
+                        if now - entry[1] < entry[2]}
+            self._rl_next_sweep = now + 60.0
+        consumed, started, _w = self._rl.get(key, (0.0, now, window_s))
+        if now - started >= window_s:
+            consumed, started = 0.0, now
+        ok = force or limit <= 0 or consumed < limit
+        if ok:
+            consumed += cost
+        self._rl[key] = (consumed, started, window_s)
+        remaining = max(0.0, window_s - (now - started))
+        return {"op": "resp", "id": frame.get("id"), "ok": ok,
+                "consumed": consumed,
+                "retry_after": round(remaining, 3)}
 
 
 class HubClient:
@@ -370,6 +410,14 @@ class HubClient:
 
     async def kv_del(self, key: str) -> None:
         await self.request({"op": "kv_del", "key": key})
+
+    async def rl_take(self, key: str, cost: float, limit: float,
+                      window_s: float, force: bool = False
+                      ) -> dict[str, Any]:
+        """Shared rate-limit window op (see CoordinationHub._rl_op)."""
+        return await self.request({"op": "rl_take", "key": key,
+                                   "cost": cost, "limit": limit,
+                                   "window_s": window_s, "force": force})
 
     async def request(self, frame: dict[str, Any],
                       timeout: float = 5.0) -> dict[str, Any]:

@@ -1,0 +1,331 @@
+"""Cross-worker RPC over the event bus: the hub's request/stream seam.
+
+The coordination layer had pub/sub (bus), CAS leases, and a shared KV —
+but every cross-worker *call* (session affinity forwarding) hand-rolled
+its own correlation ids on ad-hoc topics. The multi-worker scale-out
+(docs/scaleout.md) needs three more call shapes — elicit handoff, SSE
+stream relay, and the shared engine plane's chat/stream path — so this
+module is the ONE generic seam they all ride:
+
+- :class:`BusRpc` — register named methods, ``call()`` a peer worker
+  (unary), or ``call_stream()`` it (server pushes ordered chunks). Peers
+  are addressed by worker id; requests ride topic ``rpc.req`` and
+  responses ``rpc.res.<worker>`` (each worker subscribes only to its own
+  response topic, so stream fan-out never wakes uninvolved workers).
+- Streaming is ordered by explicit ``seq`` and terminated by an ``end``
+  frame (optionally carrying an error); a client that sees no chunk for
+  ``idle_timeout_s`` checks the server's worker heartbeat lease and
+  terminates CLEANLY when the owner is dead — a worker dying mid-stream
+  must never hang its consumers (the chaos arm gates this).
+- The ``coordination.hub.rpc`` fault point (observability/faults.py)
+  fires on the CLIENT send path, scoped by method name: ``error`` raises
+  a transport-shaped failure, ``latency`` delays the send, and
+  ``corrupt`` models a PARTITION — the request frame is silently dropped
+  so the caller walks the timeout/liveness path, exactly like a split
+  bus.
+
+Wire frames (bus messages):
+  rpc.req          {"to", "from", "corr", "method", "params", "stream"}
+  rpc.res.<worker> {"corr", "result"|"error"}                    unary
+                   {"corr", "seq", "chunk"}                      stream
+                   {"corr", "end": true, "error": str|null}      stream end
+  rpc.req          {"cancel": corr, "to": server}                client gone
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, AsyncIterator, Awaitable, Callable
+
+from ..observability.faults import fault_point
+from ..utils.ids import new_id
+
+logger = logging.getLogger(__name__)
+
+REQ_TOPIC = "rpc.req"
+RES_PREFIX = "rpc.res."
+
+# server-side cap on concurrently-open streams per BusRpc (a runaway
+# client must not grow relay tasks without bound)
+MAX_OPEN_STREAMS = 1024
+
+
+class RpcError(ConnectionError):
+    """Transport-level RPC failure (timeout, dead peer, injected fault).
+    ConnectionError so callers' existing transport handlers apply."""
+
+
+class RpcPeerLost(RpcError):
+    """The serving worker died mid-call (heartbeat lease gone)."""
+
+
+class RpcAppError(RuntimeError):
+    """The remote handler raised: re-raised on the caller with the
+    remote type name in the message (never a transport retry case)."""
+
+
+Handler = Callable[[dict[str, Any]], Awaitable[Any]]
+StreamHandler = Callable[[dict[str, Any]], AsyncIterator[Any]]
+
+
+class BusRpc:
+    """Request/response + streaming over an EventBus, worker-addressed."""
+
+    def __init__(self, bus: Any, worker_id: str, leases: Any = None,
+                 default_timeout_s: float = 30.0,
+                 idle_timeout_s: float = 15.0) -> None:
+        self.bus = bus
+        self.worker_id = worker_id
+        self.leases = leases
+        self.default_timeout_s = default_timeout_s
+        self.idle_timeout_s = idle_timeout_s
+        self._handlers: dict[str, Handler] = {}
+        self._stream_handlers: dict[str, StreamHandler] = {}
+        # client side: corr -> future (unary) | asyncio.Queue (stream)
+        self._pending: dict[str, asyncio.Future] = {}
+        self._streams: dict[str, asyncio.Queue] = {}
+        # server side: corr -> relay task (cancel on client-gone frames)
+        self._serving: dict[str, asyncio.Task] = {}
+        self._unsubs: list = []
+        self._tasks: set[asyncio.Task] = set()  # strong refs (GC safety)
+        self.calls_served = 0
+        self.streams_served = 0
+
+    # ------------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        self._unsubs.append(self.bus.subscribe(REQ_TOPIC, self._on_request))
+        self._unsubs.append(self.bus.subscribe(
+            RES_PREFIX + self.worker_id, self._on_response))
+
+    async def stop(self) -> None:
+        for unsub in self._unsubs:
+            unsub()
+        self._unsubs.clear()
+        for task in list(self._serving.values()) + list(self._tasks):
+            task.cancel()
+        for task in list(self._serving.values()) + list(self._tasks):
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._serving.clear()
+        for future in self._pending.values():
+            if not future.done():
+                future.set_exception(RpcError("rpc stopped"))
+        self._pending.clear()
+        for queue in self._streams.values():
+            queue.put_nowait({"end": True, "error": "rpc stopped"})
+        self._streams.clear()
+
+    def register(self, method: str, handler: Handler) -> None:
+        self._handlers[method] = handler
+
+    def register_stream(self, method: str, handler: StreamHandler) -> None:
+        self._stream_handlers[method] = handler
+
+    # ------------------------------------------------------------ client side
+
+    async def _send_request(self, frame: dict[str, Any]) -> None:
+        """Publish a request frame through the fault seam. ``corrupt``
+        models a partition: the frame is DROPPED (the caller times out /
+        walks the liveness check) — the same observable failure as a
+        split coordination plane."""
+        act = fault_point("coordination.hub.rpc", scope=frame.get("method"))
+        if act is not None:
+            if act.kind == "corrupt":
+                return  # partition: request never leaves this worker
+            await act.async_apply()  # latency sleeps, error raises
+        await self.bus.publish(REQ_TOPIC, frame)
+
+    async def _peer_alive(self, worker: str) -> bool:
+        """Is the peer's heartbeat lease still held? Unknown leases read
+        as dead — a caller blocked on a silent peer must terminate."""
+        if self.leases is None:
+            return True
+        try:
+            return await self.leases.holder(f"worker:{worker}") == worker
+        except Exception:
+            return False
+
+    async def call(self, to: str, method: str, params: dict[str, Any],
+                   timeout_s: float | None = None) -> Any:
+        """Unary call; raises RpcAppError (remote handler raised),
+        RpcPeerLost (peer died), or RpcError (timeout/transport)."""
+        corr = new_id()
+        future: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[corr] = future
+        deadline = (timeout_s if timeout_s is not None
+                    else self.default_timeout_s)
+        try:
+            await self._send_request({"to": to, "from": self.worker_id,
+                                      "corr": corr, "method": method,
+                                      "params": params})
+            try:
+                return await asyncio.wait_for(future, deadline)
+            except asyncio.TimeoutError:
+                if not await self._peer_alive(to):
+                    raise RpcPeerLost(
+                        f"worker {to} died serving {method}") from None
+                raise RpcError(
+                    f"rpc {method} to {to} timed out after {deadline}s"
+                ) from None
+        finally:
+            self._pending.pop(corr, None)
+
+    async def call_stream(self, to: str, method: str,
+                          params: dict[str, Any],
+                          idle_timeout_s: float | None = None
+                          ) -> AsyncIterator[Any]:
+        """Streaming call: yields the server's chunks in ``seq`` order.
+        No chunk within the idle bar triggers a peer liveness check —
+        dead peer => RpcPeerLost (clean termination, counted by callers),
+        live peer => keep waiting (long TTFT is legitimate)."""
+        corr = new_id()
+        queue: asyncio.Queue = asyncio.Queue()
+        self._streams[corr] = queue
+        idle = (idle_timeout_s if idle_timeout_s is not None
+                else self.idle_timeout_s)
+        next_seq = 0
+        held: dict[int, Any] = {}  # out-of-order chunks parked by seq
+        try:
+            await self._send_request({"to": to, "from": self.worker_id,
+                                      "corr": corr, "method": method,
+                                      "params": params, "stream": True})
+            while True:
+                try:
+                    frame = await asyncio.wait_for(queue.get(), idle)
+                except asyncio.TimeoutError:
+                    if not await self._peer_alive(to):
+                        raise RpcPeerLost(
+                            f"worker {to} died mid-stream ({method})"
+                        ) from None
+                    continue
+                if frame.get("end"):
+                    error = frame.get("error")
+                    if error:
+                        raise RpcAppError(error)
+                    return
+                held[int(frame.get("seq", next_seq))] = frame.get("chunk")
+                while next_seq in held:
+                    yield held.pop(next_seq)
+                    next_seq += 1
+        finally:
+            self._streams.pop(corr, None)
+            try:
+                # tell the server the consumer is gone (idempotent)
+                await self.bus.publish(REQ_TOPIC, {"to": to,
+                                                   "cancel": corr})
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------ server side
+
+    async def _on_request(self, topic: str, frame: dict[str, Any]) -> None:
+        if frame.get("to") != self.worker_id:
+            return
+        cancel = frame.get("cancel")
+        if cancel:
+            task = self._serving.pop(cancel, None)
+            if task is not None:
+                task.cancel()
+            return
+        corr = frame.get("corr")
+        method = frame.get("method", "")
+        reply_topic = RES_PREFIX + str(frame.get("from", ""))
+        if frame.get("stream"):
+            handler = self._stream_handlers.get(method)
+            if handler is None:
+                await self.bus.publish(reply_topic, {
+                    "corr": corr, "end": True,
+                    "error": f"unknown stream method {method!r}"})
+                return
+            if len(self._serving) >= MAX_OPEN_STREAMS:
+                await self.bus.publish(reply_topic, {
+                    "corr": corr, "end": True,
+                    "error": "stream capacity exhausted"})
+                return
+            task = asyncio.get_running_loop().create_task(
+                self._serve_stream(reply_topic, corr, handler,
+                                   frame.get("params") or {}))
+            self._serving[corr] = task
+            task.add_done_callback(
+                lambda _t, c=corr: self._serving.pop(c, None))
+            return
+        handler2 = self._handlers.get(method)
+
+        async def _run() -> None:
+            if handler2 is None:
+                payload = {"corr": corr,
+                           "error": f"unknown rpc method {method!r}"}
+            else:
+                try:
+                    result = await handler2(frame.get("params") or {})
+                    payload = {"corr": corr, "result": result}
+                    self.calls_served += 1
+                except Exception as exc:
+                    payload = {"corr": corr,
+                               "error": f"{type(exc).__name__}: {exc}"}
+            await self.bus.publish(reply_topic, payload)
+
+        task = asyncio.get_running_loop().create_task(_run())
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _serve_stream(self, reply_topic: str, corr: str,
+                            handler: StreamHandler,
+                            params: dict[str, Any]) -> None:
+        seq = 0
+        error: str | None = None
+        iterator = None
+        try:
+            iterator = handler(params)
+            async for chunk in iterator:
+                await self.bus.publish(reply_topic, {
+                    "corr": corr, "seq": seq, "chunk": chunk})
+                seq += 1
+            self.streams_served += 1
+        except asyncio.CancelledError:
+            # consumer went away: close the producer, no end frame needed
+            error = "cancelled"
+            raise
+        except Exception as exc:
+            error = f"{type(exc).__name__}: {exc}"
+        finally:
+            if iterator is not None and hasattr(iterator, "aclose"):
+                try:
+                    await iterator.aclose()
+                except Exception:
+                    pass
+            if error != "cancelled":
+                try:
+                    await self.bus.publish(reply_topic, {
+                        "corr": corr, "end": True, "error": error})
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------------- client side
+
+    async def _on_response(self, topic: str, frame: dict[str, Any]) -> None:
+        corr = frame.get("corr", "")
+        queue = self._streams.get(corr)
+        if queue is not None:
+            queue.put_nowait(frame)
+            return
+        future = self._pending.get(corr)
+        if future is None or future.done():
+            return
+        if "error" in frame and frame["error"] is not None:
+            future.set_exception(RpcAppError(frame["error"]))
+        else:
+            future.set_result(frame.get("result"))
+
+    def stats(self) -> dict[str, Any]:
+        return {"worker_id": self.worker_id,
+                "methods": sorted(self._handlers),
+                "stream_methods": sorted(self._stream_handlers),
+                "open_streams": len(self._serving),
+                "pending_calls": len(self._pending),
+                "calls_served": self.calls_served,
+                "streams_served": self.streams_served}

@@ -63,6 +63,7 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     await db.migrate(MIGRATIONS)
 
     hub = None
+    hub_client = None
     if settings.bus_backend == "tcp":
         from ..coordination.hub import (CoordinationHub, HubClient, TcpEventBus,
                                         TcpLeaseManager)
@@ -103,6 +104,30 @@ async def build_app(settings: Settings | None = None) -> web.Application:
 
     ctx = AppContext(settings=settings, db=db, bus=bus, leases=leases,
                      tracer=tracer, metrics=metrics)
+
+    # cross-worker RPC seam (coordination/rpc.py, docs/scaleout.md):
+    # elicit/SSE handoff and the shared engine plane all ride this one
+    # bus-addressed request/stream layer; subscriptions open in the
+    # lifecycle after bus.start()
+    from ..coordination.rpc import BusRpc
+    bus_rpc = BusRpc(bus, ctx.worker_id, leases=leases,
+                     default_timeout_s=settings.gw_rpc_timeout_s,
+                     idle_timeout_s=settings.gw_stream_idle_timeout_s)
+    app["bus_rpc"] = bus_rpc
+    ctx.extras["bus_rpc"] = bus_rpc
+
+    # per-worker metrics aggregation (observability/fleet.py): workers
+    # publish their exposition on the bus so any worker can answer
+    # /metrics/prometheus?scope=fleet and /admin/slo?scope=fleet with
+    # fleet-wide truth
+    fleet_metrics = None
+    if settings.gw_fleet_metrics:
+        from ..observability.fleet import FleetMetrics
+        fleet_metrics = FleetMetrics(
+            bus, ctx.worker_id, metrics,
+            interval_s=settings.gw_fleet_metrics_interval_s)
+        app["fleet_metrics"] = fleet_metrics
+        ctx.extras["fleet_metrics"] = fleet_metrics
 
     if settings.otel_db_store:
         # in-DB trace store (reference observability_service: separate-path
@@ -187,7 +212,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         recorder = FlightRecorder(
             metrics, ring_size=settings.gw_flight_ring_size,
             slowest_size=settings.gw_flight_slowest_size,
-            slow_request_s=settings.gw_slow_request_s)
+            slow_request_s=settings.gw_slow_request_s,
+            worker=ctx.worker_id)
         app["flight_recorder"] = recorder
         loop_sampler = LoopLagSampler(
             metrics, interval_s=settings.gw_loop_lag_interval_s,
@@ -233,6 +259,28 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         app["tenant_usage_rollup"] = tenant_rollup
         ctx.extras["tenant_ledger"] = tenant_ledger
 
+    # distributed tenant rate limiter (coordination/ratelimit.py,
+    # docs/scaleout.md "Limiter math"): tenant quotas enforced against
+    # ONE shared window counter so N workers admit quota + one burst,
+    # never N x quota; charges are the ledger's conservation-gated
+    # token counts, reconciled by a periodic sync task
+    tenant_limiter = None
+    if (settings.gw_distributed_limiter and tenant_ledger is not None
+            and settings.tenant_quota_tokens_per_window > 0):
+        from ..coordination.ratelimit import (DistributedTenantLimiter,
+                                              make_rate_counter)
+        tenant_limiter = DistributedTenantLimiter(
+            make_rate_counter(settings.bus_backend, settings.bus_dir,
+                              hub_client=hub_client),
+            tenant_ledger,
+            quota_tokens=settings.tenant_quota_tokens_per_window,
+            window_s=(settings.tenant_quota_window_s
+                      or settings.tenant_usage_rollup_interval_s),
+            burst_tokens=settings.tenant_quota_burst_tokens,
+            sync_interval_s=settings.tenant_limiter_sync_interval_s)
+        app["tenant_limiter"] = tenant_limiter
+        ctx.extras["tenant_limiter"] = tenant_limiter
+
     # SLO verdicts over the serving histograms at GET /admin/slo —
     # engine objectives (TTFT/TPOT/queue-wait) read empty without the
     # engine, but the gateway http_p95 objective holds for every
@@ -249,6 +297,18 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         slo_classes=parse_slo_classes(settings),
         tenant_classes=tenant_class_map,
         tenant_label=tenant_clamp.peek)
+    if fleet_metrics is not None:
+        # fleet-scope twin: same objectives evaluated over the SUMMED
+        # cross-worker histogram state (/admin/slo?scope=fleet) — fleet
+        # p95, not this worker's p95
+        from ..observability.fleet import FleetMetricsView
+        app["slo_evaluator_fleet"] = SloEvaluator(
+            FleetMetricsView(metrics, fleet_metrics),
+            default_objectives(settings),
+            error_budget=settings.slo_error_budget,
+            slo_classes=parse_slo_classes(settings),
+            tenant_classes=tenant_class_map,
+            tenant_label=tenant_clamp.peek)
 
     # overload shedder (observability/degradation.py): admission-time
     # 429s on the LLM chat surface, lowest SLO class first, consuming
@@ -272,7 +332,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
             tenant_classes=tenant_class_map,
             ledger=tenant_ledger,
             degradation=degradation,
-            metrics=metrics)
+            metrics=metrics,
+            limiter=tenant_limiter)
 
     # operation-timing registry (reference performance_tracker.py): http /
     # db / tool / resource series feed /admin/performance and the bundle
@@ -321,7 +382,66 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     # tpu_local engine + LLM provider registry
     engine = None
     engine_pool = None
-    if settings.tpu_local_enabled:
+    engine_plane = None
+    if settings.tpu_local_enabled and settings.tpu_local_pool_shared:
+        # shared engine plane (tpu_local/pool_rpc.py, docs/scaleout.md):
+        # the EnginePool is built by ONE leader-elected worker; every
+        # other worker serves LLM traffic through the bus RPC seam.
+        # HBM state exists once, whatever gw_workers says.
+        from ..tpu_local.pool_rpc import SharedEnginePlane, SharedPoolProvider
+        from ..tpu_local.provider import LLMProviderRegistry
+        from ..tpu_local.server import setup_llm_routes
+
+        async def _build_pool_provider():
+            from ..tpu_local.engine import EngineConfig, TPUEngine
+            from ..tpu_local.pool import EnginePool
+            from ..tpu_local.tpu_provider import TPULocalProvider
+            config = EngineConfig.from_settings(settings)
+            if settings.tpu_local_replicas > 1:
+                pool = EnginePool(
+                    config, replicas=settings.tpu_local_replicas,
+                    tracer=tracer, metrics=metrics,
+                    affinity_routing=settings.tpu_local_pool_affinity_routing,
+                    health_interval_s=settings.tpu_local_pool_health_interval_s,
+                    heartbeat_timeout_s=(
+                        settings.tpu_local_pool_heartbeat_timeout_s),
+                    requeue_max=settings.tpu_local_pool_requeue_max,
+                    ledger=tenant_ledger)
+                await pool.start()
+                backend = pool
+                ctx.extras["tpu_engine_pool"] = pool
+                ctx.extras["tpu_engine"] = pool.replicas[0].engine
+            else:
+                local_engine = TPUEngine(config, tracer=tracer,
+                                         metrics=metrics,
+                                         ledger=tenant_ledger)
+                await local_engine.start()
+                backend = local_engine
+                ctx.extras["tpu_engine"] = local_engine
+            return TPULocalProvider(
+                "tpu_local", backend,
+                embedding_model=settings.tpu_local_embedding_model,
+                tracer=tracer, metrics=metrics,
+                encoder_max_batch=settings.tpu_local_encoder_max_batch,
+                encoder_max_wait_ms=settings.tpu_local_encoder_max_wait_ms,
+                encoder_min_seq=settings.tpu_local_encoder_min_seq)
+
+        engine_plane = SharedEnginePlane(
+            bus_rpc, leases, ctx.worker_id, _build_pool_provider,
+            lease_ttl=settings.leader_lease_ttl,
+            rpc_timeout_s=settings.gw_rpc_timeout_s,
+            stream_idle_timeout_s=settings.gw_stream_idle_timeout_s)
+        app["engine_plane"] = engine_plane
+        ctx.extras["engine_plane"] = engine_plane
+        registry = LLMProviderRegistry()
+        registry.register(
+            SharedPoolProvider("tpu_local", engine_plane),
+            [settings.tpu_local_model, "tpu_local"],
+            default_chat=True, default_embed=True)
+        ctx.llm_registry = registry
+        app["llm_registry"] = registry
+        setup_llm_routes(app, registry, prefix=settings.llm_api_prefix)
+    elif settings.tpu_local_enabled:
         from ..tpu_local.engine import EngineConfig, TPUEngine
         from ..tpu_local.provider import LLMProviderRegistry
         from ..tpu_local.server import setup_llm_routes
@@ -483,7 +603,9 @@ async def build_app(settings: Settings | None = None) -> web.Application:
             return exc.to_dict(message.get("id") if isinstance(message, dict)
                                else None)
 
-    affinity = SessionAffinityService(ctx, local_handler=_affinity_local_handler)
+    affinity = SessionAffinityService(
+        ctx, local_handler=_affinity_local_handler,
+        rpc=bus_rpc if settings.gw_session_handoff else None)
     ctx.extras["session_affinity"] = affinity
     app["session_affinity"] = affinity
     transport.affinity = affinity
@@ -656,16 +778,58 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     ctx.extras["elicitation_service"] = elicitation_service
     app["elicitation_service"] = elicitation_service
 
+    # cross-worker session handoff handlers (docs/scaleout.md): the
+    # OWNING worker serves forwarded elicit calls and relays its session
+    # SSE queue to whichever worker the client's connection landed on
+    async def _rpc_session_elicit(params: dict) -> dict:
+        session_id = params.get("session_id", "")
+        if transport.sessions.get(session_id) is None:
+            from ..services.base import NotFoundError as _NF
+            raise _NF(f"session {session_id!r} not connected here")
+        await affinity.claim_session(session_id)  # forwarded activity renews
+        return await elicitation_service.elicit(
+            session_id, params.get("message", ""),
+            requested_schema=params.get("requestedSchema"),
+            timeout=float(params.get("timeout") or 120.0))
+
+    async def _rpc_session_stream(params: dict):
+        """Relay generator: replay-from-Last-Event-ID, then live queue
+        consumption; idle gaps yield keepalive chunks so the remote
+        writer emits the same ': keepalive' comments a local stream
+        would. The remote consumer IS the stream consumer — frames are
+        byte-identical because the remote side renders them with the
+        same _sse_frame."""
+        import asyncio as _aio
+        session_id = params.get("session_id", "")
+        session = transport.sessions.get(session_id)
+        if session is None:
+            from ..services.base import NotFoundError as _NF
+            raise _NF(f"session {session_id!r} not connected here")
+        metrics.gw_session_handoffs.labels(kind="stream_served").inc()
+        last_event_id = params.get("last_event_id")
+        if last_event_id:
+            for entry in transport.sessions.events.replay_after(
+                    session_id, last_event_id):
+                yield {"event_id": entry.event_id, "message": entry.message}
+        keepalive = settings.sse_keepalive_interval
+        while True:
+            # forwarded consumption keeps ownership + the session alive
+            transport.sessions.get(session_id)
+            await affinity.claim_session(session_id)
+            try:
+                event_id, message = await _aio.wait_for(
+                    session.queue.get(), timeout=keepalive)
+                yield {"event_id": event_id, "message": message}
+            except _aio.TimeoutError:
+                yield {"keepalive": True}
+
+    bus_rpc.register("session.elicit", _rpc_session_elicit)
+    bus_rpc.register_stream("session.stream", _rpc_session_stream)
+
     async def elicit_route(request: web.Request) -> web.Response:
         request["auth"].require("tools.invoke")
         body = await request.json()
         session_id = request.match_info["session_id"]
-        # the stream lives on the owning worker only
-        if (transport.sessions.get(session_id) is None
-                and not await affinity.is_local(session_id)):
-            return web.json_response(
-                {"detail": "Session is owned by another worker; "
-                           "elicit on the owning worker"}, status=409)
         import math
         try:
             timeout = float(body.get("timeout", 120.0))
@@ -675,6 +839,37 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         if not math.isfinite(timeout):
             return web.json_response({"detail": "timeout must be finite"},
                                      status=422)
+        # the stream lives on the owning worker: forward there first
+        # (docs/scaleout.md); the 409 survives only as the fallback when
+        # no live owner answers (handoff disabled, owner died mid-claim)
+        if (transport.sessions.get(session_id) is None
+                and not await affinity.is_local(session_id)):
+            from ..coordination.rpc import RpcAppError
+            try:
+                result = await affinity.forward_elicit(session_id, {
+                    "message": body.get("message", ""),
+                    "requestedSchema": body.get("requestedSchema"),
+                    "timeout": timeout}, timeout=timeout + 10.0)
+            except RpcAppError as exc:
+                # only the owner's "session not connected here" maps to
+                # the 409 fallback; any OTHER remote failure must
+                # surface as its own error, not an invitation to retry
+                # against an owner that just failed
+                if "NotFoundError" not in str(exc):
+                    metrics.gw_session_handoffs.labels(
+                        kind="remote_error").inc()
+                    return web.json_response(
+                        {"detail": f"elicit handoff failed on the owning "
+                                   f"worker: {exc}"}, status=502)
+                result = None
+                logger.info("elicit handoff refused by owner: %s", exc)
+            if result is not None:
+                metrics.gw_session_handoffs.labels(kind="elicit").inc()
+                return web.json_response(result)
+            metrics.gw_session_handoffs.labels(kind="refused").inc()
+            return web.json_response(
+                {"detail": "Session is owned by another worker; "
+                           "elicit on the owning worker"}, status=409)
         result = await elicitation_service.elicit(
             session_id, body.get("message", ""),
             requested_schema=body.get("requestedSchema"),
@@ -763,13 +958,16 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         await bus.start()
         import asyncio as _asyncio
 
+        await bus_rpc.start()  # the cross-worker call seam rides the bus
         from ..utils.masking import native_available
         await _asyncio.to_thread(native_available)  # prebuild off the loop
         await transport.sessions.start_sweeper()
         await upstream_sessions.start()
         await auth_service.bootstrap_admin()
         await app["role_service"].bootstrap_system_roles()
-        if engine_pool is not None:
+        if engine_plane is not None:
+            await engine_plane.start()  # leader-elected shared pool
+        elif engine_pool is not None:
             await engine_pool.start()  # replicas + health monitor
         elif engine is not None:
             await engine.start()
@@ -785,6 +983,10 @@ async def build_app(settings: Settings | None = None) -> web.Application:
             await loop_sampler.start()
         if tenant_rollup is not None:
             await tenant_rollup.start()  # ledger window -> tenant_usage
+        if tenant_limiter is not None:
+            await tenant_limiter.start()  # ledger -> shared quota counter
+        if fleet_metrics is not None:
+            await fleet_metrics.start()
         await metrics_maintenance.start()
         if metrics_buffer is not None:
             await metrics_buffer.start()
@@ -832,12 +1034,17 @@ async def build_app(settings: Settings | None = None) -> web.Application:
             await metrics_buffer.stop()
         if loop_sampler is not None:
             await loop_sampler.stop()
+        if fleet_metrics is not None:
+            await fleet_metrics.stop()
+        if tenant_limiter is not None:
+            await tenant_limiter.stop()
         await metrics_maintenance.stop()
         await transport.sessions.stop_sweeper()
         await gateway_service.stop_health_loop()
         await elector.stop()
         if ctx.llm_registry is not None:
             await ctx.llm_registry.shutdown()
+        await bus_rpc.stop()
         if tenant_rollup is not None:
             # AFTER engine shutdown (the last retires have landed in the
             # ledger) and before db.close(): the final window's usage
@@ -863,5 +1070,10 @@ def run(settings: Settings | None = None) -> None:
 
     from ..utils.sslctx import serving_ssl
 
+    # gw_reuse_port: every supervised worker binds the SAME port with
+    # SO_REUSEPORT and the kernel spreads accepted connections across
+    # them — the one-socket multi-worker layout (docs/scaleout.md)
     web.run_app(_factory(), host=settings.host, port=settings.port,
+                reuse_port=settings.gw_reuse_port or None,
+                backlog=settings.gw_listen_backlog,
                 ssl_context=serving_ssl(settings))

@@ -56,8 +56,13 @@ class FlightRecorder:
 
     def __init__(self, metrics: Any = None, ring_size: int = 256,
                  slowest_size: int = 32,
-                 slow_request_s: float = 1.0) -> None:
+                 slow_request_s: float = 1.0,
+                 worker: str = "") -> None:
         self.metrics = metrics
+        # multi-worker attribution (docs/scaleout.md): every row carries
+        # the serving worker's id so a merged fleet view can say WHICH
+        # process served the outlier
+        self.worker = worker
         self.ring_size = max(1, int(ring_size))
         self.slowest_size = max(1, int(slowest_size))
         self.slow_request_s = max(0.0, float(slow_request_s))
@@ -109,6 +114,8 @@ class FlightRecorder:
             "duration_ms": round(duration_s * 1e3, 3),
             "phases_ms": phases_ms,
         }
+        if self.worker:
+            entry["worker"] = self.worker
         if tenant:
             # rows keep the EXACT tenant (bounded ring, no cardinality
             # concern); only the Prometheus label below is clamped
@@ -189,6 +196,7 @@ class FlightRecorder:
             slowest = [r for r in slowest if r.get("tenant") == tenant]
             recent = [r for r in recent if r.get("tenant") == tenant]
         out = {
+            "worker": self.worker or None,
             "recorded": self.recorded,
             "slow_requests": self.slow_requests,
             "slow_request_ms": round(self.slow_request_s * 1e3, 1),

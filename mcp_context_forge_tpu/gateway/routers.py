@@ -610,9 +610,20 @@ document.getElementById("f").onsubmit = async (e) => {
         # content negotiation: a scraper that accepts OpenMetrics gets
         # the exemplar-bearing exposition (per-bucket trace ids on the
         # TTFT/TPOT/queue-wait/http histograms — the dashboard's
-        # click-through into /admin/trace/{id}); classic text otherwise
-        body, content_type = request.app["ctx"].metrics.render(
-            accept=request.headers.get("accept", ""))
+        # click-through into /admin/trace/{id}); classic text otherwise.
+        # ?scope=fleet (multi-worker, docs/scaleout.md): the merged
+        # cross-worker exposition — counters/histograms summed, gauges
+        # per-worker under a `worker` label — from ANY worker
+        if request.query.get("scope") == "fleet":
+            fleet = request.app.get("fleet_metrics")
+            if fleet is None:
+                raise NotFoundError(
+                    "fleet metrics aggregation is not enabled "
+                    "(set MCPFORGE_GW_FLEET_METRICS=true)")
+            body, content_type = fleet.render_fleet()
+        else:
+            body, content_type = request.app["ctx"].metrics.render(
+                accept=request.headers.get("accept", ""))
         return web.Response(body=body,
                             headers={"Content-Type": content_type})
 
@@ -843,12 +854,23 @@ document.getElementById("f").onsubmit = async (e) => {
         with its own per-(window, tenant) delta isolation."""
         request["auth"].require("observability.read")
         evaluator = request.app.get("slo_evaluator")
+        if request.query.get("scope") == "fleet":
+            # fleet-wide verdicts (docs/scaleout.md): objectives over
+            # the SUMMED cross-worker histogram state — fleet p95, with
+            # its own per-consumer delta windows
+            evaluator = request.app.get("slo_evaluator_fleet")
+            if evaluator is None:
+                raise NotFoundError(
+                    "fleet SLO evaluation needs MCPFORGE_GW_FLEET_METRICS")
         if evaluator is None:  # pragma: no cover - evaluator is unconditional
             raise NotFoundError("SLO evaluation is not enabled")
         consumer = request.query.get("window", "default")[:64] or "default"
         tenant = request.query.get("tenant") or None
-        return web.json_response(evaluator.evaluate(
-            consumer=consumer, tenant=tenant[:128] if tenant else None))
+        report = evaluator.evaluate(
+            consumer=consumer, tenant=tenant[:128] if tenant else None)
+        if request.query.get("scope") == "fleet":
+            report["scope"] = "fleet"
+        return web.json_response(report)
 
     @routes.get("/admin/engine/pool")
     async def engine_pool_status(request: web.Request) -> web.Response:

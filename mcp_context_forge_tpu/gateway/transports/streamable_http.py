@@ -295,12 +295,21 @@ class StreamableHTTPTransport:
     # ------------------------------------------------------------------- GET
 
     async def handle_get(self, request: web.Request) -> web.StreamResponse:
-        """Server→client SSE stream (stateful mode) with resume."""
+        """Server→client SSE stream (stateful mode) with resume. A
+        session owned by ANOTHER worker is relayed from its owner over
+        the bus RPC seam (docs/scaleout.md) — byte-identical frames,
+        instead of the pre-scale-out 404/409 refusal."""
         if not self.settings.streamable_http_stateful:
             return web.json_response({"detail": "Stateless mode: no server stream"},
                                      status=405)
         session_id = request.headers.get("mcp-session-id")
         session = self.sessions.get(session_id) if session_id else None
+        if session is None and session_id and self.affinity is not None \
+                and self.affinity.rpc is not None \
+                and self.settings.gw_session_handoff:
+            owner = await self.affinity.remote_owner(session_id)
+            if owner is not None:
+                return await self._relay_stream(request, session_id, owner)
         if session is None:
             return web.json_response({"detail": "Unknown or missing session"}, status=404)
         resp = web.StreamResponse(headers={
@@ -321,6 +330,67 @@ class StreamableHTTPTransport:
                 except asyncio.TimeoutError:
                     await resp.write(b": keepalive\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        return resp
+
+    async def _relay_stream(self, request: web.Request, session_id: str,
+                            owner: str) -> web.StreamResponse:
+        """Serve another worker's session stream: the owner's relay
+        handler consumes the session queue and pushes (event_id,
+        message) chunks over the RPC stream; frames here are rendered
+        with the SAME ``_sse_frame`` the owner would use, so the bytes
+        on the wire are identical whichever worker the client hit. A
+        keepalive chunk maps to the same ``: keepalive`` comment. The
+        owner dying mid-relay terminates the stream CLEANLY with the
+        loss counted (``mcpforge_gw_session_handoffs_total{stream_lost}``)
+        — never a hang."""
+        from ...coordination.rpc import RpcAppError, RpcPeerLost
+        metrics = getattr(self.sessions, "metrics", None)
+
+        def _count(kind: str) -> None:
+            if metrics is not None:
+                try:
+                    metrics.gw_session_handoffs.labels(kind=kind).inc()
+                except Exception:
+                    pass
+
+        resp = web.StreamResponse(headers={
+            "content-type": "text/event-stream", "cache-control": "no-store",
+            "mcp-session-id": session_id})
+        await resp.prepare(request)
+        _count("stream")
+        chunks = self.affinity.rpc.call_stream(
+            owner, "session.stream",
+            {"session_id": session_id,
+             "last_event_id": request.headers.get("last-event-id")},
+            idle_timeout_s=max(self.settings.sse_keepalive_interval * 2,
+                               self.settings.gw_stream_idle_timeout_s))
+        try:
+            async for chunk in chunks:
+                if chunk.get("keepalive"):
+                    await resp.write(b": keepalive\n\n")
+                    continue
+                await resp.write(_sse_frame(chunk.get("event_id"),
+                                            chunk.get("message")))
+        except RpcPeerLost:
+            # owning worker died: the client gets a clean EOF (it can
+            # reconnect with Last-Event-ID once a new worker claims the
+            # session) and the loss is COUNTED
+            _count("stream_lost")
+        except RpcAppError:
+            # owner answered but refused (session expired there between
+            # the lease check and the attach): clean EOF, client re-inits
+            _count("refused")
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            try:
+                await chunks.aclose()
+            except Exception:
+                pass
+        try:
+            await resp.write_eof()
+        except ConnectionResetError:
             pass
         return resp
 

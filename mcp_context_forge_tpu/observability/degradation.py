@@ -312,7 +312,8 @@ class OverloadShedder:
                  class_order: list[str] | None = None,
                  tenant_classes: dict[str, str] | None = None,
                  ledger: Any = None, degradation: DegradationManager | None = None,
-                 metrics: Any = None, enabled: bool = True) -> None:
+                 metrics: Any = None, enabled: bool = True,
+                 limiter: Any = None) -> None:
         self.enabled = enabled
         self.shed_at = min(max(float(shed_at), 0.0), 1.0)
         self.class_order = list(class_order or [])
@@ -320,6 +321,10 @@ class OverloadShedder:
         self.ledger = ledger
         self.degradation = degradation
         self.metrics = metrics
+        # DistributedTenantLimiter (coordination/ratelimit.py): when set,
+        # the quota verdict comes from the SHARED cross-worker window
+        # instead of this worker's ledger alone (decide_admission)
+        self.limiter = limiter
         self.shed_total = 0
         # llm.overload 'open' auto-expires: decide() only runs on
         # admission, so a burst followed by total idle must not read
@@ -337,6 +342,37 @@ class OverloadShedder:
             return None
         span = 1.0 - self.shed_at
         return self.shed_at + span * rank / max(1, len(self.class_order))
+
+    async def decide_admission(self, saturation: float, tenant: str = "",
+                               est_tokens: float = 1.0
+                               ) -> dict[str, Any] | None:
+        """Admission-path decide. Order matters: the sync :meth:`decide`
+        (saturation shed + the local ledger's own ratio floor — it sees
+        this worker's usage BEFORE the reconciliation interval publishes
+        it; both only ever under-admit) runs FIRST, so a request the
+        saturation ladder refuses never debits the tenant's distributed
+        grant — an overloaded hour must not also eat the quota window.
+        Only a locally-admitted request consults the SHARED cross-worker
+        window; its refusals carry the shared window's retry horizon, so
+        N workers enforce one budget, not N."""
+        verdict = self.decide(saturation, tenant)
+        if verdict is not None:
+            return verdict
+        if self.enabled and self.limiter is not None \
+                and self.limiter.enabled:
+            quota = await self.limiter.decide(tenant, est_tokens)
+            if quota is not None:
+                slo_class = self.class_for(tenant)
+                verdict = {"status": 429, "slo_class": slo_class, **quota}
+                self.shed_total += 1
+                if self.metrics is not None:
+                    try:
+                        self.metrics.gw_requests_shed.labels(
+                            slo_class=slo_class, reason="quota").inc()
+                    except Exception:
+                        pass
+                return verdict
+        return None
 
     def decide(self, saturation: float,
                tenant: str = "") -> dict[str, Any] | None:

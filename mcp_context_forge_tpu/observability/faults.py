@@ -60,6 +60,8 @@ logger = logging.getLogger(__name__)
 # fault_point() with one of these literals; anything else raises at arm
 # time and fails the non-vacuity gate at test time.
 FAULT_POINTS = (
+    "coordination.hub.rpc",   # coordination/rpc.py client send (scope =
+                              # method; corrupt = partition: frame dropped)
     "db.execute",             # db/core.py: every statement (scope = SQL)
     "engine.dispatch",        # engine.py dispatch loop (scope = replica id)
     "federation.peer.request",  # peer connect/call (scope = peer URL)

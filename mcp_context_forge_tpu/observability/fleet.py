@@ -1,0 +1,243 @@
+"""Per-worker metrics aggregation: fleet-wide truth from any worker.
+
+A multi-worker gateway (docs/scaleout.md) owns one PrometheusRegistry
+PER PROCESS — a scrape of one worker reports 1/N of the fleet's
+counters and a random worker's gauges, and ``/admin/slo`` judged only
+that worker's histogram slice. This module makes any worker able to
+answer for the fleet:
+
+- each worker periodically publishes its classic-text exposition on the
+  ``fleet.metrics`` bus topic (and caches its peers' latest frames,
+  expiring at ``stale_factor`` × interval — a dead worker's numbers age
+  out instead of haunting the aggregate);
+- ``render_fleet()`` merges the live frames: counters and histogram
+  ``_bucket``/``_sum``/``_count`` samples SUM across workers (additive
+  truth), gauges keep per-worker values under an added ``worker`` label
+  (a last-writer-wins merge would invent a fleet saturation that no
+  worker reported);
+- :class:`FleetMetricsView` exposes the merged samples through the same
+  ``.collect()`` duck-type the SLO evaluator reads, so
+  ``/admin/slo?scope=fleet`` evaluates objectives over the SUMMED
+  histogram state — fleet p95, not worker p95.
+
+The publisher rides the bus (no hub kv listing needed); with the memory
+bus there are no peers and the fleet view degenerates to the local one,
+which is exactly the single-worker truth.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+TOPIC = "fleet.metrics"
+
+_SUMMED_TYPES = {"counter", "histogram", "summary"}
+
+
+def _escape(value: str) -> str:
+    return (value.replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _labels_key(labels: dict[str, str]) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _parse(text: str) -> list:
+    from prometheus_client.parser import text_string_to_metric_families
+    try:
+        return list(text_string_to_metric_families(text))
+    except Exception:
+        logger.debug("fleet metrics: unparseable peer exposition",
+                     exc_info=True)
+        return []
+
+
+class FleetMetrics:
+    """Bus-published exposition frames + the merged fleet view."""
+
+    def __init__(self, bus: Any, worker_id: str, metrics: Any,
+                 interval_s: float = 2.0, stale_factor: float = 3.0) -> None:
+        self.bus = bus
+        self.worker_id = worker_id
+        self.metrics = metrics
+        self.interval_s = max(0.05, float(interval_s))
+        self.stale_factor = max(1.5, float(stale_factor))
+        self._peers: dict[str, tuple[float, str]] = {}
+        self._task: asyncio.Task | None = None
+        self._unsub = None
+
+    async def start(self) -> None:
+        if self._unsub is None:
+            self._unsub = self.bus.subscribe(TOPIC, self._on_frame)
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(
+                self._run(), name="fleet-metrics-publish")
+
+    async def stop(self) -> None:
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        if self._unsub is not None:
+            self._unsub()
+            self._unsub = None
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self.publish_once()
+            except Exception:
+                logger.exception("fleet metrics publish failed")
+            await asyncio.sleep(self.interval_s)
+
+    async def publish_once(self) -> None:
+        body, _ctype = self.metrics.render()
+        await self.bus.publish(TOPIC, {
+            "worker": self.worker_id, "ts": time.time(),
+            "text": body.decode()})
+
+    async def _on_frame(self, topic: str, message: dict[str, Any]) -> None:
+        worker = str(message.get("worker", ""))
+        if not worker or worker == self.worker_id:
+            return
+        self._peers[worker] = (float(message.get("ts") or time.time()),
+                               str(message.get("text") or ""))
+
+    def live_peers(self) -> dict[str, str]:
+        """worker -> exposition text, stale frames pruned."""
+        horizon = time.time() - self.interval_s * self.stale_factor
+        for worker, (ts, _text) in list(self._peers.items()):
+            if ts < horizon:
+                del self._peers[worker]
+        return {w: text for w, (ts, text) in self._peers.items()}
+
+    # -------------------------------------------------------------- merging
+
+    def _worker_families(self) -> list[tuple[str, list]]:
+        local_text = self.metrics.render()[0].decode()
+        frames = [(self.worker_id, local_text)]
+        frames += sorted(self.live_peers().items())
+        return [(worker, _parse(text)) for worker, text in frames]
+
+    def merged_samples(self, family_name: str
+                       ) -> tuple[str, list[tuple[str, dict, float]]]:
+        """(type, [(sample_name, labels, value)]) for one family summed
+        across workers — the SLO evaluator's fleet source."""
+        acc: dict[tuple, float] = {}
+        order: list[tuple[str, tuple]] = []
+        ftype = "counter"
+        for _worker, families in self._worker_families():
+            for family in families:
+                if family.name != family_name:
+                    continue
+                ftype = family.type
+                for sample in family.samples:
+                    key = (sample.name, _labels_key(sample.labels))
+                    if key not in acc:
+                        order.append(key)
+                        acc[key] = 0.0
+                    acc[key] += sample.value
+        return ftype, [(name, dict(labels), acc[(name, labels)])
+                       for name, labels in order]
+
+    def render_fleet(self) -> tuple[bytes, str]:
+        """Merged classic-text exposition: counters/histograms summed,
+        gauges per-worker under an added ``worker`` label."""
+        from prometheus_client import CONTENT_TYPE_LATEST
+        merged: dict[str, dict[str, Any]] = {}
+        for worker, families in self._worker_families():
+            for family in families:
+                entry = merged.setdefault(family.name, {
+                    "type": family.type,
+                    "documentation": family.documentation,
+                    "sums": {}, "order": [], "gauges": []})
+                if family.type in _SUMMED_TYPES:
+                    for sample in family.samples:
+                        key = (sample.name, _labels_key(sample.labels))
+                        if key not in entry["sums"]:
+                            entry["order"].append(key)
+                            entry["sums"][key] = 0.0
+                        entry["sums"][key] += sample.value
+                else:
+                    for sample in family.samples:
+                        entry["gauges"].append(
+                            (sample.name,
+                             {**sample.labels, "worker": worker},
+                             sample.value))
+        lines: list[str] = []
+        for name, entry in merged.items():
+            doc = entry["documentation"].replace("\\", r"\\") \
+                .replace("\n", r"\n")
+            lines.append(f"# HELP {name} {doc}")
+            lines.append(f"# TYPE {name} {entry['type']}")
+            if entry["type"] in _SUMMED_TYPES:
+                samples = [(key[0], dict(key[1]), entry["sums"][key])
+                           for key in entry["order"]]
+            else:
+                samples = entry["gauges"]
+            for sname, labels, value in samples:
+                if labels:
+                    body = ",".join(
+                        f'{k}="{_escape(str(v))}"'
+                        for k, v in sorted(labels.items()))
+                    lines.append(f"{sname}{{{body}}} {value}")
+                else:
+                    lines.append(f"{sname} {value}")
+        return ("\n".join(lines) + "\n").encode(), CONTENT_TYPE_LATEST
+
+    def stats(self) -> dict[str, Any]:
+        return {"worker_id": self.worker_id,
+                "peers": sorted(self.live_peers()),
+                "interval_s": self.interval_s}
+
+
+class _Sample:
+    __slots__ = ("name", "labels", "value")
+
+    def __init__(self, name: str, labels: dict, value: float) -> None:
+        self.name = name
+        self.labels = labels
+        self.value = value
+
+
+class _MergedFamily:
+    def __init__(self, samples: list[_Sample]) -> None:
+        self.samples = samples
+
+
+class _MergedMetric:
+    """collect() duck-type over the fleet-summed samples of one metric."""
+
+    def __init__(self, aggregator: FleetMetrics, family_name: str) -> None:
+        self._aggregator = aggregator
+        self._family_name = family_name
+
+    def collect(self):
+        _type, samples = self._aggregator.merged_samples(self._family_name)
+        return [_MergedFamily([_Sample(n, l, v) for n, l, v in samples])]
+
+
+class FleetMetricsView:
+    """PrometheusRegistry facade whose histogram attributes read the
+    fleet-summed samples — handed to a second SloEvaluator for
+    ``/admin/slo?scope=fleet``."""
+
+    def __init__(self, local_metrics: Any, aggregator: FleetMetrics) -> None:
+        self._local = local_metrics
+        self._aggregator = aggregator
+
+    def __getattr__(self, attr: str) -> Any:
+        metric = getattr(self._local, attr)
+        name = getattr(metric, "_name", None)
+        if name is None:
+            return metric
+        return _MergedMetric(self._aggregator, name)

@@ -400,6 +400,20 @@ class PrometheusRegistry:
             "the class's shed bar, quota = tenant window exhausted)",
             ["slo_class", "reason"], registry=self.registry,
         )
+        # --- multi-worker scale-out (docs/scaleout.md) ---
+        # cross-worker session handoff outcomes: an SSE stream or elicit
+        # request landing on a non-owning worker is relayed to the owner
+        # over the bus RPC seam (kind: stream|elicit); stream_lost
+        # counts relays terminated because the OWNING worker died
+        # mid-stream (clean EOF to the client, loss counted — never a
+        # hang), refused counts the 409 fallback when no owner answers
+        self.gw_session_handoffs = Counter(
+            "mcpforge_gw_session_handoffs_total",
+            "Cross-worker session handoffs by outcome (stream / elicit "
+            "served via the owning worker; stream_lost = owner died "
+            "mid-relay; refused = the 409 fallback)",
+            ["kind"], registry=self.registry,
+        )
         self.sessions_active = Gauge(
             "mcpforge_sessions_active", "Active MCP sessions", registry=self.registry,
         )

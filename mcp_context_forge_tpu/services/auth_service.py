@@ -87,6 +87,15 @@ class AuthService:
         # that must be IMMEDIATE (role grants, membership changes, user
         # toggles, password ops) call invalidate_user()/invalidate_jti().
         self._cache: dict[tuple, tuple[Any, float]] = {}
+        # basic-auth verification cache pepper: a successful argon2
+        # verify caches HMAC(pepper, password) so repeat requests within
+        # auth_cache_user_ttl do a constant-time digest compare instead
+        # of a ~1 s argon2 hash + a users-table WRITE per request — the
+        # phase-histogram-dominant "auth" cost on the chat/tools-call
+        # routes under per-user traffic. The pepper is random per
+        # process: the cached digest is useless outside this memory.
+        import os as _os
+        self._basic_pepper = _os.urandom(16)
         # strong refs to fire-and-forget notification tasks (the event
         # loop holds only weak ones)
         self._bg_tasks: set[Any] = set()
@@ -117,8 +126,14 @@ class AuthService:
     def invalidate_user(self, email: str) -> None:
         """Drop every cached fact about one identity — called by the
         paths whose effect must be visible on the NEXT request."""
-        for kind in ("user", "teams", "roles"):
+        for kind in ("user", "teams", "roles", "basic_ok"):
             self._cache.pop((kind, email), None)
+
+    def _password_digest(self, password: str) -> bytes:
+        import hashlib
+        import hmac as _hmac
+        return _hmac.new(self._basic_pepper, password.encode(),
+                         hashlib.sha256).digest()
 
     def invalidate_jti(self, jti: str) -> None:
         self._cache.pop(("jti", jti), None)
@@ -579,11 +594,47 @@ class AuthService:
                                permissions=set(PERMISSIONS), via="basic",
                                password_change_required=bool(
                                    row.get("password_change_required")))
-        if await self.verify_password(username, password):
-            row = await self.ctx.db.fetchone(
-                "SELECT is_admin, password_change_required FROM users"
-                " WHERE email=?", (username,))
-            is_admin = bool(row and row["is_admin"])
+        # hot path (flight-recorder "auth" phase, docs/scaleout.md
+        # satellite): one successful argon2 verify caches a peppered
+        # digest for auth_cache_user_ttl; repeats do a constant-time
+        # compare and skip BOTH the ~1 s KDF and the per-request
+        # failed-attempts/last_login users-table WRITE. Password changes,
+        # lockouts, and deactivation call invalidate_user(), so the
+        # staleness bound is the same TTL every other auth fact has.
+        cached_digest = self._cache_get(("basic_ok", username))
+        verified_from_cache = (
+            cached_digest is not None
+            and hmac.compare_digest(cached_digest,
+                                    self._password_digest(password)))
+        if not verified_from_cache:
+            verified = False
+            try:
+                verified = await self.verify_password(username, password)
+            finally:
+                if not verified:
+                    # ANY failed attempt (wrong password, lockout raise)
+                    # drops the fast path: the next correct login runs
+                    # the full verify, which resets failed_login_attempts
+                    # — cached successes must not let typo counters
+                    # accumulate into a surprise lockout, and a lockout
+                    # must not keep authenticating from a warm cache
+                    self._cache.pop(("basic_ok", username), None)
+        if verified_from_cache or verified:
+            if not verified_from_cache:
+                self._cache_put(("basic_ok", username),
+                                self._password_digest(password),
+                                settings.auth_cache_user_ttl)
+            row = self._cache_get(("user", username))
+            if row is None:
+                row = await self.ctx.db.fetchone(
+                    "SELECT is_admin, is_active, password_change_required,"
+                    " tokens_valid_after FROM users WHERE email=?",
+                    (username,)) or {}
+                self._cache_put(("user", username), row,
+                                settings.auth_cache_user_ttl)
+            if not row or not row.get("is_active", 1):
+                raise AuthError("Invalid credentials")
+            is_admin = bool(row.get("is_admin"))
             teams = await self.user_teams(username)
             perms = (set(PERMISSIONS) if is_admin
                      else set(DEFAULT_USER_PERMISSIONS)
@@ -591,7 +642,7 @@ class AuthService:
             return AuthContext(user=username, is_admin=is_admin,
                                teams=teams, permissions=perms, via="basic",
                                password_change_required=bool(
-                                   row and row["password_change_required"]))
+                                   row.get("password_change_required")))
         raise AuthError("Invalid credentials")
 
     async def _role_permissions(self, email: str,

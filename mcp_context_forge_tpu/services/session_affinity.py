@@ -31,10 +31,14 @@ HEARTBEAT_TTL = 15.0
 
 class SessionAffinityService:
     def __init__(self, ctx: AppContext,
-                 local_handler: Callable[[dict[str, Any]], Awaitable[dict[str, Any] | None]] | None = None):
+                 local_handler: Callable[[dict[str, Any]], Awaitable[dict[str, Any] | None]] | None = None,
+                 rpc: Any = None):
         self.ctx = ctx
         self.worker_id = ctx.worker_id
         self.local_handler = local_handler  # executes a forwarded request locally
+        # BusRpc (coordination/rpc.py): the elicit + SSE-stream handoff
+        # seam — set by app wiring when gw_session_handoff is on
+        self.rpc = rpc
         self._heartbeat_task: asyncio.Task | None = None
         self._pending: dict[str, asyncio.Future] = {}
         self._unsubs: list = []
@@ -119,6 +123,38 @@ class SessionAffinityService:
                               "message": "Owning worker did not respond"}}
         finally:
             self._pending.pop(corr, None)
+
+    async def remote_owner(self, session_id: str) -> str | None:
+        """The ALIVE remote owner of a session, or None when the session
+        is local/unowned or the owner's heartbeat lease is gone (a dead
+        owner's claim is broken so this worker can take over)."""
+        owner = await self.owner_of(session_id)
+        if owner is None or owner == self.worker_id:
+            return None
+        alive = await self.ctx.leases.holder(f"worker:{owner}")
+        if alive != owner:
+            await self.ctx.leases.force_release(f"session-owner:{session_id}")
+            return None
+        return owner
+
+    async def forward_elicit(self, session_id: str,
+                             payload: dict[str, Any],
+                             timeout: float = 130.0) -> dict[str, Any] | None:
+        """Serve an elicit request through the OWNING worker (the stream
+        lives there): returns the owner's elicitation result, or None
+        when no live remote owner exists / the handoff seam is down —
+        the caller falls back to the explicit 409."""
+        if self.rpc is None:
+            return None
+        owner = await self.remote_owner(session_id)
+        if owner is None:
+            return None
+        try:
+            return await self.rpc.call(owner, "session.elicit", {
+                "session_id": session_id, **payload},
+                timeout_s=timeout)
+        except ConnectionError:
+            return None
 
     async def _on_rpc(self, topic: str, payload: dict[str, Any]) -> None:
         if payload.get("to") != self.worker_id:

@@ -1,12 +1,21 @@
 """Multi-worker process supervisor (reference: gunicorn.config.py +
 run-gunicorn.sh — N workers per pod, restart on crash).
 
-Spawns N gateway worker processes on consecutive ports (a front LB — nginx
-/ k8s Service — spreads traffic), plus an embedded coordination hub the
-workers share for affinity/leader/bus. Crashed workers are restarted with
-exponential backoff; SIGTERM/SIGINT stop everything.
+Two socket layouts (docs/scaleout.md):
 
-Run: ``python -m mcp_context_forge_tpu.cli supervise --workers 2``
+- ``reuse_port=True`` (the scale-out default): every worker binds the
+  SAME ``base_port`` with ``SO_REUSEPORT`` — the kernel hashes incoming
+  connections across the workers' accept queues, no front LB needed.
+  One advertised port, N serving processes.
+- ``reuse_port=False`` (legacy): consecutive ports, an external LB
+  spreads traffic.
+
+Either way the supervisor runs an embedded coordination hub the workers
+share for affinity/leader/bus/RPC/limiter, stamps each worker with its
+index + fleet size (fleet metrics aggregation reads them), and restarts
+crashed workers with exponential backoff; SIGTERM/SIGINT stop everything.
+
+Run: ``python -m mcp_context_forge_tpu.cli supervise --workers 4``
 """
 
 from __future__ import annotations
@@ -25,13 +34,14 @@ logger = logging.getLogger(__name__)
 class Supervisor:
     def __init__(self, workers: int, host: str, base_port: int,
                  hub_port: int | None = None, env: dict | None = None,
-                 max_backoff: float = 30.0):
+                 max_backoff: float = 30.0, reuse_port: bool = True):
         self.workers = workers
         self.host = host
         self.base_port = base_port
         self.hub_port = hub_port
         self.env = env or {}
         self.max_backoff = max_backoff
+        self.reuse_port = reuse_port
         self._procs: dict[int, subprocess.Popen] = {}   # worker idx -> proc
         self._backoff: dict[int, float] = {}
         self._restart_at: dict[int, float] = {}  # idx -> earliest respawn time
@@ -50,12 +60,19 @@ class Supervisor:
             env["MCPFORGE_BUS_TCP_HOST"] = "127.0.0.1"
             env["MCPFORGE_BUS_TCP_PORT"] = str(self.hub_port)
         env["MCPFORGE_WORKER_INDEX"] = str(idx)
+        # fleet identity: metrics aggregation + bench captures read these
+        env["MCPFORGE_GW_WORKERS"] = str(self.workers)
+        if self.workers > 1:
+            env.setdefault("MCPFORGE_GW_FLEET_METRICS", "true")
+        if self.reuse_port:
+            env["MCPFORGE_GW_REUSE_PORT"] = "true"
         return env
 
     def _spawn_worker(self, idx: int) -> subprocess.Popen:
-        port = self.base_port + idx
-        logger.info("supervisor: starting worker %d on %s:%d", idx, self.host,
-                    port)
+        port = self.base_port if self.reuse_port else self.base_port + idx
+        logger.info("supervisor: starting worker %d on %s:%d%s", idx,
+                    self.host, port,
+                    " (SO_REUSEPORT)" if self.reuse_port else "")
         return subprocess.Popen(
             [sys.executable, "-m", "mcp_context_forge_tpu.cli", "serve",
              "--host", self.host, "--port", str(port)],

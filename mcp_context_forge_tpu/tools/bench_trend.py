@@ -123,25 +123,29 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
         return result
     result["latest"] = os.path.basename(payloads[-1][1])
     # partition by arm: captures self-describe their fused-K via the
-    # "superstep" field (absent/1 = the classic one-token step) and
-    # their tiered-prefix-cache mode via "prefix_tiers" — a K=8 arm's
-    # tok/s must only be judged against K=8 history, and a BENCH_PREFIX_
-    # TIERS capture's pressure workload only against tier history —
-    # comparing across arms would read the optimization win itself as an
-    # outlier baseline and every later plain capture as a regression
-    groups: dict[tuple[int, bool],
+    # "superstep" field (absent/1 = the classic one-token step), their
+    # tiered-prefix-cache mode via "prefix_tiers", and their gateway
+    # WORKER COUNT via "workers" (absent/1 = single asyncio worker) — a
+    # K=8 arm's tok/s must only be judged against K=8 history, a
+    # BENCH_PREFIX_TIERS capture's pressure workload only against tier
+    # history, and a 4-worker scenario round must never median against
+    # 1-worker history (the scale-out win would read every later
+    # single-worker capture as a regression, and vice versa)
+    groups: dict[tuple[int, bool, int],
                  list[tuple[int, str, dict[str, Any]]]] = {}
     for item in payloads:
         groups.setdefault((int(item[2].get("superstep") or 1),
-                           bool(item[2].get("prefix_tiers"))),
+                           bool(item[2].get("prefix_tiers")),
+                           int(item[2].get("workers") or 1)),
                           []).append(item)
-    for (k_steps, tiers), group in sorted(groups.items()):
+    for (k_steps, tiers, workers), group in sorted(groups.items()):
         if len(group) < 2:
             # a new arm's first capture has no history yet — surface it
             # (a silent zero-check pass would hide the round where the
             # fused path's numbers first land, the vacuous-pass class)
             result.setdefault("new_arms", []).append(
                 {"superstep": k_steps, "prefix_tiers": tiers,
+                 "workers": workers,
                  "capture": os.path.basename(group[-1][1])})
             continue
         latest_round, latest_path, latest = group[-1]
@@ -149,6 +153,8 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
         arm = "" if k_steps == 1 else f"@superstep={k_steps}"
         if tiers:
             arm += "@tiers"
+        if workers != 1:
+            arm += f"@workers={workers}"
         for key, higher_better in _GATES[latest.get("metric")]:
             latest_val = latest.get(key)
             prior = [p.get(key) for _rnd, _path, p in history
@@ -165,6 +171,7 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
             check = {
                 "metric": key,
                 "superstep": k_steps,
+                "workers": workers,
                 "latest": latest_val,
                 "latest_round": latest_round,
                 "baseline_median": baseline,
@@ -233,9 +240,11 @@ def main(argv: list[str] | None = None) -> int:
                 continue
             for arm in result.get("new_arms", ()):
                 tiers = "@tiers" if arm.get("prefix_tiers") else ""
+                wk = (f"@workers={arm['workers']}"
+                      if arm.get("workers", 1) != 1 else "")
                 print(f"bench-trend: {result['series']}"
-                      f"@superstep={arm['superstep']}{tiers}: first capture "
-                      f"({arm['capture']}) — no history to gate yet")
+                      f"@superstep={arm['superstep']}{tiers}{wk}: first "
+                      f"capture ({arm['capture']}) — no history to gate yet")
             for check in result["checks"]:
                 arrow = "REGRESSED" if check["regressed"] else "ok"
                 print(f"bench-trend: {result['series']} {check['metric']}: "

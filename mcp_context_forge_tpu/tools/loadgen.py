@@ -205,6 +205,64 @@ async def run_phase(client, auth, kinds: Sequence[RequestFn], *,
     return result
 
 
+async def run_phase_open(client, auth, kinds: Sequence[RequestFn], *,
+                         name: str, rate_rps: float, requests: int,
+                         max_in_flight: int = 10_000) -> PhaseResult:
+    """OPEN-loop phase: arrivals follow a fixed paced schedule (request
+    ``i`` is due at ``start + i/rate``) regardless of how slow the
+    responses are, and each latency is measured from the request's
+    SCHEDULED arrival — not from when a freed-up worker got around to
+    sending it. Closed-loop drivers under-report latency at saturation
+    (coordinated omission: a stalled server pauses the offered load
+    exactly when it is slowest); this is the arm the 10k-concurrent
+    burst scenario runs.
+
+    ``max_in_flight`` bounds concurrent sockets (fd safety). When the
+    bound is hit, the wait for a slot COUNTS toward the next request's
+    latency — a saturated server inflates the tail, as it should.
+    ``concurrency`` on the result records the PEAK in-flight depth
+    actually reached."""
+    rate = max(0.001, float(rate_rps))
+    result = PhaseResult(name=name, concurrency=0)
+    auth_for = auth if callable(auth) else (lambda _i: auth)
+    semaphore = asyncio.Semaphore(max(1, max_in_flight))
+    in_flight = 0
+    peak = 0
+
+    async def one(i: int, scheduled: float) -> None:
+        nonlocal in_flight, peak
+        async with semaphore:
+            in_flight += 1
+            peak = max(peak, in_flight)
+            kind = kinds[i % len(kinds)]
+            try:
+                ok, tag = await kind(client, auth_for(i), i)
+            except Exception as exc:
+                ok, tag = False, type(exc).__name__
+            finally:
+                in_flight -= 1
+        # latency from the SCHEDULED arrival: queueing the client did on
+        # the server's behalf is the server's latency, not omitted time
+        result.latencies_ms.append((time.monotonic() - scheduled) * 1e3)
+        result.requests += 1
+        if not ok:
+            result.failures += 1
+            result.errors[tag or "error"] += 1
+
+    start = time.monotonic()
+    tasks = []
+    for i in range(requests):
+        scheduled = start + i / rate
+        delay = scheduled - time.monotonic()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        tasks.append(asyncio.ensure_future(one(i, scheduled)))
+    await asyncio.gather(*tasks)
+    result.wall_s = time.monotonic() - start
+    result.concurrency = peak
+    return result
+
+
 async def run_phases(client, auth, kinds: Sequence[RequestFn],
                      phases: Sequence[tuple[str, int, int]]
                      ) -> dict[str, Any]:
@@ -240,17 +298,25 @@ class SloWindow:
     per tenant and closes them independently."""
 
     def __init__(self, client, name: str, auth,
-                 tenant: str | None = None) -> None:
+                 tenant: str | None = None,
+                 scope: str | None = None) -> None:
         self.client = client
         self.name = name
         self.auth = auth
         self.tenant = tenant
+        # scope="fleet": verdicts over the SUMMED cross-worker histogram
+        # state (multi-worker arms — docs/scaleout.md); the engine's
+        # TTFT samples live in the pool OWNER's registry, so a window
+        # opened on any other worker needs the fleet view to see them
+        self.scope = scope
 
     async def _evaluate(self) -> dict[str, Any]:
         url = f"/admin/slo?window={self.name}"
         if self.tenant:
             from urllib.parse import quote
             url += f"&tenant={quote(self.tenant)}"
+        if self.scope:
+            url += f"&scope={self.scope}"
         resp = await self.client.get(url, auth=self.auth)
         if resp.status != 200:
             raise RuntimeError(

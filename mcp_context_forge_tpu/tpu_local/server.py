@@ -63,19 +63,45 @@ def setup_llm_routes(app: web.Application, registry: LLMProviderRegistry,
                        "code": 503, "retry_after_s": retry_in}},
             status=503, headers=headers)
 
-    def _shed_response(request: web.Request) -> web.Response | None:
+    def _estimate_tokens(body: dict) -> float:
+        """Admission-time token estimate for the distributed limiter's
+        grant debit (~4 chars/token prompt heuristic + per-message chat
+        template overhead + the completion budget); the ledger
+        reconciliation squares it against actuals. Systematic
+        UNDER-estimation is the one direction that loosens the limiter's
+        bound (grants deplete slower than real consumption until the
+        next reconcile), so the template constant errs high."""
+        try:
+            messages = [m for m in body.get("messages", [])
+                        if isinstance(m, dict)]
+            prompt_chars = sum(len(str(m.get("content", "")))
+                               for m in messages)
+            # chat-template wrapping (role headers, BOS/EOT) costs real
+            # prompt tokens the content length cannot see
+            overhead = 8.0 + 6.0 * len(messages)
+            return (prompt_chars / 4.0 + overhead
+                    + float(body.get("max_tokens") or 16))
+        except Exception:
+            return 1.0
+
+    async def _shed_response(request: web.Request,
+                             body: dict | None = None
+                             ) -> web.Response | None:
         """Overload-shedding admission gate (observability/degradation.py,
         docs/resilience.md): consult the shedder with the live engine
         saturation + the request's tenant; a shed verdict becomes a 429
-        with Retry-After, lowest SLO class first."""
+        with Retry-After, lowest SLO class first. With the distributed
+        limiter wired (docs/scaleout.md), the quota half of the verdict
+        comes from the SHARED cross-worker window."""
         shedder = request.app.get("overload_shedder")
         if shedder is None:
             return None
         from ..gateway.flight_recorder import queue_state
         state = queue_state(request.app)
-        verdict = shedder.decide(
+        verdict = await shedder.decide_admission(
             (state or {}).get("saturation", 0.0),
-            request.get("tenant") or "")
+            request.get("tenant") or "",
+            est_tokens=_estimate_tokens(body or {}))
         if verdict is None:
             return None
         headers = {"Retry-After": str(verdict["retry_after_s"])}
@@ -95,9 +121,6 @@ def setup_llm_routes(app: web.Application, registry: LLMProviderRegistry,
     @routes.post(f"{prefix}/chat/completions")
     async def chat_completions(request: web.Request) -> web.StreamResponse:
         request["auth"].require("llm.chat")
-        shed = _shed_response(request)
-        if shed is not None:
-            return shed
         try:
             body = await request.json()
         except json.JSONDecodeError:
@@ -105,6 +128,9 @@ def setup_llm_routes(app: web.Application, registry: LLMProviderRegistry,
         if not isinstance(body.get("messages"), list) or not body["messages"]:
             return web.json_response(
                 {"error": {"message": "messages must be a non-empty list"}}, status=422)
+        shed = await _shed_response(request, body)
+        if shed is not None:
+            return shed
         span = current_span()  # the gateway's http.request span
         if span is not None:
             span.set_attribute("gen_ai.operation.name", "chat")

@@ -46,10 +46,76 @@ async def _wait_healthy(port: int, timeout: float = 40.0) -> None:
     raise TimeoutError(f"worker on :{port} not healthy")
 
 
+def _worker_env(tmp_path) -> dict:
+    return {
+        "JAX_PLATFORMS": "cpu",
+        "MCPFORGE_DATABASE_URL": f"sqlite:///{tmp_path}/sup.db",
+        "MCPFORGE_PLUGINS_ENABLED": "false",
+        "MCPFORGE_TPU_LOCAL_ENABLED": "false",
+        "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
+        "MCPFORGE_JWT_SECRET_KEY": "supervisor-test-jwt-0123456789abcd",
+        "MCPFORGE_AUTH_ENCRYPTION_SECRET": "supervisor-test-enc-0123456789",
+        "MCPFORGE_DEV_MODE": "true",
+        "MCPFORGE_ENVIRONMENT": "development",
+        "MCPFORGE_LOG_LEVEL": "WARNING",
+    }
+
+
+async def test_supervisor_reuse_port_one_socket_n_workers(tmp_path):
+    """The scale-out default (docs/scaleout.md): both workers bind ONE
+    port with SO_REUSEPORT; fresh connections spread across worker
+    processes, and killing one worker leaves the port serving while the
+    supervisor revives it."""
+    base = _free_port_block(1)
+    supervisor = Supervisor(
+        workers=2, host="127.0.0.1", base_port=base, hub_port=base - 1,
+        env=_worker_env(tmp_path))
+    assert supervisor.reuse_port  # the default layout
+    supervisor.start()
+    try:
+        await _wait_healthy(base)
+        # fresh connections (no keep-alive reuse) land on BOTH workers:
+        # flight-recorder rows self-identify the serving process
+        auth = aiohttp.BasicAuth("admin", "changeme")
+        workers_seen = set()
+        deadline = time.monotonic() + 40
+        while len(workers_seen) < 2 and time.monotonic() < deadline:
+            async with aiohttp.ClientSession(
+                    connector=aiohttp.TCPConnector(force_close=True)) as s:
+                resp = await s.get(
+                    f"http://127.0.0.1:{base}/admin/gateway/requests",
+                    auth=auth)
+                if resp.status == 200:
+                    worker = (await resp.json()).get("worker")
+                    if worker:
+                        workers_seen.add(worker)
+        assert len(workers_seen) == 2, (
+            f"SO_REUSEPORT never spread connections: {workers_seen}")
+
+        # kill one worker: the shared socket keeps serving (the kernel
+        # stops handing the dead worker connections) and the supervisor
+        # revives it
+        victim = supervisor._procs[0]
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=10)
+        await _wait_healthy(base)
+        for _ in range(30):
+            supervisor.reap_once()
+            if supervisor._procs[0].poll() is None and \
+                    supervisor._procs[0].pid != victim.pid:
+                break
+            await asyncio.sleep(0.2)
+        assert supervisor._procs[0].pid != victim.pid
+        await _wait_healthy(base)
+    finally:
+        supervisor.stop()
+
+
 async def test_supervisor_spawns_and_restarts(tmp_path):
     base = _free_port_block(2)
     supervisor = Supervisor(
         workers=2, host="127.0.0.1", base_port=base, hub_port=base - 1,
+        reuse_port=False,  # the legacy port-per-worker layout
         env={
             "JAX_PLATFORMS": "cpu",
             "MCPFORGE_DATABASE_URL": f"sqlite:///{tmp_path}/sup.db",

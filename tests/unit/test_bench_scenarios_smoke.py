@@ -173,6 +173,74 @@ def test_chaos_matrix_fault_scenarios_smoke(scenario_env, monkeypatch):
         assert payload["value"] > 0
 
 
+def test_workers_scenario_cpu_smoke(scenario_env, monkeypatch):
+    """Multi-worker scale-out arm at workers=2 (docs/scaleout.md): two
+    in-process gateway workers over one hub with the SHARED engine plane
+    — open-loop single-vs-fleet throughput, byte-identical SSE handoff,
+    owner-death mid-stream terminating cleanly with counted loss, and
+    leader failover rebuilding the pool on the survivor."""
+    monkeypatch.setenv("BENCH_SCENARIO_ONLY", "workers")
+    monkeypatch.setenv("BENCH_GW_WORKERS", "2")
+    import bench_gateway_scenarios as bgs
+
+    report = asyncio.run(bgs.run_scenarios("cpu"))
+    assert report["ok"], report["problems"]
+    workers = report["scenarios"]["workers"]
+    assert workers["workers"] == 2
+    assert workers["failures"] == 0
+    assert workers["single_worker"]["rps"] > 0
+    assert workers["fleet"]["rps"] > 0
+    assert workers["scaleup"] > 0
+    handoff = workers["handoff"]
+    assert handoff["byte_identical"] is True, handoff
+    assert handoff["hang"] is False
+    assert handoff["loss_counted"] is True
+    assert workers["leader_failover"]["ok"] is True
+    # fleet-scope SLO window: TTFT lives in the pool OWNER's registry
+    # and must still be MEASURED through /admin/slo?scope=fleet
+    assert workers["slo"]["objectives"]["ttft_p95"]["window_samples"] > 0
+    names = report["captures_written"]
+    assert names == ["BENCH_SCENARIO_WORKERS_r01.json"]
+    with open(scenario_env / names[0]) as fh:
+        payload = json.load(fh)
+    assert payload["workers"] == 2  # the bench_trend arm partition key
+
+
+def test_bench_trend_partitions_worker_arms(tmp_path):
+    """A 4-worker round must NOT median against 1-worker history: the
+    scale-out win would read every later single-worker capture as a
+    regression (and the first multi-worker round as an outlier)."""
+    from mcp_context_forge_tpu.tools.bench_trend import run_check
+
+    def write(round_n, value, workers=None):
+        payload = {"metric": "gateway_scenario_slo", "scenario": "burst",
+                   "value": value, "p95_ms": 50.0, "unit": "req/s"}
+        if workers is not None:
+            payload["workers"] = workers
+        (tmp_path / f"BENCH_SCENARIO_BURST_r{round_n:02d}.json").write_text(
+            json.dumps(payload))
+
+    write(1, 100.0)
+    write(2, 104.0)
+    # first 4-worker round: 3.5x the single-worker history — must be a
+    # NEW ARM, not an outlier judged against workers=1 medians
+    write(3, 350.0, workers=4)
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert report["ok"], report["regressions"]
+    series = report["series"][0]
+    assert any(arm.get("workers") == 4
+               for arm in series.get("new_arms", []))
+    # second 4-worker round compares against 4-worker history only
+    write(4, 340.0, workers=4)
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert report["ok"], report["regressions"]
+    # a collapsed 4-worker round fails ITS arm
+    write(5, 90.0, workers=4)
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert not report["ok"]
+    assert any("workers=4" in line for line in report["regressions"])
+
+
 def test_zero_scenario_run_is_not_a_pass(scenario_env, monkeypatch):
     """PR-6's no-vacuous-pass rule: a run that produced no captures must
     not report ok (main() exits 2 on an empty scenario set)."""

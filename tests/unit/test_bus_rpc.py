@@ -1,0 +1,188 @@
+"""Cross-worker RPC seam (coordination/rpc.py): unary + streaming calls
+between bus-addressed workers, ordered chunk delivery, app-error
+propagation, the ``coordination.hub.rpc`` fault point (error / latency /
+partition), and the dead-peer liveness contract (a worker dying
+mid-stream terminates its consumers cleanly — never a hang)."""
+
+import asyncio
+
+import pytest
+
+from mcp_context_forge_tpu.coordination.bus import MemoryEventBus
+from mcp_context_forge_tpu.coordination.rpc import (BusRpc, RpcAppError,
+                                                    RpcError, RpcPeerLost)
+from mcp_context_forge_tpu.observability.faults import (FaultRule,
+                                                        configure_fault_plane)
+
+
+class _Leases:
+    """Lease stub: name -> holder."""
+
+    def __init__(self):
+        self.holders = {}
+
+    async def holder(self, name):
+        return self.holders.get(name)
+
+
+async def _pair(leases=None):
+    bus = MemoryEventBus()
+    a = BusRpc(bus, "worker-a", leases=leases, default_timeout_s=2.0,
+               idle_timeout_s=0.3)
+    b = BusRpc(bus, "worker-b", leases=leases, default_timeout_s=2.0,
+               idle_timeout_s=0.3)
+    await a.start()
+    await b.start()
+    return a, b
+
+
+async def _echo(params):
+    return {"got": params.get("x", "ok")} if "x" in params else "ok"
+
+
+async def test_unary_call_roundtrip_and_app_error():
+    a, b = await _pair()
+    b.register("echo", _echo)
+
+    async def boom(params):
+        raise ValueError("kaboom")
+
+    b.register("boom", boom)
+    assert await a.call("worker-b", "echo", {"x": 41}) == {"got": 41}
+    with pytest.raises(RpcAppError, match="ValueError: kaboom"):
+        await a.call("worker-b", "boom", {})
+    with pytest.raises(RpcAppError, match="unknown rpc method"):
+        await a.call("worker-b", "nope", {})
+    await a.stop()
+    await b.stop()
+
+
+async def test_stream_ordered_chunks_and_end_error():
+    a, b = await _pair()
+
+    async def counter(params):
+        for i in range(int(params["n"])):
+            yield {"i": i}
+
+    async def broken(params):
+        yield {"i": 0}
+        raise RuntimeError("mid-stream failure")
+
+    b.register_stream("count", counter)
+    b.register_stream("broken", broken)
+    got = [c["i"] async for c in a.call_stream("worker-b", "count",
+                                               {"n": 5})]
+    assert got == [0, 1, 2, 3, 4]
+    with pytest.raises(RpcAppError, match="mid-stream failure"):
+        async for _chunk in a.call_stream("worker-b", "broken", {}):
+            pass
+    await a.stop()
+    await b.stop()
+
+
+async def test_dead_peer_stream_terminates_cleanly_not_hangs():
+    """The chaos contract: a stream whose serving worker dies must end
+    with RpcPeerLost inside the liveness bound, never hang."""
+    leases = _Leases()
+    leases.holders["worker:worker-b"] = "worker-b"
+    a, b = await _pair(leases)
+
+    async def stall(params):
+        yield {"i": 0}
+        await asyncio.sleep(60)  # worker "dies" while the client waits
+        yield {"i": 1}
+
+    b.register_stream("stall", stall)
+    chunks = a.call_stream("worker-b", "stall", {})
+    assert (await chunks.__anext__())["i"] == 0
+    leases.holders.pop("worker:worker-b")  # heartbeat lease expires
+    with pytest.raises(RpcPeerLost):
+        await asyncio.wait_for(chunks.__anext__(), timeout=5.0)
+    await a.stop()
+    await b.stop()
+
+
+async def test_dead_peer_unary_raises_peer_lost():
+    leases = _Leases()  # worker-b never heartbeats
+    bus = MemoryEventBus()
+    a = BusRpc(bus, "worker-a", leases=leases, default_timeout_s=0.2)
+    await a.start()
+    with pytest.raises(RpcPeerLost):
+        await a.call("worker-b", "echo", {})
+    await a.stop()
+
+
+async def test_fault_point_error_latency_and_partition():
+    """coordination.hub.rpc: error raises a transport-shaped failure,
+    latency delays the send, corrupt models a PARTITION — the request
+    frame is dropped and the caller walks the timeout path."""
+    import time
+
+    plane = configure_fault_plane(True)
+    try:
+        leases = _Leases()
+        leases.holders["worker:worker-b"] = "worker-b"
+        a, b = await _pair(leases)
+        b.register("echo", _echo)
+
+        plane.arm(FaultRule(point="coordination.hub.rpc", kind="error"))
+        with pytest.raises(ConnectionError):
+            await a.call("worker-b", "echo", {})
+        plane.arm(FaultRule(point="coordination.hub.rpc", kind="latency",
+                            latency_ms=50.0))
+        started = time.monotonic()
+        assert await a.call("worker-b", "echo", {}) == "ok"
+        assert time.monotonic() - started >= 0.05
+        # partition: the frame never leaves this worker; the peer is
+        # alive, so the caller times out with RpcError (not PeerLost)
+        plane.arm(FaultRule(point="coordination.hub.rpc", kind="corrupt"))
+        with pytest.raises(RpcError):
+            await a.call("worker-b", "echo", {}, timeout_s=0.2)
+        plane.disarm("coordination.hub.rpc")
+        assert await a.call("worker-b", "echo", {}) == "ok"
+        await a.stop()
+        await b.stop()
+    finally:
+        configure_fault_plane(False)
+
+
+async def test_fault_scope_filters_by_method():
+    plane = configure_fault_plane(True)
+    try:
+        a, b = await _pair()
+        b.register("safe", _echo)
+        b.register("hit", _echo)
+        plane.arm(FaultRule(point="coordination.hub.rpc", kind="error",
+                            scope="hit"))
+        assert await a.call("worker-b", "safe", {}) == "ok"
+        with pytest.raises(ConnectionError):
+            await a.call("worker-b", "hit", {})
+        await a.stop()
+        await b.stop()
+    finally:
+        configure_fault_plane(False)
+
+
+async def test_stream_cancel_stops_server_task():
+    a, b = await _pair()
+    cancelled = asyncio.Event()
+
+    async def endless(params):
+        try:
+            i = 0
+            while True:
+                yield {"i": i}
+                i += 1
+                await asyncio.sleep(0)
+        except asyncio.CancelledError:
+            cancelled.set()
+            raise
+
+    b.register_stream("endless", endless)
+    chunks = a.call_stream("worker-b", "endless", {})
+    assert (await chunks.__anext__())["i"] == 0
+    await chunks.aclose()  # consumer walks away -> cancel frame
+    await asyncio.wait_for(cancelled.wait(), timeout=2.0)
+    assert not b._serving  # relay task reaped
+    await a.stop()
+    await b.stop()

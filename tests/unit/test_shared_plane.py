@@ -1,0 +1,192 @@
+"""Shared engine plane (tpu_local/pool_rpc.py): leader-elected pool
+ownership over the coordination leases, RPC-forwarded chat/stream from
+non-owning workers (tenant attribution riding along), LLMUnavailable
+503-shaped refusals during failover, and leader failover itself — the
+owner dies, a survivor re-elects, builds the pool, and serves."""
+
+import asyncio
+
+import pytest
+
+from mcp_context_forge_tpu.coordination.bus import MemoryEventBus
+from mcp_context_forge_tpu.coordination.leases import MemoryLeaseManager
+from mcp_context_forge_tpu.coordination.rpc import BusRpc
+from mcp_context_forge_tpu.observability import tenant as tenant_ctx
+from mcp_context_forge_tpu.tpu_local.pool_rpc import (LEASE_NAME,
+                                                      SharedEnginePlane,
+                                                      SharedPoolProvider)
+from mcp_context_forge_tpu.tpu_local.provider import (LLMError,
+                                                      LLMUnavailable)
+
+
+class FakeProvider:
+    """Engine-pool stand-in recording who served what."""
+
+    def __init__(self, name):
+        self.name = name
+        self.chats = []
+        self.tenants = []
+        self.shutdowns = 0
+
+    async def chat(self, request):
+        self.chats.append(request)
+        self.tenants.append(tenant_ctx.current_tenant())
+        return {"id": "c1", "served_by": self.name,
+                "choices": [{"message": {"content": "hi"}}]}
+
+    async def chat_stream(self, request):
+        self.tenants.append(tenant_ctx.current_tenant())
+        for i in range(3):
+            yield {"served_by": self.name, "i": i}
+
+    async def embed(self, texts, model=None):
+        return [[0.0] * 3 for _ in texts]
+
+    async def classify(self, texts):
+        return [0.1 for _ in texts]
+
+    async def models(self):
+        return ["fake"]
+
+    async def shutdown(self):
+        self.shutdowns += 1
+
+
+async def _plane(rpc, leases, worker_id, providers, ttl=0.4):
+    provider = FakeProvider(worker_id)
+
+    async def factory():
+        providers[worker_id] = provider
+        return provider
+
+    plane = SharedEnginePlane(rpc, leases, worker_id, factory,
+                              lease_ttl=ttl, rpc_timeout_s=5.0,
+                              stream_idle_timeout_s=0.5)
+    await plane.start()
+    return plane
+
+
+async def _settle(planes, timeout=5.0):
+    """Wait until exactly one plane owns a BUILT pool."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        owners = [p for p in planes if p.ready_local]
+        if owners:
+            return owners[0]
+        await asyncio.sleep(0.02)
+    raise AssertionError("no plane ever built the pool")
+
+
+async def test_one_owner_serves_remote_workers_with_tenant():
+    bus = MemoryEventBus()
+    leases = MemoryLeaseManager()
+    providers = {}
+    rpcs = [BusRpc(bus, f"w{i}", leases=leases) for i in range(3)]
+    for rpc in rpcs:
+        await rpc.start()
+    planes = [await _plane(rpcs[i], leases, f"w{i}", providers)
+              for i in range(3)]
+    try:
+        owner = await _settle(planes)
+        non_owners = [p for p in planes if p is not owner]
+        assert len(providers) == 1, "only the OWNER builds HBM state"
+
+        token = tenant_ctx.set_current_tenant("team:alpha")
+        try:
+            result = await non_owners[0].chat({"model": "fake"})
+        finally:
+            tenant_ctx.reset_current_tenant(token)
+        assert result["served_by"] == owner.worker_id
+        # tenant attribution crossed the RPC seam to the owner's ledger
+        assert providers[owner.worker_id].tenants[-1] == "team:alpha"
+
+        chunks = [c async for c in non_owners[1].chat_stream({"m": 1})]
+        assert [c["i"] for c in chunks] == [0, 1, 2]
+        assert chunks[0]["served_by"] == owner.worker_id
+
+        assert await non_owners[0].embed(["x", "y"]) == [[0.0] * 3] * 2
+        assert await non_owners[0].classify(["x"]) == [0.1]
+    finally:
+        for plane in planes:
+            await plane.stop()
+        for rpc in rpcs:
+            await rpc.stop()
+
+
+async def test_leader_failover_survivor_rebuilds_and_serves():
+    """Kill the pool-owning worker: the lease expires, a survivor
+    re-elects, builds its OWN pool, and requests flow again; the window
+    in between refuses with LLMUnavailable (503 + Retry-After shape)."""
+    bus = MemoryEventBus()
+    leases = MemoryLeaseManager()
+    providers = {}
+    rpcs = [BusRpc(bus, f"w{i}", leases=leases) for i in range(2)]
+    for rpc in rpcs:
+        await rpc.start()
+    planes = [await _plane(rpcs[i], leases, f"w{i}", providers, ttl=0.3)
+              for i in range(2)]
+    try:
+        owner = await _settle(planes)
+        survivor = next(p for p in planes if p is not owner)
+        assert (await survivor.chat({}))["served_by"] == owner.worker_id
+
+        # the owner dies: its rpc seam goes silent and its lease expires
+        await owner.stop()
+        await rpcs[planes.index(owner)].stop()
+
+        new_owner = await _settle([survivor], timeout=8.0)
+        assert new_owner is survivor
+        assert survivor.elections_won >= 1
+        assert len(providers) == 2, "survivor built a fresh pool"
+        result = await survivor.chat({})
+        assert result["served_by"] == survivor.worker_id
+    finally:
+        for plane in planes:
+            await plane.stop()
+        for rpc in rpcs:
+            await rpc.stop()
+
+
+async def test_no_owner_refuses_with_retry_after():
+    bus = MemoryEventBus()
+    leases = MemoryLeaseManager()
+    rpc = BusRpc(bus, "w0", leases=leases)
+    await rpc.start()
+
+    async def never_factory():
+        raise AssertionError("must not build")
+
+    plane = SharedEnginePlane(rpc, leases, "w0", never_factory,
+                              lease_ttl=0.2)
+    # plane NOT started: no elector, no owner anywhere
+    with pytest.raises(LLMUnavailable) as excinfo:
+        await plane.chat({})
+    assert excinfo.value.retry_after_s >= 1
+    await rpc.stop()
+
+
+async def test_provider_facade_and_remote_app_errors():
+    bus = MemoryEventBus()
+    leases = MemoryLeaseManager()
+    providers = {}
+    rpcs = [BusRpc(bus, f"w{i}", leases=leases) for i in range(2)]
+    for rpc in rpcs:
+        await rpc.start()
+    planes = [await _plane(rpcs[i], leases, f"w{i}", providers)
+              for i in range(2)]
+    try:
+        owner = await _settle(planes)
+        remote = next(p for p in planes if p is not owner)
+
+        async def bad_chat(request):
+            raise LLMError("model 'nope' is not served")
+
+        providers[owner.worker_id].chat = bad_chat
+        facade = SharedPoolProvider("tpu_local", remote)
+        with pytest.raises(LLMError, match="not served"):
+            await facade.chat({"model": "nope"})
+    finally:
+        for plane in planes:
+            await plane.stop()
+        for rpc in rpcs:
+            await rpc.stop()
