@@ -53,6 +53,14 @@ p95, tok/s, and greedy token parity across arms. The acceptance bar:
 the tiers-on arm's prefix_hit_tokens >= 2x the off arm's at the same
 page budget.
 
+BENCH_CONTROLLER=1 runs the closed-loop serving-controller A/B
+(docs/controller.md): the same phase-shifting greedy load (interactive
+-> batch -> burst) served with a frozen config vs with the
+ServingController steering superstep K over a warmed ladder. Reports
+per-arm tok/s + TTFT p95, the decision counts, and greedy token parity
+(must be 1.0 — K only moves at drain barriers) with zero serving-stage
+XLA compiles.
+
 Platform: probed in a subprocess (a wedged TPU runtime cannot hang the
 bench — round-1 failure mode); BENCH_PLATFORM overrides.
 """
@@ -413,6 +421,130 @@ def _parity_rate(base_streams, arm_streams) -> float:
     return round(matched / max(1, positions), 4)
 
 
+async def _run_controller_arm(platform: str, controlled: bool) -> dict:
+    """One arm of the BENCH_CONTROLLER A/B: identical greedy phase-
+    shifting load (interactive-heavy -> batch-heavy -> interactive
+    burst), served either by a frozen config (controlled=False) or with
+    the closed-loop ServingController steering superstep K over a
+    warmed ladder (docs/controller.md). Parity must be 1.0 — K moves
+    only at drain barriers — and serving-stage XLA compiles must stay 0
+    because every ladder rung was warmed up front."""
+    from mcp_context_forge_tpu.observability.signals import SignalBus
+    from mcp_context_forge_tpu.tpu_local.controller import ServingController
+    from mcp_context_forge_tpu.tpu_local.engine import EngineConfig, TPUEngine
+
+    model = os.environ.get(
+        "BENCH_MODEL", "llama3-1b" if platform == "tpu" else "llama3-tiny")
+    clients = int(os.environ.get("BENCH_CLIENTS", "8"))
+    max_tokens = int(os.environ.get("BENCH_TOKENS", "32"))
+    raw_k = os.environ.get("BENCH_SUPERSTEP", "8").split(",")[0]
+    base_k = max(1, int(raw_k or "8"))
+    ladder = tuple(sorted({1, max(1, base_k // 2), base_k}))
+    config = EngineConfig(
+        model=model, max_batch=min(clients, 16), max_seq_len=512,
+        page_size=16, num_pages=1024, prefill_buckets=(64,),
+        dtype="bfloat16" if platform == "tpu" else "float32",
+        attn_impl="auto", superstep=base_k,
+        k_ladder=ladder if controlled else (),
+        compile_cache_dir=os.environ.get(
+            "MCPFORGE_TPU_LOCAL_COMPILE_CACHE_DIR",
+            "/tmp/mcpforge-xla-cache"))
+    bus = SignalBus()
+    engine = TPUEngine(config, signals=bus if controlled else None)
+    await engine.start()
+    controller = None
+    try:
+        await asyncio.to_thread(
+            engine.warmup,
+            os.environ.get("BENCH_WARMUP",
+                           "fast" if platform == "tpu" else "full"))
+        prompt = engine.tokenizer.encode(
+            "benchmark prompt for decode throughput")
+        async for _ in engine.generate(prompt, max_tokens=4):
+            pass  # primes the dispatch loop end-to-end (already compiled)
+        if controlled:
+            # bench-cadence control loop: same ladders as production,
+            # compressed timing so decisions can land inside the run
+            controller = ServingController(
+                bus, lambda: [engine],
+                tick_s=0.05, cooldown_s=0.25, eval_window_s=0.25,
+                queue_wait_high_ms=25.0, queue_wait_low_ms=2.0,
+                idle_frac_high=0.05)
+            await controller.start()
+
+        async def stream(n_tokens: int) -> tuple[list[int], float | None]:
+            toks: list[int] = []
+            first = None
+            t0 = time.monotonic()
+            async for tok in engine.generate(prompt, max_tokens=n_tokens):
+                if first is None:
+                    first = (time.monotonic() - t0) * 1000
+                toks.append(tok)
+            return toks, first
+
+        streams: list[list[int]] = []
+        ttfts: list[float] = []
+
+        async def phase(reqs: int, n_tokens: int) -> None:
+            res = await asyncio.gather(*[stream(n_tokens)
+                                         for _ in range(reqs)])
+            for toks, first in res:
+                streams.append(toks)
+                if first is not None:
+                    ttfts.append(first)
+
+        started = time.monotonic()
+        await phase(clients, 8)            # interactive-heavy
+        await phase(clients, 8)
+        await phase(clients, max_tokens)   # batch-heavy
+        await phase(clients, 8)            # interactive burst again
+        wall = time.monotonic() - started
+        total = sum(len(s) for s in streams)
+        ttfts.sort()
+        arm = {
+            "controlled": controlled,
+            "value": round(total / wall, 2) if wall else 0.0,
+            "tokens": total,
+            "wall_s": round(wall, 3),
+            "superstep_base": base_k,
+            "ttft_p95_ms": (round(ttfts[int(len(ttfts) * 0.95)], 2)
+                            if ttfts else None),
+            "xla_compiles": {k: v for k, v in engine.compile_stats().items()
+                             if k != "recent"},
+            "token_streams": streams,
+        }
+        if controlled:
+            arm["k_ladder"] = list(ladder)
+            arm["knob_state"] = engine.knob_state()
+            decisions = controller.decisions(limit=256)
+            arm["decisions"] = len(decisions)
+            arm["decisions_by_knob"] = {}
+            for d in decisions:
+                key = f"{d['knob']}:{d['direction']}"
+                arm["decisions_by_knob"][key] = (
+                    arm["decisions_by_knob"].get(key, 0) + 1)
+        return arm
+    finally:
+        if controller is not None:
+            await controller.stop()
+        await engine.stop()
+
+
+def run_controller_ab(platform: str) -> dict:
+    """The BENCH_CONTROLLER A/B block: frozen config vs closed-loop
+    controller on the SAME phase-shifting greedy load. Parity is greedy
+    and must be 1.0 (K changes land only at drain barriers)."""
+    off = asyncio.run(_run_controller_arm(platform, controlled=False))
+    on = asyncio.run(_run_controller_arm(platform, controlled=True))
+    base_streams = off.pop("token_streams")
+    on_streams = on.pop("token_streams")
+    return {
+        "off": off,
+        "on": on,
+        "token_parity_rate": _parity_rate(base_streams, on_streams),
+    }
+
+
 def _superstep_sweep() -> list[int]:
     """K values of a BENCH_SUPERSTEP sweep ('1,4,8,16'); empty for a
     single/unset value (which run() consumes directly)."""
@@ -468,6 +600,13 @@ def main() -> dict:
                 3),
             "token_parity_rate": _parity_rate(base_streams, arm_streams),
         }
+    if os.environ.get("BENCH_CONTROLLER", "0") == "1":
+        # closed-loop controller A/B (docs/controller.md): frozen config
+        # vs adaptive-K under a phase-shifting load. The capture self-
+        # describes as a controller arm so bench_trend partitions it
+        # away from static-K history.
+        out["controller"] = True
+        out["controller_ab"] = run_controller_ab(platform)
     if os.environ.get("BENCH_PREFIX_TIERS", "0") == "1":
         # tiered prefix cache A/B: shared-prefix workload at a FIXED
         # small HBM page budget — tiers off drops evicted templates,

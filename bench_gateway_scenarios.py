@@ -34,6 +34,13 @@ ladder actually engaging:
   queue — the batch SLO class sheds with 429 + Retry-After while the
   premium class is admitted and holds its targets.
 
+The **controller** scenario (docs/controller.md) A/Bs the closed-loop
+serving controller against a frozen config on a phase-shifting load
+(interactive-heavy -> batch-heavy -> interactive burst): the decision
+audit ring must populate, every row must carry the signals-in/knob-
+delta/actuated schema, the mcpforge_controller_* metrics must move,
+and the warmed K ladder must mean zero serving-stage XLA compiles.
+
 Each scenario evaluates TTFT/TPOT/queue-wait/http-phase SLOs through
 ``GET /admin/slo`` per-consumer delta windows (its own named window, so
 nothing shreds the deltas) and writes a ``BENCH_SCENARIO_<NAME>_r<N>.json``
@@ -74,7 +81,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)) or ".")
 # builds rebind the process-global fault plane + degradation manager to
 # THEIR app (see _rebind_resilience_plane).
 SCENARIOS = ("burst", "ramp", "mixed", "tenant", "db-outage",
-             "tier-fault", "overload-shed", "chaos", "workers")
+             "tier-fault", "overload-shed", "controller", "chaos",
+             "workers")
 
 
 def _smoke() -> bool:
@@ -98,6 +106,7 @@ def _scale() -> dict:
                 "tier_concurrency": 3,
                 "shed_requests": 16, "shed_concurrency": 6,
                 "shed_latency_ms": 30.0,
+                "controller_requests": 12, "controller_concurrency": 4,
                 "burst_open_rate": 60.0, "burst_open_requests": 30,
                 "burst_open_inflight": 64,
                 "workers_rate": 40.0, "workers_requests": 24,
@@ -116,6 +125,7 @@ def _scale() -> dict:
             "tier_concurrency": 6,
             "shed_requests": 48, "shed_concurrency": 10,
             "shed_latency_ms": 40.0,
+            "controller_requests": 36, "controller_concurrency": 8,
             # open-loop burst arm (coordinated-omission-free): offered
             # rate is deliberately tunable ABOVE capacity so in-flight
             # climbs toward the 10k-connection bound during the arm
@@ -937,6 +947,209 @@ async def scenario_overload_shed(app, client, auth, model, scale,
             pass
 
 
+async def scenario_controller(app, client, auth, model, scale,
+                              platform) -> dict:
+    """Closed-loop serving controller under a phase-shifting load
+    (docs/controller.md). Two dedicated single-replica gateways serve
+    the SAME interactive-heavy -> batch-heavy -> interactive-burst
+    script: one with a frozen config (controller off), one with
+    MCPFORGE_CONTROLLER_ENABLED=true and a warmed superstep ladder plus
+    bench-compressed tick/cooldown/thresholds so decisions can land
+    inside the run. Gates: zero request failures in both arms, the off
+    arm's /admin/controller 404s, the on arm's decision ring is
+    populated (the loop actually closed), every ring row carries the
+    audit schema (signals in, knob delta, actuated), the
+    mcpforge_controller_* metrics moved, and the warmed ladder means
+    ZERO serving-stage XLA compiles. The off/on throughput + latency
+    comparison is recorded (not gated — CPU smoke noise would flake a
+    perf delta)."""
+    from aiohttp import BasicAuth
+
+    from mcp_context_forge_tpu.tools.loadgen import (SloWindow, chat_kind,
+                                                     probe_slowest_trace,
+                                                     run_phase)
+    base_k = "4" if _smoke() else "8"
+    ctrl_env = {
+        "MCPFORGE_TPU_LOCAL_REPLICAS": "1",
+        "MCPFORGE_TPU_LOCAL_MAX_BATCH": "4",
+        "MCPFORGE_TPU_LOCAL_MAX_QUEUE": "32",
+        "MCPFORGE_TPU_LOCAL_SUPERSTEP": base_k,
+        # the ladder must be WARMED at boot: adaptive K may only ever
+        # move between precompiled rungs (zero mid-traffic compiles).
+        # Mode "full", not "fast": fast trims intermediate prefill
+        # admission widths, and the controller's knob switches reshuffle
+        # batch grouping enough to hit one (a pow-2 group of 2 between
+        # B=1 and the cap) — which reads as a serving-stage compile and
+        # trips the zero-compile gate this scenario exists to enforce
+        "MCPFORGE_TPU_LOCAL_WARMUP": "true",
+        "MCPFORGE_TPU_LOCAL_WARMUP_MODE": "full",
+        "MCPFORGE_CONTROLLER_ENABLED": "true",
+        "MCPFORGE_CONTROLLER_K_LADDER": f"1,{base_k}",
+        # bench cadence: production defaults (1 s tick, 10 s cooldown)
+        # would never decide inside a seconds-long scenario
+        "MCPFORGE_CONTROLLER_TICK_S": "0.05",
+        "MCPFORGE_CONTROLLER_COOLDOWN_S": "0.2",
+        "MCPFORGE_CONTROLLER_EVAL_WINDOW_S": "0.2",
+        "MCPFORGE_CONTROLLER_QUEUE_WAIT_HIGH_MS": "5",
+        "MCPFORGE_CONTROLLER_QUEUE_WAIT_LOW_MS": "1",
+        "MCPFORGE_CONTROLLER_IDLE_FRAC_HIGH": "0.01",
+    }
+
+    async def run_arm(controller_on: bool) -> dict:
+        env = dict(ctrl_env)
+        if not controller_on:
+            env["MCPFORGE_CONTROLLER_ENABLED"] = "false"
+        arm_t0 = time.time()
+        fapp, fclient, fmodel = await _make_gateway(platform, replicas=1,
+                                                    extra_env=env)
+        fauth = BasicAuth("admin", "changeme")
+        tag = "on" if controller_on else "off"
+        try:
+            interactive = chat_kind(fmodel, max_tokens=4)
+            batchy = chat_kind(
+                fmodel, max_tokens=max(8, scale["max_tokens"] * 2),
+                prompt="controller scenario long-form batch request "
+                       "with extra context words")
+            await run_phase(fclient, fauth, [interactive], name="prime",
+                            concurrency=2, requests=4)
+            window = SloWindow(fclient, f"scenario-controller-{tag}",
+                               fauth)
+            await window.open()
+            phases = []
+            # the phase shift the controller exists for: TTFT-sensitive
+            # interactive load, then throughput-shaped batch load, then
+            # an interactive burst again
+            for name, kind, conc, reqs in (
+                    ("interactive", interactive,
+                     max(2, scale["controller_concurrency"] // 2),
+                     scale["controller_requests"]),
+                    ("batch", batchy, scale["controller_concurrency"],
+                     scale["controller_requests"]),
+                    ("burst", interactive,
+                     scale["controller_concurrency"] * 2,
+                     scale["controller_requests"])):
+                phase = await run_phase(fclient, fauth, [kind], name=name,
+                                        concurrency=conc, requests=reqs)
+                phases.append(phase)
+            slo = await window.close()
+            engine = fapp["tpu_engine"]
+            compiles = engine.compile_stats()
+            resp = await fclient.get("/admin/controller?limit=128",
+                                     auth=fauth)
+            ctrl = (await resp.json()) if resp.status == 200 else None
+            metrics_text = fapp["ctx"].metrics.render()[0].decode()
+            forensics = await probe_slowest_trace(fclient, fauth,
+                                                  since_ts=arm_t0)
+            requests = sum(p.requests for p in phases)
+            failures = sum(p.failures for p in phases)
+            wall_s = sum(p.wall_s for p in phases)
+            latencies = sorted(x for p in phases for x in p.latencies_ms)
+            return {
+                "controller": controller_on,
+                "value": round(requests / wall_s, 2) if wall_s else 0.0,
+                "requests": requests, "failures": failures,
+                "wall_s": round(wall_s, 3),
+                "p50_ms": round(latencies[len(latencies) // 2], 2)
+                if latencies else None,
+                "p95_ms": round(latencies[min(int(len(latencies) * 0.95),
+                                              len(latencies) - 1)], 2)
+                if latencies else None,
+                "phases": {p.name: p.summary() for p in phases},
+                "admin_status": resp.status,
+                "serving_compiles": compiles["serving"]["count"],
+                # name the guilty executables when the gate trips — a
+                # bare count is undebuggable from a CI log
+                "serving_compile_events": [
+                    e for e in compiles.get("recent", ())
+                    if e.get("stage") == "serving"] or None,
+                "controller_snapshot": ctrl,
+                "decisions_counted": (
+                    "mcpforge_controller_decisions_total" in metrics_text),
+                "knob_gauge_present": (
+                    "mcpforge_controller_knob" in metrics_text),
+                "slo": slo, "forensics": forensics,
+            }
+        finally:
+            try:
+                await fclient.close()
+            except Exception:
+                pass
+
+    off = await run_arm(False)
+    on = await run_arm(True)
+    ctrl = on.pop("controller_snapshot") or {}
+    decisions = ctrl.get("decisions") or []
+    superstep_moves = [d for d in decisions if d.get("knob") == "superstep"]
+    ring_schema_ok = all(
+        all(k in d for k in ("schema", "seq", "ts", "knob", "direction",
+                             "from", "to", "actuated", "signals"))
+        for d in decisions)
+    slo = on.pop("slo")
+    forensics = on.pop("forensics")
+    off.pop("controller_snapshot", None)
+    off_forensics = off.pop("forensics", None)
+    off.pop("slo", None)
+    return {
+        "scenario": "controller",
+        # self-describing for tools/bench_trend.py: a controller round
+        # partitions away from frozen-config history
+        "controller": True,
+        "value": on["value"],
+        "p50_ms": on["p50_ms"], "p95_ms": on["p95_ms"],
+        "requests": off["requests"] + on["requests"],
+        "failures": off["failures"] + on["failures"],
+        "wall_s": round(off["wall_s"] + on["wall_s"], 3),
+        "arms": {"off": off, "on": on},
+        "decisions": len(decisions),
+        "superstep_decisions": len(superstep_moves),
+        "decisions_by_knob": _count_by(
+            decisions, lambda d: f"{d.get('knob')}:{d.get('direction')}"),
+        "knob_state": ctrl.get("knobs"),
+        "shed_bar": ctrl.get("shed_bar"),
+        "ticks": ctrl.get("ticks"),
+        "forensics": forensics,
+        "slo": slo, "slo_ok": slo["ok"],
+        "hard_fail": (
+            (off["failures"] + on["failures"]
+             and f"{off['failures'] + on['failures']} request(s) failed "
+                 "across the controller A/B arms")
+            or (off["admin_status"] != 404
+                and "controller-off arm served /admin/controller "
+                    f"(got {off['admin_status']}, expected 404)")
+            or (on["admin_status"] != 200
+                and f"/admin/controller returned {on['admin_status']} "
+                    "on the controller arm")
+            or (not decisions
+                and "the loop never closed: zero decisions in the audit "
+                    "ring under a phase-shifting load")
+            or (not ring_schema_ok
+                and "decision ring rows are missing audit-schema fields")
+            or (not on["decisions_counted"]
+                and "mcpforge_controller_decisions_total never counted "
+                    "a decision")
+            or (not on["knob_gauge_present"]
+                and "mcpforge_controller_knob gauge missing from "
+                    "/metrics")
+            or (on["serving_compiles"]
+                and f"{on['serving_compiles']} serving-stage XLA "
+                    "compile(s) — the K ladder was not fully warmed")
+            or next((f"forensics: {p}"
+                     for p in (forensics or {}).get("problems", [])), None)
+            or next((f"off-arm forensics: {p}"
+                     for p in (off_forensics or {}).get("problems", [])),
+                    None)
+            or None),
+    }
+
+
+def _count_by(rows, key) -> dict:
+    out: dict = {}
+    for row in rows:
+        k = key(row)
+        out[k] = out.get(k, 0) + 1
+    return out
+
+
 async def _reference_streams(app, prompts, max_tokens):
     """What one UNINTERRUPTED engine emits for ``prompts`` — the parity
     bar the chaos scenario's merged failover streams must match
@@ -1465,6 +1678,8 @@ async def run_scenarios(platform: str) -> dict:
             "tier-fault": lambda: scenario_tier_fault(
                 app, client, auth, model, scale, platform),
             "overload-shed": lambda: scenario_overload_shed(
+                app, client, auth, model, scale, platform),
+            "controller": lambda: scenario_controller(
                 app, client, auth, model, scale, platform),
             "chaos": lambda: scenario_chaos(app, client, auth, model, scale),
             "workers": lambda: scenario_workers(platform, scale),

@@ -638,6 +638,59 @@ class Settings(BaseModel):
     # when the first chunk lands). 0 = send headers immediately.
     gw_stream_first_chunk_wait_s: float = 1.0
 
+    # --- closed-loop serving controller (tpu_local/controller.py +
+    # observability/signals.py, docs/controller.md) ---
+    # master switch: off (default) keeps every serving knob at its
+    # frozen-config value — behavior is bit-identical to a build without
+    # the controller (the A/B baseline the bench arms compare against)
+    controller_enabled: bool = False
+    # observe-only mode: signals flow and decisions land in the audit
+    # ring/metrics/spans, but NO knob is actually moved — the dry-run
+    # posture for qualifying the policy against live traffic
+    controller_safe_mode: bool = False
+    # signal-bus publication tick and controller evaluation cadence
+    controller_tick_s: float = 1.0
+    # per-knob cooldown: after a move the knob holds at least this long
+    # before the controller may move it again (actuation-settling guard)
+    controller_cooldown_s: float = 10.0
+    # observed-effect window: each decision's "after" signal snapshot is
+    # taken this long after actuation and written back into its ring row
+    controller_eval_window_s: float = 5.0
+    # hysteresis band: a signal must clear its threshold by this
+    # fraction before the controller reverses a prior move (flap guard)
+    controller_hysteresis: float = 0.1
+    # bounded decision audit ring served at GET /admin/controller
+    controller_ring_size: int = 256
+    # superstep ladder pre-compiled at warmup: adaptive K only moves
+    # along these rungs, so a knob change can never trigger a
+    # mid-traffic XLA compile. () = derive {1, superstep} from the
+    # static knob (controller off => just the static K: zero extra
+    # compiles)
+    controller_k_ladder: tuple[int, ...] = ()
+    # TTFT-vs-throughput ladder bars: queue-wait p95 above the high bar
+    # steps K down (admission latency dominates); device-idle fraction
+    # above its bar with queue-wait below the low bar steps K up
+    # (host-dispatch-bound; fuse more). Bars in ms / fraction.
+    controller_queue_wait_high_ms: float = 500.0
+    controller_queue_wait_low_ms: float = 50.0
+    controller_idle_frac_high: float = 0.35
+    # spec-decode toggle bars: measured acceptance (accepted drafts per
+    # verify step, 0..spec_k) below the off bar disables drafting;
+    # the controller re-probes (re-enables) after cooldown to re-measure
+    controller_spec_accept_off: float = 0.5
+    controller_spec_accept_on: float = 1.0
+    # dynamic OverloadShedder bars: SLO burn rate above burn_high
+    # tightens shed_at toward the floor; burn below burn_low relaxes it
+    # toward the configured static bar (gw_shed_saturation_at)
+    controller_burn_high: float = 1.0
+    controller_burn_low: float = 0.25
+    controller_shed_floor: float = 0.5
+    controller_shed_step: float = 0.05
+    # --- live signal bus (observability/signals.py): bounded per-
+    # (signal, replica) windows + EWMA the controller consumes ---
+    signal_window: int = 64
+    signal_ewma_alpha: float = 0.3
+
     # --- engine replica pool (tpu_local/pool/, docs/serving_pool.md) ---
     # N > 1 serves LLM traffic from N engine replicas on device-subset
     # meshes (e.g. 2 replicas x 4 chips on a v5e-8) behind an

@@ -134,10 +134,14 @@ class Database:
                       timing: list[float] | None = None
                       ) -> list[dict[str, Any]]:
         assert self._conn is not None, "Database not connected"
+        wait_start = time.monotonic() if timing is not None else 0.0
         with self._lock:
             # clock inside the lock: executor/lock queue wait is a
             # concurrency signal, not query time — a 1 ms SELECT queued
-            # behind a 200 ms statement must not WARN as a slow query
+            # behind a 200 ms statement must not WARN as a slow query.
+            # The wait itself is still attributed: it becomes the
+            # db.acquire sub-phase (timing[1]) so the flight recorder can
+            # say "queued behind the writer" vs "the statement was slow"
             started = time.monotonic() if timing is not None else 0.0
             attempt = 0
             while True:
@@ -164,6 +168,7 @@ class Database:
                     time.sleep(self._retry_interval_s)
             if timing is not None:
                 timing.append((time.monotonic() - started) * 1000)
+                timing.append((started - wait_start) * 1000)
             return rows
 
     def _executemany_sync(self, sql: str, seq: list[Sequence[Any]]) -> None:
@@ -207,11 +212,14 @@ class Database:
                 if log is not None:
                     log.append((" ".join(sql.split()), timing[0]))
                 if clock is not None:
-                    # in-lock statement time into the request's phase
-                    # vector (GET /admin/gateway/requests); executor
-                    # queue wait lands in the handler residue instead —
-                    # it is loop/pool contention, not DB time
-                    clock.add("db", timing[0] / 1e3)
+                    # phase vector (GET /admin/gateway/requests) gets the
+                    # SPLIT buckets: db.execute = in-lock statement time,
+                    # db.acquire = lock-acquire wait (writer contention).
+                    # Executor queue wait still lands in the handler
+                    # residue — it is loop/pool contention, not DB time
+                    clock.add("db.execute", timing[0] / 1e3)
+                    if len(timing) > 1:
+                        clock.add("db.acquire", timing[1] / 1e3)
             elif log is not None:
                 log.append((" ".join(sql.split()), 0.0))
 

@@ -118,12 +118,16 @@ class PostgresDatabase:
         from ..observability.phases import current_phases
         log = _query_capture.get()
         clock = current_phases()  # flight-recorder db-phase attribution
+        timed = log is not None or clock is not None
+        acquire_start = time.monotonic() if timed else 0.0
         conn = await self._pool.acquire()
         try:
-            # clock the statement only: pool-acquire wait is a sizing
-            # signal, not query time — a 1 ms query that waited 150 ms
-            # for a connection must not WARN as a slow query
-            timed = log is not None or clock is not None
+            # the statement and the pool-acquire wait are clocked as
+            # SEPARATE phase buckets: db.execute is query time (the slow-
+            # query signal), db.acquire is connection contention (a pool-
+            # sizing signal) — a 1 ms query that waited 150 ms for a
+            # connection must not WARN as a slow query, but the wait must
+            # still show up in the request's phase vector
             started = time.monotonic() if timed else 0.0
             try:
                 return await self._query(conn, sql, params)
@@ -133,7 +137,8 @@ class PostgresDatabase:
                     if log is not None:
                         log.append((" ".join(sql.split()), elapsed_ms))
                     if clock is not None:
-                        clock.add("db", elapsed_ms / 1e3)
+                        clock.add("db.execute", elapsed_ms / 1e3)
+                        clock.add("db.acquire", started - acquire_start)
         finally:
             await self._pool.release(conn)
 

@@ -168,6 +168,7 @@ const TABS = {
   engine:   {url: "/admin/engine/stats", special: "engine"},
   gateway:  {url: "/admin/gateway/requests?limit=24", special: "gwflight"},
   forensics:{url: "/admin/trace?limit=50", special: "forensics"},
+  controller:{url: "/admin/controller?limit=32", special: "controller"},
   tenants:  {url: "/admin/tenants/usage?limit=32", special: "tenants"},
   diagnostics: {special: "diagnostics"},
 };
@@ -478,6 +479,51 @@ async function forensicWaterfall(i){
       + `<div class="gantt"><div class="${cls}" style="left:${left.toFixed(2)}%;width:${width.toFixed(2)}%"></div></div>`;
   }).join("");
   d.innerHTML = html;
+}
+function renderController(snap){
+  // closed-loop serving controller (tpu_local/controller.py): the
+  // decision audit ring — signal snapshot in, knob delta out, observed
+  // effect after the eval window — plus live per-replica knob state
+  const cards = `<div class="cards">
+    <div class="card"><b>${snap.enabled ? (snap.safe_mode ? "SAFE (observe-only)" : "ACTIVE") : "off"}</b><span>controller</span></div>
+    <div class="card"><b>${cell(snap.ticks)}</b><span>ticks</span></div>
+    <div class="card"><b>${cell(snap.tick_s)}s / ${cell(snap.cooldown_s)}s</b><span>tick / cooldown</span></div>
+    <div class="card"><b>${fnum(snap.hysteresis)}</b><span>hysteresis</span></div>
+    <div class="card"><b>${fnum(snap.shed_bar)}</b><span>shed_bar (floor ${fnum(snap.shed_floor)}, ceil ${fnum(snap.shed_ceiling)})</span></div>
+   </div>`;
+  // per-replica knob state: what the engines are ACTUALLY running now
+  const knobs = snap.knobs || {};
+  const knobRows = Object.keys(knobs).sort().map(rid => {
+    const k = knobs[rid] || {};
+    return `<tr><td>${esc(rid)}</td><td>${cell(k.superstep)}</td>`
+      + `<td>${esc(JSON.stringify(k.warmed_k||[]))}</td>`
+      + `<td>${cell(k.width_floor)}</td><td>${cell(k.batch_width)}</td>`
+      + `<td>${k.spec_built ? (k.spec_enabled ? "on" : "off") : "-"}</td></tr>`;
+  }).join("");
+  const knobTable = knobRows
+    ? `<br><h3>replica knobs</h3><table><tr><th>replica</th><th>K</th>`
+      + `<th>warmed_k</th><th>width_floor</th><th>batch_width</th>`
+      + `<th>spec</th></tr>${knobRows}</table>`
+    : "<br>no engines wired";
+  // decision ring, newest first: every row says what the controller
+  // saw, what it moved, and what the signals did afterwards
+  const cols = ["ts","replica","knob","direction","from","to","actuated",
+                "signals","effect"];
+  const body = (snap.decisions || []).map(d =>
+    "<tr>" + cols.map(c => {
+      if (c === "ts") return `<td>${esc(new Date((d.ts||0)*1000)
+        .toISOString().slice(11,23))}</td>`;
+      if (c === "signals" || c === "effect")
+        return `<td class="kv">${esc(JSON.stringify(d[c]||{}))}</td>`;
+      if (c === "actuated") return `<td>${cell(d.actuated === true)}</td>`;
+      return `<td>${cell(d[c])}</td>`;
+    }).join("") + "</tr>").join("");
+  const ring = body
+    ? `<br><h3>decisions (newest first)</h3><table><tr>`
+      + cols.map(c => `<th>${esc(c)}</th>`).join("") + `</tr>${body}</table>`
+    : "<br>no decisions yet — the loop holds until signals warrant a move";
+  document.getElementById("view").innerHTML = cards + knobTable + ring;
+  document.getElementById("status").textContent = "serving controller";
 }
 async function renderTenants(usage){
   // per-tenant metering (observability/metering.py): live ledger rows,
@@ -862,6 +908,7 @@ async function show(name, keepCursor){
     if (t.special === "engine") return renderEngine(data);
     if (t.special === "gwflight") return renderGatewayFlight(data);
     if (t.special === "forensics") return renderForensics(data);
+    if (t.special === "controller") return renderController(data);
     if (t.special === "tenants") return renderTenants(data);
     if (t.special === "ingress") return renderIngress(data);
     if (t.path) data = data[t.path] || [];

@@ -335,6 +335,20 @@ async def build_app(settings: Settings | None = None) -> web.Application:
             metrics=metrics,
             limiter=tenant_limiter)
 
+    # live signal plane (observability/signals.py, docs/controller.md):
+    # bounded per-replica aggregates PUSHED by engine retire, the flight
+    # recorder and the SLO evaluator at their own cadence — the closed-
+    # loop serving controller reads these at its tick, never scrapes.
+    # Built unconditionally (publish is O(1) and the admin surfaces read
+    # it); the controller itself is opt-in below.
+    from ..observability.signals import SignalBus
+    signal_bus = SignalBus(window=settings.signal_window,
+                           ewma_alpha=settings.signal_ewma_alpha)
+    app["signal_bus"] = signal_bus
+    ctx.extras["signal_bus"] = signal_bus
+    if loop_sampler is not None:
+        loop_sampler.signals = signal_bus  # gw.loop_lag_ms onto the bus
+
     # operation-timing registry (reference performance_tracker.py): http /
     # db / tool / resource series feed /admin/performance and the bundle
     if settings.performance_tracking_enabled:
@@ -406,7 +420,7 @@ async def build_app(settings: Settings | None = None) -> web.Application:
                     heartbeat_timeout_s=(
                         settings.tpu_local_pool_heartbeat_timeout_s),
                     requeue_max=settings.tpu_local_pool_requeue_max,
-                    ledger=tenant_ledger)
+                    ledger=tenant_ledger, signals=signal_bus)
                 await pool.start()
                 backend = pool
                 ctx.extras["tpu_engine_pool"] = pool
@@ -414,7 +428,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
             else:
                 local_engine = TPUEngine(config, tracer=tracer,
                                          metrics=metrics,
-                                         ledger=tenant_ledger)
+                                         ledger=tenant_ledger,
+                                         signals=signal_bus)
                 await local_engine.start()
                 backend = local_engine
                 ctx.extras["tpu_engine"] = local_engine
@@ -468,13 +483,13 @@ async def build_app(settings: Settings | None = None) -> web.Application:
                 heartbeat_timeout_s=(
                     settings.tpu_local_pool_heartbeat_timeout_s),
                 requeue_max=settings.tpu_local_pool_requeue_max,
-                ledger=tenant_ledger)
+                ledger=tenant_ledger, signals=signal_bus)
             engine = engine_pool.replicas[0].engine
             app["tpu_engine_pool"] = engine_pool
             ctx.extras["tpu_engine_pool"] = engine_pool
         else:
             engine = TPUEngine(engine_config, tracer=tracer, metrics=metrics,
-                               ledger=tenant_ledger)
+                               ledger=tenant_ledger, signals=signal_bus)
         from ..services.diagnostics_service import JaxProfilerCapture
         app["jax_profiler"] = JaxProfilerCapture(settings.jax_profile_dir)
         provider = TPULocalProvider(
@@ -497,6 +512,48 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         ctx.extras["tpu_engine"] = engine
         app["tpu_provider"] = provider
         setup_llm_routes(app, registry, prefix=settings.llm_api_prefix)
+
+    # closed-loop serving controller (tpu_local/controller.py,
+    # docs/controller.md): reads the signal bus at a fixed tick and
+    # steers superstep K / batch-width floor / spec-decode / shed bars.
+    # Opt-in (controller_enabled) and fully auditable — every decision
+    # lands in a bounded ring behind GET /admin/controller. Engines are
+    # resolved lazily through ctx.extras so a pool hot-swap or shared-
+    # plane leader build is always steering the CURRENT engines.
+    serving_controller = None
+    if settings.controller_enabled:
+        from ..tpu_local.controller import ServingController
+
+        def _live_engines():
+            live_pool = ctx.extras.get("tpu_engine_pool")
+            if live_pool is not None:
+                return [r.engine for r in live_pool.replicas]
+            eng = ctx.extras.get("tpu_engine")
+            return [eng] if eng is not None else []
+
+        serving_controller = ServingController(
+            signal_bus, _live_engines,
+            shedder=app.get("overload_shedder"),
+            slo_evaluator=app["slo_evaluator"],
+            metrics=metrics, tracer=tracer,
+            enabled=True,
+            safe_mode=settings.controller_safe_mode,
+            tick_s=settings.controller_tick_s,
+            cooldown_s=settings.controller_cooldown_s,
+            eval_window_s=settings.controller_eval_window_s,
+            hysteresis=settings.controller_hysteresis,
+            ring_size=settings.controller_ring_size,
+            queue_wait_high_ms=settings.controller_queue_wait_high_ms,
+            queue_wait_low_ms=settings.controller_queue_wait_low_ms,
+            idle_frac_high=settings.controller_idle_frac_high,
+            spec_accept_off=settings.controller_spec_accept_off,
+            spec_accept_on=settings.controller_spec_accept_on,
+            burn_high=settings.controller_burn_high,
+            burn_low=settings.controller_burn_low,
+            shed_floor=settings.controller_shed_floor,
+            shed_step=settings.controller_shed_step)
+        app["serving_controller"] = serving_controller
+        ctx.extras["serving_controller"] = serving_controller
 
     # plugins (optional, loaded if configured)
     if settings.plugins_enabled:
@@ -972,6 +1029,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         elif engine is not None:
             await engine.start()
         await llm_provider_service.rewire()  # external providers from DB
+        if serving_controller is not None:
+            await serving_controller.start()  # closed loop over the bus
         if ctx.plugin_manager is not None:
             await ctx.plugin_manager.load_bindings()
         elector = LeaderElector(leases, "gateway-leader", ctx.worker_id,
@@ -1042,6 +1101,10 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         await transport.sessions.stop_sweeper()
         await gateway_service.stop_health_loop()
         await elector.stop()
+        if serving_controller is not None:
+            # BEFORE engine shutdown: no knob request may land on a
+            # stopping dispatch loop
+            await serving_controller.stop()
         if ctx.llm_registry is not None:
             await ctx.llm_registry.shutdown()
         await bus_rpc.stop()

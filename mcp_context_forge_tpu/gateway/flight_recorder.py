@@ -229,6 +229,10 @@ class LoopLagSampler:
         self.interval_s = max(0.01, float(interval_s))
         self.warn_s = max(0.0, float(warn_s))
         self.recorder = recorder
+        # optional live-signal bus (observability/signals.py): every
+        # sample is also pushed as gw.loop_lag_ms so the serving
+        # controller sees gateway loop health at its own tick
+        self.signals = None
         self.samples = 0
         self.long_callbacks = 0
         self.max_lag_s = 0.0
@@ -264,6 +268,8 @@ class LoopLagSampler:
         self.max_lag_s = max(self.max_lag_s, lag)
         if self.metrics is not None:
             self.metrics.gw_loop_lag.observe(lag)
+        if self.signals is not None:
+            self.signals.publish("gw.loop_lag_ms", lag * 1e3)
         if self.warn_s and lag >= self.warn_s:
             self.long_callbacks += 1
             now = time.monotonic()

@@ -211,6 +211,29 @@ def setup_extra_routes(app: web.Application) -> None:
                                   if shedder is not None else None)
         return web.json_response(snapshot)
 
+    @routes.get("/admin/controller")
+    async def controller_state(request: web.Request) -> web.Response:
+        """The closed-loop serving controller's audit surface
+        (tpu_local/controller.py, docs/controller.md): the bounded
+        decision ring — signal snapshot in, knob delta out, observed
+        effect after the eval window — plus per-replica knob state, the
+        live signal-bus aggregates the decisions were made from, and
+        the controller's own configuration. Read-only: knobs are only
+        ever moved by the control loop itself. Answers "why did K drop
+        on replica 1 at 14:03" with the exact numbers it saw."""
+        request["auth"].require("observability.read")
+        controller = request.app.get("serving_controller")
+        if controller is None:
+            raise NotFoundError(
+                "serving controller is disabled "
+                "(set MCPFORGE_CONTROLLER_ENABLED=true)")
+        try:
+            limit = int(request.query.get("limit", "64"))
+        except ValueError as exc:
+            raise ValidationFailure("limit must be an integer") from exc
+        return web.json_response(
+            controller.snapshot(limit=max(1, min(limit, 1024))))
+
     def _trace_store_or_404(request: web.Request):
         store = request.app.get("trace_store")
         if store is None:

@@ -203,6 +203,15 @@ class PrometheusRegistry:
             "retires up to K per slot per host sync)",
             ["replica"], registry=self.registry,
         )
+        # smoothed twin of the instantaneous gauge: a single dispatch's
+        # token count whipsaws with batch occupancy, so alerts and the
+        # serving controller act on this EWMA instead
+        self.llm_tokens_per_dispatch_ewma = Gauge(
+            "mcpforge_llm_tokens_per_dispatch_ewma",
+            "EWMA of tokens per decode dispatch (alpha 0.2; the smoothed "
+            "form the serving controller and alerts consume)",
+            ["replica"], registry=self.registry,
+        )
         # overlapped-decode health: the gap histogram is the host-side
         # stall between device dispatches (the thing the pipeline hides —
         # collapses to ~0 when overlap is on), and the idle fraction is
@@ -438,6 +447,26 @@ class PrometheusRegistry:
             "rejected = collector 4xx, retry_exhausted, shutdown = "
             "undeliverable at process exit)",
             ["reason"], registry=self.registry,
+        )
+        # --- closed-loop serving controller (tpu_local/controller.py,
+        # docs/controller.md) --- every knob move is a counted, labeled
+        # event; the knob gauges mirror the CURRENT actuated posture so
+        # a dashboard can overlay knob position on the signals that
+        # drove it
+        self.controller_decisions = Counter(
+            "mcpforge_controller_decisions_total",
+            "Serving-controller knob decisions, by knob (superstep, "
+            "width_floor, spec, shed_bar) and direction (up, down, on, "
+            "off, hold_rejected = the engine refused the staged value)",
+            ["knob", "direction"], registry=self.registry,
+        )
+        self.controller_knob = Gauge(
+            "mcpforge_controller_knob",
+            "Current serving-knob posture per replica (superstep = "
+            "active K, width_floor = decode width floor, spec = 0/1, "
+            "shed_bar = OverloadShedder shed_at; gateway-scope knobs "
+            "use replica '-')",
+            ["knob", "replica"], registry=self.registry,
         )
         # exemplar bucket registration: the ledger places an observed
         # value into its bucket without re-deriving prometheus internals

@@ -79,6 +79,10 @@ STITCH_SPANS = {
     # pool failover: the requeue hop joining a killed replica's spans to
     # the successor's in one trace
     "pool.requeue": "pool",
+    # serving-controller knob decisions (tpu_local/controller.py):
+    # parentless like llm.xla_compile, so a latency shift in a retained
+    # trace lines up against the knob move that caused it
+    "controller.decision": "controller",
 }
 
 # Span names legitimately emitted but OUTSIDE the waterfall (none today;
@@ -360,6 +364,13 @@ class TraceStore:
             if entry.tenant and self._admit_slowest(
                     self._slowest_tenant, entry.tenant, entry):
                 reasons.add("slowest_tenant")
+        if entry.root_name == "controller.decision":
+            # serving-controller knob moves are rare, bounded by the
+            # controller's own cooldown, and exactly what a forensics
+            # session wants next to a latency shift — retain them
+            # (UNPROTECTED: the budget eviction below still bounds the
+            # store if a misconfigured controller ever floods)
+            reasons.add("controller")
         if (not reasons or reasons == {"exemplar"}) \
                 and self.sample_every > 0:
             # deterministic 1-in-M: the same trace id always makes the

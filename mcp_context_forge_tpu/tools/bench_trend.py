@@ -125,27 +125,31 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
     # partition by arm: captures self-describe their fused-K via the
     # "superstep" field (absent/1 = the classic one-token step), their
     # tiered-prefix-cache mode via "prefix_tiers", and their gateway
-    # WORKER COUNT via "workers" (absent/1 = single asyncio worker) — a
-    # K=8 arm's tok/s must only be judged against K=8 history, a
-    # BENCH_PREFIX_TIERS capture's pressure workload only against tier
-    # history, and a 4-worker scenario round must never median against
-    # 1-worker history (the scale-out win would read every later
-    # single-worker capture as a regression, and vice versa)
-    groups: dict[tuple[int, bool, int],
+    # WORKER COUNT via "workers" (absent/1 = single asyncio worker) and
+    # their closed-loop CONTROLLER mode via "controller" (absent =
+    # frozen knobs) — a K=8 arm's tok/s must only be judged against K=8
+    # history, a BENCH_PREFIX_TIERS capture's pressure workload only
+    # against tier history, a 4-worker scenario round must never median
+    # against 1-worker history (the scale-out win would read every later
+    # single-worker capture as a regression, and vice versa), and a
+    # controller-on capture's adaptive-K numbers must not gate a
+    # frozen-config round
+    groups: dict[tuple[int, bool, int, bool],
                  list[tuple[int, str, dict[str, Any]]]] = {}
     for item in payloads:
         groups.setdefault((int(item[2].get("superstep") or 1),
                            bool(item[2].get("prefix_tiers")),
-                           int(item[2].get("workers") or 1)),
+                           int(item[2].get("workers") or 1),
+                           bool(item[2].get("controller"))),
                           []).append(item)
-    for (k_steps, tiers, workers), group in sorted(groups.items()):
+    for (k_steps, tiers, workers, controller), group in sorted(groups.items()):
         if len(group) < 2:
             # a new arm's first capture has no history yet — surface it
             # (a silent zero-check pass would hide the round where the
             # fused path's numbers first land, the vacuous-pass class)
             result.setdefault("new_arms", []).append(
                 {"superstep": k_steps, "prefix_tiers": tiers,
-                 "workers": workers,
+                 "workers": workers, "controller": controller,
                  "capture": os.path.basename(group[-1][1])})
             continue
         latest_round, latest_path, latest = group[-1]
@@ -155,6 +159,8 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
             arm += "@tiers"
         if workers != 1:
             arm += f"@workers={workers}"
+        if controller:
+            arm += "@controller"
         for key, higher_better in _GATES[latest.get("metric")]:
             latest_val = latest.get(key)
             prior = [p.get(key) for _rnd, _path, p in history
@@ -172,6 +178,7 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
                 "metric": key,
                 "superstep": k_steps,
                 "workers": workers,
+                "controller": controller,
                 "latest": latest_val,
                 "latest_round": latest_round,
                 "baseline_median": baseline,
@@ -242,8 +249,9 @@ def main(argv: list[str] | None = None) -> int:
                 tiers = "@tiers" if arm.get("prefix_tiers") else ""
                 wk = (f"@workers={arm['workers']}"
                       if arm.get("workers", 1) != 1 else "")
+                ctl = "@controller" if arm.get("controller") else ""
                 print(f"bench-trend: {result['series']}"
-                      f"@superstep={arm['superstep']}{tiers}{wk}: first "
+                      f"@superstep={arm['superstep']}{tiers}{wk}{ctl}: first "
                       f"capture ({arm['capture']}) — no history to gate yet")
             for check in result["checks"]:
                 arrow = "REGRESSED" if check["regressed"] else "ok"

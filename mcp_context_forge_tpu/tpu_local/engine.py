@@ -54,6 +54,11 @@ from .tokenizer import load_tokenizer
 
 logger = logging.getLogger(__name__)
 
+# smoothing factor for the tokens-per-dispatch EWMA gauge twin (and the
+# signal-bus copy): ~last 10 dispatches dominate, long enough to ride out
+# batch-occupancy whipsaw, short enough to track a real load shift
+_TPD_EWMA_ALPHA = 0.2
+
 
 @dataclass
 class EngineConfig:
@@ -211,12 +216,27 @@ class EngineConfig:
     # per-chip roofline peaks the live gauges divide by (defaults: v5e)
     peak_tflops_per_chip: float = V5E_PEAK_BF16_TFLOPS
     hbm_gbps_per_chip: float = V5E_HBM_GBPS
+    # extra superstep rungs warmed ALONGSIDE fused_steps so the serving
+    # controller (tpu_local/controller.py) can retune K at drain
+    # barriers onto pre-compiled executables — a knob move can never
+    # trigger a mid-traffic XLA compile. () = no extra rungs: the
+    # decode grid is exactly the static-K grid (controller-off builds
+    # compile nothing new and behave bit-identically).
+    k_ladder: tuple[int, ...] = ()
 
     @property
     def fused_steps(self) -> int:
         """Effective decode iterations fused per device dispatch: the
         superstep K when set, else the legacy decode_block alias."""
         return self.superstep if self.superstep > 1 else self.decode_block
+
+    def k_rungs(self) -> tuple[int, ...]:
+        """Superstep values the warmup decode grid compiles: the static
+        fused_steps plus every configured ladder rung, deduped and
+        ascending. Adaptive K only ever moves along this set."""
+        rungs = {self.fused_steps}
+        rungs.update(int(k) for k in self.k_ladder if int(k) >= 1)
+        return tuple(sorted(rungs))
 
     @classmethod
     def from_settings(cls, settings) -> "EngineConfig":
@@ -271,6 +291,12 @@ class EngineConfig:
                 V5E_PEAK_BF16_TFLOPS),
             hbm_gbps_per_chip=getattr(
                 settings, "tpu_local_hbm_gbps_per_chip", V5E_HBM_GBPS),
+            # extra K rungs only when the controller is on: off keeps the
+            # warmup grid — and therefore compile count and serving
+            # behavior — bit-identical to a pre-controller build
+            k_ladder=(tuple(getattr(settings, "controller_k_ladder", ()))
+                      if getattr(settings, "controller_enabled", False)
+                      else ()),
         )
 
 
@@ -451,11 +477,16 @@ class TPUEngine:
 
     def __init__(self, config: EngineConfig, tracer=None, metrics=None,
                  devices: list | None = None, ledger=None,
-                 tier_store=None, prefix_index=None):
+                 tier_store=None, prefix_index=None, signals=None):
         # telemetry handles are optional: None means zero-cost no-ops, so
         # unit tests and benches constructing engines directly pay nothing
         self.tracer = tracer
         self.metrics = metrics
+        # live signal bus (observability/signals.py): retire-site pushes
+        # feed the serving controller; None = every publish site is a
+        # single attribute check. Assignable post-construction too (the
+        # gateway wires the bus after the pool builds its replicas).
+        self.signals = signals
         # per-tenant usage ledger (observability/metering.py): fed at the
         # SAME sites as the untagged stats counters so per-tenant sums
         # conserve exactly against stats.prompt_tokens /
@@ -480,6 +511,10 @@ class TPUEngine:
             raise ValueError("spec_decode and superstep/decode_block>1 are "
                              "mutually exclusive (both widen the "
                              "per-dispatch step)")
+        if config.spec_decode and any(int(k) > 1 for k in config.k_ladder):
+            raise ValueError("k_ladder rungs > 1 are mutually exclusive "
+                             "with spec_decode (same exclusivity as "
+                             "superstep > 1)")
         if config.spec_decode and config.spec_k < 2:
             raise ValueError(f"spec_k must be >= 2, got {config.spec_k}")
         if config.spec_decode and config.spec_ngram < 1:
@@ -598,6 +633,31 @@ class TPUEngine:
         # iteration (request_cancel is the only other writer, lock-guarded)
         self._cancels: set[str] = set()  # lint: thread[dispatch]
         self._cancel_lock = threading.Lock()  # lint: lock[dispatch]
+        # serving-knob handoff (tpu_local/controller.py): loop-side
+        # callers stage validated knob moves under the lock; the dispatch
+        # thread consumes them at the top of its iteration, DRAINING the
+        # overlap pipeline first when K changes — knob moves only ever
+        # land at drain barriers, so greedy token streams match a run
+        # that used the new posture from that barrier on
+        self._pending_knobs: dict[str, Any] = {}  # lint: thread[dispatch]
+        self._knob_lock = threading.Lock()  # lint: lock[dispatch]
+        # runtime spec-decode gate (the controller's on/off knob): plain
+        # decode is always warmed as the fallback path, so flipping this
+        # never compiles; engines built without spec_decode ignore it
+        self._spec_enabled = True  # lint: thread[dispatch]
+        # controller-requested decode width floor (0 = none): bounds the
+        # batch-bucket shrink path from below when the live occupancy
+        # histogram says the next burst will just re-grow anyway
+        self._width_floor = 0  # lint: thread[dispatch]
+        # superstep rungs the warmup grid compiled; adaptive K may only
+        # select these (request_knobs rejects anything else)
+        self._warmed_k: set[int] = set()  # lint: thread[dispatch]
+        # EWMA twin of the tokens-per-dispatch gauge (the instantaneous
+        # value whipsaws with batch occupancy; smoothed form is what the
+        # signal bus and alerts act on)
+        self._tpd_ewma: float | None = None  # lint: thread[dispatch]
+        # last publish of O(window) signals (idle fraction): bounded tick
+        self._signals_slow_ts = 0.0  # lint: thread[dispatch]
         # decode-step attribution + live roofline state: the dispatch
         # counter drives the sampling cadence, phase events feed llm.decode
         # span events, the roofline window backs roofline_snapshot(), and
@@ -915,21 +975,29 @@ class TPUEngine:
                 return bucket
         return self.config.max_batch
 
-    def _decode_fn(self, ctx_pages: int, batch: int | None = None):
-        key = (batch or self.config.max_batch, ctx_pages)
+    def _decode_fn(self, ctx_pages: int, batch: int | None = None,
+                   k: int | None = None):
+        # K is part of the executable identity (the scan length is baked
+        # into the trace), so the cache keys on it: adaptive K switches
+        # between PRE-COMPILED entries and can never compile mid-traffic
+        k = self._k if k is None else int(k)
+        key = (k, batch or self.config.max_batch, ctx_pages)
         fn = self._decode_fns.get(key)
         if fn is None:
-            fn = jax.jit(partial(self._decode_and_sample, ctx_pages=ctx_pages),
+            fn = jax.jit(partial(self._decode_and_sample,
+                                 ctx_pages=ctx_pages, k=k),
                          donate_argnames=("kv",))
             self._decode_fns[key] = fn
         return fn
 
-    def _decode_fb_fn(self, ctx_pages: int, batch: int | None = None):
-        key = (batch or self.config.max_batch, ctx_pages)
+    def _decode_fb_fn(self, ctx_pages: int, batch: int | None = None,
+                      k: int | None = None):
+        k = self._k if k is None else int(k)
+        key = (k, batch or self.config.max_batch, ctx_pages)
         fn = self._decode_fb_fns.get(key)
         if fn is None:
             fn = jax.jit(partial(self._decode_and_sample_fb,
-                                 ctx_pages=ctx_pages),
+                                 ctx_pages=ctx_pages, k=k),
                          donate_argnames=("kv",))
             self._decode_fb_fns[key] = fn
         return fn
@@ -1144,6 +1212,11 @@ class TPUEngine:
             # seq_lens=0: every slot is "inactive", writes masked to trash
             widths = (self._batch_buckets() if self.config.batch_buckets
                       else [self.config.max_batch])
+            # the K ladder multiplies the grid: every (width, ctx, K rung)
+            # triple compiles here so the controller's adaptive K only
+            # ever lands on pre-warmed executables. With no ladder
+            # configured this is exactly the static-K grid (one rung).
+            k_rungs = self.config.k_rungs()
             for batch in widths:
                 bsamp = SamplingParams(jnp.zeros((batch,), jnp.float32),
                                        jnp.zeros((batch,), jnp.int32),
@@ -1155,44 +1228,56 @@ class TPUEngine:
                 wstops = jnp.full((batch, self._STOP_TBL_WIDTH), -1,
                                   jnp.int32)
                 for ctx_pages in self._ctx_buckets():
-                    args = (self.params, self.kv,
-                            jnp.zeros((batch,), jnp.int32),
-                            jnp.zeros((batch,), jnp.int32),
-                            jnp.arange(batch, dtype=jnp.int32),
-                            jnp.zeros((batch,), jnp.int32), wbudget,
-                            wstops, bsamp, jax.random.PRNGKey(0))
-                    if capture:
-                        self.cost_registry.capture(
-                            "decode", batch, ctx_pages,
-                            self._decode_fn(ctx_pages, batch), *args)
-                    (block, _, _), self.kv = \
-                        self._decode_fn(ctx_pages, batch)(*args)
-                    block.block_until_ready()
-                    shapes += 1
-                    if self.config.decode_overlap and self._verify_fns is None:
-                        # the pipelined steady state runs the feedback
-                        # variant; warm it alongside so overlap never
-                        # compiles mid-traffic. Feed it the plain decode's
-                        # OUTPUT block — at runtime the feed is always the
-                        # previous step's on-device jit output, and the
-                        # pjit cache keys on that committed sharding (a
-                        # fresh jnp.zeros here would warm a cache entry
-                        # traffic never hits)
-                        fb_args = (self.params, self.kv, block,
-                                   jnp.zeros((batch,), jnp.int32),
-                                   jnp.arange(batch, dtype=jnp.int32),
-                                   jnp.zeros((batch,), jnp.int32), wbudget,
-                                   wstops, bsamp, jax.random.PRNGKey(0))
+                    for k_rung in k_rungs:
+                        # cost entries for non-default rungs carry the
+                        # rung in the kind (FLOPs/bytes scale with K, so
+                        # MFU after a K switch must divide by the right
+                        # cost); the static rung keeps the bare kind the
+                        # existing roofline consumers look up
+                        suffix = "" if k_rung == self._k else f"@k{k_rung}"
+                        args = (self.params, self.kv,
+                                jnp.zeros((batch,), jnp.int32),
+                                jnp.zeros((batch,), jnp.int32),
+                                jnp.arange(batch, dtype=jnp.int32),
+                                jnp.zeros((batch,), jnp.int32), wbudget,
+                                wstops, bsamp, jax.random.PRNGKey(0))
                         if capture:
                             self.cost_registry.capture(
-                                "decode_fb", batch, ctx_pages,
-                                self._decode_fb_fn(ctx_pages, batch),
-                                *fb_args)
-                        (block, _, _), self.kv = self._decode_fb_fn(
-                            ctx_pages, batch)(*fb_args)
+                                "decode" + suffix, batch, ctx_pages,
+                                self._decode_fn(ctx_pages, batch, k_rung),
+                                *args)
+                        (block, _, _), self.kv = \
+                            self._decode_fn(ctx_pages, batch, k_rung)(*args)
                         block.block_until_ready()
                         shapes += 1
+                        if (self.config.decode_overlap
+                                and self._verify_fns is None):
+                            # the pipelined steady state runs the feedback
+                            # variant; warm it alongside so overlap never
+                            # compiles mid-traffic. Feed it the plain
+                            # decode's OUTPUT block — at runtime the feed
+                            # is always the previous step's on-device jit
+                            # output, and the pjit cache keys on that
+                            # committed sharding (a fresh jnp.zeros here
+                            # would warm a cache entry traffic never hits)
+                            fb_args = (self.params, self.kv, block,
+                                       jnp.zeros((batch,), jnp.int32),
+                                       jnp.arange(batch, dtype=jnp.int32),
+                                       jnp.zeros((batch,), jnp.int32),
+                                       wbudget, wstops, bsamp,
+                                       jax.random.PRNGKey(0))
+                            if capture:
+                                self.cost_registry.capture(
+                                    "decode_fb" + suffix, batch, ctx_pages,
+                                    self._decode_fb_fn(ctx_pages, batch,
+                                                       k_rung),
+                                    *fb_args)
+                            (block, _, _), self.kv = self._decode_fb_fn(
+                                ctx_pages, batch, k_rung)(*fb_args)
+                            block.block_until_ready()
+                            shapes += 1
                 self._warmed_widths.add(batch)
+            self._warmed_k.update(k_rungs)
             if self.config.batch_buckets:
                 # warmed posture: start at max (never slower than fixed
                 # width; the first burst costs zero transitions) — the
@@ -1267,7 +1352,8 @@ class TPUEngine:
     def _decode_and_sample(self, params, kv, tokens, positions, slot_ids,
                            seq_lens, budgets, stop_tbl,
                            sampling: SamplingParams, key,
-                           ctx_pages: int | None = None):
+                           ctx_pages: int | None = None,
+                           k: int | None = None):
         """One decode SUPER-STEP: k = config.fused_steps decode iterations
         as a single jitted lax.scan — fused sampling, in-loop paged-KV
         append over pre-granted pages, and per-slot budget/EOS/stop
@@ -1289,7 +1375,10 @@ class TPUEngine:
         Returns ((tokens [k, B], valid [k, B] bool, done [B] bool), kv):
         valid[j, b] marks a token the host should emit; done[b] is the
         device's end-of-stream verdict, retired in ONE readback."""
-        k = self._k
+        # k is partial-bound by _decode_fn so the scan length is part of
+        # the executable identity (adaptive K); the self._k fallback
+        # serves direct (unjitted) callers in tests
+        k = self._k if k is None else k
         # rows with work this dispatch (inactive slots — empty or
         # mid-chunk-prefill — never write; the mask below derives from
         # the INITIAL lens, not the in-scan incremented ones)
@@ -1332,7 +1421,8 @@ class TPUEngine:
     def _decode_and_sample_fb(self, params, kv, prev_block, positions,
                               slot_ids, seq_lens, budgets, stop_tbl,
                               sampling: SamplingParams, key,
-                              ctx_pages: int | None = None):
+                              ctx_pages: int | None = None,
+                              k: int | None = None):
         """Device-token-feedback decode (overlapped pipeline steady state):
         the input token is the PREVIOUS dispatch's last sampled token —
         row k-1 of its [k, B] block — which never left the device, so the
@@ -1341,7 +1431,8 @@ class TPUEngine:
         this step executes."""
         return self._decode_and_sample(params, kv, prev_block[-1], positions,
                                        slot_ids, seq_lens, budgets, stop_tbl,
-                                       sampling, key, ctx_pages=ctx_pages)
+                                       sampling, key, ctx_pages=ctx_pages,
+                                       k=k)
 
     # --------------------------------------------------------------- lifecycle
 
@@ -1589,6 +1680,13 @@ class TPUEngine:
                     if self._cancels:
                         self._apply_cancels()
                         did_work = True
+                    if self._pending_knobs:
+                        # controller knob moves land HERE — before
+                        # admission/decode, draining the overlap pipeline
+                        # when K changes, so every move is a clean drain
+                        # barrier (greedy parity holds)
+                        self._apply_knobs()
+                        did_work = True
                     incoming = bool(self._pending)
                     occupied = len(self._running) + len(self._chunking)
                     can_admit = incoming and occupied < self.config.max_batch
@@ -1606,7 +1704,9 @@ class TPUEngine:
                         self._chunk_round()
                         did_work = True
                     if self._running:
-                        if self._verify_fns is not None and self._any_would_draft():
+                        if (self._verify_fns is not None
+                                and self._spec_enabled
+                                and self._any_would_draft()):
                             self._spec_step_all()
                         elif overlap:
                             self._decode_step_overlapped()
@@ -1768,6 +1868,82 @@ class TPUEngine:
             else:
                 kept.append(request)
         self._pending = kept
+
+    def request_knobs(self, *, superstep: int | None = None,
+                      spec_enabled: bool | None = None,
+                      width_floor: int | None = None) -> dict[str, bool]:
+        """Stage serving-knob changes for the dispatch thread to land at
+        its next drain barrier (the controller's actuation surface —
+        same handoff pattern as request_cancel). Validation happens HERE,
+        against the warmed grid, so a rejected value never reaches the
+        loop: adaptive K may only select warmed ladder rungs (zero
+        mid-traffic XLA compiles by construction), toggling spec needs a
+        spec-built engine, and a width floor must be a warmed bucket
+        width. Returns {knob: accepted} so the caller can audit refusals.
+        Thread-safe; callable from any thread."""
+        accepted: dict[str, bool] = {}
+        staged: dict[str, Any] = {}
+        if superstep is not None:
+            k = int(superstep)
+            ok = k >= 1 and (k in self._warmed_k or any(
+                key[0] == k for key in self._decode_fns))
+            if self._verify_fns is not None and k > 1:
+                ok = False  # spec engines can't take K>1 (ctor exclusivity)
+            accepted["superstep"] = ok
+            if ok:
+                staged["superstep"] = k
+        if spec_enabled is not None:
+            ok = self._verify_fns is not None
+            accepted["spec_enabled"] = ok
+            if ok:
+                staged["spec_enabled"] = bool(spec_enabled)
+        if width_floor is not None:
+            w = int(width_floor)
+            ok = w == 0 or (self.config.batch_buckets
+                            and w in self._warmed_widths)
+            accepted["width_floor"] = ok
+            if ok:
+                staged["width_floor"] = min(w, self.config.max_batch)
+        if staged:
+            with self._knob_lock:
+                self._pending_knobs.update(staged)
+            self._wake.set()
+        return accepted
+
+    def _apply_knobs(self) -> None:  # lint: runs-on[dispatch]
+        """Land staged knob moves on the dispatch thread. A superstep
+        change drains the overlap pipeline first: the in-flight lookahead
+        was dispatched at the OLD K and its retire accounting carries its
+        own ``k``; after the drain the switch is a clean barrier and the
+        next dispatch picks the pre-warmed executable for the new K.
+        Spec/width-floor moves are pure host-side posture flips."""
+        with self._knob_lock:
+            knobs, self._pending_knobs = self._pending_knobs, {}
+        if not knobs:
+            return
+        new_k = knobs.get("superstep")
+        if new_k is not None and new_k != self._k:
+            if self._inflight is not None:
+                self._drain_pipeline()
+            self._k = int(new_k)
+        if "spec_enabled" in knobs:
+            self._spec_enabled = bool(knobs["spec_enabled"])
+        if "width_floor" in knobs:
+            self._width_floor = int(knobs["width_floor"])
+
+    def knob_state(self) -> dict[str, Any]:
+        """Live serving-knob posture (the /admin/controller "now" row and
+        the bench harness's zero-compile assertion read this)."""
+        return {
+            "superstep": self._k,
+            "spec_built": self._verify_fns is not None,
+            "spec_enabled": bool(self._verify_fns is not None
+                                 and self._spec_enabled),
+            "width_floor": self._width_floor,
+            "batch_width": self._batch_width,
+            "warmed_k": sorted(self._warmed_k),
+            "warmed_widths": sorted(self._warmed_widths),
+        }
 
     def _wait_for_work(self) -> None:
         """Idle path: block on the submit-side wake event instead of a
@@ -2279,6 +2455,13 @@ class TPUEngine:
             spec_emitted += emitted
         mfu, hbm_frac = self._observe_roofline(
             "spec_verify", B, spec_ctx_pages, spec_elapsed_ms)
+        if self.signals is not None and active:
+            # acceptance = EXTRA tokens per row this dispatch (0..K-1);
+            # the controller's spec on/off knob acts on its EWMA
+            self.signals.publish(
+                "llm.spec_accept",
+                max(0.0, spec_emitted / len(active) - 1.0),
+                self.config.replica_id)
         self._record_step("spec_decode", batch=len(active), width=B,
                           dur_ms=spec_elapsed_ms, tokens=spec_emitted,
                           ctx_pages=spec_ctx_pages, mfu=mfu,
@@ -2447,6 +2630,12 @@ class TPUEngine:
                               + admissible),
                           config.max_batch)
             desired = self._batch_bucket_for(ceiling)
+            if self._width_floor:
+                # controller floor: live occupancy says the next burst
+                # would just re-grow — don't shrink below it (each width
+                # change re-homes the donated KV pool)
+                desired = max(desired, self._batch_bucket_for(
+                    min(self._width_floor, config.max_batch)))
             if desired >= self._batch_width:
                 # grow immediately (arrays must cover the ceiling)
                 self._batch_width = desired
@@ -2477,7 +2666,8 @@ class TPUEngine:
                          for r in self._running.values()), default=1)
                         + self._k)
                     if (target in self._warmed_widths
-                            or (target, ctx_now) in self._decode_fns):
+                            or (self._k, target, ctx_now)
+                            in self._decode_fns):
                         self._batch_width = target
                     self._shrink_streak = 0
                     self._shrink_peak = 0
@@ -2674,7 +2864,8 @@ class TPUEngine:
             self._observe_phases(phases)
         mfu, hbm_frac = self._observe_roofline(
             "decode_fb" if inflight.get("fed") else "decode",
-            inflight["B"], inflight["ctx_pages"], step_wall_ms)
+            inflight["B"], inflight["ctx_pages"], step_wall_ms,
+            k=inflight["k"])
         self._gap_window.append((inflight["gap_s"],
                                  decode_elapsed_ms / 1000))
         self._record_step("decode", batch=inflight["batch"],
@@ -2730,13 +2921,23 @@ class TPUEngine:
                     max(0.0, dur_ms / 1e3))
 
     def _observe_roofline(self, kind: str, width: int, ctx_pages: int,
-                          dur_ms: float) -> tuple[float | None, float | None]:
+                          dur_ms: float, k: int | None = None
+                          ) -> tuple[float | None, float | None]:
         """Live roofline: the dispatched executable's warmup-captured XLA
         cost over this step's measured wall. Feeds the mcpforge_llm_mfu /
         hbm_roofline_frac gauges and the snapshot window; (None, None)
         when the registry has no entry (unwarmed engine or cost capture
-        off)."""
-        entry = self.cost_registry.lookup(kind, width, ctx_pages)
+        off). ``k`` selects the rung-suffixed cost entry when adaptive K
+        moved off the static rung (FLOPs/bytes scale with K)."""
+        entry = None
+        if k is not None and k != self.config.fused_steps:
+            entry = self.cost_registry.lookup(f"{kind}@k{k}", width,
+                                              ctx_pages)
+            if entry is None and kind == "decode_fb":
+                entry = self.cost_registry.lookup(f"decode@k{k}", width,
+                                                  ctx_pages)
+        if entry is None:
+            entry = self.cost_registry.lookup(kind, width, ctx_pages)
         if entry is None and kind == "decode_fb":
             entry = self.cost_registry.lookup("decode", width, ctx_pages)
         if entry is None or dur_ms <= 0:
@@ -2853,6 +3054,15 @@ class TPUEngine:
             "mfu": round(mfu, 12) if mfu is not None else None,
             "hbm_frac": round(hbm_frac, 12) if hbm_frac is not None else None,
         })
+        if (kind in ("decode", "spec_decode") and superstep is not None
+                and tokens):
+            # smoothed tokens-per-dispatch (satellite): updated before
+            # the gauge refresh below so the exported EWMA includes this
+            # very step
+            self._tpd_ewma = (
+                float(tokens) if self._tpd_ewma is None
+                else _TPD_EWMA_ALPHA * tokens
+                + (1.0 - _TPD_EWMA_ALPHA) * self._tpd_ewma)
         m = self.metrics
         if m is not None:
             rid = self.config.replica_id
@@ -2877,8 +3087,52 @@ class TPUEngine:
                     tokens / (rate_ms / 1e3))
             if superstep is not None and tokens:
                 m.llm_tokens_per_dispatch.labels(replica=rid).set(tokens)
+                if self._tpd_ewma is not None:
+                    # smoothed twin (satellite): the instantaneous gauge
+                    # whipsaws with batch occupancy — alerts and the
+                    # controller act on this one
+                    m.llm_tokens_per_dispatch_ewma.labels(
+                        replica=rid).set(self._tpd_ewma)
             if self._tier_client is not None:
                 self._export_tier_metrics(m, rid)
+        if kind in ("decode", "spec_decode"):
+            self._publish_signals(tokens=tokens, depth=depth, mfu=mfu,
+                                  hbm_frac=hbm_frac, gap_ms=gap_ms,
+                                  wall_ms=wall_ms if wall_ms is not None
+                                  else dur_ms)
+
+    def _publish_signals(self, *, tokens: int, depth: int,
+                         mfu: float | None, hbm_frac: float | None,
+                         gap_ms: float | None,
+                         wall_ms: float | None) -> None:
+        """Push this decode dispatch's signals onto the live bus (the
+        controller's inputs — docs/controller.md signal catalog). Every
+        publish is O(1); the O(window) idle fraction goes out on a
+        bounded tick, not per retire. No bus = one attribute check."""
+        bus = self.signals
+        if bus is None:
+            return
+        rid = self.config.replica_id
+        if tokens:
+            bus.publish("llm.tokens_per_dispatch", tokens, rid)
+        if mfu is not None:
+            bus.publish("llm.mfu", mfu, rid)
+        if hbm_frac is not None:
+            bus.publish("llm.hbm_roofline_frac", hbm_frac, rid)
+        if gap_ms is not None:
+            bus.publish("llm.dispatch_gap_ms", gap_ms, rid)
+        if wall_ms is not None and wall_ms > 0 and tokens:
+            bus.publish("llm.step_tokens_per_sec",
+                        tokens / (wall_ms / 1e3), rid)
+        bus.publish("llm.saturation",
+                    depth / max(1, self.config.max_queue), rid)
+        bus.publish("llm.occupancy",
+                    (len(self._running) + len(self._chunking))
+                    / max(1, self.config.max_batch), rid)
+        now = time.monotonic()
+        if now - self._signals_slow_ts >= 0.25:
+            self._signals_slow_ts = now
+            bus.publish("llm.idle_frac", self.device_idle_fraction(), rid)
 
     def _export_tier_metrics(self, m, rid: str) -> None:
         """Per-tier prefix counters/gauges (dispatch thread, piggybacked
@@ -2964,6 +3218,10 @@ class TPUEngine:
             self.metrics.llm_queue_wait.labels(tenant=tenant).observe(
                 wait_s, exemplar=self._exemplar("llm_queue_wait", wait_s,
                                                 request, (tenant,)))
+        if self.signals is not None:
+            self.signals.publish("llm.queue_wait_ms",
+                                 max(0.0, request.queue_ms),
+                                 self.config.replica_id)
         self._span("llm.queue", request, request.created, time.time(),
                    **{"llm.queue_ms": round(request.queue_ms, 2),
                       "llm.priority": request.priority})
@@ -2984,6 +3242,10 @@ class TPUEngine:
                 tpot_s, exemplar=self._exemplar(
                     "llm_tpot", tpot_s, request,
                     (self.config.model, self.config.replica_id, tenant)))
+        if self.signals is not None and n > 1:
+            self.signals.publish(
+                "llm.tpot_ms", max(0.0, (now - decode_start) / (n - 1)) * 1e3,
+                self.config.replica_id)
         if self.ledger is not None and request.slot >= 0:
             # HBM residency: pages this request held x its resident wall
             # (admission -> retire; pages are still held here — the
@@ -3034,6 +3296,12 @@ class TPUEngine:
             request.first_token_ts = time.time()
             if not request.ttft_observed:
                 request.ttft_observed = True
+                if self.signals is not None:
+                    self.signals.publish(
+                        "llm.ttft_ms",
+                        max(0.0, request.first_token_ts - request.created)
+                        * 1e3,
+                        self.config.replica_id)
                 if self.metrics is not None:
                     ttft_s = max(0.0,
                                  request.first_token_ts - request.created)

@@ -155,12 +155,16 @@ class EnginePool:
                  requeue_max: int = 2,
                  devices: list | None = None,
                  engine_factory: Callable[..., TPUEngine] | None = None,
-                 ledger=None):
+                 ledger=None, signals=None):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         self.config = config
         self.tracer = tracer
         self.metrics = metrics
+        # one live-signal bus shared by every replica (and every
+        # reload-rebuilt engine): per-replica aggregates the serving
+        # controller consumes must survive hot-swap
+        self.signals = signals
         # one tenant ledger shared by every replica (and every rebuilt
         # engine a reload produces): per-tenant token accounting must
         # survive failover and hot-swap with nothing lost or double-billed
@@ -232,10 +236,13 @@ class EnginePool:
     def _build_engine(self, index: int) -> TPUEngine:
         cfg = dataclasses.replace(self.config, replica_id=str(index),
                                   mesh_shape=self._mesh_shape)
-        return self._factory(cfg, self.tracer, self.metrics,
-                             self._device_sets[index], ledger=self.ledger,
-                             tier_store=self.tier_store,
-                             prefix_index=self.prefix_index)
+        engine = self._factory(cfg, self.tracer, self.metrics,
+                               self._device_sets[index], ledger=self.ledger,
+                               tier_store=self.tier_store,
+                               prefix_index=self.prefix_index)
+        if self.signals is not None:
+            engine.signals = self.signals
+        return engine
 
     # --------------------------------------------------------------- lifecycle
 

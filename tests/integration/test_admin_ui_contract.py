@@ -57,7 +57,10 @@ async def test_every_tab_endpoint_answers_with_consumable_shape():
                                tpu_local_page_size="16",
                                tpu_local_num_pages="32",
                                tpu_local_prefill_buckets="16",
-                               tpu_local_dtype="float32")
+                               tpu_local_dtype="float32",
+                               # the controller tab 404s when disabled —
+                               # the contract run needs the live surface
+                               controller_enabled="true")
     try:
         resp = await client.get("/admin", auth=AUTH)
         assert resp.status == 200
@@ -80,6 +83,11 @@ async def test_every_tab_endpoint_answers_with_consumable_shape():
                 # trace-store snapshot: retention stats + retained rows
                 assert "retained" in data and "traces" in data, (name, data)
                 assert "max_traces" in data, (name, data)
+            elif spec.get("special") == "controller":
+                # serving-controller snapshot: posture + audit ring +
+                # per-replica knob ladders + live signal table
+                assert "decisions" in data and "knobs" in data, (name, data)
+                assert "signals" in data and "ticks" in data, (name, data)
             elif spec.get("special") == "tenants":
                 # tenant metering: ledger rows + clamp + rollup blocks
                 assert "tenants" in data and "clamp" in data, (name, data)

@@ -74,9 +74,13 @@ async def test_every_request_gets_a_phase_row_summing_to_wall():
             assert all(v >= 0 for v in row["phases_ms"].values()), row
             assert _sum_ok(row), row
         tools_row = next(r for r in rows if r["path"] == "/tools")
-        # the authenticated, DB-backed route attributes both layers
+        # the authenticated, DB-backed route attributes both layers; the
+        # db bucket is split into acquire-wait vs in-lock statement time
         assert tools_row["phases_ms"].get("auth", 0) > 0, tools_row
-        assert tools_row["phases_ms"].get("db", 0) > 0, tools_row
+        assert tools_row["phases_ms"].get("db.execute", 0) > 0, tools_row
+        assert "db.acquire" in tools_row["phases_ms"], tools_row
+        assert tools_row["phases_ms"]["db.acquire"] >= 0, tools_row
+        assert "db" not in tools_row["phases_ms"], tools_row
         assert tools_row["status"] == 200
         # rows join their OTel traces (http.request span ids + corr id)
         assert len(tools_row["trace_id"]) == 32

@@ -203,8 +203,8 @@ def test_first_capture_of_a_new_arm_is_surfaced_not_silent(tmp_path, capsys):
     assert report["ok"] and report["checks"] >= 1       # K=1 still gated
     series = next(r for r in report["series"] if r["series"] == "BENCH_TPU")
     assert series["new_arms"] == [
-        {"superstep": 8, "prefix_tiers": False,
-         "capture": "BENCH_TPU_r03.json"}]
+        {"superstep": 8, "prefix_tiers": False, "workers": 1,
+         "controller": False, "capture": "BENCH_TPU_r03.json"}]
     assert main(["--root", str(tmp_path)]) == 0
     out = capsys.readouterr().out
     assert "no history to gate yet" in out
@@ -229,3 +229,31 @@ def test_prefix_tiers_captures_gate_as_their_own_arm(tmp_path):
     report = run_check(str(tmp_path), tolerance=0.25)
     assert not report["ok"]
     assert any("@tiers" in line for line in report["regressions"])
+
+
+def test_controller_captures_gate_as_their_own_arm(tmp_path):
+    """A controller-on capture (adaptive K walking the warmed ladder)
+    sits in a different tok/s-vs-TTFT regime than the frozen-config arm
+    at the same base K — it must only median against controller
+    history, and a regression inside that arm must name it."""
+    _write_series(tmp_path, "BENCH_SCENARIO_CONTROLLER", [
+        {**_capture(100.0), "superstep": 8},               # frozen history
+        {**_capture(98.0), "superstep": 8, "controller": True},
+        {**_capture(101.0), "superstep": 8},               # frozen newest
+        {**_capture(97.0), "superstep": 8, "controller": True},
+    ])
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert report["ok"], report["regressions"]
+    assert report["checks"] >= 4          # both arms actually compared
+    # a controller-arm collapse is caught within the arm and labelled
+    (tmp_path / "BENCH_SCENARIO_CONTROLLER_r05.json").write_text(
+        json.dumps({**_capture(20.0), "superstep": 8, "controller": True}))
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert not report["ok"]
+    assert any("@controller" in line for line in report["regressions"])
+    # the frozen arm stayed green: the collapse did not bleed across
+    by_arm = {c["controller"]: c
+              for r in report["series"] for c in r["checks"]
+              if c["metric"] == "value"}
+    assert by_arm[False]["regressed"] is False
+    assert by_arm[True]["regressed"] is True

@@ -47,6 +47,12 @@ GRID = [
      "BENCH_PROMPT_MODE": "repetitive"},
     # int8 on the same model: A/B the bandwidth win directly
     {"BENCH_SUPERSTEP": "8", "BENCH_SPEC": "0", "BENCH_QUANT": "int8"},
+    # closed-loop controller A/B: same K=8 base as the static arm above,
+    # but the ServingController walks the warmed {1,4,8} ladder against
+    # a phase-shifting load — the on-silicon question is whether
+    # adaptive-K holds the static-K=8 tok/s while cutting TTFT p95 in
+    # the interactive phases, with zero serving-stage XLA compiles
+    {"BENCH_SUPERSTEP": "8", "BENCH_SPEC": "0", "BENCH_CONTROLLER": "1"},
     # decode-width bucketing: 3.6x on the CPU proxy at light load; the
     # open question is the donated-pool re-home cost on real HBM
     {"BENCH_SUPERSTEP": "1", "BENCH_SPEC": "0",
