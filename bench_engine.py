@@ -53,6 +53,15 @@ p95, tok/s, and greedy token parity across arms. The acceptance bar:
 the tiers-on arm's prefix_hit_tokens >= 2x the off arm's at the same
 page budget.
 
+BENCH_DISAGG=1 runs the disaggregated prefill/decode A/B
+(docs/disaggregation.md): the same mixed long-prefill + chat load
+served by a pool of 2 replicas, uniform (both "any") vs role-split
+(prefill+decode with live KV-page migration through the shared tiers).
+Reports per-arm TTFT p95 / TPOT p95 / tok/s, the migration counters
+(ok/degraded + page conservation), tier restore p95, and greedy token
+parity across arms (must be 1.0 — the migration hop is the requeue
+continuation contract).
+
 BENCH_CONTROLLER=1 runs the closed-loop serving-controller A/B
 (docs/controller.md): the same phase-shifting greedy load (interactive
 -> batch -> burst) served with a frozen config vs with the
@@ -545,6 +554,135 @@ def run_controller_ab(platform: str) -> dict:
     }
 
 
+async def _run_disagg_arm(platform: str, roles: str) -> dict:
+    """One arm of the BENCH_DISAGG A/B: a pool of 2 replicas serving a
+    mixed load — long-prefill requests (several full pages, the class
+    disaggregation exists for) interleaved with short chat turns — with
+    either a uniform pool (roles="", both generalists) or the
+    prefill+decode split (long admissions prefill on replica 0, migrate
+    their KV chain through the shared tiers, and decode on replica 1).
+    Greedy end to end, so the arms' streams must be byte-identical."""
+    from mcp_context_forge_tpu.tpu_local.engine import EngineConfig
+    from mcp_context_forge_tpu.tpu_local.pool import EnginePool
+
+    model = os.environ.get(
+        "BENCH_MODEL", "llama3-1b" if platform == "tpu" else "llama3-tiny")
+    page_size = int(os.environ.get("BENCH_PAGE_SIZE", "16"))
+    long_reqs = int(os.environ.get("BENCH_DISAGG_LONG", "4"))
+    chat_reqs = int(os.environ.get("BENCH_DISAGG_CHAT", "4"))
+    max_tokens = int(os.environ.get("BENCH_TOKENS", "16"))
+    long_pages = 5                       # full pages per long prompt
+    config = EngineConfig(
+        model=model, max_batch=4,
+        max_seq_len=max(256, page_size * (long_pages + 2) + 2 * max_tokens),
+        page_size=page_size, num_pages=256,
+        prefill_buckets=(page_size, page_size * 8),
+        dtype="bfloat16" if platform == "tpu" else "float32",
+        attn_impl="auto", prefix_cache=True, prefix_tiers=True,
+        tier_host_bytes=64 * 1024 * 1024, tier_disk_bytes=0,
+        compile_cache_dir=os.environ.get(
+            "MCPFORGE_TPU_LOCAL_COMPILE_CACHE_DIR",
+            "/tmp/mcpforge-xla-cache"))
+    pool = EnginePool(config, replicas=2, roles=roles,
+                      disagg_prompt_tokens=page_size * 2)
+    await pool.start()
+    try:
+        await asyncio.to_thread(
+            pool.warmup,
+            os.environ.get("BENCH_WARMUP",
+                           "fast" if platform == "tpu" else "full"))
+        # deterministic synthetic prompts: the long class spans
+        # long_pages FULL pages (each distinct — no cross-request prefix
+        # reuse muddying the migration accounting); the chat class stays
+        # under the disagg threshold
+        long_prompts = [[11 + i * 97 + j
+                         for j in range(long_pages * page_size)]
+                        for i in range(long_reqs)]
+        # must stay under the disagg threshold (2 pages) even with the
+        # char-level test tokenizer, so the chat class routes to decode
+        chat_prompt = pool.tokenizer.encode("short chat turn")
+        async for _ in pool.generate(list(chat_prompt), max_tokens=2):
+            pass  # primes both dispatch loops end-to-end
+
+        async def stream(prompt: list[int], n_tokens: int
+                         ) -> tuple[list[int], float | None, list[float]]:
+            toks: list[int] = []
+            gaps: list[float] = []
+            first = None
+            t0 = time.monotonic()
+            last = t0
+            async for tok in pool.generate(list(prompt),
+                                           max_tokens=n_tokens):
+                now = time.monotonic()
+                if first is None:
+                    first = (now - t0) * 1000
+                else:
+                    gaps.append((now - last) * 1000)
+                last = now
+                toks.append(tok)
+            return toks, first, gaps
+
+        started = time.monotonic()
+        results = await asyncio.gather(
+            *[stream(p, max_tokens) for p in long_prompts],
+            *[stream(list(chat_prompt) + [1000 + i], max_tokens)
+              for i in range(chat_reqs)])
+        wall = time.monotonic() - started
+        streams = [r[0] for r in results]
+        ttfts = sorted(r[1] for r in results if r[1] is not None)
+        long_ttfts = sorted(r[1] for r in results[:long_reqs]
+                            if r[1] is not None)
+        gaps = sorted(g for r in results for g in r[2])
+        total = sum(len(s) for s in streams)
+        restore_p95 = max((r.engine.tier_stats() or {}).get(
+            "restore_p95_ms") or 0.0 for r in pool.replicas)
+        return {
+            "roles": ([p.strip() for p in roles.split(",") if p.strip()]
+                      if roles else []),
+            "value": round(total / wall, 2) if wall else 0.0,
+            "tokens": total,
+            "wall_s": round(wall, 3),
+            "ttft_p95_ms": (round(ttfts[int(len(ttfts) * 0.95)], 2)
+                            if ttfts else None),
+            "ttft_long_p95_ms": (
+                round(long_ttfts[int(len(long_ttfts) * 0.95)], 2)
+                if long_ttfts else None),
+            "tpot_p95_ms": (round(gaps[int(len(gaps) * 0.95)], 2)
+                            if gaps else None),
+            "migrations": dict(pool.migrations),
+            "migration_pages": dict(pool.migration_pages),
+            "restore_p95_ms": restore_p95,
+            "router": pool.router.counters(),
+            "requeues": pool.requeues,
+            "token_streams": streams,
+        }
+    finally:
+        await pool.stop()
+
+
+def run_disagg_ab(platform: str) -> dict:
+    """The BENCH_DISAGG A/B block: uniform pool vs prefill/decode split
+    on the SAME mixed load. Parity is greedy and must be 1.0 (the
+    migration hop is the requeue continuation contract); migration page
+    counters must conserve (spilled == restored + degraded)."""
+    uniform = asyncio.run(_run_disagg_arm(platform, roles=""))
+    disagg = asyncio.run(_run_disagg_arm(platform, roles="prefill,decode"))
+    base_streams = uniform.pop("token_streams")
+    arm_streams = disagg.pop("token_streams")
+    pages = disagg["migration_pages"]
+    return {
+        "uniform": uniform,
+        "disagg": disagg,
+        "ttft_p95_delta_ms": (
+            round(uniform["ttft_p95_ms"] - disagg["ttft_p95_ms"], 2)
+            if uniform["ttft_p95_ms"] is not None
+            and disagg["ttft_p95_ms"] is not None else None),
+        "pages_conserved": (
+            pages["spilled"] == pages["restored"] + pages["degraded"]),
+        "token_parity_rate": _parity_rate(base_streams, arm_streams),
+    }
+
+
 def _superstep_sweep() -> list[int]:
     """K values of a BENCH_SUPERSTEP sweep ('1,4,8,16'); empty for a
     single/unset value (which run() consumes directly)."""
@@ -607,6 +745,13 @@ def main() -> dict:
         # away from static-K history.
         out["controller"] = True
         out["controller_ab"] = run_controller_ab(platform)
+    if os.environ.get("BENCH_DISAGG", "0") == "1":
+        # disaggregated prefill/decode A/B (docs/disaggregation.md):
+        # uniform pool vs role-split pool with live KV-page migration.
+        # The capture self-describes its role split so bench_trend
+        # partitions it away from uniform-pool history.
+        out["roles"] = ["prefill", "decode"]
+        out["disagg_ab"] = run_disagg_ab(platform)
     if os.environ.get("BENCH_PREFIX_TIERS", "0") == "1":
         # tiered prefix cache A/B: shared-prefix workload at a FIXED
         # small HBM page budget — tiers off drops evicted templates,

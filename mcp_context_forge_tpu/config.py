@@ -708,6 +708,22 @@ class Settings(BaseModel):
     tpu_local_pool_heartbeat_timeout_s: float = 10.0
     # failovers allowed per logical request before it errors out
     tpu_local_pool_requeue_max: int = 2
+    # disaggregated prefill/decode serving (docs/disaggregation.md):
+    # comma-separated role per replica index ("prefill,decode",
+    # "prefill,decode,any", ...); "" = every replica serves both phases
+    # (the uniform pool, no migration). Roles are free-form strings so a
+    # heterogeneous fleet can route by request/SLO class behind the same
+    # field; "prefill"/"decode"/"any" carry the phase semantics.
+    tpu_local_pool_roles: str = ""
+    # prompts at/above this token count class as prefill-heavy when
+    # roles are active: they land on a prefill replica, prefill there,
+    # then migrate their KV pages to a decode replica
+    tpu_local_disagg_prompt_tokens: int = 64
+    # routing penalty (in outstanding-token units) for placing a classed
+    # request on an "any" replica instead of its exact role — small
+    # enough that an oversubscribed prefill tier spills to idle "any"
+    # capacity, large enough that exact-role replicas win at parity
+    tpu_local_pool_role_penalty_tokens: int = 256
 
     # --- header passthrough (reference config.py:3489-3499: off by
     # default for security; sensitive headers need per-gateway opt-in) ---

@@ -217,19 +217,25 @@ async function renderEngine(stats){
     const pr = await fetch("/admin/engine/pool");
     if (pr.ok){
       const p = await pr.json();
-      const pcols = ["id","state","occupancy","outstanding",
+      const pcols = ["id","state","role","occupancy","outstanding",
                      "outstanding_tokens","kv_pages_in_use","routed",
-                     "requeued_off","reloads","failures","heartbeat_age_s"];
+                     "requeued_off","migrations_out","migrations_in",
+                     "reloads","failures","heartbeat_age_s"];
       const pbody = (p.replicas || []).map(rp =>
         "<tr>" + pcols.map(c => `<td>${cell(rp[c])}</td>`).join("")
         + `<td><button class="act" onclick="poolAct('${esc(rp.id)}','drain')">drain</button>
            <button class="act" onclick="poolAct('${esc(rp.id)}','undrain')">undrain</button>
            <button class="act" onclick="poolAct('${esc(rp.id)}','reload')">reload</button></td></tr>`
       ).join("");
+      const mig = p.migrations || {};
       pool = `<br><h3>engine replica pool</h3>
         <div class="cards">
           <div class="card"><b>${cell((p.router||{}).routed)}</b><span>routed</span></div>
           <div class="card"><b>${cell((p.router||{}).affinity_hits)}</b><span>affinity_hits</span></div>
+          <div class="card"><b>${cell((p.router||{}).role_routed)}</b><span>role_routed</span></div>
+          <div class="card"><b>${cell((p.router||{}).role_spills)}</b><span>role_spills</span></div>
+          <div class="card"><b>${cell(mig.ok)}</b><span>migrations_ok</span></div>
+          <div class="card"><b>${cell(mig.degraded)}</b><span>migrations_degraded</span></div>
           <div class="card"><b>${cell(p.requeues)}</b><span>requeues</span></div>
           <div class="card"><b>${cell((p.health||{}).failures)}</b><span>replica_failures</span></div>
         </div>

@@ -420,7 +420,12 @@ async def build_app(settings: Settings | None = None) -> web.Application:
                     heartbeat_timeout_s=(
                         settings.tpu_local_pool_heartbeat_timeout_s),
                     requeue_max=settings.tpu_local_pool_requeue_max,
-                    ledger=tenant_ledger, signals=signal_bus)
+                    ledger=tenant_ledger, signals=signal_bus,
+                    roles=settings.tpu_local_pool_roles,
+                    disagg_prompt_tokens=(
+                        settings.tpu_local_disagg_prompt_tokens),
+                    role_penalty_tokens=(
+                        settings.tpu_local_pool_role_penalty_tokens))
                 await pool.start()
                 backend = pool
                 ctx.extras["tpu_engine_pool"] = pool
@@ -483,7 +488,11 @@ async def build_app(settings: Settings | None = None) -> web.Application:
                 heartbeat_timeout_s=(
                     settings.tpu_local_pool_heartbeat_timeout_s),
                 requeue_max=settings.tpu_local_pool_requeue_max,
-                ledger=tenant_ledger, signals=signal_bus)
+                ledger=tenant_ledger, signals=signal_bus,
+                roles=settings.tpu_local_pool_roles,
+                disagg_prompt_tokens=settings.tpu_local_disagg_prompt_tokens,
+                role_penalty_tokens=(
+                    settings.tpu_local_pool_role_penalty_tokens))
             engine = engine_pool.replicas[0].engine
             app["tpu_engine_pool"] = engine_pool
             ctx.extras["tpu_engine_pool"] = engine_pool

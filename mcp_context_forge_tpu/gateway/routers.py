@@ -886,9 +886,12 @@ document.getElementById("f").onsubmit = async (e) => {
 
     @routes.post("/admin/engine/pool/{replica}/{action}")
     async def engine_pool_action(request: web.Request) -> web.Response:
-        """drain | undrain | reload one replica. Drain stops routing and
-        waits for in-flight work; reload is the rolling weight hot-swap
-        (drain -> rebuild engine from config.checkpoint -> readmit)."""
+        """drain | undrain | reload | role for one replica. Drain stops
+        routing and waits for in-flight work; reload is the rolling
+        weight hot-swap (drain -> rebuild engine from config.checkpoint
+        -> readmit); role retargets the replica's prefill/decode/any
+        assignment live (body {"role": "..."}, docs/disaggregation.md —
+        routing-only state, nothing drains)."""
         request["auth"].require("admin.all")  # reload swaps weights
         pool = request.app.get("tpu_engine_pool")
         if pool is None:
@@ -916,9 +919,17 @@ document.getElementById("f").onsubmit = async (e) => {
                 result = await pool.undrain(rid)
             elif action == "reload":
                 result = await pool.reload(rid, timeout_s=timeout_s)
+            elif action == "role":
+                role = body.get("role")
+                if not isinstance(role, str) or not role:
+                    raise ValidationFailure(
+                        'role action needs a body {"role": '
+                        '"prefill|decode|any"}')
+                result = pool.set_role(rid, role)
             else:
                 raise ValidationFailure(
-                    f"action must be drain|undrain|reload, got {action!r}")
+                    f"action must be drain|undrain|reload|role, "
+                    f"got {action!r}")
         except KeyError as exc:
             raise NotFoundError(str(exc)) from exc
         except ValueError as exc:

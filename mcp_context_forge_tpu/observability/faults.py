@@ -66,6 +66,9 @@ FAULT_POINTS = (
     "engine.dispatch",        # engine.py dispatch loop (scope = replica id)
     "federation.peer.request",  # peer connect/call (scope = peer URL)
     "ledger.rollup.flush",    # metering.py rollup window -> DB write
+    "pool.migrate",           # pool.py prefill->decode KV-page transfer
+                              # (corrupt = payload fails verify-before-
+                              # serve and migration degrades in place)
     "pool.requeue",           # pool.py failover requeue hop
     "tier.disk.read",         # tiers.py T2 spill-file load
     "tier.disk.write",        # tiers.py T2 write-behind persist

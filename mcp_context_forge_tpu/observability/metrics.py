@@ -303,6 +303,34 @@ class PrometheusRegistry:
             "Rolling drain->swap->readmit reloads completed per replica",
             ["replica"], registry=self.registry,
         )
+        # disaggregated prefill/decode serving (docs/disaggregation.md):
+        # KV-page migration hops between role-specialized replicas
+        self.llm_pool_migrations = Counter(
+            "mcpforge_llm_pool_migrations_total",
+            "Prefill->decode KV-page migration hops (outcome: ok = decode "
+            "continued on the target, degraded = decode-in-place fallback)",
+            ["from", "to", "outcome"], registry=self.registry,
+        )
+        self.llm_pool_migration_seconds = Histogram(
+            "mcpforge_llm_pool_migration_seconds",
+            "Wall time of one KV-page migration hop (export + verify + "
+            "re-dispatch)",
+            registry=self.registry,
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
+        )
+        self.llm_pool_migration_pages = Counter(
+            "mcpforge_llm_pool_migration_pages_total",
+            "KV pages moved by migration per stage (spilled off the "
+            "prefill replica, restored toward the decode target, degraded "
+            "= served in place after a failed hop)",
+            ["stage"], registry=self.registry,
+        )
+        self.llm_pool_migration_bytes = Counter(
+            "mcpforge_llm_pool_migration_bytes_total",
+            "Serialized KV bytes verified through the tier store during "
+            "migration hops",
+            registry=self.registry,
+        )
         self.llm_providers_wired = Gauge(
             "mcpforge_llm_providers_wired",
             "External LLM providers currently wired into the registry",

@@ -79,6 +79,10 @@ STITCH_SPANS = {
     # pool failover: the requeue hop joining a killed replica's spans to
     # the successor's in one trace
     "pool.requeue": "pool",
+    # disaggregated serving: the prefill->decode KV-page migration hop
+    # (docs/disaggregation.md) joining the prefill leg's spans to the
+    # decode continuation's in one trace
+    "pool.migrate": "pool",
     # serving-controller knob decisions (tpu_local/controller.py):
     # parentless like llm.xla_compile, so a latency shift in a retained
     # trace lines up against the knob move that caused it

@@ -785,6 +785,30 @@ def _prefix_tier_spec(mod: types.ModuleType) -> None:
     assert alloc2.spill_resident_prefix() == 0
     tiers.active = True
 
+    # migration export (docs/disaggregation.md): spill_chain walks the
+    # prompt's registered FULL pages in chain order with exact identity,
+    # COPY semantics (pages stay resident and matchable), includes the
+    # final page of an exact-boundary prompt (the continuation prompt's
+    # matchable depth), stops at the first unregistered depth, and
+    # tier-less/inactive allocators return exactly 0
+    spills_before = len(tiers.spills)
+    assert alloc2.spill_chain(prompt) == 2         # exact count
+    assert len(tiers.spills) == spills_before + 2
+    assert [s[2] for s in tiers.spills[-2:]] == [(1, 2, 3, 4),
+                                                 (5, 6, 7, 8)]  # chain order
+    assert tiers.spills[-2][0] == chain_hash(ROOT_HASH, (1, 2, 3, 4))
+    assert tiers.spills[-2][1] == ROOT_HASH        # exact identity
+    assert tiers.spills[-1][1] == tiers.spills[-2][0]
+    assert alloc2.probe_prefix(prompt) == 8        # copy: still resident
+    assert alloc2.spill_chain(prompt[:8]) == 2     # exact page boundary
+    assert alloc2.spill_chain([90, 91, 92, 93]) == 0   # unregistered chain
+    assert alloc2.spill_chain(prompt[:3]) == 0     # no full page to walk
+    assert PA(num_pages=8, page_size=4, max_slots=2,
+              max_pages_per_slot=4).spill_chain(prompt) == 0   # tier-less
+    tiers.active = False
+    assert alloc2.spill_chain(prompt) == 0
+    tiers.active = True
+
     # probe caps tier promises at restore capacity: free+evictable of 2
     # limits a 3-chunk tiered chain to 2 pages; a fully-pinned pool
     # promises nothing (an over-promise here is an admission livelock)

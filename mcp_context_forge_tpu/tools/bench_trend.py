@@ -133,16 +133,22 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
     # against 1-worker history (the scale-out win would read every later
     # single-worker capture as a regression, and vice versa), and a
     # controller-on capture's adaptive-K numbers must not gate a
-    # frozen-config round
-    groups: dict[tuple[int, bool, int, bool],
+    # frozen-config round, and a disaggregated capture (a non-empty
+    # "roles" pool split, e.g. prefill+decode) must only be judged
+    # against same-split history (migration hops shift the TTFT/tok_s
+    # balance by design)
+    groups: dict[tuple[int, bool, int, bool, tuple[str, ...]],
                  list[tuple[int, str, dict[str, Any]]]] = {}
     for item in payloads:
         groups.setdefault((int(item[2].get("superstep") or 1),
                            bool(item[2].get("prefix_tiers")),
                            int(item[2].get("workers") or 1),
-                           bool(item[2].get("controller"))),
+                           bool(item[2].get("controller")),
+                           tuple(str(r) for r in
+                                 (item[2].get("roles") or ()))),
                           []).append(item)
-    for (k_steps, tiers, workers, controller), group in sorted(groups.items()):
+    for (k_steps, tiers, workers, controller, roles), group \
+            in sorted(groups.items()):
         if len(group) < 2:
             # a new arm's first capture has no history yet — surface it
             # (a silent zero-check pass would hide the round where the
@@ -150,6 +156,7 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
             result.setdefault("new_arms", []).append(
                 {"superstep": k_steps, "prefix_tiers": tiers,
                  "workers": workers, "controller": controller,
+                 "roles": list(roles),
                  "capture": os.path.basename(group[-1][1])})
             continue
         latest_round, latest_path, latest = group[-1]
@@ -161,6 +168,8 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
             arm += f"@workers={workers}"
         if controller:
             arm += "@controller"
+        if roles:
+            arm += f"@roles={','.join(roles)}"
         for key, higher_better in _GATES[latest.get("metric")]:
             latest_val = latest.get(key)
             prior = [p.get(key) for _rnd, _path, p in history
@@ -179,6 +188,7 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
                 "superstep": k_steps,
                 "workers": workers,
                 "controller": controller,
+                "roles": list(roles),
                 "latest": latest_val,
                 "latest_round": latest_round,
                 "baseline_median": baseline,
@@ -250,9 +260,12 @@ def main(argv: list[str] | None = None) -> int:
                 wk = (f"@workers={arm['workers']}"
                       if arm.get("workers", 1) != 1 else "")
                 ctl = "@controller" if arm.get("controller") else ""
+                rl = (f"@roles={','.join(arm['roles'])}"
+                      if arm.get("roles") else "")
                 print(f"bench-trend: {result['series']}"
-                      f"@superstep={arm['superstep']}{tiers}{wk}{ctl}: first "
-                      f"capture ({arm['capture']}) — no history to gate yet")
+                      f"@superstep={arm['superstep']}{tiers}{wk}{ctl}{rl}: "
+                      f"first capture ({arm['capture']}) — no history to "
+                      f"gate yet")
             for check in result["checks"]:
                 arrow = "REGRESSED" if check["regressed"] else "ok"
                 print(f"bench-trend: {result['series']} {check['metric']}: "

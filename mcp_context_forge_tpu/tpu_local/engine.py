@@ -30,6 +30,7 @@ import queue
 import threading
 import time
 from collections import deque
+from concurrent.futures import Future
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, AsyncIterator
@@ -343,6 +344,12 @@ class GenRequest:
     # the dispatch thread parents llm.queue/prefill/decode spans to it
     trace_ctx: tuple[str, str] | None = None
     first_token_ts: float = 0.0
+    # routing class for role-specialized pools (docs/disaggregation.md):
+    # "" = classify by shape (prompt length) at the pool router; a
+    # non-empty value pins the request to replicas holding that role
+    # ("prefill"/"decode" for the phase split, or any fleet class such
+    # as a tenant SLO tier / model size behind the same field)
+    route_class: str = ""
     # once-only guard: crash-recovery requeues pass admission twice, and
     # the queue span/histogram must not double-observe the request
     queue_observed: bool = False
@@ -641,6 +648,14 @@ class TPUEngine:
         # that used the new posture from that barrier on
         self._pending_knobs: dict[str, Any] = {}  # lint: thread[dispatch]
         self._knob_lock = threading.Lock()  # lint: lock[dispatch]
+        # chain-export handoff (pool KV migration, docs/disaggregation.md):
+        # the pool stages (prompt_ids, future) pairs; the dispatch thread
+        # consumes them at its drain barrier — device page reads are
+        # dispatch-thread-only, and exporting at the barrier guarantees
+        # the prefill leg's pages are fully retired before they spill
+        self._pending_exports: list[tuple[tuple[int, ...],
+                                          "Future"]] = []  # lint: thread[dispatch]
+        self._export_lock = threading.Lock()  # lint: lock[dispatch]
         # runtime spec-decode gate (the controller's on/off knob): plain
         # decode is always warmed as the fallback path, so flipping this
         # never compiles; engines built without spec_decode ignore it
@@ -1687,6 +1702,12 @@ class TPUEngine:
                         # barrier (greedy parity holds)
                         self._apply_knobs()
                         did_work = True
+                    if self._pending_exports:
+                        # pool KV-migration exports land at the same
+                        # barrier: the pipeline drains first so every
+                        # exported page holds fully retired prefill state
+                        self._apply_exports()
+                        did_work = True
                     incoming = bool(self._pending)
                     occupied = len(self._running) + len(self._chunking)
                     can_admit = incoming and occupied < self.config.max_batch
@@ -1811,6 +1832,15 @@ class TPUEngine:
     def _fail_outstanding(self, reason: str) -> None:
         self._inflight = None
         self._drain_work()
+        with self._export_lock:
+            exports, self._pending_exports = self._pending_exports, []
+        for _ids, fut in exports:
+            # a migration awaiting this export degrades to decode-in-
+            # place (or a plain requeue) instead of hanging forever
+            if not fut.done():
+                fut.set_exception(RuntimeError(
+                    f"engine dispatch thread died ({reason}) before the "
+                    f"chain export ran"))
         for request in list(self._running.values()):
             if request.finish_reason is None:
                 request.finish_reason = reason
@@ -1930,6 +1960,41 @@ class TPUEngine:
             self._spec_enabled = bool(knobs["spec_enabled"])
         if "width_floor" in knobs:
             self._width_floor = int(knobs["width_floor"])
+
+    def request_chain_export(self, prompt_ids: list[int]) -> "Future[int]":
+        """Stage a KV chain export for the dispatch thread (the pool's
+        prefill->decode migration seam, docs/disaggregation.md): the
+        prompt's registered full-page chain spills — as a COPY — into
+        the pool-shared tier store at the next drain barrier. Same
+        handoff pattern as request_knobs: stage under the lock, wake the
+        loop, let the only thread allowed to touch device state do the
+        reads. Returns a future resolving to the number of pages now
+        present in the store; it fails if the engine dies first.
+        Thread-safe; callable from any thread."""
+        self._check_alive()
+        fut: "Future[int]" = Future()
+        with self._export_lock:
+            self._pending_exports.append((tuple(prompt_ids), fut))
+        self._wake.set()
+        return fut
+
+    def _apply_exports(self) -> None:  # lint: runs-on[dispatch]
+        """Land staged chain exports on the dispatch thread, draining the
+        overlap pipeline first — the prefill leg's retire must be fully
+        applied to the pages before their bytes are read off the device."""
+        with self._export_lock:
+            exports, self._pending_exports = self._pending_exports, []
+        if not exports:
+            return
+        if self._inflight is not None:
+            self._drain_pipeline()
+        for prompt_ids, fut in exports:
+            if fut.cancelled():
+                continue
+            try:
+                fut.set_result(self.allocator.spill_chain(list(prompt_ids)))
+            except Exception as exc:  # device read failed: the POOL
+                fut.set_exception(exc)  # degrades; the engine lives on
 
     def knob_state(self) -> dict[str, Any]:
         """Live serving-knob posture (the /admin/controller "now" row and

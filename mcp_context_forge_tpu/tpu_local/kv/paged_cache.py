@@ -608,6 +608,31 @@ class PageAllocator:
                 spilled += 1
         return spilled
 
+    def spill_chain(self, prompt_ids: list[int]) -> int:
+        """Export one prompt's registered chain pages into the shared
+        tier store (docs/disaggregation.md): the prefill->decode
+        migration seam. Walks every FULL page of ``prompt_ids`` (the
+        registration depth — exactly the pages a continuation prompt of
+        ``prompt_ids`` plus one generated token can match) and pushes
+        each through the tier spill path. Unlike eviction this is a
+        COPY: pages stay resident and referenced here, so a degraded
+        migration decodes in place with zero re-prefill. Runs on the
+        dispatch thread (device reads). Returns pages now present in the
+        store (``TieredPageStore.put`` dedupes — chains another replica
+        already spilled count as exported)."""
+        tiers = self.tiers
+        if tiers is None or not tiers.active:
+            return 0
+        spilled = 0
+        for key, key_hash, parent, chunk in self._chain_steps(
+                prompt_ids, full=True):
+            page = self._cached.get(key)
+            if page is None:
+                break  # unregistered depth: nothing deeper can verify
+            if tiers.spill(key_hash, parent, chunk, page):
+                spilled += 1
+        return spilled
+
     def register_prefix(self, slot: int, prompt_ids: list[int]) -> None:
         """Register the slot's full prompt pages for future reuse (and
         publish their HBM residency to the pool index when one is

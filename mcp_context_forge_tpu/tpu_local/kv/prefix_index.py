@@ -63,6 +63,25 @@ def chain_hashes(prompt_ids: Sequence[int], page_size: int) -> list[bytes]:
     return out
 
 
+def chain_pages(prompt_ids: Sequence[int], page_size: int
+                ) -> list[tuple[bytes, bytes, tuple[int, ...]]]:
+    """``(key_hash, parent, chunk)`` per FULL page of ``prompt_ids`` —
+    the registration-depth walk (``len // page_size`` pages, one deeper
+    than :func:`chain_hashes`' matchable walk). This is the identity
+    evidence the tier store's verify-before-serve compares, so the
+    pool's migration path can verify an exported chain without touching
+    any allocator state."""
+    out: list[tuple[bytes, bytes, tuple[int, ...]]] = []
+    parent = ROOT_HASH
+    for i in range(len(prompt_ids) // page_size):
+        chunk = tuple(int(t) for t in
+                      prompt_ids[i * page_size:(i + 1) * page_size])
+        key_hash = chain_hash(parent, chunk)
+        out.append((key_hash, parent, chunk))
+        parent = key_hash
+    return out
+
+
 class PrefixIndex:
     """Pool-global location map for prefix-chain pages (see module doc)."""
 

@@ -569,6 +569,26 @@ class TieredPageStore:
             logger.warning("kv tier store: unreadable spill file %s", path)
             return None
 
+    def verify_chain(self, steps: Sequence[tuple[bytes, bytes,
+                                                 tuple[int, ...]]]
+                     ) -> tuple[int, int]:
+        """Verify-before-serve over a whole exported chain (the pool's
+        migration path): fetch + verify each ``(key_hash, parent,
+        chunk)`` through :meth:`get` — the SAME identity check admission
+        uses, so a corrupt or colliding payload degrades to a miss here
+        exactly as it would at the decode target's fetch-on-miss.
+        Returns ``(pages_verified, bytes_verified)``; stops at the first
+        miss (nothing deeper can restore without its parent)."""
+        pages = 0
+        nbytes = 0
+        for key_hash, parent, chunk in steps:
+            hit = self.get(key_hash, parent, chunk)
+            if hit is None:
+                break
+            pages += 1
+            nbytes += hit[0].nbytes
+        return pages, nbytes
+
     # ------------------------------------------------------------------ stats
 
     def stats(self) -> dict[str, Any]:

@@ -89,8 +89,9 @@ class HealthMonitor:
             verdict = self.verdict(replica)
             if verdict is not None:
                 self.failures += 1
-                logger.error("engine pool: replica %s %s — failing over",
-                             replica.id, verdict)
+                logger.error("engine pool: replica %s (role %s) %s — "
+                             "failing over", replica.id, replica.role,
+                             verdict)
                 self.pool.fail_replica(replica, reason=verdict)
 
     def verdict(self, replica) -> str | None:

@@ -18,7 +18,19 @@ single replica cannot have:
   request's queue-wait is observed exactly once;
 - **drain/reload**: rolling checkpoint hot-swap per replica
   (``drain -> swap weights -> readmit``) while the rest of the pool
-  keeps serving.
+  keeps serving;
+- **disaggregated prefill/decode** (docs/disaggregation.md): replicas
+  carry ROLES (``prefill`` / ``decode`` / ``any``) and the router
+  classes each admission by prompt length (or an explicit
+  ``route_class``). A prefill-classed request lands on a prefill
+  replica capped at ONE decode token, its prompt KV chain is exported
+  through the pool-shared spill tiers, verified page-by-page against
+  the token content (the same verify-before-serve gate admission
+  restores ride), and the request continues on a decode replica as a
+  pool-shadow continuation — the exact mechanism failover already
+  uses, so greedy streams stay byte-identical across the hop. ANY
+  failed step degrades to decode-in-place on the prefill replica;
+  migration never loses a stream.
 
 Requests are never handed to an engine directly: the pool submits a
 *shadow* request and pumps its stream into the client's, which is the
@@ -37,7 +49,7 @@ import dataclasses
 import logging
 import time
 from dataclasses import dataclass
-from typing import Any, AsyncIterator, Callable
+from typing import Any, AsyncIterator, Callable, Sequence
 
 from ...observability.logging import trace_extra
 from ..engine import EngineConfig, EngineStats, GenRequest, TPUEngine, probe_devices
@@ -46,6 +58,13 @@ from .health import HealthMonitor
 from .router import ReplicaRouter
 
 logger = logging.getLogger(__name__)
+
+#: legal replica roles (docs/disaggregation.md). "prefill"/"decode" are
+#: the phase split; "any" is the generalist default every pool starts
+#: with. The field is deliberately a plain string so future fleet
+#: classes (model-size tiers, tenant SLO classes) ride the same router
+#: narrowing without a schema change.
+REPLICA_ROLES = ("prefill", "decode", "any")
 
 
 def partition_devices(devices: list, n: int) -> list[list]:
@@ -82,6 +101,10 @@ class PoolRecord:
     attempts: int = 1            # dispatches so far (1 = never requeued)
     pump: asyncio.Task | None = None
     done: bool = False
+    # disaggregation: this shadow is the one-token PREFILL leg of a
+    # migration — its "length" terminal means "hand off to a decode
+    # replica", not "budget spent" (docs/disaggregation.md)
+    migrate_leg: bool = False
 
 
 class EngineReplica:
@@ -89,17 +112,21 @@ class EngineReplica:
 
     STATES = ("ready", "draining", "reloading", "dead")
 
-    def __init__(self, rid: str, index: int, engine: TPUEngine) -> None:
+    def __init__(self, rid: str, index: int, engine: TPUEngine,
+                 role: str = "any") -> None:
         self.id = rid
         self.index = index
         self.engine = engine
         self.state = "ready"
+        self.role = role
         self.outstanding: dict[str, PoolRecord] = {}
         self.routed = 0
         self.requeued_off = 0
         self.reloads = 0
         self.failures = 0
         self.last_failure = ""
+        self.migrations_out = 0   # prefill legs this replica handed off
+        self.migrations_in = 0    # decode continuations it received
 
     def outstanding_tokens(self) -> int:
         """Budgeted work still owed: the router's least-loaded signal."""
@@ -112,6 +139,7 @@ class EngineReplica:
         return {
             "id": self.id,
             "state": self.state,
+            "role": self.role,
             "model": engine.config.model,
             "mesh_devices": int(engine.mesh.size),
             "dispatch_alive": engine.dispatch_alive(),
@@ -129,6 +157,8 @@ class EngineReplica:
             "engine_restarts": stats.engine_restarts,
             "routed": self.routed,
             "requeued_off": self.requeued_off,
+            "migrations_out": self.migrations_out,
+            "migrations_in": self.migrations_in,
             "reloads": self.reloads,
             "failures": self.failures,
             "last_failure": self.last_failure,
@@ -155,7 +185,10 @@ class EnginePool:
                  requeue_max: int = 2,
                  devices: list | None = None,
                  engine_factory: Callable[..., TPUEngine] | None = None,
-                 ledger=None, signals=None):
+                 ledger=None, signals=None,
+                 roles: str | Sequence[str] | None = None,
+                 disagg_prompt_tokens: int = 64,
+                 role_penalty_tokens: int = 256):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         self.config = config
@@ -218,17 +251,43 @@ class EnginePool:
                     "auto (1, %d) mesh per replica",
                     self._mesh_shape, per, replicas, per)
                 self._mesh_shape = ""
+        # disaggregation (docs/disaggregation.md): per-replica roles,
+        # assignable statically here (comma string from config or a
+        # sequence) and dynamically over set_role / the admin surface /
+        # the BusRpc lease plane. Short lists pad with "any"; bad role
+        # names refuse to boot rather than silently routing everything.
+        role_list: list[str] = []
+        if roles:
+            parts = (roles.split(",") if isinstance(roles, str)
+                     else list(roles))
+            role_list = [str(p).strip().lower() for p in parts
+                         if str(p).strip()]
+            for role in role_list:
+                if role not in REPLICA_ROLES:
+                    raise ValueError(
+                        f"unknown replica role {role!r} "
+                        f"(roles are {list(REPLICA_ROLES)})")
+        self.disagg_prompt_tokens = max(1, int(disagg_prompt_tokens))
         self.replicas: list[EngineReplica] = []
         for i in range(replicas):
             self.replicas.append(
-                EngineReplica(str(i), i, self._build_engine(i)))
+                EngineReplica(str(i), i, self._build_engine(i),
+                              role=(role_list[i] if i < len(role_list)
+                                    else "any")))
         self.router = ReplicaRouter(affinity=affinity_routing,
                                     index=self.prefix_index,
-                                    page_size=config.page_size)
+                                    page_size=config.page_size,
+                                    role_penalty_tokens=role_penalty_tokens)
         self.health = HealthMonitor(self, interval_s=health_interval_s,
                                     heartbeat_timeout_s=heartbeat_timeout_s)
         self.tokenizer = self.replicas[0].engine.tokenizer
         self.requeues = 0            # lint: thread[pool]
+        # migration accounting (conservation gate: pages spilled ==
+        # pages restored + pages degraded-in-place — pinned in tests)
+        self.migrations = {"ok": 0, "degraded": 0}        # lint: thread[pool]
+        self.migration_pages = {"spilled": 0, "restored": 0,
+                                "degraded": 0}            # lint: thread[pool]
+        self.migration_bytes = 0     # lint: thread[pool]
         self._started = False        # lint: thread[pool]
         self._stopping = False       # lint: thread[pool]
         self._set_up_gauges()
@@ -314,19 +373,95 @@ class EnginePool:
     def _routable(self) -> list[EngineReplica]:
         return [r for r in self.replicas if r.state == "ready"]
 
-    async def _dispatch(self, request: GenRequest, attempts: int) -> None:
+    # ------------------------------------------------------------------- roles
+
+    @property
+    def roles_active(self) -> bool:
+        """True once any replica holds a non-generalist role — the gate
+        on classification and migration (a uniform pool routes exactly
+        as it did before roles existed)."""
+        return any(r.role != "any" for r in self.replicas)
+
+    def set_role(self, rid: str, role: str) -> dict[str, Any]:  # lint: runs-on[pool]
+        """Reassign one replica's role live (admin surface / lease
+        plane). Routing-only state: nothing needs draining — in-flight
+        work finishes where it runs; only FUTURE admissions see the new
+        narrowing."""
+        replica = self._replica(rid)
+        role = str(role).strip().lower()
+        if role not in REPLICA_ROLES:
+            raise ValueError(f"role must be one of {list(REPLICA_ROLES)}, "
+                             f"got {role!r}")
+        if replica.role != role:
+            logger.info("engine pool: replica %s role %s -> %s",
+                        rid, replica.role, role)
+            replica.role = role
+        return replica.status()
+
+    def _classify(self, request: GenRequest) -> str:
+        """The admission's route class. An explicit ``route_class`` on
+        the request wins (the fleet-class hook); otherwise prompt length
+        splits the phase: long prompts are prefill-heavy, short ones
+        (chat turns, continuations) are decode-heavy."""
+        if not self.roles_active:
+            return ""
+        if request.route_class:
+            return request.route_class
+        return ("prefill"
+                if len(request.prompt_ids) >= self.disagg_prompt_tokens
+                else "decode")
+
+    def _migration_eligible(self, request: GenRequest, attempts: int,
+                            replica: EngineReplica) -> bool:
+        """Should this dispatch run as a one-token prefill leg that
+        hands off to a decode replica? Only a FIRST dispatch (a requeued
+        continuation already carries generated tokens and re-migrating
+        it re-pays the hop for no TTFT win), only on an actual prefill
+        replica (a spill onto "any" can just decode in place), only
+        with the shared tiers to carry the pages, at least one full
+        page to carry, more than one token still owed, and somewhere
+        decode-capable to land."""
+        return (attempts == 1 and not request.generated
+                and replica.role == "prefill"
+                and self.tier_store is not None
+                and request.max_tokens > 1
+                and len(request.prompt_ids) >= self.config.page_size
+                and any(r is not replica and r.state == "ready"
+                        and r.role in ("decode", "any")
+                        for r in self.replicas))
+
+    # ---------------------------------------------------------------- dispatch
+
+    async def _dispatch(self, request: GenRequest, attempts: int,
+                        pin: EngineReplica | None = None
+                        ) -> EngineReplica | None:
         """Pick a replica, submit the shadow, start the pump. Retries
-        across replicas when a submit itself fails (racing a crash)."""
+        across replicas when a submit itself fails (racing a crash).
+        Returns the replica the request landed on (None = capacity
+        exhausted, stream terminated "unavailable"). A non-None ``pin``
+        is tried FIRST (the migration path's chosen decode target, or
+        its decode-in-place degrade) and never re-classified or
+        re-migrated — a pin that refuses falls back to normal routing
+        so a dying target can never strand the stream."""
         last_error: Exception | None = None
-        for _ in range(len(self.replicas)):
-            routable = self._routable()
-            if not routable:
-                break
-            replica, affinity_hit = self.router.route(routable,
-                                                      request.prompt_ids)
-            shadow = self._make_shadow(request, attempts)
+        route_class = "" if pin is not None else self._classify(request)
+        for _ in range(len(self.replicas) + (1 if pin is not None else 0)):
+            if pin is not None and pin.state == "ready":
+                replica, affinity_hit = pin, False
+            else:
+                routable = self._routable()
+                if not routable:
+                    break
+                replica, affinity_hit = self.router.route(
+                    routable, request.prompt_ids, route_class)
+            migrate_leg = (pin is None and route_class == "prefill"
+                           and self._migration_eligible(request, attempts,
+                                                        replica))
+            shadow = self._make_shadow(request, attempts,
+                                       cap=1 if migrate_leg else 0)
             record = PoolRecord(request=request, shadow=shadow,
-                                replica=replica, attempts=attempts)
+                                replica=replica, attempts=attempts,
+                                migrate_leg=migrate_leg)
             try:
                 await replica.engine.submit(shadow)
             except RuntimeError as exc:
@@ -335,6 +470,8 @@ class EnginePool:
                 last_error = exc
                 self.fail_replica(replica, reason="submit refused: "
                                   f"{exc}")
+                if replica is pin:
+                    pin = None  # fall back to normal routing
                 continue
             if replica.state == "dead":
                 # the health sweep failed the replica while submit awaited
@@ -344,6 +481,8 @@ class EnginePool:
                 # terminal lands in it unobserved) and route a fresh one.
                 last_error = RuntimeError(
                     f"replica {replica.id} died during submit")
+                if replica is pin:
+                    pin = None
                 continue
             replica.routed += 1
             replica.outstanding[request.request_id] = record
@@ -356,7 +495,7 @@ class EnginePool:
                     affinity="hit" if affinity_hit else "miss").inc()
                 m.llm_pool_outstanding.labels(replica=replica.id).set(
                     len(replica.outstanding))
-            return
+            return replica
         # no replica could take it: this is CAPACITY loss, not a broken
         # request — terminate with the "unavailable" reason the serving
         # surface maps to a clean 503 + Retry-After (backpressure-header
@@ -367,19 +506,27 @@ class EnginePool:
         if request.finish_reason is None:
             request.finish_reason = "unavailable"
         request.stream.put_nowait(None)
+        return None
 
-    def _make_shadow(self, request: GenRequest, attempts: int) -> GenRequest:
+    def _make_shadow(self, request: GenRequest, attempts: int,
+                     cap: int = 0) -> GenRequest:
         """The engine-facing request. On a requeue the prompt is the
         CONTINUATION — original prompt plus every token already delivered
         — so the survivor resumes where the failed replica stopped and
         nothing is emitted twice; ``queue_observed`` rides the engine's
         once-only guard so the logical request's queue phase is observed
-        exactly once across attempts."""
+        exactly once across attempts. A non-zero ``cap`` bounds the
+        shadow's budget below the logical request's remainder: the
+        migration prefill leg runs with cap=1 (prefill + first token,
+        then hand off)."""
         suffix = "" if attempts == 1 else f"~r{attempts - 1}"
+        budget = max(1, request.max_tokens - len(request.generated))
+        if cap:
+            budget = min(budget, cap)
         return GenRequest(
             request_id=f"{request.request_id}{suffix}",
             prompt_ids=list(request.prompt_ids) + list(request.generated),
-            max_tokens=max(1, request.max_tokens - len(request.generated)),
+            max_tokens=budget,
             temperature=request.temperature,
             top_k=request.top_k,
             top_p=request.top_p,
@@ -431,10 +578,154 @@ class EnginePool:
                                   reason="stream error + dead dispatch")
             await self._requeue(record)
             return
+        if (record.migrate_leg and reason == "length"
+                and not self._stopping
+                and request.finish_reason is None
+                and len(request.generated) < request.max_tokens):
+            # the one-token prefill leg retired its cap, not the
+            # request's budget: hand the KV chain to a decode replica.
+            # (A "stop" terminal here means the first token really
+            # finished the request — it falls through as a normal
+            # terminal, nothing to migrate.)
+            await self._migrate(record)
+            return
         record.done = True
         if request.finish_reason is None:
             request.finish_reason = reason
         request.stream.put_nowait(None)
+
+    # --------------------------------------------------------------- migration
+
+    async def _migrate(self, record: PoolRecord) -> None:
+        """The prefill->decode hop (docs/disaggregation.md): export the
+        prompt's KV chain through the pool-shared spill tiers at the
+        source engine's drain barrier, verify every page against its
+        token content (the same verify-before-serve gate admission
+        restores use — a corrupt payload degrades to a MISS, never a
+        wrong page), then continue the request on a decode replica as a
+        pool-shadow continuation. ANY failed step decodes in place on
+        the prefill replica instead; the stream never dies to a
+        migration. Conservation: every spilled page is counted restored
+        (hop landed on the target) or degraded (anything else) —
+        spilled == restored + degraded, pinned in tests."""
+        from ...observability.faults import fault_point
+        from ..kv.prefix_index import chain_pages
+        request = record.request
+        src = record.replica
+        started = time.time()
+        page_size = self.config.page_size
+        expected = len(request.prompt_ids) // page_size
+        spilled = 0
+        moved_bytes = 0
+        corrupt = False
+        target: EngineReplica | None = None
+        failure = ""
+        try:
+            # fault point pool.migrate (docs/resilience.md): error fails
+            # the hop (degrade to decode-in-place), latency stretches it
+            # (the slow-migration chaos arm), corrupt mangles the chain
+            # identity below so verify-before-serve rejects the payload.
+            act = fault_point("pool.migrate", scope=request.request_id)
+            if act is not None:
+                if act.kind == "corrupt":
+                    corrupt = True
+                else:
+                    await act.async_apply()
+            # 1) export: the source engine copies the prompt chain's
+            # resident pages into the shared store at its dispatch-loop
+            # drain barrier (quiesced device state, same seam reload's
+            # spill-on-drain uses). COPY, not move — on any later
+            # failure the pages are still resident for decode-in-place.
+            spilled = await asyncio.wait_for(
+                asyncio.wrap_future(
+                    src.engine.request_chain_export(request.prompt_ids)),
+                timeout=30.0)
+            if spilled < expected:
+                raise RuntimeError(
+                    f"chain export covered {spilled}/{expected} pages")
+            # 2) verify-before-serve, pool-side: walk the exported chain
+            # through the store's payload gate with the token content we
+            # KNOW the decode replica will request. An injected corrupt
+            # mangles the first page's expected chunk, so the store's
+            # comparison fails exactly as a real collision would — the
+            # entry is dropped and the migration degrades.
+            steps = chain_pages(request.prompt_ids, page_size)
+            if corrupt and steps:
+                key_hash, parent, chunk = steps[0]
+                steps[0] = (key_hash, parent, (chunk[0] + 1,) + chunk[1:])
+            verified, moved_bytes = self.tier_store.verify_chain(steps)
+            if verified < expected:
+                raise RuntimeError(
+                    f"verify-before-serve passed {verified}/{expected} "
+                    f"pages")
+            # 3) pick the decode target: role-aware routing over the
+            # decode-capable survivors (never the source), scored on the
+            # continuation prompt so tier affinity counts.
+            candidates = [r for r in self._routable()
+                          if r is not src and r.role in ("decode", "any")]
+            if not candidates:
+                raise RuntimeError("no decode-capable target replica")
+            target, _ = self.router.route(
+                candidates,
+                list(request.prompt_ids) + list(request.generated),
+                route_class="decode")
+        except Exception as exc:  # FaultError included: degrade, never die
+            failure = str(exc)
+            target = None
+        if target is None:
+            logger.warning(
+                "engine pool: migration of %s degrading to "
+                "decode-in-place on replica %s (%s)", request.request_id,
+                src.id, failure or "no target",
+                extra=trace_extra(request.trace_ctx))
+        # 4) continue as a pool-shadow continuation (the requeue
+        # contract: prompt + generated, once-only TTFT/queue guards) —
+        # pinned to the chosen target, or to the source for the
+        # decode-in-place degrade. A pin that refuses falls back to
+        # normal routing inside _dispatch; a lost stream is impossible
+        # short of total pool capacity loss ("unavailable" terminal).
+        landed = await self._dispatch(request, attempts=record.attempts + 1,
+                                      pin=target if target is not None
+                                      else src)
+        outcome = ("ok" if target is not None and landed is target
+                   else "degraded")
+        self.migrations[outcome] += 1
+        self.migration_pages["spilled"] += spilled
+        self.migration_pages[
+            "restored" if outcome == "ok" else "degraded"] += spilled
+        self.migration_bytes += moved_bytes
+        if outcome == "ok":
+            src.migrations_out += 1
+            landed.migrations_in += 1
+        to_id = landed.id if landed is not None else src.id
+        m = self.metrics
+        if m is not None:
+            m.llm_pool_migrations.labels(src.id, to_id, outcome).inc()
+            m.llm_pool_migration_seconds.observe(time.time() - started)
+            if spilled:
+                m.llm_pool_migration_pages.labels("spilled").inc(spilled)
+                m.llm_pool_migration_pages.labels(
+                    "restored" if outcome == "ok" else "degraded"
+                ).inc(spilled)
+            if moved_bytes:
+                m.llm_pool_migration_bytes.inc(moved_bytes)
+        if self.tracer is not None and request.trace_ctx is not None:
+            # the hop as a span: joins the prefill replica's llm.* spans
+            # to the decode replica's in ONE trace (span-stitch contract)
+            try:
+                attrs = {"llm.from_replica": src.id,
+                         "llm.to_replica": to_id,
+                         "llm.pages": spilled,
+                         "llm.outcome": outcome}
+                if failure:
+                    attrs["llm.failure"] = failure[:200]
+                if request.tenant:
+                    attrs["llm.tenant"] = request.tenant
+                self.tracer.emit_span("pool.migrate", started, time.time(),
+                                      trace_ctx=request.trace_ctx,
+                                      attributes=attrs)
+            except Exception:
+                pass  # telemetry must never break the hop
 
     # ---------------------------------------------------------------- failover
 
@@ -749,6 +1040,16 @@ class EnginePool:
                           if self.tier_store is not None else None),
                 "index": (self.prefix_index.stats()
                           if self.prefix_index is not None else None),
+            },
+            "roles": {
+                "active": self.roles_active,
+                "assignment": {r.id: r.role for r in self.replicas},
+                "disagg_prompt_tokens": self.disagg_prompt_tokens,
+            },
+            "migrations": {
+                **self.migrations,
+                "pages": dict(self.migration_pages),
+                "bytes": self.migration_bytes,
             },
             "requeues": self.requeues,
             "requeue_max": self.requeue_max,

@@ -26,6 +26,16 @@ xLLM's scheduler makes the same trade):
    requests of their remaining ``max_tokens``), the pool's proxy for
    time-to-first-slot. Ties break round-robin so cold starts spread.
 
+With ROLES assigned (docs/disaggregation.md), a third signal runs
+FIRST: a classed request ("prefill"/"decode" for the phase split, or
+any fleet class routed behind the same field) is scored only over
+replicas holding that exact role plus the "any" generalists — the
+latter carrying a configurable outstanding-token penalty, so an
+oversubscribed exact-role tier spills onto idle generalist capacity
+but never loses to it at load parity. A class no replica serves falls
+back to the full routable set: roles shape placement, they never
+refuse capacity.
+
 Priority rides THROUGH the router untouched: admission classes are a
 per-replica scheduler concern (the engine's priority-sorted pending
 queue), not a placement one — a pool that sent all priority-0 traffic
@@ -48,32 +58,51 @@ class ReplicaRouter:
 
     def __init__(self, affinity: bool = True,
                  index: "PrefixIndex | None" = None,
-                 page_size: int = 0) -> None:
+                 page_size: int = 0,
+                 role_penalty_tokens: int = 256) -> None:
         self.affinity_routing = affinity
         self._index = index
         self._page_size = page_size
+        self.role_penalty_tokens = max(0, int(role_penalty_tokens))
         self.routed = 0           # lint: thread[pool]
         self.affinity_hits = 0    # lint: thread[pool]
         self.index_hits = 0       # routes the pool index steered  # lint: thread[pool]
+        self.role_routed = 0      # classed routes an exact role served  # lint: thread[pool]
+        self.role_spills = 0      # classed routes an "any" replica took  # lint: thread[pool]
         self._rr = 0              # round-robin tiebreak cursor  # lint: thread[pool]
 
     def route(self, replicas: Sequence["EngineReplica"],  # lint: runs-on[pool]  # lint: hot-path
-              prompt_ids: list[int]) -> tuple["EngineReplica", bool]:
+              prompt_ids: list[int],
+              route_class: str = "") -> tuple["EngineReplica", bool]:
         """Pick a replica for ``prompt_ids`` among ``replicas`` (already
         filtered to routable ones, non-empty). Returns (replica,
         affinity_hit). On the submit hot path: pure host-side scoring
         (dict walks over the allocator and the pool index), no device
         sync. A single routable replica still scores — the affinity
         accounting must stay truthful when the pool is degraded to one
-        survivor."""
-        choice, hit = self._score(replicas, prompt_ids)
+        survivor. A non-empty ``route_class`` narrows the candidate set
+        to exact-role + "any" replicas (module doc), falling back to the
+        full set when the class is unserved."""
+        candidates: Sequence["EngineReplica"] = replicas
+        if route_class:
+            narrowed = [r for r in replicas
+                        if r.role in (route_class, "any")]
+            if narrowed:
+                candidates = narrowed
+        choice, hit = self._score(candidates, prompt_ids, route_class)
         self.routed += 1
         if hit:
             self.affinity_hits += 1
+        if route_class:
+            if choice.role == route_class:
+                self.role_routed += 1
+            elif choice.role == "any":
+                self.role_spills += 1
         return choice, hit
 
     def _score(self, replicas: Sequence["EngineReplica"],
-               prompt_ids: list[int]) -> tuple["EngineReplica", bool]:
+               prompt_ids: list[int],
+               route_class: str = "") -> tuple["EngineReplica", bool]:
         best = None
         best_key = None
         best_hist = 0
@@ -102,9 +131,13 @@ class ReplicaRouter:
                             from_index = True
                     if hist < engine.config.page_size:
                         hist = 0  # sub-page match saves no prefill
-            # max affinity, then min outstanding tokens, then round-robin
-            key = (-hist, replica.outstanding_tokens(),
-                   (i + self._rr) % len(replicas))
+            # max affinity, then min outstanding tokens (generalists pay
+            # the role penalty so exact-role replicas win at load parity
+            # while an oversubscribed tier still spills), then round-robin
+            load = replica.outstanding_tokens()
+            if route_class and replica.role != route_class:
+                load += self.role_penalty_tokens
+            key = (-hist, load, (i + self._rr) % len(replicas))
             if best_key is None or key < best_key:
                 best, best_key, best_hist = replica, key, hist
                 best_from_index = from_index and hist > 0
@@ -114,4 +147,6 @@ class ReplicaRouter:
 
     def counters(self) -> dict[str, int]:
         return {"routed": self.routed, "affinity_hits": self.affinity_hits,
-                "index_hits": self.index_hits}
+                "index_hits": self.index_hits,
+                "role_routed": self.role_routed,
+                "role_spills": self.role_spills}

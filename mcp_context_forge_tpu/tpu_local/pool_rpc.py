@@ -29,6 +29,10 @@ Wire shapes (all JSON over the bus):
   pool.embed       {"texts", "model", "tenant"} -> {"ok", "result"}
   pool.classify    {"texts", "tenant"} -> {"ok", "result"}
   pool.status      {} -> owner stats (worker id, provider wired, models)
+  pool.set_role    {"replica", "role"} -> {"ok", "result": replica status}
+                   — the disaggregation lease plane: any worker can
+                   retarget the owner pool's prefill/decode/any split
+                   live (docs/disaggregation.md)
 """
 
 from __future__ import annotations
@@ -75,6 +79,7 @@ class SharedEnginePlane:
         self.rpc.register("pool.embed", self._serve_embed)
         self.rpc.register("pool.classify", self._serve_classify)
         self.rpc.register("pool.status", self._serve_status)
+        self.rpc.register("pool.set_role", self._serve_set_role)
         self.rpc.register_stream("pool.chat_stream", self._serve_chat_stream)
         if self._task is None:
             self._task = asyncio.get_running_loop().create_task(
@@ -231,6 +236,22 @@ class SharedEnginePlane:
         finally:
             tenant_ctx.reset_current_tenant(token)
 
+    async def _serve_set_role(self, params: dict[str, Any]
+                              ) -> dict[str, Any]:
+        """Live role reassignment on the owner pool (disaggregation's
+        dynamic lease plane): routing-only state, no drain needed."""
+        try:
+            pool = getattr(self._local(), "engine", None)
+            set_role = getattr(pool, "set_role", None)
+            if set_role is None:
+                raise LLMError("owner provider is not pool-backed "
+                               "(roles need an EnginePool)")
+            return {"ok": True,
+                    "result": set_role(str(params.get("replica", "")),
+                                       str(params.get("role", "")))}
+        except (LLMError, KeyError, ValueError) as exc:
+            return self._fail(exc)
+
     async def _serve_status(self, params: dict[str, Any]) -> dict[str, Any]:
         provider = self.local_provider
         return {"worker_id": self.worker_id, "is_owner": self.is_owner,
@@ -256,7 +277,8 @@ class SharedEnginePlane:
         if self.ready_local:
             handler = {"pool.chat": self._serve_chat,
                        "pool.embed": self._serve_embed,
-                       "pool.classify": self._serve_classify}[method]
+                       "pool.classify": self._serve_classify,
+                       "pool.set_role": self._serve_set_role}[method]
             return self._raise_remote(await handler(params))
         owner = await self._remote_owner()
         try:
@@ -307,6 +329,12 @@ class SharedEnginePlane:
 
     async def classify(self, texts: list[str]) -> list[float]:
         return await self._call("pool.classify", {"texts": texts})
+
+    async def set_role(self, replica: str, role: str) -> dict[str, Any]:
+        """Retarget one owner-pool replica's role from ANY worker — the
+        dynamic half of disaggregation's role assignment."""
+        return await self._call("pool.set_role",
+                                {"replica": replica, "role": role})
 
     def stats(self) -> dict[str, Any]:
         return {"worker_id": self.worker_id, "is_owner": self.is_owner,

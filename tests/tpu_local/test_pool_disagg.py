@@ -1,0 +1,365 @@
+"""Disaggregated prefill/decode pool: role routing + live KV-page
+migration (ISSUE 17 / docs/disaggregation.md).
+
+The contract, falsifiable:
+
+- long admissions land on a PREFILL replica capped at one token, the
+  prompt's KV chain migrates through the pool-shared spill tiers
+  (export at the drain barrier -> verify-before-serve -> fetch-on-miss
+  restore), and decode continues on a DECODE replica with EXACT greedy
+  parity vs an unmigrated single engine — the hop is invisible in the
+  token stream;
+- conservation: every spilled page is counted restored (hop landed) or
+  degraded (decode-in-place) — spilled == restored + degraded, always;
+- ANY failed step — an armed ``pool.migrate`` error fault, a corrupt
+  payload rejected by the verify gate, the decode target dying at
+  hand-off — degrades to decode-in-place on the prefill replica with
+  zero lost and zero duplicated tokens, never a dead stream;
+- the int8-resident pool round-trips its pages bit-exactly across the
+  hop (spills carry resident precision verbatim);
+- tenant accounting conserves across the hop: ledger column sums still
+  equal the untagged engine totals, and per-tenant generated tokens
+  equal what each tenant's clients received;
+- the role-aware router serves classed admissions on exact-role
+  replicas at load parity and spills an oversubscribed prefill tier to
+  ``any`` generalists (counted as ``role_spills``).
+"""
+
+import asyncio
+
+import pytest
+
+from mcp_context_forge_tpu.observability.faults import (FaultRule,
+                                                        configure_fault_plane,
+                                                        get_fault_plane)
+from mcp_context_forge_tpu.observability.metering import TenantLedger
+from mcp_context_forge_tpu.observability.metrics import PrometheusRegistry
+from mcp_context_forge_tpu.observability.tenant import TenantClamp
+from mcp_context_forge_tpu.tpu_local.engine import (EngineConfig, GenRequest,
+                                                    TPUEngine)
+from mcp_context_forge_tpu.tpu_local.pool import EnginePool
+
+PS = 16
+# ~88 char-level tokens on the llama3-test tokenizer: 5 full pages, far
+# past the disagg threshold (PS) — the canonical migrating admission
+LONG_PROMPT = "the quick brown fox jumps over the lazy dog " * 2
+# 8 tokens < PS: stays on the decode tier, never migrates
+CHAT_PROMPT = "hi there"
+
+
+@pytest.fixture(autouse=True)
+def _hermetic_fault_plane():
+    yield
+    configure_fault_plane(False)
+
+
+def _config(**overrides):
+    kwargs = dict(model="llama3-test", max_batch=4, max_seq_len=128,
+                  page_size=PS, num_pages=64, prefill_buckets=(16, 64),
+                  dtype="float32", attn_impl="reference",
+                  prefix_cache=True, prefix_tiers=True,
+                  tier_host_bytes=64 << 20, tier_disk_bytes=0)
+    kwargs.update(overrides)
+    return EngineConfig(**kwargs)
+
+
+def _pool(replicas=2, roles="prefill,decode", **overrides):
+    health = overrides.pop("health_interval_s", 0.05)
+    beat = overrides.pop("heartbeat_timeout_s", 10.0)
+    disagg = overrides.pop("disagg_prompt_tokens", PS)
+    extra = {k: overrides.pop(k) for k in
+             ("metrics", "ledger") if k in overrides}
+    return EnginePool(_config(**overrides), replicas=replicas, roles=roles,
+                      disagg_prompt_tokens=disagg, health_interval_s=health,
+                      heartbeat_timeout_s=beat, **extra)
+
+
+async def _reference(prompt, max_tokens=12, **overrides):
+    """What a single uninterrupted (unmigrated) engine produces."""
+    overrides.setdefault("prefix_tiers", False)
+    engine = TPUEngine(_config(**overrides))
+    await engine.start()
+    try:
+        ids = engine.tokenizer.encode(prompt)
+        return [t async for t in engine.generate(ids,
+                                                 max_tokens=max_tokens)]
+    finally:
+        await engine.stop()
+
+
+async def _run(pool, prompt, rid, max_tokens=12, tenant=""):
+    ids = pool.tokenizer.encode(prompt)
+    request = GenRequest(request_id=rid, prompt_ids=ids,
+                         max_tokens=max_tokens, tenant=tenant)
+    await pool.submit(request)
+    out = []
+    while True:
+        token = await asyncio.wait_for(request.stream.get(), 120)
+        if token is None:
+            break
+        out.append(token)
+    return request, out
+
+
+def _assert_conserved_pages(pool):
+    pages = pool.migration_pages
+    assert pages["spilled"] == pages["restored"] + pages["degraded"], pages
+
+
+# ------------------------------------------------------------ role plumbing
+
+def test_role_assignment_validation_and_status_surface():
+    pool = _pool()
+    assert [r.role for r in pool.replicas] == ["prefill", "decode"]
+    assert pool.roles_active is True
+    status = pool.status()
+    assert status["roles"]["active"] is True
+    assert status["roles"]["assignment"] == {"0": "prefill", "1": "decode"}
+    assert status["roles"]["disagg_prompt_tokens"] == PS
+    assert status["migrations"]["ok"] == 0
+    assert status["migrations"]["degraded"] == 0
+    assert status["migrations"]["pages"] == {"spilled": 0, "restored": 0,
+                                             "degraded": 0}
+    assert status["migrations"]["bytes"] == 0
+    rep = pool.replicas[1].status()
+    assert rep["role"] == "decode"
+    assert rep["migrations_out"] == 0 and rep["migrations_in"] == 0
+    # live reassignment (the admin action / lease plane entry point)
+    out = pool.set_role("1", "any")
+    assert out["role"] == "any" and pool.replicas[1].role == "any"
+    pool.set_role("1", "decode")
+    with pytest.raises(ValueError):
+        pool.set_role("1", "bogus")
+    with pytest.raises(KeyError):
+        pool.set_role("9", "decode")
+    # config-string parsing: invalid roles refuse at build, short lists
+    # pad with "any" generalists
+    with pytest.raises(ValueError):
+        _pool(roles="prefill,bogus")
+    padded = _pool(roles="prefill")
+    assert [r.role for r in padded.replicas] == ["prefill", "any"]
+    uniform = _pool(roles="")
+    assert uniform.roles_active is False
+    assert [r.role for r in uniform.replicas] == ["any", "any"]
+
+
+def test_role_router_oversubscribed_prefill_spills_to_any():
+    """Classed routing at load parity picks the exact-role replica; an
+    oversubscribed prefill tier spills to an ``any`` generalist (the
+    penalty is a preference, not a partition) — both counted."""
+    pool = _pool(roles="prefill,any")
+    r_prefill, r_any = pool.replicas
+    ids = pool.tokenizer.encode(LONG_PROMPT)
+    choice, _ = pool.router.route(list(pool.replicas), ids,
+                                  route_class="prefill")
+    assert choice is r_prefill
+    assert pool.router.role_routed == 1
+    assert pool.router.role_spills == 0
+    # oversubscribe the prefill replica far past the role penalty: the
+    # generalist must absorb the admission
+    r_prefill.outstanding_tokens = lambda: 10_000
+    choice, _ = pool.router.route(list(pool.replicas), ids,
+                                  route_class="prefill")
+    assert choice is r_any
+    assert pool.router.role_spills == 1
+    # a decode-classed admission with NO decode replica in the pool can
+    # only land on the generalist — also a spill
+    choice, _ = pool.router.route(list(pool.replicas), ids,
+                                  route_class="decode")
+    assert choice is r_any
+    assert pool.router.role_spills == 2
+    assert pool.router.counters()["role_spills"] == 2
+
+
+# ---------------------------------------------------------- the happy hop
+
+def test_migration_greedy_parity_vs_unmigrated_engine():
+    """The tentpole: a long admission prefills on the prefill replica,
+    migrates its KV chain through the shared tiers, decodes on the
+    decode replica — and the merged stream is byte-identical to an
+    unmigrated single engine. Short chat turns never migrate."""
+    async def main():
+        ref_long = await _reference(LONG_PROMPT)
+        ref_chat = await _reference(CHAT_PROMPT)
+        pool = _pool()
+        await pool.start()
+        try:
+            request, out = await _run(pool, LONG_PROMPT, "mig-1")
+            _, chat = await _run(pool, CHAT_PROMPT, "chat-1")
+        finally:
+            await pool.stop()
+        assert out == ref_long                    # zero loss, zero dupes
+        assert chat == ref_chat
+        assert request.finish_reason in ("stop", "length")
+        assert pool.migrations == {"ok": 1, "degraded": 0}
+        expected_pages = len(pool.tokenizer.encode(LONG_PROMPT)) // PS
+        assert pool.migration_pages == {"spilled": expected_pages,
+                                        "restored": expected_pages,
+                                        "degraded": 0}
+        assert pool.migration_bytes > 0
+        _assert_conserved_pages(pool)
+        # the hop is visible on the replica counters, and only the long
+        # admission took it
+        assert pool.replicas[0].migrations_out == 1
+        assert pool.replicas[1].migrations_in == 1
+        assert pool.router.role_routed >= 1
+        assert pool.requeues == 0                # migration is not failover
+        status = pool.status()
+        assert status["migrations"]["ok"] == 1
+
+    asyncio.run(main())
+
+
+def test_int8_pool_migration_is_bit_exact():
+    """The int8-resident pool spills its pages at resident precision:
+    the migrated continuation must match an unmigrated int8 engine
+    token-for-token (bit-exact page round trip through the hop)."""
+    async def main():
+        ref = await _reference(LONG_PROMPT, kv_quant="int8")
+        pool = _pool(kv_quant="int8")
+        await pool.start()
+        try:
+            _, out = await _run(pool, LONG_PROMPT, "mig-int8")
+        finally:
+            await pool.stop()
+        assert out == ref
+        assert pool.migrations == {"ok": 1, "degraded": 0}
+        _assert_conserved_pages(pool)
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------------- degradation ladder
+
+def test_pool_migrate_error_fault_degrades_to_decode_in_place():
+    """An armed ``pool.migrate`` error fault fails the hop BEFORE the
+    export: the admission decodes in place on the prefill replica, the
+    stream stays byte-identical, and the failure is counted degraded
+    with zero pages moved (conservation holds trivially)."""
+    async def main():
+        ref = await _reference(LONG_PROMPT)
+        plane = configure_fault_plane(True)
+        plane.arm(FaultRule(point="pool.migrate", kind="error"))
+        pool = _pool()
+        await pool.start()
+        try:
+            request, out = await _run(pool, LONG_PROMPT, "mig-err")
+        finally:
+            await pool.stop()
+        assert out == ref                        # never a lost stream
+        assert request.finish_reason in ("stop", "length")
+        assert pool.migrations == {"ok": 0, "degraded": 1}
+        assert pool.migration_pages == {"spilled": 0, "restored": 0,
+                                        "degraded": 0}
+        _assert_conserved_pages(pool)
+        assert pool.replicas[0].migrations_out == 0
+        assert pool.replicas[1].migrations_in == 0
+        snap = get_fault_plane().snapshot()
+        assert any(r["point"] == "pool.migrate" and r["fired"] >= 1
+                   for r in snap["rules"])
+
+    asyncio.run(main())
+
+
+def test_pool_migrate_corrupt_fault_degrades_via_verify_miss():
+    """A corrupt payload must never reach the decode replica: the armed
+    corrupt fault mangles the chain identity, verify-before-serve
+    rejects it as a MISS, and the hop degrades — pages were spilled but
+    none restored (the degraded bucket absorbs them)."""
+    async def main():
+        ref = await _reference(LONG_PROMPT)
+        plane = configure_fault_plane(True)
+        plane.arm(FaultRule(point="pool.migrate", kind="corrupt"))
+        pool = _pool()
+        await pool.start()
+        try:
+            _, out = await _run(pool, LONG_PROMPT, "mig-corrupt")
+        finally:
+            await pool.stop()
+        assert out == ref
+        assert pool.migrations == {"ok": 0, "degraded": 1}
+        pages = pool.migration_pages
+        assert pages["spilled"] >= 1             # the export DID run
+        assert pages["restored"] == 0            # the gate held
+        assert pages["degraded"] == pages["spilled"]
+        _assert_conserved_pages(pool)
+
+    asyncio.run(main())
+
+
+def test_kill_decode_target_at_handoff_falls_back_in_place():
+    """Chaos: the chosen decode target dies exactly at hand-off (its
+    submit refuses). The pinned dispatch falls back to normal routing,
+    the stream finishes on the survivor (the prefill source, decoding
+    in place) with zero lost and zero duplicated tokens, and the hop is
+    counted degraded."""
+    async def main():
+        ref = await _reference(LONG_PROMPT)
+        pool = _pool()
+        await pool.start()
+        try:
+            async def refuse(shadow):
+                raise RuntimeError("injected: target killed at hand-off")
+            pool.replicas[1].engine.submit = refuse
+            request, out = await _run(pool, LONG_PROMPT, "mig-kill")
+        finally:
+            await pool.stop()
+        assert out == ref                        # zero loss, zero dupes
+        assert request.finish_reason in ("stop", "length")
+        assert pool.migrations == {"ok": 0, "degraded": 1}
+        pages = pool.migration_pages
+        assert pages["spilled"] >= 1 and pages["restored"] == 0
+        _assert_conserved_pages(pool)
+        # the refusing target was failed over; the source finished the work
+        assert pool.replicas[1].state == "dead"
+        assert pool.replicas[0].migrations_out == 0
+
+    asyncio.run(main())
+
+
+# -------------------------------------------------------------- accounting
+
+def test_tenant_conservation_across_the_migration_hop():
+    """The migration hop must be billing-invisible: ledger column sums
+    still equal the untagged engine totals (both legs count their
+    shadows identically on both sides), and per-tenant generated tokens
+    equal what each tenant's client actually received."""
+    async def main():
+        registry = PrometheusRegistry(tenant_clamp=TenantClamp(8))
+        ledger = TenantLedger(clamp=registry.tenant_clamp, metrics=registry)
+        pool = _pool(metrics=registry, ledger=ledger)
+        await pool.start()
+        try:
+            results = await asyncio.gather(
+                _run(pool, LONG_PROMPT, "acct-long", tenant="team:mig"),
+                _run(pool, CHAT_PROMPT + " one", "acct-c1",
+                     tenant="team:chat"),
+                _run(pool, CHAT_PROMPT + " two", "acct-c2",
+                     tenant="team:chat"))
+        finally:
+            await pool.stop()
+        assert all(tokens for _, tokens in results)
+        assert pool.migrations["ok"] + pool.migrations["degraded"] == 1
+        _assert_conserved_pages(pool)
+        sums = ledger.column_sums()
+        stats = pool.stats
+        assert sums["prompt_tokens"] == stats.prompt_tokens, (
+            sums, vars(stats))
+        assert sums["generated_tokens"] == stats.completion_tokens, (
+            sums, vars(stats))
+        hit_tokens = sum(r.engine.allocator.prefix_hit_tokens
+                         for r in pool.replicas)
+        assert sums["cache_hit_tokens"] == hit_tokens, (sums, hit_tokens)
+        # per-tenant: generated == delivered (no lost or double billing
+        # across the prefill leg + decode continuation)
+        delivered = {}
+        for request, tokens in results:
+            delivered[request.tenant] = (delivered.get(request.tenant, 0)
+                                         + len(tokens))
+        totals = ledger.totals()
+        for tenant, count in delivered.items():
+            assert totals[tenant]["generated_tokens"] == count, (
+                tenant, totals[tenant], delivered)
+        assert "unattributed" not in totals
+
+    asyncio.run(main())

@@ -147,6 +147,43 @@ def test_bench_engine_kv_quant_ab_arm(bench_env, monkeypatch):
     assert ab["token_parity_rate"] == 1.0
 
 
+def test_bench_engine_disagg_ab_arm(bench_env, monkeypatch):
+    """BENCH_DISAGG=1: the disaggregated prefill/decode A/B — uniform
+    pool vs prefill+decode role split on the same mixed long-prefill +
+    chat load. The role arm must actually migrate, its page counters
+    must conserve, and greedy parity across arms must be exact (the
+    migration hop is the requeue continuation contract)."""
+    import bench_engine
+
+    monkeypatch.setenv("BENCH_DISAGG", "1")
+    monkeypatch.setenv("BENCH_TOKENS", "8")
+    monkeypatch.setenv("BENCH_DISAGG_LONG", "2")
+    monkeypatch.setenv("BENCH_DISAGG_CHAT", "2")
+    monkeypatch.setattr(bench_engine, "pin_platform", lambda: "cpu")
+    out = bench_engine.main()
+    assert out["roles"] == ["prefill", "decode"]  # bench_trend arms on this
+    ab = out["disagg_ab"]
+    uniform, disagg = ab["uniform"], ab["disagg"]
+    assert "token_streams" not in uniform and "token_streams" not in disagg
+    assert uniform["roles"] == [] and disagg["roles"] == ["prefill", "decode"]
+    assert uniform["value"] > 0 and disagg["value"] > 0
+    assert uniform["ttft_p95_ms"] is not None
+    assert disagg["tpot_p95_ms"] is not None
+    # the uniform arm never migrates; the role arm must migrate every
+    # long admission (2 here) and lose none of them
+    assert uniform["migrations"] == {"ok": 0, "degraded": 0}
+    assert disagg["migrations"]["ok"] >= 1
+    assert disagg["migrations"]["ok"] + disagg["migrations"]["degraded"] == 2
+    # conservation: every spilled page is restored or degraded-in-place
+    pages = disagg["migration_pages"]
+    assert pages["spilled"] == pages["restored"] + pages["degraded"]
+    assert pages["spilled"] >= 1
+    assert ab["pages_conserved"] is True
+    assert disagg["router"]["role_routed"] >= 1
+    assert ab["token_parity_rate"] == 1.0
+    assert uniform["requeues"] == 0 and disagg["requeues"] == 0
+
+
 def test_bench_engine_prefix_tiers_ab_arm(bench_env, monkeypatch):
     """BENCH_PREFIX_TIERS=1: the shared-prefix pressure A/B — at the
     same fixed HBM page budget the tiers-on arm must serve >= 2x the

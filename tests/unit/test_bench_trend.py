@@ -204,7 +204,8 @@ def test_first_capture_of_a_new_arm_is_surfaced_not_silent(tmp_path, capsys):
     series = next(r for r in report["series"] if r["series"] == "BENCH_TPU")
     assert series["new_arms"] == [
         {"superstep": 8, "prefix_tiers": False, "workers": 1,
-         "controller": False, "capture": "BENCH_TPU_r03.json"}]
+         "controller": False, "roles": [],
+         "capture": "BENCH_TPU_r03.json"}]
     assert main(["--root", str(tmp_path)]) == 0
     out = capsys.readouterr().out
     assert "no history to gate yet" in out
@@ -257,3 +258,32 @@ def test_controller_captures_gate_as_their_own_arm(tmp_path):
               if c["metric"] == "value"}
     assert by_arm[False]["regressed"] is False
     assert by_arm[True]["regressed"] is True
+
+
+def test_roles_captures_gate_as_their_own_arm(tmp_path):
+    """A disaggregated capture (BENCH_DISAGG: prefill+decode role split,
+    migration hops in the TTFT path) is a different serving regime than
+    the uniform pool — it must only median against same-roles history,
+    and a regression inside the arm must name the split."""
+    _write_series(tmp_path, "BENCH_DISAGG", [
+        _capture(100.0),                                     # uniform
+        {**_capture(80.0), "roles": ["prefill", "decode"]},
+        _capture(101.0),                                     # uniform
+        {**_capture(79.0), "roles": ["prefill", "decode"]},
+    ])
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert report["ok"], report["regressions"]
+    assert report["checks"] >= 4          # both arms actually compared
+    # a disagg-arm collapse is caught within the arm and labelled
+    (tmp_path / "BENCH_DISAGG_r05.json").write_text(json.dumps(
+        {**_capture(20.0), "roles": ["prefill", "decode"]}))
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert not report["ok"]
+    assert any("@roles=prefill,decode" in line
+               for line in report["regressions"])
+    # the uniform arm stayed green: the collapse did not bleed across
+    by_arm = {tuple(c["roles"]): c
+              for r in report["series"] for c in r["checks"]
+              if c["metric"] == "value"}
+    assert by_arm[()]["regressed"] is False
+    assert by_arm[("prefill", "decode")]["regressed"] is True

@@ -53,6 +53,14 @@ GRID = [
     # adaptive-K holds the static-K=8 tok/s while cutting TTFT p95 in
     # the interactive phases, with zero serving-stage XLA compiles
     {"BENCH_SUPERSTEP": "8", "BENCH_SPEC": "0", "BENCH_CONTROLLER": "1"},
+    # disaggregated prefill/decode A/B on real silicon: a 2-replica pool
+    # (device-subset meshes) serving the mixed long-prefill + chat load
+    # uniform vs role-split — the on-silicon question is whether the
+    # KV-page migration hop (spill + verify + restore through the shared
+    # host tier) stays cheaper than the long-prefill HBM stall it moves
+    # off the decode replica (TTFT p95 delta at token parity 1.0)
+    {"BENCH_SUPERSTEP": "1", "BENCH_SPEC": "0", "BENCH_DISAGG": "1",
+     "BENCH_REPLICAS": "2"},
     # decode-width bucketing: 3.6x on the CPU proxy at light load; the
     # open question is the donated-pool re-home cost on real HBM
     {"BENCH_SUPERSTEP": "1", "BENCH_SPEC": "0",
