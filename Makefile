@@ -1,6 +1,6 @@
 # mcp-context-forge-tpu (reference: 8.7k-line Makefile; the targets that matter)
 
-.PHONY: serve hub lint bench-check test test-py test-fast test-two-process bench bench-engine bench-superstep bench-scenarios bench-chaos wrapper masking clean \
+.PHONY: serve hub lint bench-check test test-py test-fast test-two-process bench bench-engine bench-superstep bench-scenarios bench-workers-real bench-chaos wrapper masking clean \
 	sanitize sanitize-tsan sanitize-asan
 
 serve:
@@ -60,6 +60,17 @@ bench-superstep:
 # them per arm.
 # CPU smoke variant runs in tier-1 (tests/unit/test_bench_scenarios_smoke.py).
 bench-scenarios:
+	python bench_gateway_scenarios.py
+
+# real-process fleet arm only (docs/load_harness.md "real-process
+# topology"): forks N `mcpforge serve` workers on one SO_REUSEPORT
+# socket behind a hub process — the same path `mcpforge supervise`
+# runs in production — and gates scaleup against the honest
+# 0.8*min(workers, host_cpus) bar. Capture carries in_process:false so
+# bench-check judges it as its own arm, never against in-process rounds.
+bench-workers-real:
+	BENCH_SCENARIO_ONLY=workers-real BENCH_REAL_PROCS=1 \
+	BENCH_SCENARIO_ENFORCE_SLO=1 \
 	python bench_gateway_scenarios.py
 
 # chaos matrix only (docs/resilience.md): fault-injection arms —

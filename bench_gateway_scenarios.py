@@ -58,6 +58,12 @@ Env knobs:
                                  (double-commits device memory; off on TPU)
     BENCH_SCENARIO_ENFORCE_SLO=1 breached SLO windows fail the run
     BENCH_SCENARIO_ONLY=a,b      run a subset of scenarios
+    BENCH_REAL_PROCS=1           include the gated "workers-real" arm in a
+                                 full run (it always runs when named in
+                                 BENCH_SCENARIO_ONLY); spawns a REAL
+                                 supervised process fleet
+    BENCH_GW_REAL_WORKERS=N      real-process fleet size (default 4)
+    BENCH_PIN_CPUS=1             pass --pin-cpus semantics to the real fleet
 """
 
 from __future__ import annotations
@@ -82,7 +88,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)) or ".")
 # THEIR app (see _rebind_resilience_plane).
 SCENARIOS = ("burst", "ramp", "mixed", "tenant", "db-outage",
              "tier-fault", "overload-shed", "controller", "chaos",
-             "workers")
+             "workers", "workers-real")
 
 
 def _smoke() -> bool:
@@ -196,6 +202,15 @@ async def _make_gateway(platform: str, replicas: int = 2,
             "BENCH_SCENARIO_TTFT_MS", "30000" if platform != "tpu" else "2500"),
         "MCPFORGE_SLO_TPOT_P95_MS": os.environ.get(
             "BENCH_SCENARIO_TPOT_MS", "30000" if platform != "tpu" else "250"),
+        # http/queue get the same proxy-box hook as ttft/tpot above:
+        # defaults stay production-shaped so breach REPORTING keeps
+        # being exercised, and an ENFORCED run on a CPU proxy sets
+        # these to what the box can actually promise (the TPU
+        # acceptance posture keeps the defaults)
+        "MCPFORGE_SLO_QUEUE_WAIT_P95_MS": os.environ.get(
+            "BENCH_SCENARIO_QUEUE_MS", "1500"),
+        "MCPFORGE_SLO_HTTP_P95_MS": os.environ.get(
+            "BENCH_SCENARIO_HTTP_MS", "1000"),
         # tenant metering + SLO classes (scenario "tenant"): premium and
         # batch bundles assigned to the scenario's minted users; rollup
         # interval long — the scenario flushes explicitly for determinism
@@ -284,6 +299,97 @@ async def _register_echo_tool(client, auth, name: str):
     return upstream
 
 
+# phase-bucket accounting (docs/observability.md): every hot-path claim
+# in this harness is justified by a BEFORE/AFTER delta of the
+# mcpforge_gw_request_phase_seconds sums — "serialize went from 18% to
+# 6% of wall" is readable straight from the capture, per arm
+_PHASE_SUM_RE = re.compile(
+    r'^mcpforge_gw_request_phase_seconds_sum\{([^}]*)\}\s+([0-9eE+.\-]+)',
+    re.MULTILINE)
+_PHASE_LABEL_RE = re.compile(r'phase="([^"]+)"')
+
+
+def _phase_sums(text: str) -> dict[str, float]:
+    """Per-phase wall-second totals from a Prometheus exposition (all
+    routes/tenants summed — the harness wants the phase MIX, not the
+    per-route split the metric also carries)."""
+    sums: dict[str, float] = {}
+    for labels, value in _PHASE_SUM_RE.findall(text):
+        match = _PHASE_LABEL_RE.search(labels)
+        if match:
+            sums[match.group(1)] = sums.get(match.group(1), 0.0) \
+                + float(value)
+    return sums
+
+
+def _phase_delta(before: dict[str, float],
+                 after: dict[str, float]) -> dict[str, float]:
+    """Seconds each phase accrued between two scrapes, zero-phases
+    dropped; the capture field hot-path PRs point at."""
+    out = {}
+    for phase in sorted(set(before) | set(after)):
+        delta = after.get(phase, 0.0) - before.get(phase, 0.0)
+        if delta > 1e-9:
+            out[phase] = round(delta, 4)
+    return out
+
+
+async def _scrape_phase_sums(client, fleet: bool = False,
+                             auth=None) -> dict[str, float]:
+    path = "/metrics/prometheus" + ("?scope=fleet" if fleet else "")
+    resp = await client.get(path, auth=auth)
+    text = await resp.text()
+    return _phase_sums(text) if resp.status == 200 else {}
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+class _RemoteClient:
+    """bench._SocketClient's interface over a port this process does NOT
+    serve — the real-process arm's workers live in their own PIDs, so
+    there is no app/runner to own; ``close()`` only closes the session."""
+
+    class _Addr:
+        def __init__(self, host: str, port: int):
+            self.host, self.port = host, port
+
+    def __init__(self, host: str, port: int, force_close: bool = False,
+                 limit: int | None = None,
+                 keepalive_timeout_s: float | None = None):
+        import aiohttp
+        kwargs = {}
+        if keepalive_timeout_s is not None and not force_close:
+            kwargs["keepalive_timeout"] = keepalive_timeout_s
+        self._session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(
+                # fresh connection per request when asked: each new
+                # connection re-rolls the kernel's SO_REUSEPORT hash, so
+                # readiness probes actually visit DIFFERENT workers
+                force_close=force_close,
+                limit=limit if limit is not None else int(
+                    os.environ.get("BENCH_CLIENT_CONN_LIMIT", "512")),
+                **kwargs))
+        self._base = f"http://{host}:{port}"
+        self.server = self._Addr(host, port)
+
+    def post(self, path: str, **kwargs):
+        return self._session.post(self._base + path, **kwargs)
+
+    def get(self, path: str, **kwargs):
+        return self._session.get(self._base + path, **kwargs)
+
+    def delete(self, path: str, **kwargs):
+        return self._session.delete(self._base + path, **kwargs)
+
+    async def close(self) -> None:
+        await self._session.close()
+
+
 # ------------------------------------------------------------------ scenarios
 
 async def scenario_burst(app, client, auth, model, scale) -> dict:
@@ -295,20 +401,41 @@ async def scenario_burst(app, client, auth, model, scale) -> dict:
     saturation (coordinated omission), and this arm is where the
     10k-concurrent posture is driven (BENCH_OPEN_RATE / _REQUESTS /
     _INFLIGHT)."""
-    from mcp_context_forge_tpu.tools.loadgen import (SloWindow, chat_kind,
-                                                     run_phase_open,
-                                                     run_phases,
-                                                     tools_call_kind)
+    from mcp_context_forge_tpu.tools.loadgen import (
+        SloWindow, chat_kind, run_phase_open, run_phases,
+        shed_tracking_chat_kind, tools_call_kind)
     window = SloWindow(client, "scenario-burst", auth)
     await window.open()
     kinds = [tools_call_kind("scenario-echo"),
              chat_kind(model, max_tokens=scale["max_tokens"])]
     result = await run_phases(client, auth, kinds, scale["burst_phases"])
-    open_phase = await run_phase_open(
-        client, auth, [tools_call_kind("scenario-echo")],
-        name="burst-open", rate_rps=scale["burst_open_rate"],
-        requests=scale["burst_open_requests"],
-        max_in_flight=scale["burst_open_inflight"])
+    # open-loop overage arm at the 10k posture, against the SHED-covered
+    # chat surface: offered load is deliberately above capacity, and the
+    # acceptance is that OverloadShedder 429s (Retry-After attached)
+    # absorb the overage while every ADMITTED request completes — not
+    # that the box magically serves 1500 rps. Saturation shedding for
+    # the admin's "default" class is armed only for this arm (the
+    # closed-loop arms above measure unshedded behavior, and the trend
+    # history was recorded that way).
+    shedder = app.get("overload_shedder")
+    saved_order = list(shedder.class_order) if shedder is not None else []
+    shed_log: dict = {}
+    phases_before = await _scrape_phase_sums(client, auth=auth)
+    try:
+        if shedder is not None:
+            shedder.class_order = ["default"]
+        open_phase = await run_phase_open(
+            client, auth,
+            [shed_tracking_chat_kind(model, shed_log,
+                                     max_tokens=scale["max_tokens"])],
+            name="burst-open", rate_rps=scale["burst_open_rate"],
+            requests=scale["burst_open_requests"],
+            max_in_flight=scale["burst_open_inflight"])
+    finally:
+        if shedder is not None:
+            shedder.class_order = saved_order
+    phase_seconds = _phase_delta(phases_before,
+                                 await _scrape_phase_sums(client, auth=auth))
     result["slo"] = await window.close()
     burst_phase = next(p for p in result["phases"] if p["name"] == "burst")
     open_summary = open_phase.summary()
@@ -321,6 +448,8 @@ async def scenario_burst(app, client, auth, model, scale) -> dict:
             "open_loop": {"offered_rps": scale["burst_open_rate"],
                           "max_in_flight": scale["burst_open_inflight"],
                           "peak_in_flight": open_phase.concurrency,
+                          "shed": shed_log.get("shed", 0),
+                          "phase_seconds": phase_seconds,
                           **open_summary},
             **{k: v for k, v in _strip(result).items()},
             "failures": result["failures"] + open_phase.failures,
@@ -1434,16 +1563,19 @@ async def scenario_workers(platform, scale) -> dict:
                 return await kind(pool[i % len(pool)], a, i)
             return one
 
+        phases0 = await _scrape_phase_sums(clients[0], fleet=True, auth=auth)
         single = await run_phase_open(
             clients[0], auth, [lb(k, clients[:1]) for k in kinds],
             name="single-worker", rate_rps=scale["workers_rate"],
             requests=scale["workers_requests"],
             max_in_flight=scale["workers_inflight"])
+        phases1 = await _scrape_phase_sums(clients[0], fleet=True, auth=auth)
         fleet = await run_phase_open(
             clients[0], auth, [lb(k, clients) for k in kinds],
             name=f"fleet-{workers_n}", rate_rps=scale["workers_rate"],
             requests=scale["workers_requests"],
             max_in_flight=scale["workers_inflight"])
+        phases2 = await _scrape_phase_sums(clients[0], fleet=True, auth=auth)
         slo = await window.close()
 
         # --- cross-worker SSE handoff: byte-identical frames ---
@@ -1522,6 +1654,11 @@ async def scenario_workers(platform, scale) -> dict:
             "single_worker": single_summary,
             "fleet": fleet_summary,
             "scaleup": round(scaleup, 3),
+            # per-arm phase-bucket deltas (fleet-scope sums): the
+            # hot-path elimination evidence the perf PRs cite
+            "phase_seconds": {"single_worker": _phase_delta(phases0,
+                                                            phases1),
+                              "fleet": _phase_delta(phases1, phases2)},
             "owner_stats": owner_stats,
             "handoff": {
                 "byte_identical": handoff_identical,
@@ -1575,6 +1712,303 @@ async def scenario_workers(platform, scale) -> dict:
             pass
 
 
+async def scenario_workers_real(platform, scale) -> dict:
+    """REAL-process scale-out arm (ISSUE 18): the same supervisor
+    topology production runs — ``mcpforge supervise``'s Supervisor
+    spawning N ``cli serve`` WORKER PROCESSES on one SO_REUSEPORT
+    socket, the coordination hub in its own process, the shared engine
+    plane electing one pool owner — driven over real TCP from outside
+    the fleet. The in-process "workers" arm shares one event loop and
+    one GIL across its "workers"; this arm is the honest complement:
+    ``in_process: false`` in the capture, and tools/bench_trend.py
+    partitions the two histories so neither is judged against the other.
+
+    Verdicts:
+
+    (a) scaleup: open-loop offered load against a 1-worker fleet vs an
+        N-worker fleet (fresh supervisor each, same ports, same DB).
+        The gate is ``scaleup >= 0.8 * min(N, host_cpus)`` — on a
+        1-core box N processes cannot exceed ~1x one process, and a
+        gate pretending otherwise would either always fail or force a
+        dishonest workload; ``host_cpus`` is recorded so the number is
+        read in context.
+    (b) supervisor restart: SIGKILL worker 0 mid-fleet — the supervisor
+        must respawn it and chat must keep being served (either by the
+        respawned worker or by kernel-LB'd survivors).
+    (c) phase-bucket deltas: fleet-scope
+        mcpforge_gw_request_phase_seconds sums scraped before/after
+        each measured phase (the hot-path evidence field).
+
+    Workers are pinned to JAX cpu regardless of the bench platform: a
+    TPU runtime cannot be opened by N processes at once, and this arm
+    measures GATEWAY process fan-out, not engine speed.
+    """
+    import tempfile
+
+    from aiohttp import BasicAuth
+
+    from mcp_context_forge_tpu.supervisor import Supervisor
+    from mcp_context_forge_tpu.tools.loadgen import (SloWindow, chat_kind,
+                                                     run_phase_open)
+
+    workers_n = max(2, int(os.environ.get("BENCH_GW_REAL_WORKERS", "4")))
+    host_cpus = (len(os.sched_getaffinity(0))
+                 if hasattr(os, "sched_getaffinity")
+                 else (os.cpu_count() or 1))
+    pin = os.environ.get("BENCH_PIN_CPUS") == "1"
+    model = os.environ.get("BENCH_SCENARIO_MODEL",
+                           "llama3-test" if _smoke() else "llama3-tiny")
+    tmp = tempfile.mkdtemp(prefix="mcpforge-workers-real-")
+    port = _free_port()
+    hub_port = _free_port()
+    while hub_port == port:
+        hub_port = _free_port()
+    base_env = {
+        "MCPFORGE_JAX_PLATFORM": "cpu",
+        "JAX_PLATFORMS": "cpu",
+        "MCPFORGE_DATABASE_URL": f"sqlite:///{tmp}/fleet.db",
+        "MCPFORGE_DB_SQLITE_BUSY_TIMEOUT_MS": "5000",
+        "MCPFORGE_PLUGINS_ENABLED": "false",
+        "MCPFORGE_TPU_LOCAL_ENABLED": "true",
+        "MCPFORGE_TPU_LOCAL_POOL_SHARED": "true",
+        "MCPFORGE_TPU_LOCAL_REPLICAS": "1",
+        "MCPFORGE_TPU_LOCAL_MODEL": model,
+        "MCPFORGE_TPU_LOCAL_WARMUP": "false",
+        "MCPFORGE_TPU_LOCAL_MAX_BATCH": "8" if _smoke() else "16",
+        "MCPFORGE_TPU_LOCAL_MAX_SEQ_LEN": "128" if _smoke() else "512",
+        "MCPFORGE_TPU_LOCAL_PAGE_SIZE": "16",
+        "MCPFORGE_TPU_LOCAL_NUM_PAGES": "128" if _smoke() else "512",
+        "MCPFORGE_TPU_LOCAL_PREFILL_BUCKETS": "16,64" if _smoke() else "64",
+        "MCPFORGE_TPU_LOCAL_DTYPE": "float32",
+        "MCPFORGE_TPU_LOCAL_COMPILE_CACHE_DIR": os.environ.get(
+            "MCPFORGE_TPU_LOCAL_COMPILE_CACHE_DIR",
+            "/tmp/mcpforge-xla-cache"),
+        "MCPFORGE_STREAMABLE_HTTP_STATEFUL": "true",
+        "MCPFORGE_LEADER_LEASE_TTL": "2.0",
+        "MCPFORGE_GW_FLEET_METRICS": "true",
+        "MCPFORGE_GW_FLEET_METRICS_INTERVAL_S": "0.5",
+        "MCPFORGE_GW_LISTEN_BACKLOG": "4096",
+        "MCPFORGE_GATEWAY_HEALTH_INTERVAL": "3600",
+        "MCPFORGE_OTEL_EXPORTER": "none",
+        "MCPFORGE_LOG_LEVEL": "WARNING",
+        # this arm drives the fleet deliberately PAST saturation (the
+        # scaleup ratio needs both fleets pegged); on a CPU proxy box
+        # the latency objectives are therefore posture checks — the
+        # windows must MEASURE (zero samples still hard-fails), but
+        # production-shaped ms targets would only gate the box's core
+        # count, so they get the same 60 s ceiling as ttft/tpot
+        "MCPFORGE_SLO_TTFT_P95_MS": "60000",
+        "MCPFORGE_SLO_TPOT_P95_MS": "60000",
+        "MCPFORGE_SLO_QUEUE_WAIT_P95_MS": "60000",
+        "MCPFORGE_SLO_HTTP_P95_MS": "60000",
+    }
+    chat = chat_kind(model, max_tokens=scale["max_tokens"])
+
+    async def tools(client, a, i):
+        resp = await client.post("/rpc", auth=a, json={
+            "jsonrpc": "2.0", "id": i, "method": "tools/call",
+            "params": {"name": "workers-real-echo",
+                       "arguments": {"n": i, "text": f"payload {i}"}}})
+        body = await resp.json()
+        ok = (resp.status == 200 and "result" in body
+              and not body["result"].get("isError"))
+        return ok, "" if ok else f"http_{resp.status}"
+
+    async def _reap_loop(sup):
+        while True:
+            sup.reap_once()
+            await asyncio.sleep(0.5)
+
+    async def _all_serving(probe, sup, n, deadline_s=600.0) -> bool:
+        """Fresh-connection /health then chat until 2N consecutive OKs:
+        each force-closed connection re-rolls the kernel's SO_REUSEPORT
+        hash, so a streak this long cannot be one lucky worker; chat
+        additionally requires the elected owner's pool to be serving
+        THROUGH whichever worker the kernel picked (the bus RPC seam)."""
+        auth = BasicAuth("admin", "changeme")
+        deadline = time.monotonic() + deadline_s
+        streak = 0
+        while time.monotonic() < deadline and streak < 2 * n:
+            try:
+                resp = await probe.get("/health")
+                await resp.read()
+                ok = resp.status == 200
+                if ok:
+                    ok, _tag = await chat(probe, auth, 0)
+            except Exception:
+                ok = False
+            streak = streak + 1 if ok else 0
+            if streak < 2 * n:
+                await asyncio.sleep(0.25)
+        return streak >= 2 * n
+
+    auth = BasicAuth("admin", "changeme")
+    upstream = None
+    single_summary = fleet_summary = None
+    phase_seconds: dict = {}
+    slo = None
+    restart_ok = False
+    restart_s = None
+    problems: list[str] = []
+
+    async def _run_fleet(n: int, register: bool, kill_worker: bool):
+        nonlocal upstream, slo, restart_ok, restart_s
+        sup = Supervisor(workers=n, host="127.0.0.1", base_port=port,
+                         hub_port=hub_port, env=base_env,
+                         reuse_port=True, pin_cpus=pin)
+        sup.start()
+        reap = asyncio.ensure_future(_reap_loop(sup))
+        probe = _RemoteClient("127.0.0.1", port, force_close=True)
+        client = _RemoteClient("127.0.0.1", port)
+        # the SLO window's delta-consumer state lives in whichever
+        # WORKER PROCESS serves open(); the load client's connection
+        # pool re-rolls the SO_REUSEPORT hash per connection, so
+        # open/close must ride a dedicated single-connection client
+        # whose keepalive outlives the measured phase — otherwise
+        # close() lands on a worker that never saw open() and reads an
+        # empty window (the exact zero-samples failure this arm's
+        # first full run produced)
+        slo_client = _RemoteClient("127.0.0.1", port, limit=1,
+                                   keepalive_timeout_s=600.0)
+        try:
+            if not await _all_serving(probe, sup, n):
+                problems.append(f"{n}-worker fleet never became fully "
+                                f"serving (boot/election timeout)")
+                return None, {}
+            if register:
+                upstream = await _register_echo_tool(client, auth,
+                                                     "workers-real-echo")
+            # one settle round-trip so the tool row is visible fleet-wide
+            ok, tag = await tools(probe, auth, 0)
+            if not ok:
+                problems.append(f"tools/call priming failed: {tag}")
+                return None, {}
+            window = None
+            if n > 1:
+                window = SloWindow(slo_client, "scenario-workers-real",
+                                   auth, scope="fleet")
+                await window.open()
+            before = await _scrape_phase_sums(client, fleet=True,
+                                               auth=auth)
+            phase = await run_phase_open(
+                client, auth, [tools, tools, tools, chat],
+                name=f"real-fleet-{n}", rate_rps=scale["workers_rate"],
+                requests=scale["workers_requests"],
+                max_in_flight=scale["workers_inflight"])
+            delta = _phase_delta(before,
+                                 await _scrape_phase_sums(
+                                     client, fleet=True, auth=auth))
+            if window is not None:
+                slo = await window.close()
+            if kill_worker:
+                kill_started = time.monotonic()
+                victim = sup._procs[0]
+                victim.kill()
+                # the death must be OBSERVED before polling for the
+                # respawn: immediately after kill() the victim's
+                # poll() can still read None (signal not yet
+                # delivered), which would let the all-alive check pass
+                # with nothing respawned
+                await asyncio.to_thread(victim.wait)
+                deadline = time.monotonic() + 300
+                recovered = False
+                while time.monotonic() < deadline and not recovered:
+                    # kernel LB means survivors answer chat instantly —
+                    # "recovered" requires the supervisor to have
+                    # actually RESPAWNED the victim (the reaper swaps a
+                    # NEW Popen into slot 0, all slots alive) AND the
+                    # fleet to be serving chat through whichever worker
+                    # the probe's fresh connection lands on
+                    respawned = (sup._procs[0] is not victim
+                                 and all(p.poll() is None
+                                         for p in sup._procs.values()))
+                    if respawned:
+                        try:
+                            recovered, _tag = await chat(probe, auth, 1)
+                        except Exception:
+                            recovered = False
+                    if not recovered:
+                        await asyncio.sleep(0.5)
+                restart_ok = recovered
+                restart_s = round(time.monotonic() - kill_started, 2)
+            return phase.summary(), delta
+        finally:
+            reap.cancel()
+            for c in (probe, client, slo_client):
+                try:
+                    await c.close()
+                except Exception:
+                    pass
+            await asyncio.to_thread(sup.stop)
+
+    try:
+        single_summary, delta1 = await _run_fleet(1, register=True,
+                                                  kill_worker=False)
+        if single_summary is not None:
+            phase_seconds["single_worker"] = delta1
+            fleet_summary, deltan = await _run_fleet(workers_n,
+                                                     register=False,
+                                                     kill_worker=True)
+            if fleet_summary is not None:
+                phase_seconds["fleet"] = deltan
+    finally:
+        if upstream is not None:
+            try:
+                await upstream.close()
+            except Exception:
+                pass
+
+    scaleup = 0.0
+    if single_summary and fleet_summary and single_summary["rps"]:
+        scaleup = fleet_summary["rps"] / single_summary["rps"]
+    required = round(0.8 * min(workers_n, host_cpus), 3)
+    gate_ok = scaleup >= required
+    failures = ((single_summary or {}).get("failures", 0)
+                + (fleet_summary or {}).get("failures", 0))
+    requests = ((single_summary or {}).get("requests", 0)
+                + (fleet_summary or {}).get("requests", 0))
+    return {
+        "scenario": "workers-real", "workers": workers_n,
+        "in_process": False,
+        "host_cpus": host_cpus,
+        "pinned": pin,
+        "jax_platform": "cpu",
+        "value": (fleet_summary or {}).get("rps", 0.0),
+        "p50_ms": (fleet_summary or {}).get("p50_ms"),
+        "p95_ms": (fleet_summary or {}).get("p95_ms"),
+        "requests": requests,
+        "failures": failures,
+        "wall_s": round((single_summary or {}).get("wall_s", 0.0)
+                        + (fleet_summary or {}).get("wall_s", 0.0), 3),
+        "offered_rps": scale["workers_rate"],
+        "single_worker": single_summary,
+        "fleet": fleet_summary,
+        "scaleup": round(scaleup, 3),
+        "scaleup_gate": {"required": required, "ok": gate_ok,
+                         "rule": "0.8 * min(workers, host_cpus)"},
+        "phase_seconds": phase_seconds,
+        "supervisor_restart": {"ok": restart_ok, "recovered_s": restart_s},
+        "slo": slo or {}, "slo_ok": (slo or {}).get("ok", False),
+        # per-process trace rings: the fleet's slowest request lives in
+        # whichever worker served it, and this driver cannot know which
+        # — cross-worker forensics stitching is not this arm's verdict
+        "forensics": {"problems": [],
+                      "skipped": "per-process trace rings (real fleet)"},
+        "hard_fail": (
+            (problems and "; ".join(problems))
+            or (failures and f"{failures} request(s) failed in the "
+                             f"throughput arms")
+            or (not gate_ok
+                and f"scaleup {scaleup:.3f} below the honest gate "
+                    f"{required} (0.8 x min({workers_n} workers, "
+                    f"{host_cpus} host cpus))")
+            or (not restart_ok
+                and "supervisor did not respawn the killed worker with "
+                    "chat service restored")
+            or None),
+    }
+
+
 def _strip(result: dict) -> dict:
     """Phase summaries + SLO verdicts, minus raw latency arrays."""
     return {"requests": result["requests"], "failures": result["failures"],
@@ -1625,6 +2059,14 @@ async def run_scenarios(platform: str) -> dict:
     only = {s for s in os.environ.get("BENCH_SCENARIO_ONLY", "").split(",")
             if s}
     wanted = [s for s in SCENARIOS if not only or s in only]
+    # the real-process arm is GATED: it spawns a supervised subprocess
+    # fleet (minutes of boot on a cold compile cache) and is meaningful
+    # as a deliberate run, not as a tax on every full sweep. Explicitly
+    # naming it in BENCH_SCENARIO_ONLY always runs it; a full sweep
+    # includes it only under BENCH_REAL_PROCS=1.
+    if ("workers-real" in wanted and "workers-real" not in only
+            and os.environ.get("BENCH_REAL_PROCS") != "1"):
+        wanted.remove("workers-real")
     if not wanted:
         # nothing selected (BENCH_SCENARIO_ONLY names no real scenario):
         # report the vacuous run without paying a gateway build
@@ -1683,6 +2125,7 @@ async def run_scenarios(platform: str) -> dict:
                 app, client, auth, model, scale, platform),
             "chaos": lambda: scenario_chaos(app, client, auth, model, scale),
             "workers": lambda: scenario_workers(platform, scale),
+            "workers-real": lambda: scenario_workers_real(platform, scale),
         }
         out_dir = os.environ.get(
             "BENCH_SCENARIO_DIR",
@@ -1707,6 +2150,11 @@ async def run_scenarios(platform: str) -> dict:
             # worker-count arm partition (tools/bench_trend.py): a
             # 4-worker round must never median against 1-worker history
             capture.setdefault("workers", 1)
+            # topology honesty (tools/bench_trend.py): every arm that
+            # did NOT set in_process itself ran inside this process —
+            # real-process rounds must never be judged against (or
+            # seed) the in-process history, and vice versa
+            capture.setdefault("in_process", True)
             # no-vacuous-pass: the scenario must have actually pushed
             # samples through the objectives it claims verdicts for
             unmeasured = assert_slo_measured(

@@ -41,6 +41,16 @@ case "$1" in
     shift
     exec python bench_gateway_scenarios.py "$@"
     ;;
+  bench-workers-real)
+    # real-process fleet arm (docs/load_harness.md "real-process
+    # topology"): N forked serve workers on one SO_REUSEPORT socket
+    # behind a hub process; capture lands with in_process:false and
+    # gates scaleup against 0.8*min(workers, host_cpus)
+    shift
+    BENCH_SCENARIO_ONLY=workers-real BENCH_REAL_PROCS=1 \
+      BENCH_SCENARIO_ENFORCE_SLO=1 \
+      exec python bench_gateway_scenarios.py "$@"
+    ;;
   bench-chaos)
     # fault-injection matrix only (docs/resilience.md): db-outage /
     # tier-fault / overload-shed / chaos (slow-replica + kill), gated on

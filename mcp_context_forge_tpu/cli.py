@@ -50,6 +50,10 @@ def main(argv: list[str] | None = None) -> int:
                            help="legacy layout: worker i listens on port+i "
                                 "behind an external LB instead of one "
                                 "SO_REUSEPORT socket")
+    supervise.add_argument("--pin-cpus", action="store_true",
+                           help="pin worker i to cpu i%%ncpus "
+                                "(sched_setaffinity; Linux only, opt-in — "
+                                "helps only when workers <= free cores)")
 
     token = sub.add_parser("token", help="mint a JWT for an email")
     token.add_argument("email")
@@ -93,7 +97,8 @@ def main(argv: list[str] | None = None) -> int:
             workers=args.workers, host=args.host or settings.host,
             base_port=base_port,
             hub_port=None if args.no_hub else (args.hub_port or base_port - 1),
-            reuse_port=not args.port_per_worker)
+            reuse_port=not args.port_per_worker,
+            pin_cpus=args.pin_cpus)
         supervisor.run_forever()
         return 0
 

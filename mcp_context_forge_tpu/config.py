@@ -64,6 +64,11 @@ class Settings(BaseModel):
     # under a 10k-concurrent open-loop burst before a worker ever sees
     # them; sized for the scale-out posture
     gw_listen_backlog: int = 1024
+    # event-loop policy for the serving process: "" / "asyncio" = stdlib
+    # loop; "uvloop" = opt-in libuv loop when the package is importable,
+    # FALLING BACK to asyncio with a warning when it is not (the serving
+    # image does not bake uvloop in; the knob must never be a boot error)
+    gw_event_loop: str = ""
     # cross-worker session handoff: an SSE stream or elicit request
     # landing on a non-owning worker is served over the bus RPC seam
     # instead of refused (the 409 survives only as the fallback when the

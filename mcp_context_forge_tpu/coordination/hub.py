@@ -160,6 +160,30 @@ class CoordinationHub:
             self._send(writer, self._kv_op(op, frame))
         elif op == "rl_take":
             self._send(writer, self._rl_op(frame))
+        elif op == "batch":
+            # same-tick client coalescing (HubClient): N scalar ops ride
+            # ONE request frame and get ONE response frame back. Sub-ops
+            # execute sequentially in list order, so the total per-hub
+            # ordering the limiter's CAS depends on is preserved
+            self._send(writer, {"op": "batch_resp",
+                                "results": self._batch_op(frame)})
+
+    def _batch_op(self, frame: dict[str, Any]) -> list[dict[str, Any]]:
+        results: list[dict[str, Any]] = []
+        for sub in frame.get("ops") or []:
+            sop = sub.get("op")
+            if sop in ("acquire", "renew", "release", "holder"):
+                results.append(self._lease_op(sop, sub))
+            elif sop in ("kv_set", "kv_get", "kv_del"):
+                results.append(self._kv_op(sop, sub))
+            elif sop == "rl_take":
+                results.append(self._rl_op(sub))
+            else:
+                # pub/sub cannot batch (no resp frame to correlate)
+                results.append({"op": "resp", "id": sub.get("id"),
+                                "ok": False,
+                                "error": f"unbatchable op {sop!r}"})
+        return results
 
     async def _broadcast(self, sender: int, topic: str,
                          message: dict[str, Any]) -> None:
@@ -293,6 +317,11 @@ class HubClient:
         self._on_message: Callable[[str, dict[str, Any]], Any] | None = None
         self._connected = asyncio.Event()
         self._stopping = False
+        # same-tick op coalescing (see _enqueue_batch)
+        self._batch_buf: list[dict[str, Any]] = []
+        self._batch_scheduled = False
+        self.batches_sent = 0
+        self.batched_ops = 0
 
     async def start(self) -> None:
         self._stopping = False
@@ -379,6 +408,11 @@ class HubClient:
             future = self._pending.pop(frame.get("id"), None)
             if future is not None and not future.done():
                 future.set_result(frame)
+        elif op == "batch_resp":
+            for result in frame.get("results") or []:
+                future = self._pending.pop(result.get("id"), None)
+                if future is not None and not future.done():
+                    future.set_result(result)
 
     def _send(self, frame: dict[str, Any]) -> None:
         if self._writer is None:
@@ -414,22 +448,62 @@ class HubClient:
     async def rl_take(self, key: str, cost: float, limit: float,
                       window_s: float, force: bool = False
                       ) -> dict[str, Any]:
-        """Shared rate-limit window op (see CoordinationHub._rl_op)."""
+        """Shared rate-limit window op (see CoordinationHub._rl_op).
+
+        Batched: under burst every admitted request costs one limiter
+        round-trip, and those serialize in hub frame handling — same-tick
+        takes (N concurrent admissions, the ledger's force-charges) now
+        coalesce into one wire frame each way."""
         return await self.request({"op": "rl_take", "key": key,
                                    "cost": cost, "limit": limit,
-                                   "window_s": window_s, "force": force})
+                                   "window_s": window_s, "force": force},
+                                  batch=True)
 
-    async def request(self, frame: dict[str, Any],
-                      timeout: float = 5.0) -> dict[str, Any]:
+    async def request(self, frame: dict[str, Any], timeout: float = 5.0,
+                      batch: bool = False) -> dict[str, Any]:
         self._next_id += 1
         frame["id"] = self._next_id
         future: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[frame["id"]] = future
-        self._send(frame)
+        if batch:
+            self._enqueue_batch(frame)
+        else:
+            self._send(frame)
         try:
             return await asyncio.wait_for(future, timeout)
         finally:
             self._pending.pop(frame["id"], None)
+
+    # -------------------------------------------------- same-tick op batching
+
+    def _enqueue_batch(self, frame: dict[str, Any]) -> None:
+        """Queue a scalar op; everything queued within the same event-loop
+        tick flushes as ONE ``batch`` frame (a single op stays a plain
+        frame, so the unbatched wire shape is unchanged)."""
+        self._batch_buf.append(frame)
+        if not self._batch_scheduled:
+            self._batch_scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush_batch)
+
+    def _flush_batch(self) -> None:
+        self._batch_scheduled = False
+        frames, self._batch_buf = self._batch_buf, []
+        if not frames:
+            return
+        self.batches_sent += 1
+        self.batched_ops += len(frames)
+        try:
+            if len(frames) == 1:
+                self._send(frames[0])
+            else:
+                self._send({"op": "batch", "ops": frames})
+        except ConnectionError as exc:
+            # the send failed for every op in this flush: fail exactly
+            # those callers (their futures), nobody else
+            for sub in frames:
+                future = self._pending.pop(sub.get("id"), None)
+                if future is not None and not future.done():
+                    future.set_exception(ConnectionError(str(exc)))
 
 
 class TcpEventBus(EventBus):

@@ -24,9 +24,21 @@ module is the ONE generic seam they all ride:
   so the caller walks the timeout/liveness path, exactly like a split
   bus.
 
+- Same-tick call batching (``call(..., batch=True)``): small unary
+  calls issued within one event-loop tick to the same peer coalesce into
+  ONE request frame, and the server answers with ONE response frame —
+  amortizing the per-frame bus round-trip that serializes under burst
+  (the limiter/ledger class of calls). Ordering is preserved: the
+  server runs a batch's handlers sequentially in submission order. The
+  failure contract is unchanged — each caller keeps its own future,
+  timeout, and heartbeat-liveness check, so a peer dying mid-batch fails
+  exactly that batch's callers and nobody else.
+
 Wire frames (bus messages):
   rpc.req          {"to", "from", "corr", "method", "params", "stream"}
+  rpc.req          {"to", "from", "batch": [{"corr","method","params"}]}
   rpc.res.<worker> {"corr", "result"|"error"}                    unary
+                   {"batch": [{"corr", "result"|"error"}, ...]}  batched
                    {"corr", "seq", "chunk"}                      stream
                    {"corr", "end": true, "error": str|null}      stream end
   rpc.req          {"cancel": corr, "to": server}                client gone
@@ -89,8 +101,13 @@ class BusRpc:
         self._serving: dict[str, asyncio.Task] = {}
         self._unsubs: list = []
         self._tasks: set[asyncio.Task] = set()  # strong refs (GC safety)
+        # client side: per-peer same-tick batch buffers (call(batch=True))
+        self._batch_buf: dict[str, list[dict[str, Any]]] = {}
+        self._batch_scheduled: set[str] = set()
         self.calls_served = 0
         self.streams_served = 0
+        self.batches_sent = 0
+        self.batched_calls = 0
 
     # ------------------------------------------------------------- lifecycle
 
@@ -132,7 +149,9 @@ class BusRpc:
         models a partition: the frame is DROPPED (the caller times out /
         walks the liveness check) — the same observable failure as a
         split coordination plane."""
-        act = fault_point("coordination.hub.rpc", scope=frame.get("method"))
+        scope = (frame.get("method")
+                 or (frame.get("batch") or [{}])[0].get("method"))
+        act = fault_point("coordination.hub.rpc", scope=scope)
         if act is not None:
             if act.kind == "corrupt":
                 return  # partition: request never leaves this worker
@@ -149,19 +168,62 @@ class BusRpc:
         except Exception:
             return False
 
+    def _enqueue_batch(self, to: str, item: dict[str, Any]) -> None:
+        """Buffer one call for ``to``; the first call in a tick schedules
+        a flush at the end of the tick (call_soon), so every batched call
+        issued before the loop turns rides the same request frame."""
+        self._batch_buf.setdefault(to, []).append(item)
+        if to not in self._batch_scheduled:
+            self._batch_scheduled.add(to)
+            loop = asyncio.get_running_loop()
+            loop.call_soon(lambda: loop.create_task(self._flush_batch(to)))
+
+    async def _flush_batch(self, to: str) -> None:
+        self._batch_scheduled.discard(to)
+        items = self._batch_buf.pop(to, [])
+        if not items:
+            return
+        self.batches_sent += 1
+        self.batched_calls += len(items)
+        try:
+            if len(items) == 1:
+                # a lone call keeps the plain unary wire shape
+                frame = dict(items[0])
+                frame.update({"to": to, "from": self.worker_id})
+                await self._send_request(frame)
+            else:
+                await self._send_request({"to": to, "from": self.worker_id,
+                                          "batch": items})
+        except Exception as exc:
+            # the send failed for the WHOLE flush: fail exactly these
+            # callers' futures (peers/other batches are untouched)
+            for item in items:
+                future = self._pending.get(item["corr"])
+                if future is not None and not future.done():
+                    future.set_exception(RpcError(str(exc)))
+
     async def call(self, to: str, method: str, params: dict[str, Any],
-                   timeout_s: float | None = None) -> Any:
+                   timeout_s: float | None = None,
+                   batch: bool = False) -> Any:
         """Unary call; raises RpcAppError (remote handler raised),
-        RpcPeerLost (peer died), or RpcError (timeout/transport)."""
+        RpcPeerLost (peer died), or RpcError (timeout/transport).
+        ``batch=True`` coalesces with other same-tick batched calls to
+        the same peer — only for SHORT handlers (limiter/ledger/status
+        class): a batch executes sequentially on the server, so a slow
+        call would head-of-line-block its batchmates."""
         corr = new_id()
         future: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[corr] = future
         deadline = (timeout_s if timeout_s is not None
                     else self.default_timeout_s)
         try:
-            await self._send_request({"to": to, "from": self.worker_id,
-                                      "corr": corr, "method": method,
-                                      "params": params})
+            if batch:
+                self._enqueue_batch(to, {"corr": corr, "method": method,
+                                         "params": params})
+            else:
+                await self._send_request({"to": to, "from": self.worker_id,
+                                          "corr": corr, "method": method,
+                                          "params": params})
             try:
                 return await asyncio.wait_for(future, deadline)
             except asyncio.TimeoutError:
@@ -234,6 +296,35 @@ class BusRpc:
         corr = frame.get("corr")
         method = frame.get("method", "")
         reply_topic = RES_PREFIX + str(frame.get("from", ""))
+        batch = frame.get("batch")
+        if batch:
+            # batched unary calls: run handlers SEQUENTIALLY in list
+            # order (the ordering contract), answer with ONE frame
+            async def _run_batch() -> None:
+                payloads: list[dict[str, Any]] = []
+                for item in batch:
+                    icorr = item.get("corr")
+                    handler = self._handlers.get(item.get("method", ""))
+                    if handler is None:
+                        payloads.append({
+                            "corr": icorr,
+                            "error": f"unknown rpc method "
+                                     f"{item.get('method')!r}"})
+                        continue
+                    try:
+                        result = await handler(item.get("params") or {})
+                        payloads.append({"corr": icorr, "result": result})
+                        self.calls_served += 1
+                    except Exception as exc:
+                        payloads.append({
+                            "corr": icorr,
+                            "error": f"{type(exc).__name__}: {exc}"})
+                await self.bus.publish(reply_topic, {"batch": payloads})
+
+            task = asyncio.get_running_loop().create_task(_run_batch())
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+            return
         if frame.get("stream"):
             handler = self._stream_handlers.get(method)
             if handler is None:
@@ -308,12 +399,19 @@ class BusRpc:
     # ------------------------------------------------------------- client side
 
     async def _on_response(self, topic: str, frame: dict[str, Any]) -> None:
+        for item in frame.get("batch") or ():
+            self._resolve_unary(item)
+        if "batch" in frame:
+            return
         corr = frame.get("corr", "")
         queue = self._streams.get(corr)
         if queue is not None:
             queue.put_nowait(frame)
             return
-        future = self._pending.get(corr)
+        self._resolve_unary(frame)
+
+    def _resolve_unary(self, frame: dict[str, Any]) -> None:
+        future = self._pending.get(frame.get("corr", ""))
         if future is None or future.done():
             return
         if "error" in frame and frame["error"] is not None:
@@ -328,4 +426,6 @@ class BusRpc:
                 "open_streams": len(self._serving),
                 "pending_calls": len(self._pending),
                 "calls_served": self.calls_served,
-                "streams_served": self.streams_served}
+                "streams_served": self.streams_served,
+                "batches_sent": self.batches_sent,
+                "batched_calls": self.batched_calls}

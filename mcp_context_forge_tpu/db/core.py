@@ -1,10 +1,22 @@
 """Async sqlite3 database core.
 
-sqlite3 is synchronous; all statements run on a single dedicated executor
-thread (sqlite connections are not thread-safe across threads, and a shared
-in-memory DB requires one connection), so the event loop never blocks on I/O —
-the same discipline the reference enforces by releasing the DB session before
-network I/O (`/root/reference/mcpgateway/services/tool_service.py:5022`).
+sqlite3 is synchronous; statements run on dedicated executor threads
+(sqlite connections are not thread-safe across threads, and a shared
+in-memory DB requires one connection), so the event loop never blocks on
+I/O — the same discipline the reference enforces by releasing the DB
+session before network I/O
+(`/root/reference/mcpgateway/services/tool_service.py:5022`).
+
+Connection pool (``pool_size > 1``, file-backed WAL databases only): all
+writes stay on ONE writer lane — sqlite has a single write lock, so a
+second write connection buys nothing but SQLITE_BUSY — while read-only
+statements fan out over ``pool_size - 1`` reader lanes, each its own
+connection on its own executor thread. WAL lets readers run concurrently
+with the writer, which is exactly the half of the ``db.acquire`` phase
+bucket (lock/queue wait) the flight recorder indicts on read-heavy
+routes. A per-database statement cache memoizes the read/write routing
+decision per SQL text and sizes sqlite's native prepared-statement cache
+(``cached_statements``) to match, so hot statements skip re-parsing.
 
 This module IS the SQL sink the S006 taint rule guards: its execute/fetch
 wrappers receive ``sql`` as a parameter by design, and every call site is
@@ -75,8 +87,75 @@ class Migration:
     sql: str  # multiple statements allowed
 
 
+# SQL verbs that never write; WITH needs a body scan (sqlite allows
+# WITH ... INSERT/UPDATE/DELETE), EXPLAIN is read-only by construction
+_READ_VERBS = frozenset({"select", "explain", "values"})
+_WRITE_TOKENS = ("insert", "update", "delete", "replace", "create",
+                 "drop", "alter", "vacuum", "reindex")
+
+
+def _is_read_only(sql: str) -> bool:
+    head = sql.lstrip().split(None, 1)
+    verb = head[0].lower() if head else ""
+    if verb in _READ_VERBS:
+        return True
+    if verb != "with":
+        return False
+    lowered = " ".join(seg.lower() for _off, seg in
+                       iter_outside_literal_segments(sql))
+    return not any(tok in lowered.split() for tok in _WRITE_TOKENS)
+
+
+class _StatementCache:
+    """SQL text -> routing decision + hit counts.
+
+    The expensive prepared-statement reuse itself lives inside sqlite
+    (``cached_statements``, sized from this cache's capacity); this layer
+    memoizes the Python-side per-statement work — the read/write lane
+    routing decision — and keeps honest hit/miss counters so the
+    diagnostics surface can say whether the cache is actually hot."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = max(8, capacity)
+        self._entries: dict[str, bool] = {}  # sql -> is_read_only
+        self.hits = 0
+        self.misses = 0
+
+    def is_read(self, sql: str) -> bool:
+        cached = self._entries.get(sql)
+        if cached is not None:
+            self.hits += 1
+            return cached
+        self.misses += 1
+        decision = _is_read_only(sql)
+        if len(self._entries) >= self.capacity:
+            # drop the oldest insertion (dict preserves order); hot
+            # statements re-enter immediately so FIFO is fine here
+            self._entries.pop(next(iter(self._entries)))
+        self._entries[sql] = decision
+        return decision
+
+    def stats(self) -> dict[str, int | float]:
+        total = self.hits + self.misses
+        return {"entries": len(self._entries), "hits": self.hits,
+                "misses": self.misses, "capacity": self.capacity,
+                "hit_rate": round(self.hits / total, 4) if total else 0.0}
+
+
+class _Lane:
+    """One sqlite connection pinned to one executor thread."""
+
+    __slots__ = ("executor", "conn", "lock")
+
+    def __init__(self, name: str):
+        self.executor = ThreadPoolExecutor(max_workers=1,
+                                           thread_name_prefix=name)
+        self.conn: sqlite3.Connection | None = None
+        self.lock = threading.Lock()
+
+
 class Database:
-    """One sqlite connection on one worker thread, async API."""
+    """One writer connection (+ optional WAL reader lanes), async API."""
 
     # RETURNING landed in sqlite 3.35; serving images commonly ship older
     # (3.34 observed) — callers needing claim semantics branch on this
@@ -84,7 +163,8 @@ class Database:
 
     def __init__(self, path: str = ":memory:",
                  busy_timeout_ms: int = 10000, max_retries: int = 3,
-                 retry_interval_ms: float = 50.0):
+                 retry_interval_ms: float = 50.0, pool_size: int = 1,
+                 statement_cache_size: int = 256):
         self._path = path
         self._busy_timeout_ms = busy_timeout_ms
         self._max_retries = max(0, max_retries)
@@ -92,15 +172,31 @@ class Database:
         self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="db")
         self._conn: sqlite3.Connection | None = None
         self._lock = threading.Lock()
+        # reader lanes: file-backed WAL databases only — an in-memory DB
+        # (and the URI forms) needs exactly one connection, and readers
+        # on the writer's journal mode (rollback) would just block on it
+        pooled = (max(1, pool_size) > 1 and path not in (":memory:", "")
+                  and not path.startswith("file:"))
+        self._readers: list[_Lane] = (
+            [_Lane(f"db-r{i}") for i in range(max(1, pool_size) - 1)]
+            if pooled else [])
+        self._rr = 0  # round-robin cursor over reader lanes
+        self.statement_cache = _StatementCache(statement_cache_size)
         # optional per-query timing sink: Callable[[float], None], ms.
         # Set by the app to feed the PerformanceTracker "db.query" series.
         self.on_query = None
+
+    @property
+    def pool_size(self) -> int:
+        return 1 + len(self._readers)
 
     # -- lifecycle -----------------------------------------------------------
 
     def _connect(self) -> sqlite3.Connection:
         conn = sqlite3.connect(self._path, check_same_thread=False,
-                               timeout=self._busy_timeout_ms / 1000.0)
+                               timeout=self._busy_timeout_ms / 1000.0,
+                               cached_statements=max(
+                                   128, self.statement_cache.capacity))
         conn.row_factory = sqlite3.Row
         conn.execute("PRAGMA foreign_keys=ON")
         if self._path not in (":memory:", ""):
@@ -123,12 +219,59 @@ class Database:
 
         await self._run(_close)
         self._executor.shutdown(wait=False)
+        for lane in self._readers:
+            def _close_lane(lane: _Lane = lane) -> None:
+                if lane.conn is not None:
+                    lane.conn.close()
+                    lane.conn = None
+            try:
+                lane.executor.submit(_close_lane).result(timeout=5)
+            except Exception:
+                pass
+            lane.executor.shutdown(wait=False)
 
     async def _run(self, fn, *args):
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(self._executor, fn, *args)
 
+    def _pick_reader(self) -> _Lane:
+        self._rr = (self._rr + 1) % len(self._readers)
+        return self._readers[self._rr]
+
     # -- statements ----------------------------------------------------------
+
+    def _execute_reader_sync(self, lane: _Lane, sql: str,
+                             params: Sequence[Any],
+                             timing: list[float] | None = None
+                             ) -> list[dict[str, Any]]:
+        """Read-only statement on a reader lane (own thread, own conn).
+
+        Lazy connect: the lane's connection is created on ITS thread the
+        first time a read routes here, so boot stays one connection."""
+        if lane.conn is None:
+            lane.conn = self._connect()
+        wait_start = time.monotonic() if timing is not None else 0.0
+        with lane.lock:
+            started = time.monotonic() if timing is not None else 0.0
+            attempt = 0
+            while True:
+                try:
+                    cur = lane.conn.execute(sql, params)
+                    rows = [dict(r) for r in cur.fetchall()]
+                    break
+                except sqlite3.OperationalError as exc:
+                    # readers can still hit transient busy during WAL
+                    # checkpoints — same bounded retry as the writer
+                    message = str(exc).lower()
+                    transient = "locked" in message or "busy" in message
+                    if not transient or attempt >= self._max_retries:
+                        raise
+                    attempt += 1
+                    time.sleep(self._retry_interval_s)
+            if timing is not None:
+                timing.append((time.monotonic() - started) * 1000)
+                timing.append((started - wait_start) * 1000)
+            return rows
 
     def _execute_sync(self, sql: str, params: Sequence[Any],
                       timing: list[float] | None = None
@@ -195,10 +338,26 @@ class Database:
         log = _query_capture.get()
         cb = self.on_query
         clock = current_phases()  # flight-recorder db-phase attribution
+        # lane routing: read-only statements fan out over the WAL reader
+        # pool (decision memoized per SQL text); writes keep the single
+        # writer lane so sqlite's one write lock is never fought over
+        if self._readers and self.statement_cache.is_read(sql):
+            lane = self._pick_reader()
+            loop = asyncio.get_running_loop()
+
+            def _run_read(*args):
+                return loop.run_in_executor(
+                    lane.executor, self._execute_reader_sync, lane, *args)
+        else:
+            _run_read = None
         if log is None and cb is None and clock is None:
+            if _run_read is not None:
+                return await _run_read(sql, params)
             return await self._run(self._execute_sync, sql, params)
         timing: list[float] = []  # filled under the lock on the db thread
         try:
+            if _run_read is not None:
+                return await _run_read(sql, params, timing)
             return await self._run(self._execute_sync, sql, params, timing)
         finally:
             # timing stays empty when the statement raised — a failed query

@@ -246,7 +246,11 @@ def make_database(database_url: str, pool_size: int = 8,
         return PostgresDatabase(database_url, pool_size)
     from .core import Database
 
+    # sqlite gets the same pool_size knob: writes stay on one writer
+    # lane, pool_size-1 WAL reader lanes absorb read-only statements
+    # (db/core.py — in-memory paths collapse back to a single lane)
     return Database(database_url.split("///", 1)[-1] or ":memory:",
                     busy_timeout_ms=busy_timeout_ms,
                     max_retries=max_retries,
-                    retry_interval_ms=retry_interval_ms)
+                    retry_interval_ms=retry_interval_ms,
+                    pool_size=pool_size)

@@ -7,6 +7,7 @@ Same ordering here via aiohttp cleanup contexts.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 from pathlib import Path
@@ -689,14 +690,19 @@ async def build_app(settings: Settings | None = None) -> web.Application:
         try:
             payload = parse_body(raw, settings.max_request_size_bytes)
             rpc_request = RPCRequest.parse(payload)
-            response = await request.app["dispatcher"].dispatch(
+            # zero-copy envelope (gateway/serialize.py via the
+            # dispatcher's byte seam): pre-encoded JSON-RPC fragments
+            # around one compact result encode, charged to the flight
+            # recorder's `serialize` bucket instead of the unattributed
+            # `handler` residue (docs/observability.md)
+            body = await request.app["dispatcher"].dispatch_bytes(
                 rpc_request, request["auth"], headers=headers)
         except JSONRPCError as exc:
             rid = payload.get("id") if isinstance(payload, dict) else None
             return web.json_response(exc.to_dict(rid))
-        if response is None:
+        if body is None:
             return web.Response(status=202)
-        return web.json_response(response)
+        return web.Response(body=body, content_type="application/json")
 
     app.router.add_post("/rpc", handle_rpc)
     setup_routes(app)
@@ -1134,8 +1140,28 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     return app
 
 
+def install_event_loop(policy_name: str) -> str:
+    """Install the configured event-loop policy (gw_event_loop).
+
+    Returns the loop actually installed: ``uvloop`` only when requested
+    AND importable — the serving image does not ship it, so the knob
+    degrades to asyncio with a warning instead of failing boot."""
+    if policy_name != "uvloop":
+        return "asyncio"
+    try:
+        import uvloop  # type: ignore[import-not-found]
+    except ImportError:
+        logging.getLogger(__name__).warning(
+            "gw_event_loop=uvloop but uvloop is not installed; "
+            "falling back to asyncio")
+        return "asyncio"
+    asyncio.set_event_loop_policy(uvloop.EventLoopPolicy())
+    return "uvloop"
+
+
 def run(settings: Settings | None = None) -> None:
     settings = settings or get_settings()
+    install_event_loop(settings.gw_event_loop)
 
     async def _factory() -> web.Application:
         return await build_app(settings)

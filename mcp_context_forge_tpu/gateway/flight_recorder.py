@@ -295,28 +295,22 @@ class LoopLagSampler:
         }
 
 
-def queue_state(app: Any) -> dict[str, Any] | None:
-    """Engine/pool admission state as the HTTP tier's backpressure
-    signal: queued work summed over ROUTABLE replicas, capacity from the
-    per-engine admission bound, saturation = depth/capacity. None when
-    no engine is wired (nothing to backpressure against). Every
-    computation refreshes the ``mcpforge_gw_engine_saturation`` gauge —
-    here rather than in the header-writing branch, so SSE responses
-    (headers set pre-prepare) and header-disabled deployments still
-    feed the metric."""
+def compute_queue_state(pool: Any, engine: Any) -> dict[str, Any] | None:
+    """Depth/capacity/saturation from a replica pool or single engine —
+    the pure half of ``queue_state`` (no app, no metrics side effect),
+    shared with the shared-engine-plane's ``pool.queue_state`` RPC so
+    every worker reports the SAME arithmetic."""
     no_replicas = False
-    pool = app.get("tpu_engine_pool")
     if pool is not None:
         ready = [r for r in pool.replicas if r.state == "ready"]
         depth = sum(r.engine.stats.queue_depth for r in ready)
         capacity = sum(r.engine.config.max_queue for r in ready)
         no_replicas = not ready  # every replica dead/draining
-    else:
-        engine = app.get("tpu_engine")
-        if engine is None:
-            return None
+    elif engine is not None:
         depth = engine.stats.queue_depth
         capacity = engine.config.max_queue
+    else:
+        return None
     if no_replicas:
         saturation = 1.0  # nothing routable: saturated by definition
     elif capacity > 0:
@@ -325,12 +319,39 @@ def queue_state(app: Any) -> dict[str, Any] | None:
         # max_queue<=0 means an UNBOUNDED admission queue (queue.Queue
         # maxsize semantics) — never "full", not permanently saturated
         saturation = 0.0
+    return {"depth": int(depth), "capacity": int(capacity),
+            "saturation": round(saturation, 4)}
+
+
+def queue_state(app: Any) -> dict[str, Any] | None:
+    """Engine/pool admission state as the HTTP tier's backpressure
+    signal: queued work summed over ROUTABLE replicas, capacity from the
+    per-engine admission bound, saturation = depth/capacity. None when
+    no engine is wired (nothing to backpressure against). Every
+    computation refreshes the ``mcpforge_gw_engine_saturation`` gauge —
+    here rather than in the header-writing branch, so SSE responses
+    (headers set pre-prepare) and header-disabled deployments still
+    feed the metric.
+
+    Shared-engine-plane topology (tpu_local/pool_rpc.py): only the
+    leader-elected owner has local engine objects; every other worker
+    reads the LEADER's admission state through the plane's short-TTL
+    bus-RPC cache — a non-owner must never report a worker-local zero
+    while the owner's queue is drowning (the in-process bench masked
+    this; the real-process arm exposed it)."""
+    state = compute_queue_state(app.get("tpu_engine_pool"),
+                                app.get("tpu_engine"))
+    if state is None:
+        plane = app.get("engine_plane")
+        if plane is not None:
+            state = plane.queue_state_sync()
+    if state is None:
+        return None
     ctx = app.get("ctx")
     metrics = getattr(ctx, "metrics", None) if ctx is not None else None
     if metrics is not None:
-        metrics.gw_engine_saturation.set(saturation)
-    return {"depth": int(depth), "capacity": int(capacity),
-            "saturation": round(saturation, 4)}
+        metrics.gw_engine_saturation.set(state["saturation"])
+    return state
 
 
 def retry_after_s(saturation: float, advisory_at: float = 0.8) -> int:

@@ -4,11 +4,11 @@ routers/catalog.py, routers/metrics_maintenance.py."""
 
 from __future__ import annotations
 
-import json
-
 from aiohttp import web
 
+from ..observability import phases
 from ..services.base import ValidationFailure
+from .serialize import SSE_DONE, sse_event
 
 
 def setup_chat_routes(app: web.Application) -> None:
@@ -52,9 +52,12 @@ def setup_chat_routes(app: web.Application) -> None:
         resp = web.StreamResponse(headers={"content-type": "text/event-stream",
                                            "cache-control": "no-store"})
         await resp.prepare(request)
+        # shared zero-copy SSE path (gateway/serialize.py): one compact
+        # encoder + pre-built framing instead of per-event dumps+concat
         async for event in events:
-            await resp.write(b"data: " + json.dumps(event).encode() + b"\n\n")
-        await resp.write(b"data: [DONE]\n\n")
+            with phases.phase("serialize"):
+                await resp.write(sse_event(event))
+        await resp.write(SSE_DONE)
         await resp.write_eof()
         return resp
 

@@ -22,8 +22,10 @@ from ..jsonrpc import (
     method_registry,
     result_response,
 )
+from ..observability import phases as request_phases
 from ..services.base import AppContext, NotFoundError, ValidationFailure
 from ..services.auth_service import AuthContext, PermissionDenied
+from .serialize import jsonrpc_response_bytes
 
 logger = logging.getLogger(__name__)
 
@@ -76,6 +78,23 @@ class RPCDispatcher:
                 logger.exception("RPC %s failed", method)
                 raise JSONRPCError(INTERNAL_ERROR, f"{type(exc).__name__}: {exc}") from exc
         return result_response(request.id, result)
+
+    async def dispatch_bytes(self, request: RPCRequest, auth: AuthContext,
+                             headers: dict[str, str] | None = None,
+                             server_id: str | None = None) -> bytes | None:
+        """``dispatch`` with the response pre-encoded to wire bytes.
+
+        The zero-copy seam for byte-oriented callers (``POST /rpc``):
+        the JSON-RPC envelope is assembled from constant fragments around
+        one compact result encode (gateway/serialize.py), and the encode
+        cost is charged to the flight recorder's ``serialize`` bucket
+        here — per route, not as ``handler`` residue."""
+        response = await self.dispatch(request, auth, headers=headers,
+                                       server_id=server_id)
+        if response is None:
+            return None
+        with request_phases.phase("serialize"):
+            return jsonrpc_response_bytes(response)
 
     async def _route(self, method: str, params: dict[str, Any], auth: AuthContext,
                      headers: dict[str, str], server_id: str | None,

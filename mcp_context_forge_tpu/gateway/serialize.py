@@ -1,0 +1,74 @@
+"""Shared zero-copy response serialization: SSE framing + JSON-RPC envelopes.
+
+The flight recorder's phase vectors put ``serialize`` among the dominant
+buckets after the PR-16 auth fix, and the per-event pattern
+``resp.write(b"data: " + json.dumps(event).encode() + b"\n\n")`` was
+duplicated across three hot loops (gateway/routers_chat.py, the LLM
+surface in tpu_local/server.py, and the /mcp transport). This module is
+the ONE encoder all of them share:
+
+- ``encode_json``: compact separators (no space after ``,``/``:``),
+  ``ensure_ascii=False`` so multi-byte text is emitted as UTF-8 instead
+  of 6-byte ``\\uXXXX`` escapes — both smaller wire bytes and less
+  encoder work per event;
+- SSE framing is pre-built module-level byte constants joined with one
+  ``b"".join`` per event (no repeated bytes-concat reallocations);
+- JSON-RPC response envelopes are assembled from pre-encoded fragments
+  around the result payload, skipping a second dict walk over the
+  envelope — the ``handler`` residue the phase vectors could not
+  attribute now lands in an explicit ``serialize`` charge at the /rpc
+  route (observability/phases.py).
+
+Streams produced before and after this module must be byte-identical
+given the same events (tests/unit/test_serialize.py pins it), so SSE
+resume/handoff byte-equality contracts (docs/scaleout.md) are untouched.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+# SSE framing fragments (the wire grammar around every event)
+SSE_DATA = b"data: "
+SSE_END = b"\n\n"
+SSE_DONE = b"data: [DONE]\n\n"
+
+# JSON-RPC 2.0 response envelope fragments (jsonrpc.result_response as bytes)
+_ENV_HEAD = b'{"jsonrpc":"2.0","id":'
+_ENV_RESULT = b',"result":'
+_ENV_TAIL = b'}'
+
+
+def encode_json(obj: Any) -> bytes:
+    """THE compact encoder: every SSE/JSON-RPC byte producer rides this."""
+    return json.dumps(obj, separators=(",", ":"),
+                      ensure_ascii=False).encode()
+
+
+def sse_event(event: Any) -> bytes:
+    """One SSE ``data:`` frame for ``event`` (pre-built framing bytes)."""
+    return b"".join((SSE_DATA, encode_json(event), SSE_END))
+
+
+def jsonrpc_result_bytes(request_id: Any, result: Any) -> bytes:
+    """Encode ``{"jsonrpc":"2.0","id":...,"result":...}`` from fragments.
+
+    Only the two variable payloads (id, result) are JSON-encoded; the
+    envelope itself is constant bytes. Matches ``encode_json(
+    result_response(id, result))`` byte-for-byte (key order pinned by
+    jsonrpc.result_response's literal)."""
+    return b"".join((_ENV_HEAD, encode_json(request_id),
+                     _ENV_RESULT, encode_json(result), _ENV_TAIL))
+
+
+def jsonrpc_response_bytes(response: dict[str, Any]) -> bytes:
+    """Bytes for an already-built JSON-RPC response dict.
+
+    Result responses in canonical ``result_response`` shape take the
+    fragment fast path; anything else (error responses, extra keys)
+    falls back to the shared compact encoder."""
+    if (len(response) == 3 and "result" in response
+            and response.get("jsonrpc") == "2.0" and "id" in response):
+        return jsonrpc_result_bytes(response["id"], response["result"])
+    return encode_json(response)

@@ -24,6 +24,7 @@ from aiohttp import web
 
 from ...jsonrpc import JSONRPCError, RPCRequest, error_response, INVALID_REQUEST, PARSE_ERROR
 from ...utils.ids import new_id
+from ..serialize import encode_json
 
 
 @dataclass
@@ -146,14 +147,17 @@ class SessionManager:
         return count
 
 
+_FRAME_EVENT = b"event: message\ndata: "
+
+
 def _sse_frame(event_id: str | None, data: Any) -> bytes:
-    lines = []
+    # shared compact encoder + pre-built framing (gateway/serialize.py);
+    # resume/handoff byte-equality holds because every writer — owner,
+    # replayer, cross-worker forwarder — goes through THIS function
     if event_id:
-        lines.append(f"id: {event_id}")
-    lines.append("event: message")
-    payload = json.dumps(data, separators=(",", ":"))
-    lines.append(f"data: {payload}")
-    return ("\n".join(lines) + "\n\n").encode()
+        return b"".join((b"id: ", event_id.encode(), b"\n",
+                         _FRAME_EVENT, encode_json(data), b"\n\n"))
+    return b"".join((_FRAME_EVENT, encode_json(data), b"\n\n"))
 
 
 class StreamableHTTPTransport:

@@ -34,7 +34,8 @@ logger = logging.getLogger(__name__)
 class Supervisor:
     def __init__(self, workers: int, host: str, base_port: int,
                  hub_port: int | None = None, env: dict | None = None,
-                 max_backoff: float = 30.0, reuse_port: bool = True):
+                 max_backoff: float = 30.0, reuse_port: bool = True,
+                 pin_cpus: bool = False):
         self.workers = workers
         self.host = host
         self.base_port = base_port
@@ -42,6 +43,12 @@ class Supervisor:
         self.env = env or {}
         self.max_backoff = max_backoff
         self.reuse_port = reuse_port
+        # per-worker CPU pinning (Linux sched_setaffinity): worker idx i
+        # pins to core i % ncpus, so N workers on an N-core box never
+        # migrate onto each other's cores mid-burst. Off by default —
+        # pinning on an oversubscribed box (other tenants, fewer cores
+        # than workers) HURTS, so the operator opts in (--pin-cpus)
+        self.pin_cpus = pin_cpus and hasattr(os, "sched_setaffinity")
         self._procs: dict[int, subprocess.Popen] = {}   # worker idx -> proc
         self._backoff: dict[int, float] = {}
         self._restart_at: dict[int, float] = {}  # idx -> earliest respawn time
@@ -68,15 +75,34 @@ class Supervisor:
             env["MCPFORGE_GW_REUSE_PORT"] = "true"
         return env
 
+    def _pin_worker(self, idx: int, proc: subprocess.Popen) -> None:
+        """Pin worker ``idx`` to one core (round-robin over the
+        supervisor's own affinity mask). From the parent, post-spawn —
+        the worker needs no pinning code and a failed pin (proc already
+        died, restricted cgroup) degrades to unpinned, never to a dead
+        worker."""
+        cpus = sorted(os.sched_getaffinity(0))
+        cpu = cpus[idx % len(cpus)]
+        try:
+            os.sched_setaffinity(proc.pid, {cpu})
+            logger.info("supervisor: pinned worker %d (pid %d) to cpu %d",
+                        idx, proc.pid, cpu)
+        except OSError as exc:
+            logger.warning("supervisor: could not pin worker %d: %s",
+                           idx, exc)
+
     def _spawn_worker(self, idx: int) -> subprocess.Popen:
         port = self.base_port if self.reuse_port else self.base_port + idx
         logger.info("supervisor: starting worker %d on %s:%d%s", idx,
                     self.host, port,
                     " (SO_REUSEPORT)" if self.reuse_port else "")
-        return subprocess.Popen(
+        proc = subprocess.Popen(
             [sys.executable, "-m", "mcp_context_forge_tpu.cli", "serve",
              "--host", self.host, "--port", str(port)],
             env=self._worker_env(idx))
+        if self.pin_cpus:
+            self._pin_worker(idx, proc)
+        return proc
 
     def _spawn_hub(self) -> subprocess.Popen:
         logger.info("supervisor: starting coordination hub on :%d",

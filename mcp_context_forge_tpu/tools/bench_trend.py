@@ -136,18 +136,23 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
     # frozen-config round, and a disaggregated capture (a non-empty
     # "roles" pool split, e.g. prefill+decode) must only be judged
     # against same-split history (migration hops shift the TTFT/tok_s
-    # balance by design)
-    groups: dict[tuple[int, bool, int, bool, tuple[str, ...]],
+    # balance by design), and a REAL-PROCESS capture ("in_process":
+    # false — N forked workers under `mcpforge supervise`, real sockets,
+    # real GIL isolation) must never median into in-process history
+    # (absent = true: all pre-real-process captures ran in-process)
+    groups: dict[tuple[int, bool, int, bool, tuple[str, ...], bool],
                  list[tuple[int, str, dict[str, Any]]]] = {}
     for item in payloads:
+        in_process = item[2].get("in_process")
         groups.setdefault((int(item[2].get("superstep") or 1),
                            bool(item[2].get("prefix_tiers")),
                            int(item[2].get("workers") or 1),
                            bool(item[2].get("controller")),
                            tuple(str(r) for r in
-                                 (item[2].get("roles") or ()))),
+                                 (item[2].get("roles") or ())),
+                           True if in_process is None else bool(in_process)),
                           []).append(item)
-    for (k_steps, tiers, workers, controller, roles), group \
+    for (k_steps, tiers, workers, controller, roles, in_process), group \
             in sorted(groups.items()):
         if len(group) < 2:
             # a new arm's first capture has no history yet — surface it
@@ -156,7 +161,7 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
             result.setdefault("new_arms", []).append(
                 {"superstep": k_steps, "prefix_tiers": tiers,
                  "workers": workers, "controller": controller,
-                 "roles": list(roles),
+                 "roles": list(roles), "in_process": in_process,
                  "capture": os.path.basename(group[-1][1])})
             continue
         latest_round, latest_path, latest = group[-1]
@@ -170,6 +175,8 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
             arm += "@controller"
         if roles:
             arm += f"@roles={','.join(roles)}"
+        if not in_process:
+            arm += "@real-process"
         for key, higher_better in _GATES[latest.get("metric")]:
             latest_val = latest.get(key)
             prior = [p.get(key) for _rnd, _path, p in history
@@ -189,6 +196,7 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
                 "workers": workers,
                 "controller": controller,
                 "roles": list(roles),
+                "in_process": in_process,
                 "latest": latest_val,
                 "latest_round": latest_round,
                 "baseline_median": baseline,
@@ -262,10 +270,12 @@ def main(argv: list[str] | None = None) -> int:
                 ctl = "@controller" if arm.get("controller") else ""
                 rl = (f"@roles={','.join(arm['roles'])}"
                       if arm.get("roles") else "")
+                rp = ("@real-process"
+                      if arm.get("in_process") is False else "")
                 print(f"bench-trend: {result['series']}"
-                      f"@superstep={arm['superstep']}{tiers}{wk}{ctl}{rl}: "
-                      f"first capture ({arm['capture']}) — no history to "
-                      f"gate yet")
+                      f"@superstep={arm['superstep']}{tiers}{wk}{ctl}{rl}"
+                      f"{rp}: first capture ({arm['capture']}) — no "
+                      f"history to gate yet")
             for check in result["checks"]:
                 arrow = "REGRESSED" if check["regressed"] else "ok"
                 print(f"bench-trend: {result['series']} {check['metric']}: "

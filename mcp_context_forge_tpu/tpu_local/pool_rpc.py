@@ -71,6 +71,12 @@ class SharedEnginePlane:
         self.build_failures = 0
         self._task: asyncio.Task | None = None
         self._building = False
+        # non-owner backpressure: short-TTL cache of the OWNER's queue
+        # state, refreshed over bus RPC (see queue_state_sync)
+        self.queue_cache_ttl_s = 1.0
+        self._queue_cache: dict[str, Any] | None = None
+        self._queue_cache_at = 0.0
+        self._queue_refresh: asyncio.Task | None = None
 
     # ------------------------------------------------------------- lifecycle
 
@@ -80,12 +86,16 @@ class SharedEnginePlane:
         self.rpc.register("pool.classify", self._serve_classify)
         self.rpc.register("pool.status", self._serve_status)
         self.rpc.register("pool.set_role", self._serve_set_role)
+        self.rpc.register("pool.queue_state", self._serve_queue_state)
         self.rpc.register_stream("pool.chat_stream", self._serve_chat_stream)
         if self._task is None:
             self._task = asyncio.get_running_loop().create_task(
                 self._elector(), name="engine-pool-elector")
 
     async def stop(self) -> None:
+        refresh, self._queue_refresh = self._queue_refresh, None
+        if refresh is not None and not refresh.done():
+            refresh.cancel()
         task, self._task = self._task, None
         if task is not None:
             task.cancel()
@@ -258,7 +268,64 @@ class SharedEnginePlane:
                 "provider_ready": provider is not None,
                 "models": (await provider.models()) if provider else []}
 
+    def _local_queue_state(self) -> dict[str, Any] | None:
+        """Admission state of the locally-built pool (owner only)."""
+        from ..gateway.flight_recorder import compute_queue_state
+        backend = getattr(self.local_provider, "engine", None)
+        if backend is None:
+            return None
+        if hasattr(backend, "replicas"):
+            return compute_queue_state(backend, None)
+        return compute_queue_state(None, backend)
+
+    async def _serve_queue_state(self, params: dict[str, Any]
+                                 ) -> dict[str, Any]:
+        """The owner's queue depth/capacity/saturation — the
+        backpressure truth every non-owner worker's X-Queue-Depth /
+        Retry-After / shed decision must reflect (a worker-local zero
+        here is a lie: the worker has no engine, the owner does)."""
+        return {"ok": True, "result": self._local_queue_state()}
+
     # ----------------------------------------------------------- client side
+
+    def queue_state_sync(self) -> dict[str, Any] | None:
+        """Backpressure state for THIS worker, synchronously: the local
+        pool on the owner; elsewhere the owner's state via a short-TTL
+        bus-RPC cache (refreshed in the background — the per-request
+        path must not block on a hub round-trip). Returns None until the
+        first refresh lands / when no owner is reachable: "no signal",
+        which callers render as no backpressure headers — never a fake
+        zero depth."""
+        if self.ready_local:
+            return self._local_queue_state()
+        now = time.monotonic()
+        if (self._queue_cache_at and
+                now - self._queue_cache_at <= self.queue_cache_ttl_s):
+            return self._queue_cache
+        if self._queue_refresh is None or self._queue_refresh.done():
+            try:
+                self._queue_refresh = asyncio.get_running_loop(
+                ).create_task(self._refresh_queue_cache())
+            except RuntimeError:
+                return self._queue_cache  # no loop (sync test context)
+        return self._queue_cache
+
+    async def _refresh_queue_cache(self) -> None:
+        try:
+            owner = await self.owner()
+            if owner is None or owner == self.worker_id:
+                # no elected owner (failover window) or we ARE the owner
+                # but the pool is still building: no signal
+                self._queue_cache = None
+            else:
+                resp = await self.rpc.call(
+                    owner, "pool.queue_state", {},
+                    timeout_s=min(5.0, self.rpc_timeout_s), batch=True)
+                self._queue_cache = (resp.get("result")
+                                     if resp.get("ok") else None)
+        except Exception:
+            self._queue_cache = None  # unreachable owner: no signal
+        self._queue_cache_at = time.monotonic()
 
     @staticmethod
     def _raise_remote(resp: dict[str, Any]) -> Any:

@@ -14,6 +14,7 @@ from typing import Any
 
 from aiohttp import web
 
+from ..gateway.serialize import SSE_DONE, sse_event
 from ..observability import phases as request_phases
 from ..observability.tracing import current_span
 from .provider import LLMError, LLMProviderRegistry, LLMUnavailable
@@ -198,23 +199,20 @@ def setup_llm_routes(app: web.Application, registry: LLMProviderRegistry,
                                     chunk = None
                         while chunk is not None:
                             with request_phases.phase("serialize"):
-                                await resp.write(
-                                    b"data: " + json.dumps(chunk).encode()
-                                    + b"\n\n")
+                                await resp.write(sse_event(chunk))
                             with request_phases.phase("engine"):
                                 try:
                                     chunk = await chunks.__anext__()
                                 except StopAsyncIteration:
                                     chunk = None
-                        await resp.write(b"data: [DONE]\n\n")
+                        await resp.write(SSE_DONE)
                     except Exception as exc:
                         # mid-stream failure: error event on the stream —
                         # a second response cannot be started once
                         # prepare() has run
-                        await resp.write(b"data: " + json.dumps(
+                        await resp.write(sse_event(
                             {"error": {"message":
-                                       f"{type(exc).__name__}: {exc}"}}
-                        ).encode() + b"\n\n")
+                                       f"{type(exc).__name__}: {exc}"}}))
                     await resp.write_eof()
                     return resp
                 finally:

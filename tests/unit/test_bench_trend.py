@@ -204,7 +204,7 @@ def test_first_capture_of_a_new_arm_is_surfaced_not_silent(tmp_path, capsys):
     series = next(r for r in report["series"] if r["series"] == "BENCH_TPU")
     assert series["new_arms"] == [
         {"superstep": 8, "prefix_tiers": False, "workers": 1,
-         "controller": False, "roles": [],
+         "controller": False, "roles": [], "in_process": True,
          "capture": "BENCH_TPU_r03.json"}]
     assert main(["--root", str(tmp_path)]) == 0
     out = capsys.readouterr().out
@@ -287,3 +287,44 @@ def test_roles_captures_gate_as_their_own_arm(tmp_path):
               if c["metric"] == "value"}
     assert by_arm[()]["regressed"] is False
     assert by_arm[("prefill", "decode")]["regressed"] is True
+
+
+def test_real_process_captures_gate_as_their_own_arm(tmp_path):
+    """An ``in_process: false`` capture (real supervised worker
+    processes over TCP) is a different throughput regime than the
+    in-process fleet sharing one GIL — it must only median against
+    real-process history, absent in_process must read as in-process
+    (the pre-ISSUE-18 history), and a regression inside the arm must
+    carry the @real-process label."""
+    _write_series(tmp_path, "BENCH_SCENARIO_WORKERS", [
+        {**_capture(100.0), "workers": 4},                  # legacy (absent)
+        {**_capture(101.0), "workers": 4, "in_process": True},
+        {**_capture(30.0), "workers": 4, "in_process": False},
+        {**_capture(29.5), "workers": 4, "in_process": False},
+    ])
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert report["ok"], report["regressions"]
+    # both arms actually compared: legacy+true medianed together (r2 vs
+    # r1), real-process separately (r4 vs r3) — the 3x regime gap never
+    # reads as a regression
+    assert report["checks"] >= 4
+    # a real-process collapse is caught within the arm and labelled
+    (tmp_path / "BENCH_SCENARIO_WORKERS_r05.json").write_text(json.dumps(
+        {**_capture(10.0), "workers": 4, "in_process": False}))
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert not report["ok"]
+    assert any("@real-process" in line for line in report["regressions"])
+    # the in-process arm stayed green: the collapse did not bleed across
+    by_arm = {c["in_process"]: c
+              for r in report["series"] for c in r["checks"]
+              if c["metric"] == "value"}
+    assert by_arm[True]["regressed"] is False
+    assert by_arm[False]["regressed"] is True
+
+
+def test_zero_captures_still_exits_two(tmp_path, capsys):
+    """The no-vacuous-pass rule survives the in_process partition: a
+    directory with no captures at all exits 2, never 0."""
+    rc = main(["--root", str(tmp_path)])
+    capsys.readouterr()
+    assert rc == 2

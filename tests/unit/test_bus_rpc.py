@@ -186,3 +186,113 @@ async def test_stream_cancel_stops_server_task():
     assert not b._serving  # relay task reaped
     await a.stop()
     await b.stop()
+
+
+# ------------------------------------------------------- call batching
+
+async def test_batched_calls_coalesce_into_one_frame():
+    """Same-tick ``batch=True`` calls to one peer ride ONE request frame
+    (stats count the coalescing) and every caller still gets ITS result."""
+    a, b = await _pair()
+    b.register("echo", _echo)
+    sent_before = a.batches_sent
+    results = await asyncio.gather(
+        a.call("worker-b", "echo", {"x": 1}, batch=True),
+        a.call("worker-b", "echo", {"x": 2}, batch=True),
+        a.call("worker-b", "echo", {"x": 3}, batch=True))
+    assert results == [{"got": 1}, {"got": 2}, {"got": 3}]
+    assert a.batches_sent == sent_before + 1
+    assert a.batched_calls >= 3
+    await a.stop()
+    await b.stop()
+
+
+async def test_batch_server_runs_handlers_in_submission_order():
+    """The server executes a batch SEQUENTIALLY in list order — the
+    ordering contract that makes limiter/ledger charges deterministic."""
+    a, b = await _pair()
+    order: list[int] = []
+
+    async def record(params):
+        order.append(params["i"])
+        return params["i"]
+
+    b.register("record", record)
+    results = await asyncio.gather(*[
+        a.call("worker-b", "record", {"i": i}, batch=True)
+        for i in range(6)])
+    assert results == [0, 1, 2, 3, 4, 5]
+    assert order == [0, 1, 2, 3, 4, 5]
+    await a.stop()
+    await b.stop()
+
+
+async def test_single_batched_call_keeps_unary_wire_shape():
+    """A lone batch=True call must flush as a PLAIN unary frame — old
+    peers (and every frame-spying test) keep working."""
+    bus = MemoryEventBus()
+    frames = []
+    orig_publish = bus.publish
+
+    async def spy(topic, frame):
+        if topic == "rpc.req":
+            frames.append(frame)
+        await orig_publish(topic, frame)
+
+    bus.publish = spy
+    a = BusRpc(bus, "worker-a", default_timeout_s=2.0)
+    b = BusRpc(bus, "worker-b", default_timeout_s=2.0)
+    await a.start()
+    await b.start()
+    b.register("echo", _echo)
+    assert await a.call("worker-b", "echo", {"x": 9}, batch=True) \
+        == {"got": 9}
+    assert len(frames) == 1
+    assert "batch" not in frames[0] and frames[0]["method"] == "echo"
+    await a.stop()
+    await b.stop()
+
+
+async def test_batch_app_error_fails_only_its_caller():
+    """One failing handler inside a batch must not poison its
+    batchmates' results."""
+    a, b = await _pair()
+    b.register("echo", _echo)
+
+    async def boom(params):
+        raise ValueError("kaboom")
+
+    b.register("boom", boom)
+    ok1, err, ok2 = await asyncio.gather(
+        a.call("worker-b", "echo", {"x": 1}, batch=True),
+        a.call("worker-b", "boom", {}, batch=True),
+        a.call("worker-b", "echo", {"x": 2}, batch=True),
+        return_exceptions=True)
+    assert ok1 == {"got": 1} and ok2 == {"got": 2}
+    assert isinstance(err, RpcAppError)
+    await a.stop()
+    await b.stop()
+
+
+async def test_batch_dead_peer_fails_only_that_batch():
+    """A batch aimed at a dead peer fails exactly ITS callers with
+    RpcPeerLost; a same-tick batch to a live peer is untouched."""
+    leases = _Leases()
+    bus = MemoryEventBus()
+    a = BusRpc(bus, "worker-a", leases=leases, default_timeout_s=0.3)
+    c = BusRpc(bus, "worker-c", leases=leases, default_timeout_s=2.0)
+    await a.start()
+    await c.start()
+    leases.holders["worker:worker-c"] = "worker-c"
+    c.register("echo", _echo)
+    # worker-b never heartbeats: its batch times out -> liveness check
+    dead1, dead2, live = await asyncio.gather(
+        a.call("worker-b", "echo", {"x": 1}, batch=True),
+        a.call("worker-b", "echo", {"x": 2}, batch=True),
+        a.call("worker-c", "echo", {"x": 3}, batch=True),
+        return_exceptions=True)
+    assert isinstance(dead1, RpcPeerLost)
+    assert isinstance(dead2, RpcPeerLost)
+    assert live == {"got": 3}
+    await a.stop()
+    await c.stop()

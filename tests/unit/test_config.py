@@ -35,3 +35,24 @@ def test_strong_secrets_pass():
 def test_tuple_field_parsing():
     s = load_settings(env={"MCPFORGE_TPU_LOCAL_PREFILL_BUCKETS": "64,256,1024"}, env_file=None)
     assert s.tpu_local_prefill_buckets == (64, 256, 1024)
+
+
+def test_event_loop_policy_defaults_off_and_degrades():
+    """gw_event_loop is an OPT-IN uvloop knob: default "" (asyncio),
+    and requesting uvloop on an image that doesn't ship it must degrade
+    to asyncio with a warning — never fail boot."""
+    import asyncio
+
+    from mcp_context_forge_tpu.gateway.app import install_event_loop
+
+    assert Settings(_env_file=None).gw_event_loop == ""
+    before = asyncio.get_event_loop_policy()
+    assert install_event_loop("") == "asyncio"
+    assert install_event_loop("asyncio") == "asyncio"
+    try:
+        import uvloop  # noqa: F401
+        expected = "uvloop"
+    except ImportError:
+        expected = "asyncio"  # serving image: degrade, don't die
+    assert install_event_loop("uvloop") == expected
+    asyncio.set_event_loop_policy(before)  # leave the suite's policy alone

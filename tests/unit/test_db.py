@@ -54,3 +54,73 @@ async def test_unique_tool_name_per_gateway():
         raised = True
     assert raised
     await db.close()
+
+
+# ------------------------------------------------- per-worker read pool
+
+async def test_pool_fans_reads_out_and_keeps_one_writer(tmp_path):
+    """pool_size > 1 on a FILE db: reads round-robin over WAL reader
+    lanes while every write serializes through the one writer lane —
+    and reads always see committed writes (read-your-writes)."""
+    db = Database(str(tmp_path / "pool.db"), pool_size=4)
+    await db.migrate(MIGRATIONS)
+    assert db.pool_size == 4  # 1 writer + 3 readers
+    now = time.time()
+    for i in range(8):
+        await db.execute(
+            "INSERT INTO gateways (id, name, url, created_at, updated_at)"
+            " VALUES (?,?,?,?,?)",
+            (f"g{i}", f"peer-{i}", "http://peer/mcp", now, now))
+    import asyncio
+    counts = await asyncio.gather(*[
+        db.execute("SELECT COUNT(*) AS n FROM gateways")
+        for _ in range(12)])
+    assert all(rows[0]["n"] == 8 for rows in counts)
+    # read-your-writes across lanes: a fresh write is visible to every
+    # subsequent read no matter which lane serves it
+    await db.execute(
+        "INSERT INTO gateways (id, name, url, created_at, updated_at) "
+        "VALUES ('g8', 'peer-8', 'http://peer/mcp', ?, ?)", (now, now))
+    for _ in range(6):
+        rows = await db.execute("SELECT COUNT(*) AS n FROM gateways")
+        assert rows[0]["n"] == 9
+    await db.close()
+
+
+async def test_pool_statement_cache_classifies_and_hits():
+    db = Database(":memory:", pool_size=4)
+    await db.migrate(MIGRATIONS)
+    cache = db.statement_cache
+    assert cache.is_read("SELECT 1")
+    assert cache.is_read("  select name from tools")
+    assert cache.is_read("WITH x AS (SELECT 1) SELECT * FROM x")
+    assert not cache.is_read("INSERT INTO tools VALUES (1)")
+    assert not cache.is_read("WITH x AS (SELECT 1) "
+                             "UPDATE tools SET name='n'")
+    assert not cache.is_read("PRAGMA journal_mode=WAL")
+    for _ in range(5):
+        cache.is_read("SELECT 1")
+    stats = cache.stats()
+    assert stats["hits"] >= 5 and stats["entries"] >= 1
+    assert 0.0 < stats["hit_rate"] <= 1.0
+    await db.close()
+
+
+async def test_pool_collapses_for_memory_and_uri_paths():
+    """:memory: / shared-cache URIs cannot fan out (each connection
+    would see a DIFFERENT empty database): pool_size is forced to 1."""
+    for path in (":memory:", "", "file:seen?mode=memory&cache=shared"):
+        db = Database(path, pool_size=8)
+        assert db.pool_size == 1, path
+        await db.close()
+
+
+async def test_pool_default_stays_unpooled(tmp_path):
+    """Default construction keeps the single-connection layout — the
+    retry/wrap tests (and anyone monkeypatching db._conn) stay valid."""
+    db = Database(str(tmp_path / "plain.db"))
+    await db.migrate(MIGRATIONS)
+    assert db.pool_size == 1
+    rows = await db.execute("SELECT 1 AS one")
+    assert rows[0]["one"] == 1
+    await db.close()

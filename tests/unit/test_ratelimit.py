@@ -180,3 +180,51 @@ async def test_hub_backed_counter_end_to_end():
         for client in clients:
             await client.stop()
         await hub.stop()
+
+
+async def test_hub_batched_rl_take_conserves_quota_across_workers():
+    """Conservation under BATCHED charging (ISSUE 18): rl_take now
+    coalesces same-tick ops into one hub frame, and N workers firing
+    concurrent takes must still admit <= Q + one burst — never N x Q —
+    with the coalescing actually exercised (batches_sent advanced)."""
+    from mcp_context_forge_tpu.coordination.hub import (CoordinationHub,
+                                                        HubClient)
+    from mcp_context_forge_tpu.coordination.ratelimit import HubRateCounter
+
+    quota, per_take = 1_000, 100
+    n_workers, takes_per_worker = 3, 20  # fleet offers 6x the quota
+    hub = CoordinationHub("127.0.0.1", 0)
+    await hub.start()
+    clients: list[HubClient] = []
+    try:
+        counters = []
+        for _ in range(n_workers):
+            client = HubClient("127.0.0.1", hub.bound_port)
+            await client.start()
+            clients.append(client)
+            counters.append(HubRateCounter(client))
+
+        async def drive(counter):
+            # concurrent same-tick takes: these MUST coalesce per client
+            results = await asyncio.gather(*[
+                counter.take("team:b", per_take, limit=quota, window_s=60)
+                for _ in range(takes_per_worker)])
+            return results
+
+        rounds = await asyncio.gather(*[drive(c) for c in counters])
+        granted = sum(per_take for results in rounds
+                      for r in results if r["ok"])
+        refused = [r for results in rounds for r in results if not r["ok"]]
+        # bounded over-admission: Q + one per-take burst, NOT N x Q
+        assert granted <= quota + per_take, granted
+        assert granted >= quota - per_take, granted
+        assert refused, "fleet never hit the quota (vacuous run)"
+        assert all(r["retry_after"] > 0 for r in refused)
+        # the batching seam was actually used, not bypassed
+        assert any(c.batches_sent > 0 for c in clients)
+        assert sum(c.batched_ops for c in clients) \
+            == n_workers * takes_per_worker
+    finally:
+        for client in clients:
+            await client.stop()
+        await hub.stop()

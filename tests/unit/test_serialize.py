@@ -1,0 +1,105 @@
+"""Zero-copy serialization seam (gateway/serialize.py): the ONE compact
+encoder behind every SSE writer and the JSON-RPC response envelope.
+
+The load-bearing contracts:
+
+- fragment-assembled envelopes are byte-identical to encoding the
+  equivalent dict (the fast path must never drift from the reference);
+- every SSE producer (chat completions, the LLM surface, the /mcp
+  streamable transport) frames through the same bytes, so the
+  cross-worker handoff byte-equality contract (docs/scaleout.md)
+  reduces to "same events in, same bytes out";
+- frames parse back to the exact event (no lossy compaction).
+"""
+
+import json
+
+from mcp_context_forge_tpu.gateway.serialize import (SSE_DATA, SSE_DONE,
+                                                     SSE_END, encode_json,
+                                                     jsonrpc_response_bytes,
+                                                     jsonrpc_result_bytes,
+                                                     sse_event)
+from mcp_context_forge_tpu.jsonrpc import error_response, result_response
+
+EVENTS = [
+    {"jsonrpc": "2.0", "method": "notifications/ping", "params": {"n": 1}},
+    {"id": "chatcmpl-1", "choices": [{"delta": {"content": "héllo ✓"}}]},
+    {"nested": {"deep": [1, 2.5, None, True, "x"]}, "empty": {}, "list": []},
+    "bare string event",
+    {"unicode": "é中文\U0001f600", "quote": 'has "quotes"'},
+]
+
+
+def test_encode_json_is_compact_utf8():
+    for event in EVENTS:
+        blob = encode_json(event)
+        # exact reference encoding: compact separators, raw UTF-8
+        assert blob == json.dumps(event, separators=(",", ":"),
+                                  ensure_ascii=False).encode()
+        # and lossless: parses back to the same object
+        assert json.loads(blob.decode()) == event
+
+
+def test_sse_event_framing_and_roundtrip():
+    for event in EVENTS:
+        frame = sse_event(event)
+        assert frame.startswith(SSE_DATA) and frame.endswith(SSE_END)
+        payload = frame[len(SSE_DATA):-len(SSE_END)]
+        assert json.loads(payload.decode()) == event
+    assert SSE_DONE == b"data: [DONE]\n\n"
+
+
+def test_sse_stream_bytes_are_deterministic():
+    """Same events in -> same bytes out, regardless of which writer
+    produced them: the handoff byte-equality contract's foundation."""
+    stream_a = b"".join(sse_event(e) for e in EVENTS) + SSE_DONE
+    stream_b = b"".join(sse_event(e) for e in EVENTS) + SSE_DONE
+    assert stream_a == stream_b
+    # and each frame is exactly the reference framing
+    assert stream_a == b"".join(
+        b"data: " + json.dumps(e, separators=(",", ":"),
+                               ensure_ascii=False).encode() + b"\n\n"
+        for e in EVENTS) + b"data: [DONE]\n\n"
+
+
+def test_jsonrpc_result_bytes_matches_dict_encoding():
+    """The fragment-assembled envelope must be byte-for-byte what
+    encoding jsonrpc.result_response() produces — key order included."""
+    cases = [
+        (1, {"ok": True}),
+        ("req-42", [1, 2, 3]),
+        (None, {"content": [{"type": "text", "text": "é ✓"}]}),
+        (7, None),
+        (0, ""),
+    ]
+    for request_id, result in cases:
+        assert jsonrpc_result_bytes(request_id, result) \
+            == encode_json(result_response(request_id, result))
+
+
+def test_jsonrpc_response_bytes_fast_path_and_fallback():
+    fast = result_response(3, {"tools": []})
+    assert jsonrpc_response_bytes(fast) == encode_json(fast)
+    assert jsonrpc_response_bytes(fast) \
+        == jsonrpc_result_bytes(3, {"tools": []})
+    # non-result shapes (errors, notification acks) take the generic
+    # encoder — same bytes as encoding the dict directly
+    err = error_response(4, -32601, "method not found")
+    assert jsonrpc_response_bytes(err) == encode_json(err)
+    extra = {"jsonrpc": "2.0", "id": 5, "result": 1, "x": 2}
+    assert jsonrpc_response_bytes(extra) == encode_json(extra)
+
+
+def test_streamable_http_frame_shares_the_encoder():
+    """The /mcp transport's SSE frame rides encode_json too: framing
+    with and without an event id, byte-compared against the reference."""
+    from mcp_context_forge_tpu.gateway.transports.streamable_http import \
+        _sse_frame
+    message = {"jsonrpc": "2.0", "method": "notifications/ping",
+               "params": {"text": "中文 ✓"}}
+    body = json.dumps(message, separators=(",", ":"),
+                      ensure_ascii=False).encode()
+    assert _sse_frame(None, message) \
+        == b"event: message\ndata: " + body + b"\n\n"
+    assert _sse_frame("ev-9", message) \
+        == b"id: ev-9\nevent: message\ndata: " + body + b"\n\n"

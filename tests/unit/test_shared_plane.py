@@ -190,3 +190,55 @@ async def test_provider_facade_and_remote_app_errors():
             await plane.stop()
         for rpc in rpcs:
             await rpc.stop()
+
+
+async def test_non_owner_queue_state_reads_the_leaders_pool():
+    """Real-process topology backpressure truth (satellite of the
+    process-scale-out PR): only the leader has engine objects, so a
+    non-owner's ``queue_state()`` — the source of X-Queue-Depth and the
+    shed decision — must surface the LEADER's depth/saturation via the
+    plane's bus-RPC cache. A worker-local zero here would tell clients
+    the fleet is idle while the owner's queue is drowning."""
+    from types import SimpleNamespace
+
+    from mcp_context_forge_tpu.gateway.flight_recorder import queue_state
+
+    bus = MemoryEventBus()
+    leases = MemoryLeaseManager()
+    providers = {}
+    rpcs = [BusRpc(bus, f"w{i}", leases=leases) for i in range(2)]
+    for rpc in rpcs:
+        await rpc.start()
+    planes = [await _plane(rpcs[i], leases, f"w{i}", providers)
+              for i in range(2)]
+    try:
+        owner = await _settle(planes)
+        remote = next(p for p in planes if p is not owner)
+        # the owner's pool: 7 queued of 10 admission slots
+        providers[owner.worker_id].engine = SimpleNamespace(
+            stats=SimpleNamespace(queue_depth=7),
+            config=SimpleNamespace(max_queue=10))
+        # the owner reports its own pool directly (no RPC hop)
+        assert owner.queue_state_sync() == {
+            "depth": 7, "capacity": 10, "saturation": 0.7}
+        # the non-owner starts with NO signal (None, never a fake zero),
+        # kicks a background refresh, and converges on the owner's truth
+        state = remote.queue_state_sync()
+        assert state is None or state["depth"] == 7
+        for _ in range(100):
+            state = remote.queue_state_sync()
+            if state is not None:
+                break
+            await asyncio.sleep(0.05)
+        assert state == {"depth": 7, "capacity": 10, "saturation": 0.7}
+        # and the HTTP tier's queue_state() on a worker app with no
+        # local engine rides the same plane cache — this is what the
+        # X-Queue-Depth header and OverloadShedder consult
+        app = {"engine_plane": remote}
+        assert queue_state(app) == {
+            "depth": 7, "capacity": 10, "saturation": 0.7}
+    finally:
+        for plane in planes:
+            await plane.stop()
+        for rpc in rpcs:
+            await rpc.stop()
