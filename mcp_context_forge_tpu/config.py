@@ -54,9 +54,10 @@ class Settings(BaseModel):
     # --- multi-worker gateway scale-out (supervisor.py, coordination/rpc.py,
     # docs/scaleout.md) ---
     # informational worker count + index, stamped by the supervisor per
-    # worker (fleet metrics / flight-recorder attribution read them)
-    gw_workers: int = 1
-    worker_index: int = 0
+    # worker; no code path reads them back — they surface through the
+    # diagnostics settings.json dump for per-worker bundle attribution
+    gw_workers: int = 1    # lint: allow[config-key-liveness] supervisor-stamped identity, surfaced via diagnostics settings.json
+    worker_index: int = 0  # lint: allow[config-key-liveness] supervisor-stamped identity, surfaced via diagnostics settings.json
     # all workers bind ONE listening port with SO_REUSEPORT (the kernel
     # spreads accepts); off = the legacy port-per-worker layout
     gw_reuse_port: bool = False
@@ -175,7 +176,6 @@ class Settings(BaseModel):
     streamable_http_stateful: bool = False
     sse_keepalive_interval: float = 30.0
     session_ttl: int = 3600
-    message_ttl: int = 600
     websocket_ping_interval: float = 20.0
 
     # --- limits / validation (reference validation_* family,
@@ -187,7 +187,6 @@ class Settings(BaseModel):
     max_header_field_bytes: int = 16384    # 431 past this per-field size
     rate_limit_rps: int = 0  # 0 = disabled
     rate_limit_burst: int = 200
-    validation_max_tool_name_length: int = 255
     validation_max_name_length: int = 255
     validation_max_description_length: int = 8192
     validation_max_url_length: int = 2048
@@ -301,11 +300,11 @@ class Settings(BaseModel):
     # TTL-cached list endpoints, bus-invalidated on entity changes ---
     registry_cache_enabled: bool = False
     registry_cache_default_ttl_s: float = 30.0
-    registry_cache_tools_ttl_s: float = 30.0
-    registry_cache_resources_ttl_s: float = 30.0
-    registry_cache_prompts_ttl_s: float = 30.0
-    registry_cache_servers_ttl_s: float = 30.0
-    registry_cache_gateways_ttl_s: float = 30.0
+    registry_cache_tools_ttl_s: float = 30.0  # lint: allow[config-key-liveness] read via f-string getattr in gateway/registry_cache.py
+    registry_cache_resources_ttl_s: float = 30.0  # lint: allow[config-key-liveness] read via f-string getattr in gateway/registry_cache.py
+    registry_cache_prompts_ttl_s: float = 30.0  # lint: allow[config-key-liveness] read via f-string getattr in gateway/registry_cache.py
+    registry_cache_servers_ttl_s: float = 30.0  # lint: allow[config-key-liveness] read via f-string getattr in gateway/registry_cache.py
+    registry_cache_gateways_ttl_s: float = 30.0  # lint: allow[config-key-liveness] read via f-string getattr in gateway/registry_cache.py
     # --- SSRF guard for catalog URLs (reference ssrf_* family) ---
     ssrf_protection_enabled: bool = False  # off: localhost upstreams are
                                            # the common single-host posture
@@ -353,7 +352,6 @@ class Settings(BaseModel):
     ssl_cert_file: str = ""
     ssl_key_file: str = ""
     ssl_ca_bundle: str = ""       # custom CA bundle for OUTBOUND verification
-    ssl_context_cache_size: int = 32
     # upstream MCP session pooling (reference session registry caps)
     upstream_max_sessions: int = 128
     upstream_idle_ttl: float = 300.0
@@ -764,7 +762,6 @@ class Settings(BaseModel):
     audit_enabled: bool = True
 
     # --- admin / UI ---
-    admin_api_enabled: bool = True
     admin_ui_enabled: bool = True
 
     @field_validator("database_url")

@@ -267,7 +267,7 @@ class Database:
                     if not transient or attempt >= self._max_retries:
                         raise
                     attempt += 1
-                    time.sleep(self._retry_interval_s)
+                    time.sleep(self._retry_interval_s)  # lint: allow[await-holding-lock] bounded WAL retry on the executor thread; the lane lock IS the serialization point
             if timing is not None:
                 timing.append((time.monotonic() - started) * 1000)
                 timing.append((started - wait_start) * 1000)
@@ -308,7 +308,7 @@ class Database:
                     except sqlite3.Error:
                         pass
                     attempt += 1
-                    time.sleep(self._retry_interval_s)
+                    time.sleep(self._retry_interval_s)  # lint: allow[await-holding-lock] bounded WAL retry on the executor thread; the writer lock IS the serialization point
             if timing is not None:
                 timing.append((time.monotonic() - started) * 1000)
                 timing.append((started - wait_start) * 1000)

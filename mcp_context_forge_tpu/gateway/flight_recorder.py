@@ -269,7 +269,7 @@ class LoopLagSampler:
         if self.metrics is not None:
             self.metrics.gw_loop_lag.observe(lag)
         if self.signals is not None:
-            self.signals.publish("gw.loop_lag_ms", lag * 1e3)
+            self.signals.publish("gw.loop_lag_ms", lag * 1e3)  # lint: allow[signal-name-conformance] dashboard-only export via the /signals snapshot; no steering consumer
         if self.warn_s and lag >= self.warn_s:
             self.long_callbacks += 1
             now = time.monotonic()

@@ -108,7 +108,7 @@ class TenantLedger:
         cache_key = (id(metric), tuple(sorted(labels.items())))
         child = self._child_cache.get(cache_key)
         if child is None:
-            child = self._child_cache[cache_key] = metric.labels(**labels)
+            child = self._child_cache[cache_key] = metric.labels(**labels)  # lint: allow[metric-label-cardinality] values pre-clamped by _label_for before they reach the child cache
         return child
 
     def add(self, tenant: str, *, requests: int = 0, prompt_tokens: int = 0,
@@ -121,7 +121,7 @@ class TenantLedger:
         dispatch thread vs gateway loop) cannot apply sets out of order
         and regress the exported ratio."""
         metrics = self.metrics
-        with self._lock:
+        with self._lock:  # lint: allow[lock-order-cycle] one-way edge: the clamp never calls back into the ledger (class docstring)
             key = self._key(tenant)
             totals = self._totals.setdefault(key, _zero_row())
             window = self._window.setdefault(key, _zero_row())

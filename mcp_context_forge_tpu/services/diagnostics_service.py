@@ -144,7 +144,7 @@ class JaxProfilerCapture:
                 raise ConflictError("a profiler capture is already running")
             import jax
 
-            jax.profiler.start_trace(self.trace_dir)
+            jax.profiler.start_trace(self.trace_dir)  # lint: allow[await-holding-lock] runs via asyncio.to_thread; the mutex exists to serialize exactly these transitions
             self._started_at = time.time()
             return self.status()
 
@@ -163,7 +163,7 @@ class JaxProfilerCapture:
 
             started = self._started_at
             try:
-                jax.profiler.stop_trace()
+                jax.profiler.stop_trace()  # lint: allow[await-holding-lock] runs via asyncio.to_thread; the mutex exists to serialize exactly these transitions
             finally:
                 self._started_at = None
             return {"active": False, "trace_dir": self.trace_dir,

@@ -1158,6 +1158,7 @@ def lint_core_oracle(mod: types.ModuleType) -> None:
     base = mod.Rule()
     assert list(base.check(ctx)) == []
     assert list(base.check_project([ctx])) == []
+    assert list(base.check_graph(None, [ctx])) == []
 
     class ROne(mod.Rule):
         rule_id = "r-one"
@@ -1311,6 +1312,70 @@ def lint_core_oracle(mod: types.ModuleType) -> None:
     assert [e.rule for e in res.errors] == ["syntax-error"]
     assert res.errors[0].path == "bad.py" and res.errors[0].lineno == 1
 
+    # ---- check_graph dispatch: rules that OVERRIDE check_graph get one
+    # shared ProjectGraph + the full context list; base-Rule instances
+    # must not trigger a build or receive a call
+    seen_graphs: list = []
+    seen_paths: list = []
+
+    class Graphy(mod.Rule):
+        rule_id = "graphy"
+
+        def check_graph(self, graph, contexts):
+            seen_graphs.append(graph)
+            seen_paths.append([c.path for c in contexts])
+            for name in sorted(graph.signal_published):
+                if name not in graph.signal_read:
+                    site = graph.signal_published[name][0]
+                    yield mod.Finding("graphy", site.path, site.lineno,
+                                      f"unread {name}")
+
+    class Graphy2(mod.Rule):
+        rule_id = "graphy2"
+
+        def check_graph(self, graph, contexts):
+            seen_graphs.append(graph)
+            return ()
+
+    graph_srcs = {
+        "r.py": ('def f(bus):\n'
+                 '    bus.publish("a.read", 1.0)\n'
+                 '    bus.publish("a.orphan", 1.0)\n'),
+        "s.py": 'def g(bus, rid):\n    return bus.get("a.read", rid)\n',
+    }
+    res = mod.lint_sources(graph_srcs, [Graphy(), Graphy2(), mod.Rule()])
+    assert [(f.path, f.lineno, f.message) for f in res.findings] == [
+        ("r.py", 3, "unread a.orphan")]
+    assert len(seen_graphs) == 2
+    assert seen_graphs[0] is seen_graphs[1]    # built ONCE, shared
+    assert seen_paths[0] == ["r.py", "s.py"]   # full context list handed in
+    # graph findings flow through the same triage: allow[] suppresses
+    res = mod.lint_sources(
+        {"r.py": ('def f(bus):\n'
+                  '    bus.publish("a.orphan", 1.0)'
+                  '  # lint: allow[graphy] dashboard-only\n')},
+        [Graphy()])
+    assert res.findings == [] and len(res.suppressed) == 1
+
+    # ---- triage() direct: the runner calls it with pre-gathered raw
+    # findings — code backfill, allow, baseline, sort, stale must all
+    # behave exactly as the serial path
+    tctx = mod.FileContext.from_source(
+        "keep = 1\nBAD = 2  # lint: allow[fire] migrating\n", "t.py")
+    raw = [mod.Finding("fire", "t.py", 2, "allowed here"),
+           mod.Finding("fire", "t.py", 1, "plain"),
+           mod.Finding("fire", "a.py", 2, "baselined", code="BAD = 2"),
+           mod.Finding("zz", "no-ctx.py", 9, "passthrough", code="kept")]
+    tri = mod.triage([tctx], raw, mod.Baseline(entries=[dict(entry)]))
+    assert [(f.path, f.lineno, f.rule) for f in tri.findings] == [
+        ("no-ctx.py", 9, "zz"), ("t.py", 1, "fire")]
+    assert tri.findings[1].code == "keep = 1"      # backfilled from ctx
+    assert tri.findings[0].code == "kept"          # pre-set survives
+    assert [f.message for f in tri.suppressed] == ["allowed here"]
+    assert [f.message for f in tri.baselined] == ["baselined"]
+    assert tri.stale_baseline == []
+    assert mod.triage([], [], None).clean is True  # default empty baseline
+
     # ---- collect_sources: dirs recurse, __pycache__ skipped, files ok
     with tempfile.TemporaryDirectory() as tmp:
         root = _Path(tmp)
@@ -1324,6 +1389,350 @@ def lint_core_oracle(mod: types.ModuleType) -> None:
         names = {p.rsplit("/", 1)[-1] for p in got}
         assert names == {"a.py", "b.py", "lone.py"}
         assert got[(root / "pkg" / "a.py").as_posix()] == "a = 1\n"
+
+
+def lint_project_oracle(mod: types.ModuleType) -> None:
+    """Behavioral spec of tools/lint/project.py: every registry the
+    cross-file rules query, extracted from small in-memory trees with
+    exact expected contents. A surviving mutant is a ProjectGraph that
+    silently drops (or invents) a registry entry — a whole-program rule
+    gone blind while the gate stays green."""
+    import tempfile
+    from pathlib import Path as _Path
+
+    from mcp_context_forge_tpu.tools.lint.core import FileContext
+
+    def build(sources, docs_text=None):
+        ctxs = [FileContext.from_source(src, path)
+                for path, src in sorted(sources.items())]
+        return mod.ProjectGraph.build(ctxs, docs_text=docs_text)
+
+    # ---- site dataclasses are frozen value objects (rules dedupe them
+    # in sets — an unfrozen mutant is unhashable)
+    assert len({mod.Site("a.py", 1), mod.Site("a.py", 1)}) == 1
+    assert len({mod.RpcSite("a.py", 1, "unary"),
+                mod.RpcSite("a.py", 1, "unary")}) == 1
+    assert len({mod.MetricDecl("a", "n", (), "p", 1)}) == 1
+    assert len({mod.LockDecl("k", "", "threading", "p", 1)}) == 1
+
+    # ---- Bus-RPC registry: register/register_stream (positional and
+    # keyword names), call/call_stream with timeout detection, literal
+    # names resolved through same-class forwarders (keyword AND
+    # positional passing); dotless names and non-rpc receivers never
+    # count, on the direct path or the forwarder path
+    rpc_server = (
+        'class Srv:\n'
+        '    def __init__(self, rpc):\n'
+        '        rpc.register("pool.status", self._st)\n'
+        '        rpc.register_stream("pool.tail", self._tl)\n'
+        '        rpc.register(method="pool.kw", handler=self._kw)\n'
+        '        rpc.register("nodot", self._nd)\n'
+        '        other.register("pool.ghost", self._gh)\n'
+    )
+    rpc_client = (
+        'class Cli:\n'
+        '    def __init__(self, rpc):\n'
+        '        self._rpc = rpc\n'
+        '    def plain(self, w):\n'
+        '        return self._rpc.call(w, "pool.status")\n'
+        '    def timed(self, w):\n'
+        '        return self._rpc.call(w, "pool.status", timeout_s=1.0)\n'
+        '    def tail(self, w):\n'
+        '        return self._rpc.call_stream(w, "pool.tail",\n'
+        '                                     idle_timeout_s=2.0)\n'
+        '    def tail_bare(self, w):\n'
+        '        return self._rpc.call_stream(w, "pool.tail")\n'
+        '    def _fwd(self, w, method):\n'
+        '        return self._rpc.call(w, method=method)\n'
+        '    def via(self, w):\n'
+        '        return self._fwd(w, "pool.fwd")\n'
+        '    def _fwd2(self, w, m):\n'
+        '        return self._rpc.call(w, m)\n'
+        '    def via2(self, w):\n'
+        '        return self._fwd2(w, "pool.fwd2")\n'
+        '    def via_dotless(self, w):\n'
+        '        return self._fwd(w, "nodotfwd")\n'
+        '    def bogus(self, w):\n'
+        '        return other.call(w, "pool.bogus")\n'
+        '    def _notrpc(self, w, method):\n'
+        '        return self.conn.call(w, method)\n'
+        '    def use_notrpc(self, w):\n'
+        '        return self._notrpc(w, "pool.fake")\n'
+    )
+    g = build({"fx/server.py": rpc_server, "fx/client.py": rpc_client})
+    assert g.paths == ["fx/client.py", "fx/server.py"]
+    assert set(g.rpc_registered) == {"pool.status", "pool.tail", "pool.kw"}
+    st, = g.rpc_registered["pool.status"]
+    assert (st.path, st.lineno, st.kind) == ("fx/server.py", 3, "unary")
+    assert st.has_idle_timeout is False        # the dataclass default
+    tl, = g.rpc_registered["pool.tail"]
+    assert (tl.path, tl.lineno, tl.kind) == ("fx/server.py", 4, "stream")
+    kw, = g.rpc_registered["pool.kw"]
+    assert (kw.lineno, kw.kind) == (5, "unary")
+    assert set(g.rpc_called) == {"pool.status", "pool.tail",
+                                 "pool.fwd", "pool.fwd2"}
+    assert sorted((c.lineno, c.kind, c.has_idle_timeout)
+                  for c in g.rpc_called["pool.status"]) == [
+        (5, "unary", False), (7, "unary", True)]
+    assert sorted((c.lineno, c.kind, c.has_idle_timeout)
+                  for c in g.rpc_called["pool.tail"]) == [
+        (9, "stream", True), (12, "stream", False)]
+    fwd, = g.rpc_called["pool.fwd"]
+    assert (fwd.path, fwd.lineno, fwd.kind) == ("fx/client.py", 16, "unary")
+    fwd2, = g.rpc_called["pool.fwd2"]
+    assert (fwd2.lineno, fwd2.kind, fwd2.has_idle_timeout) == \
+        (20, "unary", False)
+    # subset-run degradation: registries anchored on an absent module
+    # come out empty, never invented
+    g = build({"fx/client.py": rpc_client})
+    assert g.rpc_registered == {}
+    assert set(g.rpc_called) == {"pool.status", "pool.tail",
+                                 "pool.fwd", "pool.fwd2"}
+
+    # ---- SignalBus names: sync publishes on signal-shaped receivers
+    # only (awaited / dict-payload calls are the EventBus twin), valid
+    # dotted lowercase names only, f-strings as dynamic prefixes; reads
+    # via get/ewma/replicas including the forwarder and const-tuple-loop
+    # idioms
+    signal_engine = (
+        'class Eng:\n'
+        '    def step(self, signals, shard):\n'
+        '        signals.publish("llm.occupancy", 0.5)\n'
+        '        signals.publish(f"slo.burn.{shard}", 1.0)\n'
+        '        signals.publish(f"nodot{shard}", 1.0)\n'
+        '        signals.publish("UPPER.Name", 1.0)\n'
+        '        signals.publish("flat", 1.0)\n'
+        '        signals.publish("llm.unread", 1.0)\n'
+        '    async def emit(self, bus):\n'
+        '        await bus.publish("llm.event", {"k": 1})\n'
+        '        await bus.publish("llm.awaited", 1.0)\n'
+        '    def dictpub(self, bus):\n'
+        '        bus.publish("llm.dictpay", {"k": 1})\n'
+        '    def other(self, queue):\n'
+        '        queue.publish("llm.queue", 1.0)\n'
+        '    def qread(self, queue, rid):\n'
+        '        queue.get("llm.qread", rid)\n'
+        '    def badargs(self, signals, shard):\n'
+        '        signals.publish(5, 1.0)\n'
+        '        signals.publish(f"{shard}.dyn", 1.0)\n'
+    )
+    signal_ctl = (
+        '_MOD_SIGS = ("ctl.mod_sig",)\n'
+        '\n'
+        'class Ctl:\n'
+        '    _EFFECTS = ("llm.eff_a", "llm.eff_b")\n'
+        '    _LIMIT = 3\n'
+        '    def __init__(self, bus):\n'
+        '        self.bus = bus\n'
+        '    def _view(self, name, rid):\n'
+        '        return self.bus.get(name, rid)\n'
+        '    def tick(self, rid):\n'
+        '        a = self.bus.get("llm.occupancy", rid)\n'
+        '        b = self.bus.ewma("llm.ew", rid)\n'
+        '        c = self.bus.replicas("llm.rep", rid)\n'
+        '        d = self._view("llm.via_fwd", rid)\n'
+        '        for name in self._EFFECTS:\n'
+        '            self.bus.get(name, rid)\n'
+        '        return a, b, c, d\n'
+        '    def probe(self, rid):\n'
+        '        for name in self._LIMIT:\n'
+        '            self.bus.get(name, rid)\n'
+        '    def modloop(self, rid):\n'
+        '        for name in _MOD_SIGS:\n'
+        '            self.bus.get(name, rid)\n'
+        '    def bad_fwd(self, rid):\n'
+        '        return self._view("NotValid.Name", rid)\n'
+        '    def _notsig(self, name, rid):\n'
+        '        return self.store.get(name, rid)\n'
+        '    def use_notsig(self, rid):\n'
+        '        return self._notsig("fake.sig", rid)\n'
+    )
+    signal_pump = (
+        '_SIGS = ("mod.one", "mod.two")\n'
+        '_MIXED = ("bad.mix", 3)\n'
+        '\n'
+        'def pump(my_signals, rid):\n'
+        '    for s in _SIGS:\n'
+        '        my_signals.get(s, rid)\n'
+    )
+    g = build({"fx/eng.py": signal_engine, "fx/ctl.py": signal_ctl,
+               "fx/pump.py": signal_pump})
+    assert set(g.signal_published) == {"llm.occupancy", "llm.unread"}
+    pub, = g.signal_published["llm.occupancy"]
+    assert (pub.path, pub.lineno) == ("fx/eng.py", 3)
+    assert [(p, s.lineno) for p, s in g.signal_prefixes] == \
+        [("slo.burn.", 4)]
+    assert set(g.signal_read) == {
+        "llm.occupancy", "llm.ew", "llm.rep", "llm.via_fwd",
+        "llm.eff_a", "llm.eff_b", "ctl.mod_sig", "mod.one", "mod.two"}
+    assert g.signal_read["llm.via_fwd"][0].lineno == 14
+    assert {s.lineno for s in g.signal_read["llm.eff_a"]} == {16}
+    assert g.signal_read["ctl.mod_sig"][0].lineno == 23
+    assert g.signal_read["mod.one"][0] == mod.Site("fx/pump.py", 6)
+    # only all-string tuples are consts (the mixed one must not index)
+    assert g.module_consts["fx/pump.py"] == {"_SIGS": ("mod.one",
+                                                       "mod.two")}
+
+    # ---- FaultPlane: the FAULT_POINTS literal counts only in a file
+    # named faults.py; fault_point("name") sites count bare or dotted
+    faults_mod = 'FAULT_POINTS = ("db.write", "rpc.send")\n'
+    fault_user = (
+        'def crash(plane):\n'
+        '    fault_point("db.write")\n'
+        '    plane.fault_point("rpc.send")\n'
+    )
+    g = build({"fx/observability/faults.py": faults_mod,
+               "fx/db.py": fault_user})
+    assert set(g.fault_points) == {"db.write", "rpc.send"}
+    assert g.fault_points["db.write"] == mod.Site(
+        "fx/observability/faults.py", 1)
+    assert {n: [s.lineno for s in sites]
+            for n, sites in g.fault_calls.items()} == {
+        "db.write": [2], "rpc.send": [3]}
+    g = build({"fx/other.py": faults_mod})
+    assert g.fault_points == {}
+    assert g.module_consts["fx/other.py"]["FAULT_POINTS"] == \
+        ("db.write", "rpc.send")
+
+    # ---- Prometheus metrics: declared only inside *Registry* classes;
+    # labels from the positional list or the labelnames keyword
+    metrics_src = (
+        'class MeterRegistry:\n'
+        '    def __init__(self):\n'
+        '        self.tpot = Histogram("llm_tpot_s", "h",\n'
+        '                              ["tenant", "phase"])\n'
+        '        self.codes = Counter("http_total", "h",\n'
+        '                             labelnames=("code",))\n'
+        '        self.plain = Gauge("up", "h")\n'
+        '        self.notmetric = dict()\n'
+        '        self.version = "1.0"\n'
+        '        self.weird = Counter(NAME_CONST, "h")\n'
+        '        self.num = Gauge(7, "h")\n'
+        '        self.empty = Counter()\n'
+        '\n'
+        'class Helper:\n'
+        '    def __init__(self):\n'
+        '        self.stray = Counter("stray_total", "h")\n'
+    )
+    g = build({"fx/metrics.py": metrics_src})
+    assert set(g.metrics) == {"tpot", "codes", "plain"}
+    assert g.metrics["tpot"].labels == ("tenant", "phase")
+    assert g.metrics["tpot"].name == "llm_tpot_s"
+    assert g.metrics["tpot"].lineno == 3
+    assert g.metrics["codes"].labels == ("code",)
+    assert g.metrics["plain"].labels == ()
+
+    # ---- Config knobs: Settings fields only in config.py (private and
+    # model_config skipped), EngineConfig fields anywhere; attr_reads
+    # indexes plain attributes AND getattr/hasattr string literals
+    config_src = (
+        'class Settings:\n'
+        '    alpha: int = 1\n'
+        '    ghost_knob: int = 2\n'
+        '    _hidden: int = 3\n'
+        '    model_config: dict = {}\n'
+        '\n'
+        'class EngineConfig:\n'
+        '    pages: int = 8\n'
+    )
+    reader_src = (
+        'def use(cfg):\n'
+        '    if hasattr(cfg, "maybe_knob"):\n'
+        '        return cfg.alpha + getattr(cfg, "opt_knob", 0)\n'
+        '    return 0\n'
+    )
+    g = build({"fx/config.py": config_src, "fx/reader.py": reader_src})
+    assert set(g.settings_fields) == {"alpha", "ghost_knob"}
+    assert g.settings_fields["alpha"] == mod.Site("fx/config.py", 2)
+    assert set(g.engine_fields) == {"pages"}
+    assert g.attr_reads.get("alpha") == {"fx/reader.py"}
+    assert g.attr_reads.get("maybe_knob") == {"fx/reader.py"}
+    assert g.attr_reads.get("opt_knob") == {"fx/reader.py"}
+    assert "ghost_knob" not in g.attr_reads
+    g = build({"fx/not_config.py": config_src})
+    assert g.settings_fields == {} and set(g.engine_fields) == {"pages"}
+
+    # ---- Locks, classes, call structure
+    locks_src = (
+        'import threading\n'
+        'import asyncio\n'
+        'from os import path\n'
+        '\n'
+        '_IO_LOCK = threading.Lock()  # lint: lock[io]\n'
+        '\n'
+        'class Pool:\n'
+        '    def __init__(self, clamp=None):\n'
+        '        self._sched_lock = threading.Lock()'
+        '  # lint: lock[sched]\n'
+        '        self._stats_lock = threading.RLock()\n'
+        '        self._gate = asyncio.Lock()\n'
+        '        self._clamp = clamp or TenantClamp()\n'
+        '    def grab(self):\n'
+        '        with self._sched_lock:\n'
+        '            self._note()\n'
+        '    def _note(self):\n'
+        '        pass\n'
+    )
+    g = build({"fx/pool.py": locks_src})
+    assert set(g.locks) == {"pool.py:_IO_LOCK", "Pool._sched_lock",
+                            "Pool._stats_lock", "Pool._gate"}
+    io_lock = g.locks["pool.py:_IO_LOCK"]
+    assert (io_lock.context, io_lock.kind, io_lock.lineno) == \
+        ("io", "threading", 5)
+    sched = g.locks["Pool._sched_lock"]
+    assert (sched.context, sched.kind, sched.lineno) == \
+        ("sched", "threading", 9)
+    assert g.locks["Pool._stats_lock"].kind == "rlock"
+    assert g.locks["Pool._gate"].kind == "asyncio"
+    info = g.classes[("fx/pool.py", "Pool")]
+    assert set(info.methods) == {"__init__", "grab", "_note"}
+    assert info.attr_types == {"_clamp": "TenantClamp"}
+    assert g.class_of_attr("fx/pool.py", "Pool", "_clamp") == "TenantClamp"
+    assert g.class_of_attr("fx/pool.py", "Pool", "_gate") is None
+    assert g.self_calls[("fx/pool.py", "Pool", "grab")] == {"_note"}
+    assert g.functions[("fx/pool.py", "Pool.grab")] == 13
+    assert g.imports["fx/pool.py"] == {"threading", "asyncio", "os"}
+
+    # ---- find_class: simple name resolves only when unambiguous
+    dup = 'class Dup:\n    pass\n'
+    uniq = 'class Uniq:\n    pass\n'
+    g = build({"fx/a.py": dup + uniq, "fx/b.py": dup})
+    assert g.find_class("Uniq").path == "fx/a.py"
+    assert g.find_class("Dup") is None
+    assert g.find_class("Missing") is None
+    assert sorted(g.class_index["Dup"]) == [("fx/a.py", "Dup"),
+                                            ("fx/b.py", "Dup")]
+
+    # ---- docs: in-memory fixture paths (not on disk) discover None;
+    # an explicit docs_text (even empty) passes through verbatim; a
+    # real tree finds the docs/ sibling, all *.md files sorted
+    assert build({"fx/a.py": "x = 1\n"}).docs_text is None
+    assert build({"fx/a.py": "x = 1\n"},
+                 docs_text="alpha knob").docs_text == "alpha knob"
+    assert build({"fx/a.py": "x = 1\n"}, docs_text="").docs_text == ""
+    with tempfile.TemporaryDirectory() as tmp:
+        root = _Path(tmp)
+        (root / "proj" / "pkg").mkdir(parents=True)
+        # a docs/ dir with no .md files does not count — the walk keeps
+        # climbing to the real one
+        (root / "proj" / "pkg" / "docs").mkdir()
+        (root / "proj" / "docs").mkdir()
+        (root / "proj" / "docs" / "a.md").write_text("ALPHA")
+        (root / "proj" / "docs" / "b.md").write_text("BETA")
+        mod_path = root / "proj" / "pkg" / "mod.py"
+        mod_path.write_text("x = 1\n")
+        ctx = FileContext.from_source("x = 1\n", mod_path.as_posix())
+        assert mod.ProjectGraph.build([ctx]).docs_text == "ALPHA\nBETA"
+
+    # ---- dump(): the debug snapshot carries every registry
+    g = build({"fx/server.py": rpc_server, "fx/eng.py": signal_engine,
+               "fx/metrics.py": metrics_src})
+    d = g.dump()
+    assert d["rpc_registered"] == ["pool.kw", "pool.status", "pool.tail"]
+    assert d["signal_published"] == ["llm.occupancy", "llm.unread"]
+    assert d["signal_prefixes"] == ["slo.burn."]
+    assert d["metrics"] == {"tpot": ["tenant", "phase"],
+                            "codes": ["code"], "plain": []}
 
 
 TARGETS: dict[str, MutationTarget] = {
@@ -1420,6 +1829,15 @@ TARGETS: dict[str, MutationTarget] = {
         # for the sources a lint run feeds it — nudging the constant is
         # unobservable
         equivalent_markers=("exc.lineno or 0",),
+    ),
+    "lint_project": MutationTarget(
+        rel_path="tools/lint/project.py",
+        module_name="mcp_context_forge_tpu.tools.lint.project",
+        package="mcp_context_forge_tpu.tools.lint",
+        oracle=lint_project_oracle,
+        # basename via rsplit("/", 1)[-1]: nudging maxsplit only adds
+        # splits LEFT of the one [-1] reads — the basename is identical
+        equivalent_markers=('ctx.path.rsplit("/", 1)[-1]',),
     ),
     "rate_limiter": MutationTarget(
         rel_path="gateway/middleware.py",

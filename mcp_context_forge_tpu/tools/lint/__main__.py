@@ -14,8 +14,11 @@ import sys
 from pathlib import Path
 
 from . import (DEFAULT_BASELINE, Baseline, active_rules,
-               load_default_baseline, lint_paths)
+               load_default_baseline)
 from .reporters import json_report, text_report
+from .runner import run_paths
+
+_DEFAULT_CACHE = Path(".lint_cache.json")
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -36,6 +39,14 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--rules", default="",
                         help="comma-separated rule ids (default: all)")
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--jobs", type=int, default=1, metavar="N",
+                        help="worker processes for per-file rules "
+                             "(default: 1, serial)")
+    parser.add_argument("--cache", type=Path, default=None, metavar="FILE",
+                        help="per-file result cache keyed on content hash "
+                             f"(default: {_DEFAULT_CACHE})")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="disable the result cache for this run")
     args = parser.parse_args(argv)
 
     rules = active_rules()
@@ -76,7 +87,13 @@ def main(argv: list[str] | None = None) -> int:
                   file=sys.stderr)
             return 2
 
-    result = lint_paths(roots, rules=rules, baseline=baseline)
+    # the cache is content-keyed so hits are never stale, but a
+    # baseline-regenerating run writes the gate file itself — run it
+    # cold so the snapshot can't inherit a cache bug
+    cache_path = (None if args.no_cache or args.write_baseline
+                  else (args.cache or _DEFAULT_CACHE))
+    result = run_paths(roots, rules, baseline=baseline,
+                       jobs=max(1, args.jobs), cache_path=cache_path)
 
     if args.write_baseline:
         # scoped runs (subset paths / --rules) must not discard the

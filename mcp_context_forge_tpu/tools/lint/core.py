@@ -108,7 +108,10 @@ class Rule:
     """Base class; subclasses register with ``@register``.
 
     Per-file rules override ``check``; whole-tree rules (which need every
-    file at once, e.g. dead-metric) override ``check_project``.
+    file at once, e.g. dead-metric) override ``check_project``; rules
+    that query the cross-file registries (bus-RPC methods, signal names,
+    locks, metrics, config fields) override ``check_graph`` and receive
+    the ``ProjectGraph`` built once per run.
     """
 
     rule_id: str = ""
@@ -118,6 +121,10 @@ class Rule:
         return ()
 
     def check_project(self, contexts: list[FileContext]) -> Iterable[Finding]:
+        return ()
+
+    def check_graph(self, graph: Any,
+                    contexts: list[FileContext]) -> Iterable[Finding]:
         return ()
 
 
@@ -234,13 +241,30 @@ def lint_contexts(contexts: list[FileContext], rules: Iterable[Rule],
                   baseline: Baseline | None = None) -> LintResult:
     """Run ``rules`` over ``contexts`` and triage every finding into
     actionable / suppressed / baselined."""
-    result = LintResult()
-    by_path = {ctx.path: ctx for ctx in contexts}
     raw: list[Finding] = []
+    rules = list(rules)
     for rule in rules:
         for ctx in contexts:
             raw.extend(rule.check(ctx))
         raw.extend(rule.check_project(contexts))
+    graph_rules = [r for r in rules
+                   if type(r).check_graph is not Rule.check_graph]
+    if graph_rules:
+        # built ONCE per run, shared by every graph-backed rule
+        from .project import ProjectGraph
+        graph = ProjectGraph.build(contexts)
+        for rule in graph_rules:
+            raw.extend(rule.check_graph(graph, contexts))
+    return triage(contexts, raw, baseline)
+
+
+def triage(contexts: list[FileContext], raw: Iterable[Finding],
+           baseline: Baseline | None = None) -> LintResult:
+    """Sort raw findings into actionable / suppressed / baselined.
+    Shared by the serial path above and the cached/parallel runner
+    (``runner.py``) so both triage identically."""
+    result = LintResult()
+    by_path = {ctx.path: ctx for ctx in contexts}
     baseline = baseline if baseline is not None else Baseline()
     for finding in raw:
         ctx = by_path.get(finding.path)

@@ -1,0 +1,604 @@
+"""ProjectGraph: the whole-program index mcpforge-lint's cross-file
+rules query.
+
+Per-file rules see one ``FileContext``; everything dangerous added since
+PR 4 lives BETWEEN files — BusRpc method registries spanning
+``coordination/`` and ``tpu_local/pool_rpc.py``, SignalBus names produced
+in the engine and consumed by the controller, lock acquisitions nesting
+across classes, ~100 config knobs defined in ``config.py`` and read
+everywhere else. The graph is built ONCE per lint run (``build`` is a
+pure function of the context list) and handed to every rule that
+overrides ``Rule.check_graph``.
+
+What it extracts (all static, all stdlib ``ast``):
+
+- **Bus-RPC registry** — ``*rpc*.register("m", h)`` /
+  ``register_stream`` sites, and ``*rpc*.call(worker, "m")`` /
+  ``call_stream`` sites. Literal method names flowing through a
+  same-class *forwarder* (a method that passes one of its own parameters
+  on to ``.call``/``.call_stream``, like ``EnginePoolRpc._call``) are
+  resolved to the forwarder's call sites.
+- **SignalBus names** — non-awaited ``.publish("a.b", value[, replica])``
+  on a ``signals``/``bus`` receiver (the EventBus twin is always awaited
+  and carries a dict payload; both filters apply), f-string publishes as
+  dynamic *prefixes*, and reads via ``.get/.ewma/.replicas`` — including
+  literals resolved through a same-class forwarder (``_view``) and
+  through ``for name in <CONST_TUPLE>`` loops (``_EFFECT_SIGNALS``).
+- **FaultPlane points** — the ``FAULT_POINTS`` literal in
+  ``observability/faults.py`` plus every ``fault_point("name")`` site.
+- **Prometheus metrics** — ``self.attr = Counter/Gauge/Histogram(name,
+  help, [labels])`` inside ``*Registry*`` classes.
+- **Config fields** — ``Settings`` class fields in ``config.py`` and
+  ``EngineConfig`` dataclass fields, plus a global attribute-read index
+  for liveness checks, and the concatenated ``docs/*.md`` text when the
+  tree being linted has a ``docs/`` sibling on disk.
+- **Locks & calls** — in-tree ``threading.Lock/RLock`` / ``asyncio.Lock``
+  declarations (with their ``# lint: lock[ctx]`` thread tags), per-class
+  method tables, same-class call edges, and attribute→class typing from
+  ``self.x = ClassName(...)`` constructions and ``__init__`` annotations,
+  so the lock-order rule can follow an acquisition chain like
+  ``TenantLedger.add → _label_for → TenantClamp.label`` across files.
+
+Subset-run degradation: registries anchored on a module that is not in
+the context set simply come out empty; rules gate on the anchor's
+presence (the span-stitch pattern) so linting one file never invents
+whole-tree findings.
+
+Mutation-gated: ``testing/oracles.py::lint_project_oracle`` specs the
+extraction behaviorally; a mutant that drops a registry entry or a call
+edge is a cross-file rule gone silently blind.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Iterable
+
+from .astutil import dotted
+from .core import FileContext
+
+_SIGNAL_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+_LOCK_CTORS = {("threading", "Lock"), ("threading", "RLock"),
+               ("asyncio", "Lock")}
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram", "Summary"}
+_SIGNAL_RECEIVERS = {"signals", "bus", "signal_bus"}
+_SIGNAL_READS = {"get", "ewma", "replicas"}
+
+
+@dataclass(frozen=True)
+class Site:
+    """One source location, reportable as a Finding anchor."""
+    path: str
+    lineno: int
+
+
+@dataclass(frozen=True)
+class RpcSite:
+    path: str
+    lineno: int
+    kind: str                 # "unary" | "stream"
+    has_idle_timeout: bool = False
+
+
+@dataclass(frozen=True)
+class MetricDecl:
+    attr: str
+    name: str
+    labels: tuple[str, ...]
+    path: str
+    lineno: int
+
+
+@dataclass(frozen=True)
+class LockDecl:
+    key: str                  # "Class.attr" or "module:name"
+    context: str              # lint: lock[ctx] tag ("" when untagged)
+    kind: str                 # "threading" | "asyncio"
+    path: str
+    lineno: int
+
+
+@dataclass
+class _ClassInfo:
+    path: str
+    name: str
+    methods: dict[str, ast.AST] = field(default_factory=dict)
+    attr_types: dict[str, str] = field(default_factory=dict)
+    consts: dict[str, tuple[str, ...]] = field(default_factory=dict)
+
+
+class ProjectGraph:
+    """Whole-program registries + call structure for cross-file rules."""
+
+    def __init__(self) -> None:
+        self.paths: list[str] = []
+        self.rpc_registered: dict[str, list[RpcSite]] = {}
+        self.rpc_called: dict[str, list[RpcSite]] = {}
+        self.signal_published: dict[str, list[Site]] = {}
+        self.signal_prefixes: list[tuple[str, Site]] = []
+        self.signal_read: dict[str, list[Site]] = {}
+        self.fault_points: dict[str, Site] = {}
+        self.fault_calls: dict[str, list[Site]] = {}
+        self.metrics: dict[str, MetricDecl] = {}
+        self.settings_fields: dict[str, Site] = {}
+        self.engine_fields: dict[str, Site] = {}
+        self.attr_reads: dict[str, set[str]] = {}
+        self.locks: dict[str, LockDecl] = {}
+        self.classes: dict[tuple[str, str], _ClassInfo] = {}
+        self.class_index: dict[str, list[tuple[str, str]]] = {}
+        self.module_consts: dict[str, dict[str, tuple[str, ...]]] = {}
+        self.imports: dict[str, set[str]] = {}
+        self.functions: dict[tuple[str, str], int] = {}
+        self.self_calls: dict[tuple[str, str, str], set[str]] = {}
+        self.docs_text: str | None = None
+
+    # ------------------------------------------------------------ build
+
+    @classmethod
+    def build(cls, contexts: list[FileContext],
+              docs_text: str | None = None) -> "ProjectGraph":
+        graph = cls()
+        for ctx in contexts:
+            graph._scan_file(ctx)
+        graph._resolve_forwarders(contexts)
+        graph.docs_text = (docs_text if docs_text is not None
+                           else cls._discover_docs(contexts))
+        return graph
+
+    @staticmethod
+    def _discover_docs(contexts: list[FileContext]) -> str | None:
+        """Concatenated ``docs/*.md`` next to the tree being linted.
+        In-memory fixture runs (paths that do not exist on disk) get
+        ``None`` — rules skip their docs clause rather than flag every
+        knob as undocumented."""
+        for ctx in contexts:
+            probe = Path(ctx.path)
+            if not probe.exists():
+                continue
+            for parent in probe.resolve().parents:
+                docs = parent / "docs"
+                if docs.is_dir() and any(docs.glob("*.md")):
+                    return "\n".join(
+                        p.read_text(encoding="utf-8", errors="replace")
+                        for p in sorted(docs.glob("*.md")))
+        return None
+
+    # ------------------------------------------------------- file scan
+
+    def _scan_file(self, ctx: FileContext) -> None:
+        self.paths.append(ctx.path)
+        filename = ctx.path.rsplit("/", 1)[-1]
+        self.imports[ctx.path] = self._imports_of(ctx.tree)
+        self.module_consts[ctx.path] = {}
+        self._scan_body(ctx, ctx.tree.body, filename)
+        # every attribute name touched anywhere in the file (liveness);
+        # getattr(x, "name", default) is a read too — the config tree's
+        # forward-compat idiom for optional knobs
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute):
+                self.attr_reads.setdefault(node.attr, set()).add(ctx.path)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in ("getattr", "hasattr") and \
+                    len(node.args) >= 2 and \
+                    isinstance(node.args[1], ast.Constant) and \
+                    isinstance(node.args[1].value, str):
+                self.attr_reads.setdefault(node.args[1].value,
+                                           set()).add(ctx.path)
+
+    @staticmethod
+    def _imports_of(tree: ast.Module) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                out.update(alias.name for alias in node.names)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                out.add(node.module)
+        return out
+
+    def _scan_body(self, ctx: FileContext, body: Iterable[ast.AST],
+                   filename: str) -> None:
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                self._scan_class(ctx, node, filename)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[(ctx.path, node.name)] = node.lineno
+                self._scan_stmts(ctx, node, cls=None)
+            else:
+                if isinstance(node, ast.Assign):
+                    self._module_assign(ctx, node, filename)
+                self._scan_stmts(ctx, node, cls=None)
+
+    def _scan_class(self, ctx: FileContext, node: ast.ClassDef,
+                    filename: str) -> None:
+        info = _ClassInfo(path=ctx.path, name=node.name)
+        self.classes[(ctx.path, node.name)] = info
+        self.class_index.setdefault(node.name, []).append(
+            (ctx.path, node.name))
+        is_registry = "Registry" in node.name
+        is_settings = filename == "config.py" and node.name == "Settings"
+        is_engine_cfg = node.name == "EngineConfig"
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.methods[stmt.name] = stmt
+                self.functions[(ctx.path, f"{node.name}.{stmt.name}")] = \
+                    stmt.lineno
+                self._scan_method(ctx, node.name, stmt, is_registry)
+            elif isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name):
+                name = stmt.target.id
+                if name.startswith("_") or name == "model_config":
+                    continue
+                if is_settings:
+                    self.settings_fields[name] = Site(ctx.path, stmt.lineno)
+                elif is_engine_cfg:
+                    self.engine_fields[name] = Site(ctx.path, stmt.lineno)
+            elif isinstance(stmt, ast.Assign):
+                consts = self._const_strs(stmt.value)
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name) and consts is not None:
+                        info.consts[target.id] = consts
+
+    def _scan_method(self, ctx: FileContext, cls: str, fn: ast.AST,
+                     is_registry: bool) -> None:
+        calls: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                self._self_assign(ctx, cls, node, is_registry)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "self":
+                calls.add(node.func.attr)
+        self.self_calls[(ctx.path, cls, getattr(fn, "name", "?"))] = calls
+        self._scan_stmts(ctx, fn, cls=cls)
+
+    def _module_assign(self, ctx: FileContext, node: ast.Assign,
+                       filename: str) -> None:
+        consts = self._const_strs(node.value)
+        for target in node.targets:
+            if not isinstance(target, ast.Name):
+                continue
+            if consts is not None:
+                self.module_consts[ctx.path][target.id] = consts
+                if filename == "faults.py" and target.id == "FAULT_POINTS":
+                    for name in consts:
+                        self.fault_points[name] = Site(ctx.path, node.lineno)
+            lock_kind = self._lock_kind(node.value)
+            if lock_kind is not None:
+                tag = ctx.markers_of("lock").get(node.lineno, "")
+                key = f"{filename}:{target.id}"
+                self.locks[key] = LockDecl(key, tag, lock_kind,
+                                           ctx.path, node.lineno)
+
+    def _self_assign(self, ctx: FileContext, cls: str,
+                     node: ast.Assign, is_registry: bool) -> None:
+        info = self.classes[(ctx.path, cls)]
+        for target in node.targets:
+            if not (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                continue
+            attr = target.attr
+            lock_kind = self._lock_kind(node.value)
+            if lock_kind is not None:
+                tag = ctx.markers_of("lock").get(node.lineno, "")
+                key = f"{cls}.{attr}"
+                self.locks[key] = LockDecl(key, tag, lock_kind,
+                                           ctx.path, node.lineno)
+            ctor = self._constructed_class(node.value)
+            if ctor is not None:
+                info.attr_types[attr] = ctor
+            if is_registry:
+                metric = self._metric_decl(attr, node.value,
+                                           ctx.path, node.lineno)
+                if metric is not None:
+                    self.metrics[attr] = metric
+
+    # ------------------------------------------------- expression helpers
+
+    @staticmethod
+    def _const_strs(value: ast.AST) -> tuple[str, ...] | None:
+        if not isinstance(value, (ast.Tuple, ast.List)):
+            return None
+        out = []
+        for elt in value.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, str)):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+
+    @staticmethod
+    def _lock_kind(value: ast.AST) -> str | None:
+        if not isinstance(value, ast.Call):
+            return None
+        d = dotted(value.func)
+        if d not in _LOCK_CTORS:
+            return None
+        if d[0] == "asyncio":
+            return "asyncio"
+        return "rlock" if d[1] == "RLock" else "threading"
+
+    @staticmethod
+    def _constructed_class(value: ast.AST) -> str | None:
+        """``ClassName(...)`` / ``x or ClassName(...)`` → ``ClassName``."""
+        if isinstance(value, ast.BoolOp):
+            for operand in value.values:
+                got = ProjectGraph._constructed_class(operand)
+                if got is not None:
+                    return got
+            return None
+        if isinstance(value, ast.Call) and isinstance(value.func, ast.Name) \
+                and value.func.id[:1].isupper():
+            return value.func.id
+        return None
+
+    @staticmethod
+    def _metric_decl(attr: str, value: ast.AST, path: str,
+                     lineno: int) -> MetricDecl | None:
+        if not (isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Name)
+                and value.func.id in _METRIC_CTORS):
+            return None
+        args = value.args
+        if not args or not (isinstance(args[0], ast.Constant)
+                            and isinstance(args[0].value, str)):
+            return None
+        labels: tuple[str, ...] = ()
+        if len(args) >= 3:
+            got = ProjectGraph._const_strs(args[2])
+            if got is not None:
+                labels = got
+        for kw in value.keywords:
+            if kw.arg in ("labelnames", "labels"):
+                got = ProjectGraph._const_strs(kw.value)
+                if got is not None:
+                    labels = got
+        return MetricDecl(attr, args[0].value, labels, path, lineno)
+
+    # -------------------------------------------------- call-site scans
+
+    def _scan_stmts(self, ctx: FileContext, root: ast.AST,
+                    cls: str | None) -> None:
+        """Registry call sites under ``root`` (one method or one
+        top-level statement): rpc register/call, signal publish/read,
+        fault_point."""
+        parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(root):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            fdot = dotted(node.func)
+            if fdot == ("fault_point",) or (fdot and
+                                            fdot[-1] == "fault_point"):
+                name = self._str_arg(node, 0, None)
+                if name is not None:
+                    self.fault_calls.setdefault(name, []).append(
+                        Site(ctx.path, node.lineno))
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            recv = dotted(node.func.value)
+            attr = node.func.attr
+            if attr in ("register", "register_stream") and \
+                    self._is_rpc_recv(recv):
+                name = self._str_arg(node, 0, "method")
+                if name is not None and "." in name:
+                    kind = "stream" if attr == "register_stream" else "unary"
+                    self.rpc_registered.setdefault(name, []).append(
+                        RpcSite(ctx.path, node.lineno, kind))
+            elif attr in ("call", "call_stream") and self._is_rpc_recv(recv):
+                name = self._str_arg(node, 1, "method")
+                kind = "stream" if attr == "call_stream" else "unary"
+                if name is not None and "." in name:
+                    self.rpc_called.setdefault(name, []).append(RpcSite(
+                        ctx.path, node.lineno, kind,
+                        self._has_timeout(node)))
+            elif attr == "publish" and self._is_signal_recv(recv):
+                self._scan_publish(ctx, node, parents)
+            elif attr in _SIGNAL_READS and self._is_signal_recv(recv):
+                self._scan_read(ctx, node, parents, cls)
+
+    @staticmethod
+    def _is_rpc_recv(recv: tuple[str, ...] | None) -> bool:
+        return bool(recv) and any("rpc" in part for part in recv)
+
+    @staticmethod
+    def _is_signal_recv(recv: tuple[str, ...] | None) -> bool:
+        return bool(recv) and (recv[-1] in _SIGNAL_RECEIVERS
+                               or "signal" in recv[-1])
+
+    @staticmethod
+    def _str_arg(node: ast.Call, pos: int, kw: str | None) -> str | None:
+        if len(node.args) > pos and isinstance(node.args[pos], ast.Constant) \
+                and isinstance(node.args[pos].value, str):
+            return node.args[pos].value
+        if kw is not None:
+            for keyword in node.keywords:
+                if keyword.arg == kw and \
+                        isinstance(keyword.value, ast.Constant) and \
+                        isinstance(keyword.value.value, str):
+                    return keyword.value.value
+        return None
+
+    @staticmethod
+    def _has_timeout(node: ast.Call) -> bool:
+        return any(kw.arg in ("idle_timeout_s", "timeout_s")
+                   for kw in node.keywords)
+
+    def _scan_publish(self, ctx: FileContext, node: ast.Call,
+                      parents: dict[ast.AST, ast.AST]) -> None:
+        # the EventBus twin is ALWAYS awaited (dict payload); a SignalBus
+        # publish is a plain sync call — both filters must agree
+        if isinstance(parents.get(node), ast.Await):
+            return
+        if len(node.args) >= 2 and isinstance(node.args[1], ast.Dict):
+            return
+        if not node.args:
+            return
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            if _SIGNAL_NAME_RE.match(first.value):
+                self.signal_published.setdefault(first.value, []).append(
+                    Site(ctx.path, node.lineno))
+        elif isinstance(first, ast.JoinedStr) and first.values and \
+                isinstance(first.values[0], ast.Constant):
+            prefix = str(first.values[0].value)
+            if "." in prefix:
+                self.signal_prefixes.append(
+                    (prefix, Site(ctx.path, node.lineno)))
+
+    def _scan_read(self, ctx: FileContext, node: ast.Call,
+                   parents: dict[ast.AST, ast.AST],
+                   cls: str | None) -> None:
+        if not node.args:
+            return
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            if _SIGNAL_NAME_RE.match(first.value):
+                self.signal_read.setdefault(first.value, []).append(
+                    Site(ctx.path, node.lineno))
+        elif isinstance(first, ast.Name):
+            for name in self._loop_consts(first.id, node, parents, ctx, cls):
+                self.signal_read.setdefault(name, []).append(
+                    Site(ctx.path, node.lineno))
+
+    def _loop_consts(self, var: str, node: ast.AST,
+                     parents: dict[ast.AST, ast.AST], ctx: FileContext,
+                     cls: str | None) -> tuple[str, ...]:
+        """``for var in <NAME|self.NAME>`` where the iterable is a
+        module/class-level tuple of string literals → those literals
+        (the ``_EFFECT_SIGNALS`` idiom)."""
+        cursor: ast.AST | None = parents.get(node)
+        while cursor is not None:
+            if isinstance(cursor, ast.For) and \
+                    isinstance(cursor.target, ast.Name) and \
+                    cursor.target.id == var:
+                it = cursor.iter
+                name = None
+                if isinstance(it, ast.Name):
+                    name = it.id
+                elif isinstance(it, ast.Attribute):
+                    name = it.attr
+                if name is not None:
+                    if cls is not None:
+                        info = self.classes.get((ctx.path, cls))
+                        if info is not None and name in info.consts:
+                            return info.consts[name]
+                    got = self.module_consts.get(ctx.path, {}).get(name)
+                    if got is not None:
+                        return got
+            cursor = parents.get(cursor)
+        return ()
+
+    # ------------------------------------------------ forwarder resolution
+
+    def _resolve_forwarders(self, contexts: list[FileContext]) -> None:
+        """A same-class method that passes one of its own parameters to
+        ``.call``/``.call_stream`` (or to a signal read) is a
+        *forwarder*; string literals at its call sites are real method /
+        signal names (``EnginePoolRpc._call``, ``Controller._view``)."""
+        for (path, cls), info in self.classes.items():
+            rpc_fwd: dict[str, tuple[int, str]] = {}
+            sig_fwd: dict[str, int] = {}
+            for mname, fn in info.methods.items():
+                params = [a.arg for a in fn.args.args if a.arg != "self"]
+                for node in ast.walk(fn):
+                    if not (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)):
+                        continue
+                    recv = dotted(node.func.value)
+                    attr = node.func.attr
+                    if attr in ("call", "call_stream") and \
+                            self._is_rpc_recv(recv):
+                        idx = self._param_pos(node, 1, "method", params)
+                        if idx is not None:
+                            kind = ("stream" if attr == "call_stream"
+                                    else "unary")
+                            rpc_fwd[mname] = (idx, kind)
+                    elif attr in _SIGNAL_READS and \
+                            self._is_signal_recv(recv):
+                        idx = self._param_pos(node, 0, "name", params)
+                        if idx is not None:
+                            sig_fwd[mname] = idx
+            if not rpc_fwd and not sig_fwd:
+                continue
+            ctx = next((c for c in contexts if c.path == path), None)
+            if ctx is None:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"):
+                    continue
+                mname = node.func.attr
+                if mname in rpc_fwd:
+                    idx, kind = rpc_fwd[mname]
+                    name = self._str_arg(node, idx, None)
+                    if name is not None and "." in name:
+                        self.rpc_called.setdefault(name, []).append(RpcSite(
+                            path, node.lineno, kind,
+                            self._has_timeout(node)))
+                if mname in sig_fwd:
+                    name = self._str_arg(node, sig_fwd[mname], None)
+                    if name is not None and \
+                            _SIGNAL_NAME_RE.match(name):
+                        self.signal_read.setdefault(name, []).append(
+                            Site(path, node.lineno))
+
+    @staticmethod
+    def _param_pos(node: ast.Call, pos: int, kw: str,
+                   params: list[str]) -> int | None:
+        """When arg ``pos`` (or keyword ``kw``) of this call is one of
+        ``params`` by name, return that parameter's index."""
+        target: ast.AST | None = None
+        if len(node.args) > pos:
+            target = node.args[pos]
+        else:
+            for keyword in node.keywords:
+                if keyword.arg == kw:
+                    target = keyword.value
+        if isinstance(target, ast.Name) and target.id in params:
+            return params.index(target.id)
+        return None
+
+    # ----------------------------------------------------- rule helpers
+
+    def class_of_attr(self, path: str, cls: str, attr: str) -> str | None:
+        """Resolve ``self.<attr>``'s class: constructor assignment in
+        the owning class first, unique duck-match on the attribute name
+        as a fallback is deliberately NOT done — ambiguity stays
+        unresolved."""
+        info = self.classes.get((path, cls))
+        if info is not None and attr in info.attr_types:
+            return info.attr_types[attr]
+        return None
+
+    def find_class(self, name: str) -> _ClassInfo | None:
+        """The class by simple name, when exactly one exists in-tree."""
+        homes = self.class_index.get(name, [])
+        if len(homes) == 1:
+            return self.classes[homes[0]]
+        return None
+
+    def dump(self) -> dict[str, Any]:
+        """Debug / test snapshot of the registries."""
+        return {
+            "rpc_registered": sorted(self.rpc_registered),
+            "rpc_called": sorted(self.rpc_called),
+            "signal_published": sorted(self.signal_published),
+            "signal_read": sorted(self.signal_read),
+            "signal_prefixes": sorted(p for p, _ in self.signal_prefixes),
+            "fault_points": sorted(self.fault_points),
+            "metrics": {a: list(m.labels) for a, m in self.metrics.items()},
+            "settings_fields": sorted(self.settings_fields),
+            "engine_fields": sorted(self.engine_fields),
+            "locks": sorted(self.locks),
+        }

@@ -1,8 +1,9 @@
 """Rule catalogue: importing this package populates the registry."""
 
 from ..core import Rule, registered_rules
-from . import (async_blocking, dead_metric, host_sync, jit_discipline,  # noqa: F401
-               span_stitch, thread_boundary)
+from . import (async_blocking, await_lock, bus_rpc, config_keys,  # noqa: F401
+               dead_metric, host_sync, jit_discipline, lock_order,
+               metric_labels, signal_names, span_stitch, thread_boundary)
 
 
 def active_rules() -> list[Rule]:

@@ -1,0 +1,154 @@
+"""Rule: ``await`` (or a known-blocking call) inside a held sync lock.
+
+A ``threading.Lock`` held across an ``await`` is the classic async
+deadlock seed: the coroutine suspends WITH the lock held, the event loop
+schedules another task, that task (or the dispatch thread) blocks on the
+same lock, and the loop wedges — the runtime twin can only catch the
+interleavings a burst happens to produce. The same shape without the
+``await`` — a known-blocking call (``time.sleep``, sync file I/O, sync
+sqlite) under a sync lock — turns every other acquirer's wait into the
+blocked call's full latency, on whatever thread they run.
+
+Scope (deliberate):
+
+- Only **sync** ``with`` statements over lock-shaped context managers
+  are analyzed: an attribute or name that an in-tree
+  ``threading.Lock/RLock()`` assignment declares (``# lint: lock[ctx]``
+  markers included), or whose name ends in ``lock``/``mutex``.
+  ``async with`` over an ``asyncio.Lock`` is DESIGNED to be held across
+  awaits and is not this rule's business (blocking calls inside async
+  defs are already the async-blocking-call rule's).
+- ``await`` is flagged only when the ``with`` sits directly in an
+  ``async def`` — a nested sync ``def`` is deferred work (to_thread
+  target, callback) whose body does not run under the caller's frame.
+- Known-blocking calls reuse the async-blocking deny-list plus
+  ``time.sleep`` on any thread.
+
+Suppression: ``# lint: allow[await-holding-lock] <reason>`` on the
+blocking line — e.g. the DB facade's bounded WAL-retry sleep, which
+holds the connection lock BY DESIGN (the lock is the serialization
+point and the sleeper runs on the executor thread, not the loop).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..astutil import dotted
+from ..core import FileContext, Finding, Rule, register
+from .async_blocking import BLOCKING_CALLS, BLOCKING_METHODS
+
+_LOCK_NAME = ("lock", "mutex")
+
+
+def _lock_attrs(ctx: FileContext) -> set[str]:
+    """Attribute/bare names this file assigns a threading lock to."""
+    out: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if not (isinstance(value, ast.Call)
+                and dotted(value.func) in (("threading", "Lock"),
+                                           ("threading", "RLock"))):
+            continue
+        for target in node.targets:
+            if isinstance(target, ast.Attribute):
+                out.add(target.attr)
+            elif isinstance(target, ast.Name):
+                out.add(target.id)
+    return out
+
+
+def _is_lock_expr(expr: ast.AST, declared: set[str]) -> str | None:
+    """The lock's display name when ``expr`` looks like a sync lock."""
+    d = dotted(expr)
+    if not d:
+        return None
+    leaf = d[-1]
+    if leaf in declared or leaf.endswith(_LOCK_NAME):
+        return ".".join(d)
+    return None
+
+
+@register
+class AwaitHoldingLockRule(Rule):
+    rule_id = "await-holding-lock"
+    description = ("await or known-blocking call while a sync "
+                   "threading lock is held")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        declared = _lock_attrs(ctx)
+        findings: list[Finding] = []
+
+        def scan_with(node: ast.With, lock_name: str,
+                      in_async: bool) -> None:
+            for stmt in node.body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue  # deferred work: another frame runs it
+                for sub in _walk_same_frame(stmt):
+                    if in_async and isinstance(sub, (ast.Await,
+                                                     ast.AsyncFor,
+                                                     ast.AsyncWith)):
+                        findings.append(Finding(
+                            self.rule_id, ctx.path, sub.lineno,
+                            f"await while holding sync lock {lock_name} "
+                            f"— the loop suspends with the lock held; "
+                            f"restructure so the await happens outside "
+                            f"the critical section"))
+                    elif isinstance(sub, ast.Call):
+                        hint = BLOCKING_CALLS.get(dotted(sub.func))
+                        if hint is None and isinstance(sub.func,
+                                                       ast.Attribute):
+                            hint = BLOCKING_METHODS.get(sub.func.attr)
+                        if hint is not None:
+                            findings.append(Finding(
+                                self.rule_id, ctx.path, sub.lineno,
+                                f"blocking call under sync lock "
+                                f"{lock_name} — every other acquirer "
+                                f"waits out the full call; {hint}"))
+
+        class Visitor(ast.NodeVisitor):
+            def __init__(self) -> None:
+                self.in_async = False
+
+            def visit_AsyncFunctionDef(self, node) -> None:
+                prev, self.in_async = self.in_async, True
+                self.generic_visit(node)
+                self.in_async = prev
+
+            def visit_FunctionDef(self, node) -> None:
+                prev, self.in_async = self.in_async, False
+                self.generic_visit(node)
+                self.in_async = prev
+
+            def visit_Lambda(self, node) -> None:
+                prev, self.in_async = self.in_async, False
+                self.generic_visit(node)
+                self.in_async = prev
+
+            def visit_With(self, node: ast.With) -> None:
+                for item in node.items:
+                    name = _is_lock_expr(item.context_expr, declared)
+                    if name is not None:
+                        scan_with(node, name, self.in_async)
+                        break
+                self.generic_visit(node)
+
+        Visitor().visit(ctx.tree)
+        return iter(findings)
+
+
+def _walk_same_frame(root: ast.AST):
+    """``ast.walk`` that does not descend into nested function frames."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)

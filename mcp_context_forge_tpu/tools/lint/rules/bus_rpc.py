@@ -1,0 +1,88 @@
+"""Rule: BusRpc method registry conformance, tree-wide.
+
+The bus-RPC protocol surface spans files: handlers register in
+``tpu_local/pool_rpc.py`` and ``gateway/app.py``; callers live in
+``services/session_affinity.py``, ``gateway/transports/``, and the pool
+client methods. Nothing at runtime checks the two sides agree until a
+request dies with ``unknown method`` mid-failover — exactly the class of
+protocol drift arXiv:2412.12488's decomposed-engine framing says must be
+machine-checked.
+
+Checks (whole-tree, via the ProjectGraph rpc registry):
+
+1. **Caller without handler** — ``.call(worker, "m")`` /
+   ``.call_stream(worker, "m")`` whose method is registered nowhere
+   in-tree: fires at the call site.
+2. **Handler without caller** — a registered method no in-tree literal
+   (or same-class forwarder) call site reaches: fires at the
+   ``register()`` line. Methods served for OPERATORS or external peers
+   are real; acknowledge them with
+   ``# lint: allow[bus-rpc-conformance] <who calls this>``.
+3. **Kind mismatch** — ``.call()`` of a stream-registered method or
+   ``.call_stream()`` of a unary one: the wire protocol frames differ,
+   the mismatch is a guaranteed runtime error.
+4. **Stream caller outside the liveness path** — a ``call_stream`` site
+   with no ``idle_timeout_s=`` (and no ``timeout_s=``): a dead owner
+   mid-stream would hang the consumer forever instead of surfacing as
+   ``RpcPeerLost`` within the idle window.
+
+Subset-run degradation: without a single ``register`` site in the
+context set there is no registry to conform to — the rule stays silent
+(span-stitch pattern), so linting one file never flags its callers.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..core import FileContext, Finding, Rule, register
+
+
+@register
+class BusRpcConformanceRule(Rule):
+    rule_id = "bus-rpc-conformance"
+    description = ("bus-RPC callers and registered handlers must agree "
+                   "tree-wide; streams need the idle-timeout path")
+
+    def check_graph(self, graph,
+                    contexts: list[FileContext]) -> Iterator[Finding]:
+        if not graph.rpc_registered:
+            return iter(())
+        findings: list[Finding] = []
+        registered_kind = {name: sites[0].kind
+                           for name, sites in graph.rpc_registered.items()}
+
+        for name, sites in sorted(graph.rpc_called.items()):
+            kind = registered_kind.get(name)
+            for site in sites:
+                if kind is None:
+                    findings.append(Finding(
+                        self.rule_id, site.path, site.lineno,
+                        f"bus-RPC call of {name!r}: no handler registers "
+                        f"this method anywhere in-tree — the call dies "
+                        f"with 'unknown method' at runtime"))
+                    continue
+                if kind != site.kind:
+                    findings.append(Finding(
+                        self.rule_id, site.path, site.lineno,
+                        f"bus-RPC kind mismatch for {name!r}: registered "
+                        f"as {kind}, invoked as {site.kind} — unary and "
+                        f"stream frames are not interchangeable"))
+                if site.kind == "stream" and not site.has_idle_timeout:
+                    findings.append(Finding(
+                        self.rule_id, site.path, site.lineno,
+                        f"call_stream({name!r}) without idle_timeout_s: "
+                        f"an owner lost mid-stream hangs this consumer "
+                        f"forever — pass the idle-timeout so liveness "
+                        f"detection can raise RpcPeerLost"))
+
+        for name, sites in sorted(graph.rpc_registered.items()):
+            if name in graph.rpc_called:
+                continue
+            for site in sites:
+                findings.append(Finding(
+                    self.rule_id, site.path, site.lineno,
+                    f"bus-RPC method {name!r} is registered but no "
+                    f"in-tree caller invokes it — dead protocol surface; "
+                    f"remove it or allow[] with who calls it"))
+        return iter(findings)

@@ -1,0 +1,238 @@
+"""Rule: static deadlock detector over the cross-file lock graph.
+
+Builds the lock-acquisition graph the ProjectGraph's lock registry and
+call structure imply: an edge ``A → B`` means some code path acquires
+``B`` while holding ``A`` — through a nested ``with``, a same-class
+helper call, or a cross-class call resolved through ``self.x =
+ClassName(...)`` typing (``TenantLedger.add`` holds the ledger lock and
+reaches ``TenantClamp.label``, which takes the clamp lock: that edge
+crosses files, exactly where per-file lint is blind).
+
+Findings, strictest first:
+
+1. **Cycles** (``A → B`` somewhere, ``B → A`` elsewhere): two threads
+   interleaving those paths deadlock. Anchored at every involved lock's
+   DECLARATION line, so an ``allow[]`` acknowledging one edge cannot
+   silently swallow the cycle itself.
+2. **Self-edges** on non-reentrant locks (``threading.Lock`` re-acquired
+   while held): a single thread deadlocks itself. RLocks are exempt.
+3. **Undeclared nesting edges**: every remaining edge fires once, at
+   the OUTER acquisition site, and must be acknowledged with
+   ``# lint: allow[lock-order-cycle] <why the order is one-way>``. This
+   is the lock-hierarchy discipline: holding one lock while taking
+   another is how every future deadlock starts, so each such pair is a
+   conscious, reviewed decision — the in-tree example is the
+   ledger→clamp edge, whose one-way-ness metering.py argues in prose.
+
+Thread-context tags (``# lint: lock[ctx]``, ``runs-on[ctx]``) ride
+along in messages so the reader can see which planes the edge spans.
+
+Scope: class methods get full propagation (same-class + typed-attribute
+calls); module-level functions are scanned for directly nested ``with``
+blocks only.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding, Rule, register
+
+
+def _with_lock_key(item: ast.AST, cls: str | None,
+                   graph) -> str | None:
+    """The lock-registry key a ``with <expr>:`` acquires, if tracked."""
+    if isinstance(item, ast.Attribute) and \
+            isinstance(item.value, ast.Name) and item.value.id == "self" \
+            and cls is not None:
+        key = f"{cls}.{item.attr}"
+        return key if key in graph.locks else None
+    if isinstance(item, ast.Name):
+        for key in graph.locks:
+            if key.endswith(f":{item.id}"):
+                return key
+    return None
+
+
+class _Edges:
+    """Edge accumulator + per-method transitive lock closure."""
+
+    def __init__(self, graph) -> None:
+        self.graph = graph
+        self.edges: dict[tuple[str, str], tuple[str, int]] = {}
+        self._memo: dict[tuple[str, str, str], frozenset[str]] = {}
+
+    # -- which locks does calling (path, cls).method eventually acquire?
+
+    def locks_of(self, path: str, cls: str, method: str,
+                 stack: frozenset = frozenset()) -> frozenset[str]:
+        key = (path, cls, method)
+        if key in self._memo:
+            return self._memo[key]
+        if key in stack:
+            return frozenset()
+        info = self.graph.classes.get((path, cls))
+        if info is None or method not in info.methods:
+            return frozenset()
+        out: set[str] = set()
+        for node in ast.walk(info.methods[method]):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    got = _with_lock_key(item.context_expr, cls, self.graph)
+                    if got is not None:
+                        out.add(got)
+            elif isinstance(node, ast.Call):
+                out.update(self._call_locks(path, cls, node,
+                                            stack | {key}))
+        result = frozenset(out)
+        self._memo[key] = result
+        return result
+
+    def _call_locks(self, path: str, cls: str, node: ast.Call,
+                    stack: frozenset) -> frozenset[str]:
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            return frozenset()
+        # self.m(...)
+        if isinstance(func.value, ast.Name) and func.value.id == "self":
+            return self.locks_of(path, cls, func.attr, stack)
+        # self.attr.m(...) via constructor/annotation typing
+        if isinstance(func.value, ast.Attribute) and \
+                isinstance(func.value.value, ast.Name) and \
+                func.value.value.id == "self":
+            target_cls = self.graph.class_of_attr(path, cls,
+                                                  func.value.attr)
+            target = (self.graph.find_class(target_cls)
+                      if target_cls else None)
+            if target is not None:
+                return self.locks_of(target.path, target.name,
+                                     func.attr, stack)
+        return frozenset()
+
+    # -- edges: locks acquired while another is held
+
+    def scan_method(self, path: str, cls: str, fn: ast.AST) -> None:
+        self._scan_frame(path, cls, fn)
+
+    def _scan_frame(self, path: str, cls: str | None,
+                    root: ast.AST) -> None:
+        for node in ast.walk(root):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                held = _with_lock_key(item.context_expr, cls, self.graph)
+                if held is None:
+                    continue
+                for stmt in node.body:
+                    self._body_edges(path, cls, held, stmt, node.lineno)
+
+    def _body_edges(self, path: str, cls: str | None, held: str,
+                    stmt: ast.AST, outer_line: int) -> None:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    inner = _with_lock_key(item.context_expr, cls,
+                                           self.graph)
+                    if inner is not None:
+                        self.edges.setdefault((held, inner),
+                                              (path, outer_line))
+            elif isinstance(node, ast.Call) and cls is not None:
+                for inner in self._call_locks(path, cls, node,
+                                              frozenset()):
+                    self.edges.setdefault((held, inner),
+                                          (path, outer_line))
+
+
+@register
+class LockOrderCycleRule(Rule):
+    rule_id = "lock-order-cycle"
+    description = ("lock-acquisition graph: cycles deadlock, nested "
+                   "acquisitions must be acknowledged")
+
+    def check_graph(self, graph, contexts) -> Iterator[Finding]:
+        if not graph.locks:
+            return iter(())
+        edges = _Edges(graph)
+        for (path, cls), info in graph.classes.items():
+            for fn in info.methods.values():
+                edges.scan_method(path, cls, fn)
+        by_path = {ctx.path: ctx for ctx in contexts}
+        for path, ctx in by_path.items():
+            for node in ctx.tree.body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    edges._scan_frame(path, None, node)
+
+        findings: list[Finding] = []
+        adj: dict[str, set[str]] = {}
+        for (a, b), _site in edges.edges.items():
+            if a != b:
+                adj.setdefault(a, set()).add(b)
+
+        def tag(key: str) -> str:
+            decl = graph.locks.get(key)
+            return (f" [ctx {decl.context}]"
+                    if decl is not None and decl.context else "")
+
+        # 1) cycles — anchored at every involved declaration
+        for cycle in _cycles(adj):
+            chain = " → ".join(cycle + (cycle[0],))
+            for key in cycle:
+                decl = graph.locks.get(key)
+                if decl is not None:
+                    findings.append(Finding(
+                        self.rule_id, decl.path, decl.lineno,
+                        f"lock-order cycle {chain}: two threads "
+                        f"interleaving these paths deadlock — pick one "
+                        f"global order and restructure"))
+
+        # 2) self-edges on non-reentrant locks
+        for (a, b), (path, lineno) in sorted(edges.edges.items()):
+            if a != b:
+                continue
+            decl = graph.locks.get(a)
+            if decl is not None and decl.kind == "rlock":
+                continue
+            findings.append(Finding(
+                self.rule_id, path, lineno,
+                f"non-reentrant lock {a}{tag(a)} re-acquired while "
+                f"held (possibly via a helper call) — single-thread "
+                f"deadlock"))
+
+        # 3) plain nesting edges: conscious, acknowledged decisions
+        in_cycle = {key for cycle in _cycles(adj) for key in cycle}
+        for (a, b), (path, lineno) in sorted(edges.edges.items()):
+            if a == b or (a in in_cycle and b in in_cycle):
+                continue
+            findings.append(Finding(
+                self.rule_id, path, lineno,
+                f"acquires {b}{tag(b)} while holding {a}{tag(a)} — "
+                f"a new lock-order edge; acknowledge the one-way "
+                f"hierarchy with allow[] or move the inner acquisition "
+                f"out of the critical section"))
+        return iter(findings)
+
+
+def _cycles(adj: dict[str, set[str]]) -> list[tuple[str, ...]]:
+    """Elementary cycles via DFS (the lock graph is tiny); each cycle
+    reported once, rotated to start at its smallest node."""
+    seen: set[tuple[str, ...]] = set()
+    out: list[tuple[str, ...]] = []
+
+    def dfs(start: str, node: str, path: list[str]) -> None:
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == start:
+                cycle = tuple(path)
+                smallest = min(range(len(cycle)),
+                               key=lambda i: cycle[i])
+                canon = cycle[smallest:] + cycle[:smallest]
+                if canon not in seen:
+                    seen.add(canon)
+                    out.append(canon)
+            elif nxt not in path and nxt > start:
+                dfs(start, nxt, path + [nxt])
+
+    for start in sorted(adj):
+        dfs(start, start, [start])
+    return out

@@ -1,0 +1,185 @@
+"""Rule: tenant-labeled Prometheus metrics must route through TenantClamp.
+
+The registry bounds every ``tenant`` label child set with a shared
+``TenantClamp`` (first-N tenants keep their label, the rest fold into
+``"other"``) so per-tenant slicing can never explode series cardinality.
+That guarantee only holds if every ``.labels(...)`` call site actually
+passes a CLAMPED value — one raw ``request.tenant`` reaching a labels
+call and an adversarial client minting tenant ids turns the registry
+into a memory leak with a /metrics endpoint.
+
+For each call ``<recv>.<metric_attr>.labels(...)`` where the graph's
+metric registry declares a ``tenant`` label for ``metric_attr``, the
+value in the tenant position (positional index from the declared label
+order, or the ``tenant=`` keyword) must be provably clamped:
+
+- a direct clamp call — ``*clamp*.label(x)`` / ``.peek(x)``;
+- a local name assigned from a clamp call in the same frame
+  (the ``tenant_label = ctx.metrics.tenant_clamp.label(...)`` idiom);
+- a call of (or local assigned from) a same-class helper whose body
+  contains a clamp call (``Engine._tenant_label``,
+  ``TenantLedger._label_for``);
+- a string literal (fixed children are bounded by construction).
+
+Anything else flags: f-strings in the tenant position, raw attribute
+reads, and ``**splat`` label dicts — the splat hides the tenant value
+from this proof entirely, so a site that builds its label dict upstream
+(metering's ``_child``) must acknowledge where the clamp happened with
+``# lint: allow[metric-label-cardinality] <where>``.
+
+Subset-run degradation: no metric declarations in the context set means
+no label schema to check against — silence.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..astutil import dotted
+from ..core import FileContext, Finding, Rule, register
+
+_CLAMP_METHODS = {"label", "peek"}
+
+
+def _is_clamp_call(node: ast.AST) -> bool:
+    """``<...clamp...>.label(x)`` / ``.peek(x)``."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _CLAMP_METHODS):
+        return False
+    recv = dotted(node.func.value)
+    return bool(recv) and any("clamp" in part for part in recv)
+
+
+def _self_method(node: ast.AST) -> str | None:
+    """``self.m(...)`` → ``m``."""
+    if isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            isinstance(node.func.value, ast.Name) and \
+            node.func.value.id == "self":
+        return node.func.attr
+    return None
+
+
+@register
+class MetricLabelCardinalityRule(Rule):
+    rule_id = "metric-label-cardinality"
+    description = ("tenant label values must provably pass through "
+                   "TenantClamp before reaching .labels()")
+
+    def check_graph(self, graph,
+                    contexts: list[FileContext]) -> Iterator[Finding]:
+        tenant_metrics = {attr: decl.labels.index("tenant")
+                          for attr, decl in graph.metrics.items()
+                          if "tenant" in decl.labels}
+        if not graph.metrics:
+            return iter(())
+        findings: list[Finding] = []
+        for ctx in contexts:
+            self._scan_file(ctx, graph, tenant_metrics, findings)
+        return iter(findings)
+
+    def _scan_file(self, ctx: FileContext, graph, tenant_metrics,
+                   findings: list) -> None:
+        # (class name or None, enclosing function node) per frame
+        def walk(node: ast.AST, cls: str | None,
+                 frame: ast.AST | None) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk(child, child.name, frame)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    walk(child, cls, child)
+                else:
+                    if isinstance(child, ast.Call):
+                        self._check_call(ctx, child, cls, frame, graph,
+                                         tenant_metrics, findings)
+                    walk(child, cls, frame)
+
+        walk(ctx.tree, None, None)
+
+    def _check_call(self, ctx: FileContext, node: ast.Call,
+                    cls: str | None, frame: ast.AST | None, graph,
+                    tenant_metrics, findings: list) -> None:
+        func = node.func
+        if not (isinstance(func, ast.Attribute) and func.attr == "labels"):
+            return
+        if isinstance(func.value, ast.Attribute):
+            metric_attr = func.value.attr
+        elif isinstance(func.value, ast.Name):
+            metric_attr = func.value.id
+        else:
+            return
+        # a **splat hides every label value from the proof, including a
+        # receiver that is a bare local (metering's generic _child)
+        if any(kw.arg is None for kw in node.keywords):
+            findings.append(Finding(
+                self.rule_id, ctx.path, node.lineno,
+                f"{metric_attr}.labels(**...) hides the label values "
+                f"from the clamp proof — pass labels explicitly or "
+                f"allow[] stating where the tenant value was clamped"))
+            return
+        if metric_attr not in tenant_metrics:
+            return
+        tenant_pos = tenant_metrics[metric_attr]
+        value: ast.AST | None = None
+        if len(node.args) > tenant_pos:
+            value = node.args[tenant_pos]
+        else:
+            for kw in node.keywords:
+                if kw.arg == "tenant":
+                    value = kw.value
+        if value is None:
+            return  # partial child (other labels bound elsewhere)
+        if not self._is_clamped(value, ctx, cls, frame, graph):
+            findings.append(Finding(
+                self.rule_id, ctx.path, node.lineno,
+                f"tenant label of {metric_attr} is not provably "
+                f"clamped — route the value through "
+                f"TenantClamp.label() or an unbounded tenant id mints "
+                f"a new series per request"))
+
+    def _is_clamped(self, value: ast.AST, ctx: FileContext,
+                    cls: str | None, frame: ast.AST | None,
+                    graph) -> bool:
+        if isinstance(value, ast.Constant) and isinstance(value.value, str):
+            return True
+        if _is_clamp_call(value):
+            return True
+        helper = _self_method(value)
+        if helper is not None:
+            return self._helper_clamps(ctx, cls, helper, graph)
+        if isinstance(value, ast.Name) and frame is not None:
+            return self._local_clamped(value.id, ctx, cls, frame, graph)
+        return False
+
+    def _helper_clamps(self, ctx: FileContext, cls: str | None,
+                       method: str, graph) -> bool:
+        """Same-class helper whose body contains a clamp call."""
+        if cls is None:
+            return False
+        info = graph.classes.get((ctx.path, cls))
+        if info is None or method not in info.methods:
+            return False
+        return any(_is_clamp_call(sub)
+                   for sub in ast.walk(info.methods[method]))
+
+    def _local_clamped(self, name: str, ctx: FileContext,
+                       cls: str | None, frame: ast.AST,
+                       graph) -> bool:
+        """A local assigned (anywhere in the frame) from a clamp call or
+        a clamping same-class helper."""
+        for sub in ast.walk(frame):
+            if not isinstance(sub, ast.Assign):
+                continue
+            if not any(isinstance(t, ast.Name) and t.id == name
+                       for t in sub.targets):
+                continue
+            if _is_clamp_call(sub.value):
+                return True
+            helper = _self_method(sub.value)
+            if helper is not None and \
+                    self._helper_clamps(ctx, cls, helper, graph):
+                return True
+        return False

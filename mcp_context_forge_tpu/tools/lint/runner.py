@@ -1,0 +1,170 @@
+"""Cached / parallel lint runner.
+
+``lint_contexts`` is the semantics; this module is the wall-clock guard
+around it. Profile of a full-tree run: per-file rule execution ~3s,
+parse ~1.2s, ProjectGraph build + graph rules ~2s. Two levers, both
+aimed at the per-file phase (the graph phase is inherently whole-tree
+and stays serial in the parent):
+
+- **Content-hash cache** — per-file findings from PER-FILE rules only,
+  keyed on ``sha256(source)`` plus a *rules signature* that hashes the
+  lint engine and every active rule module. Edit a rule (or core.py /
+  project.py / astutil.py) and the whole cache invalidates; edit one
+  source file and only that file re-checks. Graph/project findings are
+  never cached — they depend on every file at once.
+- **``--jobs N`` process pool** — cache-miss files fan out to worker
+  processes (each re-parses its own file from source; shipping ASTs
+  would cost more than re-parsing). Deterministic regardless of N:
+  ``triage`` sorts findings.
+
+The parent always parses every file: suppression triage needs the
+marker maps and the graph rules need every AST regardless. A warm cache
+therefore saves the per-file rule phase only — which is the dominant
+phase, and the one that grows with the rule catalogue.
+
+The cache file is JSON next to nothing important (default
+``.lint_cache.json`` in the working directory, gitignored); a corrupt or
+version-skewed cache is discarded, never an error.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import sys
+from pathlib import Path
+from typing import Iterable
+
+from .core import (Baseline, FileContext, Finding, LintResult, Rule,
+                   collect_sources, triage)
+
+_CACHE_VERSION = 1
+
+# engine modules whose behavior every cached result depends on
+_ENGINE_MODULES = ("core", "project", "astutil", "runner")
+
+
+def rules_signature(rules: Iterable[Rule]) -> str:
+    """Digest of the active rule set AND the engine/rule source files —
+    any behavior change invalidates every cached entry."""
+    h = hashlib.sha256()
+    here = Path(__file__).parent
+    for name in _ENGINE_MODULES:
+        h.update((here / f"{name}.py").read_bytes())
+    for rule in sorted(rules, key=lambda r: r.rule_id):
+        h.update(rule.rule_id.encode())
+        mod = sys.modules.get(type(rule).__module__)
+        mod_file = getattr(mod, "__file__", None)
+        if mod_file:
+            h.update(Path(mod_file).read_bytes())
+    return h.hexdigest()
+
+
+def _check_one(item: tuple[str, str, tuple[str, ...]]) -> list[dict]:
+    """Worker: parse one file, run the named per-file rules, return
+    finding dicts (picklable). Top-level so multiprocessing can import
+    it; a syntax error returns no findings — the parent's own parse of
+    the same source reports it."""
+    path, source, rule_ids = item
+    from . import active_rules
+    wanted = set(rule_ids)
+    try:
+        ctx = FileContext.from_source(source, path)
+    except SyntaxError:
+        return []
+    out: list[dict] = []
+    for rule in active_rules():
+        if rule.rule_id in wanted:
+            out.extend(f.to_dict() for f in rule.check(ctx))
+    return out
+
+
+def _load_cache(path: Path, sig: str) -> dict[str, dict]:
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return {}
+    if data.get("version") != _CACHE_VERSION or data.get("sig") != sig:
+        return {}
+    files = data.get("files")
+    return files if isinstance(files, dict) else {}
+
+
+def _save_cache(path: Path, sig: str, files: dict[str, dict]) -> None:
+    try:
+        path.write_text(json.dumps(
+            {"version": _CACHE_VERSION, "sig": sig, "files": files}))
+    except OSError:
+        pass  # a cache that cannot persist is a slow run, not a failure
+
+
+def run_paths(roots: list[Path], rules: list[Rule],
+              baseline: Baseline | None = None, jobs: int = 1,
+              cache_path: Path | None = None) -> LintResult:
+    """Lint ``roots`` with caching + optional process-pool fan-out.
+    Produces the same LintResult as ``lint_paths`` (same rules, same
+    triage); only the wall clock differs."""
+    sources = collect_sources(roots)
+    contexts: list[FileContext] = []
+    errors: list[Finding] = []
+    for path, source in sorted(sources.items()):
+        try:
+            contexts.append(FileContext.from_source(source, path))
+        except SyntaxError as exc:
+            errors.append(Finding("syntax-error", path, exc.lineno or 0,
+                                  "file does not parse", code=""))
+
+    per_file = [r for r in rules if type(r).check is not Rule.check]
+    per_file_ids = tuple(sorted(r.rule_id for r in per_file))
+    sig = rules_signature(rules)
+
+    cached = _load_cache(cache_path, sig) if cache_path else {}
+    fresh: dict[str, dict] = {}
+    misses: list[tuple[str, str, tuple[str, ...]]] = []
+    raw: list[Finding] = []
+    for ctx in contexts:
+        digest = hashlib.sha256(ctx.source.encode()).hexdigest()
+        entry = cached.get(ctx.path)
+        if entry is not None and entry.get("hash") == digest:
+            fresh[ctx.path] = entry
+            raw.extend(Finding(**f) for f in entry.get("findings", ()))
+        else:
+            misses.append((ctx.path, ctx.source, per_file_ids))
+
+    if misses:
+        import os
+        # never more workers than cores: on a 1-CPU box the fork + IPC
+        # overhead makes --jobs 4 SLOWER than serial, so clamp rather
+        # than trust the flag
+        pool_size = min(jobs, len(misses), os.cpu_count() or 1)
+        if pool_size > 1:
+            import multiprocessing
+
+            with multiprocessing.Pool(pool_size) as pool:
+                results = pool.map(
+                    _check_one, misses,
+                    chunksize=max(1, len(misses) // (pool_size * 4)))
+        else:
+            results = [_check_one(item) for item in misses]
+        for (path, source, _), found in zip(misses, results):
+            digest = hashlib.sha256(source.encode()).hexdigest()
+            fresh[path] = {"hash": digest, "findings": found}
+            raw.extend(Finding(**f) for f in found)
+
+    # whole-tree phases: never cached, always in the parent
+    for rule in rules:
+        raw.extend(rule.check_project(contexts))
+    graph_rules = [r for r in rules
+                   if type(r).check_graph is not Rule.check_graph]
+    if graph_rules:
+        from .project import ProjectGraph
+        graph = ProjectGraph.build(contexts)
+        for rule in graph_rules:
+            raw.extend(rule.check_graph(graph, contexts))
+
+    if cache_path:
+        _save_cache(cache_path, sig, fresh)
+
+    result = triage(contexts, raw, baseline)
+    result.errors.extend(errors)
+    return result

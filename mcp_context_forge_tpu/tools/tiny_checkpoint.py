@@ -45,6 +45,14 @@ def _chat(prompt: str, answer: str) -> str:
 
 CORPUS = [p + a for p, a in _FACTS] + [_chat(p, a) for p, a in _FACTS] + [
     "the quick brown fox jumps over the lazy dog.",
+    # a long self-repeating document: continuation-past-a-sentence-end is
+    # otherwise UNTRAINED (fact rows mask everything after the answer),
+    # so any test that decodes past "paris." would be asserting on
+    # numerics-sensitive out-of-distribution behavior. This row makes
+    # "repeat the phrase" the memorized continuation — the spec-decode
+    # acceptance test (prompt-lookup drafts over chunk-prefilled history)
+    # depends on it.
+    "the capital of france is paris. " * 9,
 ]
 
 SPECIALS = ["<|begin_of_text|>", "<|eot_id|>", "<|start_header_id|>",
@@ -67,7 +75,11 @@ def build_tokenizer(out_dir: str, vocab_size: int = 512):
     return tokenizer
 
 
-def train_model(tokenizer, steps: int = 400, seq_len: int = 48):
+def train_model(tokenizer, steps: int = 400, seq_len: int = 64):
+    # seq_len 64 (was 48) keeps the repeated-phrase document's full 63
+    # tokens + BOS in-window, so every position a decode test can reach
+    # (44-token prompt + 16 generated = 60) is a TRAINED position —
+    # rope extrapolation past the training window is not asserted on.
     """Memorize the corpus on the llama3-test geometry; returns params."""
     import jax
     import jax.numpy as jnp

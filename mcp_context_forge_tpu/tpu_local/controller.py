@@ -226,7 +226,7 @@ class ServingController:
                 continue
             class_burn = self._burn_from(sliced)
             if class_burn is not None:
-                self.bus.publish(f"slo.burn_rate.{slo_class}", class_burn,
+                self.bus.publish(f"slo.burn_rate.{slo_class}", class_burn,  # lint: allow[signal-name-conformance] per-class burn family for /signals dashboards; the controller steers on the aggregate slo.burn_rate
                                  GATEWAY_REPLICA)
 
     @staticmethod

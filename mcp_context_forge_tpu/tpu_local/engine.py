@@ -3181,15 +3181,15 @@ class TPUEngine:
         if tokens:
             bus.publish("llm.tokens_per_dispatch", tokens, rid)
         if mfu is not None:
-            bus.publish("llm.mfu", mfu, rid)
+            bus.publish("llm.mfu", mfu, rid)  # lint: allow[signal-name-conformance] dashboard-only export via the /signals snapshot
         if hbm_frac is not None:
-            bus.publish("llm.hbm_roofline_frac", hbm_frac, rid)
+            bus.publish("llm.hbm_roofline_frac", hbm_frac, rid)  # lint: allow[signal-name-conformance] dashboard-only export via the /signals snapshot
         if gap_ms is not None:
-            bus.publish("llm.dispatch_gap_ms", gap_ms, rid)
+            bus.publish("llm.dispatch_gap_ms", gap_ms, rid)  # lint: allow[signal-name-conformance] dashboard-only export via the /signals snapshot
         if wall_ms is not None and wall_ms > 0 and tokens:
             bus.publish("llm.step_tokens_per_sec",
                         tokens / (wall_ms / 1e3), rid)
-        bus.publish("llm.saturation",
+        bus.publish("llm.saturation",  # lint: allow[signal-name-conformance] dashboard-only export via the /signals snapshot
                     depth / max(1, self.config.max_queue), rid)
         bus.publish("llm.occupancy",
                     (len(self._running) + len(self._chunking))
@@ -3308,7 +3308,7 @@ class TPUEngine:
                     "llm_tpot", tpot_s, request,
                     (self.config.model, self.config.replica_id, tenant)))
         if self.signals is not None and n > 1:
-            self.signals.publish(
+            self.signals.publish(  # lint: allow[signal-name-conformance] dashboard-only export via the /signals snapshot
                 "llm.tpot_ms", max(0.0, (now - decode_start) / (n - 1)) * 1e3,
                 self.config.replica_id)
         if self.ledger is not None and request.slot >= 0:

@@ -84,7 +84,7 @@ class SharedEnginePlane:
         self.rpc.register("pool.chat", self._serve_chat)
         self.rpc.register("pool.embed", self._serve_embed)
         self.rpc.register("pool.classify", self._serve_classify)
-        self.rpc.register("pool.status", self._serve_status)
+        self.rpc.register("pool.status", self._serve_status)  # lint: allow[bus-rpc-conformance] operator surface for non-owner workers; local callers use EnginePool.status() directly
         self.rpc.register("pool.set_role", self._serve_set_role)
         self.rpc.register("pool.queue_state", self._serve_queue_state)
         self.rpc.register_stream("pool.chat_stream", self._serve_chat_stream)

@@ -19,9 +19,9 @@ from mcp_context_forge_tpu.tools.lint import (active_rules,
 PACKAGE_ROOT = Path(mcp_context_forge_tpu.__file__).resolve().parent
 
 
-def test_package_lints_clean_with_at_least_six_rules():
+def test_package_lints_clean_with_at_least_thirteen_rules():
     rules = active_rules()
-    assert len(rules) >= 6, [r.rule_id for r in rules]
+    assert len(rules) >= 13, [r.rule_id for r in rules]
     result = lint_paths([PACKAGE_ROOT], rules=rules,
                         baseline=load_default_baseline())
     assert not result.errors, "\n".join(str(f) for f in result.errors)
@@ -49,6 +49,36 @@ def test_rules_are_exercised_not_vacuous():
     # plugin-config startup read + app_info registration-time metric
     assert by_rule.get("async-blocking-call", 0) >= 1, by_rule
     assert by_rule.get("dead-metric", 0) >= 1, by_rule
+    # every whole-program (ProjectGraph) rule must have found something
+    # REAL in this tree and been answered with a reasoned allow[] — if
+    # the graph extraction silently broke, these suppressions vanish and
+    # the green gate would be vacuous:
+    #   await-holding-lock    db WAL retry x2 + diagnostics profiler x2
+    #   lock-order-cycle      metering's ledger→clamp one-way edge
+    #   bus-rpc-conformance   pool.status operator surface
+    #   signal-name-conf.     engine dashboard exports + burn-rate family
+    #   config-key-liveness   supervisor-stamped + f-string getattr knobs
+    #   metric-label-card.    metering's pre-clamped **labels child
+    assert by_rule.get("await-holding-lock", 0) >= 4, by_rule
+    assert by_rule.get("lock-order-cycle", 0) >= 1, by_rule
+    assert by_rule.get("bus-rpc-conformance", 0) >= 1, by_rule
+    assert by_rule.get("signal-name-conformance", 0) >= 7, by_rule
+    assert by_rule.get("config-key-liveness", 0) >= 7, by_rule
+    assert by_rule.get("metric-label-cardinality", 0) >= 1, by_rule
+    # and the suppressions are in REAL modules, not test fixtures
+    suppressed_paths = {f.path for f in result.suppressed
+                        if f.rule in ("await-holding-lock",
+                                      "lock-order-cycle",
+                                      "bus-rpc-conformance",
+                                      "signal-name-conformance",
+                                      "config-key-liveness",
+                                      "metric-label-cardinality")}
+    assert any(p.endswith("db/core.py") for p in suppressed_paths)
+    assert any(p.endswith("observability/metering.py")
+               for p in suppressed_paths)
+    assert any(p.endswith("tpu_local/pool_rpc.py")
+               for p in suppressed_paths)
+    assert any(p.endswith("config.py") for p in suppressed_paths)
 
 
 def test_cli_entrypoint_matches_the_gate():

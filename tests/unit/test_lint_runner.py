@@ -1,0 +1,146 @@
+"""The cached/parallel runner must be a pure wall-clock optimization:
+identical LintResult to the serial path, cache invalidation on content
+AND rule-set change, graceful degradation on cache corruption, and the
+same answers under a process pool.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+from mcp_context_forge_tpu.tools.lint import active_rules, lint_paths
+from mcp_context_forge_tpu.tools.lint.runner import (run_paths,
+                                                     rules_signature)
+
+VIOLATION = textwrap.dedent("""
+    import time
+
+    async def handler():
+        time.sleep(1)
+""")
+
+CLEAN = textwrap.dedent("""
+    import asyncio
+
+    async def handler():
+        await asyncio.sleep(1)
+""")
+
+
+def _tree(tmp_path: Path) -> Path:
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(VIOLATION)
+    (pkg / "good.py").write_text(CLEAN)
+    return pkg
+
+
+def _key(result):
+    return sorted((f.rule, f.path.rsplit("/", 1)[-1], f.lineno, f.code)
+                  for f in result.findings)
+
+
+def test_runner_matches_serial_path_and_caches(tmp_path):
+    pkg = _tree(tmp_path)
+    cache = tmp_path / "cache.json"
+    rules = active_rules()
+
+    serial = lint_paths([pkg], rules=rules)
+    cold = run_paths([pkg], rules, cache_path=cache)
+    assert _key(cold) == _key(serial)
+    assert len(cold.findings) == 1
+    assert cache.exists()
+
+    # warm run: same answer out of the cache
+    warm = run_paths([pkg], rules, cache_path=cache)
+    assert _key(warm) == _key(cold)
+
+    # the warm run truly used the cache (poison the stored finding and
+    # watch it come back out)
+    data = json.loads(cache.read_text())
+    entry = next(v for k, v in data["files"].items()
+                 if k.endswith("bad.py"))
+    assert entry["findings"], "violation file has no cached findings"
+    entry["findings"][0]["message"] = "FROM-THE-CACHE"
+    cache.write_text(json.dumps(data))
+    poisoned = run_paths([pkg], rules, cache_path=cache)
+    assert any(f.message == "FROM-THE-CACHE" for f in poisoned.findings)
+
+
+def test_runner_invalidates_on_content_change(tmp_path):
+    pkg = _tree(tmp_path)
+    cache = tmp_path / "cache.json"
+    rules = active_rules()
+    first = run_paths([pkg], rules, cache_path=cache)
+    assert len(first.findings) == 1
+    (pkg / "bad.py").write_text(CLEAN)        # fix the violation
+    second = run_paths([pkg], rules, cache_path=cache)
+    assert second.findings == []
+
+
+def test_runner_invalidates_on_rule_set_change(tmp_path):
+    pkg = _tree(tmp_path)
+    cache = tmp_path / "cache.json"
+    rules = active_rules()
+    full = run_paths([pkg], rules, cache_path=cache)
+    assert len(full.findings) == 1
+    subset = [r for r in rules if r.rule_id != "async-blocking-call"]
+    assert rules_signature(subset) != rules_signature(rules)
+    narrowed = run_paths([pkg], subset, cache_path=cache)
+    assert narrowed.findings == []            # stale entries not replayed
+
+
+def test_runner_survives_corrupt_and_skewed_caches(tmp_path):
+    pkg = _tree(tmp_path)
+    rules = active_rules()
+    for payload in ("not json{", json.dumps({"version": 999, "sig": "x",
+                                             "files": {}})):
+        cache = tmp_path / "cache.json"
+        cache.write_text(payload)
+        result = run_paths([pkg], rules, cache_path=cache)
+        assert len(result.findings) == 1      # discarded, not fatal
+
+
+def test_runner_pool_path_gives_identical_results(tmp_path, monkeypatch):
+    """Force the multiprocessing branch even on a 1-CPU box (the clamp
+    would otherwise route --jobs back to serial) and require identical
+    triage — suppressions included."""
+    pkg = _tree(tmp_path)
+    (pkg / "allowed.py").write_text(textwrap.dedent("""
+        import time
+
+        async def h():
+            time.sleep(1)  # lint: allow[async-blocking-call] legacy
+    """))
+    monkeypatch.setattr("os.cpu_count", lambda: 4)
+    rules = active_rules()
+    serial = run_paths([pkg], rules, jobs=1)
+    pooled = run_paths([pkg], rules, jobs=4)
+    assert _key(pooled) == _key(serial)
+    assert len(pooled.suppressed) == len(serial.suppressed) == 1
+
+
+def test_runner_reports_syntax_errors_like_the_serial_path(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "broken.py").write_text("def broken(:\n")
+    result = run_paths([pkg], active_rules())
+    assert not result.clean
+    assert result.errors and result.errors[0].rule == "syntax-error"
+
+
+def test_cli_flags_route_through_the_runner(tmp_path, monkeypatch):
+    from mcp_context_forge_tpu.tools.lint.__main__ import main
+
+    pkg = _tree(tmp_path)
+    monkeypatch.chdir(tmp_path)
+    assert main([str(pkg), "--no-baseline"]) == 1           # violation
+    assert (tmp_path / ".lint_cache.json").exists()         # default cache
+    cache = tmp_path / "elsewhere.json"
+    assert main([str(pkg), "--no-baseline", "--cache", str(cache),
+                 "--jobs", "2"]) == 1
+    assert cache.exists()
+    (pkg / "bad.py").write_text(CLEAN)
+    assert main([str(pkg), "--no-baseline", "--cache", str(cache)]) == 0
