@@ -1,6 +1,6 @@
 # mcp-context-forge-tpu (reference: 8.7k-line Makefile; the targets that matter)
 
-.PHONY: serve hub lint bench-check test test-py test-fast test-two-process bench bench-engine bench-superstep bench-scenarios bench-workers-real bench-chaos wrapper masking clean \
+.PHONY: serve hub lint bench-check test test-py test-fast test-two-process bench bench-engine bench-superstep bench-scenarios bench-workers-real bench-fabric bench-chaos wrapper masking clean \
 	sanitize sanitize-tsan sanitize-asan
 
 serve:
@@ -71,6 +71,17 @@ bench-scenarios:
 bench-workers-real:
 	BENCH_SCENARIO_ONLY=workers-real BENCH_REAL_PROCS=1 \
 	BENCH_SCENARIO_ENFORCE_SLO=1 \
+	python bench_gateway_scenarios.py
+
+# cross-host prefix-cache fabric arm (docs/cache_fabric.md): two real
+# supervisors with DISJOINT engine pools sharing only a file:// object
+# store — host B must serve the chains host A prefilled (byte-identical
+# continuations, exact per-tenant ledger conservation) and a forced
+# tier.object breaker-open phase must finish with zero request
+# failures. Capture carries fabric:true so bench-check judges it as
+# its own arm.
+bench-fabric:
+	BENCH_SCENARIO_ONLY=fabric BENCH_REAL_PROCS=1 \
 	python bench_gateway_scenarios.py
 
 # chaos matrix only (docs/resilience.md): fault-injection arms —

@@ -53,6 +53,19 @@ p95, tok/s, and greedy token parity across arms. The acceptance bar:
 the tiers-on arm's prefix_hit_tokens >= 2x the off arm's at the same
 page budget.
 
+BENCH_PREFIX_FABRIC=1 runs the cross-host prefix-cache fabric A/B
+(docs/cache_fabric.md): a "prefill host" engine first pushes the
+shared templates through the write-behind worker into a file:// object
+store and emits its fabric advert; then the SAME cold-start workload
+is served by a fresh engine twice — without the fabric (every template
+re-prefills) vs with the object store + the merged advert (revisits
+restore from T3 as cross-host hits). Reports per-arm
+prefix_hit_tokens, the tier hit mix (the fabric arm's "object" column
+is the cross-host win), object store read/write counters, tok/s, and
+greedy token parity across arms (must be 1.0 — lossless spill mode).
+The capture self-describes with "fabric": true so bench_trend judges
+it only against fabric history.
+
 BENCH_DISAGG=1 runs the disaggregated prefill/decode A/B
 (docs/disaggregation.md): the same mixed long-prefill + chat load
 served by a pool of 2 replicas, uniform (both "any") vs role-split
@@ -430,6 +443,170 @@ def _parity_rate(base_streams, arm_streams) -> float:
     return round(matched / max(1, positions), 4)
 
 
+def _fabric_workload(page_size: int, groups: int, rounds: int):
+    """The shared-template rotation the fabric A/B serves — same shape
+    as the tiers A/B so captures are comparable."""
+    tmpl_pages = 3
+    templates = [[7 + g * 101 + i for i in range(tmpl_pages * page_size)]
+                 for g in range(groups)]
+    prompts = [template + [900 + r * groups + g]
+               for r in range(rounds)
+               for g, template in enumerate(templates)]
+    return templates, prompts, tmpl_pages
+
+
+def _fabric_engine_config(platform: str, page_size: int, tmpl_pages: int,
+                          object_url: str, host_bytes: int):
+    from mcp_context_forge_tpu.tpu_local.engine import EngineConfig
+
+    model = os.environ.get(
+        "BENCH_MODEL", "llama3-1b" if platform == "tpu" else "llama3-tiny")
+    slot_pages = tmpl_pages + 2
+    target_pages = 1 + slot_pages + int(tmpl_pages * 1.5)
+    # tier_spill_quant="" (lossless spill) so the fabric arm's greedy
+    # parity vs the cold arm is a HARD 1.0 gate, not a drift tolerance
+    return EngineConfig(
+        model=model, max_batch=2, max_seq_len=page_size * 8,
+        page_size=page_size, num_pages=target_pages,
+        prefill_buckets=(page_size, page_size * 4),
+        dtype="bfloat16" if platform == "tpu" else "float32",
+        attn_impl="auto", prefix_cache=True, prefix_tiers=True,
+        tier_host_bytes=host_bytes, tier_disk_bytes=0,
+        tier_spill_quant="", tier_object_url=object_url,
+        compile_cache_dir=os.environ.get(
+            "MCPFORGE_TPU_LOCAL_COMPILE_CACHE_DIR",
+            "/tmp/mcpforge-xla-cache"))
+
+
+async def _fabric_prefill_host(platform: str, object_url: str,
+                               page_size: int, groups: int,
+                               max_tokens: int):
+    """Host A of the fabric A/B: serve each template once over a T1
+    budget too small to keep it, so displaced pages flow through the
+    write-behind worker into the shared object store; return the
+    advert a real deployment would gossip (docs/cache_fabric.md)."""
+    from mcp_context_forge_tpu.tpu_local.engine import TPUEngine
+    from mcp_context_forge_tpu.tpu_local.kv.fabric import FabricAdvert
+
+    templates, prompts, tmpl_pages = _fabric_workload(page_size, groups,
+                                                      rounds=1)
+    config = _fabric_engine_config(platform, page_size, tmpl_pages,
+                                   object_url, host_bytes=4096)
+    engine = TPUEngine(config)
+    await engine.start()
+    try:
+        for prompt in prompts:
+            async for _ in engine.generate(prompt, max_tokens=max_tokens):
+                pass
+        store = engine._tier_client.store
+        # push the still-resident chains through the REAL spill path so
+        # the store holds every template, then drain the writer
+        engine.allocator.spill_resident_prefix()
+        deadline = time.monotonic() + 30
+        while ((not store._writeq.empty() or store._pending)
+               and time.monotonic() < deadline):
+            await asyncio.sleep(0.02)
+        hashes = store.object_hashes()
+        return FabricAdvert(tenant=store.object_namespace,
+                            host="bench-prefill", hashes=hashes), {
+            "object_pages": store.stats().get("object_pages", 0),
+            "object_writes": store.stats().get("object_writes", 0),
+        }
+    finally:
+        await engine.stop()
+
+
+async def _run_prefix_fabric_arm(platform: str, page_size: int,
+                                 groups: int, rounds: int,
+                                 max_tokens: int, object_url: str = "",
+                                 advert=None) -> dict:
+    """One serving arm: a FRESH engine (cold local cache) over the same
+    rotation workload. With an object_url + peer advert merged, every
+    template's first visit is a cross-host T3 restore instead of a full
+    prefill."""
+    from mcp_context_forge_tpu.tpu_local.engine import TPUEngine
+
+    _templates, prompts, tmpl_pages = _fabric_workload(page_size, groups,
+                                                       rounds)
+    config = _fabric_engine_config(platform, page_size, tmpl_pages,
+                                   object_url,
+                                   host_bytes=64 * 1024 * 1024)
+    engine = TPUEngine(config)
+    await engine.start()
+    try:
+        if advert is not None:
+            engine._tier_client.store.fabric.merge(advert)
+        streams: list[list[int]] = []
+        prompt_tokens = 0
+        started = time.monotonic()
+        total = 0
+        for prompt in prompts:
+            prompt_tokens += len(prompt)
+            tokens = [t async for t in engine.generate(
+                prompt, max_tokens=max_tokens)]
+            streams.append(tokens)
+            total += len(tokens)
+        wall = time.monotonic() - started
+        alloc = engine.allocator
+        arm = {
+            "fabric": bool(object_url),
+            "value": round(total / wall, 2) if wall else 0.0,
+            "tokens": total,
+            "prompt_tokens": prompt_tokens,
+            "prefix_hits": alloc.prefix_hits,
+            "prefix_hit_tokens": alloc.prefix_hit_tokens,
+            "tier_hit_mix": dict(alloc.tier_hit_tokens),
+            "token_streams": streams,
+        }
+        stats = engine.tier_stats()
+        if stats is not None and stats.get("store"):
+            store = stats["store"]
+            for key in ("object_reads", "object_writes",
+                        "object_write_drops", "object_pages"):
+                if key in store:
+                    arm[key] = store[key]
+        return arm
+    finally:
+        await engine.stop()
+
+
+def run_prefix_fabric(platform: str) -> dict:
+    """The BENCH_PREFIX_FABRIC A/B block: cold serving vs serving over
+    a fabric another host already populated (docs/cache_fabric.md)."""
+    import shutil
+    import tempfile
+
+    page_size = int(os.environ.get("BENCH_PAGE_SIZE", "16"))
+    groups = int(os.environ.get("BENCH_TIER_GROUPS", "6"))
+    rounds = int(os.environ.get("BENCH_TIER_ROUNDS", "3"))
+    max_tokens = int(os.environ.get("BENCH_TOKENS", "8"))
+    tmp = tempfile.mkdtemp(prefix="bench-fabric-")
+    try:
+        url = f"file://{tmp}"
+        advert, prefill = asyncio.run(_fabric_prefill_host(
+            platform, url, page_size, groups, max_tokens))
+        cold = asyncio.run(_run_prefix_fabric_arm(
+            platform, page_size, groups, rounds, max_tokens))
+        fab = asyncio.run(_run_prefix_fabric_arm(
+            platform, page_size, groups, rounds, max_tokens,
+            object_url=url, advert=advert))
+        cold_streams = cold.pop("token_streams")
+        fab_streams = fab.pop("token_streams")
+        return {
+            "prefill_host": prefill,
+            "baseline": cold,
+            "fabric": fab,
+            "advert_hashes": len(advert.hashes),
+            "object_hit_tokens": fab["tier_hit_mix"].get("object", 0),
+            "hit_tokens_ratio": round(
+                fab["prefix_hit_tokens"]
+                / max(1, cold["prefix_hit_tokens"]), 3),
+            "token_parity_rate": _parity_rate(cold_streams, fab_streams),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 async def _run_controller_arm(platform: str, controlled: bool) -> dict:
     """One arm of the BENCH_CONTROLLER A/B: identical greedy phase-
     shifting load (interactive-heavy -> batch-heavy -> interactive
@@ -759,6 +936,13 @@ def main() -> dict:
         # a tiers arm so bench_trend judges it only against tier history.
         out["prefix_tiers"] = True
         out["prefix_tiers_ab"] = run_prefix_tiers(platform)
+    if os.environ.get("BENCH_PREFIX_FABRIC", "0") == "1":
+        # cross-host prefix-cache fabric A/B (docs/cache_fabric.md):
+        # cold serving vs serving over an object store another "host"
+        # populated. The capture self-describes as a fabric arm so
+        # bench_trend judges it only against fabric history.
+        out["fabric"] = True
+        out["prefix_fabric_ab"] = run_prefix_fabric(platform)
     return out
 
 

@@ -51,6 +51,15 @@ case "$1" in
       BENCH_SCENARIO_ENFORCE_SLO=1 \
       exec python bench_gateway_scenarios.py "$@"
     ;;
+  bench-fabric)
+    # cross-host prefix-cache fabric arm (docs/cache_fabric.md): two
+    # supervisors, disjoint engine pools, one shared file:// object
+    # store; gates cross-host hits, byte parity, ledger conservation,
+    # and zero failures under a forced tier.object breaker-open
+    shift
+    BENCH_SCENARIO_ONLY=fabric BENCH_REAL_PROCS=1 \
+      exec python bench_gateway_scenarios.py "$@"
+    ;;
   bench-chaos)
     # fault-injection matrix only (docs/resilience.md): db-outage /
     # tier-fault / overload-shed / chaos (slow-replica + kill), gated on

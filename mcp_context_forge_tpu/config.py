@@ -486,6 +486,25 @@ class Settings(BaseModel):
     # drift) or "" for resident-precision spills (lossless round trip).
     # int8-resident pools always spill verbatim (bit-exact).
     tpu_local_tier_spill_quant: str = "int8"
+    # cross-host prefix-cache fabric (docs/cache_fabric.md): a T3
+    # object-store hop below disk shared by EVERY host pointed at the
+    # same URL — "file://<dir>" (shared directory) or "gcs://<bucket>
+    # [/prefix]" (optional google-cloud-storage dep; a missing client
+    # refuses at startup, T3 simply stays off). "" disables the fabric.
+    tpu_local_tier_object_url: str = ""
+    # tenant namespace segment every object key is qualified by —
+    # namespaces are mutually invisible AND mutually unreachable (the
+    # key embeds the namespace)
+    tpu_local_fabric_namespace: str = "shared"
+    # gossip cadence + entry lifetime for fabric adverts: each host
+    # advertises its object-resident chains every interval; an entry a
+    # peer merged expires ttl seconds after its last refresh
+    tpu_local_fabric_advert_interval_s: float = 2.0
+    tpu_local_fabric_advert_ttl_s: float = 300.0
+    # cross-supervisor peers: comma-separated base URLs (e.g.
+    # "http://hostb:4444") whose POST /admin/fabric/adverts we gossip
+    # with; in-fleet workers ride the bus (fabric.advert) automatically
+    tpu_local_fabric_peers: str = ""
     # speculative decoding via prompt-lookup (n-gram) drafting: verify k
     # drafted tokens per dispatch — decode is bandwidth-bound, so accepted
     # drafts are nearly free. Greedy requests only; off by default.

@@ -202,7 +202,8 @@ async function renderEngine(stats){
                  "prefill_batches","queue_depth","chunking","kv_pages_in_use",
                  "kv_bytes_in_use","kv_quant",
                  "prefix_hits","prefix_hit_tokens","tier_hits_host",
-                 "tier_hits_disk","tier_hit_tokens_spilled",
+                 "tier_hits_disk","tier_hits_object",
+                 "tier_hit_tokens_spilled",
                  "spec_steps","spec_tokens",
                  "overlap_steps","pipeline_drains","dispatch_gap_ms_total",
                  "prefill_ms_total","decode_ms_total","engine_restarts"];
@@ -241,6 +242,33 @@ async function renderEngine(stats){
         </div>
         <table><tr>` + pcols.map(c => `<th>${esc(c)}</th>`).join("")
         + `<th>actions</th></tr>${pbody}</table>`;
+    }
+  } catch(e){}
+  // prefix-cache fabric card (docs/cache_fabric.md; 404 when the T3
+  // object tier is off — fabric stats only exist behind an object store)
+  let fabric = "";
+  try {
+    const fr = await fetch("/admin/fabric/adverts");
+    if (fr.ok){
+      const f = await fr.json();
+      const st = f.store || {};
+      const fx = st.fabric || {};
+      fabric = `<br><h3>prefix-cache fabric ${
+          (st.object_breaker || {}).state === "open"
+            ? '<span class="pill bad">tier.object open</span>'
+            : '<span class="pill ok">serving</span>'}</h3>
+        <div class="cards">
+          <div class="card"><b>${cell(st.object_pages)}</b><span>object_pages</span></div>
+          <div class="card"><b>${cell(st.object_bytes)}</b><span>object_bytes</span></div>
+          <div class="card"><b>${cell(st.object_reads)}</b><span>object_reads</span></div>
+          <div class="card"><b>${cell(st.object_writes)}</b><span>object_writes</span></div>
+          <div class="card"><b>${cell(st.object_write_drops)}</b><span>object_write_drops</span></div>
+          <div class="card"><b>${cell(fx.keys)}</b><span>fabric_keys</span></div>
+          <div class="card"><b>${cell(fx.hosts)}</b><span>fabric_hosts</span></div>
+          <div class="card"><b>${cell(fx.merged)}</b><span>adverts_merged</span></div>
+          <div class="card"><b>${cell(f.sent)}</b><span>adverts_sent</span></div>
+          <div class="card"><b>${cell(f.send_failures)}</b><span>advert_send_failures</span></div>
+        </div>`;
     }
   } catch(e){}
   // serving SLO verdicts (percentiles + burn rate vs error budget)
@@ -296,7 +324,7 @@ async function renderEngine(stats){
     }
   } catch(e){}
   document.getElementById("view").innerHTML =
-    `<div class="cards">${cards}${extra}</div>${pool}${slo}${steps}
+    `<div class="cards">${cards}${extra}</div>${pool}${fabric}${slo}${steps}
      <br><button class="act" onclick="engineProfile()">capture jax profile</button>
      <button class="act" onclick="engineProfileCtl('start')">start profile</button>
      <button class="act" onclick="engineProfileCtl('stop')">stop profile</button>

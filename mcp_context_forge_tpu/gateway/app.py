@@ -898,6 +898,59 @@ async def build_app(settings: Settings | None = None) -> web.Application:
     bus_rpc.register("session.elicit", _rpc_session_elicit)
     bus_rpc.register_stream("session.stream", _rpc_session_stream)
 
+    # cross-host prefix-cache fabric (docs/cache_fabric.md): one
+    # publisher per gateway host gossips the tier store's
+    # object-resident chains — in-fleet workers over the fabric.advert
+    # bus method, cross-supervisor peers over POST /admin/fabric/adverts
+    # (routers_extra.py) — and merges what peers advertise back into the
+    # store's fabric index. The store resolves lazily: under the
+    # leader-elected shared plane it only exists after election.
+    from ..tpu_local.kv.fabric.publisher import FabricIndexPublisher
+
+    def _fabric_store():
+        pool = ctx.extras.get("tpu_engine_pool") or engine_pool
+        if pool is not None and getattr(pool, "tier_store", None) is not None:
+            return pool.tier_store
+        eng = ctx.extras.get("tpu_engine") or engine
+        client = getattr(eng, "_tier_client", None) \
+            if eng is not None else None
+        return client.store if client is not None else None
+
+    _fabric_http: list = []  # ClientSession, created lazily on the loop
+
+    async def _fabric_post_json(url: str, payload: dict) -> dict | None:
+        # peer URLs may embed basic credentials
+        # ("http://admin:pw@hostb:4444") — split them out; aiohttp
+        # refuses userinfo in the request URL itself
+        import aiohttp
+        from yarl import URL
+        if not _fabric_http:
+            _fabric_http.append(aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=5.0)))
+        u = URL(url)
+        auth = (aiohttp.BasicAuth(u.user, u.password or "")
+                if u.user else None)
+        async with _fabric_http[0].post(
+                u.with_user(None), json=payload, auth=auth) as resp:
+            resp.raise_for_status()
+            return await resp.json()
+
+    fabric_publisher = FabricIndexPublisher(
+        _fabric_store, ctx.worker_id, rpc=bus_rpc,
+        bus_peers=(lambda: fleet_metrics.live_peers().keys())
+        if fleet_metrics is not None else None,
+        http_peers=[u.strip() for u in
+                    settings.tpu_local_fabric_peers.split(",")
+                    if u.strip()],
+        post_json=_fabric_post_json,
+        interval_s=settings.tpu_local_fabric_advert_interval_s,
+        ttl_s=settings.tpu_local_fabric_advert_ttl_s,
+        rpc_timeout_s=settings.gw_rpc_timeout_s,
+        metrics=metrics)
+    app["fabric_publisher"] = fabric_publisher
+    ctx.extras["fabric_publisher"] = fabric_publisher
+    bus_rpc.register("fabric.advert", fabric_publisher.handle_advert)
+
     async def elicit_route(request: web.Request) -> web.Response:
         request["auth"].require("tools.invoke")
         body = await request.json()
@@ -1061,6 +1114,8 @@ async def build_app(settings: Settings | None = None) -> web.Application:
             await tenant_limiter.start()  # ledger -> shared quota counter
         if fleet_metrics is not None:
             await fleet_metrics.start()
+        if settings.tpu_local_tier_object_url:
+            await fabric_publisher.start()  # T3 advert gossip loop
         await metrics_maintenance.start()
         if metrics_buffer is not None:
             await metrics_buffer.start()
@@ -1108,6 +1163,9 @@ async def build_app(settings: Settings | None = None) -> web.Application:
             await metrics_buffer.stop()
         if loop_sampler is not None:
             await loop_sampler.stop()
+        await fabric_publisher.stop()
+        if _fabric_http:
+            await _fabric_http[0].close()
         if fleet_metrics is not None:
             await fleet_metrics.stop()
         if tenant_limiter is not None:

@@ -831,8 +831,11 @@ document.getElementById("f").onsubmit = async (e) => {
             # scalars; the nested block above is the API-facing detail)
             "tier_hits_host": alloc.tier_hits["host"],
             "tier_hits_disk": alloc.tier_hits["disk"],
+            "tier_hits_object": alloc.tier_hits.get("object", 0),
             "tier_hit_tokens_spilled": (alloc.tier_hit_tokens["host"]
-                                        + alloc.tier_hit_tokens["disk"]),
+                                        + alloc.tier_hit_tokens["disk"]
+                                        + alloc.tier_hit_tokens.get(
+                                            "object", 0)),
             "spec_decode": {
                 "enabled": engine.config.spec_decode,
                 "steps": stats.spec_steps,

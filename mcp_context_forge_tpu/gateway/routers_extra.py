@@ -320,6 +320,49 @@ def setup_extra_routes(app: web.Application) -> None:
                                         if rollup is not None else None)
         return web.json_response(payload)
 
+    # ------------------------------------------------ prefix-cache fabric
+
+    @routes.post("/admin/fabric/adverts")
+    async def fabric_adverts_exchange(request: web.Request) -> web.Response:
+        """Cross-supervisor fabric gossip (docs/cache_fabric.md): a peer
+        host POSTs its chain-head advert batch; we merge it into the
+        local fabric index and reply with OUR adverts — the exchange is
+        bidirectional, so a one-way peer list still converges both ways.
+        In-fleet workers use the ``fabric.advert`` bus method instead;
+        this endpoint is the hop between supervisors."""
+        request["auth"].require("admin.all")
+        publisher = request.app.get("fabric_publisher")
+        if publisher is None or publisher.store is None \
+                or getattr(publisher.store, "object_store", None) is None:
+            raise NotFoundError(
+                "prefix-cache fabric is not enabled "
+                "(set MCPFORGE_TPU_LOCAL_TIER_OBJECT_URL)")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError as exc:
+            raise ValidationFailure(f"invalid JSON: {exc}") from exc
+        if not isinstance(body, dict):
+            raise ValidationFailure("body must be an advert batch object")
+        try:
+            reply = await publisher.handle_advert(body)
+        except ValueError as exc:
+            raise ValidationFailure(str(exc)) from exc
+        return web.json_response(reply)
+
+    @routes.get("/admin/fabric/adverts")
+    async def fabric_adverts_status(request: web.Request) -> web.Response:
+        """Fabric observability: publisher gossip counters plus the tier
+        store's T3/fabric-index stats (read-only twin of the POST
+        exchange — operators and the bench read this)."""
+        request["auth"].require("observability.read")
+        publisher = request.app.get("fabric_publisher")
+        if publisher is None:
+            raise NotFoundError("prefix-cache fabric is not wired")
+        payload = publisher.stats()
+        store = publisher.store
+        payload["store"] = store.stats() if store is not None else None
+        return web.json_response(payload)
+
     # ------------------------------------------- fault plane + degradation
 
     @routes.get("/admin/faults")

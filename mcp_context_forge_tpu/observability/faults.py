@@ -73,6 +73,10 @@ FAULT_POINTS = (
     "tier.disk.read",         # tiers.py T2 spill-file load
     "tier.disk.write",        # tiers.py T2 write-behind persist
     "tier.host.get",          # tiers.py T1 fetch at match time
+    "tier.object.get",        # tiers.py T3 object-store fetch (corrupt =
+                              # mangled blob -> verify-MISS, never a
+                              # served page)
+    "tier.object.put",        # tiers.py T3 write-through persist
 )
 
 KINDS = ("error", "latency", "corrupt")

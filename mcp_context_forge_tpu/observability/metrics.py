@@ -186,6 +186,17 @@ class PrometheusRegistry:
             "MISS, counted here)",
             ["tier", "op"], registry=self.registry,
         )
+        # cross-host prefix-cache fabric (tpu_local/kv/fabric/,
+        # docs/cache_fabric.md): advert gossip volume — "sent" counts
+        # pushes this host delivered to a peer (bus or HTTP), "merged"
+        # counts NEW chain hashes learned from peers (refreshes of
+        # already-known hashes don't count)
+        self.llm_fabric_adverts = Counter(
+            "mcpforge_llm_fabric_adverts_total",
+            "Prefix-fabric advert gossip by direction (sent = pushes "
+            "delivered to peers, merged = new chain hashes learned)",
+            ["direction"], registry=self.registry,
+        )
         self.llm_step_tokens_per_sec = Gauge(
             "mcpforge_llm_step_tokens_per_sec",
             "Tokens emitted per second by the last engine step (over the "

@@ -702,8 +702,10 @@ def _prefix_tier_spec(mod: types.ModuleType) -> None:
     tiers = Tiers()
     alloc = PA(num_pages=8, page_size=4, max_slots=4, max_pages_per_slot=4,
                tiers=tiers)
-    assert alloc.tier_hits == {"hbm": 0, "host": 0, "disk": 0}
-    assert alloc.tier_hit_tokens == {"hbm": 0, "host": 0, "disk": 0}
+    assert alloc.tier_hits == {"hbm": 0, "host": 0, "disk": 0,
+                               "object": 0}
+    assert alloc.tier_hit_tokens == {"hbm": 0, "host": 0, "disk": 0,
+                                     "object": 0}
     prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]
     assert alloc.allocate_slot(0, 9)
     alloc.register_prefix(0, prompt)               # 2 pages + 2 publishes
@@ -714,8 +716,10 @@ def _prefix_tier_spec(mod: types.ModuleType) -> None:
     hist, shared = alloc.match_prefix(prompt)
     assert hist == 8
     assert alloc.allocate_slot(1, 9, prefix_pages=shared)
-    assert alloc.tier_hits == {"hbm": 2, "host": 0, "disk": 0}
-    assert alloc.tier_hit_tokens == {"hbm": 8, "host": 0, "disk": 0}
+    assert alloc.tier_hits == {"hbm": 2, "host": 0, "disk": 0,
+                               "object": 0}
+    assert alloc.tier_hit_tokens == {"hbm": 8, "host": 0, "disk": 0,
+                                     "object": 0}
     assert sum(alloc.tier_hit_tokens.values()) == alloc.prefix_hit_tokens
     alloc.free_slot(1)
     alloc.free_slot(0)
@@ -747,7 +751,8 @@ def _prefix_tier_spec(mod: types.ModuleType) -> None:
     assert hist == 8 and len(pages2) == 2
     assert all(alloc2._ref[p] == 1 for p in pages2)
     assert alloc2.allocate_slot(0, 9, prefix_pages=pages2)
-    assert alloc2.tier_hits == {"hbm": 0, "host": 2, "disk": 0}
+    assert alloc2.tier_hits == {"hbm": 0, "host": 2, "disk": 0,
+                                "object": 0}
     assert alloc2.tier_hit_tokens["host"] == 8
     assert sum(alloc2.tier_hit_tokens.values()) == alloc2.prefix_hit_tokens
     alloc2.free_slot(0)
@@ -904,6 +909,133 @@ def _prefix_tier_spec(mod: types.ModuleType) -> None:
         raise AssertionError("exhausted pool handed out a phantom page")
     except RuntimeError:
         pass
+
+
+# ----------------------------------------------------------- fabric index
+
+def _fabric_index_spec(mod: types.ModuleType) -> None:
+    """Behavioral spec of the cross-host fabric index
+    (docs/cache_fabric.md): advert merge is monotone and counts only
+    NEW hashes, TTL expiry is the only eviction (lazy on covers + eager
+    sweep), tenant namespaces never cross, origin attribution is
+    first-registration-wins, and the wire codec round-trips / rejects
+    malformed frames. A surviving mutant here means a host promising
+    cross-host restores it cannot deliver (admission livelock) or one
+    tenant's cached pages visible to another."""
+    clock = [1000.0]
+    idx = mod.FabricIndex(default_ttl_s=10.0, clock=lambda: clock[0])
+    h1, h2, h3 = b"\x01" * 32, b"\x02" * 32, b"\x03" * 32
+
+    # merge counts NEW hashes only; covers/lookup agree
+    assert idx.merge(mod.FabricAdvert(tenant="t", host="A",
+                                      hashes=[h1, h2])) == 2
+    assert idx.merge(mod.FabricAdvert(tenant="t", host="A",
+                                      hashes=[h1, h3])) == 1
+    assert idx.covers(h1, "t") and idx.covers(h3, "t")
+    assert idx.lookup(h1, "t") == "A"
+    assert idx.stats()["keys"] == 3
+
+    # tenant isolation: the SAME hash under another namespace is a miss
+    assert not idx.covers(h1, "other")
+    assert idx.lookup(h1, "other") is None
+    assert idx.hashes("other") == []
+    idx.invalidate(h1, "other")                    # wrong tenant: no-op
+    assert idx.covers(h1, "t")
+
+    # first-registration-wins: a re-advert from another host refreshes
+    # the expiry but never reassigns the origin
+    clock[0] = 1005.0
+    assert idx.merge(mod.FabricAdvert(tenant="t", host="B",
+                                      hashes=[h1])) == 0
+    assert idx.lookup(h1, "t") == "A"
+
+    # ...and the refresh only EXTENDS: an advert with a shorter ttl
+    # cannot pull an existing expiry earlier
+    idx.merge(mod.FabricAdvert(tenant="t", host="B", hashes=[h1],
+                               ttl_s=0.5))
+    clock[0] = 1011.0                              # h2/h3 (exp 1010) dead
+    assert idx.covers(h1, "t")                     # refreshed to 1015
+    assert not idx.covers(h2, "t")                 # lazy expiry on read
+    assert idx.sweep() == 1                        # h3 swept eagerly
+    assert idx.stats()["keys"] == 1
+
+    # invalidate drops exactly the (tenant, hash) entry
+    idx.invalidate(h1, "t")
+    assert not idx.covers(h1, "t")
+    assert idx.lookup(h1, "t") is None
+    assert idx.invalidated == 1
+
+    # expiry is the ONLY eviction a merge can never perform: re-merging
+    # after expiry counts as NEW again (monotone within a lifetime)
+    assert idx.merge(mod.FabricAdvert(tenant="t", host="C",
+                                      hashes=[h2])) == 1
+    assert idx.lookup(h2, "t") == "C"              # fresh registration
+
+    # wire codec: round trip exact; malformed frames raise ValueError
+    advert = mod.FabricAdvert(tenant="t", host="A", hashes=[h1],
+                              ttl_s=5.0)
+    assert mod.FabricAdvert.from_wire(advert.to_wire()) == advert
+    for bad in ("nope", {"tenant": "t"}, {"tenant": "t", "host": ""},
+                {"tenant": "t", "host": "A", "hashes": ["zz"]},
+                {"tenant": "t", "host": "A", "hashes": ["abcd"]}):
+        try:
+            mod.FabricAdvert.from_wire(bad)
+            raise AssertionError(f"malformed advert accepted: {bad!r}")
+        except ValueError:
+            pass
+    # oversize adverts truncate at the wire boundary, never reject
+    digest_hex = (b"\x07" * 32).hex()
+    big = {"tenant": "t", "host": "A",
+           "hashes": [digest_hex] * (mod.MAX_ADVERT_HASHES + 5)}
+    assert len(mod.FabricAdvert.from_wire(big).hashes) \
+        == mod.MAX_ADVERT_HASHES
+    fresh = mod.FabricIndex(default_ttl_s=10.0, clock=lambda: clock[0])
+    assert mod.merge_wire_adverts(fresh, [advert.to_wire()]) == 1
+    assert fresh.covers(h1, "t")
+
+    # the re-advertisable view groups by tenant and relabels the relay
+    fresh.merge(mod.FabricAdvert(tenant="u", host="B", hashes=[h2]))
+    out = fresh.adverts("relay")
+    assert [(a.tenant, a.host, a.hashes) for a in out] \
+        == [("t", "relay", [h1]), ("u", "relay", [h2])]
+
+    # counters start at zero and count by exactly one — no-ops (a
+    # wrong-tenant invalidate) are NOT counted
+    z = mod.FabricIndex(default_ttl_s=10.0, clock=lambda: clock[0])
+    assert (z.merged, z.refreshed, z.expired, z.invalidated) \
+        == (0, 0, 0, 0)
+    z.merge(mod.FabricAdvert(tenant="t", host="A", hashes=[h1]))
+    assert z.merged == 1 and z.refreshed == 0
+    z.merge(mod.FabricAdvert(tenant="t", host="A", hashes=[h1]))
+    assert z.merged == 1 and z.refreshed == 1
+    z.invalidate(h1, "nope")
+    assert z.invalidated == 0
+    z.invalidate(h1, "t")
+    assert z.invalidated == 1
+
+    # an explicit positive ttl REPLACES the default (shorter is legal
+    # for a fresh entry): a 0.5 s advert on a 10 s-default index is
+    # gone at +1 s
+    clock[0] = 2000.0
+    z.merge(mod.FabricAdvert(tenant="t", host="A", hashes=[h2],
+                             ttl_s=0.5))
+    clock[0] = 2001.0
+    assert not z.covers(h2, "t")
+
+    # the expiry boundary is EXACT: at expires_at == now the entry is
+    # dead on EVERY read path, and each lazy expiry counts once
+    b = mod.FabricIndex(default_ttl_s=10.0, clock=lambda: clock[0])
+    clock[0] = 3000.0
+    b.merge(mod.FabricAdvert(tenant="t", host="A", hashes=[h1, h2]))
+    clock[0] = 3010.0                              # == expires_at
+    assert b.stats()["keys"] == 0
+    assert b.stats()["hosts"] == [] and b.stats()["tenants"] == []
+    assert b.hashes("t") == [] and b.adverts("r") == []
+    assert b.lookup(h1, "t") is None
+    assert not b.covers(h1, "t")                   # lazy-expires h1
+    assert b.expired == 1
+    assert b.sweep() == 1                          # h2, at the boundary
+    assert b.expired == 2
 
 
 # ------------------------------------------------------------ eventstream
@@ -1782,6 +1914,17 @@ TARGETS: dict[str, MutationTarget] = {
         equivalent_markers=(
             "key is not None and self._cached.get(key) == page",
             "current = self._ref.get(page, 1)"),
+    ),
+    "fabric_index": MutationTarget(
+        rel_path="tpu_local/kv/fabric/index.py",
+        module_name="mcp_context_forge_tpu.tpu_local.kv.fabric.index",
+        package="mcp_context_forge_tpu.tpu_local.kv.fabric",
+        oracle=_fabric_index_spec,
+        # the advert size cap is an arbitrary tunable (the spec reads
+        # mod.MAX_ADVERT_HASHES, so truncation behavior is pinned at
+        # whatever the cap is; nudging the constant by one is
+        # behaviorally equivalent)
+        equivalent_markers=("MAX_ADVERT_HASHES = 4096",),
     ),
     "eventstream": MutationTarget(
         rel_path="utils/eventstream.py",

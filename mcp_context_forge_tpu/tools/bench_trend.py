@@ -139,8 +139,12 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
     # balance by design), and a REAL-PROCESS capture ("in_process":
     # false — N forked workers under `mcpforge supervise`, real sockets,
     # real GIL isolation) must never median into in-process history
-    # (absent = true: all pre-real-process captures ran in-process)
-    groups: dict[tuple[int, bool, int, bool, tuple[str, ...], bool],
+    # (absent = true: all pre-real-process captures ran in-process),
+    # and a cross-host fabric capture ("fabric": true — serving over an
+    # object store another host populated, docs/cache_fabric.md) must
+    # only be judged against fabric history (T3 restores replace
+    # prefills, shifting tok/s and hit mix by design)
+    groups: dict[tuple[int, bool, int, bool, tuple[str, ...], bool, bool],
                  list[tuple[int, str, dict[str, Any]]]] = {}
     for item in payloads:
         in_process = item[2].get("in_process")
@@ -150,10 +154,11 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
                            bool(item[2].get("controller")),
                            tuple(str(r) for r in
                                  (item[2].get("roles") or ())),
-                           True if in_process is None else bool(in_process)),
+                           True if in_process is None else bool(in_process),
+                           bool(item[2].get("fabric"))),
                           []).append(item)
-    for (k_steps, tiers, workers, controller, roles, in_process), group \
-            in sorted(groups.items()):
+    for (k_steps, tiers, workers, controller, roles, in_process,
+         fabric), group in sorted(groups.items()):
         if len(group) < 2:
             # a new arm's first capture has no history yet — surface it
             # (a silent zero-check pass would hide the round where the
@@ -162,6 +167,7 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
                 {"superstep": k_steps, "prefix_tiers": tiers,
                  "workers": workers, "controller": controller,
                  "roles": list(roles), "in_process": in_process,
+                 "fabric": fabric,
                  "capture": os.path.basename(group[-1][1])})
             continue
         latest_round, latest_path, latest = group[-1]
@@ -177,6 +183,8 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
             arm += f"@roles={','.join(roles)}"
         if not in_process:
             arm += "@real-process"
+        if fabric:
+            arm += "@fabric"
         for key, higher_better in _GATES[latest.get("metric")]:
             latest_val = latest.get(key)
             prior = [p.get(key) for _rnd, _path, p in history
@@ -197,6 +205,7 @@ def check_series(prefix: str, entries: list[tuple[int, str]],
                 "controller": controller,
                 "roles": list(roles),
                 "in_process": in_process,
+                "fabric": fabric,
                 "latest": latest_val,
                 "latest_round": latest_round,
                 "baseline_median": baseline,
@@ -272,9 +281,10 @@ def main(argv: list[str] | None = None) -> int:
                       if arm.get("roles") else "")
                 rp = ("@real-process"
                       if arm.get("in_process") is False else "")
+                fb = "@fabric" if arm.get("fabric") else ""
                 print(f"bench-trend: {result['series']}"
                       f"@superstep={arm['superstep']}{tiers}{wk}{ctl}{rl}"
-                      f"{rp}: first capture ({arm['capture']}) — no "
+                      f"{rp}{fb}: first capture ({arm['capture']}) — no "
                       f"history to gate yet")
             for check in result["checks"]:
                 arrow = "REGRESSED" if check["regressed"] else "ok"

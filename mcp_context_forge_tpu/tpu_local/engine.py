@@ -142,6 +142,11 @@ class EngineConfig:
     # then the entry quarantines to a clean MISS
     tier_io_retry_max: int = 2
     tier_io_retry_backoff_ms: float = 10.0
+    # cross-host prefix-cache fabric (kv/fabric/, docs/cache_fabric.md):
+    # T3 object-store hop below disk — "" = no fabric; the namespace
+    # qualifies every blob key (tenant isolation by construction)
+    tier_object_url: str = ""
+    fabric_namespace: str = "shared"
     # speculative decoding via prompt-lookup (n-gram) drafting: decode is
     # HBM-bandwidth-bound (one full param read per step), so verifying
     # spec_k drafted tokens in ONE step multiplies tokens/step by the
@@ -273,6 +278,10 @@ class EngineConfig:
             tier_io_retry_max=getattr(settings, "tier_io_retry_max", 2),
             tier_io_retry_backoff_ms=getattr(
                 settings, "tier_io_retry_backoff_ms", 10.0),
+            tier_object_url=getattr(
+                settings, "tpu_local_tier_object_url", ""),
+            fabric_namespace=getattr(
+                settings, "tpu_local_fabric_namespace", "shared"),
             spec_decode=getattr(settings, "tpu_local_spec_decode", False),
             spec_k=getattr(settings, "tpu_local_spec_k", 4),
             spec_ngram=getattr(settings, "tpu_local_spec_ngram", 2),
@@ -546,13 +555,17 @@ class TPUEngine:
             from .kv.tiers import TierClient, TieredPageStore
             store = tier_store
             if store is None and config.prefix_tiers:
+                from .kv.fabric.object_store import object_store_or_none
                 store = TieredPageStore(
                     host_bytes=config.tier_host_bytes,
                     disk_bytes=config.tier_disk_bytes,
                     disk_dir=config.tier_disk_dir,
                     index=prefix_index, metrics=metrics,
                     io_retry_max=config.tier_io_retry_max,
-                    io_retry_backoff_ms=config.tier_io_retry_backoff_ms)
+                    io_retry_backoff_ms=config.tier_io_retry_backoff_ms,
+                    object_store=object_store_or_none(
+                        config.tier_object_url),
+                    object_namespace=config.fabric_namespace)
                 self._owned_tier_store = store
             self._tier_client = TierClient(config.replica_id, store=store,
                                            index=prefix_index,
@@ -3222,6 +3235,9 @@ class TPUEngine:
                 s["host_bytes"])
             m.llm_prefix_tier_bytes.labels(replica=rid, tier="disk").set(
                 s["disk_bytes"])
+            if "object_bytes" in s:
+                m.llm_prefix_tier_bytes.labels(
+                    replica=rid, tier="object").set(s["object_bytes"])
 
     def recent_steps(self, limit: int | None = None) -> list[dict[str, Any]]:
         """Last N step summaries, oldest first (diagnostics surface)."""

@@ -1,0 +1,30 @@
+"""Cross-host prefix-cache fabric (docs/cache_fabric.md).
+
+The tiered prefix cache (``kv/tiers.py``) stops at local disk; this
+package adds the cross-host hop:
+
+- :mod:`object_store` — the T3 backend protocol plus the two in-tree
+  implementations (``file://`` shared directory, ``gcs://`` optional);
+- :mod:`index` — the replicated fabric index: tenant-namespaced, TTL'd
+  chain-hash advertisements merged from remote hosts
+  (first-registration-wins), consulted by the store's probe path;
+- :mod:`publisher` — the gossip loop that advertises this host's
+  object-resident chains over the ``fabric.advert`` bus-RPC method and
+  the ``POST /admin/fabric/adverts`` HTTP peer endpoint.
+"""
+
+from .index import FabricAdvert, FabricIndex
+from .object_store import (FileObjectStore, GcsObjectStore, ObjectStore,
+                           build_object_store, object_store_or_none)
+from .publisher import FabricIndexPublisher
+
+__all__ = [
+    "FabricAdvert",
+    "FabricIndex",
+    "FabricIndexPublisher",
+    "FileObjectStore",
+    "GcsObjectStore",
+    "ObjectStore",
+    "build_object_store",
+    "object_store_or_none",
+]

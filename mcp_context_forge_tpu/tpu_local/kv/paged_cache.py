@@ -357,8 +357,8 @@ class PageAllocator:
         self._restored_tier: dict[int, str] = {}
         self.prefix_hits = 0
         self.prefix_hit_tokens = 0
-        self.tier_hits = {"hbm": 0, "host": 0, "disk": 0}
-        self.tier_hit_tokens = {"hbm": 0, "host": 0, "disk": 0}
+        self.tier_hits = {"hbm": 0, "host": 0, "disk": 0, "object": 0}
+        self.tier_hit_tokens = {"hbm": 0, "host": 0, "disk": 0, "object": 0}
         # monotonic high-water mark of pages_in_use (benches/telemetry):
         # a rolling step ring under-reports peaks on long runs
         self.peak_pages_in_use = 0

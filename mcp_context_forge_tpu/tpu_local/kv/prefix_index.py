@@ -12,7 +12,11 @@ materialized:
 - ``tier`` locations name the pool-shared spill tiers (``host``/
   ``disk`` — ``tpu_local/kv/tiers.py``) — ANY replica can fetch-on-miss
   from them at admission, so a tier hit is affinity-neutral for
-  placement but still counts as a hit for routing accounting.
+  placement but still counts as a hit for routing accounting;
+- ``object:<key>`` locations name the chain's blob in the cross-host
+  object fabric (``tpu_local/kv/fabric/``) — like a shared tier for
+  routing purposes, but host-global: the key is the tenant-namespaced
+  blob name any host sharing the store can fetch.
 
 The index stores ONLY hashes, never token content: a hash collision can
 therefore mis-route (the chosen replica's local probe then finds
@@ -89,6 +93,7 @@ class PrefixIndex:
         self._lock = threading.Lock()
         self._hbm: dict[bytes, set[str]] = {}   # hash -> replica ids
         self._tier: dict[bytes, set[str]] = {}  # hash -> {"host","disk"}
+        self._object: dict[bytes, str] = {}     # hash -> object blob key
 
     # ------------------------------------------------------------ publication
 
@@ -127,12 +132,27 @@ class PrefixIndex:
                 if not tiers:
                     del self._tier[key_hash]
 
+    def publish_object(self, key_hash: bytes, object_key: str) -> None:
+        """Record the chain page's blob in the shared object fabric.
+        The ``object:<key>`` location class is host-global: unlike
+        ``host``/``disk`` it survives this process and is reachable
+        from any host sharing the store."""
+        with self._lock:
+            self._object[key_hash] = object_key
+
+    def unpublish_object(self, key_hash: bytes) -> None:
+        with self._lock:
+            self._object.pop(key_hash, None)
+
     # ----------------------------------------------------------------- lookup
 
     def locations(self, key_hash: bytes) -> dict[str, Any]:
         with self._lock:
+            object_key = self._object.get(key_hash)
             return {"hbm": set(self._hbm.get(key_hash, ())),
-                    "tiers": set(self._tier.get(key_hash, ()))}
+                    "tiers": set(self._tier.get(key_hash, ())),
+                    "object": f"object:{object_key}"
+                    if object_key is not None else None}
 
     def chain_locations(self, prompt_ids: Sequence[int], page_size: int
                         ) -> list[tuple[set[str], bool]]:
@@ -143,7 +163,8 @@ class PrefixIndex:
         shared tier (fetch-on-miss restores the latter at admission)."""
         hashes = chain_hashes(prompt_ids, page_size)
         with self._lock:
-            return [(set(self._hbm.get(h, ())), bool(self._tier.get(h)))
+            return [(set(self._hbm.get(h, ())),
+                     bool(self._tier.get(h)) or h in self._object)
                     for h in hashes]
 
     def reachable_tokens(self, chain: Iterable[tuple[set[str], bool]],
@@ -164,4 +185,5 @@ class PrefixIndex:
     def stats(self) -> dict[str, int]:
         with self._lock:
             return {"keys_hbm": len(self._hbm),
-                    "keys_tiered": len(self._tier)}
+                    "keys_tiered": len(self._tier),
+                    "keys_object": len(self._object)}

@@ -19,6 +19,16 @@ SPILLS instead of drops:
   budget. Entries stay readable throughout (the pending map serves reads
   until the file lands). A T2 hit at match time re-onlines the payload
   into T1 on its way back to HBM.
+- **T3 (object)**: with an :class:`~.fabric.object_store.ObjectStore`
+  attached (docs/cache_fabric.md), the write-behind worker ALSO
+  persists every displaced page as a content-addressed blob
+  (``<namespace>/<chain-hash>.npz``) in the shared store — the
+  cross-HOST hop. The local ``_object`` map plus the gossip-fed
+  :class:`~.fabric.index.FabricIndex` tell probe/get which chains are
+  object-reachable; a fabric hit fetches a page another HOST prefilled
+  and re-onlines it here, behind the same verify gate. T3 has its own
+  ``tier.object`` breaker: open means object reads MISS and writebacks
+  drop (counted) while HBM/T1/T2 keep serving.
 
 The store is POOL-SHARED: every replica spills into and restores from
 the same instance, which is what makes admission-time **fetch-on-miss**
@@ -41,6 +51,7 @@ cross-thread handoffs; the router reads only the index, never the store.
 
 from __future__ import annotations
 
+import io
 import logging
 import os
 import queue
@@ -58,11 +69,13 @@ from ...observability.degradation import get_degradation
 from ...observability.faults import FaultAction, FaultError, fault_point
 
 if TYPE_CHECKING:  # pragma: no cover - typing only
+    from .fabric.index import FabricIndex
+    from .fabric.object_store import ObjectStore
     from .prefix_index import PrefixIndex
 
 logger = logging.getLogger(__name__)
 
-TIERS = ("hbm", "host", "disk")
+TIERS = ("hbm", "host", "disk", "object")
 
 
 def _backoff_s(base_ms: float, attempt: int, salt: int) -> float:
@@ -116,12 +129,24 @@ class TieredPageStore:
                  disk_dir: str = "", index: "PrefixIndex | None" = None,
                  metrics=None, pin: bool = True,
                  io_retry_max: int = 2,
-                 io_retry_backoff_ms: float = 10.0) -> None:
+                 io_retry_backoff_ms: float = 10.0,
+                 object_store: "ObjectStore | None" = None,
+                 object_namespace: str = "shared",
+                 fabric: "FabricIndex | None" = None) -> None:
         self.host_budget = max(0, int(host_bytes))
         self.disk_budget = max(0, int(disk_bytes))
         self.index = index
         self.metrics = metrics
         self._pin = pin
+        # T3 object fabric (docs/cache_fabric.md): the shared backend,
+        # the tenant namespace every key is qualified by, and the
+        # gossip-fed index of chains OTHER hosts have persisted
+        self.object_store = object_store
+        self.object_namespace = object_namespace or "shared"
+        if fabric is None and object_store is not None:
+            from .fabric.index import FabricIndex as _FabricIndex
+            fabric = _FabricIndex()
+        self.fabric = fabric
         # disk IO hardening (docs/resilience.md): transient read/write
         # errors retry with bounded jittered backoff, then the ENTRY is
         # quarantined — dropped to a clean MISS, never a hang or a
@@ -131,8 +156,10 @@ class TieredPageStore:
         self.io_retry_max = max(0, int(io_retry_max))
         self.io_retry_backoff_ms = max(0.0, float(io_retry_backoff_ms))
         self._disk_breaker = get_degradation().breaker("tier.disk")
+        self._object_breaker = get_degradation().breaker("tier.object")
         self.io_errors = {("disk", "read"): 0, ("disk", "write"): 0,
-                          ("host", "get"): 0}
+                          ("host", "get"): 0,
+                          ("object", "read"): 0, ("object", "write"): 0}
         self._lock = threading.Lock()  # lint: lock[spill]
         # T1: insertion-ordered = LRU-by-last-use (get() re-inserts)
         self._host: dict[bytes, SpilledPage] = {}
@@ -143,6 +170,11 @@ class TieredPageStore:
         # eviction when the disk budget overflows)
         self._disk: dict[bytes, tuple[str, int]] = {}  # lint: thread[spill]
         self._disk_nbytes = 0  # lint: thread[spill]
+        # T3 LOCAL knowledge: hashes THIS host wrote (or fetched) from
+        # the object store, hash -> nbytes. Remote residency lives in
+        # self.fabric; the union is what probe/get consult.
+        self._object: dict[bytes, int] = {}  # lint: thread[spill]
+        self._object_nbytes = 0  # lint: thread[spill]
         self._writeq: "queue.Queue[bytes | None]" = queue.Queue()
         self._writer: threading.Thread | None = None
         self._closed = False
@@ -154,6 +186,9 @@ class TieredPageStore:
         self.collisions = 0       # key matched, payload identity did not
         self.disk_writes = 0
         self.disk_reads = 0
+        self.object_writes = 0
+        self.object_reads = 0
+        self.object_write_drops = 0  # writebacks dropped: breaker open
 
     # ------------------------------------------------------------- lifecycle
 
@@ -207,7 +242,7 @@ class TieredPageStore:
             payload.v = pin_host(payload.v)
         with self._lock:
             if (key_hash in self._host or key_hash in self._pending
-                    or key_hash in self._disk):
+                    or key_hash in self._disk or key_hash in self._object):
                 return
             self._host[key_hash] = payload
             self._host_nbytes += payload.nbytes
@@ -227,12 +262,12 @@ class TieredPageStore:
             old_key, old = next(iter(self._host.items()))
             del self._host[old_key]
             self._host_nbytes -= old.nbytes
-            if old_key in self._disk:
-                # a displaced RE-ONLINED entry: its disk copy is already
-                # durable — rewriting would double-count _disk_nbytes
+            if old_key in self._disk or old_key in self._object:
+                # a displaced RE-ONLINED entry: its disk/object copy is
+                # already durable — rewriting would double-count bytes
                 if self.index is not None:
                     self.index.unpublish_tier(old_key, "host")
-            elif self.disk_budget > 0:
+            elif self.disk_budget > 0 or self.object_store is not None:
                 self._pending[old_key] = old  # lint: allow[cross-thread-mutation] _locked-suffix contract: every caller holds self._lock (the lint lock scope is per-method)
                 overflow.append(old_key)
             else:
@@ -251,10 +286,20 @@ class TieredPageStore:
 
     def probe(self, key_hash: bytes) -> bool:
         """True iff some tier holds the key (no payload verification —
-        the probe sizes buckets; the match verifies)."""
+        the probe sizes buckets; the match verifies). Fabric-advertised
+        chains count too — the allocator caps probes at its restore
+        capacity, so a stale advert costs one failed fetch at match
+        time (a clean MISS), never an admission livelock — UNLESS the
+        object breaker is open: a quarantined T3 must not promise
+        capacity its reads will refuse to deliver."""
         with self._lock:
-            return (key_hash in self._host or key_hash in self._pending
-                    or key_hash in self._disk)
+            if (key_hash in self._host or key_hash in self._pending
+                    or key_hash in self._disk
+                    or key_hash in self._object):
+                return True
+        return (self.object_store is not None and self.fabric is not None
+                and self._object_breaker.state != "open"
+                and self.fabric.covers(key_hash, self.object_namespace))
 
     def get(self, key_hash: bytes, parent: bytes,
             chunk: Sequence[int]) -> tuple[SpilledPage, str] | None:
@@ -321,7 +366,9 @@ class TieredPageStore:
         if payload is not None and path is None:
             return hit
         if path is None:
-            return None
+            # T1/T2 miss: the object fabric is the last hop — locally
+            # written blobs or chains a peer host advertised
+            return self._get_object(key_hash, parent, expected)
         if not self._disk_breaker.allow():
             # disk tier quarantined (breaker open): clean MISS; the
             # entry STAYS — it may serve again once a half-open probe
@@ -382,19 +429,207 @@ class TieredPageStore:
             return None
         return payload, tier
 
+    # -------------------------------------------------------- T3 object fabric
+
+    def _object_key(self, key_hash: bytes) -> str:
+        """Content-addressed, tenant-namespaced blob key: the namespace
+        segment is part of the KEY, so tenants in different namespaces
+        cannot reach each other's pages even through a forged advert."""
+        return f"{self.object_namespace}/{key_hash.hex()}.npz"
+
+    def object_hashes(self) -> list[bytes]:
+        """Chain hashes THIS host knows are object-resident (what the
+        publisher advertises to peers)."""
+        with self._lock:
+            return list(self._object)
+
+    def _drop_object_entry(self, key_hash: bytes) -> None:
+        """Forget one object promise everywhere probes look: the local
+        map, the fabric index, and the pool index — or every probe of
+        the chain re-attempts the dead fetch."""
+        with self._lock:
+            nbytes = self._object.pop(key_hash, None)
+            if nbytes is not None:
+                self._object_nbytes -= nbytes
+        if self.fabric is not None:
+            self.fabric.invalidate(key_hash, self.object_namespace)
+        if self.index is not None:
+            self.index.unpublish_object(key_hash)
+
+    def _get_object(self, key_hash: bytes, parent: bytes,
+                    expected: tuple[int, ...]
+                    ) -> tuple[SpilledPage, str] | None:
+        """The T3 fetch: serve a page from the shared object store —
+        written by THIS host (local ``_object`` map) or prefilled by a
+        PEER host (fabric advert) — behind the same verify gate as
+        every other tier. A hit re-onlines into T1 exactly like a disk
+        hit, so the cross-host fetch happens once per chain, not once
+        per request."""
+        if self.object_store is None:
+            return None
+        with self._lock:
+            known = key_hash in self._object
+        if not known and (self.fabric is None or not self.fabric.covers(
+                key_hash, self.object_namespace)):
+            return None
+        if not self._object_breaker.allow():
+            # T3 quarantined (breaker open): clean MISS; local knowledge
+            # and adverts STAY — the blob may serve again after a
+            # half-open probe closes the breaker
+            return None
+        status, payload = self._read_object(key_hash)
+        if status == "miss":
+            # the blob is gone (stale advert / external cleanup): not an
+            # IO failure — drop the promise, leave the breaker alone
+            self._object_breaker.record_success()
+            self._drop_object_entry(key_hash)
+            return None
+        if payload is None:
+            self._object_breaker.record_failure("object read")
+            self._count_io_error("object", "read")
+            self._drop_object_entry(key_hash)
+            return None
+        self._object_breaker.record_success()
+        self.object_reads += 1
+        hit = self._verify(payload, parent, expected, "object")
+        if hit is None:
+            # collision/corrupt blob: a bad payload must stop being
+            # findable (and servable) fabric-wide
+            self._drop_object_entry(key_hash)
+            self.object_store.delete(self._object_key(key_hash))
+            return None
+        # re-online on match (same budget discipline as the disk path);
+        # a fabric-fetched page is PROOF of object residency — learn it
+        # locally so a later displacement skips the redundant writeback
+        # and this host's publisher re-advertises the chain
+        overflow: list[bytes] = []
+        with self._lock:
+            if key_hash not in self._object:
+                self._object[key_hash] = payload.nbytes
+                self._object_nbytes += payload.nbytes
+            if key_hash not in self._host and not self._closed:
+                self._host[key_hash] = payload
+                self._host_nbytes += payload.nbytes
+                overflow = self._trim_host_locked()
+        if self.index is not None:
+            self.index.publish_object(key_hash, self._object_key(key_hash))
+            self.index.publish_tier(key_hash, "host")
+        self._dispatch_overflow(overflow)
+        return hit
+
+    def _read_object(self, key_hash: bytes
+                     ) -> tuple[str, SpilledPage | None]:
+        """One object fetch with bounded retries. Returns ``(status,
+        payload)`` — ``("hit", page)``, ``("miss", None)`` for a clean
+        not-found, ``("error", None)`` after exhausted retries or
+        corrupt content. The ``tier.object.get`` fault point fires per
+        ATTEMPT; a ``corrupt`` rule mangles the fetched bytes so the
+        payload either fails to parse or fails identity verification —
+        a MISS, never a served page."""
+        key = self._object_key(key_hash)
+        for attempt in range(self.io_retry_max + 1):
+            corrupt = False
+            act = fault_point("tier.object.get", scope=key)
+            try:
+                if act is not None:
+                    if act.kind == "corrupt":
+                        corrupt = True
+                    else:
+                        act.apply()
+                raw = self.object_store.get(key)
+                if raw is None:
+                    return "miss", None
+                if corrupt:
+                    raw = FaultAction.corrupt_bytes(raw)
+                with np.load(io.BytesIO(raw)) as data:
+                    return "hit", self._payload_from(data)
+            except OSError:
+                if attempt >= self.io_retry_max:
+                    return "error", None
+                time.sleep(_backoff_s(self.io_retry_backoff_ms, attempt,
+                                      len(key)))
+            except Exception:
+                # corrupt blob content: retrying cannot fix it
+                logger.warning("kv tier store: corrupt object blob %s",
+                               key)
+                return "error", None
+        return "error", None
+
+    def _write_object_tier(self, key_hash: bytes,
+                           payload: SpilledPage) -> bool:
+        """One T3 writeback with bounded retries (write-behind worker
+        only). Breaker open = drop immediately, counted — no retry
+        storm against a dead backend. The ``tier.object.put`` fault
+        point fires per attempt; a ``corrupt`` rule uploads mangled
+        bytes, which every reader's verify gate turns into a MISS."""
+        if self.object_store is None:
+            return False
+        if not self._object_breaker.allow():
+            self.object_write_drops += 1
+            return False
+        key = self._object_key(key_hash)
+        data = self._serialize(payload)
+        started = time.monotonic()
+        for attempt in range(self.io_retry_max + 1):
+            blob = data
+            act = fault_point("tier.object.put", scope=key)
+            try:
+                if act is not None:
+                    if act.kind == "corrupt":
+                        blob = FaultAction.corrupt_bytes(data)
+                    else:
+                        act.apply()
+                self.object_store.put(key, blob)
+            except OSError:
+                if attempt >= self.io_retry_max:
+                    self._object_breaker.record_failure("object write")
+                    self._count_io_error("object", "write")
+                    logger.warning(
+                        "kv tier store: object write failed after %d "
+                        "attempt(s) (%s); page stays local-only",
+                        self.io_retry_max + 1, key)
+                    return False
+                time.sleep(_backoff_s(self.io_retry_backoff_ms, attempt,
+                                      len(key)))
+                continue
+            self._object_breaker.record_success()
+            if self.metrics is not None:
+                self.metrics.llm_prefix_tier_io.labels(
+                    op="writeback", tier="object").observe(
+                    time.monotonic() - started)
+            return True
+        return False
+
+    @staticmethod
+    def _serialize(payload: SpilledPage) -> bytes:
+        buf = io.BytesIO()
+        np.savez(buf,
+                 chunk=np.asarray(payload.chunk, dtype=np.int64),
+                 parent=np.frombuffer(payload.parent, dtype=np.uint8),
+                 k=np.asarray(payload.k), v=np.asarray(payload.v),
+                 k_scales=np.asarray(payload.k_scales),
+                 v_scales=np.asarray(payload.v_scales))
+        return buf.getvalue()
+
     # ----------------------------------------------------------- spill worker
 
     def _writer_loop(self) -> None:  # lint: runs-on[spill]
-        """Write-behind: persist pending T1 overflow to disk, bounded by
-        the disk budget (oldest files evicted — past the last tier, the
-        page is truly gone and the index forgets it).
+        """Write-behind: persist pending T1 overflow to disk (bounded by
+        the disk budget, oldest files evicted) AND — write-through —
+        to the shared object store when one is attached. A page is
+        dropped only when EVERY lower tier refused it; a disk-evicted
+        page whose blob survives in T3 is still fetchable, so only a
+        blob-less eviction counts as truly gone.
 
         Hardened (docs/resilience.md): transient write errors — real or
-        injected at the ``tier.disk.write`` fault point — retry with
-        bounded jittered backoff, then the ENTRY quarantines (clean
-        drop, counted); repeated failures open the ``tier.disk``
-        breaker, after which writebacks drop immediately (no retry
-        storm against a dead disk) until a half-open probe recovers."""
+        injected at the ``tier.disk.write`` / ``tier.object.put`` fault
+        points — retry with bounded jittered backoff, then that
+        DESTINATION quarantines for the entry (clean skip, counted);
+        repeated failures open the ``tier.disk`` / ``tier.object``
+        breaker, after which writebacks to that tier drop immediately
+        (no retry storm against a dead backend) until a half-open probe
+        recovers. The tiers fail independently: an open object breaker
+        never blocks disk writeback, and vice versa."""
         while True:
             key_hash = self._writeq.get()
             if key_hash is None:
@@ -403,59 +638,77 @@ class TieredPageStore:
                 payload = self._pending.get(key_hash)
             if payload is None:
                 continue
-            path = os.path.join(self._ensure_dir(),
-                                key_hash.hex() + ".npz")
             started = time.monotonic()
-            if not self._disk_breaker.allow():
-                # disk tier quarantined: drop cleanly (stay bounded,
-                # never wedge the writer on a dead disk); T1/HBM keep
-                # serving the corpus that remains
+            wrote_disk = False
+            path = ""
+            if self.disk_budget > 0:
+                path = os.path.join(self._ensure_dir(),
+                                    key_hash.hex() + ".npz")
+                if not self._disk_breaker.allow():
+                    # disk tier quarantined: skip cleanly (stay bounded,
+                    # never wedge the writer on a dead disk)
+                    pass
+                elif self._write_disk(path, payload):
+                    self._disk_breaker.record_success()
+                    wrote_disk = True
+                else:
+                    self._disk_breaker.record_failure("disk write")
+                    self._count_io_error("disk", "write")
+                    logger.warning(
+                        "kv tier store: disk write failed after %d "
+                        "attempt(s) (%s); dropping page",
+                        self.io_retry_max + 1, path)
+            wrote_object = self._write_object_tier(key_hash, payload)
+            if not wrote_disk and not wrote_object:
+                # no lower tier took the page: truly gone
                 with self._lock:
                     self._pending.pop(key_hash, None)
                 self.dropped += 1
                 if self.index is not None:
                     self.index.unpublish_tier(key_hash, "host")
                 continue
-            if not self._write_disk(path, payload):
-                self._disk_breaker.record_failure("disk write")
-                self._count_io_error("disk", "write")
-                logger.warning("kv tier store: disk write failed after "
-                               "%d attempt(s) (%s); dropping page",
-                               self.io_retry_max + 1, path)
-                with self._lock:
-                    self._pending.pop(key_hash, None)
-                self.dropped += 1
-                if self.index is not None:
-                    self.index.unpublish_tier(key_hash, "host")
-                continue
-            self._disk_breaker.record_success()
             nbytes = payload.nbytes
-            evicted: list[tuple[bytes, str]] = []
+            evicted: list[tuple[bytes, str, bool]] = []
             with self._lock:
                 self._pending.pop(key_hash, None)
-                self._disk[key_hash] = (path, nbytes)
-                self._disk_nbytes += nbytes
-                while self._disk_nbytes > self.disk_budget \
-                        and len(self._disk) > 1:
-                    old_key, (old_path, old_nbytes) = \
-                        next(iter(self._disk.items()))
-                    del self._disk[old_key]
-                    self._disk_nbytes -= old_nbytes
-                    evicted.append((old_key, old_path))
-            self.disk_writes += 1
-            if self.metrics is not None:
-                self.metrics.llm_prefix_tier_io.labels(
-                    op="writeback", tier="disk").observe(
-                    time.monotonic() - started)
+                if wrote_disk:
+                    self._disk[key_hash] = (path, nbytes)
+                    self._disk_nbytes += nbytes
+                    while self._disk_nbytes > self.disk_budget \
+                            and len(self._disk) > 1:
+                        old_key, (old_path, old_nbytes) = \
+                            next(iter(self._disk.items()))
+                        del self._disk[old_key]
+                        self._disk_nbytes -= old_nbytes
+                        evicted.append((old_key, old_path,
+                                        old_key in self._object))
+                if wrote_object and key_hash not in self._object:
+                    self._object[key_hash] = nbytes
+                    self._object_nbytes += nbytes
+            if wrote_disk:
+                self.disk_writes += 1
+                if self.metrics is not None:
+                    self.metrics.llm_prefix_tier_io.labels(
+                        op="writeback", tier="disk").observe(
+                        time.monotonic() - started)
+            if wrote_object:
+                self.object_writes += 1
             if self.index is not None:
-                self.index.publish_tier(key_hash, "disk")
+                if wrote_disk:
+                    self.index.publish_tier(key_hash, "disk")
+                if wrote_object:
+                    self.index.publish_object(
+                        key_hash, self._object_key(key_hash))
                 self.index.unpublish_tier(key_hash, "host")
-            for old_key, old_path in evicted:
+            for old_key, old_path, still_object in evicted:
                 try:
                     os.unlink(old_path)
                 except OSError:
                     pass
-                self.dropped += 1
+                if not still_object:
+                    # past the last tier — the blob-backed case is NOT a
+                    # drop: the page is one object fetch away
+                    self.dropped += 1
                 if self.index is not None:
                     self.index.unpublish_tier(old_key, "disk")
 
@@ -598,7 +851,9 @@ class TieredPageStore:
                 p.nbytes for p in self._pending.values())
             disk_entries = len(self._disk)
             disk_nbytes = self._disk_nbytes
-        return {
+            object_entries = len(self._object)
+            object_nbytes = self._object_nbytes
+        out: dict[str, Any] = {
             "host_pages": host_entries, "host_bytes": host_nbytes,
             "host_budget_bytes": self.host_budget,
             "disk_pages": disk_entries, "disk_bytes": disk_nbytes,
@@ -610,6 +865,18 @@ class TieredPageStore:
                           in self.io_errors.items()},
             "disk_breaker": self._disk_breaker.snapshot(),
         }
+        if self.object_store is not None:
+            out["object_pages"] = object_entries
+            out["object_bytes"] = object_nbytes
+            out["object_url"] = self.object_store.url
+            out["object_namespace"] = self.object_namespace
+            out["object_writes"] = self.object_writes
+            out["object_reads"] = self.object_reads
+            out["object_write_drops"] = self.object_write_drops
+            out["object_breaker"] = self._object_breaker.snapshot()
+            if self.fabric is not None:
+                out["fabric"] = self.fabric.stats()
+        return out
 
 
 class TierClient:
@@ -713,7 +980,7 @@ class TierClient:
                 page: int) -> str | None:
         """Fetch-on-miss: verify + fetch the spilled page and upload it
         into ``page`` of THIS replica's HBM pool. Returns the source
-        tier ("host"/"disk") or None (miss / collision)."""
+        tier ("host"/"disk"/"object") or None (miss / collision)."""
         if not self.active:
             return None
         started = time.monotonic()

@@ -216,6 +216,7 @@ class EnginePool:
             from ..kv.prefix_index import PrefixIndex
             self.prefix_index = PrefixIndex()
             if config.prefix_tiers:
+                from ..kv.fabric.object_store import object_store_or_none
                 from ..kv.tiers import TieredPageStore
                 self.tier_store = TieredPageStore(
                     host_bytes=config.tier_host_bytes,
@@ -223,7 +224,10 @@ class EnginePool:
                     disk_dir=config.tier_disk_dir,
                     index=self.prefix_index, metrics=metrics,
                     io_retry_max=config.tier_io_retry_max,
-                    io_retry_backoff_ms=config.tier_io_retry_backoff_ms)
+                    io_retry_backoff_ms=config.tier_io_retry_backoff_ms,
+                    object_store=object_store_or_none(
+                        config.tier_object_url),
+                    object_namespace=config.fabric_namespace)
         self.requeue_max = max(0, requeue_max)
         self._factory = engine_factory or (
             lambda cfg, tracer, metrics, devices, ledger=None,

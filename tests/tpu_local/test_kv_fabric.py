@@ -1,0 +1,554 @@
+"""Cross-host prefix-cache fabric: T3 object tier + replicated index.
+
+The contract (ISSUE 20 / docs/cache_fabric.md), in falsifiable form:
+
+- the write-behind worker persists displaced T1 pages to the object
+  store (write-through beside disk), and a later match on ANY store
+  sharing the backend serves the page from T3 with the payload
+  byte-identical — including a store on another host that only learned
+  the chain from a :class:`FabricAdvert`;
+- every object read passes the same verify-before-serve gate as disk: a
+  collision (or a corrupted blob) is a MISS, never a wrong page, and
+  the poisoned blob + fabric entry are dropped so admission cannot
+  livelock re-probing;
+- tenant namespaces isolate by construction: the namespace is embedded
+  in the object KEY, and the fabric index keys on (tenant, hash) —
+  another namespace's pages are invisible AND unreachable;
+- injected faults at ``tier.object.get`` / ``tier.object.put`` degrade
+  along the PR-14 ladder: bounded retries, then the ``tier.object``
+  breaker opens — reads MISS cleanly, writebacks drop counted
+  (``object_write_drops``) — while T1/T2/HBM keep serving;
+- the hit accounting conserves with THREE tiers: tier_hit_tokens
+  (hbm+host+disk+object) sums to prefix_hit_tokens at the same consume
+  site the tenant ledger's cache_hit column meters — including when
+  the hit tokens were prefilled by a different host.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from mcp_context_forge_tpu.observability.metering import TenantLedger
+from mcp_context_forge_tpu.tpu_local.engine import EngineConfig, TPUEngine
+from mcp_context_forge_tpu.tpu_local.kv.fabric import (
+    FabricAdvert, FabricIndex, FabricIndexPublisher, FileObjectStore,
+    build_object_store, object_store_or_none)
+from mcp_context_forge_tpu.tpu_local.kv.fabric.index import (
+    MAX_ADVERT_HASHES, merge_wire_adverts)
+from mcp_context_forge_tpu.tpu_local.kv.fabric.object_store import (
+    _check_key, gcs_available)
+from mcp_context_forge_tpu.tpu_local.kv.prefix_index import (
+    ROOT_HASH, chain_hashes)
+from mcp_context_forge_tpu.tpu_local.kv.tiers import (SpilledPage,
+                                                      TieredPageStore)
+
+PS = 16
+
+
+def _payload(chunk, parent=ROOT_HASH, fill=1):
+    shape = (2, 4, 2, 8)  # [L, page, KV, hd]
+    return SpilledPage(chunk=tuple(chunk), parent=parent,
+                       k=np.full(shape, fill, dtype=np.int8),
+                       v=np.full(shape, fill, dtype=np.int8),
+                       k_scales=np.ones((2, 2), dtype=np.float32),
+                       v_scales=np.ones((2, 2), dtype=np.float32))
+
+
+def _hash(chunk):
+    return chain_hashes(list(chunk) + [99], 4)[0]
+
+
+def _store(tmp_path, *, namespace="shared", host_bytes=None, disk=0,
+           **kw):
+    one = _payload((0,) * 4).nbytes
+    return TieredPageStore(
+        host_bytes=one + 1 if host_bytes is None else host_bytes,
+        disk_bytes=disk, pin=False,
+        object_store=FileObjectStore(str(tmp_path / "bucket")),
+        object_namespace=namespace, **kw)
+
+
+def _drain(store, deadline_s=10):
+    deadline = time.monotonic() + deadline_s
+    while (not store._writeq.empty() or store._pending) \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+
+
+# ------------------------------------------------------------ object store
+
+def test_file_object_store_put_get_delete(tmp_path):
+    store = FileObjectStore(str(tmp_path))
+    assert store.get("ns/missing.npz") is None
+    store.put("ns/a.npz", b"payload")
+    assert store.get("ns/a.npz") == b"payload"
+    store.put("ns/a.npz", b"replaced")        # atomic replace
+    assert store.get("ns/a.npz") == b"replaced"
+    store.delete("ns/a.npz")
+    assert store.get("ns/a.npz") is None
+    store.delete("ns/a.npz")                  # idempotent
+    assert store.stats()["url"].startswith("file://")
+
+
+@pytest.mark.parametrize("bad", ["", "../escape", "a/../b", "a//b",
+                                 "/abs", "a b", "a\x00b", "ns/"])
+def test_object_keys_reject_traversal_and_junk(bad):
+    with pytest.raises(ValueError):
+        _check_key(bad)
+
+
+def test_build_object_store_schemes(tmp_path):
+    store = build_object_store(f"file://{tmp_path}/b")
+    assert isinstance(store, FileObjectStore)
+    with pytest.raises(ValueError):
+        build_object_store("s3://nope/unsupported")
+    if not gcs_available():
+        # optional dep absent: refuse loudly at BUILD time, not at the
+        # first request
+        with pytest.raises(ValueError):
+            build_object_store("gcs://bucket/prefix")
+    # the serve-anyway wrapper: "" disables silently, junk logs + None
+    assert object_store_or_none("") is None
+    assert object_store_or_none("s3://nope") is None
+    assert object_store_or_none(f"file://{tmp_path}/c") is not None
+
+
+# ------------------------------------- T3 write-through + cross-host fetch
+
+def test_object_writeback_and_cross_store_fetch(tmp_path):
+    """Displaced T1 pages land in the object store; a SECOND store that
+    shares only the backend (another host) serves them after merging the
+    first host's advert — payload byte-identical, re-onlined into T1."""
+    a = _store(tmp_path)
+    b = _store(tmp_path)
+    try:
+        chunks = [tuple(range(i, i + 4)) for i in range(0, 12, 4)]
+        hashes = [_hash(c) for c in chunks]
+        for h, c in zip(hashes, chunks):
+            a.put(h, _payload(c, fill=c[0] + 1))
+        _drain(a)
+        stats = a.stats()
+        assert stats["object_pages"] >= 2
+        assert stats["object_writes"] >= 2
+        assert set(a.object_hashes()) >= set(hashes[:2])
+        # host B learns the chains only from the advert
+        assert not b.probe(hashes[0])
+        assert b.fabric.merge(FabricAdvert(
+            tenant="shared", host="hostA", hashes=a.object_hashes())) >= 2
+        assert b.probe(hashes[0])
+        hit = b.get(hashes[0], ROOT_HASH, chunks[0])
+        assert hit is not None and hit[1] == "object"
+        payload = hit[0]
+        assert payload.chunk == chunks[0]
+        assert int(payload.k[0, 0, 0, 0]) == chunks[0][0] + 1
+        assert b.stats()["object_reads"] >= 1
+        assert b.stats()["host_pages"] >= 1      # re-onlined into T1
+        # residency learned from the fetch: B now re-advertises the hash
+        assert hashes[0] in b.object_hashes()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_object_hit_verify_gate_drops_collision(tmp_path):
+    """A wrong chunk under an advertised hash is a MISS; the poisoned
+    blob is deleted and the fabric entry invalidated, fabric-wide."""
+    a = _store(tmp_path)
+    b = _store(tmp_path)
+    try:
+        chunk = tuple(range(4))
+        h = _hash(chunk)
+        a.put(h, _payload(chunk))
+        a.put(_hash((50, 51, 52, 53)), _payload((50, 51, 52, 53)))
+        _drain(a)                      # displacement pushed h to object
+        assert h in a.object_hashes()
+        b.fabric.merge(FabricAdvert(tenant="shared", host="hostA",
+                                    hashes=[h]))
+        assert b.get(h, ROOT_HASH, (9, 9, 9, 9)) is None
+        assert b.collisions == 1
+        assert not b.probe(h)                      # invalidated locally
+        assert b.fabric.stats()["invalidated"] == 1
+        # the blob itself is gone: host A's OWN re-read now misses too
+        assert a.object_store.get(a._object_key(h)) is None
+    finally:
+        a.close()
+        b.close()
+
+
+def test_tenant_namespace_isolation(tmp_path):
+    """Namespaces isolate by construction: the key embeds the namespace
+    and the index keys on (tenant, hash) — another namespace cannot see
+    or reach the pages even over the same backend."""
+    a = _store(tmp_path, namespace="team-a")
+    other = _store(tmp_path, namespace="team-b")
+    try:
+        chunk = tuple(range(4))
+        h = _hash(chunk)
+        a.put(h, _payload(chunk))
+        a.put(_hash((50, 51, 52, 53)), _payload((50, 51, 52, 53)))
+        _drain(a)                      # displacement pushed h to object
+        assert h in a.object_hashes()
+        # even a (buggy/malicious) advert naming the hash under the
+        # WRONG tenant cannot cross: the blob key is namespaced too
+        other.fabric.merge(FabricAdvert(tenant="team-b", host="hostA",
+                                        hashes=[h]))
+        assert other.get(h, ROOT_HASH, chunk) is None
+        # the correct namespace still serves
+        b = _store(tmp_path, namespace="team-a")
+        try:
+            b.fabric.merge(FabricAdvert(tenant="team-a", host="hostA",
+                                        hashes=[h]))
+            hit = b.get(h, ROOT_HASH, chunk)
+            assert hit is not None and hit[1] == "object"
+        finally:
+            b.close()
+    finally:
+        a.close()
+        other.close()
+
+
+# ------------------------------------------- fault plane + breaker ladder
+
+def _arm(rule_kwargs):
+    from mcp_context_forge_tpu.observability.faults import (
+        FaultRule, configure_fault_plane)
+    plane = configure_fault_plane(True)
+    plane.arm(FaultRule(**rule_kwargs))
+    return plane
+
+
+@pytest.fixture()
+def fault_env():
+    from mcp_context_forge_tpu.observability.degradation import \
+        configure_degradation
+    from mcp_context_forge_tpu.observability.faults import \
+        configure_fault_plane
+    configure_degradation(failure_threshold=2, cooldown_s=0.05)
+    yield
+    configure_fault_plane(False)
+    configure_degradation()
+
+
+def test_object_put_fault_opens_breaker_drops_counted(fault_env,
+                                                      tmp_path):
+    """A persistent ``tier.object.put`` error exhausts the bounded
+    retries, opens the tier.object breaker, and later writebacks DROP
+    counted (object_write_drops) — T1 keeps serving throughout."""
+    from mcp_context_forge_tpu.observability.degradation import \
+        get_degradation
+    _arm({"point": "tier.object.put", "kind": "error", "mode": "always"})
+    store = _store(tmp_path, io_retry_max=1, io_retry_backoff_ms=1.0)
+    try:
+        chunks = [tuple(range(i, i + 4)) for i in range(0, 20, 4)]
+        hashes = [_hash(c) for c in chunks]
+        for h, c in zip(hashes, chunks):
+            store.put(h, _payload(c))
+        _drain(store)
+        stats = store.stats()
+        assert stats["object_pages"] == 0
+        assert stats["io_errors"]["object.write"] >= 2
+        assert stats["object_breaker"]["state"] == "open"
+        assert get_degradation().component_state("tier.object") == "open"
+        # breaker open: subsequent writebacks drop WITHOUT an attempt
+        assert stats["object_write_drops"] >= 1
+        # with no disk tier either, the displaced pages are truly gone —
+        # but counted, never hung
+        assert stats["dropped"] >= 1
+        # T1 keeps serving the newest entry
+        assert store.get(hashes[-1], ROOT_HASH, chunks[-1]) is not None
+    finally:
+        store.close()
+
+
+def test_object_get_fault_is_clean_miss_then_quarantine(fault_env,
+                                                        tmp_path):
+    """A persistent ``tier.object.get`` error is a clean MISS (bounded
+    retries, io_errors counted); once the breaker opens, later
+    fabric-covered probes stop promising and reads stop attempting."""
+    _arm({"point": "tier.object.get", "kind": "error", "mode": "always"})
+    a = _store(tmp_path)
+    b = _store(tmp_path, io_retry_max=1, io_retry_backoff_ms=1.0)
+    try:
+        chunks = [tuple(range(i, i + 4)) for i in range(0, 12, 4)]
+        hashes = [_hash(c) for c in chunks]
+        for h, c in zip(hashes, chunks):
+            a.put(h, _payload(c))
+        _drain(a)
+        b.fabric.merge(FabricAdvert(tenant="shared", host="hostA",
+                                    hashes=a.object_hashes()))
+        assert b.get(hashes[0], ROOT_HASH, chunks[0]) is None
+        assert b.get(hashes[1], ROOT_HASH, chunks[1]) is None
+        stats = b.stats()
+        assert stats["io_errors"]["object.read"] >= 2
+        assert stats["object_breaker"]["state"] == "open"
+        # quarantine: fabric coverage no longer scores as capacity, so
+        # admission cannot livelock on a dead backend
+        assert not b.probe(hashes[2])
+        reads0 = b.object_reads
+        assert b.get(hashes[2], ROOT_HASH, chunks[2]) is None
+        assert b.object_reads == reads0        # no attempt while open
+    finally:
+        a.close()
+        b.close()
+
+
+def test_object_get_corrupt_fault_never_serves_wrong_page(fault_env,
+                                                          tmp_path):
+    """A corrupted blob (kind="corrupt" on tier.object.get) fails the
+    verify gate — a MISS, never a wrong payload served."""
+    a = _store(tmp_path)
+    try:
+        chunk = tuple(range(4))
+        h = _hash(chunk)
+        a.put(h, _payload(chunk))
+        a.put(_hash((50, 51, 52, 53)), _payload((50, 51, 52, 53)))
+        _drain(a)                      # displacement pushed h to object
+        assert h in a.object_hashes()
+        b = _store(tmp_path, io_retry_max=0)
+        try:
+            b.fabric.merge(FabricAdvert(tenant="shared", host="hostA",
+                                        hashes=[h]))
+            _arm({"point": "tier.object.get", "kind": "corrupt",
+                  "mode": "always"})
+            assert b.get(h, ROOT_HASH, chunk) is None
+        finally:
+            b.close()
+    finally:
+        a.close()
+
+
+def test_object_breaker_half_open_probe_recovers(fault_env, tmp_path):
+    """After the outage clears, the cooldown admits ONE probe writeback;
+    success walks the open -> half_open -> closed ladder in order."""
+    from mcp_context_forge_tpu.observability.degradation import \
+        get_degradation
+    from mcp_context_forge_tpu.observability.faults import \
+        get_fault_plane
+    _arm({"point": "tier.object.put", "kind": "error", "mode": "always"})
+    store = _store(tmp_path, io_retry_max=0, io_retry_backoff_ms=1.0)
+    try:
+        chunks = [tuple(range(i, i + 4)) for i in range(0, 12, 4)]
+        for c in chunks:
+            store.put(_hash(c), _payload(c))
+        _drain(store)
+        assert store.stats()["object_breaker"]["state"] == "open"
+        get_fault_plane().disarm("tier.object.put")
+        time.sleep(0.06)                      # cooldown elapses
+        chunks2 = [tuple(range(i, i + 4)) for i in range(100, 112, 4)]
+        for c in chunks2:
+            store.put(_hash(c), _payload(c))
+        _drain(store)
+        assert store.stats()["object_breaker"]["state"] == "closed"
+        assert store.stats()["object_pages"] >= 1
+        transitions = [t["to"] for t in
+                       get_degradation().transitions("tier.object")]
+        assert transitions[:3] == ["open", "half_open", "closed"]
+    finally:
+        store.close()
+
+
+# ------------------------------------------------------------ fabric index
+
+def test_fabric_index_merge_ttl_and_first_registration_wins():
+    clock = [100.0]
+    idx = FabricIndex(default_ttl_s=10.0, clock=lambda: clock[0])
+    h1, h2 = b"\x01" * 32, b"\x02" * 32
+    assert idx.merge(FabricAdvert(tenant="t", host="A",
+                                  hashes=[h1, h2])) == 2
+    assert idx.covers(h1, "t") and idx.lookup(h1, "t") == "A"
+    # re-advert from another host: origin stays pinned (first wins),
+    # expiry only extends
+    clock[0] = 105.0
+    assert idx.merge(FabricAdvert(tenant="t", host="B",
+                                  hashes=[h1])) == 0
+    assert idx.lookup(h1, "t") == "A"
+    assert idx.refreshed == 1
+    # h2's original TTL elapses; h1 lives on via the refresh
+    clock[0] = 111.0
+    assert not idx.covers(h2, "t")            # lazy expiry on read
+    assert idx.covers(h1, "t")
+    clock[0] = 120.0
+    assert idx.sweep() == 1                   # eager expiry of h1
+    assert idx.stats()["keys"] == 0
+    assert idx.expired == 2
+
+
+def test_fabric_index_tenant_isolation_and_invalidate():
+    idx = FabricIndex(default_ttl_s=60.0)
+    h = b"\x0a" * 32
+    idx.merge(FabricAdvert(tenant="team-a", host="A", hashes=[h]))
+    assert idx.covers(h, "team-a") and not idx.covers(h, "team-b")
+    assert idx.lookup(h, "team-b") is None
+    assert idx.hashes("team-a") == [h] and idx.hashes("team-b") == []
+    idx.invalidate(h, "team-b")               # wrong tenant: no-op
+    assert idx.covers(h, "team-a")
+    idx.invalidate(h, "team-a")
+    assert not idx.covers(h, "team-a")
+    assert idx.invalidated == 1
+
+
+def test_fabric_advert_wire_round_trip_and_validation():
+    advert = FabricAdvert(tenant="t", host="A",
+                          hashes=[b"\x03" * 32], ttl_s=5.0)
+    assert FabricAdvert.from_wire(advert.to_wire()) == advert
+    for bad in ("not a dict", {"tenant": "t"}, {"tenant": "t", "host": ""},
+                {"tenant": "t", "host": "A", "hashes": ["zz"]},
+                {"tenant": "t", "host": "A", "hashes": ["ab"]}):
+        with pytest.raises(ValueError):
+            FabricAdvert.from_wire(bad)
+    # oversize adverts truncate at the wire boundary, never reject
+    big = {"tenant": "t", "host": "A",
+           "hashes": [bytes([i % 256]) .hex() * 32
+                      for i in range(MAX_ADVERT_HASHES + 5)]}
+    # hex of 1 byte repeated 32x = 32-byte digest after fromhex
+    parsed = FabricAdvert.from_wire(big)
+    assert len(parsed.hashes) == MAX_ADVERT_HASHES
+    idx = FabricIndex()
+    assert merge_wire_adverts(
+        idx, [advert.to_wire()]) == 1
+
+
+# -------------------------------------------------------------- publisher
+
+def test_publisher_gossip_round_trip(tmp_path):
+    """publish_once pushes the local advert over bus AND http; the http
+    reply's adverts merge back in (one-way peer list, two-way
+    convergence); handle_advert merges + echoes the local view."""
+    a = _store(tmp_path)
+    b = _store(tmp_path)
+    try:
+        chunk = tuple(range(4))
+        h = _hash(chunk)
+        a.put(h, _payload(chunk))
+        a.put(_hash((50, 51, 52, 53)), _payload((50, 51, 52, 53)))
+        _drain(a)                      # displacement pushed h to object
+
+        pub_b = FabricIndexPublisher(b, "hostB", ttl_s=60.0)
+
+        class _Rpc:
+            calls = []
+
+            async def call(self, worker, method, params, timeout_s=0):
+                self.calls.append((worker, method))
+                return await pub_b.handle_advert(params)
+
+        async def post_json(url, payload):
+            assert url.endswith("/admin/fabric/adverts")
+            return await pub_b.handle_advert(payload)
+
+        pub_a = FabricIndexPublisher(
+            a, "hostA", rpc=_Rpc(),
+            bus_peers=lambda: ["hostA", "w2"],   # self is skipped
+            http_peers=["http://peer-b:4444/"],
+            post_json=post_json, ttl_s=60.0)
+        report = asyncio.run(pub_a.publish_once())
+        assert report == {"sent": 2, "hashes": 1}
+        assert _Rpc.calls == [("w2", "fabric.advert")]
+        # B learned A's chain over both paths
+        assert b.fabric.covers(h, "shared")
+        assert b.probe(h)
+        # the http ECHO merged B's view back into A (nothing new here —
+        # B only knows what A sent — but the counter proves the path)
+        assert pub_a.stats()["sent"] == 2
+        assert pub_b.merged_in == 1
+        # malformed frames are protocol errors, not crashes
+        with pytest.raises(ValueError):
+            asyncio.run(pub_b.handle_advert({"nope": 1}))
+        # a publisher with no store (engine still building) is a no-op
+        idle = FabricIndexPublisher(lambda: None, "hostC")
+        assert asyncio.run(idle.publish_once()) == {"sent": 0,
+                                                    "hashes": 0}
+    finally:
+        a.close()
+        b.close()
+
+
+# ----------------------------------- three-tier hit-token conservation
+
+def _engine(tmp_path, prefix_cache=True, object_url="", ledger=None,
+            host_bytes=1 << 30):
+    config = EngineConfig(
+        model="llama3-test", max_batch=2, max_seq_len=128, page_size=PS,
+        num_pages=12, prefill_buckets=(16, 64), dtype="float32",
+        attn_impl="reference", prefix_cache=prefix_cache,
+        prefix_tiers=prefix_cache, tier_host_bytes=host_bytes,
+        tier_disk_bytes=0, tier_spill_quant="",
+        tier_object_url=object_url)
+    return TPUEngine(config, ledger=ledger)
+
+
+async def _gen(engine, ids, n=6, **kw):
+    return [t async for t in engine.generate(ids, max_tokens=n, **kw)]
+
+
+def test_three_tier_conservation_with_cross_host_object_hit(tmp_path):
+    """Host A prefills a template and its pages reach the object store;
+    host B (fresh engine, SAME backend, no local cache) learns the chain
+    from A's advert and serves the match FROM T3 — continuation
+    byte-identical to a cold admission, tier_hit_tokens gains an
+    "object" column, and the conservation law holds with three tiers:
+    sum(tier_hit_tokens) == prefix_hit_tokens == the tenant ledger's
+    cache_hit column (the cross-host ledger path of ISSUE 20)."""
+    url = f"file://{tmp_path}/bucket"
+    template = list(range(3, 36))              # 2 full pages + tail
+
+    async def main():
+        host_a = _engine(tmp_path, object_url=url)
+        await host_a.start()
+        try:
+            await _gen(host_a, template + [40])
+            store_a = host_a._tier_client.store
+            # push the cached chain through the REAL spill + write-behind
+            # path: evict every cached page, then wait for T3 to land it
+            local = host_a.allocator
+            saved, local._free = local._free, []
+            while local._walk_prefix(template + [88]):
+                saved.append(local._take_page())
+            local._free = saved
+            with store_a._lock:
+                for key_hash in list(store_a._host):
+                    payload = store_a._host.pop(key_hash)
+                    store_a._host_nbytes -= payload.nbytes
+                    store_a._pending[key_hash] = payload
+                    store_a._writeq.put(key_hash)
+            store_a._ensure_writer()
+            deadline = time.monotonic() + 20
+            while (store_a.stats()["object_pages"] < 2
+                   and time.monotonic() < deadline):
+                await asyncio.sleep(0.02)
+            assert store_a.stats()["object_pages"] >= 2
+            advert = FabricAdvert(tenant="shared", host="hostA",
+                                  hashes=store_a.object_hashes())
+        finally:
+            await host_a.stop()
+
+        ledger = TenantLedger()
+        host_b = _engine(tmp_path, object_url=url, ledger=ledger)
+        cold = _engine(tmp_path, prefix_cache=False)
+        await host_b.start()
+        await cold.start()
+        try:
+            store_b = host_b._tier_client.store
+            assert store_b.fabric.merge(advert) >= 2
+            out_b = await _gen(host_b, template + [40], tenant="team:x")
+            out_c = await _gen(cold, template + [40])
+            assert out_b == out_c              # byte-identical via T3
+            alloc = host_b.allocator
+            assert alloc.tier_hit_tokens["object"] >= 2 * PS
+            assert store_b.stats()["object_reads"] >= 2
+            # conservation with THREE tiers wired
+            assert set(alloc.tier_hit_tokens) == {"hbm", "host", "disk",
+                                                  "object"}
+            assert (sum(alloc.tier_hit_tokens.values())
+                    == alloc.prefix_hit_tokens)
+            # the tenant ledger metered the SAME tokens as cache_hit —
+            # exact, even though another host prefilled them
+            totals = ledger.totals()["team:x"]
+            assert totals["cache_hit_tokens"] == alloc.prefix_hit_tokens
+        finally:
+            await host_b.stop()
+            await cold.stop()
+
+    asyncio.run(main())

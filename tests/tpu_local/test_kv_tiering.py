@@ -415,8 +415,9 @@ def test_tier_stats_surface_shapes():
                 await _gen(engine, ids, n=2)
             stats = engine.tier_stats()
             assert stats["enabled"] is True
-            assert set(stats["hits"]) == {"hbm", "host", "disk"}
-            assert set(stats["hit_tokens"]) == {"hbm", "host", "disk"}
+            assert set(stats["hits"]) == {"hbm", "host", "disk", "object"}
+            assert set(stats["hit_tokens"]) == {"hbm", "host", "disk",
+                                                "object"}
             assert stats["store"]["host_budget_bytes"] > 0
             assert stats["restores"] >= 1
             assert stats["restore_p95_ms"] is not None
@@ -454,7 +455,8 @@ def test_prefix_index_chain_locations_and_reachability():
     index.drop_replica("1")
     chain = index.chain_locations(prompt, PS)
     assert index.reachable_tokens(chain, "1", PS) == 16  # tier only
-    assert index.stats() == {"keys_hbm": 0, "keys_tiered": 1}
+    assert index.stats() == {"keys_hbm": 0, "keys_tiered": 1,
+                             "keys_object": 0}
 
 
 # ---------------------------------------------- disk IO hardening (ISSUE 14)

@@ -205,7 +205,7 @@ def test_first_capture_of_a_new_arm_is_surfaced_not_silent(tmp_path, capsys):
     assert series["new_arms"] == [
         {"superstep": 8, "prefix_tiers": False, "workers": 1,
          "controller": False, "roles": [], "in_process": True,
-         "capture": "BENCH_TPU_r03.json"}]
+         "fabric": False, "capture": "BENCH_TPU_r03.json"}]
     assert main(["--root", str(tmp_path)]) == 0
     out = capsys.readouterr().out
     assert "no history to gate yet" in out
@@ -287,6 +287,35 @@ def test_roles_captures_gate_as_their_own_arm(tmp_path):
               if c["metric"] == "value"}
     assert by_arm[()]["regressed"] is False
     assert by_arm[("prefill", "decode")]["regressed"] is True
+
+
+def test_fabric_captures_gate_as_their_own_arm(tmp_path):
+    """A cross-host fabric capture (BENCH_PREFIX_FABRIC / the fabric
+    gateway scenario: T3 object restores replacing prefills,
+    docs/cache_fabric.md) is a different tok/s regime than the local
+    tiers — it must only median against fabric history, and a
+    regression inside the arm must name it."""
+    _write_series(tmp_path, "BENCH_SCENARIO_FABRIC", [
+        _capture(100.0),                                  # non-fabric
+        {**_capture(60.0), "fabric": True},
+        _capture(101.0),                                  # non-fabric
+        {**_capture(59.0), "fabric": True},
+    ])
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert report["ok"], report["regressions"]
+    assert report["checks"] >= 4          # both arms actually compared
+    # a fabric-arm collapse is caught within the arm and labelled
+    (tmp_path / "BENCH_SCENARIO_FABRIC_r05.json").write_text(json.dumps(
+        {**_capture(20.0), "fabric": True}))
+    report = run_check(str(tmp_path), tolerance=0.25)
+    assert not report["ok"]
+    assert any("@fabric" in line for line in report["regressions"])
+    # the non-fabric arm stayed green: the collapse did not bleed across
+    by_arm = {c["fabric"]: c
+              for r in report["series"] for c in r["checks"]
+              if c["metric"] == "value"}
+    assert by_arm[False]["regressed"] is False
+    assert by_arm[True]["regressed"] is True
 
 
 def test_real_process_captures_gate_as_their_own_arm(tmp_path):
